@@ -1,0 +1,18 @@
+"""ceph_tpu — TPU-native erasure coding + CRUSH placement framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of the reference's
+erasure-code and placement subsystems (reference: src/erasure-code, src/crush
+— see SURVEY.md), with C++ oracles standing in for the reference's native
+jerasure/gf-complete/mapper.c as bit-exactness referees and CPU baselines.
+
+Layout (SURVEY.md §7):
+    gf/        GF(2^8) tables, jerasure-exact matrix construction, inversion
+    ops/       bitplane packing + XLA/Pallas GF(2) matmul encode kernels
+    ec/        ErasureCodeInterface-style codec layer, registry, plugins
+    crush/     rjenkins hash, crush_ln, straw2, rule interpreter, batch mapper
+    parallel/  device-mesh sharding of stripe batches and CRUSH x-batches
+    bench/     ceph_erasure_code_benchmark-compatible CLI
+    utils/     profiles, perf counters, config options
+"""
+
+__version__ = "0.1.0"

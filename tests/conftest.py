@@ -1,0 +1,19 @@
+"""Test harness config — ring 1 of SURVEY.md §4.
+
+Tests run on CPU with a virtual 8-device mesh so multi-chip sharding
+(ceph_tpu.parallel) is exercised without TPU hardware, mirroring how the
+reference tests its distributed logic on one box (qa/standalone,
+SURVEY.md §4 ring 2).  Must set env vars before the first jax import.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)  # straw2 needs exact int64 (SURVEY.md §7)

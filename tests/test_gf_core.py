@@ -1,0 +1,163 @@
+"""Ring-1 unit tests for the GF(2^8) core (SURVEY.md §4).
+
+Models the reference's pure-function EC tests
+(reference: src/test/erasure-code/TestErasureCode.cc,
+TestErasureCodeJerasure.cc — encode->erase->decode round trips).
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.gf import (
+    GF_MUL_TABLE,
+    cauchy_good_coding_matrix,
+    cauchy_n_ones,
+    cauchy_original_coding_matrix,
+    decode_matrix_for,
+    gf_div,
+    gf_inv,
+    gf_matmul,
+    gf_mul,
+    invert_matrix,
+    matrix_to_bitmatrix,
+    systematic_generator,
+    vandermonde_coding_matrix,
+)
+from ceph_tpu.gf.reference_codec import apply_matrix, decode_chunks, encode_chunks
+
+
+class TestGFArithmetic:
+    def test_field_axioms_exhaustive(self):
+        # associativity/commutativity/distributivity over random triples plus
+        # full closure of the 256x256 table
+        assert GF_MUL_TABLE.shape == (256, 256)
+        rng = np.random.default_rng(0)
+        for _ in range(2000):
+            a, b, c = (int(v) for v in rng.integers(0, 256, 3))
+            assert gf_mul(a, b) == gf_mul(b, a)
+            assert gf_mul(a, gf_mul(b, c)) == gf_mul(gf_mul(a, b), c)
+            assert gf_mul(a, b ^ c) == gf_mul(a, b) ^ gf_mul(a, c)
+
+    def test_inverse_exhaustive(self):
+        for a in range(1, 256):
+            assert gf_mul(a, gf_inv(a)) == 1
+            assert gf_div(1, a) == gf_inv(a)
+
+    def test_known_products_poly_0x11d(self):
+        # anchors for the 0x11D convention (same as jerasure w=8 / ISA-L)
+        assert gf_mul(2, 128) == 0x1D  # x * x^7 = x^8 -> reduction
+        assert gf_mul(2, 0x8E) == 0x01  # 0x11C ^ 0x11D: inverse of x in 0x11D
+        assert gf_inv(2) == 0x8E
+
+    def test_mul_table_diagonal_squares(self):
+        for a in range(256):
+            assert GF_MUL_TABLE[a, a] == gf_mul(a, a)
+
+
+class TestMatrices:
+    def test_vandermonde_first_row_all_ones(self):
+        # jerasure property: first parity row is pure XOR
+        for k, m in [(2, 1), (3, 2), (4, 2), (6, 3), (8, 4), (10, 4)]:
+            c = vandermonde_coding_matrix(k, m)
+            assert c.shape == (m, k)
+            assert (c[0] == 1).all()
+
+    def test_vandermonde_mds(self):
+        # every k x k submatrix of [I;C] invertible => any m erasures decodable
+        for k, m in [(2, 1), (4, 2), (8, 4)]:
+            gen = systematic_generator(vandermonde_coding_matrix(k, m))
+            for rows in itertools.combinations(range(k + m), k):
+                dm = invert_matrix(gen[list(rows), :])
+                prod = gf_matmul(dm, gen[list(rows), :])
+                assert (prod == np.eye(k)).all()
+
+    def test_cauchy_original_values(self):
+        m_, k_ = 2, 3
+        c = cauchy_original_coding_matrix(k_, m_)
+        for i in range(m_):
+            for j in range(k_):
+                assert c[i, j] == gf_inv(i ^ (m_ + j))
+
+    def test_cauchy_good_first_row_ones_and_mds(self):
+        for k, m in [(2, 1), (4, 3), (8, 4), (6, 3)]:
+            c = cauchy_good_coding_matrix(k, m)
+            assert (c[0] == 1).all()
+            gen = systematic_generator(c)
+            for rows in itertools.combinations(range(k + m), k):
+                invert_matrix(gen[list(rows), :])  # must not raise
+
+    def test_cauchy_improve_reduces_ones(self):
+        k, m = 8, 4
+        orig = cauchy_original_coding_matrix(k, m)
+        good = cauchy_good_coding_matrix(k, m)
+        n1 = sum(cauchy_n_ones(int(v)) for v in orig.ravel())
+        n2 = sum(cauchy_n_ones(int(v)) for v in good.ravel())
+        assert n2 <= n1
+
+    def test_n_ones_identity(self):
+        assert cauchy_n_ones(1) == 8  # identity bitmatrix
+        for n in range(1, 256):
+            bm = matrix_to_bitmatrix(np.array([[n]]))
+            assert cauchy_n_ones(n) == int(bm.sum())
+
+    def test_bitmatrix_equals_gf_mul(self):
+        # multiplying bitplanes by the bitmatrix == GF byte multiply
+        rng = np.random.default_rng(1)
+        for e in [1, 2, 3, 0x1D, 0x8E, 255]:
+            bm = matrix_to_bitmatrix(np.array([[e]]))  # [8, 8]
+            bytes_in = rng.integers(0, 256, 64, dtype=np.uint8)
+            bits_in = (bytes_in[None, :] >> np.arange(8)[:, None]) & 1  # [8, N]
+            bits_out = bm.astype(np.int64) @ bits_in & 1
+            bytes_out = (bits_out << np.arange(8)[:, None]).sum(0).astype(np.uint8)
+            expected = GF_MUL_TABLE[e, bytes_in]
+            np.testing.assert_array_equal(bytes_out, expected)
+
+    def test_invert_roundtrip_random(self):
+        rng = np.random.default_rng(2)
+        done = 0
+        while done < 20:
+            n = int(rng.integers(2, 9))
+            mat = rng.integers(0, 256, (n, n)).astype(np.int64)
+            try:
+                inv = invert_matrix(mat)
+            except np.linalg.LinAlgError:
+                continue
+            assert (gf_matmul(inv, mat) == np.eye(n)).all()
+            done += 1
+
+
+class TestReferenceCodec:
+    @pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (8, 4), (6, 3)])
+    @pytest.mark.parametrize("technique", ["reed_sol_van", "cauchy_good"])
+    def test_roundtrip_all_erasure_patterns(self, k, m, technique):
+        mk = (
+            vandermonde_coding_matrix
+            if technique == "reed_sol_van"
+            else cauchy_good_coding_matrix
+        )
+        coding = mk(k, m)
+        rng = np.random.default_rng(k * 17 + m)
+        data = rng.integers(0, 256, (k, 128), dtype=np.uint8)
+        parity = encode_chunks(coding, data)
+        shards = {i: data[i] for i in range(k)} | {
+            k + i: parity[i] for i in range(m)
+        }
+        for erased in itertools.combinations(range(k + m), m):
+            avail = {i: v for i, v in shards.items() if i not in erased}
+            out = decode_chunks(coding, k, avail)
+            for i in range(k + m):
+                np.testing.assert_array_equal(out[i], shards[i], err_msg=f"shard {i} erased={erased}")
+
+    def test_encode_xor_row(self):
+        # first parity of reed_sol_van is the XOR of all data chunks
+        k, m = 5, 2
+        coding = vandermonde_coding_matrix(k, m)
+        rng = np.random.default_rng(3)
+        data = rng.integers(0, 256, (k, 64), dtype=np.uint8)
+        parity = encode_chunks(coding, data)
+        np.testing.assert_array_equal(parity[0], np.bitwise_xor.reduce(data, axis=0))
+
+    def test_apply_matrix_identity(self):
+        data = np.arange(64, dtype=np.uint8).reshape(2, 32)
+        np.testing.assert_array_equal(apply_matrix(np.eye(2), data), data)
