@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Headline benchmark — one JSON line for the driver.
+
+Measures the north-star metric (BASELINE.json): RS(8,4) cauchy_good encode
+throughput on one TPU chip via the bitplane kernel (best of XLA and Pallas),
+against the CPU SIMD oracle (native/gf_oracle.cc — the ISA-L-formulation
+baseline) on this host.  vs_baseline = TPU GiB/s / CPU GiB/s; the acceptance
+bar is >= 10x.  Timing subtleties live in ceph_tpu/bench/timing.py.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def cpu_baseline_gibps(coding, k, data_mib=64, reps=3) -> float:
+    from ceph_tpu import native_oracle
+
+    data = np.random.default_rng(0).integers(
+        0, 256, (k, data_mib * 2**20 // k), dtype=np.uint8
+    )
+    native_oracle.encode(coding, data, fast=True)  # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        native_oracle.encode(coding, data, fast=True)
+    dt = (time.perf_counter() - t0) / reps
+    return data.nbytes / dt / 2**30
+
+
+def tpu_gibps(coding, k, data_mib=256, iters=50) -> tuple[float, str]:
+    from ceph_tpu.bench.timing import time_chained_encode
+
+    data = np.random.default_rng(1).integers(
+        0, 256, (k, data_mib * 2**20 // k), dtype=np.uint8
+    )
+    best = 0.0
+    best_kernel = "xla"
+    for kernel in ("xla", "pallas"):
+        try:
+            secs = time_chained_encode(
+                coding, data, iters, kernel=kernel,
+                subtract_overhead=True, repeats=3,
+            )
+        except Exception as e:  # pallas may be unavailable on some backends
+            print(f"# kernel {kernel} failed: {e}", file=sys.stderr)
+            continue
+        gibps = data.nbytes * iters / secs / 2**30
+        if gibps > best:
+            best, best_kernel = gibps, kernel
+    return best, best_kernel
+
+
+def main():
+    from ceph_tpu.gf import cauchy_good_coding_matrix
+
+    k, m = 8, 4
+    coding = np.ascontiguousarray(cauchy_good_coding_matrix(k, m), dtype=np.uint8)
+    try:
+        cpu = cpu_baseline_gibps(coding, k)
+    except Exception as e:  # oracle build failure shouldn't kill the bench
+        print(f"# cpu baseline unavailable: {e}", file=sys.stderr)
+        cpu = None
+    tpu, kernel = tpu_gibps(coding, k)
+    print(
+        json.dumps(
+            {
+                "metric": f"rs8_4_cauchy_good_encode_throughput_{kernel}",
+                "value": round(tpu, 2),
+                "unit": "GiB/s",
+                "vs_baseline": round(tpu / cpu, 2) if cpu else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

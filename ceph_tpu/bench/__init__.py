@@ -1,0 +1,1 @@
+"""Benchmark CLIs: ec_bench (ceph_erasure_code_benchmark analog), crush_bench."""

@@ -1,0 +1,85 @@
+"""CRUSH mapping benchmark CLI — BASELINE.json config 5.
+
+The analog of `crushtool --test` timing runs (reference:
+src/tools/crushtool.cc + src/crush/CrushTester.cc) over a large x batch:
+maps N placement inputs through a rule on the TPU batch mapper and on the
+C++ oracle (the compiled-C mapper baseline), reporting maps/s.
+
+Usage:
+    python -m ceph_tpu.bench.crush_bench --osds 1024 --hosts 128 \
+        --num-pgs 10000000 --numrep 3 [--backend jax|oracle|both] [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="ceph_tpu.bench.crush_bench")
+    p.add_argument("--osds", type=int, default=1024)
+    p.add_argument("--hosts", type=int, default=128)
+    p.add_argument("--num-pgs", type=int, default=1_000_000, dest="num_pgs")
+    p.add_argument("--numrep", type=int, default=3)
+    p.add_argument("--rule", type=int, default=0, help="0=firstn replicated, 1=indep EC")
+    p.add_argument("--backend", choices=["jax", "oracle", "both"], default="both")
+    p.add_argument("--json", action="store_true")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    from ceph_tpu.crush import CompiledCrushMap, build_hierarchical_map, crush_do_rule_batch
+
+    if args.osds % args.hosts:
+        raise SystemExit("--osds must be divisible by --hosts")
+    cmap = build_hierarchical_map(args.hosts, args.osds // args.hosts)
+    weights = np.full(args.osds, 0x10000, dtype=np.uint32)
+    xs = np.arange(args.num_pgs, dtype=np.int64)
+    res: dict = {
+        "osds": args.osds,
+        "hosts": args.hosts,
+        "num_pgs": args.num_pgs,
+        "numrep": args.numrep,
+        "rule": args.rule,
+    }
+
+    if args.backend in ("jax", "both"):
+        cm = CompiledCrushMap(cmap)
+        warm = crush_do_rule_batch(cm, args.rule, xs[:1024], args.numrep, weights)
+        np.asarray(warm)  # compile + sync
+        t0 = time.perf_counter()
+        out = crush_do_rule_batch(cm, args.rule, xs, args.numrep, weights)
+        out = np.asarray(out)  # fetch = true barrier
+        dt = time.perf_counter() - t0
+        res["jax_maps_per_s"] = round(args.num_pgs / dt)
+        res["jax_seconds"] = round(dt, 4)
+        res["sample"] = out[:2].tolist()
+
+    if args.backend in ("oracle", "both"):
+        from ceph_tpu.crush.oracle_bridge import do_rule_batch_oracle
+
+        n = min(args.num_pgs, 1_000_000)  # oracle baseline on a capped batch
+        t0 = time.perf_counter()
+        out_o = do_rule_batch_oracle(cmap, args.rule, xs[:n], args.numrep, weights)
+        dt = time.perf_counter() - t0
+        res["oracle_maps_per_s"] = round(n / dt)
+        res["oracle_seconds"] = round(dt, 4)
+        if args.backend == "both" and "sample" in res:
+            match = (out_o[:2] == np.asarray(res["sample"])).all()
+            res["bit_exact_vs_oracle"] = bool(
+                (out_o == np.asarray(out[: len(out_o)])).all()
+            ) if args.num_pgs <= 1_000_000 else bool(match)
+
+    if "jax_maps_per_s" in res and "oracle_maps_per_s" in res:
+        res["speedup"] = round(res["jax_maps_per_s"] / res["oracle_maps_per_s"], 2)
+    print(json.dumps(res) if args.json else res)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

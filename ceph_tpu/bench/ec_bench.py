@@ -1,0 +1,136 @@
+"""ceph_erasure_code_benchmark-compatible CLI.
+
+Re-creation of the reference's benchmark harness (reference:
+src/test/erasure-code/ceph_erasure_code_benchmark.cc :: ErasureCodeBench —
+flags --plugin/--parameter/--workload/--size/--iterations/--erasures/
+--erasures-generation; prints seconds and bytes), so BASELINE numbers are
+produced by a CLI-compatible tool (SURVEY.md §3.5 "the contract for BASELINE
+measurements").
+
+Extra over the reference: `--json` emits one machine-readable line, and TPU
+runs amortize the dispatch/tunnel latency by chaining iterations on-device
+(each iteration consumes the previous result, so nothing is elided; see
+--no-chain to force per-iteration dispatch like the reference's loop).
+
+Usage example (BASELINE.json config 2):
+    python -m ceph_tpu.bench.ec_bench encode --plugin jax \
+        --parameter k=8 --parameter m=4 --parameter technique=cauchy_good \
+        --size 1048576 --iterations 64
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+from ..ec.registry import ErasureCodePluginRegistry
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="ceph_tpu.bench.ec_bench")
+    p.add_argument("workload", choices=["encode", "decode"])
+    p.add_argument("--plugin", "-P", default="jax")
+    p.add_argument(
+        "--parameter",
+        "-p",
+        action="append",
+        default=[],
+        help="profile key=value (repeatable), e.g. -p k=8 -p m=4",
+    )
+    p.add_argument("--size", "-s", type=int, default=1 << 20, help="object bytes per iteration")
+    p.add_argument("--iterations", "-i", type=int, default=16)
+    p.add_argument("--erasures", "-e", type=int, default=1)
+    p.add_argument(
+        "--erasures-generation",
+        choices=["random", "exhaustive"],
+        default="random",
+    )
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--no-chain", action="store_true", help="per-iteration dispatch")
+    return p.parse_args(argv)
+
+
+def build_codec(args):
+    profile = {"plugin": args.plugin}
+    for kv in args.parameter:
+        key, _, val = kv.partition("=")
+        profile[key] = val
+    return ErasureCodePluginRegistry.instance().factory(profile), profile
+
+
+def run_encode(codec, args) -> dict:
+    from .timing import time_chained_encode
+
+    rng = np.random.default_rng(args.seed)
+    chunk_size = codec.get_chunk_size(args.size)
+    chunks = rng.integers(0, 256, (codec.k, chunk_size), dtype=np.uint8)
+    if getattr(codec, "backend", None) == "jax" and not args.no_chain:
+        seconds = time_chained_encode(codec.coding, chunks, args.iterations)
+    else:
+        codec.encode_chunks(chunks)  # warm
+        t0 = time.perf_counter()
+        for _ in range(args.iterations):
+            codec.encode_chunks(chunks)
+        seconds = time.perf_counter() - t0
+    total = args.size * args.iterations
+    return {"seconds": seconds, "bytes": total}
+
+
+def run_decode(codec, args) -> dict:
+    import itertools
+
+    rng = np.random.default_rng(args.seed)
+    k, m, n = codec.k, codec.m, codec.get_chunk_count()
+    chunk_size = codec.get_chunk_size(args.size)
+    data = rng.integers(0, 256, (k, chunk_size), dtype=np.uint8)
+    encoded = codec.encode(set(range(n)), data.tobytes())
+    if args.erasures > m:
+        raise SystemExit(f"--erasures {args.erasures} > m={m}")
+    if args.erasures_generation == "exhaustive":
+        patterns = itertools.cycle(
+            itertools.combinations(range(n), args.erasures)
+        )
+    else:
+        patterns = iter(
+            lambda: tuple(rng.choice(n, size=args.erasures, replace=False)), None
+        )
+    want = set(range(k))
+    t0 = time.perf_counter()
+    for _ in range(args.iterations):
+        erased = set(int(x) for x in next(patterns))
+        have = {i: c for i, c in encoded.items() if i not in erased}
+        codec.decode(want, have, chunk_size)
+    seconds = time.perf_counter() - t0
+    return {"seconds": seconds, "bytes": args.size * args.iterations}
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    codec, profile = build_codec(args)
+    res = (run_encode if args.workload == "encode" else run_decode)(codec, args)
+    gibps = res["bytes"] / max(res["seconds"], 1e-12) / 2**30
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "workload": args.workload,
+                    "profile": profile,
+                    "seconds": round(res["seconds"], 6),
+                    "bytes": res["bytes"],
+                    "GiB_per_s": round(gibps, 3),
+                }
+            )
+        )
+    else:
+        # reference output shape: "<seconds>\t<bytes>"
+        print(f"{res['seconds']:.6f}\t{res['bytes']}")
+        print(f"# {gibps:.2f} GiB/s {args.workload} plugin={args.plugin}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

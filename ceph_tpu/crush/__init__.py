@@ -1,0 +1,31 @@
+"""CRUSH placement: straw2 + rule interpreter, batched for TPU.
+
+TPU-native rebuild of the reference's src/crush subsystem (SURVEY.md §2.2).
+"""
+from .builder import (
+    add_simple_rule,
+    build_flat_map,
+    build_hierarchical_map,
+    make_straw2_bucket,
+)
+from .mapper import CompiledCrushMap, crush_do_rule_batch
+from .reference_mapper import bucket_straw2_choose, crush_do_rule
+from .types import ITEM_NONE, CrushMap, Rule, RuleOp, RuleStep, Straw2Bucket, Tunables
+
+__all__ = [
+    "ITEM_NONE",
+    "CompiledCrushMap",
+    "CrushMap",
+    "Rule",
+    "RuleOp",
+    "RuleStep",
+    "Straw2Bucket",
+    "Tunables",
+    "add_simple_rule",
+    "bucket_straw2_choose",
+    "build_flat_map",
+    "build_hierarchical_map",
+    "crush_do_rule",
+    "crush_do_rule_batch",
+    "make_straw2_bucket",
+]

@@ -1,0 +1,136 @@
+"""Programmatic CRUSH map construction — builder.c + CrushWrapper rule helpers.
+
+Reference: src/crush/builder.c :: crush_make_straw2_bucket / crush_add_bucket,
+and src/crush/CrushWrapper.cc :: add_simple_rule (replicated) plus the EC rule
+OSDMonitor creates for erasure pools.  Also the standard test topology
+generator used by golden tests (the analog of crushtool --build).
+"""
+from __future__ import annotations
+
+from .types import CrushMap, Rule, RuleOp, RuleStep, Straw2Bucket
+
+
+def make_straw2_bucket(
+    cmap: CrushMap,
+    type_id: int,
+    items: list[int],
+    weights: list[int],
+    bucket_id: int | None = None,
+    name: str | None = None,
+) -> Straw2Bucket:
+    """builder.c :: crush_make_straw2_bucket + crush_add_bucket."""
+    if len(items) != len(weights):
+        raise ValueError("items and weights must have equal length")
+    if bucket_id is None:
+        bucket_id = -1
+        while bucket_id in cmap.buckets:
+            bucket_id -= 1
+    if bucket_id >= 0:
+        raise ValueError("bucket ids are negative")
+    if bucket_id in cmap.buckets:
+        raise ValueError(f"bucket {bucket_id} exists")
+    b = Straw2Bucket(id=bucket_id, type=type_id, items=list(items), weights=list(weights))
+    cmap.buckets[bucket_id] = b
+    for it in items:
+        if it >= 0:
+            cmap.max_devices = max(cmap.max_devices, it + 1)
+    if name:
+        cmap.bucket_names[bucket_id] = name
+    return b
+
+
+def add_simple_rule(
+    cmap: CrushMap,
+    root: int,
+    failure_domain_type: int,
+    rule_id: int | None = None,
+    firstn: bool = True,
+    num_replicas: int = 0,
+) -> Rule:
+    """CrushWrapper.cc :: add_simple_rule — take root, chooseleaf over the
+    failure domain, emit.  num_replicas 0 means 'use the requested numrep'
+    (CRUSH_CHOOSE_N)."""
+    if rule_id is None:
+        rule_id = max(cmap.rules, default=-1) + 1
+    op = RuleOp.CHOOSELEAF_FIRSTN if firstn else RuleOp.CHOOSELEAF_INDEP
+    if failure_domain_type == 0:
+        op = RuleOp.CHOOSE_FIRSTN if firstn else RuleOp.CHOOSE_INDEP
+    rule = Rule(
+        rule_id=rule_id,
+        type=1 if firstn else 3,
+        steps=[
+            RuleStep(RuleOp.TAKE, root),
+            RuleStep(op, num_replicas, failure_domain_type),
+            RuleStep(RuleOp.EMIT),
+        ],
+    )
+    cmap.rules[rule_id] = rule
+    return rule
+
+
+def build_flat_map(n_osds: int, device_weight: float = 1.0) -> CrushMap:
+    """One root straw2 bucket holding every OSD (simplest useful map)."""
+    cmap = CrushMap()
+    cmap.type_names.update({1: "root"})
+    w = int(device_weight * 0x10000)
+    make_straw2_bucket(
+        cmap, 1, list(range(n_osds)), [w] * n_osds, bucket_id=-1, name="default"
+    )
+    cmap.max_devices = n_osds
+    add_simple_rule(cmap, -1, 0, rule_id=0)
+    return cmap
+
+
+def build_hierarchical_map(
+    n_hosts: int,
+    osds_per_host: int,
+    device_weight: float = 1.0,
+    firstn: bool = True,
+    racks: int = 0,
+) -> CrushMap:
+    """root -> (racks ->) hosts -> osds, replicated + erasure rules.
+
+    The standard topology of the reference's CRUSH tests (reference:
+    src/test/crush/crush.cc builds analogous root/host trees).
+    """
+    cmap = CrushMap()
+    cmap.type_names.update({1: "host", 2: "rack", 10: "root"})
+    w = int(device_weight * 0x10000)
+    host_ids = []
+    osd = 0
+    for h in range(n_hosts):
+        items = list(range(osd, osd + osds_per_host))
+        osd += osds_per_host
+        b = make_straw2_bucket(
+            cmap, 1, items, [w] * len(items), bucket_id=-(h + 2), name=f"host{h}"
+        )
+        host_ids.append(b.id)
+    top_children = host_ids
+    if racks:
+        rack_ids = []
+        per = max(1, n_hosts // racks)
+        for r in range(racks):
+            hs = host_ids[r * per : (r + 1) * per] or host_ids[-1:]
+            b = make_straw2_bucket(
+                cmap,
+                2,
+                hs,
+                [cmap.buckets[h].weight for h in hs],
+                bucket_id=-(n_hosts + 2 + r),
+                name=f"rack{r}",
+            )
+            rack_ids.append(b.id)
+        top_children = rack_ids
+    make_straw2_bucket(
+        cmap,
+        10,
+        top_children,
+        [cmap.buckets[c].weight for c in top_children],
+        bucket_id=-1,
+        name="default",
+    )
+    cmap.max_devices = osd
+    add_simple_rule(cmap, -1, 1, rule_id=0, firstn=firstn)
+    # erasure-style indep rule over hosts (OSDMonitor's EC rule shape)
+    add_simple_rule(cmap, -1, 1, rule_id=1, firstn=False)
+    return cmap

@@ -1,0 +1,126 @@
+"""CRUSH rjenkins1 hash — vectorized, bit-exact uint32 semantics.
+
+Reference: src/crush/hash.c :: crush_hash32_rjenkins1{_2,_3,_4} — Robert
+Jenkins' 32-bit integer mix.  All arithmetic is mod 2^32 (wrapping
+subtraction, XOR, shifts); trivially vectorizable (SURVEY.md §2.2 "Trivial
+to vectorize; must match bit-for-bit").  Implemented over jnp.uint32 so the
+same code runs scalar (host) and batched (TPU) under vmap/jit; the C++
+oracle (native/crush_oracle.cc) implements the same functions for
+cross-checking.
+
+Provenance caveat (SURVEY.md §0): written from the documented hash.c
+structure; the reference mount was empty, so upstream equality could not be
+diffed this round — oracle<->JAX equality is what tests enforce.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+CRUSH_HASH_SEED = 1315423911
+
+
+def _mix(a, b, c):
+    """hash.c :: crush_hashmix(a, b, c) — mutates all three, returns them."""
+    a = a - b
+    a = a - c
+    a = a ^ (c >> 13)
+    b = b - c
+    b = b - a
+    b = b ^ (a << 8)
+    c = c - a
+    c = c - b
+    c = c ^ (b >> 13)
+    a = a - b
+    a = a - c
+    a = a ^ (c >> 12)
+    b = b - c
+    b = b - a
+    b = b ^ (a << 16)
+    c = c - a
+    c = c - b
+    c = c ^ (b >> 5)
+    a = a - b
+    a = a - c
+    a = a ^ (c >> 3)
+    b = b - c
+    b = b - a
+    b = b ^ (a << 10)
+    c = c - a
+    c = c - b
+    c = c ^ (b >> 15)
+    return a, b, c
+
+
+def _u32(x):
+    return jnp.asarray(x).astype(jnp.uint32)
+
+
+def crush_hash32(a):
+    """hash.c :: crush_hash32_rjenkins1."""
+    a = _u32(a)
+    hash_ = _u32(CRUSH_HASH_SEED) ^ a
+    b = a
+    x = _u32(231232)
+    y = _u32(1232)
+    b, x, hash_ = _mix(b, x, hash_)
+    y, a, hash_ = _mix(y, a, hash_)
+    return hash_
+
+
+def crush_hash32_2(a, b):
+    """hash.c :: crush_hash32_rjenkins1_2."""
+    a, b = _u32(a), _u32(b)
+    hash_ = _u32(CRUSH_HASH_SEED) ^ a ^ b
+    x = _u32(231232)
+    y = _u32(1232)
+    a, b, hash_ = _mix(a, b, hash_)
+    x, a, hash_ = _mix(x, a, hash_)
+    b, y, hash_ = _mix(b, y, hash_)
+    return hash_
+
+
+def crush_hash32_3(a, b, c):
+    """hash.c :: crush_hash32_rjenkins1_3 — the straw2 draw hash."""
+    a, b, c = _u32(a), _u32(b), _u32(c)
+    hash_ = _u32(CRUSH_HASH_SEED) ^ a ^ b ^ c
+    x = _u32(231232)
+    y = _u32(1232)
+    a, b, hash_ = _mix(a, b, hash_)
+    c, x, hash_ = _mix(c, x, hash_)
+    y, a, hash_ = _mix(y, a, hash_)
+    b, x, hash_ = _mix(b, x, hash_)
+    y, c, hash_ = _mix(y, c, hash_)
+    return hash_
+
+
+def crush_hash32_4(a, b, c, d):
+    """hash.c :: crush_hash32_rjenkins1_4 (chooseleaf / descend_once salt)."""
+    a, b, c, d = _u32(a), _u32(b), _u32(c), _u32(d)
+    hash_ = _u32(CRUSH_HASH_SEED) ^ a ^ b ^ c ^ d
+    x = _u32(231232)
+    y = _u32(1232)
+    a, b, hash_ = _mix(a, b, hash_)
+    c, d, hash_ = _mix(c, d, hash_)
+    a, x, hash_ = _mix(a, x, hash_)
+    y, b, hash_ = _mix(y, b, hash_)
+    c, x, hash_ = _mix(c, x, hash_)
+    y, d, hash_ = _mix(y, d, hash_)
+    return hash_
+
+
+def crush_hash32_3_np(a, b, c) -> np.ndarray:
+    """Numpy twin of crush_hash32_3 (host-side golden generator)."""
+    with np.errstate(over="ignore"):
+        a = np.asarray(a, dtype=np.uint32)
+        b = np.asarray(b, dtype=np.uint32)
+        c = np.asarray(c, dtype=np.uint32)
+        hash_ = np.uint32(CRUSH_HASH_SEED) ^ a ^ b ^ c
+        x = np.uint32(231232)
+        y = np.uint32(1232)
+        a, b, hash_ = _mix(a, b, hash_)
+        c, x, hash_ = _mix(c, x, hash_)
+        y, a, hash_ = _mix(y, a, hash_)
+        b, x, hash_ = _mix(b, x, hash_)
+        y, c, hash_ = _mix(y, c, hash_)
+        return hash_

@@ -1,0 +1,391 @@
+"""crush_do_rule_batch — the vectorized TPU CRUSH mapper (north-star #2).
+
+Reference: src/crush/mapper.c :: crush_do_rule / crush_choose_firstn /
+crush_choose_indep / bucket_straw2_choose, vectorized over the placement
+input x exactly as SURVEY.md §3.3 prescribes: all batch consumers (balancer,
+crushtool --test, osdmaptool --test-map-pgs) are embarrassingly parallel over
+x, and the data-dependent retry loops become fixed-trip masked loops bounded
+by choose_total_tries (default 50).
+
+Design:
+- The CrushMap is compiled once into dense arrays (items/weights/sizes/types
+  padded to the max bucket size) — the analog of CrushWrapper holding the
+  crush_map ready for crush_do_rule (reference: src/crush/CrushWrapper.h).
+- A rule compiles at trace time: step structure and replica counts are
+  static (static shapes for XLA), while every per-x decision — straw2
+  draws, descent, collisions, is_out rejections, retries — is traced jnp.
+- One x is evaluated by a single-x function; the batch is jax.vmap over x,
+  so the straw2 hash+ln-gather+argmax inner loop (HOT LOOP #3, SURVEY.md
+  §3.3) runs across the whole batch on the VPU.
+- int64-exact: draws are div64_s64-style truncating divisions on int64
+  (requires jax_enable_x64; SURVEY.md §7 hard parts).
+
+Scope matches the scalar twin (ceph_tpu/crush/reference_mapper.py): straw2
+buckets, modern tunables (stable=1, vary_r=1, local retries 0), rules of the
+shape TAKE -> (SET_*)* -> one CHOOSE/CHOOSELEAF -> EMIT (what
+add_simple_rule and OSDMonitor's EC rules emit).  The scalar Python, the C++
+oracle, and this mapper must agree bit-for-bit on every input — enforced by
+tests/test_crush.py over random maps and large x sweeps.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hash import crush_hash32_2, crush_hash32_3
+from .ln_table import CRUSH_LN_TABLE, LN_BIAS
+from .types import ITEM_NONE, CrushMap, RuleOp
+
+# straw2 is 64-bit fixed-point integer math (SURVEY.md §7 hard parts); the
+# mapper is unusable without x64, so the package enables it on import.
+jax.config.update("jax_enable_x64", True)
+
+S64_MIN = np.int64(np.iinfo(np.int64).min)
+
+
+class CompiledCrushMap:
+    """Dense-array form of a CrushMap for device execution."""
+
+    def __init__(self, cmap: CrushMap):
+        self.cmap = cmap
+        ids = sorted(cmap.buckets)
+        n_idx = max((-1 - bid for bid in ids), default=-1) + 1
+        max_size = max((b.size for b in cmap.buckets.values()), default=1)
+        items = np.full((max(n_idx, 1), max_size), ITEM_NONE, dtype=np.int32)
+        weights = np.zeros((max(n_idx, 1), max_size), dtype=np.int64)
+        sizes = np.zeros(max(n_idx, 1), dtype=np.int32)
+        types = np.zeros(max(n_idx, 1), dtype=np.int32)
+        for bid, b in cmap.buckets.items():
+            i = -1 - bid
+            items[i, : b.size] = b.items
+            weights[i, : b.size] = b.weights
+            sizes[i] = b.size
+            types[i] = b.type
+        self.items = jnp.asarray(items)
+        self.weights = jnp.asarray(weights)
+        self.sizes = jnp.asarray(sizes)
+        self.types = jnp.asarray(types)
+        self.n_idx = n_idx
+        self.ln_table = jnp.asarray(CRUSH_LN_TABLE)
+        self.max_size = max_size
+
+    def item_type(self, item):
+        """type of an item id: devices 0, buckets their declared type."""
+        idx = jnp.clip(jnp.where(item < 0, -1 - item, 0), 0, self.types.shape[0] - 1)
+        return jnp.where(item < 0, self.types[idx], 0)
+
+
+def _div64_trunc(a, b):
+    """C-style truncating signed division (div64_s64)."""
+    q = jnp.abs(a) // jnp.abs(b)
+    return jnp.where((a < 0) != (b < 0), -q, q).astype(jnp.int64)
+
+
+def _straw2_choose(cm: CompiledCrushMap, bucket_idx, x, r):
+    """mapper.c :: bucket_straw2_choose for one x (vmap-friendly).
+
+    Exponential-race draw per slot; first argmax matches the C loop's
+    strict-greater update.  Empty bucket -> ITEM_NONE; all-zero-weight
+    bucket -> items[0] (C semantics: high stays 0)."""
+    bucket_idx = jnp.clip(bucket_idx, 0, cm.items.shape[0] - 1)
+    items = cm.items[bucket_idx]        # [S]
+    weights = cm.weights[bucket_idx]    # [S]
+    size = cm.sizes[bucket_idx]
+    u = (
+        crush_hash32_3(
+            jnp.uint32(x), items.astype(jnp.uint32), jnp.uint32(r)
+        ).astype(jnp.int64)
+        & 0xFFFF
+    )
+    ln = cm.ln_table[u] - LN_BIAS
+    draw = _div64_trunc(ln, jnp.maximum(weights, 1))
+    slot = jnp.arange(items.shape[0])
+    valid = (slot < size) & (weights > 0)
+    draw = jnp.where(valid, draw, S64_MIN)
+    return jnp.where(size > 0, items[jnp.argmax(draw)], ITEM_NONE)
+
+
+def _is_out(weightvec, item, x):
+    """mapper.c :: is_out — probabilistic reject by device reweight."""
+    n = weightvec.shape[0]
+    idx = jnp.clip(item, 0, n - 1)
+    w = weightvec[idx].astype(jnp.int64)
+    oob = item >= n
+    h = crush_hash32_2(jnp.uint32(x), jnp.uint32(item)).astype(jnp.int64) & 0xFFFF
+    return oob | (w == 0) | ((w < 0x10000) & (h >= w))
+
+
+def _descend(cm: CompiledCrushMap, root, x, r, want_type: int):
+    """Walk intervening buckets until an item of want_type appears
+    (mapper.c's inner retry_bucket descent); dead ends yield ITEM_NONE.
+
+    Dead ends are: an empty bucket mid-descent, and a *device* of the wrong
+    type (mapper.c "bad item type" — e.g. an OSD placed directly under the
+    root when the rule wants hosts); both reject rather than mis-place."""
+
+    def cond(item):
+        return (item < 0) & (item != ITEM_NONE) & (cm.item_type(item) != want_type)
+
+    def body(item):
+        return _straw2_choose(cm, -1 - item, x, r)
+
+    item = jax.lax.while_loop(cond, body, jnp.asarray(root, jnp.int32))
+    if want_type != 0:
+        item = jnp.where(item >= 0, ITEM_NONE, item)
+    return item
+
+
+def _leaf_firstn(cm, weightvec, x, item, sub_r, outpos, out2, S, recurse_tries):
+    """Nested chooseleaf descent (crush_choose_firstn recursion with
+    stable=1: one rep, r = sub_r + ftotal, collisions vs out2[:outpos])."""
+
+    def body(state):
+        ftotal, _, done = state
+        leaf = _descend(cm, item, x, sub_r + ftotal, 0)
+        is_dev = leaf >= 0
+        collide = jnp.any((out2 == leaf) & (jnp.arange(S) < outpos)) & is_dev
+        reject = jnp.where(is_dev, _is_out(weightvec, leaf, x), True)
+        ok = is_dev & ~collide & ~reject
+        return ftotal + 1, leaf, done | ok
+
+    def cond(state):
+        ftotal, _, done = state
+        return (~done) & (ftotal < recurse_tries)
+
+    _, leaf, done = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.int32(ITEM_NONE), False)
+    )
+    return jnp.where(done, leaf, ITEM_NONE), done
+
+
+def _choose_firstn_single(
+    cm, weightvec, x, root, numrep, want_type, tries, recurse, recurse_tries
+):
+    """crush_choose_firstn for one x under modern tunables.
+
+    Returns (out, out2, count); out holds failure-domain items, out2 leaves
+    (== out when not recursing); both dense in [0, count)."""
+    S = numrep
+    out = jnp.full((S,), ITEM_NONE, dtype=jnp.int32)
+    out2 = jnp.full((S,), ITEM_NONE, dtype=jnp.int32)
+
+    def rep_body(rep, carry):
+        out, out2, outpos = carry
+
+        def try_body(state):
+            ftotal, _, _, done = state
+            r = rep + ftotal
+            cand = _descend(cm, root, x, r, want_type)
+            dead = cand == ITEM_NONE
+            collide = jnp.any((out == cand) & (jnp.arange(S) < outpos)) & ~dead
+            if recurse:
+                leaf, leaf_ok = jax.lax.cond(
+                    (cand < 0) & ~dead & ~collide,
+                    lambda: _leaf_firstn(
+                        cm, weightvec, x, cand, r, outpos, out2, S, recurse_tries
+                    ),
+                    lambda: (
+                        jnp.asarray(cand, jnp.int32),
+                        (cand >= 0) & ~_is_out(weightvec, cand, x),
+                    ),
+                )
+                reject = ~leaf_ok
+            else:
+                leaf = cand
+                reject = dead | jnp.where(
+                    cand >= 0, _is_out(weightvec, cand, x), False
+                )
+            ok = ~dead & ~collide & ~reject
+            return ftotal + 1, cand, leaf, done | ok
+
+        def try_cond(state):
+            ftotal, _, _, done = state
+            return (~done) & (ftotal < tries)
+
+        _, item, leaf, done = jax.lax.while_loop(
+            try_cond,
+            try_body,
+            (jnp.int32(0), jnp.int32(ITEM_NONE), jnp.int32(ITEM_NONE), False),
+        )
+        out = jnp.where(done, out.at[outpos].set(item), out)
+        out2 = jnp.where(done, out2.at[outpos].set(leaf), out2)
+        return out, out2, outpos + done.astype(jnp.int32)
+
+    out, out2, outpos = jax.lax.fori_loop(
+        0, numrep, rep_body, (out, out2, jnp.int32(0))
+    )
+    return out, out2, outpos
+
+
+def _choose_indep_single(
+    cm, weightvec, x, root, numrep, want_type, tries, recurse, recurse_tries
+):
+    """crush_choose_indep for one x: positional retries r = rep +
+    numrep*ftotal; failed positions stay ITEM_NONE (EC shard holes).
+    Leaf recursion checks no cross-rep collisions (mapper.c passes the
+    recursion outpos=rep, left=1, so its collide scan covers only [rep])."""
+    S = numrep
+    out = jnp.full((S,), ITEM_NONE, dtype=jnp.int32)
+    out2 = jnp.full((S,), ITEM_NONE, dtype=jnp.int32)
+    placed = jnp.zeros((S,), dtype=bool)
+
+    def ft_body(ftotal, carry):
+        out, out2, placed = carry
+
+        def rep_body(rep, carry2):
+            out, out2, placed = carry2
+            r = rep + numrep * ftotal
+            cand = _descend(cm, root, x, r, want_type)
+            dead = cand == ITEM_NONE
+            collide = jnp.any((out == cand) & placed) & ~dead
+            if recurse:
+
+                def leaf_loop():
+                    def lbody(state):
+                        lf, _, done = state
+                        leaf = _descend(cm, cand, x, rep + numrep * lf + r, 0)
+                        ok = (leaf >= 0) & ~_is_out(weightvec, leaf, x)
+                        return lf + 1, leaf, done | ok
+
+                    def lcond(state):
+                        lf, _, done = state
+                        return (~done) & (lf < recurse_tries)
+
+                    lf, leaf, ok = jax.lax.while_loop(
+                        lcond, lbody, (jnp.int32(0), jnp.int32(ITEM_NONE), False)
+                    )
+                    return jnp.where(ok, leaf, ITEM_NONE), ok
+
+                leaf, leaf_ok = jax.lax.cond(
+                    (cand < 0) & ~dead & ~collide,
+                    leaf_loop,
+                    lambda: (
+                        jnp.asarray(cand, jnp.int32),
+                        (cand >= 0) & ~_is_out(weightvec, cand, x),
+                    ),
+                )
+                ok = ~dead & ~collide & leaf_ok
+            else:
+                leaf = cand
+                reject = dead | jnp.where(
+                    cand >= 0, _is_out(weightvec, cand, x), False
+                )
+                ok = ~dead & ~collide & ~reject
+            take = ok & ~placed[rep]
+            out = jnp.where(take, out.at[rep].set(cand), out)
+            out2 = jnp.where(take, out2.at[rep].set(leaf), out2)
+            # structural dead end (empty bucket / bad item type): permanent
+            # NONE for this position, matching mapper.c's crush_choose_indep
+            # (out[rep] stays ITEM_NONE and is never retried)
+            dead_perm = (cand == ITEM_NONE) & ~placed[rep]
+            placed = placed.at[rep].set(placed[rep] | take | dead_perm)
+            return out, out2, placed
+
+        return jax.lax.fori_loop(0, numrep, rep_body, (out, out2, placed))
+
+    def ft_cond(state):
+        ftotal, (_, _, placed) = state
+        return (ftotal < tries) & ~placed.all()
+
+    def ft_step(state):
+        ftotal, carry = state
+        return ftotal + 1, ft_body(ftotal, carry)
+
+    _, (out, out2, placed) = jax.lax.while_loop(
+        ft_cond, ft_step, (jnp.int32(0), (out, out2, placed))
+    )
+    return out, out2, jnp.sum(placed.astype(jnp.int32))
+
+
+def compile_rule(cm: CompiledCrushMap, rule_id: int, numrep: int) -> dict:
+    """Static plan for a TAKE -> CHOOSE -> EMIT rule (trace-time)."""
+    rule = cm.cmap.rules[rule_id]
+    t = cm.cmap.tunables
+    plan = []
+    tries = t.choose_total_tries
+    leaf_tries = 0
+    take = None
+    for step in rule.steps:
+        if step.op == RuleOp.TAKE:
+            take = step.arg1
+        elif step.op == RuleOp.SET_CHOOSE_TRIES:
+            tries = step.arg1
+        elif step.op == RuleOp.SET_CHOOSELEAF_TRIES:
+            leaf_tries = step.arg1
+        elif step.op in (
+            RuleOp.CHOOSE_FIRSTN,
+            RuleOp.CHOOSE_INDEP,
+            RuleOp.CHOOSELEAF_FIRSTN,
+            RuleOp.CHOOSELEAF_INDEP,
+        ):
+            if take is None:
+                raise ValueError("CHOOSE before TAKE")
+            want = step.arg1 if step.arg1 > 0 else numrep + step.arg1
+            plan.append(
+                dict(
+                    take=take,
+                    want=want,
+                    type=step.arg2,
+                    firstn=step.op
+                    in (RuleOp.CHOOSE_FIRSTN, RuleOp.CHOOSELEAF_FIRSTN),
+                    recurse=step.op
+                    in (RuleOp.CHOOSELEAF_FIRSTN, RuleOp.CHOOSELEAF_INDEP),
+                    tries=tries,
+                    leaf_tries=leaf_tries,
+                )
+            )
+        elif step.op == RuleOp.EMIT:
+            pass
+        else:
+            raise ValueError(f"unsupported rule op {step.op}")
+    if not plan:
+        raise ValueError("rule has no CHOOSE step")
+    if len(plan) != 1:
+        raise NotImplementedError(
+            "multi-choose rule chains not yet supported by the batch mapper"
+        )
+    return plan[0]
+
+
+def crush_do_rule_batch(
+    cm: CompiledCrushMap,
+    rule_id: int,
+    xs,
+    numrep: int,
+    weightvec,
+) -> jnp.ndarray:
+    """Batched crush_do_rule: xs [N] -> [N, numrep] OSD ids.
+
+    The new sibling entry point of CrushWrapper::do_rule that the north star
+    adds (SURVEY.md §1 seam #2); consumed by the balancer simulation, the
+    crushtool-analog --test, and the osdmaptool-analog --test-map-pgs.
+    firstn results are dense with ITEM_NONE tail padding; indep results keep
+    positional ITEM_NONE holes (EC shard semantics)."""
+    p = compile_rule(cm, rule_id, numrep)
+    xs = jnp.asarray(xs, dtype=jnp.int32)
+    weightvec = jnp.asarray(weightvec, dtype=jnp.int64)
+    fn = _choose_firstn_single if p["firstn"] else _choose_indep_single
+    tries = p["tries"]
+    recurse_tries = (
+        (p["leaf_tries"] or tries) if p["firstn"] else (p["leaf_tries"] or 1)
+    )
+
+    def single(x):
+        out, out2, cnt = fn(
+            cm,
+            weightvec,
+            x,
+            p["take"],
+            p["want"],
+            p["type"],
+            tries,
+            p["recurse"],
+            recurse_tries,
+        )
+        res = out2 if p["recurse"] else out
+        if p["firstn"]:
+            res = jnp.where(jnp.arange(res.shape[0]) < cnt, res, ITEM_NONE)
+        return res
+
+    return jax.jit(jax.vmap(single))(xs)

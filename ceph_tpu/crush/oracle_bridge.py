@@ -1,0 +1,87 @@
+"""ctypes bridge to the C++ CRUSH oracle (native/crush_oracle.cc).
+
+Third bit-exactness implementation and the CPU maps/s baseline for
+BASELINE.json config 5 (straw2 10M-object remap) — the role mapper.c's
+compiled C plays in the reference.
+"""
+from __future__ import annotations
+
+import ctypes
+from functools import lru_cache
+
+import numpy as np
+
+from ..native_oracle import _lib
+from .mapper import CompiledCrushMap, compile_rule
+from .types import CrushMap
+
+_i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+_u32p = np.ctypeslib.ndpointer(dtype=np.uint32, flags="C_CONTIGUOUS")
+
+
+@lru_cache(maxsize=1)
+def _crush_lib() -> ctypes.CDLL:
+    lib = _lib()
+    lib.cro_do_rule_batch.argtypes = [
+        _i32p, _i64p, _i32p, _i32p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, _u32p, ctypes.c_long, _u32p, ctypes.c_int, _i32p,
+    ]
+    lib.cro_do_rule_batch.restype = ctypes.c_int
+    lib.cro_hash3.argtypes = [ctypes.c_uint32] * 3
+    lib.cro_hash3.restype = ctypes.c_uint32
+    lib.cro_hash2.argtypes = [ctypes.c_uint32] * 2
+    lib.cro_hash2.restype = ctypes.c_uint32
+    lib.cro_ln.argtypes = [ctypes.c_uint32]
+    lib.cro_ln.restype = ctypes.c_int64
+    lib.cro_ln_table.argtypes = [_i64p]
+    lib.cro_ln_table.restype = None
+    return lib
+
+
+def ln_table_full() -> np.ndarray:
+    out = np.empty(0x10000, dtype=np.int64)
+    _crush_lib().cro_ln_table(out)
+    return out
+
+
+def hash3(a: int, b: int, c: int) -> int:
+    return _crush_lib().cro_hash3(a & 0xFFFFFFFF, b & 0xFFFFFFFF, c & 0xFFFFFFFF)
+
+
+def hash2(a: int, b: int) -> int:
+    return _crush_lib().cro_hash2(a & 0xFFFFFFFF, b & 0xFFFFFFFF)
+
+
+def crush_ln(u: int) -> int:
+    return _crush_lib().cro_ln(u)
+
+
+def do_rule_batch_oracle(
+    cmap: CrushMap, rule_id: int, xs, numrep: int, weightvec
+) -> np.ndarray:
+    """Batched crush_do_rule via the C++ oracle; same contract as
+    ceph_tpu.crush.mapper.crush_do_rule_batch."""
+    cm = CompiledCrushMap(cmap)
+    p = compile_rule(cm, rule_id, numrep)
+    items = np.ascontiguousarray(np.asarray(cm.items), dtype=np.int32)
+    weights = np.ascontiguousarray(np.asarray(cm.weights), dtype=np.int64)
+    sizes = np.ascontiguousarray(np.asarray(cm.sizes), dtype=np.int32)
+    types = np.ascontiguousarray(np.asarray(cm.types), dtype=np.int32)
+    xs = np.ascontiguousarray(xs, dtype=np.uint32)
+    wv = np.ascontiguousarray(weightvec, dtype=np.uint32)
+    out = np.empty((len(xs), p["want"]), dtype=np.int32)
+    recurse_tries = (
+        (p["leaf_tries"] or p["tries"]) if p["firstn"] else (p["leaf_tries"] or 1)
+    )
+    rc = _crush_lib().cro_do_rule_batch(
+        items.reshape(-1), weights.reshape(-1), sizes, types,
+        items.shape[0], items.shape[1], p["take"], p["want"], p["type"],
+        int(p["firstn"]), int(p["recurse"]), p["tries"], recurse_tries,
+        xs, len(xs), wv, len(wv), out.reshape(-1),
+    )
+    if rc != 0:
+        raise ValueError(f"cro_do_rule_batch failed rc={rc}")
+    return out

@@ -1,0 +1,341 @@
+"""Scalar CRUSH mapper — the Python mirror of mapper.c, semantic ground truth.
+
+Reference: src/crush/mapper.c :: crush_do_rule, crush_choose_firstn,
+crush_choose_indep, bucket_straw2_choose, is_out.  This is the slow,
+readable twin of the vectorized TPU mapper (ceph_tpu/crush/mapper.py) and of
+the C++ oracle (native/crush_oracle.cc); all three must agree bit-for-bit.
+
+Implemented tunable profile: the modern defaults (Tunables dataclass) —
+choose_local_tries=0 and choose_local_fallback_tries=0 collapse the
+legacy local-retry modes, so on collision/rejection the descent restarts
+from the TAKE bucket with r' = r + ftotal (firstn) or r + numrep*ftotal
+(indep), bounded by choose_total_tries.  chooseleaf_stable=1 and
+chooseleaf_vary_r=1 semantics are implemented for the recursive leaf step.
+
+Provenance caveat (SURVEY.md §0): mirrors documented mapper.c behavior; the
+empty reference mount means upstream equality is asserted between the three
+in-repo implementations, not against Ceph binaries, this round.
+"""
+from __future__ import annotations
+
+from .ln_table import CRUSH_LN_TABLE, LN_BIAS
+from .types import ITEM_NONE, CrushMap, RuleOp, Straw2Bucket
+
+S64_MIN = -(1 << 63)
+_M32 = 0xFFFFFFFF
+_SEED = 1315423911
+
+
+def _mix_int(a: int, b: int, c: int) -> tuple[int, int, int]:
+    """crush_hashmix over plain ints (mod 2^32) — fast scalar path."""
+    a = (a - b - c) & _M32
+    a ^= c >> 13
+    b = (b - c - a) & _M32
+    b ^= (a << 8) & _M32
+    c = (c - a - b) & _M32
+    c ^= b >> 13
+    a = (a - b - c) & _M32
+    a ^= c >> 12
+    b = (b - c - a) & _M32
+    b ^= (a << 16) & _M32
+    c = (c - a - b) & _M32
+    c ^= b >> 5
+    a = (a - b - c) & _M32
+    a ^= c >> 3
+    b = (b - c - a) & _M32
+    b ^= (a << 10) & _M32
+    c = (c - a - b) & _M32
+    c ^= b >> 15
+    return a, b, c
+
+
+def _hash3(x: int, b: int, r: int) -> int:
+    """crush_hash32_rjenkins1_3 over plain ints."""
+    a, b, c = x & _M32, b & _M32, r & _M32
+    h = _SEED ^ a ^ b ^ c
+    x_, y = 231232, 1232
+    a, b, h = _mix_int(a, b, h)
+    c, x_, h = _mix_int(c, x_, h)
+    y, a, h = _mix_int(y, a, h)
+    b, x_, h = _mix_int(b, x_, h)
+    y, c, h = _mix_int(y, c, h)
+    return h
+
+
+def _hash2(a: int, b: int) -> int:
+    """crush_hash32_rjenkins1_2 over plain ints."""
+    a, b = a & _M32, b & _M32
+    h = _SEED ^ a ^ b
+    x_, y = 231232, 1232
+    a, b, h = _mix_int(a, b, h)
+    x_, a, h = _mix_int(x_, a, h)
+    b, y, h = _mix_int(b, y, h)
+    return h
+
+
+def _div_trunc(a: int, b: int) -> int:
+    """C-style truncating s64 division (div64_s64)."""
+    q = abs(a) // abs(b)
+    if (a < 0) != (b < 0):
+        q = -q
+    return q
+
+
+def bucket_straw2_choose(bucket: Straw2Bucket, x: int, r: int) -> int:
+    """mapper.c :: bucket_straw2_choose — max of ln(u)/w fixed-point draws.
+
+    ln = crush_ln(u) - 2^48 is negative (log2 of u/2^16 in 16.44 fixed
+    point); dividing by the 16.16 item weight makes larger weights less
+    negative, so argmax favors heavier items with exactly the exponential
+    race distribution.  Zero-weight items draw S64_MIN.
+    """
+    high = 0
+    high_draw = 0
+    for i, (item, weight) in enumerate(zip(bucket.items, bucket.weights)):
+        if weight:
+            u = _hash3(x, item, r) & 0xFFFF
+            ln = int(CRUSH_LN_TABLE[u]) - LN_BIAS
+            draw = _div_trunc(ln, weight)
+        else:
+            draw = S64_MIN
+        if i == 0 or draw > high_draw:
+            high = i
+            high_draw = draw
+    return bucket.items[high]
+
+
+def is_out(cmap: CrushMap, weight: list[int], item: int, x: int) -> bool:
+    """mapper.c :: is_out — probabilistic rejection by OSD reweight
+    (the `weight` vector is the per-device reweight, 16.16)."""
+    if item >= len(weight):
+        return True
+    w = weight[item]
+    if w >= 0x10000:
+        return False
+    if w == 0:
+        return True
+    return (_hash2(x, item) & 0xFFFF) >= w
+
+
+def _choose_firstn(
+    cmap: CrushMap,
+    bucket: Straw2Bucket,
+    weight: list[int],
+    x: int,
+    numrep: int,
+    type_: int,
+    out: list[int],
+    outpos: int,
+    tries: int,
+    recurse_tries: int,
+    recurse_to_leaf: bool,
+    out2: list[int] | None,
+    parent_r: int,
+) -> int:
+    """mapper.c :: crush_choose_firstn under modern tunables."""
+    t = cmap.tunables
+    stable = t.chooseleaf_stable
+    rep_range = range(0, numrep) if stable else range(outpos, numrep)
+    for rep in rep_range:
+        ftotal = 0
+        skip_rep = False
+        item = 0
+        while True:  # retry_descent
+            in_bucket = bucket
+            r = rep + parent_r + ftotal
+            reject = False
+            collide = False
+            while True:  # descend / retry_bucket
+                if in_bucket.size == 0:
+                    reject = True
+                    break
+                item = bucket_straw2_choose(in_bucket, x, r)
+                itemtype = cmap.item_type(item)
+                if itemtype != type_:
+                    if item >= 0:
+                        # device of the wrong type (mapper.c "bad item type"):
+                        # reject and burn a try
+                        reject = True
+                        break
+                    in_bucket = cmap.buckets[item]
+                    continue
+                collide = item in out[:outpos]
+                reject = False
+                if not collide and recurse_to_leaf:
+                    if item < 0:
+                        sub_r = r >> (t.chooseleaf_vary_r - 1) if t.chooseleaf_vary_r else 0
+                        out2_pos = _choose_firstn(
+                            cmap,
+                            cmap.buckets[item],
+                            weight,
+                            x,
+                            1 if stable else outpos + 1,
+                            0,
+                            out2,
+                            outpos,
+                            recurse_tries,
+                            0,
+                            False,
+                            None,
+                            sub_r,
+                        )
+                        if out2_pos <= outpos:
+                            reject = True  # didn't get a leaf
+                    else:
+                        out2[outpos] = item
+                if not reject and not collide and itemtype == 0:
+                    reject = is_out(cmap, weight, item, x)
+                break
+            if reject or collide:
+                ftotal += 1
+                if ftotal < tries:
+                    continue  # retry descent from the top
+                skip_rep = True
+            break
+        if skip_rep:
+            continue
+        out[outpos] = item
+        if out2 is not None and cmap.item_type(item) == 0:
+            out2[outpos] = item
+        outpos += 1
+    return outpos
+
+
+def _choose_indep(
+    cmap: CrushMap,
+    bucket: Straw2Bucket,
+    weight: list[int],
+    x: int,
+    left: int,
+    numrep: int,
+    type_: int,
+    out: list[int],
+    outpos: int,
+    tries: int,
+    recurse_tries: int,
+    recurse_to_leaf: bool,
+    out2: list[int] | None,
+    parent_r: int,
+) -> None:
+    """mapper.c :: crush_choose_indep — positional (EC) variant; failed
+    positions end as ITEM_NONE so shard ids stay stable."""
+    endpos = outpos + left
+    for rep in range(outpos, endpos):
+        out[rep] = None  # CRUSH_ITEM_UNDEF stand-in
+        if out2 is not None:
+            out2[rep] = None
+    ftotal = 0
+    left_count = left
+    while left_count > 0 and ftotal < tries:
+        for rep in range(outpos, endpos):
+            if out[rep] is not None:
+                continue
+            in_bucket = bucket
+            while True:
+                r = rep + parent_r + numrep * ftotal
+                if in_bucket.size == 0:
+                    # structural dead end: permanent NONE for this position
+                    out[rep] = ITEM_NONE
+                    if out2 is not None:
+                        out2[rep] = ITEM_NONE
+                    left_count -= 1
+                    break
+                item = bucket_straw2_choose(in_bucket, x, r)
+                itemtype = cmap.item_type(item)
+                if itemtype != type_:
+                    if item >= 0:
+                        # bad item type: permanent NONE for this position
+                        # (mapper.c crush_choose_indep semantics)
+                        out[rep] = ITEM_NONE
+                        if out2 is not None:
+                            out2[rep] = ITEM_NONE
+                        left_count -= 1
+                        break
+                    in_bucket = cmap.buckets[item]
+                    continue
+                collide = any(out[i] == item for i in range(outpos, endpos))
+                if collide:
+                    break
+                if recurse_to_leaf:
+                    if item < 0:
+                        _choose_indep(
+                            cmap, cmap.buckets[item], weight, x, 1, numrep,
+                            0, out2, rep, recurse_tries, 0, False, None, r,
+                        )
+                        if out2[rep] == ITEM_NONE:
+                            break
+                    else:
+                        out2[rep] = item
+                if itemtype == 0 and is_out(cmap, weight, item, x):
+                    break
+                out[rep] = item
+                left_count -= 1
+                break
+        ftotal += 1
+    for rep in range(outpos, endpos):
+        if out[rep] is None:
+            out[rep] = ITEM_NONE
+        if out2 is not None and out2[rep] is None:
+            out2[rep] = ITEM_NONE
+
+
+def crush_do_rule(
+    cmap: CrushMap, rule_id: int, x: int, numrep: int, weight: list[int]
+) -> list[int]:
+    """mapper.c :: crush_do_rule — interpret the rule's steps for input x.
+
+    weight: per-device reweight vector (16.16), the OSDMap::osd_weight analog.
+    Returns the raw OSD list (ITEM_NONE holes preserved for indep rules).
+    """
+    rule = cmap.rules[rule_id]
+    t = cmap.tunables
+    working: list[int] = []
+    result: list[int] = []
+    choose_tries = t.choose_total_tries
+    chooseleaf_tries = 0
+    for step in rule.steps:
+        if step.op == RuleOp.TAKE:
+            working = [step.arg1]
+        elif step.op == RuleOp.SET_CHOOSE_TRIES:
+            choose_tries = step.arg1
+        elif step.op == RuleOp.SET_CHOOSELEAF_TRIES:
+            chooseleaf_tries = step.arg1
+        elif step.op in (
+            RuleOp.CHOOSE_FIRSTN,
+            RuleOp.CHOOSE_INDEP,
+            RuleOp.CHOOSELEAF_FIRSTN,
+            RuleOp.CHOOSELEAF_INDEP,
+        ):
+            recurse = step.op in (RuleOp.CHOOSELEAF_FIRSTN, RuleOp.CHOOSELEAF_INDEP)
+            firstn = step.op in (RuleOp.CHOOSE_FIRSTN, RuleOp.CHOOSELEAF_FIRSTN)
+            want = step.arg1 if step.arg1 > 0 else numrep
+            if step.arg1 < 0:
+                want = numrep + step.arg1
+            out: list[int] = [0] * want
+            out2: list[int] = [0] * want if recurse else None
+            new_working: list[int] = []
+            for wi in working:
+                bucket = cmap.buckets[wi]
+                if firstn:
+                    rt = chooseleaf_tries or choose_tries
+                    pos = _choose_firstn(
+                        cmap, bucket, weight, x, want, step.arg2, out, 0,
+                        choose_tries, rt if recurse else choose_tries,
+                        recurse, out2, 0,
+                    )
+                    chosen = (out2 if recurse else out)[:pos]
+                else:
+                    _choose_indep(
+                        cmap, bucket, weight, x, want, want, step.arg2, out,
+                        0, choose_tries,
+                        chooseleaf_tries or 1, recurse, out2, 0,
+                    )
+                    chosen = (out2 if recurse else out)[:want]
+                new_working.extend(chosen)
+            working = new_working
+        elif step.op == RuleOp.EMIT:
+            result.extend(working)
+            working = []
+        else:
+            raise ValueError(f"unhandled rule op {step.op}")
+    return result

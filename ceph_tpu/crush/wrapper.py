@@ -1,0 +1,260 @@
+"""CrushWrapper analog — name/id management, rule building, text form.
+
+Reference: src/crush/CrushWrapper.{h,cc} — owns a crush_map, resolves
+names<->ids, creates rules (add_simple_rule), and drives crush_do_rule with
+allocated work buffers; plus src/crush/CrushCompiler.{h,cc} — the text <->
+binary map grammar used by crushtool compile/decompile.
+
+The text grammar here mirrors the crushtool decompile format closely enough
+to be familiar (tunables / devices / types / buckets / rules sections), and
+round-trips losslessly through parse_text/format_text — the property the
+reference's cram tests assert for crushtool (reference:
+src/test/cli/crushtool/*.t, SURVEY.md §4 ring 1).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .mapper import CompiledCrushMap, crush_do_rule_batch
+from .reference_mapper import crush_do_rule
+from .types import CrushMap, Rule, RuleOp, RuleStep, Straw2Bucket, Tunables
+
+_OP_NAMES = {
+    RuleOp.TAKE: "take",
+    RuleOp.CHOOSE_FIRSTN: "choose firstn",
+    RuleOp.CHOOSE_INDEP: "choose indep",
+    RuleOp.CHOOSELEAF_FIRSTN: "chooseleaf firstn",
+    RuleOp.CHOOSELEAF_INDEP: "chooseleaf indep",
+    RuleOp.EMIT: "emit",
+    RuleOp.SET_CHOOSE_TRIES: "set_choose_tries",
+    RuleOp.SET_CHOOSELEAF_TRIES: "set_chooseleaf_tries",
+}
+
+
+class CrushWrapper:
+    """Owns a CrushMap; the API surface OSDMap and the tools build on."""
+
+    def __init__(self, cmap: CrushMap | None = None):
+        self.map = cmap or CrushMap()
+        self._compiled: CompiledCrushMap | None = None
+
+    # -- names ------------------------------------------------------------
+    def name_of(self, item: int) -> str:
+        if item >= 0:
+            return self.map.device_names.get(item, f"osd.{item}")
+        return self.map.bucket_names.get(item, f"bucket{item}")
+
+    def id_of(self, name: str) -> int:
+        if name.startswith("osd."):
+            return int(name[4:])
+        for bid, n in self.map.bucket_names.items():
+            if n == name:
+                return bid
+        for did, n in self.map.device_names.items():
+            if n == name:
+                return did
+        raise KeyError(f"unknown crush name {name!r}")
+
+    def type_name(self, t: int) -> str:
+        return self.map.type_names.get(t, f"type{t}")
+
+    def type_id(self, name: str) -> int:
+        for tid, n in self.map.type_names.items():
+            if n == name:
+                return tid
+        raise KeyError(f"unknown crush type {name!r}")
+
+    # -- mapping ----------------------------------------------------------
+    def invalidate(self) -> None:
+        self._compiled = None
+
+    def compiled(self) -> CompiledCrushMap:
+        if self._compiled is None:
+            self._compiled = CompiledCrushMap(self.map)
+        return self._compiled
+
+    def do_rule(self, rule_id: int, x: int, numrep: int, weights) -> list[int]:
+        """Single mapping (reference: CrushWrapper::do_rule)."""
+        return crush_do_rule(self.map, rule_id, x, numrep, list(weights))
+
+    def do_rule_batch(self, rule_id: int, xs, numrep: int, weights):
+        """Batched mapping on device (the north-star sibling entry point)."""
+        return crush_do_rule_batch(self.compiled(), rule_id, xs, numrep, weights)
+
+    # -- text form (CrushCompiler analog) ---------------------------------
+    def format_text(self) -> str:
+        m = self.map
+        t = m.tunables
+        lines = ["# begin crush map"]
+        for k in (
+            "choose_total_tries",
+            "choose_local_tries",
+            "choose_local_fallback_tries",
+            "chooseleaf_descend_once",
+            "chooseleaf_vary_r",
+            "chooseleaf_stable",
+        ):
+            lines.append(f"tunable {k} {getattr(t, k)}")
+        lines.append("")
+        lines.append("# devices")
+        for d in range(m.max_devices):
+            lines.append(f"device {d} {self.name_of(d)}")
+        lines.append("")
+        lines.append("# types")
+        for tid in sorted(m.type_names):
+            lines.append(f"type {tid} {m.type_names[tid]}")
+        lines.append("")
+        lines.append("# buckets")
+        # topological order (children before parents) so parse_text never
+        # sees a forward reference — crushtool decompile does the same
+        emitted: list[int] = []
+        done: set[int] = set()
+
+        def emit(bid: int) -> None:
+            if bid in done:
+                return
+            done.add(bid)
+            for child in m.buckets[bid].items:
+                if child < 0:
+                    emit(child)
+            emitted.append(bid)
+
+        for bid in sorted(m.buckets):
+            emit(bid)
+        for bid in emitted:
+            b = m.buckets[bid]
+            lines.append(f"{self.type_name(b.type)} {self.name_of(bid)} {{")
+            lines.append(f"\tid {bid}")
+            lines.append("\talg straw2")
+            lines.append("\thash 0\t# rjenkins1")
+            for it, w in zip(b.items, b.weights):
+                lines.append(f"\titem {self.name_of(it)} weight {w / 0x10000:.5f}")
+            lines.append("}")
+        lines.append("")
+        lines.append("# rules")
+        for rid in sorted(m.rules):
+            r = m.rules[rid]
+            lines.append(f"rule rule{rid} {{")
+            lines.append(f"\tid {rid}")
+            lines.append(f"\ttype {'replicated' if r.type == 1 else 'erasure'}")
+            for s in r.steps:
+                if s.op == RuleOp.TAKE:
+                    lines.append(f"\tstep take {self.name_of(s.arg1)}")
+                elif s.op == RuleOp.EMIT:
+                    lines.append("\tstep emit")
+                elif s.op in (RuleOp.SET_CHOOSE_TRIES, RuleOp.SET_CHOOSELEAF_TRIES):
+                    lines.append(f"\tstep {_OP_NAMES[s.op]} {s.arg1}")
+                else:
+                    lines.append(
+                        f"\tstep {_OP_NAMES[s.op]} {s.arg1} type "
+                        f"{self.type_name(s.arg2)}"
+                    )
+            lines.append("}")
+        lines.append("# end crush map")
+        return "\n".join(lines) + "\n"
+
+    @classmethod
+    def parse_text(cls, text: str) -> "CrushWrapper":
+        """Inverse of format_text (CrushCompiler::compile analog)."""
+        w = cls(CrushMap())
+        m = w.map
+        m.type_names = {}
+        cur_bucket: Straw2Bucket | None = None
+        cur_rule: Rule | None = None
+        pending_items: list[tuple[str, float]] = []
+        bucket_header: tuple[str, str] | None = None
+        names_to_resolve: dict[str, int] = {}
+
+        def resolve(name: str) -> int:
+            if name.startswith("osd."):
+                return int(name[4:])
+            if name in names_to_resolve:
+                return names_to_resolve[name]
+            raise KeyError(f"forward reference to {name!r}")
+
+        for raw in text.splitlines():
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            tok = line.split()
+            # block context first: keywords like "type" also appear inside
+            # rule/bucket bodies
+            if cur_rule is not None:
+                if tok[0] == "id":
+                    cur_rule.rule_id = int(tok[1])
+                elif tok[0] == "type":
+                    cur_rule.type = 1 if tok[1] == "replicated" else 3
+                elif tok[0] == "step":
+                    op = " ".join(tok[1:3]) if tok[1] in ("choose", "chooseleaf") else tok[1]
+                    if op == "take":
+                        cur_rule.steps.append(
+                            RuleStep(RuleOp.TAKE, resolve(tok[2]))
+                        )
+                    elif op == "emit":
+                        cur_rule.steps.append(RuleStep(RuleOp.EMIT))
+                        m.rules[cur_rule.rule_id] = cur_rule
+                    elif op in ("set_choose_tries", "set_chooseleaf_tries"):
+                        o = (
+                            RuleOp.SET_CHOOSE_TRIES
+                            if op == "set_choose_tries"
+                            else RuleOp.SET_CHOOSELEAF_TRIES
+                        )
+                        cur_rule.steps.append(RuleStep(o, int(tok[2])))
+                    else:
+                        ops = {
+                            "choose firstn": RuleOp.CHOOSE_FIRSTN,
+                            "choose indep": RuleOp.CHOOSE_INDEP,
+                            "chooseleaf firstn": RuleOp.CHOOSELEAF_FIRSTN,
+                            "chooseleaf indep": RuleOp.CHOOSELEAF_INDEP,
+                        }
+                        n = int(tok[3])
+                        tname = tok[5]
+                        tid = next(
+                            t for t, nm in m.type_names.items() if nm == tname
+                        )
+                        cur_rule.steps.append(RuleStep(ops[op], n, tid))
+                elif tok[0] == "}":
+                    cur_rule = None
+            elif cur_bucket is not None:
+                if tok[0] == "id":
+                    cur_bucket.id = int(tok[1])
+                elif tok[0] == "alg":
+                    if tok[1] != "straw2":
+                        raise ValueError(
+                            f"bucket alg {tok[1]!r} unsupported (straw2 only; "
+                            "see ceph_tpu/crush/types.py)"
+                        )
+                elif tok[0] == "hash":
+                    cur_bucket.hash_id = int(tok[1])
+                elif tok[0] == "item":
+                    pending_items.append((tok[1], float(tok[3])))
+                elif tok[0] == "}":
+                    tname, bname = bucket_header
+                    cur_bucket.type = next(
+                        t for t, nm in m.type_names.items() if nm == tname
+                    )
+                    for iname, wf in pending_items:
+                        cur_bucket.items.append(resolve(iname))
+                        cur_bucket.weights.append(int(round(wf * 0x10000)))
+                    m.buckets[cur_bucket.id] = cur_bucket
+                    m.bucket_names[cur_bucket.id] = bname
+                    names_to_resolve[bname] = cur_bucket.id
+                    cur_bucket = None
+            elif tok[0] == "tunable":
+                setattr(m.tunables, tok[1], int(tok[2]))
+            elif tok[0] == "device":
+                did = int(tok[1])
+                m.max_devices = max(m.max_devices, did + 1)
+                if tok[2] != f"osd.{did}":
+                    m.device_names[did] = tok[2]
+            elif tok[0] == "type":
+                m.type_names[int(tok[1])] = tok[2]
+            elif tok[0] == "rule":
+                cur_rule = Rule(rule_id=-1)
+            elif tok[-1] == "{":
+                bucket_header = (tok[0], tok[1])
+                pending_items = []
+                cur_bucket = Straw2Bucket(id=0, type=0)
+        if 0 not in m.type_names:
+            m.type_names[0] = "osd"
+        return w

@@ -1,0 +1,23 @@
+"""Erasure-code codec layer: interface, base, registry, plugins, stripe math.
+
+TPU-native rebuild of the reference's src/erasure-code subsystem
+(SURVEY.md §2.1).
+"""
+from .interface import (
+    ErasureCode,
+    ErasureCodeInterface,
+    InsufficientChunks,
+    InvalidProfile,
+)
+from .registry import ErasureCodePlugin, ErasureCodePluginRegistry
+from .stripe import StripeInfo
+
+__all__ = [
+    "ErasureCode",
+    "ErasureCodeInterface",
+    "ErasureCodePlugin",
+    "ErasureCodePluginRegistry",
+    "InsufficientChunks",
+    "InvalidProfile",
+    "StripeInfo",
+]

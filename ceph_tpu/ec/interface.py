@@ -1,0 +1,183 @@
+"""ErasureCodeInterface + ErasureCode base — the codec contract.
+
+TPU-native re-design of the reference's codec interface and shared base class
+(reference: src/erasure-code/ErasureCodeInterface.h :: ErasureCodeInterface —
+init/get_chunk_count/get_chunk_size/minimum_to_decode/encode/decode/
+decode_concat — and src/erasure-code/ErasureCode.{h,cc} :: ErasureCode, which
+gives all plugins the shared chunk padding (encode_prepare), the default
+first-k minimum_to_decode, and decode_concat).
+
+Differences from the reference, by design:
+- The host boundary type is numpy uint8 arrays / bytes instead of
+  ceph::buffer::list; device residency is an implementation detail of each
+  plugin (the JAX plugins keep chunks on the TPU).
+- Chunk ids are plain ints 0..k+m-1 (shard ids); chunk_mapping supported.
+- Sub-chunks (CLAY) are expressed exactly as the reference's
+  get_sub_chunk_count() / minimum_to_decode sub-chunk ranges.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+import numpy as np
+
+
+class ErasureCodeInterface(ABC):
+    """Pure-virtual contract (reference: ErasureCodeInterface.h)."""
+
+    @abstractmethod
+    def init(self, profile: dict) -> None: ...
+
+    @abstractmethod
+    def get_chunk_count(self) -> int: ...
+
+    @abstractmethod
+    def get_data_chunk_count(self) -> int: ...
+
+    def get_coding_chunk_count(self) -> int:
+        return self.get_chunk_count() - self.get_data_chunk_count()
+
+    def get_sub_chunk_count(self) -> int:
+        """1 for MDS codes; >1 for CLAY (reference: ErasureCodeInterface.h ::
+        get_sub_chunk_count, introduced for the CLAY plugin)."""
+        return 1
+
+    @abstractmethod
+    def get_chunk_size(self, stripe_width: int) -> int: ...
+
+    @abstractmethod
+    def minimum_to_decode(
+        self, want_to_read: set[int], available: set[int]
+    ) -> dict[int, list[tuple[int, int]]]:
+        """Map chunk -> list of (offset, length) sub-chunk ranges to fetch.
+
+        MDS codes return the full chunk range; CLAY returns sub-chunk ranges
+        (reference: ErasureCodeInterface.h :: minimum_to_decode; SHEC/CLAY
+        make this nontrivial, SURVEY.md §3.2)."""
+
+    @abstractmethod
+    def encode(self, want_to_encode: set[int], data: bytes) -> dict[int, np.ndarray]: ...
+
+    @abstractmethod
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray: ...
+
+    @abstractmethod
+    def decode(
+        self, want_to_read: set[int], chunks: dict[int, np.ndarray], chunk_size: int
+    ) -> dict[int, np.ndarray]: ...
+
+    def get_chunk_mapping(self) -> list[int]:
+        return []
+
+    def decode_concat(self, chunks: dict[int, np.ndarray]) -> bytes:
+        """Reassemble the original byte stream from data chunks (reference:
+        ErasureCode.cc :: decode_concat)."""
+        k = self.get_data_chunk_count()
+        chunk_size = len(next(iter(chunks.values())))
+        decoded = self.decode(set(range(k)), chunks, chunk_size)
+        return b"".join(
+            np.asarray(decoded[i], dtype=np.uint8).tobytes() for i in range(k)
+        )
+
+
+class ErasureCode(ErasureCodeInterface):
+    """Shared plugin logic (reference: src/erasure-code/ErasureCode.cc).
+
+    Subclasses set self.k / self.m in init() and implement encode_chunks /
+    decode_chunks; everything else (padding, defaults) lives here.
+    """
+
+    #: alignment quantum for chunk sizes; 64 keeps chunks word- and
+    #: lane-friendly on both CPU (SIMD tails) and TPU (lanes)
+    CHUNK_ALIGN = 64
+
+    def __init__(self, profile: dict | None = None):
+        self.k = 0
+        self.m = 0
+        self.profile: dict = {}
+        if profile is not None:
+            self.init(profile)
+
+    # -- geometry ---------------------------------------------------------
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        """ceil(stripe_width / k) aligned up (reference: per-plugin
+        get_chunk_size, e.g. ErasureCodeJerasure.cc aligns to k*w*packetsize;
+        here the alignment is CHUNK_ALIGN bytes)."""
+        padded = -(-stripe_width // self.k)
+        return -(-padded // self.CHUNK_ALIGN) * self.CHUNK_ALIGN
+
+    # -- defaults ---------------------------------------------------------
+    def minimum_to_decode(self, want_to_read, available):
+        """Default MDS policy (reference: ErasureCode.cc ::
+        _minimum_to_decode): wanted chunks that are present are read
+        directly; otherwise the first k available chunks."""
+        want_to_read = set(want_to_read)
+        available = set(available)
+        full = None
+        if want_to_read <= available:
+            chosen = want_to_read
+        else:
+            if len(available) < self.k:
+                raise InsufficientChunks(
+                    f"need {self.k} chunks, only {len(available)} available"
+                )
+            chosen = set(sorted(available)[: self.k])
+        return {c: [(0, -1)] for c in sorted(chosen)}
+
+    def encode_prepare(self, data: bytes, chunk_size: int) -> np.ndarray:
+        """Zero-pad to k*chunk_size and split into [k, chunk_size]
+        (reference: ErasureCode.cc :: encode_prepare)."""
+        buf = np.zeros(self.k * chunk_size, dtype=np.uint8)
+        raw = np.frombuffer(data, dtype=np.uint8)
+        if raw.size > buf.size:
+            raise ValueError(f"object of {raw.size} B exceeds stripe of {buf.size} B")
+        buf[: raw.size] = raw
+        return buf.reshape(self.k, chunk_size)
+
+    def encode(self, want_to_encode, data: bytes):
+        chunk_size = self.get_chunk_size(len(data))
+        chunks = self.encode_prepare(data, chunk_size)
+        parity = np.asarray(self.encode_chunks(chunks), dtype=np.uint8)
+        all_chunks = {i: chunks[i] for i in range(self.k)}
+        all_chunks.update({self.k + i: parity[i] for i in range(self.m)})
+        return {i: all_chunks[i] for i in sorted(want_to_encode)}
+
+    def decode(self, want_to_read, chunks, chunk_size):
+        """Default decode via decode_chunks when anything wanted is missing
+        (reference: ErasureCode.cc :: _decode)."""
+        want_to_read = set(want_to_read)
+        have = set(chunks)
+        if want_to_read <= have:
+            return {i: np.asarray(chunks[i], dtype=np.uint8) for i in want_to_read}
+        if len(have) < self.k:
+            raise InsufficientChunks(
+                f"need {self.k} chunks to decode, have {len(have)}"
+            )
+        return self.decode_chunks(want_to_read, chunks)
+
+    def decode_chunks(self, want_to_read, chunks):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # -- profile helpers --------------------------------------------------
+    def parse_int(self, profile: dict, key: str, default: int) -> int:
+        v = profile.get(key, default)
+        try:
+            return int(v)
+        except (TypeError, ValueError) as e:
+            raise InvalidProfile(f"profile {key}={v!r} is not an integer") from e
+
+
+class InvalidProfile(ValueError):
+    """Profile rejected (the analog of OSDMonitor's profile validation
+    failure, reference: src/mon/OSDMonitor.cc handling of
+    `osd erasure-code-profile set`)."""
+
+
+class InsufficientChunks(ValueError):
+    """Fewer than k chunks available for decode."""

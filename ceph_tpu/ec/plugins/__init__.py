@@ -1,0 +1,1 @@
+"""Codec plugins: rs (jerasure/isa analog), shec, lrc, clay."""

@@ -1,0 +1,143 @@
+"""Reed-Solomon plugin family — the jerasure/ISA-L analog, TPU-first.
+
+Covers the matrix techniques of the reference's jerasure plugin (reference:
+src/erasure-code/jerasure/ErasureCodeJerasure.{h,cc} — one subclass per
+technique, each with prepare() building the matrix) and the ISA-L plugin
+(reference: src/erasure-code/isa/ErasureCodeIsa.{h,cc}):
+
+    reed_sol_van    ErasureCodeJerasureReedSolomonVandermonde
+    reed_sol_r6_op  ErasureCodeJerasureReedSolomonRAID6 (m=2: rows 1, 2^j)
+    cauchy_orig     ErasureCodeJerasureCauchyOrig
+    cauchy_good     ErasureCodeJerasureCauchyGood
+
+The bitmatrix-only techniques (liberation, blaum_roth, liber8tion) are
+byte-layout-dependent in jerasure and intentionally not reproduced; profiles
+naming them get a clear InvalidProfile (vintage note in SURVEY.md §2.1).
+
+Three interchangeable backends execute the same matrices:
+    jax     bitplane GF(2) matmul on TPU (ceph_tpu.ops.bitplane)
+    oracle  C++ SIMD split-table path (native/gf_oracle.cc — ISA-L analog)
+    numpy   pure-python referee (ceph_tpu.gf.reference_codec)
+Parity bytes are identical across backends (byte-wise GF semantics).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...gf.matrix import (
+    cauchy_good_coding_matrix,
+    cauchy_original_coding_matrix,
+    vandermonde_coding_matrix,
+)
+from ...gf.tables import gf_pow
+from ..interface import ErasureCode, InsufficientChunks, InvalidProfile
+from ..registry import ErasureCodePlugin
+
+TECHNIQUES = ("reed_sol_van", "reed_sol_r6_op", "cauchy_orig", "cauchy_good")
+_UNSUPPORTED = ("liberation", "blaum_roth", "liber8tion")
+
+
+def build_coding_matrix(technique: str, k: int, m: int) -> np.ndarray:
+    if technique == "reed_sol_van":
+        return vandermonde_coding_matrix(k, m).astype(np.uint8)
+    if technique == "reed_sol_r6_op":
+        # reed_sol.c :: reed_sol_r6_coding_matrix — RAID-6: row0 all ones,
+        # row1[j] = 2^j
+        if m != 2:
+            raise InvalidProfile("technique=reed_sol_r6_op requires m=2")
+        mat = np.ones((2, k), dtype=np.uint8)
+        mat[1] = [gf_pow(2, j) for j in range(k)]
+        return mat
+    if technique == "cauchy_orig":
+        return cauchy_original_coding_matrix(k, m).astype(np.uint8)
+    if technique == "cauchy_good":
+        return cauchy_good_coding_matrix(k, m).astype(np.uint8)
+    if technique in _UNSUPPORTED:
+        raise InvalidProfile(
+            f"technique {technique!r} is a jerasure bitmatrix/packet technique "
+            "whose parity depends on packetsize byte layout; use reed_sol_van "
+            "or cauchy_good (identical fault tolerance, layout-independent parity)"
+        )
+    raise InvalidProfile(f"unknown technique {technique!r}; known: {TECHNIQUES}")
+
+
+class RSCodec(ErasureCode):
+    """Systematic MDS Reed-Solomon codec over GF(2^8)."""
+
+    def __init__(self, profile: dict | None = None, backend: str = "jax"):
+        self.backend = backend
+        self._jax_codec = None
+        super().__init__(profile)
+
+    def init(self, profile: dict) -> None:
+        self.profile = dict(profile)
+        self.k = self.parse_int(profile, "k", 2)
+        self.m = self.parse_int(profile, "m", 1)
+        self.technique = profile.get("technique", "reed_sol_van")
+        w = self.parse_int(profile, "w", 8)
+        if w != 8:
+            raise InvalidProfile(
+                f"w={w} unsupported: the TPU bitplane kernel is specialized "
+                "for GF(2^8) (w=8), the default in the reference too"
+            )
+        if self.k < 1 or self.m < 1:
+            raise InvalidProfile(f"k={self.k}, m={self.m} must be >= 1")
+        self.coding = build_coding_matrix(self.technique, self.k, self.m)
+        if self.backend == "jax":
+            from ...ops.bitplane import BitplaneCodec
+
+            self._jax_codec = BitplaneCodec(self.coding)
+
+    # -- hot path (reference: ErasureCodeInterface.h :: encode_chunks) ----
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
+        if self.backend == "jax":
+            return np.asarray(self._jax_codec.encode(data_chunks))
+        if self.backend == "oracle":
+            from ... import native_oracle
+
+            return native_oracle.encode(self.coding, data_chunks, fast=True)
+        from ...gf.reference_codec import encode_chunks as np_encode
+
+        return np_encode(self.coding, data_chunks)
+
+    def decode_chunks(self, want_to_read, chunks: dict[int, np.ndarray]):
+        avail = sorted(chunks)
+        if len(avail) < self.k:
+            raise InsufficientChunks(f"need {self.k}, have {len(avail)}")
+        use = avail[: self.k]
+        shards = np.stack([np.asarray(chunks[r], dtype=np.uint8) for r in use])
+        if self.backend == "jax":
+            data = np.asarray(self._jax_codec.decode(use, shards))
+        elif self.backend == "oracle":
+            from ... import native_oracle
+
+            data = native_oracle.decode(self.coding, self.k, use, shards)
+        else:
+            from ...gf.reference_codec import decode_chunks as np_decode
+
+            out = np_decode(self.coding, self.k, dict(zip(use, shards)), want=list(range(self.k)))
+            data = np.stack([out[i] for i in range(self.k)])
+        result: dict[int, np.ndarray] = {}
+        for wanted in sorted(set(want_to_read)):
+            if wanted in chunks:
+                result[wanted] = np.asarray(chunks[wanted], dtype=np.uint8)
+            elif wanted < self.k:
+                result[wanted] = data[wanted]
+            else:
+                from ...gf.reference_codec import apply_matrix
+
+                row = self.coding[wanted - self.k : wanted - self.k + 1]
+                result[wanted] = apply_matrix(row, data)[0]
+        return result
+
+
+class RSPlugin(ErasureCodePlugin):
+    """Registry factory (reference: jerasure/ErasureCodePluginJerasure.cc ::
+    ErasureCodePluginJerasure::factory switching on technique)."""
+
+    def __init__(self, backend: str = "jax"):
+        self.backend = backend
+
+    def factory(self, profile: dict) -> RSCodec:
+        return RSCodec(profile, backend=self.backend)

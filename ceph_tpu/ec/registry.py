@@ -1,0 +1,112 @@
+"""Plugin registry — profile-driven codec selection.
+
+Re-design of the reference's dlopen plugin registry (reference:
+src/erasure-code/ErasureCodePlugin.{h,cc} :: ErasureCodePluginRegistry —
+factory(plugin_name, profile, &ec_impl) selecting libec_<plugin>.so via the
+exported __erasure_code_init).  Python entry points replace dlopen: a plugin
+is a factory object registered under its profile name; `plugin=jax` in an EC
+profile selects the TPU codec exactly the way `plugin=isa` selects ISA-L in
+the reference.  The same idiom backs the reference's compressor registry
+(src/compressor/CompressionPlugin.h), confirming the seam (SURVEY.md §2.1).
+
+Profiles are per-pool key=value maps, NOT daemon config (reference:
+SURVEY.md §5.6) — e.g. {"plugin": "jax", "technique": "cauchy_good",
+"k": "8", "m": "4"}.  `factory()` validates by instantiating, which is
+precisely how OSDMonitor validates `osd erasure-code-profile set`
+(reference: src/mon/OSDMonitor.cc).
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from threading import Lock
+
+from .interface import ErasureCodeInterface, InvalidProfile
+
+
+class ErasureCodePlugin(ABC):
+    """Factory for codec instances (reference: ErasureCodePlugin.h ::
+    ErasureCodePlugin::factory)."""
+
+    @abstractmethod
+    def factory(self, profile: dict) -> ErasureCodeInterface: ...
+
+
+class ErasureCodePluginRegistry:
+    """Singleton name -> plugin map (reference: ErasureCodePlugin.cc ::
+    ErasureCodePluginRegistry::instance / add / factory)."""
+
+    _instance: "ErasureCodePluginRegistry | None" = None
+    _instance_lock = Lock()
+
+    def __init__(self):
+        self._plugins: dict[str, ErasureCodePlugin] = {}
+        self._lock = Lock()
+
+    @classmethod
+    def instance(cls) -> "ErasureCodePluginRegistry":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+                _register_defaults(cls._instance)
+            return cls._instance
+
+    def add(self, name: str, plugin: ErasureCodePlugin) -> None:
+        with self._lock:
+            if name in self._plugins:
+                raise KeyError(f"erasure code plugin {name!r} already registered")
+            self._plugins[name] = plugin
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._plugins.pop(name, None)
+
+    def get(self, name: str) -> ErasureCodePlugin | None:
+        return self._plugins.get(name)
+
+    def names(self) -> list[str]:
+        return sorted(self._plugins)
+
+    def factory(self, profile: dict) -> ErasureCodeInterface:
+        """Instantiate the codec a profile names (reference:
+        ErasureCodePluginRegistry::factory).  Raises InvalidProfile for an
+        unknown plugin or a profile the plugin rejects."""
+        name = profile.get("plugin", "jax")
+        plugin = self._plugins.get(name)
+        if plugin is None:
+            raise InvalidProfile(
+                f"unknown erasure code plugin {name!r}; known: {self.names()}"
+            )
+        return plugin.factory(dict(profile))
+
+
+def _register_defaults(reg: ErasureCodePluginRegistry) -> None:
+    # Imported lazily to avoid import cycles; each module registers the
+    # analog of one reference plugin family (SURVEY.md §2.1 inventory).
+    from .plugins.rs import RSPlugin
+
+    reg.add("jax", RSPlugin(backend="jax"))          # TPU fast path
+    reg.add("oracle", RSPlugin(backend="oracle"))    # C++ CPU baseline (ISA-L analog)
+    reg.add("numpy", RSPlugin(backend="numpy"))      # pure-python referee
+    # jerasure/isa spellings accepted for drop-in profile compatibility:
+    # both map to codecs with identical byte-wise parity (see
+    # native/gf_oracle.cc header note on parity semantics).
+    reg.add("jerasure", RSPlugin(backend="oracle"))
+    reg.add("isa", RSPlugin(backend="oracle"))
+    try:
+        from .plugins.shec import ShecPlugin
+
+        reg.add("shec", ShecPlugin())
+    except ImportError:  # pragma: no cover
+        pass
+    try:
+        from .plugins.lrc import LrcPlugin
+
+        reg.add("lrc", LrcPlugin())
+    except ImportError:  # pragma: no cover
+        pass
+    try:
+        from .plugins.clay import ClayPlugin
+
+        reg.add("clay", ClayPlugin())
+    except ImportError:  # pragma: no cover
+        pass

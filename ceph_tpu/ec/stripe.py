@@ -1,0 +1,65 @@
+"""Stripe math — ECUtil::stripe_info_t re-done for batched TPU launches.
+
+Reference: src/osd/ECUtil.h :: stripe_info_t — an object is laid out in
+stripes of stripe_width = k * chunk_size bytes; chunk i of every stripe lands
+on shard i.  The TPU consequence (SURVEY.md §5.7): shard j of an object is
+the concatenation of chunk j of every stripe, so whole-object encode is ONE
+[k, object_size/k] kernel launch with the stripe axis folded into the shard
+length — no per-stripe loop exists anywhere.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class StripeInfo:
+    """stripe_unit = chunk bytes per stripe; k = data chunks per stripe."""
+
+    k: int
+    stripe_unit: int
+
+    @property
+    def stripe_width(self) -> int:
+        return self.k * self.stripe_unit
+
+    def object_stripes(self, object_size: int) -> int:
+        """Number of stripes covering an object (last may be padded)."""
+        return -(-object_size // self.stripe_width)
+
+    def shard_size(self, object_size: int) -> int:
+        return self.object_stripes(object_size) * self.stripe_unit
+
+    def logical_to_stripe(self, offset: int) -> tuple[int, int]:
+        """logical byte offset -> (stripe number, offset within stripe)."""
+        return divmod(offset, self.stripe_width)
+
+    def chunk_of(self, offset: int) -> tuple[int, int]:
+        """logical byte offset -> (shard id, byte offset within that shard)."""
+        stripe, within = self.logical_to_stripe(offset)
+        chunk, chunk_off = divmod(within, self.stripe_unit)
+        return chunk, stripe * self.stripe_unit + chunk_off
+
+    def shard_layout(self, data: bytes) -> np.ndarray:
+        """Object bytes -> [k, shard_size] shard matrix (zero padded).
+
+        This is the transpose-free layout: byte b of the object goes to
+        shard chunk_of(b) — done with one reshape/transpose pass.
+        """
+        size = len(data)
+        n_stripes = max(1, self.object_stripes(size))
+        buf = np.zeros(n_stripes * self.stripe_width, dtype=np.uint8)
+        buf[:size] = np.frombuffer(data, dtype=np.uint8)
+        # [stripes, k, unit] -> [k, stripes, unit] -> [k, shard]
+        arr = buf.reshape(n_stripes, self.k, self.stripe_unit)
+        return np.ascontiguousarray(arr.transpose(1, 0, 2)).reshape(self.k, -1)
+
+    def unshard(self, shards: np.ndarray, object_size: int) -> bytes:
+        """[k, shard_size] -> original object bytes."""
+        k, shard_size = shards.shape
+        assert k == self.k
+        n_stripes = shard_size // self.stripe_unit
+        arr = shards.reshape(k, n_stripes, self.stripe_unit).transpose(1, 0, 2)
+        return arr.reshape(-1)[:object_size].tobytes()

@@ -28,10 +28,15 @@ class OracleUnavailable(RuntimeError):
 
 @lru_cache(maxsize=1)
 def _lib() -> ctypes.CDLL:
+    # keep the generated LN table header in sync with its Python generator
+    # (the C++ crush oracle must use byte-identical tables)
+    from .crush.ln_table import emit_c_header
+
+    emit_c_header(os.path.join(_NATIVE_DIR, "crush_tables.h"))
     srcs = [
         os.path.join(_NATIVE_DIR, f)
         for f in os.listdir(_NATIVE_DIR)
-        if f.endswith(".cc")
+        if f.endswith((".cc", ".h"))
     ]
     if not os.path.exists(_LIB_PATH) or any(
         os.path.getmtime(s) >= os.path.getmtime(_LIB_PATH) for s in srcs

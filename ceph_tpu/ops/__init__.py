@@ -1,0 +1,14 @@
+"""TPU compute kernels: bitplane GF(2) matmul (XLA) and Pallas variants."""
+from .bitplane import (
+    BitplaneCodec,
+    apply_matrix_jax,
+    pack_bitplanes,
+    unpack_bitplanes,
+)
+
+__all__ = [
+    "BitplaneCodec",
+    "apply_matrix_jax",
+    "pack_bitplanes",
+    "unpack_bitplanes",
+]

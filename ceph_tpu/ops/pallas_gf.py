@@ -1,0 +1,106 @@
+"""Pallas fused GF(2^8) matrix-apply kernel — the TPU hot-loop (SURVEY.md §7
+step 2, HOT LOOP #1 of §3.1).
+
+The XLA bitplane path (ceph_tpu/ops/bitplane.py) materializes the unpacked
+bitplanes (8x the data) through HBM; this kernel keeps them in VMEM:
+
+    per L-tile:  load [n, T] bytes ->
+                 unpack to [n*8, T] 0/1 int8 (VPU shifts) ->
+                 one MXU matmul with the (rows*8, n*8) bitmatrix ->
+                 mod-2 + repack to [rows, T] bytes -> store
+
+HBM traffic becomes read 1x + write (rows/n)x of the data — the minimum —
+instead of ~17x.  Plays the role gf-complete's SIMD kernels play for
+jerasure (reference: src/erasure-code/jerasure/gf-complete :: gf_w8 SSE
+paths) and ec_encode_data's AVX-512 loops play for ISA-L (reference:
+src/isa-l).
+
+Layout notes:
+- bit-plane order inside the kernel is l*n + j (concatenate over bit l of
+  chunk j), so the host pre-permutes the bitmatrix columns accordingly;
+  output rows stay i*8 + l so repacking is a plain reshape.
+- the bitmatrix is tiny ((rows*8) x (n*8) int8) and lives in VMEM whole.
+"""
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..gf.matrix import matrix_to_bitmatrix
+
+DEFAULT_TILE = 8192
+
+
+@lru_cache(maxsize=256)
+def _permuted_bitmatrix(mat_bytes: bytes, shape: tuple[int, int]) -> np.ndarray:
+    """(rows*8) x (n*8) bitmatrix with columns permuted to l*n+j order."""
+    mat = np.frombuffer(mat_bytes, dtype=np.uint8).reshape(shape)
+    B = matrix_to_bitmatrix(mat)  # cols j*8+l
+    rows8, n8 = B.shape
+    n = n8 // 8
+    perm = np.empty(n8, dtype=np.int64)
+    for l in range(8):
+        for j in range(n):
+            perm[l * n + j] = j * 8 + l
+    return np.ascontiguousarray(B[:, perm]).astype(np.int8)
+
+
+def _apply_kernel(B_ref, x_ref, o_ref, *, n: int, rows: int):
+    x = x_ref[:].astype(jnp.int32)  # [n, T]
+    planes = [((x >> l) & 1).astype(jnp.int8) for l in range(8)]
+    bits = jnp.concatenate(planes, axis=0)  # [8n, T], row order l*n+j
+    acc = jax.lax.dot_general(
+        B_ref[:],
+        bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [rows*8, T]
+    par = (acc & 1).astype(jnp.uint8)
+    T = par.shape[1]
+    stacked = par.reshape(rows, 8, T)
+    packed = stacked[:, 0, :]
+    for l in range(1, 8):
+        packed = packed | (stacked[:, l, :] << l)
+    o_ref[:] = packed
+
+
+@partial(jax.jit, static_argnames=("rows", "n", "tile", "interpret"))
+def _apply_padded(B, chunks, rows: int, n: int, tile: int, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    L = chunks.shape[1]
+    grid = (L // tile,)
+    return pl.pallas_call(
+        partial(_apply_kernel, n=n, rows=rows),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((rows * 8, n * 8), lambda i: (0, 0)),
+            pl.BlockSpec((n, tile), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((rows, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((rows, L), jnp.uint8),
+        interpret=interpret,
+    )(B, chunks)
+
+
+def apply_matrix_pallas(
+    mat: np.ndarray, chunks, tile: int = DEFAULT_TILE, interpret: bool = False
+) -> jnp.ndarray:
+    """GF(2^8) matrix apply via the fused Pallas kernel.
+
+    Same contract (and bit-exact output) as
+    ceph_tpu.ops.bitplane.apply_matrix_jax.
+    """
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    rows, n = mat.shape
+    Bp = jnp.asarray(_permuted_bitmatrix(mat.tobytes(), mat.shape))
+    chunks = jnp.asarray(chunks, dtype=jnp.uint8)
+    L = chunks.shape[1]
+    pad = (-L) % tile
+    if pad:
+        chunks = jnp.pad(chunks, ((0, 0), (0, pad)))
+    out = _apply_padded(Bp, chunks, rows, n, tile, interpret)
+    return out[:, :L] if pad else out

@@ -1,0 +1,16 @@
+"""Multi-chip sharding of EC stripe batches and CRUSH x-batches."""
+from .mesh import (
+    LEN_AXIS,
+    ROW_AXIS,
+    distributed_decode,
+    make_mesh,
+    sharded_apply_matrix,
+)
+
+__all__ = [
+    "LEN_AXIS",
+    "ROW_AXIS",
+    "distributed_decode",
+    "make_mesh",
+    "sharded_apply_matrix",
+]

@@ -1,0 +1,258 @@
+// CRUSH oracle — C++ mirror of the straw2 mapper, third implementation for
+// bit-exactness voting and the CPU maps/s baseline (BASELINE.json config 5).
+//
+// Plays the role of the reference's native mapper (reference:
+// src/crush/mapper.c :: crush_do_rule, crush_choose_firstn,
+// crush_choose_indep, bucket_straw2_choose, is_out; src/crush/hash.c).
+// Semantics are the modern-tunables subset documented in
+// ceph_tpu/crush/reference_mapper.py; the three implementations (Python
+// scalar, JAX batch, this) must agree bit-for-bit.
+//
+// Uses the generated crush_tables.h (emitted by ceph_tpu/crush/ln_table.py)
+// so the fixed-point log table is byte-identical across all implementations.
+
+#include <cstdint>
+#include <cstring>
+
+#include "crush_tables.h"
+
+namespace {
+
+constexpr int64_t LN_BIAS = 0x1000000000000LL;
+constexpr int32_t ITEM_NONE_V = -0x7FFFFFFE;
+constexpr uint32_t SEED = 1315423911u;
+
+#define MIX(a, b, c)      \
+  do {                    \
+    a = a - b;  a = a - c;  a = a ^ (c >> 13); \
+    b = b - c;  b = b - a;  b = b ^ (a << 8);  \
+    c = c - a;  c = c - b;  c = c ^ (b >> 13); \
+    a = a - b;  a = a - c;  a = a ^ (c >> 12); \
+    b = b - c;  b = b - a;  b = b ^ (a << 16); \
+    c = c - a;  c = c - b;  c = c ^ (b >> 5);  \
+    a = a - b;  a = a - c;  a = a ^ (c >> 3);  \
+    b = b - c;  b = b - a;  b = b ^ (a << 10); \
+    c = c - a;  c = c - b;  c = c ^ (b >> 15); \
+  } while (0)
+
+uint32_t hash3(uint32_t a, uint32_t b, uint32_t c) {
+  uint32_t h = SEED ^ a ^ b ^ c;
+  uint32_t x = 231232u, y = 1232u;
+  MIX(a, b, h);
+  MIX(c, x, h);
+  MIX(y, a, h);
+  MIX(b, x, h);
+  MIX(y, c, h);
+  return h;
+}
+
+uint32_t hash2(uint32_t a, uint32_t b) {
+  uint32_t h = SEED ^ a ^ b;
+  uint32_t x = 231232u, y = 1232u;
+  MIX(a, b, h);
+  MIX(x, a, h);
+  MIX(b, y, h);
+  return h;
+}
+
+struct Map {
+  const int32_t* items;    // [n_buckets * max_size]
+  const int64_t* weights;  // [n_buckets * max_size] 16.16
+  const int32_t* sizes;    // [n_buckets]
+  const int32_t* types;    // [n_buckets]
+  int n_buckets;
+  int max_size;
+  const uint32_t* weightvec;  // [n_devices] device reweights 16.16
+  int n_devices;
+
+  int item_type(int item) const {
+    if (item >= 0) return 0;
+    const int idx = -1 - item;
+    if (idx >= n_buckets) return 0;
+    return types[idx];
+  }
+};
+
+int64_t div_trunc(int64_t a, int64_t b) { return a / b; }  // C is truncating
+
+int straw2_choose(const Map& m, int bucket_idx, uint32_t x, uint32_t r) {
+  if (bucket_idx < 0 || bucket_idx >= m.n_buckets) return ITEM_NONE_V;
+  const int size = m.sizes[bucket_idx];
+  if (size == 0) return ITEM_NONE_V;
+  const int32_t* items = m.items + (size_t)bucket_idx * m.max_size;
+  const int64_t* weights = m.weights + (size_t)bucket_idx * m.max_size;
+  int high = 0;
+  int64_t high_draw = 0;
+  for (int i = 0; i < size; ++i) {
+    int64_t draw;
+    if (weights[i]) {
+      const uint32_t u = hash3(x, (uint32_t)items[i], r) & 0xffff;
+      const int64_t ln = CRUSH_LN_TABLE[u] - LN_BIAS;
+      draw = div_trunc(ln, weights[i]);
+    } else {
+      draw = INT64_MIN;
+    }
+    if (i == 0 || draw > high_draw) {
+      high = i;
+      high_draw = draw;
+    }
+  }
+  return items[high];
+}
+
+bool is_out(const Map& m, int item, uint32_t x) {
+  if (item >= m.n_devices) return true;
+  const uint32_t w = m.weightvec[item];
+  if (w >= 0x10000u) return false;
+  if (w == 0) return true;
+  return (hash2(x, (uint32_t)item) & 0xffff) >= w;
+}
+
+int descend(const Map& m, int root, uint32_t x, uint32_t r, int want_type) {
+  int item = root;
+  while (item < 0 && item != ITEM_NONE_V && m.item_type(item) != want_type)
+    item = straw2_choose(m, -1 - item, x, r);
+  // a device of the wrong type is a dead end (mapper.c "bad item type")
+  if (want_type != 0 && item >= 0) return ITEM_NONE_V;
+  return item;
+}
+
+// crush_choose_firstn, modern tunables (stable=1, vary_r=1, local retries 0)
+int choose_firstn(const Map& m, int root, uint32_t x, int numrep,
+                  int want_type, int tries, bool recurse, int recurse_tries,
+                  int32_t* out, int32_t* out2) {
+  int outpos = 0;
+  for (int rep = 0; rep < numrep; ++rep) {
+    bool done = false;
+    int item = ITEM_NONE_V, leaf = ITEM_NONE_V;
+    for (int ftotal = 0; ftotal < tries && !done; ++ftotal) {
+      const uint32_t r = (uint32_t)(rep + ftotal);
+      const int cand = descend(m, root, x, r, want_type);
+      if (cand == ITEM_NONE_V) continue;
+      bool collide = false;
+      for (int i = 0; i < outpos; ++i)
+        if (out[i] == cand) { collide = true; break; }
+      if (collide) continue;
+      if (recurse && cand < 0) {
+        // nested chooseleaf: one rep, r' = sub_r + f, collide vs out2
+        bool lok = false;
+        int lf_leaf = ITEM_NONE_V;
+        for (int lf = 0; lf < recurse_tries && !lok; ++lf) {
+          const int l = descend(m, cand, x, r + (uint32_t)lf, 0);
+          if (l < 0) continue;
+          bool lcol = false;
+          for (int i = 0; i < outpos; ++i)
+            if (out2[i] == l) { lcol = true; break; }
+          if (lcol || is_out(m, l, x)) continue;
+          lok = true;
+          lf_leaf = l;
+        }
+        if (!lok) continue;
+        item = cand;
+        leaf = lf_leaf;
+        done = true;
+      } else {
+        if (cand >= 0 && is_out(m, cand, x)) continue;
+        if (recurse && cand >= 0 && is_out(m, cand, x)) continue;
+        item = cand;
+        leaf = cand;
+        done = true;
+      }
+    }
+    if (!done) continue;
+    out[outpos] = item;
+    out2[outpos] = leaf;
+    ++outpos;
+  }
+  return outpos;
+}
+
+// crush_choose_indep: positional retries r = rep + numrep*ftotal
+void choose_indep(const Map& m, int root, uint32_t x, int numrep,
+                  int want_type, int tries, bool recurse, int recurse_tries,
+                  int32_t* out, int32_t* out2) {
+  for (int i = 0; i < numrep; ++i) out[i] = out2[i] = ITEM_NONE_V;
+  bool placed[64] = {false};
+  for (int ftotal = 0; ftotal < tries; ++ftotal) {
+    for (int rep = 0; rep < numrep; ++rep) {
+      if (placed[rep]) continue;
+      const uint32_t r = (uint32_t)(rep + numrep * ftotal);
+      const int cand = descend(m, root, x, r, want_type);
+      if (cand == ITEM_NONE_V) {
+        // structural dead end: permanent NONE (crush_choose_indep keeps the
+        // position at CRUSH_ITEM_NONE and never retries it)
+        placed[rep] = true;
+        continue;
+      }
+      bool collide = false;
+      for (int i = 0; i < numrep; ++i)
+        if (placed[i] && out[i] == cand) { collide = true; break; }
+      if (collide) continue;
+      int leaf = cand;
+      if (recurse && cand < 0) {
+        bool lok = false;
+        for (int lf = 0; lf < recurse_tries && !lok; ++lf) {
+          const int l =
+              descend(m, cand, x, (uint32_t)(rep + numrep * lf) + r, 0);
+          if (l < 0) continue;
+          if (is_out(m, l, x)) continue;
+          lok = true;
+          leaf = l;
+        }
+        if (!lok) continue;
+      } else if (cand >= 0) {
+        if (is_out(m, cand, x)) continue;
+      } else if (!recurse) {
+        // bucket of wanted type without recursion: accepted as-is
+      }
+      out[rep] = cand;
+      out2[rep] = leaf;
+      placed[rep] = true;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Batched do_rule for a single-choose rule plan (see
+// ceph_tpu/crush/mapper.py :: compile_rule).  out is [n_x * want], filled
+// with OSD ids / ITEM_NONE.  Returns 0, or -1 on bad args.
+int cro_do_rule_batch(const int32_t* items, const int64_t* weights,
+                      const int32_t* sizes, const int32_t* types,
+                      int n_buckets, int max_size, int take, int want,
+                      int want_type, int firstn, int recurse, int tries,
+                      int recurse_tries, const uint32_t* xs, long n_x,
+                      const uint32_t* weightvec, int n_devices,
+                      int32_t* out) {
+  if (want <= 0 || want > 64) return -1;
+  Map m{items, weights, sizes, types, n_buckets, max_size, weightvec,
+        n_devices};
+  int32_t buf[64], buf2[64];
+  for (long i = 0; i < n_x; ++i) {
+    const uint32_t x = xs[i];
+    int32_t* dst = out + (size_t)i * want;
+    if (firstn) {
+      for (int j = 0; j < want; ++j) buf[j] = buf2[j] = ITEM_NONE_V;
+      const int n = choose_firstn(m, take, x, want, want_type, tries,
+                                  recurse != 0, recurse_tries, buf, buf2);
+      for (int j = 0; j < want; ++j)
+        dst[j] = (j < n) ? (recurse ? buf2[j] : buf[j]) : ITEM_NONE_V;
+    } else {
+      choose_indep(m, take, x, want, want_type, tries, recurse != 0,
+                   recurse_tries, buf, buf2);
+      for (int j = 0; j < want; ++j) dst[j] = recurse ? buf2[j] : buf[j];
+    }
+  }
+  return 0;
+}
+
+uint32_t cro_hash3(uint32_t a, uint32_t b, uint32_t c) { return hash3(a, b, c); }
+uint32_t cro_hash2(uint32_t a, uint32_t b) { return hash2(a, b); }
+int64_t cro_ln(uint32_t u) { return CRUSH_LN_TABLE[u & 0xffff]; }
+void cro_ln_table(int64_t* out) {
+  std::memcpy(out, CRUSH_LN_TABLE, sizeof(CRUSH_LN_TABLE));
+}
+
+}  // extern "C"

@@ -3,17 +3,22 @@
 Tests run on CPU with a virtual 8-device mesh so multi-chip sharding
 (ceph_tpu.parallel) is exercised without TPU hardware, mirroring how the
 reference tests its distributed logic on one box (qa/standalone,
-SURVEY.md §4 ring 2).  Must set env vars before the first jax import.
+SURVEY.md §4 ring 2).
+
+Ordering subtlety: this machine's sitecustomize imports jax at interpreter
+start and pins the tunneled TPU backend (JAX_PLATFORMS=axon), so env vars set
+here are too late — the override must go through jax.config, and XLA_FLAGS
+must be set before the first backend initialization (which is still lazy).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax  # noqa: E402
+import jax  # noqa: E402  (already imported by sitecustomize; config still mutable)
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)  # straw2 needs exact int64 (SURVEY.md §7)

@@ -1,0 +1,57 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh (conftest sets
+xla_force_host_platform_device_count=8) — the single-box stand-in for the
+reference's multi-daemon standalone tests (SURVEY.md §4 ring 2).
+"""
+import jax
+import numpy as np
+import pytest
+
+from ceph_tpu.gf import cauchy_good_coding_matrix, vandermonde_coding_matrix
+from ceph_tpu.gf.matrix import decode_matrix_for, systematic_generator
+from ceph_tpu.gf.reference_codec import encode_chunks
+from ceph_tpu.parallel import distributed_decode, make_mesh, sharded_apply_matrix
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh"
+)
+
+
+@pytest.mark.parametrize("n_dev", [2, 4, 8])
+def test_sharded_encode_matches_reference(n_dev):
+    mesh = make_mesh(n_dev)
+    k, m = 8, 4
+    coding = cauchy_good_coding_matrix(k, m)
+    data = np.random.default_rng(n_dev).integers(
+        0, 256, (k, 256 * n_dev), dtype=np.uint8
+    )
+    got = np.asarray(sharded_apply_matrix(mesh, coding, data))
+    np.testing.assert_array_equal(got, encode_chunks(coding, data))
+
+
+@pytest.mark.parametrize("n_dev,k,m", [(4, 8, 4), (8, 8, 4), (3, 6, 3)])
+def test_distributed_decode_all_gather(n_dev, k, m):
+    mesh = make_mesh(n_dev)
+    coding = vandermonde_coding_matrix(k, m)
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, (k, 128 * n_dev), dtype=np.uint8)
+    parity = encode_chunks(coding, data)
+    shards = np.vstack([data, parity])
+    lost = set(rng.choice(k + m, size=m, replace=False).tolist())
+    avail = [i for i in range(k + m) if i not in lost][:k]
+    dm = decode_matrix_for(systematic_generator(coding), k, avail)
+    rec = np.asarray(distributed_decode(mesh, dm, shards[avail]))
+    np.testing.assert_array_equal(rec, data)
+
+
+def test_graft_entry_single_chip_jittable():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = np.asarray(jax.jit(fn)(*args))
+    assert out.shape == (4, 4096)
+
+
+def test_graft_entry_dryrun():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
