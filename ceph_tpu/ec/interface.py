@@ -119,7 +119,6 @@ class ErasureCode(ErasureCodeInterface):
         directly; otherwise the first k available chunks."""
         want_to_read = set(want_to_read)
         available = set(available)
-        full = None
         if want_to_read <= available:
             chosen = want_to_read
         else:
@@ -155,10 +154,9 @@ class ErasureCode(ErasureCodeInterface):
         have = set(chunks)
         if want_to_read <= have:
             return {i: np.asarray(chunks[i], dtype=np.uint8) for i in want_to_read}
-        if len(have) < self.k:
-            raise InsufficientChunks(
-                f"need {self.k} chunks to decode, have {len(have)}"
-            )
+        # no k-of-n precondition here: locality codecs (SHEC/LRC/CLAY) can
+        # decode from fewer than k chunks; each decode_chunks raises
+        # InsufficientChunks itself when the set really is too small
         return self.decode_chunks(want_to_read, chunks)
 
     def decode_chunks(self, want_to_read, chunks):  # pragma: no cover - abstract

@@ -1,0 +1,303 @@
+"""CLAY — coupled-layer MSR regenerating code plugin (k, m, d).
+
+Reference: src/erasure-code/clay/ErasureCodeClay.{h,cc} — repair of a single
+lost chunk reads only sub-chunks from d helper chunks (bandwidth-optimal MSR
+point), introducing get_sub_chunk_count() and sub-chunk-range
+minimum_to_decode into the codec interface (SURVEY.md §2.1).
+
+Construction (Clay codes, FAST'18, as the reference implements):
+- q = d - k + 1, t = (k+m)/q; node (x, y) for x in [0,q), y in [0,t);
+  chunk index n = y*q + x; data nodes are n < k.
+- Each chunk holds q^t sub-chunks, one per "plane" z, whose base-q digits
+  are (z_0..z_{t-1}) (y=0 least significant here).
+- Uncoupled symbols U(x,y;z) form, per plane, a codeword of the scalar MDS
+  code [I_k; C] (the same jerasure-exact RS generator as the rs plugin).
+- Coupling: for x != z_y, the pair P1=(x,y;z), P2=(z_y,y;z') with
+  z' = z(y -> x) satisfies C1 = U1 ^ g*U2 and C2 = g*U1 ^ U2 (g = 2;
+  det 1^g^2 = (1+g)^2 != 0); for x == z_y ("vertex"), C = U.
+- Encode and multi-erasure decode run the layered algorithm: planes in
+  increasing intersection-score order, U recovered via pair inversion or
+  earlier planes, per-plane MDS decode of erased U, then C of erased nodes
+  from U pairs.
+- Single-chunk repair with d = k+m-1 (the reference's default d) reads only
+  the q^(t-1) planes with z_{y0} = x0 from every survivor — bandwidth
+  d/(k*q) of naive (BASELINE.json config 4 measures exactly this).
+
+Scope notes vs the reference: d must satisfy q | (k+m) (the reference pads
+with shortened virtual nodes otherwise); bandwidth-optimal repair is
+implemented for d = k+m-1 with all survivors as helpers, and falls back to
+full decode for other cases.  Parity bytes are internally defined (empty
+reference mount, SURVEY.md §0); sub-chunk accounting and repair-bandwidth
+semantics are what tests pin.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...gf.matrix import decode_matrix_for, systematic_generator, vandermonde_coding_matrix
+from ...gf.reference_codec import apply_matrix
+from ...gf.tables import GF_MUL_TABLE, gf_inv
+from ..interface import ErasureCode, InsufficientChunks, InvalidProfile
+from ..registry import ErasureCodePlugin
+
+GAMMA = 2
+_INV_DET = gf_inv(1 ^ GF_MUL_TABLE[GAMMA, GAMMA])  # 1/(1 + g^2)
+_INV_G = gf_inv(GAMMA)
+
+
+def _gmul(c: int, arr: np.ndarray) -> np.ndarray:
+    return GF_MUL_TABLE[c, arr]
+
+
+class ClayCodec(ErasureCode):
+    def init(self, profile: dict) -> None:
+        self.profile = dict(profile)
+        self.k = self.parse_int(profile, "k", 4)
+        self.m = self.parse_int(profile, "m", 2)
+        self.d = self.parse_int(profile, "d", self.k + self.m - 1)
+        if not (self.k <= self.d <= self.k + self.m - 1):
+            raise InvalidProfile(
+                f"CLAY requires k <= d <= k+m-1, got k={self.k} m={self.m} d={self.d}"
+            )
+        self.q = self.d - self.k + 1
+        n = self.k + self.m
+        if n % self.q:
+            raise InvalidProfile(
+                f"(k+m)={n} must be divisible by q=d-k+1={self.q} "
+                "(the reference pads with shortened nodes; unsupported here)"
+            )
+        self.t = n // self.q
+        self.sub_chunk_count = self.q**self.t
+        coding = vandermonde_coding_matrix(self.k, self.m)
+        self.generator = systematic_generator(coding)
+        self.coding = coding.astype(np.uint8)
+
+    def get_sub_chunk_count(self) -> int:
+        return self.sub_chunk_count
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        base = super().get_chunk_size(stripe_width)
+        # chunk must split into q^t sub-chunks of CHUNK_ALIGN-friendly size
+        unit = self.sub_chunk_count
+        return -(-base // unit) * unit
+
+    # -- geometry ---------------------------------------------------------
+    def _node(self, n: int) -> tuple[int, int]:
+        return n % self.q, n // self.q
+
+    def _digit(self, z: int, y: int) -> int:
+        return (z // self.q**y) % self.q
+
+    def _replace(self, z: int, y: int, x: int) -> int:
+        p = self.q**y
+        return z - self._digit(z, y) * p + x * p
+
+    # -- layered decode (ErasureCodeClay::decode_layered) -----------------
+    def _layered_decode(
+        self, C: dict[int, np.ndarray], erased: list[int], sub_len: int
+    ) -> dict[int, np.ndarray]:
+        """C: node -> [Z, sub_len] known coupled chunks; returns C for erased."""
+        nq, t, Z = self.q, self.t, self.sub_chunk_count
+        n_nodes = self.k + self.m
+        erased_set = set(erased)
+        if len(erased_set) > self.m:
+            raise InsufficientChunks(f"{len(erased_set)} erasures > m={self.m}")
+        U = np.zeros((n_nodes, Z, sub_len), dtype=np.uint8)
+
+        def score(z: int) -> int:
+            return sum(
+                1
+                for y in range(t)
+                if (y * nq + self._digit(z, y)) in erased_set
+            )
+
+        order = sorted(range(Z), key=score)
+        avail_nodes = sorted(set(range(n_nodes)) - erased_set)
+        dm = decode_matrix_for(self.generator, self.k, avail_nodes).astype(np.uint8)
+        for z in order:
+            digs = [self._digit(z, y) for y in range(t)]
+            for node in avail_nodes:
+                x, y = self._node(node)
+                if x == digs[y]:
+                    U[node, z] = C[node][z]
+                    continue
+                pnode = y * nq + digs[y]
+                zp = self._replace(z, y, x)
+                if pnode not in erased_set:
+                    # invert the 2x2: U1 = (C1 ^ g*C2) / (1 ^ g^2)
+                    c1 = C[node][z]
+                    c2 = C[pnode][zp]
+                    U[node, z] = _gmul(_INV_DET, c1 ^ _gmul(GAMMA, c2))
+                else:
+                    # partner erased: its plane zp has score-1, U known
+                    U[node, z] = C[node][z] ^ _gmul(GAMMA, U[pnode, zp])
+            # per-plane MDS decode of erased U symbols
+            sub = U[avail_nodes[: self.k], z]
+            data_u = apply_matrix(dm, sub)
+            full = np.zeros((n_nodes, sub_len), dtype=np.uint8)
+            full[: self.k] = data_u
+            if erased_set & set(range(self.k, n_nodes)):
+                full[self.k :] = apply_matrix(self.coding, data_u)
+            for node in erased_set:
+                U[node, z] = full[node]
+        # rebuild coupled C for erased nodes from the complete U
+        out: dict[int, np.ndarray] = {}
+        for node in erased:
+            x, y = self._node(node)
+            buf = np.zeros((Z, sub_len), dtype=np.uint8)
+            for z in range(Z):
+                dy = self._digit(z, y)
+                if x == dy:
+                    buf[z] = U[node, z]
+                else:
+                    pnode = y * nq + dy
+                    zp = self._replace(z, y, x)
+                    buf[z] = U[node, z] ^ _gmul(GAMMA, U[pnode, zp])
+            out[node] = buf
+        return out
+
+    # -- interface --------------------------------------------------------
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
+        k, L = data_chunks.shape
+        assert k == self.k
+        Z = self.sub_chunk_count
+        if L % Z:
+            raise ValueError(f"chunk length {L} not divisible by {Z} sub-chunks")
+        sub_len = L // Z
+        C = {i: data_chunks[i].reshape(Z, sub_len) for i in range(self.k)}
+        parity = self._layered_decode(
+            C, list(range(self.k, self.k + self.m)), sub_len
+        )
+        return np.stack(
+            [parity[self.k + i].reshape(L) for i in range(self.m)]
+        )
+
+    def decode_chunks(self, want_to_read, chunks):
+        have = {int(i): np.asarray(v, dtype=np.uint8) for i, v in chunks.items()}
+        L = len(next(iter(have.values())))
+        Z = self.sub_chunk_count
+        sub_len = L // Z
+        erased = sorted(set(range(self.k + self.m)) - set(have))
+        lost_wanted = sorted(set(want_to_read) - set(have))
+        if not lost_wanted:
+            return {w: have[w] for w in want_to_read}
+        if len(erased) == 1 and self.d == self.k + self.m - 1 and len(have) >= self.d:
+            rebuilt = self._repair_one(have, erased[0], sub_len)
+            out = {erased[0]: rebuilt}
+        else:
+            C = {i: v.reshape(Z, sub_len) for i, v in have.items()}
+            dec = self._layered_decode(C, erased, sub_len)
+            out = {n: v.reshape(Z * sub_len) for n, v in dec.items()}
+        result = {}
+        for w in set(want_to_read):
+            result[w] = have[w] if w in have else out[w]
+        return result
+
+    # -- bandwidth-optimal single repair (d = k+m-1) ----------------------
+    def repair_planes(self, lost: int) -> list[int]:
+        """Planes read during repair of `lost`: z with z_{y0} == x0."""
+        x0, y0 = self._node(lost)
+        return [
+            z for z in range(self.sub_chunk_count) if self._digit(z, y0) == x0
+        ]
+
+    def repair_subchunk_ranges(self, lost: int) -> list[tuple[int, int]]:
+        """Contiguous (offset, count) runs of sub-chunk indices helpers read
+        (the shape minimum_to_decode reports, reference:
+        ErasureCodeClay::minimum_to_decode sub-chunk ranges)."""
+        planes = self.repair_planes(lost)
+        runs: list[tuple[int, int]] = []
+        for z in planes:
+            if runs and runs[-1][0] + runs[-1][1] == z:
+                runs[-1] = (runs[-1][0], runs[-1][1] + 1)
+            else:
+                runs.append((z, 1))
+        return runs
+
+    def minimum_to_decode(self, want_to_read, available):
+        want = set(want_to_read)
+        avail = set(available)
+        if want <= avail:
+            return {c: [(0, -1)] for c in sorted(want)}
+        missing = want - avail
+        if (
+            len(missing) == 1
+            and self.d == self.k + self.m - 1
+            and len(avail) >= self.d
+        ):
+            ranges = self.repair_subchunk_ranges(next(iter(missing)))
+            return {c: list(ranges) for c in sorted(avail)[: self.d]}
+        if len(avail) < self.k:
+            raise InsufficientChunks(f"need {self.k} chunks, have {len(avail)}")
+        return {c: [(0, -1)] for c in sorted(avail)[: self.k]}
+
+    def _repair_one(
+        self, have: dict[int, np.ndarray], lost: int, sub_len: int
+    ) -> np.ndarray:
+        """Rebuild `lost` reading only the repair planes from all survivors."""
+        nq, t, Z = self.q, self.t, self.sub_chunk_count
+        n_nodes = self.k + self.m
+        x0, y0 = self._node(lost)
+        planes = self.repair_planes(lost)
+        plane_pos = {z: i for i, z in enumerate(planes)}
+        # helper sub-chunks restricted to repair planes
+        Cb = {
+            node: v.reshape(Z, sub_len)[planes]
+            for node, v in have.items()
+        }
+        nB = len(planes)
+        U = np.zeros((n_nodes, nB, sub_len), dtype=np.uint8)
+        known_u_nodes = []
+        for node in sorted(have):
+            x, y = self._node(node)
+            if y == y0:
+                continue  # column y0 survivors: U unknown in B planes
+            known_u_nodes.append(node)
+            for zi, z in enumerate(planes):
+                dy = self._digit(z, y)
+                if x == dy:
+                    U[node, zi] = Cb[node][zi]
+                else:
+                    pnode = y * nq + dy
+                    zp = self._replace(z, y, x)  # stays in B (digit y0 fixed)
+                    c1 = Cb[node][zi]
+                    c2 = Cb[pnode][plane_pos[zp]]
+                    U[node, zi] = _gmul(_INV_DET, c1 ^ _gmul(GAMMA, c2))
+        # per-plane MDS decode: unknown U's are exactly column y0 (q nodes);
+        # survivors outside column y0 must supply at least k known U's
+        unknown = [y0 * nq + x for x in range(nq)]
+        if len(known_u_nodes) < self.k:
+            raise InsufficientChunks(
+                f"repair needs {self.k} helpers outside column {y0}, "
+                f"have {len(known_u_nodes)}"
+            )
+        dm = decode_matrix_for(self.generator, self.k, known_u_nodes).astype(np.uint8)
+        for zi in range(nB):
+            data_u = apply_matrix(dm, U[known_u_nodes[: self.k], zi])
+            full = np.zeros((n_nodes, sub_len), dtype=np.uint8)
+            full[: self.k] = data_u
+            full[self.k :] = apply_matrix(self.coding, data_u)
+            for node in unknown:
+                U[node, zi] = full[node]
+        # rebuild lost chunk: B-planes are vertex (C = U); others via pairs
+        out = np.zeros((Z, sub_len), dtype=np.uint8)
+        for z in range(Z):
+            dy0 = self._digit(z, y0)
+            if dy0 == x0:
+                out[z] = U[lost, plane_pos[z]]
+            else:
+                pnode = y0 * nq + dy0  # surviving column-y0 node
+                zp = self._replace(z, y0, x0)  # in B
+                zpi = plane_pos[zp]
+                # C2 = g*U1 ^ U2 with P1=(lost;z), P2=(pnode;zp):
+                u1 = _gmul(_INV_G, Cb[pnode][zpi] ^ U[pnode, zpi])
+                out[z] = u1 ^ _gmul(GAMMA, U[pnode, zpi])
+        return out.reshape(Z * sub_len)
+
+
+class ClayPlugin(ErasureCodePlugin):
+    """reference: clay/ErasureCodePluginClay.cc."""
+
+    def factory(self, profile: dict) -> ClayCodec:
+        return ClayCodec(profile)

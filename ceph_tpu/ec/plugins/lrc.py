@@ -1,0 +1,250 @@
+"""LRC — layered locally-repairable code plugin.
+
+Reference: src/erasure-code/lrc/ErasureCodeLrc.{h,cc} — a composition codec:
+the profile gives a `mapping` string positioning every chunk and a list of
+`layers`, each layer being its own codec (instantiated through the registry,
+"plugin composition", SURVEY.md §2.1) over the positions its own mini-mapping
+selects.  Local layers repair single failures reading only their group;
+the global layer provides cross-group protection.
+
+Profile forms supported (as in the reference):
+- mapping= + layers= (JSON list of [layer_mapping, layer_profile_json])
+- k= m= l= sugar: k data + m global parities + one local parity per
+  locality group of l chunks (the reference generates mapping/layers from
+  k/m/l the same way; reference: ErasureCodeLrc::parse_kml).
+
+Layer mapping characters: D = chunk in this layer (data or parity of an
+outer view), c = coding chunk produced by this layer, _ = not in this layer.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..interface import ErasureCode, InsufficientChunks, InvalidProfile
+from ..registry import ErasureCodePlugin
+
+
+class LrcCodec(ErasureCode):
+    def init(self, profile: dict) -> None:
+        self.profile = dict(profile)
+        if "mapping" in profile and "layers" in profile:
+            mapping = profile["mapping"]
+            layers = profile["layers"]
+            if isinstance(layers, str):
+                layers = json.loads(layers)
+        elif all(x in profile for x in ("k", "m", "l")):
+            mapping, layers = self._generate_kml(
+                self.parse_int(profile, "k", 4),
+                self.parse_int(profile, "m", 2),
+                self.parse_int(profile, "l", 3),
+            )
+        else:
+            raise InvalidProfile(
+                "lrc profile needs mapping=+layers= or k=+m=+l="
+            )
+        self.mapping = mapping
+        self.n = len(mapping)
+        self.k = sum(1 for ch in mapping if ch == "D")
+        self.m = self.n - self.k
+        self._build_layers(layers)
+
+    def _generate_kml(self, k: int, m: int, l: int):
+        """ErasureCodeLrc::parse_kml shape: data+global parities split into
+        groups of l, one local parity appended per group."""
+        if (k + m) % l:
+            raise InvalidProfile(f"k+m={k + m} must be divisible by l={l}")
+        groups = (k + m) // l
+        mapping = ""
+        pos = 0
+        for _ in range(groups):
+            mapping += "".join(
+                "D" if pos + i < k else "_" for i in range(l)
+            )
+            pos += l
+            mapping += "_"  # local parity slot
+        # globals occupy the '_' data slots after k
+        chars = list(mapping)
+        # mark global parity slots: the first m non-D slots inside groups
+        marked = 0
+        for i, ch in enumerate(chars):
+            if ch == "_" and (i + 1) % (l + 1) != 0 and marked < m:
+                chars[i] = "D"  # globals act as data for local layers
+                marked += 1
+        mapping = "".join(chars)
+        layers = []
+        # global layer: RS over the k data producing m globals
+        gmap = "".join(
+            "D" if (i + 1) % (l + 1) != 0 and self._is_data_slot(i, k, l) else
+            ("c" if (i + 1) % (l + 1) != 0 and chars[i] == "D" and not self._is_data_slot(i, k, l) else "_")
+            for i in range(len(chars))
+        )
+        layers.append([gmap, {"plugin": "jax", "technique": "cauchy_good"}])
+        # local layers: one XOR parity per group
+        for g in range(groups):
+            lmap = ["_"] * len(chars)
+            base = g * (l + 1)
+            for i in range(l):
+                if chars[base + i] == "D":
+                    lmap[base + i] = "D"
+            lmap[base + l] = "c"
+            layers.append(
+                ["".join(lmap), {"plugin": "jax", "technique": "reed_sol_van"}]
+            )
+        # outer mapping: D for true data, _ for every parity
+        outer = "".join(
+            "D" if self._is_data_slot(i, k, l) and chars[i] == "D" else "_"
+            for i in range(len(chars))
+        )
+        return outer, layers
+
+    @staticmethod
+    def _is_data_slot(i: int, k: int, l: int) -> bool:
+        group, off = divmod(i, l + 1)
+        if off == l:
+            return False
+        return group * l + off < k
+
+    def _build_layers(self, layers) -> None:
+        from ..registry import ErasureCodePluginRegistry
+
+        reg = ErasureCodePluginRegistry.instance()
+        self.layers = []
+        for lmap, lprofile in layers:
+            if isinstance(lprofile, str):
+                lprofile = json.loads(lprofile) if lprofile.strip().startswith("{") else dict(
+                    kv.split("=", 1) for kv in lprofile.split()
+                )
+            if len(lmap) != self.n:
+                raise InvalidProfile(
+                    f"layer mapping {lmap!r} length != chunk count {self.n}"
+                )
+            d_pos = [i for i, ch in enumerate(lmap) if ch == "D"]
+            c_pos = [i for i, ch in enumerate(lmap) if ch == "c"]
+            lp = dict(lprofile)
+            lp["k"] = str(len(d_pos))
+            lp["m"] = str(len(c_pos))
+            codec = reg.factory(lp)
+            self.layers.append((d_pos, c_pos, codec))
+        if not self.layers:
+            raise InvalidProfile("lrc needs at least one layer")
+
+    def get_chunk_count(self) -> int:
+        return self.n
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    # -- encode: apply layers in order (ErasureCodeLrc::encode_chunks) ----
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
+        L = data_chunks.shape[1]
+        buf = np.zeros((self.n, L), dtype=np.uint8)
+        d_idx = [i for i, ch in enumerate(self.mapping) if ch == "D"]
+        for src, dst in enumerate(d_idx):
+            buf[dst] = data_chunks[src]
+        for d_pos, c_pos, codec in self.layers:
+            parity = codec.encode_chunks(buf[d_pos])
+            for r, dst in enumerate(c_pos):
+                buf[dst] = parity[r]
+        non_data = [i for i in range(self.n) if i not in d_idx]
+        return buf[non_data]
+
+    def chunk_index_map(self) -> tuple[list[int], list[int]]:
+        d_idx = [i for i, ch in enumerate(self.mapping) if ch == "D"]
+        return d_idx, [i for i in range(self.n) if i not in d_idx]
+
+    def _pos_of_shard(self, shard: int) -> int:
+        d_idx, p_idx = self.chunk_index_map()
+        return d_idx[shard] if shard < self.k else p_idx[shard - self.k]
+
+    def _shard_of_pos(self, pos: int) -> int:
+        d_idx, p_idx = self.chunk_index_map()
+        if pos in d_idx:
+            return d_idx.index(pos)
+        return self.k + p_idx.index(pos)
+
+    def minimum_to_decode(self, want_to_read, available):
+        """Prefer the smallest layer that can repair (local repair first) —
+        the LRC point (reference: ErasureCodeLrc::minimum_to_decode walks
+        layers).  A layer repairs a member from any k_layer of its other
+        members (MDS within the layer), and repaired positions chain into
+        later repairs without being read."""
+        want = set(want_to_read)
+        avail = set(available)
+        if want <= avail:
+            return {c: [(0, -1)] for c in sorted(want)}
+        missing_pos = {self._pos_of_shard(s) for s in want - avail}
+        avail_pos = {self._pos_of_shard(s) for s in avail}
+        layers_by_size = sorted(self.layers, key=lambda t: len(t[0]) + len(t[1]))
+        read_pos: set[int] = set()
+        repaired: set[int] = set()
+        unresolved = set(missing_pos)
+        while unresolved:
+            progress = False
+            for mp in sorted(unresolved):
+                for d_pos, c_pos, _codec in layers_by_size:
+                    members = set(d_pos) | set(c_pos)
+                    if mp not in members:
+                        continue
+                    usable = (members - {mp}) & (avail_pos | repaired)
+                    if len(usable) < len(d_pos):
+                        continue
+                    take = sorted(usable)[: len(d_pos)]
+                    read_pos |= set(take) & avail_pos
+                    repaired.add(mp)
+                    unresolved.remove(mp)
+                    progress = True
+                    break
+                if progress:
+                    break
+            if not progress:
+                raise InsufficientChunks(
+                    f"lrc cannot repair positions {sorted(unresolved)} "
+                    f"from {sorted(avail_pos)}"
+                )
+        chunks = {self._shard_of_pos(p) for p in read_pos} | (want & avail)
+        return {c: [(0, -1)] for c in sorted(chunks)}
+
+    def decode_chunks(self, want_to_read, chunks):
+        """Iterative layered repair: run layers until wanted chunks appear."""
+        L = len(next(iter(chunks.values())))
+        buf: dict[int, np.ndarray] = {
+            self._pos_of_shard(s): np.asarray(v, dtype=np.uint8)
+            for s, v in chunks.items()
+        }
+        want_pos = {self._pos_of_shard(s) for s in set(want_to_read)}
+        for _ in range(len(self.layers) + 1):
+            if want_pos <= set(buf):
+                break
+            progress = False
+            for d_pos, c_pos, codec in self.layers:
+                members = d_pos + c_pos
+                missing = [p for p in members if p not in buf]
+                if not missing:
+                    continue
+                have = {i: buf[p] for i, p in enumerate(members) if p in buf}
+                if len(have) < len(d_pos):
+                    continue
+                try:
+                    out = codec.decode_chunks(set(range(len(members))), have)
+                except (InsufficientChunks, np.linalg.LinAlgError):
+                    continue  # this layer can't help yet; a later pass may
+                for i, p in enumerate(members):
+                    if p not in buf and i in out:
+                        buf[p] = np.asarray(out[i], dtype=np.uint8)
+                        progress = True
+            if not progress:
+                break
+        missing = want_pos - set(buf)
+        if missing:
+            raise InsufficientChunks(f"lrc could not rebuild positions {sorted(missing)}")
+        return {s: buf[self._pos_of_shard(s)] for s in set(want_to_read)}
+
+
+class LrcPlugin(ErasureCodePlugin):
+    """reference: lrc/ErasureCodePluginLrc.cc."""
+
+    def factory(self, profile: dict) -> LrcCodec:
+        return LrcCodec(profile)

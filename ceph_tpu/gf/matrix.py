@@ -210,6 +210,94 @@ def invert_matrix(mat: np.ndarray) -> np.ndarray:
     return inv
 
 
+def gf_rank(mat: np.ndarray) -> int:
+    """Rank of a GF(2^8) matrix (row echelon by Gaussian elimination)."""
+    a = np.array(mat, dtype=np.int64)
+    rows, cols = a.shape
+    rank = 0
+    for c in range(cols):
+        piv = None
+        for r in range(rank, rows):
+            if a[r, c] != 0:
+                piv = r
+                break
+        if piv is None:
+            continue
+        a[[rank, piv]] = a[[piv, rank]]
+        inv = gf_inv(int(a[rank, c]))
+        for cc in range(cols):
+            a[rank, cc] = gf_mul(inv, int(a[rank, cc]))
+        for r in range(rows):
+            if r != rank and a[r, c] != 0:
+                f = int(a[r, c])
+                for cc in range(cols):
+                    a[r, cc] ^= gf_mul(f, int(a[rank, cc]))
+        rank += 1
+        if rank == rows:
+            break
+    return rank
+
+
+def gf_solve(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """Solve A @ X = B over GF(2^8) for X (unique solution required).
+
+    A: [n_eq, n_unk] coefficients, B: [n_eq, L] right-hand chunks.
+    Used by the SHEC/LRC decoders where the system is windowed parities
+    rather than a square generator submatrix (reference:
+    src/erasure-code/shec/ErasureCodeShec.cc builds and inverts the
+    analogous recovery system).  Raises LinAlgError if under-determined.
+    """
+    A = np.array(A, dtype=np.int64)
+    B = np.array(B, dtype=np.int64)
+    n_eq, n_unk = A.shape
+    if B.shape[0] != n_eq:
+        raise ValueError("A and B row mismatch")
+    aug_a = A.copy()
+    aug_b = B.copy()
+    row = 0
+    pivots = []
+    for c in range(n_unk):
+        piv = None
+        for r in range(row, n_eq):
+            if aug_a[r, c] != 0:
+                piv = r
+                break
+        if piv is None:
+            raise np.linalg.LinAlgError(
+                f"GF system under-determined at unknown {c}"
+            )
+        if piv != row:
+            aug_a[[row, piv]] = aug_a[[piv, row]]
+            aug_b[[row, piv]] = aug_b[[piv, row]]
+        inv = gf_inv(int(aug_a[row, c]))
+        if inv != 1:
+            for cc in range(n_unk):
+                aug_a[row, cc] = gf_mul(inv, int(aug_a[row, cc]))
+            aug_b[row] = _row_scale(aug_b[row], inv)
+        for r in range(n_eq):
+            if r != row and aug_a[r, c] != 0:
+                f = int(aug_a[r, c])
+                for cc in range(n_unk):
+                    aug_a[r, cc] ^= gf_mul(f, int(aug_a[row, cc]))
+                aug_b[r] ^= _row_scale(aug_b[row], f)
+        pivots.append(c)
+        row += 1
+        if row == n_eq:
+            break
+    if len(pivots) < n_unk:
+        raise np.linalg.LinAlgError("GF system under-determined")
+    X = np.zeros((n_unk, B.shape[1]), dtype=np.int64)
+    for r, c in enumerate(pivots):
+        X[c] = aug_b[r]
+    return X.astype(np.uint8)
+
+
+def _row_scale(row: np.ndarray, f: int) -> np.ndarray:
+    from .tables import GF_MUL_TABLE
+
+    return GF_MUL_TABLE[f, row.astype(np.uint8)].astype(np.int64)
+
+
 def systematic_generator(coding: np.ndarray) -> np.ndarray:
     """[I_k ; C] — full (k+m) x k generator for a systematic code."""
     m, k = coding.shape
