@@ -227,7 +227,13 @@ class ClayCodec(ErasureCode):
             and len(avail) >= self.d
         ):
             ranges = self.repair_subchunk_ranges(next(iter(missing)))
-            return {c: list(ranges) for c in sorted(avail)[: self.d]}
+            plan = {c: list(ranges) for c in sorted(avail)[: self.d]}
+            # wanted-and-available chunks are read in full, not just the
+            # repair planes a helper contributes (reference: Clay's
+            # minimum_to_decode merges want_to_read into the helper set)
+            for c in want & avail:
+                plan[c] = [(0, -1)]
+            return plan
         if len(avail) < self.k:
             raise InsufficientChunks(f"need {self.k} chunks, have {len(avail)}")
         return {c: [(0, -1)] for c in sorted(avail)[: self.k]}

@@ -209,7 +209,6 @@ class LrcCodec(ErasureCode):
 
     def decode_chunks(self, want_to_read, chunks):
         """Iterative layered repair: run layers until wanted chunks appear."""
-        L = len(next(iter(chunks.values())))
         buf: dict[int, np.ndarray] = {
             self._pos_of_shard(s): np.asarray(v, dtype=np.uint8)
             for s, v in chunks.items()

@@ -174,6 +174,46 @@ def matrix_to_bitmatrix(mat: np.ndarray, w: int = 8) -> np.ndarray:
     return bm
 
 
+def _rref(A: np.ndarray, B: np.ndarray | None) -> list[int]:
+    """Reduce A to reduced row echelon form over GF(2^8), in place, applying
+    the same row operations to B (the augmented block) when given.  Returns
+    the pivot column indices.  Shared engine of invert_matrix / gf_rank /
+    gf_solve — one elimination loop to keep bit-exact semantics in one place.
+
+    Entries must be bytes (0..255) regardless of dtype; B may be wide chunk
+    data (vectorized via the GF multiplication table).
+    """
+    from .tables import GF_MUL_TABLE
+
+    rows, cols = A.shape
+    row = 0
+    pivots: list[int] = []
+    for c in range(cols):
+        piv = next((r for r in range(row, rows) if A[r, c] != 0), None)
+        if piv is None:
+            continue
+        if piv != row:
+            A[[row, piv]] = A[[piv, row]]
+            if B is not None:
+                B[[row, piv]] = B[[piv, row]]
+        inv = gf_inv(int(A[row, c]))
+        if inv != 1:
+            A[row] = _row_scale(A[row], inv)
+            if B is not None:
+                B[row] = _row_scale(B[row], inv)
+        for r in range(rows):
+            if r != row and A[r, c] != 0:
+                f = int(A[r, c])
+                A[r] ^= _row_scale(A[row], f)
+                if B is not None:
+                    B[r] ^= _row_scale(B[row], f)
+        pivots.append(c)
+        row += 1
+        if row == rows:
+            break
+    return pivots
+
+
 def invert_matrix(mat: np.ndarray) -> np.ndarray:
     """GF(2^8) Gauss-Jordan inversion (jerasure.c :: jerasure_invert_matrix).
 
@@ -186,56 +226,14 @@ def invert_matrix(mat: np.ndarray) -> np.ndarray:
     if mat.shape != (n, n):
         raise ValueError("square matrix required")
     inv = np.eye(n, dtype=np.int64)
-    for i in range(n):
-        if mat[i, i] == 0:
-            for r in range(i + 1, n):
-                if mat[r, i] != 0:
-                    mat[[i, r]] = mat[[r, i]]
-                    inv[[i, r]] = inv[[r, i]]
-                    break
-            else:
-                raise np.linalg.LinAlgError("singular matrix over GF(2^8)")
-        piv = int(mat[i, i])
-        if piv != 1:
-            pinv = gf_div(1, piv)
-            for c in range(n):
-                mat[i, c] = gf_mul(int(mat[i, c]), pinv)
-                inv[i, c] = gf_mul(int(inv[i, c]), pinv)
-        for r in range(n):
-            if r != i and mat[r, i] != 0:
-                f = int(mat[r, i])
-                for c in range(n):
-                    mat[r, c] ^= gf_mul(f, int(mat[i, c]))
-                    inv[r, c] ^= gf_mul(f, int(inv[i, c]))
+    if len(_rref(mat, inv)) != n:
+        raise np.linalg.LinAlgError("singular matrix over GF(2^8)")
     return inv
 
 
 def gf_rank(mat: np.ndarray) -> int:
     """Rank of a GF(2^8) matrix (row echelon by Gaussian elimination)."""
-    a = np.array(mat, dtype=np.int64)
-    rows, cols = a.shape
-    rank = 0
-    for c in range(cols):
-        piv = None
-        for r in range(rank, rows):
-            if a[r, c] != 0:
-                piv = r
-                break
-        if piv is None:
-            continue
-        a[[rank, piv]] = a[[piv, rank]]
-        inv = gf_inv(int(a[rank, c]))
-        for cc in range(cols):
-            a[rank, cc] = gf_mul(inv, int(a[rank, cc]))
-        for r in range(rows):
-            if r != rank and a[r, c] != 0:
-                f = int(a[r, c])
-                for cc in range(cols):
-                    a[r, cc] ^= gf_mul(f, int(a[rank, cc]))
-        rank += 1
-        if rank == rows:
-            break
-    return rank
+    return len(_rref(np.array(mat, dtype=np.int64), None))
 
 
 def gf_solve(A: np.ndarray, B: np.ndarray) -> np.ndarray:
@@ -254,36 +252,7 @@ def gf_solve(A: np.ndarray, B: np.ndarray) -> np.ndarray:
         raise ValueError("A and B row mismatch")
     aug_a = A.copy()
     aug_b = B.copy()
-    row = 0
-    pivots = []
-    for c in range(n_unk):
-        piv = None
-        for r in range(row, n_eq):
-            if aug_a[r, c] != 0:
-                piv = r
-                break
-        if piv is None:
-            raise np.linalg.LinAlgError(
-                f"GF system under-determined at unknown {c}"
-            )
-        if piv != row:
-            aug_a[[row, piv]] = aug_a[[piv, row]]
-            aug_b[[row, piv]] = aug_b[[piv, row]]
-        inv = gf_inv(int(aug_a[row, c]))
-        if inv != 1:
-            for cc in range(n_unk):
-                aug_a[row, cc] = gf_mul(inv, int(aug_a[row, cc]))
-            aug_b[row] = _row_scale(aug_b[row], inv)
-        for r in range(n_eq):
-            if r != row and aug_a[r, c] != 0:
-                f = int(aug_a[r, c])
-                for cc in range(n_unk):
-                    aug_a[r, cc] ^= gf_mul(f, int(aug_a[row, cc]))
-                aug_b[r] ^= _row_scale(aug_b[row], f)
-        pivots.append(c)
-        row += 1
-        if row == n_eq:
-            break
+    pivots = _rref(aug_a, aug_b)
     if len(pivots) < n_unk:
         raise np.linalg.LinAlgError("GF system under-determined")
     X = np.zeros((n_unk, B.shape[1]), dtype=np.int64)

@@ -181,6 +181,17 @@ class TestClay:
             codec.d / (codec.k * codec.q)
         )  # 11/32 = 0.34375
 
+    def test_repair_plan_reads_wanted_available_chunks_in_full(self):
+        # A chunk that is wanted AND available must be planned as a full
+        # read even when it also serves as a repair helper — the repair
+        # sub-chunk ranges alone would under-read it.
+        codec = REG.factory({"plugin": "clay", "k": "8", "m": "4", "d": "11"})
+        md = codec.minimum_to_decode({3, 5}, set(range(12)) - {3})
+        assert md[5] == [(0, -1)]
+        # pure helpers still read only the repair planes
+        Z = codec.sub_chunk_count
+        assert sum(c for _, c in md[0]) == Z // codec.q
+
     def test_single_repair_every_position(self):
         codec = REG.factory({"plugin": "clay", "k": "8", "m": "4", "d": "11"})
         shards = _shards(codec, seed=5)
