@@ -25,12 +25,12 @@ def make_chained_encode(coding: np.ndarray, kernel: str = "xla"):
     coding = np.ascontiguousarray(coding, dtype=np.uint8)
     m = coding.shape[0]
     if kernel == "pallas":
-        from ..ops.pallas_gf import _apply_padded, _permuted_bitmatrix
+        from ..ops.pallas_gf import DEFAULT_TILE, _apply_padded, _permuted_bitmatrix
 
         B = jnp.asarray(_permuted_bitmatrix(coding.tobytes(), coding.shape))
 
         def apply_fn(x):
-            return _apply_padded(B, x, m, coding.shape[1], 8192, False)
+            return _apply_padded(B, x, m, coding.shape[1], DEFAULT_TILE, False)
 
     else:
         from ..ops.bitplane import _apply_bitmatrix, bitmatrix_device
@@ -66,6 +66,15 @@ def time_chained_encode(
 
     loop = make_chained_encode(coding, kernel)
     x = jnp.asarray(chunks)
+    if kernel == "pallas":
+        # _apply_padded requires tile-aligned lengths; pad once up front.
+        # Padded bytes are computed but not counted, so reported throughput
+        # can only be under-, never over-stated.
+        from ..ops.pallas_gf import DEFAULT_TILE
+
+        pad = (-x.shape[1]) % DEFAULT_TILE
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad)))
     # warm BOTH computations used in the timed region (loop + scalar fetch):
     # remote compile must not land in the timing
     np.asarray(loop(x, 1)[0, 0])

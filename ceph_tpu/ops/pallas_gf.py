@@ -31,7 +31,7 @@ import numpy as np
 
 from ..gf.matrix import matrix_to_bitmatrix
 
-DEFAULT_TILE = 8192
+DEFAULT_TILE = 32768
 
 
 @lru_cache(maxsize=256)
@@ -58,13 +58,13 @@ def _apply_kernel(B_ref, x_ref, o_ref, *, n: int, rows: int):
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
     )  # [rows*8, T]
-    par = (acc & 1).astype(jnp.uint8)
+    par = acc & 1  # int32: Mosaic cannot legalize vector shifts on int8
     T = par.shape[1]
     stacked = par.reshape(rows, 8, T)
     packed = stacked[:, 0, :]
     for l in range(1, 8):
         packed = packed | (stacked[:, l, :] << l)
-    o_ref[:] = packed
+    o_ref[:] = packed.astype(jnp.uint8)
 
 
 @partial(jax.jit, static_argnames=("rows", "n", "tile", "interpret"))
@@ -72,6 +72,8 @@ def _apply_padded(B, chunks, rows: int, n: int, tile: int, interpret: bool):
     from jax.experimental import pallas as pl
 
     L = chunks.shape[1]
+    if L % tile:
+        raise ValueError(f"chunk length {L} not a multiple of tile {tile}")
     grid = (L // tile,)
     return pl.pallas_call(
         partial(_apply_kernel, n=n, rows=rows),
