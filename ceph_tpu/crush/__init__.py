@@ -11,11 +11,13 @@ from .builder import (
 from .mapper import CompiledCrushMap, crush_do_rule_batch
 from .reference_mapper import bucket_straw2_choose, crush_do_rule
 from .types import ITEM_NONE, CrushMap, Rule, RuleOp, RuleStep, Straw2Bucket, Tunables
+from .wrapper import CrushWrapper
 
 __all__ = [
     "ITEM_NONE",
     "CompiledCrushMap",
     "CrushMap",
+    "CrushWrapper",
     "Rule",
     "RuleOp",
     "RuleStep",

@@ -44,6 +44,36 @@ jax.config.update("jax_enable_x64", True)
 S64_MIN = np.int64(np.iinfo(np.int64).min)
 
 
+def validate_choose_args(
+    cmap: CrushMap, name: str
+) -> dict[int, list[list[int]]]:
+    """Resolve and sanity-check a named choose_args weight-set: the name
+    must exist, every bucket id must be a real (negative) bucket, every
+    weight_set must be non-empty with rows matching the bucket size.
+    Shared by the scalar and batch entry points so malformed maps (e.g.
+    hand-edited text) fail identically everywhere."""
+    if name not in cmap.choose_args:
+        raise KeyError(
+            f"unknown choose_args {name!r}; known: {sorted(cmap.choose_args)}"
+        )
+    ca = cmap.choose_args[name]
+    for bid, ws in ca.items():
+        if bid >= 0 or bid not in cmap.buckets:
+            raise ValueError(f"choose_args {name!r}: no such bucket {bid}")
+        if not ws:
+            raise ValueError(
+                f"choose_args {name!r}: empty weight_set for bucket {bid}"
+            )
+        size = len(cmap.buckets[bid].items)
+        for row in ws:
+            if len(row) != size:
+                raise ValueError(
+                    f"choose_args {name!r}: weight_set row of {len(row)} "
+                    f"for bucket {bid} of size {size}"
+                )
+    return ca
+
+
 class CompiledCrushMap:
     """Dense-array form of a CrushMap for device execution."""
 
@@ -69,6 +99,30 @@ class CompiledCrushMap:
         self.n_idx = n_idx
         self.ln_table = jnp.asarray(CRUSH_LN_TABLE)
         self.max_size = max_size
+        self._choose_args_cache: dict[str, jnp.ndarray] = {}
+
+    def choose_args_arrays(self, name: str) -> jnp.ndarray:
+        """Dense [positions, n_idx, max_size] weight array for a named
+        choose_args weight-set (reference: crush_choose_arg_map).  Buckets
+        without an entry keep their own weights; buckets with fewer
+        weight_set rows than the max are clamped to their last row — the
+        get_choose_arg_weights position clamp, applied at build time."""
+        cached = self._choose_args_cache.get(name)
+        if cached is not None:
+            return cached
+        ca = validate_choose_args(self.cmap, name)
+        P = max((len(ws) for ws in ca.values()), default=1)
+        base = np.asarray(self.weights)
+        dense = np.broadcast_to(base, (P,) + base.shape).copy()
+        for bid, ws in ca.items():
+            i = -1 - bid
+            size = len(self.cmap.buckets[bid].items)
+            for p in range(P):
+                row = ws[min(p, len(ws) - 1)]
+                dense[p, i, :size] = row
+        arr = jnp.asarray(dense)
+        self._choose_args_cache[name] = arr
+        return arr
 
     def item_type(self, item):
         """type of an item id: devices 0, buckets their declared type."""
@@ -82,15 +136,21 @@ def _div64_trunc(a, b):
     return jnp.where((a < 0) != (b < 0), -q, q).astype(jnp.int64)
 
 
-def _straw2_choose(cm: CompiledCrushMap, bucket_idx, x, r):
+def _straw2_choose(cm: CompiledCrushMap, bucket_idx, x, r, cweights, position):
     """mapper.c :: bucket_straw2_choose for one x (vmap-friendly).
 
     Exponential-race draw per slot; first argmax matches the C loop's
     strict-greater update.  Empty bucket -> ITEM_NONE; all-zero-weight
-    bucket -> items[0] (C semantics: high stays 0)."""
+    bucket -> items[0] (C semantics: high stays 0).  cweights is an optional
+    [P, n_idx, S] choose_args weight array; position picks the row (clamped,
+    as get_choose_arg_weights does)."""
     bucket_idx = jnp.clip(bucket_idx, 0, cm.items.shape[0] - 1)
     items = cm.items[bucket_idx]        # [S]
-    weights = cm.weights[bucket_idx]    # [S]
+    if cweights is None:
+        weights = cm.weights[bucket_idx]    # [S]
+    else:
+        pos = jnp.minimum(position, cweights.shape[0] - 1)
+        weights = cweights[pos, bucket_idx]
     size = cm.sizes[bucket_idx]
     u = (
         crush_hash32_3(
@@ -116,7 +176,7 @@ def _is_out(weightvec, item, x):
     return oob | (w == 0) | ((w < 0x10000) & (h >= w))
 
 
-def _descend(cm: CompiledCrushMap, root, x, r, want_type: int):
+def _descend(cm: CompiledCrushMap, root, x, r, want_type: int, cweights, position):
     """Walk intervening buckets until an item of want_type appears
     (mapper.c's inner retry_bucket descent); dead ends yield ITEM_NONE.
 
@@ -128,7 +188,7 @@ def _descend(cm: CompiledCrushMap, root, x, r, want_type: int):
         return (item < 0) & (item != ITEM_NONE) & (cm.item_type(item) != want_type)
 
     def body(item):
-        return _straw2_choose(cm, -1 - item, x, r)
+        return _straw2_choose(cm, -1 - item, x, r, cweights, position)
 
     item = jax.lax.while_loop(cond, body, jnp.asarray(root, jnp.int32))
     if want_type != 0:
@@ -136,13 +196,15 @@ def _descend(cm: CompiledCrushMap, root, x, r, want_type: int):
     return item
 
 
-def _leaf_firstn(cm, weightvec, x, item, sub_r, outpos, out2, S, recurse_tries):
+def _leaf_firstn(
+    cm, weightvec, x, item, sub_r, outpos, out2, S, recurse_tries, cweights
+):
     """Nested chooseleaf descent (crush_choose_firstn recursion with
     stable=1: one rep, r = sub_r + ftotal, collisions vs out2[:outpos])."""
 
     def body(state):
         ftotal, _, done = state
-        leaf = _descend(cm, item, x, sub_r + ftotal, 0)
+        leaf = _descend(cm, item, x, sub_r + ftotal, 0, cweights, outpos)
         is_dev = leaf >= 0
         collide = jnp.any((out2 == leaf) & (jnp.arange(S) < outpos)) & is_dev
         reject = jnp.where(is_dev, _is_out(weightvec, leaf, x), True)
@@ -160,7 +222,8 @@ def _leaf_firstn(cm, weightvec, x, item, sub_r, outpos, out2, S, recurse_tries):
 
 
 def _choose_firstn_single(
-    cm, weightvec, x, root, numrep, want_type, tries, recurse, recurse_tries
+    cm, weightvec, x, root, numrep, want_type, tries, recurse, recurse_tries,
+    cweights,
 ):
     """crush_choose_firstn for one x under modern tunables.
 
@@ -176,14 +239,15 @@ def _choose_firstn_single(
         def try_body(state):
             ftotal, _, _, done = state
             r = rep + ftotal
-            cand = _descend(cm, root, x, r, want_type)
+            cand = _descend(cm, root, x, r, want_type, cweights, outpos)
             dead = cand == ITEM_NONE
             collide = jnp.any((out == cand) & (jnp.arange(S) < outpos)) & ~dead
             if recurse:
                 leaf, leaf_ok = jax.lax.cond(
                     (cand < 0) & ~dead & ~collide,
                     lambda: _leaf_firstn(
-                        cm, weightvec, x, cand, r, outpos, out2, S, recurse_tries
+                        cm, weightvec, x, cand, r, outpos, out2, S,
+                        recurse_tries, cweights,
                     ),
                     lambda: (
                         jnp.asarray(cand, jnp.int32),
@@ -219,7 +283,8 @@ def _choose_firstn_single(
 
 
 def _choose_indep_single(
-    cm, weightvec, x, root, numrep, want_type, tries, recurse, recurse_tries
+    cm, weightvec, x, root, numrep, want_type, tries, recurse, recurse_tries,
+    cweights,
 ):
     """crush_choose_indep for one x: positional retries r = rep +
     numrep*ftotal; failed positions stay ITEM_NONE (EC shard holes).
@@ -236,7 +301,9 @@ def _choose_indep_single(
         def rep_body(rep, carry2):
             out, out2, placed = carry2
             r = rep + numrep * ftotal
-            cand = _descend(cm, root, x, r, want_type)
+            # weight-set position is the choose's outpos — 0 at the top
+            # level (mapper.c); the leaf recursion below uses rep, its outpos
+            cand = _descend(cm, root, x, r, want_type, cweights, 0)
             dead = cand == ITEM_NONE
             collide = jnp.any((out == cand) & placed) & ~dead
             if recurse:
@@ -244,7 +311,10 @@ def _choose_indep_single(
                 def leaf_loop():
                     def lbody(state):
                         lf, _, done = state
-                        leaf = _descend(cm, cand, x, rep + numrep * lf + r, 0)
+                        leaf = _descend(
+                            cm, cand, x, rep + numrep * lf + r, 0, cweights,
+                            rep,
+                        )
                         ok = (leaf >= 0) & ~_is_out(weightvec, leaf, x)
                         return lf + 1, leaf, done | ok
 
@@ -354,6 +424,7 @@ def crush_do_rule_batch(
     xs,
     numrep: int,
     weightvec,
+    choose_args: str | None = None,
 ) -> jnp.ndarray:
     """Batched crush_do_rule: xs [N] -> [N, numrep] OSD ids.
 
@@ -365,6 +436,9 @@ def crush_do_rule_batch(
     p = compile_rule(cm, rule_id, numrep)
     xs = jnp.asarray(xs, dtype=jnp.int32)
     weightvec = jnp.asarray(weightvec, dtype=jnp.int64)
+    cweights = (
+        cm.choose_args_arrays(choose_args) if choose_args is not None else None
+    )
     fn = _choose_firstn_single if p["firstn"] else _choose_indep_single
     tries = p["tries"]
     recurse_tries = (
@@ -382,6 +456,7 @@ def crush_do_rule_batch(
             tries,
             p["recurse"],
             recurse_tries,
+            cweights,
         )
         res = out2 if p["recurse"] else out
         if p["firstn"]:

@@ -27,7 +27,8 @@ def _crush_lib() -> ctypes.CDLL:
         _i32p, _i64p, _i32p, _i32p,
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, _u32p, ctypes.c_long, _u32p, ctypes.c_int, _i32p,
+        ctypes.c_int, _u32p, ctypes.c_long, _u32p, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_int, _i32p,
     ]
     lib.cro_do_rule_batch.restype = ctypes.c_int
     lib.cro_hash3.argtypes = [ctypes.c_uint32] * 3
@@ -60,7 +61,12 @@ def crush_ln(u: int) -> int:
 
 
 def do_rule_batch_oracle(
-    cmap: CrushMap, rule_id: int, xs, numrep: int, weightvec
+    cmap: CrushMap,
+    rule_id: int,
+    xs,
+    numrep: int,
+    weightvec,
+    choose_args: str | None = None,
 ) -> np.ndarray:
     """Batched crush_do_rule via the C++ oracle; same contract as
     ceph_tpu.crush.mapper.crush_do_rule_batch."""
@@ -76,11 +82,21 @@ def do_rule_batch_oracle(
     recurse_tries = (
         (p["leaf_tries"] or p["tries"]) if p["firstn"] else (p["leaf_tries"] or 1)
     )
+    if choose_args is not None:
+        cw = np.ascontiguousarray(
+            np.asarray(cm.choose_args_arrays(choose_args)), dtype=np.int64
+        )
+        positions = cw.shape[0]
+        cw_ptr = cw.ctypes.data_as(ctypes.c_void_p)
+    else:
+        cw = None  # noqa: F841 — keep the buffer alive through the call
+        positions = 0
+        cw_ptr = None
     rc = _crush_lib().cro_do_rule_batch(
         items.reshape(-1), weights.reshape(-1), sizes, types,
         items.shape[0], items.shape[1], p["take"], p["want"], p["type"],
         int(p["firstn"]), int(p["recurse"]), p["tries"], recurse_tries,
-        xs, len(xs), wv, len(wv), out.reshape(-1),
+        xs, len(xs), wv, len(wv), cw_ptr, positions, out.reshape(-1),
     )
     if rc != 0:
         raise ValueError(f"cro_do_rule_batch failed rc={rc}")

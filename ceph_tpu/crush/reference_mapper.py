@@ -81,17 +81,34 @@ def _div_trunc(a: int, b: int) -> int:
     return q
 
 
-def bucket_straw2_choose(bucket: Straw2Bucket, x: int, r: int) -> int:
+def _arg_weights(choose_args, bucket: Straw2Bucket, position: int):
+    """Weight vector for a bucket under a choose_args weight-set
+    (reference: mapper.c :: get_choose_arg_weights — position clamps to the
+    last weight_set row).  None -> the bucket's own weights."""
+    if not choose_args:
+        return None
+    ws = choose_args.get(bucket.id)
+    if not ws:
+        return None
+    return ws[min(position, len(ws) - 1)]
+
+
+def bucket_straw2_choose(
+    bucket: Straw2Bucket, x: int, r: int, weights=None
+) -> int:
     """mapper.c :: bucket_straw2_choose — max of ln(u)/w fixed-point draws.
 
     ln = crush_ln(u) - 2^48 is negative (log2 of u/2^16 in 16.44 fixed
     point); dividing by the 16.16 item weight makes larger weights less
     negative, so argmax favors heavier items with exactly the exponential
-    race distribution.  Zero-weight items draw S64_MIN.
+    race distribution.  Zero-weight items draw S64_MIN.  `weights`
+    substitutes a choose_args weight_set row for the bucket's own weights.
     """
+    if weights is None:
+        weights = bucket.weights
     high = 0
     high_draw = 0
-    for i, (item, weight) in enumerate(zip(bucket.items, bucket.weights)):
+    for i, (item, weight) in enumerate(zip(bucket.items, weights)):
         if weight:
             u = _hash3(x, item, r) & 0xFFFF
             ln = int(CRUSH_LN_TABLE[u]) - LN_BIAS
@@ -131,6 +148,7 @@ def _choose_firstn(
     recurse_to_leaf: bool,
     out2: list[int] | None,
     parent_r: int,
+    choose_args=None,
 ) -> int:
     """mapper.c :: crush_choose_firstn under modern tunables."""
     t = cmap.tunables
@@ -149,7 +167,10 @@ def _choose_firstn(
                 if in_bucket.size == 0:
                     reject = True
                     break
-                item = bucket_straw2_choose(in_bucket, x, r)
+                item = bucket_straw2_choose(
+                    in_bucket, x, r,
+                    _arg_weights(choose_args, in_bucket, outpos),
+                )
                 itemtype = cmap.item_type(item)
                 if itemtype != type_:
                     if item >= 0:
@@ -178,6 +199,7 @@ def _choose_firstn(
                             False,
                             None,
                             sub_r,
+                            choose_args,
                         )
                         if out2_pos <= outpos:
                             reject = True  # didn't get a leaf
@@ -216,6 +238,7 @@ def _choose_indep(
     recurse_to_leaf: bool,
     out2: list[int] | None,
     parent_r: int,
+    choose_args=None,
 ) -> None:
     """mapper.c :: crush_choose_indep — positional (EC) variant; failed
     positions end as ITEM_NONE so shard ids stay stable."""
@@ -240,7 +263,13 @@ def _choose_indep(
                         out2[rep] = ITEM_NONE
                     left_count -= 1
                     break
-                item = bucket_straw2_choose(in_bucket, x, r)
+                # mapper.c passes the choose's outpos (0 at top level) as the
+                # weight-set position here; only the leaf recursion, whose
+                # outpos is the shard position, varies by rep
+                item = bucket_straw2_choose(
+                    in_bucket, x, r,
+                    _arg_weights(choose_args, in_bucket, outpos),
+                )
                 itemtype = cmap.item_type(item)
                 if itemtype != type_:
                     if item >= 0:
@@ -261,6 +290,7 @@ def _choose_indep(
                         _choose_indep(
                             cmap, cmap.buckets[item], weight, x, 1, numrep,
                             0, out2, rep, recurse_tries, 0, False, None, r,
+                            choose_args,
                         )
                         if out2[rep] == ITEM_NONE:
                             break
@@ -280,11 +310,18 @@ def _choose_indep(
 
 
 def crush_do_rule(
-    cmap: CrushMap, rule_id: int, x: int, numrep: int, weight: list[int]
+    cmap: CrushMap,
+    rule_id: int,
+    x: int,
+    numrep: int,
+    weight: list[int],
+    choose_args: dict[int, list[list[int]]] | None = None,
 ) -> list[int]:
     """mapper.c :: crush_do_rule — interpret the rule's steps for input x.
 
     weight: per-device reweight vector (16.16), the OSDMap::osd_weight analog.
+    choose_args: bucket id -> weight_set rows (crush_choose_arg_map analog);
+    position selects the row (clamped), outpos for firstn / rep for indep.
     Returns the raw OSD list (ITEM_NONE holes preserved for indep rules).
     """
     rule = cmap.rules[rule_id]
@@ -321,14 +358,14 @@ def crush_do_rule(
                     pos = _choose_firstn(
                         cmap, bucket, weight, x, want, step.arg2, out, 0,
                         choose_tries, rt if recurse else choose_tries,
-                        recurse, out2, 0,
+                        recurse, out2, 0, choose_args,
                     )
                     chosen = (out2 if recurse else out)[:pos]
                 else:
                     _choose_indep(
                         cmap, bucket, weight, x, want, want, step.arg2, out,
                         0, choose_tries,
-                        chooseleaf_tries or 1, recurse, out2, 0,
+                        chooseleaf_tries or 1, recurse, out2, 0, choose_args,
                     )
                     chosen = (out2 if recurse else out)[:want]
                 new_working.extend(chosen)

@@ -95,6 +95,21 @@ class CrushMap:
     bucket_names: dict[int, str] = field(default_factory=dict)
     device_names: dict[int, str] = field(default_factory=dict)
     tunables: Tunables = field(default_factory=Tunables)
+    #: device classes (reference: CrushWrapper class_map / class_name):
+    #: class id -> name, osd id -> class id
+    class_names: dict[int, str] = field(default_factory=dict)
+    device_classes: dict[int, int] = field(default_factory=dict)
+    #: shadow trees per class (reference: CrushWrapper::class_bucket,
+    #: device_class_clone): original bucket id -> class id -> shadow id
+    class_bucket: dict[int, dict[int, int]] = field(default_factory=dict)
+    #: choose_args weight-sets (reference: crush.h :: crush_choose_arg_map;
+    #: the balancer's crush-compat mode writes these): name ->
+    #: {bucket id -> weight_set [positions][bucket size] 16.16}.  Item-id
+    #: remapping (crush_choose_arg::ids) is not modeled — the balancer only
+    #: adjusts weights.
+    choose_args: dict[str, dict[int, list[list[int]]]] = field(
+        default_factory=dict
+    )
 
     def bucket(self, bid: int) -> Straw2Bucket:
         return self.buckets[bid]

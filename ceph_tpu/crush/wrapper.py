@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .mapper import CompiledCrushMap, crush_do_rule_batch
+from .mapper import CompiledCrushMap, crush_do_rule_batch, validate_choose_args
 from .reference_mapper import crush_do_rule
 from .types import CrushMap, Rule, RuleOp, RuleStep, Straw2Bucket, Tunables
 
@@ -64,6 +64,175 @@ class CrushWrapper:
                 return tid
         raise KeyError(f"unknown crush type {name!r}")
 
+    # -- device classes ----------------------------------------------------
+    # reference: CrushWrapper::class_name / set_item_class /
+    # populate_classes / device_class_clone — per-class "shadow trees" so a
+    # rule can `take default class ssd` and descend only over devices of
+    # that class.  Shadow buckets are ordinary straw2 buckets (negative ids
+    # past the originals, named "<bucket>~<class>"), so the batch mapper and
+    # the C++ oracle need no special casing.
+
+    def class_id(self, name: str, create: bool = False) -> int:
+        for cid, n in self.map.class_names.items():
+            if n == name:
+                return cid
+        if not create:
+            raise KeyError(f"unknown device class {name!r}")
+        cid = max(self.map.class_names, default=-1) + 1
+        self.map.class_names[cid] = name
+        return cid
+
+    def set_device_class(self, osd: int, name: str) -> None:
+        """Tag a device; call populate_classes() once after tagging."""
+        self.map.device_classes[osd] = self.class_id(name, create=True)
+
+    def get_device_class(self, osd: int) -> str | None:
+        cid = self.map.device_classes.get(osd)
+        return None if cid is None else self.map.class_names[cid]
+
+    def _original_buckets(self) -> list[int]:
+        shadows = {
+            sid for per in self.map.class_bucket.values() for sid in per.values()
+        }
+        return [b for b in self.map.buckets if b not in shadows]
+
+    def _topo_order(self, bucket_ids) -> list[int]:
+        """Children-before-parents order over the given buckets — shared by
+        the text form and the shadow-tree builder so both orderings can
+        never drift apart."""
+        order: list[int] = []
+        done: set[int] = set()
+
+        def emit(bid: int) -> None:
+            if bid in done:
+                return
+            done.add(bid)
+            for child in self.map.buckets[bid].items:
+                if child < 0:
+                    emit(child)
+            order.append(bid)
+
+        for bid in sorted(bucket_ids):
+            emit(bid)
+        return order
+
+    def populate_classes(self) -> None:
+        """(Re)build the per-class shadow trees (reference:
+        CrushWrapper::populate_classes -> device_class_clone).
+
+        Existing rules that TAKE a shadow bucket are re-pointed at the
+        rebuilt shadow for the same (original bucket, class)."""
+        m = self.map
+        old_shadow: dict[int, tuple[int, int]] = {}
+        for bid, per in m.class_bucket.items():
+            for cid, sid in per.items():
+                old_shadow[sid] = (bid, cid)
+        for sid in old_shadow:
+            m.buckets.pop(sid, None)
+            m.bucket_names.pop(sid, None)
+        m.class_bucket = {}
+        if m.class_names:
+            # children-before-parents so a shadow can reference its
+            # children's shadows
+            order = self._topo_order(list(m.buckets))
+            next_id = min(m.buckets, default=0) - 1
+            for cid in sorted(m.class_names):
+                shadow_of: dict[int, int] = {}
+                for bid in order:
+                    b = m.buckets[bid]
+                    items: list[int] = []
+                    weights: list[int] = []
+                    for it, w in zip(b.items, b.weights):
+                        if it >= 0:
+                            if m.device_classes.get(it) == cid:
+                                items.append(it)
+                                weights.append(w)
+                        else:
+                            sid = shadow_of[it]
+                            items.append(sid)
+                            weights.append(m.buckets[sid].weight)
+                    sid = next_id
+                    next_id -= 1
+                    m.buckets[sid] = Straw2Bucket(
+                        id=sid, type=b.type, items=items, weights=weights
+                    )
+                    m.bucket_names[sid] = (
+                        f"{self.name_of(bid)}~{m.class_names[cid]}"
+                    )
+                    shadow_of[bid] = sid
+                    m.class_bucket.setdefault(bid, {})[cid] = sid
+        for rule in m.rules.values():
+            for step in rule.steps:
+                if step.op == RuleOp.TAKE and step.arg1 in old_shadow:
+                    bid, cid = old_shadow[step.arg1]
+                    step.arg1 = m.class_bucket[bid][cid]
+        self.invalidate()
+
+    def shadow_root(self, root: int, class_name: str) -> int:
+        """Shadow bucket id for (root, class) — what `take X class c`
+        compiles to."""
+        cid = self.class_id(class_name)
+        try:
+            return self.map.class_bucket[root][cid]
+        except KeyError:
+            raise KeyError(
+                f"no shadow tree for bucket {root} class {class_name!r}; "
+                "call populate_classes() after tagging devices"
+            ) from None
+
+    def add_simple_rule(
+        self,
+        root_name: str,
+        failure_domain: str,
+        device_class: str | None = None,
+        rule_id: int | None = None,
+        firstn: bool = True,
+        num_replicas: int = 0,
+    ):
+        """reference: CrushWrapper::add_simple_rule (incl. the device-class
+        form used by `ceph osd crush rule create-replicated`)."""
+        from .builder import add_simple_rule as _add
+
+        root = self.id_of(root_name)
+        if device_class is not None:
+            root = self.shadow_root(root, device_class)
+        rule = _add(
+            self.map,
+            root,
+            self.type_id(failure_domain),
+            rule_id=rule_id,
+            firstn=firstn,
+            num_replicas=num_replicas,
+        )
+        self.invalidate()
+        return rule
+
+    # -- choose_args (weight-sets) ----------------------------------------
+    def set_choose_args(
+        self, name: str, bucket_id: int, weight_set: list[list[int]]
+    ) -> None:
+        """Install an alternate weight set for one bucket (reference:
+        crush_choose_arg_map; written by the balancer's crush-compat mode).
+
+        weight_set: [positions][bucket size] 16.16 fixed-point weights."""
+        if not weight_set:
+            raise ValueError("weight_set must have at least one position row")
+        b = self.map.buckets[bucket_id]
+        for ws in weight_set:
+            if len(ws) != b.size:
+                raise ValueError(
+                    f"weight_set row has {len(ws)} entries, bucket "
+                    f"{bucket_id} has {b.size} items"
+                )
+        self.map.choose_args.setdefault(name, {})[bucket_id] = [
+            list(ws) for ws in weight_set
+        ]
+        self.invalidate()
+
+    def rm_choose_args(self, name: str) -> None:
+        self.map.choose_args.pop(name, None)
+        self.invalidate()
+
     # -- mapping ----------------------------------------------------------
     def invalidate(self) -> None:
         self._compiled = None
@@ -73,13 +242,42 @@ class CrushWrapper:
             self._compiled = CompiledCrushMap(self.map)
         return self._compiled
 
-    def do_rule(self, rule_id: int, x: int, numrep: int, weights) -> list[int]:
-        """Single mapping (reference: CrushWrapper::do_rule)."""
-        return crush_do_rule(self.map, rule_id, x, numrep, list(weights))
+    def do_rule(
+        self,
+        rule_id: int,
+        x: int,
+        numrep: int,
+        weights,
+        choose_args: str | None = None,
+    ) -> list[int]:
+        """Single mapping (reference: CrushWrapper::do_rule; choose_args
+        names a weight-set, the choose_args_index analog)."""
+        ca = (
+            validate_choose_args(self.map, choose_args)
+            if choose_args is not None
+            else None
+        )
+        return crush_do_rule(
+            self.map, rule_id, x, numrep, list(weights), choose_args=ca
+        )
 
-    def do_rule_batch(self, rule_id: int, xs, numrep: int, weights):
+    def do_rule_batch(
+        self,
+        rule_id: int,
+        xs,
+        numrep: int,
+        weights,
+        choose_args: str | None = None,
+    ):
         """Batched mapping on device (the north-star sibling entry point)."""
-        return crush_do_rule_batch(self.compiled(), rule_id, xs, numrep, weights)
+        return crush_do_rule_batch(
+            self.compiled(),
+            rule_id,
+            xs,
+            numrep,
+            weights,
+            choose_args=choose_args,
+        )
 
     # -- text form (CrushCompiler analog) ---------------------------------
     def format_text(self) -> str:
@@ -98,7 +296,9 @@ class CrushWrapper:
         lines.append("")
         lines.append("# devices")
         for d in range(m.max_devices):
-            lines.append(f"device {d} {self.name_of(d)}")
+            cls = self.get_device_class(d)
+            suffix = f" class {cls}" if cls else ""
+            lines.append(f"device {d} {self.name_of(d)}{suffix}")
         lines.append("")
         lines.append("# types")
         for tid in sorted(m.type_names):
@@ -106,21 +306,11 @@ class CrushWrapper:
         lines.append("")
         lines.append("# buckets")
         # topological order (children before parents) so parse_text never
-        # sees a forward reference — crushtool decompile does the same
-        emitted: list[int] = []
-        done: set[int] = set()
-
-        def emit(bid: int) -> None:
-            if bid in done:
-                return
-            done.add(bid)
-            for child in m.buckets[bid].items:
-                if child < 0:
-                    emit(child)
-            emitted.append(bid)
-
-        for bid in sorted(m.buckets):
-            emit(bid)
+        # sees a forward reference — crushtool decompile does the same.
+        # Shadow buckets are omitted: like crushtool, the text form shows
+        # only the original hierarchy and class-annotated take steps, and
+        # the compiler rebuilds the shadow trees.
+        emitted = self._topo_order(self._original_buckets())
         for bid in emitted:
             b = m.buckets[bid]
             lines.append(f"{self.type_name(b.type)} {self.name_of(bid)} {{")
@@ -132,6 +322,11 @@ class CrushWrapper:
             lines.append("}")
         lines.append("")
         lines.append("# rules")
+        shadow_to = {
+            sid: (bid, cid)
+            for bid, per in m.class_bucket.items()
+            for cid, sid in per.items()
+        }
         for rid in sorted(m.rules):
             r = m.rules[rid]
             lines.append(f"rule rule{rid} {{")
@@ -139,7 +334,14 @@ class CrushWrapper:
             lines.append(f"\ttype {'replicated' if r.type == 1 else 'erasure'}")
             for s in r.steps:
                 if s.op == RuleOp.TAKE:
-                    lines.append(f"\tstep take {self.name_of(s.arg1)}")
+                    if s.arg1 in shadow_to:
+                        bid, cid = shadow_to[s.arg1]
+                        lines.append(
+                            f"\tstep take {self.name_of(bid)} "
+                            f"class {m.class_names[cid]}"
+                        )
+                    else:
+                        lines.append(f"\tstep take {self.name_of(s.arg1)}")
                 elif s.op == RuleOp.EMIT:
                     lines.append("\tstep emit")
                 elif s.op in (RuleOp.SET_CHOOSE_TRIES, RuleOp.SET_CHOOSELEAF_TRIES):
@@ -150,6 +352,18 @@ class CrushWrapper:
                         f"{self.type_name(s.arg2)}"
                     )
             lines.append("}")
+        if m.choose_args:
+            lines.append("")
+            lines.append("# choose_args")
+            for name in sorted(m.choose_args):
+                lines.append(f"choose_args {name} {{")
+                for bid in sorted(m.choose_args[name]):
+                    rows = " ".join(
+                        "[" + " ".join(f"{w / 0x10000:.5f}" for w in ws) + "]"
+                        for ws in m.choose_args[name][bid]
+                    )
+                    lines.append(f"\tbucket {bid} weight_set {rows}")
+                lines.append("}")
         lines.append("# end crush map")
         return "\n".join(lines) + "\n"
 
@@ -161,9 +375,13 @@ class CrushWrapper:
         m.type_names = {}
         cur_bucket: Straw2Bucket | None = None
         cur_rule: Rule | None = None
+        cur_choose_args: str | None = None
         pending_items: list[tuple[str, float]] = []
         bucket_header: tuple[str, str] | None = None
         names_to_resolve: dict[str, int] = {}
+        # take-with-class steps resolve only after the shadow trees are
+        # rebuilt at the end of the parse: (RuleStep, root name, class name)
+        pending_class_takes: list[tuple[RuleStep, str, str]] = []
 
         def resolve(name: str) -> int:
             if name.startswith("osd."):
@@ -187,9 +405,12 @@ class CrushWrapper:
                 elif tok[0] == "step":
                     op = " ".join(tok[1:3]) if tok[1] in ("choose", "chooseleaf") else tok[1]
                     if op == "take":
-                        cur_rule.steps.append(
-                            RuleStep(RuleOp.TAKE, resolve(tok[2]))
-                        )
+                        step = RuleStep(RuleOp.TAKE, 0)
+                        if len(tok) >= 5 and tok[3] == "class":
+                            pending_class_takes.append((step, tok[2], tok[4]))
+                        else:
+                            step.arg1 = resolve(tok[2])
+                        cur_rule.steps.append(step)
                     elif op == "emit":
                         cur_rule.steps.append(RuleStep(RuleOp.EMIT))
                         m.rules[cur_rule.rule_id] = cur_rule
@@ -240,6 +461,23 @@ class CrushWrapper:
                     m.bucket_names[cur_bucket.id] = bname
                     names_to_resolve[bname] = cur_bucket.id
                     cur_bucket = None
+            elif cur_choose_args is not None:
+                if tok[0] == "bucket":
+                    bid = int(tok[1])
+                    rows = " ".join(tok[3:])
+                    weight_set = [
+                        [
+                            int(round(float(v) * 0x10000))
+                            for v in row.split()
+                        ]
+                        for row in rows.replace("[", " ").split("]")
+                        if row.strip()
+                    ]
+                    m.choose_args.setdefault(cur_choose_args, {})[bid] = (
+                        weight_set
+                    )
+                elif tok[0] == "}":
+                    cur_choose_args = None
             elif tok[0] == "tunable":
                 setattr(m.tunables, tok[1], int(tok[2]))
             elif tok[0] == "device":
@@ -247,6 +485,10 @@ class CrushWrapper:
                 m.max_devices = max(m.max_devices, did + 1)
                 if tok[2] != f"osd.{did}":
                     m.device_names[did] = tok[2]
+                if len(tok) >= 5 and tok[3] == "class":
+                    m.device_classes[did] = w.class_id(tok[4], create=True)
+            elif tok[0] == "choose_args":
+                cur_choose_args = tok[1]
             elif tok[0] == "type":
                 m.type_names[int(tok[1])] = tok[2]
             elif tok[0] == "rule":
@@ -257,4 +499,10 @@ class CrushWrapper:
                 cur_bucket = Straw2Bucket(id=0, type=0)
         if 0 not in m.type_names:
             m.type_names[0] = "osd"
+        if m.class_names:
+            w.populate_classes()
+        for step, root_name, cls_name in pending_class_takes:
+            step.arg1 = w.shadow_root(
+                names_to_resolve[root_name], cls_name
+            )
         return w

@@ -64,6 +64,18 @@ struct Map {
   int max_size;
   const uint32_t* weightvec;  // [n_devices] device reweights 16.16
   int n_devices;
+  // choose_args weight-set (crush_choose_arg_map analog):
+  // [positions * n_buckets * max_size] or null; position clamps to the
+  // last row (get_choose_arg_weights)
+  const int64_t* cweights;
+  int positions;
+
+  const int64_t* bucket_weights(int bucket_idx, int position) const {
+    if (!cweights) return weights + (size_t)bucket_idx * max_size;
+    int p = position < positions ? position : positions - 1;
+    return cweights +
+           ((size_t)p * n_buckets + bucket_idx) * max_size;
+  }
 
   int item_type(int item) const {
     if (item >= 0) return 0;
@@ -75,12 +87,13 @@ struct Map {
 
 int64_t div_trunc(int64_t a, int64_t b) { return a / b; }  // C is truncating
 
-int straw2_choose(const Map& m, int bucket_idx, uint32_t x, uint32_t r) {
+int straw2_choose(const Map& m, int bucket_idx, uint32_t x, uint32_t r,
+                  int position) {
   if (bucket_idx < 0 || bucket_idx >= m.n_buckets) return ITEM_NONE_V;
   const int size = m.sizes[bucket_idx];
   if (size == 0) return ITEM_NONE_V;
   const int32_t* items = m.items + (size_t)bucket_idx * m.max_size;
-  const int64_t* weights = m.weights + (size_t)bucket_idx * m.max_size;
+  const int64_t* weights = m.bucket_weights(bucket_idx, position);
   int high = 0;
   int64_t high_draw = 0;
   for (int i = 0; i < size; ++i) {
@@ -108,10 +121,11 @@ bool is_out(const Map& m, int item, uint32_t x) {
   return (hash2(x, (uint32_t)item) & 0xffff) >= w;
 }
 
-int descend(const Map& m, int root, uint32_t x, uint32_t r, int want_type) {
+int descend(const Map& m, int root, uint32_t x, uint32_t r, int want_type,
+            int position) {
   int item = root;
   while (item < 0 && item != ITEM_NONE_V && m.item_type(item) != want_type)
-    item = straw2_choose(m, -1 - item, x, r);
+    item = straw2_choose(m, -1 - item, x, r, position);
   // a device of the wrong type is a dead end (mapper.c "bad item type")
   if (want_type != 0 && item >= 0) return ITEM_NONE_V;
   return item;
@@ -127,7 +141,7 @@ int choose_firstn(const Map& m, int root, uint32_t x, int numrep,
     int item = ITEM_NONE_V, leaf = ITEM_NONE_V;
     for (int ftotal = 0; ftotal < tries && !done; ++ftotal) {
       const uint32_t r = (uint32_t)(rep + ftotal);
-      const int cand = descend(m, root, x, r, want_type);
+      const int cand = descend(m, root, x, r, want_type, outpos);
       if (cand == ITEM_NONE_V) continue;
       bool collide = false;
       for (int i = 0; i < outpos; ++i)
@@ -138,7 +152,7 @@ int choose_firstn(const Map& m, int root, uint32_t x, int numrep,
         bool lok = false;
         int lf_leaf = ITEM_NONE_V;
         for (int lf = 0; lf < recurse_tries && !lok; ++lf) {
-          const int l = descend(m, cand, x, r + (uint32_t)lf, 0);
+          const int l = descend(m, cand, x, r + (uint32_t)lf, 0, outpos);
           if (l < 0) continue;
           bool lcol = false;
           for (int i = 0; i < outpos; ++i)
@@ -177,7 +191,9 @@ void choose_indep(const Map& m, int root, uint32_t x, int numrep,
     for (int rep = 0; rep < numrep; ++rep) {
       if (placed[rep]) continue;
       const uint32_t r = (uint32_t)(rep + numrep * ftotal);
-      const int cand = descend(m, root, x, r, want_type);
+      // weight-set position: the choose's outpos (0 at top level);
+      // only the leaf recursion, whose outpos is rep, varies by shard
+      const int cand = descend(m, root, x, r, want_type, /*position=*/0);
       if (cand == ITEM_NONE_V) {
         // structural dead end: permanent NONE (crush_choose_indep keeps the
         // position at CRUSH_ITEM_NONE and never retries it)
@@ -192,8 +208,8 @@ void choose_indep(const Map& m, int root, uint32_t x, int numrep,
       if (recurse && cand < 0) {
         bool lok = false;
         for (int lf = 0; lf < recurse_tries && !lok; ++lf) {
-          const int l =
-              descend(m, cand, x, (uint32_t)(rep + numrep * lf) + r, 0);
+          const int l = descend(
+              m, cand, x, (uint32_t)(rep + numrep * lf) + r, 0, rep);
           if (l < 0) continue;
           if (is_out(m, l, x)) continue;
           lok = true;
@@ -225,10 +241,11 @@ int cro_do_rule_batch(const int32_t* items, const int64_t* weights,
                       int want_type, int firstn, int recurse, int tries,
                       int recurse_tries, const uint32_t* xs, long n_x,
                       const uint32_t* weightvec, int n_devices,
-                      int32_t* out) {
+                      const int64_t* cweights, int positions, int32_t* out) {
   if (want <= 0 || want > 64) return -1;
+  if (cweights && positions <= 0) return -1;
   Map m{items, weights, sizes, types, n_buckets, max_size, weightvec,
-        n_devices};
+        n_devices, cweights, positions};
   int32_t buf[64], buf2[64];
   for (long i = 0; i < n_x; ++i) {
     const uint32_t x = xs[i];
