@@ -109,6 +109,20 @@ def crush_hash32_4(a, b, c, d):
     return hash_
 
 
+def crush_hash32_2_np(a, b) -> np.ndarray:
+    """Numpy twin of crush_hash32_2 (pg→pps seeding, primary affinity)."""
+    with np.errstate(over="ignore"):
+        a = np.asarray(a, dtype=np.uint32)
+        b = np.asarray(b, dtype=np.uint32)
+        hash_ = np.uint32(CRUSH_HASH_SEED) ^ a ^ b
+        x = np.uint32(231232)
+        y = np.uint32(1232)
+        a, b, hash_ = _mix(a, b, hash_)
+        x, a, hash_ = _mix(x, a, hash_)
+        b, y, hash_ = _mix(b, y, hash_)
+        return hash_
+
+
 def crush_hash32_3_np(a, b, c) -> np.ndarray:
     """Numpy twin of crush_hash32_3 (host-side golden generator)."""
     with np.errstate(over="ignore"):

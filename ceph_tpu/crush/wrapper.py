@@ -90,10 +90,18 @@ class CrushWrapper:
         cid = self.map.device_classes.get(osd)
         return None if cid is None else self.map.class_names[cid]
 
-    def _original_buckets(self) -> list[int]:
-        shadows = {
-            sid for per in self.map.class_bucket.values() for sid in per.values()
+    def _shadow_index(self) -> dict[int, tuple[int, int]]:
+        """shadow bucket id -> (original bucket id, class id) — the single
+        inversion of class_bucket shared by the shadow-tree builder, the
+        original-bucket filter, and the text form."""
+        return {
+            sid: (bid, cid)
+            for bid, per in self.map.class_bucket.items()
+            for cid, sid in per.items()
         }
+
+    def _original_buckets(self) -> list[int]:
+        shadows = self._shadow_index()
         return [b for b in self.map.buckets if b not in shadows]
 
     def _topo_order(self, bucket_ids) -> list[int]:
@@ -123,10 +131,7 @@ class CrushWrapper:
         Existing rules that TAKE a shadow bucket are re-pointed at the
         rebuilt shadow for the same (original bucket, class)."""
         m = self.map
-        old_shadow: dict[int, tuple[int, int]] = {}
-        for bid, per in m.class_bucket.items():
-            for cid, sid in per.items():
-                old_shadow[sid] = (bid, cid)
+        old_shadow = self._shadow_index()
         for sid in old_shadow:
             m.buckets.pop(sid, None)
             m.bucket_names.pop(sid, None)
@@ -293,6 +298,18 @@ class CrushWrapper:
             "chooseleaf_stable",
         ):
             lines.append(f"tunable {k} {getattr(t, k)}")
+        if m.class_names:
+            # Divergence from crushtool's grammar, on purpose: class ids are
+            # explicit (and precede the devices that name them) so
+            # decompile→compile preserves them.  Shadow-tree bucket ids
+            # derive from class-id order, and those ids feed the straw2
+            # descent hash — inferring class ids from device-line order
+            # would silently remap every class-rule pool whose classes were
+            # created in non-device-id order.
+            lines.append("")
+            lines.append("# classes")
+            for cid in sorted(m.class_names):
+                lines.append(f"class {cid} {m.class_names[cid]}")
         lines.append("")
         lines.append("# devices")
         for d in range(m.max_devices):
@@ -322,11 +339,7 @@ class CrushWrapper:
             lines.append("}")
         lines.append("")
         lines.append("# rules")
-        shadow_to = {
-            sid: (bid, cid)
-            for bid, per in m.class_bucket.items()
-            for cid, sid in per.items()
-        }
+        shadow_to = self._shadow_index()
         for rid in sorted(m.rules):
             r = m.rules[rid]
             lines.append(f"rule rule{rid} {{")
@@ -491,6 +504,8 @@ class CrushWrapper:
                 cur_choose_args = tok[1]
             elif tok[0] == "type":
                 m.type_names[int(tok[1])] = tok[2]
+            elif tok[0] == "class":
+                m.class_names[int(tok[1])] = tok[2]
             elif tok[0] == "rule":
                 cur_rule = Rule(rule_id=-1)
             elif tok[-1] == "{":

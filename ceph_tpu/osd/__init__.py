@@ -1,0 +1,29 @@
+"""OSDMap-layer placement: pool→PG→OSD mapping and the upmap balancer.
+
+TPU-native rebuild of the placement half of the reference's src/osd layer
+(SURVEY.md §2.3 OSDMap row, §2.5 balancer row).  The daemon half (OSD boot,
+peering, PrimaryLogPG) is process machinery the north star leaves untouched;
+what lives here is the pure placement math every client and the mgr balancer
+run: OSDMap::pg_to_up_acting_osds and OSDMap::calc_pg_upmaps, with the
+CRUSH descent batched on TPU (crush_do_rule_batch).
+"""
+from .osdmap import (
+    PG_POOL_ERASURE,
+    PG_POOL_REPLICATED,
+    OSDMap,
+    PGPool,
+    ceph_stable_mod,
+    pg_num_mask,
+)
+from .balancer import calc_pg_upmaps, pool_pg_counts
+
+__all__ = [
+    "OSDMap",
+    "PGPool",
+    "PG_POOL_ERASURE",
+    "PG_POOL_REPLICATED",
+    "calc_pg_upmaps",
+    "ceph_stable_mod",
+    "pg_num_mask",
+    "pool_pg_counts",
+]

@@ -1,0 +1,143 @@
+"""Upmap balancer — the calc_pg_upmaps optimization loop on batched CRUSH.
+
+Reference: src/osd/OSDMap.cc :: OSDMap::calc_pg_upmaps, driven by the mgr
+balancer module (src/pybind/mgr/balancer/module.py, upmap mode): clone the
+map, find over/underfull OSDs vs their weight-proportional PG share, and
+emit pg_upmap_items entries moving PG shards from the fullest OSD to the
+emptiest one that keeps the placement valid (same eligible device set,
+distinct failure domains).  This is SURVEY.md §3.3's flagship batch-CRUSH
+consumer: the full pool map runs as ONE crush_do_rule_batch launch on TPU,
+and the greedy loop then only does sparse host-side bookkeeping — upmap
+overrides never change the raw CRUSH output, so counts update incrementally
+without re-descending.
+
+The reference's loop additionally retries candidate deviations in a few
+stochastic orders; this implementation is deterministic greedy (largest
+deviation first), which the tests exploit for stable golden behavior.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..crush.types import RuleOp
+from .osdmap import OSDMap
+
+
+def _rule_take_and_type(osdmap: OSDMap, rule_id: int) -> tuple[int, int]:
+    """Extract (take root, failure-domain type) from a simple rule chain."""
+    root, ftype = None, 0
+    for st in osdmap.crush.map.rules[rule_id].steps:
+        if st.op == RuleOp.TAKE:
+            root = st.arg1
+        elif st.op in (
+            RuleOp.CHOOSE_FIRSTN,
+            RuleOp.CHOOSE_INDEP,
+            RuleOp.CHOOSELEAF_FIRSTN,
+            RuleOp.CHOOSELEAF_INDEP,
+        ):
+            ftype = st.arg2
+    if root is None:
+        raise ValueError(f"rule {rule_id} has no TAKE step")
+    return root, ftype
+
+
+def rule_osd_info(
+    osdmap: OSDMap, rule_id: int
+) -> tuple[np.ndarray, dict[int, int]]:
+    """Per-OSD CRUSH weight and failure-domain id for one rule's subtree.
+
+    reference: OSDMap::get_rule_weight_osd_map (weights) plus the subtree
+    walk calc_pg_upmaps does to group candidates by failure domain."""
+    root, ftype = _rule_take_and_type(osdmap, rule_id)
+    weights = np.zeros(osdmap.max_osd, dtype=np.float64)
+    domain: dict[int, int] = {}
+
+    def walk(bid: int, dom: int | None) -> None:
+        b = osdmap.crush.map.buckets[bid]
+        here = bid if b.type == ftype else dom
+        for it, w in zip(b.items, b.weights):
+            if it >= 0:
+                weights[it] += w / 0x10000
+                domain[it] = it if ftype == 0 else (here if here is not None else it)
+            else:
+                walk(it, here)
+
+    walk(root, None)
+    # an out (reweight 0) OSD takes no PGs — exclude from the target share
+    for o in range(osdmap.max_osd):
+        if osdmap.osd_weight[o] == 0 or not osdmap.is_up(o):
+            weights[o] = 0.0
+    return weights, domain
+
+
+def pool_pg_counts(osdmap: OSDMap, pools=None) -> np.ndarray:
+    """PG-shard count per OSD over the given pools (batched CRUSH path)."""
+    counts = np.zeros(osdmap.max_osd, dtype=np.int64)
+    for pid in pools if pools is not None else sorted(osdmap.pools):
+        up, _ = osdmap.map_pool(pid)
+        ids, c = np.unique(up[up >= 0], return_counts=True)
+        counts[ids] += c
+    return counts
+
+
+def calc_pg_upmaps(
+    osdmap: OSDMap,
+    max_deviation: float = 1.0,
+    max_iterations: int = 100,
+    pools=None,
+) -> list[tuple[int, int, int, int]]:
+    """Greedy upmap balance; mutates osdmap.pg_upmap_items.
+
+    Returns the applied changes as (pool, ps, from_osd, to_osd) tuples —
+    the analog of the incremental OSDMap::calc_pg_upmaps fills for the mgr
+    balancer to commit.  max_deviation is in PG shards, as in the reference
+    (osd_calc_pg_upmaps_max_deviation, default 1 → perfectly tight)."""
+    changes: list[tuple[int, int, int, int]] = []
+    for pid in pools if pools is not None else sorted(osdmap.pools):
+        pool = osdmap.pools[pid]
+        weights, domain = rule_osd_info(osdmap, pool.crush_rule)
+        total_w = weights.sum()
+        if total_w <= 0:
+            continue
+        up, _ = osdmap.map_pool(pid)
+        rows = [list(r) for r in up]
+        counts = np.zeros(osdmap.max_osd, dtype=np.float64)
+        ids, c = np.unique(up[up >= 0], return_counts=True)
+        counts[ids] += c
+        shards = sum(1 for r in rows for o in r if o >= 0)
+        target = weights / total_w * shards
+        eligible = weights > 0
+
+        for _ in range(max_iterations):
+            dev = np.where(eligible, counts - target, -np.inf)
+            o_hi = int(np.argmax(dev))
+            if dev[o_hi] <= max_deviation:
+                break
+            # underfull candidates, emptiest first
+            under = np.where(eligible, counts - target, np.inf)
+            candidates = [int(o) for o in np.argsort(under) if under[o] < 0]
+            moved = False
+            for ps, row in enumerate(rows):
+                if o_hi not in row or moved:
+                    continue
+                others = {domain.get(o) for o in row if o >= 0 and o != o_hi}
+                for o_lo in candidates:
+                    if o_lo in row or domain.get(o_lo) in others:
+                        continue
+                    if under[o_lo] >= dev[o_hi] - 1:
+                        break  # no move can improve the spread
+                    key = (pid, ps)
+                    osdmap.pg_upmap_items.setdefault(key, []).append(
+                        (o_hi, o_lo)
+                    )
+                    row[row.index(o_hi)] = o_lo
+                    counts[o_hi] -= 1
+                    counts[o_lo] += 1
+                    changes.append((pid, ps, o_hi, o_lo))
+                    moved = True
+                    break
+            if not moved:
+                break
+        if changes:
+            osdmap.epoch += 1
+    return changes

@@ -1,0 +1,212 @@
+"""OSDMap + balancer tests.
+
+Models the reference's OSDMap unit tests (reference: src/test/osd/TestOSDMap.cc
+— pg_to_up_acting with upmap overrides, primary affinity, and
+calc_pg_upmaps behavior; SURVEY.md §4 ring 1): the scalar mapping path is
+ground truth, the batched TPU path must agree on every PG, and the balancer
+must tighten the PG distribution while respecting failure domains.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import CrushWrapper, ITEM_NONE, build_hierarchical_map
+from ceph_tpu.osd import (
+    OSDMap,
+    PG_POOL_ERASURE,
+    calc_pg_upmaps,
+    ceph_stable_mod,
+    pg_num_mask,
+    pool_pg_counts,
+)
+from ceph_tpu.osd.balancer import rule_osd_info
+
+
+def make_map(n_hosts=8, osds_per_host=4) -> OSDMap:
+    m = OSDMap(CrushWrapper(build_hierarchical_map(n_hosts, osds_per_host)))
+    m.create_pool(1, pg_num=64, size=3, crush_rule=0, name="rbd")
+    m.create_pool(2, pg_num=32, size=6, crush_rule=1, type=PG_POOL_ERASURE)
+    return m
+
+
+class TestStableMod:
+    def test_matches_definition(self):
+        # reference: src/include/rados.h ceph_stable_mod — result < b always,
+        # and pg splitting (b -> 2b) only moves each x into {x, x+b}
+        for b in (1, 3, 8, 12, 100):
+            mask = pg_num_mask(b)
+            for x in range(4 * b):
+                r = ceph_stable_mod(x, b, mask)
+                assert 0 <= r < b
+
+    def test_split_stability(self):
+        # doubling a power-of-two pg_num splits each PG into {p, p + b}
+        for b in (4, 8, 16):
+            for x in range(1000):
+                r1 = ceph_stable_mod(x, b, pg_num_mask(b))
+                r2 = ceph_stable_mod(x, 2 * b, pg_num_mask(2 * b))
+                assert r2 in (r1, r1 + b)
+
+
+class TestPgMapping:
+    def test_scalar_basics(self):
+        m = make_map()
+        for ps in range(m.pools[1].pg_num):
+            up, upp, acting, actp = m.pg_to_up_acting_osds(1, ps)
+            assert len(up) == 3 and len(set(up)) == 3
+            assert upp == up[0] and acting == up and actp == upp
+            # failure domains distinct (chooseleaf over hosts, 4 osds/host)
+            assert len({o // 4 for o in up}) == 3
+
+    def test_ec_positional_holes(self):
+        m = make_map()
+        up, upp, _, _ = m.pg_to_up_acting_osds(2, 0)
+        assert len(up) == 6
+        victim = up[2]
+        m.mark_down(victim)
+        up2, _, _, _ = m.pg_to_up_acting_osds(2, 0)
+        assert up2[2] == ITEM_NONE  # EC keeps shard positions
+        assert [o for i, o in enumerate(up2) if i != 2] == [
+            o for i, o in enumerate(up) if i != 2
+        ]
+
+    def test_replicated_compacts_down_osds(self):
+        m = make_map()
+        up, _, _, _ = m.pg_to_up_acting_osds(1, 5)
+        m.mark_down(up[0])
+        up2, upp2, _, _ = m.pg_to_up_acting_osds(1, 5)
+        assert up[0] not in up2 and len(up2) == 2 and upp2 == up2[0]
+
+    def test_out_osd_remapped(self):
+        # out (weight 0) ⇒ CRUSH rejects it and picks a replacement,
+        # keeping the set at full size — the elastic-recovery primitive
+        # (SURVEY.md §5.3: "elasticity is literally CRUSH output changed")
+        m = make_map()
+        up, _, _, _ = m.pg_to_up_acting_osds(1, 7)
+        m.mark_out(up[1])
+        up2, _, _, _ = m.pg_to_up_acting_osds(1, 7)
+        assert up[1] not in up2 and len(up2) == 3
+
+    def test_pg_upmap_full_override(self):
+        m = make_map()
+        m.pg_upmap[(1, 3)] = [0, 4, 8]
+        up, _, _, _ = m.pg_to_up_acting_osds(1, 3)
+        assert up == [0, 4, 8]
+
+    def test_pg_upmap_items(self):
+        m = make_map()
+        up, _, _, _ = m.pg_to_up_acting_osds(1, 9)
+        frm = up[1]
+        to = next(o for o in range(m.max_osd) if o // 4 not in {x // 4 for x in up})
+        m.pg_upmap_items[(1, 9)] = [(frm, to)]
+        up2, _, _, _ = m.pg_to_up_acting_osds(1, 9)
+        assert to in up2 and frm not in up2
+
+    def test_upmap_to_out_osd_ignored(self):
+        m = make_map()
+        up, _, _, _ = m.pg_to_up_acting_osds(1, 9)
+        to = next(o for o in range(m.max_osd) if o not in up)
+        m.mark_out(to)
+        m.pg_upmap_items[(1, 9)] = [(up[0], to)]
+        up2, _, _, _ = m.pg_to_up_acting_osds(1, 9)
+        assert to not in up2
+
+    def test_pg_temp(self):
+        m = make_map()
+        m.pg_temp[(1, 0)] = [1, 2, 3]
+        m.primary_temp[(1, 0)] = 2
+        _, _, acting, actp = m.pg_to_up_acting_osds(1, 0)
+        assert acting == [1, 2, 3] and actp == 2
+
+    def test_primary_affinity_zero_skips(self):
+        m = make_map()
+        up, upp, _, _ = m.pg_to_up_acting_osds(1, 11)
+        m.set_primary_affinity(upp, 0.0)
+        _, upp2, _, _ = m.pg_to_up_acting_osds(1, 11)
+        assert upp2 != upp and upp2 in up
+
+    def test_primary_affinity_all_zero_falls_back(self):
+        m = make_map()
+        up, _, _, _ = m.pg_to_up_acting_osds(1, 11)
+        for o in up:
+            m.set_primary_affinity(o, 0.0)
+        _, upp2, _, _ = m.pg_to_up_acting_osds(1, 11)
+        assert upp2 == up[0]  # everyone declined → first up OSD
+
+
+class TestBatchParity:
+    """The batched TPU path must agree with the scalar path exactly."""
+
+    def assert_parity(self, m: OSDMap, pool_id: int):
+        up_b, prim_b = m.map_pool(pool_id)
+        pool = m.pools[pool_id]
+        for ps in range(pool.pg_num):
+            up, upp, _, _ = m.pg_to_up_acting_osds(pool_id, ps)
+            padded = up + [ITEM_NONE] * (pool.size - len(up))
+            assert list(up_b[ps]) == padded, f"ps={ps}"
+            assert prim_b[ps] == upp, f"ps={ps}"
+
+    def test_replicated(self):
+        m = make_map()
+        self.assert_parity(m, 1)
+
+    def test_erasure(self):
+        m = make_map()
+        self.assert_parity(m, 2)
+
+    def test_with_failures_and_overrides(self):
+        m = make_map()
+        m.mark_down(3)
+        m.mark_out(17)
+        m.set_primary_affinity(5, 0.25)
+        m.set_primary_affinity(9, 0.0)
+        m.pg_upmap[(1, 3)] = [0, 4, 8]
+        up, _, _, _ = m.pg_to_up_acting_osds(1, 20)
+        frm = up[1]
+        to = next(
+            o for o in range(m.max_osd) if o // 4 not in {x // 4 for x in up}
+        )
+        m.pg_upmap_items[(1, 20)] = [(frm, to)]
+        self.assert_parity(m, 1)
+        self.assert_parity(m, 2)
+
+    def test_roundtrip_json(self):
+        m = make_map()
+        m.pg_upmap_items[(1, 20)] = [(0, 4)]
+        m.mark_down(3)
+        m2 = OSDMap.from_json(m.to_json())
+        for ps in range(8):
+            assert m.pg_to_up_acting_osds(1, ps) == m2.pg_to_up_acting_osds(1, ps)
+
+
+class TestBalancer:
+    def test_rule_osd_info(self):
+        m = make_map()
+        w, dom = rule_osd_info(m, 0)
+        assert (w[: m.max_osd] == 1.0).all()
+        assert dom[0] == dom[3] and dom[0] != dom[4]  # host grouping
+
+    def test_balance_tightens_distribution(self):
+        m = make_map()
+        before = pool_pg_counts(m, [1])
+        changes = calc_pg_upmaps(m, max_deviation=1.0, pools=[1])
+        after = pool_pg_counts(m, [1])
+        assert changes, "expected the balancer to find moves"
+        assert after.sum() == before.sum()  # no shards lost
+        assert (after.max() - after.min()) < (before.max() - before.min())
+        # every override it wrote is actually in effect (valid moves only)
+        for pid, ps, frm, to in changes:
+            up, _, _, _ = m.pg_to_up_acting_osds(pid, ps)
+            assert to in up
+
+    def test_balance_respects_failure_domains(self):
+        m = make_map()
+        calc_pg_upmaps(m, max_deviation=1.0, pools=[1])
+        for ps in range(m.pools[1].pg_num):
+            up, _, _, _ = m.pg_to_up_acting_osds(1, ps)
+            assert len({o // 4 for o in up}) == len(up)
+
+    def test_balance_converges(self):
+        m = make_map()
+        calc_pg_upmaps(m, max_deviation=1.0, pools=[1])
+        again = calc_pg_upmaps(m, max_deviation=1.0, pools=[1])
+        assert not again  # already tight → no further moves
