@@ -212,6 +212,29 @@ class CrushWrapper:
         self.invalidate()
         return rule
 
+    def get_rule_weight_osd_map(self, rule_id: int) -> dict[int, float]:
+        """reference: CrushWrapper::get_rule_weight_osd_map — the crush
+        weight of every device reachable from the rule's TAKE roots (so a
+        device-class rule only counts its shadow subtree).  Consumers:
+        utilization expectations (CrushTester) and pool balance targets."""
+        out: dict[int, float] = {}
+
+        def walk(bid: int) -> None:
+            b = self.map.buckets[bid]
+            for it, w in zip(b.items, b.weights):
+                if it >= 0:
+                    out[it] = out.get(it, 0.0) + w / 0x10000
+                else:
+                    walk(it)
+
+        for step in self.map.rules[rule_id].steps:
+            if step.op == RuleOp.TAKE:
+                if step.arg1 >= 0:
+                    out[step.arg1] = out.get(step.arg1, 0.0) + 1.0
+                else:
+                    walk(step.arg1)
+        return out
+
     # -- choose_args (weight-sets) ----------------------------------------
     def set_choose_args(
         self, name: str, bucket_id: int, weight_set: list[list[int]]

@@ -138,6 +138,6 @@ def calc_pg_upmaps(
                     break
             if not moved:
                 break
-        if changes:
-            osdmap.epoch += 1
+    if changes:  # one logical map revision per calc, as OSDMonitor commits
+        osdmap.epoch += 1
     return changes

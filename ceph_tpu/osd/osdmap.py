@@ -53,9 +53,8 @@ def pg_num_mask(pg_num: int) -> int:
 
 
 def ceph_stable_mod(x: int, b: int, bmask: int) -> int:
-    """reference: src/include/ceph_hash.h? no — ceph_stable_mod lives in
-    src/include/rados.h: stable modulo so growing pg_num splits PGs instead
-    of reshuffling them."""
+    """reference: src/include/rados.h :: ceph_stable_mod — stable modulo so
+    growing pg_num splits PGs instead of reshuffling them."""
     return x & bmask if (x & bmask) < b else x & (bmask >> 1)
 
 
@@ -183,10 +182,17 @@ class OSDMap:
         return self.exists(osd) and self.osd_weight[osd] != 0
 
     def _apply_upmap(self, pool: PGPool, ps: int, raw: list[int]) -> list[int]:
-        """reference: OSDMap::_apply_upmap."""
+        """reference: OSDMap::_apply_upmap.  A pg_upmap vector whose length
+        differs from the pool size is ignored (OSDMonitor rejects such
+        entries at set time; tolerating them on load keeps the scalar and
+        batch paths — whose output width is pool.size — in agreement)."""
         key = (pool.pool_id, ps)
         forced = self.pg_upmap.get(key)
-        if forced and all(self._upmap_valid_target(o) for o in forced):
+        if (
+            forced
+            and len(forced) == pool.size
+            and all(self._upmap_valid_target(o) for o in forced)
+        ):
             return list(forced)
         items = self.pg_upmap_items.get(key)
         if items:
@@ -200,9 +206,12 @@ class OSDMap:
         """reference: OSDMap::_raw_to_up_osds — drop down/non-existent OSDs:
         compact for replicated pools, positional NONE holes for EC (shard
         identity is positional, SURVEY.md §3.2)."""
+        def ok(o: int) -> bool:
+            return o >= 0 and self.exists(o) and self.is_up(o)
+
         if pool.type == PG_POOL_ERASURE:
-            return [o if o >= 0 and self.is_up(o) else ITEM_NONE for o in raw]
-        return [o for o in raw if o >= 0 and self.is_up(o)]
+            return [o if ok(o) else ITEM_NONE for o in raw]
+        return [o for o in raw if ok(o)]
 
     def _apply_primary_affinity(self, pps: int, up: list[int]) -> int:
         """reference: OSDMap::_apply_primary_affinity — each up OSD in order
@@ -268,11 +277,13 @@ class OSDMap:
 
         # sparse per-PG upmap overrides (dict-sized, not pg_num-sized work)
         for (pid, s), forced in self.pg_upmap.items():
-            if pid == pool_id and s < pool.pg_num and all(
-                self._upmap_valid_target(o) for o in forced
+            if (
+                pid == pool_id
+                and s < pool.pg_num
+                and len(forced) == pool.size
+                and all(self._upmap_valid_target(o) for o in forced)
             ):
-                raw[s, : len(forced)] = forced
-                raw[s, len(forced) :] = ITEM_NONE
+                raw[s] = forced
         for (pid, s), items in self.pg_upmap_items.items():
             if pid != pool_id or s >= pool.pg_num:
                 continue
@@ -344,6 +355,14 @@ class OSDMap:
                 {"pool": k[0], "ps": k[1], "mappings": [list(m) for m in v]}
                 for k, v in self.pg_upmap_items.items()
             ],
+            "pg_temp": [
+                {"pool": k[0], "ps": k[1], "osds": v}
+                for k, v in self.pg_temp.items()
+            ],
+            "primary_temp": [
+                {"pool": k[0], "ps": k[1], "osd": v}
+                for k, v in self.primary_temp.items()
+            ],
         }
 
     @classmethod
@@ -361,4 +380,8 @@ class OSDMap:
             m.pg_upmap_items[(e["pool"], e["ps"])] = [
                 tuple(x) for x in e["mappings"]
             ]
+        for e in d.get("pg_temp", []):
+            m.pg_temp[(e["pool"], e["ps"])] = list(e["osds"])
+        for e in d.get("primary_temp", []):
+            m.primary_temp[(e["pool"], e["ps"])] = e["osd"]
         return m

@@ -1,0 +1,7 @@
+"""Operator CLI tools — the src/tools analogs (SURVEY.md §2.8).
+
+Each tool is an argparse `main(argv) -> int` so tests drive it in-process
+(the analog of the reference's cram-style CLI transcript tests,
+src/test/cli/*/*.t) and `python -m ceph_tpu.tools.<tool>` drives it from a
+shell.
+"""

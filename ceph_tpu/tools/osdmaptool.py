@@ -1,0 +1,163 @@
+"""osdmaptool analog — offline OSDMap inspection, PG mapping, upmap calc.
+
+Reference: src/tools/osdmaptool.cc — `--createsimple`, `--test-map-pgs`
+(batch-maps every PG of every pool and prints the per-OSD distribution) and
+`--upmap` (runs OSDMap::calc_pg_upmaps and writes the `ceph osd
+pg-upmap-items` commands an operator would apply).  Both batch modes run on
+the TPU path (OSDMap.map_pool → crush_do_rule_batch), making this tool the
+CLI face of BASELINE config 5's pool-wide remap measurement.
+
+Map files are JSON (OSDMap.to_json) — the analog of the reference's binary
+osdmap blobs.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+from ..crush import CrushWrapper, build_hierarchical_map
+from ..osd import OSDMap, calc_pg_upmaps
+from ..osd.osdmap import PG_POOL_ERASURE
+
+
+def _load(path: str) -> OSDMap:
+    with open(path) as f:
+        return OSDMap.from_json(json.load(f))
+
+
+def _save(m: OSDMap, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(m.to_json(), f, indent=1)
+
+
+def create_simple(num_osd: int, pg_num: int = 128) -> OSDMap:
+    """--createsimple analog: one host per OSD (flat failure domains), a
+    size-3 replicated pool and a 4+2 EC pool."""
+    m = OSDMap(CrushWrapper(build_hierarchical_map(num_osd, 1)))
+    m.create_pool(1, pg_num=pg_num, size=3, crush_rule=0, name="rbd")
+    m.create_pool(
+        2, pg_num=pg_num // 2, size=6, crush_rule=1,
+        type=PG_POOL_ERASURE, name="ecpool",
+    )
+    return m
+
+
+def test_map_pgs(m: OSDMap, pool_ids, out=sys.stdout) -> None:
+    """--test-map-pgs analog; per-pool then per-OSD count table plus the
+    min/max/avg summary the reference prints."""
+    counts = np.zeros(m.max_osd, dtype=np.int64)
+    primaries = np.zeros(m.max_osd, dtype=np.int64)
+    for pid in pool_ids:
+        pool = m.pools[pid]
+        up, prim = m.map_pool(pid)
+        print(f"pool {pid} pg_num {pool.pg_num}", file=out)
+        ids, c = np.unique(up[up >= 0], return_counts=True)
+        counts[ids] += c
+        ids, c = np.unique(prim[prim >= 0], return_counts=True)
+        primaries[ids] += c
+    print("#osd\tcount\tprimary", file=out)
+    for o in range(m.max_osd):
+        print(f"osd.{o}\t{counts[o]}\t{primaries[o]}", file=out)
+    up_osds = [o for o in range(m.max_osd) if m.is_up(o)]
+    act = counts[up_osds]
+    avg = act.mean() if len(act) else 0.0
+    print(f" in {len(up_osds)}", file=out)
+    print(
+        f" avg {avg:.2f} stddev {act.std():.2f} "
+        f"min osd.{up_osds[int(act.argmin())]} {act.min()} "
+        f"max osd.{up_osds[int(act.argmax())]} {act.max()}",
+        file=out,
+    )
+    size_sum = sum(m.pools[p].pg_num * m.pools[p].size for p in pool_ids)
+    print(f" size {size_sum}", file=out)
+
+
+def do_upmap(
+    m: OSDMap, pool_ids, max_dev: float, max_iter: int, out=sys.stdout
+) -> int:
+    """--upmap analog: emit `ceph osd pg-upmap-items` commands."""
+    changes = calc_pg_upmaps(
+        m, max_deviation=max_dev, max_iterations=max_iter, pools=pool_ids
+    )
+    by_pg: dict[tuple[int, int], list[int]] = {}
+    for pid, ps, frm, to in changes:
+        by_pg.setdefault((pid, ps), []).extend((frm, to))
+    for (pid, ps), pairs in sorted(by_pg.items()):
+        # pg ids print as <pool>.<ps hex>, as the reference does
+        print(
+            f"ceph osd pg-upmap-items {pid}.{ps:x} "
+            + " ".join(str(p) for p in pairs),
+            file=out,
+        )
+    return len(changes)
+
+
+def main(argv=None, out=sys.stdout) -> int:
+    ap = argparse.ArgumentParser(
+        prog="osdmaptool", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("mapfn", help="OSDMap JSON file")
+    ap.add_argument(
+        "--createsimple", type=int, metavar="NUM_OSD",
+        help="create a simple map with NUM_OSD osds and write it to mapfn",
+    )
+    ap.add_argument("--pg-num", type=int, default=128)
+    ap.add_argument("--test-map-pgs", action="store_true")
+    ap.add_argument("--pool", type=int, action="append", default=None)
+    ap.add_argument(
+        "--upmap", metavar="OUTFILE",
+        help="calc upmap moves, write pg-upmap-items commands to OUTFILE "
+        "('-' for stdout), and save the balanced map back to mapfn",
+    )
+    ap.add_argument("--upmap-deviation", type=float, default=1.0)
+    ap.add_argument("--upmap-max", type=int, default=100)
+    ap.add_argument("--dump", action="store_true", help="print map summary")
+    args = ap.parse_args(argv)
+
+    if args.createsimple:
+        m = create_simple(args.createsimple, args.pg_num)
+        _save(m, args.mapfn)
+        print(
+            f"osdmaptool: writing epoch {m.epoch} to {args.mapfn}", file=out
+        )
+        return 0
+
+    m = _load(args.mapfn)
+    pools = args.pool if args.pool else sorted(m.pools)
+    if args.dump:
+        print(f"epoch {m.epoch}", file=out)
+        print(f"max_osd {m.max_osd}", file=out)
+        for pid in sorted(m.pools):
+            p = m.pools[pid]
+            kind = "erasure" if p.type == PG_POOL_ERASURE else "replicated"
+            print(
+                f"pool {pid} '{p.name}' {kind} size {p.size} pg_num "
+                f"{p.pg_num} crush_rule {p.crush_rule}",
+                file=out,
+            )
+    if args.test_map_pgs:
+        test_map_pgs(m, pools, out=out)
+    if args.upmap:
+        sink = out if args.upmap == "-" else open(args.upmap, "w")
+        try:
+            n = do_upmap(
+                m, pools, args.upmap_deviation, args.upmap_max, out=sink
+            )
+        finally:
+            if sink is not out:
+                sink.close()
+        print(f"osdmaptool: {n} upmap changes", file=out)
+        _save(m, args.mapfn)
+    if not (args.test_map_pgs or args.upmap or args.dump):
+        print(f"osdmaptool: osdmap file {args.mapfn!r}: epoch {m.epoch}", file=out)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. `osdmaptool ... | head`
+        sys.exit(141)

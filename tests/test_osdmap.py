@@ -110,6 +110,16 @@ class TestPgMapping:
         up2, _, _, _ = m.pg_to_up_acting_osds(1, 9)
         assert to not in up2
 
+    def test_oversized_pg_upmap_ignored_both_paths(self):
+        # a forced vector longer than pool.size is invalid operator state
+        # (OSDMonitor rejects it); both paths must ignore it, not crash
+        m = make_map()
+        plain = m.pg_to_up_acting_osds(1, 3)
+        m.pg_upmap[(1, 3)] = [0, 4, 8, 12]
+        assert m.pg_to_up_acting_osds(1, 3) == plain
+        up_b, _ = m.map_pool(1)
+        assert list(up_b[3]) == plain[0]
+
     def test_pg_temp(self):
         m = make_map()
         m.pg_temp[(1, 0)] = [1, 2, 3]
@@ -172,9 +182,11 @@ class TestBatchParity:
     def test_roundtrip_json(self):
         m = make_map()
         m.pg_upmap_items[(1, 20)] = [(0, 4)]
+        m.pg_temp[(1, 5)] = [1, 2, 3]
+        m.primary_temp[(1, 5)] = 2
         m.mark_down(3)
         m2 = OSDMap.from_json(m.to_json())
-        for ps in range(8):
+        for ps in range(32):
             assert m.pg_to_up_acting_osds(1, ps) == m2.pg_to_up_acting_osds(1, ps)
 
 
@@ -210,3 +222,12 @@ class TestBalancer:
         calc_pg_upmaps(m, max_deviation=1.0, pools=[1])
         again = calc_pg_upmaps(m, max_deviation=1.0, pools=[1])
         assert not again  # already tight → no further moves
+
+    def test_balance_bumps_epoch_once(self):
+        m = make_map()
+        e0 = m.epoch
+        changes = calc_pg_upmaps(m, max_deviation=1.0, pools=[1, 2])
+        assert changes and m.epoch == e0 + 1
+        e1 = m.epoch
+        assert not calc_pg_upmaps(m, max_deviation=1.0, pools=[1, 2])
+        assert m.epoch == e1  # no-op calc leaves the epoch alone
