@@ -12,7 +12,10 @@ Layout (SURVEY.md §7):
     crush/     rjenkins hash, crush_ln, straw2, rule interpreter, batch mapper
     parallel/  device-mesh sharding of stripe batches and CRUSH x-batches
     bench/     ceph_erasure_code_benchmark-compatible CLI
-    utils/     profiles, perf counters, config options
+    common/    context, layered config, perf counters, log ring, bufferlist,
+               throttles, admin socket, heartbeat map, op tracker
+    osd/       OSDMap placement + upmap balancer (+ data plane)
+    tools/     crushtool / osdmaptool CLI analogs
 """
 
 __version__ = "0.1.0"

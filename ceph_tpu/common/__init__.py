@@ -1,0 +1,28 @@
+"""ceph_tpu.common — runtime foundation (reference: src/common, src/include,
+src/log; SURVEY.md §2.7).
+
+The compute path (gf/ops/ec/crush) is JAX; this package is the host runtime
+around it: context + layered config, perf counters, subsystem logging with an
+in-memory ring, bufferlist, throttles, admin socket, thread-liveness
+watchdog, and in-flight op tracking.  crc32c rides the native library
+(native/crc32c.cc) with a pure-Python fallback.
+"""
+from .buffer import BufferList
+from .config import Config, Option, OptionTable
+from .context import CephContext
+from .crc32c import crc32c
+from .perf_counters import PerfCounters, PerfCountersBuilder, PerfCountersCollection
+from .throttle import Throttle
+
+__all__ = [
+    "BufferList",
+    "CephContext",
+    "Config",
+    "Option",
+    "OptionTable",
+    "PerfCounters",
+    "PerfCountersBuilder",
+    "PerfCountersCollection",
+    "Throttle",
+    "crc32c",
+]

@@ -1,0 +1,78 @@
+"""CephContext — one process-entity's runtime state (reference:
+src/common/ceph_context.{h,cc} :: CephContext; created by global_init in
+src/global/global_init.cc, SURVEY.md §3.4).
+
+Bundles the layered config, log, perf-counter collection, heartbeat map and
+(optional) admin socket that every daemon and client library hangs off.
+Contexts are explicit — no process-global — so tests can run many entities
+(mon + N osds + clients) in one interpreter, which is how the ring-2
+single-host cluster tests work (SURVEY.md §4).
+"""
+from __future__ import annotations
+
+from .admin_socket import AdminSocket
+from .config import Config, LEVEL_CMDLINE
+from .heartbeat import HeartbeatMap
+from .log import Log
+from .options import default_options
+from .perf_counters import PerfCountersCollection
+
+
+class CephContext:
+    def __init__(self, name: str = "client.admin", overrides: dict | None = None):
+        self.conf = Config(default_options())
+        self.conf.set("name", name, level=LEVEL_CMDLINE)
+        if overrides:
+            for k, v in overrides.items():
+                self.conf.set(k, v, level=LEVEL_CMDLINE)
+        self.log = Log(self.conf, ring_size=self.conf.get("log_ring_size"))
+        self.perf = PerfCountersCollection()
+        self.heartbeat_map = HeartbeatMap()
+        self.admin_socket: AdminSocket | None = None
+        sock_path = self.conf.get("admin_socket")
+        if sock_path:
+            self.admin_socket = AdminSocket(sock_path)
+            self._register_default_commands()
+            self.admin_socket.start()
+
+    @property
+    def name(self) -> str:
+        return self.conf.get("name")
+
+    def dout(self, subsys: str, level: int, message: str) -> None:
+        self.log.dout(subsys, level, message)
+
+    def _register_default_commands(self) -> None:
+        ask = self.admin_socket
+        assert ask is not None
+        ask.register_command(
+            "perf dump", lambda c: self.perf.dump(), "dump perf counters"
+        )
+        ask.register_command(
+            "perf schema", lambda c: self.perf.schema(), "perf counter schema"
+        )
+        ask.register_command(
+            "config show", lambda c: self.conf.show_config(), "show config"
+        )
+        ask.register_command(
+            "config diff", lambda c: self.conf.diff(), "non-default config"
+        )
+        ask.register_command(
+            "config get",
+            lambda c: {c["var"]: self.conf.get(c["var"])},
+            "config get var=<name>",
+        )
+        ask.register_command(
+            "config set",
+            lambda c: {c["var"]: self.conf.set(c["var"], c["val"])},
+            "config set var=<name> val=<value>",
+        )
+        ask.register_command(
+            "log dump", lambda c: [e.format() for e in self.log.recent(100)],
+            "recent log ring entries",
+        )
+
+    def shutdown(self) -> None:
+        if self.admin_socket is not None:
+            self.admin_socket.stop()
+            self.admin_socket = None

@@ -1,0 +1,109 @@
+"""Framework option declarations (reference: src/common/options/*.yaml.in —
+global.yaml.in, osd.yaml.in, mon.yaml.in; SURVEY.md §5.6).
+
+One flat table; names follow the reference's where the concept matches so
+operators recognize them.  Only options the framework's runtime actually
+reads are declared — the table grows with the subsystems.
+"""
+from __future__ import annotations
+
+from .config import Option, OptionTable
+
+
+def default_options() -> OptionTable:
+    return OptionTable(
+        [
+            # -- identity / logging (reference: global.yaml.in) -----------
+            Option("name", str, "client.admin", "entity name, type.id"),
+            Option("log_to_stderr", bool, False, "emit log lines to stderr"),
+            Option("log_ring_size", int, 10000, "in-memory log ring entries",
+                   min=0, runtime=True),
+            Option("debug_default", int, 1, "default subsystem debug level",
+                   min=0, max=20, runtime=True),
+            Option("debug_osd", int, 1, "osd debug level", min=0, max=20,
+                   runtime=True),
+            Option("debug_mon", int, 1, "mon debug level", min=0, max=20,
+                   runtime=True),
+            Option("debug_ms", int, 0, "messenger debug level", min=0, max=20,
+                   runtime=True),
+            Option("debug_ec", int, 1, "erasure-code debug level", min=0,
+                   max=20, runtime=True),
+            Option("debug_crush", int, 1, "crush debug level", min=0, max=20,
+                   runtime=True),
+            Option("admin_socket", str, "", "admin socket path ('' disables)"),
+            # -- messenger (reference: ms_* in global.yaml.in) -------------
+            Option("ms_connect_timeout", float, 10.0,
+                   "seconds to wait for a connect", min=0.0),
+            Option("ms_tcp_nodelay", bool, True, "disable Nagle"),
+            Option("ms_max_frame_len", int, 1 << 28,
+                   "reject frames larger than this", min=4096),
+            Option("ms_inject_socket_failures", int, 0,
+                   "fault injection: drop the connection every ~N frames "
+                   "(0 = off; reference: ms_inject_socket_failures)",
+                   min=0, runtime=True),
+            # -- throttles -------------------------------------------------
+            Option("objecter_inflight_op_bytes", int, 100 << 20,
+                   "client dirty-data throttle", min=0),
+            Option("objecter_inflight_ops", int, 1024,
+                   "client in-flight op throttle", min=0),
+            # -- osd (reference: osd.yaml.in) ------------------------------
+            Option("osd_pool_default_size", int, 3, "replica count", min=1),
+            Option("osd_pool_default_min_size", int, 0,
+                   "min replicas to serve I/O (0 = size - size/2)", min=0),
+            Option("osd_pool_default_pg_num", int, 32, "PGs per new pool",
+                   min=1),
+            Option("osd_heartbeat_interval", float, 1.0,
+                   "seconds between peer pings", min=0.05, runtime=True),
+            Option("osd_heartbeat_grace", float, 6.0,
+                   "seconds without a ping reply before reporting a peer",
+                   min=0.1, runtime=True),
+            Option("osd_op_thread_timeout", float, 15.0,
+                   "healthy-worker watchdog grace", min=0.1),
+            Option("osd_op_thread_suicide_timeout", float, 150.0,
+                   "worker suicide grace", min=0.1),
+            Option("osd_max_backfills", int, 1,
+                   "concurrent backfills per OSD", min=1, runtime=True),
+            Option("osd_recovery_max_active", int, 3,
+                   "concurrent recovery ops per OSD", min=1, runtime=True),
+            Option("osd_op_history_size", int, 20,
+                   "historic ops kept for dump_historic_ops", min=0,
+                   runtime=True),
+            Option("osd_op_complaint_time", float, 30.0,
+                   "age at which an in-flight op is slow", min=0.0,
+                   runtime=True),
+            Option("osd_scrub_chunk_max", int, 25,
+                   "objects per scrub chunk", min=1),
+            Option("osd_debug_inject_read_err", bool, False,
+                   "fault injection: EC shard reads return EIO "
+                   "(reference: bluestore_debug_inject_read_err)",
+                   runtime=True),
+            Option("osd_debug_inject_dispatch_delay", float, 0.0,
+                   "fault injection: sleep before dispatch (seconds)",
+                   min=0.0, runtime=True),
+            # -- mon (reference: mon.yaml.in) ------------------------------
+            Option("mon_osd_down_out_interval", float, 600.0,
+                   "seconds from down to out", min=0.0, runtime=True),
+            Option("mon_osd_min_down_reporters", int, 2,
+                   "distinct reporters to mark an osd down", min=1,
+                   runtime=True),
+            Option("mon_lease", float, 5.0, "paxos lease seconds", min=0.1),
+            Option("mon_tick_interval", float, 1.0, "mon tick seconds",
+                   min=0.05),
+            Option("mon_max_pg_per_osd", int, 250,
+                   "pg-count sanity limit at pool create", min=1),
+            # -- objectstore (reference: bluestore options) ----------------
+            Option("objectstore", str, "memstore", "backend for new OSDs",
+                   enum=("memstore", "filestore")),
+            Option("objectstore_wal_sync", bool, True,
+                   "fsync the WAL on every commit"),
+            Option("objectstore_checksum", bool, True,
+                   "crc32c-verify payloads on read"),
+            # -- ec / tpu --------------------------------------------------
+            Option("ec_kernel", str, "auto",
+                   "encode kernel selection",
+                   enum=("auto", "xla", "pallas", "oracle", "numpy"),
+                   runtime=True),
+            Option("ec_batch_stripes", int, 4096,
+                   "stripes per device launch", min=1, runtime=True),
+        ]
+    )

@@ -1,0 +1,100 @@
+"""In-flight op tracking (reference: src/common/TrackedOp.{h,cc} ::
+TrackedOp, OpTracker; SURVEY.md §5.1).
+
+Every op carries a timestamped event list; the tracker keeps in-flight ops
+plus a bounded deque of completed ("historic") ops, and flags slow ops by
+age.  This is the reference's practical profiler — `dump_historic_ops` shows
+per-stage latency — and the admin socket exposes the same three dumps here.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from threading import Lock
+
+
+class TrackedOp:
+    __slots__ = ("tracker", "desc", "initiated_at", "events", "_lock")
+
+    def __init__(self, tracker: "OpTracker", desc: str):
+        self.tracker = tracker
+        self.desc = desc
+        self.initiated_at = time.time()
+        self.events: list[tuple[float, str]] = [(self.initiated_at, "initiated")]
+        self._lock = Lock()
+
+    def mark_event(self, name: str) -> None:
+        with self._lock:
+            self.events.append((time.time(), name))
+
+    def age(self, now: float | None = None) -> float:
+        return (time.time() if now is None else now) - self.initiated_at
+
+    def dump(self) -> dict:
+        with self._lock:
+            events = list(self.events)
+        t0 = self.initiated_at
+        return {
+            "description": self.desc,
+            "initiated_at": t0,
+            "age": self.age(),
+            "duration": events[-1][0] - t0,
+            "type_data": {
+                "events": [
+                    {"time": ts, "event": name, "offset": ts - t0}
+                    for ts, name in events
+                ]
+            },
+        }
+
+    def finish(self) -> None:
+        self.mark_event("done")
+        self.tracker.unregister(self)
+
+    def __enter__(self) -> "TrackedOp":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.finish()
+        return False
+
+
+class OpTracker:
+    def __init__(self, history_size: int = 20, complaint_time: float = 30.0):
+        self._inflight: dict[int, TrackedOp] = {}
+        self._history: deque[TrackedOp] = deque(maxlen=history_size)
+        self._lock = Lock()
+        self.complaint_time = complaint_time
+
+    def create(self, desc: str) -> TrackedOp:
+        op = TrackedOp(self, desc)
+        with self._lock:
+            self._inflight[id(op)] = op
+        return op
+
+    def unregister(self, op: TrackedOp) -> None:
+        with self._lock:
+            if self._inflight.pop(id(op), None) is not None:
+                self._history.append(op)
+
+    def num_inflight(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    def dump_ops_in_flight(self) -> dict:
+        with self._lock:
+            ops = list(self._inflight.values())
+        return {"num_ops": len(ops), "ops": [op.dump() for op in ops]}
+
+    def dump_historic_ops(self) -> dict:
+        with self._lock:
+            ops = list(self._history)
+        return {"num_ops": len(ops), "ops": [op.dump() for op in ops]}
+
+    def slow_ops(self, now: float | None = None) -> list[TrackedOp]:
+        """Ops older than the complaint time (reference: the
+        'slow requests' health warning path)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            ops = list(self._inflight.values())
+        return [op for op in ops if op.age(now) > self.complaint_time]

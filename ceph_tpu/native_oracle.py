@@ -82,6 +82,10 @@ def _lib() -> ctypes.CDLL:
         ctypes.c_long, _u8p,
     ]
     lib.gfo_decode.restype = ctypes.c_int
+    for name in ("ceph_tpu_crc32c", "ceph_tpu_crc32c_sw"):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_uint32, _u8p, ctypes.c_size_t]
+        fn.restype = ctypes.c_uint32
     return lib
 
 
@@ -170,6 +174,15 @@ def apply_matrix(mat: np.ndarray, chunks: np.ndarray, fast: bool = True) -> np.n
     fn = _lib().gfo_apply_fast if fast else _lib().gfo_apply
     fn(mat.reshape(-1), rows, n, chunks.reshape(-1), length, out.reshape(-1))
     return out
+
+
+def crc32c(data, seed: int = 0xFFFFFFFF, _sw: bool = False) -> int:
+    """crc32c over bytes-like data, reference convention (no final xor;
+    reference: src/common/crc32c.cc :: ceph_crc32c).  _sw forces the
+    table-driven path so tests can cross-check the hardware instruction."""
+    buf = np.frombuffer(memoryview(data).cast("B"), dtype=np.uint8)
+    fn = _lib().ceph_tpu_crc32c_sw if _sw else _lib().ceph_tpu_crc32c
+    return int(fn(seed & 0xFFFFFFFF, buf, buf.size))
 
 
 def decode(

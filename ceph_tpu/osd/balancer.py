@@ -50,14 +50,16 @@ def rule_osd_info(
     walk calc_pg_upmaps does to group candidates by failure domain."""
     root, ftype = _rule_take_and_type(osdmap, rule_id)
     weights = np.zeros(osdmap.max_osd, dtype=np.float64)
+    for osd, w in osdmap.crush.get_rule_weight_osd_map(rule_id).items():
+        if osd < osdmap.max_osd:
+            weights[osd] = w
     domain: dict[int, int] = {}
 
     def walk(bid: int, dom: int | None) -> None:
         b = osdmap.crush.map.buckets[bid]
         here = bid if b.type == ftype else dom
-        for it, w in zip(b.items, b.weights):
+        for it in b.items:
             if it >= 0:
-                weights[it] += w / 0x10000
                 domain[it] = it if ftype == 0 else (here if here is not None else it)
             else:
                 walk(it, here)

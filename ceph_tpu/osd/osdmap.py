@@ -193,7 +193,7 @@ class OSDMap:
             and len(forced) == pool.size
             and all(self._upmap_valid_target(o) for o in forced)
         ):
-            return list(forced)
+            raw = list(forced)
         items = self.pg_upmap_items.get(key)
         if items:
             raw = list(raw)

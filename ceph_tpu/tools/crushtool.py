@@ -140,7 +140,19 @@ def main(argv=None, out=sys.stdout) -> int:
     if args.test:
         weights = np.full(w.map.max_devices, 0x10000, dtype=np.int64)
         for osd, wt in args.weight:
-            weights[int(osd)] = int(float(wt) * 0x10000)
+            try:
+                osd_id, value = int(osd), float(wt)
+            except ValueError:
+                print(f"crushtool: bad --weight {osd} {wt}", file=sys.stderr)
+                return 1
+            if not 0 <= osd_id < w.map.max_devices:
+                print(
+                    f"crushtool: --weight osd.{osd_id} out of range "
+                    f"(map has max_devices {w.map.max_devices})",
+                    file=sys.stderr,
+                )
+                return 1
+            weights[osd_id] = int(value * 0x10000)
         rules = args.rule if args.rule else sorted(w.map.rules)
         run_test(
             w,

@@ -125,8 +125,20 @@ def main(argv=None, out=sys.stdout) -> int:
         )
         return 0
 
-    m = _load(args.mapfn)
+    try:
+        m = _load(args.mapfn)
+    except OSError as e:
+        print(f"osdmaptool: couldn't open map file: {e}", file=sys.stderr)
+        return 1
+    except (ValueError, KeyError) as e:
+        print(f"osdmaptool: {args.mapfn} is not an OSDMap JSON file: {e}",
+              file=sys.stderr)
+        return 1
     pools = args.pool if args.pool else sorted(m.pools)
+    for pid in pools:
+        if pid not in m.pools:
+            print(f"osdmaptool: there is no pool {pid}", file=sys.stderr)
+            return 1
     if args.dump:
         print(f"epoch {m.epoch}", file=out)
         print(f"max_osd {m.max_osd}", file=out)

@@ -1,0 +1,81 @@
+// crc32c (Castagnoli) — the checksum used across the reference's runtime
+// (reference: src/common/crc32c.cc :: ceph_crc32c, with SSE4.2/armv8
+// hardware paths under src/common/crc32c_intel_fast.c).  Convention matches
+// the reference: caller passes the running crc (seed, typically ~0u) and no
+// final inversion is applied — the hardware crc32 instruction implements
+// exactly this reflected-CRC32C update.
+//
+// Consumers: bufferlist::crc32c, store checksums, messenger frame crcs
+// (ceph_tpu/common/buffer.py, ceph_tpu/os/, ceph_tpu/msg/).
+
+#include <cstddef>
+#include <cstdint>
+
+#if defined(__SSE4_2__)
+#include <nmmintrin.h>
+#endif
+
+namespace {
+
+// Software fallback: standard reflected table for poly 0x1EDC6F41
+// (reflected form 0x82F63B78), built once at load.
+struct SwTables {
+  uint32_t t[8][256];
+  SwTables() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++)
+      for (int s = 1; s < 8; s++)
+        t[s][i] = (t[s - 1][i] >> 8) ^ t[0][t[s - 1][i] & 0xff];
+  }
+};
+const SwTables tables;
+
+uint32_t crc32c_sw(uint32_t crc, const uint8_t* p, size_t len) {
+  // slicing-by-8
+  while (len >= 8) {
+    crc ^= (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+           ((uint32_t)p[3] << 24);
+    crc = tables.t[7][crc & 0xff] ^ tables.t[6][(crc >> 8) & 0xff] ^
+          tables.t[5][(crc >> 16) & 0xff] ^ tables.t[4][crc >> 24] ^
+          tables.t[3][p[4]] ^ tables.t[2][p[5]] ^ tables.t[1][p[6]] ^
+          tables.t[0][p[7]];
+    p += 8;
+    len -= 8;
+  }
+  while (len--) crc = tables.t[0][(crc ^ *p++) & 0xff] ^ (crc >> 8);
+  return crc;
+}
+
+}  // namespace
+
+extern "C" uint32_t ceph_tpu_crc32c(uint32_t crc, const uint8_t* data,
+                                    size_t len) {
+#if defined(__SSE4_2__)
+  const uint8_t* p = data;
+  while (len && ((uintptr_t)p & 7)) {
+    crc = _mm_crc32_u8(crc, *p++);
+    len--;
+  }
+  uint64_t c64 = crc;
+  while (len >= 8) {
+    c64 = _mm_crc32_u64(c64, *(const uint64_t*)p);
+    p += 8;
+    len -= 8;
+  }
+  crc = (uint32_t)c64;
+  while (len--) crc = _mm_crc32_u8(crc, *p++);
+  return crc;
+#else
+  return crc32c_sw(crc, data, len);
+#endif
+}
+
+// Exposed so tests can cross-check the hardware path against the table path.
+extern "C" uint32_t ceph_tpu_crc32c_sw(uint32_t crc, const uint8_t* data,
+                                       size_t len) {
+  return crc32c_sw(crc, data, len);
+}

@@ -1,0 +1,383 @@
+"""Foundation-layer tests (ceph_tpu.common) — ring 1 of SURVEY.md §4.
+
+Covers the analogs of src/common: layered config + observers, perf
+counters, bufferlist, crc32c (python vs native hw vs native sw), throttle,
+heartbeat map, op tracker, admin socket round-trip, log ring.
+"""
+import os
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.common import (
+    BufferList,
+    CephContext,
+    Config,
+    Option,
+    OptionTable,
+    PerfCountersBuilder,
+    PerfCountersCollection,
+    Throttle,
+    crc32c,
+)
+from ceph_tpu.common.admin_socket import admin_socket_command
+from ceph_tpu.common.buffer import BufferListIterator
+from ceph_tpu.common.config import (
+    LEVEL_ENV,
+    LEVEL_FILE,
+    LEVEL_MON,
+    ConfigError,
+)
+from ceph_tpu.common.crc32c import _crc32c_py
+from ceph_tpu.common.heartbeat import HeartbeatMap, SuicideTimeout
+from ceph_tpu.common.options import default_options
+from ceph_tpu.common.tracked_op import OpTracker
+
+
+# ---------------------------------------------------------------- crc32c
+class TestCrc32c:
+    def test_known_vectors(self):
+        # iSCSI CRC32C check value: crc of "123456789" seeded -1, inverted.
+        assert _crc32c_py(b"123456789", 0xFFFFFFFF) ^ 0xFFFFFFFF == 0xE3069283
+
+    def test_python_matches_dispatch(self):
+        data = os.urandom(1 << 16)
+        assert crc32c(data) == _crc32c_py(data, 0xFFFFFFFF)
+        assert crc32c(data, seed=0) == _crc32c_py(data, 0)
+
+    def test_native_hw_matches_sw(self):
+        from ceph_tpu import native_oracle
+
+        if not native_oracle.available():
+            pytest.skip("native oracle unavailable")
+        for n in (0, 1, 7, 8, 9, 4096, 65537):
+            data = os.urandom(n)
+            hw = native_oracle.crc32c(data)
+            sw = native_oracle.crc32c(data, _sw=True)
+            py = _crc32c_py(data, 0xFFFFFFFF)
+            assert hw == sw == py
+
+    def test_incremental(self):
+        a, b = b"hello ", b"world"
+        assert crc32c(b, seed=crc32c(a)) == crc32c(a + b)
+
+
+# ---------------------------------------------------------------- config
+def _table():
+    return OptionTable(
+        [
+            Option("x", int, 1, min=0, max=100, runtime=True),
+            Option("mode", str, "fast", enum=("fast", "safe")),
+            Option("frac", float, 0.5),
+            Option("flag", bool, False),
+        ]
+    )
+
+
+class TestConfig:
+    def test_defaults_and_set(self):
+        conf = Config(_table())
+        assert conf.get("x") == 1
+        conf.set("x", "7")
+        assert conf.get("x") == 7
+        assert conf.source("x") == "override"
+
+    def test_layering_precedence(self):
+        conf = Config(_table())
+        conf.set("x", 10, level=LEVEL_FILE)
+        conf.set("x", 20, level=LEVEL_MON)
+        assert conf.get("x") == 20
+        conf.set("x", 30, level=LEVEL_FILE)  # lower layer can't shadow mon
+        assert conf.get("x") == 20
+        conf.rm("x", LEVEL_MON)
+        assert conf.get("x") == 30
+
+    def test_validation(self):
+        conf = Config(_table())
+        with pytest.raises(ConfigError):
+            conf.set("x", 1000)
+        with pytest.raises(ConfigError):
+            conf.set("mode", "bogus")
+        with pytest.raises(ConfigError):
+            conf.get("nonexistent")
+        assert conf.set("flag", "yes") is True
+
+    def test_file_and_env_and_argv(self, tmp_path):
+        p = tmp_path / "ceph.conf"
+        p.write_text("[global]\nx = 9  # comment\nmode = safe\nunknown = 1\n")
+        conf = Config(_table())
+        conf.parse_file(str(p))
+        assert conf.get("x") == 9 and conf.get("mode") == "safe"
+        conf.parse_env({"CEPH_TPU_X": "11"})
+        assert conf.get("x") == 11 and conf.source("x") == "env"
+        rest = conf.parse_argv(["--x", "12", "--frac=0.25", "pos", "--other"])
+        assert conf.get("x") == 12 and conf.get("frac") == 0.25
+        assert rest == ["pos", "--other"]
+        assert conf.source("x") == "cmdline"
+        conf.set("x", 5, level=LEVEL_ENV)  # env below cmdline now
+        assert conf.get("x") == 12
+
+    def test_observer_fires_on_effective_change_only(self):
+        conf = Config(_table())
+        seen = []
+        conf.add_observer(["x"], lambda n, v: seen.append((n, v)))
+        conf.set("x", 2)
+        conf.set("x", 2)  # no effective change
+        conf.set("x", 1, level=LEVEL_FILE)  # shadowed, no change
+        assert seen == [("x", 2)]
+
+    def test_diff(self):
+        conf = Config(_table())
+        conf.set("x", 3, level=LEVEL_ENV)
+        assert conf.diff() == {"x": {"value": 3, "source": "env"}}
+
+    def test_default_options_table_sane(self):
+        table = default_options()
+        assert "osd_pool_default_size" in table
+        conf = Config(table)
+        assert conf.get("osd_pool_default_size") == 3
+
+
+# ------------------------------------------------------------- bufferlist
+class TestBufferList:
+    def test_append_and_flatten(self):
+        bl = BufferList(b"abc")
+        bl.append(b"def").append(bytearray(b"gh"))
+        assert len(bl) == 8
+        assert bytes(bl) == b"abcdefgh"
+        assert bl == b"abcdefgh"
+
+    def test_substr_zero_copy_across_segments(self):
+        bl = BufferList()
+        bl.append(b"0123").append(b"4567").append(b"89")
+        assert bytes(bl.substr(2, 5)) == b"23456"
+        assert bytes(bl.substr(0, 10)) == b"0123456789"
+        assert bytes(bl.substr(9, 1)) == b"9"
+        with pytest.raises(IndexError):
+            bl.substr(5, 6)
+
+    def test_claim_append(self):
+        a, b = BufferList(b"xx"), BufferList(b"yy")
+        a.claim_append(b)
+        assert bytes(a) == b"xxyy" and len(b) == 0
+
+    def test_crc_matches_flat(self):
+        bl = BufferList()
+        for i in range(10):
+            bl.append(os.urandom(100 + i))
+        assert bl.crc32c() == crc32c(bytes(bl))
+
+    def test_rebuild_aligned(self):
+        bl = BufferList(b"abc")
+        bl.append(b"defgh")
+        bl.rebuild_aligned(4)
+        assert bl.is_contiguous() and len(bl) == 8
+        bl2 = BufferList(b"abcde")
+        bl2.rebuild_aligned(4)
+        assert len(bl2) == 8 and bytes(bl2) == b"abcde\0\0\0"
+
+    def test_encode_decode_roundtrip(self):
+        bl = BufferList()
+        bl.append_u8(7).append_u16(300).append_u32(70000).append_u64(1 << 40)
+        bl.append_str("hello").append_str(b"\x00\xff")
+        it = bl.iterator()
+        assert it.get_u8() == 7
+        assert it.get_u16() == 300
+        assert it.get_u32() == 70000
+        assert it.get_u64() == 1 << 40
+        assert it.get_str() == "hello"
+        assert it.get_str_bytes() == b"\x00\xff"
+        assert it.remaining() == 0
+        with pytest.raises(EOFError):
+            it.get_u8()
+
+    def test_iterator_on_partial(self):
+        it = BufferListIterator(b"\x01\x00")
+        assert it.get_u8() == 1
+        with pytest.raises(EOFError):
+            it.get_u32()
+
+
+# ------------------------------------------------------------ perf counters
+class TestPerfCounters:
+    def test_builder_and_dump(self):
+        pc = (
+            PerfCountersBuilder("osd")
+            .add_u64_counter("op_w", "writes")
+            .add_u64("numpg", "pg count")
+            .add_time_avg("op_w_lat", "write latency")
+            .create_perf_counters()
+        )
+        pc.inc("op_w")
+        pc.inc("op_w", 2)
+        pc.set("numpg", 5)
+        pc.avg("op_w_lat", 0.5)
+        pc.avg("op_w_lat", 1.5)
+        d = pc.dump()
+        assert d["op_w"] == 3
+        assert d["numpg"] == 5
+        assert d["op_w_lat"] == {"avgcount": 2, "sum": 2.0}
+        assert pc.schema()["op_w"]["type"] == "u64"
+
+    def test_timer_and_collection(self):
+        coll = PerfCountersCollection()
+        pc = (
+            PerfCountersBuilder("ec")
+            .add_time_avg("encode_lat")
+            .create_perf_counters()
+        )
+        coll.add(pc)
+        with pc.time_fn("encode_lat"):
+            pass
+        d = coll.dump()
+        assert d["ec"]["encode_lat"]["avgcount"] == 1
+        with pytest.raises(ValueError):
+            coll.add(pc)
+        coll.remove("ec")
+        assert coll.dump() == {}
+
+
+# ---------------------------------------------------------------- throttle
+class TestThrottle:
+    def test_basic(self):
+        t = Throttle("ops", 4)
+        assert t.get(3)
+        assert t.get_or_fail(1)
+        assert not t.get_or_fail(1)
+        t.put(2)
+        assert t.get_or_fail(2)
+        assert t.current == 4
+
+    def test_oversized_admitted_alone(self):
+        t = Throttle("bytes", 10)
+        assert t.get(100)  # > max but count was 0
+        assert not t.get_or_fail(1)
+        t.put(100)
+        assert t.get_or_fail(1)
+
+    def test_blocking_wakeup(self):
+        t = Throttle("ops", 1)
+        assert t.get(1)
+        got = []
+
+        def waiter():
+            got.append(t.get(1, timeout=5))
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        time.sleep(0.05)
+        assert not got
+        t.put(1)
+        th.join(timeout=5)
+        assert got == [True]
+
+    def test_timeout(self):
+        t = Throttle("ops", 1)
+        t.get(1)
+        assert t.get(1, timeout=0.05) is False
+
+    def test_zero_disables(self):
+        t = Throttle("off", 0)
+        assert t.get(10**9) and t.get_or_fail(10**9)
+
+
+# ---------------------------------------------------------------- heartbeat
+class TestHeartbeatMap:
+    def test_healthy_cycle(self):
+        hm = HeartbeatMap()
+        h = hm.add_worker("op_thread", grace=10.0)
+        assert hm.is_healthy(now=0.0)
+        h.reset_timeout(now=0.0)
+        assert hm.is_healthy(now=5.0)
+        assert not hm.is_healthy(now=11.0)
+        h.clear_timeout()
+        assert hm.is_healthy(now=100.0)
+
+    def test_suicide(self):
+        hm = HeartbeatMap()
+        h = hm.add_worker("op_thread", grace=1.0, suicide_grace=5.0)
+        h.reset_timeout(now=0.0)
+        with pytest.raises(SuicideTimeout):
+            hm.is_healthy(now=6.0)
+        hm.remove_worker(h)
+        assert hm.is_healthy(now=6.0)
+
+
+# ---------------------------------------------------------------- op tracker
+class TestOpTracker:
+    def test_lifecycle_and_history(self):
+        tr = OpTracker(history_size=2, complaint_time=30.0)
+        with tr.create("osd_op(write obj1)") as op:
+            op.mark_event("queued_for_pg")
+            op.mark_event("commit_sent")
+            assert tr.num_inflight() == 1
+            d = tr.dump_ops_in_flight()
+            assert d["num_ops"] == 1
+            events = d["ops"][0]["type_data"]["events"]
+            assert [e["event"] for e in events] == [
+                "initiated", "queued_for_pg", "commit_sent",
+            ]
+        assert tr.num_inflight() == 0
+        for i in range(3):
+            tr.create(f"op{i}").finish()
+        h = tr.dump_historic_ops()
+        assert h["num_ops"] == 2  # bounded deque
+        assert "op2" in h["ops"][-1]["description"]
+
+    def test_slow_ops(self):
+        tr = OpTracker(complaint_time=0.0)
+        op = tr.create("slow op")
+        time.sleep(0.01)
+        assert tr.slow_ops() == [op]
+        op.finish()
+        assert tr.slow_ops() == []
+
+
+# ---------------------------------------------------------- context + socket
+class TestContext:
+    def test_context_basics(self):
+        cct = CephContext("osd.0", overrides={"debug_osd": 5})
+        assert cct.name == "osd.0"
+        cct.dout("osd", 1, "booting")
+        assert any("booting" in e.message for e in cct.log.recent())
+        cct.shutdown()
+
+    def test_admin_socket_roundtrip(self, tmp_path):
+        path = str(tmp_path / "osd.asok")
+        cct = CephContext("osd.1", overrides={"admin_socket": path})
+        try:
+            pc = (
+                PerfCountersBuilder("osd")
+                .add_u64_counter("op")
+                .create_perf_counters()
+            )
+            cct.perf.add(pc)
+            pc.inc("op", 42)
+            out = admin_socket_command(path, "perf dump")
+            assert out == {"osd": {"op": 42}}
+            helps = admin_socket_command(path, "help")
+            assert "perf dump" in helps and "config show" in helps
+            out = admin_socket_command(
+                path, {"prefix": "config set", "var": "debug_osd", "val": "9"}
+            )
+            assert out == {"debug_osd": 9}
+            out = admin_socket_command(
+                path, {"prefix": "config get", "var": "debug_osd"}
+            )
+            assert out == {"debug_osd": 9}
+            err = admin_socket_command(path, "bogus cmd")
+            assert "error" in err
+        finally:
+            cct.shutdown()
+        assert not os.path.exists(path)
+
+    def test_log_ring_and_levels(self):
+        cct = CephContext("mon.a")
+        assert cct.log.level_for("osd") == cct.conf.get("debug_osd")
+        cct.conf.set("debug_osd", 13)
+        assert cct.log.level_for("osd") == 13
+        for i in range(5):
+            cct.dout("mon", 20, f"msg{i}")
+        assert len(cct.log.recent(3)) == 3
+        cct.shutdown()

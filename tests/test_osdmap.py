@@ -101,6 +101,18 @@ class TestPgMapping:
         up2, _, _, _ = m.pg_to_up_acting_osds(1, 9)
         assert to in up2 and frm not in up2
 
+    def test_pg_upmap_items_apply_on_top_of_pg_upmap(self):
+        # reference semantics: pg_upmap replaces the raw vector, then
+        # pg_upmap_items remap individual OSDs on top; scalar and batch
+        # paths must agree
+        m = make_map()
+        m.pg_upmap[(1, 3)] = [0, 4, 8]
+        m.pg_upmap_items[(1, 3)] = [(0, 12)]
+        up, _, _, _ = m.pg_to_up_acting_osds(1, 3)
+        assert up == [12, 4, 8]
+        up_b, _ = m.map_pool(1)
+        assert list(up_b[3]) == up
+
     def test_upmap_to_out_osd_ignored(self):
         m = make_map()
         up, _, _, _ = m.pg_to_up_acting_osds(1, 9)
