@@ -89,12 +89,17 @@ class AdminSocket:
                 conn.close()
 
     def _handle(self, conn: socket.socket) -> None:
+        # one slow/silent client must not wedge the socket: bound both the
+        # wait and the request size
+        conn.settimeout(5.0)
         data = b""
         while b"\n" not in data:
             chunk = conn.recv(65536)
             if not chunk:
                 break
             data += chunk
+            if len(data) > (1 << 20):
+                raise ValueError("admin socket request too large")
         line = data.split(b"\n", 1)[0].strip()
         try:
             cmd = json.loads(line) if line else {}

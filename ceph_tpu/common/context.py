@@ -63,14 +63,23 @@ class CephContext:
             "config get var=<name>",
         )
         ask.register_command(
-            "config set",
-            lambda c: {c["var"]: self.conf.set(c["var"], c["val"])},
-            "config set var=<name> val=<value>",
+            "config set", self._config_set_cmd,
+            "config set var=<name> val=<value> (runtime-updatable options only)",
         )
         ask.register_command(
             "log dump", lambda c: [e.format() for e in self.log.recent(100)],
             "recent log ring entries",
         )
+
+    def _config_set_cmd(self, cmd: dict) -> dict:
+        # live `config set` honors the option's runtime flag (reference:
+        # non-runtime options need a daemon restart; mon `config set` warns)
+        name = cmd["var"]
+        if not self.conf.table.get(name).runtime:
+            raise ValueError(
+                f"option {name!r} is not runtime-updatable; restart required"
+            )
+        return {name: self.conf.set(name, cmd["val"])}
 
     def shutdown(self) -> None:
         if self.admin_socket is not None:

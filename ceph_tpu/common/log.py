@@ -41,13 +41,17 @@ class Log:
         if config is not None:
             names = [
                 n for n in config.table.names()
-                if n.startswith("debug_") or n == "log_to_stderr"
+                if n.startswith("debug_")
+                or n in ("log_to_stderr", "log_ring_size")
             ]
             config.add_observer(names, self._on_conf_change)
 
     def _on_conf_change(self, name: str, value) -> None:
         if name == "log_to_stderr":
             self._stderr = bool(value)
+        elif name == "log_ring_size":
+            with self._lock:
+                self._ring = deque(self._ring, maxlen=int(value))
 
     def level_for(self, subsys: str) -> int:
         if self._config is None:

@@ -1,0 +1,301 @@
+"""ObjectStore tests — the ceph_test_objectstore analog (reference:
+src/test/objectstore/store_test.cc, parameterized over backends;
+SURVEY.md §4 ring 3) plus LogKV WAL crash-recovery cases.
+"""
+import os
+import struct
+
+import pytest
+
+from ceph_tpu.store import (
+    KStore,
+    LogKV,
+    MemStore,
+    NotFound,
+    ObjectStore,
+    StoreError,
+    Transaction,
+    create_store,
+)
+
+
+@pytest.fixture(params=["memstore", "kstore"])
+def store(request, tmp_path):
+    if request.param == "memstore":
+        s = MemStore()
+    else:
+        s = KStore(str(tmp_path / "kstore"))
+    s.mount()
+    yield s
+    s.umount()
+
+
+def _mkcoll(s: ObjectStore, cid="1.0"):
+    s.queue_transaction(Transaction().create_collection(cid))
+    return cid
+
+
+class TestObjectStore:
+    def test_write_read_roundtrip(self, store):
+        cid = _mkcoll(store)
+        t = Transaction().write(cid, "obj", 0, b"hello world")
+        committed = []
+        store.queue_transaction(t, on_commit=lambda: committed.append(1))
+        assert committed == [1]
+        assert store.read(cid, "obj") == b"hello world"
+        assert store.read(cid, "obj", 6, 5) == b"world"
+        assert store.stat(cid, "obj") == {"size": 11}
+
+    def test_overwrite_extend_zero_truncate(self, store):
+        cid = _mkcoll(store)
+        store.queue_transaction(Transaction().write(cid, "o", 0, b"aaaa"))
+        store.queue_transaction(Transaction().write(cid, "o", 2, b"bbbb"))
+        assert store.read(cid, "o") == b"aabbbb"
+        store.queue_transaction(Transaction().write(cid, "o", 8, b"cc"))
+        assert store.read(cid, "o") == b"aabbbb\0\0cc"
+        store.queue_transaction(Transaction().zero(cid, "o", 1, 3))
+        assert store.read(cid, "o") == b"a\0\0\0bb\0\0cc"
+        store.queue_transaction(Transaction().truncate(cid, "o", 4))
+        assert store.read(cid, "o") == b"a\0\0\0"
+        store.queue_transaction(Transaction().truncate(cid, "o", 6))
+        assert store.read(cid, "o") == b"a\0\0\0\0\0"
+
+    def test_touch_remove_exists(self, store):
+        cid = _mkcoll(store)
+        store.queue_transaction(Transaction().touch(cid, "o"))
+        assert store.exists(cid, "o") and store.stat(cid, "o")["size"] == 0
+        store.queue_transaction(Transaction().remove(cid, "o"))
+        assert not store.exists(cid, "o")
+        with pytest.raises(NotFound):
+            store.read(cid, "o")
+
+    def test_xattr_omap(self, store):
+        cid = _mkcoll(store)
+        t = (
+            Transaction()
+            .touch(cid, "o")
+            .setattr(cid, "o", "hinfo", b"\x01\x02")
+            .omap_setkeys(cid, "o", {"k1": b"v1", "k2": b"v2"})
+        )
+        store.queue_transaction(t)
+        assert store.getattr(cid, "o", "hinfo") == b"\x01\x02"
+        assert store.getattrs(cid, "o") == {"hinfo": b"\x01\x02"}
+        assert store.omap_get(cid, "o") == {"k1": b"v1", "k2": b"v2"}
+        store.queue_transaction(
+            Transaction().rmattr(cid, "o", "hinfo").omap_rmkeys(cid, "o", ["k1"])
+        )
+        assert store.getattrs(cid, "o") == {}
+        assert store.omap_get(cid, "o") == {"k2": b"v2"}
+        store.queue_transaction(Transaction().omap_clear(cid, "o"))
+        assert store.omap_get(cid, "o") == {}
+
+    def test_collections(self, store):
+        _mkcoll(store, "1.0")
+        _mkcoll(store, "1.1")
+        assert store.list_collections() == ["1.0", "1.1"]
+        store.queue_transaction(Transaction().touch("1.0", "a").touch("1.0", "b"))
+        assert store.list_objects("1.0") == ["a", "b"]
+        with pytest.raises(StoreError):  # not empty
+            store.queue_transaction(Transaction().remove_collection("1.0"))
+        with pytest.raises(StoreError):  # duplicate
+            store.queue_transaction(Transaction().create_collection("1.1"))
+        store.queue_transaction(Transaction().remove_collection("1.1"))
+        assert store.list_collections() == ["1.0"]
+
+    def test_move_rename(self, store):
+        _mkcoll(store, "1.0")
+        _mkcoll(store, "1.1")
+        store.queue_transaction(
+            Transaction()
+            .write("1.0", "temp_recovering", 0, b"shard")
+            .setattr("1.0", "temp_recovering", "a", b"v")
+        )
+        store.queue_transaction(
+            Transaction().collection_move_rename("1.0", "temp_recovering", "1.1", "obj")
+        )
+        assert store.list_objects("1.0") == []
+        assert store.read("1.1", "obj") == b"shard"
+        assert store.getattr("1.1", "obj", "a") == b"v"
+
+    def test_transaction_atomicity_on_failure(self, store):
+        cid = _mkcoll(store)
+        store.queue_transaction(Transaction().write(cid, "o", 0, b"base"))
+        t = (
+            Transaction()
+            .write(cid, "o", 0, b"XXXX")
+            .setattr(cid, "missing", "a", b"v")  # fails: object doesn't exist
+        )
+        with pytest.raises(NotFound):
+            store.queue_transaction(t)
+        assert store.read(cid, "o") == b"base"  # first op rolled back
+
+    def test_multi_op_transaction(self, store):
+        cid = _mkcoll(store)
+        t = (
+            Transaction()
+            .write(cid, "o", 0, b"0123456789")
+            .setattr(cid, "o", "crc", b"x")
+            .omap_setkeys(cid, "o", {"pglog.1": b"entry"})
+            .write(cid, "o2", 0, b"second")
+        )
+        store.queue_transaction(t)
+        assert store.read(cid, "o") == b"0123456789"
+        assert store.read(cid, "o2") == b"second"
+
+    def test_transaction_encode_decode(self, store):
+        t = (
+            Transaction()
+            .create_collection("1.0")
+            .write("1.0", "o", 4, b"data")
+            .zero("1.0", "o", 0, 2)
+            .setattr("1.0", "o", "n", b"v")
+            .omap_setkeys("1.0", "o", {"k": b"v"})
+            .collection_move_rename("1.0", "o", "1.0", "o2")
+        )
+        rt = Transaction.decode(bytes(t.encode()))
+        assert [(o.op, o.cid, o.oid) for o in rt.ops] == [
+            (o.op, o.cid, o.oid) for o in t.ops
+        ]
+        s2 = MemStore()
+        s2.queue_transaction(rt)
+        assert s2.read("1.0", "o2", 0) == b"\0\0\0\0data"
+
+    def test_factory(self, tmp_path):
+        assert isinstance(create_store("memstore"), MemStore)
+        assert isinstance(create_store("kstore", str(tmp_path / "k")), KStore)
+        with pytest.raises(StoreError):
+            create_store("bluestore")
+        with pytest.raises(StoreError):
+            create_store("kstore")
+
+
+class TestKStorePersistence:
+    def test_remount_preserves_everything(self, tmp_path):
+        p = str(tmp_path / "k")
+        s = KStore(p)
+        s.mount()
+        s.queue_transaction(Transaction().create_collection("1.0"))
+        s.queue_transaction(
+            Transaction()
+            .write("1.0", "o", 0, b"persist me")
+            .setattr("1.0", "o", "hinfo", b"\x07")
+            .omap_setkeys("1.0", "o", {"k": b"v"})
+        )
+        s.umount()
+        s2 = KStore(p)
+        s2.mount()
+        assert s2.read("1.0", "o") == b"persist me"
+        assert s2.getattr("1.0", "o", "hinfo") == b"\x07"
+        assert s2.omap_get("1.0", "o") == {"k": b"v"}
+        assert s2.fsck() == []
+        s2.umount()
+
+    def test_wal_replay_without_compaction(self, tmp_path):
+        p = str(tmp_path / "k")
+        s = KStore(p)
+        s.mount()
+        s.queue_transaction(Transaction().create_collection("1.0"))
+        for i in range(10):
+            s.queue_transaction(Transaction().write("1.0", f"o{i}", 0, bytes([i]) * 10))
+        # simulate a crash: no umount/close, reopen from files
+        s2 = KStore(p)
+        s2.mount()
+        assert len(s2.list_objects("1.0")) == 10
+        assert s2.read("1.0", "o7") == b"\x07" * 10
+
+    def test_torn_wal_tail_dropped(self, tmp_path):
+        p = str(tmp_path / "k")
+        s = KStore(p)
+        s.mount()
+        s.queue_transaction(Transaction().create_collection("1.0"))
+        s.queue_transaction(Transaction().write("1.0", "good", 0, b"ok"))
+        s.umount()
+        # append garbage — a torn half-written record
+        with open(os.path.join(p, "wal"), "ab") as f:
+            f.write(struct.pack("<II", 1000, 0xDEAD) + b"partial")
+        s2 = KStore(p)
+        s2.mount()
+        assert s2.read("1.0", "good") == b"ok"
+        # and the torn tail was truncated so new writes land cleanly
+        s2.queue_transaction(Transaction().write("1.0", "after", 0, b"x"))
+        s2.umount()
+        s3 = KStore(p)
+        s3.mount()
+        assert s3.read("1.0", "after") == b"x"
+
+    def test_corrupt_record_stops_replay(self, tmp_path):
+        p = str(tmp_path / "k")
+        s = KStore(p)
+        s.mount()
+        s.queue_transaction(Transaction().create_collection("1.0"))
+        s.queue_transaction(Transaction().write("1.0", "a", 0, b"first"))
+        s.umount()
+        wal_path = os.path.join(p, "wal")
+        good_size = os.path.getsize(wal_path)
+        s = KStore(p)
+        s.mount()
+        s.queue_transaction(Transaction().write("1.0", "b", 0, b"second"))
+        s.umount()
+        # flip a byte inside the second record's payload
+        with open(wal_path, "r+b") as f:
+            f.seek(good_size + 12)
+            c = f.read(1)
+            f.seek(good_size + 12)
+            f.write(bytes([c[0] ^ 0xFF]))
+        s2 = KStore(p)
+        s2.mount()
+        assert s2.read("1.0", "a") == b"first"
+        assert not s2.exists("1.0", "b")  # corrupt batch discarded
+
+    def test_compaction_snapshot(self, tmp_path):
+        p = str(tmp_path / "k")
+        s = KStore(p)
+        s.mount()
+        s.queue_transaction(Transaction().create_collection("1.0"))
+        for i in range(5):
+            s.queue_transaction(Transaction().write("1.0", "o", 0, b"v%d" % i))
+        s.compact()
+        assert os.path.getsize(os.path.join(p, "wal")) == 0
+        s.queue_transaction(Transaction().write("1.0", "post", 0, b"after snap"))
+        s.umount()
+        s2 = KStore(p)
+        s2.mount()
+        assert s2.read("1.0", "o") == b"v4"
+        assert s2.read("1.0", "post") == b"after snap"
+
+
+class TestLogKV:
+    def test_basic_and_iterate(self, tmp_path):
+        kv = LogKV(str(tmp_path / "kv"))
+        kv.set("a/1", b"x")
+        kv.set("a/2", b"y")
+        kv.set("b/1", b"z")
+        assert kv.get("a/1") == b"x"
+        assert kv.get("missing") is None
+        assert list(kv.iterate("a/")) == [("a/1", b"x"), ("a/2", b"y")]
+        kv.rm("a/1")
+        assert kv.get("a/1") is None
+        assert len(kv) == 2
+        kv.close()
+
+    def test_batch_atomic_replay(self, tmp_path):
+        from ceph_tpu.store.kv import Batch
+
+        p = str(tmp_path / "kv")
+        kv = LogKV(p)
+        kv.submit_batch(Batch().set("k1", b"v1").set("k2", b"v2").rm("k1"))
+        kv.close()
+        kv2 = LogKV(p)
+        assert kv2.get("k1") is None and kv2.get("k2") == b"v2"
+        kv2.close()
+
+    def test_auto_compact_threshold(self, tmp_path):
+        p = str(tmp_path / "kv")
+        kv = LogKV(p, compact_threshold=1000)
+        for i in range(100):
+            kv.set(f"k{i}", b"x" * 50)
+        assert os.path.getsize(os.path.join(p, "wal")) < 1000
+        kv.close()
+        kv2 = LogKV(p)
+        assert len(kv2) == 100
+        kv2.close()
