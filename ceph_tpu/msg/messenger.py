@@ -1,0 +1,493 @@
+"""Threaded TCP messenger (reference: src/msg/async/AsyncMessenger.cc,
+AsyncConnection.cc, ProtocolV2.cc; SURVEY.md §5.8).
+
+Wire format, after a banner/identify exchange:
+    frame := [u32 len][u32 crc32c(body, seed -1)][body]
+    body  := [u8 ftype][payload]
+    ftype 0 (message): payload = encode_message() bytes
+    ftype 1 (ack):     payload = u64 seq — receiver has consumed through seq
+                       (reference: ProtocolV2 ACK frames)
+A bad crc, an oversized frame, an undecodable message, or a dispatcher
+exception kills the connection, like ProtocolV2.  Acks keep the lossless
+replay queue to unacked messages only, so session replay after a reconnect
+is short and idempotent.
+
+Policies (reference: Messenger::Policy):
+- lossy (client side): a dead connection is reported via ms_handle_reset
+  and the caller (Objecter/MonClient) resends at its layer.
+- lossless_peer (OSD↔OSD): sends transparently reconnect and replay
+  unacked frames; the receiver drops seq <= in_seq duplicates (ProtocolV2
+  session replay), giving in-order exactly-once delivery per session.
+The connector advertises its policy in the banner and the acceptor adopts
+it, so both halves of a session always agree.
+
+Locking: ONE reentrant lock per session (`_Session.lock`) serializes all
+of a connection's send state, receive ordering, reconnect, and dispatch.
+A dispatcher may therefore send on the connection it was called from
+(reentrant), and a stale reader of a replaced socket cannot interleave
+with the replacement (it re-checks socket identity under the lock).  The
+coarse-grained lock trades throughput for obviousness; the reference gets
+the same effect with its per-connection event-loop thread affinity.
+
+Fault injection: `ms_inject_socket_failures = N` tears the socket down
+every ~N message frames sent (reference option of the same name) so higher
+layers' resend paths are testable — the teuthology msgr-failures idiom.
+"""
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+import time
+from collections import deque
+
+from ..common.crc32c import crc32c
+from .message import Message, decode_message, encode_message
+
+_BANNER = b"ceph_tpu msgr v1\n"
+
+_FRAME_MSG = 0
+_FRAME_ACK = 1
+
+POLICY_LOSSY = "lossy"
+POLICY_LOSSLESS_PEER = "lossless_peer"
+
+
+class _Session:
+    """Per-session state shared across socket reincarnations of one peer
+    session (reference: ProtocolV2 session state kept over reconnects)."""
+
+    __slots__ = ("in_seq", "lock")
+
+    def __init__(self):
+        self.in_seq = 0
+        self.lock = threading.RLock()
+
+
+class Dispatcher:
+    """Upcall interface (reference: src/msg/Dispatcher.h)."""
+
+    def ms_dispatch(self, conn: "Connection", msg: Message) -> bool:
+        return False
+
+    def ms_handle_reset(self, conn: "Connection") -> None:
+        pass
+
+
+class Connection:
+    """One peer session (reference: AsyncConnection + ProtocolV2 state)."""
+
+    def __init__(self, msgr: "Messenger", sock: socket.socket | None,
+                 peer_addr, policy: str, outgoing: bool,
+                 session: "_Session | None" = None):
+        self.msgr = msgr
+        self.sock = sock
+        self.peer_addr = peer_addr
+        self.peer_name = ""
+        self.policy = policy
+        self.outgoing = outgoing
+        self.out_seq = 0
+        # connect incarnation: advertised in the banner so the acceptor can
+        # tie socket reincarnations of a lossless session together and keep
+        # deduping replayed seqs (reference: ProtocolV2 client_cookie)
+        self.connect_id = random.getrandbits(63)
+        self._session = session if session is not None else _Session()
+        # unacked frames for lossless replay; unbounded — backpressure is
+        # the job of higher-layer throttles (objecter_inflight_ops), and a
+        # bounded deque here would silently break the no-loss contract
+        self._replay: deque[tuple[int, bytes]] = deque()
+        self._closed = False
+        self._frames_sent = 0
+
+    @property
+    def _lock(self) -> threading.RLock:
+        return self._session.lock
+
+    @property
+    def in_seq(self) -> int:
+        return self._session.in_seq
+
+    @in_seq.setter
+    def in_seq(self, v: int) -> None:
+        self._session.in_seq = v
+
+    # -- sending ----------------------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        with self._lock:
+            if self._closed:
+                raise ConnectionError(f"connection to {self.peer_addr} is down")
+            self.out_seq += 1
+            msg.seq = self.out_seq
+            msg.src = self.msgr.name
+            payload = encode_message(msg)
+            if self.policy == POLICY_LOSSLESS_PEER:
+                self._replay.append((self.out_seq, payload))
+            try:
+                self._send_frame(_FRAME_MSG, payload)
+            except OSError:
+                if self.policy == POLICY_LOSSLESS_PEER and self.outgoing:
+                    self._reconnect_and_replay()
+                else:
+                    self.mark_down()
+                    raise ConnectionError(
+                        f"connection to {self.peer_addr} reset"
+                    ) from None
+
+    def _send_frame(self, ftype: int, payload: bytes, inject: bool = True) -> None:
+        if inject and ftype == _FRAME_MSG:
+            n = self.msgr.inject_socket_failures
+            if n:
+                self._frames_sent += 1
+                if self._frames_sent % n == 0 and self.sock is not None:
+                    # simulate a peer reset mid-stream
+                    try:
+                        self.sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    raise OSError("injected socket failure")
+        if self.sock is None:
+            raise OSError("not connected")
+        body = bytes([ftype]) + payload
+        frame = struct.pack("<II", len(body), crc32c(body)) + body
+        self.sock.sendall(frame)
+
+    def _send_ack(self, seq: int) -> None:
+        with self._lock:
+            try:
+                self._send_frame(_FRAME_ACK, struct.pack("<Q", seq))
+            except OSError:
+                pass  # the reconnect path re-acks via dedup
+
+    def _handle_ack(self, seq: int) -> None:
+        with self._lock:
+            while self._replay and self._replay[0][0] <= seq:
+                self._replay.popleft()
+
+    def _reconnect_and_replay(self) -> None:
+        """Lossless-peer session replay (reference: ProtocolV2 reconnect).
+        Runs under the session lock, so socket swap + in_seq reset are
+        atomic with respect to any stale reader's dispatch re-check."""
+        last_err: OSError | None = None
+        for _ in range(3):
+            try:
+                sock = self.msgr._open_socket(
+                    self.peer_addr, self.connect_id, self.policy
+                )
+                self.sock = sock
+                # the peer's responding half restarts at seq 1 on a fresh
+                # socket (its duplicate requests are dropped, so replies
+                # are never duplicated) — restart our receive expectation
+                self.in_seq = 0
+                self.msgr._start_reader(self)
+                for _seq, payload in list(self._replay):
+                    self._send_frame(_FRAME_MSG, payload, inject=False)
+                return
+            except OSError as e:
+                last_err = e
+                time.sleep(0.05)
+        self.mark_down()
+        raise ConnectionError(
+            f"lossless reconnect to {self.peer_addr} failed: {last_err}"
+        ) from None
+
+    def mark_down(self) -> None:
+        """Tear down without notifying the dispatcher (reference:
+        Connection::mark_down)."""
+        self._closed = True
+        if self.sock is not None:
+            # shutdown() (not just close()) so a reader blocked in recv on
+            # this socket wakes immediately and the peer sees FIN
+            try:
+                self.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+        self.msgr._forget(self)
+
+    @property
+    def is_connected(self) -> bool:
+        return not self._closed and self.sock is not None
+
+
+class Messenger:
+    """reference: Messenger::create + AsyncMessenger."""
+
+    def __init__(self, cct, name: str):
+        self.cct = cct
+        self.name = name  # entity name, e.g. "osd.3"
+        self.myaddr: tuple[str, int] | None = None
+        self.dispatchers: list[Dispatcher] = []
+        self.default_policy = POLICY_LOSSY
+        self._listener: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self._conns: dict[tuple[str, int], Connection] = {}
+        self._conns_by_name: dict[str, Connection] = {}
+        # (peer_name, connect_id) -> _Session surviving reconnects
+        self._sessions: dict[tuple[str, int], _Session] = {}
+        self._lock = threading.RLock()
+        self._stopped = False
+
+    @classmethod
+    def create(cls, cct, name: str) -> "Messenger":
+        return cls(cct, name)
+
+    @property
+    def inject_socket_failures(self) -> int:
+        return self.cct.conf.get("ms_inject_socket_failures") if self.cct else 0
+
+    def _dout(self, level: int, msg: str) -> None:
+        if self.cct is not None:
+            self.cct.dout("ms", level, f"{self.name}: {msg}")
+
+    # -- setup ------------------------------------------------------------
+    def add_dispatcher(self, d: Dispatcher) -> None:
+        self.dispatchers.append(d)
+
+    def bind(self, addr: tuple[str, int] = ("127.0.0.1", 0)) -> tuple[str, int]:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(addr)
+        s.listen(64)
+        self._listener = s
+        self.myaddr = s.getsockname()
+        return self.myaddr
+
+    def start(self) -> None:
+        if self._listener is not None and self._accept_thread is None:
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop, name=f"msgr-{self.name}", daemon=True
+            )
+            self._accept_thread.start()
+
+    def shutdown(self) -> None:
+        self._stopped = True
+        if self._listener is not None:
+            listener, self._listener = self._listener, None
+            try:
+                listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            c.mark_down()
+
+    # -- outgoing ---------------------------------------------------------
+    def connect(
+        self, addr: tuple[str, int], policy: str | None = None
+    ) -> Connection:
+        """Get-or-create a connection (reference:
+        Messenger::connect_to/get_connection).  The blocking dial happens
+        outside the messenger lock; a lost creation race closes the extra
+        socket and returns the winner."""
+        addr = (addr[0], addr[1])
+        with self._lock:
+            conn = self._conns.get(addr)
+            if conn is not None and conn.is_connected:
+                return conn
+        fresh = Connection(
+            self, None, addr, policy or self.default_policy, outgoing=True
+        )
+        sock = self._open_socket(addr, fresh.connect_id, fresh.policy)
+        with self._lock:
+            conn = self._conns.get(addr)
+            if conn is not None and conn.is_connected:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return conn
+            fresh.sock = sock
+            self._conns[addr] = fresh
+        self._start_reader(fresh)
+        return fresh
+
+    def _open_socket(
+        self, addr: tuple[str, int], connect_id: int, policy: str
+    ) -> socket.socket:
+        timeout = self.cct.conf.get("ms_connect_timeout") if self.cct else 10.0
+        sock = socket.create_connection(addr, timeout=timeout)
+        sock.settimeout(None)
+        if self.cct is None or self.cct.conf.get("ms_tcp_nodelay"):
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # banner + identify (reference: ProtocolV2 banner/hello frames; the
+        # connect_id plays client_cookie's role, and the policy rides along
+        # so the acceptor's half agrees with ours)
+        sock.sendall(_BANNER + f"{self.name} {connect_id} {policy}\n".encode())
+        return sock
+
+    # -- incoming ---------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stopped:
+            listener = self._listener
+            if listener is None:
+                return
+            try:
+                sock, peer = listener.accept()
+            except OSError as e:
+                if self._stopped or self._listener is None:
+                    return
+                # transient accept failure (ECONNABORTED, EMFILE burst)
+                # must not kill the acceptor
+                self._dout(1, f"accept error, retrying: {e}")
+                time.sleep(0.01)
+                continue
+            threading.Thread(
+                target=self._handshake_incoming, args=(sock, peer), daemon=True
+            ).start()
+
+    def _handshake_incoming(self, sock: socket.socket, peer) -> None:
+        try:
+            sock.settimeout(self.cct.conf.get("ms_connect_timeout") if self.cct else 10.0)
+            banner = self._read_exact(sock, len(_BANNER))
+            if banner != _BANNER:
+                sock.close()
+                return
+            ident = b""
+            while not ident.endswith(b"\n"):
+                b = sock.recv(1)
+                if not b:
+                    sock.close()
+                    return
+                ident += b
+            sock.settimeout(None)
+        except OSError:
+            sock.close()
+            return
+        try:
+            peer_name, cid_str, policy = ident.decode().split()
+            connect_id = int(cid_str)
+            if policy not in (POLICY_LOSSY, POLICY_LOSSLESS_PEER):
+                raise ValueError(policy)
+        except ValueError:
+            sock.close()
+            return
+        with self._lock:
+            sess = self._sessions.setdefault((peer_name, connect_id), _Session())
+            conn = Connection(
+                self, sock, peer, policy, outgoing=False, session=sess,
+            )
+            conn.peer_name = peer_name
+            conn.connect_id = connect_id
+            self._conns[peer] = conn
+            self._conns_by_name[peer_name] = conn
+            if len(self._sessions) > 4096:
+                self._evict_sessions_locked()
+        self._start_reader(conn)
+
+    def _evict_sessions_locked(self) -> None:
+        # bound session-state memory without destroying the dedup state of
+        # sessions that still have a live connection
+        live = {id(c._session) for c in self._conns.values()}
+        for key in list(self._sessions):
+            if len(self._sessions) <= 2048:
+                break
+            if id(self._sessions[key]) not in live:
+                del self._sessions[key]
+
+    def _start_reader(self, conn: Connection) -> None:
+        threading.Thread(
+            target=self._read_loop, args=(conn, conn.sock),
+            name=f"msgr-{self.name}-rx", daemon=True,
+        ).start()
+
+    def _read_loop(self, conn: Connection, sock: socket.socket) -> None:
+        max_len = self.cct.conf.get("ms_max_frame_len") if self.cct else (1 << 28)
+        try:
+            while not conn._closed and sock is conn.sock:
+                hdr = self._read_exact(sock, 8)
+                length, crc = struct.unpack("<II", hdr)
+                if length > max_len or length < 1:
+                    raise OSError(f"bad frame length ({length})")
+                body = self._read_exact(sock, length)
+                if crc32c(body) != crc:
+                    raise OSError("frame crc mismatch")
+                ftype, payload = body[0], body[1:]
+                if ftype == _FRAME_ACK:
+                    conn._handle_ack(struct.unpack("<Q", payload)[0])
+                    continue
+                msg = decode_message(payload)
+                with conn._session.lock:
+                    if conn._closed or sock is not conn.sock:
+                        # socket was replaced/closed while we were blocked:
+                        # this frame belongs to the dead incarnation
+                        return
+                    if msg.seq <= conn.in_seq:
+                        conn._send_ack(conn.in_seq)  # re-ack dropped dup
+                        continue
+                    conn.in_seq = msg.seq
+                    if not conn.peer_name:
+                        conn.peer_name = msg.src
+                    if conn.policy == POLICY_LOSSLESS_PEER:
+                        conn._send_ack(msg.seq)
+                    self._dispatch(conn, msg)
+        except OSError:
+            pass
+        except Exception as e:
+            # decode failure / dispatcher exception: connection-fatal, like
+            # ProtocolV2 treating an undecodable frame as protocol error
+            self._dout(0, f"reader failed on {conn.peer_addr}: {e!r}")
+        # reader died: an incoming lossless conn's peer will reconnect (new
+        # socket, same session); an outgoing lossless conn repairs the
+        # session NOW if unacked frames remain — frames written to a socket
+        # that died in flight would otherwise only be replayed when the
+        # *next* send fails, which may never come.  Only lossy resets
+        # surface to the dispatcher.
+        if conn._closed or sock is not conn.sock:
+            return
+        if conn.policy == POLICY_LOSSLESS_PEER:
+            if not conn.outgoing:
+                conn.mark_down()
+                return
+            with conn._lock:
+                if conn._closed or sock is not conn.sock or not conn._replay:
+                    return
+                try:
+                    conn._reconnect_and_replay()
+                except ConnectionError:
+                    if not self._stopped:
+                        for d in self.dispatchers:
+                            d.ms_handle_reset(conn)
+            return
+        was_open = not conn._closed
+        conn.mark_down()
+        if was_open and not self._stopped:
+            for d in self.dispatchers:
+                d.ms_handle_reset(conn)
+
+    @staticmethod
+    def _read_exact(sock: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise OSError("connection closed")
+            buf += chunk
+        return buf
+
+    def _dispatch(self, conn: Connection, msg: Message) -> None:
+        for d in self.dispatchers:
+            if d.ms_dispatch(conn, msg):
+                return
+
+    def get_connection(self, peer_name: str) -> Connection | None:
+        """Latest live incoming connection from a named peer (reference:
+        Messenger tracks connections per entity)."""
+        with self._lock:
+            conn = self._conns_by_name.get(peer_name)
+            return conn if conn is not None and conn.is_connected else None
+
+    def _forget(self, conn: Connection) -> None:
+        with self._lock:
+            if self._conns.get(conn.peer_addr) is conn:
+                del self._conns[conn.peer_addr]
+            if self._conns_by_name.get(conn.peer_name) is conn:
+                del self._conns_by_name[conn.peer_name]

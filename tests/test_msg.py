@@ -1,0 +1,231 @@
+"""Messenger tests (reference behaviors: src/msg/async + ProtocolV2;
+SURVEY.md §5.8) — framing, dispatch, resets, lossless replay, fault
+injection, message registry round-trips.
+"""
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.common import CephContext
+from ceph_tpu.common.buffer import BufferList
+from ceph_tpu.msg import (
+    Dispatcher,
+    Message,
+    Messenger,
+    MPing,
+    decode_message,
+    encode_message,
+    register_message,
+)
+from ceph_tpu.msg.messenger import POLICY_LOSSLESS_PEER
+
+
+@register_message
+class MTestData(Message):
+    MSG_TYPE = 9001
+
+    def __init__(self, blob: bytes = b"", n: int = 0):
+        super().__init__()
+        self.blob = blob
+        self.n = n
+
+    def encode_payload(self, bl: BufferList) -> None:
+        bl.append_u64(self.n)
+        bl.append_str(self.blob)
+
+    def decode_payload(self, it) -> None:
+        self.n = it.get_u64()
+        self.blob = it.get_str_bytes()
+
+
+class Collector(Dispatcher):
+    def __init__(self):
+        self.msgs = []
+        self.resets = []
+        self.event = threading.Event()
+
+    def ms_dispatch(self, conn, msg):
+        self.msgs.append((conn, msg))
+        self.event.set()
+        return True
+
+    def ms_handle_reset(self, conn):
+        self.resets.append(conn)
+        self.event.set()
+
+    def wait_msgs(self, n, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while len(self.msgs) < n and time.monotonic() < deadline:
+            time.sleep(0.005)
+        return len(self.msgs) >= n
+
+
+@pytest.fixture
+def cct():
+    c = CephContext("test")
+    yield c
+    c.shutdown()
+
+
+def make_pair(cct, policy=None):
+    server = Messenger.create(cct, "osd.0")
+    server.bind(("127.0.0.1", 0))
+    if policy:
+        server.default_policy = policy
+    disp = Collector()
+    server.add_dispatcher(disp)
+    server.start()
+    client = Messenger.create(cct, "client.1")
+    if policy:
+        client.default_policy = policy
+    return server, disp, client
+
+
+class TestCodec:
+    def test_roundtrip(self):
+        m = MTestData(b"\x00\x01payload", 42)
+        m.seq, m.src = 7, "osd.3"
+        out = decode_message(encode_message(m))
+        assert isinstance(out, MTestData)
+        assert (out.n, out.blob, out.seq, out.src) == (42, b"\x00\x01payload", 7, "osd.3")
+
+    def test_unknown_type(self):
+        m = MPing("x")
+        raw = bytearray(encode_message(m))
+        raw[0] = 0xEE
+        raw[1] = 0xEE
+        with pytest.raises(ValueError):
+            decode_message(bytes(raw))
+
+    def test_duplicate_registration_rejected(self):
+        with pytest.raises(ValueError):
+            @register_message
+            class Clash(Message):
+                MSG_TYPE = 9001
+
+
+class TestMessenger:
+    def test_send_and_dispatch(self, cct):
+        server, disp, client = make_pair(cct)
+        try:
+            conn = client.connect(server.myaddr)
+            conn.send_message(MTestData(b"hello", 1))
+            conn.send_message(MTestData(b"world", 2))
+            assert disp.wait_msgs(2)
+            (c1, m1), (c2, m2) = disp.msgs
+            assert m1.blob == b"hello" and m2.blob == b"world"
+            assert m1.seq == 1 and m2.seq == 2  # in order
+            assert m1.src == "client.1" and c1.peer_name == "client.1"
+        finally:
+            client.shutdown()
+            server.shutdown()
+
+    def test_bidirectional(self, cct):
+        server, disp, client = make_pair(cct)
+
+        class Echo(Dispatcher):
+            def ms_dispatch(self, conn, msg):
+                conn.send_message(MTestData(msg.blob.upper(), msg.n))
+                return True
+
+        server.dispatchers[0] = Echo()
+        cdisp = Collector()
+        client.add_dispatcher(cdisp)
+        try:
+            conn = client.connect(server.myaddr)
+            conn.send_message(MTestData(b"abc", 5))
+            assert cdisp.wait_msgs(1)
+            assert cdisp.msgs[0][1].blob == b"ABC"
+        finally:
+            client.shutdown()
+            server.shutdown()
+
+    def test_large_frame(self, cct):
+        server, disp, client = make_pair(cct)
+        try:
+            blob = bytes(range(256)) * (4 << 10)  # 1 MiB
+            client.connect(server.myaddr).send_message(MTestData(blob, 0))
+            assert disp.wait_msgs(1)
+            assert disp.msgs[0][1].blob == blob
+        finally:
+            client.shutdown()
+            server.shutdown()
+
+    def test_client_sees_reset_on_server_shutdown(self, cct):
+        server, disp, client = make_pair(cct)
+        cdisp = Collector()
+        client.add_dispatcher(cdisp)
+        conn = client.connect(server.myaddr)
+        conn.send_message(MPing())
+        assert disp.wait_msgs(1)
+        server.shutdown()
+        deadline = time.monotonic() + 5
+        while not cdisp.resets and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert cdisp.resets == [conn]
+        with pytest.raises(ConnectionError):
+            conn.send_message(MPing())
+        client.shutdown()
+
+    def test_connection_reuse(self, cct):
+        server, disp, client = make_pair(cct)
+        try:
+            c1 = client.connect(server.myaddr)
+            c2 = client.connect(server.myaddr)
+            assert c1 is c2
+        finally:
+            client.shutdown()
+            server.shutdown()
+
+    def test_lossless_replay_on_injected_failures(self, cct):
+        # every 5th frame the socket is torn down mid-stream; the lossless
+        # policy must reconnect + replay with no loss and no duplication
+        server, disp, client = make_pair(cct, policy=POLICY_LOSSLESS_PEER)
+        cct.conf.set("ms_inject_socket_failures", 5)
+        try:
+            conn = client.connect(server.myaddr)
+            total = 37
+            for i in range(total):
+                conn.send_message(MTestData(b"m%d" % i, i))
+            assert disp.wait_msgs(total), f"got {len(disp.msgs)}/{total}"
+            ns = [m.n for _, m in disp.msgs]
+            assert ns == list(range(total))  # ordered, exactly-once
+        finally:
+            cct.conf.set("ms_inject_socket_failures", 0)
+            client.shutdown()
+            server.shutdown()
+
+    def test_lossy_conn_new_session_not_deduped(self, cct):
+        # a brand-new lossy connection restarts seqs at 1; the server must
+        # not confuse it with the previous session from the same entity
+        server, disp, client = make_pair(cct)
+        conn = client.connect(server.myaddr)
+        conn.send_message(MTestData(b"first", 1))
+        assert disp.wait_msgs(1)
+        conn.mark_down()
+        client2 = Messenger.create(cct, "client.1")
+        conn2 = client2.connect(server.myaddr)
+        conn2.send_message(MTestData(b"second", 2))
+        assert disp.wait_msgs(2)
+        assert disp.msgs[1][1].blob == b"second"
+        client.shutdown()
+        client2.shutdown()
+        server.shutdown()
+
+    def test_get_connection_by_name(self, cct):
+        server, disp, client = make_pair(cct)
+        try:
+            conn = client.connect(server.myaddr)
+            conn.send_message(MPing("hi"))
+            assert disp.wait_msgs(1)
+            sconn = server.get_connection("client.1")
+            assert sconn is not None
+            cdisp = Collector()
+            client.add_dispatcher(cdisp)
+            sconn.send_message(MPing("back"))
+            assert cdisp.wait_msgs(1)
+            assert cdisp.msgs[0][1].note == "back"
+        finally:
+            client.shutdown()
+            server.shutdown()
