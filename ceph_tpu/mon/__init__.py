@@ -1,0 +1,14 @@
+"""ceph_tpu.mon — control plane (reference: src/mon; SURVEY.md §2.5).
+
+Monitors hold the authoritative cluster maps, replicated across the quorum
+by single-decree Paxos over the MonitorDBStore (here: LogKV/MemKV).  The
+OSDMonitor is the OSDMap authority: EC profile validation (instantiating
+through the erasure-code registry, exactly how `plugin=jax` is vetted at
+`osd erasure-code-profile set`), pool creation with CRUSH rule synthesis,
+failure-report corroboration → down, and the down→out timer.  MonClient is
+the daemon/client session: commands, map subscriptions, boot.
+"""
+from .mon_client import MonClient
+from .monitor import MonMap, Monitor
+
+__all__ = ["MonClient", "MonMap", "Monitor"]

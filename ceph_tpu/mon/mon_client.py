@@ -1,0 +1,171 @@
+"""MonClient — daemon/client session to the monitor quorum (reference:
+src/mon/MonClient.{h,cc}; SURVEY.md §2.5).
+
+Hunts for a live mon, redials to the leader when a command is NACKed with
+`not leader`, keeps the OSDMap subscription alive across reconnects, and
+exposes `wait_for_osdmap` the way daemons block on map epochs.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..msg import Dispatcher, Messenger
+from ..osd.osdmap import OSDMap
+from .messages import (
+    MMonCommand,
+    MMonCommandAck,
+    MMonSubscribe,
+    MOSDBoot,
+    MOSDFailure,
+    MOSDMapMsg,
+)
+
+
+class MonClient(Dispatcher):
+    def __init__(self, cct, mon_addrs: list[tuple[str, int]], name: str | None = None):
+        self.cct = cct
+        self.mon_addrs = [tuple(a) for a in mon_addrs]
+        self.messenger = Messenger.create(cct, name or cct.name)
+        self.messenger.add_dispatcher(self)
+        self._conn = None
+        self._conn_addr: tuple[str, int] | None = None
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._tid = 0
+        self._acks: dict[int, tuple[int, object]] = {}
+        self.osdmap: OSDMap | None = None
+        self._subscribed_from = 0
+        self._map_callbacks: list = []
+
+    # -- connection hunt ---------------------------------------------------
+    def _connect(self, addr=None):
+        with self._lock:
+            if addr is None and self._conn is not None and self._conn.is_connected:
+                return self._conn
+            last_err = None
+            addrs = [addr] if addr else list(self.mon_addrs)
+            for a in addrs:
+                try:
+                    conn = self.messenger.connect(tuple(a))
+                    self._conn, self._conn_addr = conn, tuple(a)
+                    if self._subscribed_from:
+                        # re-arm the subscription on the new mon
+                        conn.send_message(
+                            MMonSubscribe(what={"osdmap": self._subscribed_from})
+                        )
+                    return conn
+                except (OSError, ConnectionError) as e:
+                    last_err = e
+            raise ConnectionError(f"no monitor reachable: {last_err}")
+
+    def ms_handle_reset(self, conn) -> None:
+        with self._lock:
+            if conn is self._conn:
+                self._conn = None
+
+    # -- dispatch ----------------------------------------------------------
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MMonCommandAck):
+            with self._lock:
+                self._acks[msg.tid] = (msg.retval, msg.result)
+                self._cond.notify_all()
+            return True
+        if isinstance(msg, MOSDMapMsg):
+            newest = None
+            for _e, j in sorted(msg.maps.items(), key=lambda kv: int(kv[0])):
+                newest = j
+            if newest is not None:
+                m = OSDMap.from_json(newest)
+                callbacks = []
+                with self._lock:
+                    if self.osdmap is None or m.epoch > self.osdmap.epoch:
+                        self.osdmap = m
+                        self._subscribed_from = m.epoch + 1
+                        callbacks = list(self._map_callbacks)
+                        self._cond.notify_all()
+                for cb in callbacks:
+                    cb(m)
+            return True
+        return False
+
+    # -- commands ----------------------------------------------------------
+    def command(self, cmd: dict, timeout: float = 10.0) -> tuple[int, object]:
+        """Send a CLI-style command; transparently follows the leader
+        (reference: MonClient command routing + Objecter retries)."""
+        deadline = threading.TIMEOUT_MAX if timeout is None else timeout
+        attempts = 0
+        addr = None
+        # one tid for every attempt of this logical command: the monitor
+        # dedups on (src, tid), so a retry after a lost ack re-fetches the
+        # recorded result instead of re-executing a non-idempotent command
+        with self._lock:
+            self._tid += 1
+            tid = self._tid
+        while attempts < 5:
+            attempts += 1
+            try:
+                conn = self._connect(addr)
+                conn.send_message(MMonCommand(tid=tid, cmd=cmd))
+            except (OSError, ConnectionError):
+                addr = None
+                continue
+            with self._lock:
+                ok = self._cond.wait_for(
+                    lambda: tid in self._acks, timeout=min(deadline, 10.0)
+                )
+                if not ok:
+                    addr = None
+                    continue
+                retval, result = self._acks.pop(tid)
+            if retval == -307 and isinstance(result, dict):
+                # peon: redial the leader it names
+                la = result.get("leader_addr")
+                addr = tuple(la) if la else None
+                if addr is None:
+                    time.sleep(0.2)  # election in progress
+                continue
+            if retval == -11:  # EAGAIN: leader elected, state still syncing
+                time.sleep(0.2)
+                continue
+            return retval, result
+        return -110, "command timed out (no leader?)"
+
+    # -- subscriptions -----------------------------------------------------
+    def subscribe_osdmap(self, from_epoch: int = 1, callback=None) -> None:
+        with self._lock:
+            self._subscribed_from = max(self._subscribed_from, from_epoch) or 1
+            if callback is not None:
+                self._map_callbacks.append(callback)
+        conn = self._connect()
+        conn.send_message(MMonSubscribe(what={"osdmap": self._subscribed_from}))
+
+    def wait_for_osdmap(self, min_epoch: int = 1, timeout: float = 10.0) -> OSDMap:
+        with self._lock:
+            ok = self._cond.wait_for(
+                lambda: self.osdmap is not None and self.osdmap.epoch >= min_epoch,
+                timeout=timeout,
+            )
+            if not ok:
+                have = self.osdmap.epoch if self.osdmap else None
+                raise TimeoutError(
+                    f"no osdmap epoch >= {min_epoch} (have {have})"
+                )
+            return self.osdmap
+
+    # -- daemon helpers ----------------------------------------------------
+    def send_boot(self, osd: int, addr: tuple[str, int]) -> None:
+        self._connect().send_message(
+            MOSDBoot(osd=osd, host=addr[0], port=addr[1])
+        )
+
+    def report_failure(self, target: int, failed_for: float = 0.0) -> None:
+        try:
+            self._connect().send_message(
+                MOSDFailure(target=target, failed_for=failed_for, reporter=None)
+            )
+        except (OSError, ConnectionError):
+            pass
+
+    def shutdown(self) -> None:
+        self.messenger.shutdown()

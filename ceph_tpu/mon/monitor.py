@@ -1,0 +1,441 @@
+"""Monitor daemon (reference: src/mon/Monitor.{h,cc}; SURVEY.md §2.5).
+
+One Monitor = messenger + Elector + Paxos + PaxosServices (OSDMonitor).
+The monmap is static for a cluster's lifetime (the reference can grow it;
+vstart-style clusters here fix it at boot).  Peons forward nothing: a
+command sent to a peon is NACKed with the leader's rank and the client
+redials (the reference routes instead — same outcome, simpler machinery).
+
+Subscriptions (reference: Monitor::handle_subscribe): a client subscribes
+to "osdmap" from an epoch; every commit pushes the new full maps to all
+subscribers.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from ..msg import Dispatcher, Messenger, MPing
+from ..msg.messenger import POLICY_LOSSLESS_PEER
+from ..osd.osdmap import OSDMap
+from ..store.kv import KeyValueDB, MemKV
+from .elector import Elector
+from .messages import (
+    MMonCommand,
+    MMonCommandAck,
+    MMonElection,
+    MMonPaxos,
+    MMonSubscribe,
+    MOSDAlive,
+    MOSDBoot,
+    MOSDFailure,
+    MOSDMapMsg,
+)
+from .osd_monitor import OSDMonitor
+
+STATE_PROBING = "probing"
+STATE_ELECTING = "electing"
+STATE_LEADER = "leader"
+STATE_PEON = "peon"
+
+
+class MonMap:
+    """reference: src/mon/MonMap.h — name → rank (sorted) + address, plus
+    the cluster fsid that fences off foreign-cluster daemons."""
+
+    def __init__(self, addrs: dict[str, tuple[str, int]], fsid: str | None = None):
+        import uuid
+
+        self.addrs = dict(addrs)
+        self._names = sorted(addrs)  # rank order = sorted names
+        self.fsid = fsid or str(uuid.uuid4())
+
+    def ranks(self) -> list[int]:
+        return list(range(len(self._names)))
+
+    def name_of(self, rank: int) -> str:
+        return self._names[rank]
+
+    def rank_of(self, name: str) -> int | None:
+        try:
+            return self._names.index(name)
+        except ValueError:
+            return None
+
+    def addr_of(self, rank: int) -> tuple[str, int]:
+        return self.addrs[self._names[rank]]
+
+    def size(self) -> int:
+        return len(self._names)
+
+
+class Monitor(Dispatcher):
+    def __init__(
+        self,
+        cct,
+        name: str,  # bare mon name, e.g. "a"
+        monmap: MonMap,
+        store: KeyValueDB | None = None,
+        initial_osdmap: OSDMap | None = None,
+    ):
+        self.cct = cct
+        self.name = name
+        self.monmap = monmap
+        rank = monmap.rank_of(name)
+        if rank is None:
+            raise ValueError(f"mon {name!r} not in monmap")
+        self.rank = rank
+        self.store = store if store is not None else MemKV()
+        self.state = STATE_PROBING
+        self.leader_rank: int | None = None
+        self.quorum: list[int] = []
+        self.messenger = Messenger.create(cct, f"mon.{name}")
+        self.messenger.default_policy = POLICY_LOSSLESS_PEER
+        self.messenger.add_dispatcher(self)
+        self.messenger.bind(monmap.addr_of(rank))
+        self.elector = Elector(self)
+        from .paxos import Paxos
+
+        self.paxos = Paxos(self, self.store)
+        self.osdmon = OSDMonitor(self, initial_osdmap)
+        # conn -> next osdmap epoch wanted
+        self._subs: dict[object, int] = {}
+        self._subs_lock = threading.Lock()
+        # (client, tid) -> completed command result, so a retried command
+        # (ack lost / slow proposal) is answered, not re-executed
+        self._cmd_results: dict[tuple[str, int], tuple[int, object]] = {}
+        self._cmd_inflight: set[tuple[str, int]] = set()
+        self._cmd_lock = threading.Lock()
+        # All cross-connection sends go through one sender thread.  Paxos
+        # and elector handlers run on connection reader threads (holding
+        # that connection's session lock) and take subsystem locks; if
+        # those subsystems also sent directly while holding their locks,
+        # the two lock orders would deadlock (session→subsystem vs
+        # subsystem→session).  Queueing breaks the cycle.
+        self._sendq: "queue.Queue[tuple | None]" = queue.Queue()
+        self._send_thread: threading.Thread | None = None
+        self._tick_thread: threading.Thread | None = None
+        self._stop_event = threading.Event()
+
+    @property
+    def _stopped(self) -> bool:
+        return self._stop_event.is_set()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.messenger.start()
+        self._send_thread = threading.Thread(
+            target=self._send_loop, name=f"mon.{self.name}-send", daemon=True
+        )
+        self._send_thread.start()
+        self.elector.start_election()
+        self._tick_thread = threading.Thread(
+            target=self._tick_loop, name=f"mon.{self.name}-tick", daemon=True
+        )
+        self._tick_thread.start()
+
+    def shutdown(self) -> None:
+        self._stop_event.set()
+        self.elector.stop()
+        self._sendq.put(None)
+        self.messenger.shutdown()
+        if self._tick_thread is not None:
+            self._tick_thread.join(timeout=5)
+        if self._send_thread is not None:
+            self._send_thread.join(timeout=5)
+        close = getattr(self.store, "close", None)
+        if close:
+            close()
+
+    def _send_loop(self) -> None:
+        while True:
+            item = self._sendq.get()
+            if item is None or self._stopped:
+                return
+            try:
+                if item[0] == "mon":
+                    _, rank, msg = item
+                    self.messenger.connect(
+                        self.monmap.addr_of(rank)
+                    ).send_message(msg)
+                elif item[0] == "publish":
+                    self._publish_osdmap_now()
+            except (OSError, ConnectionError):
+                pass  # elections / paxos timeouts handle the silence
+            except Exception as e:
+                self.cct.dout("mon", 0, f"mon.{self.name} send failed: {e!r}")
+
+    def _tick_loop(self) -> None:
+        interval = self.cct.conf.get("mon_tick_interval")
+        while not self._stop_event.wait(interval):
+            if self._stopped:
+                return
+            try:
+                self.tick()
+            except Exception as e:
+                self.cct.dout("mon", 0, f"mon.{self.name} tick failed: {e!r}")
+
+    def tick(self) -> None:
+        if self.is_leader():
+            self.osdmon.tick()
+        elif self.state == STATE_PEON and self.leader_rank is not None:
+            # leader liveness probe: a dead leader triggers re-election
+            # (reference: peons' lease timeout; SURVEY.md §5.3)
+            try:
+                conn = self.messenger.connect(
+                    self.monmap.addr_of(self.leader_rank)
+                )
+                conn.send_message(MPing("leader-probe"))
+            except (OSError, ConnectionError):
+                self.cct.dout("mon", 1, f"mon.{self.name}: leader unreachable")
+                self.elector.start_election()
+
+    # -- election plumbing (Elector callbacks) ----------------------------
+    def majority(self) -> int:
+        return self.monmap.size() // 2 + 1
+
+    def other_ranks(self) -> list[int]:
+        return [r for r in self.monmap.ranks() if r != self.rank]
+
+    def peon_ranks(self) -> list[int]:
+        return [r for r in self.quorum if r != self.rank]
+
+    def rank_of(self, entity_name: str) -> int | None:
+        if not entity_name.startswith("mon."):
+            return None
+        return self.monmap.rank_of(entity_name[4:])
+
+    def set_electing(self) -> None:
+        self.state = STATE_ELECTING
+
+    def win_election(self, epoch: int, quorum: list[int]) -> None:
+        self.state = STATE_LEADER
+        self.leader_rank = self.rank
+        self.quorum = quorum
+        self.cct.dout(
+            "mon", 1, f"mon.{self.name} won election epoch {epoch}, quorum {quorum}"
+        )
+        # leader_init blocks on the collect round; run it off the elector's
+        # calling thread (often a reader holding a session lock)
+        threading.Thread(
+            target=self._leader_init_async, args=(epoch,),
+            name=f"mon.{self.name}-leader-init", daemon=True,
+        ).start()
+
+    def _leader_init_async(self, epoch: int) -> None:
+        try:
+            if self.paxos.leader_init() and self.is_leader():
+                self.osdmon.refresh()
+                self.osdmon.on_elected_leader()
+                self.publish_osdmap()
+        except Exception as e:
+            self.cct.dout("mon", 0, f"leader init failed: {e!r}")
+
+    def lose_election(self, epoch: int, leader: int, quorum: list[int]) -> None:
+        self.state = STATE_PEON
+        self.leader_rank = leader
+        self.quorum = quorum
+
+    def is_leader(self) -> bool:
+        return self.state == STATE_LEADER
+
+    def send_mon(self, rank: int, msg) -> None:
+        """Queue a message to a peer mon; safe to call while holding any
+        subsystem lock (the sender thread does the socket work)."""
+        if hasattr(msg, "fsid"):
+            msg.fsid = self.monmap.fsid
+        self._sendq.put(("mon", rank, msg))
+
+    # -- paxos callback ----------------------------------------------------
+    def on_paxos_commit(self, version: int) -> None:
+        self.osdmon.refresh()
+        self.publish_osdmap()
+
+    # -- subscriptions -----------------------------------------------------
+    def publish_osdmap(self) -> None:
+        """Queue a push of new epochs to subscribers (runs on the sender
+        thread — callers may hold the paxos lock)."""
+        self._sendq.put(("publish",))
+
+    def _publish_osdmap_now(self) -> None:
+        cur = self.osdmon.epoch
+        if cur == 0:
+            return
+        with self._subs_lock:
+            subs = list(self._subs.items())
+        for conn, want in subs:
+            if want > cur:
+                continue
+            maps = {}
+            for e in range(want, cur + 1):
+                j = self.osdmon.get_map_json(e)
+                if j is not None:
+                    maps[str(e)] = j
+            if not maps:
+                continue
+            try:
+                conn.send_message(MOSDMapMsg(maps=maps))
+                with self._subs_lock:
+                    if conn in self._subs:
+                        self._subs[conn] = cur + 1
+            except (OSError, ConnectionError):
+                with self._subs_lock:
+                    self._subs.pop(conn, None)
+
+    # -- dispatch ----------------------------------------------------------
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, (MMonElection, MMonPaxos)):
+            # fsid fence: a zombie mon of another cluster incarnation that
+            # reconnects to a reused port must not poison elections/paxos
+            # (reference: every daemon checks the cluster fsid)
+            if msg.fsid != self.monmap.fsid:
+                return True
+        if isinstance(msg, MMonElection):
+            self.elector.handle(conn, msg)
+        elif isinstance(msg, MMonPaxos):
+            self.paxos.handle(conn, msg)
+        elif isinstance(msg, MMonCommand):
+            self._handle_command(conn, msg)
+        elif isinstance(msg, MMonSubscribe):
+            self._handle_subscribe(conn, msg)
+        elif isinstance(msg, MOSDBoot):
+            if self.is_leader():
+                self.osdmon.handle_boot(msg.osd, (msg.host, msg.port))
+            else:
+                self._forward_to_leader(msg)
+        elif isinstance(msg, MOSDFailure):
+            # pin the original reporter before any peon→leader forward so
+            # corroboration counts distinct OSDs, not forwarding mons
+            if not msg.reporter:
+                msg.reporter = msg.src
+            if self.is_leader():
+                self.osdmon.handle_failure(msg.target, msg.reporter)
+            else:
+                self._forward_to_leader(msg)
+        elif isinstance(msg, MOSDAlive):
+            self.osdmon.handle_alive(msg.target, msg.src)
+        elif isinstance(msg, MPing):
+            pass
+        else:
+            return False
+        return True
+
+    def _forward_to_leader(self, msg) -> None:
+        """Peons route daemon reports to the leader (reference: Monitor
+        forward_request_leader).  Payload fields carry everything the
+        OSDMonitor needs (incl. MOSDFailure.reporter, pinned above), so a
+        fresh message with copied fields is a faithful forward."""
+        if self.leader_rank is None or self.leader_rank == self.rank:
+            return
+        fresh = type(msg)(**{f: getattr(msg, f) for f in msg.FIELDS})
+        self.send_mon(self.leader_rank, fresh)
+
+    def ms_handle_reset(self, conn) -> None:
+        with self._subs_lock:
+            self._subs.pop(conn, None)
+
+    def _handle_subscribe(self, conn, msg: MMonSubscribe) -> None:
+        what = msg.what or {}
+        if "osdmap" in what:
+            with self._subs_lock:
+                self._subs[conn] = int(what["osdmap"]) or 1
+            self.publish_osdmap()
+
+    # -- commands ----------------------------------------------------------
+    def _handle_command(self, conn, msg: MMonCommand) -> None:
+        cmd = msg.cmd or {}
+        prefix = cmd.get("prefix", "")
+        key = (msg.src, msg.tid)
+        with self._cmd_lock:
+            done = self._cmd_results.get(key)
+            if done is None and key in self._cmd_inflight:
+                return  # retry of a command still executing; first ack wins
+            if done is None:
+                self._cmd_inflight.add(key)
+        if done is not None:
+            try:
+                conn.send_message(
+                    MMonCommandAck(tid=msg.tid, retval=done[0], result=done[1])
+                )
+            except (OSError, ConnectionError):
+                pass
+            return
+        # answerable by any mon, quorum or not
+        if prefix == "mon stat":
+            retval, result = 0, {
+                "name": self.name, "rank": self.rank, "state": self.state,
+                "leader": self.leader_rank, "quorum": self.quorum,
+                "monmap": {
+                    n: list(a) for n, a in self.monmap.addrs.items()
+                },
+            }
+        elif not self.is_leader():
+            retval, result = -307, {
+                "error": "not leader",
+                "leader": self.leader_rank,
+                "leader_addr": (
+                    list(self.monmap.addr_of(self.leader_rank))
+                    if self.leader_rank is not None else None
+                ),
+            }
+        elif prefix in ("status", "health"):
+            retval, result = 0, self._status()
+        elif self.osdmon.osdmap is None:
+            # elected but the initial map hasn't committed yet
+            retval, result = -11, "cluster still forming, retry"
+        else:
+            try:
+                retval, result = self.osdmon.handle_command(cmd)
+            except Exception as e:
+                self.cct.dout("mon", 0, f"command {prefix!r} failed: {e!r}")
+                retval, result = -22, f"command failed: {e}"
+        with self._cmd_lock:
+            self._cmd_inflight.discard(key)
+            # transient NACKs aren't final results; let retries re-run
+            if retval not in (-307, -11):
+                self._cmd_results[key] = (retval, result)
+                while len(self._cmd_results) > 256:
+                    self._cmd_results.pop(next(iter(self._cmd_results)))
+        try:
+            conn.send_message(
+                MMonCommandAck(tid=msg.tid, retval=retval, result=result)
+            )
+        except (OSError, ConnectionError):
+            pass
+
+    def _status(self) -> dict:
+        """reference: `ceph -s` (src/mon/Monitor.cc get_cluster_status +
+        health checks from src/mon/health_check.h)."""
+        osd = self.osdmon._stat()
+        checks = {}
+        m = self.osdmon.osdmap
+        if m is not None:
+            down = [
+                o for o in range(m.max_osd)
+                if m.exists(o) and not m.is_up(o)
+            ]
+            if down:
+                checks["OSD_DOWN"] = {
+                    "severity": "HEALTH_WARN",
+                    "message": f"{len(down)} osds down",
+                    "osds": down,
+                }
+            if m.flags & {"noout", "nodown", "noup"}:
+                checks["OSDMAP_FLAGS"] = {
+                    "severity": "HEALTH_WARN",
+                    "message": f"flags {sorted(m.flags)} set",
+                }
+        return {
+            "health": {
+                "status": "HEALTH_WARN" if checks else "HEALTH_OK",
+                "checks": checks,
+            },
+            "quorum": self.quorum,
+            "leader": self.leader_rank,
+            "osdmap": osd,
+            "paxos": {
+                "version": self.paxos.last_committed,
+                "pn": self.paxos.accepted_pn,
+            },
+        }

@@ -1,0 +1,322 @@
+"""OSDMonitor — the OSDMap authority (reference: src/mon/OSDMonitor.{h,cc};
+SURVEY.md §2.5).
+
+All OSDMap mutations funnel through here on the leader: a pending copy of
+the map is mutated, bumped one epoch, and proposed through Paxos as the
+store write `osdmap:<epoch>`; on commit every mon reloads and the leader
+pushes the new epoch to subscribers.  Key reference behaviors mirrored:
+
+- `osd erasure-code-profile set` validates by INSTANTIATING the codec via
+  the ErasureCodePluginRegistry — exactly the seam where `plugin=jax` gets
+  vetted (reference: OSDMonitor::crush_rule_create_erasure path).
+- `osd pool create ... erasure <profile>` synthesizes the EC CRUSH rule
+  (indep, k+m replicas) from the profile's failure domain.
+- MOSDFailure reports are corroborated (`mon_osd_min_down_reporters`
+  distinct reporters) before marking down; down OSDs go out after
+  `mon_osd_down_out_interval` unless `noout` is set (reference: §5.3).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+from ..crush import add_simple_rule
+from ..ec.interface import InvalidProfile
+from ..ec.registry import ErasureCodePluginRegistry
+from ..osd.osdmap import OSDMap, PG_POOL_ERASURE, PG_POOL_REPLICATED
+
+_K_LAST_OSDMAP = "osdmap:last"
+
+
+def _map_key(epoch: int) -> str:
+    return f"osdmap:{epoch:010d}"
+
+
+class OSDMonitor:
+    def __init__(self, mon, initial_map: OSDMap | None = None):
+        self.mon = mon
+        self.osdmap: OSDMap | None = None
+        # failure corroboration state (leader-local, reference:
+        # OSDMonitor::failure_info)
+        self._failure_reporters: dict[int, set[str]] = {}
+        self._down_stamp: dict[int, float] = {}
+        self.refresh()
+        if self.osdmap is None and initial_map is not None and mon.rank == 0:
+            self._initial = initial_map
+        else:
+            self._initial = None
+
+    # -- store sync --------------------------------------------------------
+    def refresh(self) -> None:
+        """Reload the latest committed map (reference:
+        OSDMonitor::update_from_paxos)."""
+        raw = self.mon.store.get(_K_LAST_OSDMAP)
+        if raw is None:
+            return
+        epoch = int(raw)
+        map_raw = self.mon.store.get(_map_key(epoch))
+        if map_raw is not None:
+            self.osdmap = OSDMap.from_json(json.loads(map_raw.decode()))
+
+    def on_elected_leader(self) -> None:
+        """First leader seeds the initial map (vstart hands it in)."""
+        if self.osdmap is None and self._initial is not None:
+            self._propose_map(self._initial)
+
+    def get_map_json(self, epoch: int) -> dict | None:
+        raw = self.mon.store.get(_map_key(epoch))
+        return json.loads(raw.decode()) if raw is not None else None
+
+    @property
+    def epoch(self) -> int:
+        return self.osdmap.epoch if self.osdmap is not None else 0
+
+    # -- mutation plumbing -------------------------------------------------
+    def _pending(self) -> OSDMap:
+        if self.osdmap is None:
+            raise RuntimeError("no osdmap committed yet")
+        return OSDMap.from_json(self.osdmap.to_json())
+
+    def _propose_map(self, new_map: OSDMap) -> bool:
+        new_map.epoch = max(new_map.epoch, self.epoch + 1)
+        blob = json.dumps(new_map.to_json()).encode()
+        ops = [
+            (1, _map_key(new_map.epoch), blob),
+            (1, _K_LAST_OSDMAP, str(new_map.epoch).encode()),
+        ]
+        ok = self.mon.paxos.propose(ops)
+        if ok:
+            self.mon.publish_osdmap()
+        return ok
+
+    # -- boot / failure (reference: §3.4, §5.3) ---------------------------
+    def handle_boot(self, osd: int, addr: tuple[str, int]) -> bool:
+        m = self._pending()
+        if not (0 <= osd < m.max_osd):
+            return False
+        m.mark_up(osd)
+        m.osd_addrs[osd] = addr
+        self._failure_reporters.pop(osd, None)
+        self._down_stamp.pop(osd, None)
+        return self._propose_map(m)
+
+    def handle_failure(self, target: int, reporter: str) -> bool:
+        """Corroborated failure reports → down (reference:
+        OSDMonitor::prepare_failure)."""
+        if self.osdmap is None or not self.osdmap.is_up(target):
+            return False
+        if "nodown" in self.osdmap.flags:
+            return False
+        reporters = self._failure_reporters.setdefault(target, set())
+        reporters.add(reporter)
+        needed = self.mon.cct.conf.get("mon_osd_min_down_reporters")
+        if len(reporters) < needed:
+            return False
+        m = self._pending()
+        m.mark_down(target)
+        del self._failure_reporters[target]
+        self._down_stamp[target] = time.monotonic()
+        return self._propose_map(m)
+
+    def handle_alive(self, target: int, reporter: str) -> None:
+        reporters = self._failure_reporters.get(target)
+        if reporters:
+            reporters.discard(reporter)
+
+    def tick(self) -> None:
+        """down → out after the grace (reference: mon_osd_down_out_interval
+        in OSDMonitor::tick)."""
+        if self.osdmap is None or not self.mon.is_leader():
+            return
+        if "noout" in self.osdmap.flags:
+            return
+        grace = self.mon.cct.conf.get("mon_osd_down_out_interval")
+        now = time.monotonic()
+        to_out = [
+            o for o, t in self._down_stamp.items()
+            if now - t >= grace and self.osdmap.osd_weight[o] != 0
+            and not self.osdmap.is_up(o)
+        ]
+        if not to_out:
+            return
+        m = self._pending()
+        for o in to_out:
+            m.mark_out(o)
+            del self._down_stamp[o]
+        self._propose_map(m)
+
+    # -- commands ----------------------------------------------------------
+    def handle_command(self, cmd: dict) -> tuple[int, object]:
+        """Returns (retval, result) — retval 0 on success (reference:
+        OSDMonitor::prepare_command)."""
+        prefix = cmd.get("prefix", "")
+        if prefix == "osd dump":
+            return 0, self.osdmap.to_json() if self.osdmap else {}
+        if prefix == "osd stat":
+            return 0, self._stat()
+        if prefix == "osd erasure-code-profile set":
+            return self._cmd_profile_set(cmd)
+        if prefix == "osd erasure-code-profile get":
+            name = cmd.get("name", "")
+            prof = (self.osdmap.ec_profiles if self.osdmap else {}).get(name)
+            return (0, prof) if prof is not None else (-2, f"no profile {name!r}")
+        if prefix == "osd erasure-code-profile ls":
+            return 0, sorted(self.osdmap.ec_profiles) if self.osdmap else []
+        if prefix == "osd pool create":
+            return self._cmd_pool_create(cmd)
+        if prefix == "osd pool ls":
+            if not self.osdmap:
+                return 0, []
+            if cmd.get("detail"):
+                return 0, [vars(p) for p in self.osdmap.pools.values()]
+            return 0, [p.name for p in self.osdmap.pools.values()]
+        if prefix in ("osd down", "osd out", "osd in"):
+            return self._cmd_osd_state(prefix.split()[1], cmd)
+        if prefix in ("osd set", "osd unset"):
+            flag = cmd.get("key", "")
+            if flag not in ("noout", "nodown", "noup"):
+                return -22, f"unknown flag {flag!r}"
+            m = self._pending()
+            (m.flags.add if prefix == "osd set" else m.flags.discard)(flag)
+            return (0, f"{flag} {'set' if prefix == 'osd set' else 'unset'}") \
+                if self._propose_map(m) else (-110, "proposal timed out")
+        if prefix == "osd pg-upmap-items":
+            return self._cmd_upmap_items(cmd)
+        return -22, f"unknown command {prefix!r}"
+
+    def _stat(self) -> dict:
+        m = self.osdmap
+        if m is None:
+            return {"num_osds": 0}
+        up = sum(1 for o in range(m.max_osd) if m.is_up(o))
+        inn = sum(1 for o in range(m.max_osd) if m.osd_weight[o] != 0)
+        return {
+            "epoch": m.epoch, "num_osds": m.max_osd, "num_up_osds": up,
+            "num_in_osds": inn, "flags": sorted(m.flags),
+        }
+
+    def _cmd_profile_set(self, cmd: dict) -> tuple[int, object]:
+        name = cmd.get("name")
+        if not name:
+            return -22, "profile name required"
+        profile = dict(cmd.get("profile", {}))
+        profile.setdefault("plugin", "jax")
+        # validation = instantiation through the registry, the reference's
+        # exact mechanism (OSDMonitor validating plugin=jax end to end)
+        try:
+            codec = ErasureCodePluginRegistry.instance().factory(profile)
+        except InvalidProfile as e:
+            return -22, str(e)
+        m = self._pending()
+        if name in m.ec_profiles and m.ec_profiles[name] != profile:
+            in_use = any(p.ec_profile == name for p in m.pools.values())
+            if in_use and not cmd.get("force"):
+                return -1, f"profile {name!r} is in use; --force to override"
+        m.ec_profiles[name] = profile
+        if not self._propose_map(m):
+            return -110, "proposal timed out"
+        return 0, {
+            "name": name, "profile": profile,
+            "k": codec.get_data_chunk_count(),
+            "m": codec.get_chunk_count() - codec.get_data_chunk_count(),
+        }
+
+    def _cmd_pool_create(self, cmd: dict) -> tuple[int, object]:
+        name = cmd.get("name")
+        if not name:
+            return -22, "pool name required"
+        m = self._pending()
+        if any(p.name == name for p in m.pools.values()):
+            return -17, f"pool {name!r} already exists"
+        pg_num = int(cmd.get("pg_num") or self.mon.cct.conf.get("osd_pool_default_pg_num"))
+        pool_id = max(m.pools, default=0) + 1
+        kind = cmd.get("pool_type", "replicated")
+        # pg-per-osd sanity (reference: mon_max_pg_per_osd check)
+        up = sum(1 for o in range(m.max_osd) if m.is_up(o)) or 1
+        total_pgs = sum(p.pg_num * p.size for p in m.pools.values())
+        limit = self.mon.cct.conf.get("mon_max_pg_per_osd")
+        if kind == "erasure":
+            prof_name = cmd.get("erasure_code_profile", "default")
+            profile = m.ec_profiles.get(prof_name)
+            if profile is None:
+                return -2, f"no erasure-code profile {prof_name!r}"
+            try:
+                codec = ErasureCodePluginRegistry.instance().factory(profile)
+            except InvalidProfile as e:
+                return -22, str(e)
+            size = codec.get_chunk_count()
+            if (total_pgs + pg_num * size) / up > limit:
+                return -34, f"would exceed mon_max_pg_per_osd {limit}"
+            # EC crush rule: indep over the profile's failure domain
+            # (reference: OSDMonitor::crush_rule_create_erasure)
+            rule_id = self._create_rule(
+                m, f"{name}_rule",
+                profile.get("crush-failure-domain", "host"),
+                firstn=False,
+            )
+            pool = m.create_pool(
+                pool_id, pg_num=pg_num, size=size, crush_rule=rule_id,
+                type=PG_POOL_ERASURE, name=name, ec_profile=prof_name,
+            )
+        else:
+            size = int(cmd.get("size") or self.mon.cct.conf.get("osd_pool_default_size"))
+            if (total_pgs + pg_num * size) / up > limit:
+                return -34, f"would exceed mon_max_pg_per_osd {limit}"
+            rule_id = self._create_rule(
+                m, f"{name}_rule", cmd.get("crush_failure_domain", "host"),
+                firstn=True,
+            )
+            pool = m.create_pool(
+                pool_id, pg_num=pg_num, size=size, crush_rule=rule_id,
+                type=PG_POOL_REPLICATED, name=name,
+            )
+        if not self._propose_map(m):
+            return -110, "proposal timed out"
+        return 0, {"pool_id": pool.pool_id, "name": name, "size": size,
+                   "pg_num": pg_num, "crush_rule": rule_id}
+
+    def _create_rule(self, m: OSDMap, name: str, failure_domain: str,
+                     firstn: bool) -> int:
+        # reuse an existing rule with identical shape if one exists
+        rule_id = max(m.crush.map.rules, default=-1) + 1
+        try:
+            ftype = m.crush.type_id(failure_domain)
+        except KeyError:
+            ftype = 1  # host
+        add_simple_rule(m.crush.map, -1, ftype, rule_id=rule_id, firstn=firstn)
+        m.crush.invalidate()
+        return rule_id
+
+    def _cmd_osd_state(self, action: str, cmd: dict) -> tuple[int, object]:
+        osd = cmd.get("id")
+        if osd is None or not (0 <= int(osd) < (self.osdmap.max_osd if self.osdmap else 0)):
+            return -22, f"bad osd id {osd!r}"
+        osd = int(osd)
+        m = self._pending()
+        if action == "down":
+            m.mark_down(osd)
+            self._down_stamp[osd] = time.monotonic()
+        elif action == "out":
+            m.mark_out(osd)
+        else:
+            m.mark_in(osd)
+        if not self._propose_map(m):
+            return -110, "proposal timed out"
+        return 0, f"marked {action} osd.{osd}"
+
+    def _cmd_upmap_items(self, cmd: dict) -> tuple[int, object]:
+        try:
+            pool_id, ps = int(cmd["pool"]), int(cmd["ps"])
+            pairs = [(int(a), int(b)) for a, b in cmd["mappings"]]
+        except (KeyError, TypeError, ValueError) as e:
+            return -22, f"bad pg-upmap-items args: {e}"
+        m = self._pending()
+        if pool_id not in m.pools:
+            return -2, f"no pool {pool_id}"
+        if pairs:
+            m.pg_upmap_items[(pool_id, ps)] = pairs
+        else:
+            m.pg_upmap_items.pop((pool_id, ps), None)
+        if not self._propose_map(m):
+            return -110, "proposal timed out"
+        return 0, f"set {len(pairs)} upmap items on {pool_id}.{ps:x}"

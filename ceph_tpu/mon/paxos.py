@@ -1,0 +1,296 @@
+"""Single-decree Paxos replicating the mon KV store (reference:
+src/mon/Paxos.{h,cc}; SURVEY.md §2.5 "Paxos: single-decree Paxos
+replicating MonitorDBStore").
+
+One value is chosen at a time (version = last_committed + 1); a value is a
+KV batch (JSON: {"ops": [[op, key, value_b64], ...]}) applied to the mon
+store on commit.  Phases map to the reference's:
+
+    leader_init → collect(pn) → peons reply last(pn, lc, uncommitted)
+    propose     → begin(pn, v, value) → peons accept → commit broadcast
+
+Recovery matches the reference's semantics: a collect learns any value
+accepted under an older pn and re-proposes it; peons that fall behind ask
+for a sync of missed commits (op=sync_req) instead of accepting a gap.
+Leases are simplified away: reads are served by the leader only, and a
+quorum change always runs a fresh collect.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import threading
+
+from ..store.kv import Batch
+from .messages import MMonPaxos
+
+
+def _reply(conn, msg, fsid=None) -> None:
+    if fsid is not None:
+        msg.fsid = fsid
+    try:
+        conn.send_message(msg)
+    except (OSError, ConnectionError):
+        pass  # peer reset; election/timeout machinery recovers
+
+
+_K_LAST = "paxos:last_committed"
+_K_PN = "paxos:accepted_pn"
+_K_UNCOMMITTED = "paxos:uncommitted"
+
+
+def _txn_key(version: int) -> str:
+    return f"paxos:txn:{version:012d}"
+
+
+def encode_value(ops: list[tuple[int, str, bytes]]) -> str:
+    return json.dumps(
+        {"ops": [[op, key, base64.b64encode(val).decode()] for op, key, val in ops]}
+    )
+
+
+def decode_value(value: str) -> list[tuple[int, str, bytes]]:
+    return [
+        (op, key, base64.b64decode(val))
+        for op, key, val in json.loads(value)["ops"]
+    ]
+
+
+class Paxos:
+    """Runs inside a Monitor; the monitor routes MMonPaxos to handle()."""
+
+    def __init__(self, mon, store):
+        self.mon = mon  # provides rank, quorum, peon_ranks, send_mon, on_paxos_commit
+        self.store = store
+        self.last_committed = int(store.get(_K_LAST) or b"0")
+        self.accepted_pn = int(store.get(_K_PN) or b"0")
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        # leader state
+        self.pn = 0
+        self._collect_acks: set[int] = set()
+        self._accept_acks: set[int] = set()
+        self._proposing = False
+        self._learned: dict[int, tuple[int, str]] = {}  # rank -> (v, value)
+
+    # -- helpers ----------------------------------------------------------
+    def _apply(self, version: int, value: str) -> None:
+        batch = Batch()
+        for op, key, val in decode_value(value):
+            if op == 1:
+                batch.set(key, val)
+            else:
+                batch.rm(key)
+        batch.set(_txn_key(version), value.encode())
+        batch.set(_K_LAST, str(version).encode())
+        batch.rm(_K_UNCOMMITTED)
+        self.store.submit_batch(batch)
+        self.last_committed = version
+        self.mon.on_paxos_commit(version)
+
+    def _uncommitted(self) -> tuple[int, str] | None:
+        raw = self.store.get(_K_UNCOMMITTED)
+        if not raw:
+            return None
+        d = json.loads(raw.decode())
+        return d["version"], d["value"]
+
+    def _store_uncommitted(self, version: int, value: str) -> None:
+        self.store.set(
+            _K_UNCOMMITTED,
+            json.dumps({"version": version, "value": value}).encode(),
+        )
+
+    # -- leader: recovery round -------------------------------------------
+    def leader_init(self, timeout: float = 5.0) -> bool:
+        """Collect phase after winning an election (reference:
+        Paxos::leader_init + collect)."""
+        with self._lock:
+            self.pn = (self.accepted_pn // 100 + 1) * 100 + self.mon.rank
+            self.accepted_pn = self.pn
+            self.store.set(_K_PN, str(self.pn).encode())
+            self._collect_acks = {self.mon.rank}
+            self._learned = {}
+            peons = self.mon.peon_ranks()
+            for r in peons:
+                self.mon.send_mon(
+                    r,
+                    MMonPaxos(
+                        op="collect", pn=self.pn,
+                        last_committed=self.last_committed,
+                    ),
+                )
+            ok = self._cond.wait_for(
+                lambda: len(self._collect_acks) >= self.mon.majority(),
+                timeout=timeout,
+            )
+            if not ok:
+                return False
+            # adopt any value accepted under an older pn (highest wins),
+            # then re-propose it under our pn (reference: the collect's
+            # uncommitted handling)
+            best = self._uncommitted()
+            for v, value in self._learned.values():
+                if v == self.last_committed + 1 and (
+                    best is None or v >= best[0]
+                ):
+                    best = (v, value)
+        if best is not None and best[0] == self.last_committed + 1:
+            self._propose_locked_value(best[1])
+        return True
+
+    # -- leader: proposal --------------------------------------------------
+    def propose(self, ops: list[tuple[int, str, bytes]], timeout: float = 5.0) -> bool:
+        """Replicate one KV batch; blocks until commit or timeout.
+        (reference: Paxos::propose_pending / begin)"""
+        return self._propose_locked_value(encode_value(ops), timeout)
+
+    def _propose_locked_value(self, value: str, timeout: float = 5.0) -> bool:
+        with self._lock:
+            # serialize proposals (reference: one in-flight proposal)
+            ok = self._cond.wait_for(lambda: not self._proposing, timeout=timeout)
+            if not ok:
+                return False
+            self._proposing = True
+            try:
+                version = self.last_committed + 1
+                self._store_uncommitted(version, value)
+                self._accept_acks = {self.mon.rank}
+                self._propose_version = version
+                for r in self.mon.peon_ranks():
+                    self.mon.send_mon(
+                        r,
+                        MMonPaxos(
+                            op="begin", pn=self.pn, version=version, value=value,
+                        ),
+                    )
+                ok = self._cond.wait_for(
+                    lambda: len(self._accept_acks) >= self.mon.majority(),
+                    timeout=timeout,
+                )
+                if not ok:
+                    return False
+                self._apply(version, value)
+                for r in self.mon.peon_ranks():
+                    self.mon.send_mon(
+                        r, MMonPaxos(op="commit", version=version, value=value)
+                    )
+                return True
+            finally:
+                self._proposing = False
+                self._cond.notify_all()
+
+    # -- message handling (both roles) ------------------------------------
+    def handle(self, conn, msg: MMonPaxos) -> None:
+        op = msg.op
+        if op == "collect":
+            self._handle_collect(conn, msg)
+        elif op == "last":
+            self._handle_last(msg)
+        elif op == "begin":
+            self._handle_begin(conn, msg)
+        elif op == "accept":
+            self._handle_accept(msg)
+        elif op == "commit":
+            self._handle_commit(conn, msg)
+        elif op == "sync_req":
+            self._handle_sync_req(conn, msg)
+
+    def _handle_collect(self, conn, msg: MMonPaxos) -> None:
+        with self._lock:
+            if msg.pn <= self.accepted_pn:
+                return  # stale proposer; ignore (it will time out)
+            self.accepted_pn = msg.pn
+            self.store.set(_K_PN, str(msg.pn).encode())
+            unc = self._uncommitted()
+            reply = MMonPaxos(
+                op="last", pn=msg.pn, last_committed=self.last_committed,
+                uncommitted=(
+                    {"version": unc[0], "value": unc[1]} if unc else None
+                ),
+            )
+            # share commits the new leader is missing (reference: the
+            # collect handler sending committed versions)
+            missing = {}
+            for v in range(msg.last_committed + 1, self.last_committed + 1):
+                raw = self.store.get(_txn_key(v))
+                if raw is not None:
+                    missing[str(v)] = raw.decode()
+            reply.value = missing or None
+        _reply(conn, reply, self.mon.monmap.fsid)
+
+    def _handle_last(self, msg: MMonPaxos) -> None:
+        with self._lock:
+            if msg.pn != self.pn:
+                return
+            # absorb commits we missed while not leader
+            if msg.value:
+                for v_str in sorted(msg.value, key=int):
+                    v = int(v_str)
+                    if v == self.last_committed + 1:
+                        self._apply(v, msg.value[v_str])
+            rank = self.mon.rank_of(msg.src)
+            if msg.uncommitted and rank is not None:
+                self._learned[rank] = (
+                    msg.uncommitted["version"], msg.uncommitted["value"],
+                )
+            if rank is not None:
+                self._collect_acks.add(rank)
+            self._cond.notify_all()
+
+    def _handle_begin(self, conn, msg: MMonPaxos) -> None:
+        with self._lock:
+            if msg.pn < self.accepted_pn:
+                return
+            if msg.version != self.last_committed + 1:
+                # we're behind: ask for the missed commits instead of
+                # accepting a gap
+                _reply(
+                    conn,
+                    MMonPaxos(op="sync_req", last_committed=self.last_committed),
+                    self.mon.monmap.fsid,
+                )
+                return
+            self.accepted_pn = msg.pn
+            self._store_uncommitted(msg.version, msg.value)
+        _reply(
+            conn,
+            MMonPaxos(op="accept", pn=msg.pn, version=msg.version),
+            self.mon.monmap.fsid,
+        )
+
+    def _handle_accept(self, msg: MMonPaxos) -> None:
+        with self._lock:
+            # version must match too: a late ack for an earlier proposal
+            # under the same pn must not count toward the current one
+            if msg.pn != self.pn or msg.version != getattr(self, "_propose_version", None):
+                return
+            rank = self.mon.rank_of(msg.src)
+            if rank is not None:
+                self._accept_acks.add(rank)
+            self._cond.notify_all()
+
+    def _handle_commit(self, conn, msg: MMonPaxos) -> None:
+        with self._lock:
+            if msg.version == self.last_committed + 1:
+                self._apply(msg.version, msg.value)
+            elif msg.version > self.last_committed:
+                _reply(
+                    conn,
+                    MMonPaxos(op="sync_req", last_committed=self.last_committed),
+                    self.mon.monmap.fsid,
+                )
+
+    def _handle_sync_req(self, conn, msg: MMonPaxos) -> None:
+        with self._lock:
+            versions = range(msg.last_committed + 1, self.last_committed + 1)
+            txns = [
+                (v, self.store.get(_txn_key(v))) for v in versions
+            ]
+        for v, raw in txns:
+            if raw is not None:
+                _reply(
+                    conn,
+                    MMonPaxos(op="commit", version=v, value=raw.decode()),
+                    self.mon.monmap.fsid,
+                )

@@ -289,6 +289,8 @@ class Messenger:
         outside the messenger lock; a lost creation race closes the extra
         socket and returns the winner."""
         addr = (addr[0], addr[1])
+        if self._stopped:
+            raise ConnectionError(f"messenger {self.name} is shut down")
         with self._lock:
             conn = self._conns.get(addr)
             if conn is not None and conn.is_connected:

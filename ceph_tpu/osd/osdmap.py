@@ -120,6 +120,15 @@ class OSDMap:
         # acting-set overrides (reference: OSDMap pg_temp / primary_temp)
         self.pg_temp: dict[tuple[int, int], list[int]] = {}
         self.primary_temp: dict[tuple[int, int], int] = {}
+        # osd -> (host, port) messenger address (reference: OSDMap
+        # osd_addrs — how clients locate a mapped OSD)
+        self.osd_addrs: dict[int, tuple[str, int]] = {}
+        # cluster-wide flags, e.g. "noout"/"nodown" (reference: OSDMap
+        # get_flags / CEPH_OSDMAP_NOOUT)
+        self.flags: set[str] = set()
+        # EC profiles live in the OSDMap, not daemon config (reference:
+        # OSDMap::erasure_code_profiles; SURVEY.md §5.6)
+        self.ec_profiles: dict[str, dict] = {}
 
     # -- state management --------------------------------------------------
     def create_pool(
@@ -363,6 +372,12 @@ class OSDMap:
                 {"pool": k[0], "ps": k[1], "osd": v}
                 for k, v in self.primary_temp.items()
             ],
+            "osd_addrs": [
+                {"osd": o, "host": a[0], "port": a[1]}
+                for o, a in self.osd_addrs.items()
+            ],
+            "flags": sorted(self.flags),
+            "ec_profiles": self.ec_profiles,
         }
 
     @classmethod
@@ -384,4 +399,8 @@ class OSDMap:
             m.pg_temp[(e["pool"], e["ps"])] = list(e["osds"])
         for e in d.get("primary_temp", []):
             m.primary_temp[(e["pool"], e["ps"])] = e["osd"]
+        for e in d.get("osd_addrs", []):
+            m.osd_addrs[e["osd"]] = (e["host"], e["port"])
+        m.flags = set(d.get("flags", []))
+        m.ec_profiles = dict(d.get("ec_profiles", {}))
         return m

@@ -46,6 +46,44 @@ class KeyValueDB:
         raise NotImplementedError
 
 
+class MemKV(KeyValueDB):
+    """In-RAM KV for disk-less daemons in tests (MemStore's analog at the
+    KV layer)."""
+
+    def __init__(self):
+        self._map: dict[str, bytes] = {}
+        self._lock = RLock()
+
+    def submit_batch(self, ops, sync: bool = False) -> None:
+        if isinstance(ops, Batch):
+            ops = ops.ops
+        with self._lock:
+            for op, key, value in ops:
+                if op == _OP_SET:
+                    self._map[key] = bytes(value)
+                else:
+                    self._map.pop(key, None)
+
+    def get(self, key: str) -> bytes | None:
+        with self._lock:
+            return self._map.get(key)
+
+    def iterate(self, prefix: str = ""):
+        with self._lock:
+            keys = sorted(k for k in self._map if k.startswith(prefix))
+        for k in keys:
+            v = self.get(k)
+            if v is not None:
+                yield k, v
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+    def close(self) -> None:
+        pass
+
+
 class Batch:
     """Write batch builder (reference: KeyValueDB::Transaction)."""
 

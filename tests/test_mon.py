@@ -1,0 +1,346 @@
+"""Monitor tests (reference behaviors: src/mon — elections, Paxos
+replication, OSDMonitor command handling, failure corroboration;
+SURVEY.md §2.5, §5.3).  Single-host multi-daemon, ring-2 style.
+"""
+import socket
+import time
+
+import pytest
+
+from ceph_tpu.common import CephContext
+from ceph_tpu.crush import build_hierarchical_map, CrushWrapper
+from ceph_tpu.mon import MonClient, MonMap, Monitor
+from ceph_tpu.osd.osdmap import OSDMap, PG_POOL_ERASURE
+
+
+def free_addrs(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    addrs = [s.getsockname() for s in socks]
+    for s in socks:
+        s.close()
+    return addrs
+
+
+def initial_map(num_osd=8, hosts=4):
+    return OSDMap(
+        CrushWrapper(build_hierarchical_map(hosts, num_osd // hosts))
+    )
+
+
+def make_cluster(n_mons=1, num_osd=8, overrides=None):
+    addrs = free_addrs(n_mons)
+    names = "abcde"[:n_mons]
+    monmap = MonMap({names[i]: addrs[i] for i in range(n_mons)})
+    mons = []
+    for i in range(n_mons):
+        cct = CephContext(f"mon.{names[i]}", overrides=overrides or {})
+        mon = Monitor(cct, names[i], monmap, initial_osdmap=initial_map(num_osd))
+        mons.append(mon)
+    for m in mons:
+        m.start()
+    return monmap, mons
+
+
+def wait_for(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def cluster1():
+    monmap, mons = make_cluster(1)
+    cct = CephContext("client.admin")
+    client = MonClient(cct, list(monmap.addrs.values()))
+    yield monmap, mons, client
+    client.shutdown()
+    for m in mons:
+        m.shutdown()
+
+
+@pytest.fixture
+def cluster3():
+    monmap, mons = make_cluster(3)
+    cct = CephContext("client.admin")
+    client = MonClient(cct, list(monmap.addrs.values()))
+    yield monmap, mons, client
+    client.shutdown()
+    for m in mons:
+        m.shutdown()
+
+
+class TestSingleMon:
+    def test_election_and_initial_map(self, cluster1):
+        _, mons, client = cluster1
+        assert wait_for(lambda: mons[0].is_leader())
+        assert wait_for(lambda: mons[0].osdmon.epoch >= 1)
+        rv, stat = client.command({"prefix": "mon stat"})
+        assert rv == 0 and stat["state"] == "leader" and stat["quorum"] == [0]
+
+    def test_status_health(self, cluster1):
+        _, mons, client = cluster1
+        rv, st = client.command({"prefix": "status"})
+        assert rv == 0
+        assert st["health"]["status"] == "HEALTH_OK"
+        assert st["osdmap"]["num_osds"] == 8
+
+    def test_ec_profile_set_validates_via_registry(self, cluster1):
+        _, mons, client = cluster1
+        rv, res = client.command({
+            "prefix": "osd erasure-code-profile set", "name": "tpu84",
+            "profile": {"plugin": "jax", "technique": "cauchy_good",
+                        "k": "4", "m": "2"},
+        })
+        assert rv == 0 and res["k"] == 4 and res["m"] == 2
+        rv, res = client.command({"prefix": "osd erasure-code-profile ls"})
+        assert rv == 0 and "tpu84" in res
+        rv, res = client.command(
+            {"prefix": "osd erasure-code-profile get", "name": "tpu84"}
+        )
+        assert rv == 0 and res["plugin"] == "jax"
+        # invalid plugin is rejected by instantiation, like the reference
+        rv, res = client.command({
+            "prefix": "osd erasure-code-profile set", "name": "bad",
+            "profile": {"plugin": "nonexistent"},
+        })
+        assert rv == -22 and "nonexistent" in str(res)
+        # invalid k is caught by the codec's own validation
+        rv, res = client.command({
+            "prefix": "osd erasure-code-profile set", "name": "bad2",
+            "profile": {"plugin": "jax", "k": "0", "m": "2"},
+        })
+        assert rv == -22
+
+    def test_pool_create_replicated_and_erasure(self, cluster1):
+        _, mons, client = cluster1
+        rv, res = client.command(
+            {"prefix": "osd pool create", "name": "rbd", "pg_num": 16, "size": 3}
+        )
+        assert rv == 0 and res["size"] == 3
+        # 4+2 over failure-domain osd (only 4 hosts exist, so host-domain
+        # placement would legitimately leave holes — separate test below)
+        rv, _ = client.command({
+            "prefix": "osd erasure-code-profile set", "name": "p42",
+            "profile": {"plugin": "jax", "k": "4", "m": "2",
+                        "crush-failure-domain": "osd"},
+        })
+        assert rv == 0
+        rv, res = client.command({
+            "prefix": "osd pool create", "name": "ecpool",
+            "pool_type": "erasure", "erasure_code_profile": "p42", "pg_num": 8,
+        })
+        assert rv == 0 and res["size"] == 6  # k+m
+        rv, pools = client.command({"prefix": "osd pool ls", "detail": True})
+        assert rv == 0
+        ec = next(p for p in pools if p["name"] == "ecpool")
+        assert ec["type"] == PG_POOL_ERASURE and ec["ec_profile"] == "p42"
+        # duplicate pool name rejected
+        rv, _ = client.command(
+            {"prefix": "osd pool create", "name": "rbd", "pg_num": 4}
+        )
+        assert rv == -17
+        # the new map reaches subscribers and maps PGs over the EC rule
+        client.subscribe_osdmap()
+        m = client.wait_for_osdmap(mons[0].osdmon.epoch)
+        up, prim = m.map_pool(ec["pool_id"])
+        assert up.shape == (8, 6)
+        assert (up >= 0).all()  # all shards mapped on a healthy cluster
+
+    def test_ec_pool_host_domain_wider_than_hosts_leaves_holes(self, cluster1):
+        # an honest CRUSH behavior check: 6 shards over 4 hosts cannot all
+        # be placed with failure-domain host
+        _, mons, client = cluster1
+        rv, _ = client.command({
+            "prefix": "osd erasure-code-profile set", "name": "phost",
+            "profile": {"plugin": "jax", "k": "4", "m": "2",
+                        "crush-failure-domain": "host"},
+        })
+        assert rv == 0
+        rv, res = client.command({
+            "prefix": "osd pool create", "name": "echost",
+            "pool_type": "erasure", "erasure_code_profile": "phost",
+            "pg_num": 8,
+        })
+        assert rv == 0
+        client.subscribe_osdmap()
+        m = client.wait_for_osdmap(mons[0].osdmon.epoch)
+        up, _ = m.map_pool(res["pool_id"])
+        assert (up < 0).any()
+
+    def test_osd_down_out_and_flags(self, cluster1):
+        _, mons, client = cluster1
+        rv, _ = client.command({"prefix": "osd down", "id": 3})
+        assert rv == 0
+        rv, st = client.command({"prefix": "status"})
+        assert st["health"]["status"] == "HEALTH_WARN"
+        assert st["osdmap"]["num_up_osds"] == 7
+        rv, _ = client.command({"prefix": "osd in", "id": 3})
+        assert rv == 0
+        rv, _ = client.command({"prefix": "osd set", "key": "noout"})
+        assert rv == 0
+        rv, st = client.command({"prefix": "status"})
+        assert "OSDMAP_FLAGS" in st["health"]["checks"]
+        rv, _ = client.command({"prefix": "osd unset", "key": "noout"})
+        assert rv == 0
+
+    def test_pg_upmap_items_command(self, cluster1):
+        _, mons, client = cluster1
+        rv, res = client.command(
+            {"prefix": "osd pool create", "name": "up", "pg_num": 8, "size": 3}
+        )
+        pool_id = res["pool_id"]
+        client.subscribe_osdmap()
+        m = client.wait_for_osdmap(mons[0].osdmon.epoch)
+        up, _ = m.map_pool(pool_id)
+        frm = int(up[0][0])
+        to = next(o for o in range(8) if o not in up[0] and o // 2 != frm // 2)
+        rv, _ = client.command({
+            "prefix": "osd pg-upmap-items", "pool": pool_id, "ps": 0,
+            "mappings": [[frm, to]],
+        })
+        assert rv == 0
+        m = client.wait_for_osdmap(m.epoch + 1)
+        up2, _, _, _ = m.pg_to_up_acting_osds(pool_id, 0)
+        assert to in up2 and frm not in up2
+
+
+class TestQuorum:
+    def test_lowest_rank_wins(self, cluster3):
+        _, mons, client = cluster3
+        assert wait_for(lambda: mons[0].is_leader())
+        assert wait_for(
+            lambda: all(m.state == "peon" for m in mons[1:])
+        )
+        rv, stat = client.command({"prefix": "mon stat"})
+        assert rv == 0
+
+    def test_paxos_replicates_to_peons(self, cluster3):
+        _, mons, client = cluster3
+        assert wait_for(lambda: mons[0].is_leader())
+        rv, _ = client.command(
+            {"prefix": "osd pool create", "name": "repl", "pg_num": 8}
+        )
+        assert rv == 0
+        # every mon's store converges to the same committed map
+        assert wait_for(
+            lambda: all(
+                m.osdmon.osdmap is not None
+                and any(p.name == "repl" for p in m.osdmon.osdmap.pools.values())
+                for m in mons
+            )
+        ), [m.osdmon.epoch for m in mons]
+
+    def test_leader_failover(self, cluster3):
+        monmap, mons, client = cluster3
+        assert wait_for(lambda: mons[0].is_leader())
+        rv, _ = client.command(
+            {"prefix": "osd pool create", "name": "pre", "pg_num": 8}
+        )
+        assert rv == 0
+        epoch_before = mons[1].osdmon.epoch
+        mons[0].shutdown()
+        # surviving mons elect mon.b (rank 1) after the liveness probe fails
+        assert wait_for(lambda: mons[1].is_leader(), timeout=15), mons[1].state
+        rv, res = client.command(
+            {"prefix": "osd pool create", "name": "post", "pg_num": 8},
+            timeout=30,
+        )
+        assert rv == 0
+        assert mons[1].osdmon.epoch > epoch_before
+        assert wait_for(
+            lambda: any(
+                p.name == "post" for p in mons[2].osdmon.osdmap.pools.values()
+            )
+        )
+
+    def test_failure_reports_corroborated(self, cluster3):
+        _, mons, client = cluster3
+        assert wait_for(lambda: mons[0].is_leader())
+        assert wait_for(lambda: mons[0].osdmon.epoch >= 1)
+        # min reporters default 2: one report does nothing
+        leader = mons[0]
+        leader.osdmon.handle_failure(2, "osd.5")
+        assert leader.osdmon.osdmap.is_up(2)
+        leader.osdmon.handle_failure(2, "osd.5")  # duplicate reporter
+        assert leader.osdmon.osdmap.is_up(2)
+        leader.osdmon.handle_failure(2, "osd.6")  # second distinct
+        assert not leader.osdmon.osdmap.is_up(2)
+
+    def test_down_to_out_tick(self):
+        monmap, mons = make_cluster(
+            1, overrides={"mon_osd_down_out_interval": 0.1,
+                          "mon_osd_min_down_reporters": 1}
+        )
+        cct = CephContext("client.admin")
+        client = MonClient(cct, list(monmap.addrs.values()))
+        try:
+            assert wait_for(lambda: mons[0].is_leader())
+            mons[0].osdmon.handle_failure(4, "osd.1")
+            assert not mons[0].osdmon.osdmap.is_up(4)
+            assert mons[0].osdmon.osdmap.osd_weight[4] != 0
+            assert wait_for(
+                lambda: mons[0].osdmon.osdmap.osd_weight[4] == 0, timeout=10
+            )
+        finally:
+            client.shutdown()
+            for m in mons:
+                m.shutdown()
+
+    def test_noout_blocks_auto_out(self):
+        monmap, mons = make_cluster(
+            1, overrides={"mon_osd_down_out_interval": 0.1,
+                          "mon_osd_min_down_reporters": 1}
+        )
+        cct = CephContext("client.admin")
+        client = MonClient(cct, list(monmap.addrs.values()))
+        try:
+            assert wait_for(lambda: mons[0].is_leader())
+            rv, _ = client.command({"prefix": "osd set", "key": "noout"})
+            assert rv == 0
+            mons[0].osdmon.handle_failure(4, "osd.1")
+            time.sleep(1.0)
+            assert mons[0].osdmon.osdmap.osd_weight[4] != 0
+        finally:
+            client.shutdown()
+            for m in mons:
+                m.shutdown()
+
+
+class TestMonStorePersistence:
+    def test_mon_restart_from_logkv(self, tmp_path):
+        from ceph_tpu.store import LogKV
+
+        addrs = free_addrs(1)
+        monmap = MonMap({"a": addrs[0]})
+        cct = CephContext("mon.a")
+        store = LogKV(str(tmp_path / "mon_a"))
+        mon = Monitor(cct, "a", monmap, store=store, initial_osdmap=initial_map())
+        mon.start()
+        client = MonClient(CephContext("client.admin"), addrs)
+        rv, _ = client.command(
+            {"prefix": "osd pool create", "name": "persist", "pg_num": 8}
+        )
+        assert rv == 0
+        epoch = mon.osdmon.epoch
+        client.shutdown()
+        mon.shutdown()
+        # reopen on the same store: committed state must survive
+        store2 = LogKV(str(tmp_path / "mon_a"))
+        mon2 = Monitor(CephContext("mon.a"), "a", monmap, store=store2)
+        mon2.start()
+        client2 = MonClient(CephContext("client.admin"), addrs)
+        try:
+            assert wait_for(lambda: mon2.is_leader())
+            assert mon2.osdmon.epoch == epoch
+            rv, pools = client2.command({"prefix": "osd pool ls"})
+            assert rv == 0 and "persist" in pools
+        finally:
+            client2.shutdown()
+            mon2.shutdown()
