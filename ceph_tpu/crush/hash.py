@@ -53,6 +53,10 @@ def _mix(a, b, c):
 
 
 def _u32(x):
+    if isinstance(x, int):
+        # raw Python ints >= 2^31 would overflow jnp's int32 weak-type
+        # inference when x64 is off (the production config)
+        x = np.uint32(x & 0xFFFFFFFF)
     return jnp.asarray(x).astype(jnp.uint32)
 
 

@@ -37,9 +37,23 @@ from .hash import crush_hash32_2, crush_hash32_3
 from .ln_table import CRUSH_LN_TABLE, LN_BIAS
 from .types import ITEM_NONE, CrushMap, RuleOp
 
-# straw2 is 64-bit fixed-point integer math (SURVEY.md §7 hard parts); the
-# mapper is unusable without x64, so the package enables it on import.
-jax.config.update("jax_enable_x64", True)
+# straw2 is 64-bit fixed-point integer math (SURVEY.md §7 hard parts).  x64
+# is enabled ONLY around the CRUSH traces (enable_x64 context below) — a
+# global jax_enable_x64 flip leaks i64 into unrelated traces and breaks
+# Mosaic compilation of the Pallas GF kernel on real TPUs.
+
+
+def enable_x64():
+    """Thread-scoped x64 context (jax.experimental.enable_x64 was removed
+    in jax 0.9; the config State object is the surviving spelling)."""
+    try:
+        from jax._src.config import enable_x64 as _e
+
+        return _e(True)
+    except ImportError:  # older jax
+        from jax.experimental import enable_x64 as _e
+
+        return _e()
 
 S64_MIN = np.int64(np.iinfo(np.int64).min)
 
@@ -92,12 +106,13 @@ class CompiledCrushMap:
             weights[i, : b.size] = b.weights
             sizes[i] = b.size
             types[i] = b.type
-        self.items = jnp.asarray(items)
-        self.weights = jnp.asarray(weights)
-        self.sizes = jnp.asarray(sizes)
-        self.types = jnp.asarray(types)
+        with enable_x64():
+            self.items = jnp.asarray(items)
+            self.weights = jnp.asarray(weights)
+            self.sizes = jnp.asarray(sizes)
+            self.types = jnp.asarray(types)
+            self.ln_table = jnp.asarray(CRUSH_LN_TABLE)
         self.n_idx = n_idx
-        self.ln_table = jnp.asarray(CRUSH_LN_TABLE)
         self.max_size = max_size
         self._choose_args_cache: dict[str, jnp.ndarray] = {}
 
@@ -120,14 +135,15 @@ class CompiledCrushMap:
             for p in range(P):
                 row = ws[min(p, len(ws) - 1)]
                 dense[p, i, :size] = row
-        arr = jnp.asarray(dense)
+        with enable_x64():
+            arr = jnp.asarray(dense)
         self._choose_args_cache[name] = arr
         return arr
 
     def item_type(self, item):
         """type of an item id: devices 0, buckets their declared type."""
         idx = jnp.clip(jnp.where(item < 0, -1 - item, 0), 0, self.types.shape[0] - 1)
-        return jnp.where(item < 0, self.types[idx], 0)
+        return jnp.where(item < 0, jnp.take(self.types, idx), 0)
 
 
 def _div64_trunc(a, b):
@@ -145,13 +161,17 @@ def _straw2_choose(cm: CompiledCrushMap, bucket_idx, x, r, cweights, position):
     [P, n_idx, S] choose_args weight array; position picks the row (clamped,
     as get_choose_arg_weights does)."""
     bucket_idx = jnp.clip(bucket_idx, 0, cm.items.shape[0] - 1)
-    items = cm.items[bucket_idx]        # [S]
+    # jnp.take (gather), NOT arr[idx]: scalar dynamic indexing lowers to
+    # dynamic_slice, whose vmap batching rule BROADCASTS the whole bucket
+    # matrix per batch element — [N, n_idx, S] blew HBM at N=1M on v5e
+    items = jnp.take(cm.items, bucket_idx, axis=0)        # [S]
     if cweights is None:
-        weights = cm.weights[bucket_idx]    # [S]
+        weights = jnp.take(cm.weights, bucket_idx, axis=0)    # [S]
     else:
         pos = jnp.minimum(position, cweights.shape[0] - 1)
-        weights = cweights[pos, bucket_idx]
-    size = cm.sizes[bucket_idx]
+        flat = cweights.reshape(-1, cweights.shape[-1])
+        weights = jnp.take(flat, pos * cm.items.shape[0] + bucket_idx, axis=0)
+    size = jnp.take(cm.sizes, bucket_idx)
     u = (
         crush_hash32_3(
             jnp.uint32(x), items.astype(jnp.uint32), jnp.uint32(r)
@@ -170,7 +190,7 @@ def _is_out(weightvec, item, x):
     """mapper.c :: is_out — probabilistic reject by device reweight."""
     n = weightvec.shape[0]
     idx = jnp.clip(item, 0, n - 1)
-    w = weightvec[idx].astype(jnp.int64)
+    w = jnp.take(weightvec, idx).astype(jnp.int64)
     oob = item >= n
     h = crush_hash32_2(jnp.uint32(x), jnp.uint32(item)).astype(jnp.int64) & 0xFFFF
     return oob | (w == 0) | ((w < 0x10000) & (h >= w))
@@ -243,17 +263,19 @@ def _choose_firstn_single(
             dead = cand == ITEM_NONE
             collide = jnp.any((out == cand) & (jnp.arange(S) < outpos)) & ~dead
             if recurse:
-                leaf, leaf_ok = jax.lax.cond(
-                    (cand < 0) & ~dead & ~collide,
-                    lambda: _leaf_firstn(
-                        cm, weightvec, x, cand, r, outpos, out2, S,
-                        recurse_tries, cweights,
-                    ),
-                    lambda: (
-                        jnp.asarray(cand, jnp.int32),
-                        (cand >= 0) & ~_is_out(weightvec, cand, x),
-                    ),
+                # both paths computed + jnp.where, NOT lax.cond: a batched-
+                # predicate cond inside a while_loop makes vmap broadcast
+                # the branch constants (the whole bucket matrix) to
+                # [N, n_idx, S] — the HBM blowup found at 1M x on v5e.
+                # vmap executes both branches of a cond anyway.
+                use_leaf = (cand < 0) & ~dead & ~collide
+                leaf_r, leaf_ok_r = _leaf_firstn(
+                    cm, weightvec, x, cand, r, outpos, out2, S,
+                    recurse_tries, cweights,
                 )
+                direct_ok = (cand >= 0) & ~_is_out(weightvec, cand, x)
+                leaf = jnp.where(use_leaf, leaf_r, jnp.asarray(cand, jnp.int32))
+                leaf_ok = jnp.where(use_leaf, leaf_ok_r, direct_ok)
                 reject = ~leaf_ok
             else:
                 leaf = cand
@@ -307,34 +329,30 @@ def _choose_indep_single(
             dead = cand == ITEM_NONE
             collide = jnp.any((out == cand) & placed) & ~dead
             if recurse:
-
-                def leaf_loop():
-                    def lbody(state):
-                        lf, _, done = state
-                        leaf = _descend(
-                            cm, cand, x, rep + numrep * lf + r, 0, cweights,
-                            rep,
-                        )
-                        ok = (leaf >= 0) & ~_is_out(weightvec, leaf, x)
-                        return lf + 1, leaf, done | ok
-
-                    def lcond(state):
-                        lf, _, done = state
-                        return (~done) & (lf < recurse_tries)
-
-                    lf, leaf, ok = jax.lax.while_loop(
-                        lcond, lbody, (jnp.int32(0), jnp.int32(ITEM_NONE), False)
+                # both paths + jnp.where instead of lax.cond (see
+                # _choose_firstn_single: batched cond in a while broadcasts
+                # the bucket matrices per x)
+                def lbody(state):
+                    lf, _, done = state
+                    leaf = _descend(
+                        cm, cand, x, rep + numrep * lf + r, 0, cweights,
+                        rep,
                     )
-                    return jnp.where(ok, leaf, ITEM_NONE), ok
+                    ok = (leaf >= 0) & ~_is_out(weightvec, leaf, x)
+                    return lf + 1, leaf, done | ok
 
-                leaf, leaf_ok = jax.lax.cond(
-                    (cand < 0) & ~dead & ~collide,
-                    leaf_loop,
-                    lambda: (
-                        jnp.asarray(cand, jnp.int32),
-                        (cand >= 0) & ~_is_out(weightvec, cand, x),
-                    ),
+                def lcond(state):
+                    lf, _, done = state
+                    return (~done) & (lf < recurse_tries)
+
+                _, lleaf, lok = jax.lax.while_loop(
+                    lcond, lbody, (jnp.int32(0), jnp.int32(ITEM_NONE), False)
                 )
+                lleaf = jnp.where(lok, lleaf, ITEM_NONE)
+                use_leaf = (cand < 0) & ~dead & ~collide
+                direct_ok = (cand >= 0) & ~_is_out(weightvec, cand, x)
+                leaf = jnp.where(use_leaf, lleaf, jnp.asarray(cand, jnp.int32))
+                leaf_ok = jnp.where(use_leaf, lok, direct_ok)
                 ok = ~dead & ~collide & leaf_ok
             else:
                 leaf = cand
@@ -434,8 +452,6 @@ def crush_do_rule_batch(
     firstn results are dense with ITEM_NONE tail padding; indep results keep
     positional ITEM_NONE holes (EC shard semantics)."""
     p = compile_rule(cm, rule_id, numrep)
-    xs = jnp.asarray(xs, dtype=jnp.int32)
-    weightvec = jnp.asarray(weightvec, dtype=jnp.int64)
     cweights = (
         cm.choose_args_arrays(choose_args) if choose_args is not None else None
     )
@@ -463,4 +479,7 @@ def crush_do_rule_batch(
             res = jnp.where(jnp.arange(res.shape[0]) < cnt, res, ITEM_NONE)
         return res
 
-    return jax.jit(jax.vmap(single))(xs)
+    with enable_x64():
+        xs = jnp.asarray(xs, dtype=jnp.int32)
+        weightvec = jnp.asarray(weightvec, dtype=jnp.int64)
+        return jax.jit(jax.vmap(single))(xs)

@@ -21,4 +21,7 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402  (already imported by sitecustomize; config still mutable)
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)  # straw2 needs exact int64 (SURVEY.md §7)
+# NOTE: x64 is deliberately NOT enabled globally here.  The CRUSH mapper
+# scopes jax_enable_x64 to its own traces (crush/mapper.py enable_x64); a
+# global flip would hide exactly the class of bug that broke the Pallas
+# kernel on real TPUs in round 1 (i64 leaking into unrelated traces).
