@@ -50,16 +50,18 @@ class MMonPaxos(_JsonMessage):
 
     MSG_TYPE = 66
     FIELDS = ("op", "pn", "version", "last_committed", "value", "uncommitted",
-              "fsid")
+              "nonce", "fsid")
 
 
 @register_message
 class MMonCommand(_JsonMessage):
     """reference: MMonCommand — a `ceph` CLI command as a JSON dict with
-    `prefix` plus arguments; tid correlates the ack."""
+    `prefix` plus arguments; tid correlates the ack, and `session` is a
+    per-client random id so two processes sharing the default entity name
+    cannot collide in the monitor's command dedup cache."""
 
     MSG_TYPE = 50
-    FIELDS = ("tid", "cmd")
+    FIELDS = ("tid", "cmd", "session")
 
 
 @register_message

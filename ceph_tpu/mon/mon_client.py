@@ -33,6 +33,11 @@ class MonClient(Dispatcher):
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._tid = 0
+        # random per-process session id: part of the monitor's command
+        # dedup key so two clients with the same entity name don't collide
+        import uuid
+
+        self._session = uuid.uuid4().hex
         self._acks: dict[int, tuple[int, object]] = {}
         self.osdmap: OSDMap | None = None
         self._subscribed_from = 0
@@ -97,8 +102,9 @@ class MonClient(Dispatcher):
         attempts = 0
         addr = None
         # one tid for every attempt of this logical command: the monitor
-        # dedups on (src, tid), so a retry after a lost ack re-fetches the
-        # recorded result instead of re-executing a non-idempotent command
+        # dedups on (src, session, tid), so a retry after a lost ack
+        # re-fetches the recorded result instead of re-executing a
+        # non-idempotent command
         with self._lock:
             self._tid += 1
             tid = self._tid
@@ -106,7 +112,9 @@ class MonClient(Dispatcher):
             attempts += 1
             try:
                 conn = self._connect(addr)
-                conn.send_message(MMonCommand(tid=tid, cmd=cmd))
+                conn.send_message(
+                    MMonCommand(tid=tid, cmd=cmd, session=self._session)
+                )
             except (OSError, ConnectionError):
                 addr = None
                 continue

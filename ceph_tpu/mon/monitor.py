@@ -102,19 +102,24 @@ class Monitor(Dispatcher):
         # conn -> next osdmap epoch wanted
         self._subs: dict[object, int] = {}
         self._subs_lock = threading.Lock()
-        # (client, tid) -> completed command result, so a retried command
-        # (ack lost / slow proposal) is answered, not re-executed
-        self._cmd_results: dict[tuple[str, int], tuple[int, object]] = {}
-        self._cmd_inflight: set[tuple[str, int]] = set()
+        # (client, session, tid) -> completed command result, so a retried
+        # command (ack lost / slow proposal) is answered, not re-executed
+        self._cmd_results: dict[tuple, tuple[int, object]] = {}
+        self._cmd_inflight: set[tuple] = set()
         self._cmd_lock = threading.Lock()
-        # All cross-connection sends go through one sender thread.  Paxos
+        # All cross-connection sends go through sender threads.  Paxos
         # and elector handlers run on connection reader threads (holding
         # that connection's session lock) and take subsystem locks; if
         # those subsystems also sent directly while holding their locks,
         # the two lock orders would deadlock (session→subsystem vs
-        # subsystem→session).  Queueing breaks the cycle.
-        self._sendq: "queue.Queue[tuple | None]" = queue.Queue()
-        self._send_thread: threading.Thread | None = None
+        # subsystem→session).  Queueing breaks the cycle.  One queue+thread
+        # PER PEER (plus one for subscriber publishes): a single shared
+        # sender dialing a dead-but-not-refusing peer would stall every
+        # queued election/paxos message behind a 10 s connect timeout,
+        # livelocking quorum formation (advisor r1 finding).
+        self._sendqs: dict[object, "queue.Queue"] = {}
+        self._send_threads: list[threading.Thread] = []
+        self._sendq_lock = threading.Lock()
         self._tick_thread: threading.Thread | None = None
         self._stop_event = threading.Event()
 
@@ -125,10 +130,6 @@ class Monitor(Dispatcher):
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self.messenger.start()
-        self._send_thread = threading.Thread(
-            target=self._send_loop, name=f"mon.{self.name}-send", daemon=True
-        )
-        self._send_thread.start()
         self.elector.start_election()
         self._tick_thread = threading.Thread(
             target=self._tick_loop, name=f"mon.{self.name}-tick", daemon=True
@@ -138,29 +139,50 @@ class Monitor(Dispatcher):
     def shutdown(self) -> None:
         self._stop_event.set()
         self.elector.stop()
-        self._sendq.put(None)
+        with self._sendq_lock:
+            for q in self._sendqs.values():
+                q.put(None)
+            threads = list(self._send_threads)
         self.messenger.shutdown()
         if self._tick_thread is not None:
             self._tick_thread.join(timeout=5)
-        if self._send_thread is not None:
-            self._send_thread.join(timeout=5)
+        for t in threads:
+            t.join(timeout=5)
         close = getattr(self.store, "close", None)
         if close:
             close()
 
-    def _send_loop(self) -> None:
+    def _sendq_for(self, key) -> "queue.Queue":
+        """Per-peer (or 'publish') queue, sender thread created lazily."""
+        with self._sendq_lock:
+            q = self._sendqs.get(key)
+            if q is None:
+                q = queue.Queue()
+                if self._stopped:
+                    # racing with shutdown(): park messages in a dead queue
+                    # instead of spawning a thread nobody will ever join
+                    return q
+                self._sendqs[key] = q
+                t = threading.Thread(
+                    target=self._send_loop, args=(key, q),
+                    name=f"mon.{self.name}-send-{key}", daemon=True,
+                )
+                self._send_threads.append(t)
+                t.start()
+            return q
+
+    def _send_loop(self, key, q: "queue.Queue") -> None:
         while True:
-            item = self._sendq.get()
+            item = q.get()
             if item is None or self._stopped:
                 return
             try:
-                if item[0] == "mon":
-                    _, rank, msg = item
-                    self.messenger.connect(
-                        self.monmap.addr_of(rank)
-                    ).send_message(msg)
-                elif item[0] == "publish":
+                if key == "publish":
                     self._publish_osdmap_now()
+                else:
+                    self.messenger.connect(
+                        self.monmap.addr_of(key)
+                    ).send_message(item)
             except (OSError, ConnectionError):
                 pass  # elections / paxos timeouts handle the silence
             except Exception as e:
@@ -197,9 +219,6 @@ class Monitor(Dispatcher):
 
     def other_ranks(self) -> list[int]:
         return [r for r in self.monmap.ranks() if r != self.rank]
-
-    def peon_ranks(self) -> list[int]:
-        return [r for r in self.quorum if r != self.rank]
 
     def rank_of(self, entity_name: str) -> int | None:
         if not entity_name.startswith("mon."):
@@ -245,7 +264,7 @@ class Monitor(Dispatcher):
         subsystem lock (the sender thread does the socket work)."""
         if hasattr(msg, "fsid"):
             msg.fsid = self.monmap.fsid
-        self._sendq.put(("mon", rank, msg))
+        self._sendq_for(rank).put(msg)
 
     # -- paxos callback ----------------------------------------------------
     def on_paxos_commit(self, version: int) -> None:
@@ -256,7 +275,7 @@ class Monitor(Dispatcher):
     def publish_osdmap(self) -> None:
         """Queue a push of new epochs to subscribers (runs on the sender
         thread — callers may hold the paxos lock)."""
-        self._sendq.put(("publish",))
+        self._sendq_for("publish").put(True)
 
     def _publish_osdmap_now(self) -> None:
         cur = self.osdmon.epoch
@@ -346,7 +365,10 @@ class Monitor(Dispatcher):
     def _handle_command(self, conn, msg: MMonCommand) -> None:
         cmd = msg.cmd or {}
         prefix = cmd.get("prefix", "")
-        key = (msg.src, msg.tid)
+        # dedup key includes the per-client random session id: two client
+        # processes sharing the default entity name ('client.admin') and
+        # tid counters starting at 0 must not collide (advisor r1 finding)
+        key = (msg.src, msg.session, msg.tid)
         with self._cmd_lock:
             done = self._cmd_results.get(key)
             if done is None and key in self._cmd_inflight:

@@ -60,7 +60,7 @@ class Paxos:
     """Runs inside a Monitor; the monitor routes MMonPaxos to handle()."""
 
     def __init__(self, mon, store):
-        self.mon = mon  # provides rank, quorum, peon_ranks, send_mon, on_paxos_commit
+        self.mon = mon  # provides rank, majority, other_ranks, send_mon, on_paxos_commit
         self.store = store
         self.last_committed = int(store.get(_K_LAST) or b"0")
         self.accepted_pn = int(store.get(_K_PN) or b"0")
@@ -72,6 +72,14 @@ class Paxos:
         self._accept_acks: set[int] = set()
         self._proposing = False
         self._learned: dict[int, tuple[int, str]] = {}  # rank -> (v, value)
+        # per-proposal instance id echoed in accepts: a late accept for an
+        # aborted proposal under the same (pn, version) must not count
+        # toward a different value (advisor r1 finding)
+        self._propose_nonce = 0
+        # an aborted (timed-out) proposal may have been accepted by a
+        # minority; the next proposal must run a fresh collect under a new
+        # pn (reference: Paxos re-bootstraps) instead of reusing the pn
+        self._need_collect = False
 
     # -- helpers ----------------------------------------------------------
     def _apply(self, version: int, value: str) -> None:
@@ -88,31 +96,48 @@ class Paxos:
         self.last_committed = version
         self.mon.on_paxos_commit(version)
 
-    def _uncommitted(self) -> tuple[int, str] | None:
+    def _uncommitted(self) -> tuple[int, int, str] | None:
+        """(accepted_pn, version, value) of the locally-accepted-but-
+        uncommitted proposal, if any."""
         raw = self.store.get(_K_UNCOMMITTED)
         if not raw:
             return None
         d = json.loads(raw.decode())
-        return d["version"], d["value"]
+        return d.get("pn", 0), d["version"], d["value"]
 
-    def _store_uncommitted(self, version: int, value: str) -> None:
+    def _store_uncommitted(self, version: int, value: str, pn: int) -> None:
         self.store.set(
             _K_UNCOMMITTED,
-            json.dumps({"version": version, "value": value}).encode(),
+            json.dumps({"version": version, "value": value, "pn": pn}).encode(),
         )
 
     # -- leader: recovery round -------------------------------------------
     def leader_init(self, timeout: float = 5.0) -> bool:
         """Collect phase after winning an election (reference:
         Paxos::leader_init + collect)."""
+        ok, best = self._collect(timeout)
+        if not ok:
+            return False
+        if best is not None and best[1] == self.last_committed + 1:
+            self._propose_locked_value(best[2])
+        return True
+
+    def _collect(self, timeout: float) -> tuple[bool, tuple | None]:
+        """One collect round under a fresh pn.  Returns (ok, best) where
+        best is the (pn, version, value) accepted under the highest pn at
+        the next slot, or None."""
         with self._lock:
             self.pn = (self.accepted_pn // 100 + 1) * 100 + self.mon.rank
             self.accepted_pn = self.pn
             self.store.set(_K_PN, str(self.pn).encode())
             self._collect_acks = {self.mon.rank}
             self._learned = {}
-            peons = self.mon.peon_ranks()
-            for r in peons:
+            self._need_collect = False
+            # send to every monmap member, not just the election quorum: a
+            # mon whose election ack arrived late is outside `quorum` but
+            # must still receive paxos traffic or it stays stale forever
+            # (advisor r1 high finding)
+            for r in self.mon.other_ranks():
                 self.mon.send_mon(
                     r,
                     MMonPaxos(
@@ -125,19 +150,21 @@ class Paxos:
                 timeout=timeout,
             )
             if not ok:
-                return False
-            # adopt any value accepted under an older pn (highest wins),
-            # then re-propose it under our pn (reference: the collect's
-            # uncommitted handling)
+                self._need_collect = True
+                return False, None
+            # adopt the value accepted under the HIGHEST pn at the next
+            # slot (Paxos: same-version values from different aborted
+            # rounds are tie-broken by pn, not arrival order), then
+            # re-propose it under our pn
             best = self._uncommitted()
-            for v, value in self._learned.values():
-                if v == self.last_committed + 1 and (
-                    best is None or v >= best[0]
+            for got in self._learned.values():
+                if got[1] == self.last_committed + 1 and (
+                    best is None or got[0] >= best[0]
                 ):
-                    best = (v, value)
-        if best is not None and best[0] == self.last_committed + 1:
-            self._propose_locked_value(best[1])
-        return True
+                    best = got
+            if best is not None and best[1] != self.last_committed + 1:
+                best = None
+            return True, best
 
     # -- leader: proposal --------------------------------------------------
     def propose(self, ops: list[tuple[int, str, bytes]], timeout: float = 5.0) -> bool:
@@ -153,32 +180,55 @@ class Paxos:
                 return False
             self._proposing = True
             try:
-                version = self.last_committed + 1
-                self._store_uncommitted(version, value)
-                self._accept_acks = {self.mon.rank}
-                self._propose_version = version
-                for r in self.mon.peon_ranks():
-                    self.mon.send_mon(
-                        r,
-                        MMonPaxos(
-                            op="begin", pn=self.pn, version=version, value=value,
-                        ),
-                    )
-                ok = self._cond.wait_for(
-                    lambda: len(self._accept_acks) >= self.mon.majority(),
-                    timeout=timeout,
-                )
-                if not ok:
-                    return False
-                self._apply(version, value)
-                for r in self.mon.peon_ranks():
-                    self.mon.send_mon(
-                        r, MMonPaxos(op="commit", version=version, value=value)
-                    )
-                return True
+                # an aborted predecessor may have been accepted by a
+                # minority under the current pn; Paxos safety forbids
+                # reusing that pn for a different value at the same slot.
+                # Re-collect under a fresh pn and re-propose its value
+                # first.  Checked INSIDE the _proposing slot so a
+                # concurrent proposer can't slip past the flag (reviewer
+                # r2 finding).
+                while self._need_collect:
+                    ok, best = self._collect(timeout)
+                    if not ok:
+                        return False
+                    if best is not None and best[1] == self.last_committed + 1:
+                        if not self._begin_round(best[2], timeout):
+                            return False
+                return self._begin_round(value, timeout)
             finally:
                 self._proposing = False
                 self._cond.notify_all()
+
+    def _begin_round(self, value: str, timeout: float) -> bool:
+        """One begin→accept-majority→commit round.  Caller holds _lock and
+        the _proposing slot."""
+        version = self.last_committed + 1
+        self._store_uncommitted(version, value, self.pn)
+        self._accept_acks = {self.mon.rank}
+        self._propose_version = version
+        self._propose_nonce += 1
+        nonce = self._propose_nonce
+        for r in self.mon.other_ranks():
+            self.mon.send_mon(
+                r,
+                MMonPaxos(
+                    op="begin", pn=self.pn, version=version,
+                    value=value, nonce=nonce,
+                ),
+            )
+        ok = self._cond.wait_for(
+            lambda: len(self._accept_acks) >= self.mon.majority(),
+            timeout=timeout,
+        )
+        if not ok:
+            self._need_collect = True
+            return False
+        self._apply(version, value)
+        for r in self.mon.other_ranks():
+            self.mon.send_mon(
+                r, MMonPaxos(op="commit", version=version, value=value)
+            )
+        return True
 
     # -- message handling (both roles) ------------------------------------
     def handle(self, conn, msg: MMonPaxos) -> None:
@@ -206,7 +256,8 @@ class Paxos:
             reply = MMonPaxos(
                 op="last", pn=msg.pn, last_committed=self.last_committed,
                 uncommitted=(
-                    {"version": unc[0], "value": unc[1]} if unc else None
+                    {"pn": unc[0], "version": unc[1], "value": unc[2]}
+                    if unc else None
                 ),
             )
             # share commits the new leader is missing (reference: the
@@ -232,7 +283,9 @@ class Paxos:
             rank = self.mon.rank_of(msg.src)
             if msg.uncommitted and rank is not None:
                 self._learned[rank] = (
-                    msg.uncommitted["version"], msg.uncommitted["value"],
+                    msg.uncommitted.get("pn", 0),
+                    msg.uncommitted["version"],
+                    msg.uncommitted["value"],
                 )
             if rank is not None:
                 self._collect_acks.add(rank)
@@ -252,18 +305,23 @@ class Paxos:
                 )
                 return
             self.accepted_pn = msg.pn
-            self._store_uncommitted(msg.version, msg.value)
+            self._store_uncommitted(msg.version, msg.value, msg.pn)
         _reply(
             conn,
-            MMonPaxos(op="accept", pn=msg.pn, version=msg.version),
+            MMonPaxos(op="accept", pn=msg.pn, version=msg.version, nonce=msg.nonce),
             self.mon.monmap.fsid,
         )
 
     def _handle_accept(self, msg: MMonPaxos) -> None:
         with self._lock:
-            # version must match too: a late ack for an earlier proposal
-            # under the same pn must not count toward the current one
-            if msg.pn != self.pn or msg.version != getattr(self, "_propose_version", None):
+            # (pn, version, nonce) must all match: a late accept for an
+            # aborted proposal (same pn+version, different value) must not
+            # count toward the current one
+            if (
+                msg.pn != self.pn
+                or msg.version != getattr(self, "_propose_version", None)
+                or msg.nonce != self._propose_nonce
+            ):
                 return
             rank = self.mon.rank_of(msg.src)
             if rank is not None:

@@ -49,6 +49,9 @@ _BANNER = b"ceph_tpu msgr v1\n"
 
 _FRAME_MSG = 0
 _FRAME_ACK = 1
+# delivery attempts for a message whose dispatcher keeps raising before it
+# is dropped-and-acked as poison (at-least-once, bounded)
+_POISON_RETRIES = 3
 
 POLICY_LOSSY = "lossy"
 POLICY_LOSSLESS_PEER = "lossless_peer"
@@ -58,11 +61,15 @@ class _Session:
     """Per-session state shared across socket reincarnations of one peer
     session (reference: ProtocolV2 session state kept over reconnects)."""
 
-    __slots__ = ("in_seq", "lock")
+    __slots__ = ("in_seq", "lock", "fail_seq", "fail_count")
 
     def __init__(self):
         self.in_seq = 0
         self.lock = threading.RLock()
+        # poison-message tracking: seq of the last message whose dispatch
+        # raised, and how many delivery attempts it has burned
+        self.fail_seq = -1
+        self.fail_count = 0
 
 
 class Dispatcher:
@@ -425,12 +432,42 @@ class Messenger:
                     if msg.seq <= conn.in_seq:
                         conn._send_ack(conn.in_seq)  # re-ack dropped dup
                         continue
-                    conn.in_seq = msg.seq
                     if not conn.peer_name:
                         conn.peer_name = msg.src
+                    # dispatch BEFORE advancing in_seq / acking: if the
+                    # dispatcher raises, the sender must keep its replay
+                    # entry (an early ack would prune it and lose the
+                    # message despite the lossless contract — advisor r1).
+                    # But a DETERMINISTICALLY-failing handler must not
+                    # reconnect-livelock the peer pair: after
+                    # _POISON_RETRIES failed deliveries of the same seq the
+                    # message is dropped-and-acked with a loud log.
+                    sess = conn._session
+                    try:
+                        self._dispatch(conn, msg)
+                    except Exception:
+                        if sess.fail_seq == msg.seq:
+                            sess.fail_count += 1
+                        else:
+                            sess.fail_seq, sess.fail_count = msg.seq, 1
+                        # Only an INCOMING conn earns a redelivery by dying:
+                        # its dialer holds the unacked frame in _replay and
+                        # resends on reconnect.  An outgoing conn receives
+                        # replies; the acceptor side drops its replay when
+                        # the socket dies, so killing the conn here would
+                        # just blackhole the link (reviewer r2) — drop the
+                        # message loudly and let protocol retries recover.
+                        if not conn.outgoing and sess.fail_count < _POISON_RETRIES:
+                            raise  # kill conn; dialer redelivers on reconnect
+                        self._dout(
+                            0,
+                            f"dropping poison message seq={msg.seq} "
+                            f"({type(msg).__name__}) after "
+                            f"{sess.fail_count} failed dispatch(es)",
+                        )
+                    conn.in_seq = msg.seq
                     if conn.policy == POLICY_LOSSLESS_PEER:
                         conn._send_ack(msg.seq)
-                    self._dispatch(conn, msg)
         except OSError:
             pass
         except Exception as e:
