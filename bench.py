@@ -1,11 +1,20 @@
 #!/usr/bin/env python
 """Headline benchmark — one JSON line for the driver.
 
-Measures the north-star metric (BASELINE.json): RS(8,4) cauchy_good encode
-throughput on one TPU chip via the bitplane kernel (best of XLA and Pallas),
-against the CPU SIMD oracle (native/gf_oracle.cc — the ISA-L-formulation
-baseline) on this host.  vs_baseline = TPU GiB/s / CPU GiB/s; the acceptance
-bar is >= 10x.  Timing subtleties live in ceph_tpu/bench/timing.py.
+Headline metric (BASELINE.json north star): RS(8,4) cauchy_good encode
+GiB/s on one TPU chip via the fused Pallas kernel, vs the CPU AVX2
+split-table oracle (native/gf_oracle.cc — the ISA-L ec_encode_data
+formulation) on this host.  Acceptance bar: >= 10x.
+
+LOUD-FAILURE CONTRACT (round-2 verdict item 1): on a TPU platform the
+Pallas kernel MUST compile and run — a Mosaic failure exits non-zero with
+the error in the JSON line instead of silently reporting the XLA fallback.
+The XLA number is still measured and reported in "extra" for comparison.
+
+"extra" carries the rest of the BASELINE.json matrix (configs measured so
+far: RS(2,1) reed_sol_van 4 KiB, CRUSH 1M-object remap on 1024 OSDs, the
+SHEC(6,3,2) single-erasure decode and CLAY(8,4) repair-bandwidth configs).
+Timing subtleties live in ceph_tpu/bench/timing.py.
 """
 import json
 import sys
@@ -28,47 +37,214 @@ def cpu_baseline_gibps(coding, k, data_mib=64, reps=3) -> float:
     return data.nbytes / dt / 2**30
 
 
-def tpu_gibps(coding, k, data_mib=256, iters=50) -> tuple[float, str]:
+def tpu_gibps(coding, k, kernel, data_mib=256, iters=50) -> float:
     from ceph_tpu.bench.timing import time_chained_encode
 
     data = np.random.default_rng(1).integers(
         0, 256, (k, data_mib * 2**20 // k), dtype=np.uint8
     )
-    best = 0.0
-    best_kernel = "xla"
-    for kernel in ("xla", "pallas"):
-        try:
-            secs = time_chained_encode(
-                coding, data, iters, kernel=kernel,
-                subtract_overhead=True, repeats=3,
-            )
-        except Exception as e:  # pallas may be unavailable on some backends
-            print(f"# kernel {kernel} failed: {e}", file=sys.stderr)
-            continue
-        gibps = data.nbytes * iters / secs / 2**30
-        if gibps > best:
-            best, best_kernel = gibps, kernel
-    return best, best_kernel
+    secs = time_chained_encode(
+        coding, data, iters, kernel=kernel, subtract_overhead=True, repeats=3,
+    )
+    return data.nbytes * iters / secs / 2**30
+
+
+def on_tpu() -> bool:
+    import jax
+
+    return jax.devices()[0].platform not in ("cpu",)
+
+
+def bench_rs21_van(extra: dict) -> None:
+    """BASELINE config 1: jerasure RS(2,1) reed_sol_van, 4 KiB stripes."""
+    from ceph_tpu.gf import vandermonde_coding_matrix
+
+    coding = np.ascontiguousarray(vandermonde_coding_matrix(2, 1), np.uint8)
+    # CPU first: a TPU-kernel failure must not discard the independently-
+    # obtainable baseline column
+    extra["rs2_1_van_encode_cpu_gibps"] = round(
+        cpu_baseline_gibps(coding, 2), 2
+    )
+    extra["rs2_1_van_encode_gibps"] = round(
+        tpu_gibps(coding, 2, "pallas", data_mib=128, iters=50), 2
+    )
+
+
+def bench_crush_remap(extra: dict, num_pgs=1_000_000) -> None:
+    """BASELINE config 5: straw2 remap over 1024 OSDs (maps/s), TPU batch
+    mapper vs the C mapper oracle."""
+    from ceph_tpu.crush import (
+        CompiledCrushMap,
+        build_hierarchical_map,
+        crush_do_rule_batch,
+    )
+
+    cmap = build_hierarchical_map(128, 8)
+    weights = np.full(1024, 0x10000, dtype=np.uint32)
+    xs = np.arange(num_pgs, dtype=np.int64)
+    cm = CompiledCrushMap(cmap)
+    np.asarray(crush_do_rule_batch(cm, 0, xs[:1024], 3, weights))  # compile
+    t0 = time.perf_counter()
+    np.asarray(crush_do_rule_batch(cm, 0, xs, 3, weights))
+    dt = time.perf_counter() - t0
+    extra["crush_remap_maps_per_s"] = round(num_pgs / dt)
+    try:
+        from ceph_tpu.crush.oracle_bridge import do_rule_batch_oracle
+
+        n_or = min(num_pgs, 100_000)
+        t0 = time.perf_counter()
+        do_rule_batch_oracle(cmap, 0, np.arange(n_or), 3, weights)
+        dt = time.perf_counter() - t0
+        extra["crush_remap_oracle_maps_per_s"] = round(n_or / dt)
+    except Exception as e:
+        print(f"# crush oracle baseline unavailable: {e}", file=sys.stderr)
+
+
+def bench_shec_decode(extra: dict) -> None:
+    """BASELINE config 3: SHEC(6,3,2) single-erasure local recovery."""
+    try:
+        from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+        codec = ErasureCodePluginRegistry.instance().factory(
+            {"plugin": "shec", "k": "6", "m": "3", "c": "2"}
+        )
+        chunk = 1 << 18
+        obj = np.random.default_rng(2).integers(
+            0, 256, 6 * chunk, dtype=np.uint8
+        ).tobytes()
+        enc = codec.encode(set(range(9)), obj)
+        avail = {i: enc[i] for i in enc if i != 2}
+        codec.decode({2}, dict(avail), chunk)  # warm
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            codec.decode({2}, dict(avail), chunk)
+        dt = (time.perf_counter() - t0) / reps
+        extra["shec_632_decode1_gibps"] = round(chunk / dt / 2**30, 3)
+    except Exception as e:
+        print(f"# shec decode bench failed: {e}", file=sys.stderr)
+
+
+def bench_clay_repair(extra: dict) -> None:
+    """BASELINE config 4: CLAY(8,4,d=11) repair — GiB/s of repaired data
+    plus the sub-chunk repair-bandwidth ratio vs naive RS repair."""
+    try:
+        from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+        codec = ErasureCodePluginRegistry.instance().factory(
+            {"plugin": "clay", "k": "8", "m": "4"}
+        )
+        chunk = codec.get_chunk_size(8 * (1 << 16))
+        obj = np.random.default_rng(3).integers(
+            0, 256, 8 * (1 << 16), dtype=np.uint8
+        ).tobytes()
+        enc = codec.encode(set(range(12)), obj)
+        avail = {i: enc[i] for i in enc if i != 0}
+        need = codec.minimum_to_decode({0}, set(avail))
+        codec.decode({0}, {i: avail[i] for i in need}, chunk)  # warm
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            codec.decode({0}, {i: avail[i] for i in need}, chunk)
+        dt = (time.perf_counter() - t0) / reps
+        extra["clay_84_repair_gibps"] = round(chunk / dt / 2**30, 3)
+        # repair bandwidth: bytes fetched from helpers vs naive k full
+        # chunks (the MSR claim BASELINE config 4 measures)
+        sub = codec.get_sub_chunk_count()
+        subchunk = chunk // sub
+        fetched = 0
+        for ranges in need.values():
+            for off, ln in ranges:
+                fetched += chunk if ln == -1 else ln * subchunk
+        extra["clay_84_repair_bw_frac_of_naive"] = round(
+            fetched / (codec.k * chunk), 3
+        )
+    except Exception as e:
+        print(f"# clay repair bench failed: {e}", file=sys.stderr)
 
 
 def main():
     from ceph_tpu.gf import cauchy_good_coding_matrix
 
     k, m = 8, 4
-    coding = np.ascontiguousarray(cauchy_good_coding_matrix(k, m), dtype=np.uint8)
+    coding = np.ascontiguousarray(cauchy_good_coding_matrix(k, m), np.uint8)
     try:
         cpu = cpu_baseline_gibps(coding, k)
     except Exception as e:  # oracle build failure shouldn't kill the bench
         print(f"# cpu baseline unavailable: {e}", file=sys.stderr)
         cpu = None
-    tpu, kernel = tpu_gibps(coding, k)
+
+    extra: dict = {}
+    if cpu:
+        extra["cpu_avx2_rs8_4_encode_gibps"] = round(cpu, 2)
+
+    # XLA bitplane path (round-1 fallback) for comparison
+    try:
+        extra["rs8_4_encode_xla_gibps"] = round(tpu_gibps(coding, k, "xla"), 2)
+    except Exception as e:
+        print(f"# xla kernel failed: {e}", file=sys.stderr)
+
+    # headline: the fused Pallas kernel.  On TPU a failure here is FATAL.
+    pallas_err = None
+    tpu = None
+    try:
+        tpu = tpu_gibps(coding, k, "pallas")
+    except Exception as e:
+        pallas_err = f"{type(e).__name__}: {e}"
+
+    if tpu is None:
+        if on_tpu():
+            print(
+                json.dumps(
+                    {
+                        "metric": "rs8_4_cauchy_good_encode_throughput_pallas",
+                        "value": None,
+                        "unit": "GiB/s",
+                        "vs_baseline": None,
+                        "error": f"Pallas kernel failed on TPU: {pallas_err}",
+                        "extra": extra,
+                    }
+                )
+            )
+            sys.exit(1)
+        # CPU-only host (CI): fall back to the XLA number, clearly labeled.
+        # Both kernels failing is a real regression even here — fail loudly
+        # instead of emitting a zero that reads as a measurement.
+        if "rs8_4_encode_xla_gibps" not in extra:
+            print(
+                json.dumps(
+                    {
+                        "metric": "rs8_4_cauchy_good_encode_throughput",
+                        "value": None,
+                        "unit": "GiB/s",
+                        "vs_baseline": None,
+                        "error": f"XLA and Pallas kernels both failed "
+                                 f"(pallas: {pallas_err})",
+                        "extra": extra,
+                    }
+                )
+            )
+            sys.exit(1)
+        tpu = extra["rs8_4_encode_xla_gibps"]
+        metric = "rs8_4_cauchy_good_encode_throughput_xla_cpuhost"
+    else:
+        metric = "rs8_4_cauchy_good_encode_throughput_pallas"
+
+    for fn in (bench_rs21_van, bench_crush_remap, bench_shec_decode,
+               bench_clay_repair):
+        try:
+            fn(extra)
+        except Exception as e:
+            print(f"# {fn.__name__} failed: {e}", file=sys.stderr)
+
     print(
         json.dumps(
             {
-                "metric": f"rs8_4_cauchy_good_encode_throughput_{kernel}",
+                "metric": metric,
                 "value": round(tpu, 2),
                 "unit": "GiB/s",
                 "vs_baseline": round(tpu / cpu, 2) if cpu else None,
+                "extra": extra,
             }
         )
     )
