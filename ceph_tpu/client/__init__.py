@@ -1,0 +1,6 @@
+"""Client stack: Objecter op engine + librados-style API (reference:
+src/osdc/Objecter.cc, src/librados; SURVEY.md §2.6)."""
+from .objecter import Objecter
+from .rados import Rados
+
+__all__ = ["Objecter", "Rados"]

@@ -1,0 +1,132 @@
+"""Objecter — client-side op engine (reference: src/osdc/Objecter.cc ::
+op_submit / _calc_target / resend-on-epoch-change; SURVEY.md §3.1 first
+hop).
+
+The client holds its own OSDMap (pushed by the mon subscription), computes
+each op's target primary locally (no metadata server — the CRUSH property),
+and resends ops when:
+- the reply is -ESTALE-like (-116: wrong primary; the map moved),
+- the target connection dies,
+- a new map arrives while ops are in flight and their target changed.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..msg import Dispatcher, Messenger
+from ..msg.messenger import POLICY_LOSSY
+from ..osd.daemon import object_ps
+from ..osd.messages import MOSDOp, MOSDOpReply, pack_data, unpack_data
+
+
+class Objecter(Dispatcher):
+    def __init__(self, cct, mon_client, name: str = "client"):
+        self.cct = cct
+        self.mc = mon_client
+        self.messenger = Messenger.create(cct, name)
+        self.messenger.default_policy = POLICY_LOSSY
+        self.messenger.add_dispatcher(self)
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._tid = 0
+        self._replies: dict[int, MOSDOpReply] = {}
+        self.mc.subscribe_osdmap()
+
+    def shutdown(self) -> None:
+        self.messenger.shutdown()
+
+    # -- dispatch ----------------------------------------------------------
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MOSDOpReply):
+            with self._lock:
+                self._replies[msg.tid] = msg
+                self._cond.notify_all()
+            return True
+        return False
+
+    # -- targeting ---------------------------------------------------------
+    def _calc_target(self, pool_id: int, oid: str) -> tuple[int, tuple]:
+        """reference: Objecter::_calc_target — pg from the object name,
+        primary from the local map."""
+        m = self.mc.osdmap
+        if m is None:
+            raise ConnectionError("no osdmap yet")
+        pool = m.pools.get(pool_id)
+        if pool is None:
+            raise KeyError(f"no pool {pool_id}")
+        ps = object_ps(oid, pool.pg_num)
+        _up, _upp, _acting, primary = m.pg_to_up_acting_osds(pool_id, ps)
+        addr = m.osd_addrs.get(primary)
+        if primary < 0 or addr is None:
+            raise ConnectionError(f"pg {pool_id}.{ps} has no primary")
+        return primary, tuple(addr)
+
+    # -- ops ---------------------------------------------------------------
+    def op_submit(
+        self,
+        pool_id: int,
+        oid: str,
+        op: str,
+        data: bytes | None = None,
+        off: int = 0,
+        length: int = 0,
+        timeout: float = 30.0,
+        attempts: int = 8,
+    ):
+        """Submit; blocks for the reply, retrying across map changes."""
+        import time as _time
+
+        last = None
+        for _ in range(attempts):
+            m = self.mc.osdmap
+            try:
+                _osd, addr = self._calc_target(pool_id, oid)
+            except (ConnectionError, KeyError) as e:
+                last = str(e)
+                self._refresh_map(m)
+                continue
+            with self._lock:
+                self._tid += 1
+                tid = self._tid
+            try:
+                conn = self.messenger.connect(addr)
+                conn.send_message(
+                    MOSDOp(
+                        tid=tid, pool=pool_id, oid=oid, op=op,
+                        data=pack_data(data) if data is not None else None,
+                        epoch=m.epoch if m else 0, off=off, length=length,
+                    )
+                )
+            except (OSError, ConnectionError) as e:
+                last = str(e)
+                self._refresh_map(m)
+                continue
+            with self._lock:
+                ok = self._cond.wait_for(
+                    lambda: tid in self._replies, timeout=timeout
+                )
+                rep = self._replies.pop(tid, None) if ok else None
+            if rep is None:
+                last = "op timed out"
+                self._refresh_map(m)
+                continue
+            if rep.retval == -116:  # wrong primary: map changed under us
+                last = "stale map"
+                self._refresh_map(m)
+                continue
+            if rep.retval == -11:  # not enough shards yet; let it settle
+                last = rep.result
+                _time.sleep(0.3)
+                self._refresh_map(m)
+                continue
+            return rep
+        raise ConnectionError(f"op {op} {oid!r} failed after retries: {last}")
+
+    def _refresh_map(self, old) -> None:
+        """Wait briefly for a newer epoch (reference: the Objecter blocks
+        ops on map gaps; subscriptions push the new map)."""
+        want = (old.epoch + 1) if old is not None else 1
+        try:
+            self.mc.wait_for_osdmap(min_epoch=want, timeout=3.0)
+        except TimeoutError:
+            pass

@@ -1,0 +1,104 @@
+"""Rados / IoCtx — the librados-analog public client API (reference:
+src/include/rados/librados.hpp :: Rados/IoCtx, src/librados/RadosClient.cc;
+SURVEY.md §2.6).
+
+    r = Rados(cct, mon_addrs)
+    r.connect()
+    io = r.open_ioctx("mypool")
+    io.write_full("obj", b"bytes")
+    io.read("obj")
+    r.shutdown()
+"""
+from __future__ import annotations
+
+from ..mon.mon_client import MonClient
+from ..osd.messages import unpack_data
+from .objecter import Objecter
+
+
+class IoCtx:
+    """Per-pool I/O context (reference: librados::IoCtx)."""
+
+    def __init__(self, client: "Rados", pool_id: int, pool_name: str):
+        self._client = client
+        self.pool_id = pool_id
+        self.pool_name = pool_name
+
+    def write_full(self, oid: str, data: bytes) -> int:
+        rep = self._client.objecter.op_submit(
+            self.pool_id, oid, "write_full", data=bytes(data)
+        )
+        if rep.retval != 0:
+            raise IOError(f"write_full {oid!r}: {rep.retval} {rep.result}")
+        return rep.retval
+
+    def read(self, oid: str, off: int = 0, length: int = 0) -> bytes:
+        rep = self._client.objecter.op_submit(
+            self.pool_id, oid, "read", off=off, length=length
+        )
+        if rep.retval != 0:
+            raise IOError(f"read {oid!r}: {rep.retval} {rep.result}")
+        return unpack_data(rep.data) or b""
+
+    def remove(self, oid: str) -> None:
+        rep = self._client.objecter.op_submit(self.pool_id, oid, "delete")
+        if rep.retval != 0:
+            raise IOError(f"remove {oid!r}: {rep.retval} {rep.result}")
+
+    def stat(self, oid: str) -> dict:
+        rep = self._client.objecter.op_submit(self.pool_id, oid, "stat")
+        if rep.retval != 0:
+            raise IOError(f"stat {oid!r}: {rep.retval} {rep.result}")
+        return rep.result
+
+    def list_objects(self) -> list[str]:
+        """Walk every PG primary (reference: librados nobjects_begin)."""
+        m = self._client.mc.osdmap
+        pool = m.pools[self.pool_id]
+        oids: set[str] = set()
+        for ps in range(pool.pg_num):
+            rep = self._client.objecter.op_submit(
+                self.pool_id, f":pg:{ps}", "list"
+            )
+            if rep.retval == 0 and isinstance(rep.result, dict):
+                oids.update(rep.result.get("oids") or [])
+        return sorted(oids)
+
+
+class Rados:
+    """reference: librados::Rados — cluster handle."""
+
+    def __init__(self, cct, mon_addrs, name: str = "client.admin"):
+        self.cct = cct
+        self.mc = MonClient(cct, mon_addrs, name=name)
+        self.objecter: Objecter | None = None
+        self._name = name
+
+    def connect(self, timeout: float = 15.0) -> None:
+        self.objecter = Objecter(self.cct, self.mc, name=self._name)
+        self.mc.wait_for_osdmap(timeout=timeout)
+
+    def shutdown(self) -> None:
+        if self.objecter is not None:
+            self.objecter.shutdown()
+        self.mc.shutdown()
+
+    def command(self, cmd: dict, timeout: float = 15.0):
+        """Mon command passthrough (the `ceph` CLI surface)."""
+        return self.mc.command(cmd, timeout=timeout)
+
+    def pool_id(self, name: str) -> int:
+        m = self.mc.osdmap
+        if m is None:
+            raise ConnectionError("not connected")
+        for pid, p in m.pools.items():
+            if p.name == name:
+                return pid
+        raise KeyError(f"no pool {name!r}")
+
+    def open_ioctx(self, pool: str | int) -> IoCtx:
+        if isinstance(pool, str):
+            pid = self.pool_id(pool)
+            return IoCtx(self, pid, pool)
+        pname = self.mc.osdmap.pools[pool].name if self.mc.osdmap else str(pool)
+        return IoCtx(self, pool, pname)

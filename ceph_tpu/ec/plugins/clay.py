@@ -35,7 +35,7 @@ from __future__ import annotations
 import numpy as np
 
 from ...gf.matrix import decode_matrix_for, systematic_generator, vandermonde_coding_matrix
-from ...gf.reference_codec import apply_matrix
+
 from ...gf.tables import GF_MUL_TABLE, gf_inv
 from ..interface import ErasureCode, InsufficientChunks, InvalidProfile
 from ..registry import ErasureCodePlugin
@@ -96,64 +96,76 @@ class ClayCodec(ErasureCode):
     def _layered_decode(
         self, C: dict[int, np.ndarray], erased: list[int], sub_len: int
     ) -> dict[int, np.ndarray]:
-        """C: node -> [Z, sub_len] known coupled chunks; returns C for erased."""
+        """C: node -> [Z, sub_len] known coupled chunks; returns C for erased.
+
+        TPU-native restructuring of ErasureCodeClay::decode_layered: planes
+        are grouped by intersection score (all cross-plane dependencies
+        point at strictly lower scores), couplings are vectorized numpy
+        over each group, and the per-plane MDS decodes collapse into ONE
+        on-device bitplane matmul per score group instead of Z host
+        apply_matrix calls."""
         nq, t, Z = self.q, self.t, self.sub_chunk_count
         n_nodes = self.k + self.m
         erased_set = set(erased)
         if len(erased_set) > self.m:
             raise InsufficientChunks(f"{len(erased_set)} erasures > m={self.m}")
+        from ...ops.bitplane import apply_matrix_jax
+
         U = np.zeros((n_nodes, Z, sub_len), dtype=np.uint8)
-
-        def score(z: int) -> int:
-            return sum(
-                1
-                for y in range(t)
-                if (y * nq + self._digit(z, y)) in erased_set
-            )
-
-        order = sorted(range(Z), key=score)
+        Cd = np.zeros((n_nodes, Z, sub_len), dtype=np.uint8)
+        for node, v in C.items():
+            Cd[node] = v
+        zs_all = np.arange(Z)
+        digits = np.stack(
+            [(zs_all // nq**y) % nq for y in range(t)]
+        )  # [t, Z]
+        scores = np.zeros(Z, dtype=np.int64)
+        for y in range(t):
+            scores += np.isin(y * nq + digits[y], list(erased_set))
         avail_nodes = sorted(set(range(n_nodes)) - erased_set)
         dm = decode_matrix_for(self.generator, self.k, avail_nodes).astype(np.uint8)
-        for z in order:
-            digs = [self._digit(z, y) for y in range(t)]
+        parity_erased = bool(erased_set & set(range(self.k, n_nodes)))
+        for s in range(int(scores.max()) + 1):
+            zs = zs_all[scores == s]
+            if zs.size == 0:
+                continue
+            # uncoupled U for available nodes, vectorized over the group
             for node in avail_nodes:
                 x, y = self._node(node)
-                if x == digs[y]:
-                    U[node, z] = C[node][z]
-                    continue
-                pnode = y * nq + digs[y]
-                zp = self._replace(z, y, x)
-                if pnode not in erased_set:
-                    # invert the 2x2: U1 = (C1 ^ g*C2) / (1 ^ g^2)
-                    c1 = C[node][z]
-                    c2 = C[pnode][zp]
-                    U[node, z] = _gmul(_INV_DET, c1 ^ _gmul(GAMMA, c2))
-                else:
-                    # partner erased: its plane zp has score-1, U known
-                    U[node, z] = C[node][z] ^ _gmul(GAMMA, U[pnode, zp])
-            # per-plane MDS decode of erased U symbols
-            sub = U[avail_nodes[: self.k], z]
-            data_u = apply_matrix(dm, sub)
-            full = np.zeros((n_nodes, sub_len), dtype=np.uint8)
+                digs = digits[y, zs]                      # [nZ]
+                pnode = y * nq + digs
+                zp = zs + (x - digs) * nq**y
+                vertex = (digs == x)[:, None]
+                partner_ok = (~np.isin(pnode, list(erased_set)))[:, None]
+                c1 = Cd[node, zs]
+                c2 = Cd[pnode, zp]
+                u_pair = _gmul(_INV_DET, c1 ^ _gmul(GAMMA, c2))
+                u_part = c1 ^ _gmul(GAMMA, U[pnode, zp])  # zp has score s-1
+                U[node, zs] = np.where(
+                    vertex, c1, np.where(partner_ok, u_pair, u_part)
+                )
+            # one batched MDS decode for every plane in the group
+            sub = U[avail_nodes[: self.k]][:, zs].reshape(self.k, -1)
+            data_u = np.asarray(apply_matrix_jax(dm, sub))
+            full = np.zeros((n_nodes, zs.size * sub_len), dtype=np.uint8)
             full[: self.k] = data_u
-            if erased_set & set(range(self.k, n_nodes)):
-                full[self.k :] = apply_matrix(self.coding, data_u)
+            if parity_erased:
+                full[self.k :] = np.asarray(
+                    apply_matrix_jax(self.coding, data_u)
+                )
             for node in erased_set:
-                U[node, z] = full[node]
+                U[node, zs] = full[node].reshape(zs.size, sub_len)
         # rebuild coupled C for erased nodes from the complete U
         out: dict[int, np.ndarray] = {}
         for node in erased:
             x, y = self._node(node)
-            buf = np.zeros((Z, sub_len), dtype=np.uint8)
-            for z in range(Z):
-                dy = self._digit(z, y)
-                if x == dy:
-                    buf[z] = U[node, z]
-                else:
-                    pnode = y * nq + dy
-                    zp = self._replace(z, y, x)
-                    buf[z] = U[node, z] ^ _gmul(GAMMA, U[pnode, zp])
-            out[node] = buf
+            digs = digits[y]
+            pnode = y * nq + digs
+            zp = zs_all + (x - digs) * nq**y
+            vertex = (digs == x)[:, None]
+            out[node] = np.where(
+                vertex, U[node], U[node] ^ _gmul(GAMMA, U[pnode, zp])
+            )
         return out
 
     # -- interface --------------------------------------------------------
@@ -245,14 +257,17 @@ class ClayCodec(ErasureCode):
         nq, t, Z = self.q, self.t, self.sub_chunk_count
         n_nodes = self.k + self.m
         x0, y0 = self._node(lost)
-        planes = self.repair_planes(lost)
-        plane_pos = {z: i for i, z in enumerate(planes)}
-        # helper sub-chunks restricted to repair planes
-        Cb = {
-            node: v.reshape(Z, sub_len)[planes]
-            for node, v in have.items()
-        }
-        nB = len(planes)
+        planes = np.asarray(self.repair_planes(lost))
+        nB = planes.size
+        plane_pos = np.full(Z, -1, dtype=np.int64)
+        plane_pos[planes] = np.arange(nB)
+        from ...ops.bitplane import apply_matrix_jax
+
+        # helper sub-chunks restricted to repair planes (dense array so
+        # (pnode, plane)-pairs gather vectorized)
+        Cb = np.zeros((n_nodes, nB, sub_len), dtype=np.uint8)
+        for node, v in have.items():
+            Cb[node] = v.reshape(Z, sub_len)[planes]
         U = np.zeros((n_nodes, nB, sub_len), dtype=np.uint8)
         known_u_nodes = []
         for node in sorted(have):
@@ -260,17 +275,16 @@ class ClayCodec(ErasureCode):
             if y == y0:
                 continue  # column y0 survivors: U unknown in B planes
             known_u_nodes.append(node)
-            for zi, z in enumerate(planes):
-                dy = self._digit(z, y)
-                if x == dy:
-                    U[node, zi] = Cb[node][zi]
-                else:
-                    pnode = y * nq + dy
-                    zp = self._replace(z, y, x)  # stays in B (digit y0 fixed)
-                    c1 = Cb[node][zi]
-                    c2 = Cb[pnode][plane_pos[zp]]
-                    U[node, zi] = _gmul(_INV_DET, c1 ^ _gmul(GAMMA, c2))
-        # per-plane MDS decode: unknown U's are exactly column y0 (q nodes);
+            digs = (planes // nq**y) % nq                  # [nB]
+            pnode = y * nq + digs
+            zp = planes + (x - digs) * nq**y               # stays in B
+            vertex = (digs == x)[:, None]
+            c1 = Cb[node]
+            c2 = Cb[pnode, plane_pos[zp]]
+            U[node] = np.where(
+                vertex, c1, _gmul(_INV_DET, c1 ^ _gmul(GAMMA, c2))
+            )
+        # batched MDS decode: unknown U's are exactly column y0 (q nodes);
         # survivors outside column y0 must supply at least k known U's
         unknown = [y0 * nq + x for x in range(nq)]
         if len(known_u_nodes) < self.k:
@@ -279,26 +293,25 @@ class ClayCodec(ErasureCode):
                 f"have {len(known_u_nodes)}"
             )
         dm = decode_matrix_for(self.generator, self.k, known_u_nodes).astype(np.uint8)
-        for zi in range(nB):
-            data_u = apply_matrix(dm, U[known_u_nodes[: self.k], zi])
-            full = np.zeros((n_nodes, sub_len), dtype=np.uint8)
-            full[: self.k] = data_u
-            full[self.k :] = apply_matrix(self.coding, data_u)
-            for node in unknown:
-                U[node, zi] = full[node]
+        sub = U[known_u_nodes[: self.k]].reshape(self.k, -1)
+        data_u = np.asarray(apply_matrix_jax(dm, sub))
+        full = np.zeros((n_nodes, nB * sub_len), dtype=np.uint8)
+        full[: self.k] = data_u
+        full[self.k :] = np.asarray(apply_matrix_jax(self.coding, data_u))
+        for node in unknown:
+            U[node] = full[node].reshape(nB, sub_len)
         # rebuild lost chunk: B-planes are vertex (C = U); others via pairs
-        out = np.zeros((Z, sub_len), dtype=np.uint8)
-        for z in range(Z):
-            dy0 = self._digit(z, y0)
-            if dy0 == x0:
-                out[z] = U[lost, plane_pos[z]]
-            else:
-                pnode = y0 * nq + dy0  # surviving column-y0 node
-                zp = self._replace(z, y0, x0)  # in B
-                zpi = plane_pos[zp]
-                # C2 = g*U1 ^ U2 with P1=(lost;z), P2=(pnode;zp):
-                u1 = _gmul(_INV_G, Cb[pnode][zpi] ^ U[pnode, zpi])
-                out[z] = u1 ^ _gmul(GAMMA, U[pnode, zpi])
+        zs_all = np.arange(Z)
+        dy0 = (zs_all // nq**y0) % nq
+        pnode = y0 * nq + dy0                              # [Z]
+        zp = zs_all + (x0 - dy0) * nq**y0                  # in B
+        zpi = plane_pos[zp]
+        u2 = U[pnode, zpi]                                 # [Z, sub_len]
+        # C2 = g*U1 ^ U2 with P1=(lost;z), P2=(pnode;zp):
+        u1 = _gmul(_INV_G, Cb[pnode, zpi] ^ u2)
+        out = np.where(
+            (dy0 == x0)[:, None], U[lost, zpi], u1 ^ _gmul(GAMMA, u2)
+        )
         return out.reshape(Z * sub_len)
 
 

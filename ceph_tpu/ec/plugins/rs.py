@@ -119,16 +119,31 @@ class RSCodec(ErasureCode):
             out = np_decode(self.coding, self.k, dict(zip(use, shards)), want=list(range(self.k)))
             data = np.stack([out[i] for i in range(self.k)])
         result: dict[int, np.ndarray] = {}
+        missing_par = [
+            w for w in sorted(set(want_to_read))
+            if w >= self.k and w not in chunks
+        ]
+        if missing_par:
+            # one batched apply for every missing parity row (device-path
+            # when backend is jax, host referee otherwise)
+            rowmat = np.ascontiguousarray(
+                self.coding[[w - self.k for w in missing_par]]
+            )
+            if self.backend == "jax":
+                from ...ops.bitplane import apply_matrix_jax
+
+                par = np.asarray(apply_matrix_jax(rowmat, data))
+            else:
+                from ...gf.reference_codec import apply_matrix
+
+                par = apply_matrix(rowmat, data)
+            for i, w in enumerate(missing_par):
+                result[w] = par[i]
         for wanted in sorted(set(want_to_read)):
             if wanted in chunks:
                 result[wanted] = np.asarray(chunks[wanted], dtype=np.uint8)
             elif wanted < self.k:
                 result[wanted] = data[wanted]
-            else:
-                from ...gf.reference_codec import apply_matrix
-
-                row = self.coding[wanted - self.k : wanted - self.k + 1]
-                result[wanted] = apply_matrix(row, data)[0]
         return result
 
 

@@ -46,6 +46,7 @@ def shec_coding_matrix(k: int, m: int, c: int) -> np.ndarray:
 class ShecCodec(ErasureCode):
     def __init__(self, profile: dict | None = None):
         self._plan_cache: dict[tuple, tuple] = {}
+        self._dm_cache: dict[tuple, np.ndarray] = {}
         super().__init__(profile)
 
     def init(self, profile: dict) -> None:
@@ -141,54 +142,80 @@ class ShecCodec(ErasureCode):
         chunks |= want & avail
         return {c: [(0, -1)] for c in sorted(chunks)}
 
-    def decode_chunks(self, want_to_read, chunks):
-        have = set(chunks)
-        want = frozenset(want_to_read)
-        solve_targets, _ = self._requirements(want, frozenset(have))
-        _, parities = self._recovery_plan(want, frozenset(have))
-        L = len(next(iter(chunks.values())))
-        result: dict[int, np.ndarray] = {}
-        if solve_targets:
-            # B rows: parity ^ (known window data contribution); gf_solve
-            # handles the (possibly over-determined) system directly
-            from ...gf.tables import GF_MUL_TABLE
+    def _decode_matrix(
+        self, want: frozenset[int], avail_t: tuple[int, ...]
+    ) -> np.ndarray:
+        """[n_want, n_avail] GF(2^8) matrix M with wanted = M @ available.
 
+        The whole SHEC recovery — windowed solve plus parity re-encode —
+        is GF-linear in the available chunks, so it collapses to ONE
+        cached matrix applied on-device (the ShecTableCache role,
+        reference: shec/ErasureCodeShecTableCache.cc, upgraded from
+        decode-matrix caching to whole-plan caching)."""
+        key = (want, avail_t)
+        cached = self._dm_cache.get(key)
+        if cached is not None:
+            return cached
+        from ...gf.tables import GF_MUL_TABLE
+
+        avail = frozenset(avail_t)
+        solve_targets, _ = self._requirements(want, avail)
+        _, parities = self._recovery_plan(want, avail)
+        n_in = len(avail_t)
+        pos = {c: i for i, c in enumerate(avail_t)}
+        rowX: dict[int, np.ndarray] = {}
+        if solve_targets:
+            # express each windowed-parity equation's RHS as a coefficient
+            # row over the available chunks, then solve for the targets
             A = np.stack([self.coding[p, solve_targets] for p in parities])
-            B = np.zeros((len(parities), L), dtype=np.int64)
+            Bcoef = np.zeros((len(parities), n_in), dtype=np.int64)
             for r, p in enumerate(parities):
-                row = np.asarray(chunks[self.k + p], dtype=np.uint8).astype(
-                    np.int64
-                )
+                Bcoef[r, pos[self.k + p]] ^= 1
                 for j in self._window(p):
                     if j in solve_targets:
                         continue
-                    row ^= GF_MUL_TABLE[
-                        int(self.coding[p, j]),
-                        np.asarray(chunks[j], dtype=np.uint8),
-                    ].astype(np.int64)
-                B[r] = row
-            X = gf_solve(A, B)
+                    Bcoef[r, pos[j]] ^= int(self.coding[p, j])
+            X = gf_solve(A, Bcoef)  # [n_targets, n_in]
             for idx, j in enumerate(solve_targets):
-                result[j] = X[idx].astype(np.uint8)
-        full_data: dict[int, np.ndarray] = {}
-        for j in range(self.k):
-            if j in result:
-                full_data[j] = result[j]
-            elif j in have:
-                full_data[j] = np.asarray(chunks[j], dtype=np.uint8)
-        for w in sorted(want):
-            if w in have:
-                result[w] = np.asarray(chunks[w], dtype=np.uint8)
-            elif w >= self.k:
-                p = w - self.k
-                cols = sorted(self._window(p))
-                from ...gf.reference_codec import apply_matrix
+                rowX[j] = X[idx].astype(np.int64)
 
-                data = np.stack([full_data[j] for j in cols])
-                result[w] = apply_matrix(
-                    self.coding[p : p + 1, cols].astype(np.uint8), data
-                )[0]
-        return result
+        def data_row(j: int) -> np.ndarray:
+            if j in rowX:
+                return rowX[j]
+            e = np.zeros(n_in, dtype=np.int64)
+            e[pos[j]] = 1
+            return e
+
+        rows = []
+        for w in sorted(want):
+            if w in pos:
+                e = np.zeros(n_in, dtype=np.int64)
+                e[pos[w]] = 1
+                rows.append(e)
+            elif w < self.k:
+                rows.append(data_row(w))
+            else:
+                p = w - self.k
+                r = np.zeros(n_in, dtype=np.int64)
+                for j in self._window(p):
+                    c = int(self.coding[p, j])
+                    r ^= GF_MUL_TABLE[c, data_row(j)]
+                rows.append(r)
+        M = np.stack(rows).astype(np.uint8)
+        self._dm_cache[key] = M
+        return M
+
+    def decode_chunks(self, want_to_read, chunks):
+        from ...ops.bitplane import apply_matrix_jax
+
+        want = frozenset(want_to_read)
+        avail_t = tuple(sorted(chunks))
+        M = self._decode_matrix(want, avail_t)
+        stacked = np.stack(
+            [np.asarray(chunks[c], dtype=np.uint8) for c in avail_t]
+        )
+        out = np.asarray(apply_matrix_jax(M, stacked))
+        return {w: out[i] for i, w in enumerate(sorted(want))}
 
 
 class ShecPlugin(ErasureCodePlugin):

@@ -4,10 +4,11 @@ step 2, HOT LOOP #1 of §3.1).
 The XLA bitplane path (ceph_tpu/ops/bitplane.py) materializes the unpacked
 bitplanes (8x the data) through HBM; this kernel keeps them in VMEM:
 
-    per L-tile:  load [n, T] bytes ->
-                 unpack to [n*8, T] 0/1 int8 (VPU shifts) ->
-                 one MXU matmul with the (rows*8, n*8) bitmatrix ->
-                 mod-2 + repack to [rows, T] bytes -> store
+    per L-tile:  load [kG, T] bytes ->
+                 8 mask-compares to [8*kG, T] 0/1 int8 (VPU) ->
+                 one int8 MXU matmul with the kron-expanded bitmatrix ->
+                 mod-2 -> pack bits back to bytes with a tiny bf16 matmul ->
+                 store [rows*G, T] bytes
 
 HBM traffic becomes read 1x + write (rows/n)x of the data — the minimum —
 instead of ~17x.  Plays the role gf-complete's SIMD kernels play for
@@ -15,11 +16,23 @@ jerasure (reference: src/erasure-code/jerasure/gf-complete :: gf_w8 SSE
 paths) and ec_encode_data's AVX-512 loops play for ISA-L (reference:
 src/isa-l).
 
-Layout notes:
-- bit-plane order inside the kernel is l*n + j (concatenate over bit l of
-  chunk j), so the host pre-permutes the bitmatrix columns accordingly;
-  output rows stay i*8 + l so repacking is a plain reshape.
-- the bitmatrix is tiny ((rows*8) x (n*8) int8) and lives in VMEM whole.
+Two tricks carry the throughput (measured on v5e, RS(8,4) 1 MiB shards:
+22.7 -> ~65 GiB/s):
+
+- **Pack-by-matmul**: bit->byte repacking as P @ (acc & 1) with P holding
+  2^l weights in bfloat16 (exact: sums <= 255 < 2^8 and bf16 represents
+  integers to 2^8), replacing 8 VPU shift+or passes.  int32 matmuls do not
+  legalize in Mosaic and int8 cannot hold 128, hence bf16.
+- **kron(B, I_G) row grouping**: the natural [k, T] block has only k
+  sublanes while int8 tiles are (32, 128), so every VPU op padded 4-8x.
+  Each chunk row is split into G segments stacked vertically ([k*G, T/G],
+  a free row-major reshape) and the bitmatrix becomes its Kronecker
+  expansion with I_G.  MXU cycles are unchanged (the array pads K/M to 128
+  anyway) but every elementwise op runs on full tiles.
+
+Layout: bit r of input row j lives at bits row l*kG + (j*G+g); output bit
+rows are l'*rG + (i*G+g).  The host builds both expanded matrices once per
+(mat, G) (lru_cache), the kernel is shape-generic.
 """
 from __future__ import annotations
 
@@ -31,78 +44,123 @@ import numpy as np
 
 from ..gf.matrix import matrix_to_bitmatrix
 
-DEFAULT_TILE = 32768
+DEFAULT_TILE = 8192
+
+
+def _pick_group(rows: int, n: int) -> int:
+    """Segments per row: enough that n*G reaches a full int8 sublane tile
+    (32) and the contraction depth n*8*G reaches the 128-wide MXU, capped
+    so the expanded bitmatrix stays small."""
+    G = 1
+    while n * G < 32 or n * 8 * G < 128:
+        G *= 2
+    return min(G * 2, 64)  # one extra doubling measured fastest on v5e
 
 
 @lru_cache(maxsize=256)
-def _permuted_bitmatrix(mat_bytes: bytes, shape: tuple[int, int]) -> np.ndarray:
-    """(rows*8) x (n*8) bitmatrix with columns permuted to l*n+j order."""
+def _kron_matrices(
+    mat_bytes: bytes, shape: tuple[int, int], G: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """(B', P'): kron-expanded GF(2) bitmatrix (int8) and bf16 pack matrix."""
     mat = np.frombuffer(mat_bytes, dtype=np.uint8).reshape(shape)
-    B = matrix_to_bitmatrix(mat)  # cols j*8+l
-    rows8, n8 = B.shape
-    n = n8 // 8
-    perm = np.empty(n8, dtype=np.int64)
-    for l in range(8):
-        for j in range(n):
-            perm[l * n + j] = j * 8 + l
-    return np.ascontiguousarray(B[:, perm]).astype(np.int8)
+    rows, n = shape
+    Bbit = matrix_to_bitmatrix(mat)  # [rows*8, n*8], cols j*8+l
+    kG, rG = n * G, rows * G
+    Bk = np.zeros((rows * 8 * G, n * 8 * G), np.int8)
+    g = np.arange(G)
+    for i in range(rows):
+        for l2 in range(8):
+            for j in range(n):
+                for l in range(8):
+                    if Bbit[i * 8 + l2, j * 8 + l]:
+                        Bk[l2 * rG + i * G + g, l * kG + j * G + g] = 1
+    Pk = np.zeros((rG, rows * 8 * G), np.float32)
+    for i in range(rows):
+        for l2 in range(8):
+            Pk[i * G + g, l2 * rG + i * G + g] = 1 << l2
+    return Bk, Pk
 
 
-def _apply_kernel(B_ref, x_ref, o_ref, *, n: int, rows: int):
-    x = x_ref[:].astype(jnp.int32)  # [n, T]
-    planes = [((x >> l) & 1).astype(jnp.int8) for l in range(8)]
-    bits = jnp.concatenate(planes, axis=0)  # [8n, T], row order l*n+j
+def _apply_kernel(B_ref, P_ref, x_ref, o_ref, *, kG: int):
+    x = x_ref[:]  # [kG, T] uint8
+    bits = jnp.stack(
+        [(x & jnp.uint8(1 << l) != 0).astype(jnp.int8) for l in range(8)]
+    ).reshape(8 * kG, x.shape[1])
     acc = jax.lax.dot_general(
         B_ref[:],
         bits,
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
-    )  # [rows*8, T]
-    par = acc & 1  # int32: Mosaic cannot legalize vector shifts on int8
-    T = par.shape[1]
-    stacked = par.reshape(rows, 8, T)
-    packed = stacked[:, 0, :]
-    for l in range(1, 8):
-        packed = packed | (stacked[:, l, :] << l)
-    o_ref[:] = packed.astype(jnp.uint8)
+    )
+    par = (acc & 1).astype(jnp.bfloat16)
+    packed = jax.lax.dot_general(
+        P_ref[:],
+        par,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[:] = packed.astype(jnp.int32).astype(jnp.uint8)
 
 
-@partial(jax.jit, static_argnames=("rows", "n", "tile", "interpret"))
-def _apply_padded(B, chunks, rows: int, n: int, tile: int, interpret: bool):
+@partial(jax.jit, static_argnames=("rows", "n", "G", "tile", "interpret"))
+def _apply_grouped(
+    B, P, xg, rows: int, n: int, G: int, tile: int, interpret: bool
+):
+    """xg: [n*G, Lg] uint8 (row j*G+g = segment g of chunk j); returns
+    [rows*G, Lg] uint8 in the same grouped layout."""
     from jax.experimental import pallas as pl
 
-    L = chunks.shape[1]
-    if L % tile:
-        raise ValueError(f"chunk length {L} not a multiple of tile {tile}")
-    grid = (L // tile,)
+    kG, rG = n * G, rows * G
+    Lg = xg.shape[1]
+    if Lg % tile:
+        raise ValueError(f"grouped length {Lg} not a multiple of tile {tile}")
     return pl.pallas_call(
-        partial(_apply_kernel, n=n, rows=rows),
-        grid=grid,
+        partial(_apply_kernel, kG=kG),
+        grid=(Lg // tile,),
         in_specs=[
-            pl.BlockSpec((rows * 8, n * 8), lambda i: (0, 0)),
-            pl.BlockSpec((n, tile), lambda i: (0, i)),
+            pl.BlockSpec(B.shape, lambda i: (0, 0)),
+            pl.BlockSpec(P.shape, lambda i: (0, 0)),
+            pl.BlockSpec((kG, tile), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((rows, tile), lambda i: (0, i)),
-        out_shape=jax.ShapeDtypeStruct((rows, L), jnp.uint8),
+        out_specs=pl.BlockSpec((rG, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((rG, Lg), jnp.uint8),
         interpret=interpret,
-    )(B, chunks)
+    )(B, P, xg)
 
 
 def apply_matrix_pallas(
-    mat: np.ndarray, chunks, tile: int = DEFAULT_TILE, interpret: bool = False
+    mat: np.ndarray,
+    chunks,
+    tile: int = DEFAULT_TILE,
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """GF(2^8) matrix apply via the fused Pallas kernel.
 
     Same contract (and bit-exact output) as
-    ceph_tpu.ops.bitplane.apply_matrix_jax.
+    ceph_tpu.ops.bitplane.apply_matrix_jax: [rows, n] x [n, L] -> [rows, L].
     """
     mat = np.ascontiguousarray(mat, dtype=np.uint8)
     rows, n = mat.shape
-    Bp = jnp.asarray(_permuted_bitmatrix(mat.tobytes(), mat.shape))
-    chunks = jnp.asarray(chunks, dtype=jnp.uint8)
+    G = _pick_group(rows, n)
+    Bk, Pk = _kron_matrices(mat.tobytes(), mat.shape, G)
+    B = jnp.asarray(Bk)
+    P = jnp.asarray(Pk, jnp.bfloat16)
+    if isinstance(chunks, np.ndarray):
+        chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+    else:
+        chunks = jnp.asarray(chunks, dtype=jnp.uint8)
     L = chunks.shape[1]
-    pad = (-L) % tile
+    seg = G * tile
+    pad = (-L) % seg
     if pad:
-        chunks = jnp.pad(chunks, ((0, 0), (0, pad)))
-    out = _apply_padded(Bp, chunks, rows, n, tile, interpret)
+        if isinstance(chunks, np.ndarray):
+            chunks = np.pad(chunks, ((0, 0), (0, pad)))
+        else:
+            chunks = jnp.pad(chunks, ((0, 0), (0, pad)))
+    Lp = L + pad
+    # row-major reshape [n, Lp] -> [n*G, Lp/G] is free on host arrays and a
+    # relayout copy on device arrays (still far cheaper than the kernel win)
+    xg = chunks.reshape(n * G, Lp // G)
+    out = _apply_grouped(B, P, jnp.asarray(xg), rows, n, G, tile, interpret)
+    out = out.reshape(rows, Lp)
     return out[:, :L] if pad else out

@@ -1,0 +1,925 @@
+"""OSD daemon — the EC data plane tied end-to-end (reference: src/osd/OSD.cc
+boot/dispatch, src/osd/PrimaryLogPG.cc op execution, src/osd/ECBackend.cc
+encode/fan-out/reconstruct/recover; SURVEY.md §3.1-3.2 call stacks).
+
+One OSD process = messenger (lossless peer policy) + MonClient session +
+ObjectStore + per-PG state.  The data model is the reference's at object
+granularity:
+
+- write: primary encodes the object through the pool's EC profile codec
+  (ErasureCodePluginRegistry — the TPU path), ships one chunk per shard as
+  MECSubOpWrite (each carrying the pg_log entry), commits its own shard,
+  acks the client once every reachable acting shard commits
+  (ECBackend::submit_transaction shape).
+- read: primary gathers k chunks (local + MECSubOpRead), reconstructs
+  through minimum_to_decode/decode when shards are gone
+  (objects_read_and_reconstruct), reassembles bytes.
+- recovery: on map change the primary runs peering-lite — MPGQuery each
+  acting shard, delta-push objects the peer's pg_log version misses
+  (PGLog.missing_since), or full-backfill a shard whose log is too old
+  (recover_object / backfill split, §5.4).
+
+Scope notes vs the reference: full-object writes (no partial-stripe RMW),
+scalar versions rather than eversion_t, and peering without the
+boost::statechart machine — the invariants these protect (log/data
+atomicity, ack-after-all-commit, delta-vs-backfill choice) are kept.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..common.crc32c import crc32c
+from ..ec.registry import ErasureCodePluginRegistry
+from ..mon.mon_client import MonClient
+from ..msg import Dispatcher, Messenger
+from ..msg.messenger import POLICY_LOSSLESS_PEER
+from ..osd.osdmap import OSDMap, PG_POOL_ERASURE
+from ..store.memstore import MemStore
+from ..store.object_store import NotFound, Transaction
+from .messages import (
+    MECSubOpRead,
+    MECSubOpReadReply,
+    MECSubOpWrite,
+    MECSubOpWriteReply,
+    MOSDOp,
+    MOSDOpReply,
+    MOSDPingMsg,
+    MPGNotify,
+    MPGQuery,
+    pack_data,
+    unpack_data,
+)
+from .pg_log import LogEntry, PGLog
+
+import numpy as np
+
+
+def object_ps(oid: str, pg_num: int) -> int:
+    """Object name -> placement seed (reference: ceph_str_hash + stable_mod
+    in OSDMap::object_locator_to_pg)."""
+    from ..osd.osdmap import ceph_stable_mod, pg_num_mask
+    import zlib
+
+    # rjenkins string hash analog: crc32c is stable, fast, and shared with
+    # the C++ oracle; only stability matters for placement
+    h = crc32c(oid.encode())
+    return ceph_stable_mod(h, pg_num, pg_num_mask(pg_num))
+
+
+class PGState:
+    def __init__(self, pgid: str, pool_id: int, ps: int):
+        self.pgid = pgid
+        self.pool_id = pool_id
+        self.ps = ps
+        self.log = PGLog()
+        self.version = 0
+        self.lock = threading.RLock()
+
+    def meta_oid(self) -> str:
+        return "_pgmeta"
+
+
+class OSD(Dispatcher):
+    """reference: src/osd/OSD.{h,cc} (boot, dispatch, heartbeats) +
+    PrimaryLogPG/ECBackend op execution, collapsed to one class."""
+
+    def __init__(self, cct, osd_id: int, mon_addrs, store=None):
+        self.cct = cct
+        self.id = osd_id
+        self.whoami = f"osd.{osd_id}"
+        self.store = store if store is not None else MemStore()
+        self.messenger = Messenger.create(cct, self.whoami)
+        self.messenger.default_policy = POLICY_LOSSLESS_PEER
+        self.messenger.add_dispatcher(self)
+        self.mc = MonClient(cct, mon_addrs, name=f"{self.whoami}-monc")
+        self.osdmap: OSDMap | None = None
+        self.pgs: dict[str, PGState] = {}
+        self._pgs_lock = threading.RLock()
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._sub_replies: dict[int, dict] = {}   # tid -> reply fields
+        self._tid = 0
+        self._stop = threading.Event()
+        self._tick_thread: threading.Thread | None = None
+        self._hb_failures: dict[int, int] = {}
+        self._codecs: dict[str, object] = {}
+        self._recovery_wakeup = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.store.mount()
+        addr = self.messenger.bind(("127.0.0.1", 0))
+        self.messenger.start()
+        self.mc.subscribe_osdmap(callback=self._on_map)
+        self.mc.send_boot(self.id, addr)
+        self.osdmap = self.mc.wait_for_osdmap(timeout=30.0)
+        self._load_pgs()
+        self._tick_thread = threading.Thread(
+            target=self._tick_loop, name=f"{self.whoami}-tick", daemon=True
+        )
+        self._tick_thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._recovery_wakeup.set()
+        self.mc.shutdown()
+        self.messenger.shutdown()
+        if self._tick_thread is not None:
+            self._tick_thread.join(timeout=5)
+        self.store.umount()
+
+    # -- map handling ------------------------------------------------------
+    def _on_map(self, m: OSDMap) -> None:
+        self.osdmap = m
+        self._recovery_wakeup.set()  # re-peer with the new map
+
+    def my_epoch(self) -> int:
+        return self.osdmap.epoch if self.osdmap else 0
+
+    # -- helpers -----------------------------------------------------------
+    def _codec_for_pool(self, pool):
+        """Per-profile compiled codec cache (reference: ECBackend holds its
+        ErasureCodeInterfaceRef; SURVEY.md §2.9 'per-profile kernel cache')."""
+        name = pool.ec_profile or ""
+        codec = self._codecs.get(name)
+        if codec is None:
+            profile = dict(self.osdmap.ec_profiles.get(name) or {})
+            profile.setdefault("plugin", "jax")
+            codec = ErasureCodePluginRegistry.instance().factory(profile)
+            self._codecs[name] = codec
+        return codec
+
+    def _acting(self, pool_id: int, ps: int) -> tuple[list[int], int]:
+        up, up_p, acting, acting_p = self.osdmap.pg_to_up_acting_osds(
+            pool_id, ps
+        )
+        return acting, acting_p
+
+    def _pg(self, pool_id: int, ps: int) -> PGState:
+        pgid = f"{pool_id}.{ps}"
+        with self._pgs_lock:
+            pg = self.pgs.get(pgid)
+            if pg is None:
+                pg = PGState(pgid, pool_id, ps)
+                self._load_pg_meta(pg)
+                self.pgs[pgid] = pg
+            return pg
+
+    def _cid(self, pgid: str, shard: int) -> str:
+        return f"{pgid}s{shard}"
+
+    def _conn_to_osd(self, osd: int):
+        addr = self.osdmap.osd_addrs.get(osd)
+        if addr is None:
+            raise ConnectionError(f"no address for osd.{osd}")
+        return self.messenger.connect(tuple(addr))
+
+    def _next_tid(self) -> int:
+        with self._lock:
+            self._tid += 1
+            return self._tid
+
+    # -- persistence of PG meta -------------------------------------------
+    def _load_pgs(self) -> None:
+        for cid in self.store.list_collections():
+            if "s" not in cid or "." not in cid:
+                continue
+            pgid = cid.rsplit("s", 1)[0]
+            pool_id, ps = pgid.split(".")
+            self._pg(int(pool_id), int(ps))
+
+    def _load_pg_meta(self, pg: PGState) -> None:
+        # any shard collection of this pg carries the meta object
+        for cid in self.store.list_collections():
+            if cid.rsplit("s", 1)[0] != pg.pgid:
+                continue
+            try:
+                pairs = self.store.omap_get(cid, pg.meta_oid())
+            except (NotFound, KeyError):
+                continue
+            head = int(pairs.get("head", b"0"))
+            tail = int(pairs.get("tail", b"0"))
+            pg.log = PGLog.load(pairs, head, tail)
+            pg.version = head
+            return
+
+    def _log_txn(self, t: Transaction, cid: str, pg: PGState,
+                 entry: LogEntry) -> None:
+        """Append the log entry + version keys to the same transaction as
+        the data op (log/data atomicity, reference: PGLog::write_log)."""
+        import json
+
+        trimmed = pg.log.append(entry)
+        pg.version = entry.version
+        keys = {
+            PGLog.omap_key(entry.version): json.dumps(entry.to_list()).encode(),
+            "head": str(pg.log.head).encode(),
+            "tail": str(pg.log.tail).encode(),
+        }
+        t.touch(cid, pg.meta_oid())
+        t.omap_setkeys(cid, pg.meta_oid(), keys)
+        if trimmed:
+            t.omap_rmkeys(
+                cid, pg.meta_oid(), [PGLog.omap_key(e.version) for e in trimmed]
+            )
+
+    # -- dispatch ----------------------------------------------------------
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MOSDOp):
+            threading.Thread(
+                target=self._handle_client_op, args=(conn, msg),
+                name=f"{self.whoami}-op", daemon=True,
+            ).start()
+            return True
+        if isinstance(msg, MECSubOpWrite):
+            self._handle_sub_write(conn, msg)
+            return True
+        if isinstance(msg, MECSubOpRead):
+            self._handle_sub_read(conn, msg)
+            return True
+        if isinstance(msg, (MECSubOpWriteReply, MECSubOpReadReply, MPGNotify)):
+            with self._lock:
+                self._sub_replies[msg.tid] = msg
+                self._cond.notify_all()
+            return True
+        if isinstance(msg, MPGQuery):
+            self._handle_pg_query(conn, msg)
+            return True
+        if isinstance(msg, MOSDPingMsg):
+            if msg.op == "ping":
+                try:
+                    conn.send_message(
+                        MOSDPingMsg(op="reply", osd=self.id, epoch=self.my_epoch())
+                    )
+                except (OSError, ConnectionError):
+                    pass
+            elif msg.op == "reply":
+                self._hb_failures.pop(msg.osd, None)
+            return True
+        return False
+
+    def _wait_reply(self, tid: int, timeout: float = 10.0):
+        with self._lock:
+            ok = self._cond.wait_for(
+                lambda: tid in self._sub_replies, timeout=timeout
+            )
+            return self._sub_replies.pop(tid, None) if ok else None
+
+    # -- client ops (primary) ---------------------------------------------
+    def _handle_client_op(self, conn, msg: MOSDOp) -> None:
+        try:
+            reply = self._execute_client_op(msg)
+        except Exception as e:  # never leave the client hanging
+            self.cct.dout("osd", 0, f"{self.whoami} op failed: {e!r}")
+            reply = MOSDOpReply(
+                tid=msg.tid, retval=-5, epoch=self.my_epoch(),
+                result=f"internal error: {e}",
+            )
+        try:
+            conn.send_message(reply)
+        except (OSError, ConnectionError):
+            pass
+
+    def _execute_client_op(self, msg: MOSDOp) -> MOSDOpReply:
+        m = self.osdmap
+        pool = m.pools.get(msg.pool) if m else None
+        if m is None or pool is None:
+            return MOSDOpReply(tid=msg.tid, retval=-2, epoch=self.my_epoch(),
+                               result="no such pool")
+        if msg.op == "list" and msg.oid and msg.oid.startswith(":pg:"):
+            ps = int(msg.oid[4:])  # pg-targeted listing (tools/librados)
+        else:
+            ps = object_ps(msg.oid, pool.pg_num) if msg.oid else 0
+        acting, primary = self._acting(msg.pool, ps)
+        if primary != self.id:
+            # client raced a map change (Objecter resend rule)
+            return MOSDOpReply(
+                tid=msg.tid, retval=-116, epoch=self.my_epoch(),
+                result={"primary": primary},
+            )
+        pg = self._pg(msg.pool, ps)
+        if pool.type == PG_POOL_ERASURE:
+            return self._ec_op(pg, pool, acting, msg)
+        return self._replicated_op(pg, pool, acting, msg)
+
+    # .. EC pool ...........................................................
+    def _ec_op(self, pg: PGState, pool, acting: list[int], msg: MOSDOp):
+        codec = self._codec_for_pool(pool)
+        my_shard = acting.index(self.id)
+        if msg.op == "write_full":
+            data = unpack_data(msg.data) or b""
+            with pg.lock:
+                return self._ec_write(
+                    pg, pool, codec, acting, my_shard, msg, data
+                )
+        if msg.op == "read":
+            return self._ec_read(pg, codec, acting, msg)
+        if msg.op == "delete":
+            with pg.lock:
+                return self._ec_delete(pg, acting, my_shard, msg)
+        if msg.op == "stat":
+            try:
+                size = int(
+                    self.store.getattr(
+                        self._cid(pg.pgid, my_shard), msg.oid, "size"
+                    )
+                )
+                return MOSDOpReply(tid=msg.tid, retval=0,
+                                   epoch=self.my_epoch(),
+                                   result={"size": size, "version": pg.version})
+            except (NotFound, KeyError):
+                return MOSDOpReply(tid=msg.tid, retval=-2,
+                                   epoch=self.my_epoch(), result="not found")
+        if msg.op == "list":
+            oids = sorted(
+                o for o in self.store.list_objects(self._cid(pg.pgid, my_shard))
+                if not o.startswith("_")
+            )
+            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                               result={"oids": oids})
+        return MOSDOpReply(tid=msg.tid, retval=-22, epoch=self.my_epoch(),
+                           result=f"bad op {msg.op}")
+
+    def _ec_write(self, pg, pool, codec, acting, my_shard, msg, data) -> MOSDOpReply:
+        n = codec.get_chunk_count()
+        enc = codec.encode(set(range(n)), data)
+        version = pg.version + 1
+        # entry rides a 4th element (object size) so every shard can answer
+        # size/stat even after the primary moves
+        entry = LogEntry(version, "modify", msg.oid)
+        wire_entry = entry.to_list() + [len(data)]
+        tids: dict[int, int] = {}
+        for shard, osd in enumerate(acting):
+            if shard == my_shard or osd < 0:
+                continue
+            if not self.osdmap.is_up(osd):
+                continue
+            chunk = np.asarray(enc[shard], np.uint8).tobytes()
+            tid = self._next_tid()
+            tids[tid] = shard
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MECSubOpWrite(
+                        tid=tid, pgid=pg.pgid, oid=msg.oid, shard=shard,
+                        data=pack_data(chunk), crc=crc32c(chunk),
+                        version=version, entry=wire_entry,
+                        epoch=self.my_epoch(),
+                    )
+                )
+            except (OSError, ConnectionError):
+                tids.pop(tid, None)
+                self.mc.report_failure(osd)
+        # local shard commit (chunk + log in one transaction)
+        cid = self._cid(pg.pgid, my_shard)
+        chunk = np.asarray(enc[my_shard], np.uint8).tobytes()
+        t = Transaction()
+        t.create_collection(cid)
+        t.write(cid, msg.oid, 0, chunk)
+        t.truncate(cid, msg.oid, len(chunk))
+        t.setattr(cid, msg.oid, "hinfo", str(crc32c(chunk)).encode())
+        t.setattr(cid, msg.oid, "size", str(len(data)).encode())
+        self._log_txn(t, cid, pg, entry)
+        self.store.queue_transaction(t)
+        acked = 1
+        failed: list[int] = []
+        for tid, shard in tids.items():
+            rep = self._wait_reply(tid)
+            if rep is not None and rep.retval == 0:
+                acked += 1
+            else:
+                failed.append(acting[shard])
+        for osd in failed:
+            self.mc.report_failure(osd)
+        # ack once every reachable shard committed, and never below
+        # min_size (degraded writes proceed; recovery fills the rest —
+        # reference: ECBackend requires min_size acting shards)
+        reachable = 1 + len(tids)
+        if acked >= max(pool.min_size, reachable - len(failed)) or (
+            acked == reachable and acked >= pool.min_size
+        ):
+            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                               result={"version": pg.version, "acked": acked})
+        return MOSDOpReply(tid=msg.tid, retval=-11, epoch=self.my_epoch(),
+                           result=f"only {acked} shard commits")
+
+    def _ec_delete(self, pg, acting, my_shard, msg) -> MOSDOpReply:
+        version = pg.version + 1
+        entry = LogEntry(version, "delete", msg.oid)
+        tids: dict[int, int] = {}
+        for shard, osd in enumerate(acting):
+            if shard == my_shard or osd < 0 or not self.osdmap.is_up(osd):
+                continue
+            tid = self._next_tid()
+            tids[tid] = shard
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MECSubOpWrite(
+                        tid=tid, pgid=pg.pgid, oid=msg.oid, shard=shard,
+                        data=None, crc=None, version=version,
+                        entry=entry.to_list(), epoch=self.my_epoch(),
+                    )
+                )
+            except (OSError, ConnectionError):
+                tids.pop(tid, None)
+        cid = self._cid(pg.pgid, my_shard)
+        t = Transaction()
+        t.create_collection(cid)
+        try:
+            self.store.stat(cid, msg.oid)
+            t.remove(cid, msg.oid)
+        except (NotFound, KeyError):
+            pass
+        self._log_txn(t, cid, pg, entry)
+        self.store.queue_transaction(t)
+        for tid in tids:
+            self._wait_reply(tid)
+        return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                           result={"version": pg.version})
+
+    def _gather_chunks(
+        self, pg, codec, acting, oid: str, want: set[int]
+    ) -> dict[int, bytes]:
+        """Fetch chunk bytes for shard ids in `want` (local or remote)."""
+        got: dict[int, bytes] = {}
+        tids: dict[int, int] = {}
+        for shard in sorted(want):
+            osd = acting[shard] if shard < len(acting) else -1
+            if osd == self.id:
+                try:
+                    got[shard] = self.store.read(
+                        self._cid(pg.pgid, shard), oid
+                    )
+                except (NotFound, KeyError):
+                    pass
+                continue
+            if osd < 0 or not self.osdmap.is_up(osd):
+                continue
+            tid = self._next_tid()
+            tids[tid] = shard
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MECSubOpRead(tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
+                                 offsets=None, epoch=self.my_epoch())
+                )
+            except (OSError, ConnectionError):
+                tids.pop(tid, None)
+        for tid, shard in tids.items():
+            rep = self._wait_reply(tid)
+            if rep is not None and rep.retval == 0:
+                got[shard] = unpack_data(rep.data)
+        return got
+
+    def _ec_read(self, pg, codec, acting, msg) -> MOSDOpReply:
+        k = codec.get_data_chunk_count()
+        n = codec.get_chunk_count()
+        my_shard = acting.index(self.id) if self.id in acting else -1
+        # size from any shard we can reach (primary's own shard normally)
+        size = None
+        if my_shard >= 0:
+            try:
+                size = int(self.store.getattr(
+                    self._cid(pg.pgid, my_shard), msg.oid, "size"))
+            except (NotFound, KeyError):
+                pass
+        want_data = set(range(k))
+        got = self._gather_chunks(pg, codec, acting, msg.oid, want_data)
+        missing = want_data - set(got)
+        if missing:
+            # degraded: consult minimum_to_decode over everything reachable
+            avail_probe = self._gather_chunks(
+                pg, codec, acting, msg.oid, set(range(k, n))
+            )
+            avail_probe.update(got)
+            if len(avail_probe) < k:
+                return MOSDOpReply(
+                    tid=msg.tid, retval=-5, epoch=self.my_epoch(),
+                    result=f"unreadable: only {len(avail_probe)} chunks",
+                )
+            chunks = {
+                s: np.frombuffer(b, dtype=np.uint8)
+                for s, b in avail_probe.items()
+            }
+            need = codec.minimum_to_decode(want_data, set(chunks))
+            dec = codec.decode(
+                want_data, {s: chunks[s] for s in need if s in chunks},
+                len(next(iter(chunks.values()))),
+            )
+            data = b"".join(
+                np.asarray(dec[i], np.uint8).tobytes() for i in range(k)
+            )
+        else:
+            data = b"".join(got[i] for i in range(k))
+        if size is None:
+            # fall back to stored stripe size (no padding info): strip NULs
+            size = len(data)
+        obj = data[:size]
+        if msg.off or (msg.length or 0) > 0:
+            off = msg.off or 0
+            ln = msg.length if msg.length else len(obj) - off
+            obj = obj[off : off + ln]
+        return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                           data=pack_data(obj),
+                           result={"size": size})
+
+    # .. replicated pool ...................................................
+    def _replicated_op(self, pg, pool, acting, msg) -> MOSDOpReply:
+        """Primary-copy replication (reference: ReplicatedBackend): full
+        object bytes to every acting replica, same log machinery."""
+        acting = [o for o in acting if o >= 0]
+        my_shard = 0  # replicated: every replica stores the full object
+        cid = self._cid(pg.pgid, 0)
+        if msg.op == "write_full":
+            data = unpack_data(msg.data) or b""
+            with pg.lock:
+                version = pg.version + 1
+                entry = LogEntry(version, "modify", msg.oid)
+                tids = {}
+                for osd in acting:
+                    if osd == self.id or not self.osdmap.is_up(osd):
+                        continue
+                    tid = self._next_tid()
+                    tids[tid] = osd
+                    try:
+                        self._conn_to_osd(osd).send_message(
+                            MECSubOpWrite(
+                                tid=tid, pgid=pg.pgid, oid=msg.oid, shard=0,
+                                data=msg.data, crc=crc32c(data),
+                                version=version,
+                                entry=entry.to_list() + [len(data)],
+                                epoch=self.my_epoch(),
+                            )
+                        )
+                    except (OSError, ConnectionError):
+                        tids.pop(tid, None)
+                t = Transaction()
+                t.create_collection(cid)
+                t.write(cid, msg.oid, 0, data)
+                t.truncate(cid, msg.oid, len(data))
+                t.setattr(cid, msg.oid, "size", str(len(data)).encode())
+                self._log_txn(t, cid, pg, entry)
+                self.store.queue_transaction(t)
+                acked = 1
+                for tid in tids:
+                    rep = self._wait_reply(tid)
+                    if rep is not None and rep.retval == 0:
+                        acked += 1
+                if acked >= pool.min_size:
+                    return MOSDOpReply(
+                        tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                        result={"version": pg.version, "acked": acked},
+                    )
+                return MOSDOpReply(tid=msg.tid, retval=-11,
+                                   epoch=self.my_epoch(),
+                                   result=f"only {acked} replica commits")
+        if msg.op == "read":
+            try:
+                data = self.store.read(cid, msg.oid)
+            except (NotFound, KeyError):
+                return MOSDOpReply(tid=msg.tid, retval=-2,
+                                   epoch=self.my_epoch(), result="not found")
+            if msg.off or (msg.length or 0) > 0:
+                off = msg.off or 0
+                ln = msg.length if msg.length else len(data) - off
+                data = data[off : off + ln]
+            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                               data=pack_data(data), result={})
+        if msg.op == "delete":
+            with pg.lock:
+                version = pg.version + 1
+                entry = LogEntry(version, "delete", msg.oid)
+                for osd in acting:
+                    if osd == self.id or not self.osdmap.is_up(osd):
+                        continue
+                    tid = self._next_tid()
+                    try:
+                        self._conn_to_osd(osd).send_message(
+                            MECSubOpWrite(
+                                tid=tid, pgid=pg.pgid, oid=msg.oid, shard=0,
+                                data=None, crc=None, version=version,
+                                entry=entry.to_list(), epoch=self.my_epoch(),
+                            )
+                        )
+                    except (OSError, ConnectionError):
+                        pass
+                t = Transaction()
+                t.create_collection(cid)
+                try:
+                    self.store.stat(cid, msg.oid)
+                    t.remove(cid, msg.oid)
+                except (NotFound, KeyError):
+                    pass
+                self._log_txn(t, cid, pg, entry)
+                self.store.queue_transaction(t)
+            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                               result={})
+        if msg.op == "stat":
+            try:
+                st = self.store.stat(cid, msg.oid)
+                return MOSDOpReply(tid=msg.tid, retval=0,
+                                   epoch=self.my_epoch(), result=st)
+            except (NotFound, KeyError):
+                return MOSDOpReply(tid=msg.tid, retval=-2,
+                                   epoch=self.my_epoch(), result="not found")
+        if msg.op == "list":
+            oids = sorted(
+                o for o in self.store.list_objects(cid)
+                if not o.startswith("_")
+            )
+            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                               result={"oids": oids})
+        return MOSDOpReply(tid=msg.tid, retval=-22, epoch=self.my_epoch(),
+                           result=f"bad op {msg.op}")
+
+    # -- shard sub-ops -----------------------------------------------------
+    def _handle_sub_write(self, conn, msg: MECSubOpWrite) -> None:
+        pool_id, ps = msg.pgid.split(".")
+        pg = self._pg(int(pool_id), int(ps))
+        cid = self._cid(msg.pgid, msg.shard)
+        retval = 0
+        try:
+            with pg.lock:
+                t = Transaction()
+                t.create_collection(cid)
+                if msg.data is None:
+                    try:
+                        self.store.stat(cid, msg.oid)
+                        t.remove(cid, msg.oid)
+                    except (NotFound, KeyError):
+                        pass
+                else:
+                    chunk = unpack_data(msg.data)
+                    if crc32c(chunk) != msg.crc:
+                        raise IOError("chunk crc mismatch")
+                    t.write(cid, msg.oid, 0, chunk)
+                    t.truncate(cid, msg.oid, len(chunk))
+                    t.setattr(cid, msg.oid, "hinfo", str(msg.crc).encode())
+                    if msg.entry and len(msg.entry) > 3:
+                        t.setattr(cid, msg.oid, "size",
+                                  str(msg.entry[3]).encode())
+                if msg.entry is not None and msg.version > pg.version:
+                    entry = LogEntry.from_list(msg.entry[:3])
+                    self._log_txn(t, cid, pg, entry)
+                self.store.queue_transaction(t)
+        except Exception as e:
+            self.cct.dout("osd", 0, f"{self.whoami} sub_write failed: {e!r}")
+            retval = -5
+        try:
+            conn.send_message(
+                MECSubOpWriteReply(tid=msg.tid, pgid=msg.pgid,
+                                   shard=msg.shard, retval=retval)
+            )
+        except (OSError, ConnectionError):
+            pass
+
+    def _handle_sub_read(self, conn, msg: MECSubOpRead) -> None:
+        cid = self._cid(msg.pgid, msg.shard)
+        try:
+            if msg.offsets:
+                parts = []
+                for off, ln in msg.offsets:
+                    if ln == -1:
+                        parts.append(self.store.read(cid, msg.oid))
+                    else:
+                        parts.append(self.store.read(cid, msg.oid, off, ln))
+                data = b"".join(parts)
+            else:
+                data = self.store.read(cid, msg.oid)
+            reply = MECSubOpReadReply(
+                tid=msg.tid, pgid=msg.pgid, oid=msg.oid, shard=msg.shard,
+                retval=0, data=pack_data(data),
+            )
+        except (NotFound, KeyError):
+            reply = MECSubOpReadReply(
+                tid=msg.tid, pgid=msg.pgid, oid=msg.oid, shard=msg.shard,
+                retval=-2, data=None,
+            )
+        try:
+            conn.send_message(reply)
+        except (OSError, ConnectionError):
+            pass
+
+    def _handle_pg_query(self, conn, msg: MPGQuery) -> None:
+        pool_id, ps = msg.pgid.split(".")
+        pg = self._pg(int(pool_id), int(ps))
+        cid = self._cid(msg.pgid, msg.shard)
+        oids = []
+        try:
+            oids = sorted(
+                o for o in self.store.list_objects(cid)
+                if not o.startswith("_")
+            )
+        except (NotFound, KeyError):
+            pass
+        try:
+            conn.send_message(
+                MPGNotify(tid=msg.tid, pgid=msg.pgid, shard=msg.shard,
+                          version=pg.version, log_start=pg.log.tail,
+                          oids=oids)
+            )
+        except (OSError, ConnectionError):
+            pass
+
+    # -- heartbeats + recovery tick ---------------------------------------
+    def _tick_loop(self) -> None:
+        interval = 1.0
+        last_hb = 0.0
+        while not self._stop.is_set():
+            self._recovery_wakeup.wait(timeout=interval)
+            self._recovery_wakeup.clear()
+            if self._stop.is_set():
+                return
+            now = time.monotonic()
+            try:
+                if now - last_hb >= 2.0:
+                    last_hb = now
+                    self._heartbeat()
+                self._recover_all()
+            except Exception as e:
+                self.cct.dout("osd", 0, f"{self.whoami} tick failed: {e!r}")
+
+    def _heartbeat(self) -> None:
+        """Ping peers sharing PGs with us (reference: OSD::heartbeat);
+        after 3 silent intervals report the peer to the mon (§5.3)."""
+        m = self.osdmap
+        if m is None:
+            return
+        peers: set[int] = set()
+        with self._pgs_lock:
+            pgs = list(self.pgs.values())
+        for pg in pgs:
+            try:
+                acting, _ = self._acting(pg.pool_id, pg.ps)
+            except KeyError:
+                continue
+            peers |= {o for o in acting if o >= 0 and o != self.id}
+        for osd in peers:
+            if not m.is_up(osd):
+                continue
+            prev = self._hb_failures.get(osd, 0)
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MOSDPingMsg(op="ping", osd=self.id, epoch=self.my_epoch())
+                )
+                self._hb_failures[osd] = prev + 1
+            except (OSError, ConnectionError):
+                self._hb_failures[osd] = prev + 1
+            if self._hb_failures.get(osd, 0) >= 3:
+                self.mc.report_failure(osd, failed_for=6.0)
+
+    # -- recovery (peering-lite, primary only) ----------------------------
+    def _recover_all(self) -> None:
+        m = self.osdmap
+        if m is None:
+            return
+        # discover PGs I'm primary for (incl. ones with no local data yet)
+        for pool_id, pool in m.pools.items():
+            for ps in range(pool.pg_num):
+                try:
+                    acting, primary = self._acting(pool_id, ps)
+                except KeyError:
+                    continue
+                if primary != self.id or self.id not in acting:
+                    continue
+                pg = self._pg(pool_id, ps)
+                with pg.lock:
+                    try:
+                        self._recover_pg(pg, pool, acting)
+                    except Exception as e:
+                        self.cct.dout(
+                            "osd", 1,
+                            f"{self.whoami} recover {pg.pgid}: {e!r}",
+                        )
+
+    def _recover_pg(self, pg: PGState, pool, acting: list[int]) -> None:
+        if pg.version == 0:
+            return  # nothing written yet
+        is_ec = pool.type == PG_POOL_ERASURE
+        codec = self._codec_for_pool(pool) if is_ec else None
+        for shard, osd in enumerate(acting):
+            if osd < 0 or osd == self.id or not self.osdmap.is_up(osd):
+                continue
+            tid = self._next_tid()
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MPGQuery(tid=tid, pgid=pg.pgid, shard=shard,
+                             epoch=self.my_epoch())
+                )
+            except (OSError, ConnectionError):
+                continue
+            rep = self._wait_reply(tid, timeout=5.0)
+            if rep is None or rep.version is None:
+                continue
+            if rep.version >= pg.version:
+                continue  # clean
+            if pg.log.covers(rep.version):
+                newest, deleted = pg.log.missing_since(rep.version)
+                self.cct.dout(
+                    "osd", 1,
+                    f"{self.whoami} delta-recovery {pg.pgid} shard {shard} "
+                    f"osd.{osd}: {len(newest)} objects, {len(deleted)} deletes",
+                )
+                self._push_objects(
+                    pg, codec, acting, shard, osd, newest, deleted, is_ec
+                )
+                self._bump_peer_version(pg, shard, osd, pg.version)
+                pg.stat_delta_recoveries = getattr(
+                    pg, "stat_delta_recoveries", 0) + 1
+            else:
+                # log too old: full backfill of this shard
+                my_shard = acting.index(self.id)
+                oids = [
+                    o for o in self.store.list_objects(
+                        self._cid(pg.pgid, my_shard))
+                    if not o.startswith("_")
+                ]
+                self.cct.dout(
+                    "osd", 1,
+                    f"{self.whoami} backfill {pg.pgid} shard {shard} "
+                    f"osd.{osd}: {len(oids)} objects",
+                )
+                self._push_objects(
+                    pg, codec, acting, shard, osd,
+                    {o: pg.version for o in oids}, set(), is_ec,
+                )
+                self._bump_peer_version(pg, shard, osd, pg.version)
+                pg.stat_backfills = getattr(pg, "stat_backfills", 0) + 1
+
+    def _push_objects(self, pg, codec, acting, shard, osd,
+                      newest: dict[str, int], deleted: set[str],
+                      is_ec: bool) -> None:
+        for oid in sorted(deleted):
+            tid = self._next_tid()
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MECSubOpWrite(tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
+                                  data=None, crc=None, version=None,
+                                  entry=None, epoch=self.my_epoch())
+                )
+                self._wait_reply(tid, timeout=5.0)
+            except (OSError, ConnectionError):
+                return
+        for oid in sorted(newest):
+            chunk, size = self._rebuild_shard_chunk(
+                pg, codec, acting, oid, shard, is_ec
+            )
+            if chunk is None:
+                continue
+            tid = self._next_tid()
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MECSubOpWrite(
+                        tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
+                        data=pack_data(chunk), crc=crc32c(chunk),
+                        version=None,
+                        entry=[0, "modify", oid, size],
+                        epoch=self.my_epoch(),
+                    )
+                )
+                self._wait_reply(tid, timeout=5.0)
+            except (OSError, ConnectionError):
+                return
+
+    def _bump_peer_version(self, pg, shard, osd, version: int) -> None:
+        """Final version/log sync after pushes (entry carries no data)."""
+        tid = self._next_tid()
+        try:
+            self._conn_to_osd(osd).send_message(
+                MECSubOpWrite(
+                    tid=tid, pgid=pg.pgid, oid="_pgmeta_sync", shard=shard,
+                    data=None, crc=None, version=version,
+                    entry=[version, "delete", "_pgmeta_sync"],
+                    epoch=self.my_epoch(),
+                )
+            )
+            self._wait_reply(tid, timeout=5.0)
+        except (OSError, ConnectionError):
+            pass
+
+    def _rebuild_shard_chunk(
+        self, pg, codec, acting, oid: str, shard: int, is_ec: bool
+    ) -> tuple[bytes | None, int]:
+        """Recompute shard `shard`'s bytes for oid (reference:
+        ECBackend::recover_object — read k chunks, re-encode)."""
+        my_shard = acting.index(self.id)
+        if not is_ec:
+            try:
+                data = self.store.read(self._cid(pg.pgid, 0), oid)
+                return data, len(data)
+            except (NotFound, KeyError):
+                return None, 0
+        k = codec.get_data_chunk_count()
+        n = codec.get_chunk_count()
+        try:
+            size = int(self.store.getattr(
+                self._cid(pg.pgid, my_shard), oid, "size"))
+        except (NotFound, KeyError):
+            size = 0
+        got = self._gather_chunks(pg, codec, acting, oid, set(range(n)) - {shard})
+        if len(got) < k:
+            return None, 0
+        chunks = {s: np.frombuffer(b, np.uint8) for s, b in got.items()}
+        dec = codec.decode(
+            {shard}, chunks, len(next(iter(chunks.values())))
+        )
+        return np.asarray(dec[shard], np.uint8).tobytes(), size

@@ -1,0 +1,105 @@
+"""OSD data-plane wire messages (reference: src/messages/MOSDOp.h,
+MOSDOpReply.h, MOSDECSubOpWrite.h/MOSDECSubOpRead.h via src/osd/ECMsgTypes.h,
+and the pg_query/pg_log peering messages; SURVEY.md §3.1-3.2).
+
+Bulk payloads (object data, chunk bytes) ride as latin-1-safe base64 inside
+the JSON body — the framing/crc below is byte-exact either way, and these
+messages are small control frames plus one data segment, matching the
+reference's header/front/data split in spirit if not in zero-copy.
+"""
+from __future__ import annotations
+
+import base64
+
+from ..mon.messages import _JsonMessage
+from ..msg.message import register_message
+
+
+def pack_data(data: bytes | None) -> str | None:
+    return None if data is None else base64.b64encode(bytes(data)).decode()
+
+
+def unpack_data(s: str | None) -> bytes | None:
+    return None if s is None else base64.b64decode(s)
+
+
+@register_message
+class MOSDOp(_JsonMessage):
+    """Client object op to the PG primary (reference: MOSDOp).
+
+    op: write_full | read | delete | stat | list (pg listing for tools).
+    `epoch` is the client's map epoch: a primary on a newer map NACKs with
+    -ESTALE so the client refreshes and resends (Objecter resend rule)."""
+
+    MSG_TYPE = 42
+    FIELDS = ("tid", "pool", "oid", "op", "data", "epoch", "off", "length")
+
+
+@register_message
+class MOSDOpReply(_JsonMessage):
+    """reference: MOSDOpReply — retval + (for reads) data + map epoch."""
+
+    MSG_TYPE = 43
+    FIELDS = ("tid", "retval", "data", "epoch", "result")
+
+
+@register_message
+class MECSubOpWrite(_JsonMessage):
+    """Primary → shard OSD: store one chunk (reference: MOSDECSubOpWrite
+    carrying ECSubWrite: tid, shard transactions, log entries).
+
+    `entry` is the pg_log entry [version, op, oid] the shard must append
+    atomically with the chunk write (delta-recovery bookkeeping)."""
+
+    MSG_TYPE = 108
+    FIELDS = ("tid", "pgid", "oid", "shard", "data", "crc", "version",
+              "entry", "epoch")
+
+
+@register_message
+class MECSubOpWriteReply(_JsonMessage):
+    MSG_TYPE = 109
+    FIELDS = ("tid", "pgid", "shard", "retval")
+
+
+@register_message
+class MECSubOpRead(_JsonMessage):
+    """Primary → shard OSD: fetch chunk bytes (reference: MOSDECSubOpRead).
+    `offsets` carries optional (off, len) sub-chunk ranges (CLAY repair)."""
+
+    MSG_TYPE = 110
+    FIELDS = ("tid", "pgid", "oid", "shard", "offsets", "epoch")
+
+
+@register_message
+class MECSubOpReadReply(_JsonMessage):
+    MSG_TYPE = 111
+    FIELDS = ("tid", "pgid", "oid", "shard", "retval", "data")
+
+
+@register_message
+class MPGQuery(_JsonMessage):
+    """Primary → peer shard: 'what is your PG state?' (reference: MOSDPGQuery
+    driving PeeringState; here the peering-lite version: version + log
+    bounds so the primary can pick delta vs backfill)."""
+
+    MSG_TYPE = 112
+    FIELDS = ("tid", "pgid", "shard", "epoch")
+
+
+@register_message
+class MPGNotify(_JsonMessage):
+    """Peer shard → primary: PG info reply (reference: MOSDPGNotify).
+    version: last applied version; log_start: oldest version still in the
+    bounded log (0 = log covers from the beginning)."""
+
+    MSG_TYPE = 113
+    FIELDS = ("tid", "pgid", "shard", "version", "log_start", "oids")
+
+
+@register_message
+class MOSDPingMsg(_JsonMessage):
+    """OSD↔OSD heartbeat (reference: MOSDPing PING/PING_REPLY)."""
+
+    MSG_TYPE = 70
+    FIELDS = ("op", "osd", "epoch")

@@ -1,0 +1,97 @@
+"""Bounded per-PG op log — the data plane's checkpoint/resume mechanism
+(reference: src/osd/PGLog.{h,cc} + pg_log_entry_t in osd_types.h;
+SURVEY.md §5.4 "an OSD returning after a short outage replays the delta
+instead of full copy").
+
+Simplifications vs the reference, by design:
+- versions are a single monotonically increasing integer per PG (the
+  reference's eversion_t (epoch, version) — epochs matter there because
+  primaries diverge; here the primary serializes all writes and peering
+  truncates stragglers, so a scalar version is sufficient and the
+  divergent-entry rewind machinery collapses into `entries_since`).
+- entries record (version, op, oid); op is "modify" or "delete" — enough
+  to reconstruct a missing-object set, which is all recovery needs.
+
+Persistence: the log rides in the same ObjectStore transaction as the data
+write (omap of the PG meta object), exactly how the reference keeps log and
+data atomic.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+DEFAULT_LOG_LIMIT = 500  # reference: osd_min_pg_log_entries ballpark
+
+
+@dataclass(frozen=True)
+class LogEntry:
+    version: int
+    op: str  # "modify" | "delete"
+    oid: str
+
+    def to_list(self) -> list:
+        return [self.version, self.op, self.oid]
+
+    @classmethod
+    def from_list(cls, v: list) -> "LogEntry":
+        return cls(int(v[0]), str(v[1]), str(v[2]))
+
+
+class PGLog:
+    """In-memory form; persisted as omap keys by the owning PG."""
+
+    def __init__(self, limit: int = DEFAULT_LOG_LIMIT):
+        self.limit = limit
+        self.entries: list[LogEntry] = []  # ascending version
+        self.head = 0          # newest version (0 = empty PG)
+        self.tail = 0          # version BEFORE the oldest retained entry
+
+    def append(self, entry: LogEntry) -> list[LogEntry]:
+        """Append and trim; returns entries trimmed off the tail."""
+        assert entry.version > self.head, (entry, self.head)
+        self.entries.append(entry)
+        self.head = entry.version
+        trimmed: list[LogEntry] = []
+        while len(self.entries) > self.limit:
+            e = self.entries.pop(0)
+            trimmed.append(e)
+            self.tail = e.version
+        return trimmed
+
+    def covers(self, version: int) -> bool:
+        """Can a peer at `version` be delta-recovered from this log?"""
+        return version >= self.tail
+
+    def entries_since(self, version: int) -> list[LogEntry]:
+        return [e for e in self.entries if e.version > version]
+
+    def missing_since(self, version: int) -> tuple[dict[str, int], set[str]]:
+        """(oid -> newest version to recover, oids deleted) for a peer at
+        `version` (reference: pg_missing_t built from log divergence)."""
+        newest: dict[str, int] = {}
+        deleted: set[str] = set()
+        for e in self.entries_since(version):
+            if e.op == "delete":
+                deleted.add(e.oid)
+                newest.pop(e.oid, None)
+            else:
+                deleted.discard(e.oid)
+                newest[e.oid] = e.version
+        return newest, deleted
+
+    # -- persistence -------------------------------------------------------
+    @staticmethod
+    def omap_key(version: int) -> str:
+        return f"log.{version:016d}"
+
+    @classmethod
+    def load(cls, pairs: dict[str, bytes], head: int, tail: int,
+             limit: int = DEFAULT_LOG_LIMIT) -> "PGLog":
+        import json
+
+        log = cls(limit)
+        log.head, log.tail = head, tail
+        for k in sorted(pairs):
+            if k.startswith("log."):
+                log.entries.append(LogEntry.from_list(json.loads(pairs[k])))
+        return log

@@ -1,0 +1,5 @@
+"""Ring-2 test infrastructure: in-process multi-daemon clusters
+(reference: src/vstart.sh + qa/standalone/ceph-helpers.sh; SURVEY.md §4)."""
+from .vstart import LocalCluster
+
+__all__ = ["LocalCluster"]

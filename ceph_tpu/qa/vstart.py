@@ -1,0 +1,236 @@
+"""LocalCluster — vstart.sh analog: N mons + M OSDs in one process on
+localhost sockets, with kill/revive for thrash tests (reference:
+src/vstart.sh; qa/standalone/ceph-helpers.sh `run_mon`/`run_osd`/
+`kill_daemons`; SURVEY.md §4 ring 2).
+
+    with LocalCluster(n_mons=3, n_osds=6) as c:
+        c.create_ec_pool("ecpool", k=4, m=2)
+        io = c.client().open_ioctx("ecpool")
+        io.write_full("x", b"...")
+        c.kill_osd(3)
+        io.read("x")          # degraded read
+        c.revive_osd(3)       # delta recovery kicks in
+"""
+from __future__ import annotations
+
+import socket
+import time
+
+from ..common.context import CephContext
+from ..crush import CrushWrapper, build_hierarchical_map
+from ..mon import MonMap, Monitor
+from ..osd.daemon import OSD
+from ..osd.osdmap import OSDMap
+from ..client.rados import Rados
+
+
+def _free_addrs(n: int) -> list[tuple[str, int]]:
+    socks, addrs = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        addrs.append(("127.0.0.1", s.getsockname()[1]))
+    for s in socks:
+        s.close()
+    return addrs
+
+
+class LocalCluster:
+    def __init__(
+        self,
+        n_mons: int = 3,
+        n_osds: int = 6,
+        hosts: int | None = None,
+        conf_overrides: dict | None = None,
+    ):
+        self.n_mons = n_mons
+        self.n_osds = n_osds
+        self.hosts = hosts or n_osds  # default: one OSD per host bucket
+        self.conf_overrides = dict(conf_overrides or {})
+        self.mons: dict[str, Monitor] = {}
+        self.osds: dict[int, OSD] = {}
+        self.mon_addrs: list = []
+        self._clients: list[Rados] = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "LocalCluster":
+        addrs = _free_addrs(self.n_mons)
+        self.mon_addrs = [list(a) for a in addrs]
+        names = [chr(ord("a") + i) for i in range(self.n_mons)]
+        monmap = MonMap({names[i]: addrs[i] for i in range(self.n_mons)})
+        cmap = build_hierarchical_map(
+            self.hosts, -(-self.n_osds // self.hosts)
+        )
+        initial = OSDMap(CrushWrapper(cmap), max_osd=self.n_osds)
+        for nm in names:
+            cct = self._cct(f"mon.{nm}")
+            mon = Monitor(cct, nm, monmap, initial_osdmap=initial)
+            self.mons[nm] = mon
+            mon.start()
+        deadline = time.time() + 15
+        while time.time() < deadline and not any(
+            m.is_leader() for m in self.mons.values()
+        ):
+            time.sleep(0.05)
+        if not any(m.is_leader() for m in self.mons.values()):
+            raise TimeoutError("no mon leader")
+        for i in range(self.n_osds):
+            self._start_osd(i)
+        # all OSDs booted: wait until every address is registered
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            m = self._leader().osdmon.osdmap
+            if m is not None and len(m.osd_addrs) >= self.n_osds:
+                break
+            time.sleep(0.1)
+        return self
+
+    def _cct(self, name: str) -> CephContext:
+        cct = CephContext(name)
+        for k, v in self.conf_overrides.items():
+            cct.conf.set(k, v)
+        return cct
+
+    def _start_osd(self, i: int, store=None) -> OSD:
+        osd = OSD(self._cct(f"osd.{i}"), i, self.mon_addrs, store=store)
+        self.osds[i] = osd
+        osd.start()
+        return osd
+
+    def _leader(self) -> Monitor:
+        for m in self.mons.values():
+            if m.is_leader():
+                return m
+        raise RuntimeError("no leader")
+
+    def stop(self) -> None:
+        for c in self._clients:
+            try:
+                c.shutdown()
+            except Exception:
+                pass
+        for osd in list(self.osds.values()):
+            try:
+                osd.shutdown()
+            except Exception:
+                pass
+        for mon in self.mons.values():
+            try:
+                mon.shutdown()
+            except Exception:
+                pass
+
+    def __enter__(self) -> "LocalCluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- admin -------------------------------------------------------------
+    def client(self, name: str = "client.admin") -> Rados:
+        r = Rados(self._cct(name), self.mon_addrs, name=name)
+        r.connect()
+        self._clients.append(r)
+        return r
+
+    def mon_command(self, cmd: dict):
+        c = self.client("client.vstart-admin")
+        try:
+            return c.command(cmd)
+        finally:
+            self._clients.remove(c)
+            c.shutdown()
+
+    def create_ec_pool(
+        self, name: str, k: int = 4, m: int = 2, pg_num: int = 8,
+        plugin: str = "jax", extra_profile: dict | None = None,
+    ) -> None:
+        prof = {
+            "prefix": "osd erasure-code-profile set",
+            "name": f"{name}_profile",
+            "profile": {
+                "plugin": plugin, "k": str(k), "m": str(m),
+                "crush-failure-domain": "osd",
+                **(extra_profile or {}),
+            },
+        }
+        rv, res = self.mon_command(prof)
+        assert rv == 0, (rv, res)
+        rv, res = self.mon_command({
+            "prefix": "osd pool create", "name": name, "pg_num": pg_num,
+            "pool_type": "erasure", "erasure_code_profile": f"{name}_profile",
+        })
+        assert rv == 0, (rv, res)
+
+    def create_replicated_pool(self, name: str, size: int = 3,
+                               pg_num: int = 8) -> None:
+        rv, res = self.mon_command({
+            "prefix": "osd pool create", "name": name, "pg_num": pg_num,
+            "size": size,
+        })
+        assert rv == 0, (rv, res)
+
+    # -- fault injection ---------------------------------------------------
+    def kill_osd(self, i: int) -> None:
+        """Hard-stop an OSD, keeping its store for revive (the thrasher's
+        kill; reference: qa/tasks/thrashosds.py)."""
+        osd = self.osds.pop(i)
+        self._stores = getattr(self, "_stores", {})
+        self._stores[i] = osd.store
+        osd.shutdown()
+
+    def revive_osd(self, i: int) -> OSD:
+        store = getattr(self, "_stores", {}).pop(i, None)
+        return self._start_osd(i, store=store)
+
+    def mark_osd_down_out(self, i: int) -> None:
+        """Push the map change without waiting for failure detection."""
+        rv, res = self.mon_command({"prefix": "osd down", "id": i})
+        assert rv == 0, (rv, res)
+        rv, res = self.mon_command({"prefix": "osd out", "id": i})
+        assert rv == 0, (rv, res)
+
+    def mark_osd_in_up(self, i: int) -> None:
+        rv, res = self.mon_command({"prefix": "osd in", "id": i})
+        assert rv == 0, (rv, res)
+
+    def wait_clean(self, pool: str, timeout: float = 30.0) -> None:
+        """Wait until every shard of every PG of a pool reports the
+        primary's version (recovery settled)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self._all_clean(pool):
+                return
+            time.sleep(0.3)
+        raise TimeoutError(f"pool {pool} not clean after {timeout}s")
+
+    def _all_clean(self, pool_name: str) -> bool:
+        leader = self._leader()
+        m = leader.osdmon.osdmap
+        if m is None:
+            return False
+        pid = next(
+            (i for i, p in m.pools.items() if p.name == pool_name), None
+        )
+        if pid is None:
+            return False
+        pool = m.pools[pid]
+        for ps in range(pool.pg_num):
+            _up, _upp, acting, primary = m.pg_to_up_acting_osds(pid, ps)
+            posd = self.osds.get(primary)
+            if posd is None:
+                return False
+            ppg = posd.pgs.get(f"{pid}.{ps}")
+            if ppg is None or ppg.version == 0:
+                continue  # nothing written to this PG
+            for shard, o in enumerate(acting):
+                if o < 0:
+                    continue
+                sosd = self.osds.get(o)
+                if sosd is None:
+                    return False
+                spg = sosd.pgs.get(f"{pid}.{ps}")
+                if spg is None or spg.version < ppg.version:
+                    return False
+        return True

@@ -86,7 +86,7 @@ def test_encode_parity_identical(k, m, fast):
 
 
 def test_fast_path_runs_simd_or_reports():
-    # gfo_apply_fast returns 1 when the PSHUFB path ran — record which
+    # gfo_apply_fast returns 2 for AVX2, 1 for SSSE3, 0 for scalar
     coding = vandermonde_coding_matrix(4, 2)
     data = np.zeros((4, 64), dtype=np.uint8)
     out = np.empty((2, 64), dtype=np.uint8)
@@ -94,7 +94,7 @@ def test_fast_path_runs_simd_or_reports():
         np.ascontiguousarray(coding, dtype=np.uint8).reshape(-1), 2, 4,
         data.reshape(-1), 64, out.reshape(-1),
     )
-    assert rc in (0, 1)
+    assert rc in (0, 1, 2)
 
 
 @pytest.mark.parametrize("k,m", [(8, 4), (6, 3)])
