@@ -57,6 +57,12 @@ def enable_x64():
 
 S64_MIN = np.int64(np.iinfo(np.int64).min)
 
+# Max x per device launch.  Empirically (v5e, 1024-OSD hierarchical map):
+# one vmapped launch at 1M x crashes the TPU worker process outright, while
+# <=512k launches complete; 256k leaves 2x margin and still amortizes
+# dispatch to noise.
+_BATCH_CHUNK = 1 << 18
+
 
 def validate_choose_args(
     cmap: CrushMap, name: str
@@ -115,6 +121,7 @@ class CompiledCrushMap:
         self.n_idx = n_idx
         self.max_size = max_size
         self._choose_args_cache: dict[str, jnp.ndarray] = {}
+        self._rule_fn_cache: dict = {}
 
     def choose_args_arrays(self, name: str) -> jnp.ndarray:
         """Dense [positions, n_idx, max_size] weight array for a named
@@ -451,35 +458,64 @@ def crush_do_rule_batch(
     crushtool-analog --test, and the osdmaptool-analog --test-map-pgs.
     firstn results are dense with ITEM_NONE tail padding; indep results keep
     positional ITEM_NONE holes (EC shard semantics)."""
-    p = compile_rule(cm, rule_id, numrep)
-    cweights = (
-        cm.choose_args_arrays(choose_args) if choose_args is not None else None
-    )
-    fn = _choose_firstn_single if p["firstn"] else _choose_indep_single
-    tries = p["tries"]
-    recurse_tries = (
-        (p["leaf_tries"] or tries) if p["firstn"] else (p["leaf_tries"] or 1)
-    )
-
-    def single(x):
-        out, out2, cnt = fn(
-            cm,
-            weightvec,
-            x,
-            p["take"],
-            p["want"],
-            p["type"],
-            tries,
-            p["recurse"],
-            recurse_tries,
-            cweights,
+    key = (rule_id, numrep, choose_args)
+    vf = cm._rule_fn_cache.get(key)
+    if vf is None:
+        p = compile_rule(cm, rule_id, numrep)
+        cweights = (
+            cm.choose_args_arrays(choose_args)
+            if choose_args is not None
+            else None
         )
-        res = out2 if p["recurse"] else out
-        if p["firstn"]:
-            res = jnp.where(jnp.arange(res.shape[0]) < cnt, res, ITEM_NONE)
-        return res
+        fn = _choose_firstn_single if p["firstn"] else _choose_indep_single
+        tries = p["tries"]
+        recurse_tries = (
+            (p["leaf_tries"] or tries) if p["firstn"] else (p["leaf_tries"] or 1)
+        )
+
+        def single(x, wv):
+            out, out2, cnt = fn(
+                cm,
+                wv,
+                x,
+                p["take"],
+                p["want"],
+                p["type"],
+                tries,
+                p["recurse"],
+                recurse_tries,
+                cweights,
+            )
+            res = out2 if p["recurse"] else out
+            if p["firstn"]:
+                res = jnp.where(jnp.arange(res.shape[0]) < cnt, res, ITEM_NONE)
+            return res
+
+        # jit once per (rule, numrep, choose_args) and cache on the map:
+        # a fresh jit-wrapped closure per call would recompile every call
+        # (jax caches by function identity), which at 256k x costs minutes
+        vf = jax.jit(jax.vmap(single, in_axes=(0, None)))
+        cm._rule_fn_cache[key] = vf
 
     with enable_x64():
-        xs = jnp.asarray(xs, dtype=jnp.int32)
+        xs_np = np.asarray(xs, dtype=np.int32)
         weightvec = jnp.asarray(weightvec, dtype=jnp.int64)
-        return jax.jit(jax.vmap(single))(xs)
+        N = xs_np.shape[0]
+        if N <= _BATCH_CHUNK:
+            # pad to the next power of two: bounds the number of distinct
+            # compiled shapes to log2(_BATCH_CHUNK) across all callers
+            Np = max(1, 1 << (max(N, 1) - 1).bit_length())
+            out = vf(jnp.asarray(np.resize(xs_np, Np)), weightvec)
+            return out[:N] if Np != N else out
+        # Large batches run as fixed-size device calls: one Mosaic launch
+        # over >~512k x (vmapped int64 while-loops) hard-faults the v5e
+        # worker, and a single huge launch would also hold the whole
+        # [N, trace] intermediate set live in HBM.  Chunking keeps each
+        # launch inside the envelope at ~zero throughput cost (the per-x
+        # math dwarfs dispatch).
+        pieces = []
+        for lo in range(0, N, _BATCH_CHUNK):
+            chunk = np.resize(xs_np[lo : lo + _BATCH_CHUNK], _BATCH_CHUNK)
+            pieces.append(np.asarray(vf(jnp.asarray(chunk), weightvec)))
+        out = np.concatenate(pieces)[:N]
+        return jnp.asarray(out)
