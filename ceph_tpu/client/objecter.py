@@ -15,8 +15,8 @@ import threading
 
 from ..msg import Dispatcher, Messenger
 from ..msg.messenger import POLICY_LOSSY
-from ..osd.daemon import object_ps
-from ..osd.messages import MOSDOp, MOSDOpReply, pack_data, unpack_data
+from ..osd.osdmap import object_ps
+from ..osd.messages import MOSDOp, MOSDOpReply, pack_data
 
 
 class Objecter(Dispatcher):
@@ -30,6 +30,7 @@ class Objecter(Dispatcher):
         self._cond = threading.Condition(self._lock)
         self._tid = 0
         self._replies: dict[int, MOSDOpReply] = {}
+        self._outstanding: set[int] = set()
         self.mc.subscribe_osdmap()
 
     def shutdown(self) -> None:
@@ -39,8 +40,12 @@ class Objecter(Dispatcher):
     def ms_dispatch(self, conn, msg) -> bool:
         if isinstance(msg, MOSDOpReply):
             with self._lock:
-                self._replies[msg.tid] = msg
-                self._cond.notify_all()
+                # drop replies for tids nobody waits on any more (late
+                # replies after a timeout/retry would otherwise accumulate
+                # forever in a long-lived client)
+                if msg.tid in self._outstanding:
+                    self._replies[msg.tid] = msg
+                    self._cond.notify_all()
             return True
         return False
 
@@ -54,7 +59,11 @@ class Objecter(Dispatcher):
         pool = m.pools.get(pool_id)
         if pool is None:
             raise KeyError(f"no pool {pool_id}")
-        ps = object_ps(oid, pool.pg_num)
+        if oid.startswith(":pg:"):
+            # pg-targeted pseudo-oid (listing): same parse as the OSD's
+            ps = int(oid[4:])
+        else:
+            ps = object_ps(oid, pool.pg_num)
         _up, _upp, _acting, primary = m.pg_to_up_acting_osds(pool_id, ps)
         addr = m.osd_addrs.get(primary)
         if primary < 0 or addr is None:
@@ -88,6 +97,7 @@ class Objecter(Dispatcher):
             with self._lock:
                 self._tid += 1
                 tid = self._tid
+                self._outstanding.add(tid)
             try:
                 conn = self.messenger.connect(addr)
                 conn.send_message(
@@ -99,6 +109,8 @@ class Objecter(Dispatcher):
                 )
             except (OSError, ConnectionError) as e:
                 last = str(e)
+                with self._lock:
+                    self._outstanding.discard(tid)
                 self._refresh_map(m)
                 continue
             with self._lock:
@@ -106,6 +118,7 @@ class Objecter(Dispatcher):
                     lambda: tid in self._replies, timeout=timeout
                 )
                 rep = self._replies.pop(tid, None) if ok else None
+                self._outstanding.discard(tid)
             if rep is None:
                 last = "op timed out"
                 self._refresh_map(m)

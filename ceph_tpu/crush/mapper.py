@@ -515,7 +515,16 @@ def crush_do_rule_batch(
         # math dwarfs dispatch).
         pieces = []
         for lo in range(0, N, _BATCH_CHUNK):
-            chunk = np.resize(xs_np[lo : lo + _BATCH_CHUNK], _BATCH_CHUNK)
-            pieces.append(np.asarray(vf(jnp.asarray(chunk), weightvec)))
-        out = np.concatenate(pieces)[:N]
+            part = xs_np[lo : lo + _BATCH_CHUNK]
+            # ragged tail: pad to its own next power of two (a shape the
+            # small-batch path compiles anyway), not to a full chunk —
+            # padding 1 element to 256k would be pure discarded compute
+            width = (
+                _BATCH_CHUNK
+                if len(part) == _BATCH_CHUNK
+                else 1 << (len(part) - 1).bit_length()
+            )
+            chunk = np.resize(part, width)
+            pieces.append(np.asarray(vf(jnp.asarray(chunk), weightvec))[: len(part)])
+        out = np.concatenate(pieces)
         return jnp.asarray(out)

@@ -54,15 +54,19 @@ class MonClient(Dispatcher):
                 try:
                     conn = self.messenger.connect(tuple(a))
                     self._conn, self._conn_addr = conn, tuple(a)
-                    if self._subscribed_from:
-                        # re-arm the subscription on the new mon
-                        conn.send_message(
-                            MMonSubscribe(what={"osdmap": self._subscribed_from})
-                        )
+                    self._renew_sub(conn)
                     return conn
                 except (OSError, ConnectionError) as e:
                     last_err = e
             raise ConnectionError(f"no monitor reachable: {last_err}")
+
+    def _renew_sub(self, conn) -> None:
+        """(Re-)arm the osdmap subscription on a connection; idempotent on
+        the mon side, shared by dial/subscribe/wait paths."""
+        if self._subscribed_from:
+            conn.send_message(
+                MMonSubscribe(what={"osdmap": self._subscribed_from})
+            )
 
     def ms_handle_reset(self, conn) -> None:
         with self._lock:
@@ -145,21 +149,43 @@ class MonClient(Dispatcher):
             self._subscribed_from = max(self._subscribed_from, from_epoch) or 1
             if callback is not None:
                 self._map_callbacks.append(callback)
-        conn = self._connect()
-        conn.send_message(MMonSubscribe(what={"osdmap": self._subscribed_from}))
+        # _connect renews only on a fresh dial; renew explicitly in case a
+        # cached connection predates the subscription
+        self._renew_sub(self._connect())
 
     def wait_for_osdmap(self, min_epoch: int = 1, timeout: float = 10.0) -> OSDMap:
-        with self._lock:
-            ok = self._cond.wait_for(
-                lambda: self.osdmap is not None and self.osdmap.epoch >= min_epoch,
-                timeout=timeout,
-            )
-            if not ok:
+        """Block until a map >= min_epoch arrives, actively hunting: if the
+        mon connection resets (mon restart, lossy drop, mid-election
+        hiccup) the subscription is re-armed on a fresh dial instead of
+        waiting out the timeout on a dead session (reference: MonClient's
+        hunt + renew on reset)."""
+        deadline = time.monotonic() + timeout
+
+        def have_map() -> bool:
+            return self.osdmap is not None and self.osdmap.epoch >= min_epoch
+
+        while True:
+            with self._lock:
+                if self._cond.wait_for(
+                    have_map, timeout=min(1.0, max(0.0, deadline - time.monotonic()))
+                ):
+                    return self.osdmap
+                expired = time.monotonic() >= deadline
+            if expired:
                 have = self.osdmap.epoch if self.osdmap else None
                 raise TimeoutError(
                     f"no osdmap epoch >= {min_epoch} (have {have})"
                 )
-            return self.osdmap
+            # not served yet: re-dial if the connection died (a fresh dial
+            # re-arms the subscription); nudge the sub on a live one
+            try:
+                with self._lock:
+                    live = self._conn is not None and self._conn.is_connected
+                conn = self._connect()
+                if live:
+                    self._renew_sub(conn)
+            except (OSError, ConnectionError):
+                pass
 
     # -- daemon helpers ----------------------------------------------------
     def send_boot(self, osd: int, addr: tuple[str, int]) -> None:

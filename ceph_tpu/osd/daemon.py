@@ -34,7 +34,7 @@ from ..ec.registry import ErasureCodePluginRegistry
 from ..mon.mon_client import MonClient
 from ..msg import Dispatcher, Messenger
 from ..msg.messenger import POLICY_LOSSLESS_PEER
-from ..osd.osdmap import OSDMap, PG_POOL_ERASURE
+from ..osd.osdmap import OSDMap, PG_POOL_ERASURE, object_ps
 from ..store.memstore import MemStore
 from ..store.object_store import NotFound, Transaction
 from .messages import (
@@ -53,18 +53,6 @@ from .messages import (
 from .pg_log import LogEntry, PGLog
 
 import numpy as np
-
-
-def object_ps(oid: str, pg_num: int) -> int:
-    """Object name -> placement seed (reference: ceph_str_hash + stable_mod
-    in OSDMap::object_locator_to_pg)."""
-    from ..osd.osdmap import ceph_stable_mod, pg_num_mask
-    import zlib
-
-    # rjenkins string hash analog: crc32c is stable, fast, and shared with
-    # the C++ oracle; only stability matters for placement
-    h = crc32c(oid.encode())
-    return ceph_stable_mod(h, pg_num, pg_num_mask(pg_num))
 
 
 class PGState:
@@ -112,8 +100,31 @@ class OSD(Dispatcher):
         addr = self.messenger.bind(("127.0.0.1", 0))
         self.messenger.start()
         self.mc.subscribe_osdmap(callback=self._on_map)
-        self.mc.send_boot(self.id, addr)
-        self.osdmap = self.mc.wait_for_osdmap(timeout=30.0)
+        # resend boot until the map shows our address (reference: OSD
+        # re-sends MOSDBoot until it sees itself up) — a boot riding a
+        # connection that resets mid-handshake would otherwise be lost
+        deadline = time.monotonic() + 30.0
+        min_epoch = 1
+        while True:
+            try:
+                self.mc.send_boot(self.id, addr)
+            except (OSError, ConnectionError):
+                pass
+            try:
+                m = self.mc.wait_for_osdmap(min_epoch=min_epoch, timeout=2.0)
+            except TimeoutError:
+                m = self.mc.osdmap
+            if m is not None:
+                if tuple(m.osd_addrs.get(self.id) or ()) == tuple(addr):
+                    self.osdmap = m
+                    break
+                # wait for a NEWER epoch next round so the retry loop
+                # blocks instead of spinning on the same stale map
+                min_epoch = m.epoch + 1
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"{self.whoami}: boot not acknowledged in 30s"
+                )
         self._load_pgs()
         self._tick_thread = threading.Thread(
             target=self._tick_loop, name=f"{self.whoami}-tick", daemon=True
@@ -223,6 +234,20 @@ class OSD(Dispatcher):
             t.omap_rmkeys(
                 cid, pg.meta_oid(), [PGLog.omap_key(e.version) for e in trimmed]
             )
+
+    def _log_seal_txn(self, t: Transaction, cid: str, pg: PGState,
+                      version: int) -> None:
+        """Seal an empty log window at `version` (backfill completion)."""
+        old_keys = [PGLog.omap_key(e.version) for e in pg.log.entries]
+        pg.log.reset_to(version)
+        pg.version = version
+        t.touch(cid, pg.meta_oid())
+        t.omap_setkeys(cid, pg.meta_oid(), {
+            "head": str(version).encode(),
+            "tail": str(version).encode(),
+        })
+        if old_keys:
+            t.omap_rmkeys(cid, pg.meta_oid(), old_keys)
 
     # -- dispatch ----------------------------------------------------------
     def ms_dispatch(self, conn, msg) -> bool:
@@ -374,7 +399,7 @@ class OSD(Dispatcher):
         cid = self._cid(pg.pgid, my_shard)
         chunk = np.asarray(enc[my_shard], np.uint8).tobytes()
         t = Transaction()
-        t.create_collection(cid)
+        t.try_create_collection(cid)
         t.write(cid, msg.oid, 0, chunk)
         t.truncate(cid, msg.oid, len(chunk))
         t.setattr(cid, msg.oid, "hinfo", str(crc32c(chunk)).encode())
@@ -391,13 +416,11 @@ class OSD(Dispatcher):
                 failed.append(acting[shard])
         for osd in failed:
             self.mc.report_failure(osd)
-        # ack once every reachable shard committed, and never below
-        # min_size (degraded writes proceed; recovery fills the rest —
-        # reference: ECBackend requires min_size acting shards)
-        reachable = 1 + len(tids)
-        if acked >= max(pool.min_size, reachable - len(failed)) or (
-            acked == reachable and acked >= pool.min_size
-        ):
+        # degraded-write policy: ack at min_size commits.  Shards that
+        # missed the write are reported to the mon and filled by delta
+        # recovery off the pg_log (reference: ECBackend requires min_size
+        # acting shards; recovery completes the stripe)
+        if acked >= pool.min_size:
             return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
                                result={"version": pg.version, "acked": acked})
         return MOSDOpReply(tid=msg.tid, retval=-11, epoch=self.my_epoch(),
@@ -424,7 +447,7 @@ class OSD(Dispatcher):
                 tids.pop(tid, None)
         cid = self._cid(pg.pgid, my_shard)
         t = Transaction()
-        t.create_collection(cid)
+        t.try_create_collection(cid)
         try:
             self.store.stat(cid, msg.oid)
             t.remove(cid, msg.oid)
@@ -438,9 +461,12 @@ class OSD(Dispatcher):
                            result={"version": pg.version})
 
     def _gather_chunks(
-        self, pg, codec, acting, oid: str, want: set[int]
+        self, pg, codec, acting, oid: str, want: set[int],
+        sizes: dict[int, int] | None = None,
     ) -> dict[int, bytes]:
-        """Fetch chunk bytes for shard ids in `want` (local or remote)."""
+        """Fetch chunk bytes for shard ids in `want` (local or remote).
+        `sizes`, if given, collects the object-size xattr each replying
+        shard reports (for padding-strip when the primary has no copy)."""
         got: dict[int, bytes] = {}
         tids: dict[int, int] = {}
         for shard in sorted(want):
@@ -468,6 +494,8 @@ class OSD(Dispatcher):
             rep = self._wait_reply(tid)
             if rep is not None and rep.retval == 0:
                 got[shard] = unpack_data(rep.data)
+                if sizes is not None and rep.size is not None:
+                    sizes[shard] = int(rep.size)
         return got
 
     def _ec_read(self, pg, codec, acting, msg) -> MOSDOpReply:
@@ -482,13 +510,17 @@ class OSD(Dispatcher):
                     self._cid(pg.pgid, my_shard), msg.oid, "size"))
             except (NotFound, KeyError):
                 pass
+        peer_sizes: dict[int, int] = {}
         want_data = set(range(k))
-        got = self._gather_chunks(pg, codec, acting, msg.oid, want_data)
+        got = self._gather_chunks(
+            pg, codec, acting, msg.oid, want_data, sizes=peer_sizes
+        )
         missing = want_data - set(got)
         if missing:
             # degraded: consult minimum_to_decode over everything reachable
             avail_probe = self._gather_chunks(
-                pg, codec, acting, msg.oid, set(range(k, n))
+                pg, codec, acting, msg.oid, set(range(k, n)),
+                sizes=peer_sizes,
             )
             avail_probe.update(got)
             if len(avail_probe) < k:
@@ -510,8 +542,11 @@ class OSD(Dispatcher):
             )
         else:
             data = b"".join(got[i] for i in range(k))
+        if size is None and peer_sizes:
+            size = next(iter(peer_sizes.values()))
         if size is None:
-            # fall back to stored stripe size (no padding info): strip NULs
+            # no shard could report a size xattr: the full (padded) stripe
+            # is the best available answer
             size = len(data)
         obj = data[:size]
         if msg.off or (msg.length or 0) > 0:
@@ -553,7 +588,7 @@ class OSD(Dispatcher):
                     except (OSError, ConnectionError):
                         tids.pop(tid, None)
                 t = Transaction()
-                t.create_collection(cid)
+                t.try_create_collection(cid)
                 t.write(cid, msg.oid, 0, data)
                 t.truncate(cid, msg.oid, len(data))
                 t.setattr(cid, msg.oid, "size", str(len(data)).encode())
@@ -603,7 +638,7 @@ class OSD(Dispatcher):
                     except (OSError, ConnectionError):
                         pass
                 t = Transaction()
-                t.create_collection(cid)
+                t.try_create_collection(cid)
                 try:
                     self.store.stat(cid, msg.oid)
                     t.remove(cid, msg.oid)
@@ -639,15 +674,10 @@ class OSD(Dispatcher):
         retval = 0
         try:
             with pg.lock:
+                entry_op = msg.entry[1] if msg.entry else None
                 t = Transaction()
-                t.create_collection(cid)
-                if msg.data is None:
-                    try:
-                        self.store.stat(cid, msg.oid)
-                        t.remove(cid, msg.oid)
-                    except (NotFound, KeyError):
-                        pass
-                else:
+                t.try_create_collection(cid)
+                if msg.data is not None:
                     chunk = unpack_data(msg.data)
                     if crc32c(chunk) != msg.crc:
                         raise IOError("chunk crc mismatch")
@@ -657,9 +687,29 @@ class OSD(Dispatcher):
                     if msg.entry and len(msg.entry) > 3:
                         t.setattr(cid, msg.oid, "size",
                                   str(msg.entry[3]).encode())
-                if msg.entry is not None and msg.version > pg.version:
-                    entry = LogEntry.from_list(msg.entry[:3])
-                    self._log_txn(t, cid, pg, entry)
+                elif entry_op in (None, "delete"):
+                    # data-less delete (live op or recovery replay)
+                    try:
+                        self.store.stat(cid, msg.oid)
+                        t.remove(cid, msg.oid)
+                    except (NotFound, KeyError):
+                        pass
+                # else: entry-only push ("modify" log replay / "clean"
+                # seal) — log bookkeeping below, no data op
+                if (
+                    msg.entry is not None
+                    and msg.version is not None
+                    and msg.version > pg.version
+                ):
+                    if entry_op == "clean":
+                        # a clean that JUMPS our version means we were
+                        # backfilled across a gap: seal an empty log window
+                        # so covers() stays honest about what we can vouch
+                        # for entry-by-entry
+                        self._log_seal_txn(t, cid, pg, msg.version)
+                    else:
+                        entry = LogEntry.from_list(msg.entry[:3])
+                        self._log_txn(t, cid, pg, entry)
                 self.store.queue_transaction(t)
         except Exception as e:
             self.cct.dout("osd", 0, f"{self.whoami} sub_write failed: {e!r}")
@@ -685,14 +735,18 @@ class OSD(Dispatcher):
                 data = b"".join(parts)
             else:
                 data = self.store.read(cid, msg.oid)
+            try:
+                size = int(self.store.getattr(cid, msg.oid, "size"))
+            except (NotFound, KeyError):
+                size = None
             reply = MECSubOpReadReply(
                 tid=msg.tid, pgid=msg.pgid, oid=msg.oid, shard=msg.shard,
-                retval=0, data=pack_data(data),
+                retval=0, data=pack_data(data), size=size,
             )
         except (NotFound, KeyError):
             reply = MECSubOpReadReply(
                 tid=msg.tid, pgid=msg.pgid, oid=msg.oid, shard=msg.shard,
-                retval=-2, data=None,
+                retval=-2, data=None, size=None,
             )
         try:
             conn.send_message(reply)
@@ -766,6 +820,9 @@ class OSD(Dispatcher):
                 self._hb_failures[osd] = prev + 1
             if self._hb_failures.get(osd, 0) >= 3:
                 self.mc.report_failure(osd, failed_for=6.0)
+                # restart the count: re-report only after another 3 silent
+                # intervals, not on every subsequent tick
+                self._hb_failures.pop(osd, None)
 
     # -- recovery (peering-lite, primary only) ----------------------------
     def _recover_all(self) -> None:
@@ -799,10 +856,13 @@ class OSD(Dispatcher):
         for shard, osd in enumerate(acting):
             if osd < 0 or osd == self.id or not self.osdmap.is_up(osd):
                 continue
+            # replicated replicas all store in the s0 collection; only EC
+            # shards have per-shard collections
+            store_shard = shard if is_ec else 0
             tid = self._next_tid()
             try:
                 self._conn_to_osd(osd).send_message(
-                    MPGQuery(tid=tid, pgid=pg.pgid, shard=shard,
+                    MPGQuery(tid=tid, pgid=pg.pgid, shard=store_shard,
                              epoch=self.my_epoch())
                 )
             except (OSError, ConnectionError):
@@ -813,21 +873,23 @@ class OSD(Dispatcher):
             if rep.version >= pg.version:
                 continue  # clean
             if pg.log.covers(rep.version):
-                newest, deleted = pg.log.missing_since(rep.version)
                 self.cct.dout(
                     "osd", 1,
                     f"{self.whoami} delta-recovery {pg.pgid} shard {shard} "
-                    f"osd.{osd}: {len(newest)} objects, {len(deleted)} deletes",
+                    f"osd.{osd} from v{rep.version}",
                 )
-                self._push_objects(
-                    pg, codec, acting, shard, osd, newest, deleted, is_ec
+                ok = self._push_log_delta(
+                    pg, codec, acting, store_shard, osd, rep.version, is_ec
                 )
-                self._bump_peer_version(pg, shard, osd, pg.version)
-                pg.stat_delta_recoveries = getattr(
-                    pg, "stat_delta_recoveries", 0) + 1
+                if ok:
+                    self._bump_peer_version(pg, store_shard, osd, pg.version)
+                    pg.stat_delta_recoveries = getattr(
+                        pg, "stat_delta_recoveries", 0) + 1
             else:
-                # log too old: full backfill of this shard
-                my_shard = acting.index(self.id)
+                # log too old: full backfill of this shard.  Versions are
+                # unknowable per object (trimmed), so chunks are pushed
+                # unversioned and the final sync entry seals the version.
+                my_shard = acting.index(self.id) if is_ec else 0
                 oids = [
                     o for o in self.store.list_objects(
                         self._cid(pg.pgid, my_shard))
@@ -838,57 +900,105 @@ class OSD(Dispatcher):
                     f"{self.whoami} backfill {pg.pgid} shard {shard} "
                     f"osd.{osd}: {len(oids)} objects",
                 )
-                self._push_objects(
-                    pg, codec, acting, shard, osd,
-                    {o: pg.version for o in oids}, set(), is_ec,
+                ok = self._push_objects(
+                    pg, codec, acting, store_shard, osd,
+                    {o: None for o in oids}, set(), is_ec,
                 )
-                self._bump_peer_version(pg, shard, osd, pg.version)
-                pg.stat_backfills = getattr(pg, "stat_backfills", 0) + 1
+                if ok:
+                    self._bump_peer_version(pg, store_shard, osd, pg.version)
+                    pg.stat_backfills = getattr(pg, "stat_backfills", 0) + 1
 
-    def _push_objects(self, pg, codec, acting, shard, osd,
-                      newest: dict[str, int], deleted: set[str],
-                      is_ec: bool) -> None:
-        for oid in sorted(deleted):
-            tid = self._next_tid()
-            try:
-                self._conn_to_osd(osd).send_message(
-                    MECSubOpWrite(tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
-                                  data=None, crc=None, version=None,
-                                  entry=None, epoch=self.my_epoch())
-                )
-                self._wait_reply(tid, timeout=5.0)
-            except (OSError, ConnectionError):
-                return
-        for oid in sorted(newest):
-            chunk, size = self._rebuild_shard_chunk(
-                pg, codec, acting, oid, shard, is_ec
-            )
-            if chunk is None:
-                continue
-            tid = self._next_tid()
-            try:
-                self._conn_to_osd(osd).send_message(
-                    MECSubOpWrite(
-                        tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
-                        data=pack_data(chunk), crc=crc32c(chunk),
-                        version=None,
-                        entry=[0, "modify", oid, size],
-                        epoch=self.my_epoch(),
-                    )
-                )
-                self._wait_reply(tid, timeout=5.0)
-            except (OSError, ConnectionError):
-                return
-
-    def _bump_peer_version(self, pg, shard, osd, version: int) -> None:
-        """Final version/log sync after pushes (entry carries no data)."""
+    def _push_sub_write(self, pg, osd, shard, oid, data, version, entry) -> bool:
+        """One recovery push; True iff the peer acked it (retval 0)."""
         tid = self._next_tid()
         try:
             self._conn_to_osd(osd).send_message(
                 MECSubOpWrite(
-                    tid=tid, pgid=pg.pgid, oid="_pgmeta_sync", shard=shard,
+                    tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
+                    data=pack_data(data) if data is not None else None,
+                    crc=crc32c(data) if data is not None else None,
+                    version=version, entry=entry, epoch=self.my_epoch(),
+                )
+            )
+        except (OSError, ConnectionError):
+            return False
+        rep = self._wait_reply(tid, timeout=5.0)
+        return rep is not None and rep.retval == 0
+
+    def _push_log_delta(self, pg, codec, acting, shard, osd,
+                        peer_version: int, is_ec: bool) -> bool:
+        """Delta recovery: replay the FULL entry stream since the peer's
+        version, in order, so the peer's pg_log stays contiguous and its
+        covers() answer stays honest if it later becomes primary
+        (reference: PGLog merge + pg_missing_t-driven recover_object).
+
+        Data rides only the newest modify of each object; earlier modifies
+        and deletes replay as log-only / delete pushes.  Returns True only
+        if every push acked, so the caller never marks the peer clean past
+        data it does not hold."""
+        newest, _deleted = pg.log.missing_since(peer_version)
+        for e in pg.log.entries_since(peer_version):
+            if e.op == "delete":
+                ok = self._push_sub_write(
+                    pg, osd, shard, e.oid, None, e.version, e.to_list()
+                )
+            elif e.op == "modify" and newest.get(e.oid) == e.version:
+                chunk, size = self._rebuild_shard_chunk(
+                    pg, codec, acting, e.oid, shard, is_ec
+                )
+                if chunk is None:
+                    return False  # unreadable right now: retry next tick
+                ok = self._push_sub_write(
+                    pg, osd, shard, e.oid, chunk, e.version,
+                    e.to_list() + [size],
+                )
+            else:
+                # superseded modify / clean marker: log-entry-only replay
+                ok = self._push_sub_write(
+                    pg, osd, shard, e.oid, None, e.version, e.to_list()
+                )
+            if not ok:
+                return False
+        return True
+
+    def _push_objects(self, pg, codec, acting, shard, osd,
+                      newest: dict[str, int | None], deleted: set[str],
+                      is_ec: bool) -> bool:
+        """Backfill push: chunk data for every object, unversioned (the
+        trimmed log cannot vouch for per-object versions); the final
+        "clean" seal establishes the peer's version and empty log window.
+        The entry still carries the object size so the peer can answer
+        stat/padding-strip (entry[3] -> size xattr)."""
+        for oid in sorted(deleted):
+            if not self._push_sub_write(pg, osd, shard, oid, None, None, None):
+                return False
+        all_ok = True
+        for oid in sorted(newest, key=lambda o: (newest[o] or 0, o)):
+            chunk, size = self._rebuild_shard_chunk(
+                pg, codec, acting, oid, shard, is_ec
+            )
+            if chunk is None:
+                all_ok = False  # unreadable right now: retry next tick
+                continue
+            version = newest[oid]
+            entry = [version or 0, "modify", oid, size]
+            if not self._push_sub_write(
+                pg, osd, shard, oid, chunk, version, entry
+            ):
+                all_ok = False
+        return all_ok
+
+    def _bump_peer_version(self, pg, shard, osd, version: int) -> None:
+        """Final version/log sync after successful pushes: a data-less
+        "clean" entry (ignored by missing_since) seals the peer at the
+        primary's version."""
+        tid = self._next_tid()
+        try:
+            self._conn_to_osd(osd).send_message(
+                MECSubOpWrite(
+                    tid=tid, pgid=pg.pgid, oid="", shard=shard,
                     data=None, crc=None, version=version,
-                    entry=[version, "delete", "_pgmeta_sync"],
+                    entry=[version, "clean", ""],
                     epoch=self.my_epoch(),
                 )
             )

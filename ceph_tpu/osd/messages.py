@@ -73,8 +73,11 @@ class MECSubOpRead(_JsonMessage):
 
 @register_message
 class MECSubOpReadReply(_JsonMessage):
+    """`size` echoes the shard's stored object-size xattr so a primary
+    without its own shard copy can still strip stripe padding."""
+
     MSG_TYPE = 111
-    FIELDS = ("tid", "pgid", "oid", "shard", "retval", "data")
+    FIELDS = ("tid", "pgid", "oid", "shard", "retval", "data", "size")
 
 
 @register_message

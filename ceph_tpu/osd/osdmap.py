@@ -63,6 +63,18 @@ def _stable_mod_np(x: np.ndarray, b: int, bmask: int) -> np.ndarray:
     return np.where(lo < b, lo, x & np.uint32(bmask >> 1))
 
 
+def object_ps(oid: str, pg_num: int) -> int:
+    """Object name -> placement seed (reference: ceph_str_hash + stable_mod
+    in OSDMap::object_locator_to_pg).
+
+    crc32c stands in for the rjenkins string hash: it is stable, fast, and
+    shared with the C++ oracle; only stability matters for placement."""
+    from ..common.crc32c import crc32c
+
+    h = crc32c(oid.encode())
+    return ceph_stable_mod(h, pg_num, pg_num_mask(pg_num))
+
+
 @dataclass
 class PGPool:
     """reference: src/osd/osd_types.h :: pg_pool_t (placement fields only —

@@ -9,8 +9,10 @@ Simplifications vs the reference, by design:
   primaries diverge; here the primary serializes all writes and peering
   truncates stragglers, so a scalar version is sufficient and the
   divergent-entry rewind machinery collapses into `entries_since`).
-- entries record (version, op, oid); op is "modify" or "delete" — enough
-  to reconstruct a missing-object set, which is all recovery needs.
+- entries record (version, op, oid); op is "modify", "delete", or "clean"
+  (a data-less version marker recovery uses to seal a peer at the
+  primary's version) — enough to reconstruct a missing-object set, which
+  is all recovery needs.
 
 Persistence: the log rides in the same ObjectStore transaction as the data
 write (omap of the PG meta object), exactly how the reference keeps log and
@@ -26,7 +28,7 @@ DEFAULT_LOG_LIMIT = 500  # reference: osd_min_pg_log_entries ballpark
 @dataclass(frozen=True)
 class LogEntry:
     version: int
-    op: str  # "modify" | "delete"
+    op: str  # "modify" | "delete" | "clean"
     oid: str
 
     def to_list(self) -> list:
@@ -62,6 +64,14 @@ class PGLog:
         """Can a peer at `version` be delta-recovered from this log?"""
         return version >= self.tail
 
+    def reset_to(self, version: int) -> None:
+        """Empty the log window at `version` (head = tail = version): the
+        state after a full backfill, where nothing below `version` can be
+        vouched for entry-by-entry (reference: pg_log rewind/reset on
+        backfill completion keeps covers() honest)."""
+        self.entries = []
+        self.head = self.tail = version
+
     def entries_since(self, version: int) -> list[LogEntry]:
         return [e for e in self.entries if e.version > version]
 
@@ -71,6 +81,8 @@ class PGLog:
         newest: dict[str, int] = {}
         deleted: set[str] = set()
         for e in self.entries_since(version):
+            if e.op == "clean":
+                continue  # version marker, no object behind it
             if e.op == "delete":
                 deleted.add(e.oid)
                 newest.pop(e.oid, None)
@@ -93,5 +105,9 @@ class PGLog:
         log.head, log.tail = head, tail
         for k in sorted(pairs):
             if k.startswith("log."):
-                log.entries.append(LogEntry.from_list(json.loads(pairs[k])))
+                e = LogEntry.from_list(json.loads(pairs[k]))
+                # stale keys below the window (left behind by a reset_to
+                # seal) must not resurrect into the live log
+                if tail < e.version <= head:
+                    log.entries.append(e)
         return log

@@ -38,6 +38,7 @@ OP_OMAP_CLEAR = 10
 OP_MKCOLL = 11
 OP_RMCOLL = 12
 OP_COLL_MOVE_RENAME = 13
+OP_TRY_MKCOLL = 14  # idempotent create (collection may already exist)
 
 
 @dataclass
@@ -113,6 +114,13 @@ class Transaction:
     # -- collections ------------------------------------------------------
     def create_collection(self, cid: str) -> "Transaction":
         self.ops.append(Op(OP_MKCOLL, cid))
+        return self
+
+    def try_create_collection(self, cid: str) -> "Transaction":
+        """Create-if-missing (the OSD touches its shard collection on every
+        write; reference: OSD collections are created at PG instantiation,
+        but this daemon creates them lazily)."""
+        self.ops.append(Op(OP_TRY_MKCOLL, cid))
         return self
 
     def remove_collection(self, cid: str) -> "Transaction":
@@ -282,6 +290,9 @@ class ObjectStore:
                 if op.cid in colls:
                     raise StoreError(f"collection {op.cid} exists")
                 colls[op.cid] = Collection()
+                continue
+            if op.op == OP_TRY_MKCOLL:
+                colls.setdefault(op.cid, Collection())
                 continue
             if op.op == OP_RMCOLL:
                 c = colls.get(op.cid)
