@@ -50,7 +50,9 @@ class Objecter(Dispatcher):
         return False
 
     # -- targeting ---------------------------------------------------------
-    def _calc_target(self, pool_id: int, oid: str) -> tuple[int, tuple]:
+    def _calc_target(
+        self, pool_id: int, oid: str, op: str = ""
+    ) -> tuple[int, tuple]:
         """reference: Objecter::_calc_target — pg from the object name,
         primary from the local map."""
         m = self.mc.osdmap
@@ -59,8 +61,9 @@ class Objecter(Dispatcher):
         pool = m.pools.get(pool_id)
         if pool is None:
             raise KeyError(f"no pool {pool_id}")
-        if oid.startswith(":pg:"):
-            # pg-targeted pseudo-oid (listing): same parse as the OSD's
+        if op == "list" and oid.startswith(":pg:"):
+            # pg-targeted pseudo-oid — honored by the OSD only for
+            # listings; any other op treats ':pg:*' as a normal name
             ps = int(oid[4:])
         else:
             ps = object_ps(oid, pool.pg_num)
@@ -89,7 +92,7 @@ class Objecter(Dispatcher):
         for _ in range(attempts):
             m = self.mc.osdmap
             try:
-                _osd, addr = self._calc_target(pool_id, oid)
+                _osd, addr = self._calc_target(pool_id, oid, op)
             except (ConnectionError, KeyError) as e:
                 last = str(e)
                 self._refresh_map(m)

@@ -1,0 +1,353 @@
+"""Explicitly-batched CRUSH choose kernels — the SPMD core of the TPU
+mapper (reference: src/crush/mapper.c :: crush_choose_firstn /
+crush_choose_indep / bucket_straw2_choose / is_out, batched over x).
+
+Every function here takes [B]-shaped lane arrays instead of scalars —
+manual SPMD rather than jax.vmap — for two reasons:
+
+- the straw2 hot loop ([B, S] hash + ln + draw) can then be swapped
+  between a jnp formulation (CPU) and one fused Pallas launch per retry
+  iteration (TPU) without fighting vmap's pallas_call batching rules;
+- retry loops become masked lax.while_loops whose trip count is the
+  max over lanes, exactly the semantics vmap gives, but with the state
+  laid out for full-tile VPU work at every iteration.
+
+Bit-exactness contract: identical output to reference_mapper.crush_do_rule
+and the C++ oracle for every input — enforced by tests/test_crush.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hash import crush_hash32_2, crush_hash32_3
+from .ln_table import LN_BIAS
+from .types import ITEM_NONE
+
+S64_MIN = np.int64(np.iinfo(np.int64).min)
+
+
+def _div64_trunc(a, b):
+    """C-style truncating signed division (div64_s64)."""
+    q = jnp.abs(a) // jnp.abs(b)
+    return jnp.where((a < 0) != (b < 0), -q, q).astype(jnp.int64)
+
+
+def ln_scores_jnp(cm, x, items, r):
+    """[B, S] crush_ln(hash3(x, item, r) & 0xffff) via XLA: elementwise
+    rjenkins hash + full-table gather — fast on CPU backends."""
+    u = (
+        crush_hash32_3(
+            x[:, None].astype(jnp.uint32),
+            items.astype(jnp.uint32),
+            r[:, None].astype(jnp.uint32),
+        ).astype(jnp.int64)
+        & 0xFFFF
+    )
+    return jnp.take(cm.ln_table, u, axis=None)
+
+
+def ln_scores_pallas(cm, x, items, r):
+    """[B, S] hash+ln via the fused Pallas kernel (TPU: no vector gather —
+    see ops/pallas_crush.py).  Pads B to the tile multiple and S to the
+    128-lane multiple, slices back."""
+    from ..ops.pallas_crush import DEFAULT_TILE, straw2_scores_pallas
+
+    B, S = items.shape
+    Bp = -(-B // DEFAULT_TILE) * DEFAULT_TILE
+    Sp = -(-S // 128) * 128
+    xi = x.astype(jnp.int32)
+    ri = r.astype(jnp.int32)
+    ii = items.astype(jnp.int32)
+    if Bp != B:
+        xi = jnp.pad(xi, (0, Bp - B))
+        ri = jnp.pad(ri, (0, Bp - B))
+        ii = jnp.pad(ii, ((0, Bp - B), (0, 0)))
+    if Sp != S:
+        ii = jnp.pad(ii, ((0, 0), (0, Sp - S)))
+    # interpret mode keeps this path testable on CPU hosts
+    hi, lo = straw2_scores_pallas(
+        xi, ri, ii, interpret=jax.default_backend() == "cpu"
+    )
+    ln = (hi.astype(jnp.int64) << 24) | lo.astype(jnp.int64)
+    return ln[:B, :S]
+
+
+def straw2_choose_b(cm, score_fn, bucket_idx, x, r, cweights, position):
+    """bucket_straw2_choose over lanes: bucket_idx/x/r/position are [B];
+    returns the chosen item per lane ([B] int32, ITEM_NONE for empty
+    buckets).  `score_fn(cm, x, items, r) -> int64 crush_ln values` is the
+    pluggable hot path (hash + table gather on CPU, fused Pallas on TPU).
+    """
+    bidx = jnp.clip(bucket_idx, 0, cm.items.shape[0] - 1)
+    items = jnp.take(cm.items, bidx, axis=0)          # [B, S] row gather
+    if cweights is None:
+        weights = jnp.take(cm.weights, bidx, axis=0)  # [B, S]
+    else:
+        pos = jnp.minimum(position, cweights.shape[0] - 1)
+        flat = cweights.reshape(-1, cweights.shape[-1])
+        weights = jnp.take(flat, pos * cm.items.shape[0] + bidx, axis=0)
+    size = jnp.take(cm.sizes, bidx)                   # [B]
+    ln = score_fn(cm, x, items, r) - LN_BIAS
+    draw = _div64_trunc(ln, jnp.maximum(weights, 1))
+    slot = jnp.arange(items.shape[1])
+    valid = (slot[None, :] < size[:, None]) & (weights > 0)
+    draw = jnp.where(valid, draw, S64_MIN)
+    choice = jnp.argmax(draw, axis=1)                 # first max, like C
+    picked = jnp.take_along_axis(items, choice[:, None], axis=1)[:, 0]
+    return jnp.where(size > 0, picked, ITEM_NONE)
+
+
+def item_type_b(cm, item):
+    """Type of each item: devices 0, buckets their declared type."""
+    idx = jnp.clip(jnp.where(item < 0, -1 - item, 0), 0, cm.types.shape[0] - 1)
+    return jnp.where(item < 0, jnp.take(cm.types, idx), 0)
+
+
+def is_out_b(weightvec, item, x):
+    """mapper.c :: is_out over lanes (probabilistic reweight reject)."""
+    n = weightvec.shape[0]
+    idx = jnp.clip(item, 0, n - 1)
+    w = jnp.take(weightvec, idx).astype(jnp.int64)
+    oob = item >= n
+    h = (
+        crush_hash32_2(x.astype(jnp.uint32), item.astype(jnp.uint32))
+        .astype(jnp.int64)
+        & 0xFFFF
+    )
+    return oob | (w == 0) | ((w < 0x10000) & (h >= w))
+
+
+def descend_b(cm, score_fn, root, x, r, want_type: int, cweights, position):
+    """Walk intervening buckets until an item of want_type appears
+    (mapper.c's retry_bucket descent), all lanes in lock-step; dead ends
+    (empty bucket, device of the wrong type) yield ITEM_NONE."""
+
+    def cond(item):
+        live = (item < 0) & (item != ITEM_NONE)
+        return jnp.any(live & (item_type_b(cm, item) != want_type))
+
+    def body(item):
+        live = (item < 0) & (item != ITEM_NONE)
+        go = live & (item_type_b(cm, item) != want_type)
+        nxt = straw2_choose_b(
+            cm, score_fn, -1 - item, x, r, cweights, position
+        )
+        return jnp.where(go, nxt, item)
+
+    item = jax.lax.while_loop(
+        cond, body, jnp.broadcast_to(jnp.asarray(root, jnp.int32), x.shape)
+    )
+    if want_type != 0:
+        item = jnp.where(item >= 0, ITEM_NONE, item)
+    return item
+
+
+def _leaf_firstn_b(
+    cm, score_fn, weightvec, x, item, sub_r, outpos, out2, recurse_tries,
+    cweights, active,
+):
+    """Nested chooseleaf descent over lanes (stable=1: one rep,
+    r = sub_r + ftotal, collisions vs out2[:, :outpos])."""
+    S = out2.shape[1]
+
+    def body(state):
+        ftotal, leaf0, done = state
+        leaf = descend_b(
+            cm, score_fn, item, x, sub_r + ftotal, 0, cweights, outpos
+        )
+        is_dev = leaf >= 0
+        collide = (
+            jnp.any(
+                (out2 == leaf[:, None])
+                & (jnp.arange(S)[None, :] < outpos[:, None]),
+                axis=1,
+            )
+            & is_dev
+        )
+        reject = jnp.where(is_dev, is_out_b(weightvec, leaf, x), True)
+        ok = is_dev & ~collide & ~reject & active
+        return (
+            ftotal + 1,
+            jnp.where(ok & ~done, leaf, leaf0),
+            done | ok,
+        )
+
+    def cond(state):
+        ftotal, _, done = state
+        return jnp.any(active & ~done & (ftotal < recurse_tries))
+
+    B = x.shape[0]
+    _, leaf, done = jax.lax.while_loop(
+        cond,
+        body,
+        (
+            jnp.zeros((B,), jnp.int32),
+            jnp.full((B,), ITEM_NONE, jnp.int32),
+            jnp.zeros((B,), bool),
+        ),
+    )
+    return jnp.where(done, leaf, ITEM_NONE), done
+
+
+def choose_firstn_b(
+    cm, score_fn, weightvec, x, root, numrep: int, want_type: int,
+    tries: int, recurse: bool, recurse_tries: int, cweights, parent_ok,
+):
+    """crush_choose_firstn over lanes.  `root` is [B] (per-lane parent —
+    multi-choose steps descend from different buckets per lane);
+    `parent_ok` masks lanes whose parent is a real bucket.  Returns
+    (out [B, numrep], out2 [B, numrep], count [B])."""
+    B = x.shape[0]
+    S = numrep
+    out = jnp.full((B, S), ITEM_NONE, jnp.int32)
+    out2 = jnp.full((B, S), ITEM_NONE, jnp.int32)
+    outpos = jnp.zeros((B,), jnp.int32)
+
+    for rep in range(numrep):
+
+        def try_body(state, rep=rep):
+            ftotal, item0, leaf0, done = state
+            active = parent_ok & ~done & (ftotal < tries)
+            r = rep + ftotal
+            cand = descend_b(
+                cm, score_fn, root, x, r, want_type, cweights, outpos
+            )
+            dead = cand == ITEM_NONE
+            collide = (
+                jnp.any(
+                    (out == cand[:, None])
+                    & (jnp.arange(S)[None, :] < outpos[:, None]),
+                    axis=1,
+                )
+                & ~dead
+            )
+            if recurse:
+                use_leaf = (cand < 0) & ~dead & ~collide
+                leaf_r, leaf_ok_r = _leaf_firstn_b(
+                    cm, score_fn, weightvec, x, cand, r, outpos, out2,
+                    recurse_tries, cweights, active & use_leaf,
+                )
+                direct_ok = (cand >= 0) & ~is_out_b(weightvec, cand, x)
+                leaf = jnp.where(use_leaf, leaf_r, cand)
+                leaf_ok = jnp.where(use_leaf, leaf_ok_r, direct_ok)
+                reject = ~leaf_ok
+            else:
+                leaf = cand
+                reject = dead | jnp.where(
+                    cand >= 0, is_out_b(weightvec, cand, x), False
+                )
+            ok = active & ~dead & ~collide & ~reject
+            return (
+                ftotal + 1,
+                jnp.where(ok & ~done, cand, item0),
+                jnp.where(ok & ~done, leaf, leaf0),
+                done | ok,
+            )
+
+        def try_cond(state):
+            ftotal, _, _, done = state
+            return jnp.any(parent_ok & ~done & (ftotal < tries))
+
+        _, item, leaf, done = jax.lax.while_loop(
+            try_cond,
+            try_body,
+            (
+                jnp.zeros((B,), jnp.int32),
+                jnp.full((B,), ITEM_NONE, jnp.int32),
+                jnp.full((B,), ITEM_NONE, jnp.int32),
+                jnp.zeros((B,), bool),
+            ),
+        )
+        slotmask = jnp.arange(S)[None, :] == outpos[:, None]
+        put = done[:, None] & slotmask
+        out = jnp.where(put, item[:, None], out)
+        out2 = jnp.where(put, leaf[:, None], out2)
+        outpos = outpos + done.astype(jnp.int32)
+    return out, out2, outpos
+
+
+def choose_indep_b(
+    cm, score_fn, weightvec, x, root, numrep: int, want_type: int,
+    tries: int, recurse: bool, recurse_tries: int, cweights, parent_ok,
+):
+    """crush_choose_indep over lanes: positional retries
+    r = rep + numrep*ftotal; failed positions stay ITEM_NONE (EC shard
+    holes).  Returns (out [B, numrep], out2 [B, numrep])."""
+    B = x.shape[0]
+    S = numrep
+    out = jnp.full((B, S), ITEM_NONE, jnp.int32)
+    out2 = jnp.full((B, S), ITEM_NONE, jnp.int32)
+    placed = ~parent_ok[:, None] & jnp.ones((B, S), bool)
+
+    def ft_body(state):
+        ftotal, out, out2, placed = state
+        for rep in range(numrep):
+            active = parent_ok & ~placed[:, rep]
+            # indep rounds share one global ftotal (scalar) — broadcast to
+            # lanes for the descend/straw2 [B] contract
+            r = jnp.broadcast_to(rep + numrep * ftotal, x.shape).astype(jnp.int32)
+            # weight-set position is the choose's outpos — 0 at the top
+            # level (mapper.c); the leaf recursion below uses rep
+            cand = descend_b(
+                cm, score_fn, root, x, r, want_type, cweights,
+                jnp.zeros((B,), jnp.int32),
+            )
+            dead = cand == ITEM_NONE
+            collide = jnp.any((out == cand[:, None]) & placed, axis=1) & ~dead
+
+            if recurse:
+                use_leaf = (cand < 0) & ~dead & ~collide
+
+                def lbody(state, rep=rep, r=r, cand=cand):
+                    lf, leaf0, done = state
+                    leaf = descend_b(
+                        cm, score_fn, cand, x, rep + numrep * lf + r, 0,
+                        cweights, jnp.full((B,), rep, jnp.int32),
+                    )
+                    ok = (leaf >= 0) & ~is_out_b(weightvec, leaf, x)
+                    return lf + 1, jnp.where(ok & ~done, leaf, leaf0), done | ok
+
+                def lcond(state):
+                    lf, _, done = state
+                    return jnp.any(~done & (lf < recurse_tries))
+
+                _, lleaf, lok = jax.lax.while_loop(
+                    lcond,
+                    lbody,
+                    (
+                        jnp.zeros((B,), jnp.int32),
+                        jnp.full((B,), ITEM_NONE, jnp.int32),
+                        jnp.zeros((B,), bool),
+                    ),
+                )
+                direct_ok = (cand >= 0) & ~is_out_b(weightvec, cand, x)
+                leaf = jnp.where(use_leaf, jnp.where(lok, lleaf, ITEM_NONE), cand)
+                leaf_ok = jnp.where(use_leaf, lok, direct_ok)
+                ok = ~dead & ~collide & leaf_ok
+            else:
+                leaf = cand
+                reject = dead | jnp.where(
+                    cand >= 0, is_out_b(weightvec, cand, x), False
+                )
+                ok = ~dead & ~collide & ~reject
+
+            take = active & ok
+            # structural dead end: permanent NONE for this position
+            # (mapper.c keeps out[rep] = ITEM_NONE and never retries it)
+            dead_perm = active & dead
+            slotmask = jnp.arange(S)[None, :] == rep
+            out = jnp.where(take[:, None] & slotmask, cand[:, None], out)
+            out2 = jnp.where(take[:, None] & slotmask, leaf[:, None], out2)
+            placed = placed | ((take | dead_perm)[:, None] & slotmask)
+        return ftotal + 1, out, out2, placed
+
+    def ft_cond(state):
+        ftotal, _, _, placed = state
+        return (ftotal < tries) & jnp.any(~placed)
+
+    _, out, out2, _ = jax.lax.while_loop(
+        ft_cond, ft_body, (jnp.int32(0), out, out2, placed)
+    )
+    return out, out2

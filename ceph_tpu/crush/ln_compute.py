@@ -1,0 +1,186 @@
+"""crush_ln computed on-device from the small RH/LH/LL tables — no 2^16
+gather (reference: src/crush/mapper.c :: crush_ln + crush_ln_table.h).
+
+Why this exists: TPUs have no hardware vector gather, so the straw2 hot
+loop's per-(x, item) lookup into the 65,536-entry CRUSH_LN_TABLE serializes
+at ~9 ns/element and dominates the whole batched mapper (measured ~0.55 s of
+a 0.62 s straw2 launch at 262k x 128 draws on v5e).  The reference's own
+formulation of crush_ln only ever consults two tables of 129 and 256
+entries; lookups that small vectorize as one-hot matmuls on the MXU, and
+the remaining arithmetic is exact 32-bit limb math on the VPU.
+
+Everything here is int32/float32-safe — no int64, so it runs identically
+as plain jnp (CPU, tests) and inside a Mosaic kernel (ops/pallas_crush.py).
+Bit-exactness vs the scalar generator is asserted for all 2^16 inputs in
+tests/test_crush.py.
+
+Layout of the 64-bit intermediates in 32-bit limbs:
+
+- RH = ceil(2^56/index1) <= 2^48 splits into three 16-bit limbs r2,r1,r0
+  (r2 can reach 2^16, still int32/f32-exact).
+- xn*RH needs only its bits 48..55 (index2).  With xn = a*2^9 + b
+  (a < 2^8, b < 2^9), every partial product a*r_i, b*r_i < 2^26, and the
+  products accumulate into base-2^16 limbs L0..L3 with cascaded carries —
+  all < 2^27, exact in int32.
+- LH, LL <= 2^48 split into 24-bit limbs (hi can reach 2^24 when
+  index1 = 512: f32-exact, and the carry math never assumes hi < 2^24).
+- The result (<= 2^48) returns as two int32 planes (hi = bits 24..47,
+  lo = bits 0..23); the straw2 caller recombines into int64 and subtracts
+  the 2^48 bias under its x64 scope.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .ln_table import LL_TBL, RH_LH_TBL
+
+_MASK24 = 0xFFFFFF
+
+
+def _tables_f32() -> tuple[np.ndarray, np.ndarray]:
+    """(TBL1 [129, 8], TBL2 [256, 8]) f32 lookup matrices for the one-hot
+    matmul path.  TBL1 columns: r2, r1, r0 (16-bit limbs of RH), lh_hi,
+    lh_lo (24-bit limbs of LH), 3 zero pads.  TBL2: ll_hi, ll_lo + pads.
+    Every value < 2^25, exact in f32."""
+    rh = RH_LH_TBL[0::2].astype(object)  # 129 entries, python ints
+    lh = RH_LH_TBL[1::2].astype(object)
+    t1 = np.zeros((129, 8), np.float32)
+    t1[:, 0] = [int(v) >> 32 for v in rh]
+    t1[:, 1] = [(int(v) >> 16) & 0xFFFF for v in rh]
+    t1[:, 2] = [int(v) & 0xFFFF for v in rh]
+    t1[:, 3] = [int(v) >> 24 for v in lh]
+    t1[:, 4] = [int(v) & _MASK24 for v in lh]
+    t2 = np.zeros((256, 8), np.float32)
+    t2[:, 0] = [int(v) >> 24 for v in LL_TBL]
+    t2[:, 1] = [int(v) & _MASK24 for v in LL_TBL]
+    return t1, t2
+
+
+TBL1_F32, TBL2_F32 = _tables_f32()
+
+
+def _byte_limb_tables() -> tuple[np.ndarray, np.ndarray]:
+    """The same tables split into 8-bit limbs for single-pass bf16 matmul
+    lookups (bf16 represents 0..255 exactly; the MXU's default f32 path
+    truncates operands to bf16, so full-width f32 columns need the slow
+    HIGHEST-precision multi-pass mode — byte limbs don't).
+
+    TBL1_BYTES [256, 16]: r2[3], r1[2], r0[2], lh_hi[4], lh_lo[3], pad.
+    TBL2_BYTES [256, 8]:  ll_hi[4], ll_lo[3], pad.
+    Limb j of a value v is (v >> 8j) & 0xFF; recombine with shifts+ors.
+    """
+    rh = [int(v) for v in RH_LH_TBL[0::2]]
+    lh = [int(v) for v in RH_LH_TBL[1::2]]
+    ll = [int(v) for v in LL_TBL]
+
+    def limbs(vals, n):
+        return np.array(
+            [[(v >> (8 * j)) & 0xFF for j in range(n)] for v in vals],
+            np.float32,
+        )
+
+    t1 = np.zeros((256, 16), np.float32)
+    t1[:129, 0:3] = limbs([v >> 32 for v in rh], 3)
+    t1[:129, 3:5] = limbs([(v >> 16) & 0xFFFF for v in rh], 2)
+    t1[:129, 5:7] = limbs([v & 0xFFFF for v in rh], 2)
+    t1[:129, 7:11] = limbs([v >> 24 for v in lh], 4)
+    t1[:129, 11:14] = limbs([v & _MASK24 for v in lh], 3)
+    t2 = np.zeros((256, 8), np.float32)
+    t2[:, 0:4] = limbs([v >> 24 for v in ll], 4)
+    t2[:, 4:7] = limbs([v & _MASK24 for v in ll], 3)
+    return t1, t2
+
+
+TBL1_BYTES, TBL2_BYTES = _byte_limb_tables()
+
+
+def recombine_limbs(rows, start: int, n: int, jnp):
+    """Byte limbs rows[..., start:start+n] (f32) -> int32 value.
+
+    Accumulates in f32 (exact: limbs <= 255, every partial sum <= the
+    table value <= 2^24, all f32-representable) with ONE final int32
+    convert — Mosaic miscompiles 3-term int32 shift/or chains over sliced
+    dot results, while the f32 Horner form lowers correctly."""
+    v = rows[..., start + n - 1]
+    for j in range(n - 2, -1, -1):
+        v = v * np.float32(256.0) + rows[..., start + j]
+    return v.astype(jnp.int32)
+
+
+def crush_ln_limbs(u, jnp, lookup1, lookup2):
+    """crush_ln(u) -> (hi, lo) int32 planes (bits 24..47 / 0..23).
+
+    `u`: int32 array in [0, 0xffff].  `jnp`: the array namespace (jax.numpy
+    both outside and inside Pallas kernels).  `lookup1(idx) -> (r2, r1,
+    r0, lh_hi, lh_lo)`, `lookup2(idx) -> (ll_hi, ll_lo)`: int32 limb
+    fetchers — one-hot matmuls in kernels, jnp.take outside.
+    """
+    x = (u + 1).astype(jnp.int32)  # [1, 0x10000]
+    # bit_length via the f32 exponent field (exact: x <= 2^16 < 2^24)
+    xf = x.astype(jnp.float32)
+    bl = (
+        jnp.right_shift(
+            jax_bitcast(jnp, xf), 23
+        )
+        - 126
+    )
+    bits = jnp.maximum(0, 16 - bl)  # normalization shift count
+    xn = jnp.left_shift(x, bits)    # [0x8000, 0x10000*? ] -> [2^15, 2^16]
+    iexpon = 15 - bits
+
+    idx1 = jnp.right_shift(xn, 8) - 128  # (index1 - 256)/2 in [0, 128]
+    r2, r1, r0, lh_hi, lh_lo = lookup1(idx1)
+
+    # index2 = bits 48..55 of xn * RH, in 32-bit limb arithmetic
+    a = jnp.right_shift(xn, 9)      # < 2^8
+    b = xn & 0x1FF                  # < 2^9
+    t0 = b * r0
+    t1 = a * r0
+    t2 = b * r1
+    t3 = a * r1
+    t4 = b * r2
+    t5 = a * r2
+    L0 = t0 + jnp.left_shift(t1 & 0x7F, 9)
+    L1 = jnp.right_shift(t1, 7) + t2 + jnp.left_shift(t3 & 0x7F, 9)
+    L2 = jnp.right_shift(t3, 7) + t4 + jnp.left_shift(t5 & 0x7F, 9)
+    L3 = jnp.right_shift(t5, 7)
+    c0 = jnp.right_shift(L0, 16)
+    c1 = jnp.right_shift(L1 + c0, 16)
+    c2 = jnp.right_shift(L2 + c1, 16)
+    index2 = (L3 + c2) & 0xFF
+
+    ll_hi, ll_lo = lookup2(index2)
+
+    # result = (iexpon << 44) + ((LH + LL) >> 4), in 24-bit limbs
+    lo_sum = lh_lo + ll_lo                      # < 2^25
+    hi_sum = lh_hi + ll_hi + jnp.right_shift(lo_sum, 24)
+    low24 = lo_sum & _MASK24
+    out_lo = jnp.left_shift(hi_sum & 0xF, 20) | jnp.right_shift(low24, 4)
+    out_hi = jnp.left_shift(iexpon, 20) + jnp.right_shift(hi_sum, 4)
+    return out_hi, out_lo
+
+
+def jax_bitcast(jnp, xf):
+    """f32 -> int32 bit pattern (works in jnp and Mosaic)."""
+    import jax
+
+    return jax.lax.bitcast_convert_type(xf, jnp.int32)
+
+
+def crush_ln_jnp(u):
+    """Plain-jnp spelling (jnp.take row lookups) — the CPU/test path and
+    the reference for the Pallas kernel's one-hot variant."""
+    import jax.numpy as jnp
+
+    t1 = jnp.asarray(TBL1_F32, jnp.int32)
+    t2 = jnp.asarray(TBL2_F32, jnp.int32)
+
+    def look1(i):
+        rows = jnp.take(t1, i, axis=0)
+        return tuple(rows[..., j] for j in range(5))
+
+    def look2(i):
+        rows = jnp.take(t2, i, axis=0)
+        return rows[..., 0], rows[..., 1]
+
+    return crush_ln_limbs(jnp.asarray(u, jnp.int32), jnp, look1, look2)

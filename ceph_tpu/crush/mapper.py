@@ -4,28 +4,31 @@ Reference: src/crush/mapper.c :: crush_do_rule / crush_choose_firstn /
 crush_choose_indep / bucket_straw2_choose, vectorized over the placement
 input x exactly as SURVEY.md §3.3 prescribes: all batch consumers (balancer,
 crushtool --test, osdmaptool --test-map-pgs) are embarrassingly parallel over
-x, and the data-dependent retry loops become fixed-trip masked loops bounded
+x, and the data-dependent retry loops become masked fixed-trip loops bounded
 by choose_total_tries (default 50).
 
 Design:
 - The CrushMap is compiled once into dense arrays (items/weights/sizes/types
   padded to the max bucket size) — the analog of CrushWrapper holding the
   crush_map ready for crush_do_rule (reference: src/crush/CrushWrapper.h).
-- A rule compiles at trace time: step structure and replica counts are
-  static (static shapes for XLA), while every per-x decision — straw2
-  draws, descent, collisions, is_out rejections, retries — is traced jnp.
-- One x is evaluated by a single-x function; the batch is jax.vmap over x,
-  so the straw2 hash+ln-gather+argmax inner loop (HOT LOOP #3, SURVEY.md
-  §3.3) runs across the whole batch on the VPU.
+- A rule compiles into a static step plan (TAKE/CHOOSE/EMIT sequence with
+  static replica counts — static shapes for XLA); every per-x decision —
+  straw2 draws, descent, collisions, is_out rejections, retries — is traced
+  jnp over explicit [B] lane arrays (ceph_tpu/crush/batched.py).
+- Multi-choose chains (TAKE → CHOOSE rack → CHOOSE host → EMIT) flatten the
+  parent axis into the lane axis: a step with W working items per x runs
+  one batched choose over N*W lanes, mirroring mapper.c's `for (i = 0;
+  i < wsize; i++)` loop over the working vector.
+- The straw2 score path is pluggable: full-table ln gather on CPU, the
+  fused Pallas hash+ln kernel on TPU (TPUs have no vector gather — see
+  ceph_tpu/crush/ln_compute.py).
 - int64-exact: draws are div64_s64-style truncating divisions on int64
-  (requires jax_enable_x64; SURVEY.md §7 hard parts).
+  (x64 scoped to the CRUSH traces; a global flip breaks Mosaic compiles).
 
 Scope matches the scalar twin (ceph_tpu/crush/reference_mapper.py): straw2
-buckets, modern tunables (stable=1, vary_r=1, local retries 0), rules of the
-shape TAKE -> (SET_*)* -> one CHOOSE/CHOOSELEAF -> EMIT (what
-add_simple_rule and OSDMonitor's EC rules emit).  The scalar Python, the C++
-oracle, and this mapper must agree bit-for-bit on every input — enforced by
-tests/test_crush.py over random maps and large x sweeps.
+buckets, modern tunables (stable=1, vary_r=1, local retries 0).  The scalar
+Python, the C++ oracle, and this mapper must agree bit-for-bit on every
+input — enforced by tests/test_crush.py over random maps and large x sweeps.
 """
 from __future__ import annotations
 
@@ -33,8 +36,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .hash import crush_hash32_2, crush_hash32_3
-from .ln_table import CRUSH_LN_TABLE, LN_BIAS
+from .batched import (
+    choose_firstn_b,
+    choose_indep_b,
+    ln_scores_jnp,
+    ln_scores_pallas,
+)
+from .ln_table import CRUSH_LN_TABLE
 from .types import ITEM_NONE, CrushMap, RuleOp
 
 # straw2 is 64-bit fixed-point integer math (SURVEY.md §7 hard parts).  x64
@@ -55,12 +63,11 @@ def enable_x64():
 
         return _e()
 
-S64_MIN = np.int64(np.iinfo(np.int64).min)
 
-# Max x per device launch.  Empirically (v5e, 1024-OSD hierarchical map):
-# one vmapped launch at 1M x crashes the TPU worker process outright, while
-# <=512k launches complete; 256k leaves 2x margin and still amortizes
-# dispatch to noise.
+# Max LANES (x times working-set width) per device launch.  Empirically
+# (v5e, 1024-OSD hierarchical map): one launch at 1M lanes crashes the TPU
+# worker process outright, while <=512k complete; 256k leaves 2x margin and
+# still amortizes dispatch to noise.
 _BATCH_CHUNK = 1 << 18
 
 
@@ -147,263 +154,18 @@ class CompiledCrushMap:
         self._choose_args_cache[name] = arr
         return arr
 
-    def item_type(self, item):
-        """type of an item id: devices 0, buckets their declared type."""
-        idx = jnp.clip(jnp.where(item < 0, -1 - item, 0), 0, self.types.shape[0] - 1)
-        return jnp.where(item < 0, jnp.take(self.types, idx), 0)
 
-
-def _div64_trunc(a, b):
-    """C-style truncating signed division (div64_s64)."""
-    q = jnp.abs(a) // jnp.abs(b)
-    return jnp.where((a < 0) != (b < 0), -q, q).astype(jnp.int64)
-
-
-def _straw2_choose(cm: CompiledCrushMap, bucket_idx, x, r, cweights, position):
-    """mapper.c :: bucket_straw2_choose for one x (vmap-friendly).
-
-    Exponential-race draw per slot; first argmax matches the C loop's
-    strict-greater update.  Empty bucket -> ITEM_NONE; all-zero-weight
-    bucket -> items[0] (C semantics: high stays 0).  cweights is an optional
-    [P, n_idx, S] choose_args weight array; position picks the row (clamped,
-    as get_choose_arg_weights does)."""
-    bucket_idx = jnp.clip(bucket_idx, 0, cm.items.shape[0] - 1)
-    # jnp.take (gather), NOT arr[idx]: scalar dynamic indexing lowers to
-    # dynamic_slice, whose vmap batching rule BROADCASTS the whole bucket
-    # matrix per batch element — [N, n_idx, S] blew HBM at N=1M on v5e
-    items = jnp.take(cm.items, bucket_idx, axis=0)        # [S]
-    if cweights is None:
-        weights = jnp.take(cm.weights, bucket_idx, axis=0)    # [S]
-    else:
-        pos = jnp.minimum(position, cweights.shape[0] - 1)
-        flat = cweights.reshape(-1, cweights.shape[-1])
-        weights = jnp.take(flat, pos * cm.items.shape[0] + bucket_idx, axis=0)
-    size = jnp.take(cm.sizes, bucket_idx)
-    u = (
-        crush_hash32_3(
-            jnp.uint32(x), items.astype(jnp.uint32), jnp.uint32(r)
-        ).astype(jnp.int64)
-        & 0xFFFF
-    )
-    ln = cm.ln_table[u] - LN_BIAS
-    draw = _div64_trunc(ln, jnp.maximum(weights, 1))
-    slot = jnp.arange(items.shape[0])
-    valid = (slot < size) & (weights > 0)
-    draw = jnp.where(valid, draw, S64_MIN)
-    return jnp.where(size > 0, items[jnp.argmax(draw)], ITEM_NONE)
-
-
-def _is_out(weightvec, item, x):
-    """mapper.c :: is_out — probabilistic reject by device reweight."""
-    n = weightvec.shape[0]
-    idx = jnp.clip(item, 0, n - 1)
-    w = jnp.take(weightvec, idx).astype(jnp.int64)
-    oob = item >= n
-    h = crush_hash32_2(jnp.uint32(x), jnp.uint32(item)).astype(jnp.int64) & 0xFFFF
-    return oob | (w == 0) | ((w < 0x10000) & (h >= w))
-
-
-def _descend(cm: CompiledCrushMap, root, x, r, want_type: int, cweights, position):
-    """Walk intervening buckets until an item of want_type appears
-    (mapper.c's inner retry_bucket descent); dead ends yield ITEM_NONE.
-
-    Dead ends are: an empty bucket mid-descent, and a *device* of the wrong
-    type (mapper.c "bad item type" — e.g. an OSD placed directly under the
-    root when the rule wants hosts); both reject rather than mis-place."""
-
-    def cond(item):
-        return (item < 0) & (item != ITEM_NONE) & (cm.item_type(item) != want_type)
-
-    def body(item):
-        return _straw2_choose(cm, -1 - item, x, r, cweights, position)
-
-    item = jax.lax.while_loop(cond, body, jnp.asarray(root, jnp.int32))
-    if want_type != 0:
-        item = jnp.where(item >= 0, ITEM_NONE, item)
-    return item
-
-
-def _leaf_firstn(
-    cm, weightvec, x, item, sub_r, outpos, out2, S, recurse_tries, cweights
-):
-    """Nested chooseleaf descent (crush_choose_firstn recursion with
-    stable=1: one rep, r = sub_r + ftotal, collisions vs out2[:outpos])."""
-
-    def body(state):
-        ftotal, _, done = state
-        leaf = _descend(cm, item, x, sub_r + ftotal, 0, cweights, outpos)
-        is_dev = leaf >= 0
-        collide = jnp.any((out2 == leaf) & (jnp.arange(S) < outpos)) & is_dev
-        reject = jnp.where(is_dev, _is_out(weightvec, leaf, x), True)
-        ok = is_dev & ~collide & ~reject
-        return ftotal + 1, leaf, done | ok
-
-    def cond(state):
-        ftotal, _, done = state
-        return (~done) & (ftotal < recurse_tries)
-
-    _, leaf, done = jax.lax.while_loop(
-        cond, body, (jnp.int32(0), jnp.int32(ITEM_NONE), False)
-    )
-    return jnp.where(done, leaf, ITEM_NONE), done
-
-
-def _choose_firstn_single(
-    cm, weightvec, x, root, numrep, want_type, tries, recurse, recurse_tries,
-    cweights,
-):
-    """crush_choose_firstn for one x under modern tunables.
-
-    Returns (out, out2, count); out holds failure-domain items, out2 leaves
-    (== out when not recursing); both dense in [0, count)."""
-    S = numrep
-    out = jnp.full((S,), ITEM_NONE, dtype=jnp.int32)
-    out2 = jnp.full((S,), ITEM_NONE, dtype=jnp.int32)
-
-    def rep_body(rep, carry):
-        out, out2, outpos = carry
-
-        def try_body(state):
-            ftotal, _, _, done = state
-            r = rep + ftotal
-            cand = _descend(cm, root, x, r, want_type, cweights, outpos)
-            dead = cand == ITEM_NONE
-            collide = jnp.any((out == cand) & (jnp.arange(S) < outpos)) & ~dead
-            if recurse:
-                # both paths computed + jnp.where, NOT lax.cond: a batched-
-                # predicate cond inside a while_loop makes vmap broadcast
-                # the branch constants (the whole bucket matrix) to
-                # [N, n_idx, S] — the HBM blowup found at 1M x on v5e.
-                # vmap executes both branches of a cond anyway.
-                use_leaf = (cand < 0) & ~dead & ~collide
-                leaf_r, leaf_ok_r = _leaf_firstn(
-                    cm, weightvec, x, cand, r, outpos, out2, S,
-                    recurse_tries, cweights,
-                )
-                direct_ok = (cand >= 0) & ~_is_out(weightvec, cand, x)
-                leaf = jnp.where(use_leaf, leaf_r, jnp.asarray(cand, jnp.int32))
-                leaf_ok = jnp.where(use_leaf, leaf_ok_r, direct_ok)
-                reject = ~leaf_ok
-            else:
-                leaf = cand
-                reject = dead | jnp.where(
-                    cand >= 0, _is_out(weightvec, cand, x), False
-                )
-            ok = ~dead & ~collide & ~reject
-            return ftotal + 1, cand, leaf, done | ok
-
-        def try_cond(state):
-            ftotal, _, _, done = state
-            return (~done) & (ftotal < tries)
-
-        _, item, leaf, done = jax.lax.while_loop(
-            try_cond,
-            try_body,
-            (jnp.int32(0), jnp.int32(ITEM_NONE), jnp.int32(ITEM_NONE), False),
-        )
-        out = jnp.where(done, out.at[outpos].set(item), out)
-        out2 = jnp.where(done, out2.at[outpos].set(leaf), out2)
-        return out, out2, outpos + done.astype(jnp.int32)
-
-    out, out2, outpos = jax.lax.fori_loop(
-        0, numrep, rep_body, (out, out2, jnp.int32(0))
-    )
-    return out, out2, outpos
-
-
-def _choose_indep_single(
-    cm, weightvec, x, root, numrep, want_type, tries, recurse, recurse_tries,
-    cweights,
-):
-    """crush_choose_indep for one x: positional retries r = rep +
-    numrep*ftotal; failed positions stay ITEM_NONE (EC shard holes).
-    Leaf recursion checks no cross-rep collisions (mapper.c passes the
-    recursion outpos=rep, left=1, so its collide scan covers only [rep])."""
-    S = numrep
-    out = jnp.full((S,), ITEM_NONE, dtype=jnp.int32)
-    out2 = jnp.full((S,), ITEM_NONE, dtype=jnp.int32)
-    placed = jnp.zeros((S,), dtype=bool)
-
-    def ft_body(ftotal, carry):
-        out, out2, placed = carry
-
-        def rep_body(rep, carry2):
-            out, out2, placed = carry2
-            r = rep + numrep * ftotal
-            # weight-set position is the choose's outpos — 0 at the top
-            # level (mapper.c); the leaf recursion below uses rep, its outpos
-            cand = _descend(cm, root, x, r, want_type, cweights, 0)
-            dead = cand == ITEM_NONE
-            collide = jnp.any((out == cand) & placed) & ~dead
-            if recurse:
-                # both paths + jnp.where instead of lax.cond (see
-                # _choose_firstn_single: batched cond in a while broadcasts
-                # the bucket matrices per x)
-                def lbody(state):
-                    lf, _, done = state
-                    leaf = _descend(
-                        cm, cand, x, rep + numrep * lf + r, 0, cweights,
-                        rep,
-                    )
-                    ok = (leaf >= 0) & ~_is_out(weightvec, leaf, x)
-                    return lf + 1, leaf, done | ok
-
-                def lcond(state):
-                    lf, _, done = state
-                    return (~done) & (lf < recurse_tries)
-
-                _, lleaf, lok = jax.lax.while_loop(
-                    lcond, lbody, (jnp.int32(0), jnp.int32(ITEM_NONE), False)
-                )
-                lleaf = jnp.where(lok, lleaf, ITEM_NONE)
-                use_leaf = (cand < 0) & ~dead & ~collide
-                direct_ok = (cand >= 0) & ~_is_out(weightvec, cand, x)
-                leaf = jnp.where(use_leaf, lleaf, jnp.asarray(cand, jnp.int32))
-                leaf_ok = jnp.where(use_leaf, lok, direct_ok)
-                ok = ~dead & ~collide & leaf_ok
-            else:
-                leaf = cand
-                reject = dead | jnp.where(
-                    cand >= 0, _is_out(weightvec, cand, x), False
-                )
-                ok = ~dead & ~collide & ~reject
-            take = ok & ~placed[rep]
-            out = jnp.where(take, out.at[rep].set(cand), out)
-            out2 = jnp.where(take, out2.at[rep].set(leaf), out2)
-            # structural dead end (empty bucket / bad item type): permanent
-            # NONE for this position, matching mapper.c's crush_choose_indep
-            # (out[rep] stays ITEM_NONE and is never retried)
-            dead_perm = (cand == ITEM_NONE) & ~placed[rep]
-            placed = placed.at[rep].set(placed[rep] | take | dead_perm)
-            return out, out2, placed
-
-        return jax.lax.fori_loop(0, numrep, rep_body, (out, out2, placed))
-
-    def ft_cond(state):
-        ftotal, (_, _, placed) = state
-        return (ftotal < tries) & ~placed.all()
-
-    def ft_step(state):
-        ftotal, carry = state
-        return ftotal + 1, ft_body(ftotal, carry)
-
-    _, (out, out2, placed) = jax.lax.while_loop(
-        ft_cond, ft_step, (jnp.int32(0), (out, out2, placed))
-    )
-    return out, out2, jnp.sum(placed.astype(jnp.int32))
-
-
-def compile_rule(cm: CompiledCrushMap, rule_id: int, numrep: int) -> dict:
-    """Static plan for a TAKE -> CHOOSE -> EMIT rule (trace-time)."""
+def compile_plan(cm: CompiledCrushMap, rule_id: int, numrep: int) -> list[dict]:
+    """Static step plan for an arbitrary TAKE/(SET_*)/CHOOSE*/EMIT rule
+    (the trace-time analog of crush_do_rule's step switch)."""
     rule = cm.cmap.rules[rule_id]
     t = cm.cmap.tunables
-    plan = []
+    plan: list[dict] = []
     tries = t.choose_total_tries
     leaf_tries = 0
-    take = None
     for step in rule.steps:
         if step.op == RuleOp.TAKE:
-            take = step.arg1
+            plan.append(dict(op="take", take=step.arg1))
         elif step.op == RuleOp.SET_CHOOSE_TRIES:
             tries = step.arg1
         elif step.op == RuleOp.SET_CHOOSELEAF_TRIES:
@@ -414,16 +176,14 @@ def compile_rule(cm: CompiledCrushMap, rule_id: int, numrep: int) -> dict:
             RuleOp.CHOOSELEAF_FIRSTN,
             RuleOp.CHOOSELEAF_INDEP,
         ):
-            if take is None:
-                raise ValueError("CHOOSE before TAKE")
             want = step.arg1 if step.arg1 > 0 else numrep + step.arg1
+            firstn = step.op in (RuleOp.CHOOSE_FIRSTN, RuleOp.CHOOSELEAF_FIRSTN)
             plan.append(
                 dict(
-                    take=take,
+                    op="choose",
                     want=want,
                     type=step.arg2,
-                    firstn=step.op
-                    in (RuleOp.CHOOSE_FIRSTN, RuleOp.CHOOSELEAF_FIRSTN),
+                    firstn=firstn,
                     recurse=step.op
                     in (RuleOp.CHOOSELEAF_FIRSTN, RuleOp.CHOOSELEAF_INDEP),
                     tries=tries,
@@ -431,16 +191,126 @@ def compile_rule(cm: CompiledCrushMap, rule_id: int, numrep: int) -> dict:
                 )
             )
         elif step.op == RuleOp.EMIT:
-            pass
+            plan.append(dict(op="emit"))
         else:
             raise ValueError(f"unsupported rule op {step.op}")
-    if not plan:
+    if not any(p["op"] == "choose" for p in plan):
         raise ValueError("rule has no CHOOSE step")
-    if len(plan) != 1:
+    return plan
+
+
+def compile_rule(cm: CompiledCrushMap, rule_id: int, numrep: int) -> dict:
+    """Single-choose plan (the C++ oracle bridge's wire format); raises on
+    multi-choose chains, which only the JAX and scalar mappers interpret."""
+    steps = compile_plan(cm, rule_id, numrep)
+    chooses = [p for p in steps if p["op"] == "choose"]
+    takes = [p for p in steps if p["op"] == "take"]
+    if len(chooses) != 1 or len(takes) != 1:
         raise NotImplementedError(
-            "multi-choose rule chains not yet supported by the batch mapper"
+            "the C++ oracle speaks single-TAKE single-CHOOSE plans only"
         )
-    return plan[0]
+    return dict(takes[0], **chooses[0])
+
+
+def _firstn_compact(work: jnp.ndarray) -> jnp.ndarray:
+    """Dense-pack non-NONE entries left, preserving order (crush_do_rule
+    concatenates each parent's successes contiguously into the working
+    vector)."""
+    is_none = work == ITEM_NONE
+    order = jnp.argsort(is_none, axis=1, stable=True)
+    return jnp.take_along_axis(work, order, axis=1)
+
+
+def _build_rule_fn(cm: CompiledCrushMap, rule_id: int, numrep: int,
+                   choose_args: str | None, score_fn):
+    plan = compile_plan(cm, rule_id, numrep)
+    cweights = (
+        cm.choose_args_arrays(choose_args) if choose_args is not None else None
+    )
+
+    def fn(xs, weightvec):
+        N = xs.shape[0]
+        work = None          # [N, W] current working vector
+        emitted = []         # list of [N, w] blocks
+        for p in plan:
+            if p["op"] == "take":
+                work = jnp.full((N, 1), p["take"], jnp.int32)
+            elif p["op"] == "choose":
+                if work is None:
+                    raise ValueError("CHOOSE before TAKE")
+                W = work.shape[1]
+                want = p["want"]
+                parents = work.reshape(N * W)
+                x_b = jnp.repeat(xs, W) if W > 1 else xs
+                parent_ok = (parents < 0) & (parents != ITEM_NONE)
+                fn_b = choose_firstn_b if p["firstn"] else choose_indep_b
+                tries = p["tries"]
+                recurse_tries = (
+                    (p["leaf_tries"] or tries)
+                    if p["firstn"]
+                    else (p["leaf_tries"] or 1)
+                )
+                res = fn_b(
+                    cm, score_fn, weightvec, x_b, parents, want, p["type"],
+                    tries, p["recurse"], recurse_tries, cweights, parent_ok,
+                )
+                out, out2 = res[0], res[1]
+                chosen = out2 if p["recurse"] else out
+                if p["firstn"]:
+                    cnt = res[2]
+                    chosen = jnp.where(
+                        jnp.arange(want)[None, :] < cnt[:, None],
+                        chosen,
+                        ITEM_NONE,
+                    )
+                chosen = chosen.reshape(N, W * want)
+                if p["firstn"] and W > 1:
+                    chosen = _firstn_compact(chosen)
+                work = chosen
+            else:  # emit
+                if work is not None:
+                    emitted.append(work)
+                work = None
+        if work is not None:  # tolerate a missing trailing EMIT
+            emitted.append(work)
+        result = emitted[0] if len(emitted) == 1 else jnp.concatenate(
+            emitted, axis=1
+        )
+        # contract: [N, numrep] — truncate extra width, pad scarcity
+        if result.shape[1] > numrep:
+            result = result[:, :numrep]
+        elif result.shape[1] < numrep:
+            result = jnp.concatenate(
+                [
+                    result,
+                    jnp.full((N, numrep - result.shape[1]), ITEM_NONE, jnp.int32),
+                ],
+                axis=1,
+            )
+        return result
+
+    # max lanes any step fans out to, for memory-aware chunking
+    width = 1
+    max_width = 1
+    for p in plan:
+        if p["op"] == "take":
+            width = 1
+        elif p["op"] == "choose":
+            width *= p["want"]
+            max_width = max(max_width, width)
+    return jax.jit(fn), max_width
+
+
+def default_score_fn():
+    """Pick the straw2 ln path for the active backend: the fused Pallas
+    hash+ln kernel on TPU (no hardware vector gather — the 2^16-entry
+    table gather serializes there), the XLA table gather on CPU."""
+    # 'axon' is this machine's tunneled TPU platform name; anything else
+    # (cpu, gpu) has fast hardware gathers and no Mosaic, so the table
+    # gather is both correct and faster there
+    if jax.default_backend() in ("tpu", "axon"):
+        return ln_scores_pallas
+    return ln_scores_jnp
 
 
 def crush_do_rule_batch(
@@ -457,74 +327,50 @@ def crush_do_rule_batch(
     adds (SURVEY.md §1 seam #2); consumed by the balancer simulation, the
     crushtool-analog --test, and the osdmaptool-analog --test-map-pgs.
     firstn results are dense with ITEM_NONE tail padding; indep results keep
-    positional ITEM_NONE holes (EC shard semantics)."""
+    positional ITEM_NONE holes (EC shard semantics).  Arbitrary
+    TAKE/CHOOSE/EMIT chains are interpreted (multi-choose rules flatten the
+    working vector into the lane axis)."""
     key = (rule_id, numrep, choose_args)
-    vf = cm._rule_fn_cache.get(key)
-    if vf is None:
-        p = compile_rule(cm, rule_id, numrep)
-        cweights = (
-            cm.choose_args_arrays(choose_args)
-            if choose_args is not None
-            else None
-        )
-        fn = _choose_firstn_single if p["firstn"] else _choose_indep_single
-        tries = p["tries"]
-        recurse_tries = (
-            (p["leaf_tries"] or tries) if p["firstn"] else (p["leaf_tries"] or 1)
-        )
-
-        def single(x, wv):
-            out, out2, cnt = fn(
-                cm,
-                wv,
-                x,
-                p["take"],
-                p["want"],
-                p["type"],
-                tries,
-                p["recurse"],
-                recurse_tries,
-                cweights,
+    cached = cm._rule_fn_cache.get(key)
+    if cached is None:
+        with enable_x64():
+            cached = _build_rule_fn(
+                cm, rule_id, numrep, choose_args, default_score_fn()
             )
-            res = out2 if p["recurse"] else out
-            if p["firstn"]:
-                res = jnp.where(jnp.arange(res.shape[0]) < cnt, res, ITEM_NONE)
-            return res
-
-        # jit once per (rule, numrep, choose_args) and cache on the map:
-        # a fresh jit-wrapped closure per call would recompile every call
-        # (jax caches by function identity), which at 256k x costs minutes
-        vf = jax.jit(jax.vmap(single, in_axes=(0, None)))
-        cm._rule_fn_cache[key] = vf
+        cm._rule_fn_cache[key] = cached
+    vf, max_width = cached
 
     with enable_x64():
         xs_np = np.asarray(xs, dtype=np.int32)
         weightvec = jnp.asarray(weightvec, dtype=jnp.int64)
         N = xs_np.shape[0]
-        if N <= _BATCH_CHUNK:
+        # chunk by LANES (N x max step width), not raw N: a multi-choose
+        # step fans each x out to its working-vector width
+        chunk_n = max(1, _BATCH_CHUNK // max_width)
+        if N <= chunk_n:
             # pad to the next power of two: bounds the number of distinct
             # compiled shapes to log2(_BATCH_CHUNK) across all callers
             Np = max(1, 1 << (max(N, 1) - 1).bit_length())
             out = vf(jnp.asarray(np.resize(xs_np, Np)), weightvec)
             return out[:N] if Np != N else out
-        # Large batches run as fixed-size device calls: one Mosaic launch
-        # over >~512k x (vmapped int64 while-loops) hard-faults the v5e
-        # worker, and a single huge launch would also hold the whole
-        # [N, trace] intermediate set live in HBM.  Chunking keeps each
-        # launch inside the envelope at ~zero throughput cost (the per-x
-        # math dwarfs dispatch).
+        # Large batches run as fixed-size device calls: one launch over
+        # >~512k lanes (int64 while-loops) hard-faults the v5e worker, and
+        # a single huge launch would also hold the whole [lanes, S]
+        # intermediate set live in HBM.  Chunking keeps each launch inside
+        # the envelope at ~zero throughput cost (per-x math dwarfs
+        # dispatch).
         pieces = []
-        for lo in range(0, N, _BATCH_CHUNK):
-            part = xs_np[lo : lo + _BATCH_CHUNK]
+        for lo in range(0, N, chunk_n):
+            part = xs_np[lo : lo + chunk_n]
             # ragged tail: pad to its own next power of two (a shape the
-            # small-batch path compiles anyway), not to a full chunk —
-            # padding 1 element to 256k would be pure discarded compute
+            # small-batch path compiles anyway), not to a full chunk
             width = (
-                _BATCH_CHUNK
-                if len(part) == _BATCH_CHUNK
+                chunk_n
+                if len(part) == chunk_n
                 else 1 << (len(part) - 1).bit_length()
             )
-            chunk = np.resize(part, width)
-            pieces.append(np.asarray(vf(jnp.asarray(chunk), weightvec))[: len(part)])
-        out = np.concatenate(pieces)
-        return jnp.asarray(out)
+            padded = np.resize(part, width)
+            pieces.append(
+                np.asarray(vf(jnp.asarray(padded), weightvec))[: len(part)]
+            )
+        return jnp.asarray(np.concatenate(pieces))

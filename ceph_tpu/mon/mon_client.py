@@ -176,14 +176,12 @@ class MonClient(Dispatcher):
                 raise TimeoutError(
                     f"no osdmap epoch >= {min_epoch} (have {have})"
                 )
-            # not served yet: re-dial if the connection died (a fresh dial
-            # re-arms the subscription); nudge the sub on a live one
+            # not served yet: re-dial if the connection died — the fresh
+            # dial re-arms the subscription.  A live connection needs no
+            # nudge (re-sending the sub every slice would make the mon
+            # push the full map once per second per waiting daemon).
             try:
-                with self._lock:
-                    live = self._conn is not None and self._conn.is_connected
-                conn = self._connect()
-                if live:
-                    self._renew_sub(conn)
+                self._connect()
             except (OSError, ConnectionError):
                 pass
 

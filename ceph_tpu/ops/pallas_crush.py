@@ -1,0 +1,145 @@
+"""Pallas fused straw2 score kernel — hash + crush_ln without gathers
+(HOT LOOP #3 of SURVEY.md §3.3, the straw2 draw inner loop).
+
+Why: TPUs have no hardware vector gather, so XLA lowers the batched
+mapper's two per-(x, item) random lookups — the 2^16-entry CRUSH_LN_TABLE
+gather — at ~9 ns/element; measured, that one op was ~0.55 s of every
+0.62 s straw2 launch at 262k x 128 draws on v5e, and XLA's int32 rjenkins
+hash another 0.06 s.  This kernel keeps everything in VMEM:
+
+    per [T, S] tile:  rjenkins1_3(x, item, r) on the VPU (u32 add/xor/
+                      shift only — no multiplies in the hash) ->
+                      u = h & 0xffff ->
+                      crush_ln(u) via the reference's OWN small-table
+                      formulation (crush/ln_compute.py): two lookups into
+                      129- and 256-entry tables, each a one-hot f32
+                      matmul on the MXU (the TPU-native gather), plus
+                      exact 32-bit limb arithmetic ->
+                      ln as two int32 planes (bits 24..47 / 0..23)
+
+The caller (crush/mapper.py score path) recombines the planes into int64
+and runs the div64 draw + argmax under its x64 scope — those measured at
+noise level.  Plays the role the compiled mapper.c straw2 loop plays for
+the reference (reference: src/crush/mapper.c :: bucket_straw2_choose).
+
+Bit-exactness: tests/test_crush.py compares this path (interpret=True on
+CPU) against the table gather for random and exhaustive inputs.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crush.hash import crush_hash32_3
+from ..crush.ln_compute import (
+    TBL1_BYTES,
+    TBL2_BYTES,
+    crush_ln_limbs,
+    recombine_limbs,
+)
+
+# one-hot matmul tables in 8-bit limbs (bf16-exact), bf16 operands so the
+# MXU runs its fast single-pass mode while staying bit-exact: the default
+# f32 path silently truncates operands to bf16 (observed: table value
+# 34663 -> 34560), and HIGHEST-precision f32 costs a 6-pass decomposition
+_T1 = TBL1_BYTES  # [256, 16], rows 129.. zero-padded by the builder
+_T2 = TBL2_BYTES  # [256, 8]
+
+DEFAULT_TILE = 64  # rows per grid step ([T, S] tile; S padded to 128)
+
+
+def _onehot_lookup(idx, tbl_bf16, ncols: int):
+    """[T, S] int32 indices -> [T, S, ncols] f32 byte-limb rows via a bf16
+    one-hot matmul (exact: one-hot rows select a single 0..255 value, and
+    bf16 represents those exactly).  The 3D one-hot + last-dim contraction
+    is the shape Mosaic legalizes (2D flatten reshapes are not)."""
+    K = tbl_bf16.shape[0]
+    oh = (
+        idx[:, :, None]
+        == jax.lax.broadcasted_iota(jnp.int32, (1, 1, K), 2)
+    ).astype(jnp.bfloat16)
+    return jax.lax.dot_general(
+        oh, tbl_bf16,
+        dimension_numbers=(((2,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _score_kernel(x_ref, r_ref, items_ref, t1_ref, t2_ref, hi_ref, lo_ref):
+    x = x_ref[:]          # [T, 1] int32
+    r = r_ref[:]          # [T, 1] int32
+    items = items_ref[:]  # [T, S] int32
+    h = crush_hash32_3(
+        x.astype(jnp.uint32),  # broadcasts [T, 1] across S
+        items.astype(jnp.uint32),
+        r.astype(jnp.uint32),
+    )
+    u = (h & jnp.uint32(0xFFFF)).astype(jnp.int32)
+    t1 = t1_ref[:]
+    t2 = t2_ref[:]
+
+    def look1(i):
+        rows = _onehot_lookup(i, t1, 16)
+        return (
+            recombine_limbs(rows, 0, 3, jnp),    # r2
+            recombine_limbs(rows, 3, 2, jnp),    # r1
+            recombine_limbs(rows, 5, 2, jnp),    # r0
+            recombine_limbs(rows, 7, 4, jnp),    # lh_hi
+            recombine_limbs(rows, 11, 3, jnp),   # lh_lo
+        )
+
+    def look2(i):
+        rows = _onehot_lookup(i, t2, 8)
+        return (
+            recombine_limbs(rows, 0, 4, jnp),    # ll_hi
+            recombine_limbs(rows, 4, 3, jnp),    # ll_lo
+        )
+
+    hi, lo = crush_ln_limbs(u, jnp, look1, look2)
+    hi_ref[:] = hi
+    lo_ref[:] = lo
+
+
+@partial(jax.jit, static_argnames=("tile", "interpret"))
+def straw2_scores_pallas(x, r, items, tile: int = DEFAULT_TILE,
+                         interpret: bool = False):
+    """(x [B], r [B], items [B, S]) -> (ln_hi [B, S], ln_lo [B, S]) int32.
+
+    B must be a multiple of `tile` and S a multiple of 128 (the mapper
+    pads); planes combine as crush_ln = hi * 2^24 + lo.
+    """
+    from jax.experimental import pallas as pl
+
+    B, S = items.shape
+    if B % tile:
+        raise ValueError(f"B={B} not a multiple of tile={tile}")
+    if S % 128:
+        raise ValueError(f"S={S} not a multiple of 128")
+    x2 = x.reshape(B, 1).astype(jnp.int32)
+    r2 = r.reshape(B, 1).astype(jnp.int32)
+    t1 = jnp.asarray(_T1, jnp.bfloat16)
+    t2 = jnp.asarray(_T2, jnp.bfloat16)
+    out = pl.pallas_call(
+        _score_kernel,
+        grid=(B // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile, S), lambda i: (i, 0)),
+            pl.BlockSpec(_T1.shape, lambda i: (0, 0)),
+            pl.BlockSpec(_T2.shape, lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile, S), lambda i: (i, 0)),
+            pl.BlockSpec((tile, S), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, S), jnp.int32),
+            jax.ShapeDtypeStruct((B, S), jnp.int32),
+        ],
+        interpret=interpret,
+    )(x2, r2, items.astype(jnp.int32), t1, t2)
+    return out

@@ -707,9 +707,16 @@ class OSD(Dispatcher):
                         # so covers() stays honest about what we can vouch
                         # for entry-by-entry
                         self._log_seal_txn(t, cid, pg, msg.version)
-                    else:
+                    elif msg.version == pg.version + 1:
                         entry = LogEntry.from_list(msg.entry[:3])
                         self._log_txn(t, cid, pg, entry)
+                    # else: the entry JUMPS our version (we missed writes —
+                    # e.g. a sub-write lost while the primary acked at
+                    # min_size).  Apply the data but refuse the log append:
+                    # advancing head across a hole would make this shard
+                    # report itself clean at a version whose intermediate
+                    # objects it does not hold.  Our stale version makes
+                    # the primary's next recovery tick replay the gap.
                 self.store.queue_transaction(t)
         except Exception as e:
             self.cct.dout("osd", 0, f"{self.whoami} sub_write failed: {e!r}")

@@ -277,3 +277,138 @@ class TestBehavior:
         w = np.zeros(4, dtype=np.uint32)
         got = np.asarray(crush_do_rule_batch(cm, 0, np.arange(10), 2, w))
         assert (got == ITEM_NONE).all()
+
+
+class TestComputedLn:
+    def test_limb_crush_ln_exhaustive(self):
+        """The small-table limb formulation (TPU path: no 2^16 gather) must
+        equal the generated table for every possible straw2 input."""
+        from ceph_tpu.crush.ln_compute import crush_ln_jnp
+
+        u = np.arange(0x10000, dtype=np.int32)
+        hi, lo = crush_ln_jnp(u)
+        got = (np.asarray(hi).astype(np.int64) << 24) | np.asarray(lo).astype(
+            np.int64
+        )
+        np.testing.assert_array_equal(got, np.asarray(CRUSH_LN_TABLE))
+
+
+class TestMultiChoose:
+    """Multi-step rule chains (TAKE -> CHOOSE -> CHOOSE -> EMIT) — batch
+    mapper vs the scalar interpreter (reference: crush_do_rule's working-
+    vector loop; production rack/host rules)."""
+
+    @staticmethod
+    def _rule(steps):
+        from ceph_tpu.crush.types import Rule, RuleStep
+
+        return Rule(rule_id=9, type=1, steps=[RuleStep(*s) for s in steps])
+
+    def _check_vs_scalar(self, cmap, rule_id, nrep, weights, xs):
+        cm = CompiledCrushMap(cmap)
+        got = np.asarray(crush_do_rule_batch(cm, rule_id, xs, nrep, weights))
+        for i, x in enumerate(xs):
+            exp = crush_do_rule(cmap, rule_id, int(x), nrep, list(weights))
+            exp = (exp + [ITEM_NONE] * nrep)[:nrep]
+            assert list(got[i]) == exp, f"x={x}: {list(got[i])} != {exp}"
+
+    def test_rack_then_chooseleaf_host_firstn(self):
+        from ceph_tpu.crush.types import RuleOp
+
+        cmap = build_hierarchical_map(12, 2, racks=3)
+        cmap.rules[9] = self._rule([
+            (RuleOp.TAKE, -1, 0),
+            (RuleOp.CHOOSE_FIRSTN, 0, 2),       # numrep racks
+            (RuleOp.CHOOSELEAF_FIRSTN, 1, 1),   # 1 host-leaf per rack
+            (RuleOp.EMIT, 0, 0),
+        ])
+        w = np.full(24, 0x10000, dtype=np.uint32)
+        w[5] = 0x8000
+        w[11] = 0
+        self._check_vs_scalar(cmap, 9, 3, w, np.arange(200))
+
+    def test_choose_host_then_choose_osd_firstn(self):
+        from ceph_tpu.crush.types import RuleOp
+
+        cmap = build_hierarchical_map(6, 3)
+        cmap.rules[9] = self._rule([
+            (RuleOp.TAKE, -1, 0),
+            (RuleOp.CHOOSE_FIRSTN, 0, 1),   # numrep hosts
+            (RuleOp.CHOOSE_FIRSTN, 1, 0),   # 1 osd per host
+            (RuleOp.EMIT, 0, 0),
+        ])
+        w = np.full(18, 0x10000, dtype=np.uint32)
+        w[4] = 0
+        self._check_vs_scalar(cmap, 9, 4, w, np.arange(200))
+
+    def test_rack_then_chooseleaf_host_indep(self):
+        from ceph_tpu.crush.types import RuleOp
+
+        cmap = build_hierarchical_map(12, 2, racks=4)
+        cmap.rules[9] = self._rule([
+            (RuleOp.TAKE, -1, 0),
+            (RuleOp.CHOOSE_INDEP, 0, 2),       # numrep racks, positional
+            (RuleOp.CHOOSELEAF_INDEP, 1, 1),   # 1 host-leaf per rack
+            (RuleOp.EMIT, 0, 0),
+        ])
+        w = np.full(24, 0x10000, dtype=np.uint32)
+        w[3] = 0x4000
+        self._check_vs_scalar(cmap, 9, 4, w, np.arange(200))
+
+    def test_two_take_emit_blocks(self):
+        """TAKE a / CHOOSE / EMIT / TAKE b / CHOOSE / EMIT concatenates
+        (the reference's multi-root rule shape)."""
+        from ceph_tpu.crush.builder import make_straw2_bucket
+        from ceph_tpu.crush.types import CrushMap, RuleOp
+
+        cmap = CrushMap()
+        cmap.type_names.update({1: "root"})
+        make_straw2_bucket(cmap, 1, [0, 1, 2], [0x10000] * 3, bucket_id=-1)
+        make_straw2_bucket(cmap, 1, [3, 4, 5], [0x10000] * 3, bucket_id=-2)
+        cmap.max_devices = 6
+        cmap.rules[9] = self._rule([
+            (RuleOp.TAKE, -1, 0),
+            (RuleOp.CHOOSE_FIRSTN, 1, 0),
+            (RuleOp.EMIT, 0, 0),
+            (RuleOp.TAKE, -2, 0),
+            (RuleOp.CHOOSE_FIRSTN, 1, 0),
+            (RuleOp.EMIT, 0, 0),
+        ])
+        w = np.full(6, 0x10000, dtype=np.uint32)
+        self._check_vs_scalar(cmap, 9, 2, w, np.arange(100))
+
+    def test_negative_choose_arg(self):
+        """CHOOSE with arg1 < 0 means numrep + arg1 (mapper.c)."""
+        from ceph_tpu.crush.types import RuleOp
+
+        cmap = build_hierarchical_map(8, 2)
+        cmap.rules[9] = self._rule([
+            (RuleOp.TAKE, -1, 0),
+            (RuleOp.CHOOSELEAF_FIRSTN, -1, 1),  # numrep - 1 host leaves
+            (RuleOp.EMIT, 0, 0),
+        ])
+        w = np.full(16, 0x10000, dtype=np.uint32)
+        self._check_vs_scalar(cmap, 9, 4, w, np.arange(150))
+
+    def test_pallas_score_path_matches_gather(self):
+        """The fused Pallas hash+ln scorer (interpret mode on CPU) must
+        drive the batched mapper to identical placements as the table-
+        gather path."""
+        import functools
+
+        import ceph_tpu.crush.mapper as mapper_mod
+        from ceph_tpu.crush.batched import ln_scores_pallas
+
+        cmap = build_hierarchical_map(8, 3)
+        w = np.full(24, 0x10000, dtype=np.uint32)
+        w[3] = 0x9000
+        cm = CompiledCrushMap(cmap)
+        base = np.asarray(crush_do_rule_batch(cm, 0, np.arange(128), 3, w))
+        cm2 = CompiledCrushMap(cmap)
+        orig = mapper_mod.default_score_fn
+        mapper_mod.default_score_fn = lambda: ln_scores_pallas
+        try:
+            got = np.asarray(crush_do_rule_batch(cm2, 0, np.arange(128), 3, w))
+        finally:
+            mapper_mod.default_score_fn = orig
+        np.testing.assert_array_equal(got, base)
