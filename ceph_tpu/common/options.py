@@ -91,6 +91,26 @@ def default_options() -> OptionTable:
                    min=0.05),
             Option("mon_max_pg_per_osd", int, 250,
                    "pg-count sanity limit at pool create", min=1),
+            # -- mgr (reference: mgr.yaml.in) ------------------------------
+            Option("mgr_addr", str, "",
+                   "host:port daemons send MMgrReport to ('' disables)",
+                   runtime=True),
+            Option("mgr_report_interval", float, 2.0,
+                   "seconds between daemon perf reports to the mgr",
+                   min=0.1, runtime=True),
+            Option("mgr_tick_interval", float, 2.0, "mgr tick seconds",
+                   min=0.05),
+            Option("mgr_modules", str, "status,prometheus,balancer",
+                   "comma-separated modules the mgr hosts"),
+            Option("mgr_prometheus_port", int, 0,
+                   "prometheus exporter port (0 = ephemeral)", min=0),
+            Option("mgr_balancer_interval", float, 10.0,
+                   "seconds between balancer passes", min=0.1, runtime=True),
+            Option("mgr_balancer_active", bool, True,
+                   "balancer applies upmaps (false = dry-run)",
+                   runtime=True),
+            Option("mgr_stale_report_age", float, 30.0,
+                   "drop daemon reports older than this", min=1.0),
             # -- objectstore (reference: bluestore options) ----------------
             Option("objectstore", str, "memstore", "backend for new OSDs",
                    enum=("memstore", "filestore")),

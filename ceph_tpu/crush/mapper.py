@@ -302,12 +302,20 @@ def _build_rule_fn(cm: CompiledCrushMap, rule_id: int, numrep: int,
 
 
 def default_score_fn():
-    """Pick the straw2 ln path for the active backend: the fused Pallas
-    hash+ln kernel on TPU (no hardware vector gather — the 2^16-entry
-    table gather serializes there), the XLA table gather on CPU."""
-    # 'axon' is this machine's tunneled TPU platform name; anything else
-    # (cpu, gpu) has fast hardware gathers and no Mosaic, so the table
-    # gather is both correct and faster there
+    """Pick the straw2 ln path: the fused Pallas hash+ln kernel on TPU (no
+    hardware vector gather — the 2^16-entry table gather serializes
+    there), the XLA table gather elsewhere.
+
+    CEPH_TPU_CRUSH_SCORE overrides: "pallas" / "gather" force a path (for
+    platforms whose TPU alias isn't recognized, or benchmarking); default
+    "auto" detects by backend name ('axon' is a tunneled-TPU alias)."""
+    import os
+
+    mode = os.environ.get("CEPH_TPU_CRUSH_SCORE", "auto")
+    if mode == "pallas":
+        return ln_scores_pallas
+    if mode == "gather":
+        return ln_scores_jnp
     if jax.default_backend() in ("tpu", "axon"):
         return ln_scores_pallas
     return ln_scores_jnp
@@ -347,10 +355,17 @@ def crush_do_rule_batch(
         # chunk by LANES (N x max step width), not raw N: a multi-choose
         # step fans each x out to its working-vector width
         chunk_n = max(1, _BATCH_CHUNK // max_width)
+
+        def padded_width(n: int) -> int:
+            # next power of two, capped at chunk_n: bounds compiled-shape
+            # count to log2(chunk_n) while never exceeding the lane budget
+            # (an uncapped pow2 pad of a non-pow2 chunk_n could launch ~2x
+            # _BATCH_CHUNK lanes — the empirical v5e fault boundary)
+            p = max(1, 1 << (max(n, 1) - 1).bit_length())
+            return chunk_n if p > chunk_n else p
+
         if N <= chunk_n:
-            # pad to the next power of two: bounds the number of distinct
-            # compiled shapes to log2(_BATCH_CHUNK) across all callers
-            Np = max(1, 1 << (max(N, 1) - 1).bit_length())
+            Np = padded_width(N)
             out = vf(jnp.asarray(np.resize(xs_np, Np)), weightvec)
             return out[:N] if Np != N else out
         # Large batches run as fixed-size device calls: one launch over
@@ -362,13 +377,9 @@ def crush_do_rule_batch(
         pieces = []
         for lo in range(0, N, chunk_n):
             part = xs_np[lo : lo + chunk_n]
-            # ragged tail: pad to its own next power of two (a shape the
-            # small-batch path compiles anyway), not to a full chunk
-            width = (
-                chunk_n
-                if len(part) == chunk_n
-                else 1 << (len(part) - 1).bit_length()
-            )
+            # ragged tail: pad to its own (capped) power of two — a shape
+            # the small-batch path compiles anyway — not to a full chunk
+            width = padded_width(len(part))
             padded = np.resize(part, width)
             pieces.append(
                 np.asarray(vf(jnp.asarray(padded), weightvec))[: len(part)]

@@ -1,0 +1,62 @@
+"""Balancer module — periodic upmap optimization (reference:
+src/pybind/mgr/balancer/module.py upmap mode: propose OSDMap::calc_pg_upmaps
+fills against the current map, commit via mon commands).
+
+The placement math itself is the batched-CRUSH library routine
+(ceph_tpu/osd/balancer.py :: calc_pg_upmaps — one device launch per pass);
+this module is the daemon loop driving it against the LIVE map."""
+from __future__ import annotations
+
+from ..osd.balancer import calc_pg_upmaps
+from .module import MgrModule, register_module
+
+
+@register_module
+class BalancerModule(MgrModule):
+    NAME = "balancer"
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self.last_result: list = []
+        self.passes = 0
+
+    def optimize_once(self) -> list[tuple[int, int, int, int]]:
+        """One balance pass: propose on a scratch copy of the live map,
+        commit each change as `osd pg-upmap-items` (the reference commits
+        an inc map the same way)."""
+        m = self.get("osd_map")
+        if m is None or not m.pools:
+            return []
+        import copy
+
+        scratch = copy.deepcopy(m)
+        changes = calc_pg_upmaps(scratch)
+        active = self.cct.conf.get("mgr_balancer_active")
+        if active:
+            committed = set()
+            for pool_id, ps, _from, _to in changes:
+                if (pool_id, ps) in committed:
+                    continue  # one command carries the pg's full pair list
+                committed.add((pool_id, ps))
+                pairs = scratch.pg_upmap_items.get((pool_id, ps), [])
+                rv, res = self.mon_command({
+                    "prefix": "osd pg-upmap-items",
+                    "pool": pool_id,
+                    "ps": ps,
+                    "mappings": [list(p) for p in pairs],
+                })
+                if rv != 0:
+                    self.cct.dout(
+                        "mgr", 1, f"balancer: upmap commit failed: {res}"
+                    )
+        self.last_result = changes
+        self.passes += 1
+        return changes
+
+    def serve(self) -> None:
+        interval = self.cct.conf.get("mgr_balancer_interval")
+        while not self._stop.wait(interval):
+            try:
+                self.optimize_once()
+            except Exception as e:
+                self.cct.dout("mgr", 1, f"balancer pass failed: {e!r}")

@@ -1,0 +1,115 @@
+"""MgrDaemon — module host + daemon report sink (reference: src/mgr/Mgr.cc
+/ DaemonServer.cc: daemons stream MMgrReport, modules consume the state;
+SURVEY.md §2.5).
+
+    mgr = MgrDaemon(cct, mon_addrs)
+    mgr.start()                  # hosts cct.conf 'mgr_modules'
+    mgr.module('prometheus').url # scrape target
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..mon.mon_client import MonClient
+from ..msg import Dispatcher, Messenger
+from .messages import MMgrReport
+from .module import MODULE_REGISTRY, MgrModule
+
+# imports register the in-tree modules
+from . import balancer_module  # noqa: F401
+from . import prometheus_module  # noqa: F401
+from . import status_module  # noqa: F401
+
+
+class MgrDaemon(Dispatcher):
+    def __init__(self, cct, mon_addrs):
+        self.cct = cct
+        self.messenger = Messenger.create(cct, "mgr")
+        self.messenger.add_dispatcher(self)
+        self.mc = MonClient(cct, mon_addrs, name="mgr-monc")
+        self._reports: dict[str, dict] = {}   # daemon -> last MMgrReport view
+        self._reports_lock = threading.Lock()
+        self._modules: dict[str, MgrModule] = {}
+        self._threads: list[threading.Thread] = []
+        self.addr: tuple[str, int] | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.addr = self.messenger.bind(("127.0.0.1", 0))
+        self.messenger.start()
+        self.mc.subscribe_osdmap()
+        self.mc.wait_for_osdmap(timeout=30.0)
+        wanted = [
+            m.strip()
+            for m in str(self.cct.conf.get("mgr_modules")).split(",")
+            if m.strip()
+        ]
+        for name in wanted:
+            cls = MODULE_REGISTRY.get(name)
+            if cls is None:
+                self.cct.dout("mgr", 0, f"mgr: unknown module {name!r}")
+                continue
+            mod = cls(self)
+            self._modules[name] = mod
+            t = threading.Thread(
+                target=self._serve_module, args=(mod,),
+                name=f"mgr-{name}", daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+
+    def _serve_module(self, mod: MgrModule) -> None:
+        try:
+            mod.serve()
+        except Exception as e:
+            self.cct.dout("mgr", 0, f"mgr module {mod.NAME} died: {e!r}")
+
+    def shutdown(self) -> None:
+        for mod in self._modules.values():
+            try:
+                mod.shutdown()
+            except Exception:
+                pass
+        self.mc.shutdown()
+        self.messenger.shutdown()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def module(self, name: str) -> MgrModule:
+        return self._modules[name]
+
+    # -- report sink -------------------------------------------------------
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MMgrReport):
+            with self._reports_lock:
+                self._reports[msg.daemon] = {
+                    "counters": msg.counters or {},
+                    "stats": msg.stats or {},
+                    "epoch": msg.epoch,
+                    "ts": time.monotonic(),
+                }
+            return True
+        return False
+
+    def latest_reports(self) -> dict:
+        """{daemon: {subsystem: {counter: value}}}, stale reports dropped
+        (a dead OSD's last snapshot must not linger on the dashboard)."""
+        max_age = self.cct.conf.get("mgr_stale_report_age")
+        now = time.monotonic()
+        with self._reports_lock:
+            return {
+                d: r["counters"]
+                for d, r in self._reports.items()
+                if now - r["ts"] <= max_age
+            }
+
+    def latest_stats(self) -> dict:
+        max_age = self.cct.conf.get("mgr_stale_report_age")
+        now = time.monotonic()
+        with self._reports_lock:
+            return {
+                d: r["stats"]
+                for d, r in self._reports.items()
+                if now - r["ts"] <= max_age
+            }

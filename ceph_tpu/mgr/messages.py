@@ -1,0 +1,20 @@
+"""Mgr wire messages (reference: src/messages/MMgrReport.h — daemons
+stream perf-counter snapshots to the active mgr; MMgrOpen's session
+handshake collapses into the report itself here)."""
+from __future__ import annotations
+
+from ..mon.messages import _JsonMessage
+from ..msg.message import register_message
+
+
+@register_message
+class MMgrReport(_JsonMessage):
+    """Daemon -> mgr perf snapshot.
+
+    daemon: entity name ("osd.3"); counters: {subsystem: {name: value}}
+    (the PerfCountersCollection dump); epoch: the daemon's map epoch so the
+    mgr can spot laggards; stats: free-form daemon stats (pg counts,
+    store bytes) for modules that want more than counters."""
+
+    MSG_TYPE = 120
+    FIELDS = ("daemon", "counters", "epoch", "stats")

@@ -1,0 +1,129 @@
+"""Prometheus exporter module (reference: src/pybind/mgr/prometheus/
+module.py — text exposition of cluster health + daemon perf counters).
+
+Serves GET /metrics on `mgr_prometheus_port` (0 = ephemeral; read
+`.url` after start).  Metric naming follows the reference's scheme:
+`ceph_osd_up`-style cluster gauges plus `ceph_daemon_...` counter series
+labelled by daemon."""
+from __future__ import annotations
+
+import http.server
+import threading
+
+from .module import MgrModule, register_module
+
+
+def render_metrics(osdmap, reports: dict) -> str:
+    """Text exposition (the pure part, unit-testable without sockets)."""
+    lines: list[str] = []
+
+    def metric(name, doc, typ, samples):
+        lines.append(f"# HELP {name} {doc}")
+        lines.append(f"# TYPE {name} {typ}")
+        for labels, value in samples:
+            lab = (
+                "{" + ",".join(f'{k}="{v}"' for k, v in labels.items()) + "}"
+                if labels
+                else ""
+            )
+            lines.append(f"{name}{lab} {value}")
+
+    if osdmap is not None:
+        metric(
+            "ceph_osd_up", "OSD up state", "gauge",
+            [
+                ({"ceph_daemon": f"osd.{o}"}, int(osdmap.is_up(o)))
+                for o in range(osdmap.max_osd)
+                if osdmap.exists(o)
+            ],
+        )
+        metric(
+            "ceph_osd_in", "OSD in state", "gauge",
+            [
+                ({"ceph_daemon": f"osd.{o}"}, int(osdmap.is_in(o)))
+                for o in range(osdmap.max_osd)
+                if osdmap.exists(o)
+            ],
+        )
+        metric(
+            "ceph_osdmap_epoch", "OSDMap epoch", "gauge",
+            [({}, osdmap.epoch)],
+        )
+        metric(
+            "ceph_pool_pg_num", "PGs per pool", "gauge",
+            [
+                ({"pool": p.name}, p.pg_num)
+                for p in osdmap.pools.values()
+            ],
+        )
+    # per-daemon perf counters: flatten subsystem dumps into one series
+    # per counter, labelled by daemon (the reference's ceph_daemon label)
+    series: dict[str, list] = {}
+    for daemon, subsystems in sorted(reports.items()):
+        for subsys, counters in sorted((subsystems or {}).items()):
+            for cname, value in sorted(counters.items()):
+                if isinstance(value, dict):  # longrunavg {avgcount, sum}
+                    for part, v in value.items():
+                        key = f"ceph_{subsys}_{cname}_{part}"
+                        series.setdefault(key, []).append(
+                            ({"ceph_daemon": daemon}, v)
+                        )
+                else:
+                    key = f"ceph_{subsys}_{cname}"
+                    series.setdefault(key, []).append(
+                        ({"ceph_daemon": daemon}, value)
+                    )
+    for key, samples in sorted(series.items()):
+        metric(key, f"perf counter {key}", "counter", samples)
+    return "\n".join(lines) + "\n"
+
+
+@register_module
+class PrometheusModule(MgrModule):
+    NAME = "prometheus"
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._server: http.server.ThreadingHTTPServer | None = None
+        self.url: str | None = None
+
+    def serve(self) -> None:
+        module = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = render_metrics(
+                        module.get("osd_map"),
+                        module.get_all_perf_counters(),
+                    ).encode()
+                except Exception as e:  # scrape must not kill the server
+                    self.send_error(500, str(e))
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        port = int(self.cct.conf.get("mgr_prometheus_port"))
+        self._server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), Handler
+        )
+        self.url = f"http://127.0.0.1:{self._server.server_address[1]}/metrics"
+        t = threading.Thread(
+            target=self._server.serve_forever, name="mgr-prometheus-http",
+            daemon=True,
+        )
+        t.start()
+        self._stop.wait()
+        self._server.shutdown()
+        self._server.server_close()

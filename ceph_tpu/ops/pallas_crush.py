@@ -51,7 +51,7 @@ _T2 = TBL2_BYTES  # [256, 8]
 DEFAULT_TILE = 64  # rows per grid step ([T, S] tile; S padded to 128)
 
 
-def _onehot_lookup(idx, tbl_bf16, ncols: int):
+def _onehot_lookup(idx, tbl_bf16):
     """[T, S] int32 indices -> [T, S, ncols] f32 byte-limb rows via a bf16
     one-hot matmul (exact: one-hot rows select a single 0..255 value, and
     bf16 represents those exactly).  The 3D one-hot + last-dim contraction
@@ -82,7 +82,7 @@ def _score_kernel(x_ref, r_ref, items_ref, t1_ref, t2_ref, hi_ref, lo_ref):
     t2 = t2_ref[:]
 
     def look1(i):
-        rows = _onehot_lookup(i, t1, 16)
+        rows = _onehot_lookup(i, t1)
         return (
             recombine_limbs(rows, 0, 3, jnp),    # r2
             recombine_limbs(rows, 3, 2, jnp),    # r1
@@ -92,7 +92,7 @@ def _score_kernel(x_ref, r_ref, items_ref, t1_ref, t2_ref, hi_ref, lo_ref):
         )
 
     def look2(i):
-        rows = _onehot_lookup(i, t2, 8)
+        rows = _onehot_lookup(i, t2)
         return (
             recombine_limbs(rows, 0, 4, jnp),    # ll_hi
             recombine_limbs(rows, 4, 3, jnp),    # ll_lo

@@ -30,6 +30,7 @@ import threading
 import time
 
 from ..common.crc32c import crc32c
+from ..common.perf_counters import PerfCountersBuilder
 from ..ec.registry import ErasureCodePluginRegistry
 from ..mon.mon_client import MonClient
 from ..msg import Dispatcher, Messenger
@@ -93,6 +94,20 @@ class OSD(Dispatcher):
         self._hb_failures: dict[int, int] = {}
         self._codecs: dict[str, object] = {}
         self._recovery_wakeup = threading.Event()
+        # reference: OSD::create_logger (l_osd_op / l_osd_op_w / ...)
+        self.logger = cct.perf.add(
+            PerfCountersBuilder("osd")
+            .add_u64_counter("op", "client operations")
+            .add_u64_counter("op_w", "client writes")
+            .add_u64_counter("op_r", "client reads")
+            .add_u64_counter("op_w_bytes", "bytes written")
+            .add_u64_counter("op_r_bytes", "bytes read")
+            .add_time_avg("op_latency", "op latency")
+            .add_u64_counter("recovery_ops", "objects pushed in recovery")
+            .add_u64_counter("subop_w", "shard sub-writes applied")
+            .add_u64("numpg", "placement groups hosted")
+            .create_perf_counters()
+        )
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -293,6 +308,13 @@ class OSD(Dispatcher):
 
     # -- client ops (primary) ---------------------------------------------
     def _handle_client_op(self, conn, msg: MOSDOp) -> None:
+        t0 = time.perf_counter()
+        self.logger.inc("op")
+        if msg.op == "write_full":
+            self.logger.inc("op_w")
+            self.logger.inc("op_w_bytes", len(msg.data or "") * 3 // 4)
+        elif msg.op == "read":
+            self.logger.inc("op_r")
         try:
             reply = self._execute_client_op(msg)
         except Exception as e:  # never leave the client hanging
@@ -301,6 +323,9 @@ class OSD(Dispatcher):
                 tid=msg.tid, retval=-5, epoch=self.my_epoch(),
                 result=f"internal error: {e}",
             )
+        if msg.op == "read" and reply.retval == 0 and reply.data:
+            self.logger.inc("op_r_bytes", len(reply.data) * 3 // 4)
+        self.logger.tinc("op_latency", time.perf_counter() - t0)
         try:
             conn.send_message(reply)
         except (OSError, ConnectionError):
@@ -721,6 +746,8 @@ class OSD(Dispatcher):
         except Exception as e:
             self.cct.dout("osd", 0, f"{self.whoami} sub_write failed: {e!r}")
             retval = -5
+        else:
+            self.logger.inc("subop_w")
         try:
             conn.send_message(
                 MECSubOpWriteReply(tid=msg.tid, pgid=msg.pgid,
@@ -785,6 +812,7 @@ class OSD(Dispatcher):
     def _tick_loop(self) -> None:
         interval = 1.0
         last_hb = 0.0
+        last_mgr = 0.0
         while not self._stop.is_set():
             self._recovery_wakeup.wait(timeout=interval)
             self._recovery_wakeup.clear()
@@ -795,9 +823,48 @@ class OSD(Dispatcher):
                 if now - last_hb >= 2.0:
                     last_hb = now
                     self._heartbeat()
+                if now - last_mgr >= self.cct.conf.get("mgr_report_interval"):
+                    last_mgr = now
+                    self._mgr_report()
                 self._recover_all()
             except Exception as e:
                 self.cct.dout("osd", 0, f"{self.whoami} tick failed: {e!r}")
+
+    def _mgr_report(self) -> None:
+        """Stream a perf snapshot to the mgr (reference: MgrClient sending
+        MMgrReport on its tick)."""
+        addr = self.cct.conf.get("mgr_addr")
+        if not addr:
+            return
+        from ..mgr.messages import MMgrReport
+
+        host, _, port = addr.rpartition(":")
+        with self._pgs_lock:
+            num_pgs = len(self.pgs)
+        # the store scan runs UNLOCKED: heartbeats/recovery/map-apply all
+        # contend on _pgs_lock, and an O(objects) walk per report tick
+        # must not delay them toward the failure-report threshold
+        num_objects = 0
+        for cid in self.store.list_collections():
+            try:
+                num_objects += sum(
+                    1 for o in self.store.list_objects(cid)
+                    if not o.startswith("_")
+                )
+            except Exception:
+                pass
+        self.logger.set("numpg", num_pgs)
+        try:
+            self.messenger.connect((host, int(port))).send_message(
+                MMgrReport(
+                    daemon=self.whoami,
+                    counters=self.cct.perf.dump(),
+                    epoch=self.my_epoch(),
+                    stats={"num_pgs": num_pgs, "num_objects": num_objects},
+                )
+            )
+        except (OSError, ConnectionError, ValueError):
+            pass  # mgr down: retry next interval
 
     def _heartbeat(self) -> None:
         """Ping peers sharing PGs with us (reference: OSD::heartbeat);
@@ -959,6 +1026,7 @@ class OSD(Dispatcher):
                     pg, osd, shard, e.oid, chunk, e.version,
                     e.to_list() + [size],
                 )
+                self.logger.inc("recovery_ops")
             else:
                 # superseded modify / clean marker: log-entry-only replay
                 ok = self._push_sub_write(

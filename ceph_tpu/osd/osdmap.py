@@ -167,6 +167,10 @@ class OSDMap:
     def exists(self, osd: int) -> bool:
         return 0 <= osd < self.max_osd and bool(self.osd_state[osd] & OSD_EXISTS)
 
+    def is_in(self, osd: int) -> bool:
+        """reference: OSDMap::is_in — nonzero reweight."""
+        return self.exists(osd) and self.osd_weight[osd] != 0
+
     def mark_down(self, osd: int) -> None:
         """reference: OSDMonitor failure handling — down keeps CRUSH weight;
         the PG maps elsewhere only once the OSD is also marked out."""

@@ -43,13 +43,16 @@ class LocalCluster:
         n_osds: int = 6,
         hosts: int | None = None,
         conf_overrides: dict | None = None,
+        with_mgr: bool = False,
     ):
         self.n_mons = n_mons
         self.n_osds = n_osds
         self.hosts = hosts or n_osds  # default: one OSD per host bucket
         self.conf_overrides = dict(conf_overrides or {})
+        self.with_mgr = with_mgr
         self.mons: dict[str, Monitor] = {}
         self.osds: dict[int, OSD] = {}
+        self.mgr = None
         self.mon_addrs: list = []
         self._clients: list[Rados] = []
 
@@ -75,6 +78,15 @@ class LocalCluster:
             time.sleep(0.05)
         if not any(m.is_leader() for m in self.mons.values()):
             raise TimeoutError("no mon leader")
+        if self.with_mgr:
+            from ..mgr import MgrDaemon
+
+            self.mgr = MgrDaemon(self._cct("mgr"), self.mon_addrs)
+            self.mgr.start()
+            # daemons stream MMgrReport here (MgrMap-analog wiring)
+            self.conf_overrides["mgr_addr"] = (
+                f"{self.mgr.addr[0]}:{self.mgr.addr[1]}"
+            )
         for i in range(self.n_osds):
             self._start_osd(i)
         # all OSDs booted: wait until every address is registered
@@ -113,6 +125,11 @@ class LocalCluster:
         for osd in list(self.osds.values()):
             try:
                 osd.shutdown()
+            except Exception:
+                pass
+        if self.mgr is not None:
+            try:
+                self.mgr.shutdown()
             except Exception:
                 pass
         for mon in self.mons.values():
