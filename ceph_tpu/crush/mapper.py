@@ -316,6 +316,12 @@ def default_score_fn():
         return ln_scores_pallas
     if mode == "gather":
         return ln_scores_jnp
+    if mode != "auto":
+        # a typo'd override silently auto-detecting would defeat its
+        # purpose (forcing Pallas on unrecognized TPU aliases)
+        raise ValueError(
+            f"CEPH_TPU_CRUSH_SCORE={mode!r}: want auto|pallas|gather"
+        )
     if jax.default_backend() in ("tpu", "axon"):
         return ln_scores_pallas
     return ln_scores_jnp

@@ -17,12 +17,22 @@ def render_metrics(osdmap, reports: dict) -> str:
     """Text exposition (the pure part, unit-testable without sockets)."""
     lines: list[str] = []
 
+    def esc(v) -> str:
+        # exposition-format label escaping: one bad pool name must not
+        # poison the whole scrape
+        return (
+            str(v)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+
     def metric(name, doc, typ, samples):
         lines.append(f"# HELP {name} {doc}")
         lines.append(f"# TYPE {name} {typ}")
         for labels, value in samples:
             lab = (
-                "{" + ",".join(f'{k}="{v}"' for k, v in labels.items()) + "}"
+                "{" + ",".join(f'{k}="{esc(v)}"' for k, v in labels.items()) + "}"
                 if labels
                 else ""
             )
@@ -84,10 +94,16 @@ class PrometheusModule(MgrModule):
 
     def __init__(self, mgr):
         super().__init__(mgr)
-        self._server: http.server.ThreadingHTTPServer | None = None
-        self.url: str | None = None
+        # bind SYNCHRONOUSLY (module construction happens inside
+        # MgrDaemon.start) so `mgr.start(); module('prometheus').url`
+        # never races the serve thread
+        port = int(self.cct.conf.get("mgr_prometheus_port"))
+        self._server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), self._handler_class()
+        )
+        self.url = f"http://127.0.0.1:{self._server.server_address[1]}/metrics"
 
-    def serve(self) -> None:
+    def _handler_class(self):
         module = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -114,11 +130,9 @@ class PrometheusModule(MgrModule):
             def log_message(self, *a):  # quiet
                 pass
 
-        port = int(self.cct.conf.get("mgr_prometheus_port"))
-        self._server = http.server.ThreadingHTTPServer(
-            ("127.0.0.1", port), Handler
-        )
-        self.url = f"http://127.0.0.1:{self._server.server_address[1]}/metrics"
+        return Handler
+
+    def serve(self) -> None:
         t = threading.Thread(
             target=self._server.serve_forever, name="mgr-prometheus-http",
             daemon=True,

@@ -182,7 +182,48 @@ class OSDMonitor:
                 if self._propose_map(m) else (-110, "proposal timed out")
         if prefix == "osd pg-upmap-items":
             return self._cmd_upmap_items(cmd)
+        if prefix == "osd tree":
+            return 0, self._cmd_tree()
         return -22, f"unknown command {prefix!r}"
+
+    def _cmd_tree(self) -> list[dict]:
+        """reference: `ceph osd tree` (OSDMonitor dumping the CRUSH
+        hierarchy annotated with up/in state)."""
+        m = self.osdmap
+        if m is None:
+            return []
+        w = m.crush
+        rows: list[dict] = []
+
+        def walk(item: int, depth: int) -> None:
+            if item >= 0:
+                rows.append({
+                    "id": item,
+                    "name": f"osd.{item}",
+                    "type": "osd",
+                    "depth": depth,
+                    "reweight": m.osd_weight[item] / 0x10000
+                    if item < m.max_osd else 0.0,
+                    "status": "up" if m.is_up(item) else "down",
+                })
+                return
+            b = w.map.buckets[item]
+            rows.append({
+                "id": item,
+                "name": w.name_of(item),
+                "type": w.type_name(b.type),
+                "depth": depth,
+                "weight": b.weight / 0x10000,
+            })
+            for child in b.items:
+                walk(child, depth + 1)
+
+        roots = set(w.map.buckets) - {
+            c for b in w.map.buckets.values() for c in b.items if c < 0
+        }
+        for root in sorted(roots, reverse=True):
+            walk(root, 0)
+        return rows
 
     def _stat(self) -> dict:
         m = self.osdmap

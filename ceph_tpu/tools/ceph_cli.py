@@ -1,0 +1,120 @@
+"""`ceph` CLI analog — mon command passthrough with friendly rendering.
+
+Reference: src/tools/ceph.in / src/ceph.in (the ceph CLI sends structured
+commands to the mon and renders the reply; SURVEY.md §2.8).
+
+    python -m ceph_tpu.tools.ceph_cli -m 127.0.0.1:6789 status
+    python -m ceph_tpu.tools.ceph_cli -m ... osd tree
+    python -m ceph_tpu.tools.ceph_cli -m ... osd pool create mypool 32
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..common.context import CephContext
+from ..mon.mon_client import MonClient
+from .rados import _parse_mons
+
+
+def _render_status(res: dict, out) -> None:
+    health = res.get("health", {})
+    print(f"  health: {health.get('status')}", file=out)
+    for name, chk in (health.get("checks") or {}).items():
+        print(f"          {name}: {chk.get('message')}", file=out)
+    print(f"  quorum: {res.get('quorum')}  leader: {res.get('leader')}",
+          file=out)
+    osd = res.get("osdmap", {})
+    print(
+        f"  osd: {osd.get('num_osds', 0)} osds: "
+        f"{osd.get('num_up_osds', 0)} up, {osd.get('num_in_osds', 0)} in  "
+        f"(epoch {osd.get('epoch', 0)})",
+        file=out,
+    )
+
+
+def _render_tree(rows: list, out) -> None:
+    print(f"{'ID':>5} {'WEIGHT':>8}  {'TYPE NAME':<30} STATUS REWEIGHT",
+          file=out)
+    for r in rows:
+        pad = "    " * r.get("depth", 0)
+        if r.get("type") == "osd":
+            print(
+                f"{r['id']:>5} {'':>8}  {pad + r['name']:<30} "
+                f"{r.get('status', ''):<6} {r.get('reweight', 1.0):.5f}",
+                file=out,
+            )
+        else:
+            print(
+                f"{r['id']:>5} {r.get('weight', 0):>8.4f}  "
+                f"{pad + r['type'] + ' ' + r['name']:<30}",
+                file=out,
+            )
+
+
+# CLI word-forms -> structured mon command builders (the reference ships a
+# JSON command table; this is the subset the monitors implement)
+def _build_command(words: list[str]) -> dict:
+    joined = " ".join(words)
+    for fixed in (
+        "status", "health", "mon stat", "osd dump", "osd stat",
+        "osd tree", "osd pool ls", "osd erasure-code-profile ls",
+    ):
+        if joined == fixed:
+            return {"prefix": fixed}
+    if words[:3] == ["osd", "pool", "create"]:
+        cmd = {"prefix": "osd pool create", "name": words[3]}
+        if len(words) > 4:
+            cmd["pg_num"] = int(words[4])
+        for extra in words[5:]:
+            k, _, v = extra.partition("=")
+            cmd[k] = v
+        return cmd
+    if words[:2] == ["osd", "down"] or words[:2] == ["osd", "out"] or \
+            words[:2] == ["osd", "in"]:
+        return {"prefix": f"osd {words[1]}", "id": int(words[2])}
+    if words[:2] == ["osd", "set"] or words[:2] == ["osd", "unset"]:
+        return {"prefix": f"osd {words[1]}", "key": words[2]}
+    if words[:2] == ["osd", "erasure-code-profile"] and words[2] == "get":
+        return {"prefix": "osd erasure-code-profile get", "name": words[3]}
+    raise ValueError(f"unknown command: {joined!r}")
+
+
+def main(argv=None, out=sys.stdout) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ceph", description="cluster admin commands"
+    )
+    ap.add_argument("-m", "--mon", required=True,
+                    help="mon address(es) host:port[,host:port]")
+    ap.add_argument("--format", choices=("plain", "json"), default="plain")
+    ap.add_argument("words", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if not args.words:
+        ap.error("no command")
+    try:
+        cmd = _build_command(args.words)
+    except (ValueError, IndexError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 22
+    mc = MonClient(CephContext("client.ceph-cli"), _parse_mons(args.mon))
+    try:
+        rv, res = mc.command(cmd, timeout=20.0)
+    finally:
+        mc.shutdown()
+    if rv != 0:
+        print(f"Error {rv}: {res}", file=sys.stderr)
+        return 1
+    if args.format == "json":
+        print(json.dumps(res, indent=2, default=str), file=out)
+    elif cmd["prefix"] in ("status", "health"):
+        _render_status(res, out)
+    elif cmd["prefix"] == "osd tree":
+        _render_tree(res, out)
+    else:
+        print(json.dumps(res, indent=2, default=str), file=out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

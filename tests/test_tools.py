@@ -6,6 +6,8 @@ of full map runs, SURVEY.md §4 ring 1): drive main(argv) and assert on the
 printed output and produced files.
 """
 import io
+
+import pytest
 import json
 
 from ceph_tpu.tools import crushtool, osdmaptool
@@ -159,3 +161,155 @@ class TestOsdmaptool:
             osdmaptool, [str(mapfn), "--upmap", str(cmds), "--pool", "1"]
         )
         assert rc == 0 and cmds.exists()
+
+
+class TestObjectstoreTool:
+    def _seed(self, tmp_path):
+        from ceph_tpu.store.kstore import KStore
+        from ceph_tpu.store.object_store import Transaction
+
+        store = KStore(str(tmp_path / "osd0"))
+        store.mount()
+        t = Transaction()
+        t.try_create_collection("1.0s0")
+        t.write("1.0s0", "alpha", 0, b"chunk-bytes")
+        t.setattr("1.0s0", "alpha", "size", b"11")
+        t.omap_setkeys("1.0s0", "alpha", {"k": b"v"})
+        t.try_create_collection("1.1s2")
+        t.write("1.1s2", "beta", 0, b"other")
+        store.queue_transaction(t)
+        store.umount()
+        return str(tmp_path / "osd0")
+
+    def test_list_info_fsck(self, tmp_path):
+        from ceph_tpu.tools import objectstore_tool
+
+        path = self._seed(tmp_path)
+        rc, out = run(objectstore_tool, ["--data-path", path, "--op", "list"])
+        assert rc == 0
+        rows = [json.loads(line) for line in out.splitlines()]
+        assert ["1.0s0", "alpha"] in rows and ["1.1s2", "beta"] in rows
+        rc, out = run(objectstore_tool, [
+            "--data-path", path, "--op", "info", "--pgid", "1.0s0", "alpha",
+        ])
+        assert rc == 0 and ('"size"' in out or '"stat"' in out)
+        rc, out = run(objectstore_tool, ["--data-path", path, "--op", "fsck"])
+        assert rc == 0 and "0 error(s)" in out
+
+    def test_export_import_roundtrip(self, tmp_path, monkeypatch):
+        import io as _io
+        import sys as _sys
+
+        from ceph_tpu.store.kstore import KStore
+        from ceph_tpu.tools import objectstore_tool
+
+        path = self._seed(tmp_path)
+        rc, doc = run(objectstore_tool, [
+            "--data-path", path, "--op", "export", "--pgid", "1.0s0",
+        ])
+        assert rc == 0
+        # import into a FRESH store (the move-a-pg-shard flow)
+        dest = str(tmp_path / "osd1")
+        KStore(dest).mount()  # create
+        monkeypatch.setattr(_sys, "stdin", _io.StringIO(doc))
+        rc, _ = run(objectstore_tool, ["--data-path", dest, "--op", "import"])
+        assert rc == 0
+        store = KStore(dest)
+        store.mount()
+        assert bytes(store.read("1.0s0", "alpha")) == b"chunk-bytes"
+        assert store.getattr("1.0s0", "alpha", "size") == b"11"
+        assert store.omap_get("1.0s0", "alpha") == {"k": b"v"}
+        store.umount()
+
+    def test_remove(self, tmp_path):
+        from ceph_tpu.store.kstore import KStore
+        from ceph_tpu.tools import objectstore_tool
+
+        path = self._seed(tmp_path)
+        rc, _ = run(objectstore_tool, [
+            "--data-path", path, "--op", "remove", "--pgid", "1.1s2", "beta",
+        ])
+        assert rc == 0
+        store = KStore(path)
+        store.mount()
+        assert "beta" not in store.list_objects("1.1s2")
+        store.umount()
+
+
+class TestClusterClis:
+    """rados + ceph CLI against a live localhost cluster (reference:
+    src/test/cli + qa workunits driving the real binaries)."""
+
+    @pytest.fixture(scope="class")
+    def cli_cluster(self):
+        from ceph_tpu.qa.vstart import LocalCluster
+
+        with LocalCluster(n_mons=1, n_osds=4) as c:
+            c.create_ec_pool("clipool", k=2, m=1)
+            yield c
+
+    def _mon(self, c):
+        return ",".join(f"{h}:{p}" for h, p in (tuple(a) for a in c.mon_addrs))
+
+    def test_rados_put_get_ls_stat_rm(self, cli_cluster, tmp_path):
+        from ceph_tpu.tools import rados as rados_cli
+
+        mon = self._mon(cli_cluster)
+        src = tmp_path / "payload.bin"
+        src.write_bytes(bytes(range(256)) * 10)
+        rc, _ = run(rados_cli, ["-m", mon, "-p", "clipool", "put", "obj1",
+                                str(src)])
+        assert rc == 0
+        dst = tmp_path / "back.bin"
+        rc, _ = run(rados_cli, ["-m", mon, "-p", "clipool", "get", "obj1",
+                                str(dst)])
+        assert rc == 0 and dst.read_bytes() == src.read_bytes()
+        rc, out = run(rados_cli, ["-m", mon, "-p", "clipool", "ls"])
+        assert rc == 0 and "obj1" in out.split()
+        rc, out = run(rados_cli, ["-m", mon, "-p", "clipool", "stat", "obj1"])
+        assert rc == 0 and "size 2560" in out
+        rc, _ = run(rados_cli, ["-m", mon, "-p", "clipool", "rm", "obj1"])
+        assert rc == 0
+        rc, out = run(rados_cli, ["-m", mon, "-p", "clipool", "ls"])
+        assert "obj1" not in out.split()
+
+    def test_rados_bench(self, cli_cluster):
+        from ceph_tpu.tools import rados as rados_cli
+
+        mon = self._mon(cli_cluster)
+        rc, out = run(rados_cli, ["-m", mon, "-p", "clipool", "bench", "2",
+                                  "write", "-b", "8192"])
+        assert rc == 0 and "Bandwidth (MB/sec)" in out
+        rc, out = run(rados_cli, ["-m", mon, "-p", "clipool", "bench", "1",
+                                  "seq", "-b", "8192"])
+        assert rc == 0 and "reads made" in out
+
+    def test_ceph_status_tree_pools(self, cli_cluster):
+        from ceph_tpu.tools import ceph_cli
+
+        mon = self._mon(cli_cluster)
+        rc, out = run(ceph_cli, ["-m", mon, "status"])
+        assert rc == 0 and "health:" in out and "4 osds: 4 up" in out
+        rc, out = run(ceph_cli, ["-m", mon, "osd", "tree"])
+        assert rc == 0 and "osd.3" in out and "root" in out
+        rc, out = run(ceph_cli, ["-m", mon, "osd", "pool", "ls"])
+        assert rc == 0 and "clipool" in out
+        rc, out = run(ceph_cli, ["-m", mon, "--format", "json", "osd",
+                                 "dump"])
+        assert rc == 0 and json.loads(out)
+
+    def test_ceph_pool_create_and_flags(self, cli_cluster):
+        from ceph_tpu.tools import ceph_cli
+
+        mon = self._mon(cli_cluster)
+        rc, _ = run(ceph_cli, ["-m", mon, "osd", "pool", "create",
+                               "clitest", "8", "size=2"])
+        assert rc == 0
+        rc, out = run(ceph_cli, ["-m", mon, "osd", "pool", "ls"])
+        assert "clitest" in out
+        rc, _ = run(ceph_cli, ["-m", mon, "osd", "set", "noout"])
+        assert rc == 0
+        rc, out = run(ceph_cli, ["-m", mon, "status"])
+        assert "OSDMAP_FLAGS" in out or "noout" in out
+        rc, _ = run(ceph_cli, ["-m", mon, "osd", "unset", "noout"])
+        assert rc == 0
