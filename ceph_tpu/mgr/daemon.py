@@ -50,7 +50,15 @@ class MgrDaemon(Dispatcher):
             if cls is None:
                 self.cct.dout("mgr", 0, f"mgr: unknown module {name!r}")
                 continue
-            mod = cls(self)
+            try:
+                mod = cls(self)
+            except Exception as e:
+                # one module failing to construct (e.g. prometheus port
+                # taken) must not take down the whole mgr
+                self.cct.dout(
+                    "mgr", 0, f"mgr module {name!r} failed to load: {e!r}"
+                )
+                continue
             self._modules[name] = mod
             t = threading.Thread(
                 target=self._serve_module, args=(mod,),

@@ -94,10 +94,11 @@ def main(argv=None, out=sys.stdout) -> int:
         ap.error("no command")
     try:
         cmd = _build_command(args.words)
+        mons = _parse_mons(args.mon)
     except (ValueError, IndexError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 22
-    mc = MonClient(CephContext("client.ceph-cli"), _parse_mons(args.mon))
+    mc = MonClient(CephContext("client.ceph-cli"), mons)
     try:
         rv, res = mc.command(cmd, timeout=20.0)
     finally:

@@ -102,6 +102,10 @@ def op_import(store, src, force: bool) -> int:
         data = base64.b64decode(obj["data"])
         t = Transaction()
         t.try_create_collection(cid)
+        if store.collection_exists(cid) and store.exists(cid, oid):
+            # replace, don't merge: stale xattrs/omap on the destination
+            # must not survive into the "identical" imported copy
+            t.remove(cid, oid)
         t.touch(cid, oid)
         t.write(cid, oid, 0, data)
         t.truncate(cid, oid, len(data))

@@ -20,13 +20,19 @@ def _parse_mons(spec: str) -> list[tuple[str, int]]:
     addrs = []
     for part in spec.split(","):
         host, _, port = part.strip().rpartition(":")
-        addrs.append((host or "127.0.0.1", int(port)))
+        if not host or not port.isdigit():
+            raise ValueError(
+                f"bad mon address {part.strip()!r} (want host:port)"
+            )
+        addrs.append((host, int(port)))
     return addrs
 
 
-def bench(io, seconds: int, mode: str, block: int, out) -> int:
-    """`rados bench` analog: timed write burst, then optional seq read of
-    what was written (reference: rados.cc ObjBencher flow)."""
+def bench(io, seconds: int, mode: str, block: int, out,
+          cleanup: bool = True) -> int:
+    """`rados bench` analog: timed write burst (cleaned up unless
+    --no-cleanup, which seq mode depends on), or seq read of a prior
+    write bench's leftovers (reference: rados.cc ObjBencher flow)."""
     payload = bytes(i & 0xFF for i in range(block))
     written: list[str] = []
     t0 = time.monotonic()
@@ -37,23 +43,24 @@ def bench(io, seconds: int, mode: str, block: int, out) -> int:
             written.append(oid)
         dt = time.monotonic() - t0
         n = len(written)
+        nbytes = n * block
     else:  # seq: read back the objects a prior write bench left behind
         oids = [o for o in io.list_objects() if o.startswith("benchmark_data_")]
         n = 0
+        nbytes = 0
         for oid in oids:
             if time.monotonic() - t0 >= seconds:
                 break
-            io.read(oid)
+            nbytes += len(io.read(oid))  # actual bytes, not the -b flag
             n += 1
         dt = time.monotonic() - t0
-    mb = n * block / 1e6
     print(f"Total time run:       {dt:.3f}", file=out)
     print(f"Total {'writes' if mode == 'write' else 'reads'} made: {n}", file=out)
-    print(f"Bandwidth (MB/sec):   {mb / dt if dt else 0:.3f}", file=out)
+    print(f"Bandwidth (MB/sec):   {nbytes / 1e6 / dt if dt else 0:.3f}", file=out)
     print(f"Average IOPS:         {n / dt if dt else 0:.1f}", file=out)
-    if mode == "write":
-        for oid in written:  # leave the pool clean unless asked not to
-            pass
+    if mode == "write" and cleanup:
+        for oid in written:
+            io.remove(oid)
     return 0
 
 
@@ -80,9 +87,16 @@ def main(argv=None, out=sys.stdout) -> int:
     p.add_argument("seconds", type=int)
     p.add_argument("mode", choices=("write", "seq"))
     p.add_argument("-b", "--block-size", type=int, default=4 << 20)
+    p.add_argument("--no-cleanup", action="store_true",
+                   help="keep benchmark objects (seq mode reads them)")
     args = ap.parse_args(argv)
 
-    r = Rados(CephContext("client.rados-tool"), _parse_mons(args.mon))
+    try:
+        mons = _parse_mons(args.mon)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 22
+    r = Rados(CephContext("client.rados-tool"), mons)
     try:
         r.connect()
         io = r.open_ioctx(args.pool)
@@ -112,7 +126,8 @@ def main(argv=None, out=sys.stdout) -> int:
                 file=out,
             )
         elif args.op == "bench":
-            return bench(io, args.seconds, args.mode, args.block_size, out)
+            return bench(io, args.seconds, args.mode, args.block_size, out,
+                         cleanup=not args.no_cleanup)
         return 0
     except (IOError, KeyError, ConnectionError) as e:
         print(f"error: {e}", file=sys.stderr)

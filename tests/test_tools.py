@@ -278,11 +278,23 @@ class TestClusterClis:
 
         mon = self._mon(cli_cluster)
         rc, out = run(rados_cli, ["-m", mon, "-p", "clipool", "bench", "2",
-                                  "write", "-b", "8192"])
+                                  "write", "-b", "8192", "--no-cleanup"])
         assert rc == 0 and "Bandwidth (MB/sec)" in out
         rc, out = run(rados_cli, ["-m", mon, "-p", "clipool", "bench", "1",
                                   "seq", "-b", "8192"])
         assert rc == 0 and "reads made" in out
+        nreads = int(next(l for l in out.splitlines()
+                          if "reads made" in l).rsplit(" ", 1)[1])
+        assert nreads > 0, out
+        # default write bench cleans up after itself: object count in the
+        # pool does not grow past the --no-cleanup run's leftovers
+        rc, out = run(rados_cli, ["-m", mon, "-p", "clipool", "ls"])
+        before = set(out.split())
+        rc, _ = run(rados_cli, ["-m", mon, "-p", "clipool", "bench", "1",
+                                "write", "-b", "8192"])
+        assert rc == 0
+        rc, out = run(rados_cli, ["-m", mon, "-p", "clipool", "ls"])
+        assert set(out.split()) <= before
 
     def test_ceph_status_tree_pools(self, cli_cluster):
         from ceph_tpu.tools import ceph_cli
