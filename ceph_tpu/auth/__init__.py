@@ -1,0 +1,4 @@
+"""Authentication (reference: src/auth — cephx; SURVEY.md §2.7)."""
+from .cephx import AuthError, CephxAuthenticator, generate_secret
+
+__all__ = ["AuthError", "CephxAuthenticator", "generate_secret"]

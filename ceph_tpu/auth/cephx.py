@@ -1,0 +1,63 @@
+"""cephx-style mutual authentication for messenger connections.
+
+Reference: src/auth/cephx (CephxProtocol.h: challenge/proof exchange with
+HMAC over a shared secret; src/msg ProtocolV2's auth frames carry it).
+
+Scope vs the reference, by design: one shared cluster secret (the
+`auth_shared_secret` option) stands in for the mon-brokered per-service
+ticket hierarchy — the wire exchange (server challenge -> client proof +
+counter-challenge -> server proof) and its properties (mutual proof of
+key possession, per-connection nonces so transcripts never replay) match
+CephxProtocol's session-key handshake; what's elided is ticket issuance
+and rotation, which need the mon KeyServer state machine.
+
+Wire form (one line each, after the messenger banner/ident):
+
+    S->C  auth-challenge <snonce-hex>
+    C->S  auth-proof <hmac-hex> <cnonce-hex>
+    S->C  auth-ok <hmac-hex>
+
+proofs: HMAC-SHA256(secret, nonce || peer-entity-name).  A server with
+auth disabled sends no challenge (wire-compatible with unauthenticated
+peers); a client expecting auth then times out — the same hard failure a
+cephx-required cluster gives unauthenticated clients.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+
+
+class AuthError(Exception):
+    pass
+
+
+def generate_secret() -> str:
+    """A fresh base64 cluster secret (`ceph-authtool --gen-key` analog)."""
+    return base64.b64encode(os.urandom(32)).decode()
+
+
+class CephxAuthenticator:
+    """Per-messenger auth engine; stateless besides the secret."""
+
+    def __init__(self, secret_b64: str):
+        try:
+            self._secret = base64.b64decode(secret_b64.encode(), validate=True)
+        except Exception as e:
+            raise AuthError(f"bad auth_shared_secret: {e}") from e
+        if len(self._secret) < 16:
+            raise AuthError("auth_shared_secret shorter than 16 bytes")
+
+    def make_nonce(self) -> str:
+        return os.urandom(16).hex()
+
+    def proof(self, nonce_hex: str, name: str) -> str:
+        return hmac.new(
+            self._secret, bytes.fromhex(nonce_hex) + name.encode(),
+            hashlib.sha256,
+        ).hexdigest()
+
+    def verify(self, nonce_hex: str, name: str, proof_hex: str) -> bool:
+        return hmac.compare_digest(self.proof(nonce_hex, name), proof_hex)

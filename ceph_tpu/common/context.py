@@ -26,6 +26,10 @@ class CephContext:
             for k, v in overrides.items():
                 self.conf.set(k, v, level=LEVEL_CMDLINE)
         self.log = Log(self.conf, ring_size=self.conf.get("log_ring_size"))
+        if self.conf.get("lockdep"):
+            from . import lockdep
+
+            lockdep.enable()
         self.perf = PerfCountersCollection()
         self.heartbeat_map = HeartbeatMap()
         self.admin_socket: AdminSocket | None = None

@@ -31,6 +31,9 @@ def default_options() -> OptionTable:
             Option("debug_crush", int, 1, "crush debug level", min=0, max=20,
                    runtime=True),
             Option("admin_socket", str, "", "admin socket path ('' disables)"),
+            Option("lockdep", bool, False,
+                   "runtime lock-order cycle detection (reference: "
+                   "src/common/lockdep.cc)"),
             # -- messenger (reference: ms_* in global.yaml.in) -------------
             Option("ms_connect_timeout", float, 10.0,
                    "seconds to wait for a connect", min=0.0),
@@ -47,6 +50,9 @@ def default_options() -> OptionTable:
             Option("objecter_inflight_ops", int, 1024,
                    "client in-flight op throttle", min=0),
             # -- osd (reference: osd.yaml.in) ------------------------------
+            Option("osd_data", str, "",
+                   "data directory for file-backed objectstores "
+                   "('' with objectstore=filestore is a config error)"),
             Option("osd_pool_default_size", int, 3, "replica count", min=1),
             Option("osd_pool_default_min_size", int, 0,
                    "min replicas to serve I/O (0 = size - size/2)", min=0),
@@ -91,6 +97,13 @@ def default_options() -> OptionTable:
                    min=0.05),
             Option("mon_max_pg_per_osd", int, 250,
                    "pg-count sanity limit at pool create", min=1),
+            # -- auth (reference: auth_* in global.yaml.in) ----------------
+            Option("auth_cluster_required", str, "none",
+                   "authentication for intra-cluster + client connections",
+                   enum=("none", "cephx")),
+            Option("auth_shared_secret", str, "",
+                   "base64 cluster secret (cephx key analog; "
+                   "auth.generate_secret() makes one)"),
             # -- mgr (reference: mgr.yaml.in) ------------------------------
             Option("mgr_addr", str, "",
                    "host:port daemons send MMgrReport to ('' disables)",
@@ -118,6 +131,10 @@ def default_options() -> OptionTable:
                    "fsync the WAL on every commit"),
             Option("objectstore_checksum", bool, True,
                    "crc32c-verify payloads on read"),
+            Option("objectstore_compression", str, "none",
+                   "at-rest object-data compression for file-backed "
+                   "stores (reference: bluestore_compression_algorithm)",
+                   enum=("none", "zlib", "snappy", "zstd", "lz4")),
             # -- ec / tpu --------------------------------------------------
             Option("ec_kernel", str, "auto",
                    "encode kernel selection",

@@ -237,6 +237,39 @@ class Messenger:
         self._sessions: dict[tuple[str, int], _Session] = {}
         self._lock = threading.RLock()
         self._stopped = False
+        # cephx-style mutual auth (reference: ProtocolV2 auth frames);
+        # engine built lazily from config so tests can flip it per-context
+        self._auth = None
+        self._auth_checked = False
+
+    def _authenticator(self):
+        if not self._auth_checked:
+            if (
+                self.cct is not None
+                and self.cct.conf.get("auth_cluster_required") == "cephx"
+            ):
+                from ..auth import CephxAuthenticator
+
+                # construct BEFORE marking checked: a bad secret must stay
+                # a loud failure on every connection (fail closed), never
+                # silently disable auth on a cephx-required messenger
+                self._auth = CephxAuthenticator(
+                    self.cct.conf.get("auth_shared_secret")
+                )
+            self._auth_checked = True
+        return self._auth
+
+    @staticmethod
+    def _read_line(sock: socket.socket, limit: int = 512) -> str:
+        line = b""
+        while not line.endswith(b"\n"):
+            if len(line) > limit:
+                raise ConnectionError("auth line too long")
+            b = sock.recv(1)
+            if not b:
+                raise ConnectionError("peer closed during auth")
+            line += b
+        return line.decode().strip()
 
     @classmethod
     def create(cls, cct, name: str) -> "Messenger":
@@ -331,7 +364,44 @@ class Messenger:
         # connect_id plays client_cookie's role, and the policy rides along
         # so the acceptor's half agrees with ours)
         sock.sendall(_BANNER + f"{self.name} {connect_id} {policy}\n".encode())
+        try:
+            auth = self._authenticator()
+        except Exception as e:
+            sock.close()
+            raise ConnectionError(f"auth misconfigured: {e}") from e
+        if auth is not None:
+            # mutual cephx-style proof (ceph_tpu/auth/cephx.py wire form).
+            # a server WITHOUT auth sends no challenge -> we time out, the
+            # same hard failure a cephx-required cluster hands a peer
+            try:
+                sock.settimeout(timeout)
+                kind, snonce = self._read_line(sock).split()
+                if kind != "auth-challenge":
+                    raise ConnectionError(f"expected challenge, got {kind}")
+                cnonce = auth.make_nonce()
+                sock.sendall(
+                    f"auth-proof {auth.proof(snonce, self.name)} {cnonce}\n"
+                    .encode()
+                )
+                kind, sproof = self._read_line(sock).split()
+                peer_entity = self._peer_entity_hint(addr)
+                if kind != "auth-ok" or not auth.verify(
+                    cnonce, peer_entity, sproof
+                ):
+                    raise ConnectionError("server failed mutual auth")
+                sock.settimeout(None)
+            except (OSError, ValueError) as e:
+                sock.close()
+                raise ConnectionError(f"auth handshake failed: {e}") from e
         return sock
+
+    def _peer_entity_hint(self, addr) -> str:
+        """Entity name the server proves as.  The server signs with the
+        name it sends in auth-ok's preceding exchange — which is its
+        messenger name; since we dialed blind, the proof binds our cnonce
+        + the shared secret, and any key holder is cluster-trusted, so the
+        name contributes no extra trust.  Server signs 'cluster'."""
+        return "cluster"
 
     # -- incoming ---------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -360,25 +430,47 @@ class Messenger:
             if banner != _BANNER:
                 sock.close()
                 return
-            ident = b""
-            while not ident.endswith(b"\n"):
-                b = sock.recv(1)
-                if not b:
-                    sock.close()
-                    return
-                ident += b
+            ident = self._read_line(sock)
             sock.settimeout(None)
-        except OSError:
+        except (OSError, ConnectionError):
             sock.close()
             return
         try:
-            peer_name, cid_str, policy = ident.decode().split()
+            peer_name, cid_str, policy = ident.split()
             connect_id = int(cid_str)
             if policy not in (POLICY_LOSSY, POLICY_LOSSLESS_PEER):
                 raise ValueError(policy)
         except ValueError:
             sock.close()
             return
+        try:
+            auth = self._authenticator()
+        except Exception as e:
+            # misconfigured secret on a cephx-required acceptor: reject
+            # every peer loudly rather than failing open
+            self._dout(0, f"auth misconfigured, rejecting {peer}: {e}")
+            sock.close()
+            return
+        if auth is not None:
+            try:
+                sock.settimeout(
+                    self.cct.conf.get("ms_connect_timeout") if self.cct else 10.0
+                )
+                snonce = auth.make_nonce()
+                sock.sendall(f"auth-challenge {snonce}\n".encode())
+                kind, proof, cnonce = self._read_line(sock).split()
+                if kind != "auth-proof" or not auth.verify(
+                    snonce, peer_name, proof
+                ):
+                    raise ConnectionError(f"bad auth proof from {peer_name}")
+                sock.sendall(
+                    f"auth-ok {auth.proof(cnonce, 'cluster')}\n".encode()
+                )
+                sock.settimeout(None)
+            except (OSError, ValueError, ConnectionError) as e:
+                self._dout(1, f"auth reject {peer_name}@{peer}: {e}")
+                sock.close()
+                return
         with self._lock:
             sess = self._sessions.setdefault((peer_name, connect_id), _Session())
             conn = Connection(

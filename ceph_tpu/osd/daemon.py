@@ -30,6 +30,7 @@ import threading
 import time
 
 from ..common.crc32c import crc32c
+from ..common.lockdep import make_lock
 from ..common.perf_counters import PerfCountersBuilder
 from ..ec.registry import ErasureCodePluginRegistry
 from ..mon.mon_client import MonClient
@@ -63,7 +64,7 @@ class PGState:
         self.ps = ps
         self.log = PGLog()
         self.version = 0
-        self.lock = threading.RLock()
+        self.lock = make_lock("osd::pg")
 
     def meta_oid(self) -> str:
         return "_pgmeta"
@@ -77,14 +78,28 @@ class OSD(Dispatcher):
         self.cct = cct
         self.id = osd_id
         self.whoami = f"osd.{osd_id}"
-        self.store = store if store is not None else MemStore()
+        if store is not None:
+            self.store = store
+        else:
+            # config-driven backend (reference: OSD reads `osd objectstore`)
+            kind = cct.conf.get("objectstore")
+            if kind == "memstore":
+                self.store = MemStore()
+            else:
+                from ..store.object_store import create_store
+
+                self.store = create_store(
+                    kind,
+                    cct.conf.get("osd_data") or None,
+                    compression=cct.conf.get("objectstore_compression"),
+                )
         self.messenger = Messenger.create(cct, self.whoami)
         self.messenger.default_policy = POLICY_LOSSLESS_PEER
         self.messenger.add_dispatcher(self)
         self.mc = MonClient(cct, mon_addrs, name=f"{self.whoami}-monc")
         self.osdmap: OSDMap | None = None
         self.pgs: dict[str, PGState] = {}
-        self._pgs_lock = threading.RLock()
+        self._pgs_lock = make_lock("osd::pgs")
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._sub_replies: dict[int, dict] = {}   # tid -> reply fields
@@ -332,6 +347,26 @@ class OSD(Dispatcher):
             pass
 
     def _execute_client_op(self, msg: MOSDOp) -> MOSDOpReply:
+        # the client targeted with a NEWER map than ours: wait for it
+        # before deciding anything (reference: OSD::require_same_or_newer_map
+        # waiting_for_map) — answering from the stale map would yield
+        # false 'no such pool' / wrong-primary verdicts
+        if msg.epoch and msg.epoch > self.my_epoch():
+            deadline = time.monotonic() + 10.0
+            while (
+                msg.epoch > self.my_epoch()
+                and time.monotonic() < deadline
+                and not self._stop.is_set()
+            ):
+                time.sleep(0.05)
+            if msg.epoch > self.my_epoch():
+                # still behind: NACK retryably — answering from a map the
+                # client provably outdates would yield FINAL wrong results
+                # ('no such pool', wrong primary)
+                return MOSDOpReply(
+                    tid=msg.tid, retval=-11, epoch=self.my_epoch(),
+                    result="waiting for newer osdmap",
+                )
         m = self.osdmap
         pool = m.pools.get(msg.pool) if m else None
         if m is None or pool is None:

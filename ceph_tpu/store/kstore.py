@@ -38,15 +38,28 @@ def _ckey(cid: str) -> str:
     return f"C{_SEP}{cid}"
 
 
+def _zkey(cid: str, oid: str) -> str:
+    """Compressed-data twin of _dkey (value: b"<algo>\\x00" + blob)."""
+    return f"Z{_SEP}{cid}{_SEP}{oid}"
+
+
 class KStore(MemStore):
     """MemStore's read paths + apply loop, with a durable KV underneath."""
 
-    def __init__(self, path: str, sync: bool = True):
+    def __init__(self, path: str, sync: bool = True,
+                 compression: str = "none"):
         super().__init__()
         self.path = path
         self._kv = LogKV(path, sync_default=sync)
         self._mounted = False
         self._io_lock = RLock()
+        # at-rest object-data compression (reference: bluestore_compression
+        # — data only, stored iff it actually shrinks; xattr/omap stay raw)
+        self._compressor = None
+        if compression and compression != "none":
+            from ..compressor import Compressor
+
+            self._compressor = Compressor.create(compression)
 
     # -- lifecycle --------------------------------------------------------
     def mount(self) -> None:
@@ -58,6 +71,19 @@ class KStore(MemStore):
             for key, val in self._kv.iterate(f"D{_SEP}"):
                 _, cid, oid = key.split(_SEP, 2)
                 colls[cid].objects[oid] = Object(data=bytearray(val))
+            decompressors: dict[str, object] = {}
+            for key, val in self._kv.iterate(f"Z{_SEP}"):
+                _, cid, oid = key.split(_SEP, 2)
+                algo, _, blob = bytes(val).partition(b"\x00")
+                name = algo.decode()
+                comp = decompressors.get(name)
+                if comp is None:
+                    from ..compressor import Compressor
+
+                    comp = decompressors[name] = Compressor.create(name)
+                colls[cid].objects[oid] = Object(
+                    data=bytearray(comp.decompress(blob))
+                )
             for key, val in self._kv.iterate(f"A{_SEP}"):
                 _, cid, oid, name = key.split(_SEP, 3)
                 colls[cid].objects[oid].xattrs[name] = val
@@ -103,6 +129,7 @@ class KStore(MemStore):
                 # clear any stale keys for the object, then write absolute
                 # post-state — makes the batch idempotent under replay
                 batch.rm(_dkey(cid, oid))
+                batch.rm(_zkey(cid, oid))
                 old_xattrs, old_omap = stale[(cid, oid)]
                 for name in old_xattrs:
                     batch.rm(_akey(cid, oid, name))
@@ -111,7 +138,18 @@ class KStore(MemStore):
                 c = self._colls.get(cid)
                 o = c.objects.get(oid) if c else None
                 if o is not None:
-                    batch.set(_dkey(cid, oid), bytes(o.data))
+                    raw = bytes(o.data)
+                    blob = None
+                    if self._compressor is not None and raw:
+                        z = self._compressor.compress(raw)
+                        if len(z) < len(raw):  # store compressed iff it wins
+                            blob = (
+                                self._compressor.NAME.encode() + b"\x00" + z
+                            )
+                    if blob is not None:
+                        batch.set(_zkey(cid, oid), blob)
+                    else:
+                        batch.set(_dkey(cid, oid), raw)
                     for name, val in o.xattrs.items():
                         batch.set(_akey(cid, oid, name), val)
                     for key, val in o.omap.items():
@@ -127,14 +165,20 @@ class KStore(MemStore):
             seen_colls = {
                 key.split(_SEP, 1)[1] for key, _ in self._kv.iterate(f"C{_SEP}")
             }
-            for key, _ in self._kv.iterate(f"D{_SEP}"):
-                _, cid, _oid = key.split(_SEP, 2)
-                if cid not in seen_colls:
-                    errors.append(f"object key {key!r} in missing collection")
+            for kind in ("D", "Z"):
+                for key, _ in self._kv.iterate(f"{kind}{_SEP}"):
+                    _, cid, _oid = key.split(_SEP, 2)
+                    if cid not in seen_colls:
+                        errors.append(
+                            f"object key {key!r} in missing collection"
+                        )
             for kind in ("A", "O"):
                 for key, _ in self._kv.iterate(f"{kind}{_SEP}"):
                     _, cid, oid, _rest = key.split(_SEP, 3)
-                    if self._kv.get(_dkey(cid, oid)) is None:
+                    if (
+                        self._kv.get(_dkey(cid, oid)) is None
+                        and self._kv.get(_zkey(cid, oid)) is None
+                    ):
                         errors.append(f"{key!r} has no object data key")
         return errors
 

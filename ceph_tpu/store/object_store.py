@@ -352,8 +352,11 @@ class ObjectStore:
                 raise StoreError(f"unknown transaction op {op.op}")
 
 
-def create_store(kind: str, path: str | None = None) -> ObjectStore:
-    """Factory (reference: ObjectStore::create keyed by `objectstore`)."""
+def create_store(
+    kind: str, path: str | None = None, compression: str = "none"
+) -> ObjectStore:
+    """Factory (reference: ObjectStore::create keyed by `objectstore`;
+    `compression` is the objectstore_compression option)."""
     from .kstore import KStore
     from .memstore import MemStore
 
@@ -362,5 +365,5 @@ def create_store(kind: str, path: str | None = None) -> ObjectStore:
     if kind in ("kstore", "filestore"):
         if not path:
             raise StoreError(f"{kind} requires a path")
-        return KStore(path)
+        return KStore(path, compression=compression)
     raise StoreError(f"unknown objectstore {kind!r}")
