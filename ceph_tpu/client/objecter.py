@@ -61,9 +61,9 @@ class Objecter(Dispatcher):
         pool = m.pools.get(pool_id)
         if pool is None:
             raise KeyError(f"no pool {pool_id}")
-        if op == "list" and oid.startswith(":pg:"):
-            # pg-targeted pseudo-oid — honored by the OSD only for
-            # listings; any other op treats ':pg:*' as a normal name
+        if op in ("list", "scrub") and oid.startswith(":pg:"):
+            # pg-targeted pseudo-oid — honored by the OSD only for these
+            # ops; anything else treats ':pg:*' as a normal name
             ps = int(oid[4:])
         else:
             ps = object_ps(oid, pool.pg_num)

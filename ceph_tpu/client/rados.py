@@ -51,6 +51,22 @@ class IoCtx:
             raise IOError(f"stat {oid!r}: {rep.retval} {rep.result}")
         return rep.result
 
+    def scrub_pg(self, ps: int) -> dict:
+        """Deep-scrub one PG on its primary; returns the scrub report
+        (reference: `ceph pg deep-scrub` reaching the primary)."""
+        rep = self._client.objecter.op_submit(
+            self.pool_id, f":pg:{ps}", "scrub", timeout=60.0
+        )
+        if rep.retval != 0:
+            raise IOError(f"scrub pg {ps}: {rep.retval} {rep.result}")
+        return rep.result
+
+    def scrub(self) -> list[dict]:
+        """Deep-scrub every PG of the pool."""
+        m = self._client.mc.osdmap
+        pool = m.pools[self.pool_id]
+        return [self.scrub_pg(ps) for ps in range(pool.pg_num)]
+
     def list_objects(self) -> list[str]:
         """Walk every PG primary (reference: librados nobjects_begin)."""
         m = self._client.mc.osdmap

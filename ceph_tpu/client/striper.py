@@ -1,0 +1,185 @@
+"""File striper — byte-stream <-> RADOS-object extent mapping (reference:
+src/osdc/Striper.cc :: file_to_extents + src/libradosstriper;
+SURVEY.md §5.7).
+
+A "file" of bytes is striped over objects exactly the reference way:
+stripe units of `su` bytes round-robin across `stripe_count` objects of a
+set, each object holding at most `object_size` bytes; sets repeat.  For a
+byte range the mapping yields (object name, object offset, length)
+extents; StripedObject wraps an IoCtx with write/read/truncate over the
+mapping, storing the logical size in the first object's "size" metadata
+sidecar object.
+
+    s = StripedObject(io, "vol1", object_size=1 << 22, stripe_unit=1 << 16,
+                      stripe_count=4)
+    s.write(data, off)
+    s.read(off, length)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class StripePolicy:
+    """reference: ceph_file_layout (su, stripe_count, object_size)."""
+
+    object_size: int = 1 << 22
+    stripe_unit: int = 1 << 16
+    stripe_count: int = 1
+
+    def __post_init__(self):
+        if self.stripe_unit <= 0 or self.object_size <= 0 or self.stripe_count <= 0:
+            raise ValueError("layout fields must be positive")
+        if self.object_size % self.stripe_unit:
+            raise ValueError("object_size must be a multiple of stripe_unit")
+
+    @property
+    def stripes_per_object(self) -> int:
+        return self.object_size // self.stripe_unit
+
+    def extents(self, off: int, length: int):
+        """Yield (objectno, obj_off, len) for a byte range — the
+        file_to_extents loop, unrolled per stripe unit then merged for
+        contiguous runs within one object."""
+        su = self.stripe_unit
+        spo = self.stripes_per_object
+        sc = self.stripe_count
+        out: list[list[int]] = []  # [objectno, obj_off, len] merged
+        pos = off
+        end = off + length
+        while pos < end:
+            blockno = pos // su          # stripe unit index in the stream
+            stripeno = blockno // sc     # full stripe row
+            stripepos = blockno % sc     # which object of the set
+            objectsetno = stripeno // spo
+            objectno = objectsetno * sc + stripepos
+            block_off = pos % su
+            obj_off = (stripeno % spo) * su + block_off
+            take = min(su - block_off, end - pos)
+            if out and out[-1][0] == objectno and \
+                    out[-1][1] + out[-1][2] == obj_off:
+                out[-1][2] += take
+            else:
+                out.append([objectno, obj_off, take])
+            pos += take
+        return [tuple(e) for e in out]
+
+
+class StripedObject:
+    """Striped byte-stream over an IoCtx (reference: libradosstriper's
+    RadosStriperImpl, the write/read/truncate subset)."""
+
+    def __init__(self, io, name: str, policy: StripePolicy | None = None,
+                 **layout):
+        self.io = io
+        self.name = name
+        self.policy = policy or StripePolicy(**layout)
+
+    def _obj(self, objectno: int) -> str:
+        # reference: {name}.{%016x} object naming
+        return f"{self.name}.{objectno:016x}"
+
+    def _meta(self) -> str:
+        return f"{self.name}.meta"
+
+    # -- size sidecar ------------------------------------------------------
+    def size(self) -> int:
+        try:
+            raw = self.io.read(self._meta())
+        except IOError:
+            return 0
+        return int(raw or b"0")
+
+    def _set_size(self, size: int) -> None:
+        self.io.write_full(self._meta(), str(size).encode())
+
+    # -- I/O ---------------------------------------------------------------
+    def write(self, data: bytes, off: int = 0) -> None:
+        """Read-modify-write each touched object (the framework's object
+        store is whole-object; the reference writes sub-object extents
+        natively — same bytes land either way)."""
+        src = 0  # extents come back in stream order
+        for objectno, obj_off, ln in self.policy.extents(off, len(data)):
+            try:
+                cur = bytearray(self.io.read(self._obj(objectno)))
+            except IOError:
+                cur = bytearray()
+            end = obj_off + ln
+            if len(cur) < end:
+                cur.extend(b"\0" * (end - len(cur)))
+            cur[obj_off:end] = data[src : src + ln]
+            src += ln
+            self.io.write_full(self._obj(objectno), bytes(cur))
+        if off + len(data) > self.size():
+            self._set_size(off + len(data))
+
+    def read(self, off: int = 0, length: int | None = None) -> bytes:
+        size = self.size()
+        if off >= size:
+            return b""
+        if length is None or off + length > size:
+            length = size - off
+        parts: list[bytes] = []
+        for objectno, obj_off, ln in self.policy.extents(off, length):
+            try:
+                chunk = self.io.read(self._obj(objectno), off=obj_off,
+                                     length=ln)
+            except IOError:
+                chunk = b""
+            if len(chunk) < ln:  # sparse object: logical zeros
+                chunk = chunk + b"\0" * (ln - len(chunk))
+            parts.append(chunk)
+        return b"".join(parts)
+
+    def truncate(self, size: int) -> None:
+        """Shrink to `size`: whole objects past it are removed and kept
+        objects are cut to their surviving prefix, so a later write that
+        re-extends the stream reads zeros (not stale bytes) in the gap —
+        POSIX/libradosstriper truncate semantics."""
+        old = self.size()
+        if size >= old:
+            self._set_size(size)
+            return
+        kept = self.policy.extents(0, size)
+        # per-object surviving prefix length (striping interleaves, so an
+        # object can hold stream bytes BEYOND `size` below other kept
+        # ranges — everything past the last kept extent end must go)
+        keep_len: dict[int, int] = {}
+        for objectno, obj_off, ln in kept:
+            keep_len[objectno] = max(
+                keep_len.get(objectno, 0), obj_off + ln
+            )
+        last_obj = max(
+            (e[0] for e in self.policy.extents(0, old)), default=-1
+        )
+        for objectno in range(last_obj + 1):
+            keep = keep_len.get(objectno, 0)
+            if keep == 0:
+                try:
+                    self.io.remove(self._obj(objectno))
+                except IOError:
+                    pass
+                continue
+            try:
+                cur = self.io.read(self._obj(objectno))
+            except IOError:
+                continue
+            if len(cur) > keep:
+                self.io.write_full(self._obj(objectno), bytes(cur[:keep]))
+        self._set_size(size)
+
+    def remove(self) -> None:
+        last_obj = max(
+            (e[0] for e in self.policy.extents(0, max(self.size(), 1))),
+            default=-1,
+        )
+        for objectno in range(last_obj + 1):
+            try:
+                self.io.remove(self._obj(objectno))
+            except IOError:
+                pass
+        try:
+            self.io.remove(self._meta())
+        except IOError:
+            pass

@@ -79,6 +79,9 @@ def default_options() -> OptionTable:
                    runtime=True),
             Option("osd_scrub_chunk_max", int, 25,
                    "objects per scrub chunk", min=1),
+            Option("osd_deep_scrub_interval", float, 0.0,
+                   "seconds between periodic deep scrubs (0 disables)",
+                   min=0.0, runtime=True),
             Option("osd_debug_inject_read_err", bool, False,
                    "fault injection: EC shard reads return EIO "
                    "(reference: bluestore_debug_inject_read_err)",

@@ -49,10 +49,13 @@ from .messages import (
     MOSDPingMsg,
     MPGNotify,
     MPGQuery,
+    MScrubShard,
+    MScrubShardReply,
     pack_data,
     unpack_data,
 )
 from .pg_log import LogEntry, PGLog
+from .scheduler import MClockScheduler, QoSParams
 
 import numpy as np
 
@@ -109,6 +112,20 @@ class OSD(Dispatcher):
         self._hb_failures: dict[int, int] = {}
         self._codecs: dict[str, object] = {}
         self._recovery_wakeup = threading.Event()
+        # mClock QoS dispatch (reference: osd_mclock_profile
+        # balanced-ish): client I/O keeps a reservation floor; recovery
+        # and scrub share leftovers under ceilings
+        self.scheduler = MClockScheduler({
+            "client": QoSParams(reservation=100.0, weight=10.0),
+            "background_recovery": QoSParams(
+                reservation=10.0, weight=2.0, limit=200.0
+            ),
+            "background_scrub": QoSParams(weight=1.0, limit=50.0),
+        })
+        self._workers: list[threading.Thread] = []
+        self._recovery_inflight = False
+        self._last_scrub = 0.0
+        self._scrubs_queued: set[str] = set()
         # reference: OSD::create_logger (l_osd_op / l_osd_op_w / ...)
         self.logger = cct.perf.add(
             PerfCountersBuilder("osd")
@@ -120,6 +137,9 @@ class OSD(Dispatcher):
             .add_time_avg("op_latency", "op latency")
             .add_u64_counter("recovery_ops", "objects pushed in recovery")
             .add_u64_counter("subop_w", "shard sub-writes applied")
+            .add_u64_counter("scrubs", "PG scrubs completed")
+            .add_u64_counter("scrub_errors", "shard inconsistencies found")
+            .add_u64_counter("scrub_repairs", "shards repaired by scrub")
             .add_u64("numpg", "placement groups hosted")
             .create_perf_counters()
         )
@@ -160,9 +180,44 @@ class OSD(Dispatcher):
             target=self._tick_loop, name=f"{self.whoami}-tick", daemon=True
         )
         self._tick_thread.start()
+        # op worker pool draining the mClock queue (reference: osd_op_tp)
+        for i in range(2):
+            t = threading.Thread(
+                target=self._op_worker, name=f"{self.whoami}-op-{i}",
+                daemon=True,
+            )
+            self._workers.append(t)
+            t.start()
+
+    def _op_worker(self) -> None:
+        while not self._stop.is_set():
+            picked = self.scheduler.dequeue(timeout=1.0)
+            if picked is None:
+                continue
+            cls, work = picked
+            if cls == "client":
+                # mClock orders ADMISSION; execution gets its own thread
+                # so a client op blocked on a slow peer's sub-op never
+                # pins a worker that background work (or the recovery
+                # that would fix the peer) needs
+                threading.Thread(
+                    target=self._run_op, args=(work,),
+                    name=f"{self.whoami}-op", daemon=True,
+                ).start()
+            else:
+                # background work runs inline: worker count bounds its
+                # concurrency, which is the point of the QoS classes
+                self._run_op(work)
+
+    def _run_op(self, work) -> None:
+        try:
+            work()
+        except Exception as e:
+            self.cct.dout("osd", 0, f"{self.whoami} op failed: {e!r}")
 
     def shutdown(self) -> None:
         self._stop.set()
+        self.scheduler.stop()
         self._recovery_wakeup.set()
         self.mc.shutdown()
         self.messenger.shutdown()
@@ -282,10 +337,11 @@ class OSD(Dispatcher):
     # -- dispatch ----------------------------------------------------------
     def ms_dispatch(self, conn, msg) -> bool:
         if isinstance(msg, MOSDOp):
-            threading.Thread(
-                target=self._handle_client_op, args=(conn, msg),
-                name=f"{self.whoami}-op", daemon=True,
-            ).start()
+            # client ops flow through the mClock queue (reference:
+            # OSD::ms_fast_dispatch -> op_shardedwq enqueue)
+            self.scheduler.enqueue(
+                "client", lambda: self._handle_client_op(conn, msg)
+            )
             return True
         if isinstance(msg, MECSubOpWrite):
             self._handle_sub_write(conn, msg)
@@ -293,13 +349,20 @@ class OSD(Dispatcher):
         if isinstance(msg, MECSubOpRead):
             self._handle_sub_read(conn, msg)
             return True
-        if isinstance(msg, (MECSubOpWriteReply, MECSubOpReadReply, MPGNotify)):
+        if isinstance(
+            msg,
+            (MECSubOpWriteReply, MECSubOpReadReply, MPGNotify,
+             MScrubShardReply),
+        ):
             with self._lock:
                 self._sub_replies[msg.tid] = msg
                 self._cond.notify_all()
             return True
         if isinstance(msg, MPGQuery):
             self._handle_pg_query(conn, msg)
+            return True
+        if isinstance(msg, MScrubShard):
+            self._handle_scrub_shard(conn, msg)
             return True
         if isinstance(msg, MOSDPingMsg):
             if msg.op == "ping":
@@ -372,10 +435,21 @@ class OSD(Dispatcher):
         if m is None or pool is None:
             return MOSDOpReply(tid=msg.tid, retval=-2, epoch=self.my_epoch(),
                                result="no such pool")
-        if msg.op == "list" and msg.oid and msg.oid.startswith(":pg:"):
-            ps = int(msg.oid[4:])  # pg-targeted listing (tools/librados)
+        if (
+            msg.op in ("list", "scrub")
+            and msg.oid
+            and msg.oid.startswith(":pg:")
+        ):
+            ps = int(msg.oid[4:])  # pg-targeted op (tools/librados)
         else:
             ps = object_ps(msg.oid, pool.pg_num) if msg.oid else 0
+        if msg.op == "scrub":
+            try:
+                result = self.scrub_pg(msg.pool, ps, repair=True)
+                return MOSDOpReply(tid=msg.tid, retval=0,
+                                   epoch=self.my_epoch(), result=result)
+            except RuntimeError:
+                pass  # not primary: fall through to the -116 NACK below
         acting, primary = self._acting(msg.pool, ps)
         if primary != self.id:
             # client raced a map change (Objecter resend rule)
@@ -651,6 +725,9 @@ class OSD(Dispatcher):
                 t.try_create_collection(cid)
                 t.write(cid, msg.oid, 0, data)
                 t.truncate(cid, msg.oid, len(data))
+                # self-digest so scrub can tell at-rest rot on the primary
+                # from divergence (replicas get theirs via sub-write)
+                t.setattr(cid, msg.oid, "hinfo", str(crc32c(data)).encode())
                 t.setattr(cid, msg.oid, "size", str(len(data)).encode())
                 self._log_txn(t, cid, pg, entry)
                 self.store.queue_transaction(t)
@@ -843,6 +920,249 @@ class OSD(Dispatcher):
         except (OSError, ConnectionError):
             pass
 
+    # -- scrub (reference: src/osd/scrubber — deep scrub subset) ----------
+    def _local_scrub_map(self, cid: str) -> dict:
+        """ScrubMap of one shard collection: oid -> [computed_crc,
+        stored_crc_or_None, size] (reference: PGBackend::be_scan_list)."""
+        objects: dict[str, list] = {}
+        try:
+            oids = self.store.list_objects(cid)
+        except (NotFound, KeyError):
+            return objects
+        for oid in oids:
+            if oid.startswith("_"):
+                continue
+            try:
+                data = self.store.read(cid, oid)
+            except (NotFound, KeyError):
+                continue
+            try:
+                stored = int(self.store.getattr(cid, oid, "hinfo"))
+            except (NotFound, KeyError, ValueError):
+                stored = None
+            objects[oid] = [crc32c(data), stored, len(data)]
+        return objects
+
+    def _replicated_authoritative(
+        self, pg, maps: dict, acting: list[int], oid: str, bad_shard: int
+    ) -> tuple[bytes | None, int]:
+        """Authoritative copy for a replicated repair: any replica whose
+        scrub entry is self-consistent (computed == stored digest), the
+        primary's preferred (reference: be_select_auth_object)."""
+        candidates = sorted(
+            maps,
+            key=lambda s: (acting[s] != self.id, s),  # self first
+        )
+        for s in candidates:
+            if s == bad_shard:
+                continue
+            ent = maps[s].get(oid)
+            if ent is None or (ent[1] is not None and ent[0] != ent[1]):
+                continue
+            osd = acting[s]
+            if osd == self.id:
+                try:
+                    data = self.store.read(self._cid(pg.pgid, 0), oid)
+                    return bytes(data), len(data)
+                except (NotFound, KeyError):
+                    continue
+            tid = self._next_tid()
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MECSubOpRead(tid=tid, pgid=pg.pgid, oid=oid, shard=0,
+                                 offsets=None, epoch=self.my_epoch())
+                )
+            except (OSError, ConnectionError):
+                continue
+            rep = self._wait_reply(tid, timeout=5.0)
+            if rep is not None and rep.retval == 0:
+                data = unpack_data(rep.data)
+                return data, len(data)
+        return None, 0
+
+    def _handle_scrub_shard(self, conn, msg: MScrubShard) -> None:
+        try:
+            conn.send_message(
+                MScrubShardReply(
+                    tid=msg.tid, pgid=msg.pgid, shard=msg.shard,
+                    objects=self._local_scrub_map(
+                        self._cid(msg.pgid, msg.shard)
+                    ),
+                )
+            )
+        except (OSError, ConnectionError):
+            pass
+
+    def scrub_pg(self, pool_id: int, ps: int, repair: bool = True) -> dict:
+        """Deep scrub one PG from its primary: collect every shard's
+        ScrubMap, flag shards whose at-rest bytes rotted under their own
+        digest or that miss objects others hold, and (repair=True) rebuild
+        those shards from the surviving ones (reference:
+        PrimaryLogPG::scrub_compare_maps + repair_object)."""
+        m = self.osdmap
+        pool = m.pools.get(pool_id) if m else None
+        if pool is None:
+            raise KeyError(f"no pool {pool_id}")
+        acting, primary = self._acting(pool_id, ps)
+        if primary != self.id:
+            raise RuntimeError(f"not primary for {pool_id}.{ps}")
+        pg = self._pg(pool_id, ps)
+        is_ec = pool.type == PG_POOL_ERASURE
+        codec = self._codec_for_pool(pool) if is_ec else None
+        # map collection runs UNLOCKED (writes proceed; a racing write can
+        # only produce a false positive whose "repair" re-pushes current,
+        # consistent bytes).  pg.lock is taken per-object for repairs, so
+        # a slow shard never blocks client I/O for the whole scrub.
+        maps: dict[int, dict] = {}
+        tids: dict[int, int] = {}
+        for shard, osd in enumerate(acting):
+            store_shard = shard if is_ec else 0
+            if osd < 0 or not m.is_up(osd):
+                continue
+            if osd == self.id:
+                maps[shard] = self._local_scrub_map(
+                    self._cid(pg.pgid, store_shard)
+                )
+                continue
+            tid = self._next_tid()
+            tids[tid] = shard
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MScrubShard(tid=tid, pgid=pg.pgid, shard=store_shard,
+                                epoch=self.my_epoch())
+                )
+            except (OSError, ConnectionError):
+                tids.pop(tid, None)
+        for tid, shard in tids.items():
+            rep = self._wait_reply(tid, timeout=10.0)
+            if rep is not None:
+                maps[shard] = rep.objects or {}
+
+        all_oids: set[str] = set()
+        for sm in maps.values():
+            all_oids |= set(sm)
+        # objects whose FINAL log entry is a delete: a shard still holding
+        # one is stale (its delete sub-op was lost) — flag the holder, and
+        # never let "missing" on up-to-date shards resurrect the object
+        _newest, log_deleted = pg.log.missing_since(0)
+        my_shard = next((s for s in maps if acting[s] == self.id), None)
+        errors: list[dict] = []
+        for oid in sorted(all_oids):
+            if oid in log_deleted:
+                for shard, sm in maps.items():
+                    if oid in sm:
+                        errors.append(
+                            {"oid": oid, "shard": shard,
+                             "error": "stale_deleted"}
+                        )
+                continue
+            # authoritative digest for cross-copy comparison (replicated):
+            # a SELF-CONSISTENT copy, the primary's preferred (reference:
+            # be_select_auth_object) — never a copy that fails its own
+            # digest, so primary bit-rot cannot propagate
+            auth_crc = None
+            if not is_ec:
+                order = sorted(
+                    maps, key=lambda s: (s != my_shard, s)
+                )
+                for s in order:
+                    ent = maps[s].get(oid)
+                    if ent is None:
+                        continue
+                    if ent[1] is None or ent[0] == ent[1]:
+                        auth_crc = ent[0]
+                        break
+            for shard, sm in maps.items():
+                ent = sm.get(oid)
+                if ent is None:
+                    errors.append(
+                        {"oid": oid, "shard": shard, "error": "missing"}
+                    )
+                elif ent[1] is not None and ent[0] != ent[1]:
+                    # at-rest rot under the shard's own digest (EC chunks
+                    # and, with hinfo now stamped everywhere, replicas)
+                    errors.append(
+                        {"oid": oid, "shard": shard,
+                         "error": "data_digest_mismatch"}
+                    )
+                elif (
+                    not is_ec
+                    and auth_crc is not None
+                    and ent[0] != auth_crc
+                ):
+                    errors.append(
+                        {"oid": oid, "shard": shard,
+                         "error": "data_digest_mismatch"}
+                    )
+            self.logger.inc("scrubs")
+            self.logger.inc("scrub_errors", len(errors))
+        repaired = 0
+        if repair and errors:
+            # shards known-bad per oid: their chunks must not feed a
+            # rebuild (decoding from a rotted chunk would launder the
+            # corruption into a fresh self-consistent digest)
+            bad_by_oid: dict[str, set[int]] = {}
+            for err in errors:
+                bad_by_oid.setdefault(err["oid"], set()).add(err["shard"])
+            for err in errors:
+                shard = err["shard"]
+                osd = acting[shard]
+                store_shard = shard if is_ec else 0
+                with pg.lock:  # per-object: writes proceed between repairs
+                    if err["error"] == "stale_deleted":
+                        if osd == self.id:
+                            cid = self._cid(pg.pgid, store_shard)
+                            t = Transaction()
+                            try:
+                                self.store.stat(cid, err["oid"])
+                                t.remove(cid, err["oid"])
+                                self.store.queue_transaction(t)
+                                repaired += 1
+                            except (NotFound, KeyError):
+                                pass
+                        elif self._push_sub_write(
+                            pg, osd, store_shard, err["oid"], None, None,
+                            None,
+                        ):
+                            repaired += 1
+                        continue
+                    if is_ec:
+                        chunk, size = self._rebuild_shard_chunk(
+                            pg, codec, acting, err["oid"], shard, True,
+                            exclude=bad_by_oid.get(err["oid"], set()),
+                        )
+                    else:
+                        chunk, size = self._replicated_authoritative(
+                            pg, maps, acting, err["oid"], bad_shard=shard
+                        )
+                    if chunk is None:
+                        continue
+                    if osd == self.id:
+                        cid = self._cid(pg.pgid, store_shard)
+                        t = Transaction()
+                        t.try_create_collection(cid)
+                        t.write(cid, err["oid"], 0, chunk)
+                        t.truncate(cid, err["oid"], len(chunk))
+                        t.setattr(cid, err["oid"], "hinfo",
+                                  str(crc32c(chunk)).encode())
+                        t.setattr(cid, err["oid"], "size",
+                                  str(size).encode())
+                        self.store.queue_transaction(t)
+                        repaired += 1
+                    elif self._push_sub_write(
+                        pg, osd, store_shard, err["oid"], chunk, None,
+                        [0, "modify", err["oid"], size],
+                    ):
+                        repaired += 1
+            self.logger.inc("scrub_repairs", repaired)
+        return {
+            "pgid": pg.pgid,
+            "shards": len(maps),
+            "objects": len(all_oids),
+            "errors": errors,
+            "repaired": repaired if repair else 0,
+        }
+
     # -- heartbeats + recovery tick ---------------------------------------
     def _tick_loop(self) -> None:
         interval = 1.0
@@ -861,9 +1181,54 @@ class OSD(Dispatcher):
                 if now - last_mgr >= self.cct.conf.get("mgr_report_interval"):
                     last_mgr = now
                     self._mgr_report()
-                self._recover_all()
+                # recovery rides the mClock queue as background work so
+                # client ops keep their reservation during big recoveries
+                if not self._recovery_inflight:
+                    self._recovery_inflight = True
+                    self.scheduler.enqueue(
+                        "background_recovery", self._recover_all_work
+                    )
+                self._maybe_schedule_scrub(now)
             except Exception as e:
                 self.cct.dout("osd", 0, f"{self.whoami} tick failed: {e!r}")
+
+    def _recover_all_work(self) -> None:
+        try:
+            self._recover_all()
+        finally:
+            self._recovery_inflight = False
+
+    def _maybe_schedule_scrub(self, now: float) -> None:
+        """Periodic deep scrub of primary PGs (reference: OSD::sched_scrub;
+        osd_deep_scrub_interval 0 disables — tests drive scrub_pg
+        directly)."""
+        interval = self.cct.conf.get("osd_deep_scrub_interval")
+        if not interval or now - self._last_scrub < interval:
+            return
+        self._last_scrub = now
+        m = self.osdmap
+        if m is None:
+            return
+        for pool_id, pool in m.pools.items():
+            for ps in range(pool.pg_num):
+                try:
+                    _acting, primary = self._acting(pool_id, ps)
+                except KeyError:
+                    continue
+                if primary != self.id:
+                    continue
+                pgid = f"{pool_id}.{ps}"
+                if pgid in self._scrubs_queued:
+                    continue  # scrubs outlasting the interval must not pile
+                self._scrubs_queued.add(pgid)
+
+                def scrub_work(pid=pool_id, s=ps, key=pgid):
+                    try:
+                        self.scrub_pg(pid, s)
+                    finally:
+                        self._scrubs_queued.discard(key)
+
+                self.scheduler.enqueue("background_scrub", scrub_work)
 
     def _mgr_report(self) -> None:
         """Stream a perf snapshot to the mgr (reference: MgrClient sending
@@ -1117,10 +1482,13 @@ class OSD(Dispatcher):
             pass
 
     def _rebuild_shard_chunk(
-        self, pg, codec, acting, oid: str, shard: int, is_ec: bool
+        self, pg, codec, acting, oid: str, shard: int, is_ec: bool,
+        exclude: set[int] | None = None,
     ) -> tuple[bytes | None, int]:
         """Recompute shard `shard`'s bytes for oid (reference:
-        ECBackend::recover_object — read k chunks, re-encode)."""
+        ECBackend::recover_object — read k chunks, re-encode).  `exclude`
+        names additional shards whose data must not feed the rebuild
+        (scrub-flagged rot)."""
         my_shard = acting.index(self.id)
         if not is_ec:
             try:
@@ -1130,14 +1498,18 @@ class OSD(Dispatcher):
                 return None, 0
         k = codec.get_data_chunk_count()
         n = codec.get_chunk_count()
+        want = set(range(n)) - {shard} - (exclude or set())
+        sizes: dict[int, int] = {}
+        got = self._gather_chunks(pg, codec, acting, oid, want, sizes=sizes)
+        if len(got) < k:
+            return None, 0
         try:
             size = int(self.store.getattr(
                 self._cid(pg.pgid, my_shard), oid, "size"))
-        except (NotFound, KeyError):
-            size = 0
-        got = self._gather_chunks(pg, codec, acting, oid, set(range(n)) - {shard})
-        if len(got) < k:
-            return None, 0
+        except (NotFound, KeyError, ValueError):
+            # our own xattr is gone (we may be the shard being repaired):
+            # any healthy peer's size xattr is authoritative
+            size = next(iter(sizes.values()), 0)
         chunks = {s: np.frombuffer(b, np.uint8) for s, b in got.items()}
         dec = codec.decode(
             {shard}, chunks, len(next(iter(chunks.values())))

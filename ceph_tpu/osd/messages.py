@@ -106,3 +106,22 @@ class MOSDPingMsg(_JsonMessage):
 
     MSG_TYPE = 70
     FIELDS = ("op", "osd", "epoch")
+
+
+@register_message
+class MScrubShard(_JsonMessage):
+    """Primary → shard OSD: report your digests for a PG shard
+    (reference: MOSDRepScrub requesting a ScrubMap)."""
+
+    MSG_TYPE = 114
+    FIELDS = ("tid", "pgid", "shard", "epoch")
+
+
+@register_message
+class MScrubShardReply(_JsonMessage):
+    """Shard ScrubMap: oid -> [computed_crc, stored_crc_or_null, size]
+    (reference: ScrubMap::object digests; stored != computed means the
+    shard's at-rest data rotted under its own hinfo)."""
+
+    MSG_TYPE = 115
+    FIELDS = ("tid", "pgid", "shard", "objects")

@@ -1,0 +1,139 @@
+"""mClock op scheduler — QoS-tagged dispatch (reference:
+src/osd/scheduler/mClockScheduler.{h,cc} wrapping the dmclock library;
+SURVEY.md §2.3).
+
+Each op class holds (reservation, weight, limit) in ops/sec.  Ops get
+three tags at enqueue:
+
+    R = max(now, prev_R + 1/reservation)   # guaranteed minimum
+    L = max(now, prev_L + 1/limit)         # hard ceiling
+    P = max(prev_P, now) + 1/weight        # proportional share
+
+Dequeue (mClock's two phases): first any class whose R tag is due — pick
+the earliest R (reservations are guarantees, served before everything);
+otherwise among classes whose L tag is due pick the earliest P tag
+(weighted fair sharing under the ceiling).  If nothing is eligible the
+caller sleeps until the earliest tag matures.
+
+The OSD instantiates the reference's three classes — client,
+background_recovery, background_scrub — so client I/O keeps its floor
+while recovery/scrub make progress without starving it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class QoSParams:
+    """reference: dmclock ClientInfo (reservation, weight, limit);
+    0 = none (no floor / no ceiling)."""
+
+    reservation: float = 0.0
+    weight: float = 1.0
+    limit: float = 0.0
+
+
+@dataclass
+class _ClassState:
+    params: QoSParams
+    queue: list = field(default_factory=list)  # FIFO of (seq, item)
+    r_tag: float = 0.0
+    p_tag: float = 0.0
+    l_tag: float = 0.0
+
+
+class MClockScheduler:
+    def __init__(self, classes: dict[str, QoSParams],
+                 clock=time.monotonic):
+        self._classes = {
+            name: _ClassState(params) for name, params in classes.items()
+        }
+        self._clock = clock
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._stopped = False
+
+    # -- producer ----------------------------------------------------------
+    def enqueue(self, cls: str, item) -> None:
+        now = self._clock()
+        with self._lock:
+            st = self._classes[cls]
+            empty = not st.queue
+            self._seq += 1
+            st.queue.append((self._seq, item))
+            if empty:
+                # tags advance per dequeue; a class going idle resets its
+                # cadence to "now" (dmclock's idle-client tag reset)
+                p = st.params
+                if p.reservation:
+                    st.r_tag = max(st.r_tag, now)
+                if p.limit:
+                    st.l_tag = max(st.l_tag, now)
+                st.p_tag = max(st.p_tag, now)
+            self._cond.notify()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            self._cond.notify_all()
+
+    # -- consumer ----------------------------------------------------------
+    def _pick_locked(self, now: float):
+        """(cls, item) of the next eligible op, or (None, wake_at)."""
+        best_r = None  # (r_tag, name)
+        best_p = None  # (p_tag, name)
+        wake = None
+        for name, st in self._classes.items():
+            if not st.queue:
+                continue
+            p = st.params
+            if p.reservation and st.r_tag <= now:
+                if best_r is None or st.r_tag < best_r[0]:
+                    best_r = (st.r_tag, name)
+                continue  # reservation-phase candidates skip P
+            if p.limit and st.l_tag > now:
+                wake = st.l_tag if wake is None else min(wake, st.l_tag)
+                continue
+            if best_p is None or st.p_tag < best_p[0]:
+                best_p = (st.p_tag, name)
+            if p.reservation:
+                wake = st.r_tag if wake is None else min(wake, st.r_tag)
+        name = best_r[1] if best_r is not None else (
+            best_p[1] if best_p is not None else None
+        )
+        if name is None:
+            return None, wake
+        st = self._classes[name]
+        _, item = st.queue.pop(0)
+        p = st.params
+        if p.reservation:
+            st.r_tag = max(now, st.r_tag) + 1.0 / p.reservation
+        if p.limit:
+            st.l_tag = max(now, st.l_tag) + 1.0 / p.limit
+        st.p_tag = max(now, st.p_tag) + 1.0 / p.weight
+        return (name, item), None
+
+    def dequeue(self, timeout: float | None = None):
+        """Blocking pop -> (class, item) or None on stop/timeout."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._lock:
+            while not self._stopped:
+                picked, wake = self._pick_locked(self._clock())
+                if picked is not None:
+                    return picked
+                now = self._clock()
+                waits = [w - now for w in (wake,) if w is not None]
+                if deadline is not None:
+                    if now >= deadline:
+                        return None
+                    waits.append(deadline - now)
+                self._cond.wait(timeout=min(waits) if waits else None)
+            return None
+
+    def qlen(self) -> int:
+        with self._lock:
+            return sum(len(st.queue) for st in self._classes.values())
