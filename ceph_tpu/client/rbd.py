@@ -1,0 +1,145 @@
+"""RBD-analog block images over the striper (reference: src/librbd —
+librbd::Image create/open/read/write/resize/remove over striped RADOS
+objects; SURVEY.md §2.6 gateways).
+
+Scope vs the reference, stated plainly: the data path (an image = a
+header object + data striped over `{id}.<objectno>` objects) matches
+librbd's native format at the block level; snapshots, clones, journaling,
+mirroring, and the kernel client are mon/feature machinery this analog
+does not carry.
+
+    rbd = RBD(ioctx)
+    rbd.create("vol1", size=1 << 30)
+    with rbd.open("vol1") as img:
+        img.write(b"...", off)
+        img.read(off, length)
+        img.resize(2 << 30)
+"""
+from __future__ import annotations
+
+import json
+
+from .striper import StripedObject, StripePolicy
+
+_HEADER_SUFFIX = ".rbd_header"
+
+
+class ImageExists(IOError):
+    pass
+
+
+class ImageNotFound(IOError):
+    pass
+
+
+class Image:
+    """An open image handle (reference: librbd::Image)."""
+
+    def __init__(self, io, name: str, header: dict):
+        self._io = io
+        self.name = name
+        self._header = header
+        self._data = StripedObject(
+            io, header["block_name_prefix"],
+            StripePolicy(
+                object_size=1 << header["order"],
+                stripe_unit=header["stripe_unit"],
+                stripe_count=header["stripe_count"],
+            ),
+        )
+
+    # -- metadata -----------------------------------------------------------
+    def size(self) -> int:
+        return self._header["size"]
+
+    def stat(self) -> dict:
+        return dict(self._header)
+
+    # -- I/O ------------------------------------------------------------—--
+    def read(self, off: int, length: int) -> bytes:
+        if off >= self.size():
+            return b""
+        length = min(length, self.size() - off)
+        data = self._data.read(off, length)
+        # unwritten ranges inside the image read as zeros (thin provision)
+        return data + b"\0" * (length - len(data))
+
+    def write(self, data: bytes, off: int) -> int:
+        if off + len(data) > self.size():
+            raise IOError(
+                f"write past end of image ({off + len(data)} > {self.size()})"
+            )
+        self._data.write(data, off)
+        return len(data)
+
+    def resize(self, size: int) -> None:
+        if size < self.size():
+            self._data.truncate(size)
+        self._header["size"] = size
+        self._io.write_full(
+            self.name + _HEADER_SUFFIX, json.dumps(self._header).encode()
+        )
+
+    def flush(self) -> None:  # writes are synchronous; parity of API
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "Image":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class RBD:
+    """Image administration (reference: librbd::RBD)."""
+
+    def __init__(self, io):
+        self._io = io
+
+    def create(self, name: str, size: int, order: int = 22,
+               stripe_unit: int | None = None, stripe_count: int = 1) -> None:
+        """order: log2 of the object size, default 4 MiB objects — the
+        reference's default layout."""
+        hdr_oid = name + _HEADER_SUFFIX
+        try:
+            self._io.read(hdr_oid)
+            raise ImageExists(f"image {name!r} exists")
+        except ImageExists:
+            raise
+        except IOError:
+            pass
+        object_size = 1 << order
+        su = stripe_unit or object_size
+        StripePolicy(object_size=object_size, stripe_unit=su,
+                     stripe_count=stripe_count)  # validate layout
+        header = {
+            "name": name,
+            "size": int(size),
+            "order": order,
+            "stripe_unit": su,
+            "stripe_count": stripe_count,
+            "block_name_prefix": f"rbd_data.{name}",
+        }
+        self._io.write_full(hdr_oid, json.dumps(header).encode())
+
+    def open(self, name: str) -> Image:
+        try:
+            raw = self._io.read(name + _HEADER_SUFFIX)
+        except IOError as e:
+            raise ImageNotFound(f"no image {name!r}") from e
+        return Image(self._io, name, json.loads(raw))
+
+    def list(self) -> list[str]:
+        out = []
+        for oid in self._io.list_objects():
+            if oid.endswith(_HEADER_SUFFIX):
+                out.append(oid[: -len(_HEADER_SUFFIX)])
+        return sorted(out)
+
+    def remove(self, name: str) -> None:
+        img = self.open(name)
+        img._data.remove()
+        self._io.remove(name + _HEADER_SUFFIX)

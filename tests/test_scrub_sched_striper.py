@@ -310,3 +310,80 @@ def test_striped_io_over_cluster(scrub_cluster):
     s.write(b"PATCH", 12345)
     expect = data[:12345] + b"PATCH" + data[12350:]
     assert s.read() == expect
+
+
+class TestRBD:
+    """RBD-analog images (reference: src/librbd data path)."""
+
+    def _rbd(self):
+        from ceph_tpu.client.rbd import RBD
+
+        io = _DictIo()
+        io.list_objects = lambda: sorted(io.objs)
+        return RBD(io), io
+
+    def test_create_open_io(self):
+        rbd, io = self._rbd()
+        rbd.create("vol", size=1 << 20, order=16)
+        assert rbd.list() == ["vol"]
+        with rbd.open("vol") as img:
+            assert img.size() == 1 << 20
+            img.write(b"BLOCKDATA" * 100, 4096)
+            assert img.read(4096, 900) == (b"BLOCKDATA" * 100)[:900]
+            assert img.read(0, 16) == b"\0" * 16  # thin-provisioned zeros
+
+    def test_create_collision_and_missing(self):
+        import pytest as _pytest
+
+        from ceph_tpu.client.rbd import ImageExists, ImageNotFound
+
+        rbd, _ = self._rbd()
+        rbd.create("vol", size=4096, order=12)
+        with _pytest.raises(ImageExists):
+            rbd.create("vol", size=4096)
+        with _pytest.raises(ImageNotFound):
+            rbd.open("nope")
+
+    def test_bounds_and_resize(self):
+        import pytest as _pytest
+
+        rbd, _ = self._rbd()
+        rbd.create("vol", size=8192, order=12)
+        img = rbd.open("vol")
+        with _pytest.raises(IOError):
+            img.write(b"x" * 100, 8150)  # past the end
+        img.resize(16384)
+        img.write(b"grown", 9000)
+        img2 = rbd.open("vol")  # header persisted
+        assert img2.size() == 16384
+        assert img2.read(9000, 5) == b"grown"
+        img2.resize(4096)  # shrink drops tail
+        assert rbd.open("vol").read(9000, 5) == b""
+
+    def test_remove(self):
+        rbd, io = self._rbd()
+        rbd.create("vol", size=1 << 16, order=12)
+        with rbd.open("vol") as img:
+            img.write(b"z" * 30000, 0)
+        rbd.remove("vol")
+        assert rbd.list() == []
+        assert not io.objs
+
+
+@pytest.mark.cluster
+def test_rbd_image_over_cluster(scrub_cluster):
+    from ceph_tpu.client.rbd import RBD
+
+    c = scrub_cluster
+    io = c.client().open_ioctx("scrubec")
+    rbd = RBD(io)
+    rbd.create("disk0", size=1 << 22, order=16, stripe_unit=4096,
+               stripe_count=4)
+    with rbd.open("disk0") as img:
+        block = bytes((i * 13) & 0xFF for i in range(65536))
+        img.write(block, 123456)
+        assert img.read(123456, 65536) == block
+        assert img.read(0, 512) == b"\0" * 512
+    assert "disk0" in rbd.list()
+    rbd.remove("disk0")
+    assert "disk0" not in rbd.list()
