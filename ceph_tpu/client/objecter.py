@@ -103,10 +103,17 @@ class Objecter(Dispatcher):
                 self._outstanding.add(tid)
             try:
                 conn = self.messenger.connect(addr)
+                # bytes payloads ride base64; structured payloads (xattr
+                # update maps) ride as-is in the JSON body
+                wire_data = (
+                    pack_data(data)
+                    if isinstance(data, (bytes, bytearray, memoryview))
+                    else data
+                )
                 conn.send_message(
                     MOSDOp(
                         tid=tid, pool=pool_id, oid=oid, op=op,
-                        data=pack_data(data) if data is not None else None,
+                        data=wire_data,
                         epoch=m.epoch if m else 0, off=off, length=length,
                     )
                 )

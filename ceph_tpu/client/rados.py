@@ -51,6 +51,40 @@ class IoCtx:
             raise IOError(f"stat {oid!r}: {rep.retval} {rep.result}")
         return rep.result
 
+    def set_xattr(self, oid: str, name: str, value: bytes) -> None:
+        """reference: rados_setxattr."""
+        from ..osd.messages import pack_data
+
+        rep = self._client.objecter.op_submit(
+            self.pool_id, oid, "setxattr",
+            data={name: pack_data(bytes(value))},
+        )
+        if rep.retval != 0:
+            raise IOError(f"setxattr {oid!r}: {rep.retval} {rep.result}")
+
+    def rm_xattr(self, oid: str, name: str) -> None:
+        """reference: rados_rmxattr."""
+        rep = self._client.objecter.op_submit(
+            self.pool_id, oid, "setxattr", data={name: None}
+        )
+        if rep.retval != 0:
+            raise IOError(f"rm_xattr {oid!r}: {rep.retval} {rep.result}")
+
+    def get_xattrs(self, oid: str) -> dict[str, bytes]:
+        """reference: rados_getxattrs."""
+        rep = self._client.objecter.op_submit(self.pool_id, oid, "getxattrs")
+        if rep.retval != 0:
+            raise IOError(f"getxattrs {oid!r}: {rep.retval} {rep.result}")
+        return {
+            k: unpack_data(v) for k, v in (rep.result or {}).items()
+        }
+
+    def get_xattr(self, oid: str, name: str) -> bytes:
+        attrs = self.get_xattrs(oid)
+        if name not in attrs:
+            raise KeyError(name)
+        return attrs[name]
+
     def scrub_pg(self, ps: int) -> dict:
         """Deep-scrub one PG on its primary; returns the scrub report
         (reference: `ceph pg deep-scrub` reaching the primary)."""

@@ -497,8 +497,157 @@ class OSD(Dispatcher):
             )
             return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
                                result={"oids": oids})
+        if msg.op in ("setxattr", "getxattrs"):
+            return self._xattr_op(pg, acting, my_shard, msg)
         return MOSDOpReply(tid=msg.tid, retval=-22, epoch=self.my_epoch(),
                            result=f"bad op {msg.op}")
+
+    # .. user xattrs (both pool types) .....................................
+    def _xattr_op(self, pg, acting, my_shard, msg) -> MOSDOpReply:
+        """librados xattr surface (reference: rados_setxattr/getxattrs).
+        User attrs live as `u_<name>` on every shard so any future primary
+        answers; updates append a pg_log entry so recovery replays them."""
+        cid = self._cid(pg.pgid, my_shard)
+        if msg.op == "getxattrs":
+            try:
+                attrs = {
+                    n[2:]: pack_data(v)
+                    for n, v in self.store.getattrs(cid, msg.oid).items()
+                    if n.startswith("u_")
+                }
+            except (NotFound, KeyError):
+                # degraded primary (remap before recovery): any shard that
+                # holds the object carries the same user xattrs
+                attrs = self._probe_peer_xattrs(pg, acting, msg.oid)
+                if attrs is None:
+                    return MOSDOpReply(
+                        tid=msg.tid, retval=-2, epoch=self.my_epoch(),
+                        result="not found",
+                    )
+            return MOSDOpReply(
+                tid=msg.tid, retval=0, epoch=self.my_epoch(), result=attrs
+            )
+        updates = msg.data or {}
+        pool = self.osdmap.pools.get(pg.pool_id)
+        with pg.lock:
+            try:
+                self.store.stat(cid, msg.oid)
+            except (NotFound, KeyError):
+                # no local copy: object missing cluster-wide (-2, final)
+                # vs degraded primary pending recovery (-11, retryable)
+                if self._probe_peer_xattrs(pg, acting, msg.oid) is None:
+                    return MOSDOpReply(
+                        tid=msg.tid, retval=-2, epoch=self.my_epoch(),
+                        result="not found",
+                    )
+                return MOSDOpReply(
+                    tid=msg.tid, retval=-11, epoch=self.my_epoch(),
+                    result="object not recovered here yet",
+                )
+            version = pg.version + 1
+            entry = LogEntry(version, "modify", msg.oid)
+            tids: dict[int, int] = {}
+            for shard, osd in enumerate(acting):
+                if osd == self.id or osd < 0 or not self.osdmap.is_up(osd):
+                    continue
+                tid = self._next_tid()
+                tids[tid] = shard
+                try:
+                    self._conn_to_osd(osd).send_message(
+                        MECSubOpWrite(
+                            tid=tid, pgid=pg.pgid, oid=msg.oid,
+                            shard=shard if self._is_ec_pg(pg) else 0,
+                            data=None, crc=None, version=version,
+                            entry=entry.to_list(), epoch=self.my_epoch(),
+                            xattrs=updates,
+                        )
+                    )
+                except (OSError, ConnectionError):
+                    tids.pop(tid, None)
+            t = Transaction()
+            self._apply_xattr_updates(t, cid, msg.oid, updates)
+            self._log_txn(t, cid, pg, entry)
+            self.store.queue_transaction(t)
+            acked = 1
+            for tid in tids:
+                rep = self._wait_reply(tid)
+                if rep is not None and rep.retval == 0:
+                    acked += 1
+        # same durability bar as write_full: the update must be on enough
+        # shards to survive (reference: xattr ops ride the same repop)
+        if pool is not None and acked < pool.min_size:
+            return MOSDOpReply(tid=msg.tid, retval=-11,
+                               epoch=self.my_epoch(),
+                               result=f"only {acked} shard commits")
+        return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                           result={"version": pg.version})
+
+    def _apply_xattr_updates(self, t: Transaction, cid: str, oid: str,
+                             updates: dict, snapshot: bool = False) -> None:
+        """Apply user-xattr updates {name: b64|None} to a transaction;
+        snapshot=True means `updates` is the complete set (recovery) and
+        any other u_* attr must go."""
+        try:
+            existing = {
+                n[2:] for n in self.store.getattrs(cid, oid)
+                if n.startswith("u_")
+            }
+        except (NotFound, KeyError):
+            existing = set()
+        for name, val in updates.items():
+            if val is None:
+                if name in existing:
+                    t.rmattr(cid, oid, f"u_{name}")
+            else:
+                t.setattr(cid, oid, f"u_{name}", unpack_data(val))
+        if snapshot:
+            for name in existing - set(updates):
+                t.rmattr(cid, oid, f"u_{name}")
+
+    def _probe_peer_xattrs(self, pg, acting, oid: str) -> dict | None:
+        """User xattrs for oid from the FRESHEST up shard (degraded
+        getxattrs).  Peers are ordered by their pg_log version so a
+        just-revived stale shard cannot answer with pre-update attrs;
+        metadata-only reads (offsets=[]) keep the object body off the
+        wire."""
+        is_ec = self._is_ec_pg(pg)
+        peers = []  # (version, shard, osd)
+        for shard, osd in enumerate(acting):
+            if osd < 0 or osd == self.id or not self.osdmap.is_up(osd):
+                continue
+            tid = self._next_tid()
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MPGQuery(tid=tid, pgid=pg.pgid,
+                             shard=shard if is_ec else 0,
+                             epoch=self.my_epoch())
+                )
+            except (OSError, ConnectionError):
+                continue
+            rep = self._wait_reply(tid, timeout=5.0)
+            peers.append(
+                ((rep.version if rep is not None else 0) or 0, shard, osd)
+            )
+        for _v, shard, osd in sorted(peers, reverse=True):
+            tid = self._next_tid()
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MECSubOpRead(
+                        tid=tid, pgid=pg.pgid, oid=oid,
+                        shard=shard if is_ec else 0,
+                        offsets=[], epoch=self.my_epoch(),
+                    )
+                )
+            except (OSError, ConnectionError):
+                continue
+            rep = self._wait_reply(tid, timeout=5.0)
+            if rep is not None and rep.retval == 0:
+                return rep.xattrs or {}
+        return None
+
+    def _is_ec_pg(self, pg) -> bool:
+        pool = self.osdmap.pools.get(pg.pool_id) if self.osdmap else None
+        return bool(pool and pool.type == PG_POOL_ERASURE)
 
     def _ec_write(self, pg, pool, codec, acting, my_shard, msg, data) -> MOSDOpReply:
         n = codec.get_chunk_count()
@@ -800,6 +949,8 @@ class OSD(Dispatcher):
             )
             return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
                                result={"oids": oids})
+        if msg.op in ("setxattr", "getxattrs"):
+            return self._xattr_op(pg, acting, 0, msg)
         return MOSDOpReply(tid=msg.tid, retval=-22, epoch=self.my_epoch(),
                            result=f"bad op {msg.op}")
 
@@ -824,7 +975,7 @@ class OSD(Dispatcher):
                     if msg.entry and len(msg.entry) > 3:
                         t.setattr(cid, msg.oid, "size",
                                   str(msg.entry[3]).encode())
-                elif entry_op in (None, "delete"):
+                elif entry_op in (None, "delete") and not msg.xattrs:
                     # data-less delete (live op or recovery replay)
                     try:
                         self.store.stat(cid, msg.oid)
@@ -832,7 +983,28 @@ class OSD(Dispatcher):
                     except (NotFound, KeyError):
                         pass
                 # else: entry-only push ("modify" log replay / "clean"
-                # seal) — log bookkeeping below, no data op
+                # seal / xattr-only update) — no data op
+                if msg.xattrs is not None:
+                    if msg.data is not None:
+                        # riding a data push (recovery): the dict is a FULL
+                        # snapshot — stale attrs a removal we missed must
+                        # not survive
+                        self._apply_xattr_updates(
+                            t, cid, msg.oid, msg.xattrs, snapshot=True
+                        )
+                    else:
+                        # live xattr-only update: apply ONLY if this shard
+                        # holds the object; a shard that missed the write
+                        # must not grow a phantom zero-length object
+                        # (recovery pushes data + attrs together later)
+                        try:
+                            self.store.stat(cid, msg.oid)
+                        except (NotFound, KeyError):
+                            pass
+                        else:
+                            self._apply_xattr_updates(
+                                t, cid, msg.oid, msg.xattrs
+                            )
                 if (
                     msg.entry is not None
                     and msg.version is not None
@@ -871,7 +1043,11 @@ class OSD(Dispatcher):
     def _handle_sub_read(self, conn, msg: MECSubOpRead) -> None:
         cid = self._cid(msg.pgid, msg.shard)
         try:
-            if msg.offsets:
+            if msg.offsets == []:
+                # metadata-only probe: existence + size/xattrs, no body
+                self.store.stat(cid, msg.oid)
+                data = b""
+            elif msg.offsets:
                 parts = []
                 for off, ln in msg.offsets:
                     if ln == -1:
@@ -885,14 +1061,22 @@ class OSD(Dispatcher):
                 size = int(self.store.getattr(cid, msg.oid, "size"))
             except (NotFound, KeyError):
                 size = None
+            try:
+                user = {
+                    n[2:]: pack_data(v)
+                    for n, v in self.store.getattrs(cid, msg.oid).items()
+                    if n.startswith("u_")
+                }
+            except (NotFound, KeyError):
+                user = None
             reply = MECSubOpReadReply(
                 tid=msg.tid, pgid=msg.pgid, oid=msg.oid, shard=msg.shard,
-                retval=0, data=pack_data(data), size=size,
+                retval=0, data=pack_data(data), size=size, xattrs=user,
             )
         except (NotFound, KeyError):
             reply = MECSubOpReadReply(
                 tid=msg.tid, pgid=msg.pgid, oid=msg.oid, shard=msg.shard,
-                retval=-2, data=None, size=None,
+                retval=-2, data=None, size=None, xattrs=None,
             )
         try:
             conn.send_message(reply)
@@ -1382,8 +1566,24 @@ class OSD(Dispatcher):
                     self._bump_peer_version(pg, store_shard, osd, pg.version)
                     pg.stat_backfills = getattr(pg, "stat_backfills", 0) + 1
 
-    def _push_sub_write(self, pg, osd, shard, oid, data, version, entry) -> bool:
-        """One recovery push; True iff the peer acked it (retval 0)."""
+    def _push_sub_write(self, pg, osd, shard, oid, data, version, entry,
+                        src_cid: str | None = None) -> bool:
+        """One recovery push; True iff the peer acked it (retval 0).
+        Data pushes copy the object's user xattrs from `src_cid` (the
+        primary's own shard collection) so a recovered shard can answer
+        getxattrs after a primary move."""
+        xattrs = None
+        if data is not None and src_cid is not None:
+            try:
+                mine = self.store.getattrs(src_cid, oid)
+            except (NotFound, KeyError):
+                mine = {}
+            # always a dict (may be empty): the receiver treats it as the
+            # FULL snapshot, clearing stale attrs a removal left behind
+            xattrs = {
+                n[2:]: pack_data(v)
+                for n, v in mine.items() if n.startswith("u_")
+            }
         tid = self._next_tid()
         try:
             self._conn_to_osd(osd).send_message(
@@ -1392,6 +1592,7 @@ class OSD(Dispatcher):
                     data=pack_data(data) if data is not None else None,
                     crc=crc32c(data) if data is not None else None,
                     version=version, entry=entry, epoch=self.my_epoch(),
+                    xattrs=xattrs,
                 )
             )
         except (OSError, ConnectionError):
@@ -1411,6 +1612,9 @@ class OSD(Dispatcher):
         if every push acked, so the caller never marks the peer clean past
         data it does not hold."""
         newest, _deleted = pg.log.missing_since(peer_version)
+        my_cid = self._cid(
+            pg.pgid, acting.index(self.id) if is_ec else 0
+        )
         for e in pg.log.entries_since(peer_version):
             if e.op == "delete":
                 ok = self._push_sub_write(
@@ -1424,7 +1628,7 @@ class OSD(Dispatcher):
                     return False  # unreadable right now: retry next tick
                 ok = self._push_sub_write(
                     pg, osd, shard, e.oid, chunk, e.version,
-                    e.to_list() + [size],
+                    e.to_list() + [size], src_cid=my_cid,
                 )
                 self.logger.inc("recovery_ops")
             else:
@@ -1447,6 +1651,9 @@ class OSD(Dispatcher):
         for oid in sorted(deleted):
             if not self._push_sub_write(pg, osd, shard, oid, None, None, None):
                 return False
+        my_cid = self._cid(
+            pg.pgid, acting.index(self.id) if is_ec else 0
+        )
         all_ok = True
         for oid in sorted(newest, key=lambda o: (newest[o] or 0, o)):
             chunk, size = self._rebuild_shard_chunk(
@@ -1458,7 +1665,7 @@ class OSD(Dispatcher):
             version = newest[oid]
             entry = [version or 0, "modify", oid, size]
             if not self._push_sub_write(
-                pg, osd, shard, oid, chunk, version, entry
+                pg, osd, shard, oid, chunk, version, entry, src_cid=my_cid
             ):
                 all_ok = False
         return all_ok

@@ -49,11 +49,13 @@ class MECSubOpWrite(_JsonMessage):
     carrying ECSubWrite: tid, shard transactions, log entries).
 
     `entry` is the pg_log entry [version, op, oid] the shard must append
-    atomically with the chunk write (delta-recovery bookkeeping)."""
+    atomically with the chunk write (delta-recovery bookkeeping).
+    `xattrs` carries user-xattr updates {name: b64 | null-to-remove},
+    applied in the same transaction (librados xattr replication)."""
 
     MSG_TYPE = 108
     FIELDS = ("tid", "pgid", "oid", "shard", "data", "crc", "version",
-              "entry", "epoch")
+              "entry", "epoch", "xattrs")
 
 
 @register_message
@@ -74,10 +76,12 @@ class MECSubOpRead(_JsonMessage):
 @register_message
 class MECSubOpReadReply(_JsonMessage):
     """`size` echoes the shard's stored object-size xattr so a primary
-    without its own shard copy can still strip stripe padding."""
+    without its own shard copy can still strip stripe padding; `xattrs`
+    echoes the user xattrs for the same degraded-primary case."""
 
     MSG_TYPE = 111
-    FIELDS = ("tid", "pgid", "oid", "shard", "retval", "data", "size")
+    FIELDS = ("tid", "pgid", "oid", "shard", "retval", "data", "size",
+              "xattrs")
 
 
 @register_message

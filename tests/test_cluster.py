@@ -284,3 +284,47 @@ def test_osd_restart_persists_pg_state():
         c.wait_clean("ec", timeout=60)
         for oid, data in blobs.items():
             assert io.read(oid) == data
+
+
+def test_user_xattrs(cluster, client):
+    """librados xattr surface: set/get/rm, replicated to shards
+    (reference: rados_setxattr/getxattrs).  Non-destructive half; the
+    primary-kill half builds its own cluster below."""
+    io = client.open_ioctx("ecpool")
+    io.write_full("attrobj", b"body" * 300)
+    io.set_xattr("attrobj", "owner", b"alice")
+    io.set_xattr("attrobj", "tag", b"\x00\xffbinary")
+    assert io.get_xattrs("attrobj") == {
+        "owner": b"alice", "tag": b"\x00\xffbinary"
+    }
+    io.set_xattr("attrobj", "owner", b"bob")  # overwrite
+    assert io.get_xattr("attrobj", "owner") == b"bob"
+    io.rm_xattr("attrobj", "tag")
+    assert io.get_xattrs("attrobj") == {"owner": b"bob"}
+    with pytest.raises(IOError):
+        io.set_xattr("no-such-object", "x", b"y")
+
+
+def test_user_xattrs_survive_primary_change():
+    """Every shard carries user xattrs, so a remapped primary still
+    serves them (and a removal never resurrects through recovery)."""
+    from ceph_tpu.osd.osdmap import object_ps
+
+    with LocalCluster(n_mons=1, n_osds=6) as c:
+        c.create_ec_pool("ec", k=4, m=2)
+        io = c.client().open_ioctx("ec")
+        io.write_full("attrobj", b"body" * 300)
+        io.set_xattr("attrobj", "owner", b"bob")
+        io.set_xattr("attrobj", "gone", b"soon")
+        io.rm_xattr("attrobj", "gone")
+        m = c._leader().osdmon.osdmap
+        pid = next(i for i, p in m.pools.items() if p.name == "ec")
+        ps = object_ps("attrobj", m.pools[pid].pg_num)
+        _up, _upp, _acting, primary = m.pg_to_up_acting_osds(pid, ps)
+        c.kill_osd(primary)
+        c.mark_osd_down_out(primary)
+        assert io.get_xattrs("attrobj") == {"owner": b"bob"}
+        c.revive_osd(primary)
+        c.mark_osd_in_up(primary)
+        c.wait_clean("ec", timeout=60)
+        assert io.get_xattrs("attrobj") == {"owner": b"bob"}
