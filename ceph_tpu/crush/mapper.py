@@ -200,16 +200,16 @@ def compile_plan(cm: CompiledCrushMap, rule_id: int, numrep: int) -> list[dict]:
 
 
 def compile_rule(cm: CompiledCrushMap, rule_id: int, numrep: int) -> dict:
-    """Single-choose plan (the C++ oracle bridge's wire format); raises on
-    multi-choose chains, which only the JAX and scalar mappers interpret."""
+    """Single-choose plan (the C++ oracle bridge's fast path); raises on
+    anything but the canonical TAKE-CHOOSE-EMIT shape — multi-choose
+    chains and EMIT-less rules go through the step interpreter."""
     steps = compile_plan(cm, rule_id, numrep)
-    chooses = [p for p in steps if p["op"] == "choose"]
-    takes = [p for p in steps if p["op"] == "take"]
-    if len(chooses) != 1 or len(takes) != 1:
+    ops = [p["op"] for p in steps]
+    if ops != ["take", "choose", "emit"]:
         raise NotImplementedError(
-            "the C++ oracle speaks single-TAKE single-CHOOSE plans only"
+            "the C++ oracle fast path speaks TAKE-CHOOSE-EMIT only"
         )
-    return dict(takes[0], **chooses[0])
+    return dict(steps[0], **steps[1])
 
 
 def _firstn_compact(work: jnp.ndarray) -> jnp.ndarray:
@@ -271,8 +271,10 @@ def _build_rule_fn(cm: CompiledCrushMap, rule_id: int, numrep: int,
                 if work is not None:
                     emitted.append(work)
                 work = None
-        if work is not None:  # tolerate a missing trailing EMIT
-            emitted.append(work)
+        # un-emitted working items are DROPPED, like crush_do_rule (the
+        # scalar mapper agrees; a rule without EMIT maps to nothing)
+        if not emitted:
+            return jnp.full((N, numrep), ITEM_NONE, jnp.int32)
         result = emitted[0] if len(emitted) == 1 else jnp.concatenate(
             emitted, axis=1
         )

@@ -13,7 +13,7 @@ import numpy as np
 
 from ..native_oracle import _lib
 from .mapper import CompiledCrushMap, compile_rule
-from .types import CrushMap
+from .types import CrushMap, ITEM_NONE
 
 _i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
 _i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
@@ -31,6 +31,13 @@ def _crush_lib() -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_int, _i32p,
     ]
     lib.cro_do_rule_batch.restype = ctypes.c_int
+    lib.cro_do_rule_steps.argtypes = [
+        _i32p, _i64p, _i32p, _i32p,
+        ctypes.c_int, ctypes.c_int, _i32p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, _u32p, ctypes.c_long, _u32p, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_int, _i32p,
+    ]
+    lib.cro_do_rule_steps.restype = ctypes.c_int
     lib.cro_hash3.argtypes = [ctypes.c_uint32] * 3
     lib.cro_hash3.restype = ctypes.c_uint32
     lib.cro_hash2.argtypes = [ctypes.c_uint32] * 2
@@ -60,6 +67,70 @@ def crush_ln(u: int) -> int:
     return _crush_lib().cro_ln(u)
 
 
+def _marshal(cm: CompiledCrushMap, xs, weightvec,
+             choose_args: str | None):
+    """Dense C-ready views of a compiled map + inputs.  Returns a dict;
+    the `cw` entry must stay referenced through the ctypes call."""
+    m = dict(
+        items=np.ascontiguousarray(np.asarray(cm.items), dtype=np.int32),
+        weights=np.ascontiguousarray(np.asarray(cm.weights), dtype=np.int64),
+        sizes=np.ascontiguousarray(np.asarray(cm.sizes), dtype=np.int32),
+        types=np.ascontiguousarray(np.asarray(cm.types), dtype=np.int32),
+        xs=np.ascontiguousarray(xs, dtype=np.uint32),
+        wv=np.ascontiguousarray(weightvec, dtype=np.uint32),
+        cw=None, positions=0, cw_ptr=None,
+    )
+    if choose_args is not None:
+        cw = np.ascontiguousarray(
+            np.asarray(cm.choose_args_arrays(choose_args)), dtype=np.int64
+        )
+        m.update(cw=cw, positions=cw.shape[0],
+                 cw_ptr=cw.ctypes.data_as(ctypes.c_void_p))
+    return m
+
+
+def _pad_to_numrep(out: np.ndarray, numrep: int) -> np.ndarray:
+    """crush_do_rule_batch's [N, numrep] contract: NONE tail for a CHOOSE
+    with arg1 < 0, truncate any excess."""
+    if out.shape[1] < numrep:
+        pad = np.full((out.shape[0], numrep - out.shape[1]), ITEM_NONE,
+                      dtype=np.int32)
+        out = np.concatenate([out, pad], axis=1)
+    return out[:, :numrep]
+
+
+def do_rule_steps_oracle(
+    cmap: CrushMap,
+    rule_id: int,
+    xs,
+    numrep: int,
+    weightvec,
+    choose_args: str | None = None,
+    cm: CompiledCrushMap | None = None,
+) -> np.ndarray:
+    """Batched crush_do_rule via the oracle's full step interpreter —
+    handles multi-choose chains; same contract as crush_do_rule_batch."""
+    if cm is None:
+        cm = CompiledCrushMap(cmap)
+    rule = cmap.rules[rule_id]
+    steps = np.ascontiguousarray(
+        [[int(s.op), int(s.arg1), int(s.arg2)] for s in rule.steps],
+        dtype=np.int32,
+    )
+    a = _marshal(cm, xs, weightvec, choose_args)
+    out = np.empty((len(a["xs"]), numrep), dtype=np.int32)
+    rc = _crush_lib().cro_do_rule_steps(
+        a["items"].reshape(-1), a["weights"].reshape(-1), a["sizes"],
+        a["types"], a["items"].shape[0], a["items"].shape[1],
+        steps.reshape(-1), len(rule.steps), numrep,
+        cmap.tunables.choose_total_tries, a["xs"], len(a["xs"]), a["wv"],
+        len(a["wv"]), a["cw_ptr"], a["positions"], out.reshape(-1),
+    )
+    if rc != 0:
+        raise ValueError(f"cro_do_rule_steps failed rc={rc}")
+    return out
+
+
 def do_rule_batch_oracle(
     cmap: CrushMap,
     rule_id: int,
@@ -71,33 +142,25 @@ def do_rule_batch_oracle(
     """Batched crush_do_rule via the C++ oracle; same contract as
     ceph_tpu.crush.mapper.crush_do_rule_batch."""
     cm = CompiledCrushMap(cmap)
-    p = compile_rule(cm, rule_id, numrep)
-    items = np.ascontiguousarray(np.asarray(cm.items), dtype=np.int32)
-    weights = np.ascontiguousarray(np.asarray(cm.weights), dtype=np.int64)
-    sizes = np.ascontiguousarray(np.asarray(cm.sizes), dtype=np.int32)
-    types = np.ascontiguousarray(np.asarray(cm.types), dtype=np.int32)
-    xs = np.ascontiguousarray(xs, dtype=np.uint32)
-    wv = np.ascontiguousarray(weightvec, dtype=np.uint32)
-    out = np.empty((len(xs), p["want"]), dtype=np.int32)
+    try:
+        p = compile_rule(cm, rule_id, numrep)
+    except NotImplementedError:
+        # multi-choose chain: the step interpreter speaks those
+        return do_rule_steps_oracle(
+            cmap, rule_id, xs, numrep, weightvec, choose_args, cm=cm
+        )
+    a = _marshal(cm, xs, weightvec, choose_args)
+    out = np.empty((len(a["xs"]), p["want"]), dtype=np.int32)
     recurse_tries = (
         (p["leaf_tries"] or p["tries"]) if p["firstn"] else (p["leaf_tries"] or 1)
     )
-    if choose_args is not None:
-        cw = np.ascontiguousarray(
-            np.asarray(cm.choose_args_arrays(choose_args)), dtype=np.int64
-        )
-        positions = cw.shape[0]
-        cw_ptr = cw.ctypes.data_as(ctypes.c_void_p)
-    else:
-        cw = None  # noqa: F841 — keep the buffer alive through the call
-        positions = 0
-        cw_ptr = None
     rc = _crush_lib().cro_do_rule_batch(
-        items.reshape(-1), weights.reshape(-1), sizes, types,
-        items.shape[0], items.shape[1], p["take"], p["want"], p["type"],
-        int(p["firstn"]), int(p["recurse"]), p["tries"], recurse_tries,
-        xs, len(xs), wv, len(wv), cw_ptr, positions, out.reshape(-1),
+        a["items"].reshape(-1), a["weights"].reshape(-1), a["sizes"],
+        a["types"], a["items"].shape[0], a["items"].shape[1], p["take"],
+        p["want"], p["type"], int(p["firstn"]), int(p["recurse"]),
+        p["tries"], recurse_tries, a["xs"], len(a["xs"]), a["wv"],
+        len(a["wv"]), a["cw_ptr"], a["positions"], out.reshape(-1),
     )
     if rc != 0:
         raise ValueError(f"cro_do_rule_batch failed rc={rc}")
-    return out
+    return _pad_to_numrep(out, numrep)

@@ -265,6 +265,98 @@ int cro_do_rule_batch(const int32_t* items, const int64_t* weights,
   return 0;
 }
 
+// Batched do_rule over an arbitrary step plan — the crush_do_rule
+// working-vector loop (reference: src/crush/mapper.c :: crush_do_rule's
+// step switch).  steps is [n_steps * 3] of (op, arg1, arg2) with op codes
+// matching ceph_tpu/crush/types.py :: RuleOp (crush.h codes): 1=TAKE,
+// 2=CHOOSE_FIRSTN, 3=CHOOSE_INDEP, 4=EMIT, 6=CHOOSELEAF_FIRSTN,
+// 7=CHOOSELEAF_INDEP, 8=SET_CHOOSE_TRIES, 9=SET_CHOOSELEAF_TRIES.
+// out is [n_x * numrep].
+int cro_do_rule_steps(const int32_t* items, const int64_t* weights,
+                      const int32_t* sizes, const int32_t* types,
+                      int n_buckets, int max_size, const int32_t* steps,
+                      int n_steps, int numrep, int default_tries,
+                      const uint32_t* xs, long n_x,
+                      const uint32_t* weightvec, int n_devices,
+                      const int64_t* cweights, int positions, int32_t* out) {
+  if (numrep <= 0 || numrep > 64) return -1;
+  if (cweights && positions <= 0) return -1;
+  Map m{items, weights, sizes, types, n_buckets, max_size, weightvec,
+        n_devices, cweights, positions};
+  for (long i = 0; i < n_x; ++i) {
+    const uint32_t x = xs[i];
+    int32_t* dst = out + (size_t)i * numrep;
+    int32_t working[256];
+    int wsize = 0;
+    int32_t result[256];
+    int rsize = 0;
+    int choose_tries = default_tries;
+    int chooseleaf_tries = 0;
+    for (int s = 0; s < n_steps; ++s) {
+      const int op = steps[3 * s], a1 = steps[3 * s + 1],
+                a2 = steps[3 * s + 2];
+      if (op == 1) {  // TAKE
+        working[0] = a1;
+        wsize = 1;
+      } else if (op == 8) {
+        choose_tries = a1;
+      } else if (op == 9) {
+        chooseleaf_tries = a1;
+      } else if (op == 2 || op == 3 || op == 6 || op == 7) {  // CHOOSE*
+        const bool firstn = (op == 2 || op == 6);
+        const bool recurse = (op == 6 || op == 7);
+        int want = a1 > 0 ? a1 : numrep + a1;
+        if (want <= 0 || want > 64) return -1;
+        int32_t nw[256];
+        int nwsize = 0;
+        for (int wi = 0; wi < wsize; ++wi) {
+          const int parent = working[wi];
+          if (parent >= 0 || parent == ITEM_NONE_V) {
+            // not a bucket: nothing to choose from (the batched mapper
+            // emits NONEs here; firstn packs them away, indep keeps
+            // positional holes)
+            if (!firstn)
+              for (int j = 0; j < want && nwsize < 256; ++j)
+                nw[nwsize++] = ITEM_NONE_V;
+            continue;
+          }
+          int32_t buf[64], buf2[64];
+          for (int j = 0; j < want; ++j) buf[j] = buf2[j] = ITEM_NONE_V;
+          if (firstn) {
+            const int rt = chooseleaf_tries ? chooseleaf_tries
+                                            : choose_tries;
+            const int n = choose_firstn(m, parent, x, want, a2,
+                                        choose_tries, recurse,
+                                        recurse ? rt : choose_tries, buf,
+                                        buf2);
+            for (int j = 0; j < n && nwsize < 256; ++j)
+              nw[nwsize++] = recurse ? buf2[j] : buf[j];
+          } else {
+            choose_indep(m, parent, x, want, a2, choose_tries, recurse,
+                         chooseleaf_tries ? chooseleaf_tries : 1, buf,
+                         buf2);
+            for (int j = 0; j < want && nwsize < 256; ++j)
+              nw[nwsize++] = recurse ? buf2[j] : buf[j];
+          }
+        }
+        std::memcpy(working, nw, nwsize * sizeof(int32_t));
+        wsize = nwsize;
+      } else if (op == 4) {  // EMIT
+        for (int j = 0; j < wsize && rsize < 256; ++j)
+          result[rsize++] = working[j];
+        wsize = 0;
+      } else {
+        return -1;
+      }
+    }
+    // un-emitted working items are DROPPED (mapper.c: only EMIT moves
+    // results out), matching the scalar and batch interpreters
+    for (int j = 0; j < numrep; ++j)
+      dst[j] = (j < rsize) ? result[j] : ITEM_NONE_V;
+  }
+  return 0;
+}
+
 uint32_t cro_hash3(uint32_t a, uint32_t b, uint32_t c) { return hash3(a, b, c); }
 uint32_t cro_hash2(uint32_t a, uint32_t b) { return hash2(a, b); }
 int64_t cro_ln(uint32_t u) { return CRUSH_LN_TABLE[u & 0xffff]; }

@@ -311,6 +311,10 @@ class TestMultiChoose:
             exp = crush_do_rule(cmap, rule_id, int(x), nrep, list(weights))
             exp = (exp + [ITEM_NONE] * nrep)[:nrep]
             assert list(got[i]) == exp, f"x={x}: {list(got[i])} != {exp}"
+        if ORACLE:
+            # third implementation: the C++ step interpreter
+            got_cpp = do_rule_batch_oracle(cmap, rule_id, xs, nrep, weights)
+            np.testing.assert_array_equal(got_cpp, got)
 
     def test_rack_then_chooseleaf_host_firstn(self):
         from ceph_tpu.crush.types import RuleOp
@@ -412,3 +416,73 @@ class TestMultiChoose:
         finally:
             mapper_mod.default_score_fn = orig
         np.testing.assert_array_equal(got, base)
+
+    def test_set_tries_steps(self):
+        """SET_CHOOSE_TRIES / SET_CHOOSELEAF_TRIES steps plumb through all
+        three interpreters identically."""
+        from ceph_tpu.crush.types import RuleOp
+
+        cmap = build_hierarchical_map(8, 2)
+        cmap.rules[9] = self._rule([
+            (RuleOp.TAKE, -1, 0),
+            (RuleOp.SET_CHOOSE_TRIES, 13, 0),
+            (RuleOp.SET_CHOOSELEAF_TRIES, 3, 0),
+            (RuleOp.CHOOSELEAF_FIRSTN, 0, 1),
+            (RuleOp.EMIT, 0, 0),
+        ])
+        w = np.full(16, 0x10000, dtype=np.uint32)
+        w[1] = 0x2000  # rejections exercise the retry budgets
+        w[9] = 0x1000
+        self._check_vs_scalar(cmap, 9, 4, w, np.arange(300))
+
+    def test_multichoose_with_choose_args(self):
+        """choose_args weight-sets through a multi-step chain (positions
+        select per-outpos rows)."""
+        from ceph_tpu.crush.types import RuleOp
+
+        cmap = build_hierarchical_map(6, 2)
+        # alternate weight rows for the root bucket (position-dependent)
+        root = cmap.buckets[-1]
+        cmap.choose_args["wset"] = {
+            -1: [
+                [0x8000] * root.size,
+                [0x18000] * root.size,
+            ],
+        }
+        cmap.rules[9] = self._rule([
+            (RuleOp.TAKE, -1, 0),
+            (RuleOp.CHOOSE_FIRSTN, 0, 1),
+            (RuleOp.CHOOSE_FIRSTN, 1, 0),
+            (RuleOp.EMIT, 0, 0),
+        ])
+        w = np.full(12, 0x10000, dtype=np.uint32)
+        cm = CompiledCrushMap(cmap)
+        got = np.asarray(
+            crush_do_rule_batch(cm, 9, np.arange(200), 3, w,
+                                choose_args="wset")
+        )
+        ca = cmap.choose_args["wset"]
+        for x in range(200):
+            exp = crush_do_rule(cmap, 9, x, 3, list(w), choose_args=ca)
+            exp = (exp + [ITEM_NONE] * 3)[:3]
+            assert list(got[x]) == exp, x
+        if ORACLE:
+            from ceph_tpu.crush.oracle_bridge import do_rule_steps_oracle
+
+            got_cpp = do_rule_steps_oracle(
+                cmap, 9, np.arange(200), 3, w, choose_args="wset"
+            )
+            np.testing.assert_array_equal(got_cpp, got)
+
+    def test_rule_without_emit_maps_nothing(self):
+        """mapper.c: only EMIT moves results out — a rule ending without
+        one yields NONEs from every interpreter."""
+        from ceph_tpu.crush.types import RuleOp
+
+        cmap = build_hierarchical_map(4, 2)
+        cmap.rules[9] = self._rule([
+            (RuleOp.TAKE, -1, 0),
+            (RuleOp.CHOOSELEAF_FIRSTN, 0, 1),
+        ])
+        w = np.full(8, 0x10000, dtype=np.uint32)
+        self._check_vs_scalar(cmap, 9, 2, w, np.arange(40))
