@@ -66,19 +66,112 @@ class StripePolicy:
         return [tuple(e) for e in out]
 
 
+class ExtentIO:
+    """Extent-level striped I/O over whole-object IoCtx ops — the shared
+    engine under libradosstriper's StripedObject and the FS FileHandle
+    (which differ only in object naming and where the logical size lives).
+
+    `namer(objectno) -> oid` supplies the object naming convention.  Size
+    bookkeeping stays with the caller; `read` takes the caller's logical
+    length (already clamped) and `truncate_data`/`purge` take the old
+    logical size."""
+
+    def __init__(self, io, namer, policy: StripePolicy):
+        self.io = io
+        self.namer = namer
+        self.policy = policy
+
+    def write(self, data: bytes, off: int) -> None:
+        """Read-modify-write each touched object (the framework's object
+        store is whole-object; the reference writes sub-object extents
+        natively — same bytes land either way)."""
+        src = 0  # extents come back in stream order
+        for objectno, obj_off, ln in self.policy.extents(off, len(data)):
+            oid = self.namer(objectno)
+            try:
+                cur = bytearray(self.io.read(oid))
+            except IOError:
+                cur = bytearray()
+            end = obj_off + ln
+            if len(cur) < end:
+                cur.extend(b"\0" * (end - len(cur)))
+            cur[obj_off:end] = data[src : src + ln]
+            src += ln
+            self.io.write_full(oid, bytes(cur))
+
+    def read(self, off: int, length: int) -> bytes:
+        parts: list[bytes] = []
+        for objectno, obj_off, ln in self.policy.extents(off, length):
+            try:
+                chunk = self.io.read(self.namer(objectno), off=obj_off,
+                                     length=ln)
+            except IOError:
+                chunk = b""
+            if len(chunk) < ln:  # sparse object: logical zeros
+                chunk = chunk + b"\0" * (ln - len(chunk))
+            parts.append(chunk)
+        return b"".join(parts)
+
+    def truncate_data(self, old: int, size: int) -> None:
+        """Shrink the data objects to logical `size`: whole objects past it
+        are removed and kept objects cut to their surviving prefix, so a
+        later write that re-extends the stream reads zeros (not stale
+        bytes) in the gap — POSIX/libradosstriper truncate semantics.
+        (Striping interleaves, so an object can hold stream bytes BEYOND
+        `size` below other kept ranges — everything past the last kept
+        extent end must go.)"""
+        keep_len: dict[int, int] = {}
+        for objectno, obj_off, ln in self.policy.extents(0, size):
+            keep_len[objectno] = max(
+                keep_len.get(objectno, 0), obj_off + ln
+            )
+        last_obj = max(
+            (e[0] for e in self.policy.extents(0, old)), default=-1
+        )
+        for objectno in range(last_obj + 1):
+            keep = keep_len.get(objectno, 0)
+            oid = self.namer(objectno)
+            if keep == 0:
+                try:
+                    self.io.remove(oid)
+                except IOError:
+                    pass
+                continue
+            try:
+                cur = self.io.read(oid)
+            except IOError:
+                continue
+            if len(cur) > keep:
+                self.io.write_full(oid, bytes(cur[:keep]))
+
+    def purge(self, size: int) -> None:
+        """Remove every data object of a stream whose logical size was
+        `size`."""
+        last_obj = max(
+            (e[0] for e in self.policy.extents(0, max(size, 1))),
+            default=-1,
+        )
+        for objectno in range(last_obj + 1):
+            try:
+                self.io.remove(self.namer(objectno))
+            except IOError:
+                pass
+
+
 class StripedObject:
     """Striped byte-stream over an IoCtx (reference: libradosstriper's
-    RadosStriperImpl, the write/read/truncate subset)."""
+    RadosStriperImpl, the write/read/truncate subset).  Logical size lives
+    in a `.meta` sidecar object."""
 
     def __init__(self, io, name: str, policy: StripePolicy | None = None,
                  **layout):
         self.io = io
         self.name = name
         self.policy = policy or StripePolicy(**layout)
-
-    def _obj(self, objectno: int) -> str:
         # reference: {name}.{%016x} object naming
-        return f"{self.name}.{objectno:016x}"
+        self._ext = ExtentIO(
+            io, lambda objectno: f"{name}.{objectno:016x}", self.policy
+        )
 
     def _meta(self) -> str:
         return f"{self.name}.meta"
@@ -96,21 +189,7 @@ class StripedObject:
 
     # -- I/O ---------------------------------------------------------------
     def write(self, data: bytes, off: int = 0) -> None:
-        """Read-modify-write each touched object (the framework's object
-        store is whole-object; the reference writes sub-object extents
-        natively — same bytes land either way)."""
-        src = 0  # extents come back in stream order
-        for objectno, obj_off, ln in self.policy.extents(off, len(data)):
-            try:
-                cur = bytearray(self.io.read(self._obj(objectno)))
-            except IOError:
-                cur = bytearray()
-            end = obj_off + ln
-            if len(cur) < end:
-                cur.extend(b"\0" * (end - len(cur)))
-            cur[obj_off:end] = data[src : src + ln]
-            src += ln
-            self.io.write_full(self._obj(objectno), bytes(cur))
+        self._ext.write(data, off)
         if off + len(data) > self.size():
             self._set_size(off + len(data))
 
@@ -120,65 +199,16 @@ class StripedObject:
             return b""
         if length is None or off + length > size:
             length = size - off
-        parts: list[bytes] = []
-        for objectno, obj_off, ln in self.policy.extents(off, length):
-            try:
-                chunk = self.io.read(self._obj(objectno), off=obj_off,
-                                     length=ln)
-            except IOError:
-                chunk = b""
-            if len(chunk) < ln:  # sparse object: logical zeros
-                chunk = chunk + b"\0" * (ln - len(chunk))
-            parts.append(chunk)
-        return b"".join(parts)
+        return self._ext.read(off, length)
 
     def truncate(self, size: int) -> None:
-        """Shrink to `size`: whole objects past it are removed and kept
-        objects are cut to their surviving prefix, so a later write that
-        re-extends the stream reads zeros (not stale bytes) in the gap —
-        POSIX/libradosstriper truncate semantics."""
         old = self.size()
-        if size >= old:
-            self._set_size(size)
-            return
-        kept = self.policy.extents(0, size)
-        # per-object surviving prefix length (striping interleaves, so an
-        # object can hold stream bytes BEYOND `size` below other kept
-        # ranges — everything past the last kept extent end must go)
-        keep_len: dict[int, int] = {}
-        for objectno, obj_off, ln in kept:
-            keep_len[objectno] = max(
-                keep_len.get(objectno, 0), obj_off + ln
-            )
-        last_obj = max(
-            (e[0] for e in self.policy.extents(0, old)), default=-1
-        )
-        for objectno in range(last_obj + 1):
-            keep = keep_len.get(objectno, 0)
-            if keep == 0:
-                try:
-                    self.io.remove(self._obj(objectno))
-                except IOError:
-                    pass
-                continue
-            try:
-                cur = self.io.read(self._obj(objectno))
-            except IOError:
-                continue
-            if len(cur) > keep:
-                self.io.write_full(self._obj(objectno), bytes(cur[:keep]))
+        if size < old:
+            self._ext.truncate_data(old, size)
         self._set_size(size)
 
     def remove(self) -> None:
-        last_obj = max(
-            (e[0] for e in self.policy.extents(0, max(self.size(), 1))),
-            default=-1,
-        )
-        for objectno in range(last_obj + 1):
-            try:
-                self.io.remove(self._obj(objectno))
-            except IOError:
-                pass
+        self._ext.purge(self.size())
         try:
             self.io.remove(self._meta())
         except IOError:

@@ -1,0 +1,24 @@
+"""Small JAX config helpers shared across subsystems."""
+from __future__ import annotations
+
+
+def x64_ctx(enabled: bool):
+    """Thread-scoped x64 on/off context.  One definition for both sides of
+    the CRUSH/Pallas boundary: the mapper traces straw2 under x64 (64-bit
+    fixed-point draws), while Pallas kernels must trace with x64 OFF so
+    Python literals in BlockSpec index_maps and kernel bodies stay i32 —
+    ambient i64 constants fail Mosaic legalization on real TPUs
+    (``func.return (i32, i64)``).
+
+    jax.experimental.enable_x64 was removed in jax 0.9; the config State
+    object is the surviving spelling, with the experimental fallback for
+    older jax.
+    """
+    try:
+        from jax._src.config import enable_x64 as _e
+
+        return _e(enabled)
+    except ImportError:  # older jax
+        from jax.experimental import enable_x64 as _e
+
+        return _e(enabled)

@@ -127,6 +127,12 @@ def default_options() -> OptionTable:
                    runtime=True),
             Option("mgr_stale_report_age", float, 30.0,
                    "drop daemon reports older than this", min=1.0),
+            # -- mds (reference: mds.yaml.in) ------------------------------
+            Option("debug_mds", int, 1, "mds debug level", min=0, max=20,
+                   runtime=True),
+            Option("mds_journal_segment_events", int, 128,
+                   "journal events per segment before a dirfrag flush + "
+                   "trim (reference: mds_log_events_per_segment)", min=1),
             # -- objectstore (reference: bluestore options) ----------------
             Option("objectstore", str, "memstore", "backend for new OSDs",
                    enum=("memstore", "filestore")),

@@ -52,16 +52,10 @@ from .types import ITEM_NONE, CrushMap, RuleOp
 
 
 def enable_x64():
-    """Thread-scoped x64 context (jax.experimental.enable_x64 was removed
-    in jax 0.9; the config State object is the surviving spelling)."""
-    try:
-        from jax._src.config import enable_x64 as _e
+    """Thread-scoped x64 context for the CRUSH traces."""
+    from ..common.jaxutil import x64_ctx
 
-        return _e(True)
-    except ImportError:  # older jax
-        from jax.experimental import enable_x64 as _e
-
-        return _e()
+    return x64_ctx(True)
 
 
 # Max LANES (x times working-set width) per device launch.  Empirically

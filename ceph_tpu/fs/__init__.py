@@ -1,0 +1,12 @@
+"""CephFS-analog filesystem layer (reference: src/mds + src/client;
+SURVEY.md §2.6).
+
+Architecture mirrors the reference's split: an MDS daemon owns the
+namespace (metadata in a RADOS metadata pool, journaled), while clients
+do file data I/O directly against the data pool through the striper —
+the MDS never touches file bytes.
+"""
+from .client import FSClient
+from .mds import MDSDaemon
+
+__all__ = ["FSClient", "MDSDaemon"]

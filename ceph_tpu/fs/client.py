@@ -1,0 +1,316 @@
+"""FSClient — the filesystem client (reference: src/client/Client.cc;
+SURVEY.md §2.6 "CephFS").
+
+Metadata ops go to the MDS over the messenger; file data is striped
+directly into the data pool through the striper (the MDS never sees file
+bytes).  Path resolution walks components with ``lookup`` from the root
+inode, exactly the reference's path-walk, with a small dentry cache
+invalidated on every namespace mutation.
+
+    fs = FSClient(cct, rados, mds_addr)
+    fs.mount()
+    fs.mkdir("/a")
+    f = fs.open("/a/hello", create=True)
+    f.write(b"world")
+    f.read(0, 5)
+    fs.listdir("/a")
+"""
+from __future__ import annotations
+
+import threading
+
+import time as _time
+import uuid
+
+from ..client.striper import ExtentIO, StripePolicy
+from ..msg import Dispatcher, Messenger
+from .mds import ROOT_INO
+from .messages import MClientReply, MClientRequest, MClientSession
+
+_ERR = {
+    -2: FileNotFoundError,
+    -17: FileExistsError,
+    -20: NotADirectoryError,
+    -21: IsADirectoryError,
+    -39: OSError,  # ENOTEMPTY
+}
+
+
+class FSError(OSError):
+    pass
+
+
+class FileHandle:
+    """Open file: striped data I/O + size writeback to the MDS (the
+    cap-flush analog — reference: Client::_write updating inode size)."""
+
+    def __init__(self, fs: "FSClient", inode: dict):
+        self.fs = fs
+        self.inode = dict(inode)
+        layout = self.inode.get("layout") or {}
+        self.policy = StripePolicy(
+            object_size=layout.get("object_size", 1 << 22),
+            stripe_unit=layout.get("stripe_unit", 1 << 16),
+            stripe_count=layout.get("stripe_count", 4),
+        )
+        self.io = fs._data_io(layout.get("pool"))
+        # reference object naming: {ino:x}.{objectno:08x}; the striper's
+        # ExtentIO carries the RMW/sparse/truncate mechanics (logical size
+        # lives in the MDS inode, not a sidecar)
+        ino = self.inode["ino"]
+        self._ext = ExtentIO(
+            self.io, lambda objectno: f"{ino:x}.{objectno:08x}", self.policy
+        )
+
+    @property
+    def ino(self) -> int:
+        return self.inode["ino"]
+
+    def size(self) -> int:
+        return int(self.inode.get("size", 0))
+
+    def write(self, data: bytes, off: int = 0) -> int:
+        self._ext.write(data, off)
+        # size/mtime writeback — the cap-flush analog
+        attrs = {"ino": self.ino, "mtime": _time.time()}
+        if off + len(data) > self.size():
+            attrs["size"] = off + len(data)
+        self.inode = self.fs._request("setattr", attrs)
+        return len(data)
+
+    def read(self, off: int = 0, length: int | None = None) -> bytes:
+        size = self.size()
+        if off >= size:
+            return b""
+        if length is None or off + length > size:
+            length = size - off
+        return self._ext.read(off, length)
+
+    def truncate(self, size: int) -> None:
+        old = self.size()
+        if size < old:
+            self._ext.truncate_data(old, size)
+        self.inode = self.fs._request(
+            "setattr", {"ino": self.ino, "size": size, "mtime": _time.time()}
+        )
+
+
+class FSClient(Dispatcher):
+    def __init__(self, cct, rados, mds_addr: tuple[str, int],
+                 name: str = "client.fs"):
+        self.cct = cct
+        self.rados = rados  # data-pool I/O rides the librados client
+        self.mds_addr = tuple(mds_addr)
+        self.name = name
+        self.messenger = Messenger.create(cct, name)
+        self.messenger.add_dispatcher(self)
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._tid = 0
+        # per-process session id: the MDS keys its reply cache on
+        # (session, tid) so a retried request after a connection reset is
+        # answered from the cache instead of re-executed (at-most-once for
+        # non-idempotent namespace ops)
+        self._session = uuid.uuid4().hex
+        self._replies: dict[int, tuple[int, object]] = {}
+        self._session_open = False
+        self._conn = None
+        self._dcache: dict[tuple[int, str], dict] = {}
+        self._ios: dict[str, object] = {}
+
+    # -- session -----------------------------------------------------------
+    def mount(self, timeout: float = 10.0) -> None:
+        self.messenger.start()
+        self._conn = self.messenger.connect(self.mds_addr)
+        self._conn.send_message(
+            MClientSession(op="request_open", client=self.name)
+        )
+        with self._lock:
+            if not self._cond.wait_for(lambda: self._session_open, timeout):
+                raise TimeoutError("MDS session open timed out")
+
+    def unmount(self) -> None:
+        try:
+            if self._conn is not None:
+                self._conn.send_message(
+                    MClientSession(op="request_close", client=self.name)
+                )
+        except (OSError, ConnectionError):
+            pass
+        self.messenger.shutdown()
+
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MClientSession):
+            with self._lock:
+                if msg.op == "open":
+                    self._session_open = True
+                self._cond.notify_all()
+            return True
+        if isinstance(msg, MClientReply):
+            with self._lock:
+                self._replies[msg.tid] = (msg.retval, msg.result)
+                self._cond.notify_all()
+            return True
+        return False
+
+    def ms_handle_reset(self, conn) -> None:
+        with self._lock:
+            if conn is self._conn:
+                self._conn = None
+            self._cond.notify_all()
+
+    # -- RPC ---------------------------------------------------------------
+    def _request(self, op: str, args: dict, timeout: float = 10.0):
+        with self._lock:
+            self._tid += 1
+            tid = self._tid
+        for attempt in range(3):
+            with self._lock:
+                conn = self._conn
+            try:
+                if conn is None:
+                    conn = self.messenger.connect(self.mds_addr)
+                    with self._lock:
+                        self._conn = conn
+                conn.send_message(
+                    MClientRequest(
+                        tid=tid, op=op, args=args, session=self._session
+                    )
+                )
+            except (OSError, ConnectionError):
+                with self._lock:
+                    self._conn = None
+                continue
+            with self._lock:
+                if self._cond.wait_for(
+                    lambda: tid in self._replies or self._conn is None,
+                    timeout,
+                ) and tid in self._replies:
+                    rv, result = self._replies.pop(tid)
+                    break
+        else:
+            raise FSError(f"MDS request {op} failed after retries")
+        if rv < 0:
+            exc = _ERR.get(rv, FSError)
+            raise exc(f"{op} {args}: errno {rv} ({result})")
+        if op in ("create", "mkdir", "unlink", "rmdir", "rename"):
+            self._dcache.clear()
+        elif op == "setattr":
+            # setattr changes no dentries — evict only entries caching the
+            # touched inode so data-write size/mtime writebacks don't nuke
+            # every cached path lookup
+            ino = args.get("ino")
+            with self._lock:
+                for key in [
+                    k for k, v in self._dcache.items()
+                    if v.get("ino") == ino
+                ]:
+                    del self._dcache[key]
+        return result
+
+    # -- path machinery ----------------------------------------------------
+    @staticmethod
+    def _split(path: str) -> list[str]:
+        parts = [p for p in path.strip("/").split("/") if p]
+        return parts
+
+    def _lookup(self, parent: int, name: str) -> dict:
+        key = (parent, name)
+        hit = self._dcache.get(key)
+        if hit is not None:
+            return hit
+        inode = self._request("lookup", {"parent": parent, "name": name})
+        self._dcache[key] = inode
+        return inode
+
+    def _resolve(self, path: str) -> dict:
+        inode = {"ino": ROOT_INO, "type": "dir"}
+        for name in self._split(path):
+            if inode["type"] != "dir":
+                raise NotADirectoryError(path)
+            inode = self._lookup(inode["ino"], name)
+        return inode
+
+    def _resolve_parent(self, path: str) -> tuple[int, str]:
+        parts = self._split(path)
+        if not parts:
+            raise FSError("path refers to the root")
+        parent = self._resolve("/".join(parts[:-1]))
+        if parent["type"] != "dir":
+            raise NotADirectoryError(path)
+        return parent["ino"], parts[-1]
+
+    def _data_io(self, pool: str | None):
+        pool = pool or "cephfs_data"
+        if pool not in self._ios:
+            self._ios[pool] = self.rados.open_ioctx(pool)
+        return self._ios[pool]
+
+    # -- public API --------------------------------------------------------
+    def mkdir(self, path: str) -> dict:
+        parent, name = self._resolve_parent(path)
+        return self._request("mkdir", {"parent": parent, "name": name})
+
+    def listdir(self, path: str = "/") -> dict:
+        inode = self._resolve(path)
+        if inode["type"] != "dir":
+            raise NotADirectoryError(path)
+        return self._request("readdir", {"ino": inode["ino"]})
+
+    def stat(self, path: str) -> dict:
+        return self._resolve(path)
+
+    def open(self, path: str, create: bool = False,
+             layout: dict | None = None) -> FileHandle:
+        if create:
+            parent, name = self._resolve_parent(path)
+            try:
+                inode = self._request(
+                    "create",
+                    {"parent": parent, "name": name, "layout": layout},
+                )
+            except FileExistsError:
+                inode = self._resolve(path)
+        else:
+            inode = self._resolve(path)
+        if inode["type"] == "dir":
+            raise IsADirectoryError(path)
+        return FileHandle(self, inode)
+
+    def _purge_data(self, inode: dict) -> None:
+        """Remove a dead file's data objects (reference: the MDS purge
+        queue; here the client that held the last ref does it inline)."""
+        fh = FileHandle(self, inode)
+        fh._ext.purge(fh.size())
+
+    def unlink(self, path: str) -> None:
+        parent, name = self._resolve_parent(path)
+        inode = self._request("unlink", {"parent": parent, "name": name})
+        self._purge_data(inode)
+
+    def rmdir(self, path: str) -> None:
+        parent, name = self._resolve_parent(path)
+        self._request("rmdir", {"parent": parent, "name": name})
+
+    def rename(self, src: str, dst: str) -> None:
+        sdir, sname = self._resolve_parent(src)
+        ddir, dname = self._resolve_parent(dst)
+        result = self._request(
+            "rename",
+            {"srcdir": sdir, "sname": sname, "dstdir": ddir, "dname": dname},
+        )
+        # a replaced destination file's data objects are purged by the
+        # client holding the last reference (the MDS purge-queue analog,
+        # as in unlink)
+        replaced = (result or {}).get("replaced")
+        if replaced is not None and replaced.get("type") == "file":
+            self._purge_data(replaced)
+
+    def write_file(self, path: str, data: bytes) -> None:
+        fh = self.open(path, create=True)
+        if fh.size():
+            fh.truncate(0)
+        fh.write(data)
+
+    def read_file(self, path: str) -> bytes:
+        return self.open(path).read()

@@ -1,0 +1,398 @@
+"""MDSDaemon — metadata server for the FS layer (reference: src/mds/MDSRank,
+MDCache, MDLog, CInode/CDir/CDentry; SURVEY.md §2.6 "CephFS").
+
+Faithful structural choices:
+
+- The namespace lives in RADOS objects in a *metadata pool*: one dirfrag
+  object per directory (``dir.{ino:x}``) whose entries embed the child
+  inode — the reference's primary-dentry-embeds-inode layout
+  (src/mds/CDentry.h).  Hardlinks (remote dentries) are out of scope.
+- Updates are journaled before dirfrags are flushed (src/mds/MDLog.cc:
+  EUpdate events into journal segments stored as RADOS objects); a
+  restarted MDS replays segments newer than the last flush point, so
+  namespace mutations survive an MDS crash without per-op dirfrag
+  writeback.
+- One big lock serializes metadata ops — the reference's ``mds_lock``.
+- File *data* never passes through the MDS: clients stripe it directly
+  into the data pool (src/client/Client.cc writes via the Objecter).
+  File size/mtime come back to the MDS as a ``setattr`` — the cap-flush
+  analog.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ..client.rados import Rados
+from ..msg import Dispatcher, Messenger
+from .messages import MClientReply, MClientRequest, MClientSession
+
+ROOT_INO = 1
+
+
+class MDSDaemon(Dispatcher):
+    """Single active MDS (rank 0).  reference: src/mds/MDSDaemon.cc boots a
+    rank that loads the root dirfrag + replays the journal."""
+
+    def __init__(
+        self,
+        cct,
+        mon_addrs,
+        metadata_pool: str = "cephfs_meta",
+        data_pool: str = "cephfs_data",
+    ):
+        self.cct = cct
+        self.mon_addrs = mon_addrs
+        self.metadata_pool = metadata_pool
+        self.data_pool = data_pool
+        self.messenger = Messenger.create(cct, "mds")
+        self.messenger.add_dispatcher(self)
+        self.addr: tuple[str, int] | None = None
+        self._lock = threading.RLock()  # the mds_lock
+        # in-memory cache (MDCache): dirfrags + ino backpointers
+        self.dirs: dict[int, dict[str, dict]] = {}
+        self.backptr: dict[int, tuple[int, str]] = {}  # ino -> (parent, name)
+        self.next_ino = ROOT_INO + 1
+        self._dirty: set[int] = set()  # dirfrags awaiting flush
+        self._seg_seq = 0   # current journal segment (MDLog)
+        self._seg_idx = 0   # next event slot within the segment
+        self._first_seg = 0
+        self._sessions: set[str] = set()
+        # bounded (session, tid) -> (rv, result) reply cache: resent
+        # requests after a connection reset are answered, not re-executed
+        # (reference: Session::have_completed_request)
+        self._reply_cache: dict[tuple[str, int], tuple[int, object]] = {}
+        self._reply_order: list[tuple[str, int]] = []
+        self._rados: Rados | None = None
+        self._io = None
+
+    # -- persistence helpers ----------------------------------------------
+    def _obj_read(self, oid: str) -> dict | list | None:
+        try:
+            return json.loads(self._io.read(oid))
+        except (IOError, ValueError):
+            return None
+
+    def _obj_write(self, oid: str, body) -> None:
+        self._io.write_full(oid, json.dumps(body).encode())
+
+    def _load(self) -> None:
+        """Boot: load the flushed namespace, then replay journal segments
+        (reference: MDCache::open_root + MDLog::replay)."""
+        head = self._obj_read("mds_head") or {}
+        self._first_seg = int(head.get("first_seg", 0))
+        self._seg_seq = self._first_seg
+        ino_tbl = self._obj_read("mds_inotable") or {}
+        self.next_ino = int(ino_tbl.get("next_ino", ROOT_INO + 1))
+        for oid in self._io.list_objects():
+            if not oid.startswith("dir."):
+                continue
+            ino = int(oid[4:], 16)
+            entries = self._obj_read(oid) or {}
+            self.dirs[ino] = entries
+        if ROOT_INO not in self.dirs:
+            self.dirs[ROOT_INO] = {}
+            self._dirty.add(ROOT_INO)
+        # backptrs must exist BEFORE replay: a replayed setattr resolves
+        # its inode through backptr, and inodes living in flushed dirfrags
+        # are invisible to it otherwise (their size/mtime updates would be
+        # silently dropped, then the post-replay flush would trim the
+        # journal and make the loss permanent)
+        self._rebuild_backptrs()
+        # replay: events are idempotent state setters, applied in order;
+        # one RADOS object per event (see _journal)
+        seq = self._first_seg
+        while True:
+            idx = 0
+            while True:
+                ev = self._obj_read(f"journal.{seq:08x}.{idx:04x}")
+                if ev is None:
+                    break
+                self._apply(ev)
+                idx += 1
+            if idx == 0:
+                break
+            seq += 1
+        self._seg_seq = seq
+        self._seg_idx = 0
+        self._flush()
+
+    def _rebuild_backptrs(self) -> None:
+        self.backptr = {}
+        for dino, entries in self.dirs.items():
+            for name, inode in entries.items():
+                self.backptr[inode["ino"]] = (dino, name)
+
+    def _flush(self) -> None:
+        """Flush dirty dirfrags + inotable, then trim the journal
+        (reference: MDLog segment expiry writing back dirty CDirs)."""
+        for ino in sorted(self._dirty):
+            if ino in self.dirs:
+                self._obj_write(f"dir.{ino:x}", self.dirs[ino])
+            else:
+                try:
+                    self._io.remove(f"dir.{ino:x}")
+                except IOError:
+                    pass
+        self._dirty.clear()
+        self._obj_write("mds_inotable", {"next_ino": self.next_ino})
+        self._first_seg = self._seg_seq
+        self._obj_write("mds_head", {"first_seg": self._first_seg})
+        # trim: every event object of now-expired segments
+        for oid in self._io.list_objects():
+            if not oid.startswith("journal."):
+                continue
+            if int(oid.split(".")[1], 16) < self._first_seg:
+                try:
+                    self._io.remove(oid)
+                except IOError:
+                    pass
+
+    def _journal(self, ev: dict) -> None:
+        """Persist one event as its own RADOS object (write-ahead: durable
+        before the reply).  One object per event because the object store
+        is whole-object — rewriting a growing segment object per op would
+        be O(n^2) bytes per segment."""
+        self._obj_write(
+            f"journal.{self._seg_seq:08x}.{self._seg_idx:04x}", ev
+        )
+        self._seg_idx += 1
+
+    def _commit(self, ev: dict) -> None:
+        """Journal, apply, then roll the segment if full.  The roll's
+        dirfrag flush must come AFTER apply — flushing between journal and
+        apply would trim the segment holding an event the dirfrags don't
+        yet contain, losing it."""
+        self._journal(ev)
+        self._apply(ev)
+        max_ev = self.cct.conf.get("mds_journal_segment_events")
+        if self._seg_idx >= max_ev:
+            self._seg_idx = 0
+            self._seg_seq += 1
+            self._flush()
+
+    # -- event application (shared by live ops and replay) ----------------
+    def _apply(self, ev: dict) -> None:
+        kind = ev["e"]
+        if kind == "link":  # create/mkdir: insert dentry with embedded inode
+            parent, name, inode = ev["parent"], ev["name"], ev["inode"]
+            self.dirs.setdefault(parent, {})[name] = inode
+            if inode["type"] == "dir":
+                self.dirs.setdefault(inode["ino"], {})
+                self._dirty.add(inode["ino"])
+            self.backptr[inode["ino"]] = (parent, name)
+            self.next_ino = max(self.next_ino, inode["ino"] + 1)
+            self._dirty.add(parent)
+        elif kind == "unlink":
+            parent, name = ev["parent"], ev["name"]
+            inode = self.dirs.get(parent, {}).pop(name, None)
+            if inode is not None:
+                self.backptr.pop(inode["ino"], None)
+                if inode["type"] == "dir":
+                    self.dirs.pop(inode["ino"], None)
+                    self._dirty.add(inode["ino"])
+            self._dirty.add(parent)
+        elif kind == "rename":
+            sdir, sname = ev["srcdir"], ev["sname"]
+            ddir, dname = ev["dstdir"], ev["dname"]
+            inode = self.dirs.get(sdir, {}).pop(sname, None)
+            if inode is not None:
+                replaced = self.dirs.setdefault(ddir, {}).get(dname)
+                if replaced is not None:
+                    self.backptr.pop(replaced["ino"], None)
+                    if replaced["type"] == "dir":  # empty dir replaced
+                        self.dirs.pop(replaced["ino"], None)
+                        self._dirty.add(replaced["ino"])
+                self.dirs[ddir][dname] = inode
+                self.backptr[inode["ino"]] = (ddir, dname)
+            self._dirty.update((sdir, ddir))
+        elif kind == "setattr":
+            ino = ev["ino"]
+            bp = self.backptr.get(ino)
+            if bp is not None:
+                inode = self.dirs[bp[0]][bp[1]]
+                for f in ("size", "mtime"):
+                    if ev.get(f) is not None:
+                        inode[f] = ev[f]
+                self._dirty.add(bp[0])
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._rados = Rados(self.cct, self.mon_addrs, name="mds.0")
+        self._rados.connect(timeout=30.0)
+        self._io = self._rados.open_ioctx(self.metadata_pool)
+        with self._lock:
+            self._load()
+        self.addr = self.messenger.bind(("127.0.0.1", 0))
+        self.messenger.start()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            try:
+                self._flush()
+            except Exception:
+                pass
+        self.hard_kill()
+
+    def hard_kill(self) -> None:
+        """Stop WITHOUT the shutdown flush — crash simulation for failover
+        tests: the journal alone must carry unflushed namespace state."""
+        self.messenger.shutdown()
+        if self._rados is not None:
+            self._rados.shutdown()
+
+    # -- op handling -------------------------------------------------------
+    def _inode_of(self, ino: int) -> dict | None:
+        if ino == ROOT_INO:
+            return {"ino": ROOT_INO, "type": "dir", "size": 0, "mtime": 0.0}
+        bp = self.backptr.get(ino)
+        return None if bp is None else self.dirs[bp[0]][bp[1]]
+
+    def _alloc_ino(self) -> int:
+        ino = self.next_ino
+        self.next_ino += 1
+        return ino
+
+    def _handle(self, op: str, a: dict):
+        """Returns (retval, result).  Negative errnos follow the reference
+        (-2 ENOENT, -17 EEXIST, -20 ENOTDIR, -21 EISDIR, -39 ENOTEMPTY)."""
+        if op == "lookup":
+            entries = self.dirs.get(a["parent"])
+            if entries is None:
+                return -2, None
+            inode = entries.get(a["name"])
+            return (0, inode) if inode is not None else (-2, None)
+        if op == "getattr":
+            inode = self._inode_of(a["ino"])
+            return (0, inode) if inode is not None else (-2, None)
+        if op == "readdir":
+            entries = self.dirs.get(a["ino"])
+            if entries is None:
+                return -20, None
+            return 0, {n: i for n, i in sorted(entries.items())}
+        if op in ("create", "mkdir"):
+            parent = a["parent"]
+            if parent not in self.dirs:
+                return -20, None
+            if a["name"] in self.dirs[parent]:
+                return -17, self.dirs[parent][a["name"]]
+            inode = {
+                "ino": self._alloc_ino(),
+                "type": "dir" if op == "mkdir" else "file",
+                "size": 0,
+                "mtime": time.time(),
+            }
+            if op == "create":
+                inode["layout"] = a.get("layout") or {
+                    "pool": self.data_pool,
+                    "object_size": 1 << 22,
+                    "stripe_unit": 1 << 16,
+                    "stripe_count": 4,
+                }
+            self._commit({"e": "link", "parent": parent,
+                          "name": a["name"], "inode": inode})
+            return 0, inode
+        if op in ("unlink", "rmdir"):
+            parent, name = a["parent"], a["name"]
+            inode = self.dirs.get(parent, {}).get(name)
+            if inode is None:
+                return -2, None
+            if op == "rmdir":
+                if inode["type"] != "dir":
+                    return -20, None
+                if self.dirs.get(inode["ino"]):
+                    return -39, None
+            elif inode["type"] == "dir":
+                return -21, None
+            self._commit({"e": "unlink", "parent": parent, "name": name})
+            return 0, inode
+        if op == "rename":
+            sdir, sname = a["srcdir"], a["sname"]
+            inode = self.dirs.get(sdir, {}).get(sname)
+            if inode is None:
+                return -2, None
+            dst = self.dirs.get(a["dstdir"])
+            if dst is None:
+                return -20, None
+            existing = dst.get(a["dname"])
+            if existing is not None:
+                if existing["ino"] == inode["ino"]:
+                    return 0, {"moved": inode, "replaced": None}
+                # POSIX replacement matrix: file over dir = EISDIR; dir
+                # over file = ENOTDIR; dir over non-empty dir = ENOTEMPTY
+                if existing["type"] == "dir":
+                    if inode["type"] != "dir":
+                        return -21, None
+                    if self.dirs.get(existing["ino"]):
+                        return -39, None
+                elif inode["type"] == "dir":
+                    return -20, None
+            if inode["type"] == "dir":
+                # reject moving a directory under itself (would detach the
+                # subtree — reference: MDCache path-traversal rename checks)
+                cur = a["dstdir"]
+                while cur != ROOT_INO:
+                    if cur == inode["ino"]:
+                        return -22, None  # EINVAL
+                    bp = self.backptr.get(cur)
+                    if bp is None:
+                        break
+                    cur = bp[0]
+            self._commit({"e": "rename", "srcdir": sdir, "sname": sname,
+                          "dstdir": a["dstdir"], "dname": a["dname"]})
+            # a replaced file's inode goes back to the caller so the
+            # client can purge its data objects (purge-queue analog)
+            return 0, {"moved": inode, "replaced": existing}
+        if op == "setattr":
+            inode = self._inode_of(a["ino"])
+            if inode is None:
+                return -2, None
+            self._commit({"e": "setattr", "ino": a["ino"],
+                          "size": a.get("size"), "mtime": a.get("mtime")})
+            return 0, self._inode_of(a["ino"])
+        if op == "open":
+            inode = self._inode_of(a["ino"])
+            if inode is None:
+                return -2, None
+            if inode["type"] == "dir":
+                return -21, None
+            return 0, inode
+        return -95, f"unknown op {op!r}"  # EOPNOTSUPP
+
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MClientSession):
+            with self._lock:
+                if msg.op == "request_open":
+                    self._sessions.add(msg.client)
+                    conn.send_message(
+                        MClientSession(op="open", client=msg.client)
+                    )
+                elif msg.op == "request_close":
+                    self._sessions.discard(msg.client)
+                    conn.send_message(
+                        MClientSession(op="close", client=msg.client)
+                    )
+            return True
+        if isinstance(msg, MClientRequest):
+            key = (msg.session or msg.src, msg.tid)
+            with self._lock:
+                if key in self._reply_cache:
+                    rv, result = self._reply_cache[key]
+                else:
+                    try:
+                        rv, result = self._handle(msg.op, msg.args or {})
+                    except Exception as e:  # op bug must not kill the daemon
+                        self.cct.dout(
+                            "mds", 0, f"mds op {msg.op} failed: {e!r}"
+                        )
+                        rv, result = -5, repr(e)  # EIO
+                    self._reply_cache[key] = (rv, result)
+                    self._reply_order.append(key)
+                    while len(self._reply_order) > 512:
+                        self._reply_cache.pop(self._reply_order.pop(0), None)
+            conn.send_message(
+                MClientReply(tid=msg.tid, retval=rv, result=result)
+            )
+            return True
+        return False

@@ -1,0 +1,42 @@
+"""MDS wire messages (reference: src/messages/MClientSession.h,
+MClientRequest.h, MClientReply.h).  Type codes follow the reference's
+CEPH_MSG_CLIENT_* numbering.
+"""
+from __future__ import annotations
+
+from ..mon.messages import _JsonMessage
+from ..msg.message import register_message
+
+
+@register_message
+class MClientSession(_JsonMessage):
+    """Client <-> MDS session control (reference: MClientSession ops
+    REQUEST_OPEN/OPEN/REQUEST_CLOSE/CLOSE)."""
+
+    MSG_TYPE = 22  # CEPH_MSG_CLIENT_SESSION
+    FIELDS = ("op", "client", "seq")
+
+
+@register_message
+class MClientRequest(_JsonMessage):
+    """Metadata op to the MDS (reference: MClientRequest).
+
+    op: lookup | getattr | readdir | create | mkdir | unlink | rmdir |
+        rename | setattr | open
+    args: op-specific {parent, name, ino, srcdir, sname, dstdir, dname,
+        size, mtime, mode}.  `session` is a per-client-process id: the MDS
+    keys a bounded reply cache on (session, tid) so resent requests after
+    a connection reset are answered, not re-executed (the reference's
+    completed-requests session tracking).
+    """
+
+    MSG_TYPE = 24  # CEPH_MSG_CLIENT_REQUEST
+    FIELDS = ("tid", "op", "args", "session")
+
+
+@register_message
+class MClientReply(_JsonMessage):
+    """reference: MClientReply — retval + op-specific result body."""
+
+    MSG_TYPE = 26  # CEPH_MSG_CLIENT_REPLY
+    FIELDS = ("tid", "retval", "result")

@@ -48,7 +48,21 @@ from ..crush.ln_compute import (
 _T1 = TBL1_BYTES  # [256, 16], rows 129.. zero-padded by the builder
 _T2 = TBL2_BYTES  # [256, 8]
 
-DEFAULT_TILE = 64  # rows per grid step ([T, S] tile; S padded to 128)
+DEFAULT_TILE = 32  # rows per grid step ([T, S] tile; S padded to 128).
+# 64 exceeds the 16 MiB scoped-vmem limit on v5e: the two one-hot
+# [T, S, 256] bf16 intermediates hit ~28 MiB; 32 fits with margin and
+# compiles + matches the table gather bit-exactly on hardware.
+
+
+def _disable_x64():
+    """x64-OFF trace scope: the mapper calls this kernel inside its
+    enable_x64() context, and ambient x64 turns index_map/kernel literals
+    into i64 constants Mosaic can't legalize (see common/jaxutil.py).
+    Everything in this kernel is explicit int32/uint32 limb math, so
+    tracing with x64 off is both safe and required."""
+    from ..common.jaxutil import x64_ctx
+
+    return x64_ctx(False)
 
 
 def _onehot_lookup(idx, tbl_bf16):
@@ -120,26 +134,28 @@ def straw2_scores_pallas(x, r, items, tile: int = DEFAULT_TILE,
         raise ValueError(f"S={S} not a multiple of 128")
     x2 = x.reshape(B, 1).astype(jnp.int32)
     r2 = r.reshape(B, 1).astype(jnp.int32)
-    t1 = jnp.asarray(_T1, jnp.bfloat16)
-    t2 = jnp.asarray(_T2, jnp.bfloat16)
-    out = pl.pallas_call(
-        _score_kernel,
-        grid=(B // tile,),
-        in_specs=[
-            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
-            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
-            pl.BlockSpec((tile, S), lambda i: (i, 0)),
-            pl.BlockSpec(_T1.shape, lambda i: (0, 0)),
-            pl.BlockSpec(_T2.shape, lambda i: (0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((tile, S), lambda i: (i, 0)),
-            pl.BlockSpec((tile, S), lambda i: (i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, S), jnp.int32),
-            jax.ShapeDtypeStruct((B, S), jnp.int32),
-        ],
-        interpret=interpret,
-    )(x2, r2, items.astype(jnp.int32), t1, t2)
+    items2 = items.astype(jnp.int32)
+    with _disable_x64():
+        t1 = jnp.asarray(_T1, jnp.bfloat16)
+        t2 = jnp.asarray(_T2, jnp.bfloat16)
+        out = pl.pallas_call(
+            _score_kernel,
+            grid=(B // tile,),
+            in_specs=[
+                pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+                pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+                pl.BlockSpec((tile, S), lambda i: (i, 0)),
+                pl.BlockSpec(_T1.shape, lambda i: (0, 0)),
+                pl.BlockSpec(_T2.shape, lambda i: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((tile, S), lambda i: (i, 0)),
+                pl.BlockSpec((tile, S), lambda i: (i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, S), jnp.int32),
+                jax.ShapeDtypeStruct((B, S), jnp.int32),
+            ],
+            interpret=interpret,
+        )(x2, r2, items2, t1, t2)
     return out

@@ -44,15 +44,18 @@ class LocalCluster:
         hosts: int | None = None,
         conf_overrides: dict | None = None,
         with_mgr: bool = False,
+        with_mds: bool = False,
     ):
         self.n_mons = n_mons
         self.n_osds = n_osds
         self.hosts = hosts or n_osds  # default: one OSD per host bucket
         self.conf_overrides = dict(conf_overrides or {})
         self.with_mgr = with_mgr
+        self.with_mds = with_mds
         self.mons: dict[str, Monitor] = {}
         self.osds: dict[int, OSD] = {}
         self.mgr = None
+        self.mds = None
         self.mon_addrs: list = []
         self._clients: list[Rados] = []
 
@@ -96,6 +99,8 @@ class LocalCluster:
             if m is not None and len(m.osd_addrs) >= self.n_osds:
                 break
             time.sleep(0.1)
+        if self.with_mds:
+            self.start_mds()
         return self
 
     def _cct(self, name: str) -> CephContext:
@@ -120,6 +125,13 @@ class LocalCluster:
         for c in self._clients:
             try:
                 c.shutdown()
+            except Exception:
+                pass
+        # the MDS is a RADOS client: stop it while OSDs are still up so
+        # its shutdown flush can reach the metadata pool
+        if self.mds is not None:
+            try:
+                self.mds.shutdown()
             except Exception:
                 pass
         for osd in list(self.osds.values()):
@@ -187,6 +199,42 @@ class LocalCluster:
             "size": size,
         })
         assert rv == 0, (rv, res)
+
+    # -- filesystem (reference: vstart.sh's cephfs setup) ------------------
+    def start_mds(self) -> None:
+        """Create the FS pools (if absent) and start rank 0 (reference:
+        `ceph fs new` + ceph-mds boot)."""
+        from ..fs import MDSDaemon
+
+        existing = {
+            p.name for p in (self._leader().osdmon.osdmap.pools or {}).values()
+        }
+        if "cephfs_meta" not in existing:
+            self.create_replicated_pool("cephfs_meta", size=min(3, self.n_osds))
+        if "cephfs_data" not in existing:
+            self.create_replicated_pool("cephfs_data", size=min(3, self.n_osds))
+        self.mds = MDSDaemon(self._cct("mds.0"), self.mon_addrs)
+        self.mds.start()
+
+    def kill_mds(self) -> None:
+        """Hard-stop the MDS *without* the shutdown flush — the journal
+        must carry the namespace (reference: MDS failover replay)."""
+        if self.mds is not None:
+            self.mds.hard_kill()
+            self.mds = None
+
+    def restart_mds(self) -> None:
+        self.kill_mds()
+        self.start_mds()
+
+    def fs_client(self, name: str = "client.fs"):
+        from ..fs import FSClient
+
+        assert self.mds is not None and self.mds.addr is not None
+        r = self.client(name)
+        fs = FSClient(r.cct, r, self.mds.addr, name=name)
+        fs.mount()
+        return fs
 
     # -- fault injection ---------------------------------------------------
     def kill_osd(self, i: int) -> None:

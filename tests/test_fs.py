@@ -1,0 +1,211 @@
+"""FS-layer tests: MDS namespace ops, striped file I/O, journal replay
+across an MDS crash (reference: the cephfs subset of qa/ suites — mount,
+pjd-style namespace ops, MDS failover replay; SURVEY.md §2.6).
+"""
+import pytest
+
+from ceph_tpu.qa.vstart import LocalCluster
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with LocalCluster(n_mons=1, n_osds=3, with_mds=True) as c:
+        yield c
+
+
+@pytest.fixture(scope="module")
+def fs(cluster):
+    f = cluster.fs_client()
+    yield f
+    f.unmount()
+
+
+def test_mkdir_listdir(fs):
+    fs.mkdir("/a")
+    fs.mkdir("/a/b")
+    assert "a" in fs.listdir("/")
+    assert list(fs.listdir("/a")) == ["b"]
+    st = fs.stat("/a/b")
+    assert st["type"] == "dir"
+
+
+def test_mkdir_errors(fs):
+    fs.mkdir("/errs")
+    with pytest.raises(FileExistsError):
+        fs.mkdir("/errs")
+    with pytest.raises(FileNotFoundError):
+        fs.listdir("/no/such/dir")
+
+
+def test_file_write_read_roundtrip(fs):
+    fs.mkdir("/d1")
+    f = fs.open("/d1/hello", create=True)
+    f.write(b"hello world")
+    assert f.read() == b"hello world"
+    assert fs.stat("/d1/hello")["size"] == 11
+    # reopen by path
+    assert fs.read_file("/d1/hello") == b"hello world"
+
+
+def test_striped_large_file(fs):
+    """Data > object_size must stripe across objects and come back exact."""
+    data = bytes(range(256)) * 2048  # 512 KiB
+    f = fs.open(
+        "/big", create=True,
+        layout={"pool": "cephfs_data", "object_size": 1 << 16,
+                "stripe_unit": 1 << 12, "stripe_count": 3},
+    )
+    f.write(data)
+    assert f.read() == data
+    # sub-range read crossing stripe boundaries
+    assert f.read(5000, 70000) == data[5000:75000]
+    # partial overwrite in the middle
+    f.write(b"Z" * 9999, 12345)
+    expect = data[:12345] + b"Z" * 9999 + data[12345 + 9999:]
+    assert f.read() == expect
+
+
+def test_sparse_write(fs):
+    f = fs.open("/sparse", create=True)
+    f.write(b"end", 100_000)
+    assert f.size() == 100_003
+    got = f.read()
+    assert got[:100_000] == b"\0" * 100_000 and got[100_000:] == b"end"
+
+
+def test_truncate(fs):
+    f = fs.open("/trunc", create=True)
+    f.write(b"x" * 50_000)
+    f.truncate(100)
+    assert fs.stat("/trunc")["size"] == 100
+    assert f.read() == b"x" * 100
+    # re-extend reads zeros, not stale bytes
+    f.truncate(200)
+    assert f.read() == b"x" * 100 + b"\0" * 100
+
+
+def test_rename_unlink(fs):
+    fs.mkdir("/mv")
+    fs.mkdir("/mv2")
+    fs.write_file("/mv/f", b"payload")
+    fs.rename("/mv/f", "/mv2/g")
+    assert "f" not in fs.listdir("/mv")
+    assert fs.read_file("/mv2/g") == b"payload"
+    fs.unlink("/mv2/g")
+    with pytest.raises(FileNotFoundError):
+        fs.stat("/mv2/g")
+    with pytest.raises(OSError):  # ENOTEMPTY
+        fs.rmdir("/")
+    fs.rmdir("/mv")
+    with pytest.raises(FileNotFoundError):
+        fs.listdir("/mv")
+
+
+def test_rename_over_existing_purges_and_retargets(fs, cluster):
+    """Rename onto an existing file must drop the replaced inode (backptr
+    + data objects), not leak it."""
+    fs.mkdir("/ro")
+    fs.write_file("/ro/old", b"OLD" * 50_000)
+    fs.write_file("/ro/new", b"NEW" * 10)
+    client = cluster.client("client.ro-check")
+    io = client.open_ioctx("cephfs_data")
+    before = len(io.list_objects())
+    fs.rename("/ro/new", "/ro/old")
+    assert fs.read_file("/ro/old") == b"NEW" * 10
+    assert "new" not in fs.listdir("/ro")
+    assert len(io.list_objects()) < before  # replaced data purged
+    # writes through the surviving file must update ITS size, not a ghost
+    f = fs.open("/ro/old")
+    f.write(b"xyz", 0)
+    assert fs.stat("/ro/old")["size"] == 30
+    mt = fs.stat("/ro/old")["mtime"]
+    assert mt > 0
+
+
+def test_rename_into_own_subtree_rejected(fs):
+    fs.mkdir("/cyc")
+    fs.mkdir("/cyc/in")
+    with pytest.raises(OSError):
+        fs.rename("/cyc", "/cyc/in/self")
+    # namespace unchanged and still reachable
+    assert "cyc" in fs.listdir("/")
+    assert "in" in fs.listdir("/cyc")
+
+
+def test_write_updates_mtime(fs):
+    f = fs.open("/mtime_f", create=True)
+    t0 = fs.stat("/mtime_f")["mtime"]
+    f.write(b"a")
+    t1 = fs.stat("/mtime_f")["mtime"]
+    assert t1 >= t0
+    f.write(b"b", 0)  # non-extending write still bumps mtime
+    assert fs.stat("/mtime_f")["mtime"] >= t1
+
+
+def test_unlink_purges_data_objects(fs, cluster):
+    fs.write_file("/purge_me", b"p" * 200_000)
+    client = cluster.client("client.purge-check")
+    io = client.open_ioctx("cephfs_data")
+    before = [o for o in io.list_objects()]
+    fs.unlink("/purge_me")
+    after = [o for o in io.list_objects()]
+    assert len(after) < len(before)
+
+
+def test_mds_crash_replays_journal():
+    """Namespace mutations made after the last flush must survive an MDS
+    hard kill via journal replay (reference: MDLog::replay on failover)."""
+    with LocalCluster(n_mons=1, n_osds=3, with_mds=True) as c:
+        fs = c.fs_client("client.crash")
+        fs.mkdir("/keep")
+        fs.write_file("/keep/data", b"persisted bytes")
+        fs.mkdir("/keep/sub")
+        fs.rename("/keep/data", "/keep/sub/data")
+        c.kill_mds()        # no flush — journal only
+        c.restart_mds()
+        fs2 = c.fs_client("client.crash2")
+        assert list(fs2.listdir("/keep")) == ["sub"]
+        assert fs2.read_file("/keep/sub/data") == b"persisted bytes"
+        fs2.unmount()
+        fs.unmount()
+
+
+def test_setattr_after_flush_survives_crash():
+    """A setattr journaled AFTER its inode's dirfrag was flushed must
+    survive replay (regression: replay resolved inodes through backptrs
+    built only after the replay loop, dropping the size update)."""
+    with LocalCluster(
+        n_mons=1, n_osds=3, with_mds=True,
+        conf_overrides={"mds_journal_segment_events": 2},
+    ) as c:
+        fs = c.fs_client("client.sa")
+        f = fs.open("/flushed_then_grown", create=True)
+        fs.mkdir("/pad1")  # rolls the 2-event segment -> dirfrag flushed
+        f.write(b"eleven chars")  # setattr size=12 lands journal-only
+        c.kill_mds()
+        c.restart_mds()
+        fs2 = c.fs_client("client.sa2")
+        assert fs2.stat("/flushed_then_grown")["size"] == 12
+        assert fs2.read_file("/flushed_then_grown") == b"eleven chars"
+        fs2.unmount()
+        fs.unmount()
+
+
+def test_many_ops_roll_journal_segments():
+    """More events than one segment holds: flush+trim must kick in and the
+    namespace must still be complete after a restart."""
+    with LocalCluster(
+        n_mons=1, n_osds=3, with_mds=True,
+        conf_overrides={"mds_journal_segment_events": 8},
+    ) as c:
+        fs = c.fs_client("client.roll")
+        for i in range(30):
+            fs.mkdir(f"/d{i:02d}")
+        c.kill_mds()
+        c.restart_mds()
+        fs2 = c.fs_client("client.roll2")
+        assert len(fs2.listdir("/")) == 30
+        fs2.unmount()
+        fs.unmount()
