@@ -50,7 +50,12 @@ def _gmul(c: int, arr: np.ndarray) -> np.ndarray:
 
 
 class ClayCodec(ErasureCode):
+    def __init__(self, profile: dict | None = None):
+        self._repair_mat_cache: dict[tuple, np.ndarray] = {}
+        super().__init__(profile)
+
     def init(self, profile: dict) -> None:
+        self._repair_mat_cache.clear()  # re-init invalidates geometry
         self.profile = dict(profile)
         self.k = self.parse_int(profile, "k", 4)
         self.m = self.parse_int(profile, "m", 2)
@@ -250,27 +255,40 @@ class ClayCodec(ErasureCode):
             raise InsufficientChunks(f"need {self.k} chunks, have {len(avail)}")
         return {c: [(0, -1)] for c in sorted(avail)[: self.k]}
 
-    def _repair_one(
-        self, have: dict[int, np.ndarray], lost: int, sub_len: int
-    ) -> np.ndarray:
-        """Rebuild `lost` reading only the repair planes from all survivors."""
-        nq, t, Z = self.q, self.t, self.sub_chunk_count
+    def repair_matrix(self, lost: int, helpers: tuple[int, ...]) -> np.ndarray:
+        """[Z, len(helpers)*nB] GF(2^8) matrix M with
+        ``lost_subchunks = M @ fetched``, where `fetched` stacks each
+        helper's repair-plane sub-chunk rows in helper order.
+
+        The ENTIRE single-shard repair — pair uncoupling, per-plane MDS
+        decode, parity re-encode, recoupling — is GF-linear in the fetched
+        bytes, so it collapses into one cached matrix and repair becomes a
+        single on-device bitplane/Pallas apply.  (TPU-first restructure of
+        ErasureCodeClay::repair's layered host loop; the algebra below IS
+        the layered algorithm, run symbolically on coefficient rows
+        instead of chunk bytes.)"""
+        key = (lost, helpers)
+        cached = self._repair_mat_cache.get(key)
+        if cached is not None:
+            return cached
+        from ...gf.reference_codec import apply_matrix as gf_apply
+
+        nq, Z = self.q, self.sub_chunk_count
         n_nodes = self.k + self.m
         x0, y0 = self._node(lost)
         planes = np.asarray(self.repair_planes(lost))
         nB = planes.size
         plane_pos = np.full(Z, -1, dtype=np.int64)
         plane_pos[planes] = np.arange(nB)
-        from ...ops.bitplane import apply_matrix_jax
-
-        # helper sub-chunks restricted to repair planes (dense array so
-        # (pnode, plane)-pairs gather vectorized)
-        Cb = np.zeros((n_nodes, nB, sub_len), dtype=np.uint8)
-        for node, v in have.items():
-            Cb[node] = v.reshape(Z, sub_len)[planes]
-        U = np.zeros((n_nodes, nB, sub_len), dtype=np.uint8)
+        n_in = len(helpers) * nB
+        # coefficient rows: Cb[node, b] = unit vector of input position
+        # (helper node, repair plane b)
+        Cb = np.zeros((n_nodes, nB, n_in), dtype=np.uint8)
+        for hi, node in enumerate(helpers):
+            Cb[node, np.arange(nB), hi * nB + np.arange(nB)] = 1
+        U = np.zeros((n_nodes, nB, n_in), dtype=np.uint8)
         known_u_nodes = []
-        for node in sorted(have):
+        for node in helpers:
             x, y = self._node(node)
             if y == y0:
                 continue  # column y0 survivors: U unknown in B planes
@@ -292,27 +310,56 @@ class ClayCodec(ErasureCode):
                 f"repair needs {self.k} helpers outside column {y0}, "
                 f"have {len(known_u_nodes)}"
             )
-        dm = decode_matrix_for(self.generator, self.k, known_u_nodes).astype(np.uint8)
+        dm = decode_matrix_for(
+            self.generator, self.k, known_u_nodes
+        ).astype(np.uint8)
         sub = U[known_u_nodes[: self.k]].reshape(self.k, -1)
-        data_u = np.asarray(apply_matrix_jax(dm, sub))
-        full = np.zeros((n_nodes, nB * sub_len), dtype=np.uint8)
+        data_u = gf_apply(dm, sub)
+        full = np.zeros((n_nodes, nB * n_in), dtype=np.uint8)
         full[: self.k] = data_u
-        full[self.k :] = np.asarray(apply_matrix_jax(self.coding, data_u))
+        full[self.k :] = gf_apply(self.coding, data_u)
         for node in unknown:
-            U[node] = full[node].reshape(nB, sub_len)
+            U[node] = full[node].reshape(nB, n_in)
         # rebuild lost chunk: B-planes are vertex (C = U); others via pairs
         zs_all = np.arange(Z)
         dy0 = (zs_all // nq**y0) % nq
         pnode = y0 * nq + dy0                              # [Z]
         zp = zs_all + (x0 - dy0) * nq**y0                  # in B
         zpi = plane_pos[zp]
-        u2 = U[pnode, zpi]                                 # [Z, sub_len]
+        u2 = U[pnode, zpi]                                 # [Z, n_in]
         # C2 = g*U1 ^ U2 with P1=(lost;z), P2=(pnode;zp):
         u1 = _gmul(_INV_G, Cb[pnode, zpi] ^ u2)
-        out = np.where(
+        M = np.where(
             (dy0 == x0)[:, None], U[lost, zpi], u1 ^ _gmul(GAMMA, u2)
         )
-        return out.reshape(Z * sub_len)
+        self._repair_mat_cache[key] = M
+        return M
+
+    def gather_repair_input(
+        self, have: dict[int, np.ndarray], lost: int, sub_len: int,
+        helpers: tuple[int, ...],
+    ) -> np.ndarray:
+        """[len(helpers)*nB, sub_len] — each helper's repair-plane
+        sub-chunks stacked in helper order (the layout repair_matrix
+        contracts over)."""
+        Z = self.sub_chunk_count
+        planes = np.asarray(self.repair_planes(lost))
+        return np.concatenate(
+            [have[n].reshape(Z, sub_len)[planes] for n in helpers]
+        )
+
+    def _repair_one(
+        self, have: dict[int, np.ndarray], lost: int, sub_len: int
+    ) -> np.ndarray:
+        """Rebuild `lost` reading only the repair planes from all
+        survivors: one cached-matrix device apply."""
+        from ...ops.bitplane import apply_matrix_jax
+
+        helpers = tuple(sorted(have))
+        M = self.repair_matrix(lost, helpers)
+        x = self.gather_repair_input(have, lost, sub_len, helpers)
+        out = np.asarray(apply_matrix_jax(M, x))
+        return out.reshape(self.sub_chunk_count * sub_len)
 
 
 class ClayPlugin(ErasureCodePlugin):

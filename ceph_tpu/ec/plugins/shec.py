@@ -50,6 +50,8 @@ class ShecCodec(ErasureCode):
         super().__init__(profile)
 
     def init(self, profile: dict) -> None:
+        self._plan_cache.clear()  # re-init invalidates cached geometry
+        self._dm_cache.clear()
         self.profile = dict(profile)
         self.k = self.parse_int(profile, "k", 4)
         self.m = self.parse_int(profile, "m", 3)

@@ -56,6 +56,7 @@ class LocalCluster:
         self.osds: dict[int, OSD] = {}
         self.mgr = None
         self.mds = None
+        self.rgw = None
         self.mon_addrs: list = []
         self._clients: list[Rados] = []
 
@@ -127,8 +128,13 @@ class LocalCluster:
                 c.shutdown()
             except Exception:
                 pass
-        # the MDS is a RADOS client: stop it while OSDs are still up so
-        # its shutdown flush can reach the metadata pool
+        # gateways and the MDS are RADOS clients: stop them while OSDs are
+        # still up so their shutdown I/O can reach the pools
+        if self.rgw is not None:
+            try:
+                self.rgw.shutdown()
+            except Exception:
+                pass
         if self.mds is not None:
             try:
                 self.mds.shutdown()
@@ -200,19 +206,23 @@ class LocalCluster:
         })
         assert rv == 0, (rv, res)
 
+    def _ensure_replicated_pools(self, *names: str) -> None:
+        """Create any of `names` that don't exist yet (service-pool
+        bootstrap shared by the MDS and RGW starters)."""
+        existing = {
+            p.name for p in (self._leader().osdmon.osdmap.pools or {}).values()
+        }
+        for name in names:
+            if name not in existing:
+                self.create_replicated_pool(name, size=min(3, self.n_osds))
+
     # -- filesystem (reference: vstart.sh's cephfs setup) ------------------
     def start_mds(self) -> None:
         """Create the FS pools (if absent) and start rank 0 (reference:
         `ceph fs new` + ceph-mds boot)."""
         from ..fs import MDSDaemon
 
-        existing = {
-            p.name for p in (self._leader().osdmon.osdmap.pools or {}).values()
-        }
-        if "cephfs_meta" not in existing:
-            self.create_replicated_pool("cephfs_meta", size=min(3, self.n_osds))
-        if "cephfs_data" not in existing:
-            self.create_replicated_pool("cephfs_data", size=min(3, self.n_osds))
+        self._ensure_replicated_pools("cephfs_meta", "cephfs_data")
         self.mds = MDSDaemon(self._cct("mds.0"), self.mon_addrs)
         self.mds.start()
 
@@ -235,6 +245,16 @@ class LocalCluster:
         fs = FSClient(r.cct, r, self.mds.addr, name=name)
         fs.mount()
         return fs
+
+    # -- object gateway (reference: radosgw) -------------------------------
+    def start_rgw(self):
+        """Create the rgw pools (if absent) and start the S3 gateway."""
+        from ..rgw import RGWDaemon
+
+        self._ensure_replicated_pools("rgw_meta", "rgw_data")
+        self.rgw = RGWDaemon(self._cct("rgw.0"), self.mon_addrs)
+        self.rgw.start()
+        return self.rgw
 
     # -- fault injection ---------------------------------------------------
     def kill_osd(self, i: int) -> None:

@@ -1,0 +1,10 @@
+"""RGW-analog object gateway (reference: src/rgw; SURVEY.md §2.6).
+
+An HTTP gateway speaking the S3 REST dialect's core surface — buckets,
+objects, prefix/marker listing, multipart upload — over librados, with
+bucket indexes and object data living in RADOS pools exactly as the
+reference's .rgw.* pools do.
+"""
+from .gateway import RGWDaemon
+
+__all__ = ["RGWDaemon"]

@@ -1,0 +1,415 @@
+"""RGWDaemon — S3-dialect HTTP gateway over librados (reference:
+src/rgw/rgw_main.cc + rgw_rest_s3.cc + rgw_op.cc, the
+bucket/object/multipart core; SURVEY.md §2.6).
+
+Layout in RADOS (mirroring the reference's pool split):
+
+- ``rgw_meta`` pool: ``buckets`` (the bucket catalog, JSON) and one
+  ``idx.{bucket}`` object per bucket — the bucket index the reference
+  keeps in .rgw.buckets.index omaps (key -> size/etag/mtime).
+- ``rgw_data`` pool: object payloads, striped via the striper as
+  ``{bucket}/{key}`` streams (reference: .rgw.buckets.data with
+  manifest-driven striping); multipart parts as
+  ``{bucket}/{key}.part.{uploadId}.{n}`` promoted on complete.
+
+Surface: GET / (ListAllMyBuckets), PUT/DELETE/GET /bucket (create,
+delete, ListObjects v1 with prefix/marker/max-keys), PUT/GET/HEAD/DELETE
+/bucket/key, POST ?uploads / PUT ?partNumber / POST ?uploadId (multipart
+create/upload/complete), DELETE ?uploadId (abort).  Responses are the S3
+XML bodies; ETags are MD5 hex (multipart: MD5-of-MD5s with -N suffix,
+the S3 convention).  Request signing (AWS SigV4, cephx-backed in the
+reference) is out of scope — the gateway serves every caller, like a
+reference zone with anonymous access grants.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+from ..client.striper import StripedObject
+
+META_POOL = "rgw_meta"
+DATA_POOL = "rgw_data"
+
+
+def _xml_escape(s: str) -> str:
+    return (
+        s.replace("&", "&amp;").replace("<", "&lt;").replace(">", "&gt;")
+    )
+
+
+class _Store:
+    """Bucket catalog + per-bucket index + striped object data, shared by
+    all request threads under one lock (the reference shards this across
+    index objects; one gateway-wide lock serves the test scale)."""
+
+    def __init__(self, rados):
+        self.rados = rados
+        self.meta = rados.open_ioctx(META_POOL)
+        self.data = rados.open_ioctx(DATA_POOL)
+        self.lock = threading.RLock()
+        self.uploads: dict[str, dict] = {}  # uploadId -> {bucket,key,parts}
+
+    # -- catalog -----------------------------------------------------------
+    def _read_json(self, io, oid, default):
+        try:
+            return json.loads(io.read(oid))
+        except (IOError, ValueError):
+            return default
+
+    def buckets(self) -> dict:
+        return self._read_json(self.meta, "buckets", {})
+
+    def _write_buckets(self, b: dict) -> None:
+        self.meta.write_full("buckets", json.dumps(b).encode())
+
+    def index(self, bucket: str) -> dict:
+        return self._read_json(self.meta, f"idx.{bucket}", {})
+
+    def _write_index(self, bucket: str, idx: dict) -> None:
+        self.meta.write_full(f"idx.{bucket}", json.dumps(idx).encode())
+
+    # -- bucket ops --------------------------------------------------------
+    def create_bucket(self, bucket: str) -> bool:
+        with self.lock:
+            b = self.buckets()
+            if bucket in b:
+                return False
+            b[bucket] = {"created": time.time()}
+            self._write_buckets(b)
+            self._write_index(bucket, {})
+            return True
+
+    def delete_bucket(self, bucket: str) -> int:
+        """0 ok, -404 no bucket, -409 not empty."""
+        with self.lock:
+            b = self.buckets()
+            if bucket not in b:
+                return -404
+            if self.index(bucket):
+                return -409
+            del b[bucket]
+            self._write_buckets(b)
+            try:
+                self.meta.remove(f"idx.{bucket}")
+            except IOError:
+                pass
+            return 0
+
+    # -- object ops --------------------------------------------------------
+    def _stream(self, bucket: str, key: str) -> StripedObject:
+        return StripedObject(
+            self.data, f"{bucket}/{key}",
+            object_size=1 << 22, stripe_unit=1 << 16, stripe_count=4,
+        )
+
+    def put_object(self, bucket: str, key: str, body: bytes) -> str | None:
+        with self.lock:
+            if bucket not in self.buckets():
+                return None
+            etag = hashlib.md5(body).hexdigest()
+            s = self._stream(bucket, key)
+            s.truncate(0)
+            s.write(body)
+            idx = self.index(bucket)
+            idx[key] = {
+                "size": len(body), "etag": etag, "mtime": time.time()
+            }
+            self._write_index(bucket, idx)
+            return etag
+
+    def get_object(self, bucket: str, key: str):
+        with self.lock:
+            ent = self.index(bucket).get(key)
+            if ent is None:
+                return None, None
+            return self._stream(bucket, key).read(0, ent["size"]), ent
+
+    def head_object(self, bucket: str, key: str):
+        with self.lock:
+            return self.index(bucket).get(key)
+
+    def delete_object(self, bucket: str, key: str) -> bool:
+        with self.lock:
+            idx = self.index(bucket)
+            if key not in idx:
+                return False
+            self._stream(bucket, key).remove()
+            del idx[key]
+            self._write_index(bucket, idx)
+            return True
+
+    # -- multipart ---------------------------------------------------------
+    def create_upload(self, bucket: str, key: str) -> str | None:
+        with self.lock:
+            if bucket not in self.buckets():
+                return None
+            uid = uuid.uuid4().hex
+            self.uploads[uid] = {"bucket": bucket, "key": key, "parts": {}}
+            return uid
+
+    def put_part(self, uid: str, n: int, body: bytes) -> str | None:
+        with self.lock:
+            up = self.uploads.get(uid)
+            if up is None:
+                return None
+            etag = hashlib.md5(body).hexdigest()
+            s = self._stream(up["bucket"], f"{up['key']}.part.{uid}.{n}")
+            s.truncate(0)
+            s.write(body)
+            up["parts"][n] = {"size": len(body), "etag": etag}
+            return etag
+
+    def complete_upload(self, uid: str) -> tuple[str, str, str] | None:
+        """Concatenate parts in part-number order into the final object
+        (the reference writes a manifest instead of copying; copy keeps
+        the data path simple here).  Returns (bucket, key, etag)."""
+        with self.lock:
+            up = self.uploads.pop(uid, None)
+            if up is None or not up["parts"]:
+                return None
+            bucket, key = up["bucket"], up["key"]
+            dst = self._stream(bucket, key)
+            dst.truncate(0)
+            off = 0
+            md5s = b""
+            for n in sorted(up["parts"]):
+                part = self._stream(bucket, f"{key}.part.{uid}.{n}")
+                body = part.read()
+                dst.write(body, off)
+                off += len(body)
+                md5s += bytes.fromhex(up["parts"][n]["etag"])
+                part.remove()
+            etag = (
+                f"{hashlib.md5(md5s).hexdigest()}-{len(up['parts'])}"
+            )
+            idx = self.index(bucket)
+            idx[key] = {"size": off, "etag": etag, "mtime": time.time()}
+            self._write_index(bucket, idx)
+            return bucket, key, etag
+
+    def abort_upload(self, uid: str) -> bool:
+        with self.lock:
+            up = self.uploads.pop(uid, None)
+            if up is None:
+                return False
+            for n in sorted(up["parts"]):
+                self._stream(
+                    up["bucket"], f"{up['key']}.part.{uid}.{n}"
+                ).remove()
+            return True
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    store: _Store  # injected by RGWDaemon
+
+    def log_message(self, fmt, *args):  # route through cct logging
+        self.server.cct.dout("rgw", 10, f"rgw: {fmt % args}")
+
+    # -- helpers -----------------------------------------------------------
+    def _path(self) -> tuple[str, str, dict]:
+        u = urlparse(self.path)
+        parts = u.path.lstrip("/").split("/", 1)
+        bucket = unquote(parts[0]) if parts[0] else ""
+        key = unquote(parts[1]) if len(parts) > 1 else ""
+        return bucket, key, parse_qs(u.query, keep_blank_values=True)
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    def _reply(self, code: int, body: bytes = b"",
+               ctype: str = "application/xml",
+               headers: dict | None = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _error(self, code: int, s3code: str) -> None:
+        body = (
+            f'<?xml version="1.0"?><Error><Code>{s3code}</Code>'
+            f"</Error>".encode()
+        )
+        self._reply(code, body)
+
+    # -- verbs -------------------------------------------------------------
+    def do_GET(self):
+        bucket, key, q = self._path()
+        if not bucket:
+            # ListAllMyBuckets
+            items = "".join(
+                f"<Bucket><Name>{_xml_escape(n)}</Name></Bucket>"
+                for n in sorted(self.store.buckets())
+            )
+            self._reply(200, (
+                '<?xml version="1.0"?><ListAllMyBucketsResult>'
+                f"<Buckets>{items}</Buckets></ListAllMyBucketsResult>"
+            ).encode())
+            return
+        if not key:
+            if bucket not in self.store.buckets():
+                return self._error(404, "NoSuchBucket")
+            prefix = q.get("prefix", [""])[0]
+            marker = q.get("marker", [""])[0]
+            max_keys = int(q.get("max-keys", ["1000"])[0])
+            idx = self.store.index(bucket)
+            keys = sorted(
+                k for k in idx
+                if k.startswith(prefix) and k > marker
+            )
+            truncated = len(keys) > max_keys
+            keys = keys[:max_keys]
+            items = "".join(
+                f"<Contents><Key>{_xml_escape(k)}</Key>"
+                f"<Size>{idx[k]['size']}</Size>"
+                f'<ETag>"{idx[k]["etag"]}"</ETag></Contents>'
+                for k in keys
+            )
+            self._reply(200, (
+                '<?xml version="1.0"?><ListBucketResult>'
+                f"<Name>{_xml_escape(bucket)}</Name>"
+                f"<Prefix>{_xml_escape(prefix)}</Prefix>"
+                f"<IsTruncated>{str(truncated).lower()}</IsTruncated>"
+                f"{items}</ListBucketResult>"
+            ).encode())
+            return
+        body, ent = self.store.get_object(bucket, key)
+        if ent is None:
+            return self._error(404, "NoSuchKey")
+        self._reply(
+            200, body, ctype="application/octet-stream",
+            headers={"ETag": f'"{ent["etag"]}"'},
+        )
+
+    def do_HEAD(self):
+        bucket, key, _ = self._path()
+        ent = self.store.head_object(bucket, key) if key else None
+        if ent is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(ent["size"]))
+        self.send_header("ETag", f'"{ent["etag"]}"')
+        self.end_headers()
+
+    def do_PUT(self):
+        bucket, key, q = self._path()
+        if not bucket:
+            return self._error(400, "InvalidRequest")
+        if not key:
+            self.store.create_bucket(bucket)  # idempotent, like S3
+            self._reply(200)
+            return
+        body = self._body()
+        if "partNumber" in q and "uploadId" in q:
+            etag = self.store.put_part(
+                q["uploadId"][0], int(q["partNumber"][0]), body
+            )
+            if etag is None:
+                return self._error(404, "NoSuchUpload")
+            self._reply(200, headers={"ETag": f'"{etag}"'})
+            return
+        etag = self.store.put_object(bucket, key, body)
+        if etag is None:
+            return self._error(404, "NoSuchBucket")
+        self._reply(200, headers={"ETag": f'"{etag}"'})
+
+    def do_POST(self):
+        bucket, key, q = self._path()
+        self._body()  # drain (CompleteMultipartUpload part list unused)
+        if "uploads" in q:
+            uid = self.store.create_upload(bucket, key)
+            if uid is None:
+                return self._error(404, "NoSuchBucket")
+            self._reply(200, (
+                '<?xml version="1.0"?><InitiateMultipartUploadResult>'
+                f"<UploadId>{uid}</UploadId>"
+                "</InitiateMultipartUploadResult>"
+            ).encode())
+            return
+        if "uploadId" in q:
+            done = self.store.complete_upload(q["uploadId"][0])
+            if done is None:
+                return self._error(404, "NoSuchUpload")
+            b, k, etag = done
+            self._reply(200, (
+                '<?xml version="1.0"?><CompleteMultipartUploadResult>'
+                f"<Key>{_xml_escape(k)}</Key>"
+                f'<ETag>"{etag}"</ETag>'
+                "</CompleteMultipartUploadResult>"
+            ).encode())
+            return
+        self._error(400, "InvalidRequest")
+
+    def do_DELETE(self):
+        bucket, key, q = self._path()
+        if key and "uploadId" in q:
+            if not self.store.abort_upload(q["uploadId"][0]):
+                return self._error(404, "NoSuchUpload")
+            self._reply(204)
+            return
+        if key:
+            if not self.store.delete_object(bucket, key):
+                return self._error(404, "NoSuchKey")
+            self._reply(204)
+            return
+        rv = self.store.delete_bucket(bucket)
+        if rv == -404:
+            return self._error(404, "NoSuchBucket")
+        if rv == -409:
+            return self._error(409, "BucketNotEmpty")
+        self._reply(204)
+
+
+class RGWDaemon:
+    """reference: the radosgw daemon — binds HTTP, serves S3 over its own
+    librados client."""
+
+    def __init__(self, cct, mon_addrs, port: int = 0):
+        self.cct = cct
+        self.mon_addrs = mon_addrs
+        self.port = port
+        self.httpd: ThreadingHTTPServer | None = None
+        self._rados = None
+        self._thread: threading.Thread | None = None
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        assert self.httpd is not None
+        return self.httpd.server_address[:2]
+
+    def start(self) -> None:
+        from ..client.rados import Rados
+
+        self._rados = Rados(self.cct, self.mon_addrs, name="client.rgw")
+        self._rados.connect(timeout=30.0)
+        store = _Store(self._rados)
+        handler = type("BoundHandler", (_Handler,), {"store": store})
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", self.port), handler)
+        self.httpd.cct = self.cct
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="rgw-http", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        if self.httpd is not None:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._rados is not None:
+            self._rados.shutdown()
