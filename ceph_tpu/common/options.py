@@ -127,6 +127,19 @@ def default_options() -> OptionTable:
                    runtime=True),
             Option("mgr_stale_report_age", float, 30.0,
                    "drop daemon reports older than this", min=1.0),
+            Option("mon_target_pg_per_osd", int, 100,
+                   "PGs per OSD the autoscaler aims for (reference: "
+                   "mon_target_pg_per_osd)", min=1, runtime=True),
+            Option("mgr_pg_autoscale_threshold", float, 3.0,
+                   "adjust only when off-target by this factor "
+                   "(reference: the autoscaler's 3x rule)", min=1.0,
+                   runtime=True),
+            Option("mgr_pg_autoscale_interval", float, 15.0,
+                   "seconds between autoscaler passes", min=0.1,
+                   runtime=True),
+            Option("mgr_pg_autoscale_active", bool, False,
+                   "autoscaler applies pg_num changes (false = advise)",
+                   runtime=True),
             # -- mds (reference: mds.yaml.in) ------------------------------
             Option("debug_mds", int, 1, "mds debug level", min=0, max=20,
                    runtime=True),
@@ -135,7 +148,10 @@ def default_options() -> OptionTable:
                    "trim (reference: mds_log_events_per_segment)", min=1),
             # -- objectstore (reference: bluestore options) ----------------
             Option("objectstore", str, "memstore", "backend for new OSDs",
-                   enum=("memstore", "filestore")),
+                   enum=("memstore", "filestore", "bluestore")),
+            Option("bluestore_block_size", int, 1 << 30,
+                   "bluestore device-file size in bytes (reference: "
+                   "bluestore_block_size)", min=1 << 20),
             Option("objectstore_wal_sync", bool, True,
                    "fsync the WAL on every commit"),
             Option("objectstore_checksum", bool, True,

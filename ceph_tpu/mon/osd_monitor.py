@@ -180,11 +180,66 @@ class OSDMonitor:
             (m.flags.add if prefix == "osd set" else m.flags.discard)(flag)
             return (0, f"{flag} {'set' if prefix == 'osd set' else 'unset'}") \
                 if self._propose_map(m) else (-110, "proposal timed out")
+        if prefix == "osd pool set":
+            return self._cmd_pool_set(cmd)
         if prefix == "osd pg-upmap-items":
             return self._cmd_upmap_items(cmd)
         if prefix == "osd tree":
             return 0, self._cmd_tree()
         return -22, f"unknown command {prefix!r}"
+
+    def _cmd_pool_set(self, cmd: dict) -> tuple[int, object]:
+        """`osd pool set <pool> <key> <value>` — pg_num/pgp_num/size
+        (reference: OSDMonitor::prepare_command_pool_set).  pg_num may
+        only grow (splits; merges are out of scope), and pgp_num follows
+        pg_num so placement tracks the split immediately."""
+        name = cmd.get("name", "")
+        key = cmd.get("key", "")
+        try:
+            value = int(cmd.get("value"))
+        except (TypeError, ValueError):
+            return -22, f"pool set {key}: integer value required"
+        m = self._pending()
+        pool = next((p for p in m.pools.values() if p.name == name), None)
+        if pool is None:
+            return -2, f"no pool {name!r}"
+        if key == "pg_num":
+            if value < pool.pg_num:
+                return -22, (
+                    f"pg_num {value} < current {pool.pg_num}: "
+                    "merges not supported"
+                )
+            if value == pool.pg_num:
+                return 0, f"pg_num already {value}"
+            per_osd = self.mon.cct.conf.get("mon_max_pg_per_osd")
+            n_osds = max(1, sum(1 for o in range(m.max_osd) if m.is_up(o)))
+            if value * pool.size > per_osd * n_osds:
+                return -34, (  # ERANGE, as the reference returns
+                    f"pg_num {value} would exceed "
+                    f"mon_max_pg_per_osd {per_osd}"
+                )
+            pool.pg_num = value
+            pool.pgp_num = value
+        elif key == "pgp_num":
+            if not (1 <= value <= pool.pg_num):
+                return -22, (
+                    f"pgp_num {value} must be in [1, pg_num={pool.pg_num}]"
+                )
+            pool.pgp_num = value
+        elif key == "size":
+            if pool.type == PG_POOL_ERASURE:
+                # EC width is k+m from the profile, not a free knob
+                return -95, "cannot change size of an erasure-coded pool"
+            if not (1 <= value <= 10):
+                return -22, f"size {value} out of range"
+            pool.size = value
+            # keep the derived write quorum consistent (the same rule
+            # PGPool.__post_init__ applies at creation)
+            pool.min_size = value // 2 + 1
+        else:
+            return -22, f"unknown pool key {key!r}"
+        return (0, f"set pool {name} {key} to {value}") \
+            if self._propose_map(m) else (-110, "proposal timed out")
 
     def _cmd_tree(self) -> list[dict]:
         """reference: `ceph osd tree` (OSDMonitor dumping the CRUSH

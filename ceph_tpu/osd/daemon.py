@@ -67,6 +67,9 @@ class PGState:
         self.ps = ps
         self.log = PGLog()
         self.version = 0
+        # highest pool pg_num this PG has been split-scanned under (0 =
+        # scan on next pass; in-memory: a restart just rescans)
+        self.split_scanned = 0
         self.lock = make_lock("osd::pg")
 
     def meta_oid(self) -> str:
@@ -89,12 +92,22 @@ class OSD(Dispatcher):
             if kind == "memstore":
                 self.store = MemStore()
             else:
+                import os
+
                 from ..store.object_store import create_store
 
+                data_dir = cct.conf.get("osd_data") or None
+                if data_dir:
+                    # per-daemon subdir (reference: osd_data defaults to
+                    # /var/lib/ceph/osd/$cluster-$id — never shared)
+                    data_dir = os.path.join(data_dir, self.whoami)
                 self.store = create_store(
                     kind,
-                    cct.conf.get("osd_data") or None,
+                    data_dir,
                     compression=cct.conf.get("objectstore_compression"),
+                    sync=cct.conf.get("objectstore_wal_sync"),
+                    checksum=cct.conf.get("objectstore_checksum"),
+                    device_size=cct.conf.get("bluestore_block_size"),
                 )
         self.messenger = Messenger.create(cct, self.whoami)
         self.messenger.default_policy = POLICY_LOSSLESS_PEER
@@ -124,6 +137,7 @@ class OSD(Dispatcher):
         })
         self._workers: list[threading.Thread] = []
         self._recovery_inflight = False
+        self._split_inflight = False
         self._last_scrub = 0.0
         self._scrubs_queued: set[str] = set()
         # reference: OSD::create_logger (l_osd_op / l_osd_op_w / ...)
@@ -352,8 +366,10 @@ class OSD(Dispatcher):
         if isinstance(
             msg,
             (MECSubOpWriteReply, MECSubOpReadReply, MPGNotify,
-             MScrubShardReply),
+             MScrubShardReply, MOSDOpReply),
         ):
+            # MOSDOpReply arrives when this OSD acts as its own client
+            # (split migration forwarding ops to the post-split primary)
             with self._lock:
                 self._sub_replies[msg.tid] = msg
                 self._cond.notify_all()
@@ -441,6 +457,10 @@ class OSD(Dispatcher):
             and msg.oid.startswith(":pg:")
         ):
             ps = int(msg.oid[4:])  # pg-targeted op (tools/librados)
+        elif getattr(msg, "ps", None) is not None:
+            # explicit placement seed: the split migrator addressing an
+            # object still housed in its pre-split PG
+            ps = int(msg.ps)
         else:
             ps = object_ps(msg.oid, pool.pg_num) if msg.oid else 0
         if msg.op == "scrub":
@@ -1372,6 +1392,11 @@ class OSD(Dispatcher):
                     self.scheduler.enqueue(
                         "background_recovery", self._recover_all_work
                     )
+                if not self._split_inflight:
+                    self._split_inflight = True
+                    self.scheduler.enqueue(
+                        "background_recovery", self._split_pass_work
+                    )
                 self._maybe_schedule_scrub(now)
             except Exception as e:
                 self.cct.dout("osd", 0, f"{self.whoami} tick failed: {e!r}")
@@ -1381,6 +1406,135 @@ class OSD(Dispatcher):
             self._recover_all()
         finally:
             self._recovery_inflight = False
+
+    # -- PG split migration (pg_num increase) ------------------------------
+    def _split_pass_work(self) -> None:
+        try:
+            self._split_pass()
+        finally:
+            self._split_inflight = False
+
+    def _split_pass(self) -> None:
+        """Migrate objects stranded in pre-split PGs (reference: PG split —
+        OSD::split_pgs + backfill; here the old-PG primary rewrites each
+        misplaced object through the normal client-op path to its
+        post-split PG, then deletes the old copy).
+
+        Eventually consistent: the pass re-runs every tick until each
+        primary PG has been scanned clean under the current pg_num, so an
+        OSD that was down during the split finishes the job when it
+        returns.  Window semantics: until an object is migrated, clients
+        on the new map read -ENOENT from the post-split PG (the reference
+        covers this window with pg history + peering; SURVEY's data plane
+        accepts the brief window)."""
+        m = self.osdmap
+        if m is None:
+            return
+        for pgid, pg in list(self.pgs.items()):
+            if self._stop.is_set():
+                return
+            pool = m.pools.get(pg.pool_id)
+            if pool is None or pg.split_scanned >= pool.pg_num:
+                continue
+            _acting, primary = self._acting(pg.pool_id, pg.ps)
+            if primary != self.id:
+                continue  # re-checked next pass (primary may change)
+            try:
+                self._split_migrate_pg(pg, pool)
+                pg.split_scanned = pool.pg_num
+            except Exception as e:
+                self.cct.dout(
+                    "osd", 1, f"{self.whoami} split pass {pgid}: {e!r}"
+                )
+
+    def _split_migrate_pg(self, pg, pool) -> None:
+        rep = self._execute_client_op(MOSDOp(
+            tid=self._next_tid(), pool=pg.pool_id, oid=f":pg:{pg.ps}",
+            op="list", epoch=self.my_epoch(),
+        ))
+        if rep.retval != 0:
+            raise RuntimeError(f"split list: {rep.result}")
+        for oid in (rep.result or {}).get("oids") or []:
+            new_ps = object_ps(oid, pool.pg_num)
+            if new_ps != pg.ps:
+                self._migrate_object(pg, pool, oid, new_ps)
+
+    def _forward_op(self, target: int, msg: MOSDOp):
+        """Execute an op locally when this OSD is the target primary, else
+        ship it and wait (the OSD acting as its own Objecter)."""
+        if target == self.id:
+            return self._execute_client_op(msg)
+        conn = self._conn_to_osd(target)
+        conn.send_message(msg)
+        return self._wait_reply(msg.tid, timeout=15.0)
+
+    def _migrate_object(self, pg, pool, oid: str, new_ps: int) -> None:
+        """write-to-new-PG before delete-from-old: a crash mid-migration
+        leaves a duplicate (invisible: lookups hash to the new PG), never
+        a loss.
+
+        Lost-update guard: a client on the new map may have ALREADY
+        written the object into its post-split PG; the stale pre-split
+        copy must not clobber it, so the destination is stat'd first and
+        a hit just drops the old copy.  (A write landing between the stat
+        and our write is the residual window; the reference closes it
+        with peering's authoritative log — out of scope here and noted.)
+        """
+        e = self.my_epoch()
+        _a, new_primary = self._acting(pg.pool_id, new_ps)
+        st = self._forward_op(new_primary, MOSDOp(
+            tid=self._next_tid(), pool=pg.pool_id, oid=oid, op="stat",
+            epoch=e,
+        ))
+        if st is not None and st.retval == 0:
+            # newer post-split copy exists: just retire the stale one
+            d = self._execute_client_op(MOSDOp(
+                tid=self._next_tid(), pool=pg.pool_id, oid=oid,
+                op="delete", epoch=e, ps=pg.ps,
+            ))
+            if d.retval != 0:
+                raise RuntimeError(f"split retire {oid}: {d.result}")
+            return
+        r = self._execute_client_op(MOSDOp(
+            tid=self._next_tid(), pool=pg.pool_id, oid=oid, op="read",
+            epoch=e, ps=pg.ps, off=0, length=0,
+        ))
+        if r.retval != 0:
+            raise RuntimeError(f"split read {oid}: {r.result}")
+        xr = self._execute_client_op(MOSDOp(
+            tid=self._next_tid(), pool=pg.pool_id, oid=oid,
+            op="getxattrs", epoch=e, ps=pg.ps,
+        ))
+        xattrs = xr.result if xr.retval == 0 else None
+        _a, new_primary = self._acting(pg.pool_id, new_ps)
+        w = self._forward_op(new_primary, MOSDOp(
+            tid=self._next_tid(), pool=pg.pool_id, oid=oid,
+            op="write_full", data=r.data, epoch=e,
+        ))
+        if w is None or w.retval != 0:
+            raise RuntimeError(
+                f"split write {oid}: {w.result if w else 'timeout'}"
+            )
+        if xattrs:
+            xw = self._forward_op(new_primary, MOSDOp(
+                tid=self._next_tid(), pool=pg.pool_id, oid=oid,
+                op="setxattr", data=xattrs, epoch=e,
+            ))
+            if xw is None or xw.retval != 0:
+                raise RuntimeError(
+                    f"split xattrs {oid}: {xw.result if xw else 'timeout'}"
+                )
+        d = self._execute_client_op(MOSDOp(
+            tid=self._next_tid(), pool=pg.pool_id, oid=oid, op="delete",
+            epoch=e, ps=pg.ps,
+        ))
+        if d.retval != 0:
+            raise RuntimeError(f"split delete {oid}: {d.result}")
+        self.cct.dout(
+            "osd", 10,
+            f"{self.whoami} split: migrated {oid} "
+            f"{pg.pool_id}.{pg.ps} -> {pg.pool_id}.{new_ps}",
+        )
 
     def _maybe_schedule_scrub(self, now: float) -> None:
         """Periodic deep scrub of primary PGs (reference: OSD::sched_scrub;
@@ -1429,12 +1583,26 @@ class OSD(Dispatcher):
         # contend on _pgs_lock, and an O(objects) walk per report tick
         # must not delay them toward the failure-report threshold
         num_objects = 0
+        pool_bytes: dict[int, int] = {}
         for cid in self.store.list_collections():
+            pool_id = None
+            if "." in cid:
+                try:
+                    pool_id = int(cid.split(".", 1)[0])
+                except ValueError:
+                    pool_id = None
             try:
                 num_objects += sum(
                     1 for o in self.store.list_objects(cid)
                     if not o.startswith("_")
                 )
+                if pool_id is not None:
+                    # backends answer from their in-RAM metadata (onodes /
+                    # RAM image), keeping the report walk O(names)
+                    pool_bytes[pool_id] = (
+                        pool_bytes.get(pool_id, 0)
+                        + self.store.collection_bytes(cid)
+                    )
             except Exception:
                 pass
         self.logger.set("numpg", num_pgs)
@@ -1444,7 +1612,10 @@ class OSD(Dispatcher):
                     daemon=self.whoami,
                     counters=self.cct.perf.dump(),
                     epoch=self.my_epoch(),
-                    stats={"num_pgs": num_pgs, "num_objects": num_objects},
+                    stats={"num_pgs": num_pgs, "num_objects": num_objects,
+                           "pool_bytes": {
+                               str(k): v for k, v in pool_bytes.items()
+                           }},
                 )
             )
         except (OSError, ConnectionError, ValueError):

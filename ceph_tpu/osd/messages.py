@@ -29,10 +29,14 @@ class MOSDOp(_JsonMessage):
 
     op: write_full | read | delete | stat | list (pg listing for tools).
     `epoch` is the client's map epoch: a primary on a newer map NACKs with
-    -ESTALE so the client refreshes and resends (Objecter resend rule)."""
+    -ESTALE so the client refreshes and resends (Objecter resend rule).
+    `ps` overrides the oid-hash placement seed — the PG-split migrator
+    addresses an object still living in its pre-split PG this way (the
+    reference reaches old PGs through pg history / past_intervals)."""
 
     MSG_TYPE = 42
-    FIELDS = ("tid", "pool", "oid", "op", "data", "epoch", "off", "length")
+    FIELDS = ("tid", "pool", "oid", "op", "data", "epoch", "off", "length",
+              "ps")
 
 
 @register_message

@@ -53,7 +53,31 @@ class _Store:
         self.meta = rados.open_ioctx(META_POOL)
         self.data = rados.open_ioctx(DATA_POOL)
         self.lock = threading.RLock()
-        self.uploads: dict[str, dict] = {}  # uploadId -> {bucket,key,parts}
+        # uploadId -> {bucket, key, parts}; persisted as mpu.{uid} objects
+        # in the meta pool (reference: RGW's multipart upload meta objects
+        # in the bucket index namespace) so a gateway restart neither
+        # forgets in-flight uploads nor orphans their part data
+        self.uploads: dict[str, dict] = {}
+        for oid in self.meta.list_objects():
+            if oid.startswith("mpu."):
+                up = self._read_json(self.meta, oid, None)
+                if up is not None:
+                    up["parts"] = {
+                        int(n): v for n, v in up.get("parts", {}).items()
+                    }
+                    self.uploads[oid[4:]] = up
+
+    def _persist_upload(self, uid: str) -> None:
+        up = self.uploads[uid]
+        body = dict(up, parts={str(n): v for n, v in up["parts"].items()})
+        self.meta.write_full(f"mpu.{uid}", json.dumps(body).encode())
+
+    def _drop_upload(self, uid: str) -> None:
+        self.uploads.pop(uid, None)
+        try:
+            self.meta.remove(f"mpu.{uid}")
+        except IOError:
+            pass
 
     # -- catalog -----------------------------------------------------------
     def _read_json(self, io, oid, default):
@@ -99,6 +123,13 @@ class _Store:
                 self.meta.remove(f"idx.{bucket}")
             except IOError:
                 pass
+            # reap the bucket's in-flight multipart uploads (their part
+            # objects would otherwise be orphaned in rgw_data)
+            for uid in [
+                u for u, up in self.uploads.items()
+                if up["bucket"] == bucket
+            ]:
+                self.abort_upload(uid)
             return 0
 
     # -- object ops --------------------------------------------------------
@@ -151,6 +182,7 @@ class _Store:
                 return None
             uid = uuid.uuid4().hex
             self.uploads[uid] = {"bucket": bucket, "key": key, "parts": {}}
+            self._persist_upload(uid)
             return uid
 
     def put_part(self, uid: str, n: int, body: bytes) -> str | None:
@@ -163,16 +195,29 @@ class _Store:
             s.truncate(0)
             s.write(body)
             up["parts"][n] = {"size": len(body), "etag": etag}
+            self._persist_upload(uid)
             return etag
 
-    def complete_upload(self, uid: str) -> tuple[str, str, str] | None:
+    def complete_upload(self, uid: str):
         """Concatenate parts in part-number order into the final object
         (the reference writes a manifest instead of copying; copy keeps
-        the data path simple here).  Returns (bucket, key, etag)."""
+        the data path simple here).
+
+        Returns ("ok", (bucket, key, etag)) | ("nosuch",) — unknown id or
+        bucket deleted under the upload | ("empty",) — zero parts, the
+        upload stays alive (S3 rejects the complete without killing it).
+        """
         with self.lock:
-            up = self.uploads.pop(uid, None)
-            if up is None or not up["parts"]:
-                return None
+            up = self.uploads.get(uid)
+            if up is None:
+                return ("nosuch",)
+            if not up["parts"]:
+                return ("empty",)
+            if up["bucket"] not in self.buckets():
+                # bucket vanished: the upload is dead; reap the parts
+                self.abort_upload(uid)
+                return ("nosuch",)
+            self._drop_upload(uid)
             bucket, key = up["bucket"], up["key"]
             dst = self._stream(bucket, key)
             dst.truncate(0)
@@ -191,18 +236,23 @@ class _Store:
             idx = self.index(bucket)
             idx[key] = {"size": off, "etag": etag, "mtime": time.time()}
             self._write_index(bucket, idx)
-            return bucket, key, etag
+            return ("ok", (bucket, key, etag))
 
     def abort_upload(self, uid: str) -> bool:
         with self.lock:
-            up = self.uploads.pop(uid, None)
+            up = self.uploads.get(uid)
             if up is None:
                 return False
+            self._drop_upload(uid)
             for n in sorted(up["parts"]):
                 self._stream(
                     up["bucket"], f"{up['key']}.part.{uid}.{n}"
                 ).remove()
             return True
+
+
+class _BadParam(ValueError):
+    pass
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -243,6 +293,17 @@ class _Handler(BaseHTTPRequestHandler):
         )
         self._reply(code, body)
 
+    def _int_param(self, q: dict, name: str, default: int | None = None):
+        """Parse an int query param; raises _BadParam -> 400
+        InvalidArgument instead of a connection-killing ValueError."""
+        vals = q.get(name)
+        if not vals:
+            return default
+        try:
+            return int(vals[0])
+        except ValueError:
+            raise _BadParam(name)
+
     # -- verbs -------------------------------------------------------------
     def do_GET(self):
         bucket, key, q = self._path()
@@ -262,13 +323,18 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._error(404, "NoSuchBucket")
             prefix = q.get("prefix", [""])[0]
             marker = q.get("marker", [""])[0]
-            max_keys = int(q.get("max-keys", ["1000"])[0])
+            try:
+                max_keys = self._int_param(q, "max-keys", 1000)
+            except _BadParam:
+                return self._error(400, "InvalidArgument")
+            if max_keys < 0:
+                return self._error(400, "InvalidArgument")
             idx = self.store.index(bucket)
             keys = sorted(
                 k for k in idx
                 if k.startswith(prefix) and k > marker
             )
-            truncated = len(keys) > max_keys
+            truncated = max_keys > 0 and len(keys) > max_keys
             keys = keys[:max_keys]
             items = "".join(
                 f"<Contents><Key>{_xml_escape(k)}</Key>"
@@ -307,17 +373,21 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_PUT(self):
         bucket, key, q = self._path()
+        # always drain the body: an unread body desynchronizes the
+        # HTTP/1.1 keep-alive stream (e.g. CreateBucketConfiguration XML)
+        body = self._body()
         if not bucket:
             return self._error(400, "InvalidRequest")
         if not key:
             self.store.create_bucket(bucket)  # idempotent, like S3
             self._reply(200)
             return
-        body = self._body()
         if "partNumber" in q and "uploadId" in q:
-            etag = self.store.put_part(
-                q["uploadId"][0], int(q["partNumber"][0]), body
-            )
+            try:
+                part_n = self._int_param(q, "partNumber")
+            except _BadParam:
+                return self._error(400, "InvalidArgument")
+            etag = self.store.put_part(q["uploadId"][0], part_n, body)
             if etag is None:
                 return self._error(404, "NoSuchUpload")
             self._reply(200, headers={"ETag": f'"{etag}"'})
@@ -342,9 +412,11 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if "uploadId" in q:
             done = self.store.complete_upload(q["uploadId"][0])
-            if done is None:
+            if done[0] == "nosuch":
                 return self._error(404, "NoSuchUpload")
-            b, k, etag = done
+            if done[0] == "empty":
+                return self._error(400, "InvalidPart")
+            b, k, etag = done[1]
             self._reply(200, (
                 '<?xml version="1.0"?><CompleteMultipartUploadResult>'
                 f"<Key>{_xml_escape(k)}</Key>"

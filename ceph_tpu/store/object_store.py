@@ -241,6 +241,15 @@ class ObjectStore:
     def list_objects(self, cid: str) -> list[str]:
         raise NotImplementedError
 
+    def collection_bytes(self, cid: str) -> int:
+        """Total logical object bytes in a collection (stats-report path —
+        backends override with their O(metadata) walk; this default pays a
+        stat per object)."""
+        return sum(
+            self.stat(cid, o)["size"] for o in self.list_objects(cid)
+            if not o.startswith("_")
+        )
+
     # -- shared Transaction interpreter ------------------------------------
     # Backends that materialize state as {cid: Collection} dicts reuse this
     # (MemStore applies directly; KStore applies to its in-RAM image after
@@ -353,10 +362,17 @@ class ObjectStore:
 
 
 def create_store(
-    kind: str, path: str | None = None, compression: str = "none"
+    kind: str,
+    path: str | None = None,
+    compression: str = "none",
+    sync: bool = True,
+    checksum: bool = True,
+    device_size: int = 1 << 30,
 ) -> ObjectStore:
     """Factory (reference: ObjectStore::create keyed by `objectstore`;
-    `compression` is the objectstore_compression option)."""
+    `compression`/`sync`/`checksum`/`device_size` are the
+    objectstore_compression / objectstore_wal_sync /
+    objectstore_checksum / bluestore_block_size options)."""
     from .kstore import KStore
     from .memstore import MemStore
 
@@ -365,5 +381,18 @@ def create_store(
     if kind in ("kstore", "filestore"):
         if not path:
             raise StoreError(f"{kind} requires a path")
-        return KStore(path, compression=compression)
+        return KStore(path, sync=sync, compression=compression)
+    if kind == "bluestore":
+        from .bluestore import BlueStore
+
+        if not path:
+            raise StoreError("bluestore requires a path")
+        if compression and compression != "none":
+            # loud rather than silently ignoring the operator's knob
+            raise StoreError(
+                "bluestore backend does not support compression yet"
+            )
+        return BlueStore(
+            path, device_size=device_size, sync=sync, checksum=checksum
+        )
     raise StoreError(f"unknown objectstore {kind!r}")

@@ -1,0 +1,139 @@
+// Block allocator for the BlueStore-analog backend (reference role:
+// src/os/bluestore/BitmapAllocator.cc / AvlAllocator.cc — the component
+// BlueStore uses to carve its raw block device; SURVEY.md §2.4).
+//
+// Design: a word-packed free bitmap (1 = free) with a next-fit cursor.
+// allocate() returns up to max_extents (start, len) runs, preferring one
+// contiguous run but falling back to fragmented harvesting exactly like
+// the reference's allocators under fragmentation.  C ABI via ctypes; the
+// Python side (ceph_tpu/store/alloc.py) carries a pure-Python fallback
+// with identical behavior for hosts without the built .so.
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+struct Allocator {
+  uint64_t n_blocks;
+  uint64_t n_words;
+  uint64_t cursor;   // next-fit hint (block index)
+  uint64_t n_free;
+  uint64_t* bits;    // 1 = free
+};
+
+inline bool get_bit(const Allocator* a, uint64_t i) {
+  return (a->bits[i >> 6] >> (i & 63)) & 1;
+}
+inline void set_bit(Allocator* a, uint64_t i, bool v) {
+  if (v)
+    a->bits[i >> 6] |= (1ull << (i & 63));
+  else
+    a->bits[i >> 6] &= ~(1ull << (i & 63));
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ctpu_alloc_create(uint64_t n_blocks) {
+  auto* a = static_cast<Allocator*>(std::malloc(sizeof(Allocator)));
+  if (!a) return nullptr;
+  a->n_blocks = n_blocks;
+  a->n_words = (n_blocks + 63) / 64;
+  a->cursor = 0;
+  a->n_free = n_blocks;
+  a->bits = static_cast<uint64_t*>(std::malloc(a->n_words * 8));
+  if (!a->bits) {
+    std::free(a);
+    return nullptr;
+  }
+  std::memset(a->bits, 0xff, a->n_words * 8);
+  // clear the tail past n_blocks so word scans never see ghost blocks
+  for (uint64_t i = n_blocks; i < a->n_words * 64; i++) set_bit(a, i, false);
+  return a;
+}
+
+void ctpu_alloc_destroy(void* h) {
+  auto* a = static_cast<Allocator*>(h);
+  if (!a) return;
+  std::free(a->bits);
+  std::free(a);
+}
+
+uint64_t ctpu_alloc_free_blocks(void* h) {
+  return static_cast<Allocator*>(h)->n_free;
+}
+
+// Mark [start, start+len) used (0) or free (1).  Returns 0, or -1 on
+// out-of-range.  Double-free / double-use are accepted idempotently (the
+// mount-time freelist rebuild marks extents in arbitrary order).
+int ctpu_alloc_mark(void* h, uint64_t start, uint64_t len, int free_) {
+  auto* a = static_cast<Allocator*>(h);
+  if (start + len > a->n_blocks) return -1;
+  for (uint64_t i = start; i < start + len; i++) {
+    bool cur = get_bit(a, i);
+    if (cur != (free_ != 0)) {
+      set_bit(a, i, free_ != 0);
+      a->n_free += free_ ? 1 : -1;
+    }
+  }
+  return 0;
+}
+
+// Allocate `want` blocks as up to max_extents (start, len) runs written
+// into out[2*i], out[2*i+1].  Next-fit from the cursor, wrapping once.
+// Returns the number of extents, or -1 if the space or the extent budget
+// cannot satisfy the request (nothing is allocated on failure).
+int ctpu_alloc_allocate(void* h, uint64_t want, uint64_t* out,
+                        int max_extents) {
+  auto* a = static_cast<Allocator*>(h);
+  if (want == 0) return 0;
+  if (want > a->n_free) return -1;
+  int n_ext = 0;
+  uint64_t got = 0;
+  uint64_t pos = a->cursor % (a->n_blocks ? a->n_blocks : 1);
+  uint64_t scanned = 0;
+  while (got < want && scanned < a->n_blocks) {
+    // skip used region (word-at-a-time when aligned and fully used)
+    while (scanned < a->n_blocks && !get_bit(a, pos)) {
+      if ((pos & 63) == 0 && a->bits[pos >> 6] == 0 &&
+          pos + 64 <= a->n_blocks && scanned + 64 <= a->n_blocks) {
+        pos += 64;
+        scanned += 64;
+      } else {
+        pos++;
+        scanned++;
+      }
+      if (pos >= a->n_blocks) pos = 0;
+    }
+    if (scanned >= a->n_blocks) break;
+    // harvest a free run
+    uint64_t run_start = pos;
+    uint64_t run_len = 0;
+    while (scanned < a->n_blocks && got + run_len < want &&
+           pos < a->n_blocks && get_bit(a, pos)) {
+      run_len++;
+      pos++;
+      scanned++;
+    }
+    if (run_len) {
+      if (n_ext >= max_extents) return -1;  // nothing committed yet
+      out[2 * n_ext] = run_start;
+      out[2 * n_ext + 1] = run_len;
+      n_ext++;
+      got += run_len;
+    }
+    if (pos >= a->n_blocks) pos = 0;
+  }
+  if (got < want) return -1;
+  // commit: clear the bits
+  for (int e = 0; e < n_ext; e++)
+    for (uint64_t i = out[2 * e]; i < out[2 * e] + out[2 * e + 1]; i++)
+      set_bit(a, i, false);
+  a->n_free -= want;
+  a->cursor = pos;
+  return n_ext;
+}
+
+}  // extern "C"

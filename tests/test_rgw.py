@@ -114,6 +114,91 @@ def test_multipart_upload(conn):
     assert _req(conn, "POST", f"/mp/big?uploadId={uid}")[0] == 404
 
 
+def test_put_bucket_with_body_keeps_connection(conn):
+    """PUT /bucket with a CreateBucketConfiguration-style body must drain
+    it, or the keep-alive stream desynchronizes."""
+    st, _, _ = _req(conn, "PUT", "/cfg",
+                    body=b"<CreateBucketConfiguration/>")
+    assert st == 200
+    # next request on the SAME connection must parse cleanly
+    st, _, body = _req(conn, "GET", "/")
+    assert st == 200 and b"cfg" in body
+
+
+def test_bad_int_params_are_400(conn):
+    _req(conn, "PUT", "/bad")
+    assert _req(conn, "GET", "/bad?max-keys=abc")[0] == 400
+    uid = re.search(
+        rb"<UploadId>([^<]+)<", _req(conn, "POST", "/bad/x?uploads")[2]
+    ).group(1).decode()
+    st, _, _ = _req(conn, "PUT", f"/bad/x?partNumber=zz&uploadId={uid}",
+                    body=b"p")
+    assert st == 400
+    # connection still alive
+    assert _req(conn, "GET", "/")[0] == 200
+
+
+def test_complete_with_no_parts_keeps_upload(conn):
+    _req(conn, "PUT", "/np")
+    uid = re.search(
+        rb"<UploadId>([^<]+)<", _req(conn, "POST", "/np/x?uploads")[2]
+    ).group(1).decode()
+    assert _req(conn, "POST", f"/np/x?uploadId={uid}")[0] == 400
+    # upload survives the rejected complete: parts can still land
+    assert _req(
+        conn, "PUT", f"/np/x?partNumber=1&uploadId={uid}", body=b"later"
+    )[0] == 200
+    assert _req(conn, "POST", f"/np/x?uploadId={uid}")[0] == 200
+    assert _req(conn, "GET", "/np/x")[2] == b"later"
+
+
+def test_delete_bucket_reaps_inflight_uploads(cluster, conn):
+    _req(conn, "PUT", "/reap")
+    uid = re.search(
+        rb"<UploadId>([^<]+)<", _req(conn, "POST", "/reap/x?uploads")[2]
+    ).group(1).decode()
+    _req(conn, "PUT", f"/reap/x?partNumber=1&uploadId={uid}",
+         body=b"z" * 50000)
+    client = cluster.client("client.reap-check")
+    data_io = client.open_ioctx("rgw_data")
+    assert any("part" in o for o in data_io.list_objects())
+    assert _req(conn, "DELETE", "/reap")[0] == 204
+    assert not any("reap/x.part" in o for o in data_io.list_objects())
+    assert _req(conn, "POST", f"/reap/x?uploadId={uid}")[0] == 404
+
+
+def test_gateway_restart_resumes_multipart(cluster):
+    """In-flight uploads are persisted in the meta pool: a new gateway
+    instance can complete an upload the old one started."""
+    from ceph_tpu.rgw import RGWDaemon
+
+    _req_on = lambda c, m, p, body=None: _req(c, m, p, body)
+    host, port = cluster.rgw.addr
+    c1 = http.client.HTTPConnection(host, port, timeout=30)
+    _req_on(c1, "PUT", "/persist")
+    uid = re.search(
+        rb"<UploadId>([^<]+)<",
+        _req_on(c1, "POST", "/persist/doc?uploads")[2],
+    ).group(1).decode()
+    _req_on(c1, "PUT", f"/persist/doc?partNumber=1&uploadId={uid}",
+            body=b"half-")
+    c1.close()
+    # second gateway (simulating a restart) sees the persisted upload
+    g2 = RGWDaemon(cluster._cct("rgw.1"), cluster.mon_addrs)
+    g2.start()
+    try:
+        h2, p2 = g2.addr
+        c2 = http.client.HTTPConnection(h2, p2, timeout=30)
+        _req_on(c2, "PUT", f"/persist/doc?partNumber=2&uploadId={uid}",
+                body=b"done")
+        st, _, _ = _req_on(c2, "POST", f"/persist/doc?uploadId={uid}")
+        assert st == 200
+        assert _req_on(c2, "GET", "/persist/doc")[2] == b"half-done"
+        c2.close()
+    finally:
+        g2.shutdown()
+
+
 def test_multipart_abort(conn):
     _req(conn, "PUT", "/ab")
     uid = re.search(

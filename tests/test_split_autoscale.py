@@ -1,0 +1,148 @@
+"""PG split (pg_num increase) + pg_autoscaler tests (reference: the
+autoscaler suite + OSD::split_pgs behavior; SURVEY.md §2.5).
+"""
+import time
+
+import pytest
+
+from ceph_tpu.qa.vstart import LocalCluster
+
+pytestmark = pytest.mark.cluster
+
+
+def _wait_all_readable(io, objects, timeout=30.0):
+    deadline = time.time() + timeout
+    last_err = None
+    while time.time() < deadline:
+        try:
+            for oid, data in objects.items():
+                assert io.read(oid) == data, oid
+            return
+        except (IOError, AssertionError) as e:
+            last_err = e
+            time.sleep(0.4)
+    raise AssertionError(f"objects not readable after split: {last_err}")
+
+
+def test_pool_set_pg_num_validation():
+    with LocalCluster(n_mons=1, n_osds=3) as c:
+        c.create_replicated_pool("p", size=2, pg_num=4)
+        rv, res = c.mon_command({
+            "prefix": "osd pool set", "name": "p", "key": "pg_num",
+            "value": 2,
+        })
+        assert rv == -22 and "merges" in str(res)
+        rv, _ = c.mon_command({
+            "prefix": "osd pool set", "name": "nope", "key": "pg_num",
+            "value": 8,
+        })
+        assert rv == -2
+        rv, _ = c.mon_command({
+            "prefix": "osd pool set", "name": "p", "key": "pg_num",
+            "value": 1 << 20,
+        })
+        assert rv == -34  # mon_max_pg_per_osd guard
+        rv, _ = c.mon_command({
+            "prefix": "osd pool set", "name": "p", "key": "size",
+            "value": 3,
+        })
+        assert rv == 0
+
+
+def test_replicated_pg_split_migrates_objects():
+    with LocalCluster(n_mons=1, n_osds=4) as c:
+        c.create_replicated_pool("rp", size=2, pg_num=2)
+        client = c.client()
+        io = client.open_ioctx("rp")
+        objects = {
+            f"obj-{i}": (f"payload-{i}-" * 50).encode() for i in range(24)
+        }
+        for oid, data in objects.items():
+            io.write_full(oid, data)
+        rv, res = c.mon_command({
+            "prefix": "osd pool set", "name": "rp", "key": "pg_num",
+            "value": 8,
+        })
+        assert rv == 0, res
+        _wait_all_readable(io, objects)
+        assert sorted(io.list_objects()) == sorted(objects)
+        # overwrite after split works through the new PGs
+        io.write_full("obj-0", b"post-split")
+        assert io.read("obj-0") == b"post-split"
+
+
+def test_ec_pg_split_migrates_objects():
+    with LocalCluster(n_mons=1, n_osds=6) as c:
+        c.create_ec_pool("ec", k=2, m=1, pg_num=2)
+        client = c.client()
+        io = client.open_ioctx("ec")
+        objects = {
+            f"e{i}": bytes([i]) * (1000 + 137 * i) for i in range(12)
+        }
+        for oid, data in objects.items():
+            io.write_full(oid, data)
+            io.set_xattr(oid, "tag", f"t{i}".encode() if (i := 0) else b"t")
+        rv, res = c.mon_command({
+            "prefix": "osd pool set", "name": "ec", "key": "pg_num",
+            "value": 8,
+        })
+        assert rv == 0, res
+        _wait_all_readable(io, objects)
+        # xattrs rode along
+        assert io.get_xattr("e3", "tag") == b"t"
+
+
+def test_pg_autoscaler_scales_up_and_data_survives():
+    with LocalCluster(
+        n_mons=1, n_osds=4, with_mgr=True,
+        conf_overrides={
+            "mgr_modules": "pg_autoscaler",
+            "mgr_pg_autoscale_active": True,
+            "mgr_pg_autoscale_interval": 1.0,
+            "mon_target_pg_per_osd": 64,
+        },
+    ) as c:
+        c.create_replicated_pool("auto", size=2, pg_num=4)
+        client = c.client()
+        io = client.open_ioctx("auto")
+        objects = {f"a{i}": f"v{i}".encode() * 100 for i in range(16)}
+        for oid, data in objects.items():
+            io.write_full(oid, data)
+        # equal-share target: 64 * 4 osds / size 2 = 128 -> far above 4*3
+        mod = c.mgr.module("pg_autoscaler")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            m = client.mc.osdmap
+            pool = next(p for p in m.pools.values() if p.name == "auto")
+            if pool.pg_num > 4:
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(
+                f"autoscaler never scaled (eval={mod.last_eval})"
+            )
+        _wait_all_readable(io, objects)
+
+
+def test_pg_autoscaler_advises_without_applying():
+    with LocalCluster(
+        n_mons=1, n_osds=3, with_mgr=True,
+        conf_overrides={
+            "mgr_modules": "pg_autoscaler",
+            "mgr_pg_autoscale_active": False,
+            "mgr_pg_autoscale_interval": 0.5,
+            "mon_target_pg_per_osd": 64,
+        },
+    ) as c:
+        c.create_replicated_pool("adv", size=3, pg_num=4)
+        mod = c.mgr.module("pg_autoscaler")
+        deadline = time.time() + 15
+        while time.time() < deadline and not mod.last_eval:
+            time.sleep(0.3)
+        assert mod.last_eval, "no evaluation happened"
+        ev = next(e for e in mod.last_eval if e["pool"] == "adv")
+        assert ev["would_adjust"] and ev["target"] > 4
+        # advise-only: pg_num unchanged
+        m = c._leader().osdmon.osdmap
+        pool = next(p for p in m.pools.values() if p.name == "adv")
+        assert pool.pg_num == 4

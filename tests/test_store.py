@@ -19,12 +19,18 @@ from ceph_tpu.store import (
 )
 
 
-@pytest.fixture(params=["memstore", "kstore"])
+@pytest.fixture(params=["memstore", "kstore", "bluestore"])
 def store(request, tmp_path):
     if request.param == "memstore":
         s = MemStore()
-    else:
+    elif request.param == "kstore":
         s = KStore(str(tmp_path / "kstore"))
+    else:
+        from ceph_tpu.store.bluestore import BlueStore
+
+        # small device + tiny inline threshold so extent paths are hit
+        s = BlueStore(str(tmp_path / "bluestore"), device_size=16 << 20,
+                      inline_threshold=64)
     s.mount()
     yield s
     s.umount()
