@@ -84,6 +84,7 @@ class Objecter(Dispatcher):
         length: int = 0,
         timeout: float = 30.0,
         attempts: int = 8,
+        snapid: int | None = None,
     ):
         """Submit; blocks for the reply, retrying across map changes."""
         import time as _time
@@ -91,6 +92,16 @@ class Objecter(Dispatcher):
         last = None
         for _ in range(attempts):
             m = self.mc.osdmap
+            # snap context rides every mutation (reference: MOSDOp's
+            # SnapContext) so a primary whose map lags a fresh mksnap
+            # still clones before overwriting
+            snap_seq = 0
+            if m is not None and op in ("write_full", "delete"):
+                p = m.pools.get(pool_id)
+                # newest LIVE snap, not snap_seq: after the last rmsnap
+                # there is nothing left to preserve, and a stale high seq
+                # would make primaries mint un-trimmable clones forever
+                snap_seq = max(p.snaps, default=0) if p is not None else 0
             try:
                 _osd, addr = self._calc_target(pool_id, oid, op)
             except (ConnectionError, KeyError) as e:
@@ -115,6 +126,7 @@ class Objecter(Dispatcher):
                         tid=tid, pool=pool_id, oid=oid, op=op,
                         data=wire_data,
                         epoch=m.epoch if m else 0, off=off, length=length,
+                        snapid=snapid, snap_seq=snap_seq,
                     )
                 )
             except (OSError, ConnectionError) as e:

@@ -32,13 +32,78 @@ class IoCtx:
             raise IOError(f"write_full {oid!r}: {rep.retval} {rep.result}")
         return rep.retval
 
-    def read(self, oid: str, off: int = 0, length: int = 0) -> bytes:
+    def read(self, oid: str, off: int = 0, length: int = 0,
+             snapid: int | None = None) -> bytes:
+        """`snapid` reads the pool-snapshot view (reference: IoCtx
+        snap_set_read + read)."""
         rep = self._client.objecter.op_submit(
-            self.pool_id, oid, "read", off=off, length=length
+            self.pool_id, oid, "read", off=off, length=length,
+            snapid=snapid,
         )
         if rep.retval != 0:
             raise IOError(f"read {oid!r}: {rep.retval} {rep.result}")
         return unpack_data(rep.data) or b""
+
+    # -- pool snapshots (reference: rados_ioctx_snap_create/remove etc.) --
+    def _pool(self):
+        m = self._client.mc.osdmap
+        return m.pools[self.pool_id]
+
+    def snap_create(self, name: str) -> int:
+        rv, res = self._client.command({
+            "prefix": "osd pool mksnap",
+            "name": self.pool_name, "snapname": name,
+        })
+        if rv != 0:
+            raise IOError(f"mksnap {name!r}: {rv} {res}")
+        sid = res["snapid"]
+        # block until OUR map carries the snap: the next write's snap
+        # context must include it (reference: librados waits for the
+        # map epoch the mon committed)
+        self._wait_map(lambda p: p.snap_seq >= sid)
+        return sid
+
+    def snap_remove(self, name: str) -> None:
+        rv, res = self._client.command({
+            "prefix": "osd pool rmsnap",
+            "name": self.pool_name, "snapname": name,
+        })
+        if rv != 0:
+            raise IOError(f"rmsnap {name!r}: {rv} {res}")
+        removed = res["removed"]
+        self._wait_map(lambda p: removed not in p.snaps)
+
+    def _wait_map(self, pred, timeout: float = 10.0) -> None:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            m = self._client.mc.osdmap
+            if m is not None and pred(m.pools[self.pool_id]):
+                return
+            e = m.epoch if m else 0
+            try:
+                self._client.mc.wait_for_osdmap(
+                    min_epoch=e + 1, timeout=1.0
+                )
+            except TimeoutError:
+                pass
+        raise IOError("timed out waiting for the snap map epoch")
+
+    def snap_list(self) -> dict[int, str]:
+        self._client.mc.wait_for_osdmap(timeout=10.0)
+        return dict(self._pool().snaps)
+
+    def snap_lookup(self, name: str) -> int:
+        for sid, n in self.snap_list().items():
+            if n == name:
+                return sid
+        raise KeyError(f"no snap {name!r}")
+
+    def snap_rollback(self, oid: str, snapname: str) -> None:
+        """reference: rados_ioctx_snap_rollback — restore the head to the
+        snapshot's content (client-side: snap read then write_full)."""
+        self.write_full(oid, self.read(oid, snapid=self.snap_lookup(snapname)))
 
     def remove(self, oid: str) -> None:
         rep = self._client.objecter.op_submit(self.pool_id, oid, "delete")

@@ -1,0 +1,1 @@
+"""Deployment tooling (reference: src/cephadm; SURVEY.md §2.8)."""

@@ -1,0 +1,174 @@
+"""cephadm-analog deploy CLI (reference: src/cephadm/cephadm.py —
+bootstrap / ls / rm-cluster / shell; SURVEY.md §2.8).
+
+The reference deploys containerized daemons under systemd; this analog
+deploys the framework's threaded daemons under one detached supervisor
+process per cluster (deploy/host.py), tracked by a state file in the
+cluster's data dir.
+
+    python -m ceph_tpu.deploy.cephadm bootstrap --data-dir DIR \
+        [--spec spec.json]
+    python -m ceph_tpu.deploy.cephadm ls --data-dir DIR
+    python -m ceph_tpu.deploy.cephadm ps --data-dir DIR
+    python -m ceph_tpu.deploy.cephadm shell --data-dir DIR -- \
+        osd pool create mypool
+    python -m ceph_tpu.deploy.cephadm rm-cluster --data-dir DIR
+
+Spec (JSON; every section optional):
+
+    {"mon": {"count": 3}, "mgr": {"count": 1},
+     "osd": {"count": 6, "objectstore": "bluestore"},
+     "mds": {"count": 1}, "rgw": {"count": 1},
+     "conf": {"osd_pool_default_size": 2}}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+DEFAULT_SPEC = {"mon": {"count": 1}, "osd": {"count": 3}}
+
+
+def _state_path(data_dir: str) -> str:
+    return os.path.join(data_dir, "cluster.json")
+
+
+def _load_state(data_dir: str) -> dict | None:
+    try:
+        with open(_state_path(data_dir)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def cmd_bootstrap(args, out) -> int:
+    os.makedirs(args.data_dir, exist_ok=True)
+    if _load_state(args.data_dir):
+        print(f"cluster already deployed in {args.data_dir}", file=out)
+        return 1
+    if args.spec:
+        with open(args.spec) as f:
+            spec = json.load(f)
+    else:
+        spec = DEFAULT_SPEC
+    with open(os.path.join(args.data_dir, "spec.json"), "w") as f:
+        json.dump(spec, f, indent=2)
+    log = open(os.path.join(args.data_dir, "host.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ceph_tpu.deploy.host",
+         "--data-dir", args.data_dir],
+        stdout=log, stderr=log,
+        start_new_session=True,  # survives the CLI exiting
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+    )
+    deadline = time.time() + args.timeout
+    while time.time() < deadline:
+        state = _load_state(args.data_dir)
+        if state:
+            mons = ",".join(f"{h}:{p}" for h, p in state["mon_addrs"])
+            print(f"cluster up: mon {mons}", file=out)
+            print(f"daemons: {' '.join(state['daemons'])}", file=out)
+            if "rgw_addr" in state:
+                h, p = state["rgw_addr"]
+                print(f"rgw: http://{h}:{p}", file=out)
+            return 0
+        if proc.poll() is not None:
+            print("host process died during bootstrap (see host.log)",
+                  file=out)
+            return 1
+        time.sleep(0.2)
+    proc.terminate()
+    print("bootstrap timed out", file=out)
+    return 1
+
+
+def cmd_ls(args, out) -> int:
+    state = _load_state(args.data_dir)
+    if not state:
+        print("no cluster deployed", file=out)
+        return 1
+    for d in state["daemons"]:
+        print(d, file=out)
+    return 0
+
+
+def cmd_ps(args, out) -> int:
+    state = _load_state(args.data_dir)
+    if not state:
+        print("no cluster deployed", file=out)
+        return 1
+    up = _alive(state["pid"])
+    print(f"pid {state['pid']}: {'running' if up else 'DEAD'} "
+          f"({len(state['daemons'])} daemons)", file=out)
+    return 0 if up else 2
+
+
+def cmd_shell(args, out) -> int:
+    """Run a `ceph` CLI command against the deployed cluster (reference:
+    cephadm shell -- ceph ...)."""
+    state = _load_state(args.data_dir)
+    if not state:
+        print("no cluster deployed", file=out)
+        return 1
+    from ..tools.ceph_cli import main as ceph_main
+
+    mons = ",".join(f"{h}:{p}" for h, p in state["mon_addrs"])
+    return ceph_main(["-m", mons] + args.words, out=out)
+
+
+def cmd_rm_cluster(args, out) -> int:
+    state = _load_state(args.data_dir)
+    if state and _alive(state["pid"]):
+        os.kill(state["pid"], signal.SIGTERM)
+        deadline = time.time() + 15
+        while _alive(state["pid"]) and time.time() < deadline:
+            time.sleep(0.1)
+        if _alive(state["pid"]):
+            os.kill(state["pid"], signal.SIGKILL)
+    if os.path.isdir(args.data_dir):
+        shutil.rmtree(args.data_dir, ignore_errors=True)
+    print("cluster removed", file=out)
+    return 0
+
+
+def main(argv=None, out=sys.stdout) -> int:
+    ap = argparse.ArgumentParser(prog="cephadm")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name in ("bootstrap", "ls", "ps", "rm-cluster", "shell"):
+        p = sub.add_parser(name)
+        p.add_argument("--data-dir", required=True)
+        if name == "bootstrap":
+            p.add_argument("--spec")
+            p.add_argument("--timeout", type=float, default=60.0)
+        if name == "shell":
+            p.add_argument("words", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if args.cmd == "shell":
+        # strip a leading "--" separator
+        if args.words and args.words[0] == "--":
+            args.words = args.words[1:]
+    return {
+        "bootstrap": cmd_bootstrap,
+        "ls": cmd_ls,
+        "ps": cmd_ps,
+        "rm-cluster": cmd_rm_cluster,
+        "shell": cmd_shell,
+    }[args.cmd](args, out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

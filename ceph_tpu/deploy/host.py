@@ -1,0 +1,87 @@
+"""Deployment host process — runs a spec's daemons until killed
+(reference role: the systemd units cephadm writes per daemon; here one
+supervisor process hosts the cluster, matching the framework's
+threaded-daemon model).
+
+Invoked by cephadm bootstrap as a detached subprocess:
+
+    python -m ceph_tpu.deploy.host --data-dir DIR
+
+Reads DIR/spec.json, builds the cluster, writes DIR/cluster.json
+(mon addresses, service endpoints, pid), then idles until SIGTERM.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", required=True)
+    args = ap.parse_args(argv)
+
+    with open(os.path.join(args.data_dir, "spec.json")) as f:
+        spec = json.load(f)
+
+    # daemons default to the CPU backend: placement uses the scalar
+    # mapper, and a supervisor must not block on TPU-tunnel availability.
+    # A spec can opt the balancer/EC offload onto the device with
+    # {"jax_platform": "axon"}.
+    import jax
+
+    jax.config.update("jax_platforms", spec.get("jax_platform", "cpu"))
+
+    from ..qa.vstart import LocalCluster
+
+    osd_spec = spec.get("osd") or {}
+    conf = dict(spec.get("conf") or {})
+    if osd_spec.get("objectstore"):
+        conf["objectstore"] = osd_spec["objectstore"]
+        conf.setdefault("osd_data", os.path.join(args.data_dir, "osd"))
+    cluster = LocalCluster(
+        n_mons=(spec.get("mon") or {}).get("count", 1),
+        n_osds=osd_spec.get("count", 3),
+        conf_overrides=conf,
+        with_mgr=(spec.get("mgr") or {}).get("count", 0) > 0,
+        with_mds=(spec.get("mds") or {}).get("count", 0) > 0,
+    )
+    cluster.start()
+    state = {
+        "pid": os.getpid(),
+        "mon_addrs": cluster.mon_addrs,
+        "daemons": (
+            [f"mon.{n}" for n in cluster.mons]
+            + [f"osd.{i}" for i in cluster.osds]
+        ),
+    }
+    if cluster.mgr is not None:
+        state["daemons"].append("mgr.x")
+    if cluster.mds is not None:
+        state["daemons"].append("mds.0")
+    if (spec.get("rgw") or {}).get("count", 0) > 0:
+        rgw = cluster.start_rgw()
+        state["rgw_addr"] = list(rgw.addr)
+        state["daemons"].append("rgw.0")
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+
+    # state file last: its presence tells bootstrap the cluster is up
+    tmp = os.path.join(args.data_dir, ".cluster.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+    os.replace(tmp, os.path.join(args.data_dir, "cluster.json"))
+
+    stop.wait()
+    cluster.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -182,6 +182,8 @@ class OSDMonitor:
                 if self._propose_map(m) else (-110, "proposal timed out")
         if prefix == "osd pool set":
             return self._cmd_pool_set(cmd)
+        if prefix in ("osd pool mksnap", "osd pool rmsnap"):
+            return self._cmd_pool_snap(prefix.endswith("mksnap"), cmd)
         if prefix == "osd pg-upmap-items":
             return self._cmd_upmap_items(cmd)
         if prefix == "osd tree":
@@ -240,6 +242,34 @@ class OSDMonitor:
             return -22, f"unknown pool key {key!r}"
         return (0, f"set pool {name} {key} to {value}") \
             if self._propose_map(m) else (-110, "proposal timed out")
+
+    def _cmd_pool_snap(self, create: bool, cmd: dict) -> tuple[int, object]:
+        """`osd pool mksnap/rmsnap <pool> <snapname>` (reference:
+        OSDMonitor's pool-snap commands updating pg_pool_t::snaps)."""
+        name = cmd.get("name", "")
+        snapname = cmd.get("snapname", "")
+        if not snapname:
+            return -22, "snap name required"
+        m = self._pending()
+        pool = next((p for p in m.pools.values() if p.name == name), None)
+        if pool is None:
+            return -2, f"no pool {name!r}"
+        if create:
+            if snapname in pool.snaps.values():
+                return -17, f"snap {snapname!r} exists"
+            pool.snap_seq += 1
+            pool.snaps[pool.snap_seq] = snapname
+            result = {"snapid": pool.snap_seq}
+        else:
+            sid = next(
+                (i for i, n in pool.snaps.items() if n == snapname), None
+            )
+            if sid is None:
+                return -2, f"no snap {snapname!r}"
+            del pool.snaps[sid]
+            result = {"removed": sid}
+        return (0, result) if self._propose_map(m) else \
+            (-110, "proposal timed out")
 
     def _cmd_tree(self) -> list[dict]:
         """reference: `ceph osd tree` (OSDMonitor dumping the CRUSH

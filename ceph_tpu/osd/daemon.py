@@ -70,10 +70,18 @@ class PGState:
         # highest pool pg_num this PG has been split-scanned under (0 =
         # scan on next pass; in-memory: a restart just rescans)
         self.split_scanned = 0
+        # live-snap-id tuple this PG was last trimmed against (None =
+        # never trimmed; distinct from () = trimmed against empty set)
+        self.snap_trimmed: tuple | None = None
         self.lock = make_lock("osd::pg")
 
     def meta_oid(self) -> str:
         return "_pgmeta"
+
+
+# clone-object name separator (reference: clones are (oid, snapid) hobjects;
+# here the snapid rides in the name, invisible to client listings)
+CLONE_SEP = "\x02"
 
 
 class OSD(Dispatcher):
@@ -138,6 +146,7 @@ class OSD(Dispatcher):
         self._workers: list[threading.Thread] = []
         self._recovery_inflight = False
         self._split_inflight = False
+        self._clone_mutex = make_lock("osd::snap_clone")
         self._last_scrub = 0.0
         self._scrubs_queued: set[str] = set()
         # reference: OSD::create_logger (l_osd_op / l_osd_op_w / ...)
@@ -478,9 +487,170 @@ class OSD(Dispatcher):
                 result={"primary": primary},
             )
         pg = self._pg(msg.pool, ps)
+        # pool snapshots (reference: make_writeable's clone-on-write +
+        # SnapSet resolution in PrimaryLogPG)
+        # clone against the newest LIVE snap (snap_seq never resets, and
+        # cloning for snaps that no longer exist would leak un-trimmable
+        # copies on every first write); the client's snap context covers
+        # the window where this map lags a fresh mksnap
+        live_max = max(pool.snaps, default=0)
+        snap_seq = max(live_max, int(getattr(msg, "snap_seq", 0) or 0))
+        if (
+            msg.op in ("write_full", "delete")
+            and snap_seq
+            and msg.oid
+            and CLONE_SEP not in msg.oid
+            and getattr(msg, "ps", None) is None
+            # explicit-ps ops are internal machinery (split migration,
+            # trim), not client mutations: the split's old-PG delete must
+            # not mint a stranded clone — the head's bytes live on,
+            # unchanged, in the post-split PG
+        ):
+            try:
+                self._maybe_clone(pg, pool, msg.oid, snap_seq)
+            except Exception as e:
+                return MOSDOpReply(
+                    tid=msg.tid, retval=-5, epoch=self.my_epoch(),
+                    result=f"snap clone failed: {e}",
+                )
+        if (
+            msg.op == "read"
+            and getattr(msg, "snapid", None)
+            and CLONE_SEP not in msg.oid
+        ):
+            clone_oid = self._resolve_snap_read(
+                pg, pool, acting, msg.oid, int(msg.snapid)
+            )
+            if clone_oid != msg.oid:
+                msg = MOSDOp(
+                    tid=msg.tid, pool=msg.pool, oid=clone_oid, op="read",
+                    epoch=msg.epoch, off=msg.off, length=msg.length,
+                    ps=ps,
+                )
         if pool.type == PG_POOL_ERASURE:
             return self._ec_op(pg, pool, acting, msg)
         return self._replicated_op(pg, pool, acting, msg)
+
+    # -- pool snapshots ----------------------------------------------------
+    def _clone_oid(self, oid: str, snapid: int) -> str:
+        return f"{oid}{CLONE_SEP}{snapid:08d}"
+
+    def _maybe_clone(self, pg, pool, oid: str, snap_seq: int) -> None:
+        """Clone-on-first-write-after-snap: preserve the head's bytes as
+        clone `snap_seq` before an overwrite/delete mutates it.  The clone
+        is a full normal object in the SAME PG (explicit ps), so
+        replication/EC encoding, recovery, and scrub all cover it.
+
+        The stat->read->write sequence is serialized under _clone_mutex:
+        two concurrent writers racing it could otherwise both miss the
+        stat and the later one would capture POST-snap bytes as the
+        clone, corrupting the snapshot view."""
+        with self._clone_mutex:
+            self._maybe_clone_locked(pg, pool, oid, snap_seq)
+
+    def _maybe_clone_locked(self, pg, pool, oid: str, snap_seq: int) -> None:
+        clone = self._clone_oid(oid, snap_seq)
+        e = self.my_epoch()
+        st = self._execute_client_op(MOSDOp(
+            tid=self._next_tid(), pool=pool.pool_id, oid=clone, op="stat",
+            epoch=e, ps=pg.ps,
+        ))
+        if st.retval == 0:
+            return  # this snap generation already preserved
+        r = self._execute_client_op(MOSDOp(
+            tid=self._next_tid(), pool=pool.pool_id, oid=oid, op="read",
+            epoch=e, ps=pg.ps, off=0, length=0,
+        ))
+        if r.retval != 0:
+            return  # no head: nothing to preserve
+        w = self._execute_client_op(MOSDOp(
+            tid=self._next_tid(), pool=pool.pool_id, oid=clone,
+            op="write_full", data=r.data, epoch=e, ps=pg.ps,
+        ))
+        if w.retval != 0:
+            raise RuntimeError(f"clone write: {w.result}")
+
+    def _primary_cid(self, pg, pool, acting) -> str:
+        shard = acting.index(self.id) if pool.type == PG_POOL_ERASURE else 0
+        return self._cid(pg.pgid, shard)
+
+    def _resolve_snap_read(
+        self, pg, pool, acting, oid: str, snapid: int
+    ) -> str:
+        """Oldest clone at-or-after `snapid` serves the snapshot view; no
+        such clone means the head hasn't changed since (or never existed).
+        reference: SnapSet::get_clone_bytes / find_object lookup."""
+        prefix = oid + CLONE_SEP
+        try:
+            names = self.store.list_objects(
+                self._primary_cid(pg, pool, acting)
+            )
+        except (NotFound, KeyError):
+            return oid
+        ids = sorted(
+            int(n[len(prefix):]) for n in names if n.startswith(prefix)
+        )
+        for c in ids:
+            if c >= snapid:
+                return self._clone_oid(oid, c)
+        return oid
+
+    def _snaptrim_pass(self) -> None:
+        """Remove clones no live snap needs (reference: the snap-trim
+        queue PrimaryLogPG works through after a snap is deleted, fed by
+        SnapMapper).  A clone c of a head covers snaps in (prev_clone, c];
+        with none of those alive it is garbage."""
+        m = self.osdmap
+        if m is None:
+            return
+        for pgid, pg in list(self.pgs.items()):
+            if self._stop.is_set():
+                return
+            pool = m.pools.get(pg.pool_id)
+            if pool is None:
+                continue
+            live_key = tuple(sorted(pool.snaps))
+            if pg.snap_trimmed == live_key:
+                continue
+            acting, primary = self._acting(pg.pool_id, pg.ps)
+            if primary != self.id or self.id not in acting:
+                continue
+            try:
+                self._snaptrim_pg(pg, pool, acting, live_key)
+                pg.snap_trimmed = live_key
+            except Exception as e:
+                self.cct.dout(
+                    "osd", 1, f"{self.whoami} snaptrim {pgid}: {e!r}"
+                )
+
+    def _snaptrim_pg(self, pg, pool, acting, live_key) -> None:
+        try:
+            names = self.store.list_objects(
+                self._primary_cid(pg, pool, acting)
+            )
+        except (NotFound, KeyError):
+            return
+        by_head: dict[str, list[int]] = {}
+        for n in names:
+            if CLONE_SEP in n:
+                head, _, suffix = n.partition(CLONE_SEP)
+                by_head.setdefault(head, []).append(int(suffix))
+        live = sorted(live_key)
+        for head, ids in by_head.items():
+            ids.sort()
+            prev = 0
+            for c in ids:
+                needed = any(prev < s <= c for s in live)
+                prev = c
+                if needed:
+                    continue
+                d = self._execute_client_op(MOSDOp(
+                    tid=self._next_tid(), pool=pool.pool_id,
+                    oid=self._clone_oid(head, c), op="delete",
+                    epoch=self.my_epoch(), ps=pg.ps,
+                ))
+                if d.retval != 0:
+                    raise RuntimeError(f"trim {head}@{c}: {d.result}")
 
     # .. EC pool ...........................................................
     def _ec_op(self, pg: PGState, pool, acting: list[int], msg: MOSDOp):
@@ -513,7 +683,7 @@ class OSD(Dispatcher):
         if msg.op == "list":
             oids = sorted(
                 o for o in self.store.list_objects(self._cid(pg.pgid, my_shard))
-                if not o.startswith("_")
+                if not o.startswith("_") and CLONE_SEP not in o
             )
             return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
                                result={"oids": oids})
@@ -965,7 +1135,7 @@ class OSD(Dispatcher):
         if msg.op == "list":
             oids = sorted(
                 o for o in self.store.list_objects(cid)
-                if not o.startswith("_")
+                if not o.startswith("_") and CLONE_SEP not in o
             )
             return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
                                result={"oids": oids})
@@ -1411,6 +1581,7 @@ class OSD(Dispatcher):
     def _split_pass_work(self) -> None:
         try:
             self._split_pass()
+            self._snaptrim_pass()
         finally:
             self._split_inflight = False
 
@@ -1448,14 +1619,22 @@ class OSD(Dispatcher):
                 )
 
     def _split_migrate_pg(self, pg, pool) -> None:
-        rep = self._execute_client_op(MOSDOp(
-            tid=self._next_tid(), pool=pg.pool_id, oid=f":pg:{pg.ps}",
-            op="list", epoch=self.my_epoch(),
-        ))
-        if rep.retval != 0:
-            raise RuntimeError(f"split list: {rep.result}")
-        for oid in (rep.result or {}).get("oids") or []:
-            new_ps = object_ps(oid, pool.pg_num)
+        # raw store listing: snapshot clones are hidden from the client
+        # `list` op but must migrate with their head
+        acting, _p = self._acting(pg.pool_id, pg.ps)
+        if self.id not in acting:
+            return
+        try:
+            names = self.store.list_objects(
+                self._primary_cid(pg, pool, acting)
+            )
+        except (NotFound, KeyError):
+            return
+        for oid in sorted(names):
+            if oid.startswith("_"):
+                continue
+            head = oid.split(CLONE_SEP, 1)[0]
+            new_ps = object_ps(head, pool.pg_num)
             if new_ps != pg.ps:
                 self._migrate_object(pg, pool, oid, new_ps)
 
@@ -1482,9 +1661,11 @@ class OSD(Dispatcher):
         """
         e = self.my_epoch()
         _a, new_primary = self._acting(pg.pool_id, new_ps)
+        # every dest op carries the explicit post-split ps: snapshot-clone
+        # names would hash elsewhere (placement follows their HEAD object)
         st = self._forward_op(new_primary, MOSDOp(
             tid=self._next_tid(), pool=pg.pool_id, oid=oid, op="stat",
-            epoch=e,
+            epoch=e, ps=new_ps,
         ))
         if st is not None and st.retval == 0:
             # newer post-split copy exists: just retire the stale one
@@ -1506,10 +1687,9 @@ class OSD(Dispatcher):
             op="getxattrs", epoch=e, ps=pg.ps,
         ))
         xattrs = xr.result if xr.retval == 0 else None
-        _a, new_primary = self._acting(pg.pool_id, new_ps)
         w = self._forward_op(new_primary, MOSDOp(
             tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-            op="write_full", data=r.data, epoch=e,
+            op="write_full", data=r.data, epoch=e, ps=new_ps,
         ))
         if w is None or w.retval != 0:
             raise RuntimeError(
@@ -1518,7 +1698,7 @@ class OSD(Dispatcher):
         if xattrs:
             xw = self._forward_op(new_primary, MOSDOp(
                 tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-                op="setxattr", data=xattrs, epoch=e,
+                op="setxattr", data=xattrs, epoch=e, ps=new_ps,
             ))
             if xw is None or xw.retval != 0:
                 raise RuntimeError(
@@ -1584,6 +1764,10 @@ class OSD(Dispatcher):
         # must not delay them toward the failure-report threshold
         num_objects = 0
         pool_bytes: dict[int, int] = {}
+        try:
+            coll_bytes = self.store.collections_bytes()  # one index pass
+        except Exception:
+            coll_bytes = {}
         for cid in self.store.list_collections():
             pool_id = None
             if "." in cid:
@@ -1596,15 +1780,12 @@ class OSD(Dispatcher):
                     1 for o in self.store.list_objects(cid)
                     if not o.startswith("_")
                 )
-                if pool_id is not None:
-                    # backends answer from their in-RAM metadata (onodes /
-                    # RAM image), keeping the report walk O(names)
-                    pool_bytes[pool_id] = (
-                        pool_bytes.get(pool_id, 0)
-                        + self.store.collection_bytes(cid)
-                    )
             except Exception:
-                pass
+                continue
+            if pool_id is not None:
+                pool_bytes[pool_id] = (
+                    pool_bytes.get(pool_id, 0) + coll_bytes.get(cid, 0)
+                )
         self.logger.set("numpg", num_pgs)
         try:
             self.messenger.connect((host, int(port))).send_message(

@@ -32,11 +32,17 @@ class MOSDOp(_JsonMessage):
     -ESTALE so the client refreshes and resends (Objecter resend rule).
     `ps` overrides the oid-hash placement seed — the PG-split migrator
     addresses an object still living in its pre-split PG this way (the
-    reference reaches old PGs through pg history / past_intervals)."""
+    reference reaches old PGs through pg history / past_intervals).
+    `snapid` on reads selects the pool-snapshot view of the object
+    (served from the newest clone at-or-after that id, else the head).
+    `snap_seq` on writes is the client's snap context: the primary clones
+    against max(its map's seq, the client's) so a write never races the
+    map push after a mksnap (reference: the SnapContext in every MOSDOp).
+    """
 
     MSG_TYPE = 42
     FIELDS = ("tid", "pool", "oid", "op", "data", "epoch", "off", "length",
-              "ps")
+              "ps", "snapid", "snap_seq")
 
 
 @register_message

@@ -77,8 +77,9 @@ def object_ps(oid: str, pg_num: int) -> int:
 
 @dataclass
 class PGPool:
-    """reference: src/osd/osd_types.h :: pg_pool_t (placement fields only —
-    snapshot/tier/quota state has no bearing on mapping)."""
+    """reference: src/osd/osd_types.h :: pg_pool_t (placement fields plus
+    the pool-snapshot registry: snap_seq is the latest issued snap id,
+    snaps maps live ids to names — reference: pg_pool_t::snaps/snap_seq)."""
 
     pool_id: int
     pg_num: int
@@ -89,6 +90,8 @@ class PGPool:
     pgp_num: int = 0  # 0 → pg_num
     ec_profile: str | None = None  # profile name for erasure pools
     name: str = ""
+    snap_seq: int = 0
+    snaps: dict = field(default_factory=dict)  # snapid -> name
 
     def __post_init__(self):
         if not self.pgp_num:
@@ -99,6 +102,8 @@ class PGPool:
             )
         if not self.name:
             self.name = f"pool{self.pool_id}"
+        # JSON round-trips dict keys as strings
+        self.snaps = {int(k): v for k, v in (self.snaps or {}).items()}
 
     def raw_pg_to_pps(self, ps: int) -> int:
         """reference: pg_pool_t::raw_pg_to_pps, FLAG_HASHPSPOOL branch —

@@ -217,7 +217,6 @@ class _Store:
                 # bucket vanished: the upload is dead; reap the parts
                 self.abort_upload(uid)
                 return ("nosuch",)
-            self._drop_upload(uid)
             bucket, key = up["bucket"], up["key"]
             dst = self._stream(bucket, key)
             dst.truncate(0)
@@ -236,6 +235,10 @@ class _Store:
             idx = self.index(bucket)
             idx[key] = {"size": off, "etag": etag, "mtime": time.time()}
             self._write_index(bucket, idx)
+            # drop the persisted record LAST: a crash mid-complete leaves
+            # the mpu.{uid} record so a restarted gateway can still reap
+            # or re-complete (parts are only removed above after copying)
+            self._drop_upload(uid)
             return ("ok", (bucket, key, etag))
 
     def abort_upload(self, uid: str) -> bool:
@@ -243,11 +246,13 @@ class _Store:
             up = self.uploads.get(uid)
             if up is None:
                 return False
-            self._drop_upload(uid)
+            # parts first, record last: a crash mid-abort keeps the
+            # record so a restarted gateway can finish the reap
             for n in sorted(up["parts"]):
                 self._stream(
                     up["bucket"], f"{up['key']}.part.{uid}.{n}"
                 ).remove()
+            self._drop_upload(uid)
             return True
 
 

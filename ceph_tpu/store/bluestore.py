@@ -530,6 +530,16 @@ class BlueStore(ObjectStore):
                 if c == cid and not o.startswith("_")
             )
 
+    def collections_bytes(self) -> dict[str, int]:
+        # single pass over the onode index (collection_bytes per cid
+        # would rescan all onodes once per collection)
+        with self._lock:
+            out = {cid: 0 for cid in self._colls}
+            for (c, o), onode in self._onodes.items():
+                if not o.startswith("_") and c in out:
+                    out[c] += onode.size
+            return out
+
     # -- fsck --------------------------------------------------------------
     def fsck(self, deep: bool = False, repair: bool = False) -> dict:
         """Extent audit + optional data crc verify (reference:

@@ -250,6 +250,15 @@ class ObjectStore:
             if not o.startswith("_")
         )
 
+    def collections_bytes(self) -> dict[str, int]:
+        """{cid: bytes} for every collection in ONE metadata pass — the
+        per-report-tick stats surface (a per-collection loop over a
+        store-wide index would be O(collections x objects))."""
+        return {
+            cid: self.collection_bytes(cid)
+            for cid in self.list_collections()
+        }
+
     # -- shared Transaction interpreter ------------------------------------
     # Backends that materialize state as {cid: Collection} dicts reuse this
     # (MemStore applies directly; KStore applies to its in-RAM image after

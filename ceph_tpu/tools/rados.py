@@ -78,11 +78,17 @@ def main(argv=None, out=sys.stdout) -> int:
     p = sub.add_parser("get")
     p.add_argument("oid")
     p.add_argument("outfile")
+    p.add_argument("-s", "--snap", help="read the pool-snapshot view")
     p = sub.add_parser("rm")
     p.add_argument("oid")
     sub.add_parser("ls")
     p = sub.add_parser("stat")
     p.add_argument("oid")
+    p = sub.add_parser("mksnap")
+    p.add_argument("snapname")
+    p = sub.add_parser("rmsnap")
+    p.add_argument("snapname")
+    sub.add_parser("lssnap")
     p = sub.add_parser("bench")
     p.add_argument("seconds", type=int)
     p.add_argument("mode", choices=("write", "seq"))
@@ -108,12 +114,22 @@ def main(argv=None, out=sys.stdout) -> int:
             )
             io.write_full(args.oid, data)
         elif args.op == "get":
-            data = io.read(args.oid)
+            snapid = io.snap_lookup(args.snap) if args.snap else None
+            data = io.read(args.oid, snapid=snapid)
             if args.outfile == "-":
                 sys.stdout.buffer.write(data)
             else:
                 with open(args.outfile, "wb") as f:
                     f.write(data)
+        elif args.op == "mksnap":
+            sid = io.snap_create(args.snapname)
+            print(f"created pool snap {args.snapname!r} id {sid}", file=out)
+        elif args.op == "rmsnap":
+            io.snap_remove(args.snapname)
+            print(f"removed pool snap {args.snapname!r}", file=out)
+        elif args.op == "lssnap":
+            for sid, name in sorted(io.snap_list().items()):
+                print(f"{sid}\t{name}", file=out)
         elif args.op == "rm":
             io.remove(args.oid)
         elif args.op == "ls":

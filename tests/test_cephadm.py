@@ -1,0 +1,97 @@
+"""cephadm-analog deploy tests: bootstrap a real detached cluster from a
+spec, drive it through the CLI surface, tear it down (reference:
+src/cephadm bootstrap/ls/rm-cluster flows; SURVEY.md §2.8).
+"""
+import io
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ceph_tpu.deploy.cephadm import main as cephadm
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture
+def deployed(tmp_path):
+    data_dir = str(tmp_path / "cluster")
+    spec = {
+        "mon": {"count": 1},
+        "mgr": {"count": 0},
+        "osd": {"count": 3},
+        "rgw": {"count": 1},
+        "conf": {"osd_pool_default_size": 2},
+    }
+    spec_path = str(tmp_path / "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+    out = io.StringIO()
+    rc = cephadm(
+        ["bootstrap", "--data-dir", data_dir, "--spec", spec_path,
+         "--timeout", "120"],
+        out=out,
+    )
+    assert rc == 0, out.getvalue()
+    yield data_dir, out.getvalue()
+    cephadm(["rm-cluster", "--data-dir", data_dir], out=io.StringIO())
+    assert not os.path.exists(data_dir)
+
+
+def test_bootstrap_ls_ps_shell_rm(deployed):
+    data_dir, boot_out = deployed
+    assert "cluster up: mon" in boot_out and "rgw: http://" in boot_out
+
+    out = io.StringIO()
+    assert cephadm(["ls", "--data-dir", data_dir], out=out) == 0
+    listed = out.getvalue()
+    assert "mon.a" in listed and "osd.0" in listed and "rgw.0" in listed
+
+    out = io.StringIO()
+    assert cephadm(["ps", "--data-dir", data_dir], out=out) == 0
+    assert "running" in out.getvalue()
+
+    # admin command through the shell surface
+    out = io.StringIO()
+    rc = cephadm(
+        ["shell", "--data-dir", data_dir, "--",
+         "osd", "pool", "create", "deploypool", "8"],
+        out=out,
+    )
+    assert rc == 0, out.getvalue()
+
+    # object I/O through the rados CLI against the deployed cluster
+    state = json.load(open(os.path.join(data_dir, "cluster.json")))
+    mons = ",".join(f"{h}:{p}" for h, p in state["mon_addrs"])
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    put = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu');"
+         "import sys; from ceph_tpu.tools.rados import main;"
+         f"sys.exit(main(['-m', '{mons}', '-p', 'deploypool',"
+         "'put', 'obj1', '-']))"],
+        input=b"deployed-bytes", cwd=repo, env=env,
+        capture_output=True, timeout=60,
+    )
+    assert put.returncode == 0, put.stderr.decode()
+    get = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu');"
+         "import sys; from ceph_tpu.tools.rados import main;"
+         f"sys.exit(main(['-m', '{mons}', '-p', 'deploypool',"
+         "'get', 'obj1', '-']))"],
+        cwd=repo, env=env, capture_output=True, timeout=60,
+    )
+    assert get.returncode == 0 and b"deployed-bytes" in get.stdout
+
+
+def test_bootstrap_twice_refused(deployed):
+    data_dir, _ = deployed
+    out = io.StringIO()
+    assert cephadm(
+        ["bootstrap", "--data-dir", data_dir], out=out
+    ) == 1
+    assert "already deployed" in out.getvalue()

@@ -1,0 +1,142 @@
+"""Pool-snapshot tests: clone-on-write, snap reads, rollback, trim
+(reference: pool snaps via pg_pool_t::snaps + PrimaryLogPG
+make_writeable/snap-trim; SURVEY.md §5.4 "Snapshots").
+"""
+import time
+
+import pytest
+
+from ceph_tpu.qa.vstart import LocalCluster
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with LocalCluster(n_mons=1, n_osds=6) as c:
+        c.create_replicated_pool("rp", size=2)
+        c.create_ec_pool("ec", k=4, m=2)
+        yield c
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    return cluster.client()
+
+
+@pytest.mark.parametrize("pool", ["rp", "ec"])
+def test_snap_read_returns_old_content(cluster, client, pool):
+    io = client.open_ioctx(pool)
+    io.write_full(f"{pool}-doc", b"version-1")
+    sid = io.snap_create(f"{pool}-s1")
+    io.write_full(f"{pool}-doc", b"version-2-is-longer")
+    assert io.read(f"{pool}-doc") == b"version-2-is-longer"
+    assert io.read(f"{pool}-doc", snapid=sid) == b"version-1"
+    # second write in the same snap generation makes no new clone;
+    # snapshot view is still the pre-snap state
+    io.write_full(f"{pool}-doc", b"version-3")
+    assert io.read(f"{pool}-doc", snapid=sid) == b"version-1"
+    io.snap_remove(f"{pool}-s1")
+
+
+def test_multiple_snap_generations(client):
+    io = client.open_ioctx("rp")
+    io.write_full("gen", b"A")
+    s1 = io.snap_create("g1")
+    io.write_full("gen", b"B")
+    s2 = io.snap_create("g2")
+    io.write_full("gen", b"C")
+    assert io.read("gen") == b"C"
+    assert io.read("gen", snapid=s1) == b"A"
+    assert io.read("gen", snapid=s2) == b"B"
+    # object untouched since a snap: head serves the snap view
+    s3 = io.snap_create("g3")
+    assert io.read("gen", snapid=s3) == b"C"
+    for n in ("g1", "g2", "g3"):
+        io.snap_remove(n)
+
+
+def test_snap_preserves_deleted_object(client):
+    io = client.open_ioctx("rp")
+    io.write_full("doomed", b"keep me")
+    sid = io.snap_create("predel")
+    io.remove("doomed")
+    with pytest.raises(IOError):
+        io.read("doomed")
+    assert io.read("doomed", snapid=sid) == b"keep me"
+    io.snap_remove("predel")
+
+
+def test_snap_rollback(client):
+    io = client.open_ioctx("rp")
+    io.write_full("rb", b"good state")
+    io.snap_create("known-good")
+    io.write_full("rb", b"bad state")
+    io.snap_rollback("rb", "known-good")
+    assert io.read("rb") == b"good state"
+    io.snap_remove("known-good")
+
+
+def test_clones_hidden_from_listing(client):
+    io = client.open_ioctx("rp")
+    io.write_full("vis", b"1")
+    io.snap_create("ls-snap")
+    io.write_full("vis", b"2")
+    names = io.list_objects()
+    assert "vis" in names
+    assert all("\x02" not in n for n in names)
+    io.snap_remove("ls-snap")
+
+
+def test_snap_remove_trims_clones(cluster, client):
+    io = client.open_ioctx("rp")
+    io.write_full("trim", b"one")
+    sid = io.snap_create("trimsnap")
+    io.write_full("trim", b"two")
+    assert io.read("trim", snapid=sid) == b"one"
+    io.snap_remove("trimsnap")
+    # the background trim pass deletes the now-unneeded clone
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        clones = [
+            o
+            for osd in cluster.osds.values()
+            for cid in osd.store.list_collections()
+            for o in osd.store.list_objects(cid)
+            if o.startswith("trim\x02")
+        ]
+        if not clones:
+            break
+        time.sleep(0.5)
+    assert not clones, clones
+    assert io.read("trim") == b"two"
+
+
+def test_rados_cli_snaps(cluster):
+    import io as _io
+
+    from ceph_tpu.tools.rados import main as rados_main
+
+    mons = ",".join(f"{h}:{p}" for h, p in cluster.mon_addrs)
+    out = _io.StringIO()
+
+    def run(*words):
+        rc = rados_main(["-m", mons, "-p", "rp", *words], out=out)
+        assert rc == 0, out.getvalue()
+
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        p1 = os.path.join(d, "v1")
+        open(p1, "wb").write(b"cli-v1")
+        run("put", "cliobj", p1)
+        run("mksnap", "clisnap")
+        open(p1, "wb").write(b"cli-v2")
+        run("put", "cliobj", p1)
+        run("lssnap")
+        assert "clisnap" in out.getvalue()
+        outfile = os.path.join(d, "got")
+        run("get", "cliobj", outfile, "--snap", "clisnap")
+        assert open(outfile, "rb").read() == b"cli-v1"
+        run("get", "cliobj", outfile)
+        assert open(outfile, "rb").read() == b"cli-v2"
+        run("rmsnap", "clisnap")
