@@ -51,6 +51,11 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--json", action="store_true")
     p.add_argument("--no-chain", action="store_true", help="per-iteration dispatch")
+    p.add_argument(
+        "--stream", type=int, default=0, metavar="N",
+        help="encode N fresh host batches double-buffered (DMA/compute "
+        "overlap) instead of chained device-resident iterations",
+    )
     return p.parse_args(argv)
 
 
@@ -68,6 +73,28 @@ def run_encode(codec, args) -> dict:
     rng = np.random.default_rng(args.seed)
     chunk_size = codec.get_chunk_size(args.size)
     chunks = rng.integers(0, 256, (codec.k, chunk_size), dtype=np.uint8)
+    if args.stream:
+        # end-to-end streaming throughput INCLUDING host->device DMA,
+        # double-buffered (ops/pipeline.py); distinct fresh batches so
+        # nothing is cached away
+        if getattr(codec, "coding", None) is None or \
+                getattr(codec, "backend", None) != "jax":
+            raise SystemExit(
+                "--stream needs a byte-matrix codec on the jax backend "
+                "(bitmatrix techniques / host backends use the default "
+                "timing paths)"
+            )
+        from ..ops.pipeline import stream_encode
+
+        batches = [
+            rng.integers(0, 256, (codec.k, chunk_size), dtype=np.uint8)
+            for _ in range(args.stream)
+        ]
+        stream_encode(codec.coding, batches[:1])  # warm/compile
+        t0 = time.perf_counter()
+        stream_encode(codec.coding, batches)
+        seconds = time.perf_counter() - t0
+        return {"seconds": seconds, "bytes": args.size * args.stream}
     if getattr(codec, "backend", None) == "jax" and not args.no_chain:
         seconds = time_chained_encode(codec.coding, chunks, args.iterations)
     else:
@@ -109,9 +136,13 @@ def run_decode(codec, args) -> dict:
 
 
 def main(argv=None):
+    from ..common.tracer import device_trace as _device_trace
     args = parse_args(argv)
     codec, profile = build_codec(args)
-    res = (run_encode if args.workload == "encode" else run_decode)(codec, args)
+    with _device_trace():  # armed by CEPH_TPU_PROFILE=<logdir>
+        res = (
+            run_encode if args.workload == "encode" else run_decode
+        )(codec, args)
     gibps = res["bytes"] / max(res["seconds"], 1e-12) / 2**30
     if args.json:
         print(

@@ -47,6 +47,8 @@ class IoCtx:
     # -- pool snapshots (reference: rados_ioctx_snap_create/remove etc.) --
     def _pool(self):
         m = self._client.mc.osdmap
+        if m is None or self.pool_id not in m.pools:
+            raise IOError(f"pool {self.pool_id} not in the current map")
         return m.pools[self.pool_id]
 
     def snap_create(self, name: str) -> int:
@@ -79,7 +81,8 @@ class IoCtx:
         deadline = _time.monotonic() + timeout
         while _time.monotonic() < deadline:
             m = self._client.mc.osdmap
-            if m is not None and pred(m.pools[self.pool_id]):
+            p = m.pools.get(self.pool_id) if m is not None else None
+            if p is not None and pred(p):
                 return
             e = m.epoch if m else 0
             try:
@@ -142,6 +145,7 @@ class IoCtx:
             raise IOError(f"getxattrs {oid!r}: {rep.retval} {rep.result}")
         return {
             k: unpack_data(v) for k, v in (rep.result or {}).items()
+            if not k.startswith("_")  # '_'-names are framework-internal
         }
 
     def get_xattr(self, oid: str, name: str) -> bytes:

@@ -1,0 +1,88 @@
+"""Tracepoints + device profiling (reference: src/tracing/*.tp LTTng
+tracepoints and src/common/tracer.{h,cc} Jaeger spans; SURVEY.md §5.1).
+
+Two layers, both cheap enough to leave compiled in:
+
+- **Tracepoints**: `tracepoint(subsys, event, **fields)` appends a
+  timestamped record to a bounded in-memory ring (the LTTng-userspace
+  role); `span(subsys, name)` brackets a region and records its
+  duration.  Dump via `events()` — the admin-socket/`dump_historic_ops`
+  style surface.  Disabled (the default) they cost one attribute check.
+- **Device profiling**: `device_trace(logdir)` wraps `jax.profiler`'s
+  trace context so the TPU hot paths (encode kernels, batched CRUSH)
+  emit an XPlane trace viewable in TensorBoard/Perfetto — the
+  `jax.profiler` equivalent SURVEY §5.1 calls for.  Set
+  CEPH_TPU_PROFILE=<dir> to arm it in the bench CLIs.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+_MAX_EVENTS = 10_000
+
+
+class Tracer:
+    def __init__(self):
+        self.enabled = False
+        self._events: list[tuple] = []
+        self._lock = threading.Lock()
+
+    def enable(self, on: bool = True) -> None:
+        self.enabled = on
+
+    def tracepoint(self, subsys: str, event: str, **fields) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append((time.monotonic(), subsys, event, fields))
+            if len(self._events) > _MAX_EVENTS:
+                del self._events[: _MAX_EVENTS // 10]
+
+    @contextmanager
+    def span(self, subsys: str, name: str, **fields):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.tracepoint(
+                subsys, name, dur_ms=(time.monotonic() - t0) * 1e3, **fields
+            )
+
+    def events(self, subsys: str | None = None) -> list[dict]:
+        with self._lock:
+            evs = list(self._events)
+        return [
+            {"ts": ts, "subsys": s, "event": e, **f}
+            for ts, s, e, f in evs
+            if subsys is None or s == subsys
+        ]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+TRACER = Tracer()
+tracepoint = TRACER.tracepoint
+span = TRACER.span
+
+
+@contextmanager
+def device_trace(logdir: str | None = None):
+    """jax.profiler trace context; logdir defaults to $CEPH_TPU_PROFILE.
+    A no-op when neither is set, so call sites can wrap hot regions
+    unconditionally."""
+    logdir = logdir or os.environ.get("CEPH_TPU_PROFILE")
+    if not logdir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield

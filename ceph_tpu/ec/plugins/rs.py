@@ -10,9 +10,12 @@ technique, each with prepare() building the matrix) and the ISA-L plugin
     cauchy_orig     ErasureCodeJerasureCauchyOrig
     cauchy_good     ErasureCodeJerasureCauchyGood
 
-The bitmatrix-only techniques (liberation, blaum_roth, liber8tion) are
-byte-layout-dependent in jerasure and intentionally not reproduced; profiles
-naming them get a clear InvalidProfile (vintage note in SURVEY.md §2.1).
+The bitmatrix/packet techniques (liberation, blaum_roth, liber8tion —
+reference: jerasure/liberation.c + ErasureCodeJerasureLiberation/
+BlaumRoth/Liber8tion) run through BitmatrixCodec: m=2 RAID-6 codes whose
+chunks split into w packets XOR-combined per a [2w, kw] GF(2) bitmatrix
+(construction + provenance notes: gf/gf2.py), applied on-device through
+the same MXU bitplane matmul as the byte codes.
 
 Three interchangeable backends execute the same matrices:
     jax     bitplane GF(2) matmul on TPU (ceph_tpu.ops.bitplane)
@@ -34,7 +37,7 @@ from ..interface import ErasureCode, InsufficientChunks, InvalidProfile
 from ..registry import ErasureCodePlugin
 
 TECHNIQUES = ("reed_sol_van", "reed_sol_r6_op", "cauchy_orig", "cauchy_good")
-_UNSUPPORTED = ("liberation", "blaum_roth", "liber8tion")
+BITMATRIX_TECHNIQUES = ("liberation", "blaum_roth", "liber8tion")
 
 
 def build_coding_matrix(technique: str, k: int, m: int) -> np.ndarray:
@@ -52,13 +55,10 @@ def build_coding_matrix(technique: str, k: int, m: int) -> np.ndarray:
         return cauchy_original_coding_matrix(k, m).astype(np.uint8)
     if technique == "cauchy_good":
         return cauchy_good_coding_matrix(k, m).astype(np.uint8)
-    if technique in _UNSUPPORTED:
-        raise InvalidProfile(
-            f"technique {technique!r} is a jerasure bitmatrix/packet technique "
-            "whose parity depends on packetsize byte layout; use reed_sol_van "
-            "or cauchy_good (identical fault tolerance, layout-independent parity)"
-        )
-    raise InvalidProfile(f"unknown technique {technique!r}; known: {TECHNIQUES}")
+    raise InvalidProfile(
+        f"unknown technique {technique!r}; known: "
+        f"{TECHNIQUES + BITMATRIX_TECHNIQUES}"
+    )
 
 
 class RSCodec(ErasureCode):
@@ -147,6 +147,106 @@ class RSCodec(ErasureCode):
         return result
 
 
+class BitmatrixCodec(ErasureCode):
+    """m=2 RAID-6 packet codec for the jerasure bitmatrix techniques
+    (reference: ErasureCodeJerasureLiberation et al.: chunks split into w
+    packets, parity = GF(2) bitmatrix over packets).  Default w per
+    technique follows the reference's ErasureCodeJerasure defaults where
+    they exist (liberation/blaum_roth stock w=7; liber8tion w=8)."""
+
+    def __init__(self, profile: dict | None = None, backend: str = "jax"):
+        self.backend = backend
+        super().__init__(profile)
+
+    def init(self, profile: dict) -> None:
+        from ...gf.gf2 import gf2_inv, raid6_bitmatrix
+
+        self.profile = dict(profile)
+        self.k = self.parse_int(profile, "k", 2)
+        self.m = self.parse_int(profile, "m", 2)
+        self.technique = profile.get("technique", "liberation")
+        if self.m != 2:
+            raise InvalidProfile(
+                f"technique={self.technique} is RAID-6 only (m=2), got "
+                f"m={self.m}"
+            )
+        default_w = 8 if self.technique == "liber8tion" else 7
+        self.w = self.parse_int(profile, "w", default_w)
+        try:
+            self.B = raid6_bitmatrix(self.technique, self.k, self.w)
+        except ValueError as e:
+            raise InvalidProfile(str(e))
+        self._gf2_inv = gf2_inv
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        base = super().get_chunk_size(stripe_width)
+        return -(-base // self.w) * self.w  # w packets per chunk
+
+    def _apply(self, M: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        if self.backend == "jax":
+            from ...ops.bitplane import apply_xor_matrix_jax
+
+            return np.asarray(apply_xor_matrix_jax(M, rows))
+        out = np.zeros((M.shape[0], rows.shape[1]), dtype=np.uint8)
+        for r in range(M.shape[0]):
+            for j in np.nonzero(M[r])[0]:
+                out[r] ^= rows[j]
+        return out
+
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
+        k, L = data_chunks.shape
+        if L % self.w:
+            raise ValueError(f"chunk length {L} not divisible by w={self.w}")
+        rows = data_chunks.reshape(k * self.w, L // self.w)
+        parity = self._apply(self.B, rows)
+        return parity.reshape(2, L)
+
+    def decode_chunks(self, want_to_read, chunks: dict[int, np.ndarray]):
+        avail = sorted(chunks)
+        if len(avail) < self.k:
+            raise InsufficientChunks(f"need {self.k}, have {len(avail)}")
+        use = avail[: self.k]
+        L = len(next(iter(chunks.values())))
+        w, k = self.w, self.k
+        # generator rows: data chunk i = identity block i; parity j = B
+        # row block j
+        G = np.concatenate(
+            [np.eye(k * w, dtype=np.uint8), self.B], axis=0
+        )
+        sel = np.concatenate(
+            [G[c * w : (c + 1) * w] for c in use], axis=0
+        )  # [kw, kw]
+        inv = self._gf2_inv(sel)
+        rows = np.concatenate([
+            np.asarray(chunks[c], dtype=np.uint8).reshape(w, L // w)
+            for c in use
+        ])
+        data_rows = self._apply(inv, rows)
+        data = data_rows.reshape(k, L)
+        result: dict[int, np.ndarray] = {}
+        missing_par = [
+            c for c in sorted(set(want_to_read))
+            if c >= k and c not in chunks
+        ]
+        if missing_par:
+            par = self._apply(
+                np.concatenate(
+                    [self.B[(c - k) * w : (c - k + 1) * w]
+                     for c in missing_par]
+                ),
+                data_rows,
+            )
+            for i, c in enumerate(missing_par):
+                result[c] = par[i * w : (i + 1) * w].reshape(L)
+        for wanted in sorted(set(want_to_read)):
+            if wanted in chunks:
+                result[wanted] = np.asarray(chunks[wanted], dtype=np.uint8)
+            elif wanted < k:
+                result[wanted] = data[wanted]
+        return result
+
+
 class RSPlugin(ErasureCodePlugin):
     """Registry factory (reference: jerasure/ErasureCodePluginJerasure.cc ::
     ErasureCodePluginJerasure::factory switching on technique)."""
@@ -154,5 +254,7 @@ class RSPlugin(ErasureCodePlugin):
     def __init__(self, backend: str = "jax"):
         self.backend = backend
 
-    def factory(self, profile: dict) -> RSCodec:
+    def factory(self, profile: dict):
+        if profile.get("technique") in BITMATRIX_TECHNIQUES:
+            return BitmatrixCodec(profile, backend=self.backend)
         return RSCodec(profile, backend=self.backend)

@@ -1,0 +1,164 @@
+"""GF(2) matrix algebra + the RAID-6 bitmatrix code constructions
+(reference: the jerasure bitmatrix techniques' matrix builders —
+liberation.c :: liberation_coding_bitmatrix / liber8tion_coding_bitmatrix
+and jerasure.c blaum_roth support; SURVEY.md §2.1).
+
+Provenance caveat (SURVEY.md §0, as for SHEC): the reference mount was
+empty, so bit-for-bit parity with jerasure's tables is unverifiable.
+What IS pinned, by construction and by tests:
+
+- blaum_roth: THE Blaum-Roth code — the ring GF(2)[x]/M_p(x) with
+  p = w+1 prime and M_p = 1 + x + ... + x^(p-1); X_i is multiplication
+  by x^i in that ring (companion-matrix powers).  Fully determined by
+  the published definition.
+- liberation: w prime, X_0 = I and X_i = R^i (bit-rotation by i) plus
+  ONE extra bit per matrix — the Liberation structure (minimum-density
+  RAID-6).  The extra bit is chosen by a deterministic search that
+  enforces the MDS property exhaustively; positions may differ from
+  Plank's published tables but the density and fault-tolerance contract
+  is the same.
+- liber8tion: the same minimum-density search at w = 8 (k <= 8).
+
+All three yield true MDS RAID-6 (every 2-erasure pattern decodable),
+asserted at construction time.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+
+def gf2_inv(A: np.ndarray) -> np.ndarray:
+    """Inverse of a square GF(2) matrix; raises ValueError if singular."""
+    n = A.shape[0]
+    M = np.concatenate(
+        [A.astype(np.uint8) & 1, np.eye(n, dtype=np.uint8)], axis=1
+    )
+    for col in range(n):
+        piv = next((r for r in range(col, n) if M[r, col]), None)
+        if piv is None:
+            raise ValueError("singular GF(2) matrix")
+        if piv != col:
+            M[[col, piv]] = M[[piv, col]]
+        rows = np.nonzero(M[:, col])[0]
+        rows = rows[rows != col]
+        M[rows] ^= M[col]
+    return M[:, n:]
+
+
+def gf2_is_invertible(A: np.ndarray) -> bool:
+    try:
+        gf2_inv(A)
+        return True
+    except ValueError:
+        return False
+
+
+def _rotation(w: int, i: int) -> np.ndarray:
+    """R^i: bit r of the output is bit (r - i) mod w of the input."""
+    X = np.zeros((w, w), dtype=np.uint8)
+    X[(np.arange(w) + i) % w, np.arange(w)] = 1
+    return X
+
+
+def _companion_pow(poly_taps: list[int], w: int, i: int) -> np.ndarray:
+    """C^i for the companion matrix of x^w + sum x^t (t in taps)."""
+    C = np.zeros((w, w), dtype=np.uint8)
+    C[1:, :-1] = np.eye(w - 1, dtype=np.uint8)
+    for t in poly_taps:
+        C[t, w - 1] = 1
+    X = np.eye(w, dtype=np.uint8)
+    for _ in range(i):
+        X = (X @ C) & 1
+    return X
+
+
+def _mds_ok(xs: list[np.ndarray]) -> bool:
+    """RAID-6 MDS test: with P = XOR of data and Q = XOR of X_i d_i,
+    every 2-erasure decodes iff each X_i and each X_i ^ X_j is
+    invertible (single erasures follow a fortiori)."""
+    for i, Xi in enumerate(xs):
+        if not gf2_is_invertible(Xi):
+            return False
+        for Xj in xs[:i]:
+            if not gf2_is_invertible(Xi ^ Xj):
+                return False
+    return True
+
+
+def _is_prime(n: int) -> bool:
+    if n < 2:
+        return False
+    return all(n % d for d in range(2, int(n**0.5) + 1))
+
+
+def _min_density_xs(k: int, w: int, fallback_taps: list[int]) -> list:
+    """X_0 = I; X_i = R^i + one extra bit, the bit found by deterministic
+    search so the prefix stays MDS; a position-exhausted column falls
+    back to companion-powers of `fallback_taps`' polynomial for ALL
+    matrices (always MDS when the polynomial is primitive)."""
+    xs: list[np.ndarray] = [np.eye(w, dtype=np.uint8)]
+    for i in range(1, k):
+        base = _rotation(w, i)
+        placed = False
+        for r in range(w):
+            for c in range(w):
+                if base[r, c]:
+                    continue
+                cand = base.copy()
+                cand[r, c] = 1
+                if _mds_ok(xs + [cand]):
+                    xs.append(cand)
+                    placed = True
+                    break
+            if placed:
+                break
+        if not placed:
+            return [
+                _companion_pow(fallback_taps, w, i) for i in range(k)
+            ]
+    return xs
+
+
+@lru_cache(maxsize=64)
+def raid6_bitmatrix(technique: str, k: int, w: int) -> np.ndarray:
+    """[2w, kw] GF(2) coding bitmatrix (P rows then Q rows) for the
+    given bitmatrix technique."""
+    if k < 1:
+        raise ValueError("k must be >= 1")
+    if technique == "blaum_roth":
+        if not _is_prime(w + 1):
+            raise ValueError(f"blaum_roth requires w+1 prime (w={w})")
+        if k > w:
+            raise ValueError(f"blaum_roth requires k <= w (k={k}, w={w})")
+        # multiplication by x in GF(2)[x]/M_p: shift, with x^w folding to
+        # 1 + x + ... + x^(w-1)  (x^p = 1 and M_p(x) = 0)
+        xs = [_companion_pow(list(range(w)), w, i) for i in range(k)]
+    elif technique == "liberation":
+        if not _is_prime(w):
+            raise ValueError(f"liberation requires w prime (w={w})")
+        if k > w:
+            raise ValueError(f"liberation requires k <= w (k={k}, w={w})")
+        xs = _min_density_xs(k, w, [0, 2, 3, 4])
+    elif technique == "liber8tion":
+        if w != 8:
+            raise ValueError("liber8tion fixes w=8")
+        if k > 8:
+            raise ValueError(f"liber8tion requires k <= 8 (k={k})")
+        # fallback polynomial: x^8 + x^4 + x^3 + x^2 + 1 (primitive)
+        xs = _min_density_xs(k, 8, [0, 2, 3, 4])
+    else:
+        raise ValueError(f"unknown bitmatrix technique {technique!r}")
+    if not _mds_ok(xs):
+        # must hold in ALL run modes (an assert would vanish under -O and
+        # let a non-MDS matrix serve I/O); BitmatrixCodec converts this
+        # to InvalidProfile
+        raise ValueError(
+            f"{technique}(k={k}, w={w}) failed the MDS check"
+        )
+    B = np.zeros((2 * w, k * w), dtype=np.uint8)
+    for j in range(k):
+        B[:w, j * w : (j + 1) * w] = np.eye(w, dtype=np.uint8)  # P
+        B[w:, j * w : (j + 1) * w] = xs[j]                       # Q
+    return B

@@ -73,6 +73,25 @@ def apply_matrix_jax(mat: np.ndarray, chunks) -> jnp.ndarray:
 
 
 @lru_cache(maxsize=256)
+def xor_bitmatrix_device(b_bytes: bytes, shape: tuple[int, int]) -> jnp.ndarray:
+    """0/1 XOR-combination matrix expanded to bitplane form: each byte
+    row mixes independently per bit, so the bit-level operator is
+    kron(B, I_8) and the GF(2^8) bitplane kernel serves XOR codes
+    (liberation/blaum_roth/liber8tion packets) unchanged."""
+    B = np.frombuffer(b_bytes, dtype=np.uint8).reshape(shape)
+    return jnp.asarray(np.kron(B, np.eye(8, dtype=np.int8)))
+
+
+def apply_xor_matrix_jax(B: np.ndarray, rows) -> jnp.ndarray:
+    """[R, N] 0/1 matrix XOR-combining [N, L] byte rows -> [R, L], on
+    device through the same MXU bitplane matmul as the GF(2^8) path."""
+    Bd = xor_bitmatrix_device(
+        np.ascontiguousarray(B, dtype=np.uint8).tobytes(), B.shape
+    )
+    return _apply_bitmatrix(Bd, jnp.asarray(rows, dtype=jnp.uint8))
+
+
+@lru_cache(maxsize=256)
 def bitmatrix_device(mat_bytes: bytes, shape: tuple[int, int]) -> jnp.ndarray:
     """Host-expanded bitmatrix, cached per coding matrix (the analog of
     ErasureCodeIsaTableCache's per-pattern table cache, reference:

@@ -1,0 +1,56 @@
+"""Host<->device pipelining for stripe-batch streams (SURVEY.md §2.9
+"pipeline parallelism" analog: the reference overlaps its write pipeline
+stages; the TPU equivalent is double-buffering host->device DMA against
+kernel compute).
+
+`stream_encode` drives a sequence of host batches through the encode
+kernel with at most two batches resident: while the device computes
+parity for batch i, batch i+1's transfer is already in flight (both
+device_put and kernel launches are async under JAX's dispatch model;
+the np.asarray fetch of result i-1 is the only sync point and it
+overlaps the later batches' work).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def stream_encode(mat: np.ndarray, batches, kernel: str = "xla"):
+    """Encode an iterable of [k, L] host batches; returns the list of
+    parity arrays.  kernel: 'xla' (ops.bitplane) or 'pallas'
+    (ops.pallas_gf)."""
+    import jax
+
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    if kernel == "pallas":
+        from .pallas_gf import apply_matrix_pallas
+
+        def apply_fn(x):
+            return apply_matrix_pallas(mat, x)
+
+    else:
+        from .bitplane import apply_matrix_jax
+
+        def apply_fn(x):
+            return apply_matrix_jax(mat, x)
+
+    batches = list(batches)
+    if not batches:
+        return []
+    outs = []
+    results = []
+    nxt = jax.device_put(np.ascontiguousarray(batches[0], dtype=np.uint8))
+    for i in range(len(batches)):
+        cur = nxt
+        # launch compute first (async), THEN start the next DMA so the
+        # copy engine and the cores overlap
+        results.append(apply_fn(cur))
+        if i + 1 < len(batches):
+            nxt = jax.device_put(
+                np.ascontiguousarray(batches[i + 1], dtype=np.uint8)
+            )
+        if i >= 1:  # fetch the previous result; keeps two batches live
+            outs.append(np.asarray(results[i - 1]))
+            results[i - 1] = None
+    outs.append(np.asarray(results[-1]))
+    return outs

@@ -507,12 +507,21 @@ class OSD(Dispatcher):
             # unchanged, in the post-split PG
         ):
             try:
-                self._maybe_clone(pg, pool, msg.oid, snap_seq)
+                head_existed = self._maybe_clone(pg, pool, msg.oid, snap_seq)
             except Exception as e:
                 return MOSDOpReply(
                     tid=msg.tid, retval=-5, epoch=self.my_epoch(),
                     result=f"snap clone failed: {e}",
                 )
+            if msg.op == "write_full" and not head_existed:
+                rep = (
+                    self._ec_op(pg, pool, acting, msg)
+                    if pool.type == PG_POOL_ERASURE
+                    else self._replicated_op(pg, pool, acting, msg)
+                )
+                if rep.retval == 0:
+                    self._mark_born(pg, pool, msg.oid, snap_seq)
+                return rep
         if (
             msg.op == "read"
             and getattr(msg, "snapid", None)
@@ -521,6 +530,12 @@ class OSD(Dispatcher):
             clone_oid = self._resolve_snap_read(
                 pg, pool, acting, msg.oid, int(msg.snapid)
             )
+            if clone_oid is None:
+                # object was created after the snapshot
+                return MOSDOpReply(
+                    tid=msg.tid, retval=-2, epoch=self.my_epoch(),
+                    result="did not exist at snap",
+                )
             if clone_oid != msg.oid:
                 msg = MOSDOp(
                     tid=msg.tid, pool=msg.pool, oid=clone_oid, op="read",
@@ -546,9 +561,11 @@ class OSD(Dispatcher):
         stat and the later one would capture POST-snap bytes as the
         clone, corrupting the snapshot view."""
         with self._clone_mutex:
-            self._maybe_clone_locked(pg, pool, oid, snap_seq)
+            return self._maybe_clone_locked(pg, pool, oid, snap_seq)
 
-    def _maybe_clone_locked(self, pg, pool, oid: str, snap_seq: int) -> None:
+    def _maybe_clone_locked(self, pg, pool, oid: str, snap_seq: int) -> bool:
+        """Returns True when the head EXISTED (clone made or already
+        present); False = brand-new object this write creates."""
         clone = self._clone_oid(oid, snap_seq)
         e = self.my_epoch()
         st = self._execute_client_op(MOSDOp(
@@ -556,19 +573,37 @@ class OSD(Dispatcher):
             epoch=e, ps=pg.ps,
         ))
         if st.retval == 0:
-            return  # this snap generation already preserved
+            return True  # this snap generation already preserved
         r = self._execute_client_op(MOSDOp(
             tid=self._next_tid(), pool=pool.pool_id, oid=oid, op="read",
             epoch=e, ps=pg.ps, off=0, length=0,
         ))
         if r.retval != 0:
-            return  # no head: nothing to preserve
+            return False  # no head: nothing to preserve
         w = self._execute_client_op(MOSDOp(
             tid=self._next_tid(), pool=pool.pool_id, oid=clone,
             op="write_full", data=r.data, epoch=e, ps=pg.ps,
         ))
         if w.retval != 0:
             raise RuntimeError(f"clone write: {w.result}")
+        return True
+
+    def _mark_born(self, pg, pool, oid: str, snap_seq: int) -> None:
+        """Stamp a newly created object with the snap generation it was
+        born in, so snapshot reads older than its creation return ENOENT
+        instead of the head (reference: SnapSet knows object existence
+        per snap).  Rides the replicated user-xattr path under a
+        reserved '_'-name the client surface filters out."""
+        r = self._execute_client_op(MOSDOp(
+            tid=self._next_tid(), pool=pool.pool_id, oid=oid,
+            op="setxattr", epoch=self.my_epoch(), ps=pg.ps,
+            data={"_snapborn": pack_data(str(snap_seq).encode())},
+        ))
+        if r.retval != 0:
+            self.cct.dout(
+                "osd", 1,
+                f"{self.whoami} snapborn mark {oid} failed: {r.result}",
+            )
 
     def _primary_cid(self, pg, pool, acting) -> str:
         shard = acting.index(self.id) if pool.type == PG_POOL_ERASURE else 0
@@ -593,6 +628,20 @@ class OSD(Dispatcher):
         for c in ids:
             if c >= snapid:
                 return self._clone_oid(oid, c)
+        # no clone: the head serves the snap view — unless the object was
+        # born after the snapshot (its _snapborn generation >= snapid)
+        xr = self._execute_client_op(MOSDOp(
+            tid=self._next_tid(), pool=pool.pool_id, oid=oid,
+            op="getxattrs", epoch=self.my_epoch(), ps=pg.ps,
+        ))
+        if xr.retval == 0 and isinstance(xr.result, dict):
+            born = xr.result.get("_snapborn")
+            if born is not None:
+                try:
+                    if int(unpack_data(born).decode()) >= snapid:
+                        return None
+                except (ValueError, AttributeError):
+                    pass
         return oid
 
     def _snaptrim_pass(self) -> None:
@@ -636,10 +685,17 @@ class OSD(Dispatcher):
                 head, _, suffix = n.partition(CLONE_SEP)
                 by_head.setdefault(head, []).append(int(suffix))
         live = sorted(live_key)
+        snap_seq = max([pool.snap_seq, *live_key]) if live_key else pool.snap_seq
         for head, ids in by_head.items():
             ids.sort()
             prev = 0
             for c in ids:
+                if c > snap_seq:
+                    # a generation this map hasn't seen yet (clone minted
+                    # from a newer client's snap context right after a
+                    # mksnap): deleting it would destroy the new snapshot
+                    prev = c
+                    continue
                 needed = any(prev < s <= c for s in live)
                 prev = c
                 if needed:

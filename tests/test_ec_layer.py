@@ -38,7 +38,10 @@ class TestRegistry:
         for bad in (
             {"plugin": "jax", "k": "x"},
             {"plugin": "jax", "k": "0", "m": "1"},
-            {"plugin": "jax", "technique": "liberation"},
+            # liberation is now a supported technique; only its RAID-6
+            # contract violations reject
+            {"plugin": "jax", "technique": "liberation", "m": "3"},
+            {"plugin": "jax", "technique": "liberation", "w": "9"},
             {"plugin": "jax", "technique": "made_up"},
             {"plugin": "jax", "w": "16"},
             {"plugin": "jax", "technique": "reed_sol_r6_op", "k": "4", "m": "3"},

@@ -67,6 +67,22 @@ def test_snap_preserves_deleted_object(client):
     io.snap_remove("predel")
 
 
+def test_object_born_after_snap_is_absent_in_snap_view(client):
+    io = client.open_ioctx("rp")
+    sid = io.snap_create("early")
+    io.write_full("newborn", b"post-snap bytes")
+    assert io.read("newborn") == b"post-snap bytes"
+    with pytest.raises(IOError):  # did not exist at snap time
+        io.read("newborn", snapid=sid)
+    # a later snap DOES see it
+    s2 = io.snap_create("later")
+    assert io.read("newborn", snapid=s2) == b"post-snap bytes"
+    # the born marker stays out of the client xattr surface
+    assert "_snapborn" not in io.get_xattrs("newborn")
+    io.snap_remove("early")
+    io.snap_remove("later")
+
+
 def test_snap_rollback(client):
     io = client.open_ioctx("rp")
     io.write_full("rb", b"good state")
