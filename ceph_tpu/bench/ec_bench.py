@@ -95,7 +95,11 @@ def run_encode(codec, args) -> dict:
         stream_encode(codec.coding, batches)
         seconds = time.perf_counter() - t0
         return {"seconds": seconds, "bytes": args.size * args.stream}
-    if getattr(codec, "backend", None) == "jax" and not args.no_chain:
+    if (
+        getattr(codec, "backend", None) == "jax"
+        and getattr(codec, "coding", None) is not None
+        and not args.no_chain
+    ):  # bitmatrix codecs (no byte coding matrix) take the generic path
         seconds = time_chained_encode(codec.coding, chunks, args.iterations)
     else:
         codec.encode_chunks(chunks)  # warm

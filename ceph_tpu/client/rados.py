@@ -123,6 +123,11 @@ class IoCtx:
         """reference: rados_setxattr."""
         from ..osd.messages import pack_data
 
+        if name.startswith("_"):
+            raise IOError(
+                f"xattr {name!r}: '_'-prefixed names are reserved for "
+                "framework metadata (snapshot bookkeeping)"
+            )
         rep = self._client.objecter.op_submit(
             self.pool_id, oid, "setxattr",
             data={name: pack_data(bytes(value))},
@@ -132,6 +137,8 @@ class IoCtx:
 
     def rm_xattr(self, oid: str, name: str) -> None:
         """reference: rados_rmxattr."""
+        if name.startswith("_"):
+            raise IOError(f"xattr {name!r}: '_'-prefixed names are reserved")
         rep = self._client.objecter.op_submit(
             self.pool_id, oid, "setxattr", data={name: None}
         )

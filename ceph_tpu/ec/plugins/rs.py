@@ -156,11 +156,13 @@ class BitmatrixCodec(ErasureCode):
 
     def __init__(self, profile: dict | None = None, backend: str = "jax"):
         self.backend = backend
+        self._dm_cache: dict[tuple, np.ndarray] = {}
         super().__init__(profile)
 
     def init(self, profile: dict) -> None:
         from ...gf.gf2 import gf2_inv, raid6_bitmatrix
 
+        self._dm_cache.clear()
         self.profile = dict(profile)
         self.k = self.parse_int(profile, "k", 2)
         self.m = self.parse_int(profile, "m", 2)
@@ -209,15 +211,19 @@ class BitmatrixCodec(ErasureCode):
         use = avail[: self.k]
         L = len(next(iter(chunks.values())))
         w, k = self.w, self.k
-        # generator rows: data chunk i = identity block i; parity j = B
-        # row block j
-        G = np.concatenate(
-            [np.eye(k * w, dtype=np.uint8), self.B], axis=0
-        )
-        sel = np.concatenate(
-            [G[c * w : (c + 1) * w] for c in use], axis=0
-        )  # [kw, kw]
-        inv = self._gf2_inv(sel)
+        inv = self._dm_cache.get(tuple(use))
+        if inv is None:
+            # generator rows: data chunk i = identity block i; parity j =
+            # B row block j; per-pattern cache (the ShecTableCache /
+            # BitplaneCodec._decode_cache role — at most C(k+2,2) entries)
+            G = np.concatenate(
+                [np.eye(k * w, dtype=np.uint8), self.B], axis=0
+            )
+            sel = np.concatenate(
+                [G[c * w : (c + 1) * w] for c in use], axis=0
+            )  # [kw, kw]
+            inv = self._gf2_inv(sel)
+            self._dm_cache[tuple(use)] = inv
         rows = np.concatenate([
             np.asarray(chunks[c], dtype=np.uint8).reshape(w, L // w)
             for c in use

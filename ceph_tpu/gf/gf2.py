@@ -93,11 +93,76 @@ def _is_prime(n: int) -> bool:
     return all(n % d for d in range(2, int(n**0.5) + 1))
 
 
-def _min_density_xs(k: int, w: int, fallback_taps: list[int]) -> list:
+def _polymulmod(a: int, b: int, f: int, w: int) -> int:
+    """(a*b) mod f over GF(2), polynomials as bit-ints, deg f = w."""
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        b >>= 1
+        a <<= 1
+        if a >> w:
+            a ^= f
+    return r
+
+
+def _polypowmod(a: int, e: int, f: int, w: int) -> int:
+    r = 1
+    while e:
+        if e & 1:
+            r = _polymulmod(r, a, f, w)
+        a = _polymulmod(a, a, f, w)
+        e >>= 1
+    return r
+
+
+def _poly_gcd(a: int, b: int) -> int:
+    while b:
+        while a.bit_length() >= b.bit_length() and a:
+            a ^= b << (a.bit_length() - b.bit_length())
+        a, b = b, a
+    return a
+
+
+def _is_irreducible(f: int, w: int) -> bool:
+    """Rabin's test: x^(2^w) == x mod f, and for every prime p | w,
+    gcd(x^(2^(w/p)) - x, f) == 1."""
+    if _polypowmod(2, 1 << w, f, w) != 2:  # x = poly '10' = 2
+        return False
+    n, primes = w, []
+    d = 2
+    while d * d <= n:
+        if n % d == 0:
+            primes.append(d)
+            while n % d == 0:
+                n //= d
+        d += 1
+    if n > 1:
+        primes.append(n)
+    for p in primes:
+        h = _polypowmod(2, 1 << (w // p), f, w) ^ 2
+        if _poly_gcd(f, h) != 1:
+            return False
+    return True
+
+
+def _first_irreducible(w: int) -> int:
+    """Deterministic smallest irreducible degree-w polynomial (bit-int
+    with the x^w term set)."""
+    for low in range(1, 1 << w, 2):  # constant term must be 1
+        f = (1 << w) | low
+        if _is_irreducible(f, w):
+            return f
+    raise ValueError(f"no irreducible polynomial of degree {w}")  # unreachable
+
+
+def _min_density_xs(k: int, w: int) -> list:
     """X_0 = I; X_i = R^i + one extra bit, the bit found by deterministic
     search so the prefix stays MDS; a position-exhausted column falls
-    back to companion-powers of `fallback_taps`' polynomial for ALL
-    matrices (always MDS when the polynomial is primitive)."""
+    back to companion-powers of the smallest IRREDUCIBLE degree-w
+    polynomial for ALL matrices.  Irreducibility alone guarantees MDS
+    here: a root's multiplicative order exceeds w >= k, so alpha^(i-j)
+    != 1 and every X_i ^ X_j stays invertible."""
     xs: list[np.ndarray] = [np.eye(w, dtype=np.uint8)]
     for i in range(1, k):
         base = _rotation(w, i)
@@ -115,9 +180,9 @@ def _min_density_xs(k: int, w: int, fallback_taps: list[int]) -> list:
             if placed:
                 break
         if not placed:
-            return [
-                _companion_pow(fallback_taps, w, i) for i in range(k)
-            ]
+            f = _first_irreducible(w)
+            taps = [t for t in range(w) if (f >> t) & 1]
+            return [_companion_pow(taps, w, i) for i in range(k)]
     return xs
 
 
@@ -140,14 +205,13 @@ def raid6_bitmatrix(technique: str, k: int, w: int) -> np.ndarray:
             raise ValueError(f"liberation requires w prime (w={w})")
         if k > w:
             raise ValueError(f"liberation requires k <= w (k={k}, w={w})")
-        xs = _min_density_xs(k, w, [0, 2, 3, 4])
+        xs = _min_density_xs(k, w)
     elif technique == "liber8tion":
         if w != 8:
             raise ValueError("liber8tion fixes w=8")
         if k > 8:
             raise ValueError(f"liber8tion requires k <= 8 (k={k})")
-        # fallback polynomial: x^8 + x^4 + x^3 + x^2 + 1 (primitive)
-        xs = _min_density_xs(k, 8, [0, 2, 3, 4])
+        xs = _min_density_xs(k, 8)
     else:
         raise ValueError(f"unknown bitmatrix technique {technique!r}")
     if not _mds_ok(xs):

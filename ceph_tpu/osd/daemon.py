@@ -586,7 +586,30 @@ class OSD(Dispatcher):
         ))
         if w.retval != 0:
             raise RuntimeError(f"clone write: {w.result}")
+        born = self._born_of(pg, pool, oid)
+        if born:
+            self._execute_client_op(MOSDOp(
+                tid=self._next_tid(), pool=pool.pool_id, oid=clone,
+                op="setxattr", epoch=e, ps=pg.ps,
+                data={"_snapborn": pack_data(str(born).encode())},
+            ))
         return True
+
+    def _born_of(self, pg, pool, oid: str) -> int:
+        """Snap generation an object (head or clone) was created in; 0 =
+        pre-snapshot or unmarked."""
+        xr = self._execute_client_op(MOSDOp(
+            tid=self._next_tid(), pool=pool.pool_id, oid=oid,
+            op="getxattrs", epoch=self.my_epoch(), ps=pg.ps,
+        ))
+        if xr.retval == 0 and isinstance(xr.result, dict):
+            born = xr.result.get("_snapborn")
+            if born is not None:
+                try:
+                    return int(unpack_data(born).decode())
+                except (ValueError, AttributeError):
+                    pass
+        return 0
 
     def _mark_born(self, pg, pool, oid: str, snap_seq: int) -> None:
         """Stamp a newly created object with the snap generation it was
@@ -627,21 +650,17 @@ class OSD(Dispatcher):
         )
         for c in ids:
             if c >= snapid:
-                return self._clone_oid(oid, c)
+                clone = self._clone_oid(oid, c)
+                # the clone inherits its head's born marker: a clone made
+                # AFTER a post-snap creation must not make the object
+                # appear in older snap views
+                if self._born_of(pg, pool, clone) >= snapid:
+                    return None
+                return clone
         # no clone: the head serves the snap view — unless the object was
         # born after the snapshot (its _snapborn generation >= snapid)
-        xr = self._execute_client_op(MOSDOp(
-            tid=self._next_tid(), pool=pool.pool_id, oid=oid,
-            op="getxattrs", epoch=self.my_epoch(), ps=pg.ps,
-        ))
-        if xr.retval == 0 and isinstance(xr.result, dict):
-            born = xr.result.get("_snapborn")
-            if born is not None:
-                try:
-                    if int(unpack_data(born).decode()) >= snapid:
-                        return None
-                except (ValueError, AttributeError):
-                    pass
+        if self._born_of(pg, pool, oid) >= snapid:
+            return None
         return oid
 
     def _snaptrim_pass(self) -> None:

@@ -3,7 +3,9 @@
 Reference: src/tools/ceph_objectstore_tool.cc (list/info/export/import/
 remove objects and fsck against an offline data path; SURVEY.md §2.8).
 
-Works on a KStore directory (the file-backed ObjectStore).  Export format
+Works on a KStore or BlueStore directory (auto-detected by the block
+device file; the ceph-bluestore-tool fsck/repair role folds in here for
+bluestore paths).  Export format
 is a self-contained JSON document (data/xattrs/omap base64'd) so an object
 or a whole PG's shard collection can be moved between stores — the
 analog of the reference's export/import stream.
@@ -25,8 +27,17 @@ from ..store.kstore import KStore
 from ..store.object_store import NotFound, Transaction
 
 
-def _open(path: str) -> KStore:
-    store = KStore(path)
+def _open(path: str):
+    import os
+
+    if os.path.exists(os.path.join(path, "block")):
+        from ..store.bluestore import BlueStore
+
+        # size from the existing device file — never resize on open
+        dev = os.path.getsize(os.path.join(path, "block"))
+        store = BlueStore(path, device_size=dev)
+    else:
+        store = KStore(path)
     store.mount()
     return store
 
@@ -164,11 +175,28 @@ def main(argv=None, out=sys.stdout) -> int:
                 ap.error("remove needs --pgid and an object name")
             return op_remove(store, args.pgid, args.object)
         if args.op == "fsck":
-            errors = store.fsck()
-            for e in errors:
+            from ..store.bluestore import BlueStore
+
+            report = store.fsck(
+                **({"deep": True, "repair": args.force}
+                   if isinstance(store, BlueStore) else {})
+            )
+            if isinstance(report, dict):  # bluestore: structured report
+                errors = report["errors"]
+                for e in errors:
+                    print(e, file=out)
+                print(
+                    f"fsck: {len(errors)} error(s), "
+                    f"{report['leaked_blocks']} leaked block(s)"
+                    + (f", repaired {report['repaired']}"
+                       if report.get("repaired") else ""),
+                    file=out,
+                )
+                return 1 if errors or report["leaked_blocks"] else 0
+            for e in report:
                 print(e, file=out)
-            print(f"fsck: {len(errors)} error(s)", file=out)
-            return 1 if errors else 0
+            print(f"fsck: {len(report)} error(s)", file=out)
+            return 1 if report else 0
         return 2
     finally:
         store.umount()

@@ -231,6 +231,28 @@ def test_device_full(tmp_path):
     s.umount()
 
 
+def test_objectstore_tool_on_bluestore(tmp_path):
+    """The offline surgery tool auto-detects bluestore dirs and fscks
+    them (the ceph-bluestore-tool role)."""
+    import io as _io
+
+    from ceph_tpu.tools.objectstore_tool import main as ost
+
+    path = str(tmp_path / "bs")
+    s = BlueStore(path, device_size=8 << 20, inline_threshold=64)
+    s.queue_transaction(Transaction().create_collection("1.0s0"))
+    s.queue_transaction(
+        Transaction().write("1.0s0", "obj", 0, os.urandom(20000))
+    )
+    s.umount()
+    out = _io.StringIO()
+    assert ost(["--data-path", path, "--op", "list"], out=out) == 0
+    assert "obj" in out.getvalue()
+    out = _io.StringIO()
+    assert ost(["--data-path", path, "--op", "fsck"], out=out) == 0
+    assert "0 error(s), 0 leaked" in out.getvalue()
+
+
 def test_osd_boots_on_bluestore(tmp_path):
     """objectstore=bluestore serves a replicated pool end-to-end."""
     from ceph_tpu.qa.vstart import LocalCluster

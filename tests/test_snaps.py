@@ -83,6 +83,31 @@ def test_object_born_after_snap_is_absent_in_snap_view(client):
     io.snap_remove("later")
 
 
+def test_born_after_snap_stays_absent_through_clones(client):
+    """An overwrite of a post-snap object mints a clone; that clone must
+    not make the object visible at the OLDER snap (the clone inherits
+    the head's born marker)."""
+    io = client.open_ioctx("rp")
+    s1 = io.snap_create("bc1")
+    io.write_full("bc-obj", b"A")   # born after bc1
+    s2 = io.snap_create("bc2")
+    io.write_full("bc-obj", b"B")   # clone@2 preserves A
+    assert io.read("bc-obj", snapid=s2) == b"A"
+    with pytest.raises(IOError):
+        io.read("bc-obj", snapid=s1)
+    io.snap_remove("bc1")
+    io.snap_remove("bc2")
+
+
+def test_reserved_xattr_names_rejected(client):
+    io = client.open_ioctx("rp")
+    io.write_full("resx", b"x")
+    with pytest.raises(IOError):
+        io.set_xattr("resx", "_snapborn", b"0")
+    with pytest.raises(IOError):
+        io.rm_xattr("resx", "_anything")
+
+
 def test_snap_rollback(client):
     io = client.open_ioctx("rp")
     io.write_full("rb", b"good state")
