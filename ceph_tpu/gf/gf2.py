@@ -21,6 +21,13 @@ What IS pinned, by construction and by tests:
 
 All three yield true MDS RAID-6 (every 2-erasure pattern decodable),
 asserted at construction time.
+
+FORMAT STABILITY: the construction (search order, fallback polynomial
+choice) IS the on-disk parity format for these techniques — changing it
+would make previously persisted parity undecodable with no error.
+tests/test_bitmatrix_codecs.py pins golden checksums of the generated
+matrices; a legitimate format change must bump those goldens AND ship a
+migration path.
 """
 from __future__ import annotations
 

@@ -32,6 +32,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
 
 from ..crush.hash import crush_hash32_3
 from ..crush.ln_compute import (
@@ -48,10 +49,37 @@ from ..crush.ln_compute import (
 _T1 = TBL1_BYTES  # [256, 16], rows 129.. zero-padded by the builder
 _T2 = TBL2_BYTES  # [256, 8]
 
-DEFAULT_TILE = 32  # rows per grid step ([T, S] tile; S padded to 128).
-# 64 exceeds the 16 MiB scoped-vmem limit on v5e: the two one-hot
-# [T, S, 256] bf16 intermediates hit ~28 MiB; 32 fits with margin and
-# compiles + matches the table gather bit-exactly on hardware.
+import os as _os
+
+CHUNK = 32
+
+
+def _tile_from_env() -> int:
+    """CEPH_TPU_STRAW2_TILE override for hardware sweeps (e.g. 32
+    restores the single-slab shape); validated here so a bad value fails
+    at the knob with its name, not deep inside a score call."""
+    raw = _os.environ.get("CEPH_TPU_STRAW2_TILE", "256")
+    try:
+        tile = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"CEPH_TPU_STRAW2_TILE={raw!r}: integer required"
+        ) from None
+    if tile <= 0 or tile % CHUNK:
+        raise ValueError(
+            f"CEPH_TPU_STRAW2_TILE={tile}: must be a positive multiple "
+            f"of {CHUNK}"
+        )
+    return tile
+
+
+# rows per grid step ([T, S] tile; S padded to 128)
+DEFAULT_TILE = _tile_from_env()
+# The kernel walks the tile in CHUNK-row slabs with an inner fori_loop:
+# the one-hot [CHUNK, S, 256] bf16 intermediates are what blow the
+# 16 MiB scoped-vmem limit (CHUNK=64 hit ~28 MiB on v5e), so CHUNK
+# stays small while the tile — and therefore the number of grid steps,
+# each of which pays fixed Mosaic setup cost — shrinks by tile/CHUNK.
 
 
 def _disable_x64():
@@ -83,17 +111,9 @@ def _onehot_lookup(idx, tbl_bf16):
 
 
 def _score_kernel(x_ref, r_ref, items_ref, t1_ref, t2_ref, hi_ref, lo_ref):
-    x = x_ref[:]          # [T, 1] int32
-    r = r_ref[:]          # [T, 1] int32
-    items = items_ref[:]  # [T, S] int32
-    h = crush_hash32_3(
-        x.astype(jnp.uint32),  # broadcasts [T, 1] across S
-        items.astype(jnp.uint32),
-        r.astype(jnp.uint32),
-    )
-    u = (h & jnp.uint32(0xFFFF)).astype(jnp.int32)
     t1 = t1_ref[:]
     t2 = t2_ref[:]
+    T = x_ref.shape[0]
 
     def look1(i):
         rows = _onehot_lookup(i, t1)
@@ -112,9 +132,25 @@ def _score_kernel(x_ref, r_ref, items_ref, t1_ref, t2_ref, hi_ref, lo_ref):
             recombine_limbs(rows, 4, 3, jnp),    # ll_lo
         )
 
-    hi, lo = crush_ln_limbs(u, jnp, look1, look2)
-    hi_ref[:] = hi
-    lo_ref[:] = lo
+    def slab(c, _):
+        # CHUNK-row slab: bounds the [CHUNK, S, 256] one-hot VMEM
+        # footprint while the grid step stays large
+        row = c * CHUNK
+        x = jax.lax.dynamic_slice_in_dim(x_ref[:], row, CHUNK, 0)
+        r = jax.lax.dynamic_slice_in_dim(r_ref[:], row, CHUNK, 0)
+        items = jax.lax.dynamic_slice_in_dim(items_ref[:], row, CHUNK, 0)
+        h = crush_hash32_3(
+            x.astype(jnp.uint32),  # broadcasts [CHUNK, 1] across S
+            items.astype(jnp.uint32),
+            r.astype(jnp.uint32),
+        )
+        u = (h & jnp.uint32(0xFFFF)).astype(jnp.int32)
+        hi, lo = crush_ln_limbs(u, jnp, look1, look2)
+        hi_ref[pl.dslice(row, CHUNK), :] = hi
+        lo_ref[pl.dslice(row, CHUNK), :] = lo
+        return _
+
+    jax.lax.fori_loop(0, T // CHUNK, slab, 0)
 
 
 @partial(jax.jit, static_argnames=("tile", "interpret"))
@@ -125,11 +161,11 @@ def straw2_scores_pallas(x, r, items, tile: int = DEFAULT_TILE,
     B must be a multiple of `tile` and S a multiple of 128 (the mapper
     pads); planes combine as crush_ln = hi * 2^24 + lo.
     """
-    from jax.experimental import pallas as pl
-
     B, S = items.shape
     if B % tile:
         raise ValueError(f"B={B} not a multiple of tile={tile}")
+    if tile % CHUNK:
+        raise ValueError(f"tile={tile} not a multiple of CHUNK={CHUNK}")
     if S % 128:
         raise ValueError(f"S={S} not a multiple of 128")
     x2 = x.reshape(B, 1).astype(jnp.int32)

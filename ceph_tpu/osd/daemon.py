@@ -573,7 +573,14 @@ class OSD(Dispatcher):
             epoch=e, ps=pg.ps,
         ))
         if st.retval == 0:
-            return True  # this snap generation already preserved
+            # this snap generation already preserved; a retried clone
+            # whose marker write was interrupted gets repaired here (the
+            # marker is what keeps born-after objects out of older views)
+            if self._born_of(pg, pool, clone) == 0:
+                born = self._born_of(pg, pool, oid)
+                if born:
+                    self._set_born(pg, pool, clone, born)
+            return True
         r = self._execute_client_op(MOSDOp(
             tid=self._next_tid(), pool=pool.pool_id, oid=oid, op="read",
             epoch=e, ps=pg.ps, off=0, length=0,
@@ -588,12 +595,19 @@ class OSD(Dispatcher):
             raise RuntimeError(f"clone write: {w.result}")
         born = self._born_of(pg, pool, oid)
         if born:
-            self._execute_client_op(MOSDOp(
-                tid=self._next_tid(), pool=pool.pool_id, oid=clone,
-                op="setxattr", epoch=e, ps=pg.ps,
-                data={"_snapborn": pack_data(str(born).encode())},
-            ))
+            self._set_born(pg, pool, clone, born)
         return True
+
+    def _set_born(self, pg, pool, oid: str, born: int) -> None:
+        r = self._execute_client_op(MOSDOp(
+            tid=self._next_tid(), pool=pool.pool_id, oid=oid,
+            op="setxattr", epoch=self.my_epoch(), ps=pg.ps,
+            data={"_snapborn": pack_data(str(born).encode())},
+        ))
+        if r.retval != 0:
+            # fail the client write rather than leave a clone that would
+            # surface a born-after object in older snap views
+            raise RuntimeError(f"clone born-marker write: {r.result}")
 
     def _born_of(self, pg, pool, oid: str) -> int:
         """Snap generation an object (head or clone) was created in; 0 =

@@ -125,6 +125,38 @@ def test_jax_and_host_backends_agree():
     assert np.array_equal(cj.encode_chunks(data), ch.encode_chunks(data))
 
 
+def test_matrix_format_is_pinned():
+    """The construction IS the on-disk parity format: any change to the
+    search order or fallback polynomial makes persisted parity silently
+    undecodable, so the generated matrices are pinned by checksum.  A
+    deliberate format change must bump these goldens AND ship a
+    migration path (see gf/gf2.py FORMAT STABILITY)."""
+    import zlib
+
+    goldens = [
+        ("liberation", 7, 7, 370869246),
+        ("blaum_roth", 6, 6, 312457762),
+        ("liber8tion", 8, 8, 673314900),
+        ("liberation", 11, 11, 1483187623),
+    ]
+    for tech, k, w, crc in goldens:
+        B = raid6_bitmatrix(tech, k, w)
+        assert zlib.crc32(B.tobytes()) == crc, (tech, k, w)
+
+
+def test_straw2_tile_env_validation(monkeypatch):
+    from ceph_tpu.ops.pallas_crush import _tile_from_env
+
+    monkeypatch.setenv("CEPH_TPU_STRAW2_TILE", "abc")
+    with pytest.raises(ValueError, match="CEPH_TPU_STRAW2_TILE"):
+        _tile_from_env()
+    monkeypatch.setenv("CEPH_TPU_STRAW2_TILE", "0")
+    with pytest.raises(ValueError, match="positive multiple"):
+        _tile_from_env()
+    monkeypatch.setenv("CEPH_TPU_STRAW2_TILE", "96")
+    assert _tile_from_env() == 96
+
+
 def test_gf2_inv_roundtrip():
     rng = np.random.default_rng(11)
     for n in (1, 5, 17):
