@@ -48,6 +48,8 @@ from .messages import (
     MOSDOpReply,
     MOSDPingMsg,
     MPGNotify,
+    MPGPull,
+    MPGPullReply,
     MPGQuery,
     MScrubShard,
     MScrubShardReply,
@@ -372,10 +374,13 @@ class OSD(Dispatcher):
         if isinstance(msg, MECSubOpRead):
             self._handle_sub_read(conn, msg)
             return True
+        if isinstance(msg, MPGPull):
+            self._handle_pg_pull(conn, msg)
+            return True
         if isinstance(
             msg,
             (MECSubOpWriteReply, MECSubOpReadReply, MPGNotify,
-             MScrubShardReply, MOSDOpReply),
+             MScrubShardReply, MOSDOpReply, MPGPullReply),
         ):
             # MOSDOpReply arrives when this OSD acts as its own client
             # (split migration forwarding ops to the post-split primary)
@@ -1938,20 +1943,25 @@ class OSD(Dispatcher):
                 if primary != self.id or self.id not in acting:
                     continue
                 pg = self._pg(pool_id, ps)
-                with pg.lock:
-                    try:
-                        self._recover_pg(pg, pool, acting)
-                    except Exception as e:
-                        self.cct.dout(
-                            "osd", 1,
-                            f"{self.whoami} recover {pg.pgid}: {e!r}",
-                        )
+                # NO pg.lock here: _recover_pg's pull phase waits on the
+                # donor's sub-writes, which our dispatch thread can only
+                # apply after taking pg.lock — holding it across the pull
+                # self-deadlocks.  _recover_pg locks its push phase.
+                try:
+                    self._recover_pg(pg, pool, acting)
+                except Exception as e:
+                    self.cct.dout(
+                        "osd", 1,
+                        f"{self.whoami} recover {pg.pgid}: {e!r}",
+                    )
 
     def _recover_pg(self, pg: PGState, pool, acting: list[int]) -> None:
-        if pg.version == 0:
-            return  # nothing written yet
         is_ec = pool.type == PG_POOL_ERASURE
         codec = self._codec_for_pool(pool) if is_ec else None
+        # one query round: peer versions + object lists drive the
+        # authoritative-log pull, the per-peer classification, and
+        # delete propagation
+        peers: dict[tuple[int, int], tuple[int, list]] = {}
         for shard, osd in enumerate(acting):
             if osd < 0 or osd == self.id or not self.osdmap.is_up(osd):
                 continue
@@ -1969,43 +1979,155 @@ class OSD(Dispatcher):
             rep = self._wait_reply(tid, timeout=5.0)
             if rep is None or rep.version is None:
                 continue
-            if rep.version >= pg.version:
-                continue  # clean
-            if pg.log.covers(rep.version):
-                self.cct.dout(
-                    "osd", 1,
-                    f"{self.whoami} delta-recovery {pg.pgid} shard {shard} "
-                    f"osd.{osd} from v{rep.version}",
-                )
-                ok = self._push_log_delta(
-                    pg, codec, acting, store_shard, osd, rep.version, is_ec
-                )
-                if ok:
-                    self._bump_peer_version(pg, store_shard, osd, pg.version)
-                    pg.stat_delta_recoveries = getattr(
-                        pg, "stat_delta_recoveries", 0) + 1
-            else:
-                # log too old: full backfill of this shard.  Versions are
-                # unknowable per object (trimmed), so chunks are pushed
-                # unversioned and the final sync entry seals the version.
-                my_shard = acting.index(self.id) if is_ec else 0
-                oids = [
+            peers[(shard, osd)] = (rep.version, rep.oids or [])
+        # phase 0 — adopt the authoritative log (reference: peering's
+        # choose_acting/authoritative-log step): a primary revived after
+        # missing writes must catch ITSELF up first, else it would mint
+        # duplicate versions on the next write and wrongly judge
+        # ahead-peers clean (wait_clean compares against the primary).
+        # Runs WITHOUT pg.lock: the donor's catch-up arrives as
+        # MECSubOpWrites our dispatch thread applies under that lock.
+        ahead = {k: v for k, (v, _o) in peers.items() if v > pg.version}
+        if ahead:
+            (_b_shard, b_osd), _bv = max(ahead.items(), key=lambda kv: kv[1])
+            my_shard = acting.index(self.id) if is_ec else 0
+            try:
+                my_oids = [
                     o for o in self.store.list_objects(
                         self._cid(pg.pgid, my_shard))
                     if not o.startswith("_")
                 ]
+            except (NotFound, KeyError):
+                my_oids = []
+            tid = self._next_tid()
+            try:
+                self._conn_to_osd(b_osd).send_message(MPGPull(
+                    tid=tid, pgid=pg.pgid, shard=my_shard,
+                    from_version=pg.version, epoch=self.my_epoch(),
+                    have_oids=my_oids,
+                ))
+                rep = self._wait_reply(tid, timeout=30.0)
+            except (OSError, ConnectionError):
+                rep = None
+            if rep is not None and rep.retval == 0:
                 self.cct.dout(
                     "osd", 1,
-                    f"{self.whoami} backfill {pg.pgid} shard {shard} "
-                    f"osd.{osd}: {len(oids)} objects",
+                    f"{self.whoami} pulled {pg.pgid} forward to "
+                    f"v{pg.version} from osd.{b_osd}",
                 )
-                ok = self._push_objects(
-                    pg, codec, acting, store_shard, osd,
-                    {o: None for o in oids}, set(), is_ec,
+            else:
+                return  # retry next tick; judging peers now would be wrong
+        if pg.version == 0:
+            return  # nothing written yet
+        # push phase: serialize vs concurrent client writes on this PG
+        with pg.lock:
+            for (shard, osd), (peer_ver, peer_oids) in peers.items():
+                if peer_ver >= pg.version:
+                    continue  # clean
+                self._push_missing(
+                    pg, codec, acting, shard if is_ec else 0, osd,
+                    peer_ver, is_ec, peer_oids,
                 )
-                if ok:
-                    self._bump_peer_version(pg, store_shard, osd, pg.version)
-                    pg.stat_backfills = getattr(pg, "stat_backfills", 0) + 1
+
+    def _push_missing(self, pg, codec, acting, dest_shard, dest_osd,
+                      from_version, is_ec, dest_oids) -> bool:
+        """Classify delta vs backfill, push, seal — shared by the primary
+        push loop and the pull donor.  Counters are started/completed
+        pairs: stat_delta_recoveries / stat_backfills count rounds
+        STARTED (race-free for observers — an ack lost after the peer
+        applied would leave a completed-only counter at zero), the
+        *_completed twins count fully acked rounds."""
+        my_shard = acting.index(self.id) if is_ec else 0
+        if pg.log.covers(from_version):
+            self.cct.dout(
+                "osd", 1,
+                f"{self.whoami} delta-recovery {pg.pgid} "
+                f"shard {dest_shard} osd.{dest_osd} from v{from_version}",
+            )
+            pg.stat_delta_recoveries = getattr(
+                pg, "stat_delta_recoveries", 0) + 1
+            ok = self._push_log_delta(
+                pg, codec, acting, dest_shard, dest_osd, from_version, is_ec
+            )
+            if ok:
+                self._bump_peer_version(pg, dest_shard, dest_osd, pg.version)
+                pg.stat_delta_completed = getattr(
+                    pg, "stat_delta_completed", 0) + 1
+            return ok
+        # log too old: full backfill of this shard.  Versions are
+        # unknowable per object (trimmed), so chunks are pushed
+        # unversioned and the final sync entry seals the version.  The
+        # target's extra objects (deleted here after its log horizon)
+        # get data-less deletes — a survivors-only push would resurrect
+        # deletions when the target is later trusted.
+        try:
+            oids = [
+                o for o in self.store.list_objects(
+                    self._cid(pg.pgid, my_shard))
+                if not o.startswith("_")
+            ]
+        except (NotFound, KeyError):
+            oids = []
+        deleted = set(dest_oids or []) - set(oids)
+        self.cct.dout(
+            "osd", 1,
+            f"{self.whoami} backfill {pg.pgid} shard {dest_shard} "
+            f"osd.{dest_osd}: {len(oids)} objects, "
+            f"{len(deleted)} deletions",
+        )
+        pg.stat_backfills = getattr(pg, "stat_backfills", 0) + 1
+        ok = self._push_objects(
+            pg, codec, acting, dest_shard, dest_osd,
+            {o: None for o in oids}, deleted, is_ec,
+        )
+        if ok:
+            self._bump_peer_version(pg, dest_shard, dest_osd, pg.version)
+            pg.stat_backfill_completed = getattr(
+                pg, "stat_backfill_completed", 0) + 1
+        return ok
+
+    def _handle_pg_pull(self, conn, msg: MPGPull) -> None:
+        """An ahead peer serving a stale primary's catch-up request: push
+        my log delta (or full objects + deletions when my log was
+        trimmed) to the requester, then seal its version (the
+        authoritative-log donor role in peering).  Runs under MY pg.lock
+        so a concurrent write cannot advance the version mid-push and
+        let the seal vouch for entries never sent; the requester holds
+        no lock while waiting, so there is no cross-OSD lock cycle."""
+        retval = -5
+        try:
+            pool_id, ps = msg.pgid.split(".")
+            pg = self._pg(int(pool_id), int(ps))
+            pool = self.osdmap.pools.get(int(pool_id))
+            requester = (
+                int(msg.src.split(".", 1)[1])
+                if msg.src.startswith("osd.") else None
+            )
+            if pool is None or requester is None:
+                raise ValueError(f"bad pull {msg.src} {msg.pgid}")
+            acting, _p = self._acting(int(pool_id), int(ps))
+            is_ec = pool.type == PG_POOL_ERASURE
+            codec = self._codec_for_pool(pool) if is_ec else None
+            from_v = int(msg.from_version or 0)
+            with pg.lock:
+                if pg.version <= from_v:
+                    retval = 0  # nothing newer here
+                else:
+                    ok = self._push_missing(
+                        pg, codec, acting, msg.shard, requester, from_v,
+                        is_ec, msg.have_oids,
+                    )
+                    retval = 0 if ok else -5
+        except Exception as e:
+            self.cct.dout(
+                "osd", 0, f"{self.whoami} pg pull failed: {e!r}"
+            )
+        try:
+            conn.send_message(MPGPullReply(
+                tid=msg.tid, pgid=msg.pgid, shard=msg.shard, retval=retval
+            ))
+        except (OSError, ConnectionError):
+            pass
 
     def _push_sub_write(self, pg, osd, shard, oid, data, version, entry,
                         src_cid: str | None = None) -> bool:

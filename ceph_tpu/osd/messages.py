@@ -115,6 +115,26 @@ class MPGNotify(_JsonMessage):
 
 
 @register_message
+class MPGPull(_JsonMessage):
+    """Stale primary → ahead peer: 'push me your log delta' (reference:
+    peering's authoritative-log adoption — the revived primary catches
+    ITSELF up before judging peers; without this it would mint duplicate
+    versions and judge ahead-peers clean).  `have_oids` is the
+    requester's local object list so the donor can push deletes for
+    objects that no longer exist (a survivors-only backfill would
+    resurrect deletions)."""
+
+    MSG_TYPE = 116
+    FIELDS = ("tid", "pgid", "shard", "from_version", "epoch", "have_oids")
+
+
+@register_message
+class MPGPullReply(_JsonMessage):
+    MSG_TYPE = 117
+    FIELDS = ("tid", "pgid", "shard", "retval")
+
+
+@register_message
 class MOSDPingMsg(_JsonMessage):
     """OSD↔OSD heartbeat (reference: MOSDPing PING/PING_REPLY)."""
 

@@ -197,6 +197,45 @@ def test_backfill_when_log_trimmed():
         PGLog.__init__.__defaults__ = old
 
 
+def test_backfill_propagates_deletions():
+    """An object deleted while a peer was away — with the delete trimmed
+    out of the log — must NOT survive on the revived peer: the backfill
+    pushes data-less deletes for the target's stale extras (resurrection
+    guard)."""
+    from ceph_tpu.osd.pg_log import PGLog
+
+    old = PGLog.__init__.__defaults__
+    PGLog.__init__.__defaults__ = (4,)
+    try:
+        with LocalCluster(n_mons=1, n_osds=4) as c:
+            c.create_replicated_pool("rp", size=3, pg_num=1)
+            io = c.client().open_ioctx("rp")
+            io.write_full("victim", b"gone soon")
+            killed = _primary_peer(c, "rp")
+            io.remove("victim")
+            _fill(io, "churn", 8)  # trim the delete out of the log
+            c.revive_osd(killed)
+            c.wait_clean("rp", timeout=60)
+            # the revived peer's store must NOT hold the deleted object
+            revived = c.osds[killed]
+            import time as _t
+
+            deadline = _t.time() + 30
+            while _t.time() < deadline:
+                held = [
+                    o for cid in revived.store.list_collections()
+                    for o in revived.store.list_objects(cid)
+                    if o == "victim"
+                ]
+                if not held:
+                    break
+                _t.sleep(0.5)
+            assert not held, "deleted object resurrected on revived peer"
+            assert "victim" not in io.list_objects()
+    finally:
+        PGLog.__init__.__defaults__ = old
+
+
 def _primary_peer(c, pool_name):
     """Kill target: a non-primary acting member of the pool's only PG (so
     the primary keeps serving and logging writes).  The kill is also
