@@ -52,8 +52,10 @@ def ln_scores_pallas(cm, x, items, r):
     """[B, S] hash+ln via the fused Pallas kernel (TPU: no vector gather —
     see ops/pallas_crush.py).  Pads B to the tile multiple and S to the
     128-lane multiple, slices back."""
-    from ..ops.pallas_crush import DEFAULT_TILE, straw2_scores_pallas
+    from ..ops import pallas_crush
+    from ..ops.pallas_crush import straw2_scores_pallas
 
+    DEFAULT_TILE = pallas_crush.DEFAULT_TILE  # call-time read
     B, S = items.shape
     Bp = -(-B // DEFAULT_TILE) * DEFAULT_TILE
     Sp = -(-S // 128) * 128
@@ -68,7 +70,8 @@ def ln_scores_pallas(cm, x, items, r):
         ii = jnp.pad(ii, ((0, 0), (0, Sp - S)))
     # interpret mode keeps this path testable on CPU hosts
     hi, lo = straw2_scores_pallas(
-        xi, ri, ii, interpret=jax.default_backend() == "cpu"
+        xi, ri, ii, tile=DEFAULT_TILE,  # call-time module attr (fallback)
+        interpret=jax.default_backend() == "cpu",
     )
     ln = (hi.astype(jnp.int64) << 24) | lo.astype(jnp.int64)
     return ln[:B, :S]

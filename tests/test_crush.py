@@ -486,3 +486,98 @@ class TestMultiChoose:
         ])
         w = np.full(8, 0x10000, dtype=np.uint32)
         self._check_vs_scalar(cmap, 9, 2, w, np.arange(40))
+
+
+class TestTileFallback:
+    def test_launch_failure_downshifts_tile_once(self, monkeypatch):
+        """A Mosaic-style launch failure must rebuild with the proven
+        32-row tile and still return bit-exact results (the unattended
+        bench's safety net).  Forces the Pallas scorer (interpret mode on
+        CPU) so the downshifted tile is actually CONSUMED by the rebuilt
+        function — a tile frozen at def time would fail this test with a
+        B-not-multiple-of-tile shape error."""
+        import numpy as np
+
+        from ceph_tpu.crush import (
+            CompiledCrushMap,
+            build_hierarchical_map,
+            crush_do_rule,
+            crush_do_rule_batch,
+        )
+        from ceph_tpu.crush import mapper as mapper_mod
+        from ceph_tpu.ops import pallas_crush
+
+        monkeypatch.setenv("CEPH_TPU_CRUSH_SCORE", "pallas")
+        cmap = build_hierarchical_map(4, 2)
+        weights = np.full(8, 0x10000, dtype=np.uint32)
+        cm = CompiledCrushMap(cmap)
+        real_launch = mapper_mod._launch_rule_fn
+        calls = {"n": 0}
+
+        def flaky(cm_, cached, xs, numrep, weightvec):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("Mosaic failed to compile TPU kernel")
+            return real_launch(cm_, cached, xs, numrep, weightvec)
+
+        monkeypatch.setattr(mapper_mod, "_launch_rule_fn", flaky)
+        monkeypatch.setattr(pallas_crush, "DEFAULT_TILE", 256)
+        out = np.asarray(crush_do_rule_batch(cm, 0, np.arange(64), 3, weights))
+        assert calls["n"] == 2  # failed once, retried downshifted
+        assert pallas_crush.DEFAULT_TILE == pallas_crush.CHUNK
+        for x in range(64):
+            exp = crush_do_rule(cmap, 0, x, 3, list(weights))
+            exp = (exp + [-0x7FFFFFFF - 1] * 3)[:3] if len(exp) < 3 else exp
+            assert list(out[x])[: len(exp)] == exp[:3] or list(out[x]) == exp
+
+    def test_shape_errors_never_downshift(self, monkeypatch):
+        """Our own TileShapeError must not trigger the retry (it is a
+        caller bug, not a hardware compile failure)."""
+        from ceph_tpu.crush import mapper as mapper_mod
+        from ceph_tpu.ops import pallas_crush
+        from ceph_tpu.ops.pallas_crush import TileShapeError
+        import numpy as np
+
+        from ceph_tpu.crush import CompiledCrushMap, build_hierarchical_map
+
+        cm = CompiledCrushMap(build_hierarchical_map(4, 2))
+        monkeypatch.setattr(pallas_crush, "DEFAULT_TILE", 256)
+
+        def bad(cm_, cached, xs, numrep, weightvec):
+            raise TileShapeError("B=7 not a multiple of tile=256")
+
+        monkeypatch.setattr(mapper_mod, "_launch_rule_fn", bad)
+        import pytest as _pytest
+
+        with _pytest.raises(TileShapeError):
+            mapper_mod.crush_do_rule_batch(
+                cm, 0, np.arange(8), 3,
+                np.full(8, 0x10000, dtype=np.uint32),
+            )
+        assert pallas_crush.DEFAULT_TILE == 256  # untouched
+
+    def test_unrelated_double_failure_restores_tile(self, monkeypatch):
+        """When the downshifted retry ALSO fails, the tile must be
+        restored (the failure wasn't tile-related) so the process doesn't
+        run 8x the grid steps forever."""
+        from ceph_tpu.crush import mapper as mapper_mod
+        from ceph_tpu.ops import pallas_crush
+        import numpy as np
+
+        from ceph_tpu.crush import CompiledCrushMap, build_hierarchical_map
+
+        cm = CompiledCrushMap(build_hierarchical_map(4, 2))
+        monkeypatch.setattr(pallas_crush, "DEFAULT_TILE", 256)
+
+        def always_bad(cm_, cached, xs, numrep, weightvec):
+            raise RuntimeError("tunnel dropped")
+
+        monkeypatch.setattr(mapper_mod, "_launch_rule_fn", always_bad)
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="tunnel"):
+            mapper_mod.crush_do_rule_batch(
+                cm, 0, np.arange(8), 3,
+                np.full(8, 0x10000, dtype=np.uint32),
+            )
+        assert pallas_crush.DEFAULT_TILE == 256  # restored

@@ -228,13 +228,17 @@ class TestQuorum:
             {"prefix": "osd pool create", "name": "repl", "pg_num": 8}
         )
         assert rv == 0
-        # every mon's store converges to the same committed map
+        # every mon's store converges to the same committed map.  30s:
+        # an out-of-quorum peon syncs via its own probe cycle, which under
+        # full-suite load can outlast the default window (convergence is
+        # guaranteed by the quorum fix; slow is not stuck)
         assert wait_for(
             lambda: all(
                 m.osdmon.osdmap is not None
                 and any(p.name == "repl" for p in m.osdmon.osdmap.pools.values())
                 for m in mons
-            )
+            ),
+            timeout=30,
         ), [m.osdmon.epoch for m in mons]
 
     def test_leader_failover(self, cluster3):
