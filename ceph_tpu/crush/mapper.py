@@ -341,13 +341,16 @@ def crush_do_rule_batch(
     TAKE/CHOOSE/EMIT chains are interpreted (multi-choose rules flatten the
     working vector into the lane axis)."""
     key = (rule_id, numrep, choose_args)
-    cached = cm._rule_fn_cache.get(key)
-    if cached is None:
+
+    def build_and_cache():
         with enable_x64():
-            cached = _build_rule_fn(
+            built = _build_rule_fn(
                 cm, rule_id, numrep, choose_args, default_score_fn()
             )
-        cm._rule_fn_cache[key] = cached
+        cm._rule_fn_cache[key] = built
+        return built
+
+    cached = cm._rule_fn_cache.get(key) or build_and_cache()
     try:
         return _launch_rule_fn(cm, cached, xs, numrep, weightvec)
     except Exception as e:
@@ -364,6 +367,10 @@ def crush_do_rule_batch(
         if (
             isinstance(e, TileShapeError)
             or pallas_crush.DEFAULT_TILE == pallas_crush.CHUNK
+            # the tile can only be implicated when the Pallas scorer is
+            # the active path; on gather/CPU hosts the error is someone
+            # else's and a rebuild would just repeat it slower
+            or default_score_fn() is not ln_scores_pallas
         ):
             raise
         import sys
@@ -376,12 +383,9 @@ def crush_do_rule_batch(
         )
         pallas_crush.DEFAULT_TILE = pallas_crush.CHUNK
         try:
-            with enable_x64():
-                cached = _build_rule_fn(
-                    cm, rule_id, numrep, choose_args, default_score_fn()
-                )
-            cm._rule_fn_cache[key] = cached
-            return _launch_rule_fn(cm, cached, xs, numrep, weightvec)
+            return _launch_rule_fn(
+                cm, build_and_cache(), xs, numrep, weightvec
+            )
         except Exception:
             # not a tile problem after all: undo the downshift so the
             # process doesn't run 8x the grid steps forever

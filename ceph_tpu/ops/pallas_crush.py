@@ -73,20 +73,22 @@ def _tile_from_env() -> int:
     return tile
 
 
-# rows per grid step ([T, S] tile; S padded to 128).  Read this at CALL
-# time (module attribute), never at def time: the mapper's downshift
-# fallback mutates it after a hardware compile failure.
+# rows per grid step ([T, S] tile; S padded to 128).  Callers read this
+# module attribute at CALL time and pass it as the explicit static
+# `tile` argument — the mapper's downshift fallback mutates it after a
+# hardware compile failure, and jit's static-arg cache keys on the
+# passed value, so the mutation takes effect on the next call.
+# The kernel walks the tile in CHUNK-row slabs with an inner fori_loop:
+# the one-hot [CHUNK, S, 256] bf16 intermediates are what blow the
+# 16 MiB scoped-vmem limit (CHUNK=64 hit ~28 MiB on v5e), so CHUNK
+# stays small while the tile — and therefore the number of grid steps,
+# each of which pays fixed Mosaic setup cost — shrinks by tile/CHUNK.
 DEFAULT_TILE = _tile_from_env()
 
 
 class TileShapeError(ValueError):
     """Caller-side shape/validation error (distinct from hardware compile
     failures so the mapper's tile-downshift retry can tell them apart)."""
-# The kernel walks the tile in CHUNK-row slabs with an inner fori_loop:
-# the one-hot [CHUNK, S, 256] bf16 intermediates are what blow the
-# 16 MiB scoped-vmem limit (CHUNK=64 hit ~28 MiB on v5e), so CHUNK
-# stays small while the tile — and therefore the number of grid steps,
-# each of which pays fixed Mosaic setup cost — shrinks by tile/CHUNK.
 
 
 def _disable_x64():
@@ -161,15 +163,13 @@ def _score_kernel(x_ref, r_ref, items_ref, t1_ref, t2_ref, hi_ref, lo_ref):
 
 
 @partial(jax.jit, static_argnames=("tile", "interpret"))
-def straw2_scores_pallas(x, r, items, tile: int | None = None,
+def straw2_scores_pallas(x, r, items, tile: int,
                          interpret: bool = False):
     """(x [B], r [B], items [B, S]) -> (ln_hi [B, S], ln_lo [B, S]) int32.
 
     B must be a multiple of `tile` and S a multiple of 128 (the mapper
     pads); planes combine as crush_ln = hi * 2^24 + lo.
     """
-    if tile is None:
-        tile = DEFAULT_TILE  # call-time read: the downshift fallback works
     B, S = items.shape
     if B % tile:
         raise TileShapeError(f"B={B} not a multiple of tile={tile}")

@@ -92,6 +92,42 @@ def test_ec_pg_split_migrates_objects():
         assert io.get_xattr("e3", "tag") == b"t"
 
 
+def test_snapshot_clones_survive_pg_split():
+    """Clones live in their head's PG and must migrate with it: after a
+    pg_num grow, snap reads of pre-split snapshots still serve the
+    pre-snap bytes (clone names hash differently than heads — the
+    migrator must place them by HEAD)."""
+    with LocalCluster(n_mons=1, n_osds=4) as c:
+        c.create_replicated_pool("sp", size=2, pg_num=2)
+        client = c.client()
+        io = client.open_ioctx("sp")
+        objects = {f"s{i}": f"old-{i}".encode() * 40 for i in range(10)}
+        for oid, data in objects.items():
+            io.write_full(oid, data)
+        sid = io.snap_create("before-split")
+        for oid in objects:
+            io.write_full(oid, b"new-" + oid.encode())
+        rv, res = c.mon_command({
+            "prefix": "osd pool set", "name": "sp", "key": "pg_num",
+            "value": 8,
+        })
+        assert rv == 0, res
+        new_heads = {oid: b"new-" + oid.encode() for oid in objects}
+        _wait_all_readable(io, new_heads)
+        # snapshot view intact through the migration
+        deadline = time.time() + 30
+        while True:
+            try:
+                for oid, data in objects.items():
+                    assert io.read(oid, snapid=sid) == data, oid
+                break
+            except (IOError, AssertionError):
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.5)
+        io.snap_remove("before-split")
+
+
 def test_pg_autoscaler_scales_up_and_data_survives():
     with LocalCluster(
         n_mons=1, n_osds=4, with_mgr=True,
