@@ -31,7 +31,7 @@ from ..ec.registry import ErasureCodePluginRegistry
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(prog="ceph_tpu.bench.ec_bench")
-    p.add_argument("workload", choices=["encode", "decode"])
+    p.add_argument("workload", choices=["encode", "decode", "rmw"])
     p.add_argument("--plugin", "-P", default="jax")
     p.add_argument(
         "--parameter",
@@ -55,6 +55,10 @@ def parse_args(argv=None):
         "--stream", type=int, default=0, metavar="N",
         help="encode N fresh host batches double-buffered (DMA/compute "
         "overlap) instead of chained device-resident iterations",
+    )
+    p.add_argument(
+        "--rmw-width", type=int, default=4096, metavar="BYTES",
+        help="rmw workload: bytes of the sub-stripe update window",
     )
     return p.parse_args(argv)
 
@@ -111,6 +115,37 @@ def run_encode(codec, args) -> dict:
     return {"seconds": seconds, "bytes": total}
 
 
+def run_rmw(codec, args) -> dict:
+    """Partial-stripe RMW parity-delta workload: each iteration is the
+    device-side cost of one OSD ranged write — the parity delta for a
+    --rmw-width byte sub-stripe update, i.e. one GF matrix apply over
+    just the touched column window (reference: the re-encode inside
+    src/osd/ECTransaction.cc :: generate_transactions, expressed as the
+    optimized-EC parity-delta; mirrors OSD._ec_rmw).  Reported bytes are
+    the UPDATED user bytes, so GiB/s is directly comparable to what a
+    full-stripe re-encode of the same update would cost."""
+    from .timing import time_chained_encode
+
+    rng = np.random.default_rng(args.seed)
+    w = args.rmw_width
+    W = codec.get_chunk_size(codec.k * w)
+    delta = rng.integers(0, 256, (codec.k, W), dtype=np.uint8)
+    if (
+        getattr(codec, "backend", None) == "jax"
+        and getattr(codec, "coding", None) is not None
+        and not args.no_chain
+    ):
+        seconds = time_chained_encode(codec.coding, delta, args.iterations)
+    else:
+        codec.encode_chunks(delta)  # warm
+        t0 = time.perf_counter()
+        for _ in range(args.iterations):
+            codec.encode_chunks(delta)
+        seconds = time.perf_counter() - t0
+    return {"seconds": seconds, "bytes": w * args.iterations,
+            "ops": args.iterations}
+
+
 def run_decode(codec, args) -> dict:
     import itertools
 
@@ -144,9 +179,9 @@ def main(argv=None):
     args = parse_args(argv)
     codec, profile = build_codec(args)
     with _device_trace():  # armed by CEPH_TPU_PROFILE=<logdir>
-        res = (
-            run_encode if args.workload == "encode" else run_decode
-        )(codec, args)
+        runner = {"encode": run_encode, "decode": run_decode,
+                  "rmw": run_rmw}[args.workload]
+        res = runner(codec, args)
     gibps = res["bytes"] / max(res["seconds"], 1e-12) / 2**30
     if args.json:
         print(

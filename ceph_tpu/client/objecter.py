@@ -29,6 +29,11 @@ class Objecter(Dispatcher):
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._tid = 0
+        # instance nonce: makes reqids unique across Objecter restarts
+        # that reset the tid counter (reference: osd_reqid_t's name+inc)
+        import uuid
+
+        self._nonce = uuid.uuid4().hex[:12]
         self._replies: dict[int, MOSDOpReply] = {}
         self._outstanding: set[int] = set()
         self.mc.subscribe_osdmap()
@@ -90,13 +95,22 @@ class Objecter(Dispatcher):
         import time as _time
 
         last = None
+        # ONE logical-op id across every resend attempt: a reply lost in
+        # flight after the primary applied must not re-execute the op
+        # (append would double-append; an RMW would double-apply) — the
+        # primary's dup cache answers the resend instead
+        with self._lock:
+            self._tid += 1
+            logical_tid = self._tid
+        reqid = f"{self._nonce}:{logical_tid}"
         for _ in range(attempts):
             m = self.mc.osdmap
             # snap context rides every mutation (reference: MOSDOp's
             # SnapContext) so a primary whose map lags a fresh mksnap
             # still clones before overwriting
             snap_seq = 0
-            if m is not None and op in ("write_full", "delete"):
+            if m is not None and op in ("write_full", "write", "append",
+                                        "delete"):
                 p = m.pools.get(pool_id)
                 # newest LIVE snap, not snap_seq: after the last rmsnap
                 # there is nothing left to preserve, and a stale high seq
@@ -126,7 +140,7 @@ class Objecter(Dispatcher):
                         tid=tid, pool=pool_id, oid=oid, op=op,
                         data=wire_data,
                         epoch=m.epoch if m else 0, off=off, length=length,
-                        snapid=snapid, snap_seq=snap_seq,
+                        snapid=snapid, snap_seq=snap_seq, reqid=reqid,
                     )
                 )
             except (OSError, ConnectionError) as e:

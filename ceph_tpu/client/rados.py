@@ -32,6 +32,27 @@ class IoCtx:
             raise IOError(f"write_full {oid!r}: {rep.retval} {rep.result}")
         return rep.retval
 
+    def write(self, oid: str, data: bytes, off: int = 0) -> int:
+        """Ranged write (reference: rados_write): splices `data` into the
+        object at `off`, growing it if needed; a gap below `off` on a new
+        object reads back as zeros.  On EC pools this is the
+        partial-stripe RMW path (parity-delta update)."""
+        rep = self._client.objecter.op_submit(
+            self.pool_id, oid, "write", data=bytes(data), off=off
+        )
+        if rep.retval != 0:
+            raise IOError(f"write {oid!r}@{off}: {rep.retval} {rep.result}")
+        return rep.retval
+
+    def append(self, oid: str, data: bytes) -> int:
+        """reference: rados_append — write at the current object size."""
+        rep = self._client.objecter.op_submit(
+            self.pool_id, oid, "append", data=bytes(data)
+        )
+        if rep.retval != 0:
+            raise IOError(f"append {oid!r}: {rep.retval} {rep.result}")
+        return rep.retval
+
     def read(self, oid: str, off: int = 0, length: int = 0,
              snapid: int | None = None) -> bytes:
         """`snapid` reads the pool-snapshot view (reference: IoCtx

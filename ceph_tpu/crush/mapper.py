@@ -32,6 +32,8 @@ input — enforced by tests/test_crush.py over random maps and large x sweeps.
 """
 from __future__ import annotations
 
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -44,6 +46,9 @@ from .batched import (
 )
 from .ln_table import CRUSH_LN_TABLE
 from .types import ITEM_NONE, CrushMap, RuleOp
+
+# serializes the one-shot straw2 tile downshift in crush_do_rule_batch
+_TILE_LOCK = threading.Lock()
 
 # straw2 is 64-bit fixed-point integer math (SURVEY.md §7 hard parts).  x64
 # is enabled ONLY around the CRUSH traces (enable_x64 context below) — a
@@ -375,23 +380,33 @@ def crush_do_rule_batch(
             raise
         import sys
 
-        orig_tile = pallas_crush.DEFAULT_TILE
-        print(
-            f"# crush straw2 tile {orig_tile} failed "
-            f"({type(e).__name__}); retrying with tile "
-            f"{pallas_crush.CHUNK}", file=sys.stderr,
-        )
-        pallas_crush.DEFAULT_TILE = pallas_crush.CHUNK
-        try:
-            return _launch_rule_fn(
-                cm, build_and_cache(), xs, numrep, weightvec
+        # the downshift mutates module-global DEFAULT_TILE; serialize so
+        # concurrent callers can't observe a half-applied downshift or
+        # cache rule fns built against a tile mid-restore
+        with _TILE_LOCK:
+            if pallas_crush.DEFAULT_TILE == pallas_crush.CHUNK:
+                # another thread downshifted while we waited — rebuild
+                # against the settled tile and retry once
+                return _launch_rule_fn(
+                    cm, build_and_cache(), xs, numrep, weightvec
+                )
+            orig_tile = pallas_crush.DEFAULT_TILE
+            print(
+                f"# crush straw2 tile {orig_tile} failed "
+                f"({type(e).__name__}); retrying with tile "
+                f"{pallas_crush.CHUNK}", file=sys.stderr,
             )
-        except Exception:
-            # not a tile problem after all: undo the downshift so the
-            # process doesn't run 8x the grid steps forever
-            pallas_crush.DEFAULT_TILE = orig_tile
-            cm._rule_fn_cache.pop(key, None)
-            raise
+            pallas_crush.DEFAULT_TILE = pallas_crush.CHUNK
+            try:
+                return _launch_rule_fn(
+                    cm, build_and_cache(), xs, numrep, weightvec
+                )
+            except Exception:
+                # not a tile problem after all: undo the downshift so the
+                # process doesn't run 8x the grid steps forever
+                pallas_crush.DEFAULT_TILE = orig_tile
+                cm._rule_fn_cache.pop(key, None)
+                raise
 
 
 def _launch_rule_fn(cm, cached, xs, numrep, weightvec) -> jnp.ndarray:

@@ -51,8 +51,13 @@ def _alive(pid: int) -> bool:
     try:
         os.kill(pid, 0)
         return True
-    except (ProcessLookupError, PermissionError):
+    except ProcessLookupError:
         return False
+    except PermissionError:
+        # EPERM: the pid exists but belongs to another user — very much
+        # alive; treating it as dead would let rm-cluster rmtree the data
+        # dir out from under a running process
+        return True
 
 
 def cmd_bootstrap(args, out) -> int:

@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from collections import OrderedDict
 
 from ..client.rados import Rados
 from ..msg import Dispatcher, Messenger
@@ -59,11 +60,12 @@ class MDSDaemon(Dispatcher):
         self._seg_idx = 0   # next event slot within the segment
         self._first_seg = 0
         self._sessions: set[str] = set()
-        # bounded (session, tid) -> (rv, result) reply cache: resent
-        # requests after a connection reset are answered, not re-executed
-        # (reference: Session::have_completed_request)
-        self._reply_cache: dict[tuple[str, int], tuple[int, object]] = {}
-        self._reply_order: list[tuple[str, int]] = []
+        # per-session bounded tid -> (rv, result) reply cache: resent
+        # requests after a connection reset are answered, not re-executed.
+        # Bounded PER SESSION (reference: Session::have_completed_request
+        # is per-Session) so one busy client can't evict another session's
+        # in-flight retry window
+        self._reply_cache: OrderedDict[str, OrderedDict] = OrderedDict()
         self._rados: Rados | None = None
         self._io = None
 
@@ -370,15 +372,34 @@ class MDSDaemon(Dispatcher):
                     )
                 elif msg.op == "request_close":
                     self._sessions.discard(msg.client)
+                    # a closed session retires its completed-request set
+                    # (reference: Session teardown) — without this the
+                    # per-session caches grow with every client ever seen
+                    self._reply_cache.pop(msg.client, None)
                     conn.send_message(
                         MClientSession(op="close", client=msg.client)
                     )
             return True
         if isinstance(msg, MClientRequest):
-            key = (msg.session or msg.src, msg.tid)
+            sess = msg.session or msg.src
             with self._lock:
-                if key in self._reply_cache:
-                    rv, result = self._reply_cache[key]
+                cache = self._reply_cache.setdefault(sess, OrderedDict())
+                # LRU over SESSIONS too: clients that vanish without a
+                # request_close (crash, connection loss) must not leak
+                # their cache forever.  Only sessions no longer OPEN are
+                # evicted — dropping a live session's cache would
+                # re-expose it to the replay re-execution this exists
+                # to prevent; all-open caches may exceed the soft cap.
+                self._reply_cache.move_to_end(sess)
+                while len(self._reply_cache) > 64:
+                    victim = next(
+                        (s for s in self._reply_cache
+                         if s not in self._sessions), None)
+                    if victim is None:
+                        break
+                    self._reply_cache.pop(victim)
+                if msg.tid in cache:
+                    rv, result = cache[msg.tid]
                 else:
                     try:
                         rv, result = self._handle(msg.op, msg.args or {})
@@ -387,10 +408,9 @@ class MDSDaemon(Dispatcher):
                             "mds", 0, f"mds op {msg.op} failed: {e!r}"
                         )
                         rv, result = -5, repr(e)  # EIO
-                    self._reply_cache[key] = (rv, result)
-                    self._reply_order.append(key)
-                    while len(self._reply_order) > 512:
-                        self._reply_cache.pop(self._reply_order.pop(0), None)
+                    cache[msg.tid] = (rv, result)
+                    while len(cache) > 512:
+                        cache.popitem(last=False)
             conn.send_message(
                 MClientReply(tid=msg.tid, retval=rv, result=result)
             )

@@ -9,8 +9,17 @@ granularity:
 - write: primary encodes the object through the pool's EC profile codec
   (ErasureCodePluginRegistry — the TPU path), ships one chunk per shard as
   MECSubOpWrite (each carrying the pg_log entry), commits its own shard,
-  acks the client once every reachable acting shard commits
-  (ECBackend::submit_transaction shape).
+  acks the client at >= min_size shard commits after an UPFRONT min_size
+  reachability gate (ECBackend::submit_transaction shape + PrimaryLogPG's
+  min_size refusal).
+- ranged write / append: partial-stripe RMW as a parity-delta update —
+  touched data shards get spliced segments, parity shards GF-XOR one
+  matrix-apply's worth of delta over just the touched column window
+  (reference: ECTransaction::generate_transactions, in the optimized-EC
+  delta formulation).  Safety comes from per-object version stamps
+  (object_info_t analog): stale-generation shards refuse the delta and
+  are rebuilt by recovery; resends are answered by the per-PG reqid dup
+  cache (pg_log dup entries analog).
 - read: primary gathers k chunks (local + MECSubOpRead), reconstructs
   through minimum_to_decode/decode when shards are gone
   (objects_read_and_reconstruct), reassembles bytes.
@@ -19,15 +28,17 @@ granularity:
   (PGLog.missing_since), or full-backfill a shard whose log is too old
   (recover_object / backfill split, §5.4).
 
-Scope notes vs the reference: full-object writes (no partial-stripe RMW),
-scalar versions rather than eversion_t, and peering without the
-boost::statechart machine — the invariants these protect (log/data
-atomicity, ack-after-all-commit, delta-vs-backfill choice) are kept.
+Scope notes vs the reference: scalar versions rather than eversion_t, and
+peering without the boost::statechart machine — the invariants these
+protect (log/data atomicity, min_size-gated acks, delta-vs-backfill
+choice, no mixed-generation decodes, missing_loc-style stray-source
+recovery) are kept.
 """
 from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 
 from ..common.crc32c import crc32c
 from ..common.lockdep import make_lock
@@ -75,6 +86,15 @@ class PGState:
         # live-snap-id tuple this PG was last trimmed against (None =
         # never trimmed; distinct from () = trimmed against empty set)
         self.snap_trimmed: tuple | None = None
+        # reqid -> (retval, result) of COMPLETED mutations: a client
+        # resend whose reply was lost is answered from here instead of
+        # re-executed (reference: pg_log dup entries / osd_reqid_t);
+        # success-only so retryable -EAGAIN refusals still re-execute
+        self.reqid_cache: "OrderedDict[str, tuple]" = OrderedDict()
+        # reqid -> Event of a mutation mid-execution: a resend racing the
+        # original waits here instead of double-executing (reference:
+        # PrimaryLogPG::check_in_progress_op)
+        self.inflight: dict[str, threading.Event] = {}
         self.lock = make_lock("osd::pg")
 
     def meta_oid(self) -> str:
@@ -84,6 +104,12 @@ class PGState:
 # clone-object name separator (reference: clones are (oid, snapid) hobjects;
 # here the snapid rides in the name, invisible to client listings)
 CLONE_SEP = "\x02"
+
+# client ops covered by reqid dup detection (mutations whose re-execution
+# on a resend would be wrong or wasteful)
+MUTATING_OPS = frozenset(
+    {"write_full", "write", "append", "delete", "setxattr"}
+)
 
 
 class OSD(Dispatcher):
@@ -492,6 +518,105 @@ class OSD(Dispatcher):
                 result={"primary": primary},
             )
         pg = self._pg(msg.pool, ps)
+        # dup detection + in-flight serialization (reference: pg_log dup
+        # entries + PrimaryLogPG::check_in_progress_op): a resend of a
+        # completed mutation is answered without re-executing — from the
+        # reply cache, or (surviving primary changes) from the reqid the
+        # REPLICATED log entry carries; a resend racing the still-running
+        # original waits for it instead of double-executing
+        reqid = getattr(msg, "reqid", None)
+        if reqid is not None and msg.op in MUTATING_OPS:
+            rep = self._check_dup(pg, pool, acting, msg, reqid)
+            if rep is not None:
+                return rep
+            while True:
+                guard = threading.Event()
+                prior = pg.inflight.setdefault(reqid, guard)
+                if prior is guard:
+                    break  # we own the slot
+                if not prior.wait(60.0):
+                    # original STILL running (e.g. a long degraded
+                    # splice): executing now would double-apply — refuse
+                    # retryably and let the next resend re-check
+                    return MOSDOpReply(
+                        tid=msg.tid, retval=-11, epoch=self.my_epoch(),
+                        result="op with same reqid still in flight",
+                    )
+                rep = self._check_dup(pg, pool, acting, msg, reqid)
+                if rep is not None:
+                    return rep
+                # the original died before logging anything — loop back
+                # to CONTEND for the slot (setdefault): two waiters must
+                # not both install themselves and double-execute
+            try:
+                return self._execute_routed_op(pg, pool, acting, ps, msg)
+            finally:
+                pg.inflight.pop(reqid, None)
+                guard.set()
+        return self._execute_routed_op(pg, pool, acting, ps, msg)
+
+    def _check_dup(self, pg, pool, acting, msg, reqid) -> MOSDOpReply | None:
+        """Reply for an already-seen reqid, or None to execute."""
+        hit = pg.reqid_cache.get(reqid)
+        if hit is None:
+            v = pg.log.find_reqid(reqid)
+            if v is not None:
+                hit = ("applied", v)
+        if hit is None:
+            return None
+        if hit[0] == "done":
+            return MOSDOpReply(tid=msg.tid, retval=hit[1],
+                               epoch=self.my_epoch(), result=hit[2])
+        # ("applied", v): the op mutated state exactly once but was
+        # under-acked (< min_size commits) at the time.  Never re-execute.
+        # Success is reported only when the write has ACTUALLY reached
+        # min_size shards — counted from the per-object version stamps,
+        # not mere reachability (reachable-but-unrecovered shards don't
+        # hold the data yet).  Deletes are idempotent at the log level:
+        # applied = done.
+        if msg.op == "delete":
+            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                               result={"version": pg.version, "dup": True})
+        holding = 0
+        is_ec = pool.type == PG_POOL_ERASURE
+        for shard, osd in enumerate(acting):
+            if osd < 0:
+                continue
+            # replicated pools keep every replica in the shard-0
+            # collection; only EC pools have per-shard collections
+            store_shard = shard if is_ec else 0
+            if osd == self.id:
+                v = self._stored_ver(self._cid(pg.pgid, store_shard),
+                                     msg.oid)
+                if v is not None and v >= hit[1]:
+                    holding += 1
+                continue
+            if not self.osdmap.is_up(osd):
+                continue
+            tid = self._next_tid()
+            try:
+                self._conn_to_osd(osd).send_message(MECSubOpRead(
+                    tid=tid, pgid=pg.pgid, oid=msg.oid, shard=store_shard,
+                    offsets=[], epoch=self.my_epoch(),
+                ))
+            except (OSError, ConnectionError):
+                continue
+            rep = self._wait_reply(tid, timeout=5.0)
+            if rep is None or rep.retval != 0:
+                continue
+            v = getattr(rep, "ver", None)
+            if v is not None and v >= hit[1]:
+                holding += 1
+        if holding >= pool.min_size:
+            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                               result={"version": pg.version, "dup": True})
+        return MOSDOpReply(
+            tid=msg.tid, retval=-11, epoch=self.my_epoch(),
+            result=f"applied at v{hit[1]}; {holding} shards hold it "
+                   f"< min_size {pool.min_size}",
+        )
+
+    def _execute_routed_op(self, pg, pool, acting, ps, msg) -> MOSDOpReply:
         # pool snapshots (reference: make_writeable's clone-on-write +
         # SnapSet resolution in PrimaryLogPG)
         # clone against the newest LIVE snap (snap_seq never resets, and
@@ -501,7 +626,7 @@ class OSD(Dispatcher):
         live_max = max(pool.snaps, default=0)
         snap_seq = max(live_max, int(getattr(msg, "snap_seq", 0) or 0))
         if (
-            msg.op in ("write_full", "delete")
+            msg.op in ("write_full", "write", "append", "delete")
             and snap_seq
             and msg.oid
             and CLONE_SEP not in msg.oid
@@ -518,15 +643,24 @@ class OSD(Dispatcher):
                     tid=msg.tid, retval=-5, epoch=self.my_epoch(),
                     result=f"snap clone failed: {e}",
                 )
-            if msg.op == "write_full" and not head_existed:
+            if msg.op in ("write_full", "write", "append") and not head_existed:
                 rep = (
                     self._ec_op(pg, pool, acting, msg)
                     if pool.type == PG_POOL_ERASURE
                     else self._replicated_op(pg, pool, acting, msg)
                 )
                 if rep.retval == 0:
-                    self._mark_born(pg, pool, msg.oid, snap_seq)
-                return rep
+                    try:
+                        self._mark_born(pg, pool, msg.oid, snap_seq)
+                    except Exception as e:
+                        # same contract as _set_born: a lost born marker
+                        # would surface this object in snap views older
+                        # than its creation, so fail the write instead
+                        return MOSDOpReply(
+                            tid=msg.tid, retval=-5, epoch=self.my_epoch(),
+                            result=f"snapborn mark failed: {e}",
+                        )
+                return self._record_reqid(pg, msg, rep)
         if (
             msg.op == "read"
             and getattr(msg, "snapid", None)
@@ -548,8 +682,35 @@ class OSD(Dispatcher):
                     ps=ps,
                 )
         if pool.type == PG_POOL_ERASURE:
-            return self._ec_op(pg, pool, acting, msg)
-        return self._replicated_op(pg, pool, acting, msg)
+            rep = self._ec_op(pg, pool, acting, msg)
+        else:
+            rep = self._replicated_op(pg, pool, acting, msg)
+        return self._record_reqid(pg, msg, rep)
+
+    def _record_reqid(self, pg, msg, rep: MOSDOpReply) -> MOSDOpReply:
+        """Remember a completed mutation's outcome for dup detection.
+        Successes cache the full reply; an UNDER-ACKED mutation (applied
+        and logged, but < min_size commits, reported -11) caches the
+        applied-at version so the resend re-evaluates availability
+        instead of re-executing — re-running an append/RMW would
+        double-apply.  Plain refusals (gate -11, -ESTALE) that mutated
+        nothing cache nothing and re-execute freely."""
+        reqid = getattr(msg, "reqid", None)
+        if reqid is None or msg.op not in MUTATING_OPS:
+            return rep
+        if rep.retval == 0:
+            pg.reqid_cache[reqid] = ("done", rep.retval, rep.result)
+        elif (
+            rep.retval == -11
+            and isinstance(rep.result, dict)
+            and "applied" in rep.result
+        ):
+            pg.reqid_cache[reqid] = ("applied", rep.result["applied"])
+        else:
+            return rep
+        while len(pg.reqid_cache) > 1024:
+            pg.reqid_cache.popitem(last=False)
+        return rep
 
     # -- pool snapshots ----------------------------------------------------
     def _clone_oid(self, oid: str, snapid: int) -> str:
@@ -635,17 +796,19 @@ class OSD(Dispatcher):
         born in, so snapshot reads older than its creation return ENOENT
         instead of the head (reference: SnapSet knows object existence
         per snap).  Rides the replicated user-xattr path under a
-        reserved '_'-name the client surface filters out."""
-        r = self._execute_client_op(MOSDOp(
-            tid=self._next_tid(), pool=pool.pool_id, oid=oid,
-            op="setxattr", epoch=self.my_epoch(), ps=pg.ps,
-            data={"_snapborn": pack_data(str(snap_seq).encode())},
-        ))
-        if r.retval != 0:
-            self.cct.dout(
-                "osd", 1,
-                f"{self.whoami} snapborn mark {oid} failed: {r.result}",
-            )
+        reserved '_'-name the client surface filters out.  Raises on
+        persistent failure (after one retry) — the caller fails the
+        client write, matching _set_born's contract."""
+        r = None
+        for _ in range(2):
+            r = self._execute_client_op(MOSDOp(
+                tid=self._next_tid(), pool=pool.pool_id, oid=oid,
+                op="setxattr", epoch=self.my_epoch(), ps=pg.ps,
+                data={"_snapborn": pack_data(str(snap_seq).encode())},
+            ))
+            if r.retval == 0:
+                return
+        raise RuntimeError(f"snapborn marker write: {r.result}")
 
     def _primary_cid(self, pg, pool, acting) -> str:
         shard = acting.index(self.id) if pool.type == PG_POOL_ERASURE else 0
@@ -750,10 +913,33 @@ class OSD(Dispatcher):
     def _ec_op(self, pg: PGState, pool, acting: list[int], msg: MOSDOp):
         codec = self._codec_for_pool(pool)
         my_shard = acting.index(self.id)
+        if msg.op in ("write_full", "write", "append", "delete"):
+            # min_size gate BEFORE any mutation (reference: PrimaryLogPG
+            # refuses ops while acting < pool.min_size): refusing up front
+            # both protects durability (never take a write we may not be
+            # able to re-protect) and keeps -EAGAIN retries side-effect
+            # free — a partially-applied-then-refused write would make
+            # the client resend double-apply
+            reachable = sum(
+                1 for o in acting
+                if o >= 0 and (o == self.id or self.osdmap.is_up(o))
+            )
+            if reachable < pool.min_size:
+                return MOSDOpReply(
+                    tid=msg.tid, retval=-11, epoch=self.my_epoch(),
+                    result=f"{reachable} acting shards reachable < "
+                           f"min_size {pool.min_size}",
+                )
         if msg.op == "write_full":
             data = unpack_data(msg.data) or b""
             with pg.lock:
                 return self._ec_write(
+                    pg, pool, codec, acting, my_shard, msg, data
+                )
+        if msg.op in ("write", "append"):
+            data = unpack_data(msg.data) or b""
+            with pg.lock:
+                return self._ec_rmw(
                     pg, pool, codec, acting, my_shard, msg, data
                 )
         if msg.op == "read":
@@ -939,8 +1125,9 @@ class OSD(Dispatcher):
         version = pg.version + 1
         # entry rides a 4th element (object size) so every shard can answer
         # size/stat even after the primary moves
-        entry = LogEntry(version, "modify", msg.oid)
-        wire_entry = entry.to_list() + [len(data)]
+        entry = LogEntry(version, "modify", msg.oid,
+                         reqid=getattr(msg, "reqid", None))
+        wire_entry = entry.to_list()
         tids: dict[int, int] = {}
         for shard, osd in enumerate(acting):
             if shard == my_shard or osd < 0:
@@ -956,7 +1143,7 @@ class OSD(Dispatcher):
                         tid=tid, pgid=pg.pgid, oid=msg.oid, shard=shard,
                         data=pack_data(chunk), crc=crc32c(chunk),
                         version=version, entry=wire_entry,
-                        epoch=self.my_epoch(),
+                        epoch=self.my_epoch(), osize=len(data),
                     )
                 )
             except (OSError, ConnectionError):
@@ -971,6 +1158,7 @@ class OSD(Dispatcher):
         t.truncate(cid, msg.oid, len(chunk))
         t.setattr(cid, msg.oid, "hinfo", str(crc32c(chunk)).encode())
         t.setattr(cid, msg.oid, "size", str(len(data)).encode())
+        t.setattr(cid, msg.oid, "ver", str(version).encode())
         self._log_txn(t, cid, pg, entry)
         self.store.queue_transaction(t)
         acked = 1
@@ -990,12 +1178,320 @@ class OSD(Dispatcher):
         if acked >= pool.min_size:
             return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
                                result={"version": pg.version, "acked": acked})
+        # structured under-ack refusal: the op IS applied+logged locally;
+        # "applied" lets dup detection refuse re-execution on the resend
         return MOSDOpReply(tid=msg.tid, retval=-11, epoch=self.my_epoch(),
-                           result=f"only {acked} shard commits")
+                           result={"applied": pg.version, "acked": acked,
+                                   "error": "below min_size commits"})
+
+    # .. partial-stripe RMW ................................................
+    def _ec_object_size(self, pg, acting, oid: str):
+        """Stored object size (the `size` xattr), local shard preferred,
+        else reachable peers' metadata probes.  Returns an int, "absent"
+        (a shard DEFINITIVELY reported no such object), or "unknown"
+        (nobody answered either way — e.g. transient connection faults).
+        The distinction matters: treating unreachable as absent would
+        let a ranged write re-create an existing object as zeros."""
+        for shard, osd in enumerate(acting):
+            if osd != self.id:
+                continue
+            try:
+                return int(self.store.getattr(
+                    self._cid(pg.pgid, shard), oid, "size"))
+            except (NotFound, KeyError, ValueError):
+                break
+        verdict = "unknown"
+        best_size = None
+        best_ver = -1
+        for shard, osd in enumerate(acting):
+            if osd < 0 or osd == self.id or not self.osdmap.is_up(osd):
+                continue
+            tid = self._next_tid()
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MECSubOpRead(tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
+                                 offsets=[], epoch=self.my_epoch())
+                )
+            except (OSError, ConnectionError):
+                continue
+            rep = self._wait_reply(tid)
+            if rep is None:
+                continue
+            if rep.retval == 0 and rep.size is not None:
+                # prefer the NEWEST-generation shard's size: a stale
+                # shard that missed the last append would hand back the
+                # old size and the append would overwrite live bytes
+                v = getattr(rep, "ver", None)
+                if v is None:
+                    v = 0
+                if v > best_ver or best_size is None:
+                    best_ver, best_size = v, int(rep.size)
+            elif rep.retval == -2:
+                verdict = "absent"  # a live shard is sure it isn't there
+        if best_size is not None:
+            return best_size
+        return verdict
+
+    def _fetch_shard_range(self, pg, acting, shard: int, oid: str,
+                           off: int, ln: int):
+        """(`ln` bytes at `off` of one shard's stored chunk, that shard's
+        stored per-object version) — local or via a ranged MECSubOpRead.
+        (None, None) = holder down / chunk missing / short read."""
+        osd = acting[shard] if shard < len(acting) else -1
+        if osd == self.id:
+            cid = self._cid(pg.pgid, shard)
+            try:
+                b = self.store.read(cid, oid, off, ln)
+            except (NotFound, KeyError):
+                return None, None
+            return (bytes(b), self._stored_ver(cid, oid)) \
+                if len(b) == ln else (None, None)
+        if osd < 0 or not self.osdmap.is_up(osd):
+            return None, None
+        tid = self._next_tid()
+        try:
+            self._conn_to_osd(osd).send_message(
+                MECSubOpRead(tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
+                             offsets=[[off, ln]], epoch=self.my_epoch())
+            )
+        except (OSError, ConnectionError):
+            return None, None
+        rep = self._wait_reply(tid)
+        if rep is None or rep.retval != 0:
+            return None, None
+        b = unpack_data(rep.data) or b""
+        return (b, rep.ver) if len(b) == ln else (None, None)
+
+    def _stored_ver(self, cid: str, oid: str) -> int | None:
+        """Per-object version xattr (object_info_t analog); None =
+        unversioned (legacy object or backfill-pushed wildcard)."""
+        try:
+            v = self.store.getattr(cid, oid, "ver")
+        except (NotFound, KeyError):
+            return None
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return None
+
+    def _rmw_apply_local(self, t: Transaction, cid: str, oid: str,
+                         full: bytearray, off: int, payload: bytes,
+                         xor: bool) -> None:
+        """Splice (xor=False) or GF-XOR (xor=True) `payload` into the
+        primary's own pre-validated chunk bytes `full` at `off`, keeping
+        the hinfo CRC current."""
+        if xor:
+            seg = (
+                np.frombuffer(bytes(full[off:off + len(payload)]), np.uint8)
+                ^ np.frombuffer(payload, np.uint8)
+            ).tobytes()
+        else:
+            seg = payload
+        full[off:off + len(seg)] = seg
+        t.write(cid, oid, off, seg)
+        t.setattr(cid, oid, "hinfo", str(crc32c(bytes(full))).encode())
+
+    def _ec_full_splice(self, pg, pool, codec, acting, my_shard, msg,
+                        data: bytes, off: int, size) -> MOSDOpReply:
+        """RMW slow path: read the whole (possibly degraded) object,
+        splice, re-encode everything via the full-object write.  Used when
+        the write grows the stripe, the codec is sub-chunked (CLAY), or an
+        affected shard's old bytes are unreachable (reconstruction needed).
+        """
+        old = b""
+        if size:
+            rd = self._ec_read(pg, codec, acting, MOSDOp(
+                tid=self._next_tid(), pool=msg.pool, oid=msg.oid, op="read",
+                epoch=self.my_epoch(), ps=pg.ps,
+            ))
+            if rd.retval != 0:
+                return MOSDOpReply(
+                    tid=msg.tid, retval=-5, epoch=self.my_epoch(),
+                    result=f"rmw old-object read: {rd.result}",
+                )
+            old = unpack_data(rd.data) or b""
+        buf = bytearray(max(len(old), off + len(data)))
+        buf[:len(old)] = old
+        buf[off:off + len(data)] = data
+        return self._ec_write(pg, pool, codec, acting, my_shard, msg,
+                              bytes(buf))
+
+    def _ec_rmw(self, pg, pool, codec, acting, my_shard, msg,
+                data: bytes) -> MOSDOpReply:
+        """Ranged write / append on an EC object (reference:
+        src/osd/ECTransaction.cc :: generate_transactions — the RMW that
+        reads the old stripe remainder and re-encodes the touched stripes;
+        expressed here as a PARITY-DELTA update, the optimized-EC
+        formulation, which is also the TPU-shaped one: the parity delta is
+        one GF matrix apply over just the touched column window).
+
+        Correctness rests on GF-linearity of every registered plugin's
+        encode_chunks: parity(new) = parity(old) XOR parity(delta), column
+        by column.  Shards that would fuse stale bytes with the delta
+        refuse the sub-op (version-jump guard in _handle_sub_write) and
+        are rebuilt by log-delta recovery instead."""
+        k = codec.get_data_chunk_count()
+        n = codec.get_chunk_count()
+        size = self._ec_object_size(pg, acting, msg.oid)
+        if size == "unknown":
+            # can't tell whether the object exists (transient faults):
+            # refusing retryably is the only safe answer — guessing
+            # "absent" would zero-fill over live data
+            return MOSDOpReply(tid=msg.tid, retval=-11, epoch=self.my_epoch(),
+                               result="object existence unknown (peers "
+                                      "unreachable)")
+        if size == "absent":
+            size = None
+        off = (size or 0) if msg.op == "append" else int(msg.off or 0)
+        if not data:
+            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                               result={"version": pg.version})
+        end = off + len(data)
+        if size is None:
+            # object doesn't exist yet: a ranged write below `off` reads
+            # back as zeros (reference: sparse write semantics)
+            return self._ec_write(pg, pool, codec, acting, my_shard, msg,
+                                  b"\x00" * off + data)
+        L = codec.get_chunk_size(size) if size else 0
+        sub_chunks = 1
+        try:
+            sub_chunks = codec.get_sub_chunk_count()
+        except Exception:
+            pass
+        if size == 0 or end > k * L or sub_chunks != 1:
+            return self._ec_full_splice(pg, pool, codec, acting, my_shard,
+                                        msg, data, off, size)
+        # local pre-validation: the delta fast path needs the primary's
+        # own chunk present, rot-free, and version-stamped — the stamp is
+        # the authoritative old object version every other shard must
+        # match (the primary serialized all prior writes)
+        cid = self._cid(pg.pgid, my_shard)
+        try:
+            my_chunk = bytearray(self.store.read(cid, msg.oid))
+        except (NotFound, KeyError):
+            return self._ec_full_splice(pg, pool, codec, acting, my_shard,
+                                        msg, data, off, size)
+        my_ver = self._stored_ver(cid, msg.oid)
+        try:
+            stored_h = int(self.store.getattr(cid, msg.oid, "hinfo"))
+        except (NotFound, KeyError, ValueError):
+            stored_h = None
+        if (
+            my_ver is None
+            or len(my_chunk) != L
+            or (stored_h is not None and crc32c(bytes(my_chunk)) != stored_h)
+        ):
+            # unversioned legacy object, unexpected chunk length, or
+            # local rot (full-splice reads exclude the rotted chunk and
+            # the re-encode heals it)
+            return self._ec_full_splice(pg, pool, codec, acting, my_shard,
+                                        msg, data, off, size)
+        # per-data-shard touched segments: shard j holds object bytes
+        # [j*L, (j+1)*L) (contiguous-split layout, ErasureCode.encode_prepare)
+        segs: dict[int, tuple[int, bytes]] = {}
+        for j in range(k):
+            lo, hi = max(off, j * L), min(end, (j + 1) * L)
+            if lo < hi:
+                segs[j] = (lo - j * L, data[lo - off:hi - off])
+        c0 = min(o for o, _ in segs.values())
+        c1 = max(o + len(b) for o, b in segs.values())
+        w = c1 - c0
+        old: dict[int, bytes] = {}
+        for j, (o, b) in segs.items():
+            if j == my_shard:
+                old[j] = bytes(my_chunk[o:o + len(b)])
+                continue
+            ob, over = self._fetch_shard_range(
+                pg, acting, j, msg.oid, o, len(b)
+            )
+            if ob is None or over != my_ver:
+                # unreachable, or the holder is a STALE generation whose
+                # old bytes would poison the parity delta (the retry-
+                # after-partial-apply case): reconstruct via the decode
+                # slow path instead, which filters by version
+                return self._ec_full_splice(pg, pool, codec, acting,
+                                            my_shard, msg, data, off, size)
+            old[j] = ob
+        # parity delta = encode_chunks(delta window): zero rows for
+        # untouched shards, new^old for touched ones; padded to the
+        # codec's alignment (zero delta => zero parity delta, trim back)
+        W = codec.get_chunk_size(k * w)
+        delta = np.zeros((k, W), np.uint8)
+        for j, (o, b) in segs.items():
+            delta[j, o - c0:o - c0 + len(b)] = (
+                np.frombuffer(b, np.uint8) ^ np.frombuffer(old[j], np.uint8)
+            )
+        parity_delta = np.asarray(codec.encode_chunks(delta), np.uint8)[:, :w]
+        new_size = max(size, end)
+        version = pg.version + 1
+        entry = LogEntry(version, "modify", msg.oid,
+                         reqid=getattr(msg, "reqid", None))
+        wire_entry = entry.to_list()
+        tids: dict[int, int] = {}
+        for shard, osd in enumerate(acting):
+            if shard == my_shard or osd < 0 or not self.osdmap.is_up(osd):
+                continue
+            if shard in segs:
+                mode, moff, payload = "range", segs[shard][0], segs[shard][1]
+            elif shard >= k:
+                mode, moff = "delta", c0
+                payload = parity_delta[shard - k].tobytes()
+            else:
+                mode, moff, payload = None, None, None  # entry+size only
+            tid = self._next_tid()
+            tids[tid] = shard
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MECSubOpWrite(
+                        tid=tid, pgid=pg.pgid, oid=msg.oid, shard=shard,
+                        data=pack_data(payload) if payload is not None
+                        else None,
+                        crc=crc32c(payload) if payload is not None else None,
+                        version=version, entry=wire_entry,
+                        epoch=self.my_epoch(), mode=mode, off=moff,
+                        over=my_ver, osize=new_size,
+                    )
+                )
+            except (OSError, ConnectionError):
+                tids.pop(tid, None)
+                self.mc.report_failure(osd)
+        t = Transaction()
+        t.try_create_collection(cid)
+        if my_shard in segs:
+            o, b = segs[my_shard]
+            self._rmw_apply_local(t, cid, msg.oid, my_chunk, o, b, xor=False)
+        elif my_shard >= k:
+            self._rmw_apply_local(
+                t, cid, msg.oid, my_chunk, c0,
+                parity_delta[my_shard - k].tobytes(), xor=True,
+            )
+        t.setattr(cid, msg.oid, "size", str(new_size).encode())
+        t.setattr(cid, msg.oid, "ver", str(version).encode())
+        self._log_txn(t, cid, pg, entry)
+        self.store.queue_transaction(t)
+        acked = 1
+        failed: list[int] = []
+        for tid, shard in tids.items():
+            rep = self._wait_reply(tid)
+            if rep is not None and rep.retval == 0:
+                acked += 1
+            else:
+                failed.append(acting[shard])
+        for osd in failed:
+            self.mc.report_failure(osd)
+        if acked >= pool.min_size:
+            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                               result={"version": pg.version, "acked": acked})
+        # structured under-ack refusal: the op IS applied+logged locally;
+        # "applied" lets dup detection refuse re-execution on the resend
+        return MOSDOpReply(tid=msg.tid, retval=-11, epoch=self.my_epoch(),
+                           result={"applied": pg.version, "acked": acked,
+                                   "error": "below min_size commits"})
 
     def _ec_delete(self, pg, acting, my_shard, msg) -> MOSDOpReply:
         version = pg.version + 1
-        entry = LogEntry(version, "delete", msg.oid)
+        entry = LogEntry(version, "delete", msg.oid,
+                         reqid=getattr(msg, "reqid", None))
         tids: dict[int, int] = {}
         for shard, osd in enumerate(acting):
             if shard == my_shard or osd < 0 or not self.osdmap.is_up(osd):
@@ -1030,21 +1526,43 @@ class OSD(Dispatcher):
     def _gather_chunks(
         self, pg, codec, acting, oid: str, want: set[int],
         sizes: dict[int, int] | None = None,
+        vers: dict[int, int | None] | None = None,
+        stray: bool = False,
     ) -> dict[int, bytes]:
         """Fetch chunk bytes for shard ids in `want` (local or remote).
         `sizes`, if given, collects the object-size xattr each replying
-        shard reports (for padding-strip when the primary has no copy)."""
+        shard reports (for padding-strip when the primary has no copy);
+        `vers` likewise collects each shard's stored per-object version
+        (None = wildcard) for stale-generation filtering.  `stray` also
+        probes non-acting locations for shards the acting map cannot
+        serve (see _gather_stray_chunks)."""
         got: dict[int, bytes] = {}
         tids: dict[int, int] = {}
         for shard in sorted(want):
             osd = acting[shard] if shard < len(acting) else -1
             if osd == self.id:
+                cid = self._cid(pg.pgid, shard)
                 try:
-                    got[shard] = self.store.read(
-                        self._cid(pg.pgid, shard), oid
-                    )
+                    chunk = self.store.read(cid, oid)
                 except (NotFound, KeyError):
-                    pass
+                    continue
+                try:
+                    stored = int(self.store.getattr(cid, oid, "hinfo"))
+                except (NotFound, KeyError, ValueError):
+                    stored = None
+                if stored is not None and crc32c(chunk) != stored:
+                    # rotted local chunk counts as missing: reconstruct
+                    # from peers rather than decode garbage (hinfo read
+                    # check, as in _handle_sub_read)
+                    self.cct.dout(
+                        "osd", 0,
+                        f"{self.whoami} hinfo mismatch on local read "
+                        f"{pg.pgid}/{oid} shard {shard}",
+                    )
+                    continue
+                got[shard] = chunk
+                if vers is not None:
+                    vers[shard] = self._stored_ver(cid, oid)
                 continue
             if osd < 0 or not self.osdmap.is_up(osd):
                 continue
@@ -1063,7 +1581,81 @@ class OSD(Dispatcher):
                 got[shard] = unpack_data(rep.data)
                 if sizes is not None and rep.size is not None:
                     sizes[shard] = int(rep.size)
+                if vers is not None:
+                    vers[shard] = getattr(rep, "ver", None)
+        if stray and want - set(got):
+            self._gather_stray_chunks(
+                pg, oid, want - set(got), got, sizes, vers, acting
+            )
         return got
+
+    def _gather_stray_chunks(self, pg, oid: str, missing: set[int],
+                             got: dict, sizes, vers, acting) -> None:
+        """Probe NON-acting locations for shards whose acting holder is a
+        hole or empty-handed: after an acting-set permutation (OSD out ->
+        CRUSH reshuffle) a surviving OSD may still hold a shard's chunk
+        from its previous role, addressable only outside the acting map
+        (reference: PeeringState's missing_loc — recovery reads from any
+        OSD known to hold the object, not just the acting set)."""
+        for shard in sorted(missing):
+            cid = self._cid(pg.pgid, shard)
+            holder = acting[shard] if shard < len(acting) else -1
+            chunk = None
+            if holder != self.id:  # acting-local was already tried
+                try:
+                    chunk = self.store.read(cid, oid)
+                except (NotFound, KeyError):
+                    chunk = None
+            if chunk is not None:
+                try:
+                    stored = int(self.store.getattr(cid, oid, "hinfo"))
+                except (NotFound, KeyError, ValueError):
+                    stored = None
+                if stored is not None and crc32c(chunk) != stored:
+                    chunk = None  # rotted stray: keep probing
+            if chunk is not None:
+                got[shard] = chunk
+                if vers is not None:
+                    vers[shard] = self._stored_ver(cid, oid)
+                continue
+            probes = 0
+            for osd in range(self.osdmap.max_osd):
+                if osd in (self.id, holder) or not self.osdmap.is_up(osd):
+                    continue
+                if probes >= 16:
+                    break  # bound the walk on big maps (client-path cost)
+                probes += 1
+                # metadata-only probe first (offsets=[]): a miss costs a
+                # tiny -2 round trip, not a full-chunk transfer; bytes
+                # are fetched only from a peer that reports holding the
+                # object (past_intervals will shrink this candidate walk)
+                tid = self._next_tid()
+                try:
+                    self._conn_to_osd(osd).send_message(MECSubOpRead(
+                        tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
+                        offsets=[], epoch=self.my_epoch(),
+                    ))
+                except (OSError, ConnectionError):
+                    continue
+                rep = self._wait_reply(tid, timeout=3.0)
+                if rep is None or rep.retval != 0:
+                    continue
+                tid = self._next_tid()
+                try:
+                    self._conn_to_osd(osd).send_message(MECSubOpRead(
+                        tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
+                        offsets=None, epoch=self.my_epoch(),
+                    ))
+                except (OSError, ConnectionError):
+                    continue
+                rep = self._wait_reply(tid, timeout=5.0)
+                if rep is not None and rep.retval == 0:
+                    got[shard] = unpack_data(rep.data)
+                    if sizes is not None and rep.size is not None:
+                        sizes[shard] = int(rep.size)
+                    if vers is not None:
+                        vers[shard] = getattr(rep, "ver", None)
+                    break
 
     def _ec_read(self, pg, codec, acting, msg) -> MOSDOpReply:
         k = codec.get_data_chunk_count()
@@ -1078,18 +1670,39 @@ class OSD(Dispatcher):
             except (NotFound, KeyError):
                 pass
         peer_sizes: dict[int, int] = {}
+        vers: dict[int, int | None] = {}
         want_data = set(range(k))
         got = self._gather_chunks(
-            pg, codec, acting, msg.oid, want_data, sizes=peer_sizes
+            pg, codec, acting, msg.oid, want_data, sizes=peer_sizes,
+            vers=vers,
         )
+
+        def current_only(chunks: dict) -> dict:
+            """Drop stale-GENERATION chunks: shards versioned below the
+            newest version seen carry pre-RMW bytes that must never be
+            mixed into a decode (None = wildcard, e.g. backfill-rebuilt).
+            The newest seen is authoritative — no shard can be stamped
+            above the last primary-serialized write."""
+            present = [v for v in vers.values() if v is not None]
+            if not present:
+                return chunks
+            target = max(present)
+            return {
+                s: b for s, b in chunks.items()
+                if vers.get(s) is None or vers.get(s) == target
+            }
+
+        got = current_only(got)
         missing = want_data - set(got)
         if missing:
-            # degraded: consult minimum_to_decode over everything reachable
+            # degraded: consult minimum_to_decode over everything
+            # reachable, including stray (non-acting) chunk locations
             avail_probe = self._gather_chunks(
-                pg, codec, acting, msg.oid, set(range(k, n)),
-                sizes=peer_sizes,
+                pg, codec, acting, msg.oid, set(range(k, n)) | missing,
+                sizes=peer_sizes, vers=vers, stray=True,
             )
             avail_probe.update(got)
+            avail_probe = current_only(avail_probe)
             if len(avail_probe) < k:
                 return MOSDOpReply(
                     tid=msg.tid, retval=-5, epoch=self.my_epoch(),
@@ -1110,7 +1723,15 @@ class OSD(Dispatcher):
         else:
             data = b"".join(got[i] for i in range(k))
         if size is None and peer_sizes:
-            size = next(iter(peer_sizes.values()))
+            # prefer a size reported by a current-generation shard — a
+            # stale shard's size xattr predates the newest RMW
+            present = [v for v in vers.values() if v is not None]
+            target = max(present) if present else None
+            good = [
+                sz for s, sz in peer_sizes.items()
+                if target is None or vers.get(s) in (None, target)
+            ]
+            size = good[0] if good else next(iter(peer_sizes.values()))
         if size is None:
             # no shard could report a size xattr: the full (padded) stripe
             # is the best available answer
@@ -1131,11 +1752,52 @@ class OSD(Dispatcher):
         acting = [o for o in acting if o >= 0]
         my_shard = 0  # replicated: every replica stores the full object
         cid = self._cid(pg.pgid, 0)
+        if msg.op in ("write_full", "write", "append", "delete"):
+            # min_size gate, as on the EC path
+            reachable = sum(
+                1 for o in acting
+                if o == self.id or self.osdmap.is_up(o)
+            )
+            if reachable < pool.min_size:
+                return MOSDOpReply(
+                    tid=msg.tid, retval=-11, epoch=self.my_epoch(),
+                    result=f"{reachable} replicas reachable < "
+                           f"min_size {pool.min_size}",
+                )
+        if msg.op in ("write", "append"):
+            # ranged write / append: splice into the primary's copy (the
+            # primary always holds the authoritative full object on a
+            # replicated pool) and replicate the result full-object —
+            # the reference ships op-level deltas; full-object keeps the
+            # one replication path here while the EC pool carries the
+            # real RMW machinery.  The read-splice-replicate sequence
+            # runs under pg.lock (reentrant) so two concurrent appends
+            # cannot both read the same old length and lose one update;
+            # the rebuilt op KEEPS the reqid so the logged entry still
+            # answers cross-primary resends.
+            with pg.lock:
+                new = unpack_data(msg.data) or b""
+                try:
+                    old = bytes(self.store.read(cid, msg.oid))
+                except (NotFound, KeyError):
+                    old = b""
+                off = len(old) if msg.op == "append" else int(msg.off or 0)
+                buf = bytearray(max(len(old), off + len(new)))
+                buf[:len(old)] = old
+                buf[off:off + len(new)] = new
+                msg = MOSDOp(
+                    tid=msg.tid, pool=msg.pool, oid=msg.oid,
+                    op="write_full", data=pack_data(bytes(buf)),
+                    epoch=msg.epoch, ps=msg.ps,
+                    reqid=getattr(msg, "reqid", None),
+                )
+                return self._replicated_op(pg, pool, acting, msg)
         if msg.op == "write_full":
             data = unpack_data(msg.data) or b""
             with pg.lock:
                 version = pg.version + 1
-                entry = LogEntry(version, "modify", msg.oid)
+                entry = LogEntry(version, "modify", msg.oid,
+                                 reqid=getattr(msg, "reqid", None))
                 tids = {}
                 for osd in acting:
                     if osd == self.id or not self.osdmap.is_up(osd):
@@ -1148,8 +1810,8 @@ class OSD(Dispatcher):
                                 tid=tid, pgid=pg.pgid, oid=msg.oid, shard=0,
                                 data=msg.data, crc=crc32c(data),
                                 version=version,
-                                entry=entry.to_list() + [len(data)],
-                                epoch=self.my_epoch(),
+                                entry=entry.to_list(),
+                                epoch=self.my_epoch(), osize=len(data),
                             )
                         )
                     except (OSError, ConnectionError):
@@ -1162,6 +1824,7 @@ class OSD(Dispatcher):
                 # from divergence (replicas get theirs via sub-write)
                 t.setattr(cid, msg.oid, "hinfo", str(crc32c(data)).encode())
                 t.setattr(cid, msg.oid, "size", str(len(data)).encode())
+                t.setattr(cid, msg.oid, "ver", str(version).encode())
                 self._log_txn(t, cid, pg, entry)
                 self.store.queue_transaction(t)
                 acked = 1
@@ -1174,9 +1837,10 @@ class OSD(Dispatcher):
                         tid=msg.tid, retval=0, epoch=self.my_epoch(),
                         result={"version": pg.version, "acked": acked},
                     )
-                return MOSDOpReply(tid=msg.tid, retval=-11,
-                                   epoch=self.my_epoch(),
-                                   result=f"only {acked} replica commits")
+                return MOSDOpReply(
+                    tid=msg.tid, retval=-11, epoch=self.my_epoch(),
+                    result={"applied": pg.version, "acked": acked,
+                            "error": "below min_size commits"})
         if msg.op == "read":
             try:
                 data = self.store.read(cid, msg.oid)
@@ -1192,7 +1856,8 @@ class OSD(Dispatcher):
         if msg.op == "delete":
             with pg.lock:
                 version = pg.version + 1
-                entry = LogEntry(version, "delete", msg.oid)
+                entry = LogEntry(version, "delete", msg.oid,
+                                 reqid=getattr(msg, "reqid", None))
                 for osd in acting:
                     if osd == self.id or not self.osdmap.is_up(osd):
                         continue
@@ -1249,16 +1914,111 @@ class OSD(Dispatcher):
                 entry_op = msg.entry[1] if msg.entry else None
                 t = Transaction()
                 t.try_create_collection(cid)
-                if msg.data is not None:
+                if (
+                    msg.data is not None
+                    and getattr(msg, "mode", None) in ("range", "delta")
+                ):
+                    # partial-stripe RMW sub-op: splice (data shard) or
+                    # GF-XOR (parity shard) into the stored chunk.  The
+                    # per-object version guard (`over` -> `ver`) is what
+                    # makes this safe: an RMW onto a STALE generation
+                    # would fuse old and new stripes, and a REPLAYED RMW
+                    # (dup/resend) would double-apply the delta.
+                    stored_ver = self._stored_ver(cid, msg.oid)
+                    if stored_ver == msg.version:
+                        # already applied (idempotent replay): ack as-is
+                        pass
+                    elif (
+                        getattr(msg, "over", None) is None
+                        or stored_ver != msg.over
+                        or msg.version != pg.version + 1
+                    ):
+                        raise IOError(
+                            f"rmw v{msg.over}->v{msg.version} onto shard "
+                            f"at obj v{stored_ver} pg v{pg.version}"
+                        )
+                    else:
+                        seg = unpack_data(msg.data)
+                        if crc32c(seg) != msg.crc:
+                            raise IOError("rmw sub-op crc mismatch")
+                        off = int(msg.off or 0)
+                        try:
+                            full = bytearray(self.store.read(cid, msg.oid))
+                        except (NotFound, KeyError):
+                            raise IOError("rmw target chunk missing on shard")
+                        if off + len(seg) > len(full):
+                            raise IOError("rmw beyond stored chunk")
+                        # rot check BEFORE applying: stamping a fresh
+                        # hinfo over a corrupt base would launder the rot
+                        # past every later integrity check
+                        try:
+                            stored_h = int(
+                                self.store.getattr(cid, msg.oid, "hinfo"))
+                        except (NotFound, KeyError, ValueError):
+                            stored_h = None
+                        if (stored_h is not None
+                                and crc32c(bytes(full)) != stored_h):
+                            raise IOError("rmw base chunk failed hinfo")
+                        if msg.mode == "delta":
+                            seg = (
+                                np.frombuffer(
+                                    bytes(full[off:off + len(seg)]), np.uint8
+                                )
+                                ^ np.frombuffer(seg, np.uint8)
+                            ).tobytes()
+                        full[off:off + len(seg)] = seg
+                        t.write(cid, msg.oid, off, seg)
+                        t.setattr(cid, msg.oid, "hinfo",
+                                  str(crc32c(bytes(full))).encode())
+                        t.setattr(cid, msg.oid, "ver",
+                                  str(msg.version).encode())
+                        if msg.osize is not None:
+                            t.setattr(cid, msg.oid, "size",
+                                      str(msg.osize).encode())
+                elif msg.data is not None:
                     chunk = unpack_data(msg.data)
                     if crc32c(chunk) != msg.crc:
                         raise IOError("chunk crc mismatch")
                     t.write(cid, msg.oid, 0, chunk)
                     t.truncate(cid, msg.oid, len(chunk))
                     t.setattr(cid, msg.oid, "hinfo", str(msg.crc).encode())
-                    if msg.entry and len(msg.entry) > 3:
+                    # full-chunk pushes stamp the chunk GENERATION: a
+                    # recovery push carries the primary's stored stamp
+                    # (`over`) since its bytes are rebuilt-current; a
+                    # live write stamps its own version; a push that
+                    # knows neither (backfill of a legacy object) stamps
+                    # the wildcard so readers accept the bytes
+                    gen = getattr(msg, "over", None)
+                    if gen is None:
+                        gen = msg.version
+                    t.setattr(cid, msg.oid, "ver",
+                              str(gen).encode() if gen else b"")
+                    if msg.osize is not None:
                         t.setattr(cid, msg.oid, "size",
-                                  str(msg.entry[3]).encode())
+                                  str(msg.osize).encode())
+                elif (
+                    entry_op == "modify"
+                    and msg.osize is not None
+                    and msg.xattrs is None
+                ):
+                    # entry-only RMW companion (this shard's chunk bytes
+                    # were untouched): keep the size xattr and object
+                    # version current, but only if we actually hold the
+                    # object — and only when our log is contiguous, else
+                    # we'd stamp a version whose writes we missed.
+                    # (`ver` is a CHUNK-GENERATION stamp: xattr-only
+                    # pushes carry msg.xattrs and must not touch it —
+                    # they don't change stripe bytes)
+                    if msg.version is not None and msg.version == pg.version + 1:
+                        try:
+                            self.store.stat(cid, msg.oid)
+                        except (NotFound, KeyError):
+                            pass
+                        else:
+                            t.setattr(cid, msg.oid, "size",
+                                      str(msg.osize).encode())
+                            t.setattr(cid, msg.oid, "ver",
+                                      str(msg.version).encode())
                 elif entry_op in (None, "delete") and not msg.xattrs:
                     # data-less delete (live op or recovery replay)
                     try:
@@ -1301,7 +2061,7 @@ class OSD(Dispatcher):
                         # for entry-by-entry
                         self._log_seal_txn(t, cid, pg, msg.version)
                     elif msg.version == pg.version + 1:
-                        entry = LogEntry.from_list(msg.entry[:3])
+                        entry = LogEntry.from_list(msg.entry)
                         self._log_txn(t, cid, pg, entry)
                     # else: the entry JUMPS our version (we missed writes —
                     # e.g. a sub-write lost while the primary acked at
@@ -1332,15 +2092,47 @@ class OSD(Dispatcher):
                 self.store.stat(cid, msg.oid)
                 data = b""
             elif msg.offsets:
+                # ranged reads feed RMW old-byte fetches and CLAY repair:
+                # verify the WHOLE chunk's hinfo first — serving rotted
+                # bytes here would poison a parity delta with a fresh CRC
+                # stamped over it (no rot check could catch it later)
+                whole = self.store.read(cid, msg.oid)
+                try:
+                    stored = int(self.store.getattr(cid, msg.oid, "hinfo"))
+                except (NotFound, KeyError, ValueError):
+                    stored = None
+                if stored is not None and crc32c(whole) != stored:
+                    self.cct.dout(
+                        "osd", 0,
+                        f"{self.whoami} hinfo mismatch on ranged read "
+                        f"{msg.pgid}/{msg.oid} shard {msg.shard}",
+                    )
+                    raise NotFound(msg.oid)
                 parts = []
                 for off, ln in msg.offsets:
                     if ln == -1:
-                        parts.append(self.store.read(cid, msg.oid))
+                        parts.append(whole)
                     else:
-                        parts.append(self.store.read(cid, msg.oid, off, ln))
+                        parts.append(whole[off:off + ln])
                 data = b"".join(parts)
             else:
                 data = self.store.read(cid, msg.oid)
+                # full-chunk read: verify at-rest integrity against the
+                # stored hinfo CRC before serving — a rotted chunk must
+                # read as MISSING so the primary reconstructs instead of
+                # decoding garbage (reference: ECBackend checks
+                # ECUtil::HashInfo on read, -EIO on mismatch)
+                try:
+                    stored = int(self.store.getattr(cid, msg.oid, "hinfo"))
+                except (NotFound, KeyError, ValueError):
+                    stored = None
+                if stored is not None and crc32c(data) != stored:
+                    self.cct.dout(
+                        "osd", 0,
+                        f"{self.whoami} hinfo mismatch on read "
+                        f"{msg.pgid}/{msg.oid} shard {msg.shard}",
+                    )
+                    raise NotFound(msg.oid)
             try:
                 size = int(self.store.getattr(cid, msg.oid, "size"))
             except (NotFound, KeyError):
@@ -1356,11 +2148,12 @@ class OSD(Dispatcher):
             reply = MECSubOpReadReply(
                 tid=msg.tid, pgid=msg.pgid, oid=msg.oid, shard=msg.shard,
                 retval=0, data=pack_data(data), size=size, xattrs=user,
+                ver=self._stored_ver(cid, msg.oid),
             )
         except (NotFound, KeyError):
             reply = MECSubOpReadReply(
                 tid=msg.tid, pgid=msg.pgid, oid=msg.oid, shard=msg.shard,
-                retval=-2, data=None, size=None, xattrs=None,
+                retval=-2, data=None, size=None, xattrs=None, ver=None,
             )
         try:
             conn.send_message(reply)
@@ -1619,7 +2412,7 @@ class OSD(Dispatcher):
                         repaired += 1
                     elif self._push_sub_write(
                         pg, osd, store_shard, err["oid"], chunk, None,
-                        [0, "modify", err["oid"], size],
+                        [0, "modify", err["oid"]], osize=size,
                     ):
                         repaired += 1
             self.logger.inc("scrub_repairs", repaired)
@@ -2019,15 +2812,43 @@ class OSD(Dispatcher):
                 return  # retry next tick; judging peers now would be wrong
         if pg.version == 0:
             return  # nothing written yet
+        try:
+            my_oids = {
+                o for o in self.store.list_objects(self._cid(
+                    pg.pgid, acting.index(self.id) if is_ec else 0))
+                if not o.startswith("_")
+            }
+        except (NotFound, KeyError):
+            my_oids = set()
         # push phase: serialize vs concurrent client writes on this PG
         with pg.lock:
             for (shard, osd), (peer_ver, peer_oids) in peers.items():
-                if peer_ver >= pg.version:
+                role_missing = my_oids - set(peer_oids)
+                if peer_ver >= pg.version and not role_missing:
                     continue  # clean
-                self._push_missing(
-                    pg, codec, acting, shard if is_ec else 0, osd,
-                    peer_ver, is_ec, peer_oids,
-                )
+                if peer_ver >= pg.version:
+                    # version-current but the SHARD ROLE's objects are
+                    # absent: an acting-set permutation (OSD out -> CRUSH
+                    # reshuffle) handed this OSD a shard it never held —
+                    # the per-PG version cannot see that, only the
+                    # contents comparison can.  Rebuild its new role's
+                    # chunks (and retire any stale leftovers in that
+                    # collection from an older interval).
+                    self.cct.dout(
+                        "osd", 1,
+                        f"{self.whoami} role-backfill {pg.pgid} shard "
+                        f"{shard} osd.{osd}: {len(role_missing)} objects",
+                    )
+                    self._push_objects(
+                        pg, codec, acting, shard if is_ec else 0, osd,
+                        {o: None for o in sorted(role_missing)},
+                        set(peer_oids) - my_oids, is_ec,
+                    )
+                else:
+                    self._push_missing(
+                        pg, codec, acting, shard if is_ec else 0, osd,
+                        peer_ver, is_ec, peer_oids,
+                    )
 
     def _push_missing(self, pg, codec, acting, dest_shard, dest_osd,
                       from_version, is_ec, dest_oids) -> bool:
@@ -2130,13 +2951,20 @@ class OSD(Dispatcher):
             pass
 
     def _push_sub_write(self, pg, osd, shard, oid, data, version, entry,
-                        src_cid: str | None = None) -> bool:
+                        src_cid: str | None = None,
+                        osize: int | None = None) -> bool:
         """One recovery push; True iff the peer acked it (retval 0).
         Data pushes copy the object's user xattrs from `src_cid` (the
         primary's own shard collection) so a recovered shard can answer
-        getxattrs after a primary move."""
+        getxattrs after a primary move.  They also carry the primary's
+        stored chunk-generation stamp (`over`): the pushed bytes are
+        rebuilt-CURRENT, and stamping the log-entry version instead
+        would diverge from undisturbed shards whenever the log advanced
+        through xattr-only modifies (which don't change stripe bytes)."""
         xattrs = None
+        gen = None
         if data is not None and src_cid is not None:
+            gen = self._stored_ver(src_cid, oid)
             try:
                 mine = self.store.getattrs(src_cid, oid)
             except (NotFound, KeyError):
@@ -2155,7 +2983,7 @@ class OSD(Dispatcher):
                     data=pack_data(data) if data is not None else None,
                     crc=crc32c(data) if data is not None else None,
                     version=version, entry=entry, epoch=self.my_epoch(),
-                    xattrs=xattrs,
+                    xattrs=xattrs, over=gen, osize=osize,
                 )
             )
         except (OSError, ConnectionError):
@@ -2191,7 +3019,7 @@ class OSD(Dispatcher):
                     return False  # unreadable right now: retry next tick
                 ok = self._push_sub_write(
                     pg, osd, shard, e.oid, chunk, e.version,
-                    e.to_list() + [size], src_cid=my_cid,
+                    e.to_list(), src_cid=my_cid, osize=size,
                 )
                 self.logger.inc("recovery_ops")
             else:
@@ -2209,8 +3037,8 @@ class OSD(Dispatcher):
         """Backfill push: chunk data for every object, unversioned (the
         trimmed log cannot vouch for per-object versions); the final
         "clean" seal establishes the peer's version and empty log window.
-        The entry still carries the object size so the peer can answer
-        stat/padding-strip (entry[3] -> size xattr)."""
+        The push still carries the object size (osize) so the peer can
+        answer stat/padding-strip."""
         for oid in sorted(deleted):
             if not self._push_sub_write(pg, osd, shard, oid, None, None, None):
                 return False
@@ -2226,9 +3054,10 @@ class OSD(Dispatcher):
                 all_ok = False  # unreadable right now: retry next tick
                 continue
             version = newest[oid]
-            entry = [version or 0, "modify", oid, size]
+            entry = [version or 0, "modify", oid]
             if not self._push_sub_write(
-                pg, osd, shard, oid, chunk, version, entry, src_cid=my_cid
+                pg, osd, shard, oid, chunk, version, entry, src_cid=my_cid,
+                osize=size,
             ):
                 all_ok = False
         return all_ok
@@ -2270,7 +3099,19 @@ class OSD(Dispatcher):
         n = codec.get_chunk_count()
         want = set(range(n)) - {shard} - (exclude or set())
         sizes: dict[int, int] = {}
-        got = self._gather_chunks(pg, codec, acting, oid, want, sizes=sizes)
+        vers: dict[int, int | None] = {}
+        got = self._gather_chunks(pg, codec, acting, oid, want, sizes=sizes,
+                                  vers=vers, stray=True)
+        # never rebuild from a MIX of stripe generations: drop shards
+        # versioned below the newest seen (None = wildcard), exactly as
+        # the read path does
+        present = [v for v in vers.values() if v is not None]
+        if present:
+            target = max(present)
+            got = {
+                s: b for s, b in got.items()
+                if vers.get(s) is None or vers.get(s) == target
+            }
         if len(got) < k:
             return None, 0
         try:

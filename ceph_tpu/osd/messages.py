@@ -38,11 +38,16 @@ class MOSDOp(_JsonMessage):
     `snap_seq` on writes is the client's snap context: the primary clones
     against max(its map's seq, the client's) so a write never races the
     map push after a mksnap (reference: the SnapContext in every MOSDOp).
+    `reqid` is the client-unique id of the LOGICAL op, stable across
+    resends (reference: osd_reqid_t): the primary's per-PG dup cache
+    answers a resent already-applied mutation from it instead of
+    re-executing (reference: pg_log dup detection), which is what makes
+    append and partial-stripe RMW retry-safe.
     """
 
     MSG_TYPE = 42
     FIELDS = ("tid", "pool", "oid", "op", "data", "epoch", "off", "length",
-              "ps", "snapid", "snap_seq")
+              "ps", "snapid", "snap_seq", "reqid")
 
 
 @register_message
@@ -58,14 +63,30 @@ class MECSubOpWrite(_JsonMessage):
     """Primary → shard OSD: store one chunk (reference: MOSDECSubOpWrite
     carrying ECSubWrite: tid, shard transactions, log entries).
 
-    `entry` is the pg_log entry [version, op, oid] the shard must append
-    atomically with the chunk write (delta-recovery bookkeeping).
+    `entry` is the pg_log entry [version, op, oid(, reqid)] the shard
+    must append atomically with the chunk write (delta-recovery
+    bookkeeping; the optional reqid makes dup detection survive primary
+    changes).  `osize` carries the OBJECT size of a modify so every
+    shard can answer stat/padding-strip.
     `xattrs` carries user-xattr updates {name: b64 | null-to-remove},
-    applied in the same transaction (librados xattr replication)."""
+    applied in the same transaction (librados xattr replication).
+
+    `mode`/`off` carry the partial-stripe RMW sub-ops (reference:
+    src/osd/ECTransaction.cc :: generate_transactions — here expressed
+    as parity-delta writes, the optimized-EC formulation):
+      mode=None  — full-chunk replace (the classic write_full path)
+      mode="range" — splice `data` into the chunk at byte `off`
+      mode="delta" — GF(2^8)-XOR `data` onto the chunk at byte `off`
+                     (parity shards of an RMW)
+    Both RMW modes recompute the chunk's hinfo CRC after applying.
+    `over` is the object version the RMW transitions FROM: a shard whose
+    stored per-object `ver` xattr differs refuses (it is stale and will
+    be rebuilt by recovery), and one already at the target version acks
+    as a no-op (idempotent replay) — the object_info_t version guard."""
 
     MSG_TYPE = 108
     FIELDS = ("tid", "pgid", "oid", "shard", "data", "crc", "version",
-              "entry", "epoch", "xattrs")
+              "entry", "epoch", "xattrs", "mode", "off", "over", "osize")
 
 
 @register_message
@@ -87,11 +108,13 @@ class MECSubOpRead(_JsonMessage):
 class MECSubOpReadReply(_JsonMessage):
     """`size` echoes the shard's stored object-size xattr so a primary
     without its own shard copy can still strip stripe padding; `xattrs`
-    echoes the user xattrs for the same degraded-primary case."""
+    echoes the user xattrs for the same degraded-primary case.  `ver`
+    echoes the stored per-object version xattr (None = unversioned /
+    backfilled-wildcard) so readers can reject stale-generation chunks."""
 
     MSG_TYPE = 111
     FIELDS = ("tid", "pgid", "oid", "shard", "retval", "data", "size",
-              "xattrs")
+              "xattrs", "ver")
 
 
 @register_message

@@ -30,13 +30,21 @@ class LogEntry:
     version: int
     op: str  # "modify" | "delete" | "clean"
     oid: str
+    # client reqid of the mutation, if any (reference: pg_log_entry_t's
+    # reqid / pg_log_dup_t): because it rides IN the replicated+persisted
+    # log entry, dup detection survives primary restarts and acting-set
+    # changes — a new primary's delta-recovered log still answers resends
+    reqid: str | None = None
 
     def to_list(self) -> list:
-        return [self.version, self.op, self.oid]
+        if self.reqid is None:
+            return [self.version, self.op, self.oid]
+        return [self.version, self.op, self.oid, self.reqid]
 
     @classmethod
     def from_list(cls, v: list) -> "LogEntry":
-        return cls(int(v[0]), str(v[1]), str(v[2]))
+        return cls(int(v[0]), str(v[1]), str(v[2]),
+                   str(v[3]) if len(v) > 3 else None)
 
 
 class PGLog:
@@ -47,18 +55,30 @@ class PGLog:
         self.entries: list[LogEntry] = []  # ascending version
         self.head = 0          # newest version (0 = empty PG)
         self.tail = 0          # version BEFORE the oldest retained entry
+        # reqid -> version for the retained window (reference:
+        # pg_log_dup_t set): dup detection against the replicated log
+        self.reqids: dict[str, int] = {}
 
     def append(self, entry: LogEntry) -> list[LogEntry]:
         """Append and trim; returns entries trimmed off the tail."""
         assert entry.version > self.head, (entry, self.head)
         self.entries.append(entry)
         self.head = entry.version
+        if entry.reqid is not None:
+            self.reqids[entry.reqid] = entry.version
         trimmed: list[LogEntry] = []
         while len(self.entries) > self.limit:
             e = self.entries.pop(0)
             trimmed.append(e)
             self.tail = e.version
+            if e.reqid is not None and self.reqids.get(e.reqid) == e.version:
+                self.reqids.pop(e.reqid, None)
         return trimmed
+
+    def find_reqid(self, reqid: str) -> int | None:
+        """Version at which a client op was applied, if it is in the
+        retained log window (None = never seen or trimmed away)."""
+        return self.reqids.get(reqid)
 
     def covers(self, version: int) -> bool:
         """Can a peer at `version` be delta-recovered from this log?"""
@@ -71,6 +91,7 @@ class PGLog:
         backfill completion keeps covers() honest)."""
         self.entries = []
         self.head = self.tail = version
+        self.reqids = {}
 
     def entries_since(self, version: int) -> list[LogEntry]:
         return [e for e in self.entries if e.version > version]
@@ -110,4 +131,6 @@ class PGLog:
                 # seal) must not resurrect into the live log
                 if tail < e.version <= head:
                     log.entries.append(e)
+                    if e.reqid is not None:
+                        log.reqids[e.reqid] = e.version
         return log

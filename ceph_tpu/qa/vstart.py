@@ -303,12 +303,13 @@ class LocalCluster:
         pool = m.pools[pid]
         for ps in range(pool.pg_num):
             _up, _upp, acting, primary = m.pg_to_up_acting_osds(pid, ps)
-            posd = self.osds.get(primary)
-            if posd is None:
+            if self.osds.get(primary) is None:
                 return False
-            ppg = posd.pgs.get(f"{pid}.{ps}")
-            if ppg is None or ppg.version == 0:
-                continue  # nothing written to this PG
+            # every acting shard must agree on ONE version — `peer >=
+            # primary` is not enough: a just-revived STALE primary (v1,
+            # peers at v2) would read as clean in the window before its
+            # pull-forward tick, and reads in that window serve old data
+            vers = []
             for shard, o in enumerate(acting):
                 if o < 0:
                     continue
@@ -316,6 +317,33 @@ class LocalCluster:
                 if sosd is None:
                     return False
                 spg = sosd.pgs.get(f"{pid}.{ps}")
-                if spg is None or spg.version < ppg.version:
+                vers.append(spg.version if spg is not None else 0)
+            if vers and any(v != vers[0] for v in vers):
+                return False
+            # content completeness: an acting-set permutation can leave a
+            # version-current holder without its (new) shard role's
+            # objects; versions alone cannot see that
+            from ..osd.osdmap import PG_POOL_ERASURE
+
+            is_ec = pool.type == PG_POOL_ERASURE
+            posd = self.osds[primary]
+            pshard = acting.index(primary) if is_ec else 0
+            try:
+                pobjs = {
+                    obj for obj in posd.store.list_objects(
+                        f"{pid}.{ps}s{pshard}")
+                    if not obj.startswith("_")
+                }
+            except Exception:
+                pobjs = set()
+            for shard, o in enumerate(acting):
+                if o < 0 or o == primary:
+                    continue
+                cid = f"{pid}.{ps}s{shard if is_ec else 0}"
+                try:
+                    sobjs = set(self.osds[o].store.list_objects(cid))
+                except Exception:
+                    sobjs = set()
+                if pobjs - sobjs:
                     return False
         return True

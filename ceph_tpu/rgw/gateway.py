@@ -58,6 +58,7 @@ class _Store:
         # in the bucket index namespace) so a gateway restart neither
         # forgets in-flight uploads nor orphans their part data
         self.uploads: dict[str, dict] = {}
+        reaps = []
         for oid in self.meta.list_objects():
             if oid.startswith("mpu."):
                 up = self._read_json(self.meta, oid, None)
@@ -66,6 +67,13 @@ class _Store:
                         int(n): v for n, v in up.get("parts", {}).items()
                     }
                     self.uploads[oid[4:]] = up
+            elif oid.startswith("reap."):
+                reaps.append(oid)
+        # finish part deletions a crashed complete_upload left behind
+        for oid in reaps:
+            r = self._read_json(self.meta, oid, None)
+            if r is not None and oid[5:] not in self.uploads:
+                self._reap(oid[5:], r["bucket"], r.get("parts", []))
 
     def _persist_upload(self, uid: str) -> None:
         up = self.uploads[uid]
@@ -222,24 +230,51 @@ class _Store:
             dst.truncate(0)
             off = 0
             md5s = b""
+            part_names = []
             for n in sorted(up["parts"]):
-                part = self._stream(bucket, f"{key}.part.{uid}.{n}")
-                body = part.read()
+                name = f"{key}.part.{uid}.{n}"
+                body = self._stream(bucket, name).read()
                 dst.write(body, off)
                 off += len(body)
                 md5s += bytes.fromhex(up["parts"][n]["etag"])
-                part.remove()
+                part_names.append(name)
             etag = (
                 f"{hashlib.md5(md5s).hexdigest()}-{len(up['parts'])}"
             )
             idx = self.index(bucket)
             idx[key] = {"size": off, "etag": etag, "mtime": time.time()}
             self._write_index(bucket, idx)
-            # drop the persisted record LAST: a crash mid-complete leaves
-            # the mpu.{uid} record so a restarted gateway can still reap
-            # or re-complete (parts are only removed above after copying)
+            # Parts are only deleted AFTER the index write and the record
+            # drop: a crash anywhere up to here leaves record + parts
+            # intact, so a restarted gateway can re-complete idempotently.
+            # The reap.{uid} record is written BEFORE the mpu record drop
+            # so no crash point orphans the parts without a pointer; the
+            # startup sweep ignores reap records whose mpu record still
+            # exists, so a crash between the two writes re-completes
+            # rather than reaping live parts.
+            self.meta.write_full(
+                f"reap.{uid}",
+                json.dumps({"bucket": bucket, "parts": part_names}).encode(),
+            )
             self._drop_upload(uid)
+            self._reap(uid, bucket, part_names)
             return ("ok", (bucket, key, etag))
+
+    def _reap(self, uid: str, bucket: str, part_names: list) -> None:
+        all_gone = True
+        for name in part_names:
+            try:
+                self._stream(bucket, name).remove()
+            except IOError:
+                all_gone = False  # transient: retried from the record
+        if not all_gone:
+            # keep the reap record so a later startup sweep finishes the
+            # deletions — dropping it now would orphan the failed parts
+            return
+        try:
+            self.meta.remove(f"reap.{uid}")
+        except IOError:
+            pass
 
     def abort_upload(self, uid: str) -> bool:
         with self.lock:
