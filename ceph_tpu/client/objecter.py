@@ -16,7 +16,14 @@ import threading
 from ..msg import Dispatcher, Messenger
 from ..msg.messenger import POLICY_LOSSY
 from ..osd.osdmap import object_ps
-from ..osd.messages import MOSDOp, MOSDOpReply, pack_data
+from ..osd.messages import (
+    MOSDOp,
+    MOSDOpReply,
+    MWatchNotify,
+    MWatchNotifyAck,
+    pack_data,
+    unpack_data,
+)
 
 
 class Objecter(Dispatcher):
@@ -34,9 +41,53 @@ class Objecter(Dispatcher):
         import uuid
 
         self._nonce = uuid.uuid4().hex[:12]
+        # lingering watches: (pool, oid, cookie) -> {"callback": fn}
+        self._watches: dict[tuple, dict] = {}
+        self._cookie = 0
+        self._relinger_epoch = 0     # newest epoch watches were re-sent at
+        self._relingering = False    # single relinger loop at a time
+        self._linger_kick = False    # a map arrived while relinging
+        self._linger_lock = threading.Lock()
         self._replies: dict[int, MOSDOpReply] = {}
         self._outstanding: set[int] = set()
-        self.mc.subscribe_osdmap()
+        self.mc.subscribe_osdmap(callback=self._on_new_map)
+
+    def _on_new_map(self, m) -> None:
+        """Map-push hook: a new map may mean a new primary that has
+        never heard of our watches — re-register them off-thread (linger
+        resend; runs even for an idle watcher that submits no ops)."""
+        if not self._watches:
+            return
+        with self._linger_lock:
+            self._linger_kick = True
+        threading.Thread(target=self._relinger_guarded, daemon=True).start()
+
+    def _relinger_guarded(self) -> None:
+        """At most one relinger loop runs; the `kick` flag (set under
+        the lock by every map push) makes the exit decision atomic with
+        clearing `_relingering`, so an epoch that arrives mid-flight is
+        either handled by this loop's next pass or by the push's own
+        thread — never silently skipped."""
+        with self._linger_lock:
+            if self._relingering:
+                return
+            self._relingering = True
+        try:
+            while True:
+                with self._linger_lock:
+                    self._linger_kick = False
+                m = self.mc.osdmap
+                target = m.epoch if m is not None else 0
+                if target > self._relinger_epoch:
+                    self._relinger()
+                    self._relinger_epoch = target
+                with self._linger_lock:
+                    if not self._linger_kick:
+                        self._relingering = False
+                        return
+        finally:
+            with self._linger_lock:
+                self._relingering = False
 
     def shutdown(self) -> None:
         self.messenger.shutdown()
@@ -52,7 +103,66 @@ class Objecter(Dispatcher):
                     self._replies[msg.tid] = msg
                     self._cond.notify_all()
             return True
+        if isinstance(msg, MWatchNotify):
+            # watcher side of notify: fire the callback off-thread (a
+            # slow callback must not stall the messenger) and ack so the
+            # notifier's collect phase completes
+            entry = self._watches.get((msg.pool, msg.oid, msg.cookie))
+            if entry is not None:
+                cb = entry["callback"]
+                data = unpack_data(msg.data) or b""
+
+                def run(cb=cb, nid=msg.notify_id, ck=msg.cookie, d=data):
+                    try:
+                        cb(nid, ck, d)
+                    except Exception:
+                        pass
+
+                threading.Thread(target=run, daemon=True).start()
+            try:
+                conn.send_message(MWatchNotifyAck(
+                    notify_id=msg.notify_id, pool=msg.pool, oid=msg.oid,
+                    cookie=msg.cookie,
+                ))
+            except (OSError, ConnectionError):
+                pass
+            return True
         return False
+
+    # -- watch / notify (linger ops) ---------------------------------------
+    def watch(self, pool_id: int, oid: str, callback) -> int:
+        with self._lock:
+            self._cookie += 1
+            cookie = self._cookie
+        self._watches[(pool_id, oid, cookie)] = {"callback": callback}
+        try:
+            rep = self.op_submit(pool_id, oid, "watch",
+                                 data={"cookie": cookie})
+        except Exception:
+            # a failed registration must not leave a phantom entry that
+            # the next map push re-lingers behind the caller's back
+            self._watches.pop((pool_id, oid, cookie), None)
+            raise
+        if rep.retval != 0:
+            self._watches.pop((pool_id, oid, cookie), None)
+            raise IOError(f"watch {oid!r}: {rep.retval} {rep.result}")
+        return cookie
+
+    def unwatch(self, pool_id: int, oid: str, cookie: int) -> None:
+        self._watches.pop((pool_id, oid, cookie), None)
+        self.op_submit(pool_id, oid, "unwatch", data={"cookie": cookie})
+
+    def _relinger(self) -> None:
+        """Re-register every lingering watch (reference: the Objecter
+        resends linger ops after a map change, which is what makes a
+        watch survive primary failover — the new primary has no
+        in-memory watch state until we tell it)."""
+        for (pool_id, oid, cookie) in list(self._watches):
+            try:
+                self.op_submit(pool_id, oid, "watch",
+                               data={"cookie": cookie}, attempts=2)
+            except (ConnectionError, OSError):
+                pass  # next map change retries
 
     # -- targeting ---------------------------------------------------------
     def _calc_target(
@@ -179,3 +289,4 @@ class Objecter(Dispatcher):
             self.mc.wait_for_osdmap(min_epoch=want, timeout=3.0)
         except TimeoutError:
             pass
+

@@ -12,7 +12,7 @@ SURVEY.md §2.6).
 from __future__ import annotations
 
 from ..mon.mon_client import MonClient
-from ..osd.messages import unpack_data
+from ..osd.messages import pack_data, unpack_data
 from .objecter import Objecter
 
 
@@ -64,6 +64,75 @@ class IoCtx:
         if rep.retval != 0:
             raise IOError(f"read {oid!r}: {rep.retval} {rep.result}")
         return unpack_data(rep.data) or b""
+
+    # -- omap (reference: rados_omap_* — replicated pools only) -----------
+    def omap_set(self, oid: str, kv: dict[str, bytes]) -> None:
+        rep = self._client.objecter.op_submit(
+            self.pool_id, oid, "omap_set",
+            data={"keys": {k: pack_data(bytes(v)) for k, v in kv.items()}},
+        )
+        if rep.retval != 0:
+            raise IOError(f"omap_set {oid!r}: {rep.retval} {rep.result}")
+
+    def omap_get(self, oid: str, keys=None) -> dict[str, bytes]:
+        """All pairs (keys=None) or just `keys` (reference:
+        omap_get_vals_by_keys)."""
+        rep = self._client.objecter.op_submit(
+            self.pool_id, oid, "omap_get",
+            data={"keys": list(keys) if keys is not None else None},
+        )
+        if rep.retval != 0:
+            raise IOError(f"omap_get {oid!r}: {rep.retval} {rep.result}")
+        return {k: unpack_data(v) for k, v in rep.result["kv"].items()}
+
+    def omap_get_vals(self, oid: str, after: str = "",
+                      max_return: int = 512) -> dict[str, bytes]:
+        """Paginated scan: keys strictly greater than `after`, up to
+        `max_return` (reference: rados_omap_get_vals)."""
+        rep = self._client.objecter.op_submit(
+            self.pool_id, oid, "omap_get",
+            data={"after": after, "max": max_return},
+        )
+        if rep.retval != 0:
+            raise IOError(f"omap_get_vals {oid!r}: {rep.retval} {rep.result}")
+        return {k: unpack_data(v) for k, v in rep.result["kv"].items()}
+
+    def omap_rm_keys(self, oid: str, keys) -> None:
+        rep = self._client.objecter.op_submit(
+            self.pool_id, oid, "omap_rm", data={"keys": list(keys)},
+        )
+        if rep.retval != 0:
+            raise IOError(f"omap_rm {oid!r}: {rep.retval} {rep.result}")
+
+    def omap_clear(self, oid: str) -> None:
+        rep = self._client.objecter.op_submit(
+            self.pool_id, oid, "omap_clear", data={},
+        )
+        if rep.retval != 0:
+            raise IOError(f"omap_clear {oid!r}: {rep.retval} {rep.result}")
+
+    # -- watch / notify (reference: rados_watch3 / rados_notify2) ---------
+    def watch(self, oid: str, callback) -> int:
+        """Register a watch; `callback(notify_id, cookie, data: bytes)`
+        fires for each notify.  Returns the watch cookie.  The watch
+        lingers: the Objecter re-registers it after a map change, so it
+        survives primary failover (reference: linger ops)."""
+        return self._client.objecter.watch(self.pool_id, oid, callback)
+
+    def unwatch(self, oid: str, cookie: int) -> None:
+        self._client.objecter.unwatch(self.pool_id, oid, cookie)
+
+    def notify(self, oid: str, data: bytes = b"",
+               timeout: float = 5.0) -> dict:
+        """Fire a notify and collect watcher acks; returns
+        {"acked": [cookies], "missed": [cookies]}."""
+        rep = self._client.objecter.op_submit(
+            self.pool_id, oid, "notify",
+            data={"payload": pack_data(bytes(data)), "timeout": timeout},
+        )
+        if rep.retval != 0:
+            raise IOError(f"notify {oid!r}: {rep.retval} {rep.result}")
+        return rep.result
 
     # -- pool snapshots (reference: rados_ioctx_snap_create/remove etc.) --
     def _pool(self):

@@ -69,6 +69,17 @@ class ErasureCodeInterface(ABC):
     def get_chunk_mapping(self) -> list[int]:
         return []
 
+    def supports_parity_delta(self) -> bool:
+        """True iff encode_chunks is BYTE-COLUMN-LOCAL and chunk
+        placement is the identity split: parity byte at column c depends
+        only on the k data bytes at column c.  That is exactly the
+        property the OSD's partial-stripe RMW parity-delta relies on
+        (delta window encode XORed into stored parity).  Packet-based
+        bitmatrix techniques, sub-chunked codes (CLAY), and
+        position-remapped codes (LRC) must return False — for them the
+        OSD falls back to full-stripe re-encode."""
+        return False
+
     def decode_concat(self, chunks: dict[int, np.ndarray]) -> bytes:
         """Reassemble the original byte stream from data chunks (reference:
         ErasureCode.cc :: decode_concat)."""

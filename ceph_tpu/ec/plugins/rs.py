@@ -89,6 +89,11 @@ class RSCodec(ErasureCode):
             self._jax_codec = BitplaneCodec(self.coding)
 
     # -- hot path (reference: ErasureCodeInterface.h :: encode_chunks) ----
+    def supports_parity_delta(self) -> bool:
+        # byte-wise GF matrix apply: strictly column-local, identity
+        # placement — safe for the OSD's RMW parity-delta
+        return True
+
     def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
         data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
         if self.backend == "jax":

@@ -66,6 +66,9 @@ class ShecCodec(ErasureCode):
         self.window = -(-self.k * self.c // self.m)
 
     # -- encode -----------------------------------------------------------
+    def supports_parity_delta(self) -> bool:
+        return True  # byte-matrix apply, column-local, identity layout
+
     def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
         from ...ops.bitplane import apply_matrix_jax
 

@@ -122,8 +122,11 @@ class FSClient(Dispatcher):
     def mount(self, timeout: float = 10.0) -> None:
         self.messenger.start()
         self._conn = self.messenger.connect(self.mds_addr)
+        # the session id (not the display name) is the identity: the MDS
+        # keys its per-session reply cache and open-session set on it, so
+        # open/close and every request must all use the SAME identifier
         self._conn.send_message(
-            MClientSession(op="request_open", client=self.name)
+            MClientSession(op="request_open", client=self._session)
         )
         with self._lock:
             if not self._cond.wait_for(lambda: self._session_open, timeout):
@@ -133,7 +136,7 @@ class FSClient(Dispatcher):
         try:
             if self._conn is not None:
                 self._conn.send_message(
-                    MClientSession(op="request_close", client=self.name)
+                    MClientSession(op="request_close", client=self._session)
                 )
         except (OSError, ConnectionError):
             pass

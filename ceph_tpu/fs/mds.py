@@ -4,9 +4,11 @@ MDCache, MDLog, CInode/CDir/CDentry; SURVEY.md §2.6 "CephFS").
 Faithful structural choices:
 
 - The namespace lives in RADOS objects in a *metadata pool*: one dirfrag
-  object per directory (``dir.{ino:x}``) whose entries embed the child
-  inode — the reference's primary-dentry-embeds-inode layout
-  (src/mds/CDentry.h).  Hardlinks (remote dentries) are out of scope.
+  object per directory (``dir.{ino:x}``) whose OMAP holds one key per
+  dentry with the child inode embedded in the value — the reference's
+  dirfrag omap layout (src/mds/CDir.cc stores dentries as omap keys of
+  the dir object; primary dentry embeds the inode, src/mds/CDentry.h).
+  Hardlinks (remote dentries) are out of scope.
 - Updates are journaled before dirfrags are flushed (src/mds/MDLog.cc:
   EUpdate events into journal segments stored as RADOS objects); a
   restarted MDS replays segments newer than the last flush point, so
@@ -56,6 +58,13 @@ class MDSDaemon(Dispatcher):
         self.backptr: dict[int, tuple[int, str]] = {}  # ino -> (parent, name)
         self.next_ino = ROOT_INO + 1
         self._dirty: set[int] = set()  # dirfrags awaiting flush
+        # per-dirfrag dentry deltas (name -> inode | None=removed): the
+        # flush writes only changed omap keys, not the whole directory
+        # (reference: CDir commits dirty dentries, not full dirfrags)
+        self._dirty_names: dict[int, dict[str, dict | None]] = {}
+        # dirfrags needing a full clear+rewrite (newly created dirs,
+        # whose omap object must exist even when empty so _load finds it)
+        self._dirty_full: set[int] = set()
         self._seg_seq = 0   # current journal segment (MDLog)
         self._seg_idx = 0   # next event slot within the segment
         self._first_seg = 0
@@ -91,11 +100,17 @@ class MDSDaemon(Dispatcher):
             if not oid.startswith("dir."):
                 continue
             ino = int(oid[4:], 16)
-            entries = self._obj_read(oid) or {}
-            self.dirs[ino] = entries
+            try:
+                kv = self._io.omap_get(oid)
+            except IOError:
+                kv = {}
+            self.dirs[ino] = {
+                name: json.loads(v) for name, v in kv.items()
+            }
         if ROOT_INO not in self.dirs:
             self.dirs[ROOT_INO] = {}
             self._dirty.add(ROOT_INO)
+            self._dirty_full.add(ROOT_INO)
         # backptrs must exist BEFORE replay: a replayed setattr resolves
         # its inode through backptr, and inodes living in flushed dirfrags
         # are invisible to it otherwise (their size/mtime updates would be
@@ -130,14 +145,36 @@ class MDSDaemon(Dispatcher):
         """Flush dirty dirfrags + inotable, then trim the journal
         (reference: MDLog segment expiry writing back dirty CDirs)."""
         for ino in sorted(self._dirty):
-            if ino in self.dirs:
-                self._obj_write(f"dir.{ino:x}", self.dirs[ino])
-            else:
+            oid = f"dir.{ino:x}"
+            if ino not in self.dirs:
                 try:
-                    self._io.remove(f"dir.{ino:x}")
+                    self._io.remove(oid)
                 except IOError:
                     pass
+                continue
+            if ino in self._dirty_full:
+                # new dirfrag: create its omap object (clear creates via
+                # touch) and write everything
+                self._io.omap_clear(oid)
+                if self.dirs[ino]:
+                    self._io.omap_set(oid, {
+                        name: json.dumps(inode).encode()
+                        for name, inode in self.dirs[ino].items()
+                    })
+                continue
+            # delta flush: only the dentries that changed since the last
+            # flush — O(change), not O(directory)
+            ops = self._dirty_names.get(ino, {})
+            sets = {n: json.dumps(i).encode()
+                    for n, i in ops.items() if i is not None}
+            rms = [n for n, i in ops.items() if i is None]
+            if sets:
+                self._io.omap_set(oid, sets)
+            if rms:
+                self._io.omap_rm_keys(oid, rms)
         self._dirty.clear()
+        self._dirty_names.clear()
+        self._dirty_full.clear()
         self._obj_write("mds_inotable", {"next_ino": self.next_ino})
         self._first_seg = self._seg_seq
         self._obj_write("mds_head", {"first_seg": self._first_seg})
@@ -175,6 +212,12 @@ class MDSDaemon(Dispatcher):
             self._flush()
 
     # -- event application (shared by live ops and replay) ----------------
+    def _mark(self, dino: int, name: str, inode: dict | None) -> None:
+        """Record one dentry delta for the flush (None = removed)."""
+        self._dirty.add(dino)
+        if dino not in self._dirty_full:
+            self._dirty_names.setdefault(dino, {})[name] = inode
+
     def _apply(self, ev: dict) -> None:
         kind = ev["e"]
         if kind == "link":  # create/mkdir: insert dentry with embedded inode
@@ -183,9 +226,10 @@ class MDSDaemon(Dispatcher):
             if inode["type"] == "dir":
                 self.dirs.setdefault(inode["ino"], {})
                 self._dirty.add(inode["ino"])
+                self._dirty_full.add(inode["ino"])  # create the omap obj
             self.backptr[inode["ino"]] = (parent, name)
             self.next_ino = max(self.next_ino, inode["ino"] + 1)
-            self._dirty.add(parent)
+            self._mark(parent, name, inode)
         elif kind == "unlink":
             parent, name = ev["parent"], ev["name"]
             inode = self.dirs.get(parent, {}).pop(name, None)
@@ -194,11 +238,14 @@ class MDSDaemon(Dispatcher):
                 if inode["type"] == "dir":
                     self.dirs.pop(inode["ino"], None)
                     self._dirty.add(inode["ino"])
-            self._dirty.add(parent)
+            self._mark(parent, name, None)
         elif kind == "rename":
             sdir, sname = ev["srcdir"], ev["sname"]
             ddir, dname = ev["dstdir"], ev["dname"]
             inode = self.dirs.get(sdir, {}).pop(sname, None)
+            # src removal marked BEFORE the dst set so a same-path rename
+            # nets out to the set, not the removal
+            self._mark(sdir, sname, None)
             if inode is not None:
                 replaced = self.dirs.setdefault(ddir, {}).get(dname)
                 if replaced is not None:
@@ -208,12 +255,13 @@ class MDSDaemon(Dispatcher):
                         self._dirty.add(replaced["ino"])
                 self.dirs[ddir][dname] = inode
                 self.backptr[inode["ino"]] = (ddir, dname)
-            self._dirty.update((sdir, ddir))
+                self._mark(ddir, dname, inode)
         elif kind == "setattr":
             ino = ev["ino"]
             bp = self.backptr.get(ino)
             if bp is not None:
                 inode = self.dirs[bp[0]][bp[1]]
+                self._mark(bp[0], bp[1], inode)
                 for f in ("size", "mtime"):
                     if ev.get(f) is not None:
                         inode[f] = ev[f]

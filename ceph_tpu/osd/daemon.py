@@ -52,6 +52,8 @@ from ..store.memstore import MemStore
 from ..store.object_store import NotFound, Transaction
 from .messages import (
     MECSubOpRead,
+    MWatchNotify,
+    MWatchNotifyAck,
     MECSubOpReadReply,
     MECSubOpWrite,
     MECSubOpWriteReply,
@@ -108,8 +110,25 @@ CLONE_SEP = "\x02"
 # client ops covered by reqid dup detection (mutations whose re-execution
 # on a resend would be wrong or wasteful)
 MUTATING_OPS = frozenset(
-    {"write_full", "write", "append", "delete", "setxattr"}
+    {"write_full", "write", "append", "delete", "setxattr",
+     "omap_set", "omap_rm", "omap_clear"}
 )
+
+
+def _current_generation(chunks: dict, vers: dict) -> dict:
+    """Drop stale-GENERATION chunks: shards versioned below the newest
+    version seen carry pre-RMW bytes that must never be mixed into a
+    decode (None = wildcard, e.g. backfill-rebuilt).  The newest seen is
+    authoritative — no shard can be stamped above the last
+    primary-serialized write."""
+    present = [v for v in vers.values() if v is not None]
+    if not present:
+        return chunks
+    target = max(present)
+    return {
+        s: b for s, b in chunks.items()
+        if vers.get(s) is None or vers.get(s) == target
+    }
 
 
 class OSD(Dispatcher):
@@ -175,6 +194,13 @@ class OSD(Dispatcher):
         self._recovery_inflight = False
         self._split_inflight = False
         self._clone_mutex = make_lock("osd::snap_clone")
+        # watch/notify state (reference: PrimaryLogPG watchers): primary-
+        # local; clients re-register lingering watches on map change
+        self.watchers: dict[tuple, dict[int, str]] = {}
+        self._watch_lock = threading.Lock()
+        self._client_conns: dict[str, object] = {}
+        self._watch_cond = threading.Condition()
+        self._notify_acks: dict[tuple[int, int], bool] = {}
         self._last_scrub = 0.0
         self._scrubs_queued: set[str] = set()
         # reference: OSD::create_logger (l_osd_op / l_osd_op_w / ...)
@@ -388,11 +414,31 @@ class OSD(Dispatcher):
     # -- dispatch ----------------------------------------------------------
     def ms_dispatch(self, conn, msg) -> bool:
         if isinstance(msg, MOSDOp):
+            src = getattr(msg, "src", None)
+            if src is not None:
+                # notify fan-out reaches a watcher over the SAME
+                # connection its ops arrive on (reference: the watch's
+                # Session connection).  Bounded: oldest client entries
+                # are dropped (their watches re-linger on the next map)
+                self._client_conns.pop(src, None)
+                self._client_conns[src] = conn  # re-insert: LRU position
+                if len(self._client_conns) > 512:
+                    self._client_conns.pop(
+                        next(iter(self._client_conns)), None)
             # client ops flow through the mClock queue (reference:
             # OSD::ms_fast_dispatch -> op_shardedwq enqueue)
             self.scheduler.enqueue(
                 "client", lambda: self._handle_client_op(conn, msg)
             )
+            return True
+        if isinstance(msg, MWatchNotifyAck):
+            with self._watch_cond:
+                self._notify_acks[(msg.notify_id, msg.cookie)] = True
+                # bound the ack ledger (ids are monotonic; stale ones
+                # are dead after their notify's timeout)
+                while len(self._notify_acks) > 4096:
+                    self._notify_acks.pop(next(iter(self._notify_acks)))
+                self._watch_cond.notify_all()
             return True
         if isinstance(msg, MECSubOpWrite):
             self._handle_sub_write(conn, msg)
@@ -533,7 +579,15 @@ class OSD(Dispatcher):
                 guard = threading.Event()
                 prior = pg.inflight.setdefault(reqid, guard)
                 if prior is guard:
-                    break  # we own the slot
+                    # we own the slot — but the original may have
+                    # COMPLETED between our _check_dup miss and now
+                    # (check-then-act): re-check before executing
+                    rep = self._check_dup(pg, pool, acting, msg, reqid)
+                    if rep is not None:
+                        pg.inflight.pop(reqid, None)
+                        guard.set()
+                        return rep
+                    break
                 if not prior.wait(60.0):
                     # original STILL running (e.g. a long degraded
                     # splice): executing now would double-apply — refuse
@@ -617,6 +671,12 @@ class OSD(Dispatcher):
         )
 
     def _execute_routed_op(self, pg, pool, acting, ps, msg) -> MOSDOpReply:
+        if msg.op == "write" and int(msg.off or 0) < 0:
+            # reference: negative offsets are -EINVAL; Python slicing
+            # would otherwise silently splice into the object's tail
+            return MOSDOpReply(tid=msg.tid, retval=-22,
+                               epoch=self.my_epoch(),
+                               result="negative write offset")
         # pool snapshots (reference: make_writeable's clone-on-write +
         # SnapSet resolution in PrimaryLogPG)
         # clone against the newest LIVE snap (snap_seq never resets, and
@@ -969,6 +1029,14 @@ class OSD(Dispatcher):
                                result={"oids": oids})
         if msg.op in ("setxattr", "getxattrs"):
             return self._xattr_op(pg, acting, my_shard, msg)
+        if msg.op.startswith("omap_"):
+            # reference parity: EC pools do not support omap
+            # (PrimaryLogPG::do_osd_ops returns -EOPNOTSUPP)
+            return MOSDOpReply(tid=msg.tid, retval=-95,
+                               epoch=self.my_epoch(),
+                               result="omap not supported on EC pools")
+        if msg.op in ("watch", "unwatch", "notify"):
+            return self._watch_op(pg, pool, msg)
         return MOSDOpReply(tid=msg.tid, retval=-22, epoch=self.my_epoch(),
                            result=f"bad op {msg.op}")
 
@@ -1358,7 +1426,14 @@ class OSD(Dispatcher):
             sub_chunks = codec.get_sub_chunk_count()
         except Exception:
             pass
-        if size == 0 or end > k * L or sub_chunks != 1:
+        try:
+            delta_ok = bool(codec.supports_parity_delta())
+        except Exception:
+            delta_ok = False
+        if size == 0 or end > k * L or sub_chunks != 1 or not delta_ok:
+            # codecs whose encode is not byte-column-local (bitmatrix
+            # packet techniques, CLAY sub-chunks, LRC remapping) re-encode
+            # the full stripe — a windowed delta would corrupt parity
             return self._ec_full_splice(pg, pool, codec, acting, my_shard,
                                         msg, data, off, size)
         # local pre-validation: the delta fast path needs the primary's
@@ -1677,22 +1752,7 @@ class OSD(Dispatcher):
             vers=vers,
         )
 
-        def current_only(chunks: dict) -> dict:
-            """Drop stale-GENERATION chunks: shards versioned below the
-            newest version seen carry pre-RMW bytes that must never be
-            mixed into a decode (None = wildcard, e.g. backfill-rebuilt).
-            The newest seen is authoritative — no shard can be stamped
-            above the last primary-serialized write."""
-            present = [v for v in vers.values() if v is not None]
-            if not present:
-                return chunks
-            target = max(present)
-            return {
-                s: b for s, b in chunks.items()
-                if vers.get(s) is None or vers.get(s) == target
-            }
-
-        got = current_only(got)
+        got = _current_generation(got, vers)
         missing = want_data - set(got)
         if missing:
             # degraded: consult minimum_to_decode over everything
@@ -1702,7 +1762,7 @@ class OSD(Dispatcher):
                 sizes=peer_sizes, vers=vers, stray=True,
             )
             avail_probe.update(got)
-            avail_probe = current_only(avail_probe)
+            avail_probe = _current_generation(avail_probe, vers)
             if len(avail_probe) < k:
                 return MOSDOpReply(
                     tid=msg.tid, retval=-5, epoch=self.my_epoch(),
@@ -1900,8 +1960,192 @@ class OSD(Dispatcher):
                                result={"oids": oids})
         if msg.op in ("setxattr", "getxattrs"):
             return self._xattr_op(pg, acting, 0, msg)
+        if msg.op.startswith("omap_"):
+            return self._omap_op(pg, pool, acting, msg)
+        if msg.op in ("watch", "unwatch", "notify"):
+            return self._watch_op(pg, pool, msg)
         return MOSDOpReply(tid=msg.tid, retval=-22, epoch=self.my_epoch(),
                            result=f"bad op {msg.op}")
+
+    # .. omap (replicated pools only, like the reference) ..................
+    def _omap_op(self, pg, pool, acting, msg) -> MOSDOpReply:
+        """librados omap surface (reference: rados_omap_get_vals /
+        omap_set / omap_rm_keys / omap_clear, executed by
+        PrimaryLogPG::do_osd_ops OMAP* cases).  Key-value pairs ride the
+        object; mutations replicate and log exactly like xattr updates,
+        and recovery pushes carry a full omap snapshot."""
+        cid = self._cid(pg.pgid, 0)
+        args = msg.data or {}
+        if msg.op == "omap_get":
+            try:
+                self.store.stat(cid, msg.oid)
+            except (NotFound, KeyError):
+                return MOSDOpReply(tid=msg.tid, retval=-2,
+                                   epoch=self.my_epoch(), result="not found")
+            kv = self.store.omap_get(cid, msg.oid)
+            want = args.get("keys")
+            if want is not None:
+                kv = {k: v for k, v in kv.items() if k in want}
+            else:
+                after = args.get("after") or ""
+                maxn = int(args.get("max") or 0)
+                keys = sorted(k for k in kv if k > after)
+                if maxn:
+                    keys = keys[:maxn]
+                kv = {k: kv[k] for k in keys}
+            return MOSDOpReply(
+                tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                result={"kv": {k: pack_data(v) for k, v in kv.items()}},
+            )
+        # mutations
+        omap_payload = None
+        if msg.op == "omap_set":
+            omap_payload = {"set": args.get("keys") or {}}
+        elif msg.op == "omap_rm":
+            omap_payload = {"rm": list(args.get("keys") or [])}
+        elif msg.op == "omap_clear":
+            omap_payload = {"clear": True}
+        else:
+            return MOSDOpReply(tid=msg.tid, retval=-22,
+                               epoch=self.my_epoch(),
+                               result=f"bad op {msg.op}")
+        with pg.lock:
+            version = pg.version + 1
+            entry = LogEntry(version, "modify", msg.oid,
+                             reqid=getattr(msg, "reqid", None))
+            tids: dict[int, int] = {}
+            for shard, osd in enumerate(acting):
+                if osd == self.id or osd < 0 or not self.osdmap.is_up(osd):
+                    continue
+                tid = self._next_tid()
+                tids[tid] = shard
+                try:
+                    self._conn_to_osd(osd).send_message(MECSubOpWrite(
+                        tid=tid, pgid=pg.pgid, oid=msg.oid, shard=0,
+                        data=None, crc=None, version=version,
+                        entry=entry.to_list(), epoch=self.my_epoch(),
+                        omap=omap_payload,
+                    ))
+                except (OSError, ConnectionError):
+                    tids.pop(tid, None)
+            t = Transaction()
+            t.try_create_collection(cid)
+            t.touch(cid, msg.oid)  # omap on a fresh oid creates it
+            self._apply_omap(t, cid, msg.oid, omap_payload)
+            # stamp the object version: _check_dup's applied-resend
+            # verification counts shards holding ver >= v (replicated
+            # pools never generation-filter reads, so this is safe)
+            t.setattr(cid, msg.oid, "ver", str(version).encode())
+            self._log_txn(t, cid, pg, entry)
+            self.store.queue_transaction(t)
+            acked = 1
+            for tid in tids:
+                rep = self._wait_reply(tid)
+                if rep is not None and rep.retval == 0:
+                    acked += 1
+        if acked < pool.min_size:
+            return MOSDOpReply(tid=msg.tid, retval=-11,
+                               epoch=self.my_epoch(),
+                               result={"applied": pg.version, "acked": acked,
+                                       "error": "below min_size commits"})
+        return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                           result={"version": pg.version})
+
+    def _apply_omap(self, t: Transaction, cid: str, oid: str,
+                    payload: dict) -> None:
+        if payload.get("snapshot") is not None:
+            # recovery push: the dict IS the whole omap
+            t.omap_clear(cid, oid)
+            t.omap_setkeys(cid, oid, {
+                k: unpack_data(v) for k, v in payload["snapshot"].items()
+            })
+            return
+        if payload.get("clear"):
+            t.omap_clear(cid, oid)
+        if payload.get("set"):
+            t.omap_setkeys(cid, oid, {
+                k: unpack_data(v) for k, v in payload["set"].items()
+            })
+        if payload.get("rm"):
+            t.omap_rmkeys(cid, oid, payload["rm"])
+
+    # .. watch / notify ....................................................
+    def _watch_op(self, pg, pool, msg) -> MOSDOpReply:
+        """Object watch/notify (reference: PrimaryLogPG watch/notify +
+        MWatchNotify).  Watch state is primary-local and in-memory; the
+        client's Objecter re-registers lingering watches after a map
+        change (reference: linger ops re-sent by Objecter), which covers
+        primary failover."""
+        args = msg.data or {}
+        key = (msg.pool, msg.oid)
+        if msg.op == "watch":
+            cookie = int(args.get("cookie") or 0)
+            with self._watch_lock:
+                self.watchers.setdefault(key, {})[cookie] = (
+                    getattr(msg, "src", None))
+            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                               result={"cookie": cookie})
+        if msg.op == "unwatch":
+            cookie = int(args.get("cookie") or 0)
+            with self._watch_lock:
+                ws = self.watchers.get(key, {})
+                ws.pop(cookie, None)
+                if not ws:
+                    self.watchers.pop(key, None)
+            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
+                               result={})
+        # notify: fan out to every watcher, collect acks with a timeout
+        notify_id = self._next_tid()
+        payload = args.get("payload")
+        timeout = float(args.get("timeout") or 5.0)
+        with self._watch_lock:
+            targets = dict(self.watchers.get(key, {}))
+        pending = {}
+        dead = []
+        for cookie, src in targets.items():
+            conn = self._client_conns.get(src)
+            if conn is None:
+                dead.append(cookie)
+                continue
+            try:
+                conn.send_message(MWatchNotify(
+                    notify_id=notify_id, pool=msg.pool, oid=msg.oid,
+                    cookie=cookie, data=payload,
+                ))
+                pending[cookie] = src
+            except (OSError, ConnectionError):
+                dead.append(cookie)
+        if dead:
+            # a watcher whose connection is gone is expired (reference:
+            # watch timeout reaps dead watchers); its client re-lingers
+            # on the next map push if it is actually alive
+            with self._watch_lock:
+                ws = self.watchers.get(key, {})
+                for cookie in dead:
+                    ws.pop(cookie, None)
+                if not ws:
+                    self.watchers.pop(key, None)
+        acked, missed = [], []
+        deadline = time.monotonic() + timeout
+        for cookie in pending:
+            remain = max(0.0, deadline - time.monotonic())
+            if self._wait_notify_ack(notify_id, cookie, remain):
+                acked.append(cookie)
+            else:
+                missed.append(cookie)
+        return MOSDOpReply(
+            tid=msg.tid, retval=0, epoch=self.my_epoch(),
+            result={"notify_id": notify_id, "acked": acked,
+                    "missed": missed},
+        )
+
+    def _wait_notify_ack(self, notify_id: int, cookie: int,
+                         timeout: float) -> bool:
+        with self._watch_cond:
+            return self._watch_cond.wait_for(
+                lambda: (notify_id, cookie) in self._notify_acks,
+                timeout=timeout,
+            )
 
     # -- shard sub-ops -----------------------------------------------------
     def _handle_sub_write(self, conn, msg: MECSubOpWrite) -> None:
@@ -2049,6 +2293,19 @@ class OSD(Dispatcher):
                             self._apply_xattr_updates(
                                 t, cid, msg.oid, msg.xattrs
                             )
+                if getattr(msg, "omap", None) is not None:
+                    # live omap mutation or recovery snapshot: omap
+                    # exists on replicated pools only; an omap op on a
+                    # fresh oid creates the object (touch), matching the
+                    # primary's transaction
+                    t.touch(cid, msg.oid)
+                    self._apply_omap(t, cid, msg.oid, msg.omap)
+                    if (msg.data is None and msg.version is not None
+                            and msg.version == pg.version + 1):
+                        # live omap-only update on a log-contiguous
+                        # shard: stamp the version for dup verification
+                        t.setattr(cid, msg.oid, "ver",
+                                  str(msg.version).encode())
                 if (
                     msg.entry is not None
                     and msg.version is not None
@@ -2812,14 +3069,54 @@ class OSD(Dispatcher):
                 return  # retry next tick; judging peers now would be wrong
         if pg.version == 0:
             return  # nothing written yet
-        try:
-            my_oids = {
-                o for o in self.store.list_objects(self._cid(
-                    pg.pgid, acting.index(self.id) if is_ec else 0))
-                if not o.startswith("_")
-            }
-        except (NotFound, KeyError):
-            my_oids = set()
+        my_shard = acting.index(self.id) if is_ec else 0
+        my_cid = self._cid(pg.pgid, my_shard)
+
+        def _my_oids() -> set:
+            try:
+                return {
+                    o for o in self.store.list_objects(my_cid)
+                    if not o.startswith("_")
+                }
+            except (NotFound, KeyError):
+                return set()
+
+        my_oids = _my_oids()
+        # phase 0.5 — SELF role-heal: an acting permutation can hand this
+        # primary a shard role it never held; every peer below is judged
+        # against MY collection, so an empty one would read as
+        # everything-clean while the primary serves nothing.  Pull full
+        # content from an up-to-date peer — the donor's backfill push
+        # carries data + xattrs + omap and deletes my stale extras
+        # (reference: the primary recovers itself first in
+        # PeeringState::activate / recovery_state).
+        peer_union: set = set()
+        for (_v, oids) in peers.values():
+            peer_union.update(oids)
+        if peer_union - my_oids:
+            donor = next(
+                (osd for (shard, osd), (v, _o) in peers.items()
+                 if v >= pg.version),
+                None,
+            )
+            if donor is not None:
+                self.cct.dout(
+                    "osd", 1,
+                    f"{self.whoami} self role-heal {pg.pgid} shard "
+                    f"{my_shard}: {len(peer_union - my_oids)} objects "
+                    f"from osd.{donor}",
+                )
+                tid = self._next_tid()
+                try:
+                    self._conn_to_osd(donor).send_message(MPGPull(
+                        tid=tid, pgid=pg.pgid, shard=my_shard,
+                        from_version=0, epoch=self.my_epoch(),
+                        have_oids=sorted(my_oids),
+                    ))
+                    self._wait_reply(tid, timeout=30.0)
+                except (OSError, ConnectionError):
+                    pass
+                my_oids = _my_oids()
         # push phase: serialize vs concurrent client writes on this PG
         with pg.lock:
             for (shard, osd), (peer_ver, peer_oids) in peers.items():
@@ -2963,6 +3260,7 @@ class OSD(Dispatcher):
         through xattr-only modifies (which don't change stripe bytes)."""
         xattrs = None
         gen = None
+        omap = None
         if data is not None and src_cid is not None:
             gen = self._stored_ver(src_cid, oid)
             try:
@@ -2975,6 +3273,13 @@ class OSD(Dispatcher):
                 n[2:]: pack_data(v)
                 for n, v in mine.items() if n.startswith("u_")
             }
+            try:
+                kv = self.store.omap_get(src_cid, oid)
+            except (NotFound, KeyError):
+                kv = {}
+            # omap recovered as a full snapshot, like the xattrs — sent
+            # even when empty so a replica's stale keys are cleared
+            omap = {"snapshot": {k: pack_data(v) for k, v in kv.items()}}
         tid = self._next_tid()
         try:
             self._conn_to_osd(osd).send_message(
@@ -2983,7 +3288,7 @@ class OSD(Dispatcher):
                     data=pack_data(data) if data is not None else None,
                     crc=crc32c(data) if data is not None else None,
                     version=version, entry=entry, epoch=self.my_epoch(),
-                    xattrs=xattrs, over=gen, osize=osize,
+                    xattrs=xattrs, over=gen, osize=osize, omap=omap,
                 )
             )
         except (OSError, ConnectionError):
@@ -3102,16 +3407,8 @@ class OSD(Dispatcher):
         vers: dict[int, int | None] = {}
         got = self._gather_chunks(pg, codec, acting, oid, want, sizes=sizes,
                                   vers=vers, stray=True)
-        # never rebuild from a MIX of stripe generations: drop shards
-        # versioned below the newest seen (None = wildcard), exactly as
-        # the read path does
-        present = [v for v in vers.values() if v is not None]
-        if present:
-            target = max(present)
-            got = {
-                s: b for s, b in got.items()
-                if vers.get(s) is None or vers.get(s) == target
-            }
+        # never rebuild from a MIX of stripe generations
+        got = _current_generation(got, vers)
         if len(got) < k:
             return None, 0
         try:

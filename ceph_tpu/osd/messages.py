@@ -82,11 +82,17 @@ class MECSubOpWrite(_JsonMessage):
     `over` is the object version the RMW transitions FROM: a shard whose
     stored per-object `ver` xattr differs refuses (it is stale and will
     be rebuilt by recovery), and one already at the target version acks
-    as a no-op (idempotent replay) — the object_info_t version guard."""
+    as a no-op (idempotent replay) — the object_info_t version guard.
+
+    `omap` carries omap mutations or a recovery snapshot:
+      {"set": {key: b64}, "rm": [key...], "clear": bool} applied in the
+      same transaction; {"snapshot": {key: b64}} replaces the whole omap
+      (recovery push, mirroring the xattr snapshot semantics)."""
 
     MSG_TYPE = 108
     FIELDS = ("tid", "pgid", "oid", "shard", "data", "crc", "version",
-              "entry", "epoch", "xattrs", "mode", "off", "over", "osize")
+              "entry", "epoch", "xattrs", "mode", "off", "over", "osize",
+              "omap")
 
 
 @register_message
@@ -182,3 +188,20 @@ class MScrubShardReply(_JsonMessage):
 
     MSG_TYPE = 115
     FIELDS = ("tid", "pgid", "shard", "objects")
+
+
+@register_message
+class MWatchNotify(_JsonMessage):
+    """Primary OSD → watcher client: a notify fired on a watched object
+    (reference: MWatchNotify carrying notify_id/cookie/payload).  The
+    watcher replies with MWatchNotifyAck so the notifier's collect
+    phase can complete (reference: notify_ack op)."""
+
+    MSG_TYPE = 118
+    FIELDS = ("notify_id", "pool", "oid", "cookie", "data")
+
+
+@register_message
+class MWatchNotifyAck(_JsonMessage):
+    MSG_TYPE = 119
+    FIELDS = ("notify_id", "pool", "oid", "cookie")

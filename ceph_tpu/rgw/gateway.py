@@ -100,11 +100,65 @@ class _Store:
     def _write_buckets(self, b: dict) -> None:
         self.meta.write_full("buckets", json.dumps(b).encode())
 
-    def index(self, bucket: str) -> dict:
-        return self._read_json(self.meta, f"idx.{bucket}", {})
+    # -- bucket index: omap on idx.{bucket} (reference: the cls_rgw
+    # bucket index objects in .rgw.buckets.index — one omap key per
+    # object, listed with paginated omap scans; round 2 kept this as a
+    # JSON blob, which could not scale past toy listings) ---------------
+    def _index_put(self, bucket: str, key: str, ent: dict) -> None:
+        self.meta.omap_set(
+            f"idx.{bucket}", {key: json.dumps(ent).encode()}
+        )
 
-    def _write_index(self, bucket: str, idx: dict) -> None:
-        self.meta.write_full(f"idx.{bucket}", json.dumps(idx).encode())
+    def _index_rm(self, bucket: str, key: str) -> None:
+        try:
+            self.meta.omap_rm_keys(f"idx.{bucket}", [key])
+        except IOError:
+            pass
+
+    def _index_get(self, bucket: str, key: str) -> dict | None:
+        try:
+            kv = self.meta.omap_get(f"idx.{bucket}", keys=[key])
+        except IOError:
+            return None
+        return json.loads(kv[key]) if key in kv else None
+
+    def _index_list(
+        self, bucket: str, prefix: str = "", marker: str = "",
+        maxn: int = 1000,
+    ) -> tuple[list[tuple[str, dict]], bool]:
+        """Sorted (key, entry) pairs after `marker` matching `prefix`,
+        at most `maxn`, plus a truncation flag — paginated omap scans,
+        never the whole index in one read."""
+        out: list[tuple[str, dict]] = []
+        if maxn == 0:
+            return out, False  # S3: max-keys=0 lists nothing
+        after = marker
+        if prefix and prefix[:-1] > marker:
+            # sorted keys: nothing below the prefix can match, so start
+            # the scan at the prefix minus its last character (strictly
+            # below every candidate, including `prefix` itself)
+            after = prefix[:-1]
+        while True:
+            try:
+                page = self.meta.omap_get_vals(
+                    f"idx.{bucket}", after=after, max_return=256
+                )
+            except IOError:
+                break
+            if not page:
+                break
+            for k in sorted(page):
+                after = k
+                if prefix and not k.startswith(prefix):
+                    if k > prefix:
+                        return out, False  # sorted: past the prefix range
+                    continue
+                if k <= marker:
+                    continue
+                if maxn and len(out) >= maxn:
+                    return out, True
+                out.append((k, json.loads(page[k])))
+        return out, False
 
     # -- bucket ops --------------------------------------------------------
     def create_bucket(self, bucket: str) -> bool:
@@ -114,7 +168,7 @@ class _Store:
                 return False
             b[bucket] = {"created": time.time()}
             self._write_buckets(b)
-            self._write_index(bucket, {})
+            self.meta.write_full(f"idx.{bucket}", b"")  # empty index obj
             return True
 
     def delete_bucket(self, bucket: str) -> int:
@@ -123,7 +177,7 @@ class _Store:
             b = self.buckets()
             if bucket not in b:
                 return -404
-            if self.index(bucket):
+            if self._index_list(bucket, maxn=1)[0]:
                 return -409
             del b[bucket]
             self._write_buckets(b)
@@ -155,32 +209,28 @@ class _Store:
             s = self._stream(bucket, key)
             s.truncate(0)
             s.write(body)
-            idx = self.index(bucket)
-            idx[key] = {
+            self._index_put(bucket, key, {
                 "size": len(body), "etag": etag, "mtime": time.time()
-            }
-            self._write_index(bucket, idx)
+            })
             return etag
 
     def get_object(self, bucket: str, key: str):
         with self.lock:
-            ent = self.index(bucket).get(key)
+            ent = self._index_get(bucket, key)
             if ent is None:
                 return None, None
             return self._stream(bucket, key).read(0, ent["size"]), ent
 
     def head_object(self, bucket: str, key: str):
         with self.lock:
-            return self.index(bucket).get(key)
+            return self._index_get(bucket, key)
 
     def delete_object(self, bucket: str, key: str) -> bool:
         with self.lock:
-            idx = self.index(bucket)
-            if key not in idx:
+            if self._index_get(bucket, key) is None:
                 return False
             self._stream(bucket, key).remove()
-            del idx[key]
-            self._write_index(bucket, idx)
+            self._index_rm(bucket, key)
             return True
 
     # -- multipart ---------------------------------------------------------
@@ -241,9 +291,9 @@ class _Store:
             etag = (
                 f"{hashlib.md5(md5s).hexdigest()}-{len(up['parts'])}"
             )
-            idx = self.index(bucket)
-            idx[key] = {"size": off, "etag": etag, "mtime": time.time()}
-            self._write_index(bucket, idx)
+            self._index_put(bucket, key, {
+                "size": off, "etag": etag, "mtime": time.time()
+            })
             # Parts are only deleted AFTER the index write and the record
             # drop: a crash anywhere up to here leaves record + parts
             # intact, so a restarted gateway can re-complete idempotently.
@@ -369,18 +419,14 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._error(400, "InvalidArgument")
             if max_keys < 0:
                 return self._error(400, "InvalidArgument")
-            idx = self.store.index(bucket)
-            keys = sorted(
-                k for k in idx
-                if k.startswith(prefix) and k > marker
+            entries, truncated = self.store._index_list(
+                bucket, prefix=prefix, marker=marker, maxn=max_keys
             )
-            truncated = max_keys > 0 and len(keys) > max_keys
-            keys = keys[:max_keys]
             items = "".join(
                 f"<Contents><Key>{_xml_escape(k)}</Key>"
-                f"<Size>{idx[k]['size']}</Size>"
-                f'<ETag>"{idx[k]["etag"]}"</ETag></Contents>'
-                for k in keys
+                f"<Size>{ent['size']}</Size>"
+                f'<ETag>"{ent["etag"]}"</ETag></Contents>'
+                for k, ent in entries
             )
             self._reply(200, (
                 '<?xml version="1.0"?><ListBucketResult>'

@@ -1,0 +1,167 @@
+"""librados omap + watch/notify through the ring-2 cluster (reference:
+src/librados omap_* ops + PrimaryLogPG watch/notify, qa watch_notify
+tests).  Omap mutations replicate, recover, and survive primary changes;
+watches linger across primary failover.
+"""
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.qa.vstart import LocalCluster
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with LocalCluster(n_mons=1, n_osds=4) as c:
+        c.create_replicated_pool("om", size=3)
+        c.create_ec_pool("omec", k=2, m=1)
+        yield c
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    return cluster.client()
+
+
+# -- omap --------------------------------------------------------------------
+
+def test_omap_roundtrip(cluster, client):
+    io = client.open_ioctx("om")
+    io.omap_set("o1", {"a": b"1", "b": b"2", "c": b"3"})
+    assert io.omap_get("o1") == {"a": b"1", "b": b"2", "c": b"3"}
+    assert io.omap_get("o1", keys=["b"]) == {"b": b"2"}
+    io.omap_rm_keys("o1", ["a"])
+    assert sorted(io.omap_get("o1")) == ["b", "c"]
+    io.omap_clear("o1")
+    assert io.omap_get("o1") == {}
+    # omap on a fresh oid creates the object (touch semantics)
+    assert "o1" in io.list_objects()
+
+
+def test_omap_pagination(cluster, client):
+    io = client.open_ioctx("om")
+    kv = {f"k{i:04d}": str(i).encode() for i in range(40)}
+    io.omap_set("pag", kv)
+    got, after = {}, ""
+    while True:
+        page = io.omap_get_vals("pag", after=after, max_return=7)
+        if not page:
+            break
+        assert len(page) <= 7
+        got.update(page)
+        after = max(page)
+    assert got == kv
+
+
+def test_omap_coexists_with_data_and_xattrs(cluster, client):
+    io = client.open_ioctx("om")
+    io.write_full("mix", b"payload")
+    io.omap_set("mix", {"idx": b"entry"})
+    io.set_xattr("mix", "tag", b"t")
+    assert io.read("mix") == b"payload"
+    assert io.omap_get("mix") == {"idx": b"entry"}
+    io.write("mix", b"PAY", off=0)  # RMW must not disturb omap
+    assert io.omap_get("mix") == {"idx": b"entry"}
+    io.remove("mix")
+    with pytest.raises(IOError):
+        io.omap_get("mix")
+
+
+def test_omap_rejected_on_ec_pool(cluster, client):
+    io = client.open_ioctx("omec")
+    with pytest.raises(IOError) as ei:
+        io.omap_set("x", {"k": b"v"})
+    assert "-95" in str(ei.value) or "not supported" in str(ei.value)
+
+
+def test_omap_recovery_after_kill(cluster):
+    with LocalCluster(n_mons=1, n_osds=4) as c:
+        c.create_replicated_pool("omr", size=3)
+        cl = c.client()
+        io = cl.open_ioctx("omr")
+        io.omap_set("bucketidx", {f"obj{i}": b"meta" for i in range(10)})
+        # a replica misses further updates while down
+        victim = 3
+        c.kill_osd(victim)
+        c.mark_osd_down_out(victim)
+        time.sleep(0.5)
+        io.omap_set("bucketidx", {"late": b"update"})
+        io.omap_rm_keys("bucketidx", ["obj0"])
+        c.revive_osd(victim)
+        c.mark_osd_in_up(victim)
+        c.wait_clean("omr")
+        want = {f"obj{i}": b"meta" for i in range(1, 10)}
+        want["late"] = b"update"
+        assert io.omap_get("bucketidx") == want
+        cl.shutdown()
+
+
+# -- watch / notify -----------------------------------------------------------
+
+def test_watch_notify_roundtrip(cluster, client):
+    io = client.open_ioctx("om")
+    io.write_full("watched", b"x")
+    seen = []
+    ev = threading.Event()
+
+    def cb(notify_id, cookie, data):
+        seen.append((cookie, data))
+        ev.set()
+
+    cookie = io.watch("watched", cb)
+    res = io.notify("watched", b"hello", timeout=5.0)
+    assert cookie in res["acked"] and not res["missed"]
+    assert ev.wait(5.0)
+    assert seen and seen[0][1] == b"hello"
+    io.unwatch("watched", cookie)
+    res = io.notify("watched", b"nobody", timeout=2.0)
+    assert res["acked"] == [] and res["missed"] == []
+
+
+def test_notify_across_primary_failover(cluster):
+    """VERDICT next-4 done-criterion: a watcher sees a notify across a
+    primary failover (the Objecter re-lingers on the pushed map)."""
+    from ceph_tpu.osd.osdmap import object_ps
+
+    with LocalCluster(n_mons=1, n_osds=4) as c:
+        c.create_replicated_pool("wf", size=3)
+        watcher = c.client("client.watcher")
+        notifier = c.client("client.notifier")
+        iow = watcher.open_ioctx("wf")
+        ion = notifier.open_ioctx("wf")
+        ion.write_full("obj", b"x")
+        got = []
+        ev = threading.Event()
+        iow.watch("obj", lambda nid, ck, d: (got.append(d), ev.set()))
+        # sanity pre-failover
+        res = ion.notify("obj", b"pre", timeout=5.0)
+        assert res["acked"], res
+        assert ev.wait(5.0)
+        ev.clear()
+        # kill the primary; the watcher's Objecter must re-register on
+        # the new map before a notify via the new primary reaches it
+        pid = notifier.pool_id("wf")
+        m = notifier.mc.osdmap
+        ps = object_ps("obj", m.pools[pid].pg_num)
+        _u, _up, _a, primary = m.pg_to_up_acting_osds(pid, ps)
+        c.kill_osd(primary)
+        c.mark_osd_down_out(primary)
+        c.wait_clean("wf")
+        deadline = time.time() + 20
+        delivered = False
+        while time.time() < deadline and not delivered:
+            try:
+                res = ion.notify("obj", b"post", timeout=3.0)
+            except IOError:
+                time.sleep(0.5)
+                continue
+            delivered = bool(res["acked"]) and ev.wait(2.0)
+            if not delivered:
+                time.sleep(0.5)
+        assert delivered, "watch did not survive the failover"
+        assert b"post" in got
+        watcher.shutdown()
+        notifier.shutdown()
