@@ -213,7 +213,15 @@ class Objecter(Dispatcher):
             self._tid += 1
             logical_tid = self._tid
         reqid = f"{self._nonce}:{logical_tid}"
-        for _ in range(attempts):
+        # -EAGAIN refusals (degraded below min_size, existence unknown,
+        # op in flight) are TIME-bounded, not attempt-bounded: recovery
+        # may legitimately need longer than 8 quick retries to restore
+        # min_size, and the op is already durably logged in the
+        # 'applied' case — giving up early turns a pending success into
+        # a spurious client error
+        eagain_deadline = _time.monotonic() + max(60.0, 2 * timeout)
+        hard = 0
+        while hard < attempts:
             m = self.mc.osdmap
             # snap context rides every mutation (reference: MOSDOp's
             # SnapContext) so a primary whose map lags a fresh mksnap
@@ -230,6 +238,7 @@ class Objecter(Dispatcher):
                 _osd, addr = self._calc_target(pool_id, oid, op)
             except (ConnectionError, KeyError) as e:
                 last = str(e)
+                hard += 1
                 self._refresh_map(m)
                 continue
             with self._lock:
@@ -255,6 +264,7 @@ class Objecter(Dispatcher):
                 )
             except (OSError, ConnectionError) as e:
                 last = str(e)
+                hard += 1
                 with self._lock:
                     self._outstanding.discard(tid)
                 self._refresh_map(m)
@@ -267,14 +277,18 @@ class Objecter(Dispatcher):
                 self._outstanding.discard(tid)
             if rep is None:
                 last = "op timed out"
+                hard += 1
                 self._refresh_map(m)
                 continue
             if rep.retval == -116:  # wrong primary: map changed under us
                 last = "stale map"
+                hard += 1
                 self._refresh_map(m)
                 continue
             if rep.retval == -11:  # not enough shards yet; let it settle
                 last = rep.result
+                if _time.monotonic() >= eagain_deadline:
+                    break
                 _time.sleep(0.3)
                 self._refresh_map(m)
                 continue

@@ -129,6 +129,7 @@ class IoCtx:
         rep = self._client.objecter.op_submit(
             self.pool_id, oid, "notify",
             data={"payload": pack_data(bytes(data)), "timeout": timeout},
+            timeout=max(30.0, timeout + 10.0),
         )
         if rep.retval != 0:
             raise IOError(f"notify {oid!r}: {rep.retval} {rep.result}")

@@ -148,7 +148,10 @@ def default_options() -> OptionTable:
                    "trim (reference: mds_log_events_per_segment)", min=1),
             # -- objectstore (reference: bluestore options) ----------------
             Option("objectstore", str, "memstore", "backend for new OSDs",
-                   enum=("memstore", "filestore", "bluestore")),
+                   enum=("memstore", "kstore", "filestore", "bluestore")),
+            Option("osd_fsck_on_mount", bool, False,
+                   "run a store fsck pass at OSD boot, failing the boot "
+                   "on errors (reference: bluestore_fsck_on_mount)"),
             Option("bluestore_block_size", int, 1 << 30,
                    "bluestore device-file size in bytes (reference: "
                    "bluestore_block_size)", min=1 << 20),

@@ -88,6 +88,19 @@ class PGState:
         # live-snap-id tuple this PG was last trimmed against (None =
         # never trimmed; distinct from () = trimmed against empty set)
         self.snap_trimmed: tuple | None = None
+        # epoch at which this PG's up/acting last CHANGED (reference:
+        # pg_history_t::same_interval_since): sub-ops stamped with an
+        # older epoch come from a primary of a PAST interval — a stale
+        # primary racing a map change — and must be refused, or its
+        # writes fork the PG's history behind the current interval's back
+        self.interval_start = 0
+        # interval this PG last completed its peering round in (phase 0
+        # of _recover_pg: query peers, adopt the authoritative log).
+        # A primary serves NO client ops until activated for the
+        # CURRENT interval (reference: PG activation gates ops) — a
+        # revived primary answering from its stale log/version would
+        # fork history or falsely ack writes it cannot place.
+        self.activated_interval = -1
         # reqid -> (retval, result) of COMPLETED mutations: a client
         # resend whose reply was lost is answered from here instead of
         # re-executed (reference: pg_log dup entries / osd_reqid_t);
@@ -115,13 +128,19 @@ MUTATING_OPS = frozenset(
 )
 
 
-def _current_generation(chunks: dict, vers: dict) -> dict:
+def _current_generation(chunks: dict, vers: dict,
+                        floor: int | None = None) -> dict:
     """Drop stale-GENERATION chunks: shards versioned below the newest
     version seen carry pre-RMW bytes that must never be mixed into a
-    decode (None = wildcard, e.g. backfill-rebuilt).  The newest seen is
-    authoritative — no shard can be stamped above the last
-    primary-serialized write."""
+    decode (None = wildcard, e.g. backfill-rebuilt).  `floor` is the
+    LOG's newest data version for the object (when known): even if every
+    reachable chunk is older — the current copies are on a crashed
+    disk — the stale generation must read as MISSING, not as current,
+    or a later splice-and-rewrite would launder the rollback into a
+    fresh higher version (reference: the missing/unfound machinery)."""
     present = [v for v in vers.values() if v is not None]
+    if floor is not None:
+        present.append(floor)
     if not present:
         return chunks
     target = max(present)
@@ -164,6 +183,19 @@ class OSD(Dispatcher):
                     checksum=cct.conf.get("objectstore_checksum"),
                     device_size=cct.conf.get("bluestore_block_size"),
                 )
+                if cct.conf.get("osd_fsck_on_mount"):
+                    # boot-time consistency pass over the freshly
+                    # mounted (WAL-replayed) store (reference:
+                    # bluestore_fsck_on_mount)
+                    errs = self.store.fsck()
+                    bad = (
+                        errs.get("errors") if isinstance(errs, dict)
+                        else errs
+                    )
+                    if bad:
+                        raise RuntimeError(
+                            f"{self.whoami} fsck on mount: {bad}"
+                        )
         self.messenger = Messenger.create(cct, self.whoami)
         self.messenger.default_policy = POLICY_LOSSLESS_PEER
         self.messenger.add_dispatcher(self)
@@ -292,7 +324,10 @@ class OSD(Dispatcher):
         except Exception as e:
             self.cct.dout("osd", 0, f"{self.whoami} op failed: {e!r}")
 
-    def shutdown(self) -> None:
+    def shutdown(self, umount: bool = True) -> None:
+        """umount=False is the thrasher's CRASH kill: threads stop but
+        the store is dropped without a graceful unmount, so a revive
+        from the same directory exercises real WAL replay + fsck."""
         self._stop.set()
         self.scheduler.stop()
         self._recovery_wakeup.set()
@@ -300,11 +335,26 @@ class OSD(Dispatcher):
         self.messenger.shutdown()
         if self._tick_thread is not None:
             self._tick_thread.join(timeout=5)
-        self.store.umount()
+        if umount:
+            self.store.umount()
 
     # -- map handling ------------------------------------------------------
     def _on_map(self, m: OSDMap) -> None:
+        old = self.osdmap
         self.osdmap = m
+        if old is not None:
+            # interval bookkeeping (same_interval_since): a PG whose
+            # up/acting changed starts a NEW interval at this epoch
+            with self._pgs_lock:
+                pgs = list(self.pgs.values())
+            for pg in pgs:
+                try:
+                    o = old.pg_to_up_acting_osds(pg.pool_id, pg.ps)
+                    n = m.pg_to_up_acting_osds(pg.pool_id, pg.ps)
+                except Exception:
+                    continue
+                if (o[2], o[3]) != (n[2], n[3]):
+                    pg.interval_start = m.epoch
         self._recovery_wakeup.set()  # re-peer with the new map
 
     def my_epoch(self) -> int:
@@ -336,6 +386,11 @@ class OSD(Dispatcher):
             if pg is None:
                 pg = PGState(pgid, pool_id, ps)
                 self._load_pg_meta(pg)
+                # an OSD (re)booting IS an interval change for its PGs:
+                # without this a revived OSD would accept sub-ops from a
+                # primary deposed while it was down (interval_start=0
+                # would pass everything)
+                pg.interval_start = self.my_epoch()
                 self.pgs[pgid] = pg
             return pg
 
@@ -564,6 +619,14 @@ class OSD(Dispatcher):
                 result={"primary": primary},
             )
         pg = self._pg(msg.pool, ps)
+        if pg.activated_interval != pg.interval_start:
+            # not yet peered for the current interval: refuse retryably
+            # and peer NOW (reference: ops wait on PG activation)
+            self._recovery_wakeup.set()
+            return MOSDOpReply(
+                tid=msg.tid, retval=-11, epoch=self.my_epoch(),
+                result="peering: pg not active in this interval",
+            )
         # dup detection + in-flight serialization (reference: pg_log dup
         # entries + PrimaryLogPG::check_in_progress_op): a resend of a
         # completed mutation is answered without re-executing — from the
@@ -612,6 +675,11 @@ class OSD(Dispatcher):
     def _check_dup(self, pg, pool, acting, msg, reqid) -> MOSDOpReply | None:
         """Reply for an already-seen reqid, or None to execute."""
         hit = pg.reqid_cache.get(reqid)
+        if hit is not None and hit[0] == "forked":
+            # executed here in a DEAD interval: the fork is invisible to
+            # the real history; re-execute (a still-stale primary gets
+            # deposed again until its map catches up)
+            return None
         if hit is None:
             v = pg.log.find_reqid(reqid)
             if v is not None:
@@ -664,6 +732,9 @@ class OSD(Dispatcher):
         if holding >= pool.min_size:
             return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
                                result={"version": pg.version, "dup": True})
+        # the op is durably logged but under-replicated: recovery is the
+        # only path to an ack, so kick it rather than wait for the tick
+        self._recovery_wakeup.set()
         return MOSDOpReply(
             tid=msg.tid, retval=-11, epoch=self.my_epoch(),
             result=f"applied at v{hit[1]}; {holding} shards hold it "
@@ -699,8 +770,14 @@ class OSD(Dispatcher):
             try:
                 head_existed = self._maybe_clone(pg, pool, msg.oid, snap_seq)
             except Exception as e:
+                # clone failures are overwhelmingly transient races (a
+                # map change mid-op re-targeting the internal clone
+                # write, a peer mid-recovery): refuse RETRYABLY so the
+                # client resends to the current primary — a fatal -EIO
+                # here would fail a write that the next attempt performs
+                # cleanly
                 return MOSDOpReply(
-                    tid=msg.tid, retval=-5, epoch=self.my_epoch(),
+                    tid=msg.tid, retval=-11, epoch=self.my_epoch(),
                     result=f"snap clone failed: {e}",
                 )
             if msg.op in ("write_full", "write", "append") and not head_existed:
@@ -747,6 +824,23 @@ class OSD(Dispatcher):
             rep = self._replicated_op(pg, pool, acting, msg)
         return self._record_reqid(pg, msg, rep)
 
+    def _collect_subop_acks(self, tids: dict, acting=None):
+        """(acked_remote, deposed, failed_osds) over a tid->shard map.
+        `deposed` = some peer answered -116: it is in a NEWER interval
+        than the epoch we stamped — we may have been deposed mid-op."""
+        acked = 0
+        deposed = False
+        failed: list[int] = []
+        for tid, shard in tids.items():
+            rep = self._wait_reply(tid)
+            if rep is not None and rep.retval == 0:
+                acked += 1
+            elif rep is not None and rep.retval == -116:
+                deposed = True
+            elif acting is not None:
+                failed.append(acting[shard])
+        return acked, deposed, failed
+
     def _record_reqid(self, pg, msg, rep: MOSDOpReply) -> MOSDOpReply:
         """Remember a completed mutation's outcome for dup detection.
         Successes cache the full reply; an UNDER-ACKED mutation (applied
@@ -761,11 +855,21 @@ class OSD(Dispatcher):
         if rep.retval == 0:
             pg.reqid_cache[reqid] = ("done", rep.retval, rep.result)
         elif (
+            rep.retval == -116
+            and isinstance(rep.result, dict)
+            and rep.result.get("deposed")
+        ):
+            # the op executed on a DEPOSED primary: its local log entry
+            # is a fork in a dead interval — the marker stops this OSD's
+            # own log from answering the resend as an "applied" dup
+            pg.reqid_cache[reqid] = ("forked",)
+        elif (
             rep.retval == -11
             and isinstance(rep.result, dict)
             and "applied" in rep.result
         ):
             pg.reqid_cache[reqid] = ("applied", rep.result["applied"])
+            self._recovery_wakeup.set()  # under-acked: converge now
         else:
             return rep
         while len(pg.reqid_cache) > 1024:
@@ -1083,7 +1187,7 @@ class OSD(Dispatcher):
                     result="object not recovered here yet",
                 )
             version = pg.version + 1
-            entry = LogEntry(version, "modify", msg.oid)
+            entry = LogEntry(version, "attr", msg.oid)
             tids: dict[int, int] = {}
             for shard, osd in enumerate(acting):
                 if osd == self.id or osd < 0 or not self.osdmap.is_up(osd):
@@ -1106,11 +1210,12 @@ class OSD(Dispatcher):
             self._apply_xattr_updates(t, cid, msg.oid, updates)
             self._log_txn(t, cid, pg, entry)
             self.store.queue_transaction(t)
-            acked = 1
-            for tid in tids:
-                rep = self._wait_reply(tid)
-                if rep is not None and rep.retval == 0:
-                    acked += 1
+            a, deposed, _f = self._collect_subop_acks(tids)
+            acked = 1 + a
+        if deposed and (pool is None or acked < pool.min_size):
+            return MOSDOpReply(tid=msg.tid, retval=-116,
+                               epoch=self.my_epoch(),
+                               result={"deposed": True})
         # same durability bar as write_full: the update must be on enough
         # shards to survive (reference: xattr ops ride the same repop)
         if pool is not None and acked < pool.min_size:
@@ -1229,16 +1334,20 @@ class OSD(Dispatcher):
         t.setattr(cid, msg.oid, "ver", str(version).encode())
         self._log_txn(t, cid, pg, entry)
         self.store.queue_transaction(t)
-        acked = 1
-        failed: list[int] = []
-        for tid, shard in tids.items():
-            rep = self._wait_reply(tid)
-            if rep is not None and rep.retval == 0:
-                acked += 1
-            else:
-                failed.append(acting[shard])
+        a, deposed, failed = self._collect_subop_acks(tids, acting)
+        acked = 1 + a
         for osd in failed:
             self.mc.report_failure(osd)
+        if deposed and acked < pool.min_size:
+            # deposed mid-op below quorum: the local apply is a FORK in a
+            # dead interval — never acked, never answered as a dup
+            # (_record_reqid marks the reqid "forked" so the resend
+            # re-executes on the real primary).  At >= min_size the op
+            # is durable in THIS interval despite the stray -116 (e.g. a
+            # peer that just rebooted): ack it normally below.
+            return MOSDOpReply(tid=msg.tid, retval=-116,
+                               epoch=self.my_epoch(),
+                               result={"deposed": True})
         # degraded-write policy: ack at min_size commits.  Shards that
         # missed the write are reported to the mon and filled by delta
         # recovery off the pg_log (reference: ECBackend requires min_size
@@ -1373,9 +1482,13 @@ class OSD(Dispatcher):
                 epoch=self.my_epoch(), ps=pg.ps,
             ))
             if rd.retval != 0:
+                # the current generation is temporarily sourceless
+                # (unfound-pending): refuse retryably — serving/splicing
+                # a stale base would launder a rollback into a fresh
+                # version (reference: ops wait on missing objects)
                 return MOSDOpReply(
-                    tid=msg.tid, retval=-5, epoch=self.my_epoch(),
-                    result=f"rmw old-object read: {rd.result}",
+                    tid=msg.tid, retval=-11, epoch=self.my_epoch(),
+                    result=f"rmw base unreadable now: {rd.result}",
                 )
             old = unpack_data(rd.data) or b""
         buf = bytearray(max(len(old), off + len(data)))
@@ -1451,8 +1564,10 @@ class OSD(Dispatcher):
             stored_h = int(self.store.getattr(cid, msg.oid, "hinfo"))
         except (NotFound, KeyError, ValueError):
             stored_h = None
+        floor = pg.log.obj_newest.get(msg.oid)
         if (
             my_ver is None
+            or (floor is not None and my_ver < floor)
             or len(my_chunk) != L
             or (stored_h is not None and crc32c(bytes(my_chunk)) != stored_h)
         ):
@@ -1544,16 +1659,20 @@ class OSD(Dispatcher):
         t.setattr(cid, msg.oid, "ver", str(version).encode())
         self._log_txn(t, cid, pg, entry)
         self.store.queue_transaction(t)
-        acked = 1
-        failed: list[int] = []
-        for tid, shard in tids.items():
-            rep = self._wait_reply(tid)
-            if rep is not None and rep.retval == 0:
-                acked += 1
-            else:
-                failed.append(acting[shard])
+        a, deposed, failed = self._collect_subop_acks(tids, acting)
+        acked = 1 + a
         for osd in failed:
             self.mc.report_failure(osd)
+        if deposed and acked < pool.min_size:
+            # deposed mid-op below quorum: the local apply is a FORK in a
+            # dead interval — never acked, never answered as a dup
+            # (_record_reqid marks the reqid "forked" so the resend
+            # re-executes on the real primary).  At >= min_size the op
+            # is durable in THIS interval despite the stray -116 (e.g. a
+            # peer that just rebooted): ack it normally below.
+            return MOSDOpReply(tid=msg.tid, retval=-116,
+                               epoch=self.my_epoch(),
+                               result={"deposed": True})
         if acked >= pool.min_size:
             return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
                                result={"version": pg.version, "acked": acked})
@@ -1603,6 +1722,7 @@ class OSD(Dispatcher):
         sizes: dict[int, int] | None = None,
         vers: dict[int, int | None] | None = None,
         stray: bool = False,
+        floor: int | None = None,
     ) -> dict[int, bytes]:
         """Fetch chunk bytes for shard ids in `want` (local or remote).
         `sizes`, if given, collects the object-size xattr each replying
@@ -1658,79 +1778,126 @@ class OSD(Dispatcher):
                     sizes[shard] = int(rep.size)
                 if vers is not None:
                     vers[shard] = getattr(rep, "ver", None)
-        if stray and want - set(got):
-            self._gather_stray_chunks(
-                pg, oid, want - set(got), got, sizes, vers, acting
-            )
+        if stray:
+            self._stray_upgrade(pg, oid, want, got, sizes, vers, acting,
+                                floor)
         return got
 
-    def _gather_stray_chunks(self, pg, oid: str, missing: set[int],
-                             got: dict, sizes, vers, acting) -> None:
-        """Probe NON-acting locations for shards whose acting holder is a
-        hole or empty-handed: after an acting-set permutation (OSD out ->
-        CRUSH reshuffle) a surviving OSD may still hold a shard's chunk
-        from its previous role, addressable only outside the acting map
-        (reference: PeeringState's missing_loc — recovery reads from any
-        OSD known to hold the object, not just the acting set)."""
-        for shard in sorted(missing):
-            cid = self._cid(pg.pgid, shard)
-            holder = acting[shard] if shard < len(acting) else -1
-            chunk = None
-            if holder != self.id:  # acting-local was already tried
-                try:
-                    chunk = self.store.read(cid, oid)
-                except (NotFound, KeyError):
-                    chunk = None
+    def _stray_upgrade(self, pg, oid: str, want: set[int], got: dict,
+                       sizes, vers, acting,
+                       floor: int | None = None) -> None:
+        """Hunt NON-acting locations (reference: PeeringState's
+        missing_loc — recovery reads from any OSD known to hold the
+        object, not just the acting set) for two cases an acting
+        permutation creates:
+        - a shard with NO chunk at all (its new holder never held the
+          role) — any copy helps;
+        - a shard whose acting chunk is a STALE generation — only a
+          copy stamped at (or above) the newest generation seen helps,
+          and crucially the stale chunk must NOT suppress the hunt, or
+          a current stray that could complete the stripe stays
+          invisible and reads fail with too-few chunks.
+        Iterates because finding a higher generation can reclassify
+        previously-accepted chunks as stale."""
+        for _round in range(3):
+            present = [v for v in vers.values() if v is not None]
+            if floor is not None:
+                present.append(floor)
+            target = max(present) if present else None
+            todo = [
+                sh for sh in sorted(want)
+                if sh not in got
+                or (target is not None and vers.get(sh) is not None
+                    and vers[sh] < target)
+            ]
+            if not todo:
+                return
+            improved = False
+            for shard in todo:
+                min_ver = target if shard in got else None
+                found = self._probe_stray(pg, oid, shard, acting, min_ver)
+                if found is None:
+                    continue
+                data, ver, size = found
+                got[shard] = data
+                if vers is not None:
+                    vers[shard] = ver
+                if sizes is not None and size is not None:
+                    sizes[shard] = size
+                improved = True
+            if not improved:
+                return
+
+    def _probe_stray(self, pg, oid: str, shard: int, acting,
+                     min_ver: int | None):
+        """One shard's chunk from any non-acting location.  min_ver set:
+        only a copy with a NUMERIC generation >= min_ver qualifies (a
+        wildcard stamp proves nothing about currency); min_ver None (the
+        shard has no chunk at all): any copy, wildcard included."""
+        holder = acting[shard] if shard < len(acting) else -1
+        cid = self._cid(pg.pgid, shard)
+        if holder != self.id:  # acting-local was already tried
+            try:
+                chunk = self.store.read(cid, oid)
+            except (NotFound, KeyError):
+                chunk = None
             if chunk is not None:
                 try:
                     stored = int(self.store.getattr(cid, oid, "hinfo"))
                 except (NotFound, KeyError, ValueError):
                     stored = None
-                if stored is not None and crc32c(chunk) != stored:
-                    chunk = None  # rotted stray: keep probing
-            if chunk is not None:
-                got[shard] = chunk
-                if vers is not None:
-                    vers[shard] = self._stored_ver(cid, oid)
+                ver = self._stored_ver(cid, oid)
+                if (
+                    (stored is None or crc32c(chunk) == stored)
+                    and (min_ver is None
+                         or (ver is not None and ver >= min_ver))
+                ):
+                    size = None
+                    try:
+                        size = int(self.store.getattr(cid, oid, "size"))
+                    except (NotFound, KeyError, ValueError):
+                        pass
+                    return bytes(chunk), ver, size
+        probes = 0
+        for osd in range(self.osdmap.max_osd):
+            if osd in (self.id, holder) or not self.osdmap.is_up(osd):
                 continue
-            probes = 0
-            for osd in range(self.osdmap.max_osd):
-                if osd in (self.id, holder) or not self.osdmap.is_up(osd):
-                    continue
-                if probes >= 16:
-                    break  # bound the walk on big maps (client-path cost)
-                probes += 1
-                # metadata-only probe first (offsets=[]): a miss costs a
-                # tiny -2 round trip, not a full-chunk transfer; bytes
-                # are fetched only from a peer that reports holding the
-                # object (past_intervals will shrink this candidate walk)
-                tid = self._next_tid()
-                try:
-                    self._conn_to_osd(osd).send_message(MECSubOpRead(
-                        tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
-                        offsets=[], epoch=self.my_epoch(),
-                    ))
-                except (OSError, ConnectionError):
-                    continue
-                rep = self._wait_reply(tid, timeout=3.0)
-                if rep is None or rep.retval != 0:
-                    continue
-                tid = self._next_tid()
-                try:
-                    self._conn_to_osd(osd).send_message(MECSubOpRead(
-                        tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
-                        offsets=None, epoch=self.my_epoch(),
-                    ))
-                except (OSError, ConnectionError):
-                    continue
-                rep = self._wait_reply(tid, timeout=5.0)
-                if rep is not None and rep.retval == 0:
-                    got[shard] = unpack_data(rep.data)
-                    if sizes is not None and rep.size is not None:
-                        sizes[shard] = int(rep.size)
-                    if vers is not None:
-                        vers[shard] = getattr(rep, "ver", None)
-                    break
+            if probes >= 16:
+                break  # bound the walk on big maps (client-path cost)
+            probes += 1
+            # metadata-only probe first (offsets=[]): a miss or a
+            # non-qualifying generation costs a tiny round trip, not a
+            # full-chunk transfer (past_intervals will shrink this walk)
+            tid = self._next_tid()
+            try:
+                self._conn_to_osd(osd).send_message(MECSubOpRead(
+                    tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
+                    offsets=[], epoch=self.my_epoch(),
+                ))
+            except (OSError, ConnectionError):
+                continue
+            rep = self._wait_reply(tid, timeout=3.0)
+            if rep is None or rep.retval != 0:
+                continue
+            ver = getattr(rep, "ver", None)
+            if min_ver is not None and (ver is None or ver < min_ver):
+                continue
+            tid = self._next_tid()
+            try:
+                self._conn_to_osd(osd).send_message(MECSubOpRead(
+                    tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
+                    offsets=None, epoch=self.my_epoch(),
+                ))
+            except (OSError, ConnectionError):
+                continue
+            rep = self._wait_reply(tid, timeout=5.0)
+            if rep is not None and rep.retval == 0:
+                return (
+                    unpack_data(rep.data),
+                    getattr(rep, "ver", None),
+                    int(rep.size) if rep.size is not None else None,
+                )
+        return None
 
     def _ec_read(self, pg, codec, acting, msg) -> MOSDOpReply:
         k = codec.get_data_chunk_count()
@@ -1746,23 +1913,24 @@ class OSD(Dispatcher):
                 pass
         peer_sizes: dict[int, int] = {}
         vers: dict[int, int | None] = {}
+        floor = pg.log.obj_newest.get(msg.oid)
         want_data = set(range(k))
         got = self._gather_chunks(
             pg, codec, acting, msg.oid, want_data, sizes=peer_sizes,
-            vers=vers,
+            vers=vers, floor=floor,
         )
 
-        got = _current_generation(got, vers)
+        got = _current_generation(got, vers, floor)
         missing = want_data - set(got)
         if missing:
             # degraded: consult minimum_to_decode over everything
             # reachable, including stray (non-acting) chunk locations
             avail_probe = self._gather_chunks(
                 pg, codec, acting, msg.oid, set(range(k, n)) | missing,
-                sizes=peer_sizes, vers=vers, stray=True,
+                sizes=peer_sizes, vers=vers, stray=True, floor=floor,
             )
             avail_probe.update(got)
-            avail_probe = _current_generation(avail_probe, vers)
+            avail_probe = _current_generation(avail_probe, vers, floor)
             if len(avail_probe) < k:
                 return MOSDOpReply(
                     tid=msg.tid, retval=-5, epoch=self.my_epoch(),
@@ -1887,11 +2055,12 @@ class OSD(Dispatcher):
                 t.setattr(cid, msg.oid, "ver", str(version).encode())
                 self._log_txn(t, cid, pg, entry)
                 self.store.queue_transaction(t)
-                acked = 1
-                for tid in tids:
-                    rep = self._wait_reply(tid)
-                    if rep is not None and rep.retval == 0:
-                        acked += 1
+                a, deposed, _f = self._collect_subop_acks(tids)
+                acked = 1 + a
+                if deposed and acked < pool.min_size:
+                    return MOSDOpReply(tid=msg.tid, retval=-116,
+                                       epoch=self.my_epoch(),
+                                       result={"deposed": True})
                 if acked >= pool.min_size:
                     return MOSDOpReply(
                         tid=msg.tid, retval=0, epoch=self.my_epoch(),
@@ -2038,11 +2207,12 @@ class OSD(Dispatcher):
             t.setattr(cid, msg.oid, "ver", str(version).encode())
             self._log_txn(t, cid, pg, entry)
             self.store.queue_transaction(t)
-            acked = 1
-            for tid in tids:
-                rep = self._wait_reply(tid)
-                if rep is not None and rep.retval == 0:
-                    acked += 1
+            a, deposed, _f = self._collect_subop_acks(tids)
+            acked = 1 + a
+        if deposed and acked < pool.min_size:
+            return MOSDOpReply(tid=msg.tid, retval=-116,
+                               epoch=self.my_epoch(),
+                               result={"deposed": True})
         if acked < pool.min_size:
             return MOSDOpReply(tid=msg.tid, retval=-11,
                                epoch=self.my_epoch(),
@@ -2102,10 +2272,14 @@ class OSD(Dispatcher):
             targets = dict(self.watchers.get(key, {}))
         pending = {}
         dead = []
+        unreachable = []
         for cookie, src in targets.items():
             conn = self._client_conns.get(src)
             if conn is None:
-                dead.append(cookie)
+                # conn LRU-evicted or never seen: the watcher may be
+                # alive and idle — report it missed, do NOT reap (only a
+                # CONFIRMED-dead connection expires a watch)
+                unreachable.append(cookie)
                 continue
             try:
                 conn.send_message(MWatchNotify(
@@ -2125,7 +2299,7 @@ class OSD(Dispatcher):
                     ws.pop(cookie, None)
                 if not ws:
                     self.watchers.pop(key, None)
-        acked, missed = [], []
+        acked, missed = [], list(unreachable)
         deadline = time.monotonic() + timeout
         for cookie in pending:
             remain = max(0.0, deadline - time.monotonic())
@@ -2154,6 +2328,25 @@ class OSD(Dispatcher):
         cid = self._cid(msg.pgid, msg.shard)
         retval = 0
         try:
+            if (
+                msg.epoch is not None
+                and pg.interval_start
+                and msg.epoch < pg.interval_start
+            ):
+                # sub-op from a PAST-interval primary (stale map racing
+                # the change that re-elected this PG): refuse with the
+                # DISTINCT -ESTALE code so the deposed sender knows to
+                # step down rather than treat it as a flaky peer
+                # (reference: ops tagged with an older
+                # same_interval_since are dropped)
+                try:
+                    conn.send_message(
+                        MECSubOpWriteReply(tid=msg.tid, pgid=msg.pgid,
+                                           shard=msg.shard, retval=-116)
+                    )
+                except (OSError, ConnectionError):
+                    pass
+                return
             with pg.lock:
                 entry_op = msg.entry[1] if msg.entry else None
                 t = Transaction()
@@ -2223,6 +2416,25 @@ class OSD(Dispatcher):
                     chunk = unpack_data(msg.data)
                     if crc32c(chunk) != msg.crc:
                         raise IOError("chunk crc mismatch")
+                    # generation-regression guard: a full-chunk push
+                    # rebuilt from STALE sources (a donor that hasn't
+                    # caught up across an acting permutation) must never
+                    # overwrite a NEWER generation we hold — that is how
+                    # an applied write gets rolled back cluster-wide.
+                    # Equal/newer stamps apply (idempotent refresh /
+                    # catch-up); wildcard pushes only land on chunks
+                    # that carry no numeric stamp themselves.
+                    stored_gen = self._stored_ver(cid, msg.oid)
+                    push_gen = getattr(msg, "over", None)
+                    if push_gen is None:
+                        push_gen = msg.version
+                    if stored_gen is not None and (
+                        push_gen is None or push_gen < stored_gen
+                    ):
+                        raise IOError(
+                            f"refusing generation regression "
+                            f"v{push_gen} onto v{stored_gen}"
+                        )
                     t.write(cid, msg.oid, 0, chunk)
                     t.truncate(cid, msg.oid, len(chunk))
                     t.setattr(cid, msg.oid, "hinfo", str(msg.crc).encode())
@@ -2670,6 +2882,9 @@ class OSD(Dispatcher):
                     elif self._push_sub_write(
                         pg, osd, store_shard, err["oid"], chunk, None,
                         [0, "modify", err["oid"]], osize=size,
+                        src_cid=self._cid(
+                            pg.pgid,
+                            acting.index(self.id) if is_ec else 0),
                     ):
                         repaired += 1
             self.logger.inc("scrub_repairs", repaired)
@@ -3030,6 +3245,7 @@ class OSD(Dispatcher):
             if rep is None or rep.version is None:
                 continue
             peers[(shard, osd)] = (rep.version, rep.oids or [])
+        interval_at_entry = pg.interval_start
         # phase 0 — adopt the authoritative log (reference: peering's
         # choose_acting/authoritative-log step): a primary revived after
         # missing writes must catch ITSELF up first, else it would mint
@@ -3067,6 +3283,9 @@ class OSD(Dispatcher):
                 )
             else:
                 return  # retry next tick; judging peers now would be wrong
+        # peered: no peer is ahead (or we just adopted the ahead log) —
+        # this primary may now serve ops for the current interval
+        pg.activated_interval = interval_at_entry
         if pg.version == 0:
             return  # nothing written yet
         my_shard = acting.index(self.id) if is_ec else 0
@@ -3316,12 +3535,31 @@ class OSD(Dispatcher):
                 ok = self._push_sub_write(
                     pg, osd, shard, e.oid, None, e.version, e.to_list()
                 )
-            elif e.op == "modify" and newest.get(e.oid) == e.version:
+            elif e.op in ("modify", "attr") and newest.get(e.oid) == e.version:
                 chunk, size = self._rebuild_shard_chunk(
                     pg, codec, acting, e.oid, shard, is_ec
                 )
                 if chunk is None:
-                    return False  # unreadable right now: retry next tick
+                    # UNFOUND right now (reference: missing_loc unfound
+                    # set): park THIS object but keep recovering the
+                    # rest — one unrecoverable object must not wedge
+                    # the whole peer's recovery.  The entry still
+                    # replays (log stays contiguous); the object stays
+                    # missing on the peer exactly as it is everywhere
+                    # else, and a later tick retries when a source
+                    # resurfaces.
+                    self.cct.dout(
+                        "osd", 1,
+                        f"{self.whoami} recovery: {pg.pgid}/{e.oid} "
+                        f"unfound, parking",
+                    )
+                    ok = self._push_sub_write(
+                        pg, osd, shard, e.oid, None, e.version,
+                        e.to_list(),
+                    )
+                    if not ok:
+                        return False
+                    continue
                 ok = self._push_sub_write(
                     pg, osd, shard, e.oid, chunk, e.version,
                     e.to_list(), src_cid=my_cid, osize=size,
@@ -3356,7 +3594,10 @@ class OSD(Dispatcher):
                 pg, codec, acting, oid, shard, is_ec
             )
             if chunk is None:
-                all_ok = False  # unreadable right now: retry next tick
+                # unfound: park this object, recover the rest (see
+                # _push_log_delta); all_ok=False keeps the peer unsealed
+                # so later ticks retry
+                all_ok = False
                 continue
             version = newest[oid]
             entry = [version or 0, "modify", oid]
@@ -3402,13 +3643,27 @@ class OSD(Dispatcher):
                 return None, 0
         k = codec.get_data_chunk_count()
         n = codec.get_chunk_count()
-        want = set(range(n)) - {shard} - (exclude or set())
+        # include the DEST shard in the gather: the receiver lacks its
+        # chunk, but the exact chunk may survive as a stray on a previous
+        # holder (acting permutations) — using it directly also rescues
+        # objects written degraded at exactly min_size, where fewer than
+        # k OTHER chunks exist and decode alone could never recover
+        want = set(range(n)) - (exclude or set())
         sizes: dict[int, int] = {}
         vers: dict[int, int | None] = {}
+        floor = pg.log.obj_newest.get(oid)
         got = self._gather_chunks(pg, codec, acting, oid, want, sizes=sizes,
-                                  vers=vers, stray=True)
-        # never rebuild from a MIX of stripe generations
-        got = _current_generation(got, vers)
+                                  vers=vers, stray=True, floor=floor)
+        # never rebuild from a MIX of stripe generations, nor from one
+        # the log proves is below the newest write
+        got = _current_generation(got, vers, floor)
+        if shard in got:
+            try:
+                size = int(self.store.getattr(
+                    self._cid(pg.pgid, acting.index(self.id)), oid, "size"))
+            except (NotFound, KeyError, ValueError):
+                size = sizes.get(shard, next(iter(sizes.values()), 0))
+            return bytes(got[shard]), size
         if len(got) < k:
             return None, 0
         try:

@@ -9,7 +9,10 @@ Simplifications vs the reference, by design:
   primaries diverge; here the primary serializes all writes and peering
   truncates stragglers, so a scalar version is sufficient and the
   divergent-entry rewind machinery collapses into `entries_since`).
-- entries record (version, op, oid); op is "modify", "delete", or "clean"
+- entries record (version, op, oid); op is "modify", "delete", "attr"
+  (an xattr-only mutation: recovered exactly like a modify, but it does
+  NOT move the object's data-generation floor — chunk bytes are
+  untouched, so no chunk stamp will ever carry its version), or "clean"
   (a data-less version marker recovery uses to seal a peer at the
   primary's version) — enough to reconstruct a missing-object set, which
   is all recovery needs.
@@ -58,6 +61,13 @@ class PGLog:
         # reqid -> version for the retained window (reference:
         # pg_log_dup_t set): dup detection against the replicated log
         self.reqids: dict[str, int] = {}
+        # oid -> newest DATA-mutation version ever logged (reference:
+        # the missing-set's need versions): the generation FLOOR readers
+        # and rebuilders require — serving a chunk generation below it
+        # would resurrect pre-write bytes whenever the current copies
+        # are temporarily unreachable.  Kept across trims (floors stay
+        # true); rebuilt from the retained window after a reload.
+        self.obj_newest: dict[str, int] = {}
 
     def append(self, entry: LogEntry) -> list[LogEntry]:
         """Append and trim; returns entries trimmed off the tail."""
@@ -66,6 +76,10 @@ class PGLog:
         self.head = entry.version
         if entry.reqid is not None:
             self.reqids[entry.reqid] = entry.version
+        if entry.op in ("modify", "delete"):
+            # NOT "attr": xattr-only entries leave chunk bytes (and
+            # stamps) alone, so they must not raise the data floor
+            self.obj_newest[entry.oid] = entry.version
         trimmed: list[LogEntry] = []
         while len(self.entries) > self.limit:
             e = self.entries.pop(0)
@@ -92,6 +106,7 @@ class PGLog:
         self.entries = []
         self.head = self.tail = version
         self.reqids = {}
+        # obj_newest survives: the floors reflect real history
 
     def entries_since(self, version: int) -> list[LogEntry]:
         return [e for e in self.entries if e.version > version]
@@ -133,4 +148,7 @@ class PGLog:
                     log.entries.append(e)
                     if e.reqid is not None:
                         log.reqids[e.reqid] = e.version
+                    if e.op in ("modify", "delete"):
+                        log.obj_newest[e.oid] = max(
+                            log.obj_newest.get(e.oid, 0), e.version)
         return log

@@ -45,11 +45,27 @@ class LocalCluster:
         conf_overrides: dict | None = None,
         with_mgr: bool = False,
         with_mds: bool = False,
+        objectstore: str | None = None,
     ):
+        """objectstore: None = in-memory stores handed across revives
+        (fast, the round-2 behavior).  "kstore"/"bluestore" = PERSISTENT
+        mode: each OSD mounts a store under a tmp data dir; kill_osd is
+        a crash (no unmount) and revive_osd constructs a FRESH store
+        from the same directory — real WAL replay + fsck on mount
+        (reference: qa/standalone restarts daemons from disk)."""
         self.n_mons = n_mons
         self.n_osds = n_osds
         self.hosts = hosts or n_osds  # default: one OSD per host bucket
         self.conf_overrides = dict(conf_overrides or {})
+        self.objectstore = objectstore
+        self.data_dir: str | None = None
+        if objectstore:
+            import tempfile
+
+            self.data_dir = tempfile.mkdtemp(prefix="ceph_tpu_osd_")
+            self.conf_overrides.setdefault("objectstore", objectstore)
+            self.conf_overrides.setdefault("osd_data", self.data_dir)
+            self.conf_overrides.setdefault("osd_fsck_on_mount", True)
         self.with_mgr = with_mgr
         self.with_mds = with_mds
         self.mons: dict[str, Monitor] = {}
@@ -155,6 +171,10 @@ class LocalCluster:
                 mon.shutdown()
             except Exception:
                 pass
+        if self.data_dir is not None:
+            import shutil
+
+            shutil.rmtree(self.data_dir, ignore_errors=True)
 
     def __enter__(self) -> "LocalCluster":
         return self.start()
@@ -258,14 +278,23 @@ class LocalCluster:
 
     # -- fault injection ---------------------------------------------------
     def kill_osd(self, i: int) -> None:
-        """Hard-stop an OSD, keeping its store for revive (the thrasher's
-        kill; reference: qa/tasks/thrashosds.py)."""
+        """Hard-stop an OSD (the thrasher's kill; reference:
+        qa/tasks/thrashosds.py).  In-memory mode stashes the store
+        object for revive; persistent mode CRASHES — no unmount, the
+        store object is dropped and revive remounts from disk."""
         osd = self.osds.pop(i)
+        if self.objectstore:
+            osd.shutdown(umount=False)
+            return
         self._stores = getattr(self, "_stores", {})
         self._stores[i] = osd.store
         osd.shutdown()
 
     def revive_osd(self, i: int) -> OSD:
+        if self.objectstore:
+            # fresh store from the same osd_data subdir: WAL replay +
+            # fsck-on-mount happen inside the OSD boot
+            return self._start_osd(i)
         store = getattr(self, "_stores", {}).pop(i, None)
         return self._start_osd(i, store=store)
 

@@ -1,0 +1,145 @@
+"""Kill -> remount-from-disk -> recover: the ring-2 cluster on
+persistent stores (reference: qa/standalone/ceph-helpers.sh restart
+flows — daemons restart from their data dirs, exercising real WAL
+replay and fsck-on-mount, which the round-2 revive-same-object harness
+never did).
+"""
+import time
+
+import pytest
+
+from ceph_tpu.qa.vstart import LocalCluster
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.mark.parametrize("kind", ["kstore", "bluestore"])
+def test_crash_remount_preserves_everything(kind):
+    with LocalCluster(n_mons=1, n_osds=4, objectstore=kind) as c:
+        c.create_replicated_pool("pr", size=3)
+        c.create_ec_pool("pe", k=2, m=1)
+        cl = c.client()
+        ior = cl.open_ioctx("pr")
+        ioe = cl.open_ioctx("pe")
+        ior.write_full("r1", b"replicated bytes")
+        ior.omap_set("r1", {"k": b"v", "k2": b"v2"})
+        ior.set_xattr("r1", "tag", b"xv")
+        ioe.write_full("e1", bytes(range(256)) * 40)
+        ioe.write("e1", b"PATCH", off=1000)  # RMW state must persist
+        want_e1 = bytearray(bytes(range(256)) * 40)
+        want_e1[1000:1005] = b"PATCH"
+        # crash an OSD (no unmount) and remount it from disk
+        c.kill_osd(2)
+        time.sleep(0.3)
+        c.revive_osd(2)
+        c.wait_clean("pr")
+        c.wait_clean("pe")
+        assert ior.read("r1") == b"replicated bytes"
+        assert ior.omap_get("r1") == {"k": b"v", "k2": b"v2"}
+        assert ior.get_xattr("r1", "tag") == b"xv"
+        assert ioe.read("e1") == bytes(want_e1)
+        cl.shutdown()
+
+
+def test_writes_while_down_recovered_after_remount():
+    """The remounted OSD is BEHIND (missed writes while crashed): its
+    replayed pg_log must drive delta recovery, not resurrect old data."""
+    with LocalCluster(n_mons=1, n_osds=4, objectstore="kstore") as c:
+        c.create_replicated_pool("wd", size=3)
+        cl = c.client()
+        io = cl.open_ioctx("wd")
+        for i in range(8):
+            io.write_full(f"o{i}", f"v1-{i}".encode() * 20)
+        c.kill_osd(1)
+        c.mark_osd_down_out(1)
+        time.sleep(0.3)
+        for i in range(8):
+            io.write_full(f"o{i}", f"v2-{i}".encode() * 20)
+        io.remove("o7")
+        c.revive_osd(1)
+        c.mark_osd_in_up(1)
+        c.wait_clean("wd")
+        for i in range(7):
+            assert io.read(f"o{i}") == f"v2-{i}".encode() * 20, i
+        with pytest.raises(IOError):
+            io.read("o7")  # the delete must propagate to the remounted OSD
+        cl.shutdown()
+
+
+def test_full_cluster_restart_from_disk():
+    """Every OSD crashes; a full remount must bring all data back with
+    no surviving in-memory state at all."""
+    with LocalCluster(n_mons=1, n_osds=4, objectstore="kstore") as c:
+        c.create_ec_pool("full", k=2, m=1)
+        cl = c.client()
+        io = cl.open_ioctx("full")
+        blobs = {
+            f"b{i}": bytes([(i * 3 + j) % 256 for j in range(4000)])
+            for i in range(6)
+        }
+        for o, d in blobs.items():
+            io.write_full(o, d)
+        for i in range(4):
+            c.kill_osd(i)
+        time.sleep(0.3)
+        for i in range(4):
+            c.revive_osd(i)
+        c.wait_clean("full")
+        for o, d in blobs.items():
+            assert io.read(o) == d, o
+        cl.shutdown()
+
+
+@pytest.mark.slow
+def test_thrash_with_remounts_scrub_and_snaptrim():
+    """Randomized kill/crash-remount soak on persistent stores with
+    concurrent scrubs and snapshot create/remove churn (reference:
+    qa/tasks/thrashosds.py with chance_test_min_size + scrub injection).
+    Zero loss tolerated."""
+    import random
+
+    rng = random.Random(7)
+    with LocalCluster(n_mons=1, n_osds=5, objectstore="kstore") as c:
+        c.create_ec_pool("th", k=2, m=1)
+        cl = c.client()
+        io = cl.open_ioctx("th")
+        state = {}
+        for i in range(12):
+            state[f"t{i}"] = bytes([(i + j) % 256 for j in range(2000)])
+            io.write_full(f"t{i}", state[f"t{i}"])
+        snaps = []
+        for cycle in range(4):
+            victim = rng.randrange(5)
+            c.kill_osd(victim)
+            c.mark_osd_down_out(victim)
+            # concurrent chaos while degraded: writes, RMWs, snaps
+            for _ in range(6):
+                oid = f"t{rng.randrange(12)}"
+                if rng.random() < 0.5:
+                    data = bytes([rng.randrange(256)] * 2000)
+                    io.write_full(oid, data)
+                    state[oid] = data
+                else:
+                    patch = bytes([rng.randrange(256)] * 64)
+                    off = rng.randrange(1800)
+                    io.write(oid, patch, off=off)
+                    buf = bytearray(state[oid])
+                    buf[off:off + 64] = patch
+                    state[oid] = bytes(buf)
+            if rng.random() < 0.7:
+                snaps.append((f"s{cycle}", io.snap_create(f"s{cycle}")))
+            if len(snaps) > 1 and rng.random() < 0.5:
+                name, _sid = snaps.pop(rng.randrange(len(snaps)))
+                io.snap_remove(name)  # snaptrim churn during recovery
+            c.revive_osd(victim)
+            c.mark_osd_in_up(victim)
+            c.wait_clean("th", timeout=60)
+            reports = io.scrub()
+            assert all(not r.get("inconsistent") for r in reports), reports
+            for oid, data in state.items():
+                assert io.read(oid) == data, (cycle, oid)
+        # snapshot views still resolve after the churn
+        for _name, sid in snaps:
+            for oid in list(state)[:3]:
+                io.read(oid, snapid=sid)  # must not error
+        cl.shutdown()

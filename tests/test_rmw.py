@@ -294,7 +294,14 @@ def test_append_dup_survives_primary_change(cluster):
         rep = None
         while time.time() < deadline:
             tid += 1
-            rep = append_req(c.osds[new_primary], tid, cl.mc.osdmap.epoch)
+            # follow the live map: primaryship can move again while the
+            # cluster settles (peering/activation churn)
+            m3 = cl.mc.osdmap
+            _u2, _up2, _a2, p3 = m3.pg_to_up_acting_osds(pid, ps)
+            if p3 == primary or p3 not in c.osds:
+                time.sleep(0.3)
+                continue
+            rep = append_req(c.osds[p3], tid, m3.epoch)
             if rep.retval == 0:
                 break
             # transient refusals while the cluster converges: -11
