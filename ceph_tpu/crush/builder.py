@@ -7,7 +7,91 @@ generator used by golden tests (the analog of crushtool --build).
 """
 from __future__ import annotations
 
-from .types import CrushMap, Rule, RuleOp, RuleStep, Straw2Bucket
+from .types import (
+    BUCKET_LIST,
+    BUCKET_STRAW,
+    BUCKET_STRAW2,
+    BUCKET_TREE,
+    BUCKET_UNIFORM,
+    CrushMap,
+    Rule,
+    RuleOp,
+    RuleStep,
+    Straw2Bucket,
+)
+
+
+def calc_straws(weights: list[int]) -> list[int]:
+    """16.16 straw scaling factors for a legacy straw bucket
+    (reference: builder.c :: crush_calc_straw).  Items are processed in
+    increasing weight order; each distinct weight tier lengthens the
+    straws of everything still standing so the expected win probability
+    tracks the weights.  (The classic straw algorithm this reproduces is
+    the one straw2 replaced precisely because this scaling is only
+    approximately fair for some weight patterns.)
+
+    NOTE: the reference mount is empty this round, so this is a
+    reconstruction of the published algorithm; what the repo GUARANTEES
+    is internal bit-exactness — straws are computed once, here, and all
+    three mappers consume the same table."""
+    size = len(weights)
+    if size == 0:
+        return []
+    order = sorted(range(size), key=lambda i: (weights[i], i))
+    straws = [0] * size
+    numleft = size
+    straw = 1.0
+    wbelow = 0.0
+    lastw = 0.0
+    i = 0
+    while i < size:
+        idx = order[i]
+        if weights[idx] == 0:
+            straws[idx] = 0
+            i += 1
+            continue
+        straws[idx] = int(straw * 0x10000)
+        i += 1
+        if i == size:
+            break
+        nxt = order[i]
+        if weights[nxt] == weights[idx]:
+            continue  # same tier: same straw length
+        # close the tier: probability mass below this weight
+        wbelow += (float(weights[idx]) - lastw) * numleft
+        numleft = size - i  # items still standing (strictly heavier)
+        wnext = float(numleft * (weights[nxt] - weights[idx]))
+        pbelow = wbelow / (wbelow + wnext)
+        straw *= pbelow ** (-1.0 / numleft) if numleft else 1.0
+        lastw = float(weights[idx])
+    return straws
+
+
+def calc_tree_nodes(weights: list[int]) -> list[int]:
+    """Implicit-binary-tree node weights for a tree bucket (reference:
+    builder.c :: crush_make_tree_bucket): leaves live at odd indices
+    1,3,..,2i+1; an internal node's weight is the sum of its subtree.
+    Array length is 1 << depth where depth covers 2*size slots."""
+    size = len(weights)
+    if size == 0:
+        return []
+    depth = 1
+    while (1 << depth) < size * 2:
+        depth += 1
+    nodes = [0] * (1 << depth)
+    for i, w in enumerate(weights):
+        node = i * 2 + 1
+        nodes[node] = w
+        n = node
+        while n != (1 << (depth - 1)):
+            # parent(n): set the bit above the lowest set bit, clear it
+            kb = n & -n
+            parent = (n | (kb << 1)) & ~kb
+            if parent >= len(nodes):
+                break
+            nodes[parent] += w
+            n = parent
+    return nodes
 
 
 def make_straw2_bucket(
@@ -17,8 +101,12 @@ def make_straw2_bucket(
     weights: list[int],
     bucket_id: int | None = None,
     name: str | None = None,
+    alg: int = BUCKET_STRAW2,
 ) -> Straw2Bucket:
-    """builder.c :: crush_make_straw2_bucket + crush_add_bucket."""
+    """builder.c :: crush_make_<alg>_bucket + crush_add_bucket — one
+    constructor covering all five algorithms (alg selects; straw/tree
+    aux tables are derived here, at build time, like the reference
+    builder does)."""
     if len(items) != len(weights):
         raise ValueError("items and weights must have equal length")
     if bucket_id is None:
@@ -29,7 +117,14 @@ def make_straw2_bucket(
         raise ValueError("bucket ids are negative")
     if bucket_id in cmap.buckets:
         raise ValueError(f"bucket {bucket_id} exists")
-    b = Straw2Bucket(id=bucket_id, type=type_id, items=list(items), weights=list(weights))
+    b = Straw2Bucket(id=bucket_id, type=type_id, items=list(items),
+                     weights=list(weights), alg=alg)
+    if alg == BUCKET_STRAW:
+        b.straws = calc_straws(b.weights)
+    elif alg == BUCKET_TREE:
+        b.node_weights = calc_tree_nodes(b.weights)
+    elif alg == BUCKET_UNIFORM and len(set(weights)) > 1:
+        raise ValueError("uniform buckets need equal item weights")
     cmap.buckets[bucket_id] = b
     for it in items:
         if it >= 0:

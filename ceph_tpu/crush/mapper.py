@@ -25,10 +25,14 @@ Design:
 - int64-exact: draws are div64_s64-style truncating divisions on int64
   (x64 scoped to the CRUSH traces; a global flip breaks Mosaic compiles).
 
-Scope matches the scalar twin (ceph_tpu/crush/reference_mapper.py): straw2
-buckets, modern tunables (stable=1, vary_r=1, local retries 0).  The scalar
-Python, the C++ oracle, and this mapper must agree bit-for-bit on every
-input — enforced by tests/test_crush.py over random maps and large x sweeps.
+Scope: modern tunables (stable=1, vary_r=1, local retries 0).  The jax
+lanes implement straw2 — the algorithm every real deployment uses for
+data; maps carrying LEGACY bucket algorithms (uniform/list/tree/straw,
+crush.h CRUSH_BUCKET_*) are detected at compile time and the batch API
+routes them to the compiled C oracle (tests/test_crush_legacy_buckets.py
+proves 3-way bit-exactness).  The scalar Python, the C++ oracle, and
+this mapper must agree bit-for-bit on every input — enforced by
+tests/test_crush.py over random maps and large x sweeps.
 """
 from __future__ import annotations
 
@@ -112,12 +116,31 @@ class CompiledCrushMap:
         weights = np.zeros((max(n_idx, 1), max_size), dtype=np.int64)
         sizes = np.zeros(max(n_idx, 1), dtype=np.int32)
         types = np.zeros(max(n_idx, 1), dtype=np.int32)
+        algs = np.full(max(n_idx, 1), 5, dtype=np.int32)  # straw2
+        straws = np.zeros((max(n_idx, 1), max_size), dtype=np.int64)
+        max_nodes = 1
+        for b in cmap.buckets.values():
+            if getattr(b, "node_weights", None):
+                max_nodes = max(max_nodes, len(b.node_weights))
+        nodes = np.zeros((max(n_idx, 1), max_nodes), dtype=np.int64)
         for bid, b in cmap.buckets.items():
             i = -1 - bid
             items[i, : b.size] = b.items
             weights[i, : b.size] = b.weights
             sizes[i] = b.size
             types[i] = b.type
+            algs[i] = getattr(b, "alg", 5)
+            if getattr(b, "straws", None):
+                straws[i, : b.size] = b.straws
+            if getattr(b, "node_weights", None):
+                nodes[i, : len(b.node_weights)] = b.node_weights
+        self.algs = algs
+        self.straws = straws
+        self.node_weights = nodes
+        self.max_nodes = max_nodes
+        #: True iff every bucket is straw2 — the jax/Pallas batch path
+        #: covers exactly this; legacy maps route to the C oracle
+        self.straw2_only = bool((algs[: max(n_idx, 1)] == 5).all()) if n_idx else True
         with enable_x64():
             self.items = jnp.asarray(items)
             self.weights = jnp.asarray(weights)
@@ -344,7 +367,23 @@ def crush_do_rule_batch(
     firstn results are dense with ITEM_NONE tail padding; indep results keep
     positional ITEM_NONE holes (EC shard semantics).  Arbitrary
     TAKE/CHOOSE/EMIT chains are interpreted (multi-choose rules flatten the
-    working vector into the lane axis)."""
+    working vector into the lane axis).
+
+    Maps containing LEGACY bucket algorithms (uniform/list/tree/straw)
+    route to the compiled C oracle: the jax/Pallas lanes implement
+    straw2 — the algorithm every real deployment uses for data — and the
+    legacy types exist for map-ingest parity, where C-speed batch
+    evaluation is ample (uniform buckets are additionally STATEFUL per
+    (x, rule) via their permutation cache, which is hostile to the
+    fixed-trip vectorization)."""
+    if not getattr(cm, "straw2_only", True):
+        from .oracle_bridge import do_rule_steps_oracle
+
+        out = do_rule_steps_oracle(
+            cm.cmap, rule_id, np.asarray(xs), numrep,
+            np.asarray(weightvec), choose_args, cm=cm,
+        )
+        return jnp.asarray(out)
     key = (rule_id, numrep, choose_args)
 
     def build_and_cache():

@@ -28,14 +28,18 @@ def _crush_lib() -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, _u32p, ctypes.c_long, _u32p, ctypes.c_int,
-        ctypes.c_void_p, ctypes.c_int, _i32p,
+        ctypes.c_void_p, ctypes.c_int,
+        _i32p, _i64p, _i64p, ctypes.c_int,  # algs/straws/nodes/max_nodes
+        _i32p,
     ]
     lib.cro_do_rule_batch.restype = ctypes.c_int
     lib.cro_do_rule_steps.argtypes = [
         _i32p, _i64p, _i32p, _i32p,
         ctypes.c_int, ctypes.c_int, _i32p, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, _u32p, ctypes.c_long, _u32p, ctypes.c_int,
-        ctypes.c_void_p, ctypes.c_int, _i32p,
+        ctypes.c_void_p, ctypes.c_int,
+        _i32p, _i64p, _i64p, ctypes.c_int,  # algs/straws/nodes/max_nodes
+        _i32p,
     ]
     lib.cro_do_rule_steps.restype = ctypes.c_int
     lib.cro_hash3.argtypes = [ctypes.c_uint32] * 3
@@ -79,6 +83,10 @@ def _marshal(cm: CompiledCrushMap, xs, weightvec,
         xs=np.ascontiguousarray(xs, dtype=np.uint32),
         wv=np.ascontiguousarray(weightvec, dtype=np.uint32),
         cw=None, positions=0, cw_ptr=None,
+        algs=np.ascontiguousarray(cm.algs, dtype=np.int32),
+        straws=np.ascontiguousarray(cm.straws, dtype=np.int64),
+        nodes=np.ascontiguousarray(cm.node_weights, dtype=np.int64),
+        max_nodes=int(cm.max_nodes),
     )
     if choose_args is not None:
         cw = np.ascontiguousarray(
@@ -124,7 +132,9 @@ def do_rule_steps_oracle(
         a["types"], a["items"].shape[0], a["items"].shape[1],
         steps.reshape(-1), len(rule.steps), numrep,
         cmap.tunables.choose_total_tries, a["xs"], len(a["xs"]), a["wv"],
-        len(a["wv"]), a["cw_ptr"], a["positions"], out.reshape(-1),
+        len(a["wv"]), a["cw_ptr"], a["positions"],
+        a["algs"], a["straws"].reshape(-1), a["nodes"].reshape(-1),
+        a["max_nodes"], out.reshape(-1),
     )
     if rc != 0:
         raise ValueError(f"cro_do_rule_steps failed rc={rc}")
@@ -159,7 +169,9 @@ def do_rule_batch_oracle(
         a["types"], a["items"].shape[0], a["items"].shape[1], p["take"],
         p["want"], p["type"], int(p["firstn"]), int(p["recurse"]),
         p["tries"], recurse_tries, a["xs"], len(a["xs"]), a["wv"],
-        len(a["wv"]), a["cw_ptr"], a["positions"], out.reshape(-1),
+        len(a["wv"]), a["cw_ptr"], a["positions"],
+        a["algs"], a["straws"].reshape(-1), a["nodes"].reshape(-1),
+        a["max_nodes"], out.reshape(-1),
     )
     if rc != 0:
         raise ValueError(f"cro_do_rule_batch failed rc={rc}")

@@ -1,7 +1,8 @@
 """Scalar CRUSH mapper — the Python mirror of mapper.c, semantic ground truth.
 
 Reference: src/crush/mapper.c :: crush_do_rule, crush_choose_firstn,
-crush_choose_indep, bucket_straw2_choose, is_out.  This is the slow,
+crush_choose_indep, the per-algorithm bucket chooses (straw2 plus the
+legacy uniform/list/tree/straw types), is_out.  This is the slow,
 readable twin of the vectorized TPU mapper (ceph_tpu/crush/mapper.py) and of
 the C++ oracle (native/crush_oracle.cc); all three must agree bit-for-bit.
 
@@ -19,7 +20,17 @@ in-repo implementations, not against Ceph binaries, this round.
 from __future__ import annotations
 
 from .ln_table import CRUSH_LN_TABLE, LN_BIAS
-from .types import ITEM_NONE, CrushMap, RuleOp, Straw2Bucket
+from .types import (
+    BUCKET_LIST,
+    BUCKET_STRAW,
+    BUCKET_STRAW2,
+    BUCKET_TREE,
+    BUCKET_UNIFORM,
+    ITEM_NONE,
+    CrushMap,
+    RuleOp,
+    Straw2Bucket,
+)
 
 S64_MIN = -(1 << 63)
 _M32 = 0xFFFFFFFF
@@ -59,6 +70,22 @@ def _hash3(x: int, b: int, r: int) -> int:
     y, a, h = _mix_int(y, a, h)
     b, x_, h = _mix_int(b, x_, h)
     y, c, h = _mix_int(y, c, h)
+    return h
+
+
+def _hash4(a: int, b: int, c: int, d: int) -> int:
+    """hash.c :: crush_hash32_rjenkins1_4 over plain ints (the jnp twin
+    in crush/hash.py is for traced code; these scalar loops need the
+    sub-microsecond path like _hash2/_hash3 above)."""
+    a, b, c, d = a & _M32, b & _M32, c & _M32, d & _M32
+    h = (_SEED ^ a ^ b ^ c ^ d) & _M32
+    x, y = 231232, 1232
+    a, b, h = _mix_int(a, b, h)
+    c, d, h = _mix_int(c, d, h)
+    a, x, h = _mix_int(a, x, h)
+    y, b, h = _mix_int(y, b, h)
+    c, x, h = _mix_int(c, x, h)
+    y, d, h = _mix_int(y, d, h)
     return h
 
 
@@ -121,6 +148,113 @@ def bucket_straw2_choose(
     return bucket.items[high]
 
 
+def bucket_uniform_choose(bucket, work: dict, x: int, r: int) -> int:
+    """mapper.c :: bucket_perm_choose — uniform buckets pick via a lazily
+    built pseudo-random permutation CACHED PER (bucket, x) in the
+    rule-invocation work space (reference: crush_work_bucket).  The
+    cache is semantic, not an optimization: mixing r values for one x
+    must walk ONE permutation, including the optimized r==0 shortcut's
+    cleanup, to reproduce mapper.c bit-for-bit."""
+    size = bucket.size
+    pr = r % size
+    st = work.setdefault(bucket.id, {"perm_x": None, "perm_n": 0, "perm": []})
+    if st["perm_x"] != x or st["perm_n"] == 0:
+        st["perm_x"] = x
+        if pr == 0:
+            s0 = _hash3(x, bucket.id, 0) % size
+            st["perm"] = [s0]
+            st["perm_n"] = 0xFFFF  # magic: only slot 0 materialized
+            return bucket.items[s0]
+        st["perm"] = list(range(size))
+        st["perm_n"] = 0
+    elif st["perm_n"] == 0xFFFF:
+        # clean up after the r==0 shortcut: materialize the identity and
+        # swap slot 0's winner into place
+        s0 = st["perm"][0]
+        st["perm"] = list(range(size))
+        st["perm"][0], st["perm"][s0] = st["perm"][s0], st["perm"][0]
+        st["perm_n"] = 1
+    perm = st["perm"]
+    while st["perm_n"] <= pr:
+        p = st["perm_n"]
+        if p < size - 1:
+            i = _hash3(x, bucket.id, p) % (size - p)
+            if i:
+                perm[p], perm[p + i] = perm[p + i], perm[p]
+        st["perm_n"] += 1
+    return bucket.items[perm[pr]]
+
+
+def bucket_list_choose(bucket, x: int, r: int) -> int:
+    """mapper.c :: bucket_list_choose — walk from the TAIL; each item
+    wins with probability weight/sum-so-far via a 16-bit draw scaled by
+    the cumulative weight."""
+    cum = 0
+    sums = []
+    for w in bucket.weights:
+        cum += w
+        sums.append(cum)
+    for i in range(bucket.size - 1, -1, -1):
+        w = _hash4(x, bucket.items[i], r, bucket.id) & 0xFFFF
+        w = (w * sums[i]) >> 16
+        if w < bucket.weights[i]:
+            return bucket.items[i]
+    return bucket.items[0]  # "bad list sums" fallback
+
+
+def bucket_tree_choose(bucket, x: int, r: int) -> int:
+    """mapper.c :: bucket_tree_choose — descend the implicit binary tree
+    (leaves at odd indices), hashing a split point against the left
+    subtree's weight at each internal node."""
+    nodes = bucket.node_weights
+    depth = len(nodes).bit_length() - 1
+    n = 1 << (depth - 1)  # root
+    # an all-zero subtree (zero-weight bucket) collapses to the first
+    # item, exactly as the oracle's root-collapse loop does
+    while n > 1 and nodes[n] == 0:
+        n >>= 1
+    while not (n & 1):
+        w = nodes[n]
+        t = (_hash4(x, n, r, bucket.id) * w) >> 32
+        h = (n & -n) >> 1  # half the subtree span
+        left = n - h
+        n = left if t < nodes[left] else n + h
+    return bucket.items[n >> 1]
+
+
+def bucket_straw_choose(bucket, x: int, r: int) -> int:
+    """mapper.c :: bucket_straw_choose — 16-bit draw times the
+    build-time straw scaling factor; longest straw wins."""
+    high = 0
+    high_draw = -1
+    for i, item in enumerate(bucket.items):
+        draw = (_hash3(x, item, r) & 0xFFFF) * bucket.straws[i]
+        if i == 0 or draw > high_draw:
+            high = i
+            high_draw = draw
+    return bucket.items[high]
+
+
+def bucket_choose(bucket, x: int, r: int, weights=None,
+                  work: dict | None = None) -> int:
+    """Per-algorithm dispatch (mapper.c :: crush_bucket_choose).
+    choose_args weight-set overrides apply to straw2 only — the legacy
+    algorithms predate weight sets."""
+    alg = getattr(bucket, "alg", BUCKET_STRAW2)
+    if alg == BUCKET_STRAW2:
+        return bucket_straw2_choose(bucket, x, r, weights)
+    if alg == BUCKET_STRAW:
+        return bucket_straw_choose(bucket, x, r)
+    if alg == BUCKET_LIST:
+        return bucket_list_choose(bucket, x, r)
+    if alg == BUCKET_TREE:
+        return bucket_tree_choose(bucket, x, r)
+    if alg == BUCKET_UNIFORM:
+        return bucket_uniform_choose(bucket, work if work is not None else {},
+                                     x, r)
+    raise ValueError(f"unknown bucket alg {alg}")
+
+
 def is_out(cmap: CrushMap, weight: list[int], item: int, x: int) -> bool:
     """mapper.c :: is_out — probabilistic rejection by OSD reweight
     (the `weight` vector is the per-device reweight, 16.16)."""
@@ -149,8 +283,11 @@ def _choose_firstn(
     out2: list[int] | None,
     parent_r: int,
     choose_args=None,
+    work: dict | None = None,
 ) -> int:
     """mapper.c :: crush_choose_firstn under modern tunables."""
+    if work is None:
+        work = {}
     t = cmap.tunables
     stable = t.chooseleaf_stable
     rep_range = range(0, numrep) if stable else range(outpos, numrep)
@@ -167,9 +304,10 @@ def _choose_firstn(
                 if in_bucket.size == 0:
                     reject = True
                     break
-                item = bucket_straw2_choose(
+                item = bucket_choose(
                     in_bucket, x, r,
                     _arg_weights(choose_args, in_bucket, outpos),
+                    work,
                 )
                 itemtype = cmap.item_type(item)
                 if itemtype != type_:
@@ -200,6 +338,7 @@ def _choose_firstn(
                             None,
                             sub_r,
                             choose_args,
+                            work,
                         )
                         if out2_pos <= outpos:
                             reject = True  # didn't get a leaf
@@ -239,9 +378,12 @@ def _choose_indep(
     out2: list[int] | None,
     parent_r: int,
     choose_args=None,
+    work: dict | None = None,
 ) -> None:
     """mapper.c :: crush_choose_indep — positional (EC) variant; failed
     positions end as ITEM_NONE so shard ids stay stable."""
+    if work is None:
+        work = {}
     endpos = outpos + left
     for rep in range(outpos, endpos):
         out[rep] = None  # CRUSH_ITEM_UNDEF stand-in
@@ -266,9 +408,10 @@ def _choose_indep(
                 # mapper.c passes the choose's outpos (0 at top level) as the
                 # weight-set position here; only the leaf recursion, whose
                 # outpos is the shard position, varies by rep
-                item = bucket_straw2_choose(
+                item = bucket_choose(
                     in_bucket, x, r,
                     _arg_weights(choose_args, in_bucket, outpos),
+                    work,
                 )
                 itemtype = cmap.item_type(item)
                 if itemtype != type_:
@@ -290,7 +433,7 @@ def _choose_indep(
                         _choose_indep(
                             cmap, cmap.buckets[item], weight, x, 1, numrep,
                             0, out2, rep, recurse_tries, 0, False, None, r,
-                            choose_args,
+                            choose_args, work,
                         )
                         if out2[rep] == ITEM_NONE:
                             break
@@ -328,6 +471,9 @@ def crush_do_rule(
     t = cmap.tunables
     working: list[int] = []
     result: list[int] = []
+    # per-invocation scratch (reference: crush_work) — uniform buckets'
+    # permutation cache lives here, shared across the rule's steps
+    work: dict = {}
     choose_tries = t.choose_total_tries
     chooseleaf_tries = 0
     for step in rule.steps:
@@ -358,7 +504,7 @@ def crush_do_rule(
                     pos = _choose_firstn(
                         cmap, bucket, weight, x, want, step.arg2, out, 0,
                         choose_tries, rt if recurse else choose_tries,
-                        recurse, out2, 0, choose_args,
+                        recurse, out2, 0, choose_args, work,
                     )
                     chosen = (out2 if recurse else out)[:pos]
                 else:
@@ -366,6 +512,7 @@ def crush_do_rule(
                         cmap, bucket, weight, x, want, want, step.arg2, out,
                         0, choose_tries,
                         chooseleaf_tries or 1, recurse, out2, 0, choose_args,
+                        work,
                     )
                     chosen = (out2 if recurse else out)[:want]
                 new_working.extend(chosen)

@@ -1,8 +1,10 @@
 """CRUSH map data model — crush.h structs re-done as Python dataclasses.
 
-Reference: src/crush/crush.h :: crush_map, crush_bucket_straw2, crush_rule,
-crush_rule_step.  Only straw2 buckets are modeled: straw2 has been the
-default and recommended bucket algorithm since Hammer (allowed_bucket_algs in
+Reference: src/crush/crush.h :: crush_map, crush_bucket_* variants,
+crush_rule, crush_rule_step.  All five bucket algorithms are modeled:
+straw2 (the default and recommended algorithm since Hammer), plus the
+legacy uniform/list/tree/straw types real decompiled maps still carry
+(allowed_bucket_algs in
 the modern tunable profiles), and the balancer/upmap machinery the north star
 accelerates assumes it.  Bucket ids are negative (-1-index), devices are
 non-negative ints, exactly as in the reference.
@@ -39,15 +41,39 @@ ITEM_UNDEF = -0x7FFFFFFF
 ITEM_NONE = -0x7FFFFFFE
 
 
+#: bucket algorithms (reference: crush.h CRUSH_BUCKET_*)
+BUCKET_UNIFORM = 1
+BUCKET_LIST = 2
+BUCKET_TREE = 3
+BUCKET_STRAW = 4
+BUCKET_STRAW2 = 5
+
+BUCKET_ALG_NAMES = {
+    BUCKET_UNIFORM: "uniform", BUCKET_LIST: "list", BUCKET_TREE: "tree",
+    BUCKET_STRAW: "straw", BUCKET_STRAW2: "straw2",
+}
+
+
 @dataclass
 class Straw2Bucket:
-    """reference: crush.h :: crush_bucket_straw2 (+ crush_bucket header)."""
+    """reference: crush.h :: crush_bucket_straw2 and siblings (the
+    crush_bucket header + per-alg payload).  The class predates the
+    legacy algorithms and keeps its name; `alg` selects the choose
+    function.  Aux fields:
+    - straw buckets carry `straws` (16.16 scaling factors derived from
+      the weights at build time, reference: builder.c crush_calc_straw);
+    - tree buckets carry `node_weights` (the implicit binary tree of
+      builder.c, leaves at odd indices, internal nodes summing children);
+    - uniform buckets treat weights[0] as the shared item weight."""
 
     id: int  # negative
     type: int  # bucket type id (>0; devices are type 0)
     items: list[int] = field(default_factory=list)
     weights: list[int] = field(default_factory=list)  # 16.16 fixed-point
     hash_id: int = 0  # CRUSH_HASH_RJENKINS1
+    alg: int = BUCKET_STRAW2
+    straws: list[int] = field(default_factory=list)        # straw only
+    node_weights: list[int] = field(default_factory=list)  # tree only
 
     @property
     def size(self) -> int:

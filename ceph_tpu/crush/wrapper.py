@@ -17,7 +17,7 @@ import numpy as np
 
 from .mapper import CompiledCrushMap, crush_do_rule_batch, validate_choose_args
 from .reference_mapper import crush_do_rule
-from .types import CrushMap, Rule, RuleOp, RuleStep, Straw2Bucket, Tunables
+from .types import BUCKET_ALG_NAMES, BUCKET_STRAW, BUCKET_TREE, BUCKET_UNIFORM, CrushMap, Rule, RuleOp, RuleStep, Straw2Bucket, Tunables
 
 _OP_NAMES = {
     RuleOp.TAKE: "take",
@@ -355,7 +355,7 @@ class CrushWrapper:
             b = m.buckets[bid]
             lines.append(f"{self.type_name(b.type)} {self.name_of(bid)} {{")
             lines.append(f"\tid {bid}")
-            lines.append("\talg straw2")
+            lines.append(f"\talg {BUCKET_ALG_NAMES[getattr(b, 'alg', 5)]}")
             lines.append("\thash 0\t# rjenkins1")
             for it, w in zip(b.items, b.weights):
                 lines.append(f"\titem {self.name_of(it)} weight {w / 0x10000:.5f}")
@@ -476,11 +476,10 @@ class CrushWrapper:
                 if tok[0] == "id":
                     cur_bucket.id = int(tok[1])
                 elif tok[0] == "alg":
-                    if tok[1] != "straw2":
-                        raise ValueError(
-                            f"bucket alg {tok[1]!r} unsupported (straw2 only; "
-                            "see ceph_tpu/crush/types.py)"
-                        )
+                    by_name = {v: k for k, v in BUCKET_ALG_NAMES.items()}
+                    if tok[1] not in by_name:
+                        raise ValueError(f"bucket alg {tok[1]!r} unknown")
+                    cur_bucket.alg = by_name[tok[1]]
                 elif tok[0] == "hash":
                     cur_bucket.hash_id = int(tok[1])
                 elif tok[0] == "item":
@@ -493,6 +492,27 @@ class CrushWrapper:
                     for iname, wf in pending_items:
                         cur_bucket.items.append(resolve(iname))
                         cur_bucket.weights.append(int(round(wf * 0x10000)))
+                    # legacy aux tables are BUILD-time artifacts: derive
+                    # them on ingest exactly as the builder does — and
+                    # apply the builder's validation so the same invalid
+                    # map is rejected regardless of entry point
+                    if (
+                        cur_bucket.alg == BUCKET_UNIFORM
+                        and len(set(cur_bucket.weights)) > 1
+                    ):
+                        raise ValueError(
+                            f"uniform bucket {bname!r} has unequal item "
+                            f"weights"
+                        )
+                    if cur_bucket.alg == BUCKET_STRAW:
+                        from .builder import calc_straws
+
+                        cur_bucket.straws = calc_straws(cur_bucket.weights)
+                    elif cur_bucket.alg == BUCKET_TREE:
+                        from .builder import calc_tree_nodes
+
+                        cur_bucket.node_weights = calc_tree_nodes(
+                            cur_bucket.weights)
                     m.buckets[cur_bucket.id] = cur_bucket
                     m.bucket_names[cur_bucket.id] = bname
                     names_to_resolve[bname] = cur_bucket.id

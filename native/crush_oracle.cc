@@ -11,8 +11,10 @@
 // Uses the generated crush_tables.h (emitted by ceph_tpu/crush/ln_table.py)
 // so the fixed-point log table is byte-identical across all implementations.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 #include "crush_tables.h"
 
@@ -46,6 +48,19 @@ uint32_t hash3(uint32_t a, uint32_t b, uint32_t c) {
   return h;
 }
 
+uint32_t hash4(uint32_t a, uint32_t b, uint32_t c, uint32_t d) {
+  // hash.c :: crush_hash32_rjenkins1_4 (must match crush/hash.py)
+  uint32_t h = SEED ^ a ^ b ^ c ^ d;
+  uint32_t x = 231232u, y = 1232u;
+  MIX(a, b, h);
+  MIX(c, d, h);
+  MIX(a, x, h);
+  MIX(y, b, h);
+  MIX(c, x, h);
+  MIX(y, d, h);
+  return h;
+}
+
 uint32_t hash2(uint32_t a, uint32_t b) {
   uint32_t h = SEED ^ a ^ b;
   uint32_t x = 231232u, y = 1232u;
@@ -62,6 +77,11 @@ struct Map {
   const int32_t* types;    // [n_buckets]
   int n_buckets;
   int max_size;
+  // legacy bucket algorithms (crush.h CRUSH_BUCKET_*): null = all straw2
+  const int32_t* algs = nullptr;          // [n_buckets]
+  const int64_t* straws = nullptr;        // [n_buckets * max_size] 16.16
+  const int64_t* node_weights = nullptr;  // [n_buckets * max_nodes]
+  int max_nodes = 0;
   const uint32_t* weightvec;  // [n_devices] device reweights 16.16
   int n_devices;
   // choose_args weight-set (crush_choose_arg_map analog):
@@ -86,6 +106,130 @@ struct Map {
 };
 
 int64_t div_trunc(int64_t a, int64_t b) { return a / b; }  // C is truncating
+
+// reference: crush_work_bucket — per-do_rule scratch holding uniform
+// buckets' lazily built permutations (the cache is SEMANTIC: mixing r
+// values for one x must walk one permutation, r==0 shortcut included)
+struct PermWork {
+  std::vector<int32_t> perm;
+  std::vector<uint32_t> perm_x;
+  std::vector<uint32_t> perm_n;
+  std::vector<uint8_t> fresh;
+  int max_size = 0;
+  void init(int n_buckets, int ms) {
+    max_size = ms;
+    perm.assign((size_t)n_buckets * ms, 0);
+    perm_x.assign(n_buckets, 0);
+    perm_n.assign(n_buckets, 0);
+    fresh.assign(n_buckets, 1);
+  }
+  void reset() {
+    std::fill(fresh.begin(), fresh.end(), 1);
+  }
+};
+
+// mapper.c :: bucket_perm_choose (uniform buckets)
+int uniform_choose(const Map& m, PermWork& work, int bucket_idx, uint32_t x,
+                   uint32_t r) {
+  const int size = m.sizes[bucket_idx];
+  const int32_t* items = m.items + (size_t)bucket_idx * m.max_size;
+  const int32_t bid = -1 - bucket_idx;
+  const unsigned pr = r % (unsigned)size;
+  int32_t* perm = work.perm.data() + (size_t)bucket_idx * work.max_size;
+  if (work.fresh[bucket_idx] || work.perm_x[bucket_idx] != x ||
+      work.perm_n[bucket_idx] == 0) {
+    work.fresh[bucket_idx] = 0;
+    work.perm_x[bucket_idx] = x;
+    if (pr == 0) {
+      const unsigned s0 = hash3(x, (uint32_t)bid, 0) % (unsigned)size;
+      perm[0] = (int32_t)s0;
+      work.perm_n[bucket_idx] = 0xffff;  // magic: only slot 0 is real
+      return items[s0];
+    }
+    for (int i = 0; i < size; ++i) perm[i] = i;
+    work.perm_n[bucket_idx] = 0;
+  } else if (work.perm_n[bucket_idx] == 0xffff) {
+    // clean up after the r==0 shortcut
+    const int32_t s0 = perm[0];
+    for (int i = 0; i < size; ++i) perm[i] = i;
+    perm[0] = s0;
+    perm[s0] = 0;
+    work.perm_n[bucket_idx] = 1;
+  }
+  while (work.perm_n[bucket_idx] <= pr) {
+    const unsigned p = work.perm_n[bucket_idx];
+    if ((int)p < size - 1) {
+      const unsigned i = hash3(x, (uint32_t)bid, p) % (unsigned)(size - p);
+      if (i) {
+        const int32_t t = perm[p + i];
+        perm[p + i] = perm[p];
+        perm[p] = t;
+      }
+    }
+    work.perm_n[bucket_idx]++;
+  }
+  return items[perm[pr]];
+}
+
+// mapper.c :: bucket_list_choose — tail-first cumulative-weight race
+int list_choose(const Map& m, int bucket_idx, uint32_t x, uint32_t r) {
+  const int size = m.sizes[bucket_idx];
+  const int32_t* items = m.items + (size_t)bucket_idx * m.max_size;
+  const int64_t* weights = m.weights + (size_t)bucket_idx * m.max_size;
+  const int32_t bid = -1 - bucket_idx;
+  std::vector<int64_t> sums((size_t)size);
+  int64_t cum = 0;
+  for (int i = 0; i < size; ++i) {
+    cum += weights[i];
+    sums[i] = cum;
+  }
+  for (int i = size - 1; i >= 0; --i) {
+    uint64_t w = hash4(x, (uint32_t)items[i], r, (uint32_t)bid) & 0xffff;
+    w = (w * (uint64_t)sums[i]) >> 16;
+    if ((int64_t)w < weights[i]) return items[i];
+  }
+  return items[0];  // "bad list sums" fallback
+}
+
+// mapper.c :: bucket_tree_choose — implicit binary tree descent
+int tree_choose(const Map& m, int bucket_idx, uint32_t x, uint32_t r) {
+  const int32_t* items = m.items + (size_t)bucket_idx * m.max_size;
+  const int64_t* nodes = m.node_weights + (size_t)bucket_idx * m.max_nodes;
+  const int32_t bid = -1 - bucket_idx;
+  int depth = 0;
+  while ((1 << (depth + 1)) <= m.max_nodes) ++depth;
+  // the bucket's own tree may be shallower than max_nodes: find its root
+  // as the highest power of two whose node weight is the bucket total
+  int n = 1 << (depth - 1);
+  while (n > 1 && nodes[n] == 0) n >>= 1;
+  while (!(n & 1)) {
+    const uint64_t w = (uint64_t)nodes[n];
+    const uint64_t t =
+        ((uint64_t)hash4(x, (uint32_t)n, r, (uint32_t)bid) * w) >> 32;
+    const int h = (n & -n) >> 1;
+    const int left = n - h;
+    n = ((int64_t)t < nodes[left]) ? left : n + h;
+  }
+  return items[n >> 1];
+}
+
+// mapper.c :: bucket_straw_choose — hashed draw times build-time straw
+int straw_choose(const Map& m, int bucket_idx, uint32_t x, uint32_t r) {
+  const int size = m.sizes[bucket_idx];
+  const int32_t* items = m.items + (size_t)bucket_idx * m.max_size;
+  const int64_t* straws = m.straws + (size_t)bucket_idx * m.max_size;
+  int high = 0;
+  int64_t high_draw = 0;
+  for (int i = 0; i < size; ++i) {
+    const int64_t draw =
+        (int64_t)(hash3(x, (uint32_t)items[i], r) & 0xffff) * straws[i];
+    if (i == 0 || draw > high_draw) {
+      high = i;
+      high_draw = draw;
+    }
+  }
+  return items[high];
+}
 
 int straw2_choose(const Map& m, int bucket_idx, uint32_t x, uint32_t r,
                   int position) {
@@ -113,6 +257,20 @@ int straw2_choose(const Map& m, int bucket_idx, uint32_t x, uint32_t r,
   return items[high];
 }
 
+int bucket_choose(const Map& m, PermWork& work, int bucket_idx, uint32_t x,
+                  uint32_t r, int position) {
+  if (bucket_idx < 0 || bucket_idx >= m.n_buckets) return ITEM_NONE_V;
+  if (m.sizes[bucket_idx] == 0) return ITEM_NONE_V;
+  const int alg = m.algs ? m.algs[bucket_idx] : 5;
+  switch (alg) {
+    case 1: return uniform_choose(m, work, bucket_idx, x, r);
+    case 2: return list_choose(m, bucket_idx, x, r);
+    case 3: return tree_choose(m, bucket_idx, x, r);
+    case 4: return straw_choose(m, bucket_idx, x, r);
+    default: return straw2_choose(m, bucket_idx, x, r, position);
+  }
+}
+
 bool is_out(const Map& m, int item, uint32_t x) {
   if (item >= m.n_devices) return true;
   const uint32_t w = m.weightvec[item];
@@ -121,27 +279,27 @@ bool is_out(const Map& m, int item, uint32_t x) {
   return (hash2(x, (uint32_t)item) & 0xffff) >= w;
 }
 
-int descend(const Map& m, int root, uint32_t x, uint32_t r, int want_type,
-            int position) {
+int descend(const Map& m, PermWork& work, int root, uint32_t x, uint32_t r,
+            int want_type, int position) {
   int item = root;
   while (item < 0 && item != ITEM_NONE_V && m.item_type(item) != want_type)
-    item = straw2_choose(m, -1 - item, x, r, position);
+    item = bucket_choose(m, work, -1 - item, x, r, position);
   // a device of the wrong type is a dead end (mapper.c "bad item type")
   if (want_type != 0 && item >= 0) return ITEM_NONE_V;
   return item;
 }
 
 // crush_choose_firstn, modern tunables (stable=1, vary_r=1, local retries 0)
-int choose_firstn(const Map& m, int root, uint32_t x, int numrep,
-                  int want_type, int tries, bool recurse, int recurse_tries,
-                  int32_t* out, int32_t* out2) {
+int choose_firstn(const Map& m, PermWork& work, int root, uint32_t x,
+                  int numrep, int want_type, int tries, bool recurse,
+                  int recurse_tries, int32_t* out, int32_t* out2) {
   int outpos = 0;
   for (int rep = 0; rep < numrep; ++rep) {
     bool done = false;
     int item = ITEM_NONE_V, leaf = ITEM_NONE_V;
     for (int ftotal = 0; ftotal < tries && !done; ++ftotal) {
       const uint32_t r = (uint32_t)(rep + ftotal);
-      const int cand = descend(m, root, x, r, want_type, outpos);
+      const int cand = descend(m, work, root, x, r, want_type, outpos);
       if (cand == ITEM_NONE_V) continue;
       bool collide = false;
       for (int i = 0; i < outpos; ++i)
@@ -152,7 +310,8 @@ int choose_firstn(const Map& m, int root, uint32_t x, int numrep,
         bool lok = false;
         int lf_leaf = ITEM_NONE_V;
         for (int lf = 0; lf < recurse_tries && !lok; ++lf) {
-          const int l = descend(m, cand, x, r + (uint32_t)lf, 0, outpos);
+          const int l =
+              descend(m, work, cand, x, r + (uint32_t)lf, 0, outpos);
           if (l < 0) continue;
           bool lcol = false;
           for (int i = 0; i < outpos; ++i)
@@ -182,9 +341,9 @@ int choose_firstn(const Map& m, int root, uint32_t x, int numrep,
 }
 
 // crush_choose_indep: positional retries r = rep + numrep*ftotal
-void choose_indep(const Map& m, int root, uint32_t x, int numrep,
-                  int want_type, int tries, bool recurse, int recurse_tries,
-                  int32_t* out, int32_t* out2) {
+void choose_indep(const Map& m, PermWork& work, int root, uint32_t x,
+                  int numrep, int want_type, int tries, bool recurse,
+                  int recurse_tries, int32_t* out, int32_t* out2) {
   for (int i = 0; i < numrep; ++i) out[i] = out2[i] = ITEM_NONE_V;
   bool placed[64] = {false};
   for (int ftotal = 0; ftotal < tries; ++ftotal) {
@@ -193,7 +352,8 @@ void choose_indep(const Map& m, int root, uint32_t x, int numrep,
       const uint32_t r = (uint32_t)(rep + numrep * ftotal);
       // weight-set position: the choose's outpos (0 at top level);
       // only the leaf recursion, whose outpos is rep, varies by shard
-      const int cand = descend(m, root, x, r, want_type, /*position=*/0);
+      const int cand =
+          descend(m, work, root, x, r, want_type, /*position=*/0);
       if (cand == ITEM_NONE_V) {
         // structural dead end: permanent NONE (crush_choose_indep keeps the
         // position at CRUSH_ITEM_NONE and never retries it)
@@ -209,7 +369,7 @@ void choose_indep(const Map& m, int root, uint32_t x, int numrep,
         bool lok = false;
         for (int lf = 0; lf < recurse_tries && !lok; ++lf) {
           const int l = descend(
-              m, cand, x, (uint32_t)(rep + numrep * lf) + r, 0, rep);
+              m, work, cand, x, (uint32_t)(rep + numrep * lf) + r, 0, rep);
           if (l < 0) continue;
           if (is_out(m, l, x)) continue;
           lok = true;
@@ -241,23 +401,30 @@ int cro_do_rule_batch(const int32_t* items, const int64_t* weights,
                       int want_type, int firstn, int recurse, int tries,
                       int recurse_tries, const uint32_t* xs, long n_x,
                       const uint32_t* weightvec, int n_devices,
-                      const int64_t* cweights, int positions, int32_t* out) {
+                      const int64_t* cweights, int positions,
+                      const int32_t* algs, const int64_t* straws,
+                      const int64_t* node_weights, int max_nodes,
+                      int32_t* out) {
   if (want <= 0 || want > 64) return -1;
   if (cweights && positions <= 0) return -1;
-  Map m{items, weights, sizes, types, n_buckets, max_size, weightvec,
-        n_devices, cweights, positions};
+  Map m{items,     weights,  sizes,     types,        n_buckets,
+        max_size,  algs,     straws,    node_weights, max_nodes,
+        weightvec, n_devices, cweights, positions};
+  PermWork work;
+  work.init(n_buckets, max_size);
   int32_t buf[64], buf2[64];
   for (long i = 0; i < n_x; ++i) {
     const uint32_t x = xs[i];
+    work.reset();  // crush_work is per do_rule invocation
     int32_t* dst = out + (size_t)i * want;
     if (firstn) {
       for (int j = 0; j < want; ++j) buf[j] = buf2[j] = ITEM_NONE_V;
-      const int n = choose_firstn(m, take, x, want, want_type, tries,
+      const int n = choose_firstn(m, work, take, x, want, want_type, tries,
                                   recurse != 0, recurse_tries, buf, buf2);
       for (int j = 0; j < want; ++j)
         dst[j] = (j < n) ? (recurse ? buf2[j] : buf[j]) : ITEM_NONE_V;
     } else {
-      choose_indep(m, take, x, want, want_type, tries, recurse != 0,
+      choose_indep(m, work, take, x, want, want_type, tries, recurse != 0,
                    recurse_tries, buf, buf2);
       for (int j = 0; j < want; ++j) dst[j] = recurse ? buf2[j] : buf[j];
     }
@@ -278,13 +445,20 @@ int cro_do_rule_steps(const int32_t* items, const int64_t* weights,
                       int n_steps, int numrep, int default_tries,
                       const uint32_t* xs, long n_x,
                       const uint32_t* weightvec, int n_devices,
-                      const int64_t* cweights, int positions, int32_t* out) {
+                      const int64_t* cweights, int positions,
+                      const int32_t* algs, const int64_t* straws,
+                      const int64_t* node_weights, int max_nodes,
+                      int32_t* out) {
   if (numrep <= 0 || numrep > 64) return -1;
   if (cweights && positions <= 0) return -1;
-  Map m{items, weights, sizes, types, n_buckets, max_size, weightvec,
-        n_devices, cweights, positions};
+  Map m{items,     weights,  sizes,     types,        n_buckets,
+        max_size,  algs,     straws,    node_weights, max_nodes,
+        weightvec, n_devices, cweights, positions};
+  PermWork work;
+  work.init(n_buckets, max_size);
   for (long i = 0; i < n_x; ++i) {
     const uint32_t x = xs[i];
+    work.reset();
     int32_t* dst = out + (size_t)i * numrep;
     int32_t working[256];
     int wsize = 0;
@@ -325,16 +499,16 @@ int cro_do_rule_steps(const int32_t* items, const int64_t* weights,
           if (firstn) {
             const int rt = chooseleaf_tries ? chooseleaf_tries
                                             : choose_tries;
-            const int n = choose_firstn(m, parent, x, want, a2,
+            const int n = choose_firstn(m, work, parent, x, want, a2,
                                         choose_tries, recurse,
                                         recurse ? rt : choose_tries, buf,
                                         buf2);
             for (int j = 0; j < n && nwsize < 256; ++j)
               nw[nwsize++] = recurse ? buf2[j] : buf[j];
           } else {
-            choose_indep(m, parent, x, want, a2, choose_tries, recurse,
-                         chooseleaf_tries ? chooseleaf_tries : 1, buf,
-                         buf2);
+            choose_indep(m, work, parent, x, want, a2, choose_tries,
+                         recurse, chooseleaf_tries ? chooseleaf_tries : 1,
+                         buf, buf2);
             for (int j = 0; j < want && nwsize < 256; ++j)
               nw[nwsize++] = recurse ? buf2[j] : buf[j];
           }
