@@ -61,3 +61,95 @@ class CephxAuthenticator:
 
     def verify(self, nonce_hex: str, name: str, proof_hex: str) -> bool:
         return hmac.compare_digest(self.proof(nonce_hex, name), proof_hex)
+
+
+# -- tickets (reference: src/auth/cephx CephxKeyServer / CephXTicketBlob) --
+#
+# Service keys are DERIVED, not distributed: key(service, gen) =
+# HMAC(cluster-secret, "svc:{service}:{gen}").  The current generation per
+# service lives in the OSDMap (OSDMap.auth_gens), so `auth rotate` is a
+# map change that reaches every daemon through the normal paxos/subscribe
+# path — the role CephxKeyServer's rotating_secrets distribution plays.
+# Daemons accept {gen, gen-1} (the reference keeps the previous rotating
+# secret for a grace window); anything older unseals to nothing and the
+# ticket is refused.
+
+import json as _json
+import struct as _struct
+import time as _time
+
+
+def _keystream(key: bytes, n: int) -> bytes:
+    """SHA256-counter keystream (stand-in for the reference's AES-CBC —
+    the properties the tests pin are integrity, expiry, and rotation
+    refusal; the stream hides the session key from a passive reader)."""
+    out = bytearray()
+    ctr = 0
+    while len(out) < n:
+        out += hashlib.sha256(key + _struct.pack("<Q", ctr)).digest()
+        ctr += 1
+    return bytes(out[:n])
+
+
+def seal(key: bytes, obj: dict) -> str:
+    """Encrypt-then-MAC a JSON payload under `key`; hex blob."""
+    pt = _json.dumps(obj, sort_keys=True).encode()
+    iv = os.urandom(8)
+    ct = bytes(a ^ b for a, b in zip(pt, _keystream(key + iv, len(pt))))
+    tag = hmac.new(key, iv + ct, hashlib.sha256).digest()[:16]
+    return (iv + tag + ct).hex()
+
+
+def unseal(key: bytes, blob_hex: str) -> dict | None:
+    """None on ANY failure (wrong key/generation, tamper, garbage)."""
+    try:
+        raw = bytes.fromhex(blob_hex)
+        iv, tag, ct = raw[:8], raw[8:24], raw[24:]
+        want = hmac.new(key, iv + ct, hashlib.sha256).digest()[:16]
+        if not hmac.compare_digest(tag, want):
+            return None
+        pt = bytes(a ^ b for a, b in zip(ct, _keystream(key + iv, len(ct))))
+        return _json.loads(pt.decode())
+    except Exception:
+        return None
+
+
+def derive_service_key(secret: bytes, service: str, gen: int) -> bytes:
+    return hmac.new(secret, f"svc:{service}:{gen}".encode(),
+                    hashlib.sha256).digest()
+
+
+def mint_ticket(secret: bytes, entity: str, service: str, gen: int,
+                ttl: float) -> tuple[str, str]:
+    """(sealed ticket blob, session_key_hex).  The blob is sealed under
+    the SERVICE key — only daemons of that service can open it; the
+    session key goes back to the client sealed under ITS key (the mon
+    command layer does that part)."""
+    session_key = os.urandom(32).hex()
+    blob = seal(derive_service_key(secret, service, gen), {
+        "entity": entity,
+        "service": service,
+        "session_key": session_key,
+        "expires": _time.time() + ttl,
+        "gen": gen,
+    })
+    return blob, session_key
+
+
+def validate_ticket(secret: bytes, service: str, current_gen: int,
+                    blob_hex: str) -> dict | None:
+    """Daemon-side check: try the current generation and one before (the
+    rotation grace window); enforce service binding and expiry.  None =
+    refuse the connection."""
+    for gen in (current_gen, current_gen - 1):
+        if gen < 1:
+            continue
+        t = unseal(derive_service_key(secret, service, gen), blob_hex)
+        if t is None:
+            continue
+        if t.get("service") != service or t.get("gen") != gen:
+            return None
+        if t.get("expires", 0) < _time.time():
+            return None
+        return t
+    return None

@@ -140,6 +140,12 @@ class OSDMap:
         # osd -> (host, port) messenger address (reference: OSDMap
         # osd_addrs — how clients locate a mapped OSD)
         self.osd_addrs: dict[int, tuple[str, int]] = {}
+        # cephx service-key GENERATIONS (reference: the rotating secrets
+        # CephxKeyServer distributes — here each generation's key derives
+        # deterministically from the cluster secret, so bumping the
+        # generation IN THE MAP rotates every daemon atomically with the
+        # map push and needs no key-distribution protocol)
+        self.auth_gens: dict[str, int] = {}
         # cluster-wide flags, e.g. "noout"/"nodown" (reference: OSDMap
         # get_flags / CEPH_OSDMAP_NOOUT)
         self.flags: set[str] = set()
@@ -399,6 +405,7 @@ class OSDMap:
             ],
             "flags": sorted(self.flags),
             "ec_profiles": self.ec_profiles,
+            "auth_gens": self.auth_gens,
         }
 
     @classmethod
@@ -424,4 +431,5 @@ class OSDMap:
             m.osd_addrs[e["osd"]] = (e["host"], e["port"])
         m.flags = set(d.get("flags", []))
         m.ec_profiles = dict(d.get("ec_profiles", {}))
+        m.auth_gens = dict(d.get("auth_gens", {}))
         return m
