@@ -250,10 +250,25 @@ def phase_rs21() -> dict:
     )}
 
 
-def phase_crush(num_pgs=1_000_000) -> dict:
+def phase_crush(num_pgs=None) -> dict:
     """BASELINE config 5: straw2 remap over 1024 OSDs (maps/s), TPU batch
     mapper (Pallas scorer — the gather path is never compiled on TPU; it
-    has wedged the tunnel before)."""
+    has wedged the tunnel before).  CEPH_TPU_BENCH_CRUSH_PGS shrinks the
+    batch for the tunnel watchdog's cautious first probe (the full 1M-PG
+    launch is implicated in wedging the tunnel, r4)."""
+    if num_pgs is None:
+        raw = os.environ.get("CEPH_TPU_BENCH_CRUSH_PGS", "1000000")
+        try:
+            num_pgs = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"CEPH_TPU_BENCH_CRUSH_PGS={raw!r}: integer required"
+            ) from None
+        if num_pgs < 1024:
+            raise ValueError(
+                f"CEPH_TPU_BENCH_CRUSH_PGS={num_pgs}: must be >= 1024 "
+                f"(the warm-up batch size)"
+            )
     from ceph_tpu.crush import (
         CompiledCrushMap,
         build_hierarchical_map,

@@ -78,11 +78,13 @@ def _tile_from_env() -> int:
 # `tile` argument — the mapper's downshift fallback mutates it after a
 # hardware compile failure, and jit's static-arg cache keys on the
 # passed value, so the mutation takes effect on the next call.
-# The kernel walks the tile in CHUNK-row slabs with an inner fori_loop:
-# the one-hot [CHUNK, S, 256] bf16 intermediates are what blow the
-# 16 MiB scoped-vmem limit (CHUNK=64 hit ~28 MiB on v5e), so CHUNK
-# stays small while the tile — and therefore the number of grid steps,
-# each of which pays fixed Mosaic setup cost — shrinks by tile/CHUNK.
+# The kernel walks the tile in statically-unrolled CHUNK-row slabs: the
+# one-hot [CHUNK, S, 256] bf16 intermediates are what blow the 16 MiB
+# scoped-vmem limit (CHUNK=64 hit ~28 MiB on v5e), so CHUNK stays small
+# while the tile — and therefore the number of grid steps, each of which
+# pays fixed Mosaic setup cost — shrinks by tile/CHUNK.  Cost model for
+# sweeps: a larger tile means fewer grid steps but tile/CHUNK unrolled
+# slab bodies in the traced kernel, i.e. compile time grows with tile.
 DEFAULT_TILE = _tile_from_env()
 
 
@@ -141,13 +143,18 @@ def _score_kernel(x_ref, r_ref, items_ref, t1_ref, t2_ref, hi_ref, lo_ref):
             recombine_limbs(rows, 4, 3, jnp),    # ll_lo
         )
 
-    def slab(c, _):
-        # CHUNK-row slab: bounds the [CHUNK, S, 256] one-hot VMEM
-        # footprint while the grid step stays large
+    # CHUNK-row slabs: bound the [CHUNK, S, 256] one-hot VMEM footprint
+    # while the grid step stays large.  STATICALLY unrolled (T // CHUNK is
+    # a Python int — the block shape): real Mosaic has no lowering for
+    # value-level dynamic_slice (KernelType.TC, observed on v5e r4), so a
+    # fori_loop over dynamic offsets never compiles on silicon; static
+    # slices of the refs always legalize, and the compiler reuses the slab
+    # temporaries across iterations.
+    for c in range(T // CHUNK):
         row = c * CHUNK
-        x = jax.lax.dynamic_slice_in_dim(x_ref[:], row, CHUNK, 0)
-        r = jax.lax.dynamic_slice_in_dim(r_ref[:], row, CHUNK, 0)
-        items = jax.lax.dynamic_slice_in_dim(items_ref[:], row, CHUNK, 0)
+        x = x_ref[row:row + CHUNK, :]
+        r = r_ref[row:row + CHUNK, :]
+        items = items_ref[row:row + CHUNK, :]
         h = crush_hash32_3(
             x.astype(jnp.uint32),  # broadcasts [CHUNK, 1] across S
             items.astype(jnp.uint32),
@@ -155,11 +162,8 @@ def _score_kernel(x_ref, r_ref, items_ref, t1_ref, t2_ref, hi_ref, lo_ref):
         )
         u = (h & jnp.uint32(0xFFFF)).astype(jnp.int32)
         hi, lo = crush_ln_limbs(u, jnp, look1, look2)
-        hi_ref[pl.dslice(row, CHUNK), :] = hi
-        lo_ref[pl.dslice(row, CHUNK), :] = lo
-        return _
-
-    jax.lax.fori_loop(0, T // CHUNK, slab, 0)
+        hi_ref[row:row + CHUNK, :] = hi
+        lo_ref[row:row + CHUNK, :] = lo
 
 
 @partial(jax.jit, static_argnames=("tile", "interpret"))

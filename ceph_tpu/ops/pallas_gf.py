@@ -57,6 +57,28 @@ def _pick_group(rows: int, n: int) -> int:
     return min(G * 2, 64)  # one extra doubling measured fastest on v5e
 
 
+def _pick_tile(rows: int, n: int, G: int, tile: int = DEFAULT_TILE) -> int:
+    """Shrink the column tile until the kernel's VMEM working set fits.
+
+    Scoped VMEM scales linearly in the tile width: the unpacked bitplanes
+    (8*kG int8), the int32 accumulator + its bf16 parity view (8*rG each),
+    the packed f32 output (4*rG), and the in/out byte blocks.  Small
+    coding matrices (RS 8+4: ~2.3 KiB/col) run the full DEFAULT_TILE; big
+    decode/repair matrices (CLAY(8,4,d=11) repair is [64, 176]: ~10
+    KiB/col) blew the v5e 16 MiB scoped-vmem limit at 8192 (observed:
+    43 MiB requested, r4 silicon).  The 24 MiB budget is calibrated to
+    the compiler's observed ~2x buffer reuse over this naive sum — the
+    known-good RS(8,4)@8192 case sits just under it."""
+    kG, rG = n * G, rows * G
+    # bytes per tile column: bits int8 [8kG] + acc int32 [8rG] + parity
+    # bf16 [8rG] + packed f32 [rG] + in/out byte blocks
+    per_col = 8 * kG + 32 * rG + 16 * rG + 4 * rG + kG + rG
+    budget = 24 << 20
+    while tile > 512 and per_col * tile > budget:
+        tile //= 2
+    return tile
+
+
 @lru_cache(maxsize=256)
 def _kron_matrices(
     mat_bytes: bytes, shape: tuple[int, int], G: int
@@ -142,6 +164,7 @@ def apply_matrix_pallas(
     mat = np.ascontiguousarray(mat, dtype=np.uint8)
     rows, n = mat.shape
     G = _pick_group(rows, n)
+    tile = _pick_tile(rows, n, G, tile)
     Bk, Pk = _kron_matrices(mat.tobytes(), mat.shape, G)
     B = jnp.asarray(Bk)
     P = jnp.asarray(Pk, jnp.bfloat16)
