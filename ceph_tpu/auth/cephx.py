@@ -3,22 +3,35 @@
 Reference: src/auth/cephx (CephxProtocol.h: challenge/proof exchange with
 HMAC over a shared secret; src/msg ProtocolV2's auth frames carry it).
 
-Scope vs the reference, by design: one shared cluster secret (the
-`auth_shared_secret` option) stands in for the mon-brokered per-service
-ticket hierarchy — the wire exchange (server challenge -> client proof +
-counter-challenge -> server proof) and its properties (mutual proof of
-key possession, per-connection nonces so transcripts never replay) match
-CephxProtocol's session-key handshake; what's elided is ticket issuance
-and rotation, which need the mon KeyServer state machine.
+Two credential modes, mirroring the reference's split between
+intra-cluster keys and mon-brokered service tickets:
+
+- Shared-secret peers (daemons, admin clients holding the keyring): the
+  wire exchange (server challenge -> client proof + counter-challenge ->
+  server proof) matches CephxProtocol's session-key handshake; the
+  per-connection frame key is derived from both nonces
+  (`session_key_from_nonces`).
+- Ticket clients (no cluster secret): the mon mints a per-service ticket
+  (`auth get-ticket` -> `mint_ticket`); the client presents the sealed
+  blob and proves possession of the session key inside it; the serving
+  daemon opens the blob with its DERIVED service key at the OSDMap's
+  current auth generation (`validate_ticket`), so `auth rotate` cuts
+  stale tickets off cluster-wide through the normal map-propagation path
+  (the CephxKeyServer rotating_secrets role).
 
 Wire form (one line each, after the messenger banner/ident):
 
-    S->C  auth-challenge <snonce-hex>
-    C->S  auth-proof <hmac-hex> <cnonce-hex>
+    S->C  auth-challenge <snonce-hex> <service>
+    C->S  auth-proof <hmac-hex> <cnonce-hex>            (secret holders)
+    C->S  auth-ticket <blob-hex> <hmac-hex> <cnonce-hex>  (ticket clients)
     S->C  auth-ok <hmac-hex>
 
-proofs: HMAC-SHA256(secret, nonce || peer-entity-name).  A server with
-auth disabled sends no challenge (wire-compatible with unauthenticated
+proofs: HMAC-SHA256(key, nonce || peer-entity-name), key = cluster
+secret or the ticket session key.  After an authenticated handshake
+EVERY frame carries a 16-byte HMAC tag over (per-direction counter ||
+body) under the negotiated session key (`frame_tag`) — the ProtocolV2
+signed-frames role; a bad tag is connection-fatal.  A server with auth
+disabled sends no challenge (wire-compatible with unauthenticated
 peers); a client expecting auth then times out — the same hard failure a
 cephx-required cluster gives unauthenticated clients.
 """
@@ -27,7 +40,10 @@ from __future__ import annotations
 import base64
 import hashlib
 import hmac
+import json as _json
 import os
+import struct as _struct
+import time as _time
 
 
 class AuthError(Exception):
@@ -37,6 +53,37 @@ class AuthError(Exception):
 def generate_secret() -> str:
     """A fresh base64 cluster secret (`ceph-authtool --gen-key` analog)."""
     return base64.b64encode(os.urandom(32)).decode()
+
+
+def proof_hex(key: bytes, nonce_hex: str, name: str) -> str:
+    """HMAC(key, nonce || name) — the handshake proof shape, shared by the
+    shared-secret and ticket-session-key flows."""
+    return hmac.new(
+        key, bytes.fromhex(nonce_hex) + name.encode(), hashlib.sha256
+    ).hexdigest()
+
+
+def session_key_from_nonces(secret: bytes, snonce_hex: str,
+                            cnonce_hex: str) -> bytes:
+    """Per-connection frame-signing key for two shared-secret holders —
+    both sides saw both handshake nonces, so both derive it without an
+    extra round trip (the role CephxProtocol's session_key plays for
+    intra-cluster peers)."""
+    return hmac.new(
+        secret,
+        b"sess:" + bytes.fromhex(snonce_hex) + bytes.fromhex(cnonce_hex),
+        hashlib.sha256,
+    ).digest()
+
+
+def frame_tag(key: bytes, ctr: int, body: bytes) -> bytes:
+    """16-byte per-frame auth tag: HMAC(session key, counter || body).
+    The counter is per-direction, per-socket-incarnation, so a frame can
+    be neither tampered with nor replayed/reordered within a session
+    (reference: ProtocolV2 signed frames' rx/tx segment signatures)."""
+    return hmac.new(
+        key, _struct.pack("<Q", ctr) + body, hashlib.sha256
+    ).digest()[:16]
 
 
 class CephxAuthenticator:
@@ -50,17 +97,21 @@ class CephxAuthenticator:
         if len(self._secret) < 16:
             raise AuthError("auth_shared_secret shorter than 16 bytes")
 
+    @property
+    def secret(self) -> bytes:
+        return self._secret
+
     def make_nonce(self) -> str:
         return os.urandom(16).hex()
 
     def proof(self, nonce_hex: str, name: str) -> str:
-        return hmac.new(
-            self._secret, bytes.fromhex(nonce_hex) + name.encode(),
-            hashlib.sha256,
-        ).hexdigest()
+        return proof_hex(self._secret, nonce_hex, name)
 
-    def verify(self, nonce_hex: str, name: str, proof_hex: str) -> bool:
-        return hmac.compare_digest(self.proof(nonce_hex, name), proof_hex)
+    def verify(self, nonce_hex: str, name: str, proof_hex_: str) -> bool:
+        return hmac.compare_digest(self.proof(nonce_hex, name), proof_hex_)
+
+    def session_key(self, snonce_hex: str, cnonce_hex: str) -> bytes:
+        return session_key_from_nonces(self._secret, snonce_hex, cnonce_hex)
 
 
 # -- tickets (reference: src/auth/cephx CephxKeyServer / CephXTicketBlob) --
@@ -73,10 +124,6 @@ class CephxAuthenticator:
 # Daemons accept {gen, gen-1} (the reference keeps the previous rotating
 # secret for a grace window); anything older unseals to nothing and the
 # ticket is refused.
-
-import json as _json
-import struct as _struct
-import time as _time
 
 
 def _keystream(key: bytes, n: int) -> bytes:
@@ -123,8 +170,9 @@ def mint_ticket(secret: bytes, entity: str, service: str, gen: int,
                 ttl: float) -> tuple[str, str]:
     """(sealed ticket blob, session_key_hex).  The blob is sealed under
     the SERVICE key — only daemons of that service can open it; the
-    session key goes back to the client sealed under ITS key (the mon
-    command layer does that part)."""
+    session key returns to the requesting client over its authenticated,
+    frame-signed mon session (`auth get-ticket`), standing in for the
+    reference's seal-under-client-key step."""
     session_key = os.urandom(32).hex()
     blob = seal(derive_service_key(secret, service, gen), {
         "entity": entity,

@@ -32,6 +32,11 @@ class CephContext:
             lockdep.enable()
         self.perf = PerfCountersCollection()
         self.heartbeat_map = HeartbeatMap()
+        # mon-minted service tickets for cephx clients without the cluster
+        # secret: {service: {"ticket": blob_hex, "session_key": hex}};
+        # runtime credentials, not config (reference: the client-side
+        # CephXTicketManager)
+        self.tickets: dict[str, dict] = {}
         self.admin_socket: AdminSocket | None = None
         sock_path = self.conf.get("admin_socket")
         if sock_path:

@@ -107,6 +107,10 @@ def default_options() -> OptionTable:
             Option("auth_shared_secret", str, "",
                    "base64 cluster secret (cephx key analog; "
                    "auth.generate_secret() makes one)"),
+            Option("auth_service_ticket_ttl", float, 3600.0,
+                   "lifetime of mon-minted service tickets, seconds "
+                   "(reference: auth_service_ticket_ttl)", min=0.1,
+                   runtime=True),
             # -- mgr (reference: mgr.yaml.in) ------------------------------
             Option("mgr_addr", str, "",
                    "host:port daemons send MMgrReport to ('' disables)",

@@ -51,6 +51,11 @@ class MDSDaemon(Dispatcher):
         self.data_pool = data_pool
         self.messenger = Messenger.create(cct, "mds")
         self.messenger.add_dispatcher(self)
+        self.messenger.auth_gen_provider = lambda: (
+            self._rados.mc.osdmap.auth_gens.get("mds", 1)
+            if self._rados is not None and self._rados.mc.osdmap is not None
+            else 1
+        )
         self.addr: tuple[str, int] | None = None
         self._lock = threading.RLock()  # the mds_lock
         # in-memory cache (MDCache): dirfrags + ino backpointers

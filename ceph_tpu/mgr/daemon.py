@@ -29,6 +29,9 @@ class MgrDaemon(Dispatcher):
         self.messenger = Messenger.create(cct, "mgr")
         self.messenger.add_dispatcher(self)
         self.mc = MonClient(cct, mon_addrs, name="mgr-monc")
+        self.messenger.auth_gen_provider = lambda: (
+            self.mc.osdmap.auth_gens.get("mgr", 1) if self.mc.osdmap else 1
+        )
         self._reports: dict[str, dict] = {}   # daemon -> last MMgrReport view
         self._reports_lock = threading.Lock()
         self._modules: dict[str, MgrModule] = {}

@@ -93,6 +93,11 @@ class Monitor(Dispatcher):
         self.messenger = Messenger.create(cct, f"mon.{name}")
         self.messenger.default_policy = POLICY_LOSSLESS_PEER
         self.messenger.add_dispatcher(self)
+        self.messenger.auth_gen_provider = lambda: (
+            self.osdmon.osdmap.auth_gens.get("mon", 1)
+            if getattr(self, "osdmon", None) is not None
+            and self.osdmon.osdmap is not None else 1
+        )
         self.messenger.bind(monmap.addr_of(rank))
         self.elector = Elector(self)
         from .paxos import Paxos

@@ -188,7 +188,67 @@ class OSDMonitor:
             return self._cmd_upmap_items(cmd)
         if prefix == "osd tree":
             return 0, self._cmd_tree()
+        if prefix == "auth get-ticket":
+            return self._cmd_auth_ticket(cmd)
+        if prefix == "auth rotate":
+            return self._cmd_auth_rotate(cmd)
+        if prefix == "auth gens":
+            return 0, dict(self.osdmap.auth_gens) if self.osdmap else {}
         return -22, f"unknown command {prefix!r}"
+
+    # -- cephx KeyServer role (reference: src/auth/cephx CephxKeyServer;
+    # the mon mints service tickets, and rotation is an OSDMap change so
+    # it reaches every daemon through paxos + subscriptions) -------------
+    def _cluster_secret(self) -> bytes | None:
+        """Same parsing + length rules as the messengers
+        (CephxAuthenticator) — the mon must never mint tickets under a
+        secret the acceptors refuse to load."""
+        from ..auth import AuthError, CephxAuthenticator
+
+        s = self.mon.cct.conf.get("auth_shared_secret")
+        if not s:
+            return None
+        try:
+            return CephxAuthenticator(s).secret
+        except AuthError:
+            return None
+
+    def _cmd_auth_ticket(self, cmd: dict) -> tuple[int, object]:
+        """`auth get-ticket service=<svc> [entity=<name>] [ttl=<secs>]` —
+        mints a sealed service ticket + session key.  Reaches the client
+        over its (authenticated, frame-signed) mon session; a cluster
+        with auth off can still mint, which tests use to pre-provision."""
+        from ..auth import mint_ticket
+
+        secret = self._cluster_secret()
+        if secret is None:
+            return -1, "no cluster secret configured (auth_shared_secret)"
+        service = cmd.get("service", "")
+        if not service or not service.isidentifier():
+            return -22, f"bad service {service!r}"
+        entity = cmd.get("entity", "client.admin")
+        ttl = float(cmd.get("ttl")
+                    or self.mon.cct.conf.get("auth_service_ticket_ttl"))
+        gen = (self.osdmap.auth_gens.get(service, 1)
+               if self.osdmap is not None else 1)
+        blob, session_key = mint_ticket(secret, entity, service, gen, ttl)
+        return 0, {"service": service, "entity": entity, "gen": gen,
+                   "ticket": blob, "session_key": session_key}
+
+    def _cmd_auth_rotate(self, cmd: dict) -> tuple[int, object]:
+        """`auth rotate service=<svc>` — bump the service's key
+        generation in the OSDMap.  Daemons accept {gen, gen-1}
+        (validate_ticket's grace window), so one rotation starts the
+        cutover and a second one cuts stale tickets off entirely."""
+        service = cmd.get("service", "")
+        if not service or not service.isidentifier():
+            return -22, f"bad service {service!r}"
+        m = self._pending()
+        new_gen = m.auth_gens.get(service, 1) + 1
+        m.auth_gens[service] = new_gen
+        if not self._propose_map(m):
+            return -110, "proposal timed out"
+        return 0, {"service": service, "gen": new_gen}
 
     def _cmd_pool_set(self, cmd: dict) -> tuple[int, object]:
         """`osd pool set <pool> <key> <value>` — pg_num/pgp_num/size

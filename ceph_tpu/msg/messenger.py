@@ -32,9 +32,21 @@ the same effect with its per-connection event-loop thread affinity.
 Fault injection: `ms_inject_socket_failures = N` tears the socket down
 every ~N message frames sent (reference option of the same name) so higher
 layers' resend paths are testable — the teuthology msgr-failures idiom.
+
+Auth (reference: ProtocolV2 auth frames + signed frames; SURVEY.md §2.7):
+with `auth_cluster_required = cephx` the handshake runs the cephx exchange
+(ceph_tpu/auth/cephx.py wire form) in one of two modes — shared-secret
+proof (daemons, admin clients) or mon-minted service ticket (limited
+clients, validated against the OSDMap's current auth generation) — and
+every post-handshake frame then carries a 16-byte HMAC tag over
+(per-direction counter || body) under the negotiated per-connection
+session key.  A missing or bad tag is connection-fatal, so a
+post-handshake frame can be neither forged, tampered with, nor replayed
+within a session.
 """
 from __future__ import annotations
 
+import hmac as _hmac
 import random
 import socket
 import struct
@@ -42,10 +54,28 @@ import threading
 import time
 from collections import deque
 
+from ..auth.cephx import (
+    frame_tag,
+    proof_hex,
+    session_key_from_nonces,
+    validate_ticket,
+)
 from ..common.crc32c import crc32c
 from .message import Message, decode_message, encode_message
 
+_TAG_LEN = 16
+# handshake lines are bounded; the auth-ticket reply carries a sealed
+# ~450-byte hex blob plus proof + nonce, so the auth exchange gets a
+# larger budget than the short banner/ident lines
+_AUTH_LINE_LIMIT = 4096
+
 _BANNER = b"ceph_tpu msgr v1\n"
+
+
+def _os_nonce() -> str:
+    import os
+
+    return os.urandom(16).hex()
 
 _FRAME_MSG = 0
 _FRAME_ACK = 1
@@ -106,6 +136,12 @@ class Connection:
         self._replay: deque[tuple[int, bytes]] = deque()
         self._closed = False
         self._frames_sent = 0
+        # per-connection frame-signing key + send counter, reset together
+        # with every socket incarnation (fresh handshake = fresh key); the
+        # receive counter lives in the reader thread, which is also
+        # per-incarnation
+        self._frame_key: bytes | None = None
+        self._tx_ctr = 0
 
     @property
     def _lock(self) -> threading.RLock:
@@ -157,6 +193,9 @@ class Connection:
             raise OSError("not connected")
         body = bytes([ftype]) + payload
         frame = struct.pack("<II", len(body), crc32c(body)) + body
+        if self._frame_key is not None:
+            frame += frame_tag(self._frame_key, self._tx_ctr, body)
+            self._tx_ctr += 1
         self.sock.sendall(frame)
 
     def _send_ack(self, seq: int) -> None:
@@ -178,10 +217,11 @@ class Connection:
         last_err: OSError | None = None
         for _ in range(3):
             try:
-                sock = self.msgr._open_socket(
+                sock, fkey = self.msgr._open_socket(
                     self.peer_addr, self.connect_id, self.policy
                 )
                 self.sock = sock
+                self._frame_key, self._tx_ctr = fkey, 0
                 # the peer's responding half restarts at seq 1 on a fresh
                 # socket (its duplicate requests are dropped, so replies
                 # are never duplicated) — restart our receive expectation
@@ -242,12 +282,20 @@ class Messenger:
         self._auth = None
         self._auth_checked = False
 
+    def _auth_required(self) -> bool:
+        return (
+            self.cct is not None
+            and self.cct.conf.get("auth_cluster_required") == "cephx"
+        )
+
     def _authenticator(self):
+        """Shared-secret engine, or None when no secret is configured —
+        which on a cephx-required CONNECTOR means ticket mode (the
+        credentials live in cct.tickets), and on a cephx-required ACCEPTOR
+        means misconfiguration (every peer is rejected: only secret
+        holders can validate anything — fail closed)."""
         if not self._auth_checked:
-            if (
-                self.cct is not None
-                and self.cct.conf.get("auth_cluster_required") == "cephx"
-            ):
+            if self._auth_required() and self.cct.conf.get("auth_shared_secret"):
                 from ..auth import CephxAuthenticator
 
                 # construct BEFORE marking checked: a bad secret must stay
@@ -258,6 +306,19 @@ class Messenger:
                 )
             self._auth_checked = True
         return self._auth
+
+    @property
+    def auth_service(self) -> str:
+        """Service this messenger serves as, announced in the challenge so
+        ticket clients pick the right ticket: the entity-name type prefix
+        ('osd.3' -> 'osd', the reference's entity_name_t type)."""
+        return self.name.split(".", 1)[0]
+
+    # Current auth generation for ticket validation; daemons point this at
+    # their OSDMap view (osdmap.auth_gens) so `auth rotate` propagates
+    # through the normal map-subscription path (the CephxKeyServer
+    # rotating_secrets role).  None -> generation 1 (rotation never used).
+    auth_gen_provider = None
 
     @staticmethod
     def _read_line(sock: socket.socket, limit: int = 512) -> str:
@@ -338,7 +399,7 @@ class Messenger:
         fresh = Connection(
             self, None, addr, policy or self.default_policy, outgoing=True
         )
-        sock = self._open_socket(addr, fresh.connect_id, fresh.policy)
+        sock, fkey = self._open_socket(addr, fresh.connect_id, fresh.policy)
         with self._lock:
             conn = self._conns.get(addr)
             if conn is not None and conn.is_connected:
@@ -348,13 +409,16 @@ class Messenger:
                     pass
                 return conn
             fresh.sock = sock
+            fresh._frame_key = fkey
             self._conns[addr] = fresh
         self._start_reader(fresh)
         return fresh
 
     def _open_socket(
         self, addr: tuple[str, int], connect_id: int, policy: str
-    ) -> socket.socket:
+    ) -> tuple[socket.socket, bytes | None]:
+        """Dial + banner + (when cephx-required) the auth handshake.
+        Returns (socket, frame-signing key or None)."""
         timeout = self.cct.conf.get("ms_connect_timeout") if self.cct else 10.0
         sock = socket.create_connection(addr, timeout=timeout)
         sock.settimeout(None)
@@ -369,39 +433,61 @@ class Messenger:
         except Exception as e:
             sock.close()
             raise ConnectionError(f"auth misconfigured: {e}") from e
-        if auth is not None:
-            # mutual cephx-style proof (ceph_tpu/auth/cephx.py wire form).
-            # a server WITHOUT auth sends no challenge -> we time out, the
-            # same hard failure a cephx-required cluster hands a peer
-            try:
-                sock.settimeout(timeout)
-                kind, snonce = self._read_line(sock).split()
-                if kind != "auth-challenge":
-                    raise ConnectionError(f"expected challenge, got {kind}")
-                cnonce = auth.make_nonce()
+        if not self._auth_required():
+            return sock, None
+        # mutual cephx-style exchange (ceph_tpu/auth/cephx.py wire form):
+        # shared-secret proof when we hold the keyring, service ticket
+        # otherwise.  A server WITHOUT auth sends no challenge -> we time
+        # out, the same hard failure a cephx-required cluster hands a peer
+        try:
+            sock.settimeout(timeout)
+            kind, snonce, service = self._read_line(
+                sock, _AUTH_LINE_LIMIT
+            ).split()
+            if kind != "auth-challenge":
+                raise ConnectionError(f"expected challenge, got {kind}")
+            cnonce = _os_nonce()
+            if auth is not None:
                 sock.sendall(
                     f"auth-proof {auth.proof(snonce, self.name)} {cnonce}\n"
                     .encode()
                 )
-                kind, sproof = self._read_line(sock).split()
-                peer_entity = self._peer_entity_hint(addr)
-                if kind != "auth-ok" or not auth.verify(
-                    cnonce, peer_entity, sproof
-                ):
-                    raise ConnectionError("server failed mutual auth")
-                sock.settimeout(None)
-            except (OSError, ValueError) as e:
-                sock.close()
-                raise ConnectionError(f"auth handshake failed: {e}") from e
-        return sock
-
-    def _peer_entity_hint(self, addr) -> str:
-        """Entity name the server proves as.  The server signs with the
-        name it sends in auth-ok's preceding exchange — which is its
-        messenger name; since we dialed blind, the proof binds our cnonce
-        + the shared secret, and any key holder is cluster-trusted, so the
-        name contributes no extra trust.  Server signs 'cluster'."""
-        return "cluster"
+                fkey = auth.session_key(snonce, cnonce)
+            else:
+                t = (getattr(self.cct, "tickets", None) or {}).get(service)
+                if t is None:
+                    raise ConnectionError(
+                        f"server requires cephx and no secret or "
+                        f"{service!r} ticket is available"
+                    )
+                skey = bytes.fromhex(t["session_key"])
+                sock.sendall(
+                    f"auth-ticket {t['ticket']} "
+                    f"{proof_hex(skey, snonce, self.name)} {cnonce}\n"
+                    .encode()
+                )
+                # frame key mixes BOTH nonces so every socket incarnation
+                # signs under a fresh key — reusing the raw ticket session
+                # key would let frames recorded on one incarnation replay
+                # on the next at the same counter positions
+                fkey = session_key_from_nonces(skey, snonce, cnonce)
+            kind, sproof = self._read_line(sock, _AUTH_LINE_LIMIT).split()
+            # the server proves as 'cluster': any cluster-secret holder is
+            # equally trusted, so the entity name adds nothing (proof
+            # mode); in ticket mode it proves possession of the ticket's
+            # session key, which only a service-key holder could unseal
+            if kind != "auth-ok" or not _hmac.compare_digest(
+                proof_hex(skey, cnonce, "cluster")
+                if auth is None
+                else auth.proof(cnonce, "cluster"),
+                sproof,
+            ):
+                raise ConnectionError("server failed mutual auth")
+            sock.settimeout(None)
+        except (OSError, ValueError) as e:
+            sock.close()
+            raise ConnectionError(f"auth handshake failed: {e}") from e
+        return sock, fkey
 
     # -- incoming ---------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -443,6 +529,7 @@ class Messenger:
         except ValueError:
             sock.close()
             return
+        fkey: bytes | None = None
         try:
             auth = self._authenticator()
         except Exception as e:
@@ -451,21 +538,60 @@ class Messenger:
             self._dout(0, f"auth misconfigured, rejecting {peer}: {e}")
             sock.close()
             return
-        if auth is not None:
+        if self._auth_required():
+            if auth is None:
+                # cephx required but no secret: an acceptor cannot
+                # validate proofs OR tickets — fail closed
+                self._dout(0, f"cephx required but no secret; rejecting {peer}")
+                sock.close()
+                return
             try:
                 sock.settimeout(
                     self.cct.conf.get("ms_connect_timeout") if self.cct else 10.0
                 )
                 snonce = auth.make_nonce()
-                sock.sendall(f"auth-challenge {snonce}\n".encode())
-                kind, proof, cnonce = self._read_line(sock).split()
-                if kind != "auth-proof" or not auth.verify(
-                    snonce, peer_name, proof
-                ):
-                    raise ConnectionError(f"bad auth proof from {peer_name}")
                 sock.sendall(
-                    f"auth-ok {auth.proof(cnonce, 'cluster')}\n".encode()
+                    f"auth-challenge {snonce} {self.auth_service}\n".encode()
                 )
+                parts = self._read_line(sock, _AUTH_LINE_LIMIT).split()
+                if not parts:
+                    raise ConnectionError("empty auth reply")
+                if parts[0] == "auth-proof" and len(parts) == 3:
+                    _, proof, cnonce = parts
+                    if not auth.verify(snonce, peer_name, proof):
+                        raise ConnectionError(f"bad auth proof from {peer_name}")
+                    sock.sendall(
+                        f"auth-ok {auth.proof(cnonce, 'cluster')}\n".encode()
+                    )
+                    fkey = auth.session_key(snonce, cnonce)
+                elif parts[0] == "auth-ticket" and len(parts) == 4:
+                    _, blob, proof, cnonce = parts
+                    gen = (self.auth_gen_provider() if self.auth_gen_provider
+                           else 1)
+                    t = validate_ticket(
+                        auth.secret, self.auth_service, gen, blob
+                    )
+                    if t is None:
+                        raise ConnectionError(
+                            f"invalid/expired/rotated-out {self.auth_service} "
+                            f"ticket from {peer_name}"
+                        )
+                    skey = bytes.fromhex(t["session_key"])
+                    if t.get("entity") != peer_name or not _hmac.compare_digest(
+                        proof_hex(skey, snonce, peer_name), proof
+                    ):
+                        raise ConnectionError(
+                            f"ticket session-key proof failed for {peer_name}"
+                        )
+                    sock.sendall(
+                        f"auth-ok {proof_hex(skey, cnonce, 'cluster')}\n"
+                        .encode()
+                    )
+                    # mix both nonces: fresh frame key per incarnation
+                    # (see the connector-side comment)
+                    fkey = session_key_from_nonces(skey, snonce, cnonce)
+                else:
+                    raise ConnectionError(f"bad auth reply {parts[:1]}")
                 sock.settimeout(None)
             except (OSError, ValueError, ConnectionError) as e:
                 self._dout(1, f"auth reject {peer_name}@{peer}: {e}")
@@ -478,6 +604,7 @@ class Messenger:
             )
             conn.peer_name = peer_name
             conn.connect_id = connect_id
+            conn._frame_key = fkey
             self._conns[peer] = conn
             self._conns_by_name[peer_name] = conn
             if len(self._sessions) > 4096:
@@ -502,6 +629,13 @@ class Messenger:
 
     def _read_loop(self, conn: Connection, sock: socket.socket) -> None:
         max_len = self.cct.conf.get("ms_max_frame_len") if self.cct else (1 << 28)
+        # frame auth state is per socket incarnation: the key was set by
+        # the handshake that produced `sock`, and the receive counter
+        # starts at 0 exactly when the peer's send counter does
+        fkey = conn._frame_key
+        rx_ctr = 0
+        if fkey is not None:
+            from ..auth.cephx import frame_tag
         try:
             while not conn._closed and sock is conn.sock:
                 hdr = self._read_exact(sock, 8)
@@ -511,6 +645,18 @@ class Messenger:
                 body = self._read_exact(sock, length)
                 if crc32c(body) != crc:
                     raise OSError("frame crc mismatch")
+                if fkey is not None:
+                    tag = self._read_exact(sock, _TAG_LEN)
+                    if not _hmac.compare_digest(
+                        frame_tag(fkey, rx_ctr, body), tag
+                    ):
+                        # forged/tampered/replayed frame: connection-fatal
+                        # (reference: ProtocolV2 signed-frame mismatch)
+                        self._dout(
+                            0, f"frame auth tag mismatch from {conn.peer_addr}"
+                        )
+                        raise OSError("frame auth tag mismatch")
+                    rx_ctr += 1
                 ftype, payload = body[0], body[1:]
                 if ftype == _FRAME_ACK:
                     conn._handle_ack(struct.unpack("<Q", payload)[0])

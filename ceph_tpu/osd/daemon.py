@@ -199,6 +199,12 @@ class OSD(Dispatcher):
         self.messenger = Messenger.create(cct, self.whoami)
         self.messenger.default_policy = POLICY_LOSSLESS_PEER
         self.messenger.add_dispatcher(self)
+        # ticket validation tracks the map's auth generation, so `auth
+        # rotate` cuts stale clients off as soon as this OSD sees the
+        # new epoch (reference: rotating service keys via MAuth)
+        self.messenger.auth_gen_provider = lambda: (
+            self.osdmap.auth_gens.get("osd", 1) if self.osdmap else 1
+        )
         self.mc = MonClient(cct, mon_addrs, name=f"{self.whoami}-monc")
         self.osdmap: OSDMap | None = None
         self.pgs: dict[str, PGState] = {}

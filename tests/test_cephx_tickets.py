@@ -1,0 +1,402 @@
+"""cephx service tickets, rotation, and signed frames (reference:
+src/auth/cephx CephxKeyServer/CephXTicketBlob + ProtocolV2 signed frames;
+round-3 verdict task #3: wire the ticket machinery end-to-end).
+
+Three rings:
+- unit: mint/validate (expiry, tamper, service binding, generation grace)
+- messenger: ticket handshake, rotation refusal, tampered-frame kill
+- ring-2: a vstart cluster with auth on — a ticket-only client (no
+  cluster secret) does real I/O; `auth rotate` x2 cuts it off
+"""
+import base64
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.auth import (
+    derive_service_key,
+    frame_tag,
+    generate_secret,
+    mint_ticket,
+    proof_hex,
+    seal,
+    session_key_from_nonces,
+    unseal,
+    validate_ticket,
+)
+from ceph_tpu.common.context import CephContext
+from ceph_tpu.common.crc32c import crc32c
+from ceph_tpu.msg import Dispatcher, Messenger, MPing
+
+
+def _secret_bytes(secret_b64: str) -> bytes:
+    return base64.b64decode(secret_b64)
+
+
+class TestTicketUnit:
+    def setup_method(self):
+        self.secret = _secret_bytes(generate_secret())
+
+    def test_mint_validate_roundtrip(self):
+        blob, skey = mint_ticket(self.secret, "client.x", "osd", 3, 60.0)
+        t = validate_ticket(self.secret, "osd", 3, blob)
+        assert t is not None
+        assert t["entity"] == "client.x"
+        assert t["session_key"] == skey
+        assert t["gen"] == 3
+
+    def test_expired_refused(self):
+        blob, _ = mint_ticket(self.secret, "client.x", "osd", 1, 0.01)
+        time.sleep(0.05)
+        assert validate_ticket(self.secret, "osd", 1, blob) is None
+
+    def test_wrong_service_refused(self):
+        blob, _ = mint_ticket(self.secret, "client.x", "osd", 1, 60.0)
+        assert validate_ticket(self.secret, "mds", 1, blob) is None
+
+    def test_generation_grace_window(self):
+        """gen-1 tickets survive one rotation (grace), die after two."""
+        blob, _ = mint_ticket(self.secret, "client.x", "osd", 2, 60.0)
+        assert validate_ticket(self.secret, "osd", 2, blob) is not None
+        assert validate_ticket(self.secret, "osd", 3, blob) is not None
+        assert validate_ticket(self.secret, "osd", 4, blob) is None
+
+    def test_tampered_blob_refused(self):
+        blob, _ = mint_ticket(self.secret, "client.x", "osd", 1, 60.0)
+        raw = bytearray(bytes.fromhex(blob))
+        raw[-1] ^= 0xFF
+        assert validate_ticket(self.secret, "osd", 1, raw.hex()) is None
+        assert validate_ticket(self.secret, "osd", 1, "zz-not-hex") is None
+
+    def test_wrong_secret_refused(self):
+        blob, _ = mint_ticket(self.secret, "client.x", "osd", 1, 60.0)
+        other = _secret_bytes(generate_secret())
+        assert validate_ticket(other, "osd", 1, blob) is None
+
+    def test_seal_unseal_integrity(self):
+        key = derive_service_key(self.secret, "osd", 1)
+        blob = seal(key, {"a": 1})
+        assert unseal(key, blob) == {"a": 1}
+        assert unseal(derive_service_key(self.secret, "osd", 2), blob) is None
+        raw = bytearray(bytes.fromhex(blob))
+        raw[10] ^= 1
+        assert unseal(key, raw.hex()) is None
+
+
+def _server(secret, name="osd.0", gen_provider=None):
+    got, done = [], threading.Event()
+
+    class Sink(Dispatcher):
+        def ms_dispatch(self, conn, msg):
+            got.append(getattr(msg, "note", msg))
+            done.set()
+            return True
+
+    cct = CephContext(name, overrides={
+        "auth_cluster_required": "cephx", "auth_shared_secret": secret,
+    })
+    srv = Messenger.create(cct, name)
+    srv.add_dispatcher(Sink())
+    if gen_provider is not None:
+        srv.auth_gen_provider = gen_provider
+    addr = srv.bind(("127.0.0.1", 0))
+    srv.start()
+    return srv, addr, got, done
+
+
+def _ticket_client(secret_b64, tickets, name="client.lim"):
+    """A messenger that holds NO cluster secret — only tickets."""
+    cct = CephContext(name, overrides={"auth_cluster_required": "cephx"})
+    cct.tickets = tickets
+    return Messenger.create(cct, name)
+
+
+class TestTicketMessenger:
+    def setup_method(self):
+        self.secret = generate_secret()
+        self.sbytes = _secret_bytes(self.secret)
+
+    def _mint(self, service="osd", gen=1, ttl=60.0, entity="client.lim"):
+        blob, skey = mint_ticket(self.sbytes, entity, service, gen, ttl)
+        return {service: {"ticket": blob, "session_key": skey}}
+
+    def test_ticket_client_io(self):
+        srv, addr, got, done = _server(self.secret)
+        cli = _ticket_client(self.secret, self._mint())
+        try:
+            cli.connect(addr).send_message(MPing("via-ticket"))
+            assert done.wait(5), "ticket-authed message not delivered"
+            assert got == ["via-ticket"]
+        finally:
+            cli.shutdown()
+            srv.shutdown()
+
+    def test_expired_ticket_refused(self):
+        srv, addr, got, done = _server(self.secret)
+        cli = _ticket_client(self.secret, self._mint(ttl=0.01))
+        time.sleep(0.05)
+        try:
+            with pytest.raises(ConnectionError):
+                cli.connect(addr)
+            assert not got
+        finally:
+            cli.shutdown()
+            srv.shutdown()
+
+    def test_rotated_out_ticket_refused(self):
+        """gen-1 ticket works during the grace window (server at gen 2),
+        refused once the server reaches gen 3."""
+        gen = {"osd": 2}
+        srv, addr, got, done = _server(
+            self.secret, gen_provider=lambda: gen["osd"]
+        )
+        cli = _ticket_client(self.secret, self._mint(gen=1))
+        try:
+            cli.connect(addr).send_message(MPing("grace"))
+            assert done.wait(5)
+            gen["osd"] = 3  # second rotation: grace window over
+            cli2 = _ticket_client(self.secret, self._mint(gen=1),
+                                  name="client.lim2")
+            try:
+                with pytest.raises(ConnectionError):
+                    cli2.connect(addr)
+            finally:
+                cli2.shutdown()
+        finally:
+            cli.shutdown()
+            srv.shutdown()
+
+    def test_stolen_ticket_wrong_entity_refused(self):
+        """A ticket names its entity; presenting it under another name
+        fails even with the right session key."""
+        srv, addr, got, done = _server(self.secret)
+        cli = _ticket_client(
+            self.secret, self._mint(entity="client.other"), name="client.lim"
+        )
+        try:
+            with pytest.raises(ConnectionError):
+                cli.connect(addr)
+        finally:
+            cli.shutdown()
+            srv.shutdown()
+
+    def test_long_entity_name_ticket_accepted(self):
+        """The auth-ticket line (sealed blob + proof + nonce) blows the
+        512-byte default line limit even for ~20-char entity names; the
+        auth exchange must use the larger budget."""
+        name = "client.monitoring-agent-with-a-rather-long-name"
+        srv, addr, got, done = _server(self.secret)
+        cli = _ticket_client(
+            self.secret, self._mint(entity=name), name=name
+        )
+        try:
+            cli.connect(addr).send_message(MPing("long-name"))
+            assert done.wait(5), "long-entity ticket client rejected"
+        finally:
+            cli.shutdown()
+            srv.shutdown()
+
+    def test_no_matching_service_ticket(self):
+        srv, addr, got, done = _server(self.secret)  # announces "osd"
+        cli = _ticket_client(self.secret, self._mint(service="mds"))
+        try:
+            with pytest.raises(ConnectionError):
+                cli.connect(addr)
+        finally:
+            cli.shutdown()
+            srv.shutdown()
+
+
+class TestSignedFrames:
+    """Post-handshake frame authentication (ProtocolV2 signed-frames role):
+    drive the wire by hand so each failure mode is byte-precise."""
+
+    def setup_method(self):
+        self.secret = generate_secret()
+        self.sbytes = _secret_bytes(self.secret)
+
+    def _raw_handshake(self, addr, name="client.raw"):
+        """Manual banner + ticket handshake on a plain socket; returns
+        (sock, session_key)."""
+        blob, skey_hex = mint_ticket(self.sbytes, name, "osd", 1, 60.0)
+        skey = bytes.fromhex(skey_hex)
+        s = socket.create_connection(addr, timeout=5)
+        s.sendall(b"ceph_tpu msgr v1\n" + f"{name} 1234 lossy\n".encode())
+        f = s.makefile("rb")
+        kind, snonce, service = f.readline().decode().split()
+        assert kind == "auth-challenge" and service == "osd"
+        cnonce = "ab" * 16
+        s.sendall(
+            f"auth-ticket {blob} {proof_hex(skey, snonce, name)} "
+            f"{cnonce}\n".encode()
+        )
+        kind, sproof = f.readline().decode().split()
+        assert kind == "auth-ok"
+        assert sproof == proof_hex(skey, cnonce, "cluster")
+        # frames sign under the per-incarnation key (both nonces mixed),
+        # NOT the raw ticket session key — raw-key frames must be refused
+        self._last_raw_skey = skey
+        return s, session_key_from_nonces(skey, snonce, cnonce)
+
+    @staticmethod
+    def _frame(body: bytes, key: bytes | None, ctr: int) -> bytes:
+        frame = struct.pack("<II", len(body), crc32c(body)) + body
+        if key is not None:
+            frame += frame_tag(key, ctr, body)
+        return frame
+
+    def _ping_body(self, payload="x"):
+        from ceph_tpu.msg.message import encode_message
+
+        m = MPing(payload)
+        m.seq = 1
+        m.src = "client.raw"
+        return bytes([0]) + encode_message(m)
+
+    def test_signed_frame_dispatches(self):
+        srv, addr, got, done = _server(self.secret)
+        try:
+            s, skey = self._raw_handshake(addr)
+            s.sendall(self._frame(self._ping_body("signed"), skey, 0))
+            assert done.wait(5), "correctly signed frame not dispatched"
+            assert got == ["signed"]
+            s.close()
+        finally:
+            srv.shutdown()
+
+    def test_tampered_frame_killed(self):
+        """Valid CRC, wrong tag: the frame must NOT dispatch and the
+        connection must die (tag mismatch is connection-fatal)."""
+        srv, addr, got, done = _server(self.secret)
+        try:
+            s, skey = self._raw_handshake(addr)
+            body = self._ping_body("forged")
+            evil = self._frame(body, b"\x00" * 32, 0)  # wrong key => bad tag
+            s.sendall(evil)
+            assert not done.wait(1.0), "tampered frame dispatched!"
+            # server killed the connection: subsequent valid traffic is dead
+            s.settimeout(2)
+            try:
+                s.sendall(self._frame(self._ping_body("after"), skey, 1))
+                assert s.recv(1) == b"", "connection survived a bad tag"
+            except OSError:
+                pass
+            s.close()
+        finally:
+            srv.shutdown()
+
+    def test_unsigned_frame_after_auth_killed(self):
+        """Omitting the tag entirely desyncs framing — the 16 tag bytes
+        the server expects swallow the next header — and no message may
+        ever dispatch."""
+        srv, addr, got, done = _server(self.secret)
+        try:
+            s, _ = self._raw_handshake(addr)
+            s.sendall(self._frame(self._ping_body("naked"), None, 0))
+            assert not done.wait(1.0), "unsigned frame dispatched!"
+            s.close()
+        finally:
+            srv.shutdown()
+
+    def test_replayed_frame_killed(self):
+        """Re-sending a captured signed frame fails: the receive counter
+        has moved on, so the tag no longer matches."""
+        srv, addr, got, done = _server(self.secret)
+        try:
+            s, skey = self._raw_handshake(addr)
+            wire = self._frame(self._ping_body("once"), skey, 0)
+            s.sendall(wire)
+            assert done.wait(5)
+            done.clear()
+            s.sendall(wire)  # byte-identical replay
+            assert not done.wait(1.0), "replayed frame dispatched!"
+            s.close()
+        finally:
+            srv.shutdown()
+
+    def test_raw_ticket_key_signed_frame_refused(self):
+        """Signing with the raw ticket session key (instead of the
+        per-incarnation derived key) must fail: otherwise frames recorded
+        on one socket incarnation would replay on the next."""
+        srv, addr, got, done = _server(self.secret)
+        try:
+            s, _fkey = self._raw_handshake(addr)
+            bad = self._frame(
+                self._ping_body("stale-key"), self._last_raw_skey, 0
+            )
+            s.sendall(bad)
+            assert not done.wait(1.0), "raw-ticket-key frame dispatched!"
+            s.close()
+        finally:
+            srv.shutdown()
+
+    def test_session_key_from_nonces_agreement(self):
+        sn, cn = "11" * 16, "22" * 16
+        k1 = session_key_from_nonces(self.sbytes, sn, cn)
+        k2 = session_key_from_nonces(self.sbytes, sn, cn)
+        assert k1 == k2 and len(k1) == 32
+        assert session_key_from_nonces(self.sbytes, cn, sn) != k1
+
+
+@pytest.mark.cluster
+def test_ring2_ticket_client_and_rotation():
+    """Ring-2 (verdict r3 task #3 'done' criteria): a client holding ONLY
+    mon-minted tickets — no cluster secret — performs real I/O against a
+    cephx cluster; `auth rotate` twice then cuts a stale ticket off."""
+    from ceph_tpu.client.rados import Rados
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    secret = generate_secret()
+    with LocalCluster(
+        n_mons=1, n_osds=3,
+        conf_overrides={
+            "auth_cluster_required": "cephx",
+            "auth_shared_secret": secret,
+        },
+    ) as c:
+        c.create_replicated_pool("tick", size=2)
+        # admin (secret holder) provisions tickets for a limited client
+        tickets = {}
+        for svc in ("mon", "osd"):
+            rv, t = c.mon_command(
+                {"prefix": "auth get-ticket", "service": svc,
+                 "entity": "client.lim"}
+            )
+            assert rv == 0, t
+            tickets[svc] = {"ticket": t["ticket"],
+                            "session_key": t["session_key"]}
+
+        lim_cct = CephContext(
+            "client.lim", overrides={"auth_cluster_required": "cephx"}
+        )
+        lim_cct.tickets = tickets
+        lim = Rados(lim_cct, c.mon_addrs, name="client.lim")
+        lim.connect(timeout=10.0)
+        io = lim.open_ioctx("tick")
+        io.write_full("by-ticket", b"ticketed payload" * 64)
+        assert io.read("by-ticket") == b"ticketed payload" * 64
+        lim.shutdown()
+
+        # rotate the osd service twice: gen-1 grace, then cut off
+        for _ in range(2):
+            rv, r = c.mon_command({"prefix": "auth rotate", "service": "osd"})
+            assert rv == 0, r
+        # a FRESH client with the stale osd ticket: mon still admits it
+        # (mon gen unrotated), but every OSD refuses -> I/O cannot complete
+        lim2_cct = CephContext(
+            "client.lim", overrides={"auth_cluster_required": "cephx"}
+        )
+        lim2_cct.tickets = dict(tickets)
+        lim2 = Rados(lim2_cct, c.mon_addrs, name="client.lim")
+        lim2.connect(timeout=10.0)
+        io2 = lim2.open_ioctx("tick")
+        with pytest.raises((IOError, ConnectionError, TimeoutError)):
+            io2.read("by-ticket")
+        lim2.shutdown()
+
+        # the admin (secret-holder) path is untouched by rotation
+        io3 = c.client().open_ioctx("tick")
+        assert io3.read("by-ticket") == b"ticketed payload" * 64
