@@ -1,27 +1,47 @@
 """RBD-analog block images over the striper (reference: src/librbd —
-librbd::Image create/open/read/write/resize/remove over striped RADOS
-objects; SURVEY.md §2.6 gateways).
+librbd::Image create/open/read/write/resize/remove, snapshot
+create/rollback/protect, and clone/flatten COW machinery over striped
+RADOS objects; SURVEY.md §2.6 gateways).
 
-Scope vs the reference, stated plainly: the data path (an image = a
-header object + data striped over `{id}.<objectno>` objects) matches
-librbd's native format at the block level; snapshots, clones, journaling,
-mirroring, and the kernel client are mon/feature machinery this analog
-does not carry.
+Design, stated plainly:
+
+- An image is a JSON header object + data striped over
+  `rbd_data.{name}.{objectno:016x}` objects (librbd's native layout at
+  the block level).
+- **Snapshots** ride the pool-snapshot substrate: `snap_create` takes a
+  pool snap (named `rbd.{image}@{snap}` — '@' is banned in both names,
+  so this cannot collide across images) and records {name -> snapid,
+  size} in the header; a
+  snap-opened image reads its data objects at that snapid (the OSD's
+  per-object clone machinery serves the old bytes).  This replaces
+  librbd's self-managed snap context — the visible semantics (point-in-
+  time reads, rollback, protection) match.
+- **Clones** are COW children at whole-object granularity: the child's
+  header carries `parent = {image, snap, snap_id, overlap}`; a read of
+  an object the child does not yet own falls through to the parent's
+  snap view, and the first write to such an object copies the parent's
+  object up first (librbd's copy-up).  `flatten` copies every remaining
+  parent object and severs the link.  Children are registered in the
+  pool's `rbd_children` omap so `snap_unprotect` can refuse while
+  clones exist (reference: cls_rbd's rbd_children directory).
+- Journaling, mirroring, and the kernel client remain out of scope.
 
     rbd = RBD(ioctx)
     rbd.create("vol1", size=1 << 30)
     with rbd.open("vol1") as img:
         img.write(b"...", off)
-        img.read(off, length)
-        img.resize(2 << 30)
+        img.snap_create("s1")
+        img.snap_protect("s1")
+    rbd.clone("vol1", "s1", "vol2")
 """
 from __future__ import annotations
 
 import json
 
-from .striper import StripedObject, StripePolicy
+from .striper import ExtentIO, StripePolicy
 
 _HEADER_SUFFIX = ".rbd_header"
+_CHILDREN_OID = "rbd_children"
 
 
 class ImageExists(IOError):
@@ -32,53 +52,216 @@ class ImageNotFound(IOError):
     pass
 
 
-class Image:
-    """An open image handle (reference: librbd::Image)."""
+class ReadOnlyImage(IOError):
+    pass
 
-    def __init__(self, io, name: str, header: dict):
+
+class SnapshotError(IOError):
+    pass
+
+
+class ImageBusy(IOError):
+    pass
+
+
+def _check_name(kind: str, name: str) -> None:
+    """Image/snap names must not contain '@' (it separates image from
+    snap in the pool-snap encoding and the img@snap spec syntax, like
+    the reference refuses it) or be empty."""
+    if not name or "@" in name:
+        raise ValueError(f"bad {kind} name {name!r}")
+
+
+def _pool_snap_name(image: str, snap: str) -> str:
+    # '@' appears in neither component (_check_name), so this cannot
+    # collide across images
+    return f"rbd.{image}@{snap}"
+
+
+def _parent_oid(p: dict, objectno: int) -> str:
+    return f"{p['block_name_prefix']}.{objectno:016x}"
+
+
+def _children_of(io, parent: str, snap: str) -> list[str]:
+    """Clone children registered under parent@snap; [] when the
+    rbd_children directory object does not exist yet."""
+    key = f"{parent}@{snap}"
+    try:
+        cur = io.omap_get(_CHILDREN_OID, keys=[key]).get(key)
+    except IOError:
+        return []
+    return json.loads(cur.decode()) if cur else []
+
+
+class Image:
+    """An open image handle (reference: librbd::Image).  Pass `snap` at
+    open for a read-only point-in-time view."""
+
+    def __init__(self, io, name: str, header: dict, snap: str | None = None):
         self._io = io
         self.name = name
         self._header = header
-        self._data = StripedObject(
-            io, header["block_name_prefix"],
-            StripePolicy(
-                object_size=1 << header["order"],
-                stripe_unit=header["stripe_unit"],
-                stripe_count=header["stripe_count"],
-            ),
+        self.snap_name = snap
+        if snap is not None:
+            if snap not in header.get("snaps", {}):
+                raise SnapshotError(f"image {name!r} has no snap {snap!r}")
+            self._snap = header["snaps"][snap]
+        else:
+            self._snap = None
+        self._policy = StripePolicy(
+            object_size=1 << header["order"],
+            stripe_unit=header["stripe_unit"],
+            stripe_count=header["stripe_count"],
         )
+        # the header is the size authority (librbd keeps no sidecar), so
+        # the image drives the extent engine directly — copy-up, rollback
+        # and flatten write objects without any logical-size bookkeeping
+        self._ext = ExtentIO(io, self._data_oid, self._policy)
 
     # -- metadata -----------------------------------------------------------
     def size(self) -> int:
-        return self._header["size"]
+        return self._snap["size"] if self._snap else self._header["size"]
 
     def stat(self) -> dict:
         return dict(self._header)
 
-    # -- I/O ------------------------------------------------------------—--
+    def parent_info(self) -> dict | None:
+        """(reference: librbd::Image::parent_info) None for non-clones."""
+        p = self._header.get("parent")
+        return dict(p) if p else None
+
+    def _save_header(self) -> None:
+        self._io.write_full(
+            self.name + _HEADER_SUFFIX, json.dumps(self._header).encode()
+        )
+
+    def _data_oid(self, objectno: int) -> str:
+        return f"{self._header['block_name_prefix']}.{objectno:016x}"
+
+    # -- parent (clone) plumbing -------------------------------------------
+    def _object_exists(self, objectno: int) -> bool:
+        try:
+            self._io.stat(self._data_oid(objectno))
+            return True
+        except IOError:
+            return False
+
+    def _copy_up(self, off: int, length: int) -> None:
+        """Whole-object copy-up of every touched object the child does
+        not own yet (reference: librbd copy-up before a child write).
+        The parent shares this image's layout, so objectno N of the
+        parent holds exactly the stream bytes objectno N of the child
+        will: one object-level read-at-snap + write_full suffices —
+        clipped to the clone overlap, so parent bytes a shrink-then-grow
+        resize turned into zeros are not resurrected."""
+        p = self._header.get("parent")
+        if not p:
+            return
+        seen: set[int] = set()
+        for objectno, _obj_off, _ln in self._policy.extents(off, length):
+            if objectno in seen:
+                continue
+            seen.add(objectno)
+            if self._object_exists(objectno):
+                continue
+            keep = self._policy.object_keep_len(objectno, p["overlap"])
+            if keep == 0:
+                continue  # entirely past the overlap: reads are zeros
+            try:
+                pdata = self._io.read(
+                    _parent_oid(p, objectno), snapid=p["snap_id"]
+                )
+            except IOError:
+                continue  # parent object absent at snap: nothing to copy
+            if pdata[:keep]:
+                self._io.write_full(self._data_oid(objectno), pdata[:keep])
+
+    # -- I/O ----------------------------------------------------------------
     def read(self, off: int, length: int) -> bytes:
         if off >= self.size():
             return b""
         length = min(length, self.size() - off)
-        data = self._data.read(off, length)
-        # unwritten ranges inside the image read as zeros (thin provision)
-        return data + b"\0" * (length - len(data))
+        p = self._header.get("parent")
+        if self._snap is not None:
+            if p is None:
+                # ExtentIO pads every extent, so no padding needed here
+                return self._ext.read(off, length, snapid=self._snap["id"])
+            # snap view OF A CLONE: objects the child owned AT the snap
+            # are authoritative; the rest falls through to the parent at
+            # the overlap recorded when the snap was taken
+            return self._read_with_parent(
+                off, length, p,
+                snapid=self._snap["id"],
+                overlap=self._snap.get("overlap", p["overlap"]),
+            )
+        if p is None:
+            return self._ext.read(off, length)
+        return self._read_with_parent(off, length, p)
+
+    def _read_with_parent(
+        self, off: int, length: int, p: dict,
+        snapid: int | None = None, overlap: int | None = None,
+    ) -> bytes:
+        """Per-extent merge: an object the child owns (copy-up or write
+        already happened) is authoritative; otherwise the byte range
+        falls through to the parent's snap view, clipped to the clone
+        overlap (reference: librbd ObjectReadRequest's parent fallback).
+
+        Ownership is the read attempt itself — a missing object (at head
+        or, for a clone's snap view, at `snapid`) raises IOError while an
+        existing one returns (possibly short) bytes — memoized per object
+        so stripe rows don't re-probe."""
+        pext = ExtentIO(
+            self._io, lambda objectno: _parent_oid(p, objectno), self._policy
+        )
+        overlap = p["overlap"] if overlap is None else overlap
+        kw = {} if snapid is None else {"snapid": snapid}
+        owned: dict[int, bool] = {}
+        parts: list[bytes] = []
+        pos = off
+        for objectno, obj_off, ln in self._policy.extents(off, length):
+            chunk = None
+            if owned.get(objectno, True):
+                try:
+                    chunk = self._io.read(
+                        self._data_oid(objectno), off=obj_off, length=ln, **kw
+                    )
+                    owned[objectno] = True
+                except IOError:
+                    owned[objectno] = False
+            if chunk is None:
+                if pos < overlap:
+                    take = min(ln, overlap - pos)
+                    chunk = pext.read(pos, take, snapid=p["snap_id"])
+                else:
+                    chunk = b""
+            parts.append(chunk + b"\0" * (ln - len(chunk)))
+            pos += ln
+        return b"".join(parts)
 
     def write(self, data: bytes, off: int) -> int:
+        if self._snap is not None:
+            raise ReadOnlyImage(f"{self.name}@{self.snap_name} is read-only")
         if off + len(data) > self.size():
             raise IOError(
                 f"write past end of image ({off + len(data)} > {self.size()})"
             )
-        self._data.write(data, off)
+        self._copy_up(off, len(data))
+        self._ext.write(data, off)
         return len(data)
 
     def resize(self, size: int) -> None:
+        if self._snap is not None:
+            raise ReadOnlyImage(f"{self.name}@{self.snap_name} is read-only")
         if size < self.size():
-            self._data.truncate(size)
+            self._ext.truncate_data(self._header["size"], size)
+            p = self._header.get("parent")
+            if p and size < p["overlap"]:
+                # shrinking below the overlap permanently narrows it
+                # (reference: librbd shrink adjusts the parent overlap)
+                p["overlap"] = size
         self._header["size"] = size
-        self._io.write_full(
-            self.name + _HEADER_SUFFIX, json.dumps(self._header).encode()
-        )
+        self._save_header()
 
     def flush(self) -> None:  # writes are synchronous; parity of API
         pass
@@ -92,6 +275,108 @@ class Image:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    # -- snapshots ----------------------------------------------------------
+    def snap_list(self) -> dict[str, dict]:
+        return {n: dict(s) for n, s in self._header.get("snaps", {}).items()}
+
+    def snap_create(self, snap: str) -> int:
+        """Point-in-time snapshot (reference: librbd snap_create): a pool
+        snap scoped by name to this image + a header record of the id
+        and the size at snap time."""
+        if self._snap is not None:
+            raise ReadOnlyImage("cannot snapshot a snap view")
+        _check_name("snap", snap)
+        snaps = self._header.setdefault("snaps", {})
+        if snap in snaps:
+            raise SnapshotError(f"snap {snap!r} exists")
+        sid = self._io.snap_create(_pool_snap_name(self.name, snap))
+        snaps[snap] = {"id": sid, "size": self._header["size"],
+                       "protected": False}
+        p = self._header.get("parent")
+        if p:
+            # a clone's snap view needs the overlap AS OF the snap — a
+            # later shrink narrows the live overlap but not this one
+            snaps[snap]["overlap"] = p["overlap"]
+        self._save_header()
+        return sid
+
+    def snap_remove(self, snap: str) -> None:
+        snaps = self._header.get("snaps", {})
+        if snap not in snaps:
+            raise SnapshotError(f"no snap {snap!r}")
+        if snaps[snap].get("protected"):
+            raise ImageBusy(f"snap {snap!r} is protected")
+        self._io.snap_remove(_pool_snap_name(self.name, snap))
+        del snaps[snap]
+        self._save_header()
+
+    def snap_protect(self, snap: str) -> None:
+        """Required before cloning (reference: librbd snap_protect)."""
+        snaps = self._header.get("snaps", {})
+        if snap not in snaps:
+            raise SnapshotError(f"no snap {snap!r}")
+        snaps[snap]["protected"] = True
+        self._save_header()
+
+    def snap_unprotect(self, snap: str) -> None:
+        snaps = self._header.get("snaps", {})
+        if snap not in snaps:
+            raise SnapshotError(f"no snap {snap!r}")
+        kids = _children_of(self._io, self.name, snap)
+        if kids:
+            raise ImageBusy(f"snap {snap!r} has clone children: {kids}")
+        snaps[snap]["protected"] = False
+        self._save_header()
+
+    def snap_is_protected(self, snap: str) -> bool:
+        snaps = self._header.get("snaps", {})
+        if snap not in snaps:
+            raise SnapshotError(f"no snap {snap!r}")
+        return bool(snaps[snap].get("protected"))
+
+    def snap_rollback(self, snap: str) -> None:
+        """Restore the image head to the snapshot state (reference:
+        librbd snap_rollback: per-object copy from the snap view)."""
+        if self._snap is not None:
+            raise ReadOnlyImage("cannot roll back a snap view")
+        snaps = self._header.get("snaps", {})
+        if snap not in snaps:
+            raise SnapshotError(f"no snap {snap!r}")
+        s = snaps[snap]
+        head_size = self._header["size"]
+        span = max(head_size, s["size"], 1)
+        last_obj = max(
+            (e[0] for e in self._policy.extents(0, span)), default=-1
+        )
+        for objectno in range(last_obj + 1):
+            oid = self._data_oid(objectno)
+            try:
+                old = self._io.read(oid, snapid=s["id"])
+            except IOError:
+                old = None
+            if old is None:
+                try:
+                    self._io.remove(oid)
+                except IOError:
+                    pass
+            else:
+                self._io.write_full(oid, old)
+        self._header["size"] = s["size"]
+        self._save_header()
+
+    # -- clone maintenance ---------------------------------------------------
+    def flatten(self) -> None:
+        """Copy every not-yet-owned parent object into the child and
+        sever the parent link (reference: librbd flatten)."""
+        p = self._header.get("parent")
+        if not p:
+            return
+        if p["overlap"] > 0:
+            self._copy_up(0, p["overlap"])
+        self._header["parent"] = None
+        self._save_header()
+        RBD(self._io)._unregister_child(p["image"], p["snap"], self.name)
+
 
 class RBD:
     """Image administration (reference: librbd::RBD)."""
@@ -103,6 +388,7 @@ class RBD:
                stripe_unit: int | None = None, stripe_count: int = 1) -> None:
         """order: log2 of the object size, default 4 MiB objects — the
         reference's default layout."""
+        _check_name("image", name)
         hdr_oid = name + _HEADER_SUFFIX
         try:
             self._io.read(hdr_oid)
@@ -122,15 +408,17 @@ class RBD:
             "stripe_unit": su,
             "stripe_count": stripe_count,
             "block_name_prefix": f"rbd_data.{name}",
+            "snaps": {},
+            "parent": None,
         }
         self._io.write_full(hdr_oid, json.dumps(header).encode())
 
-    def open(self, name: str) -> Image:
+    def open(self, name: str, snap: str | None = None) -> Image:
         try:
             raw = self._io.read(name + _HEADER_SUFFIX)
         except IOError as e:
             raise ImageNotFound(f"no image {name!r}") from e
-        return Image(self._io, name, json.loads(raw))
+        return Image(self._io, name, json.loads(raw), snap=snap)
 
     def list(self) -> list[str]:
         out = []
@@ -141,5 +429,87 @@ class RBD:
 
     def remove(self, name: str) -> None:
         img = self.open(name)
-        img._data.remove()
+        if img._header.get("snaps"):
+            raise ImageBusy(
+                f"image {name!r} has snapshots: "
+                f"{sorted(img._header['snaps'])}"
+            )
+        img._ext.purge(img._header["size"])
+        for legacy in (f"{img._header['block_name_prefix']}.meta",):
+            # images written by the pre-snapshot format kept a striper
+            # size sidecar; sweep it so remove leaves nothing behind
+            try:
+                self._io.remove(legacy)
+            except IOError:
+                pass
         self._io.remove(name + _HEADER_SUFFIX)
+        p = img._header.get("parent")
+        if p:
+            # unregister LAST: a purge failure above must leave the
+            # child registered, or the parent could unprotect while a
+            # half-removed but still-openable clone depends on its snap
+            self._unregister_child(p["image"], p["snap"], name)
+
+    # -- clones --------------------------------------------------------------
+    def clone(self, parent: str, snap: str, child: str) -> None:
+        """COW child of parent@snap (reference: librbd::RBD::clone; the
+        snap must be protected first, like the reference enforces)."""
+        _check_name("image", child)
+        pimg = self.open(parent)
+        snaps = pimg._header.get("snaps", {})
+        if snap not in snaps:
+            raise SnapshotError(f"no snap {parent}@{snap}")
+        if not snaps[snap].get("protected"):
+            raise SnapshotError(
+                f"snap {parent}@{snap} must be protected to clone"
+            )
+        s = snaps[snap]
+        hdr_oid = child + _HEADER_SUFFIX
+        try:
+            self._io.read(hdr_oid)
+            raise ImageExists(f"image {child!r} exists")
+        except ImageExists:
+            raise
+        except IOError:
+            pass
+        header = {
+            "name": child,
+            "size": s["size"],
+            "order": pimg._header["order"],
+            "stripe_unit": pimg._header["stripe_unit"],
+            "stripe_count": pimg._header["stripe_count"],
+            "block_name_prefix": f"rbd_data.{child}",
+            "snaps": {},
+            "parent": {
+                "image": parent,
+                "snap": snap,
+                "snap_id": s["id"],
+                "overlap": s["size"],
+                "block_name_prefix": pimg._header["block_name_prefix"],
+            },
+        }
+        self._io.write_full(hdr_oid, json.dumps(header).encode())
+        self._register_child(parent, snap, child)
+
+    def _register_child(self, parent: str, snap: str, child: str) -> None:
+        kids = _children_of(self._io, parent, snap)
+        if child not in kids:
+            kids.append(child)
+        self._io.omap_set(
+            _CHILDREN_OID,
+            {f"{parent}@{snap}": json.dumps(kids).encode()},
+        )
+
+    def _unregister_child(self, parent: str, snap: str, child: str) -> None:
+        kids = [k for k in _children_of(self._io, parent, snap) if k != child]
+        key = f"{parent}@{snap}"
+        if kids:
+            self._io.omap_set(_CHILDREN_OID, {key: json.dumps(kids).encode()})
+        else:
+            try:
+                self._io.omap_rm_keys(_CHILDREN_OID, [key])
+            except IOError:
+                pass
+
+    def children(self, parent: str, snap: str) -> list[str]:
+        return _children_of(self._io, parent, snap)

@@ -38,6 +38,22 @@ class StripePolicy:
     def stripes_per_object(self) -> int:
         return self.object_size // self.stripe_unit
 
+    def object_keep_len(self, objectno: int, size: int) -> int:
+        """Bytes of object `objectno` that hold stream data below logical
+        `size` (0 = none).  Striping interleaves, so this scans just the
+        object's own stripe-set window — bounded at stripes_per_object *
+        stripe_count units (used by RBD copy-up to clip parent objects to
+        the clone overlap)."""
+        set_span = self.stripe_count * self.object_size
+        lo = (objectno // self.stripe_count) * set_span
+        hi = min(size, lo + set_span)
+        keep = 0
+        if hi > lo:
+            for o, obj_off, ln in self.extents(lo, hi - lo):
+                if o == objectno:
+                    keep = max(keep, obj_off + ln)
+        return keep
+
     def extents(self, off: int, length: int):
         """Yield (objectno, obj_off, len) for a byte range — the
         file_to_extents loop, unrolled per stripe unit then merged for
@@ -99,12 +115,18 @@ class ExtentIO:
             src += ln
             self.io.write_full(oid, bytes(cur))
 
-    def read(self, off: int, length: int) -> bytes:
+    def read(self, off: int, length: int,
+             snapid: int | None = None) -> bytes:
+        """`snapid` reads the pool-snapshot view of every data object —
+        the substrate RBD snapshot reads ride on.  Passed through only
+        when set, so snap-unaware io backends (FS data path, tests'
+        fakes) keep working."""
+        kw = {} if snapid is None else {"snapid": snapid}
         parts: list[bytes] = []
         for objectno, obj_off, ln in self.policy.extents(off, length):
             try:
                 chunk = self.io.read(self.namer(objectno), off=obj_off,
-                                     length=ln)
+                                     length=ln, **kw)
             except IOError:
                 chunk = b""
             if len(chunk) < ln:  # sparse object: logical zeros
