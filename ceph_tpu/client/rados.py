@@ -66,6 +66,27 @@ class IoCtx:
         return unpack_data(rep.data) or b""
 
     # -- omap (reference: rados_omap_* — replicated pools only) -----------
+    def exec(self, oid: str, cls: str, method: str,
+             inp: dict | None = None) -> tuple[int, object]:
+        """Run a server-side object-class method at the object's primary
+        (reference: rados_exec / librados::IoCtx::exec; classes in
+        ceph_tpu/osd/classes.py).  Returns (retval, out) — retval < 0 is
+        the METHOD's verdict (e.g. -17 for a failed create guard), which
+        callers branch on; transport/cluster failures raise IOError."""
+        rep = self._client.objecter.op_submit(
+            self.pool_id, oid, "exec",
+            data={"cls": cls, "method": method, "in": inp or {}},
+        )
+        # method verdicts come back wrapped in "cls_out"; anything else
+        # with a non-zero retval is a cluster-side refusal (unknown
+        # class, EC pool, no pool, min_size)
+        if isinstance(rep.result, dict) and "cls_out" in rep.result:
+            return rep.retval, rep.result["cls_out"]
+        if rep.retval == 0:
+            return 0, rep.result  # dup-cache resend of an applied exec
+        raise IOError(f"exec {oid!r} {cls}.{method}: "
+                      f"{rep.retval} {rep.result}")
+
     def omap_set(self, oid: str, kv: dict[str, bytes]) -> None:
         rep = self._client.objecter.op_submit(
             self.pool_id, oid, "omap_set",

@@ -124,7 +124,7 @@ CLONE_SEP = "\x02"
 # on a resend would be wrong or wasteful)
 MUTATING_OPS = frozenset(
     {"write_full", "write", "append", "delete", "setxattr",
-     "omap_set", "omap_rm", "omap_clear"}
+     "omap_set", "omap_rm", "omap_clear", "exec"}
 )
 
 
@@ -1139,12 +1139,13 @@ class OSD(Dispatcher):
                                result={"oids": oids})
         if msg.op in ("setxattr", "getxattrs"):
             return self._xattr_op(pg, acting, my_shard, msg)
-        if msg.op.startswith("omap_"):
-            # reference parity: EC pools do not support omap
+        if msg.op.startswith("omap_") or msg.op == "exec":
+            # reference parity: EC pools support neither omap nor the
+            # omap-backed object classes
             # (PrimaryLogPG::do_osd_ops returns -EOPNOTSUPP)
             return MOSDOpReply(tid=msg.tid, retval=-95,
                                epoch=self.my_epoch(),
-                               result="omap not supported on EC pools")
+                               result=f"{msg.op} not supported on EC pools")
         if msg.op in ("watch", "unwatch", "notify"):
             return self._watch_op(pg, pool, msg)
         return MOSDOpReply(tid=msg.tid, retval=-22, epoch=self.my_epoch(),
@@ -2137,6 +2138,8 @@ class OSD(Dispatcher):
             return self._xattr_op(pg, acting, 0, msg)
         if msg.op.startswith("omap_"):
             return self._omap_op(pg, pool, acting, msg)
+        if msg.op == "exec":
+            return self._exec_op(pg, pool, acting, msg)
         if msg.op in ("watch", "unwatch", "notify"):
             return self._watch_op(pg, pool, msg)
         return MOSDOpReply(tid=msg.tid, retval=-22, epoch=self.my_epoch(),
@@ -2226,6 +2229,143 @@ class OSD(Dispatcher):
                                        "error": "below min_size commits"})
         return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
                            result={"version": pg.version})
+
+    # .. object classes (replicated pools only, like omap) .................
+    def _exec_op(self, pg, pool, acting, msg) -> MOSDOpReply:
+        """`rados exec` — run a registered class method at the primary
+        under the PG lock and commit its staged mutations as one
+        replicated, logged transaction (reference: PrimaryLogPG
+        CEPH_OSD_OP_CALL -> ClassHandler; src/cls).  The lock-scoped
+        execute-then-commit is what makes cls ops (bucket-index updates,
+        create guards, counters) immune to concurrent-writer races."""
+        from .classes import ClassRegistry, ClsHandle
+
+        cid = self._cid(pg.pgid, 0)
+        args = msg.data or {}
+        fn = ClassRegistry.instance().get(
+            args.get("cls", ""), args.get("method", "")
+        )
+        if fn is None:
+            return MOSDOpReply(
+                tid=msg.tid, retval=-95, epoch=self.my_epoch(),
+                result=f"no class method "
+                       f"{args.get('cls')}.{args.get('method')}",
+            )
+        # pool-snapshot clone-on-write, same as the plain mutation path
+        # (lines above in _execute_routed_op): a method MAY stage a data
+        # write (hctx.write_full), and the clone must capture the head
+        # BEFORE pg.lock — the write path's order is _clone_mutex then
+        # pg.lock, and inverting it here would risk deadlock.  We cannot
+        # yet know whether the method will touch data, so clone whenever
+        # a snap is live: a clone of an omap-only exec is merely the
+        # head's (correct) at-snap state, never wrong.
+        live_max = max(pool.snaps, default=0)
+        snap_seq = max(live_max, int(getattr(msg, "snap_seq", 0) or 0))
+        head_existed = True
+        if snap_seq and msg.oid and CLONE_SEP not in msg.oid:
+            try:
+                head_existed = self._maybe_clone(pg, pool, msg.oid, snap_seq)
+            except Exception as e:
+                return MOSDOpReply(
+                    tid=msg.tid, retval=-11, epoch=self.my_epoch(),
+                    result=f"snap clone failed: {e}",
+                )
+        with pg.lock:
+            def read_data():
+                try:
+                    return self.store.read(cid, msg.oid)
+                except (NotFound, KeyError):
+                    return None
+
+            def read_omap():
+                try:
+                    return self.store.omap_get(cid, msg.oid)
+                except (NotFound, KeyError):
+                    return {}
+
+            hctx = ClsHandle(msg.oid, read_data, read_omap)
+            try:
+                retval, out = fn(hctx, args.get("in") or {})
+            except Exception as e:
+                self.cct.dout("osd", 0,
+                              f"{self.whoami} cls method raised: {e!r}")
+                return MOSDOpReply(tid=msg.tid, retval=-22,
+                                   epoch=self.my_epoch(),
+                                   result=f"cls method failed: {e}")
+            if retval < 0 or not hctx.dirty:
+                # aborted or read-only: nothing to commit or replicate
+                return MOSDOpReply(tid=msg.tid, retval=retval,
+                                   epoch=self.my_epoch(),
+                                   result={"cls_out": out})
+            omap_payload = None
+            if hctx.staged_set or hctx.staged_rm:
+                omap_payload = {
+                    "set": {k: pack_data(v)
+                            for k, v in hctx.staged_set.items()},
+                    "rm": sorted(hctx.staged_rm),
+                }
+            wire_data = crc = osize = None
+            if hctx.staged_data is not None:
+                wire_data = pack_data(hctx.staged_data)
+                crc = crc32c(hctx.staged_data)
+                osize = len(hctx.staged_data)
+            version = pg.version + 1
+            entry = LogEntry(version, "modify", msg.oid,
+                             reqid=getattr(msg, "reqid", None))
+            tids: dict[int, int] = {}
+            for shard, osd in enumerate(acting):
+                if osd == self.id or osd < 0 or not self.osdmap.is_up(osd):
+                    continue
+                tid = self._next_tid()
+                tids[tid] = shard
+                try:
+                    self._conn_to_osd(osd).send_message(MECSubOpWrite(
+                        tid=tid, pgid=pg.pgid, oid=msg.oid, shard=0,
+                        data=wire_data, crc=crc, osize=osize,
+                        version=version, entry=entry.to_list(),
+                        epoch=self.my_epoch(), omap=omap_payload,
+                    ))
+                except (OSError, ConnectionError):
+                    tids.pop(tid, None)
+            t = Transaction()
+            t.try_create_collection(cid)
+            t.touch(cid, msg.oid)
+            if hctx.staged_data is not None:
+                t.write(cid, msg.oid, 0, hctx.staged_data)
+                t.truncate(cid, msg.oid, len(hctx.staged_data))
+                t.setattr(cid, msg.oid, "hinfo",
+                          str(crc32c(hctx.staged_data)).encode())
+                t.setattr(cid, msg.oid, "size",
+                          str(len(hctx.staged_data)).encode())
+            if omap_payload is not None:
+                self._apply_omap(t, cid, msg.oid, omap_payload)
+            t.setattr(cid, msg.oid, "ver", str(version).encode())
+            self._log_txn(t, cid, pg, entry)
+            self.store.queue_transaction(t)
+            a, deposed, _f = self._collect_subop_acks(tids)
+            acked = 1 + a
+        if deposed and acked < pool.min_size:
+            return MOSDOpReply(tid=msg.tid, retval=-116,
+                               epoch=self.my_epoch(),
+                               result={"deposed": True})
+        if acked < pool.min_size:
+            return MOSDOpReply(tid=msg.tid, retval=-11,
+                               epoch=self.my_epoch(),
+                               result={"applied": pg.version, "acked": acked,
+                                       "error": "below min_size commits"})
+        if snap_seq and not head_existed:
+            # exec CREATED the object post-snap: mark it born so older
+            # snap views keep it invisible (same contract as the plain
+            # write path's _mark_born)
+            try:
+                self._mark_born(pg, pool, msg.oid, snap_seq)
+            except Exception as e:
+                return MOSDOpReply(
+                    tid=msg.tid, retval=-5, epoch=self.my_epoch(),
+                    result=f"snapborn mark failed: {e}",
+                )
+        return MOSDOpReply(tid=msg.tid, retval=retval,
+                           epoch=self.my_epoch(), result={"cls_out": out})
 
     def _apply_omap(self, t: Transaction, cid: str, oid: str,
                     payload: dict) -> None:

@@ -4,9 +4,15 @@ bucket/object/multipart core; SURVEY.md §2.6).
 
 Layout in RADOS (mirroring the reference's pool split):
 
-- ``rgw_meta`` pool: ``buckets`` (the bucket catalog, JSON) and one
-  ``idx.{bucket}`` object per bucket — the bucket index the reference
-  keeps in .rgw.buckets.index omaps (key -> size/etag/mtime).
+- ``rgw_meta`` pool: ``buckets`` (the bucket catalog, one omap key per
+  bucket) and one ``idx.{bucket}`` object per bucket — the bucket index
+  the reference keeps in .rgw.buckets.index omaps (key ->
+  size/etag/mtime).  BOTH are mutated exclusively through the
+  server-side ``rgw`` object class (`rados exec`, the cls_rgw role):
+  create-if-absent bucket claims and transactional multi-key index
+  updates execute at the index object's primary under the PG lock, so
+  two concurrent gateways can neither double-create a bucket nor lose
+  index entries.
 - ``rgw_data`` pool: object payloads, striped via the striper as
   ``{bucket}/{key}`` streams (reference: .rgw.buckets.data with
   manifest-driven striping); multipart parts as
@@ -58,6 +64,7 @@ class _Store:
         # in the bucket index namespace) so a gateway restart neither
         # forgets in-flight uploads nor orphans their part data
         self.uploads: dict[str, dict] = {}
+        self._migrate_legacy_catalog()
         reaps = []
         for oid in self.meta.list_objects():
             if oid.startswith("mpu."):
@@ -87,31 +94,73 @@ class _Store:
         except IOError:
             pass
 
-    # -- catalog -----------------------------------------------------------
+    # -- catalog: omap on `buckets`, mutated via the rgw class ------------
     def _read_json(self, io, oid, default):
         try:
             return json.loads(io.read(oid))
         except (IOError, ValueError):
             return default
 
-    def buckets(self) -> dict:
-        return self._read_json(self.meta, "buckets", {})
+    def _migrate_legacy_catalog(self) -> None:
+        """Rounds <= 3 kept the catalog as a JSON blob in the `buckets`
+        object's DATA; move those entries into the omap (via the same
+        atomic class op) so they are neither lost nor silently shadowed
+        (advisor r3: never drop a legacy on-disk format quietly)."""
+        try:
+            legacy = self._read_json(self.meta, "buckets", None)
+            if not legacy:
+                return
+            for name, info in legacy.items():
+                self.meta.exec("buckets", "rgw", "dir_entry_create",
+                               {"key": name, "val": info})  # -17 dup ok
+            self.meta.write_full("buckets", b"")
+        except (IOError, ConnectionError, TimeoutError) as e:
+            # a degraded cluster must not stop the gateway from starting
+            # (every other init-path call tolerates cluster errors); the
+            # blob is untouched, so the NEXT start retries the migration
+            self.rados.cct.dout("rgw", 0,
+                                f"legacy catalog migration deferred: {e}")
 
-    def _write_buckets(self, b: dict) -> None:
-        self.meta.write_full("buckets", json.dumps(b).encode())
+    def bucket_exists(self, bucket: str) -> bool:
+        try:
+            return bucket in self.meta.omap_get("buckets", keys=[bucket])
+        except IOError:
+            return False
+
+    def buckets(self) -> dict:
+        """Full catalog scan (ListAllMyBuckets is unpaginated in S3 v1)."""
+        out: dict[str, dict] = {}
+        after = ""
+        while True:
+            try:
+                page = self.meta.omap_get_vals("buckets", after=after,
+                                               max_return=256)
+            except IOError:
+                break
+            if not page:
+                break
+            for k in sorted(page):
+                after = k
+                out[k] = json.loads(page[k])
+        return out
 
     # -- bucket index: omap on idx.{bucket} (reference: the cls_rgw
     # bucket index objects in .rgw.buckets.index — one omap key per
     # object, listed with paginated omap scans; round 2 kept this as a
     # JSON blob, which could not scale past toy listings) ---------------
-    def _index_put(self, bucket: str, key: str, ent: dict) -> None:
-        self.meta.omap_set(
-            f"idx.{bucket}", {key: json.dumps(ent).encode()}
-        )
+    def _index_put(self, bucket: str, key: str, ent: dict) -> bool:
+        """Server-side transactional update (reference: cls_rgw index
+        complete) — atomic at the index object's primary even with many
+        gateways.  False = the index is sealed (the bucket was deleted
+        by a concurrent gateway after our existence check)."""
+        rv, _ = self.meta.exec(f"idx.{bucket}", "rgw", "index_update",
+                               {"add": {key: ent}})
+        return rv == 0
 
     def _index_rm(self, bucket: str, key: str) -> None:
         try:
-            self.meta.omap_rm_keys(f"idx.{bucket}", [key])
+            self.meta.exec(f"idx.{bucket}", "rgw", "index_update",
+                           {"rm": [key]})
         except IOError:
             pass
 
@@ -149,6 +198,8 @@ class _Store:
                 break
             for k in sorted(page):
                 after = k
+                if k.startswith("\x01"):
+                    continue  # reserved index-state keys (seal marker)
                 if prefix and not k.startswith(prefix):
                     if k > prefix:
                         return out, False  # sorted: past the prefix range
@@ -163,24 +214,37 @@ class _Store:
     # -- bucket ops --------------------------------------------------------
     def create_bucket(self, bucket: str) -> bool:
         with self.lock:
-            b = self.buckets()
-            if bucket in b:
+            # atomic create-if-absent claim: of N concurrent gateways,
+            # exactly one sees rv == 0 (reference: cls_rgw guards)
+            rv, _ = self.meta.exec(
+                "buckets", "rgw", "dir_entry_create",
+                {"key": bucket, "val": {"created": time.time()}},
+            )
+            if rv == -17:
                 return False
-            b[bucket] = {"created": time.time()}
-            self._write_buckets(b)
-            self.meta.write_full(f"idx.{bucket}", b"")  # empty index obj
+            # reset the index object: clears a stale seal / ghost
+            # entries a half-completed delete of this name left behind
+            self.meta.exec(f"idx.{bucket}", "rgw", "bucket_init", {})
             return True
 
     def delete_bucket(self, bucket: str) -> int:
-        """0 ok, -404 no bucket, -409 not empty."""
+        """0 ok, -404 no bucket, -409 not empty.
+
+        Ordering closes the delete/PUT race: the SEAL is the atomic
+        check-empty + tombstone on the index object itself (cls
+        bucket_seal), so a concurrent PUT either lands its entry before
+        the seal (we return -409) or hits the sealed index and fails —
+        never a ghost entry in a deleted bucket."""
         with self.lock:
-            b = self.buckets()
-            if bucket not in b:
+            if not self.bucket_exists(bucket):
                 return -404
-            if self._index_list(bucket, maxn=1)[0]:
+            rv, _ = self.meta.exec(f"idx.{bucket}", "rgw", "bucket_seal", {})
+            if rv == -39:
                 return -409
-            del b[bucket]
-            self._write_buckets(b)
+            rv, _ = self.meta.exec("buckets", "rgw", "dir_entry_remove",
+                                   {"key": bucket})
+            if rv == -2:
+                return -404  # lost a delete race with another gateway
             try:
                 self.meta.remove(f"idx.{bucket}")
             except IOError:
@@ -203,15 +267,19 @@ class _Store:
 
     def put_object(self, bucket: str, key: str, body: bytes) -> str | None:
         with self.lock:
-            if bucket not in self.buckets():
+            if not self.bucket_exists(bucket):
                 return None
             etag = hashlib.md5(body).hexdigest()
             s = self._stream(bucket, key)
             s.truncate(0)
             s.write(body)
-            self._index_put(bucket, key, {
+            if not self._index_put(bucket, key, {
                 "size": len(body), "etag": etag, "mtime": time.time()
-            })
+            }):
+                # index sealed: the bucket was deleted under us — undo
+                # the data write instead of orphaning it
+                s.remove()
+                return None
             return etag
 
     def get_object(self, bucket: str, key: str):
@@ -236,7 +304,7 @@ class _Store:
     # -- multipart ---------------------------------------------------------
     def create_upload(self, bucket: str, key: str) -> str | None:
         with self.lock:
-            if bucket not in self.buckets():
+            if not self.bucket_exists(bucket):
                 return None
             uid = uuid.uuid4().hex
             self.uploads[uid] = {"bucket": bucket, "key": key, "parts": {}}
@@ -271,7 +339,7 @@ class _Store:
                 return ("nosuch",)
             if not up["parts"]:
                 return ("empty",)
-            if up["bucket"] not in self.buckets():
+            if not self.bucket_exists(up["bucket"]):
                 # bucket vanished: the upload is dead; reap the parts
                 self.abort_upload(uid)
                 return ("nosuch",)
@@ -291,9 +359,13 @@ class _Store:
             etag = (
                 f"{hashlib.md5(md5s).hexdigest()}-{len(up['parts'])}"
             )
-            self._index_put(bucket, key, {
+            if not self._index_put(bucket, key, {
                 "size": off, "etag": etag, "mtime": time.time()
-            })
+            }):
+                # bucket deleted mid-complete: reap everything
+                dst.remove()
+                self.abort_upload(uid)
+                return ("nosuch",)
             # Parts are only deleted AFTER the index write and the record
             # drop: a crash anywhere up to here leaves record + parts
             # intact, so a restarted gateway can re-complete idempotently.
@@ -409,7 +481,7 @@ class _Handler(BaseHTTPRequestHandler):
             ).encode())
             return
         if not key:
-            if bucket not in self.store.buckets():
+            if not self.store.bucket_exists(bucket):
                 return self._error(404, "NoSuchBucket")
             prefix = q.get("prefix", [""])[0]
             marker = q.get("marker", [""])[0]
