@@ -2,6 +2,7 @@
 from .cephx import (
     AuthError,
     CephxAuthenticator,
+    derive_s3_secret,
     derive_service_key,
     frame_tag,
     generate_secret,
@@ -16,6 +17,7 @@ from .cephx import (
 __all__ = [
     "AuthError",
     "CephxAuthenticator",
+    "derive_s3_secret",
     "derive_service_key",
     "frame_tag",
     "generate_secret",

@@ -166,6 +166,17 @@ def derive_service_key(secret: bytes, service: str, gen: int) -> bytes:
                     hashlib.sha256).digest()
 
 
+def derive_s3_secret(secret: bytes, access_key: str, gen: int) -> str:
+    """Hex S3 secret key for the RGW SigV4 surface — same
+    derive-don't-store pattern as service keys, rotated by the "rgw"
+    auth generation (used by the mon's `auth get-s3-key` and the
+    gateway's verifier; reference: RGWUserInfo credentials, here backed
+    by the cephx cluster secret instead of a user database)."""
+    return hmac.new(
+        secret, f"s3:{access_key}:{gen}".encode(), hashlib.sha256
+    ).hexdigest()
+
+
 def mint_ticket(secret: bytes, entity: str, service: str, gen: int,
                 ttl: float) -> tuple[str, str]:
     """(sealed ticket blob, session_key_hex).  The blob is sealed under

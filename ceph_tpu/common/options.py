@@ -111,6 +111,10 @@ def default_options() -> OptionTable:
                    "lifetime of mon-minted service tickets, seconds "
                    "(reference: auth_service_ticket_ttl)", min=0.1,
                    runtime=True),
+            Option("rgw_enable_sigv4", bool, False,
+                   "require AWS SigV4 request signing at the S3 gateway "
+                   "(keys derive from the cephx cluster secret; False = "
+                   "anonymous zone, the pre-r4 behavior)"),
             # -- mgr (reference: mgr.yaml.in) ------------------------------
             Option("mgr_addr", str, "",
                    "host:port daemons send MMgrReport to ('' disables)",

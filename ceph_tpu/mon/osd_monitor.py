@@ -194,6 +194,8 @@ class OSDMonitor:
             return self._cmd_auth_rotate(cmd)
         if prefix == "auth gens":
             return 0, dict(self.osdmap.auth_gens) if self.osdmap else {}
+        if prefix == "auth get-s3-key":
+            return self._cmd_auth_s3_key(cmd)
         return -22, f"unknown command {prefix!r}"
 
     # -- cephx KeyServer role (reference: src/auth/cephx CephxKeyServer;
@@ -234,6 +236,24 @@ class OSDMonitor:
         blob, session_key = mint_ticket(secret, entity, service, gen, ttl)
         return 0, {"service": service, "entity": entity, "gen": gen,
                    "ticket": blob, "session_key": session_key}
+
+    def _cmd_auth_s3_key(self, cmd: dict) -> tuple[int, object]:
+        """`auth get-s3-key entity=<name>` — S3 credentials DERIVED from
+        the cephx cluster secret at the current "rgw" generation, so
+        `auth rotate service=rgw` invalidates outstanding keys (the
+        RGWUserInfo-credential role without a user database)."""
+        from ..auth import derive_s3_secret
+
+        secret = self._cluster_secret()
+        if secret is None:
+            return -1, "no cluster secret configured (auth_shared_secret)"
+        entity = cmd.get("entity", "client.admin")
+        if not entity or any(c in entity for c in " /,"):
+            return -22, f"bad entity {entity!r}"
+        gen = (self.osdmap.auth_gens.get("rgw", 1)
+               if self.osdmap is not None else 1)
+        return 0, {"access_key": entity, "gen": gen,
+                   "secret_key": derive_s3_secret(secret, entity, gen)}
 
     def _cmd_auth_rotate(self, cmd: dict) -> tuple[int, object]:
         """`auth rotate service=<svc>` — bump the service's key

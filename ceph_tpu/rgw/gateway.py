@@ -35,9 +35,10 @@ import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, unquote, urlparse
+from urllib.parse import parse_qs, parse_qsl, unquote, urlparse
 
 from ..client.striper import StripedObject
+from .sigv4 import SigV4Error, verify_request
 
 META_POOL = "rgw_meta"
 DATA_POOL = "rgw_data"
@@ -455,6 +456,37 @@ class _Handler(BaseHTTPRequestHandler):
         )
         self._reply(code, body)
 
+    def _auth_ok(self, body: bytes) -> bool:
+        """SigV4 gate (reference: rgw_auth_s3.cc): with rgw_enable_sigv4
+        every request — including each multipart step — must carry a
+        valid signature over the canonical request; anonymous and
+        bad-signature callers get the S3 error and never reach the
+        store.  Auth off = anonymous zone, the pre-r4 behavior."""
+        lookup = getattr(self.server, "s3_secret_lookup", None)
+        if lookup is None:
+            return True
+        u = urlparse(self.path)
+        try:
+            verify_request(
+                self.command, unquote(u.path),
+                parse_qsl(u.query, keep_blank_values=True),
+                dict(self.headers), body, lookup,
+            )
+            return True
+        except SigV4Error as e:
+            self.server.cct.dout("rgw", 5, f"sigv4 reject: {e}")
+            code = 403 if e.s3code in (
+                "AccessDenied", "SignatureDoesNotMatch",
+                "InvalidAccessKeyId", "RequestTimeTooSkewed",
+            ) else 400
+            if self.command == "HEAD":  # no body on HEAD replies
+                self.send_response(code)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+            else:
+                self._error(code, e.s3code)
+            return False
+
     def _int_param(self, q: dict, name: str, default: int | None = None):
         """Parse an int query param; raises _BadParam -> 400
         InvalidArgument instead of a connection-killing ValueError."""
@@ -468,6 +500,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- verbs -------------------------------------------------------------
     def do_GET(self):
+        if not self._auth_ok(self._body()):
+            return
         bucket, key, q = self._path()
         if not bucket:
             # ListAllMyBuckets
@@ -517,6 +551,8 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
     def do_HEAD(self):
+        if not self._auth_ok(self._body()):
+            return
         bucket, key, _ = self._path()
         ent = self.store.head_object(bucket, key) if key else None
         if ent is None:
@@ -534,6 +570,8 @@ class _Handler(BaseHTTPRequestHandler):
         # always drain the body: an unread body desynchronizes the
         # HTTP/1.1 keep-alive stream (e.g. CreateBucketConfiguration XML)
         body = self._body()
+        if not self._auth_ok(body):
+            return
         if not bucket:
             return self._error(400, "InvalidRequest")
         if not key:
@@ -557,7 +595,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         bucket, key, q = self._path()
-        self._body()  # drain (CompleteMultipartUpload part list unused)
+        body = self._body()  # drain (CompleteMultipartUpload list unused)
+        if not self._auth_ok(body):
+            return
         if "uploads" in q:
             uid = self.store.create_upload(bucket, key)
             if uid is None:
@@ -585,6 +625,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._error(400, "InvalidRequest")
 
     def do_DELETE(self):
+        if not self._auth_ok(self._body()):
+            return
         bucket, key, q = self._path()
         if key and "uploadId" in q:
             if not self.store.abort_upload(q["uploadId"][0]):
@@ -630,6 +672,25 @@ class RGWDaemon:
         handler = type("BoundHandler", (_Handler,), {"store": store})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", self.port), handler)
         self.httpd.cct = self.cct
+        self.httpd.s3_secret_lookup = None
+        if self.cct.conf.get("rgw_enable_sigv4"):
+            # fail LOUDLY at start if misconfigured: a sigv4 gateway
+            # without the cluster secret could never accept anyone
+            from ..auth import CephxAuthenticator
+            from .sigv4 import derive_s3_secret
+
+            secret = CephxAuthenticator(
+                self.cct.conf.get("auth_shared_secret")
+            ).secret
+            mc = self._rados.mc
+
+            def lookup(access_key: str) -> list[str]:
+                gen = (mc.osdmap.auth_gens.get("rgw", 1)
+                       if mc.osdmap is not None else 1)
+                return [derive_s3_secret(secret, access_key, g)
+                        for g in (gen, gen - 1) if g >= 1]
+
+            self.httpd.s3_secret_lookup = lookup
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, name="rgw-http", daemon=True
         )

@@ -1,0 +1,264 @@
+"""RGW AWS SigV4 request signing, backed by cephx-derived keys
+(reference: src/rgw/rgw_auth_s3.cc; round-3 verdict task #5)."""
+import hashlib
+import hmac
+import http.client
+import time
+from urllib.parse import parse_qsl, unquote, urlparse
+
+import pytest
+
+from ceph_tpu.auth import generate_secret
+from ceph_tpu.rgw.sigv4 import (
+    SigV4Error,
+    canonical_request,
+    derive_s3_secret,
+    sign_request,
+    string_to_sign,
+    verify_request,
+    _hx,
+)
+
+
+class TestVectors:
+    """Pinned to the AWS-published 'get-vanilla-query' suite example so
+    the implementation cannot drift from the spec."""
+
+    HDRS = {
+        "host": "iam.amazonaws.com",
+        "content-type": "application/x-www-form-urlencoded; charset=utf-8",
+        "x-amz-date": "20150830T123600Z",
+    }
+    PARAMS = [("Action", "ListUsers"), ("Version", "2010-05-08")]
+    SECRET = "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY"
+
+    def test_canonical_request_hash(self):
+        creq = canonical_request(
+            "GET", "/", self.PARAMS, self.HDRS,
+            ["content-type", "host", "x-amz-date"], _hx(b""),
+        )
+        assert _hx(creq.encode()) == (
+            "f536975d06c0309214f805bb90ccff089219ecd68b2577efef23edd43b7e1a59"
+        )
+
+    def test_final_signature(self):
+        creq = canonical_request(
+            "GET", "/", self.PARAMS, self.HDRS,
+            ["content-type", "host", "x-amz-date"], _hx(b""),
+        )
+        sts = string_to_sign(
+            "20150830T123600Z", "20150830/us-east-1/iam/aws4_request", creq
+        )
+
+        def hm(k, m):
+            return hmac.new(k, m.encode(), hashlib.sha256).digest()
+
+        k = hm(("AWS4" + self.SECRET).encode(), "20150830")
+        for part in ("us-east-1", "iam", "aws4_request"):
+            k = hm(k, part)
+        sig = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+        assert sig == ("5d672d79c15b13162d9279b0855cfba6789a8edb4c8"
+                       "2c400e06b5924a6f2b5d7")
+
+    def test_sign_verify_roundtrip(self):
+        secret = "topsecret"
+        headers = {"Host": "gw:8000"}
+        headers.update(sign_request(
+            "PUT", "/b/k", [], dict(headers), b"payload", "ak", secret
+        ))
+        assert verify_request("PUT", "/b/k", [], headers, b"payload",
+                              lambda ak: [secret]) == "ak"
+        with pytest.raises(SigV4Error):  # tampered body
+            verify_request("PUT", "/b/k", [], headers, b"payloaX",
+                           lambda ak: [secret])
+        with pytest.raises(SigV4Error):  # wrong secret
+            verify_request("PUT", "/b/k", [], headers, b"payload",
+                           lambda ak: ["other"])
+        # grace window: any candidate secret matching passes
+        assert verify_request("PUT", "/b/k", [], headers, b"payload",
+                              lambda ak: ["other", secret]) == "ak"
+
+    def test_skewed_clock_refused(self):
+        secret = "s"
+        headers = {"Host": "h"}
+        headers.update(sign_request(
+            "GET", "/", [], dict(headers), b"", "ak", secret,
+            amz_date="20200101T000000Z",
+        ))
+        with pytest.raises(SigV4Error) as ei:
+            verify_request("GET", "/", [], headers, b"",
+                           lambda ak: [secret])
+        assert ei.value.s3code == "RequestTimeTooSkewed"
+
+    def test_derive_s3_secret_gen_dependence(self):
+        cs = b"x" * 32
+        assert derive_s3_secret(cs, "a", 1) != derive_s3_secret(cs, "a", 2)
+        assert derive_s3_secret(cs, "a", 1) != derive_s3_secret(cs, "b", 1)
+        assert derive_s3_secret(cs, "a", 1) == derive_s3_secret(cs, "a", 1)
+
+
+# ---------------------------------------------------------------- ring-2
+
+pytestmark_cluster = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    with LocalCluster(
+        n_mons=1, n_osds=3,
+        conf_overrides={
+            "rgw_enable_sigv4": True,
+            "auth_shared_secret": generate_secret(),
+        },
+    ) as c:
+        c.start_rgw()
+        yield c
+
+
+@pytest.fixture(scope="module")
+def creds(cluster):
+    rv, out = cluster.mon_command(
+        {"prefix": "auth get-s3-key", "entity": "client.s3test"}
+    )
+    assert rv == 0, out
+    return out["access_key"], out["secret_key"]
+
+
+@pytest.fixture()
+def conn(cluster):
+    host, port = cluster.rgw.addr
+    c = http.client.HTTPConnection(host, port, timeout=30)
+    c._gw = (host, port)
+    yield c
+    c.close()
+
+
+def _signed(conn, method, path, body=b"", access=None, secret=None,
+            mutate_sig=False, amz_date=None):
+    host, port = conn._gw
+    u = urlparse(path)
+    headers = {"Host": f"{host}:{port}"}
+    extra = sign_request(
+        method, unquote(u.path),
+        parse_qsl(u.query, keep_blank_values=True),
+        dict(headers), body, access, secret, amz_date=amz_date,
+    )
+    if mutate_sig:
+        extra["Authorization"] = extra["Authorization"][:-4] + "beef"
+    headers.update(extra)
+    conn.request(method, path, body=body, headers=headers)
+    r = conn.getresponse()
+    data = r.read()
+    return r.status, dict(r.getheaders()), data
+
+
+@pytest.mark.cluster
+class TestSignedGateway:
+    def test_anonymous_refused(self, conn):
+        conn.request("GET", "/")
+        r = conn.getresponse()
+        body = r.read()
+        assert r.status == 403 and b"AccessDenied" in body
+
+    def test_signed_roundtrip(self, conn, creds):
+        ak, sk = creds
+        assert _signed(conn, "PUT", "/sb", access=ak, secret=sk)[0] == 200
+        payload = b"signed payload " * 100
+        st, hdrs, _ = _signed(conn, "PUT", "/sb/obj", payload, ak, sk)
+        assert st == 200
+        st, hdrs, body = _signed(conn, "GET", "/sb/obj", access=ak,
+                                 secret=sk)
+        assert st == 200 and body == payload
+        st, hdrs, _ = _signed(conn, "HEAD", "/sb/obj", access=ak,
+                              secret=sk)
+        assert st == 200 and int(hdrs["Content-Length"]) == len(payload)
+        # listing with query params is part of the canonical request
+        st, _, body = _signed(conn, "GET", "/sb?prefix=o&max-keys=10",
+                              access=ak, secret=sk)
+        assert st == 200 and b"<Key>obj</Key>" in body
+        assert _signed(conn, "DELETE", "/sb/obj", access=ak,
+                       secret=sk)[0] == 204
+
+    def test_bad_signature_refused(self, conn, creds):
+        ak, sk = creds
+        st, _, body = _signed(conn, "PUT", "/sb/evil", b"x", ak, sk,
+                              mutate_sig=True)
+        assert st == 403 and b"SignatureDoesNotMatch" in body
+
+    def test_wrong_secret_refused(self, conn, creds):
+        ak, _ = creds
+        st, _, body = _signed(conn, "GET", "/sb", access=ak,
+                              secret="not-the-secret")
+        assert st == 403 and b"SignatureDoesNotMatch" in body
+
+    def test_tampered_payload_refused(self, conn, creds):
+        ak, sk = creds
+        host, port = conn._gw
+        headers = {"Host": f"{host}:{port}"}
+        extra = sign_request("PUT", "/sb/t", [], dict(headers),
+                             b"original", ak, sk)
+        headers.update(extra)
+        conn.request("PUT", "/sb/t", body=b"tampered!", headers=headers)
+        r = conn.getresponse()
+        body = r.read()
+        assert r.status == 400 and b"XAmzContentSHA256Mismatch" in body
+
+    def test_stale_date_refused(self, conn, creds):
+        ak, sk = creds
+        old = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime(time.time() - 7200))
+        st, _, body = _signed(conn, "GET", "/sb", access=ak, secret=sk,
+                              amz_date=old)
+        assert st == 403 and b"RequestTimeTooSkewed" in body
+
+    def test_multipart_flow_signed(self, conn, creds):
+        ak, sk = creds
+        assert _signed(conn, "PUT", "/mpb", access=ak, secret=sk)[0] == 200
+        st, _, body = _signed(conn, "POST", "/mpb/big?uploads",
+                              access=ak, secret=sk)
+        assert st == 200
+        uid = body.split(b"<UploadId>")[1].split(b"</UploadId>")[0].decode()
+        p1, p2 = b"A" * 70000, b"B" * 50000
+        for n, part in ((1, p1), (2, p2)):
+            st, _, _ = _signed(
+                conn, "PUT", f"/mpb/big?partNumber={n}&uploadId={uid}",
+                part, ak, sk,
+            )
+            assert st == 200
+        st, _, body = _signed(conn, "POST", f"/mpb/big?uploadId={uid}",
+                              access=ak, secret=sk)
+        assert st == 200 and b"CompleteMultipartUploadResult" in body
+        st, _, body = _signed(conn, "GET", "/mpb/big", access=ak,
+                              secret=sk)
+        assert st == 200 and body == p1 + p2
+        # an UNSIGNED part upload is refused
+        conn.request("PUT", f"/mpb/big?partNumber=3&uploadId={uid}",
+                     body=b"x")
+        r = conn.getresponse()
+        assert r.status == 403
+        r.read()
+
+    def test_rotation_cuts_off_old_key(self, cluster, conn, creds):
+        ak, sk = creds
+        # two rotations: past the one-generation grace window
+        for _ in range(2):
+            rv, _r = cluster.mon_command(
+                {"prefix": "auth rotate", "service": "rgw"}
+            )
+            assert rv == 0
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            st, _, _ = _signed(conn, "GET", "/", access=ak, secret=sk)
+            if st == 403:
+                break
+            time.sleep(0.5)
+        assert st == 403, "rotated-out S3 key still accepted"
+        # a freshly minted key (current generation) works
+        rv, out = cluster.mon_command(
+            {"prefix": "auth get-s3-key", "entity": "client.s3test"}
+        )
+        assert rv == 0 and out["gen"] >= 3
+        st, _, _ = _signed(conn, "GET", "/", access=out["access_key"],
+                           secret=out["secret_key"])
+        assert st == 200
