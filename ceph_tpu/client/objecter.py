@@ -218,8 +218,14 @@ class Objecter(Dispatcher):
         # may legitimately need longer than 8 quick retries to restore
         # min_size, and the op is already durably logged in the
         # 'applied' case — giving up early turns a pending success into
-        # a spurious client error
-        eagain_deadline = _time.monotonic() + max(60.0, 2 * timeout)
+        # a spurious client error.  objecter_eagain_patience overrides
+        # for callers that would rather fail fast against a pool that
+        # cannot reach min_size (advisor r3)
+        patience = (self.cct.conf.get("objecter_eagain_patience")
+                    if self.cct else 0.0)
+        if not patience:
+            patience = max(60.0, 2 * timeout)
+        eagain_deadline = _time.monotonic() + patience
         hard = 0
         while hard < attempts:
             m = self.mc.osdmap

@@ -45,6 +45,10 @@ def default_options() -> OptionTable:
                    "(0 = off; reference: ms_inject_socket_failures)",
                    min=0, runtime=True),
             # -- throttles -------------------------------------------------
+            Option("objecter_eagain_patience", float, 0.0,
+                   "seconds to keep retrying -EAGAIN refusals (degraded "
+                   "pg, peering) before surfacing the error; 0 = auto "
+                   "(max(60, 2x op timeout))", min=0.0, runtime=True),
             Option("objecter_inflight_op_bytes", int, 100 << 20,
                    "client dirty-data throttle", min=0),
             Option("objecter_inflight_ops", int, 1024,

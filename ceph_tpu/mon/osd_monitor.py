@@ -152,6 +152,33 @@ class OSDMonitor:
         prefix = cmd.get("prefix", "")
         if prefix == "osd dump":
             return 0, self.osdmap.to_json() if self.osdmap else {}
+        if prefix == "osd getmap":
+            # historical epoch fetch (reference: mon serving old maps for
+            # OSD pg-history reconstruction / PastIntervals rebuild)
+            try:
+                e = int(cmd.get("epoch", 0))
+            except (TypeError, ValueError):
+                return -22, "bad epoch"
+            j = self.get_map_json(e)
+            return (0, j) if j is not None else (-2, f"no map epoch {e}")
+        if prefix == "osd getmaps":
+            # batched range fetch for interval-history rebuilds: 64
+            # epochs per call keeps one recovery pass at ~8 round trips
+            # instead of 512 (review r4); trimmed epochs are omitted
+            try:
+                first = int(cmd.get("first", 0))
+                last = int(cmd.get("last", 0))
+            except (TypeError, ValueError):
+                return -22, "bad epoch range"
+            if first < 1 or last < first:
+                return -22, f"bad epoch range [{first},{last}]"
+            last = min(last, first + 63)
+            out = {}
+            for e in range(first, last + 1):
+                j = self.get_map_json(e)
+                if j is not None:
+                    out[str(e)] = j
+            return 0, {"maps": out, "last": last}
         if prefix == "osd stat":
             return 0, self._stat()
         if prefix == "osd erasure-code-profile set":

@@ -101,6 +101,22 @@ class PGState:
         # revived primary answering from its stale log/version would
         # fork history or falsely ack writes it cannot place.
         self.activated_interval = -1
+        # formal history of CLOSED up/acting intervals (reference:
+        # PastIntervals) — drives choose_acting's candidate pool, the
+        # build_prior activation block, and bounded stray probing
+        from .past_intervals import PastIntervals
+
+        self.past_intervals = PastIntervals()
+        # cumulative closures recorded this process-lifetime (observability
+        # only — prune clears the history, not this)
+        self.intervals_closed = 0
+        # newest map epoch under which this PG logged a write (persisted
+        # with the log): a revived OSD uses it as the starting point to
+        # REBUILD interval history from the mon's old maps — intervals
+        # that passed while it was down were never seen by _on_map
+        # (reference: pg_history_t + build via past OSDMaps)
+        self.last_map_epoch = 0
+        self.intervals_rebuilt = False
         # reqid -> (retval, result) of COMPLETED mutations: a client
         # resend whose reply was lost is answered from here instead of
         # re-executed (reference: pg_log dup entries / osd_reqid_t);
@@ -251,6 +267,7 @@ class OSD(Dispatcher):
             .add_u64_counter("op_r_bytes", "bytes read")
             .add_time_avg("op_latency", "op latency")
             .add_u64_counter("recovery_ops", "objects pushed in recovery")
+            .add_u64_counter("stray_probes", "stray-location probes sent")
             .add_u64_counter("subop_w", "shard sub-writes applied")
             .add_u64_counter("scrubs", "PG scrubs completed")
             .add_u64_counter("scrub_errors", "shard inconsistencies found")
@@ -360,7 +377,24 @@ class OSD(Dispatcher):
                 except Exception:
                     continue
                 if (o[2], o[3]) != (n[2], n[3]):
+                    # close the old interval into the history BEFORE
+                    # starting the new one (reference: check_new_interval)
+                    old_pool = old.pools.get(pg.pool_id)
+                    went_rw = (
+                        o[3] >= 0
+                        and old_pool is not None
+                        and sum(1 for a in o[2] if a >= 0)
+                        >= old_pool.min_size
+                    )
+                    pg.past_intervals.add(
+                        first=pg.interval_start or old.epoch,
+                        last=m.epoch - 1,
+                        up=o[0], acting=o[2], primary=o[3],
+                        maybe_went_rw=went_rw,
+                    )
+                    pg.intervals_closed += 1
                     pg.interval_start = m.epoch
+                    self._save_intervals(pg)
         self._recovery_wakeup.set()  # re-peer with the new map
 
     def my_epoch(self) -> int:
@@ -424,6 +458,8 @@ class OSD(Dispatcher):
             self._pg(int(pool_id), int(ps))
 
     def _load_pg_meta(self, pg: PGState) -> None:
+        from .past_intervals import PastIntervals
+
         # any shard collection of this pg carries the meta object
         for cid in self.store.list_collections():
             if cid.rsplit("s", 1)[0] != pg.pgid:
@@ -436,7 +472,40 @@ class OSD(Dispatcher):
             tail = int(pairs.get("tail", b"0"))
             pg.log = PGLog.load(pairs, head, tail)
             pg.version = head
+            pg.past_intervals = PastIntervals.from_bytes(
+                pairs.get("past_intervals")
+            )
+            pg.last_map_epoch = int(pairs.get("last_epoch", b"0"))
             return
+
+    def _save_intervals(self, pg: PGState) -> None:
+        """Persist the interval history next to the PG log (same meta
+        omap; reference: PastIntervals rides pg_info_t in the pg meta).
+        Written to every local shard collection of the PG so whichever
+        shard survives a wipe still carries the history."""
+        wrote = False
+        for cid in self.store.list_collections():
+            if cid.rsplit("s", 1)[0] != pg.pgid:
+                continue
+            t = Transaction()
+            t.touch(cid, pg.meta_oid())
+            t.omap_setkeys(cid, pg.meta_oid(), {
+                "past_intervals": pg.past_intervals.to_bytes(),
+            })
+            self.store.queue_transaction(t)
+            wrote = True
+        if not wrote and pg.past_intervals:
+            # no local collection yet (e.g. freshly assigned primary):
+            # stash under this OSD's would-be-primary shard so the
+            # history survives a restart
+            cid = self._cid(pg.pgid, 0)
+            t = Transaction()
+            t.try_create_collection(cid)
+            t.touch(cid, pg.meta_oid())
+            t.omap_setkeys(cid, pg.meta_oid(), {
+                "past_intervals": pg.past_intervals.to_bytes(),
+            })
+            self.store.queue_transaction(t)
 
     def _log_txn(self, t: Transaction, cid: str, pg: PGState,
                  entry: LogEntry) -> None:
@@ -446,10 +515,12 @@ class OSD(Dispatcher):
 
         trimmed = pg.log.append(entry)
         pg.version = entry.version
+        pg.last_map_epoch = self.my_epoch()
         keys = {
             PGLog.omap_key(entry.version): json.dumps(entry.to_list()).encode(),
             "head": str(pg.log.head).encode(),
             "tail": str(pg.log.tail).encode(),
+            "last_epoch": str(pg.last_map_epoch).encode(),
         }
         t.touch(cid, pg.meta_oid())
         t.omap_setkeys(cid, pg.meta_oid(), keys)
@@ -1865,16 +1936,29 @@ class OSD(Dispatcher):
                     except (NotFound, KeyError, ValueError):
                         pass
                     return bytes(chunk), ver, size
+        # candidate order (reference: missing_loc built from
+        # PastIntervals): past holders of THIS shard first — they are
+        # the only OSDs that can plausibly hold it — then, only when no
+        # history exists (fresh boot, pruned-clean PG), the bounded
+        # global walk the pre-history code used
+        exclude = {self.id, holder}
+        candidates = pg.past_intervals.holders_of_shard(shard, exclude)
+        if not candidates:
+            candidates = [
+                osd for osd in range(self.osdmap.max_osd)
+                if osd not in exclude
+            ]
         probes = 0
-        for osd in range(self.osdmap.max_osd):
-            if osd in (self.id, holder) or not self.osdmap.is_up(osd):
+        for osd in candidates:
+            if not self.osdmap.is_up(osd):
                 continue
             if probes >= 16:
                 break  # bound the walk on big maps (client-path cost)
             probes += 1
+            self.logger.inc("stray_probes")
             # metadata-only probe first (offsets=[]): a miss or a
             # non-qualifying generation costs a tiny round trip, not a
-            # full-chunk transfer (past_intervals will shrink this walk)
+            # full-chunk transfer
             tid = self._next_tid()
             try:
                 self._conn_to_osd(osd).send_message(MECSubOpRead(
@@ -2791,7 +2875,7 @@ class OSD(Dispatcher):
             conn.send_message(
                 MPGNotify(tid=msg.tid, pgid=msg.pgid, shard=msg.shard,
                           version=pg.version, log_start=pg.log.tail,
-                          oids=oids)
+                          oids=oids, last_epoch=pg.last_map_epoch)
             )
         except (OSError, ConnectionError):
             pass
@@ -3366,6 +3450,88 @@ class OSD(Dispatcher):
                         f"{self.whoami} recover {pg.pgid}: {e!r}",
                     )
 
+    def _rebuild_intervals_from_maps(self, pg: PGState, start: int,
+                                     until: int | None = None) -> None:
+        """Reconstruct interval history from the mon's stored maps
+        (reference: PastIntervals::check_new_interval walked over past
+        OSDMaps via OSDService::get_map).  A revived OSD's in-memory
+        tracking saw nothing while it was down, and a freshly-assigned
+        primary only started recording at its own PG creation; the maps
+        saw everything.  Rebuilds the closures over [start, until) and
+        PREPENDS them to whatever in-memory history already exists."""
+        from .past_intervals import PastIntervals
+
+        cur = self.my_epoch()
+        until = cur if until is None else min(until, cur)
+        start = max(1, start)
+        if until - start > 512:
+            start = until - 512  # bound mon fetches on huge gaps
+        # batched fetch: ~8 round trips for the full 512-epoch bound
+        # instead of one command per epoch (review r4)
+        fetched: dict[int, dict] = {}
+        e = start
+        while e <= until:
+            if self.osdmap is not None and e == self.osdmap.epoch:
+                e += 1
+                continue
+            try:
+                rv, res = self.mc.command(
+                    {"prefix": "osd getmaps", "first": e, "last": until},
+                    timeout=10.0,
+                )
+            except (OSError, ConnectionError):
+                return  # mon unreachable: retry next pass
+            if rv != 0:
+                return
+            fetched.update(
+                {int(k): v for k, v in res.get("maps", {}).items()}
+            )
+            e = int(res.get("last", e)) + 1
+        rebuilt = PastIntervals()
+        prev = None
+        prev_ua = None
+        first = start
+        for e in range(start, until + 1):
+            if self.osdmap is not None and e == self.osdmap.epoch:
+                m = self.osdmap
+            else:
+                j = fetched.get(e)
+                if j is None:
+                    continue  # epoch gap (paxos-trimmed): skip
+                m = OSDMap.from_json(j)
+            try:
+                ua = m.pg_to_up_acting_osds(pg.pool_id, pg.ps)
+            except Exception:
+                prev, prev_ua = m, None
+                continue
+            if prev_ua is not None and (prev_ua[2], prev_ua[3]) != \
+                    (ua[2], ua[3]):
+                pool = prev.pools.get(pg.pool_id)
+                went_rw = (
+                    prev_ua[3] >= 0
+                    and pool is not None
+                    and sum(1 for a in prev_ua[2] if a >= 0) >= pool.min_size
+                )
+                rebuilt.add(
+                    first=first, last=m.epoch - 1,
+                    up=prev_ua[0], acting=prev_ua[2], primary=prev_ua[3],
+                    maybe_went_rw=went_rw,
+                )
+                first = m.epoch
+            prev, prev_ua = m, ua
+        pg.intervals_rebuilt = True
+        if rebuilt:
+            pg.past_intervals.intervals = (
+                rebuilt.intervals + pg.past_intervals.intervals
+            )
+            self.cct.dout(
+                "osd", 1,
+                f"{self.whoami} {pg.pgid} rebuilt "
+                f"{len(rebuilt.intervals)} past interval(s) from maps "
+                f"[{start},{until}]",
+            )
+            self._save_intervals(pg)
+
     def _recover_pg(self, pg: PGState, pool, acting: list[int]) -> None:
         is_ec = pool.type == PG_POOL_ERASURE
         codec = self._codec_for_pool(pool) if is_ec else None
@@ -3373,6 +3539,7 @@ class OSD(Dispatcher):
         # authoritative-log pull, the per-peer classification, and
         # delete propagation
         peers: dict[tuple[int, int], tuple[int, list]] = {}
+        peer_epochs: list[int] = []
         for shard, osd in enumerate(acting):
             if osd < 0 or osd == self.id or not self.osdmap.is_up(osd):
                 continue
@@ -3391,7 +3558,72 @@ class OSD(Dispatcher):
             if rep is None or rep.version is None:
                 continue
             peers[(shard, osd)] = (rep.version, rep.oids or [])
+            e = getattr(rep, "last_epoch", None)
+            if e:
+                peer_epochs.append(int(e))
         interval_at_entry = pg.interval_start
+        # history rebuild (reference: pg_history_t carried in notifies +
+        # PastIntervals built over past OSDMaps): when this primary has
+        # no interval history but the PG demonstrably has a past — its
+        # own or any peer's last-write epoch predates the current
+        # interval — fetch the intervening maps from the mon and
+        # reconstruct the closed intervals before judging anything.
+        # Covers both the revived stale OSD (its own epoch is old) and
+        # the freshly-assigned empty primary (a peer's epoch is old) —
+        # even one that already recorded SOME closures of its own: the
+        # rebuild fills the prefix its in-memory tracking predates.
+        known = [e for e in ([pg.last_map_epoch] + peer_epochs) if e]
+        hist_floor = (
+            pg.past_intervals.intervals[0]["first"]
+            if pg.past_intervals else pg.interval_start
+        )
+        if (
+            not pg.intervals_rebuilt
+            and known
+            and min(known) < hist_floor
+        ):
+            self._rebuild_intervals_from_maps(
+                pg, start=min(known), until=hist_floor
+            )
+        # choose_acting beyond the acting set (reference: build_prior +
+        # choose_acting over PastIntervals): members of past rw
+        # intervals may hold a log NEWER than anything the current
+        # acting set has — query them too, bounded by the history
+        strays: dict[tuple[int, int], int] = {}
+        queried = {self.id} | {osd for (_s, osd) in peers}
+        prior = pg.past_intervals.query_candidates(
+            exclude={-1, self.id} | {o for o in acting if o >= 0},
+            is_up=self.osdmap.is_up,
+        )
+        for osd, p_shard in prior.items():
+            tid = self._next_tid()
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MPGQuery(tid=tid, pgid=pg.pgid,
+                             shard=p_shard if is_ec else 0,
+                             epoch=self.my_epoch())
+                )
+            except (OSError, ConnectionError):
+                continue
+            rep = self._wait_reply(tid, timeout=5.0)
+            if rep is None or rep.version is None:
+                continue
+            queried.add(osd)
+            strays[(p_shard, osd)] = rep.version
+        # build_prior activation block: a past rw interval NONE of whose
+        # members answered may hold the authoritative log — activating
+        # anyway could serve a stale/forked history (the exact failure
+        # generation floors cannot see).  Stay inactive and retry.
+        blocked = pg.past_intervals.blocked_by(queried)
+        if blocked:
+            iv = blocked[0]
+            self.cct.dout(
+                "osd", 1,
+                f"{self.whoami} {pg.pgid} peering blocked: interval "
+                f"[{iv['first']},{iv['last']}] acting {iv['acting']} "
+                f"went rw and no member is reachable",
+            )
+            return
         # phase 0 — adopt the authoritative log (reference: peering's
         # choose_acting/authoritative-log step): a primary revived after
         # missing writes must catch ITSELF up first, else it would mint
@@ -3400,6 +3632,28 @@ class OSD(Dispatcher):
         # Runs WITHOUT pg.lock: the donor's catch-up arrives as
         # MECSubOpWrites our dispatch thread applies under that lock.
         ahead = {k: v for k, (v, _o) in peers.items() if v > pg.version}
+        stray_newest = max(strays.values(), default=0)
+        if stray_newest > max([pg.version, *ahead.values()]):
+            if is_ec:
+                # an EC stray proves newer writes exist, but a non-acting
+                # donor cannot push shard-correct chunks (the donor path
+                # reads by its acting index) — stay INACTIVE rather than
+                # activate on a log we know is stale; the PG heals when
+                # the stray rejoins acting or an acting member catches up
+                self.cct.dout(
+                    "osd", 1,
+                    f"{self.whoami} {pg.pgid} stale vs stray holders "
+                    f"(v{stray_newest} > v{pg.version}); deferring "
+                    f"activation",
+                )
+                return
+            # replicated: the past-interval holder IS the authoritative
+            # log donor even though it is not acting (choose_acting
+            # electing a stray; every replica is shard 0, so the pull
+            # path needs no shard translation)
+            ahead = {
+                k: v for k, v in strays.items() if v == stray_newest
+            }
         if ahead:
             (_b_shard, b_osd), _bv = max(ahead.items(), key=lambda kv: kv[1])
             my_shard = acting.index(self.id) if is_ec else 0
@@ -3483,11 +3737,13 @@ class OSD(Dispatcher):
                     pass
                 my_oids = _my_oids()
         # push phase: serialize vs concurrent client writes on this PG
+        all_clean = True
         with pg.lock:
             for (shard, osd), (peer_ver, peer_oids) in peers.items():
                 role_missing = my_oids - set(peer_oids)
                 if peer_ver >= pg.version and not role_missing:
                     continue  # clean
+                all_clean = False
                 if peer_ver >= pg.version:
                     # version-current but the SHARD ROLE's objects are
                     # absent: an acting-set permutation (OSD out -> CRUSH
@@ -3511,6 +3767,25 @@ class OSD(Dispatcher):
                         pg, codec, acting, shard if is_ec else 0, osd,
                         peer_ver, is_ec, peer_oids,
                     )
+        # prune the interval history once the PG is CLEAN in the current
+        # interval (reference: PastIntervals pruned at last_epoch_clean).
+        # "Clean" demands a FULL acting set in which every member (up or
+        # not) answered and needed no push — a degraded PG (down member,
+        # unfilled slot) keeps its history: those unheard members are
+        # exactly what the history exists to track (review r4).
+        acting_members = {o for o in acting if o >= 0 and o != self.id}
+        if (
+            all_clean
+            and pg.past_intervals
+            and all(o >= 0 for o in acting)
+            and acting_members <= {osd for (_s, osd) in peers}
+        ):
+            pg.past_intervals.clear()
+            # a future staleness gap starts from NOW, and may rebuild
+            # again if it appears
+            pg.last_map_epoch = max(pg.last_map_epoch, self.my_epoch())
+            pg.intervals_rebuilt = False
+            self._save_intervals(pg)
 
     def _push_missing(self, pg, codec, acting, dest_shard, dest_osd,
                       from_version, is_ec, dest_oids) -> bool:

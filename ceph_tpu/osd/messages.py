@@ -137,10 +137,15 @@ class MPGQuery(_JsonMessage):
 class MPGNotify(_JsonMessage):
     """Peer shard → primary: PG info reply (reference: MOSDPGNotify).
     version: last applied version; log_start: oldest version still in the
-    bounded log (0 = log covers from the beginning)."""
+    bounded log (0 = log covers from the beginning); last_epoch: newest
+    map epoch the peer logged a write under (reference: pg_history_t
+    riding pg_info_t in notifies) — a freshly-assigned primary with no
+    local history uses the minimum over peers as the starting point to
+    rebuild PastIntervals from the mon's map archive."""
 
     MSG_TYPE = 113
-    FIELDS = ("tid", "pgid", "shard", "version", "log_start", "oids")
+    FIELDS = ("tid", "pgid", "shard", "version", "log_start", "oids",
+              "last_epoch")
 
 
 @register_message
