@@ -207,19 +207,21 @@ def bucket_tree_choose(bucket, x: int, r: int) -> int:
     (leaves at odd indices), hashing a split point against the left
     subtree's weight at each internal node."""
     nodes = bucket.node_weights
-    depth = len(nodes).bit_length() - 1
-    n = 1 << (depth - 1)  # root
-    # an all-zero subtree (zero-weight bucket) collapses to the first
-    # item, exactly as the oracle's root-collapse loop does
-    while n > 1 and nodes[n] == 0:
-        n >>= 1
+    # root = num_nodes >> 1, unconditionally (mapper.c) — no zero-weight
+    # collapse (advisor r3).  A weighted descent can never reach an
+    # empty leaf: t in [0, w) and the left subtree holds all of w when
+    # the right is empty, so t < left always steers left.  The one
+    # exception is an ALL-ZERO tree (t = 0, comparisons all false,
+    # descend right into padding) — upstream reads out-of-bounds there;
+    # we pin that degenerate case to the last real item.
+    n = len(nodes) >> 1
     while not (n & 1):
         w = nodes[n]
         t = (_hash4(x, n, r, bucket.id) * w) >> 32
         h = (n & -n) >> 1  # half the subtree span
         left = n - h
         n = left if t < nodes[left] else n + h
-    return bucket.items[n >> 1]
+    return bucket.items[min(n >> 1, len(bucket.items) - 1)]
 
 
 def bucket_straw_choose(bucket, x: int, r: int) -> int:

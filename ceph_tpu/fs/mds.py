@@ -109,9 +109,32 @@ class MDSDaemon(Dispatcher):
                 kv = self._io.omap_get(oid)
             except IOError:
                 kv = {}
-            self.dirs[ino] = {
-                name: json.loads(v) for name, v in kv.items()
-            }
+            if kv:
+                self.dirs[ino] = {
+                    name: json.loads(v) for name, v in kv.items()
+                }
+                continue
+            # legacy format (rounds <= 2 kept dirfrags as a JSON blob in
+            # the object DATA): migrate instead of silently loading an
+            # empty directory and losing the namespace (advisor r3).
+            # Migrate NOW — omap written first, blob cleared after — a
+            # stale blob left behind would resurrect deleted entries the
+            # next time this directory's omap goes empty (review r4)
+            legacy = self._obj_read(oid)
+            if legacy:
+                self.dirs[ino] = dict(legacy)
+                self._io.omap_set(oid, {
+                    name: json.dumps(inode).encode()
+                    for name, inode in legacy.items()
+                })
+                self._io.write_full(oid, b"")
+                self.cct.dout(
+                    "mds", 1,
+                    f"migrated legacy dirfrag {oid} "
+                    f"({len(legacy)} entries) to omap",
+                )
+            else:
+                self.dirs[ino] = {}
         if ROOT_INO not in self.dirs:
             self.dirs[ROOT_INO] = {}
             self._dirty.add(ROOT_INO)

@@ -60,6 +60,7 @@ from .messages import (
     MOSDOp,
     MOSDOpReply,
     MOSDPingMsg,
+    MPGClean,
     MPGNotify,
     MPGPull,
     MPGPullReply,
@@ -117,6 +118,12 @@ class PGState:
         # (reference: pg_history_t + build via past OSDMaps)
         self.last_map_epoch = 0
         self.intervals_rebuilt = False
+        # shard collections known to hold this PG's meta locally (filled
+        # by _load_pg_meta/_log_txn so _save_intervals never rescans the
+        # whole store per map change)
+        self.meta_cids: set[str] = set()
+        # interval for which this primary last broadcast MPGClean
+        self.clean_broadcast_interval = -1
         # reqid -> (retval, result) of COMPLETED mutations: a client
         # resend whose reply was lost is answered from here instead of
         # re-executed (reference: pg_log dup entries / osd_reqid_t);
@@ -476,35 +483,35 @@ class OSD(Dispatcher):
                 pairs.get("past_intervals")
             )
             pg.last_map_epoch = int(pairs.get("last_epoch", b"0"))
+            pg.meta_cids.add(cid)
             return
 
     def _save_intervals(self, pg: PGState) -> None:
-        """Persist the interval history next to the PG log (same meta
-        omap; reference: PastIntervals rides pg_info_t in the pg meta).
-        Written to every local shard collection of the PG so whichever
-        shard survives a wipe still carries the history."""
-        wrote = False
-        for cid in self.store.list_collections():
-            if cid.rsplit("s", 1)[0] != pg.pgid:
-                continue
-            t = Transaction()
-            t.touch(cid, pg.meta_oid())
-            t.omap_setkeys(cid, pg.meta_oid(), {
-                "past_intervals": pg.past_intervals.to_bytes(),
-            })
-            self.store.queue_transaction(t)
-            wrote = True
-        if not wrote and pg.past_intervals:
-            # no local collection yet (e.g. freshly assigned primary):
-            # stash under this OSD's would-be-primary shard so the
-            # history survives a restart
-            cid = self._cid(pg.pgid, 0)
+        """Persist the interval history + rebuild floor next to the PG
+        log (same meta omap; reference: PastIntervals + history ride
+        pg_info_t in the pg meta).  Uses the PG's known shard
+        collections (meta_cids) — a full store scan per map change was
+        O(pgs x collections) on the map-handling path (review r4); the
+        scan runs once, only when the cache is cold."""
+        if not pg.meta_cids:
+            pg.meta_cids = {
+                cid for cid in self.store.list_collections()
+                if cid.rsplit("s", 1)[0] == pg.pgid
+            }
+            if not pg.meta_cids:
+                # no local collection yet (freshly assigned primary):
+                # stash under the would-be-primary shard so the history
+                # survives a restart
+                pg.meta_cids = {self._cid(pg.pgid, 0)}
+        keys = {
+            "past_intervals": pg.past_intervals.to_bytes(),
+            "last_epoch": str(pg.last_map_epoch).encode(),
+        }
+        for cid in pg.meta_cids:
             t = Transaction()
             t.try_create_collection(cid)
             t.touch(cid, pg.meta_oid())
-            t.omap_setkeys(cid, pg.meta_oid(), {
-                "past_intervals": pg.past_intervals.to_bytes(),
-            })
+            t.omap_setkeys(cid, pg.meta_oid(), keys)
             self.store.queue_transaction(t)
 
     def _log_txn(self, t: Transaction, cid: str, pg: PGState,
@@ -524,6 +531,7 @@ class OSD(Dispatcher):
         }
         t.touch(cid, pg.meta_oid())
         t.omap_setkeys(cid, pg.meta_oid(), keys)
+        pg.meta_cids.add(cid)
         if trimmed:
             t.omap_rmkeys(
                 cid, pg.meta_oid(), [PGLog.omap_key(e.version) for e in trimmed]
@@ -594,6 +602,9 @@ class OSD(Dispatcher):
             return True
         if isinstance(msg, MPGQuery):
             self._handle_pg_query(conn, msg)
+            return True
+        if isinstance(msg, MPGClean):
+            self._handle_pg_clean(msg)
             return True
         if isinstance(msg, MScrubShard):
             self._handle_scrub_shard(conn, msg)
@@ -1938,16 +1949,17 @@ class OSD(Dispatcher):
                     return bytes(chunk), ver, size
         # candidate order (reference: missing_loc built from
         # PastIntervals): past holders of THIS shard first — they are
-        # the only OSDs that can plausibly hold it — then, only when no
-        # history exists (fresh boot, pruned-clean PG), the bounded
-        # global walk the pre-history code used
+        # the only OSDs that can plausibly hold it — then the bounded
+        # global walk as a suffix, so an INCOMPLETE history (capped,
+        # trimmed maps) can still find a holder the pre-history walk
+        # would have (review r4); the probe cap below bounds the cost
         exclude = {self.id, holder}
         candidates = pg.past_intervals.holders_of_shard(shard, exclude)
-        if not candidates:
-            candidates = [
-                osd for osd in range(self.osdmap.max_osd)
-                if osd not in exclude
-            ]
+        seen = set(candidates)
+        candidates += [
+            osd for osd in range(self.osdmap.max_osd)
+            if osd not in exclude and osd not in seen
+        ]
         probes = 0
         for osd in candidates:
             if not self.osdmap.is_up(osd):
@@ -2880,6 +2892,23 @@ class OSD(Dispatcher):
         except (OSError, ConnectionError):
             pass
 
+    def _handle_pg_clean(self, msg: MPGClean) -> None:
+        """Primary says the PG went clean at `epoch` (the
+        last_epoch_clean role): advance the persisted rebuild floor and
+        drop local interval history — settled intervals must never
+        re-block a future peering round.  A clean claim from a PAST
+        interval is ignored (a deposed primary cannot retro-settle
+        history it no longer owns)."""
+        pool_id, ps = msg.pgid.split(".")
+        pg = self._pg(int(pool_id), int(ps))
+        with pg.lock:
+            if msg.epoch < pg.interval_start:
+                return
+            pg.last_map_epoch = max(pg.last_map_epoch, int(msg.epoch))
+            pg.past_intervals.clear()
+            pg.intervals_rebuilt = False
+            self._save_intervals(pg)
+
     # -- scrub (reference: src/osd/scrubber — deep scrub subset) ----------
     def _local_scrub_map(self, cid: str) -> dict:
         """ScrubMap of one shard collection: oid -> [computed_crc,
@@ -3521,9 +3550,13 @@ class OSD(Dispatcher):
             prev, prev_ua = m, ua
         pg.intervals_rebuilt = True
         if rebuilt:
+            from .past_intervals import MAX_INTERVALS
+
+            # keep the NEWEST MAX_INTERVALS — direct assignment must not
+            # bypass add()'s growth cap (review r4)
             pg.past_intervals.intervals = (
                 rebuilt.intervals + pg.past_intervals.intervals
-            )
+            )[-MAX_INTERVALS:]
             self.cct.dout(
                 "osd", 1,
                 f"{self.whoami} {pg.pgid} rebuilt "
@@ -3768,24 +3801,39 @@ class OSD(Dispatcher):
                         peer_ver, is_ec, peer_oids,
                     )
         # prune the interval history once the PG is CLEAN in the current
-        # interval (reference: PastIntervals pruned at last_epoch_clean).
-        # "Clean" demands a FULL acting set in which every member (up or
-        # not) answered and needed no push — a degraded PG (down member,
-        # unfilled slot) keeps its history: those unheard members are
-        # exactly what the history exists to track (review r4).
+        # interval (reference: last_epoch_clean).  "Clean" demands a
+        # FULL acting set in which every member answered and needed no
+        # push — a degraded PG keeps its history: those unheard members
+        # are exactly what the history exists to track (review r4).
+        # The clean point is BROADCAST to the acting replicas (MPGClean)
+        # so their persisted rebuild floors advance too — otherwise a
+        # later primary rebuilding from a replica's stale last-write
+        # epoch would resurrect already-settled intervals whose members
+        # are long gone and block activation forever (review r4).
         acting_members = {o for o in acting if o >= 0 and o != self.id}
         if (
             all_clean
-            and pg.past_intervals
             and all(o >= 0 for o in acting)
             and acting_members <= {osd for (_s, osd) in peers}
+            and (pg.past_intervals
+                 or pg.clean_broadcast_interval != interval_at_entry)
         ):
+            epoch = self.my_epoch()
             pg.past_intervals.clear()
-            # a future staleness gap starts from NOW, and may rebuild
-            # again if it appears
-            pg.last_map_epoch = max(pg.last_map_epoch, self.my_epoch())
+            pg.last_map_epoch = max(pg.last_map_epoch, epoch)
             pg.intervals_rebuilt = False
+            pg.clean_broadcast_interval = interval_at_entry
             self._save_intervals(pg)
+            for shard, osd in enumerate(acting):
+                if osd < 0 or osd == self.id or not self.osdmap.is_up(osd):
+                    continue
+                try:
+                    self._conn_to_osd(osd).send_message(MPGClean(
+                        pgid=pg.pgid, shard=shard if is_ec else 0,
+                        epoch=epoch,
+                    ))
+                except (OSError, ConnectionError):
+                    pass  # replica re-learns at its next clean pass
 
     def _push_missing(self, pg, codec, acting, dest_shard, dest_osd,
                       from_version, is_ec, dest_oids) -> bool:

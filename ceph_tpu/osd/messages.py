@@ -210,3 +210,17 @@ class MWatchNotify(_JsonMessage):
 class MWatchNotifyAck(_JsonMessage):
     MSG_TYPE = 119
     FIELDS = ("notify_id", "pool", "oid", "cookie")
+
+
+@register_message
+class MPGClean(_JsonMessage):
+    """Primary → acting replicas: the PG went CLEAN in the current
+    interval at `epoch` (reference: last_epoch_clean riding pg_info /
+    MOSDPGInfo).  Replicas bump their persisted interval-rebuild floor
+    and drop their own past-interval history — intervals older than a
+    clean point are settled and must never re-block a future peering
+    round (their members may be long gone while every byte lives on in
+    the clean acting set)."""
+
+    MSG_TYPE = 120
+    FIELDS = ("pgid", "shard", "epoch")

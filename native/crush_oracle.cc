@@ -196,12 +196,19 @@ int tree_choose(const Map& m, int bucket_idx, uint32_t x, uint32_t r) {
   const int32_t* items = m.items + (size_t)bucket_idx * m.max_size;
   const int64_t* nodes = m.node_weights + (size_t)bucket_idx * m.max_nodes;
   const int32_t bid = -1 - bucket_idx;
-  int depth = 0;
-  while ((1 << (depth + 1)) <= m.max_nodes) ++depth;
-  // the bucket's own tree may be shallower than max_nodes: find its root
-  // as the highest power of two whose node weight is the bucket total
-  int n = 1 << (depth - 1);
-  while (n > 1 && nodes[n] == 0) n >>= 1;
+  // the bucket's own num_nodes is structural — the smallest power of
+  // two covering 2*size leaf slots (builder.c crush_make_tree_bucket) —
+  // so the root is num_nodes >> 1 exactly as mapper.c starts, with no
+  // zero-weight collapse (advisor r3).  A weighted descent never lands
+  // on an empty leaf (t < w and the left subtree carries all the weight
+  // when the right is empty); only an ALL-ZERO tree descends right into
+  // padding, where upstream reads out of bounds — pin that degenerate
+  // case to the last real item instead of padding (which aliased a
+  // bucket id and cycled forever).
+  const int size = m.sizes[bucket_idx];
+  int nn = 2;
+  while (nn < 2 * size) nn <<= 1;
+  int n = nn >> 1;
   while (!(n & 1)) {
     const uint64_t w = (uint64_t)nodes[n];
     const uint64_t t =
@@ -210,7 +217,8 @@ int tree_choose(const Map& m, int bucket_idx, uint32_t x, uint32_t r) {
     const int left = n - h;
     n = ((int64_t)t < nodes[left]) ? left : n + h;
   }
-  return items[n >> 1];
+  const int leaf = n >> 1;
+  return items[leaf < size ? leaf : size - 1];
 }
 
 // mapper.c :: bucket_straw_choose — hashed draw times build-time straw

@@ -197,9 +197,10 @@ def test_uniform_requires_equal_weights():
 
 
 def test_tree_bucket_zero_total_weight():
-    """All-zero tree bucket: scalar and oracle must agree (the implicit
-    descent has no signal; both collapse to the first item) instead of
-    the scalar walking into zero padding."""
+    """All-zero tree bucket: scalar and oracle must agree.  The descent
+    has no signal (t = 0 everywhere) so both descend right and pin the
+    empty-leaf landing to the LAST real item — mapper.c's root start
+    with the out-of-bounds degenerate read made safe (advisor r3)."""
     cmap = CrushMap(type_names={0: "osd", 1: "host", 2: "root"})
     b = make_straw2_bucket(cmap, 1, [0, 1, 2], [0, 0, 0],
                            name="h0", alg=BUCKET_TREE)

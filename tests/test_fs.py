@@ -209,3 +209,36 @@ def test_many_ops_roll_journal_segments():
         assert len(fs2.listdir("/")) == 30
         fs2.unmount()
         fs.unmount()
+
+
+def test_legacy_dirfrag_blob_migrates_on_load():
+    """A metadata pool written by the rounds<=2 data-blob dirfrag format
+    must load with its namespace INTACT — migrated into the omap format,
+    not silently dropped (advisor r3)."""
+    import json
+
+    with LocalCluster(n_mons=1, n_osds=3, with_mds=True) as c:
+        f = c.fs_client()
+        f.mkdir("/keepme")
+        f.unmount()
+        c.mds._flush()  # dirfrags land on RADOS (omap format)
+        c.kill_mds()
+        # rewrite the ROOT dirfrag the legacy way: JSON blob in the
+        # object data, omap cleared
+        meta = c.client("client.legacy").open_ioctx("cephfs_meta")
+        from ceph_tpu.fs.mds import ROOT_INO
+
+        oid = f"dir.{ROOT_INO:x}"
+        legacy_entries = {
+            name: json.loads(v)
+            for name, v in meta.omap_get(oid).items()
+        }
+        assert "keepme" in legacy_entries
+        meta.omap_clear(oid)
+        meta.write_full(oid, json.dumps(legacy_entries).encode())
+        c.restart_mds()
+        f2 = c.fs_client("client.fs2")
+        assert "keepme" in f2.listdir("/")          # namespace survived
+        f2.mkdir("/fresh")                           # and is writable
+        assert sorted(f2.listdir("/")) == ["fresh", "keepme"]
+        f2.unmount()
