@@ -222,5 +222,5 @@ class MPGClean(_JsonMessage):
     round (their members may be long gone while every byte lives on in
     the clean acting set)."""
 
-    MSG_TYPE = 120
+    MSG_TYPE = 121
     FIELDS = ("pgid", "shard", "epoch")
