@@ -139,6 +139,19 @@ def default_options() -> OptionTable:
                    runtime=True),
             Option("mgr_stale_report_age", float, 30.0,
                    "drop daemon reports older than this", min=1.0),
+            Option("mgr_dashboard_port", int, 0,
+                   "dashboard HTTP port (0 = ephemeral)"),
+            Option("mgr_devicehealth_self_heal", bool, True,
+                   "devicehealth marks failing OSDs out automatically "
+                   "(reference: devicehealth self_heal)", runtime=True),
+            Option("mgr_devicehealth_mark_out_threshold", int, 8,
+                   "cumulative integrity errors before devicehealth "
+                   "marks an OSD out", min=1, runtime=True),
+            Option("mgr_devicehealth_min_in_ratio", float, 0.75,
+                   "refuse self-heal mark-outs that would drop the "
+                   "in-OSD ratio below this (reference: "
+                   "mon_osd_min_in_ratio)", min=0.0, max=1.0,
+                   runtime=True),
             Option("mon_target_pg_per_osd", int, 100,
                    "PGs per OSD the autoscaler aims for (reference: "
                    "mon_target_pg_per_osd)", min=1, runtime=True),

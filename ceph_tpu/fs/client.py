@@ -196,7 +196,9 @@ class FSClient(Dispatcher):
         if rv < 0:
             exc = _ERR.get(rv, FSError)
             raise exc(f"{op} {args}: errno {rv} ({result})")
-        if op in ("create", "mkdir", "unlink", "rmdir", "rename"):
+        if op in ("create", "mkdir", "unlink", "rmdir", "rename", "link"):
+            # link changes the TARGET inode's nlink too, so cached
+            # lookups of any of its paths would go stale
             self._dcache.clear()
         elif op == "setattr":
             # setattr changes no dentries — evict only entries caching the
@@ -286,10 +288,23 @@ class FSClient(Dispatcher):
         fh = FileHandle(self, inode)
         fh._ext.purge(fh.size())
 
+    def link(self, src: str, dst: str) -> dict:
+        """Hardlink (reference: Client::link -> MDS remote dentry): both
+        paths resolve to the SAME inode afterwards; data lives until the
+        last link goes."""
+        inode = self._resolve(src)
+        parent, name = self._resolve_parent(dst)
+        return self._request(
+            "link", {"parent": parent, "name": name, "ino": inode["ino"]}
+        )
+
     def unlink(self, path: str) -> None:
         parent, name = self._resolve_parent(path)
         inode = self._request("unlink", {"parent": parent, "name": name})
-        self._purge_data(inode)
+        # purge only on the LAST link (reference: the purge queue fires
+        # at nlink 0; surviving hardlinks keep the data objects)
+        if inode.get("type") == "file" and inode.get("nlink_after", 0) == 0:
+            self._purge_data(inode)
 
     def rmdir(self, path: str) -> None:
         parent, name = self._resolve_parent(path)
@@ -306,7 +321,10 @@ class FSClient(Dispatcher):
         # client holding the last reference (the MDS purge-queue analog,
         # as in unlink)
         replaced = (result or {}).get("replaced")
-        if replaced is not None and replaced.get("type") == "file":
+        if (
+            replaced is not None and replaced.get("type") == "file"
+            and replaced.get("nlink_after", 0) == 0
+        ):
             self._purge_data(replaced)
 
     def write_file(self, path: str, data: bytes) -> None:

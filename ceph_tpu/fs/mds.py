@@ -8,7 +8,11 @@ Faithful structural choices:
   dentry with the child inode embedded in the value — the reference's
   dirfrag omap layout (src/mds/CDir.cc stores dentries as omap keys of
   the dir object; primary dentry embeds the inode, src/mds/CDentry.h).
-  Hardlinks (remote dentries) are out of scope.
+  Hardlinks are REMOTE dentries ({"remote": ino} stubs) resolving to
+  the primary via the backpointer map; the primary inode carries nlink,
+  and removing the primary while links remain promotes a recorded
+  remote stub to primary (src/mds/CDentry.h remote linkage; the
+  promotion the reference performs at link-merge time).
 - Updates are journaled before dirfrags are flushed (src/mds/MDLog.cc:
   EUpdate events into journal segments stored as RADOS objects); a
   restarted MDS replays segments newer than the last flush point, so
@@ -61,6 +65,8 @@ class MDSDaemon(Dispatcher):
         # in-memory cache (MDCache): dirfrags + ino backpointers
         self.dirs: dict[int, dict[str, dict]] = {}
         self.backptr: dict[int, tuple[int, str]] = {}  # ino -> (parent, name)
+        # hardlink reverse map: ino -> remote-stub dentry locations
+        self.remotes: dict[int, set[tuple[int, str]]] = {}
         self.next_ino = ROOT_INO + 1
         self._dirty: set[int] = set()  # dirfrags awaiting flush
         # per-dirfrag dentry deltas (name -> inode | None=removed): the
@@ -164,10 +170,25 @@ class MDSDaemon(Dispatcher):
         self._flush()
 
     def _rebuild_backptrs(self) -> None:
+        """Primary dentries (embedded inode) feed backptr; remote stubs
+        ({"remote": ino}) feed the hardlink reverse map (reference:
+        CDentry primary vs remote linkage)."""
         self.backptr = {}
+        self.remotes = {}
         for dino, entries in self.dirs.items():
             for name, inode in entries.items():
-                self.backptr[inode["ino"]] = (dino, name)
+                if "remote" in inode:
+                    self.remotes.setdefault(
+                        inode["remote"], set()).add((dino, name))
+                else:
+                    self.backptr[inode["ino"]] = (dino, name)
+
+    def _resolve_entry(self, entry: dict | None) -> dict | None:
+        """Follow a remote (hardlink) stub to its primary inode; primary
+        entries return as-is (reference: CDentry::get_linkage)."""
+        if entry is None or "remote" not in entry:
+            return entry
+        return self._inode_of(entry["remote"])
 
     def _flush(self) -> None:
         """Flush dirty dirfrags + inotable, then trim the journal
@@ -258,32 +279,95 @@ class MDSDaemon(Dispatcher):
             self.backptr[inode["ino"]] = (parent, name)
             self.next_ino = max(self.next_ino, inode["ino"] + 1)
             self._mark(parent, name, inode)
+        elif kind == "link_remote":  # hardlink: remote stub + nlink SET
+            parent, name, ino = ev["parent"], ev["name"], ev["ino"]
+            stub = {"remote": ino, "type": "file"}
+            self.dirs.setdefault(parent, {})[name] = stub
+            self.remotes.setdefault(ino, set()).add((parent, name))
+            self._mark(parent, name, stub)
+            inode = self._inode_of(ino)
+            bp = self.backptr.get(ino)
+            if inode is not None and bp is not None:
+                # ABSOLUTE value from the event, not +1: replay against
+                # already-flushed state must stay idempotent (review r4)
+                inode["nlink"] = ev["nlink"]
+                self._mark(bp[0], bp[1], inode)
         elif kind == "unlink":
             parent, name = ev["parent"], ev["name"]
-            inode = self.dirs.get(parent, {}).pop(name, None)
-            if inode is not None:
-                self.backptr.pop(inode["ino"], None)
-                if inode["type"] == "dir":
-                    self.dirs.pop(inode["ino"], None)
-                    self._dirty.add(inode["ino"])
+            entry = self.dirs.get(parent, {}).pop(name, None)
             self._mark(parent, name, None)
+            if "stub_ino" in ev:
+                # a hardlink stub died: the primary's nlink is SET to the
+                # journaled value (idempotent replay)
+                ino = ev["stub_ino"]
+                self.remotes.get(ino, set()).discard((parent, name))
+                inode = self._inode_of(ino)
+                bp = self.backptr.get(ino)
+                if inode is not None and bp is not None:
+                    inode["nlink"] = ev["primary_nlink"]
+                    self._mark(bp[0], bp[1], inode)
+            else:
+                if entry is not None and "remote" not in entry:
+                    self.backptr.pop(entry["ino"], None)
+                    if entry["type"] == "dir":
+                        self.dirs.pop(entry["ino"], None)
+                        self._dirty.add(entry["ino"])
+                # primary dentry died but hardlinks remain: the recorded
+                # stub becomes primary.  The FULL promoted inode rides in
+                # the event so replay applies even when the source dentry
+                # was already flushed away (entry None — review r4)
+                pinode = ev.get("promote_inode")
+                if pinode is not None:
+                    pdino, pname = ev["promote"]
+                    pinode = dict(pinode)
+                    self.dirs.setdefault(pdino, {})[pname] = pinode
+                    self.remotes.get(pinode["ino"], set()).discard(
+                        (pdino, pname))
+                    self.backptr[pinode["ino"]] = (pdino, pname)
+                    self._mark(pdino, pname, pinode)
         elif kind == "rename":
             sdir, sname = ev["srcdir"], ev["sname"]
             ddir, dname = ev["dstdir"], ev["dname"]
-            inode = self.dirs.get(sdir, {}).pop(sname, None)
+            entry = self.dirs.get(sdir, {}).pop(sname, None)
             # src removal marked BEFORE the dst set so a same-path rename
             # nets out to the set, not the removal
             self._mark(sdir, sname, None)
-            if inode is not None:
+            if entry is not None:
                 replaced = self.dirs.setdefault(ddir, {}).get(dname)
-                if replaced is not None:
+                if replaced is not None and "remote" in replaced:
+                    # clobbering a hardlink stub: its primary lives on
+                    # with the journaled ABSOLUTE nlink
+                    rino = replaced["remote"]
+                    self.remotes.get(rino, set()).discard((ddir, dname))
+                    rinode = self._inode_of(rino)
+                    bp = self.backptr.get(rino)
+                    if (rinode is not None and bp is not None
+                            and "replaced_nlink" in ev):
+                        rinode["nlink"] = ev["replaced_nlink"]
+                        self._mark(bp[0], bp[1], rinode)
+                elif replaced is not None:
                     self.backptr.pop(replaced["ino"], None)
                     if replaced["type"] == "dir":  # empty dir replaced
                         self.dirs.pop(replaced["ino"], None)
                         self._dirty.add(replaced["ino"])
-                self.dirs[ddir][dname] = inode
-                self.backptr[inode["ino"]] = (ddir, dname)
-                self._mark(ddir, dname, inode)
+                pinode = ev.get("promote_inode")
+                if pinode is not None:
+                    pdino, pname = ev["promote_replaced"]
+                    pinode = dict(pinode)
+                    self.dirs.setdefault(pdino, {})[pname] = pinode
+                    self.remotes.get(pinode["ino"], set()).discard(
+                        (pdino, pname))
+                    self.backptr[pinode["ino"]] = (pdino, pname)
+                    self._mark(pdino, pname, pinode)
+                self.dirs[ddir][dname] = entry
+                if "remote" in entry:
+                    ino = entry["remote"]
+                    self.remotes.setdefault(ino, set()).discard(
+                        (sdir, sname))
+                    self.remotes.setdefault(ino, set()).add((ddir, dname))
+                else:
+                    self.backptr[entry["ino"]] = (ddir, dname)
+                self._mark(ddir, dname, entry)
         elif kind == "setattr":
             ino = ev["ino"]
             bp = self.backptr.get(ino)
@@ -339,7 +423,7 @@ class MDSDaemon(Dispatcher):
             entries = self.dirs.get(a["parent"])
             if entries is None:
                 return -2, None
-            inode = entries.get(a["name"])
+            inode = self._resolve_entry(entries.get(a["name"]))
             return (0, inode) if inode is not None else (-2, None)
         if op == "getattr":
             inode = self._inode_of(a["ino"])
@@ -348,7 +432,27 @@ class MDSDaemon(Dispatcher):
             entries = self.dirs.get(a["ino"])
             if entries is None:
                 return -20, None
-            return 0, {n: i for n, i in sorted(entries.items())}
+            return 0, {
+                n: self._resolve_entry(i) for n, i in sorted(entries.items())
+            }
+        if op == "link":
+            # hardlink (reference: Server::handle_client_link — a remote
+            # dentry referencing an existing file inode); directories are
+            # refused like link(2) does
+            parent, name, ino = a["parent"], a["name"], a["ino"]
+            if parent not in self.dirs:
+                return -20, None
+            if name in self.dirs[parent]:
+                return -17, None
+            inode = self._inode_of(ino)
+            if inode is None:
+                return -2, None
+            if inode["type"] == "dir":
+                return -1, None  # EPERM
+            self._commit({"e": "link_remote", "parent": parent,
+                          "name": name, "ino": ino,
+                          "nlink": inode.get("nlink", 1) + 1})
+            return 0, self._inode_of(ino)
         if op in ("create", "mkdir"):
             parent = a["parent"]
             if parent not in self.dirs:
@@ -373,7 +477,8 @@ class MDSDaemon(Dispatcher):
             return 0, inode
         if op in ("unlink", "rmdir"):
             parent, name = a["parent"], a["name"]
-            inode = self.dirs.get(parent, {}).get(name)
+            entry = self.dirs.get(parent, {}).get(name)
+            inode = self._resolve_entry(entry)
             if inode is None:
                 return -2, None
             if op == "rmdir":
@@ -383,17 +488,42 @@ class MDSDaemon(Dispatcher):
                     return -39, None
             elif inode["type"] == "dir":
                 return -21, None
-            self._commit({"e": "unlink", "parent": parent, "name": name})
-            return 0, inode
+            ev = {"e": "unlink", "parent": parent, "name": name}
+            nlink_after = inode.get("nlink", 1) - 1
+            if entry is not None and "remote" in entry:
+                # stub removal: journal the primary's resulting nlink as
+                # an ABSOLUTE value (idempotent replay)
+                ev["stub_ino"] = entry["remote"]
+                ev["primary_nlink"] = max(nlink_after, 1)
+            elif (
+                entry is not None and inode["type"] == "file"
+                and nlink_after >= 1
+            ):
+                # primary dentry dies but hardlinks remain: promote a
+                # deterministic remote stub to primary, with the FULL
+                # promoted inode in the event so replay works even after
+                # partial flushes (review r4)
+                rem = sorted(self.remotes.get(inode["ino"], set()))
+                if rem:
+                    ev["promote"] = list(rem[0])
+                    ev["promote_inode"] = dict(
+                        inode, nlink=max(nlink_after, 1)
+                    )
+            self._commit(ev)
+            # nlink_after tells the client whether it holds the LAST
+            # reference (purge) or a survivor keeps the data alive
+            return 0, dict(inode, nlink_after=max(nlink_after, 0))
         if op == "rename":
             sdir, sname = a["srcdir"], a["sname"]
-            inode = self.dirs.get(sdir, {}).get(sname)
+            entry = self.dirs.get(sdir, {}).get(sname)
+            inode = self._resolve_entry(entry)
             if inode is None:
                 return -2, None
             dst = self.dirs.get(a["dstdir"])
             if dst is None:
                 return -20, None
-            existing = dst.get(a["dname"])
+            dst_entry = dst.get(a["dname"])
+            existing = self._resolve_entry(dst_entry)
             if existing is not None:
                 if existing["ino"] == inode["ino"]:
                     return 0, {"moved": inode, "replaced": None}
@@ -417,11 +547,33 @@ class MDSDaemon(Dispatcher):
                     if bp is None:
                         break
                     cur = bp[0]
-            self._commit({"e": "rename", "srcdir": sdir, "sname": sname,
-                          "dstdir": a["dstdir"], "dname": a["dname"]})
+            ev = {"e": "rename", "srcdir": sdir, "sname": sname,
+                  "dstdir": a["dstdir"], "dname": a["dname"]}
+            replaced_nlink_after = None
+            if existing is not None:
+                replaced_nlink_after = existing.get("nlink", 1) - 1
+                if dst_entry is not None and "remote" in dst_entry:
+                    ev["replaced_nlink"] = max(replaced_nlink_after, 1)
+                elif (
+                    existing["type"] == "file"
+                    and replaced_nlink_after >= 1
+                ):
+                    rem = sorted(self.remotes.get(existing["ino"], set()))
+                    if rem:
+                        ev["promote_replaced"] = list(rem[0])
+                        ev["promote_inode"] = dict(
+                            existing, nlink=max(replaced_nlink_after, 1)
+                        )
+            self._commit(ev)
             # a replaced file's inode goes back to the caller so the
-            # client can purge its data objects (purge-queue analog)
-            return 0, {"moved": inode, "replaced": existing}
+            # client holding the LAST reference can purge its data
+            # objects (purge-queue analog); surviving hardlinks keep it
+            replaced = None
+            if existing is not None:
+                replaced = dict(
+                    existing, nlink_after=max(replaced_nlink_after, 0)
+                )
+            return 0, {"moved": inode, "replaced": replaced}
         if op == "setattr":
             inode = self._inode_of(a["ino"])
             if inode is None:

@@ -18,6 +18,8 @@ from .module import MODULE_REGISTRY, MgrModule
 
 # imports register the in-tree modules
 from . import balancer_module  # noqa: F401
+from . import dashboard_module  # noqa: F401
+from . import devicehealth_module  # noqa: F401
 from . import pg_autoscaler_module  # noqa: F401
 from . import prometheus_module  # noqa: F401
 from . import status_module  # noqa: F401

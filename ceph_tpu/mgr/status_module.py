@@ -5,24 +5,32 @@ from __future__ import annotations
 from .module import MgrModule, register_module
 
 
+def assemble_osd_rows(m, stats: dict) -> list[dict]:
+    """Per-OSD status rows — shared by `ceph osd status` (this module)
+    and the dashboard's /api/osd so they can never drift apart."""
+    rows = []
+    if m is not None:
+        for o in range(m.max_osd):
+            if not m.exists(o):
+                continue
+            st = stats.get(f"osd.{o}", {})
+            rows.append({
+                "id": o,
+                "up": int(m.is_up(o)),
+                "in": int(m.is_in(o)),
+                "pgs": st.get("num_pgs", 0),
+                "objects": st.get("num_objects", 0),
+            })
+    return rows
+
+
 @register_module
 class StatusModule(MgrModule):
     NAME = "status"
 
     def osd_status(self) -> dict:
         m = self.get("osd_map")
-        stats = self.mgr.latest_stats()
-        rows = []
-        if m is not None:
-            for o in range(m.max_osd):
-                if not m.exists(o):
-                    continue
-                st = stats.get(f"osd.{o}", {})
-                rows.append({
-                    "id": o,
-                    "up": int(m.is_up(o)),
-                    "in": int(m.is_in(o)),
-                    "pgs": st.get("num_pgs", 0),
-                    "objects": st.get("num_objects", 0),
-                })
-        return {"epoch": m.epoch if m else 0, "osds": rows}
+        return {
+            "epoch": m.epoch if m else 0,
+            "osds": assemble_osd_rows(m, self.mgr.latest_stats()),
+        }

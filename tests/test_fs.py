@@ -242,3 +242,86 @@ def test_legacy_dirfrag_blob_migrates_on_load():
         f2.mkdir("/fresh")                           # and is writable
         assert sorted(f2.listdir("/")) == ["fresh", "keepme"]
         f2.unmount()
+
+
+class TestHardlinks:
+    """Remote dentries + nlink + primary promotion (reference:
+    src/mds/CDentry.h remote linkage; src/mds/Server handle_client_link)."""
+
+    def test_link_shares_inode_and_data(self, fs):
+        fs.write_file("/hl_orig", b"linked bytes")
+        fs.link("/hl_orig", "/hl_alias")
+        st1, st2 = fs.stat("/hl_orig"), fs.stat("/hl_alias")
+        assert st1["ino"] == st2["ino"]
+        assert st1.get("nlink", 1) == 2
+        assert fs.read_file("/hl_alias") == b"linked bytes"
+        # writes through one path visible through the other (same inode)
+        fs.write_file("/hl_orig", b"updated!")
+        assert fs.read_file("/hl_alias") == b"updated!"
+
+    def test_unlink_one_keeps_data(self, fs):
+        fs.write_file("/hl_a", b"survives")
+        fs.link("/hl_a", "/hl_b")
+        fs.unlink("/hl_a")  # removes the PRIMARY: promotion must occur
+        assert fs.read_file("/hl_b") == b"survives"
+        assert fs.stat("/hl_b").get("nlink", 1) == 1
+        # setattr via the promoted primary still works
+        fh = fs.open("/hl_b")
+        fh.truncate(4)
+        assert fs.read_file("/hl_b") == b"surv"
+        fs.unlink("/hl_b")  # last link: data really goes
+        import pytest as _pytest
+
+        with _pytest.raises(OSError):
+            fs.read_file("/hl_b")
+
+    def test_link_errors(self, fs):
+        fs.mkdir("/hl_dir")
+        import pytest as _pytest
+
+        with _pytest.raises(OSError):   # EPERM on directories
+            fs.link("/hl_dir", "/hl_dirlink")
+        fs.write_file("/hl_c", b"x")
+        with _pytest.raises(OSError):   # EEXIST
+            fs.link("/hl_c", "/hl_c")
+        with _pytest.raises(OSError):   # ENOENT source
+            fs.link("/hl_missing", "/hl_y")
+
+    def test_rename_of_stub_and_replacement(self, fs):
+        fs.write_file("/hl_p", b"payload")
+        fs.link("/hl_p", "/hl_q")
+        fs.rename("/hl_q", "/hl_q2")            # move the stub
+        assert fs.read_file("/hl_q2") == b"payload"
+        assert fs.stat("/hl_q2")["ino"] == fs.stat("/hl_p")["ino"]
+        # replace a stub by rename: primary survives with nlink 1
+        fs.write_file("/hl_other", b"other")
+        fs.rename("/hl_other", "/hl_q2")
+        assert fs.read_file("/hl_q2") == b"other"
+        assert fs.read_file("/hl_p") == b"payload"   # data NOT purged
+        assert fs.stat("/hl_p").get("nlink", 1) == 1
+
+    def test_replay_is_idempotent(self, cluster, fs):
+        """Events are ABSOLUTE state setters: re-applying a journaled
+        link/unlink against already-flushed state must not drift nlink
+        (review r4 — a crash inside _flush replays untrimmed events)."""
+        fs.write_file("/hl_idem", b"x")
+        fs.link("/hl_idem", "/hl_idem2")
+        mds = cluster.mds
+        ino = fs.stat("/hl_idem")["ino"]
+        ev = {"e": "link_remote", "parent": 1, "name": "hl_idem2",
+              "ino": ino, "nlink": 2}
+        with mds._lock:
+            mds._apply(ev)   # double-apply, as replay-after-flush would
+            mds._apply(ev)
+        assert fs.stat("/hl_idem")["nlink"] == 2  # not 3 or 4
+
+    def test_links_survive_mds_crash_replay(self, cluster, fs):
+        fs.write_file("/hl_j", b"journaled")
+        fs.link("/hl_j", "/hl_j2")
+        fs.unlink("/hl_j")   # promotion lands in the journal too
+        cluster.kill_mds()   # crash: no flush
+        cluster.restart_mds()
+        f2 = cluster.fs_client("client.hlre")
+        assert f2.read_file("/hl_j2") == b"journaled"
+        assert f2.stat("/hl_j2").get("nlink", 1) == 1
+        f2.unmount()

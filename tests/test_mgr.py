@@ -126,3 +126,99 @@ def test_balancer_dry_run_mode():
         c.mgr.module("balancer").optimize_once()
         time.sleep(1.0)
         assert not c.mgr.mc.osdmap.pg_upmap_items
+
+
+@pytest.fixture(scope="module")
+def dd_cluster():
+    with LocalCluster(
+        n_mons=1, n_osds=3, with_mgr=True,
+        conf_overrides={
+            "mgr_report_interval": 0.5,
+            "mgr_tick_interval": 0.5,
+            "mgr_modules": "status,devicehealth,dashboard",
+            "mgr_devicehealth_mark_out_threshold": 3,
+            # 3-OSD cluster: one mark-out leaves 2/3 in; the default
+            # 0.75 floor would (correctly) refuse every self-heal
+            "mgr_devicehealth_min_in_ratio": 0.5,
+        },
+    ) as c:
+        c.create_replicated_pool("dh", size=2)
+        yield c
+
+
+def test_dashboard_endpoints(dd_cluster):
+    """The dashboard module serves the HTML page and the REST API rows
+    (reference: the mgr dashboard's REST layer)."""
+    io = dd_cluster.client().open_ioctx("dh")
+    io.write_full("seen", b"x" * 1000)
+    mod = dd_cluster.mgr.module("dashboard")
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        rows = mod.osd_rows()
+        if rows and any(r["up"] for r in rows):
+            break
+        time.sleep(0.5)
+    page = urllib.request.urlopen(mod.url, timeout=10).read().decode()
+    assert "<h1>cluster: HEALTH_" in page and "osd.0" in page
+    import json as _json
+
+    api = _json.loads(urllib.request.urlopen(
+        mod.url + "api/osd?format=json", timeout=10).read())
+    assert {r["id"] for r in api} == {0, 1, 2}
+    pools = _json.loads(urllib.request.urlopen(
+        mod.url + "api/pool", timeout=10).read())
+    assert any(p["name"] == "dh" for p in pools)
+    health = _json.loads(urllib.request.urlopen(
+        mod.url + "api/health", timeout=10).read())
+    assert "health" in health or "error" in health
+
+
+def test_devicehealth_tracks_and_marks_out(dd_cluster):
+    """Integrity errors (scrub_errors counter) push an OSD over the
+    threshold: devicehealth warns, then marks it OUT via the mon
+    (reference: devicehealth mark_out_threshold self-heal)."""
+    mod = dd_cluster.mgr.module("devicehealth")
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if len(mod.status()["tracked"]) >= 3:
+            break
+        time.sleep(0.5)
+    assert len(mod.status()["tracked"]) >= 3
+    # simulate a rotting device: bump osd.2's scrub_errors counter the
+    # way a scrub repair pass would
+    victim = dd_cluster.osds[2]
+    for _ in range(4):
+        victim.logger.inc("scrub_errors")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = mod.status()
+        if "osd.2" in st["warnings"] and 2 in st["marked_out"]:
+            break
+        time.sleep(0.5)
+    st = mod.status()
+    assert "osd.2" in st["warnings"], st
+    assert st["warnings"]["osd.2"]["new_errors"] >= 4
+    assert 2 in st["marked_out"], st
+    # the map really shows it out
+    deadline = time.time() + 15
+    cl = dd_cluster.client("client.dhchk")
+    while time.time() < deadline:
+        m = cl.mc.osdmap
+        if m is not None and not m.is_in(2):
+            break
+        time.sleep(0.5)
+    assert not cl.mc.osdmap.is_in(2)
+    cl.shutdown()
+    # the in-ratio floor now blocks further self-heals (2/3 in; another
+    # mark-out would leave 1/3 < 0.5): rot a second OSD and verify the
+    # guard holds instead of healing the cluster into an outage
+    victim2 = dd_cluster.osds[1]
+    for _ in range(4):
+        victim2.logger.inc("scrub_errors")
+    deadline = time.time() + 8
+    while time.time() < deadline:
+        if "osd.1" in mod.status()["warnings"]:
+            break
+        time.sleep(0.5)
+    time.sleep(2)  # give self-heal passes a chance to (wrongly) fire
+    assert 1 not in mod.status()["marked_out"], "ratio floor ignored"
