@@ -1,0 +1,178 @@
+"""Exact division-by-invariant-integer magic for the fused straw2 kernel.
+
+The straw2 draw is ``div64_s64(crush_ln(u) - 2**48, weight)`` (reference:
+src/crush/mapper.c :: bucket_straw2_choose).  On TPU there is no 64-bit
+integer divide — XLA lowers s64 division to a long software sequence and
+forces the whole mapper under an x64 scope.  But CRUSH weights are *map
+constants*, not data: every (bucket, slot) divisor is known on the host
+when the map compiles.  So we precompute, per divisor ``w``, a magic
+multiplier ``(M, k, a)`` with
+
+    floor(p / w) == ((p + a) * M) >> k      for all 0 <= p <= P_MAX
+
+(Granlund & Montgomery's classic technique; Hacker's Delight 10-9/10-10:
+the round-up magic ``a=0`` or the round-down-with-increment ``a=1``
+variant always exists at modest k).  The kernel then needs only 16-bit
+limb multiplies and shifts — all exact in int32 lanes.
+
+``p`` here is the *negated* draw numerator: ln = crush_ln(u) - 2**48 is
+in [-2**48, 0], so p = -ln = 2**48 - crush_ln(u) is in [0, 2**48] and
+draw = -floor(p / w).  Arg-MAX over draws (first max wins, mapper.c's
+strict ``>`` scan) becomes arg-MIN over quotients (first min wins).
+
+Everything in this module is host-side numpy/bignum; the traced twin
+lives in ops/pallas_crush.py (fused kernel) with a jnp reference in
+crush/batched.py.  Bit-exactness of the magic contract is proven per
+divisor at build time by the analytic bound (not sampling), and
+tests/test_magic_div.py re-checks against bignum division on random and
+adversarial p.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# p = 2**48 - crush_ln(u) <= 2**48 inclusive
+P_MAX = 1 << 48
+
+# Magic multipliers fit 4 x 16-bit limbs for every divisor (M ~ 2**49..
+# 2**51 regardless of w — see magic_for_divisor's postcondition check)
+M_LIMBS = 4
+# (p + a) fits 4 x 16-bit limbs (p <= 2**48, so limb 3 is 0 or 1)
+P_LIMBS = 4
+# full product fits 7 limbs (2**48 * 2**51 < 2**112)
+PROD_LIMBS = 7
+
+
+def magic_for_divisor(w: int) -> tuple[int, int, int]:
+    """(M, k, a) with ((p + a) * M) >> k == p // w for all 0 <= p <= P_MAX.
+
+    Proof obligations (checked, not assumed):
+    - round-up (a=0): M = 2**k // w + 1, e = M*w - 2**k in (0, w];
+      exact iff P_MAX * e < 2**k  (then the quotient error term
+      p*e/2**k < 1 can never carry the floor past the true quotient).
+    - round-down + increment (a=1): M = 2**k // w, e = 2**k - M*w in
+      [0, w); exact iff (P_MAX + 1) * e <= 2**k.
+    """
+    if w <= 0:
+        raise ValueError(f"divisor must be positive, got {w}")
+    if w & (w - 1) == 0:
+        # power of two: p // w == p >> lg(w), expressed at k=48 so the
+        # kernel's fixed shift window applies
+        return 1 << (48 - (w.bit_length() - 1)), 48, 0
+    k = max(w.bit_length(), 1)
+    while True:
+        m_up = (1 << k) // w + 1
+        e_up = m_up * w - (1 << k)
+        if P_MAX * e_up < (1 << k):
+            M, a = m_up, 0
+            break
+        m_dn = (1 << k) // w
+        e_dn = (1 << k) - m_dn * w
+        # e_dn == 0 would make this floor((p+1)/w) — only e_dn >= 1 keeps
+        # the error term strictly inside the (r, r+1] bracket
+        if m_dn > 0 and e_dn > 0 and (P_MAX + 1) * e_dn <= (1 << k):
+            M, a = m_dn, 1
+            break
+        k += 1
+    # postconditions the kernel layout depends on
+    if M.bit_length() > 16 * M_LIMBS:
+        raise AssertionError(f"magic for w={w} needs {M.bit_length()} bits")
+    if not (48 <= k <= 16 * (PROD_LIMBS - 1)):
+        # k < 48 can only happen for pathological tiny w bounds; clamp up
+        # by scaling M so the kernel's shift window (limbs 3..5 + 0..15
+        # bit shift) always applies
+        shift_up = 48 - k
+        M <<= shift_up
+        k = 48
+        if M.bit_length() > 16 * M_LIMBS:
+            raise AssertionError(f"normalized magic for w={w} overflows")
+    return M, k, a
+
+
+def apply_magic(p, M: int, k: int, a: int):
+    """Bignum/numpy-object golden: ((p + a) * M) >> k."""
+    p = np.asarray(p, dtype=object)
+    return (p + a) * M >> k
+
+
+def magic_tables(weights: np.ndarray):
+    """Vectorized build for a [..., S] int64 weight array.
+
+    Returns dict of int32 arrays, all shaped like ``weights`` plus a limb
+    axis where noted:
+      m_limbs  [..., S, M_LIMBS]  16-bit limbs of M
+      k        [..., S]           shift
+      a        [..., S]           increment flag
+    Zero/negative weights get an all-zero magic (their slots are masked
+    invalid by the caller before the argmin).
+    """
+    w = np.asarray(weights, dtype=np.int64)
+    flat = w.reshape(-1)
+    m_limbs = np.zeros((flat.size, M_LIMBS), np.int32)
+    ks = np.full(flat.size, 48, np.int32)
+    aa = np.zeros(flat.size, np.int32)
+    cache: dict[int, tuple[int, int, int]] = {}
+    for i, wi in enumerate(flat.tolist()):
+        if wi <= 0:
+            continue
+        got = cache.get(wi)
+        if got is None:
+            got = cache[wi] = magic_for_divisor(wi)
+        M, k, a = got
+        for j in range(M_LIMBS):
+            m_limbs[i, j] = (M >> (16 * j)) & 0xFFFF
+        ks[i] = k
+        aa[i] = a
+    shape = w.shape
+    return {
+        "m_limbs": m_limbs.reshape(shape + (M_LIMBS,)),
+        "k": ks.reshape(shape),
+        "a": aa.reshape(shape),
+    }
+
+
+def straw2_draw_q_np(p: np.ndarray, m_limbs, k, a) -> np.ndarray:
+    """Numpy-int64-free golden of the limb pipeline the kernel runs:
+    split p into 16-bit limbs, multiply by the magic limbs with base-2**16
+    carry propagation, variable-shift the 7-limb product by k, recombine
+    the 48-bit quotient as (hi24 << 24) | lo24 in python ints.
+
+    This mirrors the kernel's arithmetic exactly (same limb widths, same
+    carry points) so a bug in the layout fails HERE, on the host, first.
+    """
+    p = np.asarray(p, dtype=object)
+    m_limbs = np.asarray(m_limbs, dtype=object)
+    k = np.asarray(k, dtype=object)
+    a = np.asarray(a, dtype=object)
+    pa = p + a
+    pl = [(pa >> (16 * j)) & 0xFFFF for j in range(P_LIMBS)]
+    # column accumulation: col[c] = sum_{i+j==c} pl[i]*ml[j]
+    cols = [np.zeros_like(p) for _ in range(PROD_LIMBS + 1)]
+    for i in range(P_LIMBS):
+        for j in range(M_LIMBS):
+            cols[i + j] = cols[i + j] + pl[i] * m_limbs[..., j]
+    # carry propagate to clean 16-bit limbs
+    limbs = []
+    carry = np.zeros_like(p)
+    for c in range(PROD_LIMBS + 1):
+        v = cols[c] + carry
+        limbs.append(v & 0xFFFF)
+        carry = v >> 16
+    # variable shift: quotient = product >> k, k in [48, 96]
+    total = np.zeros_like(p)
+    for c, l in enumerate(limbs):
+        total = total + (l << (16 * c))
+    q = total >> k
+    return q
+
+
+__all__ = [
+    "P_MAX",
+    "M_LIMBS",
+    "P_LIMBS",
+    "PROD_LIMBS",
+    "magic_for_divisor",
+    "apply_magic",
+    "magic_tables",
+    "straw2_draw_q_np",
+]

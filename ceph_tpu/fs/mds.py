@@ -332,8 +332,30 @@ class MDSDaemon(Dispatcher):
             # src removal marked BEFORE the dst set so a same-path rename
             # nets out to the set, not the removal
             self._mark(sdir, sname, None)
+            if entry is None:
+                # replay against partially-flushed dirfrags: the source
+                # dentry was already flushed away (crash inside _flush
+                # between the src and dst omap writes).  The event carries
+                # the full moved entry so the rename still applies —
+                # without this the moved dentry and any replaced-primary
+                # promotion would be lost, then the post-replay flush
+                # would trim the journal and make the loss permanent
+                entry = ev.get("moved_entry")
             if entry is not None:
                 replaced = self.dirs.setdefault(ddir, {}).get(dname)
+                # replay idempotency: when the dst dirfrag was already
+                # flushed with the moved entry before the crash, the
+                # "replaced" dentry IS the moved entry — tearing it down
+                # would destroy the moved directory's children (the
+                # post-replay flush would then delete the dirfrag object
+                # permanently) or double-apply a stub clobber.  Identity
+                # compares the linkage target, covering both primary
+                # dentries and remote stubs.
+                def _ident(d):
+                    return d.get("remote", d.get("ino"))
+
+                if replaced is not None and _ident(replaced) == _ident(entry):
+                    replaced = None
                 if replaced is not None and "remote" in replaced:
                     # clobbering a hardlink stub: its primary lives on
                     # with the journaled ABSOLUTE nlink
@@ -548,7 +570,10 @@ class MDSDaemon(Dispatcher):
                         break
                     cur = bp[0]
             ev = {"e": "rename", "srcdir": sdir, "sname": sname,
-                  "dstdir": a["dstdir"], "dname": a["dname"]}
+                  "dstdir": a["dstdir"], "dname": a["dname"],
+                  # full moved entry (primary inode or remote stub) so
+                  # replay is self-contained against flushed-away sources
+                  "moved_entry": dict(entry)}
             replaced_nlink_after = None
             if existing is not None:
                 replaced_nlink_after = existing.get("nlink", 1) - 1

@@ -17,6 +17,7 @@ commands and carry no extra semantics).
 """
 from __future__ import annotations
 
+import html
 import http.server
 import json
 import threading
@@ -25,8 +26,7 @@ from .module import MgrModule, register_module
 
 
 def _esc(s) -> str:
-    return (str(s).replace("&", "&amp;").replace("<", "&lt;")
-            .replace(">", "&gt;"))
+    return html.escape(str(s))
 
 
 @register_module

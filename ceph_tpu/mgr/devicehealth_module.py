@@ -84,6 +84,22 @@ class DeviceHealthModule(MgrModule):
         m = self.get("osd_map")
         if m is None:
             return
+        # marked_out only exists to bridge map-propagation delay: once the
+        # map confirms an OSD is out, drop the entry — keeping it would
+        # permanently undercount n_in and permanently exempt the OSD from
+        # self-heal after an operator replaces the device and marks it
+        # back in
+        self.marked_out = {o for o in self.marked_out if m.is_in(o)}
+        # the in-count is tracked LOCALLY across this pass (and debited
+        # for mark-outs we already issued whose map hasn't propagated):
+        # checking each candidate against the same stale map would let a
+        # storm that pushes several OSDs over the threshold at once mark
+        # them all out and sail through the floor one stale read at a time
+        existing = [o for o in range(m.max_osd) if m.exists(o)]
+        n_in = sum(
+            1 for o in existing
+            if m.is_in(o) and o not in self.marked_out
+        )
         for daemon, h in self.history.items():
             if not h or h[-1][1] < threshold:
                 continue
@@ -94,8 +110,6 @@ class DeviceHealthModule(MgrModule):
             # the in-ratio would drop below the floor (reference:
             # devicehealth's mon_osd_min_in_ratio guard — a cluster-wide
             # error storm must not mark everything out)
-            existing = [o for o in range(m.max_osd) if m.exists(o)]
-            n_in = sum(1 for o in existing if m.is_in(o))
             if existing and (n_in - 1) / len(existing) < min_ratio:
                 self.cct.dout(
                     "mgr", 0,
@@ -106,6 +120,7 @@ class DeviceHealthModule(MgrModule):
             rv, res = self.mon_command({"prefix": "osd out", "id": osd})
             if rv == 0:
                 self.marked_out.add(osd)
+                n_in -= 1
                 self.cct.dout(
                     "mgr", 0,
                     f"devicehealth: marked osd.{osd} OUT "

@@ -20,6 +20,10 @@ class Elector:
         self.epoch = 1
         self._acks: set[int] = set()
         self._electing = False
+        # True while we are deferring to a lower-ranked proposer: our own
+        # proposal is dead, so acks must not accumulate and a timeout must
+        # RE-PROPOSE, never declare victory
+        self._deferred = False
         self._timer: threading.Timer | None = None
         self._lock = threading.RLock()
 
@@ -41,6 +45,7 @@ class Elector:
             else:
                 self.epoch += 2
             self._electing = True
+            self._deferred = False
             self._acks = {self.mon.rank}
             self.mon.set_electing()
             for r in self.mon.other_ranks():
@@ -61,11 +66,12 @@ class Elector:
         with self._lock:
             if not self._electing:
                 return
-            if len(self._acks) >= self.mon.majority():
+            if not self._deferred and len(self._acks) >= self.mon.majority():
                 self._declare_victory_locked()
             else:
-                # couldn't form a quorum (or we were deferring to a
-                # proposer that went silent); try again
+                # couldn't form a quorum, or we were deferring to a
+                # proposer that went silent: a deferred mon's proposal is
+                # dead, so it RE-PROPOSES — it never declares victory
                 self._electing = False
                 self.start_election()
 
@@ -108,8 +114,15 @@ class Elector:
                 # a timer armed so a proposer that dies mid-election leaves
                 # us retrying, not stranded — but MUCH longer than the
                 # proposer's victory timer, else our re-propose races its
-                # victory and elections livelock (epoch churn forever)
+                # victory and elections livelock (epoch churn forever).
+                # Forget any acks from our own abandoned proposal: a defer
+                # timeout must RE-PROPOSE, never declare victory on a dead
+                # election's ack set (a deferring mon that still held a
+                # majority of stale acks would steal leadership from the
+                # lower rank whenever the victory message was slow)
                 self._electing = True
+                self._deferred = True
+                self._acks = {self.mon.rank}
                 self.mon.set_electing()
                 self._arm_timer(factor=5.0)
                 self.mon.send_mon(
@@ -126,7 +139,10 @@ class Elector:
 
     def _handle_ack(self, msg: MMonElection) -> None:
         with self._lock:
-            if not self._electing or msg.epoch != self.epoch:
+            # acks addressed to a proposal we abandoned by deferring must
+            # not accumulate — _maybe_win_locked would declare victory on
+            # a dead election once every rank's late ack trickled in
+            if not self._electing or self._deferred or msg.epoch != self.epoch:
                 return
             self._acks.add(msg.rank)
             self._maybe_win_locked()
@@ -137,6 +153,7 @@ class Elector:
                 return
             self.epoch = msg.epoch
             self._electing = False
+            self._deferred = False
             if self._timer is not None:
                 self._timer.cancel()
                 self._timer = None

@@ -1,0 +1,114 @@
+"""One-off silicon profile of the batched CRUSH mapper's pieces.
+
+Usage: python perf_runs/profile_crush.py <piece>
+Pieces: score32 score64 score128 score256 choose full gather_na
+Each run is a separate process so a Mosaic failure can't poison the rest.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def timeit(fn, n=5):
+    fn()  # warm/compile
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn()
+    np.asarray(r)  # sync
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    piece = sys.argv[1]
+    import jax
+    import jax.numpy as jnp
+
+    print("backend:", jax.default_backend(), flush=True)
+    B, S = 1 << 18, 128
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.integers(0, 1 << 31, B, dtype=np.int64).astype(np.int32))
+    r = jnp.asarray(np.zeros(B, np.int32))
+    items = jnp.asarray(
+        np.broadcast_to(np.arange(S, dtype=np.int32), (B, S)).copy()
+    )
+
+    if piece.startswith("score"):
+        tile = int(piece[5:])
+        from ceph_tpu.ops.pallas_crush import straw2_scores_pallas
+
+        def f():
+            hi, lo = straw2_scores_pallas(x, r, items, tile=tile)
+            return lo
+
+        dt = timeit(f)
+        print(f"score launch tile={tile}: {dt*1e3:.2f} ms "
+              f"({B/dt/1e6:.1f} M lane-draws/s over S={S})", flush=True)
+
+    elif piece == "choose":
+        from ceph_tpu.crush import CompiledCrushMap, build_hierarchical_map
+        from ceph_tpu.crush.batched import straw2_choose_b, ln_scores_pallas
+        from ceph_tpu.crush.mapper import enable_x64
+
+        cmap = build_hierarchical_map(128, 8)
+        cm = CompiledCrushMap(cmap)
+        with enable_x64():
+            bidx = jnp.zeros(B, jnp.int32)  # root bucket row
+
+            @jax.jit
+            def g(bidx, x, r):
+                return straw2_choose_b(
+                    cm, ln_scores_pallas, bidx, x, r, None,
+                    jnp.zeros(B, jnp.int32),
+                )
+
+            xx = x
+            dt = timeit(lambda: g(bidx, xx, r))
+        print(f"straw2_choose_b (score+div+argmax): {dt*1e3:.2f} ms", flush=True)
+
+    elif piece == "div":
+        # isolate the int64 draw division + argmax at [B, S]
+        from ceph_tpu.crush.mapper import enable_x64
+        with enable_x64():
+            ln = jnp.asarray(
+                rng.integers(-(1 << 48), 0, (B, S)), jnp.int64
+            )
+            w = jnp.asarray(
+                rng.integers(1, 1 << 20, (B, S)), jnp.int64
+            )
+
+            @jax.jit
+            def g(ln, w):
+                q = jnp.abs(ln) // jnp.abs(w)
+                d = jnp.where((ln < 0) != (w < 0), -q, q)
+                return jnp.argmax(d, axis=1)
+
+            dt = timeit(lambda: g(ln, w))
+        print(f"i64 div+argmax [B,S]: {dt*1e3:.2f} ms", flush=True)
+
+    elif piece == "full":
+        from ceph_tpu.crush import (
+            CompiledCrushMap, build_hierarchical_map, crush_do_rule_batch,
+        )
+
+        cmap = build_hierarchical_map(128, 8)
+        cm = CompiledCrushMap(cmap)
+        weights = np.full(1024, 0x10000, dtype=np.uint32)
+        xs = np.arange(B, dtype=np.int64)
+        np.asarray(crush_do_rule_batch(cm, 0, xs[:1024], 3, weights))
+        t0 = time.perf_counter()
+        out = np.asarray(crush_do_rule_batch(cm, 0, xs, 3, weights))
+        dt = time.perf_counter() - t0
+        print(f"full rule chunk B={B}: {dt*1e3:.1f} ms "
+              f"({B/dt:.0f} maps/s)", flush=True)
+
+    else:
+        print("unknown piece", piece)
+        sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

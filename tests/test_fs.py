@@ -244,6 +244,41 @@ def test_legacy_dirfrag_blob_migrates_on_load():
         f2.unmount()
 
 
+def test_rename_replay_idempotent_against_flushed_state():
+    """Replaying a journaled directory rename against dirfrags that were
+    ALREADY flushed with the post-rename state must be a no-op: the dst
+    dentry replay sees is the moved entry itself, and tearing it down as
+    a 'replaced' entry would drop the moved directory's children and let
+    the post-replay flush delete the dirfrag object permanently
+    (regression: review r4 — crash between _flush's dirfrag writes and
+    the mds_head rewrite leaves the rename event un-trimmed)."""
+    with LocalCluster(n_mons=1, n_osds=3, with_mds=True) as c:
+        fs = c.fs_client("client.ri")
+        fs.mkdir("/d")
+        fs.write_file("/d/c", b"child payload")
+        fs.rename("/d", "/e")
+        mds = c.mds
+        # capture the journaled rename event before the flush trims it
+        evs = [
+            mds._obj_read(oid)
+            for oid in sorted(mds._io.list_objects())
+            if oid.startswith("journal.")
+        ]
+        rename_evs = [e for e in evs if e and e.get("e") == "rename"]
+        assert rename_evs, "rename event must be journaled"
+        with mds._lock:
+            mds._flush()            # dirfrags now hold post-rename state
+            mds._apply(rename_evs[-1])   # replay against flushed state
+            mds._flush()            # would delete dir.{D} if torn down
+        c.kill_mds()
+        c.restart_mds()
+        fs2 = c.fs_client("client.ri2")
+        assert list(fs2.listdir("/e")) == ["c"]
+        assert fs2.read_file("/e/c") == b"child payload"
+        fs2.unmount()
+        fs.unmount()
+
+
 class TestHardlinks:
     """Remote dentries + nlink + primary promotion (reference:
     src/mds/CDentry.h remote linkage; src/mds/Server handle_client_link)."""

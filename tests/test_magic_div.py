@@ -1,0 +1,79 @@
+"""Magic-divisor contract tests: ((p + a) * M) >> k == p // w for every
+p in [0, 2**48] — the draw division the fused straw2 kernel replaces
+(reference: src/crush/mapper.c :: bucket_straw2_choose's div64_s64)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.magic_div import (
+    P_MAX,
+    apply_magic,
+    magic_for_divisor,
+    magic_tables,
+    straw2_draw_q_np,
+)
+
+
+def _adversarial_ps(w: int) -> list[int]:
+    """p values where magic division classically breaks: around multiples
+    of w, powers of two, and the extremes."""
+    ps = [0, 1, 2, w - 1, w, w + 1, P_MAX - 1, P_MAX]
+    for bits in (16, 24, 32, 40, 47, 48):
+        ps += [(1 << bits) - 1, 1 << bits, (1 << bits) + 1]
+    for mult in (1, 2, 3, 1000, P_MAX // max(w, 1)):
+        ps += [mult * w - 1, mult * w, mult * w + 1]
+    return [p for p in ps if 0 <= p <= P_MAX]
+
+
+DIVISORS = [
+    1, 2, 3, 6, 7, 0xFFFF, 0x10000, 0x10001, 0x20000, 0x80000,
+    0x123456, 0xFFFFFF, 0x1000000, (1 << 31) - 1, 1 << 31, (1 << 32) - 1,
+]
+
+
+@pytest.mark.parametrize("w", DIVISORS)
+def test_magic_exact_adversarial(w):
+    M, k, a = magic_for_divisor(w)
+    for p in _adversarial_ps(w):
+        assert ((p + a) * M) >> k == p // w, (w, p, M, k, a)
+
+
+def test_magic_exact_random():
+    rng = np.random.default_rng(0xC0FFEE)
+    ws = list(rng.integers(1, 1 << 32, size=200)) + DIVISORS
+    ps = rng.integers(0, P_MAX, size=500, dtype=np.int64)
+    for w in ws:
+        w = int(w)
+        M, k, a = magic_for_divisor(w)
+        got = apply_magic(ps.astype(object), M, k, a)
+        want = ps.astype(object) // w
+        assert (got == want).all(), w
+
+
+def test_limb_pipeline_matches_bignum():
+    """straw2_draw_q_np (the kernel-shaped limb math) == plain bignum."""
+    rng = np.random.default_rng(7)
+    weights = rng.integers(1, 1 << 28, size=(5, 8)).astype(np.int64)
+    weights[0, 0] = 1
+    weights[0, 1] = 0x10000
+    weights[1, 0] = (1 << 32) - 1
+    tabs = magic_tables(weights)
+    ps = np.concatenate(
+        [rng.integers(0, P_MAX, size=(64,), dtype=np.int64),
+         np.array([0, 1, P_MAX - 1, P_MAX], dtype=np.int64)]
+    )
+    for i in range(weights.shape[0]):
+        for j in range(weights.shape[1]):
+            q = straw2_draw_q_np(
+                ps.astype(object),
+                tabs["m_limbs"][i, j].astype(object),
+                int(tabs["k"][i, j]),
+                int(tabs["a"][i, j]),
+            )
+            want = ps.astype(object) // int(weights[i, j])
+            assert (q == want).all(), (i, j, int(weights[i, j]))
+
+
+def test_zero_weight_slots_masked():
+    tabs = magic_tables(np.array([[0, 5]], dtype=np.int64))
+    assert (tabs["m_limbs"][0, 0] == 0).all()
+    assert tabs["k"][0, 0] == 48

@@ -211,12 +211,68 @@ class TestSingleMon:
         assert to in up2 and frm not in up2
 
 
+class TestElectorDefer:
+    """Unit-level elector regression: a mon that defers to a lower rank
+    must forget its own proposal's acks — a defer timeout re-proposes, it
+    never declares victory on the dead election's ack set."""
+
+    class _FakeMon:
+        def __init__(self, rank, n=3):
+            self.rank = rank
+            self.monmap = type("MM", (), {"ranks": lambda s: list(range(n))})()
+            self.sent = []  # (rank, msg)
+            self.won = None
+            self.lost = None
+
+        def majority(self):
+            return len(self.monmap.ranks()) // 2 + 1
+
+        def other_ranks(self):
+            return [r for r in self.monmap.ranks() if r != self.rank]
+
+        def set_electing(self):
+            pass
+
+        def send_mon(self, rank, msg):
+            self.sent.append((rank, msg))
+
+        def win_election(self, epoch, quorum):
+            self.won = (epoch, quorum)
+
+        def lose_election(self, epoch, leader, quorum):
+            self.lost = (epoch, leader, quorum)
+
+    def test_defer_timeout_reproposes_instead_of_stale_victory(self):
+        from ceph_tpu.mon.elector import Elector
+        from ceph_tpu.mon.messages import MMonElection
+
+        mon1 = self._FakeMon(rank=1)
+        el = Elector(mon1, timeout=60.0)  # timers never fire on their own
+        # mon1 boots first: proposes epoch 3, collects mon2's ack -> {1, 2}
+        el.start_election()
+        el.handle(None, MMonElection(op="ack", epoch=el.epoch, rank=2))
+        assert el._acks == {1, 2}
+        # mon0 comes up and proposes; mon1 defers
+        el.handle(None, MMonElection(op="propose", epoch=el.epoch, rank=0))
+        # mon0's victory is slow; mon1's defer timer fires.  With the
+        # stale {1, 2} ack set this used to declare victory at rank 1.
+        el._election_timeout()
+        assert mon1.won is None, "deferring mon stole the election"
+        # it re-proposed instead (propose messages to both peers)
+        assert any(
+            m.op == "propose" for _, m in mon1.sent[-2:]
+        )
+
+
 class TestQuorum:
     def test_lowest_rank_wins(self, cluster3):
         _, mons, client = cluster3
-        assert wait_for(lambda: mons[0].is_leader())
+        # 30s: under full-suite load boot elections can be slow (send
+        # queues behind connect timeouts); slow is not stuck, and the
+        # stale-ack defer fix guarantees rank 0 ends up leader
+        assert wait_for(lambda: mons[0].is_leader(), timeout=30.0)
         assert wait_for(
-            lambda: all(m.state == "peon" for m in mons[1:])
+            lambda: all(m.state == "peon" for m in mons[1:]), timeout=30.0
         )
         rv, stat = client.command({"prefix": "mon stat"})
         assert rv == 0
