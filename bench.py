@@ -49,7 +49,9 @@ PHASE_TIMEOUTS = {
     "shec": 420,
     "clay": 420,
 }
-TPU_PHASES = ("rs84", "rs21", "crush", "shec", "clay")
+# crush LAST: the 1M-PG batch launch is the one phase that has wedged
+# the tunnel (r2, r4) — a wedge there must not cost the shec/clay columns
+TPU_PHASES = ("rs84", "rs21", "shec", "clay", "crush")
 
 
 # ---------------------------------------------------------------- measurement

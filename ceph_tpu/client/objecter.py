@@ -165,6 +165,28 @@ class Objecter(Dispatcher):
                 pass  # next map change retries
 
     # -- targeting ---------------------------------------------------------
+    # mutations route to write_tier, everything else to read_tier
+    # (reference: Objecter::_calc_target's CEPH_OSD_FLAG_WRITE split)
+    _WRITE_OPS = frozenset(
+        {"write_full", "write", "append", "delete", "setxattr",
+         "omap_set", "omap_rm", "omap_clear", "exec", "watch", "unwatch",
+         "notify"}
+    )
+
+    def _resolve_overlay(self, m, pool_id: int, op: str,
+                         ignore_overlay: bool) -> int:
+        """Cache-tier overlay redirect (reference: Objecter::_calc_target
+        honoring pg_pool_t::read_tier/write_tier unless the op carries
+        CEPH_OSD_FLAG_IGNORE_OVERLAY).  Pool listings stay on the pool
+        the caller named — `rados ls` on the base enumerates the base."""
+        pool = m.pools.get(pool_id)
+        if pool is None or ignore_overlay or op in ("list", "scrub"):
+            return pool_id
+        tier = pool.write_tier if op in self._WRITE_OPS else pool.read_tier
+        if tier >= 0 and tier in m.pools:
+            return tier
+        return pool_id
+
     def _calc_target(
         self, pool_id: int, oid: str, op: str = ""
     ) -> tuple[int, tuple]:
@@ -200,6 +222,7 @@ class Objecter(Dispatcher):
         timeout: float = 30.0,
         attempts: int = 8,
         snapid: int | None = None,
+        ignore_overlay: bool = False,
     ):
         """Submit; blocks for the reply, retrying across map changes."""
         import time as _time
@@ -229,19 +252,25 @@ class Objecter(Dispatcher):
         hard = 0
         while hard < attempts:
             m = self.mc.osdmap
+            # overlay redirect re-resolves every attempt: a mid-op
+            # set-overlay / remove-overlay retargets the resend
+            target_pool = (
+                self._resolve_overlay(m, pool_id, op, ignore_overlay)
+                if m is not None else pool_id
+            )
             # snap context rides every mutation (reference: MOSDOp's
             # SnapContext) so a primary whose map lags a fresh mksnap
             # still clones before overwriting
             snap_seq = 0
             if m is not None and op in ("write_full", "write", "append",
                                         "delete"):
-                p = m.pools.get(pool_id)
+                p = m.pools.get(target_pool)
                 # newest LIVE snap, not snap_seq: after the last rmsnap
                 # there is nothing left to preserve, and a stale high seq
                 # would make primaries mint un-trimmable clones forever
                 snap_seq = max(p.snaps, default=0) if p is not None else 0
             try:
-                _osd, addr = self._calc_target(pool_id, oid, op)
+                _osd, addr = self._calc_target(target_pool, oid, op)
             except (ConnectionError, KeyError) as e:
                 last = str(e)
                 hard += 1
@@ -262,7 +291,7 @@ class Objecter(Dispatcher):
                 )
                 conn.send_message(
                     MOSDOp(
-                        tid=tid, pool=pool_id, oid=oid, op=op,
+                        tid=tid, pool=target_pool, oid=oid, op=op,
                         data=wire_data,
                         epoch=m.epoch if m else 0, off=off, length=length,
                         snapid=snapid, snap_seq=snap_seq, reqid=reqid,

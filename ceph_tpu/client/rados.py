@@ -65,6 +65,24 @@ class IoCtx:
             raise IOError(f"read {oid!r}: {rep.retval} {rep.result}")
         return unpack_data(rep.data) or b""
 
+    # -- cache tiering (reference: rados cache-flush / cache-evict ops;
+    # this IoCtx must be open on the CACHE pool) --------------------------
+    def cache_flush(self, oid: str) -> object:
+        rep = self._client.objecter.op_submit(
+            self.pool_id, oid, "cache_flush", ignore_overlay=True
+        )
+        if rep.retval != 0:
+            raise IOError(f"cache_flush {oid!r}: {rep.retval} {rep.result}")
+        return rep.result
+
+    def cache_evict(self, oid: str) -> object:
+        rep = self._client.objecter.op_submit(
+            self.pool_id, oid, "cache_evict", ignore_overlay=True
+        )
+        if rep.retval != 0:
+            raise IOError(f"cache_evict {oid!r}: {rep.retval} {rep.result}")
+        return rep.result
+
     # -- omap (reference: rados_omap_* — replicated pools only) -----------
     def exec(self, oid: str, cls: str, method: str,
              inp: dict | None = None) -> tuple[int, object]:

@@ -213,6 +213,8 @@ class OSDMonitor:
             return self._cmd_pool_snap(prefix.endswith("mksnap"), cmd)
         if prefix == "osd pg-upmap-items":
             return self._cmd_upmap_items(cmd)
+        if prefix.startswith("osd tier "):
+            return self._cmd_tier(prefix[len("osd tier "):], cmd)
         if prefix == "osd tree":
             return 0, self._cmd_tree()
         if prefix == "auth get-ticket":
@@ -345,6 +347,12 @@ class OSDMonitor:
             # keep the derived write quorum consistent (the same rule
             # PGPool.__post_init__ applies at creation)
             pool.min_size = value // 2 + 1
+        elif key == "target_max_objects":
+            # cache-tier agent threshold (reference: pg_pool_t::
+            # target_max_objects driving agent_choose_mode)
+            if value < 0:
+                return -22, "target_max_objects must be >= 0"
+            pool.target_max_objects = value
         else:
             return -22, f"unknown pool key {key!r}"
         return (0, f"set pool {name} {key} to {value}") \
@@ -375,6 +383,81 @@ class OSDMonitor:
                 return -2, f"no snap {snapname!r}"
             del pool.snaps[sid]
             result = {"removed": sid}
+        return (0, result) if self._propose_map(m) else \
+            (-110, "proposal timed out")
+
+    def _cmd_tier(self, sub: str, cmd: dict) -> tuple[int, object]:
+        """`osd tier add/remove/cache-mode/set-overlay/remove-overlay`
+        (reference: OSDMonitor::prepare_command's "osd tier *" family
+        mutating pg_pool_t tier fields).  `pool` names the BASE pool and
+        `tierpool` the cache for add/remove/set-overlay; cache-mode takes
+        the cache pool in `pool`."""
+        m = self._pending()
+
+        def by_name(n):
+            return next((p for p in m.pools.values() if p.name == n), None)
+
+        pool = by_name(cmd.get("pool", ""))
+        if pool is None:
+            return -2, f"no pool {cmd.get('pool')!r}"
+        if sub in ("add", "remove", "set-overlay"):
+            tierpool = by_name(cmd.get("tierpool", ""))
+            if tierpool is None:
+                return -2, f"no tier pool {cmd.get('tierpool')!r}"
+        if sub == "add":
+            if tierpool.pool_id == pool.pool_id:
+                return -22, "pool cannot tier itself"
+            if tierpool.tier_of >= 0 and tierpool.tier_of != pool.pool_id:
+                return -16, f"pool {tierpool.name!r} is already a tier"
+            if tierpool.tiers or pool.tier_of >= 0:
+                return -22, "multi-level tiering not supported"
+            if tierpool.type == PG_POOL_ERASURE:
+                # the cache must serve arbitrary overwrites cheaply
+                return -95, "an erasure-coded pool cannot be a cache tier"
+            tierpool.tier_of = pool.pool_id
+            if tierpool.pool_id not in pool.tiers:
+                pool.tiers.append(tierpool.pool_id)
+            result = f"pool {tierpool.name!r} is now a tier of {pool.name!r}"
+        elif sub == "remove":
+            if tierpool.tier_of != pool.pool_id:
+                return -2, f"pool {tierpool.name!r} is not a tier of {pool.name!r}"
+            if pool.read_tier == tierpool.pool_id or \
+                    pool.write_tier == tierpool.pool_id:
+                return -16, "remove the overlay first"
+            tierpool.tier_of = -1
+            tierpool.cache_mode = "none"
+            pool.tiers = [t for t in pool.tiers if t != tierpool.pool_id]
+            result = f"pool {tierpool.name!r} removed as tier of {pool.name!r}"
+        elif sub == "cache-mode":
+            mode = cmd.get("mode", "")
+            if mode not in ("none", "writeback", "readproxy"):
+                return -22, f"unknown cache mode {mode!r}"
+            if pool.tier_of < 0:
+                return -22, f"pool {pool.name!r} is not a tier"
+            if mode == "none":
+                # with the overlay still routing base I/O here, mode none
+                # would bypass promotion and make every non-cached base
+                # object unreadable (the reference refuses this too)
+                basep = m.pools.get(pool.tier_of)
+                if basep is not None and pool.pool_id in (
+                    basep.read_tier, basep.write_tier
+                ):
+                    return -16, (
+                        f"pool {pool.name!r} is the overlay for "
+                        f"{basep.name!r}; remove-overlay first"
+                    )
+            pool.cache_mode = mode
+            result = f"set cache-mode of {pool.name!r} to {mode}"
+        elif sub == "set-overlay":
+            if tierpool.tier_of != pool.pool_id:
+                return -22, f"pool {tierpool.name!r} is not a tier of {pool.name!r}"
+            pool.read_tier = pool.write_tier = tierpool.pool_id
+            result = f"overlay for {pool.name!r} is now {tierpool.name!r}"
+        elif sub == "remove-overlay":
+            pool.read_tier = pool.write_tier = -1
+            result = f"overlay for {pool.name!r} removed"
+        else:
+            return -22, f"unknown tier command {sub!r}"
         return (0, result) if self._propose_map(m) else \
             (-110, "proposal timed out")
 

@@ -92,6 +92,19 @@ class PGPool:
     name: str = ""
     snap_seq: int = 0
     snaps: dict = field(default_factory=dict)  # snapid -> name
+    # cache tiering (reference: pg_pool_t::tier_of / read_tier /
+    # write_tier / cache_mode / tiers).  A CACHE pool has tier_of >= 0
+    # pointing at its base; the BASE pool lists its tiers and, once an
+    # overlay is set, carries read_tier/write_tier so the Objecter
+    # redirects client I/O to the cache (Objecter::_calc_target).
+    tier_of: int = -1
+    tiers: list = field(default_factory=list)
+    read_tier: int = -1
+    write_tier: int = -1
+    cache_mode: str = "none"  # none | writeback | readproxy
+    # agent thresholds (reference: pg_pool_t::target_max_objects and the
+    # TierAgentState full/evict effort derived from it)
+    target_max_objects: int = 0
 
     def __post_init__(self):
         if not self.pgp_num:

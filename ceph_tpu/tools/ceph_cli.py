@@ -78,6 +78,22 @@ def _build_command(words: list[str]) -> dict:
         return {"prefix": f"osd {words[1]}", "key": words[2]}
     if words[:2] == ["osd", "erasure-code-profile"] and words[2] == "get":
         return {"prefix": "osd erasure-code-profile get", "name": words[3]}
+    if words[:2] == ["osd", "tier"]:
+        # osd tier add <base> <cache> | remove <base> <cache> |
+        # cache-mode <cache> <mode> | set-overlay <base> <cache> |
+        # remove-overlay <base>
+        sub = words[2] if len(words) > 2 else ""
+        want = 5 if sub in ("add", "remove", "set-overlay",
+                            "cache-mode") else 4
+        if sub not in ("add", "remove", "set-overlay", "cache-mode",
+                       "remove-overlay") or len(words) < want:
+            raise ValueError(f"bad tier command: {joined!r}")
+        cmd = {"prefix": f"osd tier {sub}", "pool": words[3]}
+        if sub in ("add", "remove", "set-overlay"):
+            cmd["tierpool"] = words[4]
+        elif sub == "cache-mode":
+            cmd["mode"] = words[4]
+        return cmd
     raise ValueError(f"unknown command: {joined!r}")
 
 

@@ -1,0 +1,114 @@
+"""Probe: flat-stream one-hot ln lookup — elements as a 1D stream in
+[R, 1] blocks, one-hot [R, 256] 2D (vreg-natural: idx along sublanes,
+table axis along lanes) vs the production kernel's 3D [32,128,256].
+
+Also probes the FULL fused pipeline in flat layout: hash + ln, to see
+end-to-end draws/s at various R.
+"""
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, "/root/repo")
+from ceph_tpu.crush.hash import crush_hash32_3
+from ceph_tpu.crush.ln_compute import (
+    TBL1_BYTES, TBL2_BYTES, crush_ln_limbs, recombine_limbs,
+)
+from ceph_tpu.crush.ln_table import CRUSH_LN_TABLE
+
+B, S = 1 << 18, 128
+N = B * S  # 33.5M elements
+rng = np.random.default_rng(3)
+u_np = rng.integers(0, 1 << 16, N, dtype=np.int32)
+u = jnp.asarray(u_np)
+
+Rs = [int(a) for a in sys.argv[1:]] or [2048, 8192]
+
+t1 = jnp.asarray(TBL1_BYTES, jnp.bfloat16)
+t2 = jnp.asarray(TBL2_BYTES, jnp.bfloat16)
+
+
+def _onehot_flat(idx, tbl_bf16):
+    # idx [R] -> one-hot [R, K] -> [R, ncols] f32
+    K = tbl_bf16.shape[0]
+    oh = (
+        idx[:, None] == jax.lax.broadcasted_iota(jnp.int32, (1, K), 1)
+    ).astype(jnp.bfloat16)
+    return jax.lax.dot_general(
+        oh, tbl_bf16, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def ln_kernel(u_ref, t1_ref, t2_ref, hi_ref, lo_ref):
+    t1 = t1_ref[:]
+    t2 = t2_ref[:]
+    uu = u_ref[:, 0]
+
+    def look1(i):
+        rows = _onehot_flat(i, t1)
+        return (
+            recombine_limbs(rows, 0, 3, jnp),
+            recombine_limbs(rows, 3, 2, jnp),
+            recombine_limbs(rows, 5, 2, jnp),
+            recombine_limbs(rows, 7, 4, jnp),
+            recombine_limbs(rows, 11, 3, jnp),
+        )
+
+    def look2(i):
+        rows = _onehot_flat(i, t2)
+        return (
+            recombine_limbs(rows, 0, 4, jnp),
+            recombine_limbs(rows, 4, 3, jnp),
+        )
+
+    hi, lo = crush_ln_limbs(uu, jnp, look1, look2)
+    hi_ref[:, 0] = hi
+    lo_ref[:, 0] = lo
+
+
+want_ln = CRUSH_LN_TABLE[u_np]
+
+for R in Rs:
+    try:
+        f = pl.pallas_call(
+            ln_kernel,
+            grid=(N // R,),
+            in_specs=[
+                pl.BlockSpec((R, 1), lambda i: (i, 0)),
+                pl.BlockSpec(TBL1_BYTES.shape, lambda i: (0, 0)),
+                pl.BlockSpec(TBL2_BYTES.shape, lambda i: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((R, 1), lambda i: (i, 0)),
+                pl.BlockSpec((R, 1), lambda i: (i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((N, 1), jnp.int32),
+                jax.ShapeDtypeStruct((N, 1), jnp.int32),
+            ],
+        )
+        u2 = u.reshape(N, 1)
+        hi, lo = f(u2, t1, t2)
+        jax.block_until_ready((hi, lo))
+        got = (np.asarray(hi)[:, 0].astype(np.int64) << 24) | np.asarray(lo)[
+            :, 0
+        ].astype(np.int64)
+        ok = bool((got == want_ln).all())
+        ts = []
+        for _ in range(6):
+            t0 = time.perf_counter()
+            o = f(u2, t1, t2)
+            jax.block_until_ready(o)
+            ts.append(time.perf_counter() - t0)
+        best = min(ts[1:])
+        print(f"flat R={R:6d} exact={ok} best={best*1e3:.2f}ms "
+              f"lookups/s={N/best/1e9:.2f}G", flush=True)
+    except Exception as e:
+        head = str(e).split("\n")[0][:250]
+        print(f"flat R={R:6d} FAIL {type(e).__name__}: {head}", flush=True)

@@ -1,0 +1,105 @@
+"""Capability probe: which in-kernel lookup formulations does this
+Mosaic/libtpu stack legalize, and how fast are they?
+
+Variants:
+  take    — jnp.take(table_1d, idx) inside the kernel (dynamic gather)
+  takeax  — jnp.take_along_axis on a 2D broadcast table
+  onehot  — current bf16 one-hot matmul against a [256,16] table
+  onehot8 — int8 one-hot, s8xs8->s32 matmul
+"""
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+variants = sys.argv[1:] or ["take", "takeax", "onehot", "onehot8"]
+
+B, S = 1 << 18, 128
+TILE = 32
+rng = np.random.default_rng(2)
+idx_np = rng.integers(0, 1 << 16, (B, S), dtype=np.int32)
+idx = jnp.asarray(idx_np)
+tbl16_np = rng.integers(-(1 << 31), 1 << 31, (1 << 16,), dtype=np.int32)
+tbl16 = jnp.asarray(tbl16_np)
+tbl256_np = rng.integers(0, 256, (256, 16), dtype=np.int32)
+
+
+def run(name, kernel, inputs, out_shape, want=None):
+    try:
+        f = pl.pallas_call(
+            kernel,
+            grid=(B // TILE,),
+            in_specs=[
+                pl.BlockSpec((TILE, S), lambda i: (i, 0)),
+            ] + [pl.BlockSpec(t.shape, lambda i: tuple([0] * t.ndim))
+                 for t in inputs[1:]],
+            out_specs=pl.BlockSpec((TILE, S), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((B, S), out_shape),
+        )
+        o = f(*inputs)
+        jax.block_until_ready(o)
+        if want is not None:
+            ok = bool((np.asarray(o) == want).all())
+        else:
+            ok = "?"
+        ts = []
+        for _ in range(6):
+            t0 = time.perf_counter()
+            o = f(*inputs)
+            jax.block_until_ready(o)
+            ts.append(time.perf_counter() - t0)
+        best = min(ts[1:])
+        print(f"{name:8s} OK exact={ok} best={best*1e3:.2f}ms "
+              f"lookups/s={B*S/best/1e9:.2f}G", flush=True)
+    except Exception as e:
+        head = str(e).split("\n")[0][:200]
+        print(f"{name:8s} FAIL {type(e).__name__}: {head}", flush=True)
+
+
+want16 = tbl16_np[idx_np]
+
+if "take" in variants:
+    def k_take(idx_ref, tbl_ref, out_ref):
+        out_ref[:] = jnp.take(tbl_ref[:], idx_ref[:], axis=0)
+    run("take", k_take, (idx, tbl16), jnp.int32, want16)
+
+if "takeax" in variants:
+    def k_takeax(idx_ref, tbl_ref, out_ref):
+        t = tbl_ref[:]  # [65536] -> broadcast rows? use take_along_axis
+        out_ref[:] = jnp.take_along_axis(
+            jnp.broadcast_to(t[None, :], (idx_ref.shape[0], t.shape[0])),
+            idx_ref[:], axis=1,
+        )
+    run("takeax", k_takeax, (idx, tbl16), jnp.int32, want16)
+
+idx8_np = idx_np & 0xFF
+idx8 = jnp.asarray(idx8_np)
+want8 = tbl256_np[idx8_np].sum(-1).astype(np.int32)
+
+if "onehot" in variants:
+    tblb = jnp.asarray(tbl256_np, jnp.bfloat16)
+    def k_oh(idx_ref, tbl_ref, out_ref):
+        oh = (idx_ref[:][:, :, None]
+              == jax.lax.broadcasted_iota(jnp.int32, (1, 1, 256), 2)
+              ).astype(jnp.bfloat16)
+        rows = jax.lax.dot_general(
+            oh, tbl_ref[:], dimension_numbers=(((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out_ref[:] = rows.sum(-1).astype(jnp.int32)
+    run("onehot", k_oh, (idx8, tblb), jnp.int32, want8)
+
+if "onehot8" in variants:
+    tbl8 = jnp.asarray(tbl256_np, jnp.int8)
+    def k_oh8(idx_ref, tbl_ref, out_ref):
+        oh = (idx_ref[:][:, :, None]
+              == jax.lax.broadcasted_iota(jnp.int32, (1, 1, 256), 2)
+              ).astype(jnp.int8)
+        rows = jax.lax.dot_general(
+            oh, tbl_ref[:], dimension_numbers=(((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        out_ref[:] = rows.sum(-1)
+    run("onehot8", k_oh8, (idx8, tbl8), jnp.int32, want8)

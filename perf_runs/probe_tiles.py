@@ -1,0 +1,51 @@
+"""Probe straw2 Pallas kernel tiles on silicon: compile + time each tile.
+
+Usage: python perf_runs/probe_tiles.py [tile ...]
+Prints one line per tile: ok/fail, compile time, steady-state time, draws/s.
+"""
+import os
+import sys
+import time
+import traceback
+
+tiles = [int(t) for t in sys.argv[1:]] or [32, 64, 128, 256]
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+print("backend:", jax.default_backend(), jax.devices(), flush=True)
+
+from ceph_tpu.ops.pallas_crush import straw2_scores_pallas, TileShapeError
+
+B, S = 1 << 18, 128
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.integers(0, 1 << 31, B, dtype=np.int32))
+r = jnp.asarray(rng.integers(0, 4, B, dtype=np.int32))
+items = jnp.asarray(rng.integers(0, 1024, (B, S), dtype=np.int32))
+
+for tile in tiles:
+    try:
+        t0 = time.perf_counter()
+        hi, lo = straw2_scores_pallas(x, r, items, tile=tile)
+        jax.block_until_ready((hi, lo))
+        t_compile = time.perf_counter() - t0
+        # steady state: chain a few launches, block at the end
+        n = 5
+        t0 = time.perf_counter()
+        for i in range(n):
+            hi, lo = straw2_scores_pallas(x, r + i, items, tile=tile)
+        jax.block_until_ready((hi, lo))
+        dt = (time.perf_counter() - t0) / n
+        print(
+            f"tile={tile:4d} OK compile+first={t_compile:.2f}s "
+            f"steady={dt*1e3:.1f}ms draws/s={B*S/dt/1e9:.2f}G",
+            flush=True,
+        )
+    except Exception as e:
+        msg = str(e).split("\n")
+        head = msg[0][:300]
+        print(f"tile={tile:4d} FAIL {type(e).__name__}: {head}", flush=True)
+        # full traceback to a side file for the first failure
+        with open(f"/root/repo/perf_runs/tile_{tile}_fail.txt", "w") as f:
+            f.write(traceback.format_exc())

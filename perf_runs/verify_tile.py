@@ -1,0 +1,59 @@
+"""Verify straw2 Pallas kernel output at a given tile vs the XLA gather
+path, on device, and retime with a per-launch block."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from ceph_tpu.ops.pallas_crush import straw2_scores_pallas
+from ceph_tpu.crush.ln_table import CRUSH_LN_TABLE
+from ceph_tpu.crush.hash import crush_hash32_3
+
+tiles = [int(t) for t in sys.argv[1:]] or [32, 64]
+
+B, S = 1 << 18, 128
+rng = np.random.default_rng(1)
+x = jnp.asarray(rng.integers(0, 1 << 31, B, dtype=np.int32))
+r = jnp.asarray(rng.integers(0, 4, B, dtype=np.int32))
+items = jnp.asarray(rng.integers(0, 1024, (B, S), dtype=np.int32))
+
+# ground truth on host (numpy gather)
+xn = np.asarray(x).astype(np.uint32)
+rn = np.asarray(r).astype(np.uint32)
+inn = np.asarray(items).astype(np.uint32)
+
+
+def hash3_np(a, b, c):
+    import ceph_tpu.crush.hash as H
+    return np.asarray(
+        crush_hash32_3(jnp.asarray(a[:, None]), jnp.asarray(inn),
+                       jnp.asarray(c[:, None]))
+    )
+
+
+u = hash3_np(xn, inn, rn) & 0xFFFF
+want = CRUSH_LN_TABLE[u]
+
+for tile in tiles:
+    hi, lo = straw2_scores_pallas(x, r, items, tile=tile)
+    hi, lo = np.asarray(hi), np.asarray(lo)
+    got = (hi.astype(np.int64) << 24) | lo.astype(np.int64)
+    ok = (got == want).all()
+    nbad = int((got != want).sum())
+    print(f"tile={tile:4d} exact={ok} mismatches={nbad}/{got.size}", flush=True)
+    # careful retime: block after EVERY launch
+    ts = []
+    for i in range(8):
+        t0 = time.perf_counter()
+        o = straw2_scores_pallas(x, r + i, items, tile=tile)
+        jax.block_until_ready(o)
+        ts.append(time.perf_counter() - t0)
+    best = min(ts[2:])
+    print(
+        f"tile={tile:4d} per-launch best={best*1e3:.2f}ms "
+        f"draws/s={B*S/best/1e9:.2f}G all={[round(t*1e3,1) for t in ts]}",
+        flush=True,
+    )

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Round-4 second-wave tunnel watchdog: probe every 5 min; on recovery,
+run the straw2-kernel experiments (flat-layout probes, tile timings) and
+the RMW silicon bench, saving outputs to perf_runs/.  Marker files make
+each experiment idempotent across restarts.
+
+Run: nohup python perf_runs/watchdog2.py >> perf_runs/watchdog2.log 2>&1 &
+"""
+import os
+import subprocess
+import sys
+import time
+
+OUT = "/root/repo/perf_runs"
+os.chdir("/root/repo")
+
+EXPERIMENTS = [
+    # (marker, timeout_s, argv)
+    ("flat_ln", 1500,
+     [sys.executable, "perf_runs/probe_flat.py", "512", "2048", "8192"]),
+    ("tile64", 900,
+     [sys.executable, "perf_runs/verify_tile.py", "64"]),
+    ("rmw", 900,
+     [sys.executable, "-m", "ceph_tpu.bench.ec_bench", "--plugin", "jax",
+      "--k", "8", "--m", "4", "--technique", "cauchy_good",
+      "--workload", "rmw", "--rmw-window", "65536", "--json"]),
+]
+
+
+def log(msg):
+    print(time.strftime("%FT%TZ", time.gmtime()), msg, flush=True)
+
+
+def probe() -> bool:
+    code = ("import jax\n"
+            "assert jax.devices()[0].platform != 'cpu'\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=90,
+                           capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main():
+    log(f"watchdog2 started (pid {os.getpid()})")
+    while True:
+        todo = [e for e in EXPERIMENTS
+                if not os.path.exists(f"{OUT}/{e[0]}.done")]
+        if not todo:
+            log("all experiments captured; exiting")
+            return
+        if not probe():
+            log("tunnel down/wedged; sleeping 300s")
+            time.sleep(300)
+            continue
+        log("tunnel UP")
+        for marker, tmo, argv in todo:
+            log(f"running {marker}: {' '.join(argv[1:])}")
+            try:
+                with open(f"{OUT}/{marker}.out", "w") as f:
+                    r = subprocess.run(argv, timeout=tmo, stdout=f,
+                                       stderr=subprocess.STDOUT)
+                if r.returncode == 0:
+                    open(f"{OUT}/{marker}.done", "w").close()
+                    log(f"{marker} OK")
+                else:
+                    log(f"{marker} rc={r.returncode}")
+            except subprocess.TimeoutExpired:
+                log(f"{marker} TIMED OUT after {tmo}s")
+            if not probe():
+                log("tunnel lost mid-wave; back to sleep")
+                break
+
+
+if __name__ == "__main__":
+    main()

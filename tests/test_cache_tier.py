@@ -1,0 +1,252 @@
+"""Cache tiering through the ring-2 cluster (reference: PrimaryLogPG's
+maybe_handle_cache_detail promote/proxy/whiteout machinery, the TierAgent
+flush/evict loop, OSDMonitor's `osd tier *` commands, and the Objecter's
+read_tier/write_tier overlay redirect — qa/workunits cache-pool tests).
+"""
+import time
+
+import pytest
+
+from ceph_tpu.qa.vstart import LocalCluster
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with LocalCluster(n_mons=1, n_osds=4) as c:
+        c.create_replicated_pool("base", size=2)
+        c.create_replicated_pool("cache", size=2)
+        for cmd in (
+            {"prefix": "osd tier add", "pool": "base", "tierpool": "cache"},
+            {"prefix": "osd tier cache-mode", "pool": "cache",
+             "mode": "writeback"},
+            {"prefix": "osd tier set-overlay", "pool": "base",
+             "tierpool": "cache"},
+        ):
+            rv, res = c.mon_command(cmd)
+            assert rv == 0, (cmd, rv, res)
+        yield c
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    return cluster.client()
+
+
+def _wait(pred, timeout=15.0, step=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+def _settle(cluster, client=None):
+    """Wait until every OSD (and optionally the client) observes the
+    newest map epoch — tier-mode and overlay changes take effect
+    per-daemon as the map propagates, so I/O issued immediately after a
+    mon_command can race the old mode."""
+    target = cluster._leader().osdmon.osdmap.epoch
+    assert _wait(
+        lambda: all(o.my_epoch() >= target for o in cluster.osds.values())
+    ), "OSDs never caught up to the map epoch"
+    if client is not None:
+        assert _wait(
+            lambda: client.mc.osdmap is not None
+            and client.mc.osdmap.epoch >= target
+        ), "client never caught up to the map epoch"
+
+
+def test_overlay_routes_writes_to_cache(cluster, client):
+    base = client.open_ioctx("base")
+    cache = client.open_ioctx("cache")
+    base.write_full("obj-a", b"hello world")
+    # the overlay redirected the write: it lives in the cache pool only
+    # (ls is never redirected — it enumerates the pool it names)
+    assert "obj-a" in cache.list_objects()
+    assert base.read("obj-a") == b"hello world"  # read via overlay
+
+
+def test_flush_copies_to_base_and_evict_drops(cluster, client):
+    base = client.open_ioctx("base")
+    cache = client.open_ioctx("cache")
+    base.write_full("obj-f", b"flush me")
+    # agent or explicit flush: use the explicit op for determinism
+    cache.cache_flush("obj-f")
+    assert "obj-f" in base.list_objects(), "flush must install the base copy"
+    cache.cache_evict("obj-f")
+    assert "obj-f" not in cache.list_objects()
+    # read through the overlay promotes it back from the base
+    assert base.read("obj-f") == b"flush me"
+    assert "obj-f" in cache.list_objects()
+
+
+def test_evict_refuses_dirty(cluster, client):
+    base = client.open_ioctx("base")
+    cache = client.open_ioctx("cache")
+    base.write_full("obj-d", b"dirty")
+    with pytest.raises(IOError, match="-16|dirty"):
+        cache.cache_evict("obj-d")
+    cache.cache_flush("obj-d")
+    cache.cache_evict("obj-d")  # clean now
+
+
+def test_rewrite_after_flush_redirties(cluster, client):
+    base = client.open_ioctx("base")
+    cache = client.open_ioctx("cache")
+    base.write_full("obj-r", b"v1")
+    cache.cache_flush("obj-r")
+    base.write_full("obj-r", b"v2")  # removes the clean marker
+    with pytest.raises(IOError):
+        cache.cache_evict("obj-r")
+    cache.cache_flush("obj-r")
+    cache.cache_evict("obj-r")
+    assert base.read("obj-r") == b"v2"
+
+
+def test_partial_write_promotes_base_content(cluster, client):
+    base = client.open_ioctx("base")
+    cache = client.open_ioctx("cache")
+    base.write_full("obj-p", b"hello world")
+    cache.cache_flush("obj-p")
+    cache.cache_evict("obj-p")
+    # ranged write on the evicted object: must splice into PROMOTED
+    # bytes, not a fresh empty object
+    base.write("obj-p", b"XY", off=6)
+    assert base.read("obj-p") == b"hello XYrld"
+
+
+def test_delete_whiteout_hides_base_copy(cluster, client):
+    base = client.open_ioctx("base")
+    cache = client.open_ioctx("cache")
+    base.write_full("obj-w", b"to delete")
+    cache.cache_flush("obj-w")
+    cache.cache_evict("obj-w")
+    assert "obj-w" in base.list_objects()
+    base.remove("obj-w")  # whiteout in the cache; base copy still there
+    with pytest.raises(IOError):
+        base.read("obj-w")
+    # flush propagates the delete and retires the stub
+    cache.cache_flush("obj-w")
+    assert _wait(lambda: "obj-w" not in base.list_objects())
+    with pytest.raises(IOError):
+        base.read("obj-w")
+
+
+def test_xattrs_and_omap_survive_flush_evict_promote(cluster, client):
+    base = client.open_ioctx("base")
+    cache = client.open_ioctx("cache")
+    base.write_full("obj-x", b"payload")
+    base.set_xattr("obj-x", "color", b"red")
+    base.omap_set("obj-x", {"k1": b"v1", "k2": b"v2"})
+    cache.cache_flush("obj-x")
+    cache.cache_evict("obj-x")
+    # promote restores data + xattrs + omap
+    assert base.read("obj-x") == b"payload"
+    assert base.get_xattr("obj-x", "color") == b"red"
+    assert base.omap_get("obj-x") == {"k1": b"v1", "k2": b"v2"}
+
+
+def test_agent_flushes_and_evicts_to_target(cluster, client):
+    rv, res = cluster.mon_command({
+        "prefix": "osd pool set", "name": "cache",
+        "key": "target_max_objects", "value": "1",
+    })
+    assert rv == 0, res
+    base = client.open_ioctx("base")
+    cache = client.open_ioctx("cache")
+    for i in range(6):
+        base.write_full(f"agent-{i}", f"payload-{i}".encode())
+    # the background agent must flush every dirty object to the base and
+    # evict down toward the (tiny) target
+    assert _wait(
+        lambda: all(
+            f"agent-{i}" in base.list_objects() for i in range(6)
+        ),
+        timeout=30.0,
+    ), "agent did not flush to base"
+    assert _wait(
+        lambda: len([o for o in cache.list_objects()
+                     if o.startswith("agent-")]) <= 2,
+        timeout=30.0,
+    ), "agent did not evict toward target_max_objects"
+    # nothing was lost
+    for i in range(6):
+        assert base.read(f"agent-{i}") == f"payload-{i}".encode()
+    rv, _ = cluster.mon_command({
+        "prefix": "osd pool set", "name": "cache",
+        "key": "target_max_objects", "value": "0",
+    })
+    assert rv == 0
+
+
+def test_readproxy_serves_without_promoting(cluster, client):
+    base = client.open_ioctx("base")
+    cache = client.open_ioctx("cache")
+    base.write_full("obj-rp", b"proxy me")
+    cache.cache_flush("obj-rp")
+    cache.cache_evict("obj-rp")
+    rv, res = cluster.mon_command({
+        "prefix": "osd tier cache-mode", "pool": "cache",
+        "mode": "readproxy",
+    })
+    assert rv == 0, res
+    _settle(cluster, client)
+    try:
+        assert base.read("obj-rp") == b"proxy me"
+        assert "obj-rp" not in cache.list_objects(), \
+            "readproxy must not promote on read"
+        # writes still land in the cache (promote-on-write)
+        base.write_full("obj-rp", b"proxy v2")
+        assert "obj-rp" in cache.list_objects()
+        assert base.read("obj-rp") == b"proxy v2"
+    finally:
+        rv, _ = cluster.mon_command({
+            "prefix": "osd tier cache-mode", "pool": "cache",
+            "mode": "writeback",
+        })
+        assert rv == 0
+        _settle(cluster, client)
+
+
+def test_remove_overlay_restores_direct_io(cluster, client):
+    base = client.open_ioctx("base")
+    cache = client.open_ioctx("cache")
+    base.write_full("obj-o", b"direct?")
+    cache.cache_flush("obj-o")
+    cache.cache_evict("obj-o")
+    rv, res = cluster.mon_command(
+        {"prefix": "osd tier remove-overlay", "pool": "base"})
+    assert rv == 0, res
+    _settle(cluster, client)
+    try:
+        # no redirect: the write lands in the base pool itself
+        base.write_full("obj-o2", b"direct!")
+        assert "obj-o2" in base.list_objects()
+        assert "obj-o2" not in cache.list_objects()
+        assert base.read("obj-o") == b"direct?"
+    finally:
+        rv, _ = cluster.mon_command({
+            "prefix": "osd tier set-overlay", "pool": "base",
+            "tierpool": "cache",
+        })
+        assert rv == 0
+        _settle(cluster, client)
+
+
+def test_tier_command_validation(cluster):
+    # EC pools cannot cache
+    cluster.create_ec_pool("ecp", k=2, m=1)
+    rv, res = cluster.mon_command(
+        {"prefix": "osd tier add", "pool": "base", "tierpool": "ecp"})
+    assert rv == -95, (rv, res)
+    # removing a tier under an active overlay is refused
+    rv, res = cluster.mon_command(
+        {"prefix": "osd tier remove", "pool": "base", "tierpool": "cache"})
+    assert rv == -16, (rv, res)
+    # a pool cannot tier itself
+    rv, res = cluster.mon_command(
+        {"prefix": "osd tier add", "pool": "base", "tierpool": "base"})
+    assert rv == -22, (rv, res)
