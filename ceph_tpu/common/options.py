@@ -171,6 +171,11 @@ def default_options() -> OptionTable:
             Option("mds_journal_segment_events", int, 128,
                    "journal events per segment before a dirfrag flush + "
                    "trim (reference: mds_log_events_per_segment)", min=1),
+            Option("mds_reconnect_timeout", float, 5.0,
+                   "seconds a restarted MDS waits for a prior writer "
+                   "session to re-flush its buffered caps before evicting "
+                   "it (reference: mds_reconnect_timeout)", min=0.0,
+                   runtime=True),
             # -- objectstore (reference: bluestore options) ----------------
             Option("objectstore", str, "memstore", "backend for new OSDs",
                    enum=("memstore", "kstore", "filestore", "bluestore")),

@@ -25,7 +25,12 @@ import uuid
 from ..client.striper import ExtentIO, StripePolicy
 from ..msg import Dispatcher, Messenger
 from .mds import ROOT_INO
-from .messages import MClientReply, MClientRequest, MClientSession
+from .messages import (
+    MClientCaps,
+    MClientReply,
+    MClientRequest,
+    MClientSession,
+)
 
 _ERR = {
     -2: FileNotFoundError,
@@ -41,8 +46,15 @@ class FSError(OSError):
 
 
 class FileHandle:
-    """Open file: striped data I/O + size writeback to the MDS (the
-    cap-flush analog — reference: Client::_write updating inode size)."""
+    """Open file: striped data I/O + capability-gated metadata writeback
+    (reference: Client::_write under Fw/Fb caps).
+
+    With the "w" cap (exclusive opener) size/mtime updates BUFFER locally
+    — one cap flush on close/revoke instead of a synchronous setattr per
+    write.  Without it (contended file), every write syncs attrs to the
+    MDS exactly like the pre-caps behavior.  With "r" the cached inode
+    serves size() without a getattr; uncapped handles refresh from the
+    MDS so another client's flushed size is visible."""
 
     def __init__(self, fs: "FSClient", inode: dict):
         self.fs = fs
@@ -61,20 +73,50 @@ class FileHandle:
         self._ext = ExtentIO(
             self.io, lambda objectno: f"{ino:x}.{objectno:08x}", self.policy
         )
+        fs._register_handle(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     @property
     def ino(self) -> int:
         return self.inode["ino"]
 
+    def _caps(self) -> str:
+        return self.fs._caps_of(self.ino)
+
     def size(self) -> int:
+        ent = self.fs._cap_entry(self.ino)
+        if ent is not None and ent["dirty"].get("size") is not None:
+            return int(ent["dirty"]["size"])
+        if not self._caps():
+            # no cap: another client may hold w — ask the MDS (which
+            # syncs writers) rather than trusting the stale local copy
+            try:
+                self.inode = self.fs._request(
+                    "getattr", {"ino": self.ino})
+            except OSError:
+                pass  # unlinked-but-open: serve the last known attrs
         return int(self.inode.get("size", 0))
 
     def write(self, data: bytes, off: int = 0) -> int:
         self._ext.write(data, off)
-        # size/mtime writeback — the cap-flush analog
+        new_end = off + len(data)
+        ent = self.fs._cap_entry(self.ino)
+        if ent is not None and "w" in ent["caps"]:
+            # Fb: buffer the attr update; flushed on close/revoke
+            d = ent["dirty"]
+            if new_end > max(int(self.inode.get("size", 0)),
+                             int(d.get("size") or 0)):
+                d["size"] = new_end
+            d["mtime"] = _time.time()
+            return len(data)
         attrs = {"ino": self.ino, "mtime": _time.time()}
-        if off + len(data) > self.size():
-            attrs["size"] = off + len(data)
+        if new_end > self.size():
+            attrs["size"] = new_end
         self.inode = self.fs._request("setattr", attrs)
         return len(data)
 
@@ -90,9 +132,15 @@ class FileHandle:
         old = self.size()
         if size < old:
             self._ext.truncate_data(old, size)
+        self.fs._flush_caps(self.ino)  # a buffered larger size is stale now
         self.inode = self.fs._request(
             "setattr", {"ino": self.ino, "size": size, "mtime": _time.time()}
         )
+
+    def close(self) -> None:
+        """Flush buffered attrs and release caps (reference:
+        Client::_release_fh -> cap release)."""
+        self.fs._close_handle(self)
 
 
 class FSClient(Dispatcher):
@@ -117,6 +165,11 @@ class FSClient(Dispatcher):
         self._conn = None
         self._dcache: dict[tuple[int, str], dict] = {}
         self._ios: dict[str, object] = {}
+        # capability state (reference: Client::caps): ino -> {"caps",
+        # "dirty" {size, mtime}, "count" open handles}.  In-memory; a
+        # connection reset drops every cap (reconnect-window analog) but
+        # keeps the dirty attrs, which then flush synchronously.
+        self._caps_state: dict[int, dict] = {}
 
     # -- session -----------------------------------------------------------
     def mount(self, timeout: float = 10.0) -> None:
@@ -133,6 +186,11 @@ class FSClient(Dispatcher):
                 raise TimeoutError("MDS session open timed out")
 
     def unmount(self) -> None:
+        for ino in list(self._caps_state):
+            try:
+                self._flush_caps(ino, release=True)
+            except (OSError, FSError):
+                pass
         try:
             if self._conn is not None:
                 self._conn.send_message(
@@ -154,13 +212,66 @@ class FSClient(Dispatcher):
                 self._replies[msg.tid] = (msg.retval, msg.result)
                 self._cond.notify_all()
             return True
+        if isinstance(msg, MClientCaps) and msg.op == "revoke":
+            # MDS recall: flush dirty attrs, drop to the granted set, ack
+            # with a "flush" carrying whatever was buffered (reference:
+            # Client::handle_cap_grant's revoke branch)
+            with self._lock:
+                ent = self._caps_state.get(msg.ino)
+                dirty = dict(ent["dirty"]) if ent else {}
+                if ent is not None:
+                    ent["caps"] = msg.caps or ""
+                    ent["dirty"] = {}
+            try:
+                conn.send_message(MClientCaps(
+                    op="flush", client=self._session, ino=msg.ino,
+                    caps=msg.caps or "", seq=msg.seq,
+                    attrs=dirty or None,
+                ))
+            except (OSError, ConnectionError):
+                pass
+            return True
         return False
 
     def ms_handle_reset(self, conn) -> None:
         with self._lock:
             if conn is self._conn:
                 self._conn = None
+            # every cap dies with the session connection; buffered attrs
+            # survive locally and MUST reach the restarted MDS — it holds
+            # our writer registration in its sessionmap and blocks attr
+            # readers on our reconnect flush (reference: the client
+            # reconnect phase re-asserting caps after MDS failover)
+            dirty = {}
+            for ino, ent in self._caps_state.items():
+                if "w" in ent["caps"] and ent["dirty"]:
+                    dirty[ino] = dict(ent["dirty"])
+                ent["caps"] = ""
             self._cond.notify_all()
+        if dirty:
+            threading.Thread(
+                target=self._reconnect_flush, args=(dirty,), daemon=True
+            ).start()
+
+    def _reconnect_flush(self, dirty: dict, timeout: float = 15.0) -> None:
+        """Push buffered attrs at the (restarted) MDS until a send lands
+        or the deadline passes — flushes are absolute-valued and
+        idempotent, so resending is safe."""
+        import time as _t
+
+        deadline = _t.monotonic() + timeout
+        pending = dict(dirty)
+        while pending and _t.monotonic() < deadline:
+            try:
+                conn = self.messenger.connect(self.mds_addr)
+                for ino in list(pending):
+                    conn.send_message(MClientCaps(
+                        op="flush", client=self._session, ino=ino,
+                        caps="", seq=0, attrs=pending[ino],
+                    ))
+                    pending.pop(ino)
+            except (OSError, ConnectionError):
+                _t.sleep(0.5)
 
     # -- RPC ---------------------------------------------------------------
     def _request(self, op: str, args: dict, timeout: float = 10.0):
@@ -251,22 +362,92 @@ class FSClient(Dispatcher):
             self._ios[pool] = self.rados.open_ioctx(pool)
         return self._ios[pool]
 
+    # -- capabilities ------------------------------------------------------
+    def _cap_entry(self, ino: int) -> dict | None:
+        return self._caps_state.get(ino)
+
+    def _caps_of(self, ino: int) -> str:
+        ent = self._caps_state.get(ino)
+        return ent["caps"] if ent else ""
+
+    def _register_handle(self, fh: "FileHandle") -> None:
+        with self._lock:
+            ent = self._caps_state.setdefault(
+                fh.ino, {"caps": "", "dirty": {}, "count": 0}
+            )
+            caps = fh.inode.pop("caps", None)
+            if caps is not None:
+                ent["caps"] = caps
+            ent["count"] += 1
+
+    def _flush_caps(self, ino: int, release: bool = False) -> None:
+        """Write buffered size/mtime back to the MDS (cap flush).  Uses a
+        plain setattr request (journaled identically to the revoke-ack
+        flush) so it also covers the cap-lost-on-reset path."""
+        with self._lock:
+            ent = self._caps_state.get(ino)
+            if ent is None:
+                return
+            dirty, ent["dirty"] = ent["dirty"], {}
+            caps = ent["caps"]
+            if release:
+                self._caps_state.pop(ino, None)
+        if dirty.get("size") is not None or dirty.get("mtime") is not None:
+            self._request("setattr", {"ino": ino, **dirty})
+        if release and caps:
+            try:
+                conn = self._conn
+                if conn is not None:
+                    conn.send_message(MClientCaps(
+                        op="release", client=self._session, ino=ino,
+                        caps="", seq=0,
+                    ))
+            except (OSError, ConnectionError):
+                pass
+
+    def _close_handle(self, fh: "FileHandle") -> None:
+        with self._lock:
+            ent = self._caps_state.get(fh.ino)
+            if ent is None:
+                return
+            ent["count"] -= 1
+            last = ent["count"] <= 0
+        self._flush_caps(fh.ino, release=last)
+
     # -- public API --------------------------------------------------------
     def mkdir(self, path: str) -> dict:
         parent, name = self._resolve_parent(path)
         return self._request("mkdir", {"parent": parent, "name": name})
 
+    def _overlay_dirty(self, inode: dict) -> dict:
+        """Merge this client's own buffered (cap-dirty) attrs into an MDS
+        inode — a stat must see our unflushed writes (reference: the
+        client fills stat from its own caps when it holds them)."""
+        ent = self._caps_state.get(inode.get("ino"))
+        if not ent or not ent["dirty"]:
+            return inode
+        out = dict(inode)
+        for k in ("size", "mtime"):
+            if ent["dirty"].get(k) is not None:
+                out[k] = ent["dirty"][k]
+        return out
+
     def listdir(self, path: str = "/") -> dict:
         inode = self._resolve(path)
         if inode["type"] != "dir":
             raise NotADirectoryError(path)
-        return self._request("readdir", {"ino": inode["ino"]})
+        out = self._request("readdir", {"ino": inode["ino"]})
+        return {n: self._overlay_dirty(i) if isinstance(i, dict) else i
+                for n, i in (out or {}).items()}
 
     def stat(self, path: str) -> dict:
-        return self._resolve(path)
+        return self._overlay_dirty(self._resolve(path))
 
     def open(self, path: str, create: bool = False,
-             layout: dict | None = None) -> FileHandle:
+             layout: dict | None = None, want: str = "rw") -> FileHandle:
+        """`want` asks for capabilities: "rw" (buffer attrs while the
+        sole opener) or "r" (cache attrs alongside other readers).  The
+        MDS may grant less under contention."""
         if create:
             parent, name = self._resolve_parent(path)
             try:
@@ -280,13 +461,19 @@ class FSClient(Dispatcher):
             inode = self._resolve(path)
         if inode["type"] == "dir":
             raise IsADirectoryError(path)
-        return FileHandle(self, inode)
+        # explicit open RPC: grants caps (and flushes competing writers)
+        inode = self._request(
+            "open", {"ino": inode["ino"], "want": want})
+        return FileHandle(self, dict(inode))
 
     def _purge_data(self, inode: dict) -> None:
         """Remove a dead file's data objects (reference: the MDS purge
         queue; here the client that held the last ref does it inline)."""
         fh = FileHandle(self, inode)
-        fh._ext.purge(fh.size())
+        try:
+            fh._ext.purge(int(fh.inode.get("size", 0)))
+        finally:
+            fh.close()
 
     def link(self, src: str, dst: str) -> dict:
         """Hardlink (reference: Client::link -> MDS remote dentry): both
@@ -328,10 +515,11 @@ class FSClient(Dispatcher):
             self._purge_data(replaced)
 
     def write_file(self, path: str, data: bytes) -> None:
-        fh = self.open(path, create=True)
-        if fh.size():
-            fh.truncate(0)
-        fh.write(data)
+        with self.open(path, create=True) as fh:
+            if fh.size():
+                fh.truncate(0)
+            fh.write(data)
 
     def read_file(self, path: str) -> bytes:
-        return self.open(path).read()
+        with self.open(path, want="r") as fh:
+            return fh.read()

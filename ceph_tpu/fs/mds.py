@@ -33,7 +33,12 @@ from collections import OrderedDict
 
 from ..client.rados import Rados
 from ..msg import Dispatcher, Messenger
-from .messages import MClientReply, MClientRequest, MClientSession
+from .messages import (
+    MClientCaps,
+    MClientReply,
+    MClientRequest,
+    MClientSession,
+)
 
 ROOT_INO = 1
 
@@ -48,8 +53,10 @@ class MDSDaemon(Dispatcher):
         mon_addrs,
         metadata_pool: str = "cephfs_meta",
         data_pool: str = "cephfs_data",
+        bind_addr: tuple[str, int] | None = None,
     ):
         self.cct = cct
+        self._bind_addr = tuple(bind_addr) if bind_addr else None
         self.mon_addrs = mon_addrs
         self.metadata_pool = metadata_pool
         self.data_pool = data_pool
@@ -86,6 +93,27 @@ class MDSDaemon(Dispatcher):
         # is per-Session) so one busy client can't evict another session's
         # in-flight retry window
         self._reply_cache: OrderedDict[str, OrderedDict] = OrderedDict()
+        # client capabilities (reference: Capability.h + the Locker's
+        # per-inode filelock): ino -> {session: {"caps": "rw"|"r"|"",
+        # "seq": n}}.  "w" implies the holder may BUFFER size/mtime
+        # (Fw|Fb), "r" implies it may cache attrs (Fr|Fc); in-memory
+        # only — clients treat a connection reset as cap loss and fall
+        # back to synchronous writeback (the reconnect-window analog).
+        self.caps: dict[int, dict[str, dict]] = {}
+        self._caps_cond = threading.Condition(self._lock)
+        # session -> live connection, for pushing revokes (the Session's
+        # Connection in the reference)
+        self._session_conns: dict[str, object] = {}
+        # persisted writer-cap registry (the SessionMap analog,
+        # reference: src/mds/SessionMap.cc stored in the metadata pool):
+        # ino -> [sessions holding w].  A restarted MDS reads it and
+        # makes attr reads of those inos WAIT for the writer's reconnect
+        # flush (the mds_reconnect_timeout window) before serving, so
+        # buffered sizes survive MDS failover; writers that never return
+        # are evicted at the deadline.
+        self._writers: dict[int, list[str]] = {}
+        self._reconnect: dict[int, list[str]] = {}  # prior incarnation's
+        self._reconnect_deadline = 0.0
         self._rados: Rados | None = None
         self._io = None
 
@@ -168,6 +196,18 @@ class MDSDaemon(Dispatcher):
         self._seg_seq = seq
         self._seg_idx = 0
         self._flush()
+        # sessionmap: writer sessions from the previous incarnation get a
+        # reconnect window to re-flush their buffered attrs before attr
+        # reads of their inos are served (reference: the MDS reconnect
+        # phase driven by the persisted SessionMap)
+        sm = self._obj_read("mds_sessionmap") or {}
+        self._reconnect = {
+            int(k, 16): list(v) for k, v in sm.items() if v
+        }
+        if self._reconnect:
+            self._reconnect_deadline = time.monotonic() + float(
+                self.cct.conf.get("mds_reconnect_timeout")
+            )
 
     def _rebuild_backptrs(self) -> None:
         """Primary dentries (embedded inode) feed backptr; remote stubs
@@ -408,7 +448,9 @@ class MDSDaemon(Dispatcher):
         self._io = self._rados.open_ioctx(self.metadata_pool)
         with self._lock:
             self._load()
-        self.addr = self.messenger.bind(("127.0.0.1", 0))
+        self.addr = self.messenger.bind(
+            self._bind_addr or ("127.0.0.1", 0)
+        )
         self.messenger.start()
 
     def shutdown(self) -> None:
@@ -438,7 +480,147 @@ class MDSDaemon(Dispatcher):
         self.next_ino += 1
         return ino
 
-    def _handle(self, op: str, a: dict):
+    # -- capabilities (reference: src/mds/Locker.cc issue/revoke flow) -----
+    def _cap_writers(self, ino: int, but: str | None = None) -> list[str]:
+        return [
+            s for s, c in self.caps.get(ino, {}).items()
+            if "w" in c["caps"] and s != but
+        ]
+
+    def _persist_writers(self) -> None:
+        """Write the SessionMap analog: every session holding w — current
+        grants plus prior-incarnation sessions still inside their
+        reconnect window (a second crash must keep waiting for them)."""
+        merged: dict[str, list[str]] = {}
+        for src in (self._writers, self._reconnect):
+            for ino, sessions in src.items():
+                if sessions:
+                    cur = merged.setdefault(f"{ino:x}", [])
+                    cur.extend(s for s in sessions if s not in cur)
+        self._obj_write("mds_sessionmap", merged)
+
+    def _set_writer(self, ino: int, session: str, on: bool) -> None:
+        cur = self._writers.setdefault(ino, [])
+        if on and session not in cur:
+            cur.append(session)
+        elif not on and session in cur:
+            cur.remove(session)
+        else:
+            return
+        if not cur:
+            self._writers.pop(ino, None)
+        self._persist_writers()
+
+    def _await_reconnect(self, ino: int) -> None:
+        """Block attr access to an ino whose prior-incarnation writer has
+        not re-flushed yet (the reconnect phase, per-inode); the deadline
+        evicts writers that never came back — their buffered attrs are
+        lost, exactly what evicting a dead client costs upstream."""
+        if not self._reconnect.get(ino):
+            return
+        remain = self._reconnect_deadline - time.monotonic()
+        if remain > 0:
+            self._caps_cond.wait_for(
+                lambda: not self._reconnect.get(ino), timeout=remain
+            )
+        if self._reconnect.get(ino):
+            self._reconnect.pop(ino, None)
+            self._persist_writers()
+            self.cct.dout(
+                "mds", 1, f"evicted unreconnected writer(s) of ino {ino:x}"
+            )
+
+    def _revoke_caps(self, ino: int, session: str, keep: str,
+                     timeout: float = 5.0) -> None:
+        """Push a revoke to `session` and wait for its flush-ack (the
+        Locker's revoke path).  Waiting releases the mds_lock (condition
+        wait), so the client's MClientCaps flush can be applied by the
+        messenger thread.  A client that never acks is force-downgraded —
+        the session-eviction analog: its buffered size/mtime are lost,
+        exactly what evicting a dead client costs upstream."""
+        holders = self.caps.get(ino, {})
+        ent = holders.get(session)
+        if ent is None or set(ent["caps"]) <= set(keep):
+            return
+        ent["seq"] = ent.get("seq", 0) + 1
+        conn = self._session_conns.get(session)
+        if conn is not None:
+            try:
+                conn.send_message(MClientCaps(
+                    op="revoke", client=session, ino=ino, caps=keep,
+                    seq=ent["seq"],
+                ))
+            except (OSError, ConnectionError):
+                conn = None
+        if conn is None:
+            ent["caps"] = keep  # dead session: force-drop
+            if "w" not in keep:
+                self._set_writer(ino, session, False)
+            return
+        self._caps_cond.wait_for(
+            lambda: set(holders.get(session, {"caps": ""})["caps"])
+            <= set(keep),
+            timeout=timeout,
+        )
+        ent = holders.get(session)
+        if ent is not None and not set(ent["caps"]) <= set(keep):
+            ent["caps"] = keep  # ack timeout: evict the cap
+            if "w" not in keep:
+                self._set_writer(ino, session, False)
+
+    def _grant_caps(self, ino: int, session: str | None, want: str) -> str:
+        """Grant rules (the filelock state machine, collapsed): exclusive
+        writer gets rw (buffer+cache); a second opener forces MIX — every
+        holder drops to uncached sync I/O ("" for writers, "r" readers);
+        readers coexist caching ("r").  Degraded holders are not
+        re-upgraded when contention ends until they reopen (the reference
+        re-issues caps eagerly; out of scope)."""
+        if session is None:
+            return ""
+        self._await_reconnect(ino)
+        holders = self.caps.setdefault(ino, {})
+        others = {s: c for s, c in holders.items() if s != session}
+        if want == "rw":
+            if others:
+                for s in list(others):
+                    self._revoke_caps(ino, s, "")
+                grant = ""
+            else:
+                grant = "rw"
+        else:
+            for s in self._cap_writers(ino, but=session):
+                self._revoke_caps(ino, s, "r")
+            grant = "r"
+        prev = holders.get(session)
+        holders[session] = {"caps": grant,
+                            "seq": (prev or {}).get("seq", 0)}
+        self._set_writer(ino, session, "w" in grant)
+        return grant
+
+    def _sync_writers(self, ino: int, but: str | None = None) -> None:
+        """Flush other sessions' buffered size/mtime before serving an
+        attr read or destroying the inode (Locker::simple_sync).  Also
+        holds attr reads for a prior incarnation's writer still inside
+        the reconnect window."""
+        self._await_reconnect(ino)
+        for s in self._cap_writers(ino, but=but):
+            self._revoke_caps(ino, s, "r")
+
+    def _invalidate_readers(self, ino: int, but: str | None = None) -> None:
+        """Recall other sessions' attr caches after an attr change they
+        did not make (the Fc recall a setattr triggers in the Locker) —
+        their next size() re-fetches from the MDS."""
+        for s, c in list(self.caps.get(ino, {}).items()):
+            if s != but and "r" in c["caps"]:
+                self._revoke_caps(ino, s, "")
+
+    def _drop_ino_caps(self, ino: int) -> None:
+        self.caps.pop(ino, None)
+        self._reconnect.pop(ino, None)
+        if self._writers.pop(ino, None) is not None:
+            self._persist_writers()
+
+    def _handle(self, op: str, a: dict, session: str | None = None):
         """Returns (retval, result).  Negative errnos follow the reference
         (-2 ENOENT, -17 EEXIST, -20 ENOTDIR, -21 EISDIR, -39 ENOTEMPTY)."""
         if op == "lookup":
@@ -446,10 +628,21 @@ class MDSDaemon(Dispatcher):
             if entries is None:
                 return -2, None
             inode = self._resolve_entry(entries.get(a["name"]))
-            return (0, inode) if inode is not None else (-2, None)
+            if inode is None:
+                return -2, None
+            if inode.get("type") == "file":
+                # fresh size: flush other sessions' buffered attrs
+                self._sync_writers(inode["ino"], but=session)
+                inode = self._resolve_entry(entries.get(a["name"]))
+            return 0, inode
         if op == "getattr":
             inode = self._inode_of(a["ino"])
-            return (0, inode) if inode is not None else (-2, None)
+            if inode is None:
+                return -2, None
+            if inode.get("type") == "file":
+                self._sync_writers(a["ino"], but=session)
+                inode = self._inode_of(a["ino"])
+            return 0, inode
         if op == "readdir":
             entries = self.dirs.get(a["ino"])
             if entries is None:
@@ -503,6 +696,11 @@ class MDSDaemon(Dispatcher):
             inode = self._resolve_entry(entry)
             if inode is None:
                 return -2, None
+            if inode.get("type") == "file":
+                # buffered sizes must land before the returned inode is
+                # used to purge data extents
+                self._sync_writers(inode["ino"], but=session)
+                inode = self._resolve_entry(entry)
             if op == "rmdir":
                 if inode["type"] != "dir":
                     return -20, None
@@ -532,6 +730,8 @@ class MDSDaemon(Dispatcher):
                         inode, nlink=max(nlink_after, 1)
                     )
             self._commit(ev)
+            if inode.get("type") == "file" and nlink_after <= 0:
+                self._drop_ino_caps(inode["ino"])
             # nlink_after tells the client whether it holds the LAST
             # reference (purge) or a survivor keeps the data alive
             return 0, dict(inode, nlink_after=max(nlink_after, 0))
@@ -546,6 +746,11 @@ class MDSDaemon(Dispatcher):
                 return -20, None
             dst_entry = dst.get(a["dname"])
             existing = self._resolve_entry(dst_entry)
+            if existing is not None and existing.get("type") == "file":
+                # replaced file's buffered size must land before its
+                # inode is handed back for data purge
+                self._sync_writers(existing["ino"], but=session)
+                existing = self._resolve_entry(dst_entry)
             if existing is not None:
                 if existing["ino"] == inode["ino"]:
                     return 0, {"moved": inode, "replaced": None}
@@ -598,13 +803,23 @@ class MDSDaemon(Dispatcher):
                 replaced = dict(
                     existing, nlink_after=max(replaced_nlink_after, 0)
                 )
+                if (
+                    existing.get("type") == "file"
+                    and replaced_nlink_after <= 0
+                ):
+                    self._drop_ino_caps(existing["ino"])
             return 0, {"moved": inode, "replaced": replaced}
         if op == "setattr":
             inode = self._inode_of(a["ino"])
             if inode is None:
                 return -2, None
+            # a sync setattr from one session must not be overwritten by
+            # another session's later cap flush of stale buffered attrs
+            self._sync_writers(a["ino"], but=session)
             self._commit({"e": "setattr", "ino": a["ino"],
                           "size": a.get("size"), "mtime": a.get("mtime")})
+            # and other sessions' cached attrs are stale now
+            self._invalidate_readers(a["ino"], but=session)
             return 0, self._inode_of(a["ino"])
         if op == "open":
             inode = self._inode_of(a["ino"])
@@ -612,7 +827,11 @@ class MDSDaemon(Dispatcher):
                 return -2, None
             if inode["type"] == "dir":
                 return -21, None
-            return 0, inode
+            caps = self._grant_caps(
+                inode["ino"], session, a.get("want", "rw")
+            )
+            # grant may have flushed a writer: re-read the inode
+            return 0, dict(self._inode_of(a["ino"]), caps=caps)
         return -95, f"unknown op {op!r}"  # EOPNOTSUPP
 
     def ms_dispatch(self, conn, msg) -> bool:
@@ -620,22 +839,77 @@ class MDSDaemon(Dispatcher):
             with self._lock:
                 if msg.op == "request_open":
                     self._sessions.add(msg.client)
+                    self._session_conns[msg.client] = conn
                     conn.send_message(
                         MClientSession(op="open", client=msg.client)
                     )
                 elif msg.op == "request_close":
                     self._sessions.discard(msg.client)
+                    self._session_conns.pop(msg.client, None)
                     # a closed session retires its completed-request set
                     # (reference: Session teardown) — without this the
                     # per-session caches grow with every client ever seen
                     self._reply_cache.pop(msg.client, None)
+                    # and surrenders every capability it still holds
+                    for ino, holders in self.caps.items():
+                        if "w" in holders.get(msg.client, {}).get("caps", ""):
+                            self._set_writer(ino, msg.client, False)
+                        holders.pop(msg.client, None)
+                    self._caps_cond.notify_all()
                     conn.send_message(
                         MClientSession(op="close", client=msg.client)
                     )
             return True
+        if isinstance(msg, MClientCaps):
+            with self._lock:
+                holders = self.caps.get(msg.ino, {})
+                ent = holders.get(msg.client)
+                if msg.op == "flush":
+                    # dirty writeback + revoke ack (the cap-flush): apply
+                    # the buffered attrs only while the sender still holds
+                    # w — a raced revoke already force-dropped it — or
+                    # while it is a RECONNECTING writer from the previous
+                    # incarnation (its w cap is recorded in the persisted
+                    # sessionmap, not in memory)
+                    recon = msg.client in (
+                        self._reconnect.get(msg.ino) or []
+                    )
+                    attrs = msg.attrs or {}
+                    if (
+                        ((ent is not None and "w" in ent["caps"]) or recon)
+                        and (attrs.get("size") is not None
+                             or attrs.get("mtime") is not None)
+                        and self._inode_of(msg.ino) is not None
+                    ):
+                        self._commit({
+                            "e": "setattr", "ino": msg.ino,
+                            "size": attrs.get("size"),
+                            "mtime": attrs.get("mtime"),
+                        })
+                    if recon:
+                        pend = self._reconnect.get(msg.ino, [])
+                        if msg.client in pend:
+                            pend.remove(msg.client)
+                        if not pend:
+                            self._reconnect.pop(msg.ino, None)
+                        self._persist_writers()
+                    if ent is not None:
+                        had_w = "w" in ent["caps"]
+                        ent["caps"] = msg.caps or ""
+                        if had_w and "w" not in ent["caps"]:
+                            self._set_writer(msg.ino, msg.client, False)
+                elif msg.op == "release":
+                    if ent is not None and "w" in ent["caps"]:
+                        self._set_writer(msg.ino, msg.client, False)
+                    holders.pop(msg.client, None)
+                self._caps_cond.notify_all()
+            return True
         if isinstance(msg, MClientRequest):
             sess = msg.session or msg.src
             with self._lock:
+                # track the session's live connection for cap revokes
+                if sess in self._sessions:
+                    self._session_conns[sess] = conn
                 cache = self._reply_cache.setdefault(sess, OrderedDict())
                 # LRU over SESSIONS too: clients that vanish without a
                 # request_close (crash, connection loss) must not leak
@@ -655,7 +929,9 @@ class MDSDaemon(Dispatcher):
                     rv, result = cache[msg.tid]
                 else:
                     try:
-                        rv, result = self._handle(msg.op, msg.args or {})
+                        rv, result = self._handle(
+                            msg.op, msg.args or {}, session=sess
+                        )
                     except Exception as e:  # op bug must not kill the daemon
                         self.cct.dout(
                             "mds", 0, f"mds op {msg.op} failed: {e!r}"

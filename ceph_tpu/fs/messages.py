@@ -40,3 +40,19 @@ class MClientReply(_JsonMessage):
 
     MSG_TYPE = 26  # CEPH_MSG_CLIENT_REPLY
     FIELDS = ("tid", "retval", "result")
+
+
+@register_message
+class MClientCaps(_JsonMessage):
+    """Capability traffic (reference: MClientCaps — CEPH_CAP_OP_GRANT /
+    REVOKE / FLUSH / FLUSHSNAP_ACK family).
+
+    op: "revoke" (MDS -> client: drop to `caps`, flush dirty state, ack)
+        | "flush" (client -> MDS: dirty size/mtime writeback + revoke ack)
+        | "release" (client -> MDS: closing, drop all caps on ino)
+    caps: remaining cap string ("rw", "r", "") — the Fw/Fb vs Fr/Fc
+    split collapses to w implies buffer, r implies cache.
+    `attrs` carries the flushed {size, mtime} on "flush"."""
+
+    MSG_TYPE = 23  # CEPH_MSG_CLIENT_CAPS
+    FIELDS = ("op", "client", "ino", "caps", "seq", "attrs")

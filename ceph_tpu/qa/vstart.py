@@ -243,8 +243,13 @@ class LocalCluster:
         from ..fs import MDSDaemon
 
         self._ensure_replicated_pools("cephfs_meta", "cephfs_data")
-        self.mds = MDSDaemon(self._cct("mds.0"), self.mon_addrs)
+        # restarts REBIND the previous address so surviving clients can
+        # reach the new incarnation (the mon's MDSMap would republish it
+        # upstream; here the addr is stable across failover instead)
+        self.mds = MDSDaemon(self._cct("mds.0"), self.mon_addrs,
+                             bind_addr=getattr(self, "_mds_addr", None))
         self.mds.start()
+        self._mds_addr = self.mds.addr
 
     def kill_mds(self) -> None:
         """Hard-stop the MDS *without* the shutdown flush — the journal

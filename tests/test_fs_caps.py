@@ -1,0 +1,156 @@
+"""CephFS client capabilities (reference: src/mds/Locker.cc issue/revoke,
+Capability.h, Client.cc cap handling + the SessionMap-backed reconnect
+phase).  Exclusive writers buffer size/mtime (one flush instead of a
+setattr per write); contention revokes; buffered attrs survive MDS
+failover via the reconnect flush."""
+import time
+
+import pytest
+
+from ceph_tpu.qa.vstart import LocalCluster
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with LocalCluster(n_mons=1, n_osds=3, with_mds=True) as c:
+        yield c
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return pred()
+
+
+def test_exclusive_writer_buffers_attrs(cluster):
+    fs = cluster.fs_client("client.cap-a")
+    try:
+        fh = fs.open("/buffered", create=True)
+        assert fs._caps_of(fh.ino) == "rw", "sole opener gets exclusive caps"
+        fh.write(b"chunk-one-")
+        fh.write(b"chunk-two", off=10)
+        # the MDS has NOT seen the size yet (attrs buffered under Fw/Fb)…
+        assert cluster.mds._inode_of(fh.ino)["size"] == 0
+        # …but the writing client's own stat sees it (served from caps)
+        assert fs.stat("/buffered")["size"] == 19
+        fh.close()
+        # close flushed: the MDS inode is current and caps are released
+        # (the release rides a one-way message — allow it to land)
+        assert cluster.mds._inode_of(fh.ino)["size"] == 19
+        assert _wait(lambda: cluster.mds.caps.get(fh.ino, {}) == {})
+    finally:
+        fs.unmount()
+
+
+def test_cross_client_open_revokes_and_flushes(cluster):
+    fs_a = cluster.fs_client("client.cap-w")
+    fs_b = cluster.fs_client("client.cap-r")
+    try:
+        fh = fs_a.open("/contended", create=True)
+        fh.write(b"writer payload")
+        assert cluster.mds._inode_of(fh.ino)["size"] == 0  # still buffered
+        # B's open recalls A's write cap -> A flushes -> B sees the bytes
+        assert fs_b.read_file("/contended") == b"writer payload"
+        assert fs_a._caps_of(fh.ino) == "r", "writer degraded by the recall"
+        # A keeps writing — now synchronously (no w cap)
+        fh.write(b"!", off=14)
+        assert cluster.mds._inode_of(fh.ino)["size"] == 15
+        fh.close()
+    finally:
+        fs_a.unmount()
+        fs_b.unmount()
+
+
+def test_two_writers_degrade_to_sync(cluster):
+    fs_a = cluster.fs_client("client.two-a")
+    fs_b = cluster.fs_client("client.two-b")
+    try:
+        fa = fs_a.open("/both", create=True)
+        fb = fs_b.open("/both")
+        # second rw opener forces MIX: nobody buffers
+        assert fs_b._caps_of(fb.ino) == ""
+        assert fs_a._caps_of(fa.ino) == ""
+        fa.write(b"AAAA")
+        fb.write(b"BB", off=4)
+        # both writes reached the MDS synchronously
+        assert cluster.mds._inode_of(fa.ino)["size"] == 6
+        assert fs_a.read_file("/both") == b"AAAABB"
+        fa.close()
+        fb.close()
+    finally:
+        fs_a.unmount()
+        fs_b.unmount()
+
+
+def test_reader_cache_invalidated_by_sync_writer(cluster):
+    fs_a = cluster.fs_client("client.inv-a")
+    fs_b = cluster.fs_client("client.inv-b")
+    try:
+        fs_a.write_file("/inval", b"12345")
+        fb = fs_b.open("/inval", want="r")
+        assert fs_b._caps_of(fb.ino) == "r"
+        assert fb.size() == 5
+        # A writes (sync path after B's read cap degraded it at open…):
+        fa = fs_a.open("/inval")
+        fa.write(b"6789", off=5)
+        fa.close()
+        # B's cached attrs were recalled by the setattr: next size() is
+        # fresh whether or not B still holds r
+        assert fb.size() == 9
+        assert fb.read() == b"123456789"
+        fb.close()
+    finally:
+        fs_a.unmount()
+        fs_b.unmount()
+
+
+@pytest.mark.slow
+def test_buffered_attrs_survive_mds_failover(cluster):
+    """The SessionMap reconnect window: a writer's buffered size must be
+    visible to other clients after an MDS crash+restart, delivered by
+    the client's reconnect flush."""
+    fs = cluster.fs_client("client.fo")
+    fh = fs.open("/failover", create=True)
+    fh.write(b"buffered across failover")
+    assert cluster.mds._inode_of(fh.ino)["size"] == 0
+    cluster.restart_mds()
+    try:
+        fs2 = cluster.fs_client("client.fo2")
+        # the new MDS blocks this stat until the writer's reconnect
+        # flush lands (or the window expires — which would fail this)
+        assert fs2.stat("/failover")["size"] == 24
+        assert fs2.read_file("/failover") == b"buffered across failover"
+        fs2.unmount()
+    finally:
+        fs.unmount()
+
+
+def test_dead_writer_evicted_at_reconnect_deadline(cluster):
+    """A writer that never comes back must not block readers forever:
+    the reconnect window expires and the MDS evicts it (buffered attrs
+    lost — the documented eviction cost)."""
+    conf = cluster._cct("mds.x").conf
+    fs = cluster.fs_client("client.dead")
+    fh = fs.open("/abandoned", create=True)
+    fh.write(b"never flushed")
+    ino = fh.ino
+    # simulate a client crash: kill its messenger so the reconnect
+    # flusher can never deliver
+    fs.messenger.shutdown()
+    cluster.restart_mds()
+    fs2 = cluster.fs_client("client.dead2")
+    try:
+        t0 = time.monotonic()
+        st = fs2.stat("/abandoned")
+        waited = time.monotonic() - t0
+        # served only after the reconnect deadline evicted the writer;
+        # the buffered size is gone (flushed size 0 = creation state)
+        assert st["size"] == 0
+        assert cluster.mds._reconnect == {}
+    finally:
+        fs2.unmount()
