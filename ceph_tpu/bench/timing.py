@@ -28,15 +28,23 @@ def make_chained_encode(coding: np.ndarray, kernel: str = "xla"):
         from ..ops.pallas_gf import (
             _apply_grouped,
             _kron_matrices,
+            _kron_matrices_blocked,
             _pick_group,
-            _pick_tile,
+            _pick_layout,
         )
 
         if rows > n:
             raise ValueError("chained pallas bench needs rows <= n")
         G = _pick_group(rows, n)
-        tile = _pick_tile(rows, n, G)  # VMEM-bounded (big decode matrices)
-        Bk, Pk = _kron_matrices(coding.tobytes(), coding.shape, G)
+        # VMEM-bounded layout: fat decode/repair matrices row-block
+        # instead of shrinking the tile (round-4 verdict item #4)
+        tile, rb = _pick_layout(rows, n, G)
+        if rb == 1:
+            Bk, Pk = _kron_matrices(coding.tobytes(), coding.shape, G)
+        else:
+            Bk, Pk, _rows_b = _kron_matrices_blocked(
+                coding.tobytes(), coding.shape, G, rb
+            )
         B = jnp.asarray(Bk)
         P = jnp.asarray(Pk, jnp.bfloat16)
         xor_rows = rows * G
@@ -52,7 +60,8 @@ def make_chained_encode(coding: np.ndarray, kernel: str = "xla"):
             return jnp.asarray(chunks.reshape(n * G, -1))
 
         def apply_fn(xg):
-            return _apply_grouped(B, P, xg, rows, n, G, tile, False)
+            out = _apply_grouped(B, P, xg, rows, n, G, tile, rb, False)
+            return out[:xor_rows]
 
     else:
         from ..ops.bitplane import _apply_bitmatrix, bitmatrix_device

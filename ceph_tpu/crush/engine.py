@@ -1,0 +1,279 @@
+"""TPU draw engine for the batched CRUSH mapper — gather-free, int64-free.
+
+Round-4 verdict item #2: the batched mapper lost to the scalar C++ oracle
+by 6.5x.  Profiling attributed the loss to exactly three TPU-hostile
+constructs in the XLA glue around the (fast) Pallas hash+ln kernel:
+
+  1. per-iteration row GATHERS (``jnp.take(cm.items, bidx)`` etc.) — TPUs
+     have no vector gather; XLA serializes at ~9 ns/element;
+  2. the int64 draw (``div64_s64(crush_ln(u) - 2^48, weight)``) — XLA
+     emulates 64-bit division in long scalar sequences and the whole
+     trace sits under an x64 scope;
+  3. int64 intermediates everywhere (weights, scores, argmax), doubling
+     vector-register pressure.
+
+This module replaces all three with MXU/VPU-native formulations:
+
+  - **One-hot fat-table gather**: every per-bucket array the choose loop
+    needs (item ids, magic-divisor limbs, shift/increment, size, type)
+    is decomposed host-side into 8-bit planes and concatenated into ONE
+    ``[n_idx, C]`` table; a bucket-row lookup is then a single bf16
+    one-hot matmul ``[T, n_idx] @ [n_idx, C]`` (bit-exact: every plane
+    value <= 255, which bf16 represents exactly) — the TPU-native gather,
+    same trick the Pallas ln kernel uses for its small tables.
+  - **Magic-divisor limb draw** (crush/magic_div.py, Granlund-Montgomery):
+    weights are map constants, so each divisor's exact magic ``(M, k, a)``
+    is precomputed on the host and the kernel-side draw is 16-bit limb
+    multiplies + a variable limb shift — all uint32 VPU lanes, no
+    division, no int64.  ``draw = -floor(p / w)`` with ``p = 2^48 -
+    crush_ln(u)``, so the reference's first-strict-max over draws becomes
+    a first-strict-min over 48-bit quotients, compared lexicographically
+    on (hi24, lo24) int32 planes.
+  - **is_out via plane lookup**: the reweight test needs only
+    ``min(w, 0x10000)`` (17 bits -> 3 planes) and a w==0 flag per OSD.
+
+The scalar Python mapper (reference_mapper.py), the C++ oracle, and both
+jax engines (this one and the int64 gather engine in batched.py) must
+agree bit-for-bit on every input — tests/test_crush_limb.py sweeps them
+against each other.  Reference seam: src/crush/mapper.c ::
+bucket_straw2_choose / is_out; SURVEY.md §3.3 HOT LOOP #3.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .magic_div import M_LIMBS, magic_tables
+
+I32_MAX = np.int32(0x7FFFFFFF)
+
+
+# --------------------------------------------------------------- fat table
+
+class LimbTables:
+    """Host-built 8-bit-plane tables for the one-hot gathers.
+
+    Layout of the per-bucket fat table ``bucket_tbl`` [n_idx, C]:
+      cols [0,      4S)   item ids, 4 planes (uint32 little-endian bytes)
+      cols [4S,    12S)   magic M limbs, M_LIMBS(4) x 2 planes each
+      cols [12S,   13S)   shift k - 48 (0..48, one plane)
+      cols [13S,   14S)   increment a (0/1) + (weight>0) flag packed as
+                          a | valid<<1
+      cols [14S, 14S+2)   bucket size lo/hi planes
+      cols [14S+2, 14S+4) bucket type lo/hi planes
+    """
+
+    def __init__(self, items: np.ndarray, weights: np.ndarray,
+                 sizes: np.ndarray, types: np.ndarray):
+        n_idx, S = items.shape
+        self.n_idx, self.S = n_idx, S
+        mg = magic_tables(weights)
+        m_limbs = mg["m_limbs"]          # [n_idx, S, 4] int32 16-bit limbs
+        ks = mg["k"] - 48                # [n_idx, S] in [0, 48]
+        aa = mg["a"]                     # [n_idx, S] 0/1
+        valid = (weights > 0).astype(np.int32)
+        iu = items.astype(np.uint32)
+        planes = []
+        for b in range(4):
+            planes.append(((iu >> (8 * b)) & 0xFF).astype(np.float32))
+        for limb in range(M_LIMBS):
+            v = m_limbs[:, :, limb]
+            planes.append((v & 0xFF).astype(np.float32))
+            planes.append(((v >> 8) & 0xFF).astype(np.float32))
+        planes.append(ks.astype(np.float32))
+        planes.append((aa | (valid << 1)).astype(np.float32))
+        tbl = np.concatenate(planes, axis=1)          # [n_idx, 14*S]
+        meta = np.stack([
+            sizes & 0xFF, (sizes >> 8) & 0xFF,
+            types & 0xFF, (types >> 8) & 0xFF,
+        ], axis=1).astype(np.float32)                 # [n_idx, 4]
+        self.tbl = jnp.asarray(np.concatenate([tbl, meta], axis=1),
+                               jnp.bfloat16)
+        if np.any(tbl > 255) or np.any(tbl < 0):
+            raise AssertionError("fat-table plane out of 8-bit range")
+
+    def split(self, rows: jnp.ndarray):
+        """Decode a gathered [T, C] f32 row block back into int32 arrays:
+        (items [T,S], m_limbs 4x[T,S], k_s [T,S], a [T,S], valid [T,S],
+        size [T], btype [T])."""
+        S = self.S
+        r = rows.astype(jnp.int32)
+        it = (r[:, 0:S]
+              | (r[:, S:2 * S] << 8)
+              | (r[:, 2 * S:3 * S] << 16)
+              | (r[:, 3 * S:4 * S] << 24))
+        m = []
+        for limb in range(M_LIMBS):
+            lo = r[:, (4 + 2 * limb) * S:(5 + 2 * limb) * S]
+            hi = r[:, (5 + 2 * limb) * S:(6 + 2 * limb) * S]
+            m.append(lo | (hi << 8))
+        k_s = r[:, 12 * S:13 * S]
+        av = r[:, 13 * S:14 * S]
+        a = av & 1
+        valid = (av >> 1) & 1
+        size = r[:, 14 * S] | (r[:, 14 * S + 1] << 8)
+        btype = r[:, 14 * S + 2] | (r[:, 14 * S + 3] << 8)
+        return it, m, k_s, a, valid, size, btype
+
+
+def build_weightvec_planes(weightvec: jnp.ndarray) -> jnp.ndarray:
+    """[n_osd] int32/int64 reweights -> [n_osd, 4] bf16 planes of
+    wc = min(w, 0x10000) (3 bytes) + (w == 0) flag.  Runs inside the jit
+    (reweights are per-call data, unlike the map constants)."""
+    w = jnp.clip(weightvec.astype(jnp.int32), 0, 0x10000)
+    zero = (weightvec.astype(jnp.int32) == 0).astype(jnp.int32)
+    return jnp.stack(
+        [w & 0xFF, (w >> 8) & 0xFF, (w >> 16) & 0xFF, zero], axis=1
+    ).astype(jnp.bfloat16)
+
+
+def onehot_rows(idx: jnp.ndarray, tbl: jnp.ndarray) -> jnp.ndarray:
+    """[T] int32 indices -> [T, C] f32 rows of the bf16 table via the
+    one-hot MXU matmul (exact for 8-bit plane values)."""
+    n = tbl.shape[0]
+    oh = (
+        idx[:, None] == jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
+    ).astype(jnp.bfloat16)
+    return jax.lax.dot_general(
+        oh, tbl,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+# ------------------------------------------------------------ limb pipeline
+
+def quotient_planes(hi, lo, m_limbs, k_s, a):
+    """(q_hi24, q_lo24) int32 planes of q = floor(p / w) where
+    p = 2^48 - (hi<<24 | lo) and the divisor is encoded as magic limbs.
+
+    Mirrors magic_div.straw2_draw_q_np limb-for-limb (same widths, same
+    carry points) in uint32 lanes; hi/lo are the Pallas score kernel's
+    crush_ln output planes (bits 24..47 / 0..23).
+    """
+    u = lambda x: x.astype(jnp.uint32)
+    MASK16 = jnp.uint32(0xFFFF)
+    MASK24 = jnp.uint32(0xFFFFFF)
+    # p + a = 2^48 - (hi<<24|lo) + a via 24-bit borrow arithmetic
+    t0 = (MASK24 - u(lo)) + jnp.uint32(1) + u(a)
+    p_lo = t0 & MASK24
+    c0 = t0 >> 24
+    t1 = (MASK24 - u(hi)) + c0
+    p_hi = t1 & MASK24
+    l3 = t1 >> 24                      # 0 or 1 (p == 2^48)
+    # 16-bit limbs of p
+    pl = [
+        p_lo & MASK16,
+        (p_lo >> 16) | ((p_hi & jnp.uint32(0xFF)) << 8),
+        (p_hi >> 8) & MASK16,
+        l3,
+    ]
+    ml = [u(m) for m in m_limbs]
+    # column accumulation of 16x16 partial products, split lo/hi to keep
+    # every accumulator far below 2^32
+    ncols = 8
+    cols = [jnp.zeros_like(pl[0]) for _ in range(ncols + 1)]
+    for i in range(4):
+        for j in range(4):
+            prod = pl[i] * ml[j]
+            cols[i + j] = cols[i + j] + (prod & MASK16)
+            cols[i + j + 1] = cols[i + j + 1] + (prod >> 16)
+    limbs = []
+    carry = jnp.zeros_like(pl[0])
+    for c in range(ncols + 1):
+        v = cols[c] + carry
+        limbs.append(v & MASK16)
+        carry = v >> 16
+    # q = product >> k, k = 48 + k_s with k_s in [0, 48]: take limbs 3..
+    # and shift by k_s.  h[i] = limb[3 + i]; indices up to 6 needed.
+    h = limbs[3:8] + [jnp.zeros_like(pl[0])]
+    ks = u(k_s)
+    si = (ks >> 4).astype(jnp.int32)          # 0..3
+    sr = ks & jnp.uint32(0xF)
+
+    def pick(base):
+        """h[base + si] with si in 0..3, vector select."""
+        v = h[base]
+        for s in (1, 2, 3):
+            v = jnp.where(si == s, h[base + s] if base + s < len(h)
+                          else jnp.zeros_like(v), v)
+        return v
+
+    def shifted(j):
+        lo_l = pick(j)
+        hi_l = pick(j + 1)
+        # sr == 0 edge: (hi << 16) & 0xFFFF == 0, so the OR is exact
+        return ((lo_l >> sr) | ((hi_l << (jnp.uint32(16) - sr)) & MASK16)) \
+            & MASK16
+
+    q0, q1, q2 = shifted(0), shifted(1), shifted(2)
+    q_lo24 = (q0 | (q1 << 16)) & MASK24
+    q_hi24 = ((q1 >> 8) | (q2 << 8)) & MASK24
+    return q_hi24.astype(jnp.int32), q_lo24.astype(jnp.int32)
+
+
+def argmin_planes(q_hi, q_lo, invalid):
+    """First index of the lexicographic minimum over axis 1 of the
+    (hi24, lo24) planes; `invalid` slots are +inf.  Matches mapper.c's
+    first-strict-max scan over draws (draw = -q)."""
+    q_hi = jnp.where(invalid, I32_MAX, q_hi)
+    q_lo = jnp.where(invalid, I32_MAX, q_lo)
+    mh = jnp.min(q_hi, axis=1, keepdims=True)
+    cand = q_hi == mh
+    q_lo_m = jnp.where(cand, q_lo, I32_MAX)
+    ml = jnp.min(q_lo_m, axis=1, keepdims=True)
+    first = cand & (q_lo_m == ml)
+    return jnp.argmax(first, axis=1).astype(jnp.int32)
+
+
+# ------------------------------------------------------------ choose pieces
+
+def straw2_choose_limb(cm, score_fn, bucket_idx, x, r, cweights, position):
+    """bucket_straw2_choose over lanes — limb-engine twin of
+    batched.straw2_choose_b.  Identical output contract: [B] chosen item
+    (ITEM_NONE for empty buckets)."""
+    from .types import ITEM_NONE
+
+    bidx = jnp.clip(bucket_idx, 0, cm.n_idx - 1)
+    if cweights is None:
+        tabs = cm.limb_tables
+        rows = onehot_rows(bidx, tabs.tbl)
+        items, m_limbs, k_s, a, valid, size, _bt = tabs.split(rows)
+    else:
+        tabs = cweights  # a LimbTables over [P * n_idx] flattened rows
+        pos = jnp.minimum(position, tabs.positions - 1)
+        rows = onehot_rows(pos * cm.n_idx + bidx, tabs.tbl)
+        items, m_limbs, k_s, a, valid, size, _bt = tabs.split(rows)
+    hi, lo = score_fn(cm, x, items, r)            # int32 ln planes
+    q_hi, q_lo = quotient_planes(hi, lo, m_limbs, k_s, a)
+    slot = jnp.arange(items.shape[1])[None, :]
+    invalid = (slot >= size[:, None]) | (valid == 0)
+    choice = argmin_planes(q_hi, q_lo, invalid)
+    picked = jnp.take_along_axis(items, choice[:, None], axis=1)[:, 0]
+    return jnp.where(size > 0, picked, ITEM_NONE)
+
+
+def item_type_limb(cm, item):
+    """Type of each item via the fat table's meta columns (devices 0)."""
+    idx = jnp.clip(jnp.where(item < 0, -1 - item, 0), 0, cm.n_idx - 1)
+    rows = onehot_rows(idx, cm.limb_tables.tbl)
+    *_rest, btype = cm.limb_tables.split(rows)
+    return jnp.where(item < 0, btype, 0)
+
+
+def is_out_limb(wplanes, n_osd, item, x):
+    """mapper.c :: is_out over lanes, weightvec via plane lookup.
+    `wplanes` from build_weightvec_planes; `item` device ids."""
+    from .hash import crush_hash32_2
+
+    idx = jnp.clip(item, 0, n_osd - 1)
+    rows = onehot_rows(idx, wplanes).astype(jnp.int32)   # [T, 4]
+    wc = rows[:, 0] | (rows[:, 1] << 8) | (rows[:, 2] << 16)
+    is_zero = rows[:, 3] == 1
+    oob = item >= n_osd
+    h = (
+        crush_hash32_2(x.astype(jnp.uint32), item.astype(jnp.uint32))
+        .astype(jnp.int32) & 0xFFFF
+    )
+    return oob | is_zero | ((wc < 0x10000) & (h >= wc))

@@ -43,8 +43,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from .batched import (
+    I64Engine,
+    LimbEngine,
     choose_firstn_b,
     choose_indep_b,
+    ln_planes_jnp,
+    ln_planes_pallas,
     ln_scores_jnp,
     ln_scores_pallas,
 )
@@ -147,10 +151,58 @@ class CompiledCrushMap:
             self.sizes = jnp.asarray(sizes)
             self.types = jnp.asarray(types)
             self.ln_table = jnp.asarray(CRUSH_LN_TABLE)
+        # int32 plane tables for the limb engine (no x64 anywhere)
+        self.ln_hi_table = jnp.asarray(
+            (CRUSH_LN_TABLE >> 24).astype(np.int32))
+        self.ln_lo_table = jnp.asarray(
+            (CRUSH_LN_TABLE & 0xFFFFFF).astype(np.int32))
+        self._np_items = items
+        self._np_weights = weights
+        self._np_sizes = sizes
+        self._np_types = types
         self.n_idx = n_idx
         self.max_size = max_size
+        self._limb_tables = None
         self._choose_args_cache: dict[str, jnp.ndarray] = {}
+        self._choose_args_limb_cache: dict = {}
         self._rule_fn_cache: dict = {}
+
+    @property
+    def limb_tables(self):
+        """Lazy fat-table build for the TPU limb engine (crush/engine.py)
+        — magic divisors + 8-bit gather planes, host-side once per map."""
+        if self._limb_tables is None:
+            from .engine import LimbTables
+
+            self._limb_tables = LimbTables(
+                self._np_items, self._np_weights,
+                self._np_sizes, self._np_types,
+            )
+        return self._limb_tables
+
+    def choose_args_limb(self, name: str):
+        """LimbTables over [P * n_idx] rows for a named choose_args
+        weight-set (limb-engine twin of choose_args_arrays)."""
+        cached = self._choose_args_limb_cache.get(name)
+        if cached is not None:
+            return cached
+        from .engine import LimbTables
+
+        validate_choose_args(self.cmap, name)
+        dense = np.asarray(self.choose_args_arrays(name))  # [P, n_idx, S]
+        P = dense.shape[0]
+        tiled = lambda a: np.tile(a, (P,) + (1,) * (a.ndim - 1)).reshape(
+            (P * a.shape[0],) + a.shape[1:]
+        )
+        tabs = LimbTables(
+            tiled(self._np_items),
+            dense.reshape(P * self.n_idx, -1),
+            tiled(self._np_sizes),
+            tiled(self._np_types),
+        )
+        tabs.positions = P
+        self._choose_args_limb_cache[name] = tabs
+        return tabs
 
     def choose_args_arrays(self, name: str) -> jnp.ndarray:
         """Dense [positions, n_idx, max_size] weight array for a named
@@ -244,14 +296,21 @@ def _firstn_compact(work: jnp.ndarray) -> jnp.ndarray:
 
 
 def _build_rule_fn(cm: CompiledCrushMap, rule_id: int, numrep: int,
-                   choose_args: str | None, score_fn):
+                   choose_args: str | None, engine_mode: str, score_fn):
     plan = compile_plan(cm, rule_id, numrep)
-    cweights = (
-        cm.choose_args_arrays(choose_args) if choose_args is not None else None
-    )
+    if choose_args is None:
+        cweights = None
+    elif engine_mode == "limb":
+        cweights = cm.choose_args_limb(choose_args)
+    else:
+        cweights = cm.choose_args_arrays(choose_args)
+    engine_cls = LimbEngine if engine_mode == "limb" else I64Engine
+    if engine_mode == "limb":
+        cm.limb_tables  # build the fat tables OUTSIDE the trace
 
     def fn(xs, weightvec):
         N = xs.shape[0]
+        eng = engine_cls(cm, score_fn, weightvec, cweights)
         work = None          # [N, W] current working vector
         emitted = []         # list of [N, w] blocks
         for p in plan:
@@ -273,8 +332,8 @@ def _build_rule_fn(cm: CompiledCrushMap, rule_id: int, numrep: int,
                     else (p["leaf_tries"] or 1)
                 )
                 res = fn_b(
-                    cm, score_fn, weightvec, x_b, parents, want, p["type"],
-                    tries, p["recurse"], recurse_tries, cweights, parent_ok,
+                    eng, x_b, parents, want, p["type"],
+                    tries, p["recurse"], recurse_tries, parent_ok,
                 )
                 out, out2 = res[0], res[1]
                 chosen = out2 if p["recurse"] else out
@@ -325,30 +384,41 @@ def _build_rule_fn(cm: CompiledCrushMap, rule_id: int, numrep: int,
     return jax.jit(fn), max_width
 
 
-def default_score_fn():
-    """Pick the straw2 ln path: the fused Pallas hash+ln kernel on TPU (no
-    hardware vector gather — the 2^16-entry table gather serializes
-    there), the XLA table gather elsewhere.
+def default_engine_config() -> tuple[str, object, bool]:
+    """(engine_mode, score_fn, uses_pallas) for the current backend/env.
 
-    CEPH_TPU_CRUSH_SCORE overrides: "pallas" / "gather" force a path (for
-    platforms whose TPU alias isn't recognized, or benchmarking); default
-    "auto" detects by backend name ('axon' is a tunneled-TPU alias)."""
+    Engine (CEPH_TPU_CRUSH_ENGINE = auto|limb|i64): the LIMB engine
+    (crush/engine.py — one-hot fat-table gathers + magic-divisor limb
+    draws, no int64/x64) on TPU backends; the I64 gather engine (native
+    64-bit divides, fast row gathers) on CPU.
+
+    Score path (CEPH_TPU_CRUSH_SCORE = auto|pallas|gather): the fused
+    Pallas hash+ln kernel on TPU (no hardware vector gather — the
+    2^16-entry table gather serializes there), the XLA table gather
+    elsewhere ('axon' is this box's tunneled-TPU alias)."""
     import os
 
-    mode = os.environ.get("CEPH_TPU_CRUSH_SCORE", "auto")
-    if mode == "pallas":
-        return ln_scores_pallas
-    if mode == "gather":
-        return ln_scores_jnp
-    if mode != "auto":
+    emode = os.environ.get("CEPH_TPU_CRUSH_ENGINE", "auto")
+    if emode not in ("auto", "limb", "i64"):
+        raise ValueError(
+            f"CEPH_TPU_CRUSH_ENGINE={emode!r}: want auto|limb|i64"
+        )
+    smode = os.environ.get("CEPH_TPU_CRUSH_SCORE", "auto")
+    if smode not in ("auto", "pallas", "gather"):
         # a typo'd override silently auto-detecting would defeat its
         # purpose (forcing Pallas on unrecognized TPU aliases)
         raise ValueError(
-            f"CEPH_TPU_CRUSH_SCORE={mode!r}: want auto|pallas|gather"
+            f"CEPH_TPU_CRUSH_SCORE={smode!r}: want auto|pallas|gather"
         )
-    if jax.default_backend() in ("tpu", "axon"):
-        return ln_scores_pallas
-    return ln_scores_jnp
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if emode == "auto":
+        emode = "limb" if on_tpu else "i64"
+    use_pallas = smode == "pallas" or (smode == "auto" and on_tpu)
+    if emode == "limb":
+        score = ln_planes_pallas if use_pallas else ln_planes_jnp
+    else:
+        score = ln_scores_pallas if use_pallas else ln_scores_jnp
+    return emode, score, use_pallas
 
 
 def crush_do_rule_batch(
@@ -384,13 +454,14 @@ def crush_do_rule_batch(
             np.asarray(weightvec), choose_args, cm=cm,
         )
         return jnp.asarray(out)
-    key = (rule_id, numrep, choose_args)
+    engine_mode, score_fn, uses_pallas = default_engine_config()
+    key = (rule_id, numrep, choose_args, engine_mode, uses_pallas)
 
     def build_and_cache():
-        with enable_x64():
-            built = _build_rule_fn(
-                cm, rule_id, numrep, choose_args, default_score_fn()
-            )
+        emode, score, _ = default_engine_config()
+        built = _build_rule_fn(
+            cm, rule_id, numrep, choose_args, emode, score
+        ) + (emode,)
         cm._rule_fn_cache[key] = built
         return built
 
@@ -414,22 +485,47 @@ def crush_do_rule_batch(
             # the tile can only be implicated when the Pallas scorer is
             # the active path; on gather/CPU hosts the error is someone
             # else's and a rebuild would just repeat it slower
-            or default_score_fn() is not ln_scores_pallas
+            or not uses_pallas
         ):
             raise
         import sys
 
-        # the downshift mutates module-global DEFAULT_TILE; serialize so
+        # the downshift mutates module-global shape knobs; serialize so
         # concurrent callers can't observe a half-applied downshift or
-        # cache rule fns built against a tile mid-restore
+        # cache rule fns built against a shape mid-restore
+        shape0 = (pallas_crush.LOOP_SLABS, pallas_crush.DEFAULT_TILE)
         with _TILE_LOCK:
-            if pallas_crush.DEFAULT_TILE == pallas_crush.CHUNK:
-                # another thread downshifted while we waited — rebuild
-                # against the settled tile and retry once
+            if (pallas_crush.LOOP_SLABS,
+                    pallas_crush.DEFAULT_TILE) != shape0:
+                # another thread settled a different shape while we
+                # waited (our failure is stale evidence against the NEW
+                # shape) — rebuild against it and retry once before
+                # touching the knobs ourselves
                 return _launch_rule_fn(
                     cm, build_and_cache(), xs, numrep, weightvec
                 )
+            if pallas_crush.LOOP_SLABS:
+                # step 1: maybe the fori_loop/pl.ds walk is what Mosaic
+                # rejected — restore the r4-proven static unroll at the
+                # proven tile, keep going from there on the next failure
+                print(
+                    f"# crush straw2 loop-slab kernel failed "
+                    f"({type(e).__name__}); retrying with the static "
+                    f"unroll at tile 256", file=sys.stderr,
+                )
+                pallas_crush.LOOP_SLABS = False
+                pallas_crush.DEFAULT_TILE = min(
+                    pallas_crush.DEFAULT_TILE, 256
+                )
+                try:
+                    return _launch_rule_fn(
+                        cm, build_and_cache(), xs, numrep, weightvec
+                    )
+                except Exception as e2:
+                    e = e2  # fall through to the tile downshift
             orig_tile = pallas_crush.DEFAULT_TILE
+            if orig_tile == pallas_crush.CHUNK:
+                raise
             print(
                 f"# crush straw2 tile {orig_tile} failed "
                 f"({type(e).__name__}); retrying with tile "
@@ -449,11 +545,24 @@ def crush_do_rule_batch(
 
 
 def _launch_rule_fn(cm, cached, xs, numrep, weightvec) -> jnp.ndarray:
-    vf, max_width = cached
+    import contextlib
 
-    with enable_x64():
+    vf, max_width, engine_mode = cached
+
+    # the limb engine traces WITHOUT x64 (its whole point); weightvec
+    # semantics survive the int32 clamp because is_out only compares
+    # weights below 0x10000 (values above mean "always in")
+    ctx = enable_x64() if engine_mode != "limb" else contextlib.nullcontext()
+    with ctx:
         xs_np = np.asarray(xs, dtype=np.int32)
-        weightvec = jnp.asarray(weightvec, dtype=jnp.int64)
+        if engine_mode == "limb":
+            weightvec = jnp.asarray(
+                np.minimum(
+                    np.asarray(weightvec, dtype=np.int64), 0x10000
+                ).astype(np.int32)
+            )
+        else:
+            weightvec = jnp.asarray(weightvec, dtype=jnp.int64)
         N = xs_np.shape[0]
         # chunk by LANES (N x max step width), not raw N: a multi-choose
         # step fans each x out to its working-vector width

@@ -893,7 +893,23 @@ class MDSDaemon(Dispatcher):
                         if not pend:
                             self._reconnect.pop(msg.ino, None)
                         self._persist_writers()
-                    if ent is not None:
+                    # seq gate (advisor r4): the downgrade half of a flush
+                    # only applies when it acks the CURRENT revoke — a
+                    # delayed ack from an earlier revoke (e.g. after the
+                    # 5s force-drop and a subsequent re-grant) must not
+                    # clobber the newer grant and silently strip a writer
+                    # that still buffers.  The attr flush above always
+                    # applies (flushes are absolute-valued).  seq == 0 is
+                    # NOT an ack: it is the client's reconnect flush
+                    # (client.py _reconnect_flush), whose unconditional
+                    # cap drop must keep working.  Reference:
+                    # Locker::handle_client_caps drops stale-seq cap acks.
+                    stale = (
+                        ent is not None
+                        and msg.seq is not None
+                        and 0 < msg.seq < ent.get("seq", 0)
+                    )
+                    if ent is not None and not stale:
                         had_w = "w" in ent["caps"]
                         ent["caps"] = msg.caps or ""
                         if had_w and "w" not in ent["caps"]:

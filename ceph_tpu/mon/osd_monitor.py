@@ -451,6 +451,15 @@ class OSDMonitor:
         elif sub == "set-overlay":
             if tierpool.tier_of != pool.pool_id:
                 return -22, f"pool {tierpool.name!r} is not a tier of {pool.name!r}"
+            if tierpool.cache_mode == "none":
+                # mirror of the cache-mode-none guard above (advisor r4):
+                # an overlay onto a mode-none tier redirects all base I/O
+                # to a cache whose OSD front-end is disabled — reads of
+                # non-cached objects 404 and writes land tier-less
+                return -16, (
+                    f"pool {tierpool.name!r} has cache-mode none; set "
+                    f"cache-mode first"
+                )
             pool.read_tier = pool.write_tier = tierpool.pool_id
             result = f"overlay for {pool.name!r} is now {tierpool.name!r}"
         elif sub == "remove-overlay":

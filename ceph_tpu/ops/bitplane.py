@@ -23,6 +23,8 @@ identical to the C++ oracle (native/gf_oracle.cc).
 """
 from __future__ import annotations
 
+import os
+import sys
 from functools import lru_cache, partial
 
 import jax
@@ -61,8 +63,9 @@ def _apply_bitmatrix(B: jnp.ndarray, chunks: jnp.ndarray) -> jnp.ndarray:
     return pack_bitplanes((acc & 1).astype(jnp.uint8))
 
 
-def apply_matrix_jax(mat: np.ndarray, chunks) -> jnp.ndarray:
-    """GF(2^8) matrix (rows x n, uint8 elements) applied to byte chunks on TPU.
+def apply_matrix_xla(mat: np.ndarray, chunks) -> jnp.ndarray:
+    """GF(2^8) matrix (rows x n, uint8 elements) applied to byte chunks via
+    the XLA bitplane matmul (bitplanes round-trip through HBM).
 
     Byte-wise GF semantics identical to the oracle's gfo_apply (ISA-L
     convention) for every technique.
@@ -70,6 +73,62 @@ def apply_matrix_jax(mat: np.ndarray, chunks) -> jnp.ndarray:
     B = bitmatrix_device(np.asarray(mat, dtype=np.uint8).tobytes(), mat.shape)
     chunks = jnp.asarray(chunks, dtype=jnp.uint8)
     return _apply_bitmatrix(B, chunks)
+
+
+# One-shot latch: a Mosaic/silicon failure in auto mode must not be
+# retried (and re-fail) on every subsequent op in the process.
+_pallas_broken: Exception | None = None
+
+
+def _want_pallas() -> bool:
+    """Kernel dispatch policy (round-4 verdict item #3: the production
+    registry -> codec path must reach the fused Pallas kernel on TPU).
+
+    CEPH_TPU_EC_KERNEL: "pallas" / "xla" force a path; default "auto"
+    picks the fused kernel on TPU backends ('axon' is this box's
+    tunneled-TPU alias) and the XLA gather-free bitplane path elsewhere.
+    """
+    mode = os.environ.get("CEPH_TPU_EC_KERNEL", "auto")
+    if mode == "pallas":
+        return True
+    if mode == "xla":
+        return False
+    if mode != "auto":
+        raise ValueError(
+            f"CEPH_TPU_EC_KERNEL={mode!r}: want auto|pallas|xla"
+        )
+    return _pallas_broken is None and jax.default_backend() in ("tpu", "axon")
+
+
+def apply_matrix_jax(mat: np.ndarray, chunks) -> jnp.ndarray:
+    """GF(2^8) matrix apply with kernel dispatch: the fused Pallas VMEM
+    kernel on TPU (ops/pallas_gf.py), the XLA bitplane path elsewhere.
+
+    This is the single entry every production codec (rs/shec/clay plugin
+    encode/decode/repair) goes through, so `plugin=jax` via the registry
+    runs the same kernel the headline bench measures.  In auto mode a
+    Pallas failure latches a process-wide XLA fallback (resilience for
+    the OSD data path); a forced CEPH_TPU_EC_KERNEL=pallas fails loudly.
+    """
+    global _pallas_broken
+    if _want_pallas():
+        from .pallas_gf import apply_matrix_pallas
+
+        forced = os.environ.get("CEPH_TPU_EC_KERNEL") == "pallas"
+        try:
+            return apply_matrix_pallas(
+                mat, chunks, interpret=jax.default_backend() == "cpu"
+            )
+        except Exception as e:
+            if forced:
+                raise
+            _pallas_broken = e
+            print(
+                f"# ceph_tpu: Pallas GF kernel failed "
+                f"({type(e).__name__}: {e}); latching XLA fallback",
+                file=sys.stderr,
+            )
+    return apply_matrix_xla(mat, chunks)
 
 
 @lru_cache(maxsize=256)
@@ -84,7 +143,13 @@ def xor_bitmatrix_device(b_bytes: bytes, shape: tuple[int, int]) -> jnp.ndarray:
 
 def apply_xor_matrix_jax(B: np.ndarray, rows) -> jnp.ndarray:
     """[R, N] 0/1 matrix XOR-combining [N, L] byte rows -> [R, L], on
-    device through the same MXU bitplane matmul as the GF(2^8) path."""
+    device through the same MXU bitplane matmul as the GF(2^8) path.
+
+    On TPU this dispatches through apply_matrix_jax: a 0/1 matrix IS a
+    GF(2^8) matrix (multiply-by-1 expands to the identity bitmatrix), so
+    the fused Pallas kernel serves the XOR codes unchanged."""
+    if _want_pallas():
+        return apply_matrix_jax(np.ascontiguousarray(B, dtype=np.uint8), rows)
     Bd = xor_bitmatrix_device(
         np.ascontiguousarray(B, dtype=np.uint8).tobytes(), B.shape
     )

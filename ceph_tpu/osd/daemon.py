@@ -1270,6 +1270,19 @@ class OSD(Dispatcher):
             )
         updates = msg.data or {}
         pool = self.osdmap.pools.get(pg.pool_id)
+        # user-xattr content flushes to the base pool: a cache-pool user
+        # setxattr re-dirties the object atomically (merged into the SAME
+        # update set / sub-ops) and stamps `ver` so the flush's version
+        # recheck also sees xattr-only mutations.  Tier-marker updates
+        # (tier.*) are the dirty-tracking machinery itself and must not
+        # self-trigger.
+        user_mutation = any(not n.startswith("tier.") for n in updates)
+        stamp_ver = False
+        if (user_mutation and self._tier_autoclean(pool, msg.oid)
+                and "tier.clean" not in updates):
+            updates = dict(updates)
+            updates["tier.clean"] = None
+            stamp_ver = True
         with pg.lock:
             try:
                 self.store.stat(cid, msg.oid)
@@ -1307,6 +1320,8 @@ class OSD(Dispatcher):
                     tids.pop(tid, None)
             t = Transaction()
             self._apply_xattr_updates(t, cid, msg.oid, updates)
+            if stamp_ver:
+                t.setattr(cid, msg.oid, "ver", str(version).encode())
             self._log_txn(t, cid, pg, entry)
             self.store.queue_transaction(t)
             a, deposed, _f = self._collect_subop_acks(tids)
@@ -2135,6 +2150,12 @@ class OSD(Dispatcher):
                 return self._replicated_op(pg, pool, acting, msg)
         if msg.op == "write_full":
             data = unpack_data(msg.data) or b""
+            # cache-tier pools: the clean-marker clear must ride THIS
+            # mutation's transaction + sub-ops, not a separate staging
+            # check (advisor r4 — the separate check races the flush's
+            # clean-mark and an evict then drops the only copy)
+            autoclean = self._tier_autoclean(pool, msg.oid)
+            rmattrs = ["tier.clean"] if autoclean else None
             with pg.lock:
                 version = pg.version + 1
                 entry = LogEntry(version, "modify", msg.oid,
@@ -2153,6 +2174,7 @@ class OSD(Dispatcher):
                                 version=version,
                                 entry=entry.to_list(),
                                 epoch=self.my_epoch(), osize=len(data),
+                                rmattrs=rmattrs,
                             )
                         )
                     except (OSError, ConnectionError):
@@ -2166,6 +2188,8 @@ class OSD(Dispatcher):
                 t.setattr(cid, msg.oid, "hinfo", str(crc32c(data)).encode())
                 t.setattr(cid, msg.oid, "size", str(len(data)).encode())
                 t.setattr(cid, msg.oid, "ver", str(version).encode())
+                if autoclean:
+                    self._txn_clear_clean(t, cid, msg.oid)
                 self._log_txn(t, cid, pg, entry)
                 self.store.queue_transaction(t)
                 a, deposed, _f = self._collect_subop_acks(tids)
@@ -2293,6 +2317,9 @@ class OSD(Dispatcher):
             return MOSDOpReply(tid=msg.tid, retval=-22,
                                epoch=self.my_epoch(),
                                result=f"bad op {msg.op}")
+        # omap content flushes to the base pool too: the clean clear must
+        # be atomic with the mutation exactly like the data path
+        autoclean = self._tier_autoclean(pool, msg.oid)
         with pg.lock:
             version = pg.version + 1
             entry = LogEntry(version, "modify", msg.oid,
@@ -2309,6 +2336,7 @@ class OSD(Dispatcher):
                         data=None, crc=None, version=version,
                         entry=entry.to_list(), epoch=self.my_epoch(),
                         omap=omap_payload,
+                        rmattrs=["tier.clean"] if autoclean else None,
                     ))
                 except (OSError, ConnectionError):
                     tids.pop(tid, None)
@@ -2320,6 +2348,8 @@ class OSD(Dispatcher):
             # verification counts shards holding ver >= v (replicated
             # pools never generation-filter reads, so this is safe)
             t.setattr(cid, msg.oid, "ver", str(version).encode())
+            if autoclean:
+                self._txn_clear_clean(t, cid, msg.oid)
             self._log_txn(t, cid, pg, entry)
             self.store.queue_transaction(t)
             a, deposed, _f = self._collect_subop_acks(tids)
@@ -2418,6 +2448,7 @@ class OSD(Dispatcher):
             version = pg.version + 1
             entry = LogEntry(version, "modify", msg.oid,
                              reqid=getattr(msg, "reqid", None))
+            autoclean = self._tier_autoclean(pool, msg.oid)
             tids: dict[int, int] = {}
             for shard, osd in enumerate(acting):
                 if osd == self.id or osd < 0 or not self.osdmap.is_up(osd):
@@ -2430,6 +2461,7 @@ class OSD(Dispatcher):
                         data=wire_data, crc=crc, osize=osize,
                         version=version, entry=entry.to_list(),
                         epoch=self.my_epoch(), omap=omap_payload,
+                        rmattrs=["tier.clean"] if autoclean else None,
                     ))
                 except (OSError, ConnectionError):
                     tids.pop(tid, None)
@@ -2446,6 +2478,8 @@ class OSD(Dispatcher):
             if omap_payload is not None:
                 self._apply_omap(t, cid, msg.oid, omap_payload)
             t.setattr(cid, msg.oid, "ver", str(version).encode())
+            if autoclean:
+                self._txn_clear_clean(t, cid, msg.oid)
             self._log_txn(t, cid, pg, entry)
             self.store.queue_transaction(t)
             a, deposed, _f = self._collect_subop_acks(tids)
@@ -2615,6 +2649,26 @@ class OSD(Dispatcher):
             raise OSError(f"tier op {op} {oid!r}: no reply")
         return rep
 
+    def _tier_autoclean(self, pool, oid: str) -> bool:
+        """True when a mutation of `oid` must clear the tier.clean marker
+        ATOMICALLY with its data op (advisor r4: a clean-flag check in the
+        staging path races the flush's clean-mark — only a clear inside
+        the mutation's own pg.lock transaction closes the window where
+        dirty data gets labeled clean and evicted)."""
+        if pool is None or pool.tier_of < 0 or pool.cache_mode == "none":
+            return False
+        return bool(oid) and CLONE_SEP not in oid and \
+            not oid.startswith(("_", ":pg:"))
+
+    def _txn_clear_clean(self, t: Transaction, cid: str, oid: str) -> None:
+        """Append the primary-local tier.clean removal to a mutation's
+        transaction (the replicas get theirs via the sub-op `rmattrs`)."""
+        try:
+            if "u_tier.clean" in self.store.getattrs(cid, oid):
+                t.rmattr(cid, oid, "u_tier.clean")
+        except (NotFound, KeyError):
+            pass
+
     def _tier_flag(self, pg, oid: str, flag: str) -> bool:
         cid = self._cid(pg.pgid, 0)
         try:
@@ -2632,9 +2686,13 @@ class OSD(Dispatcher):
             epoch=self.my_epoch(),
         ))
 
-    def _cache_tier_op(self, pg, pool, acting, ps, msg):
+    def _cache_tier_op(self, pg, pool, acting, ps, msg, _depth: int = 0):
         """Cache-pool front-end.  Returns a final MOSDOpReply, or None to
-        fall through to normal execution (object staged in the cache)."""
+        fall through to normal execution (object staged in the cache).
+
+        A promote that aborts because the object appeared concurrently
+        (rc == 1, see _tier_promote's race contract) restarts the whole
+        decision: the staged object changes every branch below."""
         base_id = pool.tier_of
         m = self.osdmap
         base_pool = m.pools.get(base_id) if m else None
@@ -2646,9 +2704,19 @@ class OSD(Dispatcher):
             or getattr(msg, "ps", None) is not None  # internal machinery
         ):
             return None
+
+        def retry():
+            if _depth >= 3:
+                return MOSDOpReply(tid=msg.tid, retval=-11,
+                                   epoch=self.my_epoch(),
+                                   result="tier staging kept racing")
+            return self._cache_tier_op(pg, pool, acting, ps, msg,
+                                       _depth + 1)
+
         cid = self._cid(pg.pgid, 0)
-        present = self.store.exists(cid, oid)
-        whiteout = present and self._tier_flag(pg, oid, "whiteout")
+        with pg.lock:
+            present = self.store.exists(cid, oid)
+            whiteout = present and self._tier_flag(pg, oid, "whiteout")
 
         if msg.op == "cache_flush":
             return self._tier_flush_object(pg, pool, acting, oid, msg.tid)
@@ -2680,6 +2748,8 @@ class OSD(Dispatcher):
                                    result=rep.result)
             rc = self._tier_promote(pg, pool, acting, base_id, oid,
                                     mark_clean=True)
+            if rc == 1:
+                return retry()  # raced a write: re-evaluate the staging
             if rc == -2:
                 return MOSDOpReply(tid=msg.tid, retval=-2,
                                    epoch=self.my_epoch(),
@@ -2719,9 +2789,35 @@ class OSD(Dispatcher):
             if wrep.retval != 0:
                 return MOSDOpReply(tid=msg.tid, retval=wrep.retval,
                                    epoch=self.my_epoch(), result=wrep.result)
-            t = Transaction()
-            self._apply_omap(t, cid, oid, {"clear": True})
-            self.store.queue_transaction(t)
+            # the stub must shed the pre-delete user state THROUGH THE
+            # REPLICATED paths (advisor r4, medium): a primary-local wipe
+            # leaves replicas carrying stale xattrs/omap that resurrect
+            # after failover, and a delete-then-recreate must never
+            # resurrect pre-delete attrs into a later flush
+            try:
+                stale = {
+                    n[2:]: None
+                    for n in self.store.getattrs(cid, oid)
+                    if n.startswith("u_") and not n[2:].startswith("tier.")
+                }
+            except (NotFound, KeyError):
+                stale = {}
+            if stale:
+                xrep = self._xattr_op(pg, acting, 0, MOSDOp(
+                    tid=self._next_tid(), pool=pg.pool_id, oid=oid,
+                    op="setxattr", data=stale, epoch=self.my_epoch(),
+                ))
+                if xrep.retval != 0:
+                    return MOSDOpReply(tid=msg.tid, retval=xrep.retval,
+                                       epoch=self.my_epoch(),
+                                       result=xrep.result)
+            orep = self._omap_op(pg, pool, acting, MOSDOp(
+                tid=self._next_tid(), pool=pg.pool_id, oid=oid,
+                op="omap_clear", data={}, epoch=self.my_epoch(),
+            ))
+            if orep.retval != 0:
+                return MOSDOpReply(tid=msg.tid, retval=orep.retval,
+                                   epoch=self.my_epoch(), result=orep.result)
             mrep = self._tier_mark(pg, acting, oid, "whiteout", True)
             if mrep.retval != 0:
                 return MOSDOpReply(tid=msg.tid, retval=mrep.retval,
@@ -2743,15 +2839,12 @@ class OSD(Dispatcher):
                                    result="whiteout clear not durable")
             return None
         if present:
-            # un-clean it BEFORE the data op (crash between = re-flush);
-            # same durability bar — a stale clean=1 would let evict drop
-            # the only copy of the new data
-            if self._tier_flag(pg, oid, "clean"):
-                mrep = self._tier_mark(pg, acting, oid, "clean", False)
-                if mrep.retval != 0:
-                    return MOSDOpReply(tid=msg.tid, retval=-11,
-                                       epoch=self.my_epoch(),
-                                       result="clean clear not durable")
+            # the clean-marker clear now rides the mutation's OWN
+            # transaction (_tier_autoclean in the write_full / omap /
+            # xattr / exec paths), atomically under the same pg.lock —
+            # a separate staging clear here raced the flush's clean-mark
+            # (advisor r4, medium: flush could label the object clean
+            # AFTER this check but BEFORE the data op landed)
             return None
         # absent: partial mutations need the base content staged first;
         # full overwrites don't (reference: proxy/promote decision).  A
@@ -2761,6 +2854,8 @@ class OSD(Dispatcher):
         if msg.op not in ("write_full",):
             rc = self._tier_promote(pg, pool, acting, base_id, oid,
                                     mark_clean=False)
+            if rc == 1:
+                return retry()  # raced a write: re-evaluate the staging
             if rc not in (0, -2):
                 return MOSDOpReply(tid=msg.tid, retval=-11,
                                    epoch=self.my_epoch(),
@@ -2771,7 +2866,17 @@ class OSD(Dispatcher):
                       mark_clean: bool) -> int:
         """Copy oid (data + user xattrs + omap) from the base pool into
         this cache PG (reference: PrimaryLogPG::promote_object).  Returns
-        0, -2 (no base object), or a negative errno."""
+        0, -2 (no base object), 1 (ABORTED: the object appeared locally
+        while we read the base copy — the caller re-evaluates its staging
+        decision), or a negative errno.
+
+        Race contract (advisor r4, high): the base-pool reads run
+        lock-free, but the local existence re-check and the staging
+        writes run under pg.lock — a client write that staged fresh data
+        concurrently either lands before our locked section (we see it
+        and abort: promoting would overwrite acknowledged new data with
+        stale base content) or serializes after it (its own transaction
+        clears the clean marker we may set)."""
         try:
             rep = self._tier_client_op(base_id, oid, "read")
             if rep.retval == -2:
@@ -2785,24 +2890,28 @@ class OSD(Dispatcher):
                 if orep.retval == 0 else {}
         except OSError:
             return -11
-        wrep = self._replicated_op(pg, pool, acting, MOSDOp(
-            tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-            op="write_full", data=rep.data, epoch=self.my_epoch(),
-        ))
-        if wrep.retval != 0:
-            return wrep.retval or -5
-        if xattrs:
-            self._xattr_op(pg, acting, 0, MOSDOp(
+        cid = self._cid(pg.pgid, 0)
+        with pg.lock:
+            if self.store.exists(cid, oid):
+                return 1  # raced a write: fresh data already staged
+            wrep = self._replicated_op(pg, pool, acting, MOSDOp(
                 tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-                op="setxattr", data=xattrs, epoch=self.my_epoch(),
+                op="write_full", data=rep.data, epoch=self.my_epoch(),
             ))
-        if kv:
-            self._omap_op(pg, pool, acting, MOSDOp(
-                tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-                op="omap_set", data={"keys": kv}, epoch=self.my_epoch(),
-            ))
-        if mark_clean:
-            self._tier_mark(pg, acting, oid, "clean", True)
+            if wrep.retval != 0:
+                return wrep.retval or -5
+            if xattrs:
+                self._xattr_op(pg, acting, 0, MOSDOp(
+                    tid=self._next_tid(), pool=pg.pool_id, oid=oid,
+                    op="setxattr", data=xattrs, epoch=self.my_epoch(),
+                ))
+            if kv:
+                self._omap_op(pg, pool, acting, MOSDOp(
+                    tid=self._next_tid(), pool=pg.pool_id, oid=oid,
+                    op="omap_set", data={"keys": kv}, epoch=self.my_epoch(),
+                ))
+            if mark_clean:
+                self._tier_mark(pg, acting, oid, "clean", True)
         self.logger.inc("tier_promote")
         return 0
 
@@ -3151,6 +3260,16 @@ class OSD(Dispatcher):
                             self._apply_xattr_updates(
                                 t, cid, msg.oid, msg.xattrs
                             )
+                if getattr(msg, "rmattrs", None):
+                    # atomic-with-data attr removals (cache-tier clean
+                    # clear riding a mutation); only if we hold the object
+                    try:
+                        existing = set(self.store.getattrs(cid, msg.oid))
+                    except (NotFound, KeyError):
+                        existing = set()
+                    for name in msg.rmattrs:
+                        if f"u_{name}" in existing:
+                            t.rmattr(cid, msg.oid, f"u_{name}")
                 if getattr(msg, "omap", None) is not None:
                     # live omap mutation or recovery snapshot: omap
                     # exists on replicated pools only; an omap op on a

@@ -87,12 +87,18 @@ class MECSubOpWrite(_JsonMessage):
     `omap` carries omap mutations or a recovery snapshot:
       {"set": {key: b64}, "rm": [key...], "clear": bool} applied in the
       same transaction; {"snapshot": {key: b64}} replaces the whole omap
-      (recovery push, mirroring the xattr snapshot semantics)."""
+      (recovery push, mirroring the xattr snapshot semantics).
+
+    `rmattrs` lists user-xattr names removed in the same transaction as
+    a data write (cache-tier dirty marking: the tier.clean clear must be
+    atomic with the mutation it rides — see daemon._cache_tier_op's
+    state model; `xattrs` can't carry it on a data push because a
+    data+xattrs message means a full recovery snapshot)."""
 
     MSG_TYPE = 108
     FIELDS = ("tid", "pgid", "oid", "shard", "data", "crc", "version",
               "entry", "epoch", "xattrs", "mode", "off", "over", "osize",
-              "omap")
+              "omap", "rmattrs")
 
 
 @register_message

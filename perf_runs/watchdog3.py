@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Round-5 tunnel watchdog (VERDICT r4, next-round item #1).
+
+Probes the tunneled TPU backend every 5 min; on the first UP it runs the
+pending capture jobs from perf_runs/jobs/*.json in filename order.  Each
+job file is {"marker": str, "timeout": int, "argv": [...], "env": {...}}.
+The jobs dir is rescanned every cycle, so new captures can be queued
+while the watchdog runs.  Done-markers make every job idempotent.
+
+Hard-deadline rule: no job STARTS after DEADLINE_UTC — this is the
+wedge-prevention contract: the round must never end with a builder
+process mid-compile on the tunnel (the r2/r4 wedge trigger was exactly
+that).  After the deadline the watchdog only logs probe state.
+
+Run: nohup python perf_runs/watchdog3.py >> perf_runs/watchdog3.log 2>&1 &
+"""
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+OUT = "/root/repo/perf_runs"
+JOBS = os.path.join(OUT, "jobs")
+# Round started 2026-07-31 05:31 UTC; ~12 h wall clock.  Leave a wide
+# safety margin before the driver's end-of-round bench run.
+DEADLINE_UTC = "2026-07-31T16:30"
+os.chdir("/root/repo")
+os.makedirs(JOBS, exist_ok=True)
+
+
+def log(msg):
+    print(time.strftime("%FT%TZ", time.gmtime()), msg, flush=True)
+
+
+def past_deadline() -> bool:
+    return time.strftime("%FT%H:%M", time.gmtime()) >= DEADLINE_UTC
+
+
+def probe() -> bool:
+    code = ("import jax\n"
+            "assert jax.devices()[0].platform != 'cpu'\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=90,
+                           capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def pending_jobs():
+    jobs = []
+    for path in sorted(glob.glob(os.path.join(JOBS, "*.json"))):
+        try:
+            with open(path) as f:
+                j = json.load(f)
+        except Exception as e:
+            log(f"bad job file {path}: {e}")
+            continue
+        if not os.path.exists(os.path.join(OUT, j["marker"] + ".done")):
+            jobs.append(j)
+    return jobs
+
+
+def run_job(j):
+    marker, tmo = j["marker"], int(j.get("timeout", 900))
+    env = dict(os.environ)
+    env.update(j.get("env", {}))
+    log(f"running {marker}: {' '.join(j['argv'])}")
+    try:
+        with open(os.path.join(OUT, marker + ".out"), "w") as f:
+            r = subprocess.run(j["argv"], timeout=tmo, stdout=f,
+                               stderr=subprocess.STDOUT, env=env)
+        if r.returncode == 0:
+            open(os.path.join(OUT, marker + ".done"), "w").close()
+            log(f"{marker} OK")
+            return True
+        log(f"{marker} rc={r.returncode}")
+    except subprocess.TimeoutExpired:
+        log(f"{marker} TIMED OUT after {tmo}s")
+    return False
+
+
+def main():
+    log(f"watchdog3 started (pid {os.getpid()}), deadline {DEADLINE_UTC}Z")
+    while True:
+        if past_deadline():
+            log(f"past deadline; probe={'UP' if probe() else 'down'}; "
+                "no more jobs will start")
+            time.sleep(600)
+            continue
+        todo = pending_jobs()
+        if not todo:
+            time.sleep(120)
+            continue
+        if not probe():
+            log(f"tunnel down/wedged ({len(todo)} jobs pending); sleeping 300s")
+            time.sleep(300)
+            continue
+        log(f"tunnel UP; {len(todo)} jobs pending")
+        for j in todo:
+            if past_deadline():
+                log("deadline hit mid-wave; stopping")
+                break
+            run_job(j)
+            if not probe():
+                log("tunnel lost mid-wave; back to sleep")
+                break
+
+
+if __name__ == "__main__":
+    main()

@@ -236,6 +236,149 @@ def test_remove_overlay_restores_direct_io(cluster, client):
         _settle(cluster, client)
 
 
+def _cache_pg_state(cluster, oid):
+    """(primary_osd, acting, cid) of the cache-pool PG holding oid."""
+    from ceph_tpu.osd.osdmap import object_ps
+
+    m = cluster._leader().osdmon.osdmap
+    pool = next(p for p in m.pools.values() if p.name == "cache")
+    ps = object_ps(oid, pool.pg_num)
+    primary_osd = cluster.osds[0]
+    acting, primary = primary_osd._acting(pool.pool_id, ps)
+    return pool, ps, acting, primary
+
+
+def test_mutation_clears_clean_atomically_on_all_replicas(cluster, client):
+    """Advisor r4 (high/medium): the tier.clean clear must ride the
+    mutation's own replicated transaction — after a rewrite of a flushed
+    object, NO acting replica may still carry the marker (a failover to a
+    stale-marker replica would let the agent evict the only copy)."""
+    base = client.open_ioctx("base")
+    cache = client.open_ioctx("cache")
+    base.write_full("obj-atomic", b"v1")
+    cache.cache_flush("obj-atomic")
+    pool, ps, acting, primary = _cache_pg_state(cluster, "obj-atomic")
+    # flushed: primary carries the clean marker
+    posd = cluster.osds[primary]
+    cid = posd._cid(f"{pool.pool_id}.{ps}", 0)
+    assert posd.store.getattr(cid, "obj-atomic", "u_tier.clean") == b"1"
+    base.write_full("obj-atomic", b"v2")
+    for osd_id in acting:
+        if osd_id < 0:
+            continue
+        osd = cluster.osds[osd_id]
+        attrs = osd.store.getattrs(osd._cid(f"{pool.pool_id}.{ps}", 0),
+                                   "obj-atomic")
+        assert "u_tier.clean" not in attrs, f"osd.{osd_id} kept clean marker"
+
+
+def test_omap_mutation_clears_clean(cluster, client):
+    base = client.open_ioctx("base")
+    cache = client.open_ioctx("cache")
+    base.write_full("obj-oc", b"v1")
+    cache.cache_flush("obj-oc")
+    base.omap_set("obj-oc", {"k": b"v"})
+    # dirty again: evict must refuse
+    with pytest.raises(IOError):
+        cache.cache_evict("obj-oc")
+    cache.cache_flush("obj-oc")
+    cache.cache_evict("obj-oc")
+    assert base.omap_get("obj-oc") == {"k": b"v"}
+
+
+def test_user_xattr_mutation_clears_clean(cluster, client):
+    base = client.open_ioctx("base")
+    cache = client.open_ioctx("cache")
+    base.write_full("obj-xc", b"v1")
+    cache.cache_flush("obj-xc")
+    base.set_xattr("obj-xc", "mood", b"blue")
+    with pytest.raises(IOError):
+        cache.cache_evict("obj-xc")
+    cache.cache_flush("obj-xc")
+    cache.cache_evict("obj-xc")
+    assert base.get_xattr("obj-xc", "mood") == b"blue"
+
+
+def test_promote_aborts_when_object_appears(cluster, client):
+    """Advisor r4 (high): a promote that loses the race with a client
+    write must NOT overwrite the staged data with stale base content —
+    _tier_promote re-checks existence under pg.lock and returns the
+    abort sentinel."""
+    base = client.open_ioctx("base")
+    base.write_full("obj-race", b"base-bytes")
+    cluster.osds[0]  # ensure map settled
+    _settle(cluster, client)
+    pool, ps, acting, primary = _cache_pg_state(cluster, "obj-race")
+    posd = cluster.osds[primary]
+    # flush the base copy into the base pool so a promote has a source
+    cache = client.open_ioctx("cache")
+    cache.cache_flush("obj-race")
+    pg = posd._pg(pool.pool_id, ps)
+    m = posd.osdmap
+    base_pool_id = pool.tier_of
+    # simulate the race: the object already exists locally (a concurrent
+    # write staged it) when the promote runs
+    rc = posd._tier_promote(pg, pool, acting, base_pool_id, "obj-race",
+                            mark_clean=True)
+    assert rc == 1, f"promote should abort, got {rc}"
+    # staged content untouched
+    assert base.read("obj-race") == b"base-bytes"
+
+
+def test_whiteout_sheds_xattrs_and_omap_on_replicas(cluster, client):
+    """Advisor r4 (medium): delete-then-recreate must not resurrect
+    pre-delete xattrs/omap — and the shedding must be REPLICATED so a
+    failover can't bring them back."""
+    base = client.open_ioctx("base")
+    cache = client.open_ioctx("cache")
+    base.write_full("obj-shed", b"v1")
+    base.set_xattr("obj-shed", "ghost", b"boo")
+    base.omap_set("obj-shed", {"gk": b"gv"})
+    cache.cache_flush("obj-shed")
+    base.remove("obj-shed")  # whiteout install
+    base.write_full("obj-shed", b"v2")  # recreate over the stub
+    assert base.read("obj-shed") == b"v2"
+    with pytest.raises((IOError, KeyError)):
+        base.get_xattr("obj-shed", "ghost")
+    assert base.omap_get("obj-shed") == {}
+    # replica stores must not carry the stale attr either
+    pool, ps, acting, primary = _cache_pg_state(cluster, "obj-shed")
+    for osd_id in acting:
+        if osd_id < 0:
+            continue
+        osd = cluster.osds[osd_id]
+        cid = osd._cid(f"{pool.pool_id}.{ps}", 0)
+        try:
+            attrs = osd.store.getattrs(cid, "obj-shed")
+        except Exception:
+            continue
+        assert "u_ghost" not in attrs, f"osd.{osd_id} resurrected xattr"
+        assert not osd.store.omap_get(cid, "obj-shed"), \
+            f"osd.{osd_id} resurrected omap"
+
+
+def test_set_overlay_requires_cache_mode(cluster):
+    """Advisor r4 (low): an overlay onto a cache-mode-none tier would
+    blackhole base I/O — the mon refuses, mirroring its inverse guard."""
+    cluster.create_replicated_pool("base2", size=2)
+    cluster.create_replicated_pool("cache2", size=2)
+    rv, res = cluster.mon_command(
+        {"prefix": "osd tier add", "pool": "base2", "tierpool": "cache2"})
+    assert rv == 0, res
+    rv, res = cluster.mon_command(
+        {"prefix": "osd tier set-overlay", "pool": "base2",
+         "tierpool": "cache2"})
+    assert rv == -16, (rv, res)
+    rv, res = cluster.mon_command(
+        {"prefix": "osd tier cache-mode", "pool": "cache2",
+         "mode": "writeback"})
+    assert rv == 0, res
+    rv, res = cluster.mon_command(
+        {"prefix": "osd tier set-overlay", "pool": "base2",
+         "tierpool": "cache2"})
+    assert rv == 0, (rv, res)
+
+
 def test_tier_command_validation(cluster):
     # EC pools cannot cache
     cluster.create_ec_pool("ecp", k=2, m=1)

@@ -398,10 +398,7 @@ class TestMultiChoose:
         """The fused Pallas hash+ln scorer (interpret mode on CPU) must
         drive the batched mapper to identical placements as the table-
         gather path."""
-        import functools
-
-        import ceph_tpu.crush.mapper as mapper_mod
-        from ceph_tpu.crush.batched import ln_scores_pallas
+        import os
 
         cmap = build_hierarchical_map(8, 3)
         w = np.full(24, 0x10000, dtype=np.uint32)
@@ -409,12 +406,11 @@ class TestMultiChoose:
         cm = CompiledCrushMap(cmap)
         base = np.asarray(crush_do_rule_batch(cm, 0, np.arange(128), 3, w))
         cm2 = CompiledCrushMap(cmap)
-        orig = mapper_mod.default_score_fn
-        mapper_mod.default_score_fn = lambda: ln_scores_pallas
+        os.environ["CEPH_TPU_CRUSH_SCORE"] = "pallas"
         try:
             got = np.asarray(crush_do_rule_batch(cm2, 0, np.arange(128), 3, w))
         finally:
-            mapper_mod.default_score_fn = orig
+            del os.environ["CEPH_TPU_CRUSH_SCORE"]
         np.testing.assert_array_equal(got, base)
 
     def test_set_tries_steps(self):

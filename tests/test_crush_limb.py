@@ -1,0 +1,221 @@
+"""Limb-engine bit-exactness (round-4 verdict item #2).
+
+The TPU draw engine (crush/engine.py: one-hot fat-table gathers +
+magic-divisor limb draws, no int64/x64) must produce placements
+bit-identical to the int64 gather engine — which tests/test_crush.py
+already pins against the scalar Python mapper and the C++ oracle.
+Reference: src/crush/mapper.c :: bucket_straw2_choose / is_out.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import (
+    CompiledCrushMap,
+    build_hierarchical_map,
+    crush_do_rule_batch,
+)
+
+
+@pytest.fixture
+def limb_env():
+    os.environ["CEPH_TPU_CRUSH_ENGINE"] = "limb"
+    yield
+    del os.environ["CEPH_TPU_CRUSH_ENGINE"]
+
+
+def _both_engines(cmap, rule, xs, nrep, w, choose_args=None):
+    cm1 = CompiledCrushMap(cmap)
+    base = np.asarray(
+        crush_do_rule_batch(cm1, rule, xs, nrep, w, choose_args)
+    )
+    os.environ["CEPH_TPU_CRUSH_ENGINE"] = "limb"
+    try:
+        cm2 = CompiledCrushMap(cmap)
+        got = np.asarray(
+            crush_do_rule_batch(cm2, rule, xs, nrep, w, choose_args)
+        )
+    finally:
+        del os.environ["CEPH_TPU_CRUSH_ENGINE"]
+    np.testing.assert_array_equal(got, base)
+    return base
+
+
+def test_limb_matches_i64_hierarchical():
+    cmap = build_hierarchical_map(16, 4)
+    w = np.full(64, 0x10000, dtype=np.uint32)
+    _both_engines(cmap, 0, np.arange(512), 3, w)
+
+
+def test_limb_matches_i64_weighted_buckets():
+    """Non-uniform bucket weights exercise every magic-divisor branch
+    (round-up and round-down-with-increment magics)."""
+    rng = np.random.default_rng(42)
+    cmap = build_hierarchical_map(8, 4)
+    for b in cmap.buckets.values():
+        b.weights = [int(x) for x in
+                     rng.integers(1, 0x40000, len(b.weights))]
+    w = np.full(32, 0x10000, dtype=np.uint32)
+    _both_engines(cmap, 0, np.arange(400), 3, w)
+
+
+def test_limb_matches_i64_reweights_and_zero_weights():
+    """Reweight rejects (is_out) and zero-weight slots."""
+    rng = np.random.default_rng(7)
+    cmap = build_hierarchical_map(8, 3)
+    for b in cmap.buckets.values():
+        ws = rng.integers(0, 0x20000, len(b.weights))
+        ws[rng.integers(0, len(ws))] = 0  # a dead slot per bucket
+        b.weights = [int(x) for x in ws]
+    w = rng.integers(0, 0x10001, 24).astype(np.uint32)
+    w[5] = 0
+    _both_engines(cmap, 0, np.arange(300), 3, w)
+
+
+def test_limb_matches_i64_indep():
+    from ceph_tpu.crush.types import Rule, RuleOp, RuleStep
+
+    cmap = build_hierarchical_map(8, 3)
+    cmap.rules[9] = Rule(
+        rule_id=9,
+        steps=[
+            RuleStep(RuleOp.TAKE, -1, 0),
+            RuleStep(RuleOp.CHOOSELEAF_INDEP, 0, 1),
+            RuleStep(RuleOp.EMIT, 0, 0),
+        ],
+    )
+    w = np.full(24, 0x10000, dtype=np.uint32)
+    w[2] = 0x4000
+    _both_engines(cmap, 9, np.arange(256), 4, w)
+
+
+def test_limb_matches_i64_choose_args():
+    cmap = build_hierarchical_map(4, 3)
+    bid = min(cmap.buckets)  # deepest bucket id
+    rng = np.random.default_rng(3)
+    cmap.choose_args["pos"] = {
+        bid: [
+            [int(x) for x in rng.integers(1, 0x20000,
+                                          len(cmap.buckets[bid].items))],
+            [int(x) for x in rng.integers(1, 0x20000,
+                                          len(cmap.buckets[bid].items))],
+        ]
+    }
+    w = np.full(12, 0x10000, dtype=np.uint32)
+    _both_engines(cmap, 0, np.arange(200), 3, w, choose_args="pos")
+
+
+def test_limb_matches_scalar_reference():
+    """Direct triangle close: limb engine vs the scalar Python mapper."""
+    from ceph_tpu.crush.reference_mapper import crush_do_rule
+
+    rng = np.random.default_rng(11)
+    cmap = build_hierarchical_map(8, 4)
+    for b in cmap.buckets.values():
+        b.weights = [int(x) for x in
+                     rng.integers(1, 0x30000, len(b.weights))]
+    w = rng.integers(0, 0x10001, 32).astype(np.uint32)
+    os.environ["CEPH_TPU_CRUSH_ENGINE"] = "limb"
+    try:
+        cm = CompiledCrushMap(cmap)
+        xs = np.arange(128)
+        got = np.asarray(crush_do_rule_batch(cm, 0, xs, 3, w))
+    finally:
+        del os.environ["CEPH_TPU_CRUSH_ENGINE"]
+    for i, x in enumerate(xs):
+        want = crush_do_rule(cmap, 0, int(x), 3, w)
+        want = want + [-0x7FFFFFFE] * (3 - len(want))
+        assert list(got[i]) == want, (x, list(got[i]), want)
+
+
+def test_limb_with_pallas_planes(limb_env):
+    """Limb engine + Pallas plane scorer (interpret mode) — the exact
+    configuration the TPU runs."""
+    os.environ["CEPH_TPU_CRUSH_SCORE"] = "pallas"
+    try:
+        cmap = build_hierarchical_map(8, 3)
+        w = np.full(24, 0x10000, dtype=np.uint32)
+        cm = CompiledCrushMap(cmap)
+        got = np.asarray(crush_do_rule_batch(cm, 0, np.arange(128), 3, w))
+    finally:
+        del os.environ["CEPH_TPU_CRUSH_SCORE"]
+    cm2 = CompiledCrushMap(cmap)
+    base = np.asarray(crush_do_rule_batch(cm2, 0, np.arange(128), 3, w))
+    np.testing.assert_array_equal(got, base)
+
+
+def test_loop_slab_kernel_matches_static_unroll():
+    """The fori_loop/pl.ds slab walk (constant compile time in tile —
+    round-4 verdict item #2) must be bit-identical to the r4-proven
+    statically-unrolled walk."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops.pallas_crush import straw2_scores_pallas
+
+    rng = np.random.default_rng(5)
+    B, S = 128, 128
+    x = jnp.asarray(rng.integers(0, 1 << 31, B).astype(np.int32))
+    r = jnp.asarray(rng.integers(0, 50, B).astype(np.int32))
+    items = jnp.asarray(rng.integers(-200, 200, (B, S)).astype(np.int32))
+    hi_l, lo_l = straw2_scores_pallas(x, r, items, tile=64,
+                                      loop_slabs=True, interpret=True)
+    hi_s, lo_s = straw2_scores_pallas(x, r, items, tile=64,
+                                      loop_slabs=False, interpret=True)
+    np.testing.assert_array_equal(np.asarray(hi_l), np.asarray(hi_s))
+    np.testing.assert_array_equal(np.asarray(lo_l), np.asarray(lo_s))
+
+
+def test_straw2_fallback_chain(monkeypatch):
+    """A Mosaic rejection of the loop-slab kernel must fall back to the
+    static unroll (keeping the metric), not fail the bench: flip
+    LOOP_SLABS, then downshift the tile."""
+    import ceph_tpu.crush.mapper as mapper_mod
+    from ceph_tpu.ops import pallas_crush
+
+    calls = []
+    real = pallas_crush.straw2_scores_pallas
+
+    def flaky(x, r, items, tile, loop_slabs=False, interpret=False):
+        calls.append((tile, loop_slabs))
+        if loop_slabs:
+            raise RuntimeError("Mosaic says no (simulated)")
+        return real(x, r, items, tile=tile, loop_slabs=False,
+                    interpret=interpret)
+
+    monkeypatch.setattr(pallas_crush, "straw2_scores_pallas", flaky)
+    monkeypatch.setattr(pallas_crush, "LOOP_SLABS", True)
+    monkeypatch.setattr(pallas_crush, "DEFAULT_TILE", 2048)
+    monkeypatch.setenv("CEPH_TPU_CRUSH_SCORE", "pallas")
+    cmap = build_hierarchical_map(4, 2)
+    w = np.full(8, 0x10000, dtype=np.uint32)
+    cm = CompiledCrushMap(cmap)
+    out = np.asarray(crush_do_rule_batch(cm, 0, np.arange(64), 2, w))
+    assert out.shape == (64, 2)
+    assert any(ls for _t, ls in calls), "loop kernel attempted first"
+    assert any(not ls for _t, ls in calls), "static fallback reached"
+    assert pallas_crush.LOOP_SLABS is False
+    # and the result still matches the gather engine
+    monkeypatch.delenv("CEPH_TPU_CRUSH_SCORE")
+    cm2 = CompiledCrushMap(cmap)
+    base = np.asarray(crush_do_rule_batch(cm2, 0, np.arange(64), 2, w))
+    np.testing.assert_array_equal(out, base)
+
+
+def test_limb_trace_needs_no_x64():
+    """The limb engine's raison d'etre: tracing it with x64 disabled must
+    not produce any int64 op (a leak would either crash Mosaic on TPU or
+    silently truncate)."""
+    import jax
+
+    cmap = build_hierarchical_map(4, 2)
+    w = np.full(8, 0x10000, dtype=np.uint32)
+    os.environ["CEPH_TPU_CRUSH_ENGINE"] = "limb"
+    try:
+        cm = CompiledCrushMap(cmap)
+        out = np.asarray(crush_do_rule_batch(cm, 0, np.arange(64), 2, w))
+        assert not jax.config.jax_enable_x64
+    finally:
+        del os.environ["CEPH_TPU_CRUSH_ENGINE"]
+    assert out.shape == (64, 2)
+    assert (out >= 0).all()  # healthy map: every lane placed

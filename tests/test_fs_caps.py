@@ -130,6 +130,41 @@ def test_buffered_attrs_survive_mds_failover(cluster):
         fs.unmount()
 
 
+def test_stale_seq_flush_cannot_clobber_regrant(cluster):
+    """Advisor r4 (low): a delayed flush-ack from an EARLIER revoke must
+    not downgrade a writer re-granted since (Locker drops stale-seq cap
+    acks).  The attr half of the flush still applies."""
+    from ceph_tpu.fs.messages import MClientCaps
+
+    fs = cluster.fs_client("client.stale")
+    try:
+        fh = fs.open("/stale-seq", create=True)
+        fh.write(b"buffered!")
+        mds = cluster.mds
+        holders = mds.caps.get(fh.ino, {})
+        sess = fs._session
+        ent = holders[sess]
+        assert "w" in ent["caps"]
+        # the current grant is at seq N; craft a flush acking seq N-1
+        ent["seq"] = ent.get("seq", 0) + 2
+        stale = MClientCaps(op="flush", client=sess, ino=fh.ino,
+                            caps="", seq=ent["seq"] - 1,
+                            attrs={"size": 9, "mtime": 123.0})
+        assert mds.ms_dispatch(None, stale)
+        # downgrade ignored: the writer keeps w and stays registered
+        assert "w" in mds.caps[fh.ino][sess]["caps"]
+        # the attr flush itself applied (absolute-valued)
+        assert mds._inode_of(fh.ino)["size"] == 9
+        # a CURRENT-seq flush still downgrades normally
+        fresh = MClientCaps(op="flush", client=sess, ino=fh.ino,
+                            caps="", seq=ent["seq"], attrs=None)
+        assert mds.ms_dispatch(None, fresh)
+        assert mds.caps[fh.ino][sess]["caps"] == ""
+        fs._caps_state.pop(fh.ino, None)  # drop client-side buffer state
+    finally:
+        fs.unmount()
+
+
 def test_dead_writer_evicted_at_reconnect_deadline(cluster):
     """A writer that never comes back must not block readers forever:
     the reconnect window expires and the MDS evicts it (buffered attrs

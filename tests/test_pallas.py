@@ -76,6 +76,96 @@ def test_non_tile_multiple_length_padded():
     np.testing.assert_array_equal(out, apply_ref(coding, data))
 
 
+def test_blocked_fat_matrix_bit_exact(monkeypatch):
+    """Round-4 verdict item #4: fat repair matrices (CLAY(8,4,d=11) is
+    [64, 176]) run the row-blocked kernel — bitplanes unpacked once, rb
+    unrolled band matmuls — and must stay bit-exact vs the reference."""
+    monkeypatch.setenv("CEPH_TPU_GF_TILE", "256")
+    monkeypatch.setenv("CEPH_TPU_GF_ROWBLOCKS", "4")
+    rng = np.random.default_rng(11)
+    mat = rng.integers(0, 256, (64, 176), np.uint8)
+    data = rng.integers(0, 256, (176, 512), np.uint8)
+    out = np.asarray(apply_matrix_pallas(mat, data, interpret=True))
+    np.testing.assert_array_equal(out, apply_ref(mat, data))
+
+
+def test_blocked_ragged_rows_bit_exact(monkeypatch):
+    """Row count not divisible by the block count: zero-row padding must
+    be invisible in the output."""
+    monkeypatch.setenv("CEPH_TPU_GF_TILE", "256")
+    monkeypatch.setenv("CEPH_TPU_GF_ROWBLOCKS", "4")
+    rng = np.random.default_rng(12)
+    mat = rng.integers(0, 256, (13, 40), np.uint8)  # 13 % 4 != 0
+    data = rng.integers(0, 256, (40, 300), np.uint8)
+    out = np.asarray(apply_matrix_pallas(mat, data, interpret=True))
+    np.testing.assert_array_equal(out, apply_ref(mat, data))
+
+
+# ---- silicon-shape regression guards (round-4 verdict item #10) ----------
+# Every r4 silicon failure below was invisible in interpret mode; these
+# CPU-runnable asserts pin the analytic VMEM model + layout picker so the
+# failing shapes can never be selected again.
+
+def test_vmem_model_rejects_r4_clay_failure_shape():
+    """r4 silicon failure #2: CLAY repair [64, 176] at tile=8192
+    unblocked requested 43 MiB scoped VMEM vs the 16 MiB limit."""
+    from ceph_tpu.ops.pallas_gf import VMEM_BUDGET, _pick_group, vmem_estimate
+
+    G = _pick_group(64, 176)
+    assert vmem_estimate(64, 176, G, 8192, 1) > VMEM_BUDGET
+
+
+def test_layout_picker_blocks_fat_matrices_instead_of_shrinking():
+    from ceph_tpu.ops.pallas_gf import (
+        VMEM_BUDGET,
+        _pick_group,
+        _pick_layout,
+        vmem_estimate,
+    )
+
+    G = _pick_group(64, 176)
+    tile, rb = _pick_layout(64, 176, G)
+    assert vmem_estimate(64, 176, G, tile, rb) <= VMEM_BUDGET
+    assert rb > 1, "fat matrix should row-block"
+    assert tile >= 4096, "row-blocking should keep the tile wide"
+
+
+def test_layout_picker_keeps_flagship_shapes():
+    """Known-good silicon shapes (85.04 GiB/s capture, r4) must be
+    reproduced exactly: RS(8,4) and RS(2,1) run tile=8192 unblocked."""
+    from ceph_tpu.ops.pallas_gf import (
+        VMEM_BUDGET,
+        _pick_group,
+        _pick_layout,
+        vmem_estimate,
+    )
+
+    for rows, n in [(4, 8), (1, 2)]:
+        G = _pick_group(rows, n)
+        tile, rb = _pick_layout(rows, n, G)
+        assert (tile, rb) == (8192, 1), (rows, n, tile, rb)
+        assert vmem_estimate(rows, n, G, tile, rb) <= VMEM_BUDGET
+
+
+def test_every_picked_layout_fits_budget_sweep():
+    """Property sweep: whatever (rows, n) a codec throws at the picker,
+    the chosen layout's analytic VMEM fits the budget (or the tile is at
+    its floor — the compiler's own error is then the backstop)."""
+    from ceph_tpu.ops.pallas_gf import (
+        VMEM_BUDGET,
+        _pick_group,
+        _pick_layout,
+        vmem_estimate,
+    )
+
+    for rows in (1, 2, 4, 8, 16, 64, 128):
+        for n in (2, 8, 20, 176, 256):
+            G = _pick_group(rows, n)
+            tile, rb = _pick_layout(rows, n, G)
+            est = vmem_estimate(rows, n, G, tile, rb)
+            assert est <= VMEM_BUDGET or tile <= 512, (rows, n, tile, rb, est)
+
+
 def test_kernel_traces_with_crush_mapper_imported():
     """Round-1 regression: crush.mapper flipped jax_enable_x64 globally at
     import, which leaked i64 into the Pallas BlockSpec index maps and made
