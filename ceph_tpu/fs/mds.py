@@ -44,8 +44,27 @@ ROOT_INO = 1
 
 
 class MDSDaemon(Dispatcher):
-    """Single active MDS (rank 0).  reference: src/mds/MDSDaemon.cc boots a
-    rank that loads the root dirfrag + replays the journal."""
+    """Active MDS rank (reference: src/mds/MDSDaemon.cc + MDSRank.cc).
+
+    Multi-active (round-4 verdict item #8): each rank journals to its own
+    segment chain and owns a set of ROOT-LEVEL subtrees recorded in the
+    shared `mds_subtrees` object (the subtree-export analog, coarse:
+    whole top-level directories).  Ops anchored in another rank's subtree
+    are answered with a redirect carrying the owner's address; clients
+    re-route and cache.  Rank liveness rides per-rank beacon keys in the
+    metadata pool; when a rank's beacon goes stale the lowest surviving
+    rank absorbs it — replays the dead rank's journal, adopts its
+    subtrees, and rewrites the maps — so the namespace survives a rank
+    failure without an external orchestrator (the mon/standby role,
+    collapsed into peer takeover).  Cross-subtree renames return -EXDEV
+    (the reference forwards slave requests between ranks; out of scope).
+    Ino allocation is partitioned per rank (disjoint 2^40 ranges) so two
+    ranks can never mint the same ino.
+    """
+
+    BEACON_INTERVAL = 1.0
+    BEACON_GRACE = 3.0
+    SUBTREE_TTL = 2.0
 
     def __init__(
         self,
@@ -54,8 +73,10 @@ class MDSDaemon(Dispatcher):
         metadata_pool: str = "cephfs_meta",
         data_pool: str = "cephfs_data",
         bind_addr: tuple[str, int] | None = None,
+        rank: int = 0,
     ):
         self.cct = cct
+        self.rank = int(rank)
         self._bind_addr = tuple(bind_addr) if bind_addr else None
         self.mon_addrs = mon_addrs
         self.metadata_pool = metadata_pool
@@ -116,6 +137,34 @@ class MDSDaemon(Dispatcher):
         self._reconnect_deadline = 0.0
         self._rados: Rados | None = None
         self._io = None
+        # multi-rank state: cached subtree map (top-level name -> rank)
+        # + known rank addresses, both backed by shared pool objects
+        self._subtrees: dict[str, int] = {}
+        self._subtrees_read = 0.0
+        self._rank_addrs: dict[int, tuple[str, int]] = {}
+        self._beacon_stop = threading.Event()
+        self._beacon_thread: threading.Thread | None = None
+
+    # -- per-rank object naming (rank 0 keeps the legacy names so old
+    # metadata pools replay unchanged) -----------------------------------
+    def _rk(self, name: str) -> str:
+        return name if self.rank == 0 else f"{name}.r{self.rank}"
+
+    @property
+    def _jprefix(self) -> str:
+        return "journal." if self.rank == 0 else f"journal.r{self.rank}."
+
+    @staticmethod
+    def _jseg(oid: str, prefix: str) -> int | None:
+        """Segment number of a journal oid under `prefix`, or None when
+        the oid belongs to another rank's chain (rank 0's bare prefix
+        also matches 'journal.rN.*' — filter those)."""
+        rest = oid[len(prefix):]
+        seg = rest.split(".", 1)[0]
+        try:
+            return int(seg, 16)
+        except ValueError:
+            return None
 
     # -- persistence helpers ----------------------------------------------
     def _obj_read(self, oid: str) -> dict | list | None:
@@ -130,11 +179,12 @@ class MDSDaemon(Dispatcher):
     def _load(self) -> None:
         """Boot: load the flushed namespace, then replay journal segments
         (reference: MDCache::open_root + MDLog::replay)."""
-        head = self._obj_read("mds_head") or {}
+        head = self._obj_read(self._rk("mds_head")) or {}
         self._first_seg = int(head.get("first_seg", 0))
         self._seg_seq = self._first_seg
-        ino_tbl = self._obj_read("mds_inotable") or {}
-        self.next_ino = int(ino_tbl.get("next_ino", ROOT_INO + 1))
+        ino_tbl = self._obj_read(self._rk("mds_inotable")) or {}
+        self.next_ino = int(ino_tbl.get(
+            "next_ino", ROOT_INO + 1 + self.rank * (1 << 40)))
         for oid in self._io.list_objects():
             if not oid.startswith("dir."):
                 continue
@@ -185,7 +235,7 @@ class MDSDaemon(Dispatcher):
         while True:
             idx = 0
             while True:
-                ev = self._obj_read(f"journal.{seq:08x}.{idx:04x}")
+                ev = self._obj_read(f"{self._jprefix}{seq:08x}.{idx:04x}")
                 if ev is None:
                     break
                 self._apply(ev)
@@ -200,7 +250,7 @@ class MDSDaemon(Dispatcher):
         # reconnect window to re-flush their buffered attrs before attr
         # reads of their inos are served (reference: the MDS reconnect
         # phase driven by the persisted SessionMap)
-        sm = self._obj_read("mds_sessionmap") or {}
+        sm = self._obj_read(self._rk("mds_sessionmap")) or {}
         self._reconnect = {
             int(k, 16): list(v) for k, v in sm.items() if v
         }
@@ -264,14 +314,15 @@ class MDSDaemon(Dispatcher):
         self._dirty.clear()
         self._dirty_names.clear()
         self._dirty_full.clear()
-        self._obj_write("mds_inotable", {"next_ino": self.next_ino})
+        self._obj_write(self._rk("mds_inotable"), {"next_ino": self.next_ino})
         self._first_seg = self._seg_seq
-        self._obj_write("mds_head", {"first_seg": self._first_seg})
+        self._obj_write(self._rk("mds_head"), {"first_seg": self._first_seg})
         # trim: every event object of now-expired segments
         for oid in self._io.list_objects():
-            if not oid.startswith("journal."):
+            if not oid.startswith(self._jprefix):
                 continue
-            if int(oid.split(".")[1], 16) < self._first_seg:
+            seg = self._jseg(oid, self._jprefix)
+            if seg is not None and seg < self._first_seg:
                 try:
                     self._io.remove(oid)
                 except IOError:
@@ -283,7 +334,7 @@ class MDSDaemon(Dispatcher):
         is whole-object — rewriting a growing segment object per op would
         be O(n^2) bytes per segment."""
         self._obj_write(
-            f"journal.{self._seg_seq:08x}.{self._seg_idx:04x}", ev
+            f"{self._jprefix}{self._seg_seq:08x}.{self._seg_idx:04x}", ev
         )
         self._seg_idx += 1
 
@@ -443,7 +494,8 @@ class MDSDaemon(Dispatcher):
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
-        self._rados = Rados(self.cct, self.mon_addrs, name="mds.0")
+        self._rados = Rados(self.cct, self.mon_addrs,
+                            name=f"mds.{self.rank}")
         self._rados.connect(timeout=30.0)
         self._io = self._rados.open_ioctx(self.metadata_pool)
         with self._lock:
@@ -452,6 +504,21 @@ class MDSDaemon(Dispatcher):
             self._bind_addr or ("127.0.0.1", 0)
         )
         self.messenger.start()
+        # register this rank + first beacon (omap keys: per-rank writers
+        # never clobber each other), then watch sibling beacons
+        try:
+            self._io.omap_set("mds_ranks", {
+                str(self.rank): json.dumps(list(self.addr)).encode()
+            })
+            self._beacon_once()
+        except IOError:
+            pass
+        self._beacon_stop.clear()
+        self._beacon_thread = threading.Thread(
+            target=self._beacon_loop, name=f"mds.{self.rank}-beacon",
+            daemon=True,
+        )
+        self._beacon_thread.start()
 
     def shutdown(self) -> None:
         with self._lock:
@@ -463,10 +530,212 @@ class MDSDaemon(Dispatcher):
 
     def hard_kill(self) -> None:
         """Stop WITHOUT the shutdown flush — crash simulation for failover
-        tests: the journal alone must carry unflushed namespace state."""
+        tests: the journal alone must carry unflushed namespace state
+        (and the beacon stops cold, so a surviving rank takes over)."""
+        self._beacon_stop.set()
         self.messenger.shutdown()
         if self._rados is not None:
             self._rados.shutdown()
+
+    # -- multi-rank: beacons, subtree map, takeover ------------------------
+    def _beacon_once(self) -> None:
+        self._io.omap_set("mds_beacons", {
+            str(self.rank): json.dumps(time.time()).encode()
+        })
+
+    def _beacon_loop(self) -> None:
+        """Liveness beacon + sibling watch (the mon beacon/MDSMap laning,
+        collapsed to pool state).  The LOWEST surviving rank absorbs a
+        rank whose beacon went stale — one deterministic taker, no race."""
+        while not self._beacon_stop.wait(timeout=self.BEACON_INTERVAL):
+            try:
+                self._beacon_once()
+                ranks = self._read_ranks()
+                if len(ranks) <= 1:
+                    continue
+                beacons = {
+                    int(k): json.loads(v)
+                    for k, v in (self._io.omap_get("mds_beacons") or {}).items()
+                }
+                now = time.time()
+                live = [r for r in ranks
+                        if now - beacons.get(r, 0) <= self.BEACON_GRACE]
+                if self.rank != min(live, default=self.rank):
+                    continue
+                for r in sorted(ranks):
+                    if r != self.rank and r not in live:
+                        self.cct.dout(
+                            "mds", 1,
+                            f"mds.{self.rank}: rank {r} beacon stale; "
+                            f"absorbing")
+                        self.absorb_rank(r)
+            except IOError:
+                continue  # pool unreachable this tick; keep beating
+
+    def _read_ranks(self) -> dict[int, tuple[str, int]]:
+        try:
+            kv = self._io.omap_get("mds_ranks") or {}
+        except IOError:
+            return dict(self._rank_addrs)
+        self._rank_addrs = {
+            int(k): tuple(json.loads(v)) for k, v in kv.items()
+        }
+        return dict(self._rank_addrs)
+
+    def _load_subtrees(self, force: bool = False) -> dict[str, int]:
+        if force or time.monotonic() - self._subtrees_read > self.SUBTREE_TTL:
+            old = self._subtrees
+            self._subtrees = {
+                k: int(v)
+                for k, v in (self._obj_read("mds_subtrees") or {}).items()
+            }
+            self._subtrees_read = time.monotonic()
+            # a subtree newly assigned to US must be re-read from the
+            # pool: our boot-time cache predates the old owner's flush
+            for name, owner in self._subtrees.items():
+                if owner == self.rank and old.get(name) != self.rank:
+                    self.adopt_subtree(name)
+        return self._subtrees
+
+    def _top_name(self, ino: int) -> str | None:
+        """Top-level directory name an ino lives under (None = at/above
+        root, always rank 0's)."""
+        name = None
+        seen = 0
+        while ino != ROOT_INO:
+            bp = self.backptr.get(ino)
+            if bp is None:
+                return name
+            ino, name = bp
+            seen += 1
+            if seen > 1000:  # corrupt backptr cycle guard
+                return name
+        return name
+
+    def _owner_rank(self, ino: int) -> int:
+        top = self._top_name(ino)
+        if top is None:
+            return 0
+        return self._load_subtrees().get(top, 0)
+
+    def absorb_rank(self, r: int) -> None:
+        """Take over a dead rank: reload its FLUSHED dirfrags from the
+        pool, replay its journal over them (the events are idempotent
+        state setters), adopt its subtrees, and retire its per-rank
+        objects (reference: the rank-replacement phase of MDSMap
+        transitions, journal-replay included).
+
+        The reload must come first: the dead rank flushed (and trimmed
+        its journal) at segment rolls AFTER we booted, so our cached
+        copies of its dirfrags can be stale in ways the remaining
+        journal no longer covers."""
+        jprefix = "journal." if r == 0 else f"journal.r{r}."
+        head_name = "mds_head" if r == 0 else f"mds_head.r{r}"
+        with self._lock:
+            subs0 = {
+                k: int(v)
+                for k, v in (self._obj_read("mds_subtrees") or {}).items()
+            }
+            if r == 0:
+                # rank 0 implicitly owns root + every unpinned top-level
+                # dir: refresh root from the pool, then every top-level
+                # subtree not owned by a DIFFERENT live rank
+                try:
+                    kv = self._io.omap_get(f"dir.{ROOT_INO:x}")
+                except IOError:
+                    kv = {}
+                self.dirs[ROOT_INO] = {
+                    n: json.loads(v) for n, v in kv.items()
+                }
+                self._rebuild_backptrs()
+                for name, entry in list(self.dirs[ROOT_INO].items()):
+                    if entry.get("type") != "dir":
+                        continue
+                    # only the DEAD rank's dirs (unpinned default to 0);
+                    # our own subtrees' cache may hold unflushed state
+                    # the pool copy would clobber
+                    if subs0.get(name, 0) == r:
+                        self.adopt_subtree(name)
+            else:
+                for name, owner in subs0.items():
+                    if owner == r:
+                        self.adopt_subtree(name)
+            head = self._obj_read(head_name) or {}
+            seq = int(head.get("first_seg", 0))
+            while True:
+                idx = 0
+                while True:
+                    ev = self._obj_read(f"{jprefix}{seq:08x}.{idx:04x}")
+                    if ev is None:
+                        break
+                    self._apply(ev)
+                    idx += 1
+                if idx == 0:
+                    break
+                seq += 1
+            self._flush()
+            subs = {
+                k: int(v)
+                for k, v in (self._obj_read("mds_subtrees") or {}).items()
+            }
+            changed = False
+            for name, owner in subs.items():
+                if owner == r:
+                    subs[name] = self.rank
+                    changed = True
+            if changed:
+                self._obj_write("mds_subtrees", subs)
+            self._load_subtrees(force=True)
+            try:
+                self._io.omap_rm_keys("mds_ranks", [str(r)])
+                self._io.omap_rm_keys("mds_beacons", [str(r)])
+            except IOError:
+                pass
+            # retire the dead rank's journal chain (absorbed into our
+            # flushed state) so a revived daemon cannot replay it twice
+            for oid in list(self._io.list_objects()):
+                if oid.startswith(jprefix) and \
+                        self._jseg(oid, jprefix) is not None:
+                    try:
+                        self._io.remove(oid)
+                    except IOError:
+                        pass
+        self.cct.dout("mds", 1, f"mds.{self.rank}: absorbed rank {r}")
+
+    def adopt_subtree(self, name: str) -> None:
+        """Reload a subtree's dirfrags from the pool (called when a
+        subtree is assigned to this rank AFTER boot: our cached copy may
+        predate the previous owner's flush)."""
+        with self._lock:
+            if self.rank != 0:
+                # our ROOT cache may predate the subtree's creation (root
+                # is rank 0's); refresh its dentry from the pool.  Rank 0
+                # never does this — its own root is the authority and may
+                # hold unflushed state.
+                try:
+                    kv = self._io.omap_get(f"dir.{ROOT_INO:x}")
+                except IOError:
+                    kv = {}
+                if name in kv:
+                    self.dirs.setdefault(ROOT_INO, {})[name] = \
+                        json.loads(kv[name])
+            root_entry = self.dirs.get(ROOT_INO, {}).get(name)
+            if root_entry is None or root_entry.get("type") != "dir":
+                return
+            todo = [root_entry["ino"]]
+            while todo:
+                ino = todo.pop()
+                try:
+                    kv = self._io.omap_get(f"dir.{ino:x}")
+                except IOError:
+                    kv = {}
+                self.dirs[ino] = {
+                    n: json.loads(v) for n, v in kv.items()
+                }
+                for inode in self.dirs[ino].values():
+                    if inode.get("type") == "dir":
+                        todo.append(inode["ino"])
+            self._rebuild_backptrs()
 
     # -- op handling -------------------------------------------------------
     def _inode_of(self, ino: int) -> dict | None:
@@ -497,7 +766,7 @@ class MDSDaemon(Dispatcher):
                 if sessions:
                     cur = merged.setdefault(f"{ino:x}", [])
                     cur.extend(s for s in sessions if s not in cur)
-        self._obj_write("mds_sessionmap", merged)
+        self._obj_write(self._rk("mds_sessionmap"), merged)
 
     def _set_writer(self, ino: int, session: str, on: bool) -> None:
         cur = self._writers.setdefault(ino, [])
@@ -620,9 +889,63 @@ class MDSDaemon(Dispatcher):
         if self._writers.pop(ino, None) is not None:
             self._persist_writers()
 
+    def _check_redirect(self, op: str, a: dict) -> dict | None:
+        """Ownership gate (multi-rank): an op anchored in another rank's
+        subtree is redirected to its owner (reference: the MDS forwards
+        requests to the auth MDS of the dentry; here the client re-sends).
+        Cross-subtree renames are handled in the rename op itself."""
+        if len(self._rank_addrs) <= 1 and not self._load_subtrees():
+            return None  # single-rank: never redirect
+        anchor = a.get("parent")
+        if op == "rename":
+            anchor = a.get("srcdir")
+        elif anchor is None:
+            anchor = a.get("ino")
+        if anchor is None:
+            return None
+        owner = self._owner_rank(int(anchor))
+        if owner == self.rank:
+            if op == "rename":
+                downer = self._owner_rank(int(a.get("dstdir", anchor)))
+                if downer != self.rank:
+                    return {"exdev": True}
+            return None
+        addr = self._read_ranks().get(owner)
+        if addr is None:
+            # owner not registered (mid-takeover): serve locally rather
+            # than bounce the client forever
+            return None
+        return {"rank": owner, "addr": list(addr)}
+
     def _handle(self, op: str, a: dict, session: str | None = None):
         """Returns (retval, result).  Negative errnos follow the reference
         (-2 ENOENT, -17 EEXIST, -20 ENOTDIR, -21 EISDIR, -39 ENOTEMPTY)."""
+        if op == "set_subtree":
+            # `mds export`-analog: pin a ROOT-LEVEL directory to a rank.
+            # Rank 0 is the authority for the subtree map (single writer)
+            if self.rank != 0:
+                return -116, {"rank": 0,
+                              "addr": list(self._read_ranks().get(0) or [])}
+            name = a["path"].strip("/")
+            if "/" in name or not name:
+                return -22, "subtree must be a top-level directory"
+            entry = self.dirs.get(ROOT_INO, {}).get(name)
+            if entry is None or entry.get("type") != "dir":
+                return -2, None
+            target = int(a["rank"])
+            if target not in self._read_ranks():
+                return -22, f"no active rank {target}"
+            # flush OUR dirty state first so the new owner reads current
+            # dirfrags when it adopts
+            self._flush()
+            subs = {
+                k: int(v)
+                for k, v in (self._obj_read("mds_subtrees") or {}).items()
+            }
+            subs[name] = target
+            self._obj_write("mds_subtrees", subs)
+            self._load_subtrees(force=True)
+            return 0, {"path": f"/{name}", "rank": target}
         if op == "lookup":
             entries = self.dirs.get(a["parent"])
             if entries is None:
@@ -944,6 +1267,15 @@ class MDSDaemon(Dispatcher):
                 if msg.tid in cache:
                     rv, result = cache[msg.tid]
                 else:
+                    redirect = self._check_redirect(msg.op, msg.args or {})
+                    if redirect is not None:
+                        # NOT cached: after a takeover the same tid must
+                        # re-execute here instead of replaying the stale
+                        # redirect
+                        conn.send_message(MClientReply(
+                            tid=msg.tid, retval=-116, result=redirect,
+                        ))
+                        return True
                     try:
                         rv, result = self._handle(
                             msg.op, msg.args or {}, session=sess

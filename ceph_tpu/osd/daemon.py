@@ -36,23 +36,21 @@ recovery) are kept.
 """
 from __future__ import annotations
 
+
 import threading
 import time
-from collections import OrderedDict
 
-from ..common.crc32c import crc32c
 from ..common.lockdep import make_lock
 from ..common.perf_counters import PerfCountersBuilder
 from ..ec.registry import ErasureCodePluginRegistry
 from ..mon.mon_client import MonClient
 from ..msg import Dispatcher, Messenger
 from ..msg.messenger import POLICY_LOSSLESS_PEER
-from ..osd.osdmap import OSDMap, PG_POOL_ERASURE, object_ps
+from ..osd.osdmap import OSDMap
 from ..store.memstore import MemStore
 from ..store.object_store import NotFound, Transaction
 from .messages import (
     MECSubOpRead,
-    MWatchNotify,
     MWatchNotifyAck,
     MECSubOpReadReply,
     MECSubOpWrite,
@@ -67,113 +65,39 @@ from .messages import (
     MPGQuery,
     MScrubShard,
     MScrubShardReply,
-    pack_data,
-    unpack_data,
 )
 from .pg_log import LogEntry, PGLog
 from .scheduler import MClockScheduler, QoSParams
-
-import numpy as np
-
-
-class PGState:
-    def __init__(self, pgid: str, pool_id: int, ps: int):
-        self.pgid = pgid
-        self.pool_id = pool_id
-        self.ps = ps
-        self.log = PGLog()
-        self.version = 0
-        # highest pool pg_num this PG has been split-scanned under (0 =
-        # scan on next pass; in-memory: a restart just rescans)
-        self.split_scanned = 0
-        # live-snap-id tuple this PG was last trimmed against (None =
-        # never trimmed; distinct from () = trimmed against empty set)
-        self.snap_trimmed: tuple | None = None
-        # epoch at which this PG's up/acting last CHANGED (reference:
-        # pg_history_t::same_interval_since): sub-ops stamped with an
-        # older epoch come from a primary of a PAST interval — a stale
-        # primary racing a map change — and must be refused, or its
-        # writes fork the PG's history behind the current interval's back
-        self.interval_start = 0
-        # interval this PG last completed its peering round in (phase 0
-        # of _recover_pg: query peers, adopt the authoritative log).
-        # A primary serves NO client ops until activated for the
-        # CURRENT interval (reference: PG activation gates ops) — a
-        # revived primary answering from its stale log/version would
-        # fork history or falsely ack writes it cannot place.
-        self.activated_interval = -1
-        # formal history of CLOSED up/acting intervals (reference:
-        # PastIntervals) — drives choose_acting's candidate pool, the
-        # build_prior activation block, and bounded stray probing
-        from .past_intervals import PastIntervals
-
-        self.past_intervals = PastIntervals()
-        # cumulative closures recorded this process-lifetime (observability
-        # only — prune clears the history, not this)
-        self.intervals_closed = 0
-        # newest map epoch under which this PG logged a write (persisted
-        # with the log): a revived OSD uses it as the starting point to
-        # REBUILD interval history from the mon's old maps — intervals
-        # that passed while it was down were never seen by _on_map
-        # (reference: pg_history_t + build via past OSDMaps)
-        self.last_map_epoch = 0
-        self.intervals_rebuilt = False
-        # shard collections known to hold this PG's meta locally (filled
-        # by _load_pg_meta/_log_txn so _save_intervals never rescans the
-        # whole store per map change)
-        self.meta_cids: set[str] = set()
-        # interval for which this primary last broadcast MPGClean
-        self.clean_broadcast_interval = -1
-        # reqid -> (retval, result) of COMPLETED mutations: a client
-        # resend whose reply was lost is answered from here instead of
-        # re-executed (reference: pg_log dup entries / osd_reqid_t);
-        # success-only so retryable -EAGAIN refusals still re-execute
-        self.reqid_cache: "OrderedDict[str, tuple]" = OrderedDict()
-        # reqid -> Event of a mutation mid-execution: a resend racing the
-        # original waits here instead of double-executing (reference:
-        # PrimaryLogPG::check_in_progress_op)
-        self.inflight: dict[str, threading.Event] = {}
-        self.lock = make_lock("osd::pg")
-
-    def meta_oid(self) -> str:
-        return "_pgmeta"
-
-
-# clone-object name separator (reference: clones are (oid, snapid) hobjects;
-# here the snapid rides in the name, invisible to client listings)
-CLONE_SEP = "\x02"
-
-# client ops covered by reqid dup detection (mutations whose re-execution
-# on a resend would be wrong or wasteful)
-MUTATING_OPS = frozenset(
-    {"write_full", "write", "append", "delete", "setxattr",
-     "omap_set", "omap_rm", "omap_clear", "exec"}
+from .ec_backend import ECBackendMixin
+from .object_ops import ObjectOpsMixin
+from .pg import (  # noqa: F401  (re-exported: long-standing import surface)
+    CLONE_SEP,
+    MUTATING_OPS,
+    PGState,
+    _current_generation,
 )
+from .primary_ops import PrimaryOpsMixin
+from .recovery import RecoveryMixin
+from .replicated_backend import ReplicatedBackendMixin
+from .scrub import ScrubMixin
+from .split_migration import SplitMigrationMixin
+from .subops import SubOpsMixin
+from .tiering import TieringMixin
 
 
-def _current_generation(chunks: dict, vers: dict,
-                        floor: int | None = None) -> dict:
-    """Drop stale-GENERATION chunks: shards versioned below the newest
-    version seen carry pre-RMW bytes that must never be mixed into a
-    decode (None = wildcard, e.g. backfill-rebuilt).  `floor` is the
-    LOG's newest data version for the object (when known): even if every
-    reachable chunk is older — the current copies are on a crashed
-    disk — the stale generation must read as MISSING, not as current,
-    or a later splice-and-rewrite would launder the rollback into a
-    fresh higher version (reference: the missing/unfound machinery)."""
-    present = [v for v in vers.values() if v is not None]
-    if floor is not None:
-        present.append(floor)
-    if not present:
-        return chunks
-    target = max(present)
-    return {
-        s: b for s, b in chunks.items()
-        if vers.get(s) is None or vers.get(s) == target
-    }
 
-
-class OSD(Dispatcher):
+class OSD(
+    Dispatcher,
+    PrimaryOpsMixin,
+    ECBackendMixin,
+    ObjectOpsMixin,
+    ReplicatedBackendMixin,
+    TieringMixin,
+    SubOpsMixin,
+    ScrubMixin,
+    SplitMigrationMixin,
+    RecoveryMixin,
+):
     """reference: src/osd/OSD.{h,cc} (boot, dispatch, heartbeats) +
     PrimaryLogPG/ECBackend op execution, collapsed to one class."""
 
@@ -601,6 +525,14 @@ class OSD(Dispatcher):
             # (split migration forwarding ops to the post-split primary)
             with self._lock:
                 self._sub_replies[msg.tid] = msg
+                # reap abandoned stragglers (wave replies past their
+                # shared deadline — _wait_replies leaves them here).
+                # tids are monotonic: evicting the oldest quarter only
+                # bites a live waiter if its reply sat unclaimed while
+                # 4096 newer ones arrived, far beyond any wave size
+                if len(self._sub_replies) > 4096:
+                    for tid in sorted(self._sub_replies)[:1024]:
+                        del self._sub_replies[tid]
                 self._cond.notify_all()
             return True
         if isinstance(msg, MPGQuery):
@@ -632,3051 +564,29 @@ class OSD(Dispatcher):
             )
             return self._sub_replies.pop(tid, None) if ok else None
 
-    # -- client ops (primary) ---------------------------------------------
-    def _handle_client_op(self, conn, msg: MOSDOp) -> None:
-        t0 = time.perf_counter()
-        self.logger.inc("op")
-        if msg.op == "write_full":
-            self.logger.inc("op_w")
-            self.logger.inc("op_w_bytes", len(msg.data or "") * 3 // 4)
-        elif msg.op == "read":
-            self.logger.inc("op_r")
-        try:
-            reply = self._execute_client_op(msg)
-        except Exception as e:  # never leave the client hanging
-            self.cct.dout("osd", 0, f"{self.whoami} op failed: {e!r}")
-            reply = MOSDOpReply(
-                tid=msg.tid, retval=-5, epoch=self.my_epoch(),
-                result=f"internal error: {e}",
-            )
-        if msg.op == "read" and reply.retval == 0 and reply.data:
-            self.logger.inc("op_r_bytes", len(reply.data) * 3 // 4)
-        self.logger.tinc("op_latency", time.perf_counter() - t0)
-        try:
-            conn.send_message(reply)
-        except (OSError, ConnectionError):
-            pass
-
-    def _execute_client_op(self, msg: MOSDOp) -> MOSDOpReply:
-        # the client targeted with a NEWER map than ours: wait for it
-        # before deciding anything (reference: OSD::require_same_or_newer_map
-        # waiting_for_map) — answering from the stale map would yield
-        # false 'no such pool' / wrong-primary verdicts
-        if msg.epoch and msg.epoch > self.my_epoch():
-            deadline = time.monotonic() + 10.0
-            while (
-                msg.epoch > self.my_epoch()
-                and time.monotonic() < deadline
-                and not self._stop.is_set()
-            ):
-                time.sleep(0.05)
-            if msg.epoch > self.my_epoch():
-                # still behind: NACK retryably — answering from a map the
-                # client provably outdates would yield FINAL wrong results
-                # ('no such pool', wrong primary)
-                return MOSDOpReply(
-                    tid=msg.tid, retval=-11, epoch=self.my_epoch(),
-                    result="waiting for newer osdmap",
-                )
-        m = self.osdmap
-        pool = m.pools.get(msg.pool) if m else None
-        if m is None or pool is None:
-            return MOSDOpReply(tid=msg.tid, retval=-2, epoch=self.my_epoch(),
-                               result="no such pool")
-        if (
-            msg.op in ("list", "scrub")
-            and msg.oid
-            and msg.oid.startswith(":pg:")
-        ):
-            ps = int(msg.oid[4:])  # pg-targeted op (tools/librados)
-        elif getattr(msg, "ps", None) is not None:
-            # explicit placement seed: the split migrator addressing an
-            # object still housed in its pre-split PG
-            ps = int(msg.ps)
-        else:
-            ps = object_ps(msg.oid, pool.pg_num) if msg.oid else 0
-        if msg.op == "scrub":
-            try:
-                result = self.scrub_pg(msg.pool, ps, repair=True)
-                return MOSDOpReply(tid=msg.tid, retval=0,
-                                   epoch=self.my_epoch(), result=result)
-            except RuntimeError:
-                pass  # not primary: fall through to the -116 NACK below
-        acting, primary = self._acting(msg.pool, ps)
-        if primary != self.id:
-            # client raced a map change (Objecter resend rule)
-            return MOSDOpReply(
-                tid=msg.tid, retval=-116, epoch=self.my_epoch(),
-                result={"primary": primary},
-            )
-        pg = self._pg(msg.pool, ps)
-        if pg.activated_interval != pg.interval_start:
-            # not yet peered for the current interval: refuse retryably
-            # and peer NOW (reference: ops wait on PG activation)
-            self._recovery_wakeup.set()
-            return MOSDOpReply(
-                tid=msg.tid, retval=-11, epoch=self.my_epoch(),
-                result="peering: pg not active in this interval",
-            )
-        # dup detection + in-flight serialization (reference: pg_log dup
-        # entries + PrimaryLogPG::check_in_progress_op): a resend of a
-        # completed mutation is answered without re-executing — from the
-        # reply cache, or (surviving primary changes) from the reqid the
-        # REPLICATED log entry carries; a resend racing the still-running
-        # original waits for it instead of double-executing
-        reqid = getattr(msg, "reqid", None)
-        if reqid is not None and msg.op in MUTATING_OPS:
-            rep = self._check_dup(pg, pool, acting, msg, reqid)
-            if rep is not None:
-                return rep
-            while True:
-                guard = threading.Event()
-                prior = pg.inflight.setdefault(reqid, guard)
-                if prior is guard:
-                    # we own the slot — but the original may have
-                    # COMPLETED between our _check_dup miss and now
-                    # (check-then-act): re-check before executing
-                    rep = self._check_dup(pg, pool, acting, msg, reqid)
-                    if rep is not None:
-                        pg.inflight.pop(reqid, None)
-                        guard.set()
-                        return rep
+    def _wait_replies(self, tids, deadline: float) -> dict:
+        """Collect replies for MANY tids under one SHARED deadline
+        (advisor r4: N sequential per-reply waits made degraded-read
+        stray probing O(N * timeout); a wave is bounded by the single
+        deadline).  Returns {tid: reply} for those that arrived; late
+        stragglers stay in _sub_replies for the reaper."""
+        out: dict = {}
+        pending = set(tids)
+        with self._lock:
+            while pending:
+                for tid in [t for t in pending if t in self._sub_replies]:
+                    out[tid] = self._sub_replies.pop(tid)
+                    pending.discard(tid)
+                if not pending:
                     break
-                if not prior.wait(60.0):
-                    # original STILL running (e.g. a long degraded
-                    # splice): executing now would double-apply — refuse
-                    # retryably and let the next resend re-check
-                    return MOSDOpReply(
-                        tid=msg.tid, retval=-11, epoch=self.my_epoch(),
-                        result="op with same reqid still in flight",
-                    )
-                rep = self._check_dup(pg, pool, acting, msg, reqid)
-                if rep is not None:
-                    return rep
-                # the original died before logging anything — loop back
-                # to CONTEND for the slot (setdefault): two waiters must
-                # not both install themselves and double-execute
-            try:
-                return self._execute_routed_op(pg, pool, acting, ps, msg)
-            finally:
-                pg.inflight.pop(reqid, None)
-                guard.set()
-        return self._execute_routed_op(pg, pool, acting, ps, msg)
-
-    def _check_dup(self, pg, pool, acting, msg, reqid) -> MOSDOpReply | None:
-        """Reply for an already-seen reqid, or None to execute."""
-        hit = pg.reqid_cache.get(reqid)
-        if hit is not None and hit[0] == "forked":
-            # executed here in a DEAD interval: the fork is invisible to
-            # the real history; re-execute (a still-stale primary gets
-            # deposed again until its map catches up)
-            return None
-        if hit is None:
-            v = pg.log.find_reqid(reqid)
-            if v is not None:
-                hit = ("applied", v)
-        if hit is None:
-            return None
-        if hit[0] == "done":
-            return MOSDOpReply(tid=msg.tid, retval=hit[1],
-                               epoch=self.my_epoch(), result=hit[2])
-        # ("applied", v): the op mutated state exactly once but was
-        # under-acked (< min_size commits) at the time.  Never re-execute.
-        # Success is reported only when the write has ACTUALLY reached
-        # min_size shards — counted from the per-object version stamps,
-        # not mere reachability (reachable-but-unrecovered shards don't
-        # hold the data yet).  Deletes are idempotent at the log level:
-        # applied = done.
-        if msg.op == "delete":
-            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                               result={"version": pg.version, "dup": True})
-        holding = 0
-        is_ec = pool.type == PG_POOL_ERASURE
-        for shard, osd in enumerate(acting):
-            if osd < 0:
-                continue
-            # replicated pools keep every replica in the shard-0
-            # collection; only EC pools have per-shard collections
-            store_shard = shard if is_ec else 0
-            if osd == self.id:
-                v = self._stored_ver(self._cid(pg.pgid, store_shard),
-                                     msg.oid)
-                if v is not None and v >= hit[1]:
-                    holding += 1
-                continue
-            if not self.osdmap.is_up(osd):
-                continue
-            tid = self._next_tid()
-            try:
-                self._conn_to_osd(osd).send_message(MECSubOpRead(
-                    tid=tid, pgid=pg.pgid, oid=msg.oid, shard=store_shard,
-                    offsets=[], epoch=self.my_epoch(),
-                ))
-            except (OSError, ConnectionError):
-                continue
-            rep = self._wait_reply(tid, timeout=5.0)
-            if rep is None or rep.retval != 0:
-                continue
-            v = getattr(rep, "ver", None)
-            if v is not None and v >= hit[1]:
-                holding += 1
-        if holding >= pool.min_size:
-            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                               result={"version": pg.version, "dup": True})
-        # the op is durably logged but under-replicated: recovery is the
-        # only path to an ack, so kick it rather than wait for the tick
-        self._recovery_wakeup.set()
-        return MOSDOpReply(
-            tid=msg.tid, retval=-11, epoch=self.my_epoch(),
-            result=f"applied at v{hit[1]}; {holding} shards hold it "
-                   f"< min_size {pool.min_size}",
-        )
-
-    def _execute_routed_op(self, pg, pool, acting, ps, msg) -> MOSDOpReply:
-        if msg.op == "write" and int(msg.off or 0) < 0:
-            # reference: negative offsets are -EINVAL; Python slicing
-            # would otherwise silently splice into the object's tail
-            return MOSDOpReply(tid=msg.tid, retval=-22,
-                               epoch=self.my_epoch(),
-                               result="negative write offset")
-        # cache-tier front-end: a PG in a cache pool stages/proxies/
-        # whiteouts before normal execution (reference: PrimaryLogPG::
-        # maybe_handle_cache_detail runs before do_op proper)
-        if pool.tier_of >= 0 and pool.cache_mode != "none":
-            rep = self._cache_tier_op(pg, pool, acting, ps, msg)
-            if rep is not None:
-                return self._record_reqid(pg, msg, rep)
-        # pool snapshots (reference: make_writeable's clone-on-write +
-        # SnapSet resolution in PrimaryLogPG)
-        # clone against the newest LIVE snap (snap_seq never resets, and
-        # cloning for snaps that no longer exist would leak un-trimmable
-        # copies on every first write); the client's snap context covers
-        # the window where this map lags a fresh mksnap
-        live_max = max(pool.snaps, default=0)
-        snap_seq = max(live_max, int(getattr(msg, "snap_seq", 0) or 0))
-        if (
-            msg.op in ("write_full", "write", "append", "delete")
-            and snap_seq
-            and msg.oid
-            and CLONE_SEP not in msg.oid
-            and getattr(msg, "ps", None) is None
-            # explicit-ps ops are internal machinery (split migration,
-            # trim), not client mutations: the split's old-PG delete must
-            # not mint a stranded clone — the head's bytes live on,
-            # unchanged, in the post-split PG
-        ):
-            try:
-                head_existed = self._maybe_clone(pg, pool, msg.oid, snap_seq)
-            except Exception as e:
-                # clone failures are overwhelmingly transient races (a
-                # map change mid-op re-targeting the internal clone
-                # write, a peer mid-recovery): refuse RETRYABLY so the
-                # client resends to the current primary — a fatal -EIO
-                # here would fail a write that the next attempt performs
-                # cleanly
-                return MOSDOpReply(
-                    tid=msg.tid, retval=-11, epoch=self.my_epoch(),
-                    result=f"snap clone failed: {e}",
-                )
-            if msg.op in ("write_full", "write", "append") and not head_existed:
-                rep = (
-                    self._ec_op(pg, pool, acting, msg)
-                    if pool.type == PG_POOL_ERASURE
-                    else self._replicated_op(pg, pool, acting, msg)
-                )
-                if rep.retval == 0:
-                    try:
-                        self._mark_born(pg, pool, msg.oid, snap_seq)
-                    except Exception as e:
-                        # same contract as _set_born: a lost born marker
-                        # would surface this object in snap views older
-                        # than its creation, so fail the write instead
-                        return MOSDOpReply(
-                            tid=msg.tid, retval=-5, epoch=self.my_epoch(),
-                            result=f"snapborn mark failed: {e}",
-                        )
-                return self._record_reqid(pg, msg, rep)
-        if (
-            msg.op == "read"
-            and getattr(msg, "snapid", None)
-            and CLONE_SEP not in msg.oid
-        ):
-            clone_oid = self._resolve_snap_read(
-                pg, pool, acting, msg.oid, int(msg.snapid)
-            )
-            if clone_oid is None:
-                # object was created after the snapshot
-                return MOSDOpReply(
-                    tid=msg.tid, retval=-2, epoch=self.my_epoch(),
-                    result="did not exist at snap",
-                )
-            if clone_oid != msg.oid:
-                msg = MOSDOp(
-                    tid=msg.tid, pool=msg.pool, oid=clone_oid, op="read",
-                    epoch=msg.epoch, off=msg.off, length=msg.length,
-                    ps=ps,
-                )
-        if pool.type == PG_POOL_ERASURE:
-            rep = self._ec_op(pg, pool, acting, msg)
-        else:
-            rep = self._replicated_op(pg, pool, acting, msg)
-        return self._record_reqid(pg, msg, rep)
-
-    def _collect_subop_acks(self, tids: dict, acting=None):
-        """(acked_remote, deposed, failed_osds) over a tid->shard map.
-        `deposed` = some peer answered -116: it is in a NEWER interval
-        than the epoch we stamped — we may have been deposed mid-op."""
-        acked = 0
-        deposed = False
-        failed: list[int] = []
-        for tid, shard in tids.items():
-            rep = self._wait_reply(tid)
-            if rep is not None and rep.retval == 0:
-                acked += 1
-            elif rep is not None and rep.retval == -116:
-                deposed = True
-            elif acting is not None:
-                failed.append(acting[shard])
-        return acked, deposed, failed
-
-    def _record_reqid(self, pg, msg, rep: MOSDOpReply) -> MOSDOpReply:
-        """Remember a completed mutation's outcome for dup detection.
-        Successes cache the full reply; an UNDER-ACKED mutation (applied
-        and logged, but < min_size commits, reported -11) caches the
-        applied-at version so the resend re-evaluates availability
-        instead of re-executing — re-running an append/RMW would
-        double-apply.  Plain refusals (gate -11, -ESTALE) that mutated
-        nothing cache nothing and re-execute freely."""
-        reqid = getattr(msg, "reqid", None)
-        if reqid is None or msg.op not in MUTATING_OPS:
-            return rep
-        if rep.retval == 0:
-            pg.reqid_cache[reqid] = ("done", rep.retval, rep.result)
-        elif (
-            rep.retval == -116
-            and isinstance(rep.result, dict)
-            and rep.result.get("deposed")
-        ):
-            # the op executed on a DEPOSED primary: its local log entry
-            # is a fork in a dead interval — the marker stops this OSD's
-            # own log from answering the resend as an "applied" dup
-            pg.reqid_cache[reqid] = ("forked",)
-        elif (
-            rep.retval == -11
-            and isinstance(rep.result, dict)
-            and "applied" in rep.result
-        ):
-            pg.reqid_cache[reqid] = ("applied", rep.result["applied"])
-            self._recovery_wakeup.set()  # under-acked: converge now
-        else:
-            return rep
-        while len(pg.reqid_cache) > 1024:
-            pg.reqid_cache.popitem(last=False)
-        return rep
-
-    # -- pool snapshots ----------------------------------------------------
-    def _clone_oid(self, oid: str, snapid: int) -> str:
-        return f"{oid}{CLONE_SEP}{snapid:08d}"
-
-    def _maybe_clone(self, pg, pool, oid: str, snap_seq: int) -> None:
-        """Clone-on-first-write-after-snap: preserve the head's bytes as
-        clone `snap_seq` before an overwrite/delete mutates it.  The clone
-        is a full normal object in the SAME PG (explicit ps), so
-        replication/EC encoding, recovery, and scrub all cover it.
-
-        The stat->read->write sequence is serialized under _clone_mutex:
-        two concurrent writers racing it could otherwise both miss the
-        stat and the later one would capture POST-snap bytes as the
-        clone, corrupting the snapshot view."""
-        with self._clone_mutex:
-            return self._maybe_clone_locked(pg, pool, oid, snap_seq)
-
-    def _maybe_clone_locked(self, pg, pool, oid: str, snap_seq: int) -> bool:
-        """Returns True when the head EXISTED (clone made or already
-        present); False = brand-new object this write creates."""
-        clone = self._clone_oid(oid, snap_seq)
-        e = self.my_epoch()
-        st = self._execute_client_op(MOSDOp(
-            tid=self._next_tid(), pool=pool.pool_id, oid=clone, op="stat",
-            epoch=e, ps=pg.ps,
-        ))
-        if st.retval == 0:
-            # this snap generation already preserved; a retried clone
-            # whose marker write was interrupted gets repaired here (the
-            # marker is what keeps born-after objects out of older views)
-            if self._born_of(pg, pool, clone) == 0:
-                born = self._born_of(pg, pool, oid)
-                if born:
-                    self._set_born(pg, pool, clone, born)
-            return True
-        r = self._execute_client_op(MOSDOp(
-            tid=self._next_tid(), pool=pool.pool_id, oid=oid, op="read",
-            epoch=e, ps=pg.ps, off=0, length=0,
-        ))
-        if r.retval != 0:
-            return False  # no head: nothing to preserve
-        w = self._execute_client_op(MOSDOp(
-            tid=self._next_tid(), pool=pool.pool_id, oid=clone,
-            op="write_full", data=r.data, epoch=e, ps=pg.ps,
-        ))
-        if w.retval != 0:
-            raise RuntimeError(f"clone write: {w.result}")
-        born = self._born_of(pg, pool, oid)
-        if born:
-            self._set_born(pg, pool, clone, born)
-        return True
-
-    def _set_born(self, pg, pool, oid: str, born: int) -> None:
-        r = self._execute_client_op(MOSDOp(
-            tid=self._next_tid(), pool=pool.pool_id, oid=oid,
-            op="setxattr", epoch=self.my_epoch(), ps=pg.ps,
-            data={"_snapborn": pack_data(str(born).encode())},
-        ))
-        if r.retval != 0:
-            # fail the client write rather than leave a clone that would
-            # surface a born-after object in older snap views
-            raise RuntimeError(f"clone born-marker write: {r.result}")
-
-    def _born_of(self, pg, pool, oid: str) -> int:
-        """Snap generation an object (head or clone) was created in; 0 =
-        pre-snapshot or unmarked."""
-        xr = self._execute_client_op(MOSDOp(
-            tid=self._next_tid(), pool=pool.pool_id, oid=oid,
-            op="getxattrs", epoch=self.my_epoch(), ps=pg.ps,
-        ))
-        if xr.retval == 0 and isinstance(xr.result, dict):
-            born = xr.result.get("_snapborn")
-            if born is not None:
-                try:
-                    return int(unpack_data(born).decode())
-                except (ValueError, AttributeError):
-                    pass
-        return 0
-
-    def _mark_born(self, pg, pool, oid: str, snap_seq: int) -> None:
-        """Stamp a newly created object with the snap generation it was
-        born in, so snapshot reads older than its creation return ENOENT
-        instead of the head (reference: SnapSet knows object existence
-        per snap).  Rides the replicated user-xattr path under a
-        reserved '_'-name the client surface filters out.  Raises on
-        persistent failure (after one retry) — the caller fails the
-        client write, matching _set_born's contract."""
-        r = None
-        for _ in range(2):
-            r = self._execute_client_op(MOSDOp(
-                tid=self._next_tid(), pool=pool.pool_id, oid=oid,
-                op="setxattr", epoch=self.my_epoch(), ps=pg.ps,
-                data={"_snapborn": pack_data(str(snap_seq).encode())},
-            ))
-            if r.retval == 0:
-                return
-        raise RuntimeError(f"snapborn marker write: {r.result}")
-
-    def _primary_cid(self, pg, pool, acting) -> str:
-        shard = acting.index(self.id) if pool.type == PG_POOL_ERASURE else 0
-        return self._cid(pg.pgid, shard)
-
-    def _resolve_snap_read(
-        self, pg, pool, acting, oid: str, snapid: int
-    ) -> str:
-        """Oldest clone at-or-after `snapid` serves the snapshot view; no
-        such clone means the head hasn't changed since (or never existed).
-        reference: SnapSet::get_clone_bytes / find_object lookup."""
-        prefix = oid + CLONE_SEP
-        try:
-            names = self.store.list_objects(
-                self._primary_cid(pg, pool, acting)
-            )
-        except (NotFound, KeyError):
-            return oid
-        ids = sorted(
-            int(n[len(prefix):]) for n in names if n.startswith(prefix)
-        )
-        for c in ids:
-            if c >= snapid:
-                clone = self._clone_oid(oid, c)
-                # the clone inherits its head's born marker: a clone made
-                # AFTER a post-snap creation must not make the object
-                # appear in older snap views
-                if self._born_of(pg, pool, clone) >= snapid:
-                    return None
-                return clone
-        # no clone: the head serves the snap view — unless the object was
-        # born after the snapshot (its _snapborn generation >= snapid)
-        if self._born_of(pg, pool, oid) >= snapid:
-            return None
-        return oid
-
-    def _snaptrim_pass(self) -> None:
-        """Remove clones no live snap needs (reference: the snap-trim
-        queue PrimaryLogPG works through after a snap is deleted, fed by
-        SnapMapper).  A clone c of a head covers snaps in (prev_clone, c];
-        with none of those alive it is garbage."""
-        m = self.osdmap
-        if m is None:
-            return
-        for pgid, pg in list(self.pgs.items()):
-            if self._stop.is_set():
-                return
-            pool = m.pools.get(pg.pool_id)
-            if pool is None:
-                continue
-            live_key = tuple(sorted(pool.snaps))
-            if pg.snap_trimmed == live_key:
-                continue
-            acting, primary = self._acting(pg.pool_id, pg.ps)
-            if primary != self.id or self.id not in acting:
-                continue
-            try:
-                self._snaptrim_pg(pg, pool, acting, live_key)
-                pg.snap_trimmed = live_key
-            except Exception as e:
-                self.cct.dout(
-                    "osd", 1, f"{self.whoami} snaptrim {pgid}: {e!r}"
-                )
-
-    def _snaptrim_pg(self, pg, pool, acting, live_key) -> None:
-        try:
-            names = self.store.list_objects(
-                self._primary_cid(pg, pool, acting)
-            )
-        except (NotFound, KeyError):
-            return
-        by_head: dict[str, list[int]] = {}
-        for n in names:
-            if CLONE_SEP in n:
-                head, _, suffix = n.partition(CLONE_SEP)
-                by_head.setdefault(head, []).append(int(suffix))
-        live = sorted(live_key)
-        snap_seq = max([pool.snap_seq, *live_key]) if live_key else pool.snap_seq
-        for head, ids in by_head.items():
-            ids.sort()
-            prev = 0
-            for c in ids:
-                if c > snap_seq:
-                    # a generation this map hasn't seen yet (clone minted
-                    # from a newer client's snap context right after a
-                    # mksnap): deleting it would destroy the new snapshot
-                    prev = c
-                    continue
-                needed = any(prev < s <= c for s in live)
-                prev = c
-                if needed:
-                    continue
-                d = self._execute_client_op(MOSDOp(
-                    tid=self._next_tid(), pool=pool.pool_id,
-                    oid=self._clone_oid(head, c), op="delete",
-                    epoch=self.my_epoch(), ps=pg.ps,
-                ))
-                if d.retval != 0:
-                    raise RuntimeError(f"trim {head}@{c}: {d.result}")
-
-    # .. EC pool ...........................................................
-    def _ec_op(self, pg: PGState, pool, acting: list[int], msg: MOSDOp):
-        codec = self._codec_for_pool(pool)
-        my_shard = acting.index(self.id)
-        if msg.op in ("write_full", "write", "append", "delete"):
-            # min_size gate BEFORE any mutation (reference: PrimaryLogPG
-            # refuses ops while acting < pool.min_size): refusing up front
-            # both protects durability (never take a write we may not be
-            # able to re-protect) and keeps -EAGAIN retries side-effect
-            # free — a partially-applied-then-refused write would make
-            # the client resend double-apply
-            reachable = sum(
-                1 for o in acting
-                if o >= 0 and (o == self.id or self.osdmap.is_up(o))
-            )
-            if reachable < pool.min_size:
-                return MOSDOpReply(
-                    tid=msg.tid, retval=-11, epoch=self.my_epoch(),
-                    result=f"{reachable} acting shards reachable < "
-                           f"min_size {pool.min_size}",
-                )
-        if msg.op == "write_full":
-            data = unpack_data(msg.data) or b""
-            with pg.lock:
-                return self._ec_write(
-                    pg, pool, codec, acting, my_shard, msg, data
-                )
-        if msg.op in ("write", "append"):
-            data = unpack_data(msg.data) or b""
-            with pg.lock:
-                return self._ec_rmw(
-                    pg, pool, codec, acting, my_shard, msg, data
-                )
-        if msg.op == "read":
-            return self._ec_read(pg, codec, acting, msg)
-        if msg.op == "delete":
-            with pg.lock:
-                return self._ec_delete(pg, acting, my_shard, msg)
-        if msg.op == "stat":
-            try:
-                size = int(
-                    self.store.getattr(
-                        self._cid(pg.pgid, my_shard), msg.oid, "size"
-                    )
-                )
-                return MOSDOpReply(tid=msg.tid, retval=0,
-                                   epoch=self.my_epoch(),
-                                   result={"size": size, "version": pg.version})
-            except (NotFound, KeyError):
-                return MOSDOpReply(tid=msg.tid, retval=-2,
-                                   epoch=self.my_epoch(), result="not found")
-        if msg.op == "list":
-            oids = sorted(
-                o for o in self.store.list_objects(self._cid(pg.pgid, my_shard))
-                if not o.startswith("_") and CLONE_SEP not in o
-            )
-            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                               result={"oids": oids})
-        if msg.op in ("setxattr", "getxattrs"):
-            return self._xattr_op(pg, acting, my_shard, msg)
-        if msg.op.startswith("omap_") or msg.op == "exec":
-            # reference parity: EC pools support neither omap nor the
-            # omap-backed object classes
-            # (PrimaryLogPG::do_osd_ops returns -EOPNOTSUPP)
-            return MOSDOpReply(tid=msg.tid, retval=-95,
-                               epoch=self.my_epoch(),
-                               result=f"{msg.op} not supported on EC pools")
-        if msg.op in ("watch", "unwatch", "notify"):
-            return self._watch_op(pg, pool, msg)
-        return MOSDOpReply(tid=msg.tid, retval=-22, epoch=self.my_epoch(),
-                           result=f"bad op {msg.op}")
-
-    # .. user xattrs (both pool types) .....................................
-    def _xattr_op(self, pg, acting, my_shard, msg) -> MOSDOpReply:
-        """librados xattr surface (reference: rados_setxattr/getxattrs).
-        User attrs live as `u_<name>` on every shard so any future primary
-        answers; updates append a pg_log entry so recovery replays them."""
-        cid = self._cid(pg.pgid, my_shard)
-        if msg.op == "getxattrs":
-            try:
-                attrs = {
-                    n[2:]: pack_data(v)
-                    for n, v in self.store.getattrs(cid, msg.oid).items()
-                    if n.startswith("u_")
-                }
-            except (NotFound, KeyError):
-                # degraded primary (remap before recovery): any shard that
-                # holds the object carries the same user xattrs
-                attrs = self._probe_peer_xattrs(pg, acting, msg.oid)
-                if attrs is None:
-                    return MOSDOpReply(
-                        tid=msg.tid, retval=-2, epoch=self.my_epoch(),
-                        result="not found",
-                    )
-            return MOSDOpReply(
-                tid=msg.tid, retval=0, epoch=self.my_epoch(), result=attrs
-            )
-        updates = msg.data or {}
-        pool = self.osdmap.pools.get(pg.pool_id)
-        # user-xattr content flushes to the base pool: a cache-pool user
-        # setxattr re-dirties the object atomically (merged into the SAME
-        # update set / sub-ops) and stamps `ver` so the flush's version
-        # recheck also sees xattr-only mutations.  Tier-marker updates
-        # (tier.*) are the dirty-tracking machinery itself and must not
-        # self-trigger.
-        user_mutation = any(not n.startswith("tier.") for n in updates)
-        stamp_ver = False
-        if (user_mutation and self._tier_autoclean(pool, msg.oid)
-                and "tier.clean" not in updates):
-            updates = dict(updates)
-            updates["tier.clean"] = None
-            stamp_ver = True
-        with pg.lock:
-            try:
-                self.store.stat(cid, msg.oid)
-            except (NotFound, KeyError):
-                # no local copy: object missing cluster-wide (-2, final)
-                # vs degraded primary pending recovery (-11, retryable)
-                if self._probe_peer_xattrs(pg, acting, msg.oid) is None:
-                    return MOSDOpReply(
-                        tid=msg.tid, retval=-2, epoch=self.my_epoch(),
-                        result="not found",
-                    )
-                return MOSDOpReply(
-                    tid=msg.tid, retval=-11, epoch=self.my_epoch(),
-                    result="object not recovered here yet",
-                )
-            version = pg.version + 1
-            entry = LogEntry(version, "attr", msg.oid)
-            tids: dict[int, int] = {}
-            for shard, osd in enumerate(acting):
-                if osd == self.id or osd < 0 or not self.osdmap.is_up(osd):
-                    continue
-                tid = self._next_tid()
-                tids[tid] = shard
-                try:
-                    self._conn_to_osd(osd).send_message(
-                        MECSubOpWrite(
-                            tid=tid, pgid=pg.pgid, oid=msg.oid,
-                            shard=shard if self._is_ec_pg(pg) else 0,
-                            data=None, crc=None, version=version,
-                            entry=entry.to_list(), epoch=self.my_epoch(),
-                            xattrs=updates,
-                        )
-                    )
-                except (OSError, ConnectionError):
-                    tids.pop(tid, None)
-            t = Transaction()
-            self._apply_xattr_updates(t, cid, msg.oid, updates)
-            if stamp_ver:
-                t.setattr(cid, msg.oid, "ver", str(version).encode())
-            self._log_txn(t, cid, pg, entry)
-            self.store.queue_transaction(t)
-            a, deposed, _f = self._collect_subop_acks(tids)
-            acked = 1 + a
-        if deposed and (pool is None or acked < pool.min_size):
-            return MOSDOpReply(tid=msg.tid, retval=-116,
-                               epoch=self.my_epoch(),
-                               result={"deposed": True})
-        # same durability bar as write_full: the update must be on enough
-        # shards to survive (reference: xattr ops ride the same repop)
-        if pool is not None and acked < pool.min_size:
-            return MOSDOpReply(tid=msg.tid, retval=-11,
-                               epoch=self.my_epoch(),
-                               result=f"only {acked} shard commits")
-        return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                           result={"version": pg.version})
-
-    def _apply_xattr_updates(self, t: Transaction, cid: str, oid: str,
-                             updates: dict, snapshot: bool = False) -> None:
-        """Apply user-xattr updates {name: b64|None} to a transaction;
-        snapshot=True means `updates` is the complete set (recovery) and
-        any other u_* attr must go."""
-        try:
-            existing = {
-                n[2:] for n in self.store.getattrs(cid, oid)
-                if n.startswith("u_")
-            }
-        except (NotFound, KeyError):
-            existing = set()
-        for name, val in updates.items():
-            if val is None:
-                if name in existing:
-                    t.rmattr(cid, oid, f"u_{name}")
-            else:
-                t.setattr(cid, oid, f"u_{name}", unpack_data(val))
-        if snapshot:
-            for name in existing - set(updates):
-                t.rmattr(cid, oid, f"u_{name}")
-
-    def _probe_peer_xattrs(self, pg, acting, oid: str) -> dict | None:
-        """User xattrs for oid from the FRESHEST up shard (degraded
-        getxattrs).  Peers are ordered by their pg_log version so a
-        just-revived stale shard cannot answer with pre-update attrs;
-        metadata-only reads (offsets=[]) keep the object body off the
-        wire."""
-        is_ec = self._is_ec_pg(pg)
-        peers = []  # (version, shard, osd)
-        for shard, osd in enumerate(acting):
-            if osd < 0 or osd == self.id or not self.osdmap.is_up(osd):
-                continue
-            tid = self._next_tid()
-            try:
-                self._conn_to_osd(osd).send_message(
-                    MPGQuery(tid=tid, pgid=pg.pgid,
-                             shard=shard if is_ec else 0,
-                             epoch=self.my_epoch())
-                )
-            except (OSError, ConnectionError):
-                continue
-            rep = self._wait_reply(tid, timeout=5.0)
-            peers.append(
-                ((rep.version if rep is not None else 0) or 0, shard, osd)
-            )
-        for _v, shard, osd in sorted(peers, reverse=True):
-            tid = self._next_tid()
-            try:
-                self._conn_to_osd(osd).send_message(
-                    MECSubOpRead(
-                        tid=tid, pgid=pg.pgid, oid=oid,
-                        shard=shard if is_ec else 0,
-                        offsets=[], epoch=self.my_epoch(),
-                    )
-                )
-            except (OSError, ConnectionError):
-                continue
-            rep = self._wait_reply(tid, timeout=5.0)
-            if rep is not None and rep.retval == 0:
-                return rep.xattrs or {}
-        return None
-
-    def _is_ec_pg(self, pg) -> bool:
-        pool = self.osdmap.pools.get(pg.pool_id) if self.osdmap else None
-        return bool(pool and pool.type == PG_POOL_ERASURE)
-
-    def _ec_write(self, pg, pool, codec, acting, my_shard, msg, data) -> MOSDOpReply:
-        n = codec.get_chunk_count()
-        enc = codec.encode(set(range(n)), data)
-        version = pg.version + 1
-        # entry rides a 4th element (object size) so every shard can answer
-        # size/stat even after the primary moves
-        entry = LogEntry(version, "modify", msg.oid,
-                         reqid=getattr(msg, "reqid", None))
-        wire_entry = entry.to_list()
-        tids: dict[int, int] = {}
-        for shard, osd in enumerate(acting):
-            if shard == my_shard or osd < 0:
-                continue
-            if not self.osdmap.is_up(osd):
-                continue
-            chunk = np.asarray(enc[shard], np.uint8).tobytes()
-            tid = self._next_tid()
-            tids[tid] = shard
-            try:
-                self._conn_to_osd(osd).send_message(
-                    MECSubOpWrite(
-                        tid=tid, pgid=pg.pgid, oid=msg.oid, shard=shard,
-                        data=pack_data(chunk), crc=crc32c(chunk),
-                        version=version, entry=wire_entry,
-                        epoch=self.my_epoch(), osize=len(data),
-                    )
-                )
-            except (OSError, ConnectionError):
-                tids.pop(tid, None)
-                self.mc.report_failure(osd)
-        # local shard commit (chunk + log in one transaction)
-        cid = self._cid(pg.pgid, my_shard)
-        chunk = np.asarray(enc[my_shard], np.uint8).tobytes()
-        t = Transaction()
-        t.try_create_collection(cid)
-        t.write(cid, msg.oid, 0, chunk)
-        t.truncate(cid, msg.oid, len(chunk))
-        t.setattr(cid, msg.oid, "hinfo", str(crc32c(chunk)).encode())
-        t.setattr(cid, msg.oid, "size", str(len(data)).encode())
-        t.setattr(cid, msg.oid, "ver", str(version).encode())
-        self._log_txn(t, cid, pg, entry)
-        self.store.queue_transaction(t)
-        a, deposed, failed = self._collect_subop_acks(tids, acting)
-        acked = 1 + a
-        for osd in failed:
-            self.mc.report_failure(osd)
-        if deposed and acked < pool.min_size:
-            # deposed mid-op below quorum: the local apply is a FORK in a
-            # dead interval — never acked, never answered as a dup
-            # (_record_reqid marks the reqid "forked" so the resend
-            # re-executes on the real primary).  At >= min_size the op
-            # is durable in THIS interval despite the stray -116 (e.g. a
-            # peer that just rebooted): ack it normally below.
-            return MOSDOpReply(tid=msg.tid, retval=-116,
-                               epoch=self.my_epoch(),
-                               result={"deposed": True})
-        # degraded-write policy: ack at min_size commits.  Shards that
-        # missed the write are reported to the mon and filled by delta
-        # recovery off the pg_log (reference: ECBackend requires min_size
-        # acting shards; recovery completes the stripe)
-        if acked >= pool.min_size:
-            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                               result={"version": pg.version, "acked": acked})
-        # structured under-ack refusal: the op IS applied+logged locally;
-        # "applied" lets dup detection refuse re-execution on the resend
-        return MOSDOpReply(tid=msg.tid, retval=-11, epoch=self.my_epoch(),
-                           result={"applied": pg.version, "acked": acked,
-                                   "error": "below min_size commits"})
-
-    # .. partial-stripe RMW ................................................
-    def _ec_object_size(self, pg, acting, oid: str):
-        """Stored object size (the `size` xattr), local shard preferred,
-        else reachable peers' metadata probes.  Returns an int, "absent"
-        (a shard DEFINITIVELY reported no such object), or "unknown"
-        (nobody answered either way — e.g. transient connection faults).
-        The distinction matters: treating unreachable as absent would
-        let a ranged write re-create an existing object as zeros."""
-        for shard, osd in enumerate(acting):
-            if osd != self.id:
-                continue
-            try:
-                return int(self.store.getattr(
-                    self._cid(pg.pgid, shard), oid, "size"))
-            except (NotFound, KeyError, ValueError):
-                break
-        verdict = "unknown"
-        best_size = None
-        best_ver = -1
-        for shard, osd in enumerate(acting):
-            if osd < 0 or osd == self.id or not self.osdmap.is_up(osd):
-                continue
-            tid = self._next_tid()
-            try:
-                self._conn_to_osd(osd).send_message(
-                    MECSubOpRead(tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
-                                 offsets=[], epoch=self.my_epoch())
-                )
-            except (OSError, ConnectionError):
-                continue
-            rep = self._wait_reply(tid)
-            if rep is None:
-                continue
-            if rep.retval == 0 and rep.size is not None:
-                # prefer the NEWEST-generation shard's size: a stale
-                # shard that missed the last append would hand back the
-                # old size and the append would overwrite live bytes
-                v = getattr(rep, "ver", None)
-                if v is None:
-                    v = 0
-                if v > best_ver or best_size is None:
-                    best_ver, best_size = v, int(rep.size)
-            elif rep.retval == -2:
-                verdict = "absent"  # a live shard is sure it isn't there
-        if best_size is not None:
-            return best_size
-        return verdict
-
-    def _fetch_shard_range(self, pg, acting, shard: int, oid: str,
-                           off: int, ln: int):
-        """(`ln` bytes at `off` of one shard's stored chunk, that shard's
-        stored per-object version) — local or via a ranged MECSubOpRead.
-        (None, None) = holder down / chunk missing / short read."""
-        osd = acting[shard] if shard < len(acting) else -1
-        if osd == self.id:
-            cid = self._cid(pg.pgid, shard)
-            try:
-                b = self.store.read(cid, oid, off, ln)
-            except (NotFound, KeyError):
-                return None, None
-            return (bytes(b), self._stored_ver(cid, oid)) \
-                if len(b) == ln else (None, None)
-        if osd < 0 or not self.osdmap.is_up(osd):
-            return None, None
-        tid = self._next_tid()
-        try:
-            self._conn_to_osd(osd).send_message(
-                MECSubOpRead(tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
-                             offsets=[[off, ln]], epoch=self.my_epoch())
-            )
-        except (OSError, ConnectionError):
-            return None, None
-        rep = self._wait_reply(tid)
-        if rep is None or rep.retval != 0:
-            return None, None
-        b = unpack_data(rep.data) or b""
-        return (b, rep.ver) if len(b) == ln else (None, None)
-
-    def _stored_ver(self, cid: str, oid: str) -> int | None:
-        """Per-object version xattr (object_info_t analog); None =
-        unversioned (legacy object or backfill-pushed wildcard)."""
-        try:
-            v = self.store.getattr(cid, oid, "ver")
-        except (NotFound, KeyError):
-            return None
-        try:
-            return int(v)
-        except (TypeError, ValueError):
-            return None
-
-    def _rmw_apply_local(self, t: Transaction, cid: str, oid: str,
-                         full: bytearray, off: int, payload: bytes,
-                         xor: bool) -> None:
-        """Splice (xor=False) or GF-XOR (xor=True) `payload` into the
-        primary's own pre-validated chunk bytes `full` at `off`, keeping
-        the hinfo CRC current."""
-        if xor:
-            seg = (
-                np.frombuffer(bytes(full[off:off + len(payload)]), np.uint8)
-                ^ np.frombuffer(payload, np.uint8)
-            ).tobytes()
-        else:
-            seg = payload
-        full[off:off + len(seg)] = seg
-        t.write(cid, oid, off, seg)
-        t.setattr(cid, oid, "hinfo", str(crc32c(bytes(full))).encode())
-
-    def _ec_full_splice(self, pg, pool, codec, acting, my_shard, msg,
-                        data: bytes, off: int, size) -> MOSDOpReply:
-        """RMW slow path: read the whole (possibly degraded) object,
-        splice, re-encode everything via the full-object write.  Used when
-        the write grows the stripe, the codec is sub-chunked (CLAY), or an
-        affected shard's old bytes are unreachable (reconstruction needed).
-        """
-        old = b""
-        if size:
-            rd = self._ec_read(pg, codec, acting, MOSDOp(
-                tid=self._next_tid(), pool=msg.pool, oid=msg.oid, op="read",
-                epoch=self.my_epoch(), ps=pg.ps,
-            ))
-            if rd.retval != 0:
-                # the current generation is temporarily sourceless
-                # (unfound-pending): refuse retryably — serving/splicing
-                # a stale base would launder a rollback into a fresh
-                # version (reference: ops wait on missing objects)
-                return MOSDOpReply(
-                    tid=msg.tid, retval=-11, epoch=self.my_epoch(),
-                    result=f"rmw base unreadable now: {rd.result}",
-                )
-            old = unpack_data(rd.data) or b""
-        buf = bytearray(max(len(old), off + len(data)))
-        buf[:len(old)] = old
-        buf[off:off + len(data)] = data
-        return self._ec_write(pg, pool, codec, acting, my_shard, msg,
-                              bytes(buf))
-
-    def _ec_rmw(self, pg, pool, codec, acting, my_shard, msg,
-                data: bytes) -> MOSDOpReply:
-        """Ranged write / append on an EC object (reference:
-        src/osd/ECTransaction.cc :: generate_transactions — the RMW that
-        reads the old stripe remainder and re-encodes the touched stripes;
-        expressed here as a PARITY-DELTA update, the optimized-EC
-        formulation, which is also the TPU-shaped one: the parity delta is
-        one GF matrix apply over just the touched column window).
-
-        Correctness rests on GF-linearity of every registered plugin's
-        encode_chunks: parity(new) = parity(old) XOR parity(delta), column
-        by column.  Shards that would fuse stale bytes with the delta
-        refuse the sub-op (version-jump guard in _handle_sub_write) and
-        are rebuilt by log-delta recovery instead."""
-        k = codec.get_data_chunk_count()
-        n = codec.get_chunk_count()
-        size = self._ec_object_size(pg, acting, msg.oid)
-        if size == "unknown":
-            # can't tell whether the object exists (transient faults):
-            # refusing retryably is the only safe answer — guessing
-            # "absent" would zero-fill over live data
-            return MOSDOpReply(tid=msg.tid, retval=-11, epoch=self.my_epoch(),
-                               result="object existence unknown (peers "
-                                      "unreachable)")
-        if size == "absent":
-            size = None
-        off = (size or 0) if msg.op == "append" else int(msg.off or 0)
-        if not data:
-            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                               result={"version": pg.version})
-        end = off + len(data)
-        if size is None:
-            # object doesn't exist yet: a ranged write below `off` reads
-            # back as zeros (reference: sparse write semantics)
-            return self._ec_write(pg, pool, codec, acting, my_shard, msg,
-                                  b"\x00" * off + data)
-        L = codec.get_chunk_size(size) if size else 0
-        sub_chunks = 1
-        try:
-            sub_chunks = codec.get_sub_chunk_count()
-        except Exception:
-            pass
-        try:
-            delta_ok = bool(codec.supports_parity_delta())
-        except Exception:
-            delta_ok = False
-        if size == 0 or end > k * L or sub_chunks != 1 or not delta_ok:
-            # codecs whose encode is not byte-column-local (bitmatrix
-            # packet techniques, CLAY sub-chunks, LRC remapping) re-encode
-            # the full stripe — a windowed delta would corrupt parity
-            return self._ec_full_splice(pg, pool, codec, acting, my_shard,
-                                        msg, data, off, size)
-        # local pre-validation: the delta fast path needs the primary's
-        # own chunk present, rot-free, and version-stamped — the stamp is
-        # the authoritative old object version every other shard must
-        # match (the primary serialized all prior writes)
-        cid = self._cid(pg.pgid, my_shard)
-        try:
-            my_chunk = bytearray(self.store.read(cid, msg.oid))
-        except (NotFound, KeyError):
-            return self._ec_full_splice(pg, pool, codec, acting, my_shard,
-                                        msg, data, off, size)
-        my_ver = self._stored_ver(cid, msg.oid)
-        try:
-            stored_h = int(self.store.getattr(cid, msg.oid, "hinfo"))
-        except (NotFound, KeyError, ValueError):
-            stored_h = None
-        floor = pg.log.obj_newest.get(msg.oid)
-        if (
-            my_ver is None
-            or (floor is not None and my_ver < floor)
-            or len(my_chunk) != L
-            or (stored_h is not None and crc32c(bytes(my_chunk)) != stored_h)
-        ):
-            # unversioned legacy object, unexpected chunk length, or
-            # local rot (full-splice reads exclude the rotted chunk and
-            # the re-encode heals it)
-            return self._ec_full_splice(pg, pool, codec, acting, my_shard,
-                                        msg, data, off, size)
-        # per-data-shard touched segments: shard j holds object bytes
-        # [j*L, (j+1)*L) (contiguous-split layout, ErasureCode.encode_prepare)
-        segs: dict[int, tuple[int, bytes]] = {}
-        for j in range(k):
-            lo, hi = max(off, j * L), min(end, (j + 1) * L)
-            if lo < hi:
-                segs[j] = (lo - j * L, data[lo - off:hi - off])
-        c0 = min(o for o, _ in segs.values())
-        c1 = max(o + len(b) for o, b in segs.values())
-        w = c1 - c0
-        old: dict[int, bytes] = {}
-        for j, (o, b) in segs.items():
-            if j == my_shard:
-                old[j] = bytes(my_chunk[o:o + len(b)])
-                continue
-            ob, over = self._fetch_shard_range(
-                pg, acting, j, msg.oid, o, len(b)
-            )
-            if ob is None or over != my_ver:
-                # unreachable, or the holder is a STALE generation whose
-                # old bytes would poison the parity delta (the retry-
-                # after-partial-apply case): reconstruct via the decode
-                # slow path instead, which filters by version
-                return self._ec_full_splice(pg, pool, codec, acting,
-                                            my_shard, msg, data, off, size)
-            old[j] = ob
-        # parity delta = encode_chunks(delta window): zero rows for
-        # untouched shards, new^old for touched ones; padded to the
-        # codec's alignment (zero delta => zero parity delta, trim back)
-        W = codec.get_chunk_size(k * w)
-        delta = np.zeros((k, W), np.uint8)
-        for j, (o, b) in segs.items():
-            delta[j, o - c0:o - c0 + len(b)] = (
-                np.frombuffer(b, np.uint8) ^ np.frombuffer(old[j], np.uint8)
-            )
-        parity_delta = np.asarray(codec.encode_chunks(delta), np.uint8)[:, :w]
-        new_size = max(size, end)
-        version = pg.version + 1
-        entry = LogEntry(version, "modify", msg.oid,
-                         reqid=getattr(msg, "reqid", None))
-        wire_entry = entry.to_list()
-        tids: dict[int, int] = {}
-        for shard, osd in enumerate(acting):
-            if shard == my_shard or osd < 0 or not self.osdmap.is_up(osd):
-                continue
-            if shard in segs:
-                mode, moff, payload = "range", segs[shard][0], segs[shard][1]
-            elif shard >= k:
-                mode, moff = "delta", c0
-                payload = parity_delta[shard - k].tobytes()
-            else:
-                mode, moff, payload = None, None, None  # entry+size only
-            tid = self._next_tid()
-            tids[tid] = shard
-            try:
-                self._conn_to_osd(osd).send_message(
-                    MECSubOpWrite(
-                        tid=tid, pgid=pg.pgid, oid=msg.oid, shard=shard,
-                        data=pack_data(payload) if payload is not None
-                        else None,
-                        crc=crc32c(payload) if payload is not None else None,
-                        version=version, entry=wire_entry,
-                        epoch=self.my_epoch(), mode=mode, off=moff,
-                        over=my_ver, osize=new_size,
-                    )
-                )
-            except (OSError, ConnectionError):
-                tids.pop(tid, None)
-                self.mc.report_failure(osd)
-        t = Transaction()
-        t.try_create_collection(cid)
-        if my_shard in segs:
-            o, b = segs[my_shard]
-            self._rmw_apply_local(t, cid, msg.oid, my_chunk, o, b, xor=False)
-        elif my_shard >= k:
-            self._rmw_apply_local(
-                t, cid, msg.oid, my_chunk, c0,
-                parity_delta[my_shard - k].tobytes(), xor=True,
-            )
-        t.setattr(cid, msg.oid, "size", str(new_size).encode())
-        t.setattr(cid, msg.oid, "ver", str(version).encode())
-        self._log_txn(t, cid, pg, entry)
-        self.store.queue_transaction(t)
-        a, deposed, failed = self._collect_subop_acks(tids, acting)
-        acked = 1 + a
-        for osd in failed:
-            self.mc.report_failure(osd)
-        if deposed and acked < pool.min_size:
-            # deposed mid-op below quorum: the local apply is a FORK in a
-            # dead interval — never acked, never answered as a dup
-            # (_record_reqid marks the reqid "forked" so the resend
-            # re-executes on the real primary).  At >= min_size the op
-            # is durable in THIS interval despite the stray -116 (e.g. a
-            # peer that just rebooted): ack it normally below.
-            return MOSDOpReply(tid=msg.tid, retval=-116,
-                               epoch=self.my_epoch(),
-                               result={"deposed": True})
-        if acked >= pool.min_size:
-            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                               result={"version": pg.version, "acked": acked})
-        # structured under-ack refusal: the op IS applied+logged locally;
-        # "applied" lets dup detection refuse re-execution on the resend
-        return MOSDOpReply(tid=msg.tid, retval=-11, epoch=self.my_epoch(),
-                           result={"applied": pg.version, "acked": acked,
-                                   "error": "below min_size commits"})
-
-    def _ec_delete(self, pg, acting, my_shard, msg) -> MOSDOpReply:
-        version = pg.version + 1
-        entry = LogEntry(version, "delete", msg.oid,
-                         reqid=getattr(msg, "reqid", None))
-        tids: dict[int, int] = {}
-        for shard, osd in enumerate(acting):
-            if shard == my_shard or osd < 0 or not self.osdmap.is_up(osd):
-                continue
-            tid = self._next_tid()
-            tids[tid] = shard
-            try:
-                self._conn_to_osd(osd).send_message(
-                    MECSubOpWrite(
-                        tid=tid, pgid=pg.pgid, oid=msg.oid, shard=shard,
-                        data=None, crc=None, version=version,
-                        entry=entry.to_list(), epoch=self.my_epoch(),
-                    )
-                )
-            except (OSError, ConnectionError):
-                tids.pop(tid, None)
-        cid = self._cid(pg.pgid, my_shard)
-        t = Transaction()
-        t.try_create_collection(cid)
-        try:
-            self.store.stat(cid, msg.oid)
-            t.remove(cid, msg.oid)
-        except (NotFound, KeyError):
-            pass
-        self._log_txn(t, cid, pg, entry)
-        self.store.queue_transaction(t)
-        for tid in tids:
-            self._wait_reply(tid)
-        return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                           result={"version": pg.version})
-
-    def _gather_chunks(
-        self, pg, codec, acting, oid: str, want: set[int],
-        sizes: dict[int, int] | None = None,
-        vers: dict[int, int | None] | None = None,
-        stray: bool = False,
-        floor: int | None = None,
-    ) -> dict[int, bytes]:
-        """Fetch chunk bytes for shard ids in `want` (local or remote).
-        `sizes`, if given, collects the object-size xattr each replying
-        shard reports (for padding-strip when the primary has no copy);
-        `vers` likewise collects each shard's stored per-object version
-        (None = wildcard) for stale-generation filtering.  `stray` also
-        probes non-acting locations for shards the acting map cannot
-        serve (see _gather_stray_chunks)."""
-        got: dict[int, bytes] = {}
-        tids: dict[int, int] = {}
-        for shard in sorted(want):
-            osd = acting[shard] if shard < len(acting) else -1
-            if osd == self.id:
-                cid = self._cid(pg.pgid, shard)
-                try:
-                    chunk = self.store.read(cid, oid)
-                except (NotFound, KeyError):
-                    continue
-                try:
-                    stored = int(self.store.getattr(cid, oid, "hinfo"))
-                except (NotFound, KeyError, ValueError):
-                    stored = None
-                if stored is not None and crc32c(chunk) != stored:
-                    # rotted local chunk counts as missing: reconstruct
-                    # from peers rather than decode garbage (hinfo read
-                    # check, as in _handle_sub_read)
-                    self.cct.dout(
-                        "osd", 0,
-                        f"{self.whoami} hinfo mismatch on local read "
-                        f"{pg.pgid}/{oid} shard {shard}",
-                    )
-                    continue
-                got[shard] = chunk
-                if vers is not None:
-                    vers[shard] = self._stored_ver(cid, oid)
-                continue
-            if osd < 0 or not self.osdmap.is_up(osd):
-                continue
-            tid = self._next_tid()
-            tids[tid] = shard
-            try:
-                self._conn_to_osd(osd).send_message(
-                    MECSubOpRead(tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
-                                 offsets=None, epoch=self.my_epoch())
-                )
-            except (OSError, ConnectionError):
-                tids.pop(tid, None)
-        for tid, shard in tids.items():
-            rep = self._wait_reply(tid)
-            if rep is not None and rep.retval == 0:
-                got[shard] = unpack_data(rep.data)
-                if sizes is not None and rep.size is not None:
-                    sizes[shard] = int(rep.size)
-                if vers is not None:
-                    vers[shard] = getattr(rep, "ver", None)
-        if stray:
-            self._stray_upgrade(pg, oid, want, got, sizes, vers, acting,
-                                floor)
-        return got
-
-    def _stray_upgrade(self, pg, oid: str, want: set[int], got: dict,
-                       sizes, vers, acting,
-                       floor: int | None = None) -> None:
-        """Hunt NON-acting locations (reference: PeeringState's
-        missing_loc — recovery reads from any OSD known to hold the
-        object, not just the acting set) for two cases an acting
-        permutation creates:
-        - a shard with NO chunk at all (its new holder never held the
-          role) — any copy helps;
-        - a shard whose acting chunk is a STALE generation — only a
-          copy stamped at (or above) the newest generation seen helps,
-          and crucially the stale chunk must NOT suppress the hunt, or
-          a current stray that could complete the stripe stays
-          invisible and reads fail with too-few chunks.
-        Iterates because finding a higher generation can reclassify
-        previously-accepted chunks as stale."""
-        for _round in range(3):
-            present = [v for v in vers.values() if v is not None]
-            if floor is not None:
-                present.append(floor)
-            target = max(present) if present else None
-            todo = [
-                sh for sh in sorted(want)
-                if sh not in got
-                or (target is not None and vers.get(sh) is not None
-                    and vers[sh] < target)
-            ]
-            if not todo:
-                return
-            improved = False
-            for shard in todo:
-                min_ver = target if shard in got else None
-                found = self._probe_stray(pg, oid, shard, acting, min_ver)
-                if found is None:
-                    continue
-                data, ver, size = found
-                got[shard] = data
-                if vers is not None:
-                    vers[shard] = ver
-                if sizes is not None and size is not None:
-                    sizes[shard] = size
-                improved = True
-            if not improved:
-                return
-
-    def _probe_stray(self, pg, oid: str, shard: int, acting,
-                     min_ver: int | None):
-        """One shard's chunk from any non-acting location.  min_ver set:
-        only a copy with a NUMERIC generation >= min_ver qualifies (a
-        wildcard stamp proves nothing about currency); min_ver None (the
-        shard has no chunk at all): any copy, wildcard included."""
-        holder = acting[shard] if shard < len(acting) else -1
-        cid = self._cid(pg.pgid, shard)
-        if holder != self.id:  # acting-local was already tried
-            try:
-                chunk = self.store.read(cid, oid)
-            except (NotFound, KeyError):
-                chunk = None
-            if chunk is not None:
-                try:
-                    stored = int(self.store.getattr(cid, oid, "hinfo"))
-                except (NotFound, KeyError, ValueError):
-                    stored = None
-                ver = self._stored_ver(cid, oid)
-                if (
-                    (stored is None or crc32c(chunk) == stored)
-                    and (min_ver is None
-                         or (ver is not None and ver >= min_ver))
-                ):
-                    size = None
-                    try:
-                        size = int(self.store.getattr(cid, oid, "size"))
-                    except (NotFound, KeyError, ValueError):
-                        pass
-                    return bytes(chunk), ver, size
-        # candidate order (reference: missing_loc built from
-        # PastIntervals): past holders of THIS shard first — they are
-        # the only OSDs that can plausibly hold it — then the bounded
-        # global walk as a suffix, so an INCOMPLETE history (capped,
-        # trimmed maps) can still find a holder the pre-history walk
-        # would have (review r4); the probe cap below bounds the cost
-        exclude = {self.id, holder}
-        candidates = pg.past_intervals.holders_of_shard(shard, exclude)
-        seen = set(candidates)
-        candidates += [
-            osd for osd in range(self.osdmap.max_osd)
-            if osd not in exclude and osd not in seen
-        ]
-        probes = 0
-        for osd in candidates:
-            if not self.osdmap.is_up(osd):
-                continue
-            if probes >= 16:
-                break  # bound the walk on big maps (client-path cost)
-            probes += 1
-            self.logger.inc("stray_probes")
-            # metadata-only probe first (offsets=[]): a miss or a
-            # non-qualifying generation costs a tiny round trip, not a
-            # full-chunk transfer
-            tid = self._next_tid()
-            try:
-                self._conn_to_osd(osd).send_message(MECSubOpRead(
-                    tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
-                    offsets=[], epoch=self.my_epoch(),
-                ))
-            except (OSError, ConnectionError):
-                continue
-            rep = self._wait_reply(tid, timeout=3.0)
-            if rep is None or rep.retval != 0:
-                continue
-            ver = getattr(rep, "ver", None)
-            if min_ver is not None and (ver is None or ver < min_ver):
-                continue
-            tid = self._next_tid()
-            try:
-                self._conn_to_osd(osd).send_message(MECSubOpRead(
-                    tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
-                    offsets=None, epoch=self.my_epoch(),
-                ))
-            except (OSError, ConnectionError):
-                continue
-            rep = self._wait_reply(tid, timeout=5.0)
-            if rep is not None and rep.retval == 0:
-                return (
-                    unpack_data(rep.data),
-                    getattr(rep, "ver", None),
-                    int(rep.size) if rep.size is not None else None,
-                )
-        return None
-
-    def _ec_read(self, pg, codec, acting, msg) -> MOSDOpReply:
-        k = codec.get_data_chunk_count()
-        n = codec.get_chunk_count()
-        my_shard = acting.index(self.id) if self.id in acting else -1
-        # size from any shard we can reach (primary's own shard normally)
-        size = None
-        if my_shard >= 0:
-            try:
-                size = int(self.store.getattr(
-                    self._cid(pg.pgid, my_shard), msg.oid, "size"))
-            except (NotFound, KeyError):
-                pass
-        peer_sizes: dict[int, int] = {}
-        vers: dict[int, int | None] = {}
-        floor = pg.log.obj_newest.get(msg.oid)
-        want_data = set(range(k))
-        got = self._gather_chunks(
-            pg, codec, acting, msg.oid, want_data, sizes=peer_sizes,
-            vers=vers, floor=floor,
-        )
-
-        got = _current_generation(got, vers, floor)
-        missing = want_data - set(got)
-        if missing:
-            # degraded: consult minimum_to_decode over everything
-            # reachable, including stray (non-acting) chunk locations
-            avail_probe = self._gather_chunks(
-                pg, codec, acting, msg.oid, set(range(k, n)) | missing,
-                sizes=peer_sizes, vers=vers, stray=True, floor=floor,
-            )
-            avail_probe.update(got)
-            avail_probe = _current_generation(avail_probe, vers, floor)
-            if len(avail_probe) < k:
-                return MOSDOpReply(
-                    tid=msg.tid, retval=-5, epoch=self.my_epoch(),
-                    result=f"unreadable: only {len(avail_probe)} chunks",
-                )
-            chunks = {
-                s: np.frombuffer(b, dtype=np.uint8)
-                for s, b in avail_probe.items()
-            }
-            need = codec.minimum_to_decode(want_data, set(chunks))
-            dec = codec.decode(
-                want_data, {s: chunks[s] for s in need if s in chunks},
-                len(next(iter(chunks.values()))),
-            )
-            data = b"".join(
-                np.asarray(dec[i], np.uint8).tobytes() for i in range(k)
-            )
-        else:
-            data = b"".join(got[i] for i in range(k))
-        if size is None and peer_sizes:
-            # prefer a size reported by a current-generation shard — a
-            # stale shard's size xattr predates the newest RMW
-            present = [v for v in vers.values() if v is not None]
-            target = max(present) if present else None
-            good = [
-                sz for s, sz in peer_sizes.items()
-                if target is None or vers.get(s) in (None, target)
-            ]
-            size = good[0] if good else next(iter(peer_sizes.values()))
-        if size is None:
-            # no shard could report a size xattr: the full (padded) stripe
-            # is the best available answer
-            size = len(data)
-        obj = data[:size]
-        if msg.off or (msg.length or 0) > 0:
-            off = msg.off or 0
-            ln = msg.length if msg.length else len(obj) - off
-            obj = obj[off : off + ln]
-        return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                           data=pack_data(obj),
-                           result={"size": size})
-
-    # .. replicated pool ...................................................
-    def _replicated_op(self, pg, pool, acting, msg) -> MOSDOpReply:
-        """Primary-copy replication (reference: ReplicatedBackend): full
-        object bytes to every acting replica, same log machinery."""
-        acting = [o for o in acting if o >= 0]
-        my_shard = 0  # replicated: every replica stores the full object
-        cid = self._cid(pg.pgid, 0)
-        if msg.op in ("write_full", "write", "append", "delete"):
-            # min_size gate, as on the EC path
-            reachable = sum(
-                1 for o in acting
-                if o == self.id or self.osdmap.is_up(o)
-            )
-            if reachable < pool.min_size:
-                return MOSDOpReply(
-                    tid=msg.tid, retval=-11, epoch=self.my_epoch(),
-                    result=f"{reachable} replicas reachable < "
-                           f"min_size {pool.min_size}",
-                )
-        if msg.op in ("write", "append"):
-            # ranged write / append: splice into the primary's copy (the
-            # primary always holds the authoritative full object on a
-            # replicated pool) and replicate the result full-object —
-            # the reference ships op-level deltas; full-object keeps the
-            # one replication path here while the EC pool carries the
-            # real RMW machinery.  The read-splice-replicate sequence
-            # runs under pg.lock (reentrant) so two concurrent appends
-            # cannot both read the same old length and lose one update;
-            # the rebuilt op KEEPS the reqid so the logged entry still
-            # answers cross-primary resends.
-            with pg.lock:
-                new = unpack_data(msg.data) or b""
-                try:
-                    old = bytes(self.store.read(cid, msg.oid))
-                except (NotFound, KeyError):
-                    old = b""
-                off = len(old) if msg.op == "append" else int(msg.off or 0)
-                buf = bytearray(max(len(old), off + len(new)))
-                buf[:len(old)] = old
-                buf[off:off + len(new)] = new
-                msg = MOSDOp(
-                    tid=msg.tid, pool=msg.pool, oid=msg.oid,
-                    op="write_full", data=pack_data(bytes(buf)),
-                    epoch=msg.epoch, ps=msg.ps,
-                    reqid=getattr(msg, "reqid", None),
-                )
-                return self._replicated_op(pg, pool, acting, msg)
-        if msg.op == "write_full":
-            data = unpack_data(msg.data) or b""
-            # cache-tier pools: the clean-marker clear must ride THIS
-            # mutation's transaction + sub-ops, not a separate staging
-            # check (advisor r4 — the separate check races the flush's
-            # clean-mark and an evict then drops the only copy)
-            autoclean = self._tier_autoclean(pool, msg.oid)
-            rmattrs = ["tier.clean"] if autoclean else None
-            with pg.lock:
-                version = pg.version + 1
-                entry = LogEntry(version, "modify", msg.oid,
-                                 reqid=getattr(msg, "reqid", None))
-                tids = {}
-                for osd in acting:
-                    if osd == self.id or not self.osdmap.is_up(osd):
-                        continue
-                    tid = self._next_tid()
-                    tids[tid] = osd
-                    try:
-                        self._conn_to_osd(osd).send_message(
-                            MECSubOpWrite(
-                                tid=tid, pgid=pg.pgid, oid=msg.oid, shard=0,
-                                data=msg.data, crc=crc32c(data),
-                                version=version,
-                                entry=entry.to_list(),
-                                epoch=self.my_epoch(), osize=len(data),
-                                rmattrs=rmattrs,
-                            )
-                        )
-                    except (OSError, ConnectionError):
-                        tids.pop(tid, None)
-                t = Transaction()
-                t.try_create_collection(cid)
-                t.write(cid, msg.oid, 0, data)
-                t.truncate(cid, msg.oid, len(data))
-                # self-digest so scrub can tell at-rest rot on the primary
-                # from divergence (replicas get theirs via sub-write)
-                t.setattr(cid, msg.oid, "hinfo", str(crc32c(data)).encode())
-                t.setattr(cid, msg.oid, "size", str(len(data)).encode())
-                t.setattr(cid, msg.oid, "ver", str(version).encode())
-                if autoclean:
-                    self._txn_clear_clean(t, cid, msg.oid)
-                self._log_txn(t, cid, pg, entry)
-                self.store.queue_transaction(t)
-                a, deposed, _f = self._collect_subop_acks(tids)
-                acked = 1 + a
-                if deposed and acked < pool.min_size:
-                    return MOSDOpReply(tid=msg.tid, retval=-116,
-                                       epoch=self.my_epoch(),
-                                       result={"deposed": True})
-                if acked >= pool.min_size:
-                    return MOSDOpReply(
-                        tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                        result={"version": pg.version, "acked": acked},
-                    )
-                return MOSDOpReply(
-                    tid=msg.tid, retval=-11, epoch=self.my_epoch(),
-                    result={"applied": pg.version, "acked": acked,
-                            "error": "below min_size commits"})
-        if msg.op == "read":
-            try:
-                data = self.store.read(cid, msg.oid)
-            except (NotFound, KeyError):
-                return MOSDOpReply(tid=msg.tid, retval=-2,
-                                   epoch=self.my_epoch(), result="not found")
-            if msg.off or (msg.length or 0) > 0:
-                off = msg.off or 0
-                ln = msg.length if msg.length else len(data) - off
-                data = data[off : off + ln]
-            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                               data=pack_data(data), result={})
-        if msg.op == "delete":
-            with pg.lock:
-                version = pg.version + 1
-                entry = LogEntry(version, "delete", msg.oid,
-                                 reqid=getattr(msg, "reqid", None))
-                for osd in acting:
-                    if osd == self.id or not self.osdmap.is_up(osd):
-                        continue
-                    tid = self._next_tid()
-                    try:
-                        self._conn_to_osd(osd).send_message(
-                            MECSubOpWrite(
-                                tid=tid, pgid=pg.pgid, oid=msg.oid, shard=0,
-                                data=None, crc=None, version=version,
-                                entry=entry.to_list(), epoch=self.my_epoch(),
-                            )
-                        )
-                    except (OSError, ConnectionError):
-                        pass
-                t = Transaction()
-                t.try_create_collection(cid)
-                try:
-                    self.store.stat(cid, msg.oid)
-                    t.remove(cid, msg.oid)
-                except (NotFound, KeyError):
-                    pass
-                self._log_txn(t, cid, pg, entry)
-                self.store.queue_transaction(t)
-            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                               result={})
-        if msg.op == "stat":
-            try:
-                st = self.store.stat(cid, msg.oid)
-                return MOSDOpReply(tid=msg.tid, retval=0,
-                                   epoch=self.my_epoch(), result=st)
-            except (NotFound, KeyError):
-                return MOSDOpReply(tid=msg.tid, retval=-2,
-                                   epoch=self.my_epoch(), result="not found")
-        if msg.op == "list":
-            oids = sorted(
-                o for o in self.store.list_objects(cid)
-                if not o.startswith("_") and CLONE_SEP not in o
-            )
-            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                               result={"oids": oids})
-        if msg.op in ("setxattr", "getxattrs"):
-            return self._xattr_op(pg, acting, 0, msg)
-        if msg.op.startswith("omap_"):
-            return self._omap_op(pg, pool, acting, msg)
-        if msg.op == "exec":
-            return self._exec_op(pg, pool, acting, msg)
-        if msg.op in ("watch", "unwatch", "notify"):
-            return self._watch_op(pg, pool, msg)
-        return MOSDOpReply(tid=msg.tid, retval=-22, epoch=self.my_epoch(),
-                           result=f"bad op {msg.op}")
-
-    # .. omap (replicated pools only, like the reference) ..................
-    def _omap_op(self, pg, pool, acting, msg) -> MOSDOpReply:
-        """librados omap surface (reference: rados_omap_get_vals /
-        omap_set / omap_rm_keys / omap_clear, executed by
-        PrimaryLogPG::do_osd_ops OMAP* cases).  Key-value pairs ride the
-        object; mutations replicate and log exactly like xattr updates,
-        and recovery pushes carry a full omap snapshot."""
-        cid = self._cid(pg.pgid, 0)
-        args = msg.data or {}
-        if msg.op == "omap_get":
-            try:
-                self.store.stat(cid, msg.oid)
-            except (NotFound, KeyError):
-                return MOSDOpReply(tid=msg.tid, retval=-2,
-                                   epoch=self.my_epoch(), result="not found")
-            kv = self.store.omap_get(cid, msg.oid)
-            want = args.get("keys")
-            if want is not None:
-                kv = {k: v for k, v in kv.items() if k in want}
-            else:
-                after = args.get("after") or ""
-                maxn = int(args.get("max") or 0)
-                keys = sorted(k for k in kv if k > after)
-                if maxn:
-                    keys = keys[:maxn]
-                kv = {k: kv[k] for k in keys}
-            return MOSDOpReply(
-                tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                result={"kv": {k: pack_data(v) for k, v in kv.items()}},
-            )
-        # mutations
-        omap_payload = None
-        if msg.op == "omap_set":
-            omap_payload = {"set": args.get("keys") or {}}
-        elif msg.op == "omap_rm":
-            omap_payload = {"rm": list(args.get("keys") or [])}
-        elif msg.op == "omap_clear":
-            omap_payload = {"clear": True}
-        else:
-            return MOSDOpReply(tid=msg.tid, retval=-22,
-                               epoch=self.my_epoch(),
-                               result=f"bad op {msg.op}")
-        # omap content flushes to the base pool too: the clean clear must
-        # be atomic with the mutation exactly like the data path
-        autoclean = self._tier_autoclean(pool, msg.oid)
-        with pg.lock:
-            version = pg.version + 1
-            entry = LogEntry(version, "modify", msg.oid,
-                             reqid=getattr(msg, "reqid", None))
-            tids: dict[int, int] = {}
-            for shard, osd in enumerate(acting):
-                if osd == self.id or osd < 0 or not self.osdmap.is_up(osd):
-                    continue
-                tid = self._next_tid()
-                tids[tid] = shard
-                try:
-                    self._conn_to_osd(osd).send_message(MECSubOpWrite(
-                        tid=tid, pgid=pg.pgid, oid=msg.oid, shard=0,
-                        data=None, crc=None, version=version,
-                        entry=entry.to_list(), epoch=self.my_epoch(),
-                        omap=omap_payload,
-                        rmattrs=["tier.clean"] if autoclean else None,
-                    ))
-                except (OSError, ConnectionError):
-                    tids.pop(tid, None)
-            t = Transaction()
-            t.try_create_collection(cid)
-            t.touch(cid, msg.oid)  # omap on a fresh oid creates it
-            self._apply_omap(t, cid, msg.oid, omap_payload)
-            # stamp the object version: _check_dup's applied-resend
-            # verification counts shards holding ver >= v (replicated
-            # pools never generation-filter reads, so this is safe)
-            t.setattr(cid, msg.oid, "ver", str(version).encode())
-            if autoclean:
-                self._txn_clear_clean(t, cid, msg.oid)
-            self._log_txn(t, cid, pg, entry)
-            self.store.queue_transaction(t)
-            a, deposed, _f = self._collect_subop_acks(tids)
-            acked = 1 + a
-        if deposed and acked < pool.min_size:
-            return MOSDOpReply(tid=msg.tid, retval=-116,
-                               epoch=self.my_epoch(),
-                               result={"deposed": True})
-        if acked < pool.min_size:
-            return MOSDOpReply(tid=msg.tid, retval=-11,
-                               epoch=self.my_epoch(),
-                               result={"applied": pg.version, "acked": acked,
-                                       "error": "below min_size commits"})
-        return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                           result={"version": pg.version})
-
-    # .. object classes (replicated pools only, like omap) .................
-    def _exec_op(self, pg, pool, acting, msg) -> MOSDOpReply:
-        """`rados exec` — run a registered class method at the primary
-        under the PG lock and commit its staged mutations as one
-        replicated, logged transaction (reference: PrimaryLogPG
-        CEPH_OSD_OP_CALL -> ClassHandler; src/cls).  The lock-scoped
-        execute-then-commit is what makes cls ops (bucket-index updates,
-        create guards, counters) immune to concurrent-writer races."""
-        from .classes import ClassRegistry, ClsHandle
-
-        cid = self._cid(pg.pgid, 0)
-        args = msg.data or {}
-        fn = ClassRegistry.instance().get(
-            args.get("cls", ""), args.get("method", "")
-        )
-        if fn is None:
-            return MOSDOpReply(
-                tid=msg.tid, retval=-95, epoch=self.my_epoch(),
-                result=f"no class method "
-                       f"{args.get('cls')}.{args.get('method')}",
-            )
-        # pool-snapshot clone-on-write, same as the plain mutation path
-        # (lines above in _execute_routed_op): a method MAY stage a data
-        # write (hctx.write_full), and the clone must capture the head
-        # BEFORE pg.lock — the write path's order is _clone_mutex then
-        # pg.lock, and inverting it here would risk deadlock.  We cannot
-        # yet know whether the method will touch data, so clone whenever
-        # a snap is live: a clone of an omap-only exec is merely the
-        # head's (correct) at-snap state, never wrong.
-        live_max = max(pool.snaps, default=0)
-        snap_seq = max(live_max, int(getattr(msg, "snap_seq", 0) or 0))
-        head_existed = True
-        if snap_seq and msg.oid and CLONE_SEP not in msg.oid:
-            try:
-                head_existed = self._maybe_clone(pg, pool, msg.oid, snap_seq)
-            except Exception as e:
-                return MOSDOpReply(
-                    tid=msg.tid, retval=-11, epoch=self.my_epoch(),
-                    result=f"snap clone failed: {e}",
-                )
-        with pg.lock:
-            def read_data():
-                try:
-                    return self.store.read(cid, msg.oid)
-                except (NotFound, KeyError):
-                    return None
-
-            def read_omap():
-                try:
-                    return self.store.omap_get(cid, msg.oid)
-                except (NotFound, KeyError):
-                    return {}
-
-            hctx = ClsHandle(msg.oid, read_data, read_omap)
-            try:
-                retval, out = fn(hctx, args.get("in") or {})
-            except Exception as e:
-                self.cct.dout("osd", 0,
-                              f"{self.whoami} cls method raised: {e!r}")
-                return MOSDOpReply(tid=msg.tid, retval=-22,
-                                   epoch=self.my_epoch(),
-                                   result=f"cls method failed: {e}")
-            if retval < 0 or not hctx.dirty:
-                # aborted or read-only: nothing to commit or replicate
-                return MOSDOpReply(tid=msg.tid, retval=retval,
-                                   epoch=self.my_epoch(),
-                                   result={"cls_out": out})
-            omap_payload = None
-            if hctx.staged_set or hctx.staged_rm:
-                omap_payload = {
-                    "set": {k: pack_data(v)
-                            for k, v in hctx.staged_set.items()},
-                    "rm": sorted(hctx.staged_rm),
-                }
-            wire_data = crc = osize = None
-            if hctx.staged_data is not None:
-                wire_data = pack_data(hctx.staged_data)
-                crc = crc32c(hctx.staged_data)
-                osize = len(hctx.staged_data)
-            version = pg.version + 1
-            entry = LogEntry(version, "modify", msg.oid,
-                             reqid=getattr(msg, "reqid", None))
-            autoclean = self._tier_autoclean(pool, msg.oid)
-            tids: dict[int, int] = {}
-            for shard, osd in enumerate(acting):
-                if osd == self.id or osd < 0 or not self.osdmap.is_up(osd):
-                    continue
-                tid = self._next_tid()
-                tids[tid] = shard
-                try:
-                    self._conn_to_osd(osd).send_message(MECSubOpWrite(
-                        tid=tid, pgid=pg.pgid, oid=msg.oid, shard=0,
-                        data=wire_data, crc=crc, osize=osize,
-                        version=version, entry=entry.to_list(),
-                        epoch=self.my_epoch(), omap=omap_payload,
-                        rmattrs=["tier.clean"] if autoclean else None,
-                    ))
-                except (OSError, ConnectionError):
-                    tids.pop(tid, None)
-            t = Transaction()
-            t.try_create_collection(cid)
-            t.touch(cid, msg.oid)
-            if hctx.staged_data is not None:
-                t.write(cid, msg.oid, 0, hctx.staged_data)
-                t.truncate(cid, msg.oid, len(hctx.staged_data))
-                t.setattr(cid, msg.oid, "hinfo",
-                          str(crc32c(hctx.staged_data)).encode())
-                t.setattr(cid, msg.oid, "size",
-                          str(len(hctx.staged_data)).encode())
-            if omap_payload is not None:
-                self._apply_omap(t, cid, msg.oid, omap_payload)
-            t.setattr(cid, msg.oid, "ver", str(version).encode())
-            if autoclean:
-                self._txn_clear_clean(t, cid, msg.oid)
-            self._log_txn(t, cid, pg, entry)
-            self.store.queue_transaction(t)
-            a, deposed, _f = self._collect_subop_acks(tids)
-            acked = 1 + a
-        if deposed and acked < pool.min_size:
-            return MOSDOpReply(tid=msg.tid, retval=-116,
-                               epoch=self.my_epoch(),
-                               result={"deposed": True})
-        if acked < pool.min_size:
-            return MOSDOpReply(tid=msg.tid, retval=-11,
-                               epoch=self.my_epoch(),
-                               result={"applied": pg.version, "acked": acked,
-                                       "error": "below min_size commits"})
-        if snap_seq and not head_existed:
-            # exec CREATED the object post-snap: mark it born so older
-            # snap views keep it invisible (same contract as the plain
-            # write path's _mark_born)
-            try:
-                self._mark_born(pg, pool, msg.oid, snap_seq)
-            except Exception as e:
-                return MOSDOpReply(
-                    tid=msg.tid, retval=-5, epoch=self.my_epoch(),
-                    result=f"snapborn mark failed: {e}",
-                )
-        return MOSDOpReply(tid=msg.tid, retval=retval,
-                           epoch=self.my_epoch(), result={"cls_out": out})
-
-    def _apply_omap(self, t: Transaction, cid: str, oid: str,
-                    payload: dict) -> None:
-        if payload.get("snapshot") is not None:
-            # recovery push: the dict IS the whole omap
-            t.omap_clear(cid, oid)
-            t.omap_setkeys(cid, oid, {
-                k: unpack_data(v) for k, v in payload["snapshot"].items()
-            })
-            return
-        if payload.get("clear"):
-            t.omap_clear(cid, oid)
-        if payload.get("set"):
-            t.omap_setkeys(cid, oid, {
-                k: unpack_data(v) for k, v in payload["set"].items()
-            })
-        if payload.get("rm"):
-            t.omap_rmkeys(cid, oid, payload["rm"])
-
-    # .. watch / notify ....................................................
-    def _watch_op(self, pg, pool, msg) -> MOSDOpReply:
-        """Object watch/notify (reference: PrimaryLogPG watch/notify +
-        MWatchNotify).  Watch state is primary-local and in-memory; the
-        client's Objecter re-registers lingering watches after a map
-        change (reference: linger ops re-sent by Objecter), which covers
-        primary failover."""
-        args = msg.data or {}
-        key = (msg.pool, msg.oid)
-        if msg.op == "watch":
-            cookie = int(args.get("cookie") or 0)
-            with self._watch_lock:
-                self.watchers.setdefault(key, {})[cookie] = (
-                    getattr(msg, "src", None))
-            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                               result={"cookie": cookie})
-        if msg.op == "unwatch":
-            cookie = int(args.get("cookie") or 0)
-            with self._watch_lock:
-                ws = self.watchers.get(key, {})
-                ws.pop(cookie, None)
-                if not ws:
-                    self.watchers.pop(key, None)
-            return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
-                               result={})
-        # notify: fan out to every watcher, collect acks with a timeout
-        notify_id = self._next_tid()
-        payload = args.get("payload")
-        timeout = float(args.get("timeout") or 5.0)
-        with self._watch_lock:
-            targets = dict(self.watchers.get(key, {}))
-        pending = {}
-        dead = []
-        unreachable = []
-        for cookie, src in targets.items():
-            conn = self._client_conns.get(src)
-            if conn is None:
-                # conn LRU-evicted or never seen: the watcher may be
-                # alive and idle — report it missed, do NOT reap (only a
-                # CONFIRMED-dead connection expires a watch)
-                unreachable.append(cookie)
-                continue
-            try:
-                conn.send_message(MWatchNotify(
-                    notify_id=notify_id, pool=msg.pool, oid=msg.oid,
-                    cookie=cookie, data=payload,
-                ))
-                pending[cookie] = src
-            except (OSError, ConnectionError):
-                dead.append(cookie)
-        if dead:
-            # a watcher whose connection is gone is expired (reference:
-            # watch timeout reaps dead watchers); its client re-lingers
-            # on the next map push if it is actually alive
-            with self._watch_lock:
-                ws = self.watchers.get(key, {})
-                for cookie in dead:
-                    ws.pop(cookie, None)
-                if not ws:
-                    self.watchers.pop(key, None)
-        acked, missed = [], list(unreachable)
-        deadline = time.monotonic() + timeout
-        for cookie in pending:
-            remain = max(0.0, deadline - time.monotonic())
-            if self._wait_notify_ack(notify_id, cookie, remain):
-                acked.append(cookie)
-            else:
-                missed.append(cookie)
-        return MOSDOpReply(
-            tid=msg.tid, retval=0, epoch=self.my_epoch(),
-            result={"notify_id": notify_id, "acked": acked,
-                    "missed": missed},
-        )
-
-    def _wait_notify_ack(self, notify_id: int, cookie: int,
-                         timeout: float) -> bool:
-        with self._watch_cond:
-            return self._watch_cond.wait_for(
-                lambda: (notify_id, cookie) in self._notify_acks,
-                timeout=timeout,
-            )
-
-    # -- cache tiering (reference: PrimaryLogPG::maybe_handle_cache_detail
-    # — promote_object / do_proxy_read / whiteouts — plus the TierAgent
-    # flush/evict loop in PrimaryLogPG::agent_work) -----------------------
-    #
-    # State model (crash-safe by construction): a cache object with the
-    # `tier.clean` user xattr is known flushed/promoted-identical to the
-    # base copy and may be evicted; an object WITHOUT it is treated as
-    # dirty and will be flushed.  Mutations remove the marker BEFORE the
-    # data op and flush/promote set it AFTER the content settles, so a
-    # crash at any point can only mislabel a clean object as dirty (a
-    # harmless re-flush), never a dirty one as clean (which could evict
-    # an unflushed write).  The reference carries these as object_info_t
-    # FLAG_DIRTY/FLAG_WHITEOUT inside the op transaction; the xattr
-    # spelling reuses this repo's replicated-xattr machinery instead.
-    # `tier.whiteout` marks a deleted-in-cache stub whose flush deletes
-    # the base object.  tier.* xattrs are internal metadata: visible in
-    # getxattrs (documented), never copied to the base pool.
-
-    def _tier_client_op(self, pool_id: int, oid: str, op: str,
-                        data=None, off: int = 0, length: int = 0):
-        """OSD-as-client op against another pool (promote reads, flush
-        writes) — targets the named pool directly, the internal analog
-        of CEPH_OSD_FLAG_IGNORE_OVERLAY.  Returns the reply or raises
-        OSError on timeout/conn failure."""
-        m = self.osdmap
-        pool = m.pools.get(pool_id) if m else None
-        if pool is None:
-            raise OSError(f"tier op: no pool {pool_id}")
-        ps = object_ps(oid, pool.pg_num)
-        _a, primary = self._acting(pool_id, ps)
-        if primary < 0:
-            raise OSError(f"tier op: pg {pool_id}.{ps} has no primary")
-        tid = self._next_tid()
-        rep = self._forward_op(primary, MOSDOp(
-            tid=tid, pool=pool_id, oid=oid, op=op, data=data,
-            epoch=self.my_epoch(), off=off, length=length,
-            reqid=f"tier.{self.id}.{tid}" if op in MUTATING_OPS else None,
-        ))
-        if rep is None:
-            raise OSError(f"tier op {op} {oid!r}: no reply")
-        return rep
-
-    def _tier_autoclean(self, pool, oid: str) -> bool:
-        """True when a mutation of `oid` must clear the tier.clean marker
-        ATOMICALLY with its data op (advisor r4: a clean-flag check in the
-        staging path races the flush's clean-mark — only a clear inside
-        the mutation's own pg.lock transaction closes the window where
-        dirty data gets labeled clean and evicted)."""
-        if pool is None or pool.tier_of < 0 or pool.cache_mode == "none":
-            return False
-        return bool(oid) and CLONE_SEP not in oid and \
-            not oid.startswith(("_", ":pg:"))
-
-    def _txn_clear_clean(self, t: Transaction, cid: str, oid: str) -> None:
-        """Append the primary-local tier.clean removal to a mutation's
-        transaction (the replicas get theirs via the sub-op `rmattrs`)."""
-        try:
-            if "u_tier.clean" in self.store.getattrs(cid, oid):
-                t.rmattr(cid, oid, "u_tier.clean")
-        except (NotFound, KeyError):
-            pass
-
-    def _tier_flag(self, pg, oid: str, flag: str) -> bool:
-        cid = self._cid(pg.pgid, 0)
-        try:
-            return self.store.getattr(cid, oid, f"u_tier.{flag}") == b"1"
-        except (NotFound, KeyError):
-            return False
-
-    def _tier_mark(self, pg, acting, oid: str, flag: str,
-                   value: bool) -> MOSDOpReply:
-        """Set/clear a tier.* marker through the replicated xattr path so
-        it survives primary failover."""
-        return self._xattr_op(pg, acting, 0, MOSDOp(
-            tid=self._next_tid(), pool=pg.pool_id, oid=oid, op="setxattr",
-            data={f"tier.{flag}": pack_data(b"1") if value else None},
-            epoch=self.my_epoch(),
-        ))
-
-    def _cache_tier_op(self, pg, pool, acting, ps, msg, _depth: int = 0):
-        """Cache-pool front-end.  Returns a final MOSDOpReply, or None to
-        fall through to normal execution (object staged in the cache).
-
-        A promote that aborts because the object appeared concurrently
-        (rc == 1, see _tier_promote's race contract) restarts the whole
-        decision: the staged object changes every branch below."""
-        base_id = pool.tier_of
-        m = self.osdmap
-        base_pool = m.pools.get(base_id) if m else None
-        oid = msg.oid
-        if (
-            base_pool is None or not oid or CLONE_SEP in oid
-            or oid.startswith(":pg:")
-            or msg.op in ("list", "watch", "unwatch", "notify")
-            or getattr(msg, "ps", None) is not None  # internal machinery
-        ):
-            return None
-
-        def retry():
-            if _depth >= 3:
-                return MOSDOpReply(tid=msg.tid, retval=-11,
-                                   epoch=self.my_epoch(),
-                                   result="tier staging kept racing")
-            return self._cache_tier_op(pg, pool, acting, ps, msg,
-                                       _depth + 1)
-
-        cid = self._cid(pg.pgid, 0)
-        with pg.lock:
-            present = self.store.exists(cid, oid)
-            whiteout = present and self._tier_flag(pg, oid, "whiteout")
-
-        if msg.op == "cache_flush":
-            return self._tier_flush_object(pg, pool, acting, oid, msg.tid)
-        if msg.op == "cache_evict":
-            return self._tier_evict_object(pg, pool, acting, oid, msg.tid)
-
-        mutating = msg.op in MUTATING_OPS
-        if not mutating:
-            # reads / stat / getxattrs / omap_get
-            if whiteout:
-                return MOSDOpReply(tid=msg.tid, retval=-2,
-                                   epoch=self.my_epoch(),
-                                   result="not found (whiteout)")
-            if present:
-                return None
-            if pool.cache_mode == "readproxy":
-                # proxy without promoting (reference: do_proxy_read)
-                try:
-                    rep = self._tier_client_op(
-                        base_id, oid, msg.op, data=msg.data,
-                        off=msg.off or 0, length=msg.length or 0,
-                    )
-                except OSError as e:
-                    return MOSDOpReply(tid=msg.tid, retval=-11,
-                                       epoch=self.my_epoch(),
-                                       result=f"proxy read: {e}")
-                return MOSDOpReply(tid=msg.tid, retval=rep.retval,
-                                   epoch=self.my_epoch(), data=rep.data,
-                                   result=rep.result)
-            rc = self._tier_promote(pg, pool, acting, base_id, oid,
-                                    mark_clean=True)
-            if rc == 1:
-                return retry()  # raced a write: re-evaluate the staging
-            if rc == -2:
-                return MOSDOpReply(tid=msg.tid, retval=-2,
-                                   epoch=self.my_epoch(),
-                                   result="not found")
-            if rc != 0:
-                return MOSDOpReply(tid=msg.tid, retval=-11,
-                                   epoch=self.my_epoch(),
-                                   result=f"promote failed ({rc})")
-            return None  # promoted: serve locally
-
-        # mutations (writeback; readproxy promotes writes too)
-        if msg.op == "delete":
-            if not present or whiteout:
-                # nothing cached (or already whited out): existence is
-                # decided by the base copy
-                if whiteout:
-                    return MOSDOpReply(tid=msg.tid, retval=-2,
-                                       epoch=self.my_epoch(),
-                                       result="not found (whiteout)")
-                try:
-                    st = self._tier_client_op(base_id, oid, "stat")
-                except OSError as e:
-                    return MOSDOpReply(tid=msg.tid, retval=-11,
-                                       epoch=self.my_epoch(),
-                                       result=f"tier stat: {e}")
-                if st.retval != 0:
-                    return MOSDOpReply(tid=msg.tid, retval=-2,
-                                       epoch=self.my_epoch(),
-                                       result="not found")
-            # install the whiteout stub: empty object + markers; the
-            # agent propagates the delete to the base and retires it
-            wrep = self._replicated_op(pg, pool, acting, MOSDOp(
-                tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-                op="write_full", data=pack_data(b""),
-                epoch=self.my_epoch(), reqid=getattr(msg, "reqid", None),
-            ))
-            if wrep.retval != 0:
-                return MOSDOpReply(tid=msg.tid, retval=wrep.retval,
-                                   epoch=self.my_epoch(), result=wrep.result)
-            # the stub must shed the pre-delete user state THROUGH THE
-            # REPLICATED paths (advisor r4, medium): a primary-local wipe
-            # leaves replicas carrying stale xattrs/omap that resurrect
-            # after failover, and a delete-then-recreate must never
-            # resurrect pre-delete attrs into a later flush
-            try:
-                stale = {
-                    n[2:]: None
-                    for n in self.store.getattrs(cid, oid)
-                    if n.startswith("u_") and not n[2:].startswith("tier.")
-                }
-            except (NotFound, KeyError):
-                stale = {}
-            if stale:
-                xrep = self._xattr_op(pg, acting, 0, MOSDOp(
-                    tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-                    op="setxattr", data=stale, epoch=self.my_epoch(),
-                ))
-                if xrep.retval != 0:
-                    return MOSDOpReply(tid=msg.tid, retval=xrep.retval,
-                                       epoch=self.my_epoch(),
-                                       result=xrep.result)
-            orep = self._omap_op(pg, pool, acting, MOSDOp(
-                tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-                op="omap_clear", data={}, epoch=self.my_epoch(),
-            ))
-            if orep.retval != 0:
-                return MOSDOpReply(tid=msg.tid, retval=orep.retval,
-                                   epoch=self.my_epoch(), result=orep.result)
-            mrep = self._tier_mark(pg, acting, oid, "whiteout", True)
-            if mrep.retval != 0:
-                return MOSDOpReply(tid=msg.tid, retval=mrep.retval,
-                                   epoch=self.my_epoch(), result=mrep.result)
-            self._tier_mark(pg, acting, oid, "clean", False)
-            return MOSDOpReply(tid=msg.tid, retval=0,
-                               epoch=self.my_epoch(), result={})
-
-        if whiteout:
-            # write onto a deleted object: never resurrect base bytes —
-            # clear the markers and start from the empty stub.  The clear
-            # must be DURABLE before the data op: a stale whiteout
-            # surviving primary failover would later flush as a delete,
-            # destroying the acknowledged write
-            mrep = self._tier_mark(pg, acting, oid, "whiteout", False)
-            if mrep.retval != 0:
-                return MOSDOpReply(tid=msg.tid, retval=-11,
-                                   epoch=self.my_epoch(),
-                                   result="whiteout clear not durable")
-            return None
-        if present:
-            # the clean-marker clear now rides the mutation's OWN
-            # transaction (_tier_autoclean in the write_full / omap /
-            # xattr / exec paths), atomically under the same pg.lock —
-            # a separate staging clear here raced the flush's clean-mark
-            # (advisor r4, medium: flush could label the object clean
-            # AFTER this check but BEFORE the data op landed)
-            return None
-        # absent: partial mutations need the base content staged first;
-        # full overwrites don't (reference: proxy/promote decision).  A
-        # base miss (rc == -2) just falls through: the normal path gives
-        # xattr ops their -2 and creates fresh objects for write/omap,
-        # matching un-tiered pool semantics.
-        if msg.op not in ("write_full",):
-            rc = self._tier_promote(pg, pool, acting, base_id, oid,
-                                    mark_clean=False)
-            if rc == 1:
-                return retry()  # raced a write: re-evaluate the staging
-            if rc not in (0, -2):
-                return MOSDOpReply(tid=msg.tid, retval=-11,
-                                   epoch=self.my_epoch(),
-                                   result=f"promote failed ({rc})")
-        return None
-
-    def _tier_promote(self, pg, pool, acting, base_id: int, oid: str,
-                      mark_clean: bool) -> int:
-        """Copy oid (data + user xattrs + omap) from the base pool into
-        this cache PG (reference: PrimaryLogPG::promote_object).  Returns
-        0, -2 (no base object), 1 (ABORTED: the object appeared locally
-        while we read the base copy — the caller re-evaluates its staging
-        decision), or a negative errno.
-
-        Race contract (advisor r4, high): the base-pool reads run
-        lock-free, but the local existence re-check and the staging
-        writes run under pg.lock — a client write that staged fresh data
-        concurrently either lands before our locked section (we see it
-        and abort: promoting would overwrite acknowledged new data with
-        stale base content) or serializes after it (its own transaction
-        clears the clean marker we may set)."""
-        try:
-            rep = self._tier_client_op(base_id, oid, "read")
-            if rep.retval == -2:
-                return -2
-            if rep.retval != 0:
-                return rep.retval or -5
-            xrep = self._tier_client_op(base_id, oid, "getxattrs")
-            xattrs = dict(xrep.result or {}) if xrep.retval == 0 else {}
-            orep = self._tier_client_op(base_id, oid, "omap_get")
-            kv = dict((orep.result or {}).get("kv") or {}) \
-                if orep.retval == 0 else {}
-        except OSError:
-            return -11
-        cid = self._cid(pg.pgid, 0)
-        with pg.lock:
-            if self.store.exists(cid, oid):
-                return 1  # raced a write: fresh data already staged
-            wrep = self._replicated_op(pg, pool, acting, MOSDOp(
-                tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-                op="write_full", data=rep.data, epoch=self.my_epoch(),
-            ))
-            if wrep.retval != 0:
-                return wrep.retval or -5
-            if xattrs:
-                self._xattr_op(pg, acting, 0, MOSDOp(
-                    tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-                    op="setxattr", data=xattrs, epoch=self.my_epoch(),
-                ))
-            if kv:
-                self._omap_op(pg, pool, acting, MOSDOp(
-                    tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-                    op="omap_set", data={"keys": kv}, epoch=self.my_epoch(),
-                ))
-            if mark_clean:
-                self._tier_mark(pg, acting, oid, "clean", True)
-        self.logger.inc("tier_promote")
-        return 0
-
-    def _tier_flush_object(self, pg, pool, acting, oid: str,
-                           tid: int) -> MOSDOpReply:
-        """Flush one cache object to the base pool (reference:
-        PrimaryLogPG::start_flush).  Whiteouts propagate the delete and
-        retire the stub; dirty objects copy content and gain the clean
-        marker — guarded by a version recheck so a write racing the
-        flush re-dirties instead of being mislabeled clean."""
-        base_id = pool.tier_of
-        cid = self._cid(pg.pgid, 0)
-        if not self.store.exists(cid, oid):
-            return MOSDOpReply(tid=tid, retval=-2, epoch=self.my_epoch(),
-                               result="not found")
-        if self._tier_flag(pg, oid, "whiteout"):
-            try:
-                drep = self._tier_client_op(base_id, oid, "delete")
-            except OSError as e:
-                return MOSDOpReply(tid=tid, retval=-11,
-                                   epoch=self.my_epoch(),
-                                   result=f"flush delete: {e}")
-            if drep.retval not in (0, -2):
-                return MOSDOpReply(tid=tid, retval=drep.retval,
-                                   epoch=self.my_epoch(), result=drep.result)
-            # retire the stub under pg.lock, re-checking the marker: a
-            # client write racing this flush clears the whiteout and
-            # stages fresh data in the stub — deleting it then would lose
-            # an acknowledged write (the re-dirtied object simply flushes
-            # again on the next pass, recreating the base copy)
-            with pg.lock:
-                if not self._tier_flag(pg, oid, "whiteout"):
-                    return MOSDOpReply(
-                        tid=tid, retval=0, epoch=self.my_epoch(),
-                        result={"flushed": "raced a rewrite; kept"})
-                rrep = self._replicated_op(pg, pool, acting, MOSDOp(
-                    tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-                    op="delete", epoch=self.my_epoch(),
-                ))
-            return MOSDOpReply(tid=tid, retval=rrep.retval,
-                               epoch=self.my_epoch(),
-                               result={"flushed": "whiteout"})
-        if self._tier_flag(pg, oid, "clean"):
-            return MOSDOpReply(tid=tid, retval=0, epoch=self.my_epoch(),
-                               result={"flushed": "already clean"})
-        try:
-            ver_before = self.store.getattr(cid, oid, "ver")
-        except (NotFound, KeyError):
-            ver_before = None
-        data = bytes(self.store.read(cid, oid))
-        xattrs = {
-            n[2:]: pack_data(v)
-            for n, v in self.store.getattrs(cid, oid).items()
-            if n.startswith("u_") and not n[2:].startswith("tier.")
-        }
-        kv = self.store.omap_get(cid, oid)
-        try:
-            wrep = self._tier_client_op(base_id, oid, "write_full",
-                                        data=pack_data(data))
-            if wrep.retval != 0:
-                return MOSDOpReply(tid=tid, retval=wrep.retval,
-                                   epoch=self.my_epoch(), result=wrep.result)
-            if xattrs:
-                self._tier_client_op(base_id, oid, "setxattr", data=xattrs)
-            if kv:
-                self._tier_client_op(
-                    base_id, oid, "omap_set",
-                    data={"keys": {k: pack_data(v) for k, v in kv.items()}},
-                )
-        except OSError as e:
-            return MOSDOpReply(tid=tid, retval=-11, epoch=self.my_epoch(),
-                               result=f"flush write: {e}")
-        with pg.lock:
-            try:
-                ver_now = self.store.getattr(cid, oid, "ver")
-            except (NotFound, KeyError):
-                ver_now = None
-            if ver_now == ver_before:
-                self._tier_mark(pg, acting, oid, "clean", True)
-        self.logger.inc("tier_flush")
-        return MOSDOpReply(tid=tid, retval=0, epoch=self.my_epoch(),
-                           result={"flushed": len(data)})
-
-    def _tier_evict_object(self, pg, pool, acting, oid: str,
-                           tid: int) -> MOSDOpReply:
-        """Drop a CLEAN cache copy (reference: PrimaryLogPG::_delete_oid
-        under agent_maybe_evict); -EBUSY for dirty/whiteout objects."""
-        cid = self._cid(pg.pgid, 0)
-        with pg.lock:
-            if not self.store.exists(cid, oid):
-                return MOSDOpReply(tid=tid, retval=-2,
-                                   epoch=self.my_epoch(),
-                                   result="not found")
-            if (
-                not self._tier_flag(pg, oid, "clean")
-                or self._tier_flag(pg, oid, "whiteout")
-            ):
-                return MOSDOpReply(tid=tid, retval=-16,
-                                   epoch=self.my_epoch(),
-                                   result="dirty: flush first")
-            rrep = self._replicated_op(pg, pool, acting, MOSDOp(
-                tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-                op="delete", epoch=self.my_epoch(),
-            ))
-        if rrep.retval != 0:
-            return MOSDOpReply(tid=tid, retval=rrep.retval,
-                               epoch=self.my_epoch(), result=rrep.result)
-        self.logger.inc("tier_evict")
-        return MOSDOpReply(tid=tid, retval=0,
-                           epoch=self.my_epoch(), result={"evicted": oid})
-
-    def _tier_agent_pass(self) -> None:
-        """Background flush/evict over primary cache-pool PGs (reference:
-        the TierAgent woken by agent_choose_mode).  Flushes every dirty
-        object and whiteout; evicts clean objects while the pool is over
-        target_max_objects (eviction order is name-sorted — the
-        reference ranks by hit_set temperature, out of scope here)."""
-        m = self.osdmap
-        if m is None:
-            return
-        for pool in list(m.pools.values()):
-            # readproxy pools flush too: their writes stage dirty in the
-            # cache exactly like writeback (only reads are proxied)
-            if pool.tier_of < 0 or pool.cache_mode == "none":
-                continue
-            for ps in range(pool.pg_num):
-                acting, primary = self._acting(pool.pool_id, ps)
-                if primary != self.id:
-                    continue
-                pg = self._pg(pool.pool_id, ps)
-                if pg.activated_interval != pg.interval_start:
-                    continue
-                cid = self._cid(pg.pgid, 0)
-                try:
-                    oids = [
-                        o for o in self.store.list_objects(cid)
-                        if not o.startswith("_") and CLONE_SEP not in o
-                    ]
-                except (NotFound, KeyError):
-                    continue
-                live = []
-                for oid in sorted(oids):
-                    if self._tier_flag(pg, oid, "whiteout") or \
-                            not self._tier_flag(pg, oid, "clean"):
-                        try:
-                            self._tier_flush_object(
-                                pg, pool, acting, oid, self._next_tid()
-                            )
-                        except Exception as e:
-                            self.cct.dout(
-                                "osd", 5,
-                                f"{self.whoami} tier flush {oid}: {e!r}")
-                    if self.store.exists(cid, oid):
-                        live.append(oid)
-                target = pool.target_max_objects
-                if target and len(live) > max(0, target // pool.pg_num):
-                    for oid in live[max(0, target // pool.pg_num):]:
-                        try:
-                            self._tier_evict_object(
-                                pg, pool, acting, oid, self._next_tid()
-                            )
-                        except Exception:
-                            pass
-
-    # -- shard sub-ops -----------------------------------------------------
-    def _handle_sub_write(self, conn, msg: MECSubOpWrite) -> None:
-        pool_id, ps = msg.pgid.split(".")
-        pg = self._pg(int(pool_id), int(ps))
-        cid = self._cid(msg.pgid, msg.shard)
-        retval = 0
-        try:
-            if (
-                msg.epoch is not None
-                and pg.interval_start
-                and msg.epoch < pg.interval_start
-            ):
-                # sub-op from a PAST-interval primary (stale map racing
-                # the change that re-elected this PG): refuse with the
-                # DISTINCT -ESTALE code so the deposed sender knows to
-                # step down rather than treat it as a flaky peer
-                # (reference: ops tagged with an older
-                # same_interval_since are dropped)
-                try:
-                    conn.send_message(
-                        MECSubOpWriteReply(tid=msg.tid, pgid=msg.pgid,
-                                           shard=msg.shard, retval=-116)
-                    )
-                except (OSError, ConnectionError):
-                    pass
-                return
-            with pg.lock:
-                entry_op = msg.entry[1] if msg.entry else None
-                t = Transaction()
-                t.try_create_collection(cid)
-                if (
-                    msg.data is not None
-                    and getattr(msg, "mode", None) in ("range", "delta")
-                ):
-                    # partial-stripe RMW sub-op: splice (data shard) or
-                    # GF-XOR (parity shard) into the stored chunk.  The
-                    # per-object version guard (`over` -> `ver`) is what
-                    # makes this safe: an RMW onto a STALE generation
-                    # would fuse old and new stripes, and a REPLAYED RMW
-                    # (dup/resend) would double-apply the delta.
-                    stored_ver = self._stored_ver(cid, msg.oid)
-                    if stored_ver == msg.version:
-                        # already applied (idempotent replay): ack as-is
-                        pass
-                    elif (
-                        getattr(msg, "over", None) is None
-                        or stored_ver != msg.over
-                        or msg.version != pg.version + 1
-                    ):
-                        raise IOError(
-                            f"rmw v{msg.over}->v{msg.version} onto shard "
-                            f"at obj v{stored_ver} pg v{pg.version}"
-                        )
-                    else:
-                        seg = unpack_data(msg.data)
-                        if crc32c(seg) != msg.crc:
-                            raise IOError("rmw sub-op crc mismatch")
-                        off = int(msg.off or 0)
-                        try:
-                            full = bytearray(self.store.read(cid, msg.oid))
-                        except (NotFound, KeyError):
-                            raise IOError("rmw target chunk missing on shard")
-                        if off + len(seg) > len(full):
-                            raise IOError("rmw beyond stored chunk")
-                        # rot check BEFORE applying: stamping a fresh
-                        # hinfo over a corrupt base would launder the rot
-                        # past every later integrity check
-                        try:
-                            stored_h = int(
-                                self.store.getattr(cid, msg.oid, "hinfo"))
-                        except (NotFound, KeyError, ValueError):
-                            stored_h = None
-                        if (stored_h is not None
-                                and crc32c(bytes(full)) != stored_h):
-                            raise IOError("rmw base chunk failed hinfo")
-                        if msg.mode == "delta":
-                            seg = (
-                                np.frombuffer(
-                                    bytes(full[off:off + len(seg)]), np.uint8
-                                )
-                                ^ np.frombuffer(seg, np.uint8)
-                            ).tobytes()
-                        full[off:off + len(seg)] = seg
-                        t.write(cid, msg.oid, off, seg)
-                        t.setattr(cid, msg.oid, "hinfo",
-                                  str(crc32c(bytes(full))).encode())
-                        t.setattr(cid, msg.oid, "ver",
-                                  str(msg.version).encode())
-                        if msg.osize is not None:
-                            t.setattr(cid, msg.oid, "size",
-                                      str(msg.osize).encode())
-                elif msg.data is not None:
-                    chunk = unpack_data(msg.data)
-                    if crc32c(chunk) != msg.crc:
-                        raise IOError("chunk crc mismatch")
-                    # generation-regression guard: a full-chunk push
-                    # rebuilt from STALE sources (a donor that hasn't
-                    # caught up across an acting permutation) must never
-                    # overwrite a NEWER generation we hold — that is how
-                    # an applied write gets rolled back cluster-wide.
-                    # Equal/newer stamps apply (idempotent refresh /
-                    # catch-up); wildcard pushes only land on chunks
-                    # that carry no numeric stamp themselves.
-                    stored_gen = self._stored_ver(cid, msg.oid)
-                    push_gen = getattr(msg, "over", None)
-                    if push_gen is None:
-                        push_gen = msg.version
-                    if stored_gen is not None and (
-                        push_gen is None or push_gen < stored_gen
-                    ):
-                        raise IOError(
-                            f"refusing generation regression "
-                            f"v{push_gen} onto v{stored_gen}"
-                        )
-                    t.write(cid, msg.oid, 0, chunk)
-                    t.truncate(cid, msg.oid, len(chunk))
-                    t.setattr(cid, msg.oid, "hinfo", str(msg.crc).encode())
-                    # full-chunk pushes stamp the chunk GENERATION: a
-                    # recovery push carries the primary's stored stamp
-                    # (`over`) since its bytes are rebuilt-current; a
-                    # live write stamps its own version; a push that
-                    # knows neither (backfill of a legacy object) stamps
-                    # the wildcard so readers accept the bytes
-                    gen = getattr(msg, "over", None)
-                    if gen is None:
-                        gen = msg.version
-                    t.setattr(cid, msg.oid, "ver",
-                              str(gen).encode() if gen else b"")
-                    if msg.osize is not None:
-                        t.setattr(cid, msg.oid, "size",
-                                  str(msg.osize).encode())
-                elif (
-                    entry_op == "modify"
-                    and msg.osize is not None
-                    and msg.xattrs is None
-                ):
-                    # entry-only RMW companion (this shard's chunk bytes
-                    # were untouched): keep the size xattr and object
-                    # version current, but only if we actually hold the
-                    # object — and only when our log is contiguous, else
-                    # we'd stamp a version whose writes we missed.
-                    # (`ver` is a CHUNK-GENERATION stamp: xattr-only
-                    # pushes carry msg.xattrs and must not touch it —
-                    # they don't change stripe bytes)
-                    if msg.version is not None and msg.version == pg.version + 1:
-                        try:
-                            self.store.stat(cid, msg.oid)
-                        except (NotFound, KeyError):
-                            pass
-                        else:
-                            t.setattr(cid, msg.oid, "size",
-                                      str(msg.osize).encode())
-                            t.setattr(cid, msg.oid, "ver",
-                                      str(msg.version).encode())
-                elif entry_op in (None, "delete") and not msg.xattrs:
-                    # data-less delete (live op or recovery replay)
-                    try:
-                        self.store.stat(cid, msg.oid)
-                        t.remove(cid, msg.oid)
-                    except (NotFound, KeyError):
-                        pass
-                # else: entry-only push ("modify" log replay / "clean"
-                # seal / xattr-only update) — no data op
-                if msg.xattrs is not None:
-                    if msg.data is not None:
-                        # riding a data push (recovery): the dict is a FULL
-                        # snapshot — stale attrs a removal we missed must
-                        # not survive
-                        self._apply_xattr_updates(
-                            t, cid, msg.oid, msg.xattrs, snapshot=True
-                        )
-                    else:
-                        # live xattr-only update: apply ONLY if this shard
-                        # holds the object; a shard that missed the write
-                        # must not grow a phantom zero-length object
-                        # (recovery pushes data + attrs together later)
-                        try:
-                            self.store.stat(cid, msg.oid)
-                        except (NotFound, KeyError):
-                            pass
-                        else:
-                            self._apply_xattr_updates(
-                                t, cid, msg.oid, msg.xattrs
-                            )
-                if getattr(msg, "rmattrs", None):
-                    # atomic-with-data attr removals (cache-tier clean
-                    # clear riding a mutation); only if we hold the object
-                    try:
-                        existing = set(self.store.getattrs(cid, msg.oid))
-                    except (NotFound, KeyError):
-                        existing = set()
-                    for name in msg.rmattrs:
-                        if f"u_{name}" in existing:
-                            t.rmattr(cid, msg.oid, f"u_{name}")
-                if getattr(msg, "omap", None) is not None:
-                    # live omap mutation or recovery snapshot: omap
-                    # exists on replicated pools only; an omap op on a
-                    # fresh oid creates the object (touch), matching the
-                    # primary's transaction
-                    t.touch(cid, msg.oid)
-                    self._apply_omap(t, cid, msg.oid, msg.omap)
-                    if (msg.data is None and msg.version is not None
-                            and msg.version == pg.version + 1):
-                        # live omap-only update on a log-contiguous
-                        # shard: stamp the version for dup verification
-                        t.setattr(cid, msg.oid, "ver",
-                                  str(msg.version).encode())
-                if (
-                    msg.entry is not None
-                    and msg.version is not None
-                    and msg.version > pg.version
-                ):
-                    if entry_op == "clean":
-                        # a clean that JUMPS our version means we were
-                        # backfilled across a gap: seal an empty log window
-                        # so covers() stays honest about what we can vouch
-                        # for entry-by-entry
-                        self._log_seal_txn(t, cid, pg, msg.version)
-                    elif msg.version == pg.version + 1:
-                        entry = LogEntry.from_list(msg.entry)
-                        self._log_txn(t, cid, pg, entry)
-                    # else: the entry JUMPS our version (we missed writes —
-                    # e.g. a sub-write lost while the primary acked at
-                    # min_size).  Apply the data but refuse the log append:
-                    # advancing head across a hole would make this shard
-                    # report itself clean at a version whose intermediate
-                    # objects it does not hold.  Our stale version makes
-                    # the primary's next recovery tick replay the gap.
-                self.store.queue_transaction(t)
-        except Exception as e:
-            self.cct.dout("osd", 0, f"{self.whoami} sub_write failed: {e!r}")
-            retval = -5
-        else:
-            self.logger.inc("subop_w")
-        try:
-            conn.send_message(
-                MECSubOpWriteReply(tid=msg.tid, pgid=msg.pgid,
-                                   shard=msg.shard, retval=retval)
-            )
-        except (OSError, ConnectionError):
-            pass
-
-    def _handle_sub_read(self, conn, msg: MECSubOpRead) -> None:
-        cid = self._cid(msg.pgid, msg.shard)
-        try:
-            if msg.offsets == []:
-                # metadata-only probe: existence + size/xattrs, no body
-                self.store.stat(cid, msg.oid)
-                data = b""
-            elif msg.offsets:
-                # ranged reads feed RMW old-byte fetches and CLAY repair:
-                # verify the WHOLE chunk's hinfo first — serving rotted
-                # bytes here would poison a parity delta with a fresh CRC
-                # stamped over it (no rot check could catch it later)
-                whole = self.store.read(cid, msg.oid)
-                try:
-                    stored = int(self.store.getattr(cid, msg.oid, "hinfo"))
-                except (NotFound, KeyError, ValueError):
-                    stored = None
-                if stored is not None and crc32c(whole) != stored:
-                    self.cct.dout(
-                        "osd", 0,
-                        f"{self.whoami} hinfo mismatch on ranged read "
-                        f"{msg.pgid}/{msg.oid} shard {msg.shard}",
-                    )
-                    raise NotFound(msg.oid)
-                parts = []
-                for off, ln in msg.offsets:
-                    if ln == -1:
-                        parts.append(whole)
-                    else:
-                        parts.append(whole[off:off + ln])
-                data = b"".join(parts)
-            else:
-                data = self.store.read(cid, msg.oid)
-                # full-chunk read: verify at-rest integrity against the
-                # stored hinfo CRC before serving — a rotted chunk must
-                # read as MISSING so the primary reconstructs instead of
-                # decoding garbage (reference: ECBackend checks
-                # ECUtil::HashInfo on read, -EIO on mismatch)
-                try:
-                    stored = int(self.store.getattr(cid, msg.oid, "hinfo"))
-                except (NotFound, KeyError, ValueError):
-                    stored = None
-                if stored is not None and crc32c(data) != stored:
-                    self.cct.dout(
-                        "osd", 0,
-                        f"{self.whoami} hinfo mismatch on read "
-                        f"{msg.pgid}/{msg.oid} shard {msg.shard}",
-                    )
-                    raise NotFound(msg.oid)
-            try:
-                size = int(self.store.getattr(cid, msg.oid, "size"))
-            except (NotFound, KeyError):
-                size = None
-            try:
-                user = {
-                    n[2:]: pack_data(v)
-                    for n, v in self.store.getattrs(cid, msg.oid).items()
-                    if n.startswith("u_")
-                }
-            except (NotFound, KeyError):
-                user = None
-            reply = MECSubOpReadReply(
-                tid=msg.tid, pgid=msg.pgid, oid=msg.oid, shard=msg.shard,
-                retval=0, data=pack_data(data), size=size, xattrs=user,
-                ver=self._stored_ver(cid, msg.oid),
-            )
-        except (NotFound, KeyError):
-            reply = MECSubOpReadReply(
-                tid=msg.tid, pgid=msg.pgid, oid=msg.oid, shard=msg.shard,
-                retval=-2, data=None, size=None, xattrs=None, ver=None,
-            )
-        try:
-            conn.send_message(reply)
-        except (OSError, ConnectionError):
-            pass
-
-    def _handle_pg_query(self, conn, msg: MPGQuery) -> None:
-        pool_id, ps = msg.pgid.split(".")
-        pg = self._pg(int(pool_id), int(ps))
-        cid = self._cid(msg.pgid, msg.shard)
-        oids = []
-        try:
-            oids = sorted(
-                o for o in self.store.list_objects(cid)
-                if not o.startswith("_")
-            )
-        except (NotFound, KeyError):
-            pass
-        try:
-            conn.send_message(
-                MPGNotify(tid=msg.tid, pgid=msg.pgid, shard=msg.shard,
-                          version=pg.version, log_start=pg.log.tail,
-                          oids=oids, last_epoch=pg.last_map_epoch)
-            )
-        except (OSError, ConnectionError):
-            pass
-
-    def _handle_pg_clean(self, msg: MPGClean) -> None:
-        """Primary says the PG went clean at `epoch` (the
-        last_epoch_clean role): advance the persisted rebuild floor and
-        drop local interval history — settled intervals must never
-        re-block a future peering round.  A clean claim from a PAST
-        interval is ignored (a deposed primary cannot retro-settle
-        history it no longer owns)."""
-        pool_id, ps = msg.pgid.split(".")
-        pg = self._pg(int(pool_id), int(ps))
-        with pg.lock:
-            if msg.epoch < pg.interval_start:
-                return
-            pg.last_map_epoch = max(pg.last_map_epoch, int(msg.epoch))
-            pg.past_intervals.clear()
-            pg.intervals_rebuilt = False
-            self._save_intervals(pg)
-
-    # -- scrub (reference: src/osd/scrubber — deep scrub subset) ----------
-    def _local_scrub_map(self, cid: str) -> dict:
-        """ScrubMap of one shard collection: oid -> [computed_crc,
-        stored_crc_or_None, size] (reference: PGBackend::be_scan_list)."""
-        objects: dict[str, list] = {}
-        try:
-            oids = self.store.list_objects(cid)
-        except (NotFound, KeyError):
-            return objects
-        for oid in oids:
-            if oid.startswith("_"):
-                continue
-            try:
-                data = self.store.read(cid, oid)
-            except (NotFound, KeyError):
-                continue
-            try:
-                stored = int(self.store.getattr(cid, oid, "hinfo"))
-            except (NotFound, KeyError, ValueError):
-                stored = None
-            objects[oid] = [crc32c(data), stored, len(data)]
-        return objects
-
-    def _replicated_authoritative(
-        self, pg, maps: dict, acting: list[int], oid: str, bad_shard: int
-    ) -> tuple[bytes | None, int]:
-        """Authoritative copy for a replicated repair: any replica whose
-        scrub entry is self-consistent (computed == stored digest), the
-        primary's preferred (reference: be_select_auth_object)."""
-        candidates = sorted(
-            maps,
-            key=lambda s: (acting[s] != self.id, s),  # self first
-        )
-        for s in candidates:
-            if s == bad_shard:
-                continue
-            ent = maps[s].get(oid)
-            if ent is None or (ent[1] is not None and ent[0] != ent[1]):
-                continue
-            osd = acting[s]
-            if osd == self.id:
-                try:
-                    data = self.store.read(self._cid(pg.pgid, 0), oid)
-                    return bytes(data), len(data)
-                except (NotFound, KeyError):
-                    continue
-            tid = self._next_tid()
-            try:
-                self._conn_to_osd(osd).send_message(
-                    MECSubOpRead(tid=tid, pgid=pg.pgid, oid=oid, shard=0,
-                                 offsets=None, epoch=self.my_epoch())
-                )
-            except (OSError, ConnectionError):
-                continue
-            rep = self._wait_reply(tid, timeout=5.0)
-            if rep is not None and rep.retval == 0:
-                data = unpack_data(rep.data)
-                return data, len(data)
-        return None, 0
-
-    def _handle_scrub_shard(self, conn, msg: MScrubShard) -> None:
-        try:
-            conn.send_message(
-                MScrubShardReply(
-                    tid=msg.tid, pgid=msg.pgid, shard=msg.shard,
-                    objects=self._local_scrub_map(
-                        self._cid(msg.pgid, msg.shard)
-                    ),
-                )
-            )
-        except (OSError, ConnectionError):
-            pass
-
-    def scrub_pg(self, pool_id: int, ps: int, repair: bool = True) -> dict:
-        """Deep scrub one PG from its primary: collect every shard's
-        ScrubMap, flag shards whose at-rest bytes rotted under their own
-        digest or that miss objects others hold, and (repair=True) rebuild
-        those shards from the surviving ones (reference:
-        PrimaryLogPG::scrub_compare_maps + repair_object)."""
-        m = self.osdmap
-        pool = m.pools.get(pool_id) if m else None
-        if pool is None:
-            raise KeyError(f"no pool {pool_id}")
-        acting, primary = self._acting(pool_id, ps)
-        if primary != self.id:
-            raise RuntimeError(f"not primary for {pool_id}.{ps}")
-        pg = self._pg(pool_id, ps)
-        is_ec = pool.type == PG_POOL_ERASURE
-        codec = self._codec_for_pool(pool) if is_ec else None
-        # map collection runs UNLOCKED (writes proceed; a racing write can
-        # only produce a false positive whose "repair" re-pushes current,
-        # consistent bytes).  pg.lock is taken per-object for repairs, so
-        # a slow shard never blocks client I/O for the whole scrub.
-        maps: dict[int, dict] = {}
-        tids: dict[int, int] = {}
-        for shard, osd in enumerate(acting):
-            store_shard = shard if is_ec else 0
-            if osd < 0 or not m.is_up(osd):
-                continue
-            if osd == self.id:
-                maps[shard] = self._local_scrub_map(
-                    self._cid(pg.pgid, store_shard)
-                )
-                continue
-            tid = self._next_tid()
-            tids[tid] = shard
-            try:
-                self._conn_to_osd(osd).send_message(
-                    MScrubShard(tid=tid, pgid=pg.pgid, shard=store_shard,
-                                epoch=self.my_epoch())
-                )
-            except (OSError, ConnectionError):
-                tids.pop(tid, None)
-        for tid, shard in tids.items():
-            rep = self._wait_reply(tid, timeout=10.0)
-            if rep is not None:
-                maps[shard] = rep.objects or {}
-
-        all_oids: set[str] = set()
-        for sm in maps.values():
-            all_oids |= set(sm)
-        # objects whose FINAL log entry is a delete: a shard still holding
-        # one is stale (its delete sub-op was lost) — flag the holder, and
-        # never let "missing" on up-to-date shards resurrect the object
-        _newest, log_deleted = pg.log.missing_since(0)
-        my_shard = next((s for s in maps if acting[s] == self.id), None)
-        errors: list[dict] = []
-        for oid in sorted(all_oids):
-            if oid in log_deleted:
-                for shard, sm in maps.items():
-                    if oid in sm:
-                        errors.append(
-                            {"oid": oid, "shard": shard,
-                             "error": "stale_deleted"}
-                        )
-                continue
-            # authoritative digest for cross-copy comparison (replicated):
-            # a SELF-CONSISTENT copy, the primary's preferred (reference:
-            # be_select_auth_object) — never a copy that fails its own
-            # digest, so primary bit-rot cannot propagate
-            auth_crc = None
-            if not is_ec:
-                order = sorted(
-                    maps, key=lambda s: (s != my_shard, s)
-                )
-                for s in order:
-                    ent = maps[s].get(oid)
-                    if ent is None:
-                        continue
-                    if ent[1] is None or ent[0] == ent[1]:
-                        auth_crc = ent[0]
-                        break
-            for shard, sm in maps.items():
-                ent = sm.get(oid)
-                if ent is None:
-                    errors.append(
-                        {"oid": oid, "shard": shard, "error": "missing"}
-                    )
-                elif ent[1] is not None and ent[0] != ent[1]:
-                    # at-rest rot under the shard's own digest (EC chunks
-                    # and, with hinfo now stamped everywhere, replicas)
-                    errors.append(
-                        {"oid": oid, "shard": shard,
-                         "error": "data_digest_mismatch"}
-                    )
-                elif (
-                    not is_ec
-                    and auth_crc is not None
-                    and ent[0] != auth_crc
-                ):
-                    errors.append(
-                        {"oid": oid, "shard": shard,
-                         "error": "data_digest_mismatch"}
-                    )
-            self.logger.inc("scrubs")
-            self.logger.inc("scrub_errors", len(errors))
-        repaired = 0
-        if repair and errors:
-            # shards known-bad per oid: their chunks must not feed a
-            # rebuild (decoding from a rotted chunk would launder the
-            # corruption into a fresh self-consistent digest)
-            bad_by_oid: dict[str, set[int]] = {}
-            for err in errors:
-                bad_by_oid.setdefault(err["oid"], set()).add(err["shard"])
-            for err in errors:
-                shard = err["shard"]
-                osd = acting[shard]
-                store_shard = shard if is_ec else 0
-                with pg.lock:  # per-object: writes proceed between repairs
-                    if err["error"] == "stale_deleted":
-                        if osd == self.id:
-                            cid = self._cid(pg.pgid, store_shard)
-                            t = Transaction()
-                            try:
-                                self.store.stat(cid, err["oid"])
-                                t.remove(cid, err["oid"])
-                                self.store.queue_transaction(t)
-                                repaired += 1
-                            except (NotFound, KeyError):
-                                pass
-                        elif self._push_sub_write(
-                            pg, osd, store_shard, err["oid"], None, None,
-                            None,
-                        ):
-                            repaired += 1
-                        continue
-                    if is_ec:
-                        chunk, size = self._rebuild_shard_chunk(
-                            pg, codec, acting, err["oid"], shard, True,
-                            exclude=bad_by_oid.get(err["oid"], set()),
-                        )
-                    else:
-                        chunk, size = self._replicated_authoritative(
-                            pg, maps, acting, err["oid"], bad_shard=shard
-                        )
-                    if chunk is None:
-                        continue
-                    if osd == self.id:
-                        cid = self._cid(pg.pgid, store_shard)
-                        t = Transaction()
-                        t.try_create_collection(cid)
-                        t.write(cid, err["oid"], 0, chunk)
-                        t.truncate(cid, err["oid"], len(chunk))
-                        t.setattr(cid, err["oid"], "hinfo",
-                                  str(crc32c(chunk)).encode())
-                        t.setattr(cid, err["oid"], "size",
-                                  str(size).encode())
-                        self.store.queue_transaction(t)
-                        repaired += 1
-                    elif self._push_sub_write(
-                        pg, osd, store_shard, err["oid"], chunk, None,
-                        [0, "modify", err["oid"]], osize=size,
-                        src_cid=self._cid(
-                            pg.pgid,
-                            acting.index(self.id) if is_ec else 0),
-                    ):
-                        repaired += 1
-            self.logger.inc("scrub_repairs", repaired)
-        return {
-            "pgid": pg.pgid,
-            "shards": len(maps),
-            "objects": len(all_oids),
-            "errors": errors,
-            "repaired": repaired if repair else 0,
-        }
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(timeout=remaining):
+                    # timed out: drain anything that landed, then stop
+                    for tid in [t for t in pending
+                                if t in self._sub_replies]:
+                        out[tid] = self._sub_replies.pop(tid)
+                    break
+        return out
 
     # -- heartbeats + recovery tick ---------------------------------------
     def _tick_loop(self) -> None:
@@ -3718,956 +628,3 @@ class OSD(Dispatcher):
         finally:
             self._recovery_inflight = False
 
-    # -- PG split migration (pg_num increase) ------------------------------
-    def _split_pass_work(self) -> None:
-        try:
-            self._split_pass()
-            self._snaptrim_pass()
-            self._tier_agent_pass()
-        finally:
-            self._split_inflight = False
-
-    def _split_pass(self) -> None:
-        """Migrate objects stranded in pre-split PGs (reference: PG split —
-        OSD::split_pgs + backfill; here the old-PG primary rewrites each
-        misplaced object through the normal client-op path to its
-        post-split PG, then deletes the old copy).
-
-        Eventually consistent: the pass re-runs every tick until each
-        primary PG has been scanned clean under the current pg_num, so an
-        OSD that was down during the split finishes the job when it
-        returns.  Window semantics: until an object is migrated, clients
-        on the new map read -ENOENT from the post-split PG (the reference
-        covers this window with pg history + peering; SURVEY's data plane
-        accepts the brief window)."""
-        m = self.osdmap
-        if m is None:
-            return
-        for pgid, pg in list(self.pgs.items()):
-            if self._stop.is_set():
-                return
-            pool = m.pools.get(pg.pool_id)
-            if pool is None or pg.split_scanned >= pool.pg_num:
-                continue
-            _acting, primary = self._acting(pg.pool_id, pg.ps)
-            if primary != self.id:
-                continue  # re-checked next pass (primary may change)
-            try:
-                self._split_migrate_pg(pg, pool)
-                pg.split_scanned = pool.pg_num
-            except Exception as e:
-                self.cct.dout(
-                    "osd", 1, f"{self.whoami} split pass {pgid}: {e!r}"
-                )
-
-    def _split_migrate_pg(self, pg, pool) -> None:
-        # raw store listing: snapshot clones are hidden from the client
-        # `list` op but must migrate with their head
-        acting, _p = self._acting(pg.pool_id, pg.ps)
-        if self.id not in acting:
-            return
-        try:
-            names = self.store.list_objects(
-                self._primary_cid(pg, pool, acting)
-            )
-        except (NotFound, KeyError):
-            return
-        for oid in sorted(names):
-            if oid.startswith("_"):
-                continue
-            head = oid.split(CLONE_SEP, 1)[0]
-            new_ps = object_ps(head, pool.pg_num)
-            if new_ps != pg.ps:
-                self._migrate_object(pg, pool, oid, new_ps)
-
-    def _forward_op(self, target: int, msg: MOSDOp):
-        """Execute an op locally when this OSD is the target primary, else
-        ship it and wait (the OSD acting as its own Objecter)."""
-        if target == self.id:
-            return self._execute_client_op(msg)
-        conn = self._conn_to_osd(target)
-        conn.send_message(msg)
-        return self._wait_reply(msg.tid, timeout=15.0)
-
-    def _migrate_object(self, pg, pool, oid: str, new_ps: int) -> None:
-        """write-to-new-PG before delete-from-old: a crash mid-migration
-        leaves a duplicate (invisible: lookups hash to the new PG), never
-        a loss.
-
-        Lost-update guard: a client on the new map may have ALREADY
-        written the object into its post-split PG; the stale pre-split
-        copy must not clobber it, so the destination is stat'd first and
-        a hit just drops the old copy.  (A write landing between the stat
-        and our write is the residual window; the reference closes it
-        with peering's authoritative log — out of scope here and noted.)
-        """
-        e = self.my_epoch()
-        _a, new_primary = self._acting(pg.pool_id, new_ps)
-        # every dest op carries the explicit post-split ps: snapshot-clone
-        # names would hash elsewhere (placement follows their HEAD object)
-        st = self._forward_op(new_primary, MOSDOp(
-            tid=self._next_tid(), pool=pg.pool_id, oid=oid, op="stat",
-            epoch=e, ps=new_ps,
-        ))
-        if st is not None and st.retval == 0:
-            # newer post-split copy exists: just retire the stale one
-            d = self._execute_client_op(MOSDOp(
-                tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-                op="delete", epoch=e, ps=pg.ps,
-            ))
-            if d.retval != 0:
-                raise RuntimeError(f"split retire {oid}: {d.result}")
-            return
-        r = self._execute_client_op(MOSDOp(
-            tid=self._next_tid(), pool=pg.pool_id, oid=oid, op="read",
-            epoch=e, ps=pg.ps, off=0, length=0,
-        ))
-        if r.retval != 0:
-            raise RuntimeError(f"split read {oid}: {r.result}")
-        xr = self._execute_client_op(MOSDOp(
-            tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-            op="getxattrs", epoch=e, ps=pg.ps,
-        ))
-        xattrs = xr.result if xr.retval == 0 else None
-        w = self._forward_op(new_primary, MOSDOp(
-            tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-            op="write_full", data=r.data, epoch=e, ps=new_ps,
-        ))
-        if w is None or w.retval != 0:
-            raise RuntimeError(
-                f"split write {oid}: {w.result if w else 'timeout'}"
-            )
-        if xattrs:
-            xw = self._forward_op(new_primary, MOSDOp(
-                tid=self._next_tid(), pool=pg.pool_id, oid=oid,
-                op="setxattr", data=xattrs, epoch=e, ps=new_ps,
-            ))
-            if xw is None or xw.retval != 0:
-                raise RuntimeError(
-                    f"split xattrs {oid}: {xw.result if xw else 'timeout'}"
-                )
-        d = self._execute_client_op(MOSDOp(
-            tid=self._next_tid(), pool=pg.pool_id, oid=oid, op="delete",
-            epoch=e, ps=pg.ps,
-        ))
-        if d.retval != 0:
-            raise RuntimeError(f"split delete {oid}: {d.result}")
-        self.cct.dout(
-            "osd", 10,
-            f"{self.whoami} split: migrated {oid} "
-            f"{pg.pool_id}.{pg.ps} -> {pg.pool_id}.{new_ps}",
-        )
-
-    def _maybe_schedule_scrub(self, now: float) -> None:
-        """Periodic deep scrub of primary PGs (reference: OSD::sched_scrub;
-        osd_deep_scrub_interval 0 disables — tests drive scrub_pg
-        directly)."""
-        interval = self.cct.conf.get("osd_deep_scrub_interval")
-        if not interval or now - self._last_scrub < interval:
-            return
-        self._last_scrub = now
-        m = self.osdmap
-        if m is None:
-            return
-        for pool_id, pool in m.pools.items():
-            for ps in range(pool.pg_num):
-                try:
-                    _acting, primary = self._acting(pool_id, ps)
-                except KeyError:
-                    continue
-                if primary != self.id:
-                    continue
-                pgid = f"{pool_id}.{ps}"
-                if pgid in self._scrubs_queued:
-                    continue  # scrubs outlasting the interval must not pile
-                self._scrubs_queued.add(pgid)
-
-                def scrub_work(pid=pool_id, s=ps, key=pgid):
-                    try:
-                        self.scrub_pg(pid, s)
-                    finally:
-                        self._scrubs_queued.discard(key)
-
-                self.scheduler.enqueue("background_scrub", scrub_work)
-
-    def _mgr_report(self) -> None:
-        """Stream a perf snapshot to the mgr (reference: MgrClient sending
-        MMgrReport on its tick)."""
-        addr = self.cct.conf.get("mgr_addr")
-        if not addr:
-            return
-        from ..mgr.messages import MMgrReport
-
-        host, _, port = addr.rpartition(":")
-        with self._pgs_lock:
-            num_pgs = len(self.pgs)
-        # the store scan runs UNLOCKED: heartbeats/recovery/map-apply all
-        # contend on _pgs_lock, and an O(objects) walk per report tick
-        # must not delay them toward the failure-report threshold
-        num_objects = 0
-        pool_bytes: dict[int, int] = {}
-        try:
-            coll_bytes = self.store.collections_bytes()  # one index pass
-        except Exception:
-            coll_bytes = {}
-        for cid in self.store.list_collections():
-            pool_id = None
-            if "." in cid:
-                try:
-                    pool_id = int(cid.split(".", 1)[0])
-                except ValueError:
-                    pool_id = None
-            try:
-                num_objects += sum(
-                    1 for o in self.store.list_objects(cid)
-                    if not o.startswith("_")
-                )
-            except Exception:
-                continue
-            if pool_id is not None:
-                pool_bytes[pool_id] = (
-                    pool_bytes.get(pool_id, 0) + coll_bytes.get(cid, 0)
-                )
-        self.logger.set("numpg", num_pgs)
-        try:
-            self.messenger.connect((host, int(port))).send_message(
-                MMgrReport(
-                    daemon=self.whoami,
-                    counters=self.cct.perf.dump(),
-                    epoch=self.my_epoch(),
-                    stats={"num_pgs": num_pgs, "num_objects": num_objects,
-                           "pool_bytes": {
-                               str(k): v for k, v in pool_bytes.items()
-                           }},
-                )
-            )
-        except (OSError, ConnectionError, ValueError):
-            pass  # mgr down: retry next interval
-
-    def _heartbeat(self) -> None:
-        """Ping peers sharing PGs with us (reference: OSD::heartbeat);
-        after 3 silent intervals report the peer to the mon (§5.3)."""
-        m = self.osdmap
-        if m is None:
-            return
-        peers: set[int] = set()
-        with self._pgs_lock:
-            pgs = list(self.pgs.values())
-        for pg in pgs:
-            try:
-                acting, _ = self._acting(pg.pool_id, pg.ps)
-            except KeyError:
-                continue
-            peers |= {o for o in acting if o >= 0 and o != self.id}
-        for osd in peers:
-            if not m.is_up(osd):
-                continue
-            prev = self._hb_failures.get(osd, 0)
-            try:
-                self._conn_to_osd(osd).send_message(
-                    MOSDPingMsg(op="ping", osd=self.id, epoch=self.my_epoch())
-                )
-                self._hb_failures[osd] = prev + 1
-            except (OSError, ConnectionError):
-                self._hb_failures[osd] = prev + 1
-            if self._hb_failures.get(osd, 0) >= 3:
-                self.mc.report_failure(osd, failed_for=6.0)
-                # restart the count: re-report only after another 3 silent
-                # intervals, not on every subsequent tick
-                self._hb_failures.pop(osd, None)
-
-    # -- recovery (peering-lite, primary only) ----------------------------
-    def _recover_all(self) -> None:
-        m = self.osdmap
-        if m is None:
-            return
-        # discover PGs I'm primary for (incl. ones with no local data yet)
-        for pool_id, pool in m.pools.items():
-            for ps in range(pool.pg_num):
-                try:
-                    acting, primary = self._acting(pool_id, ps)
-                except KeyError:
-                    continue
-                if primary != self.id or self.id not in acting:
-                    continue
-                pg = self._pg(pool_id, ps)
-                # NO pg.lock here: _recover_pg's pull phase waits on the
-                # donor's sub-writes, which our dispatch thread can only
-                # apply after taking pg.lock — holding it across the pull
-                # self-deadlocks.  _recover_pg locks its push phase.
-                try:
-                    self._recover_pg(pg, pool, acting)
-                except Exception as e:
-                    self.cct.dout(
-                        "osd", 1,
-                        f"{self.whoami} recover {pg.pgid}: {e!r}",
-                    )
-
-    def _rebuild_intervals_from_maps(self, pg: PGState, start: int,
-                                     until: int | None = None) -> None:
-        """Reconstruct interval history from the mon's stored maps
-        (reference: PastIntervals::check_new_interval walked over past
-        OSDMaps via OSDService::get_map).  A revived OSD's in-memory
-        tracking saw nothing while it was down, and a freshly-assigned
-        primary only started recording at its own PG creation; the maps
-        saw everything.  Rebuilds the closures over [start, until) and
-        PREPENDS them to whatever in-memory history already exists."""
-        from .past_intervals import PastIntervals
-
-        cur = self.my_epoch()
-        until = cur if until is None else min(until, cur)
-        start = max(1, start)
-        if until - start > 512:
-            start = until - 512  # bound mon fetches on huge gaps
-        # batched fetch: ~8 round trips for the full 512-epoch bound
-        # instead of one command per epoch (review r4)
-        fetched: dict[int, dict] = {}
-        e = start
-        while e <= until:
-            if self.osdmap is not None and e == self.osdmap.epoch:
-                e += 1
-                continue
-            try:
-                rv, res = self.mc.command(
-                    {"prefix": "osd getmaps", "first": e, "last": until},
-                    timeout=10.0,
-                )
-            except (OSError, ConnectionError):
-                return  # mon unreachable: retry next pass
-            if rv != 0:
-                return
-            fetched.update(
-                {int(k): v for k, v in res.get("maps", {}).items()}
-            )
-            e = int(res.get("last", e)) + 1
-        rebuilt = PastIntervals()
-        prev = None
-        prev_ua = None
-        first = start
-        for e in range(start, until + 1):
-            if self.osdmap is not None and e == self.osdmap.epoch:
-                m = self.osdmap
-            else:
-                j = fetched.get(e)
-                if j is None:
-                    continue  # epoch gap (paxos-trimmed): skip
-                m = OSDMap.from_json(j)
-            try:
-                ua = m.pg_to_up_acting_osds(pg.pool_id, pg.ps)
-            except Exception:
-                prev, prev_ua = m, None
-                continue
-            if prev_ua is not None and (prev_ua[2], prev_ua[3]) != \
-                    (ua[2], ua[3]):
-                pool = prev.pools.get(pg.pool_id)
-                went_rw = (
-                    prev_ua[3] >= 0
-                    and pool is not None
-                    and sum(1 for a in prev_ua[2] if a >= 0) >= pool.min_size
-                )
-                rebuilt.add(
-                    first=first, last=m.epoch - 1,
-                    up=prev_ua[0], acting=prev_ua[2], primary=prev_ua[3],
-                    maybe_went_rw=went_rw,
-                )
-                first = m.epoch
-            prev, prev_ua = m, ua
-        pg.intervals_rebuilt = True
-        if rebuilt:
-            from .past_intervals import MAX_INTERVALS
-
-            # keep the NEWEST MAX_INTERVALS — direct assignment must not
-            # bypass add()'s growth cap (review r4)
-            pg.past_intervals.intervals = (
-                rebuilt.intervals + pg.past_intervals.intervals
-            )[-MAX_INTERVALS:]
-            self.cct.dout(
-                "osd", 1,
-                f"{self.whoami} {pg.pgid} rebuilt "
-                f"{len(rebuilt.intervals)} past interval(s) from maps "
-                f"[{start},{until}]",
-            )
-            self._save_intervals(pg)
-
-    def _recover_pg(self, pg: PGState, pool, acting: list[int]) -> None:
-        is_ec = pool.type == PG_POOL_ERASURE
-        codec = self._codec_for_pool(pool) if is_ec else None
-        # one query round: peer versions + object lists drive the
-        # authoritative-log pull, the per-peer classification, and
-        # delete propagation
-        peers: dict[tuple[int, int], tuple[int, list]] = {}
-        peer_epochs: list[int] = []
-        for shard, osd in enumerate(acting):
-            if osd < 0 or osd == self.id or not self.osdmap.is_up(osd):
-                continue
-            # replicated replicas all store in the s0 collection; only EC
-            # shards have per-shard collections
-            store_shard = shard if is_ec else 0
-            tid = self._next_tid()
-            try:
-                self._conn_to_osd(osd).send_message(
-                    MPGQuery(tid=tid, pgid=pg.pgid, shard=store_shard,
-                             epoch=self.my_epoch())
-                )
-            except (OSError, ConnectionError):
-                continue
-            rep = self._wait_reply(tid, timeout=5.0)
-            if rep is None or rep.version is None:
-                continue
-            peers[(shard, osd)] = (rep.version, rep.oids or [])
-            e = getattr(rep, "last_epoch", None)
-            if e:
-                peer_epochs.append(int(e))
-        interval_at_entry = pg.interval_start
-        # history rebuild (reference: pg_history_t carried in notifies +
-        # PastIntervals built over past OSDMaps): when this primary has
-        # no interval history but the PG demonstrably has a past — its
-        # own or any peer's last-write epoch predates the current
-        # interval — fetch the intervening maps from the mon and
-        # reconstruct the closed intervals before judging anything.
-        # Covers both the revived stale OSD (its own epoch is old) and
-        # the freshly-assigned empty primary (a peer's epoch is old) —
-        # even one that already recorded SOME closures of its own: the
-        # rebuild fills the prefix its in-memory tracking predates.
-        known = [e for e in ([pg.last_map_epoch] + peer_epochs) if e]
-        hist_floor = (
-            pg.past_intervals.intervals[0]["first"]
-            if pg.past_intervals else pg.interval_start
-        )
-        if (
-            not pg.intervals_rebuilt
-            and known
-            and min(known) < hist_floor
-        ):
-            self._rebuild_intervals_from_maps(
-                pg, start=min(known), until=hist_floor
-            )
-        # choose_acting beyond the acting set (reference: build_prior +
-        # choose_acting over PastIntervals): members of past rw
-        # intervals may hold a log NEWER than anything the current
-        # acting set has — query them too, bounded by the history
-        strays: dict[tuple[int, int], int] = {}
-        queried = {self.id} | {osd for (_s, osd) in peers}
-        prior = pg.past_intervals.query_candidates(
-            exclude={-1, self.id} | {o for o in acting if o >= 0},
-            is_up=self.osdmap.is_up,
-        )
-        for osd, p_shard in prior.items():
-            tid = self._next_tid()
-            try:
-                self._conn_to_osd(osd).send_message(
-                    MPGQuery(tid=tid, pgid=pg.pgid,
-                             shard=p_shard if is_ec else 0,
-                             epoch=self.my_epoch())
-                )
-            except (OSError, ConnectionError):
-                continue
-            rep = self._wait_reply(tid, timeout=5.0)
-            if rep is None or rep.version is None:
-                continue
-            queried.add(osd)
-            strays[(p_shard, osd)] = rep.version
-        # build_prior activation block: a past rw interval NONE of whose
-        # members answered may hold the authoritative log — activating
-        # anyway could serve a stale/forked history (the exact failure
-        # generation floors cannot see).  Stay inactive and retry.
-        blocked = pg.past_intervals.blocked_by(queried)
-        if blocked:
-            iv = blocked[0]
-            self.cct.dout(
-                "osd", 1,
-                f"{self.whoami} {pg.pgid} peering blocked: interval "
-                f"[{iv['first']},{iv['last']}] acting {iv['acting']} "
-                f"went rw and no member is reachable",
-            )
-            return
-        # phase 0 — adopt the authoritative log (reference: peering's
-        # choose_acting/authoritative-log step): a primary revived after
-        # missing writes must catch ITSELF up first, else it would mint
-        # duplicate versions on the next write and wrongly judge
-        # ahead-peers clean (wait_clean compares against the primary).
-        # Runs WITHOUT pg.lock: the donor's catch-up arrives as
-        # MECSubOpWrites our dispatch thread applies under that lock.
-        ahead = {k: v for k, (v, _o) in peers.items() if v > pg.version}
-        stray_newest = max(strays.values(), default=0)
-        if stray_newest > max([pg.version, *ahead.values()]):
-            if is_ec:
-                # an EC stray proves newer writes exist, but a non-acting
-                # donor cannot push shard-correct chunks (the donor path
-                # reads by its acting index) — stay INACTIVE rather than
-                # activate on a log we know is stale; the PG heals when
-                # the stray rejoins acting or an acting member catches up
-                self.cct.dout(
-                    "osd", 1,
-                    f"{self.whoami} {pg.pgid} stale vs stray holders "
-                    f"(v{stray_newest} > v{pg.version}); deferring "
-                    f"activation",
-                )
-                return
-            # replicated: the past-interval holder IS the authoritative
-            # log donor even though it is not acting (choose_acting
-            # electing a stray; every replica is shard 0, so the pull
-            # path needs no shard translation)
-            ahead = {
-                k: v for k, v in strays.items() if v == stray_newest
-            }
-        if ahead:
-            (_b_shard, b_osd), _bv = max(ahead.items(), key=lambda kv: kv[1])
-            my_shard = acting.index(self.id) if is_ec else 0
-            try:
-                my_oids = [
-                    o for o in self.store.list_objects(
-                        self._cid(pg.pgid, my_shard))
-                    if not o.startswith("_")
-                ]
-            except (NotFound, KeyError):
-                my_oids = []
-            tid = self._next_tid()
-            try:
-                self._conn_to_osd(b_osd).send_message(MPGPull(
-                    tid=tid, pgid=pg.pgid, shard=my_shard,
-                    from_version=pg.version, epoch=self.my_epoch(),
-                    have_oids=my_oids,
-                ))
-                rep = self._wait_reply(tid, timeout=30.0)
-            except (OSError, ConnectionError):
-                rep = None
-            if rep is not None and rep.retval == 0:
-                self.cct.dout(
-                    "osd", 1,
-                    f"{self.whoami} pulled {pg.pgid} forward to "
-                    f"v{pg.version} from osd.{b_osd}",
-                )
-            else:
-                return  # retry next tick; judging peers now would be wrong
-        # peered: no peer is ahead (or we just adopted the ahead log) —
-        # this primary may now serve ops for the current interval
-        pg.activated_interval = interval_at_entry
-        if pg.version == 0:
-            return  # nothing written yet
-        my_shard = acting.index(self.id) if is_ec else 0
-        my_cid = self._cid(pg.pgid, my_shard)
-
-        def _my_oids() -> set:
-            try:
-                return {
-                    o for o in self.store.list_objects(my_cid)
-                    if not o.startswith("_")
-                }
-            except (NotFound, KeyError):
-                return set()
-
-        my_oids = _my_oids()
-        # phase 0.5 — SELF role-heal: an acting permutation can hand this
-        # primary a shard role it never held; every peer below is judged
-        # against MY collection, so an empty one would read as
-        # everything-clean while the primary serves nothing.  Pull full
-        # content from an up-to-date peer — the donor's backfill push
-        # carries data + xattrs + omap and deletes my stale extras
-        # (reference: the primary recovers itself first in
-        # PeeringState::activate / recovery_state).
-        peer_union: set = set()
-        for (_v, oids) in peers.values():
-            peer_union.update(oids)
-        if peer_union - my_oids:
-            donor = next(
-                (osd for (shard, osd), (v, _o) in peers.items()
-                 if v >= pg.version),
-                None,
-            )
-            if donor is not None:
-                self.cct.dout(
-                    "osd", 1,
-                    f"{self.whoami} self role-heal {pg.pgid} shard "
-                    f"{my_shard}: {len(peer_union - my_oids)} objects "
-                    f"from osd.{donor}",
-                )
-                tid = self._next_tid()
-                try:
-                    self._conn_to_osd(donor).send_message(MPGPull(
-                        tid=tid, pgid=pg.pgid, shard=my_shard,
-                        from_version=0, epoch=self.my_epoch(),
-                        have_oids=sorted(my_oids),
-                    ))
-                    self._wait_reply(tid, timeout=30.0)
-                except (OSError, ConnectionError):
-                    pass
-                my_oids = _my_oids()
-        # push phase: serialize vs concurrent client writes on this PG
-        all_clean = True
-        with pg.lock:
-            for (shard, osd), (peer_ver, peer_oids) in peers.items():
-                role_missing = my_oids - set(peer_oids)
-                if peer_ver >= pg.version and not role_missing:
-                    continue  # clean
-                all_clean = False
-                if peer_ver >= pg.version:
-                    # version-current but the SHARD ROLE's objects are
-                    # absent: an acting-set permutation (OSD out -> CRUSH
-                    # reshuffle) handed this OSD a shard it never held —
-                    # the per-PG version cannot see that, only the
-                    # contents comparison can.  Rebuild its new role's
-                    # chunks (and retire any stale leftovers in that
-                    # collection from an older interval).
-                    self.cct.dout(
-                        "osd", 1,
-                        f"{self.whoami} role-backfill {pg.pgid} shard "
-                        f"{shard} osd.{osd}: {len(role_missing)} objects",
-                    )
-                    self._push_objects(
-                        pg, codec, acting, shard if is_ec else 0, osd,
-                        {o: None for o in sorted(role_missing)},
-                        set(peer_oids) - my_oids, is_ec,
-                    )
-                else:
-                    self._push_missing(
-                        pg, codec, acting, shard if is_ec else 0, osd,
-                        peer_ver, is_ec, peer_oids,
-                    )
-        # prune the interval history once the PG is CLEAN in the current
-        # interval (reference: last_epoch_clean).  "Clean" demands a
-        # FULL acting set in which every member answered and needed no
-        # push — a degraded PG keeps its history: those unheard members
-        # are exactly what the history exists to track (review r4).
-        # The clean point is BROADCAST to the acting replicas (MPGClean)
-        # so their persisted rebuild floors advance too — otherwise a
-        # later primary rebuilding from a replica's stale last-write
-        # epoch would resurrect already-settled intervals whose members
-        # are long gone and block activation forever (review r4).
-        acting_members = {o for o in acting if o >= 0 and o != self.id}
-        if (
-            all_clean
-            and all(o >= 0 for o in acting)
-            and acting_members <= {osd for (_s, osd) in peers}
-            and (pg.past_intervals
-                 or pg.clean_broadcast_interval != interval_at_entry)
-        ):
-            epoch = self.my_epoch()
-            pg.past_intervals.clear()
-            pg.last_map_epoch = max(pg.last_map_epoch, epoch)
-            pg.intervals_rebuilt = False
-            pg.clean_broadcast_interval = interval_at_entry
-            self._save_intervals(pg)
-            for shard, osd in enumerate(acting):
-                if osd < 0 or osd == self.id or not self.osdmap.is_up(osd):
-                    continue
-                try:
-                    self._conn_to_osd(osd).send_message(MPGClean(
-                        pgid=pg.pgid, shard=shard if is_ec else 0,
-                        epoch=epoch,
-                    ))
-                except (OSError, ConnectionError):
-                    pass  # replica re-learns at its next clean pass
-
-    def _push_missing(self, pg, codec, acting, dest_shard, dest_osd,
-                      from_version, is_ec, dest_oids) -> bool:
-        """Classify delta vs backfill, push, seal — shared by the primary
-        push loop and the pull donor.  Counters are started/completed
-        pairs: stat_delta_recoveries / stat_backfills count rounds
-        STARTED (race-free for observers — an ack lost after the peer
-        applied would leave a completed-only counter at zero), the
-        *_completed twins count fully acked rounds."""
-        my_shard = acting.index(self.id) if is_ec else 0
-        if pg.log.covers(from_version):
-            self.cct.dout(
-                "osd", 1,
-                f"{self.whoami} delta-recovery {pg.pgid} "
-                f"shard {dest_shard} osd.{dest_osd} from v{from_version}",
-            )
-            pg.stat_delta_recoveries = getattr(
-                pg, "stat_delta_recoveries", 0) + 1
-            ok = self._push_log_delta(
-                pg, codec, acting, dest_shard, dest_osd, from_version, is_ec
-            )
-            if ok:
-                self._bump_peer_version(pg, dest_shard, dest_osd, pg.version)
-                pg.stat_delta_completed = getattr(
-                    pg, "stat_delta_completed", 0) + 1
-            return ok
-        # log too old: full backfill of this shard.  Versions are
-        # unknowable per object (trimmed), so chunks are pushed
-        # unversioned and the final sync entry seals the version.  The
-        # target's extra objects (deleted here after its log horizon)
-        # get data-less deletes — a survivors-only push would resurrect
-        # deletions when the target is later trusted.
-        try:
-            oids = [
-                o for o in self.store.list_objects(
-                    self._cid(pg.pgid, my_shard))
-                if not o.startswith("_")
-            ]
-        except (NotFound, KeyError):
-            oids = []
-        deleted = set(dest_oids or []) - set(oids)
-        self.cct.dout(
-            "osd", 1,
-            f"{self.whoami} backfill {pg.pgid} shard {dest_shard} "
-            f"osd.{dest_osd}: {len(oids)} objects, "
-            f"{len(deleted)} deletions",
-        )
-        pg.stat_backfills = getattr(pg, "stat_backfills", 0) + 1
-        ok = self._push_objects(
-            pg, codec, acting, dest_shard, dest_osd,
-            {o: None for o in oids}, deleted, is_ec,
-        )
-        if ok:
-            self._bump_peer_version(pg, dest_shard, dest_osd, pg.version)
-            pg.stat_backfill_completed = getattr(
-                pg, "stat_backfill_completed", 0) + 1
-        return ok
-
-    def _handle_pg_pull(self, conn, msg: MPGPull) -> None:
-        """An ahead peer serving a stale primary's catch-up request: push
-        my log delta (or full objects + deletions when my log was
-        trimmed) to the requester, then seal its version (the
-        authoritative-log donor role in peering).  Runs under MY pg.lock
-        so a concurrent write cannot advance the version mid-push and
-        let the seal vouch for entries never sent; the requester holds
-        no lock while waiting, so there is no cross-OSD lock cycle."""
-        retval = -5
-        try:
-            pool_id, ps = msg.pgid.split(".")
-            pg = self._pg(int(pool_id), int(ps))
-            pool = self.osdmap.pools.get(int(pool_id))
-            requester = (
-                int(msg.src.split(".", 1)[1])
-                if msg.src.startswith("osd.") else None
-            )
-            if pool is None or requester is None:
-                raise ValueError(f"bad pull {msg.src} {msg.pgid}")
-            acting, _p = self._acting(int(pool_id), int(ps))
-            is_ec = pool.type == PG_POOL_ERASURE
-            codec = self._codec_for_pool(pool) if is_ec else None
-            from_v = int(msg.from_version or 0)
-            with pg.lock:
-                if pg.version <= from_v:
-                    retval = 0  # nothing newer here
-                else:
-                    ok = self._push_missing(
-                        pg, codec, acting, msg.shard, requester, from_v,
-                        is_ec, msg.have_oids,
-                    )
-                    retval = 0 if ok else -5
-        except Exception as e:
-            self.cct.dout(
-                "osd", 0, f"{self.whoami} pg pull failed: {e!r}"
-            )
-        try:
-            conn.send_message(MPGPullReply(
-                tid=msg.tid, pgid=msg.pgid, shard=msg.shard, retval=retval
-            ))
-        except (OSError, ConnectionError):
-            pass
-
-    def _push_sub_write(self, pg, osd, shard, oid, data, version, entry,
-                        src_cid: str | None = None,
-                        osize: int | None = None) -> bool:
-        """One recovery push; True iff the peer acked it (retval 0).
-        Data pushes copy the object's user xattrs from `src_cid` (the
-        primary's own shard collection) so a recovered shard can answer
-        getxattrs after a primary move.  They also carry the primary's
-        stored chunk-generation stamp (`over`): the pushed bytes are
-        rebuilt-CURRENT, and stamping the log-entry version instead
-        would diverge from undisturbed shards whenever the log advanced
-        through xattr-only modifies (which don't change stripe bytes)."""
-        xattrs = None
-        gen = None
-        omap = None
-        if data is not None and src_cid is not None:
-            gen = self._stored_ver(src_cid, oid)
-            try:
-                mine = self.store.getattrs(src_cid, oid)
-            except (NotFound, KeyError):
-                mine = {}
-            # always a dict (may be empty): the receiver treats it as the
-            # FULL snapshot, clearing stale attrs a removal left behind
-            xattrs = {
-                n[2:]: pack_data(v)
-                for n, v in mine.items() if n.startswith("u_")
-            }
-            try:
-                kv = self.store.omap_get(src_cid, oid)
-            except (NotFound, KeyError):
-                kv = {}
-            # omap recovered as a full snapshot, like the xattrs — sent
-            # even when empty so a replica's stale keys are cleared
-            omap = {"snapshot": {k: pack_data(v) for k, v in kv.items()}}
-        tid = self._next_tid()
-        try:
-            self._conn_to_osd(osd).send_message(
-                MECSubOpWrite(
-                    tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
-                    data=pack_data(data) if data is not None else None,
-                    crc=crc32c(data) if data is not None else None,
-                    version=version, entry=entry, epoch=self.my_epoch(),
-                    xattrs=xattrs, over=gen, osize=osize, omap=omap,
-                )
-            )
-        except (OSError, ConnectionError):
-            return False
-        rep = self._wait_reply(tid, timeout=5.0)
-        return rep is not None and rep.retval == 0
-
-    def _push_log_delta(self, pg, codec, acting, shard, osd,
-                        peer_version: int, is_ec: bool) -> bool:
-        """Delta recovery: replay the FULL entry stream since the peer's
-        version, in order, so the peer's pg_log stays contiguous and its
-        covers() answer stays honest if it later becomes primary
-        (reference: PGLog merge + pg_missing_t-driven recover_object).
-
-        Data rides only the newest modify of each object; earlier modifies
-        and deletes replay as log-only / delete pushes.  Returns True only
-        if every push acked, so the caller never marks the peer clean past
-        data it does not hold."""
-        newest, _deleted = pg.log.missing_since(peer_version)
-        my_cid = self._cid(
-            pg.pgid, acting.index(self.id) if is_ec else 0
-        )
-        for e in pg.log.entries_since(peer_version):
-            if e.op == "delete":
-                ok = self._push_sub_write(
-                    pg, osd, shard, e.oid, None, e.version, e.to_list()
-                )
-            elif e.op in ("modify", "attr") and newest.get(e.oid) == e.version:
-                chunk, size = self._rebuild_shard_chunk(
-                    pg, codec, acting, e.oid, shard, is_ec
-                )
-                if chunk is None:
-                    # UNFOUND right now (reference: missing_loc unfound
-                    # set): park THIS object but keep recovering the
-                    # rest — one unrecoverable object must not wedge
-                    # the whole peer's recovery.  The entry still
-                    # replays (log stays contiguous); the object stays
-                    # missing on the peer exactly as it is everywhere
-                    # else, and a later tick retries when a source
-                    # resurfaces.
-                    self.cct.dout(
-                        "osd", 1,
-                        f"{self.whoami} recovery: {pg.pgid}/{e.oid} "
-                        f"unfound, parking",
-                    )
-                    ok = self._push_sub_write(
-                        pg, osd, shard, e.oid, None, e.version,
-                        e.to_list(),
-                    )
-                    if not ok:
-                        return False
-                    continue
-                ok = self._push_sub_write(
-                    pg, osd, shard, e.oid, chunk, e.version,
-                    e.to_list(), src_cid=my_cid, osize=size,
-                )
-                self.logger.inc("recovery_ops")
-            else:
-                # superseded modify / clean marker: log-entry-only replay
-                ok = self._push_sub_write(
-                    pg, osd, shard, e.oid, None, e.version, e.to_list()
-                )
-            if not ok:
-                return False
-        return True
-
-    def _push_objects(self, pg, codec, acting, shard, osd,
-                      newest: dict[str, int | None], deleted: set[str],
-                      is_ec: bool) -> bool:
-        """Backfill push: chunk data for every object, unversioned (the
-        trimmed log cannot vouch for per-object versions); the final
-        "clean" seal establishes the peer's version and empty log window.
-        The push still carries the object size (osize) so the peer can
-        answer stat/padding-strip."""
-        for oid in sorted(deleted):
-            if not self._push_sub_write(pg, osd, shard, oid, None, None, None):
-                return False
-        my_cid = self._cid(
-            pg.pgid, acting.index(self.id) if is_ec else 0
-        )
-        all_ok = True
-        for oid in sorted(newest, key=lambda o: (newest[o] or 0, o)):
-            chunk, size = self._rebuild_shard_chunk(
-                pg, codec, acting, oid, shard, is_ec
-            )
-            if chunk is None:
-                # unfound: park this object, recover the rest (see
-                # _push_log_delta); all_ok=False keeps the peer unsealed
-                # so later ticks retry
-                all_ok = False
-                continue
-            version = newest[oid]
-            entry = [version or 0, "modify", oid]
-            if not self._push_sub_write(
-                pg, osd, shard, oid, chunk, version, entry, src_cid=my_cid,
-                osize=size,
-            ):
-                all_ok = False
-        return all_ok
-
-    def _bump_peer_version(self, pg, shard, osd, version: int) -> None:
-        """Final version/log sync after successful pushes: a data-less
-        "clean" entry (ignored by missing_since) seals the peer at the
-        primary's version."""
-        tid = self._next_tid()
-        try:
-            self._conn_to_osd(osd).send_message(
-                MECSubOpWrite(
-                    tid=tid, pgid=pg.pgid, oid="", shard=shard,
-                    data=None, crc=None, version=version,
-                    entry=[version, "clean", ""],
-                    epoch=self.my_epoch(),
-                )
-            )
-            self._wait_reply(tid, timeout=5.0)
-        except (OSError, ConnectionError):
-            pass
-
-    def _rebuild_shard_chunk(
-        self, pg, codec, acting, oid: str, shard: int, is_ec: bool,
-        exclude: set[int] | None = None,
-    ) -> tuple[bytes | None, int]:
-        """Recompute shard `shard`'s bytes for oid (reference:
-        ECBackend::recover_object — read k chunks, re-encode).  `exclude`
-        names additional shards whose data must not feed the rebuild
-        (scrub-flagged rot)."""
-        my_shard = acting.index(self.id)
-        if not is_ec:
-            try:
-                data = self.store.read(self._cid(pg.pgid, 0), oid)
-                return data, len(data)
-            except (NotFound, KeyError):
-                return None, 0
-        k = codec.get_data_chunk_count()
-        n = codec.get_chunk_count()
-        # include the DEST shard in the gather: the receiver lacks its
-        # chunk, but the exact chunk may survive as a stray on a previous
-        # holder (acting permutations) — using it directly also rescues
-        # objects written degraded at exactly min_size, where fewer than
-        # k OTHER chunks exist and decode alone could never recover
-        want = set(range(n)) - (exclude or set())
-        sizes: dict[int, int] = {}
-        vers: dict[int, int | None] = {}
-        floor = pg.log.obj_newest.get(oid)
-        got = self._gather_chunks(pg, codec, acting, oid, want, sizes=sizes,
-                                  vers=vers, stray=True, floor=floor)
-        # never rebuild from a MIX of stripe generations, nor from one
-        # the log proves is below the newest write
-        got = _current_generation(got, vers, floor)
-        if shard in got:
-            try:
-                size = int(self.store.getattr(
-                    self._cid(pg.pgid, acting.index(self.id)), oid, "size"))
-            except (NotFound, KeyError, ValueError):
-                size = sizes.get(shard, next(iter(sizes.values()), 0))
-            return bytes(got[shard]), size
-        if len(got) < k:
-            return None, 0
-        try:
-            size = int(self.store.getattr(
-                self._cid(pg.pgid, my_shard), oid, "size"))
-        except (NotFound, KeyError, ValueError):
-            # our own xattr is gone (we may be the shard being repaired):
-            # any healthy peer's size xattr is authoritative
-            size = next(iter(sizes.values()), 0)
-        chunks = {s: np.frombuffer(b, np.uint8) for s, b in got.items()}
-        dec = codec.decode(
-            {shard}, chunks, len(next(iter(chunks.values())))
-        )
-        return np.asarray(dec[shard], np.uint8).tobytes(), size

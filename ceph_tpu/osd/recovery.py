@@ -1,0 +1,723 @@
+"""Peering-lite, delta recovery, backfill, and stray-shard probing (reference: src/osd/PeeringState.cc + ECBackend recovery).
+
+Split out of osd/daemon.py (round-4 verdict item #6) — the methods
+are verbatim; `OSD` composes every mixin, so cross-mixin calls (e.g.
+the tier front-end invoking the replicated backend) resolve on self.
+"""
+from __future__ import annotations
+
+
+
+import numpy as np
+
+from ..common.crc32c import crc32c
+from ..store.object_store import NotFound
+from .messages import (
+    MECSubOpWrite,
+    MPGClean,
+    MPGPull,
+    MPGPullReply,
+    MPGQuery,
+    pack_data,
+)
+from ..osd.osdmap import PG_POOL_ERASURE
+from ..osd.osdmap import OSDMap  # noqa: F401 (annotations)
+from .pg import _current_generation, PGState
+
+
+class RecoveryMixin:
+    # -- recovery (peering-lite, primary only) ----------------------------
+    def _recover_all(self) -> None:
+        m = self.osdmap
+        if m is None:
+            return
+        # discover PGs I'm primary for (incl. ones with no local data yet)
+        for pool_id, pool in m.pools.items():
+            for ps in range(pool.pg_num):
+                try:
+                    acting, primary = self._acting(pool_id, ps)
+                except KeyError:
+                    continue
+                if primary != self.id or self.id not in acting:
+                    continue
+                pg = self._pg(pool_id, ps)
+                # NO pg.lock here: _recover_pg's pull phase waits on the
+                # donor's sub-writes, which our dispatch thread can only
+                # apply after taking pg.lock — holding it across the pull
+                # self-deadlocks.  _recover_pg locks its push phase.
+                try:
+                    self._recover_pg(pg, pool, acting)
+                except Exception as e:
+                    self.cct.dout(
+                        "osd", 1,
+                        f"{self.whoami} recover {pg.pgid}: {e!r}",
+                    )
+
+    def _rebuild_intervals_from_maps(self, pg: PGState, start: int,
+                                     until: int | None = None) -> None:
+        """Reconstruct interval history from the mon's stored maps
+        (reference: PastIntervals::check_new_interval walked over past
+        OSDMaps via OSDService::get_map).  A revived OSD's in-memory
+        tracking saw nothing while it was down, and a freshly-assigned
+        primary only started recording at its own PG creation; the maps
+        saw everything.  Rebuilds the closures over [start, until) and
+        PREPENDS them to whatever in-memory history already exists."""
+        from .past_intervals import PastIntervals
+
+        cur = self.my_epoch()
+        until = cur if until is None else min(until, cur)
+        start = max(1, start)
+        if until - start > 512:
+            start = until - 512  # bound mon fetches on huge gaps
+        # batched fetch: ~8 round trips for the full 512-epoch bound
+        # instead of one command per epoch (review r4)
+        fetched: dict[int, dict] = {}
+        e = start
+        while e <= until:
+            if self.osdmap is not None and e == self.osdmap.epoch:
+                e += 1
+                continue
+            try:
+                rv, res = self.mc.command(
+                    {"prefix": "osd getmaps", "first": e, "last": until},
+                    timeout=10.0,
+                )
+            except (OSError, ConnectionError):
+                return  # mon unreachable: retry next pass
+            if rv != 0:
+                return
+            fetched.update(
+                {int(k): v for k, v in res.get("maps", {}).items()}
+            )
+            e = int(res.get("last", e)) + 1
+        rebuilt = PastIntervals()
+        prev = None
+        prev_ua = None
+        first = start
+        for e in range(start, until + 1):
+            if self.osdmap is not None and e == self.osdmap.epoch:
+                m = self.osdmap
+            else:
+                j = fetched.get(e)
+                if j is None:
+                    continue  # epoch gap (paxos-trimmed): skip
+                m = OSDMap.from_json(j)
+            try:
+                ua = m.pg_to_up_acting_osds(pg.pool_id, pg.ps)
+            except Exception:
+                prev, prev_ua = m, None
+                continue
+            if prev_ua is not None and (prev_ua[2], prev_ua[3]) != \
+                    (ua[2], ua[3]):
+                pool = prev.pools.get(pg.pool_id)
+                went_rw = (
+                    prev_ua[3] >= 0
+                    and pool is not None
+                    and sum(1 for a in prev_ua[2] if a >= 0) >= pool.min_size
+                )
+                rebuilt.add(
+                    first=first, last=m.epoch - 1,
+                    up=prev_ua[0], acting=prev_ua[2], primary=prev_ua[3],
+                    maybe_went_rw=went_rw,
+                )
+                first = m.epoch
+            prev, prev_ua = m, ua
+        pg.intervals_rebuilt = True
+        if rebuilt:
+            from .past_intervals import MAX_INTERVALS
+
+            # keep the NEWEST MAX_INTERVALS — direct assignment must not
+            # bypass add()'s growth cap (review r4)
+            pg.past_intervals.intervals = (
+                rebuilt.intervals + pg.past_intervals.intervals
+            )[-MAX_INTERVALS:]
+            self.cct.dout(
+                "osd", 1,
+                f"{self.whoami} {pg.pgid} rebuilt "
+                f"{len(rebuilt.intervals)} past interval(s) from maps "
+                f"[{start},{until}]",
+            )
+            self._save_intervals(pg)
+
+    def _recover_pg(self, pg: PGState, pool, acting: list[int]) -> None:
+        is_ec = pool.type == PG_POOL_ERASURE
+        codec = self._codec_for_pool(pool) if is_ec else None
+        # one query round: peer versions + object lists drive the
+        # authoritative-log pull, the per-peer classification, and
+        # delete propagation
+        peers: dict[tuple[int, int], tuple[int, list]] = {}
+        peer_epochs: list[int] = []
+        for shard, osd in enumerate(acting):
+            if osd < 0 or osd == self.id or not self.osdmap.is_up(osd):
+                continue
+            # replicated replicas all store in the s0 collection; only EC
+            # shards have per-shard collections
+            store_shard = shard if is_ec else 0
+            tid = self._next_tid()
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MPGQuery(tid=tid, pgid=pg.pgid, shard=store_shard,
+                             epoch=self.my_epoch())
+                )
+            except (OSError, ConnectionError):
+                continue
+            rep = self._wait_reply(tid, timeout=5.0)
+            if rep is None or rep.version is None:
+                continue
+            peers[(shard, osd)] = (rep.version, rep.oids or [])
+            e = getattr(rep, "last_epoch", None)
+            if e:
+                peer_epochs.append(int(e))
+        interval_at_entry = pg.interval_start
+        # history rebuild (reference: pg_history_t carried in notifies +
+        # PastIntervals built over past OSDMaps): when this primary has
+        # no interval history but the PG demonstrably has a past — its
+        # own or any peer's last-write epoch predates the current
+        # interval — fetch the intervening maps from the mon and
+        # reconstruct the closed intervals before judging anything.
+        # Covers both the revived stale OSD (its own epoch is old) and
+        # the freshly-assigned empty primary (a peer's epoch is old) —
+        # even one that already recorded SOME closures of its own: the
+        # rebuild fills the prefix its in-memory tracking predates.
+        known = [e for e in ([pg.last_map_epoch] + peer_epochs) if e]
+        hist_floor = (
+            pg.past_intervals.intervals[0]["first"]
+            if pg.past_intervals else pg.interval_start
+        )
+        if (
+            not pg.intervals_rebuilt
+            and known
+            and min(known) < hist_floor
+        ):
+            self._rebuild_intervals_from_maps(
+                pg, start=min(known), until=hist_floor
+            )
+        # choose_acting beyond the acting set (reference: build_prior +
+        # choose_acting over PastIntervals): members of past rw
+        # intervals may hold a log NEWER than anything the current
+        # acting set has — query them too, bounded by the history
+        strays: dict[tuple[int, int], int] = {}
+        queried = {self.id} | {osd for (_s, osd) in peers}
+        prior = pg.past_intervals.query_candidates(
+            exclude={-1, self.id} | {o for o in acting if o >= 0},
+            is_up=self.osdmap.is_up,
+        )
+        for osd, p_shard in prior.items():
+            tid = self._next_tid()
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MPGQuery(tid=tid, pgid=pg.pgid,
+                             shard=p_shard if is_ec else 0,
+                             epoch=self.my_epoch())
+                )
+            except (OSError, ConnectionError):
+                continue
+            rep = self._wait_reply(tid, timeout=5.0)
+            if rep is None or rep.version is None:
+                continue
+            queried.add(osd)
+            strays[(p_shard, osd)] = rep.version
+        # build_prior activation block: a past rw interval NONE of whose
+        # members answered may hold the authoritative log — activating
+        # anyway could serve a stale/forked history (the exact failure
+        # generation floors cannot see).  Stay inactive and retry.
+        blocked = pg.past_intervals.blocked_by(queried)
+        if blocked:
+            iv = blocked[0]
+            self.cct.dout(
+                "osd", 1,
+                f"{self.whoami} {pg.pgid} peering blocked: interval "
+                f"[{iv['first']},{iv['last']}] acting {iv['acting']} "
+                f"went rw and no member is reachable",
+            )
+            return
+        # phase 0 — adopt the authoritative log (reference: peering's
+        # choose_acting/authoritative-log step): a primary revived after
+        # missing writes must catch ITSELF up first, else it would mint
+        # duplicate versions on the next write and wrongly judge
+        # ahead-peers clean (wait_clean compares against the primary).
+        # Runs WITHOUT pg.lock: the donor's catch-up arrives as
+        # MECSubOpWrites our dispatch thread applies under that lock.
+        ahead = {k: v for k, (v, _o) in peers.items() if v > pg.version}
+        stray_newest = max(strays.values(), default=0)
+        if stray_newest > max([pg.version, *ahead.values()]):
+            if is_ec:
+                # an EC stray proves newer writes exist, but a non-acting
+                # donor cannot push shard-correct chunks (the donor path
+                # reads by its acting index) — stay INACTIVE rather than
+                # activate on a log we know is stale; the PG heals when
+                # the stray rejoins acting or an acting member catches up
+                self.cct.dout(
+                    "osd", 1,
+                    f"{self.whoami} {pg.pgid} stale vs stray holders "
+                    f"(v{stray_newest} > v{pg.version}); deferring "
+                    f"activation",
+                )
+                return
+            # replicated: the past-interval holder IS the authoritative
+            # log donor even though it is not acting (choose_acting
+            # electing a stray; every replica is shard 0, so the pull
+            # path needs no shard translation)
+            ahead = {
+                k: v for k, v in strays.items() if v == stray_newest
+            }
+        if ahead:
+            (_b_shard, b_osd), _bv = max(ahead.items(), key=lambda kv: kv[1])
+            my_shard = acting.index(self.id) if is_ec else 0
+            try:
+                my_oids = [
+                    o for o in self.store.list_objects(
+                        self._cid(pg.pgid, my_shard))
+                    if not o.startswith("_")
+                ]
+            except (NotFound, KeyError):
+                my_oids = []
+            tid = self._next_tid()
+            try:
+                self._conn_to_osd(b_osd).send_message(MPGPull(
+                    tid=tid, pgid=pg.pgid, shard=my_shard,
+                    from_version=pg.version, epoch=self.my_epoch(),
+                    have_oids=my_oids,
+                ))
+                rep = self._wait_reply(tid, timeout=30.0)
+            except (OSError, ConnectionError):
+                rep = None
+            if rep is not None and rep.retval == 0:
+                self.cct.dout(
+                    "osd", 1,
+                    f"{self.whoami} pulled {pg.pgid} forward to "
+                    f"v{pg.version} from osd.{b_osd}",
+                )
+            else:
+                return  # retry next tick; judging peers now would be wrong
+        # peered: no peer is ahead (or we just adopted the ahead log) —
+        # this primary may now serve ops for the current interval
+        pg.activated_interval = interval_at_entry
+        if pg.version == 0:
+            return  # nothing written yet
+        my_shard = acting.index(self.id) if is_ec else 0
+        my_cid = self._cid(pg.pgid, my_shard)
+
+        def _my_oids() -> set:
+            try:
+                return {
+                    o for o in self.store.list_objects(my_cid)
+                    if not o.startswith("_")
+                }
+            except (NotFound, KeyError):
+                return set()
+
+        my_oids = _my_oids()
+        # phase 0.5 — SELF role-heal: an acting permutation can hand this
+        # primary a shard role it never held; every peer below is judged
+        # against MY collection, so an empty one would read as
+        # everything-clean while the primary serves nothing.  Pull full
+        # content from an up-to-date peer — the donor's backfill push
+        # carries data + xattrs + omap and deletes my stale extras
+        # (reference: the primary recovers itself first in
+        # PeeringState::activate / recovery_state).
+        peer_union: set = set()
+        for (_v, oids) in peers.values():
+            peer_union.update(oids)
+        if peer_union - my_oids:
+            donor = next(
+                (osd for (shard, osd), (v, _o) in peers.items()
+                 if v >= pg.version),
+                None,
+            )
+            if donor is not None:
+                self.cct.dout(
+                    "osd", 1,
+                    f"{self.whoami} self role-heal {pg.pgid} shard "
+                    f"{my_shard}: {len(peer_union - my_oids)} objects "
+                    f"from osd.{donor}",
+                )
+                tid = self._next_tid()
+                try:
+                    self._conn_to_osd(donor).send_message(MPGPull(
+                        tid=tid, pgid=pg.pgid, shard=my_shard,
+                        from_version=0, epoch=self.my_epoch(),
+                        have_oids=sorted(my_oids),
+                    ))
+                    self._wait_reply(tid, timeout=30.0)
+                except (OSError, ConnectionError):
+                    pass
+                my_oids = _my_oids()
+        # push phase: serialize vs concurrent client writes on this PG
+        all_clean = True
+        with pg.lock:
+            for (shard, osd), (peer_ver, peer_oids) in peers.items():
+                role_missing = my_oids - set(peer_oids)
+                if peer_ver >= pg.version and not role_missing:
+                    continue  # clean
+                all_clean = False
+                if peer_ver >= pg.version:
+                    # version-current but the SHARD ROLE's objects are
+                    # absent: an acting-set permutation (OSD out -> CRUSH
+                    # reshuffle) handed this OSD a shard it never held —
+                    # the per-PG version cannot see that, only the
+                    # contents comparison can.  Rebuild its new role's
+                    # chunks (and retire any stale leftovers in that
+                    # collection from an older interval).
+                    self.cct.dout(
+                        "osd", 1,
+                        f"{self.whoami} role-backfill {pg.pgid} shard "
+                        f"{shard} osd.{osd}: {len(role_missing)} objects",
+                    )
+                    self._push_objects(
+                        pg, codec, acting, shard if is_ec else 0, osd,
+                        {o: None for o in sorted(role_missing)},
+                        set(peer_oids) - my_oids, is_ec,
+                    )
+                else:
+                    self._push_missing(
+                        pg, codec, acting, shard if is_ec else 0, osd,
+                        peer_ver, is_ec, peer_oids,
+                    )
+        # prune the interval history once the PG is CLEAN in the current
+        # interval (reference: last_epoch_clean).  "Clean" demands a
+        # FULL acting set in which every member answered and needed no
+        # push — a degraded PG keeps its history: those unheard members
+        # are exactly what the history exists to track (review r4).
+        # The clean point is BROADCAST to the acting replicas (MPGClean)
+        # so their persisted rebuild floors advance too — otherwise a
+        # later primary rebuilding from a replica's stale last-write
+        # epoch would resurrect already-settled intervals whose members
+        # are long gone and block activation forever (review r4).
+        acting_members = {o for o in acting if o >= 0 and o != self.id}
+        if (
+            all_clean
+            and all(o >= 0 for o in acting)
+            and acting_members <= {osd for (_s, osd) in peers}
+            and (pg.past_intervals
+                 or pg.clean_broadcast_interval != interval_at_entry)
+        ):
+            epoch = self.my_epoch()
+            pg.past_intervals.clear()
+            pg.last_map_epoch = max(pg.last_map_epoch, epoch)
+            pg.intervals_rebuilt = False
+            pg.clean_broadcast_interval = interval_at_entry
+            self._save_intervals(pg)
+            for shard, osd in enumerate(acting):
+                if osd < 0 or osd == self.id or not self.osdmap.is_up(osd):
+                    continue
+                try:
+                    self._conn_to_osd(osd).send_message(MPGClean(
+                        pgid=pg.pgid, shard=shard if is_ec else 0,
+                        epoch=epoch,
+                    ))
+                except (OSError, ConnectionError):
+                    pass  # replica re-learns at its next clean pass
+
+    def _push_missing(self, pg, codec, acting, dest_shard, dest_osd,
+                      from_version, is_ec, dest_oids) -> bool:
+        """Classify delta vs backfill, push, seal — shared by the primary
+        push loop and the pull donor.  Counters are started/completed
+        pairs: stat_delta_recoveries / stat_backfills count rounds
+        STARTED (race-free for observers — an ack lost after the peer
+        applied would leave a completed-only counter at zero), the
+        *_completed twins count fully acked rounds."""
+        my_shard = acting.index(self.id) if is_ec else 0
+        if pg.log.covers(from_version):
+            self.cct.dout(
+                "osd", 1,
+                f"{self.whoami} delta-recovery {pg.pgid} "
+                f"shard {dest_shard} osd.{dest_osd} from v{from_version}",
+            )
+            pg.stat_delta_recoveries = getattr(
+                pg, "stat_delta_recoveries", 0) + 1
+            ok = self._push_log_delta(
+                pg, codec, acting, dest_shard, dest_osd, from_version, is_ec
+            )
+            if ok:
+                self._bump_peer_version(pg, dest_shard, dest_osd, pg.version)
+                pg.stat_delta_completed = getattr(
+                    pg, "stat_delta_completed", 0) + 1
+            return ok
+        # log too old: full backfill of this shard.  Versions are
+        # unknowable per object (trimmed), so chunks are pushed
+        # unversioned and the final sync entry seals the version.  The
+        # target's extra objects (deleted here after its log horizon)
+        # get data-less deletes — a survivors-only push would resurrect
+        # deletions when the target is later trusted.
+        try:
+            oids = [
+                o for o in self.store.list_objects(
+                    self._cid(pg.pgid, my_shard))
+                if not o.startswith("_")
+            ]
+        except (NotFound, KeyError):
+            oids = []
+        deleted = set(dest_oids or []) - set(oids)
+        self.cct.dout(
+            "osd", 1,
+            f"{self.whoami} backfill {pg.pgid} shard {dest_shard} "
+            f"osd.{dest_osd}: {len(oids)} objects, "
+            f"{len(deleted)} deletions",
+        )
+        pg.stat_backfills = getattr(pg, "stat_backfills", 0) + 1
+        ok = self._push_objects(
+            pg, codec, acting, dest_shard, dest_osd,
+            {o: None for o in oids}, deleted, is_ec,
+        )
+        if ok:
+            self._bump_peer_version(pg, dest_shard, dest_osd, pg.version)
+            pg.stat_backfill_completed = getattr(
+                pg, "stat_backfill_completed", 0) + 1
+        return ok
+
+    def _handle_pg_pull(self, conn, msg: MPGPull) -> None:
+        """An ahead peer serving a stale primary's catch-up request: push
+        my log delta (or full objects + deletions when my log was
+        trimmed) to the requester, then seal its version (the
+        authoritative-log donor role in peering).  Runs under MY pg.lock
+        so a concurrent write cannot advance the version mid-push and
+        let the seal vouch for entries never sent; the requester holds
+        no lock while waiting, so there is no cross-OSD lock cycle."""
+        retval = -5
+        try:
+            pool_id, ps = msg.pgid.split(".")
+            pg = self._pg(int(pool_id), int(ps))
+            pool = self.osdmap.pools.get(int(pool_id))
+            requester = (
+                int(msg.src.split(".", 1)[1])
+                if msg.src.startswith("osd.") else None
+            )
+            if pool is None or requester is None:
+                raise ValueError(f"bad pull {msg.src} {msg.pgid}")
+            acting, _p = self._acting(int(pool_id), int(ps))
+            is_ec = pool.type == PG_POOL_ERASURE
+            codec = self._codec_for_pool(pool) if is_ec else None
+            from_v = int(msg.from_version or 0)
+            with pg.lock:
+                if pg.version <= from_v:
+                    retval = 0  # nothing newer here
+                else:
+                    ok = self._push_missing(
+                        pg, codec, acting, msg.shard, requester, from_v,
+                        is_ec, msg.have_oids,
+                    )
+                    retval = 0 if ok else -5
+        except Exception as e:
+            self.cct.dout(
+                "osd", 0, f"{self.whoami} pg pull failed: {e!r}"
+            )
+        try:
+            conn.send_message(MPGPullReply(
+                tid=msg.tid, pgid=msg.pgid, shard=msg.shard, retval=retval
+            ))
+        except (OSError, ConnectionError):
+            pass
+
+    def _push_sub_write(self, pg, osd, shard, oid, data, version, entry,
+                        src_cid: str | None = None,
+                        osize: int | None = None) -> bool:
+        """One recovery push; True iff the peer acked it (retval 0).
+        Data pushes copy the object's user xattrs from `src_cid` (the
+        primary's own shard collection) so a recovered shard can answer
+        getxattrs after a primary move.  They also carry the primary's
+        stored chunk-generation stamp (`over`): the pushed bytes are
+        rebuilt-CURRENT, and stamping the log-entry version instead
+        would diverge from undisturbed shards whenever the log advanced
+        through xattr-only modifies (which don't change stripe bytes)."""
+        xattrs = None
+        gen = None
+        omap = None
+        if data is not None and src_cid is not None:
+            gen = self._stored_ver(src_cid, oid)
+            try:
+                mine = self.store.getattrs(src_cid, oid)
+            except (NotFound, KeyError):
+                mine = {}
+            # always a dict (may be empty): the receiver treats it as the
+            # FULL snapshot, clearing stale attrs a removal left behind
+            xattrs = {
+                n[2:]: pack_data(v)
+                for n, v in mine.items() if n.startswith("u_")
+            }
+            try:
+                kv = self.store.omap_get(src_cid, oid)
+            except (NotFound, KeyError):
+                kv = {}
+            # omap recovered as a full snapshot, like the xattrs — sent
+            # even when empty so a replica's stale keys are cleared
+            omap = {"snapshot": {k: pack_data(v) for k, v in kv.items()}}
+        tid = self._next_tid()
+        try:
+            self._conn_to_osd(osd).send_message(
+                MECSubOpWrite(
+                    tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
+                    data=pack_data(data) if data is not None else None,
+                    crc=crc32c(data) if data is not None else None,
+                    version=version, entry=entry, epoch=self.my_epoch(),
+                    xattrs=xattrs, over=gen, osize=osize, omap=omap,
+                )
+            )
+        except (OSError, ConnectionError):
+            return False
+        rep = self._wait_reply(tid, timeout=5.0)
+        return rep is not None and rep.retval == 0
+
+    def _push_log_delta(self, pg, codec, acting, shard, osd,
+                        peer_version: int, is_ec: bool) -> bool:
+        """Delta recovery: replay the FULL entry stream since the peer's
+        version, in order, so the peer's pg_log stays contiguous and its
+        covers() answer stays honest if it later becomes primary
+        (reference: PGLog merge + pg_missing_t-driven recover_object).
+
+        Data rides only the newest modify of each object; earlier modifies
+        and deletes replay as log-only / delete pushes.  Returns True only
+        if every push acked, so the caller never marks the peer clean past
+        data it does not hold."""
+        newest, _deleted = pg.log.missing_since(peer_version)
+        my_cid = self._cid(
+            pg.pgid, acting.index(self.id) if is_ec else 0
+        )
+        for e in pg.log.entries_since(peer_version):
+            if e.op == "delete":
+                ok = self._push_sub_write(
+                    pg, osd, shard, e.oid, None, e.version, e.to_list()
+                )
+            elif e.op in ("modify", "attr") and newest.get(e.oid) == e.version:
+                chunk, size = self._rebuild_shard_chunk(
+                    pg, codec, acting, e.oid, shard, is_ec
+                )
+                if chunk is None:
+                    # UNFOUND right now (reference: missing_loc unfound
+                    # set): park THIS object but keep recovering the
+                    # rest — one unrecoverable object must not wedge
+                    # the whole peer's recovery.  The entry still
+                    # replays (log stays contiguous); the object stays
+                    # missing on the peer exactly as it is everywhere
+                    # else, and a later tick retries when a source
+                    # resurfaces.
+                    self.cct.dout(
+                        "osd", 1,
+                        f"{self.whoami} recovery: {pg.pgid}/{e.oid} "
+                        f"unfound, parking",
+                    )
+                    ok = self._push_sub_write(
+                        pg, osd, shard, e.oid, None, e.version,
+                        e.to_list(),
+                    )
+                    if not ok:
+                        return False
+                    continue
+                ok = self._push_sub_write(
+                    pg, osd, shard, e.oid, chunk, e.version,
+                    e.to_list(), src_cid=my_cid, osize=size,
+                )
+                self.logger.inc("recovery_ops")
+            else:
+                # superseded modify / clean marker: log-entry-only replay
+                ok = self._push_sub_write(
+                    pg, osd, shard, e.oid, None, e.version, e.to_list()
+                )
+            if not ok:
+                return False
+        return True
+
+    def _push_objects(self, pg, codec, acting, shard, osd,
+                      newest: dict[str, int | None], deleted: set[str],
+                      is_ec: bool) -> bool:
+        """Backfill push: chunk data for every object, unversioned (the
+        trimmed log cannot vouch for per-object versions); the final
+        "clean" seal establishes the peer's version and empty log window.
+        The push still carries the object size (osize) so the peer can
+        answer stat/padding-strip."""
+        for oid in sorted(deleted):
+            if not self._push_sub_write(pg, osd, shard, oid, None, None, None):
+                return False
+        my_cid = self._cid(
+            pg.pgid, acting.index(self.id) if is_ec else 0
+        )
+        all_ok = True
+        for oid in sorted(newest, key=lambda o: (newest[o] or 0, o)):
+            chunk, size = self._rebuild_shard_chunk(
+                pg, codec, acting, oid, shard, is_ec
+            )
+            if chunk is None:
+                # unfound: park this object, recover the rest (see
+                # _push_log_delta); all_ok=False keeps the peer unsealed
+                # so later ticks retry
+                all_ok = False
+                continue
+            version = newest[oid]
+            entry = [version or 0, "modify", oid]
+            if not self._push_sub_write(
+                pg, osd, shard, oid, chunk, version, entry, src_cid=my_cid,
+                osize=size,
+            ):
+                all_ok = False
+        return all_ok
+
+    def _bump_peer_version(self, pg, shard, osd, version: int) -> None:
+        """Final version/log sync after successful pushes: a data-less
+        "clean" entry (ignored by missing_since) seals the peer at the
+        primary's version."""
+        tid = self._next_tid()
+        try:
+            self._conn_to_osd(osd).send_message(
+                MECSubOpWrite(
+                    tid=tid, pgid=pg.pgid, oid="", shard=shard,
+                    data=None, crc=None, version=version,
+                    entry=[version, "clean", ""],
+                    epoch=self.my_epoch(),
+                )
+            )
+            self._wait_reply(tid, timeout=5.0)
+        except (OSError, ConnectionError):
+            pass
+
+    def _rebuild_shard_chunk(
+        self, pg, codec, acting, oid: str, shard: int, is_ec: bool,
+        exclude: set[int] | None = None,
+    ) -> tuple[bytes | None, int]:
+        """Recompute shard `shard`'s bytes for oid (reference:
+        ECBackend::recover_object — read k chunks, re-encode).  `exclude`
+        names additional shards whose data must not feed the rebuild
+        (scrub-flagged rot)."""
+        my_shard = acting.index(self.id)
+        if not is_ec:
+            try:
+                data = self.store.read(self._cid(pg.pgid, 0), oid)
+                return data, len(data)
+            except (NotFound, KeyError):
+                return None, 0
+        k = codec.get_data_chunk_count()
+        n = codec.get_chunk_count()
+        # include the DEST shard in the gather: the receiver lacks its
+        # chunk, but the exact chunk may survive as a stray on a previous
+        # holder (acting permutations) — using it directly also rescues
+        # objects written degraded at exactly min_size, where fewer than
+        # k OTHER chunks exist and decode alone could never recover
+        want = set(range(n)) - (exclude or set())
+        sizes: dict[int, int] = {}
+        vers: dict[int, int | None] = {}
+        floor = pg.log.obj_newest.get(oid)
+        got = self._gather_chunks(pg, codec, acting, oid, want, sizes=sizes,
+                                  vers=vers, stray=True, floor=floor)
+        # never rebuild from a MIX of stripe generations, nor from one
+        # the log proves is below the newest write
+        got = _current_generation(got, vers, floor)
+        if shard in got:
+            try:
+                size = int(self.store.getattr(
+                    self._cid(pg.pgid, acting.index(self.id)), oid, "size"))
+            except (NotFound, KeyError, ValueError):
+                size = sizes.get(shard, next(iter(sizes.values()), 0))
+            return bytes(got[shard]), size
+        if len(got) < k:
+            return None, 0
+        try:
+            size = int(self.store.getattr(
+                self._cid(pg.pgid, my_shard), oid, "size"))
+        except (NotFound, KeyError, ValueError):
+            # our own xattr is gone (we may be the shard being repaired):
+            # any healthy peer's size xattr is authoritative
+            size = next(iter(sizes.values()), 0)
+        chunks = {s: np.frombuffer(b, np.uint8) for s, b in got.items()}
+        dec = codec.decode(
+            {shard}, chunks, len(next(iter(chunks.values())))
+        )
+        return np.asarray(dec[shard], np.uint8).tobytes(), size

@@ -1,0 +1,279 @@
+"""PG split migration on pg_num increase (reference: PG::split_into + the upmap-era split machinery).
+
+Split out of osd/daemon.py (round-4 verdict item #6) — the methods
+are verbatim; `OSD` composes every mixin, so cross-mixin calls (e.g.
+the tier front-end invoking the replicated backend) resolve on self.
+"""
+from __future__ import annotations
+
+
+
+
+from ..store.object_store import NotFound
+from .messages import (
+    MOSDOp,
+)
+from ..osd.osdmap import object_ps
+from .messages import MOSDPingMsg
+from .pg import CLONE_SEP
+
+
+class SplitMigrationMixin:
+    # -- PG split migration (pg_num increase) ------------------------------
+    def _split_pass_work(self) -> None:
+        try:
+            self._split_pass()
+            self._snaptrim_pass()
+            self._tier_agent_pass()
+        finally:
+            self._split_inflight = False
+
+    def _split_pass(self) -> None:
+        """Migrate objects stranded in pre-split PGs (reference: PG split —
+        OSD::split_pgs + backfill; here the old-PG primary rewrites each
+        misplaced object through the normal client-op path to its
+        post-split PG, then deletes the old copy).
+
+        Eventually consistent: the pass re-runs every tick until each
+        primary PG has been scanned clean under the current pg_num, so an
+        OSD that was down during the split finishes the job when it
+        returns.  Window semantics: until an object is migrated, clients
+        on the new map read -ENOENT from the post-split PG (the reference
+        covers this window with pg history + peering; SURVEY's data plane
+        accepts the brief window)."""
+        m = self.osdmap
+        if m is None:
+            return
+        for pgid, pg in list(self.pgs.items()):
+            if self._stop.is_set():
+                return
+            pool = m.pools.get(pg.pool_id)
+            if pool is None or pg.split_scanned >= pool.pg_num:
+                continue
+            _acting, primary = self._acting(pg.pool_id, pg.ps)
+            if primary != self.id:
+                continue  # re-checked next pass (primary may change)
+            try:
+                self._split_migrate_pg(pg, pool)
+                pg.split_scanned = pool.pg_num
+            except Exception as e:
+                self.cct.dout(
+                    "osd", 1, f"{self.whoami} split pass {pgid}: {e!r}"
+                )
+
+    def _split_migrate_pg(self, pg, pool) -> None:
+        # raw store listing: snapshot clones are hidden from the client
+        # `list` op but must migrate with their head
+        acting, _p = self._acting(pg.pool_id, pg.ps)
+        if self.id not in acting:
+            return
+        try:
+            names = self.store.list_objects(
+                self._primary_cid(pg, pool, acting)
+            )
+        except (NotFound, KeyError):
+            return
+        for oid in sorted(names):
+            if oid.startswith("_"):
+                continue
+            head = oid.split(CLONE_SEP, 1)[0]
+            new_ps = object_ps(head, pool.pg_num)
+            if new_ps != pg.ps:
+                self._migrate_object(pg, pool, oid, new_ps)
+
+    def _forward_op(self, target: int, msg: MOSDOp):
+        """Execute an op locally when this OSD is the target primary, else
+        ship it and wait (the OSD acting as its own Objecter)."""
+        if target == self.id:
+            return self._execute_client_op(msg)
+        conn = self._conn_to_osd(target)
+        conn.send_message(msg)
+        return self._wait_reply(msg.tid, timeout=15.0)
+
+    def _migrate_object(self, pg, pool, oid: str, new_ps: int) -> None:
+        """write-to-new-PG before delete-from-old: a crash mid-migration
+        leaves a duplicate (invisible: lookups hash to the new PG), never
+        a loss.
+
+        Lost-update guard: a client on the new map may have ALREADY
+        written the object into its post-split PG; the stale pre-split
+        copy must not clobber it, so the destination is stat'd first and
+        a hit just drops the old copy.  (A write landing between the stat
+        and our write is the residual window; the reference closes it
+        with peering's authoritative log — out of scope here and noted.)
+        """
+        e = self.my_epoch()
+        _a, new_primary = self._acting(pg.pool_id, new_ps)
+        # every dest op carries the explicit post-split ps: snapshot-clone
+        # names would hash elsewhere (placement follows their HEAD object)
+        st = self._forward_op(new_primary, MOSDOp(
+            tid=self._next_tid(), pool=pg.pool_id, oid=oid, op="stat",
+            epoch=e, ps=new_ps,
+        ))
+        if st is not None and st.retval == 0:
+            # newer post-split copy exists: just retire the stale one
+            d = self._execute_client_op(MOSDOp(
+                tid=self._next_tid(), pool=pg.pool_id, oid=oid,
+                op="delete", epoch=e, ps=pg.ps,
+            ))
+            if d.retval != 0:
+                raise RuntimeError(f"split retire {oid}: {d.result}")
+            return
+        r = self._execute_client_op(MOSDOp(
+            tid=self._next_tid(), pool=pg.pool_id, oid=oid, op="read",
+            epoch=e, ps=pg.ps, off=0, length=0,
+        ))
+        if r.retval != 0:
+            raise RuntimeError(f"split read {oid}: {r.result}")
+        xr = self._execute_client_op(MOSDOp(
+            tid=self._next_tid(), pool=pg.pool_id, oid=oid,
+            op="getxattrs", epoch=e, ps=pg.ps,
+        ))
+        xattrs = xr.result if xr.retval == 0 else None
+        w = self._forward_op(new_primary, MOSDOp(
+            tid=self._next_tid(), pool=pg.pool_id, oid=oid,
+            op="write_full", data=r.data, epoch=e, ps=new_ps,
+        ))
+        if w is None or w.retval != 0:
+            raise RuntimeError(
+                f"split write {oid}: {w.result if w else 'timeout'}"
+            )
+        if xattrs:
+            xw = self._forward_op(new_primary, MOSDOp(
+                tid=self._next_tid(), pool=pg.pool_id, oid=oid,
+                op="setxattr", data=xattrs, epoch=e, ps=new_ps,
+            ))
+            if xw is None or xw.retval != 0:
+                raise RuntimeError(
+                    f"split xattrs {oid}: {xw.result if xw else 'timeout'}"
+                )
+        d = self._execute_client_op(MOSDOp(
+            tid=self._next_tid(), pool=pg.pool_id, oid=oid, op="delete",
+            epoch=e, ps=pg.ps,
+        ))
+        if d.retval != 0:
+            raise RuntimeError(f"split delete {oid}: {d.result}")
+        self.cct.dout(
+            "osd", 10,
+            f"{self.whoami} split: migrated {oid} "
+            f"{pg.pool_id}.{pg.ps} -> {pg.pool_id}.{new_ps}",
+        )
+
+    def _maybe_schedule_scrub(self, now: float) -> None:
+        """Periodic deep scrub of primary PGs (reference: OSD::sched_scrub;
+        osd_deep_scrub_interval 0 disables — tests drive scrub_pg
+        directly)."""
+        interval = self.cct.conf.get("osd_deep_scrub_interval")
+        if not interval or now - self._last_scrub < interval:
+            return
+        self._last_scrub = now
+        m = self.osdmap
+        if m is None:
+            return
+        for pool_id, pool in m.pools.items():
+            for ps in range(pool.pg_num):
+                try:
+                    _acting, primary = self._acting(pool_id, ps)
+                except KeyError:
+                    continue
+                if primary != self.id:
+                    continue
+                pgid = f"{pool_id}.{ps}"
+                if pgid in self._scrubs_queued:
+                    continue  # scrubs outlasting the interval must not pile
+                self._scrubs_queued.add(pgid)
+
+                def scrub_work(pid=pool_id, s=ps, key=pgid):
+                    try:
+                        self.scrub_pg(pid, s)
+                    finally:
+                        self._scrubs_queued.discard(key)
+
+                self.scheduler.enqueue("background_scrub", scrub_work)
+
+    def _mgr_report(self) -> None:
+        """Stream a perf snapshot to the mgr (reference: MgrClient sending
+        MMgrReport on its tick)."""
+        addr = self.cct.conf.get("mgr_addr")
+        if not addr:
+            return
+        from ..mgr.messages import MMgrReport
+
+        host, _, port = addr.rpartition(":")
+        with self._pgs_lock:
+            num_pgs = len(self.pgs)
+        # the store scan runs UNLOCKED: heartbeats/recovery/map-apply all
+        # contend on _pgs_lock, and an O(objects) walk per report tick
+        # must not delay them toward the failure-report threshold
+        num_objects = 0
+        pool_bytes: dict[int, int] = {}
+        try:
+            coll_bytes = self.store.collections_bytes()  # one index pass
+        except Exception:
+            coll_bytes = {}
+        for cid in self.store.list_collections():
+            pool_id = None
+            if "." in cid:
+                try:
+                    pool_id = int(cid.split(".", 1)[0])
+                except ValueError:
+                    pool_id = None
+            try:
+                num_objects += sum(
+                    1 for o in self.store.list_objects(cid)
+                    if not o.startswith("_")
+                )
+            except Exception:
+                continue
+            if pool_id is not None:
+                pool_bytes[pool_id] = (
+                    pool_bytes.get(pool_id, 0) + coll_bytes.get(cid, 0)
+                )
+        self.logger.set("numpg", num_pgs)
+        try:
+            self.messenger.connect((host, int(port))).send_message(
+                MMgrReport(
+                    daemon=self.whoami,
+                    counters=self.cct.perf.dump(),
+                    epoch=self.my_epoch(),
+                    stats={"num_pgs": num_pgs, "num_objects": num_objects,
+                           "pool_bytes": {
+                               str(k): v for k, v in pool_bytes.items()
+                           }},
+                )
+            )
+        except (OSError, ConnectionError, ValueError):
+            pass  # mgr down: retry next interval
+
+    def _heartbeat(self) -> None:
+        """Ping peers sharing PGs with us (reference: OSD::heartbeat);
+        after 3 silent intervals report the peer to the mon (§5.3)."""
+        m = self.osdmap
+        if m is None:
+            return
+        peers: set[int] = set()
+        with self._pgs_lock:
+            pgs = list(self.pgs.values())
+        for pg in pgs:
+            try:
+                acting, _ = self._acting(pg.pool_id, pg.ps)
+            except KeyError:
+                continue
+            peers |= {o for o in acting if o >= 0 and o != self.id}
+        for osd in peers:
+            if not m.is_up(osd):
+                continue
+            prev = self._hb_failures.get(osd, 0)
+            try:
+                self._conn_to_osd(osd).send_message(
+                    MOSDPingMsg(op="ping", osd=self.id, epoch=self.my_epoch())
+                )
+                self._hb_failures[osd] = prev + 1
+            except (OSError, ConnectionError):
+                self._hb_failures[osd] = prev + 1
+            if self._hb_failures.get(osd, 0) >= 3:
+                self.mc.report_failure(osd, failed_for=6.0)
+                # restart the count: re-report only after another 3 silent
+                # intervals, not on every subsequent tick
+                self._hb_failures.pop(osd, None)
+

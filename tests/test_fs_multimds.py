@@ -1,0 +1,137 @@
+"""Multi-active MDS (round-4 verdict item #8; reference: src/mds/MDSRank
+multi-active, subtree export pinning, and rank-failure journal replay).
+
+Two active ranks with root-level subtree assignment; clients follow MDS
+redirects; a failed rank's beacon goes stale and the lowest surviving
+rank absorbs its subtrees by replaying its journal — namespace intact.
+"""
+import time
+
+import pytest
+
+from ceph_tpu.qa.vstart import LocalCluster
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with LocalCluster(n_mons=1, n_osds=3, with_mds=True) as c:
+        c.start_mds_rank(1)
+        yield c
+
+
+def _wait(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.2)
+    return pred()
+
+
+def test_subtree_assignment_routes_to_rank1(cluster):
+    fs = cluster.fs_client("client.mm-a")
+    try:
+        fs.mkdir("/pinned")
+        fs.mkdir("/home")
+        with fs.open("/home/r0-file", create=True) as f:
+            f.write(b"rank zero data")
+        fs.set_subtree("/pinned", 1)
+        # ops inside /pinned now redirect to rank 1; the client learns
+        # the route and the op lands in rank 1's journal
+        with fs.open("/pinned/r1-file", create=True) as f:
+            f.write(b"rank one data")
+        fs.mkdir("/pinned/sub")
+        with fs.open("/pinned/sub/deep", create=True) as f:
+            f.write(b"deep data")
+        r1 = cluster.mds_ranks[1]
+        assert r1._seg_idx > 0 or r1._seg_seq > 0, \
+            "rank 1 journaled nothing — ops were not routed to it"
+        # reads work from both subtrees through one client
+        assert fs.read_file("/pinned/r1-file") == b"rank one data"
+        assert fs.read_file("/home/r0-file") == b"rank zero data"
+        assert sorted(fs.listdir("/pinned")) == ["r1-file", "sub"]
+        # inos minted by the two ranks come from disjoint ranges
+        st0 = fs.stat("/home/r0-file")
+        st1 = fs.stat("/pinned/r1-file")
+        assert (st1["ino"] >> 40) != (st0["ino"] >> 40)
+    finally:
+        fs.unmount()
+
+
+def test_cross_subtree_rename_refused(cluster):
+    fs = cluster.fs_client("client.mm-x")
+    try:
+        with fs.open("/pinned/movable", create=True) as f:
+            f.write(b"x")
+        with pytest.raises(OSError, match="-18|cross-subtree"):
+            fs.rename("/pinned/movable", "/home/moved")
+        # same-subtree rename still works
+        fs.rename("/pinned/movable", "/pinned/moved")
+        assert fs.read_file("/pinned/moved") == b"x"
+    finally:
+        fs.unmount()
+
+
+def test_rank0_failure_survivor_serves_everything():
+    """The harder direction (review r5): rank 0 dies; rank 1 must absorb
+    root + every unpinned subtree — including dirfrags rank 0 flushed
+    AFTER rank 1 booted (journal replay alone cannot cover those) — and
+    the client must find the survivor without a rank-0 redirect."""
+    with LocalCluster(n_mons=1, n_osds=3, with_mds=True) as c:
+        r1 = c.start_mds_rank(1)
+        fs = c.fs_client("client.mm-r0")
+        try:
+            fs.mkdir("/mine")
+            fs.set_subtree("/mine", 1)
+            # teach the client rank 1's address (via the redirect)
+            with fs.open("/mine/hint", create=True) as f:
+                f.write(b"routed")
+            # rank-0 state created AFTER rank 1 booted, then flushed by
+            # a forced segment roll (journal trimmed -> replay can't
+            # recover it; only the dirfrag reload can)
+            fs.mkdir("/home")
+            with fs.open("/home/flushed", create=True) as f:
+                f.write(b"flushed bytes")
+            with c.mds._lock:
+                c.mds._flush()
+            with fs.open("/home/journal-only", create=True) as f:
+                f.write(b"journal bytes")
+            c.fail_mds_rank(0)
+            assert _wait(
+                lambda: not r1._read_ranks().get(0), timeout=15.0
+            ), "rank 1 never absorbed rank 0"
+            assert fs.read_file("/home/flushed") == b"flushed bytes"
+            assert fs.read_file("/home/journal-only") == b"journal bytes"
+            assert fs.read_file("/mine/hint") == b"routed"
+            with fs.open("/home/after", create=True) as f:
+                f.write(b"survivor writes")
+            assert fs.read_file("/home/after") == b"survivor writes"
+        finally:
+            fs.unmount()
+
+
+def test_rank1_failure_takeover_namespace_intact(cluster):
+    fs = cluster.fs_client("client.mm-f")
+    try:
+        # unflushed rank-1 state: lives only in rank 1's journal when it
+        # crashes (hard_kill skips the flush)
+        with fs.open("/pinned/unflushed", create=True) as f:
+            f.write(b"survives the crash")
+        cluster.fail_mds_rank(1)
+        r0 = cluster.mds
+        assert _wait(lambda: r0._load_subtrees(force=True).get("pinned") == 0,
+                     timeout=15.0), "rank 0 never absorbed rank 1"
+        # full namespace intact through the survivor, including the
+        # journal-only file
+        assert fs.read_file("/pinned/unflushed") == b"survives the crash"
+        assert fs.read_file("/pinned/r1-file") == b"rank one data"
+        assert fs.read_file("/pinned/sub/deep") == b"deep data"
+        assert fs.read_file("/home/r0-file") == b"rank zero data"
+        # and the subtree is writable again (now at rank 0)
+        with fs.open("/pinned/after-takeover", create=True) as f:
+            f.write(b"new owner")
+        assert fs.read_file("/pinned/after-takeover") == b"new owner"
+    finally:
+        fs.unmount()

@@ -144,8 +144,9 @@ def test_two_gateways_lose_no_index_entries(cluster):
     def hammer(store, tag):
         try:
             for i in range(40):
-                assert store.put_object("shared", f"{tag}-{i:03d}",
-                                        f"{tag}{i}".encode())
+                etag, _vid = store.put_object(
+                    "shared", f"{tag}-{i:03d}", f"{tag}{i}".encode())
+                assert etag is not None
         except Exception as e:  # pragma: no cover - diagnostic
             errs.append(e)
 
@@ -161,8 +162,8 @@ def test_two_gateways_lose_no_index_entries(cluster):
     assert keys == sorted(f"gw{g}-{i:03d}" for g in (1, 2) for i in range(40))
     # interleaved deletes from both sides: every entry accounted for
     for i in range(0, 40, 2):
-        assert s2.delete_object("shared", f"gw1-{i:03d}")
-        assert s1.delete_object("shared", f"gw2-{i:03d}")
+        assert s2.delete_object("shared", f"gw1-{i:03d}")[0] == "deleted"
+        assert s1.delete_object("shared", f"gw2-{i:03d}")[0] == "deleted"
     listing, _ = s1._index_list("shared", maxn=1000)
     assert len(listing) == 40
     c1.shutdown()
@@ -190,17 +191,17 @@ def test_sealed_index_refuses_puts(cluster):
     # check and our index write: seal the index directly
     rv, _ = s.meta.exec("idx.race", "rgw", "bucket_seal", {})
     assert rv == 0
-    assert s.put_object("race", "ghost", b"x") is None  # refused + undone
+    assert s.put_object("race", "ghost", b"x")[0] is None  # refused + undone
     listing, _ = s._index_list("race", maxn=10)
     assert listing == []
     # non-empty bucket cannot be sealed
-    assert s.create_bucket("full") and s.put_object("full", "k", b"v")
+    assert s.create_bucket("full") and s.put_object("full", "k", b"v")[0]
     rv, out = s.meta.exec("idx.full", "rgw", "bucket_seal", {})
     assert rv == -39, (rv, out)
     # recreate after delete: seal cleared, puts work again
     assert s.delete_bucket("race") == 0
     assert s.create_bucket("race")
-    assert s.put_object("race", "alive", b"y")
+    assert s.put_object("race", "alive", b"y")[0]
     listing, _ = s._index_list("race", maxn=10)
     assert [k for k, _ in listing] == ["alive"]
     cl.shutdown()
