@@ -127,6 +127,7 @@ class CompiledCrushMap:
             if getattr(b, "node_weights", None):
                 max_nodes = max(max_nodes, len(b.node_weights))
         nodes = np.zeros((max(n_idx, 1), max_nodes), dtype=np.int64)
+        counts = np.zeros(max(n_idx, 1), dtype=np.int32)
         for bid, b in cmap.buckets.items():
             i = -1 - bid
             items[i, : b.size] = b.items
@@ -138,10 +139,15 @@ class CompiledCrushMap:
                 straws[i, : b.size] = b.straws
             if getattr(b, "node_weights", None):
                 nodes[i, : len(b.node_weights)] = b.node_weights
+                counts[i] = len(b.node_weights)
         self.algs = algs
         self.straws = straws
         self.node_weights = nodes
         self.max_nodes = max_nodes
+        #: true per-bucket tree node counts (len(node_weights); 0 = not
+        #: a tree bucket) — passed to the oracle verbatim so an ingested
+        #: bucket's structural count is authoritative (r4 verdict #5)
+        self.node_counts = counts
         #: True iff every bucket is straw2 — the jax/Pallas batch path
         #: covers exactly this; legacy maps route to the C oracle
         self.straw2_only = bool((algs[: max(n_idx, 1)] == 5).all()) if n_idx else True

@@ -30,6 +30,7 @@ def _crush_lib() -> ctypes.CDLL:
         ctypes.c_int, _u32p, ctypes.c_long, _u32p, ctypes.c_int,
         ctypes.c_void_p, ctypes.c_int,
         _i32p, _i64p, _i64p, ctypes.c_int,  # algs/straws/nodes/max_nodes
+        _i32p,  # num_nodes (true per-bucket counts, r4 verdict #5)
         _i32p,
     ]
     lib.cro_do_rule_batch.restype = ctypes.c_int
@@ -39,6 +40,7 @@ def _crush_lib() -> ctypes.CDLL:
         ctypes.c_int, _u32p, ctypes.c_long, _u32p, ctypes.c_int,
         ctypes.c_void_p, ctypes.c_int,
         _i32p, _i64p, _i64p, ctypes.c_int,  # algs/straws/nodes/max_nodes
+        _i32p,  # num_nodes (true per-bucket counts, r4 verdict #5)
         _i32p,
     ]
     lib.cro_do_rule_steps.restype = ctypes.c_int
@@ -87,6 +89,7 @@ def _marshal(cm: CompiledCrushMap, xs, weightvec,
         straws=np.ascontiguousarray(cm.straws, dtype=np.int64),
         nodes=np.ascontiguousarray(cm.node_weights, dtype=np.int64),
         max_nodes=int(cm.max_nodes),
+        num_nodes=np.ascontiguousarray(cm.node_counts, dtype=np.int32),
     )
     if choose_args is not None:
         cw = np.ascontiguousarray(
@@ -134,7 +137,7 @@ def do_rule_steps_oracle(
         cmap.tunables.choose_total_tries, a["xs"], len(a["xs"]), a["wv"],
         len(a["wv"]), a["cw_ptr"], a["positions"],
         a["algs"], a["straws"].reshape(-1), a["nodes"].reshape(-1),
-        a["max_nodes"], out.reshape(-1),
+        a["max_nodes"], a["num_nodes"], out.reshape(-1),
     )
     if rc != 0:
         raise ValueError(f"cro_do_rule_steps failed rc={rc}")
@@ -171,7 +174,7 @@ def do_rule_batch_oracle(
         p["tries"], recurse_tries, a["xs"], len(a["xs"]), a["wv"],
         len(a["wv"]), a["cw_ptr"], a["positions"],
         a["algs"], a["straws"].reshape(-1), a["nodes"].reshape(-1),
-        a["max_nodes"], out.reshape(-1),
+        a["max_nodes"], a["num_nodes"], out.reshape(-1),
     )
     if rc != 0:
         raise ValueError(f"cro_do_rule_batch failed rc={rc}")

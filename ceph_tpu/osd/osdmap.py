@@ -395,6 +395,21 @@ class OSDMap:
             "osd_weight": list(self.osd_weight),
             "osd_primary_affinity": list(self.osd_primary_affinity),
             "crush_text": self.crush.format_text(),
+            # legacy aux tables VERBATIM (advisor r3 / r4 verdict #5):
+            # the text format cannot carry straw scaling factors or tree
+            # node weights, and re-deriving them on every decode would
+            # silently replace tables an ingested map computed under a
+            # different straw_calc_version — changing placements across
+            # a mon restart.  Reference: crush wire encoding carries the
+            # bucket aux arrays; straw_calc_version only governs builds.
+            "crush_aux": {
+                str(bid): {
+                    "straws": list(b.straws),
+                    "node_weights": list(b.node_weights),
+                }
+                for bid, b in self.crush.map.buckets.items()
+                if b.straws or b.node_weights
+            },
             "pools": [vars(p) for p in self.pools.values()],
             "pg_upmap": [
                 {"pool": k[0], "ps": k[1], "osds": v}
@@ -424,6 +439,29 @@ class OSDMap:
     @classmethod
     def from_json(cls, d: dict) -> "OSDMap":
         m = cls(CrushWrapper.parse_text(d["crush_text"]), d["max_osd"])
+        # restore ingested aux tables verbatim over the parser's
+        # re-derived ones (see to_json): length-checked so a corrupt
+        # record falls back to the derived tables instead of crashing
+        # the mapper later
+        for bid_s, aux in (d.get("crush_aux") or {}).items():
+            try:
+                b = m.crush.map.buckets.get(int(bid_s))
+                if b is None or not isinstance(aux, dict):
+                    continue
+                straws = aux.get("straws") or []
+                if straws and len(straws) == len(b.items):
+                    b.straws = [int(s) for s in straws]
+                nodes = aux.get("node_weights") or []
+                # structural validity: a tree's node array length is a
+                # power of two covering 2*size leaves — anything else
+                # would start descent at an odd root and collapse every
+                # draw onto one item
+                n = len(nodes)
+                if (nodes and n >= 2 * len(b.items)
+                        and n & (n - 1) == 0):
+                    b.node_weights = [int(x) for x in nodes]
+            except (TypeError, ValueError, AttributeError):
+                continue  # corrupt record: keep the derived tables
         m.epoch = d.get("epoch", 1)
         m.osd_state = list(d["osd_state"])
         m.osd_weight = list(d["osd_weight"])
