@@ -323,3 +323,26 @@ def test_unversioned_bucket_behavior_unchanged(conn):
     assert st == 200 and _vid(hdrs) is None
     assert _req(conn, "DELETE", "/plain/x")[0] == 204
     assert _req(conn, "GET", "/plain/x")[0] == 404
+
+
+def test_listing_paginates_past_delete_markers(conn):
+    """review r5: delete markers are filtered BEFORE the max-keys window
+    fills — a page of markers must not truncate the listing early."""
+    _req(conn, "PUT", "/vpage")
+    _req(conn, "PUT", "/vpage?versioning", body=b"<Status>Enabled</Status>")
+    # keys a0..a4 become markers; b0..b2 stay live
+    for i in range(5):
+        _req(conn, "PUT", f"/vpage/a{i}", body=b"x")
+        _req(conn, "DELETE", f"/vpage/a{i}")
+    for i in range(3):
+        _req(conn, "PUT", f"/vpage/b{i}", body=b"y")
+    st, _, body = _req(conn, "GET", "/vpage?max-keys=3")
+    assert st == 200
+    for i in range(3):
+        assert f"<Key>b{i}</Key>".encode() in body, body
+    assert b"<Key>a0</Key>" not in body
+    # Swift view agrees and the HEAD count matches the visible objects
+    st, _, sbody = _req(conn, "GET", "/swift/v1/vpage?limit=3")
+    assert st == 200 and sbody == b"b0\nb1\nb2\n"
+    st, hdrs, _ = _req(conn, "HEAD", "/swift/v1/vpage")
+    assert int(hdrs["X-Container-Object-Count"]) == 3

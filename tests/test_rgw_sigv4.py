@@ -299,3 +299,46 @@ class TestSignedGateway:
         assert st == 204 and hdrs.get("x-amz-delete-marker") == "true"
         st, _, _ = _signed(conn, "GET", "/vsig/doc", access=ak, secret=sk)
         assert st == 404
+
+    def test_swift_auth_enforced(self, cluster, conn):
+        """Swift front under enforced auth: the v1 handshake validates
+        the key against the same cephx-derived secrets as SigV4, and
+        /swift/v1 requires the issued token."""
+        import http.client
+
+        host, port = conn._gw
+        c = http.client.HTTPConnection(host, port, timeout=30)
+        try:
+            # no token: refused
+            c.request("GET", "/swift/v1")
+            r = c.getresponse(); r.read()
+            assert r.status == 401
+            # bad key: refused
+            c.request("GET", "/auth/v1.0", headers={
+                "X-Auth-User": "nope:swift", "X-Auth-Key": "bad"})
+            r = c.getresponse(); r.read()
+            assert r.status == 401
+            # good key: token issued and honored
+            rv, out = cluster.mon_command(
+                {"prefix": "auth get-s3-key", "entity": "client.swifty"})
+            assert rv == 0
+            ak, sk = out["access_key"], out["secret_key"]
+            c.request("GET", "/auth/v1.0", headers={
+                "X-Auth-User": f"{ak}:swift", "X-Auth-Key": sk})
+            r = c.getresponse(); r.read()
+            assert r.status == 200
+            token = r.getheader("X-Auth-Token")
+            c.request("PUT", "/swift/v1/swc",
+                      headers={"X-Auth-Token": token})
+            r = c.getresponse(); r.read()
+            assert r.status == 201
+            c.request("PUT", "/swift/v1/swc/obj", body=b"tokened",
+                      headers={"X-Auth-Token": token})
+            r = c.getresponse(); r.read()
+            assert r.status == 201
+            c.request("GET", "/swift/v1/swc/obj",
+                      headers={"X-Auth-Token": token})
+            r = c.getresponse()
+            assert r.status == 200 and r.read() == b"tokened"
+        finally:
+            c.close()

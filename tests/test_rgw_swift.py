@@ -1,0 +1,120 @@
+"""Swift API surface (reference: rgw_rest_swift.cc; round-4 verdict
+missing #4): the second protocol front over the same bucket layer."""
+import http.client
+import json
+
+import pytest
+
+from ceph_tpu.qa.vstart import LocalCluster
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with LocalCluster(n_mons=1, n_osds=3) as c:
+        c.start_rgw()
+        yield c
+
+
+@pytest.fixture()
+def conn(cluster):
+    host, port = cluster.rgw.addr
+    c = http.client.HTTPConnection(host, port, timeout=30)
+    yield c
+    c.close()
+
+
+def _req(conn, method, path, body=None, headers=None):
+    conn.request(method, path, body=body, headers=headers or {})
+    r = conn.getresponse()
+    data = r.read()
+    return r.status, dict(r.getheaders()), data
+
+
+def test_auth_handshake_anonymous_zone(conn):
+    st, hdrs, _ = _req(conn, "GET", "/auth/v1.0",
+                       headers={"X-Auth-User": "test:swift",
+                                "X-Auth-Key": "whatever"})
+    assert st == 200
+    assert hdrs.get("X-Auth-Token")
+    assert hdrs.get("X-Storage-Url", "").endswith("/swift/v1")
+
+
+def test_container_lifecycle(conn):
+    assert _req(conn, "PUT", "/swift/v1/cont1")[0] == 201
+    assert _req(conn, "PUT", "/swift/v1/cont1")[0] == 202  # exists
+    st, _, body = _req(conn, "GET", "/swift/v1")
+    assert st == 200 and b"cont1" in body
+    st, _, body = _req(conn, "GET", "/swift/v1?format=json")
+    assert st == 200
+    assert any(e["name"] == "cont1" for e in json.loads(body))
+    st, hdrs, _ = _req(conn, "HEAD", "/swift/v1")
+    assert st == 204 and int(hdrs["X-Account-Container-Count"]) >= 1
+    assert _req(conn, "DELETE", "/swift/v1/cont1")[0] == 204
+    assert _req(conn, "DELETE", "/swift/v1/cont1")[0] == 404
+
+
+def test_object_crud_with_metadata(conn):
+    _req(conn, "PUT", "/swift/v1/oc")
+    st, hdrs, _ = _req(conn, "PUT", "/swift/v1/oc/hello.txt",
+                       body=b"swift bytes",
+                       headers={"X-Object-Meta-Color": "teal",
+                                "X-Object-Meta-Rank": "7"})
+    assert st == 201 and hdrs.get("ETag")
+    st, hdrs, body = _req(conn, "GET", "/swift/v1/oc/hello.txt")
+    assert st == 200 and body == b"swift bytes"
+    assert hdrs.get("X-Object-Meta-Color") == "teal"
+    assert hdrs.get("X-Object-Meta-Rank") == "7"
+    st, hdrs, _ = _req(conn, "HEAD", "/swift/v1/oc/hello.txt")
+    assert st == 200 and int(hdrs["Content-Length"]) == len(b"swift bytes")
+    assert hdrs.get("X-Object-Meta-Color") == "teal"
+    # POST replaces the metadata set
+    st, _, _ = _req(conn, "POST", "/swift/v1/oc/hello.txt",
+                    headers={"X-Object-Meta-Mood": "calm"})
+    assert st == 202
+    st, hdrs, _ = _req(conn, "HEAD", "/swift/v1/oc/hello.txt")
+    assert hdrs.get("X-Object-Meta-Mood") == "calm"
+    assert "X-Object-Meta-Color" not in hdrs
+    assert _req(conn, "DELETE", "/swift/v1/oc/hello.txt")[0] == 204
+    assert _req(conn, "GET", "/swift/v1/oc/hello.txt")[0] == 404
+
+
+def test_container_listing_prefix_marker_limit(conn):
+    _req(conn, "PUT", "/swift/v1/lst")
+    for name in ("a1", "a2", "b1", "b2"):
+        _req(conn, "PUT", f"/swift/v1/lst/{name}", body=b"x")
+    st, _, body = _req(conn, "GET", "/swift/v1/lst")
+    assert st == 200 and body == b"a1\na2\nb1\nb2\n"
+    st, _, body = _req(conn, "GET", "/swift/v1/lst?prefix=a")
+    assert body == b"a1\na2\n"
+    st, _, body = _req(conn, "GET", "/swift/v1/lst?marker=a2&limit=1")
+    assert body == b"b1\n"
+    st, _, body = _req(conn, "GET", "/swift/v1/lst?format=json&prefix=b")
+    rows = json.loads(body)
+    assert [r["name"] for r in rows] == ["b1", "b2"]
+    assert all(r["bytes"] == 1 for r in rows)
+    st, hdrs, _ = _req(conn, "HEAD", "/swift/v1/lst")
+    assert st == 204 and int(hdrs["X-Container-Object-Count"]) == 4
+    # non-empty container delete refused
+    assert _req(conn, "DELETE", "/swift/v1/lst")[0] == 409
+
+
+def test_empty_listings_are_204(conn):
+    _req(conn, "PUT", "/swift/v1/empty")
+    assert _req(conn, "GET", "/swift/v1/empty")[0] == 204
+    assert _req(conn, "GET", "/swift/v1/missing")[0] == 404
+
+
+def test_s3_and_swift_share_the_namespace(conn):
+    """One bucket layer, two fronts (the reference's design): an object
+    PUT via S3 is visible via Swift and vice versa."""
+    _req(conn, "PUT", "/shared-ns")  # S3 bucket create
+    _req(conn, "PUT", "/shared-ns/from-s3", body=b"s3 data")
+    st, _, body = _req(conn, "GET", "/swift/v1/shared-ns/from-s3")
+    assert st == 200 and body == b"s3 data"
+    _req(conn, "PUT", "/swift/v1/shared-ns/from-swift", body=b"sw data")
+    st, _, body = _req(conn, "GET", "/shared-ns/from-swift")
+    assert st == 200 and body == b"sw data"
+    st, _, body = _req(conn, "GET", "/shared-ns")
+    assert b"<Key>from-swift</Key>" in body
