@@ -24,7 +24,17 @@ Design, stated plainly:
   parent object and severs the link.  Children are registered in the
   pool's `rbd_children` omap so `snap_unprotect` can refuse while
   clones exist (reference: cls_rbd's rbd_children directory).
-- Journaling, mirroring, and the kernel client remain out of scope.
+- **Journaling** (reference: librbd's journaling feature): with the
+  feature enabled, every mutation (write/resize/snap ops) appends a
+  write-ahead record to `journal.{image}.{tid:016x}` objects BEFORE
+  applying; `journal.{image}` (the journal header) tracks the next tid
+  and each registered client's commit position, and records committed
+  by every client are trimmed.  **Mirroring** (reference: the
+  rbd-mirror daemon) tails a primary image's journal and replays it
+  onto a same-name image in a peer pool: non-primary replicas refuse
+  client writes, promote/demote flips the primary side for failover
+  (ceph_tpu/client/rbd_mirror.py).  The kernel client remains out of
+  scope.
 
     rbd = RBD(ioctx)
     rbd.create("vol1", size=1 << 30)
@@ -95,13 +105,17 @@ def _children_of(io, parent: str, snap: str) -> list[str]:
 
 class Image:
     """An open image handle (reference: librbd::Image).  Pass `snap` at
-    open for a read-only point-in-time view."""
+    open for a read-only point-in-time view.  `_replaying` marks a
+    mirror-replay handle: it may mutate a NON-PRIMARY replica and must
+    not re-journal the replayed ops."""
 
-    def __init__(self, io, name: str, header: dict, snap: str | None = None):
+    def __init__(self, io, name: str, header: dict, snap: str | None = None,
+                 _replaying: bool = False):
         self._io = io
         self.name = name
         self._header = header
         self.snap_name = snap
+        self._replaying = _replaying
         if snap is not None:
             if snap not in header.get("snaps", {}):
                 raise SnapshotError(f"image {name!r} has no snap {snap!r}")
@@ -137,6 +151,42 @@ class Image:
 
     def _data_oid(self, objectno: int) -> str:
         return f"{self._header['block_name_prefix']}.{objectno:016x}"
+
+    # -- journaling (reference: librbd Journal<I>::append_io_event) --------
+    def _journaled(self) -> bool:
+        return "journaling" in self._header.get("features", [])
+
+    def _check_writable(self) -> None:
+        if self._snap is not None:
+            raise ReadOnlyImage(f"{self.name}@{self.snap_name} is read-only")
+        mir = self._header.get("mirror")
+        if mir and not mir.get("primary", True) and not self._replaying:
+            raise ReadOnlyImage(
+                f"{self.name!r} is a non-primary mirror replica"
+            )
+
+    def _journal_append(self, record: dict):
+        """Write-ahead: the record is durable BEFORE the mutation applies.
+        A crash between append and apply is healed at the next open —
+        RBD.open replays the primary's own uncommitted tail through the
+        __local__ journal client (librbd's open-time journal replay);
+        every record is an idempotent absolute-state setter.  Returns
+        the tid (None when not journaling)."""
+        if not self._journaled() or self._replaying:
+            return None
+        from .rbd_mirror import journal_append
+
+        return journal_append(self._io, self.name, record)
+
+    def _journal_applied(self, tid) -> None:
+        """Mark a just-applied record committed for the local side; also
+        drives trimming, so an image with no mirror peer registered
+        cannot grow its journal without bound (review r5)."""
+        if tid is None:
+            return
+        from .rbd_mirror import LOCAL_CLIENT, journal_commit
+
+        journal_commit(self._io, self.name, LOCAL_CLIENT, tid)
 
     # -- parent (clone) plumbing -------------------------------------------
     def _object_exists(self, objectno: int) -> bool:
@@ -240,19 +290,25 @@ class Image:
         return b"".join(parts)
 
     def write(self, data: bytes, off: int) -> int:
-        if self._snap is not None:
-            raise ReadOnlyImage(f"{self.name}@{self.snap_name} is read-only")
+        self._check_writable()
         if off + len(data) > self.size():
             raise IOError(
                 f"write past end of image ({off + len(data)} > {self.size()})"
             )
+        import base64
+
+        tid = self._journal_append({
+            "op": "write", "off": int(off),
+            "data": base64.b64encode(bytes(data)).decode(),
+        })
         self._copy_up(off, len(data))
         self._ext.write(data, off)
+        self._journal_applied(tid)
         return len(data)
 
     def resize(self, size: int) -> None:
-        if self._snap is not None:
-            raise ReadOnlyImage(f"{self.name}@{self.snap_name} is read-only")
+        self._check_writable()
+        tid = self._journal_append({"op": "resize", "size": int(size)})
         if size < self.size():
             self._ext.truncate_data(self._header["size"], size)
             p = self._header.get("parent")
@@ -262,6 +318,7 @@ class Image:
                 p["overlap"] = size
         self._header["size"] = size
         self._save_header()
+        self._journal_applied(tid)
 
     def flush(self) -> None:  # writes are synchronous; parity of API
         pass
@@ -285,10 +342,12 @@ class Image:
         and the size at snap time."""
         if self._snap is not None:
             raise ReadOnlyImage("cannot snapshot a snap view")
+        self._check_writable()
         _check_name("snap", snap)
         snaps = self._header.setdefault("snaps", {})
         if snap in snaps:
             raise SnapshotError(f"snap {snap!r} exists")
+        tid = self._journal_append({"op": "snap_create", "snap": snap})
         sid = self._io.snap_create(_pool_snap_name(self.name, snap))
         snaps[snap] = {"id": sid, "size": self._header["size"],
                        "protected": False}
@@ -298,17 +357,21 @@ class Image:
             # later shrink narrows the live overlap but not this one
             snaps[snap]["overlap"] = p["overlap"]
         self._save_header()
+        self._journal_applied(tid)
         return sid
 
     def snap_remove(self, snap: str) -> None:
+        self._check_writable()
         snaps = self._header.get("snaps", {})
         if snap not in snaps:
             raise SnapshotError(f"no snap {snap!r}")
         if snaps[snap].get("protected"):
             raise ImageBusy(f"snap {snap!r} is protected")
+        tid = self._journal_append({"op": "snap_remove", "snap": snap})
         self._io.snap_remove(_pool_snap_name(self.name, snap))
         del snaps[snap]
         self._save_header()
+        self._journal_applied(tid)
 
     def snap_protect(self, snap: str) -> None:
         """Required before cloning (reference: librbd snap_protect)."""
@@ -339,9 +402,11 @@ class Image:
         librbd snap_rollback: per-object copy from the snap view)."""
         if self._snap is not None:
             raise ReadOnlyImage("cannot roll back a snap view")
+        self._check_writable()
         snaps = self._header.get("snaps", {})
         if snap not in snaps:
             raise SnapshotError(f"no snap {snap!r}")
+        tid = self._journal_append({"op": "snap_rollback", "snap": snap})
         s = snaps[snap]
         head_size = self._header["size"]
         span = max(head_size, s["size"], 1)
@@ -363,6 +428,7 @@ class Image:
                 self._io.write_full(oid, old)
         self._header["size"] = s["size"]
         self._save_header()
+        self._journal_applied(tid)
 
     # -- clone maintenance ---------------------------------------------------
     def flatten(self) -> None:
@@ -418,7 +484,19 @@ class RBD:
             raw = self._io.read(name + _HEADER_SUFFIX)
         except IOError as e:
             raise ImageNotFound(f"no image {name!r}") from e
-        return Image(self._io, name, json.loads(raw), snap=snap)
+        img = Image(self._io, name, json.loads(raw), snap=snap)
+        if (
+            snap is None and img._journaled()
+            and (img._header.get("mirror") or {}).get("primary", True)
+        ):
+            # open-time journal replay (librbd's Journal open path): a
+            # crash between a record's append and its apply left the
+            # tail ahead of the image — re-apply it through the local
+            # client position so the write-ahead contract holds
+            from .rbd_mirror import replay_local_tail
+
+            replay_local_tail(self._io, img)
+        return img
 
     def list(self) -> list[str]:
         out = []

@@ -1,0 +1,377 @@
+"""RBD image journaling + mirroring (reference: librbd's journaling
+feature — Journal<I> write-ahead event records — and the rbd-mirror
+daemon's journal-based one-way replay; SURVEY.md §2.6).
+
+Journal layout (per image, in the image's own pool):
+
+- ``journal.{image}``          header: {"next_tid": N, "clients":
+                               {client_id: last_committed_tid}}
+- ``journal.{image}.{tid:016x}``  one JSON record per event, written
+                               BEFORE the mutation applies (write-ahead;
+                               every record is an idempotent
+                               absolute-state setter, so replay after a
+                               crash between append and apply is safe).
+
+Mirroring model (the rbd-mirror daemon, collapsed to a pull replayer):
+
+- enabling mirroring marks the image ``mirror: {enabled, primary,
+  global_id}`` and implies the journaling feature;
+- a ``MirrorReplayer(src_io, dst_io)`` registers as a journal client on
+  each mirror-enabled primary image in the source pool, creates the
+  same-name NON-PRIMARY replica in the destination pool (same layout),
+  and replays journal records from its commit position — writes,
+  resizes, snap create/remove — advancing the position and trimming
+  records every registered client has committed;
+- non-primary replicas refuse client writes (Image._check_writable);
+  ``demote`` then ``promote`` flips the direction for failover, exactly
+  the reference's promote/demote workflow (resync after a split-brain
+  divergence is out of scope — the reference requires an explicit
+  resync request there too).
+"""
+from __future__ import annotations
+
+import base64
+import json
+import uuid
+
+from .rbd import RBD, Image, ImageNotFound, ReadOnlyImage
+
+_JHDR = "journal.{}"
+_JREC = "journal.{}.{:016x}"
+
+
+# ------------------------------------------------------------- journal core
+
+def _jread(io, oid):
+    try:
+        return json.loads(io.read(oid))
+    except (IOError, ValueError):
+        return None
+
+
+LOCAL_CLIENT = "__local__"
+
+
+def journal_header(io, image: str) -> dict:
+    return _jread(io, _JHDR.format(image)) or {
+        "next_tid": 0, "clients": {}, "trimmed": -1,
+    }
+
+
+def _save_header(io, image: str, hdr: dict) -> None:
+    io.write_full(_JHDR.format(image), json.dumps(hdr).encode())
+
+
+def journal_append(io, image: str, record: dict) -> int:
+    """Append one event record; returns its tid.  Record object first,
+    header second: a crash between the two leaves an orphan record ABOVE
+    next_tid that the next append overwrites — never a header pointing
+    at a missing record."""
+    hdr = journal_header(io, image)
+    tid = hdr["next_tid"]
+    io.write_full(_JREC.format(image, tid), json.dumps(record).encode())
+    hdr["next_tid"] = tid + 1
+    _save_header(io, image, hdr)
+    return tid
+
+
+def journal_register(io, image: str, client_id: str) -> int:
+    """Register a replay client at the beginning of the RETAINED
+    journal.  Safe unconditionally: every record is an idempotent
+    absolute-state setter, so re-applying records whose effects the
+    bootstrap copy (or the old primary's own history, on failback)
+    already carries converges on the same state.  One honest caveat:
+    a snap_create replayed AFTER later writes were bootstrap-copied
+    snapshots the replica's current state, not the source's
+    point-in-time view — the reference's image sync walks snapshots
+    explicitly to avoid this; live mirroring (replayer registered
+    before the snap) is point-in-time correct."""
+    hdr = journal_header(io, image)
+    if client_id not in hdr["clients"]:
+        hdr["clients"][client_id] = -1
+        _save_header(io, image, hdr)
+    return hdr["clients"][client_id]
+
+
+# records retained while NO mirror peer is registered: enough for a
+# soon-arriving replayer to catch up without a resync, bounded so an
+# unmirrored journaled image cannot grow its journal forever (a peer
+# registering past the window heals via MirrorReplayer's resync)
+RETAIN_NO_PEER = 4096
+
+
+def journal_commit(io, image: str, client_id: str, tid: int) -> None:
+    """Advance a client's commit position and trim committed records
+    (MDLog-style expiry).  The LOCAL client (the primary committing its
+    own applies) does not gate retention on its own: with no mirror
+    peer registered the journal keeps only the last RETAIN_NO_PEER
+    records; once a peer exists, the floor is the slowest client.  The
+    trim walks only [trimmed+1, floor] — both known from the header —
+    never the pool's object listing (review r5)."""
+    hdr = journal_header(io, image)
+    hdr["clients"][client_id] = max(hdr["clients"].get(client_id, -1), tid)
+    peers = [v for k, v in hdr["clients"].items() if k != LOCAL_CLIENT]
+    if peers:
+        floor = min(hdr["clients"].values())
+    else:
+        floor = hdr["next_tid"] - 1 - RETAIN_NO_PEER
+    start = hdr.get("trimmed", -1) + 1
+    for rec_tid in range(start, floor + 1):
+        try:
+            io.remove(_JREC.format(image, rec_tid))
+        except IOError:
+            pass
+    if floor >= start:
+        hdr["trimmed"] = floor
+    _save_header(io, image, hdr)
+
+
+def replay_local_tail(io, img: Image) -> None:
+    """Re-apply the primary's own uncommitted journal tail (records
+    appended whose apply a crash interrupted) — RBD.open calls this for
+    journaled primary images (librbd's open-time journal replay)."""
+    image = img.name
+    hdr = journal_header(io, image)
+    pos = hdr["clients"].get(LOCAL_CLIENT, -1)
+    if pos >= hdr["next_tid"] - 1:
+        return
+    replayer = Image(io, image, img._header, _replaying=True)
+    for tid in range(pos + 1, hdr["next_tid"]):
+        rec = _jread(io, _JREC.format(image, tid))
+        if rec is not None:
+            _apply_record(replayer, rec)
+    journal_commit(io, image, LOCAL_CLIENT, hdr["next_tid"] - 1)
+
+
+def _apply_record(img: Image, rec: dict) -> None:
+    """Apply one journal record to an image through a replay handle —
+    shared by the primary's open-time tail replay and the mirror
+    replayer.  Every op is an idempotent absolute-state setter."""
+    op = rec["op"]
+    if op == "write":
+        data = base64.b64decode(rec["data"])
+        end = rec["off"] + len(data)
+        if end > img.size():
+            img.resize(end)  # defensive: record order guarantees this
+        img.write(data, rec["off"])
+    elif op == "resize":
+        img.resize(rec["size"])
+    elif op == "snap_create":
+        if rec["snap"] not in img.snap_list():
+            img.snap_create(rec["snap"])
+    elif op == "snap_remove":
+        if rec["snap"] in img.snap_list():
+            img.snap_remove(rec["snap"])
+    elif op == "snap_rollback":
+        if rec["snap"] in img.snap_list():
+            img.snap_rollback(rec["snap"])
+    # unknown ops are skipped (forward compatibility)
+
+
+# ---------------------------------------------------------- mirror admin
+
+def _edit_header(io, name: str, fn) -> dict:
+    rbd = RBD(io)
+    img = rbd.open(name)
+    fn(img._header)
+    img._save_header()
+    return img._header
+
+
+def mirror_enable(io, name: str) -> dict:
+    """Enable journal-based mirroring on an image (implies the
+    journaling feature; the image starts as the PRIMARY side)."""
+
+    def fn(h):
+        feats = h.setdefault("features", [])
+        if "journaling" not in feats:
+            feats.append("journaling")
+        h.setdefault("mirror", {
+            "enabled": True, "primary": True,
+            "global_id": uuid.uuid4().hex,
+        })
+        h["mirror"]["enabled"] = True
+
+    return _edit_header(io, name, fn)
+
+
+def mirror_disable(io, name: str) -> dict:
+    def fn(h):
+        if h.get("mirror"):
+            h["mirror"]["enabled"] = False
+
+    return _edit_header(io, name, fn)
+
+
+def mirror_demote(io, name: str) -> dict:
+    """Primary -> non-primary (step 1 of failover; drain the journal
+    with a replayer pass before promoting the other side)."""
+
+    def fn(h):
+        mir = h.get("mirror")
+        if not mir or not mir.get("enabled"):
+            raise ReadOnlyImage(f"{name!r} is not mirror-enabled")
+        mir["primary"] = False
+
+    return _edit_header(io, name, fn)
+
+
+def mirror_promote(io, name: str, force: bool = False) -> dict:
+    """Non-primary -> primary (step 2 of failover).  `force` is the
+    split-brain override accepted for API parity with `rbd mirror image
+    promote --force`; the divergence detection that distinguishes the
+    two upstream needs the peer's journal, which a promoted-side-only
+    caller may not reach — resync remains the operator's explicit step
+    either way, as in the reference."""
+
+    def fn(h):
+        mir = h.get("mirror")
+        if not mir or not mir.get("enabled"):
+            raise ReadOnlyImage(f"{name!r} is not mirror-enabled")
+        mir["primary"] = True
+
+    return _edit_header(io, name, fn)
+
+
+def mirror_image_status(io, name: str) -> dict:
+    rbd = RBD(io)
+    img = rbd.open(name)
+    hdr = journal_header(io, name)
+    mir = dict(img._header.get("mirror") or {})
+    mir["journal_next_tid"] = hdr["next_tid"]
+    mir["journal_clients"] = dict(hdr["clients"])
+    return mir
+
+
+# ---------------------------------------------------------- the replayer
+
+class MirrorReplayer:
+    """One-way journal replayer (the rbd-mirror daemon role for one
+    pool pair).  `run_once()` pulls every mirror-enabled primary image
+    in `src_io`, bootstraps missing replicas, replays new journal
+    records onto `dst_io`, commits, and trims."""
+
+    def __init__(self, src_io, dst_io, client_id: str = "rbd-mirror"):
+        self.src = src_io
+        self.dst = dst_io
+        self.client_id = client_id
+
+    # -- bootstrap (reference: rbd-mirror image sync) --------------------
+    def _bootstrap(self, name: str, src_img: Image) -> None:
+        """Full-copy the current image state into a fresh NON-PRIMARY
+        replica.  Data is read through the IMAGE (not raw objects), so a
+        clone's parent-backed ranges arrive too (review r5: raw head
+        reads dropped everything not yet copied up).  Pre-existing
+        snapshot NAMES are recreated on the replica so later
+        snap_remove/rollback records resolve — their content is the
+        bootstrap-time state, not the source's point-in-time view (the
+        reference's image sync walks snapshot deltas; documented
+        limitation here, same caveat as journal_register)."""
+        h = src_img._header
+        dst_rbd = RBD(self.dst)
+        dst_rbd.create(
+            name, h["size"], order=h["order"],
+            stripe_unit=h["stripe_unit"], stripe_count=h["stripe_count"],
+        )
+        dst_img = Image(self.dst, name,
+                        json.loads(self.dst.read(name + ".rbd_header")),
+                        _replaying=True)
+        dst_img._header["features"] = list(h.get("features", []))
+        dst_img._header["mirror"] = dict(h["mirror"], primary=False)
+        dst_img._save_header()
+        # snaps whose CREATE record is still retained will be replayed
+        # in order (point-in-time correct) — bootstrap must not
+        # pre-create them or the replay's exists-guard would skip the
+        # correctly-timed create
+        jhdr = journal_header(self.src, src_img.name)
+        replayed_snaps = set()
+        for tid in range(jhdr.get("trimmed", -1) + 1, jhdr["next_tid"]):
+            rec = _jread(self.src, _JREC.format(src_img.name, tid))
+            if rec and rec.get("op") == "snap_create":
+                replayed_snaps.add(rec["snap"])
+        self._sync_data(src_img, dst_img, sparse_skip=True,
+                        skip_snaps=replayed_snaps)
+
+    def _sync_data(self, src_img: Image, dst_img: Image,
+                   sparse_skip: bool,
+                   skip_snaps: set | None = None) -> None:
+        """Logical full-copy src -> dst in object-size chunks.  Reads go
+        through the IMAGE, so a clone's parent-backed ranges arrive too.
+        sparse_skip elides all-zero chunks — valid only for a FRESH
+        replica; a resync over existing data must overwrite everything
+        or stale bytes survive where the source has zeros.  Snapshot
+        NAMES are recreated (content = sync-time state, not the
+        source's point-in-time view — the reference's image sync walks
+        snapshot deltas; documented limitation) except `skip_snaps`,
+        whose retained journal records will replay them correctly."""
+        h = src_img._header
+        if dst_img.size() != h["size"]:
+            dst_img.resize(h["size"])
+        step = 1 << h["order"]
+        for off in range(0, h["size"], step):
+            chunk = src_img.read(off, min(step, h["size"] - off))
+            if sparse_skip and not chunk.strip(b"\x00"):
+                continue
+            dst_img.write(chunk, off)
+        for snap in src_img.snap_list():
+            if snap in (skip_snaps or ()):
+                continue
+            if snap not in dst_img.snap_list():
+                dst_img.snap_create(snap)
+
+    def run_once(self) -> dict:
+        """One replay pass; returns {image: records_applied}."""
+        src_rbd = RBD(self.src)
+        applied: dict[str, int] = {}
+        for name in src_rbd.list():
+            try:
+                src_img = src_rbd.open(name)
+            except ImageNotFound:
+                continue
+            mir = src_img._header.get("mirror")
+            if not mir or not mir.get("enabled"):
+                continue
+            # a demoted source still drains (records appended while it
+            # was primary remain), but NEVER replay onto a destination
+            # that has been PROMOTED: a force-promote with a live
+            # replayer must not let stale source records overwrite the
+            # new primary's writes (review r5)
+            try:
+                dst_probe = RBD(self.dst).open(name)
+                if (dst_probe._header.get("mirror") or {}).get(
+                        "primary", False):
+                    continue
+            except ImageNotFound:
+                self._bootstrap(name, src_img)
+            journal_register(self.src, name, self.client_id)
+            hdr = journal_header(self.src, name)
+            pos = hdr["clients"][self.client_id]
+            n = 0
+            dst_img = Image(
+                self.dst, name,
+                json.loads(self.dst.read(name + ".rbd_header")),
+                _replaying=True,
+            )
+            if pos < hdr.get("trimmed", -1):
+                # our position predates the trim floor: records we need
+                # are gone (the primary's local client trims behind
+                # itself) — RESYNC the image state and jump forward,
+                # the rbd-mirror behavior when a journal is no longer
+                # retained for a peer
+                self._sync_data(src_img, dst_img, sparse_skip=False)
+                journal_commit(self.src, name, self.client_id,
+                               hdr["trimmed"])
+                pos = hdr["trimmed"]
+                applied[name] = applied.get(name, 0)
+            for tid in range(pos + 1, hdr["next_tid"]):
+                rec = _jread(self.src, _JREC.format(name, tid))
+                if rec is None:
+                    continue  # trimmed below a racing commit floor
+                _apply_record(dst_img, rec)
+                n += 1
+            if n or pos < hdr["next_tid"] - 1:
+                journal_commit(self.src, name, self.client_id,
+                               hdr["next_tid"] - 1)
+            if n:
+                applied[name] = n
+        return applied

@@ -1,0 +1,209 @@
+"""RBD journaling + mirroring (reference: librbd journaling feature +
+the rbd-mirror daemon's journal replay; round-4 verdict missing #5)."""
+import pytest
+
+from ceph_tpu.client.rbd import RBD, ReadOnlyImage
+from ceph_tpu.client.rbd_mirror import (
+    MirrorReplayer,
+    journal_header,
+    mirror_demote,
+    mirror_enable,
+    mirror_image_status,
+    mirror_promote,
+)
+from ceph_tpu.qa.vstart import LocalCluster
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with LocalCluster(n_mons=1, n_osds=3) as c:
+        c.create_replicated_pool("rbd-a", size=2)
+        c.create_replicated_pool("rbd-b", size=2)
+        yield c
+
+
+@pytest.fixture(scope="module")
+def ios(cluster):
+    cl = cluster.client()
+    return cl.open_ioctx("rbd-a"), cl.open_ioctx("rbd-b")
+
+
+def test_journaled_writes_append_records(ios):
+    src, _dst = ios
+    rbd = RBD(src)
+    rbd.create("jimg", size=1 << 20)
+    mirror_enable(src, "jimg")
+    with rbd.open("jimg") as img:
+        img.write(b"abc" * 100, 0)
+        img.write(b"xyz", 4096)
+        img.resize(1 << 21)
+    hdr = journal_header(src, "jimg")
+    assert hdr["next_tid"] == 3
+
+
+def test_mirror_replay_bootstraps_and_tracks(ios):
+    src, dst = ios
+    rbd = RBD(src)
+    rbd.create("vol", size=1 << 20)
+    with rbd.open("vol") as img:
+        img.write(b"pre-mirror data", 0)  # before enabling: bootstrap copy
+    mirror_enable(src, "vol")
+    rep = MirrorReplayer(src, dst)
+    rep.run_once()
+    dst_rbd = RBD(dst)
+    with dst_rbd.open("vol") as replica:
+        assert replica.read(0, 15) == b"pre-mirror data"
+        assert replica.stat()["mirror"]["primary"] is False
+    # new journaled writes flow on the next pass
+    with rbd.open("vol") as img:
+        img.write(b"LIVE", 100)
+        img.snap_create("ms1")
+        img.resize(1 << 21)
+    applied = rep.run_once()
+    assert applied.get("vol") == 3
+    with dst_rbd.open("vol") as replica:
+        assert replica.read(100, 4) == b"LIVE"
+        assert replica.size() == 1 << 21
+        assert "ms1" in replica.snap_list()
+    # commit position advanced and the journal trimmed
+    st = mirror_image_status(src, "vol")
+    assert st["journal_clients"]["rbd-mirror"] == st["journal_next_tid"] - 1
+    assert not [
+        o for o in src.list_objects()
+        if o.startswith("journal.vol.") and o != "journal.vol"
+    ], "journal records not trimmed after full commit"
+
+
+def test_non_primary_replica_refuses_writes(ios):
+    src, dst = ios
+    rbd = RBD(src)
+    rbd.create("ro", size=1 << 20)
+    mirror_enable(src, "ro")
+    rep = MirrorReplayer(src, dst)
+    rep.run_once()
+    with RBD(dst).open("ro") as replica:
+        with pytest.raises(ReadOnlyImage, match="non-primary"):
+            replica.write(b"nope", 0)
+        with pytest.raises(ReadOnlyImage):
+            replica.snap_create("s")
+
+
+def test_failover_demote_promote(ios):
+    src, dst = ios
+    rbd = RBD(src)
+    rbd.create("fo", size=1 << 20)
+    mirror_enable(src, "fo")
+    with rbd.open("fo") as img:
+        img.write(b"written at site A", 0)
+    rep = MirrorReplayer(src, dst)
+    rep.run_once()
+    # failover: demote A, drain, promote B
+    mirror_demote(src, "fo")
+    rep.run_once()  # drain any tail
+    mirror_promote(dst, "fo")
+    with RBD(src).open("fo") as old_primary:
+        with pytest.raises(ReadOnlyImage):
+            old_primary.write(b"refused", 0)
+    with RBD(dst).open("fo") as new_primary:
+        new_primary.write(b"written at site B", 0)
+        assert new_primary.read(0, 17) == b"written at site B"
+    # failback direction: a reverse replayer carries B's writes to A
+    back = MirrorReplayer(dst, src)
+    back.run_once()
+    with RBD(src).open("fo") as a_side:
+        assert a_side.read(0, 17) == b"written at site B"
+
+
+def test_snap_remove_replays(ios):
+    src, dst = ios
+    rbd = RBD(src)
+    rbd.create("sr", size=1 << 20)
+    mirror_enable(src, "sr")
+    rep = MirrorReplayer(src, dst)
+    with rbd.open("sr") as img:
+        img.snap_create("tmp")
+    rep.run_once()
+    assert "tmp" in RBD(dst).open("sr").snap_list()
+    with rbd.open("sr") as img:
+        img.snap_remove("tmp")
+    rep.run_once()
+    assert "tmp" not in RBD(dst).open("sr").snap_list()
+
+
+def test_clone_bootstrap_carries_parent_data(ios):
+    """review r5: bootstrap reads through the image, so a clone's
+    parent-backed (never copied-up) ranges reach the replica."""
+    src, dst = ios
+    rbd = RBD(src)
+    rbd.create("par", size=1 << 20)
+    with rbd.open("par") as img:
+        img.write(b"parent payload", 0)
+        img.snap_create("base")
+        img.snap_protect("base")
+    rbd.clone("par", "base", "kid")
+    mirror_enable(src, "kid")
+    MirrorReplayer(src, dst).run_once()
+    with RBD(dst).open("kid") as replica:
+        assert replica.read(0, 14) == b"parent payload"
+
+
+def test_snap_rollback_replays_and_guards(ios):
+    src, dst = ios
+    rbd = RBD(src)
+    rbd.create("rb", size=1 << 20)
+    mirror_enable(src, "rb")
+    rep = MirrorReplayer(src, dst)
+    with rbd.open("rb") as img:
+        img.write(b"good state", 0)
+        img.snap_create("keep")
+        img.write(b"bad bytes!", 0)
+    rep.run_once()
+    with rbd.open("rb") as img:
+        img.snap_rollback("keep")
+    rep.run_once()
+    with RBD(dst).open("rb") as replica:
+        assert replica.read(0, 10) == b"good state"
+        # and a replica refuses client rollbacks
+        with pytest.raises(ReadOnlyImage):
+            replica.snap_rollback("keep")
+
+
+def test_open_replays_crashed_tail(ios):
+    """review r5: a record appended whose apply crashed is re-applied at
+    the next open (the write-ahead contract)."""
+    from ceph_tpu.client.rbd_mirror import journal_append
+
+    src, _dst = ios
+    rbd = RBD(src)
+    rbd.create("crash", size=1 << 20)
+    mirror_enable(src, "crash")
+    with rbd.open("crash") as img:
+        img.write(b"applied", 0)
+    # simulate append-then-crash: record durable, mutation never ran
+    import base64
+
+    journal_append(src, "crash", {
+        "op": "write", "off": 0,
+        "data": base64.b64encode(b"REPLAYED").decode(),
+    })
+    with rbd.open("crash") as img:  # open-time tail replay heals it
+        assert img.read(0, 8) == b"REPLAYED"
+
+
+def test_replayer_refuses_promoted_destination(ios):
+    """review r5: a force-promoted replica must not be clobbered by a
+    still-running replayer's stale records."""
+    src, dst = ios
+    rbd = RBD(src)
+    rbd.create("fp", size=1 << 20)
+    mirror_enable(src, "fp")
+    rep = MirrorReplayer(src, dst)
+    rep.run_once()
+    mirror_promote(dst, "fp", force=True)  # split-brain on purpose
+    with rbd.open("fp") as img:  # src still thinks it's primary
+        img.write(b"stale source write", 0)
+    rep.run_once()  # must NOT touch the promoted replica
+    with RBD(dst).open("fp") as newp:
+        assert newp.read(0, 18) != b"stale source write"
