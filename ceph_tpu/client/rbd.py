@@ -340,8 +340,6 @@ class Image:
         """Point-in-time snapshot (reference: librbd snap_create): a pool
         snap scoped by name to this image + a header record of the id
         and the size at snap time."""
-        if self._snap is not None:
-            raise ReadOnlyImage("cannot snapshot a snap view")
         self._check_writable()
         _check_name("snap", snap)
         snaps = self._header.setdefault("snaps", {})
@@ -375,21 +373,27 @@ class Image:
 
     def snap_protect(self, snap: str) -> None:
         """Required before cloning (reference: librbd snap_protect)."""
+        self._check_writable()
         snaps = self._header.get("snaps", {})
         if snap not in snaps:
             raise SnapshotError(f"no snap {snap!r}")
+        tid = self._journal_append({"op": "snap_protect", "snap": snap})
         snaps[snap]["protected"] = True
         self._save_header()
+        self._journal_applied(tid)
 
     def snap_unprotect(self, snap: str) -> None:
+        self._check_writable()
         snaps = self._header.get("snaps", {})
         if snap not in snaps:
             raise SnapshotError(f"no snap {snap!r}")
         kids = _children_of(self._io, self.name, snap)
         if kids:
             raise ImageBusy(f"snap {snap!r} has clone children: {kids}")
+        tid = self._journal_append({"op": "snap_unprotect", "snap": snap})
         snaps[snap]["protected"] = False
         self._save_header()
+        self._journal_applied(tid)
 
     def snap_is_protected(self, snap: str) -> bool:
         snaps = self._header.get("snaps", {})
@@ -400,8 +404,6 @@ class Image:
     def snap_rollback(self, snap: str) -> None:
         """Restore the image head to the snapshot state (reference:
         librbd snap_rollback: per-object copy from the snap view)."""
-        if self._snap is not None:
-            raise ReadOnlyImage("cannot roll back a snap view")
         self._check_writable()
         snaps = self._header.get("snaps", {})
         if snap not in snaps:
@@ -520,6 +522,13 @@ class RBD:
                 self._io.remove(legacy)
             except IOError:
                 pass
+        if img._journaled():
+            # the journal dies with the image (review r5): a leaked
+            # header + record tail would replay the OLD image's bytes
+            # onto a re-created same-name image at its first open
+            from .rbd_mirror import journal_purge
+
+            journal_purge(self._io, name)
         self._io.remove(name + _HEADER_SUFFIX)
         p = img._header.get("parent")
         if p:

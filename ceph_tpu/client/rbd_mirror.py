@@ -34,7 +34,13 @@ import base64
 import json
 import uuid
 
-from .rbd import RBD, Image, ImageNotFound, ReadOnlyImage
+from .rbd import (
+    _HEADER_SUFFIX,
+    Image,
+    ImageNotFound,
+    RBD,
+    ReadOnlyImage,
+)
 
 _JHDR = "journal.{}"
 _JREC = "journal.{}.{:016x}"
@@ -165,6 +171,12 @@ def _apply_record(img: Image, rec: dict) -> None:
     elif op == "snap_rollback":
         if rec["snap"] in img.snap_list():
             img.snap_rollback(rec["snap"])
+    elif op == "snap_protect":
+        if rec["snap"] in img.snap_list():
+            img.snap_protect(rec["snap"])
+    elif op == "snap_unprotect":
+        if rec["snap"] in img.snap_list():
+            img.snap_unprotect(rec["snap"])
     # unknown ops are skipped (forward compatibility)
 
 
@@ -195,12 +207,37 @@ def mirror_enable(io, name: str) -> dict:
     return _edit_header(io, name, fn)
 
 
+def journal_purge(io, image: str) -> None:
+    """Delete the journal header + every retained record (image removal
+    and mirror disable; bounded by the header's trimmed/next_tid)."""
+    hdr = journal_header(io, image)
+    for tid in range(hdr.get("trimmed", -1) + 1, hdr["next_tid"]):
+        try:
+            io.remove(_JREC.format(image, tid))
+        except IOError:
+            pass
+    try:
+        io.remove(_JHDR.format(image))
+    except IOError:
+        pass
+
+
 def mirror_disable(io, name: str) -> dict:
+    """Tear mirroring down (reference: `rbd mirror image disable`
+    removes the journal): drop the feature AND purge the journal, so a
+    frozen peer's commit position cannot pin records forever and later
+    writes stop journaling (review r5)."""
+
     def fn(h):
         if h.get("mirror"):
             h["mirror"]["enabled"] = False
+        feats = h.get("features") or []
+        if "journaling" in feats:
+            feats.remove("journaling")
 
-    return _edit_header(io, name, fn)
+    out = _edit_header(io, name, fn)
+    journal_purge(io, name)
+    return out
 
 
 def mirror_demote(io, name: str) -> dict:
@@ -274,7 +311,7 @@ class MirrorReplayer:
             stripe_unit=h["stripe_unit"], stripe_count=h["stripe_count"],
         )
         dst_img = Image(self.dst, name,
-                        json.loads(self.dst.read(name + ".rbd_header")),
+                        json.loads(self.dst.read(name + _HEADER_SUFFIX)),
                         _replaying=True)
         dst_img._header["features"] = list(h.get("features", []))
         dst_img._header["mirror"] = dict(h["mirror"], primary=False)
@@ -349,7 +386,7 @@ class MirrorReplayer:
             n = 0
             dst_img = Image(
                 self.dst, name,
-                json.loads(self.dst.read(name + ".rbd_header")),
+                json.loads(self.dst.read(name + _HEADER_SUFFIX)),
                 _replaying=True,
             )
             if pos < hdr.get("trimmed", -1):

@@ -1,0 +1,205 @@
+"""rbd CLI analog — image administration from the shell.
+
+Reference: src/tools/rbd/rbd.cc (the `rbd` command: create/ls/info/rm,
+snap create/ls/rollback/protect, clone/flatten, import/export, and the
+`rbd mirror image` family; SURVEY.md §2.8).
+
+    python -m ceph_tpu.tools.rbd -m 127.0.0.1:6789 -p rbd create img --size 64M
+    python -m ceph_tpu.tools.rbd -m ... -p rbd snap create img@s1
+    python -m ceph_tpu.tools.rbd -m ... -p rbd mirror image enable img
+    python -m ceph_tpu.tools.rbd -m ... -p rbd export img out.bin
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..client.rados import Rados
+from ..client.rbd import RBD
+from ..common.context import CephContext
+from .rados import _parse_mons
+
+
+def _parse_size(s: str) -> int:
+    mult = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+    s = s.strip()
+    if s and s[-1].lower() in mult:
+        return int(float(s[:-1]) * mult[s[-1].lower()])
+    return int(s)
+
+
+def _split_spec(spec: str) -> tuple[str, str]:
+    """image@snap -> (image, snap); snap required."""
+    if "@" not in spec:
+        raise ValueError(f"expected image@snap, got {spec!r}")
+    image, _, snap = spec.partition("@")
+    return image, snap
+
+
+def main(argv=None, out=sys.stdout) -> int:
+    ap = argparse.ArgumentParser(
+        prog="rbd", description="block image administration"
+    )
+    ap.add_argument("-m", "--mon", required=True,
+                    help="mon address(es) host:port[,host:port]")
+    ap.add_argument("-p", "--pool", required=True)
+    sub = ap.add_subparsers(dest="op", required=True)
+
+    p = sub.add_parser("create")
+    p.add_argument("image")
+    p.add_argument("--size", required=True, help="bytes, or with K/M/G/T")
+    p.add_argument("--order", type=int, default=22)
+    sub.add_parser("ls")
+    p = sub.add_parser("info")
+    p.add_argument("image")
+    p = sub.add_parser("rm")
+    p.add_argument("image")
+    p = sub.add_parser("resize")
+    p.add_argument("image")
+    p.add_argument("--size", required=True)
+
+    p = sub.add_parser("snap")
+    p.add_argument("snap_op",
+                   choices=["create", "ls", "rm", "rollback",
+                            "protect", "unprotect"])
+    p.add_argument("spec", help="image (for ls) or image@snap")
+
+    p = sub.add_parser("clone")
+    p.add_argument("parent_spec", help="parent@snap")
+    p.add_argument("child")
+    p = sub.add_parser("flatten")
+    p.add_argument("image")
+
+    p = sub.add_parser("export")
+    p.add_argument("image")
+    p.add_argument("outfile")
+    p = sub.add_parser("import")
+    p.add_argument("infile")
+    p.add_argument("image")
+    p.add_argument("--order", type=int, default=22)
+
+    p = sub.add_parser("mirror")
+    p.add_argument("mirror_scope", choices=["image"])
+    p.add_argument("mirror_op",
+                   choices=["enable", "disable", "promote", "demote",
+                            "status"])
+    p.add_argument("image")
+    p.add_argument("--force", action="store_true")
+
+    args = ap.parse_args(argv)
+    cct = CephContext("client.rbd-tool")
+    client = Rados(cct, _parse_mons(args.mon))
+    client.connect(timeout=10.0)
+    try:
+        io = client.open_ioctx(args.pool)
+        rbd = RBD(io)
+        if args.op == "create":
+            rbd.create(args.image, _parse_size(args.size),
+                       order=args.order)
+            return 0
+        if args.op == "ls":
+            for name in rbd.list():
+                print(name, file=out)
+            return 0
+        if args.op == "info":
+            with rbd.open(args.image) as img:
+                st = img.stat()
+                print(f"rbd image '{args.image}':", file=out)
+                print(f"\tsize {st['size']} bytes", file=out)
+                print(f"\torder {st['order']} "
+                      f"({1 << st['order']} byte objects)", file=out)
+                print(f"\tblock_name_prefix: {st['block_name_prefix']}",
+                      file=out)
+                feats = st.get("features") or []
+                if feats:
+                    print(f"\tfeatures: {', '.join(feats)}", file=out)
+                if st.get("parent"):
+                    par = st["parent"]
+                    print(f"\tparent: {par['image']}@{par['snap']}",
+                          file=out)
+                mir = st.get("mirror")
+                if mir and mir.get("enabled"):
+                    role = "primary" if mir.get("primary") else "non-primary"
+                    print(f"\tmirroring: enabled ({role})", file=out)
+            return 0
+        if args.op == "rm":
+            rbd.remove(args.image)
+            return 0
+        if args.op == "resize":
+            with rbd.open(args.image) as img:
+                img.resize(_parse_size(args.size))
+            return 0
+        if args.op == "snap":
+            if args.snap_op == "ls":
+                with rbd.open(args.spec) as img:
+                    for name, s in sorted(img.snap_list().items()):
+                        prot = " (protected)" if s.get("protected") else ""
+                        print(f"{name}\t{s['size']}{prot}", file=out)
+                return 0
+            image, snap = _split_spec(args.spec)
+            with rbd.open(image) as img:
+                getattr(img, {
+                    "create": "snap_create", "rm": "snap_remove",
+                    "rollback": "snap_rollback",
+                    "protect": "snap_protect",
+                    "unprotect": "snap_unprotect",
+                }[args.snap_op])(snap)
+            return 0
+        if args.op == "clone":
+            parent, snap = _split_spec(args.parent_spec)
+            rbd.clone(parent, snap, args.child)
+            return 0
+        if args.op == "flatten":
+            with rbd.open(args.image) as img:
+                img.flatten()
+            return 0
+        if args.op == "export":
+            with rbd.open(args.image) as img, \
+                    open(args.outfile, "wb") as f:
+                step = 1 << img.stat()["order"]
+                for off in range(0, img.size(), step):
+                    f.write(img.read(off, min(step, img.size() - off)))
+            return 0
+        if args.op == "import":
+            with open(args.infile, "rb") as f:
+                data = f.read()
+            rbd.create(args.image, len(data), order=args.order)
+            with rbd.open(args.image) as img:
+                step = 1 << args.order
+                for off in range(0, len(data), step):
+                    chunk = data[off:off + step]
+                    if chunk.strip(b"\x00"):
+                        img.write(chunk, off)
+            return 0
+        if args.op == "mirror":
+            from ..client.rbd_mirror import (
+                mirror_demote,
+                mirror_disable,
+                mirror_enable,
+                mirror_image_status,
+                mirror_promote,
+            )
+
+            fn = {
+                "enable": lambda: mirror_enable(io, args.image),
+                "disable": lambda: mirror_disable(io, args.image),
+                "demote": lambda: mirror_demote(io, args.image),
+                "promote": lambda: mirror_promote(io, args.image,
+                                                  force=args.force),
+                "status": lambda: print(
+                    json.dumps(mirror_image_status(io, args.image),
+                               indent=2), file=out),
+            }[args.mirror_op]
+            fn()
+            return 0
+        raise AssertionError(args.op)
+    except (IOError, ValueError) as e:
+        print(f"rbd: {e}", file=sys.stderr)
+        return 1
+    finally:
+        client.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

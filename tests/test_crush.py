@@ -518,6 +518,8 @@ class TestTileFallback:
 
         monkeypatch.setattr(mapper_mod, "_launch_rule_fn", flaky)
         monkeypatch.setattr(pallas_crush, "DEFAULT_TILE", 256)
+        # stage 2 of the r5 fallback chain: loop-slabs already ruled out
+        monkeypatch.setattr(pallas_crush, "LOOP_SLABS", False)
         out = np.asarray(crush_do_rule_batch(cm, 0, np.arange(64), 3, weights))
         assert calls["n"] == 2  # failed once, retried downshifted
         assert pallas_crush.DEFAULT_TILE == pallas_crush.CHUNK
@@ -525,6 +527,42 @@ class TestTileFallback:
             exp = crush_do_rule(cmap, 0, x, 3, list(weights))
             exp = (exp + [-0x7FFFFFFF - 1] * 3)[:3]
             assert list(out[x]) == exp
+
+    def test_loop_slab_failure_flips_before_tile_downshift(self, monkeypatch):
+        """Stage 1 of the r5 chain: with the fori_loop slab walk active,
+        a launch failure first restores the static unroll at tile 256 —
+        the tile only downshifts if THAT also fails."""
+        import numpy as np
+
+        from ceph_tpu.crush import (
+            CompiledCrushMap,
+            build_hierarchical_map,
+            crush_do_rule_batch,
+        )
+        from ceph_tpu.crush import mapper as mapper_mod
+        from ceph_tpu.ops import pallas_crush
+
+        monkeypatch.setenv("CEPH_TPU_CRUSH_SCORE", "pallas")
+        cmap = build_hierarchical_map(4, 2)
+        weights = np.full(8, 0x10000, dtype=np.uint32)
+        cm = CompiledCrushMap(cmap)
+        real_launch = mapper_mod._launch_rule_fn
+        calls = {"n": 0}
+
+        def flaky(cm_, cached, xs, numrep, weightvec):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("Mosaic failed to compile TPU kernel")
+            return real_launch(cm_, cached, xs, numrep, weightvec)
+
+        monkeypatch.setattr(mapper_mod, "_launch_rule_fn", flaky)
+        monkeypatch.setattr(pallas_crush, "DEFAULT_TILE", 2048)
+        monkeypatch.setattr(pallas_crush, "LOOP_SLABS", True)
+        out = np.asarray(crush_do_rule_batch(cm, 0, np.arange(64), 3, weights))
+        assert out.shape == (64, 3)
+        assert calls["n"] == 2
+        assert pallas_crush.LOOP_SLABS is False
+        assert pallas_crush.DEFAULT_TILE == 256  # NOT all the way to 32
 
     def test_shape_errors_never_downshift(self, monkeypatch):
         """Our own TileShapeError must not trigger the retry (it is a

@@ -207,3 +207,37 @@ def test_replayer_refuses_promoted_destination(ios):
     rep.run_once()  # must NOT touch the promoted replica
     with RBD(dst).open("fp") as newp:
         assert newp.read(0, 18) != b"stale source write"
+
+
+def test_remove_and_disable_purge_the_journal(ios):
+    """review r5: the journal dies with the image (a leaked tail would
+    replay old bytes onto a re-created same-name image), and disable
+    tears the journal down so a frozen peer cannot pin records."""
+    src, dst = ios
+    rbd = RBD(src)
+    rbd.create("purge", size=1 << 20)
+    mirror_enable(src, "purge")
+    MirrorReplayer(src, dst).run_once()  # register a peer
+    with rbd.open("purge") as img:
+        img.write(b"doomed bytes", 0)
+    # disable: journal gone, feature off, later writes don't journal
+    from ceph_tpu.client.rbd_mirror import mirror_disable
+
+    mirror_disable(src, "purge")
+    assert not [o for o in src.list_objects()
+                if o.startswith("journal.purge")]
+    with rbd.open("purge") as img:
+        img.write(b"unjournaled", 0)
+    assert not [o for o in src.list_objects()
+                if o.startswith("journal.purge")]
+    # remove + recreate: no stale replay
+    mirror_enable(src, "purge")
+    with rbd.open("purge") as img:
+        img.write(b"old image bytes", 0)
+    rbd.remove("purge")
+    assert not [o for o in src.list_objects()
+                if o.startswith("journal.purge")]
+    rbd.create("purge", size=1 << 20)
+    mirror_enable(src, "purge")
+    with rbd.open("purge") as img:  # open-time replay must find nothing
+        assert img.read(0, 15) == b"\x00" * 15

@@ -325,3 +325,89 @@ class TestClusterClis:
         assert "OSDMAP_FLAGS" in out or "noout" in out
         rc, _ = run(ceph_cli, ["-m", mon, "osd", "unset", "noout"])
         assert rc == 0
+
+
+@pytest.mark.cluster
+class TestRbdCli:
+    """The rbd CLI analog (reference: src/tools/rbd/rbd.cc)."""
+
+    @pytest.fixture(scope="class")
+    def cli_cluster(self):
+        from ceph_tpu.qa.vstart import LocalCluster
+
+        # replicated pool: RBD's clone-children registry and journal
+        # ride omap/object machinery replicated pools carry
+        with LocalCluster(n_mons=1, n_osds=3) as c:
+            c.create_replicated_pool("clipool", size=2)
+            yield c
+
+    def _mon(self, c):
+        return ",".join(f"{h}:{p}" for h, p in (tuple(a) for a in c.mon_addrs))
+
+    def test_image_lifecycle(self, cli_cluster, tmp_path):
+        from ceph_tpu.tools import rbd as rbd_cli
+
+        mon = self._mon(cli_cluster)
+        base = ["-m", mon, "-p", "clipool"]
+        rc, _ = run(rbd_cli, base + ["create", "disk1", "--size", "4M"])
+        assert rc == 0
+        rc, out = run(rbd_cli, base + ["ls"])
+        assert rc == 0 and "disk1" in out.split()
+        rc, out = run(rbd_cli, base + ["info", "disk1"])
+        assert rc == 0 and "size 4194304 bytes" in out
+        rc, _ = run(rbd_cli, base + ["resize", "disk1", "--size", "8M"])
+        assert rc == 0
+        rc, out = run(rbd_cli, base + ["info", "disk1"])
+        assert "size 8388608 bytes" in out
+        # snapshots through the CLI
+        rc, _ = run(rbd_cli, base + ["snap", "create", "disk1@s1"])
+        assert rc == 0
+        rc, out = run(rbd_cli, base + ["snap", "ls", "disk1"])
+        assert "s1" in out
+        rc, _ = run(rbd_cli, base + ["snap", "rm", "disk1@s1"])
+        assert rc == 0
+        rc, _ = run(rbd_cli, base + ["rm", "disk1"])
+        assert rc == 0
+        rc, out = run(rbd_cli, base + ["ls"])
+        assert "disk1" not in out.split()
+
+    def test_import_export_roundtrip(self, cli_cluster, tmp_path):
+        from ceph_tpu.tools import rbd as rbd_cli
+
+        mon = self._mon(cli_cluster)
+        base = ["-m", mon, "-p", "clipool"]
+        src = tmp_path / "vol.img"
+        src.write_bytes(b"IMAGE" * 1000 + b"\x00" * 5000 + b"TAIL")
+        rc, _ = run(rbd_cli, base + ["import", str(src), "imp1"])
+        assert rc == 0
+        dst = tmp_path / "back.img"
+        rc, _ = run(rbd_cli, base + ["export", "imp1", str(dst)])
+        assert rc == 0
+        assert dst.read_bytes() == src.read_bytes()
+
+    def test_mirror_commands(self, cli_cluster):
+        from ceph_tpu.tools import rbd as rbd_cli
+
+        mon = self._mon(cli_cluster)
+        base = ["-m", mon, "-p", "clipool"]
+        run(rbd_cli, base + ["create", "mimg", "--size", "1M"])
+        rc, _ = run(rbd_cli, base + ["mirror", "image", "enable", "mimg"])
+        assert rc == 0
+        rc, out = run(rbd_cli, base + ["info", "mimg"])
+        assert "mirroring: enabled (primary)" in out
+        rc, _ = run(rbd_cli, base + ["mirror", "image", "demote", "mimg"])
+        assert rc == 0
+        rc, out = run(rbd_cli, base + ["info", "mimg"])
+        assert "(non-primary)" in out
+        rc, _ = run(rbd_cli, base + ["mirror", "image", "promote", "mimg"])
+        assert rc == 0
+        rc, out = run(rbd_cli, base + ["mirror", "image", "status", "mimg"])
+        assert rc == 0 and '"primary": true' in out
+
+    def test_errors_are_clean(self, cli_cluster):
+        from ceph_tpu.tools import rbd as rbd_cli
+
+        mon = self._mon(cli_cluster)
+        base = ["-m", mon, "-p", "clipool"]
+        rc, _ = run(rbd_cli, base + ["info", "no-such-image"])
+        assert rc == 1
