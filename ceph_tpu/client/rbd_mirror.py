@@ -4,8 +4,12 @@ daemon's journal-based one-way replay; SURVEY.md §2.6).
 
 Journal layout (per image, in the image's own pool):
 
-- ``journal.{image}``          header: {"next_tid": N, "clients":
-                               {client_id: last_committed_tid}}
+- ``journal.{image}``          header as OMAP keys ("next_tid",
+                               "client.{id}" commit positions,
+                               "trimmed") — per-key atomic with one
+                               writer per key, so the mirror daemon
+                               thread and the primary's client thread
+                               never lose each other's updates
 - ``journal.{image}.{tid:016x}``  one JSON record per event, written
                                BEFORE the mutation applies (write-ahead;
                                every record is an idempotent
@@ -32,6 +36,7 @@ from __future__ import annotations
 
 import base64
 import json
+import threading
 import uuid
 
 from .rbd import (
@@ -58,26 +63,54 @@ def _jread(io, oid):
 LOCAL_CLIENT = "__local__"
 
 
+# The journal header lives as OMAP KEYS on journal.{image} — per-key
+# writes are atomic at the object's primary, and each key has ONE
+# writer: "next_tid" belongs to the appending primary handle,
+# "client.{id}" to that client, and "trimmed" is monotonic best-effort
+# (a stale rewrite only re-deletes already-deleted records).  This is
+# what lets the MirrorDaemon thread commit concurrently with client
+# appends without a lost update (review r5: the earlier whole-JSON
+# header was a read-modify-write that two writers could interleave).
+
 def journal_header(io, image: str) -> dict:
-    return _jread(io, _JHDR.format(image)) or {
-        "next_tid": 0, "clients": {}, "trimmed": -1,
-    }
-
-
-def _save_header(io, image: str, hdr: dict) -> None:
-    io.write_full(_JHDR.format(image), json.dumps(hdr).encode())
+    oid = _JHDR.format(image)
+    try:
+        kv = io.omap_get(oid)
+    except IOError:
+        kv = {}
+    if not kv:
+        # legacy whole-JSON header (pre-omap format): migrate on read
+        legacy = _jread(io, oid)
+        if legacy:
+            sets = {"next_tid": str(legacy.get("next_tid", 0)).encode(),
+                    "trimmed": str(legacy.get("trimmed", -1)).encode()}
+            for cid, pos in (legacy.get("clients") or {}).items():
+                sets[f"client.{cid}"] = str(pos).encode()
+            io.omap_set(oid, sets)
+            io.write_full(oid, b"")
+            kv = sets
+    hdr = {"next_tid": 0, "clients": {}, "trimmed": -1}
+    for k, v in kv.items():
+        if k == "next_tid":
+            hdr["next_tid"] = int(v)
+        elif k == "trimmed":
+            hdr["trimmed"] = int(v)
+        elif k.startswith("client."):
+            hdr["clients"][k[len("client."):]] = int(v)
+    return hdr
 
 
 def journal_append(io, image: str, record: dict) -> int:
     """Append one event record; returns its tid.  Record object first,
-    header second: a crash between the two leaves an orphan record ABOVE
-    next_tid that the next append overwrites — never a header pointing
-    at a missing record."""
+    next_tid second: a crash between the two leaves an orphan record
+    ABOVE next_tid that the next append overwrites — never a pointer at
+    a missing record.  Single appender per image (the primary handle),
+    so the next_tid read-increment needs no CAS."""
+    oid = _JHDR.format(image)
     hdr = journal_header(io, image)
     tid = hdr["next_tid"]
     io.write_full(_JREC.format(image, tid), json.dumps(record).encode())
-    hdr["next_tid"] = tid + 1
-    _save_header(io, image, hdr)
+    io.omap_set(oid, {"next_tid": str(tid + 1).encode()})
     return tid
 
 
@@ -94,9 +127,19 @@ def journal_register(io, image: str, client_id: str) -> int:
     before the snap) is point-in-time correct."""
     hdr = journal_header(io, image)
     if client_id not in hdr["clients"]:
-        hdr["clients"][client_id] = -1
-        _save_header(io, image, hdr)
+        io.omap_set(_JHDR.format(image),
+                    {f"client.{client_id}": b"-1"})
+        return -1
     return hdr["clients"][client_id]
+
+
+def journal_unregister(io, image: str, client_id: str) -> None:
+    """Drop a replay client so its frozen position stops pinning
+    retention (a stopped mirror daemon unregisters on the way out)."""
+    try:
+        io.omap_rm_keys(_JHDR.format(image), [f"client.{client_id}"])
+    except IOError:
+        pass
 
 
 # records retained while NO mirror peer is registered: enough for a
@@ -112,10 +155,12 @@ def journal_commit(io, image: str, client_id: str, tid: int) -> None:
     own applies) does not gate retention on its own: with no mirror
     peer registered the journal keeps only the last RETAIN_NO_PEER
     records; once a peer exists, the floor is the slowest client.  The
-    trim walks only [trimmed+1, floor] — both known from the header —
-    never the pool's object listing (review r5)."""
+    trim walks only [trimmed+1, floor] — both known from the header."""
+    oid = _JHDR.format(image)
     hdr = journal_header(io, image)
-    hdr["clients"][client_id] = max(hdr["clients"].get(client_id, -1), tid)
+    pos = max(hdr["clients"].get(client_id, -1), tid)
+    io.omap_set(oid, {f"client.{client_id}": str(pos).encode()})
+    hdr["clients"][client_id] = pos
     peers = [v for k, v in hdr["clients"].items() if k != LOCAL_CLIENT]
     if peers:
         floor = min(hdr["clients"].values())
@@ -128,8 +173,7 @@ def journal_commit(io, image: str, client_id: str, tid: int) -> None:
         except IOError:
             pass
     if floor >= start:
-        hdr["trimmed"] = floor
-    _save_header(io, image, hdr)
+        io.omap_set(oid, {"trimmed": str(floor).encode()})
 
 
 def replay_local_tail(io, img: Image) -> None:
@@ -292,6 +336,7 @@ class MirrorReplayer:
         self.src = src_io
         self.dst = dst_io
         self.client_id = client_id
+        self.registered: set[str] = set()  # images we joined as a client
 
     # -- bootstrap (reference: rbd-mirror image sync) --------------------
     def _bootstrap(self, name: str, src_img: Image) -> None:
@@ -381,6 +426,7 @@ class MirrorReplayer:
             except ImageNotFound:
                 self._bootstrap(name, src_img)
             journal_register(self.src, name, self.client_id)
+            self.registered.add(name)
             hdr = journal_header(self.src, name)
             pos = hdr["clients"][self.client_id]
             n = 0
@@ -412,3 +458,47 @@ class MirrorReplayer:
             if n:
                 applied[name] = n
         return applied
+
+
+class MirrorDaemon:
+    """The rbd-mirror daemon proper: a background thread driving a
+    MirrorReplayer on an interval (reference: the rbd-mirror process
+    polling journals per pool peer).  One daemon per directed pool
+    pair; run a second one for the reverse direction after a failover."""
+
+    def __init__(self, src_io, dst_io, interval: float = 0.5,
+                 client_id: str = "rbd-mirror"):
+        self.replayer = MirrorReplayer(src_io, dst_io, client_id)
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.passes = 0
+        self.last_error: str | None = None
+
+    def start(self) -> "MirrorDaemon":
+        self._thread = threading.Thread(
+            target=self._loop, name="rbd-mirror", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(timeout=self.interval):
+            try:
+                self.replayer.run_once()
+                self.passes += 1
+                self.last_error = None
+            except Exception as e:  # a flaky pass must not kill the daemon
+                self.last_error = repr(e)
+
+    def stop(self, unregister: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if unregister:
+            # a dead peer's frozen commit position must not pin journal
+            # retention forever (review r5); records it had not yet
+            # replayed are healed by the resync path if it ever returns
+            for name in sorted(self.replayer.registered):
+                journal_unregister(self.replayer.src, name,
+                                   self.replayer.client_id)

@@ -75,6 +75,7 @@ class LocalCluster:
         self.rgw = None
         self.mon_addrs: list = []
         self._clients: list[Rados] = []
+        self._rbd_mirrors: list = []
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "LocalCluster":
@@ -139,6 +140,11 @@ class LocalCluster:
         raise RuntimeError("no leader")
 
     def stop(self) -> None:
+        for d in self._rbd_mirrors:
+            try:
+                d.stop()
+            except Exception:
+                pass
         for c in self._clients:
             try:
                 c.shutdown()
@@ -301,6 +307,18 @@ class LocalCluster:
         fs = FSClient(r.cct, r, self.mds.addr, name=name)
         fs.mount()
         return fs
+
+    def start_rbd_mirror(self, src_pool: str, dst_pool: str,
+                         interval: float = 0.2):
+        """Start an rbd-mirror daemon replaying src_pool -> dst_pool
+        (reference: the rbd-mirror process per pool peer)."""
+        from ..client.rbd_mirror import MirrorDaemon
+
+        cl = self.client("client.rbd-mirror")
+        d = MirrorDaemon(cl.open_ioctx(src_pool), cl.open_ioctx(dst_pool),
+                         interval=interval).start()
+        self._rbd_mirrors.append(d)
+        return d
 
     # -- object gateway (reference: radosgw) -------------------------------
     def start_rgw(self):

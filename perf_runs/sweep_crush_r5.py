@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Round-5 silicon sweep: straw2 score-kernel shapes + end-to-end CRUSH
+remap with the limb engine.
+
+Each configuration runs in a SUBPROCESS with a hard timeout so one bad
+Mosaic shape cannot wedge the whole sweep (the r2/r4 lesson); results
+append to perf_runs/sweep_crush_r5.jsonl as one JSON line each.
+
+Usage: python perf_runs/sweep_crush_r5.py            # run the sweep
+       python perf_runs/sweep_crush_r5.py --one CFG  # child mode
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+OUT = "/root/repo/perf_runs/sweep_crush_r5.jsonl"
+os.chdir("/root/repo")
+
+# (name, env overrides) — score-kernel shape sweeps at a fixed bench,
+# then the full 256k-PG remap per engine.  Loop-slab tiles beyond 2048
+# test whether wide tiles pay off now that compile cost is constant.
+CONFIGS = [
+    ("score_loop_t512", {"CEPH_TPU_STRAW2_LOOP": "1",
+                         "CEPH_TPU_STRAW2_TILE": "512"}),
+    ("score_loop_t2048", {"CEPH_TPU_STRAW2_LOOP": "1",
+                          "CEPH_TPU_STRAW2_TILE": "2048"}),
+    ("score_loop_t8192", {"CEPH_TPU_STRAW2_LOOP": "1",
+                          "CEPH_TPU_STRAW2_TILE": "8192"}),
+    ("score_static_t256", {"CEPH_TPU_STRAW2_LOOP": "0",
+                           "CEPH_TPU_STRAW2_TILE": "256"}),
+    ("remap_limb_loop", {"CEPH_TPU_CRUSH_ENGINE": "limb",
+                         "CEPH_TPU_STRAW2_LOOP": "1",
+                         "CEPH_TPU_BENCH_CRUSH_PGS": "262144"}),
+    ("remap_limb_static", {"CEPH_TPU_CRUSH_ENGINE": "limb",
+                           "CEPH_TPU_STRAW2_LOOP": "0",
+                           "CEPH_TPU_STRAW2_TILE": "256",
+                           "CEPH_TPU_BENCH_CRUSH_PGS": "262144"}),
+    ("remap_i64_gather", {"CEPH_TPU_CRUSH_ENGINE": "i64",
+                          "CEPH_TPU_BENCH_CRUSH_PGS": "262144"}),
+]
+
+
+def child(name: str) -> None:
+    env = dict(CONFIGS)[name]
+    os.environ.update(env)
+    import numpy as np
+
+    if name.startswith("score_"):
+        import jax.numpy as jnp
+
+        from ceph_tpu.ops import pallas_crush
+        from ceph_tpu.ops.pallas_crush import straw2_scores_pallas
+
+        B, S = 1 << 18, 128
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.integers(0, 1 << 31, B).astype(np.int32))
+        r = jnp.asarray(np.zeros(B, np.int32))
+        items = jnp.asarray(
+            np.broadcast_to(np.arange(S, dtype=np.int32), (B, S)).copy())
+        tile = pallas_crush.DEFAULT_TILE
+        loop = pallas_crush.LOOP_SLABS
+        t0 = time.perf_counter()
+        np.asarray(straw2_scores_pallas(x, r, items, tile=tile,
+                                        loop_slabs=loop)[1])
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        n = 5
+        for _ in range(n):
+            out = straw2_scores_pallas(x, r, items, tile=tile,
+                                       loop_slabs=loop)[1]
+        np.asarray(out)
+        dt = (time.perf_counter() - t0) / n
+        print(json.dumps({
+            "cfg": name, "tile": tile, "loop": loop,
+            "compile_s": round(compile_s, 2),
+            "launch_ms": round(dt * 1e3, 2),
+            "mdraws_per_s": round(B * S / dt / 1e6, 1),
+        }))
+    else:
+        sys.argv = ["bench.py", "--phase", "crush"]
+        import runpy
+
+        t0 = time.perf_counter()
+        runpy.run_path("bench.py", run_name="__main__")
+        # phase prints its own JSON; add wall time on stderr
+        print(f"# wall {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+
+def main() -> None:
+    for name, _env in CONFIGS:
+        marker = f"perf_runs/sweep_{name}.done"
+        if os.path.exists(marker):
+            continue
+        print(f"=== {name}", flush=True)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--one", name],
+                timeout=900, capture_output=True, text=True,
+            )
+            line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+            rec = {"cfg": name, "rc": r.returncode}
+            try:
+                rec.update(json.loads(line))
+            except ValueError:
+                rec["tail"] = " | ".join(r.stderr.splitlines()[-2:])
+        except subprocess.TimeoutExpired:
+            rec = {"cfg": name, "rc": -1, "error": "timeout 900s"}
+        with open(OUT, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+        if rec.get("rc") != 0:
+            # probe the tunnel before continuing: a wedge poisons the rest
+            try:
+                p = subprocess.run(
+                    [sys.executable, "-c",
+                     "import jax; assert jax.devices()[0].platform != 'cpu'"],
+                    timeout=90)
+                if p.returncode != 0:
+                    print("tunnel lost; stopping sweep", flush=True)
+                    return
+            except subprocess.TimeoutExpired:
+                print("tunnel wedged; stopping sweep", flush=True)
+                return
+        open(marker, "w").close()
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--one":
+        child(sys.argv[2])
+    else:
+        main()

@@ -241,3 +241,36 @@ def test_remove_and_disable_purge_the_journal(ios):
     mirror_enable(src, "purge")
     with rbd.open("purge") as img:  # open-time replay must find nothing
         assert img.read(0, 15) == b"\x00" * 15
+
+
+def test_mirror_daemon_replays_in_background(cluster, ios):
+    """The rbd-mirror DAEMON (thread loop) replays without explicit
+    run_once calls."""
+    import time
+
+    src, dst = ios
+    d = cluster.start_rbd_mirror("rbd-a", "rbd-b", interval=0.1)
+    try:
+        rbd = RBD(src)
+        rbd.create("auto", size=1 << 20)
+        mirror_enable(src, "auto")
+        with rbd.open("auto") as img:
+            img.write(b"hands-free", 0)
+        deadline = time.monotonic() + 10
+        got = None
+        while time.monotonic() < deadline:
+            try:
+                with RBD(dst).open("auto") as r:
+                    got = r.read(0, 10)
+                if got == b"hands-free":
+                    break
+            except IOError:
+                pass
+            time.sleep(0.1)
+        assert got == b"hands-free", (got, d.passes, d.last_error)
+        deadline = time.monotonic() + 5
+        while d.passes == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)  # the counter bumps after the pass returns
+        assert d.passes > 0 and d.last_error is None
+    finally:
+        d.stop()
