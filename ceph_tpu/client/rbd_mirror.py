@@ -73,23 +73,25 @@ LOCAL_CLIENT = "__local__"
 # header was a read-modify-write that two writers could interleave).
 
 def journal_header(io, image: str) -> dict:
+    """READ-ONLY view merging the legacy whole-JSON body (pre-omap
+    format) under any omap keys present.  The read path never writes:
+    a read-triggered migration would itself be a multi-key RMW two
+    threads could interleave (review r5) — the single APPENDER migrates
+    in journal_append instead, and omap keys always win over the body
+    so a commit landing before that migration is never shadowed."""
     oid = _JHDR.format(image)
     try:
         kv = io.omap_get(oid)
     except IOError:
         kv = {}
-    if not kv:
-        # legacy whole-JSON header (pre-omap format): migrate on read
-        legacy = _jread(io, oid)
-        if legacy:
-            sets = {"next_tid": str(legacy.get("next_tid", 0)).encode(),
-                    "trimmed": str(legacy.get("trimmed", -1)).encode()}
-            for cid, pos in (legacy.get("clients") or {}).items():
-                sets[f"client.{cid}"] = str(pos).encode()
-            io.omap_set(oid, sets)
-            io.write_full(oid, b"")
-            kv = sets
     hdr = {"next_tid": 0, "clients": {}, "trimmed": -1}
+    legacy = None if kv.get("next_tid") is not None else _jread(io, oid)
+    if legacy:
+        hdr["next_tid"] = int(legacy.get("next_tid", 0))
+        hdr["trimmed"] = int(legacy.get("trimmed", -1))
+        hdr["clients"] = {
+            str(c): int(p) for c, p in (legacy.get("clients") or {}).items()
+        }
     for k, v in kv.items():
         if k == "next_tid":
             hdr["next_tid"] = int(v)
@@ -105,9 +107,21 @@ def journal_append(io, image: str, record: dict) -> int:
     next_tid second: a crash between the two leaves an orphan record
     ABOVE next_tid that the next append overwrites — never a pointer at
     a missing record.  Single appender per image (the primary handle),
-    so the next_tid read-increment needs no CAS."""
+    so the next_tid read-increment needs no CAS — and that makes this
+    the one safe place to migrate a legacy JSON body to omap keys."""
     oid = _JHDR.format(image)
     hdr = journal_header(io, image)
+    legacy = _jread(io, oid)
+    if legacy:
+        # one-time migration by the single writer of next_tid: copy the
+        # body's view (omap keys landed meanwhile already override in
+        # journal_header) and clear the body
+        sets = {"next_tid": str(hdr["next_tid"]).encode(),
+                "trimmed": str(hdr["trimmed"]).encode()}
+        for cid, pos in hdr["clients"].items():
+            sets[f"client.{cid}"] = str(pos).encode()
+        io.omap_set(oid, sets)
+        io.write_full(oid, b"")
     tid = hdr["next_tid"]
     io.write_full(_JREC.format(image, tid), json.dumps(record).encode())
     io.omap_set(oid, {"next_tid": str(tid + 1).encode()})

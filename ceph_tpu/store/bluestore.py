@@ -82,7 +82,8 @@ class Onode:
     """Per-object metadata (reference: BlueStore::Onode).  Data is either
     inline bytes or a list of device extents with per-extent crc32c."""
 
-    __slots__ = ("size", "inline", "extents", "crcs", "xattrs", "omap")
+    __slots__ = ("size", "inline", "extents", "crcs", "xattrs", "omap",
+                 "comp", "clen")
 
     def __init__(self):
         self.size = 0
@@ -91,9 +92,18 @@ class Onode:
         self.crcs: list[int] = []
         self.xattrs: dict[str, bytes] = {}
         self.omap: dict[str, bytes] = {}
+        # at-rest compression (reference: bluestore_compression blobs):
+        # comp = algorithm name when the extents hold a COMPRESSED blob
+        # of clen stored bytes decompressing to `size` logical bytes
+        self.comp: str | None = None
+        self.clen = 0
+
+    def stored_len(self) -> int:
+        """Bytes actually on the device (compressed or raw)."""
+        return self.clen if self.comp else self.size
 
     def encode(self) -> bytes:
-        return json.dumps({
+        d = {
             "size": self.size,
             "inline": (
                 base64.b64encode(self.inline).decode()
@@ -101,7 +111,11 @@ class Onode:
             ),
             "extents": self.extents,
             "crcs": self.crcs,
-        }).encode()
+        }
+        if self.comp:
+            d["comp"] = self.comp
+            d["clen"] = self.clen
+        return json.dumps(d).encode()
 
     @classmethod
     def decode(cls, raw: bytes) -> "Onode":
@@ -113,6 +127,8 @@ class Onode:
         )
         o.extents = [tuple(e) for e in d["extents"]]
         o.crcs = list(d["crcs"])
+        o.comp = d.get("comp")
+        o.clen = d.get("clen", 0)
         return o
 
 
@@ -125,12 +141,22 @@ class BlueStore(ObjectStore):
         inline_threshold: int = 4096,
         sync: bool = True,
         checksum: bool = True,
+        compression: str = "none",
     ):
         os.makedirs(path, exist_ok=True)
         self.path = path
         self.block_size = block_size
         self.inline_threshold = inline_threshold
         self.checksum = checksum
+        # at-rest data compression (reference: bluestore_compression —
+        # whole-blob, kept only when it actually shrinks; metadata and
+        # inline blobs stay raw)
+        self._comp_name = compression if compression != "none" else None
+        self._compressor = None
+        if self._comp_name:
+            from ..compressor import Compressor
+
+            self._compressor = Compressor.create(self._comp_name)
         self._kv = None
         self._dev_path = os.path.join(path, "block")
         self._dev = None
@@ -173,17 +199,35 @@ class BlueStore(ObjectStore):
                         f"crc mismatch on extent {i} ({start},{n})"
                     )
             parts.append(part)
-        return b"".join(parts)[: onode.size]
+        stored = b"".join(parts)[: onode.stored_len()]
+        if onode.comp:
+            stored = self._decompressor(onode.comp).decompress(stored)
+        return stored[: onode.size]
+
+    def _decompressor(self, name: str):
+        """Cached per-algorithm decompressor (a store reads objects
+        compressed under any past knob setting, not just its own)."""
+        if name == self._comp_name and self._compressor is not None:
+            return self._compressor
+        cache = getattr(self, "_decompressors", None)
+        if cache is None:
+            cache = self._decompressors = {}
+        comp = cache.get(name)
+        if comp is None:
+            from ..compressor import Compressor
+
+            comp = cache[name] = Compressor.create(name)
+        return comp
 
     def _part_len(self, onode: Onode, i: int) -> int:
         """Bytes of payload stored in extent i (last extent may be
-        partial)."""
+        partial); compressed blobs measure by their STORED length."""
         before = sum(
             n * self.block_size for _, n in onode.extents[:i]
         )
         return min(
             onode.extents[i][1] * self.block_size,
-            max(0, onode.size - before),
+            max(0, onode.stored_len() - before),
         )
 
     # -- mount / freelist rebuild -----------------------------------------
@@ -393,14 +437,26 @@ class BlueStore(ObjectStore):
                     continue
                 data = bytes(st["data"])
                 if len(data) <= self.inline_threshold:
-                    new_extents[key] = (data, [], [])
+                    new_extents[key] = (data, [], [], None, 0)
                     continue
-                want = -(-len(data) // self.block_size)
+                comp_name = None
+                stored = data
+                if self._compressor is not None:
+                    packed = self._compressor.compress(data)
+                    # keep compression only when it saves whole blocks —
+                    # the allocation granularity (reference: blobs are
+                    # kept raw unless the required_ratio is met)
+                    if (-(-len(packed) // self.block_size)
+                            < -(-len(data) // self.block_size)):
+                        stored = packed
+                        comp_name = self._comp_name
+                want = -(-len(stored) // self.block_size)
                 extents = self._alloc.allocate(want)
                 allocated.extend(extents)
-                crcs = self._dev_write(extents, data)
-                new_extents[key] = (None, extents, crcs)
-            if any(e for _, e, _ in new_extents.values()):
+                crcs = self._dev_write(extents, stored)
+                new_extents[key] = (None, extents, crcs, comp_name,
+                                    len(stored))
+            if any(e for _, e, _c, _n, _l in new_extents.values()):
                 self._dev.flush()
                 if self._sync:
                     os.fdatasync(self._dev.fileno())
@@ -433,16 +489,20 @@ class BlueStore(ObjectStore):
                 len(st["data"]) if st["dirty_data"] else st["size"]
             )
             if key in new_extents:
-                inline, extents, crcs = new_extents[key]
+                inline, extents, crcs, comp, clen = new_extents[key]
                 onode.inline = inline
                 onode.extents = extents
                 onode.crcs = crcs
+                onode.comp = comp
+                onode.clen = clen
                 if old is not None:
                     freed.extend(old.extents)
             elif old is not None:
                 onode.inline = old.inline
                 onode.extents = old.extents
                 onode.crcs = old.crcs
+                onode.comp = old.comp
+                onode.clen = old.clen
             onode.xattrs = dict(st["xattrs"])
             onode.omap = dict(st["omap"])
             batch.set(_nkey(cid, oid), onode.encode())
@@ -568,9 +628,10 @@ class BlueStore(ObjectStore):
                             )
                         used[b] = key
                     seen += n * self.block_size
-                if onode.inline is None and seen < onode.size:
+                if onode.inline is None and seen < onode.stored_len():
                     report["errors"].append(
-                        f"{key}: extents cover {seen} < size {onode.size}"
+                        f"{key}: extents cover {seen} < stored "
+                        f"{onode.stored_len()}"
                     )
                 if deep:
                     try:

@@ -396,12 +396,8 @@ def create_store(
 
         if not path:
             raise StoreError("bluestore requires a path")
-        if compression and compression != "none":
-            # loud rather than silently ignoring the operator's knob
-            raise StoreError(
-                "bluestore backend does not support compression yet"
-            )
         return BlueStore(
-            path, device_size=device_size, sync=sync, checksum=checksum
+            path, device_size=device_size, sync=sync, checksum=checksum,
+            compression=compression or "none",
         )
     raise StoreError(f"unknown objectstore {kind!r}")

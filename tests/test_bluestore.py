@@ -266,3 +266,81 @@ def test_osd_boots_on_bluestore(tmp_path):
         io = c.client().open_ioctx("rp")
         io.write_full("o", b"bluestore-backed" * 3000)
         assert io.read("o") == b"bluestore-backed" * 3000
+
+
+class TestBlueStoreCompression:
+    """At-rest compression (reference: bluestore_compression blobs;
+    closes the factory's former 'not supported yet' refusal)."""
+
+    def _mk(self, tmp_path, **kw):
+        from ceph_tpu.store.bluestore import BlueStore
+
+        return BlueStore(str(tmp_path / "bs"), device_size=1 << 24,
+                         sync=False, compression="zlib", **kw)
+
+    def _write(self, bs, cid, oid, data):
+        from ceph_tpu.store.object_store import Transaction
+
+        t = Transaction()
+        t.try_create_collection(cid)
+        t.write(cid, oid, 0, data)
+        t.truncate(cid, oid, len(data))
+        bs.queue_transaction(t)
+
+    def test_compressible_data_saves_blocks_and_roundtrips(self, tmp_path):
+        bs = self._mk(tmp_path)
+        data = b"A" * 300_000  # wildly compressible
+        self._write(bs, "c", "o", data)
+        onode = bs._onodes[("c", "o")]
+        assert onode.comp == "zlib"
+        assert onode.clen < len(data) // 10
+        blocks = sum(n for _, n in onode.extents)
+        assert blocks < 300_000 // bs.block_size  # whole blocks saved
+        assert bytes(bs.read("c", "o")) == data
+        # survives a remount (fresh store object from the same dir)
+        bs.umount()
+        from ceph_tpu.store.bluestore import BlueStore
+
+        bs2 = BlueStore(str(tmp_path / "bs"), device_size=1 << 24,
+                        sync=False, compression="zlib")
+        assert bytes(bs2.read("c", "o")) == data
+        assert bs2.fsck(deep=True)["errors"] == []
+
+    def test_incompressible_data_stays_raw(self, tmp_path):
+        import os as _os
+
+        bs = self._mk(tmp_path)
+        data = _os.urandom(100_000)
+        self._write(bs, "c", "r", data)
+        onode = bs._onodes[("c", "r")]
+        assert onode.comp is None
+        assert bytes(bs.read("c", "r")) == data
+
+    def test_partial_write_on_compressed_object(self, tmp_path):
+        from ceph_tpu.store.object_store import Transaction
+
+        bs = self._mk(tmp_path)
+        data = bytearray(b"B" * 200_000)
+        self._write(bs, "c", "p", bytes(data))
+        t = Transaction()
+        t.write("c", "p", 12345, b"PATCH")
+        bs.queue_transaction(t)
+        data[12345:12350] = b"PATCH"
+        assert bytes(bs.read("c", "p")) == bytes(data)
+        assert bs.fsck(deep=True)["errors"] == []
+
+    def test_uncompressed_store_reads_compressed_onodes(self, tmp_path):
+        """A store remounted WITHOUT the knob still reads compressed
+        objects (the onode carries the algorithm)."""
+        bs = self._mk(tmp_path)
+        self._write(bs, "c", "x", b"Z" * 150_000)
+        bs.umount()
+        from ceph_tpu.store.bluestore import BlueStore
+
+        bs2 = BlueStore(str(tmp_path / "bs"), device_size=1 << 24,
+                        sync=False)  # compression off
+        assert bytes(bs2.read("c", "x")) == b"Z" * 150_000
+        # new writes from this store are raw; old stay readable
+        self._write(bs2, "c", "y", b"Y" * 150_000)
+        assert bs2._onodes[("c", "y")].comp is None
+        assert bs2.fsck(deep=True)["errors"] == []
