@@ -110,19 +110,31 @@ def journal_append(io, image: str, record: dict) -> int:
     so the next_tid read-increment needs no CAS — and that makes this
     the one safe place to migrate a legacy JSON body to omap keys."""
     oid = _JHDR.format(image)
-    hdr = journal_header(io, image)
-    legacy = _jread(io, oid)
-    if legacy:
-        # one-time migration by the single writer of next_tid: copy the
-        # body's view (omap keys landed meanwhile already override in
-        # journal_header) and clear the body
-        sets = {"next_tid": str(hdr["next_tid"]).encode(),
-                "trimmed": str(hdr["trimmed"]).encode()}
-        for cid, pos in hdr["clients"].items():
-            sets[f"client.{cid}"] = str(pos).encode()
+    try:
+        kv = io.omap_get(oid)
+    except IOError:
+        kv = {}
+    if kv.get("next_tid") is None:
+        # one-time migration of a legacy JSON body by the single writer
+        # of next_tid.  Seed ONLY keys absent from the live omap: a
+        # client key present there is per-key-owned by its client and a
+        # concurrently advanced position must not be regressed from this
+        # stale snapshot (review r5).  After migration the body is empty
+        # and kv carries next_tid, so this branch never runs again — no
+        # per-append body read on the hot path.
+        legacy = _jread(io, oid) or {}
+        sets = {"next_tid": str(legacy.get("next_tid", 0)).encode()}
+        if "trimmed" not in kv:
+            sets["trimmed"] = str(legacy.get("trimmed", -1)).encode()
+        for cid, pos in (legacy.get("clients") or {}).items():
+            if f"client.{cid}" not in kv:
+                sets[f"client.{cid}"] = str(pos).encode()
         io.omap_set(oid, sets)
-        io.write_full(oid, b"")
-    tid = hdr["next_tid"]
+        if legacy:
+            io.write_full(oid, b"")
+        tid = int(legacy.get("next_tid", 0))
+    else:
+        tid = int(kv["next_tid"])
     io.write_full(_JREC.format(image, tid), json.dumps(record).encode())
     io.omap_set(oid, {"next_tid": str(tid + 1).encode()})
     return tid

@@ -128,7 +128,7 @@ def default_options() -> OptionTable:
                    min=0.1, runtime=True),
             Option("mgr_tick_interval", float, 2.0, "mgr tick seconds",
                    min=0.05),
-            Option("mgr_modules", str, "status,prometheus,balancer",
+            Option("mgr_modules", str, "status,prometheus,balancer,iostat",
                    "comma-separated modules the mgr hosts"),
             Option("mgr_prometheus_port", int, 0,
                    "prometheus exporter port (0 = ephemeral)", min=0),

@@ -1,0 +1,56 @@
+"""iostat module — cluster IO rates from perf-report deltas (reference:
+src/pybind/mgr/iostat/module.py feeding `ceph iostat`: rd/wr ops and
+bytes per second computed between consecutive daemon reports)."""
+from __future__ import annotations
+
+import time
+
+from .module import MgrModule, register_module
+
+_RATE_COUNTERS = ("op", "op_r", "op_w", "op_r_bytes", "op_w_bytes")
+
+
+@register_module
+class IostatModule(MgrModule):
+    NAME = "iostat"
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        # daemon -> (ts, {counter: value}) of the previous sample
+        self._prev: dict[str, tuple[float, dict]] = {}
+
+    def sample(self) -> dict:
+        """Cluster-wide rates since the previous sample (first call
+        primes the baseline and reports zeros, like `iostat`'s first
+        line being since-boot noise the reference also skips)."""
+        now = time.monotonic()
+        reports = self.get_all_perf_counters()
+        totals = {c: 0.0 for c in _RATE_COUNTERS}
+        per_daemon: dict[str, dict] = {}
+        for daemon, subsystems in reports.items():
+            osd = subsystems.get("osd") or {}
+            cur = {c: float(osd.get(c, 0)) for c in _RATE_COUNTERS}
+            prev = self._prev.get(daemon)
+            self._prev[daemon] = (now, cur)
+            if prev is None:
+                continue
+            dt = now - prev[0]
+            if dt <= 0:
+                continue
+            rates = {
+                # counters can reset when a daemon restarts: clamp to 0
+                # instead of reporting a huge negative rate
+                c: max(0.0, (cur[c] - prev[1][c]) / dt)
+                for c in _RATE_COUNTERS
+            }
+            per_daemon[daemon] = rates
+            for c in _RATE_COUNTERS:
+                totals[c] += rates[c]
+        return {
+            "ops_per_s": round(totals["op"], 1),
+            "rd_ops_per_s": round(totals["op_r"], 1),
+            "wr_ops_per_s": round(totals["op_w"], 1),
+            "rd_bytes_per_s": round(totals["op_r_bytes"], 1),
+            "wr_bytes_per_s": round(totals["op_w_bytes"], 1),
+            "daemons": per_daemon,
+        }

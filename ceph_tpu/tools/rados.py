@@ -89,6 +89,9 @@ def main(argv=None, out=sys.stdout) -> int:
     p = sub.add_parser("rmsnap")
     p.add_argument("snapname")
     sub.add_parser("lssnap")
+    p = sub.add_parser("scrub", help="deep-scrub + repair the pool's PGs")
+    p.add_argument("--pg", type=int, default=None,
+                   help="one placement-group seed (default: all)")
     p = sub.add_parser("bench")
     p.add_argument("seconds", type=int)
     p.add_argument("mode", choices=("write", "seq"))
@@ -121,6 +124,19 @@ def main(argv=None, out=sys.stdout) -> int:
             else:
                 with open(args.outfile, "wb") as f:
                     f.write(data)
+        elif args.op == "scrub":
+            reports = (
+                [io.scrub_pg(args.pg)] if args.pg is not None
+                else io.scrub()
+            )
+            errs = reps = 0
+            for rep in reports:
+                errs += len(rep.get("errors", []))
+                reps += rep.get("repaired", 0)
+                for e in rep.get("errors", []):
+                    print(f"inconsistent: {e}", file=out)
+            print(f"scrubbed {len(reports)} pgs: {errs} inconsistencies, "
+                  f"{reps} repaired", file=out)
         elif args.op == "mksnap":
             sid = io.snap_create(args.snapname)
             print(f"created pool snap {args.snapname!r} id {sid}", file=out)

@@ -135,3 +135,60 @@ def test_rank1_failure_takeover_namespace_intact(cluster):
         assert fs.read_file("/pinned/after-takeover") == b"new owner"
     finally:
         fs.unmount()
+
+
+def test_traffic_through_rank_failure():
+    """Thrash: a writer stream into the pinned subtree survives the
+    owning rank's crash — requests retry through redirects/fallback and
+    every acknowledged file is intact after takeover."""
+    import threading
+
+    with LocalCluster(n_mons=1, n_osds=3, with_mds=True) as c:
+        c.start_mds_rank(1)
+        fs = c.fs_client("client.mm-thrash")
+        try:
+            fs.mkdir("/busy")
+            fs.set_subtree("/busy", 1)
+            with fs.open("/busy/warm", create=True) as f:
+                f.write(b"route-learned")
+            written: list[str] = []
+            errors: list[str] = []
+            stop = threading.Event()
+
+            def writer():
+                i = 0
+                while not stop.is_set() and i < 200:
+                    path = f"/busy/f{i:03d}"
+                    try:
+                        with fs.open(path, create=True) as f:
+                            f.write(f"payload-{i}".encode())
+                        written.append(path)
+                    except OSError as e:
+                        # during the takeover window a request can fail
+                        # after retries; that op is allowed to error,
+                        # silently wrong data is not
+                        errors.append(f"{path}: {e}")
+                    i += 1
+                stop.set()
+
+            t = threading.Thread(target=writer)
+            t.start()
+            time.sleep(0.7)  # let some writes land at rank 1
+            c.fail_mds_rank(1)
+            t.join(timeout=90)
+            stop.set()
+            assert not t.is_alive(), "writer hung through the failover"
+            assert _wait(
+                lambda: c.mds._load_subtrees(force=True).get("busy") == 0,
+                timeout=15.0,
+            ), "takeover never happened"
+            assert len(written) >= 20, (len(written), errors[:3])
+            for path in written:
+                i = int(path.rsplit("f", 1)[1])
+                assert fs.read_file(path) == f"payload-{i}".encode(), path
+            # namespace consistent: listdir sees exactly the survivors+
+            names = set(fs.listdir("/busy"))
+            for path in written:
+                assert path.rsplit("/", 1)[1] in names
+        finally:
+            fs.unmount()

@@ -222,3 +222,31 @@ def test_devicehealth_tracks_and_marks_out(dd_cluster):
         time.sleep(0.5)
     time.sleep(2)  # give self-heal passes a chance to (wrongly) fire
     assert 1 not in mod.status()["marked_out"], "ratio floor ignored"
+
+
+def test_iostat_module_reports_rates(mgr_cluster):
+    c = mgr_cluster
+    io_mod = c.mgr.module("iostat")  # hosted: iostat is a default module
+    io_mod.sample()  # prime the baseline
+    io = c.client().open_ioctx("ec")
+    for i in range(20):
+        io.write_full(f"iostat-{i}", b"x" * 4096)
+    for i in range(20):
+        io.read(f"iostat-{i}")
+    deadline = time.time() + 15
+    while True:
+        time.sleep(1.0)  # let a fresh MMgrReport land
+        s = io_mod.sample()
+        if s["wr_ops_per_s"] > 0 and s["rd_ops_per_s"] > 0:
+            break
+        assert time.time() < deadline, s
+    assert s["wr_bytes_per_s"] > 0
+    assert s["daemons"], "no per-daemon rates"
+    # rates settle back toward zero once IO stops
+    deadline = time.time() + 20
+    while True:
+        time.sleep(1.5)
+        s2 = io_mod.sample()
+        if s2["ops_per_s"] == 0:
+            break
+        assert time.time() < deadline, s2

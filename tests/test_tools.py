@@ -296,6 +296,18 @@ class TestClusterClis:
         rc, out = run(rados_cli, ["-m", mon, "-p", "clipool", "ls"])
         assert set(out.split()) <= before
 
+    def test_rados_scrub(self, cli_cluster):
+        from ceph_tpu.tools import rados as rados_cli
+
+        mon = self._mon(cli_cluster)
+        io = cli_cluster.client().open_ioctx("clipool")
+        io.write_full("sobj", b"scrub me" * 100)
+        rc, out = run(rados_cli, ["-m", mon, "-p", "clipool", "scrub"])
+        assert rc == 0 and "0 inconsistencies" in out
+        rc, out = run(rados_cli, ["-m", mon, "-p", "clipool", "scrub",
+                                  "--pg", "0"])
+        assert rc == 0 and "scrubbed 1 pgs" in out
+
     def test_ceph_status_tree_pools(self, cli_cluster):
         from ceph_tpu.tools import ceph_cli
 
