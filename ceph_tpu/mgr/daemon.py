@@ -119,6 +119,19 @@ class MgrDaemon(Dispatcher):
                 if now - r["ts"] <= max_age
             }
 
+    def latest_reports_with_ts(self) -> dict:
+        """{daemon: (arrival_ts, counters)} — rate computations must
+        divide by the REPORT interval, not the caller's sampling
+        interval (iostat)."""
+        max_age = self.cct.conf.get("mgr_stale_report_age")
+        now = time.monotonic()
+        with self._reports_lock:
+            return {
+                d: (r["ts"], r["counters"])
+                for d, r in self._reports.items()
+                if now - r["ts"] <= max_age
+            }
+
     def latest_stats(self) -> dict:
         max_age = self.cct.conf.get("mgr_stale_report_age")
         now = time.monotonic()

@@ -3,8 +3,6 @@ src/pybind/mgr/iostat/module.py feeding `ceph iostat`: rd/wr ops and
 bytes per second computed between consecutive daemon reports)."""
 from __future__ import annotations
 
-import time
-
 from .module import MgrModule, register_module
 
 _RATE_COUNTERS = ("op", "op_r", "op_w", "op_r_bytes", "op_w_bytes")
@@ -20,21 +18,35 @@ class IostatModule(MgrModule):
         self._prev: dict[str, tuple[float, dict]] = {}
 
     def sample(self) -> dict:
-        """Cluster-wide rates since the previous sample (first call
-        primes the baseline and reports zeros, like `iostat`'s first
-        line being since-boot noise the reference also skips)."""
-        now = time.monotonic()
-        reports = self.get_all_perf_counters()
+        """Cluster-wide rates between each daemon's two most recent
+        REPORTS (first call primes the baseline and reports zeros, like
+        `iostat`'s first line being since-boot noise the reference also
+        skips).  Deltas divide by the report ARRIVAL interval, not the
+        caller's sampling cadence, so polling faster than
+        mgr_report_interval neither zeroes nor inflates the rates."""
+        reports = self.mgr.latest_reports_with_ts()
+        # prune daemons that fell out of the report window (dead or
+        # removed): their stale baselines must not linger, and a daemon
+        # returning later restarts from a fresh baseline
+        for gone in set(self._prev) - set(reports):
+            del self._prev[gone]
         totals = {c: 0.0 for c in _RATE_COUNTERS}
         per_daemon: dict[str, dict] = {}
-        for daemon, subsystems in reports.items():
+        for daemon, (ts, subsystems) in reports.items():
             osd = subsystems.get("osd") or {}
             cur = {c: float(osd.get(c, 0)) for c in _RATE_COUNTERS}
             prev = self._prev.get(daemon)
-            self._prev[daemon] = (now, cur)
+            if prev is not None and ts == prev[0]:
+                # same report as last sample: keep the old baseline so
+                # the NEXT fresh report diffs against real history
+                prev_for_rates = None
+            else:
+                self._prev[daemon] = (ts, cur)
+                prev_for_rates = prev
+            prev = prev_for_rates
             if prev is None:
                 continue
-            dt = now - prev[0]
+            dt = ts - prev[0]
             if dt <= 0:
                 continue
             rates = {

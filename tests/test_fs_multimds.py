@@ -171,7 +171,7 @@ def test_traffic_through_rank_failure():
                     i += 1
                 stop.set()
 
-            t = threading.Thread(target=writer)
+            t = threading.Thread(target=writer, daemon=True)
             t.start()
             time.sleep(0.7)  # let some writes land at rank 1
             c.fail_mds_rank(1)
@@ -192,3 +192,34 @@ def test_traffic_through_rank_failure():
                 assert path.rsplit("/", 1)[1] in names
         finally:
             fs.unmount()
+
+
+def test_ceph_fs_status_cli():
+    """`ceph fs status` shows both active ranks and the subtree pins.
+    Own cluster: the module fixture's rank 1 is crashed by the
+    takeover test above."""
+    import contextlib
+    import io as _io
+
+    from ceph_tpu.tools import ceph_cli
+
+    with contextlib.ExitStack() as stack:
+        c = stack.enter_context(
+            LocalCluster(n_mons=1, n_osds=3, with_mds=True)
+        )
+        c.start_mds_rank(1)
+        fs = c.fs_client("client.mm-cli")
+        stack.callback(fs.unmount)
+        fs.mkdir("/clipin")
+        fs.set_subtree("/clipin", 1)
+        mon = ",".join(f"{h}:{p}"
+                       for h, p in (tuple(a) for a in c.mon_addrs))
+        out = _io.StringIO()
+        rc = ceph_cli.main(["-m", mon, "fs", "status"], out=out)
+        body = out.getvalue()
+        assert rc == 0, body
+        lines = [l for l in body.splitlines() if l.strip()]
+        assert any(l.strip().startswith("0") and "active" in l
+                   for l in lines)
+        rank1 = next(l for l in lines if l.strip().startswith("1"))
+        assert "active" in rank1 and "/clipin" in rank1
