@@ -613,9 +613,26 @@ class MDSDaemon(Dispatcher):
         return name
 
     def _owner_rank(self, ino: int) -> int:
+        if ino == ROOT_INO:
+            return 0  # root itself is always rank 0's (review r5: this
+            # must not fall into the unknown-ino refresh below)
         top = self._top_name(ino)
         if top is None:
-            return 0
+            # unknown ino: our cache may predate a subtree newly
+            # assigned to US — refresh the map (which adopts and
+            # rebuilds backptrs) and retry the walk.  Without this, a
+            # rank whose first look at a redirected op happens after
+            # its TTL window ping-pongs the client back to rank 0
+            # forever (capstone test).  Rate-limited to one forced
+            # refresh per TTL so an ino we can NEVER resolve (it lives
+            # in another rank's subtree) doesn't cost a pool read per op.
+            now = time.monotonic()
+            if now - getattr(self, "_last_forced_subtrees", 0.0)                     > self.SUBTREE_TTL:
+                self._last_forced_subtrees = now
+                self._load_subtrees(force=True)
+                top = self._top_name(ino)
+            if top is None:
+                return 0  # genuinely not ours: rank 0 owns unknowns
         return self._load_subtrees().get(top, 0)
 
     def absorb_rank(self, r: int) -> None:

@@ -346,7 +346,7 @@ class OSDMonitor:
             pool.size = value
             # keep the derived write quorum consistent (the same rule
             # PGPool.__post_init__ applies at creation)
-            pool.min_size = value // 2 + 1
+            pool.min_size = value - value // 2
         elif key == "target_max_objects":
             # cache-tier agent threshold (reference: pg_pool_t::
             # target_max_objects driving agent_choose_mode)

@@ -110,8 +110,12 @@ class PGPool:
         if not self.pgp_num:
             self.pgp_num = self.pg_num
         if not self.min_size:
+            # replicated: the reference's default write quorum is
+            # size - size/2 (1 for size-2 pools — a degraded pair still
+            # takes writes); EC keeps k (= size - 1 parity short)
             self.min_size = (
-                self.size // 2 + 1 if self.type == PG_POOL_REPLICATED else self.size - 1
+                self.size - self.size // 2
+                if self.type == PG_POOL_REPLICATED else self.size - 1
             )
         if not self.name:
             self.name = f"pool{self.pool_id}"

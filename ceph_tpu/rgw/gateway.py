@@ -232,18 +232,44 @@ class _Store:
                 out.append((k, ent))
         return out, False
 
-    def count_live(self, bucket: str) -> int:
-        """Paginated live-object count (Swift container HEAD)."""
-        total = 0
+    def iter_index(self, bucket: str, live_only: bool = False):
+        """Paginated generator over every (key, entry) of a bucket's
+        index — the PUBLIC full-walk used by count_live and the
+        radosgw-admin stats (callers must not bind the private
+        _index_list pagination contract)."""
         marker = ""
         while True:
             entries, truncated = self._index_list(
-                bucket, marker=marker, maxn=1000, live_only=True
+                bucket, marker=marker, maxn=1000, live_only=live_only
             )
-            total += len(entries)
+            yield from entries
             if not truncated or not entries:
-                return total
+                return
             marker = entries[-1][0]
+
+    def count_live(self, bucket: str) -> int:
+        """Paginated live-object count (Swift container HEAD)."""
+        return sum(1 for _ in self.iter_index(bucket, live_only=True))
+
+    def bucket_stats(self, bucket: str) -> dict:
+        """radosgw-admin `bucket stats` rollup: live objects, total
+        index entries, version counts, live byte total, versioning."""
+        num_entries = num_versions = num_live = size = 0
+        for _k, ent in self.iter_index(bucket):
+            num_entries += 1
+            recs = self._versions_of(ent)
+            num_versions += len(recs)
+            size += sum(r["size"] for r in recs if not r.get("dm"))
+            if not self._is_dm_head(ent):
+                num_live += 1
+        return {
+            "bucket": bucket,
+            "num_objects": num_live,
+            "num_entries": num_entries,
+            "num_versions": num_versions,
+            "size_bytes": size,
+            "versioning": self.versioning_status(bucket) or "off",
+        }
 
     def update_meta(self, bucket: str, key: str, meta: dict | None) -> bool:
         """Metadata-only update of the CURRENT version (Swift POST):

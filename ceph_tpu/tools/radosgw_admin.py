@@ -1,0 +1,102 @@
+"""radosgw-admin analog — gateway administration from the shell.
+
+Reference: src/rgw/rgw_admin.cc (`radosgw-admin bucket list / bucket
+stats / user create`; SURVEY.md §2.8).  Bucket state is read straight
+from the gateway's rgw_meta pool (catalog omap + per-bucket index),
+matching how the reference tool opens the zone pools directly rather
+than going through a gateway; `user create` mints the cephx-derived S3
+key pair through the mon (the `ceph auth get-s3-key` seam SigV4
+validates against).
+
+    python -m ceph_tpu.tools.radosgw_admin -m HOST:PORT bucket list
+    python -m ceph_tpu.tools.radosgw_admin -m ... bucket stats --bucket b
+    python -m ceph_tpu.tools.radosgw_admin -m ... user create --uid alice
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..client.rados import Rados
+from ..common.context import CephContext
+from .rados import _parse_mons
+
+
+def main(argv=None, out=sys.stdout) -> int:
+    ap = argparse.ArgumentParser(
+        prog="radosgw-admin", description="object gateway administration"
+    )
+    ap.add_argument("-m", "--mon", required=True,
+                    help="mon address(es) host:port[,host:port]")
+    sub = ap.add_subparsers(dest="op", required=True)
+
+    p = sub.add_parser("bucket")
+    p.add_argument("bucket_op", choices=["list", "stats", "rm"])
+    p.add_argument("--bucket", default=None)
+
+    p = sub.add_parser("user")
+    p.add_argument("user_op", choices=["create", "info"])
+    p.add_argument("--uid", required=True)
+
+    args = ap.parse_args(argv)
+    client = Rados(CephContext("client.rgw-admin"), _parse_mons(args.mon))
+    try:
+        client.connect(timeout=10.0)
+        if args.op == "user":
+            # the key pair every gateway derives independently from the
+            # cluster secret + access key (rgw/sigv4.py) — "create" and
+            # "info" are the same deterministic lookup, like the
+            # reference's system-user key retrieval
+            rv, res = client.command({
+                "prefix": "auth get-s3-key",
+                "entity": f"client.{args.uid}",
+            })
+            if rv != 0:
+                print(f"radosgw-admin: {res}", file=sys.stderr)
+                return 1
+            print(json.dumps({
+                "user_id": args.uid,
+                "keys": [{
+                    "access_key": res["access_key"],
+                    "secret_key": res["secret_key"],
+                }],
+            }, indent=2), file=out)
+            return 0
+        from ..rgw.gateway import _Store
+
+        store = _Store(client)
+        if args.bucket_op == "list":
+            print(json.dumps(sorted(store.buckets()), indent=2), file=out)
+            return 0
+        if not args.bucket:
+            print("radosgw-admin: --bucket required", file=sys.stderr)
+            return 22
+        if args.bucket_op == "stats":
+            if not store.bucket_exists(args.bucket):
+                print(f"radosgw-admin: no bucket {args.bucket!r}",
+                      file=sys.stderr)
+                return 1
+            print(json.dumps(store.bucket_stats(args.bucket), indent=2),
+                  file=out)
+            return 0
+        # rm
+        rv = store.delete_bucket(args.bucket)
+        if rv == -404:
+            print(f"radosgw-admin: no bucket {args.bucket!r}",
+                  file=sys.stderr)
+            return 1
+        if rv == -409:
+            print(f"radosgw-admin: bucket {args.bucket!r} not empty",
+                  file=sys.stderr)
+            return 1
+        return 0
+    except (IOError, KeyError, ValueError) as e:
+        print(f"radosgw-admin: {e}", file=sys.stderr)
+        return 1
+    finally:
+        client.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

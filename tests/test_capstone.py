@@ -1,0 +1,129 @@
+"""Capstone integration: every gateway subsystem composing on ONE
+cluster through a failure cycle (the qa-suite spirit: block + file +
+object workloads sharing the RADOS substrate while OSDs thrash).
+
+One LocalCluster hosts: an EC pool under client IO, a replicated RBD
+pool mirrored into a second pool by the background daemon, a
+two-active-rank CephFS, and the RGW with S3 versioning + Swift — then
+an OSD is crashed and revived mid-flight and every subsystem must
+come out consistent.
+"""
+import http.client
+import time
+
+import pytest
+
+from ceph_tpu.qa.vstart import LocalCluster
+
+pytestmark = pytest.mark.cluster
+
+
+def _wait(pred, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.3)
+    return pred()
+
+
+def test_all_subsystems_compose_through_osd_crash():
+    with LocalCluster(n_mons=1, n_osds=5, with_mgr=True,
+                      with_mds=True) as c:
+        c.start_mds_rank(1)
+        c.create_ec_pool("ecdata", k=2, m=1)
+        c.create_replicated_pool("rbd-a", size=2)
+        c.create_replicated_pool("rbd-b", size=2)
+        c.start_rgw()
+        mirror = c.start_rbd_mirror("rbd-a", "rbd-b", interval=0.2)
+
+        cl = c.client()
+        # -- object layer (EC pool) --
+        eio = cl.open_ioctx("ecdata")
+        for i in range(8):
+            eio.write_full(f"obj{i}", f"ec payload {i}".encode() * 50)
+
+        # -- block layer: journaled + mirrored image --
+        from ceph_tpu.client.rbd import RBD
+        from ceph_tpu.client.rbd_mirror import mirror_enable
+
+        aio = cl.open_ioctx("rbd-a")
+        rbd = RBD(aio)
+        rbd.create("capvol", size=1 << 20)
+        mirror_enable(aio, "capvol")
+        with rbd.open("capvol") as img:
+            img.write(b"block bytes before crash", 0)
+            img.snap_create("precrash")
+
+        # -- file layer: both MDS ranks --
+        fs = c.fs_client("client.capstone")
+        fs.mkdir("/shared")
+        fs.set_subtree("/shared", 1)
+        with fs.open("/shared/doc", create=True) as f:
+            f.write(b"file on rank 1")
+        fs.mkdir("/local")
+        with fs.open("/local/doc", create=True) as f:
+            f.write(b"file on rank 0")
+
+        # -- S3 + Swift over the same gateway --
+        host, port = c.rgw.addr
+        hc = http.client.HTTPConnection(host, port, timeout=30)
+
+        def req(m, p, b=None, h=None):
+            hc.request(m, p, body=b, headers=h or {})
+            r = hc.getresponse()
+            return r.status, dict(r.getheaders()), r.read()
+
+        req("PUT", "/capbkt")
+        req("PUT", "/capbkt?versioning", b"<Status>Enabled</Status>")
+        _, h1, _ = req("PUT", "/capbkt/key", b"version one")
+        v1 = h1["x-amz-version-id"]
+        req("PUT", "/capbkt/key", b"version two")
+        req("PUT", "/swift/v1/capbkt/via-swift", b"swift object")
+
+        # -- crash an OSD mid-flight, keep using everything --
+        c.kill_osd(4)
+        for i in range(8, 12):
+            eio.write_full(f"obj{i}", f"ec payload {i}".encode() * 50)
+        with rbd.open("capvol") as img:
+            img.write(b"written degraded", 100)
+        with fs.open("/shared/during", create=True) as f:
+            f.write(b"written while degraded")
+        req("PUT", "/capbkt/during", b"degraded s3 write")
+        c.mark_osd_down_out(4)
+        c.revive_osd(4)
+        c.mark_osd_in_up(4)
+        c.wait_clean("ecdata", timeout=90)
+
+        # -- everything consistent after recovery --
+        for i in range(12):
+            assert eio.read(f"obj{i}") == f"ec payload {i}".encode() * 50
+        assert _wait(lambda: _mirrored(c, cl)), \
+            f"mirror never caught up ({mirror.last_error})"
+        with RBD(cl.open_ioctx("rbd-b")).open("capvol") as replica:
+            assert replica.read(0, 24) == b"block bytes before crash"
+            assert replica.read(100, 16) == b"written degraded"
+            assert "precrash" in replica.snap_list()
+        assert fs.read_file("/shared/doc") == b"file on rank 1"
+        assert fs.read_file("/shared/during") == b"written while degraded"
+        assert fs.read_file("/local/doc") == b"file on rank 0"
+        assert req("GET", "/capbkt/key")[2] == b"version two"
+        assert req("GET", f"/capbkt/key?versionId={v1}")[2] == b"version one"
+        assert req("GET", "/capbkt/during")[2] == b"degraded s3 write"
+        assert req("GET", "/swift/v1/capbkt/via-swift")[2] == b"swift object"
+        # the mgr saw the whole story: iostat reports live daemons
+        mod = c.mgr.module("iostat")
+        mod.sample()
+        assert _wait(lambda: mod.sample()["daemons"] is not None, 10)
+        fs.unmount()
+        hc.close()
+
+
+def _mirrored(c, cl) -> bool:
+    from ceph_tpu.client.rbd import RBD
+
+    try:
+        with RBD(cl.open_ioctx("rbd-b")).open("capvol") as r:
+            return r.read(100, 16) == b"written degraded"
+    except IOError:
+        return False

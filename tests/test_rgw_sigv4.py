@@ -342,3 +342,25 @@ class TestSignedGateway:
             assert r.status == 200 and r.read() == b"tokened"
         finally:
             c.close()
+
+
+    def test_radosgw_admin_user_keys(self, cluster):
+        """radosgw-admin user create/info mints the same cephx-derived
+        pair SigV4 validates against."""
+        import io as _io
+        import json as _json
+
+        from ceph_tpu.tools import radosgw_admin
+
+        mon = ",".join(f"{h}:{p}"
+                       for h, p in (tuple(a) for a in cluster.mon_addrs))
+        out = _io.StringIO()
+        rc = radosgw_admin.main(
+            ["-m", mon, "user", "create", "--uid", "adminuser"], out=out)
+        assert rc == 0
+        keys = _json.loads(out.getvalue())["keys"][0]
+        assert keys["access_key"] and keys["secret_key"]
+        out2 = _io.StringIO()
+        radosgw_admin.main(
+            ["-m", mon, "user", "info", "--uid", "adminuser"], out=out2)
+        assert _json.loads(out2.getvalue())["keys"] == [keys]
