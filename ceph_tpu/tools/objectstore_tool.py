@@ -152,11 +152,45 @@ def main(argv=None, out=sys.stdout) -> int:
     ap.add_argument("--data-path", required=True, help="KStore directory")
     ap.add_argument("--op", required=True,
                     choices=("list", "info", "export", "import", "remove",
-                             "fsck"))
+                             "fsck", "kv-list", "kv-get"))
     ap.add_argument("--pgid", help="shard collection id, e.g. 1.3s0")
     ap.add_argument("object", nargs="?", help="object name")
     ap.add_argument("--force", action="store_true")
+    ap.add_argument("--prefix", default="",
+                    help="key prefix filter for kv-list")
     args = ap.parse_args(argv)
+
+    if args.op in ("kv-list", "kv-get"):
+        # ceph-kvstore-tool role (reference: src/tools/kvstore_tool.cc):
+        # raw inspection of the store's KV layer, no store mount — works
+        # on kstore and bluestore data dirs (both keep a LogKV at kv/)
+        import os as _os
+
+        from ..store.kv import LogKV
+
+        kv_dir = args.data_path
+        if _os.path.isdir(_os.path.join(args.data_path, "kv")):
+            kv_dir = _os.path.join(args.data_path, "kv")
+        kv = LogKV(kv_dir, sync_default=False)
+        try:
+            if args.op == "kv-list":
+                n = 0
+                for key, val in kv.iterate(args.prefix):
+                    print(f"{key}\t{len(val)}", file=out)
+                    n += 1
+                print(f"{n} key(s)", file=out)
+                return 0
+            if not args.object:
+                ap.error("kv-get needs a key name")
+            val = kv.get(args.object)
+            if val is None:
+                print(f"no key {args.object!r}", file=sys.stderr)
+                return 2
+            sys.stdout.buffer.write(bytes(val)) if out is sys.stdout \
+                else print(bytes(val), file=out)
+            return 0
+        finally:
+            kv.close()
 
     store = _open(args.data_path)
     try:
