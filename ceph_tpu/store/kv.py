@@ -103,14 +103,21 @@ class LogKV(KeyValueDB):
     """WAL + snapshot file pair in a directory."""
 
     def __init__(self, path: str, sync_default: bool = True,
-                 compact_threshold: int = 64 << 20):
+                 compact_threshold: int = 64 << 20,
+                 readonly: bool = False):
+        """readonly: pure inspection open (kvstore-tool role) — never
+        creates the directory, never truncates a torn WAL tail (the torn
+        record is evidence on a corrupt store), never opens the WAL for
+        append; submit_batch refuses."""
         self.path = path
         self.sync_default = sync_default
         self.compact_threshold = compact_threshold
+        self.readonly = readonly
         self._map: dict[str, bytes] = {}
         self._lock = RLock()
         self._wal = None
-        os.makedirs(path, exist_ok=True)
+        if not readonly:
+            os.makedirs(path, exist_ok=True)
         self._snap_path = os.path.join(path, "snapshot")
         self._wal_path = os.path.join(path, "wal")
         self._recover()
@@ -142,12 +149,13 @@ class LogKV(KeyValueDB):
                     break  # torn tail: last batch never committed
                 self._replay(payload)
                 pos += 8 + length
-            if pos < len(wal):
+            if pos < len(wal) and not self.readonly:
                 # drop the torn tail so future appends start at a clean
                 # record boundary (RocksDB recycles the WAL the same way)
                 with open(self._wal_path, "r+b") as f:
                     f.truncate(pos)
-        self._wal = open(self._wal_path, "ab")
+        if not self.readonly:
+            self._wal = open(self._wal_path, "ab")
 
     def _replay(self, payload: bytes) -> None:
         it = BufferListIterator(payload)
@@ -162,6 +170,8 @@ class LogKV(KeyValueDB):
 
     # -- writes -----------------------------------------------------------
     def submit_batch(self, ops, sync: bool | None = None) -> None:
+        if self.readonly:
+            raise IOError("read-only KV open refuses writes")
         if isinstance(ops, Batch):
             ops = ops.ops
         sync = self.sync_default if sync is None else sync

@@ -162,32 +162,63 @@ def main(argv=None, out=sys.stdout) -> int:
 
     if args.op in ("kv-list", "kv-get"):
         # ceph-kvstore-tool role (reference: src/tools/kvstore_tool.cc):
-        # raw inspection of the store's KV layer, no store mount — works
-        # on kstore and bluestore data dirs (both keep a LogKV at kv/)
+        # raw READ-ONLY inspection of the store's KV layer, no store
+        # mount — works on kstore and bluestore data dirs (both keep a
+        # LogKV; bluestore under kv/).  Keys embed NUL separators, so
+        # listings print them ESCAPED (\0 for NUL, \\ for backslash)
+        # and kv-get accepts the same escaped form — argv cannot carry
+        # raw NULs.
         import os as _os
 
         from ..store.kv import LogKV
 
+        def esc(k: str) -> str:
+            return k.replace("\\", "\\\\").replace("\x00", "\\0")
+
+        def unesc(k: str) -> str:
+            out_chars = []
+            i = 0
+            while i < len(k):
+                if k[i] == "\\" and i + 1 < len(k):
+                    out_chars.append(
+                        "\x00" if k[i + 1] == "0" else k[i + 1])
+                    i += 2
+                else:
+                    out_chars.append(k[i])
+                    i += 1
+            return "".join(out_chars)
+
         kv_dir = args.data_path
         if _os.path.isdir(_os.path.join(args.data_path, "kv")):
             kv_dir = _os.path.join(args.data_path, "kv")
-        kv = LogKV(kv_dir, sync_default=False)
+        if not (_os.path.exists(_os.path.join(kv_dir, "wal"))
+                or _os.path.exists(_os.path.join(kv_dir, "snapshot"))):
+            # a typo'd path must error, not conjure an empty store
+            print(f"{kv_dir}: no KV store (no wal/snapshot)",
+                  file=sys.stderr)
+            return 2
+        kv = LogKV(kv_dir, readonly=True)
         try:
             if args.op == "kv-list":
                 n = 0
-                for key, val in kv.iterate(args.prefix):
-                    print(f"{key}\t{len(val)}", file=out)
+                for key, val in kv.iterate(unesc(args.prefix)):
+                    print(f"{esc(key)}\t{len(val)}", file=out)
                     n += 1
                 print(f"{n} key(s)", file=out)
                 return 0
             if not args.object:
                 ap.error("kv-get needs a key name")
-            val = kv.get(args.object)
+            val = kv.get(unesc(args.object))
             if val is None:
                 print(f"no key {args.object!r}", file=sys.stderr)
                 return 2
-            sys.stdout.buffer.write(bytes(val)) if out is sys.stdout \
-                else print(bytes(val), file=out)
+            # byte-clean on a real stdout; latin-1 text (no repr noise)
+            # on injected text streams
+            buf = getattr(out, "buffer", None)
+            if buf is not None:
+                buf.write(bytes(val))
+            else:
+                out.write(bytes(val).decode("latin-1"))
             return 0
         finally:
             kv.close()

@@ -427,12 +427,12 @@ class TestRbdCli:
 
 class TestKvstoreVerbs:
     """ceph-kvstore-tool role (reference: src/tools/kvstore_tool.cc) —
-    raw KV inspection via objectstore-tool kv-list / kv-get."""
+    raw READ-ONLY KV inspection via objectstore-tool kv-list/kv-get
+    with NUL-escaped keys."""
 
-    def test_kv_list_and_get(self, tmp_path):
+    def _seed(self, tmp_path):
         from ceph_tpu.store.kstore import KStore
         from ceph_tpu.store.object_store import Transaction
-        from ceph_tpu.tools import objectstore_tool
 
         path = str(tmp_path / "ks")
         ks = KStore(path, sync=False)
@@ -443,23 +443,63 @@ class TestKvstoreVerbs:
         t.setattr("1.0s0", "obj", "color", b"red")
         ks.queue_transaction(t)
         ks.umount()
+        return path
+
+    def test_kv_list_escapes_and_get_roundtrips(self, tmp_path):
+        from ceph_tpu.tools import objectstore_tool
+
+        path = self._seed(tmp_path)
         rc, out = run(objectstore_tool,
                       ["--data-path", path, "--op", "kv-list"])
         assert rc == 0
         lines = out.strip().splitlines()
-        assert any(l.startswith("D") and "obj" in l for l in lines)
         assert lines[-1].endswith("key(s)")
-        # prefix filter narrows to attr keys only
-        rc, out2 = run(objectstore_tool,
-                       ["--data-path", path, "--op", "kv-list",
-                        "--prefix", "A"])
-        assert rc == 0 and all(
-            l.startswith("A") for l in out2.strip().splitlines()[:-1])
-        # fetch one concrete key observed in the listing
-        key = next(l.split("\t")[0] for l in lines if l.startswith("D"))
+        assert "\x00" not in out, "raw NULs leaked into the listing"
+        data_key = next(l.split("\t")[0] for l in lines
+                        if l.startswith("D") and "obj" in l)
+        assert "\\0" in data_key  # separators visible, copyable
+        # the ESCAPED key from the listing fetches the raw value
         rc, out3 = run(objectstore_tool,
-                       ["--data-path", path, "--op", "kv-get", key])
-        assert rc == 0 and "kv payload" in out3
+                       ["--data-path", path, "--op", "kv-get", data_key])
+        assert rc == 0 and out3 == "kv payload"
         rc, _ = run(objectstore_tool,
-                    ["--data-path", path, "--op", "kv-get", "Z~nope"])
+                    ["--data-path", path, "--op", "kv-get", "Z\\0nope"])
         assert rc == 2
+
+    def test_kv_prefix_filter(self, tmp_path):
+        from ceph_tpu.tools import objectstore_tool
+
+        path = self._seed(tmp_path)
+        rc, out = run(objectstore_tool,
+                      ["--data-path", path, "--op", "kv-list",
+                       "--prefix", "A"])
+        assert rc == 0
+        assert all(l.startswith("A")
+                   for l in out.strip().splitlines()[:-1])
+
+    def test_kv_inspection_is_readonly(self, tmp_path):
+        """A torn WAL tail must SURVIVE inspection (it is evidence on a
+        corrupt store); a normal writable open then truncates it."""
+        import os
+
+        from ceph_tpu.tools import objectstore_tool
+
+        path = self._seed(tmp_path)
+        wal = os.path.join(path, "wal")
+        size_before = os.path.getsize(wal)
+        with open(wal, "ab") as f:
+            f.write(b"TORN-RECORD-FRAGMENT")
+        run(objectstore_tool, ["--data-path", path, "--op", "kv-list"])
+        assert os.path.getsize(wal) == size_before + 20, \
+            "read-only inspection truncated the torn tail"
+
+    def test_kv_bad_path_errors(self, tmp_path):
+        import os
+
+        from ceph_tpu.tools import objectstore_tool
+
+        bogus = str(tmp_path / "typo")
+        rc, _ = run(objectstore_tool,
+                    ["--data-path", bogus, "--op", "kv-list"])
+        assert rc == 2
+        assert not os.path.exists(bogus), "typo'd path was conjured"
