@@ -38,6 +38,16 @@ def default_options() -> OptionTable:
             Option("ms_connect_timeout", float, 10.0,
                    "seconds to wait for a connect", min=0.0),
             Option("ms_tcp_nodelay", bool, True, "disable Nagle"),
+            Option("ms_compress", str, "none",
+                   "on-wire frame compression algorithm (reference: "
+                   "ms_osd_compress_mode + compressor registry)",
+                   enum=("none", "zlib", "snappy", "zstd", "lz4")),
+            Option("ms_compress_force", bool, False,
+                   "allow non-zlib wire compression (no handshake "
+                   "negotiation: every peer must carry the module)"),
+            Option("ms_compress_min_size", int, 4096,
+                   "frames below this many payload bytes stay raw "
+                   "(reference: ms_osd_compress_min_size)", min=0),
             Option("ms_max_frame_len", int, 1 << 28,
                    "reject frames larger than this", min=4096),
             Option("ms_inject_socket_failures", int, 0,

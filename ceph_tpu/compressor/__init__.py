@@ -70,6 +70,23 @@ class ZlibCompressor(Compressor):
         except zlib.error as e:
             raise CompressorError(f"zlib: {e}") from e
 
+    def decompress_bounded(self, data: bytes, max_out: int) -> bytes:
+        """Inflate at most max_out bytes (decompression-bomb guard for
+        untrusted frames): a stream that would exceed the bound raises
+        instead of allocating it."""
+        import zlib
+
+        d = zlib.decompressobj()
+        try:
+            out = d.decompress(bytes(data), max_out)
+        except zlib.error as e:
+            raise CompressorError(f"zlib: {e}") from e
+        if d.unconsumed_tail or (d.decompress(b"", 1) if not d.eof else b""):
+            raise CompressorError(
+                f"zlib: inflated stream exceeds bound ({max_out})"
+            )
+        return out
+
 
 def _try_register_optional() -> None:
     """snappy / zstd / lz4 exist only if their modules are importable —

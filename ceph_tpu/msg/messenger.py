@@ -79,6 +79,13 @@ def _os_nonce() -> str:
 
 _FRAME_MSG = 0
 _FRAME_ACK = 1
+# compressed message frame (reference: ProtocolV2 compression frames):
+# body = [2][u8 algo_len][algo name][compressed payload].  The RECEIVE
+# side is configuration-independent — it decompresses by the named
+# algorithm from the registry — so only the sender's ms_compress knob
+# governs whether a link compresses (the reference's ms_osd_compress_*
+# conf gates the sender the same way)
+_FRAME_MSG_Z = 2
 # delivery attempts for a message whose dispatcher keeps raising before it
 # is dropped-and-acked as poison (at-least-once, bounded)
 _POISON_RETRIES = 3
@@ -191,6 +198,20 @@ class Connection:
                     raise OSError("injected socket failure")
         if self.sock is None:
             raise OSError("not connected")
+        comp = self.msgr._wire_comp
+        if (
+            ftype == _FRAME_MSG and comp is not None
+            and len(payload) >= self.msgr._wire_min_size
+        ):
+            z = comp.compress(payload)
+            name = self.msgr._wire_comp_name.encode()
+            if len(z) + len(name) + 6 < len(payload):
+                ftype = _FRAME_MSG_Z
+                # declared raw length up front: the receiver bounds its
+                # allocation BEFORE inflating (decompression-bomb guard)
+                payload = (bytes([len(name)]) + name
+                           + struct.pack("<I", len(payload)) + z)
+                self.msgr.comp_frames_sent += 1
         body = bytes([ftype]) + payload
         frame = struct.pack("<II", len(body), crc32c(body)) + body
         if self._frame_key is not None:
@@ -281,6 +302,34 @@ class Messenger:
         # engine built lazily from config so tests can flip it per-context
         self._auth = None
         self._auth_checked = False
+        # on-wire compression (sender-side knob; see _FRAME_MSG_Z).
+        # Default policy restricts the WIRE to zlib — the one algorithm
+        # every receiver can construct (stdlib) — because there is no
+        # capability negotiation in the handshake: a receiver missing an
+        # optional module would fail the frame connection-fatally and
+        # the lossless replay would loop.  ms_compress_force overrides
+        # for fleets known to carry the module everywhere.
+        self._wire_comp = None
+        self._wire_comp_name = ""
+        self._wire_min_size = 4096
+        algo = cct.conf.get("ms_compress") if cct else "none"
+        if algo and algo != "none":
+            if algo != "zlib" and not (
+                cct and cct.conf.get("ms_compress_force")
+            ):
+                raise ValueError(
+                    f"ms_compress={algo!r} needs ms_compress_force=true "
+                    f"(no wire negotiation: every peer must carry the "
+                    f"module; zlib is the negotiation-free default)"
+                )
+            from ..compressor import Compressor
+
+            self._wire_comp = Compressor.create(algo)
+            self._wire_comp_name = algo
+            self._wire_min_size = cct.conf.get("ms_compress_min_size")
+        self._wire_decomp: dict[str, object] = {}
+        #: frames actually sent compressed (observability/tests)
+        self.comp_frames_sent = 0
 
     def _auth_required(self) -> bool:
         return (
@@ -661,6 +710,31 @@ class Messenger:
                 if ftype == _FRAME_ACK:
                     conn._handle_ack(struct.unpack("<Q", payload)[0])
                     continue
+                if ftype == _FRAME_MSG_Z:
+                    alen = payload[0]
+                    algo = payload[1:1 + alen].decode()
+                    (raw_len,) = struct.unpack_from("<I", payload,
+                                                    1 + alen)
+                    if raw_len > max_len or raw_len < 1:
+                        # ms_max_frame_len bounds the INFLATED size too:
+                        # a lying header cannot make us allocate beyond
+                        # it (decompression-bomb guard)
+                        raise OSError(
+                            f"bad inflated frame length ({raw_len})")
+                    comp = self._wire_decomp.get(algo)
+                    if comp is None:
+                        from ..compressor import Compressor
+
+                        comp = self._wire_decomp[algo] = \
+                            Compressor.create(algo)
+                    z = payload[5 + alen:]
+                    payload = comp.decompress_bounded(z, raw_len) \
+                        if hasattr(comp, "decompress_bounded") \
+                        else comp.decompress(z)
+                    if len(payload) != raw_len:
+                        raise OSError(
+                            "inflated frame length mismatch "
+                            f"({len(payload)} != declared {raw_len})")
                 msg = decode_message(payload)
                 with conn._session.lock:
                     if conn._closed or sock is not conn.sock:

@@ -229,3 +229,206 @@ class TestMessenger:
         finally:
             client.shutdown()
             server.shutdown()
+
+
+class TestWireCompression:
+    """On-wire frame compression (reference: ProtocolV2 compression
+    frames gated by the sender's ms_osd_compress_* conf)."""
+
+    def _pair(self, send_comp: str, recv_comp: str = "none"):
+        from ceph_tpu.common.context import CephContext
+        from ceph_tpu.msg import Dispatcher, Messenger
+
+        got = []
+
+        class Sink(Dispatcher):
+            def ms_dispatch(self, conn, msg):
+                got.append(msg)
+                return True
+
+        rc = CephContext("recv")
+        rc.conf.set("ms_compress", recv_comp)
+        rx = Messenger.create(rc, "rx")
+        rx.add_dispatcher(Sink())
+        addr = rx.bind(("127.0.0.1", 0))
+        rx.start()
+        sc = CephContext("send")
+        sc.conf.set("ms_compress", send_comp)
+        tx = Messenger.create(sc, "tx")
+        tx.start()
+        return tx, rx, addr, got
+
+    def test_large_frames_compress_and_roundtrip(self):
+        import time
+
+        from ceph_tpu.mon.messages import MMonCommand
+
+        tx, rx, addr, got = self._pair("zlib")
+        try:
+            conn = tx.connect(addr)
+            big = "A" * 200_000  # wildly compressible payload
+            conn.send_message(MMonCommand(tid=1, cmd={"blob": big}))
+            deadline = time.monotonic() + 10
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert got and got[0].cmd["blob"] == big
+            assert tx.comp_frames_sent == 1, "big frame stayed raw"
+            # tiny frames stay raw (below ms_compress_min_size)
+            conn.send_message(MMonCommand(tid=2, cmd={"blob": "tiny"}))
+            deadline = time.monotonic() + 10
+            while len(got) < 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert len(got) == 2 and tx.comp_frames_sent == 1
+        finally:
+            tx.shutdown()
+            rx.shutdown()
+
+    def test_receiver_needs_no_conf(self):
+        """Decompression is frame-driven: a receiver with compression
+        off still reads compressed frames (sender-side knob only)."""
+        import time
+
+        from ceph_tpu.mon.messages import MMonCommand
+
+        tx, rx, addr, got = self._pair("zlib", recv_comp="none")
+        try:
+            conn = tx.connect(addr)
+            conn.send_message(MMonCommand(tid=1, cmd={"blob": "B" * 50000}))
+            deadline = time.monotonic() + 10
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert got and got[0].cmd["blob"] == "B" * 50000
+        finally:
+            tx.shutdown()
+            rx.shutdown()
+
+    def test_incompressible_frames_stay_raw(self):
+        import os
+        import time
+
+        from ceph_tpu.mon.messages import MMonCommand
+        from ceph_tpu.osd.messages import pack_data
+
+        tx, rx, addr, got = self._pair("zlib")
+        try:
+            conn = tx.connect(addr)
+            noise = pack_data(os.urandom(100_000))  # b64 of random bytes
+            conn.send_message(MMonCommand(tid=1, cmd={"blob": noise}))
+            deadline = time.monotonic() + 10
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert got and got[0].cmd["blob"] == noise
+            # b64 noise barely compresses; zlib may still shave a few
+            # percent, so just assert integrity here — the raw-stays-raw
+            # contract is covered by the tiny-frame case above
+        finally:
+            tx.shutdown()
+            rx.shutdown()
+
+
+@pytest.mark.cluster
+def test_cluster_runs_fully_compressed():
+    """A whole cluster with ms_compress=zlib on every messenger: EC
+    writes (big sub-op frames), degraded reads, and recovery all ride
+    compressed wires — with cephx signing on top (the auth tag covers
+    the compressed body)."""
+    from ceph_tpu.auth import generate_secret
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    with LocalCluster(
+        n_mons=1, n_osds=4,
+        conf_overrides={
+            "ms_compress": "zlib",
+            "ms_compress_min_size": 1024,
+            "auth_cluster_required": "cephx",
+            "auth_shared_secret": generate_secret(),
+        },
+    ) as c:
+        c.create_ec_pool("zec", k=2, m=1)
+        io = c.client().open_ioctx("zec")
+        blob = b"compress every wire " * 2000
+        for i in range(4):
+            io.write_full(f"z{i}", blob)
+        for i in range(4):
+            assert io.read(f"z{i}") == blob
+        c.kill_osd(3)
+        c.mark_osd_down_out(3)
+        assert io.read("z0") == blob  # degraded decode over compressed wires
+        c.revive_osd(3)
+        c.mark_osd_in_up(3)
+        c.wait_clean("zec", timeout=60)
+        sent = sum(o.messenger.comp_frames_sent for o in c.osds.values())
+        assert sent > 0, "no frame ever compressed"
+
+
+def test_decompression_bomb_rejected():
+    """A frame whose declared inflated size exceeds ms_max_frame_len —
+    or whose stream inflates past its declaration — must be rejected
+    before the allocation, killing the connection, not the process."""
+    import struct
+    import time
+    import zlib
+
+    from ceph_tpu.common.context import CephContext
+    from ceph_tpu.common.crc32c import crc32c
+    from ceph_tpu.msg import Dispatcher, Messenger
+
+    got = []
+
+    class Sink(Dispatcher):
+        def ms_dispatch(self, conn, msg):
+            got.append(msg)
+            return True
+
+    rc = CephContext("recv")
+    rc.conf.set("ms_max_frame_len", 1 << 20)
+    rx = Messenger.create(rc, "rx")
+    rx.add_dispatcher(Sink())
+    addr = rx.bind(("127.0.0.1", 0))
+    rx.start()
+    try:
+        import socket as s
+
+        # hand-craft a compressed frame declaring 512 MiB inflated
+        z = zlib.compress(b"\x00" * 1024)
+        body = (bytes([2, 4]) + b"zlib"
+                + struct.pack("<I", 512 << 20) + z)
+        frame = struct.pack("<II", len(body), crc32c(body)) + body
+        sk = s.create_connection(addr, timeout=5)
+        sk.sendall(frame)
+        # connection must die (receiver refuses), nothing dispatched
+        sk.settimeout(5)
+        try:
+            assert sk.recv(1) == b""  # FIN
+        except ConnectionResetError:
+            pass  # RST: equally dead
+        sk.close()
+        assert not got
+        # and a LYING header (small declaration, bigger stream) dies too
+        z2 = zlib.compress(b"\x00" * 100_000)
+        body2 = (bytes([2, 4]) + b"zlib"
+                 + struct.pack("<I", 10) + z2)
+        frame2 = struct.pack("<II", len(body2), crc32c(body2)) + body2
+        sk2 = s.create_connection(addr, timeout=5)
+        sk2.sendall(frame2)
+        sk2.settimeout(5)
+        try:
+            assert sk2.recv(1) == b""
+        except ConnectionResetError:
+            pass
+        sk2.close()
+        assert not got
+    finally:
+        rx.shutdown()
+
+
+def test_non_zlib_wire_compression_needs_force():
+    import pytest as _pytest
+
+    from ceph_tpu.common.context import CephContext
+    from ceph_tpu.msg import Messenger
+
+    cct = CephContext("t")
+    cct.conf.set("ms_compress", "zstd")
+    with _pytest.raises(ValueError, match="ms_compress_force"):
+        Messenger.create(cct, "tx")
