@@ -123,6 +123,14 @@ def _try_register_optional() -> None:
                     return zstandard.ZstdDecompressor().decompress(bytes(data))
                 except Exception as e:
                     raise CompressorError(f"zstd: {e}") from e
+
+            def decompress_bounded(self, data: bytes,
+                                   max_out: int) -> bytes:
+                try:
+                    return zstandard.ZstdDecompressor().decompress(
+                        bytes(data), max_output_size=max_out)
+                except Exception as e:
+                    raise CompressorError(f"zstd: {e}") from e
     except ImportError:
         pass
     try:

@@ -43,6 +43,45 @@ from .messages import (
 ROOT_INO = 1
 
 
+def assemble_rank_rows(io, now: float | None = None) -> list[dict]:
+    """MDS rank table rows from the metadata pool's registry/beacons/
+    subtree map — ONE assembler shared by `ceph fs status` and the
+    dashboard's /api/fs so the two surfaces cannot drift (the same
+    sharing pattern as status_module.assemble_osd_rows)."""
+    if now is None:
+        now = time.time()
+    try:
+        ranks = {int(k): tuple(json.loads(v))
+                 for k, v in (io.omap_get("mds_ranks") or {}).items()}
+    except IOError:
+        return []
+    try:
+        beacons = {int(k): json.loads(v)
+                   for k, v in (io.omap_get("mds_beacons") or {}).items()}
+    except IOError:
+        beacons = {}  # beacons unreadable must not hide live ranks
+    try:
+        subs = json.loads(io.read("mds_subtrees"))
+    except (IOError, ValueError):
+        subs = {}
+    rows = []
+    for rank in sorted(ranks):
+        if rank not in beacons:
+            state = "no-beacon"
+        elif now - beacons[rank] <= MDSDaemon.BEACON_GRACE:
+            state = "active"
+        else:
+            state = f"stale({now - beacons[rank]:.0f}s)"
+        host, port = ranks[rank]
+        rows.append({
+            "rank": rank, "state": state, "addr": f"{host}:{port}",
+            "subtrees": sorted(
+                f"/{n}" for n, o in subs.items() if int(o) == rank
+            ),
+        })
+    return rows
+
+
 class MDSDaemon(Dispatcher):
     """Active MDS rank (reference: src/mds/MDSDaemon.cc + MDSRank.cc).
 

@@ -40,6 +40,10 @@ class MgrDaemon(Dispatcher):
         self._modules: dict[str, MgrModule] = {}
         self._threads: list[threading.Thread] = []
         self.addr: tuple[str, int] | None = None
+        self._mon_addrs = mon_addrs
+        self._rados = None  # lazy module-facing RADOS client
+        self._rados_lock = threading.Lock()
+        self._closed = False
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -81,11 +85,21 @@ class MgrDaemon(Dispatcher):
             self.cct.dout("mgr", 0, f"mgr module {mod.NAME} died: {e!r}")
 
     def shutdown(self) -> None:
+        with self._rados_lock:
+            self._closed = True  # no module may lazily mint a client now
         for mod in self._modules.values():
             try:
                 mod.shutdown()
             except Exception:
                 pass
+        # rados AFTER the modules that reach through it
+        with self._rados_lock:
+            if self._rados is not None:
+                try:
+                    self._rados.shutdown()
+                except Exception:
+                    pass
+                self._rados = None
         self.mc.shutdown()
         self.messenger.shutdown()
         for t in self._threads:
@@ -118,6 +132,27 @@ class MgrDaemon(Dispatcher):
                 for d, r in self._reports.items()
                 if now - r["ts"] <= max_age
             }
+
+    def rados_ioctx(self, pool: str):
+        """Pool I/O handle for modules (the reference mgr holds its own
+        librados instance modules reach through MgrModule.rados).
+        Serialized + fail-safe: module HTTP threads race here, a failed
+        connect must not leak its half-started client, and nothing may
+        lazily mint a client after shutdown."""
+        with self._rados_lock:
+            if self._closed:
+                raise IOError("mgr shutting down")
+            if self._rados is None:
+                from ..client.rados import Rados
+
+                r = Rados(self.cct, self._mon_addrs, name="mgr-rados")
+                try:
+                    r.connect(timeout=10.0)
+                except Exception:
+                    r.shutdown()
+                    raise
+                self._rados = r
+            return self._rados.open_ioctx(pool)
 
     def latest_reports_with_ts(self) -> dict:
         """{daemon: (arrival_ts, counters)} — rate computations must

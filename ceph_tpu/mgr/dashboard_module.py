@@ -10,6 +10,8 @@ Endpoints (JSON unless noted):
     /api/osd              per-OSD up/in/pgs/objects rows
     /api/pool             per-pool type/size/pg_num/bytes
     /api/perf             latest per-daemon perf counter snapshots
+    /api/iostat           cluster + per-daemon IO rates (iostat module)
+    /api/fs               MDS ranks, beacon liveness, subtree pins
 
 Read-only by design: mutations belong to the `ceph` CLI / mon command
 surface (the reference dashboard's write paths wrap the same mon
@@ -71,6 +73,23 @@ class DashboardModule(MgrModule):
             })
         return rows
 
+    def iostat(self) -> dict:
+        mod = self.mgr._modules.get("iostat")
+        if mod is None:
+            return {"error": "iostat module not hosted"}
+        return mod.sample()
+
+    def fs_ranks(self) -> list[dict]:
+        """MDS rank table (the `ceph fs status` data, JSON) via the
+        shared assembler in fs/mds.py."""
+        from ..fs.mds import assemble_rank_rows
+
+        try:
+            io = self.mgr.rados_ioctx("cephfs_meta")
+        except (IOError, KeyError):
+            return []
+        return assemble_rank_rows(io)
+
     def _page(self) -> str:
         h = self.health()
         # the mon nests: {"health": {"status": ..., "checks": {...}}, ...}
@@ -128,6 +147,12 @@ class DashboardModule(MgrModule):
                     elif path == "/api/perf":
                         body = json.dumps(
                             module.get_all_perf_counters()).encode()
+                        ctype = "application/json"
+                    elif path == "/api/iostat":
+                        body = json.dumps(module.iostat()).encode()
+                        ctype = "application/json"
+                    elif path == "/api/fs":
+                        body = json.dumps(module.fs_ranks()).encode()
                         ctype = "application/json"
                     else:
                         self.send_error(404)

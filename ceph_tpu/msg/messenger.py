@@ -728,9 +728,15 @@ class Messenger:
                         comp = self._wire_decomp[algo] = \
                             Compressor.create(algo)
                     z = payload[5 + alen:]
-                    payload = comp.decompress_bounded(z, raw_len) \
-                        if hasattr(comp, "decompress_bounded") \
-                        else comp.decompress(z)
+                    if not hasattr(comp, "decompress_bounded"):
+                        # an unbounded inflate would defeat the bomb
+                        # guard (the stream could exceed its declared
+                        # size before any post-check): only algorithms
+                        # with a bounded inflate may ride the wire
+                        raise OSError(
+                            f"wire compression {algo!r} lacks bounded "
+                            f"inflate")
+                    payload = comp.decompress_bounded(z, raw_len)
                     if len(payload) != raw_len:
                         raise OSError(
                             "inflated frame length mismatch "

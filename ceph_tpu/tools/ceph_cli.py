@@ -101,49 +101,23 @@ def _fs_status(mons, out) -> int:
     """`ceph fs status` analog: active MDS ranks, beacon liveness, and
     subtree pins.  Upstream routes this through the mgr; here the rank
     registry/beacons/subtree map live in the metadata pool (the MDSMap
-    role collapsed to pool state, see fs/mds.py), so the CLI reads them
-    through a rados client directly."""
-    import json as _json
-    import time as _time
-
+    role collapsed to pool state, see fs/mds.py), read through the
+    SHARED assembler the dashboard's /api/fs also uses."""
     from ..client.rados import Rados
-    from ..fs.mds import MDSDaemon
+    from ..fs.mds import assemble_rank_rows
 
     r = Rados(CephContext("client.ceph-cli"), mons)
     try:
         r.connect(timeout=10.0)
         io = r.open_ioctx("cephfs_meta")
-        try:
-            ranks = {int(k): tuple(_json.loads(v))
-                     for k, v in (io.omap_get("mds_ranks") or {}).items()}
-        except IOError:
-            ranks = {}
-        try:
-            beacons = {int(k): _json.loads(v)
-                       for k, v in (io.omap_get("mds_beacons") or {}).items()}
-        except IOError:
-            beacons = {}  # beacons unreadable must not hide live ranks
-        try:
-            subs = _json.loads(io.read("mds_subtrees"))
-        except (IOError, ValueError):
-            subs = {}
-        now = _time.time()
+        rows = assemble_rank_rows(io)
         print(f"{'RANK':>4}  {'STATE':<8} {'ADDR':<22} SUBTREES", file=out)
-        for rank in sorted(ranks):
-            if rank not in beacons:
-                state = "no-beacon"
-            else:
-                age = now - beacons[rank]
-                state = "active" if age <= MDSDaemon.BEACON_GRACE else \
-                    f"stale({age:.0f}s)"
-            pinned = sorted(
-                f"/{n}" for n, owner in subs.items() if int(owner) == rank
-            )
-            default = ["(root + unpinned)"] if rank == 0 else []
-            host, port = ranks[rank]
-            print(f"{rank:>4}  {state:<8} {host}:{port:<16} "
-                  f"{' '.join(default + pinned)}", file=out)
-        if not ranks:
+        for row in rows:
+            default = ["(root + unpinned)"] if row["rank"] == 0 else []
+            print(f"{row['rank']:>4}  {row['state']:<8} "
+                  f"{row['addr']:<22} "
+                  f"{' '.join(default + row['subtrees'])}", file=out)
+        if not rows:
             print("no active MDS ranks", file=out)
         return 0
     finally:

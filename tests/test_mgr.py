@@ -250,3 +250,33 @@ def test_iostat_module_reports_rates(mgr_cluster):
         if s2["ops_per_s"] == 0:
             break
         assert time.time() < deadline, s2
+
+
+def test_dashboard_iostat_and_fs_endpoints():
+    """New dashboard endpoints: /api/iostat (rates) and /api/fs (MDS
+    rank table) — own cluster so the FS pools exist."""
+    import json as _json
+    import urllib.request
+
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    with LocalCluster(
+        n_mons=1, n_osds=3, with_mgr=True, with_mds=True,
+        conf_overrides={
+            "mgr_report_interval": 0.5,
+            "mgr_modules": "status,dashboard,iostat",
+        },
+    ) as c:
+        url = c.mgr.module("dashboard").url
+        body = urllib.request.urlopen(url + "api/iostat", timeout=10).read()
+        s = _json.loads(body)
+        assert "ops_per_s" in s and "daemons" in s
+        deadline = time.time() + 15
+        while True:
+            body = urllib.request.urlopen(url + "api/fs", timeout=10).read()
+            rows = _json.loads(body)
+            if rows and rows[0]["state"] == "active":
+                break
+            assert time.time() < deadline, rows
+            time.sleep(0.5)
+        assert rows[0]["rank"] == 0
