@@ -400,10 +400,10 @@ class FSClient(Dispatcher):
             # link changes the TARGET inode's nlink too, so cached
             # lookups of any of its paths would go stale
             self._dcache.clear()
-        elif op == "setattr":
-            # setattr changes no dentries — evict only entries caching the
-            # touched inode so data-write size/mtime writebacks don't nuke
-            # every cached path lookup
+        elif op in ("setattr", "setxattr"):
+            # attr ops change no dentries — evict only entries caching
+            # the touched inode so writebacks/tagging don't nuke every
+            # cached path lookup
             ino = args.get("ino")
             with self._lock:
                 for key in [
@@ -526,11 +526,52 @@ class FSClient(Dispatcher):
         if inode["type"] != "dir":
             raise NotADirectoryError(path)
         out = self._request("readdir", {"ino": inode["ino"]})
-        return {n: self._overlay_dirty(i) if isinstance(i, dict) else i
-                for n, i in (out or {}).items()}
+        return {
+            n: self._public_inode(self._overlay_dirty(i))
+            if isinstance(i, dict) else i
+            for n, i in (out or {}).items()
+        }
+
+    def setxattr(self, path: str, name: str, value: bytes) -> None:
+        """User extended attribute (reference: Client::setxattr)."""
+        import base64
+
+        inode = self._resolve(path)
+        self._request("setxattr", {
+            "ino": inode["ino"], "name": name,
+            "val": base64.b64encode(bytes(value)).decode(),
+        })  # _request evicts this ino's dentry-cache entries
+
+    def getxattr(self, path: str, name: str) -> bytes:
+        xattrs = self.listxattr(path)
+        if name not in xattrs:
+            raise FSError(f"no xattr {name!r} on {path!r}")
+        return xattrs[name]
+
+    def listxattr(self, path: str) -> dict:
+        import base64
+
+        inode = self._resolve(path)
+        raw = self._request("getxattrs", {"ino": inode["ino"]})
+        return {n: base64.b64decode(v) for n, v in (raw or {}).items()}
+
+    def removexattr(self, path: str, name: str) -> None:
+        inode = self._resolve(path)
+        self._request("setxattr", {
+            "ino": inode["ino"], "name": name, "val": None,
+        })  # _request evicts this ino's dentry-cache entries
+
+    @staticmethod
+    def _public_inode(inode: dict) -> dict:
+        """Inode view for stat/listdir: the embedded xattrs dict carries
+        WIRE-encoded (b64) values — the xattr surface is
+        getxattr/listxattr, which decode; leaking the raw map would hand
+        consumers encoded junk (review r5)."""
+        return {k: v for k, v in inode.items() if k != "xattrs"}
 
     def stat(self, path: str) -> dict:
-        return self._overlay_dirty(self._resolve(path))
+        return self._public_inode(
+            self._overlay_dirty(self._resolve(path)))
 
     def open(self, path: str, create: bool = False,
              layout: dict | None = None, want: str = "rw") -> FileHandle:

@@ -530,6 +530,22 @@ class MDSDaemon(Dispatcher):
                     if ev.get(f) is not None:
                         inode[f] = ev[f]
                 self._dirty.add(bp[0])
+        elif kind == "setxattr":
+            # user extended attributes on the inode (reference:
+            # Server::handle_client_setxattr — xattrs live in the
+            # CInode, journaled like any metadata update).  val None
+            # removes (removexattr).
+            ino = ev["ino"]
+            bp = self.backptr.get(ino)
+            if bp is not None:
+                inode = self.dirs[bp[0]][bp[1]]
+                xattrs = inode.setdefault("xattrs", {})
+                if ev["val"] is None:
+                    xattrs.pop(ev["name"], None)
+                else:
+                    xattrs[ev["name"]] = ev["val"]
+                self._mark(bp[0], bp[1], inode)
+                self._dirty.add(bp[0])
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -1200,6 +1216,27 @@ class MDSDaemon(Dispatcher):
             # and other sessions' cached attrs are stale now
             self._invalidate_readers(a["ino"], but=session)
             return 0, self._inode_of(a["ino"])
+        if op == "setxattr":
+            # value b64 (or None to remove); root has no dentry to carry
+            # xattrs, like the reference refuses most root setattrs here
+            ino = a["ino"]
+            if ino == ROOT_INO or self._inode_of(ino) is None:
+                return -2, None
+            if a.get("val") is None and a["name"] not in (
+                self._inode_of(ino).get("xattrs") or {}
+            ):
+                return -61, None  # ENODATA: removing a missing xattr
+            self._commit({"e": "setxattr", "ino": ino,
+                          "name": a["name"], "val": a.get("val")})
+            # other sessions' cached attrs are stale now (same contract
+            # as setattr — review r5)
+            self._invalidate_readers(ino, but=session)
+            return 0, self._inode_of(ino)
+        if op == "getxattrs":
+            inode = self._inode_of(a["ino"])
+            if inode is None:
+                return -2, None
+            return 0, dict(inode.get("xattrs") or {})
         if op == "open":
             inode = self._inode_of(a["ino"])
             if inode is None:

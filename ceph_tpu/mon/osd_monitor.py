@@ -199,6 +199,27 @@ class OSDMonitor:
             return 0, [p.name for p in self.osdmap.pools.values()]
         if prefix in ("osd down", "osd out", "osd in"):
             return self._cmd_osd_state(prefix.split()[1], cmd)
+        if prefix in ("osd reweight", "osd primary-affinity"):
+            # reference: OSDMonitor prepare_command OSD_REWEIGHT /
+            # OSD_PRIMARY_AFFINITY — 0.0..1.0 stored as 16.16 fixed
+            try:
+                osd = int(cmd.get("id"))
+                w = float(cmd.get("weight"))
+            except (TypeError, ValueError):
+                return -22, "need id and weight"
+            if not (0.0 <= w <= 1.0):
+                return -22, f"weight {w} out of [0, 1]"
+            if self.osdmap is None or not (0 <= osd < self.osdmap.max_osd):
+                return -22, f"no osd.{osd}"
+            m = self._pending()
+            fixed = int(round(w * 0x10000))
+            if prefix == "osd reweight":
+                m.osd_weight[osd] = fixed
+            else:
+                m.osd_primary_affinity[osd] = fixed
+            what = prefix.split()[1]
+            return (0, f"{what} osd.{osd} to {w}") \
+                if self._propose_map(m) else (-110, "proposal timed out")
         if prefix in ("osd set", "osd unset"):
             flag = cmd.get("key", "")
             if flag not in ("noout", "nodown", "noup"):

@@ -74,6 +74,10 @@ def _build_command(words: list[str]) -> dict:
     if words[:2] == ["osd", "down"] or words[:2] == ["osd", "out"] or \
             words[:2] == ["osd", "in"]:
         return {"prefix": f"osd {words[1]}", "id": int(words[2])}
+    if words[:2] == ["osd", "reweight"] or \
+            words[:2] == ["osd", "primary-affinity"]:
+        return {"prefix": f"osd {words[1]}", "id": int(words[2]),
+                "weight": float(words[3])}
     if words[:2] == ["osd", "set"] or words[:2] == ["osd", "unset"]:
         return {"prefix": f"osd {words[1]}", "key": words[2]}
     if words[:2] == ["osd", "erasure-code-profile"] and words[2] == "get":

@@ -360,3 +360,54 @@ class TestHardlinks:
         assert f2.read_file("/hl_j2") == b"journaled"
         assert f2.stat("/hl_j2").get("nlink", 1) == 1
         f2.unmount()
+
+
+@pytest.mark.cluster
+def test_fs_xattrs_roundtrip_and_survive_failover(cluster):
+    """User xattrs on files and dirs (reference: Client::setxattr /
+    Server::handle_client_setxattr): set/get/list/remove, journaled so
+    they survive an MDS crash."""
+    fs = cluster.fs_client("client.xattr")
+    try:
+        fs.mkdir("/xa")
+        with fs.open("/xa/f", create=True) as f:
+            f.write(b"body")
+        fs.setxattr("/xa/f", "user.color", b"teal")
+        fs.setxattr("/xa/f", "user.rank", b"7")
+        fs.setxattr("/xa", "user.dirmeta", b"on a directory")
+        assert fs.getxattr("/xa/f", "user.color") == b"teal"
+        assert sorted(fs.listxattr("/xa/f")) == ["user.color", "user.rank"]
+        assert fs.getxattr("/xa", "user.dirmeta") == b"on a directory"
+        fs.removexattr("/xa/f", "user.rank")
+        assert sorted(fs.listxattr("/xa/f")) == ["user.color"]
+        import pytest as _pytest
+
+        with _pytest.raises(OSError):
+            fs.removexattr("/xa/f", "user.nope")  # ENODATA
+        # journaled: a crashed MDS replays them
+        cluster.restart_mds()
+        assert fs.getxattr("/xa/f", "user.color") == b"teal"
+        assert fs.getxattr("/xa", "user.dirmeta") == b"on a directory"
+    finally:
+        fs.unmount()
+
+
+@pytest.mark.cluster
+def test_xattrs_not_leaked_in_stat_and_cross_client_fresh(cluster):
+    """stat/listdir never expose the wire-encoded xattr map, and a
+    second client sees xattr updates (reader invalidation)."""
+    fs_a = cluster.fs_client("client.xa-a")
+    fs_b = cluster.fs_client("client.xa-b")
+    try:
+        fs_a.mkdir("/xleak")
+        with fs_a.open("/xleak/f", create=True) as f:
+            f.write(b"x")
+        fs_a.setxattr("/xleak/f", "user.tag", b"v1")
+        assert "xattrs" not in fs_a.stat("/xleak/f")
+        assert "xattrs" not in fs_a.listdir("/xleak")["f"]
+        assert fs_b.getxattr("/xleak/f", "user.tag") == b"v1"
+        fs_a.setxattr("/xleak/f", "user.tag", b"v2")
+        assert fs_b.getxattr("/xleak/f", "user.tag") == b"v2"
+    finally:
+        fs_a.unmount()
+        fs_b.unmount()

@@ -404,3 +404,37 @@ class TestMonStorePersistence:
         finally:
             client2.shutdown()
             mon2.shutdown()
+
+
+@pytest.mark.cluster
+def test_osd_reweight_and_primary_affinity():
+    """`osd reweight` thins placements probabilistically (is_out) and
+    `osd primary-affinity` steers primary selection — both 16.16 fixed
+    in the map (reference: OSDMonitor prepare_command)."""
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    with LocalCluster(n_mons=1, n_osds=4) as c:
+        rv, res = c.mon_command(
+            {"prefix": "osd reweight", "id": 2, "weight": 0.25})
+        assert rv == 0, res
+        rv, res = c.mon_command(
+            {"prefix": "osd primary-affinity", "id": 3, "weight": 0.0})
+        assert rv == 0, res
+        m = c._leader().osdmon.osdmap
+        assert m.osd_weight[2] == 0x4000
+        assert m.osd_primary_affinity[3] == 0
+        # affinity 0: osd.3 should never be primary while others exist
+        pool_id = None
+        c.create_replicated_pool("aff", size=2)
+        m = c._leader().osdmon.osdmap
+        pool_id = next(i for i, p in m.pools.items() if p.name == "aff")
+        primaries = set()
+        for ps in range(m.pools[pool_id].pg_num):
+            _u, _up, _a, pri = m.pg_to_up_acting_osds(pool_id, ps)
+            primaries.add(pri)
+        assert 3 not in primaries
+        # out-of-range weights rejected
+        assert c.mon_command(
+            {"prefix": "osd reweight", "id": 1, "weight": 1.5})[0] == -22
+        assert c.mon_command(
+            {"prefix": "osd reweight", "id": 99, "weight": 0.5})[0] == -22
