@@ -339,6 +339,10 @@ class Objecter(Dispatcher):
                 hard += 1
                 self._refresh_map(m)
                 continue
+            if rep.retval == -122:
+                # EDQUOT: the pool is over quota — final, no retry (only
+                # deletes or a raised quota can clear it)
+                return rep
             if rep.retval == -11:  # not enough shards yet; let it settle
                 last = rep.result
                 if _time.monotonic() >= eagain_deadline:

@@ -138,8 +138,11 @@ def default_options() -> OptionTable:
                    min=0.1, runtime=True),
             Option("mgr_tick_interval", float, 2.0, "mgr tick seconds",
                    min=0.05),
-            Option("mgr_modules", str, "status,prometheus,balancer,iostat",
+            Option("mgr_modules", str,
+                   "status,prometheus,balancer,iostat,quota",
                    "comma-separated modules the mgr hosts"),
+            Option("mgr_quota_interval", float, 2.0,
+                   "seconds between pool-quota enforcement passes", min=0.1),
             Option("mgr_prometheus_port", int, 0,
                    "prometheus exporter port (0 = ephemeral)", min=0),
             Option("mgr_balancer_interval", float, 10.0,

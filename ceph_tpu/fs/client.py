@@ -543,10 +543,14 @@ class FSClient(Dispatcher):
         })  # _request evicts this ino's dentry-cache entries
 
     def getxattr(self, path: str, name: str) -> bytes:
-        xattrs = self.listxattr(path)
-        if name not in xattrs:
+        import base64
+
+        inode = self._resolve(path)
+        raw = self._request("getxattrs",
+                            {"ino": inode["ino"], "name": name})
+        if name not in (raw or {}):
             raise FSError(f"no xattr {name!r} on {path!r}")
-        return xattrs[name]
+        return base64.b64decode(raw[name])
 
     def listxattr(self, path: str) -> dict:
         import base64

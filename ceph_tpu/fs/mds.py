@@ -1220,10 +1220,11 @@ class MDSDaemon(Dispatcher):
             # value b64 (or None to remove); root has no dentry to carry
             # xattrs, like the reference refuses most root setattrs here
             ino = a["ino"]
-            if ino == ROOT_INO or self._inode_of(ino) is None:
+            inode = None if ino == ROOT_INO else self._inode_of(ino)
+            if inode is None:
                 return -2, None
             if a.get("val") is None and a["name"] not in (
-                self._inode_of(ino).get("xattrs") or {}
+                inode.get("xattrs") or {}
             ):
                 return -61, None  # ENODATA: removing a missing xattr
             self._commit({"e": "setxattr", "ino": ino,
@@ -1236,7 +1237,11 @@ class MDSDaemon(Dispatcher):
             inode = self._inode_of(a["ino"])
             if inode is None:
                 return -2, None
-            return 0, dict(inode.get("xattrs") or {})
+            xattrs = dict(inode.get("xattrs") or {})
+            if a.get("name") is not None:  # single-key fetch
+                name = a["name"]
+                return 0, ({name: xattrs[name]} if name in xattrs else {})
+            return 0, xattrs
         if op == "open":
             inode = self._inode_of(a["ino"])
             if inode is None:

@@ -21,6 +21,7 @@ from . import balancer_module  # noqa: F401
 from . import dashboard_module  # noqa: F401
 from . import devicehealth_module  # noqa: F401
 from . import iostat_module  # noqa: F401
+from . import quota_module  # noqa: F401
 from . import pg_autoscaler_module  # noqa: F401
 from . import prometheus_module  # noqa: F401
 from . import status_module  # noqa: F401

@@ -230,6 +230,37 @@ class OSDMonitor:
                 if self._propose_map(m) else (-110, "proposal timed out")
         if prefix == "osd pool set":
             return self._cmd_pool_set(cmd)
+        if prefix == "osd pool set-quota":
+            return self._cmd_pool_quota(cmd)
+        if prefix == "osd pool get-quota":
+            name = cmd.get("name", "")
+            pool = next((p for p in self.osdmap.pools.values()
+                         if p.name == name), None) if self.osdmap else None
+            if pool is None:
+                return -2, f"no pool {name!r}"
+            return 0, {"quota_max_bytes": pool.quota_max_bytes,
+                       "quota_max_objects": pool.quota_max_objects,
+                       "full": "full_quota" in pool.flags}
+        if prefix == "osd pool quota-flag":
+            # internal: the mgr's quota loop flips FULL_QUOTA when stats
+            # cross/clear the quota (reference: the mon's own stats-driven
+            # pool FULL flag; our stats live in the mgr)
+            name = cmd.get("name", "")
+            m = self._pending()
+            pool = next((p for p in m.pools.values() if p.name == name),
+                        None)
+            if pool is None:
+                return -2, f"no pool {name!r}"
+            want = bool(int(cmd.get("full", 0)))
+            have = "full_quota" in pool.flags
+            if want == have:
+                return 0, "unchanged"
+            if want:
+                pool.flags.append("full_quota")
+            else:
+                pool.flags.remove("full_quota")
+            return (0, f"full_quota={'set' if want else 'cleared'}") \
+                if self._propose_map(m) else (-110, "proposal timed out")
         if prefix in ("osd pool mksnap", "osd pool rmsnap"):
             return self._cmd_pool_snap(prefix.endswith("mksnap"), cmd)
         if prefix == "osd pg-upmap-items":
@@ -319,6 +350,33 @@ class OSDMonitor:
         if not self._propose_map(m):
             return -110, "proposal timed out"
         return 0, {"service": service, "gen": new_gen}
+
+    def _cmd_pool_quota(self, cmd: dict) -> tuple[int, object]:
+        """`osd pool set-quota <pool> max_bytes|max_objects <val>`
+        (reference: OSDMonitor prepare_command OSD_POOL_SET_QUOTA);
+        0 clears."""
+        name = cmd.get("name", "")
+        fieldn = cmd.get("field", "")
+        if fieldn not in ("max_bytes", "max_objects"):
+            return -22, f"field {fieldn!r}: want max_bytes|max_objects"
+        try:
+            value = int(cmd.get("value"))
+        except (TypeError, ValueError):
+            return -22, "integer value required"
+        if value < 0:
+            return -22, f"quota {value} must be >= 0"
+        m = self._pending()
+        pool = next((p for p in m.pools.values() if p.name == name), None)
+        if pool is None:
+            return -2, f"no pool {name!r}"
+        setattr(pool, f"quota_{fieldn}", value)
+        if value == 0 and not (pool.quota_max_bytes
+                               or pool.quota_max_objects):
+            # clearing the last quota lifts a standing full flag
+            if "full_quota" in pool.flags:
+                pool.flags.remove("full_quota")
+        return (0, f"set quota_{fieldn} = {value} on {name!r}") \
+            if self._propose_map(m) else (-110, "proposal timed out")
 
     def _cmd_pool_set(self, cmd: dict) -> tuple[int, object]:
         """`osd pool set <pool> <key> <value>` — pg_num/pgp_num/size

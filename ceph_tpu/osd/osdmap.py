@@ -105,6 +105,12 @@ class PGPool:
     # agent thresholds (reference: pg_pool_t::target_max_objects and the
     # TierAgentState full/evict effort derived from it)
     target_max_objects: int = 0
+    # pool quotas (reference: pg_pool_t::quota_max_bytes/objects + the
+    # FLAG_FULL_QUOTA the mon sets when stats cross them); `flags`
+    # carries pool flags, e.g. "full_quota"
+    quota_max_bytes: int = 0
+    quota_max_objects: int = 0
+    flags: list = field(default_factory=list)
 
     def __post_init__(self):
         if not self.pgp_num:
@@ -121,6 +127,11 @@ class PGPool:
             self.name = f"pool{self.pool_id}"
         # JSON round-trips dict keys as strings
         self.snaps = {int(k): v for k, v in (self.snaps or {}).items()}
+        # mutable fields must be COPIES: _pending()'s vars()/**kwargs
+        # round-trip would otherwise alias the committed map's lists and
+        # a failed proposal's mutation would leak into committed state
+        self.flags = list(self.flags or [])
+        self.tiers = list(self.tiers or [])
 
     def raw_pg_to_pps(self, ps: int) -> int:
         """reference: pg_pool_t::raw_pg_to_pps, FLAG_HASHPSPOOL branch —

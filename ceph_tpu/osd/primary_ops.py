@@ -225,6 +225,31 @@ class PrimaryOpsMixin:
         )
 
     def _execute_routed_op(self, pg, pool, acting, ps, msg) -> MOSDOpReply:
+        quota_pools = ["full_quota" in getattr(pool, "flags", ())]
+        if pool.tier_of >= 0 and self.osdmap is not None:
+            # a CACHE pool fronts its base: client writes redirected
+            # here must honor the BASE pool's quota or the overlay
+            # becomes a quota bypass (review r5)
+            base = self.osdmap.pools.get(pool.tier_of)
+            quota_pools.append(
+                base is not None
+                and "full_quota" in getattr(base, "flags", ())
+            )
+        if (
+            any(quota_pools)
+            and msg.op in MUTATING_OPS
+            and msg.op != "delete"  # deletes free space, always allowed
+            # internal tier traffic (flush/promote staging) moves bytes
+            # BETWEEN the tiers, bounded by the cache size — refusing it
+            # would wedge dirty objects in the cache forever
+            and not str(getattr(msg, "reqid", "") or "").startswith("tier.")
+        ):
+            # reference: PrimaryLogPG refuses writes on FLAG_FULL_QUOTA
+            # pools with -EDQUOT; the mgr's quota loop set the flag
+            return MOSDOpReply(
+                tid=msg.tid, retval=-122, epoch=self.my_epoch(),
+                result=f"pool {pool.name!r} quota exceeded (EDQUOT)",
+            )
         if msg.op == "write" and int(msg.off or 0) < 0:
             # reference: negative offsets are -EINVAL; Python slicing
             # would otherwise silently splice into the object's tail

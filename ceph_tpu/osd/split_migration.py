@@ -207,6 +207,7 @@ class SplitMigrationMixin:
         # must not delay them toward the failure-report threshold
         num_objects = 0
         pool_bytes: dict[int, int] = {}
+        pool_objects: dict[int, int] = {}
         try:
             coll_bytes = self.store.collections_bytes()  # one index pass
         except Exception:
@@ -219,15 +220,19 @@ class SplitMigrationMixin:
                 except ValueError:
                     pool_id = None
             try:
-                num_objects += sum(
+                n_here = sum(
                     1 for o in self.store.list_objects(cid)
                     if not o.startswith("_")
                 )
             except Exception:
                 continue
+            num_objects += n_here
             if pool_id is not None:
                 pool_bytes[pool_id] = (
                     pool_bytes.get(pool_id, 0) + coll_bytes.get(cid, 0)
+                )
+                pool_objects[pool_id] = (
+                    pool_objects.get(pool_id, 0) + n_here
                 )
         self.logger.set("numpg", num_pgs)
         try:
@@ -239,6 +244,9 @@ class SplitMigrationMixin:
                     stats={"num_pgs": num_pgs, "num_objects": num_objects,
                            "pool_bytes": {
                                str(k): v for k, v in pool_bytes.items()
+                           },
+                           "pool_objects": {
+                               str(k): v for k, v in pool_objects.items()
                            }},
                 )
             )

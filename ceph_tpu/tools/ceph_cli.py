@@ -74,6 +74,12 @@ def _build_command(words: list[str]) -> dict:
     if words[:2] == ["osd", "down"] or words[:2] == ["osd", "out"] or \
             words[:2] == ["osd", "in"]:
         return {"prefix": f"osd {words[1]}", "id": int(words[2])}
+    if words[:3] == ["osd", "pool", "set-quota"]:
+        # osd pool set-quota <pool> max_objects|max_bytes <val>
+        return {"prefix": "osd pool set-quota", "name": words[3],
+                "field": words[4], "value": int(words[5])}
+    if words[:3] == ["osd", "pool", "get-quota"]:
+        return {"prefix": "osd pool get-quota", "name": words[3]}
     if words[:2] == ["osd", "reweight"] or \
             words[:2] == ["osd", "primary-affinity"]:
         return {"prefix": f"osd {words[1]}", "id": int(words[2]),

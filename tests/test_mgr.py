@@ -280,3 +280,61 @@ def test_dashboard_iostat_and_fs_endpoints():
             assert time.time() < deadline, rows
             time.sleep(0.5)
         assert rows[0]["rank"] == 0
+
+
+def test_pool_quota_enforced_and_lifted():
+    """Pool quotas (reference: osd pool set-quota + FLAG_FULL_QUOTA):
+    the mgr's quota loop flags an over-quota pool, writes then refuse
+    with EDQUOT (deletes still allowed), and deleting under quota lifts
+    the flag."""
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    with LocalCluster(
+        n_mons=1, n_osds=3, with_mgr=True,
+        conf_overrides={"mgr_report_interval": 0.4,
+                        "mgr_quota_interval": 0.4},
+    ) as c:
+        c.create_replicated_pool("qp", size=2)
+        rv, res = c.mon_command({
+            "prefix": "osd pool set-quota", "name": "qp",
+            "field": "max_objects", "value": 5,
+        })
+        assert rv == 0, res
+        io = c.client().open_ioctx("qp")
+        for i in range(5):
+            io.write_full(f"q{i}", b"x" * 1000)
+        deadline = time.time() + 25
+        while True:
+            m = c._leader().osdmon.osdmap
+            pool = next(p for p in m.pools.values() if p.name == "qp")
+            if "full_quota" in pool.flags:
+                break
+            assert time.time() < deadline, "pool never flagged full"
+            time.sleep(0.3)
+        # writes refuse FAST with EDQUOT once OSDs see the flag
+        deadline = time.time() + 15
+        while True:
+            try:
+                io.write_full("overflow", b"y")
+            except IOError as e:
+                assert "-122" in str(e) or "EDQUOT" in str(e) or \
+                    "quota" in str(e).lower(), e
+                break
+            assert time.time() < deadline, "write never hit the quota"
+            time.sleep(0.3)
+        rv, res = c.mon_command(
+            {"prefix": "osd pool get-quota", "name": "qp"})
+        assert rv == 0 and res["full"] is True
+        # deletes are allowed and lift the flag once back under quota
+        for i in range(5):
+            io.remove(f"q{i}")
+        deadline = time.time() + 25
+        while True:
+            m = c._leader().osdmon.osdmap
+            pool = next(p for p in m.pools.values() if p.name == "qp")
+            if "full_quota" not in pool.flags:
+                break
+            assert time.time() < deadline, "flag never lifted"
+            time.sleep(0.3)
+        io.write_full("after", b"ok again")
+        assert io.read("after") == b"ok again"
