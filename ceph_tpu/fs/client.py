@@ -33,6 +33,7 @@ from .messages import (
 )
 
 _ERR = {
+    -122: OSError,  # EDQUOT (directory quota)
     -2: FileNotFoundError,
     -17: FileExistsError,
     -20: NotADirectoryError,

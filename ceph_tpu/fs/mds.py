@@ -989,6 +989,100 @@ class MDSDaemon(Dispatcher):
             return None
         return {"rank": owner, "addr": list(addr)}
 
+    # -- directory quotas (reference: CephFS quota realms — the
+    # ceph.quota.max_files / ceph.quota.max_bytes vxattrs on a dir bound
+    # its SUBTREE; upstream enforces via client quota realms, here the
+    # MDS enforces at create/setattr time) --------------------------------
+    def _quota_of(self, inode: dict, name: str) -> int:
+        import base64
+
+        raw = (inode.get("xattrs") or {}).get(name)
+        if raw is None:
+            return 0
+        try:
+            return int(base64.b64decode(raw))
+        except (ValueError, TypeError):
+            return 0
+
+    def _subtree_usage(self, ino: int) -> tuple[int, int]:
+        """(entries, bytes) under a directory, recursive.  `entries`
+        counts files AND subdirectories — the rentries semantics
+        max_files bounds upstream (rfiles + rsubdirs).  O(subtree) — the
+        reference keeps rstats on every CInode for O(1); the walk is the
+        honest simple form at this scale and only runs for dirs on a
+        quota ancestor chain."""
+        files = 0
+        nbytes = 0
+        todo = [ino]
+        while todo:
+            d = todo.pop()
+            for entry in self.dirs.get(d, {}).values():
+                files += 1
+                if "remote" in entry:
+                    continue
+                if entry.get("type") == "dir":
+                    todo.append(entry["ino"])
+                else:
+                    nbytes += int(entry.get("size", 0))
+        return files, nbytes
+
+    def _quota_ancestors(self, ino: int):
+        """Yield (dir_ino, dir_inode) for each ancestor dir (incl. ino
+        itself when a dir) carrying any quota xattr."""
+        cur = ino
+        seen = 0
+        while cur != ROOT_INO and seen < 1000:
+            seen += 1
+            inode = self._inode_of(cur)
+            if inode is None:
+                return
+            if inode.get("type") == "dir" and (
+                self._quota_of(inode, "ceph.quota.max_files")
+                or self._quota_of(inode, "ceph.quota.max_bytes")
+            ):
+                yield cur, inode
+            bp = self.backptr.get(cur)
+            if bp is None:
+                return
+            cur = bp[0]
+
+    def _quota_realm(self, ino: int) -> tuple:
+        """Identity of the quota realm containing `ino`: the tuple of
+        quota-carrying ancestor dirs.  Renames across different realms
+        are refused with EXDEV (upstream CephFS does the same), which is
+        what keeps rename from teleporting usage past a quota."""
+        return tuple(d for d, _i in self._quota_ancestors(ino))
+
+    def _quota_check_create(self, parent: int) -> int:
+        """0 ok, -122 when creating one more entry would cross a
+        max_files quota on any ancestor."""
+        for dino, inode in self._quota_ancestors(parent):
+            limit = self._quota_of(inode, "ceph.quota.max_files")
+            if limit:
+                files, _b = self._subtree_usage(dino)
+                if files + 1 > limit:
+                    return -122
+        return 0
+
+    def _quota_check_size(self, ino: int, new_size) -> int:
+        """0 ok, -122 when growing a file would cross a max_bytes quota
+        on any ancestor."""
+        if new_size is None:
+            return 0
+        inode = self._inode_of(ino)
+        if inode is None:
+            return 0
+        delta = int(new_size) - int(inode.get("size", 0))
+        if delta <= 0:
+            return 0
+        for dino, q in self._quota_ancestors(ino):
+            limit = self._quota_of(q, "ceph.quota.max_bytes")
+            if limit:
+                _f, nbytes = self._subtree_usage(dino)
+                if nbytes + delta > limit:
+                    return -122
+        return 0
+
     def _handle(self, op: str, a: dict, session: str | None = None):
         """Returns (retval, result).  Negative errnos follow the reference
         (-2 ENOENT, -17 EEXIST, -20 ENOTDIR, -21 EISDIR, -39 ENOTEMPTY)."""
@@ -1059,6 +1153,8 @@ class MDSDaemon(Dispatcher):
                 return -2, None
             if inode["type"] == "dir":
                 return -1, None  # EPERM
+            if self._quota_check_create(parent) != 0:
+                return -122, "directory quota exceeded (max_files)"
             self._commit({"e": "link_remote", "parent": parent,
                           "name": name, "ino": ino,
                           "nlink": inode.get("nlink", 1) + 1})
@@ -1069,6 +1165,8 @@ class MDSDaemon(Dispatcher):
                 return -20, None
             if a["name"] in self.dirs[parent]:
                 return -17, self.dirs[parent][a["name"]]
+            if self._quota_check_create(parent) != 0:
+                return -122, "directory quota exceeded (max_files)"
             inode = {
                 "ino": self._alloc_ino(),
                 "type": "dir" if op == "mkdir" else "file",
@@ -1132,6 +1230,10 @@ class MDSDaemon(Dispatcher):
             return 0, dict(inode, nlink_after=max(nlink_after, 0))
         if op == "rename":
             sdir, sname = a["srcdir"], a["sname"]
+            if self._quota_realm(sdir) != self._quota_realm(a["dstdir"]):
+                # crossing a quota realm would teleport usage past the
+                # bound un-checked; the reference refuses with EXDEV
+                return -18, "rename across quota realms"
             entry = self.dirs.get(sdir, {}).get(sname)
             inode = self._resolve_entry(entry)
             if inode is None:
@@ -1208,6 +1310,8 @@ class MDSDaemon(Dispatcher):
             inode = self._inode_of(a["ino"])
             if inode is None:
                 return -2, None
+            if self._quota_check_size(a["ino"], a.get("size")) != 0:
+                return -122, "directory quota exceeded (max_bytes)"
             # a sync setattr from one session must not be overwritten by
             # another session's later cap flush of stale buffered attrs
             self._sync_writers(a["ino"], but=session)
@@ -1248,8 +1352,21 @@ class MDSDaemon(Dispatcher):
                 return -2, None
             if inode["type"] == "dir":
                 return -21, None
+            want = a.get("want", "rw")
+            if want == "rw" and any(
+                self._quota_of(q, "ceph.quota.max_bytes")
+                for _d, q in self._quota_ancestors(a["ino"])
+            ):
+                # under a byte quota, writes must stay SYNCHRONOUS so
+                # the setattr path can enforce max_bytes — a w cap would
+                # buffer sizes past the bound and flush them un-checked
+                # (the reference's client enforces in its quota realm;
+                # we centralize at the MDS).  Writers holding caps from
+                # BEFORE the quota xattr landed keep them until reopen —
+                # the documented enforcement window.
+                want = "r"
             caps = self._grant_caps(
-                inode["ino"], session, a.get("want", "rw")
+                inode["ino"], session, want
             )
             # grant may have flushed a writer: re-read the inode
             return 0, dict(self._inode_of(a["ino"]), caps=caps)

@@ -380,9 +380,7 @@ def test_fs_xattrs_roundtrip_and_survive_failover(cluster):
         assert fs.getxattr("/xa", "user.dirmeta") == b"on a directory"
         fs.removexattr("/xa/f", "user.rank")
         assert sorted(fs.listxattr("/xa/f")) == ["user.color"]
-        import pytest as _pytest
-
-        with _pytest.raises(OSError):
+        with pytest.raises(OSError):
             fs.removexattr("/xa/f", "user.nope")  # ENODATA
         # journaled: a crashed MDS replays them
         cluster.restart_mds()
@@ -411,3 +409,41 @@ def test_xattrs_not_leaked_in_stat_and_cross_client_fresh(cluster):
     finally:
         fs_a.unmount()
         fs_b.unmount()
+
+
+@pytest.mark.cluster
+def test_directory_quotas(cluster):
+    """CephFS dir quotas via ceph.quota.* xattrs (reference: quota
+    realms): max_files bounds subtree entries at create, max_bytes
+    bounds subtree growth at size writeback; both clear when the xattr
+    is removed."""
+    fs = cluster.fs_client("client.quota")
+    try:
+        fs.mkdir("/qd")
+        fs.mkdir("/qd/sub")
+        fs.setxattr("/qd", "ceph.quota.max_files", b"3")
+        with fs.open("/qd/f1", create=True):
+            pass
+        with fs.open("/qd/sub/f2", create=True):  # nested counts too
+            pass
+        with pytest.raises(OSError, match="-122|quota"):
+            fs.open("/qd/f-too-many", create=True)
+        fs.removexattr("/qd", "ceph.quota.max_files")
+        with fs.open("/qd/f-now-ok", create=True):
+            pass
+        # bytes quota: growth past the bound refuses at writeback
+        fs.setxattr("/qd", "ceph.quota.max_bytes", b"1000")
+        with pytest.raises(OSError, match="-122|quota"):
+            with fs.open("/qd/big", create=True) as f:
+                f.write(b"Z" * 2000)  # sync under a byte quota: no w cap
+        with fs.open("/qd/small", create=True) as f:
+            f.write(b"ok")
+        # hardlinks count toward max_files; cross-realm renames refuse
+        fs.setxattr("/qd", "ceph.quota.max_files", b"3")
+        fs.mkdir("/outside")
+        with fs.open("/outside/src", create=True) as f:
+            f.write(b"mv me")
+        with pytest.raises(OSError, match="-18|realm"):
+            fs.rename("/outside/src", "/qd/moved-in")
+    finally:
+        fs.unmount()
