@@ -453,6 +453,33 @@ class Monitor(Dispatcher):
                     "severity": "HEALTH_WARN",
                     "message": f"flags {sorted(m.flags)} set",
                 }
+            full = sorted(
+                p.name for p in m.pools.values()
+                if "full_quota" in getattr(p, "flags", ())
+            )
+            if full:
+                # reference: POOL_FULL health check from pool quota flags
+                checks["POOL_FULL"] = {
+                    "severity": "HEALTH_WARN",
+                    "message": f"{len(full)} pool(s) reached quota: "
+                               f"{', '.join(full)}",
+                    "pools": full,
+                }
+            no_rep = sorted(
+                p.name for p in m.pools.values()
+                if sum(1 for o in range(m.max_osd)
+                       if m.exists(o) and m.is_up(o) and m.is_in(o))
+                < p.min_size
+            )
+            if no_rep:
+                # reference: PG_AVAILABILITY — too few live OSDs to meet
+                # a pool's write quorum anywhere
+                checks["PG_AVAILABILITY"] = {
+                    "severity": "HEALTH_WARN",
+                    "message": f"{len(no_rep)} pool(s) below min_size "
+                               f"capacity: {', '.join(no_rep)}",
+                    "pools": no_rep,
+                }
         return {
             "health": {
                 "status": "HEALTH_WARN" if checks else "HEALTH_OK",

@@ -438,3 +438,36 @@ def test_osd_reweight_and_primary_affinity():
             {"prefix": "osd reweight", "id": 1, "weight": 1.5})[0] == -22
         assert c.mon_command(
             {"prefix": "osd reweight", "id": 99, "weight": 0.5})[0] == -22
+
+
+@pytest.mark.cluster
+def test_health_checks_pool_full_and_availability():
+    """Health surfaces the new states: POOL_FULL from the quota flag,
+    PG_AVAILABILITY when live OSDs cannot meet a pool's min_size."""
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    with LocalCluster(n_mons=1, n_osds=3) as c:
+        c.create_replicated_pool("hp", size=3)
+        rv, res = c.mon_command({"prefix": "status"})
+        assert rv == 0
+        assert res["health"]["status"] == "HEALTH_OK"
+        # flag the pool full via the internal command (the mgr's path)
+        rv, _ = c.mon_command({"prefix": "osd pool set-quota",
+                               "name": "hp", "field": "max_objects",
+                               "value": 1})
+        assert rv == 0
+        rv, _ = c.mon_command({"prefix": "osd pool quota-flag",
+                               "name": "hp", "full": 1})
+        assert rv == 0
+        rv, res = c.mon_command({"prefix": "status"})
+        checks = res["health"]["checks"]
+        assert "POOL_FULL" in checks and "hp" in checks["POOL_FULL"]["pools"]
+        # kill enough OSDs that min_size 2 is unreachable cluster-wide
+        c.kill_osd(1)
+        c.mark_osd_down_out(1)
+        c.kill_osd(2)
+        c.mark_osd_down_out(2)
+        rv, res = c.mon_command({"prefix": "status"})
+        checks = res["health"]["checks"]
+        assert "PG_AVAILABILITY" in checks
+        assert "OSD_DOWN" in checks
