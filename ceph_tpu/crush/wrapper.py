@@ -212,6 +212,52 @@ class CrushWrapper:
         self.invalidate()
         return rule
 
+    def reweight_item(self, name: str, weight: float) -> None:
+        """`ceph osd crush reweight` (reference: CrushWrapper::
+        adjust_item_weightf + the upward weight propagation of
+        crush_reweight_bucket): set a DEVICE's crush weight and
+        recompute every ancestor bucket-entry weight bottom-up —
+        including legacy straw/tree aux tables, which derive from
+        weights and must follow a legitimate weight change (unlike
+        ingest, where they are authoritative and kept verbatim)."""
+        item = self.id_of(name)
+        if item < 0:
+            raise ValueError(f"{name!r} is a bucket; reweight devices")
+        fixed = int(round(weight * 0x10000))
+        if fixed < 0:
+            raise ValueError(f"weight {weight} must be >= 0")
+        found = False
+        for b in self.map.buckets.values():
+            for i, it in enumerate(b.items):
+                if it == item:
+                    b.weights[i] = fixed
+                    found = True
+        if not found:
+            raise KeyError(f"device {name!r} is in no bucket")
+        self._propagate_weights()
+        self.invalidate()
+
+    def _propagate_weights(self) -> None:
+        """Bottom-up: a bucket entry that IS a bucket weighs the sum of
+        that bucket's items; straw/tree aux tables recompute from the
+        new weights."""
+        from .builder import calc_straws, calc_tree_nodes
+        from .types import (BUCKET_STRAW, BUCKET_STRAW2,
+                            BUCKET_TREE)
+
+        order = self._topo_order(list(self.map.buckets))
+        totals: dict[int, int] = {}
+        for bid in order:  # children before parents
+            b = self.map.buckets[bid]
+            for i, it in enumerate(b.items):
+                if it < 0:
+                    b.weights[i] = totals.get(it, b.weights[i])
+            totals[bid] = sum(b.weights)
+            if getattr(b, "alg", BUCKET_STRAW2) == BUCKET_STRAW:
+                b.straws = calc_straws(b.weights)
+            elif getattr(b, "alg", BUCKET_STRAW2) == BUCKET_TREE:
+                b.node_weights = calc_tree_nodes(b.weights)
+
     def get_rule_weight_osd_map(self, rule_id: int) -> dict[int, float]:
         """reference: CrushWrapper::get_rule_weight_osd_map — the crush
         weight of every device reachable from the rule's TAKE roots (so a

@@ -199,6 +199,21 @@ class OSDMonitor:
             return 0, [p.name for p in self.osdmap.pools.values()]
         if prefix in ("osd down", "osd out", "osd in"):
             return self._cmd_osd_state(prefix.split()[1], cmd)
+        if prefix == "osd crush reweight":
+            # reference: OSDMonitor prepare_command OSD_CRUSH_REWEIGHT —
+            # distinct from `osd reweight` (the probabilistic in/out
+            # thinning): this changes the CRUSH weight, i.e. placement
+            try:
+                w = float(cmd.get("weight"))
+            except (TypeError, ValueError):
+                return -22, "numeric weight required"
+            m = self._pending()
+            try:
+                m.crush.reweight_item(cmd.get("name", ""), w)
+            except (KeyError, ValueError) as e:
+                return -22, str(e)
+            return (0, f"reweighted {cmd.get('name')} to {w}") \
+                if self._propose_map(m) else (-110, "proposal timed out")
         if prefix in ("osd reweight", "osd primary-affinity"):
             # reference: OSDMonitor prepare_command OSD_REWEIGHT /
             # OSD_PRIMARY_AFFINITY — 0.0..1.0 stored as 16.16 fixed

@@ -80,6 +80,9 @@ def _build_command(words: list[str]) -> dict:
                 "field": words[4], "value": int(words[5])}
     if words[:3] == ["osd", "pool", "get-quota"]:
         return {"prefix": "osd pool get-quota", "name": words[3]}
+    if words[:3] == ["osd", "crush", "reweight"]:
+        return {"prefix": "osd crush reweight", "name": words[3],
+                "weight": float(words[4])}
     if words[:2] == ["osd", "reweight"] or \
             words[:2] == ["osd", "primary-affinity"]:
         return {"prefix": f"osd {words[1]}", "id": int(words[2]),

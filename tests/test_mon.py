@@ -471,3 +471,45 @@ def test_health_checks_pool_full_and_availability():
         checks = res["health"]["checks"]
         assert "PG_AVAILABILITY" in checks
         assert "OSD_DOWN" in checks
+
+
+@pytest.mark.cluster
+def test_osd_crush_reweight_moves_placements():
+    """`osd crush reweight` changes placement weights with upward
+    propagation: weighting a device to 0 drains its placements."""
+    import numpy as np
+
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    with LocalCluster(n_mons=1, n_osds=4) as c:
+        c.create_replicated_pool("crw", size=2, pg_num=32)
+        m = c._leader().osdmon.osdmap
+        pid = next(i for i, p in m.pools.items() if p.name == "crw")
+        before = sum(
+            1 for ps in range(32)
+            for o in m.pg_to_up_acting_osds(pid, ps)[2] if o == 1
+        )
+        assert before > 0
+        rv, res = c.mon_command({"prefix": "osd crush reweight",
+                                 "name": "osd.1", "weight": 0.0})
+        assert rv == 0, res
+        m = c._leader().osdmon.osdmap
+        after = sum(
+            1 for ps in range(32)
+            for o in m.pg_to_up_acting_osds(pid, ps)[2] if o == 1
+        )
+        assert after == 0, after
+        # ancestor propagation: the host bucket entry followed the sum
+        host_bid = next(
+            bid for bid, b in m.crush.map.buckets.items() if 1 in b.items
+        )
+        root = next(
+            b for b in m.crush.map.buckets.values()
+            if host_bid in b.items
+        )
+        idx = root.items.index(host_bid)
+        hb = m.crush.map.buckets[host_bid]
+        assert root.weights[idx] == sum(hb.weights)
+        # unknown device / bucket targets refuse cleanly
+        assert c.mon_command({"prefix": "osd crush reweight",
+                              "name": "osd.99", "weight": 1.0})[0] == -22
