@@ -337,10 +337,11 @@ class _Store:
                 self.meta.remove(f"idx.{bucket}")
             except IOError:
                 pass
-            try:
-                self.meta.remove(f"bver.{bucket}")
-            except IOError:
-                pass
+            for side in (f"bver.{bucket}", f"cmeta.{bucket}"):
+                try:
+                    self.meta.remove(side)
+                except IOError:
+                    pass
             # reap the bucket's in-flight multipart uploads (their part
             # objects would otherwise be orphaned in rgw_data)
             for uid in [
@@ -371,6 +372,20 @@ class _Store:
     # records {"vid","size","etag","mtime","dm"} with the head mirrored
     # into the legacy fields so listings stay cheap.  Multipart
     # completes always write the null version (out of scope).
+    def container_meta(self, bucket: str) -> dict:
+        """Swift X-Container-Meta-* storage (rides a bver-style sidecar
+        object; S3 has no bucket-metadata surface, so this is
+        Swift-only state like upstream's bucket attrs)."""
+        return self._read_json(self.meta, f"cmeta.{bucket}", None) or {}
+
+    def set_container_meta(self, bucket: str, meta: dict) -> bool:
+        with self.lock:
+            if not self.bucket_exists(bucket):
+                return False
+            self.meta.write_full(
+                f"cmeta.{bucket}", json.dumps(meta).encode())
+            return True
+
     def versioning_status(self, bucket: str) -> str | None:
         cfg = self._read_json(self.meta, f"bver.{bucket}", None)
         return cfg.get("status") if cfg else None
@@ -838,6 +853,13 @@ class _Handler(BaseHTTPRequestHandler):
             if k.lower().startswith("x-object-meta-")
         }
 
+    def _collect_container_meta(self) -> dict:
+        return {
+            k[len("X-Container-Meta-"):]: v
+            for k, v in self.headers.items()
+            if k.lower().startswith("x-container-meta-")
+        }
+
     def _swift_dispatch(self) -> bool:
         """Handle /auth/v1.0 and /swift/v1* for the current verb.
         True = request fully handled (including auth failures)."""
@@ -919,8 +941,11 @@ class _Handler(BaseHTTPRequestHandler):
             # paginated LIVE count: matches what GET lists (markers
             # hidden), no 10k cap (review r5)
             n = self.store.count_live(container)
-            return self._reply(204, b"", ctype="text/plain", headers={
-                "X-Container-Object-Count": str(n)})
+            headers = {"X-Container-Object-Count": str(n)}
+            for k, v in self.store.container_meta(container).items():
+                headers[f"X-Container-Meta-{k}"] = v
+            return self._reply(204, b"", ctype="text/plain",
+                               headers=headers)
         ent = self.store.head_object(container, obj)
         if ent is None:
             return self._swift_reply(404)
@@ -939,6 +964,9 @@ class _Handler(BaseHTTPRequestHandler):
             return self._reply(400, b"", ctype="text/plain")
         if not obj:
             created = self.store.create_bucket(container)
+            cmeta = self._collect_container_meta()
+            if cmeta:
+                self.store.set_container_meta(container, cmeta)
             return self._reply(201 if created else 202, b"",
                                ctype="text/plain")
         meta = self._collect_obj_meta()
@@ -949,6 +977,12 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(201, b"", ctype="text/plain", headers={"ETag": etag})
 
     def _swift_POST(self, container, obj, q, body):
+        if container and not obj:
+            # container metadata update (Swift POST replaces the set)
+            if not self.store.set_container_meta(
+                    container, self._collect_container_meta()):
+                return self._reply(404, b"", ctype="text/plain")
+            return self._reply(204, b"", ctype="text/plain")
         # object metadata update (Swift POST replaces the meta set) —
         # index-only: no new version, data and ETag untouched
         if not container or not obj:

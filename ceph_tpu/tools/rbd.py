@@ -79,6 +79,12 @@ def main(argv=None, out=sys.stdout) -> int:
     p.add_argument("image")
     p.add_argument("--order", type=int, default=22)
 
+    p = sub.add_parser("bench")
+    p.add_argument("image")
+    p.add_argument("--io-type", choices=["write", "read"], default="write")
+    p.add_argument("--io-size", type=int, default=65536)
+    p.add_argument("--io-total", type=int, default=4 << 20)
+
     p = sub.add_parser("mirror")
     p.add_argument("mirror_scope", choices=["image"])
     p.add_argument("mirror_op",
@@ -171,6 +177,30 @@ def main(argv=None, out=sys.stdout) -> int:
                     chunk = data[off:off + step]
                     if chunk.strip(b"\x00"):
                         img.write(chunk, off)
+            return 0
+        if args.op == "bench":
+            # reference: `rbd bench --io-type write` — sequential IO of
+            # io-size blocks until io-total bytes
+            import time as _time
+
+            with rbd.open(args.image) as img:
+                if args.io_type == "write" and \
+                        img.size() < args.io_total:
+                    img.resize(args.io_total)
+                payload = bytes(i & 0xFF for i in range(args.io_size))
+                done = 0
+                t0 = _time.monotonic()
+                while done < args.io_total:
+                    n = min(args.io_size, args.io_total - done)
+                    if args.io_type == "write":
+                        img.write(payload[:n], done)
+                    else:
+                        img.read(done, n)
+                    done += n
+                dt = _time.monotonic() - t0
+            print(f"elapsed: {dt:.3f}s  ops: "
+                  f"{-(-args.io_total // args.io_size)}  "
+                  f"bytes/sec: {done / dt if dt else 0:.0f}", file=out)
             return 0
         if args.op == "mirror":
             from ..client.rbd_mirror import (

@@ -176,3 +176,24 @@ def test_radosgw_admin_bucket_rm(cluster, conn):
     _req(conn, "DELETE", "/rmbkt/obj")
     assert run("bucket", "rm", "--bucket", "rmbkt")[0] == 0
     assert run("bucket", "rm", "--bucket", "rmbkt")[0] == 1  # gone
+
+
+def test_container_metadata(conn):
+    st, _, _ = _req(conn, "PUT", "/cmeta",
+                    headers={"X-Container-Meta-Owner": "ops"})
+    assert st == 200  # the S3 front: bucket create, meta headers ignored
+    st, hdrs, _ = _req(conn, "HEAD", "/swift/v1/cmeta")
+    assert st == 204 and hdrs.get("X-Container-Meta-Owner") != "ops", \
+        "S3 PUT must not set swift meta"
+    # Swift PUT/POST carry the meta
+    _req(conn, "PUT", "/swift/v1/cm2",
+         headers={"X-Container-Meta-Env": "prod"})
+    st, hdrs, _ = _req(conn, "HEAD", "/swift/v1/cm2")
+    assert st == 204 and hdrs.get("X-Container-Meta-Env") == "prod"
+    st, _, _ = _req(conn, "POST", "/swift/v1/cm2",
+                    headers={"X-Container-Meta-Tier": "gold"})
+    assert st == 204
+    st, hdrs, _ = _req(conn, "HEAD", "/swift/v1/cm2")
+    assert hdrs.get("X-Container-Meta-Tier") == "gold"
+    assert "X-Container-Meta-Env" not in hdrs  # POST replaces the set
+    assert _req(conn, "POST", "/swift/v1/nope")[0] == 404

@@ -424,6 +424,19 @@ class TestRbdCli:
         rc, _ = run(rbd_cli, base + ["info", "no-such-image"])
         assert rc == 1
 
+    def test_bench(self, cli_cluster):
+        from ceph_tpu.tools import rbd as rbd_cli
+
+        mon = self._mon(cli_cluster)
+        base = ["-m", mon, "-p", "clipool"]
+        run(rbd_cli, base + ["create", "bvol", "--size", "1M"])
+        rc, out = run(rbd_cli, base + ["bench", "bvol", "--io-size",
+                                       "65536", "--io-total", "262144"])
+        assert rc == 0 and "bytes/sec:" in out and "ops: 4" in out
+        rc, out = run(rbd_cli, base + ["bench", "bvol", "--io-type",
+                                       "read", "--io-total", "262144"])
+        assert rc == 0 and "bytes/sec:" in out
+
 
 class TestKvstoreVerbs:
     """ceph-kvstore-tool role (reference: src/tools/kvstore_tool.cc) —
