@@ -685,9 +685,18 @@ class OSDMonitor:
                 m, f"{name}_rule", cmd.get("crush_failure_domain", "host"),
                 firstn=True,
             )
+            extra = {}
+            try:
+                if cmd.get("min_size") is not None:
+                    ms = int(cmd["min_size"])
+                    if not (1 <= ms <= size):
+                        return -22, f"min_size {ms} out of [1, size={size}]"
+                    extra["min_size"] = ms
+            except (TypeError, ValueError):
+                return -22, "integer min_size required"
             pool = m.create_pool(
                 pool_id, pg_num=pg_num, size=size, crush_rule=rule_id,
-                type=PG_POOL_REPLICATED, name=name,
+                type=PG_POOL_REPLICATED, name=name, **extra,
             )
         if not self._propose_map(m):
             return -110, "proposal timed out"

@@ -232,11 +232,15 @@ class LocalCluster:
         assert rv == 0, (rv, res)
 
     def create_replicated_pool(self, name: str, size: int = 3,
-                               pg_num: int = 8) -> None:
-        rv, res = self.mon_command({
+                               pg_num: int = 8,
+                               min_size: int | None = None) -> None:
+        cmd = {
             "prefix": "osd pool create", "name": name, "pg_num": pg_num,
             "size": size,
-        })
+        }
+        if min_size is not None:
+            cmd["min_size"] = min_size
+        rv, res = self.mon_command(cmd)
         assert rv == 0, (rv, res)
 
     def _ensure_replicated_pools(self, *names: str) -> None:

@@ -122,7 +122,11 @@ def test_stale_primary_blocked_until_rw_interval_heard(slow_is_ok=True):
             "mon_osd_down_out_interval": 3600.0,  # we drive the map
         },
     ) as c:
-        c.create_replicated_pool("pi", size=2, pg_num=1)
+        # min_size=2: the rw-interval gate under test is about FULL
+        # write quorums; the upstream DEFAULT for size-2 is min_size 1,
+        # under which transient single-member intervals also count as
+        # maybe-rw and this topology legitimately stays incomplete
+        c.create_replicated_pool("pi", size=2, pg_num=1, min_size=2)
         client = c.client()
         io = client.open_ioctx("pi")
         io.write_full("obj", b"v1-original")
