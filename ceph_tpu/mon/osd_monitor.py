@@ -276,6 +276,8 @@ class OSDMonitor:
                 pool.flags.remove("full_quota")
             return (0, f"full_quota={'set' if want else 'cleared'}") \
                 if self._propose_map(m) else (-110, "proposal timed out")
+        if prefix == "osd pool rm":
+            return self._cmd_pool_rm(cmd)
         if prefix in ("osd pool mksnap", "osd pool rmsnap"):
             return self._cmd_pool_snap(prefix.endswith("mksnap"), cmd)
         if prefix == "osd pg-upmap-items":
@@ -639,6 +641,30 @@ class OSDMonitor:
             "k": codec.get_data_chunk_count(),
             "m": codec.get_chunk_count() - codec.get_data_chunk_count(),
         }
+
+    def _cmd_pool_rm(self, cmd: dict) -> tuple[int, object]:
+        """`osd pool rm <name> <name> --yes-i-really-really-mean-it`
+        (reference: OSDMonitor prepare_command OSD_POOL_DELETE with its
+        double-name + sure-flag safety).  OSDs garbage-collect the
+        pool's PG collections when the map lands."""
+        name = cmd.get("name", "")
+        if cmd.get("name2") != name:
+            return -1, "pool name must be given twice"
+        if not cmd.get("sure"):
+            return -1, ("this will PERMANENTLY DESTROY all data; pass "
+                        "sure=--yes-i-really-really-mean-it")
+        m = self._pending()
+        pool = next((p for p in m.pools.values() if p.name == name), None)
+        if pool is None:
+            return -2, f"no pool {name!r}"
+        if pool.tiers:
+            return -16, f"pool {name!r} has cache tiers; remove them first"
+        if pool.tier_of >= 0:
+            return -16, (f"pool {name!r} is a cache tier; "
+                         f"`osd tier remove` first")
+        del m.pools[pool.pool_id]
+        return (0, f"pool {name!r} removed") \
+            if self._propose_map(m) else (-110, "proposal timed out")
 
     def _cmd_pool_create(self, cmd: dict) -> tuple[int, object]:
         name = cmd.get("name")

@@ -329,7 +329,34 @@ class OSD(
                     pg.intervals_closed += 1
                     pg.interval_start = m.epoch
                     self._save_intervals(pg)
+            gone = set(old.pools) - set(m.pools)
+            if gone:
+                self._purge_deleted_pools(gone)
         self._recovery_wakeup.set()  # re-peer with the new map
+
+    def _purge_deleted_pools(self, pool_ids) -> None:
+        """A pool deleted from the map takes its local PG state with it
+        (reference: the OSD's PG removal queue after pool deletion)."""
+        for pid in pool_ids:
+            with self._pgs_lock:
+                doomed = [
+                    key for key in self.pgs
+                    if key.split(".", 1)[0] == str(pid)
+                ]
+                for key in doomed:
+                    del self.pgs[key]
+            for cid in list(self.store.list_collections()):
+                if cid.split(".", 1)[0] == str(pid):
+                    try:
+                        t = Transaction()
+                        for oid in list(self.store.list_objects(cid)):
+                            t.remove(cid, oid)
+                        t.remove_collection(cid)
+                        self.store.queue_transaction(t)
+                    except Exception as e:
+                        self.cct.dout(
+                            "osd", 3,
+                            f"{self.whoami} pool {pid} purge {cid}: {e!r}")
 
     def my_epoch(self) -> int:
         return self.osdmap.epoch if self.osdmap else 0

@@ -74,6 +74,14 @@ def _build_command(words: list[str]) -> dict:
     if words[:2] == ["osd", "down"] or words[:2] == ["osd", "out"] or \
             words[:2] == ["osd", "in"]:
         return {"prefix": f"osd {words[1]}", "id": int(words[2])}
+    if words[:3] == ["osd", "pool", "rm"]:
+        # osd pool rm <name> <name> --yes-i-really-really-mean-it
+        cmd = {"prefix": "osd pool rm", "name": words[3]}
+        if len(words) > 4:
+            cmd["name2"] = words[4]
+        if len(words) > 5:
+            cmd["sure"] = words[5]
+        return cmd
     if words[:3] == ["osd", "pool", "set-quota"]:
         # osd pool set-quota <pool> max_objects|max_bytes <val>
         return {"prefix": "osd pool set-quota", "name": words[3],

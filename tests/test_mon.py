@@ -513,3 +513,45 @@ def test_osd_crush_reweight_moves_placements():
         # unknown device / bucket targets refuse cleanly
         assert c.mon_command({"prefix": "osd crush reweight",
                               "name": "osd.99", "weight": 1.0})[0] == -22
+
+
+@pytest.mark.cluster
+def test_pool_rm_requires_safety_and_purges_osds():
+    """`osd pool rm` needs the doubled name + sure flag; once the map
+    lands, OSDs garbage-collect the pool's collections."""
+    import time as _t
+
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    with LocalCluster(n_mons=1, n_osds=3) as c:
+        c.create_replicated_pool("doomed", size=2)
+        io = c.client().open_ioctx("doomed")
+        for i in range(6):
+            io.write_full(f"d{i}", b"bye" * 100)
+        # safety rails
+        assert c.mon_command({"prefix": "osd pool rm",
+                              "name": "doomed"})[0] == -1
+        assert c.mon_command({"prefix": "osd pool rm", "name": "doomed",
+                              "name2": "doomed"})[0] == -1
+        rv, res = c.mon_command({
+            "prefix": "osd pool rm", "name": "doomed", "name2": "doomed",
+            "sure": "--yes-i-really-really-mean-it",
+        })
+        assert rv == 0, res
+        m = c._leader().osdmon.osdmap
+        assert not any(p.name == "doomed" for p in m.pools.values())
+        # OSD-side purge: the pool's collections disappear
+        deadline = _t.time() + 20
+        while _t.time() < deadline:
+            left = [
+                cid for o in c.osds.values()
+                for cid in o.store.list_collections()
+                if cid.split(".", 1)[0].isdigit()
+            ]
+            if not left:
+                break
+            _t.sleep(0.3)
+        assert not left, f"collections survived pool rm: {left[:5]}"
+        assert c.mon_command({"prefix": "osd pool rm", "name": "doomed",
+                              "name2": "doomed",
+                              "sure": "x"})[0] == -2  # already gone
