@@ -278,6 +278,20 @@ class OSDMonitor:
                 if self._propose_map(m) else (-110, "proposal timed out")
         if prefix == "osd pool rm":
             return self._cmd_pool_rm(cmd)
+        if prefix == "osd pool rename":
+            src_n, dst_n = cmd.get("srcpool", ""), cmd.get("destpool", "")
+            if not src_n or not dst_n:
+                return -22, "srcpool and destpool required"
+            m = self._pending()
+            if any(p.name == dst_n for p in m.pools.values()):
+                return -17, f"pool {dst_n!r} already exists"
+            pool = next((p for p in m.pools.values() if p.name == src_n),
+                        None)
+            if pool is None:
+                return -2, f"no pool {src_n!r}"
+            pool.name = dst_n
+            return (0, f"pool {src_n!r} renamed to {dst_n!r}") \
+                if self._propose_map(m) else (-110, "proposal timed out")
         if prefix in ("osd pool mksnap", "osd pool rmsnap"):
             return self._cmd_pool_snap(prefix.endswith("mksnap"), cmd)
         if prefix == "osd pg-upmap-items":
@@ -650,7 +664,7 @@ class OSDMonitor:
         name = cmd.get("name", "")
         if cmd.get("name2") != name:
             return -1, "pool name must be given twice"
-        if not cmd.get("sure"):
+        if cmd.get("sure") != "--yes-i-really-really-mean-it":
             return -1, ("this will PERMANENTLY DESTROY all data; pass "
                         "sure=--yes-i-really-really-mean-it")
         m = self._pending()
@@ -662,7 +676,14 @@ class OSDMonitor:
         if pool.tier_of >= 0:
             return -16, (f"pool {name!r} is a cache tier; "
                          f"`osd tier remove` first")
-        del m.pools[pool.pool_id]
+        pid = pool.pool_id
+        del m.pools[pid]
+        # scrub per-PG overrides keyed by (pool, ps) — a later pool must
+        # not inherit them (reference: OSDMonitor clean_pg_upmaps)
+        for ovr in (m.pg_upmap, m.pg_upmap_items, m.pg_temp,
+                    m.primary_temp):
+            for key in [k for k in ovr if k[0] == pid]:
+                del ovr[key]
         return (0, f"pool {name!r} removed") \
             if self._propose_map(m) else (-110, "proposal timed out")
 
@@ -674,7 +695,7 @@ class OSDMonitor:
         if any(p.name == name for p in m.pools.values()):
             return -17, f"pool {name!r} already exists"
         pg_num = int(cmd.get("pg_num") or self.mon.cct.conf.get("osd_pool_default_pg_num"))
-        pool_id = max(m.pools, default=0) + 1
+        pool_id = max(m.max_pool_id, max(m.pools, default=0)) + 1
         kind = cmd.get("pool_type", "replicated")
         # pg-per-osd sanity (reference: mon_max_pg_per_osd check)
         up = sum(1 for o in range(m.max_osd) if m.is_up(o)) or 1

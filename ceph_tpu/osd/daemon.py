@@ -329,34 +329,53 @@ class OSD(
                     pg.intervals_closed += 1
                     pg.interval_start = m.epoch
                     self._save_intervals(pg)
-            gone = set(old.pools) - set(m.pools)
-            if gone:
-                self._purge_deleted_pools(gone)
+        if (old is None or old.max_pool_id != m.max_pool_id
+                or set(old.pools) - set(m.pools)):
+            self._purge_deleted_pools(m)
         self._recovery_wakeup.set()  # re-peer with the new map
 
-    def _purge_deleted_pools(self, pool_ids) -> None:
-        """A pool deleted from the map takes its local PG state with it
-        (reference: the OSD's PG removal queue after pool deletion)."""
-        for pid in pool_ids:
-            with self._pgs_lock:
-                doomed = [
-                    key for key in self.pgs
-                    if key.split(".", 1)[0] == str(pid)
-                ]
-                for key in doomed:
-                    del self.pgs[key]
-            for cid in list(self.store.list_collections()):
-                if cid.split(".", 1)[0] == str(pid):
-                    try:
-                        t = Transaction()
-                        for oid in list(self.store.list_objects(cid)):
-                            t.remove(cid, oid)
-                        t.remove_collection(cid)
-                        self.store.queue_transaction(t)
-                    except Exception as e:
-                        self.cct.dout(
-                            "osd", 3,
-                            f"{self.whoami} pool {pid} purge {cid}: {e!r}")
+    def _purge_deleted_pools(self, m: OSDMap) -> None:
+        """Local PG state for any pool absent from the map is garbage
+        (reference: the OSD's PG removal queue after pool deletion).
+        Checked against the full map, not an old->new diff, so an OSD
+        that was down across the deletion still purges on its first map
+        after boot — _load_pgs resurrects PGs from leftover collections.
+        Pool ids are monotonic (OSDMap.max_pool_id), which makes the
+        check race-free against map lag: a collection whose pool id is
+        ABOVE this map's max_pool_id belongs to a pool created in an
+        epoch we haven't applied yet (a lagging replica can take a
+        sub-op for it before seeing the map) and must be left alone;
+        one at or below it that is absent from the map is definitively
+        deleted, because ids are never reused."""
+
+        def _pool_of(key: str) -> int:
+            head = key.split(".", 1)[0]
+            return int(head) if head.isdigit() else -1
+
+        live = set(m.pools)
+        ceiling = m.max_pool_id
+
+        def _doomed(pid: int) -> bool:
+            return 0 <= pid <= ceiling and pid not in live
+
+        with self._pgs_lock:
+            doomed = [k for k in self.pgs if _doomed(_pool_of(k))]
+            for key in doomed:
+                del self.pgs[key]
+        for cid in list(self.store.list_collections()):
+            pid = _pool_of(cid)
+            if not _doomed(pid):
+                continue
+            try:
+                t = Transaction()
+                for oid in list(self.store.list_objects(cid)):
+                    t.remove(cid, oid)
+                t.remove_collection(cid)
+                self.store.queue_transaction(t)
+            except Exception as e:
+                self.cct.dout(
+                    "osd", 3,
+                    f"{self.whoami} pool {pid} purge {cid}: {e!r}")
 
     def my_epoch(self) -> int:
         return self.osdmap.epoch if self.osdmap else 0

@@ -158,6 +158,10 @@ class OSDMap:
         self.osd_weight = [OSD_IN] * self.max_osd  # in/out reweight, 16.16
         self.osd_primary_affinity = [MAX_PRIMARY_AFFINITY] * self.max_osd
         self.pools: dict[int, PGPool] = {}
+        # highest pool id EVER allocated — never reused, so a deleted
+        # pool's id cannot alias a later pool in collections/upmaps
+        # (reference: OSDMap pool ids are monotonic)
+        self.max_pool_id = 0
         # (pool, ps) → explicit raw mapping (reference: OSDMap pg_upmap)
         self.pg_upmap: dict[tuple[int, int], list[int]] = {}
         # (pool, ps) → [(from, to), ...] (reference: pg_upmap_items)
@@ -198,6 +202,7 @@ class OSDMap:
             raise ValueError(f"no crush rule {crush_rule}")
         p = PGPool(pool_id, pg_num, size, crush_rule, type=type, **kw)
         self.pools[pool_id] = p
+        self.max_pool_id = max(self.max_pool_id, pool_id)
         return p
 
     def is_up(self, osd: int) -> bool:
@@ -426,6 +431,7 @@ class OSDMap:
                 if b.straws or b.node_weights
             },
             "pools": [vars(p) for p in self.pools.values()],
+            "max_pool_id": self.max_pool_id,
             "pg_upmap": [
                 {"pool": k[0], "ps": k[1], "osds": v}
                 for k, v in self.pg_upmap.items()
@@ -483,6 +489,8 @@ class OSDMap:
         m.osd_primary_affinity = list(d["osd_primary_affinity"])
         for pd in d["pools"]:
             m.pools[pd["pool_id"]] = PGPool(**pd)
+        m.max_pool_id = max(int(d.get("max_pool_id", 0)),
+                            max(m.pools, default=0))
         for e in d.get("pg_upmap", []):
             m.pg_upmap[(e["pool"], e["ps"])] = list(e["osds"])
         for e in d.get("pg_upmap_items", []):

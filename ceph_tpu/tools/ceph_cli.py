@@ -82,6 +82,11 @@ def _build_command(words: list[str]) -> dict:
         if len(words) > 5:
             cmd["sure"] = words[5]
         return cmd
+    if words[:3] == ["osd", "pool", "rename"]:
+        if len(words) < 5:
+            raise ValueError("usage: osd pool rename <src> <dest>")
+        return {"prefix": "osd pool rename", "srcpool": words[3],
+                "destpool": words[4]}
     if words[:3] == ["osd", "pool", "set-quota"]:
         # osd pool set-quota <pool> max_objects|max_bytes <val>
         return {"prefix": "osd pool set-quota", "name": words[3],

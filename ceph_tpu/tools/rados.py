@@ -89,6 +89,21 @@ def main(argv=None, out=sys.stdout) -> int:
     p = sub.add_parser("rmsnap")
     p.add_argument("snapname")
     sub.add_parser("lssnap")
+    p = sub.add_parser("setxattr")
+    p.add_argument("oid")
+    p.add_argument("name")
+    p.add_argument("value")
+    p = sub.add_parser("getxattr")
+    p.add_argument("oid")
+    p.add_argument("name")
+    p = sub.add_parser("listxattr")
+    p.add_argument("oid")
+    p = sub.add_parser("listomapvals")
+    p.add_argument("oid")
+    p = sub.add_parser("setomapval")
+    p.add_argument("oid")
+    p.add_argument("key")
+    p.add_argument("value")
     p = sub.add_parser("scrub", help="deep-scrub + repair the pool's PGs")
     p.add_argument("--pg", type=int, default=None,
                    help="one placement-group seed (default: all)")
@@ -124,6 +139,20 @@ def main(argv=None, out=sys.stdout) -> int:
             else:
                 with open(args.outfile, "wb") as f:
                     f.write(data)
+        elif args.op == "setxattr":
+            io.set_xattr(args.oid, args.name, args.value.encode())
+        elif args.op == "getxattr":
+            print(io.get_xattr(args.oid, args.name)
+                  .decode("utf-8", "backslashreplace"), file=out)
+        elif args.op == "listxattr":
+            for name in sorted(io.get_xattrs(args.oid)):
+                print(name, file=out)
+        elif args.op == "listomapvals":
+            for k, v in sorted(io.omap_get(args.oid).items()):
+                val = v.decode("utf-8", "backslashreplace")
+                print(f"{k}\t{val}", file=out)
+        elif args.op == "setomapval":
+            io.omap_set(args.oid, {args.key: args.value.encode()})
         elif args.op == "scrub":
             reports = (
                 [io.scrub_pg(args.pg)] if args.pg is not None

@@ -533,6 +533,9 @@ def test_pool_rm_requires_safety_and_purges_osds():
                               "name": "doomed"})[0] == -1
         assert c.mon_command({"prefix": "osd pool rm", "name": "doomed",
                               "name2": "doomed"})[0] == -1
+        assert c.mon_command({"prefix": "osd pool rm", "name": "doomed",
+                              "name2": "doomed",
+                              "sure": "--yes-i-really-mean-it"})[0] == -1
         rv, res = c.mon_command({
             "prefix": "osd pool rm", "name": "doomed", "name2": "doomed",
             "sure": "--yes-i-really-really-mean-it",
@@ -552,6 +555,89 @@ def test_pool_rm_requires_safety_and_purges_osds():
                 break
             _t.sleep(0.3)
         assert not left, f"collections survived pool rm: {left[:5]}"
-        assert c.mon_command({"prefix": "osd pool rm", "name": "doomed",
-                              "name2": "doomed",
-                              "sure": "x"})[0] == -2  # already gone
+        assert c.mon_command({
+            "prefix": "osd pool rm", "name": "doomed", "name2": "doomed",
+            "sure": "--yes-i-really-really-mean-it",
+        })[0] == -2  # already gone
+
+
+@pytest.mark.cluster
+def test_pool_rename_and_rados_xattr_verbs():
+    from ceph_tpu.qa.vstart import LocalCluster
+    from ceph_tpu.tools import rados as rados_tool
+
+    with LocalCluster(n_mons=1, n_osds=2) as c:
+        c.create_replicated_pool("old", size=2)
+        assert c.mon_command({"prefix": "osd pool rename",
+                              "srcpool": "nope",
+                              "destpool": "x"})[0] == -2
+        rv, res = c.mon_command({"prefix": "osd pool rename",
+                                 "srcpool": "old", "destpool": "new"})
+        assert rv == 0, res
+        assert c.mon_command({"prefix": "osd pool rename",
+                              "srcpool": "new",
+                              "destpool": "new"})[0] == -17
+        io = c.client().open_ioctx("new")
+        io.write_full("obj", b"hello")
+        mon = f"{c.mon_addrs[0][0]}:{c.mon_addrs[0][1]}"
+        import io as _io
+        buf = _io.StringIO()
+        assert rados_tool.main(
+            ["-m", mon, "-p", "new", "setxattr", "obj", "user.k", "v1"],
+            out=buf) == 0
+        buf = _io.StringIO()
+        assert rados_tool.main(
+            ["-m", mon, "-p", "new", "getxattr", "obj", "user.k"],
+            out=buf) == 0
+        assert buf.getvalue().strip() == "v1"
+        buf = _io.StringIO()
+        assert rados_tool.main(
+            ["-m", mon, "-p", "new", "listxattr", "obj"], out=buf) == 0
+        assert "user.k" in buf.getvalue()
+        assert rados_tool.main(
+            ["-m", mon, "-p", "new", "setomapval", "obj", "mk", "mv"],
+            out=buf) == 0
+        buf = _io.StringIO()
+        assert rados_tool.main(
+            ["-m", mon, "-p", "new", "listomapvals", "obj"], out=buf) == 0
+        assert "mk\tmv" in buf.getvalue()
+
+
+@pytest.mark.cluster
+def test_pool_rm_down_osd_purges_on_revive_and_ids_not_reused():
+    """An OSD that misses the deletion epoch must still purge the dead
+    pool's collections on its first map after revival, and a new pool
+    must get a fresh id (never the deleted one) so stale state can't
+    alias it."""
+    import time as _t
+
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    with LocalCluster(n_mons=1, n_osds=3) as c:
+        c.create_replicated_pool("dead", size=2)
+        io = c.client().open_ioctx("dead")
+        for i in range(4):
+            io.write_full(f"o{i}", b"z" * 64)
+        m = c._leader().osdmon.osdmap
+        dead_id = next(p.pool_id for p in m.pools.values()
+                       if p.name == "dead")
+        c.kill_osd(2)
+        rv, res = c.mon_command({
+            "prefix": "osd pool rm", "name": "dead", "name2": "dead",
+            "sure": "--yes-i-really-really-mean-it",
+        })
+        assert rv == 0, res
+        c.revive_osd(2)
+        deadline = _t.time() + 25
+        while _t.time() < deadline:
+            left = [cid for cid in c.osds[2].store.list_collections()
+                    if cid.split(".", 1)[0] == str(dead_id)]
+            if not left:
+                break
+            _t.sleep(0.3)
+        assert not left, f"revived OSD kept dead pool: {left[:4]}"
+        # id monotonicity: the replacement pool skips the dead id
+        c.create_replicated_pool("fresh", size=2)
+        m = c._leader().osdmon.osdmap
+        fresh = next(p for p in m.pools.values() if p.name == "fresh")
+        assert fresh.pool_id > dead_id
