@@ -141,6 +141,8 @@ def default_options() -> OptionTable:
             Option("mgr_modules", str,
                    "status,prometheus,balancer,iostat,quota",
                    "comma-separated modules the mgr hosts"),
+            Option("mgr_digest_interval", float, 2.0,
+                   "seconds between mgr->mon status digests", min=0.1),
             Option("mgr_quota_interval", float, 2.0,
                    "seconds between pool-quota enforcement passes", min=0.1),
             Option("mgr_prometheus_port", int, 0,

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import time
 
-from ..osd.osdmap import PG_POOL_ERASURE
 from .module import MgrModule, register_module
 
 
@@ -34,30 +33,9 @@ class QuotaModule(MgrModule):
     def pool_usage(self) -> dict[int, dict]:
         """{pool_id: {"bytes": logical_estimate, "objects": n}} from the
         freshest daemon reports."""
-        m = self.get("osd_map")
-        stats = self.mgr.latest_stats()
-        usage: dict[int, dict] = {}
-        if m is None:
-            return usage
-        for pid, pool in m.pools.items():
-            raw = 0
-            objs = 0
-            for st in stats.values():
-                raw += int(st.get("pool_bytes", {}).get(str(pid), 0))
-                objs += int(st.get("pool_objects", {}).get(str(pid), 0))
-            if pool.type == PG_POOL_ERASURE:
-                prof = m.ec_profiles.get(pool.ec_profile or "", {})
-                k = int(prof.get("k", 2))
-                factor = pool.size / max(k, 1)
-            else:
-                factor = max(pool.size, 1)
-            usage[pid] = {
-                "bytes": int(raw / factor),
-                # object counts are per-replica too: each copy/shard is
-                # one store object
-                "objects": objs // max(pool.size, 1),
-            }
-        return usage
+        from .status_module import pool_usage
+
+        return pool_usage(self.get("osd_map"), self.mgr.latest_stats())
 
     def enforce_once(self) -> list[str]:
         """Compare usage to quotas; flip full_quota where the state

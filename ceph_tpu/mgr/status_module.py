@@ -1,8 +1,116 @@
-"""Status module — cluster summary assembly (reference: the mgr side of
-`ceph -s`/`ceph osd status`: src/pybind/mgr/status/module.py)."""
+"""Status module — cluster summary assembly and the mon digest
+(reference: the mgr side of `ceph -s`/`ceph osd status`
+src/pybind/mgr/status/module.py, plus the MMonMgrReport digest the mgr
+streams to the mon so MgrStatMonitor can answer `ceph df`/`pg dump`
+from the monitor)."""
 from __future__ import annotations
 
+from ..osd.osdmap import PG_POOL_ERASURE
 from .module import MgrModule, register_module
+
+
+def pool_usage(m, stats: dict) -> dict[int, dict]:
+    """{pool_id: {"bytes": logical, "objects": n, "raw_bytes": raw}} —
+    raw sums across daemon reports, logical divides out the redundancy
+    factor (replica count, or size/k for EC)."""
+    usage: dict[int, dict] = {}
+    if m is None:
+        return usage
+    for pid, pool in m.pools.items():
+        raw = 0
+        objs = 0
+        for st in stats.values():
+            raw += int(st.get("pool_bytes", {}).get(str(pid), 0))
+            objs += int(st.get("pool_objects", {}).get(str(pid), 0))
+        if pool.type == PG_POOL_ERASURE:
+            prof = m.ec_profiles.get(pool.ec_profile or "", {})
+            k = int(prof.get("k", 2))
+            factor = pool.size / max(k, 1)
+        else:
+            factor = max(pool.size, 1)
+        usage[pid] = {
+            "bytes": int(raw / factor),
+            # object counts are per-replica too: each copy/shard is
+            # one store object
+            "objects": objs // max(pool.size, 1),
+            "raw_bytes": raw,
+            "factor": factor,
+        }
+    return usage
+
+
+def assemble_df(m, stats: dict) -> dict:
+    """`ceph df` payload (reference: PGMap::dump_cluster_stats +
+    dump_pool_stats_full)."""
+    total = used = avail = 0
+    for st in stats.values():
+        sf = st.get("statfs") or {}
+        total += int(sf.get("total", 0))
+        used += int(sf.get("used", 0))
+        avail += int(sf.get("avail", 0))
+    usage = pool_usage(m, stats)
+    pools = []
+    if m is not None:
+        for pid, pool in sorted(m.pools.items()):
+            u = usage.get(pid, {})
+            factor = u.get("factor", 1) or 1
+            stored = u.get("bytes", 0)
+            max_avail = int(avail / factor)
+            denom = stored + max_avail
+            pools.append({
+                "id": pid,
+                "name": pool.name,
+                "stored": stored,
+                "objects": u.get("objects", 0),
+                "kb_used": -(-u.get("raw_bytes", 0) // 1024),
+                "percent_used": stored / denom if denom else 0.0,
+                "max_avail": max_avail,
+                "quota_bytes": pool.quota_max_bytes,
+                "quota_objects": pool.quota_max_objects,
+            })
+    return {
+        "stats": {
+            "total_bytes": total,
+            "total_used_raw_bytes": used,
+            "total_avail_bytes": avail,
+        },
+        "pools": pools,
+    }
+
+
+def assemble_osd_df(m, stats: dict) -> dict:
+    """`ceph osd df` payload (reference: OSDMonitor print_utilization
+    via PGMap::dump_osd_stats)."""
+    rows = []
+    if m is not None:
+        for o in range(m.max_osd):
+            if not m.exists(o):
+                continue
+            st = stats.get(f"osd.{o}", {})
+            sf = st.get("statfs") or {}
+            total = int(sf.get("total", 0))
+            used = int(sf.get("used", 0))
+            rows.append({
+                "id": o,
+                "up": int(m.is_up(o)),
+                "in": int(m.is_in(o)),
+                "reweight": m.osd_weight[o] / 0x10000,
+                "size": total,
+                "use": used,
+                "avail": int(sf.get("avail", 0)),
+                "utilization": used / total if total else 0.0,
+                "pgs": st.get("num_pgs", 0),
+            })
+    n = len(rows) or 1
+    return {
+        "nodes": rows,
+        "summary": {
+            "total_kb": sum(r["size"] for r in rows) // 1024,
+            "total_kb_used": sum(r["use"] for r in rows) // 1024,
+            "average_utilization":
+                sum(r["utilization"] for r in rows) / n,
+        },
+    }
 
 
 def assemble_osd_rows(m, stats: dict) -> list[dict]:
@@ -34,3 +142,31 @@ class StatusModule(MgrModule):
             "epoch": m.epoch if m else 0,
             "osds": assemble_osd_rows(m, self.mgr.latest_stats()),
         }
+
+    def build_digest(self) -> dict:
+        """The MMonMgrReport payload: everything the mon needs to
+        answer `df`/`osd df`/`pg dump` without talking to OSDs."""
+        m = self.get("osd_map")
+        stats = self.mgr.latest_stats()
+        pg_info: dict[str, dict] = {}
+        for st in stats.values():
+            pg_info.update(st.get("pg_info") or {})
+        return {
+            "df": assemble_df(m, stats),
+            "osd_df": assemble_osd_df(m, stats),
+            "pg_info": pg_info,
+        }
+
+    def serve(self) -> None:
+        interval = float(self.cct.conf.get("mgr_digest_interval"))
+        while not self._stop.wait(timeout=interval):
+            try:
+                rv, res = self.mon_command({
+                    "prefix": "mgr digest",
+                    "digest": self.build_digest(),
+                })
+                if rv != 0:
+                    self.cct.dout("mgr", 3,
+                                  f"digest push refused: {rv} {res}")
+            except Exception as e:
+                self.cct.dout("mgr", 3, f"digest push failed: {e!r}")

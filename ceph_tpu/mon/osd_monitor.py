@@ -181,6 +181,16 @@ class OSDMonitor:
             return 0, {"maps": out, "last": last}
         if prefix == "osd stat":
             return 0, self._stat()
+        if prefix == "mgr digest":
+            # reference: MMonMgrReport -> MgrStatMonitor; the mgr streams
+            # its PGMap digest here so df/pg-dump answer from the mon
+            d = cmd.get("digest")
+            if not isinstance(d, dict):
+                return -22, "digest must be a dict"
+            self.mgr_digest = (time.monotonic(), d)
+            return 0, "ok"
+        if prefix in ("df", "osd df", "pg dump"):
+            return self._cmd_from_digest(prefix)
         if prefix == "osd erasure-code-profile set":
             return self._cmd_profile_set(cmd)
         if prefix == "osd erasure-code-profile get":
@@ -618,6 +628,43 @@ class OSDMonitor:
         for root in sorted(roots, reverse=True):
             walk(root, 0)
         return rows
+
+    def _cmd_from_digest(self, prefix: str) -> tuple[int, object]:
+        """Serve `df`/`osd df`/`pg dump` from the mgr's streamed digest
+        (reference: MgrStatMonitor::preprocess_statfs / PGMap dumps).
+        pg-dump placement columns come live from the mon's own map —
+        only state/version need the digest."""
+        ts_digest = getattr(self, "mgr_digest", None)
+        if ts_digest is None:
+            # NOT -11: MonClient treats EAGAIN as "leader still syncing"
+            # and retry-loops into a misleading timeout
+            return -2, "no mgr digest yet (is the mgr running?)"
+        ts, digest = ts_digest
+        age = time.monotonic() - ts
+        if prefix == "df":
+            out = dict(digest.get("df") or {})
+            out["digest_age_seconds"] = round(age, 1)
+            return 0, out
+        if prefix == "osd df":
+            out = dict(digest.get("osd_df") or {})
+            out["digest_age_seconds"] = round(age, 1)
+            return 0, out
+        m = self.osdmap
+        pg_info = digest.get("pg_info") or {}
+        rows = []
+        for pid, pool in sorted(m.pools.items()):
+            for ps in range(pool.pg_num):
+                up, upp, acting, prim = m.pg_to_up_acting_osds(pid, ps)
+                pgid = f"{pid}.{ps}"
+                info = pg_info.get(pgid) or {}
+                rows.append({
+                    "pgid": pgid,
+                    "state": info.get("state", "unknown"),
+                    "version": info.get("version", 0),
+                    "up": up, "up_primary": upp,
+                    "acting": acting, "acting_primary": prim,
+                })
+        return 0, {"pg_stats": rows, "digest_age_seconds": round(age, 1)}
 
     def _stat(self) -> dict:
         m = self.osdmap

@@ -235,6 +235,42 @@ class SplitMigrationMixin:
                     pool_objects.get(pool_id, 0) + n_here
                 )
         self.logger.set("numpg", num_pgs)
+        # per-PG status rows, PRIMARY-reported so each PG has exactly one
+        # author (reference: pg_stat_t streamed inside MMgrReport)
+        pg_info: dict[str, dict] = {}
+        m = self.osdmap
+        if m is not None:
+            with self._pgs_lock:
+                snapshot = list(self.pgs.values())
+            for pg in snapshot:
+                pool = m.pools.get(pg.pool_id)
+                if pool is None:
+                    continue
+                try:
+                    _up, _upp, acting, prim = m.pg_to_up_acting_osds(
+                        pg.pool_id, pg.ps)
+                except (KeyError, IndexError, ValueError):
+                    continue
+                if prim != self.id:
+                    continue
+                # a PG that has never seen an interval CHANGE never runs
+                # the peering round — activated_interval stays -1 from
+                # birth.  That is healthy ONLY while interval_start is
+                # still 0; once an interval change lands, -1 means the
+                # first peering round hasn't finished and ops are being
+                # refused (primary_ops gates on activated==interval_start)
+                peered = (pg.activated_interval == pg.interval_start
+                          or (pg.activated_interval < 0
+                              and pg.interval_start == 0))
+                if peered:
+                    state = ("active+degraded"
+                             if len(acting) < pool.size else "active+clean")
+                else:
+                    state = "peering"
+                pg_info[pg.pgid] = {
+                    "state": state,
+                    "version": pg.version,
+                }
         try:
             self.messenger.connect((host, int(port))).send_message(
                 MMgrReport(
@@ -247,7 +283,9 @@ class SplitMigrationMixin:
                            },
                            "pool_objects": {
                                str(k): v for k, v in pool_objects.items()
-                           }},
+                           },
+                           "statfs": self.store.statfs(),
+                           "pg_info": pg_info},
                 )
             )
         except (OSError, ConnectionError, ValueError):

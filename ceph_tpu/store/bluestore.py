@@ -590,6 +590,15 @@ class BlueStore(ObjectStore):
                 if c == cid and not o.startswith("_")
             )
 
+    def statfs(self) -> dict:
+        # allocator truth, not onode sums: compression and block
+        # rounding make logical size diverge from device usage
+        total = self.n_blocks * self.block_size
+        with self._lock:
+            free = (self._alloc.free_blocks * self.block_size
+                    if self._alloc else total)
+        return {"total": total, "used": total - free, "avail": free}
+
     def collections_bytes(self) -> dict[str, int]:
         # single pass over the onode index (collection_bytes per cid
         # would rescan all onodes once per collection)

@@ -259,6 +259,16 @@ class ObjectStore:
             for cid in self.list_collections()
         }
 
+    def statfs(self) -> dict:
+        """{total, used, avail} device bytes (reference:
+        ObjectStore::statfs — feeds `ceph df` / `ceph osd df`).
+        Backends without a real device report a nominal 1 GiB device
+        with logical usage."""
+        total = 1 << 30
+        used = sum(self.collections_bytes().values())
+        return {"total": total, "used": used,
+                "avail": max(0, total - used)}
+
     # -- shared Transaction interpreter ------------------------------------
     # Backends that materialize state as {cid: Collection} dicts reuse this
     # (MemStore applies directly; KStore applies to its in-RAM image after

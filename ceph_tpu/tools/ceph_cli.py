@@ -60,6 +60,7 @@ def _build_command(words: list[str]) -> dict:
     for fixed in (
         "status", "health", "mon stat", "osd dump", "osd stat",
         "osd tree", "osd pool ls", "osd erasure-code-profile ls",
+        "df", "osd df", "pg dump",
     ):
         if joined == fixed:
             return {"prefix": fixed}
@@ -150,6 +151,57 @@ def _fs_status(mons, out) -> int:
         r.shutdown()
 
 
+def _human(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return (f"{n:.0f} {unit}" if unit == "B"
+                    else f"{n:.1f} {unit}")
+        n /= 1024
+    return str(n)
+
+
+def _render_df(res: dict, out) -> None:
+    st = res.get("stats", {})
+    print("--- RAW STORAGE ---", file=out)
+    print(f"{'SIZE':>10} {'AVAIL':>10} {'USED':>10} {'%USED':>7}",
+          file=out)
+    total = st.get("total_bytes", 0)
+    used = st.get("total_used_raw_bytes", 0)
+    print(f"{_human(total):>10} {_human(st.get('total_avail_bytes', 0)):>10}"
+          f" {_human(used):>10}"
+          f" {100 * used / total if total else 0:>6.2f}%", file=out)
+    print("\n--- POOLS ---", file=out)
+    print(f"{'POOL':<16} {'ID':>3} {'STORED':>10} {'OBJECTS':>8} "
+          f"{'%USED':>7} {'MAX AVAIL':>10}", file=out)
+    for p in res.get("pools", []):
+        print(f"{p['name']:<16} {p['id']:>3} {_human(p['stored']):>10} "
+              f"{p['objects']:>8} {100 * p['percent_used']:>6.2f}% "
+              f"{_human(p['max_avail']):>10}", file=out)
+
+
+def _render_osd_df(res: dict, out) -> None:
+    print(f"{'ID':>3} {'UP':>3} {'IN':>3} {'REWEIGHT':>8} {'SIZE':>10} "
+          f"{'USE':>10} {'AVAIL':>10} {'%USE':>6} {'PGS':>5}", file=out)
+    for r in res.get("nodes", []):
+        print(f"{r['id']:>3} {r['up']:>3} {r['in']:>3} "
+              f"{r['reweight']:>8.4f} {_human(r['size']):>10} "
+              f"{_human(r['use']):>10} {_human(r['avail']):>10} "
+              f"{100 * r['utilization']:>5.2f}% {r['pgs']:>5}", file=out)
+    s = res.get("summary", {})
+    print(f"TOTAL {_human(s.get('total_kb', 0) * 1024)} used "
+          f"{_human(s.get('total_kb_used', 0) * 1024)}  avg util "
+          f"{100 * s.get('average_utilization', 0):.2f}%", file=out)
+
+
+def _render_pg_dump(res: dict, out) -> None:
+    print(f"{'PG_ID':<8} {'STATE':<18} {'VERSION':>8} {'UP':<14} "
+          f"{'ACTING':<14} {'PRIMARY':>7}", file=out)
+    for r in res.get("pg_stats", []):
+        print(f"{r['pgid']:<8} {r['state']:<18} {r['version']:>8} "
+              f"{str(r['up']):<14} {str(r['acting']):<14} "
+              f"{r['acting_primary']:>7}", file=out)
+
+
 def main(argv=None, out=sys.stdout) -> int:
     ap = argparse.ArgumentParser(
         prog="ceph", description="cluster admin commands"
@@ -187,6 +239,12 @@ def main(argv=None, out=sys.stdout) -> int:
         _render_status(res, out)
     elif cmd["prefix"] == "osd tree":
         _render_tree(res, out)
+    elif cmd["prefix"] == "df":
+        _render_df(res, out)
+    elif cmd["prefix"] == "osd df":
+        _render_osd_df(res, out)
+    elif cmd["prefix"] == "pg dump":
+        _render_pg_dump(res, out)
     else:
         print(json.dumps(res, indent=2, default=str), file=out)
     return 0

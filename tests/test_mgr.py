@@ -338,3 +338,59 @@ def test_pool_quota_enforced_and_lifted():
             time.sleep(0.3)
         io.write_full("after", b"ok again")
         assert io.read("after") == b"ok again"
+
+
+@pytest.mark.cluster
+def test_df_osd_df_pg_dump_served_from_mgr_digest():
+    """The status module streams a PGMap digest to the mon; `ceph df`,
+    `ceph osd df` and `ceph pg dump` answer from it (reference:
+    MMonMgrReport -> MgrStatMonitor)."""
+    import io as _io
+    import time as _t
+
+    from ceph_tpu.qa.vstart import LocalCluster
+    from ceph_tpu.tools.ceph_cli import main as ceph_main
+
+    with LocalCluster(n_mons=1, n_osds=3, with_mgr=True) as c:
+        c.create_replicated_pool("dfp", size=3)
+        io = c.client().open_ioctx("dfp")
+        payload = b"x" * 4096
+        for i in range(8):
+            io.write_full(f"ob{i}", payload)
+        deadline = _t.time() + 30
+        df = None
+        while _t.time() < deadline:
+            rv, df = c.mon_command({"prefix": "df"})
+            if rv == 0 and any(p["stored"] >= 8 * 4096
+                               for p in df["pools"]):
+                break
+            _t.sleep(0.5)
+        assert rv == 0, df
+        pool = next(p for p in df["pools"] if p["name"] == "dfp")
+        # logical stored divides out the 3x replication
+        assert 8 * 4096 <= pool["stored"] < 3 * 8 * 4096
+        assert pool["objects"] == 8
+        assert df["stats"]["total_bytes"] > 0
+        rv, odf = c.mon_command({"prefix": "osd df"})
+        assert rv == 0
+        assert len(odf["nodes"]) == 3
+        assert all(r["size"] > 0 for r in odf["nodes"])
+        assert sum(r["use"] for r in odf["nodes"]) > 0
+        # pg dump: placement live from the map, state from the digest
+        deadline = _t.time() + 20
+        while _t.time() < deadline:
+            rv, dump = c.mon_command({"prefix": "pg dump"})
+            assert rv == 0
+            rows = [r for r in dump["pg_stats"]
+                    if r["pgid"].startswith(f"{pool['id']}.")]
+            if rows and all(r["state"] == "active+clean" for r in rows):
+                break
+            _t.sleep(0.5)
+        assert rows and all(r["state"] == "active+clean" for r in rows)
+        assert all(len(r["acting"]) == 3 for r in rows)
+        # CLI renders all three without error
+        mon = f"{c.mon_addrs[0][0]}:{c.mon_addrs[0][1]}"
+        for words in (["df"], ["osd", "df"], ["pg", "dump"]):
+            buf = _io.StringIO()
+            assert ceph_main(["-m", mon] + words, out=buf) == 0
+            assert buf.getvalue().strip()
