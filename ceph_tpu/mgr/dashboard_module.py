@@ -12,6 +12,7 @@ Endpoints (JSON unless noted):
     /api/perf             latest per-daemon perf counter snapshots
     /api/iostat           cluster + per-daemon IO rates (iostat module)
     /api/fs               MDS ranks, beacon liveness, subtree pins
+    /api/df               cluster + per-pool usage (same as `ceph df`)
 
 Read-only by design: mutations belong to the `ceph` CLI / mon command
 surface (the reference dashboard's write paths wrap the same mon
@@ -124,6 +125,13 @@ class DashboardModule(MgrModule):
             f"{pool_rows}</table></body></html>"
         )
 
+    def df(self) -> dict:
+        """Cluster/pool usage — same assembly the mon's `ceph df` serves
+        (status_module.assemble_df), so the two can never drift."""
+        from .status_module import assemble_df
+
+        return assemble_df(self.get("osd_map"), self.mgr.latest_stats())
+
     # -- http ---------------------------------------------------------------
     def _handler_class(self):
         module = self
@@ -153,6 +161,9 @@ class DashboardModule(MgrModule):
                         ctype = "application/json"
                     elif path == "/api/fs":
                         body = json.dumps(module.fs_ranks()).encode()
+                        ctype = "application/json"
+                    elif path == "/api/df":
+                        body = json.dumps(module.df()).encode()
                         ctype = "application/json"
                     else:
                         self.send_error(404)

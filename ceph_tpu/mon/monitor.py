@@ -480,6 +480,27 @@ class Monitor(Dispatcher):
                                f"capacity: {', '.join(no_rep)}",
                     "pools": no_rep,
                 }
+        # usage + pg-state summary from the mgr digest, when one has
+        # arrived (reference: `ceph -s` data/pgs sections via PGMap)
+        usage = {}
+        pgs_by_state: dict[str, int] = {}
+        ts_digest = getattr(self.osdmon, "mgr_digest", None)
+        # a dead mgr's last digest must not masquerade as current
+        # forever: past the stale-report age, drop the sections (the
+        # missing lines in `ceph -s` ARE the signal the mgr is gone)
+        max_age = self.cct.conf.get("mgr_stale_report_age")
+        if ts_digest is not None \
+                and time.monotonic() - ts_digest[0] <= max_age:
+            digest = ts_digest[1]
+            st = (digest.get("df") or {}).get("stats") or {}
+            usage = {
+                "total_bytes": st.get("total_bytes", 0),
+                "total_used_raw_bytes": st.get("total_used_raw_bytes", 0),
+                "total_avail_bytes": st.get("total_avail_bytes", 0),
+            }
+            for info in (digest.get("pg_info") or {}).values():
+                s = info.get("state", "unknown")
+                pgs_by_state[s] = pgs_by_state.get(s, 0) + 1
         return {
             "health": {
                 "status": "HEALTH_WARN" if checks else "HEALTH_OK",
@@ -488,6 +509,8 @@ class Monitor(Dispatcher):
             "quorum": self.quorum,
             "leader": self.leader_rank,
             "osdmap": osd,
+            "usage": usage,
+            "pgs_by_state": pgs_by_state,
             "paxos": {
                 "version": self.paxos.last_committed,
                 "pn": self.paxos.accepted_pn,

@@ -264,10 +264,10 @@ class ObjectStore:
         ObjectStore::statfs — feeds `ceph df` / `ceph osd df`).
         Backends without a real device report a nominal 1 GiB device
         with logical usage."""
-        total = 1 << 30
         used = sum(self.collections_bytes().values())
+        total = max(1 << 30, used)  # invariant: used <= total
         return {"total": total, "used": used,
-                "avail": max(0, total - used)}
+                "avail": total - used}
 
     # -- shared Transaction interpreter ------------------------------------
     # Backends that materialize state as {cid: Collection} dicts reuse this

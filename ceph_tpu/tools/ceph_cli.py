@@ -32,6 +32,15 @@ def _render_status(res: dict, out) -> None:
         f"(epoch {osd.get('epoch', 0)})",
         file=out,
     )
+    usage = res.get("usage") or {}
+    if usage.get("total_bytes"):
+        print(f"  data: {_human(usage.get('total_used_raw_bytes', 0))} "
+              f"used, {_human(usage.get('total_avail_bytes', 0))} / "
+              f"{_human(usage['total_bytes'])} avail", file=out)
+    pgs = res.get("pgs_by_state") or {}
+    if pgs:
+        parts = ", ".join(f"{n} {s}" for s, n in sorted(pgs.items()))
+        print(f"  pgs: {parts}", file=out)
 
 
 def _render_tree(rows: list, out) -> None:

@@ -89,6 +89,8 @@ def main(argv=None, out=sys.stdout) -> int:
     p = sub.add_parser("rmsnap")
     p.add_argument("snapname")
     sub.add_parser("lssnap")
+    sub.add_parser("df", help="per-pool usage (cluster `df` scoped "
+                                "to -p)")
     p = sub.add_parser("setxattr")
     p.add_argument("oid")
     p.add_argument("name")
@@ -139,6 +141,17 @@ def main(argv=None, out=sys.stdout) -> int:
             else:
                 with open(args.outfile, "wb") as f:
                     f.write(data)
+        elif args.op == "df":
+            rv, res = r.command({"prefix": "df"})
+            if rv != 0:
+                print(f"rados: df: {res}", file=sys.stderr)
+                return 1
+            print(f"{'POOL':<16} {'STORED':>12} {'OBJECTS':>8}", file=out)
+            for pe in res.get("pools", []):
+                if pe["name"] != args.pool:
+                    continue
+                print(f"{pe['name']:<16} {pe['stored']:>12} "
+                      f"{pe['objects']:>8}", file=out)
         elif args.op == "setxattr":
             io.set_xattr(args.oid, args.name, args.value.encode())
         elif args.op == "getxattr":

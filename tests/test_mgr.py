@@ -394,3 +394,45 @@ def test_df_osd_df_pg_dump_served_from_mgr_digest():
             buf = _io.StringIO()
             assert ceph_main(["-m", mon] + words, out=buf) == 0
             assert buf.getvalue().strip()
+
+
+@pytest.mark.cluster
+def test_status_shows_usage_and_pg_states_and_rados_df():
+    """`ceph -s` folds the digest's usage + pg-state summary in, the
+    dashboard serves /api/df, and `rados df` renders pool rows."""
+    import io as _io
+
+    from ceph_tpu.qa.vstart import LocalCluster
+    from ceph_tpu.tools import rados as rados_tool
+    from ceph_tpu.tools.ceph_cli import main as ceph_main
+
+    with LocalCluster(n_mons=1, n_osds=2, with_mgr=True,
+                      conf_overrides={"mgr_modules":
+                                      "status,dashboard"}) as c:
+        c.create_replicated_pool("sp", size=2)
+        io = c.client().open_ioctx("sp")
+        io.write_full("o", b"q" * 2048)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            rv, st = c.mon_command({"prefix": "status"})
+            assert rv == 0
+            if st.get("usage", {}).get("total_bytes") and \
+                    st.get("pgs_by_state"):
+                break
+            time.sleep(0.5)
+        assert st["usage"]["total_bytes"] > 0
+        assert sum(st["pgs_by_state"].values()) >= 1
+        mon = f"{c.mon_addrs[0][0]}:{c.mon_addrs[0][1]}"
+        buf = _io.StringIO()
+        assert ceph_main(["-m", mon, "status"], out=buf) == 0
+        text = buf.getvalue()
+        assert "data:" in text and "pgs:" in text
+        buf = _io.StringIO()
+        assert rados_tool.main(["-m", mon, "-p", "sp", "df"],
+                               out=buf) == 0
+        assert "sp" in buf.getvalue()
+        url = c.mgr.module("dashboard").url
+        body = urllib.request.urlopen(f"{url}/api/df", timeout=5).read()
+        import json as _json
+        df = _json.loads(body)
+        assert df["stats"]["total_bytes"] > 0
