@@ -10,6 +10,7 @@ single-host cluster tests work (SURVEY.md §4).
 """
 from __future__ import annotations
 
+import os
 from .admin_socket import AdminSocket
 from .config import Config, LEVEL_CMDLINE
 from .heartbeat import HeartbeatMap
@@ -40,6 +41,11 @@ class CephContext:
         self.admin_socket: AdminSocket | None = None
         sock_path = self.conf.get("admin_socket")
         if sock_path:
+            # metavariable expansion (reference: config $name/$pid) so a
+            # cluster-wide override yields one socket per daemon
+            sock_path = (sock_path
+                         .replace("$name", self.conf.get("name"))
+                         .replace("$pid", str(os.getpid())))
             self.admin_socket = AdminSocket(sock_path)
             self._register_default_commands()
             self.admin_socket.start()

@@ -151,10 +151,13 @@ class StatusModule(MgrModule):
         pg_info: dict[str, dict] = {}
         for st in stats.values():
             pg_info.update(st.get("pg_info") or {})
+        slow = {d: int(st.get("slow_ops", 0))
+                for d, st in stats.items() if st.get("slow_ops")}
         return {
             "df": assemble_df(m, stats),
             "osd_df": assemble_osd_df(m, stats),
             "pg_info": pg_info,
+            "slow_ops": slow,
         }
 
     def serve(self) -> None:

@@ -492,6 +492,17 @@ class Monitor(Dispatcher):
         if ts_digest is not None \
                 and time.monotonic() - ts_digest[0] <= max_age:
             digest = ts_digest[1]
+            slow = digest.get("slow_ops") or {}
+            if slow:
+                # reference: the SLOW_OPS health warning from optracker
+                # complaint counts streamed through the mgr
+                n = sum(slow.values())
+                checks["SLOW_OPS"] = {
+                    "severity": "HEALTH_WARN",
+                    "message": f"{n} slow ops on "
+                               f"{', '.join(sorted(slow))}",
+                    "daemons": sorted(slow),
+                }
             st = (digest.get("df") or {}).get("stats") or {}
             usage = {
                 "total_bytes": st.get("total_bytes", 0),

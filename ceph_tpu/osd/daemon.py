@@ -42,6 +42,7 @@ import time
 
 from ..common.lockdep import make_lock
 from ..common.perf_counters import PerfCountersBuilder
+from ..common.tracked_op import OpTracker
 from ..ec.registry import ErasureCodePluginRegistry
 from ..mon.mon_client import MonClient
 from ..msg import Dispatcher, Messenger
@@ -209,6 +210,25 @@ class OSD(
             .add_u64("numpg", "placement groups hosted")
             .create_perf_counters()
         )
+        # in-flight + historic op tracking (reference: OSD's OpTracker;
+        # src/common/TrackedOp.cc — serves dump_ops_in_flight /
+        # dump_historic_ops on the admin socket and feeds the SLOW_OPS
+        # health check through the mgr digest)
+        self.op_tracker = OpTracker(
+            history_size=int(cct.conf.get("osd_op_history_size")),
+            complaint_time=float(cct.conf.get("osd_op_complaint_time")),
+        )
+        if cct.admin_socket is not None:
+            cct.admin_socket.register_command(
+                "dump_ops_in_flight",
+                lambda c: self.op_tracker.dump_ops_in_flight(),
+                "ops currently executing",
+            )
+            cct.admin_socket.register_command(
+                "dump_historic_ops",
+                lambda c: self.op_tracker.dump_historic_ops(),
+                "recently completed ops",
+            )
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:

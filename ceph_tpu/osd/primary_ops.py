@@ -33,14 +33,21 @@ class PrimaryOpsMixin:
             self.logger.inc("op_w_bytes", len(msg.data or "") * 3 // 4)
         elif msg.op == "read":
             self.logger.inc("op_r")
+        tracked = self.op_tracker.create(
+            f"osd_op({msg.op} {msg.pool}.{msg.oid} tid={msg.tid})"
+        )
         try:
+            tracked.mark_event("started")
             reply = self._execute_client_op(msg)
         except Exception as e:  # never leave the client hanging
+            tracked.mark_event(f"failed: {e!r}")
             self.cct.dout("osd", 0, f"{self.whoami} op failed: {e!r}")
             reply = MOSDOpReply(
                 tid=msg.tid, retval=-5, epoch=self.my_epoch(),
                 result=f"internal error: {e}",
             )
+        finally:
+            tracked.finish()
         if msg.op == "read" and reply.retval == 0 and reply.data:
             self.logger.inc("op_r_bytes", len(reply.data) * 3 // 4)
         self.logger.tinc("op_latency", time.perf_counter() - t0)

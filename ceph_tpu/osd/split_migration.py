@@ -285,6 +285,7 @@ class SplitMigrationMixin:
                                str(k): v for k, v in pool_objects.items()
                            },
                            "statfs": self.store.statfs(),
+                           "slow_ops": len(self.op_tracker.slow_ops()),
                            "pg_info": pg_info},
                 )
             )

@@ -122,10 +122,10 @@ class LocalCluster:
         return self
 
     def _cct(self, name: str) -> CephContext:
-        cct = CephContext(name)
-        for k, v in self.conf_overrides.items():
-            cct.conf.set(k, v)
-        return cct
+        # overrides go through the constructor: init-time features
+        # (admin socket, lockdep) read conf DURING __init__, so setting
+        # them afterwards would silently not take
+        return CephContext(name, overrides=dict(self.conf_overrides))
 
     def _start_osd(self, i: int, store=None) -> OSD:
         osd = OSD(self._cct(f"osd.{i}"), i, self.mon_addrs, store=store)

@@ -432,7 +432,7 @@ def test_status_shows_usage_and_pg_states_and_rados_df():
                                out=buf) == 0
         assert "sp" in buf.getvalue()
         url = c.mgr.module("dashboard").url
-        body = urllib.request.urlopen(f"{url}/api/df", timeout=5).read()
+        body = urllib.request.urlopen(f"{url}api/df", timeout=5).read()
         import json as _json
         df = _json.loads(body)
         assert df["stats"]["total_bytes"] > 0
