@@ -134,6 +134,17 @@ class Config:
                 return val.by_level[max(val.by_level)]
         return opt.default
 
+    def get_expanded(self, name: str) -> Any:
+        """get() plus metavariable expansion for path-like string
+        options (reference: config $name/$pid expansion in
+        md_config_t::expand_meta) — so one cluster-wide override like
+        `$name.asok` yields a distinct path per daemon."""
+        val = self.get(name)
+        if isinstance(val, str) and "$" in val:
+            val = (val.replace("$name", str(self.get("name")))
+                      .replace("$pid", str(os.getpid())))
+        return val
+
     def __getitem__(self, name: str) -> Any:
         return self.get(name)
 

@@ -39,13 +39,8 @@ class CephContext:
         # CephXTicketManager)
         self.tickets: dict[str, dict] = {}
         self.admin_socket: AdminSocket | None = None
-        sock_path = self.conf.get("admin_socket")
+        sock_path = self.conf.get_expanded("admin_socket")
         if sock_path:
-            # metavariable expansion (reference: config $name/$pid) so a
-            # cluster-wide override yields one socket per daemon
-            sock_path = (sock_path
-                         .replace("$name", self.conf.get("name"))
-                         .replace("$pid", str(os.getpid())))
             self.admin_socket = AdminSocket(sock_path)
             self._register_default_commands()
             self.admin_socket.start()

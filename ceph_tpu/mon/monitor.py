@@ -465,6 +465,20 @@ class Monitor(Dispatcher):
                                f"{', '.join(full)}",
                     "pools": full,
                 }
+            untagged = sorted(
+                p.name for p in m.pools.values()
+                if not p.application and p.tier_of < 0
+            )
+            if untagged:
+                # reference: POOL_APP_NOT_ENABLED (mgr health checks) —
+                # cache tiers inherit their base pool's application
+                checks["POOL_APP_NOT_ENABLED"] = {
+                    "severity": "HEALTH_WARN",
+                    "message": f"{len(untagged)} pool(s) have no "
+                               f"application enabled: "
+                               f"{', '.join(untagged)}",
+                    "pools": untagged,
+                }
             no_rep = sorted(
                 p.name for p in m.pools.values()
                 if sum(1 for o in range(m.max_osd)

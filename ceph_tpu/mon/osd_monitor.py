@@ -288,6 +288,17 @@ class OSDMonitor:
                 if self._propose_map(m) else (-110, "proposal timed out")
         if prefix == "osd pool rm":
             return self._cmd_pool_rm(cmd)
+        if prefix == "osd pool application enable":
+            return self._cmd_pool_application(cmd, enable=True)
+        if prefix == "osd pool application disable":
+            return self._cmd_pool_application(cmd, enable=False)
+        if prefix == "osd pool application get":
+            m = self.osdmap
+            pool = next((p for p in m.pools.values()
+                         if p.name == cmd.get("pool")), None)
+            if pool is None:
+                return -2, f"no pool {cmd.get('pool')!r}"
+            return 0, pool.application
         if prefix == "osd pool rename":
             src_n, dst_n = cmd.get("srcpool", ""), cmd.get("destpool", "")
             if not src_n or not dst_n:
@@ -702,6 +713,37 @@ class OSDMonitor:
             "k": codec.get_data_chunk_count(),
             "m": codec.get_chunk_count() - codec.get_data_chunk_count(),
         }
+
+    def _cmd_pool_application(self, cmd: dict,
+                              enable: bool) -> tuple[int, object]:
+        """reference: OSDMonitor prepare_command_pool_application —
+        tag a pool with the client application using it (rbd/rgw/
+        cephfs/rados); untagged pools raise POOL_APP_NOT_ENABLED."""
+        app = cmd.get("app", "")
+        if not app:
+            return -22, "application name required"
+        m = self._pending()
+        pool = next((p for p in m.pools.values()
+                     if p.name == cmd.get("pool")), None)
+        if pool is None:
+            return -2, f"no pool {cmd.get('pool')!r}"
+        if enable and app in pool.application:
+            return 0, f"application {app!r} already enabled"
+        if not enable and app not in pool.application:
+            return 0, f"application {app!r} not enabled"
+        if enable:
+            if pool.application and app not in pool.application \
+                    and cmd.get("sure") != "--yes-i-really-mean-it":
+                other = next(iter(pool.application))
+                return -1, (f"pool {pool.name!r} already has application "
+                            f"{other!r}; pass --yes-i-really-mean-it to "
+                            f"enable a second one")
+            pool.application[app] = {}
+        else:
+            pool.application.pop(app, None)
+        verb = "enabled on" if enable else "disabled on"
+        return (0, f"application {app!r} {verb} pool {pool.name!r}") \
+            if self._propose_map(m) else (-110, "proposal timed out")
 
     def _cmd_pool_rm(self, cmd: dict) -> tuple[int, object]:
         """`osd pool rm <name> <name> --yes-i-really-really-mean-it`

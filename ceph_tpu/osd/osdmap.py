@@ -111,6 +111,9 @@ class PGPool:
     quota_max_bytes: int = 0
     quota_max_objects: int = 0
     flags: list = field(default_factory=list)
+    # enabled applications, app -> metadata (reference:
+    # pg_pool_t::application_metadata + the POOL_APP_NOT_ENABLED check)
+    application: dict = field(default_factory=dict)
 
     def __post_init__(self):
         if not self.pgp_num:
@@ -132,6 +135,7 @@ class PGPool:
         # a failed proposal's mutation would leak into committed state
         self.flags = list(self.flags or [])
         self.tiers = list(self.tiers or [])
+        self.application = dict(self.application or {})
 
     def raw_pg_to_pps(self, ps: int) -> int:
         """reference: pg_pool_t::raw_pg_to_pps, FLAG_HASHPSPOOL branch —

@@ -230,10 +230,15 @@ class LocalCluster:
             "pool_type": "erasure", "erasure_code_profile": f"{name}_profile",
         })
         assert rv == 0, (rv, res)
+        rv, res = self.mon_command({
+            "prefix": "osd pool application enable",
+            "pool": name, "app": "rados"})
+        assert rv == 0, (rv, res)
 
     def create_replicated_pool(self, name: str, size: int = 3,
                                pg_num: int = 8,
-                               min_size: int | None = None) -> None:
+                               min_size: int | None = None,
+                               app: str = "rados") -> None:
         cmd = {
             "prefix": "osd pool create", "name": name, "pg_num": pg_num,
             "size": size,
@@ -242,8 +247,13 @@ class LocalCluster:
             cmd["min_size"] = min_size
         rv, res = self.mon_command(cmd)
         assert rv == 0, (rv, res)
+        rv, res = self.mon_command({
+            "prefix": "osd pool application enable",
+            "pool": name, "app": app})
+        assert rv == 0, (rv, res)
 
-    def _ensure_replicated_pools(self, *names: str) -> None:
+    def _ensure_replicated_pools(self, *names: str,
+                                 app: str = "rados") -> None:
         """Create any of `names` that don't exist yet (service-pool
         bootstrap shared by the MDS and RGW starters)."""
         existing = {
@@ -251,7 +261,8 @@ class LocalCluster:
         }
         for name in names:
             if name not in existing:
-                self.create_replicated_pool(name, size=min(3, self.n_osds))
+                self.create_replicated_pool(
+                    name, size=min(3, self.n_osds), app=app)
 
     # -- filesystem (reference: vstart.sh's cephfs setup) ------------------
     def start_mds(self) -> None:
@@ -259,7 +270,8 @@ class LocalCluster:
         `ceph fs new` + ceph-mds boot)."""
         from ..fs import MDSDaemon
 
-        self._ensure_replicated_pools("cephfs_meta", "cephfs_data")
+        self._ensure_replicated_pools("cephfs_meta", "cephfs_data",
+                                      app="cephfs")
         # restarts REBIND the previous address so surviving clients can
         # reach the new incarnation (the mon's MDSMap would republish it
         # upstream; here the addr is stable across failover instead)
@@ -329,7 +341,7 @@ class LocalCluster:
         """Create the rgw pools (if absent) and start the S3 gateway."""
         from ..rgw import RGWDaemon
 
-        self._ensure_replicated_pools("rgw_meta", "rgw_data")
+        self._ensure_replicated_pools("rgw_meta", "rgw_data", app="rgw")
         self.rgw = RGWDaemon(self._cct("rgw.0"), self.mon_addrs)
         self.rgw.start()
         return self.rgw

@@ -92,6 +92,25 @@ def _build_command(words: list[str]) -> dict:
         if len(words) > 5:
             cmd["sure"] = words[5]
         return cmd
+    if words[:4] == ["osd", "pool", "application", "enable"]:
+        if len(words) < 6:
+            raise ValueError(
+                "usage: osd pool application enable <pool> <app>")
+        cmd = {"prefix": "osd pool application enable",
+               "pool": words[4], "app": words[5]}
+        if len(words) > 6:
+            cmd["sure"] = words[6]
+        return cmd
+    if words[:4] == ["osd", "pool", "application", "disable"]:
+        if len(words) < 6:
+            raise ValueError(
+                "usage: osd pool application disable <pool> <app>")
+        return {"prefix": "osd pool application disable",
+                "pool": words[4], "app": words[5]}
+    if words[:4] == ["osd", "pool", "application", "get"]:
+        if len(words) < 5:
+            raise ValueError("usage: osd pool application get <pool>")
+        return {"prefix": "osd pool application get", "pool": words[4]}
     if words[:3] == ["osd", "pool", "rename"]:
         if len(words) < 5:
             raise ValueError("usage: osd pool rename <src> <dest>")
@@ -222,6 +241,33 @@ def main(argv=None, out=sys.stdout) -> int:
     args = ap.parse_args(argv)
     if not args.words:
         ap.error("no command")
+    if args.words and args.words[0] == "daemon":
+        # ceph daemon <socket-path> <command...> (reference: ceph.in
+        # admin-socket mode: `ceph daemon osd.0 perf dump`)
+        if len(args.words) < 3:
+            print("usage: ceph daemon <asok-path> <command...>",
+                  file=sys.stderr)
+            return 22
+        from ..common.admin_socket import admin_socket_command
+
+        # k=v tokens become command fields, the rest joins into the
+        # prefix: `ceph daemon x.asok config get var=debug_osd`
+        cmd = {}
+        prefix_words = []
+        for w in args.words[2:]:
+            if "=" in w and not w.startswith("="):
+                k, _, v = w.partition("=")
+                cmd[k] = v
+            else:
+                prefix_words.append(w)
+        cmd["prefix"] = " ".join(prefix_words)
+        try:
+            res = admin_socket_command(args.words[1], cmd)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(res, indent=2, default=str), file=out)
+        return 0
     if args.words[:2] == ["fs", "status"]:
         try:
             return _fs_status(_parse_mons(args.mon), out)

@@ -641,3 +641,69 @@ def test_pool_rm_down_osd_purges_on_revive_and_ids_not_reused():
         m = c._leader().osdmon.osdmap
         fresh = next(p for p in m.pools.values() if p.name == "fresh")
         assert fresh.pool_id > dead_id
+
+
+@pytest.mark.cluster
+def test_pool_application_tagging_and_health():
+    """Untagged pools raise POOL_APP_NOT_ENABLED; enabling an
+    application clears it; a second app needs the confirmation flag
+    (reference: prepare_command_pool_application)."""
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    with LocalCluster(n_mons=1, n_osds=2) as c:
+        # raw pool create, no application
+        rv, _ = c.mon_command({"prefix": "osd pool create",
+                               "name": "bare", "pg_num": 4, "size": 2})
+        assert rv == 0
+        rv, st = c.mon_command({"prefix": "status"})
+        assert "POOL_APP_NOT_ENABLED" in st["health"]["checks"]
+        assert "bare" in st["health"]["checks"][
+            "POOL_APP_NOT_ENABLED"]["pools"]
+        rv, res = c.mon_command({"prefix": "osd pool application enable",
+                                 "pool": "bare", "app": "rbd"})
+        assert rv == 0, res
+        rv, st = c.mon_command({"prefix": "status"})
+        assert "POOL_APP_NOT_ENABLED" not in st["health"]["checks"]
+        # second app requires the flag
+        assert c.mon_command({"prefix": "osd pool application enable",
+                              "pool": "bare", "app": "rgw"})[0] == -1
+        rv, _ = c.mon_command({"prefix": "osd pool application enable",
+                               "pool": "bare", "app": "rgw",
+                               "sure": "--yes-i-really-mean-it"})
+        assert rv == 0
+        rv, apps = c.mon_command({"prefix": "osd pool application get",
+                                  "pool": "bare"})
+        assert rv == 0 and set(apps) == {"rbd", "rgw"}
+        rv, _ = c.mon_command({"prefix": "osd pool application disable",
+                               "pool": "bare", "app": "rgw"})
+        assert rv == 0
+
+
+@pytest.mark.cluster
+def test_ceph_daemon_cli_hits_admin_socket():
+    import io as _io
+    import tempfile
+
+    from ceph_tpu.qa.vstart import LocalCluster
+    from ceph_tpu.tools.ceph_cli import main as ceph_main
+
+    with tempfile.TemporaryDirectory() as td:
+        with LocalCluster(
+            n_mons=1, n_osds=2,
+            conf_overrides={"admin_socket": f"{td}/$name.asok"},
+        ) as c:
+            osd = next(iter(c.osds.values()))
+            path = osd.cct.admin_socket.path
+            mon = f"{c.mon_addrs[0][0]}:{c.mon_addrs[0][1]}"
+            buf = _io.StringIO()
+            assert ceph_main(["-m", mon, "daemon", path, "perf", "dump"],
+                             out=buf) == 0
+            assert "osd" in buf.getvalue()
+            buf = _io.StringIO()
+            assert ceph_main(
+                ["-m", mon, "daemon", path, "config", "get",
+                 "var=osd_op_complaint_time"], out=buf) == 0
+            assert "30" in buf.getvalue()
+            buf = _io.StringIO()
+            assert ceph_main(["-m", mon, "daemon", path,
+                              "dump_historic_ops"], out=buf) == 0
