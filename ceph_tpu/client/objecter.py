@@ -180,7 +180,7 @@ class Objecter(Dispatcher):
         CEPH_OSD_FLAG_IGNORE_OVERLAY).  Pool listings stay on the pool
         the caller named — `rados ls` on the base enumerates the base."""
         pool = m.pools.get(pool_id)
-        if pool is None or ignore_overlay or op in ("list", "scrub"):
+        if pool is None or ignore_overlay or op in ("list", "scrub", "scrub-noprepair"):
             return pool_id
         tier = pool.write_tier if op in self._WRITE_OPS else pool.read_tier
         if tier >= 0 and tier in m.pools:
@@ -198,7 +198,7 @@ class Objecter(Dispatcher):
         if pool is None:
             return False
         try:
-            ps = (int(oid[4:]) if op in ("list", "scrub")
+            ps = (int(oid[4:]) if op in ("list", "scrub", "scrub-noprepair")
                   and oid.startswith(":pg:") else object_ps(oid, pool.pg_num))
             _up, _upp, acting, _primary = m.pg_to_up_acting_osds(pool_id, ps)
         except Exception:
@@ -217,7 +217,7 @@ class Objecter(Dispatcher):
         pool = m.pools.get(pool_id)
         if pool is None:
             raise KeyError(f"no pool {pool_id}")
-        if op in ("list", "scrub") and oid.startswith(":pg:"):
+        if op in ("list", "scrub", "scrub-noprepair") and oid.startswith(":pg:"):
             # pg-targeted pseudo-oid — honored by the OSD only for these
             # ops; anything else treats ':pg:*' as a normal name
             ps = int(oid[4:])

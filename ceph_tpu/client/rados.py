@@ -291,11 +291,14 @@ class IoCtx:
             raise KeyError(name)
         return attrs[name]
 
-    def scrub_pg(self, ps: int) -> dict:
-        """Deep-scrub one PG on its primary; returns the scrub report
-        (reference: `ceph pg deep-scrub` reaching the primary)."""
+    def scrub_pg(self, ps: int, repair: bool = True) -> dict:
+        """Deep-scrub one PG on its primary; returns the scrub report.
+        repair=False inspects only — divergent replicas are reported,
+        not rewritten (reference: `ceph pg deep-scrub` vs `pg repair`
+        reaching the primary)."""
         rep = self._client.objecter.op_submit(
-            self.pool_id, f":pg:{ps}", "scrub", timeout=60.0
+            self.pool_id, f":pg:{ps}",
+            "scrub" if repair else "scrub-noprepair", timeout=60.0
         )
         if rep.retval != 0:
             raise IOError(f"scrub pg {ps}: {rep.retval} {rep.result}")

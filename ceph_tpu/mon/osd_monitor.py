@@ -288,6 +288,10 @@ class OSDMonitor:
                 if self._propose_map(m) else (-110, "proposal timed out")
         if prefix == "osd pool rm":
             return self._cmd_pool_rm(cmd)
+        if prefix == "osd ok-to-stop":
+            return self._cmd_ok_to_stop(cmd)
+        if prefix == "osd safe-to-destroy":
+            return self._cmd_safe_to_destroy(cmd)
         if prefix == "osd pool application enable":
             return self._cmd_pool_application(cmd, enable=True)
         if prefix == "osd pool application disable":
@@ -714,6 +718,69 @@ class OSDMonitor:
             "m": codec.get_chunk_count() - codec.get_data_chunk_count(),
         }
 
+    def _cmd_ok_to_stop(self, cmd: dict) -> tuple[int, object]:
+        """Would stopping these OSDs leave every PG at or above
+        min_size?  Pure map arithmetic (reference: OSDMonitor
+        check_pg_num / ok-to-stop returning EBUSY when data
+        availability would be lost)."""
+        try:
+            ids = {int(i) for i in cmd.get("ids", [])}
+        except (TypeError, ValueError):
+            return -22, "ids must be osd numbers"
+        if not ids:
+            return -22, "no osd ids given"
+        m = self.osdmap
+        unsafe = []
+        for pid, pool in m.pools.items():
+            for ps in range(pool.pg_num):
+                _up, _upp, acting, _p = m.pg_to_up_acting_osds(pid, ps)
+                left = [o for o in acting if o not in ids and o >= 0]
+                if acting and len(left) < pool.min_size:
+                    unsafe.append(f"{pid}.{ps}")
+        if unsafe:
+            return -16, {
+                "ok_to_stop": False,
+                "unsafe_pgs": unsafe[:32],
+                "num_unsafe": len(unsafe),
+            }
+        return 0, {"ok_to_stop": True, "osds": sorted(ids)}
+
+    def _cmd_safe_to_destroy(self, cmd: dict) -> tuple[int, object]:
+        """Destroying is safe once the OSD hosts no PGs: it must be out
+        of every acting set AND its last mgr-reported pg count must be
+        zero (reference: OSDMonitor osd safe-to-destroy)."""
+        try:
+            osd = int(cmd.get("id", -1))
+        except (TypeError, ValueError):
+            return -22, "bad osd id"
+        m = self.osdmap
+        if not (0 <= osd < m.max_osd) or not m.exists(osd):
+            return -2, f"osd.{osd} does not exist"
+        mapped = []
+        for pid, pool in m.pools.items():
+            for ps in range(pool.pg_num):
+                _up, _upp, acting, _p = m.pg_to_up_acting_osds(pid, ps)
+                if osd in acting:
+                    mapped.append(f"{pid}.{ps}")
+        ts_digest = getattr(self, "mgr_digest", None)
+        reported = None
+        if ts_digest is not None:
+            for row in (ts_digest[1].get("osd_df") or {}).get("nodes", []):
+                if row.get("id") == osd:
+                    reported = row.get("pgs")
+        if mapped:
+            return -16, {"safe": False, "mapped_pgs": len(mapped)}
+        if reported is None:
+            # no mgr stats: refuse rather than approve blind — the OSD
+            # may still hold data being drained (reference returns
+            # EAGAIN "no osd_stat"; -11 would make MonClient retry-loop)
+            return -16, {"safe": False,
+                         "reason": "no mgr pg report for this osd "
+                                   "(is the mgr running?)"}
+        if reported != 0:
+            return -16, {"safe": False, "reported_pgs": reported}
+        return 0, {"safe": True, "osd": osd}
+
     def _cmd_pool_application(self, cmd: dict,
                               enable: bool) -> tuple[int, object]:
         """reference: OSDMonitor prepare_command_pool_application —
@@ -732,7 +799,9 @@ class OSDMonitor:
         if not enable and app not in pool.application:
             return 0, f"application {app!r} not enabled"
         if enable:
-            if pool.application and app not in pool.application \
+            # only reached when `app` is NOT yet enabled (early return
+            # above): the guard fires on "a different app already set"
+            if pool.application \
                     and cmd.get("sure") != "--yes-i-really-mean-it":
                 other = next(iter(pool.application))
                 return -1, (f"pool {pool.name!r} already has application "

@@ -83,7 +83,7 @@ class PrimaryOpsMixin:
             return MOSDOpReply(tid=msg.tid, retval=-2, epoch=self.my_epoch(),
                                result="no such pool")
         if (
-            msg.op in ("list", "scrub")
+            msg.op in ("list", "scrub", "scrub-noprepair")
             and msg.oid
             and msg.oid.startswith(":pg:")
         ):
@@ -94,9 +94,10 @@ class PrimaryOpsMixin:
             ps = int(msg.ps)
         else:
             ps = object_ps(msg.oid, pool.pg_num) if msg.oid else 0
-        if msg.op == "scrub":
+        if msg.op in ("scrub", "scrub-noprepair"):
             try:
-                result = self.scrub_pg(msg.pool, ps, repair=True)
+                result = self.scrub_pg(msg.pool, ps,
+                                       repair=msg.op == "scrub")
                 return MOSDOpReply(tid=msg.tid, retval=0,
                                    epoch=self.my_epoch(), result=result)
             except RuntimeError:

@@ -111,6 +111,14 @@ def _build_command(words: list[str]) -> dict:
         if len(words) < 5:
             raise ValueError("usage: osd pool application get <pool>")
         return {"prefix": "osd pool application get", "pool": words[4]}
+    if words[:2] == ["osd", "ok-to-stop"]:
+        if len(words) < 3:
+            raise ValueError("usage: osd ok-to-stop <id> [<id>...]")
+        return {"prefix": "osd ok-to-stop", "ids": words[2:]}
+    if words[:2] == ["osd", "safe-to-destroy"]:
+        if len(words) < 3:
+            raise ValueError("usage: osd safe-to-destroy <id>")
+        return {"prefix": "osd safe-to-destroy", "id": words[2]}
     if words[:3] == ["osd", "pool", "rename"]:
         if len(words) < 5:
             raise ValueError("usage: osd pool rename <src> <dest>")
@@ -268,6 +276,43 @@ def main(argv=None, out=sys.stdout) -> int:
             return 1
         print(json.dumps(res, indent=2, default=str), file=out)
         return 0
+    if args.words[0] == "pg" and len(args.words) >= 3 \
+            and args.words[1] in ("scrub", "deep-scrub", "repair"):
+        # reference: `ceph pg repair <pgid>` — the mon tells the PG's
+        # primary; here the CLI acts as the client and drives the
+        # primary directly (same wire path the rados tool uses)
+        try:
+            pool_s, _, ps_s = args.words[2].partition(".")
+            pool_id, ps = int(pool_s), int(ps_s)
+            mons = _parse_mons(args.mon)
+        except ValueError as e:
+            print(f"error: bad pgid {args.words[2]!r}: {e}",
+                  file=sys.stderr)
+            return 22
+        from ..client.rados import Rados
+        from ..common.context import CephContext as _Cct
+
+        client = Rados(_Cct("client.ceph-cli"), mons)
+        try:
+            client.connect(timeout=10.0)
+            m = client.mc.osdmap
+            pool = m.pools.get(pool_id)
+            if pool is None or ps >= pool.pg_num:
+                print(f"error: no pg {args.words[2]!r}", file=sys.stderr)
+                return 2
+            io = client.open_ioctx(pool.name)
+            rep = io.scrub_pg(ps, repair=args.words[1] == "repair")
+            errs = rep.get("errors", [])
+            print(f"pg {args.words[2]}: {len(errs)} inconsistencies, "
+                  f"{rep.get('repaired', 0)} repaired", file=out)
+            for e in errs:
+                print(f"  inconsistent: {e}", file=out)
+            return 0
+        except (IOError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        finally:
+            client.shutdown()
     if args.words[:2] == ["fs", "status"]:
         try:
             return _fs_status(_parse_mons(args.mon), out)

@@ -79,6 +79,9 @@ def main(argv=None, out=sys.stdout) -> int:
     p.add_argument("image")
     p.add_argument("--order", type=int, default=22)
 
+    p = sub.add_parser("du")
+    p.add_argument("image", nargs="?", default=None,
+                   help="one image (default: all)")
     p = sub.add_parser("bench")
     p.add_argument("image")
     p.add_argument("--io-type", choices=["write", "read"], default="write")
@@ -177,6 +180,35 @@ def main(argv=None, out=sys.stdout) -> int:
                     chunk = data[off:off + step]
                     if chunk.strip(b"\x00"):
                         img.write(chunk, off)
+            return 0
+        if args.op == "du":
+            # reference: `rbd du` — provisioned vs allocated bytes per
+            # image, counting backing objects actually written
+            names = [args.image] if args.image else rbd.list()
+            print(f"{'NAME':<20} {'PROVISIONED':>12} {'USED':>12}",
+                  file=out)
+            total_p = total_u = 0
+            all_objs = list(io.list_objects())  # one pool walk, N images
+            for name in names:
+                with rbd.open(name) as img:
+                    st = img.stat()
+                    # data objects are "<prefix>.<objectno:016x>" — the
+                    # dot matters, else img's prefix also matches img2's
+                    prefix = st["block_name_prefix"] + "."
+                    objs = [o for o in all_objs if o.startswith(prefix)]
+                    used = 0
+                    for o in objs:
+                        try:
+                            used += io.stat(o)["size"]
+                        except (IOError, KeyError):
+                            pass
+                    print(f"{name:<20} {st['size']:>12} {used:>12}",
+                          file=out)
+                    total_p += st["size"]
+                    total_u += used
+            if not args.image:
+                print(f"{'<TOTAL>':<20} {total_p:>12} {total_u:>12}",
+                      file=out)
             return 0
         if args.op == "bench":
             # reference: `rbd bench --io-type write` — sequential IO of

@@ -387,3 +387,45 @@ def test_rbd_image_over_cluster(scrub_cluster):
     assert "disk0" in rbd.list()
     rbd.remove("disk0")
     assert "disk0" not in rbd.list()
+
+
+@pytest.mark.cluster
+def test_scrub_inspect_does_not_repair():
+    """`ceph pg deep-scrub` (repair=False) reports divergence without
+    rewriting replicas; `pg repair` then fixes it."""
+    import io as _io
+
+    from ceph_tpu.qa.vstart import LocalCluster
+    from ceph_tpu.tools.ceph_cli import main as ceph_main
+
+    with LocalCluster(n_mons=1, n_osds=2) as c:
+        c.create_replicated_pool("sc", size=2, pg_num=1)
+        io = c.client().open_ioctx("sc")
+        io.write_full("victim", b"good" * 64)
+        # corrupt one replica directly in a store
+        from ceph_tpu.store.object_store import Transaction
+        corrupted = None
+        for o in c.osds.values():
+            for cid in o.store.list_collections():
+                if "victim" in list(o.store.list_objects(cid)):
+                    t = Transaction()
+                    t.write(cid, "victim", 0, b"BAD!" * 64)
+                    o.store.queue_transaction(t)
+                    corrupted = (o, cid)
+                    break
+            if corrupted:
+                break
+        assert corrupted
+        osd, cid = corrupted
+        mon = f"{c.mon_addrs[0][0]}:{c.mon_addrs[0][1]}"
+        buf = _io.StringIO()
+        assert ceph_main(["-m", mon, "pg", "deep-scrub", "1.0"],
+                         out=buf) == 0
+        assert "1 inconsistencies, 0 repaired" in buf.getvalue(), \
+            buf.getvalue()
+        # the divergent replica is still divergent (inspect-only)
+        assert osd.store.read(cid, "victim", 0, 4) == b"BAD!"
+        buf = _io.StringIO()
+        assert ceph_main(["-m", mon, "pg", "repair", "1.0"], out=buf) == 0
+        assert "1 repaired" in buf.getvalue(), buf.getvalue()
+        assert osd.store.read(cid, "victim", 0, 4) == b"good"

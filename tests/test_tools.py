@@ -516,3 +516,60 @@ class TestKvstoreVerbs:
                     ["--data-path", bogus, "--op", "kv-list"])
         assert rc == 2
         assert not os.path.exists(bogus), "typo'd path was conjured"
+
+
+@pytest.mark.cluster
+def test_ok_to_stop_safe_to_destroy_pg_repair_rbd_du():
+    """Operator command sweep: `osd ok-to-stop` flags min_size
+    violations, `osd safe-to-destroy` needs an OSD emptied first,
+    `ceph pg repair` drives a primary scrub, and `rbd du` reports
+    provisioned vs allocated."""
+    import io as _io
+
+    from ceph_tpu.qa.vstart import LocalCluster
+    from ceph_tpu.tools.ceph_cli import main as ceph_main
+    from ceph_tpu.tools.rbd import main as rbd_main
+
+    with LocalCluster(n_mons=1, n_osds=3) as c:
+        c.create_replicated_pool("op", size=3, min_size=2)
+        io = c.client().open_ioctx("op")
+        io.write_full("x", b"d" * 1024)
+        mon = f"{c.mon_addrs[0][0]}:{c.mon_addrs[0][1]}"
+        # stopping one of three is fine; stopping two breaks min_size=2
+        buf = _io.StringIO()
+        assert ceph_main(["-m", mon, "osd", "ok-to-stop", "0"],
+                         out=buf) == 0
+        rv, res = c.mon_command({"prefix": "osd ok-to-stop",
+                                 "ids": ["0", "1"]})
+        assert rv == -16 and res["num_unsafe"] > 0
+        # an in-use OSD is not safe to destroy
+        rv, res = c.mon_command({"prefix": "osd safe-to-destroy",
+                                 "id": "2"})
+        assert rv == -16 and res["safe"] is False
+        # pg repair via the CLI
+        buf = _io.StringIO()
+        assert ceph_main(["-m", mon, "pg", "repair", "1.0"],
+                         out=buf) == 0
+        assert "repaired" in buf.getvalue()
+        # rbd du
+        rv, _ = c.mon_command({"prefix": "osd pool create",
+                               "name": "rbd", "pg_num": 4, "size": 2})
+        assert rv == 0
+        buf = _io.StringIO()
+        assert rbd_main(["-m", mon, "-p", "rbd", "create", "img",
+                         "--size", "4M"], out=buf) == 0
+        assert rbd_main(["-m", mon, "-p", "rbd", "bench", "img",
+                         "--io-size", "65536", "--io-total",
+                         str(1 << 20)], out=buf) == 0
+        # a second empty image whose name extends the first must not
+        # absorb img's objects (prefix needs the dot separator)
+        assert rbd_main(["-m", mon, "-p", "rbd", "create", "img2",
+                         "--size", "4M"], out=buf) == 0
+        buf = _io.StringIO()
+        assert rbd_main(["-m", mon, "-p", "rbd", "du"], out=buf) == 0
+        rows = {ln.split()[0]: ln.split()
+                for ln in buf.getvalue().splitlines()
+                if ln.startswith("img")}
+        assert int(rows["img"][1]) == 4 << 20
+        assert 0 < int(rows["img"][2]) <= 4 << 20
+        assert int(rows["img2"][2]) == 0
