@@ -242,6 +242,7 @@ class Objecter(Dispatcher):
         attempts: int = 8,
         snapid: int | None = None,
         ignore_overlay: bool = False,
+        snapc_seq: int = 0,
     ):
         """Submit; blocks for the reply, retrying across map changes."""
         import time as _time
@@ -288,6 +289,10 @@ class Objecter(Dispatcher):
                 # there is nothing left to preserve, and a stale high seq
                 # would make primaries mint un-trimmable clones forever
                 snap_seq = max(p.snaps, default=0) if p is not None else 0
+                # self-managed context (reference: the caller-supplied
+                # SnapContext CephFS/RBD ride): the MDS allocates snapids
+                # outside the pool registry, so the per-op seq wins
+                snap_seq = max(snap_seq, snapc_seq)
             try:
                 _osd, addr = self._calc_target(target_pool, oid, op)
             except (ConnectionError, KeyError) as e:

@@ -24,9 +24,11 @@ class IoCtx:
         self.pool_id = pool_id
         self.pool_name = pool_name
 
-    def write_full(self, oid: str, data: bytes) -> int:
+    def write_full(self, oid: str, data: bytes,
+                   snapc_seq: int = 0) -> int:
         rep = self._client.objecter.op_submit(
-            self.pool_id, oid, "write_full", data=bytes(data)
+            self.pool_id, oid, "write_full", data=bytes(data),
+            snapc_seq=snapc_seq,
         )
         if rep.retval != 0:
             raise IOError(f"write_full {oid!r}: {rep.retval} {rep.result}")
@@ -238,8 +240,9 @@ class IoCtx:
         snapshot's content (client-side: snap read then write_full)."""
         self.write_full(oid, self.read(oid, snapid=self.snap_lookup(snapname)))
 
-    def remove(self, oid: str) -> None:
-        rep = self._client.objecter.op_submit(self.pool_id, oid, "delete")
+    def remove(self, oid: str, snapc_seq: int = 0) -> None:
+        rep = self._client.objecter.op_submit(
+            self.pool_id, oid, "delete", snapc_seq=snapc_seq)
         if rep.retval != 0:
             raise IOError(f"remove {oid!r}: {rep.retval} {rep.result}")
 

@@ -96,6 +96,13 @@ class ExtentIO:
         self.io = io
         self.namer = namer
         self.policy = policy
+        # self-managed snap-context seq (CephFS realm seq; 0 = none).
+        # Passed as a kwarg only when set so snap-unaware io backends
+        # (tests' fakes) keep working.
+        self.snapc_seq = 0
+
+    def _mut_kw(self) -> dict:
+        return {"snapc_seq": self.snapc_seq} if self.snapc_seq else {}
 
     def write(self, data: bytes, off: int) -> None:
         """Read-modify-write each touched object (the framework's object
@@ -113,7 +120,7 @@ class ExtentIO:
                 cur.extend(b"\0" * (end - len(cur)))
             cur[obj_off:end] = data[src : src + ln]
             src += ln
-            self.io.write_full(oid, bytes(cur))
+            self.io.write_full(oid, bytes(cur), **self._mut_kw())
 
     def read(self, off: int, length: int,
              snapid: int | None = None) -> bytes:
@@ -155,7 +162,7 @@ class ExtentIO:
             oid = self.namer(objectno)
             if keep == 0:
                 try:
-                    self.io.remove(oid)
+                    self.io.remove(oid, **self._mut_kw())
                 except IOError:
                     pass
                 continue
@@ -164,7 +171,8 @@ class ExtentIO:
             except IOError:
                 continue
             if len(cur) > keep:
-                self.io.write_full(oid, bytes(cur[:keep]))
+                self.io.write_full(oid, bytes(cur[:keep]),
+                                   **self._mut_kw())
 
     def purge(self, size: int) -> None:
         """Remove every data object of a stream whose logical size was
@@ -175,7 +183,7 @@ class ExtentIO:
         )
         for objectno in range(last_obj + 1):
             try:
-                self.io.remove(self.namer(objectno))
+                self.io.remove(self.namer(objectno), **self._mut_kw())
             except IOError:
                 pass
 

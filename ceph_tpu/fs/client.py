@@ -74,7 +74,20 @@ class FileHandle:
         self._ext = ExtentIO(
             self.io, lambda objectno: f"{ino:x}.{objectno:08x}", self.policy
         )
-        fs._register_handle(self)
+        # at-snap view (".snap/<name>/file"): reads resolve clones at
+        # this id, mutations are refused
+        self.snapid: int | None = self.inode.pop("_snapid", None)
+        seq = int(self.inode.pop("snap_seq", 0) or 0)
+        if seq:
+            fs._snap_seqs[ino] = max(fs._snap_seqs.get(ino, 0), seq)
+        if self.snapid is None:
+            fs._register_handle(self)
+
+    def _refresh_snapc(self) -> None:
+        if self.snapid is not None:
+            raise FSError(30, "snapshot is read-only")  # EROFS
+        self._ext.snapc_seq = max(self.fs._snap_seqs.get(self.ino, 0),
+                                  self.fs._snap_floor)
 
     def __enter__(self):
         return self
@@ -90,6 +103,9 @@ class FileHandle:
         return self.fs._caps_of(self.ino)
 
     def size(self) -> int:
+        if self.snapid is not None:
+            # frozen at mksnap: the manifest inode IS the truth
+            return int(self.inode.get("size", 0))
         ent = self.fs._cap_entry(self.ino)
         if ent is not None and ent["dirty"].get("size") is not None:
             return int(ent["dirty"]["size"])
@@ -104,6 +120,7 @@ class FileHandle:
         return int(self.inode.get("size", 0))
 
     def write(self, data: bytes, off: int = 0) -> int:
+        self._refresh_snapc()
         self._ext.write(data, off)
         new_end = off + len(data)
         ent = self.fs._cap_entry(self.ino)
@@ -127,9 +144,10 @@ class FileHandle:
             return b""
         if length is None or off + length > size:
             length = size - off
-        return self._ext.read(off, length)
+        return self._ext.read(off, length, snapid=self.snapid)
 
     def truncate(self, size: int) -> None:
+        self._refresh_snapc()
         old = self.size()
         if size < old:
             self._ext.truncate_data(old, size)
@@ -141,6 +159,8 @@ class FileHandle:
     def close(self) -> None:
         """Flush buffered attrs and release caps (reference:
         Client::_release_fh -> cap release)."""
+        if self.snapid is not None:
+            return  # snap view: no caps were taken
         self.fs._close_handle(self)
 
 
@@ -179,6 +199,15 @@ class FSClient(Dispatcher):
         # connection reset drops every cap (reconnect-window analog) but
         # keeps the dirty attrs, which then flush synchronously.
         self._caps_state: dict[int, dict] = {}
+        # ino -> newest realm snapid (from open replies and revoke
+        # pushes): the self-managed snap context for data writes
+        self._snap_seqs: dict[int, int] = {}
+        # floor for OUR OWN handles opened before a mksnap WE issued:
+        # the MDS cannot revoke-push the new seq to the requester (its
+        # connection thread is inside the mksnap request), so the reply
+        # seeds this instead.  Over-stamping an unrelated write mints a
+        # harmless orphan clone; under-stamping would lose the snapshot.
+        self._snap_floor = 0
 
     # -- session -----------------------------------------------------------
     def mount(self, timeout: float = 10.0) -> None:
@@ -232,6 +261,12 @@ class FSClient(Dispatcher):
                 if ent is not None:
                     ent["caps"] = msg.caps or ""
                     ent["dirty"] = {}
+                seq = (msg.attrs or {}).get("snap_seq")
+                if seq:
+                    # a mksnap bumped our realm: stamp every later data
+                    # write so the OSD clones pre-snap bytes
+                    self._snap_seqs[msg.ino] = max(
+                        self._snap_seqs.get(msg.ino, 0), int(seq))
             try:
                 conn.send_message(MClientCaps(
                     op="flush", client=self._session, ino=msg.ino,
@@ -505,7 +540,42 @@ class FSClient(Dispatcher):
         self._flush_caps(fh.ino, release=last)
 
     # -- public API --------------------------------------------------------
+    def _snap_split(self, path: str):
+        """(dir_path, snap_name, rest) for paths crossing a ".snap"
+        component (reference: the client's magic snapdir), else None."""
+        parts = self._split(path)
+        if ".snap" not in parts:
+            return None
+        i = parts.index(".snap")
+        return ("/".join(parts[:i]),
+                parts[i + 1] if len(parts) > i + 1 else None,
+                "/".join(parts[i + 2:]))
+
+    def _snapid_of(self, dino: int, snap: str) -> int:
+        snaps = self._request("lssnap", {"ino": dino})
+        ent = snaps.get(snap)
+        if ent is None:
+            raise FileNotFoundError(f"no snapshot {snap!r}")
+        return int(ent["snapid"])
+
     def mkdir(self, path: str) -> dict:
+        sp = self._snap_split(path)
+        if sp is not None:
+            dirp, snap, rest = sp
+            if not snap or rest:
+                raise FSError(22, f"bad snapshot path {path!r}")
+            dino = self._resolve(dirp)["ino"]
+            # flush + release our own caps first: the MDS syncs OTHER
+            # sessions' writers itself, but a revoke aimed at us would
+            # deadlock against our in-flight mksnap request (one
+            # connection, one dispatch thread) and time out with stale
+            # sizes in the manifest
+            for cino in list(self._caps_state):
+                self._flush_caps(cino, release=True)
+            out = self._request("mksnap", {"ino": dino, "name": snap})
+            self._snap_floor = max(self._snap_floor,
+                                   int(out.get("snapid", 0)))
+            return out
         parent, name = self._resolve_parent(path)
         return self._request("mkdir", {"parent": parent, "name": name})
 
@@ -523,6 +593,22 @@ class FSClient(Dispatcher):
         return out
 
     def listdir(self, path: str = "/") -> dict:
+        sp = self._snap_split(path)
+        if sp is not None:
+            dirp, snap, rest = sp
+            dino = self._resolve(dirp)["ino"]
+            if snap is None:
+                # `ls dir/.snap` — the snapshots themselves, as dirs
+                snaps = self._request("lssnap", {"ino": dino})
+                return {n: {"type": "dir", "ino": dino,
+                            "snapid": s["snapid"],
+                            "mtime": s.get("created")}
+                        for n, s in sorted(snaps.items())}
+            sid = self._snapid_of(dino, snap)
+            out = self._request("snapls", {"ino": dino, "snapid": sid,
+                                           "rel": rest})
+            return {n: self._public_inode(i)
+                    for n, i in sorted(out.items())}
         inode = self._resolve(path)
         if inode["type"] != "dir":
             raise NotADirectoryError(path)
@@ -575,6 +661,15 @@ class FSClient(Dispatcher):
         return {k: v for k, v in inode.items() if k != "xattrs"}
 
     def stat(self, path: str) -> dict:
+        sp = self._snap_split(path)
+        if sp is not None:
+            dirp, snap, rest = sp
+            dino = self._resolve(dirp)["ino"]
+            if snap is None:
+                return {"type": "dir", "ino": dino, "name": ".snap"}
+            sid = self._snapid_of(dino, snap)
+            return self._public_inode(self._request(
+                "snapstat", {"ino": dino, "snapid": sid, "rel": rest}))
         return self._public_inode(
             self._overlay_dirty(self._resolve(path)))
 
@@ -583,6 +678,20 @@ class FSClient(Dispatcher):
         """`want` asks for capabilities: "rw" (buffer attrs while the
         sole opener) or "r" (cache attrs alongside other readers).  The
         MDS may grant less under contention."""
+        sp = self._snap_split(path)
+        if sp is not None:
+            dirp, snap, rest = sp
+            if create or not snap or not rest:
+                raise FSError(30, "snapshot is read-only")  # EROFS
+            dino = self._resolve(dirp)["ino"]
+            sid = self._snapid_of(dino, snap)
+            inode = self._request(
+                "snapstat", {"ino": dino, "snapid": sid, "rel": rest})
+            if inode.get("type") == "dir":
+                raise IsADirectoryError(path)
+            node = dict(inode)
+            node["_snapid"] = sid
+            return FileHandle(self, node)
         if create:
             parent, name = self._resolve_parent(path)
             try:
@@ -603,9 +712,16 @@ class FSClient(Dispatcher):
 
     def _purge_data(self, inode: dict) -> None:
         """Remove a dead file's data objects (reference: the MDS purge
-        queue; here the client that held the last ref does it inline)."""
-        fh = FileHandle(self, inode)
+        queue; here the client that held the last ref does it inline).
+        Under a live snapshot the removes carry the realm seq, so the
+        OSD clones each object before deleting the head — the at-snap
+        view survives the unlink."""
+        seq = int(inode.get("snap_seq", 0) or 0)
+        fh = FileHandle(self, dict(inode))
         try:
+            fh._ext.snapc_seq = max(
+                seq, self._snap_seqs.get(inode["ino"], 0),
+                self._snap_floor)
             fh._ext.purge(int(fh.inode.get("size", 0)))
         finally:
             fh.close()
@@ -634,6 +750,14 @@ class FSClient(Dispatcher):
             self._purge_data(inode)
 
     def rmdir(self, path: str) -> None:
+        sp = self._snap_split(path)
+        if sp is not None:
+            dirp, snap, rest = sp
+            if not snap or rest:
+                raise FSError(22, f"bad snapshot path {path!r}")
+            dino = self._resolve(dirp)["ino"]
+            self._request("rmsnap", {"ino": dino, "name": snap})
+            return
         parent, name = self._resolve_parent(path)
         self._request("rmdir", {"parent": parent, "name": name})
 

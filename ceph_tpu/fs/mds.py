@@ -135,6 +135,11 @@ class MDSDaemon(Dispatcher):
         # hardlink reverse map: ino -> remote-stub dentry locations
         self.remotes: dict[int, set[tuple[int, str]]] = {}
         self.next_ino = ROOT_INO + 1
+        # SnapServer counter (reference: src/mds/SnapServer — rank-scoped
+        # here: snapid = rank<<20 | n, so every rank mints globally
+        # unique ids and a realm's ids stay monotonic because a subtree
+        # lives on one rank)
+        self.snap_counter = 0
         self._dirty: set[int] = set()  # dirfrags awaiting flush
         # per-dirfrag dentry deltas (name -> inode | None=removed): the
         # flush writes only changed omap keys, not the whole directory
@@ -224,6 +229,7 @@ class MDSDaemon(Dispatcher):
         ino_tbl = self._obj_read(self._rk("mds_inotable")) or {}
         self.next_ino = int(ino_tbl.get(
             "next_ino", ROOT_INO + 1 + self.rank * (1 << 40)))
+        self.snap_counter = int(ino_tbl.get("snap_counter", 0))
         for oid in self._io.list_objects():
             if not oid.startswith("dir."):
                 continue
@@ -353,7 +359,9 @@ class MDSDaemon(Dispatcher):
         self._dirty.clear()
         self._dirty_names.clear()
         self._dirty_full.clear()
-        self._obj_write(self._rk("mds_inotable"), {"next_ino": self.next_ino})
+        self._obj_write(self._rk("mds_inotable"),
+                        {"next_ino": self.next_ino,
+                         "snap_counter": self.snap_counter})
         self._first_seg = self._seg_seq
         self._obj_write(self._rk("mds_head"), {"first_seg": self._first_seg})
         # trim: every event object of now-expired segments
@@ -520,6 +528,26 @@ class MDSDaemon(Dispatcher):
                 else:
                     self.backptr[entry["ino"]] = (ddir, dname)
                 self._mark(ddir, dname, entry)
+        elif kind == "mksnap":
+            dino, name = ev["ino"], ev["name"]
+            inode = self._inode_of(dino)
+            if inode is not None:
+                inode.setdefault("snaps", {})[name] = {
+                    "snapid": ev["snapid"], "created": ev["created"],
+                }
+                bp = self.backptr.get(dino)
+                if bp:
+                    self._mark(bp[0], bp[1], inode)
+            self.snap_counter = max(self.snap_counter,
+                                    ev["snapid"] & 0xFFFFF)
+        elif kind == "rmsnap":
+            dino, name = ev["ino"], ev["name"]
+            inode = self._inode_of(dino)
+            if inode is not None and name in (inode.get("snaps") or {}):
+                del inode["snaps"][name]
+                bp = self.backptr.get(dino)
+                if bp:
+                    self._mark(bp[0], bp[1], inode)
         elif kind == "setattr":
             ino = ev["ino"]
             bp = self.backptr.get(ino)
@@ -816,6 +844,51 @@ class MDSDaemon(Dispatcher):
         bp = self.backptr.get(ino)
         return None if bp is None else self.dirs[bp[0]][bp[1]]
 
+    def _snap_seq_of(self, ino: int) -> int:
+        """Newest snapid governing `ino` — max over its ancestor realms
+        (reference: SnapRealm::get_newest_seq).  Drives the snap
+        context clients stamp on data writes."""
+        seq = 0
+        seen = set()
+        cur = ino
+        while cur and cur not in seen:
+            seen.add(cur)
+            inode = self._inode_of(cur)
+            if inode:
+                for s in (inode.get("snaps") or {}).values():
+                    seq = max(seq, int(s["snapid"]))
+            bp = self.backptr.get(cur)
+            if bp is None:
+                break
+            cur = bp[0]
+        return seq
+
+    def _is_under(self, ino: int, top: int) -> bool:
+        seen = set()
+        cur = ino
+        while cur not in seen:
+            if cur == top:
+                return True
+            seen.add(cur)
+            bp = self.backptr.get(cur)
+            if bp is None:
+                return False
+            cur = bp[0]
+        return False
+
+    def _walk_subtree(self, dino: int, rel: str = ""):
+        """Yield (relpath, inode) for every entry under `dino`,
+        resolving hardlink stubs; cycles cannot form (dirs are never
+        hardlinked)."""
+        for name, ent in sorted((self.dirs.get(dino) or {}).items()):
+            inode = self._resolve_entry(ent)
+            if inode is None:
+                continue
+            path = f"{rel}/{name}" if rel else name
+            yield path, inode
+            if inode.get("type") == "dir":
+                yield from self._walk_subtree(inode["ino"], path)
+
     def _alloc_ino(self) -> int:
         ino = self.next_ino
         self.next_ino += 1
@@ -872,7 +945,8 @@ class MDSDaemon(Dispatcher):
             )
 
     def _revoke_caps(self, ino: int, session: str, keep: str,
-                     timeout: float = 5.0) -> None:
+                     timeout: float = 5.0,
+                     attrs: dict | None = None) -> None:
         """Push a revoke to `session` and wait for its flush-ack (the
         Locker's revoke path).  Waiting releases the mds_lock (condition
         wait), so the client's MClientCaps flush can be applied by the
@@ -881,7 +955,24 @@ class MDSDaemon(Dispatcher):
         exactly what evicting a dead client costs upstream."""
         holders = self.caps.get(ino, {})
         ent = holders.get(session)
-        if ent is None or set(ent["caps"]) <= set(keep):
+        if ent is None:
+            return
+        if set(ent["caps"]) <= set(keep):
+            # nothing to revoke — but an attrs payload (the mksnap
+            # realm-seq push) must still reach sessions parked at ""
+            # (MIX-degraded writers), else they keep writing with a
+            # stale snap context and clobber the snapshot
+            if attrs:
+                conn = self._session_conns.get(session)
+                if conn is not None:
+                    try:
+                        conn.send_message(MClientCaps(
+                            op="revoke", client=session, ino=ino,
+                            caps=ent["caps"], seq=ent.get("seq", 0),
+                            attrs=attrs,
+                        ))
+                    except (OSError, ConnectionError):
+                        pass
             return
         ent["seq"] = ent.get("seq", 0) + 1
         conn = self._session_conns.get(session)
@@ -889,7 +980,7 @@ class MDSDaemon(Dispatcher):
             try:
                 conn.send_message(MClientCaps(
                     op="revoke", client=session, ino=ino, caps=keep,
-                    seq=ent["seq"],
+                    seq=ent["seq"], attrs=attrs,
                 ))
             except (OSError, ConnectionError):
                 conn = None
@@ -1226,8 +1317,11 @@ class MDSDaemon(Dispatcher):
             if inode.get("type") == "file" and nlink_after <= 0:
                 self._drop_ino_caps(inode["ino"])
             # nlink_after tells the client whether it holds the LAST
-            # reference (purge) or a survivor keeps the data alive
-            return 0, dict(inode, nlink_after=max(nlink_after, 0))
+            # reference (purge) or a survivor keeps the data alive;
+            # snap_seq makes that purge CLONE under a live snapshot
+            # instead of destroying the at-snap view
+            return 0, dict(inode, nlink_after=max(nlink_after, 0),
+                           snap_seq=self._snap_seq_of(parent))
         if op == "rename":
             sdir, sname = a["srcdir"], a["sname"]
             if self._quota_realm(sdir) != self._quota_realm(a["dstdir"]):
@@ -1297,8 +1391,12 @@ class MDSDaemon(Dispatcher):
             # objects (purge-queue analog); surviving hardlinks keep it
             replaced = None
             if existing is not None:
+                # snap_seq: the destination realm governs the purge —
+                # under a live snapshot the deletes must clone (same
+                # contract as the unlink reply)
                 replaced = dict(
-                    existing, nlink_after=max(replaced_nlink_after, 0)
+                    existing, nlink_after=max(replaced_nlink_after, 0),
+                    snap_seq=self._snap_seq_of(a["dstdir"]),
                 )
                 if (
                     existing.get("type") == "file"
@@ -1346,6 +1444,101 @@ class MDSDaemon(Dispatcher):
                 name = a["name"]
                 return 0, ({name: xattrs[name]} if name in xattrs else {})
             return 0, xattrs
+        if op == "mksnap":
+            # reference: Server::handle_client_mksnap + SnapServer
+            # allocation.  The at-snap NAMESPACE freezes in a manifest
+            # object (relpath -> inode copy); at-snap DATA rides the
+            # OSD's clone-on-write, driven by the realm seq clients
+            # stamp on writes from here on.
+            dino, name = int(a["ino"]), a["name"]
+            inode = self._inode_of(dino)
+            if inode is None or inode.get("type") != "dir":
+                return -20, None
+            if dino == ROOT_INO:
+                return -22, "snapshot of the root is not allowed"
+            if name in (inode.get("snaps") or {}):
+                return -17, f"snapshot {name!r} exists"
+            if not name or "/" in name or name.startswith("."):
+                return -22, f"bad snapshot name {name!r}"
+            # a subtree delegated to another rank under this dir would
+            # make the manifest partial — refuse like cross-realm rename
+            if self.rank == 0:
+                for top, r in self._load_subtrees().items():
+                    if r != self.rank:
+                        ent = self.dirs.get(ROOT_INO, {}).get(top)
+                        tino = ent and self._resolve_entry(ent)
+                        if tino and self._is_under(tino["ino"], dino):
+                            return -18, (f"subtree /{top} is on rank "
+                                         f"{r}; snapshot there")
+            self.snap_counter += 1
+            sid = (self.rank << 20) | self.snap_counter
+            # push the realm seq to every cap holder under the dir
+            # BEFORE freezing the manifest: keep="" both flushes their
+            # buffered sizes (fresh manifest) and delivers the seq, so
+            # by the time the namespace freezes every acked writer
+            # stamps its next data write and clones pre-snap bytes.
+            # The window for a NON-acking writer is its revoke timeout.
+            for cino in list(self.caps):
+                if not self._is_under(cino, dino):
+                    continue
+                self._await_reconnect(cino)
+                for sess in list(self.caps.get(cino, {})):
+                    self._revoke_caps(cino, sess, "",
+                                      attrs={"snap_seq": sid})
+            manifest = {"": dict(inode)}
+            for path, node in self._walk_subtree(dino):
+                manifest[path] = dict(node)
+            self._obj_write(f"snapmeta.{dino:x}.{sid:x}", manifest)
+            self._commit({"e": "mksnap", "ino": dino, "name": name,
+                          "snapid": sid, "created": time.time()})
+            self._flush()  # counter + dirfrag durable with the manifest
+            return 0, {"snapid": sid, "name": name}
+        if op == "rmsnap":
+            dino, name = int(a["ino"]), a["name"]
+            inode = self._inode_of(dino)
+            if inode is None or inode.get("type") != "dir":
+                return -20, None
+            s = (inode.get("snaps") or {}).get(name)
+            if s is None:
+                return -2, None
+            # journal FIRST: a crash after the manifest delete but
+            # before the event would leave a listed-but-unreadable
+            # snapshot; the orphan manifest object is merely garbage
+            self._commit({"e": "rmsnap", "ino": dino, "name": name})
+            try:
+                self._io.remove(f"snapmeta.{dino:x}.{int(s['snapid']):x}")
+            except IOError:
+                pass
+            return 0, {"name": name}
+        if op == "lssnap":
+            inode = self._inode_of(int(a["ino"]))
+            if inode is None or inode.get("type") != "dir":
+                return -20, None
+            return 0, dict(inode.get("snaps") or {})
+        if op == "snapstat":
+            manifest = self._obj_read(
+                f"snapmeta.{int(a['ino']):x}.{int(a['snapid']):x}")
+            if manifest is None:
+                return -2, None
+            node = manifest.get(a.get("rel", ""))
+            return (0, node) if node is not None else (-2, None)
+        if op == "snapls":
+            manifest = self._obj_read(
+                f"snapmeta.{int(a['ino']):x}.{int(a['snapid']):x}")
+            if manifest is None:
+                return -2, None
+            rel = a.get("rel", "")
+            if rel and rel not in manifest:
+                return -2, None
+            if rel and manifest[rel].get("type") != "dir":
+                return -20, None
+            prefix = f"{rel}/" if rel else ""
+            out = {}
+            for path, node in manifest.items():
+                if path and path.startswith(prefix) \
+                        and "/" not in path[len(prefix):]:
+                    out[path[len(prefix):]] = node
+            return 0, out
         if op == "open":
             inode = self._inode_of(a["ino"])
             if inode is None:
@@ -1369,7 +1562,8 @@ class MDSDaemon(Dispatcher):
                 inode["ino"], session, want
             )
             # grant may have flushed a writer: re-read the inode
-            return 0, dict(self._inode_of(a["ino"]), caps=caps)
+            return 0, dict(self._inode_of(a["ino"]), caps=caps,
+                           snap_seq=self._snap_seq_of(a["ino"]))
         return -95, f"unknown op {op!r}"  # EOPNOTSUPP
 
     def ms_dispatch(self, conn, msg) -> bool:

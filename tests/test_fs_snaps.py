@@ -1,0 +1,171 @@
+"""CephFS snapshots — .snap directories over MDS manifests + OSD
+clone-on-write (reference: src/mds/SnapServer + SnapRealm, the client's
+magic snapdir, and make_writeable's clone path; SURVEY.md §2.6)."""
+import pytest
+
+from ceph_tpu.qa.vstart import LocalCluster
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def snap_cluster():
+    with LocalCluster(n_mons=1, n_osds=3, with_mds=True) as c:
+        yield c
+
+
+def _fs(c):
+    return c.fs_client()
+
+
+class TestFsSnapshots:
+    def test_mksnap_lssnap_rmsnap(self, snap_cluster):
+        fs = _fs(snap_cluster)
+        fs.mkdir("/proj")
+        fs.write_file("/proj/a.txt", b"alpha")
+        fs.mkdir("/proj/.snap/s1")
+        names = list(fs.listdir("/proj/.snap"))
+        assert names == ["s1"]
+        with pytest.raises(FileExistsError):
+            fs.mkdir("/proj/.snap/s1")
+        fs.rmdir("/proj/.snap/s1")
+        assert list(fs.listdir("/proj/.snap")) == []
+
+    def test_snapshot_preserves_data_and_namespace(self, snap_cluster):
+        fs = _fs(snap_cluster)
+        fs.mkdir("/d2")
+        fs.write_file("/d2/keep.txt", b"original contents")
+        fs.mkdir("/d2/sub")
+        fs.write_file("/d2/sub/deep.txt", b"deep data")
+        fs.mkdir("/d2/.snap/before")
+        # mutate everything after the snapshot
+        fs.write_file("/d2/keep.txt", b"CLOBBERED" * 10)
+        fs.unlink("/d2/sub/deep.txt")
+        fs.write_file("/d2/new.txt", b"born after")
+        # live view
+        assert fs.read_file("/d2/keep.txt") == b"CLOBBERED" * 10
+        assert "new.txt" in fs.listdir("/d2")
+        # snapshot view: namespace
+        snap_ls = fs.listdir("/d2/.snap/before")
+        assert set(snap_ls) == {"keep.txt", "sub"}
+        assert "deep.txt" in fs.listdir("/d2/.snap/before/sub")
+        # snapshot view: data (clone-on-write preserved the old bytes)
+        assert fs.read_file("/d2/.snap/before/keep.txt") == \
+            b"original contents"
+        assert fs.read_file("/d2/.snap/before/sub/deep.txt") == \
+            b"deep data"
+        st = fs.stat("/d2/.snap/before/keep.txt")
+        assert st["size"] == len(b"original contents")
+
+    def test_snapshot_readonly(self, snap_cluster):
+        fs = _fs(snap_cluster)
+        fs.mkdir("/ro")
+        fs.write_file("/ro/f", b"x")
+        fs.mkdir("/ro/.snap/s")
+        from ceph_tpu.fs.client import FSError
+        with pytest.raises(FSError):
+            fs.open("/ro/.snap/s/f", create=True)
+        with fs.open("/ro/.snap/s/f", want="r") as fh:
+            assert fh.read() == b"x"
+            with pytest.raises(FSError):
+                fh.write(b"nope")
+            with pytest.raises(FSError):
+                fh.truncate(0)
+
+    def test_open_writer_spanning_snapshot_clones(self, snap_cluster):
+        """A handle opened BEFORE mksnap must still clone pre-snap
+        bytes on its next write — the realm seq arrives via the cap
+        revoke the mksnap pushes."""
+        fs = _fs(snap_cluster)
+        fs.mkdir("/live")
+        with fs.open("/live/f", create=True) as fh:
+            fh.write(b"pre-snap bytes")
+            fs.mkdir("/live/.snap/mid")
+            fh.write(b"POST", 0)  # same handle, after the snap
+        assert fs.read_file("/live/f")[:4] == b"POST"
+        assert fs.read_file("/live/.snap/mid/f") == b"pre-snap bytes"
+
+    def test_two_snapshots_independent_views(self, snap_cluster):
+        fs = _fs(snap_cluster)
+        fs.mkdir("/ver")
+        fs.write_file("/ver/f", b"v1")
+        fs.mkdir("/ver/.snap/s1")
+        fs.write_file("/ver/f", b"v2-longer")
+        fs.mkdir("/ver/.snap/s2")
+        fs.write_file("/ver/f", b"v3!")
+        assert fs.read_file("/ver/.snap/s1/f") == b"v1"
+        assert fs.read_file("/ver/.snap/s2/f") == b"v2-longer"
+        assert fs.read_file("/ver/f") == b"v3!"
+
+    def test_snapshots_survive_mds_restart(self, snap_cluster):
+        c = snap_cluster
+        fs = _fs(c)
+        fs.mkdir("/dur")
+        fs.write_file("/dur/f", b"durable")
+        fs.mkdir("/dur/.snap/keep")
+        fs.write_file("/dur/f", b"changed!")
+        c.kill_mds()
+        c.restart_mds()
+        fs2 = c.fs_client()
+        assert list(fs2.listdir("/dur/.snap")) == ["keep"]
+        assert fs2.read_file("/dur/.snap/keep/f") == b"durable"
+        assert fs2.read_file("/dur/f") == b"changed!"
+
+
+class TestFsSnapshotsHardening:
+    def test_rename_over_under_snapshot_preserves_view(self, snap_cluster):
+        """rename-over of an existing file must clone its data before
+        the purge, exactly like unlink (review finding)."""
+        fs = _fs(snap_cluster)
+        fs.mkdir("/rn")
+        fs.write_file("/rn/a", b"AAA contents")
+        fs.write_file("/rn/b", b"BBB contents")
+        fs.mkdir("/rn/.snap/s")
+        fs.rename("/rn/a", "/rn/b")  # replaces b; b's data purged
+        assert fs.read_file("/rn/b") == b"AAA contents"
+        assert fs.read_file("/rn/.snap/s/b") == b"BBB contents"
+        assert fs.read_file("/rn/.snap/s/a") == b"AAA contents"
+
+    def test_degraded_mix_writer_learns_seq(self, snap_cluster):
+        """Two writers degrade to '' caps (MIX); a third client's mksnap
+        must still deliver the realm seq to both, else their next write
+        clobbers the snapshot (review finding)."""
+        c = snap_cluster
+        fs_a = c.fs_client(name="client.a")
+        fs_b = c.fs_client(name="client.b")
+        fs_c = c.fs_client(name="client.c")
+        fs_a.mkdir("/mix")
+        fh_a = fs_a.open("/mix/f", create=True)
+        fh_a.write(b"from-a before snap")
+        fh_b = fs_b.open("/mix/f", want="rw")  # degrades both to ''
+        fs_c.mkdir("/mix/.snap/s")
+        import time as _t
+        _t.sleep(0.5)  # the seq push is fire-and-forget for '' holders
+        fh_a.write(b"CLOBBER-A", 0)
+        assert fs_c.read_file("/mix/.snap/s/f") == b"from-a before snap"
+        fh_a.close()
+        fh_b.close()
+
+    def test_rmsnap_crash_ordering(self, snap_cluster):
+        """rmsnap journals before deleting the manifest: replaying the
+        journal must not leave a listed-but-unreadable snapshot."""
+        c = snap_cluster
+        fs = _fs(c)
+        fs.mkdir("/rmo")
+        fs.write_file("/rmo/f", b"x")
+        fs.mkdir("/rmo/.snap/gone")
+        fs.rmdir("/rmo/.snap/gone")
+        c.kill_mds()
+        c.restart_mds()
+        fs2 = c.fs_client()
+        assert list(fs2.listdir("/rmo/.snap")) == []
+
+    def test_snapls_missing_path_is_enoent(self, snap_cluster):
+        fs = _fs(snap_cluster)
+        fs.mkdir("/e2")
+        fs.write_file("/e2/f", b"x")
+        fs.mkdir("/e2/.snap/s")
+        with pytest.raises(FileNotFoundError):
+            fs.listdir("/e2/.snap/s/nope")
+        with pytest.raises(NotADirectoryError):
+            fs.listdir("/e2/.snap/s/f")
