@@ -141,6 +141,9 @@ def default_options() -> OptionTable:
             Option("mgr_modules", str,
                    "status,prometheus,balancer,iostat,quota",
                    "comma-separated modules the mgr hosts"),
+            Option("rgw_lc_interval", float, 5.0,
+                   "seconds between lifecycle passes (upstream: daily)",
+                   min=0.1),
             Option("mgr_digest_interval", float, 2.0,
                    "seconds between mgr->mon status digests", min=0.1),
             Option("mgr_quota_interval", float, 2.0,

@@ -337,7 +337,8 @@ class _Store:
                 self.meta.remove(f"idx.{bucket}")
             except IOError:
                 pass
-            for side in (f"bver.{bucket}", f"cmeta.{bucket}"):
+            for side in (f"bver.{bucket}", f"cmeta.{bucket}",
+                         f"blc.{bucket}"):
                 try:
                     self.meta.remove(side)
                 except IOError:
@@ -398,6 +399,98 @@ class _Store:
                 f"bver.{bucket}", json.dumps({"status": status}).encode()
             )
             return True
+
+    # -- bucket lifecycle (reference: RGWLC / RGWLifecycleConfiguration
+    # — expiration rules stored as a bucket attr, applied by the lc
+    # worker; transitions/storage-classes are out of scope) ------------
+    def lifecycle_rules(self, bucket: str) -> list[dict] | None:
+        return self._read_json(self.meta, f"blc.{bucket}", None)
+
+    def set_lifecycle(self, bucket: str, rules: list[dict]) -> bool:
+        with self.lock:
+            if not self.bucket_exists(bucket):
+                return False
+            self.meta.write_full(
+                f"blc.{bucket}", json.dumps(rules).encode())
+            return True
+
+    def delete_lifecycle(self, bucket: str) -> None:
+        try:
+            self.meta.remove(f"blc.{bucket}")
+        except IOError:
+            pass
+
+    def lc_process(self, now: float | None = None) -> dict:
+        """One lifecycle pass over every configured bucket (reference:
+        RGWLC::process).  Returns {bucket: expired_count} for the lc
+        log.  Current objects past Days are deleted through the normal
+        delete path (delete marker under versioning); noncurrent
+        versions past NoncurrentDays are dropped from the version
+        chain with their backing data."""
+        now = time.time() if now is None else now
+        out: dict[str, int] = {}
+        for bucket in list(self.buckets()):
+            rules = self.lifecycle_rules(bucket) or []
+            rules = [r for r in rules if r.get("status") != "Disabled"]
+            if not rules:
+                continue
+            n = 0
+            for key, ent in list(self.iter_index(bucket)):
+                for r in rules:
+                    if not key.startswith(r.get("prefix", "")):
+                        continue
+                    days = r.get("days")
+                    if days is not None and self._expire_current(
+                            bucket, key, now, days):
+                        n += 1
+                        break
+                    nc_days = r.get("noncurrent_days")
+                    if nc_days is not None and "versions" in ent:
+                        self._expire_noncurrent(
+                            bucket, key, now, nc_days)
+            if n:
+                out[bucket] = n
+        return out
+
+    def _expire_current(self, bucket: str, key: str, now: float,
+                        days: float) -> bool:
+        """Expire the CURRENT object if still past `days`, re-checked
+        under the lock — the pass iterates an unlocked snapshot, and a
+        concurrent PUT must not have its fresh bytes deleted."""
+        with self.lock:
+            ent = self._index_get(bucket, key)
+            if ent is None or self._is_dm_head(ent):
+                return False
+            head = self._versions_of(ent)[0] if "versions" in ent else ent
+            if head.get("dm") or now - head.get("mtime", now) \
+                    < days * 86400:
+                return False
+        # delete OUTSIDE the pass's view but through the normal path
+        # (delete marker under versioning); the re-check above closed
+        # the stale-snapshot race, a PUT after it wins like any
+        # delete/put race would
+        self.delete_object(bucket, key)
+        return True
+
+    def _expire_noncurrent(self, bucket: str, key: str, now: float,
+                           nc_days: float) -> None:
+        with self.lock:
+            ent = self._index_get(bucket, key)
+            if ent is None or "versions" not in ent:
+                return
+            versions = self._versions_of(ent)
+            keep, dead = [versions[0]], []
+            for v in versions[1:]:
+                if now - v.get("mtime", now) >= nc_days * 86400:
+                    dead.append(v)
+                else:
+                    keep.append(v)
+            if not dead:
+                return
+            for v in dead:
+                if not v.get("dm"):
+                    self._stream(bucket, key, v["vid"]).remove()
+            self._index_put(bucket, key, self._ent_from_versions(keep))
 
     @staticmethod
     def _versions_of(ent: dict) -> list[dict]:
@@ -1045,6 +1138,34 @@ class _Handler(BaseHTTPRequestHandler):
                     "</VersioningConfiguration>"
                 ).encode())
                 return
+            if "lifecycle" in q:
+                rules = self.store.lifecycle_rules(bucket)
+                if rules is None:
+                    return self._error(
+                        404, "NoSuchLifecycleConfiguration")
+                parts = []
+                for r in rules:
+                    exp = (f"<Expiration><Days>{int(r['days'])}</Days>"
+                           "</Expiration>" if r.get("days") is not None
+                           else "")
+                    nce = (("<NoncurrentVersionExpiration>"
+                            f"<NoncurrentDays>"
+                            f"{int(r['noncurrent_days'])}"
+                            f"</NoncurrentDays>"
+                            "</NoncurrentVersionExpiration>")
+                           if r.get("noncurrent_days") is not None
+                           else "")
+                    parts.append(
+                        f"<Rule><ID>{_xml_escape(r.get('id', ''))}</ID>"
+                        f"<Prefix>{_xml_escape(r.get('prefix', ''))}"
+                        f"</Prefix><Status>{r.get('status', 'Enabled')}"
+                        f"</Status>{exp}{nce}</Rule>"
+                    )
+                self._reply(200, (
+                    '<?xml version="1.0"?><LifecycleConfiguration>'
+                    + "".join(parts) + "</LifecycleConfiguration>"
+                ).encode())
+                return
             prefix = q.get("prefix", [""])[0]
             marker = q.get("marker", [""])[0]
             try:
@@ -1158,6 +1279,46 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._error(404, "NoSuchBucket")
                 self._reply(200)
                 return
+            if "lifecycle" in q:
+                rules = []
+                for rxml in re.findall(rb"<Rule>(.*?)</Rule>", body,
+                                       re.S):
+                    def _tag(t, s=rxml):
+                        m = re.search(
+                            rb"<" + t + rb">\s*(.*?)\s*</" + t + rb">",
+                            s, re.S)
+                        return m.group(1).decode() if m else None
+                    rule = {"id": _tag(rb"ID") or "",
+                            "prefix": _tag(rb"Prefix") or "",
+                            "status": _tag(rb"Status") or "Enabled"}
+                    if rule["status"] not in ("Enabled", "Disabled"):
+                        return self._error(400, "MalformedXML")
+                    days = _tag(rb"Days")
+                    ncd = _tag(rb"NoncurrentDays")
+                    if days is not None:
+                        try:
+                            rule["days"] = int(days)
+                        except ValueError:
+                            return self._error(400, "MalformedXML")
+                        if rule["days"] <= 0:  # S3: positive integer
+                            return self._error(400, "MalformedXML")
+                    if ncd is not None:
+                        try:
+                            rule["noncurrent_days"] = int(ncd)
+                        except ValueError:
+                            return self._error(400, "MalformedXML")
+                        if rule["noncurrent_days"] <= 0:
+                            return self._error(400, "MalformedXML")
+                    if "days" not in rule \
+                            and "noncurrent_days" not in rule:
+                        return self._error(400, "MalformedXML")
+                    rules.append(rule)
+                if not rules:
+                    return self._error(400, "MalformedXML")
+                if not self.store.set_lifecycle(bucket, rules):
+                    return self._error(404, "NoSuchBucket")
+                self._reply(200)
+                return
             self.store.create_bucket(bucket)  # idempotent, like S3
             self._reply(200)
             return
@@ -1218,6 +1379,12 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._auth_ok(self._body()):
             return
         bucket, key, q = self._path()
+        if bucket and not key and "lifecycle" in q:
+            if not self.store.bucket_exists(bucket):
+                return self._error(404, "NoSuchBucket")
+            self.store.delete_lifecycle(bucket)
+            self._reply(204)
+            return
         if key and "uploadId" in q:
             if not self.store.abort_upload(q["uploadId"][0]):
                 return self._error(404, "NoSuchUpload")
@@ -1293,8 +1460,28 @@ class RGWDaemon:
             target=self.httpd.serve_forever, name="rgw-http", daemon=True
         )
         self._thread.start()
+        # lifecycle worker (reference: the RGWLC background thread;
+        # upstream runs daily, the dev-scale interval is configurable)
+        self._lc_stop = threading.Event()
+
+        def _lc_loop():
+            interval = float(self.cct.conf.get("rgw_lc_interval"))
+            while not self._lc_stop.wait(timeout=interval):
+                try:
+                    done = store.lc_process()
+                    if done:
+                        self.cct.dout("rgw", 2, f"lc expired {done}")
+                except Exception as e:
+                    self.cct.dout("rgw", 1, f"lc pass failed: {e!r}")
+
+        self._lc_thread = threading.Thread(
+            target=_lc_loop, name="rgw-lc", daemon=True)
+        self._lc_thread.start()
 
     def shutdown(self) -> None:
+        if getattr(self, "_lc_stop", None) is not None:
+            self._lc_stop.set()
+            self._lc_thread.join(timeout=5)
         if self.httpd is not None:
             self.httpd.shutdown()
             self.httpd.server_close()

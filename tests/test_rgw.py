@@ -346,3 +346,117 @@ def test_listing_paginates_past_delete_markers(conn):
     assert st == 200 and sbody == b"b0\nb1\nb2\n"
     st, hdrs, _ = _req(conn, "HEAD", "/swift/v1/vpage")
     assert int(hdrs["X-Container-Object-Count"]) == 3
+
+
+@pytest.mark.cluster
+def test_bucket_lifecycle_expiration():
+    """PUT/GET/DELETE ?lifecycle round-trip and the LC worker expiring
+    current objects past Days and noncurrent versions past
+    NoncurrentDays (reference: RGWLC expiration-only scope)."""
+    import re as _re
+    import time as _t
+    import urllib.error
+    import urllib.request
+
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    with LocalCluster(
+        n_mons=1, n_osds=2,
+        conf_overrides={"rgw_lc_interval": 0.5},
+    ) as c:
+        c.start_rgw()
+        host, port = c.rgw.addr
+        base = f"http://{host}:{port}"
+
+        def req(method, path, data=None):
+            r = urllib.request.Request(base + path, data=data,
+                                       method=method)
+            return urllib.request.urlopen(r, timeout=10)
+
+        req("PUT", "/lcb")
+        # no config yet -> 404 NoSuchLifecycleConfiguration
+        try:
+            req("GET", "/lcb?lifecycle")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        lc = (b'<LifecycleConfiguration><Rule><ID>exp</ID>'
+              b'<Prefix>tmp/</Prefix><Status>Enabled</Status>'
+              b'<Expiration><Days>1</Days></Expiration></Rule>'
+              b'</LifecycleConfiguration>')
+        req("PUT", "/lcb?lifecycle", lc)
+        got = req("GET", "/lcb?lifecycle").read()
+        assert b"<Prefix>tmp/</Prefix>" in got and b"<Days>1</Days>" in got
+        req("PUT", "/lcb/tmp/old", b"expire me")
+        req("PUT", "/lcb/tmp/new", b"keep me (too new)")
+        req("PUT", "/lcb/keep/other", b"outside prefix")
+        # backdate tmp/old past the rule's 1 day
+        store = c.rgw.httpd.RequestHandlerClass.store
+        with store.lock:
+            ent = store._index_get("lcb", "tmp/old")
+            ent["mtime"] = _t.time() - 2 * 86400
+            store._index_put("lcb", "tmp/old", ent)
+        deadline = _t.time() + 15
+        while _t.time() < deadline:
+            try:
+                req("GET", "/lcb/tmp/old")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+                break
+            _t.sleep(0.3)
+        else:
+            assert False, "lc never expired tmp/old"
+        req("GET", "/lcb/tmp/new")       # young object survives
+        req("GET", "/lcb/keep/other")    # other prefix survives
+        # noncurrent expiration under versioning
+        req("PUT", "/lcb?versioning",
+            b"<VersioningConfiguration><Status>Enabled</Status>"
+            b"</VersioningConfiguration>")
+        req("PUT", "/lcb?lifecycle",
+            b'<LifecycleConfiguration><Rule><ID>nc</ID>'
+            b'<Prefix>v/</Prefix><Status>Enabled</Status>'
+            b'<NoncurrentVersionExpiration><NoncurrentDays>1'
+            b'</NoncurrentDays></NoncurrentVersionExpiration>'
+            b'</Rule></LifecycleConfiguration>')
+        req("PUT", "/lcb/v/doc", b"v1-old")
+        req("PUT", "/lcb/v/doc", b"v2-current")
+        with store.lock:
+            ent = store._index_get("lcb", "v/doc")
+            vs = store._versions_of(ent)
+            vs[1]["mtime"] = _t.time() - 2 * 86400  # age the noncurrent
+            store._index_put("lcb", "v/doc",
+                             store._ent_from_versions(vs))
+        deadline = _t.time() + 15
+        while _t.time() < deadline:
+            body = req("GET", "/lcb?versions").read()
+            if body.count(b"<Key>v/doc</Key>") == 1:
+                break
+            _t.sleep(0.3)
+        else:
+            assert False, "noncurrent version never expired"
+        assert req("GET", "/lcb/v/doc").read() == b"v2-current"
+        # DELETE removes the config
+        req("DELETE", "/lcb?lifecycle")
+        try:
+            req("GET", "/lcb?lifecycle")
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        # invalid configs rejected at PUT (S3: MalformedXML)
+        for bad in (b"<Days>0</Days>", b"<Days>-3</Days>"):
+            try:
+                req("PUT", "/lcb?lifecycle",
+                    b"<LifecycleConfiguration><Rule><Status>Enabled"
+                    b"</Status><Expiration>" + bad +
+                    b"</Expiration></Rule></LifecycleConfiguration>")
+                assert False, bad
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        try:
+            req("PUT", "/lcb?lifecycle",
+                b"<LifecycleConfiguration><Rule><Status>Sometimes"
+                b"</Status><Expiration><Days>1</Days></Expiration>"
+                b"</Rule></LifecycleConfiguration>")
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
