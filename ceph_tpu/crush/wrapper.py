@@ -237,6 +237,107 @@ class CrushWrapper:
         self._propagate_weights()
         self.invalidate()
 
+    def add_bucket(self, name: str, type_name: str) -> int:
+        """`ceph osd crush add-bucket` (reference:
+        CrushWrapper::add_bucket): a new empty straw2 bucket, detached
+        until `move` places it under a parent."""
+        from .types import BUCKET_STRAW2, Straw2Bucket
+
+        if name in {*self.map.bucket_names.values(),
+                    *self.map.device_names.values()}:
+            raise ValueError(f"name {name!r} exists")
+        t = self.type_id(type_name)
+        if t <= 0:
+            raise ValueError(f"bad bucket type {type_name!r}")
+        bid = min(self.map.buckets, default=0) - 1
+        self.map.buckets[bid] = Straw2Bucket(
+            id=bid, type=t, alg=BUCKET_STRAW2, items=[], weights=[])
+        self.map.bucket_names[bid] = name
+        self.invalidate()
+        return bid
+
+    def move_item(self, name: str, parent_name: str) -> None:
+        """`ceph osd crush move` / `crush add` placement (reference:
+        CrushWrapper::move_bucket / insert_item): detach `name` from
+        its current parent (if any) and attach under `parent_name`,
+        keeping its subtree weight; ancestors re-propagate."""
+        item = self.id_of(name)
+        dest = self.id_of(parent_name)
+        if dest >= 0:
+            raise ValueError(f"{parent_name!r} is a device")
+        if dest not in self.map.buckets:
+            raise KeyError(f"no bucket {parent_name!r}")
+        if item >= 0 and item not in self.map.device_names \
+                and item >= self.map.max_devices:
+            # upstream rejects with ENOENT; inserting a ghost device
+            # would map PGs onto an id no OSD owns
+            raise KeyError(f"no device {name!r}")
+        if item < 0:
+            # moving a bucket under its own subtree would cycle
+            probe = dest
+            seen = set()
+            while probe is not None and probe not in seen:
+                if probe == item:
+                    raise ValueError(
+                        f"cannot move {name!r} under its own subtree")
+                seen.add(probe)
+                probe = next(
+                    (b.id for b in self.map.buckets.values()
+                     if probe in b.items), None)
+        shadows = set(self._shadow_index())
+        weight = None
+        for b in self.map.buckets.values():
+            if b.id not in shadows and item in b.items:
+                i = b.items.index(item)
+                weight = b.weights[i]
+                del b.items[i]
+                del b.weights[i]
+        if weight is None:
+            weight = (sum(self.map.buckets[item].weights)
+                      if item < 0 else 0x10000)
+        dst = self.map.buckets[dest]
+        dst.items.append(item)
+        dst.weights.append(weight)
+        self._propagate_weights()
+        if self.map.class_bucket:
+            # class shadow trees mirror the real topology — rebuild
+            # them or `take X class c` rules lose the moved subtree
+            self.populate_classes()
+        self.invalidate()
+
+    def remove_item(self, name: str) -> None:
+        """`ceph osd crush rm` (reference: CrushWrapper::remove_item):
+        detach a device or EMPTY bucket from the tree."""
+        item = self.id_of(name)
+        if item < 0:
+            if self.map.buckets.get(item) is None:
+                raise KeyError(name)
+            if self.map.buckets[item].items:
+                raise ValueError(f"bucket {name!r} is not empty")
+        shadows = set(self._shadow_index())
+        found = False
+        for b in self.map.buckets.values():
+            if b.id not in shadows and item in b.items:
+                i = b.items.index(item)
+                del b.items[i]
+                del b.weights[i]
+                found = True
+        if item >= 0 and not found:
+            raise KeyError(f"{name!r} is in no bucket")
+        if item < 0:
+            del self.map.buckets[item]
+            self.map.bucket_names.pop(item, None)
+            for orig, per_class in list(self.map.class_bucket.items()):
+                if orig == item:
+                    for sid in per_class.values():
+                        self.map.buckets.pop(sid, None)
+                        self.map.bucket_names.pop(sid, None)
+                    del self.map.class_bucket[orig]
+        self._propagate_weights()
+        if self.map.class_bucket:
+            self.populate_classes()
+        self.invalidate()
+
     def _propagate_weights(self) -> None:
         """Bottom-up: a bucket entry that IS a bucket weighs the sum of
         that bucket's items; straw/tree aux tables recompute from the

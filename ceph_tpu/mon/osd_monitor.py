@@ -209,6 +209,33 @@ class OSDMonitor:
             return 0, [p.name for p in self.osdmap.pools.values()]
         if prefix in ("osd down", "osd out", "osd in"):
             return self._cmd_osd_state(prefix.split()[1], cmd)
+        if prefix == "osd crush add-bucket":
+            m = self._pending()
+            try:
+                m.crush.add_bucket(cmd.get("name", ""),
+                                   cmd.get("type", ""))
+            except (ValueError, KeyError) as e:
+                return -22, str(e)
+            return (0, f"added bucket {cmd.get('name')!r}") \
+                if self._propose_map(m) else (-110, "proposal timed out")
+        if prefix == "osd crush move":
+            m = self._pending()
+            try:
+                m.crush.move_item(cmd.get("name", ""),
+                                  cmd.get("dest", ""))
+            except (ValueError, KeyError) as e:
+                return -22, str(e)
+            return (0, f"moved {cmd.get('name')!r} under "
+                       f"{cmd.get('dest')!r}") \
+                if self._propose_map(m) else (-110, "proposal timed out")
+        if prefix == "osd crush rm":
+            m = self._pending()
+            try:
+                m.crush.remove_item(cmd.get("name", ""))
+            except (ValueError, KeyError) as e:
+                return -22, str(e)
+            return (0, f"removed {cmd.get('name')!r}") \
+                if self._propose_map(m) else (-110, "proposal timed out")
         if prefix == "osd crush reweight":
             # reference: OSDMonitor prepare_command OSD_CRUSH_REWEIGHT —
             # distinct from `osd reweight` (the probabilistic in/out

@@ -481,7 +481,10 @@ class _Store:
             versions = self._versions_of(ent)
             keep, dead = [versions[0]], []
             for v in versions[1:]:
-                if now - v.get("mtime", now) >= nc_days * 86400:
+                # clock starts when the version became noncurrent
+                # (nc_at); fall back to mtime for pre-upgrade entries
+                if now - v.get("nc_at", v.get("mtime", now)) \
+                        >= nc_days * 86400:
                     dead.append(v)
                 else:
                     keep.append(v)
@@ -540,6 +543,10 @@ class _Store:
                     return None, None
                 return etag, None
             versions = self._versions_of(existing) if existing else []
+            if versions:
+                # the old head becomes noncurrent NOW — S3's
+                # NoncurrentDays clock starts here, not at its mtime
+                versions[0].setdefault("nc_at", time.time())
             rec = {"vid": None, "size": len(body), "etag": etag,
                    "mtime": time.time(), "dm": False}
             if meta:
@@ -644,6 +651,10 @@ class _Store:
                     self._stream(bucket, key).remove()
                 versions = [v for v in versions if v["vid"] != "null"]
                 mvid = "null"
+            if versions:
+                # the displaced head goes noncurrent now (NoncurrentDays
+                # clock — same stamp the overwrite path makes)
+                versions[0].setdefault("nc_at", time.time())
             versions.insert(0, {
                 "vid": mvid, "size": 0, "etag": "", "mtime": time.time(),
                 "dm": True,
@@ -1139,6 +1150,8 @@ class _Handler(BaseHTTPRequestHandler):
                 ).encode())
                 return
             if "lifecycle" in q:
+                if not self.store.bucket_exists(bucket):
+                    return self._error(404, "NoSuchBucket")
                 rules = self.store.lifecycle_rules(bucket)
                 if rules is None:
                     return self._error(
@@ -1288,13 +1301,27 @@ class _Handler(BaseHTTPRequestHandler):
                             rb"<" + t + rb">\s*(.*?)\s*</" + t + rb">",
                             s, re.S)
                         return m.group(1).decode() if m else None
+                    # transitions are out of scope — REJECT rather than
+                    # misread their <Days> as an Expiration and delete
+                    # data that was meant to move storage classes
+                    if re.search(rb"<(NoncurrentVersion)?Transition>",
+                                 rxml):
+                        return self._error(
+                            501, "NotImplemented")
                     rule = {"id": _tag(rb"ID") or "",
                             "prefix": _tag(rb"Prefix") or "",
                             "status": _tag(rb"Status") or "Enabled"}
                     if rule["status"] not in ("Enabled", "Disabled"):
                         return self._error(400, "MalformedXML")
-                    days = _tag(rb"Days")
-                    ncd = _tag(rb"NoncurrentDays")
+                    # scope day tags to their parent action elements
+                    exp = re.search(
+                        rb"<Expiration>(.*?)</Expiration>", rxml, re.S)
+                    nce = re.search(
+                        rb"<NoncurrentVersionExpiration>(.*?)"
+                        rb"</NoncurrentVersionExpiration>", rxml, re.S)
+                    days = _tag(rb"Days", exp.group(1)) if exp else None
+                    ncd = (_tag(rb"NoncurrentDays", nce.group(1))
+                           if nce else None)
                     if days is not None:
                         try:
                             rule["days"] = int(days)

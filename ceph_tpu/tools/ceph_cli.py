@@ -111,6 +111,27 @@ def _build_command(words: list[str]) -> dict:
         if len(words) < 5:
             raise ValueError("usage: osd pool application get <pool>")
         return {"prefix": "osd pool application get", "pool": words[4]}
+    if words[:3] == ["osd", "crush", "add-bucket"]:
+        if len(words) < 5:
+            raise ValueError("usage: osd crush add-bucket <name> <type>")
+        return {"prefix": "osd crush add-bucket", "name": words[3],
+                "type": words[4]}
+    if words[:3] == ["osd", "crush", "move"]:
+        # one destination only: the deepest loc wins in real ceph, and
+        # silently dropping extra key=value args would mis-place the
+        # item with a success exit code
+        if len(words) != 5:
+            raise ValueError(
+                "usage: osd crush move <name> <dest-bucket> "
+                "(one destination; deepest location)")
+        dest = words[4].partition("=")[2] if "=" in words[4] \
+            else words[4]
+        return {"prefix": "osd crush move", "name": words[3],
+                "dest": dest}
+    if words[:3] == ["osd", "crush", "rm"]:
+        if len(words) < 4:
+            raise ValueError("usage: osd crush rm <name>")
+        return {"prefix": "osd crush rm", "name": words[3]}
     if words[:2] == ["osd", "ok-to-stop"]:
         if len(words) < 3:
             raise ValueError("usage: osd ok-to-stop <id> [<id>...]")

@@ -202,3 +202,59 @@ class TestCompiledChooseArgs:
         np.testing.assert_array_equal(arr[0, 0, :2], arr[1, 0, :2])
         # bucket -2 rows differ
         assert (arr[0, 1, :2] != arr[1, 1, :2]).any()
+
+
+@pytest.mark.cluster
+def test_crush_topology_commands_move_failure_domains():
+    """add-bucket / move / rm reshape the tree live: moving an OSD to a
+    new rack changes placements, and the mapping stays consistent with
+    the scalar reference mapper on the edited map."""
+    import io as _io
+
+    import numpy as np
+
+    from ceph_tpu.crush import CompiledCrushMap, crush_do_rule_batch
+    from ceph_tpu.crush.reference_mapper import crush_do_rule
+    from ceph_tpu.qa.vstart import LocalCluster
+    from ceph_tpu.tools.ceph_cli import main as ceph_main
+
+    with LocalCluster(n_mons=1, n_osds=4) as c:
+        mon = f"{c.mon_addrs[0][0]}:{c.mon_addrs[0][1]}"
+        buf = _io.StringIO()
+        assert ceph_main(["-m", mon, "osd", "crush", "add-bucket",
+                          "rack1", "host"], out=buf) == 0
+        # attach the new bucket under the root, then move osd.3 into it
+        m = c._leader().osdmon.osdmap
+        root_name = m.crush.name_of(max(
+            (b.id for b in m.crush.map.buckets.values()),
+            key=lambda bid: m.crush.map.buckets[bid].type))
+        assert ceph_main(["-m", mon, "osd", "crush", "move", "rack1",
+                          root_name], out=buf) == 0
+        assert ceph_main(["-m", mon, "osd", "crush", "move", "osd.3",
+                          "rack1"], out=buf) == 0
+        m = c._leader().osdmon.osdmap
+        rack = next(b for b in m.crush.map.buckets.values()
+                    if m.crush.map.bucket_names[b.id] == "rack1")
+        assert 3 in rack.items
+        # edited map still matches the scalar reference mapper
+        cm = CompiledCrushMap(m.crush.map)
+        w = np.full(m.max_osd, 0x10000, dtype=np.uint32)
+        rule = min(m.crush.map.rules)
+        xs = np.arange(64)
+        got = np.asarray(crush_do_rule_batch(cm, rule, xs, 2, w))
+        for i, x in enumerate(xs):
+            want = crush_do_rule(m.crush.map, rule, int(x), 2, w)
+            want = want + [-0x7FFFFFFE] * (2 - len(want))
+            assert list(got[i]) == want, (x, list(got[i]), want)
+        # rm refuses non-empty, then empties and removes
+        assert ceph_main(["-m", mon, "osd", "crush", "rm", "rack1"],
+                         out=buf) != 0
+        host0 = next(n for bid, n in m.crush.map.bucket_names.items()
+                     if "rack" not in n
+                     and m.crush.map.buckets[bid].type == rack.type)
+        assert ceph_main(["-m", mon, "osd", "crush", "move", "osd.3",
+                          host0], out=buf) == 0
+        assert ceph_main(["-m", mon, "osd", "crush", "rm", "rack1"],
+                         out=buf) == 0
+        m = c._leader().osdmon.osdmap
+        assert "rack1" not in m.crush.map.bucket_names.values()

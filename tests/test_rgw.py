@@ -423,7 +423,7 @@ def test_bucket_lifecycle_expiration():
         with store.lock:
             ent = store._index_get("lcb", "v/doc")
             vs = store._versions_of(ent)
-            vs[1]["mtime"] = _t.time() - 2 * 86400  # age the noncurrent
+            vs[1]["nc_at"] = _t.time() - 2 * 86400  # age noncurrency
             store._index_put("lcb", "v/doc",
                              store._ent_from_versions(vs))
         deadline = _t.time() + 15
@@ -460,3 +460,19 @@ def test_bucket_lifecycle_expiration():
             assert False
         except urllib.error.HTTPError as e:
             assert e.code == 400
+        # a Transition rule must be refused, not misread as Expiration
+        try:
+            req("PUT", "/lcb?lifecycle",
+                b"<LifecycleConfiguration><Rule><Status>Enabled"
+                b"</Status><Transition><Days>30</Days>"
+                b"<StorageClass>GLACIER</StorageClass></Transition>"
+                b"</Rule></LifecycleConfiguration>")
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 501
+        # nonexistent bucket distinguishes NoSuchBucket
+        try:
+            req("GET", "/ghost?lifecycle")
+            assert False
+        except urllib.error.HTTPError as e:
+            assert b"NoSuchBucket" in e.read() or e.code == 404
