@@ -99,6 +99,33 @@ class MonClient(Dispatcher):
         return False
 
     # -- commands ----------------------------------------------------------
+    def fetch_config(self, cct, who: str | None = None) -> int:
+        """Boot-time central config pull (reference: the mon config db
+        pushed at MAuth/MConfig time): fetch this entity's merged view
+        and apply it at LEVEL_MON, so file/override settings still
+        win.  Returns the number of options applied; mon unreachable
+        or empty db is not an error — local config stands."""
+        from ..common.config import LEVEL_MON
+
+        who = who or cct.conf.get("name")
+        try:
+            rv, res = self.command({"prefix": "config get", "who": who},
+                                   timeout=5.0)
+        except Exception:
+            return 0
+        if rv != 0 or not isinstance(res, dict):
+            return 0
+        n = 0
+        for name, value in res.items():
+            try:
+                cct.conf.set(name, value, level=LEVEL_MON)
+                n += 1
+            except (KeyError, ValueError) as e:
+                cct.dout("monc", 2,
+                         f"central config {name}={value!r} rejected: "
+                         f"{e}")
+        return n
+
     def command(self, cmd: dict, timeout: float = 10.0) -> tuple[int, object]:
         """Send a CLI-style command; transparently follows the leader
         (reference: MonClient command routing + Objecter retries)."""

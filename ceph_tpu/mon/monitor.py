@@ -411,6 +411,13 @@ class Monitor(Dispatcher):
         elif self.osdmon.osdmap is None:
             # elected but the initial map hasn't committed yet
             retval, result = -11, "cluster still forming, retry"
+        elif prefix.startswith("config-key ") \
+                or prefix.startswith("config "):
+            try:
+                retval, result = self._handle_config_command(cmd)
+            except Exception as e:
+                self.cct.dout("mon", 0, f"command {prefix!r} failed: {e!r}")
+                retval, result = -22, f"command failed: {e}"
         else:
             try:
                 retval, result = self.osdmon.handle_command(cmd)
@@ -430,6 +437,78 @@ class Monitor(Dispatcher):
             )
         except (OSError, ConnectionError):
             pass
+
+    # -- central config + config-key store (reference: MonMonmap-era
+    # ConfigMonitor src/mon/ConfigMonitor.cc and the config-key KV of
+    # src/mon/ConfigKeyService.cc; both paxos-replicated) --------------
+    _CFG_SECTIONS = ("global", "mon", "osd", "mds", "mgr", "client")
+
+    def _handle_config_command(self, cmd: dict) -> tuple[int, object]:
+        prefix = cmd.get("prefix", "")
+        if prefix == "config-key set":
+            key = cmd.get("key", "")
+            if not key:
+                return -22, "key required"
+            val = cmd.get("val", "")
+            ok = self.paxos.propose([(1, f"ck/{key}",
+                                      str(val).encode())])
+            return (0, f"set {key}") if ok else (-110, "timed out")
+        if prefix == "config-key get":
+            v = self.store.get(f"ck/{cmd.get('key', '')}")
+            return (0, v.decode()) if v is not None else (-2, "no key")
+        if prefix == "config-key rm":
+            ok = self.paxos.propose([(2, f"ck/{cmd.get('key', '')}",
+                                      b"")])
+            return (0, "removed") if ok else (-110, "timed out")
+        if prefix == "config-key ls":
+            return 0, sorted(
+                k[len("ck/"):] for k, _v in self.store.iterate("ck/"))
+        if prefix == "config-key exists":
+            v = self.store.get(f"ck/{cmd.get('key', '')}")
+            return (0, "exists") if v is not None else (-2, "no key")
+        if prefix == "config set":
+            who = cmd.get("who", "")
+            name = cmd.get("name", "")
+            base = who.split(".", 1)[0]
+            if base not in self._CFG_SECTIONS:
+                return -22, f"bad section {who!r}"
+            try:
+                self.cct.conf.table.get(name)
+            except KeyError:
+                return -2, f"unknown option {name!r}"
+            ok = self.paxos.propose([
+                (1, f"config/{who}/{name}",
+                 str(cmd.get("value", "")).encode()),
+            ])
+            return (0, f"{who}/{name} set") if ok \
+                else (-110, "timed out")
+        if prefix == "config rm":
+            ok = self.paxos.propose([
+                (2, f"config/{cmd.get('who', '')}/"
+                    f"{cmd.get('name', '')}", b""),
+            ])
+            return (0, "removed") if ok else (-110, "timed out")
+        if prefix == "config dump":
+            out = []
+            for k, v in self.store.iterate("config/"):
+                who, _, name = k[len("config/"):].rpartition("/")
+                out.append({"section": who, "name": name,
+                            "value": v.decode()})
+            return 0, sorted(out, key=lambda e: (e["section"],
+                                                 e["name"]))
+        if prefix == "config get":
+            # entity view: global < type section < exact daemon id —
+            # the same precedence the daemon applies at boot
+            who = cmd.get("who", "")
+            base = who.split(".", 1)[0]
+            out: dict[str, str] = {}
+            for section in ("global", base, who):
+                if not section:
+                    continue
+                for k, v in self.store.iterate(f"config/{section}/"):
+                    out[k.rsplit("/", 1)[1]] = v.decode()
+            return 0, out
+        return -95, f"unknown config command {prefix!r}"
 
     def _status(self) -> dict:
         """reference: `ceph -s` (src/mon/Monitor.cc get_cluster_status +

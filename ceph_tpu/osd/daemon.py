@@ -236,6 +236,7 @@ class OSD(
         addr = self.messenger.bind(("127.0.0.1", 0))
         self.messenger.start()
         self.mc.subscribe_osdmap(callback=self._on_map)
+        self.mc.fetch_config(self.cct)  # central config (mon db)
         # resend boot until the map shows our address (reference: OSD
         # re-sends MOSDBoot until it sees itself up) — a boot riding a
         # connection that resets mid-handshake would otherwise be lost
