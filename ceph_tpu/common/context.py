@@ -38,11 +38,18 @@ class CephContext:
         # runtime credentials, not config (reference: the client-side
         # CephXTicketManager)
         self.tickets: dict[str, dict] = {}
+        # fault injection: route this context's inject options (legacy +
+        # the generic `failpoint` option) through the process-wide
+        # failpoint registry, scoped to hits tagged with this context
+        from . import failpoint as _failpoint
+
+        _failpoint.bind_config(self)
         self.admin_socket: AdminSocket | None = None
         sock_path = self.conf.get_expanded("admin_socket")
         if sock_path:
             self.admin_socket = AdminSocket(sock_path)
             self._register_default_commands()
+            _failpoint.register_admin_commands(self)
             self.admin_socket.start()
 
     @property
@@ -92,6 +99,9 @@ class CephContext:
         return {name: self.conf.set(name, cmd["val"])}
 
     def shutdown(self) -> None:
+        from . import failpoint as _failpoint
+
+        _failpoint.unbind(self)
         if self.admin_socket is not None:
             self.admin_socket.stop()
             self.admin_socket = None

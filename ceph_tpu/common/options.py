@@ -31,6 +31,13 @@ def default_options() -> OptionTable:
             Option("debug_crush", int, 1, "crush debug level", min=0, max=20,
                    runtime=True),
             Option("admin_socket", str, "", "admin socket path ('' disables)"),
+            Option("failpoint", str, "",
+                   "semicolon-separated name=spec failpoint assignments "
+                   "('osd.ec.shard_read=error;msgr.frame.send="
+                   "every(5,error)'), applied to the process-wide "
+                   "failpoint registry scoped to this daemon's hits "
+                   "(common/failpoint.py; docs/fault_injection.md)",
+                   runtime=True),
             Option("lockdep", bool, False,
                    "runtime lock-order cycle detection (reference: "
                    "src/common/lockdep.cc)"),
@@ -52,7 +59,9 @@ def default_options() -> OptionTable:
                    "reject frames larger than this", min=4096),
             Option("ms_inject_socket_failures", int, 0,
                    "fault injection: drop the connection every ~N frames "
-                   "(0 = off; reference: ms_inject_socket_failures)",
+                   "(0 = off; reference: ms_inject_socket_failures). "
+                   "LEGACY surface routed through the failpoint registry "
+                   "as 'msgr.frame.send' = every(N,error)",
                    min=0, runtime=True),
             # -- throttles -------------------------------------------------
             Option("objecter_eagain_patience", float, 0.0,
@@ -93,15 +102,28 @@ def default_options() -> OptionTable:
                    runtime=True),
             Option("osd_scrub_chunk_max", int, 25,
                    "objects per scrub chunk", min=1),
+            Option("osd_subop_reply_timeout", float, 10.0,
+                   "DEFAULT seconds a primary waits for one shard "
+                   "sub-op reply before treating the shard as failed; "
+                   "governs waits without an explicit per-path budget "
+                   "(client EC write/read fan-out) — scrub/recovery "
+                   "paths keep their own longer budgets. Thrash tests "
+                   "shrink it so injected partitions stall client ops "
+                   "briefly, not for the full default", min=0.1,
+                   runtime=True),
             Option("osd_deep_scrub_interval", float, 0.0,
                    "seconds between periodic deep scrubs (0 disables)",
                    min=0.0, runtime=True),
             Option("osd_debug_inject_read_err", bool, False,
                    "fault injection: EC shard reads return EIO "
-                   "(reference: bluestore_debug_inject_read_err)",
+                   "(reference: bluestore_debug_inject_read_err). "
+                   "LEGACY surface routed through the failpoint registry "
+                   "as 'osd.ec.shard_read' = error",
                    runtime=True),
             Option("osd_debug_inject_dispatch_delay", float, 0.0,
-                   "fault injection: sleep before dispatch (seconds)",
+                   "fault injection: sleep before dispatch (seconds). "
+                   "LEGACY surface routed through the failpoint registry "
+                   "as 'osd.dispatch' = delay(sec)",
                    min=0.0, runtime=True),
             # -- mon (reference: mon.yaml.in) ------------------------------
             Option("mon_osd_down_out_interval", float, 600.0,

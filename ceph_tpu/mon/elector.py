@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import threading
 
+from ..common.failpoint import FailpointCrash, FailpointError, failpoint
 from .messages import MMonElection
 
 
@@ -37,6 +38,17 @@ class Elector:
 
     def start_election(self) -> None:
         """reference: Elector::start — propose ourselves."""
+        try:
+            # "mon.election.start": delay holds this mon's proposal back
+            # (higher ranks win the round); error suppresses it entirely
+            # (getattr: unit tests drive the elector with bare stub mons)
+            failpoint("mon.election.start",
+                      cct=getattr(self.mon, "cct", None),
+                      entity=f"mon.{getattr(self.mon, 'name', self.mon.rank)}")
+        except FailpointCrash:
+            raise
+        except FailpointError:
+            return
         with self._lock:
             if getattr(self, "_stopped", False):
                 return

@@ -39,6 +39,8 @@ class MonClient(Dispatcher):
 
         self._session = uuid.uuid4().hex
         self._acks: dict[int, tuple[int, object]] = {}
+        self._last_failed_hunt = float("-inf")
+        self._hunting = False
         self.osdmap: OSDMap | None = None
         self._subscribed_from = 0
         self._map_callbacks: list = []
@@ -72,6 +74,44 @@ class MonClient(Dispatcher):
         with self._lock:
             if conn is self._conn:
                 self._conn = None
+
+    def ensure_connection(self) -> None:
+        """Re-dial the quorum if the subscription connection died.  The
+        osdmap subscription is PUSH-based: a mon that crashes between
+        pushes leaves an idle subscriber on a stale map forever unless
+        something re-hunts — daemons call this from their tick loop.
+        Never blocks: the hunt runs on a helper thread (a full-quorum
+        dial can eat whole connect timeouts under the client lock, and
+        the caller's tick loop drives heartbeats that must keep their
+        cadence), rate-limited after failures.  The state check itself
+        is a TRY-acquire — an in-flight hunt holds the client lock for
+        the whole dial, and waiting on it here would reintroduce the
+        very stall the helper thread exists to avoid."""
+        if not self._lock.acquire(blocking=False):
+            return  # a hunt (or another client op) is busy; next tick
+        try:
+            if self._conn is not None and self._conn.is_connected:
+                return
+            now = time.monotonic()
+            if self._hunting or now - self._last_failed_hunt < 2.0:
+                return
+            self._hunting = True
+        finally:
+            self._lock.release()
+
+        def _hunt() -> None:
+            try:
+                self._connect()
+            except (OSError, ConnectionError):
+                with self._lock:
+                    self._last_failed_hunt = time.monotonic()
+            finally:
+                with self._lock:
+                    self._hunting = False
+
+        threading.Thread(
+            target=_hunt, name=f"{self.messenger.name}-mon-hunt", daemon=True
+        ).start()
 
     # -- dispatch ----------------------------------------------------------
     def ms_dispatch(self, conn, msg) -> bool:

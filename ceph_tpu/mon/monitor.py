@@ -16,6 +16,7 @@ import queue
 import threading
 import time
 
+from ..common.failpoint import FailpointCrash, failpoint
 from ..msg import Dispatcher, Messenger, MPing
 from ..msg.messenger import POLICY_LOSSLESS_PEER
 from ..osd.osdmap import OSDMap
@@ -125,6 +126,9 @@ class Monitor(Dispatcher):
         self._sendqs: dict[object, "queue.Queue"] = {}
         self._send_threads: list[threading.Thread] = []
         self._sendq_lock = threading.Lock()
+        # serializes election-outcome state writes against shutdown's
+        # reset: win/lose_election (reader threads) vs shutdown
+        self._state_lock = threading.Lock()
         self._tick_thread: threading.Thread | None = None
         self._stop_event = threading.Event()
 
@@ -143,13 +147,26 @@ class Monitor(Dispatcher):
 
     def shutdown(self) -> None:
         self._stop_event.set()
+        # a stopped mon must not keep reporting itself leader: harness
+        # code (LocalCluster._leader) and peers probing state would
+        # otherwise keep consulting a corpse's stale map view.  Under
+        # _state_lock AFTER setting the stop event: an election outcome
+        # that raced past the event check holds the lock while writing,
+        # so this reset strictly follows it — and any later outcome sees
+        # the event and returns
+        with self._state_lock:
+            self.state = STATE_PROBING
+            self.leader_rank = None
         self.elector.stop()
         with self._sendq_lock:
             for q in self._sendqs.values():
                 q.put(None)
             threads = list(self._send_threads)
         self.messenger.shutdown()
-        if self._tick_thread is not None:
+        if (self._tick_thread is not None
+                and self._tick_thread is not threading.current_thread()):
+            # current_thread guard: an injected tick crash shuts the mon
+            # down from the tick thread itself (joining self raises)
             self._tick_thread.join(timeout=5)
         for t in threads:
             t.join(timeout=5)
@@ -200,10 +217,26 @@ class Monitor(Dispatcher):
                 return
             try:
                 self.tick()
+            except FailpointCrash:
+                # injected daemon death: a dead tick loop alone would
+                # leave a ZOMBIE that still answers election proposes
+                # (and, as lowest rank, keeps winning while never
+                # driving maps) — take the whole mon down so the quorum
+                # genuinely re-forms without it
+                self.cct.dout("mon", 0,
+                              f"mon.{self.name} crashed (injected)")
+                try:
+                    self.shutdown()
+                except Exception:
+                    pass
+                return
             except Exception as e:
                 self.cct.dout("mon", 0, f"mon.{self.name} tick failed: {e!r}")
 
     def tick(self) -> None:
+        # "mon.tick": delay simulates a stalled mon (missed lease-probe
+        # windows); error skips the tick via _tick_loop's handler
+        failpoint("mon.tick", cct=self.cct, entity=f"mon.{self.name}")
         if self.is_leader():
             self.osdmon.tick()
         elif self.state == STATE_PEON and self.leader_rank is not None:
@@ -234,9 +267,16 @@ class Monitor(Dispatcher):
         self.state = STATE_ELECTING
 
     def win_election(self, epoch: int, quorum: list[int]) -> None:
-        self.state = STATE_LEADER
-        self.leader_rank = self.rank
-        self.quorum = quorum
+        with self._state_lock:
+            # a victory dispatched on a reader thread mid-shutdown must
+            # not resurrect the corpse as leader: shutdown sets the stop
+            # event BEFORE taking this lock for its reset, so either we
+            # see the event here, or our writes land before the reset
+            if self._stop_event.is_set():
+                return
+            self.state = STATE_LEADER
+            self.leader_rank = self.rank
+            self.quorum = quorum
         self.cct.dout(
             "mon", 1, f"mon.{self.name} won election epoch {epoch}, quorum {quorum}"
         )
@@ -257,9 +297,12 @@ class Monitor(Dispatcher):
             self.cct.dout("mon", 0, f"leader init failed: {e!r}")
 
     def lose_election(self, epoch: int, leader: int, quorum: list[int]) -> None:
-        self.state = STATE_PEON
-        self.leader_rank = leader
-        self.quorum = quorum
+        with self._state_lock:
+            if self._stop_event.is_set():
+                return
+            self.state = STATE_PEON
+            self.leader_rank = leader
+            self.quorum = quorum
 
     def is_leader(self) -> bool:
         return self.state == STATE_LEADER

@@ -21,6 +21,7 @@ import base64
 import json
 import threading
 
+from ..common.failpoint import FailpointCrash, FailpointError, failpoint
 from ..store.kv import Batch
 from .messages import MMonPaxos
 
@@ -83,6 +84,14 @@ class Paxos:
 
     # -- helpers ----------------------------------------------------------
     def _apply(self, version: int, value: str) -> None:
+        # "mon.paxos.commit": an error here is a crash BEFORE the commit
+        # lands in the store — the accepted-but-uncommitted value stays
+        # on disk and the next collect round must recover it (the paxos
+        # crash-recovery replay path)
+        failpoint("mon.paxos.commit",
+                  cct=getattr(self.mon, "cct", None),
+                  entity=f"mon.{getattr(self.mon, 'name', self.mon.rank)}",
+                  version=version)
         batch = Batch()
         for op, key, val in decode_value(value):
             if op == 1:
@@ -173,6 +182,17 @@ class Paxos:
         return self._propose_locked_value(encode_value(ops), timeout)
 
     def _propose_locked_value(self, value: str, timeout: float = 5.0) -> bool:
+        try:
+            # "mon.paxos.propose": error refuses the proposal (callers
+            # see the same -110 a timed-out quorum produces); delay
+            # stretches the commit latency
+            failpoint("mon.paxos.propose",
+                      cct=getattr(self.mon, "cct", None),
+                      entity=f"mon.{getattr(self.mon, 'name', self.mon.rank)}")
+        except FailpointCrash:
+            raise
+        except FailpointError:
+            return False
         with self._lock:
             # serialize proposals (reference: one in-flight proposal)
             ok = self._cond.wait_for(lambda: not self._proposing, timeout=timeout)
@@ -223,7 +243,16 @@ class Paxos:
         if not ok:
             self._need_collect = True
             return False
-        self._apply(version, value)
+        try:
+            self._apply(version, value)
+        except Exception:
+            # failure (injected or real) between majority-accept and the
+            # local commit: the value IS chosen but not applied here.
+            # Reusing this pn for a different value at the same slot
+            # would break Paxos safety, so the next proposal must
+            # re-collect under a fresh pn and re-drive the chosen value.
+            self._need_collect = True
+            raise
         for r in self.mon.other_ranks():
             self.mon.send_mon(
                 r, MMonPaxos(op="commit", version=version, value=value)

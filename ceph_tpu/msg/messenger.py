@@ -29,9 +29,13 @@ with the replacement (it re-checks socket identity under the lock).  The
 coarse-grained lock trades throughput for obviousness; the reference gets
 the same effect with its per-connection event-loop thread affinity.
 
-Fault injection: `ms_inject_socket_failures = N` tears the socket down
-every ~N message frames sent (reference option of the same name) so higher
-layers' resend paths are testable — the teuthology msgr-failures idiom.
+Fault injection (common/failpoint.py; docs/fault_injection.md): message
+frames pass the `msgr.frame.send` failpoint before hitting the wire (an
+error action tears the socket down mid-stream — `ms_inject_socket_failures
+= N` is the legacy spelling, routed through the registry as
+every(N,error)) and the `msgr.frame.recv` failpoint after decode (an error
+action silently swallows the frame, the thrasher's netsplit primitive —
+the frame is neither dispatched nor acked, exactly a lossy network).
 
 Auth (reference: ProtocolV2 auth frames + signed frames; SURVEY.md §2.7):
 with `auth_cluster_required = cephx` the handshake runs the cephx exchange
@@ -61,6 +65,12 @@ from ..auth.cephx import (
     validate_ticket,
 )
 from ..common.crc32c import crc32c
+from ..common.failpoint import (
+    FailpointCrash,
+    FailpointError,
+    failpoint,
+    registry as _registry,
+)
 from .message import Message, decode_message, encode_message
 
 _TAG_LEN = 16
@@ -142,7 +152,6 @@ class Connection:
         # bounded deque here would silently break the no-loss contract
         self._replay: deque[tuple[int, bytes]] = deque()
         self._closed = False
-        self._frames_sent = 0
         # per-connection frame-signing key + send counter, reset together
         # with every socket incarnation (fresh handshake = fresh key); the
         # receive counter lives in the reader thread, which is also
@@ -185,17 +194,24 @@ class Connection:
                     ) from None
 
     def _send_frame(self, ftype: int, payload: bytes, inject: bool = True) -> None:
-        if inject and ftype == _FRAME_MSG:
-            n = self.msgr.inject_socket_failures
-            if n:
-                self._frames_sent += 1
-                if self._frames_sent % n == 0 and self.sock is not None:
-                    # simulate a peer reset mid-stream
+        if (inject and ftype == _FRAME_MSG
+                and _registry().configured("msgr.frame.send")):
+            try:
+                failpoint(
+                    "msgr.frame.send", cct=self.msgr.cct,
+                    entity=self.msgr.name, peer=self.peer_name or None,
+                )
+            except FailpointCrash:
+                raise
+            except FailpointError:
+                # simulate a peer reset mid-stream (the legacy
+                # ms_inject_socket_failures behavior)
+                if self.sock is not None:
                     try:
                         self.sock.shutdown(socket.SHUT_RDWR)
                     except OSError:
                         pass
-                    raise OSError("injected socket failure")
+                raise OSError("injected socket failure") from None
         if self.sock is None:
             raise OSError("not connected")
         comp = self.msgr._wire_comp
@@ -211,7 +227,11 @@ class Connection:
                 # allocation BEFORE inflating (decompression-bomb guard)
                 payload = (bytes([len(name)]) + name
                            + struct.pack("<I", len(payload)) + z)
-                self.msgr.comp_frames_sent += 1
+                # messenger-wide counter shared by every connection's send
+                # path: the increment must not lose updates under
+                # concurrent sends (sessions hold only their own lock)
+                with self.msgr._lock:
+                    self.msgr.comp_frames_sent += 1
         body = bytes([ftype]) + payload
         frame = struct.pack("<II", len(body), crc32c(body)) + body
         if self._frame_key is not None:
@@ -384,10 +404,6 @@ class Messenger:
     @classmethod
     def create(cls, cct, name: str) -> "Messenger":
         return cls(cct, name)
-
-    @property
-    def inject_socket_failures(self) -> int:
-        return self.cct.conf.get("ms_inject_socket_failures") if self.cct else 0
 
     def _dout(self, level: int, msg: str) -> None:
         if self.cct is not None:
@@ -742,6 +758,25 @@ class Messenger:
                             "inflated frame length mismatch "
                             f"({len(payload)} != declared {raw_len})")
                 msg = decode_message(payload)
+                if _registry().configured("msgr.frame.recv"):
+                    try:
+                        failpoint(
+                            "msgr.frame.recv", cct=self.cct,
+                            entity=self.name,
+                            peer=msg.src or conn.peer_name or None,
+                        )
+                    except FailpointCrash:
+                        # crash is CONNECTION-fatal here (the generic
+                        # reader handler below absorbs it): one
+                        # interpreter hosts many daemons, so there is no
+                        # process to kill — docs/fault_injection.md
+                        # documents this scoping
+                        raise
+                    except FailpointError:
+                        # the frame vanishes in the "network": neither
+                        # dispatched nor acked (the thrasher's netsplit
+                        # primitive) — recovery, not replay, heals the gap
+                        continue
                 with conn._session.lock:
                     if conn._closed or sock is not conn.sock:
                         # socket was replaced/closed while we were blocked:

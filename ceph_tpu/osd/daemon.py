@@ -40,6 +40,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..common.failpoint import failpoint, registry as fp_registry
 from ..common.lockdep import make_lock
 from ..common.perf_counters import PerfCountersBuilder
 from ..common.tracked_op import OpTracker
@@ -144,6 +145,12 @@ class OSD(
                         raise RuntimeError(
                             f"{self.whoami} fsck on mount: {bad}"
                         )
+        # tag the store with its owner so store-layer failpoints
+        # (osd.store.write_before/after_commit) can match per-daemon —
+        # both by entity name (thrasher-style entries) and by context
+        # (config/admin-socket-scoped entries)
+        self.store.fp_entity = self.whoami
+        self.store.fp_cct = cct
         self.messenger = Messenger.create(cct, self.whoami)
         self.messenger.default_policy = POLICY_LOSSLESS_PEER
         self.messenger.add_dispatcher(self)
@@ -442,7 +449,12 @@ class OSD(
         addr = self.osdmap.osd_addrs.get(osd)
         if addr is None:
             raise ConnectionError(f"no address for osd.{osd}")
-        return self.messenger.connect(tuple(addr))
+        conn = self.messenger.connect(tuple(addr))
+        if not conn.peer_name:
+            # dialer-side identity: lets send-path failpoints match on
+            # the peer before any reply has arrived
+            conn.peer_name = f"osd.{osd}"
+        return conn
 
     def _next_tid(self) -> int:
         with self._lock:
@@ -547,6 +559,14 @@ class OSD(
 
     # -- dispatch ----------------------------------------------------------
     def ms_dispatch(self, conn, msg) -> bool:
+        # "osd.dispatch" (legacy: osd_debug_inject_dispatch_delay routed
+        # as delay(sec)) — a delay action stalls this OSD's message
+        # handling, the slow-daemon injection; an error action poisons
+        # the message like a dispatcher bug would.  configured() guard:
+        # this is the hottest dispatch path — stay free when off
+        if fp_registry().configured("osd.dispatch"):
+            failpoint("osd.dispatch", cct=self.cct, entity=self.whoami,
+                      msg=type(msg).__name__)
         if isinstance(msg, MOSDOp):
             src = getattr(msg, "src", None)
             if src is not None:
@@ -624,7 +644,9 @@ class OSD(
             return True
         return False
 
-    def _wait_reply(self, tid: int, timeout: float = 10.0):
+    def _wait_reply(self, tid: int, timeout: float | None = None):
+        if timeout is None:
+            timeout = float(self.cct.conf.get("osd_subop_reply_timeout"))
         with self._lock:
             ok = self._cond.wait_for(
                 lambda: tid in self._sub_replies, timeout=timeout
@@ -670,6 +692,12 @@ class OSD(
                 if now - last_hb >= 2.0:
                     last_hb = now
                     self._heartbeat()
+                # keep the mon subscription alive: a crashed mon would
+                # otherwise leave this OSD on a stale map forever (the
+                # push-based subscription has no other liveness probe);
+                # non-blocking — the hunt runs on a MonClient helper
+                # thread so heartbeat cadence never stalls behind it
+                self.mc.ensure_connection()
                 if now - last_mgr >= self.cct.conf.get("mgr_report_interval"):
                     last_mgr = now
                     self._mgr_report()

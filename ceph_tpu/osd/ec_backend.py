@@ -13,6 +13,7 @@ import time
 import numpy as np
 
 from ..common.crc32c import crc32c
+from ..common.failpoint import FailpointCrash, FailpointError, failpoint
 from ..store.object_store import NotFound, Transaction
 from .messages import (
     MECSubOpRead,
@@ -476,8 +477,16 @@ class ECBackendMixin:
             if osd == self.id:
                 cid = self._cid(pg.pgid, shard)
                 try:
+                    # same injection surface a remote shard read passes
+                    # through (_handle_sub_read): a primary's own chunk
+                    # can report EIO too
+                    failpoint("osd.ec.shard_read", cct=self.cct,
+                              entity=self.whoami, pgid=pg.pgid,
+                              shard=shard, oid=oid)
                     chunk = self.store.read(cid, oid)
-                except (NotFound, KeyError):
+                except FailpointCrash:
+                    raise
+                except (FailpointError, NotFound, KeyError):
                     continue
                 try:
                     stored = int(self.store.getattr(cid, oid, "hinfo"))

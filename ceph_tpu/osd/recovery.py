@@ -11,6 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..common.crc32c import crc32c
+from ..common.failpoint import FailpointCrash, FailpointError, failpoint
 from ..store.object_store import NotFound
 from .messages import (
     MECSubOpWrite,
@@ -476,6 +477,11 @@ class RecoveryMixin:
         no lock while waiting, so there is no cross-OSD lock cycle."""
         retval = -5
         try:
+            # "osd.recovery.pull": an error action makes this donor fail
+            # the catch-up request (the requester retries next pass,
+            # possibly from another peer)
+            failpoint("osd.recovery.pull", cct=self.cct,
+                      entity=self.whoami, pgid=msg.pgid)
             pool_id, ps = msg.pgid.split(".")
             pg = self._pg(int(pool_id), int(ps))
             pool = self.osdmap.pools.get(int(pool_id))
@@ -498,6 +504,8 @@ class RecoveryMixin:
                         is_ec, msg.have_oids,
                     )
                     retval = 0 if ok else -5
+        except FailpointCrash:
+            raise
         except Exception as e:
             self.cct.dout(
                 "osd", 0, f"{self.whoami} pg pull failed: {e!r}"
@@ -544,6 +552,10 @@ class RecoveryMixin:
             omap = {"snapshot": {k: pack_data(v) for k, v in kv.items()}}
         tid = self._next_tid()
         try:
+            # "osd.recovery.push": an error action drops this push on the
+            # floor — the object stays missing until a later pass
+            failpoint("osd.recovery.push", cct=self.cct,
+                      entity=self.whoami, pgid=pg.pgid, oid=oid, to=osd)
             self._conn_to_osd(osd).send_message(
                 MECSubOpWrite(
                     tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
@@ -553,7 +565,9 @@ class RecoveryMixin:
                     xattrs=xattrs, over=gen, osize=osize, omap=omap,
                 )
             )
-        except (OSError, ConnectionError):
+        except FailpointCrash:
+            raise
+        except (FailpointError, OSError, ConnectionError):
             return False
         rep = self._wait_reply(tid, timeout=5.0)
         return rep is not None and rep.retval == 0

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 
 from ..common.crc32c import crc32c
+from ..common.failpoint import FailpointCrash, FailpointError, failpoint
 from ..store.object_store import NotFound, Transaction
 from .messages import (
     MECSubOpRead,
@@ -83,6 +84,15 @@ class ScrubMixin:
 
     def _handle_scrub_shard(self, conn, msg: MScrubShard) -> None:
         try:
+            # "osd.scrub.shard": an error action makes this shard go
+            # silent — the primary scrubs with the maps it can gather
+            failpoint("osd.scrub.shard", cct=self.cct,
+                      entity=self.whoami, pgid=msg.pgid, shard=msg.shard)
+        except FailpointCrash:
+            raise
+        except FailpointError:
+            return
+        try:
             conn.send_message(
                 MScrubShardReply(
                     tid=msg.tid, pgid=msg.pgid, shard=msg.shard,
@@ -100,6 +110,10 @@ class ScrubMixin:
         digest or that miss objects others hold, and (repair=True) rebuild
         those shards from the surviving ones (reference:
         PrimaryLogPG::scrub_compare_maps + repair_object)."""
+        # "osd.scrub.start": error aborts the scrub before any shard map
+        # is collected; delay stretches the scrub window
+        failpoint("osd.scrub.start", cct=self.cct, entity=self.whoami,
+                  pgid=f"{pool_id}.{ps}")
         m = self.osdmap
         pool = m.pools.get(pool_id) if m else None
         if pool is None:

@@ -11,6 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..common.crc32c import crc32c
+from ..common.failpoint import FailpointCrash, FailpointError, failpoint
 from ..store.object_store import NotFound, Transaction
 from .messages import (
     MECSubOpRead,
@@ -271,6 +272,25 @@ class SubOpsMixin:
 
     def _handle_sub_read(self, conn, msg: MECSubOpRead) -> None:
         cid = self._cid(msg.pgid, msg.shard)
+        try:
+            # "osd.ec.shard_read" (legacy: osd_debug_inject_read_err) —
+            # an error action makes this shard answer EIO, forcing the
+            # primary onto the reconstruct-from-survivors path
+            failpoint("osd.ec.shard_read", cct=self.cct,
+                      entity=self.whoami, pgid=msg.pgid, shard=msg.shard,
+                      oid=msg.oid)
+        except FailpointCrash:
+            raise
+        except FailpointError:
+            try:
+                conn.send_message(MECSubOpReadReply(
+                    tid=msg.tid, pgid=msg.pgid, oid=msg.oid,
+                    shard=msg.shard, retval=-5, data=None, size=None,
+                    xattrs=None, ver=None,
+                ))
+            except (OSError, ConnectionError):
+                pass
+            return
         try:
             if msg.offsets == []:
                 # metadata-only probe: existence + size/xattrs, no body

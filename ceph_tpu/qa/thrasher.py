@@ -1,0 +1,373 @@
+"""Deterministic seeded Thrasher + cluster InvariantChecker (reference:
+qa/tasks/thrashosds.py / ceph_manager.py's Thrasher, rebuilt on the
+failpoint registry; docs/fault_injection.md).
+
+The thrasher separates PLANNING from EXECUTION:
+
+- ``plan(n_events)`` derives an event schedule purely from the seed and
+  the thrasher's own bookkeeping (which OSDs it has killed, which pairs
+  it has split, which objects it has written).  No cluster state, no
+  clocks, no thread timing feeds it, so the same seed yields the same
+  event log bit-for-bit, every time — the replay property chaos findings
+  need to be debuggable.
+- ``run(n_events)`` executes that schedule against a LocalCluster:
+  kill/revive (real daemon death), netsplits (failpoint-dropped frames
+  between OSD pairs), EC shard EIO, at-rest shard corruption, mon
+  election churn — interleaved with client writes and reads whose
+  acknowledged payloads are remembered for the checker.
+
+Outcomes (did a write ack? did a read succeed?) are deliberately NOT part
+of the event log: they depend on scheduling and wall clocks.  The log is
+the schedule; the ``acked`` dict is the contract the InvariantChecker
+holds the cluster to after quiesce:
+
+    1. zero acknowledged-write loss (every acked payload reads back),
+    2. every PG of the pool active+clean (LocalCluster._all_clean),
+    3. a clean scrub (after one repair pass heals injected corruption),
+    4. replay determinism (re-planning the same seed reproduces the log).
+
+    with LocalCluster(n_mons=3, n_osds=5) as c:
+        c.create_ec_pool("th", k=2, m=1)
+        th = Thrasher(c, seed=1234, pool="th")
+        th.run(24)
+        th.quiesce()
+        InvariantChecker(c, "th").check(th)
+"""
+from __future__ import annotations
+
+import random
+import time
+import zlib
+
+from ..common.failpoint import registry
+
+
+def _pairs(alive: set[int]) -> list[tuple[int, int]]:
+    """All (low, high) OSD pairs over the alive set, sorted."""
+    ordered = sorted(alive)
+    return [
+        (a, b) for i, a in enumerate(ordered) for b in ordered[i + 1:]
+    ]
+
+
+# event kinds in FIXED declaration order — the weighted draw walks this
+# list, so reordering it changes every schedule (bump seeds if you must)
+_KINDS = (
+    ("write", 5),
+    ("read", 2),
+    ("kill", 3),
+    ("revive", 3),
+    ("netsplit", 2),
+    ("heal", 2),
+    ("ec_eio", 2),
+    ("corrupt", 2),
+    ("mon_churn", 1),
+)
+
+
+class Thrasher:
+    """Seeded chaos driver.  `cluster` may be None for plan-only use
+    (the seed-determinism tests); then `n_osds`/`n_mons` describe the
+    topology the schedule is for."""
+
+    def __init__(self, cluster, seed: int, pool: str = "thrash",
+                 n_osds: int | None = None, n_mons: int | None = None,
+                 max_dead: int = 1, max_splits: int = 1,
+                 object_size: int = 1024):
+        self.cluster = cluster
+        self.seed = seed
+        self.pool = pool
+        self.n_osds = n_osds if n_osds is not None else cluster.n_osds
+        self.n_mons = n_mons if n_mons is not None else cluster.n_mons
+        self.max_dead = max_dead
+        self.max_splits = max_splits
+        self.object_size = object_size
+        self.events: list[tuple] = []
+        #: oid -> payload for every write the cluster ACKED
+        self.acked: dict[str, bytes] = {}
+        self._payloads: dict[str, bytes] = {}
+        self._fp_tokens: list[tuple[str, int]] = []   # (name, entry id)
+        self._split_tokens: dict[tuple[int, int], list] = {}
+        self._io = None
+        self._client = None
+
+    # -- planning (pure) ---------------------------------------------------
+    def plan(self, n_events: int) -> list[tuple]:
+        """Deterministic schedule of `n_events` events for this seed.
+        Also (re)fills self._payloads with each planned write's bytes."""
+        rng = random.Random(self.seed)
+        alive = set(range(self.n_osds))
+        dead: set[int] = set()
+        splits: set[tuple[int, int]] = set()
+        written: list[str] = []
+        self._payloads = {}
+        events: list[tuple] = []
+        wseq = 0
+
+        def write_event():
+            nonlocal wseq
+            oid = f"thrash-{self.seed}-{wseq}"
+            wseq += 1
+            payload = bytes(rng.getrandbits(8)
+                            for _ in range(self.object_size))
+            self._payloads[oid] = payload
+            written.append(oid)
+            return ("write", oid, self.object_size,
+                    zlib.crc32(payload) & 0xFFFFFFFF)
+
+        # prime: the first event is always a write so read/corrupt events
+        # have targets whatever the seed says
+        events.append(write_event())
+        while len(events) < n_events:
+            kinds, weights = [], []
+            for kind, w in _KINDS:
+                if kind == "kill" and not (
+                    len(dead) < self.max_dead and len(alive) > 1
+                ):
+                    continue
+                if kind == "revive" and not dead:
+                    continue
+                if kind == "netsplit":
+                    # only pairs not already split are eligible — a
+                    # duplicate pair would double-arm the drop entries
+                    # and leak the first set past heal/quiesce
+                    unsplit = [
+                        p for p in _pairs(alive) if p not in splits
+                    ]
+                    if len(splits) >= self.max_splits or not unsplit:
+                        continue
+                if kind == "heal" and not splits:
+                    continue
+                if kind in ("ec_eio", "corrupt") and not alive:
+                    continue
+                if kind == "corrupt" and not written:
+                    continue
+                if kind == "read" and not written:
+                    continue
+                if kind == "mon_churn" and self.n_mons < 2:
+                    continue
+                kinds.append(kind)
+                weights.append(w)
+            kind = rng.choices(kinds, weights=weights)[0]
+            if kind == "write":
+                events.append(write_event())
+            elif kind == "read":
+                events.append(("read", rng.choice(written)))
+            elif kind == "kill":
+                victim = rng.choice(sorted(alive))
+                alive.discard(victim)
+                dead.add(victim)
+                events.append(("kill", victim))
+            elif kind == "revive":
+                back = rng.choice(sorted(dead))
+                dead.discard(back)
+                alive.add(back)
+                events.append(("revive", back))
+            elif kind == "netsplit":
+                pair = rng.choice(
+                    [p for p in _pairs(alive) if p not in splits]
+                )
+                splits.add(pair)
+                events.append(("netsplit",) + pair)
+            elif kind == "heal":
+                pair = rng.choice(sorted(splits))
+                splits.discard(pair)
+                events.append(("heal",) + pair)
+            elif kind == "ec_eio":
+                osd = rng.choice(sorted(alive))
+                events.append(("ec_eio", osd, rng.randint(1, 4)))
+            elif kind == "corrupt":
+                events.append(
+                    ("corrupt", rng.choice(sorted(alive)),
+                     rng.choice(written))
+                )
+            elif kind == "mon_churn":
+                events.append(
+                    ("mon_churn", chr(ord("a") + rng.randrange(self.n_mons)))
+                )
+        return events
+
+    # -- execution ---------------------------------------------------------
+    def run(self, n_events: int) -> list[tuple]:
+        """Plan and execute `n_events`; returns the event log (identical
+        to plan(n_events) for the same seed, by construction)."""
+        assert self.cluster is not None, "plan-only thrasher (no cluster)"
+        events = self.plan(n_events)
+        self.events = []
+        self._client = self.cluster.client(f"client.thrash-{self.seed}")
+        self._io = self._client.open_ioctx(self.pool)
+        for ev in events:
+            self.events.append(ev)
+            self._execute(ev)
+        return self.events
+
+    def _execute(self, ev: tuple) -> None:
+        c = self.cluster
+        kind = ev[0]
+        if kind == "write":
+            _, oid, _size, _crc = ev
+            payload = self._payloads[oid]
+            try:
+                self._io.write_full(oid, payload)
+            except (IOError, OSError, TimeoutError):
+                return  # not acked: the checker must not expect it
+            self.acked[oid] = payload
+        elif kind == "read":
+            oid = ev[1]
+            try:
+                got = self._io.read(oid)
+            except (IOError, OSError, TimeoutError, KeyError):
+                return  # unreadable mid-chaos is legal; silent loss isn't
+            if oid in self.acked:
+                assert got == self.acked[oid], (
+                    f"acked write {oid} read back wrong mid-thrash"
+                )
+        elif kind == "kill":
+            osd = ev[1]
+            if osd in c.osds:
+                c.kill_osd(osd)
+                self._mon_cmd_retry(
+                    {"prefix": "osd down", "id": osd},
+                    {"prefix": "osd out", "id": osd},
+                )
+        elif kind == "revive":
+            osd = ev[1]
+            if osd not in c.osds:
+                c.revive_osd(osd)
+                self._mon_cmd_retry({"prefix": "osd in", "id": osd})
+        elif kind == "netsplit":
+            a, b = ev[1], ev[2]
+            if (a, b) in self._split_tokens:
+                return  # already split: never orphan armed entries
+            reg = registry()
+            toks = []
+            for src, dst in ((a, b), (b, a)):
+                toks.append(reg.add(
+                    "msgr.frame.recv", "error",
+                    match={"entity": f"osd.{src}", "peer": f"osd.{dst}"},
+                ))
+            self._split_tokens[(a, b)] = toks
+        elif kind == "heal":
+            self._heal(ev[1], ev[2])
+        elif kind == "ec_eio":
+            osd, n = ev[1], ev[2]
+            eid = registry().add(
+                "osd.ec.shard_read", f"times({n},error)",
+                match={"entity": f"osd.{osd}"},
+            )
+            self._fp_tokens.append(("osd.ec.shard_read", eid))
+        elif kind == "corrupt":
+            self._corrupt(ev[1], ev[2])
+        elif kind == "mon_churn":
+            mon = c.mons.get(ev[1])
+            if mon is not None:
+                mon.elector.start_election()
+
+    def _heal(self, a: int, b: int) -> None:
+        toks = self._split_tokens.pop((a, b), [])
+        for eid in toks:
+            registry().remove("msgr.frame.recv", eid=eid)
+
+    def _corrupt(self, osd_id: int, oid: str) -> None:
+        """Scribble over ONE stored copy of `oid` on `osd_id` without
+        touching its digest xattr — exactly the at-rest rot deep scrub
+        exists to find (and repair from the surviving shards)."""
+        from ..store.object_store import Transaction
+
+        osd = self.cluster.osds.get(osd_id)
+        if osd is None:
+            return
+        try:
+            for cid in osd.store.list_collections():
+                if oid not in osd.store.list_objects(cid):
+                    continue
+                t = Transaction()
+                t.write(cid, oid, 0, b"\xde\xad\xbe\xef" * 4)
+                osd.store.queue_transaction(t)
+                return
+        except (IOError, OSError, KeyError):
+            pass  # racing a kill/delete: the corruption just didn't land
+
+    def _mon_cmd_retry(self, *cmds: dict, tries: int = 3) -> None:
+        """Mon commands ride through election churn: retry a few times,
+        then give up (failure detection will converge on its own)."""
+        for cmd in cmds:
+            for i in range(tries):
+                try:
+                    rv, _res = self.cluster.mon_command(cmd)
+                    if rv == 0:
+                        break
+                except (IOError, OSError, TimeoutError):
+                    pass
+                time.sleep(0.5 * (i + 1))
+
+    # -- teardown ----------------------------------------------------------
+    def quiesce(self, timeout: float = 90.0) -> None:
+        """Withdraw every injection, revive every victim, and wait for
+        the pool to settle — the precondition for invariant checks."""
+        c = self.cluster
+        for a, b in list(self._split_tokens):
+            self._heal(a, b)
+        for name, eid in self._fp_tokens:
+            registry().remove(name, eid=eid)
+        self._fp_tokens.clear()
+        for osd in range(self.n_osds):
+            if osd not in c.osds:
+                c.revive_osd(osd)
+            self._mon_cmd_retry({"prefix": "osd in", "id": osd})
+        c.wait_clean(self.pool, timeout=timeout)
+
+
+class InvariantChecker:
+    """Post-quiesce cluster invariants (the thrasher's acceptance gate)."""
+
+    def __init__(self, cluster, pool: str):
+        self.cluster = cluster
+        self.pool = pool
+
+    def _pool_pgs(self):
+        leader = self.cluster._leader()
+        m = leader.osdmon.osdmap
+        pid = next(i for i, p in m.pools.items() if p.name == self.pool)
+        return m, pid, m.pools[pid]
+
+    def check(self, thrasher: Thrasher, timeout: float = 90.0) -> dict:
+        """Assert all four invariants; returns a small report dict."""
+        report = {
+            "acked_writes": len(thrasher.acked),
+            "scrub_errors_repaired": 0,
+        }
+        # 1. PGs active+clean (version-agreeing, content-complete shards)
+        self.cluster.wait_clean(self.pool, timeout=timeout)
+        # 2. zero acknowledged-write loss
+        io = thrasher._io
+        for oid in sorted(thrasher.acked):
+            got = io.read(oid)
+            assert got == thrasher.acked[oid], (
+                f"acknowledged write {oid} lost or corrupted after quiesce"
+            )
+        # 3. scrub: one repair pass may heal injected at-rest corruption;
+        # the verification pass must then be spotless
+        m, pid, pool = self._pool_pgs()
+        for repair in (True, False):
+            errors = []
+            for ps in range(pool.pg_num):
+                _up, _upp, acting, primary = m.pg_to_up_acting_osds(pid, ps)
+                posd = self.cluster.osds[primary]
+                rep = posd.scrub_pg(pid, ps, repair=repair)
+                errors.extend(rep["errors"])
+                if repair:
+                    report["scrub_errors_repaired"] += rep["repaired"]
+            if not repair:
+                assert errors == [], f"scrub inconsistencies: {errors}"
+        # 4. replay determinism: the same seed re-plans to the same log
+        replay = Thrasher(
+            None, thrasher.seed, pool=thrasher.pool,
+            n_osds=thrasher.n_osds, n_mons=thrasher.n_mons,
+            max_dead=thrasher.max_dead, max_splits=thrasher.max_splits,
+            object_size=thrasher.object_size,
+        ).plan(len(thrasher.events))
+        assert replay == thrasher.events, (
+            "replay with the same seed diverged from the executed log"
+        )
+        return report

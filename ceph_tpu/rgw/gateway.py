@@ -465,12 +465,13 @@ class _Store:
             if head.get("dm") or now - head.get("mtime", now) \
                     < days * 86400:
                 return False
-        # delete OUTSIDE the pass's view but through the normal path
-        # (delete marker under versioning); the re-check above closed
-        # the stale-snapshot race, a PUT after it wins like any
-        # delete/put race would
-        self.delete_object(bucket, key)
-        return True
+            # delete while STILL holding the lock (reentrant): a PUT
+            # landing between the recheck and the delete would otherwise
+            # have its fresh bytes removed by the lifecycle worker — a
+            # far more surprising loss than any user-initiated
+            # delete/put race.  A PUT after the release wins normally.
+            self.delete_object(bucket, key)
+            return True
 
     def _expire_noncurrent(self, bucket: str, key: str, now: float,
                            nc_days: float) -> None:
@@ -490,10 +491,15 @@ class _Store:
                     keep.append(v)
             if not dead:
                 return
+            # trim the index FIRST, then drop the backing streams: a
+            # crash between the two then only leaks collectable garbage
+            # (unreferenced streams), never index entries pointing at
+            # data that is gone (listed-but-unreadable) — same ordering
+            # the rmsnap path documents
+            self._index_put(bucket, key, self._ent_from_versions(keep))
             for v in dead:
                 if not v.get("dm"):
                     self._stream(bucket, key, v["vid"]).remove()
-            self._index_put(bucket, key, self._ent_from_versions(keep))
 
     @staticmethod
     def _versions_of(ent: dict) -> list[dict]:

@@ -279,8 +279,11 @@ class BlueStore(ObjectStore):
     def queue_transaction(
         self, t: Transaction, on_commit: Callable[[], None] | None = None
     ) -> None:
+        # torn-write injection: see MemStore.queue_transaction
+        self._fp_hit("osd.store.write_before_commit")
         with self._lock:
             self._apply_txn(t)
+        self._fp_hit("osd.store.write_after_commit")
         if on_commit is not None:
             on_commit()
 

@@ -105,6 +105,10 @@ class KStore(MemStore):
     def queue_transaction(
         self, t: Transaction, on_commit: Callable[[], None] | None = None
     ) -> None:
+        # torn-write injection: before = nothing durable, after = the WAL
+        # batch committed but the caller sees a failure (the crash shapes
+        # WAL replay and dup detection must absorb)
+        self._fp_hit("osd.store.write_before_commit")
         with self._io_lock, self._lock:
             before_colls = set(self._colls)
             touched = {(op.cid, op.oid) for op in t.ops if op.oid} | {
@@ -155,6 +159,7 @@ class KStore(MemStore):
                     for key, val in o.omap.items():
                         batch.set(_okey(cid, oid, key), val)
             self._kv.submit_batch(batch)
+        self._fp_hit("osd.store.write_after_commit")
         if on_commit:
             on_commit()
 

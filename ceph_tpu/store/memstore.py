@@ -17,8 +17,14 @@ class MemStore(ObjectStore):
     def queue_transaction(
         self, t: Transaction, on_commit: Callable[[], None] | None = None
     ) -> None:
+        # torn-write injection (docs/fault_injection.md): an error BEFORE
+        # the apply fails the txn with nothing durable; one AFTER fails
+        # the caller although the txn committed — the crash-between-ack-
+        # and-apply shapes recovery must absorb
+        self._fp_hit("osd.store.write_before_commit")
         with self._lock:
             self.apply_atomic(self._colls, t)
+        self._fp_hit("osd.store.write_after_commit")
         if on_commit:
             on_commit()
 

@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Iterable
 
 from ..common.buffer import BufferList, BufferListIterator
+from ..common.failpoint import failpoint as _failpoint, registry as _fp_registry
 
 
 class StoreError(RuntimeError):
@@ -202,6 +203,16 @@ class ObjectStore:
 
     def umount(self) -> None:
         pass
+
+    def _fp_hit(self, name: str) -> None:
+        """Evaluate a store-layer failpoint with this store's owner tags
+        (fp_entity/fp_cct, stamped by the owning OSD) so per-daemon
+        entries match — shared by every backend's commit path.  The
+        configured() guard keeps the off-state commit path free (this
+        runs twice per transaction on every OSD)."""
+        if _fp_registry().configured(name):
+            _failpoint(name, cct=getattr(self, "fp_cct", None),
+                       entity=getattr(self, "fp_entity", None))
 
     # -- writes -----------------------------------------------------------
     def queue_transaction(

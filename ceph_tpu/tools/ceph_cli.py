@@ -279,17 +279,47 @@ def main(argv=None, out=sys.stdout) -> int:
             return 22
         from ..common.admin_socket import admin_socket_command
 
-        # k=v tokens become command fields, the rest joins into the
-        # prefix: `ceph daemon x.asok config get var=debug_osd`
-        cmd = {}
-        prefix_words = []
-        for w in args.words[2:]:
-            if "=" in w and not w.startswith("="):
-                k, _, v = w.partition("=")
-                cmd[k] = v
-            else:
-                prefix_words.append(w)
-        cmd["prefix"] = " ".join(prefix_words)
+        sub = args.words[2:]
+        if sub[0] == "injectargs":
+            # ceph daemon <asok> injectargs --option value [--opt=val ...]
+            # (reference: the ceph CLI's injectargs passthrough); the
+            # --flags must not be eaten by the generic k=v split
+            cmd = {"prefix": "injectargs", "args": " ".join(sub[1:])}
+        elif sub[0] == "failpoint":
+            # ceph daemon <asok> failpoint list
+            #                    failpoint seed <n>
+            #                    failpoint set|add <name> <spec>
+            #                    failpoint rm <name>
+            fsub = sub[1] if len(sub) > 1 else "list"
+            cmd = {"prefix": "failpoint", "sub": fsub}
+            try:
+                if fsub == "seed":
+                    cmd["seed"] = int(sub[2])
+                elif fsub in ("set", "add", "rm"):
+                    cmd["name"] = sub[2]
+                    if fsub != "rm":
+                        cmd["spec"] = " ".join(sub[3:])
+                        if not cmd["spec"]:
+                            raise IndexError
+                elif fsub != "list":
+                    raise IndexError
+            except (IndexError, ValueError):
+                print("usage: ceph daemon <asok> failpoint "
+                      "list | seed <n> | set|add <name> <spec> | "
+                      "rm <name>", file=sys.stderr)
+                return 22
+        else:
+            # k=v tokens become command fields, the rest joins into the
+            # prefix: `ceph daemon x.asok config get var=debug_osd`
+            cmd = {}
+            prefix_words = []
+            for w in sub:
+                if "=" in w and not w.startswith("="):
+                    k, _, v = w.partition("=")
+                    cmd[k] = v
+                else:
+                    prefix_words.append(w)
+            cmd["prefix"] = " ".join(prefix_words)
         try:
             res = admin_socket_command(args.words[1], cmd)
         except (OSError, ValueError) as e:

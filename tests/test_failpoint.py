@@ -1,0 +1,451 @@
+"""Failpoint subsystem unit tests (common/failpoint.py): spec parsing,
+every action type, the prob/times/every combinators and their seeded
+determinism, registry matching/ownership, the Config + admin-socket +
+ceph_cli control surfaces, and the Thrasher's seed-determinism (plan
+purity — no cluster needed here; execution is tests/test_thrasher.py).
+"""
+import os
+import time
+
+import pytest
+
+from ceph_tpu.common.context import CephContext
+from ceph_tpu.common.failpoint import (
+    FailpointCrash,
+    FailpointError,
+    FailpointRegistry,
+    FailpointSpecError,
+    failpoint,
+    parse_spec,
+    registry,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    registry().clear()
+    yield
+    registry().clear()
+
+
+def fires(reg: FailpointRegistry, name: str, n: int, **ctx) -> list[bool]:
+    """Hit `name` n times; True where the error action fired."""
+    out = []
+    for _ in range(n):
+        try:
+            reg.hit(name, **ctx)
+            out.append(False)
+        except FailpointError:
+            out.append(True)
+    return out
+
+
+class TestSpecParsing:
+    def test_round_trip_describe(self):
+        for spec in ("off", "error", "error(OSError)", "delay(0.5)",
+                     "crash", "prob(0.25,error)", "times(3,error)",
+                     "every(5,error)", "prob(0.5,times(2,error(OSError)))"):
+            assert parse_spec(spec).describe() == spec
+
+    @pytest.mark.parametrize("bad", [
+        "", "bogus", "error(NoSuchError)", "delay(x)", "delay(-1)",
+        "prob(2,error)", "prob(0.5)", "times(-1,error)", "every(0,error)",
+        "prob(0.5,error", "wat(1,error)", "times(1,error,extra)",
+    ])
+    def test_bad_specs_raise(self, bad):
+        with pytest.raises(FailpointSpecError):
+            parse_spec(bad)
+
+
+class TestActions:
+    def test_off_never_fires(self):
+        reg = FailpointRegistry()
+        reg.add("a", "times(1,error)")
+        reg.set("a", "off")
+        assert fires(reg, "a", 10) == [False] * 10
+
+    def test_unconfigured_is_noop(self):
+        reg = FailpointRegistry()
+        reg.hit("never.configured")  # must not raise
+
+    def test_error_default_type(self):
+        reg = FailpointRegistry()
+        reg.set("a", "error")
+        with pytest.raises(FailpointError):
+            reg.hit("a")
+
+    @pytest.mark.parametrize("name,exc", [
+        ("OSError", OSError), ("ConnectionError", ConnectionError),
+        ("TimeoutError", TimeoutError), ("RuntimeError", RuntimeError),
+    ])
+    def test_error_named_types(self, name, exc):
+        reg = FailpointRegistry()
+        reg.set("a", f"error({name})")
+        with pytest.raises(exc):
+            reg.hit("a")
+
+    def test_delay_sleeps(self):
+        reg = FailpointRegistry()
+        reg.set("a", "delay(0.05)")
+        t0 = time.monotonic()
+        reg.hit("a")
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_crash_raises_crash_subclass(self):
+        reg = FailpointRegistry()
+        reg.set("a", "crash")
+        with pytest.raises(FailpointCrash):
+            reg.hit("a")
+        # crash IS a FailpointError so generic site handlers see it, but
+        # sites re-raise it first (the crash-beats-handling contract)
+        assert issubclass(FailpointCrash, FailpointError)
+
+
+class TestCombinators:
+    def test_times_fires_exactly_n(self):
+        reg = FailpointRegistry()
+        reg.set("a", "times(2,error)")
+        assert fires(reg, "a", 5) == [True, True, False, False, False]
+
+    def test_every_cadence(self):
+        reg = FailpointRegistry()
+        reg.set("a", "every(3,error)")
+        assert fires(reg, "a", 9) == [
+            False, False, True, False, False, True, False, False, True,
+        ]
+
+    def test_prob_extremes(self):
+        reg = FailpointRegistry()
+        reg.set("a", "prob(1,error)")
+        assert fires(reg, "a", 5) == [True] * 5
+        reg.set("a", "prob(0,error)")
+        assert fires(reg, "a", 5) == [False] * 5
+
+    def test_prob_seeded_determinism(self):
+        runs = []
+        for _ in range(2):
+            reg = FailpointRegistry(seed=42)
+            reg.set("a", "prob(0.5,error)")
+            runs.append(fires(reg, "a", 40))
+        assert runs[0] == runs[1]
+        assert any(runs[0]) and not all(runs[0])  # actually stochastic
+        other = FailpointRegistry(seed=43)
+        other.set("a", "prob(0.5,error)")
+        assert fires(other, "a", 40) != runs[0]
+
+    def test_seed_reset_replays(self):
+        reg = FailpointRegistry(seed=7)
+        reg.set("a", "prob(0.5,error)")
+        first = fires(reg, "a", 30)
+        reg.seed(7)
+        reg.set("a", "prob(0.5,error)")  # fresh combinator state too
+        assert fires(reg, "a", 30) == first
+
+    def test_times_wrapping_prob_counts_executions(self):
+        # times(1, prob(...)) must burn its single shot only when the
+        # inner prob actually fires
+        reg = FailpointRegistry(seed=1)
+        reg.set("a", "times(1,prob(0.2,error))")
+        got = fires(reg, "a", 200)
+        assert sum(got) == 1
+
+    def test_every_wrapping_times(self):
+        reg = FailpointRegistry()
+        reg.set("a", "every(2,times(2,error))")
+        assert fires(reg, "a", 8) == [
+            False, True, False, True, False, False, False, False,
+        ]
+
+
+class TestRegistry:
+    def test_match_filters_by_ctx(self):
+        reg = FailpointRegistry()
+        reg.add("a", "error", match={"entity": "osd.1"})
+        assert fires(reg, "a", 1, entity="osd.1") == [True]
+        assert fires(reg, "a", 1, entity="osd.2") == [False]
+        assert fires(reg, "a", 1) == [False]  # missing key = no match
+
+    def test_multiple_entries_and_remove_by_id(self):
+        reg = FailpointRegistry()
+        e1 = reg.add("a", "error", match={"entity": "osd.1"})
+        reg.add("a", "error", match={"entity": "osd.2"})
+        assert fires(reg, "a", 1, entity="osd.1") == [True]
+        assert fires(reg, "a", 1, entity="osd.2") == [True]
+        assert reg.remove("a", eid=e1) == 1
+        assert fires(reg, "a", 1, entity="osd.1") == [False]
+        assert fires(reg, "a", 1, entity="osd.2") == [True]
+
+    def test_set_replaces_only_same_match(self):
+        reg = FailpointRegistry()
+        reg.add("a", "error", match={"entity": "osd.1"})
+        reg.set("a", "error", match={"owner": "cfg"})
+        reg.set("a", "off", match={"owner": "cfg"})  # retire cfg's entry
+        assert fires(reg, "a", 1, entity="osd.1") == [True]  # survived
+
+    def test_list_reports_hits(self):
+        reg = FailpointRegistry()
+        reg.set("a", "times(1,error)")
+        fires(reg, "a", 3)
+        info = reg.list()["a"][0]
+        assert info["hits"] == 3 and info["spec"] == "times(1,error)"
+
+
+class TestConfigRouting:
+    def test_legacy_socket_failures_option(self):
+        cct = CephContext("osd.77")
+        cct.conf.set("ms_inject_socket_failures", 4)
+        assert registry().configured("msgr.frame.send")
+        # scoped to this context: another daemon's hits don't match
+        other = CephContext("osd.78")
+        assert fires(registry(), "msgr.frame.send", 4, cct=other) == \
+            [False] * 4
+        got = fires(registry(), "msgr.frame.send", 8, cct=cct)
+        assert got == [False, False, False, True] * 2
+        cct.conf.set("ms_inject_socket_failures", 0)
+        assert not registry().configured("msgr.frame.send")
+
+    def test_legacy_read_err_option(self):
+        cct = CephContext("osd.77",
+                          overrides={"osd_debug_inject_read_err": True})
+        assert fires(registry(), "osd.ec.shard_read", 2, cct=cct) == \
+            [True, True]
+        cct.conf.set("osd_debug_inject_read_err", False)
+        assert not registry().configured("osd.ec.shard_read")
+
+    def test_legacy_dispatch_delay_option(self):
+        cct = CephContext(
+            "osd.77", overrides={"osd_debug_inject_dispatch_delay": 0.05})
+        t0 = time.monotonic()
+        registry().hit("osd.dispatch", cct=cct)
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_generic_failpoint_option(self):
+        cct = CephContext("osd.77", overrides={
+            "failpoint": "x.one=times(1,error);x.two=error(OSError)"})
+        assert fires(registry(), "x.one", 2, cct=cct) == [True, False]
+        with pytest.raises(OSError):
+            registry().hit("x.two", cct=cct)
+        cct.conf.set("failpoint", "x.one=error")
+        assert not registry().configured("x.two")  # retired with the opt
+
+    def test_generic_option_retire_resyncs_legacy(self):
+        # the legacy observer replaces (same match) the entry the
+        # generic option armed under the same name; clearing the generic
+        # option must then RE-SYNC the still-set legacy option, not
+        # leave it silently disarmed
+        cct = CephContext("osd.77", overrides={
+            "failpoint": "msgr.frame.send=error"})
+        cct.conf.set("ms_inject_socket_failures", 2)
+        cct.conf.set("failpoint", "")
+        assert registry().configured("msgr.frame.send")
+        assert fires(registry(), "msgr.frame.send", 4, cct=cct) == \
+            [False, True, False, True]
+        cct.conf.set("ms_inject_socket_failures", 0)
+        assert not registry().configured("msgr.frame.send")
+
+    def test_bad_failpoint_option_arms_nothing(self):
+        # a bad spec mid-list must not leave earlier assignments armed
+        # outside the option's ownership tracking
+        from ceph_tpu.common.config import ConfigError
+
+        cct = CephContext("osd.77")
+        with pytest.raises((FailpointSpecError, ConfigError, ValueError)):
+            cct.conf.set("failpoint",
+                         "osd.dispatch=delay(1);osd.scrub.start=bogus")
+        assert not registry().configured("osd.dispatch")
+
+    def test_config_scoped_entry_reaches_store_sites(self):
+        # the store hit sites pass the owning daemon's cct (via fp_cct),
+        # so a config/admin-socket-armed torn-write failpoint really fires
+        from ceph_tpu.store.memstore import MemStore
+        from ceph_tpu.store.object_store import Transaction
+
+        cct = CephContext("osd.77", overrides={
+            "failpoint": "osd.store.write_before_commit=times(1,error)"})
+        store = MemStore()
+        store.fp_entity, store.fp_cct = "osd.77", cct
+        t = Transaction().try_create_collection("c").touch("c", "o")
+        with pytest.raises(FailpointError):
+            store.queue_transaction(t)
+        assert not store.collection_exists("c")  # nothing durable
+        store.queue_transaction(t)  # times(1) exhausted: applies
+        assert store.collection_exists("c")
+
+    def test_shutdown_unbinds(self):
+        cct = CephContext("osd.77",
+                          overrides={"osd_debug_inject_read_err": True})
+        assert registry().configured("osd.ec.shard_read")
+        cct.shutdown()
+        assert not registry().configured("osd.ec.shard_read")
+
+
+class TestAdminSocketAndCli:
+    @pytest.fixture()
+    def asok_cct(self, tmp_path):
+        cct = CephContext(
+            "osd.88", overrides={"admin_socket": str(tmp_path / "t.asok")})
+        yield cct, str(tmp_path / "t.asok")
+        cct.shutdown()
+
+    def test_failpoint_commands(self, asok_cct):
+        from ceph_tpu.common.admin_socket import admin_socket_command
+
+        cct, path = asok_cct
+        res = admin_socket_command(
+            path, {"prefix": "failpoint", "sub": "set",
+                   "name": "y.z", "spec": "times(1,error)"})
+        assert res["y.z"] == "times(1,error)"
+        assert "y.z" in admin_socket_command(
+            path, {"prefix": "failpoint", "sub": "list"})
+        assert fires(registry(), "y.z", 2, cct=cct) == [True, False]
+        res = admin_socket_command(
+            path, {"prefix": "failpoint", "sub": "rm", "name": "y.z"})
+        assert res == {"removed": 1}
+        res = admin_socket_command(
+            path, {"prefix": "failpoint", "sub": "seed", "seed": 5})
+        assert res == {"seeded": 5}
+
+    def test_injectargs_runtime_option(self, asok_cct):
+        from ceph_tpu.common.admin_socket import admin_socket_command
+
+        cct, path = asok_cct
+        res = admin_socket_command(
+            path, {"prefix": "injectargs",
+                   "args": "--osd_debug_inject_read_err true"})
+        assert res == {"osd_debug_inject_read_err": True}
+        assert cct.conf.get("osd_debug_inject_read_err") is True
+        assert registry().configured("osd.ec.shard_read")
+        # non-runtime options are refused
+        res = admin_socket_command(
+            path, {"prefix": "injectargs", "args": "--osd_data /tmp/x"})
+        assert "error" in res
+
+    def test_ceph_cli_failpoint_and_injectargs(self, asok_cct, capsys):
+        from ceph_tpu.tools.ceph_cli import main
+
+        cct, path = asok_cct
+        rc = main(["-m", "127.0.0.1:1", "daemon", path,
+                   "failpoint", "set", "c.li", "every(2,error)"])
+        assert rc == 0
+        assert fires(registry(), "c.li", 2, cct=cct) == [False, True]
+        rc = main(["-m", "127.0.0.1:1", "daemon", path,
+                   "injectargs", "--osd_debug_inject_dispatch_delay",
+                   "0.25"])
+        assert rc == 0
+        assert cct.conf.get("osd_debug_inject_dispatch_delay") == 0.25
+        rc = main(["-m", "127.0.0.1:1", "daemon", path,
+                   "failpoint", "set"])  # missing name/spec
+        assert rc == 22
+
+
+class TestMessengerNetsplit:
+    def test_recv_drop_entry_swallows_frames(self):
+        """The thrasher's netsplit primitive: matched frames vanish at
+        the receiver; unmatched peers and healed links deliver."""
+        import threading
+
+        from ceph_tpu.msg import Dispatcher, Messenger, MPing
+
+        class Collector(Dispatcher):
+            def __init__(self):
+                self.msgs = []
+                self.event = threading.Event()
+
+            def ms_dispatch(self, conn, msg):
+                self.msgs.append((conn, msg))
+                self.event.set()
+                return True
+
+            def wait_msgs(self, n, timeout=5.0):
+                deadline = time.monotonic() + timeout
+                while len(self.msgs) < n and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                return len(self.msgs) >= n
+
+        cct = CephContext("osd.90")
+        server = Messenger.create(cct, "osd.90")
+        disp = Collector()
+        server.add_dispatcher(disp)
+        server.bind(("127.0.0.1", 0))
+        server.start()
+        client = Messenger.create(cct, "osd.91")
+        blocked = Messenger.create(cct, "osd.92")
+        try:
+            eid = registry().add(
+                "msgr.frame.recv", "error",
+                match={"entity": "osd.90", "peer": "osd.92"})
+            cb = blocked.connect(server.myaddr)
+            cc = client.connect(server.myaddr)
+            cb.send_message(MPing())          # dropped (split pair)
+            cc.send_message(MPing())          # delivered
+            assert disp.wait_msgs(1)
+            time.sleep(0.2)
+            assert len(disp.msgs) == 1
+            assert disp.msgs[0][1].src == "osd.91"
+            registry().remove("msgr.frame.recv", eid=eid)  # heal
+            cb.send_message(MPing())
+            assert disp.wait_msgs(2)
+        finally:
+            client.shutdown()
+            blocked.shutdown()
+            server.shutdown()
+
+
+class TestThrasherPlanDeterminism:
+    def test_same_seed_same_log(self):
+        from ceph_tpu.qa.thrasher import Thrasher
+
+        a = Thrasher(None, seed=99, n_osds=5, n_mons=3).plan(40)
+        b = Thrasher(None, seed=99, n_osds=5, n_mons=3).plan(40)
+        assert a == b
+        assert len(a) == 40
+
+    def test_different_seed_different_log(self):
+        from ceph_tpu.qa.thrasher import Thrasher
+
+        a = Thrasher(None, seed=99, n_osds=5, n_mons=3).plan(40)
+        c = Thrasher(None, seed=100, n_osds=5, n_mons=3).plan(40)
+        assert a != c
+
+    def test_schedule_respects_bounds_and_mixes(self):
+        from ceph_tpu.qa.thrasher import Thrasher
+
+        events = Thrasher(None, seed=3, n_osds=5, n_mons=3,
+                          max_dead=1).plan(120)
+        kinds = {e[0] for e in events}
+        # a long schedule exercises every chaos dimension
+        assert {"write", "kill", "revive", "netsplit", "heal",
+                "ec_eio", "mon_churn", "corrupt"} <= kinds
+        dead = set()
+        for ev in events:
+            if ev[0] == "kill":
+                dead.add(ev[1])
+                assert len(dead) <= 1  # max_dead respected
+            elif ev[0] == "revive":
+                dead.discard(ev[1])
+
+    def test_no_duplicate_active_netsplit_pairs(self):
+        # a second netsplit of an already-split pair would double-arm
+        # drop entries and leak them past heal/quiesce
+        from ceph_tpu.qa.thrasher import Thrasher
+
+        for seed in range(20):
+            events = Thrasher(None, seed=seed, n_osds=6, n_mons=3,
+                              max_splits=3).plan(120)
+            active = set()
+            for ev in events:
+                if ev[0] == "netsplit":
+                    pair = (ev[1], ev[2])
+                    assert pair not in active, (seed, pair)
+                    active.add(pair)
+                elif ev[0] == "heal":
+                    active.discard((ev[1], ev[2]))
+
+    def test_payloads_regenerate_with_plan(self):
+        from ceph_tpu.qa.thrasher import Thrasher
+
+        t = Thrasher(None, seed=12, n_osds=4, n_mons=1)
+        t.plan(20)
+        first = dict(t._payloads)
+        t.plan(20)
+        assert t._payloads == first
