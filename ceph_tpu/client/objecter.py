@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import threading
 
+from ..common.lockdep import make_lock
 from ..msg import Dispatcher, Messenger
 from ..msg.messenger import POLICY_LOSSY
 from ..osd.osdmap import object_ps
@@ -33,7 +34,7 @@ class Objecter(Dispatcher):
         self.messenger = Messenger.create(cct, name)
         self.messenger.default_policy = POLICY_LOSSY
         self.messenger.add_dispatcher(self)
-        self._lock = threading.RLock()
+        self._lock = make_lock("objecter::lock")
         self._cond = threading.Condition(self._lock)
         self._tid = 0
         # instance nonce: makes reqids unique across Objecter restarts
@@ -47,9 +48,13 @@ class Objecter(Dispatcher):
         self._relinger_epoch = 0     # newest epoch watches were re-sent at
         self._relingering = False    # single relinger loop at a time
         self._linger_kick = False    # a map arrived while relinging
-        self._linger_lock = threading.Lock()
+        self._linger_lock = make_lock("objecter::linger")
         self._replies: dict[int, MOSDOpReply] = {}
         self._outstanding: set[int] = set()
+        # admission throttle state (reference: Objecter's op budget —
+        # objecter_inflight_ops / objecter_inflight_op_bytes)
+        self._inflight_ops = 0
+        self._inflight_bytes = 0
         self.mc.subscribe_osdmap(callback=self._on_new_map)
 
     def _on_new_map(self, m) -> None:
@@ -230,7 +235,49 @@ class Objecter(Dispatcher):
         return primary, tuple(addr)
 
     # -- ops ---------------------------------------------------------------
-    def op_submit(
+    def op_submit(self, pool_id: int, oid: str, op: str,
+                  data: bytes | None = None, **kw):
+        """Submit; blocks for the reply, retrying across map changes.
+
+        Admission rides the objecter_inflight_ops /
+        objecter_inflight_op_bytes throttle (reference: Objecter's op
+        budget): a full window blocks new logical ops until completions
+        drain it.  An op larger than the whole byte budget is admitted
+        only once the window is empty, rather than deadlocking.
+        """
+        my_bytes = (len(data)
+                    if isinstance(data, (bytes, bytearray, memoryview))
+                    else 0)
+        conf = self.cct.conf if self.cct else None
+        max_ops = int(conf.get("objecter_inflight_ops")) if conf else 0
+        max_bytes = int(conf.get("objecter_inflight_op_bytes")) if conf else 0
+
+        def _admit() -> bool:
+            if max_ops and self._inflight_ops >= max_ops:
+                return False
+            if max_bytes and self._inflight_bytes \
+                    and self._inflight_bytes + my_bytes > max_bytes:
+                return False
+            return True
+
+        with self._lock:
+            if not self._cond.wait_for(_admit,
+                                       timeout=kw.get("timeout", 30.0)):
+                raise ConnectionError(
+                    f"op {op} {oid!r}: inflight throttle full "
+                    f"({self._inflight_ops} ops, "
+                    f"{self._inflight_bytes} bytes)")
+            self._inflight_ops += 1
+            self._inflight_bytes += my_bytes
+        try:
+            return self._op_submit(pool_id, oid, op, data=data, **kw)
+        finally:
+            with self._lock:
+                self._inflight_ops -= 1
+                self._inflight_bytes -= my_bytes
+                self._cond.notify_all()
+
+    def _op_submit(
         self,
         pool_id: int,
         oid: str,
@@ -244,7 +291,7 @@ class Objecter(Dispatcher):
         ignore_overlay: bool = False,
         snapc_seq: int = 0,
     ):
-        """Submit; blocks for the reply, retrying across map changes."""
+        """The retry loop under op_submit's admission throttle."""
         import time as _time
 
         last = None

@@ -512,7 +512,7 @@ class MirrorDaemon:
         while not self._stop.wait(timeout=self.interval):
             try:
                 self.replayer.run_once()
-                self.passes += 1
+                self.passes += 1  # noqa: CL2 — _loop is the only writer; readers poll
                 self.last_error = None
             except Exception as e:  # a flaky pass must not kill the daemon
                 self.last_error = repr(e)

@@ -16,6 +16,8 @@ import struct
 from threading import Thread
 from typing import Callable
 
+from .lockdep import make_lock
+
 Handler = Callable[[dict], object]
 
 
@@ -25,6 +27,7 @@ class AdminSocket:
         self._commands: dict[str, tuple[Handler, str]] = {}
         self._thread: Thread | None = None
         self._sock: socket.socket | None = None
+        self._lock = make_lock("common::admin_socket")
         self.register_command("help", self._help, "list available commands")
 
     # -- registration -----------------------------------------------------
@@ -60,8 +63,11 @@ class AdminSocket:
         self._thread.start()
 
     def stop(self) -> None:
-        if self._sock is not None:
+        # take the socket under the lock (two stop() racers would
+        # double-close), close it after release
+        with self._lock:
             sock, self._sock = self._sock, None
+        if sock is not None:
             sock.close()
         if os.path.exists(self.path):
             try:

@@ -415,6 +415,29 @@ class FailpointRegistry:
 _registry = FailpointRegistry()
 
 
+# The catalogue of every failpoint site the daemons mark — the single
+# list docs/fault_injection.md's name table and the thrasher's arming
+# code are held to.  cephlint CL4 (ceph_tpu/qa/analyzer) statically
+# cross-checks sites <-> this set <-> the docs table, so adding a site
+# without registering + documenting it fails tier-1.
+KNOWN_FAILPOINTS = frozenset({
+    "msgr.frame.send",
+    "msgr.frame.recv",
+    "osd.dispatch",
+    "osd.ec.shard_read",
+    "osd.recovery.push",
+    "osd.recovery.pull",
+    "osd.scrub.start",
+    "osd.scrub.shard",
+    "osd.store.write_before_commit",
+    "osd.store.write_after_commit",
+    "mon.paxos.propose",
+    "mon.paxos.commit",
+    "mon.election.start",
+    "mon.tick",
+})
+
+
 def registry() -> FailpointRegistry:
     return _registry
 

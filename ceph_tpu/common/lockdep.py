@@ -128,6 +128,31 @@ class LockdepLock:
     def __exit__(self, *exc) -> None:
         self.release()
 
+    # Condition protocol — threading.Condition(make_lock(...)) must fully
+    # release a reentrant lock across wait() and restore its recursion
+    # depth after; without these Condition falls back to a non-reentrant
+    # try-acquire probe that misreads a held RLock as un-owned.  The
+    # lockdep held-stack tracks the same save/restore so order edges are
+    # not recorded against a lock the thread no longer holds.
+    def _is_owned(self) -> bool:
+        return self._lock._is_owned()
+
+    def _release_save(self):
+        state = self._lock._release_save()
+        depth = 0
+        if _enabled:
+            stack = _holding()
+            while self.name in stack:
+                stack.remove(self.name)
+                depth += 1
+        return (state, depth)
+
+    def _acquire_restore(self, saved) -> None:
+        state, depth = saved
+        self._lock._acquire_restore(state)
+        if _enabled and depth:
+            _holding().extend([self.name] * depth)
+
 
 def make_lock(name: str) -> LockdepLock:
     return LockdepLock(name)

@@ -81,15 +81,16 @@ def default_options() -> OptionTable:
                    "min replicas to serve I/O (0 = size - size/2)", min=0),
             Option("osd_pool_default_pg_num", int, 32, "PGs per new pool",
                    min=1),
-            Option("osd_heartbeat_interval", float, 1.0,
+            Option("osd_heartbeat_interval", float, 2.0,
                    "seconds between peer pings", min=0.05, runtime=True),
             Option("osd_heartbeat_grace", float, 6.0,
-                   "seconds without a ping reply before reporting a peer",
+                   "seconds without a ping reply before reporting a peer "
+                   "(grace/interval silent pings trigger the report)",
                    min=0.1, runtime=True),
             Option("osd_op_thread_timeout", float, 15.0,
-                   "healthy-worker watchdog grace", min=0.1),
-            Option("osd_op_thread_suicide_timeout", float, 150.0,
-                   "worker suicide grace", min=0.1),
+                   "healthy-worker watchdog grace: ops executing longer "
+                   "than this are logged by the tick loop (reference: "
+                   "HeartbeatMap)", min=0.1, runtime=True),
             Option("osd_max_backfills", int, 1,
                    "concurrent backfills per OSD", min=1, runtime=True),
             Option("osd_recovery_max_active", int, 3,
@@ -100,8 +101,6 @@ def default_options() -> OptionTable:
             Option("osd_op_complaint_time", float, 30.0,
                    "age at which an in-flight op is slow", min=0.0,
                    runtime=True),
-            Option("osd_scrub_chunk_max", int, 25,
-                   "objects per scrub chunk", min=1),
             Option("osd_subop_reply_timeout", float, 10.0,
                    "DEFAULT seconds a primary waits for one shard "
                    "sub-op reply before treating the shard as failed; "
@@ -131,7 +130,6 @@ def default_options() -> OptionTable:
             Option("mon_osd_min_down_reporters", int, 2,
                    "distinct reporters to mark an osd down", min=1,
                    runtime=True),
-            Option("mon_lease", float, 5.0, "paxos lease seconds", min=0.1),
             Option("mon_tick_interval", float, 1.0, "mon tick seconds",
                    min=0.05),
             Option("mon_max_pg_per_osd", int, 250,
@@ -235,10 +233,12 @@ def default_options() -> OptionTable:
                    enum=("none", "zlib", "snappy", "zstd", "lz4")),
             # -- ec / tpu --------------------------------------------------
             Option("ec_kernel", str, "auto",
-                   "encode kernel selection",
-                   enum=("auto", "xla", "pallas", "oracle", "numpy"),
-                   runtime=True),
-            Option("ec_batch_stripes", int, 4096,
-                   "stripes per device launch", min=1, runtime=True),
+                   "encode kernel selection for the default (jax) EC "
+                   "plugin: oracle/numpy swap the backend, xla/pallas "
+                   "force the GF kernel path (process-wide, mirrors "
+                   "CEPH_TPU_EC_KERNEL); auto keeps TPU dispatch. "
+                   "Applied when a pool's codec is first compiled — set "
+                   "it at daemon construction, not injectargs",
+                   enum=("auto", "xla", "pallas", "oracle", "numpy")),
         ]
     )

@@ -573,8 +573,9 @@ class FSClient(Dispatcher):
             for cino in list(self._caps_state):
                 self._flush_caps(cino, release=True)
             out = self._request("mksnap", {"ino": dino, "name": snap})
-            self._snap_floor = max(self._snap_floor,
-                                   int(out.get("snapid", 0)))
+            with self._lock:
+                self._snap_floor = max(self._snap_floor,
+                                       int(out.get("snapid", 0)))
             return out
         parent, name = self._resolve_parent(path)
         return self._request("mkdir", {"parent": parent, "name": name})

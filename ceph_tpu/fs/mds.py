@@ -383,7 +383,7 @@ class MDSDaemon(Dispatcher):
         self._obj_write(
             f"{self._jprefix}{self._seg_seq:08x}.{self._seg_idx:04x}", ev
         )
-        self._seg_idx += 1
+        self._seg_idx += 1  # noqa: CL2 — journal path runs under _lock (dispatch)
 
     def _commit(self, ev: dict) -> None:
         """Journal, apply, then roll the segment if full.  The roll's
@@ -395,7 +395,7 @@ class MDSDaemon(Dispatcher):
         max_ev = self.cct.conf.get("mds_journal_segment_events")
         if self._seg_idx >= max_ev:
             self._seg_idx = 0
-            self._seg_seq += 1
+            self._seg_seq += 1  # noqa: CL2 — journal path runs under _lock (dispatch)
             self._flush()
 
     # -- event application (shared by live ops and replay) ----------------
@@ -415,7 +415,7 @@ class MDSDaemon(Dispatcher):
                 self._dirty.add(inode["ino"])
                 self._dirty_full.add(inode["ino"])  # create the omap obj
             self.backptr[inode["ino"]] = (parent, name)
-            self.next_ino = max(self.next_ino, inode["ino"] + 1)
+            self.next_ino = max(self.next_ino, inode["ino"] + 1)  # noqa: CL2 — _apply runs under _lock or single-threaded replay
             self._mark(parent, name, inode)
         elif kind == "link_remote":  # hardlink: remote stub + nlink SET
             parent, name, ino = ev["parent"], ev["name"], ev["ino"]
@@ -538,7 +538,7 @@ class MDSDaemon(Dispatcher):
                 bp = self.backptr.get(dino)
                 if bp:
                     self._mark(bp[0], bp[1], inode)
-            self.snap_counter = max(self.snap_counter,
+            self.snap_counter = max(self.snap_counter,  # noqa: CL2 — _apply runs under _lock or single-threaded replay
                                     ev["snapid"] & 0xFFFFF)
         elif kind == "rmsnap":
             dino, name = ev["ino"], ev["name"]
@@ -891,7 +891,7 @@ class MDSDaemon(Dispatcher):
 
     def _alloc_ino(self) -> int:
         ino = self.next_ino
-        self.next_ino += 1
+        self.next_ino += 1  # noqa: CL2 — every caller reaches here via _handle, under _lock
         return ino
 
     # -- capabilities (reference: src/mds/Locker.cc issue/revoke flow) -----
@@ -1470,7 +1470,7 @@ class MDSDaemon(Dispatcher):
                         if tino and self._is_under(tino["ino"], dino):
                             return -18, (f"subtree /{top} is on rank "
                                          f"{r}; snapshot there")
-            self.snap_counter += 1
+            self.snap_counter += 1  # noqa: CL2 — _handle runs under _lock (dispatch)
             sid = (self.rank << 20) | self.snap_counter
             # push the realm seq to every cap holder under the dir
             # BEFORE freezing the manifest: keep="" both flushes their
@@ -1568,13 +1568,14 @@ class MDSDaemon(Dispatcher):
 
     def ms_dispatch(self, conn, msg) -> bool:
         if isinstance(msg, MClientSession):
+            # build the ack under the lock, send it after release: the
+            # messenger write blocks on the peer socket (CL1)
+            reply = None
             with self._lock:
                 if msg.op == "request_open":
                     self._sessions.add(msg.client)
                     self._session_conns[msg.client] = conn
-                    conn.send_message(
-                        MClientSession(op="open", client=msg.client)
-                    )
+                    reply = MClientSession(op="open", client=msg.client)
                 elif msg.op == "request_close":
                     self._sessions.discard(msg.client)
                     self._session_conns.pop(msg.client, None)
@@ -1588,9 +1589,9 @@ class MDSDaemon(Dispatcher):
                             self._set_writer(ino, msg.client, False)
                         holders.pop(msg.client, None)
                     self._caps_cond.notify_all()
-                    conn.send_message(
-                        MClientSession(op="close", client=msg.client)
-                    )
+                    reply = MClientSession(op="close", client=msg.client)
+            if reply is not None:
+                conn.send_message(reply)
             return True
         if isinstance(msg, MClientCaps):
             with self._lock:
@@ -1680,23 +1681,22 @@ class MDSDaemon(Dispatcher):
                     if redirect is not None:
                         # NOT cached: after a takeover the same tid must
                         # re-execute here instead of replaying the stale
-                        # redirect
-                        conn.send_message(MClientReply(
-                            tid=msg.tid, retval=-116, result=redirect,
-                        ))
-                        return True
-                    try:
-                        rv, result = self._handle(
-                            msg.op, msg.args or {}, session=sess
-                        )
-                    except Exception as e:  # op bug must not kill the daemon
-                        self.cct.dout(
-                            "mds", 0, f"mds op {msg.op} failed: {e!r}"
-                        )
-                        rv, result = -5, repr(e)  # EIO
-                    cache[msg.tid] = (rv, result)
-                    while len(cache) > 512:
-                        cache.popitem(last=False)
+                        # redirect.  The reply rides the shared send below
+                        # so the socket write happens outside _lock (CL1).
+                        rv, result = -116, redirect
+                    else:
+                        try:
+                            rv, result = self._handle(
+                                msg.op, msg.args or {}, session=sess
+                            )
+                        except Exception as e:  # op bug must not kill the daemon
+                            self.cct.dout(
+                                "mds", 0, f"mds op {msg.op} failed: {e!r}"
+                            )
+                            rv, result = -5, repr(e)  # EIO
+                        cache[msg.tid] = (rv, result)
+                        while len(cache) > 512:
+                            cache.popitem(last=False)
             conn.send_message(
                 MClientReply(tid=msg.tid, retval=rv, result=result)
             )

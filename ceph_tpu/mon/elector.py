@@ -11,6 +11,7 @@ from __future__ import annotations
 import threading
 
 from ..common.failpoint import FailpointCrash, FailpointError, failpoint
+from ..common.lockdep import make_lock
 from .messages import MMonElection
 
 
@@ -26,7 +27,7 @@ class Elector:
         # RE-PROPOSE, never declare victory
         self._deferred = False
         self._timer: threading.Timer | None = None
-        self._lock = threading.RLock()
+        self._lock = make_lock("mon::elector")
 
     def stop(self) -> None:
         with self._lock:

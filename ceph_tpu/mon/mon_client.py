@@ -10,6 +10,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..common.lockdep import make_lock
 from ..msg import Dispatcher, Messenger
 from ..osd.osdmap import OSDMap
 from .messages import (
@@ -30,7 +31,7 @@ class MonClient(Dispatcher):
         self.messenger.add_dispatcher(self)
         self._conn = None
         self._conn_addr: tuple[str, int] | None = None
-        self._lock = threading.RLock()
+        self._lock = make_lock("monc::lock")
         self._cond = threading.Condition(self._lock)
         self._tid = 0
         # random per-process session id: part of the monitor's command
@@ -50,17 +51,27 @@ class MonClient(Dispatcher):
         with self._lock:
             if addr is None and self._conn is not None and self._conn.is_connected:
                 return self._conn
-            last_err = None
             addrs = [addr] if addr else list(self.mon_addrs)
-            for a in addrs:
-                try:
-                    conn = self.messenger.connect(tuple(a))
-                    self._conn, self._conn_addr = conn, tuple(a)
-                    self._renew_sub(conn)
-                    return conn
-                except (OSError, ConnectionError) as e:
-                    last_err = e
-            raise ConnectionError(f"no monitor reachable: {last_err}")
+        # the dial + subscription renewal run OUTSIDE monc::lock: the
+        # messenger dispatches incoming frames while holding
+        # msgr::session and ms_dispatch then takes monc::lock, so
+        # calling into the messenger with monc::lock held is the ABBA
+        # inversion lockdep (rightly) aborts.  Concurrent dials are
+        # harmless — last one wins the cache and the rest stay usable.
+        last_err = None
+        for a in addrs:
+            try:
+                conn = self.messenger.connect(tuple(a))
+                # a mon that dies between accept and this send must
+                # fail over to the next address like a refused dial
+                self._renew_sub(conn)
+            except (OSError, ConnectionError) as e:
+                last_err = e
+                continue
+            with self._lock:
+                self._conn, self._conn_addr = conn, tuple(a)
+            return conn
+        raise ConnectionError(f"no monitor reachable: {last_err}")
 
     def _renew_sub(self, conn) -> None:
         """(Re-)arm the osdmap subscription on a connection; idempotent on
@@ -81,12 +92,10 @@ class MonClient(Dispatcher):
         pushes leaves an idle subscriber on a stale map forever unless
         something re-hunts — daemons call this from their tick loop.
         Never blocks: the hunt runs on a helper thread (a full-quorum
-        dial can eat whole connect timeouts under the client lock, and
-        the caller's tick loop drives heartbeats that must keep their
-        cadence), rate-limited after failures.  The state check itself
-        is a TRY-acquire — an in-flight hunt holds the client lock for
-        the whole dial, and waiting on it here would reintroduce the
-        very stall the helper thread exists to avoid."""
+        dial can eat whole connect timeouts, and the caller's tick loop
+        drives heartbeats that must keep their cadence), rate-limited
+        after failures.  The state check itself is a TRY-acquire so a
+        busy client op can never stall the tick loop here."""
         if not self._lock.acquire(blocking=False):
             return  # a hunt (or another client op) is busy; next tick
         try:

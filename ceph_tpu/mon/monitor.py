@@ -17,6 +17,7 @@ import threading
 import time
 
 from ..common.failpoint import FailpointCrash, failpoint
+from ..common.lockdep import make_lock
 from ..msg import Dispatcher, Messenger, MPing
 from ..msg.messenger import POLICY_LOSSLESS_PEER
 from ..osd.osdmap import OSDMap
@@ -107,12 +108,12 @@ class Monitor(Dispatcher):
         self.osdmon = OSDMonitor(self, initial_osdmap)
         # conn -> next osdmap epoch wanted
         self._subs: dict[object, int] = {}
-        self._subs_lock = threading.Lock()
+        self._subs_lock = make_lock("mon::subs")
         # (client, session, tid) -> completed command result, so a retried
         # command (ack lost / slow proposal) is answered, not re-executed
         self._cmd_results: dict[tuple, tuple[int, object]] = {}
         self._cmd_inflight: set[tuple] = set()
-        self._cmd_lock = threading.Lock()
+        self._cmd_lock = make_lock("mon::cmd")
         # All cross-connection sends go through sender threads.  Paxos
         # and elector handlers run on connection reader threads (holding
         # that connection's session lock) and take subsystem locks; if
@@ -125,10 +126,10 @@ class Monitor(Dispatcher):
         # livelocking quorum formation (advisor r1 finding).
         self._sendqs: dict[object, "queue.Queue"] = {}
         self._send_threads: list[threading.Thread] = []
-        self._sendq_lock = threading.Lock()
+        self._sendq_lock = make_lock("mon::sendq")
         # serializes election-outcome state writes against shutdown's
         # reset: win/lose_election (reader threads) vs shutdown
-        self._state_lock = threading.Lock()
+        self._state_lock = make_lock("mon::state")
         self._tick_thread: threading.Thread | None = None
         self._stop_event = threading.Event()
 

@@ -924,6 +924,13 @@ class OSDMonitor:
                     if not (1 <= ms <= size):
                         return -22, f"min_size {ms} out of [1, size={size}]"
                     extra["min_size"] = ms
+                else:
+                    # osd_pool_default_min_size: 0 keeps the derived
+                    # size - size//2 quorum (PGPool.__post_init__)
+                    dms = int(self.mon.cct.conf.get(
+                        "osd_pool_default_min_size"))
+                    if dms:
+                        extra["min_size"] = max(1, min(dms, size))
             except (TypeError, ValueError):
                 return -22, "integer min_size required"
             pool = m.create_pool(

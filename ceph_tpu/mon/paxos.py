@@ -22,6 +22,7 @@ import json
 import threading
 
 from ..common.failpoint import FailpointCrash, FailpointError, failpoint
+from ..common.lockdep import make_lock
 from ..store.kv import Batch
 from .messages import MMonPaxos
 
@@ -65,7 +66,7 @@ class Paxos:
         self.store = store
         self.last_committed = int(store.get(_K_LAST) or b"0")
         self.accepted_pn = int(store.get(_K_PN) or b"0")
-        self._lock = threading.RLock()
+        self._lock = make_lock("mon::paxos")
         self._cond = threading.Condition(self._lock)
         # leader state
         self.pn = 0
@@ -212,14 +213,14 @@ class Paxos:
                     if not ok:
                         return False
                     if best is not None and best[1] == self.last_committed + 1:
-                        if not self._begin_round(best[2], timeout):
+                        if not self._begin_round_locked(best[2], timeout):
                             return False
-                return self._begin_round(value, timeout)
+                return self._begin_round_locked(value, timeout)
             finally:
                 self._proposing = False
                 self._cond.notify_all()
 
-    def _begin_round(self, value: str, timeout: float) -> bool:
+    def _begin_round_locked(self, value: str, timeout: float) -> bool:
         """One begin→accept-majority→commit round.  Caller holds _lock and
         the _proposing slot."""
         version = self.last_committed + 1

@@ -65,6 +65,7 @@ from ..auth.cephx import (
     validate_ticket,
 )
 from ..common.crc32c import crc32c
+from ..common.lockdep import make_lock
 from ..common.failpoint import (
     FailpointCrash,
     FailpointError,
@@ -112,7 +113,7 @@ class _Session:
 
     def __init__(self):
         self.in_seq = 0
-        self.lock = threading.RLock()
+        self.lock = make_lock("msgr::session")
         # poison-message tracking: seq of the last message whose dispatch
         # raised, and how many delivery attempts it has burned
         self.fail_seq = -1
@@ -316,7 +317,7 @@ class Messenger:
         self._conns_by_name: dict[str, Connection] = {}
         # (peer_name, connect_id) -> _Session surviving reconnects
         self._sessions: dict[tuple[str, int], _Session] = {}
-        self._lock = threading.RLock()
+        self._lock = make_lock("msgr::messenger")
         self._stopped = False
         # cephx-style mutual auth (reference: ProtocolV2 auth frames);
         # engine built lazily from config so tests can flip it per-context
@@ -431,8 +432,12 @@ class Messenger:
 
     def shutdown(self) -> None:
         self._stopped = True
-        if self._listener is not None:
+        # take the listener under the lock (two shutdown() racers would
+        # double-close), tear it down after release
+        with self._lock:
             listener, self._listener = self._listener, None
+            conns = list(self._conns.values())
+        if listener is not None:
             try:
                 listener.shutdown(socket.SHUT_RDWR)
             except OSError:
@@ -441,8 +446,6 @@ class Messenger:
                 listener.close()
             except OSError:
                 pass
-        with self._lock:
-            conns = list(self._conns.values())
         for c in conns:
             c.mark_down()
 
@@ -777,7 +780,8 @@ class Messenger:
                         # dispatched nor acked (the thrasher's netsplit
                         # primitive) — recovery, not replay, heals the gap
                         continue
-                with conn._session.lock:
+                sess = conn._session
+                with sess.lock:
                     if conn._closed or sock is not conn.sock:
                         # socket was replaced/closed while we were blocked:
                         # this frame belongs to the dead incarnation
@@ -787,37 +791,59 @@ class Messenger:
                         continue
                     if not conn.peer_name:
                         conn.peer_name = msg.src
-                    # dispatch BEFORE advancing in_seq / acking: if the
-                    # dispatcher raises, the sender must keep its replay
-                    # entry (an early ack would prune it and lose the
-                    # message despite the lossless contract — advisor r1).
-                    # But a DETERMINISTICALLY-failing handler must not
-                    # reconnect-livelock the peer pair: after
-                    # _POISON_RETRIES failed deliveries of the same seq the
-                    # message is dropped-and-acked with a loud log.
-                    sess = conn._session
-                    try:
-                        self._dispatch(conn, msg)
-                    except Exception:
+                # dispatch OUTSIDE the session lock (reference: the
+                # DispatchQueue decoupling — fast_dispatch never holds
+                # connection locks): dispatchers take their own locks
+                # (monc::lock, osd::pg, ...) and daemon code sends —
+                # which takes session locks — while holding those, so an
+                # upcall under msgr::session is one half of an ABBA
+                # inversion lockdep aborts on.  This rx thread is the
+                # connection's only reader, so delivery order is
+                # untouched.  Dispatch BEFORE advancing in_seq / acking:
+                # if the dispatcher raises, the sender must keep its
+                # replay entry (an early ack would prune it and lose the
+                # message despite the lossless contract — advisor r1).
+                # A reconnect racing the dispatch replays the frame on
+                # the next incarnation (in_seq unadvanced) — duplicate
+                # delivery, the same at-least-once edge crash-replay
+                # already forces handlers to absorb via reqid dup
+                # caches.  And a DETERMINISTICALLY-failing handler must
+                # not reconnect-livelock the peer pair: after
+                # _POISON_RETRIES failed deliveries of the same seq the
+                # message is dropped-and-acked with a loud log.
+                try:
+                    self._dispatch(conn, msg)
+                except Exception:
+                    # the session outlives socket incarnations, so a
+                    # replaced socket's rx thread can race this one on
+                    # the poison counters — count under the lock
+                    with sess.lock:
                         if sess.fail_seq == msg.seq:
                             sess.fail_count += 1
                         else:
                             sess.fail_seq, sess.fail_count = msg.seq, 1
-                        # Only an INCOMING conn earns a redelivery by dying:
-                        # its dialer holds the unacked frame in _replay and
-                        # resends on reconnect.  An outgoing conn receives
-                        # replies; the acceptor side drops its replay when
-                        # the socket dies, so killing the conn here would
-                        # just blackhole the link (reviewer r2) — drop the
-                        # message loudly and let protocol retries recover.
-                        if not conn.outgoing and sess.fail_count < _POISON_RETRIES:
-                            raise  # kill conn; dialer redelivers on reconnect
-                        self._dout(
-                            0,
-                            f"dropping poison message seq={msg.seq} "
-                            f"({type(msg).__name__}) after "
-                            f"{sess.fail_count} failed dispatch(es)",
-                        )
+                        fail_count = sess.fail_count
+                    # Only an INCOMING conn earns a redelivery by dying:
+                    # its dialer holds the unacked frame in _replay and
+                    # resends on reconnect.  An outgoing conn receives
+                    # replies; the acceptor side drops its replay when
+                    # the socket dies, so killing the conn here would
+                    # just blackhole the link (reviewer r2) — drop the
+                    # message loudly and let protocol retries recover.
+                    if not conn.outgoing and fail_count < _POISON_RETRIES:
+                        raise  # kill conn; dialer redelivers on reconnect
+                    self._dout(
+                        0,
+                        f"dropping poison message seq={msg.seq} "
+                        f"({type(msg).__name__}) after "
+                        f"{fail_count} failed dispatch(es)",
+                    )
+                with sess.lock:
+                    if conn._closed or sock is not conn.sock:
+                        # the socket died mid-dispatch: leave in_seq
+                        # unadvanced so the replacement incarnation's
+                        # replay re-delivers (at-least-once, see above)
+                        return
                     conn.in_seq = msg.seq
                     if conn.policy == POLICY_LOSSLESS_PEER:
                         conn._send_ack(msg.seq)

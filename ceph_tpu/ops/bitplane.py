@@ -79,6 +79,23 @@ def apply_matrix_xla(mat: np.ndarray, chunks) -> jnp.ndarray:
 # retried (and re-fail) on every subsequent op in the process.
 _pallas_broken: Exception | None = None
 
+# Config-surface override (the `ec_kernel` option): process-wide like the
+# env knob it mirrors — kernel dispatch is per-process, not per-daemon,
+# so the last daemon to boot with an explicit setting wins.
+_kernel_override: str | None = None
+
+
+def set_kernel_override(mode: str | None) -> None:
+    """Force the GF kernel path from config ('xla'/'pallas'; None/'auto'
+    clears).  Takes precedence over CEPH_TPU_EC_KERNEL."""
+    global _kernel_override
+    _kernel_override = None if mode in (None, "auto") else mode
+
+
+def _forced_pallas() -> bool:
+    return (_kernel_override or os.environ.get("CEPH_TPU_EC_KERNEL")) \
+        == "pallas"
+
 
 def _want_pallas() -> bool:
     """Kernel dispatch policy (round-4 verdict item #3: the production
@@ -87,8 +104,10 @@ def _want_pallas() -> bool:
     CEPH_TPU_EC_KERNEL: "pallas" / "xla" force a path; default "auto"
     picks the fused kernel on TPU backends ('axon' is this box's
     tunneled-TPU alias) and the XLA gather-free bitplane path elsewhere.
+    The `ec_kernel` config option sets the same switch programmatically
+    (set_kernel_override) and wins over the env var.
     """
-    mode = os.environ.get("CEPH_TPU_EC_KERNEL", "auto")
+    mode = _kernel_override or os.environ.get("CEPH_TPU_EC_KERNEL", "auto")
     if mode == "pallas":
         return True
     if mode == "xla":
@@ -114,7 +133,7 @@ def apply_matrix_jax(mat: np.ndarray, chunks) -> jnp.ndarray:
     if _want_pallas():
         from .pallas_gf import apply_matrix_pallas
 
-        forced = os.environ.get("CEPH_TPU_EC_KERNEL") == "pallas"
+        forced = _forced_pallas()
         try:
             return apply_matrix_pallas(
                 mat, chunks, interpret=jax.default_backend() == "cpu"

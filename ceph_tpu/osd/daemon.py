@@ -164,7 +164,7 @@ class OSD(
         self.osdmap: OSDMap | None = None
         self.pgs: dict[str, PGState] = {}
         self._pgs_lock = make_lock("osd::pgs")
-        self._lock = threading.RLock()
+        self._lock = make_lock("osd::daemon")
         self._cond = threading.Condition(self._lock)
         self._sub_replies: dict[int, dict] = {}   # tid -> reply fields
         self._tid = 0
@@ -184,13 +184,20 @@ class OSD(
             "background_scrub": QoSParams(weight=1.0, limit=50.0),
         })
         self._workers: list[threading.Thread] = []
+        # op-thread watchdog (reference: HeartbeatMap / osd_op_thread_
+        # timeout): _run_op stamps ident -> [name, class, start,
+        # last_warn]; the tick loop complains about entries older than
+        # the grace.  Keyed by thread ident, not name — concurrent
+        # client ops share the "-op" thread name
+        self._worker_busy: dict[int, list] = {}
+        self._worker_busy_lock = make_lock("osd::op_watchdog")
         self._recovery_inflight = False
         self._split_inflight = False
         self._clone_mutex = make_lock("osd::snap_clone")
         # watch/notify state (reference: PrimaryLogPG watchers): primary-
         # local; clients re-register lingering watches on map change
         self.watchers: dict[tuple, dict[int, str]] = {}
-        self._watch_lock = threading.Lock()
+        self._watch_lock = make_lock("osd::watch")
         self._client_conns: dict[str, object] = {}
         self._watch_cond = threading.Condition()
         self._notify_acks: dict[tuple[int, int], bool] = {}
@@ -301,13 +308,35 @@ class OSD(
             else:
                 # background work runs inline: worker count bounds its
                 # concurrency, which is the point of the QoS classes
-                self._run_op(work)
+                self._run_op(work, cls)
 
-    def _run_op(self, work) -> None:
+    def _run_op(self, work, cls: str = "client") -> None:
+        th = threading.current_thread()
+        now = time.monotonic()
+        with self._worker_busy_lock:
+            self._worker_busy[th.ident] = [th.name, cls, now, now]
         try:
             work()
         except Exception as e:
             self.cct.dout("osd", 0, f"{self.whoami} op failed: {e!r}")
+        finally:
+            with self._worker_busy_lock:
+                self._worker_busy.pop(th.ident, None)
+
+    def _check_op_workers(self, now: float) -> None:
+        """Complain about workers stuck past osd_op_thread_timeout
+        (reference: HeartbeatMap::is_healthy's 'had timed out' log)."""
+        grace = float(self.cct.conf.get("osd_op_thread_timeout"))
+        with self._worker_busy_lock:
+            entries = [e for e in self._worker_busy.values()
+                       if now - e[2] >= grace and now - e[3] >= grace]
+            for e in entries:
+                e[3] = now
+        for tname, cls, start, _ in entries:
+            self.cct.dout(
+                "osd", 0,
+                f"{self.whoami} worker {tname} ({cls}) stuck for "
+                f"{now - start:.1f}s (osd_op_thread_timeout {grace:.0f}s)")
 
     def shutdown(self, umount: bool = True) -> None:
         """umount=False is the thrasher's CRASH kill: threads stop but
@@ -417,6 +446,15 @@ class OSD(
         if codec is None:
             profile = dict(self.osdmap.ec_profiles.get(name) or {})
             profile.setdefault("plugin", "jax")
+            # ec_kernel: 'oracle'/'numpy' swap the whole backend for the
+            # default plugin; 'xla'/'pallas' pick the GF kernel inside
+            # the jax backend (process-wide, mirrors CEPH_TPU_EC_KERNEL)
+            kern = str(self.cct.conf.get("ec_kernel"))
+            if kern in ("oracle", "numpy") and profile["plugin"] == "jax":
+                profile["plugin"] = kern
+            elif kern in ("xla", "pallas"):
+                from ..ops.bitplane import set_kernel_override
+                set_kernel_override(kern)
             codec = ErasureCodePluginRegistry.instance().factory(profile)
             self._codecs[name] = codec
         return codec
@@ -689,9 +727,12 @@ class OSD(
                 return
             now = time.monotonic()
             try:
-                if now - last_hb >= 2.0:
+                hb_interval = float(
+                    self.cct.conf.get("osd_heartbeat_interval"))
+                if now - last_hb >= hb_interval:
                     last_hb = now
                     self._heartbeat()
+                self._check_op_workers(now)
                 # keep the mon subscription alive: a crashed mon would
                 # otherwise leave this OSD on a stale map forever (the
                 # push-based subscription has no other liveness probe);

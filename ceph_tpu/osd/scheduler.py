@@ -25,6 +25,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..common.lockdep import make_lock
+
 
 @dataclass(frozen=True)
 class QoSParams:
@@ -53,7 +55,7 @@ class MClockScheduler:
         }
         self._clock = clock
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("osd::mclock")
         self._cond = threading.Condition(self._lock)
         self._stopped = False
 

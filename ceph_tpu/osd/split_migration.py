@@ -294,10 +294,14 @@ class SplitMigrationMixin:
 
     def _heartbeat(self) -> None:
         """Ping peers sharing PGs with us (reference: OSD::heartbeat);
-        after 3 silent intervals report the peer to the mon (§5.3)."""
+        after osd_heartbeat_grace seconds of silence (grace/interval
+        intervals) report the peer to the mon (§5.3)."""
         m = self.osdmap
         if m is None:
             return
+        interval = float(self.cct.conf.get("osd_heartbeat_interval"))
+        grace = float(self.cct.conf.get("osd_heartbeat_grace"))
+        silent_limit = max(1, round(grace / max(interval, 1e-9)))
         peers: set[int] = set()
         with self._pgs_lock:
             pgs = list(self.pgs.values())
@@ -318,9 +322,9 @@ class SplitMigrationMixin:
                 self._hb_failures[osd] = prev + 1
             except (OSError, ConnectionError):
                 self._hb_failures[osd] = prev + 1
-            if self._hb_failures.get(osd, 0) >= 3:
-                self.mc.report_failure(osd, failed_for=6.0)
-                # restart the count: re-report only after another 3 silent
-                # intervals, not on every subsequent tick
+            if self._hb_failures.get(osd, 0) >= silent_limit:
+                self.mc.report_failure(osd, failed_for=grace)
+                # restart the count: re-report only after another full
+                # grace of silent intervals, not on every subsequent tick
                 self._hb_failures.pop(osd, None)
 

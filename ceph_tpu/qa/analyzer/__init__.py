@@ -1,0 +1,28 @@
+"""cephlint — whole-package static analyzer for the framework's four
+chronic hygiene hazards (reference: Ceph wires lockdep + clang-analyzer/
+cppcheck into make check; this is the AST-level equivalent for the
+Python port):
+
+    CL1  lock discipline (order inversions, blocking under a lock,
+         lockdep-invisible raw locks)
+    CL2  unlocked read-modify-writes on shared state
+    CL3  JAX tracing hygiene in ops/, crush/, parallel/, bench/
+    CL4  failpoint site / catalogue / docs drift
+    CL5  config-option read / declaration drift
+
+Run it::
+
+    python -m ceph_tpu.qa.analyzer ceph_tpu/ [--format=text|json]
+
+Suppress a single finding with ``# noqa: CL#`` on its line; pin a
+by-design finding in qa/analyzer/baseline.toml with a mandatory reason.
+docs/static_analysis.md is the operator guide; tests/test_analyzer.py
+is the tier-1 gate that keeps the package clean.
+"""
+from .core import (BaselineError, Config, Finding, Report, collect_modules,
+                   format_baseline, parse_baseline, render, run)
+
+__all__ = [
+    "BaselineError", "Config", "Finding", "Report", "collect_modules",
+    "format_baseline", "parse_baseline", "render", "run",
+]

@@ -1,0 +1,72 @@
+"""CLI: python -m ceph_tpu.qa.analyzer [paths] [--format=text|json] ...
+
+Exit status: 0 clean, 1 findings, 2 usage/parse errors — the same
+contract as the tier-1 gate in tests/test_analyzer.py.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .core import BaselineError, Config, format_baseline, render, run
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ceph_tpu.qa.analyzer",
+        description="cephlint: CL1 lock discipline, CL2 shared-state "
+                    "races, CL3 JAX tracing hygiene, CL4 failpoint "
+                    "drift, CL5 option drift")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to scan (default: the "
+                         "ceph_tpu package this analyzer ships in)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--checks", default=None, metavar="CL1,CL2,...",
+                    help="comma-separated subset of checks to run")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="baseline file (default: auto-discovered "
+                         "qa/analyzer/baseline.toml)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report baselined findings too")
+    ap.add_argument("--write-baseline", default=None, metavar="FILE",
+                    help="write the active findings as a pinned baseline "
+                         "(edit each reason before committing!)")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [str(Path(__file__).resolve().parents[2])]
+    cfg = Config.discover(paths)
+    if args.baseline is not None:
+        cfg.baseline_file = Path(args.baseline)
+    if args.no_baseline:
+        cfg.use_baseline = False
+    if args.checks:
+        checks = tuple(c.strip().upper() for c in args.checks.split(","))
+        bad = [c for c in checks if c not in cfg.checks]
+        if bad:
+            ap.error(f"unknown check(s) {', '.join(bad)}")
+        cfg.checks = checks
+
+    try:
+        report = run(cfg)
+    except BaselineError as e:
+        print(f"cephlint: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        Path(args.write_baseline).write_text(format_baseline(
+            report.findings, reason="FIXME: justify or fix"))
+        print(f"cephlint: wrote {len(report.findings)} entries to "
+              f"{args.write_baseline}")
+        return 0
+
+    out = render(report, args.format)
+    if out:
+        print(out)
+    # stale baseline entries fail here too — the same contract as the
+    # tier-1 gate, which asserts the baseline only ever shrinks
+    return 0 if report.clean and not report.stale_baseline else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,329 @@
+"""CL1 — lock discipline.
+
+Three sub-checks over the whole package:
+
+1. **Order inversions** (`lock-cycle:<A>-><B>`): the static lock-order
+   graph is derived from lexical ``with <lock>:`` nesting plus
+   one-level-resolved calls (``self.m()`` and ``self.<typed attr>.m()``)
+   made while a lock is held — every lock the callee transitively
+   acquires is ordered after every lock held at the call site.  Any
+   strongly-connected component in that graph is the ABBA shape
+   common/lockdep.py would catch at runtime, reported at analysis time.
+
+2. **Blocking under a lock** (`<fn>:blocking:<call>:<lock>`): a lexical
+   call to a known-blocking primitive (time.sleep, socket
+   send/recv/accept/dial, messenger send_message, store
+   queue_transaction) inside a ``with <lock>:`` body.  Condition
+   .wait/.wait_for are deliberately NOT in the set — they release their
+   lock.  Sites that hold a lock by design (e.g. the messenger's
+   one-session-lock send path) carry a baseline entry with the
+   justification.
+
+3. **Raw locks in concurrency-heavy dirs** (`raw-lock:<attr>`): a bare
+   threading.Lock()/RLock() in osd/, mon/, msg/, store/, client/ is
+   invisible to lockdep's runtime cycle detection; use
+   common.lockdep.make_lock("subsys::purpose").
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .core import Config, Finding, ModuleInfo
+from .symbols import ClassInfo, SymbolTable, attr_chain
+
+# call-name patterns considered blocking.  time.sleep is matched with its
+# receiver (bare ``sleep`` alone could be anything); the rest by attr name.
+_BLOCKING_ATTRS = {
+    "sendall": "socket send",
+    "recv": "socket recv",
+    "accept": "socket accept",
+    "create_connection": "socket dial",
+    "send_message": "messenger send",
+    "queue_transaction": "store commit",
+}
+
+
+@dataclass
+class _FnInfo:
+    qual: str
+    cls: ClassInfo | None
+    mod: ModuleInfo
+    node: ast.FunctionDef
+    direct_acquires: set[str] = field(default_factory=set)
+    callees: set[str] = field(default_factory=set)
+    # (held_locks_tuple, callee_qual, line)
+    calls_while_held: list[tuple[tuple[str, ...], str, int]] = field(default_factory=list)
+    # (held_locks_tuple, blocking_label, call_repr, line)
+    blocking: list[tuple[tuple[str, ...], str, str, int]] = field(default_factory=list)
+    edges: list[tuple[str, str, int]] = field(default_factory=list)
+
+
+def check(mods: list[ModuleInfo], sym: SymbolTable, cfg: Config) -> list[Finding]:
+    prime_class_cache(sym)
+    fns: dict[str, _FnInfo] = {}
+    for mod in mods:
+        for cls, fn in _iter_functions(mod):
+            qual = (f"{mod.modname}.{cls.name}.{fn.name}" if cls
+                    else f"{mod.modname}.{fn.name}")
+            info = _FnInfo(qual=qual, cls=cls, mod=mod, node=fn)
+            _Walker(info, sym).visit_body(fn.body)
+            fns[qual] = info
+
+    # method-name -> quals (for self.m() resolution within a family, and
+    # typed-attr resolution across families)
+    trans = _transitive_acquires(fns, sym)
+
+    edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+
+    def add_edge(a: str, b: str, path: str, line: int, why: str) -> None:
+        if a != b:
+            edges.setdefault((a, b), (path, line, why))
+
+    for info in fns.values():
+        for a, b, line in info.edges:
+            add_edge(a, b, info.mod.rel, line, f"with-nesting in {info.qual}")
+        for held, callee, line in info.calls_while_held:
+            for acq in trans.get(callee, ()):  # transitive callee acquires
+                for h in held:
+                    add_edge(h, acq, info.mod.rel, line,
+                             f"{info.qual} calls {callee} holding {h}")
+
+    findings: list[Finding] = []
+    for scc in _sccs({a for a, _ in edges} | {b for _, b in edges},
+                     edges.keys()):
+        if len(scc) < 2:
+            continue
+        cyc = sorted(scc)
+        for (a, b), (path, line, why) in sorted(edges.items()):
+            if a in scc and b in scc:
+                findings.append(Finding(
+                    "CL1", path, line, f"lock-cycle:{a}->{b}",
+                    f"lock-order inversion: {a} -> {b} closes a cycle "
+                    f"through {{{', '.join(cyc)}}} ({why})"))
+
+    for info in fns.values():
+        for held, label, rep, line in info.blocking:
+            findings.append(Finding(
+                "CL1", info.mod.rel, line,
+                f"{_short(info.qual)}:blocking:{rep}:{held[-1]}",
+                f"blocking call {rep} ({label}) while holding "
+                f"lock(s) {', '.join(held)}"))
+
+    raw_dirs = set(cfg.cl1_raw_lock_dirs)
+    for cls in sym.classes.values():
+        top = cls.path.split("/", 1)[0] if "/" in cls.path else ""
+        if top not in raw_dirs:
+            continue
+        for attr, li in cls.lock_attrs.items():
+            if li.kind in ("lock", "rlock"):
+                findings.append(Finding(
+                    "CL1", cls.path, li.line, f"raw-lock:{cls.name}.{attr}",
+                    f"raw threading.{'RLock' if li.kind == 'rlock' else 'Lock'}"
+                    f" {cls.name}.{attr} is invisible to lockdep; use "
+                    f"common.lockdep.make_lock(...)"))
+    return findings
+
+
+def _short(qual: str) -> str:
+    return qual.rsplit(".", 2)[-1] if qual.count(".") < 2 else \
+        ".".join(qual.rsplit(".", 2)[-2:])
+
+
+def _iter_functions(mod: ModuleInfo):
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.FunctionDef):
+            yield None, stmt
+        elif isinstance(stmt, ast.ClassDef):
+            for sub in stmt.body:
+                if isinstance(sub, ast.FunctionDef):
+                    # the symbol table holds the canonical ClassInfo
+                    yield _lookup_class(mod, stmt.name), sub
+
+
+_class_cache: dict = {}
+
+
+def _lookup_class(mod: ModuleInfo, name: str):
+    return _class_cache.get((mod.modname, name))
+
+
+class _Walker:
+    """Lexical walk of one function body tracking the held-lock stack."""
+
+    def __init__(self, info: _FnInfo, sym: SymbolTable):
+        self.info = info
+        self.sym = sym
+        self.held: list[str] = []
+
+    def visit_body(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self.visit_stmt(stmt)
+
+    def visit_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.With):
+            self._with(stmt)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs execute later, not under this lock scope
+        for node in ast.iter_child_nodes(stmt):
+            self.visit_node(node)
+
+    def visit_node(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Call):
+            self._call(node)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.stmt):
+            self.visit_stmt(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            self.visit_node(child)
+
+    def _with(self, stmt: ast.With) -> None:
+        pushed = 0
+        for item in stmt.items:
+            li = self.sym.resolve_lock(item.context_expr, self.info.cls,
+                                       self.info.mod.modname)
+            if li is None:
+                continue
+            self.info.direct_acquires.add(li.name)
+            for h in self.held:
+                if h != li.name:
+                    self.info.edges.append((h, li.name, stmt.lineno))
+            self.held.append(li.name)
+            pushed += 1
+        for item in stmt.items:
+            # still scan the with-expressions themselves for calls
+            self.visit_node(item.context_expr)
+        self.visit_body(stmt.body)
+        for _ in range(pushed):
+            self.held.pop()
+
+    def _call(self, node: ast.Call) -> None:
+        for child in ast.iter_child_nodes(node):
+            self.visit_node(child)
+        if not self.held:
+            self._record_callee(node, record_edges=False)
+            return
+        held = tuple(self.held)
+        # blocking primitives
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "sleep" and isinstance(f.value, ast.Name) \
+                    and f.value.id == "time":
+                self.info.blocking.append((held, "sleep", "time.sleep",
+                                           node.lineno))
+            elif f.attr in _BLOCKING_ATTRS:
+                self.info.blocking.append(
+                    (held, _BLOCKING_ATTRS[f.attr], f.attr, node.lineno))
+        self._record_callee(node, record_edges=True, held=held)
+
+    def _record_callee(self, node: ast.Call, record_edges: bool,
+                       held: tuple[str, ...] = ()) -> None:
+        quals = self._callee_quals(node)
+        for q in quals:
+            self.info.callees.add(q)
+            if record_edges:
+                self.info.calls_while_held.append((held, q, node.lineno))
+
+    def _callee_quals(self, node: ast.Call) -> list[str]:
+        f = node.func
+        cls = self.info.cls
+        sym = self.sym
+        # self.m(...)
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if f.value.id == "self" and cls is not None:
+                m = sym.family_methods(cls).get(f.attr)
+                if m:
+                    owner, _fn = m
+                    return [f"{owner.module}.{owner.name}.{f.attr}"]
+                return []
+            # bare module function imported or local: NAME(...)
+        if isinstance(f, ast.Name):
+            return [f"{self.info.mod.modname}.{f.id}"]
+        # self.ATTR.m(...) via the instance-attribute type map
+        ch = attr_chain(f)
+        if ch and ch[0] == "self" and len(ch[1]) == 2 and cls is not None:
+            a, m = ch[1]
+            t = sym.family_attr_types(cls).get(a)
+            if t:
+                targets = sym.class_by_name.get(t, [])
+                if len(targets) == 1 and m in targets[0].methods:
+                    tc = targets[0]
+                    return [f"{tc.module}.{tc.name}.{m}"]
+        return []
+
+
+def _transitive_acquires(fns: dict[str, _FnInfo],
+                         sym: SymbolTable) -> dict[str, set[str]]:
+    acq = {q: set(i.direct_acquires) for q, i in fns.items()}
+    changed = True
+    while changed:
+        changed = False
+        for q, info in fns.items():
+            for callee in info.callees:
+                extra = acq.get(callee)
+                if extra and not extra <= acq[q]:
+                    acq[q] |= extra
+                    changed = True
+    return acq
+
+
+def _sccs(nodes: set[str], edge_keys) -> list[set[str]]:
+    """Tarjan's strongly-connected components, iterative."""
+    out: dict[str, list[str]] = {n: [] for n in nodes}
+    for a, b in edge_keys:
+        out[a].append(b)
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on: set[str] = set()
+    stack: list[str] = []
+    sccs: list[set[str]] = []
+    counter = [0]
+
+    for root in nodes:
+        if root in index:
+            continue
+        work = [(root, iter(out[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(out[w])))
+                    advanced = True
+                    break
+                if w in on:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = set()
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.add(w)
+                    if w == v:
+                        break
+                sccs.append(comp)
+    return sccs
+
+
+def prime_class_cache(sym: SymbolTable) -> None:
+    _class_cache.clear()
+    for ci in sym.classes.values():
+        _class_cache[(ci.module, ci.name)] = ci

@@ -1,0 +1,142 @@
+"""CL2 — shared-state read-modify-write races.
+
+The `comp_frames_sent` class of bug (ADVICE.md r1): ``self.counter += 1``
+compiles to a read, an add, and a write — two threads interleaving them
+lose increments.  For every class whose *family* (the class plus its
+mixins/bases) is multi-threaded — it spawns threads or owns locks — any
+read-modify-write of a plain ``self.<attr>`` outside a lexical
+``with <lock>:`` region is reported:
+
+- augmented assignment: ``self.x += 1``, ``self.x |= mask`` ...
+- self-referential assignment: ``self.x = self.x + 1``,
+  ``old, self.x = self.x, None`` (swap idiom included: the read and the
+  write are still two distinct interpreter steps).
+
+``__init__``/``__new__`` run before the object is shared and are exempt,
+and so are methods named ``*_locked`` — the Ceph convention asserting
+"caller holds the lock" (paxos ``_begin_round_locked``, elector
+``_declare_victory_locked``); lockdep's runtime half still catches a
+caller that breaks that contract.  Other methods only ever called with
+the lock already held carry a ``# noqa: CL2`` with a one-line
+justification, or a baseline entry.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Config, Finding, ModuleInfo
+from .symbols import ClassInfo, SymbolTable
+
+_EXEMPT_METHODS = {"__init__", "__new__", "__init_subclass__"}
+
+
+def check(mods: list[ModuleInfo], sym: SymbolTable, cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    by_key = {(c.module, c.name): c for c in sym.classes.values()}
+    for mod in mods:
+        for stmt in mod.tree.body:
+            if not isinstance(stmt, ast.ClassDef):
+                continue
+            cls = by_key.get((mod.modname, stmt.name))
+            if cls is None or not sym.family_threaded(cls):
+                continue
+            for fn in stmt.body:
+                if not isinstance(fn, ast.FunctionDef) \
+                        or fn.name in _EXEMPT_METHODS \
+                        or fn.name.endswith("_locked"):
+                    continue
+                w = _Walker(mod, cls, fn.name, sym)
+                w.visit_body(fn.body)
+                findings.extend(w.findings)
+    return findings
+
+
+def _is_self_attr(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _reads_self_attr(expr: ast.expr, attr: str) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr == attr \
+                and isinstance(node.value, ast.Name) and node.value.id == "self" \
+                and isinstance(node.ctx, ast.Load):
+            return True
+    return False
+
+
+class _Walker:
+    def __init__(self, mod: ModuleInfo, cls: ClassInfo, fn_name: str,
+                 sym: SymbolTable):
+        self.mod = mod
+        self.cls = cls
+        self.fn = fn_name
+        self.sym = sym
+        self.lock_depth = 0
+        self.findings: list[Finding] = []
+        self._locks = sym.family_locks(cls)
+
+    def visit_body(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self.visit_stmt(stmt)
+
+    def visit_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.With):
+            guards = sum(
+                1 for item in stmt.items
+                if self._is_lock_guard(item.context_expr)
+            )
+            self.lock_depth += guards
+            self.visit_body(stmt.body)
+            self.lock_depth -= guards
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs run elsewhere (threads, callbacks)
+        if self.lock_depth == 0:
+            self._inspect(stmt)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self.visit_stmt(child)
+            elif isinstance(child, ast.ExceptHandler):
+                self.visit_body(child.body)
+
+    def _is_lock_guard(self, expr: ast.expr) -> bool:
+        li = self.sym.resolve_lock(expr, self.cls, self.mod.modname)
+        if li is not None:
+            return True
+        # an unresolved but lock-looking context still guards (e.g. a local
+        # alias like ``with lock:`` or ``with q.mutex:``) — CL2 errs quiet
+        tail = None
+        n = expr
+        while isinstance(n, ast.Attribute):
+            tail = n.attr
+            break
+        if isinstance(n, ast.Name):
+            tail = n.id
+        return bool(tail) and any(s in tail.lower()
+                                  for s in ("lock", "cond", "mutex"))
+
+    def _inspect(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.AugAssign):
+            attr = _is_self_attr(stmt.target)
+            if attr and attr not in self._locks:
+                self._report(stmt, attr, "augmented assignment")
+        elif isinstance(stmt, ast.Assign):
+            targets: list[ast.expr] = []
+            for t in stmt.targets:
+                targets.extend(t.elts if isinstance(t, ast.Tuple) else [t])
+            for t in targets:
+                attr = _is_self_attr(t)
+                if attr and attr not in self._locks \
+                        and _reads_self_attr(stmt.value, attr):
+                    self._report(stmt, attr, "read-modify-write")
+
+    def _report(self, stmt: ast.stmt, attr: str, what: str) -> None:
+        self.findings.append(Finding(
+            "CL2", self.mod.rel, stmt.lineno,
+            f"{self.cls.name}.{self.fn}:{attr}",
+            f"unlocked {what} of self.{attr} in multi-threaded class "
+            f"{self.cls.name} (lost-update race); guard with a family lock "
+            f"or justify with # noqa: CL2"))

@@ -1,0 +1,359 @@
+"""CL3 — JAX tracing hygiene in the accelerator dirs (ops/, crush/,
+parallel/, bench/).
+
+Functions that run under a trace — ``@jax.jit`` / ``@partial(jax.jit,
+static_argnames=...)`` decorated defs, defs wrapped by a same-module
+``jax.jit(fn)`` call, and kernels handed to ``pl.pallas_call`` — see
+abstract tracers, not values.  Five host-side habits silently break or
+degrade them, and every one has already bitten a TPU numerics stack
+(PERF.md r1: the int64 leak; "Accelerating XOR-based Erasure Coding..."
+shows the kernel win disappearing under host-side regressions):
+
+- ``branch``: a Python ``if``/``while`` on a tracer-derived value —
+  ConcretizationTypeError at trace time, or worse, a silently
+  specialized constant.  Use jnp.where / lax.cond / lax.select.
+- ``coerce``: ``bool()/int()/float()`` or ``.item()/.tolist()`` on a
+  tracer — forces a device sync at best, trace error at worst.
+- ``numpy``: ``np.*`` calls fed a tracer fall back to host numpy
+  (ConcretizationTypeError or a silent device->host copy);
+  use jnp.* inside traced code.
+- ``promote``: explicitly casting the two sides of one arithmetic op to
+  int32 vs uint32 — the promotion result flips with jax_enable_x64 and
+  the CRUSH/GF hot paths depend on exact 32-bit wrap semantics.
+- ``shape-loop``: a Python ``for`` over ``range(x.shape[i])`` /
+  ``range(len(x))`` unrolls at trace time and recompiles per shape;
+  hot paths want lax.fori_loop / lax.scan (a deliberate small unroll
+  carries a ``# noqa: CL3`` with the bound).
+
+Taint is tracked conservatively from the non-static parameters through
+simple assignments; ``.shape``/``.dtype``/``.ndim``/``len()`` launder a
+value back to static, so ``n = x.shape[0]; for i in range(n)`` is still
+(only) a shape-loop, never a branch finding.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Config, Finding, ModuleInfo
+from .symbols import SymbolTable, attr_chain, call_name
+
+_JIT_NAMES = {"jit"}
+_PALLAS_CALL = "pallas_call"
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "itemsize"}
+_COERCERS = {"bool", "int", "float", "complex"}
+_ITEM_METHODS = {"item", "tolist", "__bool__", "__float__", "__int__"}
+_NUMPY_RECEIVERS = {"np", "numpy", "onp"}
+_I32_CASTS = {"int32"}
+_U32_CASTS = {"uint32"}
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """jax.jit / jit (bare reference, not a call)."""
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_NAMES
+    return False
+
+
+def _static_names_from_call(call: ast.Call) -> set[str]:
+    out: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.add(e.value)
+    return out
+
+
+def _jit_decoration(fn: ast.FunctionDef) -> tuple[bool, set[str]]:
+    """(is traced, static arg names) from the decorator list."""
+    for dec in fn.decorator_list:
+        if _is_jit_expr(dec):
+            return True, set()
+        if isinstance(dec, ast.Call):
+            # @jax.jit(...) applied directly, or @partial(jax.jit, ...)
+            if _is_jit_expr(dec.func):
+                return True, _static_names_from_call(dec)
+            if call_name(dec) == "partial" and dec.args \
+                    and _is_jit_expr(dec.args[0]):
+                return True, _static_names_from_call(dec)
+    return False, set()
+
+
+def _collect_traced(mod: ModuleInfo) -> list[tuple[ast.FunctionDef, set[str], str]]:
+    """All (fn, static_names, why) functions in this module that run under
+    a trace: decorated, jit-wrapped by name, or passed to pl.pallas_call."""
+    defs: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.FunctionDef):
+            defs.setdefault(node.name, node)
+    out: list[tuple[ast.FunctionDef, set[str], str]] = []
+    claimed: set[str] = set()
+    for name, fn in defs.items():
+        jitted, static = _jit_decoration(fn)
+        if jitted:
+            out.append((fn, static, "jit"))
+            claimed.add(name)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cn = call_name(node)
+        if cn in _JIT_NAMES and _is_jit_expr(node.func) and node.args \
+                and isinstance(node.args[0], ast.Name):
+            tgt = node.args[0].id
+            if tgt in defs and tgt not in claimed:
+                out.append((defs[tgt], _static_names_from_call(node), "jit"))
+                claimed.add(tgt)
+        elif cn == _PALLAS_CALL and node.args \
+                and isinstance(node.args[0], ast.Name):
+            tgt = node.args[0].id
+            if tgt in defs and tgt not in claimed:
+                out.append((defs[tgt], set(), "pallas"))
+                claimed.add(tgt)
+    return out
+
+
+def check(mods: list[ModuleInfo], sym: SymbolTable, cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    dirs = set(cfg.cl3_dirs)
+    for mod in mods:
+        if mod.topdir() not in dirs:
+            continue
+        for fn, static, why in _collect_traced(mod):
+            v = _TraceVisitor(mod, fn, static, why)
+            v.run()
+            findings.extend(v.findings)
+    return findings
+
+
+class _TraceVisitor:
+    def __init__(self, mod: ModuleInfo, fn: ast.FunctionDef,
+                 static: set[str], why: str):
+        self.mod = mod
+        self.fn = fn
+        self.why = why
+        args = fn.args
+        names = [a.arg for a in
+                 args.posonlyargs + args.args + args.kwonlyargs]
+        for extra in (args.vararg, args.kwarg):
+            if extra is not None:
+                names.append(extra.arg)
+        self.tainted: set[str] = {
+            n for i, n in enumerate(names)
+            if n not in static and str(i) not in static
+            and n not in ("self", "cls")
+        }
+        self.findings: list[Finding] = []
+        self._seen_idents: set[str] = set()
+
+    # -- taint ------------------------------------------------------------
+    def _traced(self, expr: ast.expr) -> bool:
+        """Does this expression carry a tracer?  .shape/.dtype/len() and
+        friends launder back to static."""
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _STATIC_ATTRS:
+                return False
+            return self._traced(expr.value)
+        if isinstance(expr, ast.Name):
+            return expr.id in self.tainted
+        if isinstance(expr, ast.Call):
+            cn = call_name(expr)
+            if cn == "len" or cn == "range":
+                return any(self._traced(a) for a in expr.args)
+            # a call is traced if any argument (or a traced receiver) is
+            recv_traced = False
+            if isinstance(expr.func, ast.Attribute):
+                recv_traced = self._traced(expr.func.value)
+            return recv_traced or any(self._traced(a) for a in expr.args) \
+                or any(self._traced(kw.value) for kw in expr.keywords)
+        if isinstance(expr, ast.Subscript):
+            return self._traced(expr.value)
+        if isinstance(expr, (ast.BinOp,)):
+            return self._traced(expr.left) or self._traced(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self._traced(expr.operand)
+        if isinstance(expr, ast.BoolOp):
+            return any(self._traced(v) for v in expr.values)
+        if isinstance(expr, ast.Compare):
+            return self._traced(expr.left) \
+                or any(self._traced(c) for c in expr.comparators)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self._traced(e) for e in expr.elts)
+        if isinstance(expr, ast.IfExp):
+            return any(self._traced(e)
+                       for e in (expr.test, expr.body, expr.orelse))
+        if isinstance(expr, ast.Starred):
+            return self._traced(expr.value)
+        return False
+
+    def _taint_target(self, t: ast.expr) -> None:
+        if isinstance(t, ast.Name):
+            self.tainted.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._taint_target(e)
+        elif isinstance(t, ast.Starred):
+            self._taint_target(t.value)
+
+    # -- walk -------------------------------------------------------------
+    def run(self) -> None:
+        self._visit_body(self.fn.body)
+
+    def _visit_body(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self._visit_stmt(stmt)
+
+    def _visit_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            if self._traced(stmt.value):
+                for t in stmt.targets:
+                    self._taint_target(t)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None and self._traced(stmt.value):
+                self._taint_target(stmt.target)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            if self._traced(stmt.test) and not self._none_test(stmt.test):
+                kw = "while" if isinstance(stmt, ast.While) else "if"
+                self._report(stmt.test, "branch",
+                             f"Python {kw} on a tracer-derived value "
+                             f"(use jnp.where / lax.cond / lax.select)")
+        elif isinstance(stmt, ast.For):
+            self._check_for(stmt)
+        elif isinstance(stmt, ast.Assert):
+            # assert on a tracer concretizes exactly like `if`
+            if self._traced(stmt.test):
+                self._report(stmt.test, "branch",
+                             "assert on a tracer-derived value "
+                             "(use checkify or move the check host-side)")
+        for node in ast.iter_child_nodes(stmt):
+            self._visit_node(node)
+
+    def _visit_node(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Call):
+            self._check_call(node)
+        if isinstance(node, ast.BinOp):
+            self._check_promotion(node)
+        if isinstance(node, ast.stmt):
+            self._visit_stmt(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit_node(child)
+
+    @staticmethod
+    def _none_test(test: ast.expr) -> bool:
+        """`x is None` / `x is not None` style tests are static dispatch
+        on an optional argument, not a tracer branch."""
+        if isinstance(test, ast.Compare) \
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in test.ops):
+            return True
+        return False
+
+    # -- the five hazards --------------------------------------------------
+    def _check_for(self, stmt: ast.For) -> None:
+        it = stmt.iter
+        if self._traced(it):
+            self._report(it, "branch",
+                         "Python for over a tracer (iterating a traced "
+                         "array concretizes it; use lax.scan/fori_loop)")
+            return
+        # range(x.shape[0]) / range(len(x)): static, but unrolled —
+        # recompiles per shape and bloats the HLO on big axes
+        if isinstance(it, ast.Call) and call_name(it) == "range":
+            for a in it.args:
+                if self._shape_derived(a):
+                    self._report(
+                        it, "shape-loop",
+                        "Python loop over a shape-derived range unrolls "
+                        "at trace time and recompiles per shape (use "
+                        "lax.fori_loop/scan, or # noqa: CL3 a deliberate "
+                        "small unroll)")
+                    return
+
+    def _shape_derived(self, expr: ast.expr) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Attribute) and node.attr == "shape" \
+                    and self._mentions_tainted(node.value):
+                return True
+            if isinstance(node, ast.Call) and call_name(node) == "len" \
+                    and node.args and self._mentions_tainted(node.args[0]):
+                return True
+        return False
+
+    def _mentions_tainted(self, expr: ast.expr) -> bool:
+        return any(isinstance(n, ast.Name) and n.id in self.tainted
+                   for n in ast.walk(expr))
+
+    def _check_call(self, node: ast.Call) -> None:
+        f = node.func
+        cn = call_name(node)
+        # bool(x)/int(x)/float(x) on a tracer
+        if isinstance(f, ast.Name) and cn in _COERCERS and node.args \
+                and self._traced(node.args[0]):
+            self._report(node, "coerce",
+                         f"{cn}() concretizes a tracer (host sync / "
+                         f"ConcretizationTypeError)")
+        # x.item() / x.tolist()
+        if isinstance(f, ast.Attribute) and f.attr in _ITEM_METHODS \
+                and self._traced(f.value):
+            self._report(node, "coerce",
+                         f".{f.attr}() concretizes a tracer (host sync / "
+                         f"ConcretizationTypeError)")
+        # np.foo(tracer)
+        if isinstance(f, ast.Attribute):
+            ch = attr_chain(f)
+            if ch and ch[0] in _NUMPY_RECEIVERS and (
+                    any(self._traced(a) for a in node.args)
+                    or any(self._traced(kw.value) for kw in node.keywords)):
+                self._report(node, "numpy",
+                             f"host numpy call {ch[0]}.{f.attr}(...) on a "
+                             f"tracer (use jnp.{f.attr} inside traced code)")
+
+    def _check_promotion(self, node: ast.BinOp) -> None:
+        ls, rs = self._cast_sign(node.left), self._cast_sign(node.right)
+        if ls and rs and ls != rs:
+            self._report(
+                node, "promote",
+                "mixing explicit int32 and uint32 casts in one arithmetic "
+                "op — the promoted dtype flips with jax_enable_x64 and "
+                "breaks 32-bit wrap semantics in the CRUSH/GF hot path")
+
+    @staticmethod
+    def _cast_sign(expr: ast.expr) -> str | None:
+        """'i32' / 'u32' when the expression is an explicit 32-bit int
+        cast: jnp.int32(x), x.astype(jnp.uint32), np.uint32(x)."""
+        if not isinstance(expr, ast.Call):
+            return None
+        f = expr.func
+        name = None
+        if isinstance(f, ast.Attribute) and f.attr == "astype" and expr.args:
+            a = expr.args[0]
+            ach = attr_chain(a)
+            if ach and ach[1]:
+                name = ach[1][-1]
+            elif isinstance(a, ast.Constant) and isinstance(a.value, str):
+                name = a.value
+        else:
+            cn = call_name(expr)
+            if cn in _I32_CASTS | _U32_CASTS:
+                name = cn
+        if name in _I32_CASTS:
+            return "i32"
+        if name in _U32_CASTS:
+            return "u32"
+        return None
+
+    def _report(self, node: ast.AST, kind: str, msg: str) -> None:
+        ident = f"{self.fn.name}:{kind}"
+        n = 2
+        while ident in self._seen_idents:
+            ident = f"{self.fn.name}:{kind}:{n}"
+            n += 1
+        self._seen_idents.add(ident)
+        self.findings.append(Finding(
+            "CL3", self.mod.rel, getattr(node, "lineno", self.fn.lineno),
+            ident,
+            f"[{self.why}:{self.fn.name}] {msg}"))

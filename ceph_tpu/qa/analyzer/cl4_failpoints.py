@@ -1,0 +1,135 @@
+"""CL4 — failpoint drift.
+
+Three sources of truth must agree on the set of failpoint names:
+
+1. **sites** — ``failpoint("name", ...)`` / ``self._fp_hit("name")`` /
+   ``registry().hit|configured("name")`` markers in daemon code;
+2. **the registry catalogue** — ``KNOWN_FAILPOINTS`` in
+   common/failpoint.py (what `failpoint list`/the thrasher may arm);
+3. **the operator docs** — the name table in docs/fault_injection.md.
+
+Drift shapes reported (idents are the failpoint name, so baseline
+entries survive renumbering):
+
+- ``site:<name>``  a site literal missing from KNOWN_FAILPOINTS —
+  unreachable through validation, invisible to `failpoint list`;
+- ``doc:<name>``   a site literal missing from the docs table — the
+  operator can't discover it;
+- ``orphan-known:<name>``  catalogued but no site marks it — arming it
+  silently does nothing (the drift that rots fault-injection suites);
+- ``orphan-doc:<name>``    documented but no site — docs promise an
+  injection point that does not exist;
+- ``arm:<name>``   a ``registry().set/add("name", ...)`` literal naming
+  an uncatalogued failpoint (a typo'd arm never fires).
+
+Both the catalogue and the docs table are read statically (AST / table
+parse) so the analyzer works on fixture trees without importing them.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Config, Finding, ModuleInfo, rel_of
+from .symbols import SymbolTable
+
+# | `msgr.frame.send` | ... — the docs catalogue is the first backticked
+# cell of each table row
+_DOC_ROW_RE = re.compile(r"^\|\s*`([A-Za-z0-9_.\-]+)`\s*\|")
+
+
+def parse_known_failpoints(path) -> tuple[set[str], int]:
+    """KNOWN_FAILPOINTS literal (set/frozenset/tuple/list/dict of string
+    constants) from common/failpoint.py, plus its line for findings."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "KNOWN_FAILPOINTS"
+                   for t in targets):
+            continue
+        if isinstance(value, ast.Call):  # frozenset({...})
+            value = value.args[0] if value.args else value
+        elts: list[ast.expr] = []
+        if isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+            elts = value.elts
+        elif isinstance(value, ast.Dict):
+            elts = [k for k in value.keys if k is not None]
+        names = {e.value for e in elts
+                 if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+        return names, node.lineno
+    return set(), 0
+
+
+def parse_doc_names(path) -> set[str]:
+    names: set[str] = set()
+    for line in path.read_text().splitlines():
+        m = _DOC_ROW_RE.match(line.strip())
+        if m and "." in m.group(1):  # name cells, not header/option cells
+            names.add(m.group(1))
+    return names
+
+
+def check(mods: list[ModuleInfo], sym: SymbolTable, cfg: Config) -> list[Finding]:
+    if cfg.failpoint_file is None:
+        return []
+    known, known_line = parse_known_failpoints(cfg.failpoint_file)
+    docs = (parse_doc_names(cfg.docs_fault_injection)
+            if cfg.docs_fault_injection else None)
+    fp_rel = rel_of(cfg, cfg.failpoint_file)
+    doc_rel = (rel_of(cfg, cfg.docs_fault_injection)
+               if cfg.docs_fault_injection else "")
+
+    findings: list[Finding] = []
+    site_names: dict[str, tuple[str, int]] = {}
+    arm_names: dict[str, tuple[str, int]] = {}
+    for s in sym.failpoint_sites:
+        d = site_names if s.kind == "site" else arm_names
+        d.setdefault(s.name, (s.path, s.line))
+
+    for name, (path, line) in sorted(site_names.items()):
+        if name not in known:
+            findings.append(Finding(
+                "CL4", path, line, f"site:{name}",
+                f"failpoint site {name!r} is not catalogued in "
+                f"KNOWN_FAILPOINTS (common/failpoint.py)"))
+        if docs is not None and name not in docs:
+            findings.append(Finding(
+                "CL4", path, line, f"doc:{name}",
+                f"failpoint site {name!r} is missing from the "
+                f"docs/fault_injection.md name table"))
+
+    for name in sorted(known):
+        if name not in site_names:
+            findings.append(Finding(
+                "CL4", fp_rel, known_line, f"orphan-known:{name}",
+                f"KNOWN_FAILPOINTS entry {name!r} has no failpoint site "
+                f"— arming it does nothing"))
+        if docs is not None and name not in docs:
+            findings.append(Finding(
+                "CL4", fp_rel, known_line, f"undoc-known:{name}",
+                f"KNOWN_FAILPOINTS entry {name!r} is missing from the "
+                f"docs/fault_injection.md name table"))
+
+    if docs is not None:
+        for name in sorted(docs):
+            if name not in site_names and name not in known:
+                findings.append(Finding(
+                    "CL4", doc_rel, 1, f"orphan-doc:{name}",
+                    f"documented failpoint {name!r} has neither a site "
+                    f"nor a KNOWN_FAILPOINTS entry"))
+
+    for name, (path, line) in sorted(arm_names.items()):
+        if name not in known:
+            findings.append(Finding(
+                "CL4", path, line, f"arm:{name}",
+                f"arming uncatalogued failpoint {name!r} — a typo here "
+                f"never fires"))
+    return findings

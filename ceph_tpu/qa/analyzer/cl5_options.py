@@ -1,0 +1,80 @@
+"""CL5 — config-option drift.
+
+The option table (common/options.py, ``Option("name", ...)`` entries) and
+the code that reads it (``<conf>.get("name")`` / ``get_expanded`` /
+``conf["name"]``) must agree:
+
+- ``read:<name>``    a literal read of an undeclared option — Config.get
+  raises ConfigError at runtime, but only on the code path that reads it
+  (exactly how dead tunables ship);
+- ``unread:<name>``  a declared option nothing in the package reads —
+  operators set it, nothing happens (the `osd_debug_*` rot shape the
+  failpoint migration cleaned up).
+
+Dynamically composed reads (``conf.get(f"debug_{subsys}")``) are handled
+by prefix: any f-string/startswith prefix ending in ``_`` seen anywhere
+in the package marks every declared option with that prefix as read.
+Options that exist for operators/tests rather than package-internal
+readers carry a baseline entry saying so.
+
+The declaration list is parsed from the options file's AST, so fixture
+trees analyze without being imported.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Config, Finding, ModuleInfo, rel_of
+from .symbols import SymbolTable
+
+
+def parse_declared_options(path) -> dict[str, int]:
+    """name -> declaration line for every Option("name", ...) literal."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    out: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "Option" and node.args:
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                out.setdefault(a0.value, node.lineno)
+    return out
+
+
+def check(mods: list[ModuleInfo], sym: SymbolTable, cfg: Config) -> list[Finding]:
+    if cfg.options_file is None:
+        return []
+    declared = parse_declared_options(cfg.options_file)
+    opt_rel = rel_of(cfg, cfg.options_file)
+
+    findings: list[Finding] = []
+    read_names: set[str] = set()
+    for r in sym.option_reads:
+        read_names.add(r.name)
+        if r.name not in declared:
+            findings.append(Finding(
+                "CL5", r.path, r.line, f"read:{r.name}",
+                f"config read of undeclared option {r.name!r} — "
+                f"Config.get will raise ConfigError on this path; "
+                f"declare it in common/options.py"))
+
+    # a declared option also counts as read when any OTHER module mentions
+    # its name as a bare string constant in a non-read position (command
+    # tables, legacy-option maps, observer name lists); the declaration
+    # file itself obviously mentions every name and proves nothing
+    mentioned: set[str] = set()
+    for rel, lits in sym.string_literals.items():
+        if rel == opt_rel:
+            continue
+        mentioned |= lits & declared.keys()
+
+    for name, line in sorted(declared.items()):
+        if name in read_names or name in mentioned:
+            continue
+        if any(name.startswith(p) for p in sym.fstring_prefixes):
+            continue  # dynamically composed read (f"debug_{subsys}")
+        findings.append(Finding(
+            "CL5", opt_rel, line, f"unread:{name}",
+            f"option {name!r} is declared but nothing in the package "
+            f"reads it — remove it or wire it up"))
+    return findings

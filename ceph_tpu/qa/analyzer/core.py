@@ -1,0 +1,334 @@
+"""cephlint core — findings, suppressions, baseline, and the runner.
+
+The analyzer is the static half of the hygiene story whose runtime half
+is common/lockdep.py + common/failpoint.py (reference: Ceph wires
+lockdep + clang-analyzer/cppcheck into make check; src/script/run-make.sh
+and the smatch/cov scripts).  Five whole-package checks:
+
+    CL1  lock discipline: static lock-order graph, order inversions,
+         blocking calls made while a lock is held, raw (lockdep-invisible)
+         locks in the concurrency-heavy subsystems
+    CL2  shared-state races: read-modify-writes on self attributes of
+         multi-threaded classes outside any lock
+    CL3  JAX tracing hygiene in ops/, crush/, parallel/, bench/
+    CL4  failpoint drift: sites vs KNOWN_FAILPOINTS vs the docs catalogue
+    CL5  config-option drift: reads vs common/options.py declarations
+
+Suppression layers, innermost first:
+
+    # noqa: CL1            on the finding line (flake8-style; bare
+                           ``# noqa`` suppresses every check)
+    baseline.toml          pinned (code, path, ident) entries, each with a
+                           mandatory human justification line
+
+Findings carry a line-independent ``ident`` so baseline entries survive
+unrelated edits; the line number is for humans.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+@dataclass(frozen=True)
+class Finding:
+    code: str      # "CL1".."CL5"
+    path: str      # posix path as scanned (relative when possible)
+    line: int
+    ident: str     # stable key within (code, path); baseline match key
+    message: str
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.code, self.path, self.ident)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}  [{self.ident}]"
+
+    def to_json(self) -> dict:
+        return {
+            "code": self.code,
+            "path": self.path,
+            "line": self.line,
+            "ident": self.ident,
+            "message": self.message,
+        }
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file."""
+
+    path: Path              # as given (used for display)
+    rel: str                # posix path relative to its scan root
+    modname: str            # dotted module path relative to the scan root
+    tree: ast.Module
+    lines: list[str]
+
+    def topdir(self) -> str:
+        """First path component under the scan root ('' for top level)."""
+        return self.rel.split("/", 1)[0] if "/" in self.rel else ""
+
+
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*))?",
+                      re.IGNORECASE)
+
+
+def noqa_codes(line: str) -> set[str] | None:
+    """None = no noqa on this line; empty set = bare noqa (suppress all);
+    otherwise the set of codes listed."""
+    m = _NOQA_RE.search(line)
+    if not m:
+        return None
+    codes = m.group("codes")
+    if not codes:
+        return set()
+    return {c.strip().upper() for c in codes.split(",")}
+
+
+def suppressed_by_noqa(f: Finding, mod: ModuleInfo) -> bool:
+    if not (1 <= f.line <= len(mod.lines)):
+        return False
+    codes = noqa_codes(mod.lines[f.line - 1])
+    if codes is None:
+        return False
+    return not codes or f.code in codes
+
+
+# -- baseline (restricted TOML: [[suppress]] blocks of string keys) --------
+# Python 3.10 has no tomllib and the container must not grow deps, so the
+# baseline sticks to a subset a 30-line parser reads exactly: comment
+# lines, ``[[suppress]]`` headers, and ``key = "value"`` string pairs.
+
+class BaselineError(ValueError):
+    pass
+
+
+_KV_RE = re.compile(r'^([A-Za-z_][A-Za-z0-9_]*)\s*=\s*"((?:[^"\\]|\\.)*)"\s*(?:#.*)?$')
+
+
+def parse_baseline(text: str, where: str = "baseline.toml") -> list[dict]:
+    entries: list[dict] = []
+    cur: dict | None = None
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[suppress]]":
+            cur = {}
+            entries.append(cur)
+            continue
+        m = _KV_RE.match(line)
+        if not m:
+            raise BaselineError(f"{where}:{lineno}: expected [[suppress]] or "
+                                f'key = "value", got {line!r}')
+        if cur is None:
+            raise BaselineError(f"{where}:{lineno}: key outside [[suppress]]")
+        cur[m.group(1)] = m.group(2).replace('\\"', '"').replace("\\\\", "\\")
+    for i, e in enumerate(entries, 1):
+        for k in ("code", "path", "ident", "reason"):
+            if not e.get(k):
+                raise BaselineError(
+                    f"{where}: entry {i} missing {k!r} (a justification "
+                    f"'reason' is mandatory)")
+    return entries
+
+
+def _toml_quote(s: str) -> str:
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def format_baseline(findings: list[Finding], reason: str) -> str:
+    out = ["# cephlint pinned baseline — regenerate with --write-baseline,",
+           "# then EDIT each entry's reason into a real justification.", ""]
+    for f in sorted(findings, key=lambda f: (f.code, f.path, f.ident)):
+        out += ["[[suppress]]",
+                f"code = {_toml_quote(f.code)}",
+                f"path = {_toml_quote(f.path)}",
+                f"ident = {_toml_quote(f.ident)}",
+                f"reason = {_toml_quote(reason)}",
+                ""]
+    return "\n".join(out)
+
+
+# -- configuration ----------------------------------------------------------
+@dataclass
+class Config:
+    roots: list[Path]
+    package_dir: Path | None = None
+    docs_fault_injection: Path | None = None
+    options_file: Path | None = None
+    failpoint_file: Path | None = None
+    baseline_file: Path | None = None
+    use_baseline: bool = True
+    checks: tuple[str, ...] = ("CL1", "CL2", "CL3", "CL4", "CL5")
+    cl3_dirs: tuple[str, ...] = ("ops", "crush", "parallel", "bench")
+    cl1_raw_lock_dirs: tuple[str, ...] = ("osd", "mon", "msg", "store", "client")
+
+    @classmethod
+    def discover(cls, roots: list[str | Path]) -> "Config":
+        """Fill source-of-truth paths from the first scanned directory:
+        <pkg>/common/options.py, <pkg>/common/failpoint.py,
+        <repo>/docs/fault_injection.md, <pkg>/qa/analyzer/baseline.toml."""
+        paths = [Path(r) for r in roots]
+        cfg = cls(roots=paths)
+        pkg = next((p for p in paths
+                    if p.is_dir() and (p / "__init__.py").exists()), None)
+        if pkg is None and paths and paths[0].is_dir():
+            pkg = paths[0]
+        if pkg is None:
+            return cfg
+        cfg.package_dir = pkg
+        opt = pkg / "common" / "options.py"
+        fp = pkg / "common" / "failpoint.py"
+        docs = pkg.resolve().parent / "docs" / "fault_injection.md"
+        base = pkg / "qa" / "analyzer" / "baseline.toml"
+        cfg.options_file = opt if opt.exists() else None
+        cfg.failpoint_file = fp if fp.exists() else None
+        cfg.docs_fault_injection = docs if docs.exists() else None
+        cfg.baseline_file = base if base.exists() else None
+        return cfg
+
+
+def rel_of(cfg: Config, path) -> str:
+    """Scan-root-relative posix path for findings on source-of-truth
+    files (options/failpoint/docs), so baseline entries stay portable
+    across checkout locations.  Files outside every root (the docs live
+    beside, not under, the package) relativize against the package's
+    parent — the repo root in the shipped layout."""
+    roots = list(cfg.roots)
+    if cfg.package_dir is not None:
+        roots.append(cfg.package_dir.resolve().parent)
+    for root in roots:
+        try:
+            return path.resolve().relative_to(
+                root.resolve() if root.is_dir() else root.parent.resolve()
+            ).as_posix()
+        except ValueError:
+            continue
+    return path.name
+
+
+def collect_modules(cfg: Config) -> list[ModuleInfo]:
+    mods: list[ModuleInfo] = []
+    seen: set[Path] = set()
+    for root in cfg.roots:
+        if root.is_file():
+            files = [(root, root.parent)]
+        else:
+            files = [(p, root) for p in sorted(root.rglob("*.py"))]
+        for path, base in files:
+            ap = path.resolve()
+            if ap in seen:
+                continue
+            seen.add(ap)
+            try:
+                src = path.read_text()
+                tree = ast.parse(src, filename=str(path))
+            except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                # an unparsable file is itself a finding-worthy event, but
+                # the tier-1 gate wants determinism — surface it loudly
+                raise BaselineError(f"cannot parse {path}: {e}") from e
+            try:
+                rel = path.resolve().relative_to(base.resolve()).as_posix()
+            except ValueError:
+                rel = path.name
+            modname = rel[:-3].replace("/", ".")
+            if modname.endswith(".__init__"):
+                modname = modname[: -len(".__init__")]
+            mods.append(ModuleInfo(path=path, rel=rel, modname=modname,
+                                   tree=tree, lines=src.splitlines()))
+    return mods
+
+
+@dataclass
+class Report:
+    findings: list[Finding]          # active (not noqa'd, not baselined)
+    baselined: list[Finding] = field(default_factory=list)
+    noqa: list[Finding] = field(default_factory=list)
+    stale_baseline: list[dict] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> dict:
+        return {
+            "findings": [f.to_json() for f in self.findings],
+            "baselined": [f.to_json() for f in self.baselined],
+            "noqa": [f.to_json() for f in self.noqa],
+            "stale_baseline": self.stale_baseline,
+            "clean": self.clean,
+        }
+
+    def render_text(self) -> str:
+        out = [f.render() for f in self.findings]
+        if self.stale_baseline:
+            out.append("")
+            for e in self.stale_baseline:
+                out.append(f"warning: stale baseline entry "
+                           f"{e['code']} {e['path']} [{e['ident']}]")
+        counts: dict[str, int] = {}
+        for f in self.findings:
+            counts[f.code] = counts.get(f.code, 0) + 1
+        summary = ", ".join(f"{c}: {n}" for c, n in sorted(counts.items()))
+        out.append(
+            f"cephlint: {len(self.findings)} finding(s)"
+            + (f" ({summary})" if summary else "")
+            + f", {len(self.baselined)} baselined, {len(self.noqa)} noqa'd")
+        return "\n".join(out)
+
+
+def run(cfg: Config) -> Report:
+    from .symbols import SymbolTable
+    from . import cl1_locks, cl2_races, cl3_tracing, cl4_failpoints, cl5_options
+
+    mods = collect_modules(cfg)
+    sym = SymbolTable.build(mods)
+    checkers = {
+        "CL1": cl1_locks.check,
+        "CL2": cl2_races.check,
+        "CL3": cl3_tracing.check,
+        "CL4": cl4_failpoints.check,
+        "CL5": cl5_options.check,
+    }
+    raw: list[Finding] = []
+    for code in cfg.checks:
+        raw.extend(checkers[code](mods, sym, cfg))
+    raw.sort(key=lambda f: (f.path, f.line, f.code, f.ident))
+    # de-dup identical (key, line) findings from overlapping walks
+    uniq: dict[tuple, Finding] = {}
+    for f in raw:
+        uniq.setdefault((f.key(), f.line), f)
+    raw = list(uniq.values())
+
+    by_rel = {m.rel: m for m in mods}
+    baseline = []
+    if cfg.use_baseline and cfg.baseline_file and cfg.baseline_file.exists():
+        baseline = parse_baseline(cfg.baseline_file.read_text(),
+                                  str(cfg.baseline_file))
+    base_keys = {(e["code"], e["path"], e["ident"]): e for e in baseline}
+
+    report = Report(findings=[])
+    hit_base: set[tuple] = set()
+    for f in raw:
+        mod = by_rel.get(f.path)
+        if mod is not None and suppressed_by_noqa(f, mod):
+            report.noqa.append(f)
+            continue
+        if f.key() in base_keys:
+            hit_base.add(f.key())
+            report.baselined.append(f)
+            continue
+        report.findings.append(f)
+    report.stale_baseline = [e for k, e in base_keys.items()
+                             if k not in hit_base]
+    return report
+
+
+def render(report: Report, fmt: str = "text") -> str:
+    if fmt == "json":
+        return json.dumps(report.to_json(), indent=2, sort_keys=True)
+    return report.render_text()

@@ -1,0 +1,434 @@
+"""cephlint cross-file symbol table.
+
+One pass over every parsed module collects the facts the per-file
+checkers need global views of:
+
+- classes, their base names, their methods, and which classes form a
+  "family" (a class plus every mixin/base combined into it — the OSD is
+  ten mixins whose methods all share the locks OSD.__init__ creates);
+- lock-valued instance attributes (threading.Lock/RLock/Condition,
+  lockdep.make_lock/LockdepLock) with their lockdep names, plus
+  module-level locks and @property aliases to another attribute's lock;
+- instance-attribute types (``self.mc = MonClient(...)`` records mc ->
+  MonClient) so ``with self.mc._lock`` and ``self.store.queue_transaction``
+  resolve across files;
+- failpoint site/arming literals, config-option read literals, every
+  string constant, and f-string prefixes (for dynamically composed option
+  names like ``f"debug_{subsys}"``).
+
+Resolution is deliberately conservative: anything ambiguous resolves to
+None and the checkers stay silent about it rather than guessing.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .core import ModuleInfo
+
+LOCK_CTORS = {"Lock", "RLock"}
+CONDITION_CTORS = {"Condition"}
+NAMED_LOCK_CTORS = {"make_lock", "LockdepLock"}
+_CONF_RECEIVERS = {"conf", "config", "_config", "cfg"}
+_REGISTRY_NAMES = {"registry", "_registry", "fp_registry"}
+
+
+def attr_chain(node: ast.expr) -> tuple[str, list[str]] | None:
+    """``self._session.lock`` -> ("self", ["_session", "lock"]);
+    ``NAME`` -> ("NAME", []).  None for anything else."""
+    attrs: list[str] = []
+    while isinstance(node, ast.Attribute):
+        attrs.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id, list(reversed(attrs))
+    return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    """Rightmost name of the called thing: foo() -> foo, a.b.c() -> c."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+@dataclass
+class LockInfo:
+    attr: str                 # attribute or module-global name
+    owner: str                # "module.Class" or "module"
+    name: str                 # lockdep name (or derived pseudo-name)
+    kind: str                 # "lock" | "rlock" | "named" | "condition"
+    alias_chain: tuple[str, ...] | None = None  # Condition(self.X) -> ("X",)
+    line: int = 0
+    path: str = ""
+
+
+@dataclass
+class ClassInfo:
+    module: str
+    name: str
+    bases: list[str]
+    node: ast.ClassDef
+    path: str
+    methods: dict[str, ast.FunctionDef] = field(default_factory=dict)
+    lock_attrs: dict[str, LockInfo] = field(default_factory=dict)
+    attr_types: dict[str, str] = field(default_factory=dict)  # attr -> class name
+    property_aliases: dict[str, tuple[str, ...]] = field(default_factory=dict)
+    spawns_threads: bool = False
+
+    @property
+    def key(self) -> str:
+        return f"{self.module}.{self.name}"
+
+
+@dataclass
+class FailpointSite:
+    name: str
+    kind: str      # "site" (marker in daemon code) | "arm" (set/add/remove)
+    path: str
+    line: int
+
+
+@dataclass
+class OptionRead:
+    name: str
+    path: str
+    line: int
+
+
+class SymbolTable:
+    def __init__(self) -> None:
+        self.classes: dict[str, ClassInfo] = {}
+        self.class_by_name: dict[str, list[ClassInfo]] = {}
+        self.module_locks: dict[tuple[str, str], LockInfo] = {}
+        self.failpoint_sites: list[FailpointSite] = []
+        self.option_reads: list[OptionRead] = []
+        self.string_literals: dict[str, set[str]] = {}  # rel path -> set
+        self.fstring_prefixes: set[str] = set()
+        # inheritance edges by class key (built in build())
+        self._parents: dict[str, set[str]] = {}
+        self._children: dict[str, set[str]] = {}
+        self._family_cache: dict[str, list[ClassInfo]] = {}
+        # package-wide indexes (built in _finish)
+        self.lock_attr_index: dict[str, list[LockInfo]] = {}
+        self.attr_type_index: dict[str, set[str]] = {}
+
+    # -- family: the classes that can share an instance ---------------------
+    # A method of class C runs on instances of C's subclasses, so the
+    # attributes it may touch are those set up anywhere along the
+    # inheritance CHAIN through C: C's descendants plus every ancestor of
+    # those descendants (the OSD is ten mixins whose methods all share
+    # the locks OSD.__init__ creates).  Crucially this does NOT merge
+    # siblings: two Dispatcher subclasses never share an instance, so
+    # MDSDaemon._lock must not resolve into Objecter._lock.
+    def _closure(self, key: str, edges: dict[str, set[str]]) -> set[str]:
+        seen = {key}
+        work = [key]
+        while work:
+            for nxt in edges.get(work.pop(), ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    work.append(nxt)
+        return seen
+
+    def family_members(self, cls: ClassInfo) -> list[ClassInfo]:
+        cached = self._family_cache.get(cls.key)
+        if cached is not None:
+            return cached
+        keys: set[str] = set()
+        for desc in self._closure(cls.key, self._children):
+            keys |= self._closure(desc, self._parents)
+        members = [self.classes[k] for k in sorted(keys) if k in self.classes]
+        self._family_cache[cls.key] = members
+        return members
+
+    def family_locks(self, cls: ClassInfo) -> dict[str, LockInfo]:
+        out: dict[str, LockInfo] = {}
+        for c in self.family_members(cls):
+            for attr, li in c.lock_attrs.items():
+                out.setdefault(attr, li)
+        return out
+
+    def family_attr_types(self, cls: ClassInfo) -> dict[str, str]:
+        out: dict[str, str] = {}
+        for c in self.family_members(cls):
+            for attr, t in c.attr_types.items():
+                out.setdefault(attr, t)
+        return out
+
+    def family_methods(self, cls: ClassInfo) -> dict[str, tuple[ClassInfo, ast.FunctionDef]]:
+        out: dict[str, tuple[ClassInfo, ast.FunctionDef]] = {}
+        for c in self.family_members(cls):
+            for name, fn in c.methods.items():
+                out.setdefault(name, (c, fn))
+        return out
+
+    def family_properties(self, cls: ClassInfo) -> dict[str, tuple[str, ...]]:
+        out: dict[str, tuple[str, ...]] = {}
+        for c in self.family_members(cls):
+            for attr, chain in c.property_aliases.items():
+                out.setdefault(attr, chain)
+        return out
+
+    def family_threaded(self, cls: ClassInfo) -> bool:
+        members = self.family_members(cls)
+        return any(c.spawns_threads for c in members) or any(
+            c.lock_attrs for c in members
+        )
+
+    # -- build --------------------------------------------------------------
+    @classmethod
+    def build(cls, mods: list[ModuleInfo]) -> "SymbolTable":
+        sym = cls()
+        for mod in mods:
+            sym._scan_module(mod)
+        # inheritance edges to (package-local, name-matched) bases
+        for ci in list(sym.classes.values()):
+            for base in ci.bases:
+                for other in sym.class_by_name.get(base, []):
+                    if other.key != ci.key:
+                        sym._parents.setdefault(ci.key, set()).add(other.key)
+                        sym._children.setdefault(other.key, set()).add(ci.key)
+        sym._finish()
+        return sym
+
+    def _finish(self) -> None:
+        for ci in self.classes.values():
+            for attr, li in ci.lock_attrs.items():
+                self.lock_attr_index.setdefault(attr, []).append(li)
+            for attr, t in ci.attr_types.items():
+                self.attr_type_index.setdefault(attr, set()).add(t)
+
+    def _scan_module(self, mod: ModuleInfo) -> None:
+        lits = self.string_literals.setdefault(mod.rel, set())
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                lits.add(node.value)
+            elif isinstance(node, ast.JoinedStr):
+                if node.values and isinstance(node.values[0], ast.Constant) \
+                        and isinstance(node.values[0].value, str) \
+                        and len(node.values) > 1:
+                    prefix = node.values[0].value
+                    if prefix.endswith("_"):
+                        self.fstring_prefixes.add(prefix)
+            elif isinstance(node, ast.Call):
+                self._scan_call(mod, node)
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, str) \
+                    and self._confish(node.value):
+                self.option_reads.append(
+                    OptionRead(node.slice.value, mod.rel, node.lineno))
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                self._scan_class(mod, stmt)
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and isinstance(stmt.value, ast.Call):
+                li = self._lock_from_call(stmt.value, mod.modname,
+                                          stmt.targets[0].id, mod.rel)
+                if li is not None:
+                    self.module_locks[(mod.modname, stmt.targets[0].id)] = li
+
+    def _scan_call(self, mod: ModuleInfo, node: ast.Call) -> None:
+        name = call_name(node)
+        arg0 = node.args[0] if node.args else None
+        lit0 = arg0.value if (isinstance(arg0, ast.Constant)
+                              and isinstance(arg0.value, str)) else None
+        # failpoint sites: failpoint("..."), self._fp_hit("..."),
+        # <registry>.hit/configured("..."), <registry>.set/add/remove("...")
+        if lit0 is not None:
+            if name == "failpoint" or name == "_fp_hit":
+                self.failpoint_sites.append(
+                    FailpointSite(lit0, "site", mod.rel, node.lineno))
+            elif isinstance(node.func, ast.Attribute) and \
+                    name in ("hit", "configured", "set", "add", "remove") \
+                    and self._registryish(node.func.value):
+                kind = "site" if name in ("hit", "configured") else "arm"
+                self.failpoint_sites.append(
+                    FailpointSite(lit0, kind, mod.rel, node.lineno))
+        # config-option reads: <conf>.get("..."), <conf>.get_expanded("...")
+        if lit0 is not None and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("get", "get_expanded") \
+                and self._confish(node.func.value):
+            self.option_reads.append(OptionRead(lit0, mod.rel, node.lineno))
+        # .startswith("x_") teaches CL5 a dynamic option-name prefix
+        if name == "startswith" and lit0 is not None and lit0.endswith("_"):
+            self.fstring_prefixes.add(lit0)
+
+    @staticmethod
+    def _confish(node: ast.expr) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in _CONF_RECEIVERS
+        if isinstance(node, ast.Attribute):
+            return node.attr in _CONF_RECEIVERS
+        return False
+
+    @staticmethod
+    def _registryish(node: ast.expr) -> bool:
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            return node.func.id in _REGISTRY_NAMES
+        if isinstance(node, ast.Name):
+            return node.id in _REGISTRY_NAMES
+        chain = attr_chain(node)
+        return bool(chain and chain[1] and chain[1][-1] in _REGISTRY_NAMES)
+
+    # -- classes ------------------------------------------------------------
+    def _scan_class(self, mod: ModuleInfo, node: ast.ClassDef) -> None:
+        bases = []
+        for b in node.bases:
+            ch = attr_chain(b)
+            if ch:
+                bases.append(ch[1][-1] if ch[1] else ch[0])
+        ci = ClassInfo(module=mod.modname, name=node.name, bases=bases,
+                       node=node, path=mod.rel)
+        for stmt in node.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            ci.methods[stmt.name] = stmt  # type: ignore[assignment]
+            if any(isinstance(d, ast.Name) and d.id == "property"
+                   for d in stmt.decorator_list):
+                chain = _property_alias(stmt)
+                if chain:
+                    ci.property_aliases[stmt.name] = chain
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    cn = call_name(sub)
+                    if cn == "Thread":
+                        ci.spawns_threads = True
+                if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    self._scan_attr_assign(ci, sub, mod)
+        self.classes[ci.key] = ci
+        self.class_by_name.setdefault(ci.name, []).append(ci)
+
+    def _scan_attr_assign(self, ci: ClassInfo, stmt, mod: ModuleInfo) -> None:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        value = stmt.value
+        if value is None or not isinstance(value, ast.Call):
+            return
+        for t in targets:
+            if not (isinstance(t, ast.Attribute) and
+                    isinstance(t.value, ast.Name) and t.value.id == "self"):
+                continue
+            li = self._lock_from_call(value, f"{ci.module}.{ci.name}",
+                                      t.attr, mod.rel, owner_cls=ci)
+            if li is not None:
+                ci.lock_attrs.setdefault(t.attr, li)
+                continue
+            cn = call_name(value)
+            if cn and cn in self.class_by_name or cn and cn[:1].isupper():
+                ci.attr_types.setdefault(t.attr, cn)
+
+    def _lock_from_call(self, value: ast.Call, owner: str, attr: str,
+                        path: str, owner_cls: ClassInfo | None = None
+                        ) -> LockInfo | None:
+        cn = call_name(value)
+        if cn in LOCK_CTORS:
+            return LockInfo(attr=attr, owner=owner, name=f"{owner}.{attr}",
+                            kind="rlock" if cn == "RLock" else "lock",
+                            line=value.lineno, path=path)
+        if cn in NAMED_LOCK_CTORS:
+            arg0 = value.args[0] if value.args else None
+            name = (arg0.value if isinstance(arg0, ast.Constant)
+                    and isinstance(arg0.value, str) else f"{owner}.{attr}")
+            return LockInfo(attr=attr, owner=owner, name=name, kind="named",
+                            line=value.lineno, path=path)
+        if cn in CONDITION_CTORS:
+            alias = None
+            if value.args:
+                ch = attr_chain(value.args[0])
+                if ch and ch[0] == "self" and ch[1]:
+                    alias = tuple(ch[1])
+            return LockInfo(attr=attr, owner=owner, name=f"{owner}.{attr}",
+                            kind="condition", alias_chain=alias,
+                            line=value.lineno, path=path)
+        return None
+
+    # -- lock resolution ----------------------------------------------------
+    def resolve_lock(self, expr: ast.expr, cls: ClassInfo | None,
+                     modname: str) -> LockInfo | None:
+        """Resolve a with-item / lock expression to a LockInfo, or None.
+
+        Handles: self.X, self.X.Y (via attr types), bare module globals,
+        @property aliases, Condition aliases, and — for non-self receivers
+        like ``conn._session.lock`` — package-wide unique attribute-name
+        matching, two trailing components deep."""
+        ch = attr_chain(expr)
+        if ch is None:
+            return None
+        base, attrs = ch
+        if base == "self" and cls is not None:
+            return self._resolve_self_chain(attrs, cls)
+        if not attrs:
+            li = self.module_locks.get((modname, base))
+            return li
+        return self._resolve_unique_chain(attrs)
+
+    def _deref(self, li: LockInfo | None, cls: ClassInfo | None) -> LockInfo | None:
+        """Follow a Condition(self.X) alias to the real lock."""
+        seen = 0
+        while li is not None and li.alias_chain and cls is not None and seen < 4:
+            nxt = self._resolve_self_chain(list(li.alias_chain), cls)
+            if nxt is None or nxt is li:
+                return li
+            li = nxt
+            seen += 1
+        return li
+
+    def _resolve_self_chain(self, attrs: list[str],
+                            cls: ClassInfo) -> LockInfo | None:
+        if not attrs:
+            return None
+        locks = self.family_locks(cls)
+        props = self.family_properties(cls)
+        types = self.family_attr_types(cls)
+        a0 = attrs[0]
+        if len(attrs) == 1:
+            if a0 in locks:
+                return self._deref(locks[a0], cls)
+            if a0 in props:
+                return self._resolve_self_chain(list(props[a0]), cls)
+            return None
+        if a0 in types:
+            target = self.class_by_name.get(types[a0], [])
+            if len(target) == 1:
+                tcls = target[0]
+                tl = self.family_locks(tcls)
+                if attrs[1] in tl and len(attrs) == 2:
+                    return self._deref(tl[attrs[1]], tcls)
+                tp = self.family_properties(tcls)
+                if attrs[1] in tp and len(attrs) == 2:
+                    return self._resolve_self_chain(list(tp[attrs[1]]), tcls)
+        return self._resolve_unique_chain(attrs)
+
+    def _resolve_unique_chain(self, attrs: list[str]) -> LockInfo | None:
+        last = attrs[-1]
+        cands = self.lock_attr_index.get(last, [])
+        if len(cands) == 1:
+            return cands[0]
+        if len(cands) > 1 and len(attrs) >= 2:
+            pen = attrs[-2]
+            owners = self.attr_type_index.get(pen, set())
+            narrowed = [c for c in cands
+                        if c.owner.rsplit(".", 1)[-1] in owners]
+            if len(narrowed) == 1:
+                return narrowed[0]
+        return None
+
+
+def _property_alias(fn: ast.FunctionDef) -> tuple[str, ...] | None:
+    """``@property def _lock(self): return self._session.lock`` ->
+    ("_session", "lock")."""
+    body = [s for s in fn.body
+            if not (isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant))]
+    if len(body) != 1 or not isinstance(body[0], ast.Return):
+        return None
+    ch = attr_chain(body[0].value) if body[0].value is not None else None
+    if ch and ch[0] == "self" and ch[1]:
+        return tuple(ch[1])
+    return None

@@ -388,8 +388,8 @@ class _Store:
             return True
 
     def versioning_status(self, bucket: str) -> str | None:
-        cfg = self._read_json(self.meta, f"bver.{bucket}", None)
-        return cfg.get("status") if cfg else None
+        ver = self._read_json(self.meta, f"bver.{bucket}", None)
+        return ver.get("status") if ver else None
 
     def set_versioning(self, bucket: str, status: str) -> bool:
         with self.lock:

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import ctypes
 import os
-from threading import RLock
+from ..common.lockdep import make_lock
 
 _LIB = None
 
@@ -65,7 +65,7 @@ class NativeBitmapAllocator:
         if not self._h:
             raise AllocError("allocator create failed")
         self.n_blocks = n_blocks
-        self._lock = RLock()
+        self._lock = make_lock("store::alloc")
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -111,14 +111,14 @@ class PyBitmapAllocator:
         self._free = bytearray(b"\x01") * n_blocks if n_blocks else bytearray()
         self._n_free = n_blocks
         self._cursor = 0
-        self._lock = RLock()
+        self._lock = make_lock("store::alloc")
 
     @property
     def free_blocks(self) -> int:
         with self._lock:
             return self._n_free
 
-    def _mark(self, start: int, length: int, free: bool) -> None:
+    def _mark_locked(self, start: int, length: int, free: bool) -> None:
         if start + length > self.n_blocks:
             raise AllocError(f"extent ({start},{length}) out of range")
         v = 1 if free else 0
@@ -129,11 +129,11 @@ class PyBitmapAllocator:
 
     def mark_used(self, start: int, length: int) -> None:
         with self._lock:
-            self._mark(start, length, False)
+            self._mark_locked(start, length, False)
 
     def release(self, start: int, length: int) -> None:
         with self._lock:
-            self._mark(start, length, True)
+            self._mark_locked(start, length, True)
 
     def allocate(self, want: int) -> list[tuple[int, int]]:
         with self._lock:
@@ -174,7 +174,7 @@ class PyBitmapAllocator:
             if got < want:
                 raise AllocError(f"cannot allocate {want} blocks")
             for s, n in out:
-                self._mark(s, n, False)
+                self._mark_locked(s, n, False)
             self._cursor = pos
             return out
 

@@ -32,10 +32,10 @@ from __future__ import annotations
 import base64
 import json
 import os
-from threading import RLock
 from typing import Callable
 
 from ..common.crc32c import crc32c
+from ..common.lockdep import make_lock
 from .alloc import make_allocator
 from .kv import Batch, LogKV
 from .object_store import (
@@ -165,7 +165,7 @@ class BlueStore(ObjectStore):
         self._alloc = None
         self._colls: set[str] = set()
         self._onodes: dict[tuple[str, str], Onode] = {}
-        self._lock = RLock()
+        self._lock = make_lock("store::bluestore")
         self._mounted = False
         self.mount()
 

@@ -12,7 +12,7 @@ all-or-nothing), then persisted as one batch of absolute post-state values.
 """
 from __future__ import annotations
 
-from threading import RLock
+from ..common.lockdep import make_lock
 from typing import Callable
 
 from .kv import Batch, LogKV
@@ -52,7 +52,7 @@ class KStore(MemStore):
         self.path = path
         self._kv = LogKV(path, sync_default=sync)
         self._mounted = False
-        self._io_lock = RLock()
+        self._io_lock = make_lock("store::kstore_io")
         # at-rest object-data compression (reference: bluestore_compression
         # — data only, stored iff it actually shrinks; xattr/omap stay raw)
         self._compressor = None

@@ -15,9 +15,9 @@ from __future__ import annotations
 
 import os
 import struct
-from threading import RLock
 
 from ..common.buffer import BufferList, BufferListIterator
+from ..common.lockdep import make_lock
 from ..common.crc32c import crc32c
 
 _OP_SET = 1
@@ -52,7 +52,7 @@ class MemKV(KeyValueDB):
 
     def __init__(self):
         self._map: dict[str, bytes] = {}
-        self._lock = RLock()
+        self._lock = make_lock("store::kv")
 
     def submit_batch(self, ops, sync: bool = False) -> None:
         if isinstance(ops, Batch):
@@ -114,7 +114,7 @@ class LogKV(KeyValueDB):
         self.compact_threshold = compact_threshold
         self.readonly = readonly
         self._map: dict[str, bytes] = {}
-        self._lock = RLock()
+        self._lock = make_lock("store::kv")
         self._wal = None
         if not readonly:
             os.makedirs(path, exist_ok=True)

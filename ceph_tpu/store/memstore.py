@@ -3,7 +3,7 @@ SURVEY.md §4 ring 3: the unit-test backend so OSD-level tests need no disk).
 """
 from __future__ import annotations
 
-from threading import RLock
+from ..common.lockdep import make_lock
 from typing import Callable
 
 from .object_store import Collection, NotFound, ObjectStore, Transaction
@@ -12,7 +12,7 @@ from .object_store import Collection, NotFound, ObjectStore, Transaction
 class MemStore(ObjectStore):
     def __init__(self):
         self._colls: dict[str, Collection] = {}
-        self._lock = RLock()
+        self._lock = make_lock("store::memstore")
 
     def queue_transaction(
         self, t: Transaction, on_commit: Callable[[], None] | None = None

@@ -1,0 +1,426 @@
+"""cephlint (ceph_tpu.qa.analyzer) — fixture tests for every checker,
+the suppression layers, and the tier-1 whole-package gate.
+
+The fixture tests build tiny package trees under tmp_path and assert
+each CL check fires on its true-positive snippet and stays silent on
+the true-negative.  The gate test at the bottom is the PR's teeth:
+``python -m ceph_tpu.qa.analyzer ceph_tpu/`` must stay clean (zero
+non-baselined findings) — a new finding means fix it, # noqa it with a
+justification, or add a justified baseline entry.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from ceph_tpu.qa.analyzer.__main__ import main as analyzer_main
+from ceph_tpu.qa.analyzer.core import (
+    BaselineError,
+    Config,
+    format_baseline,
+    parse_baseline,
+    run,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def make_pkg(tmp_path: Path, files: dict[str, str]) -> Path:
+    """Write a fixture package tree; returns the package dir to scan."""
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return pkg
+
+
+def run_on(pkg: Path):
+    return run(Config.discover([str(pkg)]))
+
+
+def idents(report, code: str) -> set[str]:
+    return {f.ident for f in report.findings if f.code == code}
+
+
+# -- CL1: lock discipline ---------------------------------------------------
+
+CL1_TP = '''
+import threading
+import time
+from ceph_tpu.common.lockdep import make_lock
+
+
+class Daemon:
+    def __init__(self):
+        self._raw = threading.Lock()
+        self.l1 = make_lock("fix::one")
+        self.l2 = make_lock("fix::two")
+
+    def ab(self):
+        with self.l1:
+            with self.l2:
+                pass
+
+    def ba(self):
+        with self.l2:
+            with self.l1:
+                pass
+
+    def slow(self):
+        with self.l1:
+            time.sleep(1.0)
+'''
+
+CL1_TN = '''
+import time
+from ceph_tpu.common.lockdep import make_lock
+
+
+class Daemon:
+    def __init__(self):
+        self.l1 = make_lock("fix::one")
+        self.l2 = make_lock("fix::two")
+
+    def ab(self):
+        with self.l1:
+            with self.l2:
+                pass
+
+    def ab_again(self):
+        with self.l1:
+            with self.l2:
+                pass
+
+    def slow(self):
+        time.sleep(1.0)
+'''
+
+
+def test_cl1_true_positive(tmp_path):
+    pkg = make_pkg(tmp_path, {"osd/daemon.py": CL1_TP})
+    got = idents(run_on(pkg), "CL1")
+    assert "raw-lock:Daemon._raw" in got
+    assert any(i.startswith("lock-cycle:") for i in got), got
+    assert any("blocking:time.sleep" in i for i in got), got
+
+
+def test_cl1_true_negative(tmp_path):
+    pkg = make_pkg(tmp_path, {"osd/daemon.py": CL1_TN})
+    assert idents(run_on(pkg), "CL1") == set()
+
+
+def test_cl1_raw_lock_only_in_concurrency_dirs(tmp_path):
+    # the same raw lock outside osd/mon/msg/store/client is tolerated
+    pkg = make_pkg(tmp_path, {"tools/helper.py": (
+        "import threading\n"
+        "class T:\n"
+        "    def __init__(self):\n"
+        "        self._l = threading.Lock()\n")})
+    assert idents(run_on(pkg), "CL1") == set()
+
+
+# -- CL2: shared-state races ------------------------------------------------
+
+CL2_SRC = '''
+from ceph_tpu.common.lockdep import make_lock
+
+
+class Counter:
+    def __init__(self):
+        self._lock = make_lock("fix::counter")
+        self.count = 0
+        self.total = 0
+
+    def bump(self):
+        self.count += 1
+
+    def bump_safe(self):
+        with self._lock:
+            self.count += 1
+
+    def _roll_locked(self):
+        # *_locked convention: caller holds the lock
+        self.total = self.total + 1
+'''
+
+
+def test_cl2_true_positive_and_negatives(tmp_path):
+    pkg = make_pkg(tmp_path, {"osd/counter.py": CL2_SRC})
+    got = idents(run_on(pkg), "CL2")
+    assert got == {"Counter.bump:count"}, got  # safe + _locked stay quiet
+
+
+def test_cl2_single_threaded_class_is_quiet(tmp_path):
+    # no locks, no threads -> not a shared-state class
+    pkg = make_pkg(tmp_path, {"osd/plain.py": (
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self.n = 0\n"
+        "    def bump(self):\n"
+        "        self.n += 1\n")})
+    assert idents(run_on(pkg), "CL2") == set()
+
+
+# -- CL3: JAX tracing hygiene ----------------------------------------------
+
+CL3_TP = '''
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_branch(x):
+    if x > 0:
+        return x
+    return -x
+'''
+
+CL3_TN = '''
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def good_select(x):
+    return jnp.where(x > 0, x, -x)
+'''
+
+
+def test_cl3_true_positive(tmp_path):
+    pkg = make_pkg(tmp_path, {"ops/kern.py": CL3_TP})
+    got = idents(run_on(pkg), "CL3")
+    assert any("branch" in i for i in got), got
+
+
+def test_cl3_true_negative(tmp_path):
+    pkg = make_pkg(tmp_path, {"ops/kern.py": CL3_TN})
+    assert idents(run_on(pkg), "CL3") == set()
+
+
+def test_cl3_only_in_accelerator_dirs(tmp_path):
+    # the same tracer branch outside ops/crush/parallel/bench is ignored
+    pkg = make_pkg(tmp_path, {"osd/kern.py": CL3_TP})
+    assert idents(run_on(pkg), "CL3") == set()
+
+
+# -- CL4: failpoint drift ---------------------------------------------------
+
+def cl4_files(known: str, doc_names: list[str], site_src: str) -> dict:
+    rows = "\n".join(f"| `{n}` | fixture |" for n in doc_names)
+    return {
+        "common/failpoint.py": f"KNOWN_FAILPOINTS = {known}\n",
+        "osd/daemon.py": site_src,
+        "../docs/fault_injection.md": (
+            "| name | notes |\n|---|---|\n" + rows + "\n"),
+    }
+
+
+def make_cl4_pkg(tmp_path, known, doc_names, site_src):
+    files = cl4_files(known, doc_names, site_src)
+    docs_md = files.pop("../docs/fault_injection.md")
+    pkg = make_pkg(tmp_path, files)
+    docs = tmp_path / "docs"
+    docs.mkdir(exist_ok=True)
+    (docs / "fault_injection.md").write_text(docs_md)
+    return pkg
+
+
+def test_cl4_true_positive(tmp_path):
+    pkg = make_cl4_pkg(
+        tmp_path,
+        known='{"a.b", "c.d"}',
+        doc_names=["a.b", "ghost.fp"],
+        site_src=('def f(cct):\n'
+                  '    failpoint("a.b", cct=cct)\n'
+                  '    failpoint("x.y", cct=cct)\n'),
+    )
+    got = idents(run_on(pkg), "CL4")
+    assert "site:x.y" in got            # site not catalogued
+    assert "doc:x.y" in got             # site not documented
+    assert "orphan-known:c.d" in got    # catalogued, no site
+    assert "orphan-doc:ghost.fp" in got  # documented, nothing real
+
+
+def test_cl4_true_negative(tmp_path):
+    pkg = make_cl4_pkg(
+        tmp_path,
+        known='{"a.b"}',
+        doc_names=["a.b"],
+        site_src='def f(cct):\n    failpoint("a.b", cct=cct)\n',
+    )
+    assert idents(run_on(pkg), "CL4") == set()
+
+
+# -- CL5: config-option drift ----------------------------------------------
+
+def cl5_pkg(tmp_path, reader: str) -> Path:
+    return make_pkg(tmp_path, {
+        "common/options.py": (
+            "def default_options():\n"
+            "    return [\n"
+            '        Option("declared_read", int, 0, "read below"),\n'
+            '        Option("never_read", int, 0, "nothing reads this"),\n'
+            "    ]\n"),
+        "osd/reader.py": reader,
+    })
+
+
+def test_cl5_true_positive(tmp_path):
+    pkg = cl5_pkg(tmp_path, (
+        "def f(conf):\n"
+        '    a = conf.get("declared_read")\n'
+        '    b = conf.get("undeclared_opt")\n'
+        "    return a, b\n"))
+    got = idents(run_on(pkg), "CL5")
+    assert "read:undeclared_opt" in got
+    assert "unread:never_read" in got
+    assert "unread:declared_read" not in got
+
+
+def test_cl5_true_negative(tmp_path):
+    pkg = cl5_pkg(tmp_path, (
+        "def f(conf):\n"
+        '    return conf.get("declared_read"), conf.get("never_read")\n'))
+    assert idents(run_on(pkg), "CL5") == set()
+
+
+def test_cl5_dynamic_prefix_counts_as_read(tmp_path):
+    # f"debug_{x}" marks every debug_* option as read
+    pkg = make_pkg(tmp_path, {
+        "common/options.py": (
+            "def default_options():\n"
+            '    return [Option("debug_fix", int, 0, "level")]\n'),
+        "osd/reader.py": (
+            "def f(conf, subsys):\n"
+            '    return conf.get(f"debug_{subsys}")\n'),
+    })
+    assert idents(run_on(pkg), "CL5") == set()
+
+
+# -- suppression layers -----------------------------------------------------
+
+def test_noqa_suppresses_and_is_counted(tmp_path):
+    src = CL2_SRC.replace("self.count += 1\n\n",
+                          "self.count += 1  # noqa: CL2 fixture\n\n", 1)
+    pkg = make_pkg(tmp_path, {"osd/counter.py": src})
+    report = run_on(pkg)
+    assert idents(report, "CL2") == set()
+    assert any(f.ident == "Counter.bump:count" for f in report.noqa)
+
+
+def test_noqa_other_code_does_not_suppress(tmp_path):
+    src = CL2_SRC.replace("self.count += 1\n\n",
+                          "self.count += 1  # noqa: CL1\n\n", 1)
+    pkg = make_pkg(tmp_path, {"osd/counter.py": src})
+    assert idents(run_on(pkg), "CL2") == {"Counter.bump:count"}
+
+
+def test_baseline_round_trip(tmp_path):
+    pkg = make_pkg(tmp_path, {"osd/counter.py": CL2_SRC})
+    report = run_on(pkg)
+    assert len(report.findings) == 1
+
+    text = format_baseline(report.findings, reason="fixture justification")
+    entries = parse_baseline(text)
+    assert [e["ident"] for e in entries] == ["Counter.bump:count"]
+
+    base = pkg / "qa" / "analyzer" / "baseline.toml"
+    base.parent.mkdir(parents=True)
+    base.write_text(text)
+    report2 = run_on(pkg)
+    assert report2.clean
+    assert [f.ident for f in report2.baselined] == ["Counter.bump:count"]
+    assert report2.stale_baseline == []
+
+
+def test_baseline_stale_entry_warns(tmp_path):
+    pkg = make_pkg(tmp_path, {"osd/counter.py": CL2_TN_CLEAN})
+    base = pkg / "qa" / "analyzer" / "baseline.toml"
+    base.parent.mkdir(parents=True)
+    base.write_text(
+        '[[suppress]]\ncode = "CL2"\npath = "osd/counter.py"\n'
+        'ident = "Counter.gone:n"\nreason = "was fixed"\n')
+    report = run_on(pkg)
+    assert report.clean
+    assert [e["ident"] for e in report.stale_baseline] == ["Counter.gone:n"]
+    assert "stale baseline entry" in report.render_text()
+    # the CLI fails on stale entries too (same contract as the gate)
+    assert analyzer_main([str(pkg)]) == 1
+
+
+CL2_TN_CLEAN = (
+    "from ceph_tpu.common.lockdep import make_lock\n"
+    "class Counter:\n"
+    "    def __init__(self):\n"
+    '        self._lock = make_lock("fix::c")\n'
+    "        self.n = 0\n"
+    "    def bump(self):\n"
+    "        with self._lock:\n"
+    "            self.n += 1\n")
+
+
+def test_baseline_requires_reason(tmp_path):
+    with pytest.raises(BaselineError):
+        parse_baseline('[[suppress]]\ncode = "CL2"\npath = "a.py"\n'
+                       'ident = "x"\n')
+
+
+def test_baseline_rejects_garbage():
+    with pytest.raises(BaselineError):
+        parse_baseline("[[suppress]]\nnot a kv line\n")
+
+
+# -- CLI contract -----------------------------------------------------------
+
+def test_cli_exit_codes(tmp_path, capsys):
+    dirty = make_pkg(tmp_path / "dirty", {"osd/counter.py": CL2_SRC})
+    assert analyzer_main([str(dirty)]) == 1
+    clean = make_pkg(tmp_path / "clean", {"osd/counter.py": CL2_TN_CLEAN})
+    assert analyzer_main([str(clean)]) == 0
+    out = capsys.readouterr().out
+    assert "cephlint:" in out
+
+
+def test_cli_json_format(tmp_path, capsys):
+    import json
+
+    pkg = make_pkg(tmp_path, {"osd/counter.py": CL2_SRC})
+    assert analyzer_main([str(pkg), "--format=json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["clean"] is False
+    assert doc["findings"][0]["code"] == "CL2"
+
+
+def test_cli_checks_subset(tmp_path):
+    pkg = make_pkg(tmp_path, {"osd/counter.py": CL2_SRC})
+    assert analyzer_main([str(pkg), "--checks", "CL1"]) == 0
+
+
+# -- the tier-1 gate --------------------------------------------------------
+
+def test_package_analyzer_clean():
+    """`python -m ceph_tpu.qa.analyzer ceph_tpu/` exits 0: zero active
+    findings over the whole package.  New findings mean: fix the code,
+    add a justified # noqa, or baseline with a reason — see
+    docs/static_analysis.md."""
+    cfg = Config.discover([str(REPO / "ceph_tpu")])
+    report = run(cfg)
+    assert report.clean, "\n" + report.render_text()
+    # baseline hygiene rides the same gate: a stale entry means the debt
+    # was paid — delete the entry
+    assert not report.stale_baseline, report.render_text()
+
+
+def test_package_gate_matches_cli():
+    cfg = Config.discover([str(REPO / "ceph_tpu")])
+    report = run(cfg)
+    # each check ran (the gate isn't green because checks were skipped)
+    assert set(cfg.checks) == {"CL1", "CL2", "CL3", "CL4", "CL5"}
+    assert cfg.options_file is not None
+    assert cfg.failpoint_file is not None
+    assert cfg.docs_fault_injection is not None
+    assert report.clean
