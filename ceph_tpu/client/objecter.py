@@ -120,8 +120,12 @@ class Objecter(Dispatcher):
                 def run(cb=cb, nid=msg.notify_id, ck=msg.cookie, d=data):
                     try:
                         cb(nid, ck, d)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        # user callback: contain it, but leave a trace
+                        if self.cct:
+                            self.cct.dout(
+                                "objecter", 0,
+                                f"watch callback cookie={ck} raised: {e!r}")
 
                 threading.Thread(target=run, daemon=True).start()
             try:

@@ -13,6 +13,7 @@ import json
 import os
 import socket
 import struct
+import sys
 from threading import Thread
 from typing import Callable
 
@@ -89,8 +90,11 @@ class AdminSocket:
                 return
             try:
                 self._handle(conn)
-            except Exception:
-                pass
+            except Exception as e:
+                # a broken client or a handler bug must not kill the
+                # serve loop, but it must not vanish either
+                print(f"# admin_socket {self.path}: request failed: "
+                      f"{e!r}", file=sys.stderr)
             finally:
                 conn.close()
 

@@ -270,7 +270,7 @@ class FSClient(Dispatcher):
             try:
                 conn.send_message(MClientCaps(
                     op="flush", client=self._session, ino=msg.ino,
-                    caps=msg.caps or "", seq=msg.seq,
+                    caps=msg.caps or "", cap_seq=msg.cap_seq,
                     attrs=dirty or None,
                 ))
             except (OSError, ConnectionError):
@@ -315,7 +315,7 @@ class FSClient(Dispatcher):
                 for ino in list(pending):
                     conn.send_message(MClientCaps(
                         op="flush", client=self._session, ino=ino,
-                        caps="", seq=0, attrs=pending[ino],
+                        caps="", cap_seq=0, attrs=pending[ino],
                     ))
                     pending.pop(ino)
             except (OSError, ConnectionError):
@@ -525,7 +525,7 @@ class FSClient(Dispatcher):
                 if conn is not None:
                     conn.send_message(MClientCaps(
                         op="release", client=self._session, ino=ino,
-                        caps="", seq=0,
+                        caps="", cap_seq=0,
                     ))
             except (OSError, ConnectionError):
                 pass

@@ -607,8 +607,10 @@ class MDSDaemon(Dispatcher):
         with self._lock:
             try:
                 self._flush()
-            except Exception:
-                pass
+            except Exception as e:
+                self.cct.dout("mds", 0,
+                              f"mds.{self.rank} shutdown flush failed "
+                              f"(continuing to hard_kill): {e!r}")
         self.hard_kill()
 
     def hard_kill(self) -> None:
@@ -968,7 +970,7 @@ class MDSDaemon(Dispatcher):
                     try:
                         conn.send_message(MClientCaps(
                             op="revoke", client=session, ino=ino,
-                            caps=ent["caps"], seq=ent.get("seq", 0),
+                            caps=ent["caps"], cap_seq=ent.get("seq", 0),
                             attrs=attrs,
                         ))
                     except (OSError, ConnectionError):
@@ -980,7 +982,7 @@ class MDSDaemon(Dispatcher):
             try:
                 conn.send_message(MClientCaps(
                     op="revoke", client=session, ino=ino, caps=keep,
-                    seq=ent["seq"], attrs=attrs,
+                    cap_seq=ent["seq"], attrs=attrs,
                 ))
             except (OSError, ConnectionError):
                 conn = None
@@ -1639,8 +1641,8 @@ class MDSDaemon(Dispatcher):
                     # Locker::handle_client_caps drops stale-seq cap acks.
                     stale = (
                         ent is not None
-                        and msg.seq is not None
-                        and 0 < msg.seq < ent.get("seq", 0)
+                        and msg.cap_seq is not None
+                        and 0 < msg.cap_seq < ent.get("seq", 0)
                     )
                     if ent is not None and not stale:
                         had_w = "w" in ent["caps"]

@@ -14,7 +14,7 @@ class MClientSession(_JsonMessage):
     REQUEST_OPEN/OPEN/REQUEST_CLOSE/CLOSE)."""
 
     MSG_TYPE = 22  # CEPH_MSG_CLIENT_SESSION
-    FIELDS = ("op", "client", "seq")
+    FIELDS = ("op", "client")
 
 
 @register_message
@@ -52,7 +52,13 @@ class MClientCaps(_JsonMessage):
         | "release" (client -> MDS: closing, drop all caps on ino)
     caps: remaining cap string ("rw", "r", "") — the Fw/Fb vs Fr/Fc
     split collapses to w implies buffer, r implies cache.
-    `attrs` carries the flushed {size, mtime} on "flush"."""
+    `attrs` carries the flushed {size, mtime} on "flush".
+
+    `cap_seq` is the Locker's per-cap revoke sequence (reference:
+    MClientCaps::seq) — deliberately NOT named `seq`: the framing attr
+    `seq` is stamped with the connection sequence by send_message
+    BEFORE the payload encodes, so a payload field of the same name is
+    silently clobbered on the wire (cephlint CL6 field-shadow)."""
 
     MSG_TYPE = 23  # CEPH_MSG_CLIENT_CAPS
-    FIELDS = ("op", "client", "ino", "caps", "seq", "attrs")
+    FIELDS = ("op", "client", "ino", "caps", "cap_seq", "attrs")

@@ -91,15 +91,17 @@ class MgrDaemon(Dispatcher):
         for mod in self._modules.values():
             try:
                 mod.shutdown()
-            except Exception:
-                pass
+            except Exception as e:
+                self.cct.dout("mgr", 0,
+                              f"mgr module {mod.NAME} shutdown raised: {e!r}")
         # rados AFTER the modules that reach through it
         with self._rados_lock:
             if self._rados is not None:
                 try:
                     self._rados.shutdown()
-                except Exception:
-                    pass
+                except Exception as e:
+                    self.cct.dout("mgr", 0,
+                                  f"mgr rados shutdown raised: {e!r}")
                 self._rados = None
         self.mc.shutdown()
         self.messenger.shutdown()

@@ -107,7 +107,11 @@ class MOSDFailure(_JsonMessage):
 
 @register_message
 class MOSDAlive(_JsonMessage):
-    """reference: MOSDAlive / cancellation of a failure report."""
+    """reference: MOSDAlive / cancellation of a failure report.  An OSD
+    that reported a peer down and then hears its ping reply retracts the
+    report so the leader's corroboration count drains instead of riding
+    until the target reboots.  `reporter` is pinned from `src` before
+    any peon→leader forward, exactly like MOSDFailure."""
 
     MSG_TYPE = 73
-    FIELDS = ("target",)
+    FIELDS = ("target", "reporter")

@@ -17,6 +17,7 @@ from .messages import (
     MMonCommand,
     MMonCommandAck,
     MMonSubscribe,
+    MOSDAlive,
     MOSDBoot,
     MOSDFailure,
     MOSDMapMsg,
@@ -271,6 +272,19 @@ class MonClient(Dispatcher):
         try:
             self._connect().send_message(
                 MOSDFailure(target=target, failed_for=failed_for, reporter=None)
+            )
+        except (OSError, ConnectionError):
+            pass
+
+    def report_alive(self, target: int) -> None:
+        """Retract an earlier report_failure for `target` (reference:
+        OSD::send_still_alive -> MOSDAlive): the mon discards this
+        daemon's entry from the target's corroboration set.  reporter
+        is left None — the receiving mon pins it from msg.src, which
+        survives the peon→leader forward."""
+        try:
+            self._connect().send_message(
+                MOSDAlive(target=target, reporter=None)
             )
         except (OSError, ConnectionError):
             pass

@@ -196,7 +196,16 @@ class Monitor(Dispatcher):
 
     def _send_loop(self, key, q: "queue.Queue") -> None:
         while True:
-            item = q.get()
+            try:
+                # bounded wait: a mon killed without draining its send
+                # queues (thrasher hard-kill) never enqueues the None
+                # sentinel, and an unbounded get() would leak this
+                # thread; the timeout re-checks _stopped instead
+                item = q.get(timeout=5.0)
+            except queue.Empty:
+                if self._stopped:
+                    return
+                continue
             if item is None or self._stopped:
                 return
             try:
@@ -228,8 +237,10 @@ class Monitor(Dispatcher):
                               f"mon.{self.name} crashed (injected)")
                 try:
                     self.shutdown()
-                except Exception:
-                    pass
+                except Exception as e:
+                    self.cct.dout("mon", 0,
+                                  f"mon.{self.name} crash-shutdown "
+                                  f"raised: {e!r}")
                 return
             except Exception as e:
                 self.cct.dout("mon", 0, f"mon.{self.name} tick failed: {e!r}")
@@ -382,7 +393,15 @@ class Monitor(Dispatcher):
             else:
                 self._forward_to_leader(msg)
         elif isinstance(msg, MOSDAlive):
-            self.osdmon.handle_alive(msg.target, msg.src)
+            # same reporter pinning + leader routing as MOSDFailure: the
+            # retraction must drain the LEADER's corroboration set, and
+            # must count as the original OSD, not a forwarding peon
+            if not msg.reporter:
+                msg.reporter = msg.src
+            if self.is_leader():
+                self.osdmon.handle_alive(msg.target, msg.reporter)
+            else:
+                self._forward_to_leader(msg)
         elif isinstance(msg, MPing):
             pass
         else:

@@ -171,6 +171,7 @@ class OSD(
         self._stop = threading.Event()
         self._tick_thread: threading.Thread | None = None
         self._hb_failures: dict[int, int] = {}
+        self._hb_reported: set[int] = set()  # peers we told the mon are down
         self._codecs: dict[str, object] = {}
         self._recovery_wakeup = threading.Event()
         # mClock QoS dispatch (reference: osd_mclock_profile
@@ -365,7 +366,12 @@ class OSD(
                 try:
                     o = old.pg_to_up_acting_osds(pg.pool_id, pg.ps)
                     n = m.pg_to_up_acting_osds(pg.pool_id, pg.ps)
-                except Exception:
+                except Exception as e:
+                    # pool deleted between the two epochs (or a map too
+                    # old to place against) — the PG is on its way out
+                    self.cct.dout("osd", 10,
+                                  f"{self.whoami} interval check skipped "
+                                  f"pg {pg.pool_id}.{pg.ps:x}: {e!r}")
                     continue
                 if (o[2], o[3]) != (n[2], n[3]):
                     # close the old interval into the history BEFORE
@@ -679,6 +685,20 @@ class OSD(
                     pass
             elif msg.op == "reply":
                 self._hb_failures.pop(msg.osd, None)
+                if msg.osd in self._hb_reported:
+                    # we told the mon this peer was down and it just
+                    # answered a ping: retract the report so the
+                    # leader's corroboration count drains (reference:
+                    # OSD::send_still_alive) instead of riding until
+                    # the target re-boots.  Off-thread: report_alive
+                    # may have to re-dial the mon, and this runs on the
+                    # messenger rx thread, which must never block on a
+                    # connect (the PR-4 ensure_connection rule)
+                    self._hb_reported.discard(msg.osd)
+                    threading.Thread(
+                        target=self.mc.report_alive, args=(msg.osd,),
+                        name=f"osd.{self.id}-alive", daemon=True,
+                    ).start()
             return True
         return False
 

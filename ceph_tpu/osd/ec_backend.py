@@ -276,11 +276,11 @@ class ECBackendMixin:
         sub_chunks = 1
         try:
             sub_chunks = codec.get_sub_chunk_count()
-        except Exception:
-            pass
+        except (AttributeError, NotImplementedError):
+            pass  # plugin predates the sub-chunk API: classic layout
         try:
             delta_ok = bool(codec.supports_parity_delta())
-        except Exception:
+        except (AttributeError, NotImplementedError):
             delta_ok = False
         if size == 0 or end > k * L or sub_chunks != 1 or not delta_ok:
             # codecs whose encode is not byte-column-local (bitmatrix

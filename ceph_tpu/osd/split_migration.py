@@ -224,7 +224,11 @@ class SplitMigrationMixin:
                     1 for o in self.store.list_objects(cid)
                     if not o.startswith("_")
                 )
-            except Exception:
+            except Exception as e:
+                # collection dropped concurrently (split cleanup) —
+                # count what's still listable, but leave a trace
+                self.cct.dout("osd", 10,
+                              f"{self.whoami} stats skipped {cid}: {e!r}")
                 continue
             num_objects += n_here
             if pool_id is not None:
@@ -324,6 +328,10 @@ class SplitMigrationMixin:
                 self._hb_failures[osd] = prev + 1
             if self._hb_failures.get(osd, 0) >= silent_limit:
                 self.mc.report_failure(osd, failed_for=grace)
+                # remember the report so a later ping reply retracts it
+                # (MOSDAlive) instead of leaving a stale corroboration
+                # entry on the leader
+                self._hb_reported.add(osd)
                 # restart the count: re-report only after another full
                 # grace of silent intervals, not on every subsequent tick
                 self._hb_failures.pop(osd, None)

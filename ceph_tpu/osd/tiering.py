@@ -486,6 +486,10 @@ class TieringMixin:
                             self._tier_evict_object(
                                 pg, pool, acting, oid, self._next_tid()
                             )
-                        except Exception:
-                            pass
+                        except Exception as e:
+                            # eviction is opportunistic (the next agent
+                            # pass retries), but never silent
+                            self.cct.dout(
+                                "osd", 5,
+                                f"{self.whoami} tier evict {oid}: {e!r}")
 

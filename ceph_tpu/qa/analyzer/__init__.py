@@ -9,10 +9,16 @@ Python port):
     CL3  JAX tracing hygiene in ops/, crush/, parallel/, bench/
     CL4  failpoint site / catalogue / docs drift
     CL5  config-option read / declaration drift
+    CL6  wire-protocol conformance (encode/decode pairing, field loss,
+         MSG_TYPE collisions, dispatch reachability)
+    CL7  error paths (swallowed exceptions, unbounded blocking waits,
+         reset callbacks mutating shared state without the lock)
+    CL8  kernel shape/dtype dataflow in ops/, gf/, crush/
 
 Run it::
 
-    python -m ceph_tpu.qa.analyzer ceph_tpu/ [--format=text|json]
+    python -m ceph_tpu.qa.analyzer ceph_tpu/ [--format=text|json|sarif]
+    cephlint --diff origin/main          # pre-commit: changed files only
 
 Suppress a single finding with ``# noqa: CL#`` on its line; pin a
 by-design finding in qa/analyzer/baseline.toml with a mandatory reason.

@@ -1,15 +1,61 @@
-"""CLI: python -m ceph_tpu.qa.analyzer [paths] [--format=text|json] ...
+"""CLI: python -m ceph_tpu.qa.analyzer [paths] [--format=text|json|sarif] ...
 
-Exit status: 0 clean, 1 findings, 2 usage/parse errors — the same
-contract as the tier-1 gate in tests/test_analyzer.py.
+Exit-code contract (the same contract tests/test_analyzer.py gates on,
+and what pre-commit hooks should branch on):
+
+    0   clean: no active findings, no stale baseline entries
+    1   findings (or, outside --diff mode, stale baseline entries —
+        paid-down debt whose [[suppress]] block must be deleted)
+    2   usage or parse errors (bad flag, unreadable baseline, syntax
+        error in a scanned file, git failure under --diff)
+
+``--diff BASE_REF`` narrows the REPORT to files changed since BASE_REF
+(``git diff --name-only BASE_REF``); the analysis itself stays
+whole-package so cross-file checks (CL1 order graph, CL4-CL6 drift
+pairings) keep their global view.  Stale-baseline warnings are
+suppressed under --diff — a partial view can't judge them.
 """
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 from pathlib import Path
 
 from .core import BaselineError, Config, format_baseline, render, run
+
+
+def _diff_files(base_ref: str, roots: list[str]) -> frozenset[str]:
+    """Changed *.py files since base_ref, as scan-root-relative posix
+    paths (the same form Finding.path uses)."""
+    first = Path(roots[0]).resolve()
+    repo_dir = first if first.is_dir() else first.parent
+    proc = subprocess.run(
+        ["git", "diff", "--name-only", "-z", base_ref, "--"],
+        cwd=str(repo_dir), capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise BaselineError(
+            f"git diff {base_ref} failed: {proc.stderr.strip()}")
+    top = subprocess.run(
+        ["git", "rev-parse", "--show-toplevel"],
+        cwd=str(repo_dir), capture_output=True, text=True)
+    if top.returncode != 0:
+        raise BaselineError(
+            f"git rev-parse failed: {top.stderr.strip()}")
+    repo_root = Path(top.stdout.strip())
+    rels: set[str] = set()
+    for name in proc.stdout.split("\0"):
+        if not name or not name.endswith(".py"):
+            continue
+        abs_p = (repo_root / name).resolve()
+        for r in roots:
+            root = Path(r).resolve()
+            base = root if root.is_dir() else root.parent
+            try:
+                rels.add(abs_p.relative_to(base).as_posix())
+            except ValueError:
+                continue
+    return frozenset(rels)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -17,13 +63,24 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m ceph_tpu.qa.analyzer",
         description="cephlint: CL1 lock discipline, CL2 shared-state "
                     "races, CL3 JAX tracing hygiene, CL4 failpoint "
-                    "drift, CL5 option drift")
+                    "drift, CL5 option drift, CL6 wire-protocol "
+                    "conformance, CL7 error paths, CL8 kernel "
+                    "shape/dtype dataflow",
+        epilog="exit status: 0 clean; 1 findings (or stale baseline "
+               "entries outside --diff mode); 2 usage/parse errors. "
+               "--diff BASE_REF reports only files changed since "
+               "BASE_REF while still analyzing the whole package.")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/directories to scan (default: the "
                          "ceph_tpu package this analyzer ships in)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
     ap.add_argument("--checks", default=None, metavar="CL1,CL2,...",
                     help="comma-separated subset of checks to run")
+    ap.add_argument("--diff", default=None, metavar="BASE_REF",
+                    help="report only findings on files changed since "
+                         "BASE_REF (for pre-commit; analysis stays "
+                         "whole-package)")
     ap.add_argument("--baseline", default=None, metavar="FILE",
                     help="baseline file (default: auto-discovered "
                          "qa/analyzer/baseline.toml)")
@@ -33,6 +90,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="write the active findings as a pinned baseline "
                          "(edit each reason before committing!)")
     args = ap.parse_args(argv)
+    if args.write_baseline and args.diff is not None:
+        # the baseline pins the WHOLE package's accepted debt; writing
+        # it from a diff-narrowed report would silently drop every
+        # out-of-scope entry
+        ap.error("--write-baseline cannot be combined with --diff")
 
     paths = args.paths or [str(Path(__file__).resolve().parents[2])]
     cfg = Config.discover(paths)
@@ -48,6 +110,8 @@ def main(argv: list[str] | None = None) -> int:
         cfg.checks = checks
 
     try:
+        if args.diff is not None:
+            cfg.diff_files = _diff_files(args.diff, paths)
         report = run(cfg)
     except BaselineError as e:
         print(f"cephlint: error: {e}", file=sys.stderr)
@@ -60,7 +124,18 @@ def main(argv: list[str] | None = None) -> int:
               f"{args.write_baseline}")
         return 0
 
-    out = render(report, args.format)
+    sarif_prefix = ""
+    if args.format == "sarif":
+        # code-scanning resolves URIs against the repo root; rebase the
+        # scan-root-relative paths when the root sits below the cwd
+        import os
+
+        root = Path(paths[0]).resolve()
+        base = root if root.is_dir() else root.parent
+        rel = os.path.relpath(base, Path.cwd())
+        if rel != "." and not rel.startswith(".."):
+            sarif_prefix = rel.replace(os.sep, "/") + "/"
+    out = render(report, args.format, sarif_prefix)
     if out:
         print(out)
     # stale baseline entries fail here too — the same contract as the

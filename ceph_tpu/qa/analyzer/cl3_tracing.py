@@ -116,6 +116,11 @@ def _collect_traced(mod: ModuleInfo) -> list[tuple[ast.FunctionDef, set[str], st
     return out
 
 
+# public alias: CL8's abstract interpreter analyzes the same traced-
+# function population this check discovers
+collect_traced = _collect_traced
+
+
 def check(mods: list[ModuleInfo], sym: SymbolTable, cfg: Config) -> list[Finding]:
     findings: list[Finding] = []
     dirs = set(cfg.cl3_dirs)

@@ -1,0 +1,438 @@
+"""CL6 — wire-protocol conformance for the @register_message family.
+
+The five hand-paired message modules (msg/message.py, mon/messages.py,
+osd/messages.py, fs/messages.py, mgr/messages.py) are the highest-risk
+drift surface in the package: `encode_payload` and `decode_payload` are
+written by hand, twice, and nothing ties them together until a peer
+fails to parse a frame.  Four sub-checks:
+
+- ``encdec-*``      symbolic execution of encode_payload (the ordered
+  ``append_*`` calls on the BufferList parameter) against decode_payload
+  (the ordered ``get_*`` calls on the iterator parameter).  A count
+  mismatch, a width/kind mismatch at position k, or a class defining
+  only half the pair is a wire break the first cross-version peer hits.
+  Non-linear bodies (branches/loops/helper calls) are skipped — the
+  dynamic round-trip test (tests/test_analyzer_proto.py) covers what
+  straight-line symbolic execution can't prove.
+- ``field-loss:*``  an attribute assigned in ``__init__`` that the
+  effective encode path (``self.X`` reads in encode_payload + the FIELDS
+  tuple of JSON-bodied messages) never serializes: the field silently
+  dies on the wire and resurrects as the constructor default.
+- ``field-shadow:*``  a FIELDS entry named after a framing attribute
+  (``seq``/``src``).  send_message stamps both on the instance BEFORE
+  encode_payload runs, so the payload silently carries the connection
+  sequence instead of the protocol value — the bug that killed the MDS
+  cap-revoke staleness gate until the round-trip test caught it.
+- ``dup-type:*``    two registered classes sharing a MSG_TYPE code.
+  register_message raises at import time ONLY if both modules are
+  imported into one process — a client importing mon/messages and a
+  gateway importing osd/messages never see the collision; the analyzer
+  sees every module at once.  ``no-type:*`` flags a registered class
+  that never sets MSG_TYPE (it would shadow the base's 0).
+- ``unhandled:*`` / ``unsent-handler:*``  dispatch reachability: a
+  message type constructed in the package with no ``isinstance`` arm
+  anywhere in a dispatcher's ms_dispatch chain is sent into the void
+  (the messenger drops it after every dispatcher returns False); an
+  isinstance arm for a type nothing constructs is dead protocol.
+
+Width map: append_u8/u16/u32/u64 pair with get_u8/..64; append_str with
+get_str or get_str_bytes (same u32-length framing); raw ``append`` with
+``get_bytes``.  ``append_zero`` pairs with ``get_bytes`` too.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .core import Config, Finding, ModuleInfo
+from .symbols import SymbolTable, call_name
+
+# encode-call name -> wire token; decode-call name -> wire token
+_ENC_TOKENS = {
+    "append_u8": "u8", "append_u16": "u16", "append_u32": "u32",
+    "append_u64": "u64", "append_str": "str", "append": "raw",
+    "append_zero": "raw",
+}
+_DEC_TOKENS = {
+    "get_u8": "u8", "get_u16": "u16", "get_u32": "u32", "get_u64": "u64",
+    "get_str": "str", "get_str_bytes": "str", "get_bytes": "raw",
+}
+# attrs the base Message/framing owns; subclasses never encode them
+_FRAMING_ATTRS = {"seq", "src"}
+_SENDISH = ("send_message", "send_mon", "send_to", "_forward_to_leader")
+
+
+@dataclass
+class MsgClass:
+    name: str
+    module: str
+    path: str
+    line: int
+    node: ast.ClassDef
+    bases: list[str]
+    registered: bool = False
+    msg_type: int | None = None           # own (not inherited) MSG_TYPE
+    fields: tuple[str, ...] | None = None  # own FIELDS tuple
+    encode: ast.FunctionDef | None = None
+    decode: ast.FunctionDef | None = None
+    init: ast.FunctionDef | None = None
+
+
+@dataclass
+class ProtoIndex:
+    classes: dict[str, MsgClass] = field(default_factory=dict)
+    constructed: dict[str, list[tuple[str, int]]] = field(default_factory=dict)
+    handled: dict[str, list[tuple[str, int]]] = field(default_factory=dict)
+    sent: dict[str, list[tuple[str, int]]] = field(default_factory=dict)
+
+
+def _is_register_decorator(dec: ast.expr) -> bool:
+    if isinstance(dec, ast.Name):
+        return dec.id == "register_message"
+    if isinstance(dec, ast.Attribute):
+        return dec.attr == "register_message"
+    if isinstance(dec, ast.Call):
+        return _is_register_decorator(dec.func)
+    return False
+
+
+def _scan_class(mod: ModuleInfo, node: ast.ClassDef) -> MsgClass:
+    bases = []
+    for b in node.bases:
+        if isinstance(b, ast.Name):
+            bases.append(b.id)
+        elif isinstance(b, ast.Attribute):
+            bases.append(b.attr)
+    mc = MsgClass(name=node.name, module=mod.modname, path=mod.rel,
+                  line=node.lineno, node=node, bases=bases,
+                  registered=any(_is_register_decorator(d)
+                                 for d in node.decorator_list))
+    for stmt in node.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            tgt = stmt.targets[0].id
+            if tgt == "MSG_TYPE" and isinstance(stmt.value, ast.Constant) \
+                    and isinstance(stmt.value.value, int):
+                mc.msg_type = stmt.value.value
+            elif tgt == "FIELDS" and isinstance(stmt.value, ast.Tuple):
+                vals = []
+                for e in stmt.value.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        vals.append(e.value)
+                mc.fields = tuple(vals)
+        elif isinstance(stmt, ast.FunctionDef):
+            if stmt.name == "encode_payload":
+                mc.encode = stmt
+            elif stmt.name == "decode_payload":
+                mc.decode = stmt
+            elif stmt.name == "__init__":
+                mc.init = stmt
+    return mc
+
+
+def build_index(mods: list[ModuleInfo]) -> ProtoIndex:
+    idx = ProtoIndex()
+    # pass 1: classes (so pass 2 knows the registered names)
+    for mod in mods:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                mc = _scan_class(mod, node)
+                # keep the first definition; message classes are unique
+                idx.classes.setdefault(mc.name, mc)
+    reg_names = {n for n, mc in idx.classes.items()
+                 if _is_message(idx, mc)}
+    for mod in mods:
+        _scan_usage(idx, mod, reg_names)
+    return idx
+
+
+def _is_message(idx: ProtoIndex, mc: MsgClass) -> bool:
+    """Registered itself, or an ancestor of a registered class — the
+    chain walk below needs base classes like _JsonMessage/Message too."""
+    if mc.registered:
+        return True
+    return any(c.registered and mc.name in _ancestry(idx, c)
+               for c in idx.classes.values())
+
+
+def _ancestry(idx: ProtoIndex, mc: MsgClass, limit: int = 8) -> list[str]:
+    """Base-class name chain (nearest first), package-local names only."""
+    out: list[str] = []
+    cur = mc
+    while limit > 0:
+        limit -= 1
+        nxt = None
+        for b in cur.bases:
+            if b in idx.classes and b not in out and b != mc.name:
+                nxt = idx.classes[b]
+                break
+        if nxt is None:
+            break
+        out.append(nxt.name)
+        cur = nxt
+    return out
+
+
+def _chain(idx: ProtoIndex, mc: MsgClass) -> list[MsgClass]:
+    return [mc] + [idx.classes[n] for n in _ancestry(idx, mc)]
+
+
+def _effective(idx: ProtoIndex, mc: MsgClass, attr: str):
+    for c in _chain(idx, mc):
+        v = getattr(c, attr)
+        if v is not None:
+            return c, v
+    return None, None
+
+
+def _scan_usage(idx: ProtoIndex, mod: ModuleInfo, reg: set[str]) -> None:
+    """Construction sites, isinstance arms, and construction->send flows."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            cn = call_name(node)
+            if cn in reg and not isinstance(node.func, ast.Attribute):
+                # plain Name call = construction (attribute calls are
+                # methods that happen to share a name)
+                idx.constructed.setdefault(cn, []).append(
+                    (mod.rel, node.lineno))
+            if cn == "isinstance" and len(node.args) == 2:
+                t = node.args[1]
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for e in elts:
+                    name = e.id if isinstance(e, ast.Name) else (
+                        e.attr if isinstance(e, ast.Attribute) else None)
+                    if name in reg:
+                        idx.handled.setdefault(name, []).append(
+                            (mod.rel, node.lineno))
+        if isinstance(node, ast.FunctionDef):
+            _scan_send_flow(idx, mod, node, reg)
+
+
+def _scan_send_flow(idx: ProtoIndex, mod: ModuleInfo,
+                    fn: ast.FunctionDef, reg: set[str]) -> None:
+    """Within one function: MFoo(...) passed to a send-ish call directly,
+    or assigned to a name later passed to one (no order sensitivity —
+    good enough for flow in straight-line send helpers)."""
+    assigned: dict[str, str] = {}   # var -> message class
+    returned: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            cn = call_name(node.value)
+            if cn in reg and not isinstance(node.value.func, ast.Attribute):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        assigned[t.id] = cn
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Call):
+            cn = call_name(node.value)
+            if cn in reg and not isinstance(node.value.func, ast.Attribute):
+                returned.add(cn)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        cn = call_name(node)
+        if cn not in _SENDISH:
+            continue
+        for a in node.args:
+            if isinstance(a, ast.Call):
+                acn = call_name(a)
+                if acn in reg and not isinstance(a.func, ast.Attribute):
+                    idx.sent.setdefault(acn, []).append(
+                        (mod.rel, node.lineno))
+            elif isinstance(a, ast.Name) and a.id in assigned:
+                idx.sent.setdefault(assigned[a.id], []).append(
+                    (mod.rel, node.lineno))
+    # a message built and returned from a _handle/_make helper is sent by
+    # the caller; count it as sent rather than chase inter-procedural flow
+    for cn in returned:
+        idx.sent.setdefault(cn, []).append((mod.rel, fn.lineno))
+
+
+# -- symbolic encode/decode execution ---------------------------------------
+
+def _payload_param(fn: ast.FunctionDef) -> str | None:
+    args = [a.arg for a in fn.args.args]
+    return args[1] if len(args) >= 2 else None
+
+
+def _wire_ops(fn: ast.FunctionDef, tokens: dict[str, str]
+              ) -> tuple[list[tuple[str, int]], bool]:
+    """Ordered (token, line) wire ops on the payload parameter; second
+    element False when the body is non-linear (branch/loop/try or a
+    helper call that receives the payload object) and the sequence is
+    therefore untrustworthy."""
+    param = _payload_param(fn)
+    if param is None:
+        return [], False
+    linear = True
+    ops: list[tuple[str, int]] = []
+
+    def receiver_is_param(call: ast.Call) -> bool:
+        f = call.func
+        return isinstance(f, ast.Attribute) \
+            and isinstance(f.value, ast.Name) and f.value.id == param
+
+    raw: list[tuple[int, int, str]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.For, ast.While, ast.Try,
+                             ast.With, ast.IfExp)):
+            linear = False
+        if isinstance(node, ast.Call):
+            if receiver_is_param(node):
+                cn = call_name(node)
+                if cn in tokens:
+                    raw.append((node.lineno, node.col_offset, tokens[cn]))
+                else:
+                    linear = False  # unknown method on the payload object
+            elif any(isinstance(a, ast.Name) and a.id == param
+                     for a in node.args):
+                linear = False      # payload escapes into a helper
+    # ast.walk is breadth-first; wire order is SOURCE order, so sort by
+    # position (a call nested inside int(...) must not float to the end)
+    ops = [(tok, line) for line, _col, tok in sorted(raw)]
+    return ops, linear
+
+
+def _init_attrs(fn: ast.FunctionDef) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for node in ast.walk(fn):
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                out.setdefault(t.attr, t.lineno)
+    return out
+
+
+def _self_attr_reads(fn: ast.FunctionDef) -> set[str]:
+    return {n.attr for n in ast.walk(fn)
+            if isinstance(n, ast.Attribute)
+            and isinstance(n.value, ast.Name) and n.value.id == "self"}
+
+
+def check(mods: list[ModuleInfo], sym: SymbolTable, cfg: Config) -> list[Finding]:
+    idx = build_index(mods)
+    findings: list[Finding] = []
+    msg_classes = {n: mc for n, mc in idx.classes.items()
+                   if _is_message(idx, mc)}
+    registered = {n: mc for n, mc in msg_classes.items() if mc.registered}
+
+    # -- encode/decode pairing (per defining class, registered or base) ----
+    for name, mc in sorted(msg_classes.items()):
+        if mc.encode is None and mc.decode is None:
+            continue
+        if (mc.encode is None) != (mc.decode is None):
+            half = "encode_payload" if mc.encode is not None \
+                else "decode_payload"
+            fn = mc.encode or mc.decode
+            findings.append(Finding(
+                "CL6", mc.path, fn.lineno, f"encdec-half:{name}",
+                f"{name} defines {half} but not its pair — the inherited "
+                f"half decodes a different wire layout"))
+            continue
+        enc, enc_ok = _wire_ops(mc.encode, _ENC_TOKENS)
+        dec, dec_ok = _wire_ops(mc.decode, _DEC_TOKENS)
+        if not (enc_ok and dec_ok):
+            continue  # non-linear: the dynamic round-trip test owns it
+        if len(enc) != len(dec):
+            findings.append(Finding(
+                "CL6", mc.path, mc.encode.lineno, f"encdec-count:{name}",
+                f"{name}.encode_payload writes {len(enc)} wire field(s) "
+                f"but decode_payload reads {len(dec)} — a peer decoding "
+                f"this frame desyncs"))
+            continue
+        for k, ((et, eline), (dt, _dl)) in enumerate(zip(enc, dec)):
+            if et != dt:
+                findings.append(Finding(
+                    "CL6", mc.path, eline, f"encdec-order:{name}:{k}",
+                    f"{name} wire field {k} encoded as {et} but decoded "
+                    f"as {dt} — order/width mismatch desyncs the frame"))
+                break
+
+    # -- field loss --------------------------------------------------------
+    for name, mc in sorted(registered.items()):
+        init_cls, init = _effective(idx, mc, "init")
+        if init is None or init_cls is None:
+            continue
+        if init_cls.name != name and init_cls.fields is not None:
+            # inherits the FIELDS-driven __init__ (sets exactly FIELDS)
+            continue
+        _fc, fields = _effective(idx, mc, "fields")
+        enc_cls, enc = _effective(idx, mc, "encode")
+        encoded: set[str] = set(fields or ())
+        if enc is not None:
+            encoded |= _self_attr_reads(enc)
+        if enc is None and fields is None:
+            continue  # nothing encodes anything (abstract base)
+        for attr, line in sorted(_init_attrs(init).items()):
+            if attr in _FRAMING_ATTRS or attr.startswith("_"):
+                continue
+            if attr not in encoded:
+                findings.append(Finding(
+                    "CL6", init_cls.path, line, f"field-loss:{name}.{attr}",
+                    f"{name}.__init__ sets self.{attr} but "
+                    f"{enc_cls.name if enc_cls else name}.encode_payload "
+                    f"never serializes it — the field silently resets to "
+                    f"its default across the wire"))
+
+    # -- framing-attr shadowing --------------------------------------------
+    for name, mc in sorted(msg_classes.items()):
+        if mc.fields is None:
+            continue
+        for attr in mc.fields:
+            if attr in _FRAMING_ATTRS:
+                findings.append(Finding(
+                    "CL6", mc.path, mc.line, f"field-shadow:{name}.{attr}",
+                    f"{name}.FIELDS contains {attr!r}, which send_message "
+                    f"stamps with the CONNECTION value before the payload "
+                    f"encodes — the protocol field is silently clobbered "
+                    f"on the wire; rename it"))
+
+    # -- duplicate / missing MSG_TYPE --------------------------------------
+    by_code: dict[int, list[MsgClass]] = {}
+    for name, mc in sorted(registered.items()):
+        code = None
+        for c in _chain(idx, mc):
+            if c.msg_type is not None:
+                code = c.msg_type
+                break
+        if code is None or code == 0:
+            findings.append(Finding(
+                "CL6", mc.path, mc.line, f"no-type:{name}",
+                f"registered message {name} never sets a nonzero MSG_TYPE "
+                f"— it shadows the base type code in the registry"))
+            continue
+        by_code.setdefault(code, []).append(mc)
+    for code, group in sorted(by_code.items()):
+        if len(group) > 1:
+            names = ", ".join(m.name for m in group)
+            for m in group[1:]:
+                findings.append(Finding(
+                    "CL6", m.path, m.line, f"dup-type:{code}",
+                    f"MSG_TYPE {code} registered by multiple classes "
+                    f"({names}) — whichever module imports second raises "
+                    f"(or worse, never co-imports and misdecodes)"))
+
+    # -- dispatch reachability ---------------------------------------------
+    for name, mc in sorted(registered.items()):
+        sent = idx.sent.get(name, [])
+        handled = idx.handled.get(name, [])
+        constructed = idx.constructed.get(name, [])
+        if sent and not handled:
+            path, line = sent[0]
+            findings.append(Finding(
+                "CL6", path, line, f"unhandled:{name}",
+                f"{name} is sent here but no dispatcher's ms_dispatch "
+                f"chain has an isinstance arm for it — the messenger "
+                f"drops it on the floor"))
+        if handled and not constructed:
+            path, line = handled[0]
+            findings.append(Finding(
+                "CL6", path, line, f"unsent-handler:{name}",
+                f"dispatcher handles {name} but nothing in the package "
+                f"constructs one — dead protocol arm (or the sender was "
+                f"lost in a refactor)"))
+    return findings

@@ -1,0 +1,292 @@
+"""CL7 — error-path lint.
+
+Error paths are where distributed-storage bugs hide: the happy path is
+exercised by every test, the except arm only by the failure the thrasher
+(qa/thrasher.py) happens to draw.  Three shapes, each a known rot
+pattern from the failpoint/thrash work:
+
+- ``swallow:*``      a bare ``except:`` / ``except Exception:`` whose
+  body neither re-raises, logs, nor recovers (pure ``pass``/``continue``)
+  — the error vanishes and the daemon limps on in an undefined state.
+  Handlers that DO something (set a fallback, clean up, narrow retry)
+  stay quiet; a deliberate best-effort swallow carries ``# noqa: CL7``
+  with its justification or a baseline entry.
+- ``no-timeout:*``   a blocking wait with no timeout: ``Condition.wait
+  /wait_for`` without a timeout argument (a lost notify parks the thread
+  forever — the reference bounds every sub-op wait, see
+  osd_subop_reply_timeout), ``queue.get()`` with neither timeout nor
+  block=False, and ``sock.recv`` in a class that never arms
+  ``settimeout`` anywhere (an unbounded read off a dead peer).
+- ``reset-race:*``   ``ms_handle_reset`` / ``ms_handle_remote_reset``
+  mutating instance state outside any ``with <lock>:`` block in a class
+  that owns locks.  Reset callbacks run on messenger rx threads
+  concurrently with the dispatch path — every mutation there needs the
+  owning lock (the monitor's _subs_lock pattern).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Config, Finding, ModuleInfo
+from .symbols import ClassInfo, SymbolTable, attr_chain, call_name
+
+_BROAD = {"Exception", "BaseException"}
+_LOGGISH = {"dout", "debug", "info", "warning", "warn", "error",
+            "exception", "critical", "log", "print"}
+_QUEUE_TYPES = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+_MUTATORS = {"pop", "append", "add", "remove", "clear", "update",
+             "discard", "popitem", "extend", "insert", "setdefault"}
+_RESET_METHODS = {"ms_handle_reset", "ms_handle_remote_reset"}
+
+
+def _exc_names(t: ast.expr | None) -> list[str] | None:
+    """Exception-type names of a handler; None for a bare except."""
+    if t is None:
+        return None
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = []
+    for e in elts:
+        if isinstance(e, ast.Name):
+            out.append(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.append(e.attr)
+    return out
+
+
+def _has_raise_or_log(body: list[ast.stmt]) -> bool:
+    for node in ast.walk(ast.Module(body=body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call) and call_name(node) in _LOGGISH:
+            return True
+    return False
+
+
+def _pure_swallow(body: list[ast.stmt]) -> bool:
+    """True when the handler only passes/continues — nothing recovered,
+    nothing recorded."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / Ellipsis
+        return False
+    return True
+
+
+class _FnCtx:
+    """Per-function name environment for the queue/condition resolution."""
+
+    def __init__(self, fn: ast.FunctionDef):
+        self.queueish: set[str] = set()
+        for a in fn.args.args + fn.args.kwonlyargs + fn.args.posonlyargs:
+            ann = a.annotation
+            txt = ""
+            if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                txt = ann.value
+            elif ann is not None:
+                txt = ast.unparse(ann) if hasattr(ast, "unparse") else ""
+            if any(q in txt for q in _QUEUE_TYPES):
+                self.queueish.add(a.arg)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if call_name(node.value) in _QUEUE_TYPES:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.queueish.add(t.id)
+
+
+def check(mods: list[ModuleInfo], sym: SymbolTable, cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in mods:
+        seen: set[str] = set()
+
+        def report(node: ast.AST, ident: str, msg: str) -> None:
+            n, base = 2, ident
+            while ident in seen:
+                ident = f"{base}:{n}"
+                n += 1
+            seen.add(ident)
+            findings.append(Finding(
+                "CL7", mod.rel, getattr(node, "lineno", 1), ident, msg))
+
+        _check_swallows(mod, report)
+        _check_waits(mod, sym, report)
+        _check_reset_handlers(mod, sym, report)
+    return findings
+
+
+# -- swallowed errors --------------------------------------------------------
+
+def _check_swallows(mod: ModuleInfo, report) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        names = _exc_names(node.type)
+        if names is None:
+            if not _has_raise_or_log(node.body):
+                report(node, "swallow:bare",
+                       "bare except: swallows SystemExit/KeyboardInterrupt "
+                       "too — name the exceptions, or re-raise/log")
+            continue
+        broad = [n for n in names if n in _BROAD]
+        if not broad:
+            continue
+        if _has_raise_or_log(node.body) or not _pure_swallow(node.body):
+            continue
+        report(node, f"swallow:{broad[0]}",
+               f"except {broad[0]}: with a pure-pass body hides every "
+               f"failure on this path — narrow the exception types, log "
+               f"it, or # noqa: CL7 a deliberate best-effort swallow")
+
+
+# -- unbounded blocking waits ------------------------------------------------
+
+def _kw(call: ast.Call, *names: str) -> bool:
+    return any(kw.arg in names for kw in call.keywords)
+
+
+def _enclosing_classes(mod: ModuleInfo) -> list[tuple[ast.ClassDef, ast.FunctionDef]]:
+    out = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            for stmt in node.body:
+                if isinstance(stmt, ast.FunctionDef):
+                    out.append((node, stmt))
+    return out
+
+
+def _class_info(sym: SymbolTable, mod: ModuleInfo,
+                cls: ast.ClassDef) -> ClassInfo | None:
+    return sym.classes.get(f"{mod.modname}.{cls.name}")
+
+
+def _is_condition(recv: ast.expr, ci: ClassInfo | None,
+                  sym: SymbolTable, modname: str) -> bool:
+    """Does this receiver hold a threading.Condition?  family_locks is
+    consulted directly (resolve_lock derefs Condition(self.X) aliases to
+    the underlying lock, which would lose the condition kind)."""
+    ch = attr_chain(recv)
+    if ch and ch[0] == "self" and len(ch[1]) == 1 and ci is not None:
+        li = sym.family_locks(ci).get(ch[1][0])
+        if li is not None:
+            return li.kind == "condition"
+    li = sym.resolve_lock(recv, ci, modname)
+    return li is not None and li.kind == "condition"
+
+
+def _check_waits(mod: ModuleInfo, sym: SymbolTable, report) -> None:
+    settimeout_cache: dict[ast.ClassDef, bool] = {}
+    for cls, fn in _enclosing_classes(mod):
+        ci = _class_info(sym, mod, cls)
+        ctx = _FnCtx(fn)
+        class_src_has_settimeout = settimeout_cache.get(cls)
+        if class_src_has_settimeout is None:
+            class_src_has_settimeout = any(
+                isinstance(n, ast.Call) and call_name(n) == "settimeout"
+                for n in ast.walk(cls))
+            settimeout_cache[cls] = class_src_has_settimeout
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute):
+                continue
+            recv = node.func.value
+            meth = node.func.attr
+            if meth == "wait" and not node.args and not node.keywords:
+                if _is_condition(recv, ci, sym, mod.modname):
+                    report(node, f"no-timeout:{fn.name}:wait",
+                           "Condition.wait() without a timeout — a lost "
+                           "notify parks this thread forever; bound it "
+                           "(see osd_subop_reply_timeout)")
+            elif meth == "wait_for" and len(node.args) == 1 \
+                    and not _kw(node, "timeout"):
+                if _is_condition(recv, ci, sym, mod.modname):
+                    report(node, f"no-timeout:{fn.name}:wait_for",
+                           "Condition.wait_for() without a timeout — a "
+                           "lost notify or stuck predicate parks this "
+                           "thread forever; bound it")
+            elif meth == "get" and not node.args \
+                    and not _kw(node, "timeout", "block"):
+                if _queueish(recv, ctx, sym):
+                    report(node, f"no-timeout:{fn.name}:queue.get",
+                           "queue.get() with neither timeout nor "
+                           "block=False — a producer that dies without "
+                           "its sentinel parks this consumer forever")
+            elif meth == "recv" and not class_src_has_settimeout:
+                ch = attr_chain(recv)
+                leaf = (ch[1][-1] if ch and ch[1] else ch[0] if ch else "")
+                if "sock" in leaf.lower():
+                    report(node, f"no-timeout:{fn.name}:recv",
+                           "socket recv in a class that never calls "
+                           "settimeout — an unbounded read off a dead "
+                           "peer; arm a timeout on the socket")
+
+
+def _queueish(recv: ast.expr, ctx: _FnCtx, sym: SymbolTable) -> bool:
+    if isinstance(recv, ast.Name):
+        return recv.id in ctx.queueish
+    ch = attr_chain(recv)
+    if ch and ch[0] == "self" and len(ch[1]) == 1:
+        return sym.attr_type_index.get(ch[1][0], set()) & _QUEUE_TYPES != set()
+    return False
+
+
+# -- reset handlers mutating without the lock --------------------------------
+
+def _check_reset_handlers(mod: ModuleInfo, sym: SymbolTable, report) -> None:
+    for cls, fn in _enclosing_classes(mod):
+        if fn.name not in _RESET_METHODS:
+            continue
+        ci = _class_info(sym, mod, cls)
+        if ci is None or not sym.family_locks(ci):
+            continue  # no owning lock exists; nothing to hold
+        for stmt in fn.body:
+            _walk_reset(stmt, fn, mod, report, locked=False)
+
+
+def _mutates_self(node: ast.AST) -> str | None:
+    """Attr name when this statement/call mutates instance state."""
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            base = t
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self":
+                return base.attr
+    if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+        call = node.value
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            ch = attr_chain(f.value)
+            if ch and ch[0] == "self" and ch[1]:
+                return ch[1][0]
+    return None
+
+
+def _walk_reset(stmt: ast.stmt, fn: ast.FunctionDef, mod: ModuleInfo,
+                report, locked: bool) -> None:
+    if isinstance(stmt, ast.With):
+        # any with-block counts as "under a lock" — resolving which lock
+        # is CL1's job; CL7 only wants mutations with NO lock at all
+        for s in stmt.body:
+            _walk_reset(s, fn, mod, report, locked=True)
+        return
+    if not locked:
+        attr = _mutates_self(stmt)
+        if attr is not None and not attr.startswith("__"):
+            report(stmt, f"reset-race:{fn.name}:{attr}",
+                   f"{fn.name} mutates self.{attr} outside any lock — "
+                   f"reset callbacks run on messenger rx threads "
+                   f"concurrently with dispatch; hold the owning lock")
+    for child in ast.iter_child_nodes(stmt):
+        if isinstance(child, ast.stmt):
+            _walk_reset(child, fn, mod, report, locked=locked)
+        elif isinstance(child, ast.ExceptHandler):
+            # except arms are not stmts; the error path is exactly where
+            # CL7 wants to look
+            for s in child.body:
+                _walk_reset(s, fn, mod, report, locked=locked)

@@ -13,6 +13,11 @@ and the smatch/cov scripts).  Five whole-package checks:
     CL3  JAX tracing hygiene in ops/, crush/, parallel/, bench/
     CL4  failpoint drift: sites vs KNOWN_FAILPOINTS vs the docs catalogue
     CL5  config-option drift: reads vs common/options.py declarations
+    CL6  wire-protocol conformance: encode_payload/decode_payload pairing,
+         field loss, MSG_TYPE collisions, dispatch reachability
+    CL7  error paths: swallowed exceptions, unbounded blocking waits,
+         reset callbacks mutating shared state without the lock
+    CL8  kernel shape/dtype abstract interpretation in ops/, gf/, crush/
 
 Suppression layers, innermost first:
 
@@ -163,9 +168,12 @@ class Config:
     failpoint_file: Path | None = None
     baseline_file: Path | None = None
     use_baseline: bool = True
-    checks: tuple[str, ...] = ("CL1", "CL2", "CL3", "CL4", "CL5")
+    checks: tuple[str, ...] = ("CL1", "CL2", "CL3", "CL4", "CL5",
+                               "CL6", "CL7", "CL8")
     cl3_dirs: tuple[str, ...] = ("ops", "crush", "parallel", "bench")
     cl1_raw_lock_dirs: tuple[str, ...] = ("osd", "mon", "msg", "store", "client")
+    cl8_dirs: tuple[str, ...] = ("ops", "gf", "crush")
+    diff_files: frozenset[str] | None = None  # --diff: restrict findings
 
     @classmethod
     def discover(cls, roots: list[str | Path]) -> "Config":
@@ -283,7 +291,8 @@ class Report:
 
 def run(cfg: Config) -> Report:
     from .symbols import SymbolTable
-    from . import cl1_locks, cl2_races, cl3_tracing, cl4_failpoints, cl5_options
+    from . import (cl1_locks, cl2_races, cl3_tracing, cl4_failpoints,
+                   cl5_options, cl6_proto, cl7_errors, cl8_shapes)
 
     mods = collect_modules(cfg)
     sym = SymbolTable.build(mods)
@@ -293,6 +302,9 @@ def run(cfg: Config) -> Report:
         "CL3": cl3_tracing.check,
         "CL4": cl4_failpoints.check,
         "CL5": cl5_options.check,
+        "CL6": cl6_proto.check,
+        "CL7": cl7_errors.check,
+        "CL8": cl8_shapes.check,
     }
     raw: list[Finding] = []
     for code in cfg.checks:
@@ -323,12 +335,82 @@ def run(cfg: Config) -> Report:
             report.baselined.append(f)
             continue
         report.findings.append(f)
+    # an entry for a check that didn't run is unjudged, not stale —
+    # --checks CL6 must not condemn the CL5 baseline
     report.stale_baseline = [e for k, e in base_keys.items()
-                             if k not in hit_base]
+                             if k not in hit_base and e["code"] in cfg.checks]
+    if cfg.diff_files is not None:
+        # --diff mode: report only findings on the changed files.  The
+        # ANALYSIS stays whole-package (cross-file checks need the full
+        # symbol table); only the report narrows.  Stale-baseline
+        # warnings are suppressed too — a partial view can't tell paid
+        # debt from out-of-scope debt.
+        keep = cfg.diff_files
+        report.findings = [f for f in report.findings if f.path in keep]
+        report.baselined = [f for f in report.baselined if f.path in keep]
+        report.noqa = [f for f in report.noqa if f.path in keep]
+        report.stale_baseline = []
     return report
 
 
-def render(report: Report, fmt: str = "text") -> str:
+_SARIF_RULES = {
+    "CL1": "lock discipline (order inversions, blocking under a lock, "
+           "raw locks)",
+    "CL2": "unlocked read-modify-writes on shared state",
+    "CL3": "JAX tracing hygiene",
+    "CL4": "failpoint site/catalogue/docs drift",
+    "CL5": "config-option read/declaration drift",
+    "CL6": "wire-protocol conformance (encode/decode pairing, field "
+           "loss, MSG_TYPE collisions, dispatch reachability)",
+    "CL7": "error paths (swallowed exceptions, unbounded waits, "
+           "unlocked reset handlers)",
+    "CL8": "kernel shape/dtype dataflow",
+}
+
+
+def render_sarif(report: Report, uri_prefix: str = "") -> str:
+    """SARIF 2.1.0 for CI annotation (GitHub code scanning et al.).
+
+    `uri_prefix` rebases the scan-root-relative finding paths onto the
+    consumer's root (code-scanning resolves URIs against the REPO root,
+    so a repo-root CLI run passes e.g. ``ceph_tpu/``)."""
+    rules = sorted({f.code for f in report.findings})
+    doc = {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "cephlint",
+                "informationUri":
+                    "docs/static_analysis.md",
+                "rules": [{"id": c,
+                           "shortDescription":
+                               {"text": _SARIF_RULES.get(c, c)}}
+                          for c in rules],
+            }},
+            "results": [{
+                "ruleId": f.code,
+                "level": "error",
+                "message": {"text": f"{f.message}  [{f.ident}]"},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": uri_prefix + f.path},
+                        "region": {"startLine": max(f.line, 1)},
+                    },
+                }],
+                "partialFingerprints": {
+                    "cephlintIdent": f"{f.code}:{f.path}:{f.ident}",
+                },
+            } for f in report.findings],
+        }],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
+def render(report: Report, fmt: str = "text", sarif_prefix: str = "") -> str:
     if fmt == "json":
         return json.dumps(report.to_json(), indent=2, sort_keys=True)
+    if fmt == "sarif":
+        return render_sarif(report, sarif_prefix)
     return report.render_text()

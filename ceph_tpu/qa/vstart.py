@@ -14,6 +14,7 @@ src/vstart.sh; qa/standalone/ceph-helpers.sh `run_mon`/`run_osd`/
 from __future__ import annotations
 
 import socket
+import sys
 import time
 
 from ..common.context import CephContext
@@ -139,51 +140,38 @@ class LocalCluster:
                 return m
         raise RuntimeError("no leader")
 
+    @staticmethod
+    def _stop_quietly(label: str, fn) -> None:
+        """Best-effort teardown: one daemon dying mid-shutdown must not
+        keep the rest of the cluster from stopping — but it must not
+        vanish either (a repeatable shutdown crash is a real bug)."""
+        try:
+            fn()
+        except Exception as e:
+            print(f"# vstart: {label} shutdown raised: {e!r}",
+                  file=sys.stderr)
+
     def stop(self) -> None:
         for d in self._rbd_mirrors:
-            try:
-                d.stop()
-            except Exception:
-                pass
+            self._stop_quietly("rbd-mirror", d.stop)
         for c in self._clients:
-            try:
-                c.shutdown()
-            except Exception:
-                pass
+            self._stop_quietly("client", c.shutdown)
         # gateways and the MDS are RADOS clients: stop them while OSDs are
         # still up so their shutdown I/O can reach the pools
         if self.rgw is not None:
-            try:
-                self.rgw.shutdown()
-            except Exception:
-                pass
+            self._stop_quietly("rgw", self.rgw.shutdown)
         for rank, mds in sorted(getattr(self, "mds_ranks", {}).items()):
             if rank == 0:
                 continue  # rank 0 is self.mds, handled below
-            try:
-                mds.shutdown()
-            except Exception:
-                pass
+            self._stop_quietly(f"mds.{rank}", mds.shutdown)
         if self.mds is not None:
-            try:
-                self.mds.shutdown()
-            except Exception:
-                pass
-        for osd in list(self.osds.values()):
-            try:
-                osd.shutdown()
-            except Exception:
-                pass
+            self._stop_quietly("mds.0", self.mds.shutdown)
+        for i, osd in sorted(self.osds.items()):
+            self._stop_quietly(f"osd.{i}", osd.shutdown)
         if self.mgr is not None:
-            try:
-                self.mgr.shutdown()
-            except Exception:
-                pass
+            self._stop_quietly("mgr", self.mgr.shutdown)
         for mon in self.mons.values():
-            try:
-                mon.shutdown()
-            except Exception:
-                pass
+            self._stop_quietly(f"mon.{mon.name}", mon.shutdown)
         if self.data_dir is not None:
             import shutil
 

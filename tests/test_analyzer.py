@@ -301,6 +301,459 @@ def test_cl5_dynamic_prefix_counts_as_read(tmp_path):
     assert idents(run_on(pkg), "CL5") == set()
 
 
+# -- CL6: wire-protocol conformance ----------------------------------------
+
+CL6_COMMON = '''
+class Message:
+    MSG_TYPE = 0
+    def __init__(self):
+        self.seq = 0
+        self.src = ""
+    def encode_payload(self, bl):
+        pass
+    def decode_payload(self, it):
+        pass
+
+def register_message(cls):
+    return cls
+'''
+
+CL6_TP = CL6_COMMON + '''
+@register_message
+class MBad(Message):
+    MSG_TYPE = 7
+    def __init__(self, a=0, b=""):
+        super().__init__()
+        self.a = a
+        self.b = b
+        self.lost = 1
+    def encode_payload(self, bl):
+        bl.append_u32(self.a)
+        bl.append_str(self.b)
+    def decode_payload(self, it):
+        self.b = it.get_str()
+        self.a = it.get_u32()
+
+@register_message
+class MDup(Message):
+    MSG_TYPE = 7
+    def encode_payload(self, bl):
+        bl.append_u8(1)
+    def decode_payload(self, it):
+        it.get_u8()
+
+@register_message
+class MShort(Message):
+    MSG_TYPE = 8
+    def encode_payload(self, bl):
+        bl.append_u16(1)
+        bl.append_u16(2)
+    def decode_payload(self, it):
+        it.get_u16()
+
+@register_message
+class MHalf(Message):
+    MSG_TYPE = 9
+    def encode_payload(self, bl):
+        bl.append_u8(1)
+
+@register_message
+class MVoid(Message):
+    MSG_TYPE = 10
+
+@register_message
+class MGhost(Message):
+    MSG_TYPE = 11
+'''
+
+CL6_TP_USE = '''
+from ..msg.message import MVoid, MGhost
+
+class D:
+    def poke(self, conn):
+        conn.send_message(MVoid())
+    def ms_dispatch(self, conn, msg):
+        if isinstance(msg, MGhost):
+            return True
+        return False
+'''
+
+CL6_TN = CL6_COMMON + '''
+@register_message
+class MGood(Message):
+    MSG_TYPE = 7
+    def __init__(self, a=0, b=""):
+        super().__init__()
+        self.a = a
+        self.b = b
+    def encode_payload(self, bl):
+        bl.append_u32(self.a)
+        bl.append_str(self.b)
+    def decode_payload(self, it):
+        self.a = it.get_u32()
+        self.b = it.get_str()
+'''
+
+CL6_TN_USE = '''
+from ..msg.message import MGood
+
+class D:
+    def poke(self, conn):
+        conn.send_message(MGood(a=1))
+    def ms_dispatch(self, conn, msg):
+        if isinstance(msg, MGood):
+            return True
+        return False
+'''
+
+
+def test_cl6_true_positive(tmp_path):
+    pkg = make_pkg(tmp_path, {"msg/message.py": CL6_TP,
+                              "osd/daemon.py": CL6_TP_USE})
+    got = idents(run_on(pkg), "CL6")
+    assert "encdec-order:MBad:0" in got, got
+    assert "field-loss:MBad.lost" in got
+    assert "encdec-count:MShort" in got
+    assert "encdec-half:MHalf" in got
+    assert "dup-type:7" in got
+    assert "unhandled:MVoid" in got
+    assert "unsent-handler:MGhost" in got
+
+
+def test_cl6_true_negative(tmp_path):
+    pkg = make_pkg(tmp_path, {"msg/message.py": CL6_TN,
+                              "osd/daemon.py": CL6_TN_USE})
+    assert idents(run_on(pkg), "CL6") == set()
+
+
+def test_cl6_nested_wire_call_keeps_source_order(tmp_path):
+    # a get_* nested inside int(...) must not float out of wire order
+    src = CL6_COMMON + '''
+@register_message
+class MNest(Message):
+    MSG_TYPE = 14
+    def __init__(self, a=0, b=""):
+        super().__init__()
+        self.a = a
+        self.b = b
+    def encode_payload(self, bl):
+        bl.append_u32(self.a)
+        bl.append_str(self.b)
+    def decode_payload(self, it):
+        self.a = int(it.get_u32())
+        self.b = it.get_str()
+'''
+    pkg = make_pkg(tmp_path, {"msg/message.py": src})
+    assert idents(run_on(pkg), "CL6") == set()
+
+
+def test_cl6_field_shadow(tmp_path):
+    # a FIELDS entry named after a framing attr is clobbered at send
+    src = CL6_COMMON + '''
+class _JsonMessage(Message):
+    FIELDS = ()
+
+@register_message
+class MShadow(_JsonMessage):
+    MSG_TYPE = 13
+    FIELDS = ("op", "seq")
+'''
+    pkg = make_pkg(tmp_path, {"msg/message.py": src})
+    got = idents(run_on(pkg), "CL6")
+    assert "field-shadow:MShadow.seq" in got, got
+
+
+def test_cl6_fields_json_style_is_quiet(tmp_path):
+    # FIELDS-driven messages (one JSON str each way) must stay silent
+    src = CL6_COMMON + '''
+import json
+
+class _JsonMessage(Message):
+    FIELDS = ()
+    def __init__(self, **kw):
+        super().__init__()
+        for f in self.FIELDS:
+            setattr(self, f, kw.get(f))
+    def encode_payload(self, bl):
+        bl.append_str(json.dumps({f: getattr(self, f) for f in self.FIELDS}))
+    def decode_payload(self, it):
+        d = json.loads(it.get_str())
+        for f in self.FIELDS:
+            setattr(self, f, d.get(f))
+
+@register_message
+class MJson(_JsonMessage):
+    MSG_TYPE = 12
+    FIELDS = ("x", "y")
+'''
+    use = ('from ..msg.message import MJson\n'
+           'class D:\n'
+           '    def poke(self, conn):\n'
+           '        conn.send_message(MJson(x=1))\n'
+           '    def ms_dispatch(self, conn, msg):\n'
+           '        return isinstance(msg, MJson)\n')
+    pkg = make_pkg(tmp_path, {"msg/message.py": src, "osd/daemon.py": use})
+    assert idents(run_on(pkg), "CL6") == set()
+
+
+# -- CL7: error paths -------------------------------------------------------
+
+CL7_TP = '''
+import queue
+import threading
+from ceph_tpu.common.lockdep import make_lock
+
+
+class E:
+    def __init__(self):
+        self._lock = make_lock("fix::e")
+        self._cond = threading.Condition(self._lock)
+        self._q = queue.Queue()
+        self.count = 0
+        self._sock = None
+
+    def swallow(self):
+        try:
+            self.count += 1
+        except Exception:
+            pass
+
+    def bare(self):
+        try:
+            self.count += 1
+        except:
+            pass
+
+    def stuck(self):
+        with self._cond:
+            self._cond.wait()
+
+    def stuck_for(self):
+        with self._cond:
+            self._cond.wait_for(lambda: self.count)
+
+    def drain(self):
+        return self._q.get()
+
+    def read(self):
+        return self._sock.recv(1)
+
+    def ms_handle_reset(self, conn):
+        self.count = 0
+'''
+
+CL7_TN = '''
+import queue
+import threading
+from ceph_tpu.common.lockdep import make_lock
+
+
+class E:
+    def __init__(self):
+        self._lock = make_lock("fix::e")
+        self._cond = threading.Condition(self._lock)
+        self._q = queue.Queue()
+        self._sock = None
+        self.count = 0
+
+    def narrow(self):
+        try:
+            self.count += 1
+        except (OSError, ConnectionError):
+            pass
+
+    def logged(self, log):
+        try:
+            self.count += 1
+        except Exception as e:
+            log.error(f"failed: {e!r}")
+
+    def recovered(self):
+        try:
+            self.count += 1
+        except Exception:
+            self.count = 0
+
+    def bounded(self):
+        with self._cond:
+            self._cond.wait(1.0)
+            self._cond.wait_for(lambda: self.count, timeout=2.0)
+
+    def drain(self):
+        return self._q.get(timeout=5.0)
+
+    def read(self):
+        self._sock.settimeout(5.0)
+        return self._sock.recv(1)
+
+    def ms_handle_reset(self, conn):
+        with self._lock:
+            self.count = 0
+'''
+
+
+def test_cl7_true_positive(tmp_path):
+    pkg = make_pkg(tmp_path, {"osd/err.py": CL7_TP})
+    got = idents(run_on(pkg), "CL7")
+    assert "swallow:Exception" in got, got
+    assert "swallow:bare" in got
+    assert "no-timeout:stuck:wait" in got
+    assert "no-timeout:stuck_for:wait_for" in got
+    assert "no-timeout:drain:queue.get" in got
+    assert "no-timeout:read:recv" in got
+    assert "reset-race:ms_handle_reset:count" in got
+
+
+def test_cl7_true_negative(tmp_path):
+    pkg = make_pkg(tmp_path, {"osd/err.py": CL7_TN})
+    assert idents(run_on(pkg), "CL7") == set()
+
+
+def test_cl7_reset_race_in_except_arm(tmp_path):
+    # the error path of the reset handler is still the reset handler
+    src = '''
+from ceph_tpu.common.lockdep import make_lock
+
+class E:
+    def __init__(self):
+        self._lock = make_lock("fix::e")
+        self.count = 0
+
+    def ms_handle_reset(self, conn):
+        try:
+            with self._lock:
+                self.count = 1
+        except Exception:
+            self.count = 0
+'''
+    pkg = make_pkg(tmp_path, {"osd/err.py": src})
+    got = idents(run_on(pkg), "CL7")
+    assert "reset-race:ms_handle_reset:count" in got, got
+
+
+# -- CL8: kernel shape/dtype dataflow ---------------------------------------
+
+CL8_TP = '''
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_matmul():
+    a = jnp.zeros((8, 16), jnp.uint8)
+    b = jnp.zeros((8, 4), jnp.uint8)
+    return a @ b
+
+
+@jax.jit
+def bad_broadcast():
+    a = jnp.zeros((8, 16), jnp.int32)
+    b = jnp.zeros((8, 5), jnp.int32)
+    return a + b
+
+
+@jax.jit
+def bad_promote():
+    a = jnp.zeros((8,), jnp.uint8)
+    b = jnp.ones((8,), jnp.float32)
+    return a * b
+
+
+@jax.jit
+def bad_div():
+    a = jnp.zeros((8,), jnp.int32)
+    return a / 2
+
+
+@jax.jit
+def bad_reshape():
+    a = jnp.zeros((8, 16), jnp.uint8)
+    return a.reshape(4, 16)
+
+
+@jax.jit
+def bad_trip(x):
+    return jax.device_get(x)
+'''
+
+CL8_TN = '''
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def good(x):
+    a = jnp.zeros((8, 16), jnp.uint8)
+    b = jnp.zeros((16, 4), jnp.uint8)
+    c = (a @ b).astype(jnp.float32)
+    d = c / 2.0
+    e = a.reshape(4, 32) + jnp.ones((4, 32), jnp.uint8)
+    return d, e
+'''
+
+
+def test_cl8_true_positive(tmp_path):
+    pkg = make_pkg(tmp_path, {"ops/kern.py": CL8_TP})
+    got = idents(run_on(pkg), "CL8")
+    assert "bad_matmul:matmul" in got, got
+    assert "bad_broadcast:broadcast" in got
+    assert "bad_promote:promote" in got
+    assert "bad_div:int-div" in got
+    assert "bad_reshape:reshape" in got
+    assert "bad_trip:host-trip" in got
+
+
+def test_cl8_true_negative(tmp_path):
+    pkg = make_pkg(tmp_path, {"ops/kern.py": CL8_TN})
+    assert idents(run_on(pkg), "CL8") == set()
+
+
+def test_cl8_unknown_side_division_is_quiet(tmp_path):
+    # a parameter has no provable dtype: it could be float, where / is
+    # already correct — CL8 only speaks when the int domain is proven
+    src = '''
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def f(x):
+    d = jnp.zeros((8,), jnp.int32)
+    return x / d
+'''
+    pkg = make_pkg(tmp_path, {"ops/kern.py": src})
+    assert idents(run_on(pkg), "CL8") == set()
+
+
+def test_cl8_module_level_reshape_checked(tmp_path):
+    # jnp.reshape(a, shape) spells the same bug as a.reshape(shape)
+    src = '''
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def f():
+    a = jnp.zeros((8, 16), jnp.uint8)
+    return jnp.reshape(a, (4, 16))
+'''
+    pkg = make_pkg(tmp_path, {"ops/kern.py": src})
+    assert idents(run_on(pkg), "CL8") == {"f:reshape"}
+
+
+def test_cl8_only_in_kernel_dirs(tmp_path):
+    # the same shape bug outside ops/gf/crush is not CL8's business
+    pkg = make_pkg(tmp_path, {"osd/kern.py": CL8_TP})
+    assert idents(run_on(pkg), "CL8") == set()
+
+
+def test_cl8_untraced_function_is_quiet(tmp_path):
+    # host-side helper (no @jax.jit): shapes are its own problem
+    src = CL8_TP.replace("@jax.jit\n", "")
+    pkg = make_pkg(tmp_path, {"ops/kern.py": src})
+    assert idents(run_on(pkg), "CL8") == set()
+
+
 # -- suppression layers -----------------------------------------------------
 
 def test_noqa_suppresses_and_is_counted(tmp_path):
@@ -400,15 +853,104 @@ def test_cli_checks_subset(tmp_path):
     assert analyzer_main([str(pkg), "--checks", "CL1"]) == 0
 
 
+def test_checks_subset_spares_other_checks_baseline(tmp_path):
+    # a baseline entry for a check that didn't run is unjudged, not
+    # stale: --checks CL1 must not condemn a CL2 baseline entry
+    pkg = make_pkg(tmp_path, {"osd/counter.py": CL2_SRC})
+    base = pkg / "qa" / "analyzer" / "baseline.toml"
+    base.parent.mkdir(parents=True)
+    base.write_text(
+        '[[suppress]]\ncode = "CL2"\npath = "osd/counter.py"\n'
+        'ident = "Counter.bump:count"\nreason = "fixture"\n')
+    assert analyzer_main([str(pkg), "--checks", "CL1"]) == 0
+    assert analyzer_main([str(pkg)]) == 0  # full run: entry still live
+
+
+def test_cli_sarif_format(tmp_path, capsys):
+    import json
+
+    pkg = make_pkg(tmp_path, {"osd/counter.py": CL2_SRC})
+    assert analyzer_main([str(pkg), "--format=sarif"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    run0 = doc["runs"][0]
+    assert run0["tool"]["driver"]["name"] == "cephlint"
+    res = run0["results"]
+    assert res and res[0]["ruleId"] == "CL2"
+    loc = res[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "osd/counter.py"
+    assert loc["region"]["startLine"] > 0
+    # rule ids referenced by results are declared
+    rule_ids = {r["id"] for r in run0["tool"]["driver"]["rules"]}
+    assert {r["ruleId"] for r in res} <= rule_ids
+
+
+def test_cli_diff_mode(tmp_path, capsys):
+    """--diff BASE_REF narrows the report to changed files while the
+    analysis stays whole-package."""
+    import subprocess
+
+    pkg = make_pkg(tmp_path, {"osd/counter.py": CL2_SRC,
+                              "osd/other.py": CL2_SRC.replace(
+                                  "Counter", "Other")})
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       capture_output=True,
+                       env={"GIT_AUTHOR_NAME": "t",
+                            "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t",
+                            "HOME": str(tmp_path),
+                            "PATH": "/usr/bin:/bin:/usr/local/bin"})
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "base")
+    # change only counter.py after the base commit
+    (pkg / "osd" / "counter.py").write_text(CL2_SRC + "\n# touched\n")
+
+    assert analyzer_main([str(pkg), "--diff", "HEAD"]) == 1
+    out = capsys.readouterr().out
+    assert "osd/counter.py" in out
+    assert "osd/other.py" not in out  # finding exists but is out of scope
+
+    # a diff touching nothing reports clean even though findings exist
+    git("add", "-A")
+    git("commit", "-qm", "second")
+    assert analyzer_main([str(pkg), "--diff", "HEAD"]) == 0
+
+    # a bad ref is a usage error (exit 2), not a crash
+    assert analyzer_main([str(pkg), "--diff", "no-such-ref"]) == 2
+    capsys.readouterr()
+
+    # writing a baseline from a diff-narrowed view would silently drop
+    # out-of-scope entries — refused outright
+    with pytest.raises(SystemExit):
+        analyzer_main([str(pkg), "--diff", "HEAD",
+                       "--write-baseline", str(tmp_path / "b.toml")])
+    capsys.readouterr()
+
+
 # -- the tier-1 gate --------------------------------------------------------
+
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _package_scan():
+    """One whole-package run shared by the gate tests (the scan is the
+    expensive part; the assertions differ)."""
+    cfg = Config.discover([str(REPO / "ceph_tpu")])
+    return cfg, run(cfg)
+
 
 def test_package_analyzer_clean():
     """`python -m ceph_tpu.qa.analyzer ceph_tpu/` exits 0: zero active
     findings over the whole package.  New findings mean: fix the code,
     add a justified # noqa, or baseline with a reason — see
     docs/static_analysis.md."""
-    cfg = Config.discover([str(REPO / "ceph_tpu")])
-    report = run(cfg)
+    _cfg, report = _package_scan()
     assert report.clean, "\n" + report.render_text()
     # baseline hygiene rides the same gate: a stale entry means the debt
     # was paid — delete the entry
@@ -416,10 +958,10 @@ def test_package_analyzer_clean():
 
 
 def test_package_gate_matches_cli():
-    cfg = Config.discover([str(REPO / "ceph_tpu")])
-    report = run(cfg)
+    cfg, report = _package_scan()
     # each check ran (the gate isn't green because checks were skipped)
-    assert set(cfg.checks) == {"CL1", "CL2", "CL3", "CL4", "CL5"}
+    assert set(cfg.checks) == {"CL1", "CL2", "CL3", "CL4",
+                               "CL5", "CL6", "CL7", "CL8"}
     assert cfg.options_file is not None
     assert cfg.failpoint_file is not None
     assert cfg.docs_fault_injection is not None

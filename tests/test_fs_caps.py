@@ -148,7 +148,7 @@ def test_stale_seq_flush_cannot_clobber_regrant(cluster):
         # the current grant is at seq N; craft a flush acking seq N-1
         ent["seq"] = ent.get("seq", 0) + 2
         stale = MClientCaps(op="flush", client=sess, ino=fh.ino,
-                            caps="", seq=ent["seq"] - 1,
+                            caps="", cap_seq=ent["seq"] - 1,
                             attrs={"size": 9, "mtime": 123.0})
         assert mds.ms_dispatch(None, stale)
         # downgrade ignored: the writer keeps w and stays registered
@@ -157,7 +157,7 @@ def test_stale_seq_flush_cannot_clobber_regrant(cluster):
         assert mds._inode_of(fh.ino)["size"] == 9
         # a CURRENT-seq flush still downgrades normally
         fresh = MClientCaps(op="flush", client=sess, ino=fh.ino,
-                            caps="", seq=ent["seq"], attrs=None)
+                            caps="", cap_seq=ent["seq"], attrs=None)
         assert mds.ms_dispatch(None, fresh)
         assert mds.caps[fh.ino][sess]["caps"] == ""
         fs._caps_state.pop(fh.ino, None)  # drop client-side buffer state
