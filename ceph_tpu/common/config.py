@@ -13,8 +13,9 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass, field
-from threading import RLock
 from typing import Any, Callable
+
+from .lockdep import make_lock
 
 # Source levels, low to high precedence (reference: config layering §5.6).
 LEVEL_DEFAULT = 0
@@ -117,7 +118,7 @@ class Config:
         self._table = table
         self._values: dict[str, _Value] = {}
         self._observers: list[tuple[tuple[str, ...], Callable[[str, Any], None]]] = []
-        self._lock = RLock()
+        self._lock = make_lock("config::values")
         if values:
             for k, v in values.items():
                 self.set(k, v, level=LEVEL_OVERRIDE)

@@ -52,6 +52,8 @@ import random
 import threading
 import time
 
+from .lockdep import make_lock
+
 
 class FailpointError(Exception):
     """Default exception an ``error`` action raises at a failpoint site."""
@@ -296,7 +298,7 @@ class FailpointRegistry:
     RNG live behind one lock; effects (sleep/raise) run outside it."""
 
     def __init__(self, seed: int | None = None):
-        self._lock = threading.RLock()
+        self._lock = make_lock("failpoint::registry")
         self._entries: dict[str, list[_Entry]] = {}
         self._rng = random.Random(seed)
         self._next_id = 1

@@ -11,7 +11,7 @@ from __future__ import annotations
 import os
 import time
 from dataclasses import dataclass, field
-from threading import Lock
+from .lockdep import LockdepLock, make_lock
 
 
 @dataclass
@@ -40,7 +40,8 @@ class SuicideTimeout(SystemExit):
 @dataclass
 class HeartbeatMap:
     _workers: list[Handle] = field(default_factory=list)
-    _lock: Lock = field(default_factory=Lock)
+    _lock: LockdepLock = field(
+        default_factory=lambda: make_lock("heartbeat::map"))
     # test seam: by default a suicide raises; daemons may install os.abort
     on_suicide: object = None
 

@@ -25,6 +25,17 @@ _graph_lock = threading.Lock()
 _order: dict[str, set[str]] = {}
 _held = threading.local()
 
+# cephrace seam (qa/race/runtime.py): when a race session is active its
+# runtime is installed here and every LockdepLock acquire/release (and
+# the Condition save/restore protocol) reports in.  None (the default)
+# costs one global load + is-None test per operation.
+_race_hooks = None
+
+
+def set_race_hooks(hooks) -> None:
+    global _race_hooks
+    _race_hooks = hooks
+
 
 class LockOrderViolation(RuntimeError):
     pass
@@ -106,17 +117,34 @@ class LockdepLock:
 
     def __init__(self, name: str):
         self.name = name
-        self._lock = threading.RLock()
+        # the one legitimately raw lock in the tree: this IS the
+        # primitive make_lock wraps
+        self._lock = threading.RLock()  # noqa: CL1
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        h = _race_hooks
+        if h is not None:
+            # may raise DeadlockError on a cycle — but only for an
+            # UNBOUNDED acquire; try-locks and timed acquires resolve on
+            # their own and must not crash (MonClient.ensure_connection's
+            # blocking=False probe exists precisely to never stall)
+            h.before_acquire(self, blocking and timeout < 0)
         if _enabled:
             _on_acquire(self.name)
         got = self._lock.acquire(blocking, timeout)
         if not got and _enabled:
             _on_release(self.name)
+        if h is not None:
+            if got:
+                h.after_acquire(self)
+            else:
+                h.acquire_failed(self)
         return got
 
     def release(self) -> None:
+        h = _race_hooks
+        if h is not None:
+            h.before_release(self)
         self._lock.release()
         if _enabled:
             _on_release(self.name)
@@ -145,6 +173,9 @@ class LockdepLock:
             while self.name in stack:
                 stack.remove(self.name)
                 depth += 1
+        h = _race_hooks
+        if h is not None:
+            h.cond_release_save(self)
         return (state, depth)
 
     def _acquire_restore(self, saved) -> None:
@@ -152,6 +183,9 @@ class LockdepLock:
         self._lock._acquire_restore(state)
         if _enabled and depth:
             _holding().extend([self.name] * depth)
+        h = _race_hooks
+        if h is not None:
+            h.cond_acquire_restore(self)
 
 
 def make_lock(name: str) -> LockdepLock:

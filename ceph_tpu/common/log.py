@@ -14,7 +14,8 @@ import time
 import traceback
 from collections import deque
 from dataclasses import dataclass
-from threading import Lock
+
+from .lockdep import make_lock
 
 
 @dataclass(frozen=True)
@@ -36,7 +37,7 @@ class Log:
     def __init__(self, config=None, ring_size: int = 10000):
         self._config = config
         self._ring: deque[Entry] = deque(maxlen=ring_size)
-        self._lock = Lock()
+        self._lock = make_lock("log::ring")
         self._stderr = bool(config and config.get("log_to_stderr"))
         if config is not None:
             names = [

@@ -11,7 +11,7 @@ averages between two dumps.
 from __future__ import annotations
 
 import time
-from threading import Lock
+from .lockdep import make_lock
 
 TYPE_U64 = "u64"  # monotonically increasing counter
 TYPE_GAUGE = "gauge"  # settable value
@@ -37,7 +37,7 @@ class PerfCounters:
     def __init__(self, name: str):
         self.name = name
         self._counters: dict[str, _Counter] = {}
-        self._lock = Lock()
+        self._lock = make_lock("perf::counters")
 
     def _add(self, name: str, ctype: str, doc: str) -> None:
         if name in self._counters:
@@ -152,7 +152,7 @@ class PerfCountersCollection:
 
     def __init__(self):
         self._loggers: dict[str, PerfCounters] = {}
-        self._lock = Lock()
+        self._lock = make_lock("perf::collection")
 
     def add(self, pc: PerfCounters) -> PerfCounters:
         with self._lock:

@@ -8,7 +8,9 @@ reference's cond-per-waiter list is; `get_or_fail` never blocks.
 from __future__ import annotations
 
 from collections import deque
-from threading import Condition, Lock
+from threading import Condition
+
+from .lockdep import make_lock
 
 
 class Throttle:
@@ -16,7 +18,7 @@ class Throttle:
         self.name = name
         self._max = max_count
         self._count = 0
-        self._lock = Lock()
+        self._lock = make_lock("throttle::budget")
         self._cond = Condition(self._lock)
         self._waitq: deque[object] = deque()  # FIFO ticket queue
 

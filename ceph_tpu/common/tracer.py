@@ -19,6 +19,8 @@ from __future__ import annotations
 import os
 import threading
 import time
+
+from .lockdep import make_lock
 from contextlib import contextmanager
 
 _MAX_EVENTS = 10_000
@@ -28,7 +30,7 @@ class Tracer:
     def __init__(self):
         self.enabled = False
         self._events: list[tuple] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("tracer::ring")
 
     def enable(self, on: bool = True) -> None:
         self.enabled = on

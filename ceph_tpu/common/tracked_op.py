@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from threading import Lock
+from .lockdep import make_lock
 
 
 class TrackedOp:
@@ -21,7 +21,7 @@ class TrackedOp:
         self.desc = desc
         self.initiated_at = time.time()
         self.events: list[tuple[float, str]] = [(self.initiated_at, "initiated")]
-        self._lock = Lock()
+        self._lock = make_lock("optracker::op")
 
     def mark_event(self, name: str) -> None:
         with self._lock:
@@ -63,7 +63,7 @@ class OpTracker:
     def __init__(self, history_size: int = 20, complaint_time: float = 30.0):
         self._inflight: dict[int, TrackedOp] = {}
         self._history: deque[TrackedOp] = deque(maxlen=history_size)
-        self._lock = Lock()
+        self._lock = make_lock("optracker::tracker")
         self.complaint_time = complaint_time
 
     def create(self, desc: str) -> TrackedOp:

@@ -48,9 +48,20 @@ def _load_state(data_dir: str) -> dict | None:
 
 
 def _alive(pid: int) -> bool:
+    # When bootstrap ran IN-PROCESS (the test harness calls main() as a
+    # function), the detached host is a child of THIS process: once it
+    # exits it lingers as a zombie that still answers kill(0), and
+    # rm-cluster would burn its whole 15 s deadline "waiting" for a
+    # corpse.  Reap it if it is ours, then check /proc for the Z state
+    # in case someone else holds the wait.
+    try:
+        done, _status = os.waitpid(pid, os.WNOHANG)
+        if done == pid:
+            return False
+    except (ChildProcessError, OSError):
+        pass   # not our child (the normal CLI case)
     try:
         os.kill(pid, 0)
-        return True
     except ProcessLookupError:
         return False
     except PermissionError:
@@ -58,6 +69,13 @@ def _alive(pid: int) -> bool:
         # alive; treating it as dead would let rm-cluster rmtree the data
         # dir out from under a running process
         return True
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            if f.read().rsplit(")", 1)[-1].split()[0] == "Z":
+                return False   # zombie: exited, just unreaped
+    except OSError:
+        pass   # no /proc (non-linux): fall through to "alive"
+    return True
 
 
 def cmd_bootstrap(args, out) -> int:

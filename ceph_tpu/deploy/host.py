@@ -13,6 +13,7 @@ Reads DIR/spec.json, builds the cluster, writes DIR/cluster.json
 from __future__ import annotations
 
 import argparse
+import faulthandler
 import json
 import os
 import signal
@@ -24,6 +25,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--data-dir", required=True)
     args = ap.parse_args(argv)
+
+    # SIGUSR1 -> all-thread stack dump on stderr (the host.log): a host
+    # that won't die under SIGTERM can be diagnosed without a debugger
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
 
     with open(os.path.join(args.data_dir, "spec.json")) as f:
         spec = json.load(f)

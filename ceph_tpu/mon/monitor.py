@@ -249,14 +249,19 @@ class Monitor(Dispatcher):
         # "mon.tick": delay simulates a stalled mon (missed lease-probe
         # windows); error skips the tick via _tick_loop's handler
         failpoint("mon.tick", cct=self.cct, entity=f"mon.{self.name}")
-        if self.is_leader():
+        # one consistent snapshot under mon::state — the tick thread
+        # racing election-outcome writes read state/leader_rank unlocked
+        # (cephrace CR1 Monitor.leader_rank)
+        with self._state_lock:
+            state, leader_rank = self.state, self.leader_rank
+        if state == STATE_LEADER:
             self.osdmon.tick()
-        elif self.state == STATE_PEON and self.leader_rank is not None:
+        elif state == STATE_PEON and leader_rank is not None:
             # leader liveness probe: a dead leader triggers re-election
             # (reference: peons' lease timeout; SURVEY.md §5.3)
             try:
                 conn = self.messenger.connect(
-                    self.monmap.addr_of(self.leader_rank)
+                    self.monmap.addr_of(leader_rank)
                 )
                 conn.send_message(MPing("leader-probe"))
             except (OSError, ConnectionError):
@@ -276,7 +281,11 @@ class Monitor(Dispatcher):
         return self.monmap.rank_of(entity_name[4:])
 
     def set_electing(self) -> None:
-        self.state = STATE_ELECTING
+        # every other state write serializes under mon::state; this one
+        # ran bare (under only the elector's lock) until cephrace caught
+        # it racing an is_leader probe
+        with self._state_lock:
+            self.state = STATE_ELECTING
 
     def win_election(self, epoch: int, quorum: list[int]) -> None:
         with self._state_lock:
@@ -317,7 +326,11 @@ class Monitor(Dispatcher):
             self.quorum = quorum
 
     def is_leader(self) -> bool:
-        return self.state == STATE_LEADER
+        # under mon::state: election outcomes and shutdown write state
+        # under this lock, and an unlocked probe here was the first race
+        # cephrace caught in a live run (CR1 Monitor.state)
+        with self._state_lock:
+            return self.state == STATE_LEADER
 
     def send_mon(self, rank: int, msg) -> None:
         """Queue a message to a peer mon; safe to call while holding any
@@ -413,10 +426,12 @@ class Monitor(Dispatcher):
         forward_request_leader).  Payload fields carry everything the
         OSDMonitor needs (incl. MOSDFailure.reporter, pinned above), so a
         fresh message with copied fields is a faithful forward."""
-        if self.leader_rank is None or self.leader_rank == self.rank:
+        with self._state_lock:
+            leader = self.leader_rank
+        if leader is None or leader == self.rank:
             return
         fresh = type(msg)(**{f: getattr(msg, f) for f in msg.FIELDS})
-        self.send_mon(self.leader_rank, fresh)
+        self.send_mon(leader, fresh)
 
     def ms_handle_reset(self, conn) -> None:
         with self._subs_lock:

@@ -318,7 +318,10 @@ class Messenger:
         # (peer_name, connect_id) -> _Session surviving reconnects
         self._sessions: dict[tuple[str, int], _Session] = {}
         self._lock = make_lock("msgr::messenger")
-        self._stopped = False
+        # stop flag as an Event: a plain bool here is a write/read race
+        # between shutdown() and the accept/rx loops (cephrace CR1); the
+        # Event is the same idiom Monitor uses for its stop flag
+        self._stop_event = threading.Event()
         # cephx-style mutual auth (reference: ProtocolV2 auth frames);
         # engine built lazily from config so tests can flip it per-context
         self._auth = None
@@ -364,18 +367,25 @@ class Messenger:
         credentials live in cct.tickets), and on a cephx-required ACCEPTOR
         means misconfiguration (every peer is rejected: only secret
         holders can validate anything — fail closed)."""
-        if not self._auth_checked:
-            if self._auth_required() and self.cct.conf.get("auth_shared_secret"):
-                from ..auth import CephxAuthenticator
+        # fully under the messenger lock: concurrent handshake threads
+        # racing the lazy init was a write/read race on _auth_checked
+        # (cephrace CR1); handshakes are rare enough that a fast path
+        # is not worth the unsynchronized read
+        with self._lock:
+            if not self._auth_checked:
+                if self._auth_required() \
+                        and self.cct.conf.get("auth_shared_secret"):
+                    from ..auth import CephxAuthenticator
 
-                # construct BEFORE marking checked: a bad secret must stay
-                # a loud failure on every connection (fail closed), never
-                # silently disable auth on a cephx-required messenger
-                self._auth = CephxAuthenticator(
-                    self.cct.conf.get("auth_shared_secret")
-                )
-            self._auth_checked = True
-        return self._auth
+                    # construct BEFORE marking checked: a bad secret must
+                    # stay a loud failure on every connection (fail
+                    # closed), never silently disable auth on a
+                    # cephx-required messenger
+                    self._auth = CephxAuthenticator(
+                        self.cct.conf.get("auth_shared_secret")
+                    )
+                self._auth_checked = True
+            return self._auth
 
     @property
     def auth_service(self) -> str:
@@ -430,8 +440,12 @@ class Messenger:
             )
             self._accept_thread.start()
 
+    @property
+    def _stopped(self) -> bool:
+        return self._stop_event.is_set()
+
     def shutdown(self) -> None:
-        self._stopped = True
+        self._stop_event.set()
         # take the listener under the lock (two shutdown() racers would
         # double-close), tear it down after release
         with self._lock:
@@ -560,13 +574,18 @@ class Messenger:
     # -- incoming ---------------------------------------------------------
     def _accept_loop(self) -> None:
         while not self._stopped:
-            listener = self._listener
+            # snapshot under the lock (shutdown() swaps it to None under
+            # the same lock); accept() itself runs outside the lock
+            with self._lock:
+                listener = self._listener
             if listener is None:
                 return
             try:
                 sock, peer = listener.accept()
             except OSError as e:
-                if self._stopped or self._listener is None:
+                with self._lock:
+                    gone = self._listener is None
+                if self._stopped or gone:
                     return
                 # transient accept failure (ECONNABORTED, EMFILE burst)
                 # must not kill the acceptor

@@ -383,14 +383,19 @@ class OSD(
                         and sum(1 for a in o[2] if a >= 0)
                         >= old_pool.min_size
                     )
-                    pg.past_intervals.add(
-                        first=pg.interval_start or old.epoch,
-                        last=m.epoch - 1,
-                        up=o[0], acting=o[2], primary=o[3],
-                        maybe_went_rw=went_rw,
-                    )
-                    pg.intervals_closed += 1
-                    pg.interval_start = m.epoch
+                    # under pg.lock: recovery's clean-broadcast block
+                    # clears past_intervals under the same lock, and an
+                    # unserialized interleave here could close an
+                    # interval into a history recovery just wiped
+                    with pg.lock:
+                        pg.past_intervals.add(
+                            first=pg.interval_start or old.epoch,
+                            last=m.epoch - 1,
+                            up=o[0], acting=o[2], primary=o[3],
+                            maybe_went_rw=went_rw,
+                        )
+                        pg.intervals_closed += 1
+                        pg.interval_start = m.epoch
                     self._save_intervals(pg)
         if (old is None or old.max_pool_id != m.max_pool_id
                 or set(old.pools) - set(m.pools)):
@@ -553,10 +558,16 @@ class OSD(
                 # stash under the would-be-primary shard so the history
                 # survives a restart
                 pg.meta_cids = {self._cid(pg.pgid, 0)}
-        keys = {
-            "past_intervals": pg.past_intervals.to_bytes(),
-            "last_epoch": str(pg.last_map_epoch).encode(),
-        }
+        # snapshot the two fields under pg.lock: the map thread and
+        # recovery's clean-broadcast both mutate them under that lock,
+        # and serializing the WRITERS is worthless if this reader can
+        # still persist half of one writer's update.  The store txn
+        # below stays outside the lock.
+        with pg.lock:
+            keys = {
+                "past_intervals": pg.past_intervals.to_bytes(),
+                "last_epoch": str(pg.last_map_epoch).encode(),
+            }
         for cid in pg.meta_cids:
             t = Transaction()
             t.try_create_collection(cid)
@@ -763,14 +774,22 @@ class OSD(
                     last_mgr = now
                     self._mgr_report()
                 # recovery rides the mClock queue as background work so
-                # client ops keep their reservation during big recoveries
-                if not self._recovery_inflight:
-                    self._recovery_inflight = True
+                # client ops keep their reservation during big recoveries.
+                # test-and-set under the daemon lock: the worker's reset
+                # races an unlocked check (cephrace CR1), and a lost
+                # update here double-books the single recovery slot
+                with self._lock:
+                    start_recovery = not self._recovery_inflight
+                    if start_recovery:
+                        self._recovery_inflight = True
+                    start_split = not self._split_inflight
+                    if start_split:
+                        self._split_inflight = True
+                if start_recovery:
                     self.scheduler.enqueue(
                         "background_recovery", self._recover_all_work
                     )
-                if not self._split_inflight:
-                    self._split_inflight = True
+                if start_split:
                     self.scheduler.enqueue(
                         "background_recovery", self._split_pass_work
                     )
@@ -782,5 +801,6 @@ class OSD(
         try:
             self._recover_all()
         finally:
-            self._recovery_inflight = False
+            with self._lock:
+                self._recovery_inflight = False
 

@@ -394,10 +394,16 @@ class RecoveryMixin:
                  or pg.clean_broadcast_interval != interval_at_entry)
         ):
             epoch = self.my_epoch()
-            pg.past_intervals.clear()
-            pg.last_map_epoch = max(pg.last_map_epoch, epoch)
-            pg.intervals_rebuilt = False
-            pg.clean_broadcast_interval = interval_at_entry
+            # under the pg lock: _log_txn (op worker, holding pg.lock)
+            # writes last_map_epoch concurrently, and this max() is a
+            # read-modify-write (cephrace CR1 write-write).  The store
+            # txn below stays OUTSIDE the lock (blocking under a lock is
+            # CL1's business)
+            with pg.lock:
+                pg.past_intervals.clear()
+                pg.last_map_epoch = max(pg.last_map_epoch, epoch)
+                pg.intervals_rebuilt = False
+                pg.clean_broadcast_interval = interval_at_entry
             self._save_intervals(pg)
             for shard, osd in enumerate(acting):
                 if osd < 0 or osd == self.id or not self.osdmap.is_up(osd):

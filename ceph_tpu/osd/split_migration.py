@@ -26,7 +26,8 @@ class SplitMigrationMixin:
             self._snaptrim_pass()
             self._tier_agent_pass()
         finally:
-            self._split_inflight = False
+            with self._lock:
+                self._split_inflight = False
 
     def _split_pass(self) -> None:
         """Migrate objects stranded in pre-split PGs (reference: PG split —

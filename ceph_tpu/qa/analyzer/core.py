@@ -171,7 +171,8 @@ class Config:
     checks: tuple[str, ...] = ("CL1", "CL2", "CL3", "CL4", "CL5",
                                "CL6", "CL7", "CL8")
     cl3_dirs: tuple[str, ...] = ("ops", "crush", "parallel", "bench")
-    cl1_raw_lock_dirs: tuple[str, ...] = ("osd", "mon", "msg", "store", "client")
+    cl1_raw_lock_dirs: tuple[str, ...] = ("osd", "mon", "msg", "store",
+                                          "client", "common")
     cl8_dirs: tuple[str, ...] = ("ops", "gf", "crush")
     diff_files: frozenset[str] | None = None  # --diff: restrict findings
 
@@ -365,10 +366,16 @@ _SARIF_RULES = {
     "CL7": "error paths (swallowed exceptions, unbounded waits, "
            "unlocked reset handlers)",
     "CL8": "kernel shape/dtype dataflow",
+    # dynamic findings (qa/race — cephrace shares this report machinery)
+    "CR1": "data race (empty lockset + no happens-before edge)",
+    "CR2": "deadlock (waits-for cycle closed at runtime)",
+    "CR3": "lost wakeup (notify with no waiter, later wait timed out)",
 }
 
 
-def render_sarif(report: Report, uri_prefix: str = "") -> str:
+def render_sarif(report: Report, uri_prefix: str = "",
+                 tool: str = "cephlint",
+                 info_uri: str = "docs/static_analysis.md") -> str:
     """SARIF 2.1.0 for CI annotation (GitHub code scanning et al.).
 
     `uri_prefix` rebases the scan-root-relative finding paths onto the
@@ -381,9 +388,8 @@ def render_sarif(report: Report, uri_prefix: str = "") -> str:
         "version": "2.1.0",
         "runs": [{
             "tool": {"driver": {
-                "name": "cephlint",
-                "informationUri":
-                    "docs/static_analysis.md",
+                "name": tool,
+                "informationUri": info_uri,
                 "rules": [{"id": c,
                            "shortDescription":
                                {"text": _SARIF_RULES.get(c, c)}}
@@ -400,7 +406,7 @@ def render_sarif(report: Report, uri_prefix: str = "") -> str:
                     },
                 }],
                 "partialFingerprints": {
-                    "cephlintIdent": f"{f.code}:{f.path}:{f.ident}",
+                    f"{tool}Ident": f"{f.code}:{f.path}:{f.ident}",
                 },
             } for f in report.findings],
         }],
@@ -408,9 +414,11 @@ def render_sarif(report: Report, uri_prefix: str = "") -> str:
     return json.dumps(doc, indent=2, sort_keys=True)
 
 
-def render(report: Report, fmt: str = "text", sarif_prefix: str = "") -> str:
+def render(report: Report, fmt: str = "text", sarif_prefix: str = "",
+           tool: str = "cephlint",
+           info_uri: str = "docs/static_analysis.md") -> str:
     if fmt == "json":
         return json.dumps(report.to_json(), indent=2, sort_keys=True)
     if fmt == "sarif":
-        return render_sarif(report, sarif_prefix)
+        return render_sarif(report, sarif_prefix, tool, info_uri)
     return report.render_text()

@@ -1,0 +1,31 @@
+"""cephrace — dynamic data-race, deadlock, and lost-wakeup detection
+(the runtime twin of cephlint's static CL1/CL2; docs/race_detection.md).
+
+cephlint proves what it can about lock discipline from the AST; cephrace
+watches an actual seeded run.  The division of labor:
+
+- cephlint CL2 resolves which class families are multi-threaded from its
+  cross-file symbol table.  cephrace *imports that answer* as its
+  instrumentation target list (instrument.discover_targets) — static
+  analysis feeds the dynamic detector, no hand-curated class list.
+- common/lockdep.py's LockdepLock seam, threading.Thread/Condition and
+  queue.Queue are instrumented to emit a sync-event trace with vector
+  clocks (runtime.RaceRuntime).
+- An Eraser-style lockset state machine (lockset.py) runs over attribute
+  accesses of the instrumented classes; candidate races are filtered
+  through happens-before so fork/join- or queue-ordered accesses stay
+  quiet (the hybrid that keeps Eraser's sensitivity without its false
+  positives).
+- A seeded PCT-style scheduler (scheduler.py) perturbs interleavings at
+  the instrumented sync points, so a short tier-1 run explores schedules
+  a plain run never hits; the schedule plan is a pure function of the
+  seed, replayable like qa/thrasher.py.
+- Reporting reuses the analyzer's Finding/noqa/baseline/SARIF machinery
+  (report.py; codes CR1 data race, CR2 deadlock, CR3 lost wakeup).
+
+CLI: ``python -m ceph_tpu.qa.race --seed N --scenario thrash|mon_churn|ec_io``.
+"""
+from .events import VectorClock
+from .runtime import DeadlockError, RaceRuntime, race_session
+
+__all__ = ["VectorClock", "RaceRuntime", "DeadlockError", "race_session"]

@@ -1,0 +1,135 @@
+"""CLI: python -m ceph_tpu.qa.race --seed N --scenario thrash|mon_churn|ec_io
+
+Exit-code contract (mirrors cephlint's, and what qa/ci_gate.sh branches
+on):
+
+    0   clean: no active findings (stale race-baseline entries only warn
+        — a race is schedule-dependent, one seed not reproducing it is
+        not proof the debt was paid)
+    1   active findings
+    2   usage errors, unreadable baseline, scenario crash
+
+The schedule plan and the scenario workload both derive purely from
+--seed; --format=json includes the plan and the trace digest so a
+finding's schedule can be re-run bit-for-bit.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from ..analyzer.core import BaselineError, format_baseline
+from . import report as race_report
+from .scenarios import DEFAULT_EVENTS, SCENARIOS, run_scenario
+
+
+def main(argv: list[str] | None = None) -> int:
+    try:
+        return _main(argv)
+    except BrokenPipeError:
+        # `cephrace --list-targets | head` closing the pipe is not an
+        # error — and the console-script entry point calls main()
+        # directly, so the guard must live here, not under __main__.
+        # Re-point stdout at devnull so the interpreter's exit-time
+        # flush doesn't raise the same error again (CPython would exit
+        # 120 on an unraisable flush failure).
+        import os
+
+        try:
+            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        except OSError:
+            pass
+        return 0
+
+
+def _main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ceph_tpu.qa.race",
+        description="cephrace: dynamic data-race (CR1), deadlock (CR2) "
+                    "and lost-wakeup (CR3) detection over a seeded "
+                    "scenario, with PCT-style schedule exploration",
+        epilog="exit status: 0 clean; 1 findings; 2 usage/scenario "
+               "errors.  The same --seed replays the same schedule plan "
+               "and workload.")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS),
+                    default="thrash")
+    ap.add_argument("--events", type=int, default=None,
+                    help="scenario length (default: per-scenario, e.g. "
+                         f"{DEFAULT_EVENTS})")
+    ap.add_argument("--sched", choices=("perturb", "serialize", "none"),
+                    default="perturb",
+                    help="schedule exploration mode (serialize is for "
+                         "fixture-sized workloads; cluster scenarios "
+                         "want perturb)")
+    ap.add_argument("--depth", type=int, default=3,
+                    help="PCT preemption depth d (d-1 priority change "
+                         "points)")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="baseline file (default: qa/race/baseline.toml)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report baselined findings too")
+    ap.add_argument("--write-baseline", default=None, metavar="FILE",
+                    help="write active findings as a pinned baseline "
+                         "(edit each reason before committing!)")
+    ap.add_argument("--list-targets", action="store_true",
+                    help="print the statically-discovered instrumentation "
+                         "targets and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_targets:
+        from .instrument import discover_targets
+
+        for cls in discover_targets():
+            print(f"{cls.__module__}.{cls.__name__}")
+        return 0
+
+    try:
+        rt, extras = run_scenario(args.scenario, args.seed,
+                                  events=args.events, sched=args.sched,
+                                  depth=args.depth)
+    except BaselineError as e:
+        print(f"cephrace: error: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:
+        print(f"cephrace: scenario {args.scenario!r} failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    try:
+        rep = race_report.build_report(
+            rt.findings,
+            baseline_file=Path(args.baseline) if args.baseline else None,
+            use_baseline=not args.no_baseline)
+    except BaselineError as e:
+        print(f"cephrace: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        Path(args.write_baseline).write_text(format_baseline(
+            rep.findings, reason="FIXME: justify or fix"))
+        print(f"cephrace: wrote {len(rep.findings)} entries to "
+              f"{args.write_baseline}")
+        return 0
+
+    if args.format == "json":
+        doc = rep.to_json()
+        doc["run"] = extras
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        out = race_report.render(rep, args.format)
+        if out:
+            print(out)
+        if args.format == "text":
+            print(f"cephrace: scenario={args.scenario} seed={args.seed} "
+                  f"sched={args.sched} trace={extras['trace_events']} "
+                  f"events digest={extras['trace_digest']}")
+    return 0 if rep.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

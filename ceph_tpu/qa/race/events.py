@@ -1,0 +1,102 @@
+"""Vector clocks and the sync-event trace.
+
+The trace is the detector's ground truth AND its replay artifact: every
+record is built only from deterministic inputs (thread indices assigned
+in registration order, lock names, per-class instance ordinals, monotone
+sequence numbers) — no wall clocks, no memory addresses — so two runs of
+the same seeded schedule produce byte-identical traces (the Thrasher's
+replay property, extended to synchronization).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class VectorClock:
+    """Classic vector clock over small integer thread ids.
+
+    Mutating ops (tick/join) are called only by the owning thread or
+    under the runtime's state lock; snapshots taken for per-variable
+    epochs are immutable tuples.
+    """
+
+    __slots__ = ("_c",)
+
+    def __init__(self, init: dict[int, int] | None = None):
+        self._c: dict[int, int] = dict(init) if init else {}
+
+    def tick(self, tid: int) -> None:
+        self._c[tid] = self._c.get(tid, 0) + 1
+
+    def join(self, other: "VectorClock") -> None:
+        oc = other._c
+        c = self._c
+        for k, v in oc.items():
+            if v > c.get(k, 0):
+                c[k] = v
+
+    def copy(self) -> "VectorClock":
+        return VectorClock(self._c)
+
+    def snapshot(self) -> tuple[tuple[int, int], ...]:
+        return tuple(sorted(self._c.items()))
+
+    def get(self, tid: int) -> int:
+        return self._c.get(tid, 0)
+
+    def dominates(self, snap: tuple[tuple[int, int], ...]) -> bool:
+        """True iff this clock has seen every component of `snap`
+        (i.e. the snapshotted event happens-before the current state)."""
+        c = self._c
+        for tid, v in snap:
+            if v > c.get(tid, 0):
+                return False
+        return True
+
+    def __repr__(self) -> str:  # debug only
+        return f"VC{dict(sorted(self._c.items()))}"
+
+
+@dataclass
+class Event:
+    """One sync/memory event.  `seq` is the global trace order; all other
+    fields are schedule-deterministic labels."""
+
+    seq: int
+    tid: int
+    kind: str      # acquire|release|thread_start|thread_join|q_put|q_get|
+                   # cond_wait|cond_wake|cond_timeout|notify|read|write|
+                   # sched (scheduler decisions)
+    target: str    # lock name, queue label, "ClassName#ordinal.attr", ...
+    where: str = ""  # "rel/path.py:lineno" of the instrumented call site
+
+    def as_tuple(self) -> tuple:
+        return (self.seq, self.tid, self.kind, self.target, self.where)
+
+
+@dataclass
+class Trace:
+    """Bounded in-memory event log (the whole run for tier-1-sized
+    scenarios; the cap only guards pathological soaks)."""
+
+    max_events: int = 500_000
+    events: list[Event] = field(default_factory=list)
+    dropped: int = 0
+
+    def append(self, ev: Event) -> None:
+        if len(self.events) >= self.max_events:
+            self.dropped += 1
+            return
+        self.events.append(ev)
+
+    def as_tuples(self) -> list[tuple]:
+        return [e.as_tuple() for e in self.events]
+
+    def digest(self) -> str:
+        """Stable content hash for replay comparison in logs/CLI output."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for e in self.events:
+            h.update(repr(e.as_tuple()).encode())
+        return h.hexdigest()[:16]

@@ -1,0 +1,424 @@
+"""Instrumentation: where cephrace's probes physically attach.
+
+Three layers, all installed/removed as one reversible set by
+``install``/``uninstall`` (driven by runtime.race_session):
+
+1. **Lock seam** — common.lockdep gets the active runtime as its hook
+   object; every LockdepLock acquire/release (and the Condition
+   save/restore protocol) reports in.  This is free coverage for every
+   ``make_lock`` in the tree — including the common/ primitives the
+   CL1 raw-lock sweep converted.
+2. **threading / queue patches** — Thread.start/join (fork/join
+   happens-before + scheduler registration), Condition wait/notify
+   (signal edges + the lost-wakeup heuristic + held-set tracking for
+   bare Conditions whose inner lock lockdep cannot see), Queue put/get
+   (hand-off edges).  Wrappers pass straight through for threads the
+   runtime never registered, so pytest/JAX internals are untouched.
+3. **Class patches** — ``__setattr__``/``__getattribute__`` wrappers on
+   the multi-threaded class families.  The target list is computed from
+   cephlint's cross-file symbol table (``discover_targets``): a class is
+   instrumented iff its family spawns threads or owns locks — the same
+   ``family_threaded`` predicate CL2 uses — and it lives in the
+   concurrency dirs.  No hand-curated list; when a new daemon class
+   grows a lock, it becomes a detector target on the next run
+   automatically.  Only family roots are patched (a patched base already
+   covers its subclasses through attribute lookup).
+"""
+from __future__ import annotations
+
+import functools
+import queue as queue_mod
+import threading
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ...common import lockdep
+from ...common.lockdep import LockdepLock
+from .runtime import DeadlockError, active
+
+#: Condition calls whose CALLER lives in these stdlib files are library
+#: internals — Event.wait/set (threading.py, also the scheduler's own
+#: gates), queue.Queue's not_empty/not_full (queue.py).  Instrumenting
+#: them would recurse into the scheduler and hand the lost-wakeup
+#: heuristic queue-internal notifies it must not see; the Thread/Queue
+#: patches already model those edges at the right abstraction level.
+import queue as _queue_file
+import threading as _threading_file
+
+_STDLIB_SYNC_FILES = (_threading_file.__file__, _queue_file.__file__)
+
+
+def _internal_caller() -> bool:
+    import sys
+
+    return sys._getframe(2).f_code.co_filename in _STDLIB_SYNC_FILES
+
+#: the subsystems whose shared state the detector watches (the dirs the
+#: tentpole names); common/ enters via the lock seam, not attr tracking
+DEFAULT_DIRS = ("msg", "mon", "osd", "store", "client", "fs")
+
+
+# -- target discovery (static analysis feeds the dynamic detector) ----------
+
+@functools.lru_cache(maxsize=4)
+def _discover_names(dirs: tuple[str, ...]) -> tuple[tuple[str, str], ...]:
+    """(modname, classname) pairs of multi-threaded family members under
+    `dirs`, via the cephlint symbol table."""
+    from ..analyzer.core import Config, collect_modules
+    from ..analyzer.symbols import SymbolTable
+
+    pkg_dir = Path(__file__).resolve().parents[2]
+    cfg = Config.discover([str(pkg_dir)])
+    mods = collect_modules(cfg)
+    sym = SymbolTable.build(mods)
+    out = []
+    for ci in sym.classes.values():
+        top = ci.path.split("/", 1)[0] if "/" in ci.path else ""
+        if top not in dirs:
+            continue
+        if not sym.family_threaded(ci):
+            continue
+        out.append((ci.module, ci.name))
+    return tuple(sorted(set(out)))
+
+
+def discover_targets(dirs: tuple[str, ...] | None = None) -> tuple[type, ...]:
+    """Resolve the statically-discovered names to live classes."""
+    import importlib
+
+    root_pkg = __package__.split(".")[0]          # "ceph_tpu"
+    classes: list[type] = []
+    for modname, clsname in _discover_names(tuple(dirs or DEFAULT_DIRS)):
+        try:
+            mod = importlib.import_module(f"{root_pkg}.{modname}")
+            cls = getattr(mod, clsname, None)
+        except Exception as e:  # noqa: CL7 — a gated-dep module must not kill discovery
+            import sys
+
+            print(f"cephrace: skipping target {modname}.{clsname}: {e!r}",
+                  file=sys.stderr)
+            continue
+        if isinstance(cls, type):
+            classes.append(cls)
+    # family roots only: a patched base covers subclasses via lookup
+    roots = [c for c in classes
+             if not any(o is not c and issubclass(c, o) for o in classes)]
+    return tuple(roots)
+
+
+# -- patch bookkeeping -------------------------------------------------------
+
+@dataclass
+class _ClassPatch:
+    cls: type
+    had_setattr: bool
+    orig_setattr: object
+    had_getattribute: bool
+    orig_getattribute: object
+
+
+@dataclass
+class Patches:
+    classes: list[_ClassPatch] = field(default_factory=list)
+    thread_start: object = None
+    thread_join: object = None
+    cond_wait: object = None
+    cond_wait_for: object = None
+    cond_notify: object = None
+    cond_notify_all: object = None
+    cond_enter: object = None
+    cond_exit: object = None
+    q_put: object = None
+    q_get: object = None
+
+
+def _patch_class(cls: type) -> _ClassPatch:
+    orig_set = cls.__setattr__          # resolved through the MRO
+    orig_get = cls.__getattribute__
+    patch = _ClassPatch(
+        cls=cls,
+        had_setattr="__setattr__" in cls.__dict__,
+        orig_setattr=cls.__dict__.get("__setattr__"),
+        had_getattribute="__getattribute__" in cls.__dict__,
+        orig_getattribute=cls.__dict__.get("__getattribute__"),
+    )
+
+    def __setattr__(self, name, value, _orig=orig_set):
+        rt = active()
+        if rt is not None and not name.startswith("__"):
+            rt.on_access(self, name, True)
+        _orig(self, name, value)
+
+    def __getattribute__(self, name, _orig=orig_get):
+        value = _orig(self, name)
+        if name.startswith("_") and (name.startswith("__")
+                                     or name.startswith("_race")):
+            return value
+        rt = active()
+        if rt is not None and not callable(value):
+            rt.on_access(self, name, False)
+        return value
+
+    cls.__setattr__ = __setattr__
+    cls.__getattribute__ = __getattribute__
+    return patch
+
+
+def _unpatch_class(p: _ClassPatch) -> None:
+    if p.had_setattr:
+        p.cls.__setattr__ = p.orig_setattr
+    else:
+        try:
+            del p.cls.__setattr__
+        except AttributeError:
+            pass
+    if p.had_getattribute:
+        p.cls.__getattribute__ = p.orig_getattribute
+    else:
+        try:
+            del p.cls.__getattribute__
+        except AttributeError:
+            pass
+
+
+# -- threading / queue patches ----------------------------------------------
+
+def _cond_inner(cond) -> object | None:
+    return getattr(cond, "_lock", None)
+
+
+def install(rt, targets: tuple[type, ...]) -> Patches:
+    patches = Patches()
+
+    lockdep.set_race_hooks(rt)
+
+    # Thread.start: snapshot the creator's clock into the child; wrap run
+    # so the child registers itself, waits for its first schedule grant,
+    # and reports exit (with its final clock, for join edges).
+    orig_start = threading.Thread.start
+    orig_join = threading.Thread.join
+    patches.thread_start = orig_start
+    patches.thread_join = orig_join
+
+    def start(self):
+        r = active()
+        parent = r.thread_state() if r is not None else None
+        if r is None or parent is None:
+            return orig_start(self)
+        child_ts = r.make_thread_state(self.name)
+        self._race_ts = child_ts
+        r.on_thread_start(parent, child_ts)
+        # register with the scheduler HERE, on the parent side: priority
+        # assignment follows registration order, and children adopting
+        # themselves on first run would race for it (nondeterministic
+        # plans from the same seed).  adopt's own register is idempotent.
+        if r.scheduler is not None:
+            r.scheduler.register(child_ts.tid)
+        orig_run = self.run
+
+        def _race_run():
+            r2 = active()
+            if r2 is r:
+                r.adopt_thread_state(child_ts)
+                if r.scheduler is not None:
+                    r.scheduler.yield_point(child_ts.tid)
+            try:
+                orig_run()
+            except DeadlockError:
+                pass   # already recorded as a CR2 finding
+            finally:
+                if active() is r:
+                    r.on_thread_exit(child_ts)
+
+        self.run = _race_run
+        return orig_start(self)
+
+    def join(self, timeout=None):
+        r = active()
+        ts = r.thread_state() if r is not None else None
+        if r is None or ts is None:
+            return orig_join(self, timeout)
+        r.block_begin(ts)
+        try:
+            return orig_join(self, timeout)
+        finally:
+            r.block_end(ts)
+            child_ts = getattr(self, "_race_ts", None)
+            if child_ts is not None and not self.is_alive():
+                r.on_thread_join(ts, child_ts)
+
+    threading.Thread.start = start
+    threading.Thread.join = join
+
+    # Condition: wait/notify edges + lost-wakeup bookkeeping.  For a bare
+    # Condition (inner lock invisible to lockdep) the enter/exit/wait
+    # wrappers also maintain the held-lock set and deadlock owner map —
+    # otherwise attribute writes under ``with self._cond:`` would look
+    # lockless and the lockset machine would cry wolf.
+    orig_wait = threading.Condition.wait
+    orig_wait_for = threading.Condition.wait_for
+    orig_notify = threading.Condition.notify
+    orig_notify_all = threading.Condition.notify_all
+    orig_enter = threading.Condition.__enter__
+    orig_exit = threading.Condition.__exit__
+    patches.cond_wait = orig_wait
+    patches.cond_wait_for = orig_wait_for
+    patches.cond_notify = orig_notify
+    patches.cond_notify_all = orig_notify_all
+    patches.cond_enter = orig_enter
+    patches.cond_exit = orig_exit
+
+    def cond_enter(self):
+        r = active()
+        ts = r.thread_state() if r is not None else None
+        inner = _cond_inner(self)
+        if r is None or ts is None or inner is None \
+                or isinstance(inner, LockdepLock) or _internal_caller():
+            return orig_enter(self)      # lockdep hooks cover LockdepLock
+        r.before_acquire(inner)
+        got = orig_enter(self)
+        r.after_acquire(inner)
+        return got
+
+    def cond_exit(self, *exc):
+        r = active()
+        ts = r.thread_state() if r is not None else None
+        inner = _cond_inner(self)
+        if r is not None and ts is not None and inner is not None \
+                and not isinstance(inner, LockdepLock) \
+                and not _internal_caller():
+            r.before_release(inner)
+        return orig_exit(self, *exc)
+
+    def wait(self, timeout=None):
+        r = active()
+        ts = r.thread_state() if r is not None else None
+        if r is None or ts is None or _internal_caller():
+            return orig_wait(self, timeout)
+        inner = _cond_inner(self)
+        bare = inner is not None and not isinstance(inner, LockdepLock)
+        pre_lost = r.on_wait_begin(self)
+        if bare:
+            r.cond_release_save(inner)
+        r.block_begin(ts)
+        ok = None
+        try:
+            ok = orig_wait(self, timeout)
+            return ok
+        finally:
+            r.block_end(ts)
+            if bare:
+                r.cond_acquire_restore(inner)
+            r.on_wait_end(self, bool(ok), pre_lost)
+
+    def wait_for(self, predicate, timeout=None):
+        # wait_for is the tree's dominant wait idiom (throttle, OSD
+        # cond, MonClient, Objecter) and its INTERNAL self.wait calls
+        # are deliberately passed through as stdlib-internal — so the
+        # whole call gets one bracket here: one on_wait_begin/end for
+        # CR3 (a wait_for timeout after a no-waiter notify is exactly a
+        # lost wakeup) and one block_begin/end so a serialized thread
+        # parks without keeping the token.
+        r = active()
+        ts = r.thread_state() if r is not None else None
+        if r is None or ts is None or _internal_caller():
+            return orig_wait_for(self, predicate, timeout)
+        inner = _cond_inner(self)
+        bare = inner is not None and not isinstance(inner, LockdepLock)
+        pre_lost = r.on_wait_begin(self)
+        if bare:
+            r.cond_release_save(inner)
+        r.block_begin(ts)
+        ok = None
+        try:
+            ok = orig_wait_for(self, predicate, timeout)
+            return ok
+        finally:
+            r.block_end(ts)
+            if bare:
+                r.cond_acquire_restore(inner)
+            r.on_wait_end(self, bool(ok), pre_lost)
+
+    def notify(self, n=1):
+        r = active()
+        if r is not None and r.thread_state() is not None \
+                and not _internal_caller():
+            r.on_notify(self)
+        return orig_notify(self, n)
+
+    def notify_all(self):
+        r = active()
+        if r is not None and r.thread_state() is not None \
+                and not _internal_caller():
+            r.on_notify(self)
+        return orig_notify_all(self)
+
+    threading.Condition.wait = wait
+    threading.Condition.wait_for = wait_for
+    threading.Condition.notify = notify
+    threading.Condition.notify_all = notify_all
+    threading.Condition.__enter__ = cond_enter
+    threading.Condition.__exit__ = cond_exit
+
+    # Queue: hand-off happens-before via one joined clock per queue
+    orig_put = queue_mod.Queue.put
+    orig_get = queue_mod.Queue.get
+    patches.q_put = orig_put
+    patches.q_get = orig_get
+
+    def put(self, item, block=True, timeout=None):
+        r = active()
+        ts = r.thread_state() if r is not None else None
+        if r is None or ts is None:
+            return orig_put(self, item, block, timeout)
+        r.on_queue_put(self)   # clock into the queue BEFORE the item lands
+        if block:
+            r.block_begin(ts)
+        try:
+            return orig_put(self, item, block, timeout)
+        finally:
+            if block:
+                r.block_end(ts)
+
+    def get(self, block=True, timeout=None):
+        r = active()
+        ts = r.thread_state() if r is not None else None
+        if r is None or ts is None:
+            return orig_get(self, block, timeout)
+        if block:
+            r.block_begin(ts)
+        ok = False
+        try:
+            item = orig_get(self, block, timeout)
+            ok = True
+            return item
+        finally:
+            if block:
+                r.block_end(ts)
+            r.on_queue_get(self, ok)
+
+    queue_mod.Queue.put = put
+    queue_mod.Queue.get = get
+
+    for cls in targets:
+        patches.classes.append(_patch_class(cls))
+    return patches
+
+
+def uninstall(patches: Patches) -> None:
+    lockdep.set_race_hooks(None)
+    if patches.thread_start is not None:
+        threading.Thread.start = patches.thread_start
+        threading.Thread.join = patches.thread_join
+    if patches.cond_wait is not None:
+        threading.Condition.wait = patches.cond_wait
+        threading.Condition.wait_for = patches.cond_wait_for
+        threading.Condition.notify = patches.cond_notify
+        threading.Condition.notify_all = patches.cond_notify_all
+        threading.Condition.__enter__ = patches.cond_enter
+        threading.Condition.__exit__ = patches.cond_exit
+    if patches.q_put is not None:
+        queue_mod.Queue.put = patches.q_put
+        queue_mod.Queue.get = patches.q_get
+    for p in patches.classes:
+        _unpatch_class(p)

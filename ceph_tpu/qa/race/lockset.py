@@ -1,0 +1,150 @@
+"""Eraser-style lockset state machine with a happens-before filter.
+
+Per shared variable (one instrumented object attribute) the classic
+Eraser states:
+
+    VIRGIN ──first access──> EXCLUSIVE(owner)
+    EXCLUSIVE ──read by 2nd thread──> SHARED          (reads only: benign)
+    EXCLUSIVE/SHARED ──write by 2nd thread──> SHARED_MOD
+
+In SHARED/SHARED_MOD every access intersects the variable's candidate
+lockset C(v) with the accessor's held locks.  A SHARED_MOD access with
+C(v) = {} is an Eraser candidate race; pure Eraser would report it, but
+fork/join, queue hand-off, and condition signalling all order accesses
+without a common lock.  So candidates are filtered through vector
+clocks: the report fires only when the current access is concurrent
+with (not ordered after) the last conflicting access — the RaceTrack /
+ThreadSanitizer-v1 hybrid that keeps Eraser's schedule-insensitivity
+for genuinely unordered accesses while staying quiet for message-passing
+discipline.
+
+Locks are identified by *instance* (the runtime passes stable per-lock
+tokens), not lockdep name: two PGs' same-named locks must not count as
+a common lock.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+VIRGIN = 0
+EXCLUSIVE = 1
+SHARED = 2
+SHARED_MOD = 3
+
+_STATE_NAMES = {VIRGIN: "virgin", EXCLUSIVE: "exclusive",
+                SHARED: "shared-read", SHARED_MOD: "shared-modified"}
+
+
+@dataclass
+class Access:
+    """One attribute access, as the runtime saw it."""
+
+    tid: int
+    is_write: bool
+    locks: frozenset          # tokens of locks held at the access
+    vc_snap: tuple            # accessor's VectorClock.snapshot()
+    where: str                # "rel/path.py:lineno in func"
+
+
+@dataclass
+class VarState:
+    label: str                # "ClassName#ordinal.attr" (trace label)
+    cls_name: str
+    attr: str
+    state: int = VIRGIN
+    owner: int = -1
+    lockset: frozenset | None = None   # None = universe (not yet narrowed)
+    last_write: Access | None = None
+    last_reads: dict[int, Access] = field(default_factory=dict)  # tid -> last
+    reported: bool = False
+
+
+@dataclass
+class CandidateRace:
+    var: VarState
+    prior: Access
+    current: Access
+    kind: str                 # "write-write" | "read-write" | "write-read"
+
+
+class LocksetMachine:
+    """Owns every VarState; `record` returns a CandidateRace when an
+    access is an unordered empty-lockset conflict (at most one per
+    variable — later hits on the same variable stay quiet)."""
+
+    def __init__(self) -> None:
+        self.vars: dict[tuple[int, str], VarState] = {}
+
+    def var_for(self, obj_key: int, label: str, cls_name: str,
+                attr: str) -> VarState:
+        v = self.vars.get((obj_key, attr))
+        if v is None:
+            v = VarState(label=label, cls_name=cls_name, attr=attr)
+            self.vars[(obj_key, attr)] = v
+        return v
+
+    def record(self, v: VarState, acc: Access,
+               current_vc) -> CandidateRace | None:
+        """Advance v's state machine with `acc`; `current_vc` is the
+        accessor's live VectorClock (used for the dominates test)."""
+        try:
+            if v.state == VIRGIN:
+                v.state = EXCLUSIVE
+                v.owner = acc.tid
+                return None
+            if v.state == EXCLUSIVE and acc.tid == v.owner:
+                return None
+            if v.state == EXCLUSIVE:
+                # second thread arrives: leave EXCLUSIVE.  The candidate
+                # lockset starts from THIS access's held set (Eraser
+                # refinement: the first thread's accesses predate
+                # sharing, so init writes don't poison the lockset), and
+                # a read lands in SHARED — the classic init-then-shared-
+                # read-only pattern stays benign until someone WRITES
+                # after sharing.
+                v.state = SHARED_MOD if acc.is_write else SHARED
+                v.lockset = acc.locks
+            else:
+                if acc.is_write:
+                    v.state = SHARED_MOD
+                ls = v.lockset if v.lockset is not None else acc.locks
+                v.lockset = ls & acc.locks
+            if v.state != SHARED_MOD or v.reported:
+                return None
+            if v.lockset:          # a common lock still protects v
+                return None
+            prior = self._conflicting(v, acc)
+            if prior is None:
+                return None
+            # happens-before filter: ordered accesses are not a race even
+            # with an empty lockset (queue hand-off, fork/join, cond)
+            if current_vc.dominates(prior.vc_snap):
+                return None
+            v.reported = True
+            kind = ("write-write" if prior.is_write and acc.is_write
+                    else "write-read" if prior.is_write else "read-write")
+            return CandidateRace(var=v, prior=prior, current=acc, kind=kind)
+        finally:
+            if acc.is_write:
+                v.last_write = acc
+            else:
+                v.last_reads[acc.tid] = acc
+
+    @staticmethod
+    def _conflicting(v: VarState, acc: Access) -> Access | None:
+        """The most relevant prior conflicting access from ANOTHER thread:
+        for a read, the last write; for a write, the last write else any
+        last read."""
+        lw = v.last_write
+        if lw is not None and lw.tid != acc.tid:
+            return lw
+        if not acc.is_write:
+            return None
+        for tid, r in v.last_reads.items():
+            if tid != acc.tid:
+                return r
+        return None
+
+    @staticmethod
+    def state_name(state: int) -> str:
+        return _STATE_NAMES[state]

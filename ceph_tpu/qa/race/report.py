@@ -1,0 +1,94 @@
+"""cephrace reporting — the analyzer's suppression machinery, reused.
+
+Runtime RaceFindings become analyzer ``Finding``s (codes CR1/CR2/CR3)
+and flow through the exact same layers cephlint findings do:
+
+- ``# noqa: CR1`` on the access line (the line the detector attributed
+  the primary site to);
+- pinned ``qa/race/baseline.toml`` entries with a mandatory reason;
+- text / json / SARIF rendering (tool name ``cephrace``).
+
+One deliberate difference from cephlint: STALE baseline entries warn but
+never fail.  A race finding is schedule-dependent — one seed not
+reproducing a baselined race is expected, not proof the debt was paid.
+Baseline entries here are retired by hand when the underlying code is
+fixed.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+from ..analyzer import core
+
+_PKG_ROOT = Path(__file__).resolve().parents[2]      # .../ceph_tpu
+RACE_BASELINE = Path(__file__).resolve().parent / "baseline.toml"
+
+
+def to_findings(raw) -> list[core.Finding]:
+    out = [core.Finding(code=f.code, path=f.path, line=f.line,
+                        ident=f.ident, message=f.message)
+           for f in raw]
+    out.sort(key=lambda f: (f.path, f.line, f.code, f.ident))
+    return out
+
+
+def _noqa_hit(f: core.Finding) -> bool:
+    p = _PKG_ROOT / f.path
+    try:
+        lines = p.read_text().splitlines()
+    except OSError:
+        return False
+    if not (1 <= f.line <= len(lines)):
+        return False
+    codes = core.noqa_codes(lines[f.line - 1])
+    if codes is None:
+        return False
+    return not codes or f.code in codes
+
+
+def build_report(raw_findings, baseline_file: Path | None = None,
+                 use_baseline: bool = True) -> core.Report:
+    """RaceFinding list -> core.Report with noqa/baseline applied."""
+    if baseline_file is None:
+        baseline_file = RACE_BASELINE
+    entries = []
+    if use_baseline and baseline_file and Path(baseline_file).exists():
+        entries = core.parse_baseline(Path(baseline_file).read_text(),
+                                      str(baseline_file))
+
+    def match(f: core.Finding):
+        # a race finding's reported path is whichever of the two access
+        # sites the schedule surfaced first — entries may pin it, or use
+        # path = "*" to match the ident wherever it lands
+        for e in entries:
+            if e["code"] == f.code and e["ident"] == f.ident \
+                    and e["path"] in ("*", f.path):
+                return e
+        return None
+
+    report = core.Report(findings=[])
+    hit: set[int] = set()
+    for f in to_findings(raw_findings):
+        if _noqa_hit(f):
+            report.noqa.append(f)
+            continue
+        e = match(f)
+        if e is not None:
+            hit.add(id(e))
+            report.baselined.append(f)
+            continue
+        report.findings.append(f)
+    # stale entries are informational only (see module docstring)
+    report.stale_baseline = [e for e in entries if id(e) not in hit]
+    return report
+
+
+def render(report: core.Report, fmt: str = "text",
+           sarif_prefix: str = "") -> str:
+    if fmt == "text":
+        out = report.render_text()
+        # the summary line says cephlint; relabel without duplicating
+        # the renderer
+        return out.replace("cephlint:", "cephrace:")
+    return core.render(report, fmt, sarif_prefix, tool="cephrace",
+                       info_uri="docs/race_detection.md")

@@ -1,0 +1,595 @@
+"""The cephrace runtime: per-thread vector-clock state, sync-event
+recording, the lockset machine, actual-deadlock detection, and the
+lost-wakeup heuristic.
+
+One RaceRuntime is active at a time (module global, like lockdep's
+graph).  It is driven from four directions:
+
+- ``common.lockdep`` calls the LockHooks protocol on every LockdepLock
+  acquire/release (and through the Condition save/restore protocol);
+- ``instrument.py``'s class patches call ``on_read``/``on_write`` for
+  attribute traffic of the multi-threaded families;
+- ``instrument.py``'s threading/queue patches call the thread, queue
+  and condition event methods;
+- the scheduler is consulted at every sync point (``yield_point``) and
+  around real blocking operations (``block_begin``/``block_end``).
+
+Happens-before edges modelled (release -> acquire in each case):
+
+    lock release        -> same lock's next acquire
+    Thread.start        -> first event of the child
+    child's last event  -> Thread.join return
+    Queue.put           -> any later Queue.get on that queue (the queue
+                           carries one joined clock: an over-approximation
+                           that can only SUPPRESS reports, never add one)
+    Condition.notify    -> a wait that returns after it
+
+Deadlock: a waits-for graph over *instances* (thread -> lock-owner),
+checked before each blocking LockdepLock acquire; a cycle raises
+DeadlockError in the acquiring thread (deterministic, instead of
+hanging the run) and records a CR2 finding.  This complements lockdep:
+lockdep orders lock *names* and must see both orders; the waits-for
+check catches the schedule the PCT scheduler actually steered into,
+including single-name instance deadlocks lockdep's recursion allowance
+ignores.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .events import Event, Trace, VectorClock
+from .lockset import Access, LocksetMachine
+
+_PKG_ROOT = Path(__file__).resolve().parents[2]   # .../ceph_tpu
+_RACE_DIR = str(Path(__file__).resolve().parent)
+
+# plumbing frames a finding must never be attributed to: this package,
+# the lockdep seam, and the stdlib sync modules our patches wrap
+import queue as _queue_mod
+
+_SKIP_FILES = (threading.__file__, _queue_mod.__file__,
+               str(_PKG_ROOT / "common" / "lockdep.py"))
+
+
+class DeadlockError(RuntimeError):
+    """Raised in the thread whose acquire would close a waits-for cycle."""
+
+
+@dataclass
+class RaceFinding:
+    """A runtime finding, pre-report (report.py turns these into the
+    analyzer's Finding type for noqa/baseline/SARIF)."""
+
+    code: str          # CR1 data race | CR2 deadlock | CR3 lost wakeup
+    path: str          # package-relative posix path of the primary site
+    line: int
+    ident: str         # stable baseline key
+    message: str
+
+
+class _ThreadState:
+    __slots__ = ("tid", "vc", "held", "held_tokens", "name", "cs_activity",
+                 "lock_block_pending")
+
+    def __init__(self, tid: int, name: str):
+        self.tid = tid
+        self.name = name
+        self.vc = VectorClock()
+        self.vc.tick(tid)
+        # lock token -> recursion count (tokens are stable per-lock labels)
+        self.held: dict[str, int] = {}
+        self.held_tokens: frozenset | None = frozenset()  # cache
+        # lock token -> cond ids waited/notified while holding it (the
+        # lost-wakeup heuristic's evidence of signal-related activity)
+        self.cs_activity: dict[str, set[int]] = {}
+        # True between before_acquire's block_begin and the matching
+        # block_end: re-entrant and bounded acquires skip block_begin,
+        # and an UNMATCHED block_end would hand the serialize token away
+        # while this thread keeps running (two live threads = broken
+        # replay)
+        self.lock_block_pending = False
+
+    def tokens(self) -> frozenset:
+        if self.held_tokens is None:
+            self.held_tokens = frozenset(self.held)
+        return self.held_tokens
+
+
+class _SyncVC:
+    """Clock attached to a lock / queue / condition object."""
+
+    __slots__ = ("vc",)
+
+    def __init__(self) -> None:
+        self.vc = VectorClock()
+
+
+class RaceRuntime:
+    """See module docstring.  Not re-entrant: one active instance."""
+
+    def __init__(self, seed: int, scheduler=None, max_events: int = 500_000):
+        self.seed = seed
+        self.scheduler = scheduler
+        self.trace = Trace(max_events=max_events)
+        self.machine = LocksetMachine()
+        self.findings: list[RaceFinding] = []
+        self._finding_keys: set[tuple] = set()
+        self._state = threading.Lock()   # guards everything below
+        self._threads: dict[int, _ThreadState] = {}   # python ident -> state
+        self._next_tid = 0
+        self._seq = 0
+        # per-object deterministic labels: lock/queue/cond/instance
+        self._labels: dict[int, str] = {}
+        self._label_counts: dict[str, int] = {}
+        self._sync_vcs: dict[int, _SyncVC] = {}
+        # deadlock: lock token -> owning tid; tid -> (token, owner tid)
+        self._owners: dict[str, int] = {}
+        self._waiting: dict[int, tuple[str, int]] = {}
+        # lost wakeup: cond key -> [waiters, unconsumed_notifies]
+        self._conds: dict[int, list[int]] = {}
+        # lock token -> cond ids whose inner lock it is (for the
+        # critical-section clearing rule below)
+        self._lock_conds: dict[str, set[int]] = {}
+        self._reentry = threading.local()
+
+    # -- registration & labels ----------------------------------------------
+    def register_thread(self, name: str | None = None) -> _ThreadState:
+        ident = threading.get_ident()
+        with self._state:
+            ts = self._threads.get(ident)
+            if ts is None:
+                ts = _ThreadState(self._next_tid,
+                                  name or threading.current_thread().name)
+                self._next_tid += 1
+                self._threads[ident] = ts
+                if self.scheduler is not None:
+                    self.scheduler.register(ts.tid)
+            return ts
+
+    def adopt_thread_state(self, ts: _ThreadState) -> None:
+        """Bind a pre-created state (child thread start hand-off) to the
+        calling thread."""
+        with self._state:
+            self._threads[threading.get_ident()] = ts
+            if self.scheduler is not None:
+                self.scheduler.register(ts.tid)
+
+    def make_thread_state(self, name: str) -> _ThreadState:
+        with self._state:
+            ts = _ThreadState(self._next_tid, name)
+            self._next_tid += 1
+            return ts
+
+    def thread_state(self) -> _ThreadState | None:
+        return self._threads.get(threading.get_ident())
+
+    def _label_locked(self, obj, stem: str) -> str:
+        lab = self._labels.get(id(obj))
+        if lab is None:
+            n = self._label_counts.get(stem, 0)
+            self._label_counts[stem] = n + 1
+            lab = f"{stem}#{n}"
+            self._labels[id(obj)] = lab
+        return lab
+
+    def _sync_vc_locked(self, obj) -> _SyncVC:
+        sv = self._sync_vcs.get(id(obj))
+        if sv is None:
+            sv = self._sync_vcs[id(obj)] = _SyncVC()
+        return sv
+
+    # -- trace ---------------------------------------------------------------
+    def _emit_locked(self, tid: int, kind: str, target: str,
+                     where: str = "") -> None:
+        self.trace.append(Event(self._seq, tid, kind, target, where))
+        self._seq += 1
+
+    def _site(self, depth: int = 2) -> tuple[str, int, str]:
+        """(package-relative path, line, function) of the first frame
+        outside qa/race — the instrumented call site."""
+        f = sys._getframe(depth)
+        while f is not None and (
+            f.f_code.co_filename.startswith(_RACE_DIR)
+            or f.f_code.co_filename in _SKIP_FILES
+        ):
+            f = f.f_back
+        if f is None:
+            return ("?", 0, "?")
+        fn = f.f_code.co_filename
+        try:
+            rel = Path(fn).resolve().relative_to(_PKG_ROOT).as_posix()
+        except ValueError:
+            rel = Path(fn).name
+        return (rel, f.f_lineno, f.f_code.co_name)
+
+    def _add_finding(self, f: RaceFinding) -> None:
+        key = (f.code, f.ident)
+        with self._state:
+            if key in self._finding_keys:
+                return
+            self._finding_keys.add(key)
+            self.findings.append(f)
+
+    # -- scheduler glue -------------------------------------------------------
+    def _yield(self, ts: _ThreadState) -> None:
+        if self.scheduler is not None:
+            self.scheduler.yield_point(ts.tid)
+
+    def block_begin(self, ts: _ThreadState) -> None:
+        if self.scheduler is not None:
+            self.scheduler.block_begin(ts.tid)
+
+    def block_end(self, ts: _ThreadState) -> None:
+        if self.scheduler is not None:
+            self.scheduler.block_end(ts.tid)
+
+    # -- lock hooks (driven by common.lockdep) -------------------------------
+    def lock_token(self, lock) -> str:
+        with self._state:
+            return self._label_locked(lock, getattr(lock, "name", "lock"))
+
+    def before_acquire(self, lock, unbounded: bool = True) -> None:
+        ts = self.thread_state()
+        if ts is None:
+            return
+        self._yield(ts)
+        token = self.lock_token(lock)
+        with self._state:
+            if ts.held.get(token):
+                return    # recursive re-entry cannot deadlock
+            if not unbounded:
+                # try-lock / timed acquire: resolves on its own, so it
+                # neither raises nor contributes a waits-for edge (a
+                # bounded wait in the graph would fabricate cycles for
+                # OTHER threads' checks)
+                return
+            owner = self._owners.get(token)
+            if owner is not None and owner != ts.tid:
+                cycle = self._deadlock_cycle_locked(ts.tid, owner, token)
+                if cycle is not None:
+                    path, line, fn = self._site(2)
+                    names = " -> ".join(cycle)
+                    self._emit_locked(ts.tid, "deadlock", names,
+                                      f"{path}:{line}")
+                    f = RaceFinding(
+                        "CR2", path, line, f"deadlock:{names}",
+                        f"deadlock: acquiring {token} in {fn} closes the "
+                        f"waits-for cycle [{names}]")
+                    if (f.code, f.ident) not in self._finding_keys:
+                        self._finding_keys.add((f.code, f.ident))
+                        self.findings.append(f)
+                    raise DeadlockError(f.message)
+                self._waiting[ts.tid] = (token, owner)
+        self.block_begin(ts)
+        ts.lock_block_pending = True
+
+    def after_acquire(self, lock) -> None:
+        ts = self.thread_state()
+        if ts is None:
+            return
+        token = self.lock_token(lock)
+        # block_end ONLY when before_acquire actually ran block_begin
+        # (re-entrant and bounded acquires skip it; lock_block_pending
+        # is thread-local so the unlocked check is safe)
+        if ts.lock_block_pending:
+            ts.lock_block_pending = False
+            self.block_end(ts)
+        with self._state:
+            self._waiting.pop(ts.tid, None)
+            n = ts.held.get(token, 0)
+            ts.held[token] = n + 1
+            ts.held_tokens = None
+            if n == 0:
+                self._owners[token] = ts.tid
+                sv = self._sync_vc_locked(lock)
+                ts.vc.join(sv.vc)
+                ts.vc.tick(ts.tid)
+                self._emit_locked(ts.tid, "acquire", token)
+
+    def acquire_failed(self, lock) -> None:
+        """Non-blocking/timed acquire that did not get the lock."""
+        ts = self.thread_state()
+        if ts is None:
+            return
+        if ts.lock_block_pending:
+            ts.lock_block_pending = False
+            self.block_end(ts)
+        with self._state:
+            self._waiting.pop(ts.tid, None)
+
+    def before_release(self, lock) -> None:
+        ts = self.thread_state()
+        if ts is None:
+            return
+        token = self.lock_token(lock)
+        with self._state:
+            n = ts.held.get(token, 0)
+            if n <= 1:
+                ts.held.pop(token, None)
+                self._owners.pop(token, None)
+                sv = self._sync_vc_locked(lock)
+                sv.vc.join(ts.vc)
+                ts.vc.tick(ts.tid)
+                self._cs_clear_locked(ts, token)
+                self._emit_locked(ts.tid, "release", token)
+            else:
+                ts.held[token] = n - 1
+            ts.held_tokens = None
+
+    # Condition-protocol save/restore on a LockdepLock: the lock is fully
+    # released across wait() without passing through release()/acquire()
+    def cond_release_save(self, lock) -> None:
+        ts = self.thread_state()
+        if ts is None:
+            return
+        token = self.lock_token(lock)
+        with self._state:
+            if token in ts.held:
+                ts.held.pop(token, None)
+                ts.held_tokens = None
+                self._owners.pop(token, None)
+                sv = self._sync_vc_locked(lock)
+                sv.vc.join(ts.vc)
+                ts.vc.tick(ts.tid)
+                self._cs_clear_locked(ts, token)
+                self._emit_locked(ts.tid, "release", token)
+
+    def cond_acquire_restore(self, lock) -> None:
+        ts = self.thread_state()
+        if ts is None:
+            return
+        token = self.lock_token(lock)
+        with self._state:
+            ts.held[token] = ts.held.get(token, 0) + 1
+            ts.held_tokens = None
+            self._owners[token] = ts.tid
+            sv = self._sync_vc_locked(lock)
+            ts.vc.join(sv.vc)
+            ts.vc.tick(ts.tid)
+            self._emit_locked(ts.tid, "acquire", token)
+
+    def _deadlock_cycle_locked(self, me: int, owner: int,
+                               want: str) -> list[str] | None:
+        """Follow tid -> (wanted lock, owner) edges from `owner`; a path
+        back to `me` plus the new me->owner edge is a cycle.  Returns the
+        lock tokens along it."""
+        path = [want]
+        seen = {me}
+        cur = owner
+        while True:
+            if cur in seen:
+                return path if cur == me else None
+            seen.add(cur)
+            nxt = self._waiting.get(cur)
+            if nxt is None:
+                return None
+            path.append(nxt[0])
+            cur = nxt[1]
+
+    # -- thread lifecycle (driven by instrument's Thread patches) ------------
+    def on_thread_start(self, parent_ts: _ThreadState,
+                        child_ts: _ThreadState) -> None:
+        with self._state:
+            child_ts.vc.join(parent_ts.vc)
+            child_ts.vc.tick(child_ts.tid)
+            parent_ts.vc.tick(parent_ts.tid)
+            self._emit_locked(parent_ts.tid, "thread_start",
+                              f"t{child_ts.tid}")
+
+    def on_thread_exit(self, ts: _ThreadState) -> None:
+        with self._state:
+            self._emit_locked(ts.tid, "thread_exit", f"t{ts.tid}")
+        if self.scheduler is not None:
+            self.scheduler.thread_exit(ts.tid)
+
+    def on_thread_join(self, joiner: _ThreadState,
+                       child_ts: _ThreadState) -> None:
+        with self._state:
+            joiner.vc.join(child_ts.vc)
+            joiner.vc.tick(joiner.tid)
+            self._emit_locked(joiner.tid, "thread_join", f"t{child_ts.tid}")
+
+    # -- queues ---------------------------------------------------------------
+    def on_queue_put(self, q) -> None:
+        ts = self.thread_state()
+        if ts is None:
+            return
+        self._yield(ts)
+        with self._state:
+            lab = self._label_locked(q, "queue")
+            sv = self._sync_vc_locked(q)
+            sv.vc.join(ts.vc)
+            ts.vc.tick(ts.tid)
+            self._emit_locked(ts.tid, "q_put", lab)
+
+    def on_queue_get(self, q, ok: bool) -> None:
+        ts = self.thread_state()
+        if ts is None:
+            return
+        with self._state:
+            lab = self._label_locked(q, "queue")
+            if ok:
+                sv = self._sync_vc_locked(q)
+                ts.vc.join(sv.vc)
+                ts.vc.tick(ts.tid)
+                self._emit_locked(ts.tid, "q_get", lab)
+
+    # -- conditions ------------------------------------------------------------
+    def _mark_cond_activity_locked(self, ts: _ThreadState, cond) -> None:
+        """Tie this cond to its inner lock's token and record that the
+        current critical section did signal-related work on it.  A later
+        release of that lock by a thread that did NEITHER wait NOR
+        notify proves the predicate was observable without the signal —
+        any pending no-waiter notify was not lost, just unneeded (the
+        while-recheck idiom), so it stops counting."""
+        inner = getattr(cond, "_lock", None)
+        if inner is None:
+            return
+        token = self._label_locked(inner, getattr(inner, "name", "lock"))
+        self._lock_conds.setdefault(token, set()).add(id(cond))
+        if token in ts.held:
+            ts.cs_activity.setdefault(token, set()).add(id(cond))
+
+    def _cs_clear_locked(self, ts: _ThreadState, token: str) -> None:
+        conds = self._lock_conds.get(token)
+        if not conds:
+            ts.cs_activity.pop(token, None)
+            return
+        active = ts.cs_activity.pop(token, set())
+        for cid in conds:
+            if cid not in active:
+                st = self._conds.get(cid)
+                if st and st[0] == 0:
+                    st[1] = 0
+
+    def on_notify(self, cond, n_woken_hint: int | None = None) -> None:
+        ts = self.thread_state()
+        if ts is None:
+            return
+        self._yield(ts)
+        with self._state:
+            lab = self._label_locked(cond, "cond")
+            sv = self._sync_vc_locked(cond)
+            sv.vc.join(ts.vc)
+            ts.vc.tick(ts.tid)
+            st = self._conds.setdefault(id(cond), [0, 0])
+            if st[0] == 0:
+                # a notify with no waiter has no memory: if somebody was
+                # relying on it, it is lost the moment it fires
+                st[1] += 1
+            self._mark_cond_activity_locked(ts, cond)
+            self._emit_locked(ts.tid, "notify", lab)
+
+    def on_wait_begin(self, cond) -> int:
+        ts = self.thread_state()
+        if ts is None:
+            return 0
+        self._yield(ts)
+        with self._state:
+            lab = self._label_locked(cond, "cond")
+            st = self._conds.setdefault(id(cond), [0, 0])
+            st[0] += 1
+            self._mark_cond_activity_locked(ts, cond)
+            self._emit_locked(ts.tid, "cond_wait", lab)
+            return st[1]
+
+    def on_wait_end(self, cond, got_it, pre_lost: int) -> None:
+        ts = self.thread_state()
+        if ts is None:
+            return
+        with self._state:
+            lab = self._label_locked(cond, "cond")
+            st = self._conds.setdefault(id(cond), [0, 0])
+            st[0] = max(0, st[0] - 1)
+            if got_it:
+                sv = self._sync_vc_locked(cond)
+                ts.vc.join(sv.vc)
+                ts.vc.tick(ts.tid)
+                self._emit_locked(ts.tid, "cond_wake", lab)
+                return
+            self._emit_locked(ts.tid, "cond_timeout", lab)
+            lost = st[1] > 0 and pre_lost > 0
+            if lost:
+                st[1] = 0   # one report per pending notify, not per retry
+        if lost:
+            path, line, fn = self._site(2)
+            self._add_finding(RaceFinding(
+                "CR3", path, line, f"lost-wakeup:{fn}",
+                f"lost wakeup: wait in {fn} timed out although a notify "
+                f"on the same condition fired with no waiter present "
+                f"before the wait began (signal has no memory — set the "
+                f"predicate under the lock and re-check it, or notify "
+                f"after the waiter registers)"))
+
+    # -- attribute traffic (driven by instrument's class patches) -------------
+    def on_access(self, obj, attr: str, is_write: bool) -> None:
+        ts = self._threads.get(threading.get_ident())
+        if ts is None:
+            return
+        if getattr(self._reentry, "busy", False):
+            return
+        self._reentry.busy = True
+        try:
+            # writes always yield (the interleavings races live in);
+            # reads only under a serializing scheduler, where an
+            # off-token read event would break trace replay
+            if is_write or (self.scheduler is not None
+                            and self.scheduler.serialize_mode):
+                self._yield(ts)
+            path, line, fn = self._site(3)
+            where = f"{path}:{line} in {fn}"
+            with self._state:
+                cls_name = type(obj).__name__
+                lab = self._label_locked(obj, cls_name)
+                var = self.machine.var_for(id(obj), f"{lab}.{attr}",
+                                           cls_name, attr)
+                acc = Access(tid=ts.tid, is_write=is_write,
+                             locks=ts.tokens(), vc_snap=ts.vc.snapshot(),
+                             where=where)
+                self._emit_locked(ts.tid, "write" if is_write else "read",
+                                  f"{lab}.{attr}", f"{path}:{line}")
+                cand = self.machine.record(var, acc, ts.vc)
+            if cand is not None:
+                self._add_finding(RaceFinding(
+                    "CR1", path, line,
+                    f"race:{cls_name}.{attr}",
+                    f"data race ({cand.kind}) on {cls_name}.{attr}: "
+                    f"{'write' if acc.is_write else 'read'} at {where} with "
+                    f"lock(s) {{{', '.join(sorted(acc.locks)) or 'none'}}} "
+                    f"conflicts with prior "
+                    f"{'write' if cand.prior.is_write else 'read'} at "
+                    f"{cand.prior.where} holding "
+                    f"{{{', '.join(sorted(cand.prior.locks)) or 'none'}}}; "
+                    f"no common lock and no happens-before edge"))
+        finally:
+            self._reentry.busy = False
+
+
+# -- module-global active runtime ------------------------------------------
+
+_ACTIVE: RaceRuntime | None = None
+
+
+def active() -> RaceRuntime | None:
+    return _ACTIVE
+
+
+def _set_active(rt: RaceRuntime | None) -> None:
+    global _ACTIVE
+    _ACTIVE = rt
+
+
+@contextmanager
+def race_session(seed: int, scheduler=None, targets=None,
+                 target_dirs=None, max_events: int = 500_000):
+    """Install the full detector (lockdep hooks, threading/queue patches,
+    class instrumentation) around a block:
+
+        with race_session(seed=7, scheduler=make_scheduler("perturb", 7)) as rt:
+            ... run scenario ...
+        report = build_report(rt)
+
+    `targets` overrides class discovery (fixtures); by default the
+    instrumentation list comes from the cephlint symbol table
+    (instrument.discover_targets)."""
+    from . import instrument
+
+    if _ACTIVE is not None:
+        raise RuntimeError("a race_session is already active")
+    rt = RaceRuntime(seed, scheduler=scheduler, max_events=max_events)
+    if targets is None:
+        targets = instrument.discover_targets(dirs=target_dirs)
+    patches = instrument.install(rt, targets)
+    rt.register_thread("main")
+    _set_active(rt)
+    try:
+        yield rt
+    finally:
+        _set_active(None)
+        instrument.uninstall(patches)
+        if scheduler is not None:
+            scheduler.shutdown()

@@ -1,0 +1,117 @@
+"""Seeded scenarios the race CLI and the tier-1 gate drive.
+
+Each scenario is a deterministic *plan* of cluster work executed under
+an installed race_session; the PCT scheduler supplies the interleaving
+pressure.  Scenario RNG is seeded with a string (hash-stable across
+processes) so the workload — like the Thrasher's — replays from the
+seed.
+
+    thrash     LocalCluster + qa/thrasher.py events (kills, netsplits,
+               EC EIO, corruption, mon churn) — the widest seam sweep
+    mon_churn  repeated elections racing client I/O and mon commands —
+               the mon send-loop / elector / paxos surface
+    ec_io      EC writes/reads with seeded shard-read EIO — the OSD
+               EC backend + recovery surface
+"""
+from __future__ import annotations
+
+import random
+
+from .runtime import DeadlockError, race_session
+from .scheduler import make_scheduler
+
+
+def _thrash(seed: int, events: int) -> dict:
+    from ..thrasher import Thrasher
+    from ..vstart import LocalCluster
+
+    with LocalCluster(n_mons=3, n_osds=4) as c:
+        c.create_ec_pool("race", k=2, m=1)
+        th = Thrasher(c, seed, pool="race")
+        th.run(events)
+        th.quiesce()
+    return {"thrash_events": events, "acked_writes": len(th.acked),
+            "workload_digest": th.plan_digest(events)}
+
+
+def _mon_churn(seed: int, events: int) -> dict:
+    from ..vstart import LocalCluster
+
+    rng = random.Random(f"cephrace-mon-churn-{seed}")
+    churns = 0
+    with LocalCluster(n_mons=3, n_osds=2) as c:
+        c.create_replicated_pool("race_rc", size=2)
+        io = c.client().open_ioctx("race_rc")
+        for i in range(events):
+            name = chr(ord("a") + rng.randrange(c.n_mons))
+            mon = c.mons.get(name)
+            if mon is not None and rng.random() < 0.7:
+                mon.elector.start_election()
+                churns += 1
+            io.write_full(f"churn-{i}", bytes([i & 0xFF]) * 256)
+            if rng.random() < 0.5:
+                try:
+                    io.read(f"churn-{rng.randrange(i + 1)}")
+                except (IOError, OSError, TimeoutError, KeyError):
+                    pass   # mid-election turbulence is the point
+    return {"mon_churn_events": events, "elections": churns}
+
+
+def _ec_io(seed: int, events: int) -> dict:
+    from ...common.failpoint import registry
+    from ..vstart import LocalCluster
+
+    rng = random.Random(f"cephrace-ec-io-{seed}")
+    eios = 0
+    with LocalCluster(n_mons=1, n_osds=4) as c:
+        c.create_ec_pool("race_ec", k=2, m=1)
+        io = c.client().open_ioctx("race_ec")
+        for i in range(events):
+            if rng.random() < 0.4:
+                osd = rng.randrange(c.n_osds)
+                registry().add("osd.ec.shard_read", "times(1,error)",
+                               match={"entity": f"osd.{osd}"})
+                eios += 1
+            payload = bytes(rng.getrandbits(8) for _ in range(512))
+            io.write_full(f"ec-{i}", payload)
+            got = io.read(f"ec-{i}")
+            assert got == payload, f"ec readback mismatch on ec-{i}"
+    return {"ec_io_events": events, "eio_injected": eios}
+
+
+SCENARIOS = {
+    "thrash": _thrash,
+    "mon_churn": _mon_churn,
+    "ec_io": _ec_io,
+}
+
+DEFAULT_EVENTS = {"thrash": 8, "mon_churn": 6, "ec_io": 10}
+
+
+def run_scenario(name: str, seed: int, events: int | None = None,
+                 sched: str = "perturb", depth: int = 3,
+                 targets=None, target_dirs=None):
+    """Run one scenario under the full detector; returns
+    (RaceRuntime, scenario-extras dict)."""
+    fn = SCENARIOS[name]
+    n = events if events is not None else DEFAULT_EVENTS[name]
+    scheduler = make_scheduler(sched, seed, depth) if sched != "none" else None
+    with race_session(seed, scheduler=scheduler, targets=targets,
+                      target_dirs=target_dirs) as rt:
+        try:
+            extras = fn(seed, n)
+        except DeadlockError as e:
+            # the cycle closed at an acquire made by the scenario's own
+            # (main) thread: the CR2 finding is already recorded — this
+            # is the detector SUCCEEDING, not the scenario crashing, so
+            # the run must still report
+            extras = {"scenario_aborted": f"deadlock: {e}"}
+    extras["scenario"] = name
+    extras["seed"] = seed
+    extras["sched"] = sched
+    if scheduler is not None:
+        extras["sched_plan"] = scheduler.plan.describe()
+        extras["sched_breaches"] = scheduler.breaches
+    extras["trace_events"] = len(rt.trace.events)
+    extras["trace_digest"] = rt.trace.digest()
+    return rt, extras

@@ -1,0 +1,282 @@
+"""Seeded PCT-style schedule exploration.
+
+PCT (probabilistic concurrency testing) in its classic form: give every
+thread a distinct random priority, pick d-1 schedule points at which the
+running thread's priority drops below everyone else's, and always run
+the highest-priority enabled thread.  A bug of preemption depth d is
+found with probability >= 1/(n * k^(d-1)) — so short seeded runs explore
+interleavings a plain run essentially never hits.
+
+Both schedulers here derive their entire plan (per-registration-order
+priorities, change points, change values) purely from the seed, exactly
+like qa/thrasher.py's ``plan()``: the plan IS the replay artifact.
+
+Two enforcement modes:
+
+- ``PerturbScheduler`` — production mode, safe under a full LocalCluster:
+  at each instrumented sync point the current thread sleeps a delay
+  proportional to how far it is from the top priority.  No global token,
+  no risk of stalling a thread that blocks outside instrumented points.
+  The *decisions* are deterministic; the resulting trace is only as
+  deterministic as the host's threading.
+
+- ``SerializeScheduler`` — fixture mode: one global token; every
+  registered thread runs alone between sync points and hands the token
+  to the highest-priority runnable thread.  Blocking operations bracket
+  themselves with ``block_begin``/``block_end`` so the token never sits
+  inside a real wait.  With deterministic per-thread programs this makes
+  the whole event trace bit-for-bit reproducible from the seed — the
+  property tests/test_race.py gates.
+"""
+from __future__ import annotations
+
+import random
+import threading
+
+_READY = 0      # waiting at a sync point for the token
+_RUNNING = 1    # holds the token
+_BLOCKED = 2    # inside a real blocking operation (token released)
+_DONE = 3
+
+#: safety valve: a serialized thread never waits for the token longer
+#: than this before proceeding anyway (records a breach — determinism is
+#: formally broken but the run survives a scheduler bug or an
+#: uninstrumented blocking call)
+_GRANT_TIMEOUT = 10.0
+
+
+class SchedulerPlan:
+    """The pure-from-seed part, shared by both modes."""
+
+    def __init__(self, seed: int, depth: int = 3, horizon: int = 4096,
+                 max_threads: int = 64):
+        self.seed = seed
+        self.depth = depth
+        self.horizon = horizon
+        # string seeds hash deterministically across processes (tuple
+        # seeds would go through PYTHONHASHSEED-salted hash())
+        rng = random.Random(f"cephrace-sched-{seed}")
+        # distinct priorities handed out in registration order; higher
+        # wins.  A second block of low values serves the change points.
+        pr = list(range(1000, 1000 + max_threads))
+        rng.shuffle(pr)
+        self.priorities = pr
+        k = max(0, depth - 1)
+        points = sorted(rng.sample(range(1, horizon), k)) if k else []
+        self.change_points = points
+        self.change_values = [rng.randrange(0, 100) for _ in points]
+
+    def describe(self) -> dict:
+        return {
+            "seed": self.seed,
+            "depth": self.depth,
+            "priorities": self.priorities[:16],
+            "change_points": self.change_points,
+            "change_values": self.change_values,
+        }
+
+
+class _SchedulerBase:
+    #: True when the scheduler guarantees one-thread-at-a-time between
+    #: sync points; the runtime then routes attribute READS through
+    #: yield_point too (a read emitted off-token would land in the trace
+    #: at raw CPU timing, breaking same-seed replay)
+    serialize_mode = False
+
+    def __init__(self, seed: int, depth: int = 3, horizon: int = 4096):
+        self.plan = SchedulerPlan(seed, depth, horizon)
+        self._prio: dict[int, int] = {}
+        self._next_reg = 0
+        self._point = 0
+        self._next_change = 0
+        self._lock = threading.Lock()
+        self.breaches = 0
+
+    def register(self, tid: int) -> None:
+        with self._lock:
+            if tid in self._prio:
+                return
+            pr = self.plan.priorities
+            self._prio[tid] = pr[self._next_reg % len(pr)]
+            self._next_reg += 1
+
+    def _advance_point_locked(self, tid: int) -> None:
+        """Global sync-point counter + PCT priority change points."""
+        self._point += 1
+        cps = self.plan.change_points
+        if self._next_change < len(cps) and self._point >= cps[self._next_change]:
+            self._prio[tid] = self.plan.change_values[self._next_change]
+            self._next_change += 1
+
+    # interface the runtime drives; overridden per mode
+    def yield_point(self, tid: int) -> None:
+        raise NotImplementedError
+
+    def block_begin(self, tid: int) -> None:
+        pass
+
+    def block_end(self, tid: int) -> None:
+        pass
+
+    def thread_exit(self, tid: int) -> None:
+        pass
+
+    def shutdown(self) -> None:
+        pass
+
+
+class PerturbScheduler(_SchedulerBase):
+    """Priority-biased sleep injection (cluster-safe)."""
+
+    def __init__(self, seed: int, depth: int = 3, horizon: int = 4096,
+                 base_delay: float = 0.0005, max_delay: float = 0.004):
+        super().__init__(seed, depth, horizon)
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+
+    def yield_point(self, tid: int) -> None:
+        with self._lock:
+            if tid not in self._prio:
+                return
+            self._advance_point_locked(tid)
+            prio = self._prio[tid]
+            ranked = sorted(self._prio.values(), reverse=True)
+            rank = ranked.index(prio)
+        if rank:
+            import time
+
+            time.sleep(min(self.base_delay * rank, self.max_delay))
+
+
+class _SThread:
+    __slots__ = ("state", "gate")
+
+    def __init__(self) -> None:
+        self.state = _READY
+        self.gate = threading.Event()
+
+
+class SerializeScheduler(_SchedulerBase):
+    """Cooperative single-token serialization (fixture mode)."""
+
+    serialize_mode = True
+
+    def __init__(self, seed: int, depth: int = 3, horizon: int = 4096):
+        super().__init__(seed, depth, horizon)
+        self._threads: dict[int, _SThread] = {}
+        self._current: int | None = None
+        self._active = True
+
+    def register(self, tid: int) -> None:
+        super().register(tid)
+        with self._lock:
+            if tid in self._threads:
+                return
+            st = _SThread()
+            self._threads[tid] = st
+            if self._current is None:
+                self._current = tid
+                st.state = _RUNNING
+                st.gate.set()
+
+    def yield_point(self, tid: int) -> None:
+        if not self._active:
+            return
+        with self._lock:
+            st = self._threads.get(tid)
+            if st is None:
+                return
+            # only the TOKEN HOLDER's yields advance the schedule-point
+            # counter: a thread merely ARRIVING at its first yield (or
+            # re-parking) is bootstrap-timing noise, and counting it
+            # would land the PCT change points on different points of
+            # the schedule run-to-run — breaking same-seed replay
+            if self._current == tid:
+                self._advance_point_locked(tid)
+            st.state = _READY
+            st.gate.clear()
+            self._grant_locked()
+        self._await_gate(tid)
+
+    def block_begin(self, tid: int) -> None:
+        """Called before a real blocking op: hand the token off so the
+        thread we may be waiting FOR can run."""
+        if not self._active:
+            return
+        with self._lock:
+            st = self._threads.get(tid)
+            if st is None:
+                return
+            st.state = _BLOCKED
+            st.gate.clear()
+            if self._current == tid:
+                self._current = None
+            self._grant_locked()
+
+    def block_end(self, tid: int) -> None:
+        if not self._active:
+            return
+        with self._lock:
+            st = self._threads.get(tid)
+            if st is None:
+                return
+            st.state = _READY
+            # defensively drop any stale grant before re-granting: an
+            # already-set gate would let _await_gate fall through while
+            # the token went to another thread (two live runners)
+            st.gate.clear()
+            self._grant_locked()
+        self._await_gate(tid)
+
+    def thread_exit(self, tid: int) -> None:
+        with self._lock:
+            st = self._threads.get(tid)
+            if st is None:
+                return
+            st.state = _DONE
+            if self._current == tid:
+                self._current = None
+            self._grant_locked()
+
+    def shutdown(self) -> None:
+        """Release everyone (end of scenario / teardown)."""
+        with self._lock:
+            self._active = False
+            for st in self._threads.values():
+                st.gate.set()
+
+    # -- internals ----------------------------------------------------------
+    def _grant_locked(self) -> None:
+        if self._current is not None:
+            cur = self._threads[self._current]
+            if cur.state == _RUNNING:
+                return
+        ready = [(self._prio[t], t) for t, st in self._threads.items()
+                 if st.state == _READY]
+        if not ready:
+            self._current = None
+            return
+        _, chosen = max(ready)
+        self._current = chosen
+        st = self._threads[chosen]
+        st.state = _RUNNING
+        st.gate.set()
+
+    def _await_gate(self, tid: int) -> None:
+        st = self._threads[tid]
+        if not st.gate.wait(timeout=_GRANT_TIMEOUT):
+            # safety valve (see _GRANT_TIMEOUT): proceed un-granted
+            with self._lock:
+                self.breaches += 1
+                st.state = _RUNNING
+                if self._current is None:
+                    self._current = tid
+                st.gate.set()
+
+
+def make_scheduler(mode: str, seed: int, depth: int = 3) -> _SchedulerBase:
+    if mode == "serialize":
+        return SerializeScheduler(seed, depth)
+    if mode == "perturb":
+        return PerturbScheduler(seed, depth)
+    raise ValueError(f"unknown scheduler mode {mode!r}")

@@ -187,6 +187,17 @@ class Thrasher:
                 )
         return events
 
+    def plan_digest(self, n_events: int) -> str:
+        """Stable fingerprint of plan(n_events) — cheap cross-process
+        replay verification (cephrace embeds it in its run metadata so a
+        finding's workload can be matched to a re-run bit-for-bit)."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for ev in self.plan(n_events):
+            h.update(repr(ev).encode())
+        return h.hexdigest()[:16]
+
     # -- execution ---------------------------------------------------------
     def run(self, n_events: int) -> list[tuple]:
         """Plan and execute `n_events`; returns the event log (identical

@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# qa/ci_gate.sh — both analyzers outside pytest, SARIF artifacts for CI.
+#
+#   qa/ci_gate.sh [BASE_REF] [SEED]
+#
+# 1. cephlint --diff BASE_REF  (default origin/main, falling back to
+#    HEAD~1): whole-package static analysis, report narrowed to the
+#    files changed since BASE_REF.
+# 2. cephrace --seed SEED (default 1): the short seeded thrash scenario
+#    under the dynamic detector.
+#
+# Both emit SARIF 2.1.0 into qa/_sarif/ (github code-scanning uploads
+# resolve URIs against the repo root, which is where this script runs
+# from).  Exit is non-zero if EITHER gate reports active findings —
+# the same exit contracts the pytest gates (tests/test_analyzer.py,
+# tests/test_race.py) enforce.
+set -u
+cd "$(dirname "$0")/.."
+
+BASE_REF="${1:-}"
+SEED="${2:-1}"
+OUT_DIR="qa/_sarif"
+mkdir -p "$OUT_DIR"
+
+if [ -z "$BASE_REF" ]; then
+    if git rev-parse --verify -q origin/main >/dev/null; then
+        BASE_REF=origin/main
+    else
+        BASE_REF=HEAD~1
+    fi
+fi
+
+rc=0
+
+echo "== cephlint (diff vs $BASE_REF) =="
+python -m ceph_tpu.qa.analyzer --diff "$BASE_REF" --format=sarif \
+    > "$OUT_DIR/cephlint.sarif"
+lint_rc=$?
+if [ $lint_rc -ge 2 ]; then
+    # usage/parse error, not findings: the sarif on stdout is garbage —
+    # drop it rather than hand CI an empty/invalid artifact
+    rm -f "$OUT_DIR/cephlint.sarif"
+    echo "cephlint: ERROR (exit $lint_rc):"
+    python -m ceph_tpu.qa.analyzer --diff "$BASE_REF" || true
+    rc=1
+elif [ $lint_rc -eq 1 ]; then
+    echo "cephlint: findings on changed files:"
+    python -m ceph_tpu.qa.analyzer --diff "$BASE_REF" || true
+    rc=1
+else
+    echo "cephlint: clean"
+fi
+
+echo "== cephrace (seeded thrash, seed=$SEED) =="
+JAX_PLATFORMS=cpu python -m ceph_tpu.qa.race --seed "$SEED" \
+    --scenario thrash --events 4 --format=sarif \
+    > "$OUT_DIR/cephrace.sarif"
+race_rc=$?
+if [ $race_rc -ge 2 ]; then
+    rm -f "$OUT_DIR/cephrace.sarif"
+    echo "cephrace: ERROR (exit $race_rc) — scenario crashed or baseline unreadable"
+    rc=1
+elif [ $race_rc -eq 1 ]; then
+    echo "cephrace: findings:"
+    JAX_PLATFORMS=cpu python -m ceph_tpu.qa.race --seed "$SEED" \
+        --scenario thrash --events 4 || true
+    rc=1
+else
+    echo "cephrace: clean"
+fi
+
+echo "SARIF written to $OUT_DIR/ (cephlint.sarif, cephrace.sarif)"
+exit $rc

@@ -21,6 +21,16 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402  (already imported by sitecustomize; config still mutable)
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# NOTE (tier-1 budget, measured in the cephrace PR): session-scoping a
+# shared LocalCluster here was tried and came out ~100 s SLOWER — a
+# live cluster's tick/scrub/heartbeat threads burn CPU for the whole
+# session and every module's pools pile onto one recovery/scrub cycle.
+# Cluster start is ~0.3 s, stop ~0.01 s: per-module clusters are the
+# cheap option.  The levers that actually hold the 870 s cap are
+# @pytest.mark.slow on soaks and fixing real teardown bugs (e.g. the
+# cephadm zombie-wait in deploy/cephadm.py::_alive).
 # NOTE: x64 is deliberately NOT enabled globally here.  The CRUSH mapper
 # scopes jax_enable_x64 to its own traces (crush/mapper.py enable_x64); a
 # global flip would hide exactly the class of bug that broke the Pallas

@@ -27,6 +27,7 @@ def _wait(pred, timeout=30.0):
     return pred()
 
 
+@pytest.mark.slow   # ~30 s whole-stack compose soak
 def test_all_subsystems_compose_through_osd_crash():
     with LocalCluster(n_mons=1, n_osds=5, with_mgr=True,
                       with_mds=True) as c:

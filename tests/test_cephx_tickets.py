@@ -342,6 +342,7 @@ class TestSignedFrames:
 
 
 @pytest.mark.cluster
+@pytest.mark.slow   # ~25 s of ticket-expiry wall-clock waits
 def test_ring2_ticket_client_and_rotation():
     """Ring-2 (verdict r3 task #3 'done' criteria): a client holding ONLY
     mon-minted tickets — no cluster secret — performs real I/O against a

@@ -18,6 +18,10 @@ pytestmark = pytest.mark.cluster
 
 @pytest.fixture(scope="module")
 def cluster():
+    # module-scoped by measurement, not oversight: a session-shared
+    # cluster (tried in the cephrace PR) kept 9 daemons ticking and
+    # scrubbing for the whole 700 s session and slowed the suite by
+    # ~100 s — teardown at module end is cheaper than a live cluster
     with LocalCluster(n_mons=3, n_osds=6) as c:
         c.create_ec_pool("ecpool", k=4, m=2)
         c.create_replicated_pool("repl", size=3)
@@ -252,6 +256,8 @@ def _primary_peer(c, pool_name):
     return victim
 
 
+@pytest.mark.slow   # ~34 s soak; the seeded cephrace thrash gate covers
+# the short-thrash path in tier-1 (tier-1 runs under a hard 870 s cap)
 def test_thrash_soak():
     """Randomized kill/revive during writes — zero data loss (reference:
     qa/tasks/thrashosds.py).  Bounded to ~4 cycles to stay CI-sized."""

@@ -137,6 +137,7 @@ def test_rank1_failure_takeover_namespace_intact(cluster):
         fs.unmount()
 
 
+@pytest.mark.slow   # ~24 s multi-rank failover traffic soak
 def test_traffic_through_rank_failure():
     """Thrash: a writer stream into the pinned subtree survives the
     owning rank's crash — requests retry through redirects/fallback and

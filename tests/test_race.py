@@ -1,0 +1,443 @@
+"""cephrace (ceph_tpu.qa.race) — TP/TN fixture pairs per detector state,
+seed-replay determinism, suppression layers, and the tier-1 seeded
+thrash gate.
+
+Fixture tests drive purpose-built classes through race_session with
+explicit targets (no package scan) so each detector state is exercised
+in isolation and fast; the gate at the bottom is the PR's teeth: a short
+seeded thrash of a real LocalCluster under the full detector
+(instrumentation targets from the cephlint symbol table) must report
+zero unbaselined findings.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.common.lockdep import make_lock
+from ceph_tpu.qa.race import report as race_report
+from ceph_tpu.qa.race.events import VectorClock
+from ceph_tpu.qa.race.runtime import RaceFinding, race_session
+from ceph_tpu.qa.race.scheduler import SchedulerPlan, make_scheduler
+
+pytestmark = pytest.mark.cluster
+
+
+class Shared:
+    """Fixture class with one lock and a few attrs; instrumented
+    explicitly (targets=(Shared,))."""
+
+    def __init__(self):
+        self._lock = make_lock("fix::shared")
+        self.count = 0
+        self.tag = "init"
+
+    def bump_unlocked(self):
+        self.count = self.count + 1
+
+    def bump_locked(self):
+        with self._lock:
+            self.count = self.count + 1
+
+    def read_tag(self):
+        return self.tag
+
+
+def _run_threads(*targets):
+    ts = [threading.Thread(target=t) for t in targets]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(10)
+
+
+def codes(rt) -> set[str]:
+    return {f.code for f in rt.findings}
+
+
+def idents(rt) -> set[str]:
+    return {f.ident for f in rt.findings}
+
+
+# -- CR1: lockset states ----------------------------------------------------
+
+def test_racy_write_true_positive():
+    with race_session(seed=11, targets=(Shared,)) as rt:
+        s = Shared()
+        _run_threads(s.bump_unlocked, s.bump_unlocked)
+    assert "race:Shared.count" in idents(rt), rt.findings
+
+
+def test_lockset_protected_true_negative():
+    with race_session(seed=11, targets=(Shared,)) as rt:
+        s = Shared()
+        _run_threads(s.bump_locked, s.bump_locked)
+    assert codes(rt) == set(), rt.findings
+
+
+def test_shared_read_only_true_negative():
+    # init-write then cross-thread reads: Eraser's SHARED state, benign
+    with race_session(seed=11, targets=(Shared,)) as rt:
+        s = Shared()
+        _run_threads(s.read_tag, s.read_tag, s.read_tag)
+    assert codes(rt) == set(), rt.findings
+
+
+def test_queue_handoff_orders_accesses():
+    # empty lockset BUT queue put->get happens-before: no race
+    import queue
+
+    with race_session(seed=11, targets=(Shared,)) as rt:
+        s = Shared()
+        q: "queue.Queue" = queue.Queue()
+
+        def producer():
+            s.count = 1          # write, no lock
+            q.put("token")
+
+        def consumer():
+            q.get(timeout=5)     # ordered after the put
+            s.count = 2          # write, no lock — but ordered
+
+        _run_threads(producer, consumer)
+    assert codes(rt) == set(), rt.findings
+
+
+def test_fork_join_orders_accesses():
+    with race_session(seed=11, targets=(Shared,)) as rt:
+        s = Shared()
+        t = threading.Thread(target=s.bump_unlocked)
+        t.start()
+        t.join(10)
+        s.bump_unlocked()        # strictly after the join: ordered
+    assert codes(rt) == set(), rt.findings
+
+
+# -- CR2: deadlock under schedule perturbation ------------------------------
+
+class TwoLocks:
+    def __init__(self):
+        self.l1 = make_lock("fix::dl-a")
+        self.l2 = make_lock("fix::dl-b")
+        self.entered = threading.Event()   # invisible to the detector
+
+
+def test_deadlock_true_positive():
+    d = TwoLocks()
+
+    def ab():
+        with d.l1:
+            d.entered.set()
+            time.sleep(0.15)      # hold l1 while ba grabs l2
+            with d.l2:
+                pass
+
+    def ba():
+        with d.l2:
+            d.entered.wait(5)
+            time.sleep(0.15)      # both sides now hold their first lock
+            with d.l1:
+                pass
+
+    with race_session(seed=13, targets=(TwoLocks,)) as rt:
+        _run_threads(ab, ba)
+    assert "CR2" in codes(rt), rt.findings
+    assert any(i.startswith("deadlock:") for i in idents(rt))
+
+
+def test_ordered_locks_true_negative():
+    d = TwoLocks()
+
+    def ab():
+        with d.l1:
+            with d.l2:
+                pass
+
+    with race_session(seed=13, targets=(TwoLocks,)) as rt:
+        _run_threads(ab, ab)
+    assert codes(rt) == set(), rt.findings
+
+
+# -- CR3: lost wakeup --------------------------------------------------------
+
+def test_lost_wakeup_true_positive():
+    cond = threading.Condition(make_lock("fix::lw-tp"))
+    with race_session(seed=17, targets=()) as rt:
+        def notifier():
+            with cond:
+                cond.notify()     # fires with no waiter: lost
+
+        def waiter():
+            with cond:
+                cond.wait(0.2)    # the signal it needed already fired
+
+        t1 = threading.Thread(target=notifier)
+        t1.start()
+        t1.join(10)
+        t2 = threading.Thread(target=waiter)
+        t2.start()
+        t2.join(10)
+    assert "CR3" in codes(rt), rt.findings
+
+
+def test_lost_wakeup_true_negative_waiter_first():
+    cond = threading.Condition(make_lock("fix::lw-tn"))
+    with race_session(seed=17, targets=()) as rt:
+        def waiter():
+            with cond:
+                cond.wait(3.0)
+
+        t2 = threading.Thread(target=waiter)
+        t2.start()
+        time.sleep(0.2)           # waiter is parked before the notify
+
+        def notifier():
+            with cond:
+                cond.notify()
+
+        t1 = threading.Thread(target=notifier)
+        t1.start()
+        t1.join(10)
+        t2.join(10)
+    assert codes(rt) == set(), rt.findings
+
+
+def test_lost_wakeup_true_negative_predicate_recheck():
+    # the while-recheck idiom: a no-waiter notify whose predicate was
+    # later observed in a quiet critical section is unneeded, not lost
+    cond = threading.Condition(make_lock("fix::lw-rc"))
+    items: list[int] = []
+    with race_session(seed=17, targets=()) as rt:
+        def producer():
+            with cond:
+                items.append(1)
+                cond.notify()
+
+        def consumer():
+            with cond:
+                if items:
+                    items.pop()   # predicate observed, no wait needed
+            with cond:
+                cond.wait(0.15)   # later idle timeout: not a lost wakeup
+
+        t1 = threading.Thread(target=producer)
+        t1.start()
+        t1.join(10)
+        t2 = threading.Thread(target=consumer)
+        t2.start()
+        t2.join(10)
+    assert codes(rt) == set(), rt.findings
+
+
+def test_try_lock_is_not_a_deadlock():
+    # a blocking=False probe on a held lock resolves on its own; it must
+    # return False quietly, never raise DeadlockError or record CR2
+    d = TwoLocks()
+
+    def holder():
+        with d.l1:
+            time.sleep(0.3)
+
+    results = []
+
+    def prober():
+        with d.l2:                        # prober holds l2...
+            time.sleep(0.1)               # ...while holder holds l1
+            results.append(d.l1.acquire(blocking=False))
+            if results[-1]:
+                d.l1.release()
+
+    with race_session(seed=19, targets=(TwoLocks,)) as rt:
+        _run_threads(holder, prober)
+    assert results == [False]
+    assert codes(rt) == set(), rt.findings
+
+
+def test_lost_wakeup_through_wait_for():
+    # wait_for is the tree's dominant wait idiom; its timeout after a
+    # no-waiter notify must report CR3 like bare wait does
+    cond = threading.Condition(make_lock("fix::lw-wf"))
+    with race_session(seed=29, targets=()) as rt:
+        def notifier():
+            with cond:
+                cond.notify()
+
+        def waiter():
+            with cond:
+                cond.wait_for(lambda: False, timeout=0.2)
+
+        t1 = threading.Thread(target=notifier)
+        t1.start()
+        t1.join(10)
+        t2 = threading.Thread(target=waiter)
+        t2.start()
+        t2.join(10)
+    assert "CR3" in codes(rt), rt.findings
+
+
+def test_wait_for_satisfied_predicate_is_quiet():
+    cond = threading.Condition(make_lock("fix::wf-ok"))
+    with race_session(seed=29, targets=()) as rt:
+        def waiter():
+            with cond:
+                assert cond.wait_for(lambda: True, timeout=0.2)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        t.join(10)
+    assert codes(rt) == set(), rt.findings
+
+
+# -- seed replay determinism -------------------------------------------------
+
+def _serialized_run(seed: int):
+    sched = make_scheduler("serialize", seed)
+    with race_session(seed=seed, scheduler=sched, targets=(Shared,)) as rt:
+        s = Shared()
+        _run_threads(s.bump_unlocked, s.bump_unlocked, s.bump_locked)
+    return rt, sched
+
+
+def test_same_seed_reproduces_identical_trace():
+    # several repeats: the historical failure mode was BIMODAL (thread
+    # bootstrap timing deciding grant order / off-token read events), so
+    # a single pair of runs could pass by luck
+    runs = [_serialized_run(23) for _ in range(4)]
+    assert all(s.breaches == 0 for _, s in runs)
+    first = runs[0][0]
+    for rt, _s in runs[1:]:
+        assert rt.trace.as_tuples() == first.trace.as_tuples()
+    # findings replay too
+    assert len({tuple((f.code, f.ident) for f in rt.findings)
+                for rt, _s in runs}) == 1
+
+
+def test_try_lock_under_serialize_keeps_one_runner():
+    # a bounded acquire skips block_begin; the matching block_end must
+    # be skipped too, or the serialize token is granted away while the
+    # caller keeps running (two live threads -> nondeterministic trace)
+    class Probing:
+        def __init__(self):
+            self._lock = make_lock("fix::probe")
+            self.n = 0
+
+        def go(self):
+            got = self._lock.acquire(blocking=False)
+            if got:
+                self._lock.release()
+            self.n = self.n + 1
+
+    def run(seed):
+        sched = make_scheduler("serialize", seed)
+        with race_session(seed=seed, scheduler=sched,
+                          targets=(Probing,)) as rt:
+            p = Probing()
+            _run_threads(p.go, p.go)
+        return rt.trace.as_tuples(), sched.breaches
+
+    runs = [run(31) for _ in range(4)]
+    assert all(b == 0 for _, b in runs)
+    assert len({tuple(t) for t, _ in runs}) == 1, runs
+
+
+def test_schedule_plan_is_pure_function_of_seed():
+    p1 = SchedulerPlan(99).describe()
+    p2 = SchedulerPlan(99).describe()
+    p3 = SchedulerPlan(100).describe()
+    assert p1 == p2
+    assert p1 != p3
+
+
+def test_vector_clock_algebra():
+    a, b = VectorClock(), VectorClock()
+    a.tick(0)
+    snap = a.snapshot()
+    assert not b.dominates(snap)
+    b.join(a)
+    assert b.dominates(snap)
+    a.tick(0)
+    assert not b.dominates(a.snapshot())
+
+
+# -- suppression layers ------------------------------------------------------
+
+def _finding(path="osd/daemon.py", ident="race:Fake.attr", code="CR1"):
+    return RaceFinding(code=code, path=path, line=1, ident=ident,
+                       message="fixture finding")
+
+
+def test_baseline_wildcard_path_matches(tmp_path):
+    base = tmp_path / "race_baseline.toml"
+    base.write_text(
+        '[[suppress]]\ncode = "CR1"\npath = "*"\n'
+        'ident = "race:Fake.attr"\nreason = "fixture: either site"\n')
+    rep = race_report.build_report([_finding()], baseline_file=base)
+    assert rep.clean
+    assert [f.ident for f in rep.baselined] == ["race:Fake.attr"]
+    # a different ident is NOT matched
+    rep2 = race_report.build_report(
+        [_finding(ident="race:Other.attr")], baseline_file=base)
+    assert not rep2.clean
+
+
+def test_stale_race_baseline_warns_but_stays_clean(tmp_path):
+    base = tmp_path / "race_baseline.toml"
+    base.write_text(
+        '[[suppress]]\ncode = "CR1"\npath = "*"\n'
+        'ident = "race:Gone.attr"\nreason = "schedule-dependent"\n')
+    rep = race_report.build_report([], baseline_file=base)
+    assert rep.clean            # unlike cephlint: stale only warns
+    assert rep.stale_baseline
+
+
+def test_render_formats(tmp_path):
+    rep = race_report.build_report([_finding()],
+                                   baseline_file=tmp_path / "none.toml")
+    text = race_report.render(rep, "text")
+    assert "cephrace:" in text and "CR1" in text
+    import json
+
+    sarif = json.loads(race_report.render(rep, "sarif"))
+    drv = sarif["runs"][0]["tool"]["driver"]
+    assert drv["name"] == "cephrace"
+    assert sarif["runs"][0]["results"][0]["ruleId"] == "CR1"
+
+
+# -- the tier-1 gate ---------------------------------------------------------
+
+GATE_SEED = 1
+
+
+def test_targets_come_from_the_symbol_table():
+    from ceph_tpu.qa.race.instrument import discover_targets
+
+    targets = discover_targets()
+    names = {c.__name__ for c in targets}
+    # the concurrency families the tentpole names must be covered
+    assert "Messenger" in names
+    assert any(n.endswith("Mixin") for n in names), names   # OSD family
+    assert any("Paxos" in n or "Elector" in n for n in names), names
+
+
+def test_package_thrash_under_detector_is_clean():
+    """A short seeded thrash of a real cluster under the full detector:
+    zero unbaselined findings.  A new finding means fix the code, or add
+    a justified qa/race/baseline.toml entry — see docs/race_detection.md."""
+    from ceph_tpu.qa.race.scenarios import run_scenario
+
+    rt, extras = run_scenario("thrash", GATE_SEED, events=4,
+                              sched="perturb")
+    rep = race_report.build_report(rt.findings)
+    assert rep.clean, "\n" + race_report.render(rep, "text")
+    # the thrash workload itself replays from the seed (Thrasher.plan
+    # purity rides the same gate)
+    from ceph_tpu.qa.thrasher import Thrasher
+
+    p1 = Thrasher(None, GATE_SEED, pool="race", n_osds=4, n_mons=3).plan(4)
+    p2 = Thrasher(None, GATE_SEED, pool="race", n_osds=4, n_mons=3).plan(4)
+    assert p1 == p2
+    # the executed workload fingerprint matches an independent re-plan
+    assert extras["workload_digest"] == Thrasher(
+        None, GATE_SEED, pool="race", n_osds=4, n_mons=3).plan_digest(4)

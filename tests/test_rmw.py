@@ -17,6 +17,7 @@ pytestmark = pytest.mark.cluster
 
 @pytest.fixture(scope="module")
 def cluster():
+    # module-scoped by measurement (see test_cluster.py's fixture note)
     with LocalCluster(n_mons=3, n_osds=6) as c:
         c.create_ec_pool("ecrmw", k=4, m=2)
         c.create_replicated_pool("replrmw", size=3)
@@ -317,6 +318,7 @@ def test_append_dup_survives_primary_change(cluster):
         cl.shutdown()
 
 
+@pytest.mark.slow   # ~33 s of wall-clock min_size gate waits
 def test_min_size_gate_refuses_writes_and_resumes(cluster):
     """A 4+2 pool (min_size 5) with 2 OSDs down must refuse writes
     BEFORE mutating anything, and take them again once the acting set
