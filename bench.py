@@ -51,6 +51,7 @@ PHASE_TIMEOUTS = {
     "crush": 600,
     "shec": 420,
     "clay": 420,
+    "traffic": 300,
 }
 
 #: last good on-silicon capture: when the tunnel is wedged the JSON line
@@ -59,7 +60,7 @@ PHASE_TIMEOUTS = {
 LAST_SILICON_CAPTURE = "perf_runs/full_bench_r4_early.json"
 # crush LAST: the 1M-PG batch launch is the one phase that has wedged
 # the tunnel (r2, r4) — a wedge there must not cost the shec/clay columns
-TPU_PHASES = ("rs84", "rs21", "shec", "clay", "crush")
+TPU_PHASES = ("rs84", "rs21", "shec", "clay", "traffic", "crush")
 
 
 # ---------------------------------------------------------------- measurement
@@ -319,6 +320,18 @@ def phase_clay() -> dict:
     )}
 
 
+def phase_traffic() -> dict:
+    """Sustained-traffic scenario (ceph_tpu/bench/traffic.py): N
+    simulated clients x 4 KiB writes through the production
+    WriteBatcher, batched vs per-op — aggregate GiB/s + p99 latency,
+    the ROADMAP "millions of users" metric.  Runs on whatever backend
+    the child gets (TPU when the tunnel is healthy, CPU fallback
+    otherwise); the batched/per-op ratio is meaningful either way."""
+    from ceph_tpu.bench.traffic import run_scenario
+
+    return run_scenario(n_clients=32, seconds=3.0, write_size=4096)
+
+
 PHASES = {
     "cpu": phase_cpu,
     "probe": phase_probe,
@@ -327,6 +340,7 @@ PHASES = {
     "crush": phase_crush,
     "shec": phase_shec,
     "clay": phase_clay,
+    "traffic": phase_traffic,
 }
 
 

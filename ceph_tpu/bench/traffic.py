@@ -1,0 +1,233 @@
+"""Sustained-traffic benchmark: N simulated clients x small EC writes,
+batched (write batcher) vs per-op (inline codec), aggregate GiB/s and
+p99 latency — the ROADMAP "millions of users" metric.
+
+arXiv:1709.05365 (online EC on large-scale SSD arrays) shows system
+throughput under sustained small-write traffic is dominated by the
+queueing/batching structure in front of the codec, not the codec
+itself; this scenario measures exactly that layer.  Each simulated
+client is a closed-loop writer: prepare a 4 KiB stripe, submit to the
+encode stage, wait for parity, repeat.  ``batched`` mode drives the
+production ``WriteBatcher`` (osd/write_batcher.py) — the identical code
+path an OSD primary takes; ``perop`` mode submits through the same
+entry with coalescing off (ec_batch_window_ms=0), i.e. today's
+one-dispatch-per-stripe path.
+
+Usage (bench.py runs this as its "traffic" phase; qa/ci_gate.sh runs
+the tiny smoke configuration):
+
+    python -m ceph_tpu.bench.traffic --clients 32 --seconds 3 --json
+    python -m ceph_tpu.bench.traffic --clients 2 --seconds 2 --smoke
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def _chunk_len(write_size: int, k: int, align: int = 64) -> int:
+    """ErasureCode.get_chunk_size's shape: ceil(size/k), 64-aligned."""
+    padded = -(-write_size // k)
+    return -(-padded // align) * align
+
+
+def run_traffic(
+    mode: str,
+    n_clients: int = 32,
+    seconds: float = 3.0,
+    write_size: int = 4096,
+    k: int = 8,
+    m: int = 4,
+    window_ms: float = 2.0,
+    max_stripes: int = 64,
+    max_bytes: int = 8 << 20,
+    qd: int = 4,
+    warmup: float = 0.25,
+) -> dict:
+    """One mode's closed-loop run; returns ops/GiB-per-s/latency stats."""
+    from ..common.context import CephContext
+    from ..gf.matrix import cauchy_good_coding_matrix
+    from ..ops.bitplane import apply_matrix_jax
+    from ..osd.write_batcher import WriteBatcher
+
+    assert mode in ("batched", "perop"), mode
+    mat = np.ascontiguousarray(cauchy_good_coding_matrix(k, m), np.uint8)
+    L = _chunk_len(write_size, k)
+    rng = np.random.default_rng(1234)
+    # a small pool of distinct pre-built stripes per client keeps the
+    # generator out of the timed loop while avoiding constant-input
+    # caching artifacts
+    pool = [rng.integers(0, 256, (k, L), dtype=np.uint8) for _ in range(8)]
+    cct = CephContext(
+        f"client.traffic-{mode}",
+        overrides={
+            "ec_batch_window_ms": window_ms if mode == "batched" else 0.0,
+            "ec_batch_max_stripes": max_stripes,
+            "ec_batch_max_bytes": max_bytes,
+        },
+    )
+    batcher = WriteBatcher(cct, entity=f"client.traffic-{mode}")
+    batcher.start()
+    np.asarray(apply_matrix_jax(mat, pool[0]))  # compile/warm the kernel
+
+    stop_at = [0.0]
+    start_gate = threading.Event()
+    lats: list[list[float]] = [[] for _ in range(n_clients)]
+
+    def client(i: int) -> None:
+        # each simulated client keeps `qd` writes in flight (the async
+        # window a real Objecter's inflight budget allows), completing
+        # oldest-first — submit-to-parity latency per op
+        from collections import deque
+
+        my = lats[i]
+        inflight: deque = deque()
+        n = 0
+        start_gate.wait(timeout=30.0)
+        while time.monotonic() < stop_at[0]:
+            while len(inflight) < qd and time.monotonic() < stop_at[0]:
+                x = pool[(i + n) % len(pool)]
+                n += 1
+                inflight.append(
+                    (time.perf_counter(), batcher.encode_submit(mat, x))
+                )
+            if not inflight:  # clock crossed stop_at before any submit
+                break
+            t0, p = inflight.popleft()
+            batcher.encode_wait(p)
+            my.append(time.perf_counter() - t0)
+        while inflight:
+            t0, p = inflight.popleft()
+            batcher.encode_wait(p)
+            my.append(time.perf_counter() - t0)
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True,
+                         name=f"traffic-{i}")
+        for i in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    # warm the batching pipeline itself before the measured interval
+    stop_at[0] = time.monotonic() + warmup + seconds
+    start_gate.set()
+    time.sleep(warmup)
+    for lat in lats:
+        lat.clear()
+    t_begin = time.monotonic()
+    for t in threads:
+        t.join(timeout=seconds + 30.0)
+    elapsed = time.monotonic() - t_begin
+    batcher.stop()
+
+    all_lats = sorted(x for lat in lats for x in lat)
+    n_ops = len(all_lats)
+    stats = batcher.stats()
+    out = {
+        "mode": mode,
+        "clients": n_clients,
+        "write_size": write_size,
+        "seconds": round(elapsed, 3),
+        "ops": n_ops,
+        "gibps": round(n_ops * write_size / max(elapsed, 1e-9) / 2**30, 4),
+        "p50_ms": round(all_lats[n_ops // 2] * 1e3, 3) if n_ops else None,
+        "p99_ms": round(all_lats[min(n_ops - 1, int(n_ops * 0.99))] * 1e3, 3)
+        if n_ops else None,
+        "flushes": stats["flushes"],
+        "stripes_per_flush": round(stats["stripes"] / stats["flushes"], 2)
+        if stats["flushes"] else None,
+    }
+    return out
+
+
+def run_scenario(
+    n_clients: int = 32,
+    seconds: float = 3.0,
+    write_size: int = 4096,
+    k: int = 8,
+    m: int = 4,
+    window_ms: float = 2.0,
+    max_stripes: int = 64,
+    max_bytes: int = 8 << 20,
+    qd: int = 4,
+) -> dict:
+    """Both modes + the headline ratio, flat keys for bench.py's extra."""
+    perop = run_traffic("perop", n_clients, seconds, write_size, k, m,
+                        window_ms, max_stripes, max_bytes, qd)
+    batched = run_traffic("batched", n_clients, seconds, write_size, k, m,
+                          window_ms, max_stripes, max_bytes, qd)
+    speedup = (round(batched["gibps"] / perop["gibps"], 2)
+               if perop["gibps"] else None)
+    return {
+        "traffic_clients": n_clients,
+        "traffic_qd": qd,
+        "traffic_write_size": write_size,
+        "traffic_rs": f"{k}+{m}",
+        "traffic_batched_gibps": batched["gibps"],
+        "traffic_perop_gibps": perop["gibps"],
+        "traffic_batch_speedup": speedup,
+        "traffic_batched_p99_ms": batched["p99_ms"],
+        "traffic_perop_p99_ms": perop["p99_ms"],
+        "traffic_batched_p50_ms": batched["p50_ms"],
+        "traffic_perop_p50_ms": perop["p50_ms"],
+        "traffic_stripes_per_flush": batched["stripes_per_flush"],
+        "traffic_batched_ops": batched["ops"],
+        "traffic_perop_ops": perop["ops"],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="sustained small-write traffic: batched vs per-op "
+                    "encode (aggregate GiB/s + p99 latency)")
+    ap.add_argument("--clients", type=int, default=32)
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--write-size", type=int, default=4096)
+    ap.add_argument("-k", type=int, default=8)
+    ap.add_argument("-m", type=int, default=4)
+    ap.add_argument("--window-ms", type=float, default=2.0)
+    ap.add_argument("--max-stripes", type=int, default=64)
+    ap.add_argument("--max-bytes", type=int, default=8 << 20)
+    ap.add_argument("--qd", type=int, default=4,
+                    help="per-client async window (writes in flight)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON dict on stdout")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin jax to the CPU backend (via jax.config — "
+                    "the JAX_PLATFORMS env var is ignored by this box's "
+                    "sitecustomize)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate: exit 1 when the batched/per-op "
+                    "throughput ratio drops below 1.0")
+    args = ap.parse_args(argv)
+    if args.cpu or os.environ.get("CEPH_TPU_BENCH_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    res = run_scenario(args.clients, args.seconds, args.write_size,
+                       args.k, args.m, args.window_ms, args.max_stripes,
+                       args.max_bytes, args.qd)
+    if args.json:
+        print(json.dumps(res))
+    else:
+        for key in sorted(res):
+            print(f"{key}: {res[key]}")
+    if args.smoke:
+        ratio = res.get("traffic_batch_speedup")
+        if ratio is None or ratio < 1.0:
+            print(f"# traffic smoke FAILED: batched/per-op ratio "
+                  f"{ratio} < 1.0", file=sys.stderr)
+            return 1
+        print(f"# traffic smoke OK: batched/per-op ratio {ratio}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

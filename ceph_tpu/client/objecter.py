@@ -12,8 +12,10 @@ and resends ops when:
 from __future__ import annotations
 
 import threading
+from time import monotonic as _monotonic
 
 from ..common.lockdep import make_lock
+from ..common.throttle import Throttle
 from ..msg import Dispatcher, Messenger
 from ..msg.messenger import POLICY_LOSSY
 from ..osd.osdmap import object_ps
@@ -51,10 +53,14 @@ class Objecter(Dispatcher):
         self._linger_lock = make_lock("objecter::linger")
         self._replies: dict[int, MOSDOpReply] = {}
         self._outstanding: set[int] = set()
-        # admission throttle state (reference: Objecter's op budget —
-        # objecter_inflight_ops / objecter_inflight_op_bytes)
-        self._inflight_ops = 0
-        self._inflight_bytes = 0
+        # admission throttles (reference: Objecter's op budget —
+        # objecter_inflight_ops / objecter_inflight_op_bytes).  These
+        # are the backpressure sink of the whole write path: an op
+        # stalled downstream (e.g. at the OSD write-batcher's queue
+        # throttle) keeps its budget here, so sustained overload blocks
+        # NEW client ops at admission instead of piling work mid-stack.
+        self._op_throttle = Throttle("objecter::inflight_ops", 0)
+        self._bytes_throttle = Throttle("objecter::inflight_op_bytes", 0)
         self.mc.subscribe_osdmap(callback=self._on_new_map)
 
     def _on_new_map(self, m) -> None:
@@ -244,10 +250,12 @@ class Objecter(Dispatcher):
         """Submit; blocks for the reply, retrying across map changes.
 
         Admission rides the objecter_inflight_ops /
-        objecter_inflight_op_bytes throttle (reference: Objecter's op
-        budget): a full window blocks new logical ops until completions
-        drain it.  An op larger than the whole byte budget is admitted
-        only once the window is empty, rather than deadlocking.
+        objecter_inflight_op_bytes throttles (common/throttle.py
+        Throttle, reference: Objecter's op budget): a full window blocks
+        new logical ops until completions drain it, FIFO-fair.  An op
+        larger than the whole byte budget is admitted only once the
+        window is empty, rather than deadlocking (Throttle's oversize
+        rule).
         """
         my_bytes = (len(data)
                     if isinstance(data, (bytes, bytearray, memoryview))
@@ -255,31 +263,29 @@ class Objecter(Dispatcher):
         conf = self.cct.conf if self.cct else None
         max_ops = int(conf.get("objecter_inflight_ops")) if conf else 0
         max_bytes = int(conf.get("objecter_inflight_op_bytes")) if conf else 0
-
-        def _admit() -> bool:
-            if max_ops and self._inflight_ops >= max_ops:
-                return False
-            if max_bytes and self._inflight_bytes \
-                    and self._inflight_bytes + my_bytes > max_bytes:
-                return False
-            return True
-
-        with self._lock:
-            if not self._cond.wait_for(_admit,
-                                       timeout=kw.get("timeout", 30.0)):
-                raise ConnectionError(
-                    f"op {op} {oid!r}: inflight throttle full "
-                    f"({self._inflight_ops} ops, "
-                    f"{self._inflight_bytes} bytes)")
-            self._inflight_ops += 1
-            self._inflight_bytes += my_bytes
+        if max_ops != self._op_throttle.max:
+            self._op_throttle.reset_max(max_ops)
+        if max_bytes != self._bytes_throttle.max:
+            self._bytes_throttle.reset_max(max_bytes)
+        # one combined admission deadline across both throttles, like
+        # the single wait_for this replaced — not timeout twice over
+        timeout = kw.get("timeout", 30.0)
+        deadline = _monotonic() + timeout
+        if not self._op_throttle.get(1, timeout=timeout):
+            raise ConnectionError(
+                f"op {op} {oid!r}: inflight-op throttle full "
+                f"({self._op_throttle.current}/{max_ops} ops)")
+        remain = max(0.0, deadline - _monotonic())
+        if not self._bytes_throttle.get(my_bytes, timeout=remain):
+            self._op_throttle.put(1)
+            raise ConnectionError(
+                f"op {op} {oid!r}: inflight-byte throttle full "
+                f"({self._bytes_throttle.current}/{max_bytes} bytes)")
         try:
             return self._op_submit(pool_id, oid, op, data=data, **kw)
         finally:
-            with self._lock:
-                self._inflight_ops -= 1
-                self._inflight_bytes -= my_bytes
-                self._cond.notify_all()
+            self._bytes_throttle.put(my_bytes)
+            self._op_throttle.put(1)
 
     def _op_submit(
         self,

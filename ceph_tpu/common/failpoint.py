@@ -427,6 +427,7 @@ KNOWN_FAILPOINTS = frozenset({
     "msgr.frame.recv",
     "osd.dispatch",
     "osd.ec.shard_read",
+    "osd.write_batcher.flush",
     "osd.recovery.push",
     "osd.recovery.pull",
     "osd.scrub.start",

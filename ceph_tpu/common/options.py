@@ -232,6 +232,27 @@ def default_options() -> OptionTable:
                    "stores (reference: bluestore_compression_algorithm)",
                    enum=("none", "zlib", "snappy", "zstd", "lz4")),
             # -- ec / tpu --------------------------------------------------
+            Option("ec_batch_window_ms", float, 2.0,
+                   "max milliseconds the write batcher holds an EC "
+                   "encode batch open waiting for more stripes (the "
+                   "absolute coalescing timer; an inter-arrival gap of "
+                   "window/8 flushes early once arrivals stop).  0 "
+                   "disables coalescing: every op encodes inline "
+                   "(osd/write_batcher.py; docs/write_path.md)",
+                   min=0.0, runtime=True),
+            Option("ec_batch_max_stripes", int, 64,
+                   "stripes that flush an encode batch immediately "
+                   "(size cap of the write batcher's coalescing window)",
+                   min=1, runtime=True),
+            Option("ec_batch_max_bytes", int, 8 << 20,
+                   "data bytes per fused device encode batch; larger "
+                   "flushes split on stripe boundaries and double-"
+                   "buffer through ops/pipeline.stream_encode.  Also "
+                   "sizes the batcher's admission throttle (4x this) — "
+                   "the backpressure that blocks op threads, and "
+                   "through them client admission, when the encode "
+                   "stage falls behind.  0 = unbounded", min=0,
+                   runtime=True),
             Option("ec_kernel", str, "auto",
                    "encode kernel selection for the default (jax) EC "
                    "plugin: oracle/numpy swap the backend, xla/pallas "

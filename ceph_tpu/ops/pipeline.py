@@ -9,48 +9,65 @@ parity for batch i, batch i+1's transfer is already in flight (both
 device_put and kernel launches are async under JAX's dispatch model;
 the np.asarray fetch of result i-1 is the only sync point and it
 overlaps the later batches' work).
+
+The input is consumed as a true ITERATOR: a long traffic run (the
+write-batcher's multi-batch bursts, bench soaks) holds at most two
+input batches of host memory at any moment, never the whole stream.
 """
 from __future__ import annotations
 
 import numpy as np
 
 
-def stream_encode(mat: np.ndarray, batches, kernel: str = "xla"):
-    """Encode an iterable of [k, L] host batches; returns the list of
-    parity arrays.  kernel: 'xla' (ops.bitplane) or 'pallas'
-    (ops.pallas_gf)."""
-    import jax
-
-    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+def _apply_fn(mat: np.ndarray, kernel: str):
+    """Resolve the kernel choice once per stream.  'xla' and 'pallas'
+    force a path (the bench's explicit columns); 'auto' routes through
+    apply_matrix_jax's production dispatch — the same path the codec
+    plugins take, honoring the `ec_kernel` option and the latched XLA
+    fallback — so batched parity is bit-identical to the per-op path."""
     if kernel == "pallas":
         from .pallas_gf import apply_matrix_pallas
 
-        def apply_fn(x):
-            return apply_matrix_pallas(mat, x)
+        return lambda x: apply_matrix_pallas(mat, x)
+    # 'xla' (historical name for the default path) and 'auto' both route
+    # through apply_matrix_jax's dispatch, as stream_encode always has
+    from .bitplane import apply_matrix_jax
 
-    else:
-        from .bitplane import apply_matrix_jax
+    return lambda x: apply_matrix_jax(mat, x)
 
-        def apply_fn(x):
-            return apply_matrix_jax(mat, x)
 
-    batches = list(batches)
-    if not batches:
+def stream_encode(mat: np.ndarray, batches, kernel: str = "xla"):
+    """Encode an iterable of [k, L] host batches; returns the list of
+    parity arrays.  kernel: 'xla' (ops.bitplane), 'pallas'
+    (ops.pallas_gf), or 'auto' (production dispatch, ec_kernel-aware).
+
+    `batches` may be any iterable, including a one-shot generator; it is
+    pulled lazily, one batch ahead of the compute, so the stream's
+    host-memory high-water mark is two batches regardless of length."""
+    import jax
+
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    apply_fn = _apply_fn(mat, kernel)
+    it = iter(batches)
+    first = next(it, None)
+    if first is None:
         return []
     outs = []
-    results = []
-    nxt = jax.device_put(np.ascontiguousarray(batches[0], dtype=np.uint8))
-    for i in range(len(batches)):
+    pending = None  # device result of the previous batch, not yet fetched
+    nxt = jax.device_put(np.ascontiguousarray(first, dtype=np.uint8))
+    while nxt is not None:
         cur = nxt
         # launch compute first (async), THEN start the next DMA so the
         # copy engine and the cores overlap
-        results.append(apply_fn(cur))
-        if i + 1 < len(batches):
-            nxt = jax.device_put(
-                np.ascontiguousarray(batches[i + 1], dtype=np.uint8)
-            )
-        if i >= 1:  # fetch the previous result; keeps two batches live
-            outs.append(np.asarray(results[i - 1]))
-            results[i - 1] = None
-    outs.append(np.asarray(results[-1]))
+        res = apply_fn(cur)
+        upcoming = next(it, None)
+        nxt = (
+            jax.device_put(np.ascontiguousarray(upcoming, dtype=np.uint8))
+            if upcoming is not None else None
+        )
+        if pending is not None:
+            # fetch the previous result; keeps two batches live
+            outs.append(np.asarray(pending))
+        pending = res
+    outs.append(np.asarray(pending))
     return outs

@@ -85,6 +85,7 @@ from .scrub import ScrubMixin
 from .split_migration import SplitMigrationMixin
 from .subops import SubOpsMixin
 from .tiering import TieringMixin
+from .write_batcher import WriteBatcher
 
 
 
@@ -222,9 +223,23 @@ class OSD(
             .add_u64_counter("tier_promote", "cache-tier promotions")
             .add_u64_counter("tier_flush", "cache-tier flushes")
             .add_u64_counter("tier_evict", "cache-tier evictions")
+            .add_u64_counter("ec_batch_flushes",
+                             "coalesced encode batches flushed")
+            .add_u64_counter("ec_batch_stripes",
+                             "stripes encoded through the write batcher")
+            .add_u64_counter("ec_batch_bytes",
+                             "data bytes encoded through the write batcher")
+            .add_u64_counter("ec_batch_inline",
+                             "stripes encoded inline (coalescing off)")
+            .add_time_avg("ec_batch_flush_latency",
+                          "coalesced flush latency")
             .add_u64("numpg", "placement groups hosted")
             .create_perf_counters()
         )
+        # coalescing encode layer in front of the GF codec (the batched
+        # write path; osd/write_batcher.py, docs/write_path.md)
+        self.write_batcher = WriteBatcher(cct, logger=self.logger,
+                                          entity=self.whoami)
         # in-flight + historic op tracking (reference: OSD's OpTracker;
         # src/common/TrackedOp.cc — serves dump_ops_in_flight /
         # dump_historic_ops on the admin socket and feeds the SLOW_OPS
@@ -278,6 +293,7 @@ class OSD(
                     f"{self.whoami}: boot not acknowledged in 30s"
                 )
         self._load_pgs()
+        self.write_batcher.start()
         self._tick_thread = threading.Thread(
             target=self._tick_loop, name=f"{self.whoami}-tick", daemon=True
         )
@@ -345,6 +361,9 @@ class OSD(
         from the same directory exercises real WAL replay + fsck."""
         self._stop.set()
         self.scheduler.stop()
+        # drain-and-stop the coalescer first: queued stripes flush (their
+        # ops complete or fail normally) before the messenger goes away
+        self.write_batcher.stop()
         self._recovery_wakeup.set()
         self.mc.shutdown()
         self.messenger.shutdown()

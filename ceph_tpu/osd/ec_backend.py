@@ -28,6 +28,60 @@ from .pg_log import LogEntry
 
 
 class ECBackendMixin:
+    # .. coalesced encode (osd/write_batcher.py) ...........................
+    def _batch_matrix(self, codec):
+        """The codec's coding matrix IF its encode is a plain byte-
+        column-local GF matrix apply with identity chunk placement —
+        the property (same one the RMW parity delta rests on) under
+        which stripes from DIFFERENT ops can be fused along the column
+        axis and encoded in one batch.  None = not batchable: sub-
+        chunked (CLAY), packet/bitmatrix, remapped (LRC) codecs, and
+        the non-jax referee backends, all encode inline."""
+        if getattr(codec, "backend", "jax") != "jax":
+            # oracle/numpy referee backends keep their own encode path
+            # (parity provenance for the cross-backend equality tests);
+            # plugins without the attr (shec) are jax-native
+            return None
+        try:
+            if not codec.supports_parity_delta():
+                return None
+            if codec.get_sub_chunk_count() != 1:
+                return None
+        except (AttributeError, NotImplementedError):
+            return None
+        mat = getattr(codec, "coding", None)
+        if not isinstance(mat, np.ndarray):
+            return None
+        return mat
+
+    def _ec_encode_chunks(self, codec, chunks):
+        """encode_chunks through the write batcher when eligible
+        (coalesced with concurrent ops' stripes), codec-inline
+        otherwise; parity bytes identical either way."""
+        batcher = getattr(self, "write_batcher", None)
+        mat = self._batch_matrix(codec)
+        if batcher is None or mat is None:
+            return codec.encode_chunks(chunks)
+        return batcher.encode_chunks(mat, chunks)
+
+    def _ec_encode(self, codec, data: bytes) -> dict:
+        """Full-stripe encode for _ec_write: same chunk dict as
+        ``codec.encode(set(range(n)), data)``, with the parity matmul
+        routed through the write batcher when the codec is batchable."""
+        n = codec.get_chunk_count()
+        batcher = getattr(self, "write_batcher", None)
+        mat = self._batch_matrix(codec)
+        if batcher is None or mat is None:
+            return codec.encode(set(range(n)), data)
+        k = codec.get_data_chunk_count()
+        L = codec.get_chunk_size(len(data))
+        chunks = codec.encode_prepare(data, L)
+        parity = batcher.encode_chunks(mat, chunks)
+        enc = {i: chunks[i] for i in range(k)}
+        for j in range(parity.shape[0]):
+            enc[k + j] = parity[j]
+        return enc
+
     # .. EC pool ...........................................................
     def _ec_op(self, pg: PGState, pool, acting: list[int], msg: MOSDOp):
         codec = self._codec_for_pool(pool)
@@ -350,7 +404,9 @@ class ECBackendMixin:
             delta[j, o - c0:o - c0 + len(b)] = (
                 np.frombuffer(b, np.uint8) ^ np.frombuffer(old[j], np.uint8)
             )
-        parity_delta = np.asarray(codec.encode_chunks(delta), np.uint8)[:, :w]
+        parity_delta = np.asarray(
+            self._ec_encode_chunks(codec, delta), np.uint8
+        )[:, :w]
         new_size = max(size, end)
         version = pg.version + 1
         entry = LogEntry(version, "modify", msg.oid,

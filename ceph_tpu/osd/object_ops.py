@@ -193,7 +193,11 @@ class ObjectOpsMixin:
 
     def _ec_write(self, pg, pool, codec, acting, my_shard, msg, data) -> MOSDOpReply:
         n = codec.get_chunk_count()
-        enc = codec.encode(set(range(n)), data)
+        # the parity matmul coalesces with concurrent ops' stripes in
+        # the write batcher (ec_backend._ec_encode); everything after —
+        # version assignment, sub-op fan-out, ack accounting — is
+        # strictly per-op, so batching never changes semantics
+        enc = self._ec_encode(codec, data)
         version = pg.version + 1
         # entry rides a 4th element (object size) so every shard can answer
         # size/stat even after the primary moves

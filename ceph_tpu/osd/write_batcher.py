@@ -1,0 +1,391 @@
+"""WriteBatcher — the coalescing encode layer in front of the GF codec
+(ROADMAP "Batched async write path end-to-end"; arXiv:1709.05365's
+finding that online-EC system throughput is dominated by the queueing/
+batching structure in FRONT of the codec, not the codec itself).
+
+Every EC client write used to walk the stack alone and hand the codec a
+single [k, L] stripe; the TPU kernel only earns its throughput when
+stripes arrive in fat batches.  The batcher aggregates concurrent
+encode requests into multi-stripe batches and performs ONE fused
+pack -> apply_matrix -> scatter per flush:
+
+    op A  [k, L] ─┐
+    op B  [k, L] ─┼─ concat ─> [k, B*L] ── apply_matrix_jax ──> [m, B*L]
+    op C  [k, L] ─┘                                   │
+          ^ per-op parity slices demuxed back ────────┘
+
+GF matrix application is byte-column-local (the same property the RMW
+parity delta rests on), so the fused parity bytes are BIT-IDENTICAL to
+the per-op path — batching changes scheduling, never results.  Each op
+blocks for its own slice, so ack/ordering/rollback semantics upstream
+(version assignment, sub-op fan-out, dup detection) are untouched.
+
+Flush policy is NIC-interrupt-coalescing shaped, two timers + caps:
+
+- size/byte caps (``ec_batch_max_stripes`` / ``ec_batch_max_bytes``)
+  flush immediately when reached;
+- an ABSOLUTE window (``ec_batch_window_ms``) bounds how long the
+  batch's first stripe may wait;
+- an INTER-ARRIVAL gap (window/8) flushes as soon as the queue stops
+  growing — closed-loop writers (every in-flight op already queued)
+  flush at once instead of idling out the window, while open-load
+  bursts still accumulate fat batches.
+
+Backpressure: admission into the batcher rides a ``Throttle``
+(common/throttle.py) capped at a few windows of queue bytes.  A full
+queue blocks the submitting op thread BEFORE it queues more work; the
+blocked op holds its slot in the client's ``objecter_inflight_ops`` /
+``objecter_inflight_op_bytes`` admission window, so sustained overload
+propagates all the way back and new client writes block at admission,
+not mid-pipeline.
+
+A flush larger than ``ec_batch_max_bytes`` (shutdown drains, bursty
+arrivals) is split on stripe boundaries and streamed through
+``ops.pipeline.stream_encode`` so host->device DMA of device-batch i+1
+overlaps the kernel computing device-batch i.
+
+Fault injection: the ``osd.write_batcher.flush`` failpoint fires at the
+head of every flush.  ``error`` fails EVERY op in the batch (none acks
+— the thrasher's no-acked-write-loss invariant holds because the
+clients see the failure); ``delay(s)`` stalls the flush; ``crash``
+additionally latches the batcher off, after which submits fall back to
+inline per-op encode.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..common.failpoint import FailpointCrash, failpoint
+from ..common.lockdep import make_lock
+from ..common.throttle import Throttle
+
+
+class _PendingStripe:
+    """One op's stripe riding a batch: input chunks in, parity (or the
+    batch's error) out.  Completion rides a PER-OP Event rather than the
+    batcher's shared condition: a notify_all on a shared condition wakes
+    every waiter on every arrival AND every completion (a thundering
+    herd that was measured to eat the whole batching win at 8+ clients),
+    while an Event wakes exactly its own op.  The Event's internal lock
+    is the publish edge ordering the flusher's parity write before the
+    submitter's read."""
+
+    __slots__ = ("key", "mat", "chunks", "nbytes", "arrival", "event",
+                 "parity", "error", "admitted")
+
+    def __init__(self, mat: np.ndarray, chunks: np.ndarray):
+        self.mat = mat
+        self.chunks = chunks
+        # fuse only stripes encoding under the same matrix at the same
+        # chunk length: concat along columns is exact for those
+        self.key = (mat.tobytes(), chunks.shape[1])
+        self.nbytes = chunks.nbytes
+        self.arrival = time.monotonic()
+        self.event = threading.Event()
+        self.parity: np.ndarray | None = None
+        self.error: BaseException | None = None
+        self.admitted = False  # holds admission-throttle budget
+
+
+class WriteBatcher:
+    """Multi-stripe encode coalescer (see module docstring).
+
+    ``encode_chunks(mat, chunks)`` is the one entry point: [k, L] byte
+    chunks in, [m, L] parity out, blocking until the op's batch flushed.
+    Callers that are not plain column-local matrix applies must not come
+    here (the OSD's ``_batch_matrix`` eligibility gate).
+    """
+
+    #: admission throttle holds this many byte-caps of queued stripes
+    QUEUE_WINDOWS = 4
+    #: ceiling on one op's wait for admission into a saturated queue
+    ADMIT_TIMEOUT = 30.0
+    #: ceiling on one op's wait for its flush (window + device time)
+    OP_TIMEOUT = 60.0
+
+    def __init__(self, cct, logger=None, entity: str = ""):
+        self._cct = cct
+        self._logger = logger
+        self._entity = entity or (cct.name if cct is not None else "")
+        self._lock = make_lock("osd::write_batcher")
+        self._cond = threading.Condition(self._lock)
+        self._queue: list[_PendingStripe] = []
+        self._queued_bytes = 0
+        self._flush_asap = False
+        self._stop_flag = False
+        self._crashed = False
+        self._thread: threading.Thread | None = None
+        self._admission = Throttle(
+            "write_batcher::queue",
+            self._max_bytes() * self.QUEUE_WINDOWS,
+        )
+        # own counters so standalone users (bench) see stats without a
+        # PerfCounters registry; the OSD's logger mirrors them
+        self._stats = {"flushes": 0, "stripes": 0, "bytes": 0, "inline": 0}
+
+    # -- config (runtime-changeable: read per use) -------------------------
+    def _window(self) -> float:
+        if self._cct is None:
+            return 0.0
+        return max(0.0, float(self._cct.conf.get("ec_batch_window_ms"))) / 1e3
+
+    def _max_stripes(self) -> int:
+        if self._cct is None:
+            return 1
+        return max(1, int(self._cct.conf.get("ec_batch_max_stripes")))
+
+    def _max_bytes(self) -> int:
+        if self._cct is None:
+            return 0
+        return max(0, int(self._cct.conf.get("ec_batch_max_bytes")))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        with self._cond:
+            if self._thread is not None:
+                return
+            self._stop_flag = False
+            self._thread = threading.Thread(
+                target=self._flush_loop,
+                name=f"{self._entity}-wb-flush", daemon=True,
+            )
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Drain-and-stop: queued stripes are flushed (shutdown flush),
+        then the flusher exits; later submits encode inline."""
+        with self._cond:
+            self._stop_flag = True
+            self._cond.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+
+    def coalescing(self) -> bool:
+        """True when submits will be batched rather than encoded inline."""
+        with self._lock:
+            return (self._thread is not None and not self._stop_flag
+                    and not self._crashed) and self._window() > 0.0
+
+    # -- introspection (tests / bench) -------------------------------------
+    @property
+    def admission(self) -> Throttle:
+        return self._admission
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self._stats)
+
+    def flush_now(self) -> None:
+        """Force the current queue out without waiting for window/caps."""
+        with self._cond:
+            self._flush_asap = True
+            self._cond.notify_all()
+
+    # -- submit ------------------------------------------------------------
+    def encode_chunks(self, mat: np.ndarray, chunks: np.ndarray) -> np.ndarray:
+        """[k, L] data chunks -> [m, L] parity, bit-identical to
+        ``apply_matrix_jax(mat, chunks)``; blocks until this stripe's
+        batch flushed (or encodes inline when coalescing is off)."""
+        return self.encode_wait(self.encode_submit(mat, chunks))
+
+    def encode_submit(self, mat: np.ndarray,
+                      chunks: np.ndarray) -> _PendingStripe:
+        """Queue one [k, L] stripe for coalesced encode and return its
+        ticket.  Every ticket MUST be passed to encode_wait (it holds
+        admission-throttle budget until then).  Async clients keep a
+        small window of tickets in flight — that window is what lets a
+        single writer's stripes coalesce with its own, not only with
+        other writers'."""
+        mat = np.ascontiguousarray(mat, dtype=np.uint8)
+        chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+        p = _PendingStripe(mat, chunks)
+        if not self.coalescing():
+            p.parity = self._inline(mat, chunks)
+            p.event.set()
+            return p
+        # backpressure: block HERE, at admission, while the queue is
+        # saturated — the op thread's inflight budget upstream is what
+        # carries the stall back to the client's admission throttle
+        cap = self._max_bytes() * self.QUEUE_WINDOWS
+        if cap != self._admission.max:
+            self._admission.reset_max(cap)
+        if not self._admission.get(p.nbytes, timeout=self.ADMIT_TIMEOUT):
+            raise IOError(
+                f"write batcher admission timed out "
+                f"({self._admission.current} B queued, cap {cap} B)"
+            )
+        p.admitted = True
+        enqueued = False
+        with self._cond:
+            if not (self._stop_flag or self._crashed):
+                enqueued = True
+                self._queue.append(p)
+                self._queued_bytes += p.nbytes
+                # only the flusher waits on the shared condition;
+                # per-op completion rides p.event (no herd)
+                self._cond.notify_all()
+        if not enqueued:  # raced a stop/crash: encode inline
+            p.parity = self._inline(p.mat, p.chunks)
+            p.event.set()
+        return p
+
+    def encode_wait(self, p: _PendingStripe) -> np.ndarray:
+        """Block for a ticket's parity (or raise its batch's error)."""
+        try:
+            if not p.event.wait(timeout=self.OP_TIMEOUT):
+                raise TimeoutError(
+                    f"write batcher flush of {p.nbytes} B stripe timed "
+                    f"out after {self.OP_TIMEOUT}s"
+                )
+            if p.error is not None:
+                raise p.error
+            return p.parity
+        finally:
+            if p.admitted:
+                p.admitted = False
+                self._admission.put(p.nbytes)
+
+    def _inline(self, mat: np.ndarray, chunks: np.ndarray) -> np.ndarray:
+        from ..ops.bitplane import apply_matrix_jax
+
+        with self._lock:
+            self._stats["inline"] += 1
+        if self._logger is not None:
+            self._logger.inc("ec_batch_inline")
+        return np.asarray(apply_matrix_jax(mat, chunks), dtype=np.uint8)
+
+    # -- flusher -----------------------------------------------------------
+    def _flush_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop_flag:
+                    self._cond.wait(timeout=0.5)
+                if not self._queue:
+                    return  # stopped and drained
+                self._wait_for_batch_locked()
+                batch = self._queue
+                self._queue = []
+                self._queued_bytes = 0
+                self._flush_asap = False
+            try:
+                self._flush_batch(batch)
+            except Exception as e:  # belt: the flusher must never die
+                if self._cct is not None:
+                    self._cct.dout("osd", 0,
+                                   f"{self._entity} write batcher flush "
+                                   f"raised: {e!r}")
+                self._complete(batch, err=e)
+
+    def _wait_for_batch_locked(self) -> None:
+        """Coalescing wait (lock held): returns once the batch should
+        flush — caps reached, absolute window expired, an inter-arrival
+        gap passed with no growth, or stop/flush_now."""
+        window = self._window()
+        max_stripes = self._max_stripes()
+        max_bytes = self._max_bytes()
+        first = self._queue[0].arrival
+        gap = max(window / 8.0, 5e-5)
+        while (
+            not self._stop_flag
+            and not self._flush_asap
+            and len(self._queue) < max_stripes
+            and (max_bytes <= 0 or self._queued_bytes < max_bytes)
+        ):
+            remain = first + window - time.monotonic()
+            if remain <= 0:
+                break
+            n0 = len(self._queue)
+            self._cond.wait(timeout=min(remain, gap))
+            if len(self._queue) == n0:
+                break  # quiescent: every in-flight writer already queued
+
+    def _flush_batch(self, batch: list[_PendingStripe]) -> None:
+        t0 = time.perf_counter()
+        err: BaseException | None = None
+        try:
+            failpoint("osd.write_batcher.flush", cct=self._cct,
+                      entity=self._entity, stripes=len(batch))
+        except FailpointCrash as e:
+            # simulated death of the encode stage: fail the batch and
+            # latch coalescing off — later submits encode inline
+            with self._cond:
+                self._crashed = True
+            err = e
+        except Exception as e:
+            err = e
+        results: list[tuple[_PendingStripe, np.ndarray]] = []
+        if err is None:
+            try:
+                results = self._encode_groups(batch)
+            except Exception as e:
+                err = e
+        self._complete(batch, err=err, results=results)
+        if err is None:
+            nbytes = sum(p.nbytes for p in batch)
+            with self._lock:
+                self._stats["flushes"] += 1
+                self._stats["stripes"] += len(batch)
+                self._stats["bytes"] += nbytes
+            if self._logger is not None:
+                self._logger.inc("ec_batch_flushes")
+                self._logger.inc("ec_batch_stripes", len(batch))
+                self._logger.inc("ec_batch_bytes", nbytes)
+                self._logger.tinc("ec_batch_flush_latency",
+                                  time.perf_counter() - t0)
+
+    def _encode_groups(
+        self, batch: list[_PendingStripe]
+    ) -> list[tuple[_PendingStripe, np.ndarray]]:
+        """One fused pack -> encode -> scatter per (matrix, L) group."""
+        groups: dict[tuple, list[_PendingStripe]] = {}
+        for p in batch:
+            groups.setdefault(p.key, []).append(p)
+        max_bytes = self._max_bytes()
+        out: list[tuple[_PendingStripe, np.ndarray]] = []
+        for (_mat_b, L), ps in groups.items():
+            mat = ps[0].mat
+            packed = (ps[0].chunks if len(ps) == 1 else
+                      np.concatenate([p.chunks for p in ps], axis=1))
+            stripe_b = ps[0].chunks.nbytes
+            if (max_bytes > 0 and len(ps) > 1
+                    and packed.nbytes > max_bytes):
+                # burst bigger than one device batch: split on stripe
+                # boundaries and double-buffer DMA against compute
+                from ..ops.pipeline import stream_encode
+
+                spd = max(1, max_bytes // stripe_b)
+
+                def dev_batches(packed=packed, L=L, n=len(ps), spd=spd):
+                    for i in range(0, n, spd):
+                        yield packed[:, i * L:(i + spd) * L]
+
+                outs = stream_encode(mat, dev_batches(), kernel="auto")
+                parity = np.concatenate(outs, axis=1)
+            else:
+                from ..ops.bitplane import apply_matrix_jax
+
+                parity = np.asarray(apply_matrix_jax(mat, packed),
+                                    dtype=np.uint8)
+            for i, p in enumerate(ps):
+                out.append((p, parity[:, i * L:(i + 1) * L]))
+        return out
+
+    def _complete(self, batch: list[_PendingStripe],
+                  err: BaseException | None = None,
+                  results: list[tuple[_PendingStripe, np.ndarray]] = ()):
+        if err is not None:
+            for p in batch:
+                p.error = err
+                p.event.set()
+        else:
+            for p, parity in results:
+                p.parity = parity
+                p.event.set()

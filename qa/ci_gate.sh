@@ -8,6 +8,10 @@
 #    files changed since BASE_REF.
 # 2. cephrace --seed SEED (default 1): the short seeded thrash scenario
 #    under the dynamic detector.
+# 3. traffic smoke (ceph_tpu/bench/traffic.py): CPU backend, 2 clients,
+#    ~5 s — fails when the batched/per-op encode throughput ratio drops
+#    below 1.0 (the write-batcher regression gate); JSON lands next to
+#    the SARIF artifacts.
 #
 # Both emit SARIF 2.1.0 into qa/_sarif/ (github code-scanning uploads
 # resolve URIs against the repo root, which is where this script runs
@@ -69,5 +73,25 @@ else
     echo "cephrace: clean"
 fi
 
-echo "SARIF written to $OUT_DIR/ (cephlint.sarif, cephrace.sarif)"
+echo "== traffic smoke (batched vs per-op encode) =="
+CEPH_TPU_BENCH_FORCE_CPU=1 python -m ceph_tpu.bench.traffic \
+    --cpu --clients 2 --seconds 2 --json --smoke \
+    > "$OUT_DIR/traffic.json"
+traffic_rc=$?
+if [ $traffic_rc -eq 0 ]; then
+    echo "traffic smoke: ok"
+elif python -c "import json,sys; json.load(open('$OUT_DIR/traffic.json'))" \
+        2>/dev/null; then
+    # the scenario ran and produced a result: rc!=0 means the ratio gate
+    echo "traffic smoke: FAILED (batched/per-op ratio < 1.0):"
+    cat "$OUT_DIR/traffic.json"
+    rc=1
+else
+    # crashed before producing JSON: an error, not a perf regression
+    rm -f "$OUT_DIR/traffic.json"
+    echo "traffic smoke: ERROR (exit $traffic_rc) — scenario crashed"
+    rc=1
+fi
+
+echo "SARIF written to $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json)"
 exit $rc

@@ -29,18 +29,29 @@ def _clean_registry():
 def test_thrasher_smoke():
     """Bounded fixed-seed thrash (~4 chaos cycles) on every PR: one
     kill/revive pair each side of a netsplit, mon churn, EC shard EIO,
-    at-rest corruption — then every invariant must hold."""
+    at-rest corruption — then every invariant must hold.  The
+    write-batcher flush failpoint is armed for the first coalesced
+    flush: the batch it kills fails ALL its ops visibly (the clients
+    see the error, nothing acks), so the no-acked-write-loss invariant
+    also covers a stalled/failed coalesced write path."""
     with LocalCluster(n_mons=3, n_osds=5, conf_overrides=FAST_CONF) as c:
         c.create_ec_pool("th", k=2, m=1, pg_num=8)
+        registry().set("osd.write_batcher.flush", "times(1,error)")
         th = Thrasher(c, seed=12, pool="th")
         events = th.run(14)
         kinds = {e[0] for e in events}
         assert {"write", "kill", "revive", "netsplit", "ec_eio",
                 "mon_churn", "corrupt"} <= kinds
+        hits = sum(
+            e["hits"] for e in registry().list()["osd.write_batcher.flush"]
+        )
+        assert hits >= 1, "no write ever crossed the batcher flush"
+        registry().set("osd.write_batcher.flush", "off")
         th.quiesce()
         report = InvariantChecker(c, "th").check(th)
         # chaos must not have refused everything: the schedule's writes
-        # largely land (seed 12: 4 writes, ample min_size margin)
+        # largely land (seed 12: 4 writes, ample min_size margin; the
+        # injected flush failure may eat one batch)
         assert report["acked_writes"] >= 3
         # and the log replays bit-exactly from the seed alone
         assert events == Thrasher(None, seed=12, n_osds=5,

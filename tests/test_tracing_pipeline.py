@@ -56,6 +56,34 @@ def test_stream_encode_matches_single_shot():
         np.testing.assert_array_equal(o, encode_chunks(coding, b))
 
 
+def test_stream_encode_is_truly_streaming():
+    """stream_encode consumes its input lazily — a one-shot generator
+    works, and at most two batches are ever pulled ahead of the compute
+    (the traffic path's bounded host-memory contract)."""
+    k, m = 4, 2
+    coding = cauchy_good_coding_matrix(k, m).astype(np.uint8)
+    rng = np.random.default_rng(1)
+    batches = [
+        rng.integers(0, 256, (k, 4096), dtype=np.uint8) for _ in range(6)
+    ]
+    pulled = []
+
+    def gen():
+        for i, b in enumerate(batches):
+            pulled.append(i)
+            yield b
+
+    outs = stream_encode(coding, gen())
+    assert pulled == list(range(6))  # fully consumed, in order
+    assert len(outs) == 6
+    for b, o in zip(batches, outs):
+        np.testing.assert_array_equal(o, encode_chunks(coding, b))
+    # kernel='auto' (the write batcher's burst path) is bit-identical
+    outs_auto = stream_encode(coding, iter(batches), kernel="auto")
+    for o, oa in zip(outs, outs_auto):
+        np.testing.assert_array_equal(o, oa)
+
+
 def test_stream_encode_empty_and_single():
     coding = cauchy_good_coding_matrix(2, 1).astype(np.uint8)
     assert stream_encode(coding, []) == []
