@@ -13,11 +13,26 @@ path an OSD primary takes; ``perop`` mode submits through the same
 entry with coalescing off (ec_batch_window_ms=0), i.e. today's
 one-dispatch-per-stripe path.
 
+cephtrace integration (docs/tracing.md): ``--sampling R`` arms the
+tracer and head-samples R of the ops, after which the JSON carries a
+per-stage p50/p99 breakdown (admission / queue / encode [/ subop /
+commit]) computed from the recorded spans — the p99 number finally
+says WHICH stage.  ``--cluster`` runs the same closed-loop writers
+against a real LocalCluster EC pool (client -> OSD -> replicas), so
+the trace trees span daemons; ``--trace-out FILE`` writes the run's
+Perfetto/Chrome-trace JSON (open in ui.perfetto.dev).
+``--trace-smoke`` is the CI gate: untraced vs sampling=1.0 cluster
+runs, asserting a non-empty CONNECTED trace tree, all five stages in
+the breakdown, and <=10% tracing overhead.
+
 Usage (bench.py runs this as its "traffic" phase; qa/ci_gate.sh runs
-the tiny smoke configuration):
+the tiny smoke configurations):
 
     python -m ceph_tpu.bench.traffic --clients 32 --seconds 3 --json
     python -m ceph_tpu.bench.traffic --clients 2 --seconds 2 --smoke
+    python -m ceph_tpu.bench.traffic --cluster --sampling 1.0 \
+        --trace-out /tmp/trace.json --json
+    python -m ceph_tpu.bench.traffic --trace-smoke
 """
 from __future__ import annotations
 
@@ -29,6 +44,35 @@ import threading
 import time
 
 import numpy as np
+
+from ..common.tracer import (
+    OP_STAGES,
+    TRACER,
+    connected_traces,
+    perfetto_export,
+    sampled_ctx,
+    set_op_trace,
+)
+
+
+def stage_breakdown(spans: list[dict],
+                    stages: tuple = OP_STAGES) -> dict:
+    """{stage: {p50_ms, p99_ms, n}} over recorded span durations — the
+    per-stage half of the bench JSON."""
+    by_name: dict[str, list[float]] = {}
+    for s in spans:
+        if s["name"] in stages and s.get("dur_ms") is not None:
+            by_name.setdefault(s["name"], []).append(s["dur_ms"])
+    out = {}
+    for name, durs in by_name.items():
+        durs.sort()
+        n = len(durs)
+        out[name] = {
+            "p50_ms": round(durs[n // 2], 3),
+            "p99_ms": round(durs[min(n - 1, int(n * 0.99))], 3),
+            "n": n,
+        }
+    return out
 
 
 def _chunk_len(write_size: int, k: int, align: int = 64) -> int:
@@ -49,8 +93,11 @@ def run_traffic(
     max_bytes: int = 8 << 20,
     qd: int = 4,
     warmup: float = 0.25,
+    sampling: float = 0.0,
 ) -> dict:
-    """One mode's closed-loop run; returns ops/GiB-per-s/latency stats."""
+    """One mode's closed-loop run; returns ops/GiB-per-s/latency stats.
+    sampling > 0 arms cephtrace, head-samples that fraction of ops, and
+    adds a per-stage p50/p99 breakdown to the result."""
     from ..common.context import CephContext
     from ..gf.matrix import cauchy_good_coding_matrix
     from ..ops.bitplane import apply_matrix_jax
@@ -64,15 +111,19 @@ def run_traffic(
     # generator out of the timed loop while avoiding constant-input
     # caching artifacts
     pool = [rng.integers(0, 256, (k, L), dtype=np.uint8) for _ in range(8)]
+    ename = f"client.traffic-{mode}"
     cct = CephContext(
-        f"client.traffic-{mode}",
+        ename,
         overrides={
             "ec_batch_window_ms": window_ms if mode == "batched" else 0.0,
             "ec_batch_max_stripes": max_stripes,
             "ec_batch_max_bytes": max_bytes,
+            "trace_enabled": sampling > 0.0,
         },
     )
-    batcher = WriteBatcher(cct, entity=f"client.traffic-{mode}")
+    if sampling > 0.0:
+        TRACER.clear()  # this run's spans only
+    batcher = WriteBatcher(cct, entity=ename)
     batcher.start()
     np.asarray(apply_matrix_jax(mat, pool[0]))  # compile/warm the kernel
 
@@ -89,23 +140,34 @@ def run_traffic(
         my = lats[i]
         inflight: deque = deque()
         n = 0
+
+        def submit(x):
+            root = (TRACER.begin(sampled_ctx(sampling), "op_submit",
+                                 entity=ename, client=i)
+                    if sampling > 0.0 else None)
+            set_op_trace({"ctx": root.ctx(), "tracked": None}
+                         if root is not None else None)
+            t0 = time.perf_counter()
+            p = batcher.encode_submit(mat, x)
+            set_op_trace(None)
+            return t0, p, root
+
+        def finish(t0, p, root):
+            batcher.encode_wait(p)
+            TRACER.end(root)
+            my.append(time.perf_counter() - t0)
+
         start_gate.wait(timeout=30.0)
         while time.monotonic() < stop_at[0]:
             while len(inflight) < qd and time.monotonic() < stop_at[0]:
                 x = pool[(i + n) % len(pool)]
                 n += 1
-                inflight.append(
-                    (time.perf_counter(), batcher.encode_submit(mat, x))
-                )
+                inflight.append(submit(x))
             if not inflight:  # clock crossed stop_at before any submit
                 break
-            t0, p = inflight.popleft()
-            batcher.encode_wait(p)
-            my.append(time.perf_counter() - t0)
+            finish(*inflight.popleft())
         while inflight:
-            t0, p = inflight.popleft()
-            batcher.encode_wait(p)
-            my.append(time.perf_counter() - t0)
+            finish(*inflight.popleft())
 
     threads = [
         threading.Thread(target=client, args=(i,), daemon=True,
@@ -143,7 +205,143 @@ def run_traffic(
         "stripes_per_flush": round(stats["stripes"] / stats["flushes"], 2)
         if stats["flushes"] else None,
     }
+    if sampling > 0.0:
+        spans = TRACER.spans()
+        LAST_SPANS[:] = spans
+        out["sampling"] = sampling
+        out["traces"] = len({s["trace_id"] for s in spans})
+        out["stages"] = stage_breakdown(spans)
+        TRACER.enable(False)
+        TRACER.clear()
     return out
+
+
+#: spans of the most recent traced run, for --trace-out export
+LAST_SPANS: list = []
+
+
+def run_cluster_traffic(
+    n_clients: int = 2,
+    seconds: float = 2.0,
+    write_size: int = 4096,
+    k: int = 2,
+    m: int = 1,
+    n_osds: int | None = None,
+    sampling: float = 0.0,
+) -> dict:
+    """Closed-loop writers against a REAL LocalCluster EC pool — the
+    full client -> OSD -> replicas -> ack path, so traced runs produce
+    cross-daemon trees (op_submit -> osd_op -> admission/queue/encode/
+    subop/commit -> replica_commit) and the per-stage breakdown covers
+    all five OP_STAGES.  No qd knob: op_submit is synchronous, so each
+    writer holds exactly one op in flight."""
+    from ..qa.vstart import LocalCluster
+
+    if n_osds is None:
+        n_osds = k + m + 1  # room for every shard plus one spare
+    TRACER.enable(False)
+    TRACER.clear()
+    overrides = {"trace_enabled": sampling > 0.0,
+                 "trace_sampling_rate": sampling if sampling > 0.0 else 1.0}
+    lats: list[list[float]] = [[] for _ in range(n_clients)]
+    payloads = [bytes([i % 251] * write_size) for i in range(16)]
+    stop_at = [0.0]
+    start_gate = threading.Event()
+
+    with LocalCluster(n_mons=1, n_osds=n_osds,
+                      conf_overrides=overrides) as cluster:
+        cluster.create_ec_pool("traffic", k=k, m=m, pg_num=8)
+        client = cluster.client()
+        ios = [client.open_ioctx("traffic") for _ in range(n_clients)]
+
+        def writer(i: int) -> None:
+            io = ios[i]
+            my = lats[i]
+            n = 0
+            start_gate.wait(timeout=30.0)
+            while time.monotonic() < stop_at[0]:
+                t0 = time.perf_counter()
+                io.write_full(f"c{i}-{n % 16}", payloads[(i + n) % 16])
+                my.append(time.perf_counter() - t0)
+                n += 1
+
+        threads = [
+            threading.Thread(target=writer, args=(i,), daemon=True,
+                             name=f"traffic-cluster-{i}")
+            for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        stop_at[0] = time.monotonic() + seconds
+        t_begin = time.monotonic()
+        start_gate.set()
+        for t in threads:
+            t.join(timeout=seconds + 60.0)
+        elapsed = time.monotonic() - t_begin
+        spans = TRACER.spans()
+    LAST_SPANS[:] = spans
+    all_lats = sorted(x for lat in lats for x in lat)
+    n_ops = len(all_lats)
+    out = {
+        "mode": "cluster",
+        "clients": n_clients,
+        "write_size": write_size,
+        "rs": f"{k}+{m}",
+        "seconds": round(elapsed, 3),
+        "ops": n_ops,
+        "ops_per_s": round(n_ops / max(elapsed, 1e-9), 1),
+        "gibps": round(n_ops * write_size / max(elapsed, 1e-9) / 2**30, 5),
+        "p50_ms": round(all_lats[n_ops // 2] * 1e3, 3) if n_ops else None,
+        "p99_ms": round(all_lats[min(n_ops - 1, int(n_ops * 0.99))] * 1e3, 3)
+        if n_ops else None,
+        "sampling": sampling,
+    }
+    if sampling > 0.0:
+        out["traces"] = len({s["trace_id"] for s in spans})
+        out["connected_traces"] = len(connected_traces(spans))
+        out["stages"] = stage_breakdown(spans)
+        TRACER.enable(False)
+        TRACER.clear()
+    return out
+
+
+def trace_smoke(n_clients: int = 2, seconds: float = 2.0,
+                trace_out: str | None = None) -> tuple[dict, int]:
+    """The ci_gate tracing smoke: an untraced cluster run, then a
+    sampling=1.0 run.  Fails (rc 1) when the traced run produced no
+    connected trace tree, the per-stage breakdown misses one of the
+    five OP_STAGES, or tracing costs more than 10% of the untraced
+    run's throughput."""
+    # throwaway warmup: the first cluster run pays the process-wide XLA
+    # compile, which would otherwise be charged to the untraced side
+    # and mask (or invert) the real tracing overhead
+    run_cluster_traffic(n_clients, 0.5, sampling=0.0)
+    untraced = run_cluster_traffic(n_clients, seconds, sampling=0.0)
+    traced = run_cluster_traffic(n_clients, seconds, sampling=1.0)
+    if trace_out:
+        with open(trace_out, "w") as f:
+            json.dump(perfetto_export(LAST_SPANS), f)
+    overhead = None
+    if untraced["ops_per_s"]:
+        overhead = round(
+            1.0 - traced["ops_per_s"] / untraced["ops_per_s"], 4)
+    problems = []
+    if not traced.get("connected_traces"):
+        problems.append("no connected trace tree (client submit -> "
+                        "replica commit)")
+    missing = [s for s in OP_STAGES if s not in (traced.get("stages") or {})]
+    if missing:
+        problems.append(f"stage breakdown missing {missing}")
+    if overhead is not None and overhead > 0.10:
+        problems.append(f"tracing overhead {overhead:.1%} > 10%")
+    out = {
+        "untraced": untraced,
+        "traced": traced,
+        "tracing_overhead": overhead,
+        "trace_out": trace_out,
+        "problems": problems,
+    }
+    return out, (1 if problems else 0)
 
 
 def run_scenario(
@@ -189,13 +387,28 @@ def main(argv=None) -> int:
     ap.add_argument("--clients", type=int, default=32)
     ap.add_argument("--seconds", type=float, default=3.0)
     ap.add_argument("--write-size", type=int, default=4096)
-    ap.add_argument("-k", type=int, default=8)
-    ap.add_argument("-m", type=int, default=4)
+    ap.add_argument("-k", type=int, default=None,
+                    help="data chunks (default 8; 2 in --cluster mode)")
+    ap.add_argument("-m", type=int, default=None,
+                    help="parity chunks (default 4; 1 in --cluster mode)")
     ap.add_argument("--window-ms", type=float, default=2.0)
     ap.add_argument("--max-stripes", type=int, default=64)
     ap.add_argument("--max-bytes", type=int, default=8 << 20)
     ap.add_argument("--qd", type=int, default=4,
                     help="per-client async window (writes in flight)")
+    ap.add_argument("--sampling", type=float, default=0.0,
+                    help="cephtrace head-sampling rate (0 = tracing "
+                    "off); >0 adds a per-stage p50/p99 breakdown")
+    ap.add_argument("--cluster", action="store_true",
+                    help="drive a real LocalCluster EC pool instead of "
+                    "the bare write batcher (cross-daemon traces)")
+    ap.add_argument("--trace-out", metavar="FILE",
+                    help="write the traced run's Perfetto/Chrome-trace "
+                    "JSON here (open in ui.perfetto.dev)")
+    ap.add_argument("--trace-smoke", action="store_true",
+                    help="CI gate: untraced vs sampling=1.0 cluster "
+                    "runs; fail on a disconnected trace tree, a "
+                    "missing stage, or >10%% tracing overhead")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON dict on stdout")
     ap.add_argument("--cpu", action="store_true",
@@ -210,9 +423,45 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    res = run_scenario(args.clients, args.seconds, args.write_size,
-                       args.k, args.m, args.window_ms, args.max_stripes,
-                       args.max_bytes, args.qd)
+    # cluster mode drives one daemon per shard: default to a geometry a
+    # smoke-sized cluster can host
+    if args.k is None:
+        args.k = 2 if args.cluster else 8
+    if args.m is None:
+        args.m = 1 if args.cluster else 4
+    if args.trace_smoke:
+        res, rc = trace_smoke(args.clients, args.seconds,
+                              trace_out=args.trace_out)
+        if args.json:
+            print(json.dumps(res))
+        else:
+            for key in sorted(res):
+                print(f"{key}: {res[key]}")
+        for p in res["problems"]:
+            print(f"# trace smoke FAILED: {p}", file=sys.stderr)
+        if rc == 0:
+            print(f"# trace smoke OK: {res['traced']['connected_traces']} "
+                  f"connected traces, overhead {res['tracing_overhead']}",
+                  file=sys.stderr)
+        return rc
+    if args.cluster:
+        res = run_cluster_traffic(args.clients, args.seconds,
+                                  args.write_size, args.k, args.m,
+                                  sampling=args.sampling)
+    elif args.sampling > 0.0:
+        # batcher-only traced run: batched mode with stage breakdown
+        # (the 1%-sampling overhead measurement drives this directly)
+        res = run_traffic("batched", args.clients, args.seconds,
+                          args.write_size, args.k, args.m, args.window_ms,
+                          args.max_stripes, args.max_bytes, args.qd,
+                          sampling=args.sampling)
+    else:
+        res = run_scenario(args.clients, args.seconds, args.write_size,
+                           args.k, args.m, args.window_ms, args.max_stripes,
+                           args.max_bytes, args.qd)
+    if args.trace_out and LAST_SPANS:
+        with open(args.trace_out, "w") as f:
+            json.dump(perfetto_export(LAST_SPANS), f)
     if args.json:
         print(json.dumps(res))
     else:

@@ -16,6 +16,7 @@ from time import monotonic as _monotonic
 
 from ..common.lockdep import make_lock
 from ..common.throttle import Throttle
+from ..common.tracer import TRACER, sampled_ctx
 from ..msg import Dispatcher, Messenger
 from ..msg.messenger import POLICY_LOSSY
 from ..osd.osdmap import object_ps
@@ -261,6 +262,19 @@ class Objecter(Dispatcher):
                     if isinstance(data, (bytes, bytearray, memoryview))
                     else 0)
         conf = self.cct.conf if self.cct else None
+        # cephtrace birth: ONE head-based coin flip per logical op (the
+        # trace context then rides every resend attempt unchanged);
+        # tracing disabled = this single attribute check inside
+        # sampled_ctx, nothing else on the path
+        root_span = None
+        if TRACER.enabled:
+            rate = float(conf.get("trace_sampling_rate")) if conf else 1.0
+            tctx = sampled_ctx(rate)
+            root_span = TRACER.begin(
+                tctx, "op_submit",
+                entity=self.cct.name if self.cct else "client",
+                op=op, pool=pool_id, oid=oid, nbytes=my_bytes,
+            )
         max_ops = int(conf.get("objecter_inflight_ops")) if conf else 0
         max_bytes = int(conf.get("objecter_inflight_op_bytes")) if conf else 0
         if max_ops != self._op_throttle.max:
@@ -272,17 +286,27 @@ class Objecter(Dispatcher):
         timeout = kw.get("timeout", 30.0)
         deadline = _monotonic() + timeout
         if not self._op_throttle.get(1, timeout=timeout):
+            # throttle-starved ops are exactly what tracing is for: end
+            # the root span with the error rather than dropping it
+            TRACER.end(root_span, error="inflight-op throttle full")
             raise ConnectionError(
                 f"op {op} {oid!r}: inflight-op throttle full "
                 f"({self._op_throttle.current}/{max_ops} ops)")
         remain = max(0.0, deadline - _monotonic())
         if not self._bytes_throttle.get(my_bytes, timeout=remain):
             self._op_throttle.put(1)
+            TRACER.end(root_span, error="inflight-byte throttle full")
             raise ConnectionError(
                 f"op {op} {oid!r}: inflight-byte throttle full "
                 f"({self._bytes_throttle.current}/{max_bytes} bytes)")
         try:
-            return self._op_submit(pool_id, oid, op, data=data, **kw)
+            rep = self._op_submit(pool_id, oid, op, data=data,
+                                  _trace_span=root_span, **kw)
+            TRACER.end(root_span, retval=rep.retval)
+            return rep
+        except BaseException as e:
+            TRACER.end(root_span, error=repr(e))
+            raise
         finally:
             self._bytes_throttle.put(my_bytes)
             self._op_throttle.put(1)
@@ -300,6 +324,7 @@ class Objecter(Dispatcher):
         snapid: int | None = None,
         ignore_overlay: bool = False,
         snapc_seq: int = 0,
+        _trace_span=None,
     ):
         """The retry loop under op_submit's admission throttle."""
         import time as _time
@@ -376,6 +401,10 @@ class Objecter(Dispatcher):
                         data=wire_data,
                         epoch=m.epoch if m else 0, off=off, length=length,
                         snapid=snapid, snap_seq=snap_seq, reqid=reqid,
+                        trace_id=(_trace_span.trace_id
+                                  if _trace_span is not None else None),
+                        parent_span=(_trace_span.span_id
+                                     if _trace_span is not None else None),
                     )
                 )
             except (OSError, ConnectionError) as e:

@@ -33,6 +33,13 @@ class CephContext:
             lockdep.enable()
         self.perf = PerfCountersCollection()
         self.heartbeat_map = HeartbeatMap()
+        if self.conf.get("trace_enabled"):
+            # the tracer is process-wide (spans carry the entity label,
+            # so a LocalCluster's daemons stay attributable); any armed
+            # context switches it on for the process
+            from .tracer import TRACER
+
+            TRACER.enable(True)
         # mon-minted service tickets for cephx clients without the cluster
         # secret: {service: {"ticket": blob_hex, "session_key": hex}};
         # runtime credentials, not config (reference: the client-side
@@ -87,6 +94,19 @@ class CephContext:
             "log dump", lambda c: [e.format() for e in self.log.recent(100)],
             "recent log ring entries",
         )
+        ask.register_command(
+            "dump_tracing", self._dump_tracing_cmd,
+            "cephtrace spans/events for this daemon "
+            "(all=true for the whole process; format=perfetto for "
+            "Chrome-trace JSON loadable in ui.perfetto.dev)",
+        )
+
+    def _dump_tracing_cmd(self, cmd: dict) -> object:
+        from .tracer import dump_tracing
+
+        entity = None if cmd.get("all") else self.name
+        return dump_tracing(entity=entity,
+                            fmt=str(cmd.get("format", "spans")))
 
     def _config_set_cmd(self, cmd: dict) -> dict:
         # live `config set` honors the option's runtime flag (reference:

@@ -41,6 +41,20 @@ def default_options() -> OptionTable:
             Option("lockdep", bool, False,
                    "runtime lock-order cycle detection (reference: "
                    "src/common/lockdep.cc)"),
+            # -- tracing (reference: jaeger_tracing_enable) ----------------
+            Option("trace_enabled", bool, False,
+                   "arm cephtrace: distributed op spans (client -> OSD "
+                   "-> replicas), stage latency histograms, and the "
+                   "dump_tracing admin command (docs/tracing.md).  "
+                   "Disabled, the data plane pays one attribute check "
+                   "per hook (reference: jaeger_tracing_enable)"),
+            Option("trace_sampling_rate", float, 1.0,
+                   "head-based sampling: fraction of client ops that "
+                   "mint a trace context at Objecter.op_submit (one "
+                   "coin flip per logical op; resends ride the original "
+                   "decision).  1.0 traces everything, 0.01 is the "
+                   "production-viability setting benched in PERF.md",
+                   min=0.0, max=1.0, runtime=True),
             # -- messenger (reference: ms_* in global.yaml.in) -------------
             Option("ms_connect_timeout", float, 10.0,
                    "seconds to wait for a connect", min=0.0),

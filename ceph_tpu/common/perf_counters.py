@@ -17,10 +17,29 @@ TYPE_U64 = "u64"  # monotonically increasing counter
 TYPE_GAUGE = "gauge"  # settable value
 TYPE_TIME = "time"  # accumulated seconds
 TYPE_LONGRUNAVG = "longrunavg"  # (sum, count)
+TYPE_HISTOGRAM = "histogram"  # log2-bucket latency histogram
+
+# log2 bucket boundaries in SECONDS: bucket i counts samples <= 2^i µs
+# (1 µs .. ~134 s, then +Inf) — the reference's PerfHistogram uses the
+# same power-of-two scale so two dumps subtract bucket-by-bucket
+HIST_NUM_BUCKETS = 28
+HIST_LE = tuple((1 << i) / 1e6 for i in range(HIST_NUM_BUCKETS))
+
+
+def _hist_bucket(seconds: float) -> int:
+    """Index of the first bucket whose upper bound holds `seconds`;
+    HIST_NUM_BUCKETS = overflow (+Inf)."""
+    us = seconds * 1e6
+    if us <= 1.0:
+        return 0
+    b = int(us - 1e-9).bit_length()  # 2^(b-1) < us <= 2^b (approx)
+    if (1 << b) < us:
+        b += 1
+    return min(b, HIST_NUM_BUCKETS)
 
 
 class _Counter:
-    __slots__ = ("name", "type", "doc", "value", "sum", "count")
+    __slots__ = ("name", "type", "doc", "value", "sum", "count", "buckets")
 
     def __init__(self, name: str, ctype: str, doc: str):
         self.name = name
@@ -29,6 +48,9 @@ class _Counter:
         self.value = 0.0
         self.sum = 0.0
         self.count = 0
+        self.buckets = (
+            [0] * (HIST_NUM_BUCKETS + 1) if ctype == TYPE_HISTOGRAM else None
+        )
 
 
 class PerfCounters:
@@ -77,6 +99,17 @@ class PerfCounters:
             c.sum += value
             c.count += 1
 
+    def hinc(self, name: str, seconds: float) -> None:
+        """Feed one latency sample into a log2-bucket histogram
+        (reference: PerfHistogram::inc)."""
+        c = self._counters[name]
+        assert c.type == TYPE_HISTOGRAM, f"hinc on non-histogram {name}"
+        b = _hist_bucket(seconds)
+        with self._lock:
+            c.buckets[b] += 1
+            c.sum += seconds
+            c.count += 1
+
     def get(self, name: str) -> float:
         return self._counters[name].value
 
@@ -90,6 +123,12 @@ class PerfCounters:
             for c in self._counters.values():
                 if c.type == TYPE_LONGRUNAVG:
                     out[c.name] = {"avgcount": c.count, "sum": c.sum}
+                elif c.type == TYPE_HISTOGRAM:
+                    out[c.name] = {
+                        "count": c.count,
+                        "sum": c.sum,
+                        "buckets": list(c.buckets),  # per-bucket, not cumulative
+                    }
                 elif c.type == TYPE_U64:
                     out[c.name] = int(c.value)
                 else:
@@ -140,6 +179,13 @@ class PerfCountersBuilder:
 
     def add_time_avg(self, name: str, doc: str = "") -> "PerfCountersBuilder":
         self._pc._add(name, TYPE_LONGRUNAVG, doc)
+        return self
+
+    def add_time_histogram(self, name: str,
+                           doc: str = "") -> "PerfCountersBuilder":
+        """Log2-bucket latency histogram (reference: PerfHistogram —
+        add_u64_counter_histogram), fed via PerfCounters.hinc."""
+        self._pc._add(name, TYPE_HISTOGRAM, doc)
         return self
 
     def create_perf_counters(self) -> PerfCounters:

@@ -1,79 +1,358 @@
-"""Tracepoints + device profiling (reference: src/tracing/*.tp LTTng
-tracepoints and src/common/tracer.{h,cc} Jaeger spans; SURVEY.md §5.1).
+"""cephtrace — tracepoints, causal distributed spans, and device
+profiling (reference: src/tracing/*.tp LTTng tracepoints,
+src/common/tracer.{h,cc} Jaeger spans; SURVEY.md §5.1).
 
-Two layers, both cheap enough to leave compiled in:
+Three layers, all gated on ONE attribute check when disabled:
 
-- **Tracepoints**: `tracepoint(subsys, event, **fields)` appends a
+- **Tracepoints**: ``tracepoint(subsys, event, **fields)`` appends a
   timestamped record to a bounded in-memory ring (the LTTng-userspace
-  role); `span(subsys, name)` brackets a region and records its
-  duration.  Dump via `events()` — the admin-socket/`dump_historic_ops`
-  style surface.  Disabled (the default) they cost one attribute check.
-- **Device profiling**: `device_trace(logdir)` wraps `jax.profiler`'s
-  trace context so the TPU hot paths (encode kernels, batched CRUSH)
-  emit an XPlane trace viewable in TensorBoard/Perfetto — the
-  `jax.profiler` equivalent SURVEY §5.1 calls for.  Set
-  CEPH_TPU_PROFILE=<dir> to arm it in the bench CLIs.
+  role); ``span(subsys, name)`` brackets a region and records its
+  duration.  Every record carries an ``entity`` label (daemon name) so
+  a multi-daemon process (LocalCluster) stays attributable.  Dump via
+  ``events()`` / the per-daemon ``dump_tracing`` admin-socket command.
+
+- **Causal spans** (the cephtrace core): a :class:`TraceCtx`
+  (trace_id, span_id) is born at ``Objecter.op_submit`` when the
+  head-based ``trace_sampling_rate`` coin flip says so, rides wire
+  messages as explicit ``trace_id`` / ``parent_span`` FIELDS (named so
+  ``send_message``'s framing stamp of ``seq``/``src`` can never shadow
+  them — the CL6 ``field-shadow`` trap), and every stage along
+  client -> OSD dispatch -> write-batcher admission/queue/flush ->
+  encode -> sub-op fan-out -> replica commit -> ack records a
+  :class:`Span` into a bounded per-process buffer.  ``assemble_trees``
+  rebuilds the causal tree; ``perfetto_export`` emits Chrome-trace /
+  Perfetto JSON that loads directly in ui.perfetto.dev.
+
+- **Device profiling**: ``device_trace(logdir)`` wraps
+  ``jax.profiler``'s trace context so TPU hot paths emit XPlanes, and
+  ``kernel_annotation(name, trace_ids)`` wraps individual kernel
+  launches in named ``jax.profiler`` annotations keyed by trace_id so
+  the device trace correlates with host spans.
+
+Stage taxonomy (shared verbatim by ``TrackedOp.mark_event`` offsets,
+the ``stage_*`` latency histograms, and span names — one clock,
+``trace_now`` = ``time.time``):
+
+==============  ======================================================
+``admission``   write-batcher admission-throttle wait
+``queue``       stripe queued -> flush started (coalescing wait)
+``encode``      fused device encode (one flush; fan-in span)
+``subop``       sub-op fan-out -> last shard ack collected
+``commit``      local object-store transaction
+==============  ======================================================
 """
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 
 from .lockdep import make_lock
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 
 _MAX_EVENTS = 10_000
+_MAX_SPANS = 20_000
+
+#: the stage names above, in pipeline order (bench/tests iterate this)
+OP_STAGES = ("admission", "queue", "encode", "subop", "commit")
+
+
+def trace_now() -> float:
+    """THE clock every tracing consumer shares: wall time, so
+    dump_historic_ops offsets, span boundaries, and cross-daemon
+    ordering all agree (monotonic clocks are per-process and would
+    skew multi-process traces)."""
+    return time.time()
+
+
+def _new_id() -> str:
+    return f"{random.getrandbits(64):016x}"
+
+
+class TraceCtx:
+    """Propagated trace context: which trace, and which span children
+    attach to.  ``span_id`` is None only for a freshly minted root."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str | None = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __repr__(self):
+        return f"<TraceCtx {self.trace_id}/{self.span_id}>"
+
+
+class Span:
+    __slots__ = ("trace_id", "span_id", "parent", "name", "entity",
+                 "t0", "t1", "tags")
+
+    def __init__(self, trace_id: str, parent: str | None, name: str,
+                 entity: str, t0: float):
+        self.trace_id = trace_id
+        self.span_id = _new_id()
+        self.parent = parent
+        self.name = name
+        self.entity = entity
+        self.t0 = t0
+        self.t1: float | None = None
+        self.tags: dict = {}
+
+    def ctx(self) -> TraceCtx:
+        return TraceCtx(self.trace_id, self.span_id)
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span": self.parent,
+            "name": self.name,
+            "entity": self.entity,
+            "t0": self.t0,
+            "t1": self.t1,
+            "dur_ms": None if self.t1 is None else (self.t1 - self.t0) * 1e3,
+            **({"tags": self.tags} if self.tags else {}),
+        }
+
+
+# thread-local "current op" trace state: the op thread sets it once in
+# _handle_client_op and the layers below (write batcher, encode, sub-op
+# fan-out) read it without threading ctx through every signature
+_tls = threading.local()
+
+
+def set_op_trace(state: dict | None) -> None:
+    _tls.op = state
+
+
+def op_trace() -> dict | None:
+    return getattr(_tls, "op", None)
 
 
 class Tracer:
     def __init__(self):
         self.enabled = False
         self._events: list[tuple] = []
+        self._spans: list[Span] = []
         self._lock = make_lock("tracer::ring")
 
     def enable(self, on: bool = True) -> None:
         self.enabled = on
 
-    def tracepoint(self, subsys: str, event: str, **fields) -> None:
+    # -- tracepoints (the LTTng layer) ---------------------------------
+    def tracepoint(self, subsys: str, event: str, entity: str = "",
+                   **fields) -> None:
         if not self.enabled:
             return
         with self._lock:
-            self._events.append((time.monotonic(), subsys, event, fields))
+            self._events.append(
+                (trace_now(), entity, subsys, event, fields))
             if len(self._events) > _MAX_EVENTS:
                 del self._events[: _MAX_EVENTS // 10]
 
     @contextmanager
-    def span(self, subsys: str, name: str, **fields):
+    def span(self, subsys: str, name: str, entity: str = "", **fields):
         if not self.enabled:
             yield
             return
-        t0 = time.monotonic()
+        t0 = trace_now()
         try:
             yield
         finally:
             self.tracepoint(
-                subsys, name, dur_ms=(time.monotonic() - t0) * 1e3, **fields
+                subsys, name, entity=entity,
+                dur_ms=(trace_now() - t0) * 1e3, **fields
             )
 
-    def events(self, subsys: str | None = None) -> list[dict]:
+    def events(self, subsys: str | None = None,
+               entity: str | None = None) -> list[dict]:
         with self._lock:
             evs = list(self._events)
         return [
-            {"ts": ts, "subsys": s, "event": e, **f}
-            for ts, s, e, f in evs
-            if subsys is None or s == subsys
+            {"ts": ts, "entity": ent, "subsys": s, "event": e, **f}
+            for ts, ent, s, e, f in evs
+            if (subsys is None or s == subsys)
+            and (entity is None or ent == entity)
+        ]
+
+    # -- causal spans (the cephtrace layer) ----------------------------
+    def new_trace(self) -> TraceCtx | None:
+        """Mint a root context (the Objecter's head-based sampling
+        decision happens BEFORE this call)."""
+        if not self.enabled:
+            return None
+        return TraceCtx(_new_id(), None)
+
+    def begin(self, ctx: TraceCtx | None, name: str, entity: str = "",
+              t0: float | None = None, **tags) -> Span | None:
+        """Open a child span of ``ctx``; returns None (and every later
+        call on None is a no-op) when tracing is off or the op is
+        unsampled — the one-attribute-check disabled path."""
+        if not self.enabled or ctx is None:
+            return None
+        sp = Span(ctx.trace_id, ctx.span_id, name, entity,
+                  trace_now() if t0 is None else t0)
+        if tags:
+            sp.tags.update(tags)
+        return sp
+
+    def end(self, sp: Span | None, t1: float | None = None, **tags) -> None:
+        if sp is None:
+            return
+        sp.t1 = trace_now() if t1 is None else t1
+        if tags:
+            sp.tags.update(tags)
+        with self._lock:
+            self._spans.append(sp)
+            if len(self._spans) > _MAX_SPANS:
+                del self._spans[: _MAX_SPANS // 10]
+
+    def record(self, ctx: TraceCtx | None, name: str, entity: str = "",
+               t0: float | None = None, t1: float | None = None,
+               **tags) -> None:
+        """One-shot span with explicit boundaries."""
+        sp = self.begin(ctx, name, entity, t0=t0, **tags)
+        if sp is not None:
+            self.end(sp, t1=t1)
+
+    def spans(self, trace_id: str | None = None,
+              entity: str | None = None) -> list[dict]:
+        with self._lock:
+            sps = list(self._spans)
+        return [
+            s.to_dict() for s in sps
+            if (trace_id is None or s.trace_id == trace_id)
+            and (entity is None or s.entity == entity)
         ]
 
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+            self._spans.clear()
 
 
 TRACER = Tracer()
 tracepoint = TRACER.tracepoint
 span = TRACER.span
 
+
+def sampled_ctx(rate: float) -> TraceCtx | None:
+    """Head-based sampling: one coin flip per logical op, at the
+    Objecter (reference: Jaeger's probabilistic sampler).  rate >= 1
+    always samples; rate <= 0 never does."""
+    if not TRACER.enabled or rate <= 0.0:
+        return None
+    if rate < 1.0 and random.random() >= rate:
+        return None
+    return TRACER.new_trace()
+
+
+# -- trace assembly / export ------------------------------------------
+
+def assemble_trees(spans: list[dict]) -> dict[str, list[dict]]:
+    """{trace_id: [root trees]}; tree node = {"span": span_dict,
+    "children": [nodes]}.  A span whose parent isn't in its trace's
+    span set roots its own subtree (e.g. a dropped buffer segment)."""
+    by_trace: dict[str, list[dict]] = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    out: dict[str, list[dict]] = {}
+    for tid, sps in by_trace.items():
+        nodes = {s["span_id"]: {"span": s, "children": []} for s in sps}
+        roots = []
+        for s in sps:
+            parent = s.get("parent_span")
+            if parent is not None and parent in nodes:
+                nodes[parent]["children"].append(nodes[s["span_id"]])
+            else:
+                roots.append(nodes[s["span_id"]])
+        out[tid] = roots
+    return out
+
+
+def tree_span_names(node: dict) -> set[str]:
+    """All span names reachable from a tree node (connectivity checks)."""
+    names = {node["span"]["name"]}
+    for child in node["children"]:
+        names |= tree_span_names(child)
+    return names
+
+
+def connected_traces(spans: list[dict], root: str = "op_submit",
+                     leaf: str = "replica_commit") -> list[str]:
+    """trace_ids whose tree reaches `leaf` under a `root` root — the
+    ci-gate's "client submit is an ancestor of the replica commit"
+    assertion."""
+    out = []
+    for tid, roots in assemble_trees(spans).items():
+        for node in roots:
+            if node["span"]["name"] == root and leaf in tree_span_names(node):
+                out.append(tid)
+                break
+    return out
+
+
+def perfetto_export(spans: list[dict]) -> dict:
+    """Chrome-trace/Perfetto JSON: one X (complete) event per span,
+    one pid per entity (process_name metadata), one tid per trace so a
+    trace's spans nest in one track.  Opens directly in
+    ui.perfetto.dev / chrome://tracing."""
+    pids: dict[str, int] = {}
+    tids: dict[str, int] = {}
+    events: list[dict] = []
+    for s in spans:
+        ent = s.get("entity") or "?"
+        if ent not in pids:
+            pids[ent] = len(pids) + 1
+            events.append({
+                "ph": "M", "pid": pids[ent], "name": "process_name",
+                "args": {"name": ent},
+            })
+        tid = tids.setdefault(s["trace_id"], len(tids) + 1)
+        if s.get("t1") is None:
+            continue  # unfinished span: nothing to draw
+        events.append({
+            "name": s["name"],
+            "cat": "cephtrace",
+            "ph": "X",
+            "ts": s["t0"] * 1e6,          # microseconds, per the format
+            "dur": max(0.0, (s["t1"] - s["t0"]) * 1e6),
+            "pid": pids[ent],
+            "tid": tid,
+            "args": {
+                "trace_id": s["trace_id"],
+                "span_id": s["span_id"],
+                "parent_span": s.get("parent_span"),
+                **(s.get("tags") or {}),
+            },
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def dump_tracing(entity: str | None = None, fmt: str = "spans") -> object:
+    """The `dump_tracing` admin-socket surface: this daemon's spans and
+    tracepoint events (entity=None dumps the whole process — useful in
+    a LocalCluster where every daemon shares the buffer).  fmt:
+    "spans" (default), "perfetto" (Chrome-trace JSON of ALL traces this
+    entity touched, with the other daemons' halves included so the
+    trees stay connected)."""
+    spans = TRACER.spans(entity=entity)
+    if fmt == "perfetto":
+        if entity is not None:
+            touched = {s["trace_id"] for s in spans}
+            spans = [s for s in TRACER.spans() if s["trace_id"] in touched]
+        return perfetto_export(spans)
+    return {
+        "entity": entity,
+        "enabled": TRACER.enabled,
+        "num_spans": len(spans),
+        "spans": spans,
+        "events": TRACER.events(entity=entity),
+    }
+
+
+# -- device profiling --------------------------------------------------
 
 @contextmanager
 def device_trace(logdir: str | None = None):
@@ -88,3 +367,22 @@ def device_trace(logdir: str | None = None):
 
     with jax.profiler.trace(logdir):
         yield
+
+
+def kernel_annotation(name: str, trace_ids=()):
+    """Named jax.profiler annotation around a kernel launch, keyed by
+    trace_id, so the device trace's XPlanes (TensorBoard/Perfetto)
+    correlate with host spans.  Null when tracing is off — kernel
+    dispatch stays annotation-free on the hot path."""
+    if not TRACER.enabled:
+        return nullcontext()
+    try:
+        import jax
+    except ImportError:  # pragma: no cover - jax is baked into the image
+        return nullcontext()
+    ids = list(trace_ids)
+    label = f"cephtrace:{name}"
+    if ids:
+        label += f"#trace={ids[0]}" + (f"+{len(ids) - 1}" if len(ids) > 1
+                                       else "")
+    return jax.profiler.TraceAnnotation(label)

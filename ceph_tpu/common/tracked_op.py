@@ -11,6 +11,7 @@ from __future__ import annotations
 import time
 from collections import deque
 from .lockdep import make_lock
+from .tracer import trace_now
 
 
 class TrackedOp:
@@ -19,13 +20,17 @@ class TrackedOp:
     def __init__(self, tracker: "OpTracker", desc: str):
         self.tracker = tracker
         self.desc = desc
-        self.initiated_at = time.time()
+        self.initiated_at = trace_now()
         self.events: list[tuple[float, str]] = [(self.initiated_at, "initiated")]
         self._lock = make_lock("optracker::op")
 
-    def mark_event(self, name: str) -> None:
+    def mark_event(self, name: str, ts: float | None = None) -> None:
+        """`ts` lets a caller that also records a cephtrace span stamp
+        BOTH with one clock read (tracer.trace_now) — dump_historic_ops
+        per-stage offsets and span boundaries then agree exactly
+        (the OSD's _op_stage helper is that caller)."""
         with self._lock:
-            self.events.append((time.time(), name))
+            self.events.append((trace_now() if ts is None else ts, name))
 
     def age(self, now: float | None = None) -> float:
         return (time.time() if now is None else now) - self.initiated_at

@@ -117,6 +117,7 @@ class MgrDaemon(Dispatcher):
             with self._reports_lock:
                 self._reports[msg.daemon] = {
                     "counters": msg.counters or {},
+                    "schema": getattr(msg, "schema", None) or {},
                     "stats": msg.stats or {},
                     "epoch": msg.epoch,
                     "ts": time.monotonic(),
@@ -135,6 +136,18 @@ class MgrDaemon(Dispatcher):
                 for d, r in self._reports.items()
                 if now - r["ts"] <= max_age
             }
+
+    def latest_schemas(self) -> dict:
+        """Merged {subsystem: {counter: {type, description}}} across
+        daemons (same subsystem name = same declaration; later daemons
+        win harmlessly) — the prometheus exporter's HELP/TYPE source."""
+        merged: dict = {}
+        with self._reports_lock:
+            reports = [r.get("schema") or {} for r in self._reports.values()]
+        for schema in reports:
+            for subsys, counters in schema.items():
+                merged.setdefault(subsys, {}).update(counters or {})
+        return merged
 
     def rados_ioctx(self, pool: str):
         """Pool I/O handle for modules (the reference mgr holds its own

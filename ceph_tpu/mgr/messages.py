@@ -14,7 +14,10 @@ class MMgrReport(_JsonMessage):
     daemon: entity name ("osd.3"); counters: {subsystem: {name: value}}
     (the PerfCountersCollection dump); epoch: the daemon's map epoch so the
     mgr can spot laggards; stats: free-form daemon stats (pg counts,
-    store bytes) for modules that want more than counters."""
+    store bytes) for modules that want more than counters; schema:
+    {subsystem: {name: {type, description}}} (PerfCountersCollection
+    schema) so the prometheus exporter renders real HELP text and the
+    right TYPE (counter/gauge/histogram) instead of guessing."""
 
     MSG_TYPE = 120
-    FIELDS = ("daemon", "counters", "epoch", "stats")
+    FIELDS = ("daemon", "counters", "epoch", "stats", "schema")

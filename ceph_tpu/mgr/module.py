@@ -35,6 +35,11 @@ class MgrModule:
         MMgrReport of each daemon (reference: get_all_perf_counters)."""
         return self.mgr.latest_reports()
 
+    def get_perf_schema(self) -> dict:
+        """{subsystem: {counter: {type, description}}} merged across
+        daemons (reference: MMgrReport's PerfCounterType declarations)."""
+        return self.mgr.latest_schemas()
+
     def mon_command(self, cmd: dict):
         return self.mgr.mc.command(cmd)
 

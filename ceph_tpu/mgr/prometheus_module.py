@@ -10,12 +10,22 @@ from __future__ import annotations
 import http.server
 import threading
 
+from ..common.perf_counters import HIST_LE
 from .module import MgrModule, register_module
 
 
-def render_metrics(osdmap, reports: dict) -> str:
-    """Text exposition (the pure part, unit-testable without sockets)."""
+def render_metrics(osdmap, reports: dict, schema: dict | None = None) -> str:
+    """Text exposition (the pure part, unit-testable without sockets).
+
+    `schema` is the merged {subsystem: {counter: {type, description}}}
+    the daemons ship inside MMgrReport: HELP text comes from each
+    counter's declared `doc` and TYPE from its PerfCounters type —
+    u64/time -> counter, gauge -> gauge, histogram -> a real prometheus
+    histogram with cumulative log2 `le` buckets (+Inf, _sum, _count).
+    Counters without schema fall back to the generic rendering, so a
+    daemon predating the schema field still exports."""
     lines: list[str] = []
+    schema = schema or {}
 
     def esc(v) -> str:
         # exposition-format label escaping: one bad pool name must not
@@ -69,22 +79,56 @@ def render_metrics(osdmap, reports: dict) -> str:
     # per-daemon perf counters: flatten subsystem dumps into one series
     # per counter, labelled by daemon (the reference's ceph_daemon label)
     series: dict[str, list] = {}
+    hists: dict[str, dict] = {}   # base -> {"doc", "bucket", "sum", "count"}
+    meta: dict[str, tuple[str, str]] = {}  # key -> (help, type)
+
+    def declared(subsys: str, cname: str, key: str,
+                 default_typ: str) -> tuple[str, str]:
+        sch = (schema.get(subsys) or {}).get(cname) or {}
+        doc = sch.get("description") or f"perf counter {key}"
+        typ = "gauge" if sch.get("type") == "gauge" else default_typ
+        return doc, typ
+
     for daemon, subsystems in sorted(reports.items()):
+        labels = {"ceph_daemon": daemon}
         for subsys, counters in sorted((subsystems or {}).items()):
             for cname, value in sorted(counters.items()):
-                if isinstance(value, dict):  # longrunavg {avgcount, sum}
+                key = f"ceph_{subsys}_{cname}"
+                if isinstance(value, dict) and "buckets" in value:
+                    # log2-bucket latency histogram (PerfCounters
+                    # TYPE_HISTOGRAM): cumulative le buckets, seconds
+                    h = hists.setdefault(key, {
+                        "doc": declared(subsys, cname, key, "histogram")[0],
+                        "bucket": [], "sum": [], "count": [],
+                    })
+                    cum = 0
+                    for i, c in enumerate(value["buckets"]):
+                        cum += c
+                        le = (f"{HIST_LE[i]:.6g}" if i < len(HIST_LE)
+                              else "+Inf")
+                        h["bucket"].append(({**labels, "le": le}, cum))
+                    h["sum"].append((labels, value["sum"]))
+                    h["count"].append((labels, value["count"]))
+                elif isinstance(value, dict):  # longrunavg {avgcount, sum}
                     for part, v in value.items():
-                        key = f"ceph_{subsys}_{cname}_{part}"
-                        series.setdefault(key, []).append(
-                            ({"ceph_daemon": daemon}, v)
-                        )
+                        pkey = f"{key}_{part}"
+                        meta.setdefault(
+                            pkey, declared(subsys, cname, pkey, "counter"))
+                        series.setdefault(pkey, []).append((labels, v))
                 else:
-                    key = f"ceph_{subsys}_{cname}"
-                    series.setdefault(key, []).append(
-                        ({"ceph_daemon": daemon}, value)
-                    )
+                    meta.setdefault(
+                        key, declared(subsys, cname, key, "counter"))
+                    series.setdefault(key, []).append((labels, value))
     for key, samples in sorted(series.items()):
-        metric(key, f"perf counter {key}", "counter", samples)
+        doc, typ = meta.get(key, (f"perf counter {key}", "counter"))
+        metric(key, doc, typ, samples)
+    for base, h in sorted(hists.items()):
+        lines.append(f"# HELP {base} {h['doc']}")
+        lines.append(f"# TYPE {base} histogram")
+        for suffix in ("bucket", "sum", "count"):
+            for labels, value in h[suffix]:
+                lab = ",".join(f'{k}="{esc(v)}"' for k, v in labels.items())
+                lines.append(f"{base}_{suffix}{{{lab}}} {value}")
     return "\n".join(lines) + "\n"
 
 
@@ -115,6 +159,7 @@ class PrometheusModule(MgrModule):
                     body = render_metrics(
                         module.get("osd_map"),
                         module.get_all_perf_counters(),
+                        schema=module.get_perf_schema(),
                     ).encode()
                 except Exception as e:  # scrape must not kill the server
                     self.send_error(500, str(e))

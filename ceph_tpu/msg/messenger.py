@@ -66,6 +66,7 @@ from ..auth.cephx import (
 )
 from ..common.crc32c import crc32c
 from ..common.lockdep import make_lock
+from ..common.tracer import TRACER
 from ..common.failpoint import (
     FailpointCrash,
     FailpointError,
@@ -180,6 +181,14 @@ class Connection:
             self.out_seq += 1
             msg.seq = self.out_seq
             msg.src = self.msgr.name
+            if TRACER.enabled:  # one attribute check when tracing is off
+                t_id = getattr(msg, "trace_id", None)
+                if t_id is not None:
+                    TRACER.tracepoint(
+                        "msgr", "send", entity=self.msgr.name,
+                        trace_id=t_id, msg=type(msg).__name__,
+                        peer=self.peer_name or str(self.peer_addr),
+                    )
             payload = encode_message(msg)
             if self.policy == POLICY_LOSSLESS_PEER:
                 self._replay.append((self.out_seq, payload))
@@ -780,6 +789,14 @@ class Messenger:
                             "inflated frame length mismatch "
                             f"({len(payload)} != declared {raw_len})")
                 msg = decode_message(payload)
+                if TRACER.enabled:  # one attribute check when off
+                    t_id = getattr(msg, "trace_id", None)
+                    if t_id is not None:
+                        TRACER.tracepoint(
+                            "msgr", "recv", entity=self.name,
+                            trace_id=t_id, msg=type(msg).__name__,
+                            peer=msg.src or conn.peer_name or None,
+                        )
                 if _registry().configured("msgr.frame.recv"):
                     try:
                         failpoint(

@@ -43,6 +43,7 @@ import time
 from ..common.failpoint import failpoint, registry as fp_registry
 from ..common.lockdep import make_lock
 from ..common.perf_counters import PerfCountersBuilder
+from ..common.tracer import TRACER, op_trace, trace_now
 from ..common.tracked_op import OpTracker
 from ..ec.registry import ErasureCodePluginRegistry
 from ..mon.mon_client import MonClient
@@ -233,6 +234,20 @@ class OSD(
                              "stripes encoded inline (coalescing off)")
             .add_time_avg("ec_batch_flush_latency",
                           "coalesced flush latency")
+            # per-stage latency histograms (cephtrace aggregation;
+            # log2 buckets, reference: PerfHistogram).  Names match the
+            # span taxonomy in common/tracer.py OP_STAGES exactly.
+            .add_time_histogram("stage_admission",
+                                "write-batcher admission-throttle wait")
+            .add_time_histogram("stage_queue",
+                                "stripe coalescing wait (queued to "
+                                "flush start)")
+            .add_time_histogram("stage_encode",
+                                "fused device encode per flush")
+            .add_time_histogram("stage_subop",
+                                "sub-op fan-out to last shard ack")
+            .add_time_histogram("stage_commit",
+                                "local object-store commit")
             .add_u64("numpg", "placement groups hosted")
             .create_perf_counters()
         )
@@ -529,6 +544,38 @@ class OSD(
             self._tid += 1
             return self._tid
 
+    # -- cephtrace op-stage funnel -----------------------------------------
+    def _op_stage(self, stage: str, t0: float, t1: float, span=None,
+                  **tags) -> None:
+        """ONE helper for op-stage bookkeeping: the stage histogram,
+        the TrackedOp event (dump_historic_ops offsets), and the
+        cephtrace span all share one clock (tracer.trace_now) and one
+        stage name — they cannot drift apart (the double-booked-
+        timestamp bug this replaces).  Stage names: tracer.OP_STAGES.
+        `span` closes a pre-opened span (the subop fan-out opens its
+        span BEFORE sending so sub-op messages can carry its id as
+        their parent) instead of minting a fresh one."""
+        self.logger.hinc(f"stage_{stage}", t1 - t0)
+        st = op_trace()
+        if st is None:
+            TRACER.end(span, t1=t1, **tags)
+            return
+        tracked = st.get("tracked")
+        if tracked is not None:
+            tracked.mark_event(stage, ts=t1)
+        if span is not None:
+            TRACER.end(span, t1=t1, **tags)
+            return
+        ctx = st.get("ctx")
+        if ctx is not None:
+            TRACER.record(ctx, stage, entity=self.whoami, t0=t0, t1=t1,
+                          **tags)
+
+    def _op_trace_ctx(self):
+        """Current op's trace context (None = unsampled / tracing off)."""
+        st = op_trace()
+        return st.get("ctx") if st is not None else None
+
     # -- persistence of PG meta -------------------------------------------
     def _load_pgs(self) -> None:
         for cid in self.store.list_collections():
@@ -642,6 +689,10 @@ class OSD(
             failpoint("osd.dispatch", cct=self.cct, entity=self.whoami,
                       msg=type(msg).__name__)
         if isinstance(msg, MOSDOp):
+            if TRACER.enabled and msg.trace_id is not None:
+                # arrival stamp: _handle_client_op turns it into the
+                # mClock dispatch-queue span (same trace_now clock)
+                msg._rx_ts = trace_now()
             src = getattr(msg, "src", None)
             if src is not None:
                 # notify fan-out reaches a watcher over the SAME

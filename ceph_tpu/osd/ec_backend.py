@@ -14,6 +14,7 @@ import numpy as np
 
 from ..common.crc32c import crc32c
 from ..common.failpoint import FailpointCrash, FailpointError, failpoint
+from ..common.tracer import TRACER, trace_now
 from ..store.object_store import NotFound, Transaction
 from .messages import (
     MECSubOpRead,
@@ -61,7 +62,10 @@ class ECBackendMixin:
         batcher = getattr(self, "write_batcher", None)
         mat = self._batch_matrix(codec)
         if batcher is None or mat is None:
-            return codec.encode_chunks(chunks)
+            t0 = trace_now()
+            out = codec.encode_chunks(chunks)
+            self._op_stage("encode", t0, trace_now(), codec_inline=True)
+            return out
         return batcher.encode_chunks(mat, chunks)
 
     def _ec_encode(self, codec, data: bytes) -> dict:
@@ -72,7 +76,10 @@ class ECBackendMixin:
         batcher = getattr(self, "write_batcher", None)
         mat = self._batch_matrix(codec)
         if batcher is None or mat is None:
-            return codec.encode(set(range(n)), data)
+            t0 = trace_now()
+            enc = codec.encode(set(range(n)), data)
+            self._op_stage("encode", t0, trace_now(), codec_inline=True)
+            return enc
         k = codec.get_data_chunk_count()
         L = codec.get_chunk_size(len(data))
         chunks = codec.encode_prepare(data, L)
@@ -413,6 +420,12 @@ class ECBackendMixin:
                          reqid=getattr(msg, "reqid", None))
         wire_entry = entry.to_list()
         tids: dict[int, int] = {}
+        # subop span opens BEFORE the fan-out (sub-ops carry its id as
+        # parent); see object_ops._ec_write
+        sub_span = TRACER.begin(self._op_trace_ctx(), "subop",
+                                entity=self.whoami, rmw=True) \
+            if TRACER.enabled else None
+        t_sub0 = sub_span.t0 if sub_span is not None else trace_now()
         for shard, osd in enumerate(acting):
             if shard == my_shard or osd < 0 or not self.osdmap.is_up(osd):
                 continue
@@ -435,6 +448,10 @@ class ECBackendMixin:
                         version=version, entry=wire_entry,
                         epoch=self.my_epoch(), mode=mode, off=moff,
                         over=my_ver, osize=new_size,
+                        trace_id=(sub_span.trace_id
+                                  if sub_span is not None else None),
+                        parent_span=(sub_span.span_id
+                                     if sub_span is not None else None),
                     )
                 )
             except (OSError, ConnectionError):
@@ -453,8 +470,12 @@ class ECBackendMixin:
         t.setattr(cid, msg.oid, "size", str(new_size).encode())
         t.setattr(cid, msg.oid, "ver", str(version).encode())
         self._log_txn(t, cid, pg, entry)
+        t_c0 = trace_now()
         self.store.queue_transaction(t)
+        self._op_stage("commit", t_c0, trace_now(), version=version)
         a, deposed, failed = self._collect_subop_acks(tids, acting)
+        self._op_stage("subop", t_sub0, trace_now(), span=sub_span,
+                       fanout=len(tids), acked=a)
         acked = 1 + a
         for osd in failed:
             self.mc.report_failure(osd)

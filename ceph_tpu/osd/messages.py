@@ -43,11 +43,16 @@ class MOSDOp(_JsonMessage):
     answers a resent already-applied mutation from it instead of
     re-executing (reference: pg_log dup detection), which is what makes
     append and partial-stripe RMW retry-safe.
+    `trace_id`/`parent_span` carry the cephtrace context minted at
+    Objecter.op_submit (head-based sampling; None = unsampled).  The
+    names deliberately avoid the framing attrs send_message stamps
+    (`seq`/`src` — the CL6 field-shadow trap) so the payload values
+    survive the wire; tests/test_analyzer_proto.py audits this.
     """
 
     MSG_TYPE = 42
     FIELDS = ("tid", "pool", "oid", "op", "data", "epoch", "off", "length",
-              "ps", "snapid", "snap_seq", "reqid")
+              "ps", "snapid", "snap_seq", "reqid", "trace_id", "parent_span")
 
 
 @register_message
@@ -93,12 +98,16 @@ class MECSubOpWrite(_JsonMessage):
     a data write (cache-tier dirty marking: the tier.clean clear must be
     atomic with the mutation it rides — see daemon._cache_tier_op's
     state model; `xattrs` can't carry it on a data push because a
-    data+xattrs message means a full recovery snapshot)."""
+    data+xattrs message means a full recovery snapshot).
+
+    `trace_id`/`parent_span` propagate the primary's cephtrace context
+    (parent = the primary's `subop` fan-out span) so the replica's
+    commit span joins the client's trace tree across daemons."""
 
     MSG_TYPE = 108
     FIELDS = ("tid", "pgid", "oid", "shard", "data", "crc", "version",
               "entry", "epoch", "xattrs", "mode", "off", "over", "osize",
-              "omap", "rmattrs")
+              "omap", "rmattrs", "trace_id", "parent_span")
 
 
 @register_message
@@ -110,10 +119,13 @@ class MECSubOpWriteReply(_JsonMessage):
 @register_message
 class MECSubOpRead(_JsonMessage):
     """Primary → shard OSD: fetch chunk bytes (reference: MOSDECSubOpRead).
-    `offsets` carries optional (off, len) sub-chunk ranges (CLAY repair)."""
+    `offsets` carries optional (off, len) sub-chunk ranges (CLAY repair).
+    `trace_id`/`parent_span` propagate the cephtrace context for traced
+    reads (RMW old-byte fetches, degraded-read gathers)."""
 
     MSG_TYPE = 110
-    FIELDS = ("tid", "pgid", "oid", "shard", "offsets", "epoch")
+    FIELDS = ("tid", "pgid", "oid", "shard", "offsets", "epoch",
+              "trace_id", "parent_span")
 
 
 @register_message

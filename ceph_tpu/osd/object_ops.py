@@ -12,6 +12,7 @@ import time
 import numpy as np
 
 from ..common.crc32c import crc32c
+from ..common.tracer import TRACER, trace_now
 from ..store.object_store import NotFound, Transaction
 from .messages import (
     MECSubOpRead,
@@ -205,6 +206,12 @@ class ObjectOpsMixin:
                          reqid=getattr(msg, "reqid", None))
         wire_entry = entry.to_list()
         tids: dict[int, int] = {}
+        # subop span opens BEFORE the fan-out so each MECSubOpWrite can
+        # carry its id as parent — the replica commit joins THIS node
+        sub_span = TRACER.begin(self._op_trace_ctx(), "subop",
+                                entity=self.whoami) if TRACER.enabled \
+            else None
+        t_sub0 = sub_span.t0 if sub_span is not None else trace_now()
         for shard, osd in enumerate(acting):
             if shard == my_shard or osd < 0:
                 continue
@@ -220,6 +227,10 @@ class ObjectOpsMixin:
                         data=pack_data(chunk), crc=crc32c(chunk),
                         version=version, entry=wire_entry,
                         epoch=self.my_epoch(), osize=len(data),
+                        trace_id=(sub_span.trace_id
+                                  if sub_span is not None else None),
+                        parent_span=(sub_span.span_id
+                                     if sub_span is not None else None),
                     )
                 )
             except (OSError, ConnectionError):
@@ -236,8 +247,12 @@ class ObjectOpsMixin:
         t.setattr(cid, msg.oid, "size", str(len(data)).encode())
         t.setattr(cid, msg.oid, "ver", str(version).encode())
         self._log_txn(t, cid, pg, entry)
+        t_c0 = trace_now()
         self.store.queue_transaction(t)
+        self._op_stage("commit", t_c0, trace_now(), version=version)
         a, deposed, failed = self._collect_subop_acks(tids, acting)
+        self._op_stage("subop", t_sub0, trace_now(), span=sub_span,
+                       fanout=len(tids), acked=a)
         acked = 1 + a
         for osd in failed:
             self.mc.report_failure(osd)

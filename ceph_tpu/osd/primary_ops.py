@@ -11,6 +11,7 @@ import threading
 import time
 
 
+from ..common.tracer import TRACER, TraceCtx, set_op_trace, trace_now
 from ..store.object_store import NotFound
 from .messages import (
     MECSubOpRead,
@@ -36,6 +37,27 @@ class PrimaryOpsMixin:
         tracked = self.op_tracker.create(
             f"osd_op({msg.op} {msg.pool}.{msg.oid} tid={msg.tid})"
         )
+        # cephtrace: adopt the client's context (one attribute check
+        # when tracing is off).  The osd_op span parents every stage
+        # span below; the thread-local op-trace state is how the write
+        # batcher / encode / sub-op layers find it without threading a
+        # ctx through every signature.
+        osd_span = None
+        if TRACER.enabled and getattr(msg, "trace_id", None) is not None:
+            osd_span = TRACER.begin(
+                TraceCtx(msg.trace_id, msg.parent_span), "osd_op",
+                entity=self.whoami, op=msg.op, oid=msg.oid, tid=msg.tid,
+            )
+            rx = getattr(msg, "_rx_ts", None)
+            if osd_span is not None and rx is not None:
+                # mClock dispatch-queue wait (arrival -> execution)
+                TRACER.record(osd_span.ctx(), "dispatch_queue",
+                              entity=self.whoami, t0=rx, t1=osd_span.t0)
+        set_op_trace({
+            "ctx": osd_span.ctx() if osd_span is not None else None,
+            "tracked": tracked,
+        })
+        reply = None
         try:
             tracked.mark_event("started")
             reply = self._execute_client_op(msg)
@@ -48,6 +70,9 @@ class PrimaryOpsMixin:
             )
         finally:
             tracked.finish()
+            set_op_trace(None)
+            TRACER.end(osd_span,
+                       retval=reply.retval if reply is not None else None)
         if msg.op == "read" and reply.retval == 0 and reply.data:
             self.logger.inc("op_r_bytes", len(reply.data) * 3 // 4)
         self.logger.tinc("op_latency", time.perf_counter() - t0)

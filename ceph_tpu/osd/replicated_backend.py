@@ -10,6 +10,7 @@ from __future__ import annotations
 
 
 from ..common.crc32c import crc32c
+from ..common.tracer import TRACER, trace_now
 from ..store.object_store import NotFound, Transaction
 from .messages import (
     MECSubOpWrite,
@@ -83,6 +84,11 @@ class ReplicatedBackendMixin:
                 entry = LogEntry(version, "modify", msg.oid,
                                  reqid=getattr(msg, "reqid", None))
                 tids = {}
+                # subop span opens BEFORE the fan-out (see _ec_write)
+                sub_span = TRACER.begin(self._op_trace_ctx(), "subop",
+                                        entity=self.whoami) \
+                    if TRACER.enabled else None
+                t_sub0 = sub_span.t0 if sub_span is not None else trace_now()
                 for osd in acting:
                     if osd == self.id or not self.osdmap.is_up(osd):
                         continue
@@ -97,6 +103,11 @@ class ReplicatedBackendMixin:
                                 entry=entry.to_list(),
                                 epoch=self.my_epoch(), osize=len(data),
                                 rmattrs=rmattrs,
+                                trace_id=(sub_span.trace_id
+                                          if sub_span is not None else None),
+                                parent_span=(sub_span.span_id
+                                             if sub_span is not None
+                                             else None),
                             )
                         )
                     except (OSError, ConnectionError):
@@ -113,8 +124,13 @@ class ReplicatedBackendMixin:
                 if autoclean:
                     self._txn_clear_clean(t, cid, msg.oid)
                 self._log_txn(t, cid, pg, entry)
+                t_c0 = trace_now()
                 self.store.queue_transaction(t)
+                self._op_stage("commit", t_c0, trace_now(),
+                               version=version)
                 a, deposed, _f = self._collect_subop_acks(tids)
+                self._op_stage("subop", t_sub0, trace_now(), span=sub_span,
+                               fanout=len(tids), acked=a)
                 acked = 1 + a
                 if deposed and acked < pool.min_size:
                     return MOSDOpReply(tid=msg.tid, retval=-116,

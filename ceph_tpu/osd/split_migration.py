@@ -281,6 +281,9 @@ class SplitMigrationMixin:
                 MMgrReport(
                     daemon=self.whoami,
                     counters=self.cct.perf.dump(),
+                    # counter docs/types ride along so the prometheus
+                    # exporter emits real HELP text and histogram TYPEs
+                    schema=self.cct.perf.schema(),
                     epoch=self.my_epoch(),
                     stats={"num_pgs": num_pgs, "num_objects": num_objects,
                            "pool_bytes": {

@@ -12,6 +12,7 @@ import numpy as np
 
 from ..common.crc32c import crc32c
 from ..common.failpoint import FailpointCrash, FailpointError, failpoint
+from ..common.tracer import TRACER, TraceCtx
 from ..store.object_store import NotFound, Transaction
 from .messages import (
     MECSubOpRead,
@@ -34,6 +35,14 @@ class SubOpsMixin:
         pg = self._pg(int(pool_id), int(ps))
         cid = self._cid(msg.pgid, msg.shard)
         retval = 0
+        # cephtrace: the replica's commit joins the primary's subop span
+        # across the daemon boundary (one attribute check when off)
+        rspan = None
+        if TRACER.enabled and getattr(msg, "trace_id", None) is not None:
+            rspan = TRACER.begin(
+                TraceCtx(msg.trace_id, msg.parent_span), "replica_commit",
+                entity=self.whoami, shard=msg.shard, oid=msg.oid,
+            )
         try:
             if (
                 msg.epoch is not None
@@ -46,6 +55,7 @@ class SubOpsMixin:
                 # step down rather than treat it as a flaky peer
                 # (reference: ops tagged with an older
                 # same_interval_since are dropped)
+                TRACER.end(rspan, retval=-116)
                 try:
                     conn.send_message(
                         MECSubOpWriteReply(tid=msg.tid, pgid=msg.pgid,
@@ -262,6 +272,7 @@ class SubOpsMixin:
             retval = -5
         else:
             self.logger.inc("subop_w")
+        TRACER.end(rspan, retval=retval)
         try:
             conn.send_message(
                 MECSubOpWriteReply(tid=msg.tid, pgid=msg.pgid,

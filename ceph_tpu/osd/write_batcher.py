@@ -61,6 +61,7 @@ import numpy as np
 from ..common.failpoint import FailpointCrash, failpoint
 from ..common.lockdep import make_lock
 from ..common.throttle import Throttle
+from ..common.tracer import TRACER, kernel_annotation, op_trace, trace_now
 
 
 class _PendingStripe:
@@ -74,7 +75,8 @@ class _PendingStripe:
     submitter's read."""
 
     __slots__ = ("key", "mat", "chunks", "nbytes", "arrival", "event",
-                 "parity", "error", "admitted")
+                 "parity", "error", "admitted", "tctx", "tracked",
+                 "queued_at")
 
     def __init__(self, mat: np.ndarray, chunks: np.ndarray):
         self.mat = mat
@@ -88,6 +90,11 @@ class _PendingStripe:
         self.parity: np.ndarray | None = None
         self.error: BaseException | None = None
         self.admitted = False  # holds admission-throttle budget
+        # cephtrace: the submitting op's context rides the stripe so the
+        # flusher (a different thread) can attribute queue/encode spans
+        self.tctx = None
+        self.tracked = None
+        self.queued_at = 0.0  # trace_now clock, for the queue-stage span
 
 
 class WriteBatcher:
@@ -125,6 +132,9 @@ class WriteBatcher:
         # own counters so standalone users (bench) see stats without a
         # PerfCounters registry; the OSD's logger mirrors them
         self._stats = {"flushes": 0, "stripes": 0, "bytes": 0, "inline": 0}
+        # fan-in tag tying one fused encode's many per-op spans together;
+        # touched only by the single flusher thread
+        self._flush_seq = 0
 
     # -- config (runtime-changeable: read per use) -------------------------
     def _window(self) -> float:
@@ -207,8 +217,13 @@ class WriteBatcher:
         mat = np.ascontiguousarray(mat, dtype=np.uint8)
         chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
         p = _PendingStripe(mat, chunks)
+        if TRACER.enabled:  # one attribute check when tracing is off
+            st = op_trace()
+            if st is not None:
+                p.tctx = st.get("ctx")
+                p.tracked = st.get("tracked")
         if not self.coalescing():
-            p.parity = self._inline(mat, chunks)
+            p.parity = self._inline(mat, chunks, tctx=p.tctx)
             p.event.set()
             return p
         # backpressure: block HERE, at admission, while the queue is
@@ -217,12 +232,22 @@ class WriteBatcher:
         cap = self._max_bytes() * self.QUEUE_WINDOWS
         if cap != self._admission.max:
             self._admission.reset_max(cap)
+        t_adm0 = trace_now()
         if not self._admission.get(p.nbytes, timeout=self.ADMIT_TIMEOUT):
             raise IOError(
                 f"write batcher admission timed out "
                 f"({self._admission.current} B queued, cap {cap} B)"
             )
         p.admitted = True
+        t_adm1 = trace_now()
+        if self._logger is not None:
+            self._logger.hinc("stage_admission", t_adm1 - t_adm0)
+        if p.tctx is not None:
+            TRACER.record(p.tctx, "admission", entity=self._entity,
+                          t0=t_adm0, t1=t_adm1, nbytes=p.nbytes)
+            if p.tracked is not None:
+                p.tracked.mark_event("admission", ts=t_adm1)
+        p.queued_at = t_adm1
         enqueued = False
         with self._cond:
             if not (self._stop_flag or self._crashed):
@@ -233,7 +258,7 @@ class WriteBatcher:
                 # per-op completion rides p.event (no herd)
                 self._cond.notify_all()
         if not enqueued:  # raced a stop/crash: encode inline
-            p.parity = self._inline(p.mat, p.chunks)
+            p.parity = self._inline(p.mat, p.chunks, tctx=p.tctx)
             p.event.set()
         return p
 
@@ -245,6 +270,10 @@ class WriteBatcher:
                     f"write batcher flush of {p.nbytes} B stripe timed "
                     f"out after {self.OP_TIMEOUT}s"
                 )
+            if p.tracked is not None:
+                # dump_historic_ops offset for the encode stage, same
+                # trace_now clock the flusher's span boundaries use
+                p.tracked.mark_event("encode", ts=trace_now())
             if p.error is not None:
                 raise p.error
             return p.parity
@@ -253,14 +282,26 @@ class WriteBatcher:
                 p.admitted = False
                 self._admission.put(p.nbytes)
 
-    def _inline(self, mat: np.ndarray, chunks: np.ndarray) -> np.ndarray:
+    def _inline(self, mat: np.ndarray, chunks: np.ndarray,
+                tctx=None) -> np.ndarray:
         from ..ops.bitplane import apply_matrix_jax
 
         with self._lock:
             self._stats["inline"] += 1
         if self._logger is not None:
             self._logger.inc("ec_batch_inline")
-        return np.asarray(apply_matrix_jax(mat, chunks), dtype=np.uint8)
+        t0 = trace_now()
+        with kernel_annotation(
+            "ec_encode_inline", (tctx.trace_id,) if tctx is not None else ()
+        ):
+            parity = np.asarray(apply_matrix_jax(mat, chunks),
+                                dtype=np.uint8)
+        if tctx is not None:
+            TRACER.record(tctx, "encode", entity=self._entity,
+                          t0=t0, t1=trace_now(), inline=True)
+        if self._logger is not None:
+            self._logger.hinc("stage_encode", trace_now() - t0)
+        return parity
 
     # -- flusher -----------------------------------------------------------
     def _flush_loop(self) -> None:
@@ -309,6 +350,16 @@ class WriteBatcher:
 
     def _flush_batch(self, batch: list[_PendingStripe]) -> None:
         t0 = time.perf_counter()
+        w0 = trace_now()
+        traced = [p for p in batch if p.tctx is not None]
+        if traced or self._logger is not None:
+            # queue stage: stripe admitted -> flush started
+            for p in batch:
+                if self._logger is not None and p.queued_at:
+                    self._logger.hinc("stage_queue", max(0.0, w0 - p.queued_at))
+            for p in traced:
+                TRACER.record(p.tctx, "queue", entity=self._entity,
+                              t0=p.queued_at or w0, t1=w0)
         err: BaseException | None = None
         try:
             failpoint("osd.write_batcher.flush", cct=self._cct,
@@ -327,6 +378,25 @@ class WriteBatcher:
                 results = self._encode_groups(batch)
             except Exception as e:
                 err = e
+        w1 = trace_now()
+        if err is None and traced:
+            # ONE fused-encode flush, MANY op spans: the fan-in is
+            # expressed as one "encode" span per participating trace
+            # (parent = that op's ctx, so every tree stays connected)
+            # all sharing a flush_id + fan_in tag
+            with self._lock:
+                self._flush_seq += 1
+                fid = self._flush_seq
+            fan_in = len({p.tctx.trace_id for p in traced})
+            seen: set[str] = set()
+            for p in traced:
+                if p.tctx.trace_id in seen:
+                    continue  # one op may batch several stripes
+                seen.add(p.tctx.trace_id)
+                TRACER.record(
+                    p.tctx, "encode", entity=self._entity, t0=w0, t1=w1,
+                    flush_id=fid, stripes=len(batch), fan_in=fan_in,
+                )
         self._complete(batch, err=err, results=results)
         if err is None:
             nbytes = sum(p.nbytes for p in batch)
@@ -340,6 +410,7 @@ class WriteBatcher:
                 self._logger.inc("ec_batch_bytes", nbytes)
                 self._logger.tinc("ec_batch_flush_latency",
                                   time.perf_counter() - t0)
+                self._logger.hinc("stage_encode", w1 - w0)
 
     def _encode_groups(
         self, batch: list[_PendingStripe]

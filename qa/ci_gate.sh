@@ -12,8 +12,15 @@
 #    ~5 s — fails when the batched/per-op encode throughput ratio drops
 #    below 1.0 (the write-batcher regression gate); JSON lands next to
 #    the SARIF artifacts.
+# 4. trace smoke (--trace-smoke): the 2-client CLUSTER traffic run,
+#    untraced vs sampling=1.0 — fails when the traced run produces no
+#    connected trace tree (client submit -> replica commit), when the
+#    per-stage breakdown misses one of admission/queue/encode/subop/
+#    commit, or when tracing-enabled overhead exceeds 10% of the
+#    untraced smoke.  Artifacts: traffic_trace.json (bench JSON) and
+#    trace_perfetto.json (open in ui.perfetto.dev).
 #
-# Both emit SARIF 2.1.0 into qa/_sarif/ (github code-scanning uploads
+# Analyzers emit SARIF 2.1.0 into qa/_sarif/ (github code-scanning uploads
 # resolve URIs against the repo root, which is where this script runs
 # from).  Exit is non-zero if EITHER gate reports active findings —
 # the same exit contracts the pytest gates (tests/test_analyzer.py,
@@ -93,5 +100,26 @@ else
     rc=1
 fi
 
-echo "SARIF written to $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json)"
+echo "== trace smoke (cluster traffic, untraced vs sampling=1.0) =="
+CEPH_TPU_BENCH_FORCE_CPU=1 JAX_PLATFORMS=cpu python -m ceph_tpu.bench.traffic \
+    --cpu --trace-smoke --clients 2 --seconds 2 --json \
+    --trace-out "$OUT_DIR/trace_perfetto.json" \
+    > "$OUT_DIR/traffic_trace.json"
+trace_rc=$?
+if [ $trace_rc -eq 0 ]; then
+    echo "trace smoke: ok"
+elif python -c "import json,sys; json.load(open('$OUT_DIR/traffic_trace.json'))" \
+        2>/dev/null; then
+    # ran to completion: rc!=0 means a gate fired (disconnected tree,
+    # missing stage, or >10% tracing overhead) — details in the JSON
+    echo "trace smoke: FAILED:"
+    python -c "import json; [print(' -', p) for p in json.load(open('$OUT_DIR/traffic_trace.json'))['problems']]" || true
+    rc=1
+else
+    rm -f "$OUT_DIR/traffic_trace.json" "$OUT_DIR/trace_perfetto.json"
+    echo "trace smoke: ERROR (exit $trace_rc) — scenario crashed"
+    rc=1
+fi
+
+echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json)"
 exit $rc

@@ -92,6 +92,39 @@ def test_seq_src_framing_is_base_owned():
     assert out.src == "osd.9"
 
 
+def test_trace_fields_survive_framing():
+    """cephtrace context fields must survive the send path EXACTLY as
+    set: send_message stamps the framing attrs (seq/src) on the
+    instance BEFORE encode, so a trace field named after one of them
+    would be silently clobbered (the CL6 field-shadow trap that killed
+    the MDS cap_seq).  Audit every carrier in the registry: stamp
+    framing attrs the way send_message does, round-trip, and require
+    the payload trace values back byte-identical."""
+    carriers = [
+        cls for cls in _REGISTRY.values()
+        if "trace_id" in getattr(cls, "FIELDS", ())
+    ]
+    # the data-plane messages the tentpole threads context through
+    names = {c.__name__ for c in carriers}
+    assert {"MOSDOp", "MECSubOpWrite", "MECSubOpRead"} <= names
+    for cls in carriers:
+        fields = cls.FIELDS
+        assert "parent_span" in fields, f"{cls.__name__} carries trace_id " \
+            f"without parent_span (orphaned spans)"
+        # the framing-shadow audit proper: no FIELDS entry may collide
+        # with an attr send_message stamps at send time
+        shadowed = {"seq", "src"} & set(fields)
+        assert not shadowed, f"{cls.__name__} FIELDS shadow framing " \
+            f"attrs {shadowed}: send_message would clobber them"
+        m = cls()
+        m.trace_id = "aabbccdd00112233"
+        m.parent_span = "445566778899aabb"
+        m.seq, m.src = 777, "osd.3"  # what send_message stamps
+        out = decode_message(encode_message(m))
+        assert out.trace_id == "aabbccdd00112233", cls.__name__
+        assert out.parent_span == "445566778899aabb", cls.__name__
+
+
 def test_unknown_type_rejected():
     import struct
 

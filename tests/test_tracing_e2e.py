@@ -1,0 +1,223 @@
+"""cephtrace end-to-end: cross-daemon span propagation over a real
+LocalCluster, sampling, Perfetto export, stage histograms, and the
+disabled-path no-op (docs/tracing.md; satellite of the tracing PR).
+
+Fast class (~10 s): one module-scoped 1-mon/4-osd cluster, a handful of
+writes.  The wire-level trace-field round-trip audit lives in
+test_analyzer_proto.py next to the rest of the _REGISTRY conformance
+suite.
+"""
+from __future__ import annotations
+
+import pytest
+
+from ceph_tpu.common.tracer import (
+    OP_STAGES,
+    TRACER,
+    assemble_trees,
+    connected_traces,
+    dump_tracing,
+    perfetto_export,
+    tree_span_names,
+)
+from ceph_tpu.qa.vstart import LocalCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    TRACER.enable(False)
+    TRACER.clear()
+    with LocalCluster(
+        n_mons=1, n_osds=4,
+        conf_overrides={"trace_enabled": True},
+    ) as c:
+        c.create_ec_pool("trace_ec", k=2, m=1, pg_num=8)
+        yield c
+    # the tracer is process-global: never leak an armed tracer into
+    # later test modules
+    TRACER.enable(False)
+    TRACER.clear()
+
+
+def _one_traced_write(cluster, oid: str, data: bytes,
+                      append: bool = False) -> list[dict]:
+    """Write and return ONLY the new write's spans."""
+    before = {s["span_id"] for s in TRACER.spans()}
+    io = cluster.client().open_ioctx("trace_ec")
+    if append:
+        io.append(oid, data)
+    else:
+        io.write_full(oid, data)
+    return [s for s in TRACER.spans() if s["span_id"] not in before]
+
+
+def test_batched_write_produces_connected_tree(cluster):
+    spans = _one_traced_write(cluster, "obj-batched", b"a" * 4096)
+    conn = connected_traces(spans)
+    assert conn, f"no connected trace: {sorted(s['name'] for s in spans)}"
+    trees = assemble_trees(spans)
+    root = trees[conn[0]][0]
+    names = tree_span_names(root)
+    # the full pipeline, across three entities (client, primary,
+    # replicas): submit -> osd_op -> batcher stages -> fan-out -> commit
+    assert root["span"]["name"] == "op_submit"
+    assert {"osd_op", "subop", "replica_commit"} <= names
+    assert {"admission", "queue", "encode", "commit"} <= names, names
+    # entities differ across the tree: this is a DISTRIBUTED trace
+    entities = {s["entity"] for s in spans}
+    assert any(e.startswith("client.") for e in entities)
+    assert sum(1 for e in entities if e.startswith("osd.")) >= 2
+    # the fused-flush fan-in span carries its batch identity
+    enc = [s for s in spans if s["name"] == "encode"]
+    assert enc and all("flush_id" in (s.get("tags") or {}) for s in enc)
+
+
+def test_inline_path_produces_connected_tree(cluster):
+    # ec_batch_window_ms=0 turns coalescing off: the encode span comes
+    # from the batcher's inline fallback instead of a flush
+    for osd in cluster.osds.values():
+        osd.cct.conf.set("ec_batch_window_ms", 0)
+    try:
+        spans = _one_traced_write(cluster, "obj-inline", b"b" * 4096)
+    finally:
+        for osd in cluster.osds.values():
+            osd.cct.conf.set("ec_batch_window_ms", 2.0)
+    conn = connected_traces(spans)
+    assert conn
+    names = tree_span_names(assemble_trees(spans)[conn[0]][0])
+    assert {"osd_op", "encode", "subop", "replica_commit"} <= names
+    enc = [s for s in spans if s["name"] == "encode"]
+    assert any((s.get("tags") or {}).get("inline") for s in enc)
+
+
+def test_rmw_append_traced(cluster):
+    _one_traced_write(cluster, "obj-rmw", b"c" * 4096)
+    spans = _one_traced_write(cluster, "obj-rmw", b"d" * 512, append=True)
+    conn = connected_traces(spans)
+    assert conn, sorted(s["name"] for s in spans)
+    root = assemble_trees(spans)[conn[0]][0]
+    assert (root["span"].get("tags") or {}).get("op") == "append"
+
+
+def test_sampling_rate_honored(cluster):
+    cl = cluster.client()
+    cl.cct.conf.set("trace_sampling_rate", 0.0)
+    io = cl.open_ioctx("trace_ec")
+    before = len(TRACER.spans())
+    io.write_full("obj-unsampled", b"e" * 1024)
+    new = [s for s in TRACER.spans()[before:] if s["name"] == "op_submit"
+           and (s.get("tags") or {}).get("oid") == "obj-unsampled"]
+    assert new == [], "rate=0.0 must mint no trace context"
+
+
+def test_perfetto_export_validates(cluster):
+    spans = TRACER.spans()
+    assert spans, "earlier tests recorded spans"
+    doc = perfetto_export(spans)
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    procs = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert procs and all(e["name"] == "process_name" for e in procs)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert xs, "no complete events"
+    for e in xs:
+        # the chrome trace-event schema's required keys for ph=X
+        assert isinstance(e["name"], str)
+        assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+        assert e["dur"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert e["args"]["trace_id"]
+    # every X event's pid resolves to a declared process
+    declared = {e["pid"] for e in procs}
+    assert {e["pid"] for e in xs} <= declared
+
+
+def test_stage_histograms_populated(cluster):
+    from ceph_tpu.common.perf_counters import HIST_NUM_BUCKETS
+
+    dumps = [osd.logger.dump() for osd in cluster.osds.values()]
+    for stage in OP_STAGES:
+        agg = sum(d[f"stage_{stage}"]["count"] for d in dumps)
+        assert agg > 0, f"stage_{stage} never sampled"
+    h = dumps[0]["stage_commit"]
+    assert len(h["buckets"]) == HIST_NUM_BUCKETS + 1  # log2 + overflow
+    # schema declares the type so the exporter can render it
+    schema = next(iter(cluster.osds.values())).logger.schema()
+    assert schema["stage_commit"]["type"] == "histogram"
+
+
+def test_prometheus_renders_batch_counters_and_histograms(cluster):
+    from ceph_tpu.mgr.prometheus_module import render_metrics
+
+    osd = next(o for o in cluster.osds.values()
+               if o.logger.dump()["stage_commit"]["count"] > 0)
+    text = render_metrics(
+        None,
+        {osd.whoami: {"osd": osd.logger.dump()}},
+        schema={"osd": osd.logger.schema()},
+    )
+    # PR-8 batch counters surface WITH their declared doc as HELP
+    assert ("# HELP ceph_osd_ec_batch_flushes "
+            "coalesced encode batches flushed") in text
+    assert "ceph_osd_ec_batch_stripes" in text
+    assert "ceph_osd_ec_batch_flush_latency_sum" in text
+    # stage histograms render as real prometheus histograms
+    assert "# TYPE ceph_osd_stage_commit histogram" in text
+    assert 'ceph_osd_stage_commit_bucket{ceph_daemon="' in text
+    assert 'le="+Inf"' in text
+    assert "ceph_osd_stage_commit_count{" in text
+
+
+def test_historic_ops_share_stage_clock(cluster):
+    """dump_historic_ops offsets and span boundaries ride one helper
+    (OSD._op_stage) and one clock: the stage names appear as tracked
+    events with monotonic non-negative offsets."""
+    _one_traced_write(cluster, "obj-historic", b"f" * 2048)
+    found = None
+    for osd in cluster.osds.values():
+        for op in osd.op_tracker.dump_historic_ops()["ops"]:
+            evs = [e["event"] for e in op["type_data"]["events"]]
+            if "obj-historic" in op["description"] and "subop" in evs:
+                found = op
+    assert found is not None, "primary's historic op records stage marks"
+    evs = found["type_data"]["events"]
+    assert {"admission", "encode", "subop", "commit"} <= {
+        e["event"] for e in evs}
+    offs = [e["offset"] for e in evs]
+    assert all(o >= 0 for o in offs)
+    assert offs == sorted(offs), "stage offsets must be monotonic"
+
+
+def test_dump_tracing_entity_filter(cluster):
+    osd_entities = {s["entity"] for s in TRACER.spans()
+                    if s["entity"].startswith("osd.")}
+    assert osd_entities
+    ent = sorted(osd_entities)[0]
+    d = dump_tracing(entity=ent)
+    assert d["entity"] == ent and d["num_spans"] > 0
+    assert all(s["entity"] == ent for s in d["spans"])
+    # tracepoint events are entity-stamped too (the singleton's old
+    # daemon-identity blindness): msgr send/recv carry their messenger
+    evs = TRACER.events(subsys="msgr")
+    assert evs and all(e["entity"] for e in evs)
+    only = TRACER.events(subsys="msgr", entity=evs[0]["entity"])
+    assert only and {e["entity"] for e in only} == {evs[0]["entity"]}
+    # perfetto-format dump stays loadable
+    pf = dump_tracing(entity=ent, fmt="perfetto")
+    assert pf["traceEvents"]
+
+
+def test_disabled_path_is_noop(cluster):
+    # client minted BEFORE disabling: a fresh trace_enabled=True context
+    # would re-arm the process-wide tracer
+    io = cluster.client().open_ioctx("trace_ec")
+    TRACER.enable(False)
+    try:
+        before = len(TRACER.spans())
+        assert TRACER.new_trace() is None
+        assert TRACER.begin(None, "x") is None
+        TRACER.end(None)  # no-op on the unsampled sentinel
+        TRACER.record(None, "x")
+        io.write_full("obj-off", b"g" * 1024)
+        assert len(TRACER.spans()) == before, "disabled tracer recorded"
+    finally:
+        TRACER.enable(True)
