@@ -40,6 +40,14 @@ class CephContext:
             from .tracer import TRACER
 
             TRACER.enable(True)
+        if not self.conf.get("kernel_telemetry"):
+            # the kernel telemetry registry is process-wide like the
+            # tracer, but default-ON (observability parity with perf
+            # counters); a context disabling it disarms the process —
+            # disabled dispatch pays one attribute check (PERF.md)
+            from .kernel_telemetry import TELEMETRY
+
+            TELEMETRY.enable(False)
         # mon-minted service tickets for cephx clients without the cluster
         # secret: {service: {"ticket": blob_hex, "session_key": hex}};
         # runtime credentials, not config (reference: the client-side
@@ -100,6 +108,35 @@ class CephContext:
             "(all=true for the whole process; format=perfetto for "
             "Chrome-trace JSON loadable in ui.perfetto.dev)",
         )
+        ask.register_command(
+            "dump_kernel_telemetry", self._dump_kernel_telemetry_cmd,
+            "per-kernel dispatch telemetry + backend sentinel state "
+            "(process-wide; docs/observability.md)",
+        )
+        ask.register_command(
+            "clear_kernel_fallback", self._clear_kernel_fallback_cmd,
+            "un-latch the codec's XLA fallback without a restart: the "
+            "next auto-mode dispatch retries the Pallas kernel",
+        )
+
+    def _dump_kernel_telemetry_cmd(self, cmd: dict) -> object:
+        from .kernel_telemetry import dump_kernel_telemetry
+
+        return dump_kernel_telemetry()
+
+    def _clear_kernel_fallback_cmd(self, cmd: dict) -> dict:
+        import sys as _sys
+
+        from .kernel_telemetry import TELEMETRY
+
+        cleared = TELEMETRY.clear_fallback()
+        # un-latch the bitplane module only if the data plane loaded it:
+        # importing ops.bitplane pulls jax into processes (mon-only, CLI)
+        # that never run kernels
+        bp = _sys.modules.get("ceph_tpu.ops.bitplane")
+        if bp is not None:
+            cleared = bp.clear_fallback_latch() or cleared
+        return {"cleared": bool(cleared)}
 
     def _dump_tracing_cmd(self, cmd: dict) -> object:
         from .tracer import dump_tracing

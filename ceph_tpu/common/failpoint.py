@@ -438,6 +438,7 @@ KNOWN_FAILPOINTS = frozenset({
     "mon.paxos.commit",
     "mon.election.start",
     "mon.tick",
+    "tpu.backend.probe",
 })
 
 

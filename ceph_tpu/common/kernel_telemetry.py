@@ -1,0 +1,504 @@
+"""cephdev — per-kernel telemetry registry + TPU backend health sentinel
+(reference: the mon `DEVICE_HEALTH*`/`SLOW_OPS` device-health scraping of
+src/mgr/DaemonHealthMetricCollector.cc + mgr/devicehealth, applied to the
+accelerator under the data plane; arXiv:1709.05365's finding that a
+degraded device path changes the whole write path's queueing behavior —
+so degradation must be a first-class, alertable cluster state, not a
+bench footnote).
+
+Two layers, both process-wide (kernel dispatch is per-process, like the
+`ec_kernel` override and the cephtrace TRACER):
+
+- **KernelTelemetry** (``TELEMETRY``): one record per kernel entry point
+  (``gf_apply``, ``gf_xor``, ``stream_encode``, ``ec_batch_flush``,
+  ``crush_do_rule_batch``) — invocation counts, compile-vs-execute wall
+  time as log2 histograms (the PR-9 ``TYPE_HISTOGRAM``), bytes in/out,
+  achieved GiB/s where the call is a true sync point, and the backend
+  that served each call.  Storage IS a shared
+  :class:`~ceph_tpu.common.perf_counters.PerfCounters` ("kernel"), so the
+  numbers flow through the existing ``perf dump`` -> MMgrReport ->
+  prometheus exporter pipeline (HELP text from the PR-9 schema path)
+  with zero new wire plumbing.  Fallback latches (the codec's one-shot
+  Pallas->XLA downgrade) are recorded with reason + timestamp and feed
+  the ``KERNEL_FALLBACK_LATCHED`` health check.  Disabled, every
+  instrumented dispatch pays ONE attribute check (measured in PERF.md).
+
+- **BackendSentinel** (``SENTINEL``): a probe thread (constructor-
+  injected :class:`SentinelPolicy`, per the ROADMAP's topology-injection
+  direction) that checks backend liveness on a FAST timeout — the probe
+  runs on a disposable worker thread so a wedged backend hangs the
+  worker, never the sentinel or any caller — and latches a
+  cluster-visible ``degraded`` state instead of wedging callers.  The
+  kernel dispatch policy (``ops.bitplane._want_pallas``) consults the
+  latch, so a sick backend downgrades the data path instead of feeding
+  it.  The state clears itself when a later probe answers.  Surfaced as
+  the mon ``TPU_BACKEND_DEGRADED`` health check (OSD ``_mgr_report`` ->
+  mgr status digest -> mon ``_status``), the ``dump_kernel_telemetry``
+  admin command, and ``bench.py``'s wedge reporting.
+
+CI / tests force states without hardware: the ``CEPH_TPU_SENTINEL_STATE``
+env var (``degraded[:reason]`` / ``ok``) short-circuits the default
+probe, and the ``tpu.backend.probe`` failpoint (``error`` arm) fails it
+through the registry.  See docs/observability.md.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from .failpoint import failpoint
+from .lockdep import make_lock
+from .perf_counters import PerfCounters
+
+#: bounded latch/sentinel event log (rare transitions; 256 is weeks)
+_MAX_EVENTS = 256
+
+
+class _KernelStats:
+    """Rich per-kernel record behind the PerfCounters mirror (backends
+    per call, last-call provenance, achieved GiB/s)."""
+
+    __slots__ = ("calls", "bytes_in", "bytes_out", "exec_seconds",
+                 "compiles", "backends", "last_backend", "last_ts",
+                 "last_gibps")
+
+    def __init__(self):
+        self.calls = 0
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.exec_seconds = 0.0
+        self.compiles = 0
+        self.backends: dict[str, int] = {}
+        self.last_backend: str | None = None
+        self.last_ts: float | None = None
+        self.last_gibps: float | None = None
+
+    def to_dict(self) -> dict:
+        return {
+            "calls": self.calls,
+            "bytes_in": self.bytes_in,
+            "bytes_out": self.bytes_out,
+            "exec_seconds": self.exec_seconds,
+            "compiles": self.compiles,
+            "backends": dict(self.backends),
+            "last_backend": self.last_backend,
+            "last_ts": self.last_ts,
+            "last_gibps": self.last_gibps,
+        }
+
+
+class KernelTelemetry:
+    """Process-wide per-kernel dispatch telemetry (see module docstring).
+
+    The hot-path contract: every instrumented seam does
+
+        if TELEMETRY.enabled:
+            ...time + record...
+
+    so disabled telemetry costs one attribute check per dispatch.
+    """
+
+    def __init__(self):
+        self.enabled = True
+        self._lock = make_lock("telemetry::kernels")
+        #: shared PerfCounters: daemons add this one object to their
+        #: cct.perf so kernel series ride the existing report pipeline
+        self.perf = PerfCounters("kernel")
+        self._kernels: dict[str, _KernelStats] = {}
+        self._declared: set[str] = set()
+        self._compile_keys: set[tuple] = set()
+        #: kernel -> active fallback latch record (reason, ts, from, to)
+        self._fallbacks: dict[str, dict] = {}
+        self._events: list[dict] = []
+
+    def enable(self, on: bool = True) -> None:
+        self.enabled = on
+
+    # -- recording ---------------------------------------------------------
+    def _declare_locked(self, kernel: str) -> _KernelStats:
+        ks = self._kernels.get(kernel)
+        if ks is None:
+            ks = self._kernels[kernel] = _KernelStats()
+        if kernel not in self._declared:
+            self._declared.add(kernel)
+            self.perf._add(f"{kernel}_calls", "u64",
+                           f"{kernel} kernel invocations")
+            self.perf._add(f"{kernel}_bytes_in", "u64",
+                           f"{kernel} input bytes dispatched")
+            self.perf._add(f"{kernel}_bytes_out", "u64",
+                           f"{kernel} output bytes produced")
+            self.perf._add(f"{kernel}_compile", "histogram",
+                           f"{kernel} first-shape (compile) wall time")
+            self.perf._add(f"{kernel}_execute", "histogram",
+                           f"{kernel} steady-state dispatch wall time")
+            self.perf._add(f"{kernel}_gibps", "gauge",
+                           f"{kernel} last achieved GiB/s (sync calls)")
+        return ks
+
+    def first_call(self, key: tuple) -> bool:
+        """True the first time `key` (kernel + shapes + backend) is seen —
+        the compile-vs-execute histogram discriminator (jit recompiles
+        per shape, so a fresh shape's wall time includes the compile)."""
+        with self._lock:
+            if key in self._compile_keys:
+                return False
+            self._compile_keys.add(key)
+            return True
+
+    def record(self, kernel: str, backend: str, seconds: float,
+               bytes_in: int = 0, bytes_out: int = 0,
+               compiled: bool = False, synced: bool = False) -> None:
+        """One kernel dispatch.  `synced` marks calls whose wall time
+        covers a device round-trip (result fetched) — only those yield
+        an honest achieved-GiB/s sample; async dispatches record wall
+        time only (JAX queues the launch and returns)."""
+        if not self.enabled:
+            return
+        now = time.time()
+        gibps = None
+        if synced and seconds > 0 and bytes_in:
+            gibps = bytes_in / seconds / 2**30
+        with self._lock:
+            ks = self._declare_locked(kernel)
+            ks.calls += 1
+            ks.bytes_in += int(bytes_in)
+            ks.bytes_out += int(bytes_out)
+            ks.exec_seconds += seconds
+            ks.backends[backend] = ks.backends.get(backend, 0) + 1
+            ks.last_backend = backend
+            ks.last_ts = now
+            if compiled:
+                ks.compiles += 1
+            if gibps is not None:
+                ks.last_gibps = gibps
+        self.perf.inc(f"{kernel}_calls")
+        if bytes_in:
+            self.perf.inc(f"{kernel}_bytes_in", int(bytes_in))
+        if bytes_out:
+            self.perf.inc(f"{kernel}_bytes_out", int(bytes_out))
+        self.perf.hinc(f"{kernel}_compile" if compiled
+                       else f"{kernel}_execute", seconds)
+        if gibps is not None:
+            self.perf.set(f"{kernel}_gibps", gibps)
+
+    # -- fallback latches + event log --------------------------------------
+    def record_event(self, kind: str, **fields) -> None:
+        """Append one transition event (fallback latch/clear, sentinel
+        degrade/recover) to the bounded log; always on — transitions are
+        rare and ARE the alertable signal, so they bypass `enabled`."""
+        with self._lock:
+            self._events.append({"ts": time.time(), "kind": kind, **fields})
+            if len(self._events) > _MAX_EVENTS:
+                del self._events[: _MAX_EVENTS // 4]
+
+    def record_fallback(self, kernel: str, reason: str,
+                        frm: str = "pallas", to: str = "xla") -> None:
+        """A kernel latched a fallback backend (the codec's one-shot
+        Pallas->XLA downgrade).  Feeds KERNEL_FALLBACK_LATCHED."""
+        rec = {"kernel": kernel, "reason": reason, "from": frm, "to": to,
+               "ts": time.time()}
+        with self._lock:
+            self._fallbacks[kernel] = rec
+        self.record_event("fallback_latched", **rec)
+
+    def clear_fallback(self, kernel: str | None = None) -> bool:
+        """Drop active fallback latches (kernel=None: all).  Returns
+        True if anything was latched.  The bitplane module's
+        `clear_fallback_latch` composes this with its own un-latch."""
+        with self._lock:
+            if kernel is None:
+                cleared = sorted(self._fallbacks)
+                self._fallbacks.clear()
+            else:
+                cleared = [kernel] if self._fallbacks.pop(kernel, None) \
+                    else []
+        for k in cleared:
+            self.record_event("fallback_cleared", kernel=k)
+        return bool(cleared)
+
+    def fallback_latched(self) -> dict:
+        """{kernel: latch record} for every active latch ({} = none)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._fallbacks.items()}
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    # -- introspection -----------------------------------------------------
+    def dump(self) -> dict:
+        with self._lock:
+            kernels = {k: v.to_dict() for k, v in self._kernels.items()}
+        return kernels
+
+    def summary(self, kernels=None) -> dict:
+        """Compact {kernel: {calls, backends, last_backend, last_gibps}}
+        (bench.py attaches this to phase results as silicon provenance)."""
+        out = {}
+        with self._lock:
+            for k, v in self._kernels.items():
+                if kernels is not None and k not in kernels:
+                    continue
+                out[k] = {"calls": v.calls, "backends": dict(v.backends),
+                          "last_backend": v.last_backend,
+                          "last_gibps": v.last_gibps}
+        return out
+
+
+TELEMETRY = KernelTelemetry()
+
+
+# -- backend health sentinel -----------------------------------------------
+
+def default_probe() -> str:
+    """Backend liveness probe: returns the platform string or raises.
+
+    Runs on a DISPOSABLE worker thread (a wedged backend hangs the
+    worker, not the sentinel).  Overridable without hardware:
+
+    - failpoint ``tpu.backend.probe`` (``error`` arm) fails it through
+      the registry;
+    - ``CEPH_TPU_SENTINEL_STATE=degraded[:reason]`` fails it,
+      ``=ok`` passes it — both WITHOUT touching jax (the CI simulated
+      wedge; bench.py's watchdog probe honors the same variable).
+    """
+    failpoint("tpu.backend.probe")
+    forced = os.environ.get("CEPH_TPU_SENTINEL_STATE", "")
+    if forced:
+        state, _, reason = forced.partition(":")
+        if state == "degraded":
+            raise RuntimeError(
+                reason or "forced degraded (CEPH_TPU_SENTINEL_STATE)")
+        return "forced-ok"
+    import jax
+
+    return jax.devices()[0].platform
+
+
+class SentinelPolicy:
+    """Constructor-injected sentinel behavior (probe cadence, the fast
+    timeout that bounds a wedged probe, and the probe itself) — the same
+    injection shape the ROADMAP asks of device topology, so a test can
+    hand the sentinel a canned probe and a laptop and a pod slice run
+    the same daemon code."""
+
+    __slots__ = ("interval", "timeout", "probe", "boot_timeout")
+
+    def __init__(self, interval: float = 5.0, timeout: float = 2.0,
+                 probe=None, boot_timeout: float | None = None):
+        self.interval = float(interval)
+        self.timeout = float(timeout)
+        self.probe = probe if probe is not None else default_probe
+        # until the runtime has answered ONCE, the probe budget covers
+        # cold init (the first jax.devices() on a real TPU routinely
+        # takes >2 s bringing the runtime up) — without this grace every
+        # cold boot latches a spurious TPU_BACKEND_DEGRADED blip
+        self.boot_timeout = (float(boot_timeout) if boot_timeout is not None
+                             else max(15.0, 5.0 * self.timeout))
+
+
+class BackendSentinel:
+    """Latched backend health state + the probe loop (see module
+    docstring).  Refcounted start: every OSD acquires it at boot with
+    its conf-built policy (first acquirer's policy wins — the backend is
+    per-process) and releases at shutdown; the loop stops with the last
+    daemon."""
+
+    def __init__(self, policy: SentinelPolicy | None = None):
+        self._policy = policy or SentinelPolicy()
+        self._lock = make_lock("telemetry::sentinel")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._refs = 0
+        #: hot-path flag (ops.bitplane reads it per dispatch): plain
+        #: attribute, flipped only inside _transition under _lock
+        self.is_degraded = False
+        self._forced: tuple[str, str] | None = None
+        self._hung_probe: threading.Thread | None = None
+        self._answered = False  # any probe ever returned (ok OR error)
+        self._st = {
+            "state": "unknown", "reason": None, "since": None,
+            "platform": None, "last_probe": None, "probes": 0,
+            "transitions": 0,
+        }
+
+    # -- lifecycle (refcounted) --------------------------------------------
+    def acquire(self, policy: SentinelPolicy | None = None) -> None:
+        with self._lock:
+            self._refs += 1
+            if self._thread is not None:
+                return
+            if policy is not None:
+                self._policy = policy
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="backend-sentinel", daemon=True)
+            t = self._thread
+        t.start()
+
+    def release(self) -> None:
+        with self._lock:
+            self._refs = max(0, self._refs - 1)
+            if self._refs:
+                return
+            self._stop.set()
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def running(self) -> bool:
+        with self._lock:
+            return self._thread is not None
+
+    # -- state -------------------------------------------------------------
+    def degraded(self) -> bool:
+        return self.is_degraded
+
+    def state(self) -> dict:
+        with self._lock:
+            return dict(self._st)
+
+    def reset_state(self) -> None:
+        """Back to pristine `unknown` (clears any force pin): tests and
+        one-shot tools that must not leak latched state process-wide."""
+        with self._lock:
+            self._forced = None
+            self._hung_probe = None
+            self._answered = False
+            self.is_degraded = False
+            self._st = {
+                "state": "unknown", "reason": None, "since": None,
+                "platform": None, "last_probe": None, "probes": 0,
+                "transitions": 0,
+            }
+
+    def force(self, state: str | None, reason: str = "") -> None:
+        """Test/operator hook: pin the sentinel state ('degraded'/'ok'),
+        applied immediately and held against probes until force(None)."""
+        with self._lock:
+            self._forced = None if state is None else (state, reason)
+        if state is not None:
+            self._transition(state == "degraded",
+                             reason or f"forced {state}",
+                             platform=None)
+
+    # -- probing -----------------------------------------------------------
+    def probe_once(self) -> dict:
+        """One synchronous probe cycle (the loop body; also bench.py's
+        entry).  Returns the resulting state dict."""
+        self._probe_cycle()
+        return self.state()
+
+    def _loop(self) -> None:
+        interval = max(0.05, self._policy.interval)
+        while not self._stop.wait(timeout=interval):
+            try:
+                self._probe_cycle()
+            except Exception as e:
+                # the sentinel must never die to a probe bug; latch the
+                # uncertainty instead
+                self._transition(True, f"sentinel probe raised: {e!r}",
+                                 platform=None)
+
+    def _probe_cycle(self) -> None:
+        with self._lock:
+            forced = self._forced
+            self._st["probes"] += 1
+            self._st["last_probe"] = time.time()
+            hung = self._hung_probe
+        if forced is not None:
+            self._transition(forced[0] == "degraded",
+                             forced[1] or f"forced {forced[0]}",
+                             platform=None)
+            return
+        if hung is not None and hung.is_alive():
+            # the previous probe never answered: the backend is still
+            # wedged — do not stack more hung workers
+            self._transition(True, "backend probe still hung", None)
+            return
+        box: dict = {}
+        done = threading.Event()
+
+        def work():
+            try:
+                box["platform"] = self._policy.probe()
+            except BaseException as e:
+                box["error"] = f"{type(e).__name__}: {e}"
+            done.set()
+
+        t = threading.Thread(target=work, name="backend-probe", daemon=True)
+        t.start()
+        with self._lock:
+            # the fast timeout applies once the runtime has answered at
+            # least once; a cold process gets the boot grace instead
+            timeout = (self._policy.timeout if self._answered
+                       else self._policy.boot_timeout)
+        if not done.wait(timeout=timeout):
+            with self._lock:
+                self._hung_probe = t
+            self._transition(
+                True, f"backend probe timed out after {timeout}s", None)
+            return
+        with self._lock:
+            self._hung_probe = None
+            self._answered = True
+        if "error" in box:
+            self._transition(True, f"backend probe failed: {box['error']}",
+                             None)
+        else:
+            self._transition(False, None, box.get("platform"))
+
+    def _transition(self, degraded: bool, reason: str | None,
+                    platform: str | None) -> None:
+        """Apply a probe outcome; log + event only on EDGES so a wedged
+        backend yields one alert, not one per probe."""
+        with self._lock:
+            was = self._st["state"]
+            now_state = "degraded" if degraded else "ok"
+            changed = was != now_state
+            self._st["state"] = now_state
+            self._st["reason"] = reason
+            if platform is not None:
+                self._st["platform"] = platform
+            if changed:
+                self._st["since"] = time.time()
+                self._st["transitions"] += 1
+            self.is_degraded = degraded
+        if not changed:
+            return
+        if degraded:
+            print(f"# ceph_tpu: backend sentinel DEGRADED: {reason}",
+                  file=sys.stderr)
+            TELEMETRY.record_event("sentinel_degraded", reason=reason)
+        else:
+            if was == "degraded":
+                print("# ceph_tpu: backend sentinel recovered",
+                      file=sys.stderr)
+            TELEMETRY.record_event("sentinel_recovered",
+                                   platform=platform)
+
+
+SENTINEL = BackendSentinel()
+
+
+def backend_health() -> dict:
+    """The per-daemon health blob OSDs ship inside MMgrReport stats —
+    the mgr status digest aggregates it and the mon `_health` turns it
+    into TPU_BACKEND_DEGRADED / KERNEL_FALLBACK_LATCHED checks."""
+    return {
+        "sentinel": SENTINEL.state(),
+        "fallback": TELEMETRY.fallback_latched(),
+    }
+
+
+def dump_kernel_telemetry() -> dict:
+    """The `dump_kernel_telemetry` admin-socket payload."""
+    return {
+        "enabled": TELEMETRY.enabled,
+        "kernels": TELEMETRY.dump(),
+        "fallback": TELEMETRY.fallback_latched(),
+        "sentinel": SENTINEL.state(),
+        "events": TELEMETRY.events(),
+    }

@@ -267,6 +267,30 @@ def default_options() -> OptionTable:
                    "through them client admission, when the encode "
                    "stage falls behind.  0 = unbounded", min=0,
                    runtime=True),
+            Option("kernel_telemetry", bool, True,
+                   "per-kernel dispatch telemetry registry "
+                   "(common/kernel_telemetry.py): invocation counts, "
+                   "compile/execute log2 histograms, bytes, achieved "
+                   "GiB/s, backend per call, fallback-latch events — "
+                   "dump_kernel_telemetry / prometheus.  Process-wide; "
+                   "False disarms it (disabled dispatch pays one "
+                   "attribute check, measured in PERF.md)"),
+            Option("backend_sentinel_interval", float, 5.0,
+                   "seconds between backend liveness probes by the "
+                   "health sentinel (latches the TPU_BACKEND_DEGRADED "
+                   "cluster state instead of wedging callers; "
+                   "docs/observability.md).  0 disables the sentinel.  "
+                   "Read ONCE at daemon start into the injected policy "
+                   "(first daemon in the process wins) — restart to "
+                   "change", min=0.0),
+            Option("backend_sentinel_timeout", float, 2.0,
+                   "fast-fail budget for one backend probe: a probe "
+                   "that has not answered within this latches "
+                   "`degraded` (the wedged-tunnel signature is a hang, "
+                   "not an error).  A cold process gets a boot grace "
+                   "(max(15s, 5x) until the runtime first answers) so "
+                   "jax init cannot latch a false degrade.  Read once "
+                   "at daemon start, like the interval", min=0.1),
             Option("ec_kernel", str, "auto",
                    "encode kernel selection for the default (jax) EC "
                    "plugin: oracle/numpy swap the backend, xla/pallas "

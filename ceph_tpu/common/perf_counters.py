@@ -62,9 +62,13 @@ class PerfCounters:
         self._lock = make_lock("perf::counters")
 
     def _add(self, name: str, ctype: str, doc: str) -> None:
-        if name in self._counters:
-            raise ValueError(f"duplicate perf counter {self.name}.{name}")
-        self._counters[name] = _Counter(name, ctype, doc)
+        # locked: the kernel-telemetry registry declares counters lazily
+        # at first dispatch, racing dump()/schema() iterations
+        with self._lock:
+            if name in self._counters:
+                raise ValueError(
+                    f"duplicate perf counter {self.name}.{name}")
+            self._counters[name] = _Counter(name, ctype, doc)
 
     def inc(self, name: str, amount: float = 1) -> None:
         c = self._counters[name]
@@ -136,10 +140,11 @@ class PerfCounters:
         return out
 
     def schema(self) -> dict:
-        return {
-            c.name: {"type": c.type, "description": c.doc}
-            for c in self._counters.values()
-        }
+        with self._lock:
+            return {
+                c.name: {"type": c.type, "description": c.doc}
+                for c in self._counters.values()
+            }
 
 
 class _Timer:

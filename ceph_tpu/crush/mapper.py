@@ -38,10 +38,13 @@ from __future__ import annotations
 
 import threading
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common.kernel_telemetry import TELEMETRY
 from .batched import (
     I64Engine,
     LimbEngine,
@@ -452,13 +455,20 @@ def crush_do_rule_batch(
     evaluation is ample (uniform buckets are additionally STATEFUL per
     (x, rule) via their permutation cache, which is hostile to the
     fixed-trip vectorization)."""
+    tm = TELEMETRY
     if not getattr(cm, "straw2_only", True):
         from .oracle_bridge import do_rule_steps_oracle
 
+        t0 = time.perf_counter() if tm.enabled else 0.0
         out = do_rule_steps_oracle(
             cm.cmap, rule_id, np.asarray(xs), numrep,
             np.asarray(weightvec), choose_args, cm=cm,
         )
+        if tm.enabled:
+            tm.record("crush_do_rule_batch", "oracle",
+                      time.perf_counter() - t0,
+                      bytes_in=int(np.asarray(xs).nbytes),
+                      bytes_out=int(out.nbytes), synced=True)
         return jnp.asarray(out)
     engine_mode, score_fn, uses_pallas = default_engine_config()
     key = (rule_id, numrep, choose_args, engine_mode, uses_pallas)
@@ -471,9 +481,13 @@ def crush_do_rule_batch(
         cm._rule_fn_cache[key] = built
         return built
 
-    cached = cm._rule_fn_cache.get(key) or build_and_cache()
+    cached = cm._rule_fn_cache.get(key)
+    compiled = cached is None
+    if cached is None:
+        cached = build_and_cache()
+    t0 = time.perf_counter() if tm.enabled else 0.0
     try:
-        return _launch_rule_fn(cm, cached, xs, numrep, weightvec)
+        out = _launch_rule_fn(cm, cached, xs, numrep, weightvec)
     except Exception as e:
         # one-shot downshift: an unattended bench must not lose the CRUSH
         # metric to a straw2-tile shape the installed Mosaic rejects —
@@ -548,6 +562,17 @@ def crush_do_rule_batch(
                 pallas_crush.DEFAULT_TILE = orig_tile
                 cm._rule_fn_cache.pop(key, None)
                 raise
+    else:
+        if tm.enabled:
+            # dispatch-side wall time (the result is a device array);
+            # the rare one-shot downshift retries above go unrecorded
+            tm.record("crush_do_rule_batch",
+                      "pallas" if uses_pallas else "xla",
+                      time.perf_counter() - t0,
+                      bytes_in=int(getattr(xs, "nbytes", 0) or 0),
+                      bytes_out=int(getattr(out, "nbytes", 0) or 0),
+                      compiled=compiled)
+        return out
 
 
 def _launch_rule_fn(cm, cached, xs, numrep, weightvec) -> jnp.ndarray:

@@ -14,7 +14,8 @@ from ..common.perf_counters import HIST_LE
 from .module import MgrModule, register_module
 
 
-def render_metrics(osdmap, reports: dict, schema: dict | None = None) -> str:
+def render_metrics(osdmap, reports: dict, schema: dict | None = None,
+                   health: dict | None = None) -> str:
     """Text exposition (the pure part, unit-testable without sockets).
 
     `schema` is the merged {subsystem: {counter: {type, description}}}
@@ -23,7 +24,13 @@ def render_metrics(osdmap, reports: dict, schema: dict | None = None) -> str:
     u64/time -> counter, gauge -> gauge, histogram -> a real prometheus
     histogram with cumulative log2 `le` buckets (+Inf, _sum, _count).
     Counters without schema fall back to the generic rendering, so a
-    daemon predating the schema field still exports."""
+    daemon predating the schema field still exports.
+
+    `health` is the mon's `health` payload: rendered as
+    `ceph_health_status` (0=OK 1=WARN 2=ERR) plus one
+    `ceph_health_detail{name,severity}` series per ACTIVE check —
+    upstream mgr/prometheus parity, which is what makes the new
+    TPU_BACKEND_DEGRADED / KERNEL_FALLBACK_LATCHED checks scrapeable."""
     lines: list[str] = []
     schema = schema or {}
 
@@ -48,6 +55,30 @@ def render_metrics(osdmap, reports: dict, schema: dict | None = None) -> str:
             )
             lines.append(f"{name}{lab} {value}")
 
+    if health is not None:
+        hblock = health.get("health") if isinstance(
+            health.get("health"), dict) else {}
+        status = (hblock or {}).get("status")
+        metric(
+            "ceph_health_status",
+            "cluster health status (0=HEALTH_OK 1=HEALTH_WARN "
+            "2=HEALTH_ERR; reference: mgr/prometheus health_status)",
+            "gauge",
+            [({}, {"HEALTH_OK": 0, "HEALTH_WARN": 1,
+                   "HEALTH_ERR": 2}.get(status, 2))],
+        )
+        checks = (hblock or {}).get("checks") or {}
+        if checks:
+            metric(
+                "ceph_health_detail",
+                "active health checks (1 per check; reference: "
+                "mgr/prometheus health_detail)", "gauge",
+                [
+                    ({"name": name,
+                      "severity": chk.get("severity", "HEALTH_WARN")}, 1)
+                    for name, chk in sorted(checks.items())
+                ],
+            )
     if osdmap is not None:
         metric(
             "ceph_osd_up", "OSD up state", "gauge",
@@ -156,10 +187,21 @@ class PrometheusModule(MgrModule):
                     self.send_error(404)
                     return
                 try:
+                    # cluster health piggybacks the scrape (a mon round
+                    # trip); an unreachable/electing mon drops the
+                    # health series, never the whole exposition
+                    try:
+                        rv, health = module.mon_command(
+                            {"prefix": "health"})
+                        if rv != 0 or not isinstance(health, dict):
+                            health = None
+                    except Exception:
+                        health = None
                     body = render_metrics(
                         module.get("osd_map"),
                         module.get_all_perf_counters(),
                         schema=module.get_perf_schema(),
+                        health=health,
                     ).encode()
                 except Exception as e:  # scrape must not kill the server
                     self.send_error(500, str(e))

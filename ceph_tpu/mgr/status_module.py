@@ -153,11 +153,22 @@ class StatusModule(MgrModule):
             pg_info.update(st.get("pg_info") or {})
         slow = {d: int(st.get("slow_ops", 0))
                 for d, st in stats.items() if st.get("slow_ops")}
+        # accelerator health (common/kernel_telemetry.py): forward only
+        # daemons with something to report — a degraded sentinel or an
+        # active kernel-fallback latch — so the digest stays small and
+        # the mon's checks key directly off presence
+        backend: dict[str, dict] = {}
+        for d, st in stats.items():
+            bh = st.get("backend_health") or {}
+            sent = bh.get("sentinel") or {}
+            if sent.get("state") == "degraded" or bh.get("fallback"):
+                backend[d] = bh
         return {
             "df": assemble_df(m, stats),
             "osd_df": assemble_osd_df(m, stats),
             "pg_info": pg_info,
             "slow_ops": slow,
+            "backend_health": backend,
         }
 
     def serve(self) -> None:

@@ -484,7 +484,9 @@ class Monitor(Dispatcher):
                     if self.leader_rank is not None else None
                 ),
             }
-        elif prefix in ("status", "health"):
+        elif prefix in ("status", "health", "health detail"):
+            # `health detail` is the same payload — checks carry their
+            # `detail` lines always; the CLI decides how much to render
             retval, result = 0, self._status()
         elif self.osdmon.osdmap is None:
             # elected but the initial map hasn't committed yet
@@ -673,6 +675,51 @@ class Monitor(Dispatcher):
                     "message": f"{n} slow ops on "
                                f"{', '.join(sorted(slow))}",
                     "daemons": sorted(slow),
+                }
+            backend = digest.get("backend_health") or {}
+            deg = sorted(
+                d for d, bh in backend.items()
+                if (bh.get("sentinel") or {}).get("state") == "degraded"
+            )
+            if deg:
+                # the accelerator analog of DEVICE_HEALTH: the backend
+                # sentinel latched `degraded` on these daemons — kernels
+                # are being served by the fallback path, perf numbers
+                # reflect the fallback silicon (docs/observability.md)
+                checks["TPU_BACKEND_DEGRADED"] = {
+                    "severity": "HEALTH_WARN",
+                    "message": f"TPU backend degraded on "
+                               f"{len(deg)} daemon(s): "
+                               f"{', '.join(deg)}",
+                    "daemons": deg,
+                    "detail": [
+                        f"{d}: "
+                        f"{(backend[d].get('sentinel') or {}).get('reason')}"
+                        f" (since "
+                        f"{(backend[d].get('sentinel') or {}).get('since')})"
+                        for d in deg
+                    ],
+                }
+            latched = sorted(d for d, bh in backend.items()
+                             if bh.get("fallback"))
+            if latched:
+                # a codec latched its XLA fallback (one-shot Pallas
+                # failure): traffic is served, numbers lie about the
+                # silicon — alert until cleared (clear_kernel_fallback)
+                details = []
+                for d in latched:
+                    for kern, rec in sorted(
+                            (backend[d].get("fallback") or {}).items()):
+                        details.append(
+                            f"{d}: {kern} {rec.get('from')} -> "
+                            f"{rec.get('to')} ({rec.get('reason')})")
+                checks["KERNEL_FALLBACK_LATCHED"] = {
+                    "severity": "HEALTH_WARN",
+                    "message": f"kernel fallback latched on "
+                               f"{len(latched)} daemon(s): "
+                               f"{', '.join(latched)}",
+                    "daemons": latched,
+                    "detail": details,
                 }
             st = (digest.get("df") or {}).get("stats") or {}
             usage = {

@@ -25,12 +25,15 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common.kernel_telemetry import SENTINEL, TELEMETRY
+from ..common.tracer import tracepoint
 from ..gf.matrix import decode_matrix_for, matrix_to_bitmatrix, systematic_generator
 
 _BIT_IDX = np.arange(8, dtype=np.uint8)
@@ -116,7 +119,66 @@ def _want_pallas() -> bool:
         raise ValueError(
             f"CEPH_TPU_EC_KERNEL={mode!r}: want auto|pallas|xla"
         )
-    return _pallas_broken is None and jax.default_backend() in ("tpu", "axon")
+    # the sentinel's latched `degraded` state downgrades auto dispatch:
+    # a wedged backend must not be fed fresh Pallas launches (forced
+    # modes above still win — the operator said so)
+    return (_pallas_broken is None and not SENTINEL.is_degraded
+            and jax.default_backend() in ("tpu", "axon"))
+
+
+def current_backend() -> str:
+    """The GF kernel auto dispatch would pick right now ('pallas'/'xla')
+    — telemetry provenance for call sites above this seam."""
+    return "pallas" if _want_pallas() else "xla"
+
+
+def _latch_xla_fallback(e: Exception) -> None:
+    """Latch the process-wide XLA fallback LOUDLY: stderr (the historic
+    channel), a cephtrace tracepoint, and a telemetry fallback-latch
+    event that the mon surfaces as KERNEL_FALLBACK_LATCHED."""
+    global _pallas_broken
+    _pallas_broken = e
+    reason = f"{type(e).__name__}: {e}"
+    print(
+        f"# ceph_tpu: Pallas GF kernel failed ({reason}); "
+        f"latching XLA fallback",
+        file=sys.stderr,
+    )
+    TELEMETRY.record_fallback("gf_apply", reason, frm="pallas", to="xla")
+    tracepoint("ops", "kernel_fallback_latched", kernel="gf_apply",
+               reason=reason)
+
+
+def clear_fallback_latch() -> bool:
+    """Un-latch the XLA fallback without a daemon restart (the
+    `clear_kernel_fallback` admin command): the next auto-mode dispatch
+    retries Pallas.  Returns True if a latch was actually cleared."""
+    global _pallas_broken
+    was = _pallas_broken is not None
+    _pallas_broken = None
+    TELEMETRY.clear_fallback("gf_apply")
+    if was:
+        tracepoint("ops", "kernel_fallback_cleared", kernel="gf_apply")
+    return was
+
+
+def _apply_matrix_dispatch(mat: np.ndarray, chunks) -> tuple:
+    """(result, backend) — the dispatch body of apply_matrix_jax, split
+    out so the telemetry wrapper can attribute the call to the backend
+    that actually served it (a latching fallback serves on 'xla')."""
+    if _want_pallas():
+        from .pallas_gf import apply_matrix_pallas
+
+        forced = _forced_pallas()
+        try:
+            return apply_matrix_pallas(
+                mat, chunks, interpret=jax.default_backend() == "cpu"
+            ), "pallas"
+        except Exception as e:
+            if forced:
+                raise
+            _latch_xla_fallback(e)
+    return apply_matrix_xla(mat, chunks), "xla"
 
 
 def apply_matrix_jax(mat: np.ndarray, chunks) -> jnp.ndarray:
@@ -127,27 +189,29 @@ def apply_matrix_jax(mat: np.ndarray, chunks) -> jnp.ndarray:
     encode/decode/repair) goes through, so `plugin=jax` via the registry
     runs the same kernel the headline bench measures.  In auto mode a
     Pallas failure latches a process-wide XLA fallback (resilience for
-    the OSD data path); a forced CEPH_TPU_EC_KERNEL=pallas fails loudly.
-    """
-    global _pallas_broken
-    if _want_pallas():
-        from .pallas_gf import apply_matrix_pallas
+    the OSD data path) with a counted telemetry event; a forced
+    CEPH_TPU_EC_KERNEL=pallas fails loudly.
 
-        forced = _forced_pallas()
-        try:
-            return apply_matrix_pallas(
-                mat, chunks, interpret=jax.default_backend() == "cpu"
-            )
-        except Exception as e:
-            if forced:
-                raise
-            _pallas_broken = e
-            print(
-                f"# ceph_tpu: Pallas GF kernel failed "
-                f"({type(e).__name__}: {e}); latching XLA fallback",
-                file=sys.stderr,
-            )
-    return apply_matrix_xla(mat, chunks)
+    Telemetry (docs/observability.md): one `gf_apply` record per call —
+    backend, wall time (dispatch-side; JAX queues the launch, so only
+    sync call sites above this seam report achieved GiB/s), bytes
+    in/out, compile-vs-execute split by first-seen shape.  Disabled:
+    one attribute check.
+    """
+    tm = TELEMETRY
+    if not tm.enabled:
+        return _apply_matrix_dispatch(mat, chunks)[0]
+    t0 = time.perf_counter()
+    out, backend = _apply_matrix_dispatch(mat, chunks)
+    dt = time.perf_counter() - t0
+    shape = getattr(chunks, "shape", None)
+    tm.record(
+        "gf_apply", backend, dt,
+        bytes_in=int(getattr(chunks, "nbytes", 0)),
+        bytes_out=mat.shape[0] * shape[-1] if shape else 0,
+        compiled=tm.first_call(("gf_apply", mat.shape, shape, backend)),
+    )
+    return out
 
 
 @lru_cache(maxsize=256)
@@ -172,7 +236,19 @@ def apply_xor_matrix_jax(B: np.ndarray, rows) -> jnp.ndarray:
     Bd = xor_bitmatrix_device(
         np.ascontiguousarray(B, dtype=np.uint8).tobytes(), B.shape
     )
-    return _apply_bitmatrix(Bd, jnp.asarray(rows, dtype=jnp.uint8))
+    tm = TELEMETRY
+    if not tm.enabled:
+        return _apply_bitmatrix(Bd, jnp.asarray(rows, dtype=jnp.uint8))
+    t0 = time.perf_counter()
+    out = _apply_bitmatrix(Bd, jnp.asarray(rows, dtype=jnp.uint8))
+    shape = getattr(rows, "shape", None)
+    tm.record(
+        "gf_xor", "xla", time.perf_counter() - t0,
+        bytes_in=int(getattr(rows, "nbytes", 0)),
+        bytes_out=B.shape[0] * shape[-1] if shape else 0,
+        compiled=tm.first_call(("gf_xor", B.shape, shape)),
+    )
+    return out
 
 
 @lru_cache(maxsize=256)

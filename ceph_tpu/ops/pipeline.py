@@ -16,7 +16,11 @@ input batches of host memory at any moment, never the whole stream.
 """
 from __future__ import annotations
 
+import time
+
 import numpy as np
+
+from ..common.kernel_telemetry import TELEMETRY
 
 
 def _apply_fn(mat: np.ndarray, kernel: str):
@@ -43,9 +47,16 @@ def stream_encode(mat: np.ndarray, batches, kernel: str = "xla"):
 
     `batches` may be any iterable, including a one-shot generator; it is
     pulled lazily, one batch ahead of the compute, so the stream's
-    host-memory high-water mark is two batches regardless of length."""
+    host-memory high-water mark is two batches regardless of length.
+
+    Telemetry: one `stream_encode` record per stream — the np.asarray
+    fetches make this a true sync point, so the record carries an honest
+    achieved GiB/s for the whole double-buffered pipeline."""
     import jax
 
+    tm = TELEMETRY
+    t_start = time.perf_counter() if tm.enabled else 0.0
+    bytes_in = bytes_out = 0
     mat = np.ascontiguousarray(mat, dtype=np.uint8)
     apply_fn = _apply_fn(mat, kernel)
     it = iter(batches)
@@ -57,6 +68,8 @@ def stream_encode(mat: np.ndarray, batches, kernel: str = "xla"):
     nxt = jax.device_put(np.ascontiguousarray(first, dtype=np.uint8))
     while nxt is not None:
         cur = nxt
+        if tm.enabled:
+            bytes_in += int(cur.nbytes)
         # launch compute first (async), THEN start the next DMA so the
         # copy engine and the cores overlap
         res = apply_fn(cur)
@@ -70,4 +83,12 @@ def stream_encode(mat: np.ndarray, batches, kernel: str = "xla"):
             outs.append(np.asarray(pending))
         pending = res
     outs.append(np.asarray(pending))
+    if tm.enabled:
+        from .bitplane import current_backend
+
+        bytes_out = sum(int(o.nbytes) for o in outs)
+        backend = kernel if kernel == "pallas" else current_backend()
+        tm.record("stream_encode", backend,
+                  time.perf_counter() - t_start,
+                  bytes_in=bytes_in, bytes_out=bytes_out, synced=True)
     return outs

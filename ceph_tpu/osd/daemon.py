@@ -41,6 +41,7 @@ import threading
 import time
 
 from ..common.failpoint import failpoint, registry as fp_registry
+from ..common.kernel_telemetry import SENTINEL, TELEMETRY, SentinelPolicy
 from ..common.lockdep import make_lock
 from ..common.perf_counters import PerfCountersBuilder
 from ..common.tracer import TRACER, op_trace, trace_now
@@ -196,6 +197,7 @@ class OSD(
         self._worker_busy_lock = make_lock("osd::op_watchdog")
         self._recovery_inflight = False
         self._split_inflight = False
+        self._sentinel_held = False  # flipped under self._lock
         self._clone_mutex = make_lock("osd::snap_clone")
         # watch/notify state (reference: PrimaryLogPG watchers): primary-
         # local; clients re-register lingering watches on map change
@@ -251,6 +253,12 @@ class OSD(
             .add_u64("numpg", "placement groups hosted")
             .create_perf_counters()
         )
+        # the process-wide kernel telemetry registry rides this daemon's
+        # perf pipeline (perf dump -> MMgrReport -> prometheus): kernels
+        # are per-process, so every OSD in a LocalCluster reports the
+        # same shared "kernel" subsystem (docs/observability.md)
+        if cct.perf.get(TELEMETRY.perf.name) is None:
+            cct.perf.add(TELEMETRY.perf)
         # coalescing encode layer in front of the GF codec (the batched
         # write path; osd/write_batcher.py, docs/write_path.md)
         self.write_batcher = WriteBatcher(cct, logger=self.logger,
@@ -309,6 +317,18 @@ class OSD(
                 )
         self._load_pgs()
         self.write_batcher.start()
+        # backend health sentinel (common/kernel_telemetry.py): policy
+        # built from THIS daemon's conf and constructor-injected — the
+        # sentinel itself is process-wide (kernel dispatch is), refs
+        # counted across the local daemons; interval <= 0 disables
+        si = float(self.cct.conf.get("backend_sentinel_interval"))
+        if si > 0:
+            SENTINEL.acquire(SentinelPolicy(
+                interval=si,
+                timeout=float(self.cct.conf.get("backend_sentinel_timeout")),
+            ))
+            with self._lock:
+                self._sentinel_held = True
         self._tick_thread = threading.Thread(
             target=self._tick_loop, name=f"{self.whoami}-tick", daemon=True
         )
@@ -376,6 +396,13 @@ class OSD(
         from the same directory exercises real WAL replay + fsck."""
         self._stop.set()
         self.scheduler.stop()
+        # test-and-set under the daemon lock (double-shutdown must not
+        # double-release the refcounted sentinel)
+        with self._lock:
+            release_sentinel = self._sentinel_held
+            self._sentinel_held = False
+        if release_sentinel:
+            SENTINEL.release()
         # drain-and-stop the coalescer first: queued stripes flush (their
         # ops complete or fail normally) before the messenger goes away
         self.write_batcher.stop()

@@ -198,6 +198,7 @@ class SplitMigrationMixin:
         addr = self.cct.conf.get("mgr_addr")
         if not addr:
             return
+        from ..common.kernel_telemetry import backend_health
         from ..mgr.messages import MMgrReport
 
         host, _, port = addr.rpartition(":")
@@ -294,6 +295,9 @@ class SplitMigrationMixin:
                            },
                            "statfs": self.store.statfs(),
                            "slow_ops": len(self.op_tracker.slow_ops()),
+                           # accelerator health rides the same stream
+                           # SLOW_OPS does: mgr digest -> mon _health
+                           "backend_health": backend_health(),
                            "pg_info": pg_info},
                 )
             )

@@ -59,6 +59,7 @@ import time
 import numpy as np
 
 from ..common.failpoint import FailpointCrash, failpoint
+from ..common.kernel_telemetry import TELEMETRY
 from ..common.lockdep import make_lock
 from ..common.throttle import Throttle
 from ..common.tracer import TRACER, kernel_annotation, op_trace, trace_now
@@ -411,6 +412,17 @@ class WriteBatcher:
                 self._logger.tinc("ec_batch_flush_latency",
                                   time.perf_counter() - t0)
                 self._logger.hinc("stage_encode", w1 - w0)
+            if TELEMETRY.enabled:
+                # the flush fetched every parity slice (np arrays), so
+                # this is a true sync point: honest achieved GiB/s for
+                # the fused pack -> encode -> scatter
+                from ..ops.bitplane import current_backend
+
+                TELEMETRY.record(
+                    "ec_batch_flush", current_backend(),
+                    time.perf_counter() - t0, bytes_in=nbytes,
+                    bytes_out=sum(int(r[1].nbytes) for r in results),
+                    synced=True)
 
     def _encode_groups(
         self, batch: list[_PendingStripe]

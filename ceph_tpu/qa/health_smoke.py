@@ -1,0 +1,145 @@
+"""Backend-health CI smoke: forced wedge -> health checks raise ->
+recovery -> checks clear (qa/ci_gate.sh step 5; ISSUE 10 acceptance).
+
+Drives the WHOLE surface through the production path, no shortcuts:
+
+1. arm the simulated wedge through the sentinel's env probe override
+   (``CEPH_TPU_SENTINEL_STATE=degraded:...`` — the probe never touches
+   jax) and latch a codec fallback through the telemetry registry;
+2. start a LocalCluster (mgr hosted) with a fast sentinel cadence and
+   wait for ``health detail`` to report **TPU_BACKEND_DEGRADED** and
+   **KERNEL_FALLBACK_LATCHED** — i.e. OSD probe -> `_mgr_report` ->
+   status-module digest -> mon `_health`, end to end;
+3. scrape the mgr prometheus exporter and assert ``ceph_health_status``
+   is 1 (WARN) with a ``ceph_health_detail`` series per check;
+4. smoke-check the ``dump_kernel_telemetry`` admin-command JSON schema
+   over a real admin socket;
+5. flip the probe override to ``ok`` + clear the fallback latch via the
+   ``clear_kernel_fallback`` admin command, and wait for BOTH checks to
+   clear.
+
+Exit 0 on success; 1 with a `problems` list otherwise.  Prints one JSON
+summary on stdout (the gate archives it next to the SARIF artifacts).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _wait(pred, timeout: float, step: float = 0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+def main() -> int:
+    import jax
+
+    # this box's sitecustomize pins the tunneled TPU backend and IGNORES
+    # the JAX_PLATFORMS env var; config.update is the reliable spelling
+    # (tests/conftest.py) — the smoke must never touch the tunnel
+    jax.config.update("jax_platforms", "cpu")
+
+    os.environ["CEPH_TPU_SENTINEL_STATE"] = "degraded:ci simulated wedge"
+
+    from ..common.admin_socket import admin_socket_command
+    from ..common.kernel_telemetry import TELEMETRY
+    from ..qa.vstart import LocalCluster
+
+    problems: list[str] = []
+    summary: dict = {}
+    TELEMETRY.record_fallback(
+        "gf_apply", "ci simulated mosaic failure", frm="pallas", to="xla")
+
+    import tempfile
+
+    asok_dir = tempfile.mkdtemp(prefix="ceph_tpu_health_")
+    overrides = {
+        "backend_sentinel_interval": 0.2,
+        "backend_sentinel_timeout": 0.5,
+        "mgr_report_interval": 0.2,
+        "mgr_digest_interval": 0.2,
+        "mgr_stale_report_age": 30.0,
+        "admin_socket": os.path.join(asok_dir, "$name.asok"),
+    }
+
+    def checks() -> dict:
+        rv, res = c.mon_command({"prefix": "health detail"})
+        if rv != 0 or not isinstance(res, dict):
+            return {}
+        return (res.get("health") or {}).get("checks") or {}
+
+    with LocalCluster(n_mons=1, n_osds=2, with_mgr=True,
+                      conf_overrides=overrides) as c:
+        # -- raise ----------------------------------------------------
+        if not _wait(lambda: {"TPU_BACKEND_DEGRADED",
+                              "KERNEL_FALLBACK_LATCHED"} <= set(checks()),
+                     timeout=20.0):
+            problems.append(
+                f"wedged checks did not raise; got {sorted(checks())}")
+        summary["raised_checks"] = sorted(checks())
+
+        # -- prometheus while degraded --------------------------------
+        try:
+            import urllib.request
+
+            url = c.mgr.module("prometheus").url
+            body = urllib.request.urlopen(url, timeout=10).read().decode()
+            if "ceph_health_status 1" not in body:
+                problems.append("prometheus: ceph_health_status != 1 "
+                                "while degraded")
+            for name in ("TPU_BACKEND_DEGRADED", "KERNEL_FALLBACK_LATCHED"):
+                if f'ceph_health_detail{{name="{name}"' not in body:
+                    problems.append(f"prometheus: no ceph_health_detail "
+                                    f"series for {name}")
+        except Exception as e:
+            problems.append(f"prometheus scrape failed: {e!r}")
+
+        # -- dump_kernel_telemetry schema over the admin socket -------
+        asok = os.path.join(asok_dir, "osd.0.asok")
+        try:
+            dump = admin_socket_command(asok, "dump_kernel_telemetry")
+            for key in ("enabled", "kernels", "fallback", "sentinel",
+                        "events"):
+                if key not in dump:
+                    problems.append(
+                        f"dump_kernel_telemetry missing {key!r}")
+            if (dump.get("sentinel") or {}).get("state") != "degraded":
+                problems.append("dump_kernel_telemetry sentinel state "
+                                f"!= degraded: {dump.get('sentinel')}")
+            if "gf_apply" not in (dump.get("fallback") or {}):
+                problems.append("dump_kernel_telemetry fallback latch "
+                                "missing gf_apply")
+            summary["telemetry_kernels"] = sorted(dump.get("kernels") or {})
+        except Exception as e:
+            problems.append(f"dump_kernel_telemetry failed: {e!r}")
+
+        # -- recover --------------------------------------------------
+        os.environ["CEPH_TPU_SENTINEL_STATE"] = "ok"
+        try:
+            res = admin_socket_command(asok, "clear_kernel_fallback")
+            if not res.get("cleared"):
+                problems.append(f"clear_kernel_fallback: {res}")
+        except Exception as e:
+            problems.append(f"clear_kernel_fallback failed: {e!r}")
+        if not _wait(lambda: not ({"TPU_BACKEND_DEGRADED",
+                                   "KERNEL_FALLBACK_LATCHED"}
+                                  & set(checks())), timeout=20.0):
+            problems.append(
+                f"checks did not clear after recovery; "
+                f"still {sorted(checks())}")
+        summary["cleared_checks"] = sorted(checks())
+
+    summary["problems"] = problems
+    print(json.dumps(summary, indent=2, default=str))
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,11 +18,16 @@ from ..mon.mon_client import MonClient
 from .rados import _parse_mons
 
 
-def _render_status(res: dict, out) -> None:
+def _render_status(res: dict, out, detail: bool = False) -> None:
     health = res.get("health", {})
     print(f"  health: {health.get('status')}", file=out)
     for name, chk in (health.get("checks") or {}).items():
         print(f"          {name}: {chk.get('message')}", file=out)
+        if detail:
+            # `health detail`: the per-check detail lines (reference:
+            # the ceph CLI's health detail rendering)
+            for line in chk.get("detail") or []:
+                print(f"              {line}", file=out)
     print(f"  quorum: {res.get('quorum')}  leader: {res.get('leader')}",
           file=out)
     osd = res.get("osdmap", {})
@@ -67,7 +72,8 @@ def _render_tree(rows: list, out) -> None:
 def _build_command(words: list[str]) -> dict:
     joined = " ".join(words)
     for fixed in (
-        "status", "health", "mon stat", "osd dump", "osd stat",
+        "status", "health", "health detail", "mon stat", "osd dump",
+        "osd stat",
         "osd tree", "osd pool ls", "osd erasure-code-profile ls",
         "df", "osd df", "pg dump",
     ):
@@ -386,8 +392,8 @@ def main(argv=None, out=sys.stdout) -> int:
         return 1
     if args.format == "json":
         print(json.dumps(res, indent=2, default=str), file=out)
-    elif cmd["prefix"] in ("status", "health"):
-        _render_status(res, out)
+    elif cmd["prefix"] in ("status", "health", "health detail"):
+        _render_status(res, out, detail=cmd["prefix"] == "health detail")
     elif cmd["prefix"] == "osd tree":
         _render_tree(res, out)
     elif cmd["prefix"] == "df":

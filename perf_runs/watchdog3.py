@@ -1,6 +1,11 @@
 #!/usr/bin/env python
 """Round-5 tunnel watchdog (VERDICT r4, next-round item #1).
 
+SUPERSEDED: this job chain is folded into the bench proper —
+`python bench.py --watchdog [--deadline YYYY-mm-ddTHH:MM]` runs the
+same probe loop / jobs dir / done-marker contract (PR 10).  Kept for
+the round-5 log provenance.
+
 Probes the tunneled TPU backend every 5 min; on the first UP it runs the
 pending capture jobs from perf_runs/jobs/*.json in filename order.  Each
 job file is {"marker": str, "timeout": int, "argv": [...], "env": {...}}.

@@ -19,6 +19,14 @@
 #    commit, or when tracing-enabled overhead exceeds 10% of the
 #    untraced smoke.  Artifacts: traffic_trace.json (bench JSON) and
 #    trace_perfetto.json (open in ui.perfetto.dev).
+# 5. backend health smoke (ceph_tpu/qa/health_smoke.py): simulated
+#    wedge must raise TPU_BACKEND_DEGRADED + KERNEL_FALLBACK_LATCHED in
+#    `health detail` and on the prometheus exporter, dump_kernel_
+#    telemetry must answer with its full schema, and recovery must
+#    clear both checks.
+# 6. wedged bench (CEPH_TPU_BENCH_FORCE_WEDGED): bench.py must exit
+#    rc=3 carrying last_known_silicon + sentinel state + per-phase
+#    stale captures instead of a null headline.
 #
 # Analyzers emit SARIF 2.1.0 into qa/_sarif/ (github code-scanning uploads
 # resolve URIs against the repo root, which is where this script runs
@@ -121,5 +129,52 @@ else
     rc=1
 fi
 
-echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json)"
+echo "== backend health smoke (simulated wedge -> raise -> clear) =="
+# forces a wedge through the sentinel's env probe override + a latched
+# codec fallback, asserts TPU_BACKEND_DEGRADED / KERNEL_FALLBACK_LATCHED
+# raise in `health detail` and on the prometheus exporter, smoke-checks
+# the dump_kernel_telemetry JSON schema, then recovers and asserts the
+# checks clear (ceph_tpu/qa/health_smoke.py; docs/observability.md)
+python -m ceph_tpu.qa.health_smoke > "$OUT_DIR/health_smoke.json"
+health_rc=$?
+if [ $health_rc -eq 0 ]; then
+    echo "health smoke: ok"
+elif python -c "import json; json.load(open('$OUT_DIR/health_smoke.json'))" \
+        2>/dev/null; then
+    echo "health smoke: FAILED:"
+    python -c "import json; [print(' -', p) for p in json.load(open('$OUT_DIR/health_smoke.json'))['problems']]" || true
+    rc=1
+else
+    rm -f "$OUT_DIR/health_smoke.json"
+    echo "health smoke: ERROR (exit $health_rc) — scenario crashed"
+    rc=1
+fi
+
+echo "== wedged bench degradation (rc discrimination) =="
+# a forced-wedge bench must exit rc=3 carrying last_known_silicon (+
+# sentinel state + per-phase stale captures) — never a null headline
+CEPH_TPU_BENCH_FORCE_WEDGED=1 CEPH_TPU_BENCH_SKIP_CPU=1 \
+    python bench.py > "$OUT_DIR/bench_wedged.json" 2>/dev/null
+bench_rc=$?
+if [ $bench_rc -ne 3 ]; then
+    echo "wedged bench: FAILED — rc=$bench_rc, want 3 (degraded-with-stale-data)"
+    rc=1
+elif python - "$OUT_DIR/bench_wedged.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+extra = doc.get("extra") or {}
+assert doc.get("value") is not None, "null headline on wedge"
+assert extra.get("value_is_last_known_silicon") is True, "stale flag missing"
+assert (extra.get("sentinel") or {}).get("state") == "degraded", "no sentinel state"
+assert extra.get("last_known_silicon_phases"), "no per-phase stale captures"
+EOF
+then
+    echo "wedged bench: ok (rc=3, last_known_silicon carried)"
+else
+    echo "wedged bench: FAILED — degradation contract violated:"
+    cat "$OUT_DIR/bench_wedged.json"
+    rc=1
+fi
+
+echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json)"
 exit $rc
