@@ -67,11 +67,11 @@ def stage_breakdown(spans: list[dict],
     out = {}
     for name, durs in by_name.items():
         durs.sort()
-        n = len(durs)
+        p50, p99 = _pctiles(durs)
         out[name] = {
-            "p50_ms": round(durs[n // 2], 3),
-            "p99_ms": round(durs[min(n - 1, int(n * 0.99))], 3),
-            "n": n,
+            "p50_ms": round(p50, 3),
+            "p99_ms": round(p99, 3),
+            "n": len(durs),
         }
     return out
 
@@ -80,6 +80,38 @@ def _chunk_len(write_size: int, k: int, align: int = 64) -> int:
     """ErasureCode.get_chunk_size's shape: ceil(size/k), 64-aligned."""
     padded = -(-write_size // k)
     return -(-padded // align) * align
+
+
+def _pctiles(sorted_vals: list[float]) -> tuple[float | None, float | None]:
+    """(p50, p99) of an already-sorted latency list (the one percentile
+    idiom every traffic stat shares), None/None when empty."""
+    n = len(sorted_vals)
+    if not n:
+        return None, None
+    return sorted_vals[n // 2], sorted_vals[min(n - 1, int(n * 0.99))]
+
+
+def per_client_stats(lats: list[list[float]]) -> tuple[dict, float | None]:
+    """({client: {ops, p50_ms, p99_ms}}, max/min fairness ratio) over
+    per-client latency lists — the regression surface the future QoS
+    controller is gated on (cephmeter): a controller that starves one
+    writer shows up as fairness_ratio >> 1 before it shows up anywhere
+    else.  A FULLY starved client still appears (ops=0) and forces
+    fairness_ratio to None — total starvation must fail a
+    `fairness_ratio <= X` gate, never pass it by omission."""
+    rows: dict[str, dict] = {}
+    for i, lat in enumerate(lats):
+        ls = sorted(lat)
+        p50, p99 = _pctiles(ls)
+        rows[str(i)] = {
+            "ops": len(ls),
+            "p50_ms": round(p50 * 1e3, 3) if p50 is not None else None,
+            "p99_ms": round(p99 * 1e3, 3) if p99 is not None else None,
+        }
+    ops = [r["ops"] for r in rows.values()]
+    fairness = (round(max(ops) / min(ops), 3)
+                if ops and min(ops) > 0 else None)
+    return rows, fairness
 
 
 def run_traffic(
@@ -191,6 +223,7 @@ def run_traffic(
 
     all_lats = sorted(x for lat in lats for x in lat)
     n_ops = len(all_lats)
+    p50, p99 = _pctiles(all_lats)
     stats = batcher.stats()
     out = {
         "mode": mode,
@@ -199,13 +232,13 @@ def run_traffic(
         "seconds": round(elapsed, 3),
         "ops": n_ops,
         "gibps": round(n_ops * write_size / max(elapsed, 1e-9) / 2**30, 4),
-        "p50_ms": round(all_lats[n_ops // 2] * 1e3, 3) if n_ops else None,
-        "p99_ms": round(all_lats[min(n_ops - 1, int(n_ops * 0.99))] * 1e3, 3)
-        if n_ops else None,
+        "p50_ms": round(p50 * 1e3, 3) if p50 is not None else None,
+        "p99_ms": round(p99 * 1e3, 3) if p99 is not None else None,
         "flushes": stats["flushes"],
         "stripes_per_flush": round(stats["stripes"] / stats["flushes"], 2)
         if stats["flushes"] else None,
     }
+    out["per_client"], out["fairness_ratio"] = per_client_stats(lats)
     if sampling > 0.0:
         spans = TRACER.spans()
         LAST_SPANS[:] = spans
@@ -229,6 +262,7 @@ def run_cluster_traffic(
     m: int = 1,
     n_osds: int | None = None,
     sampling: float = 0.0,
+    conf_overrides: dict | None = None,
 ) -> dict:
     """Closed-loop writers against a REAL LocalCluster EC pool — the
     full client -> OSD -> replicas -> ack path, so traced runs produce
@@ -243,7 +277,10 @@ def run_cluster_traffic(
     TRACER.enable(False)
     TRACER.clear()
     overrides = {"trace_enabled": sampling > 0.0,
-                 "trace_sampling_rate": sampling if sampling > 0.0 else 1.0}
+                 "trace_sampling_rate": sampling if sampling > 0.0 else 1.0,
+                 # extra knobs (e.g. osd_client_io_accounting on/off for
+                 # the PERF.md overhead comparison) ride on top
+                 **(conf_overrides or {})}
     lats: list[list[float]] = [[] for _ in range(n_clients)]
     payloads = [bytes([i % 251] * write_size) for i in range(16)]
     stop_at = [0.0]
@@ -320,6 +357,7 @@ def run_cluster_traffic(
     LAST_SPANS[:] = spans
     all_lats = sorted(x for lat in lats for x in lat)
     n_ops = len(all_lats)
+    p50, p99 = _pctiles(all_lats)
     out = {
         "mode": "cluster",
         "clients": n_clients,
@@ -329,11 +367,11 @@ def run_cluster_traffic(
         "ops": n_ops,
         "ops_per_s": round(n_ops / max(elapsed, 1e-9), 1),
         "gibps": round(n_ops * write_size / max(elapsed, 1e-9) / 2**30, 5),
-        "p50_ms": round(all_lats[n_ops // 2] * 1e3, 3) if n_ops else None,
-        "p99_ms": round(all_lats[min(n_ops - 1, int(n_ops * 0.99))] * 1e3, 3)
-        if n_ops else None,
+        "p50_ms": round(p50 * 1e3, 3) if p50 is not None else None,
+        "p99_ms": round(p99 * 1e3, 3) if p99 is not None else None,
         "sampling": sampling,
     }
+    out["per_client"], out["fairness_ratio"] = per_client_stats(lats)
     if sampling > 0.0:
         out["traces"] = len({s["trace_id"] for s in spans})
         out["connected_traces"] = len(connected_traces(spans))
@@ -415,6 +453,8 @@ def run_scenario(
         "traffic_stripes_per_flush": batched["stripes_per_flush"],
         "traffic_batched_ops": batched["ops"],
         "traffic_perop_ops": perop["ops"],
+        "traffic_batched_fairness_ratio": batched["fairness_ratio"],
+        "traffic_perop_fairness_ratio": perop["fairness_ratio"],
     }
 
 

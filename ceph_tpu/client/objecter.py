@@ -16,7 +16,7 @@ from time import monotonic as _monotonic
 
 from ..common.lockdep import make_lock
 from ..common.throttle import Throttle
-from ..common.tracer import TRACER, sampled_ctx
+from ..common.tracer import TRACER, sampled_ctx, trace_now
 from ..msg import Dispatcher, Messenger
 from ..msg.messenger import POLICY_LOSSY
 from ..osd.osdmap import object_ps
@@ -265,16 +265,27 @@ class Objecter(Dispatcher):
         # cephtrace birth: ONE head-based coin flip per logical op (the
         # trace context then rides every resend attempt unchanged);
         # tracing disabled = this single attribute check inside
-        # sampled_ctx, nothing else on the path
+        # sampled_ctx, nothing else on the path.  trace_tail_latency_ms
+        # arms tail sampling: a losing flip still mints a PROVISIONAL
+        # context whose spans buffer until this op's completion latency
+        # renders the promote/discard verdict (cephmeter).
         root_span = None
+        tail_ms = 0.0
+        provisional = False
+        t_e2e0 = 0.0
         if TRACER.enabled:
             rate = float(conf.get("trace_sampling_rate")) if conf else 1.0
-            tctx = sampled_ctx(rate)
+            tail_ms = (float(conf.get("trace_tail_latency_ms"))
+                       if conf else 0.0)
+            tctx = sampled_ctx(rate, tail=tail_ms > 0.0)
+            provisional = TRACER.is_provisional(
+                tctx.trace_id if tctx is not None else None)
             root_span = TRACER.begin(
                 tctx, "op_submit",
                 entity=self.cct.name if self.cct else "client",
                 op=op, pool=pool_id, oid=oid, nbytes=my_bytes,
             )
+            t_e2e0 = trace_now()
         max_ops = int(conf.get("objecter_inflight_ops")) if conf else 0
         max_bytes = int(conf.get("objecter_inflight_op_bytes")) if conf else 0
         if max_ops != self._op_throttle.max:
@@ -287,8 +298,12 @@ class Objecter(Dispatcher):
         deadline = _monotonic() + timeout
         if not self._op_throttle.get(1, timeout=timeout):
             # throttle-starved ops are exactly what tracing is for: end
-            # the root span with the error rather than dropping it
+            # the root span with the error rather than dropping it (and
+            # a provisional trace that starved at admission is a
+            # straggler by definition — promote it)
             TRACER.end(root_span, error="inflight-op throttle full")
+            if provisional and root_span is not None:
+                TRACER.promote(root_span.trace_id, reason="throttle")
             raise ConnectionError(
                 f"op {op} {oid!r}: inflight-op throttle full "
                 f"({self._op_throttle.current}/{max_ops} ops)")
@@ -296,6 +311,8 @@ class Objecter(Dispatcher):
         if not self._bytes_throttle.get(my_bytes, timeout=remain):
             self._op_throttle.put(1)
             TRACER.end(root_span, error="inflight-byte throttle full")
+            if provisional and root_span is not None:
+                TRACER.promote(root_span.trace_id, reason="throttle")
             raise ConnectionError(
                 f"op {op} {oid!r}: inflight-byte throttle full "
                 f"({self._bytes_throttle.current}/{max_bytes} bytes)")
@@ -308,6 +325,17 @@ class Objecter(Dispatcher):
             TRACER.end(root_span, error=repr(e))
             raise
         finally:
+            if provisional and root_span is not None:
+                # the client-side tail verdict: a provisional trace
+                # whose e2e crossed the threshold is kept; otherwise
+                # discard — unless a daemon (complaint-time promotion
+                # at the primary) already promoted it, which wins
+                e2e_ms = (trace_now() - t_e2e0) * 1e3
+                if e2e_ms >= tail_ms:
+                    TRACER.promote(root_span.trace_id,
+                                   reason="client_e2e")
+                else:
+                    TRACER.discard(root_span.trace_id)
             self._bytes_throttle.put(my_bytes)
             self._op_throttle.put(1)
 

@@ -55,6 +55,15 @@ def default_options() -> OptionTable:
                    "decision).  1.0 traces everything, 0.01 is the "
                    "production-viability setting benched in PERF.md",
                    min=0.0, max=1.0, runtime=True),
+            Option("trace_tail_latency_ms", float, 0.0,
+                   "tail sampling (cephmeter): ops that LOST the head "
+                   "coin flip still buffer their spans provisionally, "
+                   "and one whose completion latency crosses this many "
+                   "milliseconds (or the OSD's osd_op_complaint_time) "
+                   "is promoted into the trace buffer retroactively — "
+                   "a p99 straggler keeps its trace even at "
+                   "trace_sampling_rate=0 (docs/observability.md).  "
+                   "0 disables tail sampling", min=0.0, runtime=True),
             # -- messenger (reference: ms_* in global.yaml.in) -------------
             Option("ms_connect_timeout", float, 10.0,
                    "seconds to wait for a connect", min=0.0),
@@ -115,6 +124,25 @@ def default_options() -> OptionTable:
             Option("osd_op_complaint_time", float, 30.0,
                    "age at which an in-flight op is slow", min=0.0,
                    runtime=True),
+            Option("osd_slow_op_window", float, 60.0,
+                   "seconds a COMPLETED slow op stays in the sticky "
+                   "SLOW_OPS count (cephmeter: a straggler finishing "
+                   "between two mgr report polls must not vanish from "
+                   "the health check before the digest samples it)",
+                   min=0.0, runtime=True),
+            Option("osd_client_io_accounting", bool, True,
+                   "per-(client,pool) I/O accounting table on every OSD "
+                   "(cephmeter: ops/bytes/admission/queue/e2e latency "
+                   "histograms as labeled prometheus series — the "
+                   "future mClock QoS tags; common/io_accounting.py, "
+                   "docs/observability.md).  Disabled = no table, no "
+                   "stamping"),
+            Option("osd_client_io_top_k", int, 64,
+                   "bounded cardinality of the per-OSD accounting "
+                   "table: at most this many live (client,pool) "
+                   "entries; overflow evicts the least-recently-used "
+                   "non-heavy-hitter into the _other_ bucket (sums "
+                   "preserved)", min=1),
             Option("osd_subop_reply_timeout", float, 10.0,
                    "DEFAULT seconds a primary waits for one shard "
                    "sub-op reply before treating the shard as failed; "
@@ -173,7 +201,8 @@ def default_options() -> OptionTable:
             Option("mgr_tick_interval", float, 2.0, "mgr tick seconds",
                    min=0.05),
             Option("mgr_modules", str,
-                   "status,prometheus,balancer,iostat,quota",
+                   "status,prometheus,balancer,iostat,quota,"
+                   "metrics_history",
                    "comma-separated modules the mgr hosts"),
             Option("rgw_lc_interval", float, 5.0,
                    "seconds between lifecycle passes (upstream: daily)",
@@ -191,6 +220,16 @@ def default_options() -> OptionTable:
                    runtime=True),
             Option("mgr_stale_report_age", float, 30.0,
                    "drop daemon reports older than this", min=1.0),
+            Option("mgr_metrics_history_samples", int, 512,
+                   "samples kept per (daemon, counter) series in the "
+                   "mgr metrics-history ring (mgr/metrics_history.py — "
+                   "the substrate iostat and the future QoS controller "
+                   "query; one sample lands per MMgrReport)", min=2),
+            Option("mgr_metrics_history_max_series", int, 8192,
+                   "total (daemon, counter) series the metrics-history "
+                   "store tracks; series beyond the cap are dropped "
+                   "and counted (bounded memory under runaway "
+                   "cardinality)", min=1),
             Option("mgr_dashboard_port", int, 0,
                    "dashboard HTTP port (0 = ephemeral)"),
             Option("mgr_devicehealth_self_heal", bool, True,

@@ -23,6 +23,15 @@ Three layers, all gated on ONE attribute check when disabled:
   rebuilds the causal tree; ``perfetto_export`` emits Chrome-trace /
   Perfetto JSON that loads directly in ui.perfetto.dev.
 
+  **Tail sampling** (cephmeter, ``trace_tail_latency_ms``): an op that
+  LOSES the coin flip can still mint a *provisional* context
+  (``sampled_ctx(rate, tail=True)``) — its spans buffer aside until
+  the op completes, then ``promote``/``discard`` renders the verdict
+  (primary: complaint-time/threshold crossing; client: its own e2e;
+  promote wins).  A p99 straggler keeps its connected cross-entity
+  tree even at ``trace_sampling_rate = 0``
+  (docs/observability.md).
+
 - **Device profiling**: ``device_trace(logdir)`` wraps
   ``jax.profiler``'s trace context so TPU hot paths emit XPlanes, and
   ``kernel_annotation(name, trace_ids)`` wraps individual kernel
@@ -53,6 +62,15 @@ from contextlib import contextmanager, nullcontext
 
 _MAX_EVENTS = 10_000
 _MAX_SPANS = 20_000
+#: tail sampling: at most this many traces buffered provisionally
+#: (awaiting their op's completion verdict) at once
+_MAX_PROVISIONAL = 1024
+#: spans one provisional trace may buffer (a runaway op must not eat
+#: the process)
+_MAX_PROV_SPANS = 256
+#: promoted/discarded verdicts remembered (late spans of a decided
+#: trace route by these)
+_MAX_DECIDED = 8192
 
 #: the stage names above, in pipeline order (bench/tests iterate this)
 OP_STAGES = ("admission", "queue", "encode", "subop", "commit")
@@ -135,6 +153,13 @@ class Tracer:
         self.enabled = False
         self._events: list[tuple] = []
         self._spans: list[Span] = []
+        # tail sampling (cephmeter): traces whose head coin flip said NO
+        # buffer here until their op completes; promotion moves them
+        # into _spans retroactively, a discard drops them.  All three
+        # structures are insertion-ordered so bounds evict oldest-first.
+        self._provisional: dict[str, list[Span]] = {}
+        self._promoted: dict[str, bool] = {}
+        self._discarded: dict[str, bool] = {}
         self._lock = make_lock("tracer::ring")
 
     def enable(self, on: bool = True) -> None:
@@ -204,9 +229,82 @@ class Tracer:
         if tags:
             sp.tags.update(tags)
         with self._lock:
+            buf = self._provisional.get(sp.trace_id)
+            if buf is not None:
+                # tail-sampling hold: the op's completion verdict
+                # (promote/discard) decides this span's fate
+                if len(buf) < _MAX_PROV_SPANS:
+                    buf.append(sp)
+                return
+            if sp.trace_id in self._discarded:
+                return  # the op completed fast; its late spans drop too
             self._spans.append(sp)
             if len(self._spans) > _MAX_SPANS:
                 del self._spans[: _MAX_SPANS // 10]
+
+    # -- tail sampling (retroactive promotion) -------------------------
+    def mark_provisional(self, trace_id: str | None) -> None:
+        """Register a trace whose head coin flip said no: its spans
+        buffer until promote()/discard() renders the verdict.  Bounded —
+        the oldest undecided trace is discarded on overflow."""
+        if trace_id is None:
+            return
+        with self._lock:
+            if (trace_id in self._provisional
+                    or trace_id in self._promoted
+                    or trace_id in self._discarded):
+                return
+            while len(self._provisional) >= _MAX_PROVISIONAL:
+                old = next(iter(self._provisional))
+                del self._provisional[old]
+                self._note_decided_locked(self._discarded, old)
+            self._provisional[trace_id] = []
+
+    def is_provisional(self, trace_id: str | None) -> bool:
+        if trace_id is None:
+            return False
+        with self._lock:
+            return trace_id in self._provisional
+
+    def _note_decided_locked(self, table: dict, trace_id: str) -> None:
+        table[trace_id] = True
+        while len(table) > _MAX_DECIDED:
+            del table[next(iter(table))]
+
+    def promote(self, trace_id: str | None, reason: str = "") -> bool:
+        """Retroactively keep a provisionally buffered trace: its spans
+        move into the real buffer and every LATER span of the trace
+        records normally.  Idempotent; safe (and a no-op beyond the
+        verdict note) on a head-sampled trace.  Returns True when
+        buffered spans were actually promoted."""
+        if trace_id is None:
+            return False
+        with self._lock:
+            buf = self._provisional.pop(trace_id, None)
+            self._discarded.pop(trace_id, None)
+            self._note_decided_locked(self._promoted, trace_id)
+            if not buf:
+                return False
+            if reason:
+                for sp in buf:
+                    sp.tags.setdefault("tail_promoted", reason)
+            self._spans.extend(buf)
+            if len(self._spans) > _MAX_SPANS:
+                del self._spans[: _MAX_SPANS // 10]
+            return True
+
+    def discard(self, trace_id: str | None) -> bool:
+        """Drop a provisionally buffered trace (the op completed fast).
+        A trace ANY participant already promoted stays promoted — the
+        primary's complaint-time verdict wins over the client's."""
+        if trace_id is None:
+            return False
+        with self._lock:
+            if trace_id in self._promoted:
+                return False
+            self._provisional.pop(trace_id, None)
+            self._note_decided_locked(self._discarded, trace_id)
+            return True
 
     def record(self, ctx: TraceCtx | None, name: str, entity: str = "",
                t0: float | None = None, t1: float | None = None,
@@ -230,6 +328,9 @@ class Tracer:
         with self._lock:
             self._events.clear()
             self._spans.clear()
+            self._provisional.clear()
+            self._promoted.clear()
+            self._discarded.clear()
 
 
 TRACER = Tracer()
@@ -237,15 +338,26 @@ tracepoint = TRACER.tracepoint
 span = TRACER.span
 
 
-def sampled_ctx(rate: float) -> TraceCtx | None:
+def sampled_ctx(rate: float, tail: bool = False) -> TraceCtx | None:
     """Head-based sampling: one coin flip per logical op, at the
     Objecter (reference: Jaeger's probabilistic sampler).  rate >= 1
-    always samples; rate <= 0 never does."""
-    if not TRACER.enabled or rate <= 0.0:
+    always samples; rate <= 0 never does.
+
+    ``tail=True`` (cephmeter tail sampling, armed by
+    ``trace_tail_latency_ms``) turns a losing coin flip into a
+    PROVISIONAL context instead of None: every stage still records, but
+    the spans buffer aside until the op's completion latency renders
+    the promote/discard verdict — a p99 straggler keeps its trace even
+    at ``trace_sampling_rate=0``."""
+    if not TRACER.enabled:
         return None
-    if rate < 1.0 and random.random() >= rate:
+    if rate >= 1.0 or (rate > 0.0 and random.random() < rate):
+        return TRACER.new_trace()
+    if not tail:
         return None
-    return TRACER.new_trace()
+    ctx = TraceCtx(_new_id(), None)
+    TRACER.mark_provisional(ctx.trace_id)
+    return ctx
 
 
 # -- trace assembly / export ------------------------------------------

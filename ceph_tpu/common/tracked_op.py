@@ -5,23 +5,46 @@ Every op carries a timestamped event list; the tracker keeps in-flight ops
 plus a bounded deque of completed ("historic") ops, and flags slow ops by
 age.  This is the reference's practical profiler — `dump_historic_ops` shows
 per-stage latency — and the admin socket exposes the same three dumps here.
+
+cephmeter additions (PR 11):
+
+- **per-stage durations**: ``stage_add`` accumulates named stage wall
+  time (fed by ``OSD._op_stage`` and the write batcher on the same
+  ``tracer.trace_now`` clock as the event marks), so a slow op's dump
+  says WHICH stage dominated, not just when each ended;
+- **slow-op history**: an op that completes slower than the complaint
+  time is kept in a separate bounded deque served by
+  ``dump_historic_slow_ops`` — with its stage attribution and (when
+  cephtrace kept or tail-promoted the trace) the assembled
+  cross-entity trace tree;
+- **sticky slow accounting**: ``slow_op_count`` adds a decaying
+  recent-slow count to the in-flight count, so an op that completes
+  slow BETWEEN mgr report polls cannot vanish from SLOW_OPS before the
+  digest samples it (the fast-finishing-straggler hole).
 """
 from __future__ import annotations
 
 import time
 from collections import deque
 from .lockdep import make_lock
-from .tracer import trace_now
+from .tracer import TRACER, assemble_trees, trace_now
 
 
 class TrackedOp:
-    __slots__ = ("tracker", "desc", "initiated_at", "events", "_lock")
+    __slots__ = ("tracker", "desc", "initiated_at", "events", "stages",
+                 "trace_id", "_lock")
 
     def __init__(self, tracker: "OpTracker", desc: str):
         self.tracker = tracker
         self.desc = desc
         self.initiated_at = trace_now()
         self.events: list[tuple[float, str]] = [(self.initiated_at, "initiated")]
+        # stage -> accumulated seconds (cephmeter per-stage attribution)
+        self.stages: dict[str, float] = {}
+        # cephtrace context id, when the op rode a (sampled or
+        # provisionally buffered) trace — dump_historic_slow_ops uses it
+        # to attach the assembled tree
+        self.trace_id: str | None = None
         self._lock = make_lock("optracker::op")
 
     def mark_event(self, name: str, ts: float | None = None) -> None:
@@ -32,14 +55,46 @@ class TrackedOp:
         with self._lock:
             self.events.append((trace_now() if ts is None else ts, name))
 
+    def stage_add(self, stage: str, seconds: float) -> None:
+        """Accumulate one stage's wall time (several batcher waits or
+        sub-op rounds may feed the same stage)."""
+        with self._lock:
+            self.stages[stage] = self.stages.get(stage, 0.0) + seconds
+
     def age(self, now: float | None = None) -> float:
         return (time.time() if now is None else now) - self.initiated_at
+
+    def duration(self) -> float:
+        """Initiation to last recorded event (== total for a finished
+        op, whose final event is the 'done' mark)."""
+        with self._lock:
+            return self.events[-1][0] - self.initiated_at
+
+    def dominant_stage(self) -> tuple[str, float] | None:
+        with self._lock:
+            if not self.stages:
+                return None
+            name = max(self.stages, key=self.stages.get)
+            return name, self.stages[name]
+
+    def _dom_suffix(self) -> str:
+        """The shared ', dominant stage X (N ms)' tail of every
+        SLOW_OPS detail line ('' when no stage recorded)."""
+        dom = self.dominant_stage()
+        if dom is None:
+            return ""
+        return f", dominant stage {dom[0]} ({dom[1] * 1e3:.1f} ms)"
+
+    def slow_summary(self, now: float | None = None) -> str:
+        """One SLOW_OPS detail line naming the dominant stage."""
+        return f"{self.desc}: {self.age(now):.2f}s{self._dom_suffix()}"
 
     def dump(self) -> dict:
         with self._lock:
             events = list(self.events)
+            stages = dict(self.stages)
         t0 = self.initiated_at
-        return {
+        out = {
             "description": self.desc,
             "initiated_at": t0,
             "age": self.age(),
@@ -51,6 +106,14 @@ class TrackedOp:
                 ]
             },
         }
+        if stages:
+            out["stages"] = {
+                s: round(d * 1e3, 3) for s, d in stages.items()
+            }
+            out["dominant_stage"] = max(stages, key=stages.get)
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
+        return out
 
     def finish(self) -> None:
         self.mark_event("done")
@@ -65,11 +128,20 @@ class TrackedOp:
 
 
 class OpTracker:
-    def __init__(self, history_size: int = 20, complaint_time: float = 30.0):
+    def __init__(self, history_size: int = 20, complaint_time: float = 30.0,
+                 recent_slow_window: float = 60.0):
         self._inflight: dict[int, TrackedOp] = {}
         self._history: deque[TrackedOp] = deque(maxlen=history_size)
+        # completed-slow ops, separately bounded: a burst of fast ops
+        # must not push a straggler out of forensic reach
+        self._slow_history: deque[TrackedOp] = deque(
+            maxlen=max(1, history_size))
+        # completion wall-clock stamps of recent slow ops — the sticky
+        # SLOW_OPS count (decays after recent_slow_window seconds)
+        self._recent_slow: deque[float] = deque(maxlen=1024)
         self._lock = make_lock("optracker::tracker")
         self.complaint_time = complaint_time
+        self.recent_slow_window = recent_slow_window
 
     def create(self, desc: str) -> TrackedOp:
         op = TrackedOp(self, desc)
@@ -78,9 +150,14 @@ class OpTracker:
         return op
 
     def unregister(self, op: TrackedOp) -> None:
+        slow = (self.complaint_time > 0
+                and op.duration() > self.complaint_time)
         with self._lock:
             if self._inflight.pop(id(op), None) is not None:
                 self._history.append(op)
+                if slow:
+                    self._slow_history.append(op)
+                    self._recent_slow.append(time.time())
 
     def num_inflight(self) -> int:
         with self._lock:
@@ -96,6 +173,28 @@ class OpTracker:
             ops = list(self._history)
         return {"num_ops": len(ops), "ops": [op.dump() for op in ops]}
 
+    def dump_historic_slow_ops(self, with_traces: bool = True) -> dict:
+        """Completed-slow forensics: stage attribution per op plus (when
+        cephtrace kept the spans — head-sampled or tail-promoted) the
+        assembled cross-entity trace tree (docs/observability.md)."""
+        with self._lock:
+            ops = list(self._slow_history)
+        out = []
+        for op in ops:
+            d = op.dump()
+            if with_traces and op.trace_id is not None:
+                spans = TRACER.spans(trace_id=op.trace_id)
+                if spans:
+                    d["trace"] = {
+                        "trace_id": op.trace_id,
+                        "num_spans": len(spans),
+                        "entities": sorted({s["entity"] for s in spans}),
+                        "tree": assemble_trees(spans).get(op.trace_id, []),
+                    }
+            out.append(d)
+        return {"num_ops": len(out),
+                "complaint_time": self.complaint_time, "ops": out}
+
     def slow_ops(self, now: float | None = None) -> list[TrackedOp]:
         """Ops older than the complaint time (reference: the
         'slow requests' health warning path)."""
@@ -103,3 +202,31 @@ class OpTracker:
         with self._lock:
             ops = list(self._inflight.values())
         return [op for op in ops if op.age(now) > self.complaint_time]
+
+    def slow_op_count(self, now: float | None = None) -> int:
+        """In-flight slow ops PLUS recently-completed slow ops within
+        the decay window — the sticky count SLOW_OPS reports, so a
+        straggler that finishes between two mgr report polls still
+        surfaces (satellite: no vanishing fast-finishing stragglers)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            while (self._recent_slow
+                   and now - self._recent_slow[0] > self.recent_slow_window):
+                self._recent_slow.popleft()
+            recent = len(self._recent_slow)
+        return len(self.slow_ops(now)) + recent
+
+    def slow_summaries(self, now: float | None = None,
+                       limit: int = 5) -> list[str]:
+        """Detail lines for the SLOW_OPS health check: in-flight slow
+        ops first, then the freshest completed stragglers."""
+        now = time.time() if now is None else now
+        lines = [op.slow_summary(now) for op in self.slow_ops(now)]
+        with self._lock:
+            recent = list(self._slow_history)
+        for op in reversed(recent):
+            if len(lines) >= limit:
+                break
+            lines.append(f"{op.desc}: completed in "
+                         f"{op.duration():.2f}s{op._dom_suffix()}")
+        return lines[:limit]

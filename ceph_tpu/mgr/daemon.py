@@ -25,6 +25,7 @@ from . import quota_module  # noqa: F401
 from . import pg_autoscaler_module  # noqa: F401
 from . import prometheus_module  # noqa: F401
 from . import status_module  # noqa: F401
+from .metrics_history import MetricsHistory  # also registers the module
 
 
 class MgrDaemon(Dispatcher):
@@ -38,6 +39,17 @@ class MgrDaemon(Dispatcher):
         )
         self._reports: dict[str, dict] = {}   # daemon -> last MMgrReport view
         self._reports_lock = threading.Lock()
+        # cephmeter: the bounded time-series ring every history consumer
+        # (iostat, `perf history`, future QoS controllers) queries — fed
+        # synchronously per incoming MMgrReport, daemon-owned so it
+        # exists whether or not the metrics_history module is hosted
+        self.metrics_history = MetricsHistory(
+            max_samples=int(cct.conf.get("mgr_metrics_history_samples")),
+            max_series=int(cct.conf.get("mgr_metrics_history_max_series")),
+            # well past the query-side staleness filter: hidden first,
+            # forgotten (series slots freed) only once clearly dead
+            forget_age=10 * float(cct.conf.get("mgr_stale_report_age")),
+        )
         self._modules: dict[str, MgrModule] = {}
         self._threads: list[threading.Thread] = []
         self.addr: tuple[str, int] | None = None
@@ -114,14 +126,20 @@ class MgrDaemon(Dispatcher):
     # -- report sink -------------------------------------------------------
     def ms_dispatch(self, conn, msg) -> bool:
         if isinstance(msg, MMgrReport):
+            ts = time.monotonic()
             with self._reports_lock:
                 self._reports[msg.daemon] = {
                     "counters": msg.counters or {},
                     "schema": getattr(msg, "schema", None) or {},
                     "stats": msg.stats or {},
                     "epoch": msg.epoch,
-                    "ts": time.monotonic(),
+                    "ts": ts,
                 }
+            # one history sample per report, stamped with the ARRIVAL
+            # time (rates divide by the report interval, not a sampling
+            # cadence) — outside the reports lock; the store has its own
+            self.metrics_history.add_report(
+                msg.daemon, ts, msg.counters or {})
             return True
         return False
 

@@ -1,6 +1,20 @@
-"""iostat module — cluster IO rates from perf-report deltas (reference:
-src/pybind/mgr/iostat/module.py feeding `ceph iostat`: rd/wr ops and
-bytes per second computed between consecutive daemon reports)."""
+"""iostat module — cluster IO rates from the shared metrics-history
+store (reference: src/pybind/mgr/iostat/module.py feeding `ceph
+iostat`: rd/wr ops and bytes per second computed between consecutive
+daemon reports).
+
+cephmeter refactor (PR 11): the module used to hand-roll its own
+``_prev`` delta tracking over ``latest_reports_with_ts``; that private
+value history is gone — the DATA lives in ``mgr.metrics_history``, the
+same bounded ring every other history consumer (the `perf history`
+command, future QoS controllers) queries.  The module keeps only a
+per-daemon poll CURSOR (the newest sample ts it saw last time) so the
+old semantics survive the refactor: a rate covers everything since the
+previous ``sample()`` call — a counter burst between two polls is never
+missed — deltas divide by report ARRIVAL intervals, counter resets
+clamp to 0, and dead daemons drop out via the staleness filter (hidden
+from output immediately; the store forgets their series — and this
+module their cursors — after the store's ``forget_age``)."""
 from __future__ import annotations
 
 from .module import MgrModule, register_module
@@ -14,50 +28,44 @@ class IostatModule(MgrModule):
 
     def __init__(self, mgr):
         super().__init__(mgr)
-        # daemon -> (ts, {counter: value}) of the previous sample
-        self._prev: dict[str, tuple[float, dict]] = {}
+        # daemon -> newest history-sample ts consumed by the previous
+        # sample() call (a cursor into the SHARED store, not a value
+        # copy — the first call primes it and reports zeros, like
+        # `iostat`'s since-boot first line the reference also skips)
+        self._cursor: dict[str, float] = {}
 
     def sample(self) -> dict:
-        """Cluster-wide rates between each daemon's two most recent
-        REPORTS (first call primes the baseline and reports zeros, like
-        `iostat`'s first line being since-boot noise the reference also
-        skips).  Deltas divide by the report ARRIVAL interval, not the
-        caller's sampling cadence, so polling faster than
-        mgr_report_interval neither zeroes nor inflates the rates."""
-        reports = self.mgr.latest_reports_with_ts()
-        # prune daemons that fell out of the report window (dead or
-        # removed): their stale baselines must not linger, and a daemon
-        # returning later restarts from a fresh baseline
-        for gone in set(self._prev) - set(reports):
-            del self._prev[gone]
+        """Cluster-wide rates since the PREVIOUS sample() call, from
+        the shared metrics-history store."""
+        h = self.mgr.metrics_history
+        max_age = self.cct.conf.get("mgr_stale_report_age")
         totals = {c: 0.0 for c in _RATE_COUNTERS}
         per_daemon: dict[str, dict] = {}
-        for daemon, (ts, subsystems) in reports.items():
-            osd = subsystems.get("osd") or {}
-            cur = {c: float(osd.get(c, 0)) for c in _RATE_COUNTERS}
-            prev = self._prev.get(daemon)
-            if prev is not None and ts == prev[0]:
-                # same report as last sample: keep the old baseline so
-                # the NEXT fresh report diffs against real history
-                prev_for_rates = None
-            else:
-                self._prev[daemon] = (ts, cur)
-                prev_for_rates = prev
-            prev = prev_for_rates
-            if prev is None:
-                continue
-            dt = ts - prev[0]
-            if dt <= 0:
-                continue
-            rates = {
-                # counters can reset when a daemon restarts: clamp to 0
-                # instead of reporting a huge negative rate
-                c: max(0.0, (cur[c] - prev[1][c]) / dt)
-                for c in _RATE_COUNTERS
-            }
-            per_daemon[daemon] = rates
+        seen: dict[str, float] = {}
+        for c in _RATE_COUNTERS:
+            rates = h.rate_since(f"osd.{c}", self._cursor,
+                                 max_age=max_age)
+            for daemon, (r, ts) in rates.items():
+                seen[daemon] = max(ts, seen.get(daemon, 0.0))
+                if r is None:
+                    continue  # priming: cursor set, rate next poll
+                per_daemon.setdefault(daemon, {})[c] = r
+                totals[c] += r
+        # advance cursors for daemons with fresh reports.  A daemon
+        # rate_since omitted this poll (nothing new yet, or briefly
+        # stale) keeps its cursor — if it returns after a restart the
+        # reset-clamp yields one 0 rate and the next poll is clean;
+        # one the STORE has forgotten (silent past forget_age) loses
+        # its cursor too, so _cursor cannot grow without bound under
+        # daemon churn
+        for daemon, ts in seen.items():
+            self._cursor[daemon] = ts
+        live = set(h.daemons())
+        for gone in set(self._cursor) - live:
+            del self._cursor[gone]
+        for rates in per_daemon.values():
             for c in _RATE_COUNTERS:
-                totals[c] += rates[c]
+                rates.setdefault(c, 0.0)
         return {
             "ops_per_s": round(totals["op"], 1),
             "rd_ops_per_s": round(totals["op_r"], 1),

@@ -13,6 +13,55 @@ import threading
 from ..common.perf_counters import HIST_LE
 from .module import MgrModule, register_module
 
+#: exposition-time cardinality guard for labeled (per-client) series:
+#: at most this many label sets per daemon per labeled structure; the
+#: overflow folds into one `_other_` row (sums preserved) — the second
+#: bound after the OSD table's own top-K (docs/observability.md)
+_MAX_LABEL_SETS = 256
+
+
+def _sanitize_label(v) -> str:
+    """Label-value hygiene for client entity names: control characters
+    (incl. newline before esc() would see it) are stripped and the
+    value is length-capped, so one hostile or mangled entity name
+    cannot poison the exposition or explode a label.  Quotes and
+    backslashes are handled by esc() at emission."""
+    s = str(v)
+    if any(ch < " " or ch == "\x7f" for ch in s):
+        s = "".join(ch for ch in s if ch >= " " and ch != "\x7f")
+    return s[:120] if len(s) > 120 else s
+
+
+def _fold_labeled_rows(rows: list, cap: int = _MAX_LABEL_SETS) -> list:
+    """Cap a labeled-row list, folding the tail (plus any pre-existing
+    `_other_` rows) into ONE `_other_` row whose scalar fields sum and
+    whose histograms merge bucket-by-bucket — counts survive the cap,
+    only attribution is lost."""
+    if len(rows) <= cap:
+        return rows
+    keep = [r for r in rows[:cap - 1]
+            if (r.get("labels") or {}).get("client") != "_other_"]
+    fold = [r for r in rows if r not in keep]
+    merged: dict = {"labels": {
+        k: "_other_" for k in (fold[0].get("labels") or {"client": 0})
+    }}
+    for row in fold:
+        for f, v in row.items():
+            if f == "labels":
+                continue
+            if isinstance(v, dict) and "buckets" in v:
+                agg = merged.setdefault(f, {
+                    "count": 0, "sum": 0.0,
+                    "buckets": [0] * len(v["buckets"]),
+                })
+                agg["count"] += v.get("count", 0)
+                agg["sum"] += v.get("sum", 0.0)
+                for i, c in enumerate(v["buckets"]):
+                    agg["buckets"][i] += c
+            elif isinstance(v, (int, float)):
+                merged[f] = merged.get(f, 0) + v
+    return keep + [merged]
+
 
 def render_metrics(osdmap, reports: dict, schema: dict | None = None,
                    health: dict | None = None) -> str:
@@ -120,26 +169,55 @@ def render_metrics(osdmap, reports: dict, schema: dict | None = None,
         typ = "gauge" if sch.get("type") == "gauge" else default_typ
         return doc, typ
 
+    def add_hist(key: str, doc: str, labels: dict, value: dict) -> None:
+        """Accumulate one histogram dump (cumulative le buckets)."""
+        h = hists.setdefault(key, {
+            "doc": doc, "bucket": [], "sum": [], "count": [],
+        })
+        cum = 0
+        for i, c in enumerate(value["buckets"]):
+            cum += c
+            le = f"{HIST_LE[i]:.6g}" if i < len(HIST_LE) else "+Inf"
+            h["bucket"].append(({**labels, "le": le}, cum))
+        h["sum"].append((labels, value["sum"]))
+        h["count"].append((labels, value["count"]))
+
     for daemon, subsystems in sorted(reports.items()):
         labels = {"ceph_daemon": daemon}
         for subsys, counters in sorted((subsystems or {}).items()):
             for cname, value in sorted(counters.items()):
                 key = f"ceph_{subsys}_{cname}"
+                if isinstance(value, dict) and value.get("__labeled__"):
+                    # cephmeter labeled rows (the per-(client,pool)
+                    # accounting table): each row's fields become
+                    # ceph_<subsys>_<field>{ceph_daemon,client,pool,...}
+                    # series; sanitized label values, bounded row count
+                    for row in _fold_labeled_rows(value.get("rows") or []):
+                        rl = {**labels, **{
+                            k: _sanitize_label(v)
+                            for k, v in (row.get("labels") or {}).items()
+                        }}
+                        for f, v in sorted(row.items()):
+                            if f == "labels":
+                                continue
+                            fkey = f"ceph_{subsys}_{f}"
+                            if isinstance(v, dict) and "buckets" in v:
+                                add_hist(
+                                    fkey,
+                                    declared(subsys, f, fkey,
+                                             "histogram")[0],
+                                    rl, v)
+                            elif isinstance(v, (int, float)):
+                                meta.setdefault(fkey, declared(
+                                    subsys, f, fkey, "counter"))
+                                series.setdefault(fkey, []).append(
+                                    (rl, v))
+                    continue
                 if isinstance(value, dict) and "buckets" in value:
                     # log2-bucket latency histogram (PerfCounters
                     # TYPE_HISTOGRAM): cumulative le buckets, seconds
-                    h = hists.setdefault(key, {
-                        "doc": declared(subsys, cname, key, "histogram")[0],
-                        "bucket": [], "sum": [], "count": [],
-                    })
-                    cum = 0
-                    for i, c in enumerate(value["buckets"]):
-                        cum += c
-                        le = (f"{HIST_LE[i]:.6g}" if i < len(HIST_LE)
-                              else "+Inf")
-                        h["bucket"].append(({**labels, "le": le}, cum))
-                    h["sum"].append((labels, value["sum"]))
-                    h["count"].append((labels, value["count"]))
+                    add_hist(key, declared(subsys, cname, key,
+                                           "histogram")[0], labels, value)
                 elif isinstance(value, dict):  # longrunavg {avgcount, sum}
                     for part, v in value.items():
                         pkey = f"{key}_{part}"

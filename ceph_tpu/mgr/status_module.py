@@ -153,6 +153,11 @@ class StatusModule(MgrModule):
             pg_info.update(st.get("pg_info") or {})
         slow = {d: int(st.get("slow_ops", 0))
                 for d, st in stats.items() if st.get("slow_ops")}
+        # per-daemon detail lines (cephmeter: each names its op's
+        # dominant stage) ride along only for daemons with slow ops
+        slow_detail = {d: st.get("slow_ops_detail")
+                       for d, st in stats.items()
+                       if st.get("slow_ops") and st.get("slow_ops_detail")}
         # accelerator health (common/kernel_telemetry.py): forward only
         # daemons with something to report — a degraded sentinel or an
         # active kernel-fallback latch — so the digest stays small and
@@ -168,7 +173,12 @@ class StatusModule(MgrModule):
             "osd_df": assemble_osd_df(m, stats),
             "pg_info": pg_info,
             "slow_ops": slow,
+            "slow_ops_detail": slow_detail,
             "backend_health": backend,
+            # compact metrics-history snapshot: the mon's `perf history`
+            # command answers from this (cephmeter; the mon has no
+            # channel TO the mgr, so the history rides the digest)
+            "perf_history": self.mgr.metrics_history.digest(),
         }
 
     def serve(self) -> None:

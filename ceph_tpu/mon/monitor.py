@@ -668,13 +668,22 @@ class Monitor(Dispatcher):
             slow = digest.get("slow_ops") or {}
             if slow:
                 # reference: the SLOW_OPS health warning from optracker
-                # complaint counts streamed through the mgr
+                # complaint counts streamed through the mgr.  The count
+                # is the OSDs' STICKY count (in-flight + recently
+                # completed slow), and the detail lines name each op's
+                # dominant stage (cephmeter forensics)
                 n = sum(slow.values())
+                slow_detail = digest.get("slow_ops_detail") or {}
                 checks["SLOW_OPS"] = {
                     "severity": "HEALTH_WARN",
                     "message": f"{n} slow ops on "
                                f"{', '.join(sorted(slow))}",
                     "daemons": sorted(slow),
+                    "detail": [
+                        f"{d}: {line}"
+                        for d in sorted(slow)
+                        for line in (slow_detail.get(d) or [])
+                    ][:12],
                 }
             backend = digest.get("backend_health") or {}
             deg = sorted(

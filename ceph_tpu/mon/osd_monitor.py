@@ -191,6 +191,8 @@ class OSDMonitor:
             return 0, "ok"
         if prefix in ("df", "osd df", "pg dump"):
             return self._cmd_from_digest(prefix)
+        if prefix == "perf history":
+            return self._cmd_perf_history(cmd)
         if prefix == "osd erasure-code-profile set":
             return self._cmd_profile_set(cmd)
         if prefix == "osd erasure-code-profile get":
@@ -670,6 +672,38 @@ class OSDMonitor:
         for root in sorted(roots, reverse=True):
             walk(root, 0)
         return rows
+
+    def _cmd_perf_history(self, cmd: dict) -> tuple[int, object]:
+        """`ceph perf history [name] [daemon]` — recent samples of the
+        digest's perf series (cephmeter; reference: the reads a
+        closed-loop controller does against its own series, served
+        mon-side from the MMonMgrReport digest like df/pg dump)."""
+        ts_digest = getattr(self, "mgr_digest", None)
+        if ts_digest is None:
+            return -2, "no mgr digest yet (is the mgr running?)"
+        ts, digest = ts_digest
+        hist = digest.get("perf_history")
+        if not isinstance(hist, dict) or not hist.get("daemons"):
+            return -2, "digest carries no perf history yet"
+        name = cmd.get("name")
+        daemon = cmd.get("daemon")
+        daemons = {}
+        for d, series in (hist.get("daemons") or {}).items():
+            if daemon is not None and d != daemon:
+                continue
+            keep = {n: s for n, s in series.items()
+                    if name is None or n == name}
+            if keep:
+                daemons[d] = keep
+        if (name is not None or daemon is not None) and not daemons:
+            return -2, (f"no history for name={name!r} daemon={daemon!r}; "
+                        f"names: {hist.get('names')}")
+        return 0, {
+            "digest_age_seconds": round(time.monotonic() - ts, 1),
+            "names": hist.get("names"),
+            "samples_per_series": hist.get("samples_per_series"),
+            "daemons": daemons,
+        }
 
     def _cmd_from_digest(self, prefix: str) -> tuple[int, object]:
         """Serve `df`/`osd df`/`pg dump` from the mgr's streamed digest

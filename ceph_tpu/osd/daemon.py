@@ -41,6 +41,7 @@ import threading
 import time
 
 from ..common.failpoint import failpoint, registry as fp_registry
+from ..common.io_accounting import IOAccounting
 from ..common.kernel_telemetry import SENTINEL, TELEMETRY, SentinelPolicy
 from ..common.lockdep import make_lock
 from ..common.perf_counters import PerfCountersBuilder
@@ -270,7 +271,20 @@ class OSD(
         self.op_tracker = OpTracker(
             history_size=int(cct.conf.get("osd_op_history_size")),
             complaint_time=float(cct.conf.get("osd_op_complaint_time")),
+            recent_slow_window=float(cct.conf.get("osd_slow_op_window")),
         )
+        # cephmeter: per-(client,pool) accounting — the labels are the
+        # future mClock QoS tags (common/io_accounting.py).  The table
+        # duck-types PerfCounters, so adding it to cct.perf makes the
+        # labeled series ride perf dump -> MMgrReport -> prometheus
+        # with zero new wire plumbing (docs/observability.md)
+        self.io_acct: IOAccounting | None = None
+        if cct.conf.get("osd_client_io_accounting"):
+            self.io_acct = IOAccounting(
+                "client_io",
+                top_k=int(cct.conf.get("osd_client_io_top_k")),
+            )
+            cct.perf.add(self.io_acct)
         if cct.admin_socket is not None:
             cct.admin_socket.register_command(
                 "dump_ops_in_flight",
@@ -281,6 +295,13 @@ class OSD(
                 "dump_historic_ops",
                 lambda c: self.op_tracker.dump_historic_ops(),
                 "recently completed ops",
+            )
+            cct.admin_socket.register_command(
+                "dump_historic_slow_ops",
+                lambda c: self.op_tracker.dump_historic_slow_ops(),
+                "completed slow ops with per-stage attribution and "
+                "(when cephtrace kept or tail-promoted the trace) the "
+                "assembled cross-entity trace tree",
             )
 
     # -- lifecycle ---------------------------------------------------------
@@ -590,6 +611,9 @@ class OSD(
         tracked = st.get("tracked")
         if tracked is not None:
             tracked.mark_event(stage, ts=t1)
+            # cephmeter: accumulated per-stage duration, so a slow op's
+            # dump_historic_slow_ops entry names the dominant stage
+            tracked.stage_add(stage, t1 - t0)
         if span is not None:
             TRACER.end(span, t1=t1, **tags)
             return

@@ -29,14 +29,23 @@ class PrimaryOpsMixin:
     def _handle_client_op(self, conn, msg: MOSDOp) -> None:
         t0 = time.perf_counter()
         self.logger.inc("op")
+        wr_bytes = 0
         if msg.op == "write_full":
             self.logger.inc("op_w")
-            self.logger.inc("op_w_bytes", len(msg.data or "") * 3 // 4)
+            wr_bytes = len(msg.data or "") * 3 // 4
+            self.logger.inc("op_w_bytes", wr_bytes)
         elif msg.op == "read":
             self.logger.inc("op_r")
         tracked = self.op_tracker.create(
             f"osd_op({msg.op} {msg.pool}.{msg.oid} tid={msg.tid})"
         )
+        # cephmeter: (client entity, pool) stamp — msg.src is the
+        # messenger-framed entity name the Objecter sends under.  These
+        # labels ARE the future mClock tags; the accounting table and
+        # the write batcher (through the op-trace state) attribute
+        # per-stage latency to them (docs/observability.md)
+        client = getattr(msg, "src", None) or "client._unknown_"
+        tracked.trace_id = getattr(msg, "trace_id", None)
         # cephtrace: adopt the client's context (one attribute check
         # when tracing is off).  The osd_op span parents every stage
         # span below; the thread-local op-trace state is how the write
@@ -56,6 +65,8 @@ class PrimaryOpsMixin:
         set_op_trace({
             "ctx": osd_span.ctx() if osd_span is not None else None,
             "tracked": tracked,
+            "acct": ((self.io_acct, client, msg.pool)
+                     if self.io_acct is not None else None),
         })
         reply = None
         try:
@@ -73,13 +84,37 @@ class PrimaryOpsMixin:
             set_op_trace(None)
             TRACER.end(osd_span,
                        retval=reply.retval if reply is not None else None)
+            if TRACER.enabled and tracked.trace_id is not None:
+                self._maybe_tail_promote(tracked)
         if msg.op == "read" and reply.retval == 0 and reply.data:
             self.logger.inc("op_r_bytes", len(reply.data) * 3 // 4)
+        if self.io_acct is not None:
+            nbytes = wr_bytes
+            if msg.op == "read" and reply.retval == 0 and reply.data:
+                nbytes = len(reply.data) * 3 // 4
+            self.io_acct.record_op(client, msg.pool, msg.op,
+                                   nbytes=nbytes, e2e=tracked.duration())
         self.logger.tinc("op_latency", time.perf_counter() - t0)
         try:
             conn.send_message(reply)
         except (OSError, ConnectionError):
             pass
+
+    def _maybe_tail_promote(self, tracked) -> None:
+        """cephmeter tail sampling, primary side: an op that crossed
+        osd_op_complaint_time or trace_tail_latency_ms promotes its
+        provisionally buffered trace into the real buffer — even when
+        the head coin flip said no (trace_sampling_rate=0).  Runs after
+        the osd_op span ended, so the whole OSD-side subtree (and the
+        replicas' commit spans, already ended before the acks were
+        collected) moves together."""
+        dur = tracked.duration()
+        complaint = self.op_tracker.complaint_time
+        tail_ms = float(self.cct.conf.get("trace_tail_latency_ms"))
+        if complaint > 0 and dur > complaint:
+            TRACER.promote(tracked.trace_id, reason="osd_complaint")
+        elif tail_ms > 0 and dur * 1e3 >= tail_ms:
+            TRACER.promote(tracked.trace_id, reason="osd_tail")
 
     def _execute_client_op(self, msg: MOSDOp) -> MOSDOpReply:
         # the client targeted with a NEWER map than ours: wait for it

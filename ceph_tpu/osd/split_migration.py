@@ -294,7 +294,14 @@ class SplitMigrationMixin:
                                str(k): v for k, v in pool_objects.items()
                            },
                            "statfs": self.store.statfs(),
-                           "slow_ops": len(self.op_tracker.slow_ops()),
+                           # sticky count: in-flight slow PLUS recently
+                           # completed slow (cephmeter — a straggler
+                           # finishing between report polls must not
+                           # vanish from SLOW_OPS before the digest
+                           # samples it)
+                           "slow_ops": self.op_tracker.slow_op_count(),
+                           "slow_ops_detail":
+                               self.op_tracker.slow_summaries(),
                            # accelerator health rides the same stream
                            # SLOW_OPS does: mgr digest -> mon _health
                            "backend_health": backend_health(),

@@ -77,7 +77,7 @@ class _PendingStripe:
 
     __slots__ = ("key", "mat", "chunks", "nbytes", "arrival", "event",
                  "parity", "error", "admitted", "tctx", "tracked",
-                 "queued_at")
+                 "acct", "queued_at")
 
     def __init__(self, mat: np.ndarray, chunks: np.ndarray):
         self.mat = mat
@@ -95,6 +95,9 @@ class _PendingStripe:
         # flusher (a different thread) can attribute queue/encode spans
         self.tctx = None
         self.tracked = None
+        # cephmeter: (table, client, pool) identity the OSD stamped into
+        # the op-trace state — per-client admission/queue attribution
+        self.acct = None
         self.queued_at = 0.0  # trace_now clock, for the queue-stage span
 
 
@@ -218,13 +221,15 @@ class WriteBatcher:
         mat = np.ascontiguousarray(mat, dtype=np.uint8)
         chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
         p = _PendingStripe(mat, chunks)
-        if TRACER.enabled:  # one attribute check when tracing is off
-            st = op_trace()
-            if st is not None:
+        st = op_trace()
+        if st is not None:
+            if TRACER.enabled:  # one attribute check when tracing is off
                 p.tctx = st.get("ctx")
-                p.tracked = st.get("tracked")
+            p.tracked = st.get("tracked")
+            p.acct = st.get("acct")
         if not self.coalescing():
-            p.parity = self._inline(mat, chunks, tctx=p.tctx)
+            p.parity = self._inline(mat, chunks, tctx=p.tctx,
+                                    tracked=p.tracked)
             p.event.set()
             return p
         # backpressure: block HERE, at admission, while the queue is
@@ -243,6 +248,11 @@ class WriteBatcher:
         t_adm1 = trace_now()
         if self._logger is not None:
             self._logger.hinc("stage_admission", t_adm1 - t_adm0)
+        if p.acct is not None:
+            tab, client, pool = p.acct
+            tab.record_stage(client, pool, "admission", t_adm1 - t_adm0)
+        if p.tracked is not None:
+            p.tracked.stage_add("admission", t_adm1 - t_adm0)
         if p.tctx is not None:
             TRACER.record(p.tctx, "admission", entity=self._entity,
                           t0=t_adm0, t1=t_adm1, nbytes=p.nbytes)
@@ -259,7 +269,8 @@ class WriteBatcher:
                 # per-op completion rides p.event (no herd)
                 self._cond.notify_all()
         if not enqueued:  # raced a stop/crash: encode inline
-            p.parity = self._inline(p.mat, p.chunks, tctx=p.tctx)
+            p.parity = self._inline(p.mat, p.chunks, tctx=p.tctx,
+                                    tracked=p.tracked)
             p.event.set()
         return p
 
@@ -284,7 +295,7 @@ class WriteBatcher:
                 self._admission.put(p.nbytes)
 
     def _inline(self, mat: np.ndarray, chunks: np.ndarray,
-                tctx=None) -> np.ndarray:
+                tctx=None, tracked=None) -> np.ndarray:
         from ..ops.bitplane import apply_matrix_jax
 
         with self._lock:
@@ -300,6 +311,8 @@ class WriteBatcher:
         if tctx is not None:
             TRACER.record(tctx, "encode", entity=self._entity,
                           t0=t0, t1=trace_now(), inline=True)
+        if tracked is not None:
+            tracked.stage_add("encode", trace_now() - t0)
         if self._logger is not None:
             self._logger.hinc("stage_encode", trace_now() - t0)
         return parity
@@ -353,14 +366,21 @@ class WriteBatcher:
         t0 = time.perf_counter()
         w0 = trace_now()
         traced = [p for p in batch if p.tctx is not None]
-        if traced or self._logger is not None:
-            # queue stage: stripe admitted -> flush started
-            for p in batch:
-                if self._logger is not None and p.queued_at:
-                    self._logger.hinc("stage_queue", max(0.0, w0 - p.queued_at))
-            for p in traced:
-                TRACER.record(p.tctx, "queue", entity=self._entity,
-                              t0=p.queued_at or w0, t1=w0)
+        # queue stage: stripe admitted -> flush started
+        for p in batch:
+            if not p.queued_at:
+                continue
+            q_dur = max(0.0, w0 - p.queued_at)
+            if self._logger is not None:
+                self._logger.hinc("stage_queue", q_dur)
+            if p.acct is not None:
+                tab, client, pool = p.acct
+                tab.record_stage(client, pool, "queue", q_dur)
+            if p.tracked is not None:
+                p.tracked.stage_add("queue", q_dur)
+        for p in traced:
+            TRACER.record(p.tctx, "queue", entity=self._entity,
+                          t0=p.queued_at or w0, t1=w0)
         err: BaseException | None = None
         try:
             failpoint("osd.write_batcher.flush", cct=self._cct,
@@ -380,6 +400,10 @@ class WriteBatcher:
             except Exception as e:
                 err = e
         w1 = trace_now()
+        if err is None:
+            for p in batch:
+                if p.tracked is not None:
+                    p.tracked.stage_add("encode", w1 - w0)
         if err is None and traced:
             # ONE fused-encode flush, MANY op spans: the fan-in is
             # expressed as one "encode" span per participating trace

@@ -138,6 +138,15 @@ def _build_command(words: list[str]) -> dict:
         if len(words) < 4:
             raise ValueError("usage: osd crush rm <name>")
         return {"prefix": "osd crush rm", "name": words[3]}
+    if words[:2] == ["perf", "history"]:
+        # perf history [series-name] [daemon] — recent samples from the
+        # mgr's metrics-history digest (cephmeter)
+        cmd = {"prefix": "perf history"}
+        if len(words) > 2:
+            cmd["name"] = words[2]
+        if len(words) > 3:
+            cmd["daemon"] = words[3]
+        return cmd
     if words[:2] == ["osd", "ok-to-stop"]:
         if len(words) < 3:
             raise ValueError("usage: osd ok-to-stop <id> [<id>...]")
@@ -185,6 +194,26 @@ def _build_command(words: list[str]) -> dict:
             cmd["mode"] = words[4]
         return cmd
     raise ValueError(f"unknown command: {joined!r}")
+
+
+def _render_perf_history(res: dict, out) -> None:
+    """`ceph perf history`: per-daemon series table — samples kept,
+    newest value, and the rate between the last two samples."""
+    print(f"perf history (digest age "
+          f"{res.get('digest_age_seconds', '?')}s, "
+          f"series: {', '.join(res.get('names') or [])})", file=out)
+    for daemon in sorted(res.get("daemons") or {}):
+        print(f"  {daemon}:", file=out)
+        for name, samples in sorted(res["daemons"][daemon].items()):
+            last = samples[-1] if samples else None
+            rate = ""
+            if len(samples) >= 2:
+                (t0, v0), (t1, v1) = samples[-2], samples[-1]
+                if t1 > t0:
+                    rate = f"  ({max(0.0, (v1 - v0) / (t1 - t0)):.1f}/s)"
+            val = f"{last[1]:g}" if last else "-"
+            print(f"    {name:<24} n={len(samples):<4} last={val}{rate}",
+                  file=out)
 
 
 def _fs_status(mons, out) -> int:
@@ -402,6 +431,8 @@ def main(argv=None, out=sys.stdout) -> int:
         _render_osd_df(res, out)
     elif cmd["prefix"] == "pg dump":
         _render_pg_dump(res, out)
+    elif cmd["prefix"] == "perf history":
+        _render_perf_history(res, out)
     else:
         print(json.dumps(res, indent=2, default=str), file=out)
     return 0

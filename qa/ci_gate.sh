@@ -27,6 +27,13 @@
 # 6. wedged bench (CEPH_TPU_BENCH_FORCE_WEDGED): bench.py must exit
 #    rc=3 carrying last_known_silicon + sentinel state + per-phase
 #    stale captures instead of a null headline.
+# 7. accounting smoke (ceph_tpu/qa/accounting_smoke.py): a 2-client
+#    cluster must render per-(client,pool) labeled series on the
+#    prometheus exporter with per-client bytes summing to the aggregate
+#    within tolerance, `perf history` must answer from the mon, and a
+#    failpoint-delayed op must surface in dump_historic_slow_ops with
+#    per-stage attribution and a tail-promoted cross-entity trace
+#    (trace_sampling_rate=0 — the head coin flip said no).
 #
 # Analyzers emit SARIF 2.1.0 into qa/_sarif/ (github code-scanning uploads
 # resolve URIs against the repo root, which is where this script runs
@@ -176,5 +183,25 @@ else
     rc=1
 fi
 
-echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json)"
+echo "== accounting smoke (labeled series + slow-op forensics) =="
+# per-client labeled series on the exporter, bytes conservation, `perf
+# history` through the mon, and a failpoint-delayed op surfacing in
+# dump_historic_slow_ops with a tail-promoted cross-entity trace
+# (ceph_tpu/qa/accounting_smoke.py; docs/observability.md)
+python -m ceph_tpu.qa.accounting_smoke > "$OUT_DIR/accounting_smoke.json"
+acct_rc=$?
+if [ $acct_rc -eq 0 ]; then
+    echo "accounting smoke: ok"
+elif python -c "import json; json.load(open('$OUT_DIR/accounting_smoke.json'))" \
+        2>/dev/null; then
+    echo "accounting smoke: FAILED:"
+    python -c "import json; [print(' -', p) for p in json.load(open('$OUT_DIR/accounting_smoke.json'))['problems']]" || true
+    rc=1
+else
+    rm -f "$OUT_DIR/accounting_smoke.json"
+    echo "accounting smoke: ERROR (exit $acct_rc) — scenario crashed"
+    rc=1
+fi
+
+echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json, accounting_smoke.json)"
 exit $rc

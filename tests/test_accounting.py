@@ -1,0 +1,371 @@
+"""cephmeter: per-(client,pool) accounting, the mgr metrics-history
+ring, and tail-sampled slow-op forensics (docs/observability.md).
+
+Fast class (~8 s): unit tests over the bounded table / history store /
+provisional tracer plus ONE small LocalCluster for the
+trace_sampling_rate=0 tail-promotion acceptance path.  Alphabetically
+early on purpose — the tier-1 suite executes in filename order under a
+hard budget (ROADMAP standing constraint)."""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.common.io_accounting import IOAccounting, OTHER_KEY
+from ceph_tpu.common.perf_counters import HIST_NUM_BUCKETS
+from ceph_tpu.common.tracer import TRACER, TraceCtx, connected_traces
+from ceph_tpu.common.tracked_op import OpTracker
+from ceph_tpu.mgr.metrics_history import MetricsHistory
+from ceph_tpu.mgr.prometheus_module import (
+    _fold_labeled_rows,
+    _sanitize_label,
+    render_metrics,
+)
+
+
+# -- accounting table --------------------------------------------------------
+
+def test_accounting_cardinality_bound_and_overflow_sums():
+    """top-K bound: the table never exceeds K entries, evictions fold
+    into _other_, and TOTALS are conserved (attribution is lost, counts
+    never are)."""
+    acct = IOAccounting(top_k=8)
+    for i in range(30):
+        acct.record_op(f"client.c{i}", 1, "write_full", nbytes=100,
+                       e2e=0.001)
+    dump = acct.dump()
+    rows = dump["per_client"]["rows"]
+    other = [r for r in rows if r["labels"]["client"] == OTHER_KEY[0]]
+    live = [r for r in rows if r["labels"]["client"] != OTHER_KEY[0]]
+    assert len(live) <= 8
+    assert dump["tracked_clients"] <= 8
+    assert dump["evictions"] == 30 - len(live)
+    assert other, "evictions must fold into _other_"
+    # conservation: ops, bytes, and histogram counts all add up
+    t = acct.totals()
+    assert t["ops"] == 30 and t["bytes_w"] == 3000
+    assert t["e2e_count"] == 30
+    assert sum(r["ops"] for r in rows) == 30
+    assert sum(r["bytes_w"] for r in rows) == 3000
+    assert sum(r["lat_e2e"]["count"] for r in rows) == 30
+
+
+def test_accounting_lru_and_heavy_hitter_protection():
+    """A heavy hitter survives a scan of one-op clients (top-half-by-ops
+    protection); among cold entries the least-recently-used goes."""
+    acct = IOAccounting(top_k=4)
+    for _ in range(50):
+        acct.record_op("client.heavy", 1, "write_full", nbytes=10)
+    acct.record_op("client.cold1", 1, "read")
+    acct.record_op("client.cold2", 1, "read")
+    # table full; a scan of new one-op clients must never evict heavy
+    for i in range(20):
+        acct.record_op(f"client.scan{i}", 1, "read")
+    clients = {r["labels"]["client"]
+               for r in acct.dump()["per_client"]["rows"]}
+    assert "client.heavy" in clients
+    # LRU among the cold: cold1/cold2 were the oldest-touched and fell
+    assert "client.cold1" not in clients
+    assert "client.cold2" not in clients
+    assert t_ops_conserved(acct, 72)
+
+
+def t_ops_conserved(acct: IOAccounting, want: int) -> bool:
+    return acct.totals()["ops"] == want
+
+
+def test_accounting_stage_histograms():
+    acct = IOAccounting(top_k=4)
+    acct.record_stage("client.a", 2, "admission", 0.002)
+    acct.record_stage("client.a", 2, "queue", 0.004)
+    acct.record_stage("client.a", 2, "nonsense", 1.0)  # ignored
+    row = acct.dump()["per_client"]["rows"][0]
+    assert row["labels"] == {"client": "client.a", "pool": "2"}
+    assert row["lat_admission"]["count"] == 1
+    assert row["lat_queue"]["count"] == 1
+    assert len(row["lat_queue"]["buckets"]) == HIST_NUM_BUCKETS + 1
+    assert row["lat_queue"]["sum"] == pytest.approx(0.004)
+
+
+# -- prometheus labeled exposition -------------------------------------------
+
+def test_labeled_rows_render_with_sanitized_labels():
+    acct = IOAccounting(top_k=8)
+    acct.record_op('client."we\\ird"\n\x01.name', 3, "write_full",
+                   nbytes=4096, e2e=0.01)
+    acct.record_op("client.plain", 3, "read", nbytes=128, e2e=0.002)
+    text = render_metrics(
+        None,
+        {"osd.0": {"client_io": acct.dump()}},
+        schema={"client_io": acct.schema()},
+    )
+    assert ('ceph_client_io_ops{ceph_daemon="osd.0",'
+            'client="client.plain",pool="3"} 1') in text
+    assert 'ceph_client_io_bytes_w{' in text
+    # control chars stripped BEFORE exposition escaping; quotes and
+    # backslashes escaped by esc()
+    assert "\x01" not in text
+    assert 'client="client.\\"we\\\\ird\\"' in text
+    # labeled histograms render as real prometheus histograms
+    assert "# TYPE ceph_client_io_lat_e2e histogram" in text
+    assert 'ceph_client_io_lat_e2e_bucket{' in text
+    assert 'le="+Inf"' in text
+    # HELP text comes from the table's schema
+    assert "# HELP ceph_client_io_ops client ops attributed" in text
+
+
+def test_exposition_cardinality_guard_folds_overflow():
+    rows = [
+        {"labels": {"client": f"client.c{i}", "pool": "1"},
+         "ops": 1, "bytes_w": 10,
+         "lat_e2e": {"count": 1, "sum": 0.001, "buckets": [1, 0]}}
+        for i in range(300)
+    ]
+    out = _fold_labeled_rows(rows, cap=16)
+    assert len(out) == 16
+    other = out[-1]
+    assert other["labels"]["client"] == "_other_"
+    assert other["ops"] == 300 - 15
+    assert other["bytes_w"] == 10 * (300 - 15)
+    assert other["lat_e2e"]["count"] == 300 - 15
+    assert other["lat_e2e"]["buckets"][0] == 300 - 15
+    # under the cap: untouched (incl. a pre-existing _other_ row)
+    assert _fold_labeled_rows(rows[:10], cap=16) == rows[:10]
+
+
+def test_sanitize_label():
+    assert _sanitize_label("client.admin") == "client.admin"
+    assert _sanitize_label("a\nb\x00c\x7fd") == "abcd"
+    assert len(_sanitize_label("x" * 500)) == 120
+
+
+# -- metrics history ---------------------------------------------------------
+
+def test_metrics_history_ring_eviction_and_rates():
+    h = MetricsHistory(max_samples=4, max_series=100)
+    for ts in range(10):
+        h.add_report("osd.0", float(ts),
+                     {"osd": {"op": ts * 10, "op_w_bytes": ts * 100}})
+    s = h.series("osd.op", daemon="osd.0")
+    assert len(s) == 4, "ring must evict down to max_samples"
+    assert s[-1] == (9.0, 90.0)
+    # rate between the last two samples, per second
+    assert h.rate("osd.op") == {"osd.0": pytest.approx(10.0)}
+    assert h.rate("osd.op", daemon="osd.0") == pytest.approx(10.0)
+    # since= filters (incremental-poll idiom)
+    assert [v for _t, v in h.series("osd.op", daemon="osd.0",
+                                    since=7.5)] == [80.0, 90.0]
+    # counter reset (daemon restart) clamps to 0, never negative
+    h.add_report("osd.0", 10.0, {"osd": {"op": 0}})
+    assert h.rate("osd.op", daemon="osd.0") == 0.0
+    # staleness: a daemon whose newest sample is old drops out
+    assert h.rate("osd.op", max_age=5.0, now=100.0) == {}
+
+
+def test_metrics_history_dedup_caps_and_flatten():
+    h = MetricsHistory(max_samples=8, max_series=3)
+    hist_dump = {"count": 5, "sum": 0.25, "buckets": [5]}
+    h.add_report("osd.0", 1.0, {"osd": {"op": 1,
+                                        "lat": hist_dump}})
+    # duplicate delivery of the same report ts is ignored
+    h.add_report("osd.0", 1.0, {"osd": {"op": 999}})
+    assert h.series("osd.op", daemon="osd.0") == [(1.0, 1.0)]
+    # histograms flatten to .count/.sum sub-series
+    assert h.latest("osd.lat.count", "osd.0") == (1.0, 5.0)
+    # max_series cap: the 4th distinct series is dropped and counted
+    h.add_report("osd.1", 1.0, {"osd": {"op": 1, "x": 2}})
+    st = h.stats()
+    assert st["series"] == 3 and st["dropped_series"] >= 1
+    h.forget_daemon("osd.0")
+    assert "osd.0" not in h.daemons()
+
+
+def test_metrics_history_forgets_dead_daemons():
+    """A daemon silent past forget_age is dropped at the next ingest,
+    FREEING its max_series slots (daemon churn must not permanently
+    exhaust the cap)."""
+    h = MetricsHistory(max_samples=4, max_series=2, forget_age=100.0)
+    h.add_report("osd.dead", 0.0, {"osd": {"op": 1, "op_w": 1}})
+    assert h.stats()["series"] == 2  # cap full
+    # a new daemon 200s later: the dead one is forgotten, slots freed
+    h.add_report("osd.new", 200.0, {"osd": {"op": 5, "op_w": 5}})
+    assert h.daemons() == ["osd.new"]
+    assert h.latest("osd.op", "osd.new") == (200.0, 5.0)
+
+
+def test_fairness_ratio_surfaces_total_starvation():
+    """A fully starved client appears with ops=0 and forces
+    fairness_ratio to None — starvation must fail a `<= X` gate, not
+    pass it by omission (review finding)."""
+    from ceph_tpu.bench.traffic import per_client_stats
+
+    rows, fairness = per_client_stats([[0.01] * 10, []])
+    assert rows["1"] == {"ops": 0, "p50_ms": None, "p99_ms": None}
+    assert fairness is None
+    rows, fairness = per_client_stats([[0.01] * 30, [0.01] * 10])
+    assert fairness == pytest.approx(3.0)
+
+
+def test_iostat_module_reads_shared_history():
+    """The refactored iostat has NO private value tracking — the data
+    lives in mgr.metrics_history (satellite: `_prev` deleted); only a
+    poll cursor remains, so a burst between two sample() calls is
+    never missed."""
+    from ceph_tpu.common.context import CephContext
+    from ceph_tpu.mgr.iostat_module import IostatModule
+
+    class FakeMgr:
+        cct = CephContext("mgr.test")
+        metrics_history = MetricsHistory()
+
+    mod = IostatModule(FakeMgr())
+    assert not hasattr(mod, "_prev")
+    h = FakeMgr.metrics_history
+    now = time.monotonic()
+
+    def report(ts, n):
+        h.add_report("osd.0", ts, {"osd": {"op": n, "op_w": n,
+                                           "op_r": 0, "op_r_bytes": 0,
+                                           "op_w_bytes": n * 256}})
+
+    report(now - 4.0, 0)
+    prime = mod.sample()  # first call primes the cursor, reports zeros
+    assert prime["daemons"] == {}
+    # a burst lands across SEVERAL reports between two polls: the
+    # cursor rate must cover all of it (the last-two-reports trap)
+    report(now - 2.0, 40)
+    report(now, 40)  # burst over; newest pair alone would rate 0
+    s = mod.sample()
+    assert s["wr_ops_per_s"] == pytest.approx(10.0, rel=0.01)
+    assert s["wr_bytes_per_s"] == pytest.approx(2560.0, rel=0.01)
+    assert s["daemons"]["osd.0"]["op"] == pytest.approx(10.0, rel=0.01)
+    # no new report since: daemon omitted, cursor intact
+    assert mod.sample()["daemons"] == {}
+    report(now + 2.0, 50)
+    assert mod.sample()["daemons"]["osd.0"]["op"] == pytest.approx(
+        5.0, rel=0.01)
+
+
+# -- tail sampling (unit) ----------------------------------------------------
+
+def _span(ctx, name, entity="t"):
+    sp = TRACER.begin(ctx, name, entity=entity)
+    TRACER.end(sp)
+    return sp
+
+
+def test_tracer_provisional_promote_and_discard():
+    TRACER.enable(True)
+    TRACER.clear()
+    try:
+        from ceph_tpu.common.tracer import sampled_ctx
+
+        # rate=0 + tail: a provisional ctx, spans buffer aside
+        ctx = sampled_ctx(0.0, tail=True)
+        assert ctx is not None and TRACER.is_provisional(ctx.trace_id)
+        _span(ctx, "op_submit")
+        assert TRACER.spans(trace_id=ctx.trace_id) == []
+        # promotion moves the buffer into the real spans retroactively
+        assert TRACER.promote(ctx.trace_id, reason="test")
+        kept = TRACER.spans(trace_id=ctx.trace_id)
+        assert len(kept) == 1
+        assert kept[0]["tags"]["tail_promoted"] == "test"
+        # later spans of a promoted trace record directly
+        _span(TraceCtx(ctx.trace_id, None), "late")
+        assert len(TRACER.spans(trace_id=ctx.trace_id)) == 2
+        # a promoted trace cannot be discarded (primary's verdict wins)
+        assert not TRACER.discard(ctx.trace_id)
+
+        # discard path: buffered spans vanish, stragglers drop too
+        ctx2 = sampled_ctx(0.0, tail=True)
+        _span(ctx2, "op_submit")
+        assert TRACER.discard(ctx2.trace_id)
+        _span(TraceCtx(ctx2.trace_id, None), "straggler")
+        assert TRACER.spans(trace_id=ctx2.trace_id) == []
+
+        # rate=0 without tail stays the old no-context behavior
+        assert sampled_ctx(0.0, tail=False) is None
+    finally:
+        TRACER.enable(False)
+        TRACER.clear()
+
+
+def test_tracked_op_sticky_slow_and_stage_attribution():
+    tr = OpTracker(history_size=8, complaint_time=0.05,
+                   recent_slow_window=60.0)
+    op = tr.create("osd_op(write_full 1.x tid=1)")
+    op.stage_add("encode", 0.002)
+    op.stage_add("subop", 0.09)
+    op.stage_add("subop", 0.01)
+    time.sleep(0.07)
+    op.finish()
+    # completed: gone from the in-flight slow list...
+    assert tr.slow_ops() == []
+    # ...but the sticky count holds it until the window decays
+    assert tr.slow_op_count() == 1
+    assert tr.slow_op_count(now=time.time() + 120.0) == 0
+    dump = tr.dump_historic_slow_ops(with_traces=False)
+    assert dump["num_ops"] == 1
+    entry = dump["ops"][0]
+    assert entry["dominant_stage"] == "subop"
+    assert entry["stages"]["subop"] == pytest.approx(100.0, rel=0.01)
+    # detail lines name the dominant stage (SLOW_OPS health surface)
+    lines = tr.slow_summaries()
+    assert lines and "dominant stage subop" in lines[0]
+    # a fast op stays out of the slow history
+    tr.create("osd_op(read 1.y tid=2)").finish()
+    assert tr.dump_historic_slow_ops(with_traces=False)["num_ops"] == 1
+
+
+# -- tail promotion end to end (trace_sampling_rate=0) -----------------------
+
+@pytest.mark.cluster
+def test_tail_promotion_yields_connected_multi_entity_tree():
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    TRACER.enable(False)
+    TRACER.clear()
+    try:
+        with LocalCluster(
+            n_mons=1, n_osds=4,
+            conf_overrides={
+                "trace_enabled": True,
+                "trace_sampling_rate": 0.0,   # head sampling says NO
+                "trace_tail_latency_ms": 0.01,  # ...every op crosses it
+            },
+        ) as c:
+            c.create_ec_pool("tail_ec", k=2, m=1, pg_num=8)
+            io = c.client("client.tail").open_ioctx("tail_ec")
+            io.write_full("tail-slow", b"t" * 4096)
+            spans = TRACER.spans()
+            conn = connected_traces(spans)
+            assert conn, ("tail promotion must keep the trace at "
+                          f"sampling=0: {sorted(s['name'] for s in spans)}")
+            mine = [s for s in spans if s["trace_id"] == conn[0]]
+            entities = {s["entity"] for s in mine}
+            assert any(e.startswith("client.") for e in entities)
+            assert sum(1 for e in entities if e.startswith("osd.")) >= 2
+            # the op's historic record links to the same trace
+            prim = next(o for o in c.osds.values()
+                        if any("tail-slow" in op["description"]
+                               for op in
+                               o.op_tracker.dump_historic_ops()["ops"]
+                               if op["description"].startswith("osd_op")))
+            rec = next(op for op in
+                       prim.op_tracker.dump_historic_ops()["ops"]
+                       if "tail-slow" in op["description"])
+            assert rec.get("trace_id") == conn[0]
+
+            # raise the threshold sky-high: fast ops now DISCARD — no
+            # span survives for an op that lost both coin flip and tail
+            for cct in [o.cct for o in c.osds.values()] + [io._client.cct]:
+                cct.conf.set("trace_tail_latency_ms", 1e9)
+            TRACER.clear()
+            io.write_full("tail-fast", b"f" * 2048)
+            fast = [s for s in TRACER.spans()
+                    if (s.get("tags") or {}).get("oid") == "tail-fast"]
+            assert fast == [], "a fast op's provisional trace must drop"
+    finally:
+        TRACER.enable(False)
+        TRACER.clear()
