@@ -33,6 +33,13 @@ the tiny smoke configurations):
     python -m ceph_tpu.bench.traffic --cluster --sampling 1.0 \
         --trace-out /tmp/trace.json --json
     python -m ceph_tpu.bench.traffic --trace-smoke
+
+cephqos additions (docs/qos.md): ``--arrivals poisson --rate R`` makes
+each client OPEN-loop (seeded exponential gaps at R ops/s — offered
+load independent of service rate, the workload that exposes queueing),
+and ``--bully [--qos]`` runs the mixed-population fairness scenario (1
+heavy streamer vs N small Poisson writers on a real LocalCluster) that
+``qa/qos_smoke.py`` gates controller-on against controller-off.
 """
 from __future__ import annotations
 
@@ -127,16 +134,28 @@ def run_traffic(
     qd: int = 4,
     warmup: float = 0.25,
     sampling: float = 0.0,
+    arrivals: str = "closed",
+    rate: float = 100.0,
 ) -> dict:
-    """One mode's closed-loop run; returns ops/GiB-per-s/latency stats.
+    """One mode's run; returns ops/GiB-per-s/latency stats.
     sampling > 0 arms cephtrace, head-samples that fraction of ops, and
-    adds a per-stage p50/p99 breakdown to the result."""
+    adds a per-stage p50/p99 breakdown to the result.
+
+    ``arrivals``: "closed" (the original closed-loop writers — every
+    client keeps ``qd`` writes in flight, so offered load tracks
+    service rate) or "poisson" (OPEN-loop: each client draws seeded
+    exponential inter-arrival gaps at ``rate`` ops/s and submits on
+    schedule regardless of completions, up to ``qd`` outstanding —
+    offered load is independent of the system, which is the workload
+    that exposes queueing; a backlogged client notes its lateness in
+    ``sched_lag_ms`` instead of silently slowing down)."""
     from ..common.context import CephContext
     from ..gf.matrix import cauchy_good_coding_matrix
     from ..ops.bitplane import apply_matrix_jax
     from ..osd.write_batcher import WriteBatcher
 
     assert mode in ("batched", "perop"), mode
+    assert arrivals in ("closed", "poisson"), arrivals
     mat = np.ascontiguousarray(cauchy_good_coding_matrix(k, m), np.uint8)
     L = _chunk_len(write_size, k)
     rng = np.random.default_rng(1234)
@@ -163,16 +182,21 @@ def run_traffic(
     stop_at = [0.0]
     start_gate = threading.Event()
     lats: list[list[float]] = [[] for _ in range(n_clients)]
+    sched_lag: list[float] = [0.0] * n_clients  # poisson backlog, seconds
 
     def client(i: int) -> None:
-        # each simulated client keeps `qd` writes in flight (the async
-        # window a real Objecter's inflight budget allows), completing
-        # oldest-first — submit-to-parity latency per op
+        # closed mode: each simulated client keeps `qd` writes in
+        # flight (the async window a real Objecter's inflight budget
+        # allows), completing oldest-first — submit-to-parity latency
+        # per op.  poisson mode: submissions follow a seeded
+        # exponential-gap schedule instead of the completion clock.
         from collections import deque
 
         my = lats[i]
         inflight: deque = deque()
         n = 0
+        arr_rng = np.random.default_rng(9000 + i)
+        next_due = None  # poisson schedule, monotonic clock
 
         def submit(x):
             root = (TRACER.begin(sampled_ctx(sampling), "op_submit",
@@ -191,7 +215,22 @@ def run_traffic(
             my.append(time.perf_counter() - t0)
 
         start_gate.wait(timeout=30.0)
+        if arrivals == "poisson":
+            next_due = time.monotonic()
         while time.monotonic() < stop_at[0]:
+            if arrivals == "poisson":
+                now = time.monotonic()
+                if now < next_due:
+                    time.sleep(min(next_due - now, 0.05))
+                    continue
+                sched_lag[i] = max(sched_lag[i], now - next_due)
+                next_due += float(arr_rng.exponential(1.0 / max(rate, 1e-6)))
+                if len(inflight) >= qd:
+                    finish(*inflight.popleft())  # cap outstanding
+                x = pool[(i + n) % len(pool)]
+                n += 1
+                inflight.append(submit(x))
+                continue
             while len(inflight) < qd and time.monotonic() < stop_at[0]:
                 x = pool[(i + n) % len(pool)]
                 n += 1
@@ -227,6 +266,7 @@ def run_traffic(
     stats = batcher.stats()
     out = {
         "mode": mode,
+        "arrivals": arrivals,
         "clients": n_clients,
         "write_size": write_size,
         "seconds": round(elapsed, 3),
@@ -239,6 +279,9 @@ def run_traffic(
         if stats["flushes"] else None,
     }
     out["per_client"], out["fairness_ratio"] = per_client_stats(lats)
+    if arrivals == "poisson":
+        out["target_rate"] = rate
+        out["sched_lag_ms"] = round(max(sched_lag) * 1e3, 3)
     if sampling > 0.0:
         spans = TRACER.spans()
         LAST_SPANS[:] = spans
@@ -381,6 +424,199 @@ def run_cluster_traffic(
     return out
 
 
+def run_bully_traffic(
+    n_small: int = 3,
+    seconds: float = 4.0,
+    bully_streams: int = 6,
+    bully_size: int = 1 << 16,
+    small_size: int = 4096,
+    small_rate: float = 10.0,
+    k: int = 2,
+    m: int = 1,
+    n_osds: int | None = None,
+    qos: bool = False,
+    settle: float = 0.0,
+    conf_overrides: dict | None = None,
+) -> dict:
+    """The mixed-population fairness scenario (ROADMAP closed-loop QoS;
+    docs/qos.md): ONE heavy streamer (``client.bully`` — bully_streams
+    closed-loop threads of bully_size writes, offered load limited only
+    by service rate) against N small writers (``client.small<i>`` —
+    open-loop Poisson arrivals at small_rate ops/s of small_size
+    writes, the workload a million light tenants offer).  Runs on a
+    REAL LocalCluster (mgr hosted) so the cephqos machinery under test
+    is the production path: per-client mClock classes, the batcher
+    admission share, and — with ``qos=True`` — the live controller
+    retuning both from its own telemetry.
+
+    The headline numbers: pooled victim p50/p99, the cephmeter
+    ``fairness_ratio`` across every client (bully included — the bully
+    driving it far above 1 is exactly the regression the QoS gate
+    watches), ``bully_dominance`` (bully ops over mean victim ops),
+    and aggregate GiB/s (fairness must not be bought with throughput —
+    the gate's 10% budget)."""
+    from ..qa.vstart import LocalCluster
+
+    if n_osds is None:
+        n_osds = k + m + 1
+    overrides = {
+        "mgr_report_interval": 0.2,
+        "mgr_digest_interval": 0.5,
+        # controller cadence fast enough to converge inside the run
+        "mgr_qos_interval": 0.3,
+        "mgr_qos_active": qos,
+        "osd_mclock_client_classes": qos,
+        # the measured sweet spot (docs/qos.md): 3 execution slots make
+        # the tags bite without serializing the bully's streams
+        "osd_mclock_client_slots": 3,
+        # off = pre-cephqos admission (one FIFO, no per-client share)
+        "ec_batch_client_max_share": 0.25 if qos else 1.0,
+        **(conf_overrides or {}),
+    }
+    lats: list[list[float]] = [[] for _ in range(n_small + 1)]  # [0]=bully
+    stop_at = [0.0]
+    start_gate = threading.Event()
+    warm_gate = threading.Barrier(n_small + bully_streams + 1)
+
+    with LocalCluster(n_mons=1, n_osds=n_osds, with_mgr=True,
+                      conf_overrides=overrides) as cluster:
+        cluster.create_ec_pool("bully", k=k, m=m, pg_num=8)
+        bully_payload = b"B" * bully_size
+        small_payloads = [bytes([i % 251] * small_size) for i in range(8)]
+        bully_io = cluster.client("client.bully").open_ioctx("bully")
+        small_ios = [cluster.client(f"client.small{i}").open_ioctx("bully")
+                     for i in range(n_small)]
+
+        def bully(stream: int) -> None:
+            my = lats[0]
+            n = 0
+            try:
+                bully_io.write_full(f"b{stream}-w", bully_payload)
+            except Exception as e:
+                print(f"# bully warm write failed: {e!r}", file=sys.stderr)
+            finally:
+                try:
+                    warm_gate.wait(timeout=60.0)
+                except threading.BrokenBarrierError:
+                    pass
+            start_gate.wait(timeout=60.0)
+            while time.monotonic() < stop_at[0]:
+                t0 = time.perf_counter()
+                try:
+                    bully_io.write_full(f"b{stream}-{n % 8}", bully_payload)
+                except Exception as e:
+                    print(f"# bully write failed: {e!r}", file=sys.stderr)
+                    return
+                my.append(time.perf_counter() - t0)
+                n += 1
+
+        def small(i: int) -> None:
+            io = small_ios[i]
+            my = lats[i + 1]
+            rng = np.random.default_rng(7000 + i)
+            n = 0
+            try:
+                io.write_full(f"s{i}-w", small_payloads[0])
+            except Exception as e:
+                print(f"# small {i} warm write failed: {e!r}",
+                      file=sys.stderr)
+            finally:
+                try:
+                    warm_gate.wait(timeout=60.0)
+                except threading.BrokenBarrierError:
+                    pass
+            start_gate.wait(timeout=60.0)
+            # open-loop Poisson: submit on the arrival schedule with
+            # catch-up (a backlogged victim's waits show up as latency,
+            # not as silently reduced offered load)
+            next_due = time.monotonic()
+            while time.monotonic() < stop_at[0]:
+                now = time.monotonic()
+                if now < next_due:
+                    time.sleep(min(next_due - now, 0.02))
+                    continue
+                next_due += float(
+                    rng.exponential(1.0 / max(small_rate, 1e-6)))
+                t0 = time.perf_counter()
+                try:
+                    io.write_full(f"s{i}-{n % 8}", small_payloads[n % 8])
+                except Exception as e:
+                    print(f"# small {i} write failed: {e!r}",
+                          file=sys.stderr)
+                    return
+                my.append(time.perf_counter() - t0)
+                n += 1
+
+        threads = [threading.Thread(target=bully, args=(s,), daemon=True,
+                                    name=f"bully-{s}")
+                   for s in range(bully_streams)]
+        threads += [threading.Thread(target=small, args=(i,), daemon=True,
+                                     name=f"small-{i}")
+                    for i in range(n_small)]
+        for t in threads:
+            t.start()
+        try:
+            warm_gate.wait(timeout=120.0)
+        except threading.BrokenBarrierError:
+            pass
+        # settle: traffic flows UNMEASURED while the controller observes
+        # its first report deltas and pushes (qos runs need ~2 report
+        # intervals + a controller tick before classes/window land)
+        stop_at[0] = time.monotonic() + settle + seconds
+        start_gate.set()
+        if settle > 0:
+            time.sleep(settle)
+        for lat in lats:
+            lat.clear()
+        t_begin = time.monotonic()
+        for t in threads:
+            t.join(timeout=settle + seconds + 120.0)
+        elapsed = max(time.monotonic() - t_begin, 1e-9)
+        qos_status = None
+        sched_dump = None
+        if cluster.mgr is not None:
+            try:
+                qos_status = cluster.mgr.module("qos").status()
+            except KeyError:
+                qos_status = None  # qos module not hosted this run
+        if cluster.osds:
+            sched_dump = next(iter(
+                cluster.osds.values())).scheduler.dump()
+
+    bully_ops = len(lats[0])
+    small_lats = sorted(x for lat in lats[1:] for x in lat)
+    small_ops = len(small_lats)
+    vp50, vp99 = _pctiles(small_lats)
+    bl = sorted(lats[0])
+    bp50, bp99 = _pctiles(bl)
+    per_client, fairness = per_client_stats(lats)
+    agg_bytes = bully_ops * bully_size + small_ops * small_size
+    out = {
+        "mode": "bully",
+        "qos": qos,
+        "seconds": round(elapsed, 3),
+        "bully_streams": bully_streams,
+        "bully_size": bully_size,
+        "n_small": n_small,
+        "small_rate": small_rate,
+        "aggregate_gibps": round(agg_bytes / elapsed / 2**30, 5),
+        "bully_ops": bully_ops,
+        "bully_p50_ms": round(bp50 * 1e3, 3) if bp50 is not None else None,
+        "bully_p99_ms": round(bp99 * 1e3, 3) if bp99 is not None else None,
+        "victim_ops": small_ops,
+        "victim_offered": round(n_small * small_rate * elapsed, 1),
+        "victim_p50_ms": round(vp50 * 1e3, 3) if vp50 is not None else None,
+        "victim_p99_ms": round(vp99 * 1e3, 3) if vp99 is not None else None,
+        "bully_dominance": (round(bully_ops / (small_ops / n_small), 3)
+                            if small_ops else None),
+        "fairness_ratio": fairness,
+        "per_client": per_client,
+        "qos_status": qos_status,
+        "op_queue": sched_dump,
+    }
+    return out
+
+
 def trace_smoke(n_clients: int = 2, seconds: float = 2.0,
                 trace_out: str | None = None) -> tuple[dict, int]:
     """The ci_gate tracing smoke: an untraced cluster run, then a
@@ -474,6 +710,25 @@ def main(argv=None) -> int:
     ap.add_argument("--max-bytes", type=int, default=8 << 20)
     ap.add_argument("--qd", type=int, default=4,
                     help="per-client async window (writes in flight)")
+    ap.add_argument("--arrivals", choices=("closed", "poisson"),
+                    default="closed",
+                    help="closed-loop writers (default) or open-loop "
+                    "Poisson arrivals at --rate ops/s per client")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="per-client arrival rate, ops/s (default 100 "
+                    "for --arrivals poisson against the bare batcher; "
+                    "10 for --bully's small writers — a real "
+                    "LocalCluster serves ~2 orders of magnitude less "
+                    "than the in-process batcher, and an open-loop "
+                    "rate past its capacity measures only the backlog)")
+    ap.add_argument("--bully", action="store_true",
+                    help="mixed-population fairness scenario on a real "
+                    "LocalCluster: 1 heavy streamer vs N small Poisson "
+                    "writers (--clients = small-writer count); "
+                    "--qos arms the closed-loop controller")
+    ap.add_argument("--qos", action="store_true",
+                    help="with --bully: per-client mClock classes + "
+                    "batcher share + live QoS controller")
     ap.add_argument("--sampling", type=float, default=0.0,
                     help="cephtrace head-sampling rate (0 = tracing "
                     "off); >0 adds a per-stage p50/p99 breakdown")
@@ -501,12 +756,13 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    # cluster mode drives one daemon per shard: default to a geometry a
-    # smoke-sized cluster can host
+    # cluster-backed modes drive one daemon per shard: default to a
+    # geometry a smoke-sized cluster can host (RS(8,4) would mean a
+    # 13-daemon in-process cluster — measured pathological)
     if args.k is None:
-        args.k = 2 if args.cluster else 8
+        args.k = 2 if (args.cluster or args.bully) else 8
     if args.m is None:
-        args.m = 1 if args.cluster else 4
+        args.m = 1 if (args.cluster or args.bully) else 4
     if args.trace_smoke:
         res, rc = trace_smoke(args.clients, args.seconds,
                               trace_out=args.trace_out)
@@ -522,7 +778,15 @@ def main(argv=None) -> int:
                   f"connected traces, overhead {res['tracing_overhead']}",
                   file=sys.stderr)
         return rc
-    if args.cluster:
+    if args.bully:
+        res = run_bully_traffic(n_small=max(1, args.clients),
+                                seconds=args.seconds,
+                                small_size=args.write_size,
+                                small_rate=(args.rate if args.rate
+                                            is not None else 10.0),
+                                k=args.k, m=args.m, qos=args.qos,
+                                settle=1.5 if args.qos else 0.0)
+    elif args.cluster:
         res = run_cluster_traffic(args.clients, args.seconds,
                                   args.write_size, args.k, args.m,
                                   sampling=args.sampling)
@@ -533,6 +797,15 @@ def main(argv=None) -> int:
                           args.write_size, args.k, args.m, args.window_ms,
                           args.max_stripes, args.max_bytes, args.qd,
                           sampling=args.sampling)
+    elif args.arrivals == "poisson":
+        # open-loop single-mode run: offered load independent of
+        # service rate (the queueing-exposing workload)
+        res = run_traffic("batched", args.clients, args.seconds,
+                          args.write_size, args.k, args.m, args.window_ms,
+                          args.max_stripes, args.max_bytes, args.qd,
+                          arrivals="poisson",
+                          rate=(args.rate if args.rate is not None
+                                else 100.0))
     else:
         res = run_scenario(args.clients, args.seconds, args.write_size,
                            args.k, args.m, args.window_ms, args.max_stripes,

@@ -548,6 +548,31 @@ def unbind(cct) -> None:
         _registry.remove(name, match=match)
 
 
+def apply_runtime_options(cct, pairs) -> dict:
+    """Validated runtime config application — the injectargs core,
+    shared by the admin-socket command and the QoS controller's
+    MQoSSettings push (both are 'injectargs over a different
+    transport').  Validates the WHOLE list (existence, runtime flag,
+    value parse) before applying anything: a bad option mid-list must
+    not leave the earlier ones silently applied behind an error."""
+    pairs = [(name, value) for name, value in pairs]
+    for name, value in pairs:
+        opt = cct.conf.table.get(name)
+        if not opt.runtime:
+            raise ValueError(
+                f"option {name!r} is not runtime-updatable"
+            )
+        opt.parse(value)
+        if name == "failpoint":
+            # opt.parse only checks it's a string; the observer
+            # raising on a bad spec mid-apply would break the
+            # nothing-applied-on-error contract
+            parse_failpoint_option(value)
+    return {
+        name: cct.conf.set(name, value) for name, value in pairs
+    }
+
+
 def register_admin_commands(cct) -> None:
     """`failpoint set|add|rm|list|seed` + `injectargs` on a daemon's admin
     socket (reference: ceph's `ceph daemon ... config set` /
@@ -600,24 +625,7 @@ def register_admin_commands(cct) -> None:
                 value = argv[i + 1]
                 i += 2
             pairs.append((name.replace("-", "_"), value))
-        # validate the WHOLE list (existence, runtime flag, value parse)
-        # before applying anything: a bad option mid-list must not leave
-        # the earlier ones silently applied behind an error reply
-        for name, value in pairs:
-            opt = cct.conf.table.get(name)
-            if not opt.runtime:
-                raise ValueError(
-                    f"option {name!r} is not runtime-updatable"
-                )
-            opt.parse(value)
-            if name == "failpoint":
-                # opt.parse only checks it's a string; the observer
-                # raising on a bad spec mid-apply would break the
-                # nothing-applied-on-error contract
-                parse_failpoint_option(value)
-        return {
-            name: cct.conf.set(name, value) for name, value in pairs
-        }
+        return apply_runtime_options(cct, pairs)
 
     ask.register_command(
         "failpoint", _fp_cmd,

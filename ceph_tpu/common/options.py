@@ -143,6 +143,29 @@ def default_options() -> OptionTable:
                    "entries; overflow evicts the least-recently-used "
                    "non-heavy-hitter into the _other_ bucket (sums "
                    "preserved)", min=1),
+            Option("osd_mclock_client_classes", bool, True,
+                   "cephqos: route client ops through DYNAMIC per-"
+                   "(client,pool) mClock classes keyed by the cephmeter "
+                   "accounting identity, so the QoS controller can "
+                   "retune individual tenants (osd/scheduler.py; "
+                   "docs/qos.md).  False = the single static 'client' "
+                   "class (pre-cephqos behavior).  Read at daemon "
+                   "construction"),
+            Option("osd_mclock_client_slots", int, 8,
+                   "concurrent client-op executions per OSD for ops in "
+                   "DYNAMIC per-client classes: while all slots are "
+                   "busy, dynamic classes are ineligible to dequeue, "
+                   "so the mClock tags (not thread-spawn order) decide "
+                   "who runs next under saturation.  Internal OSD-to-"
+                   "OSD forwards and background work are exempt.  0 = "
+                   "unbounded (pre-cephqos).  Read at daemon "
+                   "construction", min=0),
+            Option("osd_mclock_max_client_classes", int, 32,
+                   "bounded cardinality of dynamic per-client mClock "
+                   "classes per OSD: past the bound the least-recently-"
+                   "enqueued class retires into the _default_ catch-all "
+                   "(queued ops and stats fold, counts conserved).  "
+                   "Read at daemon construction", min=1),
             Option("osd_subop_reply_timeout", float, 10.0,
                    "DEFAULT seconds a primary waits for one shard "
                    "sub-op reply before treating the shard as failed; "
@@ -202,7 +225,7 @@ def default_options() -> OptionTable:
                    min=0.05),
             Option("mgr_modules", str,
                    "status,prometheus,balancer,iostat,quota,"
-                   "metrics_history",
+                   "metrics_history,qos",
                    "comma-separated modules the mgr hosts"),
             Option("rgw_lc_interval", float, 5.0,
                    "seconds between lifecycle passes (upstream: daily)",
@@ -230,6 +253,50 @@ def default_options() -> OptionTable:
                    "store tracks; series beyond the cap are dropped "
                    "and counted (bounded memory under runaway "
                    "cardinality)", min=1),
+            # -- cephqos controller (mgr/qos_module.py; docs/qos.md) -------
+            Option("mgr_qos_interval", float, 2.0,
+                   "seconds between QoS controller ticks (observe "
+                   "telemetry -> plan -> push MQoSSettings)", min=0.1,
+                   runtime=True),
+            Option("mgr_qos_active", bool, False,
+                   "QoS controller pushes retuned settings to OSDs "
+                   "(false = observe and export ceph_qos_* series "
+                   "only — the balancer's dry-run precedent)",
+                   runtime=True),
+            Option("mgr_qos_queue_p99_target_ms", float, 50.0,
+                   "stage_queue p99 the controller holds the write "
+                   "path under: overshoot shrinks the coalescing "
+                   "window multiplicatively; headroom lets it follow "
+                   "the arrival-matched ideal", min=0.1, runtime=True),
+            Option("mgr_qos_window_min_ms", float, 0.5,
+                   "lower clamp on controller-set ec_batch_window_ms",
+                   min=0.0, runtime=True),
+            Option("mgr_qos_window_max_ms", float, 20.0,
+                   "upper clamp on controller-set ec_batch_window_ms",
+                   min=0.1, runtime=True),
+            Option("mgr_qos_stripes_min", int, 8,
+                   "lower clamp on controller-set ec_batch_max_stripes",
+                   min=1, runtime=True),
+            Option("mgr_qos_stripes_max", int, 256,
+                   "upper clamp on controller-set ec_batch_max_stripes",
+                   min=1, runtime=True),
+            Option("mgr_qos_bully_factor", float, 4.0,
+                   "a client whose write-op rate exceeds this factor "
+                   "x the median of its peers is classed HEAVY (low "
+                   "mClock weight, no hard limit — work-conserving)",
+                   min=1.0, runtime=True),
+            Option("mgr_qos_heavy_weight", float, 5.0,
+                   "mClock weight the controller assigns heavy "
+                   "clients (vs the per-client default of 10).  The "
+                   "default is deliberately gentle — half weight plus "
+                   "the victims' reservation floor measured enough to "
+                   "triple victim p99 without costing aggregate "
+                   "throughput (qa/qos_smoke.py); crank it down for "
+                   "harder isolation", min=0.001, runtime=True),
+            Option("mgr_qos_victim_reservation", float, 40.0,
+                   "ops/s reservation floor the controller assigns "
+                   "non-heavy clients while any heavy client is "
+                   "present", min=0.0, runtime=True),
             Option("mgr_dashboard_port", int, 0,
                    "dashboard HTTP port (0 = ephemeral)"),
             Option("mgr_devicehealth_self_heal", bool, True,
@@ -306,6 +373,15 @@ def default_options() -> OptionTable:
                    "through them client admission, when the encode "
                    "stage falls behind.  0 = unbounded", min=0,
                    runtime=True),
+            Option("ec_batch_client_max_share", float, 0.5,
+                   "cephqos: fraction of the write batcher's admission "
+                   "budget one (client,pool) identity may hold; ops "
+                   "past the share wait for their OWN bytes to drain "
+                   "before entering the global FIFO throttle, so one "
+                   "bulk streamer cannot crowd small writers out of "
+                   "admission (osd/write_batcher.py; docs/qos.md).  "
+                   ">= 1.0 disables the per-client share",
+                   min=0.01, runtime=True),
             Option("kernel_telemetry", bool, True,
                    "per-kernel dispatch telemetry registry "
                    "(common/kernel_telemetry.py): invocation counts, "

@@ -24,6 +24,7 @@ from . import iostat_module  # noqa: F401
 from . import quota_module  # noqa: F401
 from . import pg_autoscaler_module  # noqa: F401
 from . import prometheus_module  # noqa: F401
+from . import qos_module  # noqa: F401
 from . import status_module  # noqa: F401
 from .metrics_history import MetricsHistory  # also registers the module
 
@@ -39,6 +40,10 @@ class MgrDaemon(Dispatcher):
         )
         self._reports: dict[str, dict] = {}   # daemon -> last MMgrReport view
         self._reports_lock = threading.Lock()
+        # cephqos: the connection each daemon's last report arrived on —
+        # the controller's push channel back to it (MQoSSettings rides
+        # the report plumbing instead of dialing admin sockets)
+        self._report_conns: dict[str, object] = {}
         # cephmeter: the bounded time-series ring every history consumer
         # (iostat, `perf history`, future QoS controllers) queries — fed
         # synchronously per incoming MMgrReport, daemon-owned so it
@@ -135,6 +140,7 @@ class MgrDaemon(Dispatcher):
                     "epoch": msg.epoch,
                     "ts": ts,
                 }
+                self._report_conns[msg.daemon] = conn
             # one history sample per report, stamped with the ARRIVAL
             # time (rates divide by the report interval, not a sampling
             # cadence) — outside the reports lock; the store has its own
@@ -142,6 +148,40 @@ class MgrDaemon(Dispatcher):
                 msg.daemon, ts, msg.counters or {})
             return True
         return False
+
+    def report_conns(self, prefix: str = "") -> dict:
+        """{daemon: connection} of the freshest report senders (optionally
+        filtered by name prefix, e.g. "osd.") — the QoS controller's
+        push fan-out.  Staleness mirrors latest_reports: a dead daemon's
+        conn must not be dialed forever."""
+        max_age = self.cct.conf.get("mgr_stale_report_age")
+        now = time.monotonic()
+        with self._reports_lock:
+            return {
+                d: c for d, c in self._report_conns.items()
+                if d.startswith(prefix)
+                and d in self._reports
+                and now - self._reports[d]["ts"] <= max_age
+            }
+
+    def ingest_local_report(self, daemon: str, counters: dict,
+                            schema: dict | None = None,
+                            stats: dict | None = None) -> None:
+        """Feed a report authored INSIDE the mgr process (the QoS
+        module's ceph_qos_* series) through the same sink daemon
+        reports take: it lands in the latest-reports view (so the
+        prometheus exporter renders it) AND the metrics-history ring
+        (so the controller's own decisions are queryable history)."""
+        ts = time.monotonic()
+        with self._reports_lock:
+            self._reports[daemon] = {
+                "counters": counters or {},
+                "schema": schema or {},
+                "stats": stats or {},
+                "epoch": 0,
+                "ts": ts,
+            }
+        self.metrics_history.add_report(daemon, ts, counters or {})
 
     def latest_reports(self) -> dict:
         """{daemon: {subsystem: {counter: value}}}, stale reports dropped

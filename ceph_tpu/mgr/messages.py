@@ -21,3 +21,20 @@ class MMgrReport(_JsonMessage):
 
     MSG_TYPE = 120
     FIELDS = ("daemon", "counters", "epoch", "stats", "schema")
+
+
+@register_message
+class MQoSSettings(_JsonMessage):
+    """Mgr -> daemon QoS retune push (cephqos; docs/qos.md).
+
+    Rides BACK over the connection the daemon's MMgrReport arrived on
+    (no new dialing, no admin-socket dependency).  ``options`` is a
+    {name: value} map applied through the daemon's injectargs core
+    (validate-all-then-apply, runtime options only); ``classes`` maps
+    an mClock class name — the cephmeter "client/pool" identity — to
+    its [reservation, weight, limit]; ``qos_epoch`` is the controller's
+    monotonically increasing push counter, so a stale/reordered push
+    never rolls settings back."""
+
+    MSG_TYPE = 122
+    FIELDS = ("qos_epoch", "options", "classes")

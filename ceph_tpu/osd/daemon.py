@@ -71,8 +71,9 @@ from .messages import (
     MScrubShard,
     MScrubShardReply,
 )
+from ..mgr.messages import MQoSSettings
 from .pg_log import LogEntry, PGLog
-from .scheduler import MClockScheduler, QoSParams
+from .scheduler import MClockScheduler, QoSParams, SchedulerPerf
 from .ec_backend import ECBackendMixin
 from .object_ops import ObjectOpsMixin
 from .pg import (  # noqa: F401  (re-exported: long-standing import surface)
@@ -180,14 +181,43 @@ class OSD(
         self._recovery_wakeup = threading.Event()
         # mClock QoS dispatch (reference: osd_mclock_profile
         # balanced-ish): client I/O keeps a reservation floor; recovery
-        # and scrub share leftovers under ceilings
-        self.scheduler = MClockScheduler({
-            "client": QoSParams(reservation=100.0, weight=10.0),
-            "background_recovery": QoSParams(
-                reservation=10.0, weight=2.0, limit=200.0
+        # and scrub share leftovers under ceilings.  cephqos grows the
+        # client side into bounded DYNAMIC per-(client,pool) classes
+        # (keyed by the cephmeter accounting identity) so the mgr's QoS
+        # controller can retune individual tenants; the background
+        # classes stay static and keep their floors (docs/qos.md)
+        self._qos_classes = bool(cct.conf.get("osd_mclock_client_classes"))
+        self.scheduler = MClockScheduler(
+            {
+                "client": QoSParams(reservation=100.0, weight=10.0),
+                "background_recovery": QoSParams(
+                    reservation=10.0, weight=2.0, limit=200.0
+                ),
+                "background_scrub": QoSParams(weight=1.0, limit=50.0),
+            },
+            max_dynamic=(
+                int(cct.conf.get("osd_mclock_max_client_classes"))
+                if self._qos_classes else 0
             ),
-            "background_scrub": QoSParams(weight=1.0, limit=50.0),
-        })
+            # per-client default mirrors the static client class, so
+            # flipping dynamic classes on changes attribution, not QoS
+            dynamic_params=QoSParams(reservation=100.0, weight=10.0),
+            # bounded client-op execution (reference: osd_op_tp's fixed
+            # thread count): while all slots are busy, dynamic classes
+            # are ineligible to dequeue, so mClock's tags decide who
+            # runs NEXT — an unbounded pool would drain the queue
+            # instantly and the tags would order nothing.  Internal
+            # OSD-to-OSD forwards ride the exempt static "client"
+            # class (deadlock-free forwarding)
+            client_slots=int(cct.conf.get("osd_mclock_client_slots")),
+        )
+        # monotonically increasing settings epoch: stale controller
+        # pushes (reordered frames, a deposed mgr) must not roll QoS
+        # back; flipped under self._lock
+        self._qos_epoch = 0
+        # per-class depth/served/wait as labeled prometheus series
+        # (perf dump -> MMgrReport -> prometheus; docs/qos.md)
+        cct.perf.add(SchedulerPerf(self.scheduler))
         self._workers: list[threading.Thread] = []
         # op-thread watchdog (reference: HeartbeatMap / osd_op_thread_
         # timeout): _run_op stamps ident -> [name, class, start,
@@ -303,6 +333,13 @@ class OSD(
                 "(when cephtrace kept or tail-promoted the trace) the "
                 "assembled cross-entity trace tree",
             )
+            cct.admin_socket.register_command(
+                "dump_op_queue",
+                lambda c: self.scheduler.dump(),
+                "mClock per-class queue depth, served ops, wait "
+                "histograms, and (reservation, weight, limit) params "
+                "(docs/qos.md)",
+            )
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -369,19 +406,31 @@ class OSD(
             if picked is None:
                 continue
             cls, work = picked
-            if cls == "client":
-                # mClock orders ADMISSION; execution gets its own thread
-                # so a client op blocked on a slow peer's sub-op never
-                # pins a worker that background work (or the recovery
-                # that would fix the peer) needs
-                threading.Thread(
-                    target=self._run_op, args=(work,),
-                    name=f"{self.whoami}-op", daemon=True,
-                ).start()
-            else:
+            if cls in ("background_recovery", "background_scrub"):
                 # background work runs inline: worker count bounds its
                 # concurrency, which is the point of the QoS classes
                 self._run_op(work, cls)
+            else:
+                # client-side classes ("client", per-client dynamic,
+                # "_default_"): mClock orders ADMISSION; execution gets
+                # its own thread so a client op blocked on a slow
+                # peer's sub-op never pins a worker that background
+                # work (or the recovery that would fix the peer) needs.
+                # Dynamic-class ops consumed a client-op slot at the
+                # pick (the bound that makes the tags bite); the
+                # executor returns it via client_op_done()
+                threading.Thread(
+                    target=self._run_client_op,
+                    args=(work, cls, cls != "client"),
+                    name=f"{self.whoami}-op", daemon=True,
+                ).start()
+
+    def _run_client_op(self, work, cls: str, slotted: bool) -> None:
+        try:
+            self._run_op(work, cls)
+        finally:
+            if slotted and self.scheduler.client_slots > 0:
+                self.scheduler.client_op_done()
 
     def _run_op(self, work, cls: str = "client") -> None:
         th = threading.current_thread()
@@ -756,10 +805,24 @@ class OSD(
                     self._client_conns.pop(
                         next(iter(self._client_conns)), None)
             # client ops flow through the mClock queue (reference:
-            # OSD::ms_fast_dispatch -> op_shardedwq enqueue)
+            # OSD::ms_fast_dispatch -> op_shardedwq enqueue), under a
+            # per-(client,pool) dynamic class when cephqos is armed —
+            # the SAME identity the accounting table keys on, so the
+            # controller's retuned params land on the tenants its
+            # telemetry named (docs/qos.md)
+            qcls = "client"
+            if (self._qos_classes and src is not None
+                    and not src.startswith("osd.")):
+                # osd.* sources are internal forwards (split migration,
+                # clone staging): they stay on the exempt static class
+                # so a slot-full OSD can never deadlock a peer's op
+                qcls = self.scheduler.client_class(f"{src}/{msg.pool}")
             self.scheduler.enqueue(
-                "client", lambda: self._handle_client_op(conn, msg)
+                qcls, lambda: self._handle_client_op(conn, msg)
             )
+            return True
+        if isinstance(msg, MQoSSettings):
+            self._handle_qos_settings(msg)
             return True
         if isinstance(msg, MWatchNotifyAck):
             with self._watch_cond:
@@ -833,6 +896,55 @@ class OSD(
                     ).start()
             return True
         return False
+
+    def _handle_qos_settings(self, msg: MQoSSettings) -> None:
+        """Apply one controller push (mgr/qos_module.py): runtime
+        options go through the SAME validate-all-then-apply core as
+        injectargs; per-class (reservation, weight, limit) land on the
+        scheduler.  Epoch-guarded — a stale push (reordered frames, a
+        deposed mgr's last tick) must not roll settings back.  The
+        background classes' floors are never controller-writable."""
+        epoch = int(msg.qos_epoch or 0)
+        with self._lock:
+            if epoch <= self._qos_epoch:
+                return
+            self._qos_epoch = epoch
+        applied: dict = {}
+        try:
+            if msg.options:
+                from ..common.failpoint import apply_runtime_options
+
+                applied = apply_runtime_options(
+                    self.cct, sorted(msg.options.items()))
+        except Exception as e:
+            self.cct.dout("osd", 1,
+                          f"{self.whoami} qos push epoch {epoch} options "
+                          f"rejected: {e!r}")
+            TRACER.tracepoint("qos", "reject", entity=self.whoami,
+                              qos_epoch=epoch, error=repr(e))
+            return
+        n_classes = 0
+        for name, rwl in sorted((msg.classes or {}).items()):
+            if name in ("background_recovery", "background_scrub"):
+                continue  # background floors are not controller-writable
+            try:
+                r, w, li = (float(rwl[0]), float(rwl[1]), float(rwl[2]))
+                # register=False: the controller fans one cluster-wide
+                # class map to every OSD — identities this OSD never
+                # serves must not LRU-thrash its live classes; a class
+                # that appears later starts on defaults and picks up
+                # the params at the next push (one controller tick)
+                if self.scheduler.set_params(
+                        name, QoSParams(reservation=r, weight=w, limit=li),
+                        register=False):
+                    n_classes += 1
+            except (ValueError, TypeError, IndexError) as e:
+                self.cct.dout("osd", 1,
+                              f"{self.whoami} qos class {name!r} params "
+                              f"{rwl!r} rejected: {e!r}")
+        TRACER.tracepoint("qos", "apply", entity=self.whoami,
+                          qos_epoch=epoch, options=applied,
+                          classes=n_classes)
 
     def _wait_reply(self, tid: int, timeout: float | None = None):
         if timeout is None:

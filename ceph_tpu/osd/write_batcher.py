@@ -77,7 +77,7 @@ class _PendingStripe:
 
     __slots__ = ("key", "mat", "chunks", "nbytes", "arrival", "event",
                  "parity", "error", "admitted", "tctx", "tracked",
-                 "acct", "queued_at")
+                 "acct", "queued_at", "share_key")
 
     def __init__(self, mat: np.ndarray, chunks: np.ndarray):
         self.mat = mat
@@ -99,6 +99,9 @@ class _PendingStripe:
         # the op-trace state — per-client admission/queue attribution
         self.acct = None
         self.queued_at = 0.0  # trace_now clock, for the queue-stage span
+        # cephqos: (client, pool) whose per-client admission share this
+        # stripe's bytes count against (None = identity-less submit)
+        self.share_key = None
 
 
 class WriteBatcher:
@@ -135,10 +138,34 @@ class WriteBatcher:
         )
         # own counters so standalone users (bench) see stats without a
         # PerfCounters registry; the OSD's logger mirrors them
-        self._stats = {"flushes": 0, "stripes": 0, "bytes": 0, "inline": 0}
+        self._stats = {"flushes": 0, "stripes": 0, "bytes": 0, "inline": 0,
+                       "share_waits": 0}
+        # cephqos: admission bytes currently held per (client, pool) —
+        # the per-client share gate reads/writes this under self._lock;
+        # _share_waiters counts gate sleepers so releases only notify
+        # when someone is actually parked (a no-waiter notify is noise
+        # to the flusher and to cephrace's lost-wakeup heuristic)
+        self._held: dict[tuple, int] = {}
+        self._share_waiters = 0
         # fan-in tag tying one fused encode's many per-op spans together;
         # touched only by the single flusher thread
         self._flush_seq = 0
+
+    def _release_share(self, p: _PendingStripe) -> None:
+        """Return one stripe's bytes to its client's admission share and
+        wake share-gate waiters (idempotent via share_key clearing)."""
+        key = p.share_key
+        if key is None:
+            return
+        p.share_key = None
+        with self._cond:
+            left = self._held.get(key, 0) - p.nbytes
+            if left > 0:
+                self._held[key] = left
+            else:
+                self._held.pop(key, None)
+            if self._share_waiters:
+                self._cond.notify_all()
 
     # -- config (runtime-changeable: read per use) -------------------------
     def _window(self) -> float:
@@ -155,6 +182,16 @@ class WriteBatcher:
         if self._cct is None:
             return 0
         return max(0, int(self._cct.conf.get("ec_batch_max_bytes")))
+
+    def _client_share(self, cap: int) -> int:
+        """Per-(client,pool) admission-share cap in bytes (cephqos);
+        0 = disabled (no cct, unbounded queue, or share >= 1.0)."""
+        if self._cct is None or cap <= 0:
+            return 0
+        frac = float(self._cct.conf.get("ec_batch_client_max_share"))
+        if frac >= 1.0:
+            return 0
+        return max(1, int(cap * frac))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -239,7 +276,39 @@ class WriteBatcher:
         if cap != self._admission.max:
             self._admission.reset_max(cap)
         t_adm0 = trace_now()
+        # cephqos per-client share gate BEFORE the global FIFO: one bulk
+        # streamer's bytes cap out at share*cap, so a small writer's
+        # stripe never queues behind a wall of someone else's budget.
+        # An op past its own share waits for its OWN earlier bytes to
+        # drain (at least one stripe always fits — no self-deadlock);
+        # stop/crash pass the gate and take the inline path below.
+        share = self._client_share(cap)
+        key = tuple(p.acct[1:]) if p.acct is not None else None
+        if share > 0 and key is not None:
+            with self._cond:
+                if self._held.get(key, 0) + p.nbytes > max(share, p.nbytes):
+                    self._stats["share_waits"] += 1
+                    self._share_waiters += 1
+                    try:
+                        ok = self._cond.wait_for(
+                            lambda: (self._stop_flag or self._crashed
+                                     or self._held.get(key, 0) + p.nbytes
+                                     <= max(share, p.nbytes)),
+                            timeout=self.ADMIT_TIMEOUT)
+                    finally:
+                        self._share_waiters -= 1
+                    if not ok:
+                        raise IOError(
+                            f"write batcher per-client share timed out "
+                            f"({self._held.get(key, 0)} B held by {key}, "
+                            f"share {share} B)")
+                # reserve inside the critical section (two threads of
+                # one client must not both pass the check unreserved);
+                # released by encode_wait, or below on admission timeout
+                self._held[key] = self._held.get(key, 0) + p.nbytes
+            p.share_key = key
         if not self._admission.get(p.nbytes, timeout=self.ADMIT_TIMEOUT):
+            self._release_share(p)
             raise IOError(
                 f"write batcher admission timed out "
                 f"({self._admission.current} B queued, cap {cap} B)"
@@ -293,6 +362,7 @@ class WriteBatcher:
             if p.admitted:
                 p.admitted = False
                 self._admission.put(p.nbytes)
+            self._release_share(p)
 
     def _inline(self, mat: np.ndarray, chunks: np.ndarray,
                 tctx=None, tracked=None) -> np.ndarray:

@@ -1,0 +1,120 @@
+"""cephqos CI smoke: the bully scenario, controller off vs on, on a
+real CPU LocalCluster (qa/ci_gate.sh step 8; ISSUE 12 acceptance).
+
+Two identical mixed-population runs (``bench/traffic.py
+run_bully_traffic``: one heavy streamer driving several closed-loop
+64 KiB write streams against N small open-loop Poisson writers), the
+first with every cephqos mechanism DISABLED (one static mClock class,
+no per-client batcher share, controller inert — the pre-cephqos data
+plane), the second with the full closed loop armed: dynamic per-client
+mClock classes, bounded client-op slots, the batcher admission share,
+and the live mgr controller observing its own telemetry and pushing
+MQoSSettings.
+
+Gates (the ISSUE's bars):
+
+- victim ``fairness_ratio`` (max/min ops across every client, bully
+  included) must IMPROVE with the controller on — total starvation
+  (ratio None) on the on-side fails outright;
+- aggregate GiB/s must stay within 10% of the controller-off run
+  (fairness must not be bought with throughput);
+- pooled victim p99 must improve >= 1.5x (typical measured ~3x; the
+  acceptance headline is 2x and the JSON carries the exact ratio);
+- the controller must have actually closed the loop: settings pushes
+  applied (qos_epoch > 0 on the OSDs' view via qos_status) and at
+  least one client classed heavy at some point (decisions ring).
+
+Exit 0 on success; 1 with a ``problems`` list otherwise.  Prints one
+JSON summary on stdout (the gate archives it next to the SARIF
+artifacts).
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> int:
+    import jax
+
+    # this box's sitecustomize pins the tunneled TPU backend and IGNORES
+    # the JAX_PLATFORMS env var; config.update is the reliable spelling
+    jax.config.update("jax_platforms", "cpu")
+
+    from ..bench.traffic import run_bully_traffic
+
+    problems: list[str] = []
+    scenario = dict(n_small=3, seconds=4.0, bully_streams=6,
+                    small_rate=10.0)
+    off = run_bully_traffic(qos=False, **scenario)
+    on = run_bully_traffic(qos=True, settle=2.0, **scenario)
+
+    # -- fairness must improve ------------------------------------------
+    f_off, f_on = off.get("fairness_ratio"), on.get("fairness_ratio")
+    if f_on is None:
+        problems.append(
+            "controller-on run has a fully starved client "
+            "(fairness_ratio None)")
+    elif f_off is not None and f_on >= f_off:
+        problems.append(
+            f"victim fairness did not improve: {f_off} -> {f_on}")
+
+    # -- aggregate throughput within 10% --------------------------------
+    agg_ratio = None
+    if off.get("aggregate_gibps"):
+        agg_ratio = round(on["aggregate_gibps"] / off["aggregate_gibps"], 3)
+        if agg_ratio < 0.90:
+            problems.append(
+                f"aggregate GiB/s regressed {1 - agg_ratio:.1%} > 10% "
+                f"({off['aggregate_gibps']} -> {on['aggregate_gibps']})")
+    else:
+        problems.append("controller-off run produced no throughput")
+
+    # -- victim tail latency --------------------------------------------
+    p99_ratio = None
+    if off.get("victim_p99_ms") and on.get("victim_p99_ms"):
+        p99_ratio = round(off["victim_p99_ms"] / on["victim_p99_ms"], 2)
+        if p99_ratio < 1.5:
+            problems.append(
+                f"victim p99 improved only {p99_ratio}x "
+                f"({off['victim_p99_ms']} -> {on['victim_p99_ms']} ms), "
+                f"want >= 1.5x")
+    else:
+        problems.append("victim p99 missing from a run")
+
+    # -- the loop actually closed ---------------------------------------
+    st = on.get("qos_status") or {}
+    if not st.get("qos_epoch"):
+        problems.append("controller never pushed settings (qos_epoch 0)")
+    if not (st.get("stats") or {}).get("pushes"):
+        problems.append("no MQoSSettings deliveries recorded")
+    classes = ((on.get("op_queue") or {}).get("classes") or {})
+    if not any(c.get("dynamic") and c.get("served")
+               for c in classes.values()):
+        problems.append("no dynamic per-client class served ops on the "
+                        "sampled OSD")
+
+    summary = {
+        "off": {k: off.get(k) for k in (
+            "aggregate_gibps", "bully_ops", "victim_ops",
+            "victim_p50_ms", "victim_p99_ms", "fairness_ratio")},
+        "on": {k: on.get(k) for k in (
+            "aggregate_gibps", "bully_ops", "victim_ops",
+            "victim_p50_ms", "victim_p99_ms", "fairness_ratio")},
+        "aggregate_ratio": agg_ratio,
+        "victim_p99_improvement": p99_ratio,
+        "qos_status": st,
+        "problems": problems,
+    }
+    print(json.dumps(summary))
+    for p in problems:
+        print(f"# qos smoke FAILED: {p}", file=sys.stderr)
+    if not problems:
+        print(f"# qos smoke OK: victim p99 {p99_ratio}x better, "
+              f"fairness {f_off} -> {f_on}, aggregate x{agg_ratio}",
+              file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,11 @@
 #    failpoint-delayed op must surface in dump_historic_slow_ops with
 #    per-stage attribution and a tail-promoted cross-entity trace
 #    (trace_sampling_rate=0 — the head coin flip said no).
+# 8. QoS smoke (ceph_tpu/qa/qos_smoke.py): the bully scenario (1 heavy
+#    streamer vs N small Poisson writers) on a real LocalCluster,
+#    controller off vs on — fails when victim fairness_ratio does not
+#    improve, aggregate GiB/s regresses >10%, victim p99 improves
+#    <1.5x, or the controller never actually pushed settings.
 #
 # Analyzers emit SARIF 2.1.0 into qa/_sarif/ (github code-scanning uploads
 # resolve URIs against the repo root, which is where this script runs
@@ -203,5 +208,24 @@ else
     rc=1
 fi
 
-echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json, accounting_smoke.json)"
+echo "== QoS smoke (bully scenario, controller off vs on) =="
+# per-client mClock classes + batcher share + live controller must
+# improve victim fairness and p99 without costing >10% aggregate
+# (ceph_tpu/qa/qos_smoke.py; docs/qos.md)
+python -m ceph_tpu.qa.qos_smoke > "$OUT_DIR/qos_smoke.json"
+qos_rc=$?
+if [ $qos_rc -eq 0 ]; then
+    echo "qos smoke: ok"
+elif python -c "import json; json.load(open('$OUT_DIR/qos_smoke.json'))" \
+        2>/dev/null; then
+    echo "qos smoke: FAILED:"
+    python -c "import json; [print(' -', p) for p in json.load(open('$OUT_DIR/qos_smoke.json'))['problems']]" || true
+    rc=1
+else
+    rm -f "$OUT_DIR/qos_smoke.json"
+    echo "qos smoke: ERROR (exit $qos_rc) — scenario crashed"
+    rc=1
+fi
+
+echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json, accounting_smoke.json, qos_smoke.json)"
 exit $rc

@@ -161,7 +161,8 @@ def test_demux_parity_bit_identical_rs84():
             t.join(timeout=10.0)
         assert errs == [None] * 12
         assert wb.stats() == {"flushes": 1, "stripes": 12,
-                              "bytes": 12 * xs[0].nbytes, "inline": 0}
+                              "bytes": 12 * xs[0].nbytes, "inline": 0,
+                              "share_waits": 0}
         for x, o in zip(xs, outs):
             inline = np.asarray(codec.encode_chunks(x), np.uint8)
             np.testing.assert_array_equal(o, inline)
