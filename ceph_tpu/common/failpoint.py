@@ -430,6 +430,7 @@ KNOWN_FAILPOINTS = frozenset({
     "osd.write_batcher.flush",
     "osd.recovery.push",
     "osd.recovery.pull",
+    "osd.recovery.tick",
     "osd.scrub.start",
     "osd.scrub.shard",
     "osd.store.write_before_commit",

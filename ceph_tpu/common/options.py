@@ -225,7 +225,7 @@ def default_options() -> OptionTable:
                    min=0.05),
             Option("mgr_modules", str,
                    "status,prometheus,balancer,iostat,quota,"
-                   "metrics_history,qos",
+                   "metrics_history,qos,progress",
                    "comma-separated modules the mgr hosts"),
             Option("rgw_lc_interval", float, 5.0,
                    "seconds between lifecycle passes (upstream: daily)",
@@ -243,6 +243,18 @@ def default_options() -> OptionTable:
                    runtime=True),
             Option("mgr_stale_report_age", float, 30.0,
                    "drop daemon reports older than this", min=1.0),
+            # -- cephheal progress (mgr/progress_module.py) ----------------
+            Option("mgr_progress_interval", float, 1.0,
+                   "seconds between progress-module passes over the "
+                   "OSDs' pg_info degraded/misplaced counts (per-PG "
+                   "recovery/backfill completion fractions + ETAs; "
+                   "`ceph progress`, the `ceph status` recovery line)",
+                   min=0.1, runtime=True),
+            Option("mgr_recovery_stalled_grace", float, 10.0,
+                   "seconds a PG may sit degraded with ~zero drain "
+                   "(and no cluster recovery-op rate) before the "
+                   "progress module marks it stalled and the mon "
+                   "raises RECOVERY_STALLED", min=0.5, runtime=True),
             Option("mgr_metrics_history_samples", int, 512,
                    "samples kept per (daemon, counter) series in the "
                    "mgr metrics-history ring (mgr/metrics_history.py — "

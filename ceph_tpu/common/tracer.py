@@ -75,6 +75,19 @@ _MAX_DECIDED = 8192
 #: the stage names above, in pipeline order (bench/tests iterate this)
 OP_STAGES = ("admission", "queue", "encode", "subop", "commit")
 
+#: background-plane stage taxonomy (cephheal): recovery and scrub spans,
+#: the OSD's recovery_*/scrub_* latency histograms, and TrackedOp marks
+#: share these names verbatim, exactly like OP_STAGES on the client path
+BG_STAGES = (
+    "recovery_peer",      # MPGQuery round: peer versions + object lists
+    "recovery_pull",      # authoritative-log catch-up (MPGPull wait)
+    "recovery_rebuild",   # one shard chunk recomputed (gather + decode)
+    "recovery_push",      # push round to one peer (delta or backfill)
+    "scrub_read",         # shard ScrubMap collection
+    "scrub_compare",      # cross-shard digest comparison
+    "scrub_repair",       # flagged-shard rebuild + re-push
+)
+
 
 def trace_now() -> float:
     """THE clock every tracing consumer shares: wall time, so

@@ -32,9 +32,10 @@ from .tracer import TRACER, assemble_trees, trace_now
 
 class TrackedOp:
     __slots__ = ("tracker", "desc", "initiated_at", "events", "stages",
-                 "trace_id", "_lock")
+                 "trace_id", "src", "_lock")
 
-    def __init__(self, tracker: "OpTracker", desc: str):
+    def __init__(self, tracker: "OpTracker", desc: str,
+                 src: str = "client"):
         self.tracker = tracker
         self.desc = desc
         self.initiated_at = trace_now()
@@ -45,6 +46,12 @@ class TrackedOp:
         # provisionally buffered) trace — dump_historic_slow_ops uses it
         # to attach the assembled tree
         self.trace_id: str | None = None
+        # origin plane (cephheal): "client" ops vs background
+        # "recovery"/"scrub" work — background ops keep their own
+        # bounded history so a recovery tick can never evict client
+        # forensics (and vice versa), but slow ones share the slow-op
+        # history so dump_historic_slow_ops covers the whole daemon
+        self.src = src
         self._lock = make_lock("optracker::op")
 
     def mark_event(self, name: str, ts: float | None = None) -> None:
@@ -85,9 +92,16 @@ class TrackedOp:
             return ""
         return f", dominant stage {dom[0]} ({dom[1] * 1e3:.1f} ms)"
 
+    def _desc_tagged(self) -> str:
+        """Background ops carry their plane in the detail line so a
+        SLOW_OPS report distinguishes a recovery pull from a client op."""
+        return self.desc if self.src == "client" \
+            else f"[{self.src}] {self.desc}"
+
     def slow_summary(self, now: float | None = None) -> str:
         """One SLOW_OPS detail line naming the dominant stage."""
-        return f"{self.desc}: {self.age(now):.2f}s{self._dom_suffix()}"
+        return (f"{self._desc_tagged()}: {self.age(now):.2f}s"
+                f"{self._dom_suffix()}")
 
     def dump(self) -> dict:
         with self._lock:
@@ -96,6 +110,7 @@ class TrackedOp:
         t0 = self.initiated_at
         out = {
             "description": self.desc,
+            "src": self.src,
             "initiated_at": t0,
             "age": self.age(),
             "duration": events[-1][0] - t0,
@@ -132,6 +147,12 @@ class OpTracker:
                  recent_slow_window: float = 60.0):
         self._inflight: dict[int, TrackedOp] = {}
         self._history: deque[TrackedOp] = deque(maxlen=history_size)
+        # background (recovery/scrub) ops, separately bounded: the
+        # per-tick recovery pass must not cycle client ops out of
+        # dump_historic_ops, and client bursts must not hide a slow
+        # backfill from dump_historic_bg_ops (cephheal)
+        self._bg_history: deque[TrackedOp] = deque(
+            maxlen=max(1, history_size))
         # completed-slow ops, separately bounded: a burst of fast ops
         # must not push a straggler out of forensic reach
         self._slow_history: deque[TrackedOp] = deque(
@@ -143,8 +164,8 @@ class OpTracker:
         self.complaint_time = complaint_time
         self.recent_slow_window = recent_slow_window
 
-    def create(self, desc: str) -> TrackedOp:
-        op = TrackedOp(self, desc)
+    def create(self, desc: str, src: str = "client") -> TrackedOp:
+        op = TrackedOp(self, desc, src=src)
         with self._lock:
             self._inflight[id(op)] = op
         return op
@@ -154,7 +175,10 @@ class OpTracker:
                 and op.duration() > self.complaint_time)
         with self._lock:
             if self._inflight.pop(id(op), None) is not None:
-                self._history.append(op)
+                if op.src == "client":
+                    self._history.append(op)
+                else:
+                    self._bg_history.append(op)
                 if slow:
                     self._slow_history.append(op)
                     self._recent_slow.append(time.time())
@@ -171,6 +195,13 @@ class OpTracker:
     def dump_historic_ops(self) -> dict:
         with self._lock:
             ops = list(self._history)
+        return {"num_ops": len(ops), "ops": [op.dump() for op in ops]}
+
+    def dump_historic_bg_ops(self) -> dict:
+        """Completed background (recovery/scrub) ops — the plane
+        dump_historic_ops never saw before cephheal."""
+        with self._lock:
+            ops = list(self._bg_history)
         return {"num_ops": len(ops), "ops": [op.dump() for op in ops]}
 
     def dump_historic_slow_ops(self, with_traces: bool = True) -> dict:
@@ -227,6 +258,6 @@ class OpTracker:
         for op in reversed(recent):
             if len(lines) >= limit:
                 break
-            lines.append(f"{op.desc}: completed in "
+            lines.append(f"{op._desc_tagged()}: completed in "
                          f"{op.duration():.2f}s{op._dom_suffix()}")
         return lines[:limit]

@@ -23,6 +23,7 @@ from . import devicehealth_module  # noqa: F401
 from . import iostat_module  # noqa: F401
 from . import quota_module  # noqa: F401
 from . import pg_autoscaler_module  # noqa: F401
+from . import progress_module  # noqa: F401
 from . import prometheus_module  # noqa: F401
 from . import qos_module  # noqa: F401
 from . import status_module  # noqa: F401
@@ -242,11 +243,21 @@ class MgrDaemon(Dispatcher):
             }
 
     def latest_stats(self) -> dict:
+        return {d: s for d, (_t, s)
+                in self.latest_stats_with_ts().items()}
+
+    def latest_stats_with_ts(self) -> dict:
+        """{daemon: (arrival_ts, stats)} — consumers that merge
+        per-PG rows across daemons (progress, the status digest) must
+        arbitrate duplicates by report FRESHNESS: after a primary
+        change, the dead primary's final report lingers up to
+        mgr_stale_report_age and its stale pg_info rows must not mask
+        the new primary's (cephheal)."""
         max_age = self.cct.conf.get("mgr_stale_report_age")
         now = time.monotonic()
         with self._reports_lock:
             return {
-                d: r["stats"]
+                d: (r["ts"], r["stats"])
                 for d, r in self._reports.items()
                 if now - r["ts"] <= max_age
             }

@@ -52,6 +52,11 @@ from .module import MgrModule, register_module
 #: stage_* histograms / tracer.OP_STAGES verbatim)
 WATCHED_STAGES = ("stage_queue", "stage_encode")
 
+#: background mClock classes the controller OBSERVES (cephheal: their
+#: depth/served/wait feed the loop's telemetry and export, but plan()
+#: never writes them — the static floors stay protected, docs/qos.md)
+BACKGROUND_CLASSES = ("background_recovery", "background_scrub")
+
 
 def hist_quantile(buckets, q: float = 0.99) -> float | None:
     """Quantile (seconds, upper bucket bound) of one log2 bucket-count
@@ -109,6 +114,9 @@ class QoSObservation:
     op_rate: float = 0.0                 # aggregate client writes/s
     stripes_per_flush: float | None = None
     per_client_rates: dict = field(default_factory=dict)  # key -> ops/s
+    # cephheal (observe-only): {class: {depth, rate, wait_p99_ms}} for
+    # BACKGROUND_CLASSES — plan() must never retune these
+    background: dict = field(default_factory=dict)
 
 
 class QoSController:
@@ -221,10 +229,13 @@ class QoSModule(MgrModule):
         self._prev_hists: dict[tuple[str, str], dict] = {}
         self._prev_client_ops: dict[tuple[str, str], float] = {}
         self._prev_client_ts: float | None = None
+        # cephheal: background-class served counters (windowed rates)
+        self._prev_bg_served: dict[str, float] = {}
+        self._prev_bg_ts: float | None = None
         self._stats = {"ticks": 0, "retunes": 0, "pushes": 0,
                        "push_errors": 0, "heavy_clients": 0}
         self._last = {"queue_p99_ms": None, "encode_p99_ms": None,
-                      "op_rate": 0.0, "reasons": []}
+                      "op_rate": 0.0, "background": {}, "reasons": []}
         self.decisions: list[dict] = []  # bounded ring, introspection
 
     def _clamps(self) -> QoSClamps:
@@ -286,7 +297,77 @@ class QoSModule(MgrModule):
             op_rate=op_rate,
             stripes_per_flush=spf,
             per_client_rates=self._client_rates(reports),
+            background=self._background_state(reports),
         )
+
+    def _background_state(self, reports: dict) -> dict:
+        """Aggregate the background_recovery/background_scrub mClock
+        rows (the ceph_mclock_*{qclass} SchedulerPerf series) across
+        OSDs: queue depth, served-op rate (windowed cumulative-counter
+        delta), and wait p99 (windowed histogram bucket delta — the
+        same discipline as the stage p99s).  Observe-only: the first
+        half of the ROADMAP QoS residual; feeding them into plan()
+        stays future work and the background floors stay
+        controller-unwritable."""
+        now = time.monotonic()
+        out: dict[str, dict] = {}
+        agg_wait: dict[str, list[int]] = {}
+        depth: dict[str, int] = {}
+        served: dict[str, float] = {}
+        for daemon, subsystems in reports.items():
+            if not daemon.startswith("osd."):
+                continue
+            rows = (((subsystems or {}).get("mclock") or {})
+                    .get("per_class") or {}).get("rows") or []
+            for row in rows:
+                cls = (row.get("labels") or {}).get("qclass")
+                if cls not in BACKGROUND_CLASSES:
+                    continue
+                depth[cls] = depth.get(cls, 0) + int(
+                    row.get("depth") or 0)
+                served[cls] = served.get(cls, 0.0) + float(
+                    row.get("served") or 0)
+                wait = row.get("wait")
+                if isinstance(wait, dict) and "buckets" in wait:
+                    key = (daemon, f"mclock.{cls}.wait")
+                    prev = self._prev_hists.get(key)
+                    self._prev_hists[key] = wait
+                    if prev is None:
+                        continue  # first sighting primes
+                    delta = hist_delta(wait, prev)
+                    if delta:
+                        tot = agg_wait.setdefault(cls, [0] * len(delta))
+                        if len(tot) == len(delta):
+                            for i, d in enumerate(delta):
+                                tot[i] += d
+        prev_ts = self._prev_bg_ts
+        prev_served_map = self._prev_bg_served
+        self._prev_bg_ts = now
+        # the prev map is replaced WHOLESALE (the _client_rates rule):
+        # a class absent this tick — every report stale during an OSD
+        # outage — re-primes on return instead of booking the whole
+        # gap's served delta against one tick interval
+        self._prev_bg_served = {
+            cls: served.get(cls, 0.0)
+            for cls in BACKGROUND_CLASSES
+            if cls in depth or cls in served
+        }
+        for cls in BACKGROUND_CLASSES:
+            if cls not in depth and cls not in served:
+                continue
+            rate = None
+            prev_served = prev_served_map.get(cls)
+            if prev_served is not None and prev_ts is not None \
+                    and now > prev_ts:
+                rate = max(0.0, (served.get(cls, 0.0) - prev_served)
+                           / (now - prev_ts))
+            p99 = hist_quantile(agg_wait.get(cls, ()))
+            out[cls] = {
+                "depth": depth.get(cls, 0),
+                "rate": None if rate is None else round(rate, 3),
+                "wait_p99_ms": None if p99 is None else p99 * 1e3,
+            }
+        return out
 
     def _client_rates(self, reports: dict) -> dict:
         """Per-(client,pool) write-op rates from the cephmeter labeled
@@ -341,6 +422,7 @@ class QoSModule(MgrModule):
             self._last = {"queue_p99_ms": obs.queue_p99_ms,
                           "encode_p99_ms": obs.encode_p99_ms,
                           "op_rate": obs.op_rate,
+                          "background": obs.background,
                           "reasons": decision["reasons"]}
             self.decisions.append(
                 {"ts": time.monotonic(), **decision})
@@ -409,7 +491,18 @@ class QoSModule(MgrModule):
         the mgr's own report sink (prometheus + metrics_history)."""
         with self._lock:
             last = dict(self._last)
+            bg = last.get("background") or {}
+            rec = bg.get("background_recovery") or {}
+            scr = bg.get("background_scrub") or {}
             counters = {"qos": {
+                # cephheal (observe-only): the background classes'
+                # scheduler state as first-class controller telemetry
+                "recovery_depth": rec.get("depth") or 0,
+                "recovery_served_rate": rec.get("rate") or 0.0,
+                "recovery_wait_p99_ms": rec.get("wait_p99_ms") or 0.0,
+                "scrub_depth": scr.get("depth") or 0,
+                "scrub_served_rate": scr.get("rate") or 0.0,
+                "scrub_wait_p99_ms": scr.get("wait_p99_ms") or 0.0,
                 "window_ms": self._window_ms,
                 "max_stripes": self._max_stripes,
                 "ticks": self._stats["ticks"],
@@ -482,4 +575,27 @@ _QOS_SCHEMA = {"qos": {
     "active": {"type": "gauge",
                "description": "1 = controller pushes settings; 0 = "
                               "observe/export only"},
+    "recovery_depth": {
+        "type": "gauge",
+        "description": "background_recovery mClock queue depth summed "
+                       "across OSDs (cephheal observe-only)"},
+    "recovery_served_rate": {
+        "type": "gauge",
+        "description": "background_recovery ops dequeued per second "
+                       "(windowed served-counter delta)"},
+    "recovery_wait_p99_ms": {
+        "type": "gauge",
+        "description": "background_recovery enqueue->dequeue wait p99 "
+                       "this tick (windowed bucket deltas)"},
+    "scrub_depth": {
+        "type": "gauge",
+        "description": "background_scrub mClock queue depth summed "
+                       "across OSDs"},
+    "scrub_served_rate": {
+        "type": "gauge",
+        "description": "background_scrub ops dequeued per second"},
+    "scrub_wait_p99_ms": {
+        "type": "gauge",
+        "description": "background_scrub enqueue->dequeue wait p99 "
+                       "this tick"},
 }}

@@ -147,9 +147,15 @@ class StatusModule(MgrModule):
         """The MMonMgrReport payload: everything the mon needs to
         answer `df`/`osd df`/`pg dump` without talking to OSDs."""
         m = self.get("osd_map")
-        stats = self.mgr.latest_stats()
+        # ONE report snapshot feeds every section, so pg_info can never
+        # name a daemon the slow-op/df views disagree about
+        stats_ts = self.mgr.latest_stats_with_ts()
+        stats = {d: s for d, (_t, s) in stats_ts.items()}
+        # pg_info rows merged OLDEST-report-first so on a pgid collision
+        # (primary change: the dead primary's last report lingers) the
+        # FRESHEST author wins (cephheal)
         pg_info: dict[str, dict] = {}
-        for st in stats.values():
+        for _ts, st in sorted(stats_ts.values(), key=lambda tv: tv[0]):
             pg_info.update(st.get("pg_info") or {})
         slow = {d: int(st.get("slow_ops", 0))
                 for d, st in stats.items() if st.get("slow_ops")}
@@ -168,6 +174,18 @@ class StatusModule(MgrModule):
             sent = bh.get("sentinel") or {}
             if sent.get("state") == "degraded" or bh.get("fallback"):
                 backend[d] = bh
+        # cephheal: the progress module's event/stalled snapshot rides
+        # the digest so the mon can answer `progress`, render the
+        # `ceph status` recovery line, and raise RECOVERY_STALLED —
+        # tolerant of the module not being hosted
+        progress = None
+        prog_mod = self.mgr._modules.get("progress")
+        if prog_mod is not None:
+            try:
+                progress = prog_mod.snapshot()
+            except Exception as e:
+                self.cct.dout("mgr", 3,
+                              f"progress snapshot failed: {e!r}")
         return {
             "df": assemble_df(m, stats),
             "osd_df": assemble_osd_df(m, stats),
@@ -175,6 +193,7 @@ class StatusModule(MgrModule):
             "slow_ops": slow,
             "slow_ops_detail": slow_detail,
             "backend_health": backend,
+            "progress": progress,
             # compact metrics-history snapshot: the mon's `perf history`
             # command answers from this (cephmeter; the mon has no
             # channel TO the mgr, so the history rides the digest)

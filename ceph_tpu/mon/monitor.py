@@ -657,6 +657,7 @@ class Monitor(Dispatcher):
         # arrived (reference: `ceph -s` data/pgs sections via PGMap)
         usage = {}
         pgs_by_state: dict[str, int] = {}
+        progress_out: dict | None = None
         ts_digest = getattr(self.osdmon, "mgr_digest", None)
         # a dead mgr's last digest must not masquerade as current
         # forever: past the stale-report age, drop the sections (the
@@ -730,6 +731,63 @@ class Monitor(Dispatcher):
                     "daemons": latched,
                     "detail": details,
                 }
+            # cephheal: degraded-redundancy + stalled-recovery checks
+            # from the pg_info counts and the progress-module snapshot
+            # the digest now carries (docs/observability.md)
+            pg_info = digest.get("pg_info") or {}
+            deg_pgs = {
+                pgid: int(info.get("degraded") or 0)
+                for pgid, info in pg_info.items()
+                if int(info.get("degraded") or 0) > 0
+            }
+            if deg_pgs:
+                # reference: PG_DEGRADED ("Degraded data redundancy")
+                total_deg = sum(deg_pgs.values())
+                worst = sorted(deg_pgs.items(), key=lambda kv: -kv[1])
+                checks["PG_DEGRADED"] = {
+                    "severity": "HEALTH_WARN",
+                    "message": f"Degraded data redundancy: "
+                               f"{total_deg} object copies degraded, "
+                               f"{len(deg_pgs)} pg(s) degraded",
+                    "pgs": sorted(deg_pgs),
+                    "detail": [
+                        f"pg {pgid} is degraded ({n} object copies)"
+                        for pgid, n in worst[:6]
+                    ],
+                }
+            prog = digest.get("progress") or {}
+            stalled = prog.get("stalled") or []
+            failing = prog.get("failing") or {}
+            if stalled or failing:
+                # recovery is owed (degraded > 0) but the drain rate is
+                # ~zero past the grace, or a PG's recovery pass raises
+                # every tick — either way the self-heal plane is stuck,
+                # which a degraded count alone cannot distinguish from
+                # slow-but-progressing recovery
+                names = sorted({e["pgid"] for e in stalled}
+                               | set(failing))
+                detail = [
+                    f"pg {e['pgid']}: {e['degraded']} object copies "
+                    f"degraded, no progress for {e['stalled_for']}s"
+                    for e in stalled[:6]
+                ] + [
+                    f"pg {pgid}: recovery failing on {rec.get('daemon')}"
+                    f" ({rec.get('count')} consecutive ticks): "
+                    f"{rec.get('error')}"
+                    for pgid, rec in sorted(failing.items())[:6]
+                ]
+                checks["RECOVERY_STALLED"] = {
+                    "severity": "HEALTH_WARN",
+                    "message": f"recovery stalled on {len(names)} "
+                               f"pg(s): {', '.join(names[:8])}",
+                    "pgs": names,
+                    "detail": detail,
+                }
+            if prog.get("events") is not None:
+                progress_out = {
+                    "events": prog.get("events") or [],
+                    "stalled": stalled,
+                }
             st = (digest.get("df") or {}).get("stats") or {}
             usage = {
                 "total_bytes": st.get("total_bytes", 0),
@@ -749,6 +807,9 @@ class Monitor(Dispatcher):
             "osdmap": osd,
             "usage": usage,
             "pgs_by_state": pgs_by_state,
+            # cephheal: in-flight recovery events for the `ceph status`
+            # one-line progress bar (None = no progress data yet)
+            "progress": progress_out,
             "paxos": {
                 "version": self.paxos.last_committed,
                 "pn": self.paxos.accepted_pn,

@@ -193,6 +193,8 @@ class OSDMonitor:
             return self._cmd_from_digest(prefix)
         if prefix == "perf history":
             return self._cmd_perf_history(cmd)
+        if prefix == "progress":
+            return self._cmd_progress()
         if prefix == "osd erasure-code-profile set":
             return self._cmd_profile_set(cmd)
         if prefix == "osd erasure-code-profile get":
@@ -703,6 +705,27 @@ class OSDMonitor:
             "names": hist.get("names"),
             "samples_per_series": hist.get("samples_per_series"),
             "daemons": daemons,
+        }
+
+    def _cmd_progress(self) -> tuple[int, object]:
+        """`ceph progress` (cephheal; reference: the mgr progress
+        module's `ceph progress` output) — per-PG recovery/backfill
+        events with completion fraction, drain rate, and ETA, served
+        mon-side from the digest like perf history."""
+        ts_digest = getattr(self, "mgr_digest", None)
+        if ts_digest is None:
+            return -2, "no mgr digest yet (is the mgr running?)"
+        ts, digest = ts_digest
+        prog = digest.get("progress")
+        if not isinstance(prog, dict):
+            return -2, ("digest carries no progress data yet (is the "
+                        "progress module hosted?)")
+        return 0, {
+            "digest_age_seconds": round(time.monotonic() - ts, 1),
+            "events": prog.get("events") or [],
+            "completed": prog.get("completed") or [],
+            "stalled": prog.get("stalled") or [],
+            "failing": prog.get("failing") or {},
         }
 
     def _cmd_from_digest(self, prefix: str) -> tuple[int, object]:

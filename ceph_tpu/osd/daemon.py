@@ -45,7 +45,8 @@ from ..common.io_accounting import IOAccounting
 from ..common.kernel_telemetry import SENTINEL, TELEMETRY, SentinelPolicy
 from ..common.lockdep import make_lock
 from ..common.perf_counters import PerfCountersBuilder
-from ..common.tracer import TRACER, op_trace, trace_now
+from ..common.recovery_accounting import RecoveryAccounting
+from ..common.tracer import TRACER, op_trace, sampled_ctx, trace_now
 from ..common.tracked_op import OpTracker
 from ..ec.registry import ErasureCodePluginRegistry
 from ..mon.mon_client import MonClient
@@ -281,9 +282,44 @@ class OSD(
                                 "sub-op fan-out to last shard ack")
             .add_time_histogram("stage_commit",
                                 "local object-store commit")
+            # background-plane stage histograms (cephheal): names match
+            # tracer.BG_STAGES / the recovery and scrub span taxonomy
+            # verbatim, like stage_* matches OP_STAGES
+            .add_time_histogram("recovery_peer",
+                                "recovery peer-query round (MPGQuery "
+                                "versions + object lists)")
+            .add_time_histogram("recovery_pull",
+                                "authoritative-log catch-up wait "
+                                "(MPGPull to donor reply)")
+            .add_time_histogram("recovery_rebuild",
+                                "one shard chunk rebuilt (helper "
+                                "gather + decode)")
+            .add_time_histogram("recovery_push",
+                                "one peer's push round (delta replay "
+                                "or backfill)")
+            .add_time_histogram("scrub_read",
+                                "shard ScrubMap collection")
+            .add_time_histogram("scrub_compare",
+                                "cross-shard digest comparison")
+            .add_time_histogram("scrub_repair",
+                                "flagged-shard rebuild + re-push")
+            .add_u64_counter("recovery_errors",
+                             "per-PG recovery passes that raised "
+                             "(previously a dout-level-1 line only)")
             .add_u64("numpg", "placement groups hosted")
             .create_perf_counters()
         )
+        # cephheal: per-(pool,codec) repair-bandwidth table — helper
+        # shards/bytes read vs bytes repaired, the live CLAY-vs-RS
+        # repair ratio (common/recovery_accounting.py); duck-types
+        # PerfCounters so the labeled rows ride perf dump ->
+        # MMgrReport -> prometheus as ceph_recovery_*{pool,codec}
+        self.recovery_acct = cct.perf.add(RecoveryAccounting())
+        # consecutive _recover_pg failures per PG (satellite: a PG
+        # failing every tick must surface in RECOVERY_STALLED, not
+        # scroll away in logs); pgid -> [count, last_error], under
+        # self._lock (recovery worker writes, report tick reads)
+        self._recovery_failures: dict[str, list] = {}
         # the process-wide kernel telemetry registry rides this daemon's
         # perf pipeline (perf dump -> MMgrReport -> prometheus): kernels
         # are per-process, so every OSD in a LocalCluster reports the
@@ -325,6 +361,12 @@ class OSD(
                 "dump_historic_ops",
                 lambda c: self.op_tracker.dump_historic_ops(),
                 "recently completed ops",
+            )
+            cct.admin_socket.register_command(
+                "dump_historic_bg_ops",
+                lambda c: self.op_tracker.dump_historic_bg_ops(),
+                "recently completed background (recovery/scrub) ops "
+                "with per-stage attribution (cephheal)",
             )
             cct.admin_socket.register_command(
                 "dump_historic_slow_ops",
@@ -652,7 +694,14 @@ class OSD(
         `span` closes a pre-opened span (the subop fan-out opens its
         span BEFORE sending so sub-op messages can carry its id as
         their parent) instead of minting a fresh one."""
-        self.logger.hinc(f"stage_{stage}", t1 - t0)
+        self._stage_funnel(f"stage_{stage}", stage, t0, t1, span, tags)
+
+    def _stage_funnel(self, counter: str, stage: str, t0: float,
+                      t1: float, span, tags: dict) -> None:
+        """The shared histogram + TrackedOp + span funnel behind
+        _op_stage (client plane, `stage_*` counters) and _bg_stage
+        (background plane, bare BG_STAGES counters)."""
+        self.logger.hinc(counter, t1 - t0)
         st = op_trace()
         if st is None:
             TRACER.end(span, t1=t1, **tags)
@@ -675,6 +724,61 @@ class OSD(
         """Current op's trace context (None = unsampled / tracing off)."""
         st = op_trace()
         return st.get("ctx") if st is not None else None
+
+    # -- cephheal background-op funnel ---------------------------------
+    def _bg_stage(self, stage: str, t0: float, t1: float, span=None,
+                  **tags) -> None:
+        """_op_stage's background twin: one call feeds the recovery_*/
+        scrub_* latency histogram, the TrackedOp stage attribution, and
+        the cephtrace span — one clock, one stage name (tracer.
+        BG_STAGES, which IS the counter name).  The histogram fills
+        whether or not tracing is on; the span side is the usual
+        one-attribute-check no-op when off."""
+        self._stage_funnel(stage, stage, t0, t1, span, tags)
+
+    def _bg_trace_ctx(self):
+        """Root context for a background op (recovery pass, scrub):
+        the SAME head-coin-flip + tail-provisional contract client ops
+        get at op_submit, so a slow recovery keeps its connected tree
+        even at trace_sampling_rate=0 (docs/tracing.md)."""
+        if not TRACER.enabled:
+            return None
+        return sampled_ctx(
+            float(self.cct.conf.get("trace_sampling_rate")),
+            tail=float(self.cct.conf.get("trace_tail_latency_ms")) > 0,
+        )
+
+    def _bg_tail_verdict(self, tracked) -> None:
+        """Promote-or-discard a background op's provisionally buffered
+        trace on completion (the client-side Objecter verdict has no
+        analog here — the background op IS its own client)."""
+        tid = tracked.trace_id
+        if tid is None:
+            return
+        dur = tracked.duration()
+        complaint = self.op_tracker.complaint_time
+        tail_ms = float(self.cct.conf.get("trace_tail_latency_ms"))
+        if complaint > 0 and dur > complaint:
+            TRACER.promote(tid, reason=f"{tracked.src}_complaint")
+        elif tail_ms > 0 and dur * 1e3 >= tail_ms:
+            TRACER.promote(tid, reason=f"{tracked.src}_tail")
+        elif TRACER.is_provisional(tid):
+            TRACER.discard(tid)
+
+    def _codec_label(self, pool) -> str:
+        """(pool, codec) label for the repair-bandwidth rows: the EC
+        profile's plugin (+technique when set), or 'replica'."""
+        from ..osd.osdmap import PG_POOL_ERASURE
+
+        if pool is None:
+            return "?"
+        if pool.type != PG_POOL_ERASURE:
+            return "replica"
+        prof = ((self.osdmap.ec_profiles if self.osdmap else {})
+                .get(pool.ec_profile or "") or {})
+        plugin = str(prof.get("plugin", "jax"))
+        tech = prof.get("technique")
+        return f"{plugin}-{tech}" if tech else plugin
 
     # -- persistence of PG meta -------------------------------------------
     def _load_pgs(self) -> None:

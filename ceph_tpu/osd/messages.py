@@ -174,16 +174,28 @@ class MPGPull(_JsonMessage):
     versions and judge ahead-peers clean).  `have_oids` is the
     requester's local object list so the donor can push deletes for
     objects that no longer exist (a survivors-only backfill would
-    resurrect deletions)."""
+    resurrect deletions).
+
+    `trace_id`/`parent_span` carry the requester's cephheal recovery
+    trace context (parent = its `recovery_pull` span, opened BEFORE the
+    send) so the donor's rebuild/push spans join the recovery tree
+    across daemons.  Named to dodge the framing attrs send_message
+    stamps (`seq`/`src` — the CL6 field-shadow trap), like the PR-9
+    client-op fields."""
 
     MSG_TYPE = 116
-    FIELDS = ("tid", "pgid", "shard", "from_version", "epoch", "have_oids")
+    FIELDS = ("tid", "pgid", "shard", "from_version", "epoch", "have_oids",
+              "trace_id", "parent_span")
 
 
 @register_message
 class MPGPullReply(_JsonMessage):
+    """`trace_id`/`parent_span` echo the request's context (the donor's
+    completion joining the same recovery tree) — same field-shadow-safe
+    naming as MPGPull."""
+
     MSG_TYPE = 117
-    FIELDS = ("tid", "pgid", "shard", "retval")
+    FIELDS = ("tid", "pgid", "shard", "retval", "trace_id", "parent_span")
 
 
 @register_message

@@ -52,6 +52,12 @@ class PGState:
         # cumulative closures recorded this process-lifetime (observability
         # only — prune clears the history, not this)
         self.intervals_closed = 0
+        # cephheal pg_stats (observability only): object-copies this
+        # PG's LIVE peers were missing at the last recovery pass
+        # (down/absent shards are counted live by _mgr_report from its
+        # store walk); the push helpers decrement as objects land so a
+        # long backfill drains visibly between passes
+        self.stat_degraded_peers = 0
         # newest map epoch under which this PG logged a write (persisted
         # with the log): a revived OSD uses it as the starting point to
         # REBUILD interval history from the mon's old maps — intervals

@@ -12,14 +12,18 @@ import numpy as np
 
 from ..common.crc32c import crc32c
 from ..common.failpoint import FailpointCrash, FailpointError, failpoint
+from ..common.tracer import TRACER, TraceCtx, op_trace, set_op_trace, \
+    trace_now
 from ..store.object_store import NotFound
 from .messages import (
+    MECSubOpRead,
     MECSubOpWrite,
     MPGClean,
     MPGPull,
     MPGPullReply,
     MPGQuery,
     pack_data,
+    unpack_data,
 )
 from ..osd.osdmap import PG_POOL_ERASURE
 from ..osd.osdmap import OSDMap  # noqa: F401 (annotations)
@@ -48,7 +52,27 @@ class RecoveryMixin:
                 # self-deadlocks.  _recover_pg locks its push phase.
                 try:
                     self._recover_pg(pg, pool, acting)
+                    with self._lock:
+                        self._recovery_failures.pop(pg.pgid, None)
+                except FailpointCrash:
+                    # a simulated abort must propagate like a real one
+                    # (the failpoint contract) — never count as a
+                    # recoverable per-PG failure
+                    raise
                 except Exception as e:
+                    # cephheal: a per-tick failure is a counted,
+                    # traced, health-visible event — not a dout line
+                    # that scrolls away (satellite: repeat-failing PGs
+                    # surface in RECOVERY_STALLED via _mgr_report)
+                    self.logger.inc("recovery_errors")
+                    TRACER.tracepoint(
+                        "recovery", "error", entity=self.whoami,
+                        pgid=pg.pgid, error=repr(e))
+                    with self._lock:
+                        ent = self._recovery_failures.setdefault(
+                            pg.pgid, [0, ""])
+                        ent[0] += 1
+                        ent[1] = repr(e)
                     self.cct.dout(
                         "osd", 1,
                         f"{self.whoami} recover {pg.pgid}: {e!r}",
@@ -141,6 +165,43 @@ class RecoveryMixin:
             self._save_intervals(pg)
 
     def _recover_pg(self, pg: PGState, pool, acting: list[int]) -> None:
+        """cephheal wrapper: one recovery pass = one traceable,
+        TrackedOp-registered background op.  The ctx is born HERE (the
+        recovery analog of op_submit) with the same head-coin-flip +
+        tail-provisional contract, so a slow recovery keeps its
+        connected tree at trace_sampling_rate=0; the TrackedOp
+        (src="recovery") puts multi-second pulls into
+        dump_historic_slow_ops.  The body is _recover_pg_inner —
+        exceptions propagate to _recover_all's error accounting."""
+        # "osd.recovery.tick": an error action fails this PG's whole
+        # pass at the top of every tick — the deterministic driver for
+        # the repeat-failing-PG health surface (docs/fault_injection.md)
+        failpoint("osd.recovery.tick", cct=self.cct, entity=self.whoami,
+                  pgid=pg.pgid)
+        ctx = self._bg_trace_ctx()
+        root = None
+        if ctx is not None:
+            root = TRACER.begin(ctx, "recovery", entity=self.whoami,
+                                pgid=pg.pgid)
+        tracked = self.op_tracker.create(
+            f"recovery({pg.pgid})", src="recovery")
+        tracked.trace_id = ctx.trace_id if ctx is not None else None
+        prev = op_trace()
+        set_op_trace({
+            "ctx": root.ctx() if root is not None else ctx,
+            "tracked": tracked,
+        })
+        try:
+            self._recover_pg_inner(pg, pool, acting)
+        finally:
+            set_op_trace(prev)
+            TRACER.end(root)
+            tracked.finish()
+            if TRACER.enabled and tracked.trace_id is not None:
+                self._bg_tail_verdict(tracked)
+
+    def _recover_pg_inner(self, pg: PGState, pool,
+                          acting: list[int]) -> None:
         is_ec = pool.type == PG_POOL_ERASURE
         codec = self._codec_for_pool(pool) if is_ec else None
         # one query round: peer versions + object lists drive the
@@ -148,6 +209,8 @@ class RecoveryMixin:
         # delete propagation
         peers: dict[tuple[int, int], tuple[int, list]] = {}
         peer_epochs: list[int] = []
+        t_peer0 = trace_now()
+        queried = 0
         for shard, osd in enumerate(acting):
             if osd < 0 or osd == self.id or not self.osdmap.is_up(osd):
                 continue
@@ -162,6 +225,7 @@ class RecoveryMixin:
                 )
             except (OSError, ConnectionError):
                 continue
+            queried += 1
             rep = self._wait_reply(tid, timeout=5.0)
             if rep is None or rep.version is None:
                 continue
@@ -169,6 +233,11 @@ class RecoveryMixin:
             e = getattr(rep, "last_epoch", None)
             if e:
                 peer_epochs.append(int(e))
+        if queried:
+            # sampled only when a query actually went out — the
+            # every-tick idle pass must not drown the histogram
+            self._bg_stage("recovery_peer", t_peer0, trace_now(),
+                           peers=len(peers), queried=queried)
         interval_at_entry = pg.interval_start
         # history rebuild (reference: pg_history_t carried in notifies +
         # PastIntervals built over past OSDMaps): when this primary has
@@ -274,15 +343,31 @@ class RecoveryMixin:
             except (NotFound, KeyError):
                 my_oids = []
             tid = self._next_tid()
+            # span opened BEFORE the send so the MPGPull carries its id
+            # as parent — the donor's rebuild/push spans join THIS node
+            # (the subop fan-out pattern from PR 9)
+            pull_span = TRACER.begin(
+                self._op_trace_ctx(), "recovery_pull",
+                entity=self.whoami, donor=f"osd.{b_osd}",
+            ) if TRACER.enabled else None
+            t_pull0 = pull_span.t0 if pull_span is not None else trace_now()
             try:
                 self._conn_to_osd(b_osd).send_message(MPGPull(
                     tid=tid, pgid=pg.pgid, shard=my_shard,
                     from_version=pg.version, epoch=self.my_epoch(),
                     have_oids=my_oids,
+                    trace_id=(pull_span.trace_id
+                              if pull_span is not None else None),
+                    parent_span=(pull_span.span_id
+                                 if pull_span is not None else None),
                 ))
                 rep = self._wait_reply(tid, timeout=30.0)
             except (OSError, ConnectionError):
                 rep = None
+            self._bg_stage(
+                "recovery_pull", t_pull0, trace_now(), span=pull_span,
+                donor=f"osd.{b_osd}",
+                retval=rep.retval if rep is not None else None)
             if rep is not None and rep.retval == 0:
                 self.cct.dout(
                     "osd", 1,
@@ -334,16 +419,47 @@ class RecoveryMixin:
                     f"from osd.{donor}",
                 )
                 tid = self._next_tid()
+                heal_span = TRACER.begin(
+                    self._op_trace_ctx(), "recovery_pull",
+                    entity=self.whoami, donor=f"osd.{donor}",
+                    role_heal=True,
+                ) if TRACER.enabled else None
+                t_heal0 = (heal_span.t0 if heal_span is not None
+                           else trace_now())
                 try:
                     self._conn_to_osd(donor).send_message(MPGPull(
                         tid=tid, pgid=pg.pgid, shard=my_shard,
                         from_version=0, epoch=self.my_epoch(),
                         have_oids=sorted(my_oids),
+                        trace_id=(heal_span.trace_id
+                                  if heal_span is not None else None),
+                        parent_span=(heal_span.span_id
+                                     if heal_span is not None else None),
                     ))
                     self._wait_reply(tid, timeout=30.0)
                 except (OSError, ConnectionError):
                     pass
+                self._bg_stage("recovery_pull", t_heal0, trace_now(),
+                               span=heal_span, donor=f"osd.{donor}",
+                               role_heal=True)
                 my_oids = _my_oids()
+        # cephheal pg_stats: object-copies this PG's LIVE peers are
+        # missing (down/absent shards are counted live by _mgr_report
+        # from its store walk — this is the recoverable-by-push half
+        # the report cannot see).  Per-pass granularity; the push
+        # helpers decrement as objects land so a long backfill drains
+        # visibly between passes.
+        degraded = 0
+        for (shard, osd), (peer_ver, peer_oids) in peers.items():
+            role_missing_n = len(my_oids - set(peer_oids))
+            if peer_ver >= pg.version:
+                degraded += role_missing_n
+            elif pg.log.covers(peer_ver):
+                newest, _d = pg.log.missing_since(peer_ver)
+                degraded += max(len(newest), role_missing_n)
+            else:
+                degraded += max(len(my_oids), role_missing_n)
+        pg.stat_degraded_peers = degraded
         # push phase: serialize vs concurrent client writes on this PG
         all_clean = True
         with pg.lock:
@@ -365,16 +481,23 @@ class RecoveryMixin:
                         f"{self.whoami} role-backfill {pg.pgid} shard "
                         f"{shard} osd.{osd}: {len(role_missing)} objects",
                     )
+                    t_rb0 = trace_now()
                     self._push_objects(
                         pg, codec, acting, shard if is_ec else 0, osd,
                         {o: None for o in sorted(role_missing)},
                         set(peer_oids) - my_oids, is_ec,
                     )
+                    self._bg_stage("recovery_push", t_rb0, trace_now(),
+                                   peer=f"osd.{osd}", shard=shard,
+                                   mode="role_backfill",
+                                   objects=len(role_missing))
                 else:
                     self._push_missing(
                         pg, codec, acting, shard if is_ec else 0, osd,
                         peer_ver, is_ec, peer_oids,
                     )
+        if all_clean:
+            pg.stat_degraded_peers = 0
         # prune the interval history once the PG is CLEAN in the current
         # interval (reference: last_epoch_clean).  "Clean" demands a
         # FULL acting set in which every member answered and needed no
@@ -419,7 +542,22 @@ class RecoveryMixin:
     def _push_missing(self, pg, codec, acting, dest_shard, dest_osd,
                       from_version, is_ec, dest_oids) -> bool:
         """Classify delta vs backfill, push, seal — shared by the primary
-        push loop and the pull donor.  Counters are started/completed
+        push loop and the pull donor; one `recovery_push` stage sample /
+        span per round, whichever side runs it (cephheal)."""
+        t0 = trace_now()
+        ok = self._push_missing_inner(
+            pg, codec, acting, dest_shard, dest_osd, from_version,
+            is_ec, dest_oids,
+        )
+        self._bg_stage(
+            "recovery_push", t0, trace_now(), peer=f"osd.{dest_osd}",
+            shard=dest_shard, ok=ok,
+            mode="delta" if pg.log.covers(from_version) else "backfill")
+        return ok
+
+    def _push_missing_inner(self, pg, codec, acting, dest_shard, dest_osd,
+                            from_version, is_ec, dest_oids) -> bool:
+        """Counters are started/completed
         pairs: stat_delta_recoveries / stat_backfills count rounds
         STARTED (race-free for observers — an ack lost after the peer
         applied would leave a completed-only counter at zero), the
@@ -482,6 +620,24 @@ class RecoveryMixin:
         let the seal vouch for entries never sent; the requester holds
         no lock while waiting, so there is no cross-OSD lock cycle."""
         retval = -5
+        # cephheal: the donor's half of the recovery tree — its rebuild
+        # and push spans parent to the requester's recovery_pull span
+        # carried on the wire, and the work rides a src="recovery"
+        # TrackedOp so a multi-second donor push is slow-op-visible
+        donor_span = None
+        if TRACER.enabled and getattr(msg, "trace_id", None) is not None:
+            donor_span = TRACER.begin(
+                TraceCtx(msg.trace_id, msg.parent_span), "recovery_donor",
+                entity=self.whoami, pgid=msg.pgid, requester=msg.src,
+            )
+        tracked = self.op_tracker.create(
+            f"recovery_donor({msg.pgid} -> {msg.src})", src="recovery")
+        tracked.trace_id = getattr(msg, "trace_id", None)
+        prev = op_trace()
+        set_op_trace({
+            "ctx": donor_span.ctx() if donor_span is not None else None,
+            "tracked": tracked,
+        })
         try:
             # "osd.recovery.pull": an error action makes this donor fail
             # the catch-up request (the requester retries next pass,
@@ -516,9 +672,22 @@ class RecoveryMixin:
             self.cct.dout(
                 "osd", 0, f"{self.whoami} pg pull failed: {e!r}"
             )
+        finally:
+            set_op_trace(prev)
+            TRACER.end(donor_span, retval=retval)
+            tracked.finish()
+            if TRACER.enabled and tracked.trace_id is not None \
+                    and self.op_tracker.complaint_time > 0 \
+                    and tracked.duration() > self.op_tracker.complaint_time:
+                # promote only — the requester's verdict owns the
+                # discard (promote wins over discard, PR-11 rule)
+                TRACER.promote(tracked.trace_id, reason="recovery_donor")
         try:
             conn.send_message(MPGPullReply(
-                tid=msg.tid, pgid=msg.pgid, shard=msg.shard, retval=retval
+                tid=msg.tid, pgid=msg.pgid, shard=msg.shard,
+                retval=retval,
+                trace_id=getattr(msg, "trace_id", None),
+                parent_span=getattr(msg, "parent_span", None),
             ))
         except (OSError, ConnectionError):
             pass
@@ -557,6 +726,10 @@ class RecoveryMixin:
             # even when empty so a replica's stale keys are cleared
             omap = {"snapshot": {k: pack_data(v) for k, v in kv.items()}}
         tid = self._next_tid()
+        # cephheal: recovery pushes carry the background trace context
+        # (MECSubOpWrite learned the fields in PR 9), so the receiving
+        # shard's replica_commit span joins the recovery tree
+        ctx = self._op_trace_ctx()
         try:
             # "osd.recovery.push": an error action drops this push on the
             # floor — the object stays missing until a later pass
@@ -569,6 +742,8 @@ class RecoveryMixin:
                     crc=crc32c(data) if data is not None else None,
                     version=version, entry=entry, epoch=self.my_epoch(),
                     xattrs=xattrs, over=gen, osize=osize, omap=omap,
+                    trace_id=ctx.trace_id if ctx is not None else None,
+                    parent_span=ctx.span_id if ctx is not None else None,
                 )
             )
         except FailpointCrash:
@@ -628,6 +803,11 @@ class RecoveryMixin:
                     e.to_list(), src_cid=my_cid, osize=size,
                 )
                 self.logger.inc("recovery_ops")
+                if ok:
+                    # live drain for the progress plane: one recovered
+                    # object-copy off the degraded count
+                    pg.stat_degraded_peers = max(
+                        0, pg.stat_degraded_peers - 1)
             else:
                 # superseded modify / clean marker: log-entry-only replay
                 ok = self._push_sub_write(
@@ -664,10 +844,14 @@ class RecoveryMixin:
                 continue
             version = newest[oid]
             entry = [version or 0, "modify", oid]
-            if not self._push_sub_write(
+            if self._push_sub_write(
                 pg, osd, shard, oid, chunk, version, entry, src_cid=my_cid,
                 osize=size,
             ):
+                # live drain for the progress plane (see _push_log_delta)
+                pg.stat_degraded_peers = max(
+                    0, pg.stat_degraded_peers - 1)
+            else:
                 all_ok = False
         return all_ok
 
@@ -696,16 +880,44 @@ class RecoveryMixin:
         """Recompute shard `shard`'s bytes for oid (reference:
         ECBackend::recover_object — read k chunks, re-encode).  `exclude`
         names additional shards whose data must not feed the rebuild
-        (scrub-flagged rot)."""
+        (scrub-flagged rot).
+
+        cephheal: the rebuild first follows the codec's
+        minimum_to_decode plan (_plan_repair_read) — k full helper
+        chunks for an MDS code, d helpers x sub-chunk ranges for CLAY —
+        and only falls back to the historical gather-everything path
+        when the plan cannot be satisfied (stale generations, silent
+        helpers, self-heal).  Every completed rebuild lands one
+        repair-bandwidth accounting record (helper reads, bytes read,
+        bytes repaired) keyed by (pool, codec), and one
+        `recovery_rebuild` stage sample/span."""
+        t_rb0 = trace_now()
+        pool = self.osdmap.pools.get(pg.pool_id) if self.osdmap else None
+        clabel = self._codec_label(pool)
         my_shard = acting.index(self.id)
         if not is_ec:
             try:
                 data = self.store.read(self._cid(pg.pgid, 0), oid)
-                return data, len(data)
             except (NotFound, KeyError):
                 return None, 0
+            self.recovery_acct.record_repair(
+                pg.pool_id, clabel, 1, len(data), len(data))
+            self._bg_stage("recovery_rebuild", t_rb0, trace_now(),
+                           oid=oid, shard=shard)
+            return data, len(data)
         k = codec.get_data_chunk_count()
         n = codec.get_chunk_count()
+        floor = pg.log.obj_newest.get(oid)
+        planned = self._plan_repair_read(pg, codec, acting, oid, shard,
+                                         exclude, floor)
+        if planned is not None:
+            chunk, size, reads, nbytes = planned
+            self.recovery_acct.record_repair(
+                pg.pool_id, clabel, reads, nbytes, len(chunk))
+            self._bg_stage("recovery_rebuild", t_rb0, trace_now(),
+                           oid=oid, shard=shard, planned=True,
+                           helper_reads=reads)
+            return chunk, size
         # include the DEST shard in the gather: the receiver lacks its
         # chunk, but the exact chunk may survive as a stray on a previous
         # holder (acting permutations) — using it directly also rescues
@@ -714,9 +926,10 @@ class RecoveryMixin:
         want = set(range(n)) - (exclude or set())
         sizes: dict[int, int] = {}
         vers: dict[int, int | None] = {}
-        floor = pg.log.obj_newest.get(oid)
         got = self._gather_chunks(pg, codec, acting, oid, want, sizes=sizes,
                                   vers=vers, stray=True, floor=floor)
+        read_bytes = sum(len(b) for b in got.values())
+        n_reads = len(got)
         # never rebuild from a MIX of stripe generations, nor from one
         # the log proves is below the newest write
         got = _current_generation(got, vers, floor)
@@ -726,6 +939,11 @@ class RecoveryMixin:
                     self._cid(pg.pgid, acting.index(self.id)), oid, "size"))
             except (NotFound, KeyError, ValueError):
                 size = sizes.get(shard, next(iter(sizes.values()), 0))
+            self.recovery_acct.record_repair(
+                pg.pool_id, clabel, n_reads, read_bytes,
+                len(got[shard]), full_gather=True)
+            self._bg_stage("recovery_rebuild", t_rb0, trace_now(),
+                           oid=oid, shard=shard, stray_rescue=True)
             return bytes(got[shard]), size
         if len(got) < k:
             return None, 0
@@ -740,4 +958,200 @@ class RecoveryMixin:
         dec = codec.decode(
             {shard}, chunks, len(next(iter(chunks.values())))
         )
-        return np.asarray(dec[shard], np.uint8).tobytes(), size
+        out = np.asarray(dec[shard], np.uint8).tobytes()
+        self.recovery_acct.record_repair(
+            pg.pool_id, clabel, n_reads, read_bytes, len(out),
+            full_gather=True)
+        self._bg_stage("recovery_rebuild", t_rb0, trace_now(),
+                       oid=oid, shard=shard)
+        return out, size
+
+    def _plan_repair_read(
+        self, pg, codec, acting, oid: str, lost: int,
+        exclude: set[int] | None, floor: int | None,
+    ) -> tuple[bytes, int, int, int] | None:
+        """Bandwidth-minimal rebuild of one lost EC shard following the
+        codec's minimum_to_decode plan (reference: ECBackend asks the
+        codec which chunks — and for CLAY which SUB-chunk ranges — a
+        repair must read, instead of fetching every survivor).
+
+        Returns (chunk_bytes, object_size, helper_reads, bytes_read) on
+        success, or None to fall back to the broad-gather path.  The
+        fast path bails on ANY surprise — a silent helper, a
+        generation mismatch against this primary's chunk or the log
+        floor, a sub-chunk geometry it cannot verify — because the
+        fallback path owns stray hunting and mixed-generation
+        arbitration; this path only claims the healthy common case,
+        which is where the bandwidth goes (arXiv:1412.3022)."""
+        my_shard = acting.index(self.id)
+        if lost == my_shard:
+            return None  # self-heal: no local generation/size anchor
+        my_cid = self._cid(pg.pgid, my_shard)
+        try:
+            failpoint("osd.ec.shard_read", cct=self.cct,
+                      entity=self.whoami, pgid=pg.pgid, shard=my_shard,
+                      oid=oid)
+            mine = bytes(self.store.read(my_cid, oid))
+        except FailpointCrash:
+            raise
+        except (FailpointError, NotFound, KeyError):
+            return None
+        try:
+            stored = int(self.store.getattr(my_cid, oid, "hinfo"))
+        except (NotFound, KeyError, ValueError):
+            stored = None
+        if not mine or (stored is not None and crc32c(mine) != stored):
+            return None
+        my_ver = self._stored_ver(my_cid, oid)
+        target = floor
+        if my_ver is not None:
+            if floor is not None and my_ver != floor:
+                return None  # our own chunk is off-generation
+            target = my_ver
+        try:
+            size = int(self.store.getattr(my_cid, oid, "size"))
+        except (NotFound, KeyError, ValueError):
+            return None
+        avail = {
+            s for s, o in enumerate(acting)
+            if o >= 0 and s != lost and self.osdmap.is_up(o)
+        } - (exclude or set())
+        if my_shard not in avail:
+            return None
+        try:
+            plan = codec.minimum_to_decode({lost}, avail)
+        except Exception:
+            return None
+        if lost in plan:
+            return None  # plan wants the lost chunk itself: nonsense here
+        helpers = sorted(plan)
+        full_plan = all(
+            len(r) == 1 and tuple(r[0]) == (0, -1)
+            for r in plan.values()
+        )
+        if full_plan:
+            return self._plan_full_reads(
+                pg, codec, acting, oid, lost, helpers, mine, my_shard,
+                my_ver, target, size)
+        return self._plan_subchunk_reads(
+            pg, codec, acting, oid, lost, plan, helpers, mine, my_shard,
+            my_ver, target, size)
+
+    def _plan_full_reads(self, pg, codec, acting, oid, lost, helpers,
+                         mine, my_shard, my_ver, target, size):
+        """MDS plan: exactly the k planned full chunks feed the decode
+        — reads/repaired lands at the textbook k, not n-1.  The local
+        chunk joins the decode only when the PLAN names it (the default
+        MDS plan picks the k lowest available shards, which may not
+        include this primary's own) — it still anchors chunk_size,
+        generation, and object size either way."""
+        vers: dict[int, int | None] = {my_shard: my_ver}
+        got = self._gather_chunks(
+            pg, codec, acting, oid, set(helpers) - {my_shard},
+            vers=vers, stray=False)
+        if my_shard in helpers:
+            got[my_shard] = mine
+        if set(got) != set(helpers):
+            return None  # a planned helper went silent: fall back
+        for v in vers.values():
+            if v is not None and v != target:
+                if target is None:
+                    target = v
+                else:
+                    return None  # mixed generations: fall back
+        if any(len(b) != len(mine) for b in got.values()):
+            return None
+        chunks = {s: np.frombuffer(bytes(b), np.uint8)
+                  for s, b in got.items()}
+        try:
+            dec = codec.decode({lost}, chunks, len(mine))
+            out = np.asarray(dec[lost], np.uint8).tobytes()
+        except Exception:
+            return None
+        nbytes = sum(len(b) for b in got.values())
+        return out, size, len(got), nbytes
+
+    def _plan_subchunk_reads(self, pg, codec, acting, oid, lost, plan,
+                             helpers, mine, my_shard, my_ver, target,
+                             size):
+        """CLAY plan: fetch only the repair-plane sub-chunk ranges from
+        each of the d helpers (ranged MECSubOpRead — hinfo-verified
+        server-side) and rebuild through the codec's cached repair
+        matrix: the live d/q-of-a-chunk repair bandwidth the bench
+        measured offline, now on the recovery path."""
+        if not hasattr(codec, "repair_matrix"):
+            return None
+        Z = codec.get_sub_chunk_count()
+        chunk_size = len(mine)
+        if Z <= 1 or chunk_size % Z:
+            return None
+        sub_len = chunk_size // Z
+        nB = len(codec.repair_planes(lost))
+        fetched: dict[int, np.ndarray] = {}
+        bytes_read = 0
+        for h in helpers:
+            ranges = [tuple(r) for r in plan[h]]
+            if ranges == [(0, -1)]:
+                byte_ranges = [(0, chunk_size)]
+            else:
+                byte_ranges = [(off * sub_len, cnt * sub_len)
+                               for off, cnt in ranges]
+            want_len = sum(ln for _o, ln in byte_ranges)
+            if h == my_shard:
+                buf = b"".join(mine[o:o + ln] for o, ln in byte_ranges)
+                ver = my_ver
+            else:
+                buf, ver = self._fetch_shard_ranges(
+                    pg, acting, h, oid, byte_ranges)
+            if buf is None or len(buf) != want_len:
+                return None
+            if ver is not None:
+                if target is None:
+                    target = ver
+                elif ver != target:
+                    return None  # stale-generation helper: fall back
+            rows = np.frombuffer(buf, np.uint8).reshape(-1, sub_len)
+            if rows.shape[0] not in (nB, Z):
+                return None
+            if rows.shape[0] == Z:
+                # a full-chunk helper (want&avail merge case): slice
+                # its repair planes for the stacked input
+                rows = rows[np.asarray(codec.repair_planes(lost))]
+            fetched[h] = rows
+            bytes_read += want_len
+        try:
+            from ..ops.bitplane import apply_matrix_jax
+
+            M = codec.repair_matrix(lost, tuple(helpers))
+            x = np.concatenate([fetched[h] for h in helpers])
+            out = np.asarray(apply_matrix_jax(M, x), np.uint8)
+            chunk = out.reshape(Z * sub_len).tobytes()
+        except Exception:
+            return None
+        return chunk, size, len(helpers), bytes_read
+
+    def _fetch_shard_ranges(self, pg, acting, shard: int, oid: str,
+                            byte_ranges: list[tuple[int, int]]):
+        """(concatenated bytes of `byte_ranges` from one shard's stored
+        chunk, that shard's per-object version) via one multi-range
+        MECSubOpRead; (None, None) on any failure.  The serving side
+        verifies the WHOLE chunk's hinfo before slicing
+        (subops._handle_sub_read), so rot cannot ride a ranged read."""
+        osd = acting[shard] if shard < len(acting) else -1
+        if osd < 0 or not self.osdmap.is_up(osd):
+            return None, None
+        tid = self._next_tid()
+        try:
+            self._conn_to_osd(osd).send_message(
+                MECSubOpRead(
+                    tid=tid, pgid=pg.pgid, oid=oid, shard=shard,
+                    offsets=[[o, ln] for o, ln in byte_ranges],
+                    epoch=self.my_epoch(),
+                )
+            )
+        except (OSError, ConnectionError):
+            return None, None
+        rep = self._wait_reply(tid)
+        if rep is None or rep.retval != 0:
+            return None, None
+        return unpack_data(rep.data), getattr(rep, "ver", None)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from ..common.crc32c import crc32c
 from ..common.failpoint import FailpointCrash, FailpointError, failpoint
+from ..common.tracer import TRACER, op_trace, set_op_trace, trace_now
 from ..store.object_store import NotFound, Transaction
 from .messages import (
     MECSubOpRead,
@@ -105,15 +106,51 @@ class ScrubMixin:
             pass
 
     def scrub_pg(self, pool_id: int, ps: int, repair: bool = True) -> dict:
+        """cephheal wrapper around _scrub_pg_inner: one scrub = one
+        traceable, TrackedOp-registered background op (src="scrub"),
+        with the same head-coin-flip + tail-provisional trace contract
+        client ops get — a slow scrub keeps its tree at sampling=0 and
+        shows up in dump_historic_slow_ops."""
+        # "osd.scrub.start": error aborts the scrub before any shard map
+        # is collected; delay stretches the scrub window
+        failpoint("osd.scrub.start", cct=self.cct, entity=self.whoami,
+                  pgid=f"{pool_id}.{ps}")
+        ctx = self._bg_trace_ctx()
+        root = None
+        if ctx is not None:
+            root = TRACER.begin(ctx, "scrub", entity=self.whoami,
+                                pgid=f"{pool_id}.{ps}", repair=repair)
+        tracked = self.op_tracker.create(
+            f"scrub({pool_id}.{ps})", src="scrub")
+        tracked.trace_id = ctx.trace_id if ctx is not None else None
+        # save/restore the op-trace state: a scrub driven through the
+        # client `scrub` op runs on an op thread that already carries
+        # the client op's state
+        prev = op_trace()
+        set_op_trace({
+            "ctx": root.ctx() if root is not None else ctx,
+            "tracked": tracked,
+        })
+        try:
+            result = self._scrub_pg_inner(pool_id, ps, repair)
+            TRACER.end(root, errors=len(result.get("errors") or ()),
+                       repaired=result.get("repaired", 0))
+            root = None
+            return result
+        finally:
+            set_op_trace(prev)
+            TRACER.end(root)  # error path: close unconditionally
+            tracked.finish()
+            if TRACER.enabled and tracked.trace_id is not None:
+                self._bg_tail_verdict(tracked)
+
+    def _scrub_pg_inner(self, pool_id: int, ps: int,
+                        repair: bool = True) -> dict:
         """Deep scrub one PG from its primary: collect every shard's
         ScrubMap, flag shards whose at-rest bytes rotted under their own
         digest or that miss objects others hold, and (repair=True) rebuild
         those shards from the surviving ones (reference:
         PrimaryLogPG::scrub_compare_maps + repair_object)."""
-        # "osd.scrub.start": error aborts the scrub before any shard map
-        # is collected; delay stretches the scrub window
-        failpoint("osd.scrub.start", cct=self.cct, entity=self.whoami,
-                  pgid=f"{pool_id}.{ps}")
         m = self.osdmap
         pool = m.pools.get(pool_id) if m else None
         if pool is None:
@@ -128,6 +165,7 @@ class ScrubMixin:
         # only produce a false positive whose "repair" re-pushes current,
         # consistent bytes).  pg.lock is taken per-object for repairs, so
         # a slow shard never blocks client I/O for the whole scrub.
+        t_read0 = trace_now()
         maps: dict[int, dict] = {}
         tids: dict[int, int] = {}
         for shard, osd in enumerate(acting):
@@ -152,7 +190,10 @@ class ScrubMixin:
             rep = self._wait_reply(tid, timeout=10.0)
             if rep is not None:
                 maps[shard] = rep.objects or {}
+        self._bg_stage("scrub_read", t_read0, trace_now(),
+                       shards=len(maps))
 
+        t_cmp0 = trace_now()
         all_oids: set[str] = set()
         for sm in maps.values():
             all_oids |= set(sm)
@@ -211,8 +252,11 @@ class ScrubMixin:
                     )
             self.logger.inc("scrubs")
             self.logger.inc("scrub_errors", len(errors))
+        self._bg_stage("scrub_compare", t_cmp0, trace_now(),
+                       objects=len(all_oids), errors=len(errors))
         repaired = 0
         if repair and errors:
+            t_rep0 = trace_now()
             # shards known-bad per oid: their chunks must not feed a
             # rebuild (decoding from a rotted chunk would launder the
             # corruption into a fresh self-consistent digest)
@@ -273,6 +317,8 @@ class ScrubMixin:
                     ):
                         repaired += 1
             self.logger.inc("scrub_repairs", repaired)
+            self._bg_stage("scrub_repair", t_rep0, trace_now(),
+                           repaired=repaired, errors=len(errors))
         return {
             "pgid": pg.pgid,
             "shards": len(maps),

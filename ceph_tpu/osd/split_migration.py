@@ -13,7 +13,7 @@ from ..store.object_store import NotFound
 from .messages import (
     MOSDOp,
 )
-from ..osd.osdmap import object_ps
+from ..osd.osdmap import PG_POOL_ERASURE, object_ps
 from .messages import MOSDPingMsg
 from .pg import CLONE_SEP
 
@@ -210,6 +210,7 @@ class SplitMigrationMixin:
         num_objects = 0
         pool_bytes: dict[int, int] = {}
         pool_objects: dict[int, int] = {}
+        coll_objects: dict[str, int] = {}  # cid -> objects (pg rows below)
         try:
             coll_bytes = self.store.collections_bytes()  # one index pass
         except Exception:
@@ -232,6 +233,7 @@ class SplitMigrationMixin:
                 self.cct.dout("osd", 10,
                               f"{self.whoami} stats skipped {cid}: {e!r}")
                 continue
+            coll_objects[cid] = n_here
             num_objects += n_here
             if pool_id is not None:
                 pool_bytes[pool_id] = (
@@ -253,7 +255,7 @@ class SplitMigrationMixin:
                 if pool is None:
                     continue
                 try:
-                    _up, _upp, acting, prim = m.pg_to_up_acting_osds(
+                    up, _upp, acting, prim = m.pg_to_up_acting_osds(
                         pg.pool_id, pg.ps)
                 except (KeyError, IndexError, ValueError):
                     continue
@@ -268,14 +270,44 @@ class SplitMigrationMixin:
                 peered = (pg.activated_interval == pg.interval_start
                           or (pg.activated_interval < 0
                               and pg.interval_start == 0))
+                # cephheal pg_stats: object count of the primary's own
+                # shard collection (reusing the store walk above), plus
+                # degraded/misplaced object-copy counts — down or
+                # absent acting slots degrade every object LIVE (no
+                # recovery pass needed to see a kill), and the recovery
+                # pass's missing-on-live-peers count rides on top
+                is_ec = pool.type == PG_POOL_ERASURE
+                try:
+                    my_shard = acting.index(self.id) if is_ec else 0
+                except ValueError:
+                    my_shard = 0
+                n_obj = coll_objects.get(self._cid(pg.pgid, my_shard), 0)
+                # missing copies = pool.size minus LIVE members: counts
+                # both EC's positional -1 holes and replicated pools'
+                # COMPACTED acting lists (a down replica is dropped
+                # from acting entirely, never a -1 slot)
+                live_members = sum(
+                    1 for o in acting if o >= 0 and m.is_up(o))
+                down_slots = max(0, pool.size - live_members)
+                degraded = (n_obj * down_slots
+                            + int(getattr(pg, "stat_degraded_peers", 0)))
+                misplaced = n_obj * sum(
+                    1 for a, u in zip(acting, up) if a != u)
                 if peered:
-                    state = ("active+degraded"
-                             if len(acting) < pool.size else "active+clean")
+                    if down_slots:
+                        state = "active+degraded"
+                    elif degraded:
+                        state = "active+recovering+degraded"
+                    else:
+                        state = "active+clean"
                 else:
                     state = "peering"
                 pg_info[pg.pgid] = {
                     "state": state,
                     "version": pg.version,
+                    "objects": n_obj,
+                    "degraded": degraded,
+                    "misplaced": misplaced,
                 }
         try:
             self.messenger.connect((host, int(port))).send_message(
@@ -305,11 +337,26 @@ class SplitMigrationMixin:
                            # accelerator health rides the same stream
                            # SLOW_OPS does: mgr digest -> mon _health
                            "backend_health": backend_health(),
+                           # cephheal: PGs whose recovery pass has
+                           # raised >= 3 consecutive ticks — surfaced
+                           # in RECOVERY_STALLED instead of scrolling
+                           # away at dout level 1
+                           "recovery_failing": self._failing_pgs(),
                            "pg_info": pg_info},
                 )
             )
         except (OSError, ConnectionError, ValueError):
             pass  # mgr down: retry next interval
+
+    def _failing_pgs(self, threshold: int = 3) -> dict:
+        """{pgid: {"count", "error"}} for PGs whose _recover_pg has
+        raised `threshold`+ consecutive ticks (reset on a clean pass)."""
+        with self._lock:
+            return {
+                pgid: {"count": ent[0], "error": ent[1]}
+                for pgid, ent in self._recovery_failures.items()
+                if ent[0] >= threshold
+            }
 
     def _heartbeat(self) -> None:
         """Ping peers sharing PGs with us (reference: OSD::heartbeat);

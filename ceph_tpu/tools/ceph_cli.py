@@ -46,6 +46,44 @@ def _render_status(res: dict, out, detail: bool = False) -> None:
     if pgs:
         parts = ", ".join(f"{n} {s}" for s, n in sorted(pgs.items()))
         print(f"  pgs: {parts}", file=out)
+    # cephheal: one-line recovery bar per in-flight progress event
+    # (reference: the progress-module bars at the bottom of `ceph -s`)
+    for ev in (res.get("progress") or {}).get("events") or []:
+        print(f"  progress: {_progress_bar(ev)}", file=out)
+
+
+def _progress_bar(ev: dict, width: int = 20) -> str:
+    """`recovery of pg 1.3: [=======.....] 58% (eta 12s)`"""
+    frac = max(0.0, min(1.0, float(ev.get("progress") or 0.0)))
+    filled = int(round(frac * width))
+    bar = "=" * filled + "." * (width - filled)
+    eta = ev.get("eta_seconds")
+    tail = f" (eta {eta:.0f}s)" if isinstance(eta, (int, float)) else ""
+    return f"{ev.get('message')}: [{bar}] {100 * frac:.0f}%{tail}"
+
+
+def _render_progress(res: dict, out) -> None:
+    """`ceph progress`: in-flight bars, stalled PGs, recent completions."""
+    events = res.get("events") or []
+    if not events:
+        print("no recovery events in flight", file=out)
+    for ev in events:
+        extra = ""
+        if ev.get("rate_objects_per_sec"):
+            extra = f"  ({ev['degraded']} degraded, " \
+                    f"{ev['rate_objects_per_sec']}/s)"
+        print(f"  {_progress_bar(ev)}{extra}", file=out)
+    for e in res.get("stalled") or []:
+        print(f"  STALLED: pg {e['pgid']} ({e['degraded']} degraded, "
+              f"no progress for {e['stalled_for']}s)", file=out)
+    for pgid, rec in sorted((res.get("failing") or {}).items()):
+        print(f"  FAILING: pg {pgid} on {rec.get('daemon')} "
+              f"({rec.get('count')} ticks): {rec.get('error')}",
+              file=out)
+    done = res.get("completed") or []
+    for ev in done[-5:]:
+        print(f"  done: {ev.get('message')} in "
+              f"{ev.get('duration', 0):.1f}s", file=out)
 
 
 def _render_tree(rows: list, out) -> None:
@@ -75,7 +113,7 @@ def _build_command(words: list[str]) -> dict:
         "status", "health", "health detail", "mon stat", "osd dump",
         "osd stat",
         "osd tree", "osd pool ls", "osd erasure-code-profile ls",
-        "df", "osd df", "pg dump",
+        "df", "osd df", "pg dump", "progress",
     ):
         if joined == fixed:
             return {"prefix": fixed}
@@ -433,6 +471,8 @@ def main(argv=None, out=sys.stdout) -> int:
         _render_pg_dump(res, out)
     elif cmd["prefix"] == "perf history":
         _render_perf_history(res, out)
+    elif cmd["prefix"] == "progress":
+        _render_progress(res, out)
     else:
         print(json.dumps(res, indent=2, default=str), file=out)
     return 0

@@ -39,6 +39,12 @@
 #    controller off vs on — fails when victim fairness_ratio does not
 #    improve, aggregate GiB/s regresses >10%, victim p99 improves
 #    <1.5x, or the controller never actually pushed settings.
+# 9. recovery smoke (ceph_tpu/qa/recovery_smoke.py): kill/revive an OSD
+#    under 2-client traffic — fails unless PG_DEGRADED raises and
+#    clears, progress events complete at 1.0, degraded objects drain to
+#    0, ceph_recovery_*{pool,codec} series render on the exporter with
+#    a plausible repair ratio (~k for RS), and the tail-promoted
+#    recovery trace tree is connected cross-entity at sampling=0.
 #
 # Analyzers emit SARIF 2.1.0 into qa/_sarif/ (github code-scanning uploads
 # resolve URIs against the repo root, which is where this script runs
@@ -227,5 +233,25 @@ else
     rc=1
 fi
 
-echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json, accounting_smoke.json, qos_smoke.json)"
+echo "== recovery smoke (kill/revive observability) =="
+# PG_DEGRADED/progress raise and clear around a kill/revive under
+# 2-client traffic, ceph_recovery_* renders with a plausible repair
+# ratio, and the recovery trace tree assembles cross-entity at
+# sampling=0 (ceph_tpu/qa/recovery_smoke.py; docs/observability.md)
+python -m ceph_tpu.qa.recovery_smoke > "$OUT_DIR/recovery_smoke.json"
+heal_rc=$?
+if [ $heal_rc -eq 0 ]; then
+    echo "recovery smoke: ok"
+elif python -c "import json; json.load(open('$OUT_DIR/recovery_smoke.json'))" \
+        2>/dev/null; then
+    echo "recovery smoke: FAILED:"
+    python -c "import json; [print(' -', p) for p in json.load(open('$OUT_DIR/recovery_smoke.json'))['problems']]" || true
+    rc=1
+else
+    rm -f "$OUT_DIR/recovery_smoke.json"
+    echo "recovery smoke: ERROR (exit $heal_rc) — scenario crashed"
+    rc=1
+fi
+
+echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json, accounting_smoke.json, qos_smoke.json, recovery_smoke.json)"
 exit $rc

@@ -1,0 +1,461 @@
+"""cephheal gate — recovery/backfill/scrub plane observability
+(ISSUE 13): stage histograms on a real kill/revive recovery,
+repair-bandwidth accounting (RS reads k per repaired shard on the plan
+path; CLAY reads sub-k via sub-chunk ranges), monotonic progress
+fractions reaching 1.0, RECOVERY_STALLED raise-and-clear, the
+repeat-failing-PG surface, and tail-promoted cross-entity recovery
+traces at trace_sampling_rate=0.
+
+Budget note (ROADMAP tier-1 rule): one shared cluster fixture carries
+every cluster-path assertion through a single kill/revive cycle — the
+pure-logic classes (tracker, accounting, tracked-op routing) cost
+milliseconds.
+"""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from ceph_tpu.common.recovery_accounting import RecoveryAccounting
+from ceph_tpu.common.tracer import TRACER, connected_traces
+from ceph_tpu.common.tracked_op import OpTracker
+from ceph_tpu.mgr.progress_module import ProgressTracker
+from ceph_tpu.qa.vstart import LocalCluster
+
+
+def _wait(pred, timeout: float, step: float = 0.15):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+# -- pure logic ---------------------------------------------------------
+
+
+class TestProgressTracker:
+    def test_fraction_monotonic_reaches_one(self):
+        t = ProgressTracker(stalled_grace=5.0)
+        seen = []
+        degraded = [12, 12, 9, 7, 7, 4, 1, 0]
+        for i, d in enumerate(degraded):
+            t.update(float(i), {"1.0": d}, recovery_rate=3.0)
+            evs = t.events()
+            if evs:
+                seen.append(evs[0]["progress"])
+        assert seen == sorted(seen), f"fraction regressed: {seen}"
+        assert not t.events()  # completed
+        done = t.completed()
+        assert len(done) == 1 and done[0]["progress"] == 1.0
+        assert done[0]["pgid"] == "1.0"
+
+    def test_eta_from_drain_rate(self):
+        t = ProgressTracker()
+        t.update(0.0, {"1.1": 10})
+        t.update(1.0, {"1.1": 8})  # 2 objects/s
+        ev = t.events()[0]
+        assert ev["rate_objects_per_sec"] == pytest.approx(2.0)
+        assert ev["eta_seconds"] == pytest.approx(4.0)
+
+    def test_baseline_grows_without_fraction_jump(self):
+        t = ProgressTracker()
+        t.update(0.0, {"1.2": 5})
+        t.update(1.0, {"1.2": 9})  # a later peer reported in
+        ev = t.events()[0]
+        assert ev["baseline"] == 9
+        assert 0.0 <= ev["progress"] <= 1.0
+
+    def test_stalled_detection_and_recovery_rate_veto(self):
+        t = ProgressTracker(stalled_grace=2.0)
+        t.update(0.0, {"1.3": 6}, recovery_rate=0.0)
+        t.update(1.0, {"1.3": 6}, recovery_rate=0.0)
+        assert t.stalled(1.5) == []          # inside the grace
+        assert [e["pgid"] for e in t.stalled(3.0)] == ["1.3"]
+        # cluster recovery running -> not stalled even with no drain
+        t.update(3.5, {"1.3": 6}, recovery_rate=5.0)
+        assert t.stalled(9.0) == []
+
+    def test_regression_keeps_fraction_monotone_and_restarts_stall(self):
+        # a second failure mid-recovery raises degraded WITHOUT
+        # exceeding the baseline: the bar must not walk backward, and
+        # the stall clock must restart (review finding)
+        t = ProgressTracker(stalled_grace=2.0)
+        t.update(0.0, {"1.5": 10}, recovery_rate=0.0)
+        t.update(1.0, {"1.5": 2}, recovery_rate=0.0)
+        assert t.events()[0]["progress"] == pytest.approx(0.8)
+        t.update(1.5, {"1.5": 8}, recovery_rate=0.0)  # regression
+        assert t.events()[0]["progress"] == pytest.approx(0.8)
+        assert t.stalled(3.0) == []      # clock restarted at 1.5
+        assert [e["pgid"] for e in t.stalled(4.0)] == ["1.5"]
+
+    def test_vanished_pg_forgotten(self):
+        t = ProgressTracker(stalled_grace=1.0)
+        t.update(0.0, {"1.4": 3})
+        t.update(100.0, {})  # pool deleted / primary silent
+        assert t.events() == []
+
+
+class TestRecoveryAccounting:
+    def test_ratio_and_rows(self):
+        acct = RecoveryAccounting()
+        for _ in range(3):
+            acct.record_repair("1", "jax", helper_reads=2,
+                               bytes_read=8192, bytes_repaired=4096)
+        acct.record_repair("2", "clay", helper_reads=5,
+                           bytes_read=10240, bytes_repaired=4096)
+        assert acct.ratio("1", "jax") == pytest.approx(2.0)
+        assert acct.ratio("2", "clay") == pytest.approx(2.5)
+        assert acct.ratio("9", "nope") is None
+        dump = acct.dump()
+        rows = {(r["labels"]["pool"], r["labels"]["codec"]): r
+                for r in dump["per_pool"]["rows"]}
+        assert rows[("1", "jax")]["repairs"] == 3
+        assert rows[("1", "jax")]["helper_reads"] == 6
+        assert dump["tracked_pools"] == 2
+        tot = acct.totals()
+        assert tot["bytes_read"] == 3 * 8192 + 10240
+
+    def test_overflow_folds_conserved(self):
+        acct = RecoveryAccounting()
+        for i in range(200):  # past the defensive row cap
+            acct.record_repair(str(i), "jax", 2, 100, 50)
+        tot = acct.totals()
+        assert tot["repairs"] == 200 and tot["bytes_read"] == 200 * 100
+        rows = acct.dump()["per_pool"]["rows"]
+        assert any(r["labels"]["pool"] == "_other_" for r in rows)
+
+
+def test_tracked_op_background_routing():
+    """src routing: background ops keep their own bounded history,
+    slow ones share the slow history, detail lines carry the plane."""
+    trk = OpTracker(history_size=4, complaint_time=0.0)
+    with trk.create("osd_op(write o1)") as _op:
+        pass
+    with trk.create("recovery(1.0)", src="recovery") as _op:
+        pass
+    with trk.create("scrub(1.0)", src="scrub") as _op:
+        pass
+    hist = trk.dump_historic_ops()
+    bg = trk.dump_historic_bg_ops()
+    assert [o["src"] for o in hist["ops"]] == ["client"]
+    assert sorted(o["src"] for o in bg["ops"]) == ["recovery", "scrub"]
+    # slow classification covers the background plane
+    trk2 = OpTracker(history_size=4, complaint_time=0.01)
+    op = trk2.create("recovery(2.0)", src="recovery")
+    op.stage_add("recovery_pull", 0.5)
+    time.sleep(0.02)
+    op.finish()
+    slow = trk2.dump_historic_slow_ops()
+    assert slow["num_ops"] == 1 and slow["ops"][0]["src"] == "recovery"
+    lines = trk2.slow_summaries()
+    assert any("[recovery]" in ln and "recovery_pull" in ln
+               for ln in lines)
+
+
+# -- cluster path -------------------------------------------------------
+
+K, M = 4, 2
+WSIZE = 8192
+RS_POOL, CLAY_POOL = "healrs", "healclay"
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    TRACER.enable(False)
+    TRACER.clear()
+    overrides = {
+        "mgr_report_interval": 0.2,
+        "mgr_digest_interval": 0.2,
+        "mgr_progress_interval": 0.2,
+        "mgr_recovery_stalled_grace": 1.0,
+        "mgr_stale_report_age": 30.0,
+        "trace_enabled": True,
+        "trace_sampling_rate": 0.0,   # head OFF: tail promotion must win
+        "trace_tail_latency_ms": 40.0,
+    }
+    with LocalCluster(n_mons=1, n_osds=K + M, with_mgr=True,
+                      conf_overrides=overrides) as c:
+        c.create_ec_pool(RS_POOL, k=K, m=M, pg_num=2)
+        c.create_ec_pool(CLAY_POOL, k=K, m=M, pg_num=2, plugin="clay")
+        yield c
+    TRACER.enable(False)
+    TRACER.clear()
+
+
+def _acct_rows(c):
+    agg: dict = {}
+    for _i, osd in c.osds.items():
+        rec = osd.cct.perf.dump().get("recovery", {})
+        for row in (rec.get("per_pool") or {}).get("rows", []):
+            key = row["labels"]["codec"]
+            e = agg.setdefault(key, {"bytes_read": 0, "bytes_repaired": 0,
+                                     "helper_reads": 0, "repairs": 0,
+                                     "full_gathers": 0})
+            for f in e:
+                e[f] += row[f]
+    return agg
+
+
+def _hist_counts(c, names):
+    agg = {n: 0 for n in names}
+    for _i, osd in c.osds.items():
+        d = osd.cct.perf.dump().get("osd", {})
+        for n in names:
+            v = d.get(n)
+            agg[n] += (v.get("count", 0) if isinstance(v, dict) else
+                       int(v or 0))
+    return agg
+
+
+def test_kill_revive_recovery_full_surface(cluster):
+    """The tentpole scenario in one cycle: kill -> degraded writes ->
+    PG_DEGRADED + progress events + RECOVERY_STALLED -> revive ->
+    drain to clean; then every observability surface is asserted."""
+    c = cluster
+    rs = c.client("client.rs").open_ioctx(RS_POOL)
+    clay = c.client("client.clay").open_ioctx(CLAY_POOL)
+    for i in range(3):
+        rs.write_full(f"r{i}", bytes([i + 1]) * WSIZE)
+        clay.write_full(f"c{i}", bytes([i + 11]) * WSIZE)
+    c.wait_clean(RS_POOL, timeout=20)
+    c.wait_clean(CLAY_POOL, timeout=20)
+
+    victim = K + M - 1
+    c.kill_osd(victim)
+    rv, _ = c.mon_command({"prefix": "osd down", "id": victim})
+    assert rv == 0
+    for i in range(3, 6):  # degraded writes while the shard is gone
+        rs.write_full(f"r{i}", bytes([i + 1]) * WSIZE)
+        clay.write_full(f"c{i}", bytes([i + 11]) * WSIZE)
+
+    seen = {"deg": False, "ev": False, "stalled": False}
+    fractions: list[float] = []
+
+    def degraded_observed():
+        rv2, st = c.mon_command({"prefix": "status"})
+        if rv2 != 0:
+            return False
+        checks = (st.get("health") or {}).get("checks") or {}
+        seen["deg"] |= "PG_DEGRADED" in checks
+        seen["stalled"] |= "RECOVERY_STALLED" in checks
+        for ev in (st.get("progress") or {}).get("events") or []:
+            seen["ev"] = True
+            fractions.append(ev["progress"])
+        return seen["deg"] and seen["ev"] and seen["stalled"]
+
+    assert _wait(degraded_observed, timeout=12.0), (
+        f"degraded surface incomplete: {seen}")
+
+    c.revive_osd(victim)
+    rv, _ = c.mon_command({"prefix": "osd in", "id": victim})
+
+    def healed():
+        rv2, st = c.mon_command({"prefix": "status"})
+        if rv2 != 0:
+            return False
+        checks = (st.get("health") or {}).get("checks") or {}
+        return not set(checks) & {"PG_DEGRADED", "RECOVERY_STALLED",
+                                  "OSD_DOWN"}
+
+    assert _wait(healed, timeout=30.0), "health checks never cleared"
+
+    # -- progress reached 1.0, fractions monotone while degraded -------
+    rv, prog = c.mon_command({"prefix": "progress"})
+    assert rv == 0, prog
+    assert prog["completed"], "no completed progress events"
+    assert all(e["progress"] == 1.0 for e in prog["completed"])
+
+    # -- stage histograms populated ------------------------------------
+    hists = _hist_counts(c, ("recovery_peer", "recovery_pull",
+                             "recovery_rebuild", "recovery_push"))
+    assert hists["recovery_peer"] > 0
+    assert hists["recovery_rebuild"] > 0
+    assert hists["recovery_push"] > 0
+
+    # -- repair-bandwidth accounting: RS reads k, CLAY reads sub-k -----
+    acct = _acct_rows(c)
+    assert "jax" in acct and "clay" in acct, acct
+    rs_ratio = acct["jax"]["bytes_read"] / acct["jax"]["bytes_repaired"]
+    clay_ratio = (acct["clay"]["bytes_read"]
+                  / acct["clay"]["bytes_repaired"])
+    assert rs_ratio == pytest.approx(K, rel=0.01), acct["jax"]
+    # CLAY(4,2): d=5 helpers x 1/q of a chunk = 2.5 chunk-equivalents
+    assert clay_ratio < K, acct["clay"]
+    assert clay_ratio == pytest.approx(2.5, rel=0.01), acct["clay"]
+    assert acct["jax"]["full_gathers"] == 0
+    assert acct["clay"]["full_gathers"] == 0
+
+    # -- repaired data is bit-correct ----------------------------------
+    for i in range(6):
+        assert rs.read(f"r{i}") == bytes([i + 1]) * WSIZE
+        assert clay.read(f"c{i}") == bytes([i + 11]) * WSIZE
+
+    # -- tail-promoted cross-entity recovery trace at sampling=0 -------
+    spans = TRACER.spans()
+    rec_spans = [s for s in spans if s["name"] == "recovery"]
+    assert rec_spans, "no promoted recovery root spans at sampling=0"
+    connected = connected_traces(spans, root="recovery",
+                                 leaf="replica_commit")
+    assert connected, "recovery tree never reaches a replica_commit"
+    ents = {s["entity"] for s in spans
+            if s["trace_id"] == connected[0]}
+    assert len(ents) >= 2, f"trace not cross-entity: {ents}"
+
+    # -- labeled series render on the prometheus exporter --------------
+    # polled: the repairing OSDs' next MMgrReport (0.2s cadence) may
+    # not have landed the instant the health checks cleared
+    import urllib.request
+
+    url = c.mgr.module("prometheus").url
+    wanted = ('ceph_recovery_bytes_read{', 'ceph_recovery_bytes_repaired{',
+              'codec="clay"', 'qclass="background_recovery"')
+    body = ""
+
+    def series_render():
+        nonlocal body
+        body = urllib.request.urlopen(url, timeout=10).read().decode()
+        return all(w in body for w in wanted)
+
+    assert _wait(series_render, timeout=10.0), (
+        f"missing on exporter: "
+        f"{[w for w in wanted if w not in body]}")
+
+    # -- qos module observes the background classes (observe-only) -----
+    qos = c.mgr.module("qos")
+    qos.observe()            # prime the windowed deltas
+    obs = qos.observe()
+    assert "background_recovery" in obs.background, obs.background
+    assert obs.background["background_recovery"]["depth"] >= 0
+    # the controller never writes background classes
+    plan = __import__(
+        "ceph_tpu.mgr.qos_module", fromlist=["QoSController", "QoSClamps"])
+    decision = plan.QoSController(plan.QoSClamps()).plan(obs)
+    assert not set(decision["classes"]) & {"background_recovery",
+                                           "background_scrub"}
+
+
+def test_repeat_failing_pg_surfaces_in_health(cluster):
+    """osd.recovery.tick=error every pass -> >=3 consecutive failures
+    surface the PG in RECOVERY_STALLED detail (and recovery_errors
+    counts), then clear once the failpoint is lifted."""
+    from ceph_tpu.common.failpoint import registry as fp_registry
+
+    c = cluster
+    fp_registry().set("osd.recovery.tick", "error")
+    try:
+        def failing_visible():
+            rv, st = c.mon_command({"prefix": "status"})
+            if rv != 0:
+                return False
+            chk = ((st.get("health") or {}).get("checks") or {}).get(
+                "RECOVERY_STALLED")
+            return chk is not None and any(
+                "recovery failing" in ln for ln in chk.get("detail") or [])
+
+        assert _wait(failing_visible, timeout=12.0, step=0.3), (
+            "repeat-failing PG never surfaced in RECOVERY_STALLED")
+        assert _hist_counts(c, ("recovery_errors",))["recovery_errors"] > 0
+        rv, prog = c.mon_command({"prefix": "progress"})
+        assert rv == 0 and prog["failing"], prog
+    finally:
+        fp_registry().set("osd.recovery.tick", "off")
+
+    def cleared():
+        rv, st = c.mon_command({"prefix": "status"})
+        checks = (st.get("health") or {}).get("checks") or {}
+        return "RECOVERY_STALLED" not in checks
+
+    assert _wait(cleared, timeout=12.0, step=0.3), (
+        "RECOVERY_STALLED stuck after the failpoint lifted")
+
+
+def test_replicated_pool_kill_raises_degraded():
+    """Replicated pools COMPACT a down replica out of acting (no -1
+    hole), so degraded counting must key off pool.size minus live
+    members, not positional holes (review finding) — a replica kill
+    must still raise PG_DEGRADED and open progress events."""
+    TRACER.enable(False)
+    with LocalCluster(n_mons=1, n_osds=3, with_mgr=True, conf_overrides={
+            "mgr_report_interval": 0.2, "mgr_digest_interval": 0.2,
+            "mgr_progress_interval": 0.2}) as c:
+        c.create_replicated_pool("reppool", size=3, pg_num=2)
+        io = c.client("client.r").open_ioctx("reppool")
+        for i in range(3):
+            io.write_full(f"r{i}", bytes([i + 1]) * WSIZE)
+        c.wait_clean("reppool", timeout=20)
+        c.kill_osd(2)
+        rv, _ = c.mon_command({"prefix": "osd down", "id": 2})
+        assert rv == 0
+        seen = {"deg": False, "ev": False}
+
+        def degraded_seen():
+            rv2, st = c.mon_command({"prefix": "status"})
+            if rv2 != 0:
+                return False
+            checks = (st.get("health") or {}).get("checks") or {}
+            seen["deg"] |= "PG_DEGRADED" in checks
+            seen["ev"] |= bool((st.get("progress") or {}).get("events"))
+            return seen["deg"] and seen["ev"]
+
+        assert _wait(degraded_seen, timeout=12.0), seen
+        c.revive_osd(2)
+
+        def cleared():
+            rv2, st = c.mon_command({"prefix": "status"})
+            return rv2 == 0 and not (
+                (st.get("health") or {}).get("checks") or {})
+
+        assert _wait(cleared, timeout=25.0), "checks never cleared"
+
+
+def test_scrub_stage_histograms_and_repair(cluster):
+    """A scrub with injected at-rest rot populates scrub_read/compare/
+    repair histograms and registers a src='scrub' TrackedOp."""
+    from ceph_tpu.store.object_store import Transaction
+
+    c = cluster
+    # find the primary of RS pg ps=0 and rot one local chunk
+    leader_map = None
+    for _i, osd in c.osds.items():
+        leader_map = osd.osdmap
+        break
+    pool_id = next(pid for pid, p in leader_map.pools.items()
+                   if p.name == RS_POOL)
+    primary = None
+    for i, osd in c.osds.items():
+        try:
+            _acting, prim = osd._acting(pool_id, 0)
+        except KeyError:
+            continue
+        if prim == i:
+            primary = osd
+            break
+    assert primary is not None
+    acting, _p = primary._acting(pool_id, 0)
+    my_shard = acting.index(primary.id)
+    cid = f"{pool_id}.0s{my_shard}"
+    oids = [o for o in primary.store.list_objects(cid)
+            if not o.startswith("_")]
+    assert oids, "primary shard holds no objects for ps 0"
+    t = Transaction()
+    t.write(cid, oids[0], 0, b"\xff" * 16)  # rot under the stored hinfo
+    primary.store.queue_transaction(t)
+
+    rep = primary.scrub_pg(pool_id, 0, repair=True)
+    assert rep["errors"], "scrub missed the injected rot"
+    assert rep["repaired"] >= 1
+
+    hists = _hist_counts(c, ("scrub_read", "scrub_compare",
+                             "scrub_repair"))
+    assert hists["scrub_read"] > 0
+    assert hists["scrub_compare"] > 0
+    assert hists["scrub_repair"] > 0
+    bg = primary.op_tracker.dump_historic_bg_ops()
+    assert any(o["src"] == "scrub" for o in bg["ops"])
