@@ -136,6 +136,7 @@ def run_traffic(
     sampling: float = 0.0,
     arrivals: str = "closed",
     rate: float = 100.0,
+    conf_overrides: dict | None = None,
 ) -> dict:
     """One mode's run; returns ops/GiB-per-s/latency stats.
     sampling > 0 arms cephtrace, head-samples that fraction of ops, and
@@ -164,15 +165,15 @@ def run_traffic(
     # caching artifacts
     pool = [rng.integers(0, 256, (k, L), dtype=np.uint8) for _ in range(8)]
     ename = f"client.traffic-{mode}"
-    cct = CephContext(
-        ename,
-        overrides={
-            "ec_batch_window_ms": window_ms if mode == "batched" else 0.0,
-            "ec_batch_max_stripes": max_stripes,
-            "ec_batch_max_bytes": max_bytes,
-            "trace_enabled": sampling > 0.0,
-        },
-    )
+    overrides = {
+        "ec_batch_window_ms": window_ms if mode == "batched" else 0.0,
+        "ec_batch_max_stripes": max_stripes,
+        "ec_batch_max_bytes": max_bytes,
+        "trace_enabled": sampling > 0.0,
+    }
+    if conf_overrides:
+        overrides.update(conf_overrides)
+    cct = CephContext(ename, overrides=overrides)
     if sampling > 0.0:
         TRACER.clear()  # this run's spans only
     batcher = WriteBatcher(cct, entity=ename)

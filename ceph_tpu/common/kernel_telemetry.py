@@ -58,11 +58,11 @@ _MAX_EVENTS = 256
 
 class _KernelStats:
     """Rich per-kernel record behind the PerfCounters mirror (backends
-    per call, last-call provenance, achieved GiB/s)."""
+    per call, last-call provenance, achieved GiB/s, host-copy volume)."""
 
     __slots__ = ("calls", "bytes_in", "bytes_out", "exec_seconds",
                  "compiles", "backends", "last_backend", "last_ts",
-                 "last_gibps")
+                 "last_gibps", "host_copy_bytes", "sync_points")
 
     def __init__(self):
         self.calls = 0
@@ -74,6 +74,13 @@ class _KernelStats:
         self.last_backend: str | None = None
         self.last_ts: float | None = None
         self.last_gibps: float | None = None
+        # cephdma: bytes this kernel's dispatch seam copied through host
+        # memory (staging packs, host->device commits, device->host
+        # materializations) and how many of its calls were sync points
+        # (blocked on a device round trip) — the pair the device-pool
+        # control-vs-pool audit compares (docs/write_path.md)
+        self.host_copy_bytes = 0
+        self.sync_points = 0
 
     def to_dict(self) -> dict:
         return {
@@ -86,6 +93,8 @@ class _KernelStats:
             "last_backend": self.last_backend,
             "last_ts": self.last_ts,
             "last_gibps": self.last_gibps,
+            "host_copy_bytes": self.host_copy_bytes,
+            "sync_points": self.sync_points,
         }
 
 
@@ -135,6 +144,13 @@ class KernelTelemetry:
                            f"{kernel} steady-state dispatch wall time")
             self.perf._add(f"{kernel}_gibps", "gauge",
                            f"{kernel} last achieved GiB/s (sync calls)")
+            self.perf._add(f"{kernel}_host_copy_bytes", "u64",
+                           f"{kernel} bytes copied through host memory "
+                           f"(staging packs + host<->device transfers "
+                           f"this seam performed)")
+            self.perf._add(f"{kernel}_sync_points", "u64",
+                           f"{kernel} calls that blocked on a device "
+                           f"round trip (the deliberate sync points)")
         return ks
 
     def first_call(self, key: tuple) -> bool:
@@ -149,11 +165,16 @@ class KernelTelemetry:
 
     def record(self, kernel: str, backend: str, seconds: float,
                bytes_in: int = 0, bytes_out: int = 0,
-               compiled: bool = False, synced: bool = False) -> None:
+               compiled: bool = False, synced: bool = False,
+               host_copy_bytes: int = 0) -> None:
         """One kernel dispatch.  `synced` marks calls whose wall time
         covers a device round-trip (result fetched) — only those yield
         an honest achieved-GiB/s sample; async dispatches record wall
-        time only (JAX queues the launch and returns)."""
+        time only (JAX queues the launch and returns).
+        `host_copy_bytes` counts the bytes THIS seam copied through host
+        memory during the call (staging packs, host->device commits,
+        device->host materializations) — each seam counts only its own
+        copies, so summing the counters across kernels stays honest."""
         if not self.enabled:
             return
         now = time.time()
@@ -173,6 +194,9 @@ class KernelTelemetry:
                 ks.compiles += 1
             if gibps is not None:
                 ks.last_gibps = gibps
+            ks.host_copy_bytes += int(host_copy_bytes)
+            if synced:
+                ks.sync_points += 1
         self.perf.inc(f"{kernel}_calls")
         if bytes_in:
             self.perf.inc(f"{kernel}_bytes_in", int(bytes_in))
@@ -182,6 +206,40 @@ class KernelTelemetry:
                        else f"{kernel}_execute", seconds)
         if gibps is not None:
             self.perf.set(f"{kernel}_gibps", gibps)
+        if host_copy_bytes:
+            self.perf.inc(f"{kernel}_host_copy_bytes", int(host_copy_bytes))
+        if synced:
+            self.perf.inc(f"{kernel}_sync_points")
+
+    # -- device-pool mirror (ops/device_pool.py) ---------------------------
+    _POOL_COUNTERS = ("hits", "misses", "evictions", "donations")
+
+    def record_pool(self, hits: int = 0, misses: int = 0,
+                    evictions: int = 0, donations: int = 0,
+                    resident_bytes: int | None = None) -> None:
+        """Mirror device-pool stat deltas into the shared PerfCounters so
+        `device_pool_*` series ride the same perf dump -> MMgrReport ->
+        prometheus pipeline as the kernel records (the pool keeps its own
+        authoritative totals; this is the export seam)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if "device_pool_hits" not in self._declared:
+                self._declared.add("device_pool_hits")
+                for name in self._POOL_COUNTERS:
+                    self.perf._add(
+                        f"device_pool_{name}", "u64",
+                        f"device stripe pool {name} "
+                        f"(ops/device_pool.py; docs/write_path.md)")
+                self.perf._add(
+                    "device_pool_resident_bytes", "gauge",
+                    "device stripe pool free-list residency in bytes")
+        for name, v in (("hits", hits), ("misses", misses),
+                        ("evictions", evictions), ("donations", donations)):
+            if v:
+                self.perf.inc(f"device_pool_{name}", int(v))
+        if resident_bytes is not None:
+            self.perf.set("device_pool_resident_bytes", int(resident_bytes))
 
     # -- fallback latches + event log --------------------------------------
     def record_event(self, kind: str, **fields) -> None:

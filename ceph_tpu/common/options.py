@@ -394,6 +394,28 @@ def default_options() -> OptionTable:
                    "admission (osd/write_batcher.py; docs/qos.md).  "
                    ">= 1.0 disables the per-client share",
                    min=0.01, runtime=True),
+            Option("ec_device_pool", bool, True,
+                   "cephdma: device-resident stripe-buffer pool + fully "
+                   "async encode path (ops/device_pool.py; "
+                   "docs/write_path.md).  On: batcher flushes pack into "
+                   "pooled device buffers, encode through the donated "
+                   "jit, keep parity device-resident through demux, and "
+                   "sync only at each op's encode_wait commit point.  "
+                   "Off (or whenever the backend sentinel has latched "
+                   "degraded): the historical synchronous flush — pack "
+                   "on host, device round trip, fetch on the flusher.  "
+                   "Read at daemon start into the process-wide pool and "
+                   "re-read per flush by the batcher; an injectargs "
+                   "flip also reconfigures the process-wide pool "
+                   "(OSD-registered observer — disengages the stream/"
+                   "decode/recovery paths too; last write wins, like "
+                   "ec_kernel)", runtime=True),
+            Option("ec_device_pool_max_bytes", int, 256 << 20,
+                   "bound on the device stripe pool's free-list "
+                   "residency; past it least-recently-used buffer "
+                   "geometries evict.  Read once at daemon start into "
+                   "the process-wide pool (first daemon wins, like the "
+                   "sentinel policy) — restart to change", min=0),
             Option("kernel_telemetry", bool, True,
                    "per-kernel dispatch telemetry registry "
                    "(common/kernel_telemetry.py): invocation counts, "

@@ -51,7 +51,8 @@ def _gmul(c: int, arr: np.ndarray) -> np.ndarray:
 
 class ClayCodec(ErasureCode):
     def __init__(self, profile: dict | None = None):
-        self._repair_mat_cache: dict[tuple, np.ndarray] = {}
+        #: (lost, helpers) -> (repair matrix, stable digest)
+        self._repair_mat_cache: dict[tuple, tuple[np.ndarray, str]] = {}
         super().__init__(profile)
 
     def init(self, profile: dict) -> None:
@@ -267,10 +268,27 @@ class ClayCodec(ErasureCode):
         ErasureCodeClay::repair's layered host loop; the algebra below IS
         the layered algorithm, run symbolically on coefficient rows
         instead of chunk bytes.)"""
+        return self.repair_matrix_entry(lost, helpers)[0]
+
+    def repair_matrix_entry(self, lost: int,
+                            helpers: tuple[int, ...]) -> tuple:
+        """(repair matrix, its stable digest) — the digest is computed
+        once at cache fill and keys the device bitmatrix cache, so the
+        recovery path's repeated repair applies stop paying a fresh
+        ``M.tobytes()`` host copy per rebuilt chunk (cephdma)."""
         key = (lost, helpers)
         cached = self._repair_mat_cache.get(key)
         if cached is not None:
             return cached
+        M = self._build_repair_matrix(lost, helpers)
+        from ...ops.bitplane import matrix_digest
+
+        ent = (M, matrix_digest(M))
+        self._repair_mat_cache[key] = ent
+        return ent
+
+    def _build_repair_matrix(self, lost: int,
+                             helpers: tuple[int, ...]) -> np.ndarray:
         from ...gf.reference_codec import apply_matrix as gf_apply
 
         nq, Z = self.q, self.sub_chunk_count
@@ -332,7 +350,6 @@ class ClayCodec(ErasureCode):
         M = np.where(
             (dy0 == x0)[:, None], U[lost, zpi], u1 ^ _gmul(GAMMA, u2)
         )
-        self._repair_mat_cache[key] = M
         return M
 
     def gather_repair_input(
@@ -356,9 +373,9 @@ class ClayCodec(ErasureCode):
         from ...ops.bitplane import apply_matrix_jax
 
         helpers = tuple(sorted(have))
-        M = self.repair_matrix(lost, helpers)
+        M, m_key = self.repair_matrix_entry(lost, helpers)
         x = self.gather_repair_input(have, lost, sub_len, helpers)
-        out = np.asarray(apply_matrix_jax(M, x))
+        out = np.asarray(apply_matrix_jax(M, x, mat_key=m_key))
         return out.reshape(self.sub_chunk_count * sub_len)
 
 

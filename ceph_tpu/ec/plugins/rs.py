@@ -113,7 +113,16 @@ class RSCodec(ErasureCode):
         use = avail[: self.k]
         shards = np.stack([np.asarray(chunks[r], dtype=np.uint8) for r in use])
         if self.backend == "jax":
-            data = np.asarray(self._jax_codec.decode(use, shards))
+            # cephdma: the gathered helper stack commits to the device
+            # through the stripe pool (recovery's _rebuild_shard_chunk
+            # and degraded reads both land here), so repeated rebuilds
+            # of one geometry recycle buffers instead of allocating
+            from ...ops.device_pool import POOL
+
+            dev = POOL.put(shards) if POOL.enabled() else shards
+            data = np.asarray(self._jax_codec.decode(use, dev))
+            if dev is not shards:
+                POOL.release(dev)
         elif self.backend == "oracle":
             from ... import native_oracle
 
@@ -184,16 +193,43 @@ class BitmatrixCodec(ErasureCode):
         except ValueError as e:
             raise InvalidProfile(str(e))
         self._gf2_inv = gf2_inv
+        if self.backend == "jax":
+            # stable device-cache key, once per codec (cephdma)
+            from ...ops.bitplane import matrix_digest
+
+            self._B_digest = matrix_digest(self.B)
 
     def get_chunk_size(self, stripe_width: int) -> int:
         base = super().get_chunk_size(stripe_width)
         return -(-base // self.w) * self.w  # w packets per chunk
 
-    def _apply(self, M: np.ndarray, rows: np.ndarray) -> np.ndarray:
+    def _apply(self, M: np.ndarray, rows: np.ndarray,
+               mat_key: str | None = None) -> np.ndarray:
         if self.backend == "jax":
-            from ...ops.bitplane import apply_xor_matrix_jax
+            # cephdma: the packet rows commit through the device stripe
+            # pool and the XOR apply runs the donation-enabled variant
+            # (apply_xor_matrix_dev) — the bitmatrix codecs encode
+            # inline (not batcher-eligible), so this is their whole
+            # pool/donation story; the np.asarray is their deliberate
+            # codec-seam sync
+            from ...ops.bitplane import (
+                apply_xor_matrix_dev,
+                apply_xor_matrix_jax,
+            )
+            from ...ops.device_pool import POOL, donation_supported
 
-            return np.asarray(apply_xor_matrix_jax(M, rows))
+            if POOL.enabled():
+                dev = POOL.put(rows)
+                don = donation_supported()
+                out = np.asarray(apply_xor_matrix_dev(
+                    M, dev, mat_key=mat_key, donate=don))
+                if not don:
+                    # donated buffers are consumed by the kernel; an
+                    # undonated one is dead now and recycles
+                    POOL.release(dev)
+                return out
+            return np.asarray(apply_xor_matrix_jax(M, rows,
+                                                   mat_key=mat_key))
         out = np.zeros((M.shape[0], rows.shape[1]), dtype=np.uint8)
         for r in range(M.shape[0]):
             for j in np.nonzero(M[r])[0]:
@@ -206,7 +242,8 @@ class BitmatrixCodec(ErasureCode):
         if L % self.w:
             raise ValueError(f"chunk length {L} not divisible by w={self.w}")
         rows = data_chunks.reshape(k * self.w, L // self.w)
-        parity = self._apply(self.B, rows)
+        parity = self._apply(self.B, rows,
+                             mat_key=getattr(self, "_B_digest", None))
         return parity.reshape(2, L)
 
     def decode_chunks(self, want_to_read, chunks: dict[int, np.ndarray]):

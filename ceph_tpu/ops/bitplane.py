@@ -23,10 +23,13 @@ identical to the C++ oracle (native/gf_oracle.cc).
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import sys
 import time
+from collections import OrderedDict
 from functools import lru_cache, partial
+from threading import Lock
 
 import jax
 import jax.numpy as jnp
@@ -53,9 +56,10 @@ def pack_bitplanes(bits: jnp.ndarray) -> jnp.ndarray:
     return (b << jnp.asarray(_BIT_IDX)[None, :, None]).sum(axis=1, dtype=jnp.uint8)
 
 
-@partial(jax.jit, static_argnames=())
-def _apply_bitmatrix(B: jnp.ndarray, chunks: jnp.ndarray) -> jnp.ndarray:
-    """(rows*8 x n*8) GF(2) matrix times [n, L] byte chunks -> [rows, L]."""
+def _bitmatrix_body(B: jnp.ndarray, chunks: jnp.ndarray) -> jnp.ndarray:
+    """(rows*8 x n*8) GF(2) matrix times [n, L] byte chunks -> [rows, L]
+    — THE encode math, written once and wrapped below (plain, donated,
+    and fused variants must never diverge byte-wise)."""
     bits = unpack_bitplanes(chunks)
     acc = jax.lax.dot_general(
         B,
@@ -66,14 +70,73 @@ def _apply_bitmatrix(B: jnp.ndarray, chunks: jnp.ndarray) -> jnp.ndarray:
     return pack_bitplanes((acc & 1).astype(jnp.uint8))
 
 
-def apply_matrix_xla(mat: np.ndarray, chunks) -> jnp.ndarray:
+_apply_bitmatrix = jax.jit(_bitmatrix_body)
+
+#: _apply_bitmatrix with the packed stripe buffer DONATED (SNIPPETS.md
+#: [1]/[3] `donation_vector` machinery behind `donate_argnums`): a
+#: flush's input buffer is recycled for the kernel's bitplane workspace/
+#: output instead of allocating fresh — real on donating backends
+#: (TPU/GPU), a no-op annotation on CPU.  The caller must own `chunks`
+#: exclusively (the write batcher's pooled pack does; never donate a
+#: caller-visible array).
+_apply_bitmatrix_donated = jax.jit(_bitmatrix_body, donate_argnums=(1,))
+
+
+def matrix_digest(mat: np.ndarray) -> str:
+    """Stable identity of a coding matrix (shape + bytes) — computed
+    ONCE per codec/cached-decode-matrix and used as the device-cache key
+    so the hot path stops paying a fresh `mat.tobytes()` host copy per
+    stripe (the cephdma satellite fix)."""
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    h = hashlib.sha1(repr(mat.shape).encode())
+    h.update(mat.tobytes())
+    return h.hexdigest()
+
+
+#: digest-keyed device bitmatrix cache (LRU, same bound as the legacy
+#: tobytes-keyed lru_cache); one lock — lookups are dict reads
+_BITMATRIX_BY_KEY: OrderedDict[tuple, jnp.ndarray] = OrderedDict()
+_BITMATRIX_LOCK = Lock()
+_BITMATRIX_MAX = 256
+
+
+def _bitmatrix_for(mat: np.ndarray, mat_key: str | None,
+                   xor: bool = False) -> jnp.ndarray:
+    """Device bitmatrix for `mat`: by precomputed stable digest when the
+    caller holds one (codec hot path — no per-call host copy), else the
+    legacy tobytes-keyed cache."""
+    if mat_key is None:
+        m = np.ascontiguousarray(mat, dtype=np.uint8)
+        if xor:
+            return xor_bitmatrix_device(m.tobytes(), m.shape)
+        return bitmatrix_device(m.tobytes(), m.shape)
+    key = (mat_key, bool(xor))
+    with _BITMATRIX_LOCK:
+        B = _BITMATRIX_BY_KEY.get(key)
+        if B is not None:
+            _BITMATRIX_BY_KEY.move_to_end(key)
+            return B
+    m = np.ascontiguousarray(mat, dtype=np.uint8)
+    B = (jnp.asarray(np.kron(m, np.eye(8, dtype=np.int8))) if xor
+         else jnp.asarray(matrix_to_bitmatrix(m), dtype=jnp.int8))
+    with _BITMATRIX_LOCK:
+        _BITMATRIX_BY_KEY[key] = B
+        _BITMATRIX_BY_KEY.move_to_end(key)
+        while len(_BITMATRIX_BY_KEY) > _BITMATRIX_MAX:
+            _BITMATRIX_BY_KEY.popitem(last=False)
+    return B
+
+
+def apply_matrix_xla(mat: np.ndarray, chunks,
+                     mat_key: str | None = None) -> jnp.ndarray:
     """GF(2^8) matrix (rows x n, uint8 elements) applied to byte chunks via
     the XLA bitplane matmul (bitplanes round-trip through HBM).
 
     Byte-wise GF semantics identical to the oracle's gfo_apply (ISA-L
-    convention) for every technique.
+    convention) for every technique.  `mat_key`: the codec's precomputed
+    stable digest of `mat` — skips the per-call tobytes host copy.
     """
-    B = bitmatrix_device(np.asarray(mat, dtype=np.uint8).tobytes(), mat.shape)
+    B = _bitmatrix_for(mat, mat_key)
     chunks = jnp.asarray(chunks, dtype=jnp.uint8)
     return _apply_bitmatrix(B, chunks)
 
@@ -162,10 +225,15 @@ def clear_fallback_latch() -> bool:
     return was
 
 
-def _apply_matrix_dispatch(mat: np.ndarray, chunks) -> tuple:
+def _apply_matrix_dispatch(mat: np.ndarray, chunks,
+                           mat_key: str | None = None,
+                           donate: bool = False) -> tuple:
     """(result, backend) — the dispatch body of apply_matrix_jax, split
     out so the telemetry wrapper can attribute the call to the backend
-    that actually served it (a latching fallback serves on 'xla')."""
+    that actually served it (a latching fallback serves on 'xla').
+    `donate=True` routes the XLA path through the donation-enabled jit
+    (caller owns `chunks` exclusively — the pooled pack contract); the
+    Pallas route ignores it (its VMEM kernel manages its own buffers)."""
     if _want_pallas():
         from .pallas_gf import apply_matrix_pallas
 
@@ -178,10 +246,128 @@ def _apply_matrix_dispatch(mat: np.ndarray, chunks) -> tuple:
             if forced:
                 raise
             _latch_xla_fallback(e)
-    return apply_matrix_xla(mat, chunks), "xla"
+    if donate:
+        # only take the donated jit where the backend honors donation
+        # (CPU accepts-and-ignores it, with a warning per shape): the
+        # non-donating path is byte-identical, so nothing is lost
+        from .device_pool import donation_supported
+
+        if donation_supported():
+            B = _bitmatrix_for(mat, mat_key)
+            return _apply_bitmatrix_donated(
+                B, jnp.asarray(chunks, dtype=jnp.uint8)), "xla"
+    return apply_matrix_xla(mat, chunks, mat_key=mat_key), "xla"
 
 
-def apply_matrix_jax(mat: np.ndarray, chunks) -> jnp.ndarray:
+def apply_matrix_dev(mat: np.ndarray, chunks, mat_key: str | None = None,
+                     donate: bool = False) -> jnp.ndarray:
+    """Device-resident GF(2^8) matrix apply: same kernel dispatch as
+    apply_matrix_jax, but the result STAYS a device array and the call
+    never blocks — the cephdma async encode seam.  The caller owns the
+    single deliberate sync (its commit-point `np.asarray`) and accounts
+    it there; this records an async (synced=False) telemetry sample with
+    zero host-copy bytes.  `donate=True` recycles `chunks`' device
+    buffer into the kernel (the packed-stripe-buffer donation — `chunks`
+    must be an exclusively-owned device array; a donated buffer is dead
+    to the caller afterward)."""
+    tm = TELEMETRY
+    if not tm.enabled:
+        return _apply_matrix_dispatch(mat, chunks, mat_key, donate)[0]
+    t0 = time.perf_counter()
+    out, backend = _apply_matrix_dispatch(mat, chunks, mat_key, donate)
+    dt = time.perf_counter() - t0
+    shape = getattr(chunks, "shape", None)
+    tm.record(
+        "gf_apply", backend, dt,
+        bytes_in=int(getattr(chunks, "nbytes", 0)),
+        bytes_out=mat.shape[0] * shape[-1] if shape else 0,
+        compiled=tm.first_call(("gf_apply", mat.shape, shape, backend,
+                                donate)),
+    )
+    return out
+
+
+@lru_cache(maxsize=256)
+def _fused_encode_jit(nargs: int, donate: bool):
+    """One jitted program per stripe count: commit of the host stripe
+    args, the column concat, AND the bitplane encode fuse into a single
+    dispatch — the pack never exists as a host staging copy and XLA
+    sees the whole flush (donate=True additionally donates every stripe
+    arg's committed buffer into the kernel)."""
+
+    def body(B, *chunks):
+        x = chunks[0] if len(chunks) == 1 else \
+            jnp.concatenate(chunks, axis=1)
+        return _bitmatrix_body(B, x)
+
+    return jax.jit(body, donate_argnums=tuple(range(1, nargs + 1))
+                   if donate else ())
+
+
+def fused_bucket(n: int) -> int:
+    """The arity fused_encode_async actually dispatches for `n` stripes
+    (next power of two; pads are zero stripes) — exposed so the flush
+    seam's host-copy accounting can charge the REAL transfer volume,
+    pads included."""
+    return 1 << max(0, n - 1).bit_length() if n > 1 else 1
+
+
+def fused_encode_async(mat: np.ndarray, chunks_list,
+                       mat_key: str | None = None,
+                       donate: bool = False) -> jnp.ndarray:
+    """Fused multi-stripe encode, fully async: [k, L] stripes (host or
+    device) -> ONE device-resident [m, sum(L)] parity array in ONE
+    dispatch, bit-identical to apply_matrix_jax on the host-concatenated
+    pack.  The cephdma flush seam: no host staging pack, no fetch — the
+    caller owns the single commit-point materialization and its
+    accounting.  `donate=True` donates the stripes' committed buffers
+    into the kernel on backends that honor donation."""
+    n = len(chunks_list)
+    if _want_pallas():
+        # the Pallas VMEM kernel keeps its own packing; hand it the
+        # host pack and stay async through apply_matrix_dev
+        packed = chunks_list[0] if n == 1 else \
+            np.concatenate([np.asarray(c) for c in chunks_list], axis=1)
+        return apply_matrix_dev(mat, packed, mat_key=mat_key,
+                                donate=donate)
+    if donate:
+        from .device_pool import donation_supported
+
+        donate = donation_supported()
+    # bucket the arity to the next power of two with zero stripes (the
+    # extra parity columns are zeros past every caller's demux window):
+    # a traffic run's stripe counts drift over 1..max_stripes, and an
+    # unbucketed jit compiles per DISTINCT count — measured as 200 ms+
+    # p99 stalls whenever a novel count appeared mid-run.  7 variants
+    # warm quickly; the pad waste is bounded at <2x and the pads are
+    # fresh zeros (donation-safe: every donated arg a distinct buffer).
+    bucket = fused_bucket(n)
+    if bucket > n:
+        shape = chunks_list[0].shape
+        chunks_list = list(chunks_list) + [
+            np.zeros(shape, dtype=np.uint8) for _ in range(bucket - n)]
+    B = _bitmatrix_for(mat, mat_key)
+    fn = _fused_encode_jit(bucket, donate)
+    tm = TELEMETRY
+    if not tm.enabled:
+        return fn(B, *chunks_list)
+    t0 = time.perf_counter()
+    out = fn(B, *chunks_list)
+    dt = time.perf_counter() - t0
+    # bytes_in counts what was actually committed, pads included
+    tm.record(
+        "gf_apply", "xla", dt,
+        bytes_in=sum(int(getattr(c, "nbytes", 0)) for c in chunks_list),
+        bytes_out=mat.shape[0] * n * chunks_list[0].shape[1],
+        compiled=tm.first_call(
+            ("gf_fused", mat.shape, bucket, chunks_list[0].shape,
+             donate)),
+    )
+    return out
+
+
+def apply_matrix_jax(mat: np.ndarray, chunks,
+                     mat_key: str | None = None) -> jnp.ndarray:
     """GF(2^8) matrix apply with kernel dispatch: the fused Pallas VMEM
     kernel on TPU (ops/pallas_gf.py), the XLA bitplane path elsewhere.
 
@@ -196,13 +382,15 @@ def apply_matrix_jax(mat: np.ndarray, chunks) -> jnp.ndarray:
     backend, wall time (dispatch-side; JAX queues the launch, so only
     sync call sites above this seam report achieved GiB/s), bytes
     in/out, compile-vs-execute split by first-seen shape.  Disabled:
-    one attribute check.
+    one attribute check.  `mat_key`: precomputed stable digest of `mat`
+    (matrix_digest) held on the codec — skips the per-call tobytes host
+    copy when resolving the cached device bitmatrix.
     """
     tm = TELEMETRY
     if not tm.enabled:
-        return _apply_matrix_dispatch(mat, chunks)[0]
+        return _apply_matrix_dispatch(mat, chunks, mat_key)[0]
     t0 = time.perf_counter()
-    out, backend = _apply_matrix_dispatch(mat, chunks)
+    out, backend = _apply_matrix_dispatch(mat, chunks, mat_key)
     dt = time.perf_counter() - t0
     shape = getattr(chunks, "shape", None)
     tm.record(
@@ -224,7 +412,8 @@ def xor_bitmatrix_device(b_bytes: bytes, shape: tuple[int, int]) -> jnp.ndarray:
     return jnp.asarray(np.kron(B, np.eye(8, dtype=np.int8)))
 
 
-def apply_xor_matrix_jax(B: np.ndarray, rows) -> jnp.ndarray:
+def apply_xor_matrix_jax(B: np.ndarray, rows,
+                         mat_key: str | None = None) -> jnp.ndarray:
     """[R, N] 0/1 matrix XOR-combining [N, L] byte rows -> [R, L], on
     device through the same MXU bitplane matmul as the GF(2^8) path.
 
@@ -232,10 +421,9 @@ def apply_xor_matrix_jax(B: np.ndarray, rows) -> jnp.ndarray:
     GF(2^8) matrix (multiply-by-1 expands to the identity bitmatrix), so
     the fused Pallas kernel serves the XOR codes unchanged."""
     if _want_pallas():
-        return apply_matrix_jax(np.ascontiguousarray(B, dtype=np.uint8), rows)
-    Bd = xor_bitmatrix_device(
-        np.ascontiguousarray(B, dtype=np.uint8).tobytes(), B.shape
-    )
+        return apply_matrix_jax(np.ascontiguousarray(B, dtype=np.uint8),
+                                rows, mat_key=mat_key)
+    Bd = _bitmatrix_for(B, mat_key, xor=True)
     tm = TELEMETRY
     if not tm.enabled:
         return _apply_bitmatrix(Bd, jnp.asarray(rows, dtype=jnp.uint8))
@@ -247,6 +435,36 @@ def apply_xor_matrix_jax(B: np.ndarray, rows) -> jnp.ndarray:
         bytes_in=int(getattr(rows, "nbytes", 0)),
         bytes_out=B.shape[0] * shape[-1] if shape else 0,
         compiled=tm.first_call(("gf_xor", B.shape, shape)),
+    )
+    return out
+
+
+def apply_xor_matrix_dev(B: np.ndarray, rows, mat_key: str | None = None,
+                         donate: bool = False) -> jnp.ndarray:
+    """Device-resident variant of apply_xor_matrix_jax (the bitmatrix/
+    packet-codec route of the cephdma async seam): result stays on
+    device, no sync; `donate=True` recycles `rows`' exclusively-owned
+    device buffer through the donation-enabled jit."""
+    if _want_pallas():
+        return apply_matrix_dev(np.ascontiguousarray(B, dtype=np.uint8),
+                                rows, mat_key=mat_key, donate=donate)
+    if donate:
+        from .device_pool import donation_supported
+
+        donate = donation_supported()
+    Bd = _bitmatrix_for(B, mat_key, xor=True)
+    fn = _apply_bitmatrix_donated if donate else _apply_bitmatrix
+    tm = TELEMETRY
+    if not tm.enabled:
+        return fn(Bd, jnp.asarray(rows, dtype=jnp.uint8))
+    t0 = time.perf_counter()
+    out = fn(Bd, jnp.asarray(rows, dtype=jnp.uint8))
+    shape = getattr(rows, "shape", None)
+    tm.record(
+        "gf_xor", "xla", time.perf_counter() - t0,
+        bytes_in=int(getattr(rows, "nbytes", 0)),
+        bytes_out=B.shape[0] * shape[-1] if shape else 0,
+        compiled=tm.first_call(("gf_xor", B.shape, shape, donate)),
     )
     return out
 
@@ -271,25 +489,36 @@ class BitplaneCodec:
     def __init__(self, coding: np.ndarray):
         self.coding = np.ascontiguousarray(coding, dtype=np.uint8)
         self.m, self.k = self.coding.shape
+        # stable device-cache key, computed ONCE per codec (cephdma: the
+        # hot path used to pay a fresh mat.tobytes() host copy per
+        # stripe to key the bitmatrix cache)
+        self.coding_digest = matrix_digest(self.coding)
         self.generator = systematic_generator(self.coding)
-        self._decode_cache: dict[tuple[int, ...], np.ndarray] = {}
+        #: erasure pattern -> (decode matrix, its stable digest)
+        self._decode_cache: dict[tuple[int, ...],
+                                 tuple[np.ndarray, str]] = {}
 
     def encode(self, data) -> jnp.ndarray:
         """[k, L] data shards -> [m, L] parity shards (device array)."""
         data = jnp.asarray(data, dtype=jnp.uint8)
         if data.shape[0] != self.k:
             raise ValueError(f"expected {self.k} data shards, got {data.shape[0]}")
-        return apply_matrix_jax(self.coding, data)
+        return apply_matrix_jax(self.coding, data,
+                                mat_key=self.coding_digest)
 
     def decode_matrix(self, available_rows: tuple[int, ...]) -> np.ndarray:
         """Per-erasure-pattern inverted matrix, host-cached (ISA-L table-cache
         pattern; SURVEY.md §7 'decode-matrix churn')."""
+        return self._decode_entry(available_rows)[0]
+
+    def _decode_entry(self, available_rows) -> tuple[np.ndarray, str]:
         key = tuple(available_rows[: self.k])
-        dm = self._decode_cache.get(key)
-        if dm is None:
+        ent = self._decode_cache.get(key)
+        if ent is None:
             dm = decode_matrix_for(self.generator, self.k, list(key)).astype(np.uint8)
-            self._decode_cache[key] = dm
-        return dm
+            ent = (dm, matrix_digest(dm))
+            self._decode_cache[key] = ent
+        return ent
 
     def decode(self, available_rows, shards) -> jnp.ndarray:
         """Rebuild the k data shards from >= k surviving shards.
@@ -299,9 +528,9 @@ class BitplaneCodec:
         rows = tuple(int(r) for r in available_rows)
         if len(rows) < self.k:
             raise ValueError(f"need >= {self.k} shards, got {len(rows)}")
-        dm = self.decode_matrix(rows)
+        dm, dm_key = self._decode_entry(rows)
         shards = jnp.asarray(shards, dtype=jnp.uint8)[: self.k]
-        return apply_matrix_jax(dm, shards)
+        return apply_matrix_jax(dm, shards, mat_key=dm_key)
 
     def reconstruct(self, available_rows, shards, want_rows) -> jnp.ndarray:
         """Rebuild arbitrary shards (data or parity) — the recovery path

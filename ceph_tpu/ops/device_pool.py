@@ -1,0 +1,254 @@
+"""cephdma — geometry-keyed device-resident stripe-buffer pool
+(ROADMAP "Device-resident stripe pools and donated buffers"; the memory-
+access-elimination class of win arXiv:2108.02692 measures, applied to
+the queueing structure arXiv:1709.05365 shows dominates online EC).
+
+Every encode used to round-trip host memory per flush: pack on host ->
+``device_put`` -> kernel -> ``np.asarray`` -> scatter to shards.  The
+pool is the allocation half of killing those trips (the dispatch half is
+``ops.bitplane.apply_matrix_dev`` + the write batcher's async demux):
+
+- ``put(host_array)`` commits a host stripe to the device THROUGH the
+  pool: a free same-geometry buffer is recycled as donation fuel for the
+  transfer (``donate_argnums`` on the destination — XLA reuses its
+  storage for the result where the backend supports donation; CPU
+  ignores donation, so there the pool is accounting + bounding only and
+  the recycling becomes real the day the tunnel un-wedges), else a fresh
+  ``jax.device_put``.
+- ``release(dev_array)`` returns a dead device buffer (a fetched parity
+  block, a consumed helper-chunk stack) to the free list for the next
+  same-geometry ``put``.
+- Free lists are keyed by buffer geometry ``(rows, cols, dtype)`` — the
+  flattened form of the EC ``(k|m, stripes*shard_len, dtype)`` stripe
+  geometry — and bounded by ``ec_device_pool_max_bytes`` with
+  least-recently-USED geometry eviction (a retired pool's odd shapes
+  age out instead of pinning device memory).
+
+Stats (hits/misses/evictions/donations/resident_bytes) are
+authoritative here and mirrored into the kernel telemetry PerfCounters
+(``device_pool_*`` series) so the pool shows up next to the kernels it
+feeds.  ``enabled()`` is sentinel-aware: a latched TPU_BACKEND_DEGRADED
+forces pool bypass so the data path falls back to the historical
+synchronous route (the same downgrade rule ``_want_pallas`` follows).
+
+Config: ``ec_device_pool`` (escape hatch, default on) and
+``ec_device_pool_max_bytes`` are read at daemon start into this
+process-wide singleton (first daemon wins, like the sentinel policy);
+the write batcher additionally re-reads ``ec_device_pool`` per flush so
+the hatch works at runtime.
+"""
+from __future__ import annotations
+
+import warnings
+from collections import OrderedDict
+from functools import partial
+
+import jax
+import numpy as np
+
+from ..common.kernel_telemetry import SENTINEL, TELEMETRY
+from ..common.lockdep import make_lock
+
+# donation on backends that can't use it (CPU) is harmless but warns per
+# compiled shape; the pool routes donation deliberately, so silence just
+# that advisory here rather than at every call site
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+#: backends whose runtime actually recycles donated buffers ('axon' is
+#: this box's tunneled-TPU alias)
+_DONATING_BACKENDS = ("tpu", "axon", "gpu", "cuda", "rocm")
+
+
+#: test hook: pin donation_supported() (None = ask the backend)
+_donation_override: bool | None = None
+
+
+def set_donation_override(v: bool | None) -> None:
+    """Force donation_supported()'s answer (tests exercise the donation
+    accounting on CPU where the backend would say no); None clears."""
+    global _donation_override
+    _donation_override = v
+
+
+def donation_supported() -> bool:
+    """True when `donate_argnums` buys real buffer reuse on the current
+    backend (CPU accepts the annotation but ignores it)."""
+    if _donation_override is not None:
+        return _donation_override
+    return jax.default_backend() in _DONATING_BACKENDS
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _refill(dst, src):
+    """Transfer `src` into the device while donating `dst`'s storage:
+    where donation works the result lands in the recycled buffer instead
+    of a fresh allocation; elsewhere it is a plain committed copy."""
+    return src
+
+
+def _geom(shape, dtype) -> tuple:
+    return (tuple(int(d) for d in shape), np.dtype(dtype).name)
+
+
+class DevicePool:
+    """Bounded geometry-keyed free-list of device buffers (see module
+    docstring).  Process-wide singleton ``POOL`` below; thread-safe."""
+
+    def __init__(self, max_bytes: int = 256 << 20, enabled: bool = True):
+        self._lock = make_lock("ops::device_pool")
+        self._max_bytes = int(max_bytes)
+        self._enabled = bool(enabled)
+        #: geometry -> free buffers; OrderedDict order IS the LRU order
+        #: (move_to_end on every touch, evict from the front)
+        self._free: OrderedDict[tuple, list] = OrderedDict()
+        self._resident = 0
+        self._stats = {"hits": 0, "misses": 0, "evictions": 0,
+                       "donations": 0, "puts": 0, "releases": 0}
+
+    # -- config ------------------------------------------------------------
+    def configure(self, enabled: bool | None = None,
+                  max_bytes: int | None = None) -> None:
+        """Apply the ec_device_pool / ec_device_pool_max_bytes options
+        (daemon start; first daemon in the process wins the size)."""
+        with self._lock:
+            if enabled is not None:
+                self._enabled = bool(enabled)
+                if not self._enabled:
+                    self._drain_locked()
+            if max_bytes is not None:
+                self._max_bytes = int(max_bytes)
+                self._evict_locked()
+
+    def enabled(self) -> bool:
+        """Pool usable right now: configured on AND the backend sentinel
+        has not latched degraded (a sick backend must get the historical
+        synchronous path, not fresh async device traffic)."""
+        return self._enabled and not SENTINEL.is_degraded
+
+    @property
+    def max_bytes(self) -> int:
+        return self._max_bytes
+
+    # -- the free-list cycle -----------------------------------------------
+    def acquire(self, shape, dtype=np.uint8):
+        """Pop a free buffer of exactly this geometry (None = miss).
+        Stats count the hit/miss either way — `put` is the usual caller."""
+        key = _geom(shape, dtype)
+        buf = None
+        with self._lock:
+            bufs = self._free.get(key)
+            if bufs:
+                self._free.move_to_end(key)
+                buf = bufs.pop()
+                if not bufs:
+                    self._free.pop(key, None)
+                self._resident -= buf.nbytes
+                self._stats["hits"] += 1
+                resident = self._resident
+            else:
+                self._stats["misses"] += 1
+        if buf is not None:
+            TELEMETRY.record_pool(hits=1, resident_bytes=resident)
+        else:
+            TELEMETRY.record_pool(misses=1)
+        return buf
+
+    def release(self, dev) -> None:
+        """Return a dead device buffer to its geometry's free list
+        (bounded: least-recently-used geometries evict past max_bytes)."""
+        if dev is None or not self._enabled:
+            return
+        try:
+            key = _geom(dev.shape, dev.dtype)
+            nbytes = int(dev.nbytes)
+        except (AttributeError, TypeError):
+            return
+        with self._lock:
+            if not self._enabled:
+                return
+            self._free.setdefault(key, []).append(dev)
+            self._free.move_to_end(key)
+            self._resident += nbytes
+            self._stats["releases"] += 1
+            dropped = self._evict_locked()
+            resident = self._resident
+        TELEMETRY.record_pool(evictions=len(dropped),
+                              resident_bytes=resident)
+
+    def put(self, host_array):
+        """Commit one host array to the device through the pool: a free
+        same-geometry buffer becomes donation fuel for the transfer (its
+        storage recycled where the backend supports donation), else a
+        fresh device_put.  Always returns a device array."""
+        host_array = np.ascontiguousarray(host_array)
+        with self._lock:
+            self._stats["puts"] += 1
+        recycled = self.acquire(host_array.shape, host_array.dtype) \
+            if self.enabled() else None
+        if recycled is not None and donation_supported():
+            with self._lock:
+                self._stats["donations"] += 1
+            TELEMETRY.record_pool(donations=1)
+            return _refill(recycled, host_array)
+        # no recycled buffer, or a backend that ignores donation (CPU —
+        # the popped buffer is simply dropped; the hit still measures
+        # free-list reuse for the day the tunnel un-wedges)
+        return jax.device_put(host_array)  # noqa: CL8 — the pool IS the transfer seam
+
+    # -- bookkeeping -------------------------------------------------------
+    def _evict_locked(self) -> list:
+        dropped = []
+        while self._resident > self._max_bytes and self._free:
+            key, bufs = self._free.popitem(last=False)  # LRU geometry
+            for b in bufs:
+                self._resident -= b.nbytes
+                dropped.append(b)
+            self._stats["evictions"] += len(bufs)
+        return dropped
+
+    def _drain_locked(self) -> list:
+        dropped = [b for bufs in self._free.values() for b in bufs]
+        self._free.clear()
+        self._resident = 0
+        return dropped
+
+    def clear(self) -> None:
+        """Drop every pooled buffer (tests; backend resets)."""
+        with self._lock:
+            self._drain_locked()
+            resident = self._resident
+        TELEMETRY.record_pool(resident_bytes=resident)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            out["resident_bytes"] = self._resident
+            out["geometries"] = len(self._free)
+            out["max_bytes"] = self._max_bytes
+            out["enabled"] = self._enabled
+        return out
+
+
+POOL = DevicePool()
+
+#: conf already applied to the process-wide pool (first daemon wins,
+#: like the sentinel policy — later daemons must not silently undo an
+#: operator's escape hatch or re-size the bound)
+_conf_applied = False
+
+
+def configure_from_conf(conf) -> None:
+    """Wire the declared options into the process-wide pool at daemon
+    start (CL5's declared-AND-read contract for both knobs).  FIRST
+    daemon in the process wins; the write batcher additionally re-reads
+    ``ec_device_pool`` per flush, so the hatch stays per-daemon and
+    runtime there."""
+    global _conf_applied
+    if _conf_applied:
+        return
+    _conf_applied = True
+    POOL.configure(
+        enabled=bool(conf.get("ec_device_pool")),
+        max_bytes=int(conf.get("ec_device_pool_max_bytes")),
+    )

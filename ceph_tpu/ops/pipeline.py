@@ -23,24 +23,28 @@ import numpy as np
 from ..common.kernel_telemetry import TELEMETRY
 
 
-def _apply_fn(mat: np.ndarray, kernel: str):
+def _apply_fn(mat: np.ndarray, kernel: str, mat_key: str | None,
+              donate: bool):
     """Resolve the kernel choice once per stream.  'xla' and 'pallas'
     force a path (the bench's explicit columns); 'auto' routes through
-    apply_matrix_jax's production dispatch — the same path the codec
-    plugins take, honoring the `ec_kernel` option and the latched XLA
-    fallback — so batched parity is bit-identical to the per-op path."""
+    the production dispatch — the same path the codec plugins take,
+    honoring the `ec_kernel` option and the latched XLA fallback — so
+    batched parity is bit-identical to the per-op path.  With the
+    device pool on, the auto/xla route goes through apply_matrix_dev
+    with the stream's batch buffer DONATED (the stream owns it
+    exclusively) and the stable mat_key skips per-batch tobytes keys."""
     if kernel == "pallas":
         from .pallas_gf import apply_matrix_pallas
 
         return lambda x: apply_matrix_pallas(mat, x)
-    # 'xla' (historical name for the default path) and 'auto' both route
-    # through apply_matrix_jax's dispatch, as stream_encode always has
-    from .bitplane import apply_matrix_jax
+    from .bitplane import apply_matrix_dev
 
-    return lambda x: apply_matrix_jax(mat, x)
+    return lambda x: apply_matrix_dev(mat, x, mat_key=mat_key,
+                                      donate=donate)
 
 
-def stream_encode(mat: np.ndarray, batches, kernel: str = "xla"):
+def stream_encode(mat: np.ndarray, batches, kernel: str = "xla",
+                  mat_key: str | None = None):
     """Encode an iterable of [k, L] host batches; returns the list of
     parity arrays.  kernel: 'xla' (ops.bitplane), 'pallas'
     (ops.pallas_gf), or 'auto' (production dispatch, ec_kernel-aware).
@@ -49,40 +53,59 @@ def stream_encode(mat: np.ndarray, batches, kernel: str = "xla"):
     pulled lazily, one batch ahead of the compute, so the stream's
     host-memory high-water mark is two batches regardless of length.
 
+    cephdma: batch transfers commit through the device stripe pool
+    (recycled buffers where the backend donates; the pool's bypass —
+    `ec_device_pool=false` or sentinel-degraded — falls back to plain
+    device_put) and the in-flight batch buffer is donated into the
+    encode.  The result fetches stay: returning host parity arrays IS
+    this function's contract, so the stream remains a deliberate sync
+    seam and its record counts the transfer+fetch host-copy volume.
+
     Telemetry: one `stream_encode` record per stream — the np.asarray
     fetches make this a true sync point, so the record carries an honest
     achieved GiB/s for the whole double-buffered pipeline."""
     import jax
 
+    from .device_pool import POOL
+
     tm = TELEMETRY
     t_start = time.perf_counter() if tm.enabled else 0.0
     bytes_in = bytes_out = 0
     mat = np.ascontiguousarray(mat, dtype=np.uint8)
-    apply_fn = _apply_fn(mat, kernel)
+    use_pool = POOL.enabled()
+    apply_fn = _apply_fn(mat, kernel, mat_key,
+                         donate=use_pool and kernel != "pallas")
+
+    def commit(host):
+        host = np.ascontiguousarray(host, dtype=np.uint8)
+        if use_pool:
+            return POOL.put(host)
+        return jax.device_put(host)  # noqa: CL8 — pool-off transfer seam
+
     it = iter(batches)
     first = next(it, None)
     if first is None:
         return []
     outs = []
     pending = None  # device result of the previous batch, not yet fetched
-    nxt = jax.device_put(np.ascontiguousarray(first, dtype=np.uint8))
+    nxt = commit(first)
     while nxt is not None:
         cur = nxt
-        if tm.enabled:
-            bytes_in += int(cur.nbytes)
+        bytes_in += int(cur.nbytes)
         # launch compute first (async), THEN start the next DMA so the
         # copy engine and the cores overlap
         res = apply_fn(cur)
         upcoming = next(it, None)
-        nxt = (
-            jax.device_put(np.ascontiguousarray(upcoming, dtype=np.uint8))
-            if upcoming is not None else None
-        )
+        nxt = commit(upcoming) if upcoming is not None else None
         if pending is not None:
             # fetch the previous result; keeps two batches live
             outs.append(np.asarray(pending))
+            if use_pool:
+                POOL.release(pending)  # dead device buffer: recycle
         pending = res
     outs.append(np.asarray(pending))
+    if use_pool:
+        POOL.release(pending)
     if tm.enabled:
         from .bitplane import current_backend
 
@@ -90,5 +113,6 @@ def stream_encode(mat: np.ndarray, batches, kernel: str = "xla"):
         backend = kernel if kernel == "pallas" else current_backend()
         tm.record("stream_encode", backend,
                   time.perf_counter() - t_start,
-                  bytes_in=bytes_in, bytes_out=bytes_out, synced=True)
+                  bytes_in=bytes_in, bytes_out=bytes_out, synced=True,
+                  host_copy_bytes=bytes_in + bytes_out)
     return outs

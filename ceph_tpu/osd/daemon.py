@@ -416,6 +416,19 @@ class OSD(
                     f"{self.whoami}: boot not acknowledged in 30s"
                 )
         self._load_pgs()
+        # cephdma: device stripe pool sized/armed from THIS daemon's
+        # conf (process-wide like the sentinel — first daemon at boot
+        # wins the bound; the batcher re-reads ec_device_pool per flush
+        # so the hatch stays runtime there, and an EXPLICIT injectargs
+        # flips the process-wide pool too via the observer — that's
+        # what lets the hatch disengage the stream/decode/recovery
+        # paths, which consult only POOL.enabled())
+        from ..ops.device_pool import POOL, configure_from_conf
+
+        configure_from_conf(self.cct.conf)
+        self.cct.conf.add_observer(
+            ["ec_device_pool"],
+            lambda _n, v: POOL.configure(enabled=bool(v)))
         self.write_batcher.start()
         # backend health sentinel (common/kernel_telemetry.py): policy
         # built from THIS daemon's conf and constructor-injected — the

@@ -31,42 +31,56 @@ from .pg_log import LogEntry
 class ECBackendMixin:
     # .. coalesced encode (osd/write_batcher.py) ...........................
     def _batch_matrix(self, codec):
-        """The codec's coding matrix IF its encode is a plain byte-
-        column-local GF matrix apply with identity chunk placement —
-        the property (same one the RMW parity delta rests on) under
-        which stripes from DIFFERENT ops can be fused along the column
-        axis and encoded in one batch.  None = not batchable: sub-
-        chunked (CLAY), packet/bitmatrix, remapped (LRC) codecs, and
-        the non-jax referee backends, all encode inline."""
+        """(coding matrix, stable digest) IF the codec's encode is a
+        plain byte-column-local GF matrix apply with identity chunk
+        placement — the property (same one the RMW parity delta rests
+        on) under which stripes from DIFFERENT ops can be fused along
+        the column axis and encoded in one batch.  (None, None) = not
+        batchable: sub-chunked (CLAY), packet/bitmatrix, remapped (LRC)
+        codecs, and the non-jax referee backends, all encode inline.
+
+        The digest (ops.bitplane.matrix_digest) is computed ONCE and
+        cached on the codec object — the batcher and the device
+        bitmatrix cache key by it instead of a fresh per-stripe
+        ``mat.tobytes()`` host copy (the cephdma satellite fix)."""
         if getattr(codec, "backend", "jax") != "jax":
             # oracle/numpy referee backends keep their own encode path
             # (parity provenance for the cross-backend equality tests);
             # plugins without the attr (shec) are jax-native
-            return None
+            return None, None
         try:
             if not codec.supports_parity_delta():
-                return None
+                return None, None
             if codec.get_sub_chunk_count() != 1:
-                return None
+                return None, None
         except (AttributeError, NotImplementedError):
-            return None
+            return None, None
         mat = getattr(codec, "coding", None)
         if not isinstance(mat, np.ndarray):
-            return None
-        return mat
+            return None, None
+        key = getattr(codec, "_coding_digest", None)
+        if key is None:
+            from ..ops.bitplane import matrix_digest
+
+            key = matrix_digest(mat)
+            try:
+                codec._coding_digest = key
+            except (AttributeError, TypeError):
+                pass  # frozen codec object: recompute per call
+        return mat, key
 
     def _ec_encode_chunks(self, codec, chunks):
         """encode_chunks through the write batcher when eligible
         (coalesced with concurrent ops' stripes), codec-inline
         otherwise; parity bytes identical either way."""
         batcher = getattr(self, "write_batcher", None)
-        mat = self._batch_matrix(codec)
+        mat, mat_key = self._batch_matrix(codec)
         if batcher is None or mat is None:
             t0 = trace_now()
             out = codec.encode_chunks(chunks)
             self._op_stage("encode", t0, trace_now(), codec_inline=True)
             return out
-        return batcher.encode_chunks(mat, chunks)
+        return batcher.encode_chunks(mat, chunks, mat_key=mat_key)
 
     def _ec_encode(self, codec, data: bytes) -> dict:
         """Full-stripe encode for _ec_write: same chunk dict as
@@ -74,7 +88,7 @@ class ECBackendMixin:
         routed through the write batcher when the codec is batchable."""
         n = codec.get_chunk_count()
         batcher = getattr(self, "write_batcher", None)
-        mat = self._batch_matrix(codec)
+        mat, mat_key = self._batch_matrix(codec)
         if batcher is None or mat is None:
             t0 = trace_now()
             enc = codec.encode(set(range(n)), data)
@@ -83,7 +97,7 @@ class ECBackendMixin:
         k = codec.get_data_chunk_count()
         L = codec.get_chunk_size(len(data))
         chunks = codec.encode_prepare(data, L)
-        parity = batcher.encode_chunks(mat, chunks)
+        parity = batcher.encode_chunks(mat, chunks, mat_key=mat_key)
         enc = {i: chunks[i] for i in range(k)}
         for j in range(parity.shape[0]):
             enc[k + j] = parity[j]

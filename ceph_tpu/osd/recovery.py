@@ -1121,10 +1121,23 @@ class RecoveryMixin:
             bytes_read += want_len
         try:
             from ..ops.bitplane import apply_matrix_jax
+            from ..ops.device_pool import POOL
 
-            M = codec.repair_matrix(lost, tuple(helpers))
+            # cephdma: the cached repair matrix's stable digest keys the
+            # device bitmatrix cache (no per-rebuild M.tobytes() host
+            # copy), and the gathered helper sub-chunks commit to the
+            # device through the stripe pool so repeated rebuilds of
+            # one geometry recycle the same buffers
+            if hasattr(codec, "repair_matrix_entry"):
+                M, m_key = codec.repair_matrix_entry(lost, tuple(helpers))
+            else:
+                M, m_key = codec.repair_matrix(lost, tuple(helpers)), None
             x = np.concatenate([fetched[h] for h in helpers])
-            out = np.asarray(apply_matrix_jax(M, x), np.uint8)
+            x_dev = POOL.put(x) if POOL.enabled() else x
+            out = np.asarray(apply_matrix_jax(M, x_dev, mat_key=m_key),
+                             np.uint8)
+            if x_dev is not x:
+                POOL.release(x_dev)
             chunk = out.reshape(Z * sub_len).tobytes()
         except Exception:
             return None

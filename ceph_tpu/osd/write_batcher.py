@@ -44,6 +44,22 @@ arrivals) is split on stripe boundaries and streamed through
 ``ops.pipeline.stream_encode`` so host->device DMA of device-batch i+1
 overlaps the kernel computing device-batch i.
 
+cephdma — the fully async encode path: with the device-resident stripe
+pool on (``ec_device_pool``, default; ``ops/device_pool.py``) a flush
+packs stripes straight into pooled device buffers (device-side concat —
+no host staging copy), encodes through the DONATED jit (the packed
+buffer's storage is recycled for the kernel's output where the backend
+supports donation), demuxes per-stripe parity as device-side slices,
+and completes WITHOUT materializing anything on the host — the single
+deliberate sync is each op's ``encode_wait`` (the commit point), which
+fetches just its own slice and returns dead device buffers to the pool.
+Kernel telemetry separates the two seams: ``ec_batch_flush`` carries
+the flush's host-copy bytes (pool ON: transfers only; OFF: pack +
+transfer + fetch — the control the ci_gate compares), ``encode_wait``
+carries the commit-point sync bytes.  The pool is bypassed — the
+historical synchronous path — when ``ec_device_pool=false`` or the
+backend sentinel has latched degraded.
+
 Fault injection: the ``osd.write_batcher.flush`` failpoint fires at the
 head of every flush.  ``error`` fails EVERY op in the batch (none acks
 — the thrasher's no-acked-write-loss invariant holds because the
@@ -65,6 +81,102 @@ from ..common.throttle import Throttle
 from ..common.tracer import TRACER, kernel_annotation, op_trace, trace_now
 
 
+class _FlushRef:
+    """One pooled flush's device-resident parity: the fused [m, B*L]
+    parent buffer plus the shared commit state.  The FIRST op to reach
+    its encode_wait materializes the whole parent in ONE fetch (a
+    single sync + host copy per flush, not per stripe — per-stripe
+    device slicing was measured to drown CPU dispatch), caches the host
+    array for its batch-mates, and returns the parent buffer to the
+    device pool."""
+
+    #: bound on waiting out another op's in-flight fetch
+    FETCH_TIMEOUT = 60.0
+
+    __slots__ = ("parent", "host", "error", "fetch_bytes", "_claim",
+                 "_ready")
+
+    def __init__(self, parent):
+        self.parent = parent
+        self.host: np.ndarray | None = None
+        self.error: BaseException | None = None
+        self.fetch_bytes = 0  # set once, by the fetching op
+        self._claim = make_lock("osd::wb_flush_ref")
+        self._ready = threading.Event()
+
+    def prefetch(self) -> None:
+        """Start the device->host transfer WITHOUT blocking (the
+        flusher calls this BEFORE completing the batch, so no op can
+        have consumed the parent yet): by the time an op commits, the
+        bytes are in flight or landed and the elected fetcher's
+        np.asarray doesn't pin a client thread for the whole kernel."""
+        dev = self.parent
+        if dev is None:
+            return
+        try:
+            dev.copy_to_host_async()
+        except Exception:  # noqa: CL7 — best-effort warm-up: no async D2H on this array/backend, the commit fetch just pays full price
+            pass
+
+    def fetch(self) -> tuple[np.ndarray, bool]:
+        """The commit-point materialization: ONE op is elected to fetch
+        and everyone else waits on a broadcast Event — batch-mates wake
+        in a burst, not a lock-handoff trickle (the trickle was measured
+        to starve the NEXT flush's coalescing window).  A fetch failure
+        (the async path surfaces deferred device errors HERE) is latched
+        and re-raised to every batch-mate.  Returns (host parity of the
+        whole flush, did-I-pay-for-the-fetch)."""
+        from ..ops.device_pool import POOL
+
+        if self.host is None and self.error is None \
+                and self._claim.acquire(blocking=False):
+            try:
+                if self.host is None and self.error is None:
+                    # noqa: CL2 — parent is only ever touched by the
+                    # thread holding _claim (try-acquire above; CL2
+                    # can't see a non-`with` acquire)
+                    dev, self.parent = self.parent, None  # noqa: CL2
+                    try:
+                        host = np.asarray(dev, dtype=np.uint8)  # noqa: CL8 — THE commit-point sync
+                    except BaseException as e:
+                        self.error = e
+                        self._ready.set()
+                        raise
+                    self.fetch_bytes = host.nbytes
+                    self.host = host
+                    # broadcast BEFORE the pool bookkeeping: 63 batch-
+                    # mates may be parked on this event
+                    self._ready.set()
+                    POOL.release(dev)
+                    return host, True
+            finally:
+                self._claim.release()
+        if self.host is None and self.error is None \
+                and not self._ready.wait(self.FETCH_TIMEOUT):
+            raise TimeoutError("flush parity fetch never completed")
+        if self.error is not None:
+            raise self.error
+        return self.host, False
+
+
+class _DevParity:
+    """A stripe's parity still resident on device (the pooled async
+    path): column window [c0, c1) of its flush's fused parity,
+    materialized host-side only at the op's encode_wait."""
+
+    __slots__ = ("ref", "c0", "c1", "rows")
+
+    def __init__(self, ref: _FlushRef, c0: int, c1: int, rows: int):
+        self.ref = ref
+        self.c0 = c0
+        self.c1 = c1
+        self.rows = rows
+
+    @property
+    def nbytes(self) -> int:
+        return self.rows * (self.c1 - self.c0)
+
+
 class _PendingStripe:
     """One op's stripe riding a batch: input chunks in, parity (or the
     batch's error) out.  Completion rides a PER-OP Event rather than the
@@ -75,16 +187,21 @@ class _PendingStripe:
     is the publish edge ordering the flusher's parity write before the
     submitter's read."""
 
-    __slots__ = ("key", "mat", "chunks", "nbytes", "arrival", "event",
-                 "parity", "error", "admitted", "tctx", "tracked",
-                 "acct", "queued_at", "share_key")
+    __slots__ = ("key", "mat", "mat_key", "chunks", "nbytes", "arrival",
+                 "event", "parity", "error", "admitted", "tctx",
+                 "tracked", "acct", "queued_at", "share_key")
 
-    def __init__(self, mat: np.ndarray, chunks: np.ndarray):
+    def __init__(self, mat: np.ndarray, chunks: np.ndarray,
+                 mat_key: str | None = None):
         self.mat = mat
+        # stable digest of mat held on the codec (cephdma satellite: no
+        # fresh mat.tobytes() host copy per stripe to key the group)
+        self.mat_key = mat_key
         self.chunks = chunks
         # fuse only stripes encoding under the same matrix at the same
         # chunk length: concat along columns is exact for those
-        self.key = (mat.tobytes(), chunks.shape[1])
+        self.key = (mat_key if mat_key is not None else mat.tobytes(),
+                    chunks.shape[1])
         self.nbytes = chunks.nbytes
         self.arrival = time.monotonic()
         self.event = threading.Event()
@@ -240,24 +357,39 @@ class WriteBatcher:
             self._flush_asap = True
             self._cond.notify_all()
 
+    def _use_pool(self) -> bool:
+        """Pooled async flush path usable right now: the runtime escape
+        hatch (``ec_device_pool``) AND the process-wide pool's own gate
+        (configured on, sentinel not degraded)."""
+        from ..ops.device_pool import POOL
+
+        if self._cct is not None \
+                and not bool(self._cct.conf.get("ec_device_pool")):
+            return False
+        return POOL.enabled()
+
     # -- submit ------------------------------------------------------------
-    def encode_chunks(self, mat: np.ndarray, chunks: np.ndarray) -> np.ndarray:
+    def encode_chunks(self, mat: np.ndarray, chunks: np.ndarray,
+                      mat_key: str | None = None) -> np.ndarray:
         """[k, L] data chunks -> [m, L] parity, bit-identical to
         ``apply_matrix_jax(mat, chunks)``; blocks until this stripe's
         batch flushed (or encodes inline when coalescing is off)."""
-        return self.encode_wait(self.encode_submit(mat, chunks))
+        return self.encode_wait(self.encode_submit(mat, chunks, mat_key))
 
-    def encode_submit(self, mat: np.ndarray,
-                      chunks: np.ndarray) -> _PendingStripe:
+    def encode_submit(self, mat: np.ndarray, chunks: np.ndarray,
+                      mat_key: str | None = None) -> _PendingStripe:
         """Queue one [k, L] stripe for coalesced encode and return its
         ticket.  Every ticket MUST be passed to encode_wait (it holds
         admission-throttle budget until then).  Async clients keep a
         small window of tickets in flight — that window is what lets a
         single writer's stripes coalesce with its own, not only with
-        other writers'."""
+        other writers'.  ``mat_key``: the codec's precomputed stable
+        digest of ``mat`` (ops.bitplane.matrix_digest) — group keying
+        and the device bitmatrix cache then skip the per-stripe
+        ``mat.tobytes()`` host copy."""
         mat = np.ascontiguousarray(mat, dtype=np.uint8)
         chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
-        p = _PendingStripe(mat, chunks)
+        p = _PendingStripe(mat, chunks, mat_key)
         st = op_trace()
         if st is not None:
             if TRACER.enabled:  # one attribute check when tracing is off
@@ -266,7 +398,7 @@ class WriteBatcher:
             p.acct = st.get("acct")
         if not self.coalescing():
             p.parity = self._inline(mat, chunks, tctx=p.tctx,
-                                    tracked=p.tracked)
+                                    tracked=p.tracked, mat_key=mat_key)
             p.event.set()
             return p
         # backpressure: block HERE, at admission, while the queue is
@@ -339,12 +471,19 @@ class WriteBatcher:
                 self._cond.notify_all()
         if not enqueued:  # raced a stop/crash: encode inline
             p.parity = self._inline(p.mat, p.chunks, tctx=p.tctx,
-                                    tracked=p.tracked)
+                                    tracked=p.tracked, mat_key=p.mat_key)
             p.event.set()
         return p
 
     def encode_wait(self, p: _PendingStripe) -> np.ndarray:
-        """Block for a ticket's parity (or raise its batch's error)."""
+        """Block for a ticket's parity (or raise its batch's error).
+
+        THE commit point of the async encode path: a pooled flush left
+        this op's parity device-resident, and the ``np.asarray`` here is
+        the one deliberate host materialization — per op, off the
+        flusher thread, accounted as the ``encode_wait`` sync-point
+        kernel record.  The last stripe of a flush to commit returns the
+        flush's parity buffer to the device pool."""
         try:
             if not p.event.wait(timeout=self.OP_TIMEOUT):
                 raise TimeoutError(
@@ -357,6 +496,8 @@ class WriteBatcher:
                 p.tracked.mark_event("encode", ts=trace_now())
             if p.error is not None:
                 raise p.error
+            if isinstance(p.parity, _DevParity):
+                p.parity = self._commit_fetch(p.parity)
             return p.parity
         finally:
             if p.admitted:
@@ -364,8 +505,29 @@ class WriteBatcher:
                 self._admission.put(p.nbytes)
             self._release_share(p)
 
+    def _commit_fetch(self, dp: _DevParity) -> np.ndarray:
+        """Materialize one op's device-resident parity (the deliberate
+        commit sync): the flush's shared fetch runs at most once; this
+        op then slices its own column window host-side."""
+        t0 = time.perf_counter()
+        full, fetched = dp.ref.fetch()
+        if fetched and TELEMETRY.enabled:
+            # ONE record per flush, by the op that paid the fetch — its
+            # batch-mates' waits are free host slices, and recording
+            # each of them was measured to cost real throughput at
+            # 10k+ ops/s (the counters lock per record)
+            from ..ops.bitplane import current_backend
+
+            TELEMETRY.record(
+                "encode_wait", current_backend(),
+                time.perf_counter() - t0,
+                bytes_out=dp.ref.fetch_bytes, synced=True,
+                host_copy_bytes=dp.ref.fetch_bytes)
+        return full[:, dp.c0:dp.c1]
+
     def _inline(self, mat: np.ndarray, chunks: np.ndarray,
-                tctx=None, tracked=None) -> np.ndarray:
+                tctx=None, tracked=None,
+                mat_key: str | None = None) -> np.ndarray:
         from ..ops.bitplane import apply_matrix_jax
 
         with self._lock:
@@ -376,8 +538,9 @@ class WriteBatcher:
         with kernel_annotation(
             "ec_encode_inline", (tctx.trace_id,) if tctx is not None else ()
         ):
-            parity = np.asarray(apply_matrix_jax(mat, chunks),
-                                dtype=np.uint8)
+            parity = np.asarray(  # noqa: CL8 — inline per-op encode is deliberately synchronous
+                apply_matrix_jax(mat, chunks, mat_key=mat_key),
+                dtype=np.uint8)
         if tctx is not None:
             TRACER.record(tctx, "encode", entity=self._entity,
                           t0=t0, t1=trace_now(), inline=True)
@@ -463,10 +626,13 @@ class WriteBatcher:
             err = e
         except Exception as e:
             err = e
-        results: list[tuple[_PendingStripe, np.ndarray]] = []
+        results: list[tuple[_PendingStripe, object]] = []
+        host_copy = 0
+        flush_synced = False
         if err is None:
             try:
-                results = self._encode_groups(batch)
+                results, host_copy, flush_synced = \
+                    self._encode_groups(batch)
             except Exception as e:
                 err = e
         w1 = trace_now()
@@ -492,6 +658,16 @@ class WriteBatcher:
                     p.tctx, "encode", entity=self._entity, t0=w0, t1=w1,
                     flush_id=fid, stripes=len(batch), fan_in=fan_in,
                 )
+        if err is None:
+            # pooled flushes: start each parity parent's D2H in the
+            # background so commit fetches land on warm bytes.  MUST
+            # run before _complete — once events are set an op may
+            # consume the parent (fetch swaps it out and recycles it)
+            seen_refs: set[int] = set()
+            for _p, r in results:
+                if isinstance(r, _DevParity) and id(r.ref) not in seen_refs:
+                    seen_refs.add(id(r.ref))
+                    r.ref.prefetch()
         self._complete(batch, err=err, results=results)
         if err is None:
             nbytes = sum(p.nbytes for p in batch)
@@ -507,57 +683,113 @@ class WriteBatcher:
                                   time.perf_counter() - t0)
                 self._logger.hinc("stage_encode", w1 - w0)
             if TELEMETRY.enabled:
-                # the flush fetched every parity slice (np arrays), so
-                # this is a true sync point: honest achieved GiB/s for
-                # the fused pack -> encode -> scatter
+                # pool OFF: the flush fetched every parity slice, a
+                # true sync point — honest achieved GiB/s for the fused
+                # pack -> encode -> scatter.  Pool ON: dispatch is
+                # async (synced=False, the record measures the queue;
+                # the commit-point sync rides the per-op `encode_wait`
+                # record instead), and host_copy carries only the
+                # copies THIS flush actually performed — the
+                # control-vs-pool delta the ci_gate smoke compares.
                 from ..ops.bitplane import current_backend
 
                 TELEMETRY.record(
                     "ec_batch_flush", current_backend(),
                     time.perf_counter() - t0, bytes_in=nbytes,
                     bytes_out=sum(int(r[1].nbytes) for r in results),
-                    synced=True)
+                    synced=flush_synced, host_copy_bytes=host_copy)
 
     def _encode_groups(
         self, batch: list[_PendingStripe]
-    ) -> list[tuple[_PendingStripe, np.ndarray]]:
-        """One fused pack -> encode -> scatter per (matrix, L) group."""
+    ) -> tuple[list[tuple[_PendingStripe, object]], int, bool]:
+        """One fused pack -> encode -> scatter per (matrix, L) group.
+
+        Returns (results, host_copy_bytes, synced): with the device pool
+        ON the results are `_DevParity` slices still resident on device
+        (nothing materialized — host_copy counts only the host->device
+        stripe commits and synced stays False, the dispatch is async);
+        with it OFF this is the historical synchronous path (host pack
+        copy + packed transfer + full parity fetch, all counted, synced
+        True).  Parity bytes are bit-identical either way — pooling
+        changes scheduling and allocation, never results."""
         groups: dict[tuple, list[_PendingStripe]] = {}
         for p in batch:
             groups.setdefault(p.key, []).append(p)
         max_bytes = self._max_bytes()
-        out: list[tuple[_PendingStripe, np.ndarray]] = []
-        for (_mat_b, L), ps in groups.items():
+        use_pool = self._use_pool()
+        host_copy = 0
+        synced = False
+        out: list[tuple[_PendingStripe, object]] = []
+        for (_gkey, L), ps in groups.items():
             mat = ps[0].mat
-            packed = (ps[0].chunks if len(ps) == 1 else
-                      np.concatenate([p.chunks for p in ps], axis=1))
             stripe_b = ps[0].chunks.nbytes
-            if (max_bytes > 0 and len(ps) > 1
-                    and packed.nbytes > max_bytes):
+            group_b = sum(p.chunks.nbytes for p in ps)
+            if max_bytes > 0 and len(ps) > 1 and group_b > max_bytes:
                 # burst bigger than one device batch: split on stripe
                 # boundaries and double-buffer DMA against compute
+                # (stream_encode pools its own transfers; its result
+                # fetches make this group a sync point either way)
                 from ..ops.pipeline import stream_encode
 
+                packed = np.concatenate([p.chunks for p in ps], axis=1)
                 spd = max(1, max_bytes // stripe_b)
 
                 def dev_batches(packed=packed, L=L, n=len(ps), spd=spd):
                     for i in range(0, n, spd):
                         yield packed[:, i * L:(i + spd) * L]
 
-                outs = stream_encode(mat, dev_batches(), kernel="auto")
+                outs = stream_encode(mat, dev_batches(), kernel="auto",
+                                     mat_key=ps[0].mat_key)
                 parity = np.concatenate(outs, axis=1)
-            else:
-                from ..ops.bitplane import apply_matrix_jax
+                # only THIS seam's own copies (the two host concats):
+                # the transfers and result fetches are counted by the
+                # stream_encode record — each seam counts its own
+                host_copy += packed.nbytes + parity.nbytes
+                synced = True
+                for i, p in enumerate(ps):
+                    out.append((p, parity[:, i * L:(i + 1) * L]))
+                continue
+            if use_pool:
+                # cephdma pooled async path: commit + concat + encode
+                # fuse into ONE dispatch (no host staging pack — the
+                # stripes' committed buffers are donated straight into
+                # the kernel), parity stays device-resident; the op's
+                # encode_wait owns the single deliberate sync, and the
+                # parent parity buffer recycles through the pool there
+                from ..ops.bitplane import fused_bucket, fused_encode_async
 
-                parity = np.asarray(apply_matrix_jax(mat, packed),
-                                    dtype=np.uint8)
+                parity_dev = fused_encode_async(
+                    mat, [p.chunks for p in ps],
+                    mat_key=ps[0].mat_key, donate=True)
+                # the host->device stripe commits — charged at the
+                # dispatched arity (zero-stripe pads transfer too)
+                host_copy += fused_bucket(len(ps)) * stripe_b
+                ref = _FlushRef(parity_dev)
+                m_rows = mat.shape[0]
+                for i, p in enumerate(ps):
+                    out.append((p, _DevParity(
+                        ref, i * L, (i + 1) * L, m_rows)))
+                continue
+            # historical synchronous path (ec_device_pool=false escape
+            # hatch / sentinel-degraded backend): host pack, transfer,
+            # full parity fetch right here on the flusher
+            from ..ops.bitplane import apply_matrix_jax
+
+            packed = (ps[0].chunks if len(ps) == 1 else
+                      np.concatenate([p.chunks for p in ps], axis=1))
+            parity = np.asarray(  # noqa: CL8 — the pool-off flush IS the sync point
+                apply_matrix_jax(mat, packed, mat_key=ps[0].mat_key),
+                dtype=np.uint8)
+            host_copy += (packed.nbytes if len(ps) > 1 else 0) \
+                + packed.nbytes + parity.nbytes
+            synced = True
             for i, p in enumerate(ps):
                 out.append((p, parity[:, i * L:(i + 1) * L]))
-        return out
+        return out, host_copy, synced
 
     def _complete(self, batch: list[_PendingStripe],
                   err: BaseException | None = None,
-                  results: list[tuple[_PendingStripe, np.ndarray]] = ()):
+                  results: list[tuple[_PendingStripe, object]] = ()):
         if err is not None:
             for p in batch:
                 p.error = err

@@ -31,6 +31,19 @@ a conflict are provably known:
   ``block_until_ready`` inside a traced body — a host<->device round
   trip per trace (or a trace error), never what a kernel wants.
 
+cephdma adds the op-path HOST-TRIP AUDIT on top (``hosttrip:*``
+idents): every function — traced or not — in the ``cl8_dirs`` modules
+plus ``cl8_hostcopy_files`` (osd/write_batcher.py, osd/ec_backend.py)
+is scanned for explicit host<->device traffic: ``jax.device_get`` /
+``jax.device_put`` / ``.block_until_ready()`` calls, and
+``np.asarray``/``np.array`` wrapped directly around a device-producing
+kernel entry point (``apply_matrix_jax`` and friends — the
+materialize-at-the-callsite idiom the device pool exists to kill).
+The contract is drive-to-zero: a deliberate sync or transfer seam (the
+pool's own ``device_put``, an op's commit-point fetch, the pool-off
+historical flush) carries an explicit ``# noqa: CL8`` with its reason;
+everything else is a finding.  Baseline growth is a regression.
+
 Weak-typed Python scalars adopt the array side's dtype (JAX semantics)
 and never report.  ``# noqa: CL8`` / baseline.toml suppress as usual.
 """
@@ -138,18 +151,100 @@ def _broadcast(a: tuple | None, b: tuple | None):
     return tuple(reversed(out)), None
 
 
+#: device-producing kernel entry points: np.asarray(<one of these>(...))
+#: is a host materialization of a device result at the callsite
+_DEVICE_PRODUCERS = {
+    "apply_matrix_jax", "apply_xor_matrix_jax", "apply_matrix_dev",
+    "apply_xor_matrix_dev", "apply_matrix_xla", "apply_matrix_pallas",
+    "_apply_bitmatrix", "_apply_bitmatrix_donated",
+}
+_MATERIALIZERS = {"asarray", "array"}
+
+
 def check(mods: list[ModuleInfo], sym: SymbolTable, cfg: Config) -> list[Finding]:
     from .cl3_tracing import collect_traced
 
     findings: list[Finding] = []
     dirs = set(cfg.cl8_dirs)
+    audit_files = set(getattr(cfg, "cl8_hostcopy_files", ()))
     for mod in mods:
-        if mod.topdir() not in dirs:
+        in_dirs = mod.topdir() in dirs
+        in_audit = in_dirs or mod.rel in audit_files
+        if not in_audit:
             continue
-        for fn, _static, why in collect_traced(mod):
-            interp = _Interp(mod, fn, why)
-            interp.run()
-            findings.extend(interp.findings)
+        traced_fns = set()
+        if in_dirs:
+            for fn, _static, why in collect_traced(mod):
+                traced_fns.add(fn)
+                interp = _Interp(mod, fn, why)
+                interp.run()
+                findings.extend(interp.findings)
+        findings.extend(_audit_host_trips(mod, traced_fns))
+    return findings
+
+
+def _audit_host_trips(mod: ModuleInfo, traced_fns: set) -> list[Finding]:
+    """The cephdma op-path audit (module docstring): explicit
+    host<->device traffic outside traced bodies must be a noqa'd
+    deliberate seam.  Traced functions are skipped — the interpreter
+    above already polices those with the stricter in-trace rule."""
+    findings: list[Finding] = []
+    seen: set[str] = set()
+
+    def report(node: ast.AST, owner: str, msg: str) -> None:
+        ident = f"hosttrip:{owner}"
+        n = 2
+        while ident in seen:
+            ident = f"hosttrip:{owner}:{n}"
+            n += 1
+        seen.add(ident)
+        findings.append(Finding(
+            "CL8", mod.rel, getattr(node, "lineno", 1), ident, msg))
+
+    def own_nodes(scope):
+        """`scope`'s statements WITHOUT descending into nested
+        functions — those are walked (and attributed) on their own."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def audit_scope(scope, owner: str) -> None:
+        for node in own_nodes(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = call_name(node)
+            if cn in ("device_get", "device_put", "block_until_ready"):
+                report(node, f"{owner}:{cn}",
+                       f"[{owner}] explicit host<->device traffic "
+                       f"({cn}) on the op path — route through the "
+                       f"device pool / async seams, or mark the "
+                       f"deliberate sync with a reasoned noqa")
+                continue
+            if cn in _MATERIALIZERS and node.args \
+                    and isinstance(node.args[0], ast.Call):
+                inner = call_name(node.args[0])
+                if inner in _DEVICE_PRODUCERS:
+                    report(node, f"{owner}:{cn}({inner})",
+                           f"[{owner}] {cn}() materializes {inner}'s "
+                           f"device result at the callsite — a "
+                           f"host-copy sync per call; keep it "
+                           f"device-resident (apply_matrix_dev + "
+                           f"commit-point fetch) or noqa the "
+                           f"deliberate sync")
+
+    # module scope (import-time transfers count too) — own_nodes skips
+    # every FunctionDef subtree, so functions are attributed below
+    audit_scope(mod.tree, "<module>")
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn in traced_fns:
+            continue
+        audit_scope(fn, fn.name)
     return findings
 
 

@@ -174,6 +174,13 @@ class Config:
     cl1_raw_lock_dirs: tuple[str, ...] = ("osd", "mon", "msg", "store",
                                           "client", "common")
     cl8_dirs: tuple[str, ...] = ("ops", "gf", "crush")
+    #: op-path files the CL8 host-trip AUDIT additionally covers (module
+    #: scope, not just traced bodies): every host materialization of a
+    #: device result / explicit transfer must be a deliberate, noqa'd
+    #: sync point (the cephdma drive-to-zero contract; cl8_dirs modules
+    #: are audited too)
+    cl8_hostcopy_files: tuple[str, ...] = ("osd/write_batcher.py",
+                                           "osd/ec_backend.py")
     diff_files: frozenset[str] | None = None  # --diff: restrict findings
 
     @classmethod

@@ -45,6 +45,13 @@
 #    0, ceph_recovery_*{pool,codec} series render on the exporter with
 #    a plausible repair ratio (~k for RS), and the tail-promoted
 #    recovery trace tree is connected cross-entity at sampling=0.
+# 10. device pool smoke (ceph_tpu/qa/device_pool_smoke.py): the batcher
+#    traffic run with ec_device_pool=false (control) vs true — fails
+#    unless host-copy bytes per fused flush drop >= 50%, aggregate
+#    throughput does not regress (>= 0.85x control, CPU noise margin),
+#    control flushes are sync points while pooled flushes are async
+#    with their commit sync on the encode_wait record, and parity
+#    buffers recycle through the pool.
 #
 # Analyzers emit SARIF 2.1.0 into qa/_sarif/ (github code-scanning uploads
 # resolve URIs against the repo root, which is where this script runs
@@ -253,5 +260,25 @@ else
     rc=1
 fi
 
-echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json, accounting_smoke.json, qos_smoke.json, recovery_smoke.json)"
+echo "== device pool smoke (control vs pooled async encode) =="
+# host-copy bytes per fused flush must drop >= 50% with the pool on,
+# throughput must not regress, and the flush/commit sync split must be
+# honest (ceph_tpu/qa/device_pool_smoke.py; docs/write_path.md)
+CEPH_TPU_BENCH_FORCE_CPU=1 JAX_PLATFORMS=cpu \
+    python -m ceph_tpu.qa.device_pool_smoke > "$OUT_DIR/device_pool_smoke.json"
+dpool_rc=$?
+if [ $dpool_rc -eq 0 ]; then
+    echo "device pool smoke: ok"
+elif python -c "import json; json.load(open('$OUT_DIR/device_pool_smoke.json'))" \
+        2>/dev/null; then
+    echo "device pool smoke: FAILED:"
+    python -c "import json; [print(' -', p) for p in json.load(open('$OUT_DIR/device_pool_smoke.json'))['problems']]" || true
+    rc=1
+else
+    rm -f "$OUT_DIR/device_pool_smoke.json"
+    echo "device pool smoke: ERROR (exit $dpool_rc) — scenario crashed"
+    rc=1
+fi
+
+echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json, accounting_smoke.json, qos_smoke.json, recovery_smoke.json, device_pool_smoke.json)"
 exit $rc

@@ -1,0 +1,426 @@
+"""cephdma gate: device-resident stripe pool, donated buffers, and the
+fully async encode path (ISSUE 14).
+
+Fast, unit-level (no clusters) — the tier-1 budget rule.  Covers: pool
+bounds/LRU/geometry keying, donation round-trip bit-identity vs the
+numpy referee for the RS(8,4) and bitmatrix/XOR routes, async
+encode_submit/encode_wait demux identical to inline, mixed-geometry
+flushes, the ec_device_pool escape hatch + sentinel-degraded bypass,
+telemetry host-copy/sync-point counters moving, stream_encode and the
+decode (recovery) path riding the pool, and the CL8 op-path host-trip
+audit's TP/TN fixtures.
+"""
+from __future__ import annotations
+
+import warnings
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.context import CephContext
+from ceph_tpu.common.kernel_telemetry import SENTINEL, TELEMETRY
+from ceph_tpu.gf.matrix import cauchy_good_coding_matrix
+from ceph_tpu.gf.reference_codec import apply_matrix as ref_apply
+from ceph_tpu.ops import bitplane as bp
+from ceph_tpu.ops.device_pool import (
+    POOL,
+    DevicePool,
+    set_donation_override,
+)
+from ceph_tpu.ops.pipeline import stream_encode
+from ceph_tpu.osd.write_batcher import WriteBatcher
+
+RNG = np.random.default_rng(20260804)
+MAT84 = cauchy_good_coding_matrix(8, 4).astype(np.uint8)
+KEY84 = bp.matrix_digest(MAT84)
+MAT42 = cauchy_good_coding_matrix(4, 2).astype(np.uint8)
+KEY42 = bp.matrix_digest(MAT42)
+
+
+def _stripes(n, k=8, L=256):
+    return [RNG.integers(0, 256, (k, L), dtype=np.uint8)
+            for _ in range(n)]
+
+
+@pytest.fixture(autouse=True)
+def _clean_pool():
+    POOL.configure(enabled=True, max_bytes=256 << 20)
+    POOL.clear()
+    yield
+    set_donation_override(None)
+    SENTINEL.reset_state()
+    POOL.configure(enabled=True, max_bytes=256 << 20)
+    POOL.clear()
+
+
+def _batcher(**overrides):
+    conf = {"ec_batch_window_ms": 50.0, "ec_batch_max_stripes": 64,
+            "ec_batch_max_bytes": 8 << 20}
+    conf.update(overrides)
+    cct = CephContext("osd.dp", overrides=conf)
+    b = WriteBatcher(cct, entity="osd.dp")
+    b.start()
+    return b
+
+
+# -- the pool itself ---------------------------------------------------------
+
+def test_pool_geometry_keying_and_lru_bounds():
+    pool = DevicePool(max_bytes=3 * 2048, enabled=True)
+    a = [pool.put(RNG.integers(0, 256, (8, 256), dtype=np.uint8))
+         for _ in range(2)]          # geometry A: 2048 B each
+    b = pool.put(RNG.integers(0, 256, (4, 512), dtype=np.uint8))  # B: 2048
+    for dev in a:
+        pool.release(dev)
+    pool.release(b)
+    st = pool.stats()
+    assert st["resident_bytes"] == 3 * 2048
+    assert st["geometries"] == 2
+    # same-geometry acquire hits; foreign geometry misses
+    assert pool.acquire((8, 256), np.uint8) is not None
+    assert pool.acquire((2, 64), np.uint8) is None
+    st = pool.stats()
+    assert st["hits"] == 1 and st["misses"] >= 1
+    # overflow evicts the least-recently-USED geometry wholesale:
+    # geometry A was touched by the hit above, so B goes first
+    pool.release(pool.put(RNG.integers(0, 256, (8, 256), dtype=np.uint8)))
+    big = pool.put(RNG.integers(0, 256, (16, 256), dtype=np.uint8))  # 4096
+    pool.release(big)
+    st = pool.stats()
+    assert st["evictions"] >= 1
+    assert st["resident_bytes"] <= pool.max_bytes
+    assert pool.acquire((4, 512), np.uint8) is None  # B evicted
+
+
+def test_pool_disable_drains_and_bypasses():
+    pool = DevicePool(max_bytes=1 << 20, enabled=True)
+    pool.release(pool.put(RNG.integers(0, 256, (8, 64), dtype=np.uint8)))
+    assert pool.stats()["resident_bytes"] > 0
+    pool.configure(enabled=False)
+    assert pool.stats()["resident_bytes"] == 0
+    assert not pool.enabled()
+    # put still works (plain transfer), release is a no-op
+    dev = pool.put(RNG.integers(0, 256, (8, 64), dtype=np.uint8))
+    pool.release(dev)
+    assert pool.stats()["resident_bytes"] == 0
+
+
+def test_sentinel_degraded_forces_pool_bypass():
+    assert POOL.enabled()
+    SENTINEL.force("degraded", "test wedge")
+    try:
+        assert not POOL.enabled()
+    finally:
+        SENTINEL.reset_state()
+    assert POOL.enabled()
+
+
+# -- donated / async kernel entry points ------------------------------------
+
+def test_donated_roundtrip_bit_identical_rs84():
+    x = _stripes(1)[0]
+    ref = ref_apply(MAT84, x)
+    # donated jit exercised explicitly (CPU ignores donation — force
+    # the routing so the donated program itself is what runs)
+    set_donation_override(True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = np.asarray(
+            bp.apply_matrix_dev(MAT84, POOL.put(x), mat_key=KEY84,
+                                donate=True))
+        fused = np.asarray(
+            bp.fused_encode_async(MAT84, _split_cols(x, 4),
+                                  mat_key=KEY84, donate=True))
+    assert (out == ref).all()
+    assert (fused == ref).all()
+    set_donation_override(None)
+
+
+def _split_cols(x, n):
+    L = x.shape[1] // n
+    return [np.ascontiguousarray(x[:, i * L:(i + 1) * L])
+            for i in range(n)]
+
+
+def test_xor_bitmatrix_route_bit_identical():
+    B = RNG.integers(0, 2, (14, 56)).astype(np.uint8)
+    rows = RNG.integers(0, 256, (56, 128), dtype=np.uint8)
+    ref = np.zeros((14, 128), np.uint8)
+    for r in range(14):
+        for j in np.nonzero(B[r])[0]:
+            ref[r] ^= rows[j]
+    key = bp.matrix_digest(B)
+    out_jax = np.asarray(bp.apply_xor_matrix_jax(B, rows, mat_key=key))
+    out_dev = np.asarray(
+        bp.apply_xor_matrix_dev(B, POOL.put(rows), mat_key=key,
+                                donate=True))
+    assert (out_jax == ref).all()
+    assert (out_dev == ref).all()
+
+
+def test_fused_encode_matches_host_pack():
+    stripes = _stripes(5)
+    packed = np.concatenate(stripes, axis=1)
+    ref = np.asarray(bp.apply_matrix_jax(MAT84, packed, mat_key=KEY84))
+    fused = np.asarray(
+        bp.fused_encode_async(MAT84, stripes, mat_key=KEY84, donate=True))
+    # arity is bucketed to the next power of two with zero stripes: the
+    # payload window is bit-identical, the pad columns are zero parity
+    assert (fused[:, :packed.shape[1]] == ref).all()
+    assert fused.shape[1] >= packed.shape[1]
+    assert (fused[:, packed.shape[1]:] == 0).all()
+
+
+def test_matrix_digest_stable_and_distinct():
+    assert bp.matrix_digest(MAT84) == KEY84
+    assert bp.matrix_digest(MAT84.copy()) == KEY84
+    assert bp.matrix_digest(MAT42) != KEY84
+    # same bytes, different shape -> different identity
+    assert bp.matrix_digest(MAT84.reshape(8, 4)) != KEY84
+
+
+# -- the async batcher path --------------------------------------------------
+
+def test_async_demux_identical_to_inline_and_control():
+    stripes = _stripes(6)
+    refs = [ref_apply(MAT84, s) for s in stripes]
+    for pool_on in (True, False):
+        b = _batcher(ec_device_pool=pool_on,
+                     ec_batch_max_stripes=len(stripes))
+        try:
+            tickets = [b.encode_submit(MAT84, s, mat_key=KEY84)
+                       for s in stripes]
+            outs = [b.encode_wait(t) for t in tickets]
+        finally:
+            b.stop()
+        for o, r in zip(outs, refs):
+            assert isinstance(o, np.ndarray)
+            assert (np.asarray(o) == r).all(), f"pool={pool_on}"
+        assert b.stats()["flushes"] >= 1
+
+
+def test_pool_survives_mixed_geometry_flushes():
+    big = _stripes(4, k=8, L=256)
+    small = _stripes(3, k=4, L=128)
+    b = _batcher(ec_device_pool=True, ec_batch_max_stripes=16)
+    try:
+        tickets = [b.encode_submit(MAT84, s, mat_key=KEY84) for s in big] \
+            + [b.encode_submit(MAT42, s, mat_key=KEY42) for s in small]
+        outs = [b.encode_wait(t) for t in tickets]
+    finally:
+        b.stop()
+    for o, s, m in zip(outs, big + small, [MAT84] * 4 + [MAT42] * 3):
+        assert (np.asarray(o) == ref_apply(m, s)).all()
+    st = POOL.stats()
+    assert st["releases"] >= 2  # both groups' parity parents recycled
+    assert st["resident_bytes"] <= POOL.max_bytes
+
+
+def test_group_keying_by_digest_not_identity():
+    # two DIFFERENT matrices with the same shape must not fuse into one
+    # group even when both carry digests (correctness of the key)
+    s84 = _stripes(2, k=8, L=128)
+    mat_b = cauchy_good_coding_matrix(8, 4).astype(np.uint8).copy()
+    mat_b[0, 0] ^= 0x55  # distinct matrix, same geometry
+    key_b = bp.matrix_digest(mat_b)
+    assert key_b != KEY84
+    b = _batcher(ec_device_pool=True, ec_batch_max_stripes=8)
+    try:
+        t1 = b.encode_submit(MAT84, s84[0], mat_key=KEY84)
+        t2 = b.encode_submit(mat_b, s84[1], mat_key=key_b)
+        o1, o2 = b.encode_wait(t1), b.encode_wait(t2)
+    finally:
+        b.stop()
+    assert (np.asarray(o1) == ref_apply(MAT84, s84[0])).all()
+    assert (np.asarray(o2) == ref_apply(mat_b, s84[1])).all()
+
+
+def test_telemetry_counters_move_and_sync_split():
+    stripes = _stripes(4)
+    TELEMETRY.enable(True)
+
+    def flush_stats():
+        d = TELEMETRY.dump()
+        return (d.get("ec_batch_flush", {}), d.get("encode_wait", {}))
+
+    f0, w0 = flush_stats()
+    b = _batcher(ec_device_pool=True, ec_batch_max_stripes=4)
+    try:
+        outs = [b.encode_wait(t) for t in
+                [b.encode_submit(MAT84, s, mat_key=KEY84)
+                 for s in stripes]]
+    finally:
+        b.stop()
+    f1, w1 = flush_stats()
+    # pooled flush: host-copy counted (transfers), NO flush sync point;
+    # the commit sync + its host copy ride the encode_wait record
+    d_copy = f1.get("host_copy_bytes", 0) - f0.get("host_copy_bytes", 0)
+    assert d_copy == sum(s.nbytes for s in stripes)
+    assert f1.get("sync_points", 0) == f0.get("sync_points", 0)
+    assert w1.get("sync_points", 0) > w0.get("sync_points", 0)
+    assert w1.get("host_copy_bytes", 0) > w0.get("host_copy_bytes", 0)
+    # control flush: sync point on the flusher, pack+transfer+fetch
+    f0, _ = flush_stats()
+    b = _batcher(ec_device_pool=False, ec_batch_max_stripes=4)
+    try:
+        [b.encode_wait(t) for t in
+         [b.encode_submit(MAT84, s, mat_key=KEY84) for s in stripes]]
+    finally:
+        b.stop()
+    f1, _ = flush_stats()
+    assert f1.get("sync_points", 0) > f0.get("sync_points", 0)
+    assert f1.get("host_copy_bytes", 0) - f0.get("host_copy_bytes", 0) \
+        > sum(s.nbytes for s in stripes)
+    # the pool's own counters render on the shared kernel PerfCounters
+    names = set(TELEMETRY.perf.schema())
+    assert {"device_pool_hits", "device_pool_misses",
+            "device_pool_evictions", "device_pool_resident_bytes"} \
+        <= names
+
+
+def test_escape_hatch_and_degraded_take_historical_path():
+    stripes = _stripes(3)
+    ref = [ref_apply(MAT84, s) for s in stripes]
+
+    def sync_delta(run):
+        d0 = TELEMETRY.dump().get("ec_batch_flush", {})
+        run()
+        d1 = TELEMETRY.dump().get("ec_batch_flush", {})
+        return d1.get("sync_points", 0) - d0.get("sync_points", 0)
+
+    def run_with(b):
+        try:
+            outs = [b.encode_wait(t) for t in
+                    [b.encode_submit(MAT84, s, mat_key=KEY84)
+                     for s in stripes]]
+        finally:
+            b.stop()
+        for o, r in zip(outs, ref):
+            assert (np.asarray(o) == r).all()
+
+    # hatch off -> historical sync flush
+    assert sync_delta(
+        lambda: run_with(_batcher(ec_device_pool=False,
+                                  ec_batch_max_stripes=3))) >= 1
+    # hatch on but sentinel degraded -> forced bypass, still sync
+    SENTINEL.force("degraded", "test wedge")
+    try:
+        assert sync_delta(
+            lambda: run_with(_batcher(ec_device_pool=True,
+                                      ec_batch_max_stripes=3))) >= 1
+    finally:
+        SENTINEL.reset_state()
+    # healthy + hatch on -> async flush (no flush sync point)
+    assert sync_delta(
+        lambda: run_with(_batcher(ec_device_pool=True,
+                                  ec_batch_max_stripes=3))) == 0
+
+
+# -- pipeline + decode (recovery) paths --------------------------------------
+
+def test_stream_encode_pool_parity_and_recycle():
+    batches = [RNG.integers(0, 256, (8, 512), dtype=np.uint8)
+               for _ in range(4)]
+    refs = [ref_apply(MAT84, x) for x in batches]
+    outs_on = stream_encode(MAT84, iter(batches), kernel="auto",
+                            mat_key=KEY84)
+    POOL.configure(enabled=False)
+    outs_off = stream_encode(MAT84, iter(batches), kernel="auto",
+                             mat_key=KEY84)
+    POOL.configure(enabled=True)
+    for a, b_, r in zip(outs_on, outs_off, refs):
+        assert (np.asarray(a) == r).all()
+        assert (np.asarray(b_) == r).all()
+
+
+def test_decode_chunks_rides_pool_with_hits():
+    from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+    codec = ErasureCodePluginRegistry.instance().factory(
+        {"plugin": "jax", "k": "4", "m": "2",
+         "technique": "cauchy_good"})
+    data = bytes(RNG.integers(0, 256, 4 * 4096, dtype=np.uint8))
+    enc = codec.encode(set(range(6)), data)
+    h0 = POOL.stats()["hits"]
+    for _ in range(3):  # repeated same-geometry rebuilds recycle
+        dec = codec.decode({0, 1, 2, 3},
+                           {i: enc[i] for i in (1, 2, 3, 4, 5)},
+                           len(enc[0]))
+        out = b"".join(np.asarray(dec[i], np.uint8).tobytes()
+                       for i in range(4))
+        assert out == data
+    assert POOL.stats()["hits"] - h0 >= 2
+
+
+# -- CL8 op-path host-trip audit ---------------------------------------------
+
+AUDIT_TP = '''
+import numpy as np
+import jax
+from ceph_tpu.ops.bitplane import apply_matrix_jax
+
+
+def leaky_flush(mat, chunks):
+    dev = jax.device_put(chunks)
+    parity = np.asarray(apply_matrix_jax(mat, dev))
+    jax.block_until_ready(parity)
+    return parity
+'''
+
+AUDIT_TN = '''
+import numpy as np
+import jax
+from ceph_tpu.ops.bitplane import apply_matrix_jax
+
+
+def deliberate_flush(mat, chunks):
+    dev = jax.device_put(chunks)  # noqa: CL8 - the transfer seam
+    parity = np.asarray(apply_matrix_jax(mat, dev))  # noqa: CL8 - commit sync
+    return parity
+
+
+def host_only(a, b):
+    return np.asarray(a) + np.asarray(b)  # plain host numpy: no finding
+'''
+
+
+def _run_audit(tmp_path: Path, src: str):
+    from ceph_tpu.qa.analyzer.core import Config, run
+
+    pkg = tmp_path / "fixpkg"
+    (pkg / "osd").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "osd" / "write_batcher.py").write_text(src)
+    report = run(Config.discover([str(pkg)]))
+    return report
+
+
+def test_cl8_hosttrip_audit_true_positive(tmp_path):
+    report = _run_audit(tmp_path, AUDIT_TP)
+    idents = {f.ident for f in report.findings if f.code == "CL8"}
+    assert any(i.startswith("hosttrip:leaky_flush:device_put")
+               for i in idents), idents
+    assert any("asarray(apply_matrix_jax)" in i for i in idents), idents
+    assert any("block_until_ready" in i for i in idents), idents
+
+
+def test_cl8_hosttrip_audit_noqa_suppresses(tmp_path):
+    report = _run_audit(tmp_path, AUDIT_TN)
+    active = {f.ident for f in report.findings if f.code == "CL8"}
+    assert not any(i.startswith("hosttrip:") for i in active), active
+    noqa = {f.ident for f in report.noqa if f.code == "CL8"}
+    assert any(i.startswith("hosttrip:deliberate_flush") for i in noqa)
+
+
+def test_cl8_whole_package_audit_clean():
+    # the acceptance criterion: zero unsuppressed host-trip findings on
+    # the op path of the REAL package
+    from ceph_tpu.qa.analyzer.core import Config, run
+
+    repo_pkg = Path(__file__).resolve().parents[1] / "ceph_tpu"
+    cfg = Config.discover([str(repo_pkg)])
+    cfg.checks = ("CL8",)
+    report = run(cfg)
+    bad = [f.ident for f in report.findings if f.ident.startswith("hosttrip:")]
+    assert not bad, bad

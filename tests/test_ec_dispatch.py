@@ -129,9 +129,9 @@ def test_registry_codec_reaches_dispatcher(monkeypatch):
     hits = {"n": 0}
     real = bitplane.apply_matrix_jax
 
-    def spy(mat, chunks):
+    def spy(mat, chunks, **kw):
         hits["n"] += 1
-        return real(mat, chunks)
+        return real(mat, chunks, **kw)
 
     monkeypatch.setattr(bitplane, "apply_matrix_jax", spy)
     codec = ErasureCodePluginRegistry.instance().factory(
