@@ -308,6 +308,56 @@ class KernelTelemetry:
 TELEMETRY = KernelTelemetry()
 
 
+class BackendDevicePerf:
+    """PerfCounters duck type exporting the sentinel's per-device probe
+    rows as ``ceph_backend_device_*{device}`` labeled series (cephplace
+    satellite — groundwork for the ROADMAP mesh-shrink item: a sick
+    chip shows up as its OWN row going unhealthy, not just a process-
+    wide degraded flag).  Daemons add the singleton to their cct.perf
+    next to TELEMETRY.perf; the rows come live from the sentinel at
+    dump time, so there is no write path to race."""
+
+    def __init__(self):
+        self.name = "backend"
+
+    def dump(self) -> dict:
+        rows = [
+            {"labels": {"device": d["device"]},
+             "device_ok": int(bool(d.get("ok"))),
+             "device_probe_ms": round(float(d.get("latency_ms") or 0.0),
+                                      3)}
+            for d in SENTINEL.devices()
+        ]
+        return {
+            "per_device": {"__labeled__": True, "rows": rows},
+            "devices_seen": len(rows),
+        }
+
+    def schema(self) -> dict:
+        return {
+            "per_device": {
+                "type": "labeled",
+                "description": "per-accelerator-device probe rows from "
+                               "the backend sentinel "
+                               "(docs/observability.md)"},
+            "device_ok": {
+                "type": "gauge",
+                "description": "1 = the last sentinel probe reached "
+                               "this jax device; 0 = it failed or the "
+                               "backend probe as a whole is failing"},
+            "device_probe_ms": {
+                "type": "gauge",
+                "description": "last per-device probe round-trip "
+                               "latency (device_put + block) in ms"},
+            "devices_seen": {
+                "type": "gauge",
+                "description": "devices the sentinel has probed"},
+        }
+
+
+DEVICE_PERF = BackendDevicePerf()
+
+
 # -- backend health sentinel -----------------------------------------------
 
 def default_probe() -> str:
@@ -335,6 +385,48 @@ def default_probe() -> str:
     return jax.devices()[0].platform
 
 
+def _forced_device_rows(ok: bool, reason: str | None) -> list[dict]:
+    """The ONE synthesized-row shape every forced/pinned sentinel path
+    emits (env override + runtime force pin) — exporter consumers see
+    the same fields either way."""
+    return [{"device": "forced:0", "platform": "forced", "ok": ok,
+             "latency_ms": 0.0, "error": None if ok else reason}]
+
+
+def probe_device_rows() -> list[dict]:
+    """Per-device probe rows: one entry per ``jax.devices()`` device
+    with verdict + round-trip latency (a tiny device_put forced to
+    completion).  Runs INSIDE the sentinel's disposable probe worker —
+    a wedged device hangs the worker, never a caller.  The
+    ``CEPH_TPU_SENTINEL_STATE`` override synthesizes rows without
+    touching jax (the CI simulated wedge)."""
+    forced = os.environ.get("CEPH_TPU_SENTINEL_STATE", "")
+    if forced:
+        state, _, reason = forced.partition(":")
+        ok = state != "degraded"
+        return _forced_device_rows(ok, reason or (
+            "forced degraded (CEPH_TPU_SENTINEL_STATE)"))
+    import jax
+    import numpy as _np
+
+    rows = []
+    for d in jax.devices():
+        t0 = time.perf_counter()
+        try:
+            jax.device_put(_np.zeros(8, _np.uint8), d).block_until_ready()
+            ok, err = True, None
+        except Exception as e:  # one sick device must not hide the rest
+            ok, err = False, f"{type(e).__name__}: {e}"
+        rows.append({
+            "device": f"{d.platform}:{d.id}",
+            "platform": d.platform,
+            "ok": ok,
+            "latency_ms": (time.perf_counter() - t0) * 1e3,
+            "error": err,
+        })
+    return rows
+
+
 class SentinelPolicy:
     """Constructor-injected sentinel behavior (probe cadence, the fast
     timeout that bounds a wedged probe, and the probe itself) — the same
@@ -342,13 +434,25 @@ class SentinelPolicy:
     hand the sentinel a canned probe and a laptop and a pod slice run
     the same daemon code."""
 
-    __slots__ = ("interval", "timeout", "probe", "boot_timeout")
+    __slots__ = ("interval", "timeout", "probe", "boot_timeout",
+                 "device_probe")
 
     def __init__(self, interval: float = 5.0, timeout: float = 2.0,
-                 probe=None, boot_timeout: float | None = None):
+                 probe=None, boot_timeout: float | None = None,
+                 device_probe=None):
         self.interval = float(interval)
         self.timeout = float(timeout)
         self.probe = probe if probe is not None else default_probe
+        # per-device rows ride the same worker; an INJECTED headline
+        # probe must stay in control of what the worker touches — with
+        # a canned probe and no explicit device_probe, rows are
+        # synthesized from the canned verdict instead of reaching jax
+        if device_probe is not None:
+            self.device_probe = device_probe
+        elif probe is None:
+            self.device_probe = probe_device_rows
+        else:
+            self.device_probe = None
         # until the runtime has answered ONCE, the probe budget covers
         # cold init (the first jax.devices() on a real TPU routinely
         # takes >2 s bringing the runtime up) — without this grace every
@@ -376,6 +480,20 @@ class BackendSentinel:
         self._forced: tuple[str, str] | None = None
         self._hung_probe: threading.Thread | None = None
         self._answered = False  # any probe ever returned (ok OR error)
+        # the probe worker currently inside a per-device sweep (None =
+        # idle); a still-ALIVE previous sweep worker suppresses new
+        # sweeps, and its eventual answer still lands (the _hung_probe
+        # pattern — a lock held across device round-trips could never
+        # recover from a wedged device)
+        self._sweep_worker: threading.Thread | None = None
+        #: per-device probe rows from the last answering cycle (the
+        #: ceph_backend_device_*{device} series + dump payload); the
+        #: generation counter bumps on every non-sweep write so a
+        #: STRAGGLING sweep worker (wedged device answering cycles
+        #: later) cannot resurrect rows a reset/force/failure-mark
+        #: already superseded
+        self._devices: list[dict] = []
+        self._dev_gen = 0
         self._st = {
             "state": "unknown", "reason": None, "since": None,
             "platform": None, "last_probe": None, "probes": 0,
@@ -418,14 +536,36 @@ class BackendSentinel:
         with self._lock:
             return dict(self._st)
 
+    def devices(self) -> list[dict]:
+        """Per-device probe rows from the last answering cycle.  While
+        the whole backend probe is failing/hung, the rows are the last
+        known set with every verdict flipped to failed — each device is
+        suspect until a probe answers again."""
+        with self._lock:
+            return [dict(d) for d in self._devices]
+
+    def _mark_devices_failed(self, reason: str) -> None:
+        """Flip every known row suspect.  Bumps the generation so any
+        in-flight sweep's landing is invalidated (the sweep's OWN
+        overrun mark is inlined in _probe_cycle instead — there the
+        wedged worker's eventual answer is fresher and must land)."""
+        with self._lock:
+            self._dev_gen += 1
+            for d in self._devices:
+                d["ok"] = False
+                d["error"] = reason
+
     def reset_state(self) -> None:
         """Back to pristine `unknown` (clears any force pin): tests and
         one-shot tools that must not leak latched state process-wide."""
         with self._lock:
             self._forced = None
             self._hung_probe = None
+            self._sweep_worker = None
             self._answered = False
             self.is_degraded = False
+            self._devices = []
+            self._dev_gen += 1
             self._st = {
                 "state": "unknown", "reason": None, "since": None,
                 "platform": None, "last_probe": None, "probes": 0,
@@ -467,23 +607,72 @@ class BackendSentinel:
             self._st["last_probe"] = time.time()
             hung = self._hung_probe
         if forced is not None:
-            self._transition(forced[0] == "degraded",
-                             forced[1] or f"forced {forced[0]}",
-                             platform=None)
+            degraded = forced[0] == "degraded"
+            reason = forced[1] or f"forced {forced[0]}"
+            with self._lock:
+                self._devices = _forced_device_rows(not degraded, reason)
+                self._dev_gen += 1
+            self._transition(degraded, reason, platform=None)
             return
         if hung is not None and hung.is_alive():
             # the previous probe never answered: the backend is still
             # wedged — do not stack more hung workers
+            self._mark_devices_failed("backend probe still hung")
             self._transition(True, "backend probe still hung", None)
             return
         box: dict = {}
+        headline_done = threading.Event()
         done = threading.Event()
 
         def work():
+            me = threading.current_thread()
             try:
                 box["platform"] = self._policy.probe()
             except BaseException as e:
                 box["error"] = f"{type(e).__name__}: {e}"
+                headline_done.set()
+                done.set()
+                return
+            headline_done.set()
+            # per-device rows ride the same disposable worker AFTER the
+            # headline verdict is out: N busy devices queueing behind
+            # in-flight work must not eat the headline budget and latch
+            # a spurious process-wide degraded.  A still-alive previous
+            # sweep suppresses stacking (the _hung_probe pattern — a
+            # held lock could never recover from a wedged device; a
+            # thread marker clears the moment the device answers).
+            with self._lock:
+                busy = self._sweep_worker
+                if busy is not None and busy.is_alive():
+                    done.set()
+                    return
+                self._sweep_worker = me
+                gen0 = self._dev_gen
+            try:
+                dp = self._policy.device_probe
+                rows = dp() if dp is not None else [{
+                    "device": f"{box['platform']}:0",
+                    "platform": box["platform"], "ok": True,
+                    "latency_ms": 0.0, "error": None,
+                }]
+                # land directly under the lock: a sweep that WEDGED on
+                # a device and recovers cycles later must still refresh
+                # the rows, even though its own probe cycle long moved
+                # on — UNLESS a reset/force/failure-mark superseded the
+                # generation it started from (stale rows must stay dead).
+                # Landing and clearing the worker marker are ONE lock
+                # block so the overrun path can never observe
+                # landed-but-not-cleared and flip fresh rows to failed.
+                with self._lock:
+                    if self._dev_gen == gen0:
+                        self._devices = list(rows)
+                    self._sweep_worker = None
+            except BaseException as e:
+                box["devices_error"] = f"{type(e).__name__}: {e}"
+            finally:
+                with self._lock:
+                    if self._sweep_worker is me:
+                        self._sweep_worker = None
             done.set()
 
         t = threading.Thread(target=work, name="backend-probe", daemon=True)
@@ -493,9 +682,11 @@ class BackendSentinel:
             # least once; a cold process gets the boot grace instead
             timeout = (self._policy.timeout if self._answered
                        else self._policy.boot_timeout)
-        if not done.wait(timeout=timeout):
+        if not headline_done.wait(timeout=timeout):
             with self._lock:
                 self._hung_probe = t
+            self._mark_devices_failed(
+                f"backend probe timed out after {timeout}s")
             self._transition(
                 True, f"backend probe timed out after {timeout}s", None)
             return
@@ -503,9 +694,30 @@ class BackendSentinel:
             self._hung_probe = None
             self._answered = True
         if "error" in box:
+            self._mark_devices_failed(
+                f"backend probe failed: {box['error']}")
             self._transition(True, f"backend probe failed: {box['error']}",
                              None)
         else:
+            # the sweep gets its OWN grace equal to the probe budget;
+            # on overrun the verdict stays healthy but every row flips
+            # suspect (a wedged device must not keep reading ok=1), and
+            # the wedged worker's eventual answer still refreshes them —
+            # the process-wide latch keys off the headline probe only
+            if not done.wait(timeout=timeout):
+                # check + mark under ONE acquisition: a worker that
+                # landed fresh rows and cleared the marker in between
+                # must not have them flipped back to failed.  No gen
+                # bump — the wedged worker's eventual answer is fresher
+                # than this mark and must still land.
+                with self._lock:
+                    if self._sweep_worker is not None:
+                        for d in self._devices:
+                            d["ok"] = False
+                            d["error"] = "device sweep hung"
+            if "devices_error" in box:
+                self._mark_devices_failed(
+                    f"device sweep failed: {box['devices_error']}")
             self._transition(False, None, box.get("platform"))
 
     def _transition(self, degraded: bool, reason: str | None,
@@ -558,5 +770,9 @@ def dump_kernel_telemetry() -> dict:
         "kernels": TELEMETRY.dump(),
         "fallback": TELEMETRY.fallback_latched(),
         "sentinel": SENTINEL.state(),
+        # cephplace satellite: one row per jax device with the last
+        # probe's verdict + latency (ceph_backend_device_* on the
+        # exporter; groundwork for mesh-shrink on a sick chip)
+        "devices": SENTINEL.devices(),
         "events": TELEMETRY.events(),
     }

@@ -225,7 +225,7 @@ def default_options() -> OptionTable:
                    min=0.05),
             Option("mgr_modules", str,
                    "status,prometheus,balancer,iostat,quota,"
-                   "metrics_history,qos,progress",
+                   "metrics_history,qos,progress,placement",
                    "comma-separated modules the mgr hosts"),
             Option("rgw_lc_interval", float, 5.0,
                    "seconds between lifecycle passes (upstream: daily)",
@@ -241,6 +241,22 @@ def default_options() -> OptionTable:
             Option("mgr_balancer_active", bool, True,
                    "balancer applies upmaps (false = dry-run)",
                    runtime=True),
+            # -- cephplace placement observability (mgr/placement_module)
+            Option("mgr_placement_interval", float, 5.0,
+                   "seconds between periodic placement scans (each scan "
+                   "maps every pool through crush_do_rule_batch, scores "
+                   "the distribution vs the weight-proportional ideal, "
+                   "and exports ceph_placement_* series; an osdmap "
+                   "epoch change scans immediately and forecasts the "
+                   "remap as ceph_remap_* / `placement diff`)", min=0.1,
+                   runtime=True),
+            Option("mgr_placement_max_deviation", float, 8.0,
+                   "largest per-OSD deviation from the ideal PG-shard "
+                   "share a pool may carry (in PG shards) before the "
+                   "mon raises PG_IMBALANCE — only while the balancer "
+                   "is idle or off; an actively-converging balancer "
+                   "suppresses the check (docs/observability.md)",
+                   min=0.0, runtime=True),
             Option("mgr_stale_report_age", float, 30.0,
                    "drop daemon reports older than this", min=1.0),
             # -- cephheal progress (mgr/progress_module.py) ----------------

@@ -13,11 +13,29 @@ src/test/cli/crushtool/*.t, SURVEY.md §4 ring 1).
 """
 from __future__ import annotations
 
+import copy
+import hashlib
+import threading
+from collections import OrderedDict
+
 import numpy as np
 
 from .mapper import CompiledCrushMap, crush_do_rule_batch, validate_choose_args
 from .reference_mapper import crush_do_rule
 from .types import BUCKET_ALG_NAMES, BUCKET_STRAW, BUCKET_TREE, BUCKET_UNIFORM, CrushMap, Rule, RuleOp, RuleStep, Straw2Bucket, Tunables
+
+#: process-wide CompiledCrushMap cache keyed by map CONTENT digest.
+#: Every osdmap epoch the mon streams out decodes to a FRESH CrushWrapper
+#: whose compiled form would otherwise rebuild (and re-trace every jitted
+#: rule fn — seconds of host time) even though the crush content is
+#: byte-identical; with per-epoch batch consumers (the mgr placement
+#: scan, the balancer eval pair, `ceph osd df` deviation columns) that
+#: retrace dominates everything.  Entries own a PRIVATE deepcopy of the
+#: map so a source wrapper mutating its live map in place (mon-side
+#: edits) can never skew a cached entry other wrappers share.
+_COMPILED_CACHE_MAX = 8
+_COMPILED_CACHE: OrderedDict[str, CompiledCrushMap] = OrderedDict()
+_COMPILED_CACHE_LOCK = threading.Lock()
 
 _OP_NAMES = {
     RuleOp.TAKE: "take",
@@ -37,6 +55,23 @@ class CrushWrapper:
     def __init__(self, cmap: CrushMap | None = None):
         self.map = cmap or CrushMap()
         self._compiled: CompiledCrushMap | None = None
+        self._content_digest: str | None = None
+
+    def __deepcopy__(self, memo):
+        # a scratch copy (balancer pass) must not deep-copy the compiled
+        # device tables and jitted rule fns — the copy re-resolves them
+        # from the content-digest cache (crush content is unchanged by
+        # pg_upmap edits, so it's a hit)
+        cls = self.__class__
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        new.map = copy.deepcopy(self.map, memo)
+        new._compiled = None
+        # a copy has identical content by definition — keep the digest
+        # (None if never computed) so the scratch's first compiled()
+        # lookup skips the O(map) format_text+sha1 rebuild
+        new._content_digest = self._content_digest
+        return new
 
     # -- names ------------------------------------------------------------
     def name_of(self, item: int) -> str:
@@ -411,10 +446,34 @@ class CrushWrapper:
     # -- mapping ----------------------------------------------------------
     def invalidate(self) -> None:
         self._compiled = None
+        self._content_digest = None
+
+    def content_digest(self) -> str:
+        """Digest of the full text form — the same canonical content an
+        osdmap round-trips (to_json carries crush as text), so two
+        wrappers mapping identically share one digest."""
+        if self._content_digest is None:
+            self._content_digest = hashlib.sha1(
+                self.format_text().encode()).hexdigest()
+        return self._content_digest
 
     def compiled(self) -> CompiledCrushMap:
         if self._compiled is None:
-            self._compiled = CompiledCrushMap(self.map)
+            key = self.content_digest()
+            with _COMPILED_CACHE_LOCK:
+                hit = _COMPILED_CACHE.get(key)
+                if hit is not None:
+                    _COMPILED_CACHE.move_to_end(key)
+            if hit is None:
+                built = CompiledCrushMap(copy.deepcopy(self.map))
+                with _COMPILED_CACHE_LOCK:
+                    # first build wins so concurrent callers share one
+                    # entry (and its lazily-built jitted rule fns)
+                    hit = _COMPILED_CACHE.setdefault(key, built)
+                    _COMPILED_CACHE.move_to_end(key)
+                    while len(_COMPILED_CACHE) > _COMPILED_CACHE_MAX:
+                        _COMPILED_CACHE.popitem(last=False)
+            self._compiled = hit
         return self._compiled
 
     def do_rule(

@@ -23,6 +23,7 @@ from . import devicehealth_module  # noqa: F401
 from . import iostat_module  # noqa: F401
 from . import quota_module  # noqa: F401
 from . import pg_autoscaler_module  # noqa: F401
+from . import placement_module  # noqa: F401
 from . import progress_module  # noqa: F401
 from . import prometheus_module  # noqa: F401
 from . import qos_module  # noqa: F401
@@ -245,6 +246,20 @@ class MgrDaemon(Dispatcher):
     def latest_stats(self) -> dict:
         return {d: s for d, (_t, s)
                 in self.latest_stats_with_ts().items()}
+
+    def pg_degraded_by_pgid(self) -> dict[str, int]:
+        """Freshest-wins union of the primaries' pg_info rows ->
+        {pgid: degraded objects}.  THE shared merge (progress module,
+        balancer degraded-gate): each PG has one live author, but a
+        deposed primary's final report lingers up to
+        mgr_stale_report_age — merged oldest-first so the freshest
+        author wins a same-pgid collision."""
+        out: dict[str, int] = {}
+        for _ts, st in sorted(self.latest_stats_with_ts().values(),
+                              key=lambda tv: tv[0]):
+            for pgid, info in (st.get("pg_info") or {}).items():
+                out[pgid] = int(info.get("degraded") or 0)
+        return out
 
     def latest_stats_with_ts(self) -> dict:
         """{daemon: (arrival_ts, stats)} — consumers that merge

@@ -182,16 +182,9 @@ class ProgressModule(MgrModule):
                 self.cct.conf.get("mgr_recovery_stalled_grace")))
 
     def _pg_degraded(self) -> dict[str, int]:
-        """Union of the primaries' pg_info rows -> {pgid: degraded}.
-        Each PG has exactly one LIVE author, but a deposed primary's
-        final report lingers up to mgr_stale_report_age — merged
-        oldest-report-first so the freshest author wins a collision."""
-        out: dict[str, int] = {}
-        for _ts, st in sorted(self.mgr.latest_stats_with_ts().values(),
-                              key=lambda tv: tv[0]):
-            for pgid, info in (st.get("pg_info") or {}).items():
-                out[pgid] = int(info.get("degraded") or 0)
-        return out
+        """{pgid: degraded} via the mgr's shared freshest-wins pg_info
+        merge (also the balancer's degraded-gate input)."""
+        return self.mgr.pg_degraded_by_pgid()
 
     def _recovery_failing(self) -> dict[str, dict]:
         """{pgid: {count, error, daemon}} union of the OSDs'

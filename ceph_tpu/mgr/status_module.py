@@ -5,8 +5,14 @@ streams to the mon so MgrStatMonitor can answer `ceph df`/`pg dump`
 from the monitor)."""
 from __future__ import annotations
 
+import weakref
+
 from ..osd.osdmap import PG_POOL_ERASURE
 from .module import MgrModule, register_module
+
+#: assemble_osd_df's fallback scan, memoized per (map object, epoch) —
+#: see the comment at its use site
+_OSD_DF_MEMO: weakref.WeakKeyDictionary = weakref.WeakKeyDictionary()
 
 
 def pool_usage(m, stats: dict) -> dict[int, dict]:
@@ -78,9 +84,38 @@ def assemble_df(m, stats: dict) -> dict:
     }
 
 
-def assemble_osd_df(m, stats: dict) -> dict:
+def assemble_osd_df(m, stats: dict, placement: list | None = None,
+                    skew: dict | None = None) -> dict:
     """`ceph osd df` payload (reference: OSDMonitor print_utilization
-    via PGMap::dump_osd_stats)."""
+    via PGMap::dump_osd_stats).
+
+    cephplace: the deviation/skew columns come from the SHARED scoring
+    core (osd/placement.py) — `placement` accepts the placement
+    module's cached per-OSD rows and `skew` its cluster-level
+    max_deviation/stddev (so the summary shares the core's unrounded
+    metrics instead of re-deriving them from rounded rows); absent a
+    module, the core computes both here from a fresh batched scan."""
+    if placement is None and m is not None and m.pools:
+        # memoized per MAP OBJECT (weak — no hidden state written onto
+        # the domain object) and validated by epoch (mon-side mutators
+        # bump epoch in place), so the fallback costs one batched scan
+        # per epoch — not one per digest tick — when the placement
+        # module isn't hosted to hand us its cached rows
+        try:
+            hit = _OSD_DF_MEMO.get(m)
+            if hit is not None and hit[0] == m.epoch:
+                placement, skew = hit[1], hit[2]
+            else:
+                from ..osd.placement import cluster_report, osd_rows
+
+                report = cluster_report(m)
+                placement = osd_rows(report, m)
+                skew = {"max_deviation": report["max_deviation"],
+                        "stddev": report["stddev"]}
+                _OSD_DF_MEMO[m] = (m.epoch, placement, skew)
+        except Exception:
+            placement = skew = None  # torn map mid-change: skip
+    by_osd = {r["osd"]: r for r in (placement or [])}
     rows = []
     if m is not None:
         for o in range(m.max_osd):
@@ -90,6 +125,7 @@ def assemble_osd_df(m, stats: dict) -> dict:
             sf = st.get("statfs") or {}
             total = int(sf.get("total", 0))
             used = int(sf.get("used", 0))
+            pl = by_osd.get(o) or {}
             rows.append({
                 "id": o,
                 "up": int(m.is_up(o)),
@@ -100,8 +136,24 @@ def assemble_osd_df(m, stats: dict) -> dict:
                 "avail": int(sf.get("avail", 0)),
                 "utilization": used / total if total else 0.0,
                 "pgs": st.get("num_pgs", 0),
+                # scoring-core columns (shards mapped by the batched
+                # scan vs the weight-proportional ideal)
+                "pgs_mapped": pl.get("shards", 0),
+                "target": pl.get("target", 0.0),
+                "deviation": pl.get("deviation", 0.0),
             })
     n = len(rows) or 1
+    if skew is None:
+        # last resort (rows handed in without the core's summary):
+        # recompute over ELIGIBLE OSDs only, matching skew_metrics —
+        # an out OSD's 0.0 row must not dilute stddev
+        devs = [r["deviation"] for r in rows
+                if (by_osd.get(r["id"]) or {}).get("eligible")]
+        skew = {
+            "max_deviation": max((abs(d) for d in devs), default=0.0),
+            "stddev": ((sum(d * d for d in devs) / len(devs)) ** 0.5
+                       if devs else 0.0),
+        }
     return {
         "nodes": rows,
         "summary": {
@@ -109,6 +161,8 @@ def assemble_osd_df(m, stats: dict) -> dict:
             "total_kb_used": sum(r["use"] for r in rows) // 1024,
             "average_utilization":
                 sum(r["utilization"] for r in rows) / n,
+            "max_deviation": float(skew.get("max_deviation") or 0.0),
+            "stddev": float(skew.get("stddev") or 0.0),
         },
     }
 
@@ -186,9 +240,36 @@ class StatusModule(MgrModule):
             except Exception as e:
                 self.cct.dout("mgr", 3,
                               f"progress snapshot failed: {e!r}")
+        # cephplace: the placement module's skew/diff snapshot and the
+        # balancer's pass stats ride the digest so the mon answers
+        # `placement diff`/`balancer status` and raises PG_IMBALANCE —
+        # tolerant of either module not being hosted
+        placement = None
+        placement_rows = placement_skew = None
+        pl_mod = self.mgr._modules.get("placement")
+        if pl_mod is not None:
+            try:
+                placement = pl_mod.snapshot()
+                # rows + skew come from ONE locked report snapshot so a
+                # scan landing mid-digest can't mismatch them
+                placement_rows, placement_skew = pl_mod.df_inputs()
+            except Exception as e:
+                self.cct.dout("mgr", 3,
+                              f"placement snapshot failed: {e!r}")
+        balancer = None
+        bal_mod = self.mgr._modules.get("balancer")
+        if bal_mod is not None:
+            try:
+                balancer = bal_mod.status()
+            except Exception as e:
+                self.cct.dout("mgr", 3,
+                              f"balancer snapshot failed: {e!r}")
         return {
             "df": assemble_df(m, stats),
-            "osd_df": assemble_osd_df(m, stats),
+            "osd_df": assemble_osd_df(m, stats, placement=placement_rows,
+                                      skew=placement_skew),
+            "placement": placement,
+            "balancer": balancer,
             "pg_info": pg_info,
             "slow_ops": slow,
             "slow_ops_detail": slow_detail,

@@ -788,6 +788,30 @@ class Monitor(Dispatcher):
                     "events": prog.get("events") or [],
                     "stalled": stalled,
                 }
+            # cephplace: data-distribution imbalance from the placement
+            # module's skew snapshot — raised only while the balancer is
+            # idle or off (an active balancer mid-convergence would just
+            # flap the check), cleared when deviations converge under
+            # mgr_placement_max_deviation
+            pl = digest.get("placement") or {}
+            imbalanced = pl.get("imbalanced") or []
+            if imbalanced and not pl.get("balancer_busy"):
+                names = [e.get("pool") for e in imbalanced]
+                thr = pl.get("max_deviation_threshold")
+                checks["PG_IMBALANCE"] = {
+                    "severity": "HEALTH_WARN",
+                    "message": f"{len(imbalanced)} pool(s) exceed the "
+                               f"placement deviation bound ({thr} PG "
+                               f"shards) with an idle balancer: "
+                               f"{', '.join(map(str, names))}",
+                    "pools": names,
+                    "detail": [
+                        f"pool {e.get('pool')!r}: max deviation "
+                        f"{e.get('max_deviation')} PG shards (stddev "
+                        f"{e.get('stddev')}, score {e.get('score')})"
+                        for e in imbalanced[:6]
+                    ],
+                }
             st = (digest.get("df") or {}).get("stats") or {}
             usage = {
                 "total_bytes": st.get("total_bytes", 0),

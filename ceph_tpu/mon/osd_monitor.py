@@ -195,6 +195,10 @@ class OSDMonitor:
             return self._cmd_perf_history(cmd)
         if prefix == "progress":
             return self._cmd_progress()
+        if prefix == "balancer status":
+            return self._cmd_balancer_status()
+        if prefix == "placement diff":
+            return self._cmd_placement_diff()
         if prefix == "osd erasure-code-profile set":
             return self._cmd_profile_set(cmd)
         if prefix == "osd erasure-code-profile get":
@@ -726,6 +730,45 @@ class OSDMonitor:
             "completed": prog.get("completed") or [],
             "stalled": prog.get("stalled") or [],
             "failing": prog.get("failing") or {},
+        }
+
+    def _cmd_balancer_status(self) -> tuple[int, object]:
+        """`ceph balancer status` (cephplace; reference: the balancer
+        module's `balancer status` output) — passes, move outcomes,
+        pre/post skew scores, last error — served mon-side from the
+        digest like perf history."""
+        ts_digest = getattr(self, "mgr_digest", None)
+        if ts_digest is None:
+            return -2, "no mgr digest yet (is the mgr running?)"
+        ts, digest = ts_digest
+        bal = digest.get("balancer")
+        if not isinstance(bal, dict):
+            return -2, ("digest carries no balancer data yet (is the "
+                        "balancer module hosted?)")
+        return 0, {
+            "digest_age_seconds": round(time.monotonic() - ts, 1),
+            **bal,
+        }
+
+    def _cmd_placement_diff(self) -> tuple[int, object]:
+        """`ceph placement diff` (cephplace) — the latest osdmap-epoch
+        remap forecast (PGs/shards remapped, predicted bytes-to-move,
+        misplaced fraction) plus the current skew snapshot, served
+        mon-side from the digest."""
+        ts_digest = getattr(self, "mgr_digest", None)
+        if ts_digest is None:
+            return -2, "no mgr digest yet (is the mgr running?)"
+        ts, digest = ts_digest
+        pl = digest.get("placement")
+        if not isinstance(pl, dict):
+            return -2, ("digest carries no placement data yet (is the "
+                        "placement module hosted?)")
+        return 0, {
+            "digest_age_seconds": round(time.monotonic() - ts, 1),
+            "cluster": pl.get("cluster"),
+            "pools": pl.get("pools") or [],
+            "imbalanced": pl.get("imbalanced") or [],
+            "diff": pl.get("diff"),
         }
 
     def _cmd_from_digest(self, prefix: str) -> tuple[int, object]:

@@ -15,7 +15,14 @@ from .osdmap import (
     ceph_stable_mod,
     pg_num_mask,
 )
-from .balancer import calc_pg_upmaps, pool_pg_counts
+from .balancer import calc_pg_upmaps
+from .placement import (
+    cluster_report,
+    diff_mappings,
+    pool_pg_counts,
+    pool_skew,
+    rule_osd_info,
+)
 
 __all__ = [
     "OSDMap",
@@ -24,6 +31,10 @@ __all__ = [
     "PG_POOL_REPLICATED",
     "calc_pg_upmaps",
     "ceph_stable_mod",
+    "cluster_report",
+    "diff_mappings",
     "pg_num_mask",
     "pool_pg_counts",
+    "pool_skew",
+    "rule_osd_info",
 ]

@@ -11,6 +11,10 @@ and the greedy loop then only does sparse host-side bookkeeping — upmap
 overrides never change the raw CRUSH output, so counts update incrementally
 without re-descending.
 
+The weight/target/count arithmetic lives in the shared scoring core
+(osd/placement.py — cephplace), so the balancer, `ceph osd df`, the mgr
+placement module, and osdmaptool all agree on what a deviation is.
+
 The reference's loop additionally retries candidate deviations in a few
 stochastic orders; this implementation is deterministic greedy (largest
 deviation first), which the tests exploit for stable golden behavior.
@@ -19,67 +23,13 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..crush.types import RuleOp
 from .osdmap import OSDMap
-
-
-def _rule_take_and_type(osdmap: OSDMap, rule_id: int) -> tuple[int, int]:
-    """Extract (take root, failure-domain type) from a simple rule chain."""
-    root, ftype = None, 0
-    for st in osdmap.crush.map.rules[rule_id].steps:
-        if st.op == RuleOp.TAKE:
-            root = st.arg1
-        elif st.op in (
-            RuleOp.CHOOSE_FIRSTN,
-            RuleOp.CHOOSE_INDEP,
-            RuleOp.CHOOSELEAF_FIRSTN,
-            RuleOp.CHOOSELEAF_INDEP,
-        ):
-            ftype = st.arg2
-    if root is None:
-        raise ValueError(f"rule {rule_id} has no TAKE step")
-    return root, ftype
-
-
-def rule_osd_info(
-    osdmap: OSDMap, rule_id: int
-) -> tuple[np.ndarray, dict[int, int]]:
-    """Per-OSD CRUSH weight and failure-domain id for one rule's subtree.
-
-    reference: OSDMap::get_rule_weight_osd_map (weights) plus the subtree
-    walk calc_pg_upmaps does to group candidates by failure domain."""
-    root, ftype = _rule_take_and_type(osdmap, rule_id)
-    weights = np.zeros(osdmap.max_osd, dtype=np.float64)
-    for osd, w in osdmap.crush.get_rule_weight_osd_map(rule_id).items():
-        if osd < osdmap.max_osd:
-            weights[osd] = w
-    domain: dict[int, int] = {}
-
-    def walk(bid: int, dom: int | None) -> None:
-        b = osdmap.crush.map.buckets[bid]
-        here = bid if b.type == ftype else dom
-        for it in b.items:
-            if it >= 0:
-                domain[it] = it if ftype == 0 else (here if here is not None else it)
-            else:
-                walk(it, here)
-
-    walk(root, None)
-    # an out (reweight 0) OSD takes no PGs — exclude from the target share
-    for o in range(osdmap.max_osd):
-        if osdmap.osd_weight[o] == 0 or not osdmap.is_up(o):
-            weights[o] = 0.0
-    return weights, domain
-
-
-def pool_pg_counts(osdmap: OSDMap, pools=None) -> np.ndarray:
-    """PG-shard count per OSD over the given pools (batched CRUSH path)."""
-    counts = np.zeros(osdmap.max_osd, dtype=np.int64)
-    for pid in pools if pools is not None else sorted(osdmap.pools):
-        up, _ = osdmap.map_pool(pid)
-        ids, c = np.unique(up[up >= 0], return_counts=True)
-        counts[ids] += c
-    return counts
+from .placement import (  # noqa: F401  (re-exported: historical import site)
+    ideal_targets,
+    pool_pg_counts,
+    rule_osd_info,
+    shard_counts,
+)
 
 
 def calc_pg_upmaps(
@@ -87,27 +37,33 @@ def calc_pg_upmaps(
     max_deviation: float = 1.0,
     max_iterations: int = 100,
     pools=None,
+    mappings: dict | None = None,
 ) -> list[tuple[int, int, int, int]]:
     """Greedy upmap balance; mutates osdmap.pg_upmap_items.
 
     Returns the applied changes as (pool, ps, from_osd, to_osd) tuples —
     the analog of the incremental OSDMap::calc_pg_upmaps fills for the mgr
     balancer to commit.  max_deviation is in PG shards, as in the reference
-    (osd_calc_pg_upmaps_max_deviation, default 1 → perfectly tight)."""
+    (osd_calc_pg_upmaps_max_deviation, default 1 → perfectly tight).
+    `mappings` accepts precomputed {pool_id: (up, primaries)} map_pool
+    results for the UNMUTATED map, so one batched sweep can feed both
+    the caller's pre-pass score and this loop (the greedy bookkeeping is
+    host-incremental — it never re-descends after its own changes, so a
+    pre-change mapping is exactly what it starts from anyway)."""
     changes: list[tuple[int, int, int, int]] = []
     for pid in pools if pools is not None else sorted(osdmap.pools):
         pool = osdmap.pools[pid]
         weights, domain = rule_osd_info(osdmap, pool.crush_rule)
-        total_w = weights.sum()
-        if total_w <= 0:
+        if weights.sum() <= 0:
             continue
-        up, _ = osdmap.map_pool(pid)
+        if mappings is not None and pid in mappings:
+            up = mappings[pid][0]
+        else:
+            up, _ = osdmap.map_pool(pid)
         rows = [list(r) for r in up]
-        counts = np.zeros(osdmap.max_osd, dtype=np.float64)
-        ids, c = np.unique(up[up >= 0], return_counts=True)
-        counts[ids] += c
+        counts = shard_counts(up, osdmap.max_osd).astype(np.float64)
         shards = sum(1 for r in rows for o in r if o >= 0)
-        target = weights / total_w * shards
+        target = ideal_targets(weights, shards)
         eligible = weights > 0
 
         for _ in range(max_iterations):

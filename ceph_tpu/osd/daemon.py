@@ -42,7 +42,12 @@ import time
 
 from ..common.failpoint import failpoint, registry as fp_registry
 from ..common.io_accounting import IOAccounting
-from ..common.kernel_telemetry import SENTINEL, TELEMETRY, SentinelPolicy
+from ..common.kernel_telemetry import (
+    DEVICE_PERF,
+    SENTINEL,
+    TELEMETRY,
+    SentinelPolicy,
+)
 from ..common.lockdep import make_lock
 from ..common.perf_counters import PerfCountersBuilder
 from ..common.recovery_accounting import RecoveryAccounting
@@ -326,6 +331,11 @@ class OSD(
         # same shared "kernel" subsystem (docs/observability.md)
         if cct.perf.get(TELEMETRY.perf.name) is None:
             cct.perf.add(TELEMETRY.perf)
+        # cephplace satellite: the sentinel's per-device probe rows ride
+        # the same pipeline as ceph_backend_device_*{device} labeled
+        # series (one row per jax device, verdict + probe latency)
+        if cct.perf.get(DEVICE_PERF.name) is None:
+            cct.perf.add(DEVICE_PERF)
         # coalescing encode layer in front of the GF codec (the batched
         # write path; osd/write_batcher.py, docs/write_path.md)
         self.write_batcher = WriteBatcher(cct, logger=self.logger,

@@ -29,19 +29,7 @@ import sys
 import time
 
 
-def _wait(pred, timeout: float, step: float = 0.2):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if pred():
-            return True
-        time.sleep(step)
-    return pred()
-
-
-def _scrape(url: str) -> str:
-    import urllib.request
-
-    return urllib.request.urlopen(url, timeout=10).read().decode()
+from .smoke_util import scrape as _scrape, wait_for as _wait
 
 
 def _labeled_value(body: str, metric: str, **labels) -> float:

@@ -29,13 +29,7 @@ import sys
 import time
 
 
-def _wait(pred, timeout: float, step: float = 0.2):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if pred():
-            return True
-        time.sleep(step)
-    return pred()
+from .smoke_util import wait_for as _wait
 
 
 def main() -> int:

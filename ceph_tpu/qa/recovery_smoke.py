@@ -34,19 +34,7 @@ WSIZE = 4096
 POOL = "healsmoke"
 
 
-def _wait(pred, timeout: float, step: float = 0.2):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if pred():
-            return True
-        time.sleep(step)
-    return pred()
-
-
-def _scrape(url: str) -> str:
-    import urllib.request
-
-    return urllib.request.urlopen(url, timeout=10).read().decode()
+from .smoke_util import scrape as _scrape, wait_for as _wait
 
 
 def _series(body: str, metric: str) -> dict[str, float]:

@@ -1,0 +1,34 @@
+"""Shared helpers for the qa smoke scripts (ci_gate steps): poll a
+predicate, scrape the prometheus exporter, read a gauge line.  One
+implementation — the smokes were each re-forking these verbatim, and a
+fix to e.g. the exposition-line parsing must not need four edits."""
+from __future__ import annotations
+
+import time
+
+
+def wait_for(pred, timeout: float, step: float = 0.2):
+    """Poll `pred` until truthy or the deadline passes; one final call
+    after the deadline so a slow-but-correct state still counts."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+def scrape(url: str) -> str:
+    """One prometheus exporter scrape, decoded."""
+    import urllib.request
+
+    return urllib.request.urlopen(url, timeout=10).read().decode()
+
+
+def gauge(body: str, metric: str) -> float | None:
+    """First sample of `metric` (bare or labeled) in an exposition
+    body, or None when the series is absent."""
+    for line in body.splitlines():
+        if line.startswith(metric + " ") or line.startswith(metric + "{"):
+            return float(line.rsplit(" ", 1)[1])
+    return None

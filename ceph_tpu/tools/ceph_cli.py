@@ -114,6 +114,7 @@ def _build_command(words: list[str]) -> dict:
         "osd stat",
         "osd tree", "osd pool ls", "osd erasure-code-profile ls",
         "df", "osd df", "pg dump", "progress",
+        "balancer status", "placement diff",
     ):
         if joined == fixed:
             return {"prefix": fixed}
@@ -310,17 +311,87 @@ def _render_df(res: dict, out) -> None:
 
 
 def _render_osd_df(res: dict, out) -> None:
+    # DEV column (cephplace): mapped shards minus the weight-
+    # proportional ideal, from the shared scoring core
     print(f"{'ID':>3} {'UP':>3} {'IN':>3} {'REWEIGHT':>8} {'SIZE':>10} "
-          f"{'USE':>10} {'AVAIL':>10} {'%USE':>6} {'PGS':>5}", file=out)
+          f"{'USE':>10} {'AVAIL':>10} {'%USE':>6} {'PGS':>5} "
+          f"{'TARGET':>7} {'DEV':>7}", file=out)
     for r in res.get("nodes", []):
         print(f"{r['id']:>3} {r['up']:>3} {r['in']:>3} "
               f"{r['reweight']:>8.4f} {_human(r['size']):>10} "
               f"{_human(r['use']):>10} {_human(r['avail']):>10} "
-              f"{100 * r['utilization']:>5.2f}% {r['pgs']:>5}", file=out)
+              f"{100 * r['utilization']:>5.2f}% {r['pgs']:>5} "
+              f"{r.get('target', 0.0):>7.2f} "
+              f"{r.get('deviation', 0.0):>+7.2f}", file=out)
     s = res.get("summary", {})
     print(f"TOTAL {_human(s.get('total_kb', 0) * 1024)} used "
           f"{_human(s.get('total_kb_used', 0) * 1024)}  avg util "
-          f"{100 * s.get('average_utilization', 0):.2f}%", file=out)
+          f"{100 * s.get('average_utilization', 0):.2f}%  "
+          f"max dev {s.get('max_deviation', 0.0):.2f} "
+          f"stddev {s.get('stddev', 0.0):.2f}", file=out)
+
+
+def _render_balancer_status(res: dict, out) -> None:
+    """`ceph balancer status`: pass outcomes + score trajectory."""
+    mode = "active" if res.get("active") else "dry-run/off"
+    print(f"balancer: {mode}, {res.get('passes', 0)} passes "
+          f"(digest age {res.get('digest_age_seconds', '?')}s)", file=out)
+    print(f"  moves: {res.get('moves_proposed', 0)} proposed, "
+          f"{res.get('moves_committed', 0)} committed, "
+          f"{res.get('balancer_errors', 0)} errors", file=out)
+    lp = res.get("last_pass")
+    if lp:
+        b, a = lp.get("score_before") or {}, lp.get("score_after") or {}
+        print(f"  last pass ({res.get('last_pass_age_seconds', '?')}s "
+              f"ago): {lp.get('proposed', 0)} proposed, "
+              f"{lp.get('committed', 0)} committed, "
+              f"{lp.get('failed', 0)} failed", file=out)
+        print(f"    score {b.get('score', '?')} -> {a.get('score', '?')}"
+              f"  (max deviation {b.get('max_deviation', '?')} -> "
+              f"{a.get('max_deviation', '?')} PG shards)", file=out)
+    ls = res.get("last_skip")
+    if ls:
+        print(f"  last skip ({res.get('last_skip_age_seconds', '?')}s "
+              f"ago): {ls.get('reason', '?')}", file=out)
+    if res.get("last_error"):
+        print(f"  last error: {res['last_error']}", file=out)
+    traj = res.get("score_trajectory") or []
+    if traj:
+        parts = " ".join(f"{t['before']:.3f}->{t['after']:.3f}"
+                         for t in traj[-6:])
+        print(f"  trajectory: {parts}", file=out)
+
+
+def _render_placement_diff(res: dict, out) -> None:
+    """`ceph placement diff`: skew snapshot + latest remap forecast."""
+    cl = res.get("cluster") or {}
+    print(f"placement @ epoch {cl.get('epoch', '?')}: score "
+          f"{cl.get('score', '?')}, max deviation "
+          f"{cl.get('max_deviation', '?')} PG shards "
+          f"(digest age {res.get('digest_age_seconds', '?')}s)", file=out)
+    for p in res.get("pools") or []:
+        print(f"  pool {p.get('pool')!r}: {p.get('shards')} shards, "
+              f"max dev {p.get('max_deviation')}, stddev "
+              f"{p.get('stddev')}, score {p.get('score')}", file=out)
+    for e in res.get("imbalanced") or []:
+        print(f"  IMBALANCED: pool {e.get('pool')!r} max dev "
+              f"{e.get('max_deviation')}", file=out)
+    d = res.get("diff")
+    if not d:
+        print("  no epoch diff yet (map unchanged since the first scan)",
+              file=out)
+        return
+    print(f"  diff epoch {d.get('from_epoch')} -> {d.get('to_epoch')}"
+          f" ({d.get('age_seconds', '?')}s ago): "
+          f"{d.get('pgs_remapped')} pgs / {d.get('shards_remapped')} "
+          f"shards remapped "
+          f"({100 * (d.get('misplaced_fraction') or 0):.2f}% misplaced, "
+          f"~{_human(d.get('predicted_bytes', 0))} to move)", file=out)
+    for pid, p in sorted((d.get("pools") or {}).items(),
+                         key=lambda kv: int(kv[0])):
+        print(f"    pool {p.get('name')!r}: {p.get('pgs_remapped')} pgs"
+              f" / {p.get('shards_remapped')} shards"
+              + (" (resized)" if p.get("resized") else ""), file=out)
 
 
 def _render_pg_dump(res: dict, out) -> None:
@@ -473,6 +544,10 @@ def main(argv=None, out=sys.stdout) -> int:
         _render_perf_history(res, out)
     elif cmd["prefix"] == "progress":
         _render_progress(res, out)
+    elif cmd["prefix"] == "balancer status":
+        _render_balancer_status(res, out)
+    elif cmd["prefix"] == "placement diff":
+        _render_placement_diff(res, out)
     else:
         print(json.dumps(res, indent=2, default=str), file=out)
     return 0

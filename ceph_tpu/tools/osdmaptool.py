@@ -16,8 +16,6 @@ import argparse
 import json
 import sys
 
-import numpy as np
-
 from ..crush import CrushWrapper, build_hierarchical_map
 from ..osd import OSDMap, calc_pg_upmaps
 from ..osd.osdmap import PG_POOL_ERASURE
@@ -47,30 +45,35 @@ def create_simple(num_osd: int, pg_num: int = 128) -> OSDMap:
 
 def test_map_pgs(m: OSDMap, pool_ids, out=sys.stdout) -> None:
     """--test-map-pgs analog; per-pool then per-OSD count table plus the
-    min/max/avg summary the reference prints."""
-    counts = np.zeros(m.max_osd, dtype=np.int64)
-    primaries = np.zeros(m.max_osd, dtype=np.int64)
+    min/max/avg summary the reference prints.  Counts, targets, and the
+    deviation/skew columns come from the shared scoring core
+    (osd/placement.py — the same numbers `ceph osd df` and the mgr
+    placement module render, so the three surfaces can't drift)."""
+    from ..osd.placement import cluster_report
+
+    rep = cluster_report(m, pools=pool_ids)
     for pid in pool_ids:
-        pool = m.pools[pid]
-        up, prim = m.map_pool(pid)
-        print(f"pool {pid} pg_num {pool.pg_num}", file=out)
-        ids, c = np.unique(up[up >= 0], return_counts=True)
-        counts[ids] += c
-        ids, c = np.unique(prim[prim >= 0], return_counts=True)
-        primaries[ids] += c
-    print("#osd\tcount\tprimary", file=out)
+        print(f"pool {pid} pg_num {m.pools[pid].pg_num}", file=out)
+    counts = rep["osd_counts"]
+    primaries = rep["osd_primaries"]
+    targets = rep["osd_targets"]
+    print("#osd\tcount\tprimary\ttarget\tdeviation", file=out)
     for o in range(m.max_osd):
-        print(f"osd.{o}\t{counts[o]}\t{primaries[o]}", file=out)
+        print(f"osd.{o}\t{counts[o]}\t{primaries[o]}"
+              f"\t{targets[o]:.2f}\t{counts[o] - targets[o]:+.2f}",
+              file=out)
     up_osds = [o for o in range(m.max_osd) if m.is_up(o)]
     act = counts[up_osds]
     avg = act.mean() if len(act) else 0.0
     print(f" in {len(up_osds)}", file=out)
     print(
-        f" avg {avg:.2f} stddev {act.std():.2f} "
+        f" avg {avg:.2f} stddev {rep['stddev']:.2f} "
         f"min osd.{up_osds[int(act.argmin())]} {act.min()} "
         f"max osd.{up_osds[int(act.argmax())]} {act.max()}",
         file=out,
     )
+    print(f" max deviation {rep['max_deviation']:.2f} "
+          f"score {rep['score']:.4f}", file=out)
     size_sum = sum(m.pools[p].pg_num * m.pools[p].size for p in pool_ids)
     print(f" size {size_sum}", file=out)
 
@@ -78,9 +81,18 @@ def test_map_pgs(m: OSDMap, pool_ids, out=sys.stdout) -> None:
 def do_upmap(
     m: OSDMap, pool_ids, max_dev: float, max_iter: int, out=sys.stdout
 ) -> int:
-    """--upmap analog: emit `ceph osd pg-upmap-items` commands."""
+    """--upmap analog: emit `ceph osd pg-upmap-items` commands, with the
+    scoring core's before/after skew as trailing comment lines (the
+    `balancer eval` pair, offline)."""
+    from ..osd.placement import cluster_report
+
+    # one batched sweep feeds both the pre score and the greedy loop
+    # (the balancer module's two-sweeps-per-pass rule)
+    mappings = {pid: m.map_pool(pid) for pid in pool_ids}
+    pre = cluster_report(m, pools=pool_ids, mappings=mappings)
     changes = calc_pg_upmaps(
-        m, max_deviation=max_dev, max_iterations=max_iter, pools=pool_ids
+        m, max_deviation=max_dev, max_iterations=max_iter, pools=pool_ids,
+        mappings=mappings,
     )
     by_pg: dict[tuple[int, int], list[int]] = {}
     for pid, ps, frm, to in changes:
@@ -92,6 +104,10 @@ def do_upmap(
             + " ".join(str(p) for p in pairs),
             file=out,
         )
+    post = cluster_report(m, pools=pool_ids) if changes else pre
+    print(f"# score {pre['score']:.4f} -> {post['score']:.4f} "
+          f"(max deviation {pre['max_deviation']:.2f} -> "
+          f"{post['max_deviation']:.2f} PG shards)", file=out)
     return len(changes)
 
 

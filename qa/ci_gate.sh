@@ -52,6 +52,13 @@
 #    control flushes are sync points while pooled flushes are async
 #    with their commit sync on the encode_wait record, and parity
 #    buffers recycle through the pool.
+# 11. placement smoke (ceph_tpu/qa/placement_smoke.py): mark an OSD out
+#    under a small live cluster — the placement module's remap forecast
+#    (batched-CRUSH epoch diff, `placement diff`) must match the
+#    observed acting-set churn within 10%, ceph_placement_*/ceph_remap_*
+#    /ceph_balancer_* series must render on the exporter, a balancer
+#    run against a stacked imbalance must commit moves and improve the
+#    exported score, and PG_IMBALANCE must raise then clear.
 #
 # Analyzers emit SARIF 2.1.0 into qa/_sarif/ (github code-scanning uploads
 # resolve URIs against the repo root, which is where this script runs
@@ -280,5 +287,26 @@ else
     rc=1
 fi
 
-echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json, accounting_smoke.json, qos_smoke.json, recovery_smoke.json, device_pool_smoke.json)"
+echo "== placement smoke (remap forecast + balancer scoring) =="
+# forecast-vs-observed churn on an osd-out, balancer score improvement
+# against a stacked imbalance, PG_IMBALANCE raise/clear, and the
+# ceph_placement_*/ceph_remap_*/ceph_balancer_* series on the exporter
+# (ceph_tpu/qa/placement_smoke.py; docs/observability.md)
+JAX_PLATFORMS=cpu python -m ceph_tpu.qa.placement_smoke \
+    > "$OUT_DIR/placement_smoke.json"
+place_rc=$?
+if [ $place_rc -eq 0 ]; then
+    echo "placement smoke: ok"
+elif python -c "import json; json.load(open('$OUT_DIR/placement_smoke.json'))" \
+        2>/dev/null; then
+    echo "placement smoke: FAILED:"
+    python -c "import json; [print(' -', p) for p in json.load(open('$OUT_DIR/placement_smoke.json'))['problems']]" || true
+    rc=1
+else
+    rm -f "$OUT_DIR/placement_smoke.json"
+    echo "placement smoke: ERROR (exit $place_rc) — scenario crashed"
+    rc=1
+fi
+
+echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json, accounting_smoke.json, qos_smoke.json, recovery_smoke.json, device_pool_smoke.json, placement_smoke.json)"
 exit $rc

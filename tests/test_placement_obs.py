@@ -1,0 +1,485 @@
+"""cephplace tests — placement-plane observability (ISSUE 15).
+
+Scoring-core units (ideal shares, skew on weighted/zero-weight OSDs),
+epoch-diff forecast vs ground-truth remap on a map mutation, balancer
+score-improves + status/series assertions, and PG_IMBALANCE
+raise-and-clear on a LocalCluster.  Kept in the fast (~10 s) class per
+the tier-1 budget rule: one shared module-scoped cluster, ticks driven
+directly instead of waiting on timers.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import CrushWrapper, build_hierarchical_map
+from ceph_tpu.osd import OSDMap, calc_pg_upmaps
+from ceph_tpu.osd.osdmap import PG_POOL_ERASURE
+from ceph_tpu.osd.placement import (
+    cluster_report,
+    diff_mappings,
+    ideal_targets,
+    osd_rows,
+    pool_skew,
+    shard_counts,
+    skew_metrics,
+)
+
+
+def _simple_map(n: int = 8, pg_num: int = 32, size: int = 3) -> OSDMap:
+    m = OSDMap(CrushWrapper(build_hierarchical_map(n, 1)))
+    m.create_pool(1, pg_num=pg_num, size=size, crush_rule=0, name="p1")
+    return m
+
+
+def _wait(pred, timeout: float, step: float = 0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+class TestScoringCore:
+    def test_ideal_targets_weight_proportional(self):
+        t = ideal_targets(np.array([1.0, 1.0, 2.0, 0.0]), 8)
+        assert t == pytest.approx([2.0, 2.0, 4.0, 0.0])
+
+    def test_ideal_targets_zero_total(self):
+        assert ideal_targets(np.zeros(4), 8).tolist() == [0.0] * 4
+
+    def test_skew_metrics_perfect_balance(self):
+        c = np.array([4, 4, 4, 4])
+        t = np.full(4, 4.0)
+        met = skew_metrics(c, t, np.ones(4, bool))
+        assert met["max_deviation"] == 0.0
+        assert met["stddev"] == 0.0
+        assert met["score"] == 0.0
+
+    def test_skew_metrics_known_imbalance(self):
+        c = np.array([8, 0, 4, 4])
+        t = np.full(4, 4.0)
+        met = skew_metrics(c, t, np.ones(4, bool))
+        assert met["max_deviation"] == 4.0
+        assert met["stddev"] == pytest.approx(np.sqrt(8.0))
+        assert met["score"] == pytest.approx(np.sqrt(8.0) / 4.0)
+
+    def test_skew_metrics_no_eligible_osds(self):
+        met = skew_metrics(np.zeros(4), np.zeros(4), np.zeros(4, bool))
+        assert met == {"max_deviation": 0.0, "stddev": 0.0, "score": 0.0}
+
+    def test_pool_skew_counts_match_scalar_path(self):
+        """The batched counts must agree with the scalar ground-truth
+        mapping PG by PG (the test_osdmap contract, via the core)."""
+        m = _simple_map()
+        sk = pool_skew(m, 1)
+        counts = np.zeros(m.max_osd, dtype=np.int64)
+        for ps in range(m.pools[1].pg_num):
+            up, _upp, _a, _p = m.pg_to_up_acting_osds(1, ps)
+            for o in up:
+                if o >= 0:
+                    counts[o] += 1
+        assert (sk["counts"] == counts).all()
+        assert sk["shards"] == int(counts.sum())
+        assert sk["target"].sum() == pytest.approx(sk["shards"])
+
+    def test_zero_weight_osd_excluded_from_target(self):
+        m = _simple_map()
+        m.mark_out(7)
+        sk = pool_skew(m, 1)
+        assert not sk["eligible"][7]
+        assert sk["target"][7] == 0.0
+        # the out OSD's share redistributes; eligible targets still sum
+        # to the placed shards
+        assert sk["target"].sum() == pytest.approx(sk["shards"])
+
+    def test_cluster_report_aggregates_pools(self):
+        m = _simple_map()
+        m.create_pool(2, pg_num=16, size=4, crush_rule=1,
+                      type=PG_POOL_ERASURE, name="ec")
+        rep = cluster_report(m)
+        assert set(rep["pools"]) == {1, 2}
+        expect = sum(sk["counts"] for sk in rep["pools"].values())
+        assert (rep["osd_counts"] == expect).all()
+        # one primary per PG that has any live member
+        assert rep["osd_primaries"].sum() == 32 + 16
+
+    def test_osd_rows_json_safe(self):
+        import json
+
+        m = _simple_map()
+        rows = osd_rows(cluster_report(m), m)
+        assert len(rows) == m.max_osd
+        json.dumps(rows)  # no numpy scalars may leak into the digest
+        assert all(r["shards"] >= 0 and "deviation" in r for r in rows)
+
+    def test_shard_counts_ignores_holes_and_oob(self):
+        up = np.array([[0, -1, 2], [0, 99, 1]])
+        c = shard_counts(up, 4)
+        assert c.tolist() == [2, 1, 1, 0]
+
+
+class TestRemapForecast:
+    def test_diff_matches_ground_truth_on_mark_out(self):
+        """The vectorized diff must equal a per-PG set comparison of the
+        scalar mapping path (replicated: membership, not position)."""
+        m = _simple_map(n=8, pg_num=32, size=3)
+        before = {1: m.map_pool(1)[0]}
+        m.mark_out(5)
+        after = {1: m.map_pool(1)[0]}
+        d = diff_mappings(m, before, after)
+        pgs = shards = 0
+        for ps in range(32):
+            a = {int(o) for o in before[1][ps] if o >= 0}
+            b = {int(o) for o in after[1][ps] if o >= 0}
+            new = b - a
+            if new:
+                pgs += 1
+                shards += len(new)
+        assert d["pgs_remapped"] == pgs
+        assert d["shards_remapped"] == shards
+        assert pgs > 0  # marking an OSD out must remap something
+        assert 0 < d["misplaced_fraction"] < 1
+        assert d["total_shards"] == int((after[1] >= 0).sum())
+
+    def test_diff_ec_positional(self):
+        """EC shard identity is positional: the same OSD set in a
+        different order counts as remapped."""
+        m = _simple_map(n=8, pg_num=16, size=3)
+        m.create_pool(2, pg_num=16, size=4, crush_rule=1,
+                      type=PG_POOL_ERASURE, name="ec")
+        before = {2: m.map_pool(2)[0]}
+        m.mark_out(2)
+        after = {2: m.map_pool(2)[0]}
+        d = diff_mappings(m, before, after)
+        gt = int(((before[2] != after[2]) & (after[2] >= 0)).sum())
+        assert d["shards_remapped"] == gt
+
+    def test_diff_identical_maps_is_zero(self):
+        m = _simple_map()
+        up = m.map_pool(1)[0]
+        d = diff_mappings(m, {1: up}, {1: up.copy()})
+        assert d["pgs_remapped"] == 0
+        assert d["shards_remapped"] == 0
+        assert d["misplaced_fraction"] == 0.0
+        assert d["pools"] == {}
+
+    def test_diff_pool_add_remove(self):
+        m = _simple_map()
+        up = m.map_pool(1)[0]
+        d = diff_mappings(m, {1: up}, {1: up, 7: up})
+        assert d["pools_added"] == [7]
+        d = diff_mappings(m, {1: up, 7: up}, {1: up})
+        assert d["pools_removed"] == [7]
+
+    def test_predicted_bytes_from_shard_weights(self):
+        m = _simple_map()
+        before = {1: m.map_pool(1)[0]}
+        m.mark_out(0)
+        after = {1: m.map_pool(1)[0]}
+        d = diff_mappings(m, before, after, shard_bytes={1: 100.0})
+        assert d["predicted_bytes"] == d["shards_remapped"] * 100
+
+
+class TestCompiledCrushCache:
+    def test_shared_across_decodes_and_deepcopy(self):
+        """The per-epoch placement scan depends on this: a fresh decode
+        of byte-identical crush content (what the mgr sees every epoch)
+        and the balancer's scratch deepcopy must RESOLVE the existing
+        CompiledCrushMap from the content-digest cache, never rebuild —
+        a rebuild re-traces every jitted rule fn (~seconds of host
+        time per epoch, measured)."""
+        import copy
+
+        m1 = _simple_map(n=6, pg_num=8)
+        c1 = m1.crush.compiled()
+        m2 = OSDMap.from_json(m1.to_json())
+        assert m2.crush.compiled() is c1
+        assert copy.deepcopy(m1).crush.compiled() is c1
+        # content mutation must miss (and not poison the original)
+        m3 = OSDMap.from_json(m1.to_json())
+        m3.crush.reweight_item("osd.0", 0.0)
+        assert m3.crush.compiled() is not c1
+        assert m1.crush.compiled() is c1
+
+
+class TestBalancerScore:
+    def test_balancer_pass_improves_core_score(self):
+        """calc_pg_upmaps must not worsen the shared scoring core's
+        numbers — the pre/post pair the module exports."""
+        m = _simple_map(n=16, pg_num=64, size=3)
+        pre = cluster_report(m)
+        changes = calc_pg_upmaps(m)
+        post = cluster_report(m)
+        assert changes, "expected moves on a 16-osd CRUSH spread"
+        assert post["max_deviation"] <= pre["max_deviation"]
+        assert post["score"] <= pre["score"] + 1e-9
+
+    def test_balancer_refuses_degraded_cluster(self):
+        """Upstream parity: a pass against a cluster with degraded
+        objects must SKIP (no proposals, no commits, pass counter
+        still) and surface the skip in `balancer status` — an upmap
+        commit mid-recovery would retarget recovering PGs."""
+        from types import SimpleNamespace
+
+        from ceph_tpu.common.context import CephContext
+        from ceph_tpu.mgr.balancer_module import BalancerModule
+
+        cct = CephContext("mgr.test",
+                          overrides={"mgr_balancer_active": True})
+        m = _simple_map(n=16, pg_num=64, size=3)
+        committed = []
+        mgr = SimpleNamespace(
+            cct=cct,
+            mc=SimpleNamespace(osdmap=m,
+                               command=lambda cmd:
+                                   committed.append(cmd) or (0, {})),
+            _modules={},
+            pg_degraded_by_pgid=lambda: {"1.0": 3},
+            ingest_local_report=lambda d, c, schema=None: None,
+        )
+        bal = BalancerModule(mgr)
+        assert bal.optimize_once() == []
+        assert not committed
+        st = bal.status()
+        assert st["passes"] == 0
+        assert "degraded" in (st["last_skip"] or {}).get("reason", "")
+        # clean stats -> the pass runs again
+        mgr.pg_degraded_by_pgid = lambda: {"1.0": 0}
+        assert bal.optimize_once(), "clean cluster must balance"
+
+    def test_balancer_module_counts_failed_commits(self):
+        """A refused `osd pg-upmap-items` must COUNT (balancer_errors +
+        last_error), not vanish into a dout line (satellite 2)."""
+        from types import SimpleNamespace
+
+        from ceph_tpu.common.context import CephContext
+        from ceph_tpu.mgr.balancer_module import BalancerModule
+
+        cct = CephContext("mgr.test",
+                          overrides={"mgr_balancer_active": True})
+        m = _simple_map(n=16, pg_num=64, size=3)
+        reports = []
+        mgr = SimpleNamespace(
+            cct=cct,
+            mc=SimpleNamespace(osdmap=m,
+                               command=lambda cmd: (-22, "refused")),
+            _modules={},
+            ingest_local_report=lambda d, c, schema=None:
+                reports.append((d, c)),
+        )
+        bal = BalancerModule(mgr)
+        changes = bal.optimize_once()
+        assert changes, "need proposals to exercise the commit path"
+        st = bal.status()
+        assert st["passes"] == 1
+        assert st["balancer_errors"] > 0
+        assert st["moves_committed"] == 0
+        assert "refused" in st["last_error"]
+        lp = st["last_pass"]
+        assert lp["failed"] > 0 and lp["committed"] == 0
+        # nothing landed: score_after must describe the LIVE map, not
+        # the scratch proposal — a mon refusing every move must not
+        # export a converging score
+        assert lp["score_after"] == lp["score_before"]
+        # the export rode the report sink with the error count
+        assert reports
+        counters = reports[-1][1]["balancer"]
+        assert counters["balancer_errors"] == st["balancer_errors"]
+
+
+@pytest.fixture(scope="module")
+def obs_cluster():
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    with LocalCluster(
+        n_mons=1, n_osds=4, with_mgr=True,
+        conf_overrides={
+            "mgr_report_interval": 0.2,
+            "mgr_digest_interval": 0.2,
+            # scans driven by hand below — no timer races
+            "mgr_placement_interval": 3600.0,
+            "mgr_balancer_interval": 3600.0,
+            "mgr_balancer_active": False,
+        },
+    ) as c:
+        c.create_replicated_pool("plc", size=2, pg_num=16)
+        io = c.client().open_ioctx("plc")
+        for i in range(4):
+            io.write_full(f"o{i}", b"x" * 4096)
+        assert _wait(lambda: c.mgr.mc.osdmap is not None
+                     and c.mgr.mc.osdmap.pools, 15.0)
+        yield c
+
+
+@pytest.mark.cluster
+class TestClusterObservability:
+    def _scrape(self, c) -> str:
+        import urllib.request
+
+        url = c.mgr.module("prometheus").url
+        return urllib.request.urlopen(url, timeout=10).read().decode()
+
+    def test_placement_series_and_commands(self, obs_cluster):
+        c = obs_cluster
+        from ceph_tpu.common.kernel_telemetry import TELEMETRY
+
+        calls0 = (TELEMETRY.dump().get("crush_do_rule_batch") or
+                  {}).get("calls", 0)
+        pm = c.mgr.module("placement")
+        rep = pm.scan()
+        assert rep is not None and rep["score"] >= 0.0
+        # the scan ran through the batched device mapper, not a per-PG
+        # host loop (the acceptance criterion)
+        calls1 = TELEMETRY.dump()["crush_do_rule_batch"]["calls"]
+        assert calls1 > calls0
+        # ceph_balancer_* appears with the balancer serve-thread's boot
+        # export (async vs module start) — wait for the full set
+        wanted = ("ceph_placement_pool_score",
+                  "ceph_placement_pool_max_deviation",
+                  "ceph_placement_osd_shards",
+                  "ceph_placement_osd_deviation",
+                  "ceph_remap_epochs_diffed",
+                  "ceph_balancer_passes")
+        assert _wait(lambda: all(m in self._scrape(c) for m in wanted),
+                     10.0), f"metrics missing from exposition: {wanted}"
+        body = self._scrape(c)
+        assert 'pool="plc"' in body
+        assert 'osd="osd.0"' in body
+        # mon commands answer from the digest
+        assert _wait(lambda: c.mon_command(
+            {"prefix": "balancer status"})[0] == 0, 10.0)
+        rv, bs = c.mon_command({"prefix": "balancer status"})
+        assert rv == 0 and bs["passes"] >= 0 and "active" in bs
+
+        def pools_visible():
+            rv2, pd = c.mon_command({"prefix": "placement diff"})
+            return rv2 == 0 and any(
+                p["pool"] == "plc" for p in pd["pools"])
+        # the digest carrying the post-scan snapshot lands on the next
+        # mgr_digest_interval push
+        assert _wait(pools_visible, 10.0)
+
+    def test_remap_forecast_on_mark_out(self, obs_cluster):
+        c = obs_cluster
+        pm = c.mgr.module("placement")
+        pm.scan()  # prime the previous-epoch mapping cache
+        rv, _ = c.mon_command({"prefix": "osd out", "id": 3})
+        assert rv == 0
+        assert _wait(lambda: not c.mgr.mc.osdmap.is_in(3), 10.0)
+        pm.scan()
+        snap = pm.snapshot()
+        diff = snap["diff"]
+        assert diff is not None and diff["pgs_remapped"] > 0
+        assert 0 < diff["misplaced_fraction"] <= 1
+        # the forecast serves over the mon command path + the exporter
+        def diff_visible():
+            rv2, pd = c.mon_command({"prefix": "placement diff"})
+            return rv2 == 0 and (pd.get("diff") or {}).get(
+                "pgs_remapped", 0) > 0
+        assert _wait(diff_visible, 10.0)
+        body = self._scrape(c)
+        remapped = [line for line in body.splitlines()
+                    if line.startswith("ceph_remap_last_pgs_remapped")]
+        assert remapped and float(remapped[0].split()[-1]) > 0
+        # restore for the next test
+        c.mon_command({"prefix": "osd in", "id": 3})
+        assert _wait(lambda: c.mgr.mc.osdmap.is_in(3), 10.0)
+        pm.scan()
+
+    def test_osd_df_renders_deviation_columns(self, obs_cluster):
+        c = obs_cluster
+
+        def odf_ready():
+            rv, odf = c.mon_command({"prefix": "osd df"})
+            return rv == 0 and odf.get("nodes") and \
+                all("deviation" in r for r in odf["nodes"])
+        assert _wait(odf_ready, 10.0)
+        rv, odf = c.mon_command({"prefix": "osd df"})
+        nodes = odf["nodes"]
+        # scoring-core columns: counts vs weight-proportional target
+        assert sum(r["pgs_mapped"] for r in nodes) > 0
+        assert any(r["target"] > 0 for r in nodes)
+        for r in nodes:
+            assert r["deviation"] == pytest.approx(
+                r["pgs_mapped"] - r["target"], abs=0.02)
+        assert "max_deviation" in odf["summary"]
+        assert "stddev" in odf["summary"]
+
+    def test_pg_imbalance_raises_and_clears(self, obs_cluster):
+        c = obs_cluster
+        pm = c.mgr.module("placement")
+        rep = pm.scan()
+        d0 = rep["max_deviation"]
+
+        def checks() -> dict:
+            rv, st = c.mon_command({"prefix": "status"})
+            assert rv == 0
+            return (st.get("health") or {}).get("checks") or {}
+
+        # threshold above the current skew: no check
+        c.mgr.cct.conf.set("mgr_placement_max_deviation", d0 + 5.0)
+        pm.scan()
+        assert _wait(lambda: "PG_IMBALANCE" not in checks(), 10.0)
+        # threshold below the current skew, balancer off: check raises
+        c.mgr.cct.conf.set("mgr_placement_max_deviation",
+                           max(0.1, d0 - 0.5))
+        assert _wait(lambda: "PG_IMBALANCE" in checks(), 10.0)
+        chk = checks()["PG_IMBALANCE"]
+        assert "plc" in chk["pools"]
+        assert chk["detail"]
+        # balancer un-blinding: an active pass improves the exported
+        # score and the deviation converges under a bound the balancer
+        # can reach — the check clears
+        c.mgr.cct.conf.set("mgr_balancer_active", True)
+        bal = c.mgr.module("balancer")
+        bal.optimize_once()
+        st = bal.status()
+        lp = st["last_pass"]
+        assert lp["score_after"]["score"] <= lp["score_before"]["score"]
+        assert st["balancer_errors"] == 0, st["last_error"]
+        assert _wait(lambda: c.mgr.mc.osdmap.pg_upmap_items
+                     or not bal.last_result, 10.0)
+        pm.scan()
+        d1 = pm.scan()["max_deviation"]
+        assert d1 <= d0
+        c.mgr.cct.conf.set("mgr_placement_max_deviation", d1 + 0.5)
+        pm.scan()
+        assert _wait(lambda: "PG_IMBALANCE" not in checks(), 10.0)
+        c.mgr.cct.conf.set("mgr_balancer_active", False)
+
+    def test_dump_kernel_telemetry_lists_devices(self, obs_cluster):
+        from ceph_tpu.common.kernel_telemetry import (
+            SENTINEL, dump_kernel_telemetry, probe_device_rows)
+
+        rows = probe_device_rows()
+        assert rows and all("device" in r and "ok" in r for r in rows)
+        # the virtual 8-device CPU mesh (conftest) shows per-device rows
+        assert all(r["ok"] for r in rows)
+        assert all(r["latency_ms"] >= 0.0 for r in rows)
+        SENTINEL.probe_once()
+        dump = dump_kernel_telemetry()
+        assert dump["devices"], "sentinel probe left no device rows"
+        assert {r["device"] for r in dump["devices"]} == \
+            {r["device"] for r in rows}
+        # after a probe, the per-device rows render as labeled series
+        # (the next OSD perf report carries them — wait one interval)
+        assert _wait(lambda: "ceph_backend_device_ok"
+                     in self._scrape(obs_cluster), 10.0)
+        body = self._scrape(obs_cluster)
+        assert "ceph_backend_device_probe_ms" in body
+        assert 'device="' in body
+
+    def test_forced_degraded_marks_devices(self, monkeypatch):
+        import ceph_tpu.common.kernel_telemetry as kt
+
+        monkeypatch.setenv("CEPH_TPU_SENTINEL_STATE", "degraded:test")
+        rows = kt.probe_device_rows()
+        assert rows == [{"device": "forced:0", "platform": "forced",
+                         "ok": False, "latency_ms": 0.0, "error": "test"}]
+        monkeypatch.setenv("CEPH_TPU_SENTINEL_STATE", "ok")
+        rows = kt.probe_device_rows()
+        assert rows[0]["ok"] is True
