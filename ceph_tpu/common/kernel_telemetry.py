@@ -380,9 +380,12 @@ def default_probe() -> str:
             raise RuntimeError(
                 reason or "forced degraded (CEPH_TPU_SENTINEL_STATE)")
         return "forced-ok"
-    import jax
+    # platform resolves through the policy seam (cephtopo); the policy's
+    # own device-list probe is the ambient touch that a wedged runtime
+    # hangs on — which is exactly what this disposable worker is for
+    from .device_policy import get_device_policy
 
-    return jax.devices()[0].platform
+    return get_device_policy().platform()
 
 
 def _forced_device_rows(ok: bool, reason: str | None) -> list[dict]:
@@ -410,7 +413,10 @@ def probe_device_rows() -> list[dict]:
     import numpy as _np
 
     rows = []
-    for d in jax.devices():
+    # RAW topology on purpose: these per-device rows are the INPUT the
+    # DevicePolicy's healthy_devices() shrink consumes — probing through
+    # the policy would hide exactly the sick chips it must report
+    for d in jax.devices():  # noqa: CL9 — sentinel's own disposable-worker probe feeds the policy
         t0 = time.perf_counter()
         try:
             jax.device_put(_np.zeros(8, _np.uint8), d).block_until_ready()

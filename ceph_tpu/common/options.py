@@ -456,6 +456,25 @@ def default_options() -> OptionTable:
                    "(max(15s, 5x) until the runtime first answers) so "
                    "jax init cannot latch a false degrade.  Read once "
                    "at daemon start, like the interval", min=0.1),
+            Option("device_topology", str, "auto",
+                   "cephtopo: device-topology policy variant for this "
+                   "process (common/device_policy.py): single = default "
+                   "chip only; mesh = multi-chip mesh over the healthy "
+                   "devices; cpu = CPU-fallback 1-device mesh (dispatch "
+                   "treats the backend as cpu — no pallas, no donation, "
+                   "no limb engine); auto = mesh when more than one "
+                   "healthy device is visible, else single.  Sentinel "
+                   "per-device probe failures (ceph_backend_device_*) "
+                   "shrink the granted mesh and the pool budget instead "
+                   "of wedging.  Read ONCE at daemon start into the "
+                   "process-wide injected policy (first daemon wins, "
+                   "like the sentinel) — restart to change",
+                   enum=("auto", "single", "mesh", "cpu")),
+            Option("device_mesh_shape", int, 0,
+                   "cephtopo: cap on the mesh axis length (device "
+                   "count) the device policy grants; 0 = every healthy "
+                   "device.  Read once at daemon start with "
+                   "device_topology", min=0),
             Option("ec_kernel", str, "auto",
                    "encode kernel selection for the default (jax) EC "
                    "plugin: oracle/numpy swap the backend, xla/pallas "

@@ -76,11 +76,14 @@ def ln_planes_pallas(cm, x, items, r):
         ii = jnp.pad(ii, ((0, Bp - B), (0, 0)))
     if Sp != S:
         ii = jnp.pad(ii, ((0, 0), (0, Sp - S)))
-    # interpret mode keeps this path testable on CPU hosts
+    # interpret mode keeps this path testable on CPU hosts; the backend
+    # name comes from the policy seam (cephtopo)
+    from ..common.device_policy import get_device_policy
+
     hi, lo = straw2_scores_pallas(
         xi, ri, ii, tile=tile,
         loop_slabs=pallas_crush.LOOP_SLABS,
-        interpret=jax.default_backend() == "cpu",
+        interpret=get_device_policy().backend() == "cpu",
     )
     return hi[:B, :S], lo[:B, :S]
 

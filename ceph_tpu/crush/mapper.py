@@ -393,7 +393,7 @@ def _build_rule_fn(cm: CompiledCrushMap, rule_id: int, numrep: int,
     return jax.jit(fn), max_width
 
 
-def default_engine_config() -> tuple[str, object, bool]:
+def default_engine_config(policy=None) -> tuple[str, object, bool]:
     """(engine_mode, score_fn, uses_pallas) for the current backend/env.
 
     Engine (CEPH_TPU_CRUSH_ENGINE = auto|limb|i64): the LIMB engine
@@ -419,7 +419,14 @@ def default_engine_config() -> tuple[str, object, bool]:
         raise ValueError(
             f"CEPH_TPU_CRUSH_SCORE={smode!r}: want auto|pallas|gather"
         )
-    on_tpu = jax.default_backend() in ("tpu", "axon")
+    # backend resolves through the policy seam (cephtopo): a
+    # cpu-fallback topology keeps the i64 engine + gather scorer even
+    # when an accelerator is visible; callers may inject their own
+    # policy (crush_do_rule_batch threads one through)
+    from ..common.device_policy import get_device_policy
+
+    pol = policy if policy is not None else get_device_policy()
+    on_tpu = pol.backend() in ("tpu", "axon")
     if emode == "auto":
         emode = "limb" if on_tpu else "i64"
     use_pallas = smode == "pallas" or (smode == "auto" and on_tpu)
@@ -437,6 +444,7 @@ def crush_do_rule_batch(
     numrep: int,
     weightvec,
     choose_args: str | None = None,
+    policy=None,
 ) -> jnp.ndarray:
     """Batched crush_do_rule: xs [N] -> [N, numrep] OSD ids.
 
@@ -454,7 +462,10 @@ def crush_do_rule_batch(
     legacy types exist for map-ingest parity, where C-speed batch
     evaluation is ample (uniform buckets are additionally STATEFUL per
     (x, rule) via their permutation cache, which is hostile to the
-    fixed-trip vectorization)."""
+    fixed-trip vectorization).
+
+    `policy` (cephtopo) injects a DevicePolicy for the engine/scorer
+    pick; None consults the process-wide policy the daemon configured."""
     tm = TELEMETRY
     if not getattr(cm, "straw2_only", True):
         from .oracle_bridge import do_rule_steps_oracle
@@ -470,11 +481,11 @@ def crush_do_rule_batch(
                       bytes_in=int(np.asarray(xs).nbytes),
                       bytes_out=int(out.nbytes), synced=True)
         return jnp.asarray(out)
-    engine_mode, score_fn, uses_pallas = default_engine_config()
+    engine_mode, score_fn, uses_pallas = default_engine_config(policy)
     key = (rule_id, numrep, choose_args, engine_mode, uses_pallas)
 
     def build_and_cache():
-        emode, score, _ = default_engine_config()
+        emode, score, _ = default_engine_config(policy)
         built = _build_rule_fn(
             cm, rule_id, numrep, choose_args, emode, score
         ) + (emode,)

@@ -184,9 +184,13 @@ def _want_pallas() -> bool:
         )
     # the sentinel's latched `degraded` state downgrades auto dispatch:
     # a wedged backend must not be fed fresh Pallas launches (forced
-    # modes above still win — the operator said so)
+    # modes above still win — the operator said so).  The backend name
+    # comes from the policy seam (cephtopo): a cpu-fallback topology
+    # keeps auto on the XLA path even on an accelerator box
+    from ..common.device_policy import get_device_policy
+
     return (_pallas_broken is None and not SENTINEL.is_degraded
-            and jax.default_backend() in ("tpu", "axon"))
+            and get_device_policy().backend() in ("tpu", "axon"))
 
 
 def current_backend() -> str:
@@ -237,10 +241,13 @@ def _apply_matrix_dispatch(mat: np.ndarray, chunks,
     if _want_pallas():
         from .pallas_gf import apply_matrix_pallas
 
+        from ..common.device_policy import get_device_policy
+
         forced = _forced_pallas()
         try:
             return apply_matrix_pallas(
-                mat, chunks, interpret=jax.default_backend() == "cpu"
+                mat, chunks,
+                interpret=get_device_policy().backend() == "cpu",
             ), "pallas"
         except Exception as e:
             if forced:

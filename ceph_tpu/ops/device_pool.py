@@ -73,10 +73,14 @@ def set_donation_override(v: bool | None) -> None:
 
 def donation_supported() -> bool:
     """True when `donate_argnums` buys real buffer reuse on the current
-    backend (CPU accepts the annotation but ignores it)."""
+    backend (CPU accepts the annotation but ignores it).  The backend
+    name comes from the policy seam (cephtopo), so a cpu-fallback
+    topology disables donation even on an accelerator box."""
     if _donation_override is not None:
         return _donation_override
-    return jax.default_backend() in _DONATING_BACKENDS
+    from ..common.device_policy import get_device_policy
+
+    return get_device_policy().backend() in _DONATING_BACKENDS
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -95,10 +99,13 @@ class DevicePool:
     """Bounded geometry-keyed free-list of device buffers (see module
     docstring).  Process-wide singleton ``POOL`` below; thread-safe."""
 
-    def __init__(self, max_bytes: int = 256 << 20, enabled: bool = True):
+    def __init__(self, max_bytes: int = 256 << 20, enabled: bool = True,
+                 policy=None):
         self._lock = make_lock("ops::device_pool")
         self._max_bytes = int(max_bytes)
         self._enabled = bool(enabled)
+        #: injected DevicePolicy (cephtopo); None = legacy fixed bound
+        self._policy = policy
         #: geometry -> free buffers; OrderedDict order IS the LRU order
         #: (move_to_end on every touch, evict from the front)
         self._free: OrderedDict[tuple, list] = OrderedDict()
@@ -108,9 +115,14 @@ class DevicePool:
 
     # -- config ------------------------------------------------------------
     def configure(self, enabled: bool | None = None,
-                  max_bytes: int | None = None) -> None:
+                  max_bytes: int | None = None, policy=None) -> None:
         """Apply the ec_device_pool / ec_device_pool_max_bytes options
-        (daemon start; first daemon in the process wins the size)."""
+        (daemon start; first daemon in the process wins the size).
+        `policy` injects the daemon's DevicePolicy: the residency bound
+        becomes the policy's pool_budget (per-device share x healthy
+        devices), so a sentinel-shrunk mesh shrinks the pool with it."""
+        if policy is not None:
+            self._policy = policy
         with self._lock:
             if enabled is not None:
                 self._enabled = bool(enabled)
@@ -118,7 +130,18 @@ class DevicePool:
                     self._drain_locked()
             if max_bytes is not None:
                 self._max_bytes = int(max_bytes)
-                self._evict_locked()
+        bound = self._bound()
+        with self._lock:
+            self._evict_locked(bound)
+
+    def _bound(self) -> int:
+        """Effective residency bound: the injected policy's budget (it
+        consults sentinel device health), or the raw configured max.
+        Resolved OUTSIDE the pool lock — the policy reads sentinel
+        state behind its own lock."""
+        if self._policy is None:
+            return self._max_bytes
+        return self._policy.pool_budget(self._max_bytes)
 
     def enabled(self) -> bool:
         """Pool usable right now: configured on AND the backend sentinel
@@ -164,6 +187,7 @@ class DevicePool:
             nbytes = int(dev.nbytes)
         except (AttributeError, TypeError):
             return
+        bound = self._bound()  # outside the pool lock (sentinel reads)
         with self._lock:
             if not self._enabled:
                 return
@@ -171,7 +195,7 @@ class DevicePool:
             self._free.move_to_end(key)
             self._resident += nbytes
             self._stats["releases"] += 1
-            dropped = self._evict_locked()
+            dropped = self._evict_locked(bound)
             resident = self._resident
         TELEMETRY.record_pool(evictions=len(dropped),
                               resident_bytes=resident)
@@ -197,9 +221,11 @@ class DevicePool:
         return jax.device_put(host_array)  # noqa: CL8 — the pool IS the transfer seam
 
     # -- bookkeeping -------------------------------------------------------
-    def _evict_locked(self) -> list:
+    def _evict_locked(self, bound: int | None = None) -> list:
+        if bound is None:
+            bound = self._max_bytes
         dropped = []
-        while self._resident > self._max_bytes and self._free:
+        while self._resident > bound and self._free:
             key, bufs = self._free.popitem(last=False)  # LRU geometry
             for b in bufs:
                 self._resident -= b.nbytes
@@ -221,11 +247,13 @@ class DevicePool:
         TELEMETRY.record_pool(resident_bytes=resident)
 
     def stats(self) -> dict:
+        bound = self._bound()
         with self._lock:
             out = dict(self._stats)
             out["resident_bytes"] = self._resident
             out["geometries"] = len(self._free)
             out["max_bytes"] = self._max_bytes
+            out["budget_bytes"] = bound
             out["enabled"] = self._enabled
         return out
 
@@ -238,12 +266,13 @@ POOL = DevicePool()
 _conf_applied = False
 
 
-def configure_from_conf(conf) -> None:
+def configure_from_conf(conf, policy=None) -> None:
     """Wire the declared options into the process-wide pool at daemon
     start (CL5's declared-AND-read contract for both knobs).  FIRST
     daemon in the process wins; the write batcher additionally re-reads
     ``ec_device_pool`` per flush, so the hatch stays per-daemon and
-    runtime there."""
+    runtime there.  `policy` threads the daemon's DevicePolicy into the
+    pool bound (cephtopo: sentinel-shrunk mesh => shrunk pool)."""
     global _conf_applied
     if _conf_applied:
         return
@@ -251,4 +280,5 @@ def configure_from_conf(conf) -> None:
     POOL.configure(
         enabled=bool(conf.get("ec_device_pool")),
         max_bytes=int(conf.get("ec_device_pool_max_bytes")),
+        policy=policy,
     )

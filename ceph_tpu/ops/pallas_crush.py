@@ -212,7 +212,7 @@ def _score_kernel(x_ref, r_ref, items_ref, t1_ref, t2_ref, hi_ref, lo_ref,
 
 
 @partial(jax.jit, static_argnames=("tile", "loop_slabs", "interpret"))
-def straw2_scores_pallas(x, r, items, tile: int,
+def straw2_scores_pallas(x, r, items, tile: int,  # noqa: CL9 — public on purpose: crush/batched.py pads+launches it and crush_do_rule_batch owns the telemetry record; renaming would break the engine registry
                          loop_slabs: bool = False,
                          interpret: bool = False):
     """(x [B], r [B], items [B, S]) -> (ln_hi [B, S], ln_lo [B, S]) int32.

@@ -235,6 +235,7 @@ class OSD(
         self._recovery_inflight = False
         self._split_inflight = False
         self._sentinel_held = False  # flipped under self._lock
+        self.device_policy = None  # injected at start() (cephtopo)
         self._clone_mutex = make_lock("osd::snap_clone")
         # watch/notify state (reference: PrimaryLogPG watchers): primary-
         # local; clients re-register lingering watches on map change
@@ -435,7 +436,17 @@ class OSD(
         # paths, which consult only POOL.enabled())
         from ..ops.device_pool import POOL, configure_from_conf
 
-        configure_from_conf(self.cct.conf)
+        # cephtopo: device-topology policy from THIS daemon's conf
+        # (device_topology / device_mesh_shape, read ONCE here),
+        # constructor-injected process-wide — first daemon wins, like
+        # the sentinel.  The mesh/pool/dispatch/CRUSH seams consult the
+        # policy instead of ambient jax.devices() (cephlint CL9).
+        from ..common.device_policy import (DevicePolicy,
+                                            configure_device_policy)
+
+        self.device_policy = configure_device_policy(
+            DevicePolicy.from_conf(self.cct.conf))
+        configure_from_conf(self.cct.conf, policy=self.device_policy)
         self.cct.conf.add_observer(
             ["ec_device_pool"],
             lambda _n, v: POOL.configure(enabled=bool(v)))

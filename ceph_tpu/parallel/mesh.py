@@ -23,17 +23,34 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..common.device_policy import get_device_policy, mesh_over
 from ..ops.bitplane import _apply_bitmatrix, bitmatrix_device
+
+# jax.shard_map graduated from jax.experimental at 0.4.x boundaries and
+# renamed its replication-check kwarg (check_rep -> check_vma) on the
+# way; accept either spelling so the decode path works on the pinned
+# runtime
+_shard_map = getattr(jax, "shard_map", None)
+_CHECK_KW = "check_vma"
+if _shard_map is None:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
 
 LEN_AXIS = "shard_len"  # stripe-batch axis (data/sequence-parallel analog)
 ROW_AXIS = "shard_row"  # shard-id axis (tensor-parallel analog)
 
 
-def make_mesh(n_devices: int | None = None, axis: str = LEN_AXIS) -> Mesh:
-    devs = jax.devices()
-    if n_devices is not None:
-        devs = devs[:n_devices]
-    return Mesh(np.array(devs), (axis,))
+def make_mesh(n_devices: int | None = None, axis: str = LEN_AXIS,
+              policy=None) -> Mesh:
+    """Mesh over the policy-granted devices (cephtopo: the ambient
+    jax.devices() probe moved behind the injected DevicePolicy; the cpu
+    variant yields a 1-device mesh, a sentinel-shrunk policy a smaller
+    one).  ``policy=None`` consults the process-wide policy the first
+    daemon configured; ``n_devices`` keeps the historical take-first-n
+    cap so MULTICHIP_r05 callers are unchanged."""
+    pol = policy if policy is not None else get_device_policy()
+    return pol.mesh(n_devices, axis)
 
 
 def sharded_apply_matrix(mesh: Mesh, mat: np.ndarray, chunks) -> jax.Array:
@@ -66,7 +83,7 @@ def distributed_decode(mesh: Mesh, decode_mat: np.ndarray, shards) -> jax.Array:
     mat = np.ascontiguousarray(decode_mat, dtype=np.uint8)
     B = bitmatrix_device(mat.tobytes(), mat.shape)
     shards = jnp.asarray(shards, dtype=jnp.uint8)
-    row_mesh = Mesh(mesh.devices, (ROW_AXIS,))
+    row_mesh = mesh_over(mesh.devices, ROW_AXIS)
     n = row_mesh.devices.size
     if k % n != 0:
         # pad shard rows to a multiple of the mesh (zero rows are inert:
@@ -78,13 +95,14 @@ def distributed_decode(mesh: Mesh, decode_mat: np.ndarray, shards) -> jax.Array:
         )
 
     @partial(
-        jax.shard_map,
+        _shard_map,
         mesh=row_mesh,
         in_specs=(P(None, None), P(ROW_AXIS, None)),
         out_specs=P(None, None),
         # after the all_gather every device computes the same full result;
         # that replication isn't statically inferable, so skip the check
-        check_vma=False,
+        # (check_vma on current jax, check_rep on the experimental home)
+        **{_CHECK_KW: False},
     )
     def _decode(B_full, shard_slice):
         gathered = jax.lax.all_gather(

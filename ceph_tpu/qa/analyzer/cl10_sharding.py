@@ -1,0 +1,397 @@
+"""CL10 — sharding propagation (cephtopo's dataflow half).
+
+CL8 walks kernel bodies with a (shape, dtype) abstract interpreter;
+CL10 extends the same style of walk with a PLACEMENT lattice, because
+the bugs the multi-chip plane grows are not shape bugs — they are
+silent cross-device movement:
+
+    R             replicated (or host-resident) — safe everywhere
+    P(dim, axis)  partitioned: array dim `dim` split along mesh axis
+                  `axis` (the NamedSharding/PartitionSpec literal form)
+    U             unknown — joins to U, never reported
+
+Seeds (within one function body):
+
+- ``PartitionSpec``/``P`` literals: ``P(None, "len")`` / ``P(None,
+  LEN_AXIS)`` — the position of the first non-None entry is the
+  partitioned dim, its string/name the mesh axis.
+- ``NamedSharding(mesh, <spec>)`` bound to a name.
+- ``jax.device_put(x, <spec>)`` and ``with_sharding_constraint(x,
+  <spec>)`` stamp the value.
+- ``jax.jit(f, in_shardings=..., out_shardings=...,
+  donate_argnums=...)`` bound to a name: calls through that name
+  return the out spec and check donation (below).
+
+Propagation: elementwise binops join (P ⊔ R = P; P ⊔ P with equal
+(dim, axis) = P); ``@``/``jnp.dot``/``jnp.matmul``/``dot_general``
+track the surviving dims of a 2-D contraction; ``reshape`` forgets to
+U (a static walk cannot prove the partitioned dim survives);
+``concatenate`` joins its elements; ``x.at[i].set(v)`` (scatter)
+propagates ``x`` and joins ``v``; ``all_gather`` replicates.
+Function parameters start U, so un-sharded code stays silent.
+
+Finding kinds (ident ``<fn>:<kind>``):
+
+- ``reshard`` — elementwise/concat/scatter operands with provably
+  different placements: XLA inserts an implicit all-to-all or gather
+  where the code reads as local math.  Reshard deliberately
+  (with_sharding_constraint) or fix the spec.
+- ``contract-shard`` — a 2-D contraction over a partitioned dim
+  (``A @ B`` with A partitioned on its last or B on its first dim):
+  the matmul hides an all-gather/psum on the hot path.
+- ``sharded-host-trip`` — ``np.*`` / ``jax.device_get`` /
+  ``float()``-class coercion / ``.item()``/``.tolist()`` applied to a
+  value the lattice proves partitioned: the host copy gathers every
+  device's shard through one host thread.
+- ``donate-mismatch`` — a donated argument whose placement provably
+  differs from the jit's ``out_shardings``: XLA cannot alias the
+  buffer, so the donation silently degrades to a copy (and the caller
+  has still lost the buffer).
+
+Scope: ``cfg.cl10_dirs`` (default parallel/, ops/) — where sharding
+literals live.  Everything un-proven is U and silent; like CL8, this
+check prefers missed findings over false alarms.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .core import Config, Finding, ModuleInfo
+from .symbols import SymbolTable, attr_chain, call_name
+
+_NUMPY_RECEIVERS = {"np", "numpy", "onp"}
+_COERCERS = {"bool", "int", "float", "complex"}
+_ITEM_METHODS = {"item", "tolist"}
+_SPEC_NAMES = {"P", "PartitionSpec"}
+
+
+@dataclass(frozen=True)
+class SV:
+    """One placement lattice element."""
+
+    kind: str        # "rep" | "part" | "unk"
+    dim: int = -1
+    axis: str = ""
+
+    @property
+    def part(self) -> bool:
+        return self.kind == "part"
+
+
+REP = SV("rep")
+UNK = SV("unk")
+
+
+def part(dim: int, axis: str) -> SV:
+    return SV("part", dim, axis)
+
+
+def join(a: SV, b: SV) -> tuple[SV, bool]:
+    """(joined, mismatch): mismatch=True when both sides are partitioned
+    with different (dim, axis) — the implicit-reshard shape."""
+    if a.kind == "unk" or b.kind == "unk":
+        return UNK, False
+    if a.kind == "rep":
+        return b, False
+    if b.kind == "rep":
+        return a, False
+    if (a.dim, a.axis) == (b.dim, b.axis):
+        return a, False
+    return UNK, True
+
+
+@dataclass(frozen=True)
+class JitWrapper:
+    """A name bound to jax.jit(f, ...) with sharding-relevant kwargs."""
+
+    donate: tuple[int, ...]
+    out: SV | None   # out_shardings spec when statically known
+
+
+def check(mods: list[ModuleInfo], sym: SymbolTable, cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    dirs = set(cfg.cl10_dirs)
+    for mod in mods:
+        if mod.topdir() not in dirs:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef):
+                interp = _Interp(mod, node)
+                interp.run()
+                findings.extend(interp.findings)
+    return findings
+
+
+class _Interp:
+    def __init__(self, mod: ModuleInfo, fn: ast.FunctionDef):
+        self.mod = mod
+        self.fn = fn
+        self.env: dict[str, SV] = {}
+        self.specs: dict[str, SV] = {}      # names bound to sharding specs
+        self.jits: dict[str, JitWrapper] = {}
+        self.findings: list[Finding] = []
+        self._seen: set[str] = set()
+
+    def run(self) -> None:
+        for stmt in self.fn.body:
+            self._stmt(stmt)
+
+    # -- statements --------------------------------------------------------
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.FunctionDef):
+            return  # nested defs are walked as their own function
+        if isinstance(stmt, ast.Assign):
+            spec = self._spec_of(stmt.value)
+            jitw = self._jit_of(stmt.value)
+            val = self._ev(stmt.value)
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    if spec is not None:
+                        self.specs[t.id] = spec
+                    if jitw is not None:
+                        self.jits[t.id] = jitw
+                    self.env[t.id] = val
+            return
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None and isinstance(stmt.target, ast.Name):
+                self.env[stmt.target.id] = self._ev(stmt.value)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._ev(stmt.value)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._ev(stmt.value)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._stmt(child)
+            elif isinstance(child, ast.expr):
+                self._ev(child)
+
+    # -- sharding-spec literals --------------------------------------------
+    def _spec_of(self, expr: ast.expr) -> SV | None:
+        """The placement a sharding EXPRESSION denotes, or None when the
+        expression isn't (or doesn't resolve to) a spec."""
+        if isinstance(expr, ast.Name):
+            return self.specs.get(expr.id)
+        if not isinstance(expr, ast.Call):
+            return None
+        cn = call_name(expr)
+        if cn in _SPEC_NAMES:
+            for i, a in enumerate(expr.args):
+                if isinstance(a, ast.Constant) and a.value is None:
+                    continue
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    return part(i, a.value)
+                if isinstance(a, ast.Name):
+                    return part(i, a.id)
+                return None  # tuple axes etc.: out of the lattice
+            return REP  # P() / P(None, ...): fully replicated
+        if cn == "NamedSharding" and len(expr.args) >= 2:
+            return self._spec_of(expr.args[1])
+        return None
+
+    def _jit_of(self, expr: ast.expr) -> JitWrapper | None:
+        if not isinstance(expr, ast.Call):
+            return None
+        f = expr.func
+        is_jit = (isinstance(f, ast.Name) and f.id == "jit") or (
+            isinstance(f, ast.Attribute) and f.attr == "jit")
+        if not is_jit:
+            return None
+        donate: tuple[int, ...] = ()
+        out: SV | None = None
+        for kw in expr.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+                nums = []
+                for e in elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                        nums.append(e.value)
+                donate = tuple(nums)
+            elif kw.arg == "out_shardings":
+                out = self._spec_of(kw.value)
+        if not donate and out is None:
+            return None
+        return JitWrapper(donate=donate, out=out)
+
+    # -- expressions -------------------------------------------------------
+    def _ev(self, expr: ast.expr) -> SV:
+        if isinstance(expr, ast.Name):
+            return self.env.get(expr.id, UNK)
+        if isinstance(expr, ast.Constant):
+            return REP
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            sv = REP
+            for e in expr.elts:
+                sv, mism = join(sv, self._ev(e))
+                if mism:
+                    self._report(expr, "reshard",
+                                 "sequence mixes differently-partitioned "
+                                 "values — downstream ops reshard")
+            return sv
+        if isinstance(expr, ast.BinOp):
+            lv, rv = self._ev(expr.left), self._ev(expr.right)
+            if isinstance(expr.op, ast.MatMult):
+                return self._contract(expr, lv, rv)
+            sv, mism = join(lv, rv)
+            if mism:
+                self._report(expr, "reshard",
+                             "elementwise op on operands with different "
+                             "placements — XLA inserts an implicit "
+                             "reshard here")
+            return sv
+        if isinstance(expr, ast.UnaryOp):
+            return self._ev(expr.operand)
+        if isinstance(expr, ast.IfExp):
+            sv, _ = join(self._ev(expr.body), self._ev(expr.orelse))
+            return sv
+        if isinstance(expr, ast.Subscript):
+            base = self._ev(expr.value)
+            return base if base.part else UNK
+        if isinstance(expr, ast.Call):
+            return self._call(expr)
+        if isinstance(expr, ast.Attribute):
+            return self._ev(expr.value) if expr.attr in ("T", "real",
+                                                         "imag") else UNK
+        return UNK
+
+    def _call(self, node: ast.Call) -> SV:
+        cn = call_name(node)
+        f = node.func
+        args = node.args
+
+        # seeds -------------------------------------------------------
+        # the placement rides the RETURN value; the host-side input
+        # name keeps its old lattice element (device_put copies)
+        if cn == "device_put" and args:
+            if len(args) >= 2:
+                spec = self._spec_of(args[1])
+                if spec is not None:
+                    return spec
+            return UNK
+        if cn == "with_sharding_constraint" and len(args) >= 2:
+            spec = self._spec_of(args[1])
+            return spec if spec is not None else UNK
+
+        # collectives / shape ops ------------------------------------
+        if cn == "all_gather":
+            for a in args:
+                self._ev(a)
+            return REP
+        if cn == "reshape":
+            for a in args:
+                self._ev(a)
+            return UNK
+        if cn in ("concatenate", "stack", "hstack", "vstack"):
+            sv = REP
+            elems = args[0].elts if args and isinstance(
+                args[0], (ast.Tuple, ast.List)) else args
+            for e in elems:
+                sv, mism = join(sv, self._ev(e))
+                if mism:
+                    self._report(node, "reshard",
+                                 f"jnp.{cn} over differently-partitioned "
+                                 f"operands — implicit reshard")
+            return sv
+        if cn in ("dot", "matmul", "dot_general", "tensordot") \
+                and len(args) >= 2:
+            return self._contract(node, self._ev(args[0]),
+                                  self._ev(args[1]))
+        if cn == "set" and isinstance(f, ast.Attribute):
+            # x.at[i].set(v): scatter — propagate x, join the update
+            base = f.value
+            if isinstance(base, ast.Subscript) \
+                    and isinstance(base.value, ast.Attribute) \
+                    and base.value.attr == "at":
+                xv = self._ev(base.value.value)
+                uv = self._ev(args[0]) if args else REP
+                sv, mism = join(xv, uv)
+                if mism:
+                    self._report(node, "reshard",
+                                 "scatter update placed differently from "
+                                 "its target — implicit reshard")
+                return sv
+
+        # host trips --------------------------------------------------
+        if isinstance(f, ast.Attribute):
+            ch = attr_chain(f)
+            root = ch[0] if ch else None
+            if root in _NUMPY_RECEIVERS and any(
+                    self._ev(a).part for a in args):
+                self._report(node, "sharded-host-trip",
+                             f"host numpy call {root}.{f.attr}(...) on a "
+                             f"partitioned value — gathers every shard "
+                             f"through the host")
+                return REP
+            if f.attr == "device_get" and args and self._ev(args[0]).part:
+                self._report(node, "sharded-host-trip",
+                             "jax.device_get on a partitioned value — "
+                             "cross-device gather hidden in a host copy")
+                return REP
+            if f.attr in _ITEM_METHODS and self._ev(f.value).part:
+                self._report(node, "sharded-host-trip",
+                             f".{f.attr}() on a partitioned value — "
+                             f"host sync + gather")
+                return REP
+        if isinstance(f, ast.Name) and f.id in _COERCERS and args \
+                and self._ev(args[0]).part:
+            self._report(node, "sharded-host-trip",
+                         f"{f.id}() on a partitioned value — host sync "
+                         f"+ gather")
+            return REP
+
+        # calls through a recorded jit wrapper ------------------------
+        if isinstance(f, ast.Name) and f.id in self.jits:
+            w = self.jits[f.id]
+            for i in w.donate:
+                if i < len(args):
+                    av = self._ev(args[i])
+                    if av.part and w.out is not None and w.out != av:
+                        self._report(
+                            node, "donate-mismatch",
+                            f"donated arg {i} is partitioned "
+                            f"({av.axis}@dim{av.dim}) but out_shardings "
+                            f"differs — XLA cannot alias the buffer, the "
+                            f"donation degrades to a copy")
+            for a in args:
+                self._ev(a)
+            return w.out if w.out is not None else UNK
+
+        # anything else: evaluate args for side findings, answer U
+        for a in args:
+            self._ev(a)
+        for kw in node.keywords:
+            self._ev(kw.value)
+        return UNK
+
+    def _contract(self, node: ast.AST, lv: SV, rv: SV) -> SV:
+        """2-D contraction: A [m, k] @ B [k, n] -> [m, n].  A partitioned
+        contracting dim (A dim1 / B dim0) hides a gather/psum."""
+        if (lv.part and lv.dim == 1) or (rv.part and rv.dim == 0):
+            self._report(node, "contract-shard",
+                         "contraction over a partitioned dim — the "
+                         "matmul hides an all-gather/psum; reshard the "
+                         "operand or shard the batch dim instead")
+            return UNK
+        if lv.part and lv.dim == 0:
+            return lv
+        if rv.part and rv.dim == 1:
+            return rv
+        if lv.kind == "rep" and rv.kind == "rep":
+            return REP
+        return UNK
+
+    def _report(self, node: ast.AST, kind: str, msg: str) -> None:
+        ident = f"{self.fn.name}:{kind}"
+        n = 2
+        while ident in self._seen:
+            ident = f"{self.fn.name}:{kind}:{n}"
+            n += 1
+        self._seen.add(ident)
+        self.findings.append(Finding(
+            "CL10", self.mod.rel, getattr(node, "lineno", self.fn.lineno),
+            ident, msg))

@@ -1,0 +1,259 @@
+"""CL9 — device-topology discipline (cephtopo).
+
+The multi-chip data plane (ROADMAP) dies on ambient topology: every
+scattered ``jax.devices()`` / ``Mesh(...)`` / ``jax.default_backend()``
+probe hard-codes "whatever this process happens to see" and keeps the
+same OSD code from serving a laptop test, an 8-chip mesh, and a
+sentinel-shrunk degraded mesh.  Exactly ONE module — the policy
+allowlist ``cfg.cl9_policy_modules``, default
+``common/device_policy.py`` — may touch the runtime's topology;
+everything else receives a constructor-injected ``DevicePolicy``.
+
+Finding kinds (ident ``<scope>:<kind>``, scope = enclosing function or
+``<module>``):
+
+- ``ambient-devices`` — ``jax.devices()`` / ``jax.local_devices()``
+  outside the policy module.  Use ``DevicePolicy.devices()`` /
+  ``.default_device()``.
+- ``device-index`` — integer-literal subscript of a devices() result
+  (directly or via a name bound from one): positional chip addressing,
+  the ``jax.device_put(x, jax.devices()[i])`` anti-pattern.  A
+  sentinel-shrunk mesh renumbers; ask the policy for a device.
+- ``ambient-mesh`` — ``Mesh(...)`` constructed outside the policy.
+  Use ``DevicePolicy.mesh()`` or ``device_policy.mesh_over()``.
+- ``ambient-backend`` — ``jax.default_backend()`` probes outside the
+  policy: dispatch decisions (pallas, donation, CRUSH engine) must
+  respect the cpu-fallback variant, so ask ``policy.backend()``.
+- ``public-jit`` (``cfg.cl9_jit_dirs``, default ops/) — a PUBLIC
+  module-level jitted entry point (``name = jax.jit(...)`` or a public
+  ``@jax.jit`` def).  Jit entry points in ops/ stay private and
+  dispatch through a telemetry/policy-recording wrapper (the
+  ``apply_matrix_jax`` / ``crush_do_rule_batch`` pattern); a public
+  jitted name invites callers to bypass that seam.
+- ``donate`` — a ``donate_argnums`` annotation in a module that never
+  references the device-pool seam (``ops/device_pool.py``): donation
+  without the pool means no caller can route recycled buffers into the
+  donated slot, so the annotation either does nothing or silently
+  aliases a buffer the caller still holds.
+
+Deliberate ambient sites carry a reasoned ``# noqa: CL9`` (the
+sentinel's per-device probe must see the raw topology — it FEEDS the
+policy's shrink) or a justified baseline entry; the tier-1
+whole-package gate (tests/test_analyzer_topo.py) pins the count of
+unsuppressed findings at zero.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Config, Finding, ModuleInfo
+from .symbols import SymbolTable, attr_chain, call_name
+
+_DEVICE_CALLS = {"devices", "local_devices"}
+_BACKEND_CALLS = {"default_backend"}
+_JAX_ROOTS = {"jax"}
+#: names whose presence marks a module as pool-seam-aware (the donate
+#: kind's exemption): importing/defining any of these means buffers can
+#: route through ops/device_pool.py
+_POOL_MARKS = {"device_pool", "DevicePool", "POOL", "donation_supported"}
+
+
+def _is_jax_probe(node: ast.Call, names: set[str]) -> bool:
+    """jax.devices() / jax.local_devices() / jax.default_backend()."""
+    f = node.func
+    if not isinstance(f, ast.Attribute) or f.attr not in names:
+        return False
+    chain = attr_chain(f)  # None when the chain roots in an expression
+    return chain is not None and chain[0] in _JAX_ROOTS
+
+
+def _is_mesh_ctor(node: ast.Call) -> bool:
+    """Mesh(...) / jax.sharding.Mesh(...) — constructing a topology."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id == "Mesh"
+    if isinstance(f, ast.Attribute):
+        return f.attr == "Mesh"
+    return False
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id == "jit"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    return False
+
+
+def _jit_decorated(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        if _is_jit_expr(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            if _is_jit_expr(dec.func):
+                return True
+            if call_name(dec) == "partial" and dec.args \
+                    and _is_jit_expr(dec.args[0]):
+                return True
+    return False
+
+
+def _references_pool(mod: ModuleInfo) -> bool:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.split(".")[-1] == "device_pool":
+            return True
+        if isinstance(node, ast.Name) and node.id in _POOL_MARKS:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _POOL_MARKS:
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.ClassDef)) \
+                and node.name in _POOL_MARKS:
+            return True
+    return False
+
+
+def check(mods: list[ModuleInfo], sym: SymbolTable, cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    jit_dirs = set(cfg.cl9_jit_dirs)
+    for mod in mods:
+        if mod.rel in cfg.cl9_policy_modules:
+            continue  # the ONE place ambient topology is legal
+        v = _TopoVisitor(mod, pool_aware=_references_pool(mod))
+        v.run()
+        findings.extend(v.findings)
+        if mod.topdir() in jit_dirs:
+            findings.extend(_public_jit(mod))
+    return findings
+
+
+def _public_jit(mod: ModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    seen: set[str] = set()
+
+    def report(name: str, line: int) -> None:
+        ident = f"public-jit:{name}"
+        if ident in seen:
+            return
+        seen.add(ident)
+        out.append(Finding(
+            "CL9", mod.rel, line, ident,
+            f"public jitted entry point `{name}` — keep jit handles "
+            f"private and dispatch through a telemetry/policy wrapper "
+            f"(the apply_matrix_jax pattern), or # noqa with the "
+            f"wrapper that owns it"))
+
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            jit_like = _is_jit_expr(call.func) or (
+                call_name(call) == "partial" and call.args
+                and _is_jit_expr(call.args[0]))
+            if not jit_like:
+                continue
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and not t.id.startswith("_"):
+                    report(t.id, stmt.lineno)
+        elif isinstance(stmt, ast.FunctionDef):
+            if not stmt.name.startswith("_") and _jit_decorated(stmt):
+                report(stmt.name, stmt.lineno)
+    return out
+
+
+class _TopoVisitor:
+    """One pass over a module: ambient probes, mesh construction,
+    device-index addressing, and pool-less donation, each attributed to
+    the enclosing function scope (``<module>`` at top level)."""
+
+    def __init__(self, mod: ModuleInfo, pool_aware: bool):
+        self.mod = mod
+        self.pool_aware = pool_aware
+        self.findings: list[Finding] = []
+        self._seen: set[str] = set()
+
+    def run(self) -> None:
+        self._walk_scope(self.mod.tree.body, "<module>")
+
+    def _walk_scope(self, body: list[ast.stmt], scope: str) -> None:
+        """Visit this scope's own nodes in source order; nested defs
+        (including methods) recurse as their own scope so a finding is
+        attributed — and deduped — exactly once."""
+        devices_names: set[str] = set()  # names bound from devices()
+        queue: list[ast.AST] = list(body)
+        i = 0
+        nested: list[ast.FunctionDef] = []
+        while i < len(queue):
+            node = queue[i]
+            i += 1
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested.append(node)
+                continue
+            if isinstance(node, ast.ClassDef):
+                queue.extend(node.body)  # methods recurse via nested
+                continue
+            if isinstance(node, ast.Assign) \
+                    and self._mentions_devices_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        devices_names.add(t.id)
+            self._check_node(node, scope, devices_names)
+            queue.extend(ast.iter_child_nodes(node))
+        for fn in nested:
+            self._walk_scope(fn.body, fn.name)
+
+    @staticmethod
+    def _mentions_devices_call(expr: ast.expr) -> bool:
+        return any(isinstance(n, ast.Call)
+                   and _is_jax_probe(n, _DEVICE_CALLS)
+                   for n in ast.walk(expr))
+
+    def _check_node(self, node: ast.AST, scope: str,
+                    devices_names: set[str]) -> None:
+        if isinstance(node, ast.Call):
+            if _is_jax_probe(node, _DEVICE_CALLS):
+                self._report(node, scope, "ambient-devices",
+                             f"ambient jax.{node.func.attr}() — topology "
+                             f"belongs to the injected DevicePolicy "
+                             f"(common/device_policy.py)")
+            elif _is_jax_probe(node, _BACKEND_CALLS):
+                self._report(node, scope, "ambient-backend",
+                             "ambient jax.default_backend() — dispatch "
+                             "must ask policy.backend() so the "
+                             "cpu-fallback variant is honored")
+            elif _is_mesh_ctor(node):
+                self._report(node, scope, "ambient-mesh",
+                             "Mesh(...) constructed outside the policy "
+                             "module — use DevicePolicy.mesh() / "
+                             "device_policy.mesh_over()")
+            for kw in node.keywords:
+                if kw.arg == "donate_argnums" and not self.pool_aware:
+                    self._report(
+                        node, scope, "donate",
+                        "donate_argnums in a module that never touches "
+                        "the device-pool seam (ops/device_pool.py) — "
+                        "callers cannot route recycled buffers into the "
+                        "donated slot")
+        elif isinstance(node, ast.Subscript):
+            idx = node.slice
+            if isinstance(idx, ast.Constant) and isinstance(idx.value, int):
+                base = node.value
+                is_dev = (isinstance(base, ast.Call)
+                          and _is_jax_probe(base, _DEVICE_CALLS)) or (
+                    isinstance(base, ast.Name)
+                    and base.id in devices_names)
+                if is_dev:
+                    self._report(node, scope, "device-index",
+                                 "integer device index into an ambient "
+                                 "device list — a sentinel-shrunk mesh "
+                                 "renumbers; ask the policy for a device")
+
+    def _report(self, node: ast.AST, scope: str, kind: str,
+                msg: str) -> None:
+        ident = f"{scope}:{kind}"
+        n = 2
+        while ident in self._seen:
+            ident = f"{scope}:{kind}:{n}"
+            n += 1
+        self._seen.add(ident)
+        self.findings.append(Finding(
+            "CL9", self.mod.rel, getattr(node, "lineno", 1), ident, msg))

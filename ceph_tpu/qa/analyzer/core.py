@@ -18,6 +18,14 @@ and the smatch/cov scripts).  Five whole-package checks:
     CL7  error paths: swallowed exceptions, unbounded blocking waits,
          reset callbacks mutating shared state without the lock
     CL8  kernel shape/dtype abstract interpretation in ops/, gf/, crush/
+    CL9  device-topology discipline: ambient jax.devices()/Mesh()/
+         default_backend() probes outside the one policy module,
+         device-index literals, public jitted entry points in ops/,
+         donation without the device-pool seam
+    CL10 sharding propagation: a placement lattice (Replicated /
+         PartitionSpec-along-axis / Unknown) over parallel/ and ops/,
+         flagging implicit reshards, sharded host trips, and
+         donation that cannot alias its output
 
 Suppression layers, innermost first:
 
@@ -169,7 +177,7 @@ class Config:
     baseline_file: Path | None = None
     use_baseline: bool = True
     checks: tuple[str, ...] = ("CL1", "CL2", "CL3", "CL4", "CL5",
-                               "CL6", "CL7", "CL8")
+                               "CL6", "CL7", "CL8", "CL9", "CL10")
     cl3_dirs: tuple[str, ...] = ("ops", "crush", "parallel", "bench")
     cl1_raw_lock_dirs: tuple[str, ...] = ("osd", "mon", "msg", "store",
                                           "client", "common")
@@ -181,6 +189,14 @@ class Config:
     #: are audited too)
     cl8_hostcopy_files: tuple[str, ...] = ("osd/write_batcher.py",
                                            "osd/ec_backend.py")
+    #: the ONE module where ambient topology probes are legal (cephtopo:
+    #: everything else receives a constructor-injected DevicePolicy)
+    cl9_policy_modules: tuple[str, ...] = ("common/device_policy.py",)
+    #: dirs whose PUBLIC module-level jitted names CL9 flags (jit entry
+    #: points there must stay behind the telemetry/policy dispatch seam)
+    cl9_jit_dirs: tuple[str, ...] = ("ops",)
+    #: dirs the CL10 placement lattice walks (where sharding specs live)
+    cl10_dirs: tuple[str, ...] = ("parallel", "ops")
     diff_files: frozenset[str] | None = None  # --diff: restrict findings
 
     @classmethod
@@ -300,7 +316,8 @@ class Report:
 def run(cfg: Config) -> Report:
     from .symbols import SymbolTable
     from . import (cl1_locks, cl2_races, cl3_tracing, cl4_failpoints,
-                   cl5_options, cl6_proto, cl7_errors, cl8_shapes)
+                   cl5_options, cl6_proto, cl7_errors, cl8_shapes,
+                   cl9_topology, cl10_sharding)
 
     mods = collect_modules(cfg)
     sym = SymbolTable.build(mods)
@@ -313,6 +330,8 @@ def run(cfg: Config) -> Report:
         "CL6": cl6_proto.check,
         "CL7": cl7_errors.check,
         "CL8": cl8_shapes.check,
+        "CL9": cl9_topology.check,
+        "CL10": cl10_sharding.check,
     }
     raw: list[Finding] = []
     for code in cfg.checks:
@@ -373,6 +392,12 @@ _SARIF_RULES = {
     "CL7": "error paths (swallowed exceptions, unbounded waits, "
            "unlocked reset handlers)",
     "CL8": "kernel shape/dtype dataflow",
+    "CL9": "device-topology discipline (ambient devices/Mesh/backend "
+           "probes outside the policy module, device-index literals, "
+           "public jit entry points, pool-less donation)",
+    "CL10": "sharding propagation (implicit reshards, contractions "
+            "over a partitioned dim, sharded host trips, "
+            "donation/out_shardings alias mismatches)",
     # dynamic findings (qa/race — cephrace shares this report machinery)
     "CR1": "data race (empty lockset + no happens-before edge)",
     "CR2": "deadlock (waits-for cycle closed at runtime)",

@@ -59,6 +59,13 @@
 #    /ceph_balancer_* series must render on the exporter, a balancer
 #    run against a stacked imbalance must commit moves and improve the
 #    exported score, and PG_IMBALANCE must raise then clear.
+# 12. topology smoke (ceph_tpu/qa/topology_smoke.py): the same
+#    production encode must be bit-identical under a cpu-1, mesh-8, and
+#    sentinel-degraded (two devices pinned failed) DevicePolicy, the
+#    degraded mesh must shrink to the survivors, and the device-pool
+#    budget must shrink with it.  Step 1's cephlint run includes the
+#    CL9/CL10 device-topology & sharding checks that pin the policy
+#    refactor behind this smoke.
 #
 # Analyzers emit SARIF 2.1.0 into qa/_sarif/ (github code-scanning uploads
 # resolve URIs against the repo root, which is where this script runs
@@ -308,5 +315,25 @@ else
     rc=1
 fi
 
-echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json, accounting_smoke.json, qos_smoke.json, recovery_smoke.json, device_pool_smoke.json, placement_smoke.json)"
+echo "== topology smoke (cpu-1 / mesh-N / degraded parity) =="
+# the same sharded encode through three injected DevicePolicy variants
+# must be bit-identical, the sentinel-degraded mesh must shrink instead
+# of wedging, and the pool budget must track the survivors
+# (ceph_tpu/qa/topology_smoke.py; docs/static_analysis.md CL9)
+python -m ceph_tpu.qa.topology_smoke > "$OUT_DIR/topology_smoke.json"
+topo_rc=$?
+if [ $topo_rc -eq 0 ]; then
+    echo "topology smoke: ok"
+elif python -c "import json; json.load(open('$OUT_DIR/topology_smoke.json'))" \
+        2>/dev/null; then
+    echo "topology smoke: FAILED:"
+    python -c "import json; [print(' -', p) for p in json.load(open('$OUT_DIR/topology_smoke.json'))['problems']]" || true
+    rc=1
+else
+    rm -f "$OUT_DIR/topology_smoke.json"
+    echo "topology smoke: ERROR (exit $topo_rc) — scenario crashed"
+    rc=1
+fi
+
+echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json, accounting_smoke.json, qos_smoke.json, recovery_smoke.json, device_pool_smoke.json, placement_smoke.json, topology_smoke.json)"
 exit $rc

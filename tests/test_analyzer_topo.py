@@ -1,0 +1,334 @@
+"""cephtopo lint (CL9 device-topology discipline, CL10 sharding
+propagation) — TP/TN fixtures per finding kind, the suppression layers,
+and the tier-1 whole-package gate that pins the refactor: zero
+unsuppressed CL9/CL10 findings over ceph_tpu/ (every remaining ambient
+topology site is a reasoned # noqa or baseline entry).
+
+Stays in the ~10s class: fixture packages are tiny and the one
+whole-package scan is pure AST (no jax import).
+"""
+from __future__ import annotations
+
+import functools
+from pathlib import Path
+
+from ceph_tpu.qa.analyzer.__main__ import main as analyzer_main
+from ceph_tpu.qa.analyzer.core import Config, format_baseline, run
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def make_pkg(tmp_path: Path, files: dict[str, str]) -> Path:
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return pkg
+
+
+def run_on(pkg: Path):
+    return run(Config.discover([str(pkg)]))
+
+
+def idents(report, code: str) -> set[str]:
+    return {f.ident for f in report.findings if f.code == code}
+
+
+# -- CL9: device-topology discipline ----------------------------------------
+
+CL9_TP = '''
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def grab():
+    devs = jax.devices()
+    d0 = devs[0]
+    m = Mesh(np.array(devs), ("x",))
+    return jax.device_put(np.zeros(4), jax.devices()[1])
+
+
+def probe():
+    return jax.default_backend() == "cpu"
+'''
+
+CL9_TN = '''
+import numpy as np
+from ceph_tpu.common.device_policy import get_device_policy, mesh_over
+
+
+def grab():
+    pol = get_device_policy()
+    m = pol.mesh(4, "x")
+    sub = mesh_over(m.devices, "y")
+    label = ("cpu" + ":0").strip()  # expression-rooted call: must not crash
+    return pol.default_device()
+
+
+def probe(policy):
+    return policy.backend() == "cpu"
+'''
+
+
+def test_cl9_true_positive(tmp_path):
+    pkg = make_pkg(tmp_path, {"osd/topo.py": CL9_TP})
+    got = idents(run_on(pkg), "CL9")
+    assert "grab:ambient-devices" in got
+    assert "grab:ambient-devices:2" in got  # the inline devices() too
+    assert "grab:device-index" in got       # devs[0]
+    assert "grab:device-index:2" in got     # jax.devices()[1]
+    assert "grab:ambient-mesh" in got
+    assert "probe:ambient-backend" in got
+
+
+def test_cl9_true_negative(tmp_path):
+    pkg = make_pkg(tmp_path, {"osd/topo.py": CL9_TN})
+    assert idents(run_on(pkg), "CL9") == set()
+
+
+def test_cl9_policy_module_is_allowlisted(tmp_path):
+    # the same ambient probes inside the policy module are the point
+    pkg = make_pkg(tmp_path, {"common/device_policy.py": CL9_TP})
+    assert idents(run_on(pkg), "CL9") == set()
+
+
+def test_cl9_module_scope_and_methods(tmp_path):
+    pkg = make_pkg(tmp_path, {"osd/topo.py": (
+        "import jax\n"
+        "DEVS = jax.devices()\n"
+        "class T:\n"
+        "    def pick(self):\n"
+        "        return jax.default_backend()\n")})
+    got = idents(run_on(pkg), "CL9")
+    assert "<module>:ambient-devices" in got
+    assert "pick:ambient-backend" in got
+
+
+CL9_JIT = '''
+import jax
+from functools import partial
+
+
+def _body(x):
+    return x
+
+
+encode_fast = jax.jit(_body)
+_private = jax.jit(_body)
+
+
+@jax.jit
+def launch(x):
+    return x
+
+
+@partial(jax.jit, static_argnames=())
+def _quiet(x):
+    return x
+'''
+
+
+def test_cl9_public_jit_in_ops_only(tmp_path):
+    pkg = make_pkg(tmp_path, {"ops/kern.py": CL9_JIT})
+    got = idents(run_on(pkg), "CL9")
+    assert got == {"public-jit:encode_fast", "public-jit:launch"}
+    # same file outside the jit dirs: entry-point discipline is an
+    # ops/ contract, not a package-wide one
+    pkg2 = make_pkg(tmp_path / "other", {"tools/kern.py": CL9_JIT})
+    assert idents(run_on(pkg2), "CL9") == set()
+
+
+CL9_DONATE = '''
+import jax
+
+
+def _body(x, y):
+    return x + y
+
+
+_enc = jax.jit(_body, donate_argnums=(0,))
+'''
+
+
+def test_cl9_donation_needs_the_pool_seam(tmp_path):
+    pkg = make_pkg(tmp_path, {"ops/don.py": CL9_DONATE})
+    assert "<module>:donate" in idents(run_on(pkg), "CL9")
+    # referencing the pool seam (the bitplane pattern: donation routed
+    # through device_pool buffers) clears it
+    pooled = CL9_DONATE + (
+        "\nfrom .device_pool import donation_supported  # noqa: F401\n")
+    pkg2 = make_pkg(tmp_path / "p", {"ops/don.py": pooled})
+    assert idents(run_on(pkg2), "CL9") == set()
+
+
+# -- CL10: sharding propagation ---------------------------------------------
+
+CL10_TP = '''
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def mixed(mesh, x, y):
+    col = NamedSharding(mesh, P(None, "len"))
+    row = NamedSharding(mesh, P("row", None))
+    a = jax.device_put(x, col)
+    b = jax.device_put(y, row)
+    c = a + b
+    return np.asarray(a)
+
+
+def contract(mesh, x, w):
+    row = NamedSharding(mesh, P("row", None))
+    a = jax.device_put(x, row)
+    return w @ a
+
+
+def _body(x):
+    return x
+
+
+def donated(mesh, x):
+    col = NamedSharding(mesh, P(None, "len"))
+    rep = NamedSharding(mesh, P(None, None))
+    f = jax.jit(_body, donate_argnums=(0,), out_shardings=rep)
+    a = jax.device_put(x, col)
+    return f(a)
+'''
+
+CL10_TN = '''
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def aligned(mesh, x, y):
+    col = NamedSharding(mesh, P(None, "len"))
+    a = jax.device_put(x, col)
+    b = jax.device_put(y, col)
+    c = a + b                      # same placement: local math
+    d = jnp.reshape(c, (-1,))      # reshape forgets to Unknown
+    return np.asarray(y)           # host trip on an UNSHARDED value
+
+
+def contract_ok(mesh, x, w):
+    col = NamedSharding(mesh, P(None, "len"))
+    a = jax.device_put(x, col)     # partitioned on the SURVIVING dim
+    return w @ a
+
+
+def _body(x):
+    return x
+
+
+def donated_ok(mesh, x):
+    col = NamedSharding(mesh, P(None, "len"))
+    f = jax.jit(_body, donate_argnums=(0,), out_shardings=col)
+    a = jax.device_put(x, col)
+    return f(a)
+'''
+
+
+def test_cl10_true_positive(tmp_path):
+    pkg = make_pkg(tmp_path, {"parallel/shard.py": CL10_TP})
+    got = idents(run_on(pkg), "CL10")
+    assert "mixed:reshard" in got
+    assert "mixed:sharded-host-trip" in got
+    assert "contract:contract-shard" in got
+    assert "donated:donate-mismatch" in got
+
+
+def test_cl10_true_negative(tmp_path):
+    pkg = make_pkg(tmp_path, {"parallel/shard.py": CL10_TN})
+    assert idents(run_on(pkg), "CL10") == set()
+
+
+def test_cl10_only_in_sharding_dirs(tmp_path):
+    # unknown-placement code (no sharding literals) elsewhere is silent,
+    # and the check does not even walk non-sharding dirs
+    pkg = make_pkg(tmp_path, {"osd/shard.py": CL10_TP})
+    assert idents(run_on(pkg), "CL10") == set()
+
+
+def test_cl10_unknown_placement_is_quiet(tmp_path):
+    pkg = make_pkg(tmp_path, {"parallel/plain.py": (
+        "import numpy as np\n"
+        "def f(x, y):\n"
+        "    return np.asarray(x + y)\n")})
+    assert idents(run_on(pkg), "CL10") == set()
+
+
+# -- suppression layers -----------------------------------------------------
+
+def test_cl9_noqa_suppresses(tmp_path):
+    src = CL9_TP.replace("    devs = jax.devices()\n",
+                         "    devs = jax.devices()  # noqa: CL9 fixture\n")
+    pkg = make_pkg(tmp_path, {"osd/topo.py": src})
+    report = run_on(pkg)
+    assert "grab:ambient-devices" not in idents(report, "CL9")
+    assert any(f.ident == "grab:ambient-devices" for f in report.noqa)
+
+
+def test_cl9_baseline_round_trip_and_stale(tmp_path):
+    pkg = make_pkg(tmp_path, {"osd/topo.py": (
+        "import jax\n"
+        "def probe():\n"
+        "    return jax.default_backend()\n")})
+    report = run_on(pkg)
+    assert idents(report, "CL9") == {"probe:ambient-backend"}
+
+    base = pkg / "qa" / "analyzer" / "baseline.toml"
+    base.parent.mkdir(parents=True)
+    base.write_text(format_baseline(report.findings, reason="fixture"))
+    report2 = run_on(pkg)
+    assert report2.clean
+    assert [f.ident for f in report2.baselined] == ["probe:ambient-backend"]
+
+    # pay the debt: the entry goes stale and the gate (exit 1) says so
+    (pkg / "osd" / "topo.py").write_text(
+        "def probe(policy):\n    return policy.backend()\n")
+    report3 = run_on(pkg)
+    assert report3.clean
+    assert [e["ident"] for e in report3.stale_baseline] == \
+        ["probe:ambient-backend"]
+    assert analyzer_main([str(pkg)]) == 1
+    # --checks without CL9 leaves the entry unjudged, not stale
+    assert analyzer_main([str(pkg), "--checks", "CL1"]) == 0
+
+
+def test_cli_accepts_new_checks(tmp_path):
+    pkg = make_pkg(tmp_path, {"osd/topo.py": CL9_TP})
+    assert analyzer_main([str(pkg), "--checks", "CL9,CL10"]) == 1
+    assert analyzer_main([str(pkg), "--checks", "CL10"]) == 0
+
+
+# -- the tier-1 gate --------------------------------------------------------
+
+@functools.lru_cache(maxsize=1)
+def _topo_scan():
+    cfg = Config.discover([str(REPO / "ceph_tpu")])
+    cfg.checks = ("CL9", "CL10")
+    return cfg, run(cfg)
+
+
+def test_package_topology_clean():
+    """`python -m ceph_tpu.qa.analyzer --checks CL9,CL10 ceph_tpu/`
+    exits 0: the DevicePolicy refactor drove ambient-topology usage to
+    zero and every deliberate site carries a reasoned suppression.  A
+    new finding means: route through the policy, or justify the
+    ambient touch."""
+    _cfg, report = _topo_scan()
+    assert report.clean, "\n" + report.render_text()
+    assert not report.stale_baseline, report.render_text()
+
+
+def test_policy_module_is_the_allowlist():
+    cfg, _report = _topo_scan()
+    assert cfg.cl9_policy_modules == ("common/device_policy.py",)
+    assert (REPO / "ceph_tpu" / "common" / "device_policy.py").exists()
