@@ -695,6 +695,319 @@ def run_scenario(
     }
 
 
+def run_read_traffic(
+    mode: str,
+    n_clients: int = 32,
+    seconds: float = 3.0,
+    read_size: int = 4096,
+    k: int = 4,
+    m: int = 2,
+    window_ms: float = 2.0,
+    max_ops: int = 64,
+    max_bytes: int = 8 << 20,
+    qd: int = 4,
+    warmup: float = 0.25,
+    lose: int = 1,
+) -> dict:
+    """The READ-side twin of `run_traffic`: N closed-loop degraded
+    readers against the production ``ReadBatcher`` decode seam
+    (osd/read_batcher.py) — each op is one stripe's survivor stack
+    multiplied through the codec's cached decode matrix, i.e. exactly
+    the work a degraded GET costs the primary after its chunk gather.
+    ``batched`` coalesces every concurrent op's stack into one pooled
+    ``apply_matrix_jax`` dispatch per flush; ``perop`` runs the same
+    submits with coalescing off (osd_read_batch_window_ms=0), today's
+    one-dispatch-per-read path.  The ratio is the read_smoke gate."""
+    from ..common.context import CephContext
+    from ..ec.registry import ErasureCodePluginRegistry
+    from ..ops.bitplane import apply_matrix_jax
+    from ..osd.read_batcher import ReadBatcher
+
+    assert mode in ("batched", "perop"), mode
+    codec = ErasureCodePluginRegistry.instance().factory(
+        {"plugin": "jax", "k": str(k), "m": str(m)})
+    L = codec.get_chunk_size(read_size)
+    rng = np.random.default_rng(4321)
+    rows = tuple(r for r in range(k + m) if r != lose)[:k]
+    dm, dm_key = codec._jax_codec._decode_entry(rows)
+    # a pool of distinct degraded stripes (survivor stacks) per client
+    stacks = []
+    for _ in range(8):
+        x = rng.integers(0, 256, (k, L), dtype=np.uint8)
+        parity = np.asarray(codec.encode_chunks(x), np.uint8)
+        stacks.append(np.ascontiguousarray(
+            np.vstack([x, parity])[list(rows)]))
+    ename = f"client.readtraffic-{mode}"
+    cct = CephContext(ename, overrides={
+        "osd_read_batch_window_ms": window_ms if mode == "batched" else 0.0,
+        "osd_read_batch_max_ops": max_ops,
+        "osd_read_batch_max_bytes": max_bytes,
+    })
+    batcher = ReadBatcher(cct, io=None, entity=ename)
+    batcher.start()
+    np.asarray(apply_matrix_jax(dm, stacks[0]))  # compile/warm the kernel
+
+    stop_at = [0.0]
+    start_gate = threading.Event()
+    lats: list[list[float]] = [[] for _ in range(n_clients)]
+
+    def client(i: int) -> None:
+        from collections import deque
+
+        my = lats[i]
+        inflight: deque = deque()
+        n = 0
+        start_gate.wait(timeout=30.0)
+        while time.monotonic() < stop_at[0]:
+            while len(inflight) < qd and time.monotonic() < stop_at[0]:
+                x = stacks[(i + n) % len(stacks)]
+                n += 1
+                inflight.append(
+                    (time.perf_counter(),
+                     batcher.decode_submit(dm, x, dm_key)))
+            if not inflight:
+                break
+            t0, p = inflight.popleft()
+            batcher.decode_wait(p)
+            my.append(time.perf_counter() - t0)
+        while inflight:
+            t0, p = inflight.popleft()
+            batcher.decode_wait(p)
+            my.append(time.perf_counter() - t0)
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True,
+                         name=f"readtraffic-{i}")
+        for i in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    stop_at[0] = time.monotonic() + warmup + seconds
+    start_gate.set()
+    time.sleep(warmup)
+    for lat in lats:
+        lat.clear()
+    t_begin = time.monotonic()
+    for t in threads:
+        t.join(timeout=seconds + 30.0)
+    elapsed = time.monotonic() - t_begin
+    batcher.stop()
+
+    all_lats = sorted(x for lat in lats for x in lat)
+    n_ops = len(all_lats)
+    p50, p99 = _pctiles(all_lats)
+    stats = batcher.stats()
+    op_bytes = k * L  # decoded data bytes delivered per read
+    out = {
+        "mode": mode,
+        "clients": n_clients,
+        "read_size": read_size,
+        "rs": f"{k}+{m}",
+        "seconds": round(elapsed, 3),
+        "ops": n_ops,
+        "gibps": round(n_ops * op_bytes / max(elapsed, 1e-9) / 2**30, 4),
+        "p50_ms": round(p50 * 1e3, 3) if p50 is not None else None,
+        "p99_ms": round(p99 * 1e3, 3) if p99 is not None else None,
+        "flushes": stats["flushes"],
+        "ops_per_flush": round(stats["ops"] / stats["flushes"], 2)
+        if stats["flushes"] else None,
+        "decode_groups": stats["decode_groups"],
+    }
+    out["per_client"], out["fairness_ratio"] = per_client_stats(lats)
+    return out
+
+
+def run_read_scenario(
+    n_clients: int = 32,
+    seconds: float = 3.0,
+    read_size: int = 4096,
+    k: int = 4,
+    m: int = 2,
+    window_ms: float = 2.0,
+    max_ops: int = 64,
+    max_bytes: int = 8 << 20,
+    qd: int = 4,
+) -> dict:
+    """Both read modes + the headline ratio, flat keys (the read-side
+    mirror of `run_scenario`; read_smoke's >=3x gate reads these)."""
+    perop = run_read_traffic("perop", n_clients, seconds, read_size, k, m,
+                             window_ms, max_ops, max_bytes, qd)
+    batched = run_read_traffic("batched", n_clients, seconds, read_size,
+                               k, m, window_ms, max_ops, max_bytes, qd)
+    speedup = (round(batched["gibps"] / perop["gibps"], 2)
+               if perop["gibps"] else None)
+    return {
+        "read_clients": n_clients,
+        "read_qd": qd,
+        "read_size": read_size,
+        "read_rs": f"{k}+{m}",
+        "read_batched_gibps": batched["gibps"],
+        "read_perop_gibps": perop["gibps"],
+        "read_batch_speedup": speedup,
+        "read_batched_p99_ms": batched["p99_ms"],
+        "read_perop_p99_ms": perop["p99_ms"],
+        "read_batched_p50_ms": batched["p50_ms"],
+        "read_perop_p50_ms": perop["p50_ms"],
+        "read_ops_per_flush": batched["ops_per_flush"],
+        "read_batched_ops": batched["ops"],
+        "read_perop_ops": perop["ops"],
+    }
+
+
+def run_cluster_read_traffic(
+    n_clients: int = 4,
+    seconds: float = 2.0,
+    read_size: int = 4096,
+    k: int = 2,
+    m: int = 1,
+    n_osds: int | None = None,
+    scenario: str = "get",
+    degraded: bool = False,
+    mixed: bool = False,
+    working_set: int = 8,
+    conf_overrides: dict | None = None,
+) -> dict:
+    """Closed-loop READERS against a real LocalCluster EC pool — the
+    full client -> primary -> gather [-> decode] -> reply path.
+
+    ``scenario``: "get" (GET-heavy: every client hammers one shared hot
+    working set — the repeat-read workload the hot-object cache and the
+    batcher's fan-out coalescing serve) or "boot" (boot storm: each
+    client cold-sweeps its OWN object set in order, the RBD
+    many-images-at-once pattern — almost no re-reads, so it measures
+    pure gather coalescing).  ``mixed`` interleaves one write_full per
+    four ops (cache-invalidation pressure: the cache must never serve
+    the pre-write bytes).  ``degraded`` kills one OSD after the preload
+    (n_osds defaults to k+m so EVERY read must decode) — the p99 here
+    is the read_smoke degraded bar.  Every read is verified against the
+    expected payload; ``mismatches`` must stay 0."""
+    from ..qa.vstart import LocalCluster
+
+    assert scenario in ("get", "boot"), scenario
+    if n_osds is None:
+        n_osds = k + m if degraded else k + m + 1
+    overrides = {"osd_subop_reply_timeout": 1.5,
+                 **(conf_overrides or {})}
+    lats: list[list[float]] = [[] for _ in range(n_clients)]
+    mismatches = [0] * n_clients
+    write_ops = [0] * n_clients
+    stop_at = [0.0]
+    start_gate = threading.Event()
+    warm_gate = threading.Barrier(n_clients + 1)
+
+    with LocalCluster(n_mons=1, n_osds=n_osds,
+                      conf_overrides=overrides) as cluster:
+        cluster.create_ec_pool("readtraffic", k=k, m=m, pg_num=8)
+        client = cluster.client()
+        ios = [client.open_ioctx("readtraffic") for _ in range(n_clients)]
+        payloads: dict[str, bytes] = {}
+        if scenario == "get":
+            oids = [f"hot-{j}" for j in range(working_set)]
+            for j, oid in enumerate(oids):
+                payloads[oid] = bytes([j % 251]) * read_size
+                ios[0].write_full(oid, payloads[oid])
+            per_client_oids = [oids] * n_clients
+        else:
+            per_client_oids = []
+            for i in range(n_clients):
+                mine = [f"img{i}-{j}" for j in range(working_set)]
+                for j, oid in enumerate(mine):
+                    payloads[oid] = bytes([(i * 17 + j) % 251]) * read_size
+                    ios[i].write_full(oid, payloads[oid])
+                per_client_oids.append(mine)
+        if degraded:
+            # drop one OSD and push the map change: with n_osds == k+m
+            # there is no spare to backfill onto, so every PG keeps a
+            # missing shard and every read takes the decode path (the
+            # primaries the victim held move to survivors)
+            victim = sorted(cluster.osds)[-1]
+            cluster.kill_osd(victim)
+            cluster.mark_osd_down_out(victim)
+
+        def reader(i: int) -> None:
+            io = ios[i]
+            mine = per_client_oids[i]
+            my = lats[i]
+            n = 0
+            try:
+                io.read(mine[i % len(mine)])  # warm, untimed
+            except Exception as e:
+                print(f"# read traffic: client {i} warm read failed: "
+                      f"{e!r}", file=sys.stderr)
+            finally:
+                try:
+                    warm_gate.wait(timeout=30.0)
+                except threading.BrokenBarrierError:
+                    pass
+            start_gate.wait(timeout=30.0)
+            while time.monotonic() < stop_at[0]:
+                oid = mine[(i + n) % len(mine)]
+                n += 1
+                if mixed and n % 4 == 0:
+                    io.write_full(oid, payloads[oid])
+                    write_ops[i] += 1
+                    continue
+                t0 = time.perf_counter()
+                got = io.read(oid)
+                my.append(time.perf_counter() - t0)
+                if got != payloads[oid]:
+                    mismatches[i] += 1
+
+        threads = [
+            threading.Thread(target=reader, args=(i,), daemon=True,
+                             name=f"readtraffic-cluster-{i}")
+            for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            warm_gate.wait(timeout=60.0)
+        except threading.BrokenBarrierError:
+            pass
+        stop_at[0] = time.monotonic() + seconds
+        t_begin = time.monotonic()
+        start_gate.set()
+        for t in threads:
+            t.join(timeout=seconds + 60.0)
+        elapsed = time.monotonic() - t_begin
+        rb = {"flushes": 0, "ops": 0, "inline": 0, "fanouts": 0}
+        rc_hits = rc_misses = rc_inserts = 0
+        for o in cluster.osds.values():
+            s = o.read_batcher.stats()
+            for key in rb:
+                rb[key] += s[key]
+            cs = o.read_cache.stats()
+            rc_hits += cs["hits"]
+            rc_misses += cs["misses"]
+            rc_inserts += cs["inserts"]
+
+    all_lats = sorted(x for lat in lats for x in lat)
+    n_ops = len(all_lats)
+    p50, p99 = _pctiles(all_lats)
+    out = {
+        "mode": "cluster-read",
+        "scenario": scenario,
+        "degraded": degraded,
+        "mixed": mixed,
+        "clients": n_clients,
+        "read_size": read_size,
+        "rs": f"{k}+{m}",
+        "seconds": round(elapsed, 3),
+        "ops": n_ops,
+        "ops_per_s": round(n_ops / max(elapsed, 1e-9), 1),
+        "gibps": round(n_ops * read_size / max(elapsed, 1e-9) / 2**30, 5),
+        "p50_ms": round(p50 * 1e3, 3) if p50 is not None else None,
+        "p99_ms": round(p99 * 1e3, 3) if p99 is not None else None,
+        "mismatches": sum(mismatches),
+        "write_ops": sum(write_ops),
+        "read_batcher": rb,
+        "read_cache": {"hits": rc_hits, "misses": rc_misses,
+                       "inserts": rc_inserts},
+    }
+    out["per_client"], out["fairness_ratio"] = per_client_stats(lats)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="sustained small-write traffic: batched vs per-op "
@@ -730,6 +1043,21 @@ def main(argv=None) -> int:
     ap.add_argument("--qos", action="store_true",
                     help="with --bully: per-client mClock classes + "
                     "batcher share + live QoS controller")
+    ap.add_argument("--reads", action="store_true",
+                    help="READ-side traffic: batched vs per-op degraded "
+                    "decode through the ReadBatcher (with --cluster: "
+                    "real GET traffic against a LocalCluster pool)")
+    ap.add_argument("--scenario", choices=("get", "boot"), default="get",
+                    help="with --reads --cluster: GET-heavy shared "
+                    "working set (default) or per-client boot storm")
+    ap.add_argument("--degraded", action="store_true",
+                    help="with --reads --cluster: kill one OSD after "
+                    "preload so every read decodes (no spare to "
+                    "backfill onto)")
+    ap.add_argument("--mixed", action="store_true",
+                    help="with --reads --cluster: interleave one "
+                    "write_full per four reads (cache-invalidation "
+                    "pressure); implies --cluster")
     ap.add_argument("--sampling", type=float, default=0.0,
                     help="cephtrace head-sampling rate (0 = tracing "
                     "off); >0 adds a per-stage p50/p99 breakdown")
@@ -764,6 +1092,31 @@ def main(argv=None) -> int:
         args.k = 2 if (args.cluster or args.bully) else 8
     if args.m is None:
         args.m = 1 if (args.cluster or args.bully) else 4
+    if args.reads:
+        if args.cluster or args.mixed or args.degraded:
+            res = run_cluster_read_traffic(
+                max(1, args.clients), args.seconds, args.write_size,
+                args.k, args.m, scenario=args.scenario,
+                degraded=args.degraded, mixed=args.mixed)
+        else:
+            res = run_read_scenario(args.clients, args.seconds,
+                                    args.write_size, qd=args.qd,
+                                    window_ms=args.window_ms,
+                                    max_bytes=args.max_bytes)
+        if args.json:
+            print(json.dumps(res))
+        else:
+            for key in sorted(res):
+                print(f"{key}: {res[key]}")
+        if args.smoke:
+            ratio = res.get("read_batch_speedup")
+            if ratio is None or ratio < 1.0:
+                print(f"# read traffic smoke FAILED: batched/per-op "
+                      f"ratio {ratio} < 1.0", file=sys.stderr)
+                return 1
+            print(f"# read traffic smoke OK: batched/per-op ratio "
+                  f"{ratio}", file=sys.stderr)
+        return 0
     if args.trace_smoke:
         res, rc = trace_smoke(args.clients, args.seconds,
                               trace_out=args.trace_out)

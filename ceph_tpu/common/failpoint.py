@@ -428,6 +428,7 @@ KNOWN_FAILPOINTS = frozenset({
     "osd.dispatch",
     "osd.ec.shard_read",
     "osd.write_batcher.flush",
+    "osd.read_batcher.gather",
     "osd.recovery.push",
     "osd.recovery.pull",
     "osd.recovery.tick",

@@ -163,6 +163,16 @@ class IOAccounting:
             _hist_add(self._entry_locked(client, pool).hists[stage],
                       seconds)
 
+    def reads_of(self, client: str, pool) -> int:
+        """Accumulated read-op count for one (client, pool) identity —
+        the cephread hot-object cache's promotion signal (an identity
+        folded into `_other_` reads 0: an evicted row was, by
+        construction, not a heavy hitter).  Does NOT touch LRU order:
+        a promotion probe is not traffic."""
+        with self._lock:
+            e = self._entries.get((str(client), str(pool)))
+            return e.ops_r if e is not None else 0
+
     # -- introspection -----------------------------------------------------
     def totals(self) -> dict:
         """Aggregate across every entry INCLUDING `_other_` — the

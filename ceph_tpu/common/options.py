@@ -410,6 +410,37 @@ def default_options() -> OptionTable:
                    "admission (osd/write_batcher.py; docs/qos.md).  "
                    ">= 1.0 disables the per-client share",
                    min=0.01, runtime=True),
+            Option("osd_read_batch_window_ms", float, 2.0,
+                   "cephread: max milliseconds the READ batcher holds a "
+                   "gather/decode batch open waiting for more ops (the "
+                   "absolute coalescing timer; an inter-arrival gap of "
+                   "window/8 flushes early once arrivals stop).  0 "
+                   "disables coalescing: every read gathers and decodes "
+                   "inline (osd/read_batcher.py; docs/read_path.md)",
+                   min=0.0, runtime=True),
+            Option("osd_read_batch_max_ops", int, 64,
+                   "read ops that flush a gather batch immediately (size "
+                   "cap of the read batcher's coalescing window)",
+                   min=1, runtime=True),
+            Option("osd_read_batch_max_bytes", int, 8 << 20,
+                   "estimated gather + decode bytes per coalesced read "
+                   "flush; also sizes the read batcher's admission "
+                   "throttle (4x this) — the backpressure that blocks op "
+                   "threads when the read plane falls behind.  0 = "
+                   "unbounded", min=0, runtime=True),
+            Option("osd_read_cache_bytes", int, 0,
+                   "cephread: byte bound on the primary's hot-object "
+                   "read cache (osd/read_cache.py — LRU, invalidated by "
+                   "the write path's version bump and validated against "
+                   "the pg log's newest object version on every hit).  "
+                   "0 disables the cache", min=0, runtime=True),
+            Option("osd_read_cache_promote_ops", int, 8,
+                   "cephmeter-driven promotion threshold: an object is "
+                   "cached only when its reading (client,pool) identity "
+                   "has at least this many accumulated read ops in the "
+                   "per-client accounting table (the heavy-hitter rows) "
+                   "— a cold scan never churns the cache.  0 promotes "
+                   "every full-object read", min=0, runtime=True),
             Option("ec_device_pool", bool, True,
                    "cephdma: device-resident stripe-buffer pool + fully "
                    "async encode path (ops/device_pool.py; "

@@ -92,6 +92,8 @@ from .primary_ops import PrimaryOpsMixin
 from .recovery import RecoveryMixin
 from .replicated_backend import ReplicatedBackendMixin
 from .scrub import ScrubMixin
+from .read_batcher import ReadBatcher
+from .read_cache import ReadCache
 from .split_migration import SplitMigrationMixin
 from .subops import SubOpsMixin
 from .tiering import TieringMixin
@@ -274,6 +276,32 @@ class OSD(
                              "stripes encoded inline (coalescing off)")
             .add_time_avg("ec_batch_flush_latency",
                           "coalesced flush latency")
+            # cephread: the coalesced READ plane (osd/read_batcher.py)
+            # and the primary's hot-object cache (osd/read_cache.py);
+            # rides the same perf dump -> MMgrReport -> prometheus
+            # pipeline as the write-batcher series
+            .add_u64_counter("read_batcher_flushes",
+                             "coalesced read batches flushed")
+            .add_u64_counter("read_batcher_ops",
+                             "gather/decode ops through the read batcher")
+            .add_u64_counter("read_batcher_bytes",
+                             "bytes gathered/decoded through the read "
+                             "batcher")
+            .add_u64_counter("read_batcher_inline",
+                             "read ops served inline (coalescing off)")
+            .add_time_avg("read_batcher_flush_latency",
+                          "coalesced read-flush latency")
+            .add_u64_counter("read_cache_hits",
+                             "hot-object cache hits")
+            .add_u64_counter("read_cache_misses",
+                             "hot-object cache misses")
+            .add_u64_counter("read_cache_inserts",
+                             "objects promoted into the read cache")
+            .add_u64_counter("read_cache_evictions",
+                             "read-cache LRU evictions (byte bound)")
+            .add_u64_counter("read_cache_invalidations",
+                             "read-cache entries dropped by write-path "
+                             "version bumps")
             # per-stage latency histograms (cephtrace aggregation;
             # log2 buckets, reference: PerfHistogram).  Names match the
             # span taxonomy in common/tracer.py OP_STAGES exactly.
@@ -288,6 +316,15 @@ class OSD(
                                 "sub-op fan-out to last shard ack")
             .add_time_histogram("stage_commit",
                                 "local object-store commit")
+            # cephread client-plane stages (the PR-9 trace tree grows
+            # read-side spans): gather = chunk fan-out wall time,
+            # decode = degraded reconstruct (ranged or full)
+            .add_time_histogram("stage_read_gather",
+                                "read chunk gather (batched fan-out or "
+                                "per-op)")
+            .add_time_histogram("stage_read_decode",
+                                "degraded-read decode (ranged window "
+                                "or full stripe)")
             # background-plane stage histograms (cephheal): names match
             # tracer.BG_STAGES / the recovery and scrub span taxonomy
             # verbatim, like stage_* matches OP_STAGES
@@ -341,6 +378,17 @@ class OSD(
         # write path; osd/write_batcher.py, docs/write_path.md)
         self.write_batcher = WriteBatcher(cct, logger=self.logger,
                                           entity=self.whoami)
+        # cephread: the coalescing gather/decode layer behind _ec_read
+        # (osd/read_batcher.py; this OSD is its transport adapter via
+        # ECBackendMixin's rb_* methods) plus the primary's hot-object
+        # cache (osd/read_cache.py, byte-bounded, runtime-resizable)
+        self.read_batcher = ReadBatcher(cct, io=self, logger=self.logger,
+                                        entity=self.whoami)
+        self.read_cache = ReadCache(
+            int(cct.conf.get("osd_read_cache_bytes")), logger=self.logger)
+        cct.conf.add_observer(
+            ["osd_read_cache_bytes"],
+            lambda _n, v: self.read_cache.set_max_bytes(int(v)))
         # in-flight + historic op tracking (reference: OSD's OpTracker;
         # src/common/TrackedOp.cc — serves dump_ops_in_flight /
         # dump_historic_ops on the admin socket and feeds the SLOW_OPS
@@ -451,6 +499,7 @@ class OSD(
             ["ec_device_pool"],
             lambda _n, v: POOL.configure(enabled=bool(v)))
         self.write_batcher.start()
+        self.read_batcher.start()
         # backend health sentinel (common/kernel_telemetry.py): policy
         # built from THIS daemon's conf and constructor-injected — the
         # sentinel itself is process-wide (kernel dispatch is), refs
@@ -552,6 +601,7 @@ class OSD(
         # drain-and-stop the coalescer first: queued stripes flush (their
         # ops complete or fail normally) before the messenger goes away
         self.write_batcher.stop()
+        self.read_batcher.stop()
         self._recovery_wakeup.set()
         self.mc.shutdown()
         self.messenger.shutdown()

@@ -14,7 +14,7 @@ import numpy as np
 
 from ..common.crc32c import crc32c
 from ..common.failpoint import FailpointCrash, FailpointError, failpoint
-from ..common.tracer import TRACER, trace_now
+from ..common.tracer import TRACER, op_trace, trace_now
 from ..store.object_store import NotFound, Transaction
 from .messages import (
     MECSubOpRead,
@@ -253,6 +253,44 @@ class ECBackendMixin:
         b = unpack_data(rep.data) or b""
         return (b, rep.ver) if len(b) == ln else (None, None)
 
+    def _rb_fetch_ranges(self, pg, acting, my_shard: int, oid: str,
+                         wants: list[tuple[int, int, int]]):
+        """Coalesced `_fetch_shard_range` for many shards at once:
+        {shard: (bytes, ver) | (None, None)} via one read-batcher
+        gather, or None when the batcher is absent/not coalescing/
+        failed (caller falls back to the per-shard path).  Same
+        contract as `_fetch_shard_range`: a short or missing range is
+        (None, None)."""
+        rb = getattr(self, "read_batcher", None)
+        if not wants or rb is None or not rb.coalescing():
+            return None
+        from .read_batcher import ReadReq
+
+        reqs = [ReadReq(j, oid, o, ln) for j, o, ln in wants]
+        try:
+            res = rb.gather(pg.pgid, acting, reqs,
+                            est_bytes=sum(ln for _, _, ln in wants))
+        except Exception as e:
+            self.cct.dout("osd", 1,
+                          f"{self.whoami} batched range fetch failed, "
+                          f"per-shard fallback: {e!r}")
+            return None
+        out: dict[int, tuple] = {}
+        for i, (j, _o, ln) in enumerate(wants):
+            row = res.get(i)
+            if row is None or row[0] is None or len(row[0]) != ln:
+                out[j] = (None, None)
+            else:
+                out[j] = (row[0], row[1])
+        return out
+
+    def _read_cache_invalidate(self, pgid, oid: str) -> None:
+        """cephread write-path hook: drop the hot-object cache entry a
+        mutation just superseded (the version-bump invalidation)."""
+        rc = getattr(self, "read_cache", None)
+        if rc is not None:
+            rc.invalidate((pgid, oid))
+
     def _stored_ver(self, cid: str, oid: str) -> int | None:
         """Per-object version xattr (object_info_t analog); None =
         unversioned (legacy object or backfill-pushed wildcard)."""
@@ -401,13 +439,24 @@ class ECBackendMixin:
         c1 = max(o + len(b) for o, b in segs.values())
         w = c1 - c0
         old: dict[int, bytes] = {}
+        # cephread: the remote old-byte fetches ride the read batcher
+        # when it is coalescing — concurrent RMWs' ranged reads fuse
+        # into the flush's single sub-op fan-out (historically this
+        # loop paid one round trip PER SHARD PER OP)
+        batched = self._rb_fetch_ranges(
+            pg, acting, my_shard, msg.oid,
+            [(j, o, len(b)) for j, (o, b) in segs.items() if j != my_shard],
+        )
         for j, (o, b) in segs.items():
             if j == my_shard:
                 old[j] = bytes(my_chunk[o:o + len(b)])
                 continue
-            ob, over = self._fetch_shard_range(
-                pg, acting, j, msg.oid, o, len(b)
-            )
+            if batched is not None:
+                ob, over = batched.get(j, (None, None))
+            else:
+                ob, over = self._fetch_shard_range(
+                    pg, acting, j, msg.oid, o, len(b)
+                )
             if ob is None or over != my_ver:
                 # unreachable, or the holder is a STALE generation whose
                 # old bytes would poison the parity delta (the retry-
@@ -486,6 +535,7 @@ class ECBackendMixin:
         self._log_txn(t, cid, pg, entry)
         t_c0 = trace_now()
         self.store.queue_transaction(t)
+        self._read_cache_invalidate(pg.pgid, msg.oid)
         self._op_stage("commit", t_c0, trace_now(), version=version)
         a, deposed, failed = self._collect_subop_acks(tids, acting)
         self._op_stage("subop", t_sub0, trace_now(), span=sub_span,
@@ -542,6 +592,7 @@ class ECBackendMixin:
             pass
         self._log_txn(t, cid, pg, entry)
         self.store.queue_transaction(t)
+        self._read_cache_invalidate(pg.pgid, msg.oid)
         for tid in tids:
             self._wait_reply(tid)
         return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
@@ -820,10 +871,216 @@ class ECBackendMixin:
             found.update(hit)
         return found
 
+    # .. cephread: the read batcher's transport/store adapter ..............
+    # (osd/read_batcher.py drives these from its flusher thread; bench
+    # and tests substitute a local fake with the same surface)
+    def rb_local_osd(self) -> int:
+        return self.id
+
+    def rb_is_up(self, osd: int) -> bool:
+        return self.osdmap.is_up(osd)
+
+    def rb_epoch(self) -> int:
+        return self.my_epoch()
+
+    def rb_reply_timeout(self) -> float:
+        return float(self.cct.conf.get("osd_subop_reply_timeout"))
+
+    def rb_read_local(self, pgid, shard: int, oid: str, off, ln):
+        """Serve one batched descriptor from the local store: (bytes,
+        ver, size) or None.  Full-chunk reads pass the same
+        ``osd.ec.shard_read`` injection surface and hinfo CRC verify as
+        `_gather_chunks`' local branch; ranged reads match
+        `_fetch_shard_range`'s local branch (plain length-checked store
+        read)."""
+        cid = self._cid(pgid, shard)
+        if off is not None:
+            try:
+                b = self.store.read(cid, oid, off, ln)
+            except (NotFound, KeyError):
+                return None
+            if len(b) != ln:
+                return None
+            return bytes(b), self._stored_ver(cid, oid), None
+        try:
+            failpoint("osd.ec.shard_read", cct=self.cct,
+                      entity=self.whoami, pgid=pgid, shard=shard, oid=oid)
+            chunk = self.store.read(cid, oid)
+        except FailpointCrash:
+            raise
+        except (FailpointError, NotFound, KeyError):
+            return None
+        try:
+            stored = int(self.store.getattr(cid, oid, "hinfo"))
+        except (NotFound, KeyError, ValueError):
+            stored = None
+        if stored is not None and crc32c(chunk) != stored:
+            self.cct.dout(
+                "osd", 0,
+                f"{self.whoami} hinfo mismatch on local read "
+                f"{pgid}/{oid} shard {shard}",
+            )
+            return None
+        try:
+            size = int(self.store.getattr(cid, oid, "size"))
+        except (NotFound, KeyError):
+            size = None
+        return chunk, self._stored_ver(cid, oid), size
+
+    def rb_send_multiread(self, osd: int, pgid, shard: int, reads,
+                          epoch: int):
+        tid = self._next_tid()
+        try:
+            self._conn_to_osd(osd).send_message(
+                MECSubOpRead(tid=tid, pgid=pgid, oid=None, shard=shard,
+                             offsets=None, epoch=epoch, reads=reads)
+            )
+        except (OSError, ConnectionError):
+            return None
+        return tid
+
+    def rb_wait_multireads(self, tids, deadline: float) -> dict:
+        return self._wait_replies(tids, deadline)
+
+    def _rb_gather_data(self, pg, codec, acting, oid: str, want: set[int],
+                        sizes: dict, vers: dict, size_hint):
+        """Coalesced stand-in for `_gather_chunks` over acting data
+        shards (no stray probing — degraded ops take the historical
+        probe path).  Returns the got dict, or None when the batcher is
+        absent/not coalescing/failed, in which case the caller falls
+        back to the per-op fan-out."""
+        rb = getattr(self, "read_batcher", None)
+        if rb is None or not rb.coalescing():
+            return None
+        from .read_batcher import ReadReq
+
+        reqs = [ReadReq(s, oid) for s in sorted(want)]
+        est = len(reqs) * (codec.get_chunk_size(size_hint)
+                           if size_hint else 4096)
+        try:
+            res = rb.gather(pg.pgid, acting, reqs, est_bytes=est)
+        except Exception as e:
+            self.cct.dout("osd", 1,
+                          f"{self.whoami} batched gather failed, per-op "
+                          f"fallback: {e!r}")
+            return None
+        got: dict[int, bytes] = {}
+        for i, r in enumerate(reqs):
+            row = res.get(i)
+            if row is None or row[0] is None:
+                continue
+            got[r.shard] = row[0]
+            vers[r.shard] = row[1]
+            if row[2] is not None:
+                sizes[r.shard] = int(row[2])
+        return got
+
+    # .. cephread: ranged degraded decode ..................................
+    def _ranged_decode_ok(self, codec) -> bool:
+        """Range-limited decode is exact only for plain byte-column-
+        local MDS matrix codes with identity placement (the
+        `_batch_matrix` property, decode-side): sub-chunked codecs
+        (CLAY couples columns across sub-chunk planes) and non-jax
+        referee backends keep the full decode + slice path."""
+        if getattr(codec, "_jax_codec", None) is None:
+            return False
+        try:
+            return bool(codec.supports_parity_delta()) \
+                and codec.get_sub_chunk_count() == 1
+        except (AttributeError, NotImplementedError):
+            return False
+
+    @staticmethod
+    def _read_col_window(msg, k: int, L: int, size: int):
+        """Column window (c0, c1) of every chunk that covers the
+        requested byte range, or None when the request needs the full
+        stripe.  Only a range that lands inside ONE data chunk gets a
+        sub-window (a spanning range's column union is [0, L) anyway:
+        the first chunk contributes a suffix, the next a prefix)."""
+        if not (msg.off or (msg.length or 0) > 0):
+            return None
+        off = msg.off or 0
+        end = min(off + msg.length, size) if msg.length else size
+        if off >= end:
+            return (0, 0)  # empty result: nothing to decode at all
+        c_lo = off // L
+        c_hi = (end - 1) // L
+        if c_lo != c_hi or c_lo >= k:
+            return None
+        return (off % L, (end - 1) % L + 1)
+
+    def _rb_decode_window(self, codec, use: dict, k: int,
+                          c0: int, c1: int):
+        """Decode ONLY columns [c0, c1) of each data chunk through the
+        codec's cached decode matrix: {chunk id: [c1-c0] array}, or None
+        if a full matrix can't be formed.  Rows are the first k
+        available chunks in sorted order — the exact selection
+        `RSCodec.decode_chunks` makes, so the windowed bytes are
+        bit-identical to full-decode-then-slice.  The apply is fused
+        with the flush's other decodes by the read batcher (pooled
+        commit + one dispatch); bit-column locality makes the column
+        slice exact."""
+        rows = tuple(sorted(use))[:k]
+        if len(rows) < k:
+            return None
+        jc = codec._jax_codec
+        dm, dm_key = jc._decode_entry(rows)
+        stack = np.stack([np.asarray(use[r], np.uint8)[c0:c1]
+                          for r in rows])
+        rb = getattr(self, "read_batcher", None)
+        if rb is not None:
+            out = rb.decode(dm, stack, dm_key)
+        else:
+            from ..ops.bitplane import apply_matrix_jax
+            from ..ops.device_pool import POOL
+
+            dev = POOL.put(stack) if POOL.enabled() else stack
+            try:
+                out = np.asarray(  # noqa: CL8 — decoded range serializes straight into the client reply
+                    apply_matrix_jax(dm, dev, mat_key=dm_key),
+                    dtype=np.uint8)
+            finally:
+                if dev is not stack:
+                    POOL.release(dev)
+        return {i: out[i] for i in range(k)}
+
+    # .. cephread: hot-object cache plumbing ...............................
+    def _read_cache_promote(self) -> bool:
+        """cephmeter-driven promotion gate: cache a full-object read
+        only when the requesting (client, pool) identity has accumulated
+        `osd_read_cache_promote_ops` read ops in the per-client
+        accounting table (threshold 0 = promote everything) — a heavy
+        hitter's working set sticks, a cold scan never churns."""
+        thresh = int(self.cct.conf.get("osd_read_cache_promote_ops"))
+        if thresh <= 0:
+            return True
+        st = op_trace()
+        acct = st.get("acct") if st is not None else None
+        if acct is None:
+            return False
+        tab, client, pool = acct
+        return tab.reads_of(client, pool) >= thresh
+
     def _ec_read(self, pg, codec, acting, msg) -> MOSDOpReply:
         k = codec.get_data_chunk_count()
         n = codec.get_chunk_count()
         my_shard = acting.index(self.id) if self.id in acting else -1
+        floor = pg.log.obj_newest.get(msg.oid)
+        cache = getattr(self, "read_cache", None)
+        if cache is not None and cache.enabled():
+            hit = cache.get((pg.pgid, msg.oid), floor)
+            if hit is not None:
+                self.logger.inc("read_cache_hits")
+                obj, size = hit
+                if msg.off or (msg.length or 0) > 0:
+                    off = msg.off or 0
+                    ln = msg.length if msg.length else len(obj) - off
+                    obj = obj[off:off + ln]
+                return MOSDOpReply(tid=msg.tid, retval=0,
+                                   epoch=self.my_epoch(),
+                                   data=pack_data(obj),
+                                   result={"size": size})
+            self.logger.inc("read_cache_misses")
         # size from any shard we can reach (primary's own shard normally)
         size = None
         if my_shard >= 0:
@@ -834,12 +1091,16 @@ class ECBackendMixin:
                 pass
         peer_sizes: dict[int, int] = {}
         vers: dict[int, int | None] = {}
-        floor = pg.log.obj_newest.get(msg.oid)
         want_data = set(range(k))
-        got = self._gather_chunks(
-            pg, codec, acting, msg.oid, want_data, sizes=peer_sizes,
-            vers=vers, floor=floor,
-        )
+        t_g0 = trace_now()
+        got = self._rb_gather_data(pg, codec, acting, msg.oid, want_data,
+                                   peer_sizes, vers, size)
+        if got is None:
+            got = self._gather_chunks(
+                pg, codec, acting, msg.oid, want_data, sizes=peer_sizes,
+                vers=vers, floor=floor,
+            )
+        self._op_stage("read_gather", t_g0, trace_now(), shards=len(got))
 
         got = _current_generation(got, vers, floor)
         missing = want_data - set(got)
@@ -857,35 +1118,56 @@ class ECBackendMixin:
                     tid=msg.tid, retval=-5, epoch=self.my_epoch(),
                     result=f"unreadable: only {len(avail_probe)} chunks",
                 )
+            # zero-copy views over the gathered chunk bytes — the host
+            # copies happen at the pooled decode seam below, not here
             chunks = {
                 s: np.frombuffer(b, dtype=np.uint8)
                 for s, b in avail_probe.items()
             }
+            L = len(next(iter(chunks.values())))
+            size = self._resolve_read_size(size, peer_sizes, vers, k * L)
             need = codec.minimum_to_decode(want_data, set(chunks))
-            dec = codec.decode(
-                want_data, {s: chunks[s] for s in need if s in chunks},
-                len(next(iter(chunks.values()))),
-            )
+            use = {s: chunks[s] for s in need if s in chunks}
+            t_d0 = trace_now()
+            win = self._read_col_window(msg, k, L, size) \
+                if self._ranged_decode_ok(codec) else None
+            if win is not None:
+                # ranged fast path: decode ONLY the requested column
+                # window through the cached decode matrix — the bytes
+                # are identical to full-decode-then-slice, but the
+                # kernel sees k x window instead of k x L bytes
+                c0, c1 = win
+                dec = self._rb_decode_window(codec, use, k, c0, c1) \
+                    if c1 > c0 else {}
+                if dec is not None:
+                    self._op_stage("read_decode", t_d0, trace_now(),
+                                   ranged=True, window=c1 - c0)
+                    off = msg.off or 0
+                    end = min(off + msg.length, size) if msg.length \
+                        else size
+                    obj = b"" if c1 <= c0 else \
+                        np.asarray(dec[off // L], np.uint8)[
+                            :end - off].tobytes()
+                    return MOSDOpReply(tid=msg.tid, retval=0,
+                                       epoch=self.my_epoch(),
+                                       data=pack_data(obj),
+                                       result={"size": size})
+            dec = codec.decode(want_data, use, L)
+            self._op_stage("read_decode", t_d0, trace_now(), ranged=False)
             data = b"".join(
                 np.asarray(dec[i], np.uint8).tobytes() for i in range(k)
             )
         else:
             data = b"".join(got[i] for i in range(k))
-        if size is None and peer_sizes:
-            # prefer a size reported by a current-generation shard — a
-            # stale shard's size xattr predates the newest RMW
-            present = [v for v in vers.values() if v is not None]
-            target = max(present) if present else None
-            good = [
-                sz for s, sz in peer_sizes.items()
-                if target is None or vers.get(s) in (None, target)
-            ]
-            size = good[0] if good else next(iter(peer_sizes.values()))
-        if size is None:
-            # no shard could report a size xattr: the full (padded) stripe
-            # is the best available answer
-            size = len(data)
+        size = self._resolve_read_size(size, peer_sizes, vers, len(data))
         obj = data[:size]
+        if cache is not None and cache.enabled() and not missing \
+                and floor is not None and self._read_cache_promote():
+            # healthy full-object read by a heavy hitter: cache the
+            # assembled object at the PG log's newest version (the
+            # validation stamp every later hit is checked against)
+            cache.put((pg.pgid, msg.oid), floor, obj, size)
+            self.logger.inc("read_cache_inserts")
         if msg.off or (msg.length or 0) > 0:
             off = msg.off or 0
             ln = msg.length if msg.length else len(obj) - off
@@ -893,4 +1175,23 @@ class ECBackendMixin:
         return MOSDOpReply(tid=msg.tid, retval=0, epoch=self.my_epoch(),
                            data=pack_data(obj),
                            result={"size": size})
+
+    @staticmethod
+    def _resolve_read_size(size, peer_sizes: dict, vers: dict,
+                           fallback: int) -> int:
+        """Object size for padding-strip: the primary's own xattr if it
+        had one, else a size reported by a current-generation shard (a
+        stale shard's size xattr predates the newest RMW), else the
+        full padded stripe length."""
+        if size is not None:
+            return size
+        if peer_sizes:
+            present = [v for v in vers.values() if v is not None]
+            target = max(present) if present else None
+            good = [
+                sz for s, sz in peer_sizes.items()
+                if target is None or vers.get(s) in (None, target)
+            ]
+            return good[0] if good else next(iter(peer_sizes.values()))
+        return fallback
 

@@ -121,11 +121,17 @@ class MECSubOpRead(_JsonMessage):
     """Primary → shard OSD: fetch chunk bytes (reference: MOSDECSubOpRead).
     `offsets` carries optional (off, len) sub-chunk ranges (CLAY repair).
     `trace_id`/`parent_span` propagate the cephtrace context for traced
-    reads (RMW old-byte fetches, degraded-read gathers)."""
+    reads (RMW old-byte fetches, degraded-read gathers).
+
+    `reads` (cephread) generalizes the PR-13 multi-range machinery to
+    multiple objects: a list of `[oid, off, ln]` entries (off/ln None =
+    whole chunk) served in one round trip — the read batcher's one
+    sub-op fan-out per flush.  When `reads` is set, `oid`/`offsets` are
+    unused and the reply carries per-entry `results` rows instead."""
 
     MSG_TYPE = 110
     FIELDS = ("tid", "pgid", "oid", "shard", "offsets", "epoch",
-              "trace_id", "parent_span")
+              "trace_id", "parent_span", "reads")
 
 
 @register_message
@@ -134,11 +140,16 @@ class MECSubOpReadReply(_JsonMessage):
     without its own shard copy can still strip stripe padding; `xattrs`
     echoes the user xattrs for the same degraded-primary case.  `ver`
     echoes the stored per-object version xattr (None = unversioned /
-    backfilled-wildcard) so readers can reject stale-generation chunks."""
+    backfilled-wildcard) so readers can reject stale-generation chunks.
+
+    `results` answers a multi-oid `reads` request: one
+    `[retval, data(base64), size, ver]` row per request entry, aligned
+    by index (`oid`/`data`/`size`/`ver` are None on a batched reply —
+    the rows carry everything)."""
 
     MSG_TYPE = 111
     FIELDS = ("tid", "pgid", "oid", "shard", "retval", "data", "size",
-              "xattrs", "ver")
+              "xattrs", "ver", "results")
 
 
 @register_message

@@ -110,6 +110,7 @@ class ObjectOpsMixin:
                 t.setattr(cid, msg.oid, "ver", str(version).encode())
             self._log_txn(t, cid, pg, entry)
             self.store.queue_transaction(t)
+            self._read_cache_invalidate(pg.pgid, msg.oid)
             a, deposed, _f = self._collect_subop_acks(tids)
             acked = 1 + a
         if deposed and (pool is None or acked < pool.min_size):
@@ -249,6 +250,7 @@ class ObjectOpsMixin:
         self._log_txn(t, cid, pg, entry)
         t_c0 = trace_now()
         self.store.queue_transaction(t)
+        self._read_cache_invalidate(pg.pgid, msg.oid)
         self._op_stage("commit", t_c0, trace_now(), version=version)
         a, deposed, failed = self._collect_subop_acks(tids, acting)
         self._op_stage("subop", t_sub0, trace_now(), span=sub_span,

@@ -267,6 +267,11 @@ class SubOpsMixin:
                     # objects it does not hold.  Our stale version makes
                     # the primary's next recovery tick replay the gap.
                 self.store.queue_transaction(t)
+                # cephread belt-and-braces: a replica apply supersedes
+                # any object this daemon cached while IT was primary (a
+                # flapped-back primary's stale entry would otherwise
+                # survive until version validation catches it)
+                self._read_cache_invalidate(msg.pgid, msg.oid)
         except Exception as e:
             self.cct.dout("osd", 0, f"{self.whoami} sub_write failed: {e!r}")
             retval = -5
@@ -283,6 +288,9 @@ class SubOpsMixin:
 
     def _handle_sub_read(self, conn, msg: MECSubOpRead) -> None:
         cid = self._cid(msg.pgid, msg.shard)
+        if getattr(msg, "reads", None):
+            self._handle_sub_read_multi(conn, msg, cid)
+            return
         try:
             # "osd.ec.shard_read" (legacy: osd_debug_inject_read_err) —
             # an error action makes this shard answer EIO, forcing the
@@ -373,6 +381,58 @@ class SubOpsMixin:
             )
         try:
             conn.send_message(reply)
+        except (OSError, ConnectionError):
+            pass
+
+    def _handle_sub_read_multi(self, conn, msg: MECSubOpRead, cid) -> None:
+        """cephread batched branch: serve a `reads=[[oid, off, ln], ...]`
+        list in one reply (the read batcher's one fan-out per flush).
+        Per-entry semantics match the single-oid path exactly — the
+        `osd.ec.shard_read` failpoint fires once per entry (so a
+        thrasher `times(n,error)` spec EIOs n entries, not n batches),
+        the WHOLE chunk's hinfo CRC is verified before any slice is
+        served, and a missing/rotted entry answers its own -2/-5 row
+        without failing siblings."""
+        rows = []
+        for ent in msg.reads:
+            oid, off, ln = ent[0], ent[1], ent[2]
+            try:
+                failpoint("osd.ec.shard_read", cct=self.cct,
+                          entity=self.whoami, pgid=msg.pgid,
+                          shard=msg.shard, oid=oid)
+            except FailpointCrash:
+                raise
+            except FailpointError:
+                rows.append([-5, None, None, None])
+                continue
+            try:
+                whole = self.store.read(cid, oid)
+                try:
+                    stored = int(self.store.getattr(cid, oid, "hinfo"))
+                except (NotFound, KeyError, ValueError):
+                    stored = None
+                if stored is not None and crc32c(whole) != stored:
+                    self.cct.dout(
+                        "osd", 0,
+                        f"{self.whoami} hinfo mismatch on batched read "
+                        f"{msg.pgid}/{oid} shard {msg.shard}",
+                    )
+                    raise NotFound(oid)
+                data = whole if off is None else whole[off:off + ln]
+                try:
+                    size = int(self.store.getattr(cid, oid, "size"))
+                except (NotFound, KeyError):
+                    size = None
+                rows.append([0, pack_data(data), size,
+                             self._stored_ver(cid, oid)])
+            except (NotFound, KeyError):
+                rows.append([-2, None, None, None])
+        try:
+            conn.send_message(MECSubOpReadReply(
+                tid=msg.tid, pgid=msg.pgid, oid=None, shard=msg.shard,
+                retval=0, data=None, size=None, xattrs=None, ver=None,
+                results=rows,
+            ))
         except (OSError, ConnectionError):
             pass
 

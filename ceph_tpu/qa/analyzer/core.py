@@ -188,7 +188,8 @@ class Config:
     #: sync point (the cephdma drive-to-zero contract; cl8_dirs modules
     #: are audited too)
     cl8_hostcopy_files: tuple[str, ...] = ("osd/write_batcher.py",
-                                           "osd/ec_backend.py")
+                                           "osd/ec_backend.py",
+                                           "osd/read_batcher.py")
     #: the ONE module where ambient topology probes are legal (cephtopo:
     #: everything else receives a constructor-injected DevicePolicy)
     cl9_policy_modules: tuple[str, ...] = ("common/device_policy.py",)

@@ -335,5 +335,26 @@ else
     rc=1
 fi
 
-echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json, accounting_smoke.json, qos_smoke.json, recovery_smoke.json, device_pool_smoke.json, placement_smoke.json, topology_smoke.json)"
+echo "== read smoke (coalesced READ plane: speedup / GET / boot / degraded / ranged) =="
+# batched >= 3x per-op at 32 CPU clients, GET-heavy cache promotion,
+# boot-storm coalescing, degraded p99 under the CI bar, and the ranged
+# degraded decode dispatching exactly k x window bytes into the kernel
+# (ceph_tpu/qa/read_smoke.py; docs/read_path.md)
+JAX_PLATFORMS=cpu python -m ceph_tpu.qa.read_smoke \
+    > "$OUT_DIR/read_smoke.json"
+read_rc=$?
+if [ $read_rc -eq 0 ]; then
+    echo "read smoke: ok"
+elif python -c "import json; json.load(open('$OUT_DIR/read_smoke.json'))" \
+        2>/dev/null; then
+    echo "read smoke: FAILED:"
+    python -c "import json; [print(' -', p) for p in json.load(open('$OUT_DIR/read_smoke.json'))['problems']]" || true
+    rc=1
+else
+    rm -f "$OUT_DIR/read_smoke.json"
+    echo "read smoke: ERROR (exit $read_rc) — scenario crashed"
+    rc=1
+fi
+
+echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json, accounting_smoke.json, qos_smoke.json, recovery_smoke.json, device_pool_smoke.json, placement_smoke.json, topology_smoke.json, read_smoke.json)"
 exit $rc

@@ -1,0 +1,679 @@
+"""ReadBatcher + ReadCache — the coalescing READ plane
+(ceph_tpu/osd/read_batcher.py, ceph_tpu/osd/read_cache.py;
+docs/read_path.md).
+
+Fast tier-1 class (~10s): flush triggers (window / op cap / byte cap /
+shutdown), gather fan-out coalescing into multi-oid sub-ops with per-op
+demux, decode fusion bit-identical to the per-op pooled apply (real
+RS(4,2) survivor stacks as referee), the ranged degraded decode window
+math, cache hit/stale/invalidate/evict semantics, failpoint arms,
+backpressure at admission, the degraded-sentinel bypass, and the
+end-to-end cluster wiring (healthy + degraded RS(4,2)/CLAY, ranged
+degraded reads bit-identical while the kernel sees only the window's
+bytes).  Soak variants ride -m slow.
+"""
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.context import CephContext
+from ceph_tpu.common.failpoint import FailpointError, registry
+from ceph_tpu.common.kernel_telemetry import SENTINEL, TELEMETRY
+from ceph_tpu.common.throttle import Throttle
+from ceph_tpu.osd.messages import pack_data
+from ceph_tpu.osd.read_batcher import ReadBatcher, ReadReq
+from ceph_tpu.osd.read_cache import ReadCache
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    registry().clear()
+    yield
+    registry().clear()
+
+
+class FakeIO:
+    """In-memory rb_* adapter: one 'local' OSD served from the store
+    directly, every other OSD answered through the multi-read reply
+    shape the wire handler produces (the demux referee)."""
+
+    def __init__(self, local=0, down=()):
+        self.local = local
+        self.down = set(down)
+        # (osd, pgid, shard, oid) -> (chunk bytes, ver, size)
+        self.store = {}
+        self.sends = []          # one entry per multi-read sub-op sent
+        self.eio = set()         # (osd, oid) -> the shard answers EIO
+        self._tid = 0
+        self._pending = {}
+
+    def put(self, osd, pgid, shard, oid, chunk, ver=1, size=None):
+        self.store[(osd, pgid, shard, oid)] = (
+            bytes(chunk), ver, len(chunk) if size is None else size)
+
+    # -- adapter protocol --------------------------------------------------
+    def rb_local_osd(self):
+        return self.local
+
+    def rb_is_up(self, osd):
+        return osd not in self.down
+
+    def rb_epoch(self):
+        return 7
+
+    def rb_reply_timeout(self):
+        return 5.0
+
+    def rb_read_local(self, pgid, shard, oid, off, ln):
+        ent = self.store.get((self.local, pgid, shard, oid))
+        if ent is None:
+            return None
+        b, ver, size = ent
+        if off is not None:
+            b = b[off:off + ln]
+            if len(b) != ln:
+                return None
+        return (b, ver, size)
+
+    def rb_send_multiread(self, osd, pgid, shard, reads, epoch):
+        self._tid += 1
+        self.sends.append((osd, pgid, shard, [list(r) for r in reads]))
+        rows = []
+        for oid, off, ln in reads:
+            if (osd, oid) in self.eio:
+                rows.append([-5, None, None, None])
+                continue
+            ent = self.store.get((osd, pgid, shard, oid))
+            if ent is None:
+                rows.append([-2, None, None, None])
+                continue
+            b, ver, size = ent
+            if off is not None:
+                b = b[off:off + ln]
+            rows.append([0, pack_data(b), size, ver])
+        self._pending[self._tid] = SimpleNamespace(results=rows)
+        return self._tid
+
+    def rb_wait_multireads(self, tids, deadline):
+        return {t: self._pending.pop(t) for t in tids
+                if t in self._pending}
+
+
+def _batcher(io=None, **overrides):
+    conf = {"osd_read_batch_window_ms": 10_000.0,  # tests trigger
+            "osd_read_batch_max_ops": 10_000,      # flushes explicitly
+            "osd_read_batch_max_bytes": 1 << 30}   # by default
+    conf.update(overrides)
+    cct = CephContext("osd.99", overrides=conf)
+    rb = ReadBatcher(cct, io=io if io is not None else FakeIO(),
+                     entity="osd.99")
+    rb.start()
+    return rb
+
+
+def _codec42():
+    from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+    return ErasureCodePluginRegistry.instance().factory(
+        {"plugin": "jax", "k": "4", "m": "2"})
+
+
+def _decode_case(codec, seed, width=512, lose=(1,)):
+    """A real degraded RS(4,2) stripe: returns (data, dm, dm_key,
+    survivor stack) where dm @ stack must reproduce `data` exactly."""
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, 256, (4, width), dtype=np.uint8)
+    parity = np.asarray(codec.encode_chunks(x), np.uint8)
+    full = np.vstack([x, parity])
+    rows = tuple(r for r in range(6) if r not in set(lose))[:4]
+    dm, dm_key = codec._jax_codec._decode_entry(rows)
+    return x, dm, dm_key, full[list(rows)]
+
+
+def _submit_all(fn, items):
+    """One thread per item; returns (threads, outs, errs) in order."""
+    outs = [None] * len(items)
+    errs = [None] * len(items)
+
+    def go(i):
+        try:
+            outs[i] = fn(items[i])
+        except Exception as e:  # collected for assertions
+            errs[i] = e
+
+    ts = [threading.Thread(target=go, args=(i,)) for i in range(len(items))]
+    for t in ts:
+        t.start()
+    return ts, outs, errs
+
+
+# -- flush triggers ---------------------------------------------------------
+
+def test_window_flush_single_gather():
+    """A lone gather flushes on the inter-arrival gap — well inside the
+    absolute window, on no cap — and demuxes local + remote rows."""
+    io = FakeIO(local=0)
+    io.put(0, "1.0", 0, "a", b"L" * 64)
+    io.put(1, "1.0", 1, "a", b"R" * 64, ver=3)
+    rb = _batcher(io, osd_read_batch_window_ms=200.0)
+    try:
+        t0 = time.monotonic()
+        res = rb.gather("1.0", [0, 1], [ReadReq(0, "a"), ReadReq(1, "a")],
+                        est_bytes=128)
+        assert time.monotonic() - t0 < 5.0
+        assert res[0] == (b"L" * 64, 1, 64)
+        assert res[1] == (b"R" * 64, 3, 64)
+        assert rb.stats()["flushes"] == 1
+        assert rb.stats()["inline"] == 0
+    finally:
+        rb.stop()
+
+
+def test_op_cap_triggers_flush():
+    """osd_read_batch_max_ops flushes immediately — no window wait —
+    and ONE multi-oid sub-op per (pg, shard, osd) carries every op's
+    descriptor (the fan-out coalescing contract)."""
+    io = FakeIO(local=99)  # everything remote
+    oids = [f"o{i}" for i in range(4)]
+    for oid in oids:
+        io.put(1, "1.0", 0, oid, oid.encode() * 16)
+        io.put(2, "1.0", 1, oid, oid.encode()[::-1] * 16)
+    rb = _batcher(io, osd_read_batch_max_ops=4)
+    try:
+        t0 = time.monotonic()
+        ts, outs, errs = _submit_all(
+            lambda oid: rb.gather("1.0", [1, 2],
+                                  [ReadReq(0, oid), ReadReq(1, oid)],
+                                  est_bytes=64),
+            oids)
+        for t in ts:
+            t.join(timeout=10.0)
+        assert time.monotonic() - t0 < 5.0, "waited the 10s window"
+        assert errs == [None] * 4
+        for oid, res in zip(oids, outs):
+            assert res[0][0] == oid.encode() * 16
+            assert res[1][0] == oid.encode()[::-1] * 16
+        # 4 ops x 2 shards collapsed into 2 sub-ops, one per (pg,shard,osd)
+        assert len(io.sends) == 2
+        assert sorted(len(rows) for _, _, _, rows in io.sends) == [4, 4]
+        assert rb.stats() == {"flushes": 1, "ops": 4, "bytes": 4 * 64,
+                              "inline": 0, "fanouts": 2,
+                              "decode_groups": 0}
+    finally:
+        rb.stop()
+
+
+def test_byte_cap_triggers_flush():
+    codec = _codec42()
+    cases = [_decode_case(codec, s) for s in range(4)]
+    nb = cases[0][3].nbytes
+    rb = _batcher(osd_read_batch_max_bytes=2 * nb)
+    try:
+        t0 = time.monotonic()
+        ts, outs, errs = _submit_all(
+            lambda c: rb.decode(c[1], c[3], c[2]), cases)
+        for t in ts:
+            t.join(timeout=10.0)
+        assert time.monotonic() - t0 < 5.0, "waited the 10s window"
+        assert errs == [None] * 4
+        for (x, _, _, _), out in zip(cases, outs):
+            np.testing.assert_array_equal(out, x)
+    finally:
+        rb.stop()
+
+
+def test_shutdown_flushes_pending_then_inlines():
+    """stop() drains queued ops (shutdown flush); submits after stop
+    fall back to the inline per-op path."""
+    io = FakeIO(local=0)
+    io.put(0, "1.0", 0, "a", b"x" * 32)
+    rb = _batcher(io)
+    got = {}
+
+    def go():
+        got["res"] = rb.gather("1.0", [0], [ReadReq(0, "a")], est_bytes=32)
+
+    t = threading.Thread(target=go)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while rb.queue_depth() == 0 and time.monotonic() < deadline:
+        time.sleep(0.001)
+    assert rb.queue_depth() == 1
+    rb.stop()  # shutdown flush, not abandonment
+    t.join(timeout=10.0)
+    assert got["res"][0] == (b"x" * 32, 1, 32)
+    assert rb.stats()["flushes"] == 1
+    res2 = rb.gather("1.0", [0], [ReadReq(0, "a")], est_bytes=32)
+    assert res2[0] == (b"x" * 32, 1, 32)
+    assert rb.stats()["inline"] == 1
+
+
+# -- gather demux semantics -------------------------------------------------
+
+def test_gather_demux_missing_eio_down_and_ranged():
+    """Per-descriptor fault demux: a down OSD, an absent object, and a
+    remote EIO each yield None for THAT row only; ranged descriptors
+    slice server-side; a short local ranged read is None (the caller's
+    splice-fallback contract)."""
+    io = FakeIO(local=0, down={3})
+    io.put(0, "1.0", 0, "a", bytes(range(64)))
+    io.put(1, "1.0", 1, "a", bytes(range(64, 128)), ver=9)
+    io.put(2, "1.0", 2, "eio-obj", b"z" * 64)
+    io.eio.add((2, "eio-obj"))
+    rb = _batcher(osd_read_batch_max_ops=1, io=io)
+    try:
+        res = rb.gather("1.0", [0, 1, 2, 3], [
+            ReadReq(0, "a", off=8, ln=4),      # local ranged
+            ReadReq(1, "a", off=0, ln=2),      # remote ranged
+            ReadReq(2, "eio-obj"),             # remote EIO
+            ReadReq(3, "a"),                   # down OSD
+            ReadReq(1, "absent"),              # remote missing
+            ReadReq(0, "a", off=62, ln=8),     # local short range
+        ], est_bytes=64)
+        assert res[0] == (bytes(range(8, 12)), 1, 64)
+        assert res[1] == (bytes([64, 65]), 9, 64)
+        assert res[2] is None
+        assert res[3] is None
+        assert res[4] is None
+        assert res[5] is None
+    finally:
+        rb.stop()
+
+
+# -- decode fusion / bit identity -------------------------------------------
+
+def test_decode_fusion_bit_identical_rs42():
+    """Many concurrent decodes sharing one decode matrix fuse into ONE
+    group (one pooled dispatch) and every op's window demuxes back to
+    exactly its own data chunks; a second survivor set forms its own
+    group.  Referee: the encoded stripes themselves."""
+    codec = _codec42()
+    same = [_decode_case(codec, s, width=256 + 64 * s, lose=(1,))
+            for s in range(3)]      # variable widths, one matrix
+    other = _decode_case(codec, 9, lose=(0, 5))
+    cases = same + [other]
+    rb = _batcher(osd_read_batch_max_ops=4)
+    try:
+        ts, outs, errs = _submit_all(
+            lambda c: rb.decode(c[1], c[3], c[2]), cases)
+        for t in ts:
+            t.join(timeout=10.0)
+        assert errs == [None] * 4
+        for (x, _, _, _), out in zip(cases, outs):
+            np.testing.assert_array_equal(out, x)
+        s = rb.stats()
+        assert s["flushes"] == 1 and s["ops"] == 4
+        assert s["decode_groups"] == 2
+    finally:
+        rb.stop()
+
+
+def test_mixed_gather_and_decode_batch():
+    """One flush carrying both kinds: gathers fan out, decodes fuse,
+    every op completes with its own result."""
+    codec = _codec42()
+    x, dm, dm_key, stack = _decode_case(codec, 5)
+    io = FakeIO(local=0)
+    io.put(0, "1.0", 0, "g", b"G" * 128)
+    rb = _batcher(io, osd_read_batch_max_ops=2)
+    out = {}
+
+    def g():
+        out["g"] = rb.gather("1.0", [0], [ReadReq(0, "g")], est_bytes=128)
+
+    def d():
+        out["d"] = rb.decode(dm, stack, dm_key)
+
+    try:
+        ts = [threading.Thread(target=f) for f in (g, d)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10.0)
+        assert out["g"][0] == (b"G" * 128, 1, 128)
+        np.testing.assert_array_equal(out["d"], x)
+        assert rb.stats()["flushes"] == 1 and rb.stats()["ops"] == 2
+    finally:
+        rb.stop()
+
+
+# -- failure arms -----------------------------------------------------------
+
+def test_flush_error_fails_every_op_in_batch():
+    codec = _codec42()
+    cases = [_decode_case(codec, s) for s in range(3)]
+    registry().set("osd.read_batcher.gather", "times(1,error)")
+    rb = _batcher(osd_read_batch_max_ops=3)
+    try:
+        ts, outs, errs = _submit_all(
+            lambda c: rb.decode(c[1], c[3], c[2]), cases)
+        for t in ts:
+            t.join(timeout=10.0)
+        assert all(isinstance(e, FailpointError) for e in errs), errs
+        assert outs == [None] * 3
+        assert rb.stats()["flushes"] == 0  # a failed flush counts nothing
+        # the failpoint is exhausted: the next batch decodes fine
+        x, dm, dm_key, stack = cases[0]
+        np.testing.assert_array_equal(rb.decode(dm, stack, dm_key), x)
+    finally:
+        rb.stop()
+
+
+def test_flush_crash_latches_inline_fallback():
+    """crash simulates the read plane dying: the armed batch fails,
+    coalescing latches off, and later reads survive inline."""
+    registry().set("osd.read_batcher.gather", "times(1,crash)")
+    io = FakeIO(local=0)
+    io.put(0, "1.0", 0, "a", b"a" * 16)
+    rb = _batcher(io, osd_read_batch_window_ms=50.0)
+    try:
+        with pytest.raises(FailpointError):
+            rb.gather("1.0", [0], [ReadReq(0, "a")], est_bytes=16)
+        assert not rb.coalescing()
+        res = rb.gather("1.0", [0], [ReadReq(0, "a")], est_bytes=16)
+        assert res[0] == (b"a" * 16, 1, 16)
+        assert rb.stats()["inline"] == 1
+    finally:
+        rb.stop()
+
+
+def test_sentinel_degraded_bypasses_batch_plane():
+    """A degraded backend sentinel must keep reads flowing WITHOUT the
+    batch plane: coalescing() goes false and submits run the historical
+    inline path."""
+    io = FakeIO(local=0)
+    io.put(0, "1.0", 0, "a", b"s" * 16)
+    rb = _batcher(io)
+    try:
+        SENTINEL.force("degraded", "test pin")
+        try:
+            assert not rb.coalescing()
+            res = rb.gather("1.0", [0], [ReadReq(0, "a")], est_bytes=16)
+            assert res[0] == (b"s" * 16, 1, 16)
+            assert rb.stats()["inline"] == 1
+            assert rb.stats()["flushes"] == 0
+        finally:
+            SENTINEL.reset_state()
+        assert rb.coalescing()  # sentinel cleared: batching resumes
+    finally:
+        rb.stop()
+
+
+# -- backpressure -----------------------------------------------------------
+
+def test_backpressure_engages_admission_throttle():
+    """A queue at its byte budget refuses further admission (the block
+    that, on an OSD, pins the op thread and thereby the client's
+    inflight window), and drains back open after the flush."""
+    codec = _codec42()
+    cases = [_decode_case(codec, s) for s in range(4)]
+    nb = cases[0][3].nbytes
+    budget = ReadBatcher.QUEUE_WINDOWS * nb
+    # delay the first flush so all four ops hold admission budget
+    # (released only when each op COMPLETES, in _wait)
+    registry().set("osd.read_batcher.gather", "times(1,delay(0.4))")
+    rb = _batcher(osd_read_batch_window_ms=20.0,
+                  osd_read_batch_max_bytes=nb)
+    try:
+        assert isinstance(rb.admission, Throttle)
+        ts, outs, errs = _submit_all(
+            lambda c: rb.decode(c[1], c[3], c[2]), cases)
+        deadline = time.monotonic() + 5.0
+        while (rb.admission.current < budget
+               and time.monotonic() < deadline):
+            time.sleep(0.001)
+        assert rb.admission.current == budget
+        assert not rb.admission.get_or_fail(1)
+        for t in ts:
+            t.join(timeout=10.0)
+        assert errs == [None] * 4
+        for (x, _, _, _), out in zip(cases, outs):
+            np.testing.assert_array_equal(out, x)
+        assert rb.admission.current == 0
+        assert rb.admission.get_or_fail(1)
+        rb.admission.put(1)
+    finally:
+        rb.stop()
+
+
+# -- ranged degraded decode window math -------------------------------------
+
+def test_read_col_window_math():
+    """The column-window planner: only a range inside ONE data chunk
+    gets a sub-window; spanning/full/overlong requests decode the full
+    stripe; an empty range decodes nothing."""
+    from ceph_tpu.osd.ec_backend import ECBackendMixin
+
+    win = ECBackendMixin._read_col_window
+    k, L, size = 4, 1024, 4000
+
+    def req(off, length):
+        return SimpleNamespace(off=off, length=length)
+
+    assert win(req(0, 0), k, L, size) is None          # full read
+    assert win(req(None, None), k, L, size) is None
+    assert win(req(100, 50), k, L, size) == (100, 150)
+    assert win(req(1024, 1024), k, L, size) == (0, 1024)
+    assert win(req(1500, 100), k, L, size) == (476, 576)
+    assert win(req(1000, 100), k, L, size) is None     # spans chunks
+    assert win(req(0, 4096), k, L, size) is None       # whole object
+    assert win(req(3990, 500), k, L, size) == (918, 928)  # clamped @ size
+    assert win(req(4000, 10), k, L, size) == (0, 0)    # past EOF: empty
+    assert win(req(100, 0), k, L, size) is None        # off, no len: tail
+
+
+# -- read cache -------------------------------------------------------------
+
+def test_read_cache_hit_stale_invalidate_evict():
+    cache = ReadCache(max_bytes=256)
+    key = ("1.0", "a")
+    assert cache.enabled()
+    assert cache.get(key, 5) is None                  # cold miss
+    cache.put(key, 5, b"v5" * 8, 16)
+    assert cache.get(key, 5) == (b"v5" * 8, 16)       # validated hit
+    assert cache.get(key, 6) is None                  # stale: dropped
+    assert cache.get(key, 5) is None                  # ...really dropped
+    cache.put(key, 6, b"v6" * 8, 16)
+    assert cache.get(key, None) is None       # unvalidatable: dropped too
+    cache.put(key, 6, b"v6" * 8, 16)
+    cache.put(key, None, b"x", 1)                     # unstamped: refused
+    cache.put(("1.0", "big"), 1, b"z" * 512, 512)     # oversized: refused
+    assert cache.stats()["entries"] == 1
+    cache.invalidate(key)
+    assert cache.get(key, 6) is None
+    s = cache.stats()
+    assert s["invalidations"] == 1 and s["entries"] == 0
+
+    # LRU bound: touching an entry protects it, the cold one evicts
+    cache = ReadCache(max_bytes=200)
+    cache.put(("p", "x"), 1, b"x" * 100, 100)
+    cache.put(("p", "y"), 1, b"y" * 100, 100)
+    assert cache.get(("p", "x"), 1) is not None       # x now MRU
+    cache.put(("p", "z"), 1, b"z" * 100, 100)         # evicts y
+    assert cache.get(("p", "y"), 1) is None
+    assert cache.get(("p", "x"), 1) is not None
+    assert cache.stats()["evictions"] == 1
+    cache.set_max_bytes(0)                            # runtime shrink
+    assert not cache.enabled() and cache.stats()["entries"] == 0
+
+
+# -- cluster wiring ---------------------------------------------------------
+
+def _acting_of(c, pool, oid):
+    from ceph_tpu.osd.osdmap import object_ps
+
+    m = c._leader().osdmon.osdmap
+    pid = next(i for i, p in m.pools.items() if p.name == pool)
+    ps = object_ps(oid, m.pools[pid].pg_num)
+    _up, _upp, acting, primary = m.pg_to_up_acting_osds(pid, ps)
+    return acting, primary
+
+
+@pytest.mark.cluster
+def test_cluster_concurrent_reads_coalesce():
+    """End-to-end healthy path: concurrent client reads on an EC pool
+    ride the primary's read batcher (counters move) and every payload
+    comes back intact."""
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    with LocalCluster(n_mons=1, n_osds=4) as c:
+        c.create_ec_pool("rb", k=2, m=1, pg_num=4)
+        io = c.client().open_ioctx("rb")
+        payloads = {f"rb-{i}": bytes([i, 255 - i]) * 2048 for i in range(8)}
+        for oid, data in payloads.items():
+            io.write_full(oid, data)
+        outs = {}
+        ts = [threading.Thread(
+            target=lambda o=oid: outs.__setitem__(o, io.read(o)))
+            for oid in payloads]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30.0)
+        assert outs == payloads
+        ops = sum(o.read_batcher.stats()["ops"]
+                  for o in c.osds.values())
+        perf = sum(o.logger.get("read_batcher_ops")
+                   for o in c.osds.values())
+        assert ops >= 8 and perf == ops
+        # ranged healthy reads slice identically
+        assert io.read("rb-0", off=1000, length=777) == \
+            payloads["rb-0"][1000:1777]
+
+
+@pytest.mark.cluster
+def test_cluster_degraded_ranged_read_bit_identical():
+    """One data-shard OSD dead: full and ranged degraded reads are
+    byte-identical to the original payload, and a chunk-interior range
+    decodes ONLY its column window — asserted via the read_batch_decode
+    kernel's bytes-in accounting (k x window, not k x L)."""
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    conf = {"osd_subop_reply_timeout": 1.5}
+    with LocalCluster(n_mons=1, n_osds=6, conf_overrides=conf) as c:
+        c.create_ec_pool("rg", k=4, m=2, pg_num=4)
+        io = c.client().open_ioctx("rg")
+        rng = np.random.default_rng(11)
+        payload = rng.integers(0, 256, 8192, np.uint8).tobytes()
+        io.write_full("obj", payload)
+        assert io.read("obj") == payload
+        acting, primary = _acting_of(c, "rg", "obj")
+        victim = next(acting[j] for j in range(4)
+                      if acting[j] >= 0 and acting[j] != primary)
+        c.kill_osd(victim)
+        assert io.read("obj") == payload          # full degraded decode
+        L = _codec42().get_chunk_size(len(payload))  # per-chunk bytes
+
+        def decode_bytes_in():
+            return TELEMETRY.dump().get(
+                "read_batch_decode", {}).get("bytes_in", 0)
+
+        off, ln = L + 37, 101                     # interior of chunk 1
+        b0 = decode_bytes_in()
+        assert io.read("obj", off=off, length=ln) == \
+            payload[off:off + ln]
+        ranged_in = decode_bytes_in() - b0
+        # the kernel saw exactly k x window bytes — far below the
+        # k x L a full decode-then-slice would have dispatched
+        assert ranged_in == 4 * ln, (ranged_in, ln, L)
+        assert ranged_in < 4 * L
+        # a chunk-SPANNING range takes the full-decode path (identical
+        # bytes, no ranged dispatch) — the window planner refuses it
+        b1 = decode_bytes_in()
+        off2, ln2 = L - 50, 100
+        assert io.read("obj", off=off2, length=ln2) == \
+            payload[off2:off2 + ln2]
+        assert decode_bytes_in() == b1
+        # tail read with length 0 = to-EOF, still exact
+        assert io.read("obj", off=len(payload) - 64) == payload[-64:]
+
+
+@pytest.mark.cluster
+def test_cluster_degraded_clay_read_intact():
+    """CLAY couples columns across sub-chunk planes, so it must BYPASS
+    the ranged window (full decode + slice) — degraded ranged reads
+    still come back bit-exact."""
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    conf = {"osd_subop_reply_timeout": 1.5}
+    with LocalCluster(n_mons=1, n_osds=6, conf_overrides=conf) as c:
+        c.create_ec_pool("cl", k=4, m=2, pg_num=2, plugin="clay")
+        io = c.client().open_ioctx("cl")
+        payload = bytes(range(256)) * 64          # 16 KiB
+        io.write_full("obj", payload)
+        acting, primary = _acting_of(c, "cl", "obj")
+        victim = next(acting[j] for j in range(4)
+                      if acting[j] >= 0 and acting[j] != primary)
+        c.kill_osd(victim)
+        b0 = TELEMETRY.dump().get(
+            "read_batch_decode", {}).get("bytes_in", 0)
+        assert io.read("obj") == payload
+        assert io.read("obj", off=777, length=555) == payload[777:1332]
+        # no ranged dispatch happened: CLAY is excluded by design
+        assert TELEMETRY.dump().get(
+            "read_batch_decode", {}).get("bytes_in", 0) == b0
+
+
+@pytest.mark.cluster
+def test_cluster_read_cache_hit_and_write_invalidation():
+    """Hot-object cache end-to-end: with promotion at 0 the second read
+    hits (counter moves), a client overwrite invalidates, and the next
+    read serves the NEW bytes."""
+    from ceph_tpu.qa.vstart import LocalCluster
+
+    conf = {"osd_read_cache_bytes": 1 << 20,
+            "osd_read_cache_promote_ops": 0}
+    with LocalCluster(n_mons=1, n_osds=4, conf_overrides=conf) as c:
+        c.create_ec_pool("hc", k=2, m=1, pg_num=4)
+        io = c.client().open_ioctx("hc")
+        v1 = b"one" * 1365
+        io.write_full("hot", v1)
+        assert io.read("hot") == v1               # fill
+        assert io.read("hot") == v1               # hit
+        assert io.read("hot", off=100, length=50) == v1[100:150]
+        hits = sum(o.logger.get("read_cache_hits")
+                   for o in c.osds.values())
+        inserts = sum(o.read_cache.stats()["inserts"]
+                      for o in c.osds.values())
+        assert inserts >= 1 and hits >= 2
+        v2 = b"two" * 2000
+        io.write_full("hot", v2)                  # bumps version
+        assert io.read("hot") == v2               # never the stale v1
+        # RMW splice invalidates too
+        io.write("hot", b"Z" * 100, off=50)
+        exp = bytearray(v2)
+        exp[50:150] = b"Z" * 100
+        assert io.read("hot") == bytes(exp)
+        inval = sum(o.read_cache.stats()["invalidations"]
+                    for o in c.osds.values())
+        assert inval >= 1
+
+
+# -- soak -------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_traffic_scenario_batched_read_speedup():
+    """The bench read scenario (CPU backend): sustained degraded 1 KiB
+    hot-object reads from 32 async clients — the batched plane must beat
+    per-op by >= 3x aggregate (the read_smoke acceptance bar).  Small
+    reads are the coalescing sweet spot: per-op decode dispatch is
+    fixed-cost, so fusing 64 tiny decodes into one kernel call amortizes
+    what dominates; at >= 16 KiB the per-op path is already
+    bandwidth-bound and batching buys nothing (and the byte cap flushes
+    early anyway)."""
+    from ceph_tpu.bench.traffic import run_read_scenario
+
+    # loaded-CI-host noise swings this ratio; best-of-3, like the
+    # read_smoke gate's retry
+    best = {"read_batch_speedup": 0.0}
+    for _ in range(3):
+        res = run_read_scenario(n_clients=32, seconds=2.0, read_size=1024)
+        assert res["read_batched_gibps"] > 0
+        if res["read_batch_speedup"] > best["read_batch_speedup"]:
+            best = res
+        if best["read_batch_speedup"] >= 3.0:
+            break
+    assert best["read_batch_speedup"] >= 3.0, best

@@ -33,10 +33,15 @@ def test_thrasher_smoke():
     write-batcher flush failpoint is armed for the first coalesced
     flush: the batch it kills fails ALL its ops visibly (the clients
     see the error, nothing acks), so the no-acked-write-loss invariant
-    also covers a stalled/failed coalesced write path."""
+    also covers a stalled/failed coalesced write path.  The READ-side
+    twin `osd.read_batcher.gather` is armed the same way: the first
+    coalesced read flush errors, the primary falls back to the inline
+    per-op gather, and the read still answers correct bytes — so the
+    digest invariant also covers a failed coalesced read path."""
     with LocalCluster(n_mons=3, n_osds=5, conf_overrides=FAST_CONF) as c:
         c.create_ec_pool("th", k=2, m=1, pg_num=8)
         registry().set("osd.write_batcher.flush", "times(1,error)")
+        registry().set("osd.read_batcher.gather", "times(1,error)")
         th = Thrasher(c, seed=12, pool="th")
         events = th.run(14)
         kinds = {e[0] for e in events}
@@ -48,6 +53,17 @@ def test_thrasher_smoke():
         assert hits >= 1, "no write ever crossed the batcher flush"
         registry().set("osd.write_batcher.flush", "off")
         th.quiesce()
+        # seed 12's schedule has no read events, so drive one explicit
+        # read of an ACKED object through the (still armed) read-batcher
+        # gather failpoint: the flush errors, the fallback serves the
+        # read anyway — correct bytes, no client-visible error
+        some_oid, payload = next(iter(th.acked.items()))
+        assert c.client().open_ioctx("th").read(some_oid) == payload
+        rhits = sum(
+            e["hits"] for e in registry().list()["osd.read_batcher.gather"]
+        )
+        assert rhits >= 1, "no read ever crossed the batcher gather"
+        registry().set("osd.read_batcher.gather", "off")
         report = InvariantChecker(c, "th").check(th)
         # chaos must not have refused everything: the schedule's writes
         # largely land (seed 12: 4 writes, ample min_size margin; the
