@@ -40,11 +40,21 @@ load independent of service rate, the workload that exposes queueing),
 and ``--bully [--qos]`` runs the mixed-population fairness scenario (1
 heavy streamer vs N small Poisson writers on a real LocalCluster) that
 ``qa/qos_smoke.py`` gates controller-on against controller-off.
+
+cephstorm additions (docs/storm_sim.md): every generator takes one
+``seed`` (CLI ``--seed``) that derives EVERY random stream in the run
+and is recorded in every JSON artifact, so any measured run can be
+replayed bit-identically; the ``tenant_*`` functions at the bottom are
+the pure multi-tenant workload vocabulary (RGW S3 request mixes,
+CephFS metadata storms, RBD snapshot churn; bursty/diurnal arrival
+shapes over hot-object populations) the storm planner
+(qa/storm/planner.py) draws its client events from.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import threading
@@ -61,6 +71,29 @@ from ..common.tracer import (
     set_op_trace,
     trace_now,
 )
+
+
+#: the one default every traffic artifact records when --seed is absent
+DEFAULT_SEED = 1234
+
+#: fixed per-purpose stream ids: two generators never share a stream,
+#: and the same (seed, stream, index) always yields the same draws —
+#: the replay contract the storm harness's plan_digest depends on
+_SEED_STREAMS = {
+    "stripes": 0,       # run_traffic's pre-built stripe pool
+    "poisson": 1,       # per-client open-loop arrival gaps
+    "bully_small": 2,   # per-victim Poisson writers in --bully
+    "read_stacks": 3,   # run_read_traffic's survivor-stack pool
+    "tenant": 4,        # tenant_next_op draws (storm planner)
+}
+
+
+def derive_rng(seed: int, stream: str, index: int = 0):
+    """One independent Generator per (run seed, purpose, actor): numpy
+    seeds by entropy-pooling the whole int sequence, so streams never
+    collide even when ``seed + i`` arithmetic would."""
+    return np.random.default_rng(
+        [int(seed), _SEED_STREAMS[stream], int(index)])
 
 
 def stage_breakdown(spans: list[dict],
@@ -137,6 +170,7 @@ def run_traffic(
     arrivals: str = "closed",
     rate: float = 100.0,
     conf_overrides: dict | None = None,
+    seed: int = DEFAULT_SEED,
 ) -> dict:
     """One mode's run; returns ops/GiB-per-s/latency stats.
     sampling > 0 arms cephtrace, head-samples that fraction of ops, and
@@ -159,7 +193,7 @@ def run_traffic(
     assert arrivals in ("closed", "poisson"), arrivals
     mat = np.ascontiguousarray(cauchy_good_coding_matrix(k, m), np.uint8)
     L = _chunk_len(write_size, k)
-    rng = np.random.default_rng(1234)
+    rng = derive_rng(seed, "stripes")
     # a small pool of distinct pre-built stripes per client keeps the
     # generator out of the timed loop while avoiding constant-input
     # caching artifacts
@@ -196,7 +230,7 @@ def run_traffic(
         my = lats[i]
         inflight: deque = deque()
         n = 0
-        arr_rng = np.random.default_rng(9000 + i)
+        arr_rng = derive_rng(seed, "poisson", i)
         next_due = None  # poisson schedule, monotonic clock
 
         def submit(x):
@@ -268,6 +302,7 @@ def run_traffic(
     out = {
         "mode": mode,
         "arrivals": arrivals,
+        "seed": seed,
         "clients": n_clients,
         "write_size": write_size,
         "seconds": round(elapsed, 3),
@@ -307,6 +342,7 @@ def run_cluster_traffic(
     n_osds: int | None = None,
     sampling: float = 0.0,
     conf_overrides: dict | None = None,
+    seed: int = DEFAULT_SEED,
 ) -> dict:
     """Closed-loop writers against a REAL LocalCluster EC pool — the
     full client -> OSD -> replicas -> ack path, so traced runs produce
@@ -404,6 +440,7 @@ def run_cluster_traffic(
     p50, p99 = _pctiles(all_lats)
     out = {
         "mode": "cluster",
+        "seed": seed,
         "clients": n_clients,
         "write_size": write_size,
         "rs": f"{k}+{m}",
@@ -438,6 +475,7 @@ def run_bully_traffic(
     qos: bool = False,
     settle: float = 0.0,
     conf_overrides: dict | None = None,
+    seed: int = DEFAULT_SEED,
 ) -> dict:
     """The mixed-population fairness scenario (ROADMAP closed-loop QoS;
     docs/qos.md): ONE heavy streamer (``client.bully`` — bully_streams
@@ -514,7 +552,7 @@ def run_bully_traffic(
         def small(i: int) -> None:
             io = small_ios[i]
             my = lats[i + 1]
-            rng = np.random.default_rng(7000 + i)
+            rng = derive_rng(seed, "bully_small", i)
             n = 0
             try:
                 io.write_full(f"s{i}-w", small_payloads[0])
@@ -594,6 +632,7 @@ def run_bully_traffic(
     agg_bytes = bully_ops * bully_size + small_ops * small_size
     out = {
         "mode": "bully",
+        "seed": seed,
         "qos": qos,
         "seconds": round(elapsed, 3),
         "bully_streams": bully_streams,
@@ -619,7 +658,8 @@ def run_bully_traffic(
 
 
 def trace_smoke(n_clients: int = 2, seconds: float = 2.0,
-                trace_out: str | None = None) -> tuple[dict, int]:
+                trace_out: str | None = None,
+                seed: int = DEFAULT_SEED) -> tuple[dict, int]:
     """The ci_gate tracing smoke: an untraced cluster run, then a
     sampling=1.0 run.  Fails (rc 1) when the traced run produced no
     connected trace tree, the per-stage breakdown misses one of the
@@ -628,9 +668,11 @@ def trace_smoke(n_clients: int = 2, seconds: float = 2.0,
     # throwaway warmup: the first cluster run pays the process-wide XLA
     # compile, which would otherwise be charged to the untraced side
     # and mask (or invert) the real tracing overhead
-    run_cluster_traffic(n_clients, 0.5, sampling=0.0)
-    untraced = run_cluster_traffic(n_clients, seconds, sampling=0.0)
-    traced = run_cluster_traffic(n_clients, seconds, sampling=1.0)
+    run_cluster_traffic(n_clients, 0.5, sampling=0.0, seed=seed)
+    untraced = run_cluster_traffic(n_clients, seconds, sampling=0.0,
+                                   seed=seed)
+    traced = run_cluster_traffic(n_clients, seconds, sampling=1.0,
+                                 seed=seed)
     if trace_out:
         with open(trace_out, "w") as f:
             json.dump(perfetto_export(LAST_SPANS), f)
@@ -648,6 +690,7 @@ def trace_smoke(n_clients: int = 2, seconds: float = 2.0,
     if overhead is not None and overhead > 0.10:
         problems.append(f"tracing overhead {overhead:.1%} > 10%")
     out = {
+        "seed": seed,
         "untraced": untraced,
         "traced": traced,
         "tracing_overhead": overhead,
@@ -667,15 +710,17 @@ def run_scenario(
     max_stripes: int = 64,
     max_bytes: int = 8 << 20,
     qd: int = 4,
+    seed: int = DEFAULT_SEED,
 ) -> dict:
     """Both modes + the headline ratio, flat keys for bench.py's extra."""
     perop = run_traffic("perop", n_clients, seconds, write_size, k, m,
-                        window_ms, max_stripes, max_bytes, qd)
+                        window_ms, max_stripes, max_bytes, qd, seed=seed)
     batched = run_traffic("batched", n_clients, seconds, write_size, k, m,
-                          window_ms, max_stripes, max_bytes, qd)
+                          window_ms, max_stripes, max_bytes, qd, seed=seed)
     speedup = (round(batched["gibps"] / perop["gibps"], 2)
                if perop["gibps"] else None)
     return {
+        "traffic_seed": seed,
         "traffic_clients": n_clients,
         "traffic_qd": qd,
         "traffic_write_size": write_size,
@@ -708,6 +753,7 @@ def run_read_traffic(
     qd: int = 4,
     warmup: float = 0.25,
     lose: int = 1,
+    seed: int = DEFAULT_SEED,
 ) -> dict:
     """The READ-side twin of `run_traffic`: N closed-loop degraded
     readers against the production ``ReadBatcher`` decode seam
@@ -727,7 +773,7 @@ def run_read_traffic(
     codec = ErasureCodePluginRegistry.instance().factory(
         {"plugin": "jax", "k": str(k), "m": str(m)})
     L = codec.get_chunk_size(read_size)
-    rng = np.random.default_rng(4321)
+    rng = derive_rng(seed, "read_stacks")
     rows = tuple(r for r in range(k + m) if r != lose)[:k]
     dm, dm_key = codec._jax_codec._decode_entry(rows)
     # a pool of distinct degraded stripes (survivor stacks) per client
@@ -800,6 +846,7 @@ def run_read_traffic(
     op_bytes = k * L  # decoded data bytes delivered per read
     out = {
         "mode": mode,
+        "seed": seed,
         "clients": n_clients,
         "read_size": read_size,
         "rs": f"{k}+{m}",
@@ -827,16 +874,19 @@ def run_read_scenario(
     max_ops: int = 64,
     max_bytes: int = 8 << 20,
     qd: int = 4,
+    seed: int = DEFAULT_SEED,
 ) -> dict:
     """Both read modes + the headline ratio, flat keys (the read-side
     mirror of `run_scenario`; read_smoke's >=3x gate reads these)."""
     perop = run_read_traffic("perop", n_clients, seconds, read_size, k, m,
-                             window_ms, max_ops, max_bytes, qd)
+                             window_ms, max_ops, max_bytes, qd, seed=seed)
     batched = run_read_traffic("batched", n_clients, seconds, read_size,
-                               k, m, window_ms, max_ops, max_bytes, qd)
+                               k, m, window_ms, max_ops, max_bytes, qd,
+                               seed=seed)
     speedup = (round(batched["gibps"] / perop["gibps"], 2)
                if perop["gibps"] else None)
     return {
+        "read_seed": seed,
         "read_clients": n_clients,
         "read_qd": qd,
         "read_size": read_size,
@@ -866,6 +916,7 @@ def run_cluster_read_traffic(
     mixed: bool = False,
     working_set: int = 8,
     conf_overrides: dict | None = None,
+    seed: int = DEFAULT_SEED,
 ) -> dict:
     """Closed-loop READERS against a real LocalCluster EC pool — the
     full client -> primary -> gather [-> decode] -> reply path.
@@ -986,6 +1037,7 @@ def run_cluster_read_traffic(
     p50, p99 = _pctiles(all_lats)
     out = {
         "mode": "cluster-read",
+        "seed": seed,
         "scenario": scenario,
         "degraded": degraded,
         "mixed": mixed,
@@ -1006,6 +1058,80 @@ def run_cluster_read_traffic(
     }
     out["per_client"], out["fairness_ratio"] = per_client_stats(lats)
     return out
+
+
+# --- multi-tenant workload vocabulary (cephstorm) ----------------------
+#
+# Pure, seeded building blocks the storm planner (qa/storm/planner.py)
+# composes into thousand-OSD client traffic.  Three tenant kinds model
+# the three Ceph front doors: "s3" (RGW request mixes — GET-heavy over
+# bucket/key namespaces, diurnal offered load), "fs" (CephFS metadata
+# storms — tiny hot writes against a shallow directory tree, bursty),
+# "rbd" (block images under snapshot churn — half-and-half rewrites of
+# a fixed block population, wave-shaped load).  Everything here is a
+# function of (kind, seed-derived rng, position-in-run): no clocks, no
+# globals, so identical seeds yield identical op streams.
+
+TENANT_KINDS = ("s3", "fs", "rbd")
+
+#: op mix per tenant kind: relative write/read weights + payload size.
+TENANT_MIX = {
+    "s3": {"write": 4, "read": 6, "size": 8192},
+    "fs": {"write": 7, "read": 3, "size": 512},
+    "rbd": {"write": 5, "read": 5, "size": 4096},
+}
+
+
+def tenant_objects(kind: str, tenant: str, n_objects: int) -> list[str]:
+    """The tenant's deterministic object-name population, styled after
+    its real namespace (S3 bucket/keys, FS paths, RBD image blocks)."""
+    if kind == "s3":
+        return [f"{tenant}/bkt{j % 8}/obj{j:05d}" for j in range(n_objects)]
+    if kind == "fs":
+        return [f"{tenant}/dir{j % 16}/f{j:04d}.dat"
+                for j in range(n_objects)]
+    if kind == "rbd":
+        return [f"{tenant}/img{j % 4}.block{j:06d}"
+                for j in range(n_objects)]
+    raise ValueError(f"unknown tenant kind {kind!r}")
+
+
+def arrival_intensity(kind: str, t_frac: float) -> float:
+    """Relative offered-load multiplier at position ``t_frac`` in [0,1)
+    of the run: a diurnal sine for s3, 1-in-4 duty-cycle bursts for fs
+    metadata storms, and alternating snapshot-churn waves for rbd.
+    Mean is ~O(1) for every kind so mixes stay comparable."""
+    t = t_frac % 1.0
+    if kind == "s3":
+        return 0.5 + math.sin(math.pi * t) ** 2  # one day-night cycle
+    if kind == "fs":
+        return 2.5 if (t * 8.0) % 1.0 < 0.25 else 0.5  # 8 bursts
+    if kind == "rbd":
+        return 1.5 if (t * 4.0) % 1.0 < 0.5 else 0.5  # 4 snapshot waves
+    raise ValueError(f"unknown tenant kind {kind!r}")
+
+
+def tenant_next_op(kind: str, rng, objects: list[str],
+                   t_frac: float = 0.0,
+                   hot_frac: float = 0.125) -> tuple[str, str, int] | None:
+    """Draw one client op for a tenant: ``(op, oid, size)`` with op in
+    {"write", "read"}, or None when the tenant's bursty/diurnal shape
+    thins this slot out (the planner simply skips the event).  Object
+    popularity is hot-skewed: ~70% of draws land on the leading
+    ``hot_frac`` of the population (the hot-object pattern the read
+    cache and the QoS classes must survive), the rest uniform."""
+    peak = 2.5  # max of every arrival_intensity shape
+    if rng.random() * peak >= arrival_intensity(kind, t_frac):
+        return None
+    mix = TENANT_MIX[kind]
+    w, r = mix["write"], mix["read"]
+    op = "write" if rng.random() * (w + r) < w else "read"
+    n_hot = max(1, int(len(objects) * hot_frac))
+    if rng.random() < 0.7:
+        oid = objects[int(rng.integers(n_hot))]
+    else:
+        oid = objects[int(rng.integers(len(objects)))]
+    return op, oid, mix["size"]
 
 
 def main(argv=None) -> int:
@@ -1080,6 +1206,11 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="CI gate: exit 1 when the batched/per-op "
                     "throughput ratio drops below 1.0")
+    ap.add_argument("--seed", type=int, default=DEFAULT_SEED,
+                    help="derives every random stream in the run "
+                    "(stripe pools, Poisson arrivals, bully victims); "
+                    "recorded in the JSON so any artifact can be "
+                    f"replayed bit-identically (default {DEFAULT_SEED})")
     args = ap.parse_args(argv)
     if args.cpu or os.environ.get("CEPH_TPU_BENCH_FORCE_CPU"):
         import jax
@@ -1097,12 +1228,14 @@ def main(argv=None) -> int:
             res = run_cluster_read_traffic(
                 max(1, args.clients), args.seconds, args.write_size,
                 args.k, args.m, scenario=args.scenario,
-                degraded=args.degraded, mixed=args.mixed)
+                degraded=args.degraded, mixed=args.mixed,
+                seed=args.seed)
         else:
             res = run_read_scenario(args.clients, args.seconds,
                                     args.write_size, qd=args.qd,
                                     window_ms=args.window_ms,
-                                    max_bytes=args.max_bytes)
+                                    max_bytes=args.max_bytes,
+                                    seed=args.seed)
         if args.json:
             print(json.dumps(res))
         else:
@@ -1119,7 +1252,7 @@ def main(argv=None) -> int:
         return 0
     if args.trace_smoke:
         res, rc = trace_smoke(args.clients, args.seconds,
-                              trace_out=args.trace_out)
+                              trace_out=args.trace_out, seed=args.seed)
         if args.json:
             print(json.dumps(res))
         else:
@@ -1139,18 +1272,19 @@ def main(argv=None) -> int:
                                 small_rate=(args.rate if args.rate
                                             is not None else 10.0),
                                 k=args.k, m=args.m, qos=args.qos,
-                                settle=1.5 if args.qos else 0.0)
+                                settle=1.5 if args.qos else 0.0,
+                                seed=args.seed)
     elif args.cluster:
         res = run_cluster_traffic(args.clients, args.seconds,
                                   args.write_size, args.k, args.m,
-                                  sampling=args.sampling)
+                                  sampling=args.sampling, seed=args.seed)
     elif args.sampling > 0.0:
         # batcher-only traced run: batched mode with stage breakdown
         # (the 1%-sampling overhead measurement drives this directly)
         res = run_traffic("batched", args.clients, args.seconds,
                           args.write_size, args.k, args.m, args.window_ms,
                           args.max_stripes, args.max_bytes, args.qd,
-                          sampling=args.sampling)
+                          sampling=args.sampling, seed=args.seed)
     elif args.arrivals == "poisson":
         # open-loop single-mode run: offered load independent of
         # service rate (the queueing-exposing workload)
@@ -1159,11 +1293,12 @@ def main(argv=None) -> int:
                           args.max_stripes, args.max_bytes, args.qd,
                           arrivals="poisson",
                           rate=(args.rate if args.rate is not None
-                                else 100.0))
+                                else 100.0),
+                          seed=args.seed)
     else:
         res = run_scenario(args.clients, args.seconds, args.write_size,
                            args.k, args.m, args.window_ms, args.max_stripes,
-                           args.max_bytes, args.qd)
+                           args.max_bytes, args.qd, seed=args.seed)
     if args.trace_out and LAST_SPANS:
         with open(args.trace_out, "w") as f:
             json.dump(perfetto_export(LAST_SPANS), f)

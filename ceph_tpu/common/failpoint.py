@@ -441,6 +441,7 @@ KNOWN_FAILPOINTS = frozenset({
     "mon.election.start",
     "mon.tick",
     "tpu.backend.probe",
+    "storm.stub.recv",
 })
 
 

@@ -118,6 +118,25 @@ def default_options() -> OptionTable:
                    "concurrent backfills per OSD", min=1, runtime=True),
             Option("osd_recovery_max_active", int, 3,
                    "concurrent recovery ops per OSD", min=1, runtime=True),
+            Option("osd_repair_cost_aware", bool, True,
+                   "plan repair reads against MEASURED per-helper cost "
+                   "(cephstorm): helpers whose piggybacked sub-op "
+                   "telemetry shows a deep mClock queue or a degraded "
+                   "backend sentinel are pruned from the "
+                   "minimum_to_decode candidate set, falling back to "
+                   "the full set (index order) when telemetry is "
+                   "absent/stale or too few cheap helpers remain",
+                   runtime=True),
+            Option("osd_repair_helper_max_qlen", int, 16,
+                   "piggybacked mClock queue depth at/over which a "
+                   "helper shard is considered EXPENSIVE for repair "
+                   "reads (osd_repair_cost_aware)", min=1,
+                   runtime=True),
+            Option("osd_repair_telemetry_ttl", float, 30.0,
+                   "seconds a peer's piggybacked load row stays fresh "
+                   "enough to steer repair planning; older rows are "
+                   "ignored (the helper is kept)", min=0.1,
+                   runtime=True),
             Option("osd_op_history_size", int, 20,
                    "historic ops kept for dump_historic_ops", min=0,
                    runtime=True),
@@ -296,6 +315,15 @@ def default_options() -> OptionTable:
                    "path under: overshoot shrinks the coalescing "
                    "window multiplicatively; headroom lets it follow "
                    "the arrival-matched ideal", min=0.1, runtime=True),
+            Option("mgr_qos_queue_p99_recover_frac", float, 0.8,
+                   "hysteresis band for window regrowth: after a "
+                   "queue-p99 backoff the controller grows the "
+                   "coalescing window again only once p99 has "
+                   "recovered below this fraction of the target "
+                   "(backing off at >target while regrowing at "
+                   "<=target limit-cycles the window under steady "
+                   "load — the cephstorm oscillation invariant)",
+                   min=0.1, max=1.0, runtime=True),
             Option("mgr_qos_window_min_ms", float, 0.5,
                    "lower clamp on controller-set ec_batch_window_ms",
                    min=0.0, runtime=True),

@@ -98,6 +98,11 @@ class QoSClamps:
     stripes_min: int = 8
     stripes_max: int = 256
     queue_p99_target_ms: float = 50.0
+    # hysteresis (cephstorm): grow the window back only once queue p99
+    # has recovered BELOW this fraction of the target — backing off at
+    # `> target` while regrowing at `<= target` limit-cycled the window
+    # between the two rules every other tick under steady load
+    queue_p99_recover_frac: float = 0.8
     bully_factor: float = 4.0
     heavy_weight: float = 5.0
     victim_reservation: float = 40.0
@@ -149,7 +154,14 @@ class QoSController:
                 f"queue_p99 {obs.queue_p99_ms:.1f}ms > target "
                 f"{c.queue_p99_target_ms:.1f}ms: window -> "
                 f"{window:.2f}ms")
-        elif obs.op_rate > 0:
+        elif obs.op_rate > 0 and (
+                obs.queue_p99_ms is None
+                or obs.queue_p99_ms
+                <= c.queue_p99_recover_frac * c.queue_p99_target_ms):
+            # grow only once p99 has RECOVERED below the hysteresis
+            # band, not merely dipped under the backoff threshold —
+            # the storm's oscillation invariant pinned the flip-flop
+            # this band prevents (seed in tests/test_storm.py)
             ideal = self._clamp_window(
                 (obs.max_stripes / 2.0) / obs.op_rate * 1e3)
             window = self._clamp_window(
@@ -247,6 +259,8 @@ class QoSModule(MgrModule):
             stripes_max=int(cct.conf.get("mgr_qos_stripes_max")),
             queue_p99_target_ms=float(
                 cct.conf.get("mgr_qos_queue_p99_target_ms")),
+            queue_p99_recover_frac=float(
+                cct.conf.get("mgr_qos_queue_p99_recover_frac")),
             bully_factor=float(cct.conf.get("mgr_qos_bully_factor")),
             heavy_weight=float(cct.conf.get("mgr_qos_heavy_weight")),
             victim_reservation=float(

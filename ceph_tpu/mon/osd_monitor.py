@@ -1055,21 +1055,33 @@ class OSDMonitor:
         return rule_id
 
     def _cmd_osd_state(self, action: str, cmd: dict) -> tuple[int, object]:
-        osd = cmd.get("id")
-        if osd is None or not (0 <= int(osd) < (self.osdmap.max_osd if self.osdmap else 0)):
-            return -22, f"bad osd id {osd!r}"
-        osd = int(osd)
+        # `ids` marks a whole cohort in ONE map epoch / one proposal —
+        # a cascading failure is one event, and a thousand-OSD storm
+        # cannot afford a paxos round trip per member (reference: a
+        # real mon batches many down marks into a single epoch too)
+        raw = cmd.get("ids") if cmd.get("ids") is not None \
+            else [cmd.get("id")]
+        try:
+            ids = [int(o) for o in raw]
+        except (TypeError, ValueError):
+            return -22, f"bad osd ids {raw!r}"
+        max_osd = self.osdmap.max_osd if self.osdmap else 0
+        if not ids or any(not (0 <= o < max_osd) for o in ids):
+            return -22, f"bad osd ids {raw!r}"
         m = self._pending()
-        if action == "down":
-            m.mark_down(osd)
-            self._down_stamp[osd] = time.monotonic()
-        elif action == "out":
-            m.mark_out(osd)
-        else:
-            m.mark_in(osd)
+        for osd in ids:
+            if action == "down":
+                m.mark_down(osd)
+                self._down_stamp[osd] = time.monotonic()
+            elif action == "out":
+                m.mark_out(osd)
+            else:
+                m.mark_in(osd)
         if not self._propose_map(m):
             return -110, "proposal timed out"
-        return 0, f"marked {action} osd.{osd}"
+        if len(ids) == 1:
+            return 0, f"marked {action} osd.{ids[0]}"
+        return 0, f"marked {action} {len(ids)} osds"
 
     def _cmd_upmap_items(self, cmd: dict) -> tuple[int, object]:
         try:

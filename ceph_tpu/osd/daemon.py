@@ -180,6 +180,12 @@ class OSD(
         self._lock = make_lock("osd::daemon")
         self._cond = threading.Condition(self._lock)
         self._sub_replies: dict[int, dict] = {}   # tid -> reply fields
+        # cephstorm: freshest piggybacked load per peer OSD —
+        # {osd id: (monotonic ts, mclock qlen, sentinel degraded)} from
+        # sub-op reply telemetry; _plan_repair_read's cost-aware helper
+        # choice reads it (stale entries past osd_repair_telemetry_ttl
+        # are ignored, falling back to index order)
+        self._peer_load: dict[int, tuple] = {}
         self._tid = 0
         self._stop = threading.Event()
         self._tick_thread: threading.Thread | None = None
@@ -1038,6 +1044,10 @@ class OSD(
             # MOSDOpReply arrives when this OSD acts as its own client
             # (split migration forwarding ops to the post-split primary)
             with self._lock:
+                if getattr(msg, "sender", None) is not None:
+                    self._peer_load[int(msg.sender)] = (
+                        time.monotonic(), int(msg.qlen or 0),
+                        bool(msg.degraded))
                 self._sub_replies[msg.tid] = msg
                 # reap abandoned stragglers (wave replies past their
                 # shared deadline — _wait_replies leaves them here).

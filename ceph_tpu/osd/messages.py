@@ -112,8 +112,16 @@ class MECSubOpWrite(_JsonMessage):
 
 @register_message
 class MECSubOpWriteReply(_JsonMessage):
+    """`sender`/`qlen`/`degraded` (cephstorm) piggyback the replying
+    OSD's load on every ack: its id, its mClock queue depth, and its
+    backend-sentinel degraded latch.  The primary's repair planner
+    reads them from `_peer_load` to skip expensive helpers
+    (`_plan_repair_read`); None = an old peer, cost-unaware planning.
+    The names avoid the framing attrs (`seq`/`src` — CL6)."""
+
     MSG_TYPE = 109
-    FIELDS = ("tid", "pgid", "shard", "retval")
+    FIELDS = ("tid", "pgid", "shard", "retval", "sender", "qlen",
+              "degraded")
 
 
 @register_message
@@ -145,11 +153,14 @@ class MECSubOpReadReply(_JsonMessage):
     `results` answers a multi-oid `reads` request: one
     `[retval, data(base64), size, ver]` row per request entry, aligned
     by index (`oid`/`data`/`size`/`ver` are None on a batched reply —
-    the rows carry everything)."""
+    the rows carry everything).
+
+    `sender`/`qlen`/`degraded` (cephstorm) piggyback the replying OSD's
+    load — see MECSubOpWriteReply."""
 
     MSG_TYPE = 111
     FIELDS = ("tid", "pgid", "oid", "shard", "retval", "data", "size",
-              "xattrs", "ver", "results")
+              "xattrs", "ver", "results", "sender", "qlen", "degraded")
 
 
 @register_message

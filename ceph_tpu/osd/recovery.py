@@ -7,6 +7,7 @@ the tier front-end invoking the replicated backend) resolve on self.
 from __future__ import annotations
 
 
+import time
 
 import numpy as np
 
@@ -28,6 +29,35 @@ from .messages import (
 from ..osd.osdmap import PG_POOL_ERASURE
 from ..osd.osdmap import OSDMap  # noqa: F401 (annotations)
 from .pg import _current_generation, PGState
+
+
+def prune_costly_helpers(avail: set[int], acting: list[int],
+                         my_shard: int, peer_load: dict,
+                         now: float, ttl: float,
+                         max_qlen: int) -> set[int]:
+    """Drop helper shards whose owner OSD measured EXPENSIVE in the
+    freshest piggybacked sub-op telemetry (cephstorm; ROADMAP repair
+    residual): a helper is dropped only when its `_peer_load` row is
+    fresh (<= ttl old) AND reports a degraded backend sentinel or an
+    mClock queue at/over `max_qlen`.  Shards without fresh telemetry
+    are KEPT — with no telemetry at all the result equals `avail`, so
+    the codec's default index-order plan is unchanged.  `my_shard` is
+    never dropped (it anchors generation/size locally, costing no
+    network read).  Pure: unit-testable without a daemon."""
+    keep = set()
+    for s in avail:
+        if s == my_shard:
+            keep.add(s)
+            continue
+        rec = peer_load.get(acting[s])
+        if rec is None or now - rec[0] > ttl:
+            keep.add(s)
+            continue
+        _ts, qlen, degraded = rec
+        if degraded or qlen >= max_qlen:
+            continue
+        keep.add(s)
+    return keep
 
 
 class RecoveryMixin:
@@ -1018,10 +1048,31 @@ class RecoveryMixin:
         } - (exclude or set())
         if my_shard not in avail:
             return None
-        try:
-            plan = codec.minimum_to_decode({lost}, avail)
-        except Exception:
-            return None
+        plan = None
+        if bool(self.cct.conf.get("osd_repair_cost_aware")):
+            # cost-aware helper choice (cephstorm; ROADMAP repair
+            # residual): plan against the CHEAP subset first — helpers
+            # whose piggybacked telemetry shows a deep mClock queue or
+            # a degraded sentinel are pruned.  A codec that cannot plan
+            # from the cheap subset (too few survivors) falls through
+            # to the full availability set, so correctness never hinges
+            # on telemetry.
+            with self._lock:
+                peer_load = dict(self._peer_load)
+            cheap = prune_costly_helpers(
+                avail, acting, my_shard, peer_load, time.monotonic(),
+                float(self.cct.conf.get("osd_repair_telemetry_ttl")),
+                int(self.cct.conf.get("osd_repair_helper_max_qlen")))
+            if cheap != avail:
+                try:
+                    plan = codec.minimum_to_decode({lost}, cheap)
+                except Exception:
+                    plan = None
+        if plan is None or lost in plan:
+            try:
+                plan = codec.minimum_to_decode({lost}, avail)
+            except Exception:
+                return None
         if lost in plan:
             return None  # plan wants the lost chunk itself: nonsense here
         helpers = sorted(plan)

@@ -143,7 +143,18 @@ class MClockScheduler:
 
     def _register_dynamic_locked(self, key: str, params: QoSParams) -> None:
         while len(self._lru) >= self.max_dynamic:
-            self._retire_locked(next(iter(self._lru)))
+            # cephstorm: retiring the raw LRU head evicted classes with
+            # QUEUED ops while idle (empty-queue) classes survived —
+            # under hundreds of identities every eviction spliced live
+            # work into _default_ and unattributed it (retirement
+            # thrash).  Prefer the oldest-touched EMPTY class; only when
+            # every dynamic class holds work does the true LRU head go.
+            victim = next(
+                (k for k in self._lru if not self._classes[k].queue),
+                None,
+            )
+            self._retire_locked(
+                victim if victim is not None else next(iter(self._lru)))
         st = _ClassState(params)
         st.dynamic = True
         now = self._clock()

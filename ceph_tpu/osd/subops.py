@@ -30,6 +30,24 @@ from .pg_log import LogEntry
 
 class SubOpsMixin:
     # -- shard sub-ops -----------------------------------------------------
+    def _load_fields(self) -> dict:
+        """The `sender`/`qlen`/`degraded` kwargs every sub-op reply
+        piggybacks (cephstorm): this OSD's id, its mClock queue depth,
+        and the backend sentinel's degraded latch.  The primary's
+        `_peer_load` map feeds cost-aware repair planning
+        (`_plan_repair_read` skips loaded/degraded helpers)."""
+        try:
+            from ..common.kernel_telemetry import SENTINEL
+
+            degraded = bool(SENTINEL.is_degraded)
+        except Exception:
+            degraded = False
+        return {
+            "sender": self.id,
+            "qlen": self.scheduler.qlen(),
+            "degraded": degraded,
+        }
+
     def _handle_sub_write(self, conn, msg: MECSubOpWrite) -> None:
         pool_id, ps = msg.pgid.split(".")
         pg = self._pg(int(pool_id), int(ps))
@@ -59,7 +77,8 @@ class SubOpsMixin:
                 try:
                     conn.send_message(
                         MECSubOpWriteReply(tid=msg.tid, pgid=msg.pgid,
-                                           shard=msg.shard, retval=-116)
+                                           shard=msg.shard, retval=-116,
+                                           **self._load_fields())
                     )
                 except (OSError, ConnectionError):
                     pass
@@ -281,7 +300,8 @@ class SubOpsMixin:
         try:
             conn.send_message(
                 MECSubOpWriteReply(tid=msg.tid, pgid=msg.pgid,
-                                   shard=msg.shard, retval=retval)
+                                   shard=msg.shard, retval=retval,
+                                   **self._load_fields())
             )
         except (OSError, ConnectionError):
             pass
@@ -305,7 +325,7 @@ class SubOpsMixin:
                 conn.send_message(MECSubOpReadReply(
                     tid=msg.tid, pgid=msg.pgid, oid=msg.oid,
                     shard=msg.shard, retval=-5, data=None, size=None,
-                    xattrs=None, ver=None,
+                    xattrs=None, ver=None, **self._load_fields(),
                 ))
             except (OSError, ConnectionError):
                 pass
@@ -373,11 +393,13 @@ class SubOpsMixin:
                 tid=msg.tid, pgid=msg.pgid, oid=msg.oid, shard=msg.shard,
                 retval=0, data=pack_data(data), size=size, xattrs=user,
                 ver=self._stored_ver(cid, msg.oid),
+                **self._load_fields(),
             )
         except (NotFound, KeyError):
             reply = MECSubOpReadReply(
                 tid=msg.tid, pgid=msg.pgid, oid=msg.oid, shard=msg.shard,
                 retval=-2, data=None, size=None, xattrs=None, ver=None,
+                **self._load_fields(),
             )
         try:
             conn.send_message(reply)
@@ -431,7 +453,7 @@ class SubOpsMixin:
             conn.send_message(MECSubOpReadReply(
                 tid=msg.tid, pgid=msg.pgid, oid=None, shard=msg.shard,
                 retval=0, data=None, size=None, xattrs=None, ver=None,
-                results=rows,
+                results=rows, **self._load_fields(),
             ))
         except (OSError, ConnectionError):
             pass

@@ -1,0 +1,33 @@
+"""cephstorm — thousand-OSD failure-storm simulation with invariant
+gates (docs/storm_sim.md).
+
+The storm harness scales the PR-1 thrasher discipline (pure seeded
+``plan()``, executed against real control planes, gated by an invariant
+checker) past what real OSD daemons can host in one process: hundreds
+to thousands of :class:`~ceph_tpu.qa.storm.stub.StubOSD` objects — an
+in-memory data plane honoring version/ack semantics — under REAL
+monitors (Paxos, OSDMap mutation, health checks), a REAL mgr (digest
+pipeline), real CRUSH placement (batched + scalar paths cross-checked),
+and the production mClock scheduler per stub.
+
+    from ceph_tpu.qa.storm import StormCluster, StormPlanner, \
+        StormInvariantChecker
+    with StormCluster(n_stubs=250, racks=4) as c:
+        p = StormPlanner(cluster=c, seed=1)
+        p.run(400)
+        p.quiesce()
+        StormInvariantChecker(c, p).check()
+"""
+from .cluster import StormCluster
+from .invariants import StormInvariantChecker, run_remap_storm
+from .planner import StormPlanner
+from .stub import SimClock, StubOSD
+
+__all__ = [
+    "SimClock",
+    "StormCluster",
+    "StormInvariantChecker",
+    "StormPlanner",
+    "StubOSD",
+    "run_remap_storm",
+]

@@ -1,0 +1,52 @@
+"""CLI: ``python -m ceph_tpu.qa.storm`` — run a failure storm or a
+bare remap storm and print the invariant report as JSON.
+
+    python -m ceph_tpu.qa.storm --stubs 250 --events 400 --seed 1
+    python -m ceph_tpu.qa.storm remap --osds 512 --pgs 1048576
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import StormCluster, StormInvariantChecker, StormPlanner, \
+    run_remap_storm
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ceph_tpu.qa.storm")
+    ap.add_argument("mode", nargs="?", default="storm",
+                    choices=("storm", "remap"))
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--stubs", type=int, default=250)
+    ap.add_argument("--mons", type=int, default=1)
+    ap.add_argument("--racks", type=int, default=4)
+    ap.add_argument("--events", type=int, default=400)
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--pg-num", type=int, default=64)
+    ap.add_argument("--osds", type=int, default=512,
+                    help="remap mode: bare-map OSD count")
+    ap.add_argument("--pgs", type=int, default=65536,
+                    help="remap mode: bare-map pg_num (1M for the soak)")
+    args = ap.parse_args(argv)
+    if args.mode == "remap":
+        report = run_remap_storm(n_osds=args.osds, pg_num=args.pgs,
+                                 seed=args.seed)
+        print(json.dumps(report, indent=2))
+        return 0
+    with StormCluster(n_stubs=args.stubs, n_mons=args.mons,
+                      racks=args.racks) as c:
+        c.create_pool("stormdata", size=3, pg_num=args.pg_num,
+                      min_size=2)
+        p = StormPlanner(cluster=c, seed=args.seed,
+                         n_tenants=args.tenants)
+        p.run(args.events)
+        p.quiesce()
+        report = StormInvariantChecker(c, p).check()
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

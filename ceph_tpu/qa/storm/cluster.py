@@ -1,0 +1,507 @@
+"""StormCluster — real control planes over hundreds of stub OSDs.
+
+The monitors, Paxos, OSDMap mutation path, health-check assembly and
+the mgr digest pipeline are the PRODUCTION daemons (the same objects
+LocalCluster runs); only the OSDs are stubs.  Stubs need no boot
+protocol: a fresh OSDMap marks every OSD EXISTS|UP and IN, so the
+initial map handed to the monitors presents all N stubs as up.  Kill
+is the mon path (``osd down`` + ``osd out``), revive re-enters through
+the leader's ``handle_boot`` (the only path that marks up) plus
+``osd in`` — every map change is a committed Paxos proposal, exactly
+the churn a real failure storm generates.
+
+The data plane is client-driven: :meth:`write` maps the object through
+the CURRENT map's scalar path, fans the shard write out to acting
+stubs (each recv gated by the ``storm.stub.recv`` failpoint — rack
+netsplits arm two match entries per split), and acks iff ``min_size``
+shards committed — the ``acked`` dict is the no-acked-write-loss
+contract the checker holds the storm to.
+
+Forecast-vs-observed: every map-changing event snapshots the batched
+``map_pool`` arrays before/after and accumulates a
+:func:`~ceph_tpu.osd.placement.diff_mappings` forecast next to the
+scalar churn count (independent mapping path) — the checker's <=10%
+agreement gate, placement_smoke's comparison at storm scale.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ...client.rados import Rados
+from ...common.context import CephContext
+from ...common.failpoint import registry
+from ...crush import CrushWrapper, build_hierarchical_map
+from ...mgr import MgrDaemon
+from ...mon import MonMap, Monitor
+from ...osd.osdmap import OSDMap, object_ps
+from ...osd.placement import diff_mappings
+from ..vstart import _free_addrs
+from .stub import SimClock, StubOSD
+
+
+def storm_payload(oid: str, version: int, size: int) -> bytes:
+    """The deterministic payload of (oid, version) — the planner never
+    ships bytes, so replay needs no payload log."""
+    seedb = f"{oid}:{version}:".encode()
+    reps = -(-size // len(seedb))
+    return (seedb * reps)[:size]
+
+
+class StormCluster:
+    def __init__(self, n_stubs: int = 250, n_mons: int = 1,
+                 racks: int = 4, osds_per_host: int = 4,
+                 max_dynamic: int = 32,
+                 conf_overrides: dict | None = None,
+                 with_mgr: bool = True):
+        self.n_stubs = n_stubs
+        self.n_mons = n_mons
+        self.racks = max(1, racks)
+        self.osds_per_host = osds_per_host
+        self.max_dynamic = max_dynamic
+        self.with_mgr = with_mgr
+        self.conf_overrides = {
+            # storms out explicitly; the grace must not race the plan
+            "mon_osd_down_out_interval": 3600.0,
+            "mgr_digest_interval": 0.2,
+            "mgr_modules": "status",
+            **(conf_overrides or {}),
+        }
+        self.clock = SimClock()
+        self.mons: dict[str, Monitor] = {}
+        self.mgr: MgrDaemon | None = None
+        self.stubs: dict[int, StubOSD] = {}
+        self.mon_addrs: list = []
+        self._admin: Rados | None = None
+        #: (pool_name, oid) -> (version, payload) for every ACKED write
+        self.acked: dict[tuple[str, str], tuple[int, bytes]] = {}
+        #: (pool_name, oid) -> highest version ever issued (write path)
+        self._version_counters: dict[tuple[str, str], int] = {}
+        #: armed rack splits: (rack_a, rack_b) -> [entry ids]
+        self._split_tokens: dict[tuple[int, int], list[int]] = {}
+        #: accumulated remap churn: forecast (batched diff_mappings)
+        #: vs observed (scalar pg_to_up_acting churn), in shards
+        self.remap = {"events": 0, "forecast_shards": 0,
+                      "observed_shards": 0}
+        #: health checks seen raised during the storm (the raise half
+        #: of the raise-and-clear symmetry invariant)
+        self.raised_checks: set[str] = set()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "StormCluster":
+        hosts = -(-self.n_stubs // self.osds_per_host)
+        cmap = build_hierarchical_map(hosts, self.osds_per_host,
+                                      racks=self.racks)
+        initial = OSDMap(CrushWrapper(cmap), max_osd=self.n_stubs)
+        addrs = _free_addrs(self.n_mons)
+        self.mon_addrs = [list(a) for a in addrs]
+        names = [chr(ord("a") + i) for i in range(self.n_mons)]
+        monmap = MonMap({names[i]: addrs[i] for i in range(self.n_mons)})
+        for nm in names:
+            cct = CephContext(f"mon.{nm}",
+                              overrides=dict(self.conf_overrides))
+            mon = Monitor(cct, nm, monmap, initial_osdmap=initial)
+            self.mons[nm] = mon
+            mon.start()
+        deadline = time.time() + 15
+        while time.time() < deadline and not any(
+                m.is_leader() for m in self.mons.values()):
+            time.sleep(0.05)
+        if not any(m.is_leader() for m in self.mons.values()):
+            raise TimeoutError("no mon leader")
+        if self.with_mgr:
+            self.mgr = MgrDaemon(
+                CephContext("mgr", overrides=dict(self.conf_overrides)),
+                self.mon_addrs)
+            self.mgr.start()
+        per = max(1, hosts // self.racks)
+        for i in range(self.n_stubs):
+            host = i // self.osds_per_host
+            rack = min(host // per, self.racks - 1)
+            self.stubs[i] = StubOSD(i, rack, host, self.clock,
+                                    max_dynamic=self.max_dynamic)
+        self._admin = Rados(
+            CephContext("client.storm-admin",
+                        overrides=dict(self.conf_overrides)),
+            self.mon_addrs, name="client.storm-admin")
+        self._admin.connect()
+        return self
+
+    def stop(self) -> None:
+        for pair in list(self._split_tokens):
+            self.heal_racks(*pair)
+        if self._admin is not None:
+            self._admin.shutdown()
+            self._admin = None
+        if self.mgr is not None:
+            self.mgr.shutdown()
+        for mon in self.mons.values():
+            mon.shutdown()
+
+    def __enter__(self) -> "StormCluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- control plane -----------------------------------------------------
+    def _leader(self) -> Monitor:
+        for m in self.mons.values():
+            if m.is_leader():
+                return m
+        raise RuntimeError("no leader")
+
+    def mon_command(self, cmd: dict, tries: int = 3):
+        for i in range(tries):
+            try:
+                rv, res = self._admin.command(cmd)
+                if rv == 0 or i == tries - 1:
+                    return rv, res
+            except (IOError, OSError, TimeoutError):
+                if i == tries - 1:
+                    raise
+            time.sleep(0.2 * (i + 1))
+        return rv, res
+
+    def osdmap(self) -> OSDMap:
+        m = self._leader().osdmon.osdmap
+        assert m is not None, "no committed osdmap"
+        return m
+
+    def create_pool(self, name: str, size: int = 3, pg_num: int = 32,
+                    min_size: int | None = None) -> int:
+        rv, res = self.mon_command({
+            "prefix": "osd pool create", "name": name, "pg_num": pg_num,
+            "size": size,
+            **({"min_size": min_size} if min_size is not None else {}),
+        })
+        assert rv == 0, (rv, res)
+        self.mon_command({"prefix": "osd pool application enable",
+                          "pool": name, "app": "rados"})
+        return (res or {}).get("pool_id")
+
+    def pool_id(self, name: str) -> int:
+        m = self.osdmap()
+        return next(i for i, p in m.pools.items() if p.name == name)
+
+    # -- failure plane -----------------------------------------------------
+    #: scalar ground-truth PGs cross-checked per map change — the
+    #: independent mapping path pinning the batched arrays
+    SCALAR_SAMPLE = 4
+
+    def _map_change(self, fn) -> None:
+        """Run one map-mutating closure between batched mapping
+        snapshots; accumulate the diff_mappings forecast next to the
+        observed membership churn, and pin a rotating sample of PGs to
+        the scalar reference mapper (independent-path cross-check —
+        the full scalar sweep is what a thousand-stub storm cannot
+        afford per event)."""
+        prev = self._batched_mappings()
+        fn()
+        m = self.osdmap()
+        cur = self._batched_mappings()
+        fc = diff_mappings(m, prev, cur)
+        observed = 0
+        for pid in set(prev) & set(cur):
+            a, b = prev[pid], cur[pid]
+            observed += int((~(b[:, :, None] == a[:, None, :]).any(axis=2)
+                             & (b >= 0)).sum())
+        ev = self.remap["events"]
+        for pid, b in sorted(cur.items()):
+            pg_num = b.shape[0]
+            for k in range(min(self.SCALAR_SAMPLE, pg_num)):
+                ps = (ev * 7 + k * 13) % pg_num
+                u, _up, _a, _ap = m.pg_to_up_acting_osds(pid, ps)
+                su = [o for o in u if o >= 0]
+                bu = [int(x) for x in b[ps] if x >= 0]
+                assert su == bu, (
+                    f"batched/scalar mapping divergence pg {pid}.{ps}: "
+                    f"scalar={su} batched={bu}")
+        self.remap["events"] = ev + 1
+        self.remap["forecast_shards"] += int(fc["shards_remapped"])
+        self.remap["observed_shards"] += observed
+
+    def _batched_mappings(self) -> dict:
+        """{pool_id: up[pg_num, size] ndarray} via the batched mapper."""
+        return {pid: up for pid, (up, _p) in
+                self._pool_arrays().items()}
+
+    def _pool_arrays(self) -> dict:
+        """{pool_id: (up, up_primary) ndarrays}, cached per osdmap
+        epoch — ticks between map changes reuse one batched CRUSH
+        evaluation instead of re-launching it per tick."""
+        m = self.osdmap()
+        cached = self.__dict__.get("_pool_array_cache")
+        if cached is not None and cached[0] == m.epoch:
+            return cached[1]
+        arrays = {pid: tuple(np.asarray(a) for a in m.map_pool(pid))
+                  for pid in m.pools}
+        self._pool_array_cache = (m.epoch, arrays)
+        return arrays
+
+    def kill_stub(self, i: int) -> None:
+        stub = self.stubs[i]
+        if not stub.alive:
+            return
+        stub.alive = False
+
+        def out():
+            self.mon_command({"prefix": "osd down", "id": i})
+            self.mon_command({"prefix": "osd out", "id": i})
+        self._map_change(out)
+
+    def revive_stub(self, i: int) -> None:
+        stub = self.stubs[i]
+        if stub.alive:
+            return
+        stub.alive = True
+
+        def back():
+            self._leader().osdmon.handle_boot(i, ("127.0.0.1", 0))
+            self.mon_command({"prefix": "osd in", "id": i})
+        self._map_change(back)
+
+    def kill_rack(self, rack: int) -> None:
+        """Cascading rack failure — one map-change burst, one down and
+        one out proposal (the batched `ids` form) however many stubs
+        the rack holds."""
+        victims = [i for i, s in sorted(self.stubs.items())
+                   if s.rack == rack and s.alive]
+        if not victims:
+            return
+        for i in victims:
+            self.stubs[i].alive = False
+
+        def out():
+            self.mon_command({"prefix": "osd down", "ids": victims})
+            self.mon_command({"prefix": "osd out", "ids": victims})
+        self._map_change(out)
+
+    def revive_rack(self, rack: int) -> None:
+        back = [i for i, s in sorted(self.stubs.items())
+                if s.rack == rack and not s.alive]
+        if not back:
+            return
+        for i in back:
+            self.stubs[i].alive = True
+
+        def boot():
+            osdmon = self._leader().osdmon
+            for i in back:
+                osdmon.handle_boot(i, ("127.0.0.1", 0))
+            self.mon_command({"prefix": "osd in", "ids": back})
+        self._map_change(boot)
+
+    def reweight(self, osd: int, weight: float) -> None:
+        self._map_change(lambda: self.mon_command(
+            {"prefix": "osd reweight", "id": osd, "weight": weight}))
+
+    def split_racks(self, a: int, b: int) -> None:
+        """Recv-drop netsplit between two racks: O(1) failpoint entries
+        per direction, whatever the rack population."""
+        if (a, b) in self._split_tokens:
+            return
+        reg = registry()
+        toks = []
+        for src, dst in ((a, b), (b, a)):
+            toks.append(reg.add("storm.stub.recv", "error",
+                                match={"src_rack": src, "dst_rack": dst}))
+        self._split_tokens[(a, b)] = toks
+
+    def heal_racks(self, a: int, b: int) -> None:
+        for eid in self._split_tokens.pop((a, b), []):
+            registry().remove("storm.stub.recv", eid=eid)
+
+    def mon_churn(self, name: str) -> None:
+        mon = self.mons.get(name)
+        if mon is not None:
+            mon.elector.start_election()
+
+    # -- data plane --------------------------------------------------------
+    def write(self, pool: str, oid: str, size: int,
+              client_key: str | None = None) -> bool:
+        """One client write through the current map: fan the versioned
+        payload out to the acting stubs; ack iff >= min_size committed.
+        Returns the ack; acked writes land in ``self.acked``."""
+        m = self.osdmap()
+        pid = self.pool_id(pool)
+        p = m.pools[pid]
+        ps = object_ps(oid, p.pg_num)
+        _up, _upp, acting, primary = m.pg_to_up_acting_osds(pid, ps)
+        if primary < 0:
+            return False
+        vkey = (pool, oid)
+        version = self._version_counters.get(vkey, 0) + 1
+        self._version_counters[vkey] = version
+        payload = storm_payload(oid, version, size)
+        src = self.stubs[primary]
+        if not src.alive:
+            return False
+        durable = 0
+        for o in acting:
+            if o < 0:
+                continue
+            dst = self.stubs[o]
+            if o != primary and not dst.reachable_from(src):
+                continue
+            if dst.apply_write(pid, ps, oid, version, payload,
+                               client_key=client_key):
+                durable += 1
+        min_size = p.min_size or (p.size // 2 + 1)
+        if durable >= min_size:
+            self.acked[vkey] = (version, payload)
+            return True
+        return False
+
+    def read(self, pool: str, oid: str) -> tuple[int, bytes] | None:
+        """Newest stored (version, payload) among reachable acting
+        shards, primary's view — None when nothing is reachable."""
+        m = self.osdmap()
+        pid = self.pool_id(pool)
+        p = m.pools[pid]
+        ps = object_ps(oid, p.pg_num)
+        _up, _upp, acting, primary = m.pg_to_up_acting_osds(pid, ps)
+        if primary < 0 or not self.stubs[primary].alive:
+            return None
+        src = self.stubs[primary]
+        best = None
+        for o in acting:
+            if o < 0:
+                continue
+            dst = self.stubs[o]
+            if o != primary and not dst.reachable_from(src):
+                continue
+            got = dst.lookup(pid, ps, oid)
+            if got is not None and (best is None or got[0] > best[0]):
+                best = got
+        return best
+
+    # -- ticks: time, QoS drain, mgr feed, health poll ---------------------
+    def tick(self, dt: float = 0.5) -> None:
+        self.clock.advance(dt)
+        degraded, primaries = self._degraded_by_pg(with_primaries=True)
+        by_primary: dict[int, dict[str, int]] = {}
+        for pgid, n in degraded.items():
+            by_primary.setdefault(primaries[pgid], {})[pgid] = n
+        for i, s in sorted(self.stubs.items()):
+            if not s.alive:
+                continue
+            s.drain()
+            if self.mgr is not None:
+                self.mgr.ingest_local_report(
+                    f"osd.{i}", s.mgr_counters(),
+                    stats=s.mgr_stats(by_primary.get(i, {})))
+        for check in self.health_checks():
+            self.raised_checks.add(check)
+
+    def _touched_pgs(self) -> set[tuple[int, int]]:
+        """(pool_id, ps) pairs holding objects on ANY stub — the only
+        PGs degraded/recovery scans need to visit."""
+        touched: set[tuple[int, int]] = set()
+        for s in self.stubs.values():
+            for key, objs in s.store.items():
+                if objs:
+                    touched.add(key)
+        return touched
+
+    def _newest_by_pg(self) -> dict[tuple[int, int],
+                                    dict[str, tuple[int, bytes]]]:
+        """{(pool_id, ps): {oid: newest (version, payload)}} across
+        EVERY stub's store, not just the current acting set.  Stores
+        survive kills, so any holder is a legal recovery source — the
+        sim analog of past-interval peers: reweight churn can remap a
+        PG's whole acting set away from the shards that took a write,
+        and recovery must still find those bytes."""
+        newest: dict[tuple[int, int], dict[str, tuple[int, bytes]]] = {}
+        for s in self.stubs.values():
+            for key, objs in s.store.items():
+                dst = newest.setdefault(key, {})
+                for oid, rec in objs.items():
+                    if oid not in dst or rec[0] > dst[oid][0]:
+                        dst[oid] = rec
+        return newest
+
+    def _degraded_by_pg(self, with_primaries: bool = False):
+        """{pgid: missing object copies} — acting shards missing objects
+        (or holding stale versions) relative to the newest holder.  One
+        batched CRUSH evaluation per pool; only object-holding PGs are
+        scanned, so cost tracks data, not pg_num x stubs."""
+        m = self.osdmap()
+        arrays = self._pool_arrays()
+        out: dict[str, int] = {}
+        prim: dict[str, int] = {}
+        for (pid, ps), recs in sorted(self._newest_by_pg().items()):
+            pool = m.pools.get(pid)
+            if pool is None or ps >= pool.pg_num:
+                continue
+            up, upp = arrays[pid]
+            live = [int(o) for o in up[ps] if o >= 0]
+            newest = {oid: rec[0] for oid, rec in recs.items()}
+            if not newest:
+                continue
+            deg = 0
+            for o in live:
+                objs = self.stubs[o].store.get((pid, ps)) or {}
+                for oid, v in newest.items():
+                    got = objs.get(oid)
+                    if got is None or got[0] < v:
+                        deg += 1
+            deg += len(newest) * max(0, pool.size - len(live))
+            if deg:
+                pgid = f"{pid}.{ps}"
+                out[pgid] = deg
+                prim[pgid] = int(upp[ps])
+        return (out, prim) if with_primaries else out
+
+    def health_checks(self) -> dict:
+        try:
+            rv, st = self.mon_command({"prefix": "status"}, tries=1)
+        except (IOError, OSError, TimeoutError):
+            return {}
+        if rv != 0:
+            return {}
+        return (st.get("health") or {}).get("checks") or {}
+
+    # -- quiesce + recovery ------------------------------------------------
+    def quiesce(self, timeout: float = 60.0) -> None:
+        """Heal every split, revive every stub, run sim recovery (copy
+        newest versions onto every acting shard), drain, and wait for
+        the raised health checks to clear — the checker precondition."""
+        for pair in list(self._split_tokens):
+            self.heal_racks(*pair)
+        for i, s in sorted(self.stubs.items()):
+            if not s.alive:
+                self.revive_stub(i)
+        self.recover()
+        self.tick(1.0)
+        while any(s.scheduler.qlen() for s in self.stubs.values()):
+            self.tick(1.0)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            self.tick(0.0)
+            live = set(self.health_checks()) & self.raised_checks
+            if not live:
+                return
+            time.sleep(0.3)
+        raise TimeoutError(
+            f"health checks never cleared: "
+            f"{sorted(set(self.health_checks()) & self.raised_checks)}")
+
+    def recover(self) -> None:
+        """Copy each object's newest (version, payload) onto every
+        acting shard — the sim analog of log/backfill recovery."""
+        m = self.osdmap()
+        arrays = {pid: up for pid, (up, _p) in
+                  self._pool_arrays().items()}
+        for (pid, ps), newest in sorted(self._newest_by_pg().items()):
+            pool = m.pools.get(pid)
+            if pool is None or ps >= pool.pg_num:
+                continue
+            live = [int(o) for o in arrays[pid][ps] if o >= 0]
+            for o in live:
+                objs = self.stubs[o].store.setdefault((pid, ps), {})
+                for oid, rec in newest.items():
+                    cur = objs.get(oid)
+                    if cur is None or cur[0] < rec[0]:
+                        objs[oid] = rec

@@ -1,0 +1,275 @@
+"""Storm invariant gates — what a failure storm must NEVER break.
+
+:class:`StormInvariantChecker` runs after ``quiesce()`` and raises
+``AssertionError`` with a storm-replay recipe (seed + plan digest) on
+the first violation:
+
+1. **No acked-write loss** — every write the cluster acked reads back
+   at a version >= the acked version, with the payload integral for
+   whatever version is returned (a newer unacked write superseding an
+   acked one is legal; silent loss or corruption is not).
+2. **All PGs clean** — after quiesce + recovery, every acting shard of
+   every PG holds identical object/version sets; nothing degraded.
+3. **Forecast vs observed churn** — the batched
+   :func:`~ceph_tpu.osd.placement.diff_mappings` forecast accumulated
+   across every map change agrees with the scalar observed shard churn
+   within 10%.
+4. **Bounded controller oscillation** — the closed QoS loop (pure
+   :class:`~ceph_tpu.mgr.qos_module.QoSController` against a linear
+   queue model) stops flip-flopping once settled; the pre-hysteresis
+   limit cycle (``queue_p99_recover_frac=1.0``) is the regression this
+   gate pins.
+5. **QoS class conservation** — per stub, every enqueued op is
+   accounted: served by a live class, still queued, or folded into
+   the retirement aggregate; dynamic class count never exceeds the cap.
+6. **Health raise-and-clear symmetry** — every check the storm raised
+   is clear after quiesce.
+7. **Replay determinism** — re-planning the same seed on a detached
+   planner reproduces the event list and the plan digest bit-for-bit.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from ...crush import CrushWrapper, build_hierarchical_map
+from ...mgr.qos_module import QoSClamps, QoSController, QoSObservation
+from ...osd.osdmap import OSDMap
+from ...osd.placement import diff_mappings
+from .planner import StormPlanner
+
+#: forecast-vs-observed agreement: |fc - ob| <= max(floor, FRAC * ob)
+CHURN_TOLERANCE = 0.10
+CHURN_FLOOR = 8
+
+
+def controller_flip_count(recover_frac: float = 0.8, steps: int = 60,
+                          gain: float = 3.5, op_rate: float = 2000.0,
+                          max_stripes: int = 64) -> int:
+    """Drive the pure controller closed-loop against a linear queue
+    model (p99 = gain * window) and count window direction flips in the
+    settled second half.  ``recover_frac=1.0`` — back off above target
+    but regrow the moment p99 dips under it — reproduces the limit
+    cycle the hysteresis band removes; 0.8 settles to zero flips."""
+    ctrl = QoSController(QoSClamps(queue_p99_recover_frac=recover_frac))
+    window, last_delta, flips = 4.0, 0.0, 0
+    for step in range(steps):
+        obs = QoSObservation(window_ms=window, max_stripes=max_stripes,
+                             queue_p99_ms=gain * window,
+                             op_rate=op_rate)
+        new = ctrl.plan(obs)["window_ms"]
+        delta = new - window
+        if step >= steps // 2 and delta * last_delta < 0:
+            flips += 1
+        if abs(delta) > 1e-3:
+            last_delta = delta
+        window = new
+    return flips
+
+
+class StormInvariantChecker:
+    def __init__(self, cluster, planner: StormPlanner):
+        self.cluster = cluster
+        self.planner = planner
+
+    def _recipe(self) -> str:
+        md = self.planner.metadata()
+        return (f"replay: seed={md['seed']} n_stubs={md['n_stubs']} "
+                f"digest={md['plan_digest']}")
+
+    def check(self) -> dict:
+        report = {"recipe": self.planner.metadata()}
+        report["acked_writes"] = self.check_no_acked_write_loss()
+        report["pgs"] = self.check_pgs_clean()
+        report["remap"] = self.check_forecast_vs_observed()
+        report["controller_flips"] = self.check_controller_oscillation()
+        report["qos"] = self.check_class_conservation()
+        report["health"] = self.check_health_symmetry()
+        report["replay"] = self.check_replay_determinism()
+        return report
+
+    # 1 ---------------------------------------------------------------------
+    def check_no_acked_write_loss(self) -> dict:
+        c = self.cluster
+        lost, checked = [], 0
+        for (pool, oid), (version, payload) in sorted(c.acked.items()):
+            got = c.read(pool, oid)
+            checked += 1
+            if got is None or got[0] < version:
+                lost.append((pool, oid, version,
+                             None if got is None else got[0]))
+                continue
+            gv, gp = got
+            want = f"{oid}:{gv}:".encode()
+            if gv == version and gp != payload:
+                lost.append((pool, oid, version, "corrupt"))
+            elif gv > version and not gp.startswith(want[:len(gp)]):
+                lost.append((pool, oid, version, f"corrupt@{gv}"))
+        assert not lost, (
+            f"ACKED WRITE LOSS: {lost[:5]} (+{max(0, len(lost)-5)} more); "
+            f"{self._recipe()}")
+        return {"checked": checked, "lost": 0}
+
+    # 2 ---------------------------------------------------------------------
+    def check_pgs_clean(self) -> dict:
+        c = self.cluster
+        degraded = c._degraded_by_pg()
+        assert not degraded, (
+            f"PGS NOT CLEAN after quiesce: {dict(sorted(degraded.items())[:5])}; "
+            f"{self._recipe()}")
+        m = c.osdmap()
+        arrays = {pid: np.asarray(m.map_pool(pid)[0]) for pid in m.pools}
+        pgs = 0
+        for pid, ps in sorted(c._touched_pgs()):
+            if pid not in arrays or ps >= arrays[pid].shape[0]:
+                continue
+            acting = [int(o) for o in arrays[pid][ps] if o >= 0]
+            views = [
+                {o: v for o, (v, _pl) in
+                 (c.stubs[s].store.get((pid, ps)) or {}).items()}
+                for s in acting
+            ]
+            assert all(v == views[0] for v in views[1:]), (
+                f"PG {pid}.{ps} shards diverge after quiesce; "
+                f"{self._recipe()}")
+            pgs += 1
+        return {"pgs": pgs, "degraded": 0}
+
+    # 3 ---------------------------------------------------------------------
+    def check_forecast_vs_observed(self) -> dict:
+        r = dict(self.cluster.remap)
+        fc, ob = r["forecast_shards"], r["observed_shards"]
+        tol = max(CHURN_FLOOR, CHURN_TOLERANCE * ob)
+        assert abs(fc - ob) <= tol, (
+            f"REMAP FORECAST DRIFT: forecast={fc} observed={ob} "
+            f"tolerance={tol:.1f} over {r['events']} map changes; "
+            f"{self._recipe()}")
+        r["tolerance"] = tol
+        return r
+
+    # 4 ---------------------------------------------------------------------
+    def check_controller_oscillation(self, max_flips: int = 2) -> int:
+        flips = controller_flip_count()
+        assert flips <= max_flips, (
+            f"QOS CONTROLLER OSCILLATES: {flips} window direction flips "
+            f"after settling (max {max_flips}); {self._recipe()}")
+        return flips
+
+    # 5 ---------------------------------------------------------------------
+    def check_class_conservation(self) -> dict:
+        c = self.cluster
+        total_enqueued = total_classes = 0
+        for i, s in sorted(c.stubs.items()):
+            d = s.scheduler.dump()
+            served = sum(row["served"] for row in d["classes"].values())
+            depth = sum(row["depth"] for row in d["classes"].values())
+            accounted = served + depth + d["retired_served"]
+            assert accounted == s.enqueued, (
+                f"QOS CLASS LEAK on osd.{i}: enqueued={s.enqueued} "
+                f"served={served} depth={depth} "
+                f"retired_served={d['retired_served']}; {self._recipe()}")
+            assert d["dynamic_classes"] <= d["max_dynamic"], (
+                f"DYNAMIC CLASS OVERFLOW on osd.{i}: "
+                f"{d['dynamic_classes']} > {d['max_dynamic']}; "
+                f"{self._recipe()}")
+            total_enqueued += s.enqueued
+            total_classes += d["dynamic_classes"]
+        return {"enqueued": total_enqueued,
+                "dynamic_classes": total_classes}
+
+    # 6 ---------------------------------------------------------------------
+    def check_health_symmetry(self) -> dict:
+        c = self.cluster
+        still = sorted(set(c.health_checks()) & c.raised_checks)
+        assert not still, (
+            f"HEALTH CHECKS STUCK after quiesce: {still}; "
+            f"{self._recipe()}")
+        return {"raised": sorted(c.raised_checks), "stuck": []}
+
+    # 7 ---------------------------------------------------------------------
+    def check_replay_determinism(self) -> dict:
+        p = self.planner
+        twin = StormPlanner(
+            cluster=None, seed=p.seed, n_stubs=p.n_stubs,
+            n_mons=p.n_mons, racks=p.racks,
+            osds_per_host=p.osds_per_host, pool=p.pool,
+            n_tenants=p.n_tenants,
+            objects_per_tenant=p.objects_per_tenant,
+            max_dead_frac=p.max_dead_frac, max_splits=p.max_splits)
+        events = twin.plan(len(p.events))
+        assert events == p.events, (
+            f"REPLAY DIVERGENCE: twin plan differs at event "
+            f"{next(i for i, (a, b) in enumerate(zip(events, p.events)) if a != b)}; "
+            f"{self._recipe()}")
+        digest = twin.plan_digest()
+        assert digest == p.plan_digest(), (
+            f"REPLAY DIGEST MISMATCH: {digest} != {p.plan_digest()}; "
+            f"{self._recipe()}")
+        return {"events": len(events), "digest": digest}
+
+
+def run_remap_storm(n_osds: int = 64, pg_num: int = 1024,
+                    seed: int = 0, rounds: int = 4,
+                    sample: int = 256, size: int = 3) -> dict:
+    """Remap storm on a bare OSDMap (no daemons): each round marks a
+    random cohort of OSDs out (or back in), forecasts the churn with
+    batched :func:`diff_mappings`, and cross-checks the batched mapping
+    against the scalar ``pg_to_up_acting_osds`` path on a seeded PG
+    sample.  Scales to 1M PGs (the ``-m slow`` soak / CLI) because the
+    forecast is one batched CRUSH evaluation per round.
+
+    Returns a report; raises AssertionError if batched and scalar
+    mappings disagree on the sample, or forecast drifts >10% from the
+    batched observation.
+    """
+    rng = random.Random(seed)
+    hosts = -(-n_osds // 4)
+    m = OSDMap(CrushWrapper(build_hierarchical_map(hosts, 4, racks=4)),
+               max_osd=n_osds)
+    m.create_pool(1, pg_num=pg_num, size=size, crush_rule=0,
+                  name="remapstorm")
+    pgs = sorted(rng.sample(range(pg_num), min(sample, pg_num)))
+    out: list[int] = []
+    total_fc = total_ob = 0
+    for rd in range(rounds):
+        prev, _ = m.map_pool(1)
+        prev = np.asarray(prev)
+        if rd % 2 == 0 or not out:
+            cohort = rng.sample(
+                [o for o in range(n_osds) if o not in out],
+                max(1, n_osds // 16))
+            for o in cohort:
+                m.mark_out(o)
+            out.extend(cohort)
+        else:
+            back = rng.sample(out, max(1, len(out) // 2))
+            for o in back:
+                m.mark_in(o)
+            out = [o for o in out if o not in back]
+        cur, _ = m.map_pool(1)
+        cur = np.asarray(cur)
+        fc = diff_mappings(m, {1: prev}, {1: cur})
+        # observed churn straight off the batched arrays (membership)
+        ob = int((~(cur[:, :, None] == prev[:, None, :]).any(axis=2)
+                  & (cur >= 0)).sum())
+        total_fc += int(fc["shards_remapped"])
+        total_ob += ob
+        # independent-path cross-check: scalar mapper on the PG sample
+        for ps in pgs:
+            u, _up, _a, _ap = m.pg_to_up_acting_osds(1, ps)
+            su = [o for o in u if o >= 0]
+            bu = [int(o) for o in cur[ps] if o >= 0]
+            assert su == bu, (
+                f"BATCHED/SCALAR MAPPING DIVERGENCE pg 1.{ps} round "
+                f"{rd}: scalar={su} batched={bu} seed={seed}")
+    tol = max(CHURN_FLOOR, CHURN_TOLERANCE * total_ob)
+    assert abs(total_fc - total_ob) <= tol, (
+        f"REMAP FORECAST DRIFT: forecast={total_fc} observed={total_ob} "
+        f"tolerance={tol:.1f} seed={seed}")
+    return {
+        "n_osds": n_osds, "pg_num": pg_num, "rounds": rounds,
+        "seed": seed, "sampled_pgs": len(pgs),
+        "forecast_shards": total_fc, "observed_shards": total_ob,
+        "tolerance": tol,
+    }

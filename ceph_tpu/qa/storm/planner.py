@@ -1,0 +1,258 @@
+"""StormPlanner — pure seeded storm plans, thrasher discipline at scale.
+
+``plan()`` is a pure function of the constructor parameters: the same
+(seed, shape) always yields the same event list and the same
+``plan_digest()``.  The planner mirrors cluster state (dead stubs,
+armed splits, weights) WHILE drawing so eligibility filters never
+depend on execution — which is what makes replay exact: the checker
+re-plans with the same seed and asserts event-for-event equality.
+
+Event vocabulary (fixed draw order — reordering ``_KINDS`` changes
+digests, so treat it as part of the wire format):
+
+* ``("write", pool, oid, size, client_key)`` / ``("read", pool, oid)``
+  — tenant traffic from :mod:`ceph_tpu.bench.traffic`'s
+  ``tenant_next_op`` (RGW S3 / CephFS metadata / RBD snapshot mixes,
+  bursty/diurnal arrival, hot-object populations), one
+  ``derive_rng(seed, "tenant", i)`` stream per tenant.
+* ``("idle",)`` — a thinned arrival draw; kept in the plan so plan
+  length and digests are deterministic.
+* ``("tick", dt)`` — advance sim time, drain schedulers, feed the mgr.
+* ``("kill", osd)`` / ``("revive", osd)`` — single-OSD churn.
+* ``("kill_rack", r)`` / ``("revive_rack", r)`` — cascading failure.
+* ``("netsplit", a, b)`` / ``("heal", a, b)`` — recv-drop rack splits.
+* ``("reweight", osd, w)`` — remap churn without failures.
+* ``("mon_churn", name)`` — force a re-election on one monitor.
+"""
+from __future__ import annotations
+
+import hashlib
+import random
+
+from ...bench.traffic import (
+    DEFAULT_SEED,
+    TENANT_KINDS,
+    derive_rng,
+    tenant_next_op,
+    tenant_objects,
+)
+
+# (kind, weight) in FIXED order — the draw distribution is part of the
+# plan's identity, exactly the thrasher's _KINDS discipline.
+_KINDS = (
+    ("op", 10),
+    ("tick", 6),
+    ("kill", 3),
+    ("revive", 3),
+    ("kill_rack", 1),
+    ("revive_rack", 1),
+    ("netsplit", 2),
+    ("heal", 2),
+    ("reweight", 2),
+    ("mon_churn", 1),
+)
+
+
+class StormPlanner:
+    def __init__(self, cluster=None, seed: int = DEFAULT_SEED,
+                 n_stubs: int | None = None, n_mons: int | None = None,
+                 racks: int | None = None,
+                 osds_per_host: int | None = None,
+                 pool: str = "stormdata",
+                 n_tenants: int = 4, objects_per_tenant: int = 64,
+                 max_dead_frac: float = 0.3, max_splits: int = 2):
+        self.cluster = cluster
+        self.seed = int(seed)
+        self.n_stubs = n_stubs if n_stubs is not None else cluster.n_stubs
+        self.n_mons = n_mons if n_mons is not None else cluster.n_mons
+        self.racks = racks if racks is not None else cluster.racks
+        self.osds_per_host = (osds_per_host if osds_per_host is not None
+                              else cluster.osds_per_host)
+        self.pool = pool
+        self.n_tenants = n_tenants
+        self.objects_per_tenant = objects_per_tenant
+        self.max_dead_frac = max_dead_frac
+        self.max_splits = max_splits
+        self.events: list[tuple] = []
+        #: executed-event log (run()) — the replay-equality artifact
+        self.executed: list[tuple] = []
+
+    # -- topology mirror (must agree with StormCluster.start) --------------
+    def rack_of(self, osd: int) -> int:
+        hosts = -(-self.n_stubs // self.osds_per_host)
+        per = max(1, hosts // self.racks)
+        return min((osd // self.osds_per_host) // per, self.racks - 1)
+
+    # -- pure planning ------------------------------------------------------
+    def plan(self, n_events: int) -> list[tuple]:
+        rng = random.Random(self.seed)
+        kinds = [k for k, _w in _KINDS]
+        weights = [w for _k, w in _KINDS]
+        tenants = []
+        for i in range(self.n_tenants):
+            kind = TENANT_KINDS[i % len(TENANT_KINDS)]
+            name = f"tenant{i}"
+            tenants.append({
+                "name": name, "kind": kind,
+                "objects": tenant_objects(kind, name,
+                                          self.objects_per_tenant),
+                "rng": derive_rng(self.seed, "tenant", i),
+            })
+        # state mirror the eligibility filters run against
+        dead: set[int] = set()
+        splits: set[tuple[int, int]] = set()
+        weights_by_osd: dict[int, float] = {}
+        max_dead = int(self.max_dead_frac * self.n_stubs)
+        by_rack: dict[int, list[int]] = {}
+        for o in range(self.n_stubs):
+            by_rack.setdefault(self.rack_of(o), []).append(o)
+
+        events: list[tuple] = []
+        t = tenants[0]
+        first = tenant_next_op(t["kind"], t["rng"], t["objects"],
+                               t_frac=0.0)
+        if first is None or first[0] != "write":
+            first = ("write", t["objects"][0],
+                     {"s3": 8192, "fs": 512, "rbd": 4096}[t["kind"]])
+        events.append(("write", self.pool, first[1], first[2],
+                       f"{t['name']}/{self.pool}"))
+        while len(events) < n_events:
+            t_frac = len(events) / max(1, n_events)
+            kind = rng.choices(kinds, weights=weights)[0]
+            if kind == "op":
+                t = tenants[rng.randrange(len(tenants))]
+                got = tenant_next_op(t["kind"], t["rng"], t["objects"],
+                                     t_frac=t_frac)
+                if got is None:
+                    events.append(("idle",))
+                else:
+                    op, oid, size = got
+                    if op == "write":
+                        events.append(("write", self.pool, oid, size,
+                                       f"{t['name']}/{self.pool}"))
+                    else:
+                        events.append(("read", self.pool, oid))
+            elif kind == "tick":
+                events.append(("tick", round(0.1 + 0.4 * rng.random(), 3)))
+            elif kind == "kill":
+                alive = [o for o in range(self.n_stubs) if o not in dead]
+                if len(dead) >= max_dead or not alive:
+                    continue
+                o = rng.choice(alive)
+                dead.add(o)
+                events.append(("kill", o))
+            elif kind == "revive":
+                if not dead:
+                    continue
+                o = rng.choice(sorted(dead))
+                dead.discard(o)
+                events.append(("revive", o))
+            elif kind == "kill_rack":
+                cands = [r for r, osds in sorted(by_rack.items())
+                         if any(o not in dead for o in osds)
+                         and len(dead | set(osds)) <= max_dead]
+                if not cands:
+                    continue
+                r = rng.choice(cands)
+                dead |= set(by_rack[r])
+                events.append(("kill_rack", r))
+            elif kind == "revive_rack":
+                cands = [r for r, osds in sorted(by_rack.items())
+                         if any(o in dead for o in osds)]
+                if not cands:
+                    continue
+                r = rng.choice(cands)
+                dead -= set(by_rack[r])
+                events.append(("revive_rack", r))
+            elif kind == "netsplit":
+                if self.racks < 2 or len(splits) >= self.max_splits:
+                    continue
+                pairs = [(a, b) for a in range(self.racks)
+                         for b in range(a + 1, self.racks)
+                         if (a, b) not in splits]
+                if not pairs:
+                    continue
+                pair = rng.choice(pairs)
+                splits.add(pair)
+                events.append(("netsplit",) + pair)
+            elif kind == "heal":
+                if not splits:
+                    continue
+                pair = rng.choice(sorted(splits))
+                splits.discard(pair)
+                events.append(("heal",) + pair)
+            elif kind == "reweight":
+                o = rng.randrange(self.n_stubs)
+                w = rng.choice((0.5, 1.0))
+                if weights_by_osd.get(o, 1.0) == w:
+                    continue
+                weights_by_osd[o] = w
+                events.append(("reweight", o, w))
+            elif kind == "mon_churn":
+                if self.n_mons < 2:
+                    continue
+                events.append(("mon_churn",
+                               chr(ord("a") + rng.randrange(self.n_mons))))
+        self.events = events
+        return events
+
+    def plan_digest(self, events: list[tuple] | None = None) -> str:
+        h = hashlib.sha256()
+        for ev in (events if events is not None else self.events):
+            h.update(repr(ev).encode())
+        return h.hexdigest()[:16]
+
+    # -- execution ----------------------------------------------------------
+    def run(self, n_events: int = 200) -> list[tuple]:
+        """Plan (if not already planned to this length) and execute
+        against the cluster; returns the executed-event log."""
+        if len(self.events) != n_events:
+            self.plan(n_events)
+        c = self.cluster
+        assert c is not None, "run() needs a cluster"
+        for ev in self.events:
+            kind = ev[0]
+            if kind == "write":
+                c.write(ev[1], ev[2], ev[3], client_key=ev[4])
+            elif kind == "read":
+                c.read(ev[1], ev[2])
+            elif kind == "idle":
+                pass
+            elif kind == "tick":
+                c.tick(ev[1])
+            elif kind == "kill":
+                c.kill_stub(ev[1])
+            elif kind == "revive":
+                c.revive_stub(ev[1])
+            elif kind == "kill_rack":
+                c.kill_rack(ev[1])
+            elif kind == "revive_rack":
+                c.revive_rack(ev[1])
+            elif kind == "netsplit":
+                c.split_racks(ev[1], ev[2])
+            elif kind == "heal":
+                c.heal_racks(ev[1], ev[2])
+            elif kind == "reweight":
+                c.reweight(ev[1], ev[2])
+            elif kind == "mon_churn":
+                c.mon_churn(ev[1])
+            else:  # pragma: no cover — vocabulary is closed above
+                raise ValueError(f"unknown storm event {ev!r}")
+            self.executed.append(ev)
+        return self.executed
+
+    def quiesce(self, timeout: float = 60.0) -> None:
+        self.cluster.quiesce(timeout=timeout)
+
+    def metadata(self) -> dict:
+        """Run metadata for artifacts — seed + digest is the replay
+        contract (same seed, same shape => same storm)."""
+        return {
+            "seed": self.seed,
+            "n_stubs": self.n_stubs,
+            "n_mons": self.n_mons,
+            "racks": self.racks,
+            "n_tenants": self.n_tenants,
+            "events": len(self.events),
+            "plan_digest": self.plan_digest(),
+        }

@@ -1,0 +1,131 @@
+"""Stub OSD + simulation clock — the storm harness's data plane.
+
+A :class:`StubOSD` is what a thousand-daemon storm can afford per OSD:
+an in-memory versioned object store, the PRODUCTION
+:class:`~ceph_tpu.osd.scheduler.MClockScheduler` (clock-injected, so
+the sim drives time), and one failpoint seam (``storm.stub.recv``) at
+the receive path so netsplits between racks are armed exactly like the
+thrasher's per-OSD ``msgr.frame.recv`` drops — but with rack-level
+match keys, O(1) entries per split however many OSDs a rack holds.
+
+What is REAL: the QoS scheduler (per-(client,pool) dynamic classes,
+LRU retirement, the thrash surface under test).  What is STUBBED: the
+wire and the store.  The stub's ack/version semantics are the part the
+referee test (tests/test_storm.py) holds against a real OSD: a write
+carries an explicit version; newer versions overwrite, replays of the
+stored version are idempotent acks, and OLDER versions are refused —
+the object_info_t version guard every sub-op reply honors.
+"""
+from __future__ import annotations
+
+from ...common.failpoint import failpoint
+from ...osd.scheduler import MClockScheduler, QoSParams
+
+
+class SimClock:
+    """Monotonic simulated time the scheduler's tags run on — the storm
+    advances it explicitly (tick events), so schedules are a function
+    of the plan, not of wall-clock scheduling jitter."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> float:
+        assert dt >= 0.0, dt
+        self._now += dt
+        return self._now
+
+
+class StubOSD:
+    """One storm OSD: alive flag, rack/host identity, versioned object
+    store keyed by (pool, ps, oid), and a real mClock scheduler."""
+
+    def __init__(self, osd_id: int, rack: int, host: int,
+                 clock: SimClock, max_dynamic: int = 32):
+        self.id = osd_id
+        self.rack = rack
+        self.host = host
+        self.clock = clock
+        self.alive = True
+        #: (pool_id, ps) -> {oid: (version, payload)} — survives kill
+        #: (the in-memory stash semantics LocalCluster.kill_osd keeps)
+        self.store: dict[tuple[int, int], dict[str, tuple[int, bytes]]] = {}
+        #: class-conservation counter: every accepted op bumps it
+        self.enqueued = 0
+        self.scheduler = MClockScheduler(
+            {"client": QoSParams(weight=1.0),
+             "background_recovery": QoSParams(weight=0.5)},
+            clock=clock.now, max_dynamic=max_dynamic,
+            dynamic_params=QoSParams(weight=1.0))
+
+    # -- the wire seam -----------------------------------------------------
+    def reachable_from(self, src: "StubOSD") -> bool:
+        """Evaluate the ``storm.stub.recv`` failpoint for a frame from
+        `src` — the one injection point rack netsplits arm.  Dead stubs
+        drop everything; an armed matching entry raises and the frame
+        is lost (sender sees no ack, exactly a recv-drop split)."""
+        if not self.alive:
+            return False
+        try:
+            failpoint("storm.stub.recv",
+                      entity=f"osd.{self.id}", peer=f"osd.{src.id}",
+                      src_rack=src.rack, dst_rack=self.rack)
+        except Exception:
+            return False
+        return True
+
+    def apply_write(self, pool_id: int, ps: int, oid: str,
+                    version: int, payload: bytes,
+                    client_key: str | None = None) -> bool:
+        """Commit one shard write.  Returns True when the write is
+        DURABLE here (ack semantics): version > stored applies, version
+        == stored is an idempotent replay ack, version < stored is a
+        stale refusal.  The op also rides the scheduler under the
+        client's dynamic class so QoS accounting sees real traffic."""
+        objs = self.store.setdefault((pool_id, ps), {})
+        cur = objs.get(oid)
+        if cur is not None and version < cur[0]:
+            return False
+        if cur is None or version > cur[0]:
+            objs[oid] = (version, payload)
+        cls = (self.scheduler.client_class(client_key)
+               if client_key else "client")
+        self.scheduler.enqueue(cls, (oid, version))
+        self.enqueued += 1
+        return True
+
+    def lookup(self, pool_id: int, ps: int,
+               oid: str) -> tuple[int, bytes] | None:
+        return self.store.get((pool_id, ps), {}).get(oid)
+
+    def drain(self, max_ops: int | None = None) -> int:
+        """Serve queued ops non-blocking at the CURRENT sim time (tick
+        events advance the clock first).  Returns ops served."""
+        served = 0
+        while max_ops is None or served < max_ops:
+            got = self.scheduler.dequeue(timeout=0)
+            if got is None:
+                break
+            served += 1
+        return served
+
+    # -- telemetry the real mgr ingests ------------------------------------
+    def mgr_stats(self, degraded_by_pg: dict[str, int]) -> dict:
+        """The ``stats`` half of an MMgrReport: pg_info rows for PGs this
+        stub primaries (the digest's PG_DEGRADED source) + statfs."""
+        return {
+            "statfs": {"total": 1 << 30, "available": 1 << 29},
+            "pg_info": {
+                pgid: {"degraded": n} for pgid, n in degraded_by_pg.items()
+            },
+        }
+
+    def mgr_counters(self) -> dict:
+        d = self.scheduler.dump()
+        return {"osd": {"op_w": self.enqueued},
+                "mclock": {"qlen": self.scheduler.qlen(),
+                           "dynamic_classes": d["dynamic_classes"],
+                           "retired": d["retired"]}}

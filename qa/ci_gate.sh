@@ -356,5 +356,28 @@ else
     rc=1
 fi
 
-echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json, accounting_smoke.json, qos_smoke.json, recovery_smoke.json, device_pool_smoke.json, placement_smoke.json, topology_smoke.json, read_smoke.json)"
+echo "== storm smoke (250-stub seeded failure storm + invariant gates) =="
+# real mon+mgr over 250 stub OSDs: seeded kill/revive waves, rack
+# netsplit, reweight churn under 2-tenant traffic; every invariant
+# green (no acked-write loss, PGs clean, forecast-vs-observed <=10%,
+# bounded oscillation, class conservation, health symmetry, replay
+# determinism) plus a bare-map remap storm cross-check
+# (ceph_tpu/qa/storm_smoke.py; docs/storm_sim.md)
+JAX_PLATFORMS=cpu python -m ceph_tpu.qa.storm_smoke \
+    > "$OUT_DIR/storm_smoke.json"
+storm_rc=$?
+if [ $storm_rc -eq 0 ]; then
+    echo "storm smoke: ok"
+elif python -c "import json; json.load(open('$OUT_DIR/storm_smoke.json'))" \
+        2>/dev/null; then
+    echo "storm smoke: FAILED:"
+    python -c "import json; [print(' -', p) for p in json.load(open('$OUT_DIR/storm_smoke.json'))['problems']]" || true
+    rc=1
+else
+    rm -f "$OUT_DIR/storm_smoke.json"
+    echo "storm smoke: ERROR (exit $storm_rc) — scenario crashed"
+    rc=1
+fi
+
+echo "Artifacts in $OUT_DIR/ (cephlint.sarif, cephrace.sarif, traffic.json, traffic_trace.json, trace_perfetto.json, health_smoke.json, bench_wedged.json, accounting_smoke.json, qos_smoke.json, recovery_smoke.json, device_pool_smoke.json, placement_smoke.json, topology_smoke.json, read_smoke.json, storm_smoke.json)"
 exit $rc
