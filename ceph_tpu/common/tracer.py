@@ -88,6 +88,34 @@ BG_STAGES = (
     "scrub_repair",       # flagged-shard rebuild + re-push
 )
 
+#: cephread's read-side stage twins (span names and the
+#: ``stage_read_*`` histograms share these, exactly like OP_STAGES on
+#: the write path) — kept separate because the read path has no
+#: admission/queue phases
+READ_STAGES = (
+    "read_gather",        # chunk fan-out wall time (batched or per-op)
+    "read_decode",        # degraded reconstruct (ranged window or full)
+)
+
+#: every (subsys, event) tracepoint name the package may emit, as
+#: "subsys.event" — the cephlint CL12 catalogue: an emitting site
+#: outside this set is a typo'd event nothing can alert on, an entry
+#: with no site is a promise the ring never keeps
+KNOWN_TRACEPOINTS = frozenset({
+    "ops.kernel_fallback_latched",   # codec latched Pallas→XLA downgrade
+    "ops.kernel_fallback_cleared",   # latch cleared (asok or retune)
+    "placement.epoch_diff",          # remap forecast on osdmap advance
+    "balancer.pass",                 # one balancer pass (scores + moves)
+    "balancer.skipped",              # pass refused (degraded cluster)
+    "balancer.commit_failed",        # one upmap commit the mon refused
+    "qos.retune",                    # controller applied a new plan
+    "qos.reject",                    # OSD rejected a malformed directive
+    "qos.apply",                     # OSD applied a directive
+    "recovery.error",                # one failed recovery pass
+    "msgr.send",                     # traced message framed to a peer
+    "msgr.recv",                     # traced message accepted from a peer
+})
+
 
 def trace_now() -> float:
     """THE clock every tracing consumer shares: wall time, so

@@ -235,7 +235,7 @@ class QoSModule(MgrModule):
         # epochs above the dead one's high-water mark or the OSDs'
         # monotonic guard silently drops every push from the new
         # controller (a pure 0-based counter resets on failover)
-        self._epoch = int(time.time())
+        self._epoch = int(time.time())  # noqa: CL11 — failover epoch floor MUST be wall time (see comment above)
         self._lock = make_lock("mgr::qos")
         # previous-tick snapshots for windowed deltas
         self._prev_hists: dict[tuple[str, str], dict] = {}

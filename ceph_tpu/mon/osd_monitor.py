@@ -159,6 +159,8 @@ class OSDMonitor:
                 e = int(cmd.get("epoch", 0))
             except (TypeError, ValueError):
                 return -22, "bad epoch"
+            if e <= 0:  # no/zero epoch = the current map, like `osd dump`
+                e = self.osdmap.epoch if self.osdmap else 0
             j = self.get_map_json(e)
             return (0, j) if j is not None else (-2, f"no map epoch {e}")
         if prefix == "osd getmaps":

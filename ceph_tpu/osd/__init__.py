@@ -30,7 +30,7 @@ __all__ = [
     "PG_POOL_ERASURE",
     "PG_POOL_REPLICATED",
     "calc_pg_upmaps",
-    "ceph_stable_mod",
+    "ceph_stable_mod",  # noqa: CL12 — exported helper name, not a series
     "cluster_report",
     "diff_mappings",
     "pg_num_mask",

@@ -66,7 +66,9 @@ def main(argv: list[str] | None = None) -> int:
                     "drift, CL5 option drift, CL6 wire-protocol "
                     "conformance, CL7 error paths, CL8 kernel "
                     "shape/dtype dataflow, CL9 device-topology "
-                    "discipline, CL10 sharding propagation",
+                    "discipline, CL10 sharding propagation, CL11 "
+                    "seeded determinism/purity, CL12 observability "
+                    "drift",
         epilog="exit status: 0 clean; 1 findings (or stale baseline "
                "entries outside --diff mode); 2 usage/parse errors. "
                "--diff BASE_REF reports only files changed since "

@@ -115,7 +115,7 @@ def check(mods: list[ModuleInfo], sym: SymbolTable, cfg: Config) -> list[Finding
     for mod in mods:
         if mod.topdir() not in dirs:
             continue
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if isinstance(node, ast.FunctionDef):
                 interp = _Interp(mod, node)
                 interp.run()

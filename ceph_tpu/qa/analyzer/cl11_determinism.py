@@ -1,0 +1,325 @@
+"""CL11 — seeded determinism / purity discipline.
+
+Replay is the load-bearing contract of the qa plane: thrasher and
+StormPlanner ``plan()`` re-run with the same seed and assert
+event-for-event equality, the mgr controllers are pure ``plan()``
+loops over observed series, and the traffic generators draw from
+``derive_rng`` named streams.  All of that holds only while nothing on
+the plan path reads ambient state.  CL11 makes the contract static
+over ``cfg.cl11_plan_dirs``:
+
+- ``ambient-rng:<func>:<what>`` — module-global RNG anywhere in a plan
+  module: ``random.<draw>()`` / ``np.random.<draw>`` global state, or
+  ``random.Random()`` / ``default_rng()`` constructed with NO seed
+  argument.  Seeded constructions (``random.Random(self.seed)``,
+  ``derive_rng(seed, "tenant", i)``) pass.
+- ``ambient-clock:<func>:<what>`` — a ``time.time()`` / datetime-now
+  wall-clock read anywhere in a plan module (deadline loops in
+  execution harnesses are the deliberate, baselined exceptions).
+- ``wall-clock:<func>:<what>`` — ANY clock read (wall or monotonic,
+  including the tracer's ``trace_now``) inside a function reachable
+  from a ``cfg.cl11_pure_roots`` entry.  Injected clocks are exempt by
+  construction: a ``clock()`` parameter call never matches the ambient
+  patterns.
+- ``unordered-iter:<func>:<name>`` — iteration over a locally-built
+  set (or ``.keys()/.values()/.items()`` of one) without ``sorted()``
+  inside a reachable function; set order is hash-seed-dependent, so an
+  event emitted from it breaks the plan digest across processes.
+- ``impure:<func>:<target>`` — ``self.<attr>`` assignment/deletion or
+  a ``global`` statement inside a declared-pure root.  Deliberate
+  fold-state writes (the planner's replay artifact, the progress
+  tracker's event table) carry noqa/baseline entries saying so.
+
+Function identity is ``Class.method`` or the bare module-level name;
+idents carry no line numbers so baseline entries survive edits.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Config, Finding, ModuleInfo
+from .symbols import attr_chain, call_name
+
+#: module-level random draws that read the shared global RNG state
+_RAND_DRAWS = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "gauss", "betavariate", "expovariate",
+    "triangular", "normalvariate", "lognormvariate", "vonmisesvariate",
+    "paretovariate", "weibullvariate", "getrandbits", "randbytes",
+    "seed",
+}
+#: wall-clock reads (break replay identity outright)
+_WALL = {("time", ("time",)), ("time", ("time_ns",)),
+         ("datetime", ("now",)), ("datetime", ("utcnow",)),
+         ("datetime", ("datetime", "now")),
+         ("datetime", ("datetime", "utcnow"))}
+#: additional process-clock reads that are still nondeterministic on
+#: the PURE call graph (fine in execution/measurement code)
+_MONO = {("time", ("monotonic",)), ("time", ("monotonic_ns",)),
+         ("time", ("perf_counter",)), ("time", ("perf_counter_ns",))}
+
+
+def _in_plan_dirs(rel: str, cfg: Config) -> bool:
+    for d in cfg.cl11_plan_dirs:
+        d = d.rstrip("/")
+        if rel == d or rel.startswith(d + "/"):
+            return True
+    return False
+
+
+def _functions(mod: ModuleInfo):
+    """(qual, class_name | None, node) for every module-level function
+    and every method of a module-level class."""
+    for stmt in mod.tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield stmt.name, None, stmt
+        elif isinstance(stmt, ast.ClassDef):
+            for s in stmt.body:
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield f"{stmt.name}.{s.name}", stmt.name, s
+
+
+def _rng_violation(node: ast.Call) -> str | None:
+    """Name of the ambient-RNG pattern this call matches, or None."""
+    ch = attr_chain(node.func)
+    if ch is not None:
+        base, attrs = ch
+        if base == "random" and len(attrs) == 1:
+            if attrs[0] in _RAND_DRAWS:
+                return f"random.{attrs[0]}"
+            if attrs[0] == "Random" and not node.args:
+                return "random.Random()"
+        if base in ("np", "numpy") and attrs[:1] == ["random"]:
+            if len(attrs) == 2 and attrs[1] == "default_rng":
+                if not node.args:
+                    return f"{base}.random.default_rng()"
+            elif len(attrs) == 2:
+                return f"{base}.random.{attrs[1]}"
+    cn = call_name(node)
+    if cn == "default_rng" and isinstance(node.func, ast.Name) \
+            and not node.args:
+        return "default_rng()"
+    if cn == "Random" and isinstance(node.func, ast.Name) \
+            and not node.args:
+        return "Random()"
+    return None
+
+
+def _clock_violation(node: ast.Call, monotonic: bool) -> str | None:
+    ch = attr_chain(node.func)
+    if ch is not None:
+        key = (ch[0], tuple(ch[1]))
+        if key in _WALL:
+            return ".".join((ch[0],) + tuple(ch[1]))
+        if monotonic and key in _MONO:
+            return ".".join((ch[0],) + tuple(ch[1]))
+    if monotonic and isinstance(node.func, ast.Name) \
+            and node.func.id == "trace_now":
+        # the tracer's shared clock funnel is time.time by contract
+        return "trace_now"
+    return None
+
+
+def _set_locals(fn: ast.AST) -> set[str]:
+    """Names assigned a provably-unordered value (set literal/ctor/
+    comprehension) anywhere in the function body."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            v = node.value
+            unordered = isinstance(v, (ast.Set, ast.SetComp)) or (
+                isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+                and v.func.id in ("set", "frozenset"))
+            if unordered:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            v = node.value
+            if isinstance(v, (ast.Set, ast.SetComp)) or (
+                    isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Name)
+                    and v.func.id in ("set", "frozenset")):
+                if isinstance(node.target, ast.Name):
+                    out.add(node.target.id)
+    return out
+
+
+def _unordered_iters(fn: ast.AST):
+    """(name, line) for every for-loop / comprehension iterating a
+    locally-built set (directly or via .keys/.values/.items) without an
+    ordering wrapper."""
+    tracked = _set_locals(fn)
+    iters: list[tuple[ast.expr, int]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            iters.append((node.iter, node.lineno))
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                iters.append((gen.iter, node.lineno))
+    for expr, line in iters:
+        if isinstance(expr, ast.Name) and expr.id in tracked:
+            yield expr.id, line
+        elif isinstance(expr, (ast.Set, ast.SetComp)):
+            yield "<set-literal>", line
+        elif isinstance(expr, ast.Call):
+            if isinstance(expr.func, ast.Name) \
+                    and expr.func.id in ("set", "frozenset"):
+                yield expr.func.id + "()", line
+            elif isinstance(expr.func, ast.Attribute) \
+                    and expr.func.attr in ("keys", "values", "items") \
+                    and isinstance(expr.func.value, ast.Name) \
+                    and expr.func.value.id in tracked:
+                yield f"{expr.func.value.id}.{expr.func.attr}()", line
+
+
+def _self_mutations(fn: ast.AST):
+    """(attr, line) for self.<attr> writes/deletes and ('global-<n>',
+    line) for global statements."""
+    def self_attr(t: ast.expr) -> str | None:
+        # self.x / self.x[...] / self.x.y — first attribute off self
+        while isinstance(t, ast.Subscript):
+            t = t.value
+        ch = attr_chain(t)
+        if ch is not None and ch[0] == "self" and ch[1]:
+            return ch[1][0]
+        return None
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                a = self_attr(t)
+                if a is not None:
+                    yield a, node.lineno
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                a = self_attr(t)
+                if a is not None:
+                    yield a, node.lineno
+        elif isinstance(node, ast.Global):
+            for n in node.names:
+                yield f"global-{n}", node.lineno
+
+
+def check(mods: list[ModuleInfo], sym, cfg: Config) -> list[Finding]:
+    plan_mods = [m for m in mods if _in_plan_dirs(m.rel, cfg)]
+    if not plan_mods:
+        return []
+
+    # function inventory + call-graph edges over the plan modules
+    funcs: dict[str, tuple[ModuleInfo, str | None, ast.AST]] = {}
+    by_bare: dict[str, list[str]] = {}
+    for mod in plan_mods:
+        for qual, clsname, node in _functions(mod):
+            key = f"{mod.rel}::{qual}"
+            funcs[key] = (mod, clsname, node)
+            by_bare.setdefault(qual.rsplit(".", 1)[-1], []).append(key)
+
+    roots = [k for k, (_m, _c, _n) in funcs.items()
+             if k.split("::", 1)[1] in cfg.cl11_pure_roots
+             or k.split("::", 1)[1].rsplit(".", 1)[-1]
+             in cfg.cl11_pure_roots and "." not in k.split("::", 1)[1]]
+
+    # BFS: self.<m>() -> same-class method, bare f() -> module-level
+    # function anywhere in the plan modules (by unique name)
+    reachable: set[str] = set()
+    work = list(roots)
+    while work:
+        key = work.pop()
+        if key in reachable:
+            continue
+        reachable.add(key)
+        mod, clsname, node = funcs[key]
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            nxt: str | None = None
+            if isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == "self" and clsname is not None:
+                cand = f"{mod.rel}::{clsname}.{f.attr}"
+                if cand in funcs:
+                    nxt = cand
+            elif isinstance(f, ast.Name):
+                cands = [c for c in by_bare.get(f.id, ())
+                         if "." not in c.split("::", 1)[1]]
+                if len(cands) == 1:
+                    nxt = cands[0]
+            if nxt is not None and nxt not in reachable:
+                work.append(nxt)
+
+    findings: list[Finding] = []
+    seen: set[tuple[str, str, str]] = set()
+
+    def emit(mod: ModuleInfo, line: int, ident: str, msg: str) -> None:
+        k = ("CL11", mod.rel, ident)
+        if k not in seen:
+            seen.add(k)
+            findings.append(Finding("CL11", mod.rel, line, ident, msg))
+
+    for key, (mod, clsname, node) in sorted(funcs.items()):
+        qual = key.split("::", 1)[1]
+        on_graph = key in reachable
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                what = _rng_violation(sub)
+                if what is not None:
+                    emit(mod, sub.lineno, f"ambient-rng:{qual}:{what}",
+                         f"{what} in {qual}() reads ambient RNG state — "
+                         f"derive a seeded stream (derive_rng / "
+                         f"random.Random(seed)) instead")
+                    continue
+                clock = _clock_violation(sub, monotonic=on_graph)
+                if clock is not None:
+                    if on_graph:
+                        emit(mod, sub.lineno, f"wall-clock:{qual}:{clock}",
+                             f"{clock}() inside {qual}(), which is on "
+                             f"the pure-plan call graph — take the "
+                             f"timestamp as a parameter / injected "
+                             f"clock so replay stays bit-exact")
+                    else:
+                        emit(mod, sub.lineno,
+                             f"ambient-clock:{qual}:{clock}",
+                             f"{clock}() wall-clock read in plan module "
+                             f"function {qual}() — use an injected "
+                             f"clock or time.monotonic for deadlines "
+                             f"(baseline deliberate sites)")
+        if on_graph:
+            for name, line in _unordered_iters(node):
+                emit(mod, line, f"unordered-iter:{qual}:{name}",
+                     f"iteration over unordered {name} in {qual}() on "
+                     f"the plan path — wrap in sorted() so emission "
+                     f"order is deterministic")
+        if key in roots:
+            for attr, line in _self_mutations(node):
+                emit(mod, line, f"impure:{qual}:{attr}",
+                     f"{qual}() is declared pure (cl11_pure_roots) but "
+                     f"mutates {attr!r} — return the value, or noqa/"
+                     f"baseline the deliberate fold-state write")
+
+    # module-level statements of plan modules (import-time draws or
+    # clock reads are ambient by definition)
+    for mod in plan_mods:
+        for stmt in mod.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                what = _rng_violation(sub)
+                if what is not None:
+                    emit(mod, sub.lineno, f"ambient-rng:<module>:{what}",
+                         f"{what} at module scope reads ambient RNG "
+                         f"state — seed it explicitly")
+                    continue
+                clock = _clock_violation(sub, monotonic=False)
+                if clock is not None:
+                    emit(mod, sub.lineno, f"ambient-clock:<module>:{clock}",
+                         f"{clock}() wall-clock read at module scope "
+                         f"of a plan module")
+    return findings

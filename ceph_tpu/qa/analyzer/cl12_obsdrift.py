@@ -1,0 +1,426 @@
+"""CL12 — observability drift.
+
+The CL4/CL5 shape (N surfaces share a name vocabulary verbatim;
+nothing enforces agreement) generalized to the whole observability
+plane.  Six inventories are reconciled statically:
+
+- **perf counters** — names declared on a PerfCounters builder/duck
+  vs names mutated through a perf-ish receiver:
+  ``ctr-undeclared:<name>`` (mutation of a name nothing declares —
+  KeyError on that path at runtime) and ``ctr-unused:<name>``
+  (declared, never mutated, never mentioned elsewhere — a series that
+  can only ever render zero).
+- **tracepoints** — ``tracepoint("subsys", "event", ...)`` literals vs
+  the tracer's KNOWN_TRACEPOINTS catalogue vs the tracing docs table:
+  ``tp-unknown:``/``tp-orphan:``/``tp-undoc:``/``tp-orphan-doc:``.
+- **health checks** — ``checks[NAME] = ...`` raise sites vs the bold
+  check names in the observability doc: ``health-undoc:`` /
+  ``health-orphan-doc:``, plus ``health-unconditional:`` for a raise
+  with no enclosing condition — a check that can never clear
+  (raise-and-clear symmetry is a storm invariant; this makes it
+  static).
+- **commands** — mon/asok command names SENT (dict literals carrying
+  the routing key) vs dispatch arms (equality/membership/startswith
+  tests on the routing variable) vs admin-socket registrations:
+  ``cmd-unhandled:<name>`` (sent, no arm matches — the wire-dead
+  class) and ``cmd-unsent:<name>`` (an arm no tool can reach — dead
+  dispatch the CLI never grew a word-form for); registered admin
+  commands missing from the docs are ``asok-undoc:<name>``.
+- **stages** — histogram declarations against the tracer's stage
+  tuples and both docs: ``stage-unknown:`` (a histogram outside the
+  taxonomy), ``stage-nohist:`` (a stage with no histogram),
+  ``stage-undoc:``.
+- **exported series** — full literal series names in code vs the
+  series tokens in the docs (a trailing ``*``/``_`` token documents a
+  family): ``series-undoc:<name>``.
+
+Idents carry the drifting NAME, never a line, so baseline entries
+survive edits.  Families whose source of truth (tracer file, docs) is
+absent are skipped — fixture trees stay quiet unless they opt in.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Config, Finding, ModuleInfo, parse_source, read_doc, rel_of
+from .symbols import SymbolTable, attr_chain, call_name
+
+_DECL_METHODS = {"add_u64_counter", "add_u64", "add_time", "add_time_avg",
+                 "add_time_histogram", "_add"}
+_MUT_METHODS = {"inc", "dec", "set", "tinc", "avg", "hinc", "bump"}
+#: receiver spellings that make an inc()/set() a perf mutation rather
+#: than an arbitrary method call (OSD.logger is upstream's name for its
+#: PerfCounters; the rest are the package's duck-typed holders)
+_PERF_RECEIVERS = {"logger", "_logger", "perf", "_perf", "pc", "_pc",
+                   "counters", "_counters", "accounting", "_accounting"}
+
+_HEALTH_NAME_RE = re.compile(r"[A-Z][A-Z0-9_]{2,}")
+_HEALTH_DOC_RE = re.compile(r"\*\*([A-Z][A-Z0-9_]{2,})\*\*")
+_SERIES_RE = re.compile(r"ceph_[a-z0-9][a-z0-9_]*")
+_SERIES_DOC_RE = re.compile(r"ceph_[a-z0-9_]+\*?")
+_DOC_ROW_RE = re.compile(r"^\|\s*`([A-Za-z0-9_.\- ]+)`\s*\|")
+
+_STAGE_TUPLES = ("OP_STAGES", "BG_STAGES", "READ_STAGES")
+
+
+def parse_tracer_inventory(path) -> dict[str, tuple[set[str], int]]:
+    """KNOWN_TRACEPOINTS + the stage tuples from the tracer module, each
+    as (names, declaration line)."""
+    tree, _lines = parse_source(path)
+    out: dict[str, tuple[set[str], int]] = {}
+    wanted = set(_STAGE_TUPLES) | {"KNOWN_TRACEPOINTS"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        names = [t.id for t in targets
+                 if isinstance(t, ast.Name) and t.id in wanted]
+        if not names:
+            continue
+        if isinstance(value, ast.Call):  # frozenset((...))
+            value = value.args[0] if value.args else value
+        elts: list[ast.expr] = []
+        if isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+            elts = value.elts
+        vals = {e.value for e in elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+        for n in names:
+            out[n] = (vals, node.lineno)
+    return out
+
+
+def _receiver_last(node: ast.Call) -> str | None:
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    ch = attr_chain(node.func.value)
+    if ch is None:
+        return None
+    base, attrs = ch
+    return attrs[-1] if attrs else base
+
+
+def _first_arg(node: ast.Call):
+    """(literal-name, fstring-prefix) — exactly one is non-None for a
+    usable arg, both None otherwise."""
+    if not node.args:
+        return None, None
+    a0 = node.args[0]
+    if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+        return a0.value, None
+    if isinstance(a0, ast.JoinedStr) and a0.values:
+        v0 = a0.values[0]
+        if isinstance(v0, ast.Constant) and isinstance(v0.value, str) \
+                and v0.value:
+            return None, v0.value
+    return None, None
+
+
+def _health_raises(tree: ast.AST):
+    """(name, line, conditional) for ``checks[NAME] = ...`` sites."""
+    out: list[tuple[str, int, bool]] = []
+
+    def rec(stmts, cond: bool) -> None:
+        for s in stmts:
+            if isinstance(s, ast.Assign):
+                for t in s.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id in ("checks", "health_checks") \
+                            and isinstance(t.slice, ast.Constant) \
+                            and isinstance(t.slice.value, str) \
+                            and _HEALTH_NAME_RE.fullmatch(t.slice.value):
+                        out.append((t.slice.value, s.lineno, cond))
+            branches = isinstance(s, (ast.If, ast.While, ast.For,
+                                      ast.AsyncFor, ast.Try))
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(s, field, None)
+                if sub:
+                    rec(sub, cond or branches)
+            for h in getattr(s, "handlers", ()):
+                rec(h.body, True)
+
+    rec(tree.body, False)
+    return out
+
+
+def check(mods: list[ModuleInfo], sym: SymbolTable,
+          cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+
+    # ---- one pass over every module: collect all six inventories ------
+    ctr_decl: dict[str, tuple[str, int]] = {}
+    ctr_decl_pref: set[str] = set()
+    ctr_mut: dict[str, tuple[str, int]] = {}
+    ctr_mut_pref: set[str] = set()
+    hist_decl: dict[str, tuple[str, int]] = {}
+    tp_sites: dict[str, tuple[str, int]] = {}
+    raises: list[tuple[str, str, int, bool]] = []  # name, rel, line, cond
+    sent: dict[str, tuple[str, int]] = {}
+    sent_pref: set[str] = set()
+    arms: dict[str, tuple[str, int]] = {}
+    arm_pref: set[str] = set()
+    asok: dict[str, tuple[str, int]] = {}
+    series: dict[str, tuple[str, int]] = {}
+
+    for mod in mods:
+        for name, line, cond in _health_raises(mod.tree):
+            raises.append((name, mod.rel, line, cond))
+        for node in mod.walk():
+            if isinstance(node, ast.Call):
+                cn = call_name(node)
+                if cn in _DECL_METHODS and isinstance(node.func,
+                                                      ast.Attribute):
+                    lit, pref = _first_arg(node)
+                    if lit is not None:
+                        ctr_decl.setdefault(lit, (mod.rel, node.lineno))
+                        if cn == "add_time_histogram":
+                            hist_decl.setdefault(lit, (mod.rel, node.lineno))
+                    elif pref is not None:
+                        ctr_decl_pref.add(pref)
+                elif cn in _MUT_METHODS \
+                        and _receiver_last(node) in _PERF_RECEIVERS:
+                    lit, pref = _first_arg(node)
+                    if lit is not None:
+                        ctr_mut.setdefault(lit, (mod.rel, node.lineno))
+                    elif pref is not None:
+                        ctr_mut_pref.add(pref)
+                elif cn == "tracepoint" and len(node.args) >= 2:
+                    a, b = node.args[0], node.args[1]
+                    if isinstance(a, ast.Constant) \
+                            and isinstance(a.value, str) \
+                            and isinstance(b, ast.Constant) \
+                            and isinstance(b.value, str):
+                        tp_sites.setdefault(f"{a.value}.{b.value}",
+                                            (mod.rel, node.lineno))
+                elif cn == "register_command":
+                    lit, _p = _first_arg(node)
+                    if lit is not None:
+                        asok.setdefault(lit, (mod.rel, node.lineno))
+                elif cn == "startswith" \
+                        and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "prefix":
+                    lit, _p = _first_arg(node)
+                    if lit is not None:
+                        arm_pref.add(lit)
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if not (isinstance(k, ast.Constant)
+                            and k.value == "prefix"):
+                        continue
+                    if isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str):
+                        sent.setdefault(v.value, (mod.rel, node.lineno))
+                    elif isinstance(v, ast.JoinedStr) and v.values:
+                        v0 = v.values[0]
+                        if isinstance(v0, ast.Constant) \
+                                and isinstance(v0.value, str) and v0.value:
+                            sent_pref.add(v0.value)
+            elif isinstance(node, ast.Compare) \
+                    and isinstance(node.left, ast.Name) \
+                    and node.left.id == "prefix" and len(node.ops) == 1:
+                cmp0 = node.comparators[0]
+                if isinstance(node.ops[0], ast.Eq) \
+                        and isinstance(cmp0, ast.Constant) \
+                        and isinstance(cmp0.value, str):
+                    arms.setdefault(cmp0.value, (mod.rel, node.lineno))
+                elif isinstance(node.ops[0], ast.In) \
+                        and isinstance(cmp0, (ast.Tuple, ast.Set, ast.List)):
+                    for e in cmp0.elts:
+                        if isinstance(e, ast.Constant) \
+                                and isinstance(e.value, str):
+                            arms.setdefault(e.value, (mod.rel, node.lineno))
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and _SERIES_RE.fullmatch(node.value) \
+                    and node.value != "ceph_daemon" \
+                    and not node.value.startswith("ceph_tpu"):
+                series.setdefault(node.value, (mod.rel, node.lineno))
+
+    # ---- sources of truth --------------------------------------------
+    tracer_inv = (parse_tracer_inventory(cfg.tracer_file)
+                  if cfg.tracer_file is not None else {})
+    obs_text = (read_doc(cfg.docs_observability)
+                if cfg.docs_observability is not None else None)
+    trc_text = (read_doc(cfg.docs_tracing)
+                if cfg.docs_tracing is not None else None)
+    doc_text = (obs_text or "") + "\n" + (trc_text or "")
+    obs_rel = (rel_of(cfg, cfg.docs_observability)
+               if cfg.docs_observability is not None else "")
+    trc_rel = (rel_of(cfg, cfg.docs_tracing)
+               if cfg.docs_tracing is not None else "")
+    tracer_rel = (rel_of(cfg, cfg.tracer_file)
+                  if cfg.tracer_file is not None else "")
+
+    def mentioned_outside(name: str, *own: str) -> bool:
+        for rel, lits in sym.string_literals.items():
+            if rel not in own and name in lits:
+                return True
+        return False
+
+    # ---- counters -----------------------------------------------------
+    for name, (rel, line) in sorted(ctr_mut.items()):
+        if name in ctr_decl \
+                or any(name.startswith(p) for p in ctr_decl_pref):
+            continue
+        findings.append(Finding(
+            "CL12", rel, line, f"ctr-undeclared:{name}",
+            f"perf counter {name!r} is mutated here but never declared "
+            f"on any builder — this path raises KeyError at runtime"))
+    for name, (rel, line) in sorted(ctr_decl.items()):
+        if name in ctr_mut \
+                or any(name.startswith(p) for p in ctr_mut_pref) \
+                or any(name.startswith(p) for p in sym.fstring_prefixes) \
+                or mentioned_outside(name, rel):
+            continue
+        findings.append(Finding(
+            "CL12", rel, line, f"ctr-unused:{name}",
+            f"perf counter {name!r} is declared but nothing mutates or "
+            f"mentions it — the exported series can only render zero"))
+
+    # ---- tracepoints --------------------------------------------------
+    known_tp, known_tp_line = tracer_inv.get("KNOWN_TRACEPOINTS",
+                                             (None, 0))
+    if known_tp is not None:
+        tp_docs = None
+        if trc_text is not None:
+            tp_docs = {m.group(1)
+                       for line_ in trc_text.splitlines()
+                       for m in [_DOC_ROW_RE.match(line_.strip())] if m
+                       if "." in m.group(1)}
+        for name, (rel, line) in sorted(tp_sites.items()):
+            if name not in known_tp:
+                findings.append(Finding(
+                    "CL12", rel, line, f"tp-unknown:{name}",
+                    f"tracepoint {name!r} is not catalogued in "
+                    f"KNOWN_TRACEPOINTS (common/tracer.py)"))
+        for name in sorted(known_tp):
+            if name not in tp_sites:
+                findings.append(Finding(
+                    "CL12", tracer_rel, known_tp_line, f"tp-orphan:{name}",
+                    f"KNOWN_TRACEPOINTS entry {name!r} has no emitting "
+                    f"site — the catalogue promises an event that never "
+                    f"fires"))
+            if tp_docs is not None and name not in tp_docs:
+                findings.append(Finding(
+                    "CL12", tracer_rel, known_tp_line, f"tp-undoc:{name}",
+                    f"tracepoint {name!r} is missing from the "
+                    f"docs/tracing.md tracepoint table"))
+        if tp_docs is not None:
+            for name in sorted(tp_docs):
+                if name not in known_tp:
+                    findings.append(Finding(
+                        "CL12", trc_rel, 1, f"tp-orphan-doc:{name}",
+                        f"documented tracepoint {name!r} is not in "
+                        f"KNOWN_TRACEPOINTS and nothing emits it"))
+
+    # ---- health checks ------------------------------------------------
+    raised_names = {n for n, _r, _l, _c in raises}
+    for name, rel, line, cond in sorted(raises):
+        if not cond:
+            findings.append(Finding(
+                "CL12", rel, line, f"health-unconditional:{name}",
+                f"health check {name!r} is raised unconditionally — it "
+                f"can never clear (raise-and-clear symmetry)"))
+    if obs_text is not None:
+        doc_health = set(_HEALTH_DOC_RE.findall(obs_text))
+        for name, rel, line, _cond in sorted(raises):
+            if name not in doc_health:
+                findings.append(Finding(
+                    "CL12", rel, line, f"health-undoc:{name}",
+                    f"health check {name!r} is raised but not documented "
+                    f"in docs/observability.md (bold check name)"))
+        for name in sorted(doc_health - raised_names):
+            findings.append(Finding(
+                "CL12", obs_rel, 1, f"health-orphan-doc:{name}",
+                f"documented health check {name!r} is never raised"))
+
+    # ---- commands -----------------------------------------------------
+    handled = set(arms) | set(asok)
+    for name, (rel, line) in sorted(sent.items()):
+        if name in handled \
+                or any(name.startswith(p) for p in arm_pref):
+            continue
+        findings.append(Finding(
+            "CL12", rel, line, f"cmd-unhandled:{name}",
+            f"command {name!r} is sent here but no dispatch arm or "
+            f"admin-socket registration handles it — it can only error "
+            f"on the wire"))
+    for name, (rel, line) in sorted(arms.items()):
+        if name in sent \
+                or any(name.startswith(p) for p in sent_pref) \
+                or mentioned_outside(name, rel):
+            continue
+        findings.append(Finding(
+            "CL12", rel, line, f"cmd-unsent:{name}",
+            f"dispatch arm for {name!r} but nothing in the package can "
+            f"send it — dead dispatch (grow a CLI word-form or retire "
+            f"the arm)"))
+    if obs_text is not None:
+        for name, (rel, line) in sorted(asok.items()):
+            if name in doc_text:
+                continue
+            findings.append(Finding(
+                "CL12", rel, line, f"asok-undoc:{name}",
+                f"admin-socket command {name!r} is registered but appears "
+                f"in neither observability nor tracing docs"))
+
+    # ---- stages -------------------------------------------------------
+    if all(k in tracer_inv for k in _STAGE_TUPLES):
+        op_stages, op_line = tracer_inv["OP_STAGES"]
+        bg_stages, bg_line = tracer_inv["BG_STAGES"]
+        rd_stages, rd_line = tracer_inv["READ_STAGES"]
+        fg = op_stages | rd_stages
+        for name, (rel, line) in sorted(hist_decl.items()):
+            if name.startswith("stage_") and name[6:] not in fg:
+                findings.append(Finding(
+                    "CL12", rel, line, f"stage-unknown:{name}",
+                    f"histogram {name!r} names a stage outside the "
+                    f"tracer's OP_STAGES/READ_STAGES taxonomy"))
+            elif (name.startswith("recovery_")
+                  or name.startswith("scrub_")) and name not in bg_stages:
+                findings.append(Finding(
+                    "CL12", rel, line, f"stage-unknown:{name}",
+                    f"histogram {name!r} names a stage outside the "
+                    f"tracer's BG_STAGES taxonomy"))
+        for s in sorted(fg):
+            if f"stage_{s}" not in hist_decl:
+                findings.append(Finding(
+                    "CL12", tracer_rel,
+                    op_line if s in op_stages else rd_line,
+                    f"stage-nohist:{s}",
+                    f"stage {s!r} has no stage_* latency histogram"))
+        for s in sorted(bg_stages):
+            if s not in hist_decl:
+                findings.append(Finding(
+                    "CL12", tracer_rel, bg_line, f"stage-nohist:{s}",
+                    f"background stage {s!r} has no latency histogram"))
+        if obs_text is not None or trc_text is not None:
+            for s in sorted(fg | bg_stages):
+                if s not in doc_text:
+                    findings.append(Finding(
+                        "CL12", tracer_rel,
+                        bg_line if s in bg_stages else op_line,
+                        f"stage-undoc:{s}",
+                        f"stage {s!r} appears in neither tracing nor "
+                        f"observability docs"))
+
+    # ---- exported series ---------------------------------------------
+    if obs_text is not None:
+        tokens = set(_SERIES_DOC_RE.findall(doc_text))
+        exact = {t for t in tokens if not t.endswith(("*", "_"))}
+        prefixes = {t.rstrip("*") for t in tokens if t.endswith(("*", "_"))}
+        for name, (rel, line) in sorted(series.items()):
+            if name in exact \
+                    or any(name.startswith(p) for p in prefixes):
+                continue
+            findings.append(Finding(
+                "CL12", rel, line, f"series-undoc:{name}",
+                f"exported series {name!r} is not documented in "
+                f"docs/observability.md (exact token or family "
+                f"wildcard)"))
+    return findings

@@ -87,7 +87,7 @@ def _collect_traced(mod: ModuleInfo) -> list[tuple[ast.FunctionDef, set[str], st
     """All (fn, static_names, why) functions in this module that run under
     a trace: decorated, jit-wrapped by name, or passed to pl.pallas_call."""
     defs: dict[str, ast.FunctionDef] = {}
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.FunctionDef):
             defs.setdefault(node.name, node)
     out: list[tuple[ast.FunctionDef, set[str], str]] = []
@@ -97,7 +97,7 @@ def _collect_traced(mod: ModuleInfo) -> list[tuple[ast.FunctionDef, set[str], st
         if jitted:
             out.append((fn, static, "jit"))
             claimed.add(name)
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call):
             continue
         cn = call_name(node)

@@ -30,7 +30,7 @@ from __future__ import annotations
 import ast
 import re
 
-from .core import Config, Finding, ModuleInfo, rel_of
+from .core import Config, Finding, ModuleInfo, parse_source, read_doc, rel_of
 from .symbols import SymbolTable
 
 # | `msgr.frame.send` | ... — the docs catalogue is the first backticked
@@ -41,7 +41,7 @@ _DOC_ROW_RE = re.compile(r"^\|\s*`([A-Za-z0-9_.\-]+)`\s*\|")
 def parse_known_failpoints(path) -> tuple[set[str], int]:
     """KNOWN_FAILPOINTS literal (set/frozenset/tuple/list/dict of string
     constants) from common/failpoint.py, plus its line for findings."""
-    tree = ast.parse(path.read_text(), filename=str(path))
+    tree, _lines = parse_source(path)
     for node in ast.walk(tree):
         targets = []
         if isinstance(node, ast.Assign):
@@ -70,7 +70,7 @@ def parse_known_failpoints(path) -> tuple[set[str], int]:
 
 def parse_doc_names(path) -> set[str]:
     names: set[str] = set()
-    for line in path.read_text().splitlines():
+    for line in read_doc(path).splitlines():
         m = _DOC_ROW_RE.match(line.strip())
         if m and "." in m.group(1):  # name cells, not header/option cells
             names.add(m.group(1))

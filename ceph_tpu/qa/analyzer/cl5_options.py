@@ -24,13 +24,13 @@ from __future__ import annotations
 
 import ast
 
-from .core import Config, Finding, ModuleInfo, rel_of
+from .core import Config, Finding, ModuleInfo, parse_source, rel_of
 from .symbols import SymbolTable
 
 
 def parse_declared_options(path) -> dict[str, int]:
     """name -> declaration line for every Option("name", ...) literal."""
-    tree = ast.parse(path.read_text(), filename=str(path))
+    tree, _lines = parse_source(path)
     out: dict[str, int] = {}
     for node in ast.walk(tree):
         if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \

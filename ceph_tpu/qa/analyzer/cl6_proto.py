@@ -134,7 +134,7 @@ def build_index(mods: list[ModuleInfo]) -> ProtoIndex:
     idx = ProtoIndex()
     # pass 1: classes (so pass 2 knows the registered names)
     for mod in mods:
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if isinstance(node, ast.ClassDef):
                 mc = _scan_class(mod, node)
                 # keep the first definition; message classes are unique
@@ -187,7 +187,7 @@ def _effective(idx: ProtoIndex, mc: MsgClass, attr: str):
 
 def _scan_usage(idx: ProtoIndex, mod: ModuleInfo, reg: set[str]) -> None:
     """Construction sites, isinstance arms, and construction->send flows."""
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.Call):
             cn = call_name(node)
             if cn in reg and not isinstance(node.func, ast.Attribute):

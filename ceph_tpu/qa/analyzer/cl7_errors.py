@@ -119,7 +119,7 @@ def check(mods: list[ModuleInfo], sym: SymbolTable, cfg: Config) -> list[Finding
 # -- swallowed errors --------------------------------------------------------
 
 def _check_swallows(mod: ModuleInfo, report) -> None:
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.ExceptHandler):
             continue
         names = _exc_names(node.type)
@@ -148,7 +148,7 @@ def _kw(call: ast.Call, *names: str) -> bool:
 
 def _enclosing_classes(mod: ModuleInfo) -> list[tuple[ast.ClassDef, ast.FunctionDef]]:
     out = []
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.ClassDef):
             for stmt in node.body:
                 if isinstance(stmt, ast.FunctionDef):

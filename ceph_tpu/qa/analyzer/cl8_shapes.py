@@ -239,7 +239,7 @@ def _audit_host_trips(mod: ModuleInfo, traced_fns: set) -> list[Finding]:
     # module scope (import-time transfers count too) — own_nodes skips
     # every FunctionDef subtree, so functions are attributed below
     audit_scope(mod.tree, "<module>")
-    for fn in ast.walk(mod.tree):
+    for fn in mod.walk():
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         if fn in traced_fns:

@@ -99,7 +99,7 @@ def _jit_decorated(fn: ast.FunctionDef) -> bool:
 
 
 def _references_pool(mod: ModuleInfo) -> bool:
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.ImportFrom) and node.module \
                 and node.module.split(".")[-1] == "device_pool":
             return True

@@ -26,6 +26,16 @@ and the smatch/cov scripts).  Five whole-package checks:
          PartitionSpec-along-axis / Unknown) over parallel/ and ops/,
          flagging implicit reshards, sharded host trips, and
          donation that cannot alias its output
+    CL11 seeded determinism / purity: ambient RNG, wall-clock reads on
+         the pure-plan call graph, unordered-collection iteration on
+         the plan path, and self/global mutation inside functions the
+         config declares pure (thrasher/storm plan(), the mgr
+         controllers' pure cores, the traffic generators)
+    CL12 observability drift: counters incremented vs declared,
+         tracepoint names vs KNOWN_TRACEPOINTS, health checks raised
+         vs documented (and raise-without-clear), admin/mon command
+         names sent vs dispatched vs ceph_cli word-forms, stage-name
+         sets consistent between tracer, histograms, and docs
 
 Suppression layers, innermost first:
 
@@ -79,10 +89,73 @@ class ModuleInfo:
     modname: str            # dotted module path relative to the scan root
     tree: ast.Module
     lines: list[str]
+    _nodes: list | None = field(default=None, repr=False)
 
     def topdir(self) -> str:
         """First path component under the scan root ('' for top level)."""
         return self.rel.split("/", 1)[0] if "/" in self.rel else ""
+
+    def walk(self) -> list:
+        """``ast.walk(self.tree)`` materialized once and shared: every
+        checker that needs a flat view of the module iterates the same
+        list instead of re-running the BFS generator per check family."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
+
+# -- shared single-parse cache ----------------------------------------------
+# Scanned modules parse exactly once per collect_modules() call; the
+# source-of-truth files the drift checkers read (options.py,
+# failpoint.py, tracer.py) go through this cache so CL4/CL5/CL12 hand
+# the SAME tree around instead of re-reading and re-parsing per family.
+# Keyed by (path, mtime_ns, size) so edited fixtures re-parse while the
+# repeated whole-package runs the test suite does stay cheap.
+
+_PARSE_CACHE: dict[tuple[str, int, int], tuple[ast.Module, list[str]]] = {}
+
+
+def parse_source(path) -> tuple[ast.Module, list[str]]:
+    """Parse-once (tree, lines) for a source file; raises BaselineError
+    on unreadable/unparsable input like collect_modules does."""
+    p = Path(path)
+    try:
+        st = p.stat()
+        key = (str(p.resolve()), st.st_mtime_ns, st.st_size)
+        hit = _PARSE_CACHE.get(key)
+        if hit is not None:
+            return hit
+        src = p.read_text()
+        tree = ast.parse(src, filename=str(p))
+    except (SyntaxError, UnicodeDecodeError, OSError) as e:
+        raise BaselineError(f"cannot parse {path}: {e}") from e
+    out = (tree, src.splitlines())
+    if len(_PARSE_CACHE) > 4096:  # fixture churn guard, not a hot limit
+        _PARSE_CACHE.clear()
+    _PARSE_CACHE[key] = out
+    return out
+
+
+_TEXT_CACHE: dict[tuple[str, int, int], str] = {}
+
+
+def read_doc(path) -> str:
+    """Read-once text for the docs files the drift checkers reconcile
+    against (fault_injection.md, observability.md, tracing.md)."""
+    p = Path(path)
+    try:
+        st = p.stat()
+        key = (str(p.resolve()), st.st_mtime_ns, st.st_size)
+        hit = _TEXT_CACHE.get(key)
+        if hit is not None:
+            return hit
+        text = p.read_text()
+    except (UnicodeDecodeError, OSError) as e:
+        raise BaselineError(f"cannot read {path}: {e}") from e
+    if len(_TEXT_CACHE) > 4096:
+        _TEXT_CACHE.clear()
+    _TEXT_CACHE[key] = text
+    return text
 
 
 _NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*))?",
@@ -176,8 +249,13 @@ class Config:
     failpoint_file: Path | None = None
     baseline_file: Path | None = None
     use_baseline: bool = True
+    #: CL12 source-of-truth files (tracer catalogue + observability docs)
+    tracer_file: Path | None = None
+    docs_observability: Path | None = None
+    docs_tracing: Path | None = None
     checks: tuple[str, ...] = ("CL1", "CL2", "CL3", "CL4", "CL5",
-                               "CL6", "CL7", "CL8", "CL9", "CL10")
+                               "CL6", "CL7", "CL8", "CL9", "CL10",
+                               "CL11", "CL12")
     cl3_dirs: tuple[str, ...] = ("ops", "crush", "parallel", "bench")
     cl1_raw_lock_dirs: tuple[str, ...] = ("osd", "mon", "msg", "store",
                                           "client", "common")
@@ -198,6 +276,24 @@ class Config:
     cl9_jit_dirs: tuple[str, ...] = ("ops",)
     #: dirs the CL10 placement lattice walks (where sharding specs live)
     cl10_dirs: tuple[str, ...] = ("parallel", "ops")
+    #: files/dirs under the seeded-determinism contract (CL11): the
+    #: thrasher/storm planners, the race scheduler, the traffic
+    #: generators, and the mgr controllers' pure cores.  Entries are
+    #: rel-path prefixes; a .py entry matches that one file.
+    cl11_plan_dirs: tuple[str, ...] = (
+        "qa", "bench/traffic.py", "mgr/qos_module.py",
+        "mgr/progress_module.py", "mgr/placement_module.py",
+        "mgr/balancer_module.py", "osd/placement.py")
+    #: functions declared PURE: same inputs => same outputs, no ambient
+    #: clock/RNG anywhere on their call graph, no self/global mutation
+    #: in their own body (deliberate fold-state writes carry noqa or a
+    #: baseline entry).  "Class.method" for methods, bare name for
+    #: module-level functions in cl11_plan_dirs modules.
+    cl11_pure_roots: tuple[str, ...] = (
+        "Thrasher.plan", "StormPlanner.plan", "QoSController.plan",
+        "ProgressTracker.update", "cluster_report", "diff_mappings",
+        "pool_skew", "skew_metrics", "tenant_next_op", "tenant_objects",
+        "derive_rng")
     diff_files: frozenset[str] | None = None  # --diff: restrict findings
 
     @classmethod
@@ -216,11 +312,17 @@ class Config:
         cfg.package_dir = pkg
         opt = pkg / "common" / "options.py"
         fp = pkg / "common" / "failpoint.py"
+        tracer = pkg / "common" / "tracer.py"
         docs = pkg.resolve().parent / "docs" / "fault_injection.md"
+        obs = pkg.resolve().parent / "docs" / "observability.md"
+        trc = pkg.resolve().parent / "docs" / "tracing.md"
         base = pkg / "qa" / "analyzer" / "baseline.toml"
         cfg.options_file = opt if opt.exists() else None
         cfg.failpoint_file = fp if fp.exists() else None
+        cfg.tracer_file = tracer if tracer.exists() else None
         cfg.docs_fault_injection = docs if docs.exists() else None
+        cfg.docs_observability = obs if obs.exists() else None
+        cfg.docs_tracing = trc if trc.exists() else None
         cfg.baseline_file = base if base.exists() else None
         return cfg
 
@@ -257,13 +359,9 @@ def collect_modules(cfg: Config) -> list[ModuleInfo]:
             if ap in seen:
                 continue
             seen.add(ap)
-            try:
-                src = path.read_text()
-                tree = ast.parse(src, filename=str(path))
-            except (SyntaxError, UnicodeDecodeError, OSError) as e:
-                # an unparsable file is itself a finding-worthy event, but
-                # the tier-1 gate wants determinism — surface it loudly
-                raise BaselineError(f"cannot parse {path}: {e}") from e
+            # an unparsable file is itself a finding-worthy event, but
+            # the tier-1 gate wants determinism — surface it loudly
+            tree, lines = parse_source(path)
             try:
                 rel = path.resolve().relative_to(base.resolve()).as_posix()
             except ValueError:
@@ -272,7 +370,7 @@ def collect_modules(cfg: Config) -> list[ModuleInfo]:
             if modname.endswith(".__init__"):
                 modname = modname[: -len(".__init__")]
             mods.append(ModuleInfo(path=path, rel=rel, modname=modname,
-                                   tree=tree, lines=src.splitlines()))
+                                   tree=tree, lines=lines))
     return mods
 
 
@@ -318,7 +416,8 @@ def run(cfg: Config) -> Report:
     from .symbols import SymbolTable
     from . import (cl1_locks, cl2_races, cl3_tracing, cl4_failpoints,
                    cl5_options, cl6_proto, cl7_errors, cl8_shapes,
-                   cl9_topology, cl10_sharding)
+                   cl9_topology, cl10_sharding, cl11_determinism,
+                   cl12_obsdrift)
 
     mods = collect_modules(cfg)
     sym = SymbolTable.build(mods)
@@ -333,6 +432,8 @@ def run(cfg: Config) -> Report:
         "CL8": cl8_shapes.check,
         "CL9": cl9_topology.check,
         "CL10": cl10_sharding.check,
+        "CL11": cl11_determinism.check,
+        "CL12": cl12_obsdrift.check,
     }
     raw: list[Finding] = []
     for code in cfg.checks:
@@ -399,6 +500,14 @@ _SARIF_RULES = {
     "CL10": "sharding propagation (implicit reshards, contractions "
             "over a partitioned dim, sharded host trips, "
             "donation/out_shardings alias mismatches)",
+    "CL11": "seeded determinism / purity (ambient RNG, wall-clock "
+            "reads on the pure-plan call graph, unordered-collection "
+            "iteration on the plan path, self/global mutation in "
+            "declared-pure functions)",
+    "CL12": "observability drift (counters incremented vs declared, "
+            "tracepoints vs KNOWN_TRACEPOINTS, health checks raised "
+            "vs documented, command names sent vs dispatched, "
+            "stage-name set consistency)",
     # dynamic findings (qa/race — cephrace shares this report machinery)
     "CR1": "data race (empty lockset + no happens-before edge)",
     "CR2": "deadlock (waits-for cycle closed at runtime)",

@@ -204,7 +204,7 @@ class SymbolTable:
 
     def _scan_module(self, mod: ModuleInfo) -> None:
         lits = self.string_literals.setdefault(mod.rel, set())
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if isinstance(node, ast.Constant) and isinstance(node.value, str):
                 lits.add(node.value)
             elif isinstance(node, ast.JoinedStr):

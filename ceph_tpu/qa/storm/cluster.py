@@ -104,8 +104,8 @@ class StormCluster:
             mon = Monitor(cct, nm, monmap, initial_osdmap=initial)
             self.mons[nm] = mon
             mon.start()
-        deadline = time.time() + 15
-        while time.time() < deadline and not any(
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not any(
                 m.is_leader() for m in self.mons.values()):
             time.sleep(0.05)
         if not any(m.is_leader() for m in self.mons.values()):
@@ -477,8 +477,8 @@ class StormCluster:
         self.tick(1.0)
         while any(s.scheduler.qlen() for s in self.stubs.values()):
             self.tick(1.0)
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             self.tick(0.0)
             live = set(self.health_checks()) & self.raised_checks
             if not live:

@@ -193,7 +193,7 @@ class StormPlanner:
                     continue
                 events.append(("mon_churn",
                                chr(ord("a") + rng.randrange(self.n_mons))))
-        self.events = events
+        self.events = events  # noqa: CL11 — the replay artifact run()/metadata() read; plan() output itself is pure
         return events
 
     def plan_digest(self, events: list[tuple] | None = None) -> str:

@@ -46,7 +46,7 @@ def main() -> int:
 
     problems: list[str] = []
     summary: dict = {"seed": SEED, "n_stubs": N_STUBS, "events": EVENTS}
-    t0 = time.time()
+    t0 = time.monotonic()
     try:
         with StormCluster(n_stubs=N_STUBS, n_mons=1, racks=RACKS) as c:
             c.create_pool(POOL, size=3, pg_num=PG_NUM, min_size=2)
@@ -75,7 +75,7 @@ def main() -> int:
         problems.append(f"remap storm drift: {e}")
     except Exception as e:  # noqa: BLE001
         problems.append(f"remap storm crashed: {type(e).__name__}: {e}")
-    summary["elapsed_s"] = round(time.time() - t0, 1)
+    summary["elapsed_s"] = round(time.monotonic() - t0, 1)
     summary["problems"] = problems
     print(json.dumps(summary, indent=2, default=str))
     return 1 if problems else 0

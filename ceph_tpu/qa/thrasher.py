@@ -100,7 +100,7 @@ class Thrasher:
         dead: set[int] = set()
         splits: set[tuple[int, int]] = set()
         written: list[str] = []
-        self._payloads = {}
+        self._payloads = {}  # noqa: CL11 — reset of the expected-state mirror verify() reads; same (seed, shape) rebuilds it identically
         events: list[tuple] = []
         wseq = 0
 

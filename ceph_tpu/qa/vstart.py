@@ -93,8 +93,8 @@ class LocalCluster:
             mon = Monitor(cct, nm, monmap, initial_osdmap=initial)
             self.mons[nm] = mon
             mon.start()
-        deadline = time.time() + 15
-        while time.time() < deadline and not any(
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not any(
             m.is_leader() for m in self.mons.values()
         ):
             time.sleep(0.05)
@@ -112,8 +112,8 @@ class LocalCluster:
         for i in range(self.n_osds):
             self._start_osd(i)
         # all OSDs booted: wait until every address is registered
-        deadline = time.time() + 15
-        while time.time() < deadline:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
             m = self._leader().osdmon.osdmap
             if m is not None and len(m.osd_addrs) >= self.n_osds:
                 break
@@ -370,8 +370,8 @@ class LocalCluster:
     def wait_clean(self, pool: str, timeout: float = 30.0) -> None:
         """Wait until every shard of every PG of a pool reports the
         primary's version (recovery settled)."""
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             if self._all_clean(pool):
                 return
             time.sleep(0.3)

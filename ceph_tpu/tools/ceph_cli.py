@@ -216,6 +216,56 @@ def _build_command(words: list[str]) -> dict:
         return {"prefix": f"osd {words[1]}", "key": words[2]}
     if words[:2] == ["osd", "erasure-code-profile"] and words[2] == "get":
         return {"prefix": "osd erasure-code-profile get", "name": words[3]}
+    if words[:2] == ["osd", "getmap"]:
+        # osd getmap [epoch] — full map JSON at an epoch (default: latest)
+        cmd = {"prefix": "osd getmap"}
+        if len(words) > 2:
+            cmd["epoch"] = int(words[2])
+        return cmd
+    if words[0] == "config-key":
+        # config-key set <key> [<val>] | get|rm|exists <key> | ls —
+        # the paxos-replicated KV (ConfigKeyService)
+        sub = words[1] if len(words) > 1 else ""
+        if sub not in ("set", "get", "rm", "ls", "exists") or \
+                (sub != "ls" and len(words) < 3):
+            raise ValueError(
+                "usage: config-key set|get|rm|exists <key> [<val>] | ls")
+        cmd = {"prefix": f"config-key {sub}"}
+        if sub != "ls":
+            cmd["key"] = words[2]
+        if sub == "set" and len(words) > 3:
+            cmd["val"] = " ".join(words[3:])
+        return cmd
+    if words[0] == "config":
+        # config dump | config get <who> | config set <who> <name> <val>
+        # | config rm <who> <name> — the central config store
+        sub = words[1] if len(words) > 1 else ""
+        need = {"dump": 2, "get": 3, "set": 5, "rm": 4}.get(sub)
+        if need is None or len(words) < need:
+            raise ValueError(
+                "usage: config dump | config get <who> | "
+                "config set <who> <name> <value> | config rm <who> <name>")
+        cmd = {"prefix": f"config {sub}"}
+        if sub != "dump":
+            cmd["who"] = words[2]
+        if sub in ("set", "rm"):
+            cmd["name"] = words[3]
+        if sub == "set":
+            cmd["value"] = " ".join(words[4:])
+        return cmd
+    if words[0] == "auth":
+        # auth gens | auth get-ticket|rotate|get-s3-key k=v... — cephx
+        # ticket minting and generation cutover (docs: auth.md)
+        sub = words[1] if len(words) > 1 else ""
+        if sub not in ("gens", "get-ticket", "rotate", "get-s3-key"):
+            raise ValueError(
+                "usage: auth gens | auth get-ticket|rotate|get-s3-key "
+                "[service=<svc>] [entity=<name>] [ttl=<secs>]")
+        cmd = {"prefix": f"auth {sub}"}
+        for extra in words[2:]:
+            k, _, v = extra.partition("=")
+            cmd[k] = v
+        return cmd
     if words[:2] == ["osd", "tier"]:
         # osd tier add <base> <cache> | remove <base> <cache> |
         # cache-mode <cache> <mode> | set-overlay <base> <cache> |

@@ -65,7 +65,9 @@
 #    degraded mesh must shrink to the survivors, and the device-pool
 #    budget must shrink with it.  Step 1's cephlint run includes the
 #    CL9/CL10 device-topology & sharding checks that pin the policy
-#    refactor behind this smoke.
+#    refactor behind this smoke, and the CL11/CL12 determinism +
+#    observability-drift checks (no extra step: the run uses the
+#    default check set, so new checks ride it automatically).
 #
 # Analyzers emit SARIF 2.1.0 into qa/_sarif/ (github code-scanning uploads
 # resolve URIs against the repo root, which is where this script runs

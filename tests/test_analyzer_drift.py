@@ -1,0 +1,476 @@
+"""cephlint CL11 (seeded determinism / purity) + CL12 (observability
+drift) — TP/TN fixture pairs per finding kind, the suppression layers
+on the new codes, and the whole-package zero-unsuppressed gate.
+
+Fixtures ride the same conventions as tests/test_analyzer.py: tiny
+package trees under tmp_path, assertions by finding ident so line
+churn never breaks them.  The doc-backed CL12 families are exercised
+against a fixture tracer catalogue + docs pair; families whose source
+of truth is absent must stay silent (the existing CL1–CL10 fixtures
+depend on that).
+"""
+from __future__ import annotations
+
+import functools
+from pathlib import Path
+
+from ceph_tpu.qa.analyzer.__main__ import main as analyzer_main
+from ceph_tpu.qa.analyzer.core import Config, format_baseline, run
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def make_pkg(tmp_path: Path, files: dict[str, str]) -> Path:
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return pkg
+
+
+def run_on(pkg: Path):
+    return run(Config.discover([str(pkg)]))
+
+
+def idents(report, code: str) -> set[str]:
+    return {f.ident for f in report.findings if f.code == code}
+
+
+# -- CL11: ambient RNG ------------------------------------------------------
+
+RNG_TP = '''
+import random
+import numpy as np
+
+SHUFFLE_SALT = random.random()
+
+
+def draw():
+    return random.randint(0, 7)
+
+
+def draw2():
+    return np.random.randint(4)
+
+
+def draw3():
+    return np.random.default_rng()
+'''
+
+RNG_TN = '''
+import random
+import numpy as np
+
+
+def draw(seed):
+    rng = random.Random(seed)
+    return rng.randint(0, 7)
+
+
+def draw2(seed):
+    return np.random.default_rng(seed).integers(4)
+'''
+
+
+def test_cl11_ambient_rng_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path, {"qa/gen.py": RNG_TP})), "CL11")
+    assert "ambient-rng:<module>:random.random" in got, got
+    assert "ambient-rng:draw:random.randint" in got, got
+    assert "ambient-rng:draw2:np.random.randint" in got, got
+    assert "ambient-rng:draw3:np.random.default_rng()" in got, got
+
+
+def test_cl11_seeded_rng_tn(tmp_path):
+    assert idents(run_on(make_pkg(tmp_path, {"qa/gen.py": RNG_TN})),
+                  "CL11") == set()
+
+
+def test_cl11_plan_dirs_scope(tmp_path):
+    # the same ambient draw OUTSIDE cl11_plan_dirs is not CL11's business
+    assert idents(run_on(make_pkg(tmp_path, {"store/gen.py": RNG_TP})),
+                  "CL11") == set()
+
+
+# -- CL11: clocks -----------------------------------------------------------
+
+CLOCK_TP = '''
+import time
+
+
+def deadline():
+    return time.time() + 5.0
+'''
+
+CLOCK_TN = '''
+import time
+
+
+def deadline():
+    return time.monotonic() + 5.0
+'''
+
+WALL_GRAPH_TP = '''
+import time
+
+
+class StormPlanner:
+    def plan(self):
+        return [stamp()]
+
+
+def stamp():
+    return time.monotonic()
+'''
+
+WALL_GRAPH_TN = '''
+class StormPlanner:
+    def plan(self, now):
+        return [now + 1.0]
+'''
+
+
+def test_cl11_ambient_wall_clock_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path, {"qa/wait.py": CLOCK_TP})),
+                 "CL11")
+    assert got == {"ambient-clock:deadline:time.time"}, got
+
+
+def test_cl11_monotonic_off_graph_tn(tmp_path):
+    # monotonic is fine for deadlines — only wall clocks are ambient
+    assert idents(run_on(make_pkg(tmp_path, {"qa/wait.py": CLOCK_TN})),
+                  "CL11") == set()
+
+
+def test_cl11_any_clock_on_pure_graph_tp(tmp_path):
+    # ...but on a pure root's call graph even monotonic breaks replay
+    got = idents(run_on(make_pkg(tmp_path,
+                                 {"qa/plan.py": WALL_GRAPH_TP})), "CL11")
+    assert got == {"wall-clock:stamp:time.monotonic"}, got
+
+
+def test_cl11_injected_clock_tn(tmp_path):
+    assert idents(run_on(make_pkg(tmp_path,
+                                  {"qa/plan.py": WALL_GRAPH_TN})),
+                  "CL11") == set()
+
+
+# -- CL11: unordered iteration + purity -------------------------------------
+
+UNORDERED_TP = '''
+class StormPlanner:
+    def plan(self):
+        osds = {3, 1, 2}
+        events = []
+        for o in osds:
+            events.append(("kill", o))
+        return events
+'''
+
+UNORDERED_TN = '''
+class StormPlanner:
+    def plan(self):
+        osds = {3, 1, 2}
+        return [("kill", o) for o in sorted(osds)]
+'''
+
+IMPURE_TP = '''
+class StormPlanner:
+    def plan(self):
+        self.cache = [1]
+        return self.cache
+'''
+
+IMPURE_TN = '''
+class StormPlanner:
+    def plan(self):
+        events = [1]
+        return events
+'''
+
+
+def test_cl11_unordered_iter_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path,
+                                 {"qa/plan.py": UNORDERED_TP})), "CL11")
+    assert got == {"unordered-iter:StormPlanner.plan:osds"}, got
+
+
+def test_cl11_sorted_iter_tn(tmp_path):
+    assert idents(run_on(make_pkg(tmp_path,
+                                  {"qa/plan.py": UNORDERED_TN})),
+                  "CL11") == set()
+
+
+def test_cl11_impure_root_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path, {"qa/plan.py": IMPURE_TP})),
+                 "CL11")
+    assert got == {"impure:StormPlanner.plan:cache"}, got
+
+
+def test_cl11_pure_root_tn(tmp_path):
+    assert idents(run_on(make_pkg(tmp_path, {"qa/plan.py": IMPURE_TN})),
+                  "CL11") == set()
+
+
+# -- CL12: counters ---------------------------------------------------------
+
+CTR_MUT = '''
+class Daemon:
+    def __init__(self, pc):
+        self.logger = pc
+
+    def tick(self):
+        self.logger.inc("mystery_events")
+'''
+
+CTR_DECL = '''
+def build(b):
+    return b.add_u64_counter("mystery_events", "fixture events")
+'''
+
+CTR_DEAD = '''
+def build(b):
+    return b.add_u64_counter("dead_counter", "nobody bumps this")
+'''
+
+
+def test_cl12_ctr_undeclared_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path, {"osd/d.py": CTR_MUT})),
+                 "CL12")
+    assert got == {"ctr-undeclared:mystery_events"}, got
+
+
+def test_cl12_ctr_declared_tn(tmp_path):
+    pkg = make_pkg(tmp_path, {"osd/d.py": CTR_MUT,
+                              "osd/build.py": CTR_DECL})
+    assert idents(run_on(pkg), "CL12") == set()
+
+
+def test_cl12_ctr_unused_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path, {"osd/build.py": CTR_DEAD})),
+                 "CL12")
+    assert got == {"ctr-unused:dead_counter"}, got
+
+
+def test_cl12_ctr_mention_tn(tmp_path):
+    # a name another module mentions (render tables, tests) counts as used
+    pkg = make_pkg(tmp_path, {
+        "osd/build.py": CTR_DEAD,
+        "mgr/render.py": 'ROWS = ("dead_counter",)\n'})
+    assert idents(run_on(pkg), "CL12") == set()
+
+
+# -- CL12: health raise-without-clear ---------------------------------------
+
+HEALTH_STUCK = '''
+def render(checks):
+    checks["STUCK_CHECK"] = {"severity": "warn"}
+    return checks
+'''
+
+HEALTH_OK = '''
+def render(checks, broken):
+    if broken:
+        checks["STUCK_CHECK"] = {"severity": "warn"}
+    return checks
+'''
+
+
+def test_cl12_health_unconditional_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path, {"mon/h.py": HEALTH_STUCK})),
+                 "CL12")
+    assert got == {"health-unconditional:STUCK_CHECK"}, got
+
+
+def test_cl12_health_conditional_tn(tmp_path):
+    assert idents(run_on(make_pkg(tmp_path, {"mon/h.py": HEALTH_OK})),
+                  "CL12") == set()
+
+
+# -- CL12: command send/dispatch reconciliation -----------------------------
+
+CMD_SEND = '''
+def send(conn):
+    return conn.command({"prefix": "mon frob"})
+'''
+
+CMD_ARM = '''
+def dispatch(prefix, cmd):
+    if prefix == "mon frob":
+        return 0, "ok"
+    return -22, "unknown"
+'''
+
+
+def test_cl12_cmd_unhandled_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path, {"tools/cli.py": CMD_SEND})),
+                 "CL12")
+    assert got == {"cmd-unhandled:mon frob"}, got
+
+
+def test_cl12_cmd_unsent_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path, {"mon/d.py": CMD_ARM})),
+                 "CL12")
+    assert got == {"cmd-unsent:mon frob"}, got
+
+
+def test_cl12_cmd_paired_tn(tmp_path):
+    pkg = make_pkg(tmp_path, {"tools/cli.py": CMD_SEND,
+                              "mon/d.py": CMD_ARM})
+    assert idents(run_on(pkg), "CL12") == set()
+
+
+# -- CL12: doc-backed families (tracer catalogue + docs fixtures) -----------
+
+FIX_TRACER = '''
+OP_STAGES = ("alpha", "gamma")
+BG_STAGES = ()
+READ_STAGES = ()
+KNOWN_TRACEPOINTS = frozenset({"sub.seen", "sub.ghost"})
+'''
+
+FIX_OBS_CODE = '''
+def build(pc):
+    pc.add_time_histogram("stage_alpha", "d")
+    pc.add_time_histogram("stage_beta", "d")
+    pc.hinc("stage_alpha", 0.1)
+    pc.hinc("stage_beta", 0.1)
+
+
+def register(admin):
+    admin.register_command("frob_thing", None)
+    admin.register_command("known_thing", None)
+
+
+def emit(tracer):
+    tracer.tracepoint("sub", "seen", x=1)
+    tracer.tracepoint("sub", "typo", x=1)
+
+
+def render(checks, ok):
+    if ok:
+        checks["GOOD_CHECK"] = {}
+    else:
+        checks["BAD_CHECK"] = {}
+
+
+SERIES = ("ceph_fix_ok", "ceph_fix_mystery")
+'''
+
+FIX_OBS_DOC = '''# fixture observability doc
+
+- **GOOD_CHECK** — raised when the fixture is sad
+- **GHOST_CHECK** — documented, never raised
+
+The exporter renders `ceph_fix_ok`.  The `known_thing` admin command
+answers things.
+'''
+
+FIX_TRC_DOC = '''# fixture tracing doc
+
+The alpha stage is documented here.
+
+| tracepoint | fires |
+|---|---|
+| `sub.seen` | when seen |
+| `sub.phantom` | never (documented only) |
+'''
+
+
+def _doc_fixture(tmp_path):
+    pkg = make_pkg(tmp_path, {"common/tracer.py": FIX_TRACER,
+                              "obs.py": FIX_OBS_CODE})
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(FIX_OBS_DOC)
+    (docs / "tracing.md").write_text(FIX_TRC_DOC)
+    return pkg
+
+
+def test_cl12_doc_backed_families(tmp_path):
+    got = idents(run_on(_doc_fixture(tmp_path)), "CL12")
+    assert got == {
+        "tp-unknown:sub.typo",        # emitted, not catalogued
+        "tp-orphan:sub.ghost",        # catalogued, never emitted
+        "tp-undoc:sub.ghost",         # catalogued, not in the doc table
+        "tp-orphan-doc:sub.phantom",  # doc row with no catalogue entry
+        "health-undoc:BAD_CHECK",     # raised, not documented
+        "health-orphan-doc:GHOST_CHECK",
+        "series-undoc:ceph_fix_mystery",
+        "stage-unknown:stage_beta",   # histogram outside the taxonomy
+        "stage-nohist:gamma",         # stage with no histogram
+        "stage-undoc:gamma",          # stage in neither doc
+        "asok-undoc:frob_thing",      # registered, undocumented
+    }, got
+
+
+def test_cl12_families_silent_without_sources(tmp_path):
+    # no tracer file / docs: only the self-contained families may fire
+    pkg = make_pkg(tmp_path, {"obs.py": FIX_OBS_CODE})
+    got = idents(run_on(pkg), "CL12")
+    assert got == set(), got
+
+
+# -- suppression layers on the new codes ------------------------------------
+
+def test_cl11_noqa_round_trip(tmp_path):
+    src = IMPURE_TP.replace(
+        "self.cache = [1]",
+        "self.cache = [1]  # noqa: CL11 fixture fold state")
+    report = run_on(make_pkg(tmp_path, {"qa/plan.py": src}))
+    assert idents(report, "CL11") == set()
+    assert any(f.ident == "impure:StormPlanner.plan:cache"
+               for f in report.noqa)
+
+
+def test_cl12_baseline_round_trip_then_stale(tmp_path):
+    pkg = make_pkg(tmp_path, {"osd/d.py": CTR_MUT})
+    report = run_on(pkg)
+    assert [f.ident for f in report.findings] == \
+        ["ctr-undeclared:mystery_events"]
+
+    base = pkg / "qa" / "analyzer" / "baseline.toml"
+    base.parent.mkdir(parents=True)
+    base.write_text(format_baseline(report.findings,
+                                    reason="fixture justification"))
+    report2 = run_on(pkg)
+    assert report2.clean
+    assert [f.ident for f in report2.baselined] == \
+        ["ctr-undeclared:mystery_events"]
+
+    # pay the debt: the entry goes stale and the CLI exits 1
+    (pkg / "osd" / "build.py").write_text(CTR_DECL)
+    report3 = run_on(pkg)
+    assert report3.clean
+    assert [e["ident"] for e in report3.stale_baseline] == \
+        ["ctr-undeclared:mystery_events"]
+    assert analyzer_main([str(pkg)]) == 1
+
+
+# -- the whole-package gate -------------------------------------------------
+
+@functools.lru_cache(maxsize=1)
+def _drift_scan():
+    cfg = Config.discover([str(REPO / "ceph_tpu")])
+    cfg.checks = ("CL11", "CL12")
+    return cfg, run(cfg)
+
+
+def test_package_cl11_cl12_zero_unsuppressed():
+    """`--checks CL11,CL12` over the real package: zero unsuppressed
+    findings, no stale entries, and every suppression reasoned (the
+    baseline parser enforces reasons; noqa lines carry them inline)."""
+    _cfg, report = _drift_scan()
+    assert report.clean, "\n" + report.render_text()
+    assert not report.stale_baseline, report.render_text()
+
+
+def test_package_drift_suppressions_are_scoped():
+    # the debt the new checks carry is the deliberate, reasoned set —
+    # fold-state writes and the wall-clock epoch floor — not a blanket
+    _cfg, report = _drift_scan()
+    assert {f.code for f in report.baselined} <= {"CL11", "CL12"}
+    for f in report.baselined + report.noqa:
+        assert f.code in ("CL11", "CL12")
