@@ -488,10 +488,17 @@ def run_bully_traffic(
     admission share, and — with ``qos=True`` — the live controller
     retuning both from its own telemetry.
 
-    The headline numbers: pooled victim p50/p99, the cephmeter
-    ``fairness_ratio`` across every client (bully included — the bully
-    driving it far above 1 is exactly the regression the QoS gate
-    watches), ``bully_dominance`` (bully ops over mean victim ops),
+    The headline numbers: pooled victim p50/p99 (the gate that carries
+    the "controller improves fairness" claim — victims' tails stop
+    paying for the bully), ``victim_satisfaction`` (worst per-victim
+    achieved/offered ratio — the STARVATION floor: a wedged victim
+    scores << 0.5 while a served one sits near 1.0 modulo Poisson
+    arrival noise, so it gates as an absolute floor, never as an
+    off-vs-on delta), the raw cephmeter ``fairness_ratio`` (max/min
+    ops across every client — kept for observability, but NOT a gate
+    here: the bully is closed-loop, so making the cluster FASTER grows
+    its op count against the rate-capped victims and pushes max/min the
+    wrong way), ``bully_dominance`` (bully ops over mean victim ops),
     and aggregate GiB/s (fairness must not be bought with throughput —
     the gate's 10% budget)."""
     from ..qa.vstart import LocalCluster
@@ -625,6 +632,13 @@ def run_bully_traffic(
     bully_ops = len(lats[0])
     small_lats = sorted(x for lat in lats[1:] for x in lat)
     small_ops = len(small_lats)
+    # worst-victim satisfaction: each victim offers small_rate ops/s for
+    # the whole measured window; the one the scheduler starves hardest
+    # defines fairness (a fully served population scores ~1.0)
+    offered_each = small_rate * elapsed
+    victim_satisfaction = (round(
+        min(len(lat) for lat in lats[1:]) / offered_each, 3)
+        if lats[1:] and offered_each > 0 else None)
     vp50, vp99 = _pctiles(small_lats)
     bl = sorted(lats[0])
     bp50, bp99 = _pctiles(bl)
@@ -647,6 +661,7 @@ def run_bully_traffic(
         "victim_offered": round(n_small * small_rate * elapsed, 1),
         "victim_p50_ms": round(vp50 * 1e3, 3) if vp50 is not None else None,
         "victim_p99_ms": round(vp99 * 1e3, 3) if vp99 is not None else None,
+        "victim_satisfaction": victim_satisfaction,
         "bully_dominance": (round(bully_ops / (small_ops / n_small), 3)
                             if small_ops else None),
         "fairness_ratio": fairness,

@@ -72,7 +72,7 @@ class Objecter(Dispatcher):
             return
         with self._linger_lock:
             self._linger_kick = True
-        threading.Thread(target=self._relinger_guarded, daemon=True).start()
+        threading.Thread(target=self._relinger_guarded, daemon=True).start()  # noqa: CL13 — fire-and-forget by design: the kick flag dedups to at most one live relinger, and it self-terminates when the flag stays clear
 
     def _relinger_guarded(self) -> None:
         """At most one relinger loop runs; the `kick` flag (set under
@@ -134,7 +134,7 @@ class Objecter(Dispatcher):
                                 "objecter", 0,
                                 f"watch callback cookie={ck} raised: {e!r}")
 
-                threading.Thread(target=run, daemon=True).start()
+                threading.Thread(target=run, daemon=True).start()  # noqa: CL13 — fire-and-forget by design: user watch callbacks run off the reader thread and must not be joined from dispatch
             try:
                 conn.send_message(MWatchNotifyAck(
                     notify_id=msg.notify_id, pool=msg.pool, oid=msg.oid,

@@ -69,6 +69,16 @@ class AdminSocket:
         with self._lock:
             sock, self._sock = self._sock, None
         if sock is not None:
+            # closing the listener does NOT wake a thread blocked in
+            # accept() on Linux — poke it with one throwaway connection
+            # so the serve loop observes the cleared self._sock and exits
+            try:
+                with socket.socket(socket.AF_UNIX,
+                                   socket.SOCK_STREAM) as poke:
+                    poke.settimeout(1.0)
+                    poke.connect(self.path)
+            except OSError:
+                pass
             sock.close()
         if os.path.exists(self.path):
             try:
@@ -87,6 +97,9 @@ class AdminSocket:
             try:
                 conn, _ = sock.accept()
             except OSError:  # socket closed by stop()
+                return
+            if self._sock is None:  # stop()'s wake-up poke, not a client
+                conn.close()
                 return
             try:
                 self._handle(conn)

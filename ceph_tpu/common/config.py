@@ -237,6 +237,15 @@ class Config:
         with self._lock:
             self._observers.append((tuple(names), cb))
 
+    def remove_observer(self, cb: Callable[[str, Any], None]) -> None:
+        """Deregister a conf-change observer (identity match on cb): a
+        stopped daemon must not keep reacting to injectargs through a
+        callback that closes over dead state."""
+        with self._lock:
+            self._observers = [
+                (names, c) for names, c in self._observers if c is not cb
+            ]
+
     def show_config(self) -> dict[str, Any]:
         return {n: self.get(n) for n in self._table.names()}
 

@@ -91,13 +91,19 @@ def cmd_bootstrap(args, out) -> int:
     with open(os.path.join(args.data_dir, "spec.json"), "w") as f:
         json.dump(spec, f, indent=2)
     log = open(os.path.join(args.data_dir, "host.log"), "ab")
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "ceph_tpu.deploy.host",
-         "--data-dir", args.data_dir],
-        stdout=log, stderr=log,
-        start_new_session=True,  # survives the CLI exiting
-        cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
-    )
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ceph_tpu.deploy.host",
+             "--data-dir", args.data_dir],
+            stdout=log, stderr=log,
+            start_new_session=True,  # survives the CLI exiting
+            cwd=os.path.dirname(
+                os.path.dirname(os.path.dirname(__file__))),
+        )
+    finally:
+        # the child holds its own dup of the descriptor once spawned;
+        # ours only pins the fd (and leaks if Popen raises)
+        log.close()
     deadline = time.time() + args.timeout
     while time.time() < deadline:
         state = _load_state(args.data_dir)

@@ -120,9 +120,13 @@ class RSCodec(ErasureCode):
             from ...ops.device_pool import POOL
 
             dev = POOL.put(shards) if POOL.enabled() else shards
-            data = np.asarray(self._jax_codec.decode(use, dev))
-            if dev is not shards:
-                POOL.release(dev)
+            try:
+                data = np.asarray(self._jax_codec.decode(use, dev))
+            finally:
+                # a decode failure (bad shard set, kernel abort) must
+                # not strand the pooled stripe buffer
+                if dev is not shards:
+                    POOL.release(dev)
         elif self.backend == "oracle":
             from ... import native_oracle
 
@@ -219,14 +223,17 @@ class BitmatrixCodec(ErasureCode):
             from ...ops.device_pool import POOL, donation_supported
 
             if POOL.enabled():
-                dev = POOL.put(rows)
                 don = donation_supported()
-                out = np.asarray(apply_xor_matrix_dev(
-                    M, dev, mat_key=mat_key, donate=don))
-                if not don:
-                    # donated buffers are consumed by the kernel; an
-                    # undonated one is dead now and recycles
-                    POOL.release(dev)
+                dev = POOL.put(rows)
+                try:
+                    out = np.asarray(apply_xor_matrix_dev(
+                        M, dev, mat_key=mat_key, donate=don))
+                finally:
+                    if not don:
+                        # donated buffers are consumed by the kernel;
+                        # an undonated one is dead now (or the apply
+                        # raised) and recycles either way
+                        POOL.release(dev)
                 return out
             return np.asarray(apply_xor_matrix_jax(M, rows,
                                                    mat_key=mat_key))

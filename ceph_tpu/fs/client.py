@@ -297,7 +297,7 @@ class FSClient(Dispatcher):
                 ent["caps"] = ""
             self._cond.notify_all()
         if dirty:
-            threading.Thread(
+            threading.Thread(  # noqa: CL13 — fire-and-forget by design: the reconnect flush retries against the restarting MDS and self-terminates on its own deadline
                 target=self._reconnect_flush, args=(dirty,), daemon=True
             ).start()
 

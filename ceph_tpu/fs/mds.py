@@ -618,9 +618,26 @@ class MDSDaemon(Dispatcher):
         tests: the journal alone must carry unflushed namespace state
         (and the beacon stops cold, so a surviving rank takes over)."""
         self._beacon_stop.set()
-        self.messenger.shutdown()
+        if self._beacon_thread is not None:
+            # the wait() wakes on the stop event; joined before the
+            # transport it beacons through goes away
+            self._beacon_thread.join(timeout=5)
+        try:
+            self.messenger.shutdown()
+        except Exception as e:
+            self.cct.dout("mds", 0,
+                          f"mds.{self.rank} messenger shutdown raised: "
+                          f"{e!r}")
         if self._rados is not None:
-            self._rados.shutdown()
+            try:
+                self._rados.shutdown()
+            except Exception as e:
+                self.cct.dout("mds", 0,
+                              f"mds.{self.rank} rados shutdown raised: "
+                              f"{e!r}")
+        # the context goes last: its admin socket serves debug commands
+        # right up until the daemon is gone
+        self.cct.shutdown()
 
     # -- multi-rank: beacons, subtree map, takeover ------------------------
     def _beacon_once(self) -> None:

@@ -122,10 +122,23 @@ class MgrDaemon(Dispatcher):
                     self.cct.dout("mgr", 0,
                                   f"mgr rados shutdown raised: {e!r}")
                 self._rados = None
-        self.mc.shutdown()
-        self.messenger.shutdown()
+        # module serve threads before the transports they report
+        # through (teardown reverses bring-up)
         for t in self._threads:
             t.join(timeout=5)
+        try:
+            self.mc.shutdown()
+        except Exception as e:
+            self.cct.dout("mgr", 0,
+                          f"mgr mon client shutdown raised: {e!r}")
+        try:
+            self.messenger.shutdown()
+        except Exception as e:
+            self.cct.dout("mgr", 0,
+                          f"mgr messenger shutdown raised: {e!r}")
+        # the context goes last: its admin socket serves debug commands
+        # right up until the daemon is gone
+        self.cct.shutdown()
 
     def module(self, name: str) -> MgrModule:
         return self._modules[name]

@@ -191,3 +191,4 @@ class DashboardModule(MgrModule):
         self._stop.wait()
         self._server.shutdown()
         self._server.server_close()
+        t.join(timeout=5)  # serve_forever returned at shutdown()

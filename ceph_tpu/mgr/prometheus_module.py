@@ -306,3 +306,4 @@ class PrometheusModule(MgrModule):
         self._stop.wait()
         self._server.shutdown()
         self._server.server_close()
+        t.join(timeout=5)  # serve_forever returned at shutdown()

@@ -119,7 +119,7 @@ class MonClient(Dispatcher):
                 with self._lock:
                     self._hunting = False
 
-        threading.Thread(
+        threading.Thread(  # noqa: CL13 — fire-and-forget by design: the _hunting flag dedups to one live hunt and it self-terminates on connect or deadline
             target=_hunt, name=f"{self.messenger.name}-mon-hunt", daemon=True
         ).start()
 

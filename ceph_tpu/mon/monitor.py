@@ -158,22 +158,41 @@ class Monitor(Dispatcher):
         with self._state_lock:
             self.state = STATE_PROBING
             self.leader_rank = None
-        self.elector.stop()
+        try:
+            self.elector.stop()
+        except Exception as e:
+            self.cct.dout("mon", 0,
+                          f"mon.{self.name} elector stop raised "
+                          f"(continuing teardown): {e!r}")
         with self._sendq_lock:
             for q in self._sendqs.values():
                 q.put(None)
             threads = list(self._send_threads)
-        self.messenger.shutdown()
         if (self._tick_thread is not None
                 and self._tick_thread is not threading.current_thread()):
             # current_thread guard: an injected tick crash shuts the mon
-            # down from the tick thread itself (joining self raises)
+            # down from the tick thread itself (joining self raises).
+            # Joined BEFORE the messenger goes away: the tick loop
+            # sends through it (teardown reverses bring-up)
             self._tick_thread.join(timeout=5)
+        try:
+            self.messenger.shutdown()
+        except Exception as e:
+            self.cct.dout("mon", 0,
+                          f"mon.{self.name} messenger shutdown raised: "
+                          f"{e!r}")
         for t in threads:
             t.join(timeout=5)
         close = getattr(self.store, "close", None)
         if close:
-            close()
+            try:
+                close()
+            except Exception as e:
+                self.cct.dout("mon", 0,
+                              f"mon.{self.name} store close raised: {e!r}")
+        # the context goes last: its admin socket serves debug commands
+        # right up until the daemon is gone
+        self.cct.shutdown()
 
     def _sendq_for(self, key) -> "queue.Queue":
         """Per-peer (or 'publish') queue, sender thread created lazily."""
@@ -303,7 +322,7 @@ class Monitor(Dispatcher):
         )
         # leader_init blocks on the collect round; run it off the elector's
         # calling thread (often a reader holding a session lock)
-        threading.Thread(
+        threading.Thread(  # noqa: CL13 — fire-and-forget by design: leader_init must leave the elector's reader thread (session-lock order) and checks _stopped itself
             target=self._leader_init_async, args=(epoch,),
             name=f"mon.{self.name}-leader-init", daemon=True,
         ).start()

@@ -471,6 +471,13 @@ class Messenger:
                 pass
         for c in conns:
             c.mark_down()
+        # the accept loop wakes on the closed listener; reap it so a
+        # stopped messenger leaves no thread behind (join is idempotent
+        # under racing shutdowns; current_thread guards a self-stop)
+        if (self._accept_thread is not None
+                and self._accept_thread is not threading.current_thread()):
+            self._accept_thread.join(timeout=5)
+        self._accept_thread = None
 
     # -- outgoing ---------------------------------------------------------
     def connect(
@@ -601,7 +608,7 @@ class Messenger:
                 self._dout(1, f"accept error, retrying: {e}")
                 time.sleep(0.01)
                 continue
-            threading.Thread(
+            threading.Thread(  # noqa: CL13 — fire-and-forget by design: a handshake either promotes into a reader (reaped via mark_down) or closes its socket and exits
                 target=self._handshake_incoming, args=(sock, peer), daemon=True
             ).start()
 
@@ -718,7 +725,7 @@ class Messenger:
                 del self._sessions[key]
 
     def _start_reader(self, conn: Connection) -> None:
-        threading.Thread(
+        threading.Thread(  # noqa: CL13 — fire-and-forget by design: the read loop exits when its socket incarnation dies; shutdown reaps it via mark_down, not join
             target=self._read_loop, args=(conn, conn.sock),
             name=f"msgr-{self.name}-rx", daemon=True,
         ).start()

@@ -243,6 +243,7 @@ class OSD(
         self._recovery_inflight = False
         self._split_inflight = False
         self._sentinel_held = False  # flipped under self._lock
+        self._pool_observer = None  # conf observer, deregistered at stop
         self.device_policy = None  # injected at start() (cephtopo)
         self._clone_mutex = make_lock("osd::snap_clone")
         # watch/notify state (reference: PrimaryLogPG watchers): primary-
@@ -501,23 +502,15 @@ class OSD(
         self.device_policy = configure_device_policy(
             DevicePolicy.from_conf(self.cct.conf))
         configure_from_conf(self.cct.conf, policy=self.device_policy)
-        self.cct.conf.add_observer(
-            ["ec_device_pool"],
-            lambda _n, v: POOL.configure(enabled=bool(v)))
+        # keep the callback so shutdown can deregister it — a stopped
+        # OSD reacting to a later injectargs would flip the
+        # process-wide pool on behalf of a corpse
+        self._pool_observer = lambda _n, v: POOL.configure(
+            enabled=bool(v))
+        self.cct.conf.add_observer(["ec_device_pool"],
+                                   self._pool_observer)
         self.write_batcher.start()
         self.read_batcher.start()
-        # backend health sentinel (common/kernel_telemetry.py): policy
-        # built from THIS daemon's conf and constructor-injected — the
-        # sentinel itself is process-wide (kernel dispatch is), refs
-        # counted across the local daemons; interval <= 0 disables
-        si = float(self.cct.conf.get("backend_sentinel_interval"))
-        if si > 0:
-            SENTINEL.acquire(SentinelPolicy(
-                interval=si,
-                timeout=float(self.cct.conf.get("backend_sentinel_timeout")),
-            ))
-            with self._lock:
-                self._sentinel_held = True
         self._tick_thread = threading.Thread(
             target=self._tick_loop, name=f"{self.whoami}-tick", daemon=True
         )
@@ -530,6 +523,20 @@ class OSD(
             )
             self._workers.append(t)
             t.start()
+        # backend health sentinel (common/kernel_telemetry.py): policy
+        # built from THIS daemon's conf and constructor-injected — the
+        # sentinel itself is process-wide (kernel dispatch is), refs
+        # counted across the local daemons; interval <= 0 disables.
+        # Brought up LAST: a later bring-up failure escaping start()
+        # would strand the refcount no later daemon can retire
+        si = float(self.cct.conf.get("backend_sentinel_interval"))
+        if si > 0:
+            SENTINEL.acquire(SentinelPolicy(
+                interval=si,
+                timeout=float(self.cct.conf.get("backend_sentinel_timeout")),
+            ))
+            with self._lock:
+                self._sentinel_held = True
 
     def _op_worker(self) -> None:
         while not self._stop.is_set():
@@ -550,7 +557,7 @@ class OSD(
                 # Dynamic-class ops consumed a client-op slot at the
                 # pick (the bound that makes the tags bite); the
                 # executor returns it via client_op_done()
-                threading.Thread(
+                threading.Thread(  # noqa: CL13 — fire-and-forget by design: per-op executor; its lifetime is the op's, and the scheduler's inflight slot (returned via client_op_done) bounds the population
                     target=self._run_client_op,
                     args=(work, cls, cls != "client"),
                     name=f"{self.whoami}-op", daemon=True,
@@ -596,25 +603,81 @@ class OSD(
         the store is dropped without a graceful unmount, so a revive
         from the same directory exercises real WAL replay + fsck."""
         self._stop.set()
-        self.scheduler.stop()
-        # test-and-set under the daemon lock (double-shutdown must not
+        try:
+            self.scheduler.stop()
+        except Exception as e:
+            self.cct.dout("osd", 0,
+                          f"{self.whoami} scheduler stop raised: {e!r}")
+        self._recovery_wakeup.set()
+        # wake every blocked sub-op wait (_wait_reply/_wait_replies are
+        # stop-aware) so the worker joins below don't sit out the
+        # osd_subop_reply_timeout of an in-flight recovery pull
+        with self._lock:
+            self._cond.notify_all()
+        # teardown reverses bring-up, each step best-effort (one bad
+        # subsystem must not strand the rest, mgr/daemon.py style):
+        # the sentinel ref first (bring-up's last step), then op
+        # workers and the tick thread (they submit through everything
+        # below), the coalescers (queued stripes flush — their ops
+        # complete or fail normally — before the messenger goes away),
+        # the conf observer, the transports, and last the store.
+        # Test-and-set under the daemon lock (double-shutdown must not
         # double-release the refcounted sentinel)
         with self._lock:
             release_sentinel = self._sentinel_held
             self._sentinel_held = False
         if release_sentinel:
-            SENTINEL.release()
-        # drain-and-stop the coalescer first: queued stripes flush (their
-        # ops complete or fail normally) before the messenger goes away
-        self.write_batcher.stop()
-        self.read_batcher.stop()
-        self._recovery_wakeup.set()
-        self.mc.shutdown()
-        self.messenger.shutdown()
+            try:
+                SENTINEL.release()
+            except Exception as e:
+                self.cct.dout(
+                    "osd", 0,
+                    f"{self.whoami} sentinel release raised: {e!r}")
+        for t in self._workers:
+            t.join(timeout=5)
         if self._tick_thread is not None:
             self._tick_thread.join(timeout=5)
+        try:
+            self.read_batcher.stop()
+        except Exception as e:
+            self.cct.dout("osd", 0,
+                          f"{self.whoami} read batcher stop raised: "
+                          f"{e!r}")
+        try:
+            self.write_batcher.stop()
+        except Exception as e:
+            self.cct.dout("osd", 0,
+                          f"{self.whoami} write batcher stop raised: "
+                          f"{e!r}")
+        if self._pool_observer is not None:
+            try:
+                self.cct.conf.remove_observer(self._pool_observer)
+            except Exception as e:
+                self.cct.dout(
+                    "osd", 0,
+                    f"{self.whoami} observer removal raised: {e!r}")
+            self._pool_observer = None
+        try:
+            self.mc.shutdown()
+        except Exception as e:
+            self.cct.dout("osd", 0,
+                          f"{self.whoami} mon client shutdown raised: "
+                          f"{e!r}")
+        try:
+            self.messenger.shutdown()
+        except Exception as e:
+            self.cct.dout("osd", 0,
+                          f"{self.whoami} messenger shutdown raised: "
+                          f"{e!r}")
         if umount:
-            self.store.umount()
+            try:
+                self.store.umount()
+            except Exception as e:
+                self.cct.dout("osd", 0,
+                              f"{self.whoami} store umount raised: {e!r}")
+        # the context goes last: its admin socket serves debug commands
+        # (perf dump, failpoints) right up until the daemon is gone
+        self.cct.shutdown()
 
     # -- map handling ------------------------------------------------------
     def _on_map(self, m: OSDMap) -> None:
@@ -1088,7 +1151,7 @@ class OSD(
                     # messenger rx thread, which must never block on a
                     # connect (the PR-4 ensure_connection rule)
                     self._hb_reported.discard(msg.osd)
-                    threading.Thread(
+                    threading.Thread(  # noqa: CL13 — fire-and-forget by design: report_alive must leave the messenger rx thread (no blocking dial there) and makes one bounded send
                         target=self.mc.report_alive, args=(msg.osd,),
                         name=f"osd.{self.id}-alive", daemon=True,
                     ).start()
@@ -1145,13 +1208,17 @@ class OSD(
                           classes=n_classes)
 
     def _wait_reply(self, tid: int, timeout: float | None = None):
+        # stop-aware: shutdown notifies _cond after setting _stop, so a
+        # worker blocked here (recovery pulls, sub-writes) fails fast
+        # instead of burning the full sub-op timeout under join
         if timeout is None:
             timeout = float(self.cct.conf.get("osd_subop_reply_timeout"))
         with self._lock:
-            ok = self._cond.wait_for(
-                lambda: tid in self._sub_replies, timeout=timeout
+            self._cond.wait_for(
+                lambda: tid in self._sub_replies or self._stop.is_set(),
+                timeout=timeout,
             )
-            return self._sub_replies.pop(tid, None) if ok else None
+            return self._sub_replies.pop(tid, None)
 
     def _wait_replies(self, tids, deadline: float) -> dict:
         """Collect replies for MANY tids under one SHARED deadline
@@ -1166,8 +1233,8 @@ class OSD(
                 for tid in [t for t in pending if t in self._sub_replies]:
                     out[tid] = self._sub_replies.pop(tid)
                     pending.discard(tid)
-                if not pending:
-                    break
+                if not pending or self._stop.is_set():
+                    break  # shutdown fails the wave now, not at deadline
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not self._cond.wait(timeout=remaining):
                     # timed out: drain anything that landed, then stop

@@ -297,28 +297,37 @@ class ReadBatcher:
                 f"({self._admission.current} B queued, cap {cap} B)"
             )
         p.admitted = True
-        t_adm1 = trace_now()
-        if p.acct is not None:
-            tab, client, pool = p.acct
-            tab.record_stage(client, pool, "admission", t_adm1 - t_adm0)
-        if p.tracked is not None:
-            p.tracked.stage_add("admission", t_adm1 - t_adm0)
-        if p.tctx is not None:
-            TRACER.record(p.tctx, "admission", entity=self._entity,
-                          t0=t_adm0, t1=t_adm1, nbytes=p.nbytes)
-        p.queued_at = t_adm1
-        enqueued = False
-        with self._cond:
-            if not (self._stop_flag or self._crashed):
-                enqueued = True
-                self._queue.append(p)
-                self._queued_bytes += p.nbytes
-                # only the flusher waits on the shared condition;
-                # per-op completion rides p.event (no herd)
-                self._cond.notify_all()
-        if not enqueued:  # raced a stop/crash: run inline
-            self._run_inline(p)
-        return p
+        try:
+            t_adm1 = trace_now()
+            if p.acct is not None:
+                tab, client, pool = p.acct
+                tab.record_stage(client, pool, "admission",
+                                 t_adm1 - t_adm0)
+            if p.tracked is not None:
+                p.tracked.stage_add("admission", t_adm1 - t_adm0)
+            if p.tctx is not None:
+                TRACER.record(p.tctx, "admission", entity=self._entity,
+                              t0=t_adm0, t1=t_adm1, nbytes=p.nbytes)
+            p.queued_at = t_adm1
+            enqueued = False
+            with self._cond:
+                if not (self._stop_flag or self._crashed):
+                    enqueued = True
+                    self._queue.append(p)
+                    self._queued_bytes += p.nbytes
+                    # only the flusher waits on the shared condition;
+                    # per-op completion rides p.event (no herd)
+                    self._cond.notify_all()
+            if not enqueued:  # raced a stop/crash: run inline
+                self._run_inline(p)
+            return p
+        except Exception:
+            # nobody will _wait() on a ticket whose submit raised —
+            # hand the admission slot back before escaping, or the
+            # throttle pins at its cap under sustained errors
+            p.admitted = False
+            self._admission.put(p.nbytes)
+            raise
 
     def _wait(self, p: _PendingRead):
         try:

@@ -1185,10 +1185,15 @@ class RecoveryMixin:
                 M, m_key = codec.repair_matrix(lost, tuple(helpers)), None
             x = np.concatenate([fetched[h] for h in helpers])
             x_dev = POOL.put(x) if POOL.enabled() else x
-            out = np.asarray(apply_matrix_jax(M, x_dev, mat_key=m_key),
-                             np.uint8)
-            if x_dev is not x:
-                POOL.release(x_dev)
+            try:
+                out = np.asarray(apply_matrix_jax(M, x_dev, mat_key=m_key),
+                                 np.uint8)
+            finally:
+                # the except below swallows apply failures into a None
+                # result — the pooled sub-chunk buffer must still come
+                # back or every failed rebuild shrinks the pool
+                if x_dev is not x:
+                    POOL.release(x_dev)
             chunk = out.reshape(Z * sub_len).tobytes()
         except Exception:
             return None

@@ -446,34 +446,45 @@ class WriteBatcher:
                 f"({self._admission.current} B queued, cap {cap} B)"
             )
         p.admitted = True
-        t_adm1 = trace_now()
-        if self._logger is not None:
-            self._logger.hinc("stage_admission", t_adm1 - t_adm0)
-        if p.acct is not None:
-            tab, client, pool = p.acct
-            tab.record_stage(client, pool, "admission", t_adm1 - t_adm0)
-        if p.tracked is not None:
-            p.tracked.stage_add("admission", t_adm1 - t_adm0)
-        if p.tctx is not None:
-            TRACER.record(p.tctx, "admission", entity=self._entity,
-                          t0=t_adm0, t1=t_adm1, nbytes=p.nbytes)
+        try:
+            t_adm1 = trace_now()
+            if self._logger is not None:
+                self._logger.hinc("stage_admission", t_adm1 - t_adm0)
+            if p.acct is not None:
+                tab, client, pool = p.acct
+                tab.record_stage(client, pool, "admission",
+                                 t_adm1 - t_adm0)
             if p.tracked is not None:
-                p.tracked.mark_event("admission", ts=t_adm1)
-        p.queued_at = t_adm1
-        enqueued = False
-        with self._cond:
-            if not (self._stop_flag or self._crashed):
-                enqueued = True
-                self._queue.append(p)
-                self._queued_bytes += p.nbytes
-                # only the flusher waits on the shared condition;
-                # per-op completion rides p.event (no herd)
-                self._cond.notify_all()
-        if not enqueued:  # raced a stop/crash: encode inline
-            p.parity = self._inline(p.mat, p.chunks, tctx=p.tctx,
-                                    tracked=p.tracked, mat_key=p.mat_key)
-            p.event.set()
-        return p
+                p.tracked.stage_add("admission", t_adm1 - t_adm0)
+            if p.tctx is not None:
+                TRACER.record(p.tctx, "admission", entity=self._entity,
+                              t0=t_adm0, t1=t_adm1, nbytes=p.nbytes)
+                if p.tracked is not None:
+                    p.tracked.mark_event("admission", ts=t_adm1)
+            p.queued_at = t_adm1
+            enqueued = False
+            with self._cond:
+                if not (self._stop_flag or self._crashed):
+                    enqueued = True
+                    self._queue.append(p)
+                    self._queued_bytes += p.nbytes
+                    # only the flusher waits on the shared condition;
+                    # per-op completion rides p.event (no herd)
+                    self._cond.notify_all()
+            if not enqueued:  # raced a stop/crash: encode inline
+                p.parity = self._inline(p.mat, p.chunks, tctx=p.tctx,
+                                        tracked=p.tracked,
+                                        mat_key=p.mat_key)
+                p.event.set()
+            return p
+        except Exception:
+            # nobody will encode_wait() a ticket whose submit raised —
+            # hand the admission slot and share back before escaping,
+            # or the throttle pins at its cap under sustained errors
+            p.admitted = False
+            self._admission.put(p.nbytes)
+            self._release_share(p)
+            raise
 
     def encode_wait(self, p: _PendingStripe) -> np.ndarray:
         """Block for a ticket's parity (or raise its batch's error).

@@ -68,7 +68,8 @@ def main(argv: list[str] | None = None) -> int:
                     "shape/dtype dataflow, CL9 device-topology "
                     "discipline, CL10 sharding propagation, CL11 "
                     "seeded determinism/purity, CL12 observability "
-                    "drift",
+                    "drift, CL13 resource lifecycle, CL14 teardown "
+                    "ordering",
         epilog="exit status: 0 clean; 1 findings (or stale baseline "
                "entries outside --diff mode); 2 usage/parse errors. "
                "--diff BASE_REF reports only files changed since "

@@ -1,0 +1,784 @@
+"""CL13 — paired-resource lifecycle discipline (cephlife).
+
+The hot path is stitched out of acquire/release pairs — Throttle
+admission tickets, DevicePool buffers, the refcounted backend
+sentinel, provisional trace entries, armed failpoints, started
+threads, registered observers/commands, opened files.  A slot leaked
+on an error path is invisible until sustained multi-tenant load pins
+the throttle at its bound (the storm/autopilot setting), so CL13
+proves release-on-every-path statically: each function body is walked
+path-sensitively with exception edges (try/except/finally, early
+returns, re-raises) over the pinned ``RESOURCE_PAIRS`` table.
+
+Findings (idents carry no line numbers; ``<qual>`` is
+``Class.method`` or the bare module-level function name):
+
+- ``leak-on-raise:<qual>:<token>`` — a may-raise call executes while
+  the token is held and NO enclosing try protects it (no ``finally``
+  releasing it, no handler that releases-or-releases-then-reraises):
+  the exception escapes the function with the slot still held.
+- ``leak-on-return:<qual>:<token>`` — a return path (including a
+  swallowing ``except ...: return``) exits with the token held in a
+  function that DOES release that token on other paths.
+- ``double-release:<qual>:<token>`` — a path releases a token it
+  already released.
+- ``release-unacquired:<qual>:<token>`` — an unconditional release in
+  a function whose only acquire of that token was conditional: some
+  path releases what it never took.
+- ``thread-unjoined:<qual>:<name>`` — a locally-created started
+  thread that is neither joined nor handed off (stored on an object /
+  container, returned) before the function completes.
+
+Ownership-transfer semantics keep the cross-function idioms quiet: a
+function that acquires but never releases a token (the write
+batcher's submit->wait ticket handoff, ``start()`` acquiring what
+``stop()`` releases) is a TRANSFER — normal returns are fine, but
+exceptional exits still leak (precisely the admission-error windows
+this check exists to close).  A call passing the token with a
+``donate=`` kwarg transfers it to the kernel.  ``with`` context
+managers release by construction.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .core import Config, Finding, ModuleInfo
+from .symbols import SymbolTable, attr_chain, call_name
+
+# -- the pinned pairs table -------------------------------------------------
+#
+# model:
+#   "count" — the token is the RECEIVER (an admission/refcount slot):
+#             self._admission.get(n) .. self._admission.put(n)
+#   "value" — the acquire RETURNS the resource; the token is the bound
+#             name: dev = POOL.put(x) .. POOL.release(dev) / f.close()
+#   "id"    — the token is the first ARGUMENT (a registry key):
+#             TRACER.mark_provisional(tid) .. TRACER.promote(tid)
+#   "thread"— receiver-typed Thread start/join with handoff escapes
+#
+# cond acquires ("get"/"get_or_fail") return bool: `if not X.get(n):
+# raise` holds the token only on the fall-through path.
+
+
+@dataclass(frozen=True)
+class Pair:
+    kind: str
+    acquires: dict          # method -> "plain" | "cond"
+    releases: frozenset
+    model: str
+    types: frozenset = frozenset()    # receiver class names
+    globals: frozenset = frozenset()  # receiver module-global names
+    any_recv: bool = False            # method name alone identifies it
+    leak_exempt: bool = False         # no leak-on-raise/-return (CL14's
+    #                                   start/stop symmetry owns these)
+
+
+RESOURCE_PAIRS = (
+    Pair("throttle", {"take": "plain", "get": "cond",
+                      "get_or_fail": "cond"},
+         frozenset({"put"}), "count", types=frozenset({"Throttle"})),
+    Pair("device-pool", {"acquire": "plain", "put": "plain"},
+         frozenset({"release"}), "value",
+         types=frozenset({"DevicePool"}), globals=frozenset({"POOL"})),
+    Pair("sentinel", {"acquire": "plain"}, frozenset({"release"}),
+         "count", types=frozenset({"BackendSentinel"}),
+         globals=frozenset({"SENTINEL"})),
+    Pair("trace-provisional", {"mark_provisional": "plain"},
+         frozenset({"promote", "discard"}), "id",
+         types=frozenset({"Tracer"}), globals=frozenset({"TRACER"})),
+    Pair("failpoint", {"arm": "plain"}, frozenset({"disarm"}), "id",
+         types=frozenset({"FailpointRegistry"}),
+         globals=frozenset({"FAILPOINTS"})),
+    Pair("thread", {"start": "plain"}, frozenset({"join"}), "thread",
+         types=frozenset({"Thread"})),
+    Pair("conf-observer", {"add_observer": "plain"},
+         frozenset({"remove_observer"}), "count", any_recv=True,
+         leak_exempt=True),
+    Pair("admin-command", {"register_command": "plain"},
+         frozenset({"unregister_command"}), "count", any_recv=True,
+         leak_exempt=True),
+    Pair("file", {"open": "plain"}, frozenset({"close"}), "value"),
+)
+
+_FILE_PAIR = next(p for p in RESOURCE_PAIRS if p.kind == "file")
+_THREAD_PAIR = next(p for p in RESOURCE_PAIRS if p.kind == "thread")
+
+_ACQ_BY_METHOD: dict[str, list[Pair]] = {}
+_REL_BY_METHOD: dict[str, list[Pair]] = {}
+for _p in RESOURCE_PAIRS:
+    for _m in _p.acquires:
+        _ACQ_BY_METHOD.setdefault(_m, []).append(_p)
+    for _m in _p.releases:
+        _REL_BY_METHOD.setdefault(_m, []).append(_p)
+
+# -- may-raise safelist -----------------------------------------------------
+# calls that cannot realistically raise between an acquire and its
+# release: pure builtins, container/str ops, clock reads, logging.
+_SAFE_BUILTINS = frozenset({
+    "len", "range", "min", "max", "abs", "int", "float", "str", "bool",
+    "bytes", "bytearray", "list", "dict", "tuple", "set", "frozenset",
+    "sorted", "reversed", "enumerate", "zip", "isinstance",
+    "issubclass", "hasattr", "getattr", "setattr", "repr", "format",
+    "id", "sum", "any", "all", "print", "callable", "vars", "iter",
+    "divmod", "round", "hash", "super", "type", "memoryview",
+})
+#: bare-name calls that cannot raise (clock aliases, tracer clock)
+_SAFE_NAMES = frozenset({"_monotonic", "monotonic", "trace_now",
+                         "perf_counter", "time_ns"})
+_SAFE_METHODS = frozenset({
+    "append", "extend", "add", "discard", "clear", "keys", "values",
+    "items", "setdefault", "copy", "get", "strip", "split", "lower",
+    "upper", "startswith", "endswith", "format", "encode", "hex",
+    "set", "is_set", "monotonic", "time", "sleep", "perf_counter",
+    "notify", "notify_all", "wait", "dout", "debug", "info",
+    "warning", "error", "tobytes", "count", "index", "total_seconds",
+    "start", "rsplit", "splitlines", "join",
+})
+
+
+def _is_safe_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in _SAFE_BUILTINS or f.id in _SAFE_NAMES
+    if isinstance(f, ast.Attribute):
+        return f.attr in _SAFE_METHODS
+    return False
+
+
+# -- per-function analysis --------------------------------------------------
+
+HELD, MAYBE, OUT = "held", "maybe", "out"
+
+
+@dataclass
+class _Tok:
+    pair: Pair
+    key: str            # receiver chain / bound name / arg repr
+    line: int           # acquire line
+    status: str = HELD
+    cond_var: str | None = None   # bool the cond-acquire bound to
+    released_once: bool = False
+
+    def clone(self) -> "_Tok":
+        return _Tok(self.pair, self.key, self.line, self.status,
+                    self.cond_var, self.released_once)
+
+
+def _clone_state(st: dict) -> dict:
+    return {k: t.clone() for k, t in st.items()}
+
+
+class _TryFrame:
+    def __init__(self, handlers, finalbody) -> None:
+        self.handlers = handlers
+        self.finalbody = finalbody
+        self.exc_states: list[dict] = []
+
+
+def _expr_calls(node: ast.AST):
+    """Call nodes in evaluation order: arguments before the call that
+    consumes them (post-order), so ``SENTINEL.acquire(Policy(...))``
+    constructs the policy before the acquire takes effect."""
+    for child in ast.iter_child_nodes(node):
+        yield from _expr_calls(child)
+    if isinstance(node, ast.Call):
+        yield node
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _FuncAnalysis:
+    """Path-sensitive walk of one function body."""
+
+    MAX_STATES = 24
+
+    def __init__(self, qual: str, fn: ast.AST, attr_types: dict,
+                 report) -> None:
+        self.qual = qual
+        self.fn = fn
+        self.attr_types = attr_types      # self.<attr> -> class name
+        self.local_types: dict[str, str] = {}
+        self.report = report              # (finding_kind, line, token)
+        self.reported: set[tuple[str, str]] = set()
+        # names this function releases (transfer detection): a token
+        # whose key never appears here is a handoff, not a leak
+        self.released_keys: set[str] = set()
+        self.acquired_keys: set[tuple[str, str]] = set()
+        # names handed off ANYWHERE in the function (stored on an
+        # object/container, returned): a thread registered before
+        # start() is still a handoff
+        self.escaped_names: set[str] = set()
+        # set by _prescan when ANY call matched the resource tables; a
+        # function with no matches can produce no findings, so run()
+        # skips the path walk entirely (the common case, by far)
+        self._interesting = False
+        self._prescan()
+
+    # -- prescan: local var types + acquire/release inventory --------------
+    def _prescan(self) -> None:
+        # one materialized walk: every derived inventory below iterates
+        # this list instead of re-walking the tree (the function count
+        # times tree size makes repeated ast.walk the scan hotspot)
+        nodes = list(ast.walk(self.fn))
+        known = {t for p in RESOURCE_PAIRS for t in p.types}
+        # local types must be complete before the call matching below
+        # (receiver resolution reads them), hence two passes over the
+        # same list rather than one fused loop
+        for node in nodes:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                cn = call_name(node.value)
+                if cn in known:
+                    self.local_types[node.targets[0].id] = cn
+        # releases inside a re-raising except handler are error-path
+        # COMPENSATION (release-and-reraise): they don't make the
+        # normal-path handoff a "releases it on other paths" function
+        comp: set[int] = set()
+        for node in nodes:
+            if isinstance(node, ast.Try):
+                for h in node.handlers:
+                    sub = [x for s in h.body for x in ast.walk(s)]
+                    if any(isinstance(x, ast.Raise) for x in sub):
+                        comp.update(id(x) for x in sub)
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                rel = self._match_release(node)
+                if rel is not None:
+                    self._interesting = True
+                    if id(node) not in comp:
+                        self.released_keys.add(rel[1])
+                acq = self._match_acquire(node)
+                if acq is not None:
+                    self._interesting = True
+                    if acq[2] is not None:
+                        self.acquired_keys.add((acq[0].kind, acq[2]))
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("append", "add", "insert",
+                                               "put", "put_nowait",
+                                               "register"):
+                    self.escaped_names |= {a.id for a in node.args
+                                           if isinstance(a, ast.Name)}
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Name) \
+                    and any(isinstance(t, (ast.Attribute, ast.Subscript))
+                            for t in node.targets):
+                self.escaped_names.add(node.value.id)
+            elif isinstance(node, (ast.Return, ast.Yield)) \
+                    and node.value is not None:
+                self.escaped_names |= _names_in(node.value)
+
+    # -- receiver/pair resolution ------------------------------------------
+    def _recv_type(self, recv: ast.expr) -> str | None:
+        if isinstance(recv, ast.Name):
+            return self.local_types.get(recv.id)
+        ch = attr_chain(recv)
+        if ch and ch[0] == "self" and len(ch[1]) == 1:
+            return self.attr_types.get(ch[1][0])
+        return None
+
+    def _recv_key(self, recv: ast.expr) -> str | None:
+        if isinstance(recv, ast.Name):
+            return recv.id
+        ch = attr_chain(recv)
+        if ch is not None:
+            return ".".join((ch[0],) + tuple(ch[1]))
+        return None
+
+    def _pair_for(self, recv: ast.expr, method: str,
+                  table: dict) -> Pair | None:
+        for pair in table.get(method, ()):
+            if pair.any_recv:
+                return pair
+            if isinstance(recv, ast.Name) and recv.id in pair.globals:
+                return pair
+            t = self._recv_type(recv)
+            if t is not None and t in pair.types:
+                return pair
+        return None
+
+    def _match_acquire(self, node: ast.Call):
+        """(pair, mode, token_key_or_None) if this call acquires."""
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "open":
+            return _FILE_PAIR, "plain", None  # key = the bound name
+        if not isinstance(f, ast.Attribute):
+            return None
+        if f.attr == "start" and isinstance(f.value, ast.Call) \
+                and call_name(f.value) == "Thread":
+            # threading.Thread(...).start() inline: unbindable
+            return _THREAD_PAIR, "plain", None
+        pair = self._pair_for(f.value, f.attr, _ACQ_BY_METHOD)
+        if pair is None:
+            return None
+        mode = pair.acquires[f.attr]
+        if pair.model == "count":
+            key = self._recv_key(f.value)
+        elif pair.model == "id":
+            key = ast.unparse(node.args[0]) if node.args else None
+        elif pair.model == "thread":
+            key = self._recv_key(f.value)
+            # attr-held threads are stop()'s to join (CL14) — only
+            # track locals here
+            if key is None or "." in key:
+                return None
+        else:  # value: key is the assignment target, filled by caller
+            key = None
+        if key is None and pair.model in ("count", "id", "thread"):
+            return None
+        return pair, mode, key
+
+    def _match_release(self, node: ast.Call):
+        """(pair, token_key) if this call releases."""
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        pair = self._pair_for(f.value, f.attr, _REL_BY_METHOD)
+        if pair is None:
+            if f.attr == "close" and isinstance(f.value, ast.Name):
+                # .close() on a bare name: only pairs with a tracked
+                # open-token of the same name, harmless otherwise
+                return _FILE_PAIR, f.value.id
+            return None
+        if pair.model == "count":
+            key = self._recv_key(f.value)
+        elif pair.model == "id":
+            key = ast.unparse(node.args[0]) if node.args else None
+        elif pair.model == "thread":
+            key = self._recv_key(f.value)
+            if key is None or "." in key:
+                return None
+        else:  # value: POOL.release(tok) / tok.close()
+            if f.attr == "close":
+                key = self._recv_key(f.value)
+            else:
+                key = (node.args[0].id if node.args and
+                       isinstance(node.args[0], ast.Name) else None)
+        if key is None:
+            return None
+        return pair, key
+
+    # -- findings ----------------------------------------------------------
+    def _emit(self, kind: str, line: int, tok_key: str,
+              msg: str) -> None:
+        if (kind, tok_key) in self.reported:
+            return
+        self.reported.add((kind, tok_key))
+        self.report(kind, line, tok_key, msg)
+
+    def _leak_on_raise(self, st: dict, line: int,
+                       frames: list[_TryFrame], what: str) -> None:
+        """A call at `line` may raise: every held token whose release
+        no enclosing frame guarantees leaks out of the function."""
+        escapes = all(not fr.handlers for fr in frames)
+        if not escapes:
+            return  # a handler will see the state (simulated below)
+        for tok in st.values():
+            if tok.status != HELD or tok.pair.leak_exempt \
+                    or tok.pair.model == "thread":
+                continue
+            if any(self._releases_key(fr.finalbody, tok)
+                   for fr in frames):
+                continue
+            self._emit(
+                "leak-on-raise", line, tok.key,
+                f"{tok.pair.kind} '{tok.key}' acquired at line "
+                f"{tok.line} is still held when '{what}' may raise — "
+                f"the exception escapes {self.qual}() with the slot "
+                f"leaked (wrap in try/finally or release-and-reraise)")
+
+    def _releases_key(self, stmts, tok: _Tok) -> bool:
+        for s in stmts:
+            for node in ast.walk(s):
+                if isinstance(node, ast.Call):
+                    rel = self._match_release(node)
+                    if rel and rel[0].kind == tok.pair.kind \
+                            and rel[1] == tok.key:
+                        return True
+        return False
+
+    def _check_exit(self, st: dict, line: int, why: str,
+                    frames: list[_TryFrame] = ()) -> None:
+        """A return (or fall-off-end) with tokens held: leak unless
+        the token is a cross-function handoff (never released here)
+        or an enclosing finally releases it on the way out."""
+        for tok in st.values():
+            if tok.status != HELD or tok.pair.leak_exempt:
+                continue
+            if any(self._releases_key(fr.finalbody, tok)
+                   for fr in frames):
+                continue
+            if tok.pair.model == "thread":
+                if tok.key in self.escaped_names:
+                    continue  # handed off somewhere in this function
+                self._emit(
+                    "thread-unjoined", tok.line, tok.key,
+                    f"thread '{tok.key}' started at line {tok.line} in "
+                    f"{self.qual}() is never joined or handed off")
+                continue
+            if tok.key not in self.released_keys:
+                continue  # handoff: the paired release lives elsewhere
+            self._emit(
+                "leak-on-return", line, tok.key,
+                f"{tok.pair.kind} '{tok.key}' acquired at line "
+                f"{tok.line} is still held on the {why} at line "
+                f"{line} though {self.qual}() releases it on other "
+                f"paths")
+
+    # -- the walk ----------------------------------------------------------
+    def run(self) -> None:
+        if not self._interesting:
+            return  # no resource call anywhere: no finding can fire
+        body = getattr(self.fn, "body", [])
+        out = self._block(body, [{}], [])
+        last = body[-1].end_lineno if body else self.fn.lineno
+        for st in out:
+            self._check_exit(st, last, "fall-through exit")
+
+    def _dedup(self, states: list[dict]) -> list[dict]:
+        seen, out = set(), []
+        for st in states:
+            key = tuple(sorted((k, t.status) for k, t in st.items()))
+            if key not in seen:
+                seen.add(key)
+                out.append(st)
+        return out[: self.MAX_STATES]
+
+    def _block(self, stmts, states: list[dict],
+               frames: list[_TryFrame]) -> list[dict]:
+        for stmt in stmts:
+            states = self._dedup(
+                [s for st in states for s in self._stmt(stmt, st, frames)])
+            if not states:
+                break
+        return states
+
+    def _stmt(self, stmt, st: dict,
+              frames: list[_TryFrame]) -> list[dict]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return [st]  # nested defs are their own analysis scope
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._scan_exprs(stmt.value, st, frames)
+                self._escape_targets(stmt.value, st)
+            self._check_exit(st, stmt.lineno, "return", frames)
+            return []
+        if isinstance(stmt, ast.Raise):
+            # an explicit raise escapes like a may-raise call
+            self._leak_on_raise(st, stmt.lineno, frames, "raise")
+            if frames:
+                frames[-1].exc_states.append(_clone_state(st))
+            return []
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return []  # approximated: path rejoins after the loop
+        if isinstance(stmt, ast.If):
+            return self._if(stmt, st, frames)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, st, frames)
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            return self._loop(stmt, st, frames)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                # `with open(...) as f` releases by construction; scan
+                # the context exprs for other effects
+                self._scan_exprs(item.context_expr, st, frames,
+                                 managed=True)
+            return self._block(stmt.body, [st], frames)
+        if isinstance(stmt, ast.Assign):
+            return [self._assign(stmt, st, frames)]
+        if isinstance(stmt, ast.AugAssign):
+            self._scan_exprs(stmt.value, st, frames)
+            return [st]
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            fake = ast.Assign(targets=[stmt.target], value=stmt.value)
+            ast.copy_location(fake, stmt)
+            return [self._assign(fake, st, frames)]
+        if isinstance(stmt, ast.Expr):
+            self._scan_exprs(stmt.value, st, frames)
+            return [st]
+        if isinstance(stmt, (ast.Assert, ast.Delete)):
+            for v in ast.iter_child_nodes(stmt):
+                if isinstance(v, ast.expr):
+                    self._scan_exprs(v, st, frames)
+            return [st]
+        return [st]
+
+    # -- expression effects ------------------------------------------------
+    def _scan_exprs(self, expr: ast.expr, st: dict,
+                    frames: list[_TryFrame], managed: bool = False,
+                    bind: str | None = None) -> None:
+        """Apply acquire/release/may-raise effects of every call in
+        `expr`, in source order.  `bind` names the assignment target
+        for value-model acquires; `managed` marks a `with` context."""
+        for node in _expr_calls(expr):
+            acq = self._match_acquire(node)
+            if acq is not None:
+                pair, mode, key = acq
+                if pair.model == "value" and key is None:
+                    key = bind
+                if managed and pair.model == "value":
+                    continue  # the context manager releases it
+                if key is None:
+                    if pair.model == "thread":
+                        # Thread(...).start() inline: fire-and-forget
+                        self._emit(
+                            "thread-unjoined", node.lineno,
+                            f"anon@{node.lineno}",
+                            f"thread started inline at line "
+                            f"{node.lineno} in {self.qual}() can never "
+                            f"be joined (bind it, or noqa the "
+                            f"fire-and-forget)")
+                        continue
+                    key = f"anon@{node.lineno}"
+                tok = _Tok(pair, key, node.lineno)
+                if mode == "cond":
+                    tok.cond_var = bind
+                st[key] = tok
+                continue
+            rel = self._match_release(node)
+            if rel is not None:
+                pair, key = rel
+                tok = st.get(key)
+                if tok is None or tok.pair.kind != pair.kind:
+                    # released here but not held on THIS path: if this
+                    # function DID acquire it (conditionally, on some
+                    # other path) and the release is unconditional,
+                    # some path releases what it never took; with no
+                    # in-function acquire it's a cross-function
+                    # release — not ours to judge
+                    if (pair.kind, key) in self.acquired_keys \
+                            and not getattr(node, "_cl13_guard_names",
+                                            None):
+                        self._emit(
+                            "release-unacquired", node.lineno, key,
+                            f"{pair.kind} '{key}' released "
+                            f"unconditionally at line {node.lineno} "
+                            f"in {self.qual}() but only acquired "
+                            f"under a condition — some path releases "
+                            f"what it never took")
+                    continue
+                if tok.status == OUT:
+                    if not self._guarded(node, key):
+                        self._emit(
+                            "double-release", node.lineno, key,
+                            f"{pair.kind} '{key}' released again at "
+                            f"line {node.lineno} in {self.qual}() — "
+                            f"already released on this path")
+                else:
+                    tok.status = OUT
+                    tok.released_once = True
+                continue
+            # handing a token to a container/queue transfers ownership
+            if isinstance(node.func, ast.Attribute) and node.func.attr \
+                    in ("append", "add", "insert", "put",
+                        "put_nowait"):
+                for a in node.args:
+                    if isinstance(a, ast.Name) and a.id in st \
+                            and st[a.id].pair.model in ("value",
+                                                        "thread"):
+                        st[a.id].status = OUT
+                continue
+            # donation: passing a held value token with donate=<expr>
+            don = next((kw for kw in node.keywords
+                        if kw.arg == "donate"), None)
+            if don is not None:
+                for a in node.args:
+                    if isinstance(a, ast.Name) and a.id in st:
+                        tok = st[a.id]
+                        if tok.pair.model == "value":
+                            lit = isinstance(don.value, ast.Constant)
+                            tok.status = OUT if (
+                                lit and don.value.value) else (
+                                MAYBE if not lit else tok.status)
+            if not _is_safe_call(node):
+                self._leak_on_raise(
+                    st, node.lineno, frames,
+                    call_name(node) or ast.unparse(node.func))
+                if frames:
+                    frames[-1].exc_states.append(_clone_state(st))
+
+    def _guarded(self, node: ast.Call, key: str) -> bool:
+        """Release under an `if` that tests the token itself
+        (``if dev is not shards: POOL.release(dev)``) correlates with
+        a conditional acquire — assume the guard is right."""
+        guard = getattr(node, "_cl13_guard_names", None)
+        return guard is not None and (key in guard
+                                      or key.split(".")[-1] in guard)
+
+    def _escape_targets(self, expr: ast.expr, st: dict) -> None:
+        """Returning/yielding a token hands ownership to the caller."""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in st:
+                tok = st[n.id]
+                if tok.pair.model in ("value", "thread"):
+                    tok.status = OUT
+
+    # -- structured statements ---------------------------------------------
+    def _assign(self, stmt: ast.Assign, st: dict,
+                frames: list[_TryFrame]) -> dict:
+        bind = None
+        managed = False
+        if len(stmt.targets) == 1 and isinstance(stmt.targets[0],
+                                                 ast.Name):
+            bind = stmt.targets[0].id
+        elif any(isinstance(t, (ast.Attribute, ast.Subscript))
+                 for t in stmt.targets):
+            # `self._dev = open(...)`: stored on an object, the
+            # lifetime outlives this function (CL14's territory)
+            managed = True
+        self._scan_exprs(stmt.value, st, frames, bind=bind,
+                         managed=managed)
+        # storing a held token on an object/container is a handoff
+        if isinstance(stmt.value, ast.Name) and stmt.value.id in st:
+            tgt = stmt.targets[0]
+            if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                tok = st[stmt.value.id]
+                if tok.pair.model in ("value", "thread"):
+                    tok.status = OUT
+        return st
+
+    def _if(self, stmt: ast.If, st: dict,
+            frames: list[_TryFrame]) -> list[dict]:
+        then_st = _clone_state(st)
+        else_st = st
+        cond_names = _names_in(stmt.test)
+        # condition effects (an acquire inside the test itself)
+        direct = self._cond_acquire_in_test(stmt.test, then_st, else_st,
+                                            frames)
+        if not direct:
+            self._scan_exprs(stmt.test, else_st, frames)
+            then_st = _clone_state(else_st)
+            # `if not ok:` / `if ok:` resolving a cond-acquire bool
+            self._apply_bool_guard(stmt.test, then_st, else_st)
+        # tag releases under this test with the guard names so
+        # `if dev is not x: POOL.release(dev)` correlates
+        for branch in (stmt.body, stmt.orelse):
+            for s in branch:
+                for node in ast.walk(s):
+                    if isinstance(node, ast.Call):
+                        node._cl13_guard_names = cond_names | getattr(
+                            node, "_cl13_guard_names", set())
+        out = self._block(stmt.body, [then_st], frames)
+        out += self._block(stmt.orelse, [else_st], frames)
+        # guard-correlated merge: a token the test mentions that one
+        # branch released counts as released (the guard tracked the
+        # conditional acquire)
+        released = {k for s in out for k, t in s.items()
+                    if t.status == OUT and (k in cond_names or
+                                            k.split(".")[-1] in
+                                            cond_names)}
+        for s in out:
+            for k in released:
+                if k in s:
+                    s[k].status = OUT
+        return out
+
+    def _cond_acquire_in_test(self, test: ast.expr, then_st: dict,
+                              else_st: dict,
+                              frames: list[_TryFrame]) -> bool:
+        """``if X.get(n):`` / ``if not X.get(n):`` — the token exists
+        only on the truthy/falsy side respectively."""
+        positive, call = True, test
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            positive, call = False, test.operand
+        if not isinstance(call, ast.Call):
+            return False
+        acq = self._match_acquire(call)
+        if acq is None or acq[1] != "cond":
+            return False
+        pair, _mode, key = acq
+        tok = _Tok(pair, key, call.lineno)
+        (then_st if positive else else_st)[key] = tok
+        return True
+
+    def _apply_bool_guard(self, test: ast.expr, then_st: dict,
+                          else_st: dict) -> None:
+        positive, name = True, test
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            positive, name = False, test.operand
+        if not isinstance(name, ast.Name):
+            return
+        for st, holds in ((then_st, positive), (else_st, not positive)):
+            for tok in list(st.values()):
+                if tok.cond_var == name.id and not holds:
+                    del st[tok.key]
+
+    def _try(self, stmt: ast.Try, st: dict,
+             frames: list[_TryFrame]) -> list[dict]:
+        frame = _TryFrame(stmt.handlers, stmt.finalbody)
+        normal = self._block(stmt.body, [st], frames + [frame])
+        out = self._block(stmt.orelse, normal, frames) if stmt.orelse \
+            else normal
+        # exception edges: every may-raise snapshot flows into each
+        # handler; a handler that neither releases nor re-raises and
+        # then returns is a swallowed-leak return path
+        exc = self._dedup(frame.exc_states)
+        # a handler that re-raises still runs THIS try's finally on the
+        # way out — handler bodies see a finally-only frame
+        hframes = (frames + [_TryFrame([], stmt.finalbody)]
+                   if stmt.finalbody else frames)
+        for handler in stmt.handlers:
+            out += self._block(handler.body,
+                               [_clone_state(s) for s in exc], hframes)
+        if stmt.finalbody:
+            out = self._block(stmt.finalbody, self._dedup(out), frames)
+            # tokens escaping exceptionally still run the finally
+            if not stmt.handlers and exc:
+                self._block(stmt.finalbody,
+                            [_clone_state(s) for s in exc], frames)
+        return out
+
+    def _loop(self, stmt, st: dict,
+              frames: list[_TryFrame]) -> list[dict]:
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_exprs(stmt.iter, st, frames)
+        else:
+            self._scan_exprs(stmt.test, st, frames)
+        once = self._block(stmt.body, [_clone_state(st)], frames)
+        out = [st] + once  # zero or one-plus iterations
+        if stmt.orelse:
+            out = self._block(stmt.orelse, self._dedup(out), frames)
+        return self._dedup(out)
+
+
+# -- module driver ----------------------------------------------------------
+
+def _functions(mod: ModuleInfo):
+    for stmt in mod.tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield stmt.name, None, stmt
+        elif isinstance(stmt, ast.ClassDef):
+            for s in stmt.body:
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield f"{stmt.name}.{s.name}", stmt.name, s
+
+
+def check(mods: list[ModuleInfo], sym: SymbolTable,
+          cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple[str, str, str]] = set()
+    for mod in mods:
+        if mod.rel.startswith("qa/analyzer/"):
+            continue  # the analyzer's own tables mention the pair names
+        for qual, clsname, fn in _functions(mod):
+            attr_types: dict[str, str] = {}
+            if clsname is not None:
+                ci = next((c for c in sym.class_by_name.get(clsname, ())
+                           if c.path == mod.rel), None)
+                if ci is not None:
+                    attr_types = sym.family_attr_types(ci)
+
+            def report(kind, line, tok, msg, _mod=mod, _qual=qual):
+                ident = f"{kind}:{_qual}:{tok}"
+                k = ("CL13", _mod.rel, ident)
+                if k not in seen:
+                    seen.add(k)
+                    findings.append(
+                        Finding("CL13", _mod.rel, line, ident, msg))
+
+            _FuncAnalysis(qual, fn, attr_types, report).run()
+    return findings

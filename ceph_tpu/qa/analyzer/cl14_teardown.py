@@ -1,0 +1,373 @@
+"""CL14 — start/stop teardown symmetry (cephlife).
+
+CL13 proves each function releases what it takes; CL14 proves the
+daemon-level contract across the start/stop pair: everything
+``start()`` brings up, ``stop()``/``shutdown()`` must bring down, in
+an order that doesn't strand a dependency, surviving a raise
+mid-teardown, and without re-topologizing process-wide singletons on
+a second daemon.  This is the static twin of
+``qa.smoke_util.assert_no_leaked_threads`` (and the bug class behind
+the PR-7 cephadm zombie-teardown).
+
+A class is in scope when its family (mixin closure) defines both a
+``start()`` and a ``stop()``/``shutdown()``.  Acquire records in
+start, in source order:
+
+- sub-lifecycle starts: ``self.X.start()``, ``for m in self.X:
+  m.start()``
+- threads: ``self.X = threading.Thread(...)`` + ``self.X.start()``,
+  or a started local appended to ``self.X``
+- ``SENTINEL.acquire(...)`` refcounts, ``*.add_observer(...)``,
+  ``*.register_command(...)``
+- singleton installers: calls to module-level functions that assign a
+  module global
+
+Findings:
+
+- ``stop-missing:<Class>:<res>`` — acquired in start, never released
+  (stop/shutdown/join/deregister) anywhere in the stop body or the
+  same-class helpers it calls.
+- ``stop-order:<Class>:<a>,<b>`` — two resources released in the
+  SAME order they started: teardown must reverse bring-up (the pool
+  drained before its flusher stops, the tick thread joined after the
+  messenger it sends through is gone).
+- ``stop-fragile:<Class>:<step>`` — a teardown call that may raise,
+  not wrapped in try/except (or handed to a best-effort runner as a
+  bound method), with further teardown steps after it: one bad
+  subsystem strands the rest.
+- ``restart-unsafe:<Class>:<fn>`` — start() calls a module-global
+  installer with no first-daemon-wins guard (no early-return /
+  conditional install), so a second daemon in the process silently
+  re-topologizes shared state.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .core import Config, Finding, ModuleInfo
+from .symbols import ClassInfo, SymbolTable, attr_chain, call_name
+
+_STOP_NAMES = ("stop", "shutdown")
+_RELEASE_METHODS = frozenset({"stop", "shutdown", "join", "close",
+                              "umount", "release", "disarm",
+                              "remove_observer", "unregister_command"})
+#: teardown steps that realistically cannot raise (pure signal/join)
+_SAFE_TEARDOWN = frozenset({"join", "set", "clear", "is_set"})
+
+
+@dataclass
+class _Acq:
+    kind: str      # "sub" | "thread" | "sentinel" | "observer" |
+    #                "command" | "singleton"
+    res: str       # attr name / global name
+    line: int
+    order: int
+
+
+def _self_attr(node: ast.expr) -> str | None:
+    """'X' for a ``self.X`` expression (one level)."""
+    ch = attr_chain(node)
+    if ch and ch[0] == "self" and len(ch[1]) == 1:
+        return ch[1][0]
+    return None
+
+
+def _loop_binds(body: ast.AST) -> dict[str, str]:
+    """loop-var -> self attr for ``for v in self.X[...]`` (and
+    ``.values()``/``reversed()`` wrappers)."""
+    binds: dict[str, str] = {}
+    for node in ast.walk(body):
+        if not isinstance(node, (ast.For, ast.comprehension)):
+            continue
+        it = node.iter
+        while isinstance(it, ast.Call) and call_name(it) in (
+                "reversed", "list", "sorted") and it.args:
+            it = it.args[0]
+        if isinstance(it, ast.Call) and isinstance(it.func,
+                                                   ast.Attribute) \
+                and it.func.attr in ("values", "items", "keys"):
+            it = it.func.value
+        attr = _self_attr(it)
+        tgt = node.target
+        if attr is not None and isinstance(tgt, ast.Name):
+            binds[tgt.id] = attr
+        elif attr is not None and isinstance(tgt, ast.Tuple):
+            for el in tgt.elts:
+                if isinstance(el, ast.Name):
+                    binds[el.id] = attr
+    return binds
+
+
+class _ClassCheck:
+    def __init__(self, ci: ClassInfo, sym: SymbolTable, mod: ModuleInfo,
+                 installers: dict[str, bool], report) -> None:
+        self.ci = ci
+        self.sym = sym
+        self.mod = mod
+        self.installers = installers  # fn name -> has first-wins guard
+        self.report = report
+
+    # -- start(): ordered acquires -----------------------------------------
+    def acquires(self, start_fn: ast.AST) -> list[_Acq]:
+        out: list[_Acq] = []
+        binds = _loop_binds(start_fn)
+        started_locals: set[str] = set()
+        thread_attrs: set[str] = set()
+        for node in ast.walk(start_fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call) \
+                    and call_name(node.value) == "Thread":
+                for t in node.targets:
+                    a = _self_attr(t)
+                    if a is not None:
+                        thread_attrs.add(a)
+                    elif isinstance(t, ast.Name):
+                        started_locals.add(t.id)
+        for node in ast.walk(start_fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name):
+                if f.id in self.installers:
+                    out.append(_Acq("singleton", f.id, node.lineno,
+                                    len(out)))
+                continue
+            if not isinstance(f, ast.Attribute):
+                continue
+            recv, meth = f.value, f.attr
+            if meth == "start":
+                a = _self_attr(recv)
+                if a is not None:
+                    kind = "thread" if a in thread_attrs else "sub"
+                    out.append(_Acq(kind, a, node.lineno, len(out)))
+                elif isinstance(recv, ast.Name) and recv.id in binds:
+                    out.append(_Acq("sub", binds[recv.id], node.lineno,
+                                    len(out)))
+            elif meth == "append":
+                # self.X.append(t) for a started local thread
+                a = _self_attr(recv)
+                if a is not None and node.args and isinstance(
+                        node.args[0], ast.Name) \
+                        and node.args[0].id in started_locals:
+                    out.append(_Acq("thread", a, node.lineno,
+                                    len(out)))
+            elif meth == "acquire" and isinstance(recv, ast.Name) \
+                    and recv.id == "SENTINEL":
+                out.append(_Acq("sentinel", "SENTINEL", node.lineno,
+                                len(out)))
+            elif meth == "add_observer":
+                out.append(_Acq("observer", "observer", node.lineno,
+                                len(out)))
+            elif meth == "register_command":
+                out.append(_Acq("command", "admin-command", node.lineno,
+                                len(out)))
+        # one record per resource (loops start many members of one attr)
+        seen: set[tuple[str, str]] = set()
+        uniq = []
+        for a in out:
+            if (a.kind, a.res) not in seen:
+                seen.add((a.kind, a.res))
+                uniq.append(a)
+        return uniq
+
+    # -- stop(): the release inventory, in order ---------------------------
+    def _stop_nodes(self, stop_fn: ast.AST):
+        """Walk the stop body plus one level of same-class helper
+        methods it calls (``self._teardown()`` style)."""
+        yield from ast.walk(stop_fn)
+        methods = {m: fn for c in self.sym.family_members(self.ci)
+                   for m, fn in c.methods.items()}
+        for node in ast.walk(stop_fn):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute):
+                # self._helper() — Attribute value is bare `self`
+                ch = attr_chain(node.func)
+                if ch and ch[0] == "self" and len(ch[1]) == 1 \
+                        and ch[1][0] in methods \
+                        and ch[1][0] not in _STOP_NAMES:
+                    yield from ast.walk(methods[ch[1][0]])
+
+    def releases(self, stop_fn: ast.AST) -> list[tuple[str, int]]:
+        """(resource, line) for every teardown touch in stop, in
+        source order.  Bound-method references passed to a best-effort
+        runner (``_stop_quietly("osd", osd.shutdown)``) count — the
+        matcher reads Attribute nodes, not just calls."""
+        binds = _loop_binds(stop_fn)
+        all_nodes = list(self._stop_nodes(stop_fn))
+        for n in all_nodes:
+            # plain alias: ``t = self._thread`` then ``t.join()``
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                a = _self_attr(n.value)
+                if a is not None:
+                    binds.setdefault(n.targets[0].id, a)
+        rel: list[tuple[str, int]] = []
+        seen: set[str] = set()
+        nodes = sorted(
+            (n for n in all_nodes
+             if isinstance(n, ast.Attribute)
+             and n.attr in _RELEASE_METHODS),
+            key=lambda n: (n.lineno, n.col_offset))
+        for node in nodes:
+            res: str | None = None
+            if node.attr == "remove_observer":
+                res = "observer"
+            elif node.attr == "unregister_command":
+                res = "admin-command"
+            elif node.attr == "release" and isinstance(
+                    node.value, ast.Name) and node.value.id == "SENTINEL":
+                res = "SENTINEL"
+            else:
+                a = _self_attr(node.value)
+                if a is not None:
+                    res = a
+                elif isinstance(node.value, ast.Name) \
+                        and node.value.id in binds:
+                    res = binds[node.value.id]
+            if res is not None and res not in seen:
+                seen.add(res)
+                rel.append((res, node.lineno))
+        return rel
+
+    # -- the findings ------------------------------------------------------
+    def run(self, start_fn, stop_fn, stop_name: str) -> None:
+        acqs = self.acquires(start_fn)
+        rels = self.releases(stop_fn)
+        rel_by_res = {r: i for i, (r, _ln) in enumerate(rels)}
+        cname = self.ci.name
+
+        # stop-missing
+        for a in acqs:
+            if a.kind == "singleton":
+                self._restart_unsafe(a, cname)
+                continue
+            if a.res not in rel_by_res:
+                self.report(
+                    "stop-missing", a.line, f"{cname}:{a.res}",
+                    f"{cname}.start() brings up {a.kind} '{a.res}' "
+                    f"(line {a.line}) but {cname}.{stop_name}() never "
+                    f"stops/joins/deregisters it — a zombie across "
+                    f"restart")
+
+        # stop-order: consecutive releases of start-ordered resources
+        # must reverse the bring-up order
+        ordered = [(a, rel_by_res[a.res]) for a in acqs
+                   if a.kind != "singleton" and a.res in rel_by_res]
+        ordered.sort(key=lambda p: p[1])  # by release position
+        for (a1, _r1), (a2, _r2) in zip(ordered, ordered[1:]):
+            if a1.order < a2.order:
+                line = rels[rel_by_res[a2.res]][1]
+                self.report(
+                    "stop-order", line,
+                    f"{cname}:{a1.res},{a2.res}",
+                    f"{cname}.{stop_name}() releases '{a1.res}' before "
+                    f"'{a2.res}' though start() brought '{a1.res}' up "
+                    f"first — teardown must reverse bring-up, or "
+                    f"'{a2.res}' runs against a dependency that is "
+                    f"already gone")
+
+        self._fragile(stop_fn, stop_name, cname)
+
+    def _fragile(self, stop_fn, stop_name: str, cname: str) -> None:
+        """The first unprotected may-raise teardown CALL with further
+        teardown after it.  Calls under a try and bound methods handed
+        to a runner are protected by construction."""
+        calls = [n for n in ast.walk(stop_fn)
+                 if isinstance(n, ast.Call)
+                 and isinstance(n.func, ast.Attribute)
+                 and n.func.attr in _RELEASE_METHODS
+                 and n.func.attr not in _SAFE_TEARDOWN]
+        if len(calls) < 2:
+            return
+        protected: set[int] = set()
+        for node in ast.walk(stop_fn):
+            if isinstance(node, ast.Try):
+                for sub in ast.walk(node):
+                    protected.add(id(sub))
+        calls.sort(key=lambda n: (n.lineno, n.col_offset))
+        for c in calls[:-1]:  # the last step strands nothing
+            if id(c) not in protected:
+                what = ast.unparse(c.func)
+                self.report(
+                    "stop-fragile", c.lineno,
+                    f"{cname}:{what}",
+                    f"'{what}()' in {cname}.{stop_name}() can raise "
+                    f"and is not wrapped — a failure here strands "
+                    f"every teardown step after it (wrap each step "
+                    f"best-effort, mgr/daemon.py style)")
+                return
+
+    def _restart_unsafe(self, a: _Acq, cname: str) -> None:
+        if not self.installers.get(a.res, True):
+            self.report(
+                "restart-unsafe", a.line, f"{cname}:{a.res}",
+                f"{cname}.start() calls '{a.res}()' which installs a "
+                f"module global with no first-daemon-wins guard — a "
+                f"second daemon in the process re-topologizes shared "
+                f"state (guard with an applied-flag early return, "
+                f"device_policy.configure_device_policy style)")
+
+
+def _installer_index(mods: list[ModuleInfo]) -> dict[str, bool]:
+    """Module-level functions that assign a module global:
+    name -> has a first-wins guard (any If around / before the
+    install, e.g. ``if _applied: return`` or a conditional assign)."""
+    out: dict[str, bool] = {}
+    for mod in mods:
+        for stmt in mod.tree.body:
+            if not isinstance(stmt, ast.FunctionDef):
+                continue
+            globals_ = {n for s in ast.walk(stmt)
+                        if isinstance(s, ast.Global) for n in s.names}
+            if not globals_:
+                continue
+            assigns = any(
+                isinstance(n, ast.Name) and n.id in globals_
+                and isinstance(n.ctx, ast.Store)
+                for n in ast.walk(stmt))
+            if not assigns:
+                continue
+            guarded = any(isinstance(n, ast.If) for n in ast.walk(stmt))
+            # keep the STRICTEST verdict if the name repeats
+            out[stmt.name] = out.get(stmt.name, True) and guarded
+    return out
+
+
+def check(mods: list[ModuleInfo], sym: SymbolTable,
+          cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple[str, str, str]] = set()
+    installers = _installer_index(mods)
+    by_rel = {m.rel: m for m in mods}
+    for key in sorted(sym.classes):
+        ci = sym.classes[key]
+        if ci.path.startswith("qa/analyzer/"):
+            continue
+        methods = sym.family_methods(ci)
+        if "start" not in methods:
+            continue
+        start_owner, start_fn = methods["start"]
+        if start_owner.key != ci.key:
+            continue  # report once, on the class that defines start()
+        stop_pair = next((methods[n] for n in _STOP_NAMES
+                          if n in methods), None)
+        if stop_pair is None:
+            continue
+        stop_owner, stop_fn = stop_pair
+        stop_name = stop_fn.name
+        mod = by_rel.get(ci.path)
+        if mod is None:
+            continue
+
+        def report(kind, line, ident_tail, msg, _mod=mod):
+            ident = f"{kind}:{ident_tail}"
+            k = ("CL14", _mod.rel, ident)
+            if k not in seen:
+                seen.add(k)
+                findings.append(
+                    Finding("CL14", _mod.rel, line, ident, msg))
+
+        _ClassCheck(ci, sym, mod, installers, report).run(
+            start_fn, stop_fn, stop_name)
+    return findings

@@ -36,6 +36,15 @@ and the smatch/cov scripts).  Five whole-package checks:
          vs documented (and raise-without-clear), admin/mon command
          names sent vs dispatched vs ceph_cli word-forms, stage-name
          sets consistent between tracer, histograms, and docs
+    CL13 resource lifecycle: the RESOURCE_PAIRS acquire/release table
+         (throttle tickets, pool buffers, sentinel refs, provisional
+         traces, threads, observers/commands, files) proved released
+         on every path — leaks on raise/return, double releases,
+         unjoined threads
+    CL14 teardown ordering: start/stop symmetry on lifecycle classes —
+         everything start() brings up stop() must bring down, in
+         reverse order, raise-tolerant, with first-daemon-wins guards
+         on process-wide singleton installs
 
 Suppression layers, innermost first:
 
@@ -255,7 +264,7 @@ class Config:
     docs_tracing: Path | None = None
     checks: tuple[str, ...] = ("CL1", "CL2", "CL3", "CL4", "CL5",
                                "CL6", "CL7", "CL8", "CL9", "CL10",
-                               "CL11", "CL12")
+                               "CL11", "CL12", "CL13", "CL14")
     cl3_dirs: tuple[str, ...] = ("ops", "crush", "parallel", "bench")
     cl1_raw_lock_dirs: tuple[str, ...] = ("osd", "mon", "msg", "store",
                                           "client", "common")
@@ -417,7 +426,7 @@ def run(cfg: Config) -> Report:
     from . import (cl1_locks, cl2_races, cl3_tracing, cl4_failpoints,
                    cl5_options, cl6_proto, cl7_errors, cl8_shapes,
                    cl9_topology, cl10_sharding, cl11_determinism,
-                   cl12_obsdrift)
+                   cl12_obsdrift, cl13_lifecycle, cl14_teardown)
 
     mods = collect_modules(cfg)
     sym = SymbolTable.build(mods)
@@ -434,6 +443,8 @@ def run(cfg: Config) -> Report:
         "CL10": cl10_sharding.check,
         "CL11": cl11_determinism.check,
         "CL12": cl12_obsdrift.check,
+        "CL13": cl13_lifecycle.check,
+        "CL14": cl14_teardown.check,
     }
     raw: list[Finding] = []
     for code in cfg.checks:
@@ -508,6 +519,13 @@ _SARIF_RULES = {
             "tracepoints vs KNOWN_TRACEPOINTS, health checks raised "
             "vs documented, command names sent vs dispatched, "
             "stage-name set consistency)",
+    "CL13": "resource lifecycle (acquire/release pairs checked "
+            "path-sensitively with exception edges: leak-on-raise, "
+            "leak-on-return, double-release, release-unacquired, "
+            "thread-unjoined)",
+    "CL14": "teardown ordering (start/stop symmetry: stop-missing, "
+            "stop-order inversions, stop-fragile unprotected steps, "
+            "restart-unsafe singleton installs)",
     # dynamic findings (qa/race — cephrace shares this report machinery)
     "CR1": "data race (empty lockset + no happens-before edge)",
     "CR2": "deadlock (waits-for cycle closed at runtime)",

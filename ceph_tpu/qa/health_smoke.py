@@ -23,13 +23,14 @@ summary on stdout (the gate archives it next to the SARIF artifacts).
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
 import time
 
 
-from .smoke_util import wait_for as _wait
+from .smoke_util import assert_no_leaked_threads, wait_for as _wait
 
 
 def main() -> int:
@@ -69,6 +70,12 @@ def main() -> int:
             return {}
         return (res.get("health") or {}).get("checks") or {}
 
+    # Runtime twin of the CL13/CL14 lints: every thread bring-up starts
+    # must be gone after teardown.  Held open across the whole cluster
+    # lifecycle; closed below so a leak lands in `problems` (the JSON
+    # summary still renders) instead of a bare traceback.
+    leak_gate = contextlib.ExitStack()
+    leak_gate.enter_context(assert_no_leaked_threads())
     with LocalCluster(n_mons=1, n_osds=2, with_mgr=True,
                       conf_overrides=overrides) as c:
         # -- raise ----------------------------------------------------
@@ -129,6 +136,11 @@ def main() -> int:
                 f"checks did not clear after recovery; "
                 f"still {sorted(checks())}")
         summary["cleared_checks"] = sorted(checks())
+
+    try:
+        leak_gate.close()
+    except AssertionError as e:
+        problems.append(str(e))
 
     summary["problems"] = problems
     print(json.dumps(summary, indent=2, default=str))

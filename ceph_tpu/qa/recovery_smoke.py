@@ -24,6 +24,7 @@ summary on stdout (the gate archives it next to the SARIF artifacts).
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import sys
 import threading
@@ -34,7 +35,8 @@ WSIZE = 4096
 POOL = "healsmoke"
 
 
-from .smoke_util import scrape as _scrape, wait_for as _wait
+from .smoke_util import (assert_no_leaked_threads, scrape as _scrape,
+                         wait_for as _wait)
 
 
 def _series(body: str, metric: str) -> dict[str, float]:
@@ -69,6 +71,12 @@ def main() -> int:
         "trace_sampling_rate": 0.0,   # head sampling OFF: tail must win
         "trace_tail_latency_ms": 40.0,
     }
+    # Runtime twin of the CL13/CL14 lints: every thread bring-up starts
+    # must be gone after teardown.  Held open across the whole cluster
+    # lifecycle; closed below so a leak lands in `problems` (the JSON
+    # summary still renders) instead of a bare traceback.
+    leak_gate = contextlib.ExitStack()
+    leak_gate.enter_context(assert_no_leaked_threads())
     with LocalCluster(n_mons=1, n_osds=K + M, with_mgr=True,
                       conf_overrides=overrides) as c:
         c.create_ec_pool(POOL, k=K, m=M, pg_num=4)
@@ -212,6 +220,11 @@ def main() -> int:
         if not all(wrote.values()):
             problems.append(f"a client never completed a write: {wrote} "
                             f"(first errors: {errors[:3]})")
+
+    try:
+        leak_gate.close()
+    except AssertionError as e:
+        problems.append(str(e))
 
     TRACER.enable(False)
     TRACER.clear()

@@ -131,11 +131,19 @@ class StormCluster:
     def stop(self) -> None:
         for pair in list(self._split_tokens):
             self.heal_racks(*pair)
+        # each step best-effort: a wedged admin client must not strand
+        # the mgr/mon teardown behind it (mgr/daemon.py style)
         if self._admin is not None:
-            self._admin.shutdown()
+            try:
+                self._admin.shutdown()
+            except Exception as e:
+                print(f"storm: admin client shutdown raised: {e!r}")
             self._admin = None
         if self.mgr is not None:
-            self.mgr.shutdown()
+            try:
+                self.mgr.shutdown()
+            except Exception as e:
+                print(f"storm: mgr shutdown raised: {e!r}")
         for mon in self.mons.values():
             mon.shutdown()
 

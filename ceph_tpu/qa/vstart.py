@@ -156,6 +156,10 @@ class LocalCluster:
             self._stop_quietly("rbd-mirror", d.stop)
         for c in self._clients:
             self._stop_quietly("client", c.shutdown)
+            # the cluster minted this client's context (_cct), so the
+            # cluster retires it — the Rados handle itself never owns
+            # its cct (daemons embed Rados handles on shared contexts)
+            self._stop_quietly("client cct", c.cct.shutdown)
         # gateways and the MDS are RADOS clients: stop them while OSDs are
         # still up so their shutdown I/O can reach the pools
         if self.rgw is not None:
@@ -197,6 +201,7 @@ class LocalCluster:
         finally:
             self._clients.remove(c)
             c.shutdown()
+            c.cct.shutdown()
 
     def create_ec_pool(
         self, name: str, k: int = 4, m: int = 2, pg_num: int = 8,

@@ -1516,9 +1516,20 @@ class RGWDaemon:
             self._lc_stop.set()
             self._lc_thread.join(timeout=5)
         if self.httpd is not None:
-            self.httpd.shutdown()
-            self.httpd.server_close()
+            try:
+                self.httpd.shutdown()
+                self.httpd.server_close()
+            except Exception as e:
+                # a wedged listener must not strand the serve-thread
+                # join and rados teardown behind it
+                self.cct.dout("rgw", 0, f"httpd shutdown raised: {e!r}")
         if self._thread is not None:
             self._thread.join(timeout=5)
         if self._rados is not None:
-            self._rados.shutdown()
+            try:
+                self._rados.shutdown()
+            except Exception as e:
+                self.cct.dout("rgw", 0, f"rados shutdown raised: {e!r}")
+        # the context goes last: its admin socket serves debug commands
+        # right up until the daemon is gone
+        self.cct.shutdown()

@@ -5,7 +5,10 @@
 #
 # 1. cephlint --diff BASE_REF  (default origin/main, falling back to
 #    HEAD~1): whole-package static analysis, report narrowed to the
-#    files changed since BASE_REF.
+#    files changed since BASE_REF — then a timed FULL default-check run
+#    as the scan-cost regression guard: the whole-package scan must
+#    stay <=10s (the fast tier-1 budget), cost printed into
+#    cephlint-full.txt next to the SARIF artifact.
 # 2. cephrace --seed SEED (default 1): the short seeded thrash scenario
 #    under the dynamic detector.
 # 3. traffic smoke (ceph_tpu/bench/traffic.py): CPU backend, 2 clients,
@@ -36,9 +39,10 @@
 #    (trace_sampling_rate=0 — the head coin flip said no).
 # 8. QoS smoke (ceph_tpu/qa/qos_smoke.py): the bully scenario (1 heavy
 #    streamer vs N small Poisson writers) on a real LocalCluster,
-#    controller off vs on — fails when victim fairness_ratio does not
-#    improve, aggregate GiB/s regresses >10%, victim p99 improves
-#    <1.5x, or the controller never actually pushed settings.
+#    controller off vs on — fails when worst-victim satisfaction
+#    (achieved/offered) drops below the 0.5 starvation floor,
+#    aggregate GiB/s regresses >10%, victim p99 improves <1.5x, or
+#    the controller never pushed.
 # 9. recovery smoke (ceph_tpu/qa/recovery_smoke.py): kill/revive an OSD
 #    under 2-client traffic — fails unless PG_DEGRADED raises and
 #    clears, progress events complete at 1.0, degraded objects drain to
@@ -109,6 +113,22 @@ elif [ $lint_rc -eq 1 ]; then
     rc=1
 else
     echo "cephlint: clean"
+fi
+
+echo "== cephlint scan-cost guard (full default-check run) =="
+# the fast tier-1 class budgets the whole-package scan at 10s; a new
+# check that blows the budget must fail HERE, not slowly eat tier-1
+lint_t0=$(python -c 'import time; print(time.monotonic())')
+python -m ceph_tpu.qa.analyzer ceph_tpu > "$OUT_DIR/cephlint-full.txt" \
+    || true
+lint_cost=$(python -c "import time; print(round(time.monotonic() - $lint_t0, 2))")
+echo "cephlint full-scan cost: ${lint_cost}s (budget 10s)" \
+    | tee -a "$OUT_DIR/cephlint-full.txt"
+if python -c "import sys; sys.exit(0 if float('$lint_cost') <= 10.0 else 1)"; then
+    echo "cephlint scan cost: OK"
+else
+    echo "cephlint scan cost: ${lint_cost}s EXCEEDS the 10s tier-1 budget"
+    rc=1
 fi
 
 echo "== cephrace (seeded thrash, seed=$SEED) =="

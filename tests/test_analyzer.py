@@ -967,7 +967,7 @@ def test_package_gate_matches_cli():
     # each check ran (the gate isn't green because checks were skipped)
     assert set(cfg.checks) == {"CL1", "CL2", "CL3", "CL4", "CL5",
                                "CL6", "CL7", "CL8", "CL9", "CL10",
-                               "CL11", "CL12"}
+                               "CL11", "CL12", "CL13", "CL14"}
     assert cfg.options_file is not None
     assert cfg.failpoint_file is not None
     assert cfg.docs_fault_injection is not None

@@ -1,0 +1,517 @@
+"""cephlint CL13 (resource lifecycle) + CL14 (teardown ordering) —
+TP/TN fixture pairs per finding kind, the suppression layers on the
+new codes, and the whole-package zero-unsuppressed gate.
+
+Fixtures ride the same conventions as tests/test_analyzer_drift.py:
+tiny package trees under tmp_path, assertions by finding ident so
+line churn never breaks them.  Receivers are typed the same ways the
+real package types them — a local ``Throttle()`` construction, the
+``POOL``/``SENTINEL`` module-global names, ``threading.Thread``
+locals — because that is exactly the resolution surface CL13 has.
+"""
+from __future__ import annotations
+
+import functools
+from pathlib import Path
+
+from ceph_tpu.qa.analyzer.__main__ import main as analyzer_main
+from ceph_tpu.qa.analyzer.core import Config, format_baseline, run
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def make_pkg(tmp_path: Path, files: dict[str, str]) -> Path:
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return pkg
+
+
+def run_on(pkg: Path):
+    return run(Config.discover([str(pkg)]))
+
+
+def idents(report, code: str) -> set[str]:
+    return {f.ident for f in report.findings if f.code == code}
+
+
+# -- CL13: leak-on-raise ----------------------------------------------------
+
+LEAK_RAISE_TP = '''
+class Throttle:
+    pass
+
+
+def submit(n):
+    tick = Throttle()
+    tick.take(n)
+    frobnicate(n)
+    tick.put(n)
+'''
+
+LEAK_RAISE_TN = '''
+class Throttle:
+    pass
+
+
+def submit(n):
+    tick = Throttle()
+    tick.take(n)
+    try:
+        frobnicate(n)
+    finally:
+        tick.put(n)
+'''
+
+# the rs.py idiom: conditional pool acquire, guard-correlated release,
+# finally-protected — must stay silent end to end
+POOL_GUARD_TN = '''
+def rebuild(shards):
+    dev = POOL.put(shards) if POOL.enabled() else shards
+    try:
+        out = decode(dev)
+    finally:
+        if dev is not shards:
+            POOL.release(dev)
+    return out
+'''
+
+# release-and-reraise (the batcher admission-window fix shape): the
+# handler compensates on the error path, the normal return is still a
+# cross-function handoff — both silent
+RERAISE_TN = '''
+class Throttle:
+    pass
+
+
+def admit(n):
+    tick = Throttle()
+    tick.take(n)
+    try:
+        enqueue(n)
+    except Exception:
+        tick.put(n)
+        raise
+    return tick
+'''
+
+
+def test_cl13_leak_on_raise_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path, {"osd/t.py": LEAK_RAISE_TP})),
+                 "CL13")
+    assert got == {"leak-on-raise:submit:tick"}, got
+
+
+def test_cl13_try_finally_tn(tmp_path):
+    assert idents(run_on(make_pkg(tmp_path,
+                                  {"osd/t.py": LEAK_RAISE_TN})),
+                  "CL13") == set()
+
+
+def test_cl13_pool_guard_correlation_tn(tmp_path):
+    assert idents(run_on(make_pkg(tmp_path,
+                                  {"ec/r.py": POOL_GUARD_TN})),
+                  "CL13") == set()
+
+
+def test_cl13_release_and_reraise_tn(tmp_path):
+    assert idents(run_on(make_pkg(tmp_path, {"osd/a.py": RERAISE_TN})),
+                  "CL13") == set()
+
+
+# -- CL13: leak-on-return ---------------------------------------------------
+
+LEAK_RETURN_TP = '''
+class Throttle:
+    pass
+
+
+def fetch(n):
+    tick = Throttle()
+    tick.take(n)
+    try:
+        frobnicate(n)
+    except Exception:
+        return None
+    tick.put(n)
+    return n
+'''
+
+LEAK_RETURN_TN = '''
+class Throttle:
+    pass
+
+
+def fetch(n):
+    tick = Throttle()
+    tick.take(n)
+    try:
+        frobnicate(n)
+    except Exception:
+        tick.put(n)
+        return None
+    tick.put(n)
+    return n
+'''
+
+
+def test_cl13_swallowed_return_leak_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path,
+                                 {"osd/f.py": LEAK_RETURN_TP})), "CL13")
+    assert got == {"leak-on-return:fetch:tick"}, got
+
+
+def test_cl13_release_before_return_tn(tmp_path):
+    assert idents(run_on(make_pkg(tmp_path,
+                                  {"osd/f.py": LEAK_RETURN_TN})),
+                  "CL13") == set()
+
+
+# -- CL13: double-release / release-unacquired ------------------------------
+
+DOUBLE_TP = '''
+class Throttle:
+    pass
+
+
+def toggle(n):
+    tick = Throttle()
+    tick.take(n)
+    tick.put(n)
+    tick.put(n)
+'''
+
+UNACQUIRED_TP = '''
+class Throttle:
+    pass
+
+
+def drain(n):
+    tick = Throttle()
+    if congested():
+        tick.take(n)
+    tick.put(n)
+'''
+
+COND_GUARD_TN = '''
+class Throttle:
+    pass
+
+
+def drain(n):
+    tick = Throttle()
+    got = tick.get(n)
+    if got:
+        tick.put(n)
+'''
+
+
+def test_cl13_double_release_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path, {"osd/d.py": DOUBLE_TP})),
+                 "CL13")
+    assert "double-release:toggle:tick" in got, got
+
+
+def test_cl13_release_unacquired_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path, {"osd/u.py": UNACQUIRED_TP})),
+                 "CL13")
+    assert "release-unacquired:drain:tick" in got, got
+
+
+def test_cl13_cond_acquire_guarded_release_tn(tmp_path):
+    assert idents(run_on(make_pkg(tmp_path,
+                                  {"osd/u.py": COND_GUARD_TN})),
+                  "CL13") == set()
+
+
+# -- CL13: thread-unjoined --------------------------------------------------
+
+THREAD_TP = '''
+import threading
+
+
+def kick():
+    t = threading.Thread(target=frobnicate)
+    t.start()
+'''
+
+THREAD_TN = '''
+import threading
+
+
+def run_once():
+    t = threading.Thread(target=frobnicate)
+    t.start()
+    t.join()
+
+
+class Daemon:
+    def kick(self):
+        t = threading.Thread(target=self._loop)
+        self._threads.append(t)
+        t.start()
+'''
+
+
+def test_cl13_thread_unjoined_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path, {"osd/w.py": THREAD_TP})),
+                 "CL13")
+    assert got == {"thread-unjoined:kick:t"}, got
+
+
+def test_cl13_thread_join_and_handoff_tn(tmp_path):
+    # joined locals are fine; registered-then-started attr threads are
+    # a handoff to stop() (CL14's side of the contract), even when the
+    # append comes BEFORE the start
+    assert idents(run_on(make_pkg(tmp_path, {"osd/w.py": THREAD_TN})),
+                  "CL13") == set()
+
+
+# -- CL14: stop-missing -----------------------------------------------------
+
+STOP_MISSING_TP = '''
+import threading
+
+
+class Daemon:
+    def start(self):
+        self._flusher = threading.Thread(target=self._loop)
+        self._flusher.start()
+
+    def stop(self):
+        self._stopped = True
+'''
+
+STOP_ALIAS_TN = '''
+import threading
+
+
+class Daemon:
+    def start(self):
+        self._flusher = threading.Thread(target=self._loop)
+        self._flusher.start()
+
+    def stop(self):
+        t = self._flusher
+        if t is not None:
+            t.join(timeout=5)
+'''
+
+
+def test_cl14_stop_missing_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path,
+                                 {"osd/d.py": STOP_MISSING_TP})), "CL14")
+    assert got == {"stop-missing:Daemon:_flusher"}, got
+
+
+def test_cl14_join_through_alias_tn(tmp_path):
+    # `t = self._flusher; t.join()` is the batcher stop() idiom
+    assert idents(run_on(make_pkg(tmp_path,
+                                  {"osd/d.py": STOP_ALIAS_TN})),
+                  "CL14") == set()
+
+
+# -- CL14: stop-order -------------------------------------------------------
+
+STOP_ORDER_TP = '''
+class Daemon:
+    def start(self):
+        self.pool.start()
+        self.flusher.start()
+
+    def stop(self):
+        self.pool.stop()
+        self.flusher.stop()
+'''
+
+STOP_ORDER_TN = '''
+class Daemon:
+    def start(self):
+        self.pool.start()
+        self.flusher.start()
+
+    def stop(self):
+        self._stop_one(self.flusher.stop)
+        self.pool.stop()
+
+    def _stop_one(self, fn):
+        try:
+            fn()
+        except Exception as e:
+            log_teardown(e)
+'''
+
+
+def test_cl14_stop_order_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path,
+                                 {"osd/o.py": STOP_ORDER_TP})), "CL14")
+    assert "stop-order:Daemon:pool,flusher" in got, got
+
+
+def test_cl14_reverse_order_bound_method_tn(tmp_path):
+    # reverse teardown through a best-effort runner: the bound-method
+    # reference counts as the release, and the runner is the fragility
+    # protection
+    assert idents(run_on(make_pkg(tmp_path,
+                                  {"osd/o.py": STOP_ORDER_TN})),
+                  "CL14") == set()
+
+
+# -- CL14: stop-fragile -----------------------------------------------------
+
+FRAGILE_TP = '''
+class Daemon:
+    def start(self):
+        self.a.start()
+        self.b.start()
+
+    def stop(self):
+        self.b.stop()
+        self.a.stop()
+'''
+
+FRAGILE_TN = '''
+class Daemon:
+    def start(self):
+        self.a.start()
+        self.b.start()
+
+    def stop(self):
+        try:
+            self.b.stop()
+        except Exception as e:
+            log_teardown(e)
+        self.a.stop()
+'''
+
+
+def test_cl14_stop_fragile_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path, {"osd/g.py": FRAGILE_TP})),
+                 "CL14")
+    assert got == {"stop-fragile:Daemon:self.b.stop"}, got
+
+
+def test_cl14_wrapped_steps_tn(tmp_path):
+    assert idents(run_on(make_pkg(tmp_path, {"osd/g.py": FRAGILE_TN})),
+                  "CL14") == set()
+
+
+# -- CL14: restart-unsafe ---------------------------------------------------
+
+RESTART_TP = '''
+_TOPO = None
+
+
+def install_topology(shape):
+    global _TOPO
+    _TOPO = shape
+
+
+class Daemon:
+    def start(self):
+        install_topology((2, 2))
+        self.a.start()
+
+    def stop(self):
+        self.a.stop()
+'''
+
+RESTART_TN = '''
+_TOPO = None
+
+
+def install_topology(shape):
+    global _TOPO
+    if _TOPO is not None:
+        return
+    _TOPO = shape
+
+
+class Daemon:
+    def start(self):
+        install_topology((2, 2))
+        self.a.start()
+
+    def stop(self):
+        self.a.stop()
+'''
+
+
+def test_cl14_restart_unsafe_tp(tmp_path):
+    got = idents(run_on(make_pkg(tmp_path, {"osd/s.py": RESTART_TP})),
+                 "CL14")
+    assert got == {"restart-unsafe:Daemon:install_topology"}, got
+
+
+def test_cl14_first_wins_guard_tn(tmp_path):
+    assert idents(run_on(make_pkg(tmp_path, {"osd/s.py": RESTART_TN})),
+                  "CL14") == set()
+
+
+# -- suppression layers on the new codes ------------------------------------
+
+def test_cl13_noqa_round_trip(tmp_path):
+    src = LEAK_RAISE_TP.replace(
+        "    frobnicate(n)",
+        "    frobnicate(n)  # noqa: CL13 fixture deliberate leak")
+    report = run_on(make_pkg(tmp_path, {"osd/t.py": src}))
+    assert idents(report, "CL13") == set()
+    assert any(f.ident == "leak-on-raise:submit:tick"
+               for f in report.noqa)
+
+
+def test_cl14_baseline_round_trip_then_stale(tmp_path):
+    pkg = make_pkg(tmp_path, {"osd/d.py": STOP_MISSING_TP})
+    report = run_on(pkg)
+    assert [f.ident for f in report.findings
+            if f.code == "CL14"] == ["stop-missing:Daemon:_flusher"]
+
+    base = pkg / "qa" / "analyzer" / "baseline.toml"
+    base.parent.mkdir(parents=True)
+    base.write_text(format_baseline(report.findings,
+                                    reason="fixture justification"))
+    report2 = run_on(pkg)
+    assert report2.clean
+    assert "stop-missing:Daemon:_flusher" in \
+        [f.ident for f in report2.baselined]
+
+    # pay the debt: the entry goes stale and the CLI exits 1
+    (pkg / "osd" / "d.py").write_text(STOP_ALIAS_TN)
+    report3 = run_on(pkg)
+    assert report3.clean
+    assert "stop-missing:Daemon:_flusher" in \
+        [e["ident"] for e in report3.stale_baseline]
+    assert analyzer_main([str(pkg)]) == 1
+
+
+# -- the whole-package gate -------------------------------------------------
+
+@functools.lru_cache(maxsize=1)
+def _life_scan():
+    cfg = Config.discover([str(REPO / "ceph_tpu")])
+    cfg.checks = ("CL13", "CL14")
+    return cfg, run(cfg)
+
+
+def test_package_cl13_cl14_zero_unsuppressed():
+    """`--checks CL13,CL14` over the real package: zero unsuppressed
+    findings and no stale entries.  This is what pins the leak fixes —
+    reverting the rs.py decode finally, the batcher admission
+    compensation, the recovery sub-chunk release, or any of the
+    daemon-teardown reorders re-opens a finding and fails here."""
+    _cfg, report = _life_scan()
+    assert report.clean, "\n" + report.render_text()
+    assert not report.stale_baseline, report.render_text()
+
+
+def test_package_lifecycle_suppressions_are_scoped():
+    # the debt the new checks carry is the reasoned fire-and-forget
+    # thread set — every suppression is on the new codes, none blanket
+    _cfg, report = _life_scan()
+    assert {f.code for f in report.baselined} <= {"CL13", "CL14"}
+    for f in report.baselined + report.noqa:
+        assert f.code in ("CL13", "CL14")
